@@ -1,0 +1,21 @@
+"""redisson_tpu — a TPU-native in-memory data grid.
+
+Brand-new framework with the capabilities of the reference Java/Redis client
+(`lysdtbu/redisson`, see SURVEY.md): rich distributed objects, synchronizers,
+and distributed services — with the data plane executed on TPU via JAX/XLA
+(sketch/bit/register state as sharded device tensors, compound ops as fused
+kernels dispatched per micro-batch) instead of a Redis server.
+
+Layering (SURVEY.md §7.1):
+  ops/       L1' pure state kernels (BitTensor, HllTensor, ...)
+  core/      L2' execution engine (store, per-shard sequencer, micro-batching)
+  parallel/  L3' mesh/slot topology, sharded kernels, collectives
+  server/    L4' RESP-style asyncio protocol server + client
+  client/    L5'/L6' object handles + Redisson-style entry facade
+  services/  L6' executor, MapReduce, remote service, transactions
+  models/    flagship fused pipelines (bench / graft entry)
+  utils/     hashing, crc16, timers, misc
+"""
+from redisson_tpu.version import __version__  # noqa: F401
+
+__all__ = ["__version__"]

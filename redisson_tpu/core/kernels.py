@@ -1,0 +1,177 @@
+"""Jitted kernel dispatch: shape bucketing + donation + compile cache.
+
+This is the heart of the L2' execution core (SURVEY.md §7.1-2): the reference
+amortizes per-command overhead by pipelining RESP frames over one connection
+(``command/CommandBatchService.java:87-151`` — one CommandsData write per
+shard); the TPU equivalent amortizes XLA dispatch (~10-100us) by packing a
+whole batch of ops into fixed-shape tensors and dispatching ONE compiled
+kernel per (op-kind, shape-bucket).
+
+Shape discipline: batch arrays are padded up to power-of-two buckets so the
+number of distinct compiled programs is O(log max_batch) per op, never
+O(#batch-sizes).  A dynamic `n_valid` scalar masks padding *inside* the kernel
+(padded rows index out of range -> dropped scatters / ignored gathers), so
+padding never corrupts state.
+
+State-mutating kernels donate their state argument: XLA writes the new state
+into the same HBM buffer — in-place semantics without in-place ops.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from redisson_tpu.ops import bittensor as bt
+from redisson_tpu.ops import hll as hll_ops
+from redisson_tpu.utils import hashing as H
+
+MIN_BUCKET = 256
+
+
+def pow2_bucket(n: int, minimum: int = MIN_BUCKET) -> int:
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_to(arr: np.ndarray, size: int, axis: int = 0) -> np.ndarray:
+    """Zero-pad `arr` along `axis` up to `size`."""
+    if arr.shape[axis] == size:
+        return arr
+    pad = [(0, 0)] * arr.ndim
+    pad[axis] = (0, size - arr.shape[axis])
+    return np.pad(arr, pad)
+
+
+def _valid_mask(n: int, n_valid) -> jax.Array:
+    return jnp.arange(n, dtype=jnp.int32) < n_valid
+
+
+# --------------------------------------------------------------------------
+# Bloom filter kernels (state = expanded bit plane; k, m static per filter
+# geometry — the compile cache key).  Reference behavior being replaced:
+# RedissonBloomFilter.java:105-196 (k*N SETBIT/GETBIT per RBatch flush).
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(4, 5), donate_argnums=(0,))
+def bloom_add_u64_masked(bits, lo, hi, n_valid, k: int, m: int):
+    h1, h2 = H.hash_u64_pair(lo, hi, jnp)
+    idx = H.bloom_indexes(h1, h2, k, m, jnp)
+    mask = _valid_mask(lo.shape[0], n_valid)
+    # sentinel = physical plane size (m alone may land in the padding lanes,
+    # which must stay zero for bit_not/length_hint to be correct)
+    idx = jnp.where(mask[:, None], idx, bits.shape[0])  # out of range -> dropped
+    new_bits, newly = bt.set_and_report(bits, idx)
+    return new_bits, newly & mask
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def bloom_contains_u64_masked(bits, lo, hi, n_valid, k: int, m: int):
+    h1, h2 = H.hash_u64_pair(lo, hi, jnp)
+    idx = H.bloom_indexes(h1, h2, k, m, jnp)
+    found = bt.contains(bits, idx)
+    return found & _valid_mask(lo.shape[0], n_valid)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5), donate_argnums=(0,))
+def bloom_add_bytes_masked(bits, words, nbytes, n_valid, k: int, m: int):
+    h1, h2 = H.hash_packed_bytes(words, nbytes, jnp)
+    idx = H.bloom_indexes(h1, h2, k, m, jnp)
+    mask = _valid_mask(h1.shape[0], n_valid)
+    idx = jnp.where(mask[:, None], idx, bits.shape[0])
+    new_bits, newly = bt.set_and_report(bits, idx)
+    return new_bits, newly & mask
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def bloom_contains_bytes_masked(bits, words, nbytes, n_valid, k: int, m: int):
+    h1, h2 = H.hash_packed_bytes(words, nbytes, jnp)
+    idx = H.bloom_indexes(h1, h2, k, m, jnp)
+    return bt.contains(bits, idx) & _valid_mask(h1.shape[0], n_valid)
+
+
+# --- multi-tenant bloom bank: (T, m) bit plane, ops carry a tenant row ------
+# (BASELINE config 2: 1k tenants, one kernel for a mixed 100k-op flush.)
+
+@functools.partial(jax.jit, static_argnums=(5, 6), donate_argnums=(0,))
+def bloom_bank_add_u64(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
+    h1, h2 = H.hash_u64_pair(lo, hi, jnp)
+    idx = H.bloom_indexes(h1, h2, k, m, jnp)
+    mask = _valid_mask(lo.shape[0], n_valid)
+    trow = jnp.where(mask, tenant, bits2d.shape[0])[:, None]
+    old = bits2d.at[trow, idx].get(mode="fill", fill_value=1)
+    newly = jnp.any(old == 0, axis=-1) & mask
+    new_bits = bits2d.at[trow, idx].set(jnp.uint8(1), mode="drop")
+    return new_bits, newly
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def bloom_bank_contains_u64(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
+    h1, h2 = H.hash_u64_pair(lo, hi, jnp)
+    idx = H.bloom_indexes(h1, h2, k, m, jnp)
+    got = bits2d.at[tenant[:, None], idx].get(mode="fill", fill_value=1)
+    return jnp.all(got != 0, axis=-1) & _valid_mask(lo.shape[0], n_valid)
+
+
+# --------------------------------------------------------------------------
+# HLL kernels (replaces server-side PFADD/PFMERGE/PFCOUNT,
+# RedissonHyperLogLog.java:71-102).
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(4,), donate_argnums=(0,))
+def hll_add_u64(regs, lo, hi, n_valid, p: int):
+    h1, h2 = H.hash_u64_pair(lo, hi, jnp)
+    idx, rho = hll_ops.idx_rho(h1, h2, p)
+    idx = jnp.where(_valid_mask(lo.shape[0], n_valid), idx, regs.shape[-1])
+    return hll_ops.add(regs, idx, rho)
+
+
+@functools.partial(jax.jit, static_argnums=(5,), donate_argnums=(0,))
+def hll_bank_add_u64(regs2d, tenant, lo, hi, n_valid, p: int):
+    h1, h2 = H.hash_u64_pair(lo, hi, jnp)
+    idx, rho = hll_ops.idx_rho(h1, h2, p)
+    trow = jnp.where(_valid_mask(lo.shape[0], n_valid), tenant, regs2d.shape[0])
+    return hll_ops.add_bank(regs2d, trow, idx, rho)
+
+
+@functools.partial(jax.jit, static_argnums=(4,), donate_argnums=(0,))
+def hll_add_bytes(regs, words, nbytes, n_valid, p: int):
+    h1, h2 = H.hash_packed_bytes(words, nbytes, jnp)
+    idx, rho = hll_ops.idx_rho(h1, h2, p)
+    idx = jnp.where(_valid_mask(h1.shape[0], n_valid), idx, regs.shape[-1])
+    return hll_ops.add(regs, idx, rho)
+
+
+hll_merge = jax.jit(hll_ops.merge, donate_argnums=(0,))
+hll_estimate = jax.jit(hll_ops.estimate)
+hll_estimate_union = jax.jit(hll_ops.estimate_union)
+
+
+# --------------------------------------------------------------------------
+# BitSet kernels (RedissonBitSet.java surface).
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def bitset_set(bits, idx, n_valid, value):
+    mask = _valid_mask(idx.shape[0], n_valid)
+    safe = jnp.where(mask, idx, bits.shape[0])
+    old = bits.at[safe].get(mode="fill", fill_value=0)
+    return bits.at[safe].set(value.astype(jnp.uint8), mode="drop"), old & mask.astype(jnp.uint8)
+
+
+@jax.jit
+def bitset_get(bits, idx):
+    return bt.get_bits(bits, idx)
+
+
+bitset_popcount = jax.jit(bt.popcount, static_argnums=(1,))
+bitset_and = jax.jit(bt.bit_and, donate_argnums=(0,))
+bitset_or = jax.jit(bt.bit_or, donate_argnums=(0,))
+bitset_xor = jax.jit(bt.bit_xor, donate_argnums=(0,))
+bitset_not = jax.jit(bt.bit_not, static_argnums=(1,), donate_argnums=(0,))
+bitset_bitpos = jax.jit(bt.bitpos, static_argnums=(1, 2))
+bitset_length = jax.jit(bt.length_hint)

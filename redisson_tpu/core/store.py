@@ -1,0 +1,130 @@
+"""DeviceStore: the registry of named device-resident states.
+
+Role parity: in the reference, every RObject is a *stateless handle* and all
+state lives in the Redis server keyed by name (SURVEY.md §1 L5).  Here the
+"server state" is a process-local registry mapping object name -> a state
+record holding device arrays plus metadata (kind, logical sizes, hash/format
+version).  Handles stay stateless; compound mutations flow through the shard
+sequencer (core/sequencer.py) for Lua-equivalent atomicity.
+
+Mutation discipline: states are replaced wholesale (functional update) by
+kernels jitted with donated arguments, so XLA reuses the HBM buffer in place —
+the TPU analogue of Redis mutating its dict entry.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class StateRecord:
+    kind: str                       # "bloom" | "hll" | "bitset" | "bucket" | ...
+    meta: Dict[str, Any] = field(default_factory=dict)
+    arrays: Dict[str, Any] = field(default_factory=dict)  # name -> jax.Array
+    host: Any = None                # host-side python state (dict/list/...)
+    version: int = 0                # bumped on every mutation (optimistic cc)
+    expire_at: Optional[float] = None  # epoch seconds, None = persistent
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.expire_at is not None and (now or time.time()) >= self.expire_at
+
+
+class DeviceStore:
+    """Thread-safe name -> StateRecord registry with TTL semantics.
+
+    TTLs mirror RExpirable (``org/redisson/RedissonExpirable.java``): any
+    object can carry an expiry; expired entries are treated as absent and
+    reaped lazily on access plus periodically by the EvictionScheduler analog.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._states: Dict[str, StateRecord] = {}
+
+    def get(self, name: str) -> Optional[StateRecord]:
+        with self._lock:
+            rec = self._states.get(name)
+            if rec is not None and rec.expired():
+                del self._states[name]
+                return None
+            return rec
+
+    def get_or_create(self, name: str, kind: str, factory: Callable[[], StateRecord]) -> StateRecord:
+        with self._lock:
+            rec = self.get(name)
+            if rec is None:
+                rec = factory()
+                assert rec.kind == kind
+                self._states[name] = rec
+            elif rec.kind != kind:
+                raise TypeError(
+                    f"object '{name}' holds a {rec.kind}, requested {kind} "
+                    "(WRONGTYPE in the reference)"
+                )
+            return rec
+
+    def put(self, name: str, rec: StateRecord) -> None:
+        with self._lock:
+            self._states[name] = rec
+
+    def delete(self, name: str) -> bool:
+        with self._lock:
+            return self._states.pop(name, None) is not None
+
+    def exists(self, name: str) -> bool:
+        return self.get(name) is not None
+
+    def rename(self, old: str, new: str) -> bool:
+        with self._lock:
+            rec = self.get(old)
+            if rec is None:
+                return False
+            if new != old:
+                self._states[new] = rec
+                del self._states[old]
+            return True
+
+    def expire(self, name: str, at: Optional[float]) -> bool:
+        with self._lock:
+            rec = self.get(name)
+            if rec is None:
+                return False
+            rec.expire_at = at
+            return True
+
+    def ttl(self, name: str) -> Optional[float]:
+        """Remaining TTL seconds; None if absent or persistent (pttl analog)."""
+        rec = self.get(name)
+        if rec is None or rec.expire_at is None:
+            return None
+        return max(0.0, rec.expire_at - time.time())
+
+    def keys(self, pattern: Optional[str] = None):
+        """SCAN/KEYS analog (RedissonKeys.java:545 surface)."""
+        import fnmatch
+
+        with self._lock:
+            names = [n for n, r in list(self._states.items()) if not r.expired()]
+        if pattern is None or pattern == "*":
+            return names
+        return [n for n in names if fnmatch.fnmatchcase(n, pattern)]
+
+    def reap_expired(self) -> int:
+        now = time.time()
+        n = 0
+        with self._lock:
+            for name in [n_ for n_, r in self._states.items() if r.expired(now)]:
+                del self._states[name]
+                n += 1
+        return n
+
+    def flushall(self) -> None:
+        with self._lock:
+            self._states.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._states)

@@ -1,0 +1,104 @@
+"""HllTensor: dense HyperLogLog registers as a device tensor.
+
+Capability parity target: RHyperLogLog (``org/redisson/RedissonHyperLogLog.java:71-102``)
+delegates PFADD/PFCOUNT/PFMERGE to the Redis server's sketch implementation.
+Here the sketch math itself is the kernel: registers live in HBM as one uint8
+lane per register, `add` is a scatter-max, `merge` an elementwise max, and the
+cardinality estimate a couple of reduces — so 10k counters batch-add and
+pairwise-merge (BASELINE config 3) run as a handful of fused XLA ops.
+
+Scheme (part of the persisted format, versioned alongside HASH_VERSION):
+  p = 14 (m = 16384 registers, standard error ~0.81/sqrt(m) = 0.63%),
+  register index = h1 & (m-1), rho = clz32(h2) + 1  (h1, h2 independent
+  32-bit hashes from utils.hashing).  Estimator: classic bias-corrected
+  harmonic mean with linear counting for the small range and the 32-bit
+  large-range correction.
+
+A bank of counters is a (T, m) uint8 tensor — multi-tenant by construction
+(BASELINE config 3's "10k counters" is one array, merges are row ops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_P = 14
+
+
+def m_of(p: int) -> int:
+    return 1 << p
+
+
+def alpha(m: int) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1.0 + 1.079 / m)
+
+
+def make(p: int = DEFAULT_P) -> jax.Array:
+    return jnp.zeros((m_of(p),), jnp.uint8)
+
+
+def make_bank(tenants: int, p: int = DEFAULT_P) -> jax.Array:
+    return jnp.zeros((tenants, m_of(p)), jnp.uint8)
+
+
+def idx_rho(h1: jax.Array, h2: jax.Array, p: int = DEFAULT_P):
+    """Register index and rank from a pair of 32-bit hashes."""
+    m = m_of(p)
+    idx = (h1 & jnp.uint32(m - 1)).astype(jnp.int32)
+    rho = (jax.lax.clz(h2.astype(jnp.uint32)) + 1).astype(jnp.uint8)
+    return idx, rho
+
+
+def add(regs: jax.Array, idx: jax.Array, rho: jax.Array) -> jax.Array:
+    """PFADD batch: scatter-max of ranks into registers."""
+    return regs.at[idx].max(rho, mode="drop")
+
+
+def add_bank(regs: jax.Array, tenant: jax.Array, idx: jax.Array, rho: jax.Array) -> jax.Array:
+    """PFADD into a (T, m) bank; tenant/idx/rho are parallel 1-D batches."""
+    return regs.at[tenant, idx].max(rho, mode="drop")
+
+
+def merge(a: jax.Array, b: jax.Array) -> jax.Array:
+    """PFMERGE: register-wise max (RedissonHyperLogLog.java:96-102 mergeWith)."""
+    return jnp.maximum(a, b)
+
+
+def estimate(regs: jax.Array) -> jax.Array:
+    """PFCOUNT on the trailing register axis -> float32 cardinality estimate.
+
+    Works for a single (m,) counter or a (T, m) bank (per-row estimates).
+    """
+    m = regs.shape[-1]
+    r = regs.astype(jnp.float32)
+    inv = jnp.sum(jnp.exp2(-r), axis=-1)
+    e = jnp.float32(alpha(m) * m * m) / inv
+    zeros = jnp.sum((regs == 0).astype(jnp.float32), axis=-1)
+    lin = m * (jnp.log(jnp.float32(m)) - jnp.log(jnp.maximum(zeros, 1.0)))
+    e_small = jnp.where(zeros > 0, lin, e)
+    e = jnp.where(e <= 2.5 * m, e_small, e)
+    two32 = jnp.float32(4294967296.0)
+    e = jnp.where(e > two32 / 30.0, -two32 * jnp.log1p(-e / two32), e)
+    return e
+
+
+def estimate_union(a: jax.Array, b: jax.Array) -> jax.Array:
+    """PFCOUNT over a merged pair without materializing the merge on host."""
+    return estimate(jnp.maximum(a, b))
+
+
+def to_bytes(regs_host: np.ndarray) -> bytes:
+    return np.asarray(regs_host, np.uint8).tobytes()
+
+
+def from_bytes(data: bytes, p: int = DEFAULT_P) -> np.ndarray:
+    arr = np.frombuffer(data, np.uint8)
+    assert arr.shape[0] == m_of(p), "register count mismatch"
+    return arr.copy()

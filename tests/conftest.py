@@ -1,0 +1,25 @@
+"""Hermetic test backend: JAX CPU platform with 8 virtual devices.
+
+Mirrors the reference's test seam (SURVEY.md §4): the reference tests only
+against a real backend over its real protocol; our equivalent hermetic seam is
+the in-process JAX CPU backend, with 8 forced host devices so every sharding /
+mesh code path is exercised exactly as it would be on a v5e-8 slice.
+"""
+import os
+
+# Must be set before jax initializes its backends.  The image pins
+# JAX_PLATFORMS=axon (the real TPU tunnel), so override unconditionally —
+# tests are hermetic on the CPU backend; bench.py uses the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    import jax
+
+    return jax.devices()

@@ -1,0 +1,86 @@
+import jax.numpy as jnp
+import numpy as np
+
+from redisson_tpu.ops import bittensor as bt
+
+
+def test_set_get_roundtrip():
+    bits = bt.make(10_000)
+    idx = jnp.asarray([0, 5, 9999, 1234], jnp.int32)
+    bits = bt.set_bits(bits, idx, 1)
+    got = np.asarray(bt.get_bits(bits, idx))
+    assert got.tolist() == [1, 1, 1, 1]
+    other = np.asarray(bt.get_bits(bits, jnp.asarray([1, 6, 9998], jnp.int32)))
+    assert other.tolist() == [0, 0, 0]
+
+
+def test_clear_bit():
+    bits = bt.make(100)
+    bits = bt.set_bits(bits, jnp.asarray([7], jnp.int32), 1)
+    bits = bt.set_bits(bits, jnp.asarray([7], jnp.int32), 0)
+    assert int(bt.get_bits(bits, jnp.asarray([7], jnp.int32))[0]) == 0
+
+
+def test_duplicate_indices_ok():
+    bits = bt.make(64)
+    idx = jnp.asarray([3, 3, 3, 3], jnp.int32)
+    bits = bt.set_bits(bits, idx, 1)
+    assert int(bt.popcount(bits, 64)) == 1
+
+
+def test_set_and_report_newness():
+    bits = bt.make(1 << 16)
+    rows = jnp.asarray([[1, 2, 3], [10, 20, 30]], jnp.int32)
+    bits, newly = bt.set_and_report(bits, rows)
+    assert np.asarray(newly).tolist() == [True, True]
+    bits, newly = bt.set_and_report(bits, rows)
+    assert np.asarray(newly).tolist() == [False, False]
+    mixed = jnp.asarray([[1, 2, 99]], jnp.int32)  # one fresh bit -> new
+    _, newly = bt.set_and_report(bits, mixed)
+    assert np.asarray(newly).tolist() == [True]
+
+
+def test_contains():
+    bits = bt.make(1 << 12)
+    bits = bt.set_bits(bits, jnp.asarray([5, 6, 7], jnp.int32), 1)
+    q = jnp.asarray([[5, 6, 7], [5, 6, 8]], jnp.int32)
+    assert np.asarray(bt.contains(bits, q)).tolist() == [True, False]
+
+
+def test_popcount_and_bitops():
+    a = bt.make(2048)
+    b = bt.make(2048)
+    a = bt.set_bits(a, jnp.arange(0, 100, dtype=jnp.int32), 1)
+    b = bt.set_bits(b, jnp.arange(50, 150, dtype=jnp.int32), 1)
+    assert int(bt.popcount(a, 2048)) == 100
+    assert int(bt.popcount(bt.bit_and(a, b), 2048)) == 50
+    assert int(bt.popcount(bt.bit_or(a, b), 2048)) == 150
+    assert int(bt.popcount(bt.bit_xor(a, b), 2048)) == 100
+    assert int(bt.popcount(bt.bit_not(a, 2048), 2048)) == 2048 - 100
+
+
+def test_bitpos_and_length():
+    bits = bt.make(4096)
+    assert int(bt.bitpos(bits, 1, 4096)) == -1
+    assert int(bt.bitpos(bits, 0, 4096)) == 0
+    bits = bt.set_bits(bits, jnp.asarray([100, 200], jnp.int32), 1)
+    assert int(bt.bitpos(bits, 1, 4096)) == 100
+    assert int(bt.length_hint(bits)) == 201
+
+
+def test_out_of_range_dropped():
+    bits = bt.make(100)
+    bits = bt.set_bits(bits, jnp.asarray([10_000_000], jnp.int32), 1)
+    assert int(bt.popcount(bits, bits.shape[0])) == 0
+    got = bt.get_bits(bits, jnp.asarray([10_000_000], jnp.int32))
+    assert int(got[0]) == 0
+
+
+def test_pack_roundtrip():
+    bits = bt.make(1000)
+    idx = jnp.asarray([0, 1, 7, 8, 63, 999], jnp.int32)
+    bits = bt.set_bits(bits, idx, 1)
+    packed = bt.to_packed(np.asarray(bits), 1000)
+    assert len(packed) == 125
+    restored = bt.from_packed(packed, 1000)
+    np.testing.assert_array_equal(restored[:1000], np.asarray(bits)[:1000])

@@ -1,0 +1,92 @@
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.core.store import DeviceStore, StateRecord
+
+
+def _rec(kind="bucket"):
+    return StateRecord(kind=kind, host={"v": 1})
+
+
+def test_get_or_create_and_wrongtype():
+    s = DeviceStore()
+    r = s.get_or_create("a", "bucket", lambda: _rec())
+    assert s.get("a") is r
+    with pytest.raises(TypeError):
+        s.get_or_create("a", "bloom", lambda: _rec("bloom"))
+
+
+def test_delete_exists():
+    s = DeviceStore()
+    s.put("a", _rec())
+    assert s.exists("a")
+    assert s.delete("a")
+    assert not s.exists("a")
+    assert not s.delete("a")
+
+
+def test_rename_same_name_noop():
+    s = DeviceStore()
+    s.put("a", _rec())
+    assert s.rename("a", "a")
+    assert s.exists("a")
+
+
+def test_rename_moves():
+    s = DeviceStore()
+    s.put("a", _rec())
+    assert s.rename("a", "b")
+    assert not s.exists("a") and s.exists("b")
+    assert not s.rename("missing", "c")
+
+
+def test_ttl_expiry():
+    s = DeviceStore()
+    s.put("a", _rec())
+    assert s.ttl("a") is None
+    s.expire("a", time.time() + 100)
+    assert 99 < s.ttl("a") <= 100
+    s.expire("a", time.time() - 1)
+    assert s.get("a") is None
+    assert not s.exists("a")
+
+
+def test_keys_pattern_and_reap():
+    s = DeviceStore()
+    for n in ["user:1", "user:2", "order:1"]:
+        s.put(n, _rec())
+    assert sorted(s.keys("user:*")) == ["user:1", "user:2"]
+    assert len(s.keys()) == 3
+    s.expire("order:1", time.time() - 1)
+    assert s.reap_expired() in (0, 1)  # may have been lazily reaped by keys()
+    assert len(s) == 2
+
+
+def test_kernel_padding_sentinel_keeps_padding_lanes_zero():
+    """Regression: padded-row sentinel must be the physical plane size, not m."""
+    import jax.numpy as jnp
+
+    from redisson_tpu.core import kernels as K
+    from redisson_tpu.ops import bittensor as bt
+    from redisson_tpu.utils import hashing as H
+
+    m = 1500  # plane padded to 2048; idx=m would be in-plane
+    bits = bt.make(m)
+    lo, hi = H.int_keys_to_u32_pair(np.arange(256, dtype=np.int64))
+    bits, _ = K.bloom_add_u64_masked(bits, jnp.asarray(lo), jnp.asarray(hi), 0, 3, m)
+    assert int(np.asarray(bits).sum()) == 0
+
+    words, nbytes = H.pack_keys([b"k%d" % i for i in range(256)])
+    bits2 = bt.make(m)
+    bits2, _ = K.bloom_add_bytes_masked(bits2, jnp.asarray(words), jnp.asarray(nbytes), 0, 3, m)
+    assert int(np.asarray(bits2).sum()) == 0
+
+
+def test_hash_empty_batch():
+    from redisson_tpu.utils import hashing as H
+
+    words, nbytes = H.pack_keys([])
+    h1, h2 = H.hash_packed_bytes(words, nbytes, np)
+    assert h1.shape == (0,) and h2.shape == (0,)

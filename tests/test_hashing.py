@@ -1,0 +1,69 @@
+"""Hash kernel invariants: determinism, numpy/jnp agreement, distribution."""
+import jax.numpy as jnp
+import numpy as np
+
+from redisson_tpu.utils import hashing as H
+
+
+def test_versioned():
+    assert H.HASH_VERSION == 1
+    assert H.HASH_NAME.endswith("/1")
+
+
+def test_int_pair_deterministic():
+    keys = np.arange(1000, dtype=np.int64) * 2654435761
+    lo, hi = H.int_keys_to_u32_pair(keys)
+    h1a, h2a = H.hash_u64_pair(lo, hi, np)
+    h1b, h2b = H.hash_u64_pair(lo, hi, np)
+    np.testing.assert_array_equal(h1a, h1b)
+    np.testing.assert_array_equal(h2a, h2b)
+    assert h1a.dtype == np.uint32
+
+
+def test_numpy_jnp_agree():
+    keys = np.arange(512, dtype=np.int64) - 256
+    lo, hi = H.int_keys_to_u32_pair(keys)
+    h1n, h2n = H.hash_u64_pair(lo, hi, np)
+    h1j, h2j = H.hash_u64_pair(jnp.asarray(lo), jnp.asarray(hi), jnp)
+    np.testing.assert_array_equal(h1n, np.asarray(h1j))
+    np.testing.assert_array_equal(h2n, np.asarray(h2j))
+
+
+def test_bytes_numpy_jnp_agree():
+    keys = [b"", b"a", b"abcd", b"abcde", b"hello world this is a longer key", b"\x00\xff" * 9]
+    words, nbytes = H.pack_keys(keys)
+    h1n, h2n = H.hash_packed_bytes(words, nbytes, np)
+    h1j, h2j = H.hash_packed_bytes(jnp.asarray(words), jnp.asarray(nbytes), jnp)
+    np.testing.assert_array_equal(h1n, np.asarray(h1j))
+    np.testing.assert_array_equal(h2n, np.asarray(h2j))
+
+
+def test_h2_odd():
+    lo, hi = H.int_keys_to_u32_pair(np.arange(100, dtype=np.int64))
+    _, h2 = H.hash_u64_pair(lo, hi, np)
+    assert np.all(h2 & 1 == 1)
+
+
+def test_length_sensitive():
+    # b"a" vs b"a\x00" pack to the same words but differ in length
+    words, nbytes = H.pack_keys([b"a", b"a\x00"])
+    h1, _ = H.hash_packed_bytes(words, nbytes, np)
+    assert h1[0] != h1[1]
+
+
+def test_distribution_uniform():
+    lo, hi = H.int_keys_to_u32_pair(np.arange(200_000, dtype=np.int64))
+    h1, h2 = H.hash_u64_pair(lo, hi, np)
+    # no collisions expected in 200k draws from 2^32 at ~0.5% probability...
+    # allow a few, but buckets must be near-uniform
+    counts = np.bincount(h1 >> 24, minlength=256)
+    assert counts.min() > 0.8 * counts.mean()
+    assert counts.max() < 1.2 * counts.mean()
+
+
+def test_bloom_indexes_range():
+    lo, hi = H.int_keys_to_u32_pair(np.arange(1000, dtype=np.int64))
+    h1, h2 = H.hash_u64_pair(lo, hi, np)
+    idx = H.bloom_indexes(h1, h2, 7, 95850584, np)
+    assert idx.shape == (1000, 7)
+    assert idx.min() >= 0 and idx.max() < 95850584

@@ -1,0 +1,91 @@
+import jax.numpy as jnp
+import numpy as np
+
+from redisson_tpu.ops import hll
+from redisson_tpu.utils import hashing as H
+
+
+def _hash_ints(keys):
+    lo, hi = H.int_keys_to_u32_pair(np.asarray(keys, np.int64))
+    return H.hash_u64_pair(jnp.asarray(lo), jnp.asarray(hi), jnp)
+
+
+def _add_ints(regs, keys):
+    h1, h2 = _hash_ints(keys)
+    idx, rho = hll.idx_rho(h1, h2)
+    return hll.add(regs, idx, rho)
+
+
+def test_empty_estimate_zero():
+    regs = hll.make()
+    assert float(hll.estimate(regs)) == 0.0
+
+
+def test_small_cardinality_exactish():
+    regs = _add_ints(hll.make(), np.arange(100))
+    est = float(hll.estimate(regs))
+    assert abs(est - 100) <= 2  # linear-counting regime is near-exact
+
+
+def test_medium_cardinality_within_error():
+    n = 100_000
+    regs = _add_ints(hll.make(), np.arange(n))
+    est = float(hll.estimate(regs))
+    assert abs(est - n) / n < 0.02  # 3x the 0.63% std error
+
+
+def test_duplicates_dont_inflate():
+    regs = _add_ints(hll.make(), np.arange(1000))
+    regs = _add_ints(regs, np.arange(1000))  # same keys again
+    est = float(hll.estimate(regs))
+    assert abs(est - 1000) / 1000 < 0.03
+
+
+def test_merge_is_union():
+    a = _add_ints(hll.make(), np.arange(0, 50_000))
+    b = _add_ints(hll.make(), np.arange(25_000, 75_000))
+    merged = hll.merge(a, b)
+    est = float(hll.estimate(merged))
+    assert abs(est - 75_000) / 75_000 < 0.03
+    # merge is idempotent / commutative
+    np.testing.assert_array_equal(np.asarray(hll.merge(b, a)), np.asarray(merged))
+    np.testing.assert_array_equal(np.asarray(hll.merge(merged, a)), np.asarray(merged))
+
+
+def test_union_estimate_no_materialize():
+    a = _add_ints(hll.make(), np.arange(0, 10_000))
+    b = _add_ints(hll.make(), np.arange(5_000, 15_000))
+    est = float(hll.estimate_union(a, b))
+    assert abs(est - 15_000) / 15_000 < 0.03
+
+
+def test_bank_multi_tenant():
+    regs = hll.make_bank(4)
+    keys = np.arange(4000)
+    tenant = jnp.asarray(keys % 4, jnp.int32)
+    h1, h2 = _hash_ints(keys)
+    idx, rho = hll.idx_rho(h1, h2)
+    regs = hll.add_bank(regs, tenant, idx, rho)
+    ests = np.asarray(hll.estimate(regs))
+    assert ests.shape == (4,)
+    for e in ests:
+        assert abs(e - 1000) / 1000 < 0.1
+
+
+def test_serialization_roundtrip():
+    regs = _add_ints(hll.make(), np.arange(500))
+    data = hll.to_bytes(np.asarray(regs))
+    assert len(data) == 16384
+    back = hll.from_bytes(data)
+    np.testing.assert_array_equal(back, np.asarray(regs))
+
+
+def test_crc16_slots():
+    from redisson_tpu.utils.crc16 import calc_slot, crc16
+
+    # Known CRC16-XModem vector
+    assert crc16(b"123456789") == 0x31C3
+    assert calc_slot(b"123456789") == 0x31C3 % 16384
+    # hashtag colocation
+    assert calc_slot(b"{user1}.following") == calc_slot(b"{user1}.followers")
+    assert calc_slot(b"foo{}{bar}") == crc16(b"foo{}{bar}") % 16384  # empty tag ignored
