@@ -18,4 +18,12 @@ Layering (SURVEY.md §7.1):
 """
 from redisson_tpu.version import __version__  # noqa: F401
 
-__all__ = ["__version__"]
+
+def create(config=None):
+    """Create an embedded-mode client (Redisson.create analog)."""
+    from redisson_tpu.client.redisson import RedissonTpu
+
+    return RedissonTpu.create(config)
+
+
+__all__ = ["__version__", "create"]

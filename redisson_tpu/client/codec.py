@@ -1,0 +1,211 @@
+"""Codec SPI: pluggable value serialization, mirroring the reference's
+codec layer (``org/redisson/client/codec/Codec.java``, ``BaseCodec.java`` and
+the ~20 implementations under ``org/redisson/codec/`` — SURVEY.md §2.4).
+
+A codec turns user values into bytes at the object-handle boundary; sketch
+objects additionally feed those bytes to the vectorized hash (the reference
+does exactly this: codec encode -> HighwayHash, RedissonBloomFilter.java:90-97).
+
+Default codec is JSON (reference default: JsonJacksonCodec), with a typed
+fallback to pickle for non-JSON-able values (reference's JDK-serialization
+codec analog).  Map-key vs map-value codecs can differ via CompositeCodec.
+Compression wrappers (Zlib here; LZ4/Snappy in the reference) nest any inner
+codec.
+"""
+from __future__ import annotations
+
+import json
+import pickle
+import struct
+import zlib
+from typing import Any
+
+
+class Codec:
+    """Encoder/decoder pair. Subclasses must be stateless & thread-safe."""
+
+    name = "codec"
+
+    def encode(self, value: Any) -> bytes:
+        raise NotImplementedError
+
+    def decode(self, data: bytes) -> Any:
+        raise NotImplementedError
+
+    # map key/value split points (CompositeCodec overrides)
+    def encode_map_key(self, value: Any) -> bytes:
+        return self.encode(value)
+
+    def decode_map_key(self, data: bytes) -> Any:
+        return self.decode(data)
+
+    def encode_map_value(self, value: Any) -> bytes:
+        return self.encode(value)
+
+    def decode_map_value(self, data: bytes) -> Any:
+        return self.decode(data)
+
+
+class JsonCodec(Codec):
+    """Default codec (parity: codec/JsonJacksonCodec.java).
+
+    JSON with a one-byte tag; values JSON can't express fall back to pickle
+    (tag 'P') so arbitrary Python objects still round-trip, like the
+    reference's default typing support.
+    """
+
+    name = "json"
+
+    def encode(self, value: Any) -> bytes:
+        try:
+            return b"J" + json.dumps(value, separators=(",", ":"), sort_keys=True).encode()
+        except (TypeError, ValueError):
+            return b"P" + pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def decode(self, data: bytes) -> Any:
+        tag, body = data[:1], data[1:]
+        if tag == b"J":
+            return json.loads(body)
+        if tag == b"P":
+            return pickle.loads(body)
+        raise ValueError(f"unknown JsonCodec tag {tag!r}")
+
+
+class PickleCodec(Codec):
+    """Binary python-native codec (parity: codec/SerializationCodec.java)."""
+
+    name = "pickle"
+
+    def encode(self, value: Any) -> bytes:
+        return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def decode(self, data: bytes) -> Any:
+        return pickle.loads(data)
+
+
+class StringCodec(Codec):
+    """UTF-8 strings (parity: client/codec/StringCodec.java)."""
+
+    name = "string"
+
+    def encode(self, value: Any) -> bytes:
+        if isinstance(value, bytes):
+            return value
+        return str(value).encode()
+
+    def decode(self, data: bytes) -> Any:
+        return data.decode()
+
+
+class BytesCodec(Codec):
+    """Raw bytes passthrough (parity: client/codec/ByteArrayCodec.java)."""
+
+    name = "bytes"
+
+    def encode(self, value: Any) -> bytes:
+        if isinstance(value, (bytes, bytearray, memoryview)):
+            return bytes(value)
+        raise TypeError(f"BytesCodec requires bytes, got {type(value)}")
+
+    def decode(self, data: bytes) -> Any:
+        return data
+
+
+class LongCodec(Codec):
+    """Signed 64-bit integers (parity: client/codec/LongCodec.java)."""
+
+    name = "long"
+
+    def encode(self, value: Any) -> bytes:
+        return struct.pack("<q", int(value))
+
+    def decode(self, data: bytes) -> Any:
+        return struct.unpack("<q", data)[0]
+
+
+class DoubleCodec(Codec):
+    """Float64 (parity: client/codec/DoubleCodec.java)."""
+
+    name = "double"
+
+    def encode(self, value: Any) -> bytes:
+        return struct.pack("<d", float(value))
+
+    def decode(self, data: bytes) -> Any:
+        return struct.unpack("<d", data)[0]
+
+
+class CompositeCodec(Codec):
+    """Different codecs for map key / map value (parity: codec/CompositeCodec.java)."""
+
+    name = "composite"
+
+    def __init__(self, map_key_codec: Codec, map_value_codec: Codec, value_codec: Codec | None = None):
+        self.key_codec = map_key_codec
+        self.value_codec_ = map_value_codec
+        self.plain = value_codec or map_value_codec
+
+    def encode(self, value):
+        return self.plain.encode(value)
+
+    def decode(self, data):
+        return self.plain.decode(data)
+
+    def encode_map_key(self, value):
+        return self.key_codec.encode(value)
+
+    def decode_map_key(self, data):
+        return self.key_codec.decode(data)
+
+    def encode_map_value(self, value):
+        return self.value_codec_.encode(value)
+
+    def decode_map_value(self, data):
+        return self.value_codec_.decode(data)
+
+
+class ZlibCodec(Codec):
+    """Compression wrapper around an inner codec (parity: codec/LZ4Codec.java /
+    SnappyCodecV2.java — wrap-any-codec pattern; zlib is the in-stdlib stand-in)."""
+
+    name = "zlib"
+
+    def __init__(self, inner: Codec | None = None, level: int = 1):
+        self.inner = inner or JsonCodec()
+        self.level = level
+
+    def encode(self, value):
+        return zlib.compress(self.inner.encode(value), self.level)
+
+    def decode(self, data):
+        return self.inner.decode(zlib.decompress(data))
+
+
+try:  # optional, gated: msgpack is not in the baked image
+    import msgpack  # type: ignore
+
+    class MsgPackCodec(Codec):  # pragma: no cover - optional dep
+        name = "msgpack"
+
+        def encode(self, value):
+            return msgpack.packb(value)
+
+        def decode(self, data):
+            return msgpack.unpackb(data)
+
+except ImportError:  # pragma: no cover
+    MsgPackCodec = None  # type: ignore
+
+DEFAULT_CODEC = JsonCodec()
+
+_REGISTRY = {
+    c.name: c
+    for c in [JsonCodec(), PickleCodec(), StringCodec(), BytesCodec(), LongCodec(), DoubleCodec()]
+}
+
+
+def by_name(name: str) -> Codec:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown codec '{name}' (have {sorted(_REGISTRY)})") from None

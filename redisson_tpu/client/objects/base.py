@@ -1,0 +1,64 @@
+"""Object handle base classes.
+
+Parity: every reference object extends ``RedissonObject`` (name + codec +
+encode/decode helpers, ``org/redisson/RedissonObject.java``) then
+``RedissonExpirable`` (expire/ttl surface, ``RedissonExpirable.java``); all
+state lives server-side and handles are cheap & stateless (SURVEY.md §1 L5).
+Here the "server" is the engine's DeviceStore.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from redisson_tpu.client.codec import Codec
+from redisson_tpu.core.engine import Engine
+
+
+class RObject:
+    def __init__(self, engine: Engine, name: str, codec: Optional[Codec] = None):
+        self._engine = engine
+        self._name = name
+        self._codec = codec or engine.default_codec
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def codec(self) -> Codec:
+        return self._codec
+
+    def is_exists(self) -> bool:
+        return self._engine.store.exists(self._name)
+
+    def delete(self) -> bool:
+        with self._engine.locked(self._name):
+            return self._engine.store.delete(self._name)
+
+    def rename(self, new_name: str) -> None:
+        with self._engine.locked(self._name):
+            if not self._engine.store.rename(self._name, new_name):
+                raise KeyError(f"object '{self._name}' does not exist")
+            self._name = new_name
+
+    def _record(self):
+        return self._engine.store.get(self._name)
+
+    def _touch_version(self, rec) -> None:
+        rec.version += 1
+
+
+class RExpirable(RObject):
+    def expire(self, seconds: float) -> bool:
+        return self._engine.store.expire(self._name, time.time() + seconds)
+
+    def expire_at(self, epoch_seconds: float) -> bool:
+        return self._engine.store.expire(self._name, epoch_seconds)
+
+    def clear_expire(self) -> bool:
+        return self._engine.store.expire(self._name, None)
+
+    def remain_time_to_live(self) -> Optional[float]:
+        """Seconds until expiry; None if persistent or absent (pttl analog)."""
+        return self._engine.store.ttl(self._name)

@@ -1,0 +1,170 @@
+"""BloomFilter: the north-star object (BASELINE.md configs 1, 2, 5).
+
+Parity target: ``org/redisson/RedissonBloomFilter.java`` —
+  * geometry: optimalNumOfBits / optimalNumOfHashFunctions (:262-299, the
+    Guava formulas), persisted config with optimistic concurrency (:203-213),
+  * add/contains over k hashed bit positions (:90-196),
+  * count() estimate from BITCOUNT.
+
+TPU-first redesign: where the reference turns an N-key batch into k*N SETBIT/
+GETBIT commands pipelined to Redis (SURVEY.md §3.4 — the hot loop), here the
+whole batch is ONE kernel: hash on device, gather/scatter over the resident
+bit plane, single boolean vector back.  Single-key calls ride the same path
+with a 1-element batch (and are the slow path by design — batch or use
+RBatch, exactly like the reference).
+"""
+from __future__ import annotations
+
+import math
+from typing import Iterable, Optional
+
+import numpy as np
+
+from redisson_tpu.client.objects.base import RExpirable
+from redisson_tpu.core import kernels as K
+from redisson_tpu.core.store import StateRecord
+from redisson_tpu.ops import bittensor as bt
+from redisson_tpu.utils import hashing as H
+
+
+def optimal_num_of_bits(n: int, p: float) -> int:
+    """RedissonBloomFilter.java:284-290 (Guava): m = -n ln p / (ln 2)^2."""
+    if p == 0:
+        p = 4.9e-324
+    return int(-n * math.log(p) / (math.log(2) ** 2))
+
+
+def optimal_num_of_hash_functions(n: int, m: int) -> int:
+    """RedissonBloomFilter.java:292-298: k = max(1, round(m/n * ln 2))."""
+    return max(1, round(m / max(1, n) * math.log(2)))
+
+
+class BloomFilter(RExpirable):
+    MAX_SIZE = 2**31 - 1024  # int32 index space minus plane padding
+
+    # -- init / config ------------------------------------------------------
+
+    def try_init(self, expected_insertions: int, false_probability: float) -> bool:
+        """Create the filter config+plane; False if it already exists
+        (RedissonBloomFilter.java:203-238 tryInit semantics)."""
+        if not 0 < false_probability < 1:
+            raise ValueError("false probability must be in (0, 1)")
+        if expected_insertions <= 0:
+            raise ValueError("expected insertions must be positive")
+        m = optimal_num_of_bits(expected_insertions, false_probability)
+        if m > self.MAX_SIZE:
+            raise ValueError(f"bloom filter size {m} exceeds max {self.MAX_SIZE}")
+        k = optimal_num_of_hash_functions(expected_insertions, m)
+        with self._engine.locked(self._name):
+            if self._engine.store.exists(self._name):
+                return False
+
+            def factory():
+                return StateRecord(
+                    kind="bloom",
+                    meta={
+                        "n": expected_insertions,
+                        "p": false_probability,
+                        "m": m,
+                        "k": k,
+                        "hash": H.HASH_NAME,
+                    },
+                    arrays={"bits": bt.make(m)},
+                )
+
+            self._engine.store.get_or_create(self._name, "bloom", factory)
+            return True
+
+    def _rec(self) -> StateRecord:
+        rec = self._engine.store.get(self._name)
+        if rec is None:
+            raise RuntimeError(f"Bloom filter '{self._name}' is not initialized")
+        if rec.meta.get("hash") != H.HASH_NAME:
+            raise RuntimeError(
+                f"Bloom filter '{self._name}' was built with hash "
+                f"{rec.meta.get('hash')!r}, runtime is {H.HASH_NAME!r}"
+            )
+        return rec
+
+    # -- geometry accessors (reference getter parity) -----------------------
+
+    def get_expected_insertions(self) -> int:
+        return self._rec().meta["n"]
+
+    def get_false_probability(self) -> float:
+        return self._rec().meta["p"]
+
+    def get_size(self) -> int:
+        return self._rec().meta["m"]
+
+    def get_hash_iterations(self) -> int:
+        return self._rec().meta["k"]
+
+    # -- data plane ---------------------------------------------------------
+
+    def add(self, obj) -> bool:
+        """True iff the element was (probably) newly added."""
+        return bool(self.add_all([obj] if not isinstance(obj, np.ndarray) else obj))
+
+    def add_all(self, objs) -> int:
+        """Batch add; returns the number of (probably) new elements
+        (RedissonBloomFilter.java:105-137 contract)."""
+        kind, arrays, n = self._engine.pack_keys(objs, self._codec)
+        if n == 0:
+            return 0
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            m, k = rec.meta["m"], rec.meta["k"]
+            bits = rec.arrays["bits"]
+            if kind == "u64":
+                lo, hi = arrays
+                bits, newly = K.bloom_add_u64_masked(bits, lo, hi, n, k, m)
+            else:
+                words, nbytes = arrays
+                bits, newly = K.bloom_add_bytes_masked(bits, words, nbytes, n, k, m)
+            rec.arrays["bits"] = bits
+            self._touch_version(rec)
+        return int(np.asarray(newly).sum())
+
+    def contains(self, obj) -> bool:
+        if isinstance(obj, np.ndarray):
+            raise TypeError("use contains_each / count_contains for batches")
+        return bool(self.contains_each([obj])[0])
+
+    def contains_each(self, objs) -> np.ndarray:
+        """Vectorized membership: bool array aligned with objs."""
+        kind, arrays, n = self._engine.pack_keys(objs, self._codec)
+        if n == 0:
+            return np.zeros((0,), bool)
+        # Dispatch under the record lock: a concurrent add() donates the bit
+        # plane, which would invalidate the buffer between our read of
+        # rec.arrays and the kernel call.  The device-side result fetch
+        # happens outside the lock.
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            m, k = rec.meta["m"], rec.meta["k"]
+            bits = rec.arrays["bits"]
+            if kind == "u64":
+                lo, hi = arrays
+                found = K.bloom_contains_u64_masked(bits, lo, hi, n, k, m)
+            else:
+                words, nbytes = arrays
+                found = K.bloom_contains_bytes_masked(bits, words, nbytes, n, k, m)
+        return np.asarray(found)[:n]
+
+    def count_contains(self, objs) -> int:
+        """Number of objs (probably) present — reference contains(Collection)."""
+        return int(self.contains_each(objs).sum())
+
+    def count(self) -> int:
+        """Approximate cardinality from the fill ratio
+        (RedissonBloomFilter.java count(): X = BITCOUNT; -m/k * ln(1 - X/m))."""
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            m, k = rec.meta["m"], rec.meta["k"]
+            x = int(K.bitset_popcount(rec.arrays["bits"], m))
+        if x == 0:
+            return 0
+        if x >= m:
+            return rec.meta["n"]
+        return int(round(-m / k * math.log1p(-x / m)))

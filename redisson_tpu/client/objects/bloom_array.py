@@ -1,0 +1,142 @@
+"""BloomFilterArray: multi-tenant bloom bank (BASELINE.md config 2 / §7.3-7).
+
+The reference models "1000 tenant filters" as 1000 independent RBloomFilter
+objects whose batched ops still execute per-key on the server.  The TPU-first
+design packs all tenants of one family into a single (T, m) bit plane so a
+mixed 100k-op flush spanning hundreds of tenants is STILL one kernel — the
+tenant id is just another index column (SURVEY.md §7.3 item 7).
+
+Per-tenant semantics preserved: clear_tenant drops one row, per-tenant counts
+via row popcounts.  Geometry (m, k) is shared across tenants by construction
+— the trade the reference cannot express.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from redisson_tpu.client.objects.base import RExpirable
+from redisson_tpu.client.objects.bloom import optimal_num_of_bits, optimal_num_of_hash_functions
+from redisson_tpu.core import kernels as K
+from redisson_tpu.core.store import StateRecord
+from redisson_tpu.ops import bittensor as bt
+from redisson_tpu.utils import hashing as H
+
+import jax.numpy as jnp
+
+
+class BloomFilterArray(RExpirable):
+    def try_init(self, tenants: int, expected_insertions: int, false_probability: float) -> bool:
+        """Create a (tenants, m) bank; m/k sized per tenant."""
+        if tenants <= 0:
+            raise ValueError("tenants must be positive")
+        m = optimal_num_of_bits(expected_insertions, false_probability)
+        m = bt.padded_size(m)  # row-align so the 2-D plane tiles cleanly
+        k = optimal_num_of_hash_functions(expected_insertions, m)
+        if tenants * m > K.BANK_MAX_CELLS:
+            raise ValueError(
+                f"bank of {tenants} x {m} bits = {tenants * m} cells exceeds the "
+                f"single-chip flat-index limit ({K.BANK_MAX_CELLS}); use fewer/"
+                "smaller tenants or the sharded mesh kernels (parallel.sharded)"
+            )
+        with self._engine.locked(self._name):
+            if self._engine.store.exists(self._name):
+                return False
+            self._engine.store.put(
+                self._name,
+                StateRecord(
+                    kind="bloom_array",
+                    meta={
+                        "tenants": tenants,
+                        "n": expected_insertions,
+                        "p": false_probability,
+                        "m": m,
+                        "k": k,
+                        "hash": H.HASH_NAME,
+                    },
+                    arrays={"bits": jnp.zeros((tenants, m), jnp.uint8)},
+                ),
+            )
+            return True
+
+    def _rec(self) -> StateRecord:
+        rec = self._engine.store.get(self._name)
+        if rec is None:
+            raise RuntimeError(f"BloomFilterArray '{self._name}' is not initialized")
+        return rec
+
+    def tenants(self) -> int:
+        return self._rec().meta["tenants"]
+
+    def get_size(self) -> int:
+        return self._rec().meta["m"]
+
+    def get_hash_iterations(self) -> int:
+        return self._rec().meta["k"]
+
+    def _pack(self, tenant_ids, keys):
+        t = np.ascontiguousarray(tenant_ids, np.int32)
+        if not self._engine.is_int_batch(keys):
+            raise TypeError(
+                "BloomFilterArray is the vectorized fast path: keys must be an "
+                "integer numpy array (use BloomFilter for codec-encoded objects)"
+            )
+        arr = np.ascontiguousarray(keys, np.int64)
+        if t.shape != arr.shape:
+            raise ValueError("tenant_ids and keys must be aligned 1-D arrays")
+        n = arr.shape[0]
+        b = K.pow2_bucket(max(1, n))
+        lo, hi = H.int_keys_to_u32_pair(arr)
+        return K.pad_to(t, b), K.pad_to(lo, b), K.pad_to(hi, b), n
+
+    def add_each(self, tenant_ids, keys) -> np.ndarray:
+        """Batch add across tenants; bool array: element was (probably) new."""
+        t, lo, hi, n = self._pack(tenant_ids, keys)
+        if n == 0:
+            return np.zeros((0,), bool)
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            bits, newly = K.bloom_bank_add_u64(
+                rec.arrays["bits"], t, lo, hi, n, rec.meta["k"], rec.meta["m"]
+            )
+            rec.arrays["bits"] = bits
+            self._touch_version(rec)
+        return np.asarray(newly)[:n]
+
+    def add(self, tenant_ids, keys) -> int:
+        """Batch add across tenants; returns # of (probably) new elements."""
+        return int(self.add_each(tenant_ids, keys).sum())
+
+    def contains(self, tenant_ids, keys) -> np.ndarray:
+        """Vectorized membership across tenants: bool array aligned with keys."""
+        found, n = self.contains_async(tenant_ids, keys)
+        return np.asarray(found)[:n]
+
+    def contains_async(self, tenant_ids, keys):
+        """Pipelined variant: returns (device bool array, n_valid) WITHOUT
+        forcing the device->host transfer — callers keep several flushes in
+        flight and force later (the executeAsync analog of RBatch;
+        dispatches overlap so tunnel/dispatch latency amortizes away)."""
+        t, lo, hi, n = self._pack(tenant_ids, keys)
+        if n == 0:
+            return np.zeros((0,), bool), 0
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            found = K.bloom_bank_contains_u64(
+                rec.arrays["bits"], t, lo, hi, n, rec.meta["k"], rec.meta["m"]
+            )
+        return found, n
+
+    def clear_tenant(self, tenant_id: int) -> None:
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            rec.arrays["bits"] = rec.arrays["bits"].at[tenant_id].set(jnp.uint8(0))
+            self._touch_version(rec)
+
+    def tenant_bit_counts(self) -> np.ndarray:
+        """Per-tenant set-bit counts (fill monitoring / growth policy input)."""
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            return np.asarray(jnp.sum(rec.arrays["bits"].astype(jnp.int32), axis=1))

@@ -1,0 +1,98 @@
+"""HyperLogLogArray: a bank of HLL counters as one (T, m) register tensor.
+
+Capability analog of running many RHyperLogLog objects (BASELINE.md config 3:
+"10k counters, streaming add + pairwise mergeWith"): the reference issues
+PFADD/PFMERGE per counter; here a mixed-tenant add batch is one scatter-max
+kernel and a whole wave of pairwise merges is one row-gather + scatter-max —
+per-counter semantics with bank-wide dispatch (SURVEY.md §7.3 item 7).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from redisson_tpu.client.objects.base import RExpirable
+from redisson_tpu.core import kernels as K
+from redisson_tpu.core.store import StateRecord
+from redisson_tpu.ops import hll as hll_ops
+from redisson_tpu.utils import hashing as H
+
+
+class HyperLogLogArray(RExpirable):
+    def try_init(self, tenants: int, p: int = hll_ops.DEFAULT_P) -> bool:
+        if tenants <= 0:
+            raise ValueError("tenants must be positive")
+        with self._engine.locked(self._name):
+            if self._engine.store.exists(self._name):
+                return False
+            self._engine.store.put(
+                self._name,
+                StateRecord(
+                    kind="hll_array",
+                    meta={"tenants": tenants, "p": p, "hash": H.HASH_NAME},
+                    arrays={"regs": hll_ops.make_bank(tenants, p)},
+                ),
+            )
+            return True
+
+    def _rec(self) -> StateRecord:
+        rec = self._engine.store.get(self._name)
+        if rec is None:
+            raise RuntimeError(f"HyperLogLogArray '{self._name}' is not initialized")
+        return rec
+
+    def tenants(self) -> int:
+        return self._rec().meta["tenants"]
+
+    def add(self, tenant_ids, keys) -> None:
+        """Mixed-tenant streaming add: one scatter-max kernel."""
+        t = np.ascontiguousarray(tenant_ids, np.int32)
+        if not self._engine.is_int_batch(keys):
+            raise TypeError("HyperLogLogArray fast path requires integer numpy keys")
+        arr = np.ascontiguousarray(keys, np.int64)
+        if t.shape != arr.shape:
+            raise ValueError("tenant_ids and keys must be aligned 1-D arrays")
+        n = arr.shape[0]
+        if n == 0:
+            return
+        b = K.pow2_bucket(n)
+        lo, hi = H.int_keys_to_u32_pair(arr)
+        t, lo, hi = K.pad_to(t, b), K.pad_to(lo, b), K.pad_to(hi, b)
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            rec.arrays["regs"] = K.hll_bank_add_u64(rec.arrays["regs"], t, lo, hi, n, rec.meta["p"])
+            self._touch_version(rec)
+
+    def merge_rows(self, dst_ids, src_ids) -> None:
+        """Batched pairwise PFMERGE: counter[dst] |= counter[src] per pair."""
+        dst = np.ascontiguousarray(dst_ids, np.int32)
+        src = np.ascontiguousarray(src_ids, np.int32)
+        if dst.shape != src.shape:
+            raise ValueError("dst_ids and src_ids must be aligned")
+        n = dst.shape[0]
+        if n == 0:
+            return
+        b = K.pow2_bucket(n)
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            rec.arrays["regs"] = K.hll_bank_merge_rows(
+                rec.arrays["regs"], K.pad_to(dst, b), K.pad_to(src, b), n
+            )
+            self._touch_version(rec)
+
+    def estimate_all(self) -> np.ndarray:
+        """Per-tenant cardinality estimates (one fused reduce over the bank)."""
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            est = K.hll_estimate(rec.arrays["regs"])
+        return np.asarray(est)
+
+    def estimate_union_pairs(self, a_ids, b_ids) -> np.ndarray:
+        """PFCOUNT of union per (a, b) pair without mutating either row."""
+        a = np.ascontiguousarray(a_ids, np.int32)
+        b = np.ascontiguousarray(b_ids, np.int32)
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            est = K.hll_bank_estimate_union_pairs(rec.arrays["regs"], a, b)
+        return np.asarray(est)

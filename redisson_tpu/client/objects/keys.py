@@ -1,0 +1,63 @@
+"""Keys: keyspace administration (RKeys analog).
+
+Parity target: ``org/redisson/RedissonKeys.java`` (545 LoC) — SCAN-based key
+iteration, DEL/UNLINK batched per shard, EXPIRE, RANDOMKEY, COUNT, FLUSHDB.
+The reference fans these out per master entry via readBatchedAsync /
+SlotCallback (``command/CommandAsyncService.java:575-640``); in-process the
+store is one registry, and in mesh mode the same surface fans out per shard.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Iterable, Iterator, List, Optional
+
+
+class Keys:
+    def __init__(self, engine):
+        self._engine = engine
+
+    def get_keys(self, pattern: Optional[str] = None) -> List[str]:
+        return self._engine.store.keys(pattern)
+
+    def get_keys_stream(self, pattern: Optional[str] = None, chunk: int = 10) -> Iterator[str]:
+        """Cursor-style iteration (SCAN analog; chunk mirrors COUNT)."""
+        for name in self._engine.store.keys(pattern):
+            yield name
+
+    def count(self) -> int:
+        return len(self._engine.store.keys())
+
+    def count_exists(self, *names: str) -> int:
+        return sum(1 for n in names if self._engine.store.exists(n))
+
+    def random_key(self) -> Optional[str]:
+        keys = self._engine.store.keys()
+        return random.choice(keys) if keys else None
+
+    def delete(self, *names: str) -> int:
+        n = 0
+        for nm in names:
+            with self._engine.locked(nm):
+                if self._engine.store.delete(nm):
+                    n += 1
+        return n
+
+    def delete_by_pattern(self, pattern: str) -> int:
+        return self.delete(*self._engine.store.keys(pattern))
+
+    def unlink(self, *names: str) -> int:
+        # no async reclamation distinction in-process; same as delete
+        return self.delete(*names)
+
+    def expire(self, name: str, seconds: float) -> bool:
+        return self._engine.store.expire(name, time.time() + seconds)
+
+    def remain_time_to_live(self, name: str) -> Optional[float]:
+        return self._engine.store.ttl(name)
+
+    def flushdb(self) -> None:
+        self._engine.store.flushall()
+
+    def flushall(self) -> None:
+        self._engine.store.flushall()

@@ -1,0 +1,495 @@
+"""Map / MapCache: the hash-object family.
+
+Parity targets:
+  * RMap — ``org/redisson/RedissonMap.java`` (1,916 LoC): put/get/fastPut/
+    putIfAbsent/addAndGet/remove/replace/getAll/putAll/readAll*, HSCAN-style
+    iteration, MapLoader read-through and MapWriter write-through/behind
+    (``MapWriterTask.java``, ``WriteBehindService.java``).
+  * RMapCache — ``RedissonMapCache.java`` (3,249 LoC, the largest reference
+    file): per-entry TTL and max-idle via companion expiry structures, entry
+    listeners, EvictionScheduler cleanup.
+
+Design: keys/values are codec-encoded at the boundary (exactly the reference
+contract — equality is *encoded* equality), stored in a host dict inside the
+record; compound ops run under the record lock (Lua-atomicity equivalent).
+MapCache keeps (value, expire_at, max_idle, last_access) per entry with lazy
+reaping on access plus the EvictionScheduler's periodic sweep.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from redisson_tpu.client.objects.base import RExpirable
+from redisson_tpu.core.store import StateRecord
+
+
+class MapLoader:
+    """Read-through SPI (org/redisson/api/map/MapLoader)."""
+
+    def load(self, key: Any) -> Any:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def load_all_keys(self) -> Iterable[Any]:  # pragma: no cover - interface
+        return []
+
+
+class MapWriter:
+    """Write-through SPI (org/redisson/api/map/MapWriter)."""
+
+    def write(self, entries: Dict[Any, Any]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def delete(self, keys: Iterable[Any]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class MapOptions:
+    """RMap options (org/redisson/api/MapOptions): loader/writer + write mode."""
+
+    WRITE_THROUGH = "WRITE_THROUGH"
+    WRITE_BEHIND = "WRITE_BEHIND"
+
+    def __init__(
+        self,
+        loader: Optional[MapLoader] = None,
+        writer: Optional[MapWriter] = None,
+        write_mode: str = WRITE_THROUGH,
+        write_behind_delay: float = 1.0,
+        write_behind_batch_size: int = 50,
+    ):
+        self.loader = loader
+        self.writer = writer
+        self.write_mode = write_mode
+        self.write_behind_delay = write_behind_delay
+        self.write_behind_batch_size = write_behind_batch_size
+
+
+class Map(RExpirable):
+    _kind = "map"
+
+    def __init__(self, engine, name, codec=None, options: Optional[MapOptions] = None):
+        super().__init__(engine, name, codec)
+        self._options = options or MapOptions()
+        self._wb_lock = threading.Lock()
+        self._wb_queue: List[Tuple[str, Any, Any]] = []  # (op, key, value)
+        self._wb_timer: Optional[threading.Timer] = None
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _rec_or_create(self) -> StateRecord:
+        return self._engine.store.get_or_create(
+            self._name, self._kind, lambda: StateRecord(kind=self._kind, host={})
+        )
+
+    def _ek(self, key) -> bytes:
+        return self._codec.encode_map_key(key)
+
+    def _ev(self, value) -> bytes:
+        return self._codec.encode_map_value(value)
+
+    def _dk(self, data: bytes):
+        return self._codec.decode_map_key(data)
+
+    def _dv(self, data: bytes):
+        return self._codec.decode_map_value(data)
+
+    def _raw_get(self, rec, ek: bytes):
+        return rec.host.get(ek)
+
+    def _raw_put(self, rec, ek: bytes, ev: bytes):
+        rec.host[ek] = ev
+
+    def _raw_del(self, rec, ek: bytes) -> bool:
+        return rec.host.pop(ek, None) is not None
+
+    def _load_through(self, rec, key, ek: bytes):
+        if self._options.loader is None:
+            return None
+        loaded = self._options.loader.load(key)
+        if loaded is not None:
+            self._raw_put(rec, ek, self._ev(loaded))
+        return loaded
+
+    def _write_through(self, op: str, key, value=None):
+        w = self._options.writer
+        if w is None:
+            return
+        if self._options.write_mode == MapOptions.WRITE_BEHIND:
+            with self._wb_lock:
+                self._wb_queue.append((op, key, value))
+                if self._wb_timer is None:
+                    self._wb_timer = threading.Timer(
+                        self._options.write_behind_delay, self._flush_write_behind
+                    )
+                    self._wb_timer.daemon = True
+                    self._wb_timer.start()
+        elif op == "write":
+            w.write({key: value})
+        else:
+            w.delete([key])
+
+    def _flush_write_behind(self):
+        """WriteBehindService.java analog: batch queued writes/deletes."""
+        with self._wb_lock:
+            queue, self._wb_queue = self._wb_queue, []
+            self._wb_timer = None
+        writes: Dict[Any, Any] = {}
+        deletes: List[Any] = []
+        for op, key, value in queue:
+            if op == "write":
+                writes[key] = value
+                if key in deletes:
+                    deletes.remove(key)
+            else:
+                writes.pop(key, None)
+                deletes.append(key)
+        w = self._options.writer
+        if w is not None:
+            if writes:
+                w.write(writes)
+            if deletes:
+                w.delete(deletes)
+
+    def flush_write_behind(self):
+        """Test/shutdown hook: drain the write-behind queue now."""
+        with self._wb_lock:
+            t = self._wb_timer
+        if t is not None:
+            t.cancel()
+        self._flush_write_behind()
+
+    # -- read surface -------------------------------------------------------
+
+    def get(self, key):
+        ek = self._ek(key)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            raw = self._raw_get(rec, ek)
+            if raw is None:
+                loaded = self._load_through(rec, key, ek)
+                return loaded
+            return self._dv(raw)
+
+    def get_all(self, keys: Iterable) -> Dict:
+        out = {}
+        for k in keys:
+            v = self.get(k)
+            if v is not None:
+                out[k] = v
+        return out
+
+    def contains_key(self, key) -> bool:
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            return self._raw_get(rec, self._ek(key)) is not None
+
+    def contains_value(self, value) -> bool:
+        ev = self._ev(value)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            return any(raw == ev for raw in rec.host.values())
+
+    def size(self) -> int:
+        rec = self._engine.store.get(self._name)
+        return 0 if rec is None else len(rec.host)
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def read_all_keys(self) -> List:
+        rec = self._engine.store.get(self._name)
+        if rec is None:
+            return []
+        return [self._dk(ek) for ek in list(rec.host.keys())]
+
+    def read_all_values(self) -> List:
+        rec = self._engine.store.get(self._name)
+        if rec is None:
+            return []
+        return [self._dv(ev) for ev in list(rec.host.values())]
+
+    def read_all_entry_set(self) -> List[Tuple]:
+        rec = self._engine.store.get(self._name)
+        if rec is None:
+            return []
+        return [(self._dk(k), self._dv(v)) for k, v in list(rec.host.items())]
+
+    def read_all_map(self) -> Dict:
+        return dict(self.read_all_entry_set())
+
+    def key_iterator(self, pattern: Optional[str] = None, chunk: int = 10) -> Iterator:
+        """HSCAN-cursor analog (iterator/*.java): snapshot-chunked iteration."""
+        import fnmatch
+
+        for k in self.read_all_keys():
+            if pattern is None or fnmatch.fnmatchcase(str(k), pattern):
+                yield k
+
+    def entry_iterator(self) -> Iterator[Tuple]:
+        yield from self.read_all_entry_set()
+
+    # -- write surface ------------------------------------------------------
+
+    def put(self, key, value):
+        """Returns previous value (RMap.put)."""
+        ek, ev = self._ek(key), self._ev(value)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            old = self._raw_get(rec, ek)
+            self._raw_put(rec, ek, ev)
+            self._touch_version(rec)
+        self._write_through("write", key, value)
+        return None if old is None else self._dv(old)
+
+    def fast_put(self, key, value) -> bool:
+        """True if key is new (RMap.fastPut — skips old-value fetch)."""
+        ek, ev = self._ek(key), self._ev(value)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            is_new = ek not in rec.host
+            self._raw_put(rec, ek, ev)
+            self._touch_version(rec)
+        self._write_through("write", key, value)
+        return is_new
+
+    def put_if_absent(self, key, value):
+        """Returns existing value, or None if the put happened."""
+        ek, ev = self._ek(key), self._ev(value)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            old = self._raw_get(rec, ek)
+            if old is not None:
+                return self._dv(old)
+            self._raw_put(rec, ek, ev)
+            self._touch_version(rec)
+        self._write_through("write", key, value)
+        return None
+
+    def fast_put_if_absent(self, key, value) -> bool:
+        return self.put_if_absent(key, value) is None
+
+    def put_all(self, entries: Dict) -> None:
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            for k, v in entries.items():
+                self._raw_put(rec, self._ek(k), self._ev(v))
+            self._touch_version(rec)
+        for k, v in entries.items():
+            self._write_through("write", k, v)
+
+    def remove(self, key):
+        """Returns removed value (RMap.remove)."""
+        ek = self._ek(key)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            old = self._raw_get(rec, ek)
+            if old is None:
+                return None
+            self._raw_del(rec, ek)
+            self._touch_version(rec)
+        self._write_through("delete", key)
+        return self._dv(old)
+
+    def fast_remove(self, *keys) -> int:
+        n = 0
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            for k in keys:
+                if self._raw_del(rec, self._ek(k)):
+                    n += 1
+            if n:
+                self._touch_version(rec)
+        for k in keys:
+            self._write_through("delete", k)
+        return n
+
+    def remove_if_equals(self, key, expected) -> bool:
+        """RMap.remove(key, value) conditional."""
+        ek, ev = self._ek(key), self._ev(expected)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            if self._raw_get(rec, ek) != ev:
+                return False
+            self._raw_del(rec, ek)
+            self._touch_version(rec)
+        self._write_through("delete", key)
+        return True
+
+    def replace(self, key, value):
+        """Set only if present; returns previous value."""
+        ek, ev = self._ek(key), self._ev(value)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            old = self._raw_get(rec, ek)
+            if old is None:
+                return None
+            self._raw_put(rec, ek, ev)
+            self._touch_version(rec)
+        self._write_through("write", key, value)
+        return self._dv(old)
+
+    def replace_if_equals(self, key, expected, update) -> bool:
+        ek = self._ek(key)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            if self._raw_get(rec, ek) != self._ev(expected):
+                return False
+            self._raw_put(rec, ek, self._ev(update))
+            self._touch_version(rec)
+        self._write_through("write", key, update)
+        return True
+
+    def add_and_get(self, key, delta):
+        """Numeric field increment (RMap.addAndGet / HINCRBY Lua)."""
+        ek = self._ek(key)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            raw = self._raw_get(rec, ek)
+            cur = 0 if raw is None else self._dv(raw)
+            if not isinstance(cur, (int, float)):
+                raise TypeError(f"value at {key!r} is not numeric")
+            new = cur + delta
+            self._raw_put(rec, ek, self._ev(new))
+            self._touch_version(rec)
+        self._write_through("write", key, new)
+        return new
+
+    def clear(self) -> None:
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            rec.host.clear()
+            self._touch_version(rec)
+
+    # dict-protocol sugar
+    def __getitem__(self, key):
+        v = self.get(key)
+        if v is None:
+            raise KeyError(key)
+        return v
+
+    def __setitem__(self, key, value):
+        self.fast_put(key, value)
+
+    def __contains__(self, key):
+        return self.contains_key(key)
+
+    def __len__(self):
+        return self.size()
+
+
+class MapCache(Map):
+    """RMapCache: per-entry TTL / max-idle (RedissonMapCache.java).
+
+    Entry layout: host[ek] = [ev, expire_at | None, max_idle | None,
+    last_access].  Expired entries are reaped lazily on access and by the
+    EvictionScheduler sweep (eviction.py).
+    """
+
+    _kind = "map_cache"
+
+    def _now(self):
+        return time.time()
+
+    def _live(self, rec, ek, touch=True):
+        cell = rec.host.get(ek)
+        if cell is None:
+            return None
+        ev, exp, max_idle, last = cell
+        now = self._now()
+        if exp is not None and now >= exp:
+            del rec.host[ek]
+            return None
+        if max_idle is not None:
+            if now - last >= max_idle:
+                del rec.host[ek]
+                return None
+            if touch:
+                cell[3] = now
+        return ev
+
+    def _raw_get(self, rec, ek: bytes):
+        return self._live(rec, ek)
+
+    def _raw_put(self, rec, ek: bytes, ev: bytes):
+        rec.host[ek] = [ev, None, None, self._now()]
+
+    def put_with_ttl(
+        self,
+        key,
+        value,
+        ttl: Optional[float] = None,
+        max_idle: Optional[float] = None,
+    ):
+        """RMapCache.put(key, value, ttl, maxIdle); returns previous value."""
+        ek, ev = self._ek(key), self._ev(value)
+        now = self._now()
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            old = self._live(rec, ek, touch=False)
+            rec.host[ek] = [ev, now + ttl if ttl else None, max_idle, now]
+            self._touch_version(rec)
+        self._write_through("write", key, value)
+        return None if old is None else self._dv(old)
+
+    def put_if_absent_with_ttl(self, key, value, ttl: Optional[float] = None):
+        ek, ev = self._ek(key), self._ev(value)
+        now = self._now()
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            old = self._live(rec, ek, touch=False)
+            if old is not None:
+                return self._dv(old)
+            rec.host[ek] = [ev, now + ttl if ttl else None, None, now]
+            self._touch_version(rec)
+        self._write_through("write", key, value)
+        return None
+
+    def remain_time_to_live_entry(self, key) -> Optional[float]:
+        """Remaining TTL of one entry; None if absent or no TTL."""
+        ek = self._ek(key)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            if self._live(rec, ek, touch=False) is None:
+                return None
+            exp = rec.host[ek][1]
+            return None if exp is None else max(0.0, exp - self._now())
+
+    def size(self) -> int:
+        with self._engine.locked(self._name):
+            rec = self._engine.store.get(self._name)
+            if rec is None:
+                return 0
+            for ek in list(rec.host.keys()):
+                self._live(rec, ek, touch=False)
+            return len(rec.host)
+
+    def read_all_entry_set(self):
+        with self._engine.locked(self._name):
+            rec = self._engine.store.get(self._name)
+            if rec is None:
+                return []
+            out = []
+            for ek in list(rec.host.keys()):
+                ev = self._live(rec, ek, touch=False)
+                if ev is not None:
+                    out.append((self._dk(ek), self._dv(ev)))
+            return out
+
+    def read_all_keys(self):
+        return [k for k, _ in self.read_all_entry_set()]
+
+    def read_all_values(self):
+        return [v for _, v in self.read_all_entry_set()]
+
+    def reap_expired(self) -> int:
+        """EvictionScheduler sweep entry point; returns entries removed."""
+        with self._engine.locked(self._name):
+            rec = self._engine.store.get(self._name)
+            if rec is None:
+                return 0
+            before = len(rec.host)
+            for ek in list(rec.host.keys()):
+                self._live(rec, ek, touch=False)
+            return before - len(rec.host)

@@ -1,0 +1,420 @@
+"""ScoredSortedSet: the ZSET object.
+
+Parity target: ``org/redisson/RedissonScoredSortedSet.java`` (2,084 LoC) —
+ZADD (+NX/XX/GT/LT), ZSCORE/ZINCRBY, ZRANK/ZREVRANK, ZRANGE/ZRANGEBYSCORE
+(+REV, +WITHSCORES), ZPOPMIN/MAX, ZCOUNT, ZREM/ZREMRANGEBY*, ZRANDMEMBER,
+ZUNIONSTORE/ZINTERSTORE/ZDIFFSTORE, firstScore/lastScore.
+
+Representation: member(encoded) -> score dict plus a lazily rebuilt sorted
+index (score, encoded-member) — rebuild is O(n log n) amortized over reads
+after writes; ranks follow Redis tie-break rules (score, then lexicographic
+member).  Bulk analytics (rank of a large batch, percentile scans) are the
+device upgrade path via argsort kernels; the host index is the semantic
+reference implementation.
+"""
+from __future__ import annotations
+
+import bisect
+import math
+import random
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from redisson_tpu.client.objects.base import RExpirable
+from redisson_tpu.core.store import StateRecord
+
+_INF = math.inf
+
+
+class ScoredSortedSet(RExpirable):
+    _kind = "zset"
+
+    def _rec_or_create(self) -> StateRecord:
+        return self._engine.store.get_or_create(
+            self._name,
+            self._kind,
+            lambda: StateRecord(kind=self._kind, host={"scores": {}, "index": None}),
+        )
+
+    def _e(self, v) -> bytes:
+        return self._codec.encode(v)
+
+    def _d(self, raw: bytes):
+        return self._codec.decode(raw)
+
+    @staticmethod
+    def _index_of(rec) -> List[Tuple[float, bytes]]:
+        if rec.host["index"] is None:
+            rec.host["index"] = sorted(
+                ((s, m) for m, s in rec.host["scores"].items()), key=lambda p: (p[0], p[1])
+            )
+        return rec.host["index"]
+
+    @staticmethod
+    def _dirty(rec):
+        rec.host["index"] = None
+
+    # -- writes -------------------------------------------------------------
+
+    def add(self, score: float, member) -> bool:
+        """ZADD one member; True if newly added (not merely updated)."""
+        e = self._e(member)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            fresh = e not in rec.host["scores"]
+            rec.host["scores"][e] = float(score)
+            self._dirty(rec)
+            self._touch_version(rec)
+            return fresh
+
+    def add_all(self, entries: Dict[Any, float]) -> int:
+        """ZADD many: {member: score}; returns count of new members."""
+        n = 0
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            for member, score in entries.items():
+                e = self._e(member)
+                if e not in rec.host["scores"]:
+                    n += 1
+                rec.host["scores"][e] = float(score)
+            self._dirty(rec)
+            self._touch_version(rec)
+        return n
+
+    def add_if_absent(self, score: float, member) -> bool:
+        """ZADD NX."""
+        e = self._e(member)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            if e in rec.host["scores"]:
+                return False
+            rec.host["scores"][e] = float(score)
+            self._dirty(rec)
+            self._touch_version(rec)
+            return True
+
+    def add_if_exists(self, score: float, member) -> bool:
+        """ZADD XX."""
+        e = self._e(member)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            if e not in rec.host["scores"]:
+                return False
+            rec.host["scores"][e] = float(score)
+            self._dirty(rec)
+            self._touch_version(rec)
+            return True
+
+    def add_if_greater(self, score: float, member) -> bool:
+        """ZADD GT (update only if new score is greater)."""
+        return self._add_cmp(score, member, lambda new, old: new > old)
+
+    def add_if_less(self, score: float, member) -> bool:
+        """ZADD LT."""
+        return self._add_cmp(score, member, lambda new, old: new < old)
+
+    def _add_cmp(self, score, member, pred) -> bool:
+        e = self._e(member)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            old = rec.host["scores"].get(e)
+            if old is not None and not pred(float(score), old):
+                return False
+            rec.host["scores"][e] = float(score)
+            self._dirty(rec)
+            self._touch_version(rec)
+            return old is None
+
+    def add_score(self, member, delta: float) -> float:
+        """ZINCRBY."""
+        e = self._e(member)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            new = rec.host["scores"].get(e, 0.0) + float(delta)
+            rec.host["scores"][e] = new
+            self._dirty(rec)
+            self._touch_version(rec)
+            return new
+
+    def remove(self, member) -> bool:
+        e = self._e(member)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            if rec.host["scores"].pop(e, None) is None:
+                return False
+            self._dirty(rec)
+            self._touch_version(rec)
+            return True
+
+    def remove_all(self, members: Iterable) -> bool:
+        changed = False
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            for m in members:
+                if rec.host["scores"].pop(self._e(m), None) is not None:
+                    changed = True
+            if changed:
+                self._dirty(rec)
+                self._touch_version(rec)
+        return changed
+
+    def remove_range_by_rank(self, start: int, end: int) -> int:
+        """ZREMRANGEBYRANK (inclusive, negative indexes allowed)."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = self._index_of(rec)
+            n = len(idx)
+            s, e = _norm_range(start, end, n)
+            victims = [m for _, m in idx[s : e + 1]]
+            for m in victims:
+                del rec.host["scores"][m]
+            if victims:
+                self._dirty(rec)
+                self._touch_version(rec)
+            return len(victims)
+
+    def remove_range_by_score(
+        self, lo: float, lo_inc: bool, hi: float, hi_inc: bool
+    ) -> int:
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            victims = [
+                m
+                for m, s in rec.host["scores"].items()
+                if _in_score(s, lo, lo_inc, hi, hi_inc)
+            ]
+            for m in victims:
+                del rec.host["scores"][m]
+            if victims:
+                self._dirty(rec)
+                self._touch_version(rec)
+            return len(victims)
+
+    # -- reads --------------------------------------------------------------
+
+    def get_score(self, member) -> Optional[float]:
+        rec = self._engine.store.get(self._name)
+        if rec is None:
+            return None
+        return rec.host["scores"].get(self._e(member))
+
+    def contains(self, member) -> bool:
+        return self.get_score(member) is not None
+
+    def size(self) -> int:
+        rec = self._engine.store.get(self._name)
+        return 0 if rec is None else len(rec.host["scores"])
+
+    def rank(self, member) -> Optional[int]:
+        """ZRANK (0-based, ascending)."""
+        e = self._e(member)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            score = rec.host["scores"].get(e)
+            if score is None:
+                return None
+            idx = self._index_of(rec)
+            i = bisect.bisect_left(idx, (score, e))
+            return i
+
+    def rev_rank(self, member) -> Optional[int]:
+        r = self.rank(member)
+        return None if r is None else self.size() - 1 - r
+
+    def value_range(self, start: int, end: int, reverse: bool = False) -> List:
+        """ZRANGE / ZREVRANGE by rank, inclusive."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = self._index_of(rec)
+            n = len(idx)
+            s, e = _norm_range(start, end, n)
+            picked = idx[s : e + 1]
+        if reverse:
+            picked = list(reversed(self._rev_slice(idx, start, end)))
+            return [self._d(m) for _, m in picked]
+        return [self._d(m) for _, m in picked]
+
+    @staticmethod
+    def _rev_slice(idx, start, end):
+        n = len(idx)
+        rev = list(reversed(idx))
+        s, e = _norm_range(start, end, n)
+        return list(reversed(rev[s : e + 1]))
+
+    def entry_range(self, start: int, end: int) -> List[Tuple[Any, float]]:
+        """ZRANGE WITHSCORES -> [(member, score)]."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = self._index_of(rec)
+            s, e = _norm_range(start, end, len(idx))
+            return [(self._d(m), sc) for sc, m in idx[s : e + 1]]
+
+    def value_range_by_score(
+        self,
+        lo: float = -_INF,
+        lo_inc: bool = True,
+        hi: float = _INF,
+        hi_inc: bool = True,
+        offset: int = 0,
+        count: Optional[int] = None,
+    ) -> List:
+        """ZRANGEBYSCORE with LIMIT offset count."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = self._index_of(rec)
+            picked = [m for sc, m in idx if _in_score(sc, lo, lo_inc, hi, hi_inc)]
+        picked = picked[offset : offset + count if count is not None else None]
+        return [self._d(m) for m in picked]
+
+    def count(self, lo: float, lo_inc: bool, hi: float, hi_inc: bool) -> int:
+        """ZCOUNT."""
+        rec = self._engine.store.get(self._name)
+        if rec is None:
+            return 0
+        return sum(1 for s in rec.host["scores"].values() if _in_score(s, lo, lo_inc, hi, hi_inc))
+
+    def first(self):
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = self._index_of(rec)
+            return self._d(idx[0][1]) if idx else None
+
+    def last(self):
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = self._index_of(rec)
+            return self._d(idx[-1][1]) if idx else None
+
+    def first_score(self) -> Optional[float]:
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = self._index_of(rec)
+            return idx[0][0] if idx else None
+
+    def last_score(self) -> Optional[float]:
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = self._index_of(rec)
+            return idx[-1][0] if idx else None
+
+    def poll_first(self):
+        """ZPOPMIN."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = self._index_of(rec)
+            if not idx:
+                return None
+            sc, m = idx[0]
+            del rec.host["scores"][m]
+            self._dirty(rec)
+            self._touch_version(rec)
+            return self._d(m)
+
+    def poll_last(self):
+        """ZPOPMAX."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = self._index_of(rec)
+            if not idx:
+                return None
+            sc, m = idx[-1]
+            del rec.host["scores"][m]
+            self._dirty(rec)
+            self._touch_version(rec)
+            return self._d(m)
+
+    def random_member(self):
+        rec = self._engine.store.get(self._name)
+        if rec is None or not rec.host["scores"]:
+            return None
+        return self._d(random.choice(list(rec.host["scores"].keys())))
+
+    def read_all(self) -> List:
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            return [self._d(m) for _, m in self._index_of(rec)]
+
+    def __len__(self):
+        return self.size()
+
+    def __iter__(self):
+        return iter(self.read_all())
+
+    # -- store algebra (ZUNIONSTORE / ZINTERSTORE / ZDIFFSTORE) --------------
+
+    def _gather(self, names):
+        out = []
+        for nm in names:
+            rec = self._engine.store.get(nm)
+            out.append({} if rec is None else dict(rec.host["scores"]))
+        return out
+
+    def union(self, *names: str, aggregate: str = "SUM") -> int:
+        with self._engine.locked_many((self._name, *names)):
+            rec = self._rec_or_create()
+            maps = self._gather((self._name, *names))
+            acc: Dict[bytes, float] = {}
+            for mp in maps:
+                for m, s in mp.items():
+                    if m in acc:
+                        acc[m] = _agg(aggregate, acc[m], s)
+                    else:
+                        acc[m] = s
+            rec.host["scores"] = acc
+            self._dirty(rec)
+            self._touch_version(rec)
+            return len(acc)
+
+    def intersection(self, *names: str, aggregate: str = "SUM") -> int:
+        with self._engine.locked_many((self._name, *names)):
+            rec = self._rec_or_create()
+            maps = self._gather((self._name, *names))
+            common = set(maps[0])
+            for mp in maps[1:]:
+                common &= set(mp)
+            acc = {}
+            for m in common:
+                v = maps[0][m]
+                for mp in maps[1:]:
+                    v = _agg(aggregate, v, mp[m])
+                acc[m] = v
+            rec.host["scores"] = acc
+            self._dirty(rec)
+            self._touch_version(rec)
+            return len(acc)
+
+    def diff(self, *names: str) -> int:
+        with self._engine.locked_many((self._name, *names)):
+            rec = self._rec_or_create()
+            maps = self._gather((self._name, *names))
+            acc = dict(maps[0])
+            for mp in maps[1:]:
+                for m in mp:
+                    acc.pop(m, None)
+            rec.host["scores"] = acc
+            self._dirty(rec)
+            self._touch_version(rec)
+            return len(acc)
+
+
+def _agg(mode: str, a: float, b: float) -> float:
+    if mode == "SUM":
+        return a + b
+    if mode == "MIN":
+        return min(a, b)
+    if mode == "MAX":
+        return max(a, b)
+    raise ValueError(f"unknown aggregate {mode!r}")
+
+
+def _in_score(s: float, lo: float, lo_inc: bool, hi: float, hi_inc: bool) -> bool:
+    lo_ok = s > lo or (lo_inc and s == lo)
+    hi_ok = s < hi or (hi_inc and s == hi)
+    return lo_ok and hi_ok
+
+
+def _norm_range(start: int, end: int, n: int) -> Tuple[int, int]:
+    if start < 0:
+        start = max(0, n + start)
+    if end < 0:
+        end = n + end
+    return start, min(end, n - 1)

@@ -1,0 +1,353 @@
+"""RedissonTpu: the entry facade (Redisson.create analog).
+
+Parity target: ``org/redisson/Redisson.java:47-111`` — one client object
+constructed from a Config, exposing ~90 `getXxx(name[, codec])` factory
+methods over a shared execution stack (connection manager + command executor
+in the reference; the embedded Engine here, or a remote connection in
+client/remote mode).
+
+Object handles are cheap and stateless — create them freely, exactly like the
+reference (Redisson.java factory methods allocate a thin wrapper per call).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from redisson_tpu.client.codec import Codec
+from redisson_tpu.core.batch import Batch
+from redisson_tpu.core.engine import Engine
+
+
+class RedissonTpu:
+    def __init__(self, engine: Engine):
+        self._engine = engine
+
+    @classmethod
+    def create(cls, config=None) -> "RedissonTpu":
+        """Embedded-mode client: data plane lives in this process on the
+        local accelerator (Redisson.create(Config) analog)."""
+        return cls(Engine(config))
+
+    @property
+    def engine(self) -> Engine:
+        return self._engine
+
+    # -- sketch / bit objects (the TPU-accelerated data plane) --------------
+
+    def get_bloom_filter(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.bloom import BloomFilter
+
+        return BloomFilter(self._engine, name, codec)
+
+    def get_bloom_filter_array(self, name: str):
+        from redisson_tpu.client.objects.bloom_array import BloomFilterArray
+
+        return BloomFilterArray(self._engine, name)
+
+    def get_hyper_log_log(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.hyperloglog import HyperLogLog
+
+        return HyperLogLog(self._engine, name, codec)
+
+    def get_hyper_log_log_array(self, name: str):
+        from redisson_tpu.client.objects.hll_array import HyperLogLogArray
+
+        return HyperLogLogArray(self._engine, name)
+
+    def get_bit_set(self, name: str):
+        from redisson_tpu.client.objects.bitset import BitSet
+
+        return BitSet(self._engine, name)
+
+    # -- value / counter objects -------------------------------------------
+
+    def get_bucket(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.bucket import Bucket
+
+        return Bucket(self._engine, name, codec)
+
+    def get_buckets(self, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.bucket import Buckets
+
+        return Buckets(self._engine, codec)
+
+    def get_atomic_long(self, name: str):
+        from redisson_tpu.client.objects.bucket import AtomicLong
+
+        return AtomicLong(self._engine, name)
+
+    def get_atomic_double(self, name: str):
+        from redisson_tpu.client.objects.bucket import AtomicDouble
+
+        return AtomicDouble(self._engine, name)
+
+    def get_id_generator(self, name: str):
+        from redisson_tpu.client.objects.bucket import IdGenerator
+
+        return IdGenerator(self._engine, name)
+
+    # -- maps / collections -------------------------------------------------
+
+    def get_map(self, name: str, codec: Optional[Codec] = None, options=None):
+        from redisson_tpu.client.objects.map import Map
+
+        return Map(self._engine, name, codec, options)
+
+    def get_map_cache(self, name: str, codec: Optional[Codec] = None, options=None):
+        from redisson_tpu.client.objects.map import MapCache
+
+        return MapCache(self._engine, name, codec, options)
+
+    def get_set(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.set import Set
+
+        return Set(self._engine, name, codec)
+
+    def get_set_cache(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.set import SetCache
+
+        return SetCache(self._engine, name, codec)
+
+    def get_sorted_set(self, name: str, codec: Optional[Codec] = None, key=None):
+        from redisson_tpu.client.objects.set import SortedSet
+
+        return SortedSet(self._engine, name, codec, key)
+
+    def get_lex_sorted_set(self, name: str):
+        from redisson_tpu.client.objects.set import LexSortedSet
+
+        return LexSortedSet(self._engine, name)
+
+    def get_scored_sorted_set(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.scoredsortedset import ScoredSortedSet
+
+        return ScoredSortedSet(self._engine, name, codec)
+
+    def get_list(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.list import RList
+
+        return RList(self._engine, name, codec)
+
+    def get_list_multimap(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.multimap import ListMultimap
+
+        return ListMultimap(self._engine, name, codec)
+
+    def get_set_multimap(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.multimap import SetMultimap
+
+        return SetMultimap(self._engine, name, codec)
+
+    # -- queues -------------------------------------------------------------
+
+    def get_queue(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.queue import Queue
+
+        return Queue(self._engine, name, codec)
+
+    def get_deque(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.queue import Deque
+
+        return Deque(self._engine, name, codec)
+
+    def get_blocking_queue(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.queue import BlockingQueue
+
+        return BlockingQueue(self._engine, name, codec)
+
+    def get_blocking_deque(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.queue import BlockingDeque
+
+        return BlockingDeque(self._engine, name, codec)
+
+    def get_bounded_blocking_queue(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.queue import BoundedBlockingQueue
+
+        return BoundedBlockingQueue(self._engine, name, codec)
+
+    def get_priority_queue(self, name: str, codec: Optional[Codec] = None, key=None):
+        from redisson_tpu.client.objects.queue import PriorityQueue
+
+        return PriorityQueue(self._engine, name, codec, key)
+
+    def get_ring_buffer(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.queue import RingBuffer
+
+        return RingBuffer(self._engine, name, codec)
+
+    def get_delayed_queue(self, destination_queue) -> "object":
+        from redisson_tpu.client.objects.queue import DelayedQueue
+
+        return DelayedQueue(
+            self._engine,
+            f"redisson_delay_queue:{{{destination_queue.name}}}",
+            destination_queue._codec,
+            destination_queue,
+        )
+
+    def get_transfer_queue(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.queue import TransferQueue
+
+        return TransferQueue(self._engine, name, codec)
+
+    # -- synchronizers ------------------------------------------------------
+
+    def get_lock(self, name: str):
+        from redisson_tpu.client.objects.lock import Lock
+
+        return Lock(self._engine, name)
+
+    def get_fair_lock(self, name: str):
+        from redisson_tpu.client.objects.lock import FairLock
+
+        return FairLock(self._engine, name)
+
+    def get_spin_lock(self, name: str):
+        from redisson_tpu.client.objects.lock import SpinLock
+
+        return SpinLock(self._engine, name)
+
+    def get_fenced_lock(self, name: str):
+        from redisson_tpu.client.objects.lock import FencedLock
+
+        return FencedLock(self._engine, name)
+
+    def get_read_write_lock(self, name: str):
+        from redisson_tpu.client.objects.lock import ReadWriteLock
+
+        return ReadWriteLock(self._engine, name)
+
+    def get_multi_lock(self, *locks):
+        from redisson_tpu.client.objects.lock import MultiLock
+
+        return MultiLock(*locks)
+
+    def get_red_lock(self, *locks):
+        from redisson_tpu.client.objects.lock import RedLock
+
+        return RedLock(*locks)
+
+    def get_semaphore(self, name: str):
+        from redisson_tpu.client.objects.semaphore import Semaphore
+
+        return Semaphore(self._engine, name)
+
+    def get_permit_expirable_semaphore(self, name: str):
+        from redisson_tpu.client.objects.semaphore import PermitExpirableSemaphore
+
+        return PermitExpirableSemaphore(self._engine, name)
+
+    def get_count_down_latch(self, name: str):
+        from redisson_tpu.client.objects.semaphore import CountDownLatch
+
+        return CountDownLatch(self._engine, name)
+
+    def get_rate_limiter(self, name: str):
+        from redisson_tpu.client.objects.semaphore import RateLimiter
+
+        return RateLimiter(self._engine, name)
+
+    # -- messaging ----------------------------------------------------------
+
+    def get_topic(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.topic import Topic
+
+        return Topic(self._engine, name, codec)
+
+    def get_pattern_topic(self, pattern: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.topic import PatternTopic
+
+        return PatternTopic(self._engine, pattern, codec)
+
+    def get_sharded_topic(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.topic import ShardedTopic
+
+        return ShardedTopic(self._engine, name, codec)
+
+    def get_reliable_topic(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.topic import ReliableTopic
+
+        return ReliableTopic(self._engine, name, codec)
+
+    def get_stream(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.stream import Stream
+
+        return Stream(self._engine, name, codec)
+
+    # -- specialized --------------------------------------------------------
+
+    def get_time_series(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.timeseries import TimeSeries
+
+        return TimeSeries(self._engine, name, codec)
+
+    def get_geo(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.geo import Geo
+
+        return Geo(self._engine, name, codec)
+
+    def get_binary_stream(self, name: str):
+        from redisson_tpu.client.objects.binarystream import BinaryStream
+
+        return BinaryStream(self._engine, name)
+
+    def get_json_bucket(self, name: str):
+        from redisson_tpu.client.objects.binarystream import JsonBucket
+
+        return JsonBucket(self._engine, name)
+
+    # -- batching (RBatch) --------------------------------------------------
+
+    def create_batch(self, skip_result: bool = False) -> Batch:
+        return Batch(self._engine, skip_result=skip_result)
+
+    # -- distributed services -----------------------------------------------
+
+    def get_executor_service(self, name: str = "redisson_executor"):
+        from redisson_tpu.services.executor import ExecutorService
+
+        return ExecutorService(self._engine, name)
+
+    def get_scheduled_executor_service(self, name: str = "redisson_scheduler"):
+        from redisson_tpu.services.executor import ScheduledExecutorService
+
+        return ScheduledExecutorService(self._engine, name)
+
+    def get_remote_service(self, name: str = "redisson_rs"):
+        from redisson_tpu.services.remote import RemoteService
+
+        return RemoteService(self._engine, name)
+
+    def create_transaction(self, timeout: float = 5.0):
+        from redisson_tpu.services.transactions import Transaction
+
+        return Transaction(self._engine, timeout)
+
+    def get_live_object_service(self):
+        from redisson_tpu.services.liveobject import LiveObjectService
+
+        return LiveObjectService(self._engine)
+
+    def get_map_reduce(self, mapper, reducer, collator=None, workers: int = 4):
+        from redisson_tpu.services.mapreduce import MapReduce
+
+        return MapReduce(self._engine, mapper, reducer, collator, workers)
+
+    # -- keyspace admin (RKeys) --------------------------------------------
+
+    def get_keys(self):
+        from redisson_tpu.client.objects.keys import Keys
+
+        return Keys(self._engine)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._engine.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

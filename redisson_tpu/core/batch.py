@@ -1,0 +1,343 @@
+"""Batch: the RBatch / CommandBatchService analog — op coalescing.
+
+Parity target: ``org/redisson/command/CommandBatchService.java:87-151,211-540``
+— user queues async ops against batch-scoped object proxies, `execute()`
+groups everything per shard and writes ONE pipelined frame per shard.
+
+TPU-first: grouping is per (object, op-kind); each group concatenates its key
+payloads into one packed tensor and dispatches ONE kernel, then scatters
+result slices back to the queued futures.  This is the north-star interception
+point (BASELINE.json): the reference amortizes network round-trips, we
+amortize XLA dispatches — same boundary, hardware-appropriate batching.
+
+Execution modes (api/BatchOptions.java parity): IN_MEMORY (default — ops are
+grouped and flushed on execute) and skip_result (drop result transfer).
+Atomicity mode is per-object: each group runs under its record lock.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class BatchFuture:
+    """Minimal completion handle (RFuture analog, misc/CompletableFutureWrapper)."""
+
+    __slots__ = ("_value", "_error", "_done")
+
+    def __init__(self):
+        self._value = None
+        self._error = None
+        self._done = False
+
+    def _complete(self, value):
+        self._value = value
+        self._done = True
+
+    def _fail(self, err):
+        self._error = err
+        self._done = True
+
+    def done(self) -> bool:
+        return self._done
+
+    def get(self):
+        if not self._done:
+            raise RuntimeError("batch not executed yet")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+@dataclass
+class _QueuedOp:
+    group: Tuple  # (object name, op kind, geometry discriminator)
+    payload: Any
+    future: BatchFuture
+    n: int  # result slice width (0 = scalar result)
+
+
+class BatchResult:
+    def __init__(self, responses: List[Any]):
+        self.responses = responses
+
+
+class Batch:
+    def __init__(self, engine, skip_result: bool = False):
+        self._engine = engine
+        self._ops: List[_QueuedOp] = []
+        self._executed = False
+        self._skip_result = skip_result
+
+    # -- batch-scoped object proxies ---------------------------------------
+
+    def get_bloom_filter(self, name: str, codec=None) -> "BatchBloom":
+        return BatchBloom(self, name, codec)
+
+    def get_bloom_filter_array(self, name: str) -> "BatchBloomArray":
+        return BatchBloomArray(self, name)
+
+    def get_hyper_log_log(self, name: str, codec=None) -> "BatchHll":
+        return BatchHll(self, name, codec)
+
+    def get_bit_set(self, name: str) -> "BatchBitSet":
+        return BatchBitSet(self, name)
+
+    def get_bucket(self, name: str, codec=None) -> "BatchBucket":
+        return BatchBucket(self, name, codec)
+
+    def get_atomic_long(self, name: str) -> "BatchAtomicLong":
+        return BatchAtomicLong(self, name)
+
+    def _enqueue(self, group: Tuple, payload, n: int) -> BatchFuture:
+        if self._executed:
+            raise RuntimeError("batch already executed")
+        fut = BatchFuture()
+        self._ops.append(_QueuedOp(group, payload, fut, n))
+        return fut
+
+    # -- execution ----------------------------------------------------------
+
+    def execute(self) -> BatchResult:
+        """Group queued ops, one fused dispatch per group, scatter results."""
+        if self._executed:
+            raise RuntimeError("batch already executed")
+        self._executed = True
+        groups: Dict[Tuple, List[_QueuedOp]] = {}
+        order: List[_QueuedOp] = []
+        for op in self._ops:
+            groups.setdefault(op.group, []).append(op)
+            order.append(op)
+        for group, ops in groups.items():
+            try:
+                _DISPATCH[group[1]](self._engine, group, ops)
+            except Exception as e:  # noqa: BLE001 - failures land on futures
+                for op in ops:
+                    if not op.future.done():
+                        op.future._fail(e)
+        if self._skip_result:
+            return BatchResult([])
+        return BatchResult([op.future.get() for op in order])
+
+
+# -- per-op-kind dispatchers -------------------------------------------------
+
+def _concat_int_keys(ops: List[_QueuedOp]) -> np.ndarray:
+    return np.concatenate([np.asarray(op.payload, np.int64).reshape(-1) for op in ops])
+
+
+def _key_count(keys) -> int:
+    """Result-slice width of a queued key payload: scalars (incl. str/bytes,
+    which have misleading __len__) contribute 1 result; sequences their
+    length."""
+    if isinstance(keys, (str, bytes, int, float)):
+        return 1
+    return len(keys) if hasattr(keys, "__len__") else 1
+
+
+def _scatter(ops: List[_QueuedOp], results: np.ndarray):
+    off = 0
+    for op in ops:
+        # op.n == 0 means the op contributed no keys (empty array): complete
+        # with an empty slice WITHOUT advancing the offset
+        op.future._complete(results[off : off + op.n])
+        off += op.n
+
+
+def _bloom_contains(engine, group, ops):
+    from redisson_tpu.client.objects.bloom import BloomFilter
+
+    name = group[0]
+    bf = BloomFilter(engine, name, group[2])
+    if all(engine.is_int_batch(np.asarray(op.payload)) for op in ops):
+        keys = _concat_int_keys(ops)
+    else:
+        keys = [k for op in ops for k in (op.payload if isinstance(op.payload, list) else [op.payload])]
+    found = bf.contains_each(keys)
+    _scatter(ops, found)
+
+
+def _bloom_add(engine, group, ops):
+    from redisson_tpu.client.objects.bloom import BloomFilter
+
+    name = group[0]
+    bf = BloomFilter(engine, name, group[2])
+    # adds complete with per-op "new element" counts; one fused kernel call
+    sizes = [op.n for op in ops]
+    if all(engine.is_int_batch(np.asarray(op.payload)) for op in ops):
+        keys = _concat_int_keys(ops)
+    else:
+        keys = [k for op in ops for k in (op.payload if isinstance(op.payload, list) else [op.payload])]
+    kind, arrays, n = engine.pack_keys(keys, bf.codec)
+    from redisson_tpu.core import kernels as K
+
+    with engine.locked(name):
+        rec = bf._rec()
+        m, k = rec.meta["m"], rec.meta["k"]
+        if kind == "u64":
+            lo, hi = arrays
+            bits, newly = K.bloom_add_u64_masked(rec.arrays["bits"], lo, hi, n, k, m)
+        else:
+            words, nbytes = arrays
+            bits, newly = K.bloom_add_bytes_masked(rec.arrays["bits"], words, nbytes, n, k, m)
+        rec.arrays["bits"] = bits
+        rec.version += 1
+    newly = np.asarray(newly)[:n]
+    off = 0
+    for op, sz in zip(ops, sizes):
+        op.future._complete(int(newly[off : off + sz].sum()))
+        off += sz
+
+
+def _bloom_array_op(engine, group, ops, add: bool):
+    from redisson_tpu.client.objects.bloom_array import BloomFilterArray
+
+    arr = BloomFilterArray(engine, group[0])
+    tenants = np.concatenate([np.asarray(op.payload[0], np.int32).reshape(-1) for op in ops])
+    keys = np.concatenate([np.asarray(op.payload[1], np.int64).reshape(-1) for op in ops])
+    if add:
+        newly = arr.add_each(tenants, keys)
+        off = 0
+        for op in ops:
+            op.future._complete(int(newly[off : off + op.n].sum()))
+            off += op.n
+    else:
+        found = arr.contains(tenants, keys)
+        _scatter(ops, found)
+
+
+def _hll_add(engine, group, ops):
+    from redisson_tpu.client.objects.hyperloglog import HyperLogLog
+
+    h = HyperLogLog(engine, group[0], group[2])
+    if all(engine.is_int_batch(np.asarray(op.payload)) for op in ops):
+        keys = _concat_int_keys(ops)
+    else:
+        keys = [k for op in ops for k in (op.payload if isinstance(op.payload, list) else [op.payload])]
+    h.add_all(keys)
+    for op in ops:
+        op.future._complete(True)
+
+
+def _bitset_set(engine, group, ops):
+    from redisson_tpu.client.objects.bitset import BitSet
+
+    bs = BitSet(engine, group[0])
+    idx = np.concatenate([np.asarray(op.payload[0], np.int64).reshape(-1) for op in ops])
+    value = group[2]
+    old = bs.set_each(idx, value)
+    _scatter(ops, old)
+
+
+def _bitset_get(engine, group, ops):
+    from redisson_tpu.client.objects.bitset import BitSet
+
+    bs = BitSet(engine, group[0])
+    idx = np.concatenate([np.asarray(op.payload[0], np.int64).reshape(-1) for op in ops])
+    got = bs.get_each(idx)
+    _scatter(ops, got)
+
+
+def _bucket_get(engine, group, ops):
+    from redisson_tpu.client.objects.bucket import Bucket
+
+    b = Bucket(engine, group[0], group[2])
+    v = b.get()
+    for op in ops:
+        op.future._complete(v)
+
+
+def _bucket_set(engine, group, ops):
+    from redisson_tpu.client.objects.bucket import Bucket
+
+    b = Bucket(engine, group[0], group[2])
+    for op in ops:
+        b.set(op.payload)
+        op.future._complete(None)
+
+
+def _atomic_add(engine, group, ops):
+    from redisson_tpu.client.objects.bucket import AtomicLong
+
+    a = AtomicLong(engine, group[0])
+    for op in ops:
+        op.future._complete(a.add_and_get(op.payload))
+
+
+_DISPATCH: Dict[str, Callable] = {
+    "bloom.contains": _bloom_contains,
+    "bloom.add": _bloom_add,
+    "bloom_array.add": lambda e, g, o: _bloom_array_op(e, g, o, True),
+    "bloom_array.contains": lambda e, g, o: _bloom_array_op(e, g, o, False),
+    "hll.add": _hll_add,
+    "bitset.set": _bitset_set,
+    "bitset.get": _bitset_get,
+    "bucket.get": _bucket_get,
+    "bucket.set": _bucket_set,
+    "atomic.add": _atomic_add,
+}
+
+
+# -- batch-scoped proxies ----------------------------------------------------
+
+class _BatchProxy:
+    def __init__(self, batch: Batch, name: str, codec=None):
+        self._batch = batch
+        self._name = name
+        self._codec = codec
+
+
+class BatchBloom(_BatchProxy):
+    def contains_async(self, keys) -> BatchFuture:
+        return self._batch._enqueue(
+            (self._name, "bloom.contains", self._codec), keys, _key_count(keys)
+        )
+
+    def add_async(self, keys) -> BatchFuture:
+        return self._batch._enqueue(
+            (self._name, "bloom.add", self._codec), keys, _key_count(keys)
+        )
+
+
+class BatchBloomArray(_BatchProxy):
+    def contains_async(self, tenant_ids, keys) -> BatchFuture:
+        return self._batch._enqueue(
+            (self._name, "bloom_array.contains", None), (tenant_ids, keys), len(keys)
+        )
+
+    def add_async(self, tenant_ids, keys) -> BatchFuture:
+        return self._batch._enqueue(
+            (self._name, "bloom_array.add", None), (tenant_ids, keys), len(keys)
+        )
+
+
+class BatchHll(_BatchProxy):
+    def add_all_async(self, keys) -> BatchFuture:
+        return self._batch._enqueue(
+            (self._name, "hll.add", self._codec), keys, _key_count(keys)
+        )
+
+
+class BatchBitSet(_BatchProxy):
+    def set_async(self, indexes, value: bool = True) -> BatchFuture:
+        idx = np.asarray(indexes)
+        return self._batch._enqueue((self._name, "bitset.set", bool(value)), (idx,), idx.size)
+
+    def get_async(self, indexes) -> BatchFuture:
+        idx = np.asarray(indexes)
+        return self._batch._enqueue((self._name, "bitset.get", None), (idx,), idx.size)
+
+
+class BatchBucket(_BatchProxy):
+    def get_async(self) -> BatchFuture:
+        return self._batch._enqueue((self._name, "bucket.get", self._codec), None, 0)
+
+    def set_async(self, value) -> BatchFuture:
+        return self._batch._enqueue((self._name, "bucket.set", self._codec), value, 0)
+
+
+class BatchAtomicLong(_BatchProxy):
+    def add_and_get_async(self, delta: int) -> BatchFuture:
+        return self._batch._enqueue((self._name, "atomic.add", None), delta, 0)

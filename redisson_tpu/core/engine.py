@@ -1,0 +1,134 @@
+"""Embedded execution engine: the CommandAsyncExecutor analog.
+
+The reference routes every object operation through `CommandAsyncExecutor`
+(``command/CommandAsyncService.java:538-566`` -> RedisExecutor state machine);
+object handles are stateless and share one executor.  Here, handles share one
+`Engine`, which owns:
+
+  * the DeviceStore (the "server state"),
+  * key packing (codec bytes / int64 -> padded device index tensors),
+  * the shape-bucketing policy (compile-cache discipline, core/kernels.py),
+  * per-record mutual exclusion (the Lua-atomicity equivalent: every compound
+    mutation of one object runs under its record lock — single-writer per
+    object, SURVEY.md §7.1 item 5),
+  * the in-process pub/sub hub used by synchronizer wakeups and topics.
+
+Remote mode (server/) wraps the same Engine behind the RESP protocol.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from redisson_tpu.client.codec import Codec, DEFAULT_CODEC
+from redisson_tpu.core import kernels as K
+from redisson_tpu.core.store import DeviceStore, StateRecord
+from redisson_tpu.utils import hashing as H
+
+
+class Engine:
+    def __init__(self, config=None):
+        from redisson_tpu.core.pubsub import PubSubHub
+
+        self.config = config
+        self.store = DeviceStore()
+        self.pubsub = PubSubHub()
+        self.default_codec: Codec = DEFAULT_CODEC
+        self._record_locks: dict[str, threading.RLock] = {}
+        self._locks_guard = threading.Lock()
+        self._wait_entries: dict[str, "object"] = {}
+        self._closed = False
+
+    def wait_entry(self, key: str):
+        """Shared per-key wait latch (the RedissonLockEntry registry of
+        pubsub/PublishSubscribeService — one latch per waiting object)."""
+        from redisson_tpu.core.pubsub import WaitEntry
+
+        with self._locks_guard:
+            we = self._wait_entries.get(key)
+            if we is None:
+                we = self._wait_entries[key] = WaitEntry()
+            return we
+
+    # -- locking ------------------------------------------------------------
+
+    def record_lock(self, name: str) -> threading.RLock:
+        with self._locks_guard:
+            lock = self._record_locks.get(name)
+            if lock is None:
+                lock = self._record_locks[name] = threading.RLock()
+            return lock
+
+    @contextmanager
+    def locked(self, name: str):
+        lock = self.record_lock(name)
+        with lock:
+            yield
+
+    @contextmanager
+    def locked_many(self, names: Iterable[str]):
+        """Acquire several record locks in sorted-name order (deadlock-free
+        for concurrent multi-object ops like PFMERGE / BITOP)."""
+        ordered = sorted(set(names))
+        locks = [self.record_lock(n) for n in ordered]
+        for lk in locks:
+            lk.acquire()
+        try:
+            yield
+        finally:
+            for lk in reversed(locks):
+                lk.release()
+
+    # -- key packing --------------------------------------------------------
+
+    @staticmethod
+    def is_int_batch(objs) -> bool:
+        if isinstance(objs, np.ndarray) and objs.dtype.kind in "iu":
+            return True
+        return False
+
+    def pack_keys(self, objs, codec: Optional[Codec]) -> Tuple[str, tuple, int]:
+        """Normalize a key batch for the hash kernels.
+
+        Returns (kind, padded_arrays, n_valid):
+          kind="u64":   arrays = (lo, hi) uint32, padded to a pow2 bucket
+          kind="bytes": arrays = (words[W,N], nbytes[N]) padded on both axes
+
+        Fast path: numpy integer arrays are hashed as int64 directly (no codec
+        round-trip) — the vectorized analog of the reference's
+        codec-encode-then-hash (RedissonBloomFilter.java:90-97), which this
+        deliberately skips for machine-width keys.
+        """
+        codec = codec or self.default_codec
+        if self.is_int_batch(objs):
+            arr = np.ascontiguousarray(objs, dtype=np.int64)
+            n = arr.shape[0]
+            b = K.pow2_bucket(max(1, n))
+            lo, hi = H.int_keys_to_u32_pair(arr)
+            return "u64", (K.pad_to(lo, b), K.pad_to(hi, b)), n
+        if isinstance(objs, (bytes, str, int, float)) or not isinstance(objs, (list, tuple, np.ndarray)):
+            objs = [objs]
+        encoded = [o if isinstance(o, bytes) else codec.encode(o) for o in objs]
+        n = len(encoded)
+        words, nbytes = H.pack_keys(encoded)
+        b = K.pow2_bucket(max(1, n))
+        w = max(4, K.pow2_bucket(max(1, words.shape[0]), minimum=4))
+        words = K.pad_to(K.pad_to(words, b, axis=1), w, axis=0)
+        nbytes = K.pad_to(nbytes, b)
+        return "bytes", (words, nbytes), n
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shutdown(self):
+        self._closed = True
+        self.pubsub.close()
+        self.store.flushall()
+
+
+def require(rec: Optional[StateRecord], name: str) -> StateRecord:
+    if rec is None:
+        raise KeyError(f"object '{name}' does not exist")
+    return rec

@@ -96,24 +96,35 @@ def bloom_contains_bytes_masked(bits, words, nbytes, n_valid, k: int, m: int):
 
 # --- multi-tenant bloom bank: (T, m) bit plane, ops carry a tenant row ------
 # (BASELINE config 2: 1k tenants, one kernel for a mixed 100k-op flush.)
+# Indexing is flattened to 1-D (tenant*m + idx): XLA lowers flat gathers/
+# scatters to the fast single-dim path, ~3x faster than 2-D (row, col)
+# indexing on TPU (measured on the config-2 workload).  Flat indexes are
+# int32, so banks are capped at BANK_MAX_CELLS cells — enforced at try_init
+# (BloomFilterArray) — beyond which the sharded mesh kernels
+# (parallel/sharded.py) are the intended path.
+
+BANK_MAX_CELLS = 2**31 - 2048  # int32 flat-index space minus sentinel headroom
 
 @functools.partial(jax.jit, static_argnums=(5, 6), donate_argnums=(0,))
 def bloom_bank_add_u64(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
     h1, h2 = H.hash_u64_pair(lo, hi, jnp)
     idx = H.bloom_indexes(h1, h2, k, m, jnp)
     mask = _valid_mask(lo.shape[0], n_valid)
-    trow = jnp.where(mask, tenant, bits2d.shape[0])[:, None]
-    old = bits2d.at[trow, idx].get(mode="fill", fill_value=1)
+    size = bits2d.shape[0] * bits2d.shape[1]
+    flat = bits2d.reshape(-1)
+    g = jnp.where(mask[:, None], tenant[:, None] * m + idx, size)
+    old = flat.at[g].get(mode="fill", fill_value=1)
     newly = jnp.any(old == 0, axis=-1) & mask
-    new_bits = bits2d.at[trow, idx].set(jnp.uint8(1), mode="drop")
-    return new_bits, newly
+    new_flat = flat.at[g.reshape(-1)].set(jnp.uint8(1), mode="drop")
+    return new_flat.reshape(bits2d.shape), newly
 
 
 @functools.partial(jax.jit, static_argnums=(5, 6))
 def bloom_bank_contains_u64(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
     h1, h2 = H.hash_u64_pair(lo, hi, jnp)
     idx = H.bloom_indexes(h1, h2, k, m, jnp)
-    got = bits2d.at[tenant[:, None], idx].get(mode="fill", fill_value=1)
+    g = tenant[:, None] * m + idx
+    got = bits2d.reshape(-1).at[g].get(mode="fill", fill_value=1)
     return jnp.all(got != 0, axis=-1) & _valid_mask(lo.shape[0], n_valid)
 
 
@@ -134,8 +145,23 @@ def hll_add_u64(regs, lo, hi, n_valid, p: int):
 def hll_bank_add_u64(regs2d, tenant, lo, hi, n_valid, p: int):
     h1, h2 = H.hash_u64_pair(lo, hi, jnp)
     idx, rho = hll_ops.idx_rho(h1, h2, p)
-    trow = jnp.where(_valid_mask(lo.shape[0], n_valid), tenant, regs2d.shape[0])
-    return hll_ops.add_bank(regs2d, trow, idx, rho)
+    m = regs2d.shape[1]
+    size = regs2d.shape[0] * m
+    mask = _valid_mask(lo.shape[0], n_valid)
+    g = jnp.where(mask, tenant * m + idx, size)  # flat fast path (see bloom bank)
+    new_flat = regs2d.reshape(-1).at[g].max(rho, mode="drop")
+    return new_flat.reshape(regs2d.shape)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def hll_bank_merge_rows(regs2d, dst, src, n_valid):
+    """Batched pairwise PFMERGE: rows[dst] = max(rows[dst], rows[src]).
+    dst/src are padded to a pow2 bucket; padded rows are masked out (dst ->
+    out-of-range sentinel dropped, src clipped to a readable row)."""
+    mask = _valid_mask(dst.shape[0], n_valid)
+    dsafe = jnp.where(mask, dst, regs2d.shape[0])
+    ssafe = jnp.clip(src, 0, regs2d.shape[0] - 1)
+    return regs2d.at[dsafe].max(regs2d[ssafe], mode="drop")
 
 
 @functools.partial(jax.jit, static_argnums=(4,), donate_argnums=(0,))
@@ -149,6 +175,11 @@ def hll_add_bytes(regs, words, nbytes, n_valid, p: int):
 hll_merge = jax.jit(hll_ops.merge, donate_argnums=(0,))
 hll_estimate = jax.jit(hll_ops.estimate)
 hll_estimate_union = jax.jit(hll_ops.estimate_union)
+
+
+@jax.jit
+def hll_bank_estimate_union_pairs(regs2d, a, b):
+    return hll_ops.estimate(jnp.maximum(regs2d[a], regs2d[b]))
 
 
 # --------------------------------------------------------------------------

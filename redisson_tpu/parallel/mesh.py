@@ -1,0 +1,102 @@
+"""Device mesh & sharding policy: the topology layer (SURVEY.md §7.1 L3').
+
+Role parity: the reference's ConnectionManager hierarchy maps 16384 CRC16
+slots onto N master shards and replicas (``cluster/ClusterConnectionManager
+.java:84-180``); here the "cluster" is a jax device Mesh and the slot table
+maps keyspace slots onto mesh shards.
+
+Axes:
+  dp    — data-parallel over op batches (the reference's many-connections
+          concurrency: independent request streams),
+  shard — state-parallel over device-resident planes: a single logical
+          object's bit/register tensor is *sharded across chips* and probed
+          with psum collectives over ICI — capability the reference cannot
+          express (any one key's value lives wholly on one Redis shard;
+          SURVEY.md §5.7 calls this out as new).
+
+Multi-host: under `jax.distributed.initialize` the same mesh spans hosts
+(ICI within a slice, DCN across slices) — no NCCL/MPI translation, XLA
+collectives are the cluster bus (SURVEY.md §2.8).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from redisson_tpu.utils.crc16 import MAX_SLOT
+
+DP_AXIS = "dp"
+SHARD_AXIS = "shard"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    dp: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a (dp, shard) mesh over the available devices.
+
+    dp * shard == n_devices; shard gets everything dp doesn't take.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    n = n_devices if n_devices is not None else len(devs)
+    devs = devs[:n]
+    if n % dp != 0:
+        raise ValueError(f"dp={dp} must divide device count {n}")
+    grid = np.asarray(devs).reshape(dp, n // dp)
+    return Mesh(grid, (DP_AXIS, SHARD_AXIS))
+
+
+def state_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (T, m) state planes: plane axis split over `shard`,
+    replicated over `dp`."""
+    return NamedSharding(mesh, P(None, SHARD_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for op batches: split over `dp`, replicated over `shard`."""
+    return NamedSharding(mesh, P(DP_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+class SlotTable:
+    """slot -> shard routing (the slot->MasterSlaveEntry array analog,
+    ``cluster/ClusterConnectionManager.java`` keeps slot2entry[16384]).
+
+    Used by the topology manager to route *object names* to shards in
+    multi-process mode; within one mesh the state planes are uniformly
+    sharded instead and this table routes at the object level.
+    """
+
+    def __init__(self, n_shards: int):
+        if n_shards <= 0:
+            raise ValueError("need at least one shard")
+        self.n_shards = n_shards
+        # contiguous ranges, like a freshly-created Redis cluster
+        self._table = np.floor_divide(
+            np.arange(MAX_SLOT) * n_shards, MAX_SLOT
+        ).astype(np.int32)
+
+    def shard_of_slot(self, slot: int) -> int:
+        return int(self._table[slot])
+
+    def shard_of_key(self, key) -> int:
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        return self.shard_of_slot(calc_slot(key))
+
+    def move_slot(self, slot: int, to_shard: int) -> None:
+        """Slot migration (MOVED/resharding analog)."""
+        if not 0 <= to_shard < self.n_shards:
+            raise ValueError(f"shard {to_shard} out of range")
+        self._table[slot] = to_shard
+
+    def slots_of_shard(self, shard: int) -> np.ndarray:
+        return np.nonzero(self._table == shard)[0]

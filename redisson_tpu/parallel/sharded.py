@@ -1,0 +1,149 @@
+"""Sharded sketch kernels: one logical object spread across the mesh.
+
+This is the capability jump over the reference (SURVEY.md §5.7): Redis
+pins any single key's value to ONE shard; here a single BloomFilter's bit
+plane (or an HLL bank's tenant axis) is split across every chip on the
+`shard` mesh axis, and membership probes resolve with one `psum` over ICI.
+
+Kernel scheme (shard_map over mesh axes (dp, shard)):
+  * state (T, m): each shard holds columns [s*m_loc, (s+1)*m_loc).
+  * op batches: split over dp (each dp group handles its slice of ops,
+    state is replicated across dp).
+  * contains: each shard gathers its in-range probes, absent probes
+    contribute 0, `psum` over `shard` reassembles every probe's bit (exactly
+    one shard owns each probe) -> AND over k locally.
+  * add: each shard scatters only its in-range probes — no communication at
+    all; newly-added reporting needs the same psum as contains.
+  * dp axis: results stay dp-sharded (P(dp)) — no cross-dp traffic.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from redisson_tpu.parallel.mesh import DP_AXIS, SHARD_AXIS
+from redisson_tpu.ops import hll as hll_ops
+from redisson_tpu.utils import hashing as H
+
+
+def _local_probe_gather(bits_local, tenant, idx_global, m_local):
+    """Per-shard: value of each global probe if locally owned, else 0."""
+    shard = jax.lax.axis_index(SHARD_AXIS)
+    local = idx_global - shard * m_local
+    in_range = (local >= 0) & (local < m_local)
+    safe = jnp.clip(local, 0, m_local - 1)
+    got = bits_local[tenant[:, None], safe]
+    return jnp.where(in_range, got, 0).astype(jnp.uint8), in_range, safe
+
+
+def make_sharded_bloom_kernels(mesh: Mesh, k: int, m: int, n_tenants: int):
+    """Build (add, contains) jitted over the mesh for a (n_tenants, m) bank.
+
+    m must divide evenly by the shard-axis size.
+    """
+    n_shard = mesh.shape[SHARD_AXIS]
+    if m % n_shard != 0:
+        raise ValueError(f"m={m} must be divisible by shard axis size {n_shard}")
+    m_local = m // n_shard
+
+    state_spec = P(None, SHARD_AXIS)
+    ops_spec = P(DP_AXIS)
+
+    def contains_local(bits_local, tenant, lo, hi, n_valid):
+        h1, h2 = H.hash_u64_pair(lo, hi, jnp)
+        idx = H.bloom_indexes(h1, h2, k, m, jnp)
+        got, _, _ = _local_probe_gather(bits_local, tenant, idx, m_local)
+        got = jax.lax.psum(got, SHARD_AXIS)  # exactly one shard owns each probe
+        found = jnp.all(got > 0, axis=-1)
+        dp_idx = jax.lax.axis_index(DP_AXIS)
+        base = dp_idx * lo.shape[0]
+        valid = (jnp.arange(lo.shape[0], dtype=jnp.int32) + base) < n_valid
+        return found & valid
+
+    def add_local(bits_local, tenant, lo, hi, n_valid):
+        h1, h2 = H.hash_u64_pair(lo, hi, jnp)
+        idx = H.bloom_indexes(h1, h2, k, m, jnp)
+        got, in_range, safe = _local_probe_gather(bits_local, tenant, idx, m_local)
+        pre = jax.lax.psum(got, SHARD_AXIS)
+        dp_idx = jax.lax.axis_index(DP_AXIS)
+        base = dp_idx * lo.shape[0]
+        valid = (jnp.arange(lo.shape[0], dtype=jnp.int32) + base) < n_valid
+        newly = jnp.any(pre == 0, axis=-1) & valid
+        # scatter only locally-owned, valid probes; others -> dropped row
+        trow = jnp.where(in_range & valid[:, None], tenant[:, None], n_tenants)
+        bits_local = bits_local.at[trow, safe].set(jnp.uint8(1), mode="drop")
+        # dp groups each scattered their own ops into their dp-replica of the
+        # plane; max-combine across dp so every replica sees every write
+        bits_local = jax.lax.pmax(bits_local, DP_AXIS)
+        return bits_local, newly
+
+    contains = jax.jit(
+        jax.shard_map(
+            contains_local,
+            mesh=mesh,
+            in_specs=(state_spec, ops_spec, ops_spec, ops_spec, P()),
+            out_specs=ops_spec,
+        )
+    )
+    add = jax.jit(
+        jax.shard_map(
+            add_local,
+            mesh=mesh,
+            in_specs=(state_spec, ops_spec, ops_spec, ops_spec, P()),
+            out_specs=(state_spec, ops_spec),
+        ),
+        donate_argnums=(0,),
+    )
+    return add, contains
+
+
+def make_sharded_hll_kernels(mesh: Mesh, p: int, n_tenants: int):
+    """(T, m_regs) HLL bank with the TENANT axis sharded (each shard owns a
+    tenant range — the expert-parallel analog: counters are independent, so
+    adds route to the owning shard with no collective; estimates are local
+    reduces gathered at the end)."""
+    n_shard = mesh.shape[SHARD_AXIS]
+    if n_tenants % n_shard != 0:
+        raise ValueError(f"tenants={n_tenants} must divide by shard axis {n_shard}")
+    t_local = n_tenants // n_shard
+    m = hll_ops.m_of(p)
+
+    state_spec = P(SHARD_AXIS, None)
+    ops_spec = P(DP_AXIS)
+
+    def add_local(regs_local, tenant, lo, hi, n_valid):
+        h1, h2 = H.hash_u64_pair(lo, hi, jnp)
+        idx, rho = hll_ops.idx_rho(h1, h2, p)
+        shard = jax.lax.axis_index(SHARD_AXIS)
+        local_t = tenant - shard * t_local
+        dp_idx = jax.lax.axis_index(DP_AXIS)
+        base = dp_idx * lo.shape[0]
+        valid = (jnp.arange(lo.shape[0], dtype=jnp.int32) + base) < n_valid
+        owned = (local_t >= 0) & (local_t < t_local) & valid
+        trow = jnp.where(owned, local_t, t_local)
+        regs_local = regs_local.at[trow, idx].max(rho, mode="drop")
+        regs_local = jax.lax.pmax(regs_local, DP_AXIS)
+        return regs_local
+
+    def estimate_local(regs_local):
+        return hll_ops.estimate(regs_local)
+
+    add = jax.jit(
+        jax.shard_map(
+            add_local,
+            mesh=mesh,
+            in_specs=(state_spec, ops_spec, ops_spec, ops_spec, P()),
+            out_specs=state_spec,
+        ),
+        donate_argnums=(0,),
+    )
+    estimate = jax.jit(
+        jax.shard_map(
+            estimate_local, mesh=mesh, in_specs=(state_spec,), out_specs=P(SHARD_AXIS)
+        )
+    )
+    return add, estimate

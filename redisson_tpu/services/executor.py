@@ -1,0 +1,389 @@
+"""Distributed executor / scheduler service.
+
+Parity targets (SURVEY.md §2.6):
+  * RExecutorService — ``org/redisson/RedissonExecutorService.java:90-289``
+    (1,240 LoC): tasks serialized into a task hash `{name}:tasks` + request
+    queue; workers (TasksRunnerService) pull, run, ack; task ids; cancel;
+    countActiveWorkers; task retry when a worker dies before ack
+    (``executor/TasksService.java`` — tasks stay in the hash until completion).
+  * RScheduledExecutorService — schedule-with-delay / at-fixed-rate / cron
+    (``ScheduledTasksService.java``, ``CronExpression.java``): a scheduler
+    ZSET ordered by fire time + transfer of due tasks to the request queue
+    (QueueTransferTask.java:83-118).
+  * RedissonNode — ``org/redisson/RedissonNode.java``: the worker daemon ==
+    `register_workers` here (thread workers in-process; the server exposes
+    the same registration for remote worker processes).
+
+Task payloads are pickled callables (the classBody-shipping analog of
+``executor/TasksRunnerService.java:192-318`` minus JVM classloading).
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import traceback
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from redisson_tpu.core.store import StateRecord
+
+
+class TaskFuture:
+    def __init__(self, task_id: str):
+        self.task_id = task_id
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self._cancelled = False
+
+    def _complete(self, value):
+        self._value = value
+        self._event.set()
+
+    def _fail(self, err: BaseException):
+        self._error = err
+        self._event.set()
+
+    def _cancel(self):
+        self._cancelled = True
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"task {self.task_id} not finished")
+        if self._cancelled:
+            raise RuntimeError(f"task {self.task_id} was cancelled")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+@dataclass
+class _Task:
+    id: str
+    payload: bytes                      # pickled (fn, args, kwargs)
+    state: str = "queued"               # queued | running | finished | failed | cancelled
+    result: Any = None
+    error: Optional[str] = None
+    retries: int = 0
+    submitted_at: float = field(default_factory=time.time)
+
+
+class ExecutorService:
+    """One named executor: task registry + request queue + worker pool."""
+
+    MAX_RETRIES = 3
+
+    def __init__(self, engine, name: str):
+        self._engine = engine
+        self._name = name
+        self._futures: Dict[str, TaskFuture] = {}
+        self._workers: List[threading.Thread] = []
+        self._shutdown = threading.Event()
+
+    # -- state --------------------------------------------------------------
+
+    def _rec(self) -> StateRecord:
+        return self._engine.store.get_or_create(
+            f"{{{self._name}}}:tasks",
+            "executor_tasks",
+            lambda: StateRecord(kind="executor_tasks", host={"tasks": {}, "queue": [], "workers": 0}),
+        )
+
+    def _wait(self):
+        return self._engine.wait_entry(f"__exec__:{self._name}")
+
+    # -- submission (RExecutorService.submit / RExecutorService.execute) ----
+
+    def submit(self, fn: Callable, *args, **kwargs) -> TaskFuture:
+        payload = pickle.dumps((fn, args, kwargs), protocol=pickle.HIGHEST_PROTOCOL)
+        task = _Task(id=uuid.uuid4().hex[:16], payload=payload)
+        fut = TaskFuture(task.id)
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            rec.host["tasks"][task.id] = task
+            rec.host["queue"].append(task.id)
+            rec.version += 1
+        self._futures[task.id] = fut
+        self._wait().signal()
+        return fut
+
+    def execute(self, fn: Callable, *args, **kwargs) -> None:
+        self.submit(fn, *args, **kwargs)
+
+    def submit_many(self, calls: List[Tuple[Callable, tuple]]) -> List[TaskFuture]:
+        return [self.submit(fn, *args) for fn, args in calls]
+
+    def cancel_task(self, task_id: str) -> bool:
+        """RExecutorService.cancelTask: only queued tasks can be cancelled."""
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            task = rec.host["tasks"].get(task_id)
+            if task is None or task.state != "queued":
+                return False
+            task.state = "cancelled"
+            if task_id in rec.host["queue"]:
+                rec.host["queue"].remove(task_id)
+            rec.version += 1
+        fut = self._futures.get(task_id)
+        if fut:
+            fut._cancel()
+        return True
+
+    # -- workers (TasksRunnerService / RedissonNode.registerWorkers) --------
+
+    def register_workers(self, n: int) -> None:
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            rec.host["workers"] += n
+        for _ in range(n):
+            t = threading.Thread(target=self._worker_loop, daemon=True)
+            t.start()
+            self._workers.append(t)
+
+    def count_active_workers(self) -> int:
+        """RedissonExecutorService.countActiveWorkers (:207-224 does a topic
+        round-trip; in-process it's the registered count)."""
+        rec = self._engine.store.get(f"{{{self._name}}}:tasks")
+        return 0 if rec is None else rec.host["workers"]
+
+    def _take_task(self) -> Optional[_Task]:
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            while rec.host["queue"]:
+                tid = rec.host["queue"].pop(0)
+                task = rec.host["tasks"].get(tid)
+                if task is not None and task.state == "queued":
+                    task.state = "running"
+                    rec.version += 1
+                    return task
+            return None
+
+    def _worker_loop(self):
+        while not self._shutdown.is_set():
+            task = self._take_task()
+            if task is None:
+                self._wait().wait_for(0.2)
+                continue
+            self._run_task(task)
+
+    def _run_task(self, task: _Task):
+        fut = self._futures.get(task.id)
+        try:
+            fn, args, kwargs = pickle.loads(task.payload)
+            # @RInject analog (misc/Injector): tasks asking for the client get it
+            if getattr(fn, "_inject_client", False):
+                from redisson_tpu.client.redisson import RedissonTpu
+
+                kwargs = {**kwargs, "client": RedissonTpu(self._engine)}
+            result = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 - task failures are data
+            with self._engine.locked(f"{{{self._name}}}:tasks"):
+                task.retries += 1
+                if task.retries < self.MAX_RETRIES and isinstance(e, _RetryableError):
+                    task.state = "queued"
+                    rec = self._rec()
+                    rec.host["queue"].append(task.id)
+                    return
+                task.state = "failed"
+                task.error = traceback.format_exc()
+            if fut:
+                fut._fail(e)
+            return
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            task.state = "finished"
+            task.result = result
+        if fut:
+            fut._complete(result)
+
+    def requeue_orphans(self, max_running_age: float = 60.0) -> int:
+        """TasksService re-schedule of orphaned tasks: a task 'running' on a
+        dead worker goes back to the queue (the reference keeps tasks in the
+        hash until an explicit completion ack)."""
+        n = 0
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            for task in rec.host["tasks"].values():
+                if task.state == "running" and time.time() - task.submitted_at > max_running_age:
+                    task.state = "queued"
+                    rec.host["queue"].append(task.id)
+                    n += 1
+        if n:
+            self._wait().signal(all_=True)
+        return n
+
+    def task_state(self, task_id: str) -> Optional[str]:
+        rec = self._engine.store.get(f"{{{self._name}}}:tasks")
+        if rec is None:
+            return None
+        task = rec.host["tasks"].get(task_id)
+        return None if task is None else task.state
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self._wait().signal(all_=True)
+
+    def delete(self) -> bool:
+        self.shutdown()
+        return self._engine.store.delete(f"{{{self._name}}}:tasks")
+
+
+class _RetryableError(Exception):
+    """Raise from a task to request re-queue (visibility-timeout analog)."""
+
+
+def inject_client(fn: Callable) -> Callable:
+    """Decorator: task receives a `client=` kwarg (the @RInject analog)."""
+    fn._inject_client = True
+    return fn
+
+
+# -- scheduling ---------------------------------------------------------------
+
+class CronExpression:
+    """5-field cron (min hour dom mon dow), supporting '*', lists, ranges and
+    steps — the subset of ``org/redisson/executor/CronExpression.java`` the
+    scheduler surface needs."""
+
+    def __init__(self, expr: str):
+        parts = expr.split()
+        if len(parts) != 5:
+            raise ValueError(f"cron needs 5 fields, got {expr!r}")
+        ranges = [(0, 59), (0, 23), (1, 31), (1, 12), (0, 6)]
+        self.fields = [self._parse(p, lo, hi) for p, (lo, hi) in zip(parts, ranges)]
+
+    @staticmethod
+    def _parse(spec: str, lo: int, hi: int) -> set:
+        out = set()
+        for part in spec.split(","):
+            step = 1
+            if "/" in part:
+                part, step_s = part.split("/")
+                step = int(step_s)
+            if part in ("*", ""):
+                rng = range(lo, hi + 1)
+            elif "-" in part:
+                a, b = part.split("-")
+                rng = range(int(a), int(b) + 1)
+            else:
+                rng = range(int(part), int(part) + 1)
+            out.update(v for v in rng if (v - lo) % step == 0 and lo <= v <= hi)
+        return out
+
+    def matches(self, t: time.struct_time) -> bool:
+        mins, hours, doms, mons, dows = self.fields
+        return (
+            t.tm_min in mins
+            and t.tm_hour in hours
+            and t.tm_mday in doms
+            and t.tm_mon in mons
+            and t.tm_wday in {(d - 1) % 7 for d in dows} | ({6} if 0 in dows else set())
+        )
+
+    def next_fire(self, after: float) -> float:
+        """Next matching minute boundary after `after` (scan cap: 366 days)."""
+        t = int(after // 60 + 1) * 60
+        for _ in range(366 * 24 * 60):
+            if self.matches(time.localtime(t)):
+                return float(t)
+            t += 60
+        raise ValueError("cron expression never fires")
+
+
+class ScheduledExecutorService(ExecutorService):
+    """RScheduledExecutorService: delayed / fixed-rate / cron scheduling.
+
+    Due tasks transfer from the schedule (a fire-time-ordered heap — the
+    reference's `{name}:scheduler` ZSET) onto the request queue.
+    """
+
+    def __init__(self, engine, name: str):
+        super().__init__(engine, name)
+        self._timers: List[threading.Timer] = []
+
+    def schedule(self, delay: float, fn: Callable, *args, **kwargs) -> TaskFuture:
+        """scheduleAsync(task, delay)."""
+        payload = pickle.dumps((fn, args, kwargs), protocol=pickle.HIGHEST_PROTOCOL)
+        task = _Task(id=uuid.uuid4().hex[:16], payload=payload, state="scheduled")
+        fut = TaskFuture(task.id)
+        self._futures[task.id] = fut
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            rec.host["tasks"][task.id] = task
+
+        def fire():
+            with self._engine.locked(f"{{{self._name}}}:tasks"):
+                if task.state != "scheduled":
+                    return
+                task.state = "queued"
+                rec2 = self._rec()
+                rec2.host["queue"].append(task.id)
+            self._wait().signal()
+
+        t = threading.Timer(delay, fire)
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+        return fut
+
+    def schedule_at_fixed_rate(self, initial_delay: float, period: float, fn: Callable, *args) -> str:
+        """Returns a schedule id; cancel via cancel_scheduled."""
+        sid = uuid.uuid4().hex[:12]
+        stop = threading.Event()
+        self._fixed_rate_stops = getattr(self, "_fixed_rate_stops", {})
+        self._fixed_rate_stops[sid] = stop
+
+        def loop():
+            nxt = time.time() + initial_delay
+            while not stop.is_set() and not self._shutdown.is_set():
+                delay = nxt - time.time()
+                if delay > 0:
+                    stop.wait(delay)
+                    if stop.is_set():
+                        return
+                self.submit(fn, *args)
+                nxt += period
+
+        threading.Thread(target=loop, daemon=True).start()
+        return sid
+
+    def schedule_cron(self, cron_expr: str, fn: Callable, *args) -> str:
+        """schedule(task, CronSchedule.of(expr))."""
+        cron = CronExpression(cron_expr)
+        sid = uuid.uuid4().hex[:12]
+        stop = threading.Event()
+        self._fixed_rate_stops = getattr(self, "_fixed_rate_stops", {})
+        self._fixed_rate_stops[sid] = stop
+
+        def loop():
+            while not stop.is_set() and not self._shutdown.is_set():
+                nxt = cron.next_fire(time.time())
+                if stop.wait(max(0.0, nxt - time.time())):
+                    return
+                self.submit(fn, *args)
+
+        threading.Thread(target=loop, daemon=True).start()
+        return sid
+
+    def cancel_scheduled(self, schedule_id: str) -> bool:
+        stops = getattr(self, "_fixed_rate_stops", {})
+        stop = stops.pop(schedule_id, None)
+        if stop is None:
+            return False
+        stop.set()
+        return True
+
+    def shutdown(self) -> None:
+        for t in self._timers:
+            t.cancel()
+        for stop in getattr(self, "_fixed_rate_stops", {}).values():
+            stop.set()
+        super().shutdown()

@@ -1,0 +1,163 @@
+"""LiveObject service: objects whose attributes live in the grid.
+
+Parity target (SURVEY.md §2.6): ``org/redisson/RedissonLiveObjectService.java``
+(929 LoC) + ``liveobject/core/AccessorInterceptor.java`` + LiveObjectSearch —
+the reference generates a ByteBuddy proxy per @REntity class whose field
+accessors read/write an RMap hash; @RId names the primary key; @RIndex'd
+fields maintain index sets enabling condition search (EQ/GT/LT/IN/AND/OR).
+
+Here: `@entity` marks a Python class (with `id_field`); `attach/persist/get`
+return a proxy whose __getattr__/__setattr__ hit the backing Map;
+`@indexed` fields maintain per-value index sets used by `find`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Type
+
+
+def entity(id_field: str = "id", indexed: tuple = ()):  # decorator
+    """@REntity analog; `indexed` lists fields kept in search indexes."""
+
+    def wrap(cls):
+        cls.__rid_field__ = id_field
+        cls.__rindexed__ = tuple(indexed)
+        return cls
+
+    return wrap
+
+
+class LiveObjectProxy:
+    """Field-accessor proxy (AccessorInterceptor analog): every attribute
+    read/write goes straight to the backing map — no local state besides the
+    identity."""
+
+    __slots__ = ("__dict__",)
+
+    def __init__(self, service: "LiveObjectService", cls: Type, rid: Any):
+        object.__setattr__(self, "__dict__", {"_svc": service, "_cls": cls, "_rid": rid})
+
+    def _map(self):
+        d = object.__getattribute__(self, "__dict__")
+        return d["_svc"]._backing_map(d["_cls"], d["_rid"])
+
+    def __getattr__(self, name: str):
+        d = object.__getattribute__(self, "__dict__")
+        if name == d["_cls"].__rid_field__:
+            return d["_rid"]
+        v = self._map().get(name)
+        return v
+
+    def __setattr__(self, name: str, value):
+        d = object.__getattribute__(self, "__dict__")
+        cls, rid, svc = d["_cls"], d["_rid"], d["_svc"]
+        if name == cls.__rid_field__:
+            raise AttributeError("@RId field is immutable (reference rejects id writes)")
+        old = self._map().get(name)
+        self._map().fast_put(name, value)
+        if name in cls.__rindexed__:
+            svc._index_update(cls, name, rid, old, value)
+
+    def __eq__(self, other):
+        if not isinstance(other, LiveObjectProxy):
+            return NotImplemented
+        a = object.__getattribute__(self, "__dict__")
+        b = object.__getattribute__(other, "__dict__")
+        return a["_cls"] is b["_cls"] and a["_rid"] == b["_rid"]
+
+    def __hash__(self):
+        d = object.__getattribute__(self, "__dict__")
+        return hash((d["_cls"].__name__, d["_rid"]))
+
+
+class LiveObjectService:
+    """RLiveObjectService analog: persist/get/delete/is_exists/find."""
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    def _map_name(self, cls: Type, rid: Any) -> str:
+        return f"redisson_live_object:{{{cls.__name__}:{rid!r}}}"
+
+    def _index_name(self, cls: Type, field: str, value: Any) -> str:
+        return f"redisson_live_object_index:{{{cls.__name__}:{field}:{value!r}}}"
+
+    def _ids_name(self, cls: Type) -> str:
+        return f"redisson_live_object_ids:{{{cls.__name__}}}"
+
+    def _backing_map(self, cls: Type, rid: Any):
+        from redisson_tpu.client.objects.map import Map
+
+        return Map(self._engine, self._map_name(cls, rid))
+
+    def _ids_set(self, cls: Type):
+        from redisson_tpu.client.objects.set import Set as RSet
+
+        return RSet(self._engine, self._ids_name(cls))
+
+    def _index_update(self, cls: Type, field: str, rid: Any, old: Any, new: Any):
+        from redisson_tpu.client.objects.set import Set as RSet
+
+        if old is not None:
+            RSet(self._engine, self._index_name(cls, field, old)).remove(rid)
+        if new is not None:
+            RSet(self._engine, self._index_name(cls, field, new)).add(rid)
+
+    # -- lifecycle (RLiveObjectService.persist/attach/get/delete) ------------
+
+    def persist(self, instance: Any) -> LiveObjectProxy:
+        """Copy a detached instance's fields into the grid; returns the proxy.
+        Fails if an entity with the same id already exists (reference
+        persist() semantics)."""
+        cls = type(instance)
+        rid = getattr(instance, cls.__rid_field__)
+        if rid is None:
+            raise ValueError("@RId field must be set before persist")
+        if self.is_exists(cls, rid):
+            raise ValueError(f"{cls.__name__}({rid!r}) already exists")
+        proxy = LiveObjectProxy(self, cls, rid)
+        self._ids_set(cls).add(rid)
+        for k, v in vars(instance).items():
+            if k != cls.__rid_field__ and not k.startswith("_"):
+                setattr(proxy, k, v)
+        return proxy
+
+    def attach(self, cls: Type, rid: Any) -> LiveObjectProxy:
+        """Proxy without existence check (reference attach())."""
+        return LiveObjectProxy(self, cls, rid)
+
+    def get(self, cls: Type, rid: Any) -> Optional[LiveObjectProxy]:
+        if not self.is_exists(cls, rid):
+            return None
+        return LiveObjectProxy(self, cls, rid)
+
+    def is_exists(self, cls: Type, rid: Any) -> bool:
+        return self._ids_set(cls).contains(rid)
+
+    def delete(self, cls: Type, rid: Any) -> bool:
+        if not self.is_exists(cls, rid):
+            return False
+        proxy = LiveObjectProxy(self, cls, rid)
+        for field in cls.__rindexed__:
+            val = getattr(proxy, field)
+            if val is not None:
+                self._index_update(cls, field, rid, val, None)
+        self._backing_map(cls, rid).delete()
+        self._ids_set(cls).remove(rid)
+        return True
+
+    # -- search (LiveObjectSearch / liveobject/condition/*) ------------------
+
+    def find(self, cls: Type, **conditions) -> List[LiveObjectProxy]:
+        """EQ-conditions across indexed fields, AND-combined (the common
+        Conditions.and_(Conditions.eq(...)) shape)."""
+        from redisson_tpu.client.objects.set import Set as RSet
+
+        ids: Optional[set] = None
+        for field, value in conditions.items():
+            if field not in cls.__rindexed__:
+                raise ValueError(f"field {field!r} is not indexed on {cls.__name__}")
+            matches = set(RSet(self._engine, self._index_name(cls, field, value)).read_all())
+            ids = matches if ids is None else (ids & matches)
+        if ids is None:
+            ids = set(self._ids_set(cls).read_all())
+        return [LiveObjectProxy(self, cls, rid) for rid in sorted(ids, key=repr)]

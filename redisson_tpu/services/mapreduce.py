@@ -1,0 +1,204 @@
+"""MapReduce service.
+
+Parity target (SURVEY.md §2.6, §3.5): ``org/redisson/mapreduce/`` —
+`RMap.mapReduce()` / `RCollection.mapReduce()` submit a CoordinatorTask to
+the `redisson_mapreduce` executor; MapperTask iterates the source, emitting
+via Collector into per-partition multimaps keyed by `hash64(key) % workers`
+(``Collector.java:56-73``, ``MapperTask.java:50-78``); one ReducerTask per
+partition folds value lists; optional CollatorTask folds the result map
+(``CoordinatorTask.java:77-166``).
+
+TPU-first redesign (BASELINE north star): the reference's per-emit Redis
+write is the hot loop; here
+  * the host path batches emissions into in-memory partition buffers (one
+    lock touch per mapper chunk, not per emit), and
+  * the kernel path (`KernelMapReduce`) compiles map+reduce into one jitted
+    program over packed arrays — `vmap`'d map, `segment_sum/min/max` shuffle
+    — for workloads expressible as array ops (SURVEY.md §7.3 item 6's
+    "vmap-able kernel API with a host-executor fallback").
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from redisson_tpu.utils import hashing as H
+
+import numpy as np
+
+
+class Collector:
+    """Per-mapper emission buffer (Collector.java analog, minus the per-emit
+    network write)."""
+
+    def __init__(self, n_partitions: int):
+        self._parts: List[Dict[Any, List[Any]]] = [defaultdict(list) for _ in range(n_partitions)]
+        self._n = n_partitions
+
+    def emit(self, key, value) -> None:
+        kb = key.encode() if isinstance(key, str) else repr(key).encode()
+        words, nbytes = H.pack_keys([kb])
+        h1, _ = H.hash_packed_bytes(words, nbytes, np)
+        self._parts[int(h1[0]) % self._n][key].append(value)
+
+
+class MapReduce:
+    """Generic map-reduce over a Map or collection handle.
+
+    mapper(key, value, collector)           — RMapper.map analog
+    reducer(key, values) -> value           — RReducer.reduce analog
+    collator(result_dict) -> Any (optional) — RCollator analog
+    """
+
+    def __init__(
+        self,
+        engine,
+        mapper: Callable,
+        reducer: Callable,
+        collator: Optional[Callable] = None,
+        workers: int = 4,
+        executor=None,
+    ):
+        self._engine = engine
+        self._mapper = mapper
+        self._reducer = reducer
+        self._collator = collator
+        self._workers = max(1, workers)
+        self._executor = executor
+        self._timeout: Optional[float] = None
+
+    def timeout(self, seconds: float) -> "MapReduce":
+        self._timeout = seconds
+        return self
+
+    def _entries(self, source) -> List[Tuple[Any, Any]]:
+        if hasattr(source, "read_all_entry_set"):
+            return source.read_all_entry_set()
+        if hasattr(source, "read_all"):
+            return [(None, v) for v in source.read_all()]
+        return list(source)
+
+    def execute(self, source, result_map=None):
+        """Run the full pipeline; returns the reduced dict (or the collator
+        output if a collator was set).  Writes into `result_map` if given
+        (the reference's execute(resultMapName))."""
+        entries = self._entries(source)
+        n_parts = self._workers
+        chunk = max(1, (len(entries) + self._workers - 1) // self._workers)
+        collectors: List[Collector] = []
+        threads = []
+        errors: List[BaseException] = []
+
+        def run_mapper(chunk_entries):
+            c = Collector(n_parts)
+            try:
+                for k, v in chunk_entries:
+                    self._mapper(k, v, c)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+            collectors.append(c)
+
+        # mapper wave (MapperTask fan-out; threads play the worker role)
+        for i in range(0, len(entries), chunk):
+            t = threading.Thread(target=run_mapper, args=(entries[i : i + chunk],))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(self._timeout)
+        if errors:
+            raise errors[0]
+
+        # shuffle: merge per-mapper partition buffers (the multimap state)
+        partitions: List[Dict[Any, List[Any]]] = [defaultdict(list) for _ in range(n_parts)]
+        for c in collectors:
+            for pi, pmap in enumerate(c._parts):
+                for k, vals in pmap.items():
+                    partitions[pi][k].extend(vals)
+
+        # reducer wave (one ReducerTask per partition)
+        result: Dict[Any, Any] = {}
+        res_lock = threading.Lock()
+        rthreads = []
+
+        def run_reducer(pmap):
+            out = {k: self._reducer(k, vals) for k, vals in pmap.items()}
+            with res_lock:
+                result.update(out)
+
+        for pmap in partitions:
+            if pmap:
+                t = threading.Thread(target=run_reducer, args=(pmap,))
+                t.start()
+                rthreads.append(t)
+        for t in rthreads:
+            t.join(self._timeout)
+
+        if result_map is not None:
+            result_map.put_all(result)
+        if self._collator is not None:
+            return self._collator(result)
+        return result
+
+
+class KernelMapReduce:
+    """Array-native map-reduce compiled to one jitted program.
+
+    map_fn: vmap-able (value_row -> (key_id, mapped_value)) over packed arrays
+    reduce: 'sum' | 'max' | 'min' — the shuffle+reduce runs as a single
+    segment reduction on device (replacing per-emit multimap writes with one
+    scatter — SURVEY.md §3.5's "compile mapper/reducer to jax.vmap kernels").
+    """
+
+    def __init__(self, map_fn: Callable, reduce: str = "sum", n_keys: int = 1024):
+        import jax
+        import jax.numpy as jnp
+
+        if reduce not in ("sum", "max", "min"):
+            raise ValueError(f"unsupported reduce {reduce!r}")
+        self._n_keys = n_keys
+
+        def pipeline(values):
+            keys, mapped = jax.vmap(map_fn)(values)
+            if reduce == "sum":
+                return jnp.zeros((n_keys,), mapped.dtype).at[keys].add(mapped)
+            if reduce == "max":
+                init = jnp.full((n_keys,), jnp.iinfo(mapped.dtype).min if mapped.dtype.kind == "i" else -jnp.inf, mapped.dtype)
+                return init.at[keys].max(mapped)
+            init = jnp.full((n_keys,), jnp.iinfo(mapped.dtype).max if mapped.dtype.kind == "i" else jnp.inf, mapped.dtype)
+            return init.at[keys].min(mapped)
+
+        self._jitted = jax.jit(pipeline)
+
+    def execute(self, values) -> np.ndarray:
+        """values: (N, ...) array; returns (n_keys,) reduced vector."""
+        return np.asarray(self._jitted(values))
+
+
+def word_count(engine, source_map, workers: int = 4) -> Dict[str, int]:
+    """The canonical example (and BASELINE config 4 workload): count words
+    across all values of a map.  Uses a C-speed per-chunk Counter with a
+    single merge — the batched re-expression of mapper-emit/reducer-sum."""
+    from collections import Counter
+
+    entries = source_map.read_all_entry_set()
+    chunk = max(1, (len(entries) + workers - 1) // workers)
+    counters: List[Counter] = []
+    threads = []
+
+    def run(chunk_entries):
+        c = Counter()
+        for _, v in chunk_entries:
+            c.update(str(v).split())
+        counters.append(c)
+
+    for i in range(0, len(entries), chunk):
+        t = threading.Thread(target=run, args=(entries[i : i + chunk],))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    total = Counter()
+    for c in counters:
+        total.update(c)
+    return dict(total)

@@ -1,0 +1,188 @@
+"""Transactions: optimistic, buffered, all-or-nothing commit.
+
+Parity target (SURVEY.md §2.6): ``org/redisson/transaction/RedissonTransaction
+.java:49-79`` + the operation package (55 files): operations are buffered
+client-side as command objects; at commit, per-touched-object locks are taken,
+versions re-checked (optimistic concurrency), and the buffer is applied as a
+single batch; rollback simply discards the buffer.
+
+Transaction-scoped object views give read-your-writes inside the transaction
+(the reference's transactional RMap/RBucket/RSet wrappers).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+
+class TransactionException(Exception):
+    pass
+
+
+class Transaction:
+    def __init__(self, engine, timeout: float = 5.0):
+        self._engine = engine
+        self._timeout = timeout
+        self._ops: List[Tuple[str, Callable[[], None]]] = []  # (object name, apply)
+        self._read_versions: Dict[str, int] = {}
+        self._local: Dict[Tuple[str, Any], Any] = {}  # read-your-writes buffer
+        self._deleted: Set[Tuple[str, Any]] = set()
+        self._state = "active"
+        self._created_at = time.time()
+
+    # -- transactional object views ------------------------------------------
+
+    def get_map(self, name: str, codec=None) -> "TxMap":
+        from redisson_tpu.client.objects.map import Map
+
+        return TxMap(self, Map(self._engine, name, codec))
+
+    def get_bucket(self, name: str, codec=None) -> "TxBucket":
+        from redisson_tpu.client.objects.bucket import Bucket
+
+        return TxBucket(self, Bucket(self._engine, name, codec))
+
+    def get_set(self, name: str, codec=None) -> "TxSet":
+        from redisson_tpu.client.objects.set import Set as RSet
+
+        return TxSet(self, RSet(self._engine, name, codec))
+
+    # -- buffering ------------------------------------------------------------
+
+    def _check_active(self):
+        if self._state != "active":
+            raise TransactionException(f"transaction is {self._state}")
+        if time.time() - self._created_at > self._timeout:
+            self._state = "timed_out"
+            raise TransactionException("transaction timed out")
+
+    def _record_read(self, name: str):
+        rec = self._engine.store.get(name)
+        self._read_versions.setdefault(name, 0 if rec is None else rec.version)
+
+    def _buffer(self, name: str, apply: Callable[[], None]):
+        self._check_active()
+        self._ops.append((name, apply))
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def commit(self) -> None:
+        """Lock all touched objects (sorted — deadlock-free), verify observed
+        versions (optimistic check), apply the buffer, unlock."""
+        self._check_active()
+        names = sorted({n for n, _ in self._ops} | set(self._read_versions))
+        with self._engine.locked_many(names):
+            for name, seen in self._read_versions.items():
+                rec = self._engine.store.get(name)
+                cur = 0 if rec is None else rec.version
+                if cur != seen:
+                    self._state = "rolled_back"
+                    raise TransactionException(
+                        f"object '{name}' changed concurrently (version {seen} -> {cur})"
+                    )
+            for _name, apply in self._ops:
+                apply()
+        self._state = "committed"
+
+    def rollback(self) -> None:
+        self._check_active()
+        self._ops.clear()
+        self._local.clear()
+        self._state = "rolled_back"
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None and self._state == "active":
+            self.commit()
+        elif self._state == "active":
+            self.rollback()
+        return False
+
+
+class _TxView:
+    def __init__(self, tx: Transaction, obj):
+        self._tx = tx
+        self._obj = obj
+        self._name = obj.name
+
+
+class TxBucket(_TxView):
+    def get(self):
+        self._tx._check_active()
+        key = (self._name, None)
+        if key in self._tx._deleted:
+            return None
+        if key in self._tx._local:
+            return self._tx._local[key]
+        self._tx._record_read(self._name)
+        return self._obj.get()
+
+    def set(self, value) -> None:
+        key = (self._name, None)
+        self._tx._local[key] = value
+        self._tx._deleted.discard(key)
+        self._tx._buffer(self._name, lambda: self._obj.set(value))
+
+    def delete(self) -> None:
+        key = (self._name, None)
+        self._tx._deleted.add(key)
+        self._tx._local.pop(key, None)
+        self._tx._buffer(self._name, lambda: self._obj.delete())
+
+
+class TxMap(_TxView):
+    def get(self, k):
+        self._tx._check_active()
+        key = (self._name, self._obj._ek(k))
+        if key in self._tx._deleted:
+            return None
+        if key in self._tx._local:
+            return self._tx._local[key]
+        self._tx._record_read(self._name)
+        return self._obj.get(k)
+
+    def put(self, k, v) -> None:
+        key = (self._name, self._obj._ek(k))
+        self._tx._local[key] = v
+        self._tx._deleted.discard(key)
+        self._tx._buffer(self._name, lambda: self._obj.fast_put(k, v))
+
+    def remove(self, k) -> None:
+        key = (self._name, self._obj._ek(k))
+        self._tx._deleted.add(key)
+        self._tx._local.pop(key, None)
+        self._tx._buffer(self._name, lambda: self._obj.fast_remove(k))
+
+    def put_all(self, entries: Dict) -> None:
+        for k, v in entries.items():
+            self.put(k, v)
+
+
+class TxSet(_TxView):
+    def contains(self, v) -> bool:
+        self._tx._check_active()
+        key = (self._name, self._obj._e(v))
+        if key in self._tx._deleted:
+            return False
+        if key in self._tx._local:
+            return True
+        self._tx._record_read(self._name)
+        return self._obj.contains(v)
+
+    def add(self, v) -> None:
+        key = (self._name, self._obj._e(v))
+        self._tx._local[key] = v
+        self._tx._deleted.discard(key)
+        self._tx._buffer(self._name, lambda: self._obj.add(v))
+
+    def remove(self, v) -> None:
+        key = (self._name, self._obj._e(v))
+        self._tx._deleted.add(key)
+        self._tx._local.pop(key, None)
+        self._tx._buffer(self._name, lambda: self._obj.remove(v))

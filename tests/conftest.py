@@ -8,12 +8,18 @@ mesh code path is exercised exactly as it would be on a v5e-8 slice.
 import os
 
 # Must be set before jax initializes its backends.  The image pins
-# JAX_PLATFORMS=axon (the real TPU tunnel), so override unconditionally —
-# tests are hermetic on the CPU backend; bench.py uses the real chip.
+# JAX_PLATFORMS=axon (the real TPU tunnel) and a sitecustomize hook that
+# re-registers the axon backend at interpreter start, so the env var alone is
+# NOT enough — jax.config.update below is what actually wins.  Tests are
+# hermetic on the CPU backend; bench.py uses the real chip.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

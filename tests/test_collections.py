@@ -1,0 +1,406 @@
+"""Collection-object tests (RedissonMapTest / RedissonSetTest /
+RedissonListTest / RedissonQueueTest / RedissonScoredSortedSetTest analogs)."""
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+
+
+@pytest.fixture()
+def client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+class TestMap:
+    def test_put_get_semantics(self, client):
+        m = client.get_map("m")
+        assert m.put("a", 1) is None
+        assert m.put("a", 2) == 1
+        assert m.get("a") == 2
+        assert m.fast_put("b", 3)  # new key
+        assert not m.fast_put("b", 4)  # overwrite
+        assert m.size() == 2
+        assert m.contains_key("a") and not m.contains_key("z")
+        assert m.contains_value(4) and not m.contains_value(99)
+
+    def test_conditional_ops(self, client):
+        m = client.get_map("m")
+        assert m.put_if_absent("k", "v") is None
+        assert m.put_if_absent("k", "other") == "v"
+        assert m.replace("k", "v2") == "v"
+        assert m.replace("missing", "x") is None
+        assert m.replace_if_equals("k", "v2", "v3")
+        assert not m.replace_if_equals("k", "wrong", "v4")
+        assert m.remove_if_equals("k", "v3")
+        assert m.get("k") is None
+
+    def test_remove_and_iterate(self, client):
+        m = client.get_map("m")
+        m.put_all({i: i * 10 for i in range(20)})
+        assert m.remove(5) == 50
+        assert m.fast_remove(1, 2, 999) == 2
+        assert m.size() == 17
+        assert set(m.read_all_keys()) == set(range(20)) - {1, 2, 5}
+        assert m.read_all_map()[10] == 100
+        assert m.add_and_get(10, 5) == 105
+
+    def test_dict_protocol(self, client):
+        m = client.get_map("m")
+        m["x"] = 1
+        assert m["x"] == 1
+        assert "x" in m
+        assert len(m) == 1
+        with pytest.raises(KeyError):
+            m["nope"]
+
+    def test_loader_read_through(self, client):
+        from redisson_tpu.client.objects.map import MapLoader, MapOptions
+
+        class L(MapLoader):
+            def load(self, key):
+                return f"loaded:{key}" if key != "miss" else None
+
+        m = client.get_map("m", options=MapOptions(loader=L()))
+        assert m.get("a") == "loaded:a"
+        assert m.get("miss") is None
+        assert m.contains_key("a")  # cached after load
+
+    def test_writer_write_through(self, client):
+        from redisson_tpu.client.objects.map import MapOptions, MapWriter
+
+        written, deleted = {}, []
+
+        class W(MapWriter):
+            def write(self, entries):
+                written.update(entries)
+
+            def delete(self, keys):
+                deleted.extend(keys)
+
+        m = client.get_map("m", options=MapOptions(writer=W()))
+        m.put("a", 1)
+        m.remove("a")
+        assert written == {"a": 1}
+        assert deleted == ["a"]
+
+    def test_writer_write_behind(self, client):
+        from redisson_tpu.client.objects.map import MapOptions, MapWriter
+
+        written = {}
+
+        class W(MapWriter):
+            def write(self, entries):
+                written.update(entries)
+
+            def delete(self, keys):
+                pass
+
+        m = client.get_map(
+            "m", options=MapOptions(writer=W(), write_mode=MapOptions.WRITE_BEHIND, write_behind_delay=0.05)
+        )
+        m.put("a", 1)
+        assert written == {}  # not yet flushed
+        m.flush_write_behind()
+        assert written == {"a": 1}
+
+
+class TestMapCache:
+    def test_entry_ttl(self, client):
+        m = client.get_map_cache("mc")
+        m.put_with_ttl("k", "v", ttl=0.1)
+        m.put("forever", "x")
+        assert m.get("k") == "v"
+        assert 0 < m.remain_time_to_live_entry("k") <= 0.1
+        time.sleep(0.12)
+        assert m.get("k") is None
+        assert m.get("forever") == "x"
+        assert m.size() == 1
+
+    def test_max_idle(self, client):
+        m = client.get_map_cache("mc")
+        m.put_with_ttl("k", "v", max_idle=0.15)
+        time.sleep(0.08)
+        assert m.get("k") == "v"  # access refreshes idle clock
+        time.sleep(0.08)
+        assert m.get("k") == "v"
+        time.sleep(0.2)
+        assert m.get("k") is None
+
+    def test_put_if_absent_ttl_and_reap(self, client):
+        m = client.get_map_cache("mc")
+        assert m.put_if_absent_with_ttl("k", 1, ttl=0.05) is None
+        assert m.put_if_absent_with_ttl("k", 2) == 1
+        time.sleep(0.07)
+        assert m.put_if_absent_with_ttl("k", 3) is None
+        m.put_with_ttl("gone", 1, ttl=0.01)
+        time.sleep(0.02)
+        assert m.reap_expired() == 1
+
+
+class TestSet:
+    def test_basics(self, client):
+        s = client.get_set("s")
+        assert s.add("a")
+        assert not s.add("a")
+        assert s.add_all(["b", "c"])
+        assert s.contains("b")
+        assert s.size() == 3
+        assert s.remove("b")
+        assert not s.remove("b")
+        assert sorted(s.read_all()) == ["a", "c"]
+        assert s.random_member() in ("a", "c")
+        popped = s.remove_random()
+        assert popped in ("a", "c") and s.size() == 1
+
+    def test_algebra(self, client):
+        a, b = client.get_set("a"), client.get_set("b")
+        a.add_all([1, 2, 3])
+        b.add_all([2, 3, 4])
+        assert sorted(a.read_union("b")) == [1, 2, 3, 4]
+        assert sorted(a.read_intersection("b")) == [2, 3]
+        assert sorted(a.read_diff("b")) == [1]
+        assert a.intersection("b") == 2
+        assert sorted(a.read_all()) == [2, 3]
+
+    def test_move(self, client):
+        a, b = client.get_set("a"), client.get_set("b")
+        a.add("x")
+        assert a.move("b", "x")
+        assert not a.contains("x") and b.contains("x")
+        assert not a.move("b", "missing")
+
+    def test_retain(self, client):
+        s = client.get_set("s")
+        s.add_all(range(10))
+        assert s.retain_all([2, 4, 6, 99])
+        assert sorted(s.read_all()) == [2, 4, 6]
+
+
+class TestSetCache:
+    def test_value_ttl(self, client):
+        s = client.get_set_cache("sc")
+        assert s.add("tmp", ttl=0.05)
+        assert s.add("keep")
+        assert s.contains("tmp")
+        time.sleep(0.07)
+        assert not s.contains("tmp")
+        assert s.contains("keep")
+        assert s.size() == 1
+
+
+class TestSortedSets:
+    def test_sorted_set(self, client):
+        ss = client.get_sorted_set("ss")
+        assert ss.add_all([5, 1, 3])
+        assert not ss.add(3)
+        assert ss.read_all() == [1, 3, 5]
+        assert ss.first() == 1 and ss.last() == 5
+        assert ss.remove(3)
+        assert ss.read_all() == [1, 5]
+
+    def test_lex_sorted_set(self, client):
+        ls = client.get_lex_sorted_set("ls")
+        ls.add_all(["banana", "apple", "cherry", "date"])
+        assert ls.read_all() == ["apple", "banana", "cherry", "date"]
+        assert ls.range("apple", False, "date", False) == ["banana", "cherry"]
+        assert ls.range_head("banana", True) == ["apple", "banana"]
+        assert ls.range_tail("cherry", False) == ["date"]
+        assert ls.count("a", True, "z", True) == 4
+
+    def test_scored_sorted_set(self, client):
+        z = client.get_scored_sorted_set("z")
+        assert z.add(3.0, "c")
+        assert z.add(1.0, "a")
+        assert z.add(2.0, "b")
+        assert not z.add(9.0, "a")  # update, not insert
+        assert z.get_score("a") == 9.0
+        assert z.rank("b") == 0  # order is b(2) c(3) a(9)
+        assert z.rev_rank("b") == 2
+        assert z.read_all() == ["b", "c", "a"]
+        assert z.value_range(0, 1) == ["b", "c"]
+        assert z.entry_range(0, -1) == [("b", 2.0), ("c", 3.0), ("a", 9.0)]
+        assert z.value_range_by_score(2.0, True, 9.0, False) == ["b", "c"]
+        assert z.count(0, True, 3.0, True) == 2
+        assert z.first() == "b" and z.last() == "a"
+        assert z.poll_first() == "b"
+        assert z.poll_last() == "a"
+        assert z.size() == 1
+
+    def test_zadd_modes(self, client):
+        z = client.get_scored_sorted_set("z")
+        assert z.add_if_absent(1.0, "m")
+        assert not z.add_if_absent(5.0, "m")
+        assert z.get_score("m") == 1.0
+        assert z.add_if_exists(2.0, "m")
+        assert not z.add_if_exists(2.0, "nope")
+        assert not z.add_if_greater(1.0, "m")  # 1.0 < 2.0 -> no update
+        assert z.get_score("m") == 2.0
+        z.add_if_greater(7.0, "m")
+        assert z.get_score("m") == 7.0
+        z.add_if_less(3.0, "m")
+        assert z.get_score("m") == 3.0
+        assert z.add_score("m", 1.5) == 4.5
+
+    def test_z_algebra(self, client):
+        a = client.get_scored_sorted_set("a")
+        b = client.get_scored_sorted_set("b")
+        a.add_all({"x": 1, "y": 2})
+        b.add_all({"y": 10, "z": 3})
+        assert a.union("b") == 3
+        assert a.get_score("y") == 12  # SUM aggregate
+        c = client.get_scored_sorted_set("c")
+        c.add_all({"y": 5, "q": 1})
+        assert c.intersection("b", aggregate="MAX") == 1
+        assert c.get_score("y") == 10
+        d = client.get_scored_sorted_set("d")
+        d.add_all({"p": 1, "z": 2})
+        assert d.diff("b") == 1
+        assert d.read_all() == ["p"]
+
+    def test_remove_ranges(self, client):
+        z = client.get_scored_sorted_set("z")
+        z.add_all({f"m{i}": float(i) for i in range(10)})
+        assert z.remove_range_by_rank(0, 2) == 3
+        assert z.remove_range_by_score(7.0, True, 9.0, True) == 3
+        assert z.size() == 4
+
+
+class TestList:
+    def test_list_surface(self, client):
+        lst = client.get_list("l")
+        lst.add_all(["a", "b", "c"])
+        lst.add_first("z")
+        assert lst.read_all() == ["z", "a", "b", "c"]
+        assert lst.get(1) == "a"
+        assert lst.set(1, "A") == "a"
+        lst.add_at(2, "mid")
+        assert lst.read_all() == ["z", "A", "mid", "b", "c"]
+        assert lst.index_of("mid") == 2
+        assert lst.remove("mid")
+        assert lst.remove_at(0) == "z"
+        assert lst.range(0, 1) == ["A", "b"]
+        lst.trim(0, 1)
+        assert lst.read_all() == ["A", "b"]
+        assert lst[0] == "A"
+        lst[0] = "AA"
+        assert lst[0] == "AA"
+
+    def test_lrem_count_and_last_index(self, client):
+        lst = client.get_list("l")
+        lst.add_all(["x", "y", "x", "y", "x"])
+        assert lst.last_index_of("x") == 4
+        assert lst.remove_count("x", 2)
+        assert lst.read_all() == ["y", "y", "x"]
+
+
+class TestQueues:
+    def test_fifo(self, client):
+        q = client.get_queue("q")
+        q.offer(1)
+        q.offer(2)
+        assert q.peek() == 1
+        assert q.poll() == 1
+        assert q.poll() == 2
+        assert q.poll() is None
+        with pytest.raises(LookupError):
+            q.remove_head()
+
+    def test_deque(self, client):
+        d = client.get_deque("d")
+        d.add_first(2)
+        d.add_last(3)
+        d.add_first(1)
+        assert d.read_all() == [1, 2, 3]
+        assert d.poll_last() == 3
+        assert d.peek_first() == 1 and d.peek_last() == 2
+
+    def test_blocking_queue_wakeup(self, client):
+        q = client.get_blocking_queue("bq")
+        out = []
+
+        def consumer():
+            out.append(q.poll_blocking(2.0))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        q.offer("item")
+        t.join(3.0)
+        assert out == ["item"]
+
+    def test_blocking_timeout(self, client):
+        q = client.get_blocking_queue("bq")
+        t0 = time.time()
+        assert q.poll_blocking(0.1) is None
+        assert 0.08 < time.time() - t0 < 1.0
+
+    def test_poll_from_any(self, client):
+        q1 = client.get_blocking_queue("q1")
+        q2 = client.get_blocking_queue("q2")
+        q2.offer("v2")
+        name, v = q1.poll_from_any(0.5, "q2")
+        assert (name, v) == ("q2", "v2")
+
+    def test_bounded(self, client):
+        q = client.get_bounded_blocking_queue("bq")
+        assert q.try_set_capacity(2)
+        assert not q.try_set_capacity(5)
+        assert q.offer(1)
+        assert q.offer(2)
+        assert not q.offer(3)  # full, no timeout
+        assert q.poll() == 1
+        assert q.offer(3, timeout=0.5)
+
+    def test_priority_queue(self, client):
+        pq = client.get_priority_queue("pq")
+        for v in [5, 1, 3]:
+            pq.offer(v)
+        assert pq.peek() == 1
+        assert [pq.poll(), pq.poll(), pq.poll()] == [1, 3, 5]
+
+    def test_ring_buffer(self, client):
+        rb = client.get_ring_buffer("rb")
+        with pytest.raises(RuntimeError):
+            rb.offer(1)
+        rb.try_set_capacity(3)
+        for v in range(5):
+            rb.offer(v)
+        assert rb.read_all() == [2, 3, 4]
+        assert rb.remaining_capacity() == 0
+
+    def test_delayed_queue(self, client):
+        dest = client.get_blocking_queue("dest")
+        dq = client.get_delayed_queue(dest)
+        dq.offer("later", delay=0.15)
+        dq.offer("soon", delay=0.03)
+        assert dest.poll() is None
+        v = dest.poll_blocking(1.0)
+        assert v == "soon"
+        v = dest.poll_blocking(1.0)
+        assert v == "later"
+
+    def test_rpoplpush(self, client):
+        q = client.get_queue("src")
+        q.offer("a")
+        q.offer("b")
+        assert q.poll_last_and_offer_first_to("dst") == "b"
+        assert client.get_queue("dst").peek() == "b"
+
+    def test_transfer_queue(self, client):
+        tq = client.get_transfer_queue("tq")
+        assert not tq.try_transfer("x")  # no waiting consumer
+        res = []
+
+        def consumer():
+            res.append(tq.take())
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        assert tq.transfer("y", timeout=2.0)
+        t.join(2.0)
+        assert res == ["y"]

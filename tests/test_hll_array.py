@@ -1,0 +1,67 @@
+import numpy as np
+import pytest
+
+import redisson_tpu
+
+
+@pytest.fixture()
+def client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+def test_bank_streaming_add_and_estimates(client):
+    bank = client.get_hyper_log_log_array("bank")
+    assert bank.try_init(tenants=8)
+    assert not bank.try_init(tenants=8)
+    rng = np.random.default_rng(0)
+    t = (np.arange(8000) % 8).astype(np.int32)
+    keys = rng.integers(0, 1 << 60, 8000).astype(np.int64)
+    bank.add(t, keys)
+    ests = bank.estimate_all()
+    assert ests.shape == (8,)
+    for e in ests:
+        assert abs(e - 1000) / 1000 < 0.1
+
+
+def test_bank_pairwise_merge(client):
+    bank = client.get_hyper_log_log_array("bank")
+    bank.try_init(tenants=4)
+    bank.add(np.zeros(5000, np.int32), np.arange(0, 5000, dtype=np.int64))
+    bank.add(np.ones(5000, np.int32), np.arange(2500, 7500, dtype=np.int64))
+    union = bank.estimate_union_pairs([0], [1])
+    assert abs(union[0] - 7500) / 7500 < 0.05
+    bank.merge_rows([0], [1])
+    ests = bank.estimate_all()
+    assert abs(ests[0] - 7500) / 7500 < 0.05
+    assert abs(ests[1] - 5000) / 5000 < 0.05  # src untouched
+
+
+def test_bank_validation(client):
+    bank = client.get_hyper_log_log_array("bank")
+    with pytest.raises(RuntimeError, match="not initialized"):
+        bank.add(np.zeros(1, np.int32), np.zeros(1, np.int64))
+    bank.try_init(tenants=2)
+    with pytest.raises(ValueError):
+        bank.merge_rows([0, 1], [1])
+    with pytest.raises(TypeError):
+        bank.add(np.zeros(1, np.int32), ["not-int"])
+
+
+def test_graft_entry_single_chip():
+    import jax
+
+    import __graft_entry__ as G
+
+    fn, args = G.entry()
+    found, bits, regs = jax.jit(fn)(*args)
+    jax.block_until_ready((found, bits, regs))
+    n_valid = int(args[-1])
+    assert not np.asarray(found)[n_valid:].any()
+
+
+def test_graft_entry_multichip():
+    import __graft_entry__ as G
+
+    G.dryrun_multichip(8)

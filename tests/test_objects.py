@@ -1,0 +1,317 @@
+"""Object-layer tests, modeled on the reference's per-object test classes
+(RedissonBucketTest / RedissonBitSetTest / RedissonBloomFilterTest /
+RedissonHyperLogLogTest — SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import redisson_tpu
+
+
+@pytest.fixture()
+def client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+class TestBloomFilter:
+    def test_try_init_once(self, client):
+        bf = client.get_bloom_filter("bf")
+        assert bf.try_init(10_000, 0.01)
+        assert not bf.try_init(5_000, 0.1)  # second init returns False
+        assert bf.get_expected_insertions() == 10_000
+        assert bf.get_false_probability() == 0.01
+        assert bf.get_hash_iterations() == 7
+
+    def test_uninitialized_raises(self, client):
+        bf = client.get_bloom_filter("nope")
+        with pytest.raises(RuntimeError, match="not initialized"):
+            bf.add("x")
+
+    def test_invalid_geometry(self, client):
+        bf = client.get_bloom_filter("bad")
+        with pytest.raises(ValueError):
+            bf.try_init(0, 0.01)
+        with pytest.raises(ValueError):
+            bf.try_init(100, 1.5)
+
+    def test_add_contains_objects(self, client):
+        bf = client.get_bloom_filter("bf")
+        bf.try_init(1000, 0.01)
+        assert bf.add("hello")
+        assert not bf.add("hello")  # already present
+        assert bf.contains("hello")
+        assert not bf.contains("goodbye")
+        assert bf.add({"user": 1, "role": "admin"})  # any JSON-able object
+        assert bf.contains({"role": "admin", "user": 1})  # key-order canonical
+
+    def test_add_contains_int_batch(self, client):
+        bf = client.get_bloom_filter("bf")
+        bf.try_init(100_000, 0.01)
+        keys = np.arange(50_000, dtype=np.int64)
+        assert bf.add_all(keys) >= 49_990
+        found = bf.contains_each(keys)
+        assert found.all()
+        absent = bf.contains_each(np.arange(60_000, 70_000, dtype=np.int64))
+        assert absent.mean() < 0.03
+        assert bf.count_contains(keys[:100]) == 100
+
+    def test_count_estimate(self, client):
+        bf = client.get_bloom_filter("bf")
+        bf.try_init(100_000, 0.01)
+        bf.add_all(np.arange(10_000, dtype=np.int64))
+        assert abs(bf.count() - 10_000) / 10_000 < 0.05
+
+    def test_delete_and_recreate(self, client):
+        bf = client.get_bloom_filter("bf")
+        bf.try_init(1000, 0.01)
+        bf.add("x")
+        assert bf.delete()
+        assert not bf.is_exists()
+        assert bf.try_init(1000, 0.01)
+        assert not bf.contains("x")
+
+
+class TestBloomFilterArray:
+    def test_multi_tenant_isolation(self, client):
+        arr = client.get_bloom_filter_array("tenants")
+        assert arr.try_init(tenants=16, expected_insertions=1000, false_probability=0.01)
+        keys = np.arange(100, dtype=np.int64)
+        arr.add(np.zeros(100, np.int32), keys)  # only tenant 0
+        t0 = arr.contains(np.zeros(100, np.int32), keys)
+        t1 = arr.contains(np.ones(100, np.int32), keys)
+        assert t0.all()
+        assert t1.sum() <= 2  # other tenants unaffected (FP allowance)
+
+    def test_mixed_tenant_flush(self, client):
+        arr = client.get_bloom_filter_array("tenants")
+        arr.try_init(tenants=8, expected_insertions=1000, false_probability=0.01)
+        rng = np.random.default_rng(0)
+        tenants = rng.integers(0, 8, 5000).astype(np.int32)
+        keys = rng.integers(0, 1 << 40, 5000).astype(np.int64)
+        arr.add(tenants, keys)
+        assert arr.contains(tenants, keys).all()
+
+    def test_clear_tenant(self, client):
+        arr = client.get_bloom_filter_array("tenants")
+        arr.try_init(tenants=4, expected_insertions=100, false_probability=0.01)
+        keys = np.arange(50, dtype=np.int64)
+        arr.add(np.full(50, 2, np.int32), keys)
+        arr.clear_tenant(2)
+        assert not arr.contains(np.full(50, 2, np.int32), keys).any()
+
+
+class TestHyperLogLog:
+    def test_basic(self, client):
+        h = client.get_hyper_log_log("hll")
+        assert h.count() == 0
+        h.add("a")
+        h.add("b")
+        h.add("a")
+        assert h.count() == 2
+
+    def test_batch_and_merge(self, client):
+        a = client.get_hyper_log_log("a")
+        b = client.get_hyper_log_log("b")
+        a.add_all(np.arange(0, 60_000, dtype=np.int64))
+        b.add_all(np.arange(30_000, 90_000, dtype=np.int64))
+        assert abs(a.count() - 60_000) / 60_000 < 0.03
+        assert abs(a.count_with("b") - 90_000) / 90_000 < 0.03
+        a.merge_with("b")
+        assert abs(a.count() - 90_000) / 90_000 < 0.03
+        # b unchanged by merge_with
+        assert abs(b.count() - 60_000) / 60_000 < 0.03
+
+    def test_merge_with_self_noop(self, client):
+        a = client.get_hyper_log_log("a")
+        a.add_all(np.arange(1000, dtype=np.int64))
+        before = a.count()
+        a.merge_with("a")
+        assert a.count() == before
+
+
+class TestBitSet:
+    def test_single_bits(self, client):
+        bs = client.get_bit_set("bs")
+        assert not bs.set(7)  # previous value False
+        assert bs.set(7)      # now True
+        assert bs.get(7)
+        assert not bs.get(8)
+        assert bs.clear_bit(7)
+        assert not bs.get(7)
+
+    def test_vectorized_and_aggregates(self, client):
+        bs = client.get_bit_set("bs")
+        bs.set_each(np.arange(0, 1000, 2, dtype=np.int64))
+        assert bs.cardinality() == 500
+        assert bs.length() == 999
+        assert bs.bitpos(True) == 0
+        assert bs.bitpos(False) == 1
+
+    def test_auto_grow(self, client):
+        bs = client.get_bit_set("bs")
+        bs.set(10_000_000)  # beyond default plane
+        assert bs.get(10_000_000)
+        assert bs.cardinality() == 1
+
+    def test_bitops(self, client):
+        a = client.get_bit_set("a")
+        b = client.get_bit_set("b")
+        a.set_range(0, 100)
+        b.set_range(50, 150)
+        a.and_("b")
+        assert a.cardinality() == 50
+        a.or_("b")
+        assert a.cardinality() == 100
+        c = client.get_bit_set("c")
+        c.set_range(0, 10)
+        c.xor("b")
+        assert c.cardinality() == 110
+        c.not_()
+        assert c.cardinality() == c.size() - 110
+
+    def test_byte_array_roundtrip(self, client):
+        a = client.get_bit_set("a")
+        a.set_each(np.asarray([1, 8, 9, 300], np.int64))
+        data = a.to_byte_array()
+        b = client.get_bit_set("b")
+        b.from_byte_array(data)
+        assert b.get(1) and b.get(8) and b.get(9) and b.get(300)
+        assert b.cardinality() == 4
+
+
+class TestBucketFamily:
+    def test_bucket(self, client):
+        b = client.get_bucket("b")
+        assert b.get() is None
+        b.set({"x": 1})
+        assert b.get() == {"x": 1}
+        assert b.get_and_set([1, 2]) == {"x": 1}
+        assert not b.try_set("nope")
+        assert b.compare_and_set([1, 2], "new")
+        assert not b.compare_and_set([1, 2], "newer")
+        assert b.get() == "new"
+        assert b.get_and_delete() == "new"
+        assert b.get() is None
+        assert b.try_set("fresh")
+
+    def test_bucket_ttl(self, client):
+        b = client.get_bucket("b")
+        b.set("v", ttl=1000)
+        assert 999 < b.remain_time_to_live() <= 1000
+        b.set("v2")  # plain set clears TTL (SET without EX)
+        assert b.remain_time_to_live() is None
+
+    def test_buckets(self, client):
+        bs = client.get_buckets()
+        bs.set({"k1": 1, "k2": 2})
+        assert bs.get("k1", "k2", "k3") == {"k1": 1, "k2": 2}
+        assert not bs.try_set({"k3": 3, "k1": 9})  # k1 exists -> all-or-nothing
+        assert bs.get("k3") == {}
+        assert bs.try_set({"k3": 3, "k4": 4})
+        assert bs.get("k3", "k4") == {"k3": 3, "k4": 4}
+
+    def test_atomic_long(self, client):
+        a = client.get_atomic_long("cnt")
+        assert a.get() == 0
+        assert a.increment_and_get() == 1
+        assert a.add_and_get(10) == 11
+        assert a.get_and_add(5) == 11
+        assert a.get() == 16
+        assert a.compare_and_set(16, 100)
+        assert not a.compare_and_set(16, 200)
+        assert a.get_and_set(7) == 100
+        assert a.decrement_and_get() == 6
+
+    def test_atomic_double(self, client):
+        a = client.get_atomic_double("dbl")
+        assert a.add_and_get(2.5) == 2.5
+        assert a.add_and_get(0.5) == 3.0
+
+    def test_id_generator(self, client):
+        g = client.get_id_generator("ids")
+        assert g.try_init(start=100, allocation_size=10)
+        ids = [g.next_id() for _ in range(25)]
+        assert len(set(ids)) == 25
+        assert min(ids) == 100
+
+    def test_wrongtype_guard(self, client):
+        client.get_bucket("x").set(1)
+        with pytest.raises(TypeError):
+            client.get_atomic_long("x").increment_and_get()
+
+
+class TestKeys:
+    def test_keys_surface(self, client):
+        client.get_bucket("user:1").set(1)
+        client.get_bucket("user:2").set(2)
+        client.get_bucket("order:1").set(3)
+        keys = client.get_keys()
+        assert keys.count() == 3
+        assert sorted(keys.get_keys("user:*")) == ["user:1", "user:2"]
+        assert keys.count_exists("user:1", "nope") == 1
+        assert keys.random_key() is not None
+        assert keys.delete_by_pattern("user:*") == 2
+        keys.flushdb()
+        assert keys.count() == 0
+
+    def test_rename(self, client):
+        b = client.get_bucket("old")
+        b.set("v")
+        b.rename("new")
+        assert client.get_bucket("new").get() == "v"
+        assert client.get_bucket("old").get() is None
+
+
+class TestBatch:
+    def test_batch_mixed(self, client):
+        bf = client.get_bloom_filter("bf")
+        bf.try_init(10_000, 0.01)
+        batch = client.create_batch()
+        bb = batch.get_bloom_filter("bf")
+        f1 = bb.add_async(np.arange(100, dtype=np.int64))
+        f2 = bb.contains_async(np.arange(50, 150, dtype=np.int64))
+        bk = batch.get_bucket("greeting")
+        f3 = bk.set_async("hi")
+        f4 = bk.get_async()
+        al = batch.get_atomic_long("n")
+        f5 = al.add_and_get_async(42)
+        res = batch.execute()
+        assert f1.get() >= 99
+        found = f2.get()
+        assert found[:50].all()  # 50..99 were added
+        assert f3.get() is None
+        assert f4.get() == "hi"
+        assert f5.get() == 42
+        assert len(res.responses) == 5
+
+    def test_batch_contains_grouping(self, client):
+        """Many small contains ops fuse into one kernel dispatch."""
+        bf = client.get_bloom_filter("bf")
+        bf.try_init(10_000, 0.01)
+        bf.add_all(np.arange(1000, dtype=np.int64))
+        batch = client.create_batch()
+        bb = batch.get_bloom_filter("bf")
+        futs = [bb.contains_async(np.asarray([i], np.int64)) for i in range(500, 1500)]
+        batch.execute()
+        hits = [bool(f.get()[0]) for f in futs]
+        assert all(hits[:500])
+        assert sum(hits[500:]) < 25
+
+    def test_batch_cannot_rerun(self, client):
+        batch = client.create_batch()
+        batch.get_atomic_long("n").add_and_get_async(1)
+        batch.execute()
+        with pytest.raises(RuntimeError):
+            batch.execute()
+
+    def test_bloom_array_batch(self, client):
+        arr = client.get_bloom_filter_array("t")
+        arr.try_init(tenants=4, expected_insertions=1000, false_probability=0.01)
+        batch = client.create_batch()
+        ba = batch.get_bloom_filter_array("t")
+        f1 = ba.add_async(np.zeros(10, np.int32), np.arange(10, dtype=np.int64))
+        f2 = ba.add_async(np.ones(10, np.int32), np.arange(10, dtype=np.int64))
+        batch.execute()
+        assert f1.get() == 10 and f2.get() == 10
+        assert arr.contains(np.zeros(10, np.int32), np.arange(10, dtype=np.int64)).all()

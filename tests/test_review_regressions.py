@@ -1,0 +1,111 @@
+"""Regressions for code-review findings on the milestone-2 object layer."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+
+
+@pytest.fixture()
+def client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+def test_bloom_bank_size_cap(client):
+    """tenants*m beyond int32 flat-index space must be rejected at init, not
+    silently wrap (false positives)."""
+    arr = client.get_bloom_filter_array("big")
+    with pytest.raises(ValueError, match="flat-index limit"):
+        arr.try_init(tenants=1000, expected_insertions=10_000_000, false_probability=0.01)
+    # a legal large bank still works
+    ok = client.get_bloom_filter_array("ok")
+    assert ok.try_init(tenants=1000, expected_insertions=10_000, false_probability=0.01)
+
+
+def test_batch_scalar_string_key_slice(client):
+    """A single str key must claim ONE result slot, not len(str)."""
+    bf = client.get_bloom_filter("bf")
+    bf.try_init(1000, 0.01)
+    bf.add("hello")
+    batch = client.create_batch()
+    bb = batch.get_bloom_filter("bf")
+    f1 = bb.contains_async("hello")  # scalar str
+    f2 = bb.contains_async("absent-key")
+    f3 = batch.get_atomic_long("n").add_and_get_async(5)
+    batch.execute()
+    assert f1.get().tolist() == [True]
+    assert f2.get().tolist() == [False]
+    assert f3.get() == 5
+
+
+def test_batch_empty_key_array_alignment(client):
+    """An empty key array contributes zero results and must not shift the
+    offset of later ops in the same group."""
+    bs = client.get_bit_set("bs")
+    bs.set(3)
+    batch = client.create_batch()
+    bbs = batch.get_bit_set("bs")
+    f_empty = bbs.get_async(np.asarray([], np.int64))
+    f_real = bbs.get_async(np.asarray([3, 4], np.int64))
+    batch.execute()
+    assert f_empty.get().tolist() == []
+    assert f_real.get().tolist() == [1, 0]
+
+
+def test_fair_lock_dead_waiter_pruned(client):
+    """A waiter that dies at the head of the FIFO must not deadlock the lock."""
+    from redisson_tpu.client.objects.lock import FairLock
+
+    fl = client.get_fair_lock("fl")
+    fl.WAITER_TTL = 0.2  # fast test
+    fl.lock()
+    # simulate a dead waiter: enqueue a ghost holder id directly
+    rec = client.engine.store.get("fl")
+    rec.host["queue"].append(("deadbeef:999", time.time() + fl.WAITER_TTL))
+    fl.unlock()
+    got = []
+
+    def second():
+        lk = client.get_fair_lock("fl")
+        lk.WAITER_TTL = 0.2
+        got.append(lk.try_lock(2.0))
+        if got[0]:
+            lk.unlock()
+
+    t = threading.Thread(target=second)
+    t.start()
+    t.join(5.0)
+    assert got == [True]  # ghost pruned after its deadline, lock acquired
+
+
+def test_bitset_out_of_range_raises(client):
+    bs = client.get_bit_set("bs")
+    with pytest.raises(ValueError, match="out of range"):
+        bs.set(2**31)
+    with pytest.raises(ValueError, match="out of range"):
+        bs.get_each(np.asarray([-1], np.int64))
+    assert bs.cardinality() == 0  # nothing silently written
+
+
+def test_hll_merge_rows_bucketed_shapes(client):
+    """merge_rows pads to pow2 buckets — varying pair counts reuse compiles
+    and padded rows don't corrupt other counters."""
+    bank = client.get_hyper_log_log_array("bank")
+    bank.try_init(tenants=8)
+    keys = np.arange(1000, dtype=np.int64)
+    bank.add(np.zeros(1000, np.int32), keys)
+    bank.add(np.full(1000, 3, np.int32), keys + 5000)
+    before = bank.estimate_all()
+    bank.merge_rows([1], [0])  # 1 pair -> padded bucket
+    bank.merge_rows([2, 4, 5], [0, 3, 3])  # 3 pairs -> same bucket size
+    after = bank.estimate_all()
+    assert abs(after[1] - before[0]) / before[0] < 0.02
+    assert abs(after[2] - before[0]) / before[0] < 0.02
+    assert abs(after[4] - before[3]) / before[3] < 0.02
+    # untouched rows unchanged
+    assert after[6] == 0 and after[7] == 0
+    assert abs(after[0] - before[0]) < 1e-3

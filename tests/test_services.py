@@ -1,0 +1,318 @@
+"""Distributed-services tests (RedissonExecutorServiceTest /
+RedissonScheduledExecutorServiceTest / RedissonRemoteServiceTest /
+RedissonTransactionTest / RedissonLiveObjectServiceTest / MapReduce tests)."""
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.services.executor import CronExpression, inject_client
+from redisson_tpu.services.liveobject import entity
+from redisson_tpu.services.transactions import TransactionException
+
+
+@pytest.fixture()
+def client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+def square(x):
+    return x * x
+
+
+def boom():
+    raise ValueError("kapow")
+
+
+@inject_client
+def uses_client(key, client=None):
+    client.get_atomic_long(key).increment_and_get()
+    return client.get_atomic_long(key).get()
+
+
+class TestExecutor:
+    def test_submit_and_result(self, client):
+        ex = client.get_executor_service("ex")
+        ex.register_workers(2)
+        futs = [ex.submit(square, i) for i in range(10)]
+        assert [f.get(5.0) for f in futs] == [i * i for i in range(10)]
+        assert ex.count_active_workers() == 2
+        ex.shutdown()
+
+    def test_task_failure_propagates(self, client):
+        ex = client.get_executor_service("ex")
+        ex.register_workers(1)
+        f = ex.submit(boom)
+        with pytest.raises(ValueError, match="kapow"):
+            f.get(5.0)
+        assert ex.task_state(f.task_id) == "failed"
+        ex.shutdown()
+
+    def test_cancel_queued(self, client):
+        ex = client.get_executor_service("ex")  # no workers yet
+        f = ex.submit(square, 3)
+        assert ex.cancel_task(f.task_id)
+        assert f.cancelled()
+        assert not ex.cancel_task(f.task_id)
+        ex.register_workers(1)
+        time.sleep(0.1)
+        assert ex.task_state(f.task_id) == "cancelled"
+        ex.shutdown()
+
+    def test_inject_client(self, client):
+        ex = client.get_executor_service("ex")
+        ex.register_workers(1)
+        f = ex.submit(uses_client, "counter")
+        assert f.get(5.0) == 1
+        assert client.get_atomic_long("counter").get() == 1
+        ex.shutdown()
+
+    def test_tasks_survive_for_requeue(self, client):
+        """Orphaned 'running' tasks go back to the queue (worker-death
+        recovery, SURVEY.md §5.3)."""
+        ex = client.get_executor_service("ex")
+        f = ex.submit(square, 7)
+        # simulate a worker that died mid-task
+        task = ex._take_task()
+        assert task is not None and task.state == "running"
+        task.submitted_at -= 120
+        assert ex.requeue_orphans(max_running_age=60) == 1
+        ex.register_workers(1)
+        assert f.get(5.0) == 49
+        ex.shutdown()
+
+
+class TestScheduler:
+    def test_schedule_delay(self, client):
+        sched = client.get_scheduled_executor_service("s")
+        sched.register_workers(1)
+        t0 = time.time()
+        f = sched.schedule(0.1, square, 6)
+        assert f.get(5.0) == 36
+        assert time.time() - t0 >= 0.1
+        sched.shutdown()
+
+    def test_fixed_rate_and_cancel(self, client):
+        # NB: tasks are pickled (serialized-task parity), so the task must hit
+        # shared grid state — a closure over a local list would mutate a copy.
+        sched = client.get_scheduled_executor_service("s")
+        sched.register_workers(1)
+        counter = client.get_atomic_long("ticks")
+        sid = sched.schedule_at_fixed_rate(0.0, 0.05, uses_client, "ticks")
+        time.sleep(0.22)
+        assert sched.cancel_scheduled(sid)
+        time.sleep(0.15)  # drain tasks already queued before the cancel
+        n = counter.get()
+        assert n >= 3
+        time.sleep(0.15)
+        assert counter.get() == n  # no new submissions after cancel
+        sched.shutdown()
+
+    def test_cron_parsing(self):
+        c = CronExpression("*/15 3 * * 1-5")
+        assert c.fields[0] == {0, 15, 30, 45}
+        assert c.fields[1] == {3}
+        t = time.localtime(c.next_fire(time.time()))
+        assert t.tm_min in {0, 15, 30, 45} and t.tm_hour == 3
+        with pytest.raises(ValueError):
+            CronExpression("* * *")
+
+
+class TestRemoteService:
+    class Calc:
+        def add(self, a, b):
+            return a + b
+
+        def fail(self):
+            raise RuntimeError("remote boom")
+
+    def test_invoke(self, client):
+        rs = client.get_remote_service()
+        rs.register("Calc", self.Calc(), workers=2)
+        proxy = rs.get("Calc", timeout=5.0)
+        assert proxy.add(2, 3) == 5
+        with pytest.raises(RuntimeError, match="remote boom"):
+            proxy.fail()
+        rs.deregister()
+
+    def test_ack_mode_and_timeout(self, client):
+        rs = client.get_remote_service()
+        rs.register("Calc", self.Calc(), workers=1)
+        proxy = rs.get("Calc", timeout=5.0, ack_timeout=2.0)
+        assert proxy.add(1, 1) == 2
+        rs.deregister()
+        from redisson_tpu.services.remote import RemoteServiceAckTimeout
+
+        lonely = client.get_remote_service("nobody_home")
+        proxy2 = lonely.get("Ghost", timeout=0.5, ack_timeout=0.3)
+        with pytest.raises((RemoteServiceAckTimeout, TimeoutError)):
+            proxy2.anything()
+
+
+class TestTransactions:
+    def test_commit_applies(self, client):
+        tx = client.create_transaction()
+        b = tx.get_bucket("b")
+        m = tx.get_map("m")
+        b.set("v1")
+        m.put("k", 1)
+        assert client.get_bucket("b").get() is None  # not yet visible
+        tx.commit()
+        assert client.get_bucket("b").get() == "v1"
+        assert client.get_map("m").get("k") == 1
+
+    def test_read_your_writes(self, client):
+        tx = client.create_transaction()
+        m = tx.get_map("m")
+        m.put("k", 42)
+        assert m.get("k") == 42
+        m.remove("k")
+        assert m.get("k") is None
+        tx.rollback()
+        assert client.get_map("m").get("k") is None
+
+    def test_rollback_discards(self, client):
+        tx = client.create_transaction()
+        tx.get_bucket("b").set("x")
+        tx.rollback()
+        assert client.get_bucket("b").get() is None
+        with pytest.raises(TransactionException):
+            tx.commit()
+
+    def test_optimistic_conflict(self, client):
+        client.get_bucket("b").set("orig")
+        tx = client.create_transaction()
+        tb = tx.get_bucket("b")
+        assert tb.get() == "orig"  # records version
+        client.get_bucket("b").set("concurrent!")  # outside the tx
+        tb.set("mine")
+        with pytest.raises(TransactionException, match="changed concurrently"):
+            tx.commit()
+        assert client.get_bucket("b").get() == "concurrent!"
+
+    def test_context_manager_commits(self, client):
+        with client.create_transaction() as tx:
+            tx.get_set("s").add("member")
+        assert client.get_set("s").contains("member")
+
+    def test_timeout(self, client):
+        tx = client.create_transaction(timeout=0.05)
+        time.sleep(0.08)
+        with pytest.raises(TransactionException, match="timed out"):
+            tx.get_bucket("b").set("late")
+
+
+@entity(id_field="user_id", indexed=("city",))
+class User:
+    def __init__(self, user_id, name=None, city=None):
+        self.user_id = user_id
+        self.name = name
+        self.city = city
+
+
+class TestLiveObject:
+    def test_persist_and_live_updates(self, client):
+        svc = client.get_live_object_service()
+        u = svc.persist(User("u1", name="Ada", city="London"))
+        assert u.name == "Ada"
+        u.name = "Ada Lovelace"  # write-through
+        again = svc.get(User, "u1")
+        assert again.name == "Ada Lovelace"
+        assert again == u
+        with pytest.raises(ValueError):
+            svc.persist(User("u1"))
+
+    def test_id_immutable(self, client):
+        svc = client.get_live_object_service()
+        u = svc.persist(User("u2", name="Bob"))
+        with pytest.raises(AttributeError):
+            u.user_id = "other"
+
+    def test_indexed_search(self, client):
+        svc = client.get_live_object_service()
+        svc.persist(User("a", name="A", city="Paris"))
+        svc.persist(User("b", name="B", city="Paris"))
+        svc.persist(User("c", name="C", city="Tokyo"))
+        hits = svc.find(User, city="Paris")
+        assert {h.user_id for h in hits} == {"a", "b"}
+        # index follows updates
+        hits[0].city = "Tokyo"
+        assert {h.user_id for h in svc.find(User, city="Tokyo")} >= {"c"}
+        assert len(svc.find(User, city="Paris")) == 1
+        with pytest.raises(ValueError):
+            svc.find(User, name="A")  # not indexed
+
+    def test_delete(self, client):
+        svc = client.get_live_object_service()
+        svc.persist(User("d", city="Oslo"))
+        assert svc.delete(User, "d")
+        assert svc.get(User, "d") is None
+        assert not svc.delete(User, "d")
+        assert svc.find(User, city="Oslo") == []
+
+
+class TestMapReduce:
+    def test_word_count_generic(self, client):
+        m = client.get_map("src")
+        m.put_all({i: "alpha beta gamma beta" for i in range(50)})
+
+        def mapper(k, v, collector):
+            for w in v.split():
+                collector.emit(w, 1)
+
+        def reducer(word, counts):
+            return sum(counts)
+
+        mr = client.get_map_reduce(mapper, reducer, workers=4)
+        result = mr.execute(m)
+        assert result == {"alpha": 50, "beta": 100, "gamma": 50}
+
+    def test_collator_and_result_map(self, client):
+        m = client.get_map("src")
+        m.put_all({i: "x y" for i in range(10)})
+
+        mr = client.get_map_reduce(
+            lambda k, v, c: [c.emit(w, 1) for w in v.split()],
+            lambda w, counts: sum(counts),
+            collator=lambda result: sum(result.values()),
+        )
+        out_map = client.get_map("out")
+        total = mr.execute(m, result_map=out_map)
+        assert total == 20
+        assert out_map.get("x") == 10
+
+    def test_word_count_fast_path(self, client):
+        from redisson_tpu.services.mapreduce import word_count
+
+        m = client.get_map("src")
+        m.put_all({i: "tick tock tick" for i in range(100)})
+        counts = word_count(client.engine, m, workers=8)
+        assert counts == {"tick": 200, "tock": 100}
+
+    def test_kernel_mapreduce(self, client):
+        import numpy as np
+
+        from redisson_tpu.services.mapreduce import KernelMapReduce
+
+        def map_fn(v):
+            return v % 16, v * 2  # key_id, mapped value
+
+        kmr = KernelMapReduce(map_fn, reduce="sum", n_keys=16)
+        values = np.arange(1600, dtype=np.int32)
+        out = kmr.execute(values)
+        # each key gets 100 values v with v%16==k; sum(2v)
+        expected = np.asarray([sum(2 * v for v in range(k, 1600, 16)) for k in range(16)])
+        np.testing.assert_array_equal(out, expected)
+
+    def test_collection_source(self, client):
+        lst = client.get_list("l")
+        lst.add_all(["a b", "b c", "c d"])
+        mr = client.get_map_reduce(
+            lambda _k, v, c: [c.emit(w, 1) for w in v.split()],
+            lambda w, counts: sum(counts),
+            workers=2,
+        )
+        assert mr.execute(lst) == {"a": 1, "b": 2, "c": 2, "d": 1}

@@ -1,0 +1,358 @@
+"""Synchronizer tests (RedissonLockTest / RedissonSemaphoreTest /
+RedissonCountDownLatchTest / RedissonRateLimiterTest analogs), including
+cross-thread contention like BaseConcurrentTest fan-outs."""
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+
+
+@pytest.fixture()
+def client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+class TestLock:
+    def test_reentrancy(self, client):
+        lk = client.get_lock("l")
+        lk.lock()
+        lk.lock()
+        assert lk.get_hold_count() == 2
+        assert lk.is_held_by_current_thread()
+        lk.unlock()
+        assert lk.is_locked()
+        lk.unlock()
+        assert not lk.is_locked()
+
+    def test_unlock_foreign_raises(self, client):
+        lk = client.get_lock("l")
+        lk.lock()
+        err = []
+
+        def alien():
+            try:
+                lk.unlock()
+            except RuntimeError as e:
+                err.append(e)
+
+        t = threading.Thread(target=alien)
+        t.start()
+        t.join()
+        assert err
+        lk.unlock()
+
+    def test_contention_handoff(self, client):
+        lk = client.get_lock("l")
+        order = []
+
+        def worker(i):
+            lk.lock()
+            order.append(i)
+            time.sleep(0.01)
+            lk.unlock()
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5.0)
+        assert sorted(order) == [0, 1, 2, 3, 4]
+
+    def test_try_lock_timeout(self, client):
+        lk = client.get_lock("l")
+        lk.lock()
+        got = []
+
+        def other():
+            got.append(lk.try_lock(0.1))
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert got == [False]
+        lk.unlock()
+
+    def test_lease_expiry_allows_steal(self, client):
+        lk = client.get_lock("l")
+        lk.lock(lease_time=0.05)  # explicit short lease, no watchdog
+        time.sleep(0.08)
+        got = []
+
+        def other():
+            got.append(lk.try_lock(0.0))
+            if got[0]:
+                lk.unlock()
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert got == [True]
+
+    def test_context_manager(self, client):
+        with client.get_lock("l") as lk:
+            assert lk.is_locked()
+        assert not client.get_lock("l").is_locked()
+
+    def test_force_unlock(self, client):
+        lk = client.get_lock("l")
+        lk.lock()
+        assert lk.force_unlock()
+        assert not lk.is_locked()
+        assert not lk.force_unlock()
+
+
+class TestSpecialLocks:
+    def test_fenced_tokens_monotonic(self, client):
+        fl = client.get_fenced_lock("f")
+        t1 = fl.lock_and_get_token()
+        fl.unlock()
+        t2 = fl.lock_and_get_token()
+        fl.unlock()
+        assert t2 > t1
+
+    def test_spin_lock(self, client):
+        sl = client.get_spin_lock("s")
+        sl.lock()
+        assert sl.is_locked()
+        assert sl.try_lock(0.0)  # reentrant from same thread
+        sl.unlock()
+        got = []
+
+        def other():
+            got.append(sl.try_lock(0.05))
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert got == [False]  # still held once by this thread
+        sl.unlock()
+        t2 = threading.Thread(target=lambda: got.append(sl.try_lock(0.5)))
+        t2.start()
+        t2.join()
+        assert got[-1] is True
+
+    def test_fair_lock_fifo(self, client):
+        fl = client.get_fair_lock("fair")
+        fl.lock()
+        order = []
+        threads = []
+
+        def worker(i):
+            fl.lock()
+            order.append(i)
+            fl.unlock()
+
+        for i in range(4):
+            t = threading.Thread(target=worker, args=(i,))
+            t.start()
+            threads.append(t)
+            time.sleep(0.05)  # enqueue deterministically
+        fl.unlock()
+        for t in threads:
+            t.join(5.0)
+        assert order == [0, 1, 2, 3]  # FIFO grant order
+
+    def test_read_write(self, client):
+        rw = client.get_read_write_lock("rw")
+        r1, r2, w = rw.read_lock(), rw.read_lock(), rw.write_lock()
+        assert r1.try_lock(0.0)
+        assert r2.try_lock(0.0)  # shared readers
+        blocked = []
+
+        def writer():
+            blocked.append(w.try_lock(0.05))
+
+        t = threading.Thread(target=writer)
+        t.start()
+        t.join()
+        assert blocked == [False]
+        r1.unlock()
+        r2.unlock()
+        assert rw.write_lock().try_lock(0.5)
+
+    def test_write_then_read_same_thread(self, client):
+        rw = client.get_read_write_lock("rw")
+        w = rw.write_lock()
+        w.lock()
+        r = rw.read_lock()
+        assert r.try_lock(0.0)  # downgrade allowed
+        r.unlock()
+        w.unlock()
+
+    def test_multilock(self, client):
+        l1, l2 = client.get_lock("m1"), client.get_lock("m2")
+        ml = client.get_multi_lock(l1, l2)
+        assert ml.try_lock(1.0)
+        assert l1.is_locked() and l2.is_locked()
+        ml.unlock()
+        assert not l1.is_locked() and not l2.is_locked()
+
+    def test_multilock_all_or_nothing(self, client):
+        l1, l2 = client.get_lock("m1"), client.get_lock("m2")
+        holder_release = threading.Event()
+
+        def holder():
+            l2.lock()
+            holder_release.wait(3.0)
+            l2.unlock()
+
+        t = threading.Thread(target=holder)
+        t.start()
+        time.sleep(0.05)
+        ml = client.get_multi_lock(l1, l2)
+        assert not ml.try_lock(0.2)
+        assert not l1.is_locked()  # rolled back
+        holder_release.set()
+        t.join()
+
+
+class TestSemaphores:
+    def test_semaphore(self, client):
+        s = client.get_semaphore("s")
+        assert s.try_set_permits(2)
+        assert not s.try_set_permits(5)
+        assert s.try_acquire()
+        assert s.try_acquire()
+        assert not s.try_acquire()
+        s.release()
+        assert s.available_permits() == 1
+        assert s.drain_permits() == 1
+        assert s.available_permits() == 0
+
+    def test_semaphore_blocking(self, client):
+        s = client.get_semaphore("s")
+        s.try_set_permits(1)
+        s.acquire()
+        got = []
+
+        def waiter():
+            got.append(s.try_acquire(wait_time=2.0))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        s.release()
+        t.join(3.0)
+        assert got == [True]
+
+    def test_permit_expirable(self, client):
+        ps = client.get_permit_expirable_semaphore("ps")
+        ps.try_set_permits(1)
+        pid = ps.try_acquire()
+        assert pid is not None
+        assert ps.try_acquire() is None
+        assert ps.release(pid)
+        assert not ps.release(pid)  # double release
+        pid2 = ps.try_acquire(lease_time=0.05)
+        time.sleep(0.08)
+        assert ps.available_permits() == 1  # lease expired back to pool
+        assert not ps.release(pid2)
+        assert ps.update_lease_time(pid2, 10.0) is False
+
+    def test_count_down_latch(self, client):
+        latch = client.get_count_down_latch("cdl")
+        assert latch.try_set_count(2)
+        assert not latch.try_set_count(3)
+        done = []
+
+        def waiter():
+            done.append(latch.await_(3.0))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        latch.count_down()
+        assert latch.get_count() == 1
+        latch.count_down()
+        t.join(3.0)
+        assert done == [True]
+        assert latch.await_(0.0)
+
+    def test_rate_limiter(self, client):
+        rl = client.get_rate_limiter("rl")
+        assert rl.try_set_rate(rl.OVERALL, 3, 0.2)
+        assert not rl.try_set_rate(rl.OVERALL, 10, 1.0)
+        assert rl.try_acquire()
+        assert rl.try_acquire(2)
+        assert not rl.try_acquire()  # exhausted
+        assert rl.available_permits() == 0
+        time.sleep(0.25)
+        assert rl.try_acquire()  # window slid
+
+    def test_rate_limiter_waits(self, client):
+        rl = client.get_rate_limiter("rl")
+        rl.try_set_rate(rl.OVERALL, 1, 0.1)
+        assert rl.try_acquire()
+        t0 = time.time()
+        assert rl.try_acquire(timeout=1.0)
+        assert time.time() - t0 >= 0.08
+
+    def test_rate_limiter_validation(self, client):
+        rl = client.get_rate_limiter("rl")
+        with pytest.raises(RuntimeError):
+            rl.try_acquire()
+        rl.try_set_rate(rl.OVERALL, 2, 1.0)
+        with pytest.raises(ValueError):
+            rl.try_acquire(5)
+        assert rl.get_config()["rate"] == 2
+
+
+class TestTopics:
+    def test_topic_pubsub(self, client):
+        topic = client.get_topic("t")
+        got = []
+        lid = topic.add_listener(lambda ch, msg: got.append((ch, msg)))
+        assert topic.count_subscribers() == 1
+        n = topic.publish({"hello": "world"})
+        assert n == 1
+        assert got == [("t", {"hello": "world"})]
+        topic.remove_listener(lid)
+        assert topic.publish("x") == 0
+
+    def test_pattern_topic(self, client):
+        pt = client.get_pattern_topic("news.*")
+        got = []
+        pt.add_listener(lambda ch, msg: got.append((ch, msg)))
+        client.get_topic("news.sports").publish("goal")
+        client.get_topic("weather").publish("rain")
+        assert got == [("news.sports", "goal")]
+
+    def test_sharded_topic(self, client):
+        st = client.get_sharded_topic("st")
+        got = []
+        st.add_listener(lambda ch, msg: got.append(msg))
+        st.publish(1)
+        assert got == [1]
+        assert 0 <= st.slot() < 16384
+
+    def test_reliable_topic(self, client):
+        rt = client.get_reliable_topic("rt")
+        s1 = rt.add_subscriber()
+        rt.publish("m1")
+        rt.publish("m2")
+        s2 = rt.add_subscriber()  # starts at tail
+        rt.publish("m3")
+        assert rt.poll(s1, max_messages=10) == ["m1", "m2", "m3"]
+        assert rt.poll(s2, max_messages=10) == ["m3"]
+        # all consumed -> trimmed
+        assert rt.size() == 0
+        rt.remove_subscriber(s1)
+        rt.remove_subscriber(s2)
+
+    def test_reliable_topic_blocking_poll(self, client):
+        rt = client.get_reliable_topic("rt")
+        sid = rt.add_subscriber()
+        got = []
+
+        def sub():
+            got.extend(rt.poll(sid, timeout=2.0))
+
+        t = threading.Thread(target=sub)
+        t.start()
+        time.sleep(0.05)
+        rt.publish("wake")
+        t.join(3.0)
+        assert got == ["wake"]
