@@ -1,0 +1,269 @@
+// rtpu native runtime: RESP2/RESP3 frame tokenizer + CRC16 slot hashing.
+//
+// Role parity: the reference's hot wire path is Netty's CommandEncoder /
+// CommandDecoder (client/handler/CommandDecoder.java:58-270 — a
+// ReplayingDecoder over RESP2+RESP3 markers `_ , + - : $ = % * > ~ #`) and
+// connection/CRC16.java for cluster slot routing.  Here the same roles are
+// native C++ behind a C ABI consumed via ctypes (no pybind11 in the image):
+//
+//   * rtpu_resp_scan: zero-copy tokenizer — scans a byte buffer and emits a
+//     flat token stream (type, int payload, byte offset/length into the
+//     caller's buffer) for as many COMPLETE top-level values as present.
+//     Incomplete trailing values are left unconsumed (the ReplayingDecoder
+//     checkpoint discipline), so callers just retain the tail.
+//   * rtpu_crc16 / rtpu_calc_slots: CCITT CRC16 with {hashtag} extraction,
+//     batched over N keys per call.
+//
+// Python reconstructs nested values from the token stream (net/resp.py); the
+// byte scanning — the actual per-command overhead — stays native.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+struct RtpuToken {
+  int32_t type;   // token kind, see constants below
+  int32_t flags;  // reserved
+  int64_t val;    // int payload (INT/BOOL) or element count / byte length
+  uint64_t off;   // payload byte offset into the scanned buffer
+};
+
+enum {
+  RTPU_SIMPLE = 1,   // +line         -> off/val = text
+  RTPU_ERROR = 2,    // -line         -> off/val = text
+  RTPU_INT = 3,      // :n  (n        -> val
+  RTPU_BULK = 4,     // $n / =n       -> off/val = payload
+  RTPU_NULL = 5,     // _  / $-1 / *-1
+  RTPU_ARRAY = 6,    // *n            -> val = n
+  RTPU_MAP = 7,      // %n            -> val = n pairs
+  RTPU_SET = 8,      // ~n            -> val = n
+  RTPU_DOUBLE = 9,   // ,text         -> off/val = text
+  RTPU_BOOL = 10,    // #t/#f         -> val
+  RTPU_PUSH = 11,    // >n            -> val = n
+};
+
+namespace {
+
+struct Scanner {
+  const uint8_t* buf;
+  uint64_t len;
+  uint64_t pos;
+  RtpuToken* toks;
+  uint64_t ntok;
+  uint64_t max_toks;
+  bool overflow;  // token buffer exhausted mid-value
+  bool bad;       // protocol violation
+};
+
+inline bool emit(Scanner& s, int32_t type, int64_t val, uint64_t off) {
+  if (s.ntok >= s.max_toks) {
+    s.overflow = true;
+    return false;
+  }
+  RtpuToken& t = s.toks[s.ntok++];
+  t.type = type;
+  t.flags = 0;
+  t.val = val;
+  t.off = off;
+  return true;
+}
+
+// find index just past "\r\n" starting at from; 0 if not found
+inline uint64_t find_crlf(const Scanner& s, uint64_t from, uint64_t* text_end) {
+  const uint8_t* p =
+      (const uint8_t*)memchr(s.buf + from, '\r', s.len - from);
+  while (p) {
+    uint64_t i = (uint64_t)(p - s.buf);
+    if (i + 1 >= s.len) return 0;
+    if (s.buf[i + 1] == '\n') {
+      *text_end = i;
+      return i + 2;
+    }
+    p = (const uint8_t*)memchr(s.buf + i + 1, '\r', s.len - i - 1);
+  }
+  return 0;
+}
+
+inline bool parse_i64(const uint8_t* p, uint64_t n, int64_t* out) {
+  if (n == 0) return false;
+  bool neg = false;
+  uint64_t i = 0;
+  if (p[0] == '-') { neg = true; i = 1; if (n == 1) return false; }
+  else if (p[0] == '+') { i = 1; if (n == 1) return false; }
+  int64_t v = 0;
+  for (; i < n; i++) {
+    if (p[i] < '0' || p[i] > '9') return false;
+    v = v * 10 + (p[i] - '0');
+  }
+  *out = neg ? -v : v;
+  return true;
+}
+
+bool parse_value(Scanner& s) {
+  if (s.pos >= s.len) return false;
+  uint8_t t = s.buf[s.pos];
+  uint64_t text_end;
+  uint64_t next = find_crlf(s, s.pos + 1, &text_end);
+  if (next == 0) return false;
+  uint64_t loff = s.pos + 1;
+  uint64_t llen = text_end - loff;
+  switch (t) {
+    case '+':
+      if (!emit(s, RTPU_SIMPLE, (int64_t)llen, loff)) return false;
+      s.pos = next;
+      return true;
+    case '-':
+      if (!emit(s, RTPU_ERROR, (int64_t)llen, loff)) return false;
+      s.pos = next;
+      return true;
+    case ':':
+    case '(': {  // big number: parse as i64 (covers the practical range)
+      int64_t v;
+      if (!parse_i64(s.buf + loff, llen, &v)) { s.bad = true; return false; }
+      if (!emit(s, RTPU_INT, v, loff)) return false;
+      s.pos = next;
+      return true;
+    }
+    case '#':
+      if (llen != 1 || (s.buf[loff] != 't' && s.buf[loff] != 'f')) {
+        s.bad = true;
+        return false;
+      }
+      if (!emit(s, RTPU_BOOL, s.buf[loff] == 't' ? 1 : 0, loff)) return false;
+      s.pos = next;
+      return true;
+    case ',':
+      if (!emit(s, RTPU_DOUBLE, (int64_t)llen, loff)) return false;
+      s.pos = next;
+      return true;
+    case '_':
+      if (!emit(s, RTPU_NULL, 0, loff)) return false;
+      s.pos = next;
+      return true;
+    case '$':
+    case '=': {
+      int64_t n;
+      if (!parse_i64(s.buf + loff, llen, &n)) { s.bad = true; return false; }
+      if (n == -1) {
+        if (!emit(s, RTPU_NULL, 0, loff)) return false;
+        s.pos = next;
+        return true;
+      }
+      if (n < 0) { s.bad = true; return false; }
+      if (next + (uint64_t)n + 2 > s.len) return false;  // incomplete
+      if (s.buf[next + n] != '\r' || s.buf[next + n + 1] != '\n') {
+        s.bad = true;
+        return false;
+      }
+      if (!emit(s, RTPU_BULK, n, next)) return false;
+      s.pos = next + n + 2;
+      return true;
+    }
+    case '*':
+    case '~':
+    case '>':
+    case '%': {
+      int64_t n;
+      if (!parse_i64(s.buf + loff, llen, &n)) { s.bad = true; return false; }
+      if (n == -1) {
+        if (!emit(s, RTPU_NULL, 0, loff)) return false;
+        s.pos = next;
+        return true;
+      }
+      if (n < 0) { s.bad = true; return false; }
+      int32_t type = t == '*' ? RTPU_ARRAY
+                   : t == '~' ? RTPU_SET
+                   : t == '>' ? RTPU_PUSH
+                              : RTPU_MAP;
+      if (!emit(s, type, n, loff)) return false;
+      s.pos = next;
+      int64_t count = (t == '%') ? 2 * n : n;
+      for (int64_t i = 0; i < count; i++) {
+        if (!parse_value(s)) return false;
+      }
+      return true;
+    }
+    default:
+      s.bad = true;
+      return false;
+  }
+}
+
+}  // namespace
+
+// Scan as many complete top-level RESP values as present in buf[0:len).
+// Returns: number of complete values (>=0), -1 on protocol error, or -2 when
+// the token buffer overflowed before ANY value committed (caller must grow
+// max_toks and rescan — a single value can hold arbitrarily many elements).
+// *ntok_out = tokens written, *consumed_out = bytes consumed (always a
+// complete-value boundary).
+int64_t rtpu_resp_scan(const uint8_t* buf, uint64_t len, RtpuToken* toks,
+                       uint64_t max_toks, uint64_t* ntok_out,
+                       uint64_t* consumed_out) {
+  Scanner s{buf, len, 0, toks, 0, max_toks, false, false};
+  int64_t values = 0;
+  uint64_t committed_pos = 0, committed_tok = 0;
+  while (s.pos < s.len) {
+    if (!parse_value(s)) {
+      if (s.bad) return -1;
+      break;  // incomplete or token overflow: roll back to last commit
+    }
+    values++;
+    committed_pos = s.pos;
+    committed_tok = s.ntok;
+  }
+  *ntok_out = committed_tok;
+  *consumed_out = committed_pos;
+  if (values == 0 && s.overflow) return -2;
+  return values;
+}
+
+// ---------------------------------------------------------------------------
+// CRC16 (CCITT/XModem), table-driven — connection/CRC16.java parity.
+// ---------------------------------------------------------------------------
+
+static uint16_t g_crc_table[256];
+static bool g_crc_init = false;
+
+static void crc_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i << 8;
+    for (int b = 0; b < 8; b++)
+      crc = (crc & 0x8000) ? ((crc << 1) ^ 0x1021) : (crc << 1);
+    g_crc_table[i] = (uint16_t)(crc & 0xFFFF);
+  }
+  g_crc_init = true;
+}
+
+uint16_t rtpu_crc16(const uint8_t* data, uint64_t len) {
+  if (!g_crc_init) crc_init();
+  uint16_t crc = 0;
+  for (uint64_t i = 0; i < len; i++)
+    crc = (uint16_t)(((crc << 8) & 0xFFFF) ^
+                     g_crc_table[((crc >> 8) ^ data[i]) & 0xFF]);
+  return crc;
+}
+
+// Batched slot calc with {hashtag} extraction (Redis cluster rules):
+// slot = crc16(hashtag(key)) % 16384.
+void rtpu_calc_slots(const uint8_t* buf, const uint64_t* offs,
+                     const uint64_t* lens, uint64_t n, uint16_t* out) {
+  if (!g_crc_init) crc_init();
+  for (uint64_t i = 0; i < n; i++) {
+    const uint8_t* key = buf + offs[i];
+    uint64_t len = lens[i];
+    const uint8_t* h = (const uint8_t*)memchr(key, '{', len);
+    if (h) {
+      uint64_t start = (uint64_t)(h - key) + 1;
+      const uint8_t* e = (const uint8_t*)memchr(key + start, '}', len - start);
+      if (e && (uint64_t)(e - key) > start) {
+        key = key + start;
+        len = (uint64_t)(e - (key));
+      }
+    }
+    out[i] = rtpu_crc16(key, len) % 16384;
+  }
+}
+
+}  // extern "C"

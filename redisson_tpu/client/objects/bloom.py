@@ -109,9 +109,14 @@ class BloomFilter(RExpirable):
     def add_all(self, objs) -> int:
         """Batch add; returns the number of (probably) new elements
         (RedissonBloomFilter.java:105-137 contract)."""
+        return int(self.add_each(objs).sum())
+
+    def add_each(self, objs) -> np.ndarray:
+        """Batch add; returns a per-key "was newly added" bool array aligned
+        with objs (the BF.MADD reply shape)."""
         kind, arrays, n = self._engine.pack_keys(objs, self._codec)
         if n == 0:
-            return 0
+            return np.zeros((0,), bool)
         with self._engine.locked(self._name):
             rec = self._rec()
             m, k = rec.meta["m"], rec.meta["k"]
@@ -124,7 +129,7 @@ class BloomFilter(RExpirable):
                 bits, newly = K.bloom_add_bytes_masked(bits, words, nbytes, n, k, m)
             rec.arrays["bits"] = bits
             self._touch_version(rec)
-        return int(np.asarray(newly).sum())
+        return np.asarray(newly)[:n]
 
     def contains(self, obj) -> bool:
         if isinstance(obj, np.ndarray):

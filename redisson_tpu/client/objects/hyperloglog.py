@@ -30,6 +30,11 @@ class HyperLogLog(RExpirable):
 
         return self._engine.store.get_or_create(self._name, "hll", factory)
 
+    def create_if_absent(self) -> None:
+        """Create the (empty) register bank if absent (PFADD with no args).
+        Named to avoid colliding with RObject.touch's last-access contract."""
+        self._rec_or_create()
+
     def add(self, obj) -> bool:
         """PFADD semantics: True if any register changed."""
         return self.add_all([obj] if not isinstance(obj, np.ndarray) else obj)

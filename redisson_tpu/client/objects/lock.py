@@ -35,11 +35,22 @@ DEFAULT_LEASE = 30.0  # lockWatchdogTimeout default (config/Config.java:71)
 
 
 def _holder_id(engine) -> str:
-    """uuid:threadId — the reference's LockName (RedissonBaseLock.getLockName)."""
+    """uuid:threadId — the reference's LockName (RedissonBaseLock.getLockName).
+    A remote caller's identity (set via engine.impersonate) wins, so locks
+    taken over the wire belong to the client thread, not the server worker."""
+    override = engine.holder_override()
+    if override is not None:
+        return override
     eid = getattr(engine, "_client_uuid", None)
     if eid is None:
-        eid = engine._client_uuid = uuid.uuid4().hex
+        with _UUID_INIT_LOCK:
+            eid = getattr(engine, "_client_uuid", None)
+            if eid is None:
+                eid = engine._client_uuid = uuid.uuid4().hex
     return f"{eid}:{threading.get_ident()}"
+
+
+_UUID_INIT_LOCK = threading.Lock()
 
 
 class Lock(RExpirable):
@@ -104,8 +115,14 @@ class Lock(RExpirable):
 
     def _start_watchdog(self, lease_time: Optional[float]):
         """scheduleExpirationRenewal (RedissonBaseLock.java:127-189): only when
-        no explicit lease was given, renew every DEFAULT_LEASE/3 while held."""
-        if lease_time is not None:
+        no explicit lease was given, renew every DEFAULT_LEASE/3 while held.
+
+        Never started for impersonated (remote OBJCALL) holders: the
+        reference's watchdog lives in the CLIENT process precisely so a dead
+        client stops renewing and the lease expires — a server-side renewal
+        under the client's identity would pin the lock forever.  Remote
+        holders renew client-side (RemoteRedisson lock wrapper)."""
+        if lease_time is not None or self._engine.holder_override() is not None:
             return
         me = _holder_id(self._engine)
 
@@ -122,6 +139,18 @@ class Lock(RExpirable):
         t = threading.Timer(DEFAULT_LEASE / 3, renew)
         t.daemon = True
         t.start()
+
+    def renew_lease(self, lease_time: float = DEFAULT_LEASE) -> bool:
+        """One explicit lease extension if still held by the caller — the
+        remote client's watchdog tick (the PEXPIRE Lua of
+        RedissonBaseLock.renewExpiration, driven client-side over the wire)."""
+        me = _holder_id(self._engine)
+        with self._engine.locked(self._name):
+            rec = self._engine.store.get(self._name)
+            if rec is None or rec.host["owner"] != me or rec.host["count"] == 0:
+                return False
+            rec.host["lease_until"] = time.time() + lease_time
+            return True
 
     def unlock(self) -> None:
         """RedissonLock.unlock:337-360: decrement reentrancy; on zero, release

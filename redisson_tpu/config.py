@@ -1,0 +1,204 @@
+"""Config system: typed knobs + YAML/JSON loading with env-var substitution.
+
+Parity target: ``org/redisson/config/Config.java:57-99`` (global knobs with
+defaults: threads=16, lockWatchdogTimeout=30s, protocol, transportMode,
+eviction delays) plus the per-mode server configs
+(``config/BaseConfig.java``, ``BaseMasterSlaveServersConfig.java``,
+``ClusterServersConfig.java``: retryAttempts=3, retryInterval, timeout,
+pingConnectionInterval, scanInterval, pool sizes) and the YAML/JSON loaders
+with ``${ENV_VAR}`` substitution (``config/Config.java:601-631``,
+``ConfigSupport.java``).
+
+TPU-first deltas: knobs that tune Netty event loops become knobs that tune
+the batching engine (flush window, max batch, shape-bucket floor) and the
+device mesh (dp axis size, shard axis size, platform).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_ENV_PATTERN = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)(?::([^}]*))?\}")
+
+
+def _substitute_env(text: str) -> str:
+    """``${VAR}`` / ``${VAR:default}`` substitution (ConfigSupport analog)."""
+
+    def repl(m: re.Match) -> str:
+        var, default = m.group(1), m.group(2)
+        val = os.environ.get(var)
+        if val is None:
+            if default is not None:
+                return default
+            raise KeyError(f"environment variable '{var}' is not set and has no default")
+        return val
+
+    return _ENV_PATTERN.sub(repl, text)
+
+
+@dataclass
+class SingleServerConfig:
+    """Client/remote mode target (SingleServerConfig analog)."""
+
+    address: str = "tpu://127.0.0.1:6379"
+    database: int = 0
+    username: Optional[str] = None
+    password: Optional[str] = None
+    client_name: Optional[str] = None
+    # connection behavior (BaseConfig defaults)
+    connect_timeout: float = 10.0            # connectTimeout 10s
+    timeout: float = 3.0                     # command response timeout 3s
+    retry_attempts: int = 3                  # retryAttempts=3
+    retry_interval: float = 1.5              # retryInterval=1500ms
+    ping_connection_interval: float = 30.0   # pingConnectionInterval=30s
+    keep_alive: bool = True
+    # pool sizing (connection pool analog)
+    connection_pool_size: int = 8            # reference default 64 (JVM); net thread count here
+    connection_minimum_idle_size: int = 1
+    subscription_connection_pool_size: int = 2
+
+
+@dataclass
+class ClusterServersConfig:
+    """Cluster mode (ClusterServersConfig analog)."""
+
+    node_addresses: List[str] = field(default_factory=list)
+    scan_interval: float = 5.0               # scanInterval=5000ms topology poll
+    username: Optional[str] = None
+    password: Optional[str] = None
+    client_name: Optional[str] = None
+    connect_timeout: float = 10.0
+    timeout: float = 3.0
+    retry_attempts: int = 3
+    retry_interval: float = 1.5
+    ping_connection_interval: float = 30.0
+    connection_pool_size: int = 8
+    read_mode: str = "MASTER"                # MASTER | SLAVE | MASTER_SLAVE
+
+
+@dataclass
+class MeshConfig:
+    """Device-mesh layout for the embedded data plane (L3', SURVEY §7.1-3).
+
+    The reference has no analog — the closest is the cluster slot layout;
+    here it's (dp, shard) axis sizes over jax.devices().
+    """
+
+    dp: int = 1                  # data-parallel axis size (1 = no dp split)
+    shard: Optional[int] = None  # state-parallel axis; None = all remaining devices
+    platform: Optional[str] = None  # force "cpu"/"tpu"; None = jax default
+    n_devices: Optional[int] = None  # cap device count; None = all
+
+
+@dataclass
+class Config:
+    """Global framework config (org/redisson/config/Config.java analog)."""
+
+    # -- reference-named knobs (same semantics) ------------------------------
+    threads: int = 16                         # service executor pool
+    lock_watchdog_timeout: float = 30.0       # lockWatchdogTimeout=30_000ms
+    check_lock_synced_slaves: bool = True
+    reliable_topic_watchdog_timeout: float = 600.0   # Config.java:77
+    min_cleanup_delay: float = 5.0            # eviction min delay (Config.java:83-87)
+    max_cleanup_delay: float = 1800.0         # eviction max delay 30min
+    clean_up_keys_amount: int = 100
+    use_script_cache: bool = True
+    netty_threads: int = 0                    # accepted for config-file parity; unused
+
+    # -- TPU-first knobs (batching engine replaces Netty tuning) -------------
+    batch_flush_window_us: int = 200          # micro-batch collect window
+    batch_max_ops: int = 65536                # flush threshold
+    min_shape_bucket: int = 256               # pow2 padding floor (kernels.MIN_BUCKET)
+
+    # -- mode sections --------------------------------------------------------
+    single_server_config: Optional[SingleServerConfig] = None
+    cluster_servers_config: Optional[ClusterServersConfig] = None
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+    # -- SPI slots (reference extension points, §5.6) -------------------------
+    # name_mapper: map logical object names to stored keys (NameMapper)
+    name_mapper: Any = None
+    # engine hooks: instrumentation callbacks (NettyHook analog, §5.1)
+    hooks: List[Any] = field(default_factory=list)
+
+    # ------------------------------------------------------------------------
+
+    def use_single_server(self) -> SingleServerConfig:
+        if self.single_server_config is None:
+            self.single_server_config = SingleServerConfig()
+        return self.single_server_config
+
+    def use_cluster_servers(self) -> ClusterServersConfig:
+        if self.cluster_servers_config is None:
+            self.cluster_servers_config = ClusterServersConfig()
+        return self.cluster_servers_config
+
+    # -- loaders (Config.fromYAML / fromJSON analogs) ------------------------
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Config":
+        data = dict(data)
+        single = data.pop("singleServerConfig", data.pop("single_server_config", None))
+        cluster = data.pop("clusterServersConfig", data.pop("cluster_servers_config", None))
+        mesh = data.pop("mesh", None)
+        cfg = cls(**{_snake(k): v for k, v in data.items() if _known_field(cls, _snake(k))})
+        if single:
+            cfg.single_server_config = _build(SingleServerConfig, single)
+        if cluster:
+            cfg.cluster_servers_config = _build(ClusterServersConfig, cluster)
+        if mesh:
+            cfg.mesh = _build(MeshConfig, mesh)
+        return cfg
+
+    @classmethod
+    def from_yaml(cls, text_or_path) -> "Config":
+        import yaml
+
+        text = _read_maybe_path(text_or_path)
+        return cls.from_dict(yaml.safe_load(_substitute_env(text)) or {})
+
+    @classmethod
+    def from_json(cls, text_or_path) -> "Config":
+        text = _read_maybe_path(text_or_path)
+        return cls.from_dict(json.loads(_substitute_env(text)))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_yaml(self) -> str:
+        import yaml
+
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+
+def _read_maybe_path(text_or_path) -> str:
+    s = str(text_or_path)
+    if "\n" not in s and (s.endswith((".yaml", ".yml", ".json")) or os.path.exists(s)):
+        with open(s, "r", encoding="utf-8") as f:
+            return f.read()
+    return s
+
+
+_SNAKE1 = re.compile(r"(.)([A-Z][a-z]+)")
+_SNAKE2 = re.compile(r"([a-z0-9])([A-Z])")
+
+
+def _snake(name: str) -> str:
+    return _SNAKE2.sub(r"\1_\2", _SNAKE1.sub(r"\1_\2", name)).lower()
+
+
+def _known_field(cls, name: str) -> bool:
+    return name in {f.name for f in dataclasses.fields(cls)}
+
+
+def _build(cls, data: Dict[str, Any]):
+    kwargs = {}
+    for k, v in data.items():
+        sk = _snake(k)
+        if _known_field(cls, sk):
+            kwargs[sk] = v
+    return cls(**kwargs)

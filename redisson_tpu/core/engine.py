@@ -31,16 +31,37 @@ from redisson_tpu.utils import hashing as H
 
 class Engine:
     def __init__(self, config=None):
+        from redisson_tpu.config import Config
         from redisson_tpu.core.pubsub import PubSubHub
 
-        self.config = config
+        self.config = config if config is not None else Config()
         self.store = DeviceStore()
         self.pubsub = PubSubHub()
         self.default_codec: Codec = DEFAULT_CODEC
         self._record_locks: dict[str, threading.RLock] = {}
         self._locks_guard = threading.Lock()
         self._wait_entries: dict[str, "object"] = {}
+        self._holder_override = threading.local()
         self._closed = False
+
+    @contextmanager
+    def impersonate(self, holder_id: Optional[str]):
+        """Execute with an explicit synchronizer-holder identity — the server
+        runs remote calls under the CLIENT's uuid:threadId (the reference's
+        LockName travels from client to Lua the same way,
+        RedissonBaseLock.getLockName)."""
+        if holder_id is None:
+            yield
+            return
+        prev = getattr(self._holder_override, "value", None)
+        self._holder_override.value = holder_id
+        try:
+            yield
+        finally:
+            self._holder_override.value = prev
+
+    def holder_override(self) -> Optional[str]:
+        return getattr(self._holder_override, "value", None)
 
     def wait_entry(self, key: str):
         """Shared per-key wait latch (the RedissonLockEntry registry of

@@ -1,0 +1,7 @@
+"""redisson_tpu.net — wire protocol + client connection stack (L4').
+
+RESP framing (native C++ tokenizer + Python fallback), sync/async clients
+with per-connection in-flight FIFOs, pools, keepalive, reconnect watchdog,
+and failure detectors — the roles of the reference's `client/` and
+`connection/` packages (SURVEY.md §2.1-2.2).
+"""

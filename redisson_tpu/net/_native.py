@@ -1,0 +1,88 @@
+"""ctypes loader for the native runtime library (native/resp.cpp).
+
+Builds `native/build/librtpu.so` on first use with g++ (the image has no
+pybind11; the C ABI + ctypes is the binding layer — see repo guidelines).
+Every entry point degrades to pure Python if the toolchain or library is
+unavailable, so the framework never hard-requires the native path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "librtpu.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+class RtpuToken(ctypes.Structure):
+    _fields_ = [
+        ("type", ctypes.c_int32),
+        ("flags", ctypes.c_int32),
+        ("val", ctypes.c_int64),
+        ("off", ctypes.c_uint64),
+    ]
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "resp.cpp")
+    if not os.path.exists(src):
+        return False
+    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", _SO_PATH, src],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, or None if unavailable (pure-Python fallback)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("RTPU_NO_NATIVE"):
+            return None
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.rtpu_resp_scan.restype = ctypes.c_int64
+        lib.rtpu_resp_scan.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(RtpuToken),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rtpu_crc16.restype = ctypes.c_uint16
+        lib.rtpu_crc16.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rtpu_calc_slots.restype = None
+        lib.rtpu_calc_slots.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint16),
+        ]
+        _lib = lib
+        return _lib

@@ -1,0 +1,421 @@
+"""Sync client connection stack: Connection, pools, keepalive, watchdog.
+
+Parity targets (SURVEY.md §2.1-2.2):
+  * `Connection` — RedisConnection.java: framed send, reply matching.
+    Sync request/response over one socket; replies arrive in send order
+    (CommandsQueue FIFO discipline holds because the server executes one
+    connection's commands in order).
+  * `PubSubConnection` — RedisPubSubConnection.java: dedicated connection
+    with a background reader routing push frames to listeners.
+  * `ConnectionPool` — connection/pool/ConnectionPool.java:47-120: bounded
+    acquire with warm minimum-idle.
+  * `NodeClient` — RedisClient.java + ConnectionWatchdog.java:58-175 +
+    PingConnectionHandler.java:60-104: execute() with retry/backoff
+    reconnect, periodic ping, failure-detector feed.
+
+Addresses are "tpu://host:port" (RedisURI analog).
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from redisson_tpu.net import resp
+from redisson_tpu.net.detectors import FailedNodeDetector
+from redisson_tpu.net.resp import Push, RespError
+
+
+def parse_address(addr: str) -> Tuple[str, int]:
+    """tpu://host:port (also accepts redis:// and bare host:port)."""
+    for prefix in ("tpu://", "redis://", "rediss://"):
+        if addr.startswith(prefix):
+            addr = addr[len(prefix) :]
+            break
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class ConnectionError_(ConnectionError):
+    pass
+
+
+class CommandTimeoutError(TimeoutError):
+    """Response didn't arrive within `timeout` (RedisResponseTimeoutException
+    analog — message mirrors the reference's tuning advice style,
+    command/RedisExecutor.java:214-248)."""
+
+
+class Connection:
+    """One plain socket connection; NOT thread-safe (callers own exclusion,
+    normally via ConnectionPool)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        connect_timeout: float = 10.0,
+        timeout: float = 3.0,
+        password: Optional[str] = None,
+        client_name: Optional[str] = None,
+    ):
+        self.host, self.port = host, port
+        self.timeout = timeout
+        self._parser = resp.RespParser()
+        self._pending: List[Any] = []  # decoded push frames awaiting delivery
+        self.push_handler: Optional[Callable[[Push], None]] = None
+        self._sock = socket.create_connection((host, port), timeout=connect_timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(timeout)
+        self.closed = False
+        # handshake (BaseConnectionHandler.java:59-122): AUTH, SETNAME, PING
+        if password is not None:
+            self._check(self.execute("AUTH", password))
+        if client_name:
+            self.execute("CLIENT", "SETNAME", client_name)
+
+    @staticmethod
+    def _check(reply):
+        if isinstance(reply, RespError):
+            raise reply
+        return reply
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def send(self, *args) -> None:
+        try:
+            self._sock.sendall(resp.encode_command(*args))
+        except (OSError, ValueError) as e:
+            self.close()
+            raise ConnectionError_(f"send to {self.host}:{self.port} failed: {e}") from e
+
+    def read_reply(self, timeout: Optional[float] = None) -> Any:
+        """Next non-push reply; push frames route to push_handler."""
+        deadline = time.monotonic() + (timeout if timeout is not None else self.timeout)
+        while True:
+            while self._pending:
+                value = self._pending.pop(0)
+                if isinstance(value, Push) and self.push_handler is not None:
+                    self.push_handler(value)
+                    continue
+                return value
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise CommandTimeoutError(
+                    f"no response from {self.host}:{self.port} within "
+                    f"{timeout if timeout is not None else self.timeout}s; "
+                    "consider increasing 'timeout' or checking server load"
+                )
+            self._sock.settimeout(remaining)
+            try:
+                data = self._sock.recv(1 << 16)
+            except socket.timeout:
+                raise CommandTimeoutError(
+                    f"no response from {self.host}:{self.port} within budget"
+                ) from None
+            except OSError as e:
+                self.close()
+                raise ConnectionError_(f"read from {self.host}:{self.port} failed: {e}") from e
+            if not data:
+                self.close()
+                raise ConnectionError_(f"connection to {self.host}:{self.port} closed by peer")
+            self._pending.extend(self._parser.feed(data))
+
+    def execute(self, *args, timeout: Optional[float] = None) -> Any:
+        self.send(*args)
+        return self.read_reply(timeout)
+
+    def execute_many(self, commands: List[Tuple], timeout: Optional[float] = None) -> List[Any]:
+        """Pipelined send: all frames in one write, replies read in order
+        (the CommandBatchEncoder one-flush discipline)."""
+        if not commands:
+            return []
+        payload = b"".join(resp.encode_command(*c) for c in commands)
+        try:
+            self._sock.sendall(payload)
+        except OSError as e:
+            self.close()
+            raise ConnectionError_(f"send to {self.host}:{self.port} failed: {e}") from e
+        return [self.read_reply(timeout) for _ in commands]
+
+
+class PubSubConnection:
+    """Dedicated subscription connection with a reader thread
+    (RedisPubSubConnection.java + CommandPubSubDecoder routing)."""
+
+    def __init__(self, host: str, port: int, password: Optional[str] = None):
+        self._conn = Connection(host, port, password=password)
+        self._listeners: Dict[str, List[Callable[[str, bytes], None]]] = {}
+        self._plisteners: Dict[str, List[Callable[[str, str, bytes], None]]] = {}
+        self._lock = threading.RLock()
+        self._conn.push_handler = self._on_push
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._reader, daemon=True, name="rtpu-pubsub")
+        self._thread.start()
+
+    def subscribe(self, channel: str, listener: Callable[[str, bytes], None]) -> None:
+        with self._lock:
+            fresh = channel not in self._listeners
+            self._listeners.setdefault(channel, []).append(listener)
+            if fresh:
+                self._conn.send("SUBSCRIBE", channel)
+
+    def psubscribe(self, pattern: str, listener: Callable[[str, str, bytes], None]) -> None:
+        with self._lock:
+            fresh = pattern not in self._plisteners
+            self._plisteners.setdefault(pattern, []).append(listener)
+            if fresh:
+                self._conn.send("PSUBSCRIBE", pattern)
+
+    def unsubscribe(self, channel: str) -> None:
+        with self._lock:
+            if self._listeners.pop(channel, None) is not None:
+                self._conn.send("UNSUBSCRIBE", channel)
+
+    def resubscribe_on(self, conn: Connection) -> None:
+        """Re-attach all subscriptions on a fresh connection (the watchdog's
+        pubsub re-attach, ConnectionWatchdog.java:85-175)."""
+        with self._lock:
+            old, self._conn = self._conn, conn
+            old.close()
+            conn.push_handler = self._on_push
+            for channel in self._listeners:
+                conn.send("SUBSCRIBE", channel)
+            for pattern in self._plisteners:
+                conn.send("PSUBSCRIBE", pattern)
+
+    def channels(self) -> List[str]:
+        with self._lock:
+            return list(self._listeners)
+
+    def _on_push(self, push: Push) -> None:
+        kind = bytes(push[0])
+        if kind == b"message":
+            channel = push[1].decode()
+            with self._lock:
+                listeners = list(self._listeners.get(channel, ()))
+            for fn in listeners:
+                fn(channel, push[2])
+        elif kind == b"pmessage":
+            pattern, channel = push[1].decode(), push[2].decode()
+            with self._lock:
+                listeners = list(self._plisteners.get(pattern, ()))
+            for fn in listeners:
+                fn(pattern, channel, push[3])
+
+    def _reader(self) -> None:
+        while not self._stop.is_set() and not self._conn.closed:
+            try:
+                value = self._conn.read_reply(timeout=0.25)
+                # subscribe/unsubscribe confirmations arrive here; ignore
+                _ = value
+            except CommandTimeoutError:
+                continue
+            except (ConnectionError, OSError):
+                return  # watchdog (NodeClient) owns reconnect
+
+    def close(self) -> None:
+        self._stop.set()
+        self._conn.close()
+        self._thread.join(timeout=2)
+
+
+class ConnectionPool:
+    """Bounded blocking pool with min-idle warmup
+    (connection/pool/ConnectionPool.java:47-120 — AsyncSemaphore acquire)."""
+
+    def __init__(self, factory: Callable[[], Connection], size: int = 8, min_idle: int = 1):
+        self._factory = factory
+        self._size = size
+        self._sem = threading.Semaphore(size)
+        self._idle: List[Connection] = []
+        self._lock = threading.Lock()
+        for _ in range(min(min_idle, size)):
+            self._idle.append(factory())
+
+    def acquire(self, timeout: float = 10.0) -> Connection:
+        if not self._sem.acquire(timeout=timeout):
+            raise CommandTimeoutError(
+                f"connection pool exhausted ({self._size} busy); increase "
+                "'connection_pool_size' or reduce concurrency"
+            )
+        with self._lock:
+            while self._idle:
+                conn = self._idle.pop()
+                if not conn.closed:
+                    return conn
+        try:
+            return self._factory()
+        except Exception:
+            self._sem.release()
+            raise
+
+    def release(self, conn: Connection) -> None:
+        with self._lock:
+            if not conn.closed:
+                self._idle.append(conn)
+        self._sem.release()
+
+    def discard(self, conn: Connection) -> None:
+        conn.close()
+        self._sem.release()
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._idle:
+                c.close()
+            self._idle.clear()
+
+    def idle_count(self) -> int:
+        with self._lock:
+            return len(self._idle)
+
+
+class NodeClient:
+    """Client to ONE server node: pooled commands, retry w/ reconnect
+    backoff, ping keepalive, failure-detector feed.
+
+    execute() is the RedisExecutor retry state machine
+    (command/RedisExecutor.java:113-205): up to `retry_attempts` attempts,
+    `retry_interval` apart, transparent across reconnects.
+    """
+
+    def __init__(
+        self,
+        address: str,
+        password: Optional[str] = None,
+        client_name: Optional[str] = None,
+        pool_size: int = 8,
+        min_idle: int = 1,
+        timeout: float = 3.0,
+        connect_timeout: float = 10.0,
+        retry_attempts: int = 3,
+        retry_interval: float = 1.5,
+        ping_interval: float = 30.0,
+        detector: Optional[FailedNodeDetector] = None,
+    ):
+        self.address = address
+        self.host, self.port = parse_address(address)
+        self._password = password
+        self._client_name = client_name
+        self.timeout = timeout
+        self._connect_timeout = connect_timeout
+        self.retry_attempts = retry_attempts
+        self.retry_interval = retry_interval
+        self.detector = detector or FailedNodeDetector()
+        self._closed = threading.Event()
+        self.pool = ConnectionPool(self._connect, size=pool_size, min_idle=min_idle)
+        self._pubsub: Optional[PubSubConnection] = None
+        self._pubsub_lock = threading.Lock()
+        self._ping_interval = ping_interval
+        self._ping_thread: Optional[threading.Thread] = None
+        if ping_interval and ping_interval > 0:
+            self._ping_thread = threading.Thread(
+                target=self._ping_loop, daemon=True, name=f"rtpu-ping-{self.port}"
+            )
+            self._ping_thread.start()
+
+    def _connect(self) -> Connection:
+        try:
+            conn = Connection(
+                self.host,
+                self.port,
+                connect_timeout=self._connect_timeout,
+                timeout=self.timeout,
+                password=self._password,
+                client_name=self._client_name,
+            )
+        except OSError as e:
+            self.detector.on_connect_failed()
+            raise ConnectionError_(f"cannot connect to {self.address}: {e}") from e
+        self.detector.on_connect_successful()
+        return conn
+
+    # -- command path --------------------------------------------------------
+
+    def execute(self, *args, timeout: Optional[float] = None) -> Any:
+        return self._with_retry(lambda c: c.execute(*args, timeout=timeout))
+
+    def execute_many(self, commands: List[Tuple], timeout: Optional[float] = None) -> List[Any]:
+        return self._with_retry(lambda c: c.execute_many(commands, timeout=timeout))
+
+    def _with_retry(self, fn: Callable[[Connection], Any]) -> Any:
+        last: Optional[BaseException] = None
+        for attempt in range(self.retry_attempts + 1):
+            if self._closed.is_set():
+                raise ConnectionError_("client is closed")
+            if attempt:
+                # exponential backoff on reconnect attempts
+                # (ConnectionWatchdog.java: timeout = 2 << attempts ms floor)
+                time.sleep(min(self.retry_interval * attempt, 10.0))
+            try:
+                conn = self.pool.acquire(timeout=self._connect_timeout)
+            except (ConnectionError, OSError) as e:
+                last = e
+                continue
+            try:
+                result = fn(conn)
+            except CommandTimeoutError as e:
+                # command was WRITTEN; retrying could double-apply it.  The
+                # reference stops retrying once the write completed
+                # (RedisExecutor response-timeout path) — same rule here.
+                self.detector.on_command_timeout()
+                self.pool.discard(conn)
+                raise
+            except (ConnectionError, OSError) as e:
+                self.detector.on_command_failed(e)
+                self.pool.discard(conn)
+                last = e
+                continue
+            self.pool.release(conn)
+            if isinstance(result, RespError):
+                self.detector.on_command_failed(result)
+                raise result
+            self.detector.on_command_successful()
+            return result
+        assert last is not None
+        raise last
+
+    # -- pubsub --------------------------------------------------------------
+
+    def pubsub(self) -> PubSubConnection:
+        with self._pubsub_lock:
+            if self._pubsub is None or self._pubsub._conn.closed:
+                fresh = PubSubConnection(self.host, self.port, password=self._password)
+                if self._pubsub is not None:
+                    # carry listeners over (watchdog pubsub re-attach)
+                    fresh._listeners = self._pubsub._listeners
+                    fresh._plisteners = self._pubsub._plisteners
+                    for channel in fresh._listeners:
+                        fresh._conn.send("SUBSCRIBE", channel)
+                    for pattern in fresh._plisteners:
+                        fresh._conn.send("PSUBSCRIBE", pattern)
+                self._pubsub = fresh
+            return self._pubsub
+
+    # -- keepalive -----------------------------------------------------------
+
+    def _ping_loop(self) -> None:
+        while not self._closed.wait(self._ping_interval):
+            try:
+                reply = self.execute("PING", timeout=self.timeout)
+                if reply in (b"PONG", "PONG"):
+                    self.detector.on_ping_successful()
+                else:  # pragma: no cover — unexpected reply
+                    self.detector.on_ping_failed()
+            except Exception:  # noqa: BLE001
+                self.detector.on_ping_failed()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._pubsub is not None:
+            self._pubsub.close()
+        self.pool.close()

@@ -1,0 +1,105 @@
+"""Failure-detector SPI: pluggable node-health judgment.
+
+Parity target: ``client/FailedNodeDetector.java`` (SPI) and its three
+implementations (SURVEY.md §2.1): FailedConnectionDetector (N connection
+failures inside a sliding window), FailedCommandsDetector (N command errors
+in window), FailedCommandsTimeoutDetector (N command timeouts in window).
+The client feeds events; topology management polls `is_node_failed()` to
+freeze/failover a node.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque
+
+
+class FailedNodeDetector:
+    """SPI: override the on_* hooks you care about."""
+
+    def on_connect_failed(self) -> None: ...
+    def on_connect_successful(self) -> None: ...
+    def on_command_failed(self, error: BaseException) -> None: ...
+    def on_command_successful(self) -> None: ...
+    def on_command_timeout(self) -> None: ...
+    def on_ping_failed(self) -> None: ...
+    def on_ping_successful(self) -> None: ...
+
+    def is_node_failed(self) -> bool:
+        return False
+
+
+class _WindowCounter:
+    def __init__(self, window_s: float):
+        self.window_s = window_s
+        self._events: Deque[float] = deque()
+        self._lock = threading.Lock()
+
+    def record(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._events.append(now)
+            self._trim(now)
+
+    def count(self) -> int:
+        with self._lock:
+            self._trim(time.monotonic())
+            return len(self._events)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def _trim(self, now: float) -> None:
+        while self._events and now - self._events[0] > self.window_s:
+            self._events.popleft()
+
+
+class FailedConnectionDetector(FailedNodeDetector):
+    """Node failed when `threshold` connection attempts failed inside the
+    sliding window (FailedConnectionDetector.java defaults: 3 in 180s)."""
+
+    def __init__(self, threshold: int = 3, window_s: float = 180.0):
+        self.threshold = threshold
+        self._counter = _WindowCounter(window_s)
+
+    def on_connect_failed(self) -> None:
+        self._counter.record()
+
+    def on_connect_successful(self) -> None:
+        self._counter.reset()
+
+    def on_ping_failed(self) -> None:
+        self._counter.record()
+
+    def is_node_failed(self) -> bool:
+        return self._counter.count() >= self.threshold
+
+
+class FailedCommandsDetector(FailedNodeDetector):
+    """Node failed when `threshold` command errors occur inside the window."""
+
+    def __init__(self, threshold: int = 10, window_s: float = 60.0):
+        self.threshold = threshold
+        self._counter = _WindowCounter(window_s)
+
+    def on_command_failed(self, error: BaseException) -> None:
+        self._counter.record()
+
+    def is_node_failed(self) -> bool:
+        return self._counter.count() >= self.threshold
+
+
+class FailedCommandsTimeoutDetector(FailedNodeDetector):
+    """Node failed when `threshold` command timeouts occur inside the window."""
+
+    def __init__(self, threshold: int = 5, window_s: float = 60.0):
+        self.threshold = threshold
+        self._counter = _WindowCounter(window_s)
+
+    def on_command_timeout(self) -> None:
+        self._counter.record()
+
+    def is_node_failed(self) -> bool:
+        return self._counter.count() >= self.threshold

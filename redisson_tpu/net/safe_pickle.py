@@ -1,0 +1,79 @@
+"""Restricted unpickling for wire payloads (OBJCALL args/results).
+
+The reference has the same dual-use surface — its JDK-serialization codecs
+deserialize attacker-controlled bytes — and mitigates with class-filtering
+(`SerializationCodec` supports an allowed-class filter).  Same policy here,
+but as a tight allowlist of *specific globals*: broad module-root allowances
+are gadget mines (e.g. ``numpy.testing._private.utils.runstring`` execs a
+string), so numpy is limited to exactly the reconstruction callables array
+pickles need, builtins to data constructors and exception types, and the
+framework's own package to its wire-visible value classes.  Deployments
+moving custom classes through OBJCALL opt modules in via `allow_module`.
+"""
+from __future__ import annotations
+
+import builtins
+import io
+import pickle
+
+# pure-data stdlib modules where every global is a value constructor
+_ALLOWED_DATA_ROOTS = {"datetime", "decimal", "fractions", "uuid"}
+
+# user-extensible trust (allow_module) — empty by default
+_TRUSTED_ROOTS: set = set()
+
+_ALLOWED_GLOBALS = {
+    # numpy array/scalar reconstruction (numpy 1.x and 2.x module paths)
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("_codecs", "encode"),
+    # container constructors
+    ("collections", "OrderedDict"),
+    ("collections", "defaultdict"),
+    ("collections", "deque"),
+    ("collections", "Counter"),
+    # framework wire-visible classes
+    ("redisson_tpu.net.resp", "RespError"),
+    ("redisson_tpu.net.resp", "Push"),
+}
+
+_ALLOWED_BUILTINS = {
+    "set", "frozenset", "complex", "bytearray", "range", "slice", "dict",
+    "list", "tuple", "bytes", "str", "int", "float", "bool", "object",
+}
+
+
+def allow_module(root: str) -> None:
+    """Trust every global under `root` (e.g. the package holding your value
+    classes).  Explicit opt-in — trusting a module trusts its callables."""
+    _TRUSTED_ROOTS.add(root.split(".", 1)[0])
+
+
+def _is_builtin_exception(name: str) -> bool:
+    obj = getattr(builtins, name, None)
+    return isinstance(obj, type) and issubclass(obj, BaseException)
+
+
+class RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        root = module.split(".", 1)[0]
+        if (module, name) in _ALLOWED_GLOBALS:
+            return super().find_class(module, name)
+        if module == "builtins" and (name in _ALLOWED_BUILTINS or _is_builtin_exception(name)):
+            return super().find_class(module, name)
+        if root in _ALLOWED_DATA_ROOTS or root in _TRUSTED_ROOTS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"global '{module}.{name}' is forbidden in wire payloads; "
+            "register the module with redisson_tpu.net.safe_pickle.allow_module"
+        )
+
+
+def safe_loads(data: bytes):
+    return RestrictedUnpickler(io.BytesIO(data)).load()

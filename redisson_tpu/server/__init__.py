@@ -1,0 +1,6 @@
+"""redisson_tpu.server — the RESP-speaking sidecar fronting the Engine (L4').
+
+`TpuServer` is the asyncio server; `ServerThread` embeds one in-process for
+hermetic tests (the Testcontainers/RedisRunner role, SURVEY.md §4).
+"""
+from redisson_tpu.server.server import ServerThread, TpuServer  # noqa: F401

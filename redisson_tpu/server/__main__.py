@@ -1,0 +1,3 @@
+from redisson_tpu.server.server import main
+
+main()

@@ -1,0 +1,685 @@
+"""Server command registry: RESP command name -> handler over the Engine.
+
+Parity target: ``client/protocol/RedisCommands.java`` (the ~447-command
+registry) reimagined server-side: instead of 447 micro-commands, the wire
+surface is (a) a compact set of compatible commands for keyspace admin,
+strings, bits, sketches and pubsub, with **batched multi-key forms as the
+primary citizens** (BF.MADD/BF.MEXISTS carry whole key batches — the RBatch
+flush arrives as ONE command, one fused kernel dispatch), and (b) a generic
+`OBJCALL` escape hatch that invokes any client-object method server-side
+(pickled args), giving the full L5' object surface remote parity the way the
+reference ships task classBody bytes (executor/TasksRunnerService.java).
+
+Handlers run on the server's worker pool; per-connection order is preserved
+by the connection loop (CommandsQueue FIFO discipline).
+"""
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from redisson_tpu.net.resp import Push, RespError
+from redisson_tpu.version import __version__ as VERSION
+
+
+class CommandContext:
+    """Per-connection state (db selection, auth, subscriptions)."""
+
+    def __init__(self, server):
+        self.server = server
+        self.authenticated = server.password is None
+        self.name: Optional[str] = None
+        self.subscriptions: Dict[str, int] = {}
+        self.psubscriptions: Dict[str, int] = {}
+        self.push: Optional[Callable[[Any], None]] = None  # wired by the server
+
+    def subscription_count(self) -> int:
+        return len(self.subscriptions) + len(self.psubscriptions)
+
+
+class Registry:
+    def __init__(self):
+        self._handlers: Dict[bytes, Callable] = {}
+
+    def register(self, name: str):
+        def deco(fn):
+            self._handlers[name.upper().encode()] = fn
+            return fn
+
+        return deco
+
+    def dispatch(self, server, ctx: CommandContext, args: List[bytes]):
+        if not args:
+            raise RespError("ERR empty command")
+        cmd = bytes(args[0]).upper()
+        handler = self._handlers.get(cmd)
+        if handler is None:
+            raise RespError(f"ERR unknown command '{cmd.decode()}'")
+        if not ctx.authenticated and cmd not in (b"AUTH", b"HELLO", b"QUIT", b"PING"):
+            raise RespError("NOAUTH Authentication required.")
+        return handler(server, ctx, args[1:])
+
+
+REGISTRY = Registry()
+register = REGISTRY.register
+
+
+def _s(b: bytes) -> str:
+    return b.decode() if isinstance(b, (bytes, bytearray)) else str(b)
+
+
+def _int(b) -> int:
+    try:
+        return int(b)
+    except (TypeError, ValueError):
+        raise RespError("ERR value is not an integer or out of range")
+
+
+# -- connection handshake (BaseConnectionHandler.java:59-122 parity) ---------
+
+@register("PING")
+def cmd_ping(server, ctx, args):
+    if args:
+        return args[0]
+    return "+PONG"
+
+
+@register("ECHO")
+def cmd_echo(server, ctx, args):
+    return args[0]
+
+
+@register("AUTH")
+def cmd_auth(server, ctx, args):
+    password = _s(args[-1])
+    if server.password is None or password == server.password:
+        ctx.authenticated = True
+        return "+OK"
+    raise RespError("WRONGPASS invalid username-password pair")
+
+
+@register("HELLO")
+def cmd_hello(server, ctx, args):
+    # RESP3 negotiation-lite: always answers the map; protocol stays RESP2
+    # framing with push support (our parser handles both)
+    return {
+        b"server": b"redisson-tpu",
+        b"version": VERSION.encode(),
+        b"proto": 2,
+        b"id": server.next_client_id(),
+        b"mode": server.mode.encode(),
+        b"role": b"master",
+    }
+
+
+@register("SELECT")
+def cmd_select(server, ctx, args):
+    _int(args[0])  # single logical db: accept and ignore, like db 0 only
+    return "+OK"
+
+
+@register("CLIENT")
+def cmd_client(server, ctx, args):
+    sub = bytes(args[0]).upper() if args else b""
+    if sub == b"SETNAME":
+        ctx.name = _s(args[1])
+        return "+OK"
+    if sub == b"GETNAME":
+        return ctx.name.encode() if ctx.name else b""
+    if sub == b"ID":
+        return server.next_client_id()
+    return "+OK"
+
+
+@register("QUIT")
+def cmd_quit(server, ctx, args):
+    raise ConnectionResetError("client quit")
+
+
+# -- keyspace admin (RedissonKeys surface) -----------------------------------
+
+@register("KEYS")
+def cmd_keys(server, ctx, args):
+    pattern = _s(args[0]) if args else "*"
+    return [k.encode() for k in server.engine.store.keys(pattern)]
+
+
+@register("DBSIZE")
+def cmd_dbsize(server, ctx, args):
+    return len(server.engine.store)
+
+
+@register("DEL")
+def cmd_del(server, ctx, args):
+    return sum(1 for k in args if server.engine.store.delete(_s(k)))
+
+
+@register("UNLINK")
+def cmd_unlink(server, ctx, args):
+    return cmd_del(server, ctx, args)
+
+
+@register("EXISTS")
+def cmd_exists(server, ctx, args):
+    return sum(1 for k in args if server.engine.store.exists(_s(k)))
+
+
+@register("EXPIRE")
+def cmd_expire(server, ctx, args):
+    ok = server.engine.store.expire(_s(args[0]), time.time() + _int(args[1]))
+    return 1 if ok else 0
+
+
+@register("PEXPIRE")
+def cmd_pexpire(server, ctx, args):
+    ok = server.engine.store.expire(_s(args[0]), time.time() + _int(args[1]) / 1000.0)
+    return 1 if ok else 0
+
+
+@register("PERSIST")
+def cmd_persist(server, ctx, args):
+    ok = server.engine.store.expire(_s(args[0]), None)
+    return 1 if ok else 0
+
+
+@register("TTL")
+def cmd_ttl(server, ctx, args):
+    name = _s(args[0])
+    if not server.engine.store.exists(name):
+        return -2
+    ttl = server.engine.store.ttl(name)
+    return -1 if ttl is None else int(ttl)
+
+
+@register("PTTL")
+def cmd_pttl(server, ctx, args):
+    name = _s(args[0])
+    if not server.engine.store.exists(name):
+        return -2
+    ttl = server.engine.store.ttl(name)
+    return -1 if ttl is None else int(ttl * 1000)
+
+
+@register("RENAME")
+def cmd_rename(server, ctx, args):
+    if not server.engine.store.rename(_s(args[0]), _s(args[1])):
+        raise RespError("ERR no such key")
+    return "+OK"
+
+
+@register("FLUSHALL")
+def cmd_flushall(server, ctx, args):
+    server.engine.store.flushall()
+    return "+OK"
+
+
+@register("TYPE")
+def cmd_type(server, ctx, args):
+    rec = server.engine.store.get(_s(args[0]))
+    return ("+" + (rec.kind if rec else "none"))
+
+
+# -- strings / buckets --------------------------------------------------------
+
+def _bucket(server, name: str):
+    from redisson_tpu.client.objects.bucket import Bucket
+    from redisson_tpu.client.codec import BytesCodec
+
+    return Bucket(server.engine, name, BytesCodec())
+
+
+@register("GET")
+def cmd_get(server, ctx, args):
+    return _bucket(server, _s(args[0])).get()
+
+
+@register("SET")
+def cmd_set(server, ctx, args):
+    name = _s(args[0])
+    value = bytes(args[1])
+    px: Optional[float] = None
+    nx = xx = False
+    i = 2
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"PX":
+            px = _int(args[i + 1]) / 1000.0
+            i += 2
+        elif opt == b"EX":
+            px = float(_int(args[i + 1]))
+            i += 2
+        elif opt == b"NX":
+            nx = True
+            i += 1
+        elif opt == b"XX":
+            xx = True
+            i += 1
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    b = _bucket(server, name)
+    if nx:
+        if not b.try_set(value, ttl=px):
+            return None
+    elif xx:
+        with server.engine.locked(name):
+            if not b.set_if_exists(value):
+                return None
+            if px is not None:
+                server.engine.store.expire(name, time.time() + px)
+    else:
+        b.set(value, ttl=px)
+    return "+OK"
+
+
+@register("INCR")
+def cmd_incr(server, ctx, args):
+    from redisson_tpu.client.objects.bucket import AtomicLong
+
+    return AtomicLong(server.engine, _s(args[0])).increment_and_get()
+
+
+@register("INCRBY")
+def cmd_incrby(server, ctx, args):
+    from redisson_tpu.client.objects.bucket import AtomicLong
+
+    return AtomicLong(server.engine, _s(args[0])).add_and_get(_int(args[1]))
+
+
+@register("DECR")
+def cmd_decr(server, ctx, args):
+    from redisson_tpu.client.objects.bucket import AtomicLong
+
+    return AtomicLong(server.engine, _s(args[0])).decrement_and_get()
+
+
+# -- bits (RBitSet surface; batched forms are primary) ------------------------
+
+def _bitset(server, name: str):
+    from redisson_tpu.client.objects.bitset import BitSet
+
+    return BitSet(server.engine, name)
+
+
+@register("SETBIT")
+def cmd_setbit(server, ctx, args):
+    old = _bitset(server, _s(args[0])).set(_int(args[1]), bool(_int(args[2])))
+    return 1 if old else 0
+
+
+@register("GETBIT")
+def cmd_getbit(server, ctx, args):
+    return 1 if _bitset(server, _s(args[0])).get(_int(args[1])) else 0
+
+
+@register("BITCOUNT")
+def cmd_bitcount(server, ctx, args):
+    return _bitset(server, _s(args[0])).cardinality()
+
+
+@register("BITOP")
+def cmd_bitop(server, ctx, args):
+    op = bytes(args[0]).upper()
+    dest = _s(args[1])
+    srcs = [_s(a) for a in args[2:]]
+    bs = _bitset(server, dest)
+    if op == b"AND":
+        bs.and_(*srcs)
+    elif op == b"OR":
+        bs.or_(*srcs)
+    elif op == b"XOR":
+        bs.xor(*srcs)
+    elif op == b"NOT":
+        bs.from_byte_array(_bitset(server, srcs[0]).to_byte_array())
+        bs.not_()
+    else:
+        raise RespError("ERR syntax error")
+    n = bs.length()
+    return n // 8 + (1 if n % 8 else 0)
+
+
+# batched forms: SETBITS name idx... / GETBITS name idx... (one kernel each)
+@register("SETBITS")
+def cmd_setbits(server, ctx, args):
+    import numpy as np
+
+    idx = np.asarray([_int(a) for a in args[1:]], np.int64)
+    old = _bitset(server, _s(args[0])).set_each(idx, True)
+    return [int(v) for v in old]
+
+
+@register("GETBITS")
+def cmd_getbits(server, ctx, args):
+    import numpy as np
+
+    idx = np.asarray([_int(a) for a in args[1:]], np.int64)
+    got = _bitset(server, _s(args[0])).get_each(idx)
+    return [int(v) for v in got]
+
+
+# -- bloom filter (RedisBloom-compatible verbs + batch-first forms) ----------
+
+def _bloom(server, name: str):
+    from redisson_tpu.client.objects.bloom import BloomFilter
+
+    return BloomFilter(server.engine, name)
+
+
+@register("BF.RESERVE")
+def cmd_bf_reserve(server, ctx, args):
+    bf = _bloom(server, _s(args[0]))
+    error_rate = float(args[1])
+    capacity = _int(args[2])
+    if not bf.try_init(capacity, error_rate):
+        raise RespError("ERR item exists")  # RedisBloom wording
+    return "+OK"
+
+
+@register("BF.ADD")
+def cmd_bf_add(server, ctx, args):
+    bf = _bloom(server, _s(args[0]))
+    return 1 if bf.add(bytes(args[1])) else 0
+
+
+@register("BF.MADD")
+def cmd_bf_madd(server, ctx, args):
+    bf = _bloom(server, _s(args[0]))
+    newly = bf.add_each([bytes(a) for a in args[1:]])
+    return [int(v) for v in newly]
+
+
+@register("BF.EXISTS")
+def cmd_bf_exists(server, ctx, args):
+    bf = _bloom(server, _s(args[0]))
+    return 1 if bf.contains(bytes(args[1])) else 0
+
+
+@register("BF.MEXISTS")
+def cmd_bf_mexists(server, ctx, args):
+    bf = _bloom(server, _s(args[0]))
+    found = bf.contains_each([bytes(a) for a in args[1:]])
+    return [int(v) for v in found]
+
+
+@register("BF.INFO")
+def cmd_bf_info(server, ctx, args):
+    bf = _bloom(server, _s(args[0]))
+    rec = server.engine.store.get(bf.name)
+    if rec is None:
+        raise RespError("ERR not found")
+    return [
+        b"Capacity", rec.meta.get("expected_insertions", 0),
+        b"Size", rec.meta["m"],
+        b"Number of hashes", rec.meta["k"],
+        b"Number of items inserted", bf.count(),
+    ]
+
+
+# Binary batch forms — the remote RBatch hot path (BASELINE north star):
+# one command carries the whole key batch as a little-endian int64 blob, the
+# reply is a 0/1 byte per key.  This is the wire shape of "one fused kernel
+# dispatch per flush".
+
+@register("BF.MADD64")
+def cmd_bf_madd64(server, ctx, args):
+    import numpy as np
+
+    keys = np.frombuffer(bytes(args[1]), dtype="<i8")
+    newly = _bloom(server, _s(args[0])).add_each(keys)
+    return np.asarray(newly, np.uint8).tobytes()
+
+
+@register("BF.MEXISTS64")
+def cmd_bf_mexists64(server, ctx, args):
+    import numpy as np
+
+    keys = np.frombuffer(bytes(args[1]), dtype="<i8")
+    found = _bloom(server, _s(args[0])).contains_each(keys)
+    return np.asarray(found, np.uint8).tobytes()
+
+
+@register("BFA.RESERVE")
+def cmd_bfa_reserve(server, ctx, args):
+    from redisson_tpu.client.objects.bloom_array import BloomFilterArray
+
+    arr = BloomFilterArray(server.engine, _s(args[0]))
+    arr.try_init(_int(args[1]), _int(args[2]), float(args[3]))
+    return "+OK"
+
+
+@register("BFA.MADD64")
+def cmd_bfa_madd64(server, ctx, args):
+    import numpy as np
+    from redisson_tpu.client.objects.bloom_array import BloomFilterArray
+
+    arr = BloomFilterArray(server.engine, _s(args[0]))
+    tenants = np.frombuffer(bytes(args[1]), dtype="<i4")
+    keys = np.frombuffer(bytes(args[2]), dtype="<i8")
+    newly = arr.add_each(tenants, keys)
+    return np.asarray(newly, np.uint8).tobytes()
+
+
+@register("BFA.MEXISTS64")
+def cmd_bfa_mexists64(server, ctx, args):
+    import numpy as np
+    from redisson_tpu.client.objects.bloom_array import BloomFilterArray
+
+    arr = BloomFilterArray(server.engine, _s(args[0]))
+    tenants = np.frombuffer(bytes(args[1]), dtype="<i4")
+    keys = np.frombuffer(bytes(args[2]), dtype="<i8")
+    found = arr.contains(tenants, keys)
+    return np.asarray(found, np.uint8).tobytes()
+
+
+@register("PFADD64")
+def cmd_pfadd64(server, ctx, args):
+    import numpy as np
+
+    keys = np.frombuffer(bytes(args[1]), dtype="<i8")
+    return 1 if _hll(server, _s(args[0])).add_all(keys) else 0
+
+
+# -- hyperloglog (PFADD/PFCOUNT/PFMERGE parity, RedissonHyperLogLog.java) ----
+
+def _hll(server, name: str):
+    from redisson_tpu.client.objects.hyperloglog import HyperLogLog
+    from redisson_tpu.client.codec import BytesCodec
+
+    return HyperLogLog(server.engine, name, BytesCodec())
+
+
+@register("PFADD")
+def cmd_pfadd(server, ctx, args):
+    name = _s(args[0])
+    h = _hll(server, name)
+    if len(args) == 1:
+        # Redis contract: 1 only if the key was created by this call
+        with server.engine.locked(name):
+            created = not server.engine.store.exists(name)
+            h.create_if_absent()
+        return 1 if created else 0
+    return 1 if h.add_all([bytes(a) for a in args[1:]]) else 0
+
+
+@register("PFCOUNT")
+def cmd_pfcount(server, ctx, args):
+    names = [_s(a) for a in args]
+    if len(names) == 1:
+        return int(_hll(server, names[0]).count())
+    return int(_hll(server, names[0]).count_with(*names[1:]))
+
+
+@register("PFMERGE")
+def cmd_pfmerge(server, ctx, args):
+    dest = _hll(server, _s(args[0]))
+    dest.merge_with(*[_s(a) for a in args[1:]])
+    return "+OK"
+
+
+# -- pubsub ------------------------------------------------------------------
+
+@register("SUBSCRIBE")
+def cmd_subscribe(server, ctx, args):
+    out = []
+    for ch_raw in args:
+        ch = _s(ch_raw)
+        if ch not in ctx.subscriptions:
+            push = ctx.push
+
+            def listener(channel, msg, _push=push):
+                _push(Push([b"message", channel.encode(), msg if isinstance(msg, bytes) else pickle.dumps(msg)]))
+
+            ctx.subscriptions[ch] = server.engine.pubsub.subscribe(ch, listener)
+        out.append(Push([b"subscribe", ch_raw, ctx.subscription_count()]))
+    return out
+
+
+@register("UNSUBSCRIBE")
+def cmd_unsubscribe(server, ctx, args):
+    chans = [_s(a) for a in args] or list(ctx.subscriptions)
+    out = []
+    for ch in chans:
+        lid = ctx.subscriptions.pop(ch, None)
+        if lid is not None:
+            server.engine.pubsub.unsubscribe(ch, lid)
+        out.append(Push([b"unsubscribe", ch.encode(), ctx.subscription_count()]))
+    return out
+
+
+@register("PSUBSCRIBE")
+def cmd_psubscribe(server, ctx, args):
+    out = []
+    for pat_raw in args:
+        pat = _s(pat_raw)
+        if pat not in ctx.psubscriptions:
+            push = ctx.push
+
+            def listener(channel, msg, _push=push, _pat=pat):
+                _push(Push([
+                    b"pmessage", _pat.encode(), channel.encode(),
+                    msg if isinstance(msg, bytes) else pickle.dumps(msg),
+                ]))
+
+            ctx.psubscriptions[pat] = server.engine.pubsub.psubscribe(pat, listener)
+        out.append(Push([b"psubscribe", pat_raw, ctx.subscription_count()]))
+    return out
+
+
+@register("PUNSUBSCRIBE")
+def cmd_punsubscribe(server, ctx, args):
+    pats = [_s(a) for a in args] or list(ctx.psubscriptions)
+    out = []
+    for pat in pats:
+        lid = ctx.psubscriptions.pop(pat, None)
+        if lid is not None:
+            server.engine.pubsub.punsubscribe(pat, lid)
+        out.append(Push([b"punsubscribe", pat.encode(), ctx.subscription_count()]))
+    return out
+
+
+@register("PUBLISH")
+def cmd_publish(server, ctx, args):
+    return server.engine.pubsub.publish(_s(args[0]), bytes(args[1]))
+
+
+# -- admin / node info (redisnode/* surface) ---------------------------------
+
+@register("TIME")
+def cmd_time(server, ctx, args):
+    t = time.time()
+    return [str(int(t)).encode(), str(int((t % 1) * 1e6)).encode()]
+
+
+@register("INFO")
+def cmd_info(server, ctx, args):
+    return server.info_text().encode()
+
+
+@register("MEMORY")
+def cmd_memory(server, ctx, args):
+    sub = bytes(args[0]).upper() if args else b""
+    if sub == b"USAGE":
+        rec = server.engine.store.get(_s(args[1]))
+        if rec is None:
+            return None
+        total = 0
+        for arr in rec.arrays.values():
+            total += int(getattr(arr, "nbytes", 0) or 0)
+        import sys
+
+        if rec.host is not None:
+            total += sys.getsizeof(rec.host)
+        return total
+    if sub == b"STATS":
+        return [b"keys.count", len(server.engine.store)]
+    return "+OK"
+
+
+@register("CLUSTER")
+def cmd_cluster(server, ctx, args):
+    sub = bytes(args[0]).upper() if args else b""
+    if sub == b"SLOTS":
+        return server.cluster_slots()
+    if sub == b"MYID":
+        return server.node_id.encode()
+    if sub == b"INFO":
+        state = "ok" if server.cluster_view else "ok"
+        return f"cluster_enabled:{1 if server.cluster_view else 0}\r\ncluster_state:{state}\r\n".encode()
+    raise RespError("ERR unknown CLUSTER subcommand")
+
+
+# -- checkpoint (SAVE analog; full impl in core/checkpoint.py) ---------------
+
+@register("SAVE")
+def cmd_save(server, ctx, args):
+    path = _s(args[0]) if args else server.checkpoint_path
+    if path is None:
+        raise RespError("ERR no checkpoint path configured")
+    from redisson_tpu.core import checkpoint
+
+    checkpoint.save(server.engine, path)
+    return "+OK"
+
+
+@register("RESTORESTATE")
+def cmd_restorestate(server, ctx, args):
+    path = _s(args[0]) if args else server.checkpoint_path
+    if path is None:
+        raise RespError("ERR no checkpoint path configured")
+    from redisson_tpu.core import checkpoint
+
+    n = checkpoint.load(server.engine, path)
+    return n
+
+
+# -- generic object invocation (the classBody-shipping analog) ---------------
+
+@register("OBJCALL")
+def cmd_objcall(server, ctx, args):
+    """OBJCALL <factory> <name> <method> <pickled (args, kwargs)> [<caller-id>]
+    -> pickled result.  factory = RedissonTpu getter name ("get_map", ...);
+    caller-id = client uuid:threadId so synchronizer identity survives the
+    wire (RedissonBaseLock.getLockName travels client->Lua the same way)."""
+    factory, name, method = _s(args[0]), _s(args[1]), _s(args[2])
+    if not factory.startswith(("get_", "create_")):
+        raise RespError("ERR bad factory")
+    client = server.local_client()
+    fn = getattr(client, factory, None)
+    if fn is None:
+        raise RespError(f"ERR unknown factory '{factory}'")
+    obj = fn(name) if name else fn()
+    m = getattr(obj, method, None)
+    if m is None or method.startswith("_"):
+        raise RespError(f"ERR unknown method '{method}'")
+    from redisson_tpu.net.safe_pickle import safe_loads
+
+    call_args, call_kwargs = safe_loads(bytes(args[3])) if len(args) > 3 else ((), {})
+    caller = _s(args[4]) if len(args) > 4 else None
+    try:
+        with server.engine.impersonate(caller):
+            result = m(*call_args, **call_kwargs)
+    except RespError:
+        raise
+    except Exception as e:  # noqa: BLE001 — ship the exception to the caller
+        return b"E" + pickle.dumps(e)
+    return b"R" + pickle.dumps(result)

@@ -1,0 +1,321 @@
+"""TpuServer: asyncio RESP server fronting one Engine ("the sidecar").
+
+Role parity: the reference has no server (Redis is the server); the TPU
+build's data plane lives in THIS process next to the accelerator, so the
+server is the piece that takes the Redis role for remote clients while the
+Engine takes the command-execution role (SURVEY.md §7.1 L4').
+
+Connection discipline mirrors the reference's pipeline
+(client/handler/RedisChannelInitializer.java:74-108): framed RESP in, ordered
+execution per connection (the CommandsQueue FIFO guarantee), replies written
+in arrival order, pubsub push frames interleaved from a writer queue.
+Engine calls execute on a bounded thread pool so the event loop never blocks
+on device dispatch.
+"""
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from redisson_tpu.core.engine import Engine
+from redisson_tpu.net import resp
+from redisson_tpu.net.resp import ProtocolError, RespError
+from redisson_tpu.server.registry import REGISTRY, CommandContext
+
+
+class TpuServer:
+    def __init__(
+        self,
+        engine: Optional[Engine] = None,
+        host: str = "127.0.0.1",
+        port: int = 6390,
+        password: Optional[str] = None,
+        checkpoint_path: Optional[str] = None,
+        mode: str = "standalone",
+        workers: int = 4,
+    ):
+        self.engine = engine if engine is not None else Engine()
+        self.host = host
+        self.port = port
+        self.password = password
+        self.checkpoint_path = checkpoint_path
+        self.mode = mode
+        self.node_id = uuid.uuid4().hex
+        self.started_at = time.time()
+        self.stats = {"connections": 0, "commands": 0, "errors": 0}
+        # cluster_view: [(slot_from, slot_to, host, port, node_id)] when this
+        # node is part of a cluster (set by the topology/launcher, L3')
+        self.cluster_view: List[Tuple[int, int, str, int, str]] = []
+        self._client_ids = iter(range(1, 1 << 62))
+        self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="rtpu-srv")
+        # OBJCALL may run arbitrarily-blocking object methods (blocking
+        # queues, latches); isolate them on a wide pool so parked callers
+        # can't starve the data-plane workers (the reference marks such
+        # commands isBlockingCommand and gives them dedicated connections)
+        self._slow_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="rtpu-slow")
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._writers: set = set()
+        self._local_client = None
+
+    # -- registry support ----------------------------------------------------
+
+    def next_client_id(self) -> int:
+        return next(self._client_ids)
+
+    def local_client(self):
+        """Embedded client over this server's engine (OBJCALL target)."""
+        if self._local_client is None:
+            from redisson_tpu.client.redisson import RedissonTpu
+
+            self._local_client = RedissonTpu(self.engine)
+        return self._local_client
+
+    def cluster_slots(self) -> List[Any]:
+        """CLUSTER SLOTS reply shape: [from, to, [host, port, id]]."""
+        if not self.cluster_view:
+            return [[0, 16383, [self.host.encode(), self.port, self.node_id.encode()]]]
+        return [
+            [lo, hi, [h.encode(), p, nid.encode()]]
+            for (lo, hi, h, p, nid) in self.cluster_view
+        ]
+
+    def info_text(self) -> str:
+        up = int(time.time() - self.started_at)
+        return (
+            "# Server\r\n"
+            f"redis_version:7.2.0-rtpu\r\nrun_id:{self.node_id}\r\n"
+            f"tcp_port:{self.port}\r\nuptime_in_seconds:{up}\r\nmode:{self.mode}\r\n"
+            "# Clients\r\n"
+            f"connected_clients:{self.stats['connections']}\r\n"
+            "# Stats\r\n"
+            f"total_commands_processed:{self.stats['commands']}\r\n"
+            f"errors:{self.stats['errors']}\r\n"
+            "# Keyspace\r\n"
+            f"db0:keys={len(self.engine.store)},expires=0\r\n"
+        )
+
+    # -- asyncio plumbing ----------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.stats["connections"] += 1
+        self._writers.add(writer)
+        ctx = CommandContext(self)
+        parser = resp.RespParser()
+        loop = asyncio.get_running_loop()
+        write_q: asyncio.Queue = asyncio.Queue()
+
+        def push(msg) -> None:
+            # pubsub listeners fire on engine threads; hop to the loop
+            loop.call_soon_threadsafe(write_q.put_nowait, resp.encode_reply(msg))
+
+        ctx.push = push
+
+        async def writer_task():
+            while True:
+                data = await write_q.get()
+                if data is None:
+                    break
+                final = False
+                # drain coalesced frames in one syscall
+                while not write_q.empty():
+                    nxt = write_q.get_nowait()
+                    if nxt is None:
+                        final = True
+                        break
+                    data += nxt
+                writer.write(data)
+                try:
+                    await writer.drain()
+                except ConnectionError:
+                    return
+                if final:
+                    return
+
+        wt = asyncio.create_task(writer_task())
+        try:
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    break
+                try:
+                    commands = parser.feed(data)
+                except ProtocolError as e:
+                    write_q.put_nowait(resp.encode_error(f"ERR protocol error: {e}"))
+                    break
+                for cmd in commands:
+                    if not isinstance(cmd, list) or not all(
+                        isinstance(a, (bytes, bytearray)) for a in cmd
+                    ):
+                        write_q.put_nowait(resp.encode_error("ERR bad request frame"))
+                        continue
+                    self.stats["commands"] += 1
+                    pool = (
+                        self._slow_pool
+                        if bytes(cmd[0]).upper() == b"OBJCALL"
+                        else self._pool
+                    )
+                    try:
+                        result = await loop.run_in_executor(
+                            pool, REGISTRY.dispatch, self, ctx, cmd
+                        )
+                    except RespError as e:
+                        self.stats["errors"] += 1
+                        write_q.put_nowait(resp.encode_error(str(e.args[0])))
+                        continue
+                    except ConnectionResetError:
+                        raise
+                    except RuntimeError as e:
+                        if "shutdown" in str(e):  # worker pool stopped: drop conn
+                            raise ConnectionResetError(str(e)) from e
+                        raise
+                    except Exception as e:  # noqa: BLE001 — sandbox handler bugs per-command
+                        self.stats["errors"] += 1
+                        write_q.put_nowait(
+                            resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
+                        )
+                        continue
+                    write_q.put_nowait(_encode_result(result))
+        except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
+            pass
+        finally:
+            for ch, lid in list(ctx.subscriptions.items()):
+                self.engine.pubsub.unsubscribe(ch, lid)
+            for pat, lid in list(ctx.psubscriptions.items()):
+                self.engine.pubsub.punsubscribe(pat, lid)
+            write_q.put_nowait(None)
+            await wt
+            self._writers.discard(writer)
+            self.stats["connections"] -= 1
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def start_async(self):
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port, reuse_address=True
+        )
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def serve_forever(self):
+        await self.start_async()
+        async with self._server:
+            await self._server.serve_forever()
+
+    def stop(self):
+        loop, server = self._loop, self._server
+        if loop is not None and server is not None:
+            def shutdown():
+                server.close()
+                # drop established connections too: clients must see a dead
+                # node, not a half-alive one (failover tests depend on this)
+                for w in list(self._writers):
+                    try:
+                        w.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            loop.call_soon_threadsafe(shutdown)
+        self._pool.shutdown(wait=False)
+        self._slow_pool.shutdown(wait=False)
+
+
+def _encode_result(result) -> bytes:
+    if isinstance(result, str) and result.startswith("+"):
+        return resp.encode_simple(result[1:])
+    if isinstance(result, list) and result and all(isinstance(r, resp.Push) for r in result):
+        # subscribe-style confirmations: stream of push frames
+        return b"".join(resp.encode_reply(r) for r in result)
+    return resp.encode_reply(result)
+
+
+class ServerThread:
+    """In-process server on a daemon thread — the embedded-test harness
+    (RedisRunner analog for hermetic tests, SURVEY.md §4 lesson)."""
+
+    def __init__(self, engine: Optional[Engine] = None, port: int = 0, **kw):
+        self.server = TpuServer(engine=engine, port=port, **kw)
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    def __enter__(self) -> "ServerThread":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def start(self) -> "ServerThread":
+        def run():
+            async def main():
+                await self.server.start_async()
+                self._started.set()
+                async with self.server._server:
+                    try:
+                        await self.server._server.serve_forever()
+                    except asyncio.CancelledError:
+                        pass
+
+            asyncio.run(main())
+
+        self._thread = threading.Thread(target=run, daemon=True, name="rtpu-server")
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("server failed to start")
+        return self
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    @property
+    def address(self) -> str:
+        return f"tpu://{self.server.host}:{self.server.port}"
+
+    def stop(self):
+        self.server.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description="redisson-tpu server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=6390)
+    ap.add_argument("--password", default=None)
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--restore", action="store_true", help="load checkpoint on boot")
+    ap.add_argument("--platform", default=None, help="force jax platform (cpu/tpu)")
+    args = ap.parse_args(argv)
+    if args.platform:
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", args.platform)
+    engine = Engine()
+    srv = TpuServer(
+        engine,
+        host=args.host,
+        port=args.port,
+        password=args.password,
+        checkpoint_path=args.checkpoint,
+    )
+    if args.restore and args.checkpoint:
+        from redisson_tpu.core import checkpoint
+
+        checkpoint.load(engine, args.checkpoint)
+    asyncio.run(srv.serve_forever())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Config system tests (config/Config.java + ConfigSupport analogs)."""
+import os
+
+import pytest
+
+from redisson_tpu.config import Config, SingleServerConfig
+
+
+def test_defaults_match_reference_knobs():
+    cfg = Config()
+    # reference defaults: Config.java:57-99
+    assert cfg.threads == 16
+    assert cfg.lock_watchdog_timeout == 30.0
+    assert cfg.min_cleanup_delay == 5.0
+    assert cfg.max_cleanup_delay == 1800.0
+
+
+def test_use_single_server():
+    cfg = Config()
+    s = cfg.use_single_server()
+    s.address = "tpu://10.0.0.1:7000"
+    assert cfg.single_server_config.address == "tpu://10.0.0.1:7000"
+    assert s.retry_attempts == 3
+    assert s.timeout == 3.0
+
+
+def test_from_yaml_camel_case_and_sections():
+    cfg = Config.from_yaml(
+        """
+threads: 8
+lockWatchdogTimeout: 10.0
+singleServerConfig:
+  address: "tpu://localhost:6390"
+  retryAttempts: 5
+  connectionPoolSize: 4
+mesh:
+  dp: 2
+  platform: cpu
+"""
+    )
+    assert cfg.threads == 8
+    assert cfg.lock_watchdog_timeout == 10.0
+    assert cfg.single_server_config.address == "tpu://localhost:6390"
+    assert cfg.single_server_config.retry_attempts == 5
+    assert cfg.mesh.dp == 2
+    assert cfg.mesh.platform == "cpu"
+
+
+def test_from_json_env_substitution(monkeypatch):
+    monkeypatch.setenv("RTPU_ADDR", "tpu://envhost:7001")
+    cfg = Config.from_json(
+        '{"singleServerConfig": {"address": "${RTPU_ADDR}", '
+        '"clientName": "${RTPU_NAME:fallback}"}}'
+    )
+    assert cfg.single_server_config.address == "tpu://envhost:7001"
+    assert cfg.single_server_config.client_name == "fallback"
+
+
+def test_env_substitution_missing_raises():
+    with pytest.raises(KeyError):
+        Config.from_json('{"singleServerConfig": {"address": "${RTPU_NO_SUCH_VAR}"}}')
+
+
+def test_yaml_round_trip():
+    cfg = Config(threads=4)
+    cfg.use_cluster_servers().node_addresses = ["tpu://a:1", "tpu://b:2"]
+    cfg2 = Config.from_yaml(cfg.to_yaml())
+    assert cfg2.threads == 4
+    assert cfg2.cluster_servers_config.node_addresses == ["tpu://a:1", "tpu://b:2"]
+
+
+def test_engine_gets_default_config():
+    from redisson_tpu.core.engine import Engine
+
+    e = Engine()
+    assert e.config.lock_watchdog_timeout == 30.0
+    e.shutdown()
+
+
+def test_from_file(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("threads: 3\n")
+    cfg = Config.from_yaml(str(p))
+    assert cfg.threads == 3

@@ -1,0 +1,203 @@
+"""RESP framing tests: native tokenizer vs pure-Python fallback parity.
+
+Covers the marker set the reference decoder handles
+(CommandDecoder.java:58-270: `_ , + - : $ = % * > ~ #`), incremental feeds,
+and batched CRC16 slot calc parity with utils/crc16.py.
+"""
+import pytest
+
+from redisson_tpu.net import _native, resp
+from redisson_tpu.net.resp import (
+    Push,
+    RespError,
+    RespParser,
+    calc_slots,
+    encode_command,
+    encode_reply,
+)
+from redisson_tpu.utils.crc16 import calc_slot
+
+HAS_NATIVE = _native.load() is not None
+
+PARSERS = [False] + ([True] if HAS_NATIVE else [])
+
+
+def mk(use_native):
+    return RespParser(use_native=use_native)
+
+
+@pytest.mark.parametrize("native", PARSERS)
+def test_scalars(native):
+    p = mk(native)
+    data = b"+OK\r\n:42\r\n:-7\r\n$5\r\nhello\r\n$-1\r\n$0\r\n\r\n#t\r\n#f\r\n,3.5\r\n,inf\r\n_\r\n"
+    vals = p.feed(data)
+    assert vals == [b"OK", 42, -7, b"hello", None, b"", True, False, 3.5, float("inf"), None]
+    assert p.pending_bytes == 0
+
+
+@pytest.mark.parametrize("native", PARSERS)
+def test_nested_aggregates(native):
+    p = mk(native)
+    data = b"*3\r\n:1\r\n*2\r\n$1\r\na\r\n$1\r\nb\r\n*-1\r\n"
+    (v,) = p.feed(data)
+    assert v == [1, [b"a", b"b"], None]
+
+
+@pytest.mark.parametrize("native", PARSERS)
+def test_resp3_map_set_push(native):
+    p = mk(native)
+    data = b"%2\r\n$1\r\nk\r\n:1\r\n$1\r\nj\r\n:2\r\n~2\r\n:1\r\n:2\r\n>2\r\n$7\r\nmessage\r\n$2\r\nhi\r\n"
+    m, s, push = p.feed(data)
+    assert m == {b"k": 1, b"j": 2}
+    assert s == {1, 2}
+    assert isinstance(push, Push) and push == [b"message", b"hi"]
+
+
+@pytest.mark.parametrize("native", PARSERS)
+def test_error_reply(native):
+    p = mk(native)
+    (e,) = p.feed(b"-ERR unknown command\r\n")
+    assert isinstance(e, RespError)
+    assert e.code == "ERR"
+
+
+@pytest.mark.parametrize("native", PARSERS)
+def test_incremental_byte_by_byte(native):
+    p = mk(native)
+    data = encode_command("SET", "key", "value") + b":1\r\n"
+    got = []
+    for i in range(len(data)):
+        got.extend(p.feed(data[i : i + 1]))
+    assert got == [[b"SET", b"key", b"value"], 1]
+
+
+@pytest.mark.parametrize("native", PARSERS)
+def test_incomplete_bulk_not_consumed(native):
+    p = mk(native)
+    assert p.feed(b"$13\r\nhalf") == []
+    assert p.pending_bytes == len(b"$13\r\nhalf")
+    assert p.feed(b"-and-done\r\n") == [b"half-and-done"]
+
+
+@pytest.mark.parametrize("native", PARSERS)
+def test_malformed_raises(native):
+    p = mk(native)
+    with pytest.raises(resp.ProtocolError):
+        p.feed(b"!bogus\r\n")
+
+
+@pytest.mark.parametrize("native", PARSERS)
+def test_pipeline_many(native):
+    p = mk(native)
+    frame = encode_command("GET", "k")
+    vals = p.feed(frame * 1000)
+    assert len(vals) == 1000
+    assert vals[0] == [b"GET", b"k"]
+
+
+def test_encode_command_types():
+    assert encode_command("SET", b"k", 5) == b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\n5\r\n"
+
+
+def test_encode_reply_round_trip():
+    p = RespParser(use_native=False)
+    vals = [None, True, 7, 2.5, b"raw", "text", [1, [2, b"x"]], {b"a": 1}]
+    data = b"".join(encode_reply(v) for v in vals)
+    out = p.feed(data)
+    assert out[0] is None
+    assert out[1] == 1  # booleans encode as :1 on the RESP2 reply path
+    assert out[2] == 7
+    assert out[3] == 2.5
+    assert out[4] == b"raw"
+    assert out[5] == b"text"
+    assert out[6] == [1, [2, b"x"]]
+    assert out[7] == {b"a": 1}  # dict rides a RESP3 map frame
+
+
+@pytest.mark.skipif(not HAS_NATIVE, reason="native lib unavailable")
+def test_native_crc16_matches_python():
+    keys = [b"foo", b"bar{tag}baz", b"{user1000}.following", b"", b"{}", b"{x}"]
+    assert calc_slots(keys) == [calc_slot(k) for k in keys]
+
+
+@pytest.mark.skipif(not HAS_NATIVE, reason="native lib unavailable")
+def test_native_matches_python_parser_on_stream():
+    import random
+
+    rng = random.Random(0)
+    frames = []
+    for _ in range(200):
+        n = rng.randint(0, 5)
+        frames.append(encode_command(*[bytes([rng.randint(65, 90)]) * rng.randint(0, 20) for _ in range(n + 1)]))
+        frames.append(b":%d\r\n" % rng.randint(-(10**12), 10**12))
+    blob = b"".join(frames)
+    pn, pp = RespParser(True), RespParser(False)
+    # feed in ragged chunks
+    out_n, out_p = [], []
+    i = 0
+    while i < len(blob):
+        j = min(len(blob), i + rng.randint(1, 97))
+        out_n.extend(pn.feed(blob[i:j]))
+        out_p.extend(pp.feed(blob[i:j]))
+        i = j
+    assert out_n == out_p
+
+
+@pytest.mark.parametrize("native", PARSERS)
+def test_giant_aggregate_over_64k_tokens(native):
+    """A single array with >64k elements must not stall the parser
+    (token-buffer growth path in the native scanner)."""
+    p = mk(native)
+    n = 70_000
+    data = b"*%d\r\n" % n + b":1\r\n" * n + b"+OK\r\n"
+    (arr, ok) = p.feed(data)
+    assert len(arr) == n and ok == b"OK"
+    assert p.pending_bytes == 0
+
+
+def test_safe_pickle_blocks_gadgets():
+    import pickle
+
+    from redisson_tpu.net.safe_pickle import safe_loads
+
+    class Evil:
+        def __reduce__(self):
+            import os
+
+            return (os.system, ("true",))
+
+    payload = pickle.dumps(Evil())
+    with pytest.raises(pickle.UnpicklingError):
+        safe_loads(payload)
+    # data payloads still round-trip
+    import numpy as np
+
+    ok = pickle.dumps(((np.arange(3), {"a": 1}), {"k": b"v"}))
+    args, kwargs = safe_loads(ok)
+    assert kwargs == {"k": b"v"} and args[1] == {"a": 1}
+
+
+def test_safe_pickle_blocks_dangerous_builtins():
+    import pickle
+
+    from redisson_tpu.net.safe_pickle import safe_loads
+
+    payload = b"cbuiltins\neval\n."  # GLOBAL builtins.eval
+    with pytest.raises(pickle.UnpicklingError):
+        safe_loads(payload)
+
+
+def test_safe_pickle_blocks_numpy_runstring_gadget():
+    """Module-root allowances are gadget mines: numpy.testing's runstring
+    execs a string.  The allowlist must be per-global, not per-root."""
+    import pickle
+
+    from redisson_tpu.net.safe_pickle import safe_loads
+
+    payload = b"cnumpy.testing._private.utils\nrunstring\n."
+    with pytest.raises(pickle.UnpicklingError):
+        safe_loads(payload)
+    # exceptions (server error shipping) still pass
+    rt = pickle.dumps(ValueError("boom"))
+    e = safe_loads(rt)
+    assert isinstance(e, ValueError)

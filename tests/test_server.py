@@ -1,0 +1,293 @@
+"""L4' protocol/serve layer tests: server commands, remote client, pubsub,
+reconnect watchdog, failure detectors, OBJCALL surface, remote batch.
+
+Parity model (SURVEY.md §4): tests run against a real server over the real
+protocol — here an in-process ServerThread on the hermetic CPU backend.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.core.engine import Engine
+from redisson_tpu.net.client import Connection, ConnectionError_, NodeClient
+from redisson_tpu.net.detectors import (
+    FailedCommandsDetector,
+    FailedConnectionDetector,
+    FailedCommandsTimeoutDetector,
+)
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server import ServerThread
+
+
+@pytest.fixture(scope="module")
+def server():
+    with ServerThread() as st:
+        yield st
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = RemoteRedisson(server.address, ping_interval=0)
+    yield c
+    c.shutdown()
+
+
+def test_ping_hello_info(client):
+    assert client.ping()
+    assert "redis_version:7.2.0-rtpu" in client.info()
+
+
+def test_raw_connection_handshake(server):
+    conn = Connection("127.0.0.1", server.port, client_name="t1")
+    assert conn.execute("PING") == b"PONG"
+    assert conn.execute("CLIENT", "GETNAME") == b"t1"
+    hello = conn.execute("HELLO", "3")
+    assert hello[b"server"] == b"redisson-tpu"
+    conn.close()
+
+
+def test_bucket_set_get_ttl(client):
+    b = client.get_bucket("srv:bucket")
+    b.set({"x": 1})
+    assert b.get() == {"x": 1}
+    b.set("gone", ttl=0.05)
+    time.sleep(0.1)
+    assert b.get() is None
+    assert b.try_set("first")
+    assert not b.try_set("second")
+    assert b.delete()
+
+
+def test_keys_admin(client):
+    client.get_bucket("adm:a").set(1)
+    client.get_bucket("adm:b").set(2)
+    keys = client.get_keys()
+    names = keys.get_keys("adm:*")
+    assert sorted(names) == ["adm:a", "adm:b"]
+    assert keys.delete("adm:a", "adm:b") == 2
+
+
+def test_bloom_remote_hot_path(client):
+    bf = client.get_bloom_filter("srv:bloom")
+    assert bf.try_init(10_000, 0.01)
+    keys = np.arange(500, dtype=np.int64)
+    newly = bf.add_each(keys)
+    assert newly.all()
+    assert bf.contains_each(keys).all()
+    assert not bf.contains_each(np.arange(10_000, 10_100, dtype=np.int64)).any()
+    # object (codec) keys
+    assert bf.add("hello")
+    assert bf.contains("hello")
+    assert not bf.contains("absent-key")
+
+
+def test_bloom_array_remote(client):
+    arr = client.get_bloom_filter_array("srv:bloomarr")
+    assert arr.try_init(16, 1000, 0.01)
+    tenants = np.array([0, 1, 2, 0], np.int32)
+    keys = np.array([10, 10, 10, 11], np.int64)
+    assert arr.add_each(tenants, keys).all()
+    assert arr.contains(tenants, keys).all()
+    assert not arr.contains(np.array([3], np.int32), np.array([10], np.int64)).any()
+
+
+def test_hll_remote(client):
+    h = client.get_hyper_log_log("srv:hll")
+    h.add_all(np.arange(5000, dtype=np.int64))
+    assert abs(h.count() - 5000) / 5000 < 0.05
+    h2 = client.get_hyper_log_log("srv:hll2")
+    h2.add_all(np.arange(2500, 7500, dtype=np.int64))
+    est = h.count_with("srv:hll2")
+    assert abs(est - 7500) / 7500 < 0.05
+    h.merge_with("srv:hll2")
+    assert abs(h.count() - 7500) / 7500 < 0.05
+
+
+def test_bitset_remote(client):
+    bs = client.get_bit_set("srv:bits")
+    assert not bs.set(7)
+    assert bs.get(7)
+    assert bs.set_each(np.array([1, 2, 3]), True).tolist() == [False, False, False]
+    assert bs.cardinality() == 4
+    bs2 = client.get_bit_set("srv:bits2")
+    bs2.set(1)
+    bs.or_("srv:bits2")
+    assert bs.cardinality() == 4  # bit 1 already set
+
+
+def test_objcall_generic_map(client):
+    m = client.get_map("srv:map")
+    assert m.put("k", 41) is None
+    assert m.put("k", 42) == 41
+    assert m.get("k") == 42
+    assert m.size() == 1
+    assert m.contains_key("k")
+    assert m.remove("k") == 42
+
+
+def test_objcall_generic_lock(client):
+    lock = client.get_lock("srv:lock")
+    assert lock.try_lock(wait_time=0.1)
+    assert lock.is_locked()
+    lock.unlock()
+    assert not lock.is_locked()
+
+
+def test_objcall_error_propagates(client):
+    q = client.get_bounded_blocking_queue("srv:bbq")
+    q.try_set_capacity(1)
+    assert q.offer(1)
+    assert not q.offer(2, timeout=0.05)
+
+
+def test_objcall_unknown_method(client):
+    m = client.get_map("srv:map2")
+    with pytest.raises(RespError):
+        m.definitely_not_a_method()
+
+
+def test_pubsub_remote(client):
+    topic = client.get_topic("srv:topic")
+    got = []
+    evt = threading.Event()
+
+    def listener(channel, msg):
+        got.append((channel, msg))
+        evt.set()
+
+    topic.add_listener(listener)
+    time.sleep(0.1)  # allow SUBSCRIBE to land
+    n = topic.publish({"hello": "world"})
+    assert n >= 1
+    assert evt.wait(2)
+    assert got[0] == ("srv:topic", {"hello": "world"})
+    topic.remove_all_listeners()
+
+
+def test_remote_batch_flush(client):
+    bf = client.get_bloom_filter("srv:batchbloom")
+    bf.try_init(100_000, 0.01)
+    batch = client.create_batch()
+    proxy = batch.get_bloom_filter("srv:batchbloom")
+    proxy.add_async(np.arange(1000, dtype=np.int64))
+    proxy.contains_async(np.arange(500, dtype=np.int64))
+    proxy.contains_async(np.arange(99_000, 99_010, dtype=np.int64))
+    results = batch.execute()
+    assert results[0].all()           # all new
+    assert results[1].all()           # first half present
+    assert not results[2].any()       # absent range
+
+
+def test_expire_ttl_commands(server):
+    conn = Connection("127.0.0.1", server.port)
+    conn.execute("SET", "exp:k", "v")
+    assert conn.execute("TTL", "exp:k") == -1
+    assert conn.execute("PEXPIRE", "exp:k", 50_000) == 1
+    assert 0 < conn.execute("TTL", "exp:k") <= 50
+    assert conn.execute("PERSIST", "exp:k") == 1
+    assert conn.execute("TTL", "exp:k") == -1
+    assert conn.execute("TTL", "exp:missing") == -2
+    assert conn.execute("TYPE", "exp:k") == b"bucket"
+    conn.close()
+
+
+def test_watchdog_reconnect_across_restart():
+    """Kill the server, restart on the same port, command succeeds
+    (ConnectionWatchdog reconnect + RedisExecutor retry)."""
+    engine = Engine()
+    st = ServerThread(engine=engine)
+    st.start()
+    port = st.port
+    node = NodeClient(
+        st.address, retry_attempts=8, retry_interval=0.2, ping_interval=0
+    )
+    assert node.execute("SET", "wd:k", "1") == b"OK"
+    st.stop()
+    # connection now dead; restart on same port with same engine
+    time.sleep(0.2)
+    st2 = ServerThread(engine=engine, port=port)
+    st2.start()
+    try:
+        assert node.execute("GET", "wd:k") == b"1"  # retried through reconnect
+    finally:
+        node.close()
+        st2.stop()
+
+
+def test_failed_connection_detector():
+    det = FailedConnectionDetector(threshold=2, window_s=60)
+    with pytest.raises((ConnectionError, OSError)):
+        NodeClient("tpu://127.0.0.1:1", detector=det, retry_attempts=1,
+                   ping_interval=0, connect_timeout=0.2, min_idle=1)
+    assert det.is_node_failed() or det._counter.count() >= 1
+
+
+def test_failed_commands_detector_feed(client):
+    det = FailedCommandsDetector(threshold=1, window_s=60)
+    det.on_command_failed(RuntimeError("x"))
+    assert det.is_node_failed()
+    det2 = FailedCommandsTimeoutDetector(threshold=2, window_s=60)
+    det2.on_command_timeout()
+    assert not det2.is_node_failed()
+    det2.on_command_timeout()
+    assert det2.is_node_failed()
+
+
+def test_auth_required():
+    with ServerThread(password="sekret") as st:
+        with pytest.raises(RespError):
+            Connection("127.0.0.1", st.port).execute_and_raise = None  # placeholder
+            c = Connection("127.0.0.1", st.port)
+            reply = c.execute("GET", "x")
+            if isinstance(reply, RespError):
+                raise reply
+        ok = Connection("127.0.0.1", st.port, password="sekret")
+        assert ok.execute("GET", "x") is None
+        ok.close()
+
+
+def test_pipeline_execute_many(server):
+    node = NodeClient(server.address, ping_interval=0)
+    replies = node.execute_many([("SET", "p:%d" % i, str(i)) for i in range(50)])
+    assert all(r == b"OK" for r in replies)
+    replies = node.execute_many([("GET", "p:%d" % i) for i in range(50)])
+    assert [int(r) for r in replies] == list(range(50))
+    node.close()
+
+
+def test_impersonated_lock_lease_and_renewal():
+    """Remote-held locks: no server-side watchdog; lease renewed only by
+    explicit client ticks (renew_lease), so a dead client's lock expires."""
+    from redisson_tpu.client.objects.lock import Lock
+
+    engine = Engine()
+    lock = Lock(engine, "imp:lock")
+    with engine.impersonate("clientA:7"):
+        lock.lock()
+        rec = engine.store.get("imp:lock")
+        lease0 = rec.host["lease_until"]
+        assert lease0 is not None and lease0 - time.time() <= 30.5
+        assert lock.renew_lease(60.0)
+        assert rec.host["lease_until"] > lease0
+    # a different identity cannot unlock or renew
+    with engine.impersonate("clientB:9"):
+        assert not lock.renew_lease()
+        with pytest.raises(RuntimeError):
+            lock.unlock()
+    with engine.impersonate("clientA:7"):
+        lock.unlock()
+    assert not lock.is_locked()
+    engine.shutdown()
+
+
+def test_remote_lock_client_watchdog(client):
+    lock = client.get_lock("srv:wdlock")
+    assert lock.try_lock(wait_time=0.5)
+    assert lock.is_locked()
+    # renewal entry point works over the wire under the caller identity
+    assert lock.renew_lease(45.0)
+    lock.unlock()
+    assert not lock.is_locked()
