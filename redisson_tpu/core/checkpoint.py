@@ -1,0 +1,206 @@
+"""Checkpoint / restore of the DeviceStore — the RDB-snapshot analog.
+
+The reference delegates durability to Redis RDB/AOF (SURVEY.md §5.4); here the
+"server state" is device-resident (HBM) plus host-side python structures, so
+the framework needs its own snapshot path: device arrays are pulled to host
+(one `np.asarray` per array — a single device→host DMA each), serialized with
+the host state into a single versioned container, and written atomically
+(tmp + rename) so a crash mid-save never corrupts the previous snapshot.
+
+Restore re-creates every StateRecord and `jax.device_put`s arrays back onto
+the default device.  Sharded records (parallel/sharded.py grid states) are
+gathered on save and restored replicated; the shard manager re-shards them
+lazily on first sharded dispatch — format stability beats layout fidelity
+(SURVEY.md §7.3 hard-part 5: hash/layout compatibility is part of the
+persisted format, so `meta` carries the hash version of ops/bittensor).
+
+Wire format (version 1):
+    8-byte magic  b"RTPUCKP1"
+    pickle(protocol 4) of {
+        "format": 1, "saved_at": epoch-seconds, "hash_version": int,
+        "records": [
+            {"name", "kind", "meta", "version", "expire_at",
+             "host": <python>, "arrays": {name: np.ndarray}},
+            ...
+        ],
+    }
+
+Restore uses the restricted unpickler policy of net/safe_pickle.py extended
+with numpy reconstruction — a checkpoint is the same trust domain as a Redis
+RDB file, but there is no reason to allow arbitrary classes either.
+"""
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+MAGIC = b"RTPUCKP1"
+FORMAT = 1
+
+# serializes same-process savers (AutoCheckpointer thread vs SAVE command);
+# cross-process uniqueness comes from the tmp-file name
+_save_lock = __import__("threading").Lock()
+_save_seq = __import__("itertools").count()
+
+
+def _snapshot_records(engine) -> List[Dict[str, Any]]:
+    """Materialize every live record to host memory under the store lock."""
+    store = engine.store
+    out: List[Dict[str, Any]] = []
+    with store._lock:
+        items = [(n, r) for n, r in store._states.items() if not r.expired()]
+    for name, rec in items:
+        # per-record lock: a compound mutation replaces arrays wholesale, so
+        # holding the record lock gives a consistent (kind, meta, arrays) cut
+        with engine.locked(name):
+            arrays = {k: np.asarray(v) for k, v in rec.arrays.items()}
+            out.append(
+                {
+                    "name": name,
+                    "kind": rec.kind,
+                    "meta": dict(rec.meta),
+                    "version": rec.version,
+                    "expire_at": rec.expire_at,
+                    "host": rec.host,
+                    "arrays": arrays,
+                }
+            )
+    return out
+
+
+def save(engine, path: str) -> int:
+    """Snapshot the full DeviceStore to `path`. Returns #records saved."""
+    from redisson_tpu.utils import hashing as H
+
+    with _save_lock:
+        records = _snapshot_records(engine)
+        payload = {
+            "format": FORMAT,
+            "saved_at": time.time(),
+            "hash_version": getattr(H, "HASH_VERSION", 1),
+            "records": records,
+        }
+        tmp = f"{path}.tmp.{os.getpid()}.{next(_save_seq)}"
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        try:
+            with open(tmp, "wb") as f:
+                f.write(MAGIC)
+                pickle.dump(payload, f, protocol=4)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return len(records)
+
+
+def _make_unpickler(stream):
+    from redisson_tpu.net.safe_pickle import RestrictedUnpickler
+
+    class _CheckpointUnpickler(RestrictedUnpickler):
+        """safe_pickle policy + numpy array reconstruction."""
+
+        def find_class(self, module: str, name: str):
+            if module.startswith("numpy"):
+                import importlib
+
+                return getattr(importlib.import_module(module), name)
+            return super().find_class(module, name)
+
+    return _CheckpointUnpickler(stream)
+
+
+def _loads(data: bytes):
+    return _make_unpickler(io.BytesIO(data)).load()
+
+
+def load(engine, path: str) -> int:
+    """Restore a snapshot into the engine's store. Returns #records loaded.
+
+    Existing records with the same name are overwritten (RESTORE REPLACE
+    semantics); records whose TTL already elapsed are skipped.
+    """
+    import jax
+
+    from redisson_tpu.core.store import StateRecord
+    from redisson_tpu.utils import hashing as H
+
+    with open(path, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise ValueError(f"not a redisson_tpu checkpoint: {path!r}")
+        payload = _loads(f.read())
+    if payload.get("format") != FORMAT:
+        raise ValueError(f"unsupported checkpoint format {payload.get('format')}")
+    hv = payload.get("hash_version", 1)
+    if hv != getattr(H, "HASH_VERSION", 1):
+        # bloom/HLL indexes are a function of the hash (SURVEY.md §7.3 item 5):
+        # a mismatched hash version would silently corrupt membership answers
+        raise ValueError(
+            f"checkpoint hash_version={hv} != runtime {getattr(H, 'HASH_VERSION', 1)}"
+        )
+
+    now = time.time()
+    n = 0
+    for r in payload["records"]:
+        if r["expire_at"] is not None and r["expire_at"] <= now:
+            continue
+        arrays = {k: jax.device_put(v) for k, v in r["arrays"].items()}
+        rec = StateRecord(
+            kind=r["kind"],
+            meta=r["meta"],
+            arrays=arrays,
+            host=r["host"],
+            version=r["version"],
+            expire_at=r["expire_at"],
+        )
+        with engine.locked(r["name"]):
+            engine.store.put(r["name"], rec)
+        n += 1
+    return n
+
+
+class AutoCheckpointer:
+    """Background periodic snapshotter (the `save <sec> <changes>` RDB knob).
+
+    Runs `save()` every `interval` seconds on a daemon thread; failures are
+    recorded on `.last_error` and never kill the loop (a failed snapshot must
+    not take down the data plane).
+    """
+
+    def __init__(self, engine, path: str, interval: float = 300.0):
+        import threading
+
+        self.engine = engine
+        self.path = path
+        self.interval = interval
+        self.last_save: float | None = None
+        self.last_error: Exception | None = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="rtpu-checkpoint", daemon=True
+        )
+
+    def start(self) -> "AutoCheckpointer":
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                save(self.engine, self.path)
+                self.last_save = time.time()
+                self.last_error = None
+            except Exception as e:  # noqa: BLE001 - keep the loop alive
+                self.last_error = e
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)

@@ -296,8 +296,14 @@ def main(argv=None):
     ap.add_argument("--password", default=None)
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--restore", action="store_true", help="load checkpoint on boot")
+    ap.add_argument(
+        "--checkpoint-interval", type=float, default=0.0,
+        help="seconds between automatic snapshots (0 = manual SAVE only)",
+    )
     ap.add_argument("--platform", default=None, help="force jax platform (cpu/tpu)")
     args = ap.parse_args(argv)
+    if args.checkpoint_interval > 0 and not args.checkpoint:
+        ap.error("--checkpoint-interval requires --checkpoint <path>")
     if args.platform:
         import os
 
@@ -314,6 +320,10 @@ def main(argv=None):
         from redisson_tpu.core import checkpoint
 
         checkpoint.load(engine, args.checkpoint)
+    if args.checkpoint and args.checkpoint_interval > 0:
+        from redisson_tpu.core.checkpoint import AutoCheckpointer
+
+        AutoCheckpointer(engine, args.checkpoint, args.checkpoint_interval).start()
     asyncio.run(srv.serve_forever())
 
 

@@ -1,0 +1,152 @@
+"""Checkpoint/restore (core/checkpoint.py) — the RDB-snapshot analog.
+
+Reference seam: durability in the reference is delegated to Redis RDB/AOF
+(SURVEY.md §5.4); here device-resident state must round-trip through the
+framework's own snapshot container, preserving sketch answers exactly
+(bloom membership, HLL estimates) because hash indexes are part of the
+persisted format (RedissonBloomFilter.java:90-97 computes them client-side).
+"""
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.client.redisson import RedissonTpu
+from redisson_tpu.core import checkpoint
+
+
+@pytest.fixture()
+def client():
+    c = RedissonTpu.create()
+    yield c
+    c.shutdown()
+
+
+def _populate(client):
+    bf = client.get_bloom_filter("ck:bloom")
+    bf.try_init(expected_insertions=10_000, false_probability=0.01)
+    keys = np.arange(1000, dtype=np.int64)
+    bf.add(keys)
+
+    hll = client.get_hyper_log_log("ck:hll")
+    hll.add(np.arange(5000, dtype=np.int64))
+
+    m = client.get_map("ck:map")
+    m.put("a", 1)
+    m.put("b", {"nested": [1, 2, 3]})
+
+    bucket = client.get_bucket("ck:bucket")
+    bucket.set("hello")
+
+    al = client.get_atomic_long("ck:counter")
+    al.add_and_get(42)
+    return keys
+
+
+def test_round_trip(tmp_path, client):
+    keys = _populate(client)
+    path = str(tmp_path / "snap.ckpt")
+    n = checkpoint.save(client.engine, path)
+    assert n >= 5
+
+    fresh = RedissonTpu.create()
+    try:
+        loaded = checkpoint.load(fresh.engine, path)
+        assert loaded == n
+
+        bf = fresh.get_bloom_filter("ck:bloom")
+        assert bf.contains_each(keys).all()
+        assert 950 <= bf.count() <= 1100  # count() is an estimate
+
+        hll = fresh.get_hyper_log_log("ck:hll")
+        assert abs(hll.count() - 5000) / 5000 < 0.05
+
+        m = fresh.get_map("ck:map")
+        assert m.get("a") == 1
+        assert m.get("b") == {"nested": [1, 2, 3]}
+
+        assert fresh.get_bucket("ck:bucket").get() == "hello"
+        assert fresh.get_atomic_long("ck:counter").get() == 42
+    finally:
+        fresh.shutdown()
+
+
+def test_atomic_write_preserves_previous_snapshot(tmp_path, client):
+    _populate(client)
+    path = str(tmp_path / "snap.ckpt")
+    checkpoint.save(client.engine, path)
+    before = open(path, "rb").read()
+    # a second save rewrites via tmp+rename; the file is never truncated in place
+    checkpoint.save(client.engine, path)
+    after = open(path, "rb").read()
+    assert after[: len(checkpoint.MAGIC)] == checkpoint.MAGIC
+    assert len(after) > 0 and len(before) > 0
+
+
+def test_bad_magic_rejected(tmp_path, client):
+    path = str(tmp_path / "junk.ckpt")
+    with open(path, "wb") as f:
+        f.write(b"NOTACKPT" + b"\x00" * 32)
+    with pytest.raises(ValueError, match="not a redisson_tpu checkpoint"):
+        checkpoint.load(client.engine, path)
+
+
+def test_expired_records_skipped(tmp_path, client):
+    b = client.get_bucket("ck:ttl")
+    b.set("soon-gone")
+    b.expire(0.05)
+    client.get_bucket("ck:stay").set("kept")
+    path = str(tmp_path / "snap.ckpt")
+    checkpoint.save(client.engine, path)
+    time.sleep(0.1)
+
+    fresh = RedissonTpu.create()
+    try:
+        checkpoint.load(fresh.engine, path)
+        assert fresh.get_bucket("ck:stay").get() == "kept"
+        assert fresh.get_bucket("ck:ttl").get() is None
+    finally:
+        fresh.shutdown()
+
+
+def test_hash_version_mismatch_rejected(tmp_path, client, monkeypatch):
+    _populate(client)
+    path = str(tmp_path / "snap.ckpt")
+    checkpoint.save(client.engine, path)
+    from redisson_tpu.utils import hashing as H
+
+    monkeypatch.setattr(H, "HASH_VERSION", 999)
+    fresh = RedissonTpu.create()
+    try:
+        with pytest.raises(ValueError, match="hash_version"):
+            checkpoint.load(fresh.engine, path)
+    finally:
+        fresh.shutdown()
+
+
+def test_restore_overwrites_existing(tmp_path, client):
+    client.get_bucket("ck:b").set("v1")
+    path = str(tmp_path / "snap.ckpt")
+    checkpoint.save(client.engine, path)
+    client.get_bucket("ck:b").set("v2")
+    checkpoint.load(client.engine, path)
+    assert client.get_bucket("ck:b").get() == "v1"
+
+
+def test_auto_checkpointer(tmp_path, client):
+    _populate(client)
+    path = str(tmp_path / "auto.ckpt")
+    ac = checkpoint.AutoCheckpointer(client.engine, path, interval=0.1)
+    ac.start()
+    try:
+        deadline = time.time() + 5
+        while ac.last_save is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert ac.last_save is not None, f"auto save never ran (err={ac.last_error})"
+    finally:
+        ac.stop()
+    fresh = RedissonTpu.create()
+    try:
+        assert checkpoint.load(fresh.engine, path) >= 5
+    finally:
+        fresh.shutdown()
