@@ -433,7 +433,9 @@ class MapCache(Map):
         self._write_through("write", key, value)
         return None if old is None else self._dv(old)
 
-    def put_if_absent_with_ttl(self, key, value, ttl: Optional[float] = None):
+    def put_if_absent_with_ttl(
+        self, key, value, ttl: Optional[float] = None, max_idle: Optional[float] = None
+    ):
         ek, ev = self._ek(key), self._ev(value)
         now = self._now()
         with self._engine.locked(self._name):
@@ -441,7 +443,7 @@ class MapCache(Map):
             old = self._live(rec, ek, touch=False)
             if old is not None:
                 return self._dv(old)
-            rec.host[ek] = [ev, now + ttl if ttl else None, None, now]
+            rec.host[ek] = [ev, now + ttl if ttl else None, max_idle, now]
             self._touch_version(rec)
         self._write_through("write", key, value)
         return None

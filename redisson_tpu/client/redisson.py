@@ -96,7 +96,29 @@ class RedissonTpu:
     def get_map_cache(self, name: str, codec: Optional[Codec] = None, options=None):
         from redisson_tpu.client.objects.map import MapCache
 
-        return MapCache(self._engine, name, codec, options)
+        mc = MapCache(self._engine, name, codec, options)
+        self._engine.eviction.schedule(name, mc.reap_expired)
+        return mc
+
+    def get_local_cached_map(self, name: str, codec: Optional[Codec] = None, options=None):
+        from redisson_tpu.client.objects.localcache import LocalCachedMap
+
+        return LocalCachedMap(self._engine, name, codec, options)
+
+    def get_long_adder(self, name: str):
+        from redisson_tpu.client.objects.adder import LongAdder
+
+        return LongAdder(self._engine, name)
+
+    def get_double_adder(self, name: str):
+        from redisson_tpu.client.objects.adder import DoubleAdder
+
+        return DoubleAdder(self._engine, name)
+
+    def get_cache_manager(self):
+        from redisson_tpu.client.jcache import CacheManager
+
+        return CacheManager(self._engine)
 
     def get_set(self, name: str, codec: Optional[Codec] = None):
         from redisson_tpu.client.objects.set import Set
@@ -106,7 +128,9 @@ class RedissonTpu:
     def get_set_cache(self, name: str, codec: Optional[Codec] = None):
         from redisson_tpu.client.objects.set import SetCache
 
-        return SetCache(self._engine, name, codec)
+        sc = SetCache(self._engine, name, codec)
+        self._engine.eviction.schedule(name, sc.reap_expired)
+        return sc
 
     def get_sorted_set(self, name: str, codec: Optional[Codec] = None, key=None):
         from redisson_tpu.client.objects.set import SortedSet

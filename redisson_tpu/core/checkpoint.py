@@ -20,7 +20,7 @@ Wire format (version 1):
         "format": 1, "saved_at": epoch-seconds, "hash_version": int,
         "records": [
             {"name", "kind", "meta", "version", "expire_at",
-             "host": <python>, "arrays": {name: np.ndarray}},
+             "host_pickled": bytes, "arrays": {name: np.ndarray}},
             ...
         ],
     }
@@ -56,7 +56,9 @@ def _snapshot_records(engine) -> List[Dict[str, Any]]:
         items = [(n, r) for n, r in store._states.items() if not r.expired()]
     for name, rec in items:
         # per-record lock: a compound mutation replaces arrays wholesale, so
-        # holding the record lock gives a consistent (kind, meta, arrays) cut
+        # holding the record lock gives a consistent (kind, meta, arrays) cut.
+        # host state is serialized HERE, inside the lock — keeping a live
+        # reference would race with mutators once the lock is released
         with engine.locked(name):
             arrays = {k: np.asarray(v) for k, v in rec.arrays.items()}
             out.append(
@@ -66,7 +68,7 @@ def _snapshot_records(engine) -> List[Dict[str, Any]]:
                     "meta": dict(rec.meta),
                     "version": rec.version,
                     "expire_at": rec.expire_at,
-                    "host": rec.host,
+                    "host_pickled": pickle.dumps(rec.host, protocol=4),
                     "arrays": arrays,
                 }
             )
@@ -156,7 +158,7 @@ def load(engine, path: str) -> int:
             kind=r["kind"],
             meta=r["meta"],
             arrays=arrays,
-            host=r["host"],
+            host=_loads(r["host_pickled"]) if "host_pickled" in r else r.get("host"),
             version=r["version"],
             expire_at=r["expire_at"],
         )

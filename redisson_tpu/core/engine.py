@@ -43,6 +43,25 @@ class Engine:
         self._wait_entries: dict[str, "object"] = {}
         self._holder_override = threading.local()
         self._closed = False
+        self._eviction = None
+
+    @property
+    def eviction(self):
+        """Lazily-started EvictionScheduler (eviction/EvictionScheduler.java
+        analog); the sweep thread only exists once something registers."""
+        with self._locks_guard:
+            if self._closed:
+                raise RuntimeError("engine is shut down")
+            if self._eviction is None:
+                from redisson_tpu.core.eviction import EvictionScheduler
+
+                self._eviction = EvictionScheduler(
+                    min_delay=self.config.min_cleanup_delay,
+                    max_delay=self.config.max_cleanup_delay,
+                )
+                # global TTL reaper: RExpirable whole-object expiries
+                self._eviction.schedule("__store__", self.store.reap_expired)
+            return self._eviction
 
     @contextmanager
     def impersonate(self, holder_id: Optional[str]):
@@ -144,7 +163,11 @@ class Engine:
     # -- lifecycle ----------------------------------------------------------
 
     def shutdown(self):
-        self._closed = True
+        with self._locks_guard:
+            self._closed = True
+            eviction, self._eviction = self._eviction, None
+        if eviction is not None:
+            eviction.close()
         self.pubsub.close()
         self.store.flushall()
 

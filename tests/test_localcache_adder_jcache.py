@@ -1,0 +1,396 @@
+"""LocalCachedMap, adders, EvictionScheduler, JCache facade.
+
+Parity seams: RedissonLocalCachedMap (near cache + invalidation topic,
+cache/LocalCacheListener.java), RedissonBaseAdder (local counters + flush
+topic), eviction/EvictionScheduler (self-tuning sweep), org/redisson/jcache
+(JSR-107).
+"""
+import time
+
+import pytest
+
+from redisson_tpu.client.redisson import RedissonTpu
+from redisson_tpu.client.objects.localcache import (
+    EvictionPolicy,
+    LocalCachedMapOptions,
+    ReconnectionStrategy,
+    SyncStrategy,
+)
+from redisson_tpu.core.eviction import EvictionScheduler
+
+
+@pytest.fixture()
+def client():
+    c = RedissonTpu.create()
+    yield c
+    c.shutdown()
+
+
+# -- LocalCachedMap ----------------------------------------------------------
+
+
+def test_local_cache_hit_path(client):
+    m = client.get_local_cached_map("lc:basic")
+    m.put("a", 1)
+    assert m.get("a") == 1  # served from cache (populated by put)
+    assert m.hits >= 1
+    assert m.cached_size() == 1
+
+
+def test_invalidate_strategy_between_handles(client):
+    opts = LocalCachedMapOptions(sync_strategy=SyncStrategy.INVALIDATE)
+    m1 = client.get_local_cached_map("lc:inv", options=opts)
+    m2 = client.get_local_cached_map("lc:inv", options=opts)
+    m1.put("k", "v1")
+    assert m2.get("k") == "v1"         # m2 caches it
+    assert m2.cached_size() == 1
+    m1.put("k", "v2")                   # must invalidate m2's copy
+    assert "k" not in [k for k in m2.cached_keys()] or m2.get("k") == "v2"
+    assert m2.get("k") == "v2"
+
+
+def test_update_strategy_pushes_value(client):
+    opts = LocalCachedMapOptions(sync_strategy=SyncStrategy.UPDATE)
+    m1 = client.get_local_cached_map("lc:upd", options=opts)
+    m2 = client.get_local_cached_map("lc:upd", options=opts)
+    m1.put("k", "v1")
+    # m2 received the pushed value without ever reading the shared map
+    assert m2.cached_size() == 1
+    hits_before = m2.hits
+    assert m2.get("k") == "v1"
+    assert m2.hits == hits_before + 1
+
+
+def test_none_strategy_no_propagation(client):
+    opts = LocalCachedMapOptions(sync_strategy=SyncStrategy.NONE)
+    m1 = client.get_local_cached_map("lc:none", options=opts)
+    m2 = client.get_local_cached_map("lc:none", options=opts)
+    m1.put("k", "v1")
+    assert m2.cached_size() == 0
+
+
+def test_remove_invalidates_peers(client):
+    m1 = client.get_local_cached_map("lc:rm")
+    m2 = client.get_local_cached_map("lc:rm")
+    m1.put("k", 1)
+    m2.get("k")
+    m1.remove("k")
+    assert m2.cached_size() == 0
+    assert m2.get("k") is None
+
+
+def test_lru_eviction_bounds_cache(client):
+    opts = LocalCachedMapOptions(cache_size=3, eviction_policy=EvictionPolicy.LRU)
+    m = client.get_local_cached_map("lc:lru", options=opts)
+    for i in range(5):
+        m.put(f"k{i}", i)
+    assert m.cached_size() == 3
+    # underlying map still holds everything
+    assert m.size() == 5
+    assert m.get("k0") == 0  # miss -> refetch
+
+
+def test_lfu_eviction_keeps_hot_keys(client):
+    opts = LocalCachedMapOptions(cache_size=2, eviction_policy=EvictionPolicy.LFU)
+    m = client.get_local_cached_map("lc:lfu", options=opts)
+    m.put("hot", 1)
+    for _ in range(5):
+        m.get("hot")
+    m.put("warm", 2)
+    m.put("cold", 3)  # evicts the least-frequently-used of {warm, ...}
+    assert "hot" in m.cached_keys()
+
+
+def test_local_ttl_expires_cached_copy(client):
+    opts = LocalCachedMapOptions(time_to_live=0.05)
+    m = client.get_local_cached_map("lc:ttl", options=opts)
+    m.put("k", 1)
+    assert m.cached_size() == 1
+    time.sleep(0.08)
+    hits = m.hits
+    assert m.get("k") == 1  # still in shared map; near-cache copy expired
+    assert m.hits == hits   # that read was a miss
+
+
+def test_reconnection_strategies(client):
+    m = client.get_local_cached_map(
+        "lc:rec", options=LocalCachedMapOptions(reconnection_strategy=ReconnectionStrategy.CLEAR)
+    )
+    m.put("a", 1)
+    m.on_reconnect()
+    assert m.cached_size() == 0
+
+    m2 = client.get_local_cached_map(
+        "lc:rec", options=LocalCachedMapOptions(reconnection_strategy=ReconnectionStrategy.LOAD)
+    )
+    m2.on_reconnect()
+    assert m2.cached_size() == 1  # warmed from shared map
+
+
+def test_clear_propagates(client):
+    m1 = client.get_local_cached_map("lc:clear")
+    m2 = client.get_local_cached_map("lc:clear")
+    m1.put("a", 1)
+    m2.get("a")
+    m1.clear()
+    assert m2.cached_size() == 0
+    assert m1.size() == 0
+
+
+# -- adders ------------------------------------------------------------------
+
+
+def test_long_adder_sum_across_handles(client):
+    a1 = client.get_long_adder("adder:l")
+    a2 = client.get_long_adder("adder:l")
+    for _ in range(10):
+        a1.increment()
+    a2.add(5)
+    a2.decrement()
+    assert a1.sum() == 14
+    assert a2.sum() == 14
+
+
+def test_long_adder_reset(client):
+    a = client.get_long_adder("adder:reset")
+    a.add(7)
+    assert a.sum() == 7
+    a.reset()
+    assert a.sum() == 0
+
+
+def test_double_adder(client):
+    a1 = client.get_double_adder("adder:d")
+    a2 = client.get_double_adder("adder:d")
+    a1.add(1.5)
+    a2.add(2.25)
+    assert a1.sum() == pytest.approx(3.75)
+
+
+def test_adder_destroy_flushes(client):
+    a1 = client.get_long_adder("adder:destroy")
+    a2 = client.get_long_adder("adder:destroy")
+    a1.add(3)
+    a1.destroy()
+    assert a2.sum() == 3
+
+
+# -- EvictionScheduler -------------------------------------------------------
+
+
+def test_eviction_scheduler_sweeps_and_backs_off():
+    sched = EvictionScheduler(min_delay=0.02, max_delay=0.5, start_delay=0.02)
+    removed_per_call = [150, 150, 0, 0, 0]
+    calls = []
+
+    def sweep():
+        calls.append(time.time())
+        return removed_per_call[min(len(calls) - 1, len(removed_per_call) - 1)]
+
+    sched.schedule("obj", sweep)
+    deadline = time.time() + 5
+    while len(calls) < 5 and time.time() < deadline:
+        time.sleep(0.01)
+    sched.close()
+    assert len(calls) >= 5
+    assert sched.total_removed >= 300
+
+
+def test_eviction_scheduler_survives_failing_sweep():
+    sched = EvictionScheduler(min_delay=0.01, max_delay=0.1)
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise RuntimeError("boom")
+
+    sched.schedule("bad", bad)
+    deadline = time.time() + 3
+    while len(calls) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    sched.close()
+    assert len(calls) >= 2  # the loop kept going after the exception
+
+
+def test_map_cache_swept_by_engine_scheduler(client):
+    client.engine.eviction.min_delay = 0.02
+    client.engine.eviction.start_delay = 0.02
+    mc = client.get_map_cache("sweep:mc")
+    mc.put_with_ttl("k", "v", ttl=0.03)
+    rec = client.engine.store.get("sweep:mc")
+    deadline = time.time() + 5
+    while rec.host and time.time() < deadline:
+        time.sleep(0.02)
+    assert not rec.host  # removed by the background sweep, not by an access
+
+
+def test_unschedule_stops_task():
+    sched = EvictionScheduler(min_delay=0.01, max_delay=0.1)
+    calls = []
+    sched.schedule("x", lambda: calls.append(1) or 0)
+    deadline = time.time() + 3
+    while not calls and time.time() < deadline:
+        time.sleep(0.01)
+    sched.unschedule("x")
+    n = len(calls)
+    time.sleep(0.1)
+    assert len(calls) <= n + 1  # at most one in-flight sweep after unschedule
+    sched.close()
+
+
+# -- JCache ------------------------------------------------------------------
+
+
+def test_jcache_basic_contract(client):
+    from redisson_tpu.client.jcache import CacheConfig, ExpiryPolicy
+
+    cm = client.get_cache_manager()
+    cache = cm.create_cache("c1", CacheConfig(expiry=ExpiryPolicy.eternal()))
+    assert cache.get("a") is None
+    cache.put("a", 1)
+    assert cache.get("a") == 1
+    assert cache.get_and_put("a", 2) == 1
+    assert cache.put_if_absent("a", 3) is False
+    assert cache.put_if_absent("b", 9) is True
+    assert cache.contains_key("b")
+    assert cache.get_and_remove("b") == 9
+    assert cache.remove("missing") is False
+    cache.put("c", 5)
+    assert cache.remove("c", 4) is False   # value mismatch -> keep
+    assert cache.remove("c", 5) is True
+    assert cache.statistics.hits > 0 and cache.statistics.puts > 0
+
+
+def test_jcache_expiry_created(client):
+    from redisson_tpu.client.jcache import CacheConfig, ExpiryPolicy
+
+    cm = client.get_cache_manager()
+    cache = cm.create_cache("cexp", CacheConfig(expiry=ExpiryPolicy.created(0.05)))
+    cache.put("k", "v")
+    assert cache.get("k") == "v"
+    time.sleep(0.08)
+    assert cache.get("k") is None
+
+
+def test_jcache_invoke_atomic(client):
+    cm = client.get_cache_manager()
+    cache = cm.create_cache("cinv")
+    cache.put("n", 10)
+
+    def bump(entry):
+        entry.set_value(entry.value + 1)
+        return entry.value
+
+    assert cache.invoke("n", bump) == 11
+    assert cache.get("n") == 11
+
+    def drop(entry):
+        entry.remove()
+
+    cache.invoke("n", drop)
+    assert cache.get("n") is None
+
+
+def test_jcache_manager_lifecycle(client):
+    cm = client.get_cache_manager()
+    cm.create_cache("x")
+    assert cm.get_or_create_cache("x") is cm.get_cache("x")
+    assert "x" in cm.cache_names()
+    with pytest.raises(ValueError):
+        cm.create_cache("x")
+    cm.destroy_cache("x")
+    assert cm.get_cache("x") is None
+    c = cm.create_cache("y")
+    cm.close()
+    assert c.closed
+    with pytest.raises(RuntimeError):
+        c.get("a")
+
+
+# -- review regressions ------------------------------------------------------
+
+
+def test_localcache_replace_updates_near_cache(client):
+    """A replace through one handle must not leave stale near-cache copies."""
+    m1 = client.get_local_cached_map("lc:rep")
+    m2 = client.get_local_cached_map("lc:rep")
+    m1.put("k", 1)
+    assert m2.get("k") == 1
+    m1.replace("k", 2)
+    assert m1.get("k") == 2
+    assert m2.get("k") == 2
+    assert m1.replace_if_equals("k", 2, 3) is True
+    assert m2.get("k") == 3
+    assert m1.remove_if_equals("k", 3) is True
+    assert m2.get("k") is None
+
+
+def test_localcache_put_if_absent_and_add_and_get(client):
+    m1 = client.get_local_cached_map("lc:pia")
+    m2 = client.get_local_cached_map("lc:pia")
+    assert m1.put_if_absent("k", 5) is None
+    assert m2.get("k") == 5
+    assert m2.put_if_absent("k", 9) == 5  # no overwrite, no stale push
+    assert m1.get("k") == 5
+    m1.put("n", 10)
+    assert m1.add_and_get("n", 2) == 12
+    assert m2.get("n") == 12
+
+
+def test_jcache_touched_expiry_via_put_if_absent(client):
+    from redisson_tpu.client.jcache import CacheConfig, ExpiryPolicy
+
+    cm = client.get_cache_manager()
+    cache = cm.create_cache("ctouch", CacheConfig(expiry=ExpiryPolicy.touched(0.06)))
+    assert cache.put_if_absent("k", 1) is True
+    time.sleep(0.1)
+    assert cache.get("k") is None  # idle-expired even via put_if_absent
+
+
+def test_jcache_created_policy_not_rearmed_by_update(client):
+    from redisson_tpu.client.jcache import CacheConfig, ExpiryPolicy
+
+    cm = client.get_cache_manager()
+    cache = cm.create_cache("crearm", CacheConfig(expiry=ExpiryPolicy.created(0.15)))
+    cache.put("k", 1)
+    time.sleep(0.08)
+    cache.put("k", 2)  # update must NOT re-arm the created-TTL
+    time.sleep(0.1)    # ~0.18s since creation > 0.15s
+    assert cache.get("k") is None
+
+
+def test_jcache_destroy_unschedules_sweep(client):
+    cm = client.get_cache_manager()
+    cm.create_cache("cgone")
+    assert "jcache:cgone" in client.engine.eviction._tasks
+    cm.destroy_cache("cgone")
+    assert "jcache:cgone" not in client.engine.eviction._tasks
+
+
+def test_checkpoint_save_during_concurrent_map_writes(tmp_path, client):
+    """host state is serialized under the record lock — concurrent writers
+    must not be able to tear the snapshot (dict-changed-size race)."""
+    import threading
+
+    from redisson_tpu.core import checkpoint
+
+    m = client.get_map("race:map")
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            m.put(f"k{i % 500}", i)
+            i += 1
+
+    threads = [threading.Thread(target=writer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for round_ in range(10):
+            checkpoint.save(client.engine, str(tmp_path / "race.ckpt"))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert checkpoint.load(RedissonTpu.create().engine, str(tmp_path / "race.ckpt")) >= 1
