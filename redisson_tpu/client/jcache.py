@@ -91,6 +91,10 @@ class Cache:
         if self._closed:
             raise RuntimeError(f"cache '{self._name}' is closed")
 
+    def _stat(self, attr: str, n: int = 1) -> None:
+        if self._config.statistics_enabled:
+            setattr(self.statistics, attr, getattr(self.statistics, attr) + n)
+
     def _put_with_policy(self, key, value):
         """Spec-accurate expiry arming (JSR-107 §ExpiryPolicy): the creation
         duration governs inserts; the update duration governs overwrites —
@@ -114,11 +118,7 @@ class Cache:
     def get(self, key):
         self._check_open()
         v = self._map.get(key)
-        if self._config.statistics_enabled:
-            if v is None:
-                self.statistics.misses += 1
-            else:
-                self.statistics.hits += 1
+        self._stat("misses" if v is None else "hits")
         return v
 
     def get_all(self, keys: Iterable) -> Dict:
@@ -132,12 +132,12 @@ class Cache:
     def put(self, key, value) -> None:
         self._check_open()
         self._put_with_policy(key, value)
-        self.statistics.puts += 1
+        self._stat("puts")
 
     def get_and_put(self, key, value):
         self._check_open()
         old = self._put_with_policy(key, value)
-        self.statistics.puts += 1
+        self._stat("puts")
         return old
 
     def put_all(self, entries: Dict) -> None:
@@ -151,7 +151,7 @@ class Cache:
             key, value, ttl=e.creation, max_idle=e.access
         )
         if prev is None:
-            self.statistics.puts += 1
+            self._stat("puts")
             return True
         return False
 
@@ -162,32 +162,55 @@ class Cache:
         else:
             ok = self._map.fast_remove(key) > 0
         if ok:
-            self.statistics.removals += 1
+            self._stat("removals")
         return ok
 
     def get_and_remove(self, key):
         self._check_open()
         old = self._map.remove(key)
         if old is not None:
-            self.statistics.removals += 1
+            self._stat("removals")
         return old
+
+    def _replace_with_policy(self, key, value):
+        """Replace-if-present honoring the update expiry duration — going
+        straight to Map.replace would reset the cell's TTL/max-idle to None
+        via MapCache._raw_put, silently making the entry eternal."""
+        with self._manager._engine.locked(self._map.name):
+            if not self._map.contains_key(key):
+                return None, False
+            old = self._put_with_policy(key, value)
+            return old, True
 
     def replace(self, key, value, old_value=None) -> bool:
         self._check_open()
         if old_value is not None:
-            return self._map.replace_if_equals(key, old_value, value)
-        return self._map.replace(key, value) is not None
+            with self._manager._engine.locked(self._map.name):
+                if self._map.get(key) != old_value:
+                    return False
+                self._put_with_policy(key, value)
+                self._stat("puts")
+                return True
+        _, ok = self._replace_with_policy(key, value)
+        if ok:
+            self._stat("puts")
+        return ok
 
     def get_and_replace(self, key, value):
         self._check_open()
-        return self._map.replace(key, value)
+        old, ok = self._replace_with_policy(key, value)
+        if ok:
+            self._stat("puts")
+        return old
 
     def remove_all(self, keys: Optional[Iterable] = None) -> None:
         self._check_open()
         if keys is None:
+            n = self._map.size()
             self._map.clear()
+            self._stat("removals", n)
         else:
-            self._map.fast_remove(*list(keys))
+            self._stat("removals", self._map.fast_remove(*list(keys)))
 
     def clear(self) -> None:
         self._check_open()

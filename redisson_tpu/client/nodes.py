@@ -1,0 +1,162 @@
+"""Nodes admin API: per-node PING / INFO / TIME / MEMORY.
+
+Parity target: ``org/redisson/redisnode/`` (RedisNodes, RedisNode,
+RedissonClusterNodes — SURVEY.md §2.7): an administrative surface listing the
+topology's nodes and exposing health/metrics calls against each.
+
+Two node flavors here, matching the two deployment modes:
+  * EmbeddedNode — one per JAX device of the local process.  "INFO" reports
+    the device's HBM statistics (`device.memory_stats()` on TPU), platform,
+    and the store's record count; "PING" round-trips a tiny computation
+    through the device so it actually proves the chip is alive (the
+    reference's PING proves the socket + event loop, ours proves the
+    dispatch path).
+  * RemoteNode — wraps a NodeClient and issues the wire PING/INFO/TIME/
+    MEMORY commands the server registry exposes.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+
+class BaseNode:
+    id: str
+    address: str
+
+    def ping(self, timeout: float = 5.0) -> bool:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def time(self) -> float:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def info(self) -> Dict[str, Any]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def memory(self) -> Dict[str, Any]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class EmbeddedNode(BaseNode):
+    """One local JAX device viewed as a topology node."""
+
+    def __init__(self, engine, device):
+        self._engine = engine
+        self.device = device
+        self.id = f"{device.platform}:{device.id}"
+        self.address = f"device://{device.platform}/{device.id}"
+
+    def ping(self, timeout: float = 5.0) -> bool:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        try:
+            x = jax.device_put(jnp.arange(4, dtype=jnp.int32), self.device)
+            return int(np.asarray(x).sum()) == 6
+        except Exception:
+            return False
+
+    def time(self) -> float:
+        return time.time()
+
+    def info(self) -> Dict[str, Any]:
+        d = self.device
+        out: Dict[str, Any] = {
+            "id": self.id,
+            "platform": d.platform,
+            "device_kind": getattr(d, "device_kind", "unknown"),
+            "process_index": getattr(d, "process_index", 0),
+            "keys": len(self._engine.store),
+        }
+        out.update(self.memory())
+        return out
+
+    def memory(self) -> Dict[str, Any]:
+        stats: Dict[str, Any] = {}
+        try:
+            ms = self.device.memory_stats() or {}
+            stats["bytes_in_use"] = ms.get("bytes_in_use")
+            stats["bytes_limit"] = ms.get("bytes_limit")
+            stats["peak_bytes_in_use"] = ms.get("peak_bytes_in_use")
+        except Exception:
+            # CPU backend has no memory_stats; report nothing rather than lie
+            pass
+        return stats
+
+
+class RemoteNode(BaseNode):
+    """A server process reached over the wire protocol."""
+
+    def __init__(self, node_client):
+        self._nc = node_client
+        self.address = getattr(node_client, "address", "?")
+        self.id = self.address
+
+    def ping(self, timeout: float = 5.0) -> bool:
+        try:
+            return self._nc.execute("PING", timeout=timeout) in (b"PONG", "PONG")
+        except Exception:
+            return False
+
+    def time(self) -> float:
+        reply = self._nc.execute("TIME")
+        # RESP TIME returns [seconds, microseconds]
+        sec, usec = (int(x) for x in reply)
+        return sec + usec / 1e6
+
+    def info(self) -> Dict[str, Any]:
+        raw = self._nc.execute("INFO")
+        text = raw.decode() if isinstance(raw, (bytes, bytearray)) else str(raw)
+        out: Dict[str, Any] = {}
+        for line in text.splitlines():
+            if ":" in line and not line.startswith("#"):
+                k, _, v = line.partition(":")
+                out[k.strip()] = v.strip()
+        return out
+
+    def memory(self) -> Dict[str, Any]:
+        reply = self._nc.execute("MEMORY", "STATS")
+        if isinstance(reply, (list, tuple)):
+            it = iter(reply)
+            return {
+                (k.decode() if isinstance(k, (bytes, bytearray)) else str(k)): v
+                for k, v in zip(it, it)
+            }
+        return {"raw": reply}
+
+
+class NodesGroup:
+    """RedisNodes analog: enumerate + health-check the topology's nodes."""
+
+    def __init__(self, nodes: List[BaseNode]):
+        self._nodes = list(nodes)
+
+    @classmethod
+    def embedded(cls, engine) -> "NodesGroup":
+        import jax
+
+        return cls([EmbeddedNode(engine, d) for d in jax.devices()])
+
+    @classmethod
+    def remote(cls, *node_clients) -> "NodesGroup":
+        return cls([RemoteNode(nc) for nc in node_clients])
+
+    def nodes(self) -> List[BaseNode]:
+        return list(self._nodes)
+
+    def node(self, node_id: str) -> Optional[BaseNode]:
+        for n in self._nodes:
+            if n.id == node_id:
+                return n
+        return None
+
+    def ping_all(self, timeout: float = 5.0) -> bool:
+        """True iff EVERY node answers (RedisNodes.pingAll contract)."""
+        return all(n.ping(timeout) for n in self._nodes)
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def __iter__(self):
+        return iter(self._nodes)

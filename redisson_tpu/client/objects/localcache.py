@@ -178,9 +178,14 @@ class LocalCachedMap(Map):
             self.hits += 1
             return value
         self.misses += 1
-        value = super().get(key)
-        if value is not None:
-            self._cache.put(ek, value)
+        # read + cache-populate under the record lock: a writer cannot slip a
+        # mutation (whose invalidation we'd miss) between our read and the
+        # near-cache insert — the reference serializes the same window through
+        # its cache-update listener ordering (LocalCacheListener.java)
+        with self._engine.locked(self._name):
+            value = super().get(key)
+            if value is not None:
+                self._cache.put(ek, value)
         return value
 
     def get_all(self, keys) -> Dict:
@@ -194,9 +199,10 @@ class LocalCachedMap(Map):
                 self.misses += 1
                 missing.append(k)
         if missing:
-            fetched = super().get_all(missing)
-            for k, v in fetched.items():
-                self._cache.put(self._ek(k), v)
+            with self._engine.locked(self._name):
+                fetched = super().get_all(missing)
+                for k, v in fetched.items():
+                    self._cache.put(self._ek(k), v)
             out.update(fetched)
         return out
 
@@ -233,13 +239,9 @@ class LocalCachedMap(Map):
             self._broadcast("upd", [(ek, self._ev(value))])
         return prev
 
-    def fast_put_if_absent(self, key, value) -> bool:
-        inserted = super().fast_put_if_absent(key, value)
-        if inserted:
-            ek = self._ek(key)
-            self._cache.put(ek, value)
-            self._broadcast("upd", [(ek, self._ev(value))])
-        return inserted
+    # fast_put_if_absent needs no override: Map.fast_put_if_absent delegates
+    # to self.put_if_absent, which dispatches to the override above — a second
+    # override here would cache and broadcast every insert twice
 
     def replace(self, key, value):
         old = super().replace(key, value)

@@ -97,7 +97,7 @@ class RedissonTpu:
         from redisson_tpu.client.objects.map import MapCache
 
         mc = MapCache(self._engine, name, codec, options)
-        self._engine.eviction.schedule(name, mc.reap_expired)
+        self._engine.eviction.schedule_for_record(self._engine, name, mc.reap_expired)
         return mc
 
     def get_local_cached_map(self, name: str, codec: Optional[Codec] = None, options=None):
@@ -129,7 +129,7 @@ class RedissonTpu:
         from redisson_tpu.client.objects.set import SetCache
 
         sc = SetCache(self._engine, name, codec)
-        self._engine.eviction.schedule(name, sc.reap_expired)
+        self._engine.eviction.schedule_for_record(self._engine, name, sc.reap_expired)
         return sc
 
     def get_sorted_set(self, name: str, codec: Optional[Codec] = None, key=None):
@@ -358,6 +358,28 @@ class RedissonTpu:
         return MapReduce(self._engine, mapper, reducer, collator, workers)
 
     # -- keyspace admin (RKeys) --------------------------------------------
+
+    def get_script(self):
+        # engine-scoped so the sha cache survives across handles (the
+        # reference caches shas per ServiceManager, not per RScript)
+        from redisson_tpu.services.script import ScriptService
+
+        return self._engine.service("script", lambda: ScriptService(self._engine))
+
+    def get_function(self):
+        from redisson_tpu.services.script import FunctionService
+
+        return self._engine.service("function", lambda: FunctionService(self._engine))
+
+    def get_search(self):
+        from redisson_tpu.services.search import SearchService
+
+        return self._engine.service("search", lambda: SearchService(self._engine))
+
+    def get_nodes_group(self):
+        from redisson_tpu.client.nodes import NodesGroup
+
+        return NodesGroup.embedded(self._engine)
 
     def get_keys(self):
         from redisson_tpu.client.objects.keys import Keys

@@ -44,6 +44,16 @@ class Engine:
         self._holder_override = threading.local()
         self._closed = False
         self._eviction = None
+        self._services: dict = {}
+
+    def service(self, key: str, factory):
+        """Engine-scoped lazy singleton (script cache, search indexes, ...)
+        — one instance per engine regardless of which handle asks first."""
+        with self._locks_guard:
+            svc = self._services.get(key)
+            if svc is None:
+                svc = self._services[key] = factory()
+            return svc
 
     @property
     def eviction(self):

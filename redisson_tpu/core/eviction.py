@@ -37,6 +37,7 @@ class _Task:
 
 class EvictionScheduler:
     KEYS_LIMIT = 100  # removals per sweep that signal "sweep again soon"
+    DROP = -1         # sweep return value meaning "unschedule me"
 
     def __init__(
         self,
@@ -73,6 +74,22 @@ class EvictionScheduler:
             self._push(task, time.time() + task.delay)
             self._ensure_thread()
             self._cv.notify()
+
+    def schedule_for_record(self, engine, name: str, sweep: Callable[[], int]) -> None:
+        """Register a sweep tied to a store record's lifetime: once the record
+        has existed and is later deleted, the task unschedules itself —
+        otherwise per-name tasks for dynamic object names leak forever.
+        Recreating the object re-registers through the factory path."""
+        seen = [False]
+
+        def guarded() -> int:
+            exists = engine.store.exists(name)
+            if exists:
+                seen[0] = True
+                return sweep()
+            return self.DROP if seen[0] else 0
+
+        self.schedule(name, guarded)
 
     def unschedule(self, name: str) -> None:
         with self._cv:
@@ -112,6 +129,9 @@ class EvictionScheduler:
                 removed = int(task.sweep() or 0)
             except Exception:  # noqa: BLE001 - a failing sweep must not kill the loop
                 removed = 0
+            if removed == self.DROP:
+                self.unschedule(task.name)
+                continue
             self.sweeps += 1
             self.total_removed += removed
             if removed >= self.KEYS_LIMIT:

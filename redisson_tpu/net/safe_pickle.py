@@ -41,6 +41,14 @@ _ALLOWED_GLOBALS = {
     # framework wire-visible classes
     ("redisson_tpu.net.resp", "RespError"),
     ("redisson_tpu.net.resp", "Push"),
+    ("redisson_tpu.services.search", "SearchResult"),
+    ("redisson_tpu.services.search", "Condition"),
+    ("redisson_tpu.services.search", "Eq"),
+    ("redisson_tpu.services.search", "In"),
+    ("redisson_tpu.services.search", "Range"),
+    ("redisson_tpu.services.search", "Text"),
+    ("redisson_tpu.services.search", "And"),
+    ("redisson_tpu.services.search", "Or"),
 }
 
 _ALLOWED_BUILTINS = {

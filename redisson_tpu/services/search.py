@@ -1,0 +1,468 @@
+"""Search service: secondary indexes + queries + aggregations.
+
+Parity target: RSearch (``RedissonSearch.java``, 906 LoC — FT.CREATE /
+FT.SEARCH / FT.AGGREGATE over hashes selected by key prefix) and the
+condition tree of LiveObjectSearch (``liveobject/LiveObjectSearch.java``,
+``liveobject/condition/*``: EQ/GT/GE/LT/LE/IN/AND/OR).
+
+TPU-first design: the reference evaluates numeric predicates per-document in
+the RediSearch C module; here every NUMERIC field of an index is packed into
+one dense (docs × fields) float32 device matrix, so a numeric filter over N
+documents is a single vectorized compare-and-reduce on device — the MXU/VPU
+replaces the per-doc loop.  TEXT (tokenized words) and TAG (exact values)
+fields live in host-side inverted indexes: set intersection there is
+hash-table work the device has no advantage on; mixed queries intersect the
+host candidate set with the device numeric mask.
+
+Auto-indexing: the reference indexes every hash whose key matches a prefix.
+Here `sync()` scans matching maps through the engine store, and maps report
+into the index on write via the `document(...)`/`remove_document` hooks the
+client facade calls; `sync()` is also cheap enough to call before queries
+for read-your-writes freshness (it diffs record versions).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# -- schema ------------------------------------------------------------------
+
+
+class FieldType:
+    TEXT = "TEXT"
+    TAG = "TAG"
+    NUMERIC = "NUMERIC"
+
+
+_WORD = re.compile(r"[\w']+")
+
+
+def tokenize(text: str) -> List[str]:
+    return [w.lower() for w in _WORD.findall(str(text))]
+
+
+# -- condition tree (liveobject/condition/* analog) --------------------------
+
+
+@dataclass
+class Condition:
+    def and_(self, other: "Condition") -> "Condition":
+        return And([self, other])
+
+    def or_(self, other: "Condition") -> "Condition":
+        return Or([self, other])
+
+
+@dataclass
+class Eq(Condition):
+    field: str
+    value: Any
+
+
+@dataclass
+class In(Condition):
+    field: str
+    values: Sequence[Any]
+
+
+@dataclass
+class Range(Condition):
+    """lo <= field <= hi with open endpoints via inclusive flags."""
+
+    field: str
+    lo: float = float("-inf")
+    hi: float = float("inf")
+    lo_inc: bool = True
+    hi_inc: bool = True
+
+
+def Gt(field: str, v: float) -> Range:
+    return Range(field, lo=v, lo_inc=False)
+
+
+def Ge(field: str, v: float) -> Range:
+    return Range(field, lo=v, lo_inc=True)
+
+
+def Lt(field: str, v: float) -> Range:
+    return Range(field, hi=v, hi_inc=False)
+
+
+def Le(field: str, v: float) -> Range:
+    return Range(field, hi=v, hi_inc=True)
+
+
+@dataclass
+class Text(Condition):
+    """Full-text: all words must match (FT.SEARCH default AND semantics)."""
+
+    field: str
+    query: str
+
+
+@dataclass
+class And(Condition):
+    parts: List[Condition] = field(default_factory=list)
+
+
+@dataclass
+class Or(Condition):
+    parts: List[Condition] = field(default_factory=list)
+
+
+# -- index -------------------------------------------------------------------
+
+
+class _NumericPlane:
+    """Dense (docs × numeric-fields) matrix, device-resident lazily.
+
+    Rows are appended host-side and flushed to device in one transfer when a
+    query needs them (write-coalescing, the framework's universal trick)."""
+
+    def __init__(self, fields: List[str]):
+        self.fields = fields
+        self.col = {f: i for i, f in enumerate(fields)}
+        self.rows: List[np.ndarray] = []
+        self._device = None  # jax array cache, invalidated on append
+
+    def append(self, values: Dict[str, Any]) -> int:
+        row = np.full(len(self.fields), np.nan, np.float32)
+        for f, v in values.items():
+            if f in self.col and v is not None:
+                row[self.col[f]] = float(v)
+        self.rows.append(row)
+        self._device = None
+        return len(self.rows) - 1
+
+    def replace(self, rowid: int, values: Dict[str, Any]) -> None:
+        row = np.full(len(self.fields), np.nan, np.float32)
+        for f, v in values.items():
+            if f in self.col and v is not None:
+                row[self.col[f]] = float(v)
+        self.rows[rowid] = row
+        self._device = None
+
+    def clear_row(self, rowid: int) -> None:
+        self.rows[rowid] = np.full(len(self.fields), np.nan, np.float32)
+        self._device = None
+
+    def matrix(self):
+        import jax.numpy as jnp
+
+        if self._device is None or self._device.shape[0] != len(self.rows):
+            host = (
+                np.stack(self.rows)
+                if self.rows
+                else np.zeros((0, len(self.fields)), np.float32)
+            )
+            self._device = jnp.asarray(host)
+        return self._device
+
+    def range_mask(self, cond: Range) -> np.ndarray:
+        """One vectorized compare over all docs on device."""
+        import jax.numpy as jnp
+
+        m = self.matrix()
+        if m.shape[0] == 0 or cond.field not in self.col:
+            return np.zeros(len(self.rows), bool)
+        colv = m[:, self.col[cond.field]]
+        lo_ok = colv >= cond.lo if cond.lo_inc else colv > cond.lo
+        hi_ok = colv <= cond.hi if cond.hi_inc else colv < cond.hi
+        mask = jnp.where(jnp.isnan(colv), False, lo_ok & hi_ok)
+        return np.asarray(mask)
+
+
+class SearchIndex:
+    """One FT index: schema + doc table + inverted/tag/numeric planes."""
+
+    def __init__(self, name: str, schema: Dict[str, str], prefixes: Sequence[str] = ("",)):
+        self.name = name
+        self.schema = dict(schema)
+        self.prefixes = list(prefixes)
+        self.docs: Dict[str, Dict[str, Any]] = {}          # doc_id -> fields
+        self._rowid: Dict[str, int] = {}                   # doc_id -> numeric row
+        self._rowdoc: List[Optional[str]] = []             # row -> doc_id
+        self._text: Dict[str, Dict[str, set]] = {
+            f: {} for f, t in schema.items() if t == FieldType.TEXT
+        }                                                   # field -> word -> ids
+        self._tag: Dict[str, Dict[Any, set]] = {
+            f: {} for f, t in schema.items() if t == FieldType.TAG
+        }
+        self._numeric = _NumericPlane(
+            [f for f, t in schema.items() if t == FieldType.NUMERIC]
+        )
+        self._synced_versions: Dict[str, int] = {}          # map name -> version
+        self._lock = threading.RLock()
+
+    # -- document maintenance ------------------------------------------------
+
+    def add(self, doc_id: str, fields: Dict[str, Any]) -> None:
+        with self._lock:
+            if doc_id in self.docs:
+                self._unindex(doc_id)
+                self.docs[doc_id] = dict(fields)
+                self._index_inverted(doc_id, fields)
+                self._numeric.replace(self._rowid[doc_id], fields)
+            else:
+                self.docs[doc_id] = dict(fields)
+                self._index_inverted(doc_id, fields)
+                row = self._numeric.append(fields)
+                self._rowid[doc_id] = row
+                self._rowdoc.append(doc_id)
+
+    def remove(self, doc_id: str) -> bool:
+        with self._lock:
+            if doc_id not in self.docs:
+                return False
+            self._unindex(doc_id)
+            del self.docs[doc_id]
+            row = self._rowid.pop(doc_id)
+            self._rowdoc[row] = None
+            self._numeric.clear_row(row)
+            return True
+
+    def _index_inverted(self, doc_id: str, fields: Dict[str, Any]) -> None:
+        for f, words in self._text.items():
+            for w in tokenize(fields.get(f, "")):
+                words.setdefault(w, set()).add(doc_id)
+        for f, tags in self._tag.items():
+            v = fields.get(f)
+            if v is not None:
+                tags.setdefault(v, set()).add(doc_id)
+
+    def _unindex(self, doc_id: str) -> None:
+        old = self.docs[doc_id]
+        for f, words in self._text.items():
+            for w in tokenize(old.get(f, "")):
+                ids = words.get(w)
+                if ids is not None:
+                    ids.discard(doc_id)
+        for f, tags in self._tag.items():
+            v = old.get(f)
+            if v is not None and v in tags:
+                tags[v].discard(doc_id)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def _eval(self, cond: Optional[Condition]) -> set:
+        with self._lock:
+            if cond is None:
+                return set(self.docs)
+            return self._eval_inner(cond)
+
+    def _eval_inner(self, cond: Condition) -> set:
+        if isinstance(cond, And):
+            sets = [self._eval_inner(p) for p in cond.parts]
+            return set.intersection(*sets) if sets else set(self.docs)
+        if isinstance(cond, Or):
+            out: set = set()
+            for p in cond.parts:
+                out |= self._eval_inner(p)
+            return out
+        if isinstance(cond, Text):
+            words = tokenize(cond.query)
+            plane = self._text.get(cond.field, {})
+            sets = [plane.get(w, set()) for w in words]
+            return set.intersection(*sets) if sets else set()
+        if isinstance(cond, Eq):
+            ftype = self.schema.get(cond.field)
+            if ftype == FieldType.TAG:
+                return set(self._tag.get(cond.field, {}).get(cond.value, set()))
+            if ftype == FieldType.NUMERIC:
+                v = float(cond.value)
+                return self._mask_to_ids(self._numeric.range_mask(Range(cond.field, v, v)))
+            if ftype == FieldType.TEXT:
+                return self._eval_inner(Text(cond.field, str(cond.value)))
+            return {d for d, f in self.docs.items() if f.get(cond.field) == cond.value}
+        if isinstance(cond, In):
+            out = set()
+            for v in cond.values:
+                out |= self._eval_inner(Eq(cond.field, v))
+            return out
+        if isinstance(cond, Range):
+            return self._mask_to_ids(self._numeric.range_mask(cond))
+        raise TypeError(f"unknown condition {cond!r}")
+
+    def _mask_to_ids(self, mask: np.ndarray) -> set:
+        return {
+            self._rowdoc[i]
+            for i in np.nonzero(mask)[0]
+            if self._rowdoc[i] is not None
+        }
+
+    def __len__(self) -> int:
+        return len(self.docs)
+
+
+# -- results -----------------------------------------------------------------
+
+
+@dataclass
+class SearchResult:
+    total: int
+    docs: List[Tuple[str, Dict[str, Any]]]
+
+
+# -- service -----------------------------------------------------------------
+
+
+class SearchService:
+    """RSearch analog bound to one engine."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self._indexes: Dict[str, SearchIndex] = {}
+        self._lock = threading.Lock()
+
+    # -- FT.CREATE / DROPINDEX / _LIST ---------------------------------------
+
+    def create_index(
+        self,
+        name: str,
+        schema: Dict[str, str],
+        prefixes: Sequence[str] = ("",),
+    ) -> SearchIndex:
+        with self._lock:
+            if name in self._indexes:
+                raise ValueError(f"index '{name}' already exists")
+            idx = SearchIndex(name, schema, prefixes)
+            self._indexes[name] = idx
+        self.sync(name)
+        return idx
+
+    def create(
+        self,
+        name: str,
+        schema: Dict[str, str],
+        prefixes: Sequence[str] = ("",),
+    ) -> bool:
+        """Wire-friendly FT.CREATE (returns a plain bool so it survives the
+        OBJCALL pickle boundary; `create_index` returns the live index)."""
+        self.create_index(name, schema, prefixes)
+        return True
+
+    def drop_index(self, name: str) -> bool:
+        with self._lock:
+            return self._indexes.pop(name, None) is not None
+
+    def index_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._indexes)
+
+    def _idx(self, name: str) -> SearchIndex:
+        with self._lock:
+            idx = self._indexes.get(name)
+        if idx is None:
+            raise KeyError(f"no such index '{name}'")
+        return idx
+
+    def info(self, name: str) -> Dict[str, Any]:
+        idx = self._idx(name)
+        return {
+            "name": idx.name,
+            "num_docs": len(idx),
+            "schema": dict(idx.schema),
+            "prefixes": list(idx.prefixes),
+        }
+
+    # -- document ingestion --------------------------------------------------
+
+    def add_document(self, index: str, doc_id: str, fields: Dict[str, Any]) -> None:
+        self._idx(index).add(doc_id, fields)
+
+    def remove_document(self, index: str, doc_id: str) -> bool:
+        return self._idx(index).remove(doc_id)
+
+    def sync(self, name: str) -> int:
+        """Pull documents from every map whose name matches a prefix — the
+        reference's hash auto-indexing, done as a version-diffed scan (maps
+        whose record version is unchanged are skipped)."""
+        idx = self._idx(name)
+        from redisson_tpu.client.objects.map import Map
+
+        n = 0
+        for key in self._engine.store.keys():
+            if not any(key.startswith(p) for p in idx.prefixes):
+                continue
+            rec = self._engine.store.get(key)
+            if rec is None or rec.kind not in ("map", "map_cache"):
+                continue
+            if idx._synced_versions.get(key) == rec.version:
+                continue
+            m = Map(self._engine, key)
+            for k, v in m.read_all_entry_set():
+                if isinstance(v, dict):
+                    idx.add(f"{key}:{k}", v)
+                    n += 1
+            idx._synced_versions[key] = rec.version
+        return n
+
+    # -- FT.SEARCH -----------------------------------------------------------
+
+    def search(
+        self,
+        index: str,
+        condition: Optional[Condition] = None,
+        sort_by: Optional[str] = None,
+        descending: bool = False,
+        offset: int = 0,
+        limit: int = 10,
+    ) -> SearchResult:
+        idx = self._idx(index)
+        ids = idx._eval(condition)
+        docs = [(d, idx.docs[d]) for d in ids]
+        if sort_by is not None:
+            docs.sort(
+                key=lambda kv: (kv[1].get(sort_by) is None, kv[1].get(sort_by)),
+                reverse=descending,
+            )
+        else:
+            docs.sort(key=lambda kv: kv[0])
+        return SearchResult(total=len(docs), docs=docs[offset : offset + limit])
+
+    # -- FT.AGGREGATE ---------------------------------------------------------
+
+    _REDUCERS = {
+        "count": lambda xs: len(xs),
+        "sum": lambda xs: float(np.sum(xs)) if len(xs) else 0.0,
+        "avg": lambda xs: float(np.mean(xs)) if len(xs) else float("nan"),
+        "min": lambda xs: float(np.min(xs)) if len(xs) else float("nan"),
+        "max": lambda xs: float(np.max(xs)) if len(xs) else float("nan"),
+    }
+
+    def aggregate(
+        self,
+        index: str,
+        condition: Optional[Condition] = None,
+        group_by: Optional[str] = None,
+        reducers: Optional[Dict[str, Tuple[str, Optional[str]]]] = None,
+    ) -> List[Dict[str, Any]]:
+        """GROUPBY + REDUCE.  `reducers` maps output name -> (op, field);
+        ops: count/sum/avg/min/max (field ignored for count)."""
+        idx = self._idx(index)
+        ids = idx._eval(condition)
+        reducers = reducers or {"count": ("count", None)}
+        groups: Dict[Any, List[Dict[str, Any]]] = {}
+        for d in ids:
+            fields = idx.docs[d]
+            key = fields.get(group_by) if group_by else None
+            groups.setdefault(key, []).append(fields)
+        out = []
+        for key, members in groups.items():
+            row: Dict[str, Any] = {} if group_by is None else {group_by: key}
+            for out_name, (op, f) in reducers.items():
+                if op == "count":
+                    row[out_name] = len(members)
+                else:
+                    xs = np.asarray(
+                        [float(m[f]) for m in members if m.get(f) is not None],
+                        np.float64,
+                    )
+                    row[out_name] = self._REDUCERS[op](xs)
+            out.append(row)
+        out.sort(key=lambda r: (str(r.get(group_by)) if group_by else ""))
+        return out

@@ -1,0 +1,382 @@
+"""Script/Function services, Search service, Nodes admin API.
+
+Parity seams: RedissonScript (EVAL/EVALSHA + NOSCRIPT fallback,
+CommandAsyncService.java:400-512), RedissonFuction (FUNCTION LOAD/FCALL),
+RedissonSearch (FT.CREATE/SEARCH/AGGREGATE), redisnode/* (PING/INFO/TIME).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.client.redisson import RedissonTpu
+from redisson_tpu.services.script import NoScriptError, sha1_of
+from redisson_tpu.services.search import (
+    And,
+    Eq,
+    FieldType,
+    Ge,
+    Gt,
+    In,
+    Le,
+    Lt,
+    Or,
+    Range,
+    Text,
+)
+
+
+@pytest.fixture()
+def client():
+    c = RedissonTpu.create()
+    yield c
+    c.shutdown()
+
+
+# -- scripts -----------------------------------------------------------------
+
+
+def _transfer(ctx, keys, args):
+    """Move `amount` between two atomic longs iff funds suffice."""
+    src, dst = ctx.get_atomic_long(keys[0]), ctx.get_atomic_long(keys[1])
+    amount = args[0]
+    if src.get() < amount:
+        return False
+    src.add_and_get(-amount)
+    dst.add_and_get(amount)
+    return True
+
+
+def test_eval_atomic_transfer(client):
+    client.get_atomic_long("acct:a").set(100)
+    client.get_atomic_long("acct:b").set(0)
+    s = client.get_script()
+    assert s.eval(_transfer, ["acct:a", "acct:b"], [30]) is True
+    assert client.get_atomic_long("acct:a").get() == 70
+    assert client.get_atomic_long("acct:b").get() == 30
+    assert s.eval(_transfer, ["acct:a", "acct:b"], [1000]) is False
+
+
+def test_script_load_and_eval_sha(client):
+    s = client.get_script()
+    sha = s.script_load(_transfer)
+    assert sha == sha1_of(_transfer)
+    assert s.script_exists(sha) == [True]
+    assert s.script_exists("0" * 40) == [False]
+    client.get_atomic_long("acct:x").set(5)
+    client.get_atomic_long("acct:y").set(0)
+    assert s.eval_sha(sha, ["acct:x", "acct:y"], [5]) is True
+    with pytest.raises(NoScriptError):
+        s.eval_sha("f" * 40)
+    s.script_flush()
+    assert s.script_exists(sha) == [False]
+
+
+def test_eval_with_cache_noscript_fallback(client):
+    """EVAL→EVALSHA: first call loads, second hits the cache."""
+    s = client.get_script()
+    sha = sha1_of(_transfer)
+    assert s.script_exists(sha) == [False]
+    client.get_atomic_long("acct:m").set(10)
+    client.get_atomic_long("acct:n").set(0)
+    assert s.eval_with_cache(_transfer, ["acct:m", "acct:n"], [10]) is True
+    assert s.script_exists(sha) == [True]  # loaded by the fallback
+
+
+def test_script_cache_shared_across_handles(client):
+    sha = client.get_script().script_load(_transfer)
+    assert client.get_script().script_exists(sha) == [True]
+
+
+def test_script_atomicity_under_contention(client):
+    """Concurrent transfers must conserve the total (Lua-equivalent)."""
+    client.get_atomic_long("bank:a").set(1000)
+    client.get_atomic_long("bank:b").set(1000)
+    s = client.get_script()
+
+    def worker(src, dst):
+        for _ in range(100):
+            s.eval(_transfer, [src, dst], [1])
+
+    ts = [
+        threading.Thread(target=worker, args=("bank:a", "bank:b")),
+        threading.Thread(target=worker, args=("bank:b", "bank:a")),
+        threading.Thread(target=worker, args=("bank:a", "bank:b")),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    total = client.get_atomic_long("bank:a").get() + client.get_atomic_long("bank:b").get()
+    assert total == 2000
+
+
+def test_function_library(client):
+    f = client.get_function()
+    f.load("mylib", {"bump": lambda ctx, keys, args: ctx.get_atomic_long(keys[0]).add_and_get(args[0])})
+    assert f.call("bump", ["fn:c"], [7]) == 7
+    assert f.call("bump", ["fn:c"], [3]) == 10
+    assert "mylib" in f.list()
+    with pytest.raises(ValueError):
+        f.load("mylib", {})
+    f.load("mylib", {"noop": lambda ctx, keys, args: None}, replace=True)
+    with pytest.raises(KeyError):
+        f.call("bump")  # replaced away
+    assert f.unload("mylib") is True
+    assert f.unload("mylib") is False
+
+
+# -- search ------------------------------------------------------------------
+
+
+SCHEMA = {
+    "title": FieldType.TEXT,
+    "category": FieldType.TAG,
+    "price": FieldType.NUMERIC,
+    "stock": FieldType.NUMERIC,
+}
+
+
+def _products(search):
+    search.create_index("idx:prod", SCHEMA, prefixes=["prod:"])
+    docs = [
+        ("p1", {"title": "red widget deluxe", "category": "widgets", "price": 9.5, "stock": 3}),
+        ("p2", {"title": "blue widget", "category": "widgets", "price": 12.0, "stock": 0}),
+        ("p3", {"title": "green gadget", "category": "gadgets", "price": 7.25, "stock": 10}),
+        ("p4", {"title": "red gadget pro", "category": "gadgets", "price": 30.0, "stock": 2}),
+        ("p5", {"title": "widget refill pack", "category": "parts", "price": 2.0, "stock": 99}),
+    ]
+    for d, f in docs:
+        search.add_document("idx:prod", d, f)
+    return docs
+
+
+def test_search_text_and(client):
+    s = client.get_search()
+    _products(s)
+    r = s.search("idx:prod", Text("title", "red widget"))
+    assert [d for d, _ in r.docs] == ["p1"]
+    r = s.search("idx:prod", Text("title", "widget"))
+    assert {d for d, _ in r.docs} == {"p1", "p2", "p5"}
+
+
+def test_search_tag_and_numeric_range(client):
+    s = client.get_search()
+    _products(s)
+    r = s.search("idx:prod", And([Eq("category", "gadgets"), Lt("price", 20)]))
+    assert [d for d, _ in r.docs] == ["p3"]
+    r = s.search("idx:prod", Range("price", 7, 12))
+    assert {d for d, _ in r.docs} == {"p1", "p2", "p3"}
+    r = s.search("idx:prod", Gt("stock", 0))
+    assert {d for d, _ in r.docs} == {"p1", "p3", "p4", "p5"}
+
+
+def test_search_or_in_conditions(client):
+    s = client.get_search()
+    _products(s)
+    r = s.search("idx:prod", Or([Eq("category", "parts"), Ge("price", 30)]))
+    assert {d for d, _ in r.docs} == {"p4", "p5"}
+    r = s.search("idx:prod", In("category", ["widgets", "parts"]))
+    assert {d for d, _ in r.docs} == {"p1", "p2", "p5"}
+
+
+def test_search_sort_and_paging(client):
+    s = client.get_search()
+    _products(s)
+    r = s.search("idx:prod", sort_by="price", limit=2)
+    assert [d for d, _ in r.docs] == ["p5", "p3"]
+    assert r.total == 5
+    r2 = s.search("idx:prod", sort_by="price", offset=2, limit=2)
+    assert [d for d, _ in r2.docs] == ["p1", "p2"]
+    r3 = s.search("idx:prod", sort_by="price", descending=True, limit=1)
+    assert [d for d, _ in r3.docs] == ["p4"]
+
+
+def test_search_update_and_remove_document(client):
+    s = client.get_search()
+    _products(s)
+    s.add_document("idx:prod", "p2", {"title": "blue widget v2", "category": "widgets", "price": 11.0, "stock": 5})
+    r = s.search("idx:prod", Text("title", "v2"))
+    assert [d for d, _ in r.docs] == ["p2"]
+    assert s.search("idx:prod", Eq("price", 12.0)).total == 0
+    assert s.remove_document("idx:prod", "p2") is True
+    assert s.search("idx:prod", Text("title", "widget")).total == 2
+    assert s.remove_document("idx:prod", "p2") is False
+
+
+def test_search_aggregate(client):
+    s = client.get_search()
+    _products(s)
+    rows = s.aggregate(
+        "idx:prod",
+        group_by="category",
+        reducers={"n": ("count", None), "avg_price": ("avg", "price"), "max_price": ("max", "price")},
+    )
+    by_cat = {r["category"]: r for r in rows}
+    assert by_cat["widgets"]["n"] == 2
+    assert by_cat["widgets"]["avg_price"] == pytest.approx(10.75)
+    assert by_cat["gadgets"]["max_price"] == 30.0
+    total = s.aggregate("idx:prod", reducers={"sum_stock": ("sum", "stock")})
+    assert total[0]["sum_stock"] == 114
+
+
+def test_search_sync_from_maps(client):
+    s = client.get_search()
+    s.create_index("idx:users", {"name": FieldType.TEXT, "age": FieldType.NUMERIC}, prefixes=["users:"])
+    m = client.get_map("users:eu")
+    m.put("u1", {"name": "ada lovelace", "age": 36})
+    m.put("u2", {"name": "alan turing", "age": 41})
+    client.get_map("other:na").put("u3", {"name": "nope", "age": 99})
+    n = s.sync("idx:users")
+    assert n == 2
+    assert s.search("idx:users", Text("name", "ada")).total == 1
+    assert s.search("idx:users", Gt("age", 40)).total == 1
+    # unchanged map -> version-diffed scan skips it
+    assert s.sync("idx:users") == 0
+    m.put("u4", {"name": "grace hopper", "age": 46})
+    assert s.sync("idx:users") >= 1
+
+
+def test_search_index_lifecycle(client):
+    s = client.get_search()
+    assert s.create("idx:a", {"x": FieldType.NUMERIC}) is True
+    with pytest.raises(ValueError):
+        s.create_index("idx:a", {})
+    assert "idx:a" in s.index_names()
+    info = s.info("idx:a")
+    assert info["num_docs"] == 0 and info["schema"] == {"x": FieldType.NUMERIC}
+    assert s.drop_index("idx:a") is True
+    with pytest.raises(KeyError):
+        s.search("idx:a")
+
+
+def test_search_scales_vectorized(client):
+    """Numeric filtering is one device op over all docs — sanity at 20k."""
+    s = client.get_search()
+    s.create_index("idx:big", {"v": FieldType.NUMERIC})
+    rng = np.random.default_rng(0)
+    vals = rng.uniform(0, 100, 20_000)
+    for i, v in enumerate(vals):
+        s.add_document("idx:big", f"d{i}", {"v": float(v)})
+    r = s.search("idx:big", Range("v", 10, 20), limit=30_000)
+    expected = int(((vals >= 10) & (vals <= 20)).sum())
+    assert r.total == expected
+
+
+# -- nodes -------------------------------------------------------------------
+
+
+def test_embedded_nodes_group(client):
+    ng = client.get_nodes_group()
+    assert len(ng) >= 1
+    assert ng.ping_all()
+    node = ng.nodes()[0]
+    info = node.info()
+    assert info["keys"] >= 0 and "platform" in info
+    assert ng.node(node.id) is node
+    assert ng.node("nope:999") is None
+    assert node.time() > 0
+
+
+def test_remote_nodes_group(client):
+    from redisson_tpu.client.nodes import NodesGroup
+    from redisson_tpu.net.client import NodeClient
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(engine=client.engine, port=0) as st:
+        nc = NodeClient(st.address)
+        ng = NodesGroup.remote(nc)
+        assert ng.ping_all()
+        n = ng.nodes()[0]
+        assert n.time() > 0
+        info = n.info()
+        assert isinstance(info, dict) and info
+        mem = n.memory()
+        assert isinstance(mem, dict)
+        nc.close()
+
+
+# -- review regressions ------------------------------------------------------
+
+
+def test_engine_service_singleton_thread_safe(client):
+    import threading
+
+    results = []
+    barrier = threading.Barrier(8)
+
+    def grab():
+        barrier.wait()
+        results.append(client.get_script())
+
+    ts = [threading.Thread(target=grab) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert all(r is results[0] for r in results)
+
+
+def test_jcache_replace_preserves_ttl(client):
+    from redisson_tpu.client.jcache import CacheConfig, ExpiryPolicy
+
+    cm = client.get_cache_manager()
+    cache = cm.create_cache("crepl", CacheConfig(expiry=ExpiryPolicy.created(0.12)))
+    cache.put("k", 1)
+    time.sleep(0.05)
+    assert cache.replace("k", 2) is True      # must NOT wipe or re-arm the TTL
+    assert cache.get_and_replace("k", 3) == 2
+    time.sleep(0.1)                           # ~0.15s since creation
+    assert cache.get("k") is None
+    assert cache.replace("missing", 1) is False
+
+
+def test_jcache_statistics_disabled(client):
+    from redisson_tpu.client.jcache import CacheConfig
+
+    cm = client.get_cache_manager()
+    cache = cm.create_cache("cnostat", CacheConfig(statistics_enabled=False))
+    cache.put("a", 1)
+    cache.get("a")
+    cache.remove("a")
+    st = cache.statistics
+    assert st.puts == 0 and st.hits == 0 and st.removals == 0
+
+
+def test_jcache_remove_all_counts(client):
+    cm = client.get_cache_manager()
+    cache = cm.create_cache("crm")
+    cache.put_all({"a": 1, "b": 2, "c": 3})
+    cache.remove_all(["a", "b"])
+    assert cache.statistics.removals == 2
+    cache.remove_all()
+    assert cache.statistics.removals == 3
+
+
+def test_eviction_task_dropped_when_record_deleted(client):
+    client.engine.eviction.min_delay = 0.02
+    client.engine.eviction.start_delay = 0.02
+    mc = client.get_map_cache("drop:mc")
+    mc.put("k", "v")
+    ev = client.engine.eviction
+    assert "drop:mc" in ev._tasks
+    # let at least one sweep observe the record existing — only a record that
+    # has been seen alive is dropped on deletion (never-created names persist)
+    first = ev.sweeps
+    deadline = time.time() + 5
+    while ev.sweeps < first + 2 and time.time() < deadline:
+        time.sleep(0.02)
+    client.engine.store.delete("drop:mc")
+    deadline = time.time() + 5
+    while "drop:mc" in client.engine.eviction._tasks and time.time() < deadline:
+        time.sleep(0.02)
+    assert "drop:mc" not in client.engine.eviction._tasks
+
+
+def test_localcache_no_double_broadcast_on_fast_put_if_absent(client):
+    msgs = []
+    client.engine.pubsub.subscribe("redisson_local_cache:lc:dup", lambda c, m: msgs.append(m))
+    m = client.get_local_cached_map("lc:dup")
+    assert m.fast_put_if_absent("k", 1) is True
+    assert len(msgs) == 1
