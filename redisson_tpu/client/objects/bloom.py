@@ -109,7 +109,28 @@ class BloomFilter(RExpirable):
     def add_all(self, objs) -> int:
         """Batch add; returns the number of (probably) new elements
         (RedissonBloomFilter.java:105-137 contract)."""
-        return int(self.add_each(objs).sum())
+        return int(self.add_all_async(objs))
+
+    def add_all_async(self, objs):
+        """Pipelined add: newly-added count as a DEVICE scalar (4-byte result
+        path, no host sync) — streaming writers dispatch flush after flush and
+        only the final int() waits."""
+        kind, arrays, n = self._engine.pack_keys(objs, self._codec)
+        if n == 0:
+            return np.int32(0)
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            m, k = rec.meta["m"], rec.meta["k"]
+            bits = rec.arrays["bits"]
+            if kind == "u64":
+                bits, count = K.bloom_add_packed_count(bits, arrays, n, k, m)
+            else:
+                words, nbytes = arrays
+                bits, newly = K.bloom_add_bytes_masked(bits, words, nbytes, n, k, m)
+                count = newly.astype(np.int32).sum()
+            rec.arrays["bits"] = bits
+            self._touch_version(rec)
+        return count
 
     def add_each(self, objs) -> np.ndarray:
         """Batch add; returns a per-key "was newly added" bool array aligned
@@ -122,8 +143,7 @@ class BloomFilter(RExpirable):
             m, k = rec.meta["m"], rec.meta["k"]
             bits = rec.arrays["bits"]
             if kind == "u64":
-                lo, hi = arrays
-                bits, newly = K.bloom_add_u64_masked(bits, lo, hi, n, k, m)
+                bits, newly = K.bloom_add_packed(bits, arrays, n, k, m)
             else:
                 words, nbytes = arrays
                 bits, newly = K.bloom_add_bytes_masked(bits, words, nbytes, n, k, m)
@@ -138,9 +158,21 @@ class BloomFilter(RExpirable):
 
     def contains_each(self, objs) -> np.ndarray:
         """Vectorized membership: bool array aligned with objs."""
+        found, n = self.contains_each_async(objs)
+        arr = np.asarray(found)
+        if arr.dtype == np.uint32:  # packed-bitmap fast path (u64 keys)
+            return K.unpack_found(arr, n)
+        return arr[:n]
+
+    def contains_each_async(self, objs):
+        """Pipelined membership with no host sync — the RBatch executeAsync
+        analog (keep several flushes in flight, force later; see
+        BloomFilterArray.contains_async).  For integer-key batches the result
+        is a device uint32 bitmap (decode with kernels.unpack_found); for
+        codec-encoded keys it is a device bool array."""
         kind, arrays, n = self._engine.pack_keys(objs, self._codec)
         if n == 0:
-            return np.zeros((0,), bool)
+            return np.zeros((0,), np.uint32), 0
         # Dispatch under the record lock: a concurrent add() donates the bit
         # plane, which would invalidate the buffer between our read of
         # rec.arrays and the kernel call.  The device-side result fetch
@@ -150,12 +182,11 @@ class BloomFilter(RExpirable):
             m, k = rec.meta["m"], rec.meta["k"]
             bits = rec.arrays["bits"]
             if kind == "u64":
-                lo, hi = arrays
-                found = K.bloom_contains_u64_masked(bits, lo, hi, n, k, m)
+                found = K.bloom_contains_packed_bits(bits, arrays, n, k, m)
             else:
                 words, nbytes = arrays
                 found = K.bloom_contains_bytes_masked(bits, words, nbytes, n, k, m)
-        return np.asarray(found)[:n]
+        return found, n
 
     def count_contains(self, objs) -> int:
         """Number of objs (probably) present — reference contains(Collection)."""

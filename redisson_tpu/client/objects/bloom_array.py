@@ -77,6 +77,10 @@ class BloomFilterArray(RExpirable):
         return self._rec().meta["k"]
 
     def _pack(self, tenant_ids, keys):
+        """One flush -> ONE contiguous (3, B) uint32 transfer buffer
+        (rows: tenant, key-lo, key-hi).  The host->device copy dominates a
+        flush's cost on a tunneled chip, and one large transfer runs ~3x the
+        bandwidth of three small ones (core/kernels.py pack_rows note)."""
         t = np.ascontiguousarray(tenant_ids, np.int32)
         if not self._engine.is_int_batch(keys):
             raise TypeError(
@@ -87,19 +91,19 @@ class BloomFilterArray(RExpirable):
         if t.shape != arr.shape:
             raise ValueError("tenant_ids and keys must be aligned 1-D arrays")
         n = arr.shape[0]
-        b = K.pow2_bucket(max(1, n))
+        b = K.bucket_size(max(1, n))
         lo, hi = H.int_keys_to_u32_pair(arr)
-        return K.pad_to(t, b), K.pad_to(lo, b), K.pad_to(hi, b), n
+        return K.pack_rows(t, lo, hi, size=b), n
 
     def add_each(self, tenant_ids, keys) -> np.ndarray:
         """Batch add across tenants; bool array: element was (probably) new."""
-        t, lo, hi, n = self._pack(tenant_ids, keys)
+        tlh, n = self._pack(tenant_ids, keys)
         if n == 0:
             return np.zeros((0,), bool)
         with self._engine.locked(self._name):
             rec = self._rec()
-            bits, newly = K.bloom_bank_add_u64(
-                rec.arrays["bits"], t, lo, hi, n, rec.meta["k"], rec.meta["m"]
+            bits, newly = K.bloom_bank_add_packed(
+                rec.arrays["bits"], tlh, n, rec.meta["k"], rec.meta["m"]
             )
             rec.arrays["bits"] = bits
             self._touch_version(rec)
@@ -107,25 +111,44 @@ class BloomFilterArray(RExpirable):
 
     def add(self, tenant_ids, keys) -> int:
         """Batch add across tenants; returns # of (probably) new elements."""
-        return int(self.add_each(tenant_ids, keys).sum())
+        return int(self.add_async(tenant_ids, keys))
+
+    def add_async(self, tenant_ids, keys):
+        """Pipelined add: returns the newly-added count as a DEVICE scalar
+        without forcing a host sync — streaming writers dispatch flush after
+        flush and only the final int() conversion waits."""
+        tlh, n = self._pack(tenant_ids, keys)
+        if n == 0:
+            return np.int32(0)
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            bits, count = K.bloom_bank_add_packed_count(
+                rec.arrays["bits"], tlh, n, rec.meta["k"], rec.meta["m"]
+            )
+            rec.arrays["bits"] = bits
+            self._touch_version(rec)
+        return count
 
     def contains(self, tenant_ids, keys) -> np.ndarray:
         """Vectorized membership across tenants: bool array aligned with keys."""
-        found, n = self.contains_async(tenant_ids, keys)
-        return np.asarray(found)[:n]
+        packed, n = self.contains_async(tenant_ids, keys)
+        return K.unpack_found(np.asarray(packed), n)
 
     def contains_async(self, tenant_ids, keys):
-        """Pipelined variant: returns (device bool array, n_valid) WITHOUT
-        forcing the device->host transfer — callers keep several flushes in
-        flight and force later (the executeAsync analog of RBatch;
-        dispatches overlap so tunnel/dispatch latency amortizes away)."""
-        t, lo, hi, n = self._pack(tenant_ids, keys)
+        """Pipelined variant: returns (device uint32 result bitmap, n_valid)
+        WITHOUT forcing the device->host transfer — callers keep several
+        flushes in flight, force later (jax.device_get / np.asarray), and
+        decode with kernels.unpack_found(bitmap, n).  Results travel as
+        bitmaps because B bool bytes per flush dominate the d2h path (the
+        executeAsync analog of RBatch; dispatches overlap so tunnel/dispatch
+        latency amortizes away)."""
+        tlh, n = self._pack(tenant_ids, keys)
         if n == 0:
-            return np.zeros((0,), bool), 0
+            return np.zeros((0,), np.uint32), 0
         with self._engine.locked(self._name):
             rec = self._rec()
-            found = K.bloom_bank_contains_u64(
-                rec.arrays["bits"], t, lo, hi, n, rec.meta["k"], rec.meta["m"]
+            found = K.bloom_bank_contains_packed_bits(
+                rec.arrays["bits"], tlh, n, rec.meta["k"], rec.meta["m"]
             )
         return found, n
 

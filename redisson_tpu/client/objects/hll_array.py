@@ -56,12 +56,12 @@ class HyperLogLogArray(RExpirable):
         n = arr.shape[0]
         if n == 0:
             return
-        b = K.pow2_bucket(n)
+        b = K.bucket_size(n)
         lo, hi = H.int_keys_to_u32_pair(arr)
-        t, lo, hi = K.pad_to(t, b), K.pad_to(lo, b), K.pad_to(hi, b)
+        tlh = K.pack_rows(t, lo, hi, size=b)  # one contiguous transfer buffer
         with self._engine.locked(self._name):
             rec = self._rec()
-            rec.arrays["regs"] = K.hll_bank_add_u64(rec.arrays["regs"], t, lo, hi, n, rec.meta["p"])
+            rec.arrays["regs"] = K.hll_bank_add_packed(rec.arrays["regs"], tlh, n, rec.meta["p"])
             self._touch_version(rec)
 
     def merge_rows(self, dst_ids, src_ids) -> None:

@@ -177,8 +177,7 @@ def _bloom_add(engine, group, ops):
         rec = bf._rec()
         m, k = rec.meta["m"], rec.meta["k"]
         if kind == "u64":
-            lo, hi = arrays
-            bits, newly = K.bloom_add_u64_masked(rec.arrays["bits"], lo, hi, n, k, m)
+            bits, newly = K.bloom_add_packed(rec.arrays["bits"], arrays, n, k, m)
         else:
             words, nbytes = arrays
             bits, newly = K.bloom_add_bytes_masked(rec.arrays["bits"], words, nbytes, n, k, m)

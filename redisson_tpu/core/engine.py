@@ -144,7 +144,9 @@ class Engine:
         """Normalize a key batch for the hash kernels.
 
         Returns (kind, padded_arrays, n_valid):
-          kind="u64":   arrays = (lo, hi) uint32, padded to a pow2 bucket
+          kind="u64":   arrays = ONE (2, B) uint32 buffer (rows lo, hi) — a
+                        single contiguous host->device transfer per flush
+                        (kernels.pack_rows bandwidth note)
           kind="bytes": arrays = (words[W,N], nbytes[N]) padded on both axes
 
         Fast path: numpy integer arrays are hashed as int64 directly (no codec
@@ -156,9 +158,9 @@ class Engine:
         if self.is_int_batch(objs):
             arr = np.ascontiguousarray(objs, dtype=np.int64)
             n = arr.shape[0]
-            b = K.pow2_bucket(max(1, n))
+            b = K.bucket_size(max(1, n))
             lo, hi = H.int_keys_to_u32_pair(arr)
-            return "u64", (K.pad_to(lo, b), K.pad_to(hi, b)), n
+            return "u64", K.pack_rows(lo, hi, size=b), n
         if isinstance(objs, (bytes, str, int, float)) or not isinstance(objs, (list, tuple, np.ndarray)):
             objs = [objs]
         encoded = [o if isinstance(o, bytes) else codec.encode(o) for o in objs]

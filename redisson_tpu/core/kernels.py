@@ -38,6 +38,21 @@ def pow2_bucket(n: int, minimum: int = MIN_BUCKET) -> int:
     return b
 
 
+def bucket_size(n: int, minimum: int = MIN_BUCKET) -> int:
+    """Padded batch size for the transfer-bound fast paths.
+
+    Pow2 bucketing wastes up to 2x of host->device bandwidth on padding (the
+    dominant cost of a flush over a tunneled chip — measured ~230MB/s vs ~50us
+    of kernel).  This uses 1/8-octave steps instead: next multiple of
+    (next_pow2(n) / 8) — at most 12.5% padding, at most 8 compiled programs
+    per octave in the jit cache.
+    """
+    if n <= minimum:
+        return minimum
+    step = max(minimum, (1 << (int(n - 1).bit_length())) >> 3)
+    return ((n + step - 1) // step) * step
+
+
 def pad_to(arr: np.ndarray, size: int, axis: int = 0) -> np.ndarray:
     """Zero-pad `arr` along `axis` up to `size`."""
     if arr.shape[axis] == size:
@@ -57,8 +72,7 @@ def _valid_mask(n: int, n_valid) -> jax.Array:
 # RedissonBloomFilter.java:105-196 (k*N SETBIT/GETBIT per RBatch flush).
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=(4, 5), donate_argnums=(0,))
-def bloom_add_u64_masked(bits, lo, hi, n_valid, k: int, m: int):
+def _bloom_add_body(bits, lo, hi, n_valid, k: int, m: int):
     h1, h2 = H.hash_u64_pair(lo, hi, jnp)
     idx = H.bloom_indexes(h1, h2, k, m, jnp)
     mask = _valid_mask(lo.shape[0], n_valid)
@@ -69,12 +83,14 @@ def bloom_add_u64_masked(bits, lo, hi, n_valid, k: int, m: int):
     return new_bits, newly & mask
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5))
-def bloom_contains_u64_masked(bits, lo, hi, n_valid, k: int, m: int):
+def _bloom_contains_body(bits, lo, hi, n_valid, k: int, m: int):
     h1, h2 = H.hash_u64_pair(lo, hi, jnp)
     idx = H.bloom_indexes(h1, h2, k, m, jnp)
-    found = bt.contains(bits, idx)
-    return found & _valid_mask(lo.shape[0], n_valid)
+    return bt.contains(bits, idx) & _valid_mask(lo.shape[0], n_valid)
+
+
+bloom_add_u64_masked = jax.jit(_bloom_add_body, static_argnums=(4, 5), donate_argnums=(0,))
+bloom_contains_u64_masked = jax.jit(_bloom_contains_body, static_argnums=(4, 5))
 
 
 @functools.partial(jax.jit, static_argnums=(4, 5), donate_argnums=(0,))
@@ -105,8 +121,7 @@ def bloom_contains_bytes_masked(bits, words, nbytes, n_valid, k: int, m: int):
 
 BANK_MAX_CELLS = 2**31 - 2048  # int32 flat-index space minus sentinel headroom
 
-@functools.partial(jax.jit, static_argnums=(5, 6), donate_argnums=(0,))
-def bloom_bank_add_u64(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
+def _bloom_bank_add_body(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
     h1, h2 = H.hash_u64_pair(lo, hi, jnp)
     idx = H.bloom_indexes(h1, h2, k, m, jnp)
     mask = _valid_mask(lo.shape[0], n_valid)
@@ -119,8 +134,7 @@ def bloom_bank_add_u64(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
     return new_flat.reshape(bits2d.shape), newly
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6))
-def bloom_bank_contains_u64(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
+def _bloom_bank_contains_body(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
     h1, h2 = H.hash_u64_pair(lo, hi, jnp)
     idx = H.bloom_indexes(h1, h2, k, m, jnp)
     g = tenant[:, None] * m + idx
@@ -128,21 +142,114 @@ def bloom_bank_contains_u64(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
     return jnp.all(got != 0, axis=-1) & _valid_mask(lo.shape[0], n_valid)
 
 
+bloom_bank_add_u64 = jax.jit(_bloom_bank_add_body, static_argnums=(5, 6), donate_argnums=(0,))
+bloom_bank_contains_u64 = jax.jit(_bloom_bank_contains_body, static_argnums=(5, 6))
+
+
+# --- packed-row variants ----------------------------------------------------
+# One flush = ONE contiguous uint32 buffer (rows: tenant?, lo, hi) = ONE
+# host->device transfer.  Three separate device_puts of ~0.5MB each run at
+# ~1/3 the tunnel bandwidth of a single 1.5MB transfer (measured), and the
+# transfer IS the cost of a flush — the kernels below are identical math to
+# their unpacked forms, they only change the wire layout.
+
+
+def pack_rows(*arrays, size: int) -> np.ndarray:
+    """Host side: stack 1-D arrays into one (R, size) uint32 transfer buffer."""
+    out = np.zeros((len(arrays), size), np.uint32)
+    for i, a in enumerate(arrays):
+        out[i, : a.shape[0]] = a.view(np.uint32) if a.dtype == np.int32 else a
+    return out
+
+
+def _unpack_tlh(tlh):
+    return tlh[0].astype(jnp.int32), tlh[1], tlh[2]
+
+
+def _bloom_bank_add_packed(bits2d, tlh, n_valid, k: int, m: int):
+    tenant, lo, hi = _unpack_tlh(tlh)
+    return _bloom_bank_add_body(bits2d, tenant, lo, hi, n_valid, k, m)
+
+
+bloom_bank_add_packed = jax.jit(
+    _bloom_bank_add_packed, static_argnums=(3, 4), donate_argnums=(0,)
+)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4), donate_argnums=(0,))
+def bloom_bank_add_packed_count(bits2d, tlh, n_valid, k: int, m: int):
+    """Add variant returning only the newly-added COUNT — a 4-byte device
+    scalar instead of a B-byte bool plane on the result path."""
+    bits, newly = _bloom_bank_add_packed(bits2d, tlh, n_valid, k, m)
+    return bits, jnp.sum(newly.astype(jnp.int32))
+
+
+def _pack_bool_u32(found):
+    """Device side: bool[B] -> uint32[B/32] little-bit-order bitmap.  The
+    result path of a contains flush is B bool bytes otherwise — on a tunneled
+    chip small d2h transfers cost ~20ms each, so results travel as bitmaps
+    (64x fewer bytes) and unpack host-side (unpack_found)."""
+    w = found.reshape(-1, 32).astype(jnp.uint32)
+    return (w << jnp.arange(32, dtype=jnp.uint32)[None, :]).sum(axis=1, dtype=jnp.uint32)
+
+
+def unpack_found(packed, n: int) -> np.ndarray:
+    """Host side: uint32 bitmap (from _pack_bool_u32) -> bool[n]."""
+    b = np.unpackbits(np.ascontiguousarray(packed).view(np.uint8), bitorder="little")
+    return b[:n].astype(bool)
+
+
+def _bloom_bank_contains_impl(bits2d, tlh, n_valid, k: int, m: int):
+    tenant, lo, hi = _unpack_tlh(tlh)
+    return _bloom_bank_contains_body(bits2d, tenant, lo, hi, n_valid, k, m)
+
+
+bloom_bank_contains_packed = jax.jit(_bloom_bank_contains_impl, static_argnums=(3, 4))
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def bloom_bank_contains_packed_bits(bits2d, tlh, n_valid, k: int, m: int):
+    return _pack_bool_u32(_bloom_bank_contains_impl(bits2d, tlh, n_valid, k, m))
+
+
+def _bloom_add_packed(bits, lh, n_valid, k: int, m: int):
+    return _bloom_add_body(bits, lh[0], lh[1], n_valid, k, m)
+
+
+bloom_add_packed = jax.jit(_bloom_add_packed, static_argnums=(3, 4), donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4), donate_argnums=(0,))
+def bloom_add_packed_count(bits, lh, n_valid, k: int, m: int):
+    new_bits, newly = _bloom_add_packed(bits, lh, n_valid, k, m)
+    return new_bits, jnp.sum(newly.astype(jnp.int32))
+
+
+def _bloom_contains_impl(bits, lh, n_valid, k: int, m: int):
+    return _bloom_contains_body(bits, lh[0], lh[1], n_valid, k, m)
+
+
+bloom_contains_packed = jax.jit(_bloom_contains_impl, static_argnums=(3, 4))
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def bloom_contains_packed_bits(bits, lh, n_valid, k: int, m: int):
+    return _pack_bool_u32(_bloom_contains_impl(bits, lh, n_valid, k, m))
+
+
 # --------------------------------------------------------------------------
 # HLL kernels (replaces server-side PFADD/PFMERGE/PFCOUNT,
 # RedissonHyperLogLog.java:71-102).
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=(4,), donate_argnums=(0,))
-def hll_add_u64(regs, lo, hi, n_valid, p: int):
+def _hll_add_body(regs, lo, hi, n_valid, p: int):
     h1, h2 = H.hash_u64_pair(lo, hi, jnp)
     idx, rho = hll_ops.idx_rho(h1, h2, p)
     idx = jnp.where(_valid_mask(lo.shape[0], n_valid), idx, regs.shape[-1])
     return hll_ops.add(regs, idx, rho)
 
 
-@functools.partial(jax.jit, static_argnums=(5,), donate_argnums=(0,))
-def hll_bank_add_u64(regs2d, tenant, lo, hi, n_valid, p: int):
+def _hll_bank_add_body(regs2d, tenant, lo, hi, n_valid, p: int):
     h1, h2 = H.hash_u64_pair(lo, hi, jnp)
     idx, rho = hll_ops.idx_rho(h1, h2, p)
     m = regs2d.shape[1]
@@ -151,6 +258,10 @@ def hll_bank_add_u64(regs2d, tenant, lo, hi, n_valid, p: int):
     g = jnp.where(mask, tenant * m + idx, size)  # flat fast path (see bloom bank)
     new_flat = regs2d.reshape(-1).at[g].max(rho, mode="drop")
     return new_flat.reshape(regs2d.shape)
+
+
+hll_add_u64 = jax.jit(_hll_add_body, static_argnums=(4,), donate_argnums=(0,))
+hll_bank_add_u64 = jax.jit(_hll_bank_add_body, static_argnums=(5,), donate_argnums=(0,))
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -162,6 +273,17 @@ def hll_bank_merge_rows(regs2d, dst, src, n_valid):
     dsafe = jnp.where(mask, dst, regs2d.shape[0])
     ssafe = jnp.clip(src, 0, regs2d.shape[0] - 1)
     return regs2d.at[dsafe].max(regs2d[ssafe], mode="drop")
+
+
+@functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
+def hll_bank_add_packed(regs2d, tlh, n_valid, p: int):
+    tenant, lo, hi = _unpack_tlh(tlh)
+    return _hll_bank_add_body(regs2d, tenant, lo, hi, n_valid, p)
+
+
+@functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
+def hll_add_packed(regs, lh, n_valid, p: int):
+    return _hll_add_body(regs, lh[0], lh[1], n_valid, p)
 
 
 @functools.partial(jax.jit, static_argnums=(4,), donate_argnums=(0,))
