@@ -155,6 +155,75 @@ def bench_config3_hll(client):
     return add_rate, merge_rate
 
 
+def bench_config4_mapreduce(client):
+    """Word-count over a 1M-entry map, 64 mapper tasks (config 4)."""
+    from redisson_tpu.services.mapreduce import word_count
+
+    m = client.get_map("bench:wc")
+    rng = np.random.default_rng(3)
+    vocab = [f"w{i}" for i in range(1000)]
+    entries = {
+        f"doc-{i}": " ".join(vocab[j] for j in rng.integers(0, 1000, 8))
+        for i in range(1_000_000)
+    }
+    m.put_all(entries)
+    t0 = time.perf_counter()
+    counts = word_count(client._engine, m, workers=64)
+    wall = time.perf_counter() - t0
+    total_words = sum(counts.values())
+    assert total_words == 8_000_000, total_words
+    rate = 1_000_000 / wall
+    log(f"config4: word-count 1M entries in {wall:.2f}s = {rate/1e6:.2f}M entries/s (64 mappers)")
+    m.delete()
+    return rate
+
+
+def bench_config5_cluster_mixed():
+    """Mixed BitSet OR/XOR + bloom across an 8-master cluster (config 5)."""
+    from redisson_tpu.harness import ClusterRunner
+
+    runner = ClusterRunner(masters=8).run()
+    try:
+        client = runner.client(scan_interval=0)
+        tenants = 64
+        per = 10_000
+        blooms = []
+        for t in range(tenants):
+            bf = client.get_bloom_filter(f"bf{{t{t}}}")
+            assert bf.try_init(per, 0.01)
+            blooms.append(bf)
+        rng = np.random.default_rng(11)
+        keysets = [
+            (np.arange(t * per, (t + 1) * per, dtype=np.int64) * 2654435761)
+            for t in range(tenants)
+        ]
+        t0 = time.perf_counter()
+        for bf, ks in zip(blooms, keysets):
+            bf.add_each(ks)
+        for bf, ks in zip(blooms, keysets):
+            assert bf.contains_each(ks).all(), f"false negatives on {bf.name}"
+        ops = 2 * tenants * per
+        # bitset fan-out: one bitmap per tenant, OR/XOR folds on-shard
+        for t in range(tenants):
+            bs = client.get_bit_set(f"bits{{t{t}}}")
+            bs.set_each(rng.integers(0, 100_000, 500))
+            other = client.get_bit_set(f"bits2{{t{t}}}")
+            other.set_each(rng.integers(0, 100_000, 500))
+            bs.or_(f"bits2{{t{t}}}")
+            bs.xor(f"bits2{{t{t}}}")
+            ops += 1000 + 2
+        wall = time.perf_counter() - t0
+        rate = ops / wall
+        log(
+            f"config5: {ops} mixed ops over 8-master cluster in {wall:.2f}s = "
+            f"{rate/1e3:.0f}k ops/s (64-tenant fan-out)"
+        )
+        client.shutdown()
+        return rate
+    finally:
+        runner.shutdown()
+
+
 def main():
     import jax
 
@@ -178,8 +247,10 @@ def main():
         contains_single = bench_config1_single_filter(client)
         contains_bank, p99_ms = bench_config2_tenant_bank(client)
         hll_add, hll_merge = bench_config3_hll(client)
+        mr_rate = bench_config4_mapreduce(client)
     finally:
         client.shutdown()
+    cluster_rate = bench_config5_cluster_mixed()
 
     value = contains_bank
     print(
@@ -194,6 +265,8 @@ def main():
                     "config2_flush_p99_ms": round(p99_ms, 3),
                     "config3_hll_add_per_sec": round(hll_add),
                     "config3_hll_merge_pairs_per_sec": round(hll_merge),
+                    "config4_mapreduce_entries_per_sec": round(mr_rate),
+                    "config5_cluster_mixed_ops_per_sec": round(cluster_rate),
                     "baseline_model": "k=7 GETBITs @ 1M pipelined ops/s/core = 143k contains/s",
                     "device": str(dev),
                 },

@@ -1,0 +1,338 @@
+"""ClusterRedisson: slot-routed client over an N-master server topology.
+
+Parity targets (SURVEY.md §2.2, §3.6):
+  * ``cluster/ClusterConnectionManager.java:84-180`` — topology discovery
+    (CLUSTER SLOTS from any reachable seed), slot->entry table[16384],
+    scheduled topology refresh (scanInterval).
+  * ``connection/MasterSlaveEntry.java:106-299`` — per-shard master +
+    replica set with freeze/unfreeze and balancer-driven read routing
+    (ReadMode MASTER / SLAVE / MASTER_SLAVE).
+  * ``command/RedisExecutor.java`` redirect handling — MOVED replies refresh
+    the topology and re-route, bounded by max_redirects.
+
+TPU-first departure: there is no gossip; the slot map is installed by the
+launcher/failover coordinator (harness.ClusterRunner, server/monitor.py) via
+CLUSTER SETVIEW, and clients treat MOVED + periodic refresh as the only
+discovery protocol — the data plane stays entirely in the server processes
+next to their chips.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from redisson_tpu.net import commands as C
+from redisson_tpu.net.balancer import LoadBalancer, RoundRobinLoadBalancer
+from redisson_tpu.net.client import ConnectionError_, NodeClient, parse_address
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.utils.crc16 import MAX_SLOT, calc_slot
+
+READ_MASTER = "master"
+READ_REPLICA = "replica"
+READ_MASTER_SLAVE = "master_slave"
+
+
+class ShardEntry:
+    """One shard: master client + replica clients + read balancer
+    (MasterSlaveEntry analog)."""
+
+    def __init__(self, address: str, balancer: Optional[LoadBalancer] = None, **node_kw):
+        self.address = address
+        self.master = NodeClient(address, **node_kw)
+        self.replicas: Dict[str, NodeClient] = {}
+        self.balancer = balancer or RoundRobinLoadBalancer()
+        self._node_kw = node_kw
+
+    def sync_replicas(self, addresses: List[str]) -> None:
+        for addr in addresses:
+            if addr not in self.replicas:
+                self.replicas[addr] = NodeClient(addr, **self._node_kw)
+        for addr in list(self.replicas):
+            if addr not in addresses:
+                self.replicas.pop(addr).close()
+
+    def read_node(self, read_mode: str) -> NodeClient:
+        if read_mode == READ_MASTER or not self.replicas:
+            return self.master
+        pool = list(self.replicas.values())
+        if read_mode == READ_MASTER_SLAVE:
+            pool = pool + [self.master]
+        return self.balancer.pick(pool) or self.master
+
+    def close(self) -> None:
+        self.master.close()
+        for r in self.replicas.values():
+            r.close()
+
+
+from redisson_tpu.client.remote import RemoteSurface
+
+
+class ClusterRedisson(RemoteSurface):
+    """Slot-routed facade sharing the Remote* handle surface (the handles
+    call ``client.execute``/``client.objcall``; routing happens here)."""
+
+    def __init__(
+        self,
+        seeds: List[str],
+        config=None,
+        read_mode: str = READ_MASTER,
+        balancer: Optional[LoadBalancer] = None,
+        scan_interval: float = 5.0,
+        max_redirects: int = 5,
+        **node_kw,
+    ):
+        from redisson_tpu.config import Config
+
+        self.config = config or Config()
+        self.read_mode = read_mode
+        self.max_redirects = max_redirects
+        self._balancer_factory = balancer
+        self._node_kw = dict(node_kw)
+        self._seeds = list(seeds)
+        self._entries: Dict[str, ShardEntry] = {}  # master address -> entry
+        self._slots: List[Optional[str]] = [None] * MAX_SLOT  # slot -> master address
+        self._lock = threading.RLock()
+        self._closed = threading.Event()
+        self.refresh_topology()
+        self._scan_interval = scan_interval
+        self._scan_thread: Optional[threading.Thread] = None
+        if scan_interval and scan_interval > 0:
+            self._scan_thread = threading.Thread(
+                target=self._scan_loop, daemon=True, name="rtpu-cluster-scan"
+            )
+            self._scan_thread.start()
+
+    # -- topology ------------------------------------------------------------
+
+    def _fetch_view(self) -> Optional[List[Any]]:
+        """CLUSTER SLOTS from any reachable node (entries first, then seeds)."""
+        with self._lock:
+            candidates = [e.master for e in self._entries.values()]
+        for node in candidates:
+            try:
+                return node.execute("CLUSTER", "SLOTS", timeout=5.0)
+            except Exception:  # noqa: BLE001 — try the next node
+                continue
+        for seed in self._seeds:
+            probe = None
+            try:
+                probe = NodeClient(seed, ping_interval=0, retry_attempts=0)
+                return probe.execute("CLUSTER", "SLOTS", timeout=5.0)
+            except Exception:  # noqa: BLE001
+                continue
+            finally:
+                if probe is not None:
+                    probe.close()
+        return None
+
+    def refresh_topology(self) -> bool:
+        """Re-read CLUSTER SLOTS and swap the routing table.
+
+        All network I/O (entry construction, REPLICAS discovery) happens
+        OUTSIDE self._lock — one dead node's connect timeouts must not stall
+        entry_for_slot for healthy shards.  The lock only guards the final
+        table swap."""
+        view = self._fetch_view()
+        if view is None:
+            return False
+        new_slots: List[Optional[str]] = [None] * MAX_SLOT
+        masters: Dict[str, None] = {}
+        for row in view:
+            lo, hi, (host, port, _nid) = int(row[0]), int(row[1]), row[2]
+            host = host.decode() if isinstance(host, bytes) else host
+            addr = f"{host}:{int(port)}"
+            masters[addr] = None
+            for s in range(lo, hi + 1):
+                new_slots[s] = addr
+        with self._lock:
+            existing = dict(self._entries)
+        fresh: Dict[str, ShardEntry] = {}
+        for addr in masters:
+            if addr in existing:
+                fresh[addr] = existing[addr]
+            else:
+                try:
+                    fresh[addr] = ShardEntry(
+                        addr, balancer=self._balancer_factory, **self._node_kw
+                    )
+                except Exception:  # noqa: BLE001 — node down; slot stays unroutable
+                    continue
+        # replica discovery per master (REPLICAS command) — still outside lock
+        for addr, entry in fresh.items():
+            try:
+                reps = entry.master.execute("REPLICAS", timeout=5.0)
+                entry.sync_replicas(
+                    [r.decode() if isinstance(r, bytes) else r for r in reps]
+                )
+            except Exception:  # noqa: BLE001 — master briefly down
+                pass
+        with self._lock:
+            retired = [e for a, e in self._entries.items() if a not in fresh]
+            self._entries = fresh
+            self._slots = [a if a in fresh else None for a in new_slots]
+        for e in retired:
+            e.close()
+        return True
+
+    def _scan_loop(self) -> None:
+        while not self._closed.wait(self._scan_interval):
+            try:
+                self.refresh_topology()
+            except Exception:  # noqa: BLE001 — keep scanning
+                pass
+
+    def entry_for_slot(self, slot: int) -> ShardEntry:
+        with self._lock:
+            addr = self._slots[slot]
+            if addr is None or addr not in self._entries:
+                raise ConnectionError_(f"no entry serves slot {slot}")
+            return self._entries[addr]
+
+    def entries(self) -> List[ShardEntry]:
+        with self._lock:
+            return list(self._entries.values())
+
+    # -- command path (RedisExecutor redirect state machine) ------------------
+
+    # keyless commands whose answer is the union over every master — the
+    # RKeys scatter-gather surface (CommandAsyncService readAllAsync /
+    # writeAllAsync, :233-294)
+    _ALL_SHARD = {"KEYS": "concat", "DBSIZE": "sum", "FLUSHALL": "ok"}
+    # multi-key WRITE commands that are one atomic compound op server-side:
+    # all keys must colocate on one shard (Redis CROSSSLOT rule; use
+    # {hashtags} to colocate)
+    _SAME_SLOT = {"PFMERGE", "BITOP", "RENAME"}
+
+    def _route(self, cmd: str, args: tuple) -> Tuple[Optional[int], bool]:
+        keys = C.command_keys(cmd, list(args))
+        write = C.is_write(cmd, list(args))
+        if not keys:
+            return None, write
+        slots = {calc_slot(k if isinstance(k, bytes) else str(k).encode()) for k in keys}
+        if len(slots) > 1:
+            if cmd.upper() in self._SAME_SLOT:
+                raise RespError(
+                    f"CROSSSLOT keys of {cmd} map to different slots; use a "
+                    "{hashtag} to colocate them"
+                )
+            # splittable multi-key (DEL/UNLINK): caller path handles grouping
+            return -1, write
+        return slots.pop(), write
+
+    def execute(self, *cmd_args, timeout: Optional[float] = None) -> Any:
+        cmd = str(cmd_args[0]).upper()
+        if cmd in self._ALL_SHARD:
+            return self._execute_all_shards(cmd, cmd_args, timeout)
+        slot, write = self._route(cmd, cmd_args[1:])
+        if slot == -1:  # cross-slot DEL/UNLINK: per-shard sub-commands
+            return self._execute_split_keys(cmd_args, timeout)
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_redirects + 1):
+            try:
+                if slot is None:
+                    entries = self.entries()
+                    if not entries:
+                        raise ConnectionError_("no cluster entries")
+                    node = entries[0].master
+                else:
+                    entry = self.entry_for_slot(slot)
+                    node = entry.master if write else entry.read_node(self.read_mode)
+                return node.execute(*cmd_args, timeout=timeout)
+            except RespError as e:
+                msg = str(e)
+                if msg.startswith("MOVED "):
+                    # MOVED <slot> <host>:<port> — refresh and re-route
+                    # (cluster/ClusterConnectionManager topology diff analog)
+                    last = e
+                    self.refresh_topology()
+                    continue
+                raise
+            except (ConnectionError, OSError, TimeoutError) as e:
+                last = e
+                self.refresh_topology()
+                time.sleep(min(0.1 * (attempt + 1), 1.0))
+                continue
+        assert last is not None
+        raise last
+
+    def _execute_all_shards(self, cmd: str, cmd_args, timeout) -> Any:
+        merge = self._ALL_SHARD[cmd]
+        out: List[Any] = []
+        for entry in self.entries():
+            reply = entry.master.execute(*cmd_args, timeout=timeout)
+            out.append(reply)
+        if merge == "concat":
+            return [x for r in out for x in (r or [])]
+        if merge == "sum":
+            return sum(int(r) for r in out)
+        return out[0] if out else None
+
+    def _execute_split_keys(self, cmd_args, timeout) -> int:
+        """DEL/UNLINK across slots: group keys per owning shard, sum counts
+        (the per-entry grouping of RedissonKeys.deleteAsync)."""
+        cmd = cmd_args[0]
+        groups: Dict[int, List[Any]] = {}
+        for key in cmd_args[1:]:
+            kb = key if isinstance(key, bytes) else str(key).encode()
+            groups.setdefault(calc_slot(kb), []).append(key)
+        total = 0
+        for slot, keys in groups.items():
+            total += int(self.execute(cmd, *keys, timeout=timeout) or 0)
+        return total
+
+    def execute_many(self, commands, timeout: Optional[float] = None):
+        """Per-slot grouped pipeline (executeBatchedAsync per-entry grouping,
+        CommandAsyncService.java:575-640): one pipelined frame per shard,
+        results stitched back in submission order.  Entries are snapshotted
+        once; commands whose shard vanished mid-flight fall back to the
+        redirect-aware execute()."""
+        with self._lock:
+            slot_table = list(self._slots)
+            entries = dict(self._entries)
+        groups: Dict[Optional[str], List[int]] = {}
+        for i, c in enumerate(commands):
+            slot, _w = self._route(str(c[0]), tuple(c[1:]))
+            addr = None if slot in (None, -1) else slot_table[slot]
+            groups.setdefault(addr, []).append(i)
+        results: List[Any] = [None] * len(commands)
+        for addr, idxs in groups.items():
+            entry = entries.get(addr) if addr is not None else next(iter(entries.values()), None)
+            try:
+                if entry is None:
+                    raise ConnectionError_(f"no entry for {addr}")
+                replies = entry.master.execute_many(
+                    [commands[i] for i in idxs], timeout=timeout
+                )
+            except (ConnectionError, OSError, TimeoutError):
+                # topology changed under us: redirect-aware per-command path
+                replies = [self.execute(*commands[i], timeout=timeout) for i in idxs]
+            for i, r in zip(idxs, replies):
+                results[i] = r
+        return results
+
+    def pubsub_for(self, name: str):
+        """Channel subscriptions ride the shard that owns the channel's slot
+        (SSUBSCRIBE semantics — RedissonShardedTopic analog)."""
+        entry = self.entry_for_slot(calc_slot(name.encode()))
+        return entry.master.pubsub()
+
+    # -- object surface: inherited from RemoteSurface (same handle classes,
+    #    routed through execute()/objcall()/pubsub_for() above) --------------
+
+    def ping_all(self) -> Dict[str, bool]:
+        out = {}
+        for e in self.entries():
+            try:
+                out[e.address] = e.master.execute("PING") in (b"PONG", "PONG")
+            except Exception:  # noqa: BLE001
+                out[e.address] = False
+        return out
+
+    def shutdown(self) -> None:
+        self._closed.set()
+        with self._lock:
+            for e in self._entries.values():
+                e.close()
+            self._entries.clear()

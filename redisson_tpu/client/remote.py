@@ -71,7 +71,7 @@ class RemoteBloomFilter:
 
     def try_init(self, expected_insertions: int, false_probability: float) -> bool:
         try:
-            self._client.node.execute(
+            self._client.execute(
                 "BF.RESERVE", self.name, repr(false_probability), expected_insertions
             )
             return True
@@ -84,7 +84,7 @@ class RemoteBloomFilter:
         return [o if isinstance(o, bytes) else self._codec.encode(o) for o in objs]
 
     def add(self, obj) -> bool:
-        return bool(self._client.node.execute("BF.ADD", self.name, self._encode_keys(obj)[0]))
+        return bool(self._client.execute("BF.ADD", self.name, self._encode_keys(obj)[0]))
 
     def add_all(self, objs) -> int:
         return int(self.add_each(objs).sum())
@@ -92,20 +92,20 @@ class RemoteBloomFilter:
     def add_each(self, objs) -> np.ndarray:
         if isinstance(objs, np.ndarray) and objs.dtype.kind in "iu":
             blob = np.ascontiguousarray(objs, dtype="<i8").tobytes()
-            out = self._client.node.execute("BF.MADD64", self.name, blob)
+            out = self._client.execute("BF.MADD64", self.name, blob)
             return np.frombuffer(out, np.uint8).astype(bool)
-        reply = self._client.node.execute("BF.MADD", self.name, *self._encode_keys(objs))
+        reply = self._client.execute("BF.MADD", self.name, *self._encode_keys(objs))
         return np.asarray(reply, dtype=bool)
 
     def contains(self, obj) -> bool:
-        return bool(self._client.node.execute("BF.EXISTS", self.name, self._encode_keys(obj)[0]))
+        return bool(self._client.execute("BF.EXISTS", self.name, self._encode_keys(obj)[0]))
 
     def contains_each(self, objs) -> np.ndarray:
         if isinstance(objs, np.ndarray) and objs.dtype.kind in "iu":
             blob = np.ascontiguousarray(objs, dtype="<i8").tobytes()
-            out = self._client.node.execute("BF.MEXISTS64", self.name, blob)
+            out = self._client.execute("BF.MEXISTS64", self.name, blob)
             return np.frombuffer(out, np.uint8).astype(bool)
-        reply = self._client.node.execute("BF.MEXISTS", self.name, *self._encode_keys(objs))
+        reply = self._client.execute("BF.MEXISTS", self.name, *self._encode_keys(objs))
         return np.asarray(reply, dtype=bool)
 
     def count_contains(self, objs) -> int:
@@ -121,7 +121,7 @@ class RemoteBloomFilterArray:
 
     def try_init(self, tenants: int, expected_insertions: int, false_probability: float) -> bool:
         try:
-            self._client.node.execute(
+            self._client.execute(
                 "BFA.RESERVE", self.name, tenants, expected_insertions, repr(false_probability)
             )
             return True
@@ -135,12 +135,12 @@ class RemoteBloomFilterArray:
 
     def add_each(self, tenant_ids, keys) -> np.ndarray:
         t, k = self._blobs(tenant_ids, keys)
-        out = self._client.node.execute("BFA.MADD64", self.name, t, k)
+        out = self._client.execute("BFA.MADD64", self.name, t, k)
         return np.frombuffer(out, np.uint8).astype(bool)
 
     def contains(self, tenant_ids, keys) -> np.ndarray:
         t, k = self._blobs(tenant_ids, keys)
-        out = self._client.node.execute("BFA.MEXISTS64", self.name, t, k)
+        out = self._client.execute("BFA.MEXISTS64", self.name, t, k)
         return np.frombuffer(out, np.uint8).astype(bool)
 
 
@@ -152,23 +152,23 @@ class RemoteHyperLogLog:
 
     def add(self, obj) -> bool:
         data = obj if isinstance(obj, bytes) else self._codec.encode(obj)
-        return bool(self._client.node.execute("PFADD", self.name, data))
+        return bool(self._client.execute("PFADD", self.name, data))
 
     def add_all(self, objs) -> bool:
         if isinstance(objs, np.ndarray) and objs.dtype.kind in "iu":
             blob = np.ascontiguousarray(objs, dtype="<i8").tobytes()
-            return bool(self._client.node.execute("PFADD64", self.name, blob))
+            return bool(self._client.execute("PFADD64", self.name, blob))
         encoded = [o if isinstance(o, bytes) else self._codec.encode(o) for o in objs]
-        return bool(self._client.node.execute("PFADD", self.name, *encoded))
+        return bool(self._client.execute("PFADD", self.name, *encoded))
 
     def count(self) -> int:
-        return int(self._client.node.execute("PFCOUNT", self.name))
+        return int(self._client.execute("PFCOUNT", self.name))
 
     def count_with(self, *names: str) -> int:
-        return int(self._client.node.execute("PFCOUNT", self.name, *names))
+        return int(self._client.execute("PFCOUNT", self.name, *names))
 
     def merge_with(self, *names: str) -> None:
-        self._client.node.execute("PFMERGE", self.name, *names)
+        self._client.execute("PFMERGE", self.name, *names)
 
 
 class RemoteBitSet:
@@ -177,33 +177,33 @@ class RemoteBitSet:
         self.name = name
 
     def set(self, index: int, value: bool = True) -> bool:
-        return bool(self._client.node.execute("SETBIT", self.name, index, 1 if value else 0))
+        return bool(self._client.execute("SETBIT", self.name, index, 1 if value else 0))
 
     def get(self, index: int) -> bool:
-        return bool(self._client.node.execute("GETBIT", self.name, index))
+        return bool(self._client.execute("GETBIT", self.name, index))
 
     def set_each(self, indexes, value: bool = True) -> np.ndarray:
         if not value:
             proxy = RemoteObjectProxy(self._client, "get_bit_set", self.name)
             return proxy.set_each(np.asarray(indexes), False)
-        reply = self._client.node.execute("SETBITS", self.name, *[int(i) for i in indexes])
+        reply = self._client.execute("SETBITS", self.name, *[int(i) for i in indexes])
         return np.asarray(reply, dtype=bool)
 
     def get_each(self, indexes) -> np.ndarray:
-        reply = self._client.node.execute("GETBITS", self.name, *[int(i) for i in indexes])
+        reply = self._client.execute("GETBITS", self.name, *[int(i) for i in indexes])
         return np.asarray(reply, dtype=bool)
 
     def cardinality(self) -> int:
-        return int(self._client.node.execute("BITCOUNT", self.name))
+        return int(self._client.execute("BITCOUNT", self.name))
 
     def or_(self, *others: str) -> None:
-        self._client.node.execute("BITOP", "OR", self.name, self.name, *others)
+        self._client.execute("BITOP", "OR", self.name, self.name, *others)
 
     def and_(self, *others: str) -> None:
-        self._client.node.execute("BITOP", "AND", self.name, self.name, *others)
+        self._client.execute("BITOP", "AND", self.name, self.name, *others)
 
     def xor(self, *others: str) -> None:
-        self._client.node.execute("BITOP", "XOR", self.name, self.name, *others)
+        self._client.execute("BITOP", "XOR", self.name, self.name, *others)
 
 
 class RemoteBucket:
@@ -216,20 +216,20 @@ class RemoteBucket:
         args = ["SET", self.name, self._codec.encode(value)]
         if ttl is not None:
             args += ["PX", int(ttl * 1000)]
-        self._client.node.execute(*args)
+        self._client.execute(*args)
 
     def get(self) -> Any:
-        data = self._client.node.execute("GET", self.name)
+        data = self._client.execute("GET", self.name)
         return None if data is None else self._codec.decode(bytes(data))
 
     def try_set(self, value: Any, ttl: Optional[float] = None) -> bool:
         args = ["SET", self.name, self._codec.encode(value), "NX"]
         if ttl is not None:
             args += ["PX", int(ttl * 1000)]
-        return self._client.node.execute(*args) is not None
+        return self._client.execute(*args) is not None
 
     def delete(self) -> bool:
-        return bool(self._client.node.execute("DEL", self.name))
+        return bool(self._client.execute("DEL", self.name))
 
 
 class RemoteTopic:
@@ -239,7 +239,7 @@ class RemoteTopic:
         self._codec = codec or DEFAULT_CODEC
 
     def publish(self, message: Any) -> int:
-        return int(self._client.node.execute("PUBLISH", self.name, self._codec.encode(message)))
+        return int(self._client.execute("PUBLISH", self.name, self._codec.encode(message)))
 
     def add_listener(self, listener: Callable[[str, Any], None]) -> Callable[[str, bytes], None]:
         codec = self._codec
@@ -251,11 +251,11 @@ class RemoteTopic:
                 value = payload
             listener(channel, value)
 
-        self._client.node.pubsub().subscribe(self.name, wire_listener)
+        self._client.pubsub_for(self.name).subscribe(self.name, wire_listener)
         return wire_listener
 
     def remove_all_listeners(self) -> None:
-        self._client.node.pubsub().unsubscribe(self.name)
+        self._client.pubsub_for(self.name).unsubscribe(self.name)
 
 
 class RemoteBatch:
@@ -294,7 +294,7 @@ class RemoteBatch:
             cmd = "BF.MEXISTS64" if kind == "bf.contains" else "BF.MADD64"
             commands.append((cmd, name, blob))
             layout.append((idxs, [np.asarray(self._ops[i][2]).size for i in idxs]))
-        replies = self._client.node.execute_many(commands)
+        replies = self._client.execute_many(commands)
         results: List[Any] = [None] * len(self._ops)
         for (idxs, sizes), reply in zip(layout, replies):
             if isinstance(reply, RespError):
@@ -312,16 +312,16 @@ class RemoteKeys:
         self._client = client
 
     def get_keys(self, pattern: str = "*") -> List[str]:
-        return [k.decode() for k in self._client.node.execute("KEYS", pattern)]
+        return [k.decode() for k in self._client.execute("KEYS", pattern)]
 
     def delete(self, *names: str) -> int:
-        return int(self._client.node.execute("DEL", *names))
+        return int(self._client.execute("DEL", *names))
 
     def count(self) -> int:
-        return int(self._client.node.execute("DBSIZE"))
+        return int(self._client.execute("DBSIZE"))
 
     def flushall(self) -> None:
-        self._client.node.execute("FLUSHALL")
+        self._client.execute("FLUSHALL")
 
 
 class RemoteLock(RemoteObjectProxy):
@@ -440,7 +440,84 @@ _GENERIC_FACTORIES = {
 }
 
 
-class RemoteRedisson:
+class RemoteSurface:
+    """Handle-factory surface shared by the single-node client and the
+    cluster client: every factory only talks through the transport seam
+    (execute / execute_many / objcall / pubsub_for / caller_id), so the same
+    handle classes ride either routing."""
+
+    def caller_id(self) -> str:
+        """This thread's synchronizer identity (uuid:threadId — the
+        reference's LockName, RedissonBaseLock.getLockName)."""
+        import threading as _threading
+        import uuid as _uuid
+
+        if not hasattr(self, "_client_uuid"):
+            object.__setattr__(self, "_client_uuid", _uuid.uuid4().hex)
+        return f"{self._client_uuid}:{_threading.get_ident()}"
+
+    def objcall(
+        self,
+        factory: str,
+        name: str,
+        method: str,
+        args: tuple,
+        kwargs: dict,
+        caller: Optional[str] = None,
+    ) -> Any:
+        payload = pickle.dumps((args, kwargs))
+        reply = self.execute(
+            "OBJCALL", factory, name, method, payload, caller or self.caller_id()
+        )
+        return _unwrap(reply)
+
+    # -- hot-path handles ----------------------------------------------------
+
+    def get_bloom_filter(self, name: str, codec: Optional[Codec] = None) -> "RemoteBloomFilter":
+        return RemoteBloomFilter(self, name, codec)
+
+    def get_bloom_filter_array(self, name: str) -> "RemoteBloomFilterArray":
+        return RemoteBloomFilterArray(self, name)
+
+    def get_hyper_log_log(self, name: str, codec: Optional[Codec] = None) -> "RemoteHyperLogLog":
+        return RemoteHyperLogLog(self, name, codec)
+
+    def get_bit_set(self, name: str) -> "RemoteBitSet":
+        return RemoteBitSet(self, name)
+
+    def get_bucket(self, name: str, codec: Optional[Codec] = None) -> "RemoteBucket":
+        return RemoteBucket(self, name, codec)
+
+    def get_topic(self, name: str, codec: Optional[Codec] = None) -> "RemoteTopic":
+        return RemoteTopic(self, name, codec)
+
+    def create_batch(self) -> "RemoteBatch":
+        return RemoteBatch(self)
+
+    def get_keys(self) -> "RemoteKeys":
+        return RemoteKeys(self)
+
+    # -- generic surface -----------------------------------------------------
+
+    _LOCK_FACTORIES = {"get_lock", "get_fair_lock", "get_spin_lock", "get_fenced_lock"}
+
+    def __getattr__(self, factory: str):
+        if factory in self._LOCK_FACTORIES:
+
+            def make_lock(name: str, *_a, **_k) -> RemoteLock:
+                return RemoteLock(self, factory, name)
+
+            return make_lock
+        if factory in _GENERIC_FACTORIES:
+
+            def make(name: str, *_a, **_k) -> RemoteObjectProxy:
+                return RemoteObjectProxy(self, factory, name)
+
+            return make
+        raise AttributeError(factory)
+
+
+class RemoteRedisson(RemoteSurface):
     """Client-mode facade (the RedissonClient role for a remote data plane)."""
 
     def __init__(self, address: str, config=None, **node_kw):
@@ -469,75 +546,18 @@ class RemoteRedisson:
         ssc = config.use_single_server()
         return cls(ssc.address, config=config)
 
-    def caller_id(self) -> str:
-        """This thread's synchronizer identity (uuid:threadId — the
-        reference's LockName, RedissonBaseLock.getLockName)."""
-        import threading
-        import uuid as _uuid
+    # -- transport seam (handles call these; ClusterRedisson overrides with
+    #    slot routing — the CommandAsyncExecutor boundary of the wire client)
 
-        if not hasattr(self, "_client_uuid"):
-            object.__setattr__(self, "_client_uuid", _uuid.uuid4().hex)
-        return f"{self._client_uuid}:{threading.get_ident()}"
+    def execute(self, *args, timeout: Optional[float] = None) -> Any:
+        return self.node.execute(*args, timeout=timeout)
 
-    def objcall(
-        self,
-        factory: str,
-        name: str,
-        method: str,
-        args: tuple,
-        kwargs: dict,
-        caller: Optional[str] = None,
-    ) -> Any:
-        payload = pickle.dumps((args, kwargs))
-        reply = self.node.execute(
-            "OBJCALL", factory, name, method, payload, caller or self.caller_id()
-        )
-        return _unwrap(reply)
+    def execute_many(self, commands, timeout: Optional[float] = None):
+        return self.node.execute_many(commands, timeout=timeout)
 
-    # -- hot-path handles ----------------------------------------------------
-
-    def get_bloom_filter(self, name: str, codec: Optional[Codec] = None) -> RemoteBloomFilter:
-        return RemoteBloomFilter(self, name, codec)
-
-    def get_bloom_filter_array(self, name: str) -> RemoteBloomFilterArray:
-        return RemoteBloomFilterArray(self, name)
-
-    def get_hyper_log_log(self, name: str, codec: Optional[Codec] = None) -> RemoteHyperLogLog:
-        return RemoteHyperLogLog(self, name, codec)
-
-    def get_bit_set(self, name: str) -> RemoteBitSet:
-        return RemoteBitSet(self, name)
-
-    def get_bucket(self, name: str, codec: Optional[Codec] = None) -> RemoteBucket:
-        return RemoteBucket(self, name, codec)
-
-    def get_topic(self, name: str, codec: Optional[Codec] = None) -> RemoteTopic:
-        return RemoteTopic(self, name, codec)
-
-    def create_batch(self) -> RemoteBatch:
-        return RemoteBatch(self)
-
-    def get_keys(self) -> RemoteKeys:
-        return RemoteKeys(self)
-
-    # -- generic surface -----------------------------------------------------
-
-    _LOCK_FACTORIES = {"get_lock", "get_fair_lock", "get_spin_lock", "get_fenced_lock"}
-
-    def __getattr__(self, factory: str):
-        if factory in self._LOCK_FACTORIES:
-
-            def make_lock(name: str, *_a, **_k) -> RemoteLock:
-                return RemoteLock(self, factory, name)
-
-            return make_lock
-        if factory in _GENERIC_FACTORIES:
-
-            def make(name: str, *_a, **_k) -> RemoteObjectProxy:
-                return RemoteObjectProxy(self, factory, name)
-
-            return make
-        raise AttributeError(factory)
+    def pubsub_for(self, name: str):
+        """Pubsub connection serving `name`'s channel (single node: the one)."""
+        return self.node.pubsub()
 
     # -- admin ---------------------------------------------------------------
 

@@ -1,0 +1,159 @@
+"""Cluster harness: form, mutate, and kill in-process server topologies.
+
+Parity target: the reference's failover-test infrastructure —
+``org/redisson/RedisRunner.java`` (spawn/stop/restart real redis-server
+processes) and ``ClusterRunner.java:26-65`` (addNode(master, slaves...) ->
+run() forms a live cluster).  SURVEY.md §4's lesson: multi-node without
+multi-host = N nodes on localhost ports; here nodes are in-process
+ServerThreads (hermetic, works on the CPU backend) — chaos tests call
+``stop_node`` mid-load exactly like RedissonFailoverTest kills masters.
+"""
+from __future__ import annotations
+
+import socket
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.server import ServerThread
+from redisson_tpu.utils.crc16 import MAX_SLOT
+
+
+def _exec(conn, *args, timeout: Optional[float] = None):
+    reply = conn.execute(*args, timeout=timeout)
+    if isinstance(reply, RespError):
+        raise reply
+    return reply
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def split_slots(n: int) -> List[Tuple[int, int]]:
+    """Even slot partition (the reference's create-cluster default layout)."""
+    per = MAX_SLOT // n
+    ranges = []
+    for i in range(n):
+        lo = i * per
+        hi = MAX_SLOT - 1 if i == n - 1 else (i + 1) * per - 1
+        ranges.append((lo, hi))
+    return ranges
+
+
+class ClusterNode:
+    def __init__(self, server: ServerThread, role: str, master_index: Optional[int] = None):
+        self.server = server
+        self.role = role  # "master" | "replica"
+        self.master_index = master_index  # masters[i] this replicates
+        self.stopped = False
+
+    @property
+    def address(self) -> str:
+        return f"{self.server.server.host}:{self.server.server.port}"
+
+    @property
+    def port(self) -> int:
+        return self.server.server.port
+
+
+class ClusterRunner:
+    """Form an n-master (optionally replicated) in-process cluster."""
+
+    def __init__(self, masters: int = 3, replicas_per_master: int = 0, **server_kw):
+        self.n_masters = masters
+        self.replicas_per_master = replicas_per_master
+        self.server_kw = server_kw
+        self.masters: List[ClusterNode] = []
+        self.replicas: List[ClusterNode] = []
+        self.slot_ranges = split_slots(masters)
+
+    def run(self) -> "ClusterRunner":
+        for _ in range(self.n_masters):
+            st = ServerThread(port=free_port(), **self.server_kw).start()
+            self.masters.append(ClusterNode(st, "master"))
+        for mi in range(self.n_masters):
+            for _ in range(self.replicas_per_master):
+                st = ServerThread(port=free_port(), **self.server_kw).start()
+                node = ClusterNode(st, "replica", master_index=mi)
+                self.replicas.append(node)
+        self.install_view()
+        self.wire_replicas()
+        return self
+
+    # -- topology management --------------------------------------------------
+
+    def view_tuples(self) -> List[Tuple[int, int, str, int, str]]:
+        return [
+            (lo, hi, m.server.server.host, m.port, m.server.server.node_id)
+            for (lo, hi), m in zip(self.slot_ranges, self.masters)
+            if not m.stopped
+        ]
+
+    def install_view(self) -> None:
+        """Push the slot map to every live node (CLUSTER SETVIEW)."""
+        flat: List = []
+        for lo, hi, h, p, nid in self.view_tuples():
+            flat += [lo, hi, h, p, nid]
+        for node in self.masters + self.replicas:
+            if node.stopped:
+                continue
+            with node.server.client() as c:
+                _exec(c, "CLUSTER", "SETVIEW", *flat)
+
+    def wire_replicas(self) -> None:
+        for node in self.replicas:
+            if node.stopped:
+                continue
+            master = self.masters[node.master_index]
+            if master.stopped:
+                continue
+            with node.server.client() as c:
+                _exec(c, "REPLICAOF", master.server.server.host, master.port, timeout=120.0)
+
+    # -- chaos ops (RedisRunner stop()/restart() analog) ----------------------
+
+    def stop_node(self, node: ClusterNode) -> None:
+        node.stopped = True
+        node.server.stop()
+
+    def stop_master(self, index: int) -> ClusterNode:
+        node = self.masters[index]
+        self.stop_node(node)
+        return node
+
+    def promote(self, replica: ClusterNode) -> None:
+        """Manual failover: replica takes over its dead master's slot range
+        (the coordinator in server/monitor.py automates this)."""
+        mi = replica.master_index
+        with replica.server.client() as c:
+            _exec(c, "REPLICAOF", "NO", "ONE")
+        replica.role = "master"
+        old = self.masters[mi]
+        self.masters[mi] = ClusterNode(replica.server, "master")
+        self.replicas = [r for r in self.replicas if r is not replica]
+        if not old.stopped:
+            self.stop_node(old)
+        self.install_view()
+        self.wire_replicas()
+
+    def seeds(self) -> List[str]:
+        return [m.address for m in self.masters if not m.stopped] + [
+            r.address for r in self.replicas if not r.stopped
+        ]
+
+    def client(self, **kw):
+        from redisson_tpu.client.cluster import ClusterRedisson
+
+        # default response timeout must cover a first XLA compile (~40s on a
+        # real chip): a shorter timeout makes the retry machinery re-send a
+        # non-idempotent command the server actually completed
+        kw.setdefault("timeout", 180.0)
+        return ClusterRedisson(self.seeds(), **kw)
+
+    def shutdown(self) -> None:
+        for node in self.masters + self.replicas:
+            if not node.stopped:
+                node.server.stop()

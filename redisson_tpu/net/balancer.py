@@ -1,0 +1,70 @@
+"""Load balancers for replica read routing.
+
+Parity targets (SURVEY.md §2.2): ``connection/balancer/LoadBalancerManager``
+with RoundRobinLoadBalancer (default), RandomLoadBalancer,
+WeightedRoundRobinBalancer (`WeightedRoundRobinBalancer.java:153`), and
+CommandsLoadBalancer (least in-flight).  Balancers pick among the healthy
+NodeClients of one shard entry; the entry (client/cluster.py ShardEntry)
+owns freeze/unfreeze, mirroring ``connection/MasterSlaveEntry``.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+class LoadBalancer:
+    def pick(self, nodes: Sequence) -> Optional[object]:
+        raise NotImplementedError
+
+
+class RoundRobinLoadBalancer(LoadBalancer):
+    def __init__(self):
+        self._counter = itertools.count()
+        self._lock = threading.Lock()
+
+    def pick(self, nodes: Sequence):
+        if not nodes:
+            return None
+        with self._lock:
+            i = next(self._counter)
+        return nodes[i % len(nodes)]
+
+
+class RandomLoadBalancer(LoadBalancer):
+    def pick(self, nodes: Sequence):
+        return random.choice(nodes) if nodes else None
+
+
+class WeightedRoundRobinBalancer(LoadBalancer):
+    """Weights map address -> positive int; unlisted nodes get default_weight.
+    Node n is picked weight(n) times per cycle (the reference's weight-decay
+    scheme collapsed to a static expanded cycle)."""
+
+    def __init__(self, weights: Dict[str, int], default_weight: int = 1):
+        if any(w <= 0 for w in weights.values()) or default_weight <= 0:
+            raise ValueError("weights must be positive")
+        self.weights = dict(weights)
+        self.default_weight = default_weight
+        self._rr = RoundRobinLoadBalancer()
+
+    def pick(self, nodes: Sequence):
+        if not nodes:
+            return None
+        expanded: List = []
+        for n in nodes:
+            w = self.weights.get(getattr(n, "address", None), self.default_weight)
+            expanded.extend([n] * w)
+        return self._rr.pick(expanded)
+
+
+class CommandsLoadBalancer(LoadBalancer):
+    """Least in-flight commands (CommandsLoadBalancer.java) — NodeClients
+    expose in_flight() fed by their connection pools."""
+
+    def pick(self, nodes: Sequence):
+        if not nodes:
+            return None
+        return min(nodes, key=lambda n: getattr(n, "in_flight", lambda: 0)())

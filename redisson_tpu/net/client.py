@@ -236,6 +236,7 @@ class ConnectionPool:
         self._sem = threading.Semaphore(size)
         self._idle: List[Connection] = []
         self._lock = threading.Lock()
+        self.in_use = 0  # CommandsLoadBalancer feed (least in-flight picks)
         for _ in range(min(min_idle, size)):
             self._idle.append(factory())
 
@@ -246,6 +247,7 @@ class ConnectionPool:
                 "'connection_pool_size' or reduce concurrency"
             )
         with self._lock:
+            self.in_use += 1
             while self._idle:
                 conn = self._idle.pop()
                 if not conn.closed:
@@ -253,17 +255,22 @@ class ConnectionPool:
         try:
             return self._factory()
         except Exception:
+            with self._lock:
+                self.in_use -= 1
             self._sem.release()
             raise
 
     def release(self, conn: Connection) -> None:
         with self._lock:
+            self.in_use -= 1
             if not conn.closed:
                 self._idle.append(conn)
         self._sem.release()
 
     def discard(self, conn: Connection) -> None:
         conn.close()
+        with self._lock:
+            self.in_use -= 1
         self._sem.release()
 
     def close(self) -> None:
@@ -381,6 +388,10 @@ class NodeClient:
             return result
         assert last is not None
         raise last
+
+    def in_flight(self) -> int:
+        """Commands currently holding a pooled connection (CommandsLoadBalancer feed)."""
+        return self.pool.in_use
 
     # -- pubsub --------------------------------------------------------------
 

@@ -1,0 +1,103 @@
+"""Command metadata registry shared by server and cluster client.
+
+Parity target: the reference's static command registry
+(``org/redisson/client/protocol/RedisCommands.java`` — ~447 `RedisCommand`
+definitions carrying reply decoders and routing attributes).  Here the
+registry carries what the TPU-native wire needs: which args are keys (slot
+routing + server-side MOVED checks) and whether the command mutates state
+(replica READONLY enforcement + client read/write routing, the readMode
+analog of ``connection/MasterSlaveEntry`` + balancers).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+class CommandSpec:
+    __slots__ = ("name", "write", "key_at", "multi_key", "global_cmd")
+
+    def __init__(self, name: str, write: bool, key_at: Optional[int], multi_key: bool = False):
+        self.name = name
+        self.write = write
+        self.key_at = key_at  # index into args AFTER the command name; None = keyless
+        self.multi_key = multi_key  # keys run from key_at to end of args
+        self.global_cmd = key_at is None
+
+
+def _spec(table, names, write, key_at, multi_key=False):
+    for n in names.split():
+        table[n] = CommandSpec(n, write, key_at, multi_key)
+
+
+SPECS: dict = {}
+
+# keyless / administrative (never redirected)
+_spec(SPECS, "PING ECHO AUTH HELLO SELECT CLIENT QUIT DBSIZE TIME INFO MEMORY "
+             "CLUSTER KEYS SAVE REPLICAOF REPLREGISTER "
+             "REPLPUSH REPLFLUSH REPLSNAPSHOT REPLICAS SUBSCRIBE UNSUBSCRIBE "
+             "PSUBSCRIBE PUNSUBSCRIBE PUBLISH", False, None)
+
+# keyless but state-mutating: a replica must refuse these (REPLPUSH is the
+# one sanctioned mutation path on a replica)
+_spec(SPECS, "FLUSHALL RESTORESTATE", True, None)
+
+# single-key reads
+_spec(SPECS, "EXISTS TTL PTTL TYPE GET GETBIT BITCOUNT GETBITS BF.EXISTS "
+             "BF.MEXISTS BF.INFO BF.MEXISTS64 BFA.MEXISTS64 PFCOUNT", False, 0)
+
+# single-key writes
+_spec(SPECS, "EXPIRE PEXPIRE PERSIST SET INCR INCRBY DECR SETBIT SETBITS "
+             "BF.RESERVE BF.ADD BF.MADD BF.MADD64 BFA.RESERVE BFA.MADD64 "
+             "PFADD64 PFADD", True, 0)
+
+# multi-key
+_spec(SPECS, "DEL UNLINK", True, 0, multi_key=True)
+_spec(SPECS, "RENAME", True, 0, multi_key=True)
+_spec(SPECS, "PFMERGE", True, 0, multi_key=True)
+# BITOP <op> <dest> <src>... — keys start at arg index 1
+SPECS["BITOP"] = CommandSpec("BITOP", True, 1, multi_key=True)
+# OBJCALL <factory> <name> <method> ... — key is arg index 1; writeness
+# depends on the method (objcall_is_write)
+SPECS["OBJCALL"] = CommandSpec("OBJCALL", True, 1)
+
+# Object-method prefixes that never mutate state: these may be served by a
+# replica (client read routing) and are allowed on a READONLY replica.
+# Everything not matching is treated as a write — the safe default.
+READ_METHOD_PREFIXES = (
+    "get", "contains", "count", "estimate", "is_", "peek", "size", "read",
+    "ttl", "remaining", "available", "keys", "values", "entries", "range",
+    "index_of", "to_", "iterator", "scan", "first", "last", "tenants",
+    "cardinality", "length", "union_count", "try_iterate", "random",
+    "element", "stream_info", "state", "tenant_bit_counts", "name",
+)
+
+
+def objcall_is_write(method: str) -> bool:
+    m = method.lower()
+    return not any(m.startswith(p) for p in READ_METHOD_PREFIXES)
+
+
+def lookup(cmd: str) -> Optional[CommandSpec]:
+    return SPECS.get(cmd.upper())
+
+
+def command_keys(cmd: str, args: List[bytes]) -> List[bytes]:
+    """Key args of an encoded command (args EXCLUDE the command name)."""
+    spec = lookup(cmd)
+    if spec is None or spec.key_at is None or len(args) <= spec.key_at:
+        return []
+    if spec.multi_key:
+        return list(args[spec.key_at:])
+    return [args[spec.key_at]]
+
+
+def is_write(cmd: str, args: List[bytes]) -> bool:
+    spec = lookup(cmd)
+    if spec is None:
+        return True  # unknown commands are treated as writes (safe default)
+    if spec.name == "OBJCALL" and len(args) >= 3:
+        method = args[2]
+        if isinstance(method, bytes):
+            method = method.decode()
+        return objcall_is_write(method)
+    return spec.write

@@ -1,0 +1,146 @@
+"""FailoverCoordinator: the sentinel-role monitor for tpu clusters.
+
+Parity targets (SURVEY.md §5.3):
+  * detection — ``client/PingConnectionHandler.java:60-104`` periodic ping +
+    pluggable ``client/FailedNodeDetector.java`` thresholds (reused verbatim
+    from net/detectors.py);
+  * recovery — the sentinel manager's master switch
+    (``connection/SentinelConnectionManager.java:210,281-430``) and the
+    cluster manager's ``checkMasterNodesChange`` -> ``changeMaster`` path
+    (``cluster/ClusterConnectionManager.java``): on confirmed master death,
+    promote a replica (REPLICAOF NO ONE), rewrite the slot view on every
+    surviving node (CLUSTER SETVIEW), re-point sibling replicas.
+
+Unlike Redis Sentinel there is no quorum vote — one coordinator owns the
+decision (run it supervised; a standby can watch the same topology since
+promotion is idempotent: SETVIEW is last-writer-wins and replicas of the old
+master re-register against the promoted one).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from redisson_tpu.net.client import NodeClient
+from redisson_tpu.net.detectors import FailedConnectionDetector
+
+
+class MonitoredMaster:
+    def __init__(self, address: str, slot_range: Tuple[int, int], node_id: str):
+        self.address = address
+        self.slot_range = slot_range
+        self.node_id = node_id
+        # 3 failed pings in a short window = dead (the coordinator pings
+        # every check_interval, so the window bounds detection latency)
+        self.detector = FailedConnectionDetector(threshold=3, window_s=30.0)
+        self.client = NodeClient(address, ping_interval=0, retry_attempts=0)
+        self.replicas: List[str] = []
+
+
+class FailoverCoordinator:
+    """Watches the masters of one cluster view; promotes replicas on death."""
+
+    def __init__(
+        self,
+        view: List[Tuple[int, int, str, int, str]],
+        check_interval: float = 0.5,
+        on_failover: Optional[Callable[[str, str], None]] = None,
+    ):
+        self._masters: Dict[str, MonitoredMaster] = {}
+        for lo, hi, host, port, nid in view:
+            addr = f"{host}:{port}"
+            self._masters[addr] = MonitoredMaster(addr, (lo, hi), nid)
+        self.check_interval = check_interval
+        self.on_failover = on_failover
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.failovers: List[Tuple[str, str]] = []  # (dead master, promoted)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "FailoverCoordinator":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="rtpu-failover"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for m in self._masters.values():
+            m.client.close()
+
+    # -- the check loop (scheduleClusterChangeCheck analog) -------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.check_interval):
+            for m in list(self._masters.values()):
+                self._check(m)
+
+    def _check(self, m: MonitoredMaster) -> None:
+        try:
+            reply = m.client.execute("PING", timeout=2.0)
+            ok = reply in (b"PONG", "PONG")
+        except Exception:  # noqa: BLE001 — unreachable counts as a failed ping
+            ok = False
+        if ok:
+            m.detector.on_ping_successful()
+            try:
+                reps = m.client.execute("REPLICAS", timeout=2.0)
+                m.replicas = [r.decode() if isinstance(r, bytes) else r for r in reps]
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        m.detector.on_ping_failed()
+        if m.detector.is_node_failed():
+            self._failover(m)
+
+    # -- promotion ------------------------------------------------------------
+
+    def _failover(self, dead: MonitoredMaster) -> None:
+        self._masters.pop(dead.address, None)
+        dead.client.close()
+        promoted: Optional[str] = None
+        for candidate in dead.replicas:
+            try:
+                c = NodeClient(candidate, ping_interval=0, retry_attempts=0)
+                c.execute("REPLICAOF", "NO", "ONE", timeout=10.0)
+                c.close()
+                promoted = candidate
+                break
+            except Exception:  # noqa: BLE001 — try the next replica
+                continue
+        if promoted is None:
+            return  # no live replica: slot range stays down (CLUSTERDOWN)
+        host, port = promoted.rsplit(":", 1)
+        nm = MonitoredMaster(promoted, dead.slot_range, dead.node_id)
+        nm.replicas = [r for r in dead.replicas if r != promoted]
+        self._masters[promoted] = nm
+        # rewrite the view everywhere (SETVIEW is last-writer-wins)
+        flat: List = []
+        for m in self._masters.values():
+            h, p = m.address.rsplit(":", 1)
+            flat += [m.slot_range[0], m.slot_range[1], h, int(p), m.node_id]
+        for m in list(self._masters.values()):
+            try:
+                m.client.execute("CLUSTER", "SETVIEW", *flat, timeout=5.0)
+            except Exception:  # noqa: BLE001 — node will catch up on next view push
+                pass
+        # surviving replicas of the dead master re-attach to the promoted one
+        for r in nm.replicas:
+            try:
+                rc = NodeClient(r, ping_interval=0, retry_attempts=0)
+                rc.execute("CLUSTER", "SETVIEW", *flat, timeout=5.0)
+                rc.execute("REPLICAOF", host, int(port), timeout=120.0)
+                rc.close()
+            except Exception:  # noqa: BLE001
+                continue
+        self.failovers.append((dead.address, promoted))
+        if self.on_failover is not None:
+            try:
+                self.on_failover(dead.address, promoted)
+            except Exception:  # noqa: BLE001 — user callback must not kill the loop
+                pass

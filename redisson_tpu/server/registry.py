@@ -58,6 +58,8 @@ class Registry:
             raise RespError(f"ERR unknown command '{cmd.decode()}'")
         if not ctx.authenticated and cmd not in (b"AUTH", b"HELLO", b"QUIT", b"PING"):
             raise RespError("NOAUTH Authentication required.")
+        if server.cluster_view or server.role == "replica":
+            server.check_routing(cmd.decode(), args[1:])
         return handler(server, ctx, args[1:])
 
 
@@ -625,7 +627,96 @@ def cmd_cluster(server, ctx, args):
     if sub == b"INFO":
         state = "ok" if server.cluster_view else "ok"
         return f"cluster_enabled:{1 if server.cluster_view else 0}\r\ncluster_state:{state}\r\n".encode()
+    if sub == b"SETVIEW":
+        # SETVIEW <from> <to> <host> <port> <node_id> ... (5-tuples) —
+        # the topology/launcher (harness.ClusterRunner, server/monitor.py)
+        # installs the slot map on every node; the reference's analog is
+        # each node's view from CLUSTER NODES gossip
+        rest = args[1:]
+        if len(rest) % 5 != 0:
+            raise RespError("ERR SETVIEW expects 5-tuples")
+        view = []
+        for i in range(0, len(rest), 5):
+            view.append(
+                (
+                    _int(rest[i]),
+                    _int(rest[i + 1]),
+                    _s(rest[i + 2]),
+                    _int(rest[i + 3]),
+                    _s(rest[i + 4]),
+                )
+            )
+        server.cluster_view = view
+        return "+OK"
+    if sub == b"RESET":
+        server.cluster_view = []
+        return "+OK"
     raise RespError("ERR unknown CLUSTER subcommand")
+
+
+# -- replication (server/replication.py) -------------------------------------
+
+@register("REPLICAOF")
+def cmd_replicaof(server, ctx, args):
+    """REPLICAOF NO ONE -> become master; REPLICAOF <host> <port> -> full
+    sync from master, then register for the push stream."""
+    if len(args) == 2 and bytes(args[0]).upper() == b"NO" and bytes(args[1]).upper() == b"ONE":
+        server.role = "master"
+        server.master_address = None
+        return "+OK"
+    if len(args) != 2:
+        raise RespError("ERR REPLICAOF <host> <port> | NO ONE")
+    host, port = _s(args[0]), _int(args[1])
+    from redisson_tpu.net.client import NodeClient
+    from redisson_tpu.server import replication
+
+    master = NodeClient(f"{host}:{port}", ping_interval=0, retry_attempts=1)
+    try:
+        blob = master.execute("REPLSNAPSHOT", timeout=60.0)
+        replication.apply_records(server.engine, bytes(blob))
+        master.execute("REPLREGISTER", server.host, server.port)
+    finally:
+        master.close()
+    server.role = "replica"
+    server.master_address = f"{host}:{port}"
+    return "+OK"
+
+
+@register("REPLSNAPSHOT")
+def cmd_replsnapshot(server, ctx, args):
+    from redisson_tpu.server import replication
+
+    blob, _shipped = replication.serialize_records(server.engine)
+    return blob
+
+
+@register("REPLREGISTER")
+def cmd_replregister(server, ctx, args):
+    host, port = _s(args[0]), _int(args[1])
+    server.replication_source().register(f"{host}:{port}")
+    return "+OK"
+
+
+@register("REPLPUSH")
+def cmd_replpush(server, ctx, args):
+    from redisson_tpu.server import replication
+
+    return replication.apply_records(server.engine, bytes(args[0]))
+
+
+@register("REPLFLUSH")
+def cmd_replflush(server, ctx, args):
+    """Ship dirty records to all replicas NOW (WAIT / syncSlaves analog)."""
+    if server._replication is None:
+        return 0
+    return server._replication.flush()
+
+
+@register("REPLICAS")
+def cmd_replicas(server, ctx, args):
+    if server._replication is None:
+        return []
+    return [a.encode() for a in server._replication.replicas()]
 
 
 # -- checkpoint (SAVE analog; full impl in core/checkpoint.py) ---------------

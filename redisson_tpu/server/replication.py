@@ -1,0 +1,205 @@
+"""Record-level async replication: master ships changed StateRecords.
+
+Reference parity: Redisson delegates replication entirely to Redis
+(master/slave links polled by ``connection/MasterSlaveEntry`` and managed by
+the sentinel/replicated/cluster managers — SURVEY.md §2.2); the client only
+*routes* to replicas.  In the TPU build the server IS the data plane, so
+replication is native here — and instead of replaying a command stream (the
+Redis way), the master ships whole changed records: object state is already
+a small set of device arrays + host struct, every record carries a version
+counter bumped by each mutation, and array state serializes cleanly.  This
+is the op-log idea of SURVEY.md §7.1-L2' collapsed to its coarsest correct
+granularity: per-record last-writer-wins, asynchronous (replica lag mirrors
+Redis async replication semantics; REPLFLUSH forces a synchronous ship —
+the WAIT analog used by BatchOptions.syncSlaves).
+
+Wire protocol (all internal commands, net/commands.py marks them keyless):
+  replica -> master : REPLREGISTER <host> <port>     (after full sync pull)
+  replica -> master : REPLSNAPSHOT                    -> serialized records
+  master  -> replica: REPLPUSH <blob>                 (batch of records)
+  any     -> master : REPLFLUSH                       (ship now, wait)
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def serialize_records(
+    engine, names: Optional[List[str]] = None
+) -> Tuple[bytes, List[Tuple[str, int]]]:
+    """Consistent host-side cut of (all | named) records.
+
+    Returns (blob, [(name, version), ...]) — shipped versions come back so
+    the caller can track per-replica progress without re-decoding the blob.
+    The blob also carries the full live-name list: deletions don't bump any
+    record version, so the receiving replica prunes records absent from it
+    (DEL/UNLINK/FLUSHALL propagation under record-level shipping).
+    """
+    store = engine.store
+    with store._lock:
+        live = [n for n, r in store._states.items() if not r.expired()]
+        items = [
+            (n, store._states[n]) for n in live if names is None or n in names
+        ]
+    out = []
+    shipped: List[Tuple[str, int]] = []
+    for name, rec in items:
+        with engine.locked(name):
+            out.append(
+                {
+                    "name": name,
+                    "kind": rec.kind,
+                    "meta": dict(rec.meta),
+                    "version": rec.version,
+                    "expire_at": rec.expire_at,
+                    "host_pickled": pickle.dumps(rec.host, protocol=4),
+                    "arrays": {k: np.asarray(v) for k, v in rec.arrays.items()},
+                }
+            )
+            shipped.append((name, rec.version))
+    blob = pickle.dumps({"format": 1, "records": out, "live": live}, protocol=4)
+    return blob, shipped
+
+
+def apply_records(engine, blob: bytes) -> int:
+    """Install shipped records (last-writer-wins by version). Returns #applied."""
+    from redisson_tpu.core.checkpoint import _loads
+    from redisson_tpu.core.store import StateRecord
+
+    import jax.numpy as jnp
+
+    payload = _loads(blob)
+    applied = 0
+    for item in payload["records"]:
+        name = item["name"]
+        with engine.locked(name):
+            existing = engine.store.get(name)
+            if existing is not None and existing.version >= item["version"]:
+                continue  # stale ship (out-of-order push) — keep newer state
+            rec = StateRecord(
+                kind=item["kind"],
+                meta=item["meta"],
+                arrays={k: jnp.asarray(v) for k, v in item["arrays"].items()},
+                host=pickle.loads(item["host_pickled"]),  # noqa: S301 — trusted repl link
+            )
+            rec.version = item["version"]
+            rec.expire_at = item["expire_at"]
+            engine.store.put(name, rec)
+            applied += 1
+    live = payload.get("live")
+    if live is not None:
+        # prune records the master no longer has (deletion propagation)
+        live_set = set(live)
+        with engine.store._lock:
+            stale = [n for n in engine.store._states if n not in live_set]
+        for n in stale:
+            engine.store.delete(n)
+            applied += 1
+    return applied
+
+
+class ReplicaHandle:
+    """Master-side link to one registered replica."""
+
+    def __init__(self, address: str):
+        from redisson_tpu.net.client import NodeClient
+
+        self.address = address
+        self.client = NodeClient(address, ping_interval=0, retry_attempts=1)
+        self.shipped: Dict[str, int] = {}  # record name -> version last shipped
+        self.healthy = True
+
+
+class ReplicationSource:
+    """Master-side shipper: debounced scan of store versions, push deltas.
+
+    The scan is cheap (version compare per record, host memory only); array
+    serialization happens only for dirty records.  Interval = replica lag
+    upper bound under steady write load.
+    """
+
+    def __init__(self, server, interval: float = 0.2):
+        self.server = server
+        self.interval = interval
+        self._replicas: Dict[str, ReplicaHandle] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, address: str) -> None:
+        with self._lock:
+            if address not in self._replicas:
+                self._replicas[address] = ReplicaHandle(address)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="rtpu-repl-ship"
+                )
+                self._thread.start()
+
+    def unregister(self, address: str) -> None:
+        with self._lock:
+            h = self._replicas.pop(address, None)
+        if h is not None:
+            h.client.close()
+
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return list(self._replicas)
+
+    def flush(self) -> int:
+        """Ship everything dirty NOW, synchronously (the WAIT analog)."""
+        return self._ship_once()
+
+    def _dirty_for(self, handle: ReplicaHandle) -> Tuple[List[str], List[str]]:
+        """(records to ship, shipped names since deleted on the master)."""
+        engine = self.server.engine
+        with engine.store._lock:
+            live = {n: r for n, r in engine.store._states.items() if not r.expired()}
+        dirty = [n for n, r in live.items() if handle.shipped.get(n, -1) < r.version]
+        deleted = [n for n in handle.shipped if n not in live]
+        return dirty, deleted
+
+    def _ship_once(self) -> int:
+        with self._lock:
+            replicas = list(self._replicas.values())
+        total = 0
+        for h in replicas:
+            names, deleted = self._dirty_for(h)
+            if not names and not deleted:
+                continue
+            # the blob's live-name list makes the replica prune deletions,
+            # so a deletions-only sweep ships an empty record set
+            blob, shipped = serialize_records(self.server.engine, names)
+            try:
+                h.client.execute("REPLPUSH", blob, timeout=30.0)
+                h.healthy = True
+            except Exception:  # noqa: BLE001 — replica down; retry next sweep
+                h.healthy = False
+                continue
+            for name, version in shipped:
+                h.shipped[name] = version
+            for name in deleted:
+                h.shipped.pop(name, None)
+            total += len(names) + len(deleted)
+        return total
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._ship_once()
+            except Exception:  # noqa: BLE001 — keep the shipper alive
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            for h in self._replicas.values():
+                h.client.close()
+            self._replicas.clear()

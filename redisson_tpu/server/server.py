@@ -51,6 +51,11 @@ class TpuServer:
         # cluster_view: [(slot_from, slot_to, host, port, node_id)] when this
         # node is part of a cluster (set by the topology/launcher, L3')
         self.cluster_view: List[Tuple[int, int, str, int, str]] = []
+        # -- cluster / replication role (server/replication.py) -------------
+        self.role = "master"  # "master" | "replica"
+        self.master_address: Optional[str] = None
+        self._replication = None  # lazy ReplicationSource (master side)
+        self._repl_lock = threading.Lock()
         self._client_ids = iter(range(1, 1 << 62))
         self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="rtpu-srv")
         # OBJCALL may run arbitrarily-blocking object methods (blocking
@@ -84,6 +89,58 @@ class TpuServer:
             [lo, hi, [h.encode(), p, nid.encode()]]
             for (lo, hi, h, p, nid) in self.cluster_view
         ]
+
+    # -- cluster routing / replication role ----------------------------------
+
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def owns_slot(self, slot: int) -> bool:
+        if not self.cluster_view:
+            return True
+        for lo, hi, h, p, _nid in self.cluster_view:
+            if lo <= slot <= hi:
+                if (h, p) == (self.host, self.port):
+                    return True
+                # a replica serves READS for its master's range (the READONLY
+                # connection mode of Redis cluster replicas); writes are
+                # rejected separately by the role check in check_routing
+                return self.role == "replica" and self.master_address == f"{h}:{p}"
+        return False  # unassigned slot: treat as not owned
+
+    def moved_target(self, slot: int) -> Optional[Tuple[str, int]]:
+        for lo, hi, h, p, _nid in self.cluster_view:
+            if lo <= slot <= hi:
+                return h, p
+        return None
+
+    def check_routing(self, cmd: str, args: List[bytes]) -> None:
+        """MOVED + READONLY enforcement (the server half of the reference's
+        MOVED/ASK redirect protocol, cluster/ClusterConnectionManager +
+        command/RedisExecutor redirect handling)."""
+        from redisson_tpu.net import commands as C
+        from redisson_tpu.net.resp import RespError
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        if self.cluster_view:
+            for key in C.command_keys(cmd, args):
+                slot = calc_slot(key)
+                if not self.owns_slot(slot):
+                    target = self.moved_target(slot)
+                    if target is not None:
+                        raise RespError(f"MOVED {slot} {target[0]}:{target[1]}")
+                    raise RespError(f"CLUSTERDOWN Hash slot {slot} not served")
+        if self.role == "replica" and C.is_write(cmd, args):
+            raise RespError("READONLY You can't write against a read only replica.")
+
+    def replication_source(self):
+        """Lazy master-side record shipper (server/replication.py)."""
+        from redisson_tpu.server.replication import ReplicationSource
+
+        with self._repl_lock:
+            if self._replication is None:
+                self._replication = ReplicationSource(self)
+            return self._replication
 
     def info_text(self) -> str:
         up = int(time.time() - self.started_at)
@@ -226,6 +283,8 @@ class TpuServer:
                         pass
 
             loop.call_soon_threadsafe(shutdown)
+        if self._replication is not None:
+            self._replication.close()
         self._pool.shutdown(wait=False)
         self._slow_pool.shutdown(wait=False)
 
@@ -285,6 +344,21 @@ class ServerThread:
         self.server.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+    def client(self):
+        """One-shot admin connection (context manager) to this node."""
+        from contextlib import closing
+
+        from redisson_tpu.net.client import Connection
+
+        return closing(
+            Connection(
+                self.server.host,
+                self.server.port,
+                timeout=120.0,
+                password=self.server.password,
+            )
+        )
 
 
 def main(argv=None):
