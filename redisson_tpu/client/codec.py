@@ -196,12 +196,77 @@ try:  # optional, gated: msgpack is not in the baked image
 except ImportError:  # pragma: no cover
     MsgPackCodec = None  # type: ignore
 
+
+class Bz2Codec(Codec):
+    """bz2 compression wrapper (higher ratio / slower than Zlib — the LZ4-vs-
+    Snappy trade of the reference's two compression codecs)."""
+
+    name = "bz2"
+
+    def __init__(self, inner: Codec | None = None):
+        import bz2 as _bz2
+
+        self._bz2 = _bz2
+        self.inner = inner or JsonCodec()
+
+    def encode(self, value):
+        return self._bz2.compress(self.inner.encode(value))
+
+    def decode(self, data):
+        return self.inner.decode(self._bz2.decompress(data))
+
+
+class LzmaCodec(Codec):
+    """xz/lzma compression wrapper."""
+
+    name = "lzma"
+
+    def __init__(self, inner: Codec | None = None):
+        import lzma as _lzma
+
+        self._lzma = _lzma
+        self.inner = inner or JsonCodec()
+
+    def encode(self, value):
+        return self._lzma.compress(self.inner.encode(value))
+
+    def decode(self, data):
+        return self.inner.decode(self._lzma.decompress(data))
+
+
+class ProtobufCodec(Codec):
+    """Protocol-buffers codec for one message class (parity:
+    codec/ProtobufCodec.java — values must be instances of `message_cls`)."""
+
+    name = "protobuf"
+
+    def __init__(self, message_cls):
+        self.message_cls = message_cls
+
+    def encode(self, value):
+        if not isinstance(value, self.message_cls):
+            raise TypeError(
+                f"ProtobufCodec({self.message_cls.__name__}) cannot encode {type(value).__name__}"
+            )
+        return value.SerializeToString()
+
+    def decode(self, data):
+        msg = self.message_cls()
+        msg.ParseFromString(bytes(data))
+        return msg
+
+
 DEFAULT_CODEC = JsonCodec()
 
 _REGISTRY = {
     c.name: c
-    for c in [JsonCodec(), PickleCodec(), StringCodec(), BytesCodec(), LongCodec(), DoubleCodec()]
+    for c in [
+        JsonCodec(), PickleCodec(), StringCodec(), BytesCodec(), LongCodec(),
+        DoubleCodec(), ZlibCodec(), Bz2Codec(), LzmaCodec(),
+    ]
 }
+if MsgPackCodec is not None:
+    _REGISTRY["msgpack"] = MsgPackCodec()
 
 
 def by_name(name: str) -> Codec:

@@ -15,6 +15,19 @@ padding never corrupts state.
 
 State-mutating kernels donate their state argument: XLA writes the new state
 into the same HBM buffer — in-place semantics without in-place ops.
+
+Why there is no Pallas kernel here (measured decision, 2026-07): the hot ops
+are random-access bit/register probes — per-key gathers/scatters over a
+plane far larger than VMEM.  Pallas on TPU has no vectorized gather (only
+`pl.ds` slice-style dynamic indexing), so a hand-written probe kernel
+degenerates to a scalar loop or a one-hot matmul whose one-hot operand is
+O(batch x plane_rows) — both strictly worse than XLA's native gather unit.
+Microbenchmarks (bank contains, 114k keys x k=7 over a (1000, 96256) plane,
+v5e): XLA flat gather ~21us; blocked row-gather variants 20-30us; the whole
+flush is transfer-bound (~ms), not kernel-bound.  The elementwise hash chain
+fuses into the gather kernel under XLA already.  Pallas remains the right
+tool for the mesh collectives' custom overlap if profiling ever shows XLA's
+psum/pmax lagging (see parallel/sharded.py) — not for these probes.
 """
 from __future__ import annotations
 

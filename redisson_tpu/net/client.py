@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from redisson_tpu.net import resp
 from redisson_tpu.net.detectors import FailedNodeDetector
 from redisson_tpu.net.resp import Push, RespError
+from redisson_tpu.utils import metrics as _metrics
 
 
 def parse_address(addr: str) -> Tuple[str, int]:
@@ -228,17 +229,56 @@ class PubSubConnection:
 
 class ConnectionPool:
     """Bounded blocking pool with min-idle warmup
-    (connection/pool/ConnectionPool.java:47-120 — AsyncSemaphore acquire)."""
+    (connection/pool/ConnectionPool.java:47-120 — AsyncSemaphore acquire)
+    and an idle reaper (connection/IdleConnectionWatcher.java: pooled
+    connections idle beyond `idle_timeout` close, down to `min_idle`)."""
 
-    def __init__(self, factory: Callable[[], Connection], size: int = 8, min_idle: int = 1):
+    def __init__(
+        self,
+        factory: Callable[[], Connection],
+        size: int = 8,
+        min_idle: int = 1,
+        idle_timeout: float = 60.0,
+    ):
         self._factory = factory
         self._size = size
+        self._min_idle = min(min_idle, size)
+        self._idle_timeout = idle_timeout
         self._sem = threading.Semaphore(size)
-        self._idle: List[Connection] = []
+        self._idle: List[Tuple[Connection, float]] = []  # (conn, idle-since)
         self._lock = threading.Lock()
         self.in_use = 0  # CommandsLoadBalancer feed (least in-flight picks)
-        for _ in range(min(min_idle, size)):
-            self._idle.append(factory())
+        self._closed = False
+        for _ in range(self._min_idle):
+            self._idle.append((factory(), time.monotonic()))
+        self._reaper: Optional[threading.Timer] = None
+        if idle_timeout and idle_timeout > 0:
+            self._schedule_reap()
+
+    def _schedule_reap(self) -> None:
+        self._reaper = threading.Timer(max(self._idle_timeout / 2, 1.0), self._reap)
+        self._reaper.daemon = True
+        self._reaper.start()
+
+    def _reap(self) -> None:
+        now = time.monotonic()
+        victims: List[Connection] = []
+        with self._lock:
+            if self._closed:
+                return
+            keep: List[Tuple[Connection, float]] = []
+            for conn, since in self._idle:
+                if (
+                    len(self._idle) - len(victims) > self._min_idle
+                    and now - since > self._idle_timeout
+                ):
+                    victims.append(conn)
+                else:
+                    keep.append((conn, since))
+            self._idle = keep
+        for conn in victims:
+            conn.close()
+        self._schedule_reap()
 
     def acquire(self, timeout: float = 10.0) -> Connection:
         if not self._sem.acquire(timeout=timeout):
@@ -249,7 +289,7 @@ class ConnectionPool:
         with self._lock:
             self.in_use += 1
             while self._idle:
-                conn = self._idle.pop()
+                conn, _since = self._idle.pop()
                 if not conn.closed:
                     return conn
         try:
@@ -264,7 +304,7 @@ class ConnectionPool:
         with self._lock:
             self.in_use -= 1
             if not conn.closed:
-                self._idle.append(conn)
+                self._idle.append((conn, time.monotonic()))
         self._sem.release()
 
     def discard(self, conn: Connection) -> None:
@@ -275,9 +315,12 @@ class ConnectionPool:
 
     def close(self) -> None:
         with self._lock:
-            for c in self._idle:
+            self._closed = True
+            for c, _since in self._idle:
                 c.close()
             self._idle.clear()
+        if self._reaper is not None:
+            self._reaper.cancel()
 
     def idle_count(self) -> int:
         with self._lock:
@@ -306,6 +349,7 @@ class NodeClient:
         retry_interval: float = 1.5,
         ping_interval: float = 30.0,
         detector: Optional[FailedNodeDetector] = None,
+        hooks: Optional[List] = None,
     ):
         self.address = address
         self.host, self.port = parse_address(address)
@@ -316,6 +360,7 @@ class NodeClient:
         self.retry_attempts = retry_attempts
         self.retry_interval = retry_interval
         self.detector = detector or FailedNodeDetector()
+        self.hooks = list(hooks or [])  # CommandHook SPI (utils/metrics.py)
         self._closed = threading.Event()
         self.pool = ConnectionPool(self._connect, size=pool_size, min_idle=min_idle)
         self._pubsub: Optional[PubSubConnection] = None
@@ -347,10 +392,40 @@ class NodeClient:
     # -- command path --------------------------------------------------------
 
     def execute(self, *args, timeout: Optional[float] = None) -> Any:
-        return self._with_retry(lambda c: c.execute(*args, timeout=timeout))
+        if not self.hooks:
+            return self._with_retry(lambda c: c.execute(*args, timeout=timeout))
+        return self._hooked(
+            str(args[0]), args[1:],
+            lambda: self._with_retry(lambda c: c.execute(*args, timeout=timeout)),
+        )
+
+    def _hooked(self, name: str, args, fn: Callable[[], Any]) -> Any:
+        tokens = _metrics.run_hooks_start(self.hooks, name, args)
+        try:
+            result = fn()
+        except BaseException as e:
+            _metrics.run_hooks_end(tokens, name, e)
+            raise
+        _metrics.run_hooks_end(tokens, name, None)
+        return result
 
     def execute_many(self, commands: List[Tuple], timeout: Optional[float] = None) -> List[Any]:
-        return self._with_retry(lambda c: c.execute_many(commands, timeout=timeout))
+        if not self.hooks:
+            return self._with_retry(lambda c: c.execute_many(commands, timeout=timeout))
+        # instrument the batch per command so the RBatch hot path is visible
+        # to the same dashboards as single dispatches
+        tokens = [
+            _metrics.run_hooks_start(self.hooks, str(c[0]), c[1:]) for c in commands
+        ]
+        try:
+            result = self._with_retry(lambda c: c.execute_many(commands, timeout=timeout))
+        except BaseException as e:
+            for toks, c in zip(tokens, commands):
+                _metrics.run_hooks_end(toks, str(c[0]), e)
+            raise
+        for toks, c in zip(tokens, commands):
+            _metrics.run_hooks_end(toks, str(c[0]), None)
+        return result
 
     def _with_retry(self, fn: Callable[[Connection], Any]) -> Any:
         last: Optional[BaseException] = None

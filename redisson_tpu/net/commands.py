@@ -35,7 +35,7 @@ SPECS: dict = {}
 _spec(SPECS, "PING ECHO AUTH HELLO SELECT CLIENT QUIT DBSIZE TIME INFO MEMORY "
              "CLUSTER KEYS SAVE REPLICAOF REPLREGISTER "
              "REPLPUSH REPLFLUSH REPLSNAPSHOT REPLICAS SUBSCRIBE UNSUBSCRIBE "
-             "PSUBSCRIBE PUNSUBSCRIBE PUBLISH", False, None)
+             "PSUBSCRIBE PUNSUBSCRIBE PUBLISH METRICS", False, None)
 
 # keyless but state-mutating: a replica must refuse these (REPLPUSH is the
 # one sanctioned mutation path on a replica)

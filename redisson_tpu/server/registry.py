@@ -20,6 +20,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from redisson_tpu.net.resp import Push, RespError
+from redisson_tpu.utils.metrics import run_hooks_end, run_hooks_start
 from redisson_tpu.version import __version__ as VERSION
 
 
@@ -60,7 +61,18 @@ class Registry:
             raise RespError("NOAUTH Authentication required.")
         if server.cluster_view or server.role == "replica":
             server.check_routing(cmd.decode(), args[1:])
-        return handler(server, ctx, args[1:])
+        hooks = getattr(server, "hooks", None)
+        if not hooks:
+            return handler(server, ctx, args[1:])
+        name = cmd.decode()
+        tokens = run_hooks_start(hooks, name, args[1:])
+        try:
+            result = handler(server, ctx, args[1:])
+        except BaseException as e:
+            run_hooks_end(tokens, name, e)
+            raise
+        run_hooks_end(tokens, name, None)
+        return result
 
 
 REGISTRY = Registry()
@@ -717,6 +729,12 @@ def cmd_replicas(server, ctx, args):
     if server._replication is None:
         return []
     return [a.encode() for a in server._replication.replicas()]
+
+
+@register("METRICS")
+def cmd_metrics(server, ctx, args):
+    """Prometheus text exposition of the node's metrics registry."""
+    return server.metrics.prometheus_text().encode()
 
 
 # -- checkpoint (SAVE analog; full impl in core/checkpoint.py) ---------------

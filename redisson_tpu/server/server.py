@@ -48,6 +48,14 @@ class TpuServer:
         self.node_id = uuid.uuid4().hex
         self.started_at = time.time()
         self.stats = {"connections": 0, "commands": 0, "errors": 0}
+        # observability (utils/metrics.py): per-command timers + counters,
+        # rendered by the METRICS command; hooks = NettyHook-analog SPI
+        from redisson_tpu.utils.metrics import MetricsHook, MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        self.hooks = [MetricsHook(self.metrics)]
+        self.metrics.gauge("keys", lambda: len(self.engine.store))
+        self.metrics.gauge("connections", lambda: self.stats["connections"])
         # cluster_view: [(slot_from, slot_to, host, port, node_id)] when this
         # node is part of a cluster (set by the topology/launcher, L3')
         self.cluster_view: List[Tuple[int, int, str, int, str]] = []

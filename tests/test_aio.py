@@ -1,0 +1,143 @@
+"""Async client API tests (the reference's async/reactive facade analog).
+
+pytest-asyncio is not in the image; each test drives its own event loop via
+asyncio.run — which also proves the client needs no special runner.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from redisson_tpu.client.aio import AsyncRemoteRedisson
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture(scope="module")
+def server():
+    with ServerThread(port=0) as st:
+        yield st
+
+
+def test_async_basic_objects(server):
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            m = client.get_map("aio-m")
+            await m.put("k", 41)
+            assert await m.get("k") == 41
+            assert await m.size() == 1
+
+            q = client.get_queue("aio-q")
+            await q.offer("a")
+            await q.offer("b")
+            assert await q.poll() == "a"
+
+            al = client.get_atomic_long("aio-counter")
+            assert await al.increment_and_get() == 1
+            assert await al.add_and_get(9) == 10
+
+    asyncio.run(main())
+
+
+def test_async_pipelining_single_connection(server):
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            # many concurrent ops multiplex over ONE pipelined connection
+            al = client.get_atomic_long("aio-pipe")
+            results = await asyncio.gather(*(al.increment_and_get() for _ in range(50)))
+            assert sorted(results) == list(range(1, 51))
+            # raw pipeline: one write burst, ordered replies
+            replies = await client.node.execute_pipeline(
+                [("SET", f"aio-{i}", str(i)) for i in range(10)]
+                + [("GET", f"aio-{i}") for i in range(10)]
+            )
+            assert [int(r) for r in replies[10:]] == list(range(10))
+
+    asyncio.run(main())
+
+
+def test_async_error_and_reconnect_surface(server):
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            with pytest.raises(RespError):
+                await client.execute("NOSUCHCMD")
+            # still usable after an error reply
+            b = client.get_bucket("aio-b")
+            await b.set("v")
+            assert await b.get() == "v"
+
+    asyncio.run(main())
+
+
+def test_async_pubsub(server):
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            q = await client.subscribe("aio-chan")
+            await asyncio.sleep(0.1)  # let the subscription register
+            n = await client.execute("PUBLISH", "aio-chan", b"hello")
+            assert n >= 1
+            channel, payload = await asyncio.wait_for(q.get(), timeout=5)
+            assert payload == b"hello"
+
+    asyncio.run(main())
+
+
+def test_async_lock_roundtrip(server):
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            lock = client.get_lock("aio-lock")
+            assert await lock.try_lock() is True
+            # second client (distinct identity) cannot take it
+            async with await AsyncRemoteRedisson.connect(server.address) as other:
+                assert await other.get_lock("aio-lock").try_lock() is False
+            await lock.unlock()
+
+    asyncio.run(main())
+
+
+def test_async_orphan_error_reply_does_not_kill_reader(server):
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            conn = await client.node._connection()
+            # a send()-fired command whose reply is a plain error frame: no
+            # positional future exists — the reader must route it as an
+            # orphan, not die on QueueEmpty
+            conn.send("NOSUCHCMD")
+            await conn.drain()
+            await asyncio.sleep(0.2)
+            assert not conn.closed
+            assert await client.execute("PING") in (b"PONG", "PONG")
+
+    asyncio.run(main())
+
+
+def test_async_timeout_does_not_resend(server):
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            al = client.get_atomic_long("aio-timeout-counter")
+            await al.set(0)
+            # hold the lock under another identity so try_lock(wait=1s)
+            # genuinely blocks past the 0.05s client timeout
+            await client.node.execute(
+                "OBJCALL", "get_lock", "aio-slowlock", "try_lock",
+                __import__("pickle").dumps(((), {})), "holder:9",
+            )
+            with pytest.raises(TimeoutError):
+                await client.node.execute(
+                    "OBJCALL", "get_lock", "aio-slowlock", "try_lock",
+                    __import__("pickle").dumps(((1.0,), {})),
+                    "h:1", timeout=0.05,
+                )
+            v1 = await al.increment_and_get()
+            assert v1 == 1
+
+    asyncio.run(main())
+
+
+def test_async_factory_rejects_silent_codec(server):
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            with pytest.raises(TypeError):
+                client.get_bucket("b", object())
+
+    asyncio.run(main())

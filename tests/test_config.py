@@ -82,3 +82,44 @@ def test_from_file(tmp_path):
     p.write_text("threads: 3\n")
     cfg = Config.from_yaml(str(p))
     assert cfg.threads == 3
+
+
+# -- codec matrix (reference: RedissonCodecTest across ~20 codecs) -----------
+
+def test_codec_matrix_roundtrip():
+    from redisson_tpu.client import codec as C
+
+    value = {"s": "héllo", "n": 42, "list": [1, 2.5, None], "flag": True}
+    codecs = [
+        C.JsonCodec(), C.PickleCodec(), C.ZlibCodec(), C.Bz2Codec(), C.LzmaCodec(),
+        C.ZlibCodec(C.PickleCodec()), C.Bz2Codec(C.PickleCodec()),
+    ]
+    if C.MsgPackCodec is not None:
+        codecs.append(C.MsgPackCodec())
+    for codec in codecs:
+        data = codec.encode(value)
+        assert isinstance(data, bytes)
+        assert codec.decode(data) == value
+    assert C.StringCodec().decode(C.StringCodec().encode("x")) == "x"
+    assert C.LongCodec().decode(C.LongCodec().encode(2**40)) == 2**40
+    assert C.DoubleCodec().decode(C.DoubleCodec().encode(1.5)) == 1.5
+    assert C.by_name("bz2").name == "bz2"
+
+
+def test_codec_objects_end_to_end():
+    import numpy as np
+    import redisson_tpu
+    from redisson_tpu.client import codec as C
+
+    client = redisson_tpu.create()
+    try:
+        for codec in (C.ZlibCodec(), C.Bz2Codec(), C.PickleCodec()):
+            b = client.get_bucket(f"codec-{codec.name}", codec=codec)
+            b.set({"payload": [1, 2, 3]})
+            assert b.get() == {"payload": [1, 2, 3]}
+            bf = client.get_bloom_filter(f"bf-codec-{codec.name}", codec=codec)
+            bf.try_init(1000, 0.01)
+            bf.add("item-1")
+            assert bf.contains("item-1")
+    finally:
+        client.shutdown()

@@ -1,0 +1,101 @@
+"""Metrics registry + command-hook SPI tests (SURVEY.md §5.1/§5.5)."""
+import time
+
+import pytest
+
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.net.client import NodeClient
+from redisson_tpu.server.server import ServerThread
+from redisson_tpu.utils.metrics import (
+    CommandHook,
+    MetricsHook,
+    MetricsRegistry,
+)
+
+
+def test_registry_counters_gauges_timers():
+    reg = MetricsRegistry()
+    reg.counter("hits").inc()
+    reg.counter("hits").inc(4)
+    reg.gauge("depth", lambda: 7.5)
+    t = reg.timer("op")
+    for ms in (1, 2, 3, 100):
+        t.record(ms / 1000)
+    snap = reg.snapshot()
+    assert snap["hits"] == 5
+    assert snap["depth"] == 7.5
+    assert snap["op_count"] == 4
+    assert snap["op_total_seconds"] == pytest.approx(0.106)
+    assert snap["op_p99_seconds"] <= 0.1
+    text = reg.prometheus_text()
+    assert "rtpu_hits 5" in text and "rtpu_depth 7.5" in text
+
+
+def test_broken_gauge_does_not_kill_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("ok").inc()
+    reg.gauge("boom", lambda: 1 / 0)
+    assert reg.snapshot()["ok"] == 1
+
+
+def test_server_metrics_command():
+    with ServerThread(port=0) as st:
+        with RemoteRedisson(st.address) as client:
+            client.get_bucket("mk").set(1)
+            client.get_bucket("mk").get()
+            text = bytes(client.execute("METRICS")).decode()
+    assert "rtpu_commands_total" in text
+    assert "rtpu_command_objcall_count" in text or "rtpu_command_set_count" in text
+    assert "rtpu_keys 1" in text
+
+
+def test_client_side_hooks():
+    events = []
+
+    class Recording(CommandHook):
+        def on_start(self, command, args):
+            return command
+
+        def on_end(self, command, token, error):
+            events.append((command, error is None))
+
+    with ServerThread(port=0) as st:
+        node = NodeClient(st.address, ping_interval=0, hooks=[Recording()])
+        node.execute("PING")
+        node.execute("SET", "h", "1")
+        node.close()
+    assert ("PING", True) in events and ("SET", True) in events
+
+
+def test_metrics_hook_records_errors():
+    reg = MetricsRegistry()
+    hook = MetricsHook(reg)
+    token = hook.on_start("GET", ())
+    hook.on_end("GET", token, RuntimeError("x"))
+    snap = reg.snapshot()
+    assert snap["commands.errors"] == 1 and snap["commands.total"] == 1
+
+
+def test_idle_connection_reaper():
+    from redisson_tpu.net.client import ConnectionPool
+
+    made = []
+
+    class FakeConn:
+        def __init__(self):
+            self.closed = False
+            made.append(self)
+
+        def close(self):
+            self.closed = True
+
+    pool = ConnectionPool(FakeConn, size=8, min_idle=1, idle_timeout=0.1)
+    conns = [pool.acquire() for _ in range(5)]
+    for c in conns:
+        pool.release(c)
+    assert pool.idle_count() == 5
+    time.sleep(0.5)
+    pool._reap()  # deterministic sweep on top of the timer
+    assert pool.idle_count() == 1, "idle conns beyond min_idle must be reaped"
+    assert sum(1 for c in made if c.closed) >= 4
+    pool.close()
