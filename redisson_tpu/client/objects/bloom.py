@@ -123,7 +123,7 @@ class BloomFilter(RExpirable):
             m, k = rec.meta["m"], rec.meta["k"]
             bits = rec.arrays["bits"]
             if kind == "u64":
-                bits, count = K.bloom_add_packed_count(bits, arrays, n, k, m)
+                bits, count = K.bloom_add_packed_count(bits, arrays, K.valid_n(n), k, m)
             else:
                 words, nbytes = arrays
                 bits, newly = K.bloom_add_bytes_masked(bits, words, nbytes, n, k, m)
@@ -143,7 +143,7 @@ class BloomFilter(RExpirable):
             m, k = rec.meta["m"], rec.meta["k"]
             bits = rec.arrays["bits"]
             if kind == "u64":
-                bits, newly = K.bloom_add_packed(bits, arrays, n, k, m)
+                bits, newly = K.bloom_add_packed(bits, arrays, K.valid_n(n), k, m)
             else:
                 words, nbytes = arrays
                 bits, newly = K.bloom_add_bytes_masked(bits, words, nbytes, n, k, m)
@@ -182,7 +182,7 @@ class BloomFilter(RExpirable):
             m, k = rec.meta["m"], rec.meta["k"]
             bits = rec.arrays["bits"]
             if kind == "u64":
-                found = K.bloom_contains_packed_bits(bits, arrays, n, k, m)
+                found = K.bloom_contains_packed_bits(bits, arrays, K.valid_n(n), k, m)
             else:
                 words, nbytes = arrays
                 found = K.bloom_contains_bytes_masked(bits, words, nbytes, n, k, m)

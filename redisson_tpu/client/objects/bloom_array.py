@@ -103,7 +103,7 @@ class BloomFilterArray(RExpirable):
         with self._engine.locked(self._name):
             rec = self._rec()
             bits, newly = K.bloom_bank_add_packed(
-                rec.arrays["bits"], tlh, n, rec.meta["k"], rec.meta["m"]
+                rec.arrays["bits"], tlh, K.valid_n(n), rec.meta["k"], rec.meta["m"]
             )
             rec.arrays["bits"] = bits
             self._touch_version(rec)
@@ -123,7 +123,7 @@ class BloomFilterArray(RExpirable):
         with self._engine.locked(self._name):
             rec = self._rec()
             bits, count = K.bloom_bank_add_packed_count(
-                rec.arrays["bits"], tlh, n, rec.meta["k"], rec.meta["m"]
+                rec.arrays["bits"], tlh, K.valid_n(n), rec.meta["k"], rec.meta["m"]
             )
             rec.arrays["bits"] = bits
             self._touch_version(rec)
@@ -148,7 +148,7 @@ class BloomFilterArray(RExpirable):
         with self._engine.locked(self._name):
             rec = self._rec()
             found = K.bloom_bank_contains_packed_bits(
-                rec.arrays["bits"], tlh, n, rec.meta["k"], rec.meta["m"]
+                rec.arrays["bits"], tlh, K.valid_n(n), rec.meta["k"], rec.meta["m"]
             )
         return found, n
 

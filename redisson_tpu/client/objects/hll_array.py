@@ -61,7 +61,7 @@ class HyperLogLogArray(RExpirable):
         tlh = K.pack_rows(t, lo, hi, size=b)  # one contiguous transfer buffer
         with self._engine.locked(self._name):
             rec = self._rec()
-            rec.arrays["regs"] = K.hll_bank_add_packed(rec.arrays["regs"], tlh, n, rec.meta["p"])
+            rec.arrays["regs"] = K.hll_bank_add_packed(rec.arrays["regs"], tlh, K.valid_n(n), rec.meta["p"])
             self._touch_version(rec)
 
     def merge_rows(self, dst_ids, src_ids) -> None:
@@ -77,7 +77,7 @@ class HyperLogLogArray(RExpirable):
         with self._engine.locked(self._name):
             rec = self._rec()
             rec.arrays["regs"] = K.hll_bank_merge_rows(
-                rec.arrays["regs"], K.pad_to(dst, b), K.pad_to(src, b), n
+                rec.arrays["regs"], K.pad_to(dst, b), K.pad_to(src, b), K.valid_n(n)
             )
             self._touch_version(rec)
 

@@ -48,7 +48,7 @@ class HyperLogLog(RExpirable):
             p = rec.meta["p"]
             regs = rec.arrays["regs"]
             if kind == "u64":
-                new_regs = K.hll_add_packed(regs, arrays, n, p)
+                new_regs = K.hll_add_packed(regs, arrays, K.valid_n(n), p)
             else:
                 words, nbytes = arrays
                 new_regs = K.hll_add_bytes(regs, words, nbytes, n, p)

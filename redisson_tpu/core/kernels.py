@@ -79,6 +79,24 @@ def _valid_mask(n: int, n_valid) -> jax.Array:
     return jnp.arange(n, dtype=jnp.int32) < n_valid
 
 
+_N_CACHE: dict = {}
+_N_CACHE_MAX = 4096
+
+
+def valid_n(n: int):
+    """Device-resident int32 scalar for `n_valid` kernel args.
+
+    A Python int argument costs a fresh tiny host->device upload on every
+    call (~100us extra per dispatch over the tunnel); flush sizes repeat, so
+    a cached device scalar turns that into a one-time cost per distinct n."""
+    a = _N_CACHE.get(n)
+    if a is None:
+        if len(_N_CACHE) >= _N_CACHE_MAX:
+            _N_CACHE.clear()
+        a = _N_CACHE[n] = jnp.asarray(np.int32(n))
+    return a
+
+
 # --------------------------------------------------------------------------
 # Bloom filter kernels (state = expanded bit plane; k, m static per filter
 # geometry — the compile cache key).  Reference behavior being replaced:

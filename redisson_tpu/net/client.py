@@ -256,7 +256,19 @@ class ConnectionPool:
             self._schedule_reap()
 
     def _schedule_reap(self) -> None:
-        self._reaper = threading.Timer(max(self._idle_timeout / 2, 1.0), self._reap)
+        # the timer must not keep an abandoned pool alive: hold the pool by
+        # weakref so a dropped-without-close() NodeClient can still be GC'd
+        # (the timer chain ends when the ref dies)
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def fire():
+            pool = ref()
+            if pool is not None:
+                pool._reap()
+
+        self._reaper = threading.Timer(max(self._idle_timeout / 2, 1.0), fire)
         self._reaper.daemon = True
         self._reaper.start()
 
@@ -412,20 +424,13 @@ class NodeClient:
     def execute_many(self, commands: List[Tuple], timeout: Optional[float] = None) -> List[Any]:
         if not self.hooks:
             return self._with_retry(lambda c: c.execute_many(commands, timeout=timeout))
-        # instrument the batch per command so the RBatch hot path is visible
-        # to the same dashboards as single dispatches
-        tokens = [
-            _metrics.run_hooks_start(self.hooks, str(c[0]), c[1:]) for c in commands
-        ]
-        try:
-            result = self._with_retry(lambda c: c.execute_many(commands, timeout=timeout))
-        except BaseException as e:
-            for toks, c in zip(tokens, commands):
-                _metrics.run_hooks_end(toks, str(c[0]), e)
-            raise
-        for toks, c in zip(tokens, commands):
-            _metrics.run_hooks_end(toks, str(c[0]), None)
-        return result
+        # the batch is ONE wire round trip: record it as one PIPELINE[n]
+        # dispatch rather than n synthetic per-command timings — per-command
+        # timers must stay comparable with the single-dispatch path
+        return self._hooked(
+            "PIPELINE", (len(commands),),
+            lambda: self._with_retry(lambda c: c.execute_many(commands, timeout=timeout)),
+        )
 
     def _with_retry(self, fn: Callable[[Connection], Any]) -> Any:
         last: Optional[BaseException] = None

@@ -105,14 +105,17 @@ class FailoverCoordinator:
         dead.client.close()
         promoted: Optional[str] = None
         for candidate in dead.replicas:
+            c = None
             try:
                 c = NodeClient(candidate, ping_interval=0, retry_attempts=0)
                 c.execute("REPLICAOF", "NO", "ONE", timeout=10.0)
-                c.close()
                 promoted = candidate
                 break
             except Exception:  # noqa: BLE001 — try the next replica
                 continue
+            finally:
+                if c is not None:
+                    c.close()
         if promoted is None:
             return  # no live replica: slot range stays down (CLUSTERDOWN)
         host, port = promoted.rsplit(":", 1)
@@ -131,13 +134,16 @@ class FailoverCoordinator:
                 pass
         # surviving replicas of the dead master re-attach to the promoted one
         for r in nm.replicas:
+            rc = None
             try:
                 rc = NodeClient(r, ping_interval=0, retry_attempts=0)
                 rc.execute("CLUSTER", "SETVIEW", *flat, timeout=5.0)
                 rc.execute("REPLICAOF", host, int(port), timeout=120.0)
-                rc.close()
             except Exception:  # noqa: BLE001
                 continue
+            finally:
+                if rc is not None:
+                    rc.close()
         self.failovers.append((dead.address, promoted))
         if self.on_failover is not None:
             try:

@@ -141,3 +141,25 @@ def test_async_factory_rejects_silent_codec(server):
                 client.get_bucket("b", object())
 
     asyncio.run(main())
+
+
+def test_async_pubsub_multiplexed_and_unsubscribe(server):
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            q1 = await client.subscribe("mux-1")
+            q2 = await client.subscribe("mux-2")
+            # both channels share ONE pubsub connection
+            assert client._pubsub is not None
+            await asyncio.sleep(0.1)
+            await client.execute("PUBLISH", "mux-1", b"a")
+            await client.execute("PUBLISH", "mux-2", b"b")
+            assert (await asyncio.wait_for(q1.get(), 5))[1] == b"a"
+            assert (await asyncio.wait_for(q2.get(), 5))[1] == b"b"
+            await client.unsubscribe("mux-1")
+            await asyncio.sleep(0.1)
+            await client.execute("PUBLISH", "mux-1", b"gone")
+            await client.execute("PUBLISH", "mux-2", b"still")
+            assert (await asyncio.wait_for(q2.get(), 5))[1] == b"still"
+            assert q1.empty(), "unsubscribed channel must stop delivering"
+
+    asyncio.run(main())
