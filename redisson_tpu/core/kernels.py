@@ -92,7 +92,7 @@ def valid_n(n: int):
     a = _N_CACHE.get(n)
     if a is None:
         if len(_N_CACHE) >= _N_CACHE_MAX:
-            _N_CACHE.clear()
+            _N_CACHE.pop(next(iter(_N_CACHE)))  # evict oldest-inserted only
         a = _N_CACHE[n] = jnp.asarray(np.int32(n))
     return a
 

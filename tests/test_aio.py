@@ -163,3 +163,23 @@ def test_async_pubsub_multiplexed_and_unsubscribe(server):
             assert q1.empty(), "unsubscribed channel must stop delivering"
 
     asyncio.run(main())
+
+
+def test_async_pubsub_reconnects_after_drop(server):
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            q = await client.subscribe("reconn")
+            await asyncio.sleep(0.1)
+            # kill the pubsub socket out from under the client
+            await client._pubsub.close()
+            deadline = asyncio.get_running_loop().time() + 5
+            # the done-callback re-opens and re-attaches the subscription
+            while asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.2)
+                if client._pubsub is not None and not client._pubsub.closed:
+                    break
+            await asyncio.sleep(0.2)
+            await client.execute("PUBLISH", "reconn", b"back")
+            assert (await asyncio.wait_for(q.get(), 5))[1] == b"back"
+
+    asyncio.run(main())
