@@ -69,12 +69,14 @@ class TaskFuture:
 @dataclass
 class _Task:
     id: str
-    payload: bytes                      # pickled (fn, args, kwargs)
+    payload: bytes                      # pickled (fn, args, kwargs) — opaque to the server
     state: str = "queued"               # queued | running | finished | failed | cancelled
     result: Any = None
     error: Optional[str] = None
     retries: int = 0
     submitted_at: float = field(default_factory=time.time)
+    started_at: Optional[float] = None  # set on claim; orphan requeue keys on this
+    claimed_by: Optional[str] = None    # worker id (remote workers)
 
 
 class ExecutorService:
@@ -105,15 +107,9 @@ class ExecutorService:
 
     def submit(self, fn: Callable, *args, **kwargs) -> TaskFuture:
         payload = pickle.dumps((fn, args, kwargs), protocol=pickle.HIGHEST_PROTOCOL)
-        task = _Task(id=uuid.uuid4().hex[:16], payload=payload)
-        fut = TaskFuture(task.id)
-        with self._engine.locked(f"{{{self._name}}}:tasks"):
-            rec = self._rec()
-            rec.host["tasks"][task.id] = task
-            rec.host["queue"].append(task.id)
-            rec.version += 1
-        self._futures[task.id] = fut
-        self._wait().signal()
+        tid = self.submit_payload(payload)
+        fut = TaskFuture(tid)
+        self._futures[tid] = fut
         return fut
 
     def execute(self, fn: Callable, *args, **kwargs) -> None:
@@ -149,13 +145,23 @@ class ExecutorService:
             t.start()
             self._workers.append(t)
 
+    REMOTE_WORKER_TTL = 15.0  # heartbeat staleness bound
+
     def count_active_workers(self) -> int:
         """RedissonExecutorService.countActiveWorkers (:207-224 does a topic
-        round-trip; in-process it's the registered count)."""
+        round-trip; here: in-process threads + live remote heartbeats)."""
         rec = self._engine.store.get(f"{{{self._name}}}:tasks")
-        return 0 if rec is None else rec.host["workers"]
+        if rec is None:
+            return 0
+        now = time.time()
+        remote = sum(
+            1
+            for ts in rec.host.get("remote_workers", {}).values()
+            if now - ts < self.REMOTE_WORKER_TTL
+        )
+        return rec.host["workers"] + remote
 
-    def _take_task(self) -> Optional[_Task]:
+    def _take_task(self, worker_id: Optional[str] = None) -> Optional[_Task]:
         with self._engine.locked(f"{{{self._name}}}:tasks"):
             rec = self._rec()
             while rec.host["queue"]:
@@ -163,6 +169,8 @@ class ExecutorService:
                 task = rec.host["tasks"].get(tid)
                 if task is not None and task.state == "queued":
                     task.state = "running"
+                    task.started_at = time.time()
+                    task.claimed_by = worker_id
                     rec.version += 1
                     return task
             return None
@@ -187,10 +195,11 @@ class ExecutorService:
             result = fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001 - task failures are data
             with self._engine.locked(f"{{{self._name}}}:tasks"):
+                rec = self._rec()
                 task.retries += 1
+                rec.version += 1  # every transition ships to replicas
                 if task.retries < self.MAX_RETRIES and isinstance(e, _RetryableError):
                     task.state = "queued"
-                    rec = self._rec()
                     rec.host["queue"].append(task.id)
                     return
                 task.state = "failed"
@@ -201,24 +210,145 @@ class ExecutorService:
         with self._engine.locked(f"{{{self._name}}}:tasks"):
             task.state = "finished"
             task.result = result
+            self._rec().version += 1
         if fut:
             fut._complete(result)
 
     def requeue_orphans(self, max_running_age: float = 60.0) -> int:
         """TasksService re-schedule of orphaned tasks: a task 'running' on a
         dead worker goes back to the queue (the reference keeps tasks in the
-        hash until an explicit completion ack)."""
+        hash until an explicit completion ack).  Age is measured from when
+        the task STARTED running (queue wait time must not count)."""
         n = 0
+        now = time.time()
         with self._engine.locked(f"{{{self._name}}}:tasks"):
             rec = self._rec()
             for task in rec.host["tasks"].values():
-                if task.state == "running" and time.time() - task.submitted_at > max_running_age:
+                started = task.started_at if task.started_at is not None else task.submitted_at
+                if task.state == "running" and now - started > max_running_age:
                     task.state = "queued"
+                    task.claimed_by = None  # void the stale claim (fencing)
                     rec.host["queue"].append(task.id)
+                    rec.version += 1
                     n += 1
         if n:
             self._wait().signal(all_=True)
         return n
+
+    # -- remote-worker wire surface (RedissonNode / TasksRunnerService) -----
+    # Payloads are OPAQUE BYTES to the server: submitters pickle, only the
+    # claiming worker unpickles (and only the final consumer unpickles the
+    # result) — the server never deserializes task code, mirroring the
+    # reference where task classBody bytes pass through Redis untouched.
+
+    def submit_payload(self, payload: bytes) -> str:
+        """Enqueue an opaque pickled (fn, args, kwargs) payload; returns id."""
+        task = _Task(id=uuid.uuid4().hex[:16], payload=bytes(payload))
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            rec.host["tasks"][task.id] = task
+            rec.host["queue"].append(task.id)
+            rec.version += 1
+        self._wait().signal()
+        return task.id
+
+    def claim_task(self, worker_id: str) -> Optional[Tuple[str, bytes]]:
+        """Worker pull: (task_id, payload) or None.  Claiming heartbeats the
+        worker for count_active_workers."""
+        self.heartbeat(worker_id)
+        task = self._take_task(worker_id)
+        return None if task is None else (task.id, task.payload)
+
+    @staticmethod
+    def _claim_matches(task: "_Task", worker_id: Optional[str]) -> bool:
+        """Claim fencing: a worker that lost its claim to an orphan-requeue
+        (and a subsequent re-claim by another worker) must not ack the task
+        — worker_id is the fencing token (the reference keeps tasks in the
+        hash until the CLAIMING runner's ack; lose the claim, lose the ack)."""
+        return worker_id is None or task.claimed_by == worker_id
+
+    def complete_task(self, task_id: str, result_bytes: bytes, worker_id: Optional[str] = None) -> bool:
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            task = rec.host["tasks"].get(task_id)
+            if task is None or task.state not in ("running", "queued"):
+                return False
+            if not self._claim_matches(task, worker_id):
+                return False  # stale claimant (task was requeued + re-claimed)
+            task.state = "finished"
+            task.result = bytes(result_bytes)
+            rec.version += 1
+        fut = self._futures.get(task_id)
+        if fut:
+            try:
+                fut._complete(pickle.loads(task.result))  # noqa: S301 — submitter-side decode
+            except Exception as e:  # noqa: BLE001 — undecodable result must not hang waiters
+                fut._fail(RuntimeError(f"task result undecodable: {e}"))
+        self._done_wait().signal(all_=True)
+        return True
+
+    def fail_task(
+        self, task_id: str, error_text: str, retryable: bool = False,
+        worker_id: Optional[str] = None,
+    ) -> bool:
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            task = rec.host["tasks"].get(task_id)
+            if task is None or task.state != "running":
+                return False
+            if not self._claim_matches(task, worker_id):
+                return False  # stale claimant
+            task.retries += 1
+            rec.version += 1  # every transition ships to replicas
+            if retryable and task.retries < self.MAX_RETRIES:
+                task.state = "queued"
+                task.claimed_by = None
+                rec.host["queue"].append(task.id)
+                self._wait().signal()
+                return True
+            task.state = "failed"
+            task.error = error_text
+        fut = self._futures.get(task_id)
+        if fut:
+            fut._fail(RuntimeError(error_text))
+        self._done_wait().signal(all_=True)
+        return True
+
+    def _done_wait(self):
+        return self._engine.wait_entry(f"__exec_done__:{self._name}")
+
+    def await_task_result(self, task_id: str, timeout: float = 60.0):
+        """Block until the task finishes; returns the raw result (opaque
+        bytes for payload submissions).  Works across processes/handles —
+        waiters key off the task record, not an in-process future."""
+        deadline = time.time() + timeout
+        while True:
+            with self._engine.locked(f"{{{self._name}}}:tasks"):
+                rec = self._rec()
+                task = rec.host["tasks"].get(task_id)
+                if task is None:
+                    raise KeyError(f"unknown task {task_id}")
+                if task.state == "finished":
+                    return task.result
+                if task.state == "failed":
+                    raise RuntimeError(task.error or "task failed")
+                if task.state == "cancelled":
+                    raise RuntimeError("task was cancelled")
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                raise TimeoutError(f"task {task_id} not finished within {timeout}s")
+            self._done_wait().wait_for(min(remaining, 0.5))
+
+    def heartbeat(self, worker_id: str) -> None:
+        now = time.time()
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            hb = rec.host.setdefault("remote_workers", {})
+            hb[worker_id] = now
+            # prune long-dead workers so churn can't grow the record forever
+            stale = [w for w, ts in hb.items() if now - ts > 4 * self.REMOTE_WORKER_TTL]
+            for w in stale:
+                del hb[w]
 
     def task_state(self, task_id: str) -> Optional[str]:
         rec = self._engine.store.get(f"{{{self._name}}}:tasks")

@@ -78,7 +78,7 @@ class TestExecutor:
         # simulate a worker that died mid-task
         task = ex._take_task()
         assert task is not None and task.state == "running"
-        task.submitted_at -= 120
+        task.started_at -= 120  # claimed 2min ago, worker died
         assert ex.requeue_orphans(max_running_age=60) == 1
         ex.register_workers(1)
         assert f.get(5.0) == 49

@@ -1,0 +1,109 @@
+"""WorkerNode (RedissonNode analog) tests: remote task execution over the wire."""
+import pickle
+import time
+
+import pytest
+
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.node import WorkerNode
+from redisson_tpu.server.server import ServerThread
+
+
+def square(x):
+    return x * x
+
+
+def boom():
+    raise ValueError("task exploded")
+
+
+@pytest.fixture()
+def grid():
+    with ServerThread(port=0) as st:
+        node = WorkerNode(st.address, workers=2, poll_interval=0.05).start()
+        client = RemoteRedisson(st.address, timeout=60.0)
+        yield st, node, client
+        client.shutdown()
+        node.stop()
+
+
+def _submit(client, fn, *args):
+    payload = pickle.dumps((fn, args, {}))
+    return client.objcall(
+        "get_executor_service", "redisson_executor", "submit_payload", (payload,), {}
+    )
+
+
+def _await(client, task_id, timeout=30.0):
+    raw = client.objcall(
+        "get_executor_service", "redisson_executor", "await_task_result",
+        (task_id, timeout), {},
+    )
+    return pickle.loads(bytes(raw))
+
+
+def test_remote_worker_executes_tasks(grid):
+    _st, node, client = grid
+    ids = [_submit(client, square, i) for i in range(10)]
+    results = [_await(client, tid) for tid in ids]
+    assert results == [i * i for i in range(10)]
+    assert node.stats["executed"] >= 10
+    # the server process never ran the task code, the worker did
+    active = client.objcall(
+        "get_executor_service", "redisson_executor", "count_active_workers", (), {}
+    )
+    assert active >= 1  # remote heartbeats count
+
+
+def test_remote_worker_task_failure_propagates(grid):
+    _st, _node, client = grid
+    tid = _submit(client, boom)
+    with pytest.raises(RuntimeError, match="task exploded"):
+        _await(client, tid)
+
+
+def test_orphaned_claim_requeues_by_started_at():
+    """A task claimed by a dead worker re-queues after the visibility window,
+    measured from claim time — a long QUEUE wait must not trip it."""
+    import redisson_tpu
+
+    client = redisson_tpu.create()
+    try:
+        ex = client.get_executor_service("orphans")
+        tid = ex.submit_payload(pickle.dumps((square, (3,), {})))
+        time.sleep(0.3)  # queue wait: must NOT count toward running age
+        assert ex.requeue_orphans(max_running_age=0.2) == 0
+        claimed = ex.claim_task("dead-worker")
+        assert claimed is not None and claimed[0] == tid
+        assert ex.requeue_orphans(max_running_age=10.0) == 0  # still in window
+        time.sleep(0.25)
+        assert ex.requeue_orphans(max_running_age=0.2) == 1  # orphaned now
+        again = ex.claim_task("live-worker")
+        assert again is not None and again[0] == tid
+        ex.complete_task(tid, pickle.dumps(9))
+        assert pickle.loads(ex.await_task_result(tid, timeout=5)) == 9
+    finally:
+        client.shutdown()
+
+
+def test_stale_claimant_cannot_ack_reclaimed_task():
+    """Claim fencing: after orphan-requeue + re-claim, the original worker's
+    complete/fail must be rejected."""
+    import redisson_tpu
+
+    client = redisson_tpu.create()
+    try:
+        ex = client.get_executor_service("fenced")
+        tid = ex.submit_payload(pickle.dumps((square, (4,), {})))
+        assert ex.claim_task("worker-A")[0] == tid
+        time.sleep(0.15)
+        assert ex.requeue_orphans(max_running_age=0.1) == 1
+        assert ex.claim_task("worker-B")[0] == tid
+        # A wakes up late: both its failure and its success are rejected
+        assert ex.fail_task(tid, "late failure", False, worker_id="worker-A") is False
+        assert ex.complete_task(tid, pickle.dumps(0), worker_id="worker-A") is False
+        # B's ack lands
+        assert ex.complete_task(tid, pickle.dumps(16), worker_id="worker-B") is True
+        assert pickle.loads(ex.await_task_result(tid, timeout=5)) == 16
+    finally:
+        client.shutdown()
