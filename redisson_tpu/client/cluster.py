@@ -80,6 +80,7 @@ class ClusterRedisson(RemoteSurface):
         read_mode: str = READ_MASTER,
         balancer: Optional[LoadBalancer] = None,
         scan_interval: float = 5.0,
+        dns_monitoring_interval: float = 5.0,
         max_redirects: int = 5,
         **node_kw,
     ):
@@ -103,6 +104,52 @@ class ClusterRedisson(RemoteSurface):
                 target=self._scan_loop, daemon=True, name="rtpu-cluster-scan"
             )
             self._scan_thread.start()
+        # DNS re-resolution for hostname seeds (connection/DNSMonitor.java):
+        # an A-record flip behind a stable name triggers a topology refresh.
+        # <= 0 disables (the reference's dnsMonitoringInterval=-1)
+        self._dns = None
+        if dns_monitoring_interval and dns_monitoring_interval > 0:
+            from redisson_tpu.net.dns import DNSMonitor
+
+            self._dns = DNSMonitor(
+                seeds,
+                lambda _ep, _old, _new: self.refresh_topology(),
+                interval=dns_monitoring_interval,
+            ).start()
+
+    @classmethod
+    def create(cls, config) -> "ClusterRedisson":
+        """Build from Config.use_cluster_servers() (ClusterServersConfig
+        analog: node addresses, scanInterval, readMode, pool/retry knobs)."""
+        csc = config.use_cluster_servers()
+        if not csc.node_addresses:
+            raise ValueError("cluster_servers_config.node_addresses is empty")
+        if csc.username:
+            raise ValueError(
+                "ACL usernames are not supported (password-only AUTH); unset "
+                "cluster_servers_config.username"
+            )
+        read_mode = {
+            "MASTER": READ_MASTER,
+            "SLAVE": READ_REPLICA,
+            "REPLICA": READ_REPLICA,
+            "MASTER_SLAVE": READ_MASTER_SLAVE,
+        }.get(str(csc.read_mode).upper(), READ_MASTER)
+        return cls(
+            list(csc.node_addresses),
+            config=config,
+            read_mode=read_mode,
+            scan_interval=csc.scan_interval,
+            dns_monitoring_interval=getattr(csc, "dns_monitoring_interval", 5.0),
+            password=csc.password,
+            client_name=csc.client_name,
+            pool_size=csc.connection_pool_size,
+            timeout=csc.timeout,
+            connect_timeout=csc.connect_timeout,
+            retry_attempts=csc.retry_attempts,
+            retry_interval=csc.retry_interval,
+            ping_interval=csc.ping_connection_interval,
+        )
 
     # -- topology ------------------------------------------------------------
 
@@ -118,7 +165,11 @@ class ClusterRedisson(RemoteSurface):
         for seed in self._seeds:
             probe = None
             try:
-                probe = NodeClient(seed, ping_interval=0, retry_attempts=0)
+                # probes carry the same credentials as data connections —
+                # an AUTH-required cluster must bootstrap too
+                kw = dict(self._node_kw)
+                kw.update(ping_interval=0, retry_attempts=0)
+                probe = NodeClient(seed, **kw)
                 return probe.execute("CLUSTER", "SLOTS", timeout=5.0)
             except Exception:  # noqa: BLE001
                 continue
@@ -134,6 +185,8 @@ class ClusterRedisson(RemoteSurface):
         OUTSIDE self._lock — one dead node's connect timeouts must not stall
         entry_for_slot for healthy shards.  The lock only guards the final
         table swap."""
+        if self._closed.is_set():
+            return False
         view = self._fetch_view()
         if view is None:
             return False
@@ -169,12 +222,19 @@ class ClusterRedisson(RemoteSurface):
             except Exception:  # noqa: BLE001 — master briefly down
                 pass
         with self._lock:
-            retired = [e for a, e in self._entries.items() if a not in fresh]
-            self._entries = fresh
-            self._slots = [a if a in fresh else None for a in new_slots]
+            if self._closed.is_set():
+                # shutdown raced this refresh: do NOT repopulate a closed
+                # client — close anything we just opened and bail
+                retired = [e for a, e in fresh.items() if a not in self._entries]
+                swapped = False
+            else:
+                retired = [e for a, e in self._entries.items() if a not in fresh]
+                self._entries = fresh
+                self._slots = [a if a in fresh else None for a in new_slots]
+                swapped = True
         for e in retired:
             e.close()
-        return True
+        return swapped
 
     def _scan_loop(self) -> None:
         while not self._closed.wait(self._scan_interval):
@@ -332,6 +392,8 @@ class ClusterRedisson(RemoteSurface):
 
     def shutdown(self) -> None:
         self._closed.set()
+        if self._dns is not None:
+            self._dns.stop()
         with self._lock:
             for e in self._entries.values():
                 e.close()

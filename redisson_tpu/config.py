@@ -78,6 +78,7 @@ class ClusterServersConfig:
     ping_connection_interval: float = 30.0
     connection_pool_size: int = 8
     read_mode: str = "MASTER"                # MASTER | SLAVE | MASTER_SLAVE
+    dns_monitoring_interval: float = 5.0     # dnsMonitoringInterval; <=0 disables
 
 
 @dataclass

@@ -682,7 +682,11 @@ def cmd_replicaof(server, ctx, args):
     from redisson_tpu.net.client import NodeClient
     from redisson_tpu.server import replication
 
-    master = NodeClient(f"{host}:{port}", ping_interval=0, retry_attempts=1)
+    # nodes of one grid share credentials: the replication link authenticates
+    # with this node's own password (cluster-wide password convention)
+    master = NodeClient(
+        f"{host}:{port}", ping_interval=0, retry_attempts=1, password=server.password
+    )
     try:
         blob = master.execute("REPLSNAPSHOT", timeout=60.0)
         replication.apply_records(server.engine, bytes(blob))

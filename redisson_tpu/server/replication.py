@@ -107,11 +107,12 @@ def apply_records(engine, blob: bytes) -> int:
 class ReplicaHandle:
     """Master-side link to one registered replica."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, password: Optional[str] = None):
         from redisson_tpu.net.client import NodeClient
 
         self.address = address
-        self.client = NodeClient(address, ping_interval=0, retry_attempts=1)
+        # grid nodes share credentials (see registry cmd_replicaof note)
+        self.client = NodeClient(address, ping_interval=0, retry_attempts=1, password=password)
         self.shipped: Dict[str, int] = {}  # record name -> version last shipped
         self.healthy = True
 
@@ -136,7 +137,9 @@ class ReplicationSource:
     def register(self, address: str) -> None:
         with self._lock:
             if address not in self._replicas:
-                self._replicas[address] = ReplicaHandle(address)
+                self._replicas[address] = ReplicaHandle(
+                    address, password=self.server.password
+                )
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._run, daemon=True, name="rtpu-repl-ship"

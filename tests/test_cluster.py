@@ -270,3 +270,24 @@ def test_failover_coordinator_auto_promotes():
         if coord is not None:
             coord.stop()
         runner.shutdown()
+
+
+def test_password_protected_cluster_bootstrap_and_replication():
+    """Credentials thread through seed probes, data connections, and the
+    replication links (REPLICAOF pull + master push)."""
+    runner = ClusterRunner(masters=2, replicas_per_master=1, password="s3cret").run()
+    try:
+        client = runner.client(scan_interval=0, password="s3cret")
+        b = client.get_bucket("authed")
+        b.set("ok")
+        assert b.get() == "ok"
+        with runner.masters[0].server.client() as c:
+            _exec(c, "REPLFLUSH")
+        with runner.masters[1].server.client() as c:
+            _exec(c, "REPLFLUSH")
+        # replica received the ship over the authenticated link
+        owner_engines = [r.server.server.engine for r in runner.replicas]
+        assert any(e.store.exists("authed") for e in owner_engines)
+        client.shutdown()
+    finally:
+        runner.shutdown()

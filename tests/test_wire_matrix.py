@@ -1,0 +1,150 @@
+"""Wire-surface matrix: every generic object factory exercised over OBJCALL,
+against both the single-node client and the cluster client.
+
+The reference's API-variant tests mirror sync tests across Reactive/Rx
+facades (SURVEY.md §4.4); here the analog matrix is embedded vs remote vs
+cluster routing of the SAME handle surface.
+"""
+import numpy as np
+import pytest
+
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.harness import ClusterRunner
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture(scope="module")
+def single():
+    with ServerThread(port=0) as st:
+        client = RemoteRedisson(st.address, timeout=60.0)
+        yield client
+        client.shutdown()
+
+
+@pytest.fixture(scope="module")
+def clustered():
+    runner = ClusterRunner(masters=3).run()
+    client = runner.client(scan_interval=0)
+    yield client
+    client.shutdown()
+    runner.shutdown()
+
+
+def drive_surface(client, tag):
+    # maps
+    m = client.get_map(f"wm-{tag}")
+    m.put("a", 1)
+    m.put_all({"b": 2, "c": 3})
+    assert m.get("b") == 2 and m.size() == 3
+    assert sorted(m.read_all_keys()) == ["a", "b", "c"]
+    # map cache with TTL
+    mc = client.get_map_cache(f"wmc-{tag}")
+    mc.put_with_ttl("x", "y", ttl=30.0)
+    assert mc.get("x") == "y"
+    # sets
+    s = client.get_set(f"ws-{tag}")
+    s.add("p")
+    s.add("q")
+    assert s.contains("p") and s.size() == 2
+    z = client.get_scored_sorted_set(f"wz-{tag}")
+    z.add(1.0, "one")
+    z.add(2.0, "two")
+    assert z.first() == "one"
+    # lists / queues
+    lst = client.get_list(f"wl-{tag}")
+    lst.add("e0")
+    lst.add("e1")
+    assert lst.get(1) == "e1"
+    q = client.get_queue(f"wq-{tag}")
+    q.offer("job")
+    assert q.poll() == "job"
+    dq = client.get_deque(f"wdq-{tag}")
+    dq.add_first("front")
+    assert dq.poll_last() == "front"
+    # counters / ids
+    al = client.get_atomic_long(f"wal-{tag}")
+    assert al.increment_and_get() == 1
+    idg = client.get_id_generator(f"wid-{tag}")
+    first = idg.next_id()
+    assert idg.next_id() > first
+    # synchronizers
+    sem = client.get_semaphore(f"wsem-{tag}")
+    sem.try_set_permits(2)
+    assert sem.try_acquire() is True
+    sem.release()
+    latch = client.get_count_down_latch(f"wcdl-{tag}")
+    latch.try_set_count(1)
+    latch.count_down()
+    assert latch.get_count() == 0
+    rl = client.get_rate_limiter(f"wrl-{tag}")
+    rl.try_set_rate("OVERALL", 100, 1.0)
+    assert rl.try_acquire() is True
+    # streams / topics ride pubsub paths
+    st = client.get_stream(f"wst-{tag}")
+    sid = st.add({"k": "v"})
+    assert st.size() == 1
+    entries = st.range(count=10)
+    assert sid in entries and entries[sid] == {"k": "v"}
+    # multimap
+    mm = client.get_list_multimap(f"wmm-{tag}")
+    mm.put("k", "v1")
+    mm.put("k", "v2")
+    assert mm.get_all("k") == ["v1", "v2"]
+    # time series
+    ts = client.get_time_series(f"wts-{tag}")
+    ts.add(1.0, "a")
+    ts.add(2.0, "b")
+    assert ts.size() == 2
+    # json bucket
+    jb = client.get_json_bucket(f"wjb-{tag}")
+    jb.set("$", {"deep": {"v": 7}})
+    assert jb.get("$.deep.v") == 7
+
+
+def test_single_node_surface(single):
+    drive_surface(single, "single")
+
+
+def test_cluster_surface(clustered):
+    drive_surface(clustered, "cluster")
+
+
+def test_cluster_config_create():
+    from redisson_tpu.client.cluster import ClusterRedisson
+    from redisson_tpu.config import Config
+
+    runner = ClusterRunner(masters=2).run()
+    try:
+        cfg = Config()
+        csc = cfg.use_cluster_servers()
+        csc.node_addresses = [f"tpu://{a}" for a in runner.seeds()]
+        csc.scan_interval = 0
+        csc.read_mode = "MASTER_SLAVE"
+        csc.timeout = 60.0
+        client = ClusterRedisson.create(cfg)
+        client.get_bucket("cfg-made").set(1)
+        assert client.get_bucket("cfg-made").get() == 1
+        assert client.read_mode == "master_slave"
+        client.shutdown()
+    finally:
+        runner.shutdown()
+
+
+def test_dns_monitor_change_detection(monkeypatch):
+    from redisson_tpu.net import dns
+
+    ips = {"grid.example": ["10.0.0.1"]}
+    monkeypatch.setattr(dns, "_resolve", lambda host: ips.get(host, []))
+    seen = []
+    mon = dns.DNSMonitor(
+        ["tpu://grid.example:6390", "tpu://127.0.0.1:9"],  # numeric ip skipped
+        on_change=lambda ep, old, new: seen.append((ep, old, new)),
+        interval=60,
+    )
+    assert mon.watched() == ["tpu://grid.example:6390"]
+    assert mon.check_once() == []
+    ips["grid.example"] = ["10.0.0.2"]
+    changes = mon.check_once()
+    assert changes == [("tpu://grid.example:6390", ["10.0.0.1"], ["10.0.0.2"])]
+    assert seen == changes
+    mon.stop()
