@@ -95,6 +95,9 @@ class ClusterRedisson(RemoteSurface):
         self._entries: Dict[str, ShardEntry] = {}  # master address -> entry
         self._slots: List[Optional[str]] = [None] * MAX_SLOT  # slot -> master address
         self._lock = threading.RLock()
+        # refreshes serialize: two concurrent refreshes building entries for
+        # the same new address would leak the loser's connections
+        self._refresh_lock = threading.Lock()
         self._closed = threading.Event()
         self.refresh_topology()
         self._scan_interval = scan_interval
@@ -129,12 +132,18 @@ class ClusterRedisson(RemoteSurface):
                 "ACL usernames are not supported (password-only AUTH); unset "
                 "cluster_servers_config.username"
             )
-        read_mode = {
+        modes = {
             "MASTER": READ_MASTER,
             "SLAVE": READ_REPLICA,
             "REPLICA": READ_REPLICA,
             "MASTER_SLAVE": READ_MASTER_SLAVE,
-        }.get(str(csc.read_mode).upper(), READ_MASTER)
+        }
+        key = str(csc.read_mode).upper()
+        if key not in modes:
+            raise ValueError(
+                f"unknown read_mode {csc.read_mode!r}; expected one of {sorted(modes)}"
+            )
+        read_mode = modes[key]
         return cls(
             list(csc.node_addresses),
             config=config,
@@ -187,6 +196,10 @@ class ClusterRedisson(RemoteSurface):
         table swap."""
         if self._closed.is_set():
             return False
+        with self._refresh_lock:
+            return self._refresh_topology_locked()
+
+    def _refresh_topology_locked(self) -> bool:
         view = self._fetch_view()
         if view is None:
             return False
@@ -357,7 +370,8 @@ class ClusterRedisson(RemoteSurface):
             addr = None if slot in (None, -1) else slot_table[slot]
             groups.setdefault(addr, []).append(i)
         results: List[Any] = [None] * len(commands)
-        for addr, idxs in groups.items():
+
+        def run_group(addr, idxs):
             entry = entries.get(addr) if addr is not None else next(iter(entries.values()), None)
             try:
                 if entry is None:
@@ -369,7 +383,30 @@ class ClusterRedisson(RemoteSurface):
                 # topology changed under us: redirect-aware per-command path
                 replies = [self.execute(*commands[i], timeout=timeout) for i in idxs]
             for i, r in zip(idxs, replies):
+                if isinstance(r, RespError) and str(r).startswith(("MOVED ", "CLUSTERDOWN")):
+                    # pipelined frames return per-command errors as values;
+                    # redirects re-route through the redirect-aware execute()
+                    # (a migrated slot must not surface as a silent error row)
+                    try:
+                        r = self.execute(*commands[i], timeout=timeout)
+                    except Exception as e:  # noqa: BLE001 — keep the error as data
+                        r = e if isinstance(r, RespError) else r
                 results[i] = r
+
+        if len(groups) <= 1:
+            for addr, idxs in groups.items():
+                run_group(addr, idxs)
+        else:
+            # shards execute their frames CONCURRENTLY (per-shard order is
+            # preserved inside each frame) — the whole point of the per-slot
+            # grouping is that a multi-shard batch costs one shard's latency,
+            # not the sum (CommandBatchService writes all entries in parallel)
+            import concurrent.futures as _cf
+
+            with _cf.ThreadPoolExecutor(max_workers=min(len(groups), 16)) as pool:
+                futs = [pool.submit(run_group, a, idxs) for a, idxs in groups.items()]
+                for f in futs:
+                    f.result()
         return results
 
     def pubsub_for(self, name: str):

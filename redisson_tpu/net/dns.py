@@ -36,6 +36,22 @@ def _is_numeric(host: str) -> bool:
         return False
 
 
+def _host_of(endpoint: str) -> str:
+    """Endpoint -> bare hostname: scheme stripped FIRST (else the scheme's
+    colon wins the port rsplit for port-less endpoints), then the port, with
+    bracketed IPv6 respected."""
+    host = endpoint
+    for prefix in ("tpu://", "redis://", "rediss://"):
+        if host.startswith(prefix):
+            host = host[len(prefix):]
+            break
+    if host.startswith("["):  # [v6addr]:port
+        return host[1:].split("]", 1)[0]
+    if host.count(":") == 1:  # host:port (bare v6 has >= 2 colons)
+        host = host.rsplit(":", 1)[0]
+    return host
+
+
 class DNSMonitor:
     def __init__(
         self,
@@ -45,13 +61,12 @@ class DNSMonitor:
     ):
         self.interval = interval
         self.on_change = on_change
+        self._host_by_ep: Dict[str, str] = {}  # parsed once, reused per sweep
         self._hosts: Dict[str, List[str]] = {}
         for ep in endpoints:
-            host = ep.rsplit(":", 1)[0] if ":" in ep else ep
-            for prefix in ("tpu://", "redis://", "rediss://"):
-                if host.startswith(prefix):
-                    host = host[len(prefix):]
+            host = _host_of(ep)
             if not _is_numeric(host):
+                self._host_by_ep[ep] = host
                 self._hosts[ep] = _resolve(host)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -69,11 +84,7 @@ class DNSMonitor:
         """One sweep; returns [(endpoint, old, new)] for every change."""
         changes = []
         for ep in list(self._hosts):
-            host = ep.rsplit(":", 1)[0] if ":" in ep else ep
-            for prefix in ("tpu://", "redis://", "rediss://"):
-                if host.startswith(prefix):
-                    host = host[len(prefix):]
-            new = _resolve(host)
+            new = _resolve(self._host_by_ep[ep])
             old = self._hosts[ep]
             if new and new != old:
                 self._hosts[ep] = new

@@ -148,3 +148,26 @@ def test_dns_monitor_change_detection(monkeypatch):
     assert changes == [("tpu://grid.example:6390", ["10.0.0.1"], ["10.0.0.2"])]
     assert seen == changes
     mon.stop()
+
+
+def test_host_of_parsing():
+    from redisson_tpu.net.dns import _host_of
+
+    assert _host_of("tpu://grid.example:6390") == "grid.example"
+    assert _host_of("tpu://grid.example") == "grid.example"
+    assert _host_of("grid.example:6390") == "grid.example"
+    assert _host_of("grid.example") == "grid.example"
+    assert _host_of("redis://[::1]:6390") == "::1"
+    assert _host_of("127.0.0.1:6390") == "127.0.0.1"
+
+
+def test_create_rejects_bad_read_mode():
+    from redisson_tpu.client.cluster import ClusterRedisson
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    csc = cfg.use_cluster_servers()
+    csc.node_addresses = ["tpu://127.0.0.1:1"]
+    csc.read_mode = "master-slave"
+    with pytest.raises(ValueError, match="read_mode"):
+        ClusterRedisson.create(cfg)
