@@ -149,6 +149,15 @@ class LocalCachedMap(Map):
     # -- invalidation plumbing ----------------------------------------------
 
     def _on_sync(self, channel: str, msg) -> None:
+        if isinstance(msg, (bytes, bytearray)):
+            # wire clients PUBLISH pickled tuples (client/remote.py
+            # RemoteLocalCachedMap._broadcast) — same shape after decode
+            from redisson_tpu.net.safe_pickle import safe_loads
+
+            try:
+                msg = safe_loads(bytes(msg))
+            except Exception:  # noqa: BLE001 — foreign frame on our channel
+                return
         kind, sender = msg[0], msg[1]
         if sender == self._cache_id:
             return

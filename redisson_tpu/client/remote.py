@@ -16,6 +16,7 @@ Listeners (topics) ride the dedicated pubsub connection.
 from __future__ import annotations
 
 import pickle
+import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -440,6 +441,188 @@ _GENERIC_FACTORIES = {
 }
 
 
+class RemoteLocalCachedMap:
+    """RLocalCachedMap over the wire: a client-side near cache fed by the
+    shared invalidation channel (`redisson_local_cache:{name}`).
+
+    Protocol interop with the embedded handle (client/objects/localcache.py):
+    messages are (kind, cache_id, payload) tuples; this handle MUTATES the
+    plain map and PUBLISHES its own messages carrying its own cache_id — so
+    originator exclusion works exactly like the reference's excludedId scheme
+    (a client's own writes never evict its own fresh cache entries).  Both
+    the subscription and the mutations route by the MAP NAME's slot, so on a
+    cluster the invalidation feed lives on the shard that owns the data.
+    The map-key codec MUST match the server's default codec (keys align by
+    encoded bytes).
+    """
+
+    def __init__(self, client, name: str, options=None, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.localcache import (
+            LocalCachedMapOptions,
+            SyncStrategy,
+            _LocalCache,
+        )
+
+        self._client = client
+        self.name = name
+        self._opts = options or LocalCachedMapOptions.defaults()
+        self._codec = codec or DEFAULT_CODEC
+        self._cache = _LocalCache(self._opts)
+        self._cache_id = uuid.uuid4().hex
+        self._channel = f"redisson_local_cache:{name}"
+        # mutations ride the PLAIN map: this handle owns its own broadcasts
+        self._proxy = RemoteObjectProxy(client, "get_map", name)
+        self._sync_strategy = self._opts.sync_strategy
+        self._sync = self._sync_strategy != SyncStrategy.NONE
+        # generation counter: a fetch only populates the cache if no
+        # invalidation arrived while it was in flight (the wire analog of the
+        # embedded handle's read+populate under the record lock)
+        self._gen = 0
+        self.hits = 0
+        self.misses = 0
+        self._pubsub = None
+        if self._sync:
+            # subscribe on the shard that owns the MAP (not the channel
+            # string): that is where OBJCALL mutations execute and publish
+            self._pubsub = client.pubsub_for(name)
+            self._pubsub.subscribe(self._channel, self._on_wire_sync)
+
+    # -- invalidation feed ----------------------------------------------------
+
+    def _on_wire_sync(self, _channel: str, payload) -> None:
+        from redisson_tpu.net.safe_pickle import safe_loads
+
+        try:
+            msg = safe_loads(bytes(payload)) if isinstance(payload, (bytes, bytearray)) else payload
+        except Exception:  # noqa: BLE001 — unknown frame: drop all, stay safe
+            self._gen += 1
+            self._cache.clear()
+            return
+        kind, sender = msg[0], msg[1]
+        if sender == self._cache_id:
+            return  # own write (excludedId scheme)
+        self._gen += 1
+        if kind == "inv":
+            for ek in msg[2]:
+                self._cache.invalidate(ek)
+        elif kind == "upd":
+            for ek, ev in msg[2]:
+                self._cache.put(ek, self._codec.decode_map_value(ev))
+        elif kind == "clear":
+            self._cache.clear()
+
+    def _broadcast(self, kind: str, payload) -> None:
+        if not self._sync:
+            return
+        from redisson_tpu.client.objects.localcache import SyncStrategy
+
+        if kind == "upd" and self._sync_strategy != SyncStrategy.UPDATE:
+            kind, payload = "inv", [ek for ek, _ in payload]
+        blob = pickle.dumps((kind, self._cache_id, payload), protocol=4)
+        self._client.execute("PUBLISH", self._channel, blob)
+
+    def _ek(self, key) -> bytes:
+        return self._codec.encode_map_key(key)
+
+    # -- reads (near cache first) ---------------------------------------------
+
+    def get(self, key):
+        ek = self._ek(key)
+        hit, value = self._cache.get(ek)
+        if hit:
+            self.hits += 1
+            return value
+        self.misses += 1
+        gen = self._gen
+        value = self._proxy.get(key)
+        if value is not None and self._gen == gen:
+            # no invalidation raced the fetch: safe to populate
+            self._cache.put(ek, value)
+        return value
+
+    def get_all(self, keys) -> Dict:
+        out, missing = {}, []
+        for k in keys:
+            hit, v = self._cache.get(self._ek(k))
+            if hit:
+                self.hits += 1
+                out[k] = v
+            else:
+                self.misses += 1
+                missing.append(k)
+        if missing:
+            gen = self._gen
+            fetched = self._proxy.get_all(missing)
+            if self._gen == gen:
+                for k, v in fetched.items():
+                    self._cache.put(self._ek(k), v)
+            out.update(fetched)
+        return out
+
+    def cached_size(self) -> int:
+        return len(self._cache)
+
+    # -- writes (mutate shared map, update own cache, notify peers) -----------
+
+    def put(self, key, value):
+        old = self._proxy.put(key, value)
+        ek = self._ek(key)
+        self._cache.put(ek, value)
+        self._broadcast("upd", [(ek, self._codec.encode_map_value(value))])
+        return old
+
+    def fast_put(self, key, value) -> bool:
+        created = self._proxy.fast_put(key, value)
+        ek = self._ek(key)
+        self._cache.put(ek, value)
+        self._broadcast("upd", [(ek, self._codec.encode_map_value(value))])
+        return created
+
+    def put_all(self, entries: Dict) -> None:
+        self._proxy.put_all(entries)
+        payload = []
+        for k, v in entries.items():
+            ek = self._ek(k)
+            self._cache.put(ek, v)
+            payload.append((ek, self._codec.encode_map_value(v)))
+        self._broadcast("upd", payload)
+
+    def remove(self, key):
+        old = self._proxy.remove(key)
+        ek = self._ek(key)
+        self._cache.invalidate(ek)
+        self._broadcast("inv", [ek])
+        return old
+
+    def fast_remove(self, *keys) -> int:
+        n = self._proxy.fast_remove(*keys)
+        eks = [self._ek(k) for k in keys]
+        for ek in eks:
+            self._cache.invalidate(ek)
+        self._broadcast("inv", eks)
+        return n
+
+    def clear(self) -> None:
+        self._proxy.clear()
+        self._cache.clear()
+        if self._sync:
+            blob = pickle.dumps(("clear", self._cache_id), protocol=4)
+            self._client.execute("PUBLISH", self._channel, blob)
+
+    def destroy(self) -> None:
+        """Detach the invalidation listener (RObject.destroy parity) — keep
+        the shared channel alive for other handles on the same connection."""
+        if self._pubsub is not None:
+            self._pubsub.remove_listener(self._channel, self._on_wire_sync)
+            self._pubsub = None
+        self._cache.clear()
+
+    def __getattr__(self, method: str):
+        # everything else (size, contains_key, read_all_keys, ...) rides the
+        # plain OBJCALL proxy with no near-cache involvement
+        return getattr(self._proxy, method)
+
+
 class RemoteSurface:
     """Handle-factory surface shared by the single-node client and the
     cluster client: every factory only talks through the transport seam
@@ -490,6 +673,11 @@ class RemoteSurface:
 
     def get_topic(self, name: str, codec: Optional[Codec] = None) -> "RemoteTopic":
         return RemoteTopic(self, name, codec)
+
+    def get_local_cached_map(
+        self, name: str, codec: Optional[Codec] = None, options=None
+    ) -> "RemoteLocalCachedMap":
+        return RemoteLocalCachedMap(self, name, options=options, codec=codec)
 
     def create_batch(self) -> "RemoteBatch":
         return RemoteBatch(self)

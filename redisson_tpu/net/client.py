@@ -179,6 +179,21 @@ class PubSubConnection:
             if self._listeners.pop(channel, None) is not None:
                 self._conn.send("UNSUBSCRIBE", channel)
 
+    def remove_listener(self, channel: str, listener) -> None:
+        """Detach ONE listener; unsubscribes only when the last one goes
+        (handles sharing a channel on one connection keep receiving)."""
+        with self._lock:
+            listeners = self._listeners.get(channel)
+            if listeners is None:
+                return
+            try:
+                listeners.remove(listener)
+            except ValueError:
+                return
+            if not listeners:
+                del self._listeners[channel]
+                self._conn.send("UNSUBSCRIBE", channel)
+
     def resubscribe_on(self, conn: Connection) -> None:
         """Re-attach all subscriptions on a fresh connection (the watchdog's
         pubsub re-attach, ConnectionWatchdog.java:85-175)."""

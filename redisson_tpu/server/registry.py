@@ -780,7 +780,30 @@ def cmd_objcall(server, ctx, args):
     fn = getattr(client, factory, None)
     if fn is None:
         raise RespError(f"ERR unknown factory '{factory}'")
-    obj = fn(name) if name else fn()
+    # handle instances are cached per (factory, name): stateful handles
+    # (LocalCachedMap subscribes an invalidation listener, adders register
+    # counters) must not accrete one instance per OBJCALL.  create_* stays
+    # uncached by contract (fresh object per call).
+    if factory.startswith("get_"):
+        cache = server._objcall_handles
+        key = (factory, name)
+        with server._objcall_handles_lock:
+            obj = cache.get(key)
+            if obj is None:
+                obj = fn(name) if name else fn()
+                cache[key] = obj
+                if len(cache) > 4096:  # bounded LRU
+                    _k, old = cache.popitem(last=False)
+                    detach = getattr(old, "destroy", None)  # detach-only by contract
+                    if detach is not None:
+                        try:
+                            detach()
+                        except Exception:  # noqa: BLE001
+                            pass
+            else:
+                cache.move_to_end(key)
+    else:
+        obj = fn(name) if name else fn()
     m = getattr(obj, method, None)
     if m is None or method.startswith("_"):
         raise RespError(f"ERR unknown method '{method}'")

@@ -65,6 +65,11 @@ class TpuServer:
         self._replication = None  # lazy ReplicationSource (master side)
         self._repl_lock = threading.Lock()
         self._client_ids = iter(range(1, 1 << 62))
+        # OBJCALL handle cache (ordered for LRU eviction; see registry)
+        from collections import OrderedDict
+
+        self._objcall_handles: "OrderedDict" = OrderedDict()
+        self._objcall_handles_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="rtpu-srv")
         # OBJCALL may run arbitrarily-blocking object methods (blocking
         # queues, latches); isolate them on a wide pool so parked callers

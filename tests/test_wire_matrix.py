@@ -171,3 +171,43 @@ def test_create_rejects_bad_read_mode():
     csc.read_mode = "master-slave"
     with pytest.raises(ValueError, match="read_mode"):
         ClusterRedisson.create(cfg)
+
+
+def test_remote_local_cached_map_invalidation():
+    """RLocalCachedMap over the wire: near-cache hits + cross-client
+    invalidation via RESP push frames."""
+    import time as _time
+
+    from redisson_tpu.client.objects.localcache import (
+        LocalCachedMapOptions,
+        SyncStrategy,
+    )
+
+    with ServerThread(port=0) as st:
+        a = RemoteRedisson(st.address, timeout=60.0)
+        b = RemoteRedisson(st.address, timeout=60.0)
+        try:
+            ma = a.get_local_cached_map("lcm")
+            mb = b.get_local_cached_map(
+                "lcm", options=LocalCachedMapOptions(sync_strategy=SyncStrategy.INVALIDATE)
+            )
+            ma.put("k", "v1")
+            assert mb.get("k") == "v1"      # miss -> fetch -> cached
+            assert mb.get("k") == "v1"      # near-cache hit
+            assert mb.hits == 1 and mb.misses == 1
+            assert mb.cached_size() == 1
+            ma.put("k", "v2")               # server broadcasts invalidation
+            deadline = _time.time() + 5
+            while _time.time() < deadline and mb.cached_size() > 0:
+                _time.sleep(0.05)
+            assert mb.cached_size() == 0, "invalidation never reached client B"
+            assert mb.get("k") == "v2"      # re-fetch sees the new value
+            # removes invalidate too
+            ma.remove("k")
+            deadline = _time.time() + 5
+            while _time.time() < deadline and mb.cached_size() > 0:
+                _time.sleep(0.05)
+            assert mb.get("k") is None
+        finally:
+            a.shutdown()
+            b.shutdown()
