@@ -13,7 +13,6 @@ Layering (SURVEY.md §7.1):
   server/    L4' RESP-style asyncio protocol server + client
   client/    L5'/L6' object handles + Redisson-style entry facade
   services/  L6' executor, MapReduce, remote service, transactions
-  models/    flagship fused pipelines (bench / graft entry)
   utils/     hashing, crc16, timers, misc
 """
 from redisson_tpu.version import __version__  # noqa: F401

@@ -279,6 +279,15 @@ class ClusterRedisson(RemoteSurface):
     _SAME_SLOT = {"PFMERGE", "BITOP", "RENAME"}
 
     def _route(self, cmd: str, args: tuple) -> Tuple[Optional[int], bool]:
+        cu = cmd.upper()
+        if cu in ("PUBLISH", "SPUBLISH") and args:
+            # subscriptions live on the channel's slot-owner master
+            # (pubsub_for below) — a publish MUST land on that same node or
+            # topic fan-out and local-cache invalidation silently drop.
+            # Routed as a "write" so it always hits the master the
+            # subscribers are attached to, never a replica.
+            ch = args[0]
+            return calc_slot(ch if isinstance(ch, bytes) else str(ch).encode()), True
         keys = C.command_keys(cmd, list(args))
         write = C.is_write(cmd, list(args))
         if not keys:
@@ -323,6 +332,13 @@ class ClusterRedisson(RemoteSurface):
                     continue
                 raise
             except (ConnectionError, OSError, TimeoutError) as e:
+                if write and isinstance(e, TimeoutError):
+                    # the command may already have been written — re-sending a
+                    # non-idempotent write (INCR, OBJCALL put, lock ops) could
+                    # double-apply it.  At-most-once for writes, matching the
+                    # no-retry-after-write rule NodeClient._with_retry enforces
+                    # one layer down.
+                    raise
                 last = e
                 self.refresh_topology()
                 time.sleep(min(0.1 * (attempt + 1), 1.0))
@@ -365,11 +381,20 @@ class ClusterRedisson(RemoteSurface):
             slot_table = list(self._slots)
             entries = dict(self._entries)
         groups: Dict[Optional[str], List[int]] = {}
+        writes: List[bool] = [False] * len(commands)
+        results: List[Any] = [None] * len(commands)
         for i, c in enumerate(commands):
-            slot, _w = self._route(str(c[0]), tuple(c[1:]))
+            cmd = str(c[0]).upper()
+            if cmd in self._ALL_SHARD:
+                # scatter-gather commands must fan out, not land on one
+                # arbitrary entry — route through the merging single path
+                # (transport errors raise, matching execute())
+                results[i] = self._execute_all_shards(cmd, tuple(c), timeout)
+                continue
+            slot, w = self._route(cmd, tuple(c[1:]))
+            writes[i] = w
             addr = None if slot in (None, -1) else slot_table[slot]
             groups.setdefault(addr, []).append(i)
-        results: List[Any] = [None] * len(commands)
 
         def run_group(addr, idxs):
             entry = entries.get(addr) if addr is not None else next(iter(entries.values()), None)
@@ -379,8 +404,18 @@ class ClusterRedisson(RemoteSurface):
                 replies = entry.master.execute_many(
                     [commands[i] for i in idxs], timeout=timeout
                 )
-            except (ConnectionError, OSError, TimeoutError):
-                # topology changed under us: redirect-aware per-command path
+            except (ConnectionError, OSError, TimeoutError) as group_err:
+                # topology changed under us: redirect-aware per-command path.
+                # After a TIMEOUT the frame may already be written server-side,
+                # so writes must NOT re-execute (at-most-once): the whole call
+                # raises, like the single-command path.  Reads are safe to
+                # re-run; their failures also propagate (the pre-existing
+                # contract — transport errors raise, only per-command RESP
+                # errors come back as data rows).
+                if isinstance(group_err, TimeoutError) and any(
+                    writes[i] for i in idxs
+                ):
+                    raise
                 replies = [self.execute(*commands[i], timeout=timeout) for i in idxs]
             for i, r in zip(idxs, replies):
                 if isinstance(r, RespError) and str(r).startswith(("MOVED ", "CLUSTERDOWN")):
@@ -414,6 +449,14 @@ class ClusterRedisson(RemoteSurface):
         (SSUBSCRIBE semantics — RedissonShardedTopic analog)."""
         entry = self.entry_for_slot(calc_slot(name.encode()))
         return entry.master.pubsub()
+
+    def publish_for(self, routing_name: str, channel, payload) -> int:
+        """Publish on the exact node pubsub_for(routing_name) subscribed on —
+        server pubsub hubs are node-local, so the publish and the
+        subscription MUST land on the same master or fan-out silently drops
+        (topic messages, local-cache invalidations)."""
+        entry = self.entry_for_slot(calc_slot(routing_name.encode()))
+        return int(entry.master.execute("PUBLISH", channel, payload) or 0)
 
     # -- object surface: inherited from RemoteSurface (same handle classes,
     #    routed through execute()/objcall()/pubsub_for() above) --------------

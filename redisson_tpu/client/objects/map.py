@@ -120,11 +120,13 @@ class Map(RExpirable):
             with self._wb_lock:
                 self._wb_queue.append((op, key, value))
                 if self._wb_timer is None:
-                    self._wb_timer = threading.Timer(
-                        self._options.write_behind_delay, self._flush_write_behind
+                    # shared wheel timer; the flush runs on the timer pool
+                    # (user MapWriter code may block on I/O and wheel
+                    # callbacks must stay short)
+                    self._wb_timer = self._engine.schedule_timeout(
+                        self._flush_write_behind,
+                        self._options.write_behind_delay,
                     )
-                    self._wb_timer.daemon = True
-                    self._wb_timer.start()
         elif op == "write":
             w.write({key: value})
         else:

@@ -409,9 +409,10 @@ class DelayedQueue(Queue):
         return True
 
     def _schedule_transfer(self, delay: float):
-        t = threading.Timer(max(0.0, delay), self.transfer_due)
-        t.daemon = True
-        t.start()
+        # shared wheel timer (QueueTransferTask rides the reference's
+        # HashedWheelTimer the same way) — not a thread per offer; the
+        # transfer itself runs on the timer pool (it takes record locks)
+        self._engine.schedule_timeout(self.transfer_due, max(0.0, delay))
 
     def transfer_due(self) -> int:
         """QueueTransferTask.pushTask analog: move due elements to the target."""

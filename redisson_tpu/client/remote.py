@@ -25,6 +25,31 @@ from redisson_tpu.client.codec import Codec, DEFAULT_CODEC
 from redisson_tpu.net.client import NodeClient
 from redisson_tpu.net.resp import RespError
 
+# Client-process shared infrastructure for lock-watchdog renewals: ONE wheel
+# timer schedules ticks, a small pool runs the renewal RPCs (network calls
+# must not block the wheel thread).  The reference does the same with the
+# ServiceManager's HashedWheelTimer + executor — never a thread per lock.
+import threading as _threading
+
+_renewal_timer = None
+_renewal_pool = None
+_renewal_guard = _threading.Lock()
+
+
+def _client_renewal_infra():
+    global _renewal_timer, _renewal_pool
+    with _renewal_guard:
+        if _renewal_timer is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from redisson_tpu.utils.timer import HashedWheelTimer
+
+            _renewal_timer = HashedWheelTimer()
+            _renewal_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="rtpu-renew"
+            )
+        return _renewal_timer, _renewal_pool
+
 
 def _unwrap(reply: Any) -> Any:
     from redisson_tpu.net.safe_pickle import safe_loads
@@ -240,7 +265,8 @@ class RemoteTopic:
         self._codec = codec or DEFAULT_CODEC
 
     def publish(self, message: Any) -> int:
-        return int(self._client.execute("PUBLISH", self.name, self._codec.encode(message)))
+        # same node the subscribers attached to via pubsub_for(name)
+        return self._client.publish_for(self.name, self.name, self._codec.encode(message))
 
     def add_listener(self, listener: Callable[[str, Any], None]) -> Callable[[str, bytes], None]:
         codec = self._codec
@@ -391,14 +417,13 @@ class RemoteLock(RemoteObjectProxy):
         return self._client.objcall(self._factory, self._name, "force_unlock", (), {})
 
     def _start_client_watchdog(self) -> None:
-        import threading
-
         self._stop_client_watchdog()
-        # renewal fires on Timer threads, whose get_ident() differs from the
+        # renewal fires on pool threads, whose get_ident() differs from the
         # acquiring thread — capture the acquirer's identity NOW and renew
         # under it, or the server would refuse every tick
         held_as = self._client.caller_id()
         object.__setattr__(self, "_held_as", held_as)
+        timer, pool = _client_renewal_infra()
 
         def renew():
             try:
@@ -409,15 +434,15 @@ class RemoteLock(RemoteObjectProxy):
             except Exception:  # noqa: BLE001 — connection loss ends renewal
                 still_held = False
             if still_held and self.__dict__.get("_held_as") == held_as:
-                t = threading.Timer(self._WATCHDOG_LEASE / 3, renew)
-                t.daemon = True
+                t = timer.new_timeout(
+                    lambda: pool.submit(renew), self._WATCHDOG_LEASE / 3
+                )
                 object.__setattr__(self, "_renew_timer", t)
-                t.start()
 
-        t = threading.Timer(self._WATCHDOG_LEASE / 3, renew)
-        t.daemon = True
+        # the wheel tick only ENQUEUES the renewal; the RPC runs on the pool
+        # (a network call must never block the shared wheel thread)
+        t = timer.new_timeout(lambda: pool.submit(renew), self._WATCHDOG_LEASE / 3)
         object.__setattr__(self, "_renew_timer", t)
-        t.start()
 
     def _stop_client_watchdog(self) -> None:
         t = self.__dict__.get("_renew_timer")
@@ -519,7 +544,10 @@ class RemoteLocalCachedMap:
         if kind == "upd" and self._sync_strategy != SyncStrategy.UPDATE:
             kind, payload = "inv", [ek for ek, _ in payload]
         blob = pickle.dumps((kind, self._cache_id, payload), protocol=4)
-        self._client.execute("PUBLISH", self._channel, blob)
+        # route by the MAP name, not the channel string: subscribers attached
+        # on the map's slot owner (see __init__), and the channel's own slot
+        # differs from the map's
+        self._client.publish_for(self.name, self._channel, blob)
 
     def _ek(self, key) -> bytes:
         return self._codec.encode_map_key(key)
@@ -607,7 +635,7 @@ class RemoteLocalCachedMap:
         self._cache.clear()
         if self._sync:
             blob = pickle.dumps(("clear", self._cache_id), protocol=4)
-            self._client.execute("PUBLISH", self._channel, blob)
+            self._client.publish_for(self.name, self._channel, blob)
 
     def destroy(self) -> None:
         """Detach the invalidation listener (RObject.destroy parity) — keep
@@ -746,6 +774,14 @@ class RemoteRedisson(RemoteSurface):
     def pubsub_for(self, name: str):
         """Pubsub connection serving `name`'s channel (single node: the one)."""
         return self.node.pubsub()
+
+    def publish_for(self, routing_name: str, channel, payload) -> int:
+        """Publish on the node that serves `routing_name`'s subscriptions.
+
+        Must pair with pubsub_for: server pubsub hubs are node-local, so a
+        publish landing on any other node is silently lost.  Single node:
+        trivially the one node; the cluster override routes by slot."""
+        return int(self.execute("PUBLISH", channel, payload) or 0)
 
     # -- admin ---------------------------------------------------------------
 

@@ -8,11 +8,11 @@ the host state into a single versioned container, and written atomically
 (tmp + rename) so a crash mid-save never corrupts the previous snapshot.
 
 Restore re-creates every StateRecord and `jax.device_put`s arrays back onto
-the default device.  Sharded records (parallel/sharded.py grid states) are
-gathered on save and restored replicated; the shard manager re-shards them
-lazily on first sharded dispatch — format stability beats layout fidelity
-(SURVEY.md §7.3 hard-part 5: hash/layout compatibility is part of the
-persisted format, so `meta` carries the hash version of ops/bittensor).
+the default device — snapshots carry plain host arrays, never a device
+layout, so a checkpoint taken on one mesh restores on any other; format
+stability beats layout fidelity (SURVEY.md §7.3 hard-part 5: hash/layout
+compatibility is part of the persisted format, so `meta` carries the hash
+version of ops/bittensor).
 
 Wire format (version 1):
     8-byte magic  b"RTPUCKP1"

@@ -38,12 +38,19 @@ class Engine:
         self.store = DeviceStore()
         self.pubsub = PubSubHub()
         self.default_codec: Codec = DEFAULT_CODEC
-        self._record_locks: dict[str, threading.RLock] = {}
+        # name -> [RLock, refcount]: entries exist only while someone holds or
+        # waits on them, so object churn can't grow the registry unboundedly
+        self._record_locks: dict[str, list] = {}
         self._locks_guard = threading.Lock()
         self._wait_entries: dict[str, "object"] = {}
         self._holder_override = threading.local()
         self._closed = False
         self._eviction = None
+        self._timer = None
+        self._timer_pool = None
+        # (name, holder) -> Timeout: active lock-watchdog renewals, all on
+        # the ONE shared wheel timer (ServiceManager's HashedWheelTimer role)
+        self._renewals: dict[tuple, Any] = {}
         self._services: dict = {}
 
     def service(self, key: str, factory):
@@ -94,43 +101,166 @@ class Engine:
 
     def wait_entry(self, key: str):
         """Shared per-key wait latch (the RedissonLockEntry registry of
-        pubsub/PublishSubscribeService — one latch per waiting object)."""
+        pubsub/PublishSubscribeService — one latch per waiting object).
+
+        Idle entries (no waiters, no buffered signal, untouched for 60s) are
+        pruned by a background sweep; every park in the codebase is a bounded
+        retry loop, so a signal lost to a prune costs one park timeout, never
+        a hang."""
         from redisson_tpu.core.pubsub import WaitEntry
 
         with self._locks_guard:
             we = self._wait_entries.get(key)
             if we is None:
                 we = self._wait_entries[key] = WaitEntry()
-            return we
+        # the sweep rides the shared eviction thread; first use starts it
+        self.eviction.schedule("__wait_entry_gc__", self._gc_wait_entries)
+        return we
+
+    def _gc_wait_entries(self, max_idle: float = 60.0) -> int:
+        with self._locks_guard:
+            stale = [
+                k for k, we in self._wait_entries.items() if we.idle(max_idle)
+            ]
+            for k in stale:
+                del self._wait_entries[k]
+        return len(stale)
+
+    # -- timers --------------------------------------------------------------
+
+    @property
+    def timer(self):
+        """ONE shared wheel timer for all watchdogs/renewals — never a thread
+        per timeout (connection/ServiceManager.java HashedWheelTimer role)."""
+        with self._locks_guard:
+            if self._closed:
+                raise RuntimeError("engine is shut down")
+            if self._timer is None:
+                from redisson_tpu.utils.timer import HashedWheelTimer
+
+                self._timer = HashedWheelTimer()
+            return self._timer
+
+    @property
+    def timer_pool(self):
+        """Small shared pool that RUNS timed tasks (the reference pairs its
+        wheel timer with the ServiceManager executor the same way): wheel
+        ticks only enqueue, so a task blocking on a contended record lock
+        can never stall every other timeout in the process."""
+        with self._locks_guard:
+            if self._closed:
+                raise RuntimeError("engine is shut down")
+            if self._timer_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._timer_pool = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="rtpu-timer-task"
+                )
+            return self._timer_pool
+
+    def schedule_timeout(self, fn, delay: float):
+        """Run `fn` ~`delay` seconds from now on the shared timer pool.
+        Returns the wheel Timeout (cancellable until it fires)."""
+        pool = self.timer_pool
+        return self.timer.new_timeout(lambda: pool.submit(fn), delay)
+
+    def start_renewal(self, name: str, holder: str, renew, interval: float) -> None:
+        """Register a watchdog renewal for (lock name, holder) — the
+        EXPIRATION_RENEWAL_MAP discipline of RedissonBaseLock.java:127-189:
+        one renewal per (entry, holder) regardless of reentrancy; `renew()`
+        returns True to keep renewing, False to stop."""
+        key = (name, holder)
+
+        def tick():
+            # runs on the timer POOL (renew takes record locks and must not
+            # block the wheel thread — schedule_timeout enforces the hop)
+            try:
+                keep = bool(renew())
+            except Exception:  # noqa: BLE001 — a failing renew stops renewing
+                keep = False
+            with self._locks_guard:
+                if key not in self._renewals or not keep or self._closed:
+                    self._renewals.pop(key, None)
+                    return
+            nxt = self.schedule_timeout(tick, interval)
+            with self._locks_guard:
+                if key in self._renewals:
+                    self._renewals[key] = nxt
+                else:
+                    nxt.cancel()  # cancel_renewal raced the reschedule
+
+        with self._locks_guard:
+            if key in self._renewals:
+                return  # reentrant re-acquire keeps the existing renewal
+            self._renewals[key] = None  # claim the slot before scheduling
+        first = self.schedule_timeout(tick, interval)
+        with self._locks_guard:
+            if key in self._renewals:
+                self._renewals[key] = first
+            else:
+                first.cancel()  # cancelled between claim and schedule
+
+    def cancel_renewal(self, name: str, holder: Optional[str] = None) -> None:
+        """Stop renewals for a lock (all holders when holder is None — the
+        force_unlock path)."""
+        with self._locks_guard:
+            keys = [
+                k
+                for k in self._renewals
+                if k[0] == name and (holder is None or k[1] == holder)
+            ]
+            for k in keys:
+                t = self._renewals.pop(k)
+                if t is not None:  # None = start_renewal's claim placeholder
+                    t.cancel()
 
     # -- locking ------------------------------------------------------------
 
-    def record_lock(self, name: str) -> threading.RLock:
-        with self._locks_guard:
-            lock = self._record_locks.get(name)
-            if lock is None:
-                lock = self._record_locks[name] = threading.RLock()
-            return lock
-
     @contextmanager
     def locked(self, name: str):
-        lock = self.record_lock(name)
-        with lock:
-            yield
+        with self._locks_guard:
+            entry = self._record_locks.get(name)
+            if entry is None:
+                entry = self._record_locks[name] = [threading.RLock(), 0]
+            entry[1] += 1
+        try:
+            with entry[0]:
+                yield
+        finally:
+            with self._locks_guard:
+                entry[1] -= 1
+                if entry[1] == 0:
+                    # nobody holds or waits: drop the registry entry (churny
+                    # short-lived objects must not leak host memory)
+                    self._record_locks.pop(name, None)
 
     @contextmanager
     def locked_many(self, names: Iterable[str]):
         """Acquire several record locks in sorted-name order (deadlock-free
         for concurrent multi-object ops like PFMERGE / BITOP)."""
         ordered = sorted(set(names))
-        locks = [self.record_lock(n) for n in ordered]
-        for lk in locks:
-            lk.acquire()
+        entries = []
+        with self._locks_guard:
+            for n in ordered:
+                entry = self._record_locks.get(n)
+                if entry is None:
+                    entry = self._record_locks[n] = [threading.RLock(), 0]
+                entry[1] += 1
+                entries.append((n, entry))
+        acquired = []
         try:
+            for _n, entry in entries:
+                entry[0].acquire()
+                acquired.append(entry)
             yield
         finally:
-            for lk in reversed(locks):
-                lk.release()
+            for entry in reversed(acquired):
+                entry[0].release()
+            with self._locks_guard:
+                for n, entry in entries:
+                    entry[1] -= 1
+                    if entry[1] == 0:
+                        self._record_locks.pop(n, None)
 
     # -- key packing --------------------------------------------------------
 
@@ -178,6 +308,17 @@ class Engine:
         with self._locks_guard:
             self._closed = True
             eviction, self._eviction = self._eviction, None
+            timer, self._timer = self._timer, None
+            pool, self._timer_pool = self._timer_pool, None
+            renewals = list(self._renewals.values())
+            self._renewals.clear()
+        for t in renewals:
+            if t is not None:
+                t.cancel()
+        if timer is not None:
+            timer.stop()
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
         if eviction is not None:
             eviction.close()
         self.pubsub.close()

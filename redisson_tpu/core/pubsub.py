@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import fnmatch
 import threading
+import time
 from collections import defaultdict
 from typing import Any, Callable, Dict, List, Tuple
 
@@ -106,9 +107,12 @@ class WaitEntry:
     def __init__(self):
         self.cond = threading.Condition()
         self._signals = 0
+        self._waiters = 0
+        self._last_used = time.monotonic()
 
     def signal(self, all_: bool = False):
         with self.cond:
+            self._last_used = time.monotonic()
             self._signals += 1
             if all_:
                 self.cond.notify_all()
@@ -118,10 +122,28 @@ class WaitEntry:
     def wait_for(self, timeout: float | None) -> bool:
         """Wait until signalled; consumes one signal. Returns False on timeout."""
         with self.cond:
+            self._last_used = time.monotonic()
             if self._signals > 0:
                 self._signals -= 1
                 return True
-            ok = self.cond.wait(timeout)
+            self._waiters += 1
+            try:
+                ok = self.cond.wait(timeout)
+            finally:
+                self._waiters -= 1
+                self._last_used = time.monotonic()
             if ok and self._signals > 0:
                 self._signals -= 1
             return ok
+
+    def idle(self, max_idle: float) -> bool:
+        """True when prunable: nobody parked and untouched for `max_idle`
+        seconds (the engine's wait-entry GC predicate).  A buffered signal
+        does NOT pin the entry — it is a wakeup hint, and every parker in the
+        codebase re-checks its condition in a bounded retry loop, so losing a
+        stale signal costs one park timeout, never a hang."""
+        with self.cond:
+            return (
+                self._waiters == 0
+                and time.monotonic() - self._last_used >= max_idle
+            )

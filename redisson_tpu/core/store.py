@@ -4,8 +4,9 @@ Role parity: in the reference, every RObject is a *stateless handle* and all
 state lives in the Redis server keyed by name (SURVEY.md §1 L5).  Here the
 "server state" is a process-local registry mapping object name -> a state
 record holding device arrays plus metadata (kind, logical sizes, hash/format
-version).  Handles stay stateless; compound mutations flow through the shard
-sequencer (core/sequencer.py) for Lua-equivalent atomicity.
+version).  Handles stay stateless; compound mutations run under the engine's
+per-record locks (core/engine.py `locked`/`locked_many`) for Lua-equivalent
+atomicity — single writer per object name.
 
 Mutation discipline: states are replaced wholesale (functional update) by
 kernels jitted with donated arguments, so XLA reuses the HBM buffer in place —
@@ -13,6 +14,7 @@ the TPU analogue of Redis mutating its dict entry.
 """
 from __future__ import annotations
 
+import secrets
 import threading
 import time
 from dataclasses import dataclass, field
@@ -27,6 +29,10 @@ class StateRecord:
     host: Any = None                # host-side python state (dict/list/...)
     version: int = 0                # bumped on every mutation (optimistic cc)
     expire_at: Optional[float] = None  # epoch seconds, None = persistent
+    # creation identity: versions restart at 0 when a name is deleted and
+    # recreated, so replication compares (nonce, version), not version alone —
+    # otherwise a recreate within one ship interval is invisible to replicas
+    nonce: int = field(default_factory=lambda: secrets.randbits(63))
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.expire_at is not None and (now or time.time()) >= self.expire_at

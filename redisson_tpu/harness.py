@@ -124,6 +124,17 @@ class ClusterRunner:
         self.stop_node(node)
         return node
 
+    def restart_node(self, node: ClusterNode) -> ClusterNode:
+        """Bring a stopped node back on the SAME port (RedisRunner.restart
+        analog).  State starts empty — an in-process node's store dies with
+        its thread, like a redis-server restarted without persistence."""
+        port = node.port
+        node.server = ServerThread(port=port, **self.server_kw).start()
+        node.stopped = False
+        self.install_view()
+        self.wire_replicas()  # re-attach replica links severed by the restart
+        return node
+
     def promote(self, replica: ClusterNode) -> None:
         """Manual failover: replica takes over its dead master's slot range
         (the coordinator in server/monitor.py automates this)."""

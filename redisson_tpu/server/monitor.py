@@ -56,6 +56,11 @@ class FailoverCoordinator:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.failovers: List[Tuple[str, str]] = []  # (dead master, promoted)
+        # dead masters with no promotable replica: their slot range is down
+        # (CLUSTERDOWN) but NOT abandoned — the loop keeps pinging them and
+        # retrying promotion, so a restarted master or a late replica
+        # restores the range instead of leaving it orphaned forever
+        self._pending: Dict[str, MonitoredMaster] = {}
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -70,7 +75,7 @@ class FailoverCoordinator:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
-        for m in self._masters.values():
+        for m in list(self._masters.values()) + list(self._pending.values()):
             m.client.close()
 
     # -- the check loop (scheduleClusterChangeCheck analog) -------------------
@@ -79,6 +84,43 @@ class FailoverCoordinator:
         while not self._stop.wait(self.check_interval):
             for m in list(self._masters.values()):
                 self._check(m)
+            for m in list(self._pending.values()):
+                self._check_pending(m)
+
+    def _check_pending(self, m: MonitoredMaster) -> None:
+        try:
+            back = m.client.execute("PING", timeout=2.0) in (b"PONG", "PONG")
+        except Exception:  # noqa: BLE001 — still down
+            back = False
+        if back:
+            # the master itself returned: resume monitoring, and re-push the
+            # view — an intervening failover's SETVIEW was built while this
+            # range was pending and may have reached nodes that missed it
+            self._pending.pop(m.address, None)
+            m.detector.on_ping_successful()
+            self._masters[m.address] = m
+            self._push_view()
+            return
+        # still dead: a replica may have come (back) up — retry promotion
+        self._failover(m)
+
+    def _view_flat(self) -> List:
+        """Current slot view INCLUDING pending (down but unreplaced) ranges —
+        dropping a pending range from SETVIEW would orphan its slots on every
+        node even after the master returns."""
+        flat: List = []
+        for m in list(self._masters.values()) + list(self._pending.values()):
+            h, p = m.address.rsplit(":", 1)
+            flat += [m.slot_range[0], m.slot_range[1], h, int(p), m.node_id]
+        return flat
+
+    def _push_view(self) -> None:
+        flat = self._view_flat()
+        for m in list(self._masters.values()):
+            try:
+                m.client.execute("CLUSTER", "SETVIEW", *flat, timeout=5.0)
+            except Exception:  # noqa: BLE001 — node will catch up on next push
+                pass
 
     def _check(self, m: MonitoredMaster) -> None:
         try:
@@ -102,7 +144,6 @@ class FailoverCoordinator:
 
     def _failover(self, dead: MonitoredMaster) -> None:
         self._masters.pop(dead.address, None)
-        dead.client.close()
         promoted: Optional[str] = None
         for candidate in dead.replicas:
             c = None
@@ -117,21 +158,19 @@ class FailoverCoordinator:
                 if c is not None:
                     c.close()
         if promoted is None:
-            return  # no live replica: slot range stays down (CLUSTERDOWN)
+            # no live replica: slot range is down (CLUSTERDOWN) but stays on
+            # the pending list so a node restart can recover it (above)
+            self._pending[dead.address] = dead
+            return
+        self._pending.pop(dead.address, None)
+        dead.client.close()
         host, port = promoted.rsplit(":", 1)
         nm = MonitoredMaster(promoted, dead.slot_range, dead.node_id)
         nm.replicas = [r for r in dead.replicas if r != promoted]
         self._masters[promoted] = nm
-        # rewrite the view everywhere (SETVIEW is last-writer-wins)
-        flat: List = []
-        for m in self._masters.values():
-            h, p = m.address.rsplit(":", 1)
-            flat += [m.slot_range[0], m.slot_range[1], h, int(p), m.node_id]
-        for m in list(self._masters.values()):
-            try:
-                m.client.execute("CLUSTER", "SETVIEW", *flat, timeout=5.0)
-            except Exception:  # noqa: BLE001 — node will catch up on next view push
-                pass
+        # rewrite the view everywhere (SETVIEW is last-writer-wins); pending
+        # ranges stay in the view so their slots aren't orphaned
+        self._push_view()
         # surviving replicas of the dead master re-attach to the promoted one
         for r in nm.replicas:
             rc = None

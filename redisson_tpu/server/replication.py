@@ -32,11 +32,14 @@ import numpy as np
 
 def serialize_records(
     engine, names: Optional[List[str]] = None
-) -> Tuple[bytes, List[Tuple[str, int]]]:
+) -> Tuple[bytes, List[Tuple[str, int, int]]]:
     """Consistent host-side cut of (all | named) records.
 
-    Returns (blob, [(name, version), ...]) — shipped versions come back so
-    the caller can track per-replica progress without re-decoding the blob.
+    Returns (blob, [(name, nonce, version), ...]) — shipped identities come
+    back so the caller can track per-replica progress without re-decoding the
+    blob.  The nonce travels with the version because a deleted-and-recreated
+    record restarts at version 0 under a fresh nonce; comparing versions alone
+    would leave the replica serving the old value forever.
     The blob also carries the full live-name list: deletions don't bump any
     record version, so the receiving replica prunes records absent from it
     (DEL/UNLINK/FLUSHALL propagation under record-level shipping).
@@ -48,7 +51,7 @@ def serialize_records(
             (n, store._states[n]) for n in live if names is None or n in names
         ]
     out = []
-    shipped: List[Tuple[str, int]] = []
+    shipped: List[Tuple[str, int, int]] = []
     for name, rec in items:
         with engine.locked(name):
             out.append(
@@ -57,12 +60,13 @@ def serialize_records(
                     "kind": rec.kind,
                     "meta": dict(rec.meta),
                     "version": rec.version,
+                    "nonce": rec.nonce,
                     "expire_at": rec.expire_at,
                     "host_pickled": pickle.dumps(rec.host, protocol=4),
                     "arrays": {k: np.asarray(v) for k, v in rec.arrays.items()},
                 }
             )
-            shipped.append((name, rec.version))
+            shipped.append((name, rec.nonce, rec.version))
     blob = pickle.dumps({"format": 1, "records": out, "live": live}, protocol=4)
     return blob, shipped
 
@@ -78,10 +82,18 @@ def apply_records(engine, blob: bytes) -> int:
     applied = 0
     for item in payload["records"]:
         name = item["name"]
+        nonce = item.get("nonce")
         with engine.locked(name):
             existing = engine.store.get(name)
-            if existing is not None and existing.version >= item["version"]:
-                continue  # stale ship (out-of-order push) — keep newer state
+            if (
+                existing is not None
+                and (nonce is None or existing.nonce == nonce)
+                and existing.version >= item["version"]
+            ):
+                # stale ship (out-of-order push of the SAME incarnation) —
+                # keep newer state.  A nonce mismatch means the master
+                # recreated the record: install it even at a lower version.
+                continue
             rec = StateRecord(
                 kind=item["kind"],
                 meta=item["meta"],
@@ -89,6 +101,8 @@ def apply_records(engine, blob: bytes) -> int:
                 host=pickle.loads(item["host_pickled"]),  # noqa: S301 — trusted repl link
             )
             rec.version = item["version"]
+            if nonce is not None:
+                rec.nonce = nonce
             rec.expire_at = item["expire_at"]
             engine.store.put(name, rec)
             applied += 1
@@ -113,7 +127,9 @@ class ReplicaHandle:
         self.address = address
         # grid nodes share credentials (see registry cmd_replicaof note)
         self.client = NodeClient(address, ping_interval=0, retry_attempts=1, password=password)
-        self.shipped: Dict[str, int] = {}  # record name -> version last shipped
+        # record name -> (nonce, version) last shipped; the nonce detects
+        # delete+recreate between sweeps (version restarts under a new nonce)
+        self.shipped: Dict[str, Tuple[int, int]] = {}
         self.healthy = True
 
 
@@ -165,7 +181,11 @@ class ReplicationSource:
         engine = self.server.engine
         with engine.store._lock:
             live = {n: r for n, r in engine.store._states.items() if not r.expired()}
-        dirty = [n for n, r in live.items() if handle.shipped.get(n, -1) < r.version]
+        dirty = []
+        for n, r in live.items():
+            sh = handle.shipped.get(n)
+            if sh is None or sh[0] != r.nonce or sh[1] < r.version:
+                dirty.append(n)
         deleted = [n for n in handle.shipped if n not in live]
         return dirty, deleted
 
@@ -186,8 +206,8 @@ class ReplicationSource:
             except Exception:  # noqa: BLE001 — replica down; retry next sweep
                 h.healthy = False
                 continue
-            for name, version in shipped:
-                h.shipped[name] = version
+            for name, nonce, version in shipped:
+                h.shipped[name] = (nonce, version)
             for name in deleted:
                 h.shipped.pop(name, None)
             total += len(names) + len(deleted)

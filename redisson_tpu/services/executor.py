@@ -437,7 +437,7 @@ class ScheduledExecutorService(ExecutorService):
 
     def __init__(self, engine, name: str):
         super().__init__(engine, name)
-        self._timers: List[threading.Timer] = []
+        self._timers: List = []  # wheel Timeouts (shared engine timer)
 
     def schedule(self, delay: float, fn: Callable, *args, **kwargs) -> TaskFuture:
         """scheduleAsync(task, delay)."""
@@ -458,10 +458,9 @@ class ScheduledExecutorService(ExecutorService):
                 rec2.host["queue"].append(task.id)
             self._wait().signal()
 
-        t = threading.Timer(delay, fire)
-        t.daemon = True
-        t.start()
-        self._timers.append(t)
+        # one shared wheel timer, not a thread per scheduled task; fire()
+        # takes record locks, so it runs on the timer pool, not the wheel
+        self._timers.append(self._engine.schedule_timeout(fire, delay))
         return fut
 
     def schedule_at_fixed_rate(self, initial_delay: float, period: float, fn: Callable, *args) -> str:

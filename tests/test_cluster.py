@@ -291,3 +291,135 @@ def test_password_protected_cluster_bootstrap_and_replication():
         client.shutdown()
     finally:
         runner.shutdown()
+
+
+# -- advisor regressions: pubsub routing, retry semantics, recreation ---------
+
+def _name_owned_by(runner, master_index: int, prefix: str = "t") -> str:
+    """A channel/object name whose slot owner is masters[master_index]."""
+    lo, hi = runner.slot_ranges[master_index]
+    i = 0
+    while True:
+        name = f"{prefix}-{i}"
+        if lo <= calc_slot(name.encode()) <= hi:
+            return name
+        i += 1
+
+
+def test_cluster_topic_publish_routes_to_slot_owner(cluster3):
+    """PUBLISH must land on the shard pubsub_for(name) subscribed on — for
+    every shard, not just entries[0] (advisor finding: keyless routing sent
+    all publishes to the first entry)."""
+    import threading
+
+    pub = cluster3.client(scan_interval=0)
+    sub = cluster3.client(scan_interval=0)
+    try:
+        for mi in range(len(cluster3.masters)):
+            name = _name_owned_by(cluster3, mi, prefix="topic")
+            got, evt = [], threading.Event()
+            topic_sub = sub.get_topic(name)
+            topic_sub.add_listener(lambda ch, msg: (got.append((ch, msg)), evt.set()))
+            time.sleep(0.1)
+            assert pub.get_topic(name).publish({"shard": mi}) >= 1
+            assert evt.wait(2), f"message for shard {mi} never arrived"
+            assert got[0] == (name, {"shard": mi})
+            topic_sub.remove_all_listeners()
+    finally:
+        pub.shutdown()
+        sub.shutdown()
+
+
+def test_cluster_local_cached_map_invalidation(cluster3):
+    """Cross-client near-cache invalidation on a map owned by a NON-first
+    shard (advisor finding: broadcasts published to entries[0] were lost)."""
+    c1 = cluster3.client(scan_interval=0)
+    c2 = cluster3.client(scan_interval=0)
+    try:
+        name = _name_owned_by(cluster3, len(cluster3.masters) - 1, prefix="lcm")
+        m1 = c1.get_local_cached_map(name)
+        m2 = c2.get_local_cached_map(name)
+        m1.put("k", "v1")
+        assert m2.get("k") == "v1"
+        assert m2.get("k") == "v1"  # now cached near m2
+        m1.put("k", "v2")  # must invalidate m2's near cache via pubsub
+        deadline = time.time() + 5
+        while time.time() < deadline and m2.get("k") != "v2":
+            time.sleep(0.05)
+        assert m2.get("k") == "v2", "peer near-cache never invalidated"
+    finally:
+        c1.shutdown()
+        c2.shutdown()
+
+
+def test_replication_recreate_within_ship_interval():
+    """DEL + recreate between ships must still replicate: versions restart
+    at 0 under a fresh nonce, and the (nonce, version) compare catches it."""
+    runner = ClusterRunner(masters=1, replicas_per_master=1).run()
+    try:
+        client = runner.client(scan_interval=0)
+        client.get_bucket("phoenix").set("old")
+        with runner.masters[0].server.client() as c:
+            _exec(c, "REPLFLUSH")
+        # delete AND recreate before the next ship
+        client.execute("DEL", "phoenix")
+        client.get_bucket("phoenix").set("new")
+        with runner.masters[0].server.client() as c:
+            _exec(c, "REPLFLUSH")
+        rep_engine = runner.replicas[0].server.server.engine
+        rec = rep_engine.store.get("phoenix")
+        assert rec is not None, "recreated record never shipped"
+        with runner.replicas[0].server.client() as c:
+            raw = _exec(c, "GET", "phoenix")
+        from redisson_tpu.client.codec import DEFAULT_CODEC
+
+        assert DEFAULT_CODEC.decode(bytes(raw)) == "new"
+        client.shutdown()
+    finally:
+        runner.shutdown()
+
+
+def test_execute_many_all_shard_fanout(cluster3):
+    """DBSIZE/KEYS inside a pipeline must scatter-gather like the single
+    path, not land on one arbitrary entry (advisor finding)."""
+    client = cluster3.client(scan_interval=0)
+    try:
+        for i in range(12):
+            client.get_bucket(f"em-{i}").set(i)
+        results = client.execute_many([("DBSIZE",), ("GET", "em-0")])
+        assert results[0] >= 12  # sum over ALL shards, not one shard's count
+        per_shard = []
+        for node in cluster3.masters:
+            with node.server.client() as c:
+                per_shard.append(_exec(c, "DBSIZE"))
+        assert results[0] == sum(per_shard)
+    finally:
+        client.shutdown()
+
+
+def test_failover_coordinator_keeps_unpromotable_master_pending():
+    """A dead master with no replicas must stay monitored: when it returns,
+    the coordinator resumes instead of orphaning the slot range forever."""
+    from redisson_tpu.server.monitor import FailoverCoordinator
+
+    runner = ClusterRunner(masters=2, replicas_per_master=0).run()
+    coord = None
+    try:
+        coord = FailoverCoordinator(runner.view_tuples(), check_interval=0.1).start()
+        dead_addr = runner.masters[0].address
+        runner.stop_master(0)
+        deadline = time.time() + 10
+        while time.time() < deadline and dead_addr not in coord._pending:
+            time.sleep(0.1)
+        assert dead_addr in coord._pending, "dead master never went pending"
+        assert dead_addr not in coord._masters
+        runner.restart_node(runner.masters[0])
+        deadline = time.time() + 10
+        while time.time() < deadline and dead_addr not in coord._masters:
+            time.sleep(0.1)
+        assert dead_addr in coord._masters, "returned master never re-monitored"
+        assert dead_addr not in coord._pending
+    finally:
+        if coord is not None:
+            coord.stop()
+        runner.shutdown()
