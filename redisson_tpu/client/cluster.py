@@ -380,21 +380,8 @@ class ClusterRedisson(RemoteSurface):
         with self._lock:
             slot_table = list(self._slots)
             entries = dict(self._entries)
-        groups: Dict[Optional[str], List[int]] = {}
         writes: List[bool] = [False] * len(commands)
         results: List[Any] = [None] * len(commands)
-        for i, c in enumerate(commands):
-            cmd = str(c[0]).upper()
-            if cmd in self._ALL_SHARD:
-                # scatter-gather commands must fan out, not land on one
-                # arbitrary entry — route through the merging single path
-                # (transport errors raise, matching execute())
-                results[i] = self._execute_all_shards(cmd, tuple(c), timeout)
-                continue
-            slot, w = self._route(cmd, tuple(c[1:]))
-            writes[i] = w
-            addr = None if slot in (None, -1) else slot_table[slot]
-            groups.setdefault(addr, []).append(i)
 
         def run_group(addr, idxs):
             entry = entries.get(addr) if addr is not None else next(iter(entries.values()), None)
@@ -428,20 +415,48 @@ class ClusterRedisson(RemoteSurface):
                         r = e if isinstance(r, RespError) else r
                 results[i] = r
 
-        if len(groups) <= 1:
-            for addr, idxs in groups.items():
-                run_group(addr, idxs)
-        else:
-            # shards execute their frames CONCURRENTLY (per-shard order is
-            # preserved inside each frame) — the whole point of the per-slot
-            # grouping is that a multi-shard batch costs one shard's latency,
-            # not the sum (CommandBatchService writes all entries in parallel)
-            import concurrent.futures as _cf
+        def run_segment(seg: List[int]) -> None:
+            groups: Dict[Optional[str], List[int]] = {}
+            for i in seg:
+                c = commands[i]
+                slot, w = self._route(str(c[0]), tuple(c[1:]))
+                writes[i] = w
+                addr = None if slot in (None, -1) else slot_table[slot]
+                groups.setdefault(addr, []).append(i)
+            if len(groups) <= 1:
+                for addr, idxs in groups.items():
+                    run_group(addr, idxs)
+            else:
+                # shards execute their frames CONCURRENTLY (per-shard order
+                # is preserved inside each frame) — a multi-shard batch costs
+                # one shard's latency, not the sum (CommandBatchService
+                # writes all entries in parallel)
+                import concurrent.futures as _cf
 
-            with _cf.ThreadPoolExecutor(max_workers=min(len(groups), 16)) as pool:
-                futs = [pool.submit(run_group, a, idxs) for a, idxs in groups.items()]
-                for f in futs:
-                    f.result()
+                with _cf.ThreadPoolExecutor(max_workers=min(len(groups), 16)) as pool:
+                    futs = [
+                        pool.submit(run_group, a, idxs) for a, idxs in groups.items()
+                    ]
+                    for f in futs:
+                        f.result()
+
+        # scatter-gather commands (KEYS/DBSIZE/FLUSHALL) act as ordering
+        # barriers: everything submitted before one completes before it runs,
+        # everything after starts after — submission-order semantics hold
+        # even for a (\"SET\", ...), (\"FLUSHALL\",) batch.  Transport errors
+        # raise, matching execute().
+        segment: List[int] = []
+        for i, c in enumerate(commands):
+            cmd = str(c[0]).upper()
+            if cmd in self._ALL_SHARD:
+                if segment:
+                    run_segment(segment)
+                    segment = []
+                results[i] = self._execute_all_shards(cmd, tuple(c), timeout)
+            else:
+                segment.append(i)
+        if segment:
+            run_segment(segment)
         return results
 
     def pubsub_for(self, name: str):

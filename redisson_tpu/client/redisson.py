@@ -44,6 +44,19 @@ class RedissonTpu:
 
         return BloomFilterArray(self._engine, name)
 
+    def get_sharded_bloom_filter_array(self, name: str):
+        """Bloom bank whose bit plane is sharded over the device mesh
+        (parallel/manager.py; SURVEY.md §5.7 capability jump)."""
+        from redisson_tpu.client.objects.sharded import ShardedBloomFilterArray
+
+        return ShardedBloomFilterArray(self._engine, name)
+
+    def get_sharded_hll_array(self, name: str):
+        """HLL bank whose tenant axis is sharded over the device mesh."""
+        from redisson_tpu.client.objects.sharded import ShardedHllArray
+
+        return ShardedHllArray(self._engine, name)
+
     def get_hyper_log_log(self, name: str, codec: Optional[Codec] = None):
         from redisson_tpu.client.objects.hyperloglog import HyperLogLog
 

@@ -48,6 +48,7 @@ class Engine:
         self._eviction = None
         self._timer = None
         self._timer_pool = None
+        self._renewal_pool_ = None
         # (name, holder) -> Timeout: active lock-watchdog renewals, all on
         # the ONE shared wheel timer (ServiceManager's HashedWheelTimer role)
         self._renewals: dict[tuple, Any] = {}
@@ -113,6 +114,7 @@ class Engine:
             we = self._wait_entries.get(key)
             if we is None:
                 we = self._wait_entries[key] = WaitEntry()
+        we.touch()  # a fetched entry is in use: restart its idle clock
         # the sweep rides the shared eviction thread; first use starts it
         self.eviction.schedule("__wait_entry_gc__", self._gc_wait_entries)
         return we
@@ -164,6 +166,24 @@ class Engine:
         pool = self.timer_pool
         return self.timer.new_timeout(lambda: pool.submit(fn), delay)
 
+    @property
+    def _renewal_pool(self):
+        """Dedicated single worker for lease renewals.  Renewals are
+        lease-CRITICAL: sharing a pool with arbitrary user work (MapWriter
+        flushes, scheduled-task fires) would let a blocked writer starve
+        renewals past lease expiry — two holders of a mutual-exclusion
+        lock.  Renewal ticks only take a record lock briefly."""
+        with self._locks_guard:
+            if self._closed:
+                raise RuntimeError("engine is shut down")
+            if self._renewal_pool_ is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._renewal_pool_ = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="rtpu-renewal"
+                )
+            return self._renewal_pool_
+
     def start_renewal(self, name: str, holder: str, renew, interval: float) -> None:
         """Register a watchdog renewal for (lock name, holder) — the
         EXPIRATION_RENEWAL_MAP discipline of RedissonBaseLock.java:127-189:
@@ -182,7 +202,7 @@ class Engine:
                 if key not in self._renewals or not keep or self._closed:
                     self._renewals.pop(key, None)
                     return
-            nxt = self.schedule_timeout(tick, interval)
+            nxt = self._schedule_renewal_tick(tick, interval)
             with self._locks_guard:
                 if key in self._renewals:
                     self._renewals[key] = nxt
@@ -193,12 +213,16 @@ class Engine:
             if key in self._renewals:
                 return  # reentrant re-acquire keeps the existing renewal
             self._renewals[key] = None  # claim the slot before scheduling
-        first = self.schedule_timeout(tick, interval)
+        first = self._schedule_renewal_tick(tick, interval)
         with self._locks_guard:
             if key in self._renewals:
                 self._renewals[key] = first
             else:
                 first.cancel()  # cancelled between claim and schedule
+
+    def _schedule_renewal_tick(self, tick, interval: float):
+        pool = self._renewal_pool
+        return self.timer.new_timeout(lambda: pool.submit(tick), interval)
 
     def cancel_renewal(self, name: str, holder: Optional[str] = None) -> None:
         """Stop renewals for a lock (all holders when holder is None — the
@@ -310,6 +334,7 @@ class Engine:
             eviction, self._eviction = self._eviction, None
             timer, self._timer = self._timer, None
             pool, self._timer_pool = self._timer_pool, None
+            rpool, self._renewal_pool_ = self._renewal_pool_, None
             renewals = list(self._renewals.values())
             self._renewals.clear()
         for t in renewals:
@@ -317,8 +342,9 @@ class Engine:
                 t.cancel()
         if timer is not None:
             timer.stop()
-        if pool is not None:
-            pool.shutdown(wait=False, cancel_futures=True)
+        for p in (pool, rpool):
+            if p is not None:
+                p.shutdown(wait=False, cancel_futures=True)
         if eviction is not None:
             eviction.close()
         self.pubsub.close()

@@ -136,6 +136,14 @@ class WaitEntry:
                 self._signals -= 1
             return ok
 
+    def touch(self) -> None:
+        """Reset the idle clock — called on every registry fetch so the GC
+        can never prune an entry between a caller's wait_entry() lookup and
+        its first park (the fetch-to-park window is the race the sweep's
+        60s idle threshold must dominate)."""
+        with self.cond:
+            self._last_used = time.monotonic()
+
     def idle(self, max_idle: float) -> bool:
         """True when prunable: nobody parked and untouched for `max_idle`
         seconds (the engine's wait-entry GC predicate).  A buffered signal

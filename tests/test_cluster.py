@@ -423,3 +423,20 @@ def test_failover_coordinator_keeps_unpromotable_master_pending():
         if coord is not None:
             coord.stop()
         runner.shutdown()
+
+
+def test_execute_many_all_shard_is_ordering_barrier(cluster3):
+    """A fan-out command inside a pipeline observes the writes submitted
+    before it and not those after (submission-order semantics)."""
+    client = cluster3.client(scan_interval=0)
+    try:
+        client.execute("FLUSHALL")
+        res = client.execute_many(
+            [("SET", "ob-1", "x"), ("DBSIZE",), ("SET", "ob-2", "y"), ("DBSIZE",)]
+        )
+        assert res[1] == 1  # sees ob-1 only
+        assert res[3] == 2  # sees both
+        res = client.execute_many([("SET", "ob-3", "z"), ("FLUSHALL",)])
+        assert client.execute("DBSIZE") == 0  # the SET ran BEFORE the flush
+    finally:
+        client.shutdown()
