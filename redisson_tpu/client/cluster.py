@@ -330,6 +330,36 @@ class ClusterRedisson(RemoteSurface):
                     last = e
                     self.refresh_topology()
                     continue
+                if msg.startswith("ASK "):
+                    # ASK <slot> <host:port> — one-shot redirect into the
+                    # migration window; NO topology refresh (the view still
+                    # names the draining owner until finalization)
+                    try:
+                        return self._execute_asking(msg.split()[2], cmd_args, timeout)
+                    except RespError as e2:
+                        if str(e2).startswith(("MOVED ", "ASK ", "TRYAGAIN")):
+                            # stale window (chained reshard / lost view):
+                            # feed it back into the redirect loop
+                            last = e2
+                            self.refresh_topology()
+                            continue
+                        raise
+                    except (ConnectionError, OSError, TimeoutError) as e2:
+                        # importing node dropped mid-hop: same transport-retry
+                        # rules as the primary path (writes keep at-most-once)
+                        if write and isinstance(e2, TimeoutError):
+                            raise
+                        last = e2
+                        self.refresh_topology()
+                        time.sleep(min(0.1 * (attempt + 1), 1.0))
+                        continue
+                if msg.startswith("TRYAGAIN"):
+                    # multi-key op spanning a live migration window: neither
+                    # node holds every key yet — back off and retry
+                    # (RedisExecutor treats TRYAGAIN as a scheduled retry)
+                    last = e
+                    time.sleep(min(0.05 * (attempt + 1), 0.5))
+                    continue
                 raise
             except (ConnectionError, OSError, TimeoutError) as e:
                 if write and isinstance(e, TimeoutError):
@@ -345,6 +375,30 @@ class ClusterRedisson(RemoteSurface):
                 continue
         assert last is not None
         raise last
+
+    def _execute_asking(self, target: str, cmd_args, timeout) -> Any:
+        """ASKING + command on ONE connection of the importing node (the
+        RedisExecutor ASK path: same connection, no slot-table update)."""
+        with self._lock:
+            entry = self._entries.get(target)
+        transient = None
+        try:
+            if entry is not None:
+                node = entry.master
+            else:
+                # target not in the current view (fresh master taking its
+                # first slots): transient link with the same credentials
+                kw = dict(self._node_kw)
+                kw.update(ping_interval=0, retry_attempts=0)
+                transient = node = NodeClient(target, **kw)
+            replies = node.execute_many([("ASKING",), tuple(cmd_args)], timeout=timeout)
+            reply = replies[1]
+            if isinstance(reply, RespError):
+                raise reply
+            return reply
+        finally:
+            if transient is not None:
+                transient.close()
 
     def _execute_all_shards(self, cmd: str, cmd_args, timeout) -> Any:
         merge = self._ALL_SHARD[cmd]
@@ -405,7 +459,9 @@ class ClusterRedisson(RemoteSurface):
                     raise
                 replies = [self.execute(*commands[i], timeout=timeout) for i in idxs]
             for i, r in zip(idxs, replies):
-                if isinstance(r, RespError) and str(r).startswith(("MOVED ", "CLUSTERDOWN")):
+                if isinstance(r, RespError) and str(r).startswith(
+                    ("MOVED ", "CLUSTERDOWN", "ASK ", "TRYAGAIN")
+                ):
                     # pipelined frames return per-command errors as values;
                     # redirects re-route through the redirect-aware execute()
                     # (a migrated slot must not surface as a silent error row)

@@ -159,6 +159,12 @@ class ShardedBloomFilterArray(_ShardedBase):
     def clear_tenant(self, tenant_id: int) -> None:
         with self._engine.locked(self._name):
             rec = self._rec()
+            if not 0 <= tenant_id < rec.meta["tenants"]:
+                # .at[].set would silently CLAMP an out-of-range row and wipe
+                # the last tenant's bits — fail loudly instead
+                raise IndexError(
+                    f"tenant {tenant_id} out of range [0, {rec.meta['tenants']})"
+                )
             bits = self._mgr.ensure_state(rec, "bits", BLOOM_SPEC)
             rec.arrays["bits"] = bits.at[tenant_id].set(jnp.uint8(0))
             self._touch_version(rec)
@@ -236,6 +242,10 @@ class ShardedHllArray(_ShardedBase):
     def clear_tenant(self, tenant_id: int) -> None:
         with self._engine.locked(self._name):
             rec = self._rec()
+            if not 0 <= tenant_id < rec.meta["tenants"]:
+                raise IndexError(
+                    f"tenant {tenant_id} out of range [0, {rec.meta['tenants']})"
+                )
             regs = self._mgr.ensure_state(rec, "regs", HLL_SPEC)
             rec.arrays["regs"] = regs.at[tenant_id].set(jnp.uint8(0))
             self._touch_version(rec)

@@ -49,18 +49,29 @@ class DeviceStore:
     def __init__(self):
         self._lock = threading.RLock()
         self._states: Dict[str, StateRecord] = {}
+        # Optional hook called whenever an ABSENT name is touched — created
+        # (get_or_create / put) or read/deleted as missing (get / delete).
+        # The slot-migration window installs one that ASK-redirects absent
+        # names in MIGRATING slots: creations must happen on the target, and
+        # a record the drain just moved must redirect rather than read as
+        # nil (read-your-writes across the handoff).  This is the chokepoint
+        # that makes drain-vs-access races lose no acked state
+        # (server/server.py _migration_absent_guard).
+        self.absent_guard: Optional[Callable[[str], None]] = None
 
     def get(self, name: str) -> Optional[StateRecord]:
         with self._lock:
             rec = self._states.get(name)
             if rec is not None and rec.expired():
                 del self._states[name]
-                return None
+                rec = None
+            if rec is None and self.absent_guard is not None:
+                self.absent_guard(name)
             return rec
 
     def get_or_create(self, name: str, kind: str, factory: Callable[[], StateRecord]) -> StateRecord:
         with self._lock:
-            rec = self.get(name)
+            rec = self.get(name)  # raises via absent_guard in a migration window
             if rec is None:
                 rec = factory()
                 assert rec.kind == kind
@@ -74,14 +85,49 @@ class DeviceStore:
 
     def put(self, name: str, rec: StateRecord) -> None:
         with self._lock:
+            if name not in self._states and self.absent_guard is not None:
+                self.absent_guard(name)
+            self._states[name] = rec
+
+    def put_unguarded(self, name: str, rec: StateRecord) -> None:
+        """Install bypassing the absent guard — ONLY for migration/replication
+        transfer frames, which legitimately create records in windowed slots
+        (the importing side) or overwrite during a drain."""
+        with self._lock:
             self._states[name] = rec
 
     def delete(self, name: str) -> bool:
+        with self._lock:
+            existed = self._states.pop(name, None) is not None
+            if not existed and self.absent_guard is not None:
+                self.absent_guard(name)
+            return existed
+
+    def delete_unguarded(self, name: str) -> bool:
+        """Delete bypassing the absent guard (the drain's own removal)."""
         with self._lock:
             return self._states.pop(name, None) is not None
 
     def exists(self, name: str) -> bool:
         return self.get(name) is not None
+
+    def get_unguarded(self, name: str) -> Optional[StateRecord]:
+        """get() without the absent guard — for transfer-frame appliers
+        (replication/migration) that legitimately probe absent names."""
+        with self._lock:
+            rec = self._states.get(name)
+            if rec is not None and rec.expired():
+                del self._states[name]
+                return None
+            return rec
+
+    def peek(self, name: str) -> bool:
+        """Existence WITHOUT the absent guard — for routing decisions that
+        must inspect both present and absent keys (TRYAGAIN vs ASK) and for
+        the drain's own bookkeeping."""
+        with self._lock:
+            rec = self._states.get(name)
+            return rec is not None and not rec.expired()
 
     def rename(self, old: str, new: str) -> bool:
         with self._lock:

@@ -35,11 +35,12 @@ SPECS: dict = {}
 _spec(SPECS, "PING ECHO AUTH HELLO SELECT CLIENT QUIT DBSIZE TIME INFO MEMORY "
              "CLUSTER KEYS SAVE REPLICAOF REPLREGISTER "
              "REPLPUSH REPLFLUSH REPLSNAPSHOT REPLICAS SUBSCRIBE UNSUBSCRIBE "
-             "PSUBSCRIBE PUNSUBSCRIBE PUBLISH METRICS", False, None)
+             "PSUBSCRIBE PUNSUBSCRIBE PUBLISH METRICS ASKING", False, None)
 
 # keyless but state-mutating: a replica must refuse these (REPLPUSH is the
-# one sanctioned mutation path on a replica)
-_spec(SPECS, "FLUSHALL RESTORESTATE", True, None)
+# one sanctioned mutation path on a replica; IMPORTRECORDS is the slot-
+# migration transfer frame, master-to-master)
+_spec(SPECS, "FLUSHALL RESTORESTATE IMPORTRECORDS", True, None)
 
 # single-key reads
 _spec(SPECS, "EXISTS TTL PTTL TYPE GET GETBIT BITCOUNT GETBITS BF.EXISTS "

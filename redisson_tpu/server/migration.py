@@ -1,0 +1,198 @@
+"""Slot-migration orchestrator: live rebalancing with zero lost acked writes.
+
+Parity target: the reference's resharding flow — the topology poll diffs
+slot ownership (``cluster/ClusterConnectionManager.java:358-450``
+``checkSlotsMigration``) while ``command/RedisExecutor.java`` follows the
+MOVED/ASK redirects redis-cli's resharding produces.  Redis itself drives a
+reshard as: SETSLOT IMPORTING on the target, SETSLOT MIGRATING on the
+source, MIGRATE each key, SETSLOT NODE everywhere.  This orchestrator is
+that driver for the TPU grid, with records (whole device-backed objects) as
+the migration unit and the replication serializer as the transfer format.
+
+Protocol walk (per slot):
+  1. target: CLUSTER SETSLOT <s> IMPORTING <source>   (admit ASKING traffic)
+  2. source: CLUSTER SETSLOT <s> MIGRATING <target>   (absent keys -> ASK;
+     record creation in the slot is barred by the store's creation guard)
+  3. source: CLUSTER MIGRATESLOT <s> [batch] until 0  (each record moves
+     atomically under its record lock: serialize -> IMPORTRECORDS -> delete)
+  4. everyone: CLUSTER SETVIEW <new view>; source+target: SETSLOT NODE
+     (clears the window; clients converge via MOVED + refresh)
+
+During the window writes are never dropped: a record still on the source
+serves there (and ships if it mutates before its move); a record already
+moved ASK-redirects; creations ASK-redirect.  The chaos test
+(tests/test_migration.py) rebalances mid-load and audits every acked write.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from redisson_tpu.net.client import NodeClient
+from redisson_tpu.utils.crc16 import MAX_SLOT
+
+
+def _admin(addr: str, password: Optional[str]) -> NodeClient:
+    return NodeClient(addr, password=password, ping_interval=0, retry_attempts=1)
+
+
+def migrate_slots(
+    source: str,
+    target: str,
+    slots: Sequence[int],
+    all_nodes: Optional[Sequence[str]] = None,
+    password: Optional[str] = None,
+) -> int:
+    """Move `slots` from `source` to `target` while both serve traffic.
+
+    `all_nodes` = every node (masters + replicas) that should learn the new
+    view; defaults to the masters named in the source's current view plus
+    the target.  Returns the number of records moved.
+    """
+    src = _admin(source, password)
+    tgt = _admin(target, password)
+    moved = 0
+    window_open = False
+    old_view: List[Tuple[int, int, str, int, str]] = []
+    try:
+        view = old_view = _fetch_view(src)
+        target_id = _s(tgt.execute("CLUSTER", "MYID"))
+        # 1+2: open the window (importing BEFORE migrating: an ASK redirect
+        # must never land on a target that would bounce it back MOVED)
+        for s in slots:
+            tgt.execute("CLUSTER", "SETSLOT", s, "IMPORTING", source)
+        window_open = True
+        for s in slots:
+            src.execute("CLUSTER", "SETSLOT", s, "MIGRATING", target)
+        # 3: drain — one bulk call scans the store once for ALL slots; loop
+        # until a sweep moves nothing (absent-guarded creations can't add
+        # names behind the scan, so this converges in ~2 sweeps)
+        while True:
+            n = int(
+                src.execute("CLUSTER", "MIGRATESLOTS", *slots, timeout=300.0)
+            )
+            moved += n
+            if n == 0:
+                break
+        # 4: finalize.  Source and target MUST learn the new view before the
+        # window closes — a target that still believes the old view would
+        # MOVED-bounce the slot back at the source forever.  Failure here
+        # aborts (and rolls back) rather than strands the slot.
+        new_view = _reassign(view, slots, target, target_id)
+        flat: List = []
+        for lo, hi, h, p, nid in new_view:
+            flat += [lo, hi, h, p, nid]
+        tgt.execute("CLUSTER", "SETVIEW", *flat, timeout=10.0)
+        src.execute("CLUSTER", "SETVIEW", *flat, timeout=10.0)
+        for s in slots:
+            src.execute("CLUSTER", "SETSLOT", s, "STABLE")
+            tgt.execute("CLUSTER", "SETSLOT", s, "STABLE")
+        # remaining nodes are best-effort: they converge via MOVED + refresh
+        nodes = set(all_nodes or [])
+        nodes.update(f"{h}:{p}" for _lo, _hi, h, p, _nid in view)
+        nodes.discard(source)
+        nodes.discard(target)
+        for addr in nodes:
+            c = None
+            try:
+                c = _admin(addr, password)
+                c.execute("CLUSTER", "SETVIEW", *flat, timeout=10.0)
+            except Exception:  # noqa: BLE001 — down node learns on recovery/MOVED
+                pass
+            finally:
+                if c is not None:
+                    c.close()
+        return moved
+    except BaseException:
+        if window_open:
+            _rollback(src, tgt, source, target, slots, old_view)
+        raise
+    finally:
+        src.close()
+        tgt.close()
+
+
+def _rollback(src, tgt, source: str, target: str, slots, old_view) -> None:
+    """Best-effort unwind of a failed migration: pull already-moved records
+    back to the source, restore the pre-migration view on BOTH ends, close
+    the window.  If the target is unreachable, the window is still closed —
+    records already shipped stay safe on the target and a RE-RUN of
+    migrate_slots(source, target, slots) converges once it returns
+    (IMPORTRECORDS applies by version, the drain resumes where it stopped)."""
+    # close the forward window on the source FIRST: its absent guard must
+    # not ASK-bounce the reverse imports about to arrive
+    for s in slots:
+        try:
+            src.execute("CLUSTER", "SETSLOT", s, "STABLE")
+        except Exception:  # noqa: BLE001 — source gone; nothing to unwind into
+            pass
+    try:
+        # reverse-drain: target -> source for anything that already moved
+        for s in slots:
+            try:
+                src.execute("CLUSTER", "SETSLOT", s, "IMPORTING", target)
+                tgt.execute("CLUSTER", "SETSLOT", s, "MIGRATING", source)
+            except Exception:  # noqa: BLE001 — target gone; records stay there
+                pass
+        try:
+            while int(tgt.execute("CLUSTER", "MIGRATESLOTS", *slots, timeout=300.0)) > 0:
+                pass
+        except Exception:  # noqa: BLE001 — target gone; records stay there
+            pass
+    finally:
+        for s in slots:
+            for c in (src, tgt):
+                try:
+                    c.execute("CLUSTER", "SETSLOT", s, "STABLE")
+                except Exception:  # noqa: BLE001 — unreachable node
+                    pass
+        # restore the pre-migration view: a target that already installed
+        # the NEW view would otherwise claim slots it just gave back
+        if old_view:
+            flat: List = []
+            for lo, hi, h, p, nid in old_view:
+                flat += [lo, hi, h, p, nid]
+            for c in (src, tgt):
+                try:
+                    c.execute("CLUSTER", "SETVIEW", *flat, timeout=10.0)
+                except Exception:  # noqa: BLE001 — unreachable node
+                    pass
+
+
+def _s(v) -> str:
+    return v.decode() if isinstance(v, (bytes, bytearray)) else str(v)
+
+
+def _fetch_view(node: NodeClient) -> List[Tuple[int, int, str, int, str]]:
+    view = []
+    for row in node.execute("CLUSTER", "SLOTS"):
+        lo, hi, (host, port, nid) = int(row[0]), int(row[1]), row[2]
+        view.append((lo, hi, _s(host), int(port), _s(nid)))
+    return view
+
+
+def _reassign(
+    view: List[Tuple[int, int, str, int, str]],
+    slots: Sequence[int],
+    target: str,
+    target_id: str,
+) -> List[Tuple[int, int, str, int, str]]:
+    """Point `slots` at `target` and re-compress into contiguous ranges."""
+    owner: Dict[int, Tuple[str, int, str]] = {}
+    for lo, hi, h, p, nid in view:
+        for s in range(lo, hi + 1):
+            owner[s] = (h, p, nid)
+    th, tp = target.rsplit(":", 1)
+    for s in slots:
+        owner[s] = (th, int(tp), target_id)
+    out: List[Tuple[int, int, str, int, str]] = []
+    run_start: Optional[int] = None
+    prev: Optional[Tuple[str, int, str]] = None
+    for s in range(MAX_SLOT):  # slots are 0..MAX_SLOT-1 (16384 of them)
+        cur = owner.get(s)
+        if cur != prev:
+            if prev is not None and run_start is not None:
+                out.append((run_start, s - 1, *prev))
+            run_start, prev = (s, cur) if cur is not None else (None, None)
+    if prev is not None and run_start is not None:
+        out.append((run_start, MAX_SLOT - 1, *prev))
+    return out

@@ -34,6 +34,7 @@ class CommandContext:
         self.subscriptions: Dict[str, int] = {}
         self.psubscriptions: Dict[str, int] = {}
         self.push: Optional[Callable[[Any], None]] = None  # wired by the server
+        self.asking = False  # one-shot ASK admission (cleared per command)
 
     def subscription_count(self) -> int:
         return len(self.subscriptions) + len(self.psubscriptions)
@@ -59,8 +60,11 @@ class Registry:
             raise RespError(f"ERR unknown command '{cmd.decode()}'")
         if not ctx.authenticated and cmd not in (b"AUTH", b"HELLO", b"QUIT", b"PING"):
             raise RespError("NOAUTH Authentication required.")
+        # one-shot ASK admission: consumed by every command (the ASKING
+        # handler re-arms it for the next one)
+        asking, ctx.asking = ctx.asking, False
         if server.cluster_view or server.role == "replica":
-            server.check_routing(cmd.decode(), args[1:])
+            server.check_routing(cmd.decode(), args[1:], asking=asking)
         hooks = getattr(server, "hooks", None)
         if not hooks:
             return handler(server, ctx, args[1:])
@@ -663,7 +667,76 @@ def cmd_cluster(server, ctx, args):
     if sub == b"RESET":
         server.cluster_view = []
         return "+OK"
+    # -- live slot migration (MIGRATING/IMPORTING window + drain) ------------
+    if sub == b"SETSLOT":
+        # SETSLOT <slot> MIGRATING <host:port> | IMPORTING <host:port> |
+        #         STABLE | NODE <host:port> <node_id>
+        slot = _int(args[1])
+        mode = bytes(args[2]).upper()
+        if mode == b"MIGRATING":
+            server.set_slot_migrating(slot, _s(args[3]))
+            return "+OK"
+        if mode == b"IMPORTING":
+            server.set_slot_importing(slot, _s(args[3]))
+            return "+OK"
+        if mode == b"STABLE":
+            server.set_slot_stable(slot)
+            return "+OK"
+        if mode == b"NODE":
+            # finalize locally: point the slot at its new owner in this
+            # node's view and clear the window state (the orchestrator also
+            # pushes a full SETVIEW; NODE keeps single-node finalization
+            # correct even before that lands)
+            addr, nid = _s(args[3]), _s(args[4])
+            host, port = addr.rsplit(":", 1)
+            new_view = []
+            for lo, hi, h, p, vnid in server.cluster_view:
+                if lo <= slot <= hi:
+                    # split the range around the reassigned slot
+                    if lo <= slot - 1:
+                        new_view.append((lo, slot - 1, h, p, vnid))
+                    new_view.append((slot, slot, host, int(port), nid))
+                    if slot + 1 <= hi:
+                        new_view.append((slot + 1, hi, h, p, vnid))
+                else:
+                    new_view.append((lo, hi, h, p, vnid))
+            server.cluster_view = new_view
+            server.set_slot_stable(slot)
+            return "+OK"
+        raise RespError("ERR SETSLOT expects MIGRATING|IMPORTING|STABLE|NODE")
+    if sub == b"COUNTKEYSINSLOT":
+        return len(server.slot_names(_int(args[1])))
+    if sub == b"GETKEYSINSLOT":
+        names = server.slot_names(_int(args[1]))
+        limit = _int(args[2]) if len(args) > 2 else len(names)
+        return [n.encode() for n in names[:limit]]
+    if sub == b"MIGRATESLOT":
+        # drain one MIGRATING slot (optional batch limit; <=0 = fully)
+        limit = _int(args[2]) if len(args) > 2 else 0
+        return server.migrate_slot_batch(_int(args[1]), limit)
+    if sub == b"MIGRATESLOTS":
+        # drain MANY migrating slots in one store scan — the orchestrator's
+        # bulk form (a reshard of hundreds of slots must not pay a full
+        # keyspace scan per slot)
+        return server.migrate_slot_batch([_int(a) for a in args[1:]])
     raise RespError("ERR unknown CLUSTER subcommand")
+
+
+@register("ASKING")
+def cmd_asking(server, ctx, args):
+    """One-shot admission for the NEXT command on this connection into an
+    IMPORTING slot (the redirect half of the ASK protocol)."""
+    ctx.asking = True
+    return "+OK"
+
+
+@register("IMPORTRECORDS")
+def cmd_importrecords(server, ctx, args):
+    """Install migrated records (slot-migration transfer frame; the blob
+    carries records only — no live-list pruning, unlike REPLPUSH)."""
+    from redisson_tpu.server import replication
+
+    return replication.apply_records(server.engine, bytes(args[0]))
 
 
 # -- replication (server/replication.py) -------------------------------------

@@ -31,7 +31,7 @@ import numpy as np
 
 
 def serialize_records(
-    engine, names: Optional[List[str]] = None
+    engine, names: Optional[List[str]] = None, include_live: bool = True
 ) -> Tuple[bytes, List[Tuple[str, int, int]]]:
     """Consistent host-side cut of (all | named) records.
 
@@ -67,7 +67,13 @@ def serialize_records(
                 }
             )
             shipped.append((name, rec.nonce, rec.version))
-    blob = pickle.dumps({"format": 1, "records": out, "live": live}, protocol=4)
+    # include_live=False for record TRANSFER blobs (slot migration): the
+    # live-name list makes apply_records prune everything absent from it —
+    # mirror semantics that would wipe an importing master's other records.
+    payload = {"format": 1, "records": out}
+    if include_live:
+        payload["live"] = live
+    blob = pickle.dumps(payload, protocol=4)
     return blob, shipped
 
 
@@ -84,7 +90,11 @@ def apply_records(engine, blob: bytes) -> int:
         name = item["name"]
         nonce = item.get("nonce")
         with engine.locked(name):
-            existing = engine.store.get(name)
+            # unguarded access throughout: a transfer frame legitimately
+            # creates/probes absent names even inside a migration window
+            # (the rollback's reverse-drain imports into slots the receiver
+            # still has MIGRATING)
+            existing = engine.store.get_unguarded(name)
             if (
                 existing is not None
                 and (nonce is None or existing.nonce == nonce)
@@ -104,7 +114,7 @@ def apply_records(engine, blob: bytes) -> int:
             if nonce is not None:
                 rec.nonce = nonce
             rec.expire_at = item["expire_at"]
-            engine.store.put(name, rec)
+            engine.store.put_unguarded(name, rec)
             applied += 1
     live = payload.get("live")
     if live is not None:
@@ -113,7 +123,7 @@ def apply_records(engine, blob: bytes) -> int:
         with engine.store._lock:
             stale = [n for n in engine.store._states if n not in live_set]
         for n in stale:
-            engine.store.delete(n)
+            engine.store.delete_unguarded(n)
             applied += 1
     return applied
 

@@ -59,6 +59,13 @@ class TpuServer:
         # cluster_view: [(slot_from, slot_to, host, port, node_id)] when this
         # node is part of a cluster (set by the topology/launcher, L3')
         self.cluster_view: List[Tuple[int, int, str, int, str]] = []
+        # live resharding state (the MIGRATING/IMPORTING window of the
+        # reference's slot-migration protocol, cluster/ClusterConnectionManager
+        # .java:358-450 checkSlotsMigration + RedisExecutor ASK handling):
+        #   migrating_slots: slot -> target "host:port" (this node drains it)
+        #   importing_slots: slot -> source "host:port" (this node receives)
+        self.migrating_slots: Dict[int, str] = {}
+        self.importing_slots: Dict[int, str] = {}
         # -- cluster / replication role (server/replication.py) -------------
         self.role = "master"  # "master" | "replica"
         self.master_address: Optional[str] = None
@@ -127,24 +134,163 @@ class TpuServer:
                 return h, p
         return None
 
-    def check_routing(self, cmd: str, args: List[bytes]) -> None:
-        """MOVED + READONLY enforcement (the server half of the reference's
-        MOVED/ASK redirect protocol, cluster/ClusterConnectionManager +
-        command/RedisExecutor redirect handling)."""
+    def check_routing(self, cmd: str, args: List[bytes], asking: bool = False) -> None:
+        """MOVED/ASK + READONLY enforcement (the server half of the
+        reference's redirect protocol, cluster/ClusterConnectionManager +
+        command/RedisExecutor redirect handling).
+
+        Migration window semantics (Redis slot-migration model):
+          * slot MIGRATING here: keys still present serve locally; absent
+            keys redirect ASK to the draining target (they either moved
+            already or must be created there);
+          * slot IMPORTING here: normally MOVED back to the source (the view
+            still names it), but a command preceded by ASKING is served.
+        """
         from redisson_tpu.net import commands as C
         from redisson_tpu.net.resp import RespError
         from redisson_tpu.utils.crc16 import calc_slot
 
         if self.cluster_view:
+            migrating_absent = migrating_present = 0
+            ask_target = None
             for key in C.command_keys(cmd, args):
                 slot = calc_slot(key)
-                if not self.owns_slot(slot):
-                    target = self.moved_target(slot)
+                if self.owns_slot(slot):
+                    target = self.migrating_slots.get(slot)
                     if target is not None:
-                        raise RespError(f"MOVED {slot} {target[0]}:{target[1]}")
-                    raise RespError(f"CLUSTERDOWN Hash slot {slot} not served")
+                        name = key.decode() if isinstance(key, bytes) else key
+                        if self.engine.store.peek(name):
+                            migrating_present += 1
+                        else:
+                            migrating_absent += 1
+                            ask_target, ask_slot = target, slot
+                    continue
+                if asking and slot in self.importing_slots:
+                    continue  # one-shot admission during the handoff window
+                target = self.moved_target(slot)
+                if target is not None:
+                    raise RespError(f"MOVED {slot} {target[0]}:{target[1]}")
+                raise RespError(f"CLUSTERDOWN Hash slot {slot} not served")
+            if migrating_absent:
+                if migrating_present:
+                    # mixed present/absent across a migration window: neither
+                    # node holds every key right now — the client must retry
+                    # until the drain finishes (Redis returns TRYAGAIN for
+                    # exactly this multi-key case)
+                    raise RespError(
+                        "TRYAGAIN Multiple keys request during rehashing of slot"
+                    )
+                raise RespError(f"ASK {ask_slot} {ask_target}")
         if self.role == "replica" and C.is_write(cmd, args):
             raise RespError("READONLY You can't write against a read only replica.")
+
+    # -- live slot migration (server side) -----------------------------------
+
+    def _migration_absent_guard(self, name: str) -> None:
+        """DeviceStore absent-name hook: any touch of an ABSENT record in a
+        MIGRATING slot redirects to the target.  This closes the races the
+        pre-dispatch ASK check cannot: a record the drain deletes between
+        check_routing and the handler would otherwise be silently recreated
+        here (lost acked write) or read as nil (read-your-writes violation)."""
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        slot = calc_slot(name.encode())
+        target = self.migrating_slots.get(slot)
+        if target is not None:
+            raise RespError(f"ASK {slot} {target}")
+
+    def set_slot_migrating(self, slot: int, target: str) -> None:
+        self.migrating_slots[slot] = target
+        self.engine.store.absent_guard = self._migration_absent_guard
+
+    def set_slot_importing(self, slot: int, source: str) -> None:
+        self.importing_slots[slot] = source
+
+    def set_slot_stable(self, slot: int) -> None:
+        self.migrating_slots.pop(slot, None)
+        self.importing_slots.pop(slot, None)
+        if not self.migrating_slots:
+            self.engine.store.absent_guard = None
+
+    def slot_names(self, slot: int) -> List[str]:
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        return [
+            n for n in self.engine.store.keys() if calc_slot(n.encode()) == slot
+        ]
+
+    def migrate_slot_batch(self, slots, limit: int = 0) -> int:
+        """Drain MIGRATING slot(s) to their targets; limit<=0 drains fully.
+
+        Move protocol per record (NO network I/O under the record lock — a
+        record lock held across a push would stall unrelated work queued
+        behind it, e.g. lock-watchdog renewals):
+          1. under the record lock: serialize, note (nonce, version);
+          2. outside the lock: IMPORTRECORDS to the target — concurrent
+             writers keep mutating the still-present local record;
+          3. under the record lock again: if (nonce, version) unchanged,
+             delete locally (move complete); else loop — the newer state
+             re-ships.  After the delete, the absent guard ASK-redirects.
+        A write therefore either ships with the record or redirects to the
+        target — no acked write is lost.  The store is scanned ONCE for all
+        requested slots; one link per target serves the whole call.
+        """
+        from redisson_tpu.net.client import NodeClient
+        from redisson_tpu.server import replication
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        if isinstance(slots, int):
+            slots = [slots]
+        targets: Dict[int, str] = {}
+        for s in slots:
+            t = self.migrating_slots.get(s)
+            if t is None:
+                raise RespError(f"ERR slot {s} is not MIGRATING")
+            targets[s] = t
+        wanted = set(targets)
+        names = [
+            (n, calc_slot(n.encode()))
+            for n in self.engine.store.keys()
+            if calc_slot(n.encode()) in wanted
+        ]
+        if limit and limit > 0:
+            names = names[:limit]
+        if not names:
+            return 0
+        moved = 0
+        links: Dict[str, NodeClient] = {}
+        try:
+            for name, slot in names:
+                target = targets[slot]
+                link = links.get(target)
+                if link is None:
+                    link = links[target] = NodeClient(
+                        target, password=self.password, ping_interval=0, retry_attempts=1
+                    )
+                while True:
+                    with self.engine.locked(name):
+                        if not self.engine.store.peek(name):
+                            break  # expired/deleted meanwhile
+                        blob, shipped = replication.serialize_records(
+                            self.engine, [name], include_live=False
+                        )
+                    if not shipped:
+                        break
+                    link.execute("IMPORTRECORDS", blob, timeout=30.0)
+                    _n, snap_nonce, snap_version = shipped[0]
+                    with self.engine.locked(name):
+                        rec = self.engine.store.get_unguarded(name)
+                        if rec is None:
+                            break  # deleted while shipping: nothing to keep
+                        if (rec.nonce, rec.version) == (snap_nonce, snap_version):
+                            self.engine.store.delete_unguarded(name)
+                            moved += 1
+                            break
+                        # mutated while shipping: loop, re-ship latest state
+        finally:
+            for link in links.values():
+                link.close()
+        return moved
 
     def replication_source(self):
         """Lazy master-side record shipper (server/replication.py)."""

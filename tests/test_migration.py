@@ -1,0 +1,198 @@
+"""Live slot migration: MIGRATING/IMPORTING window, ASK redirects, rebalance
+under load with zero lost acked writes (VERDICT round-1 next-step #2;
+reference: cluster/ClusterConnectionManager.java:358-450 checkSlotsMigration
++ command/RedisExecutor.java ASK handling)."""
+import threading
+import time
+
+import pytest
+
+from redisson_tpu.harness import ClusterRunner, _exec
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.migration import migrate_slots
+from redisson_tpu.utils.crc16 import calc_slot
+
+
+@pytest.fixture()
+def cluster2():
+    runner = ClusterRunner(masters=2).run()
+    yield runner
+    runner.shutdown()
+
+
+def _owner_index(runner, slot: int) -> int:
+    return next(
+        i for i, (lo, hi) in enumerate(runner.slot_ranges) if lo <= slot <= hi
+    )
+
+
+def test_migrate_slot_moves_records_and_view(cluster2):
+    client = cluster2.client(scan_interval=0)
+    try:
+        client.get_bucket("mig-key").set("payload")
+        slot = calc_slot(b"mig-key")
+        si = _owner_index(cluster2, slot)
+        ti = 1 - si
+        source = cluster2.masters[si]
+        target = cluster2.masters[ti]
+        moved = migrate_slots(source.address, target.address, [slot])
+        assert moved >= 1
+        # record physically moved
+        assert not source.server.server.engine.store.exists("mig-key")
+        assert target.server.server.engine.store.exists("mig-key")
+        # window closed on both sides
+        assert not source.server.server.migrating_slots
+        assert not target.server.server.importing_slots
+        # client converges via MOVED/refresh and still reads the value
+        client.refresh_topology()
+        assert client.get_bucket("mig-key").get() == "payload"
+        # writes land on the new owner
+        client.get_bucket("mig-key").set("v2")
+        assert target.server.server.engine.store.get("mig-key").host is not None
+    finally:
+        client.shutdown()
+
+
+def test_ask_redirect_during_window(cluster2):
+    client = cluster2.client(scan_interval=0)
+    try:
+        client.get_bucket("ask-key").set("here")
+        slot = calc_slot(b"ask-key")
+        si = _owner_index(cluster2, slot)
+        source = cluster2.masters[si]
+        target = cluster2.masters[1 - si]
+        # open the window by hand and drain the one record
+        with target.server.client() as c:
+            _exec(c, "CLUSTER", "SETSLOT", slot, "IMPORTING", source.address)
+        with source.server.client() as c:
+            _exec(c, "CLUSTER", "SETSLOT", slot, "MIGRATING", target.address)
+            assert _exec(c, "CLUSTER", "MIGRATESLOT", slot) == 1
+            # moved-away key: raw source connection now gets ASK
+            reply = c.execute("GET", "ask-key")
+            assert isinstance(reply, RespError) and str(reply).startswith("ASK ")
+            # creating a NEW record in the migrating slot is barred too
+            # ({ask-key} hashtag pins it to the same slot)
+            reply = c.execute("SET", "{ask-key}fresh", "x")
+            assert isinstance(reply, RespError) and str(reply).startswith("ASK ")
+        # the cluster client follows ASK transparently, no topology change
+        assert client.get_bucket("ask-key").get() == "here"
+        client.get_bucket("{ask-key}fresh").set("made-on-target")
+        assert target.server.server.engine.store.exists("{ask-key}fresh")
+        # ASKING is one-shot: un-asked command on target still MOVED
+        with target.server.client() as c:
+            reply = c.execute("GET", "ask-key")
+            assert isinstance(reply, RespError) and str(reply).startswith("MOVED ")
+        # close the window; the orchestrator path would SETVIEW + NODE
+        with source.server.client() as c:
+            _exec(c, "CLUSTER", "SETSLOT", slot, "STABLE")
+        with target.server.client() as c:
+            _exec(c, "CLUSTER", "SETSLOT", slot, "STABLE")
+    finally:
+        client.shutdown()
+
+
+def test_rebalance_under_load_zero_lost_acked_writes(cluster2):
+    """The chaos criterion: migrate a busy slot range mid-load; every write
+    the client saw acknowledged must be readable afterwards."""
+    client = cluster2.client(scan_interval=0)
+    stop = threading.Event()
+    acked: dict = {}
+    errors: list = []
+
+    # all keys share slot range of master 0 via distinct names across many
+    # slots in [lo0, hi0]; we migrate the busiest sub-range while writing
+    lo0, hi0 = cluster2.slot_ranges[0]
+    keys = [f"load-{i}" for i in range(400)]
+    keys = [k for k in keys if lo0 <= calc_slot(k.encode()) <= hi0][:120]
+    assert len(keys) >= 50
+
+    def writer(worker: int):
+        n = 0
+        while not stop.is_set():
+            k = keys[(n * 7 + worker) % len(keys)]
+            try:
+                v = client.execute("INCR", k)
+                acked[k] = max(acked.get(k, 0), int(v))
+            except Exception as e:  # noqa: BLE001 — unacked; not counted
+                errors.append(e)
+            n += 1
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)  # build up traffic before the reshard
+    slots = sorted({calc_slot(k.encode()) for k in keys})
+    moved = migrate_slots(
+        cluster2.masters[0].address, cluster2.masters[1].address, slots
+    )
+    time.sleep(0.3)  # keep writing after the flip
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert moved >= len(keys) * 0.5  # most keys physically moved mid-load
+    client.refresh_topology()
+    lost = []
+    for k, highest in acked.items():
+        cur = client.execute("GET", k)
+        cur = int(cur) if cur is not None else 0
+        if cur < highest:
+            lost.append((k, highest, cur))
+    assert not lost, f"lost acked writes: {lost[:10]}"
+    # and the records really live on the target now
+    tgt_engine = cluster2.masters[1].server.server.engine
+    assert sum(1 for k in acked if tgt_engine.store.exists(k)) == len(acked)
+    client.shutdown()
+
+
+def test_migration_with_cluster_pipeline(cluster2):
+    """execute_many rows hitting a migration window re-route via ASK."""
+    client = cluster2.client(scan_interval=0)
+    try:
+        names = [f"pipe-{i}" for i in range(40)]
+        client.execute_many([("SET", n, str(i)) for i, n in enumerate(names)])
+        lo0, hi0 = cluster2.slot_ranges[0]
+        mine = [n for n in names if lo0 <= calc_slot(n.encode()) <= hi0]
+        slots = sorted({calc_slot(n.encode()) for n in mine})
+        migrate_slots(
+            cluster2.masters[0].address, cluster2.masters[1].address, slots
+        )
+        # stale client pipelines still resolve every row (MOVED/ASK fallback)
+        replies = client.execute_many([("GET", n) for n in names])
+        assert [int(r) for r in replies] == list(range(40))
+    finally:
+        client.shutdown()
+
+
+def test_tryagain_for_mixed_multikey_and_absent_guard(cluster2):
+    """Multi-key ops spanning a half-drained window get TRYAGAIN (neither
+    node holds every key); absent-key touches (GET/DEL) get ASK even when
+    racing past the pre-dispatch check (store-level absent guard)."""
+    client = cluster2.client(scan_interval=0)
+    try:
+        a, b = "{mix}a", "{mix}b"
+        client.get_bucket(a).set("1")
+        client.get_bucket(b).set("2")
+        slot = calc_slot(b"mix")
+        si = _owner_index(cluster2, slot)
+        source = cluster2.masters[si]
+        target = cluster2.masters[1 - si]
+        with target.server.client() as c:
+            _exec(c, "CLUSTER", "SETSLOT", slot, "IMPORTING", source.address)
+        with source.server.client() as c:
+            _exec(c, "CLUSTER", "SETSLOT", slot, "MIGRATING", target.address)
+            # drain exactly ONE of the two records -> mixed window
+            assert _exec(c, "CLUSTER", "MIGRATESLOT", slot, 1) == 1
+            reply = c.execute("RENAME", a, b)
+            assert isinstance(reply, RespError) and str(reply).startswith("TRYAGAIN")
+            # single absent key: ASK straight from the store guard
+            movedname = a if not source.server.server.engine.store.peek(a) else b
+            assert isinstance(c.execute("GET", movedname), RespError)
+            assert str(c.execute("DEL", movedname)).startswith("ASK ")
+        # finish the drain; close the window via the orchestrator path
+        moved = migrate_slots(source.address, target.address, [slot])
+        assert moved >= 1
+        client.refresh_topology()
+        assert client.get_bucket(a).get() == "1"
+        assert client.get_bucket(b).get() == "2"
+    finally:
+        client.shutdown()
