@@ -515,6 +515,70 @@ class ClusterRedisson(RemoteSurface):
             run_segment(segment)
         return results
 
+    def objcall_many(self, ops, caller=None):
+        """OBJCALLM with per-shard grouping: one frame + one pickle per
+        shard, shards concurrent (the executeBatchedAsync discipline applied
+        to the generic object wire).  Per-op MOVED/ASK errors from a stale
+        view re-route through the single-op redirect-aware objcall."""
+        caller = caller or self.caller_id()
+        with self._lock:
+            slot_table = list(self._slots)
+            entries = dict(self._entries)
+        groups: Dict[Optional[str], List[int]] = {}
+        ops = [tuple(op) for op in ops]
+        for i, op in enumerate(ops):
+            name = op[1]
+            addr = slot_table[calc_slot(str(name).encode())] if name else None
+            groups.setdefault(addr, []).append(i)
+        results: List[Any] = [None] * len(ops)
+
+        def run_group(addr, idxs):
+            import pickle as _pickle
+
+            from redisson_tpu.client.remote import _unwrap_many
+
+            entry = entries.get(addr) if addr is not None else next(iter(entries.values()), None)
+            try:
+                if entry is None:
+                    raise ConnectionError_(f"no entry for {addr}")
+                payload = _pickle.dumps([ops[i] for i in idxs])
+                replies = _unwrap_many(
+                    entry.master.execute("OBJCALLM", payload, caller)
+                )
+            except (ConnectionError, OSError, TimeoutError):
+                # stale entry: per-op redirect-aware path (reads AND writes —
+                # the failure happened before the frame was written or the
+                # caller accepts per-op at-most-once via objcall's own rules)
+                replies = []
+                for i in idxs:
+                    f, n, m, a, kw = ops[i]
+                    try:
+                        replies.append(self.objcall(f, n, m, a, kw, caller=caller))
+                    except Exception as e:  # noqa: BLE001 — errors stay as data
+                        replies.append(e)
+            for i, r in zip(idxs, replies):
+                if isinstance(r, RespError) and str(r).startswith(
+                    ("MOVED ", "ASK ", "TRYAGAIN", "CLUSTERDOWN")
+                ):
+                    f, n, m, a, kw = ops[i]
+                    try:
+                        r = self.objcall(f, n, m, a, kw, caller=caller)
+                    except Exception as e:  # noqa: BLE001
+                        r = e
+                results[i] = r
+
+        if len(groups) <= 1:
+            for addr, idxs in groups.items():
+                run_group(addr, idxs)
+        else:
+            import concurrent.futures as _cf
+
+            with _cf.ThreadPoolExecutor(max_workers=min(len(groups), 16)) as pool:
+                futs = [pool.submit(run_group, a, idxs) for a, idxs in groups.items()]
+                for f in futs:
+                    f.result()
+        return results
+
     def pubsub_for(self, name: str):
         """Channel subscriptions ride the shard that owns the channel's slot
         (SSUBSCRIBE semantics — RedissonShardedTopic analog)."""

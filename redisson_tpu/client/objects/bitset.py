@@ -70,11 +70,17 @@ class BitSet(RExpirable):
 
     def set_each(self, indexes: np.ndarray, value: bool = True) -> np.ndarray:
         """Batch SETBIT; returns previous bit values aligned with indexes."""
+        old, n = self.set_each_async(indexes, value)
+        return np.asarray(old)[:n]
+
+    def set_each_async(self, indexes: np.ndarray, value: bool = True):
+        """Pipelined batch SETBIT: (device previous-values array, n_valid),
+        no host sync (the server's lazy-reply frames force per frame)."""
         self._check_range(np.asarray(indexes, np.int64))
         idx = np.ascontiguousarray(indexes, np.int32)
         n = idx.shape[0]
         if n == 0:
-            return np.zeros((0,), np.uint8)
+            return np.zeros((0,), np.uint8), 0
         b = K.pow2_bucket(n)
         vals = np.full((b,), 1 if value else 0, np.uint8)
         with self._engine.locked(self._name):
@@ -82,19 +88,24 @@ class BitSet(RExpirable):
             bits, old = K.bitset_set(rec.arrays["bits"], K.pad_to(idx, b), n, vals)
             rec.arrays["bits"] = bits
             self._touch_version(rec)
-        return np.asarray(old)[:n]
+        return old, n
 
     def get_each(self, indexes: np.ndarray) -> np.ndarray:
+        got, n = self.get_each_async(indexes)
+        return np.asarray(got)[:n]
+
+    def get_each_async(self, indexes: np.ndarray):
         self._check_range(np.asarray(indexes, np.int64))
         idx = np.ascontiguousarray(indexes, np.int32)
-        if idx.shape[0] == 0:
-            return np.zeros((0,), np.uint8)
+        n = idx.shape[0]
+        if n == 0:
+            return np.zeros((0,), np.uint8), 0
         with self._engine.locked(self._name):
             rec = self._engine.store.get(self._name)
             if rec is None:
-                return np.zeros(idx.shape, np.uint8)
-            got = K.bitset_get(rec.arrays["bits"], K.pad_to(idx, K.pow2_bucket(idx.shape[0])))
-        return np.asarray(got)[: idx.shape[0]]
+                return np.zeros(idx.shape, np.uint8), n
+            got = K.bitset_get(rec.arrays["bits"], K.pad_to(idx, K.pow2_bucket(n)))
+        return got, n
 
     def set_range(self, from_index: int, to_index: int, value: bool = True) -> None:
         """RBitSet.set(from, to) — contiguous range."""

@@ -135,9 +135,17 @@ class BloomFilter(RExpirable):
     def add_each(self, objs) -> np.ndarray:
         """Batch add; returns a per-key "was newly added" bool array aligned
         with objs (the BF.MADD reply shape)."""
+        newly, n = self.add_each_async(objs)
+        return np.asarray(newly)[:n]
+
+    def add_each_async(self, objs):
+        """Pipelined batch add: (device newly-added array, n_valid) with NO
+        host sync — the mutation is dispatched; callers force later (the
+        frame-level lazy-reply path in server/registry.py, and streaming
+        writers that keep flushes in flight)."""
         kind, arrays, n = self._engine.pack_keys(objs, self._codec)
         if n == 0:
-            return np.zeros((0,), bool)
+            return np.zeros((0,), bool), 0
         with self._engine.locked(self._name):
             rec = self._rec()
             m, k = rec.meta["m"], rec.meta["k"]
@@ -149,7 +157,7 @@ class BloomFilter(RExpirable):
                 bits, newly = K.bloom_add_bytes_masked(bits, words, nbytes, n, k, m)
             rec.arrays["bits"] = bits
             self._touch_version(rec)
-        return np.asarray(newly)[:n]
+        return newly, n
 
     def contains(self, obj) -> bool:
         if isinstance(obj, np.ndarray):

@@ -97,9 +97,16 @@ class BloomFilterArray(RExpirable):
 
     def add_each(self, tenant_ids, keys) -> np.ndarray:
         """Batch add across tenants; bool array: element was (probably) new."""
+        newly, n = self.add_each_async(tenant_ids, keys)
+        return np.asarray(newly)[:n]
+
+    def add_each_async(self, tenant_ids, keys):
+        """Pipelined add: (device newly-added array, n_valid), no host sync —
+        callers (the server's lazy-reply frames, streaming writers) force
+        once per batch of flushes."""
         tlh, n = self._pack(tenant_ids, keys)
         if n == 0:
-            return np.zeros((0,), bool)
+            return np.zeros((0,), bool), 0
         with self._engine.locked(self._name):
             rec = self._rec()
             bits, newly = K.bloom_bank_add_packed(
@@ -107,7 +114,7 @@ class BloomFilterArray(RExpirable):
             )
             rec.arrays["bits"] = bits
             self._touch_version(rec)
-        return np.asarray(newly)[:n]
+        return newly, n
 
     def add(self, tenant_ids, keys) -> int:
         """Batch add across tenants; returns # of (probably) new elements."""

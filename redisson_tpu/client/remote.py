@@ -64,6 +64,18 @@ def _unwrap(reply: Any) -> Any:
     return reply
 
 
+def _unwrap_many(reply: Any) -> List[Any]:
+    """Decode an OBJCALLM reply: list of results with per-op exceptions left
+    AS VALUES (batch semantics — the caller decides what to raise)."""
+    from redisson_tpu.net.safe_pickle import safe_loads
+
+    if isinstance(reply, RespError):
+        raise reply
+    if not (isinstance(reply, (bytes, bytearray)) and reply[:1] == b"M"):
+        raise RespError("ERR bad OBJCALLM reply frame")
+    return [r for _tag, r in safe_loads(bytes(reply[1:]))]
+
+
 class RemoteObjectProxy:
     """Generic remote handle: every method call becomes one OBJCALL."""
 
@@ -682,6 +694,18 @@ class RemoteSurface:
             "OBJCALL", factory, name, method, payload, caller or self.caller_id()
         )
         return _unwrap(reply)
+
+    def objcall_many(
+        self, ops: List[Tuple], caller: Optional[str] = None
+    ) -> List[Any]:
+        """MANY object ops in ONE wire frame + ONE pickle (OBJCALLM — the
+        CommandBatchService flush for the generic object surface).  ops =
+        [(factory, name, method, args, kwargs), ...]; returns results
+        aligned with ops, exceptions as values.  The cluster client
+        overrides this with per-shard grouping."""
+        payload = pickle.dumps([tuple(op) for op in ops])
+        reply = self.execute("OBJCALLM", payload, caller or self.caller_id())
+        return _unwrap_many(reply)
 
     # -- hot-path handles ----------------------------------------------------
 

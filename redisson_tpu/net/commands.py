@@ -39,17 +39,19 @@ _spec(SPECS, "PING ECHO AUTH HELLO SELECT CLIENT QUIT DBSIZE TIME INFO MEMORY "
 
 # keyless but state-mutating: a replica must refuse these (REPLPUSH is the
 # one sanctioned mutation path on a replica; IMPORTRECORDS is the slot-
-# migration transfer frame, master-to-master)
-_spec(SPECS, "FLUSHALL RESTORESTATE IMPORTRECORDS", True, None)
+# migration transfer frame, master-to-master; OBJCALLM batches carry writes
+# inside their pickled payload, so the frame routes as a write)
+_spec(SPECS, "FLUSHALL RESTORESTATE IMPORTRECORDS OBJCALLM", True, None)
 
 # single-key reads
-_spec(SPECS, "EXISTS TTL PTTL TYPE GET GETBIT BITCOUNT GETBITS BF.EXISTS "
-             "BF.MEXISTS BF.INFO BF.MEXISTS64 BFA.MEXISTS64 PFCOUNT", False, 0)
+_spec(SPECS, "EXISTS TTL PTTL TYPE GET GETBIT BITCOUNT GETBITS GETBITSB "
+             "BF.EXISTS BF.MEXISTS BF.INFO BF.MEXISTS64 BFA.MEXISTS64 "
+             "PFCOUNT", False, 0)
 
 # single-key writes
 _spec(SPECS, "EXPIRE PEXPIRE PERSIST SET INCR INCRBY DECR SETBIT SETBITS "
-             "BF.RESERVE BF.ADD BF.MADD BF.MADD64 BFA.RESERVE BFA.MADD64 "
-             "PFADD64 PFADD", True, 0)
+             "SETBITSB BF.RESERVE BF.ADD BF.MADD BF.MADD64 BFA.RESERVE "
+             "BFA.MADD64 PFADD64 PFADD", True, 0)
 
 # multi-key
 _spec(SPECS, "DEL UNLINK", True, 0, multi_key=True)

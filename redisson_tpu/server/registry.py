@@ -24,6 +24,82 @@ from redisson_tpu.utils.metrics import run_hooks_end, run_hooks_start
 from redisson_tpu.version import __version__ as VERSION
 
 
+class LazyReply:
+    """Deferred reply: the handler DISPATCHED device work but did not force
+    the device->host sync.  The connection loop materializes every lazy
+    reply of a pipelined frame together — and, for the (device, finish)
+    form, CONCATENATES all device results of the frame into one transfer
+    per dtype, so a 32-command frame pays ~1 tunnel round trip instead of
+    32 (each device->host sync costs a fixed ~68ms through the tunnel
+    regardless of size; the reference's analog is CommandBatchService's
+    single-flush discipline).
+
+    Two forms:
+      LazyReply(force=fn)              — fn() -> reply, forced individually;
+      LazyReply(device=(arrs...), finish=fn) — fn(host_arrays) -> reply,
+        host_arrays delivered by the frame-level grouped transfer.
+    """
+
+    __slots__ = ("device", "finish", "_force")
+
+    def __init__(self, force: Optional[Callable[[], Any]] = None,
+                 device: Optional[tuple] = None,
+                 finish: Optional[Callable[[tuple], Any]] = None):
+        self._force = force
+        self.device = device
+        self.finish = finish
+
+    def force(self) -> Any:
+        if self._force is not None:
+            return self._force()
+        import numpy as np
+
+        return self.finish(tuple(np.asarray(v) for v in self.device))
+
+
+def gather_lazy_device_results(lazies: List["LazyReply"]) -> List[tuple]:
+    """Fetch every device value of `lazies` with ONE device->host transfer:
+    bitcast each value to a uint8 byte stream on device, concatenate, pull
+    once, split and reinterpret on the host.  Every sync through the tunnel
+    costs a fixed ~68ms regardless of size, so a frame of 32 results at one
+    transfer each would pay ~2s — this path pays ~one."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    flat = []  # (device uint8 stream, host dtype, orig shape, was_bool)
+    index: List[List[int]] = []  # per lazy: flat positions
+    for lz in lazies:
+        pos = []
+        for arr in lz.device:
+            a = jnp.asarray(arr)
+            was_bool = a.dtype == jnp.bool_
+            if was_bool:
+                b = a.astype(jnp.uint8)  # exact: values are 0/1
+            elif a.dtype == jnp.uint8:
+                b = a
+            else:
+                b = jax.lax.bitcast_convert_type(a, jnp.uint8)
+            pos.append(len(flat))
+            flat.append((jnp.ravel(b), np.dtype(a.dtype.name if not was_bool else "uint8"), a.shape, was_bool))
+        index.append(pos)
+    parts = [f[0] for f in flat]
+    sizes = [int(p.shape[0]) for p in parts]
+    if not parts:
+        return [() for _ in lazies]
+    if len(parts) == 1:
+        merged = np.asarray(parts[0])
+    else:
+        merged = np.asarray(jnp.concatenate(parts))  # THE one transfer
+    chunks = np.split(merged, np.cumsum(sizes)[:-1]) if len(parts) > 1 else [merged]
+    host: List[Any] = []
+    for chunk, (_p, dtype, shape, was_bool) in zip(chunks, flat):
+        v = np.ascontiguousarray(chunk).view(dtype).reshape(shape)
+        host.append(v.astype(bool) if was_bool else v)
+    return [tuple(host[i] for i in pos) for pos in index]
+
+
 class CommandContext:
     """Per-connection state (db selection, auth, subscriptions)."""
 
@@ -337,6 +413,8 @@ def cmd_bitcount(server, ctx, args):
 
 @register("BITOP")
 def cmd_bitop(server, ctx, args):
+    from redisson_tpu.core import kernels as K
+
     op = bytes(args[0]).upper()
     dest = _s(args[1])
     srcs = [_s(a) for a in args[2:]]
@@ -352,8 +430,17 @@ def cmd_bitop(server, ctx, args):
         bs.not_()
     else:
         raise RespError("ERR syntax error")
-    n = bs.length()
-    return n // 8 + (1 if n % 8 else 0)
+    # reply = dest byte length; computed from the device WITHOUT a per-op
+    # sync (the length rides the frame's grouped transfer)
+    with server.engine.locked(dest):
+        rec = server.engine.store.get(dest)
+        if rec is None:
+            return 0
+        length_dev = K.bitset_length(rec.arrays["bits"])
+    return LazyReply(
+        device=(length_dev,),
+        finish=lambda v: (n := int(v[0])) // 8 + (1 if n % 8 else 0),
+    )
 
 
 # batched forms: SETBITS name idx... / GETBITS name idx... (one kernel each)
@@ -362,8 +449,8 @@ def cmd_setbits(server, ctx, args):
     import numpy as np
 
     idx = np.asarray([_int(a) for a in args[1:]], np.int64)
-    old = _bitset(server, _s(args[0])).set_each(idx, True)
-    return [int(v) for v in old]
+    old, n = _bitset(server, _s(args[0])).set_each_async(idx, True)
+    return LazyReply(device=(old,), finish=lambda v: [int(x) for x in v[0][:n]])
 
 
 @register("GETBITS")
@@ -371,8 +458,34 @@ def cmd_getbits(server, ctx, args):
     import numpy as np
 
     idx = np.asarray([_int(a) for a in args[1:]], np.int64)
-    got = _bitset(server, _s(args[0])).get_each(idx)
-    return [int(v) for v in got]
+    got, n = _bitset(server, _s(args[0])).get_each_async(idx)
+    return LazyReply(device=(got,), finish=lambda v: [int(x) for x in v[0][:n]])
+
+
+# blob forms: indexes travel as ONE little-endian i32 buffer and previous
+# bit values return as ONE byte blob — RESP integer encode/parse for
+# thousands of per-bit args is pure overhead at batch sizes (bytes on the
+# wire are the cost that matters through the tunnel)
+@register("SETBITSB")
+def cmd_setbitsb(server, ctx, args):
+    import numpy as np
+
+    idx = np.frombuffer(bytes(args[1]), dtype="<i4").astype(np.int64)
+    old, n = _bitset(server, _s(args[0])).set_each_async(idx, True)
+    return LazyReply(
+        device=(old,), finish=lambda v: np.asarray(v[0][:n], np.uint8).tobytes()
+    )
+
+
+@register("GETBITSB")
+def cmd_getbitsb(server, ctx, args):
+    import numpy as np
+
+    idx = np.frombuffer(bytes(args[1]), dtype="<i4").astype(np.int64)
+    got, n = _bitset(server, _s(args[0])).get_each_async(idx)
+    return LazyReply(
+        device=(got,), finish=lambda v: np.asarray(v[0][:n], np.uint8).tobytes()
+    )
 
 
 # -- bloom filter (RedisBloom-compatible verbs + batch-first forms) ----------
@@ -443,17 +556,29 @@ def cmd_bf_madd64(server, ctx, args):
     import numpy as np
 
     keys = np.frombuffer(bytes(args[1]), dtype="<i8")
-    newly = _bloom(server, _s(args[0])).add_each(keys)
-    return np.asarray(newly, np.uint8).tobytes()
+    newly, n = _bloom(server, _s(args[0])).add_each_async(keys)
+    return LazyReply(
+        device=(newly,),
+        finish=lambda v: np.asarray(v[0], np.uint8)[:n].tobytes(),
+    )
 
 
 @register("BF.MEXISTS64")
 def cmd_bf_mexists64(server, ctx, args):
     import numpy as np
 
+    from redisson_tpu.core import kernels as K
+
     keys = np.frombuffer(bytes(args[1]), dtype="<i8")
-    found = _bloom(server, _s(args[0])).contains_each(keys)
-    return np.asarray(found, np.uint8).tobytes()
+    found, n = _bloom(server, _s(args[0])).contains_each_async(keys)
+
+    def finish(vals):
+        arr = vals[0]
+        if arr.dtype == np.uint32:  # packed bitmap (u64 fast path)
+            arr = K.unpack_found(arr, n)
+        return np.asarray(arr[:n], np.uint8).tobytes()
+
+    return LazyReply(device=(found,), finish=finish)
 
 
 @register("BFA.RESERVE")
@@ -473,20 +598,31 @@ def cmd_bfa_madd64(server, ctx, args):
     arr = BloomFilterArray(server.engine, _s(args[0]))
     tenants = np.frombuffer(bytes(args[1]), dtype="<i4")
     keys = np.frombuffer(bytes(args[2]), dtype="<i8")
-    newly = arr.add_each(tenants, keys)
-    return np.asarray(newly, np.uint8).tobytes()
+    newly, n = arr.add_each_async(tenants, keys)
+    if n == 0:
+        return b""
+    return LazyReply(
+        device=(newly,),
+        finish=lambda v: np.asarray(v[0], np.uint8)[:n].tobytes(),
+    )
 
 
 @register("BFA.MEXISTS64")
 def cmd_bfa_mexists64(server, ctx, args):
     import numpy as np
     from redisson_tpu.client.objects.bloom_array import BloomFilterArray
+    from redisson_tpu.core import kernels as K
 
     arr = BloomFilterArray(server.engine, _s(args[0]))
     tenants = np.frombuffer(bytes(args[1]), dtype="<i4")
     keys = np.frombuffer(bytes(args[2]), dtype="<i8")
-    found = arr.contains(tenants, keys)
-    return np.asarray(found, np.uint8).tobytes()
+    found, n = arr.contains_async(tenants, keys)
+    if n == 0:
+        return b""
+    return LazyReply(
+        device=(found,),
+        finish=lambda v: np.asarray(K.unpack_found(v[0], n), np.uint8).tobytes(),
+    )
 
 
 @register("PFADD64")
@@ -840,13 +976,8 @@ def cmd_restorestate(server, ctx, args):
 
 # -- generic object invocation (the classBody-shipping analog) ---------------
 
-@register("OBJCALL")
-def cmd_objcall(server, ctx, args):
-    """OBJCALL <factory> <name> <method> <pickled (args, kwargs)> [<caller-id>]
-    -> pickled result.  factory = RedissonTpu getter name ("get_map", ...);
-    caller-id = client uuid:threadId so synchronizer identity survives the
-    wire (RedissonBaseLock.getLockName travels client->Lua the same way)."""
-    factory, name, method = _s(args[0]), _s(args[1]), _s(args[2])
+def _objcall_resolve(server, factory: str, name: str):
+    """Resolve the (cached) handle instance for one object call."""
     if not factory.startswith(("get_", "create_")):
         raise RespError("ERR bad factory")
     client = server.local_client()
@@ -857,38 +988,94 @@ def cmd_objcall(server, ctx, args):
     # (LocalCachedMap subscribes an invalidation listener, adders register
     # counters) must not accrete one instance per OBJCALL.  create_* stays
     # uncached by contract (fresh object per call).
-    if factory.startswith("get_"):
-        cache = server._objcall_handles
-        key = (factory, name)
-        with server._objcall_handles_lock:
-            obj = cache.get(key)
-            if obj is None:
-                obj = fn(name) if name else fn()
-                cache[key] = obj
-                if len(cache) > 4096:  # bounded LRU
-                    _k, old = cache.popitem(last=False)
-                    detach = getattr(old, "destroy", None)  # detach-only by contract
-                    if detach is not None:
-                        try:
-                            detach()
-                        except Exception:  # noqa: BLE001
-                            pass
-            else:
-                cache.move_to_end(key)
-    else:
-        obj = fn(name) if name else fn()
+    if not factory.startswith("get_"):
+        return fn(name) if name else fn()
+    cache = server._objcall_handles
+    key = (factory, name)
+    with server._objcall_handles_lock:
+        obj = cache.get(key)
+        if obj is None:
+            obj = fn(name) if name else fn()
+            cache[key] = obj
+            if len(cache) > 4096:  # bounded LRU
+                _k, old = cache.popitem(last=False)
+                detach = getattr(old, "destroy", None)  # detach-only by contract
+                if detach is not None:
+                    try:
+                        detach()
+                    except Exception:  # noqa: BLE001
+                        pass
+        else:
+            cache.move_to_end(key)
+    return obj
+
+
+def _objcall_invoke(server, factory, name, method, call_args, call_kwargs, caller):
+    """One object-method invocation; returns the raw result (exceptions
+    other than protocol errors propagate to the caller for tagging)."""
+    obj = _objcall_resolve(server, factory, name)
     m = getattr(obj, method, None)
     if m is None or method.startswith("_"):
         raise RespError(f"ERR unknown method '{method}'")
+    with server.engine.impersonate(caller):
+        return m(*call_args, **call_kwargs)
+
+
+@register("OBJCALL")
+def cmd_objcall(server, ctx, args):
+    """OBJCALL <factory> <name> <method> <pickled (args, kwargs)> [<caller-id>]
+    -> pickled result.  factory = RedissonTpu getter name ("get_map", ...);
+    caller-id = client uuid:threadId so synchronizer identity survives the
+    wire (RedissonBaseLock.getLockName travels client->Lua the same way)."""
     from redisson_tpu.net.safe_pickle import safe_loads
 
+    factory, name, method = _s(args[0]), _s(args[1]), _s(args[2])
     call_args, call_kwargs = safe_loads(bytes(args[3])) if len(args) > 3 else ((), {})
     caller = _s(args[4]) if len(args) > 4 else None
     try:
-        with server.engine.impersonate(caller):
-            result = m(*call_args, **call_kwargs)
+        result = _objcall_invoke(
+            server, factory, name, method, call_args, call_kwargs, caller
+        )
     except RespError:
         raise
     except Exception as e:  # noqa: BLE001 — ship the exception to the caller
         return b"E" + pickle.dumps(e)
     return b"R" + pickle.dumps(result)
+
+
+@register("OBJCALLM")
+def cmd_objcallm(server, ctx, args):
+    """OBJCALLM <pickled [(factory, name, method, args, kwargs), ...]> [caller]
+    -> b"M" + pickled [("R", result) | ("E", exception), ...].
+
+    The batched object wire (CommandBatchService.java:87-151 made a single
+    command): MANY object ops cross the wire as ONE frame and ONE pickle,
+    instead of one round trip + pickle per op — the lever that lifts
+    OBJCALL-bound cluster throughput.  Per-op routing errors (MOVED/ASK
+    during a reshard) come back as tagged entries so the client re-routes
+    just those ops."""
+    from redisson_tpu.net.safe_pickle import safe_loads
+
+    ops = safe_loads(bytes(args[0]))
+    caller = _s(args[1]) if len(args) > 1 else None
+    out = []
+    for factory, name, method, call_args, call_kwargs in ops:
+        try:
+            if server.cluster_view:
+                # per-op routing check (the frame itself is keyless)
+                server.check_routing(
+                    "OBJCALL",
+                    [str(factory).encode(), str(name).encode(), str(method).encode()],
+                )
+            out.append(
+                (
+                    "R",
+                    _objcall_invoke(
+                        server, factory, name, method,
+                        tuple(call_args), dict(call_kwargs), caller,
+                    ),
+                )
+            )
+        except Exception as e:  # noqa: BLE001 — tagged per-op, frame continues
+            out.append(("E", e))
+    return b"M" + pickle.dumps(out)

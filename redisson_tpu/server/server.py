@@ -25,7 +25,54 @@ from typing import Any, Dict, List, Optional, Tuple
 from redisson_tpu.core.engine import Engine
 from redisson_tpu.net import resp
 from redisson_tpu.net.resp import ProtocolError, RespError
-from redisson_tpu.server.registry import REGISTRY, CommandContext
+from redisson_tpu.server.registry import LazyReply, REGISTRY, CommandContext
+
+
+class _Encoded:
+    """Pre-encoded wire frame (errors encoded at catch time)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data
+
+
+def _force_lazies(results: list, server) -> None:
+    """Materialize every LazyReply of a frame in place.  Device-form lazies
+    are fetched with one concatenated transfer per dtype (the whole frame
+    pays ~1 tunnel round trip); callable-form lazies force individually."""
+    from redisson_tpu.server.registry import gather_lazy_device_results
+
+    def fail(i, e):
+        server.stats["errors"] += 1
+        if isinstance(e, RespError):
+            results[i] = _Encoded(resp.encode_error(str(e.args[0])))
+        else:
+            results[i] = _Encoded(
+                resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
+            )
+
+    dev_idx = [
+        i for i, r in enumerate(results)
+        if isinstance(r, LazyReply) and r.device is not None
+    ]
+    if dev_idx:
+        try:
+            host_vals = gather_lazy_device_results([results[i] for i in dev_idx])
+        except Exception:  # noqa: BLE001 — grouped path failed; force singly
+            host_vals = None
+        if host_vals is not None:
+            for i, vals in zip(dev_idx, host_vals):
+                try:
+                    results[i] = results[i].finish(vals)
+                except Exception as e:  # noqa: BLE001 — per-reply isolation
+                    fail(i, e)
+    for i, r in enumerate(results):
+        if isinstance(r, LazyReply):
+            try:
+                results[i] = r.force()
+            except Exception as e:  # noqa: BLE001 — per-reply isolation
+                fail(i, e)
 
 
 class TpuServer:
@@ -364,11 +411,19 @@ class TpuServer:
                 except ProtocolError as e:
                     write_q.put_nowait(resp.encode_error(f"ERR protocol error: {e}"))
                     break
+                # Two-phase frame execution: dispatch every command of the
+                # pipelined frame first (handlers may return LazyReply —
+                # device work enqueued, NOT forced), then force all lazy
+                # replies together and write the replies in order.  One
+                # device->host sync per frame instead of per command; per-
+                # connection ordering is untouched (dispatch stays
+                # sequential, and the device stream is in-order).
+                results: list = []
                 for cmd in commands:
                     if not isinstance(cmd, list) or not all(
                         isinstance(a, (bytes, bytearray)) for a in cmd
                     ):
-                        write_q.put_nowait(resp.encode_error("ERR bad request frame"))
+                        results.append(_Encoded(resp.encode_error("ERR bad request frame")))
                         continue
                     self.stats["commands"] += 1
                     pool = (
@@ -377,13 +432,14 @@ class TpuServer:
                         else self._pool
                     )
                     try:
-                        result = await loop.run_in_executor(
-                            pool, REGISTRY.dispatch, self, ctx, cmd
+                        results.append(
+                            await loop.run_in_executor(
+                                pool, REGISTRY.dispatch, self, ctx, cmd
+                            )
                         )
                     except RespError as e:
                         self.stats["errors"] += 1
-                        write_q.put_nowait(resp.encode_error(str(e.args[0])))
-                        continue
+                        results.append(_Encoded(resp.encode_error(str(e.args[0]))))
                     except ConnectionResetError:
                         raise
                     except RuntimeError as e:
@@ -392,11 +448,15 @@ class TpuServer:
                         raise
                     except Exception as e:  # noqa: BLE001 — sandbox handler bugs per-command
                         self.stats["errors"] += 1
-                        write_q.put_nowait(
-                            resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
+                        results.append(
+                            _Encoded(resp.encode_error(f"ERR internal: {type(e).__name__}: {e}"))
                         )
-                        continue
-                    write_q.put_nowait(_encode_result(result))
+                if any(isinstance(r, LazyReply) for r in results):
+                    await loop.run_in_executor(self._pool, _force_lazies, results, self)
+                for r in results:
+                    write_q.put_nowait(
+                        r.data if isinstance(r, _Encoded) else _encode_result(r)
+                    )
         except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
             pass
         finally:

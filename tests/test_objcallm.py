@@ -1,0 +1,74 @@
+"""OBJCALLM: the batched object wire (one frame + one pickle for many ops)."""
+import numpy as np
+import pytest
+
+from redisson_tpu.harness import ClusterRunner, free_port
+from redisson_tpu.server.server import ServerThread
+
+
+def test_objcallm_single_node():
+    st = ServerThread(port=free_port()).start()
+    try:
+        from redisson_tpu.client.remote import RemoteRedisson
+
+        c = RemoteRedisson(f"127.0.0.1:{st.server.port}", timeout=60.0)
+        ops = []
+        for i in range(50):
+            ops.append(("get_map", "m1", "put", (f"k{i}", i), {}))
+        ops.append(("get_map", "m1", "size", (), {}))
+        ops.append(("get_set", "s1", "add", ("x",), {}))
+        ops.append(("get_atomic_long", "al", "add_and_get", (7,), {}))
+        ops.append(("get_map", "m1", "definitely_missing", (), {}))  # error row
+        res = c.objcall_many(ops)
+        assert res[50] == 50  # size after 50 puts
+        assert res[51] is True
+        assert res[52] == 7
+        assert isinstance(res[53], Exception)
+        assert c.get_map("m1").get("k7") == 7
+        c.shutdown()
+    finally:
+        st.stop()
+
+
+def test_objcallm_cluster_groups_per_shard():
+    runner = ClusterRunner(masters=3).run()
+    try:
+        client = runner.client(scan_interval=0)
+        ops = []
+        for i in range(60):
+            ops.append(("get_map", f"cm-{i}", "put", ("k", i), {}))
+        for i in range(60):
+            ops.append(("get_map", f"cm-{i}", "get", ("k",), {}))
+        res = client.objcall_many(ops)
+        assert res[:60] == [None] * 60  # put returns old value (None)
+        assert res[60:] == list(range(60))
+        # records spread over all three shards
+        per = [len(m.server.server.engine.store) for m in runner.masters]
+        assert all(p > 0 for p in per)
+        client.shutdown()
+    finally:
+        runner.shutdown()
+
+
+def test_objcallm_cluster_survives_stale_routing():
+    """Per-op MOVED rows re-route instead of surfacing as errors."""
+    from redisson_tpu.server.migration import migrate_slots
+    from redisson_tpu.utils.crc16 import calc_slot
+
+    runner = ClusterRunner(masters=2).run()
+    try:
+        client = runner.client(scan_interval=0)
+        names = [f"st-{i}" for i in range(30)]
+        client.objcall_many([("get_bucket", n, "set", (i,), {}) for i, n in enumerate(names)])
+        lo0, hi0 = runner.slot_ranges[0]
+        slots = sorted({
+            calc_slot(n.encode()) for n in names
+            if lo0 <= calc_slot(n.encode()) <= hi0
+        })
+        migrate_slots(runner.masters[0].address, runner.masters[1].address, slots)
+        # client's view is stale: per-op MOVED rows must still resolve
+        res = client.objcall_many([("get_bucket", n, "get", (), {}) for n in names])
+        assert res == list(range(30))
+        client.shutdown()
+    finally:
+        runner.shutdown()

@@ -291,3 +291,40 @@ def test_remote_lock_client_watchdog(client):
     assert lock.renew_lease(45.0)
     lock.unlock()
     assert not lock.is_locked()
+
+
+def test_setbitsb_getbitsb_blob_forms(client):
+    """Blob bit commands: i32 index buffer in, byte blob out."""
+    import numpy as np
+
+    node = client.node
+    idx = np.ascontiguousarray([1, 5, 9, 5000], "<i4")
+    old = node.execute("SETBITSB", "srv:bits", idx.tobytes())
+    assert bytes(old) == b"\x00\x00\x00\x00"
+    old = node.execute("SETBITSB", "srv:bits", idx.tobytes())
+    assert bytes(old) == b"\x01\x01\x01\x01"  # previous values now set
+    got = node.execute("GETBITSB", "srv:bits", np.ascontiguousarray([0, 1, 5, 9], "<i4").tobytes())
+    assert bytes(got) == b"\x00\x01\x01\x01"
+    # parity with the RESP-int form
+    assert client.get_bit_set("srv:bits").get_each(np.asarray([1, 5, 9, 5000])).tolist() == [1, 1, 1, 1]
+
+
+def test_pipelined_frame_lazy_replies_ordered(client):
+    """A pipelined frame mixing lazy (device) and plain replies returns
+    results in submission order with correct values."""
+    import numpy as np
+
+    node = client.node
+    idx = np.ascontiguousarray([2, 4, 6], "<i4").tobytes()
+    blob = np.ascontiguousarray(np.arange(100, dtype=np.int64), "<i8").tobytes()
+    replies = node.execute_many([
+        ("SET", "srv:pl", "x"),
+        ("BF.RESERVE", "srv:plbf", 0.01, 1000),
+        ("BF.MADD64", "srv:plbf", blob),
+        ("GET", "srv:pl"),
+        ("BF.MEXISTS64", "srv:plbf", blob),
+        ("SETBITSB", "srv:plbits", idx),
+    ])
+    assert np.frombuffer(replies[2], np.uint8).all()  # all newly added
+    assert np.frombuffer(replies[4], np.uint8).all()  # all found
+    assert bytes(replies[5]) == b"\x00\x00\x00"
