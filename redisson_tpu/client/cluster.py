@@ -545,10 +545,17 @@ class ClusterRedisson(RemoteSurface):
                 replies = _unwrap_many(
                     entry.master.execute("OBJCALLM", payload, caller)
                 )
-            except (ConnectionError, OSError, TimeoutError):
-                # stale entry: per-op redirect-aware path (reads AND writes —
-                # the failure happened before the frame was written or the
-                # caller accepts per-op at-most-once via objcall's own rules)
+            except TimeoutError:
+                # The OBJCALLM frame was written and may have EXECUTED
+                # server-side; re-running every op through the per-op path
+                # would double-apply non-idempotent writes (map puts, counter
+                # adds, lock calls).  Same rule as execute()/run_group for
+                # write+timeout: raise, let the caller decide.
+                raise
+            except (ConnectionError, OSError):
+                # stale entry / connect refused: the failure happened before
+                # the frame was written, so the per-op redirect-aware path
+                # is safe for reads AND writes
                 replies = []
                 for i in idxs:
                     f, n, m, a, kw = ops[i]

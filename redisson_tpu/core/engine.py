@@ -168,11 +168,14 @@ class Engine:
 
     @property
     def _renewal_pool(self):
-        """Dedicated single worker for lease renewals.  Renewals are
-        lease-CRITICAL: sharing a pool with arbitrary user work (MapWriter
-        flushes, scheduled-task fires) would let a blocked writer starve
-        renewals past lease expiry — two holders of a mutual-exclusion
-        lock.  Renewal ticks only take a record lock briefly."""
+        """Dedicated pool for lease renewals.  Renewals are lease-CRITICAL:
+        sharing a pool with arbitrary user work (MapWriter flushes,
+        scheduled-task fires) would let a blocked writer starve renewals
+        past lease expiry — two holders of a mutual-exclusion lock.
+        Multiple workers for the same reason INTERNALLY: one renew() stuck
+        on a contended record lock (held across a device sync or a
+        migration serialize) must not delay every other lock's renewal
+        tick past its lease."""
         with self._locks_guard:
             if self._closed:
                 raise RuntimeError("engine is shut down")
@@ -180,7 +183,7 @@ class Engine:
                 from concurrent.futures import ThreadPoolExecutor
 
                 self._renewal_pool_ = ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="rtpu-renewal"
+                    max_workers=4, thread_name_prefix="rtpu-renewal"
                 )
             return self._renewal_pool_
 

@@ -85,7 +85,12 @@ class DeviceStore:
 
     def put(self, name: str, rec: StateRecord) -> None:
         with self._lock:
-            if name not in self._states and self.absent_guard is not None:
+            # Expired entries are semantically absent: a put() recreating an
+            # expired name in a MIGRATING slot must ASK-redirect exactly like
+            # get/get_or_create would (same predicate peek() uses), or the
+            # recreated record can slip in behind a completed drain.
+            cur = self._states.get(name)
+            if (cur is None or cur.expired()) and self.absent_guard is not None:
                 self.absent_guard(name)
             self._states[name] = rec
 

@@ -28,11 +28,14 @@ class LazyReply:
     """Deferred reply: the handler DISPATCHED device work but did not force
     the device->host sync.  The connection loop materializes every lazy
     reply of a pipelined frame together — and, for the (device, finish)
-    form, CONCATENATES all device results of the frame into one transfer
-    per dtype, so a 32-command frame pays ~1 tunnel round trip instead of
-    32 (each device->host sync costs a fixed ~68ms through the tunnel
+    form, BITCASTS every device result to one uint8 stream, concatenates,
+    and pulls it in a SINGLE device->host transfer (regardless of dtype
+    mix), so a 32-command frame pays ~1 tunnel round trip instead of 32
+    (each device->host sync costs a fixed ~68ms through the tunnel
     regardless of size; the reference's analog is CommandBatchService's
-    single-flush discipline).
+    single-flush discipline).  Constraint: each device value's dtype must
+    round-trip via ``np.dtype(a.dtype.name)`` — a dtype numpy can't name
+    (e.g. bfloat16) cannot ride this path.
 
     Two forms:
       LazyReply(force=fn)              — fn() -> reply, forced individually;
@@ -246,7 +249,14 @@ def cmd_dbsize(server, ctx, args):
 
 @register("DEL")
 def cmd_del(server, ctx, args):
-    return sum(1 for k in args if server.engine.store.delete(_s(k)))
+    # Record lock per key: a DEL racing a slot drain must serialize against
+    # the in-flight ship (server.py migrate_slot_batch) or the acked delete
+    # resurrects from the migrated copy when the slot finalizes.
+    def _del(k: str) -> bool:
+        with server.engine.locked(k):
+            return server.engine.store.delete(k)
+
+    return sum(1 for k in args if _del(_s(k)))
 
 
 @register("UNLINK")
@@ -259,22 +269,26 @@ def cmd_exists(server, ctx, args):
     return sum(1 for k in args if server.engine.store.exists(_s(k)))
 
 
+def _expire_locked(server, name: str, at) -> int:
+    # Same record-lock discipline as DEL: a TTL change racing a slot drain
+    # must serialize against the in-flight ship or it silently vanishes.
+    with server.engine.locked(name):
+        return 1 if server.engine.store.expire(name, at) else 0
+
+
 @register("EXPIRE")
 def cmd_expire(server, ctx, args):
-    ok = server.engine.store.expire(_s(args[0]), time.time() + _int(args[1]))
-    return 1 if ok else 0
+    return _expire_locked(server, _s(args[0]), time.time() + _int(args[1]))
 
 
 @register("PEXPIRE")
 def cmd_pexpire(server, ctx, args):
-    ok = server.engine.store.expire(_s(args[0]), time.time() + _int(args[1]) / 1000.0)
-    return 1 if ok else 0
+    return _expire_locked(server, _s(args[0]), time.time() + _int(args[1]) / 1000.0)
 
 
 @register("PERSIST")
 def cmd_persist(server, ctx, args):
-    ok = server.engine.store.expire(_s(args[0]), None)
-    return 1 if ok else 0
+    return _expire_locked(server, _s(args[0]), None)
 
 
 @register("TTL")
@@ -297,8 +311,10 @@ def cmd_pttl(server, ctx, args):
 
 @register("RENAME")
 def cmd_rename(server, ctx, args):
-    if not server.engine.store.rename(_s(args[0]), _s(args[1])):
-        raise RespError("ERR no such key")
+    src, dst = _s(args[0]), _s(args[1])
+    with server.engine.locked_many([src, dst]):
+        if not server.engine.store.rename(src, dst):
+            raise RespError("ERR no such key")
     return "+OK"
 
 

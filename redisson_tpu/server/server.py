@@ -314,26 +314,28 @@ class TpuServer:
                     link = links[target] = NodeClient(
                         target, password=self.password, ping_interval=0, retry_attempts=1
                     )
-                while True:
-                    with self.engine.locked(name):
-                        if not self.engine.store.peek(name):
-                            break  # expired/deleted meanwhile
-                        blob, shipped = replication.serialize_records(
-                            self.engine, [name], include_live=False
-                        )
+                # Hold the record lock across serialize -> IMPORTRECORDS ->
+                # local delete.  Every mutation path (object handles AND the
+                # store-level DEL/EXPIRE commands) takes this lock, so the
+                # per-name move is atomic: no client write, delete, or expire
+                # can interleave between the snapshot leaving and the local
+                # copy dying — the zero-lost-acked-writes contract holds for
+                # deletes too (a DEL either lands before the snapshot, making
+                # peek() fail here, or blocks until the name is locally
+                # absent and then ASK-redirects to the target).  Redis gets
+                # the same guarantee from MIGRATE's single-threaded blocking;
+                # we pay it per-key instead of per-server.
+                with self.engine.locked(name):
+                    if not self.engine.store.peek(name):
+                        continue  # expired/deleted meanwhile
+                    blob, shipped = replication.serialize_records(
+                        self.engine, [name], include_live=False
+                    )
                     if not shipped:
-                        break
+                        continue
                     link.execute("IMPORTRECORDS", blob, timeout=30.0)
-                    _n, snap_nonce, snap_version = shipped[0]
-                    with self.engine.locked(name):
-                        rec = self.engine.store.get_unguarded(name)
-                        if rec is None:
-                            break  # deleted while shipping: nothing to keep
-                        if (rec.nonce, rec.version) == (snap_nonce, snap_version):
-                            self.engine.store.delete_unguarded(name)
-                            moved += 1
-                            break
-                        # mutated while shipping: loop, re-ship latest state
+                    self.engine.store.delete_unguarded(name)
+                    moved += 1
         finally:
             for link in links.values():
                 link.close()

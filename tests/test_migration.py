@@ -144,6 +144,65 @@ def test_rebalance_under_load_zero_lost_acked_writes(cluster2):
     client.shutdown()
 
 
+def test_rebalance_under_load_deletes_do_not_resurrect(cluster2):
+    """Chaos audit for DELETES: a DEL acked during a slot drain must stay
+    deleted after the slot finalizes (advisor r2 high finding — a delete
+    landing between the snapshot leaving and the drain's re-check used to
+    resurrect from the migrated copy).  Each key has exactly ONE writer
+    thread issuing SET/DEL, so the last acked op per key is deterministic."""
+    client = cluster2.client(scan_interval=0)
+    stop = threading.Event()
+    last_acked: dict = {}  # key -> ("set", value) | ("del",)
+    errors: list = []
+
+    lo0, hi0 = cluster2.slot_ranges[0]
+    keys = [f"dchaos-{i}" for i in range(600)]
+    keys = [k for k in keys if lo0 <= calc_slot(k.encode()) <= hi0][:80]
+    assert len(keys) >= 40
+
+    def writer(worker: int, nworkers: int):
+        mine = keys[worker::nworkers]
+        n = 0
+        while not stop.is_set():
+            k = mine[n % len(mine)]
+            try:
+                if n % 3 == 2:
+                    client.execute("DEL", k)
+                    last_acked[k] = ("del",)
+                else:
+                    v = f"v{worker}-{n}"
+                    client.execute("SET", k, v)
+                    last_acked[k] = ("set", v)
+            except Exception as e:  # noqa: BLE001 — unacked; not counted
+                errors.append(e)
+            n += 1
+
+    nworkers = 4
+    threads = [
+        threading.Thread(target=writer, args=(w, nworkers)) for w in range(nworkers)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    slots = sorted({calc_slot(k.encode()) for k in keys})
+    migrate_slots(cluster2.masters[0].address, cluster2.masters[1].address, slots)
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    client.refresh_topology()
+    wrong = []
+    for k, op in last_acked.items():
+        cur = client.execute("GET", k)
+        cur = bytes(cur).decode() if cur is not None else None
+        if op[0] == "del" and cur is not None:
+            wrong.append((k, "resurrected", cur))
+        elif op[0] == "set" and cur != op[1]:
+            wrong.append((k, f"expected {op[1]}", cur))
+    assert not wrong, f"post-drain state diverged: {wrong[:10]}"
+    client.shutdown()
+
+
 def test_migration_with_cluster_pipeline(cluster2):
     """execute_many rows hitting a migration window re-route via ASK."""
     client = cluster2.client(scan_interval=0)

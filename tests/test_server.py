@@ -299,14 +299,14 @@ def test_setbitsb_getbitsb_blob_forms(client):
 
     node = client.node
     idx = np.ascontiguousarray([1, 5, 9, 5000], "<i4")
-    old = node.execute("SETBITSB", "srv:bits", idx.tobytes())
+    old = node.execute("SETBITSB", "srv:blobbits", idx.tobytes())
     assert bytes(old) == b"\x00\x00\x00\x00"
-    old = node.execute("SETBITSB", "srv:bits", idx.tobytes())
+    old = node.execute("SETBITSB", "srv:blobbits", idx.tobytes())
     assert bytes(old) == b"\x01\x01\x01\x01"  # previous values now set
-    got = node.execute("GETBITSB", "srv:bits", np.ascontiguousarray([0, 1, 5, 9], "<i4").tobytes())
+    got = node.execute("GETBITSB", "srv:blobbits", np.ascontiguousarray([0, 1, 5, 9], "<i4").tobytes())
     assert bytes(got) == b"\x00\x01\x01\x01"
     # parity with the RESP-int form
-    assert client.get_bit_set("srv:bits").get_each(np.asarray([1, 5, 9, 5000])).tolist() == [1, 1, 1, 1]
+    assert client.get_bit_set("srv:blobbits").get_each(np.asarray([1, 5, 9, 5000])).tolist() == [1, 1, 1, 1]
 
 
 def test_pipelined_frame_lazy_replies_ordered(client):
