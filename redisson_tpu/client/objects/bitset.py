@@ -82,10 +82,12 @@ class BitSet(RExpirable):
         if n == 0:
             return np.zeros((0,), np.uint8), 0
         b = K.pow2_bucket(n)
-        vals = np.full((b,), 1 if value else 0, np.uint8)
+        vals = K.stage(np.full((b,), 1 if value else 0, np.uint8))
         with self._engine.locked(self._name):
             rec = self._rec_or_create(int(idx.max()) + 1 if n else 0)
-            bits, old = K.bitset_set(rec.arrays["bits"], K.pad_to(idx, b), n, vals)
+            bits, old = K.bitset_set(
+                rec.arrays["bits"], K.stage(K.pad_to(idx, b)), K.valid_n(n), vals
+            )
             rec.arrays["bits"] = bits
             self._touch_version(rec)
         return old, n
@@ -104,7 +106,9 @@ class BitSet(RExpirable):
             rec = self._engine.store.get(self._name)
             if rec is None:
                 return np.zeros(idx.shape, np.uint8), n
-            got = K.bitset_get(rec.arrays["bits"], K.pad_to(idx, K.pow2_bucket(n)))
+            got = K.bitset_get(
+                rec.arrays["bits"], K.stage(K.pad_to(idx, K.pow2_bucket(n)))
+            )
         return got, n
 
     def set_range(self, from_index: int, to_index: int, value: bool = True) -> None:

@@ -77,7 +77,10 @@ class HyperLogLogArray(RExpirable):
         with self._engine.locked(self._name):
             rec = self._rec()
             rec.arrays["regs"] = K.hll_bank_merge_rows(
-                rec.arrays["regs"], K.pad_to(dst, b), K.pad_to(src, b), K.valid_n(n)
+                rec.arrays["regs"],
+                K.stage(K.pad_to(dst, b)),
+                K.stage(K.pad_to(src, b)),
+                K.valid_n(n),
             )
             self._touch_version(rec)
 

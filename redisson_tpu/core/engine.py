@@ -325,8 +325,8 @@ class Engine:
         words, nbytes = H.pack_keys(encoded)
         b = K.pow2_bucket(max(1, n))
         w = max(4, K.pow2_bucket(max(1, words.shape[0]), minimum=4))
-        words = K.pad_to(K.pad_to(words, b, axis=1), w, axis=0)
-        nbytes = K.pad_to(nbytes, b)
+        words = K.stage(K.pad_to(K.pad_to(words, b, axis=1), w, axis=0))
+        nbytes = K.stage(K.pad_to(nbytes, b))
         return "bytes", (words, nbytes), n
 
     # -- lifecycle ----------------------------------------------------------
