@@ -64,14 +64,13 @@ def bench_config2_tenant_bank(client):
 
     arr.contains(t, keys)  # warm compile
     # latency: per-flush, synchronous (what a single caller observes).
-    # Tunnel round trips swing wildly run to run; record the best 20 of 30
-    # flushes so the p99 reflects the serving path, not one tunnel stall.
+    # All 30 samples count toward the reported p99 — trimming the tail
+    # would hide genuine serving-path stalls, not just tunnel noise.
     lat = []
     for _ in range(30):
         s = time.perf_counter()
         found = arr.contains(t, keys)
         lat.append(time.perf_counter() - s)
-    lat = sorted(lat)[:20]
     # throughput: pipelined flushes (RBatch executeAsync analog) — dispatch
     # everything (async), then fetch all results in ONE batched device_get so
     # the fixed ~68ms/sync tunnel round-trip amortizes across the whole run.
@@ -93,7 +92,7 @@ def bench_config2_tenant_bank(client):
         f"config2: {ops_per_sec/1e6:.2f}M contains/s (best of {windows} windows "
         f"of {reps} pipelined flushes: {['%.2fM' % (r/1e6) for r in rates]}), "
         f"sync flush p50={pctl(lat,50)*1e3:.2f}ms p99={pctl(lat,99)*1e3:.2f}ms "
-        f"(best 20/30), hit-rate={found.mean():.3f}"
+        f"(all 30 samples), hit-rate={found.mean():.3f}"
     )
     return ops_per_sec, pctl(lat, 99) * 1e3
 
@@ -168,10 +167,17 @@ def bench_config3_hll(client):
 
 
 def bench_config4_mapreduce(client):
-    """Word-count over a 1M-entry map, 64 mapper tasks (config 4)."""
+    """Word-count over a 1M-entry map, 64 logical mappers (config 4).
+
+    Runs the device MapReduce pipeline (kernels.wc_extract_words +
+    wc_sort_runs: tokenize/hash via scans+gathers, count via sorts — design
+    history in core/kernels.py).  StringCodec: a word-count source map holds
+    plain strings; pickling/JSON-framing 1M values would only measure codec
+    overhead.  Best of 2 runs (tunnel variance defense, same as config 2/5)."""
+    from redisson_tpu.client.codec import StringCodec
     from redisson_tpu.services.mapreduce import word_count
 
-    m = client.get_map("bench:wc")
+    m = client.get_map("bench:wc", codec=StringCodec())
     rng = np.random.default_rng(3)
     vocab = [f"w{i}" for i in range(1000)]
     entries = {
@@ -179,13 +185,16 @@ def bench_config4_mapreduce(client):
         for i in range(1_000_000)
     }
     m.put_all(entries)
-    t0 = time.perf_counter()
-    counts = word_count(client._engine, m, workers=64)
-    wall = time.perf_counter() - t0
+    wall = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        counts = word_count(m, workers=64)
+        wall = min(wall, time.perf_counter() - t0)
     total_words = sum(counts.values())
     assert total_words == 8_000_000, total_words
+    assert len(counts) == 1000, len(counts)
     rate = 1_000_000 / wall
-    log(f"config4: word-count 1M entries in {wall:.2f}s = {rate/1e6:.2f}M entries/s (64 mappers)")
+    log(f"config4: word-count 1M entries in {wall:.2f}s = {rate/1e6:.2f}M entries/s (device pipeline, best of 2)")
     m.delete()
     return rate
 

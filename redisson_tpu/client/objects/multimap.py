@@ -61,6 +61,23 @@ class _BaseMultimap(RExpirable):
             changed |= self.put(key, v)
         return changed
 
+    def put_all_entries(self, mapping) -> int:
+        """Bulk merge {key: [values...]} under ONE lock/one wire frame — the
+        batch-first citizen MapReduce mappers use to flush a whole partition
+        buffer per call instead of one put per emitted key (the reference's
+        Collector.emit writes per emit, mapreduce/Collector.java:56-73)."""
+        n = 0
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            for key, values in mapping.items():
+                ek = self._ek(key)
+                self._live(rec, ek)
+                bucket = rec.host["data"].setdefault(ek, self._container())
+                for v in values:
+                    if self._add(rec, bucket, self._ev(v)):
+                        n += 1
+        return n
+
     def get_all(self, key) -> List:
         with self._engine.locked(self._name):
             rec = self._rec_or_create()

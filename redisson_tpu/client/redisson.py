@@ -365,10 +365,10 @@ class RedissonTpu:
 
         return LiveObjectService(self._engine)
 
-    def get_map_reduce(self, mapper, reducer, collator=None, workers: int = 4):
+    def get_map_reduce(self, mapper, reducer, collator=None, workers: int = 4, executor=None):
         from redisson_tpu.services.mapreduce import MapReduce
 
-        return MapReduce(self._engine, mapper, reducer, collator, workers)
+        return MapReduce(self._engine, mapper, reducer, collator, workers, executor)
 
     # -- keyspace admin (RKeys) --------------------------------------------
 

@@ -77,12 +77,18 @@ def _unwrap_many(reply: Any) -> List[Any]:
 
 
 class RemoteObjectProxy:
-    """Generic remote handle: every method call becomes one OBJCALL."""
+    """Generic remote handle: every method call becomes one OBJCALL.
 
-    def __init__(self, client: "RemoteRedisson", factory: str, name: str):
+    A non-default `codec` travels with every call (OBJCALL's optional codec
+    frame arg) so the server-side handle encodes keys/values exactly like
+    the caller's — the reference's getMap(name, codec) contract."""
+
+    def __init__(self, client: "RemoteRedisson", factory: str, name: str,
+                 codec: Optional[Codec] = None):
         self._client = client
         self._factory = factory
         self._name = name
+        self._codec = codec
 
     @property
     def name(self) -> str:
@@ -93,7 +99,9 @@ class RemoteObjectProxy:
             raise AttributeError(method)
 
         def call(*args, **kwargs):
-            return self._client.objcall(self._factory, self._name, method, args, kwargs)
+            return self._client.objcall(
+                self._factory, self._name, method, args, kwargs, codec=self._codec
+            )
 
         call.__name__ = method
         return call
@@ -688,11 +696,15 @@ class RemoteSurface:
         args: tuple,
         kwargs: dict,
         caller: Optional[str] = None,
+        codec: Optional[Codec] = None,
     ) -> Any:
         payload = pickle.dumps((args, kwargs))
-        reply = self.execute(
-            "OBJCALL", factory, name, method, payload, caller or self.caller_id()
-        )
+        frame = [
+            "OBJCALL", factory, name, method, payload, caller or self.caller_id(),
+        ]
+        if codec is not None:
+            frame.append(pickle.dumps(codec))
+        reply = self.execute(*frame)
         return _unwrap(reply)
 
     def objcall_many(
@@ -751,8 +763,8 @@ class RemoteSurface:
             return make_lock
         if factory in _GENERIC_FACTORIES:
 
-            def make(name: str, *_a, **_k) -> RemoteObjectProxy:
-                return RemoteObjectProxy(self, factory, name)
+            def make(name: str, codec: Optional[Codec] = None, *_a, **_k) -> RemoteObjectProxy:
+                return RemoteObjectProxy(self, factory, name, codec)
 
             return make
         raise AttributeError(factory)

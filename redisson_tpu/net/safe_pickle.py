@@ -41,6 +41,22 @@ _ALLOWED_GLOBALS = {
     # framework wire-visible classes
     ("redisson_tpu.net.resp", "RespError"),
     ("redisson_tpu.net.resp", "Push"),
+    # codecs: pure-config value classes that ride OBJCALL's codec frame so
+    # remote handles honor getMap(name, codec) (client/remote.py objcall)
+    ("redisson_tpu.client.codec", "JsonCodec"),
+    ("redisson_tpu.client.codec", "PickleCodec"),
+    ("redisson_tpu.client.codec", "StringCodec"),
+    ("redisson_tpu.client.codec", "BytesCodec"),
+    ("redisson_tpu.client.codec", "LongCodec"),
+    ("redisson_tpu.client.codec", "DoubleCodec"),
+    ("redisson_tpu.client.codec", "CompositeCodec"),
+    ("redisson_tpu.client.codec", "ZlibCodec"),
+    ("redisson_tpu.client.codec", "Bz2Codec"),
+    ("redisson_tpu.client.codec", "LzmaCodec"),
+    # the restricted unpickler's own rejection travels inside E-replies;
+    # without this the root cause is masked by a second rejection
+    ("_pickle", "UnpicklingError"),
+    ("pickle", "UnpicklingError"),
     ("redisson_tpu.services.search", "SearchResult"),
     ("redisson_tpu.services.search", "Condition"),
     ("redisson_tpu.services.search", "Eq"),

@@ -59,23 +59,48 @@ class WorkerNode:
 
     def _run_one(self, executor: str, task_id: str, payload: bytes, worker_id: str) -> None:
         # worker_id doubles as the claim-fencing token: if this claim was
-        # orphan-requeued while we ran, the ack is rejected server-side
-        try:
-            fn, args, kwargs = pickle.loads(payload)  # noqa: S301 — the worker's whole job
-            result = fn(*args, **kwargs)
-        except Exception as e:  # noqa: BLE001 — task failures are data
-            self.stats["failed"] += 1
-            retryable = e.__class__.__name__ == "_RetryableError"
-            self._exec_call(
-                executor, "fail_task", task_id,
-                f"{type(e).__name__}: {e}\n{traceback.format_exc()}", retryable,
-                worker_id,
-            )
-            return
-        self._exec_call(
-            executor, "complete_task", task_id, pickle.dumps(result), worker_id
+        # orphan-requeued while we ran, the ack is rejected server-side.
+        # A background renewal ticker keeps the claim visible while the task
+        # runs (TasksRunnerService renews task visibility the same way) so a
+        # chunk slower than the orphan window isn't voided under a live
+        # worker — renewing at 1/3 the window survives two missed ticks.
+        stop_renewal = threading.Event()
+
+        def renew_loop():
+            while not stop_renewal.wait(max(0.05, self.orphan_age / 3)):
+                try:
+                    self._exec_call(executor, "renew_claim", task_id, worker_id)
+                except Exception:  # noqa: BLE001 — server briefly away; keep trying
+                    pass
+
+        renewer = threading.Thread(
+            target=renew_loop, daemon=True, name=f"rtpu-renew-{task_id[:8]}"
         )
-        self.stats["executed"] += 1
+        renewer.start()
+        try:
+            try:
+                fn, args, kwargs = pickle.loads(payload)  # noqa: S301 — the worker's whole job
+                # @RInject analog (services/executor.py inject_client):
+                # grid-aware tasks (MapReduce mappers/reducers) get THIS
+                # node's client
+                if getattr(fn, "_inject_client", False):
+                    kwargs = {**kwargs, "client": self.client}
+                result = fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — task failures are data
+                self.stats["failed"] += 1
+                retryable = e.__class__.__name__ == "_RetryableError"
+                self._exec_call(
+                    executor, "fail_task", task_id,
+                    f"{type(e).__name__}: {e}\n{traceback.format_exc()}", retryable,
+                    worker_id,
+                )
+                return
+            self._exec_call(
+                executor, "complete_task", task_id, pickle.dumps(result), worker_id
+            )
+            self.stats["executed"] += 1
+        finally:
+            stop_renewal.set()
 
     def _loop(self, wid: int) -> None:
         worker_id = f"{self.node_id}:{wid}"

@@ -992,26 +992,54 @@ def cmd_restorestate(server, ctx, args):
 
 # -- generic object invocation (the classBody-shipping analog) ---------------
 
-def _objcall_resolve(server, factory: str, name: str):
-    """Resolve the (cached) handle instance for one object call."""
+def _objcall_resolve(server, factory: str, name: str, codec_blob: Optional[bytes] = None):
+    """Resolve the (cached) handle instance for one object call.
+
+    `codec_blob` (optional, pickled Codec) lets remote clients carry a
+    non-default codec across the wire — the reference's getMap(name, codec)
+    contract; without it every wire handle silently used the server's
+    default codec.  The raw blob keys the cache so same-name handles with
+    different codecs don't alias."""
     if not factory.startswith(("get_", "create_")):
         raise RespError("ERR bad factory")
     client = server.local_client()
     fn = getattr(client, factory, None)
     if fn is None:
         raise RespError(f"ERR unknown factory '{factory}'")
+
+    def _make():
+        kw = {}
+        if codec_blob is not None:
+            import inspect
+
+            from redisson_tpu.net.safe_pickle import safe_loads
+
+            # signature probe, not except-TypeError: a TypeError raised
+            # INSIDE an accepting factory must not masquerade as "does not
+            # accept a codec"
+            try:
+                params = inspect.signature(fn).parameters
+            except (TypeError, ValueError):
+                params = {}
+            if "codec" not in params and not any(
+                p.kind == p.VAR_KEYWORD for p in params.values()
+            ):
+                raise RespError(f"ERR factory '{factory}' does not accept a codec")
+            kw["codec"] = safe_loads(codec_blob)
+        return fn(name, **kw) if name else fn(**kw)
+
     # handle instances are cached per (factory, name): stateful handles
     # (LocalCachedMap subscribes an invalidation listener, adders register
     # counters) must not accrete one instance per OBJCALL.  create_* stays
     # uncached by contract (fresh object per call).
     if not factory.startswith("get_"):
-        return fn(name) if name else fn()
+        return _make()
     cache = server._objcall_handles
-    key = (factory, name)
+    key = (factory, name, codec_blob)
     with server._objcall_handles_lock:
         obj = cache.get(key)
         if obj is None:
-            obj = fn(name) if name else fn()
+            obj = _make()
             cache[key] = obj
             if len(cache) > 4096:  # bounded LRU
                 _k, old = cache.popitem(last=False)
@@ -1026,10 +1054,11 @@ def _objcall_resolve(server, factory: str, name: str):
     return obj
 
 
-def _objcall_invoke(server, factory, name, method, call_args, call_kwargs, caller):
+def _objcall_invoke(server, factory, name, method, call_args, call_kwargs, caller,
+                    codec_blob: Optional[bytes] = None):
     """One object-method invocation; returns the raw result (exceptions
     other than protocol errors propagate to the caller for tagging)."""
-    obj = _objcall_resolve(server, factory, name)
+    obj = _objcall_resolve(server, factory, name, codec_blob)
     m = getattr(obj, method, None)
     if m is None or method.startswith("_"):
         raise RespError(f"ERR unknown method '{method}'")
@@ -1040,17 +1069,20 @@ def _objcall_invoke(server, factory, name, method, call_args, call_kwargs, calle
 @register("OBJCALL")
 def cmd_objcall(server, ctx, args):
     """OBJCALL <factory> <name> <method> <pickled (args, kwargs)> [<caller-id>]
-    -> pickled result.  factory = RedissonTpu getter name ("get_map", ...);
-    caller-id = client uuid:threadId so synchronizer identity survives the
-    wire (RedissonBaseLock.getLockName travels client->Lua the same way)."""
+    [<pickled codec>] -> pickled result.  factory = RedissonTpu getter name
+    ("get_map", ...); caller-id = client uuid:threadId so synchronizer
+    identity survives the wire (RedissonBaseLock.getLockName travels
+    client->Lua the same way); the optional codec rides the frame so remote
+    handles honor getMap(name, codec) semantics."""
     from redisson_tpu.net.safe_pickle import safe_loads
 
     factory, name, method = _s(args[0]), _s(args[1]), _s(args[2])
     call_args, call_kwargs = safe_loads(bytes(args[3])) if len(args) > 3 else ((), {})
-    caller = _s(args[4]) if len(args) > 4 else None
+    caller = _s(args[4]) if len(args) > 4 and args[4] is not None else None
+    codec_blob = bytes(args[5]) if len(args) > 5 and args[5] is not None else None
     try:
         result = _objcall_invoke(
-            server, factory, name, method, call_args, call_kwargs, caller
+            server, factory, name, method, call_args, call_kwargs, caller, codec_blob
         )
     except RespError:
         raise
@@ -1075,7 +1107,11 @@ def cmd_objcallm(server, ctx, args):
     ops = safe_loads(bytes(args[0]))
     caller = _s(args[1]) if len(args) > 1 else None
     out = []
-    for factory, name, method, call_args, call_kwargs in ops:
+    for op in ops:
+        # 5-tuple (factory, name, method, args, kwargs) or 6-tuple with a
+        # trailing pickled-codec blob (same contract as OBJCALL's 6th arg)
+        factory, name, method, call_args, call_kwargs = op[:5]
+        codec_blob = op[5] if len(op) > 5 else None
         try:
             if server.cluster_view:
                 # per-op routing check (the frame itself is keyless)
@@ -1088,7 +1124,7 @@ def cmd_objcallm(server, ctx, args):
                     "R",
                     _objcall_invoke(
                         server, factory, name, method,
-                        tuple(call_args), dict(call_kwargs), caller,
+                        tuple(call_args), dict(call_kwargs), caller, codec_blob,
                     ),
                 )
             )

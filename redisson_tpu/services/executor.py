@@ -339,6 +339,21 @@ class ExecutorService:
                 raise TimeoutError(f"task {task_id} not finished within {timeout}s")
             self._done_wait().wait_for(min(remaining, 0.5))
 
+    def renew_claim(self, task_id: str, worker_id: str) -> bool:
+        """Visibility renewal for long-running tasks (the reference renews
+        task visibility mid-run, TasksRunnerService.java:192-318): bump the
+        claim's started_at so requeue_orphans' window measures time since
+        the LAST sign of life, not since the claim — a slow-but-healthy
+        chunk must not be voided out from under a live worker."""
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            task = rec.host["tasks"].get(task_id)
+            if task is None or task.state != "running" or task.claimed_by != worker_id:
+                return False
+            task.started_at = time.time()
+            rec.version += 1
+            return True
+
     def heartbeat(self, worker_id: str) -> None:
         now = time.time()
         with self._engine.locked(f"{{{self._name}}}:tasks"):

@@ -11,18 +11,27 @@ partition folds value lists; optional CollatorTask folds the result map
 TPU-first redesign (BASELINE north star): the reference's per-emit Redis
 write is the hot loop; here
   * the host path batches emissions into in-memory partition buffers (one
-    lock touch per mapper chunk, not per emit), and
-  * the kernel path (`KernelMapReduce`) compiles map+reduce into one jitted
-    program over packed arrays — `vmap`'d map, `segment_sum/min/max` shuffle
-    — for workloads expressible as array ops (SURVEY.md §7.3 item 6's
-    "vmap-able kernel API with a host-executor fallback").
+    lock touch per mapper chunk, not per emit),
+  * the DISTRIBUTED path ships mapper chunks and reducer partitions as
+    executor tasks claimable by WorkerNode OS processes (the GIL makes
+    in-process "mapper threads" fiction — the reference's worker-JVM model,
+    ``executor/TasksRunnerService.java:192-318``, is the right shape), and
+  * the kernel path (`KernelMapReduce`, `word_count` device pipeline)
+    compiles map+shuffle+reduce into jitted programs over packed arrays
+    (SURVEY.md §7.3 item 6's "vmap-able kernel API with a host-executor
+    fallback").
 """
 from __future__ import annotations
 
+import pickle
+import re
 import threading
-from collections import defaultdict
+import time
+import uuid
+from collections import Counter, defaultdict
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+from redisson_tpu.services.executor import inject_client
 from redisson_tpu.utils import hashing as H
 
 import numpy as np
@@ -43,12 +52,128 @@ class Collector:
         self._parts[int(h1[0]) % self._n][key].append(value)
 
 
+def _part_name(job: str, chunk_idx: int, pi: int) -> str:
+    return f"mr:{job}:c{chunk_idx}:p{pi}"
+
+
+def _mr_map_task(map_name, keys, mapper, n_parts, job, chunk_idx, codec, *, client):
+    """Mapper chunk task (MapperTask.java:50-78 analog): read the chunk in
+    ONE batched call, run the user mapper into an in-memory Collector, flush
+    each partition buffer with ONE bulk multimap merge (vs the reference's
+    per-emit write).
+
+    Partition names are CHUNK-scoped and each flush starts by deleting the
+    chunk's previous output, so a re-run (orphan requeue, retry, or a
+    slow-but-alive worker racing its own requeued clone) REPLACES rather
+    than appends — duplicate emissions cannot reach the reducers.  `codec`
+    is the source map's codec: the worker must encode lookup keys exactly
+    as the writer did, or get_all matches nothing."""
+    from redisson_tpu.client.codec import PickleCodec
+
+    source = client.get_map(map_name, codec=codec)
+    entries = source.get_all(keys)
+    c = Collector(n_parts)
+    for k, v in entries.items():
+        mapper(k, v, c)
+    for pi, pmap in enumerate(c._parts):
+        mm = client.get_list_multimap(_part_name(job, chunk_idx, pi), codec=PickleCodec())
+        mm.delete()  # idempotence: wipe any partial flush from a prior run
+        if pmap:
+            mm.put_all_entries(dict(pmap))
+    return len(entries)
+
+
+def _mr_reduce_task(job, pi, n_chunks, reducer, result_name, result_codec, *, client):
+    """Reducer partition task (ReducerTask.java analog): fold each key's
+    value list across every mapper chunk's partition output, optionally
+    write into the named result map, return the reduced dict so the
+    coordinator can merge without re-reading.
+
+    IDEMPOTENT: reads only — a requeued re-run (worker died mid-fold) sees
+    every chunk again and the result-map write is a full overwrite of this
+    partition's keys.  Partition cleanup belongs to the COORDINATOR
+    (_mr_cleanup_task in its finally), never to the reducer: deleting as we
+    read would make a re-run silently undercount the already-consumed
+    chunks."""
+    from redisson_tpu.client.codec import PickleCodec
+
+    grouped: Dict[Any, List[Any]] = defaultdict(list)
+    for ci in range(n_chunks):
+        mm = client.get_list_multimap(_part_name(job, ci, pi), codec=PickleCodec())
+        for k, v in mm.entries():
+            grouped[k].append(v)
+    out = {k: reducer(k, vals) for k, vals in grouped.items()}
+    if result_name and out:
+        client.get_map(result_name, codec=result_codec).put_all(out)
+    return out
+
+
+def _wc_chunk_task(map_name, keys, codec, *, client):
+    """word_count mapper chunk: one batched read + the shared C-speed
+    Counter pass.  Returns the chunk's {word: count} dict (small —
+    vocabulary-sized).  Idempotent by construction: no grid writes."""
+    vals = client.get_map(map_name, codec=codec).get_all(keys)
+    return _host_word_count([str(v) for v in vals.values()])
+
+
+def _mr_cleanup_task(job, n_chunks, n_parts, *, client):
+    """Best-effort partition reaper for failed/abandoned jobs."""
+    from redisson_tpu.client.codec import PickleCodec
+
+    n = 0
+    for ci in range(n_chunks):
+        for pi in range(n_parts):
+            try:
+                if client.get_list_multimap(
+                    _part_name(job, ci, pi), codec=PickleCodec()
+                ).delete():
+                    n += 1
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+    return n
+
+
+# grid-aware tasks get the worker's client injected (the @RInject analog;
+# WorkerNode._run_one and ExecutorService._run_task both honor the marker)
+_mr_map_task = inject_client(_mr_map_task)
+_mr_reduce_task = inject_client(_mr_reduce_task)
+_mr_cleanup_task = inject_client(_mr_cleanup_task)
+_wc_chunk_task = inject_client(_wc_chunk_task)
+
+
+def _await_payload_task(executor, task_id: str, timeout: float):
+    """Cross-process task wait that works for local ExecutorService handles
+    AND wire proxies: poll task_state (cheap), fetch the result when done.
+    Results submitted via submit_payload come back as pickled bytes from
+    remote workers but as live objects from in-process worker threads —
+    normalize both."""
+    deadline = time.time() + timeout
+    while True:
+        state = executor.task_state(task_id)
+        if state in ("finished", "failed", "cancelled"):
+            raw = executor.await_task_result(task_id, 5.0)
+            if isinstance(raw, (bytes, bytearray, memoryview)):
+                return pickle.loads(bytes(raw))  # noqa: S301 — coordinator's own task
+            return raw
+        if state is None:
+            raise KeyError(f"unknown task {task_id}")
+        if time.time() > deadline:
+            raise TimeoutError(f"task {task_id} not finished within {timeout}s")
+        time.sleep(0.02)
+
+
 class MapReduce:
     """Generic map-reduce over a Map or collection handle.
 
     mapper(key, value, collector)           — RMapper.map analog
     reducer(key, values) -> value           — RReducer.reduce analog
     collator(result_dict) -> Any (optional) — RCollator analog
+
+    With `executor=` an ExecutorService handle (local or wire proxy), mapper
+    chunks and reducer partitions ship as claimable tasks run by WorkerNode
+    processes / registered workers (CoordinatorTask.java:77-136); without
+    one, the in-process thread path runs (useful for small jobs and tests).
+    mapper/reducer/collator must then be module-level picklable callables.
     """
 
     def __init__(
@@ -83,6 +208,8 @@ class MapReduce:
         """Run the full pipeline; returns the reduced dict (or the collator
         output if a collator was set).  Writes into `result_map` if given
         (the reference's execute(resultMapName))."""
+        if self._executor is not None:
+            return self._execute_distributed(source, result_map)
         entries = self._entries(source)
         n_parts = self._workers
         chunk = max(1, (len(entries) + self._workers - 1) // self._workers)
@@ -140,6 +267,75 @@ class MapReduce:
             return self._collator(result)
         return result
 
+    def _execute_distributed(self, source, result_map=None):
+        """Coordinator for the worker-process path (CoordinatorTask.java:
+        77-136): mapper chunks fan out as executor tasks, then one reducer
+        task per partition; every task is claim-fenced and orphan-requeued
+        by the executor machinery, so a worker dying mid-chunk re-runs on a
+        survivor (TasksService re-scheduling)."""
+        ex = self._executor
+        name = getattr(source, "_name", None)
+        if name is None:
+            raise TypeError("distributed MapReduce needs a named Map handle")
+        codec = getattr(source, "_codec", None)
+        keys = source.read_all_keys()
+        job = uuid.uuid4().hex[:12]
+        n_parts = self._workers
+        timeout = self._timeout or 120.0
+        chunk = max(1, (len(keys) + self._workers - 1) // self._workers)
+        chunks = [keys[i : i + chunk] for i in range(0, len(keys), chunk)]
+        try:
+            tids = [
+                ex.submit_payload(
+                    pickle.dumps(
+                        (_mr_map_task, (name, ck, self._mapper, n_parts, job, ci, codec), {}),
+                        protocol=pickle.HIGHEST_PROTOCOL,
+                    )
+                )
+                for ci, ck in enumerate(chunks)
+            ]
+            for tid in tids:
+                _await_payload_task(ex, tid, timeout)
+            result_name = getattr(result_map, "_name", None)
+            result_codec = getattr(result_map, "_codec", None)
+            rtids = [
+                ex.submit_payload(
+                    pickle.dumps(
+                        (
+                            _mr_reduce_task,
+                            (job, pi, len(chunks), self._reducer, result_name, result_codec),
+                            {},
+                        ),
+                        protocol=pickle.HIGHEST_PROTOCOL,
+                    )
+                )
+                for pi in range(n_parts)
+            ]
+            result: Dict[Any, Any] = {}
+            for tid in rtids:
+                result.update(_await_payload_task(ex, tid, timeout))
+        finally:
+            # reap every partition multimap — on success (reducers only READ,
+            # for re-run idempotence) and on failure/abandonment alike.
+            # Cleanup rides the executor so it works from any coordinator —
+            # local handle or wire proxy.  Residual race (documented): a
+            # stale mapper clone that flushes AFTER this cleanup re-creates
+            # its chunk's partitions; closing that needs job-epoch fencing
+            # on data-plane writes, which the executor's claim fencing does
+            # not cover.
+            try:
+                ex.submit_payload(
+                    pickle.dumps(
+                        (_mr_cleanup_task, (job, len(chunks), n_parts), {}),
+                        protocol=pickle.HIGHEST_PROTOCOL,
+                    )
+                )
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+        if self._collator is not None:
+            return self._collator(result)
+        return result
+
 
 class KernelMapReduce:
     """Array-native map-reduce compiled to one jitted program.
@@ -175,30 +371,139 @@ class KernelMapReduce:
         return np.asarray(self._jitted(values))
 
 
-def word_count(engine, source_map, workers: int = 4) -> Dict[str, int]:
+# every ASCII codepoint str.isspace() considers whitespace (str.split's
+# separator set): \t\n\x0b\x0c\r plus the \x1c-\x1f file/group/record/unit
+# separators — miss one and the device path diverges from str.split()
+_WS_TRANSLATE = bytes.maketrans(b"\t\n\x0b\x0c\r\x1c\x1d\x1e\x1f", b" " * 9)
+
+# any whitespace OUTSIDE that ASCII set (NBSP, ideographic space, \x85, ...)
+_UNICODE_WS_RE = re.compile(r"[^\S \t\n\x0b\x0c\r\x1c\x1d\x1e\x1f]")
+
+
+def _host_word_count(vals: List[str]) -> Dict[str, int]:
+    """Single-pass C-speed fallback: per-value split + Counter.update (both
+    C loops).  Measured 2026-07: ~0.67M entries/s on one core — the r2
+    '64 mapper threads' variant ran 4x SLOWER than this (GIL thrash)."""
+    c: Counter = Counter()
+    for v in vals:
+        c.update(v.split())
+    return dict(c)
+
+
+def device_word_count(vals: List[str], d_max_bits: int = 17, n_chunks: int = 2) -> Dict[str, int]:
+    """Word-count compiled to the device (kernels.wc_extract_words +
+    wc_sort_runs; design history in that module's header).
+
+    Host does only C-speed passes: join values into one byte buffer,
+    normalize whitespace (bytes.translate), find word-end positions with two
+    vectorized comparisons; the device tokenizes/hashes via scans+gathers
+    and counts via sorts.  Chunking overlaps host prep of chunk i+1 with
+    device compute of chunk i (uploads are staged asynchronously).
+    Falls back to the host path when the distinct-word count exceeds
+    2**d_max_bits."""
+    import jax
+    import jax.numpy as jnp
+
+    from redisson_tpu.core import kernels as K
+
+    if not vals:
+        return {}
+    d_max = 1 << d_max_bits
+    csize = max(1, (len(vals) + n_chunks - 1) // n_chunks)
+    blobs: List[bytes] = []
+    padded: List[int] = []
+    nw = 0
+    parts = []
+    base = 0
+    for ci in range(0, len(vals), csize):
+        joined = " ".join(vals[ci : ci + csize]) + " "
+        # ASCII whitespace (incl. \x1c-\x1f) is normalized by _WS_TRANSLATE;
+        # non-ASCII text may carry Unicode whitespace (NBSP, \x85, ...) the
+        # byte kernel cannot see — diverging from str.split() silently is
+        # worse than falling back (isascii() keeps the common case O(1)-ish)
+        if not joined.isascii() and _UNICODE_WS_RE.search(joined):
+            return _host_word_count(vals)
+        big = joined.encode().translate(_WS_TRANSLATE)
+        b = K.bucket_size(len(big))
+        buf = np.full(b, 32, np.uint8)
+        buf[: len(big)] = np.frombuffer(big, np.uint8)
+        ws = buf == 32
+        ends = np.flatnonzero(~ws[:-1] & ws[1:])
+        deltas = np.diff(ends + 1, prepend=0)
+        if len(deltas) and deltas.max() >= 65536:
+            # a >=64KB whitespace run or token: delta encoding can't carry
+            # it; this shape is pathological for the kernel anyway
+            return _host_word_count(vals)
+        eb = K.bucket_size(max(1, len(ends)))
+        deltas_p = np.zeros(eb, np.uint16)
+        deltas_p[: len(ends)] = deltas.astype(np.uint16)
+        parts.append(
+            K.wc_extract_words(
+                K.stage(buf), K.stage(deltas_p), K.valid_n(len(ends)), jnp.uint32(base)
+            )
+        )
+        blobs.append(big)
+        padded.append(b)
+        nw += len(ends)
+        base += b
+    ha = jnp.concatenate([p[0] for p in parts])
+    hb = jnp.concatenate([p[1] for p in parts])
+    st = jnp.concatenate([p[2] for p in parts])
+    fp, off = K.wc_sort_runs(ha, hb, st, d_max)
+    fp = np.asarray(fp)
+    off = np.asarray(off)
+    # padding ends carry sentinel hashes that sort AFTER every real word,
+    # so positions [0, nw) of the sorted array are the real words
+    finite = fp < nw
+    if bool(finite[-1]):
+        # every fp row is a real run start: distinct words exceed d_max
+        return _host_word_count(vals)
+    fps = fp[finite]
+    counts = np.diff(np.concatenate([fps, [nw]]))
+    out: Dict[str, int] = {}
+    bounds = np.cumsum([0] + padded)
+    for o, c in zip(off[finite], counts):
+        ci = int(np.searchsorted(bounds, o, side="right")) - 1
+        local = int(o - bounds[ci])
+        bg = blobs[ci]
+        end = local
+        while end < len(bg) and bg[end] != 32:
+            end += 1
+        out[bg[local:end].decode(errors="replace")] = int(c)
+    return out
+
+
+def word_count(
+    source_map, workers: int = 4, executor=None, timeout: float = 120.0
+) -> Dict[str, int]:
     """The canonical example (and BASELINE config 4 workload): count words
-    across all values of a map.  Uses a C-speed per-chunk Counter with a
-    single merge — the batched re-expression of mapper-emit/reducer-sum."""
-    from collections import Counter
+    across all values of a map.
 
-    entries = source_map.read_all_entry_set()
-    chunk = max(1, (len(entries) + workers - 1) // workers)
-    counters: List[Counter] = []
-    threads = []
-
-    def run(chunk_entries):
-        c = Counter()
-        for _, v in chunk_entries:
-            c.update(str(v).split())
-        counters.append(c)
-
-    for i in range(0, len(entries), chunk):
-        t = threading.Thread(target=run, args=(entries[i : i + chunk],))
-        t.start()
-        threads.append(t)
-    for t in threads:
-        t.join()
-    total = Counter()
-    for c in counters:
-        total.update(c)
-    return dict(total)
+    Three paths, fastest applicable first:
+      * `executor=` given — mapper chunks ship to WorkerNode processes (the
+        reference's worker-JVM model; escapes the coordinator's GIL);
+      * device — the wc_* kernel pipeline (sorts/scans/gathers on chip);
+      * host — single-pass C Counter fallback.
+    """
+    if executor is not None:
+        keys = source_map.read_all_keys()
+        codec = getattr(source_map, "_codec", None)
+        chunk = max(1, (len(keys) + workers - 1) // workers)
+        tids = [
+            executor.submit_payload(
+                pickle.dumps(
+                    (_wc_chunk_task, (source_map._name, keys[i : i + chunk], codec), {}),
+                    protocol=pickle.HIGHEST_PROTOCOL,
+                )
+            )
+            for i in range(0, len(keys), chunk)
+        ]
+        total: Counter = Counter()
+        for tid in tids:
+            total.update(_await_payload_task(executor, tid, timeout))
+        return dict(total)
+    vals = [str(v) for v in source_map.read_all_values()]
+    try:
+        return device_word_count(vals)
+    except Exception:  # noqa: BLE001 — device unavailable/edge shapes: host path
+        return _host_word_count(vals)

@@ -1,0 +1,20 @@
+"""Module-level task/mapper functions for distributed MapReduce tests.
+
+Worker subprocesses unpickle tasks by module reference, so these must live
+in an importable module (the classBody-shipping analog: the code identity
+crosses the wire, TasksRunnerService.java:192-318)."""
+import time
+
+
+def wc_mapper(key, value, collector):
+    for w in str(value).split():
+        collector.emit(w, 1)
+
+
+def wc_reducer(key, values):
+    return sum(values)
+
+
+def slow_echo(tag, delay=1.5):
+    time.sleep(delay)
+    return f"done-{tag}"

@@ -1,0 +1,263 @@
+"""Distributed MapReduce: mapper/reducer tasks executed by WorkerNode OS
+processes with claim fencing and killed-worker requeue (VERDICT r2 #2;
+reference: mapreduce/CoordinatorTask.java:77-136, MapperTask.java:50-78,
+executor/TasksRunnerService.java:192-318)."""
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.server.server import ServerThread
+from redisson_tpu.services.mapreduce import MapReduce, word_count
+
+from tests import _mr_tasks
+
+
+def _spawn_worker(address: str, workers: int = 1, executors: str = "redisson_executor"):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PYTHONPATH", "")
+    env["PYTHONPATH"] = "/root/repo" + (os.pathsep + env["PYTHONPATH"] if env["PYTHONPATH"] else "")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "redisson_tpu.node",
+            "--address", address,
+            "--workers", str(workers),
+            "--executors", executors,
+            "--poll-interval", "0.05",
+        ],
+        env=env,
+        cwd="/root/repo",
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+
+def _wait_active_workers(client, executor: str, n: int, timeout: float = 60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        active = client.objcall(
+            "get_executor_service", executor, "count_active_workers", (), {}
+        )
+        if active >= n:
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"never saw {n} active workers on {executor!r}")
+
+
+class _ExecutorProxy:
+    """Thin wire adapter exposing the ExecutorService coordinator surface."""
+
+    def __init__(self, client, name: str):
+        self._client = client
+        self._name = name
+
+    def submit_payload(self, payload: bytes) -> str:
+        return self._client.objcall(
+            "get_executor_service", self._name, "submit_payload", (payload,), {}
+        )
+
+    def task_state(self, task_id: str):
+        return self._client.objcall(
+            "get_executor_service", self._name, "task_state", (task_id,), {}
+        )
+
+    def await_task_result(self, task_id: str, timeout: float):
+        return self._client.objcall(
+            "get_executor_service", self._name, "await_task_result", (task_id, timeout), {}
+        )
+
+    def requeue_orphans(self, max_running_age: float) -> int:
+        return self._client.objcall(
+            "get_executor_service", self._name, "requeue_orphans", (max_running_age,), {}
+        )
+
+
+@pytest.fixture()
+def grid2():
+    """Server + TWO worker OS processes (1 worker thread each)."""
+    with ServerThread(port=0) as st:
+        procs = [_spawn_worker(st.address), _spawn_worker(st.address)]
+        client = RemoteRedisson(st.address, timeout=60.0)
+        try:
+            _wait_active_workers(client, "redisson_executor", 2)
+            yield st, procs, client
+        finally:
+            client.shutdown()
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+                    p.wait(timeout=10)
+
+
+def _claimants(st, executor: str):
+    rec = st.server.engine.store.get(f"{{{executor}}}:tasks")
+    if rec is None:
+        return {}
+    return {
+        tid: t.claimed_by
+        for tid, t in rec.host["tasks"].items()
+        if t.claimed_by is not None
+    }
+
+
+def test_mapreduce_runs_in_multiple_worker_processes(grid2):
+    st, procs, client = grid2
+    m = client.get_map("mr:src")
+    m.put_all({f"k{i}": "alpha beta " + ("gamma " if i % 2 else "") for i in range(60)})
+    ex = _ExecutorProxy(client, "redisson_executor")
+    mr = MapReduce(
+        None, _mr_tasks.wc_mapper, _mr_tasks.wc_reducer, workers=6, executor=ex
+    )
+    result = mr.execute(m)
+    assert result["alpha"] == 60
+    assert result["beta"] == 60
+    assert result["gamma"] == 30
+    # the mapper/reducer tasks really ran in >=2 distinct worker PROCESSES:
+    # worker ids are "<node_id>:<wid>" and each subprocess has its own node_id
+    nodes = {w.split(":")[0] for w in _claimants(st, "redisson_executor").values()}
+    assert len(nodes) >= 2, f"tasks ran in only {nodes}"
+
+
+def test_mapreduce_result_map_and_collator(grid2):
+    st, procs, client = grid2
+    m = client.get_map("mr:src2")
+    m.put_all({f"k{i}": "x y" for i in range(20)})
+    out_map = client.get_map("mr:out")
+    ex = _ExecutorProxy(client, "redisson_executor")
+    mr = MapReduce(
+        None,
+        _mr_tasks.wc_mapper,
+        _mr_tasks.wc_reducer,
+        collator=lambda d: sum(d.values()),
+        workers=3,
+        executor=ex,
+    )
+    # collator is applied coordinator-side; result map is written by reducers
+    total = mr.execute(m, result_map=out_map)
+    assert total == 40
+    assert out_map.get("x") == 20 and out_map.get("y") == 20
+
+
+def test_distributed_word_count(grid2):
+    st, procs, client = grid2
+    m = client.get_map("mr:wc")
+    m.put_all({f"d{i}": "foo bar foo" for i in range(50)})
+    ex = _ExecutorProxy(client, "redisson_executor")
+    counts = word_count(m, workers=4, executor=ex)
+    assert counts == {"foo": 100, "bar": 50}
+
+
+def test_killed_worker_mid_task_requeues_to_survivor(grid2):
+    """Chaos criterion: SIGKILL a worker process holding a claimed task; the
+    orphan sweep requeues it and the surviving process completes it."""
+    st, procs, client = grid2
+    ex = _ExecutorProxy(client, "redisson_executor")
+    # two slow tasks -> with 1 worker thread per process, each process claims one
+    payloads = [
+        pickle.dumps((_mr_tasks.slow_echo, (tag, 3.0), {}))
+        for tag in ("a", "b")
+    ]
+    tids = [ex.submit_payload(p) for p in payloads]
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if len(_claimants(st, "redisson_executor")) >= 2:
+            break
+        time.sleep(0.05)
+    claim_map = _claimants(st, "redisson_executor")
+    assert len(claim_map) >= 2
+    # kill one worker process outright (cpu-only subprocess: SIGKILL is safe)
+    procs[0].send_signal(signal.SIGKILL)
+    procs[0].wait(timeout=10)
+    time.sleep(0.3)
+    requeued = ex.requeue_orphans(0.1)
+    assert requeued >= 1, "dead worker's claim did not requeue"
+    # survivor finishes BOTH tasks (its own + the requeued orphan)
+    results = set()
+    for tid in tids:
+        state = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            state = ex.task_state(tid)
+            if state == "finished":
+                break
+            if state == "queued":  # claimed by dead worker again? sweep more
+                ex.requeue_orphans(0.1)
+            time.sleep(0.1)
+        assert state == "finished", f"task {tid} stuck in {state}"
+        results.add(pickle.loads(bytes(ex.await_task_result(tid, 5.0))))
+    assert results == {"done-a", "done-b"}
+
+
+def test_mapper_rerun_does_not_duplicate_emissions():
+    """Idempotence: a mapper chunk that runs TWICE (orphan requeue / slow
+    worker racing its requeued clone) must not double partition emissions —
+    chunk-scoped partition names are wiped before each flush."""
+    import redisson_tpu
+    from redisson_tpu.services.mapreduce import (
+        _mr_map_task,
+        _mr_reduce_task,
+    )
+
+    client = redisson_tpu.create()
+    try:
+        m = client.get_map("mr:rerun")
+        m.put_all({f"k{i}": "dup words dup" for i in range(10)})
+        keys = m.read_all_keys()
+        # run the SAME mapper chunk twice, as a requeue would
+        for _ in range(2):
+            _mr_map_task(
+                "mr:rerun", keys, _mr_tasks.wc_mapper, 2, "jobX", 0, None,
+                client=client,
+            )
+        out = {}
+        for pi in range(2):
+            out.update(
+                _mr_reduce_task("jobX", pi, 1, _mr_tasks.wc_reducer, None, None, client=client)
+            )
+        assert out == {"dup": 20, "words": 10}
+    finally:
+        client.shutdown()
+
+
+def test_distributed_wordcount_respects_source_codec():
+    """The codec travels with the task: a StringCodec map read by a worker
+    whose client defaults to JsonCodec must still match keys/values."""
+    import redisson_tpu
+    from redisson_tpu.client.codec import StringCodec
+
+    client = redisson_tpu.create()
+    try:
+        m = client.get_map("mr:codec", codec=StringCodec())
+        m.put_all({f"k{i}": "abc def" for i in range(8)})
+        ex = client.get_executor_service("mr_codec_exec")
+        ex.register_workers(2)
+        counts = word_count(m, workers=3, executor=ex)
+        assert counts == {"abc": 8, "def": 8}
+    finally:
+        client.shutdown()
+
+
+def test_remote_handle_codec_rides_the_wire(grid2):
+    """getMap(name, codec) over the wire: the codec travels in the OBJCALL
+    frame, so a StringCodec map written remotely is byte-identical to one
+    written by a colocated client with the same codec."""
+    from redisson_tpu.client.codec import StringCodec
+
+    st, procs, client = grid2
+    m = client.get_map("codec:wire", StringCodec())
+    m.put("k1", "plain string")
+    assert m.get("k1") == "plain string"
+    # server-side record holds RAW string bytes (no JSON quoting)
+    rec = st.server.engine.store.get("codec:wire")
+    assert b"plain string" in set(rec.host.values())
+    # distributed word_count over the wire honors the codec end to end
+    m.put_all({f"d{i}": "w1 w2" for i in range(10)})
+    ex = _ExecutorProxy(client, "redisson_executor")
+    counts = word_count(m, workers=2, executor=ex)
+    assert counts["w1"] == 10 and counts["w2"] == 10

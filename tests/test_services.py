@@ -289,7 +289,7 @@ class TestMapReduce:
 
         m = client.get_map("src")
         m.put_all({i: "tick tock tick" for i in range(100)})
-        counts = word_count(client.engine, m, workers=8)
+        counts = word_count(m, workers=8)
         assert counts == {"tick": 200, "tock": 100}
 
     def test_kernel_mapreduce(self, client):

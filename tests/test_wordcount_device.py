@@ -1,0 +1,89 @@
+"""Device word-count pipeline (kernels.wc_extract_words / wc_sort_runs):
+correctness vs the host Counter reference across edge shapes."""
+import numpy as np
+import pytest
+
+from redisson_tpu.services.mapreduce import (
+    _host_word_count,
+    device_word_count,
+    word_count,
+)
+
+
+def _ref(vals):
+    from collections import Counter
+
+    c = Counter()
+    for v in vals:
+        c.update(v.split())
+    return dict(c)
+
+
+@pytest.mark.parametrize(
+    "vals",
+    [
+        ["foo bar foo", "baz foo bar"],
+        ["single"],
+        ["  leading and  double   spaces ", "trailing spaces  "],
+        ["tabs\tand\nnewlines\r\nmixed", "v\x0bv\x0cw"],
+        ["", "", "only third has words"],
+        ["a b c d e f g h i j" * 3],
+        ["répé unicode répé", "naïve café"],
+    ],
+)
+def test_device_word_count_matches_host(vals):
+    assert device_word_count(vals) == _ref(vals)
+
+
+def test_device_word_count_long_words_and_chunking():
+    long_word = "x" * 200
+    vals = [f"{long_word} short {long_word}", "short " + "y" * 80]
+    out = device_word_count(vals, n_chunks=2)
+    assert out[long_word] == 2
+    assert out["short"] == 2
+    assert out["y" * 80] == 1
+
+
+def test_device_word_count_d_max_fallback():
+    # 3000 distinct words with d_max=2^8 -> table overflow -> host fallback
+    vals = [" ".join(f"w{i}" for i in range(j, j + 50)) for j in range(0, 3000, 50)]
+    out = device_word_count(vals, d_max_bits=8)
+    assert out == _ref(vals)
+
+
+def test_device_word_count_large_random_corpus():
+    rng = np.random.default_rng(9)
+    vocab = [f"word{i}" for i in range(500)]
+    vals = [
+        " ".join(vocab[j] for j in rng.integers(0, 500, 12)) for i in range(5000)
+    ]
+    assert device_word_count(vals) == _ref(vals)
+
+
+def test_word_count_facade_local_paths():
+    import redisson_tpu
+    from redisson_tpu.client.codec import StringCodec
+
+    client = redisson_tpu.create()
+    try:
+        m = client.get_map("wc:facade", codec=StringCodec())
+        m.put_all({f"d{i}": "alpha beta alpha" for i in range(100)})
+        counts = word_count(m)
+        assert counts == {"alpha": 200, "beta": 100}
+        assert _host_word_count(["alpha beta alpha"] * 100) == {"alpha": 200, "beta": 100}
+    finally:
+        client.shutdown()
+
+
+def test_device_word_count_unicode_whitespace_falls_back_consistently():
+    """NBSP and ideographic space are str.split() separators; the byte
+    kernel must not silently diverge — it falls back to the host path."""
+    vals = ["a b c", "x　y"]
+    assert device_word_count(vals) == _ref(vals)
+
+
+def test_device_word_count_ascii_control_whitespace():
+    """\\x1c-\\x1f are str.split() separators (str.isspace() is true for
+    them); the byte kernel must treat them identically (reviewer repro)."""
+    vals = ["alpha\x1cbeta", "alpha beta", "g\x1dh\x1ei\x1fj"]
+    assert device_word_count(vals) == _ref(vals)

@@ -107,3 +107,41 @@ def test_stale_claimant_cannot_ack_reclaimed_task():
         assert pickle.loads(ex.await_task_result(tid, timeout=5)) == 16
     finally:
         client.shutdown()
+
+
+def slow_square(x, delay=1.2):
+    time.sleep(delay)
+    return x * x
+
+
+def test_claim_renewal_keeps_slow_tasks_alive():
+    """A task slower than the orphan window must NOT be voided while its
+    worker is alive: the worker's renewal ticker bumps started_at, so
+    requeue_orphans sees a live claim (visibility renewal,
+    TasksRunnerService.java:192-318)."""
+    with ServerThread(port=0) as st:
+        node = WorkerNode(st.address, workers=1, poll_interval=0.05, orphan_age=0.3)
+        node.start()
+        client = RemoteRedisson(st.address, timeout=60.0)
+        try:
+            tid = _submit(client, slow_square, 7)
+            # sweep aggressively with a window much smaller than the task
+            requeued = 0
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                state = client.objcall(
+                    "get_executor_service", "redisson_executor", "task_state", (tid,), {}
+                )
+                if state == "finished":
+                    break
+                if state == "running":
+                    requeued += client.objcall(
+                        "get_executor_service", "redisson_executor",
+                        "requeue_orphans", (0.3,), {},
+                    )
+                time.sleep(0.1)
+            assert _await(client, tid) == 49
+            assert requeued == 0, "live worker's claim was voided mid-run"
+        finally:
+            client.shutdown()
+            node.stop()
