@@ -127,11 +127,6 @@ class ClusterRedisson(RemoteSurface):
         csc = config.use_cluster_servers()
         if not csc.node_addresses:
             raise ValueError("cluster_servers_config.node_addresses is empty")
-        if csc.username:
-            raise ValueError(
-                "ACL usernames are not supported (password-only AUTH); unset "
-                "cluster_servers_config.username"
-            )
         modes = {
             "MASTER": READ_MASTER,
             "SLAVE": READ_REPLICA,
@@ -151,6 +146,8 @@ class ClusterRedisson(RemoteSurface):
             scan_interval=csc.scan_interval,
             dns_monitoring_interval=getattr(csc, "dns_monitoring_interval", 5.0),
             password=csc.password,
+            username=csc.username,
+            ssl_context=csc.build_ssl_context(),
             client_name=csc.client_name,
             pool_size=csc.connection_pool_size,
             timeout=csc.timeout,

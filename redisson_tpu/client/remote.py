@@ -782,6 +782,7 @@ class RemoteRedisson(RemoteSurface):
         if ssc is not None:
             kw.update(
                 password=ssc.password,
+                username=ssc.username,
                 client_name=ssc.client_name,
                 pool_size=ssc.connection_pool_size,
                 min_idle=ssc.connection_minimum_idle_size,
@@ -790,6 +791,7 @@ class RemoteRedisson(RemoteSurface):
                 retry_attempts=ssc.retry_attempts,
                 retry_interval=ssc.retry_interval,
                 ping_interval=ssc.ping_connection_interval,
+                ssl_context=ssc.build_ssl_context(),
             )
         kw.update(node_kw)
         self.node = NodeClient(address, **kw)

@@ -60,6 +60,23 @@ class SingleServerConfig:
     connection_pool_size: int = 8            # reference default 64 (JVM); net thread count here
     connection_minimum_idle_size: int = 1
     subscription_connection_pool_size: int = 2
+    # TLS (BaseConfig SSL knobs; active for tpus://-scheme addresses or
+    # whenever a CA/cert is configured — RedisChannelInitializer.java:110-219)
+    ssl_ca_file: Optional[str] = None               # sslTruststore analog
+    ssl_cert_file: Optional[str] = None             # sslKeystore (client cert)
+    ssl_key_file: Optional[str] = None
+    ssl_verify_hostname: bool = True                # sslEnableEndpointIdentification
+
+    def build_ssl_context(self):
+        """SSLContext when TLS applies (scheme or explicit knobs), else None."""
+        from redisson_tpu.net.client import address_uses_tls, client_ssl_context
+
+        if not (address_uses_tls(self.address) or self.ssl_ca_file or self.ssl_cert_file):
+            return None
+        return client_ssl_context(
+            self.ssl_ca_file, self.ssl_cert_file, self.ssl_key_file,
+            self.ssl_verify_hostname,
+        )
 
 
 @dataclass
@@ -79,6 +96,24 @@ class ClusterServersConfig:
     connection_pool_size: int = 8
     read_mode: str = "MASTER"                # MASTER | SLAVE | MASTER_SLAVE
     dns_monitoring_interval: float = 5.0     # dnsMonitoringInterval; <=0 disables
+    # TLS (see SingleServerConfig).  Hostname verification defaults ON like
+    # the reference's sslEnableEndpointIdentification — IP-addressed nodes
+    # need IP SANs in their certs or an explicit opt-out, never a silent one
+    ssl_ca_file: Optional[str] = None
+    ssl_cert_file: Optional[str] = None
+    ssl_key_file: Optional[str] = None
+    ssl_verify_hostname: bool = True
+
+    def build_ssl_context(self):
+        from redisson_tpu.net.client import address_uses_tls, client_ssl_context
+
+        tls = any(address_uses_tls(a) for a in self.node_addresses)
+        if not (tls or self.ssl_ca_file or self.ssl_cert_file):
+            return None
+        return client_ssl_context(
+            self.ssl_ca_file, self.ssl_cert_file, self.ssl_key_file,
+            self.ssl_verify_hostname,
+        )
 
 
 @dataclass

@@ -13,11 +13,14 @@ Parity targets (SURVEY.md §2.1-2.2):
     PingConnectionHandler.java:60-104: execute() with retry/backoff
     reconnect, periodic ping, failure-detector feed.
 
-Addresses are "tpu://host:port" (RedisURI analog).
+Addresses are "tpu://host:port" (RedisURI analog); "tpus://" (and
+"rediss://") selects TLS, mirroring the reference's scheme-driven SSL
+(client/handler/RedisChannelInitializer.java:110-219).
 """
 from __future__ import annotations
 
 import socket
+import ssl as _ssl
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -29,13 +32,37 @@ from redisson_tpu.utils import metrics as _metrics
 
 
 def parse_address(addr: str) -> Tuple[str, int]:
-    """tpu://host:port (also accepts redis:// and bare host:port)."""
-    for prefix in ("tpu://", "redis://", "rediss://"):
+    """tpu://host:port (also accepts tpus://, redis://, rediss://, bare)."""
+    for prefix in ("tpus://", "tpu://", "rediss://", "redis://"):
         if addr.startswith(prefix):
             addr = addr[len(prefix) :]
             break
     host, _, port = addr.rpartition(":")
     return host or "127.0.0.1", int(port)
+
+
+def address_uses_tls(addr: str) -> bool:
+    return addr.startswith(("tpus://", "rediss://"))
+
+
+def client_ssl_context(
+    ca_file: Optional[str] = None,
+    cert_file: Optional[str] = None,
+    key_file: Optional[str] = None,
+    verify_hostname: bool = True,
+) -> _ssl.SSLContext:
+    """Client-side TLS context (BaseConfig SSL knobs analog): `ca_file`
+    pins the trust root (self-signed deployments), `cert_file`/`key_file`
+    present a client certificate (mTLS), `verify_hostname=False` mirrors
+    sslEnableEndpointIdentification=false for nodes addressed by IP."""
+    ctx = _ssl.create_default_context(
+        cafile=ca_file
+    ) if ca_file else _ssl.create_default_context()
+    if cert_file:
+        ctx.load_cert_chain(cert_file, key_file)
+    if not verify_hostname:
+        ctx.check_hostname = False
+    return ctx
 
 
 class ConnectionError_(ConnectionError):
@@ -60,6 +87,9 @@ class Connection:
         timeout: float = 3.0,
         password: Optional[str] = None,
         client_name: Optional[str] = None,
+        username: Optional[str] = None,
+        ssl_context: Optional[_ssl.SSLContext] = None,
+        ssl_hostname: Optional[str] = None,
     ):
         self.host, self.port = host, port
         self.timeout = timeout
@@ -68,11 +98,20 @@ class Connection:
         self.push_handler: Optional[Callable[[Push], None]] = None
         self._sock = socket.create_connection((host, port), timeout=connect_timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if ssl_context is not None:
+            # TLS handshake before any byte of RESP (the SslHandler sits
+            # FIRST in the reference pipeline, RedisChannelInitializer)
+            self._sock = ssl_context.wrap_socket(
+                self._sock, server_hostname=ssl_hostname or host
+            )
         self._sock.settimeout(timeout)
         self.closed = False
-        # handshake (BaseConnectionHandler.java:59-122): AUTH, SETNAME, PING
+        # handshake (BaseConnectionHandler.java:59-122): AUTH [user], SETNAME
         if password is not None:
-            self._check(self.execute("AUTH", password))
+            if username is not None:
+                self._check(self.execute("AUTH", username, password))
+            else:
+                self._check(self.execute("AUTH", password))
         if client_name:
             self.execute("CLIENT", "SETNAME", client_name)
 
@@ -150,8 +189,19 @@ class PubSubConnection:
     """Dedicated subscription connection with a reader thread
     (RedisPubSubConnection.java + CommandPubSubDecoder routing)."""
 
-    def __init__(self, host: str, port: int, password: Optional[str] = None):
-        self._conn = Connection(host, port, password=password)
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        password: Optional[str] = None,
+        username: Optional[str] = None,
+        ssl_context: Optional[_ssl.SSLContext] = None,
+        ssl_hostname: Optional[str] = None,
+    ):
+        self._conn = Connection(
+            host, port, password=password, username=username,
+            ssl_context=ssl_context, ssl_hostname=ssl_hostname,
+        )
         self._listeners: Dict[str, List[Callable[[str, bytes], None]]] = {}
         self._plisteners: Dict[str, List[Callable[[str, str, bytes], None]]] = {}
         self._lock = threading.RLock()
@@ -377,10 +427,20 @@ class NodeClient:
         ping_interval: float = 30.0,
         detector: Optional[FailedNodeDetector] = None,
         hooks: Optional[List] = None,
+        username: Optional[str] = None,
+        ssl_context: Optional[_ssl.SSLContext] = None,
+        ssl_hostname: Optional[str] = None,
     ):
         self.address = address
         self.host, self.port = parse_address(address)
         self._password = password
+        self._username = username
+        # a tpus:// address with no explicit context gets the system default
+        # (scheme-driven SSL like the reference's rediss://)
+        if ssl_context is None and address_uses_tls(address):
+            ssl_context = client_ssl_context()
+        self._ssl_context = ssl_context
+        self._ssl_hostname = ssl_hostname
         self._client_name = client_name
         self.timeout = timeout
         self._connect_timeout = connect_timeout
@@ -408,7 +468,10 @@ class NodeClient:
                 connect_timeout=self._connect_timeout,
                 timeout=self.timeout,
                 password=self._password,
+                username=self._username,
                 client_name=self._client_name,
+                ssl_context=self._ssl_context,
+                ssl_hostname=self._ssl_hostname,
             )
         except OSError as e:
             self.detector.on_connect_failed()
@@ -493,7 +556,11 @@ class NodeClient:
     def pubsub(self) -> PubSubConnection:
         with self._pubsub_lock:
             if self._pubsub is None or self._pubsub._conn.closed:
-                fresh = PubSubConnection(self.host, self.port, password=self._password)
+                fresh = PubSubConnection(
+                    self.host, self.port, password=self._password,
+                    username=self._username, ssl_context=self._ssl_context,
+                    ssl_hostname=self._ssl_hostname,
+                )
                 if self._pubsub is not None:
                     # carry listeners over (watchdog pubsub re-attach)
                     fresh._listeners = self._pubsub._listeners

@@ -31,8 +31,11 @@ from redisson_tpu.net.client import NodeClient
 from redisson_tpu.utils.crc16 import MAX_SLOT
 
 
-def _admin(addr: str, password: Optional[str]) -> NodeClient:
-    return NodeClient(addr, password=password, ping_interval=0, retry_attempts=1)
+def _admin(addr: str, password: Optional[str], ssl_context=None) -> NodeClient:
+    return NodeClient(
+        addr, password=password, ping_interval=0, retry_attempts=1,
+        ssl_context=ssl_context,
+    )
 
 
 def migrate_slots(
@@ -41,6 +44,7 @@ def migrate_slots(
     slots: Sequence[int],
     all_nodes: Optional[Sequence[str]] = None,
     password: Optional[str] = None,
+    ssl_context=None,
 ) -> int:
     """Move `slots` from `source` to `target` while both serve traffic.
 
@@ -48,8 +52,8 @@ def migrate_slots(
     view; defaults to the masters named in the source's current view plus
     the target.  Returns the number of records moved.
     """
-    src = _admin(source, password)
-    tgt = _admin(target, password)
+    src = _admin(source, password, ssl_context)
+    tgt = _admin(target, password, ssl_context)
     moved = 0
     window_open = False
     old_view: List[Tuple[int, int, str, int, str]] = []
@@ -94,7 +98,7 @@ def migrate_slots(
         for addr in nodes:
             c = None
             try:
-                c = _admin(addr, password)
+                c = _admin(addr, password, ssl_context)
                 c.execute("CLUSTER", "SETVIEW", *flat, timeout=10.0)
             except Exception:  # noqa: BLE001 — down node learns on recovery/MOVED
                 pass

@@ -108,7 +108,9 @@ class CommandContext:
 
     def __init__(self, server):
         self.server = server
-        self.authenticated = server.password is None
+        # auth required when a default password OR any ACL user is set
+        self.authenticated = server.password is None and not getattr(server, "users", None)
+        self.username: Optional[str] = None
         self.name: Optional[str] = None
         self.subscriptions: Dict[str, int] = {}
         self.psubscriptions: Dict[str, int] = {}
@@ -189,9 +191,27 @@ def cmd_echo(server, ctx, args):
 
 @register("AUTH")
 def cmd_auth(server, ctx, args):
-    password = _s(args[-1])
-    if server.password is None or password == server.password:
+    """AUTH <password> | AUTH <username> <password> — the ACL form matches
+    the reference handshake (BaseConnectionHandler.java:59-122 sends
+    username+password when a username is configured).  "default" aliases
+    the server-level password, like Redis ACL's default user."""
+    if len(args) >= 2:
+        username, password = _s(args[-2]), _s(args[-1])
+    else:
+        username, password = "default", _s(args[-1])
+    if username == "default":
+        # with ACL users configured but NO default password, the default
+        # user is DISABLED — `AUTH anything` must not bypass the user gate
+        if server.password is not None:
+            ok = password == server.password
+        else:
+            ok = not server.users
+    else:
+        expected = server.users.get(username)
+        ok = expected is not None and password == expected
+    if ok:
         ctx.authenticated = True
+        ctx.username = username
         return "+OK"
     raise RespError("WRONGPASS invalid username-password pair")
 
@@ -904,13 +924,13 @@ def cmd_replicaof(server, ctx, args):
     if len(args) != 2:
         raise RespError("ERR REPLICAOF <host> <port> | NO ONE")
     host, port = _s(args[0]), _int(args[1])
-    from redisson_tpu.net.client import NodeClient
     from redisson_tpu.server import replication
 
-    # nodes of one grid share credentials: the replication link authenticates
-    # with this node's own password (cluster-wide password convention)
-    master = NodeClient(
-        f"{host}:{port}", ping_interval=0, retry_attempts=1, password=server.password
+    # nodes of one grid share credentials AND transport security: the link
+    # authenticates with this node's own password and speaks TLS when this
+    # node does (cluster-wide convention; server.link_client)
+    master = server.link_client(
+        f"{host}:{port}", ping_interval=0, retry_attempts=1
     )
     try:
         blob = master.execute("REPLSNAPSHOT", timeout=60.0)

@@ -131,12 +131,18 @@ def apply_records(engine, blob: bytes) -> int:
 class ReplicaHandle:
     """Master-side link to one registered replica."""
 
-    def __init__(self, address: str, password: Optional[str] = None):
-        from redisson_tpu.net.client import NodeClient
-
+    def __init__(self, address: str, password: Optional[str] = None, server=None):
         self.address = address
-        # grid nodes share credentials (see registry cmd_replicaof note)
-        self.client = NodeClient(address, ping_interval=0, retry_attempts=1, password=password)
+        # grid nodes share credentials + transport security (registry
+        # cmd_replicaof note; server.link_client carries TLS when on)
+        if server is not None:
+            self.client = server.link_client(address, ping_interval=0, retry_attempts=1)
+        else:
+            from redisson_tpu.net.client import NodeClient
+
+            self.client = NodeClient(
+                address, ping_interval=0, retry_attempts=1, password=password
+            )
         # record name -> (nonce, version) last shipped; the nonce detects
         # delete+recreate between sweeps (version restarts under a new nonce)
         self.shipped: Dict[str, Tuple[int, int]] = {}
@@ -164,7 +170,7 @@ class ReplicationSource:
         with self._lock:
             if address not in self._replicas:
                 self._replicas[address] = ReplicaHandle(
-                    address, password=self.server.password
+                    address, password=self.server.password, server=self.server
                 )
             if self._thread is None:
                 self._thread = threading.Thread(

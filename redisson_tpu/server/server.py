@@ -85,11 +85,25 @@ class TpuServer:
         checkpoint_path: Optional[str] = None,
         mode: str = "standalone",
         workers: int = 4,
+        tls_cert_file: Optional[str] = None,
+        tls_key_file: Optional[str] = None,
+        tls_ca_file: Optional[str] = None,
+        users: Optional[Dict[str, str]] = None,
     ):
         self.engine = engine if engine is not None else Engine()
         self.host = host
         self.port = port
         self.password = password
+        # ACL users (username -> password): the reference's AUTH user pass
+        # (BaseConnectionHandler.java:59-122).  "default" aliases `password`.
+        self.users: Dict[str, str] = dict(users or {})
+        # TLS: cert+key enable the listener's TLS; ca_file additionally
+        # REQUIRES client certificates (mTLS) and pins the trust root for
+        # this node's OUTGOING links (migration/replication) so a TLS
+        # cluster's bus speaks TLS end to end.
+        self.tls_cert_file = tls_cert_file
+        self.tls_key_file = tls_key_file
+        self.tls_ca_file = tls_ca_file
         self.checkpoint_path = checkpoint_path
         self.mode = mode
         self.node_id = uuid.uuid4().hex
@@ -311,8 +325,8 @@ class TpuServer:
                 target = targets[slot]
                 link = links.get(target)
                 if link is None:
-                    link = links[target] = NodeClient(
-                        target, password=self.password, ping_interval=0, retry_attempts=1
+                    link = links[target] = self.link_client(
+                        target, ping_interval=0, retry_attempts=1
                     )
                 # Hold the record lock across serialize -> IMPORTRECORDS ->
                 # local delete.  Every mutation path (object handles AND the
@@ -476,10 +490,51 @@ class TpuServer:
             except Exception:  # noqa: BLE001
                 pass
 
+    @property
+    def tls_enabled(self) -> bool:
+        return self.tls_cert_file is not None
+
+    def _server_ssl_context(self):
+        if not self.tls_enabled:
+            return None
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.tls_cert_file, self.tls_key_file)
+        if self.tls_ca_file:
+            ctx.load_verify_locations(self.tls_ca_file)
+            ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
+        return ctx
+
+    def link_client(self, address: str, **kw):
+        """NodeClient for this node's OUTGOING links (slot drains, replica
+        sync): inherits the node's password and, when TLS is on, a client
+        context trusting the cluster CA (hostname checks off — cluster
+        peers are addressed by IP)."""
+        from redisson_tpu.net.client import NodeClient, client_ssl_context
+
+        kw.setdefault("password", self.password)
+        if self.tls_enabled:
+            kw.setdefault(
+                "ssl_context",
+                client_ssl_context(
+                    # self-signed deployments (no separate CA) trust the
+                    # shared node cert itself — same fallback as
+                    # ServerThread.client(); without it REPLSNAPSHOT and
+                    # IMPORTRECORDS links die on SSLCertVerificationError
+                    ca_file=self.tls_ca_file or self.tls_cert_file,
+                    cert_file=self.tls_cert_file,
+                    key_file=self.tls_key_file,
+                    verify_hostname=False,
+                ),
+            )
+        return NodeClient(address, **kw)
+
     async def start_async(self):
         self._loop = asyncio.get_running_loop()
         self._server = await asyncio.start_server(
-            self._handle, self.host, self.port, reuse_address=True
+            self._handle, self.host, self.port, reuse_address=True,
+            ssl=self._server_ssl_context(),
         )
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
@@ -559,7 +614,8 @@ class ServerThread:
 
     @property
     def address(self) -> str:
-        return f"tpu://{self.server.host}:{self.server.port}"
+        scheme = "tpus" if self.server.tls_enabled else "tpu"
+        return f"{scheme}://{self.server.host}:{self.server.port}"
 
     def stop(self):
         self.server.stop()
@@ -567,17 +623,27 @@ class ServerThread:
             self._thread.join(timeout=5)
 
     def client(self):
-        """One-shot admin connection (context manager) to this node."""
+        """One-shot admin connection (context manager) to this node — speaks
+        TLS when the node does (trusting the node's own CA/cert chain)."""
         from contextlib import closing
 
-        from redisson_tpu.net.client import Connection
+        from redisson_tpu.net.client import Connection, client_ssl_context
 
+        ssl_ctx = None
+        if self.server.tls_enabled:
+            ssl_ctx = client_ssl_context(
+                ca_file=self.server.tls_ca_file or self.server.tls_cert_file,
+                cert_file=self.server.tls_cert_file if self.server.tls_ca_file else None,
+                key_file=self.server.tls_key_file if self.server.tls_ca_file else None,
+                verify_hostname=False,
+            )
         return closing(
             Connection(
                 self.server.host,
                 self.server.port,
                 timeout=120.0,
                 password=self.server.password,
+                ssl_context=ssl_ctx,
             )
         )
 

@@ -52,8 +52,8 @@ class Collector:
         self._parts[int(h1[0]) % self._n][key].append(value)
 
 
-def _part_name(job: str, chunk_idx: int, pi: int) -> str:
-    return f"mr:{job}:c{chunk_idx}:p{pi}"
+def _part_name(job: str, chunk_idx: int, run: str, pi: int) -> str:
+    return f"mr:{job}:c{chunk_idx}:r{run}:p{pi}"
 
 
 def _mr_map_task(map_name, keys, mapper, n_parts, job, chunk_idx, codec, *, client):
@@ -62,32 +62,36 @@ def _mr_map_task(map_name, keys, mapper, n_parts, job, chunk_idx, codec, *, clie
     each partition buffer with ONE bulk multimap merge (vs the reference's
     per-emit write).
 
-    Partition names are CHUNK-scoped and each flush starts by deleting the
-    chunk's previous output, so a re-run (orphan requeue, retry, or a
-    slow-but-alive worker racing its own requeued clone) REPLACES rather
-    than appends — duplicate emissions cannot reach the reducers.  `codec`
-    is the source map's codec: the worker must encode lookup keys exactly
-    as the writer did, or get_all matches nothing."""
+    Partition names are RUN-scoped (fresh uuid per execution): a requeued
+    clone writes to its own names, so a stale slow worker can neither
+    append duplicates to nor delete/clobber the winning run's output — the
+    coordinator tells reducers exactly which run won (the acked one).
+    Loser runs' partitions are unreferenced garbage reaped by the cleanup
+    task.  `codec` is the source map's codec: the worker must encode lookup
+    keys exactly as the writer did, or get_all matches nothing."""
     from redisson_tpu.client.codec import PickleCodec
 
+    run = uuid.uuid4().hex[:8]
     source = client.get_map(map_name, codec=codec)
     entries = source.get_all(keys)
     c = Collector(n_parts)
     for k, v in entries.items():
         mapper(k, v, c)
     for pi, pmap in enumerate(c._parts):
-        mm = client.get_list_multimap(_part_name(job, chunk_idx, pi), codec=PickleCodec())
-        mm.delete()  # idempotence: wipe any partial flush from a prior run
         if pmap:
+            mm = client.get_list_multimap(
+                _part_name(job, chunk_idx, run, pi), codec=PickleCodec()
+            )
             mm.put_all_entries(dict(pmap))
-    return len(entries)
+    return {"entries": len(entries), "run": run}
 
 
-def _mr_reduce_task(job, pi, n_chunks, reducer, result_name, result_codec, *, client):
+def _mr_reduce_task(job, pi, chunk_runs, reducer, result_name, result_codec, *, client):
     """Reducer partition task (ReducerTask.java analog): fold each key's
-    value list across every mapper chunk's partition output, optionally
-    write into the named result map, return the reduced dict so the
-    coordinator can merge without re-reading.
+    value list across every WINNING mapper run's partition output
+    (`chunk_runs` = [(chunk_idx, run), ...] from the acked map results),
+    optionally write into the named result map, return the reduced dict so
+    the coordinator can merge without re-reading.
 
     IDEMPOTENT: reads only — a requeued re-run (worker died mid-fold) sees
     every chunk again and the result-map write is a full overwrite of this
@@ -98,8 +102,8 @@ def _mr_reduce_task(job, pi, n_chunks, reducer, result_name, result_codec, *, cl
     from redisson_tpu.client.codec import PickleCodec
 
     grouped: Dict[Any, List[Any]] = defaultdict(list)
-    for ci in range(n_chunks):
-        mm = client.get_list_multimap(_part_name(job, ci, pi), codec=PickleCodec())
+    for ci, run in chunk_runs:
+        mm = client.get_list_multimap(_part_name(job, ci, run, pi), codec=PickleCodec())
         for k, v in mm.entries():
             grouped[k].append(v)
     out = {k: reducer(k, vals) for k, vals in grouped.items()}
@@ -116,20 +120,22 @@ def _wc_chunk_task(map_name, keys, codec, *, client):
     return _host_word_count([str(v) for v in vals.values()])
 
 
-def _mr_cleanup_task(job, n_chunks, n_parts, *, client):
-    """Best-effort partition reaper for failed/abandoned jobs."""
-    from redisson_tpu.client.codec import PickleCodec
-
+def _mr_cleanup_task(job, *, client):
+    """Best-effort partition reaper: pattern-deletes EVERY `mr:{job}:*`
+    multimap — winning runs, stale-clone runs, and partial flushes alike.
+    A stale clone that flushes after this sweep leaks until a later sweep;
+    that residual is leak-shaped, never correctness-shaped (reducers only
+    read run names the coordinator handed them)."""
+    keys = client.get_keys()
     n = 0
-    for ci in range(n_chunks):
-        for pi in range(n_parts):
+    try:
+        for name in list(keys.get_keys(f"mr:{job}:*")):
             try:
-                if client.get_list_multimap(
-                    _part_name(job, ci, pi), codec=PickleCodec()
-                ).delete():
-                    n += 1
+                n += int(keys.delete(name))  # per-name: slot-routable
             except Exception:  # noqa: BLE001 — best-effort cleanup
                 pass
+    except Exception:  # noqa: BLE001 — best-effort cleanup
+        pass
     return n
 
 
@@ -294,8 +300,12 @@ class MapReduce:
                 )
                 for ci, ck in enumerate(chunks)
             ]
-            for tid in tids:
-                _await_payload_task(ex, tid, timeout)
+            # the acked map result names the WINNING run per chunk — stale
+            # clones wrote under other run ids nobody will ever read
+            chunk_runs = [
+                (ci, _await_payload_task(ex, tid, timeout)["run"])
+                for ci, tid in enumerate(tids)
+            ]
             result_name = getattr(result_map, "_name", None)
             result_codec = getattr(result_map, "_codec", None)
             rtids = [
@@ -303,7 +313,7 @@ class MapReduce:
                     pickle.dumps(
                         (
                             _mr_reduce_task,
-                            (job, pi, len(chunks), self._reducer, result_name, result_codec),
+                            (job, pi, chunk_runs, self._reducer, result_name, result_codec),
                             {},
                         ),
                         protocol=pickle.HIGHEST_PROTOCOL,
@@ -315,18 +325,18 @@ class MapReduce:
             for tid in rtids:
                 result.update(_await_payload_task(ex, tid, timeout))
         finally:
-            # reap every partition multimap — on success (reducers only READ,
-            # for re-run idempotence) and on failure/abandonment alike.
-            # Cleanup rides the executor so it works from any coordinator —
-            # local handle or wire proxy.  Residual race (documented): a
-            # stale mapper clone that flushes AFTER this cleanup re-creates
-            # its chunk's partitions; closing that needs job-epoch fencing
-            # on data-plane writes, which the executor's claim fencing does
-            # not cover.
+            # reap every mr:{job}:* partition multimap — winning runs,
+            # stale-clone runs, partial flushes — on success (reducers only
+            # READ, for re-run idempotence) and on failure alike.  Cleanup
+            # rides the executor so it works from any coordinator — local
+            # handle or wire proxy.  Residual (documented): a stale clone
+            # flushing AFTER this sweep leaks orphaned multimaps until a
+            # later sweep — a leak, never a correctness hazard, because
+            # reducers only read run ids the coordinator handed them.
             try:
                 ex.submit_payload(
                     pickle.dumps(
-                        (_mr_cleanup_task, (job, len(chunks), n_parts), {}),
+                        (_mr_cleanup_task, (job,), {}),
                         protocol=pickle.HIGHEST_PROTOCOL,
                     )
                 )

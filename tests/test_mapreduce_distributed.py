@@ -209,18 +209,30 @@ def test_mapper_rerun_does_not_duplicate_emissions():
         m = client.get_map("mr:rerun")
         m.put_all({f"k{i}": "dup words dup" for i in range(10)})
         keys = m.read_all_keys()
-        # run the SAME mapper chunk twice, as a requeue would
-        for _ in range(2):
+        # run the SAME mapper chunk twice, as a requeue would; each run
+        # writes under its own run id and only the acked (last) run counts
+        runs = [
             _mr_map_task(
                 "mr:rerun", keys, _mr_tasks.wc_mapper, 2, "jobX", 0, None,
                 client=client,
-            )
+            )["run"]
+            for _ in range(2)
+        ]
         out = {}
         for pi in range(2):
             out.update(
-                _mr_reduce_task("jobX", pi, 1, _mr_tasks.wc_reducer, None, None, client=client)
+                _mr_reduce_task(
+                    "jobX", pi, [(0, runs[-1])], _mr_tasks.wc_reducer, None, None,
+                    client=client,
+                )
             )
         assert out == {"dup": 20, "words": 10}
+        # the loser run's partitions are unreferenced; the job-wide sweep
+        # reaps winner and loser alike
+        from redisson_tpu.services.mapreduce import _mr_cleanup_task
+
+        assert _mr_cleanup_task("jobX", client=client) >= 1
+        assert not client.get_keys().get_keys("mr:jobX:*")
     finally:
         client.shutdown()
 
