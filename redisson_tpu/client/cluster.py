@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from redisson_tpu.client import routing
 from redisson_tpu.net import commands as C
 from redisson_tpu.net.balancer import LoadBalancer, RoundRobinLoadBalancer
 from redisson_tpu.net.client import ConnectionError_, NodeClient, parse_address
@@ -200,15 +201,7 @@ class ClusterRedisson(RemoteSurface):
         view = self._fetch_view()
         if view is None:
             return False
-        new_slots: List[Optional[str]] = [None] * MAX_SLOT
-        masters: Dict[str, None] = {}
-        for row in view:
-            lo, hi, (host, port, _nid) = int(row[0]), int(row[1]), row[2]
-            host = host.decode() if isinstance(host, bytes) else host
-            addr = f"{host}:{int(port)}"
-            masters[addr] = None
-            for s in range(lo, hi + 1):
-                new_slots[s] = addr
+        new_slots, masters = routing.parse_view(view)
         with self._lock:
             existing = dict(self._entries)
         fresh: Dict[str, ShardEntry] = {}
@@ -265,40 +258,13 @@ class ClusterRedisson(RemoteSurface):
             return list(self._entries.values())
 
     # -- command path (RedisExecutor redirect state machine) ------------------
+    # routing decisions live in client/routing.py — the PURE core shared
+    # with the async cluster client so the two flavors cannot drift
 
-    # keyless commands whose answer is the union over every master — the
-    # RKeys scatter-gather surface (CommandAsyncService readAllAsync /
-    # writeAllAsync, :233-294)
-    _ALL_SHARD = {"KEYS": "concat", "DBSIZE": "sum", "FLUSHALL": "ok"}
-    # multi-key WRITE commands that are one atomic compound op server-side:
-    # all keys must colocate on one shard (Redis CROSSSLOT rule; use
-    # {hashtags} to colocate)
-    _SAME_SLOT = {"PFMERGE", "BITOP", "RENAME"}
+    _ALL_SHARD = routing.ALL_SHARD
 
     def _route(self, cmd: str, args: tuple) -> Tuple[Optional[int], bool]:
-        cu = cmd.upper()
-        if cu in ("PUBLISH", "SPUBLISH") and args:
-            # subscriptions live on the channel's slot-owner master
-            # (pubsub_for below) — a publish MUST land on that same node or
-            # topic fan-out and local-cache invalidation silently drop.
-            # Routed as a "write" so it always hits the master the
-            # subscribers are attached to, never a replica.
-            ch = args[0]
-            return calc_slot(ch if isinstance(ch, bytes) else str(ch).encode()), True
-        keys = C.command_keys(cmd, list(args))
-        write = C.is_write(cmd, list(args))
-        if not keys:
-            return None, write
-        slots = {calc_slot(k if isinstance(k, bytes) else str(k).encode()) for k in keys}
-        if len(slots) > 1:
-            if cmd.upper() in self._SAME_SLOT:
-                raise RespError(
-                    f"CROSSSLOT keys of {cmd} map to different slots; use a "
-                    "{hashtag} to colocate them"
-                )
-            # splittable multi-key (DEL/UNLINK): caller path handles grouping
-            return -1, write
-        return slots.pop(), write
+        return routing.route(cmd, args)
 
     def execute(self, *cmd_args, timeout: Optional[float] = None) -> Any:
         cmd = str(cmd_args[0]).upper()
@@ -413,10 +379,7 @@ class ClusterRedisson(RemoteSurface):
         """DEL/UNLINK across slots: group keys per owning shard, sum counts
         (the per-entry grouping of RedissonKeys.deleteAsync)."""
         cmd = cmd_args[0]
-        groups: Dict[int, List[Any]] = {}
-        for key in cmd_args[1:]:
-            kb = key if isinstance(key, bytes) else str(key).encode()
-            groups.setdefault(calc_slot(kb), []).append(key)
+        groups = routing.group_by_slot(list(cmd_args[1:]))
         total = 0
         for slot, keys in groups.items():
             total += int(self.execute(cmd, *keys, timeout=timeout) or 0)
@@ -521,12 +484,8 @@ class ClusterRedisson(RemoteSurface):
         with self._lock:
             slot_table = list(self._slots)
             entries = dict(self._entries)
-        groups: Dict[Optional[str], List[int]] = {}
         ops = [tuple(op) for op in ops]
-        for i, op in enumerate(ops):
-            name = op[1]
-            addr = slot_table[calc_slot(str(name).encode())] if name else None
-            groups.setdefault(addr, []).append(i)
+        groups = routing.group_by_slot_owner(slot_table, [op[1] for op in ops])
         results: List[Any] = [None] * len(ops)
 
         def run_group(addr, idxs):

@@ -120,22 +120,27 @@ def _wc_chunk_task(map_name, keys, codec, *, client):
     return _host_word_count([str(v) for v in vals.values()])
 
 
-def _mr_cleanup_task(job, *, client):
-    """Best-effort partition reaper: pattern-deletes EVERY `mr:{job}:*`
-    multimap — winning runs, stale-clone runs, and partial flushes alike.
-    A stale clone that flushes after this sweep leaks until a later sweep;
-    that residual is leak-shaped, never correctness-shaped (reducers only
-    read run names the coordinator handed them)."""
+def _mr_cleanup_task(job, names=None, *, client):
+    """Best-effort partition reaper.  `names` (the coordinator's known
+    partition names — winning runs x partitions) deletes directly; names is
+    None on FAILED jobs where winning runs are unknown, falling back to a
+    `mr:{job}:*` pattern sweep.  The scan is the exception path only — a
+    KEYS scan per successful job would cost O(total keyspace) every run.
+    A stale clone that flushes after this sweep leaks until a failed-job
+    sweep touches it; that residual is leak-shaped, never correctness-shaped
+    (reducers only read run names the coordinator handed them)."""
     keys = client.get_keys()
+    if names is None:
+        try:
+            names = list(keys.get_keys(f"mr:{job}:*"))
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            return 0
     n = 0
-    try:
-        for name in list(keys.get_keys(f"mr:{job}:*")):
-            try:
-                n += int(keys.delete(name))  # per-name: slot-routable
-            except Exception:  # noqa: BLE001 — best-effort cleanup
-                pass
-    except Exception:  # noqa: BLE001 — best-effort cleanup
-        pass
+    for name in names:
+        try:
+            n += int(keys.delete(name))  # per-name: slot-routable
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
     return n
 
 
@@ -324,27 +329,41 @@ class MapReduce:
             result: Dict[Any, Any] = {}
             for tid in rtids:
                 result.update(_await_payload_task(ex, tid, timeout))
-        finally:
-            # reap every mr:{job}:* partition multimap — winning runs,
-            # stale-clone runs, partial flushes — on success (reducers only
-            # READ, for re-run idempotence) and on failure alike.  Cleanup
-            # rides the executor so it works from any coordinator — local
-            # handle or wire proxy.  Residual (documented): a stale clone
-            # flushing AFTER this sweep leaks orphaned multimaps until a
-            # later sweep — a leak, never a correctness hazard, because
-            # reducers only read run ids the coordinator handed them.
-            try:
-                ex.submit_payload(
-                    pickle.dumps(
-                        (_mr_cleanup_task, (job,), {}),
-                        protocol=pickle.HIGHEST_PROTOCOL,
-                    )
-                )
-            except Exception:  # noqa: BLE001 — best-effort cleanup
-                pass
+        except BaseException:
+            # failed/abandoned job: winning runs unknown — pattern sweep
+            self._submit_cleanup(ex, job, None)
+            raise
+        else:
+            # success: delete exactly the winning runs' partition names
+            # (no keyspace scan on the common path); stale-clone orphans
+            # wait for a failed-job sweep — a leak, never a correctness
+            # hazard, because reducers only read runs the coordinator named
+            self._submit_cleanup(
+                ex,
+                job,
+                [
+                    _part_name(job, ci, run, pi)
+                    for ci, run in chunk_runs
+                    for pi in range(n_parts)
+                ],
+            )
         if self._collator is not None:
             return self._collator(result)
         return result
+
+    @staticmethod
+    def _submit_cleanup(ex, job: str, names) -> None:
+        """Fire-and-forget cleanup task (rides the executor so it works from
+        any coordinator — local handle or wire proxy)."""
+        try:
+            ex.submit_payload(
+                pickle.dumps(
+                    (_mr_cleanup_task, (job, names), {}),
+                    protocol=pickle.HIGHEST_PROTOCOL,
+                )
+            )
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
 
 
 class KernelMapReduce:

@@ -1,0 +1,320 @@
+"""Async CLUSTER client: slot routing, MOVED/ASK redirects, per-shard
+pipeline grouping — the async twin of ClusterRedisson, sharing the pure
+routing core (VERDICT r2 #5; reference: Redisson.java:131-157 async facade,
+command/CommandAsyncService.java:538-566)."""
+import asyncio
+
+import pytest
+
+from redisson_tpu.client.aio import AsyncClusterRedisson
+from redisson_tpu.harness import ClusterRunner
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.migration import migrate_slots
+from redisson_tpu.utils.crc16 import calc_slot
+
+
+@pytest.fixture()
+def cluster3():
+    runner = ClusterRunner(masters=3).run()
+    yield runner
+    runner.shutdown()
+
+
+def _seeds(runner):
+    return [f"tpu://{a}" for a in runner.seeds()]
+
+
+def test_async_cluster_routes_across_shards(cluster3):
+    async def main():
+        async with await AsyncClusterRedisson.connect(
+            _seeds(cluster3), scan_interval=0
+        ) as client:
+            # spread keys over every master's range
+            for i in range(60):
+                await client.execute("SET", f"ac-{i}", str(i))
+            for i in range(60):
+                assert int(await client.execute("GET", f"ac-{i}")) == i
+            # each master actually holds a share
+            owners = {
+                cluster3.masters[
+                    next(
+                        mi
+                        for mi, (lo, hi) in enumerate(cluster3.slot_ranges)
+                        if lo <= calc_slot(f"ac-{i}".encode()) <= hi
+                    )
+                ]
+                for i in range(60)
+            }
+            assert len(owners) == 3
+            # keyless fan-out (RKeys surface): union over all masters
+            assert int(await client.execute("DBSIZE")) >= 60
+            names = await client.execute("KEYS", "ac-*")
+            assert len(names) == 60
+            # cross-slot DEL splits per shard and sums
+            deleted = await client.execute("DEL", *[f"ac-{i}" for i in range(60)])
+            assert int(deleted) == 60
+
+    asyncio.run(main())
+
+
+def test_async_cluster_object_proxies(cluster3):
+    async def main():
+        async with await AsyncClusterRedisson.connect(
+            _seeds(cluster3), scan_interval=0
+        ) as client:
+            m = client.get_map("ac-map")
+            await m.put("k", 42)
+            assert await m.get("k") == 42
+            al = client.get_atomic_long("ac-count")
+            results = await asyncio.gather(*(al.increment_and_get() for _ in range(30)))
+            assert sorted(results) == list(range(1, 31))
+            q = client.get_queue("ac-q")
+            await q.offer("x")
+            assert await q.poll() == "x"
+
+    asyncio.run(main())
+
+
+def test_async_cluster_pipeline_groups_per_shard(cluster3):
+    async def main():
+        async with await AsyncClusterRedisson.connect(
+            _seeds(cluster3), scan_interval=0
+        ) as client:
+            n = 40
+            sets = [("SET", f"acp-{i}", str(i)) for i in range(n)]
+            gets = [("GET", f"acp-{i}") for i in range(n)]
+            replies = await client.execute_pipeline(sets + gets)
+            assert [int(r) for r in replies[n:]] == list(range(n))
+
+    asyncio.run(main())
+
+
+def test_async_cluster_objcall_many(cluster3):
+    async def main():
+        async with await AsyncClusterRedisson.connect(
+            _seeds(cluster3), scan_interval=0
+        ) as client:
+            ops = [
+                ("get_map", f"acm-{i}", "put", (f"k{i}", i), {}) for i in range(20)
+            ]
+            await client.objcall_many(ops)
+            reads = [
+                ("get_map", f"acm-{i}", "get", (f"k{i}",), {}) for i in range(20)
+            ]
+            got = await client.objcall_many(reads)
+            assert got == list(range(20))
+
+    asyncio.run(main())
+
+
+def test_async_cluster_follows_moved_after_reshard(cluster3):
+    """A stale async client keeps serving through a live migration: rows hit
+    MOVED/ASK and re-route (the RedisExecutor redirect loop, async)."""
+
+    async def main():
+        async with await AsyncClusterRedisson.connect(
+            _seeds(cluster3), scan_interval=0
+        ) as client:
+            names = [f"mv-{i}" for i in range(40)]
+            for i, nme in enumerate(names):
+                await client.execute("SET", nme, str(i))
+            lo0, hi0 = cluster3.slot_ranges[0]
+            mine = [n for n in names if lo0 <= calc_slot(n.encode()) <= hi0]
+            slots = sorted({calc_slot(n.encode()) for n in mine})
+            # migrate while the async client's view is stale
+            migrate_slots(
+                cluster3.masters[0].address, cluster3.masters[1].address, slots
+            )
+            for i, nme in enumerate(names):
+                assert int(await client.execute("GET", nme)) == i
+            # writes also follow to the new owner
+            for nme in mine:
+                await client.execute("SET", nme, "moved")
+            tgt = cluster3.masters[1].server.server.engine
+            assert all(tgt.store.exists(n) for n in mine)
+
+    asyncio.run(main())
+
+
+def test_async_cluster_ask_redirect_during_window(cluster3):
+    """Mid-window (MIGRATING/IMPORTING, not finalized): the async client
+    follows one-shot ASK redirects without a topology flip."""
+    from redisson_tpu.harness import _exec
+
+    async def main():
+        async with await AsyncClusterRedisson.connect(
+            _seeds(cluster3), scan_interval=0
+        ) as client:
+            await client.execute("SET", "ask-aio", "here")
+            slot = calc_slot(b"ask-aio")
+            si = next(
+                i for i, (lo, hi) in enumerate(cluster3.slot_ranges) if lo <= slot <= hi
+            )
+            source = cluster3.masters[si]
+            target = cluster3.masters[(si + 1) % 3]
+            with target.server.client() as c:
+                _exec(c, "CLUSTER", "SETSLOT", slot, "IMPORTING", source.address)
+            with source.server.client() as c:
+                _exec(c, "CLUSTER", "SETSLOT", slot, "MIGRATING", target.address)
+                assert _exec(c, "CLUSTER", "MIGRATESLOT", slot) >= 1
+            # stale view: the GET hits the source, gets ASK, hops once
+            assert (await client.execute("GET", "ask-aio")) == b"here"
+            with source.server.client() as c:
+                _exec(c, "CLUSTER", "SETSLOT", slot, "STABLE")
+            with target.server.client() as c:
+                _exec(c, "CLUSTER", "SETSLOT", slot, "STABLE")
+
+    asyncio.run(main())
+
+
+def test_async_cluster_pubsub_slot_routed(cluster3):
+    async def main():
+        async with await AsyncClusterRedisson.connect(
+            _seeds(cluster3), scan_interval=0
+        ) as client:
+            q = await client.subscribe("ac-chan")
+            # publish routes to the channel's slot owner, so fan-out holds
+            await client.execute("PUBLISH", "ac-chan", "hello")
+            ch, payload = await asyncio.wait_for(q.get(), timeout=10)
+            assert payload in (b"hello", "hello")
+
+    asyncio.run(main())
+
+
+def test_async_cluster_crossslot_compound_rejected(cluster3):
+    async def main():
+        async with await AsyncClusterRedisson.connect(
+            _seeds(cluster3), scan_interval=0
+        ) as client:
+            await client.execute("SET", "x-a", "1")
+            await client.execute("SET", "x-b", "2")
+            with pytest.raises(RespError, match="CROSSSLOT"):
+                await client.execute("RENAME", "x-a", "x-b")
+            # hashtag colocation works
+            await client.execute("SET", "{x}a", "1")
+            await client.execute("RENAME", "{x}a", "{x}b")
+            assert (await client.execute("GET", "{x}b")) == b"1"
+
+    asyncio.run(main())
+
+
+def test_async_cluster_all_shard_covers_every_master(cluster3):
+    """KEYS/DBSIZE must fan out over EVERY master in the view — including
+    ones the lazy client never contacted (reviewer finding: partial
+    results from only-probed nodes)."""
+
+    async def main():
+        # seed data on every master BEFORE the async client exists
+        sync = cluster3.client(scan_interval=0)
+        for i in range(60):
+            sync.execute("SET", f"fan-{i}", "x")
+        sync.shutdown()
+        async with await AsyncClusterRedisson.connect(
+            _seeds(cluster3)[:1], scan_interval=0  # ONE seed: lazy contact
+        ) as client:
+            names = await client.execute("KEYS", "fan-*")
+            assert len(names) == 60
+            assert int(await client.execute("DBSIZE")) >= 60
+
+    asyncio.run(main())
+
+
+def test_async_cluster_pubsub_resubscribes_after_drop(cluster3):
+    """A dropped per-master pubsub connection re-attaches every channel the
+    address owns (reviewer finding: silent subscription loss)."""
+
+    async def main():
+        async with await AsyncClusterRedisson.connect(
+            _seeds(cluster3), scan_interval=0
+        ) as client:
+            q = await client.subscribe("resub-chan")
+            await client.execute("PUBLISH", "resub-chan", "one")
+            assert (await asyncio.wait_for(q.get(), 10))[1] in (b"one", "one")
+            # kill the pubsub socket under the client
+            addr = next(iter(client._pubsubs))
+            await client._pubsubs[addr].close()
+            # the reconnect task re-subscribes; publish until delivery
+            for _ in range(100):
+                await client.execute("PUBLISH", "resub-chan", "two")
+                try:
+                    ch, payload = await asyncio.wait_for(q.get(), 0.2)
+                    if payload in (b"two", "two"):
+                        return
+                except asyncio.TimeoutError:
+                    continue
+            raise AssertionError("subscription never recovered after drop")
+
+    asyncio.run(main())
+
+
+def test_async_cluster_over_tls(tmp_path):
+    """The async cluster client speaks TLS end to end (scheme-driven or
+    explicit context) — reviewer finding: no async TLS path existed."""
+    import subprocess
+
+    from redisson_tpu.net.client import client_ssl_context
+
+    cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", cert, "-days", "2", "-nodes", "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    runner = ClusterRunner(
+        masters=2, tls_cert_file=cert, tls_key_file=key, tls_ca_file=cert
+    ).run()
+    try:
+        ctx = client_ssl_context(
+            ca_file=cert, cert_file=cert, key_file=key, verify_hostname=False
+        )
+
+        async def main():
+            async with await AsyncClusterRedisson.connect(
+                [f"tpus://{a}" for a in runner.seeds()],
+                scan_interval=0,
+                ssl_context=ctx,
+            ) as client:
+                await client.execute("SET", "aio-tls", "on")
+                assert (await client.execute("GET", "aio-tls")) == b"on"
+                m = client.get_map("aio-tls-map")
+                await m.put("k", 7)
+                assert await m.get("k") == 7
+
+        asyncio.run(main())
+    finally:
+        runner.shutdown()
+
+
+def test_async_single_node_acl_and_tls(tmp_path):
+    """AsyncRemoteRedisson: AUTH user-pass form + TLS transport."""
+    import subprocess
+
+    from redisson_tpu.client.aio import AsyncRemoteRedisson
+    from redisson_tpu.net.client import client_ssl_context
+    from redisson_tpu.server.server import ServerThread
+
+    cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", cert, "-days", "2", "-nodes", "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    with ServerThread(
+        port=0, tls_cert_file=cert, tls_key_file=key, users={"svc": "spw"}
+    ) as st:
+        ctx = client_ssl_context(ca_file=cert)
+
+        async def main():
+            client = await AsyncRemoteRedisson.connect(
+                st.address, password="spw", username="svc", ssl_context=ctx
+            )
+            try:
+                b = client.get_bucket("aio-acl")
+                await b.set("ok")
+                assert await b.get() == "ok"
+            finally:
+                await client.aclose()
+
+        asyncio.run(main())
