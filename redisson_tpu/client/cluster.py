@@ -475,11 +475,13 @@ class ClusterRedisson(RemoteSurface):
             run_segment(segment)
         return results
 
-    def objcall_many(self, ops, caller=None):
+    def objcall_many(self, ops, caller=None, timeout: Optional[float] = None):
         """OBJCALLM with per-shard grouping: one frame + one pickle per
         shard, shards concurrent (the executeBatchedAsync discipline applied
         to the generic object wire).  Per-op MOVED/ASK errors from a stale
-        view re-route through the single-op redirect-aware objcall."""
+        view re-route through the single-op redirect-aware objcall.  Ops may
+        be 6-tuples whose trailing element is a pickled codec blob (the
+        OBJCALL codec-frame contract)."""
         caller = caller or self.caller_id()
         with self._lock:
             slot_table = list(self._slots)
@@ -487,6 +489,14 @@ class ClusterRedisson(RemoteSurface):
         ops = [tuple(op) for op in ops]
         groups = routing.group_by_slot_owner(slot_table, [op[1] for op in ops])
         results: List[Any] = [None] * len(ops)
+
+        def reroute_one(i):
+            """Single-op redirect-aware fallback, codec preserved."""
+            import pickle as _pickle
+
+            f, n, m, a, kw = ops[i][:5]
+            codec = _pickle.loads(ops[i][5]) if len(ops[i]) > 5 else None
+            return self.objcall(f, n, m, a, kw, caller=caller, codec=codec)
 
         def run_group(addr, idxs):
             import pickle as _pickle
@@ -499,7 +509,7 @@ class ClusterRedisson(RemoteSurface):
                     raise ConnectionError_(f"no entry for {addr}")
                 payload = _pickle.dumps([ops[i] for i in idxs])
                 replies = _unwrap_many(
-                    entry.master.execute("OBJCALLM", payload, caller)
+                    entry.master.execute("OBJCALLM", payload, caller, timeout=timeout)
                 )
             except TimeoutError:
                 # The OBJCALLM frame was written and may have EXECUTED
@@ -514,18 +524,16 @@ class ClusterRedisson(RemoteSurface):
                 # is safe for reads AND writes
                 replies = []
                 for i in idxs:
-                    f, n, m, a, kw = ops[i]
                     try:
-                        replies.append(self.objcall(f, n, m, a, kw, caller=caller))
+                        replies.append(reroute_one(i))
                     except Exception as e:  # noqa: BLE001 — errors stay as data
                         replies.append(e)
             for i, r in zip(idxs, replies):
                 if isinstance(r, RespError) and str(r).startswith(
                     ("MOVED ", "ASK ", "TRYAGAIN", "CLUSTERDOWN")
                 ):
-                    f, n, m, a, kw = ops[i]
                     try:
-                        r = self.objcall(f, n, m, a, kw, caller=caller)
+                        r = reroute_one(i)
                     except Exception as e:  # noqa: BLE001
                         r = e
                 results[i] = r
@@ -541,6 +549,69 @@ class ClusterRedisson(RemoteSurface):
                 for f in futs:
                     f.result()
         return results
+
+    def objcall_many_batch(
+        self, ops, atomic: bool = False, timeout: Optional[float] = None
+    ):
+        """Cluster RemoteBatch flush: per-shard OBJCALLM grouping via
+        objcall_many; atomic groups must colocate on ONE shard (the
+        reference's cluster rule for REDIS_*_ATOMIC modes — use
+        {hashtags}), shipped as a single OBJCALLMA frame to that owner."""
+        wire_ops = [self._normalize_batch_op(op) for op in ops]
+        if not atomic:
+            return self.objcall_many(wire_ops, timeout=timeout)
+        slots = {
+            calc_slot(str(op[1]).encode()) for op in wire_ops if op[1]
+        }
+        if len(slots) > 1:
+            raise RespError(
+                "CROSSSLOT atomic batch spans multiple slots; use a {hashtag} "
+                "to colocate every object of an atomic batch"
+            )
+        from redisson_tpu.client.remote import _unwrap_many
+        import pickle as _pickle
+
+        slot = slots.pop() if slots else None
+        payload = _pickle.dumps(wire_ops)
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_redirects + 1):
+            entry = self.entry_for_slot(slot) if slot is not None else next(
+                iter(self.entries()), None
+            )
+            if entry is None:
+                raise ConnectionError_("no cluster entries")
+            replies = _unwrap_many(
+                entry.master.execute("OBJCALLMA", payload, self.caller_id(), timeout=timeout)
+            )
+            # a stale view bounces EVERY op with a routing error before any
+            # applies (single-slot frame): refresh + full resend is safe.
+            # Mixed results (some applied) must NOT resend — return as-is.
+            routing_errs = [
+                r for r in replies
+                if isinstance(r, RespError)
+                and str(r).startswith(("MOVED ", "ASK ", "TRYAGAIN", "CLUSTERDOWN"))
+            ]
+            if routing_errs and len(routing_errs) == len(replies):
+                last = routing_errs[0]
+                self.refresh_topology()
+                time.sleep(min(0.05 * (attempt + 1), 0.5))
+                continue
+            return replies
+        assert last is not None
+        raise last
+
+    def sync_replication(self, names, timeout: Optional[float] = None) -> None:
+        """REPLFLUSH on every shard that owns one of `names` (syncSlaves)."""
+        with self._lock:
+            slot_table = list(self._slots)
+            entries = dict(self._entries)
+        addrs = {
+            slot_table[calc_slot(str(n).encode())] for n in names if n
+        }
+        for addr in addrs:
+            entry = entries.get(addr)
+            if entry is not None:
+                entry.master.execute("REPLFLUSH", timeout=timeout)
 
     def pubsub_for(self, name: str):
         """Channel subscriptions ride the shard that owns the channel's slot

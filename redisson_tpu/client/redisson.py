@@ -335,8 +335,8 @@ class RedissonTpu:
 
     # -- batching (RBatch) --------------------------------------------------
 
-    def create_batch(self, skip_result: bool = False) -> Batch:
-        return Batch(self._engine, skip_result=skip_result)
+    def create_batch(self, skip_result: bool = False, atomic: bool = False) -> Batch:
+        return Batch(self._engine, skip_result=skip_result, atomic=atomic)
 
     # -- distributed services -----------------------------------------------
 

@@ -16,6 +16,7 @@ Listeners (topics) ride the dedicated pubsub connection.
 from __future__ import annotations
 
 import pickle
+import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -305,44 +306,166 @@ class RemoteTopic:
         self._client.pubsub_for(self.name).unsubscribe(self.name)
 
 
-class RemoteBatch:
-    """RBatch over the wire: queued ops flush as ONE pipelined write, with
-    same-object sketch ops pre-coalesced into single blob commands
-    (CommandBatchService.java:87-151 discipline at the wire layer)."""
+class BatchOptions:
+    """api/BatchOptions.java parity: execution mode, response timeout,
+    retry policy, syncSlaves, skipResult.
 
-    def __init__(self, client: "RemoteRedisson"):
+    Modes: "IN_MEMORY" (default — ops queue client-side, flush as per-shard
+    OBJCALLM frames + coalesced sketch blobs) and "IN_MEMORY_ATOMIC" (the
+    MULTI/EXEC analog — the whole group executes under engine.locked_many
+    server-side with no interleaving; cluster rule as in the reference:
+    every touched object must colocate on one shard, use {hashtags})."""
+
+    IN_MEMORY = "IN_MEMORY"
+    IN_MEMORY_ATOMIC = "IN_MEMORY_ATOMIC"
+
+    def __init__(self):
+        self.execution_mode = self.IN_MEMORY
+        self.response_timeout: Optional[float] = None   # None = client default
+        self.retry_attempts: Optional[int] = None       # reads-only retries
+        self.retry_interval: float = 0.5
+        self.sync_slaves: bool = False                  # WAIT analog: REPLFLUSH
+        self.skip_result: bool = False
+
+    @classmethod
+    def defaults(cls) -> "BatchOptions":
+        return cls()
+
+    def atomic(self) -> "BatchOptions":
+        self.execution_mode = self.IN_MEMORY_ATOMIC
+        return self
+
+
+class _BatchObjectProxy:
+    """Batch-scoped handle: every method call QUEUES an op and returns its
+    result index (resolved by execute())."""
+
+    def __init__(self, batch: "RemoteBatch", factory: str, name: str, codec=None):
+        self._batch = batch
+        self._factory = factory
+        self._name = name
+        self._codec = codec
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def call(*args, **kwargs):
+            return self._batch._enqueue(
+                ("objcall", self._name,
+                 (self._factory, self._name, method, args, kwargs, self._codec))
+            )
+
+        call.__name__ = method
+        return call
+
+
+class RemoteBatch:
+    """RBatch over the wire (CommandBatchService.java:87-151,211-540 at the
+    wire layer): the FULL object surface queues through batch-scoped
+    proxies and flushes as per-shard OBJCALLM frames (atomic mode:
+    OBJCALLMA under the server's locked_many), while same-object bloom
+    sketch ops still pre-coalesce into single blob commands — the fastest
+    wire form for the north-star workload.
+
+    Results come back in submission order.  Writes keep at-most-once: a
+    response timeout raises instead of re-sending (the objcall_many rule)."""
+
+    def __init__(self, client: "RemoteRedisson", options: Optional[BatchOptions] = None):
         self._client = client
+        self._options = options or BatchOptions.defaults()
         self._ops: List[Tuple[str, str, Any]] = []  # (kind, name, payload)
+        self._executed = False
+
+    # -- batch-scoped handles ------------------------------------------------
 
     def get_bloom_filter(self, name: str):
         batch = self
 
         class _B:
             def contains_async(self, keys):
-                batch._ops.append(("bf.contains", name, np.asarray(keys)))
-                return len(batch._ops) - 1
+                return batch._enqueue(("bf.contains", name, np.asarray(keys)))
 
             def add_async(self, keys):
-                batch._ops.append(("bf.add", name, np.asarray(keys)))
-                return len(batch._ops) - 1
+                return batch._enqueue(("bf.add", name, np.asarray(keys)))
 
         return _B()
 
+    def __getattr__(self, factory: str):
+        if factory in _GENERIC_FACTORIES or factory in (
+            "get_bucket", "get_bit_set", "get_hyper_log_log", "get_atomic_long",
+        ):
+            def make(name: str, codec=None, *_a, **_k) -> _BatchObjectProxy:
+                return _BatchObjectProxy(self, factory, name, codec)
+
+            return make
+        raise AttributeError(factory)
+
+    def _enqueue(self, op: Tuple[str, str, Any]) -> int:
+        if self._executed:
+            raise RuntimeError("batch already executed")
+        self._ops.append(op)
+        return len(self._ops) - 1
+
+    # -- execution -------------------------------------------------------------
+
     def execute(self) -> List[Any]:
-        # group per (kind, name) preserving op order for result scatter
-        groups: Dict[Tuple[str, str], List[int]] = {}
-        for i, (kind, name, _) in enumerate(self._ops):
-            groups.setdefault((kind, name), []).append(i)
+        if self._executed:
+            raise RuntimeError("batch already executed")
+        self._executed = True
+        opts = self._options
+        timeout = opts.response_timeout
+        results: List[Any] = [None] * len(self._ops)
+
+        atomic = opts.execution_mode == BatchOptions.IN_MEMORY_ATOMIC
+        # 1) sketch blob fast path: group bf ops per (kind, name).  In
+        # ATOMIC mode bf ops must join the locked group instead — the blob
+        # commands run outside OBJCALLMA's locked_many, which would let a
+        # concurrent writer interleave between the "atomic" batch's sketch
+        # and generic ops (the embedded Batch locks bloom groups too)
+        blob_groups: Dict[Tuple[str, str], List[int]] = {}
+        objcall_idx: List[int] = []
+        for i, (kind, name, payload) in enumerate(self._ops):
+            if kind in ("bf.contains", "bf.add") and not atomic:
+                blob_groups.setdefault((kind, name), []).append(i)
+            elif kind in ("bf.contains", "bf.add"):
+                method = "contains_each" if kind == "bf.contains" else "add_each"
+                self._ops[i] = (
+                    "objcall", name,
+                    ("get_bloom_filter", name, method, (np.asarray(payload),), {}, None),
+                )
+                objcall_idx.append(i)
+            else:
+                objcall_idx.append(i)
         commands: List[Tuple] = []
-        layout: List[Tuple[List[int], List[int]]] = []  # (op indexes, sizes)
-        for (kind, name), idxs in groups.items():
+        layout: List[Tuple[List[int], List[int]]] = []
+        for (kind, name), idxs in blob_groups.items():
             keys = np.concatenate([np.asarray(self._ops[i][2]).reshape(-1) for i in idxs])
             blob = np.ascontiguousarray(keys, dtype="<i8").tobytes()
             cmd = "BF.MEXISTS64" if kind == "bf.contains" else "BF.MADD64"
             commands.append((cmd, name, blob))
             layout.append((idxs, [np.asarray(self._ops[i][2]).size for i in idxs]))
-        replies = self._client.execute_many(commands)
-        results: List[Any] = [None] * len(self._ops)
+
+        attempts = (opts.retry_attempts if opts.retry_attempts is not None else 0) + 1
+        last: Optional[BaseException] = None
+        for attempt in range(attempts):
+            try:
+                if commands:
+                    replies = self._client.execute_many(commands, timeout=timeout)
+                else:
+                    replies = []
+                break
+            except TimeoutError:
+                # the frame was WRITTEN and may have executed: re-sending
+                # would double-apply the adds (at-most-once; TimeoutError is
+                # an OSError subclass, so this clause must come first)
+                raise
+            except (ConnectionError, OSError) as e:
+                last = e  # pre-write failure: safe to retry
+                time.sleep(min(self._options.retry_interval * (attempt + 1), 2.0))
+        else:
+            assert last is not None
+            raise last
         for (idxs, sizes), reply in zip(layout, replies):
             if isinstance(reply, RespError):
                 raise reply
@@ -351,6 +474,24 @@ class RemoteBatch:
             for i, sz in zip(idxs, sizes):
                 results[i] = flags[off : off + sz]
                 off += sz
+
+        # 2) generic surface: per-shard OBJCALLM / atomic OBJCALLMA
+        if objcall_idx:
+            ops = [self._ops[i][2] for i in objcall_idx]
+            replies = self._client.objcall_many_batch(ops, atomic=atomic, timeout=timeout)
+            for i, r in zip(objcall_idx, replies):
+                if isinstance(r, BaseException):
+                    raise r
+                results[i] = r
+
+        # 3) syncSlaves (WAIT analog): force the replication stream flush on
+        # every touched shard before returning
+        if opts.sync_slaves:
+            names = {name for _k, name, _p in self._ops if name}
+            self._client.sync_replication(names, timeout=timeout)
+
+        if opts.skip_result:
+            return []
         return results
 
 
@@ -708,16 +849,50 @@ class RemoteSurface:
         return _unwrap(reply)
 
     def objcall_many(
-        self, ops: List[Tuple], caller: Optional[str] = None
+        self, ops: List[Tuple], caller: Optional[str] = None,
+        timeout: Optional[float] = None,
     ) -> List[Any]:
         """MANY object ops in ONE wire frame + ONE pickle (OBJCALLM — the
         CommandBatchService flush for the generic object surface).  ops =
-        [(factory, name, method, args, kwargs), ...]; returns results
-        aligned with ops, exceptions as values.  The cluster client
+        [(factory, name, method, args, kwargs[, codec_blob]), ...]; returns
+        results aligned with ops, exceptions as values.  The cluster client
         overrides this with per-shard grouping."""
         payload = pickle.dumps([tuple(op) for op in ops])
-        reply = self.execute("OBJCALLM", payload, caller or self.caller_id())
+        reply = self.execute(
+            "OBJCALLM", payload, caller or self.caller_id(), timeout=timeout
+        )
         return _unwrap_many(reply)
+
+    def objcall_many_batch(
+        self, ops: List[Tuple], atomic: bool = False, timeout: Optional[float] = None
+    ) -> List[Any]:
+        """RemoteBatch's generic flush: OBJCALLM, or OBJCALLMA for atomic
+        groups (server runs the whole frame under engine.locked_many — the
+        MULTI/EXEC analog).  Single-node surface: one frame either way.
+        Ops may carry a trailing Codec object; it ships pickled per the
+        OBJCALL codec-frame contract."""
+        wire_ops = [self._normalize_batch_op(op) for op in ops]
+        cmd = "OBJCALLMA" if atomic else "OBJCALLM"
+        payload = pickle.dumps(wire_ops)
+        reply = self.execute(cmd, payload, self.caller_id(), timeout=timeout)
+        return _unwrap_many(reply)
+
+    @staticmethod
+    def _normalize_batch_op(op: Tuple) -> Tuple:
+        op = tuple(op)
+        if len(op) > 5:
+            codec = op[5]
+            if codec is None:
+                return op[:5]
+            return op[:5] + (pickle.dumps(codec),)
+        return op
+
+    def sync_replication(self, names, timeout: Optional[float] = None) -> None:
+        """BatchOptions.syncSlaves analog (the WAIT command role): force the
+        replication stream to flush before returning, so a replica read
+        after the batch sees its writes.  Single-node surface: one
+        REPLFLUSH; the cluster client overrides per touched shard."""
+        self.execute("REPLFLUSH", timeout=timeout)
 
     # -- hot-path handles ----------------------------------------------------
 
@@ -744,8 +919,8 @@ class RemoteSurface:
     ) -> "RemoteLocalCachedMap":
         return RemoteLocalCachedMap(self, name, options=options, codec=codec)
 
-    def create_batch(self) -> "RemoteBatch":
-        return RemoteBatch(self)
+    def create_batch(self, options: Optional["BatchOptions"] = None) -> "RemoteBatch":
+        return RemoteBatch(self, options)
 
     def get_keys(self) -> "RemoteKeys":
         return RemoteKeys(self)

@@ -65,11 +65,15 @@ class BatchResult:
 
 
 class Batch:
-    def __init__(self, engine, skip_result: bool = False):
+    def __init__(self, engine, skip_result: bool = False, atomic: bool = False):
         self._engine = engine
         self._ops: List[_QueuedOp] = []
         self._executed = False
         self._skip_result = skip_result
+        # IN_MEMORY_ATOMIC analog: every touched record's lock is held for
+        # the WHOLE execute, so no other command interleaves with the batch
+        # (EXEC semantics — non-interleaved, no rollback)
+        self._atomic = atomic
 
     # -- batch-scoped object proxies ---------------------------------------
 
@@ -110,13 +114,24 @@ class Batch:
         for op in self._ops:
             groups.setdefault(op.group, []).append(op)
             order.append(op)
-        for group, ops in groups.items():
-            try:
-                _DISPATCH[group[1]](self._engine, group, ops)
-            except Exception as e:  # noqa: BLE001 - failures land on futures
-                for op in ops:
-                    if not op.future.done():
-                        op.future._fail(e)
+
+        def run_groups():
+            # groups run in first-submission order of their first op, so a
+            # same-name object queued under two op kinds sees its earlier-
+            # submitted group applied first (documented ordering contract)
+            for group, ops in groups.items():
+                try:
+                    _DISPATCH[group[1]](self._engine, group, ops)
+                except Exception as e:  # noqa: BLE001 - failures land on futures
+                    for op in ops:
+                        if not op.future.done():
+                            op.future._fail(e)
+
+        if self._atomic:
+            with self._engine.locked_many({g[0] for g in groups}):
+                run_groups()
+        else:
+            run_groups()
         if self._skip_result:
             return BatchResult([])
         return BatchResult([op.future.get() for op in order])

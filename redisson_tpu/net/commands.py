@@ -41,7 +41,7 @@ _spec(SPECS, "PING ECHO AUTH HELLO SELECT CLIENT QUIT DBSIZE TIME INFO MEMORY "
 # one sanctioned mutation path on a replica; IMPORTRECORDS is the slot-
 # migration transfer frame, master-to-master; OBJCALLM batches carry writes
 # inside their pickled payload, so the frame routes as a write)
-_spec(SPECS, "FLUSHALL RESTORESTATE IMPORTRECORDS OBJCALLM", True, None)
+_spec(SPECS, "FLUSHALL RESTORESTATE IMPORTRECORDS OBJCALLM OBJCALLMA", True, None)
 
 # single-key reads
 _spec(SPECS, "EXISTS TTL PTTL TYPE GET GETBIT BITCOUNT GETBITS GETBITSB "

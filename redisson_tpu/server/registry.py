@@ -1122,10 +1122,34 @@ def cmd_objcallm(server, ctx, args):
     OBJCALL-bound cluster throughput.  Per-op routing errors (MOVED/ASK
     during a reshard) come back as tagged entries so the client re-routes
     just those ops."""
+    return _objcallm_run(server, args, atomic=False)
+
+
+@register("OBJCALLMA")
+def cmd_objcallm_atomic(server, ctx, args):
+    """Atomic OBJCALLM (BatchOptions IN_MEMORY_ATOMIC / the MULTI-EXEC
+    analog, command/CommandBatchService.java:211-540): every op's record
+    lock is taken UP FRONT via engine.locked_many, so no other command
+    interleaves with the group — Redis EXEC semantics: non-interleaved
+    execution, no rollback of ops that already applied when a later op
+    errors.  Cluster rule matches the reference: all object names must
+    colocate on this node (use {hashtags})."""
+    return _objcallm_run(server, args, atomic=True)
+
+
+def _objcallm_run(server, args, atomic: bool):
     from redisson_tpu.net.safe_pickle import safe_loads
 
     ops = safe_loads(bytes(args[0]))
     caller = _s(args[1]) if len(args) > 1 else None
+    if atomic:
+        names = sorted({str(op[1]) for op in ops if op[1]})
+        with server.engine.locked_many(names):
+            return _objcallm_apply(server, ops, caller)
+    return _objcallm_apply(server, ops, caller)
+
+
+def _objcallm_apply(server, ops, caller):
     out = []
     for op in ops:
         # 5-tuple (factory, name, method, args, kwargs) or 6-tuple with a

@@ -461,7 +461,14 @@ class TpuServer:
                     except RuntimeError as e:
                         if "shutdown" in str(e):  # worker pool stopped: drop conn
                             raise ConnectionResetError(str(e)) from e
-                        raise
+                        # any other RuntimeError (uninitialized object, state
+                        # errors) is a per-command failure — reply -ERR, keep
+                        # the connection (dropping it would kill every other
+                        # pipelined command on this socket)
+                        self.stats["errors"] += 1
+                        results.append(
+                            _Encoded(resp.encode_error(f"ERR internal: {type(e).__name__}: {e}"))
+                        )
                     except Exception as e:  # noqa: BLE001 — sandbox handler bugs per-command
                         self.stats["errors"] += 1
                         results.append(
