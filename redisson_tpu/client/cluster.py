@@ -166,7 +166,9 @@ class ClusterRedisson(RemoteSurface):
             candidates = [e.master for e in self._entries.values()]
         for node in candidates:
             try:
-                return node.execute("CLUSTER", "SLOTS", timeout=5.0)
+                # single-shot: a dead candidate costs one refused connect,
+                # not retries-with-backoff — the NEXT candidate is the retry
+                return node.execute("CLUSTER", "SLOTS", timeout=5.0, retry_attempts=0)
             except Exception:  # noqa: BLE001 — try the next node
                 continue
         for seed in self._seeds:
@@ -206,19 +208,31 @@ class ClusterRedisson(RemoteSurface):
             existing = dict(self._entries)
         fresh: Dict[str, ShardEntry] = {}
         for addr in masters:
-            if addr in existing:
-                fresh[addr] = existing[addr]
-            else:
-                try:
-                    fresh[addr] = ShardEntry(
+            # gate EVERY entry — new or existing — on ONE single-shot ping:
+            # a dead master must leave the routing table (keyless commands
+            # and stale-slot fallbacks would otherwise keep picking it), and
+            # must cost one refused connect, not retries-with-backoff under
+            # the refresh lock.  Entry construction itself is lazy (pool
+            # warm-up is best-effort).
+            entry = existing.get(addr)
+            created = False
+            try:
+                if entry is None:
+                    entry = ShardEntry(
                         addr, balancer=self._balancer_factory, **self._node_kw
                     )
-                except Exception:  # noqa: BLE001 — node down; slot stays unroutable
-                    continue
-        # replica discovery per master (REPLICAS command) — still outside lock
+                    created = True
+                entry.master.execute("PING", timeout=2.0, retry_attempts=0)
+                fresh[addr] = entry
+            except Exception:  # noqa: BLE001 — node down; slot stays unroutable
+                if created and entry is not None:
+                    entry.close()
+                continue
+        # replica discovery per master (REPLICAS command) — still outside
+        # lock, single-shot for the same reason
         for addr, entry in fresh.items():
             try:
-                reps = entry.master.execute("REPLICAS", timeout=5.0)
+                reps = entry.master.execute("REPLICAS", timeout=5.0, retry_attempts=0)
                 entry.sync_replicas(
                     [r.decode() if isinstance(r, bytes) else r for r in reps]
                 )
@@ -280,7 +294,10 @@ class ClusterRedisson(RemoteSurface):
                     entries = self.entries()
                     if not entries:
                         raise ConnectionError_("no cluster entries")
-                    node = entries[0].master
+                    # rotate per redirect attempt: pinning keyless commands
+                    # to entries[0] forever starves them when that one node
+                    # is down but not yet pruned from the table
+                    node = entries[attempt % len(entries)].master
                 else:
                     entry = self.entry_for_slot(slot)
                     node = entry.master if write else entry.read_node(self.read_mode)

@@ -232,10 +232,23 @@ class FencedLock(Lock):
         self.lock(lease_time)
         return self.get_token()
 
-    def try_lock_and_get_token(self, wait_time: float = 0.0) -> Optional[int]:
-        if self.try_lock(wait_time):
-            return self.get_token()
-        return None
+    def try_lock_and_get_token(
+        self, wait_time: float = 0.0, lease_time: Optional[float] = None
+    ) -> Optional[int]:
+        """Acquire + token in ONE atomic step: the token is read under the
+        same record lock that performed the acquire, so a lapsed-lease steal
+        between acquire and read cannot hand two holders the same token."""
+        deadline = time.time() + wait_time
+        while True:
+            with self._engine.locked(self._name):
+                if self._try_acquire(lease_time) is None:
+                    tok = self._rec_or_create().host["token"]
+                    self._start_watchdog(lease_time)
+                    return int(tok)
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return None
+            self._wait().wait_for(min(remaining, 0.05))
 
     def get_token(self) -> int:
         rec = self._engine.store.get(self._name)

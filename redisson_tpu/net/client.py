@@ -314,8 +314,15 @@ class ConnectionPool:
         self._lock = threading.Lock()
         self.in_use = 0  # CommandsLoadBalancer feed (least in-flight picks)
         self._closed = False
+        # min-idle warm-up is BEST-EFFORT: a client to a temporarily-down
+        # node must still construct (failure detectors, coordinators, and
+        # the watchdog all hold clients to nodes that may be down right
+        # now) — the connect error surfaces on first acquire() instead
         for _ in range(self._min_idle):
-            self._idle.append((factory(), time.monotonic()))
+            try:
+                self._idle.append((factory(), time.monotonic()))
+            except (ConnectionError, OSError):
+                break
         self._reaper: Optional[threading.Timer] = None
         if idle_timeout and idle_timeout > 0:
             self._schedule_reap()
@@ -481,12 +488,22 @@ class NodeClient:
 
     # -- command path --------------------------------------------------------
 
-    def execute(self, *args, timeout: Optional[float] = None) -> Any:
+    def execute(
+        self, *args, timeout: Optional[float] = None,
+        retry_attempts: Optional[int] = None,
+    ) -> Any:
+        """`retry_attempts=0` makes this a single-shot probe — topology
+        refreshes ping candidate nodes this way so a dead master costs one
+        refused connect, not retries-with-backoff under the refresh lock."""
         if not self.hooks:
-            return self._with_retry(lambda c: c.execute(*args, timeout=timeout))
+            return self._with_retry(
+                lambda c: c.execute(*args, timeout=timeout), retry_attempts
+            )
         return self._hooked(
             str(args[0]), args[1:],
-            lambda: self._with_retry(lambda c: c.execute(*args, timeout=timeout)),
+            lambda: self._with_retry(
+                lambda c: c.execute(*args, timeout=timeout), retry_attempts
+            ),
         )
 
     def _hooked(self, name: str, args, fn: Callable[[], Any]) -> Any:
@@ -510,9 +527,12 @@ class NodeClient:
             lambda: self._with_retry(lambda c: c.execute_many(commands, timeout=timeout)),
         )
 
-    def _with_retry(self, fn: Callable[[Connection], Any]) -> Any:
+    def _with_retry(
+        self, fn: Callable[[Connection], Any], retry_attempts: Optional[int] = None
+    ) -> Any:
         last: Optional[BaseException] = None
-        for attempt in range(self.retry_attempts + 1):
+        attempts = self.retry_attempts if retry_attempts is None else retry_attempts
+        for attempt in range(attempts + 1):
             if self._closed.is_set():
                 raise ConnectionError_("client is closed")
             if attempt:

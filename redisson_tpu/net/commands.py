@@ -33,7 +33,7 @@ SPECS: dict = {}
 
 # keyless / administrative (never redirected)
 _spec(SPECS, "PING ECHO AUTH HELLO SELECT CLIENT QUIT DBSIZE TIME INFO MEMORY "
-             "CLUSTER KEYS SAVE REPLICAOF REPLREGISTER "
+             "CLUSTER KEYS SAVE ROLE REPLICAOF REPLREGISTER "
              "REPLPUSH REPLFLUSH REPLSNAPSHOT REPLICAS SUBSCRIBE UNSUBSCRIBE "
              "PSUBSCRIBE PUNSUBSCRIBE PUBLISH METRICS ASKING", False, None)
 

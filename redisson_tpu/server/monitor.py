@@ -46,6 +46,8 @@ class FailoverCoordinator:
         view: List[Tuple[int, int, str, int, str]],
         check_interval: float = 0.5,
         on_failover: Optional[Callable[[str, str], None]] = None,
+        view_token: Optional[int] = None,
+        known_nodes: Optional[List[str]] = None,
     ):
         self._masters: Dict[str, MonitoredMaster] = {}
         for lo, hi, host, port, nid in view:
@@ -53,6 +55,13 @@ class FailoverCoordinator:
             self._masters[addr] = MonitoredMaster(addr, (lo, hi), nid)
         self.check_interval = check_interval
         self.on_failover = on_failover
+        # leadership fencing token (HA mode): stamped on every SETVIEW so a
+        # stale ex-leader's late writes are rejected server-side
+        self.view_token = view_token
+        # every address worth probing with ROLE when a dead master's replica
+        # list is unknown — a SUCCESSOR coordinator (HA takeover) has no
+        # poll history from before the death, so it must discover
+        self.known_nodes = [a for a in (known_nodes or [])]
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.failovers: List[Tuple[str, str]] = []  # (dead master, promoted)
@@ -114,8 +123,14 @@ class FailoverCoordinator:
             flat += [m.slot_range[0], m.slot_range[1], h, int(p), m.node_id]
         return flat
 
-    def _push_view(self) -> None:
+    def _setview_args(self) -> List:
         flat = self._view_flat()
+        if self.view_token is not None:
+            return ["TOKEN", self.view_token, *flat]
+        return flat
+
+    def _push_view(self) -> None:
+        flat = self._setview_args()
         for m in list(self._masters.values()):
             try:
                 m.client.execute("CLUSTER", "SETVIEW", *flat, timeout=5.0)
@@ -142,8 +157,34 @@ class FailoverCoordinator:
 
     # -- promotion ------------------------------------------------------------
 
+    def _discover_replicas(self, master_addr: str) -> List[str]:
+        """ROLE-probe every known node for replicas of `master_addr` — the
+        successor-coordinator path: it never polled the master alive."""
+        found: List[str] = []
+        monitored = set(self._masters) | set(self._pending)
+        for addr in self.known_nodes:
+            a = addr.split("://", 1)[-1]
+            if a == master_addr or a in monitored:
+                continue
+            c = None
+            try:
+                c = NodeClient(a, ping_interval=0, retry_attempts=0)
+                role = c.execute("ROLE", timeout=2.0)
+                if role and bytes(role[0]) == b"slave":
+                    host = role[1].decode() if isinstance(role[1], bytes) else role[1]
+                    if f"{host}:{int(role[2])}" == master_addr:
+                        found.append(a)
+            except Exception:  # noqa: BLE001 — node down/probing best-effort
+                continue
+            finally:
+                if c is not None:
+                    c.close()
+        return found
+
     def _failover(self, dead: MonitoredMaster) -> None:
         self._masters.pop(dead.address, None)
+        if not dead.replicas:
+            dead.replicas = self._discover_replicas(dead.address)
         promoted: Optional[str] = None
         for candidate in dead.replicas:
             c = None
@@ -172,11 +213,12 @@ class FailoverCoordinator:
         # ranges stay in the view so their slots aren't orphaned
         self._push_view()
         # surviving replicas of the dead master re-attach to the promoted one
+        setview = self._setview_args()
         for r in nm.replicas:
             rc = None
             try:
                 rc = NodeClient(r, ping_interval=0, retry_attempts=0)
-                rc.execute("CLUSTER", "SETVIEW", *flat, timeout=5.0)
+                rc.execute("CLUSTER", "SETVIEW", *setview, timeout=5.0)
                 rc.execute("REPLICAOF", host, int(port), timeout=120.0)
             except Exception:  # noqa: BLE001
                 continue
@@ -189,3 +231,238 @@ class FailoverCoordinator:
                 self.on_failover(dead.address, promoted)
             except Exception:  # noqa: BLE001 — user callback must not kill the loop
                 pass
+
+
+class HAFailoverCoordinator:
+    """Coordinator HA (VERDICT r2 #7): run N of these; exactly one acts.
+
+    Leadership rides the framework's own FencedLock over the cluster
+    (reference analog: the sentinel layer tolerating sentinel death,
+    connection/SentinelConnectionManager.java:210-430 — re-expressed with
+    a lease instead of a quorum vote):
+      * each instance loops trying the leader lock (client-side watchdog
+        renews while alive; a crashed leader stops renewing and the lease
+        lapses — RedissonBaseLock.java:127-189 discipline);
+      * the winner gets a strictly monotonic FENCING token and runs a
+        FailoverCoordinator stamping every SETVIEW with it; nodes reject
+        lower-token views (registry CLUSTER SETVIEW TOKEN), so a paused
+        ex-leader resuming after its lease lapsed cannot clobber its
+        successor's topology;
+      * standbys keep polling; promotion is idempotent, so the successor
+        re-driving a half-finished failover converges.
+
+    Known limitation (documented, like single-sentinel deployments): the
+    leader lock lives on the cluster itself; if the shard owning the lock
+    name is down, leadership cannot CHANGE until that range recovers — the
+    incumbent keeps acting on its last-known lease.  Pin the lock to a
+    well-replicated shard with a {hashtag} if that matters.
+    """
+
+    LOCK_NAME = "redisson:failover:leader"
+
+    def __init__(
+        self,
+        view: List[Tuple[int, int, str, int, str]],
+        seeds: List[str],
+        check_interval: float = 0.5,
+        lease: float = 3.0,
+        on_failover: Optional[Callable[[str, str], None]] = None,
+        lock_name: Optional[str] = None,
+    ):
+        self._view = list(view)
+        self._seeds = list(seeds)
+        self.check_interval = check_interval
+        self.lease = lease
+        self.on_failover = on_failover
+        self.lock_name = lock_name or self.LOCK_NAME
+        self._stop = threading.Event()
+        self._release_on_stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._inner: Optional[FailoverCoordinator] = None
+        self._client = None
+        self.is_leader = threading.Event()
+        self.token: Optional[int] = None
+        # failover history survives demotion (an operator reading .failovers
+        # after a lease loss must still see what happened on our watch)
+        self._failover_log: List[Tuple[str, str]] = []
+        self._log_lock = threading.Lock()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "HAFailoverCoordinator":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="rtpu-ha-failover"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Graceful stop: releases leadership so a standby takes over fast.
+        The unlock happens ON the _run thread (synchronizer identity is
+        uuid:threadId — a cross-thread unlock would be rejected as a
+        non-owner, silently degrading stop() into kill())."""
+        self._release_on_stop.set()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._teardown(release=False)
+
+    def kill(self) -> None:
+        """Crash simulation: abandon WITHOUT unlocking — the lease must
+        lapse before a standby can take over (chaos-test hook)."""
+        self._stop.set()
+        if self._inner is not None:
+            self._inner.stop()
+            self._inner = None
+        if self._client is not None:
+            try:
+                self._client.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            self._client = None
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _teardown(self, release: bool) -> None:
+        if self._inner is not None:
+            self._inner.stop()
+            self._inner = None
+        self.is_leader.clear()
+        if self._client is not None:
+            if release:
+                try:
+                    self._client.objcall(
+                        "get_fenced_lock", self.lock_name, "unlock", (), {}
+                    )
+                except Exception:  # noqa: BLE001 — lease will lapse anyway
+                    pass
+            try:
+                self._client.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            self._client = None
+
+    # -- leadership loop -------------------------------------------------------
+
+    def _make_client(self):
+        from redisson_tpu.client.cluster import ClusterRedisson
+
+        return ClusterRedisson(self._seeds, scan_interval=2.0, timeout=10.0)
+
+    def _current_view(self) -> List[Tuple[int, int, str, int, str]]:
+        """The cluster's CURRENT slot view (CLUSTER SLOTS), falling back to
+        the constructor snapshot.  A successor leader MUST bootstrap from
+        live state: monitoring a stale snapshot after a predecessor's
+        completed failover would treat the promoted replica's range as
+        still owned by the old (dead) master — and, armed with a newer
+        fencing token, re-installing that stale map on a master restart
+        would make the pre-failover topology authoritative again."""
+        try:
+            rows = self._client.execute("CLUSTER", "SLOTS", timeout=5.0)
+            view = []
+            for row in rows:
+                lo, hi, (host, port, nid) = int(row[0]), int(row[1]), row[2]
+                host = host.decode() if isinstance(host, bytes) else host
+                nid = nid.decode() if isinstance(nid, bytes) else nid
+                view.append((lo, hi, host, int(port), nid))
+            if view:
+                return view
+        except Exception:  # noqa: BLE001 — fall back to the snapshot
+            pass
+        return list(self._view)
+
+    def _record_failover(self, dead: str, promoted: str) -> None:
+        with self._log_lock:
+            self._failover_log.append((dead, promoted))
+        if self.on_failover is not None:
+            try:
+                self.on_failover(dead, promoted)
+            except Exception:  # noqa: BLE001 — user callback must not kill us
+                pass
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self._client is None:
+                    self._client = self._make_client()
+                # acquire + fencing token in ONE atomic server-side step
+                # (two steps would let a lapse-and-steal between them hand
+                # two leaders the same token).  EXPLICIT short lease, not
+                # the 30s client watchdog: a crashed leader stops renewing
+                # and the lease lapses within `lease` seconds.
+                token = self._client.objcall(
+                    "get_fenced_lock", self.lock_name,
+                    "try_lock_and_get_token", (self.lease / 2, self.lease), {},
+                )
+                if token is None:
+                    continue
+            except Exception:  # noqa: BLE001 — cluster briefly away; retry
+                if self._client is not None:
+                    try:
+                        self._client.shutdown()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._client = None
+                self._stop.wait(min(1.0, self.lease / 2))
+                continue
+            try:
+                self.token = int(token)
+                self._inner = FailoverCoordinator(
+                    self._current_view(),
+                    check_interval=self.check_interval,
+                    on_failover=self._record_failover,
+                    view_token=self.token,
+                    known_nodes=self._seeds,
+                ).start()
+                self.is_leader.set()
+                # hold leadership: renew at lease/3.  Demotion triggers on a
+                # clean False (someone else holds it) OR when no renewal has
+                # SUCCEEDED within a full lease — a partitioned leader whose
+                # renew calls all raise must stand down, not act forever on
+                # a lease that lapsed (its unfenced REPLICAOF commands would
+                # otherwise race the successor's)
+                last_ok = time.time()
+                while not self._stop.wait(self.lease / 3):
+                    try:
+                        if not self._client.objcall(
+                            "get_fenced_lock", self.lock_name,
+                            "renew_lease", (self.lease,), {},
+                        ):
+                            break
+                        last_ok = time.time()
+                    except Exception:  # noqa: BLE001 — transient unless stale
+                        if time.time() - last_ok > self.lease:
+                            break
+                if self._stop.is_set() and self._release_on_stop.is_set():
+                    # graceful stop: unlock FROM THIS THREAD (the holder
+                    # identity is per-thread) so a standby takes over fast
+                    try:
+                        self._client.objcall(
+                            "get_fenced_lock", self.lock_name, "unlock", (), {}
+                        )
+                    except Exception:  # noqa: BLE001 — lease will lapse anyway
+                        pass
+            except Exception:  # noqa: BLE001 — leadership bootstrap failed:
+                # drop the (possibly broken) client and return to standby;
+                # the thread must NEVER die silently, or this instance
+                # leaves the HA pool forever
+                if self._client is not None:
+                    try:
+                        self._client.shutdown()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._client = None
+                self._stop.wait(min(1.0, self.lease / 2))
+            finally:
+                self.is_leader.clear()
+                if self._inner is not None:
+                    self._inner.stop()
+                    self._inner = None
+
+    # -- introspection ---------------------------------------------------------
+
+    @property
+    def failovers(self) -> List[Tuple[str, str]]:
+        """Failovers performed on THIS instance's watch — survives demotion."""
+        with self._log_lock:
+            return list(self._failover_log)

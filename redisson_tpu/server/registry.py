@@ -816,13 +816,29 @@ def cmd_cluster(server, ctx, args):
         state = "ok" if server.cluster_view else "ok"
         return f"cluster_enabled:{1 if server.cluster_view else 0}\r\ncluster_state:{state}\r\n".encode()
     if sub == b"SETVIEW":
-        # SETVIEW <from> <to> <host> <port> <node_id> ... (5-tuples) —
-        # the topology/launcher (harness.ClusterRunner, server/monitor.py)
-        # installs the slot map on every node; the reference's analog is
-        # each node's view from CLUSTER NODES gossip
+        # SETVIEW [TOKEN <n>] <from> <to> <host> <port> <node_id> ...
+        # (5-tuples) — the topology/launcher (harness.ClusterRunner,
+        # server/monitor.py) installs the slot map on every node; the
+        # reference's analog is each node's view from CLUSTER NODES gossip.
+        # TOKEN carries the writing coordinator's FENCING token (its
+        # FencedLock leadership token): a view stamped with a LOWER token
+        # than the last accepted one is a stale ex-leader's late write and
+        # is rejected — the fencing discipline that makes coordinator HA
+        # safe (a paused leader resuming after its lease lapsed cannot
+        # clobber its successor's topology).
         rest = args[1:]
+        token = None
+        if rest and bytes(rest[0]).upper() == b"TOKEN":
+            token = _int(rest[1])
+            rest = rest[2:]
         if len(rest) % 5 != 0:
             raise RespError("ERR SETVIEW expects 5-tuples")
+        if token is not None:
+            if token < server.view_epoch:
+                raise RespError(
+                    f"STALEVIEW token {token} < accepted epoch {server.view_epoch}"
+                )
+            server.view_epoch = token
         view = []
         for i in range(0, len(rest), 5):
             view.append(
@@ -971,6 +987,21 @@ def cmd_replflush(server, ctx, args):
     if server._replication is None:
         return 0
     return server._replication.flush()
+
+
+@register("ROLE")
+def cmd_role(server, ctx, args):
+    """Redis ROLE parity: master -> ["master", 0, [replica addrs]];
+    replica -> ["slave", host, port, "connected", 0].  Failover
+    coordinators probe this to DISCOVER a dead master's replicas when they
+    started after the death (a successor coordinator has no poll history)."""
+    if server.role == "replica" and server.master_address:
+        host, _, port = server.master_address.rpartition(":")
+        return [b"slave", host.encode(), int(port), b"connected", 0]
+    reps = []
+    if server._replication is not None:
+        reps = [a.encode() for a in server._replication.replicas()]
+    return [b"master", 0, reps]
 
 
 @register("REPLICAS")

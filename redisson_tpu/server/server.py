@@ -120,6 +120,10 @@ class TpuServer:
         # cluster_view: [(slot_from, slot_to, host, port, node_id)] when this
         # node is part of a cluster (set by the topology/launcher, L3')
         self.cluster_view: List[Tuple[int, int, str, int, str]] = []
+        # highest accepted SETVIEW fencing token (coordinator-HA discipline:
+        # a stale ex-leader's late view write carries a lower token and is
+        # rejected; see registry.py CLUSTER SETVIEW TOKEN)
+        self.view_epoch: int = 0
         # live resharding state (the MIGRATING/IMPORTING window of the
         # reference's slot-migration protocol, cluster/ClusterConnectionManager
         # .java:358-450 checkSlotsMigration + RedisExecutor ASK handling):

@@ -219,10 +219,15 @@ def test_watchdog_reconnect_across_restart():
 
 def test_failed_connection_detector():
     det = FailedConnectionDetector(threshold=2, window_s=60)
+    # a client to a down node CONSTRUCTS (warm-up is best-effort — failure
+    # detectors and coordinators hold clients to currently-dead nodes);
+    # the connect error surfaces on first use and feeds the detector
+    nc = NodeClient("tpu://127.0.0.1:1", detector=det, retry_attempts=1,
+                    ping_interval=0, connect_timeout=0.2, min_idle=1)
     with pytest.raises((ConnectionError, OSError)):
-        NodeClient("tpu://127.0.0.1:1", detector=det, retry_attempts=1,
-                   ping_interval=0, connect_timeout=0.2, min_idle=1)
+        nc.execute("PING", timeout=1.0)
     assert det.is_node_failed() or det._counter.count() >= 1
+    nc.close()
 
 
 def test_failed_commands_detector_feed(client):
