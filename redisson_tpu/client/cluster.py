@@ -208,12 +208,14 @@ class ClusterRedisson(RemoteSurface):
             existing = dict(self._entries)
         fresh: Dict[str, ShardEntry] = {}
         for addr in masters:
-            # gate EVERY entry — new or existing — on ONE single-shot ping:
-            # a dead master must leave the routing table (keyless commands
-            # and stale-slot fallbacks would otherwise keep picking it), and
-            # must cost one refused connect, not retries-with-backoff under
-            # the refresh lock.  Entry construction itself is lazy (pool
-            # warm-up is best-effort).
+            # gate EVERY entry on ONE single-shot ping: a dead master must
+            # leave the routing table (keyless commands and stale-slot
+            # fallbacks would otherwise keep picking it), and must cost one
+            # refused connect, not retries-with-backoff under the refresh
+            # lock.  EXISTING entries get grace: a healthy-but-slow shard
+            # (GC pause, first XLA compile) failing ONE probe must not have
+            # its warm pools torn down — eviction needs two consecutive
+            # failed refreshes.  New entries admit only on a clean ping.
             entry = existing.get(addr)
             created = False
             try:
@@ -223,11 +225,17 @@ class ClusterRedisson(RemoteSurface):
                     )
                     created = True
                 entry.master.execute("PING", timeout=2.0, retry_attempts=0)
+                entry.refresh_failures = 0
                 fresh[addr] = entry
-            except Exception:  # noqa: BLE001 — node down; slot stays unroutable
-                if created and entry is not None:
-                    entry.close()
-                continue
+            except Exception:  # noqa: BLE001 — node down or stalled
+                if created:
+                    if entry is not None:
+                        entry.close()
+                    continue
+                entry.refresh_failures = getattr(entry, "refresh_failures", 0) + 1
+                if entry.refresh_failures < 2:
+                    fresh[addr] = entry  # grace period: keep routing to it
+                # else: dropped from fresh -> closed as retired below
         # replica discovery per master (REPLICAS command) — still outside
         # lock, single-shot for the same reason
         for addr, entry in fresh.items():

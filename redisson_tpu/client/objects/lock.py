@@ -34,6 +34,13 @@ from redisson_tpu.core.store import StateRecord
 DEFAULT_LEASE = 30.0  # lockWatchdogTimeout default (config/Config.java:71)
 
 
+def unlock_channel(name: str) -> str:
+    """Canonical unlock-wakeup channel for a lock name — the ONE definition
+    both the engine publisher and the remote client's park subscribe to
+    (pubsub/LockPubSub.java's redisson_lock__channel:{name})."""
+    return f"redisson_lock__channel:{name}"
+
+
 def _holder_id(engine) -> str:
     """uuid:threadId — the reference's LockName (RedissonBaseLock.getLockName).
     A remote caller's identity (set via engine.impersonate) wins, so locks
@@ -65,6 +72,16 @@ class Lock(RExpirable):
 
     def _wait(self):
         return self._engine.wait_entry(f"__lock__:{self._name}")
+
+    def unlock_channel(self) -> str:
+        """The wakeup channel remote waiters park on (the reference's
+        redisson_lock__channel:{name}, pubsub/LockPubSub.java)."""
+        return unlock_channel(self._name)
+
+    def _publish_unlock(self) -> None:
+        # wake REMOTE waiters parked on the unlock channel (LockPubSub's
+        # UNLOCK_MESSAGE); in-process waiters ride _wait().signal()
+        self._engine.pubsub.publish(self.unlock_channel(), b"0")
 
     def _expired(self, h) -> bool:
         return h["lease_until"] is not None and time.time() >= h["lease_until"]
@@ -175,6 +192,7 @@ class Lock(RExpirable):
             # pending wheel entry to discover the release a tick later
             self._engine.cancel_renewal(self._name, me)
             self._wait().signal()
+            self._publish_unlock()
 
     def force_unlock(self) -> bool:
         with self._engine.locked(self._name):
@@ -184,6 +202,7 @@ class Lock(RExpirable):
             self._touch_version(rec)
         self._engine.cancel_renewal(self._name)  # every holder's watchdog
         self._wait().signal(all_=True)
+        self._publish_unlock()
         return held
 
     def is_locked(self) -> bool:

@@ -518,13 +518,16 @@ class RemoteLock(RemoteObjectProxy):
     scheduleExpirationRenewal in the client JVM for the same reason,
     RedissonBaseLock.java:127-189).
 
-    Acquisition is a client-side polling loop of NON-blocking server calls —
-    a blocking server-side lock() would pin a server worker thread for the
-    whole wait and collide with the command response timeout (the reference
-    parks in the client JVM on a pubsub latch for the same reason,
-    RedissonLock.java:120-144; the spin discipline here is RSpinLock's)."""
+    Contended acquisition PARKS on the lock's unlock channel and retries on
+    the push (RedissonLock.java:120-144 + pubsub/LockPubSub.java — the
+    reference parks in the client JVM on a pubsub latch); a bounded poll
+    remains as the safety net for a publish lost between the failed try and
+    the subscribe (and for lease-expiry takeovers, which publish nothing).
+    A blocking server-side lock() would pin a server worker thread for the
+    whole wait and collide with the command response timeout."""
 
     _WATCHDOG_LEASE = 30.0
+    _SAFETY_POLL = 0.25  # park cap: lost-publish / lease-expiry safety net
 
     def __init__(self, client: "RemoteRedisson", factory: str, name: str):
         super().__init__(client, factory, name)
@@ -536,31 +539,71 @@ class RemoteLock(RemoteObjectProxy):
             self._factory, self._name, "try_lock", (0.0, lease_time), {}
         )
 
-    def lock(self, lease_time=None) -> None:
-        import time as _time
+    class _UnlockPark:
+        """Subscription to the unlock channel for ONE contended wait: the
+        push sets the event; park() waits push-or-timeout."""
 
-        delay = 0.001
-        while not self._try_once(lease_time):
-            _time.sleep(delay)
-            delay = min(delay * 2, 0.1)
-        if lease_time is None:
-            self._start_client_watchdog()
+        def __init__(self, client, name: str):
+            from redisson_tpu.client.objects.lock import unlock_channel
+
+            self._event = _threading.Event()
+            self._channel = unlock_channel(name)
+            self._pubsub = None
+            self._listener = lambda _ch, _msg: self._event.set()
+            try:
+                self._pubsub = client.pubsub_for(name)
+                self._pubsub.subscribe(self._channel, self._listener)
+            except Exception:  # noqa: BLE001 — no pubsub? pure polling still works
+                self._pubsub = None
+
+        def park(self, timeout: float) -> None:
+            self._event.wait(timeout)
+            self._event.clear()
+
+        def close(self) -> None:
+            if self._pubsub is not None:
+                try:
+                    self._pubsub.remove_listener(self._channel, self._listener)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def lock(self, lease_time=None) -> None:
+        if self._try_once(lease_time):
+            if lease_time is None:
+                self._start_client_watchdog()
+            return
+        park = self._UnlockPark(self._client, self._name)
+        try:
+            while not self._try_once(lease_time):
+                park.park(self._SAFETY_POLL)
+            if lease_time is None:
+                self._start_client_watchdog()
+        finally:
+            park.close()
 
     def try_lock(self, wait_time: float = 0.0, lease_time=None) -> bool:
         import time as _time
 
+        if self._try_once(lease_time):
+            if lease_time is None:
+                self._start_client_watchdog()
+            return True
+        if wait_time <= 0:
+            return False
         deadline = _time.monotonic() + wait_time
-        delay = 0.001
-        while True:
-            if self._try_once(lease_time):
-                if lease_time is None:
-                    self._start_client_watchdog()
-                return True
-            remaining = deadline - _time.monotonic()
-            if remaining <= 0:
-                return False
-            _time.sleep(min(delay, remaining))
-            delay = min(delay * 2, 0.1)
+        park = self._UnlockPark(self._client, self._name)
+        try:
+            while True:
+                if self._try_once(lease_time):
+                    if lease_time is None:
+                        self._start_client_watchdog()
+                    return True
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return False
+                park.park(min(self._SAFETY_POLL, remaining))
+        finally:
+            park.close()
 
     def unlock(self) -> None:
         self._stop_client_watchdog()

@@ -70,16 +70,27 @@ def encode_bulk(data: Optional[bytes]) -> bytes:
     return b"$%d\r\n" % len(data) + data + CRLF
 
 
-def encode_reply(value: Any) -> bytes:
-    """Encode a server reply value (RESP2 subset + RESP3 push)."""
+def encode_reply(value: Any, proto: int = 3) -> bytes:
+    """Encode a server reply value for the negotiated protocol.
+
+    proto 3 (HELLO 3): the full typed surface — null `_`, boolean `#`,
+    double `,`, map `%`, set `~`, push `>` (CommandDecoder.java:58-270
+    marker set).  proto 2: the strictly RESP2-compliant projection real
+    Redis uses pre-HELLO — maps flatten to field-value arrays, sets and
+    pushes become plain arrays, doubles become bulk strings, booleans
+    become integers, null is the empty bulk."""
     if value is None:
-        return b"$-1\r\n"
+        return b"_\r\n" if proto >= 3 else b"$-1\r\n"
     if value is True or value is False:
+        if proto >= 3:
+            return b"#t\r\n" if value else b"#f\r\n"
         return encode_int(1 if value else 0)
     if isinstance(value, int):
         return encode_int(value)
     if isinstance(value, float):
-        return b"," + repr(value).encode() + CRLF
+        if proto >= 3:
+            return b"," + repr(value).encode() + CRLF
+        return encode_bulk(repr(value).encode())
     if isinstance(value, (bytes, bytearray, memoryview)):
         return encode_bulk(bytes(value))
     if isinstance(value, str):
@@ -87,15 +98,28 @@ def encode_reply(value: Any) -> bytes:
     if isinstance(value, RespError):
         return encode_error(str(value.args[0]) if value.args else "ERR")
     if isinstance(value, Push):
-        return b">%d\r\n" % len(value) + b"".join(encode_reply(v) for v in value)
+        marker = b">" if proto >= 3 else b"*"
+        return marker + b"%d\r\n" % len(value) + b"".join(
+            encode_reply(v, proto) for v in value
+        )
     if isinstance(value, (list, tuple)):
-        return b"*%d\r\n" % len(value) + b"".join(encode_reply(v) for v in value)
+        return b"*%d\r\n" % len(value) + b"".join(encode_reply(v, proto) for v in value)
+    if isinstance(value, (set, frozenset)):
+        marker = b"~" if proto >= 3 else b"*"
+        return marker + b"%d\r\n" % len(value) + b"".join(
+            encode_reply(v, proto) for v in sorted(value, key=repr)
+        )
     if isinstance(value, dict):
-        # RESP3 map — our parser reconstructs dicts on both ends
-        out = [b"%%%d\r\n" % len(value)]
+        if proto >= 3:
+            out = [b"%%%d\r\n" % len(value)]
+            for k, v in value.items():
+                out.append(encode_reply(k, proto))
+                out.append(encode_reply(v, proto))
+            return b"".join(out)
+        out = [b"*%d\r\n" % (2 * len(value))]
         for k, v in value.items():
-            out.append(encode_reply(k))
-            out.append(encode_reply(v))
+            out.append(encode_reply(k, proto))
+            out.append(encode_reply(v, proto))
         return b"".join(out)
     raise TypeError(f"cannot encode reply of type {type(value).__name__}")
 

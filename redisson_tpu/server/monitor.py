@@ -158,9 +158,18 @@ class FailoverCoordinator:
     # -- promotion ------------------------------------------------------------
 
     def _discover_replicas(self, master_addr: str) -> List[str]:
-        """ROLE-probe every known node for replicas of `master_addr` — the
-        successor-coordinator path: it never polled the master alive."""
-        found: List[str] = []
+        """ROLE-probe every known node for promotion candidates — the
+        successor-coordinator path: it never polled the master alive.
+
+        Two classes of candidate, slaves first:
+          * a node reporting ROLE slave OF the dead master;
+          * a node reporting ROLE MASTER that is in nobody's view and not
+            monitored — the signature of a HALF-FINISHED failover (the
+            predecessor ran REPLICAOF NO ONE, died before SETVIEW).
+            Adopting it converges the predecessor's work; the promotion
+            command is idempotent on an already-master."""
+        slaves: List[str] = []
+        orphan_masters: List[str] = []
         monitored = set(self._masters) | set(self._pending)
         for addr in self.known_nodes:
             a = addr.split("://", 1)[-1]
@@ -173,13 +182,15 @@ class FailoverCoordinator:
                 if role and bytes(role[0]) == b"slave":
                     host = role[1].decode() if isinstance(role[1], bytes) else role[1]
                     if f"{host}:{int(role[2])}" == master_addr:
-                        found.append(a)
+                        slaves.append(a)
+                elif role and bytes(role[0]) == b"master":
+                    orphan_masters.append(a)
             except Exception:  # noqa: BLE001 — node down/probing best-effort
                 continue
             finally:
                 if c is not None:
                     c.close()
-        return found
+        return slaves + orphan_masters
 
     def _failover(self, dead: MonitoredMaster) -> None:
         self._masters.pop(dead.address, None)
@@ -305,7 +316,7 @@ class HAFailoverCoordinator:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
-        self._teardown(release=False)
+        self._teardown()
 
     def kill(self) -> None:
         """Crash simulation: abandon WITHOUT unlocking — the lease must
@@ -323,19 +334,14 @@ class HAFailoverCoordinator:
         if self._thread is not None:
             self._thread.join(timeout=10)
 
-    def _teardown(self, release: bool) -> None:
+    def _teardown(self) -> None:
+        # NO unlock here: the synchronizer identity is uuid:threadId, so
+        # only the _run thread can release (stop() routes the unlock there)
         if self._inner is not None:
             self._inner.stop()
             self._inner = None
         self.is_leader.clear()
         if self._client is not None:
-            if release:
-                try:
-                    self._client.objcall(
-                        "get_fenced_lock", self.lock_name, "unlock", (), {}
-                    )
-                except Exception:  # noqa: BLE001 — lease will lapse anyway
-                    pass
             try:
                 self._client.shutdown()
             except Exception:  # noqa: BLE001
@@ -349,27 +355,30 @@ class HAFailoverCoordinator:
 
         return ClusterRedisson(self._seeds, scan_interval=2.0, timeout=10.0)
 
-    def _current_view(self) -> List[Tuple[int, int, str, int, str]]:
-        """The cluster's CURRENT slot view (CLUSTER SLOTS), falling back to
-        the constructor snapshot.  A successor leader MUST bootstrap from
-        live state: monitoring a stale snapshot after a predecessor's
-        completed failover would treat the promoted replica's range as
-        still owned by the old (dead) master — and, armed with a newer
-        fencing token, re-installing that stale map on a master restart
-        would make the pre-failover topology authoritative again."""
-        try:
-            rows = self._client.execute("CLUSTER", "SLOTS", timeout=5.0)
-            view = []
-            for row in rows:
-                lo, hi, (host, port, nid) = int(row[0]), int(row[1]), row[2]
-                host = host.decode() if isinstance(host, bytes) else host
-                nid = nid.decode() if isinstance(nid, bytes) else nid
-                view.append((lo, hi, host, int(port), nid))
-            if view:
-                return view
-        except Exception:  # noqa: BLE001 — fall back to the snapshot
-            pass
-        return list(self._view)
+    def _current_view(self) -> Optional[List[Tuple[int, int, str, int, str]]]:
+        """The cluster's CURRENT slot view (CLUSTER SLOTS), or None when it
+        cannot be fetched.  A successor leader MUST bootstrap from live
+        state: monitoring a stale snapshot after a predecessor's completed
+        failover would treat the promoted replica's range as still owned by
+        the old (dead) master — and, armed with a newer fencing token,
+        re-installing that stale map would make the pre-failover topology
+        authoritative again.  So on failure the caller must NOT lead —
+        better briefly leaderless than confidently wrong."""
+        for _ in range(3):
+            try:
+                rows = self._client.execute("CLUSTER", "SLOTS", timeout=5.0)
+                view = []
+                for row in rows:
+                    lo, hi, (host, port, nid) = int(row[0]), int(row[1]), row[2]
+                    host = host.decode() if isinstance(host, bytes) else host
+                    nid = nid.decode() if isinstance(nid, bytes) else nid
+                    view.append((lo, hi, host, int(port), nid))
+                if view:
+                    return view
+            except Exception:  # noqa: BLE001 — retry, then refuse to lead
+                pass
+            self._stop.wait(0.3)
+        return None
 
     def _record_failover(self, dead: str, promoted: str) -> None:
         with self._log_lock:
@@ -407,8 +416,21 @@ class HAFailoverCoordinator:
                 continue
             try:
                 self.token = int(token)
+                view = self._current_view()
+                if view is None:
+                    # can't see live topology: refuse to lead on a stale
+                    # snapshot — release (same thread = same holder id) and
+                    # return to standby
+                    try:
+                        self._client.objcall(
+                            "get_fenced_lock", self.lock_name, "unlock", (), {}
+                        )
+                    except Exception:  # noqa: BLE001 — lease will lapse
+                        pass
+                    self._stop.wait(min(1.0, self.lease / 2))
+                    continue
                 self._inner = FailoverCoordinator(
-                    self._current_view(),
+                    view,
                     check_interval=self.check_interval,
                     on_failover=self._record_failover,
                     view_token=self.token,

@@ -111,6 +111,10 @@ class CommandContext:
         # auth required when a default password OR any ACL user is set
         self.authenticated = server.password is None and not getattr(server, "users", None)
         self.username: Optional[str] = None
+        # negotiated protocol: this wire is RESP3-native (typed maps/sets/
+        # push/null/bool/double frames); HELLO 2 downgrades the connection
+        # to the strict RESP2 projection for compatibility clients
+        self.proto: int = 3
         self.name: Optional[str] = None
         self.subscriptions: Dict[str, int] = {}
         self.psubscriptions: Dict[str, int] = {}
@@ -218,15 +222,34 @@ def cmd_auth(server, ctx, args):
 
 @register("HELLO")
 def cmd_hello(server, ctx, args):
-    # RESP3 negotiation-lite: always answers the map; protocol stays RESP2
-    # framing with push support (our parser handles both)
+    """HELLO [protover [AUTH user pass]] — the real protocol switch
+    (config/Config.java:57-99 protocol knob; CommandDecoder.java markers).
+    This wire is RESP3-native by default; HELLO 2 downgrades the connection
+    to the strict RESP2 projection (maps flatten, pushes become arrays)."""
+    i = 0
+    if args and bytes(args[0]).isdigit():
+        ver = _int(args[0])
+        if ver not in (2, 3):
+            raise RespError("NOPROTO unsupported protocol version")
+        ctx.proto = ver
+        i = 1
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"AUTH" and i + 2 < len(args):
+            cmd_auth(server, ctx, [args[i + 1], args[i + 2]])
+            i += 3
+        elif opt == b"SETNAME" and i + 1 < len(args):
+            ctx.name = _s(args[i + 1])
+            i += 2
+        else:
+            raise RespError(f"ERR unknown HELLO option '{_s(args[i])}'")
     return {
         b"server": b"redisson-tpu",
         b"version": VERSION.encode(),
-        b"proto": 2,
+        b"proto": ctx.proto,
         b"id": server.next_client_id(),
         b"mode": server.mode.encode(),
-        b"role": b"master",
+        b"role": b"master" if server.role == "master" else b"replica",
     }
 
 

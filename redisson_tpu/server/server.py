@@ -395,7 +395,10 @@ class TpuServer:
 
         def push(msg) -> None:
             # pubsub listeners fire on engine threads; hop to the loop
-            loop.call_soon_threadsafe(write_q.put_nowait, resp.encode_reply(msg))
+            # (encoded with THIS connection's negotiated protocol)
+            loop.call_soon_threadsafe(
+                write_q.put_nowait, resp.encode_reply(msg, ctx.proto)
+            )
 
         ctx.push = push
 
@@ -482,7 +485,7 @@ class TpuServer:
                     await loop.run_in_executor(self._pool, _force_lazies, results, self)
                 for r in results:
                     write_q.put_nowait(
-                        r.data if isinstance(r, _Encoded) else _encode_result(r)
+                        r.data if isinstance(r, _Encoded) else _encode_result(r, ctx.proto)
                     )
         except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
             pass
@@ -576,13 +579,13 @@ class TpuServer:
         self._slow_pool.shutdown(wait=False)
 
 
-def _encode_result(result) -> bytes:
+def _encode_result(result, proto: int = 3) -> bytes:
     if isinstance(result, str) and result.startswith("+"):
         return resp.encode_simple(result[1:])
     if isinstance(result, list) and result and all(isinstance(r, resp.Push) for r in result):
         # subscribe-style confirmations: stream of push frames
-        return b"".join(resp.encode_reply(r) for r in result)
-    return resp.encode_reply(result)
+        return b"".join(resp.encode_reply(r, proto) for r in result)
+    return resp.encode_reply(result, proto)
 
 
 class ServerThread:

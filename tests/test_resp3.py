@@ -1,0 +1,109 @@
+"""RESP3 negotiation (VERDICT r2 #10): the wire is RESP3-native (typed
+maps/sets/push/null/bool/double frames); HELLO 2 downgrades a connection to
+the strict RESP2 projection (reference: CommandDecoder.java:58-270 markers,
+config/Config.java protocol knob)."""
+import pytest
+
+from redisson_tpu.net import resp
+from redisson_tpu.net.client import Connection
+from redisson_tpu.net.resp import Push, RespError
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture(scope="module")
+def server():
+    with ServerThread(port=0) as st:
+        yield st
+
+
+def test_hello_negotiates_and_reports_proto(server):
+    c = Connection(server.server.host, server.server.port)
+    reply = c.execute("HELLO", "3")
+    assert isinstance(reply, dict)
+    assert reply[b"proto"] == 3
+    c.close()
+
+
+def test_resp2_downgrade_flattens_maps(server):
+    c = Connection(server.server.host, server.server.port)
+    assert isinstance(c.execute("HELLO", "3"), dict)
+    # switch to RESP2; the switch reply itself is already RESP2-framed
+    reply = c.execute("HELLO", "2")
+    assert isinstance(reply, list), "RESP2 maps must flatten to arrays"
+    flat = {reply[i]: reply[i + 1] for i in range(0, len(reply), 2)}
+    assert flat[b"proto"] == 2
+    # and switching back restores typed maps
+    assert isinstance(c.execute("HELLO", "3"), dict)
+    c.close()
+
+
+def test_unsupported_proto_rejected(server):
+    c = Connection(server.server.host, server.server.port)
+    reply = c.execute("HELLO", "4")
+    assert isinstance(reply, RespError) and "NOPROTO" in str(reply)
+    c.close()
+
+
+def test_hello_auth_and_setname():
+    with ServerThread(port=0, users={"svc": "spw"}) as st:
+        c = Connection(st.server.host, st.server.port)
+        reply = c.execute("HELLO", "3", "AUTH", "svc", "spw", "SETNAME", "conn-1")
+        assert isinstance(reply, dict) and reply[b"proto"] == 3
+        # authenticated: data commands work now
+        assert not isinstance(c.execute("SET", "h:k", "v"), RespError)
+        c.close()
+
+
+def test_resp2_pubsub_messages_are_arrays(server):
+    """A RESP2 connection receives pubsub traffic as plain arrays (real
+    Redis pre-HELLO behavior); RESP3 connections get typed push frames."""
+    sub2 = Connection(server.server.host, server.server.port)
+    sub2.execute("HELLO", "2")
+    sub2.send("SUBSCRIBE", "r3:chan")
+    sub3 = Connection(server.server.host, server.server.port)
+    sub3.send("SUBSCRIBE", "r3:chan")
+
+    pub = Connection(server.server.host, server.server.port)
+    # drain subscribe confirmations first
+    conf2 = sub2.read_reply(timeout=5)
+    conf3 = sub3.read_reply(timeout=5)
+    assert not isinstance(conf2, Push), f"RESP2 confirmation was typed: {conf2!r}"
+    assert isinstance(conf3, Push)
+    pub.execute("PUBLISH", "r3:chan", "msg")
+    m2 = sub2.read_reply(timeout=5)
+    m3_seen = []
+    sub3.push_handler = m3_seen.append
+    try:
+        sub3.read_reply(timeout=1)
+    except Exception:  # noqa: BLE001 — only push frames arrive; timeout is fine
+        pass
+    assert isinstance(m2, list) and not isinstance(m2, Push)
+    assert m2[0] == b"message" and m2[2] == b"msg"
+    assert m3_seen and isinstance(m3_seen[0], Push)
+    for c in (sub2, sub3, pub):
+        c.close()
+
+
+def test_resp3_typed_scalars_roundtrip():
+    """None/bool/float/set encode as RESP3 typed frames and the parser
+    reconstructs them; RESP2 projection degrades them losslessly enough."""
+    assert resp.encode_reply(None, 3) == b"_\r\n"
+    assert resp.encode_reply(None, 2) == b"$-1\r\n"
+    assert resp.encode_reply(True, 3) == b"#t\r\n"
+    assert resp.encode_reply(True, 2) == b":1\r\n"
+    assert resp.encode_reply(1.5, 3) == b",1.5\r\n"
+    assert resp.encode_reply(1.5, 2) == b"$3\r\n1.5\r\n"
+    assert resp.encode_reply({b"a": 1}, 3).startswith(b"%1\r\n")
+    assert resp.encode_reply({b"a": 1}, 2).startswith(b"*2\r\n")
+    assert resp.encode_reply({b"x"}, 3).startswith(b"~1\r\n")
+    assert resp.encode_reply({b"x"}, 2).startswith(b"*1\r\n")
+    # parser round-trip of the typed forms
+    parser = resp.RespParser()
+    vals = parser.feed(
+        resp.encode_reply(None, 3)
+        + resp.encode_reply(False, 3)
+        + resp.encode_reply(2.25, 3)
+        + resp.encode_reply({b"k": b"v"}, 3)
+    )
+    assert vals[0] is None and vals[1] is False and vals[2] == 2.25
+    assert vals[3] == {b"k": b"v"}
