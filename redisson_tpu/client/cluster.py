@@ -228,7 +228,9 @@ class ClusterRedisson(RemoteSurface):
                 entry.refresh_failures = 0
                 fresh[addr] = entry
             except Exception:  # noqa: BLE001 — node down or stalled
-                if created:
+                if created or entry is None:
+                    # construction itself failed (unparseable address, TLS
+                    # context error) or never happened: nothing to grace
                     if entry is not None:
                         entry.close()
                     continue

@@ -192,3 +192,111 @@ class JsonBucket(RExpirable):
         if v is None:
             return None
         return {dict: "object", list: "array", str: "string", bool: "boolean", int: "integer", float: "number"}[type(v)]
+
+    def clear(self, path: str = "$") -> int:
+        """JSON.CLEAR: empty containers, zero numbers; returns #cleared."""
+        with self._engine.locked(self._name):
+            v = self.get(path)
+            if isinstance(v, dict) or isinstance(v, list):
+                self.set(path, {} if isinstance(v, dict) else [])
+                return 1
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.set(path, 0)
+                return 1
+            return 0
+
+    def toggle(self, path: str) -> Optional[bool]:
+        """JSON.TOGGLE a boolean; returns the new value."""
+        with self._engine.locked(self._name):
+            v = self.get(path)
+            if not isinstance(v, bool):
+                return None
+            self.set(path, not v)
+            return not v
+
+    def string_append(self, path: str, s: str) -> int:
+        """JSON.STRAPPEND; returns the new string length."""
+        with self._engine.locked(self._name):
+            cur = self.get(path)
+            if not isinstance(cur, str):
+                raise TypeError(f"value at {path!r} is not a string")
+            new = cur + s
+            self.set(path, new)
+            return len(new)
+
+    def array_insert(self, path: str, index: int, *values) -> int:
+        """JSON.ARRINSERT; returns the new array length."""
+        with self._engine.locked(self._name):
+            arr = self.get(path)
+            if not isinstance(arr, list):
+                raise TypeError(f"value at {path!r} is not an array")
+            for off, v in enumerate(values):
+                arr.insert(index + off, json.loads(json.dumps(v)))
+            self._touch_version(self._rec_or_create())
+            return len(arr)
+
+    def array_pop(self, path: str, index: int = -1) -> Any:
+        """JSON.ARRPOP; returns the popped element (None on empty/missing)."""
+        with self._engine.locked(self._name):
+            arr = self.get(path)
+            if not isinstance(arr, list) or not arr:
+                return None
+            v = arr.pop(index)
+            self._touch_version(self._rec_or_create())
+            return v
+
+    def array_trim(self, path: str, start: int, stop: int) -> int:
+        """JSON.ARRTRIM to [start, stop] inclusive; negative indexes count
+        from the end Redis-style (stop=-1 keeps through the last element);
+        returns the new length."""
+        with self._engine.locked(self._name):
+            arr = self.get(path)
+            if not isinstance(arr, list):
+                raise TypeError(f"value at {path!r} is not an array")
+            n = len(arr)
+            lo = max(0, start + n if start < 0 else start)
+            hi = stop + n if stop < 0 else stop
+            arr[:] = arr[lo : hi + 1] if hi >= lo else []
+            self._touch_version(self._rec_or_create())
+            return len(arr)
+
+    def array_index_of(self, path: str, value, start: int = 0, stop: int = 0) -> int:
+        """JSON.ARRINDEX; -1 when absent.  stop=0 means 'to the end'."""
+        arr = self.get(path)
+        if not isinstance(arr, list):
+            return -1
+        view = arr[start : stop if stop > 0 else len(arr)]
+        try:
+            return view.index(value) + start
+        except ValueError:
+            return -1
+
+    def object_keys(self, path: str = "$") -> Optional[List[str]]:
+        """JSON.OBJKEYS."""
+        v = self.get(path)
+        return list(v.keys()) if isinstance(v, dict) else None
+
+    def object_size(self, path: str = "$") -> Optional[int]:
+        """JSON.OBJLEN."""
+        v = self.get(path)
+        return len(v) if isinstance(v, dict) else None
+
+    def merge(self, path: str, value: Any) -> None:
+        """JSON.MERGE (RFC 7386 merge-patch): dicts merge recursively,
+        None values delete keys, everything else replaces."""
+
+        def patch(target, p):
+            if not isinstance(p, dict):
+                return json.loads(json.dumps(p))
+            if not isinstance(target, dict):
+                target = {}
+            for k, v in p.items():
+                if v is None:
+                    target.pop(k, None)
+                else:
+                    target[k] = patch(target.get(k), v)
+            return target
+
+        with self._engine.locked(self._name):
+            cur = self.get(path)
+            self.set(path, patch(cur, value))

@@ -87,6 +87,25 @@ class Stream(RExpirable):
                 self._touch_version(rec)
             return drop
 
+    def trim_by_min_id(self, min_id: str) -> int:
+        """XTRIM MINID: drop every entry with an id BELOW min_id (the second
+        trim strategy, RedissonStream StreamTrimArgs.minId)."""
+        lo = parse_id(min_id)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            before = len(rec.host["entries"])
+            rec.host["entries"] = [(i, f) for i, f in rec.host["entries"] if i >= lo]
+            drop = before - len(rec.host["entries"])
+            if drop:
+                self._touch_version(rec)
+            return drop
+
+    def last_id(self) -> Optional[str]:
+        rec = self._engine.store.get(self._name)
+        if rec is None or not rec.host["entries"]:
+            return None
+        return fmt_id(rec.host["entries"][-1][0])
+
     def remove(self, *ids: str) -> int:
         """XDEL."""
         targets = {parse_id(i) for i in ids}
@@ -301,6 +320,48 @@ class Stream(RExpirable):
             if out:
                 self._touch_version(rec)
             return fmt_id(cursor), out
+
+    def pending_summary(self, group: str) -> dict:
+        """XPENDING (summary form): total, smallest/largest pending id, and
+        per-consumer pending counts."""
+        rec = self._engine.store.get(self._name)
+        if rec is None:
+            return {"total": 0, "min_id": None, "max_id": None, "consumers": {}}
+        g = self._group(rec, group)
+        per: Dict[str, int] = {}
+        ids = sorted(g["pel"])
+        for _eid, (owner, _t, _n) in g["pel"].items():
+            per[owner] = per.get(owner, 0) + 1
+        return {
+            "total": len(ids),
+            "min_id": fmt_id(ids[0]) if ids else None,
+            "max_id": fmt_id(ids[-1]) if ids else None,
+            "consumers": per,
+        }
+
+    def remove_consumer(self, group: str, consumer: str) -> int:
+        """XGROUP DELCONSUMER: drop a consumer, DISCARDING its pending
+        entries (Redis semantics); returns #pending discarded."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            g = self._group(rec, group)
+            mine = [eid for eid, cell in g["pel"].items() if cell[0] == consumer]
+            for eid in mine:
+                del g["pel"][eid]
+            g["consumers"].pop(consumer, None)
+            if mine:
+                self._touch_version(rec)
+            return len(mine)
+
+    def set_group_id(self, group: str, from_id: str) -> None:
+        """XGROUP SETID: move the group's last-delivered cursor."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            g = self._group(rec, group)
+            g["last_delivered"] = parse_id(from_id) if from_id != "$" else (
+                rec.host["entries"][-1][0] if rec.host["entries"] else (0, 0)
+            )
+            self._touch_version(rec)
 
     def list_groups(self) -> List[str]:
         rec = self._engine.store.get(self._name)

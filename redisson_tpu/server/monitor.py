@@ -163,10 +163,13 @@ class FailoverCoordinator:
 
         Two classes of candidate, slaves first:
           * a node reporting ROLE slave OF the dead master;
-          * a node reporting ROLE MASTER that is in nobody's view and not
-            monitored — the signature of a HALF-FINISHED failover (the
-            predecessor ran REPLICAOF NO ONE, died before SETVIEW).
-            Adopting it converges the predecessor's work; the promotion
+          * an unmonitored node reporting ROLE MASTER whose promoted-from
+            breadcrumb (ROLE's 4th element) NAMES the dead master — the
+            signature of a HALF-FINISHED failover (the predecessor ran
+            REPLICAOF NO ONE, died before SETVIEW).  The breadcrumb check
+            matters: without it a RESTARTED stale master (empty data, also
+            unmonitored) would get adopted for a range it never replicated.
+            Adopting converges the predecessor's work; the promotion
             command is idempotent on an already-master."""
         slaves: List[str] = []
         orphan_masters: List[str] = []
@@ -183,8 +186,12 @@ class FailoverCoordinator:
                     host = role[1].decode() if isinstance(role[1], bytes) else role[1]
                     if f"{host}:{int(role[2])}" == master_addr:
                         slaves.append(a)
-                elif role and bytes(role[0]) == b"master":
-                    orphan_masters.append(a)
+                elif role and bytes(role[0]) == b"master" and len(role) > 3:
+                    promoted_from = (
+                        role[3].decode() if isinstance(role[3], bytes) else role[3]
+                    )
+                    if promoted_from == master_addr:
+                        orphan_masters.append(a)
             except Exception:  # noqa: BLE001 — node down/probing best-effort
                 continue
             finally:

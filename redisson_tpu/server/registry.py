@@ -957,6 +957,11 @@ def cmd_replicaof(server, ctx, args):
     """REPLICAOF NO ONE -> become master; REPLICAOF <host> <port> -> full
     sync from master, then register for the push stream."""
     if len(args) == 2 and bytes(args[0]).upper() == b"NO" and bytes(args[1]).upper() == b"ONE":
+        if server.role == "replica" and server.master_address:
+            # breadcrumb for successor coordinators: an orphaned master that
+            # can name the dead master it was promoted FROM is a
+            # half-finished failover; a restarted stale master cannot
+            server.promoted_from = server.master_address
         server.role = "master"
         server.master_address = None
         return "+OK"
@@ -1024,7 +1029,12 @@ def cmd_role(server, ctx, args):
     reps = []
     if server._replication is not None:
         reps = [a.encode() for a in server._replication.replicas()]
-    return [b"master", 0, reps]
+    promoted_from = getattr(server, "promoted_from", None)
+    # 4th element is our extension past Redis ROLE: the address this master
+    # was promoted FROM (empty when it never was a replica) — coordinators
+    # use it to adopt half-finished failovers without mistaking a restarted
+    # stale master for one
+    return [b"master", 0, reps, (promoted_from or "").encode()]
 
 
 @register("REPLICAS")

@@ -134,6 +134,10 @@ class TpuServer:
         # -- cluster / replication role (server/replication.py) -------------
         self.role = "master"  # "master" | "replica"
         self.master_address: Optional[str] = None
+        # set on REPLICAOF NO ONE promotion: the master this node replicated
+        # before — the ROLE breadcrumb coordinators use to adopt
+        # half-finished failovers (registry cmd_role / cmd_replicaof)
+        self.promoted_from: Optional[str] = None
         self._replication = None  # lazy ReplicationSource (master side)
         self._repl_lock = threading.Lock()
         self._client_ids = iter(range(1, 1 << 62))

@@ -440,9 +440,16 @@ class SearchService:
         condition: Optional[Condition] = None,
         group_by: Optional[str] = None,
         reducers: Optional[Dict[str, Tuple[str, Optional[str]]]] = None,
+        sort_by: Optional[str] = None,
+        descending: bool = False,
+        offset: int = 0,
+        limit: Optional[int] = None,
     ) -> List[Dict[str, Any]]:
-        """GROUPBY + REDUCE.  `reducers` maps output name -> (op, field);
-        ops: count/sum/avg/min/max (field ignored for count)."""
+        """GROUPBY + REDUCE [+ SORTBY + LIMIT].  `reducers` maps output
+        name -> (op, field); ops: count/sum/avg/min/max (field ignored for
+        count).  `sort_by` names any OUTPUT column (the group key or a
+        reducer name), with offset/limit paging — the FT.AGGREGATE
+        SORTBY/LIMIT pipeline stages (RedissonSearch.java aggregate)."""
         idx = self._idx(index)
         ids = idx._eval(condition)
         reducers = reducers or {"count": ("count", None)}
@@ -464,5 +471,20 @@ class SearchService:
                     )
                     row[out_name] = self._REDUCERS[op](xs)
             out.append(row)
-        out.sort(key=lambda r: (str(r.get(group_by)) if group_by else ""))
+        if sort_by is not None:
+            # type-bucketed key: a column mixing numbers and strings must
+            # sort deterministically, not raise int-vs-str TypeError
+            def _key(r):
+                v = r.get(sort_by)
+                if v is None:
+                    return (2, "", 0.0)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    return (0, "", float(v))
+                return (1, str(v), 0.0)
+
+            out.sort(key=_key, reverse=descending)
+        else:
+            out.sort(key=lambda r: (str(r.get(group_by)) if group_by else ""))
+        if offset or limit is not None:
+            out = out[offset : None if limit is None else offset + limit]
         return out

@@ -1,0 +1,170 @@
+"""Depth additions (VERDICT r2 #9): JSON path ops, stream trim strategies /
+pending summary / consumer admin, search aggregation sort+paging.
+Reference: RedissonJsonBucket.java, RedissonStream.java:1-1441,
+RedissonSearch.java."""
+import pytest
+
+import redisson_tpu
+
+
+@pytest.fixture()
+def client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+# -- JsonBucket ---------------------------------------------------------------
+
+
+def test_json_clear_toggle_strappend(client):
+    j = client.get_json_bucket("jd:doc")
+    j.set("$", {"flag": True, "n": 7, "items": [1, 2], "meta": {"a": 1}, "s": "ab"})
+    assert j.toggle("flag") is False
+    assert j.toggle("flag") is True
+    assert j.clear("items") == 1 and j.get("items") == []
+    assert j.clear("n") == 1 and j.get("n") == 0
+    assert j.clear("s") == 0  # strings aren't cleared (Redis semantics)
+    assert j.string_append("s", "cd") == 4
+    assert j.get("s") == "abcd"
+
+
+def test_json_array_ops(client):
+    j = client.get_json_bucket("jd:arr")
+    j.set("$", {"a": [1, 2, 3, 4, 5]})
+    assert j.array_insert("a", 1, 99) == 6
+    assert j.get("a") == [1, 99, 2, 3, 4, 5]
+    assert j.array_pop("a", 1) == 99
+    assert j.array_pop("a") == 5
+    assert j.array_trim("a", 1, 2) == 2
+    assert j.get("a") == [2, 3]
+    assert j.array_index_of("a", 3) == 1
+    assert j.array_index_of("a", 42) == -1
+
+
+def test_json_object_ops_and_merge(client):
+    j = client.get_json_bucket("jd:obj")
+    j.set("$", {"user": {"name": "kim", "age": 30, "tags": ["x"]}})
+    assert sorted(j.object_keys("user")) == ["age", "name", "tags"]
+    assert j.object_size("user") == 3
+    # RFC 7386 merge-patch: None deletes, dicts merge, scalars replace
+    j.merge("user", {"age": 31, "name": None, "city": "oslo"})
+    assert j.get("user") == {"age": 31, "tags": ["x"], "city": "oslo"}
+
+
+# -- Stream -------------------------------------------------------------------
+
+
+def test_stream_trim_min_id_and_last_id(client):
+    s = client.get_stream("sd:trim")
+    ids = [s.add({"i": i}) for i in range(10)]
+    assert s.last_id() == ids[-1]
+    dropped = s.trim_by_min_id(ids[4])
+    assert dropped == 4
+    assert s.size() == 6
+    assert list(s.range())[0] == ids[4]
+
+
+def test_stream_pending_summary_and_delconsumer(client):
+    s = client.get_stream("sd:pel")
+    for i in range(6):
+        s.add({"i": i})
+    s.create_group("g", from_id="0")
+    s.read_group("g", "alice", count=2)
+    s.read_group("g", "bob", count=4)
+    summary = s.pending_summary("g")
+    assert summary["total"] == 6
+    assert summary["consumers"] == {"alice": 2, "bob": 4}
+    assert summary["min_id"] is not None and summary["max_id"] is not None
+    # DELCONSUMER discards bob's pending entries
+    assert s.remove_consumer("g", "bob") == 4
+    assert s.pending_summary("g")["total"] == 2
+    assert "bob" not in s.list_consumers("g")
+
+
+def test_stream_setid_replays_history(client):
+    s = client.get_stream("sd:setid")
+    ids = [s.add({"i": i}) for i in range(4)]
+    s.create_group("g", from_id="$")  # nothing new to deliver
+    assert s.read_group("g", "c1", count=10) == {}
+    s.set_group_id("g", "0")  # rewind: everything re-delivers
+    got = s.read_group("g", "c1", count=10)
+    assert list(got) == ids
+
+
+# -- Search aggregation -------------------------------------------------------
+
+
+def test_search_aggregate_sort_and_paging(client):
+    search = client.get_search()
+    search.create_index("agg:idx", {"team": "tag", "score": "numeric"})
+    for i in range(12):
+        search.add_document(
+            "agg:idx", f"d{i}", {"team": f"t{i % 3}", "score": float(i)}
+        )
+    rows = search.aggregate(
+        "agg:idx",
+        group_by="team",
+        reducers={"n": ("count", None), "total": ("sum", "score")},
+        sort_by="total",
+        descending=True,
+    )
+    totals = [r["total"] for r in rows]
+    assert totals == sorted(totals, reverse=True)
+    assert {r["team"] for r in rows} == {"t0", "t1", "t2"}
+    # paging
+    page = search.aggregate(
+        "agg:idx", group_by="team", reducers={"n": ("count", None)},
+        sort_by="n", offset=1, limit=1,
+    )
+    assert len(page) == 1
+
+
+def test_json_array_trim_negative_indexes(client):
+    j = client.get_json_bucket("jd:negtrim")
+    j.set("$", {"a": [0, 1, 2, 3, 4]})
+    assert j.array_trim("a", 0, -1) == 5  # keep everything (Redis idiom)
+    assert j.get("a") == [0, 1, 2, 3, 4]
+    assert j.array_trim("a", -3, -2) == 2
+    assert j.get("a") == [2, 3]
+    assert j.array_trim("a", 5, 9) == 0
+    assert j.get("a") == []
+
+
+def test_search_aggregate_mixed_type_sort(client):
+    search = client.get_search()
+    search.create_index("mix:idx", {"label": "tag", "v": "numeric"})
+    search.add_document("mix:idx", "a", {"label": 42, "v": 1.0})
+    search.add_document("mix:idx", "b", {"label": "42x", "v": 2.0})
+    rows = search.aggregate(
+        "mix:idx", group_by="label", reducers={"n": ("count", None)},
+        sort_by="label",
+    )
+    assert len(rows) == 2  # no TypeError on int-vs-str
+
+
+def test_role_breadcrumb_distinguishes_promoted_from_restarted(client):
+    """Coordinator-HA discovery: only a master that NAMES the dead master it
+    was promoted from is adopted (ROLE 4th element breadcrumb)."""
+    from redisson_tpu.harness import ClusterRunner
+    from redisson_tpu.net.client import NodeClient
+
+    runner = ClusterRunner(masters=1, replicas_per_master=1).run()
+    try:
+        master = runner.masters[0]
+        replica = runner.replicas[0]
+        c = NodeClient(replica.address, ping_interval=0)
+        role = c.execute("ROLE", timeout=5.0)
+        assert bytes(role[0]) == b"slave"
+        c.execute("REPLICAOF", "NO", "ONE", timeout=10.0)
+        role = c.execute("ROLE", timeout=5.0)
+        assert bytes(role[0]) == b"master"
+        assert bytes(role[3]).decode() == master.address  # breadcrumb
+        c.close()
+        # a never-replica master has NO breadcrumb
+        cm = NodeClient(master.address, ping_interval=0)
+        role = cm.execute("ROLE", timeout=5.0)
+        assert bytes(role[3]) == b""
+        cm.close()
+    finally:
+        runner.shutdown()
