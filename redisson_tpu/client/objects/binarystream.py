@@ -225,23 +225,30 @@ class JsonBucket(RExpirable):
             return len(new)
 
     def array_insert(self, path: str, index: int, *values) -> int:
-        """JSON.ARRINSERT; returns the new array length."""
+        """JSON.ARRINSERT; negative index counts from the end; returns the
+        new array length.  All values insert CONTIGUOUSLY at the normalized
+        position (inserting relative to the growing list would scatter
+        them)."""
         with self._engine.locked(self._name):
             arr = self.get(path)
             if not isinstance(arr, list):
                 raise TypeError(f"value at {path!r} is not an array")
-            for off, v in enumerate(values):
-                arr.insert(index + off, json.loads(json.dumps(v)))
+            idx = index + len(arr) if index < 0 else index
+            idx = max(0, min(idx, len(arr)))
+            arr[idx:idx] = [json.loads(json.dumps(v)) for v in values]
             self._touch_version(self._rec_or_create())
             return len(arr)
 
     def array_pop(self, path: str, index: int = -1) -> Any:
-        """JSON.ARRPOP; returns the popped element (None on empty/missing)."""
+        """JSON.ARRPOP; returns the popped element (None on empty/missing).
+        Out-of-range indexes clamp to the nearest end (Redis semantics)."""
         with self._engine.locked(self._name):
             arr = self.get(path)
             if not isinstance(arr, list) or not arr:
                 return None
-            v = arr.pop(index)
+            idx = index + len(arr) if index < 0 else index
+            idx = max(0, min(idx, len(arr) - 1))
+            v = arr.pop(idx)
             self._touch_version(self._rec_or_create())
             return v
 
@@ -261,13 +268,17 @@ class JsonBucket(RExpirable):
             return len(arr)
 
     def array_index_of(self, path: str, value, start: int = 0, stop: int = 0) -> int:
-        """JSON.ARRINDEX; -1 when absent.  stop=0 means 'to the end'."""
+        """JSON.ARRINDEX; -1 when absent.  stop=0 means 'to the end';
+        negative indexes count from the end (Redis semantics).  The result
+        is always an ABSOLUTE position."""
         arr = self.get(path)
         if not isinstance(arr, list):
             return -1
-        view = arr[start : stop if stop > 0 else len(arr)]
+        n = len(arr)
+        lo = max(0, start + n if start < 0 else start)
+        hi = n if stop == 0 else (stop + n if stop < 0 else min(stop, n))
         try:
-            return view.index(value) + start
+            return arr.index(value, lo, hi)
         except ValueError:
             return -1
 

@@ -345,6 +345,15 @@ class RedissonTpu:
 
         return ExecutorService(self._engine, name)
 
+    def get_elements_subscribe_service(self):
+        """ElementsSubscribeService analog (embedded flavor: objcall routes
+        straight into the engine)."""
+        if not hasattr(self, "_elements_service"):
+            from redisson_tpu.services.elements import ElementsSubscribeService
+
+            self._elements_service = ElementsSubscribeService(self)
+        return self._elements_service
+
     def get_scheduled_executor_service(self, name: str = "redisson_scheduler"):
         from redisson_tpu.services.executor import ScheduledExecutorService
 

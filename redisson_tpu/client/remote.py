@@ -965,6 +965,17 @@ class RemoteSurface:
     def create_batch(self, options: Optional["BatchOptions"] = None) -> "RemoteBatch":
         return RemoteBatch(self, options)
 
+    def get_elements_subscribe_service(self):
+        """Resilient blocking-consumer subscriptions (ElementsSubscribeService
+        analog): take-loops that re-subscribe across failovers."""
+        if not hasattr(self, "_elements_service"):
+            from redisson_tpu.services.elements import ElementsSubscribeService
+
+            object.__setattr__(
+                self, "_elements_service", ElementsSubscribeService(self)
+            )
+        return self._elements_service
+
     def get_keys(self) -> "RemoteKeys":
         return RemoteKeys(self)
 

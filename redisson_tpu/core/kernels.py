@@ -79,8 +79,12 @@ def _valid_mask(n: int, n_valid) -> jax.Array:
     return jnp.arange(n, dtype=jnp.int32) < n_valid
 
 
-_N_CACHE: dict = {}
+import threading as _threading
+from collections import OrderedDict as _OrderedDict
+
+_N_CACHE: "_OrderedDict" = _OrderedDict()
 _N_CACHE_MAX = 4096
+_N_CACHE_LOCK = _threading.Lock()
 
 
 def valid_n(n: int):
@@ -88,13 +92,21 @@ def valid_n(n: int):
 
     A Python int argument costs a fresh tiny host->device upload on every
     call (~100us extra per dispatch over the tunnel); flush sizes repeat, so
-    a cached device scalar turns that into a one-time cost per distinct n."""
-    a = _N_CACHE.get(n)
-    if a is None:
+    a cached device scalar turns that into a one-time cost per distinct n.
+    True LRU eviction: a workload cycling through >_N_CACHE_MAX distinct
+    flush sizes must not silently thrash re-uploads of its hottest sizes.
+    Locked: server worker threads share this cache, and the hit-path
+    move_to_end would KeyError against a concurrent eviction."""
+    with _N_CACHE_LOCK:
+        a = _N_CACHE.get(n)
+        if a is not None:
+            _N_CACHE.move_to_end(n)  # touch: keep hot sizes resident
+            return a
+    device_scalar = jnp.asarray(np.int32(n))  # upload outside the lock
+    with _N_CACHE_LOCK:
         if len(_N_CACHE) >= _N_CACHE_MAX:
-            _N_CACHE.pop(next(iter(_N_CACHE)))  # evict oldest-inserted only
-        a = _N_CACHE[n] = jnp.asarray(np.int32(n))
-    return a
+            _N_CACHE.popitem(last=False)  # evict the LEAST-recently-used
+        return _N_CACHE.setdefault(n, device_scalar)
 
 
 # --------------------------------------------------------------------------

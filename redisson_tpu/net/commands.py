@@ -72,6 +72,8 @@ READ_METHOD_PREFIXES = (
     "index_of", "to_", "iterator", "scan", "first", "last", "tenants",
     "cardinality", "length", "union_count", "try_iterate", "random",
     "element", "stream_info", "state", "tenant_bit_counts", "name",
+    "pending_summary", "object_keys", "object_size", "array_index_of",
+    "array_size", "string_size", "type", "unlock_channel", "list_",
 )
 
 

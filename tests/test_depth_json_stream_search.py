@@ -168,3 +168,33 @@ def test_role_breadcrumb_distinguishes_promoted_from_restarted(client):
         cm.close()
     finally:
         runner.shutdown()
+
+
+def test_json_array_insert_pop_index_negative_semantics(client):
+    """Reviewer repros: negative indexes are normalized ONCE (contiguous
+    insert), pops clamp to the ends, index_of returns absolute positions."""
+    j = client.get_json_bucket("jd:negops")
+    j.set("$", {"a": [1, 2, 3]})
+    assert j.array_insert("a", -1, "x", "y") == 5
+    assert j.get("a") == [1, 2, "x", "y", 3]
+    assert j.array_pop("a", 50) == 3      # out of range: clamps to last
+    assert j.array_pop("a", -50) == 1     # clamps to first
+    assert j.get("a") == [2, "x", "y"]
+    j.set("$", {"b": [1, 2, 3]})
+    assert j.array_index_of("b", 3, start=-2) == 2  # absolute, found
+    assert j.array_index_of("b", 1, start=-2) == -1
+    assert j.array_index_of("b", 2, start=0, stop=-1) == 1
+
+
+def test_read_method_classification_for_new_surface():
+    """New read-only methods must classify as reads (replica routing)."""
+    from redisson_tpu.net.commands import objcall_is_write
+
+    for m in ("pending_summary", "object_keys", "object_size",
+              "array_index_of", "array_size", "string_size", "type",
+              "list_groups", "list_consumers", "last_id"):
+        assert not objcall_is_write(m), m
+    for m in ("array_insert", "array_pop", "array_trim", "merge", "toggle",
+              "clear", "string_append", "trim_by_min_id", "remove_consumer",
+              "set_group_id"):
+        assert objcall_is_write(m), m
