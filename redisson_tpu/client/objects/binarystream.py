@@ -277,6 +277,8 @@ class JsonBucket(RExpirable):
         n = len(arr)
         lo = max(0, start + n if start < 0 else start)
         hi = n if stop == 0 else (stop + n if stop < 0 else min(stop, n))
+        hi = max(0, hi)  # a stop below -len must mean "empty range", not a
+        # second negative re-interpretation inside list.index
         try:
             return arr.index(value, lo, hi)
         except ValueError:

@@ -259,6 +259,19 @@ class BlockingDeque(BlockingQueue, Deque):
                 return v
             self._wait_entry().wait_for(1.0)
 
+    def poll_last_blocking(self, timeout: Optional[float]):
+        """Tail-end bounded blocking poll (pollLastAsync with timeout — the
+        subscribeOnLastElements feed)."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            v = self.poll_last()
+            if v is not None:
+                return v
+            remaining = None if deadline is None else deadline - time.time()
+            if remaining is not None and remaining <= 0:
+                return None
+            self._wait_entry().wait_for(min(remaining or 1.0, 1.0))
+
 
 class BoundedBlockingQueue(BlockingQueue):
     """RBoundedBlockingQueue: capacity gate on offer (semaphore channel in the

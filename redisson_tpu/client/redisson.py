@@ -346,13 +346,13 @@ class RedissonTpu:
         return ExecutorService(self._engine, name)
 
     def get_elements_subscribe_service(self):
-        """ElementsSubscribeService analog (embedded flavor: objcall routes
-        straight into the engine)."""
-        if not hasattr(self, "_elements_service"):
-            from redisson_tpu.services.elements import ElementsSubscribeService
+        """ElementsSubscribeService analog (embedded flavor).  setdefault
+        keeps the lazy init race-safe (one shared service instance)."""
+        from redisson_tpu.services.elements import ElementsSubscribeService
 
-            self._elements_service = ElementsSubscribeService(self)
-        return self._elements_service
+        return self.__dict__.setdefault(
+            "_elements_service", ElementsSubscribeService(self)
+        )
 
     def get_scheduled_executor_service(self, name: str = "redisson_scheduler"):
         from redisson_tpu.services.executor import ScheduledExecutorService
@@ -411,6 +411,9 @@ class RedissonTpu:
     # -- lifecycle ----------------------------------------------------------
 
     def shutdown(self) -> None:
+        svc = getattr(self, "_elements_service", None)
+        if svc is not None:
+            svc.shutdown()
         self._engine.shutdown()
 
     def __enter__(self):

@@ -967,14 +967,14 @@ class RemoteSurface:
 
     def get_elements_subscribe_service(self):
         """Resilient blocking-consumer subscriptions (ElementsSubscribeService
-        analog): take-loops that re-subscribe across failovers."""
-        if not hasattr(self, "_elements_service"):
-            from redisson_tpu.services.elements import ElementsSubscribeService
+        analog): take-loops that re-subscribe across failovers.  setdefault
+        keeps the init race-safe: two racing callers must share ONE service
+        or the loser's subscription registry becomes unreachable."""
+        from redisson_tpu.services.elements import ElementsSubscribeService
 
-            object.__setattr__(
-                self, "_elements_service", ElementsSubscribeService(self)
-            )
-        return self._elements_service
+        return self.__dict__.setdefault(
+            "_elements_service", ElementsSubscribeService(self)
+        )
 
     def get_keys(self) -> "RemoteKeys":
         return RemoteKeys(self)
@@ -1060,6 +1060,11 @@ class RemoteRedisson(RemoteSurface):
         return bytes(self.node.execute("INFO")).decode()
 
     def shutdown(self) -> None:
+        # cancel element subscriptions FIRST: their daemon loops would
+        # otherwise retry the closed transport forever
+        svc = getattr(self, "_elements_service", None)
+        if svc is not None:
+            svc.shutdown()
         self.node.close()
 
     def __enter__(self):

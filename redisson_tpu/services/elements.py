@@ -20,12 +20,16 @@ from typing import Any, Callable, Dict, Optional
 class _Subscription:
     def __init__(self, service: "ElementsSubscribeService", sub_id: str,
                  queue_name: str, consumer: Callable[[Any], None],
-                 poll_interval: float):
+                 poll_interval: float, last: bool = False):
         self.id = sub_id
         self._service = service
         self._queue_name = queue_name
         self._consumer = consumer
         self._poll_interval = poll_interval
+        # last=True: feed from the TAIL of a blocking deque
+        # (subscribeOnLastElements / takeLastAsync)
+        self._factory = "get_blocking_deque" if last else "get_blocking_queue"
+        self._method = "poll_last_blocking" if last else "poll_blocking"
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"rtpu-elements-{queue_name}"
@@ -40,13 +44,12 @@ class _Subscription:
             try:
                 if hasattr(client, "objcall"):  # wire clients: slot-routed
                     v = client.objcall(
-                        "get_blocking_queue", self._queue_name, "poll_blocking",
+                        self._factory, self._queue_name, self._method,
                         (self._poll_interval,), {},
                     )
                 else:  # embedded facade: straight into the engine
-                    v = client.get_blocking_queue(self._queue_name).poll_blocking(
-                        self._poll_interval
-                    )
+                    handle = getattr(client, self._factory)(self._queue_name)
+                    v = getattr(handle, self._method)(self._poll_interval)
                 backoff = 0.05  # reachable again
                 if v is None:
                     continue
@@ -87,8 +90,21 @@ class ElementsSubscribeService:
     ) -> str:
         """Start a resilient consumer on a blocking queue; returns the
         subscription id (RBlockingQueue.subscribeOnElements analog)."""
+        return self._subscribe(queue_name, consumer, poll_interval, last=False)
+
+    def subscribe_on_last_elements(
+        self,
+        deque_name: str,
+        consumer: Callable[[Any], None],
+        poll_interval: float = 1.0,
+    ) -> str:
+        """Tail-end consumer on a blocking DEQUE
+        (RBlockingDeque.subscribeOnLastElements / takeLastAsync analog)."""
+        return self._subscribe(deque_name, consumer, poll_interval, last=True)
+
+    def _subscribe(self, name, consumer, poll_interval, last: bool) -> str:
         sub_id = uuid.uuid4().hex[:12]
-        sub = _Subscription(self, sub_id, queue_name, consumer, poll_interval)
+        sub = _Subscription(self, sub_id, name, consumer, poll_interval, last=last)
         with self._lock:
             self._subs[sub_id] = sub
         sub.start()

@@ -85,3 +85,33 @@ def test_embedded_facade_subscription():
         svc.unsubscribe(sid)
     finally:
         client.shutdown()
+
+
+def test_subscribe_on_last_elements():
+    """Tail-end subscription feeds from poll_last on a blocking deque
+    (RBlockingDeque.subscribeOnLastElements analog)."""
+    with ServerThread(port=0) as st:
+        client = RemoteRedisson(st.address, timeout=30.0)
+        try:
+            got = []
+            d = client.get_blocking_deque("es:dq")
+            d.offer_first("head")
+            d.offer_last("tail")  # seed BEFORE subscribing: order is provable
+            svc = client.get_elements_subscribe_service()
+            sid = svc.subscribe_on_last_elements("es:dq", got.append, poll_interval=0.2)
+            _wait(lambda: len(got) == 2, 10, f"tail subscription delivered {got}")
+            assert got == ["tail", "head"]  # tail end first
+            svc.unsubscribe(sid)
+        finally:
+            client.shutdown()
+
+
+def test_client_shutdown_cancels_subscriptions():
+    with ServerThread(port=0) as st:
+        client = RemoteRedisson(st.address, timeout=30.0)
+        svc = client.get_elements_subscribe_service()
+        sid = svc.subscribe_on_elements("es:sd", lambda v: None, poll_interval=0.2)
+        sub = svc.subscription(sid)
+        client.shutdown()  # must cancel the loop, not leak a retrying thread
+        sub._thread.join(5)
+        assert not sub._thread.is_alive(), "subscription outlived the client"
