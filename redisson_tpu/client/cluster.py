@@ -92,6 +92,13 @@ class ClusterRedisson(RemoteSurface):
         self.max_redirects = max_redirects
         self._balancer_factory = balancer
         self._node_kw = dict(node_kw)
+        # one ConnectionEventsHub shared by every node of the cluster:
+        # listeners see per-ADDRESS edge-triggered connect/disconnect
+        from redisson_tpu.net.detectors import ConnectionEventsHub
+
+        self.events_hub = self._node_kw.setdefault(
+            "events_hub", ConnectionEventsHub()
+        )
         self._seeds = list(seeds)
         self._entries: Dict[str, ShardEntry] = {}  # master address -> entry
         self._slots: List[Optional[str]] = [None] * MAX_SLOT  # slot -> master address
@@ -668,6 +675,12 @@ class ClusterRedisson(RemoteSurface):
 
     def shutdown(self) -> None:
         self._closed.set()
+        # cancel element subscriptions FIRST (their daemon loops would
+        # otherwise retry the closed cluster forever — same rule as the
+        # single-node facade's shutdown)
+        svc = self.__dict__.get("_elements_service")
+        if svc is not None:
+            svc.shutdown()
         if self._dns is not None:
             self._dns.stop()
         with self._lock:

@@ -203,16 +203,21 @@ class BlockingQueue(Queue):
     def take(self):
         return self.poll_blocking(None)
 
-    def poll_blocking(self, timeout: Optional[float]):
+    def _poll_blocking_impl(self, poll_fn, timeout: Optional[float]):
+        """One wait policy for every blocking-poll flavor: park the FULL
+        remaining budget (the offer side signals the wait entry)."""
         deadline = None if timeout is None else time.time() + timeout
         while True:
-            v = self.poll()
+            v = poll_fn()
             if v is not None:
                 return v
             remaining = None if deadline is None else deadline - time.time()
             if remaining is not None and remaining <= 0:
                 return None
             self._wait_entry().wait_for(remaining if remaining is not None else 1.0)
+
+    def poll_blocking(self, timeout: Optional[float]):
+        return self._poll_blocking_impl(self.poll, timeout)
 
     def poll_from_any(self, timeout: Optional[float], *other_names: str):
         """BLPOP across several queues (RBlockingQueue.pollFromAny)."""
@@ -261,16 +266,8 @@ class BlockingDeque(BlockingQueue, Deque):
 
     def poll_last_blocking(self, timeout: Optional[float]):
         """Tail-end bounded blocking poll (pollLastAsync with timeout — the
-        subscribeOnLastElements feed)."""
-        deadline = None if timeout is None else time.time() + timeout
-        while True:
-            v = self.poll_last()
-            if v is not None:
-                return v
-            remaining = None if deadline is None else deadline - time.time()
-            if remaining is not None and remaining <= 0:
-                return None
-            self._wait_entry().wait_for(min(remaining or 1.0, 1.0))
+        subscribeOnLastElements feed); shares poll_blocking's wait policy."""
+        return self._poll_blocking_impl(self.poll_last, timeout)
 
 
 class BoundedBlockingQueue(BlockingQueue):

@@ -965,6 +965,14 @@ class RemoteSurface:
     def create_batch(self, options: Optional["BatchOptions"] = None) -> "RemoteBatch":
         return RemoteBatch(self, options)
 
+    def add_connection_listener(self, listener):
+        """Register for edge-triggered per-node connect/disconnect events
+        (ConnectionEventsHub.java); both facades own an events hub."""
+        return self.events_hub.add_listener(listener)
+
+    def remove_connection_listener(self, listener) -> None:
+        self.events_hub.remove_listener(listener)
+
     def get_elements_subscribe_service(self):
         """Resilient blocking-consumer subscriptions (ElementsSubscribeService
         analog): take-loops that re-subscribe across failovers.  setdefault
@@ -1023,6 +1031,11 @@ class RemoteRedisson(RemoteSurface):
                 ssl_context=ssc.build_ssl_context(),
             )
         kw.update(node_kw)
+        # ConnectionEventsHub (connection/ConnectionEventsHub.java):
+        # edge-triggered connect/disconnect fan-out for this facade
+        from redisson_tpu.net.detectors import ConnectionEventsHub
+
+        self.events_hub = kw.setdefault("events_hub", ConnectionEventsHub())
         self.node = NodeClient(address, **kw)
 
     @classmethod

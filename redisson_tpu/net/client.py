@@ -437,9 +437,13 @@ class NodeClient:
         username: Optional[str] = None,
         ssl_context: Optional[_ssl.SSLContext] = None,
         ssl_hostname: Optional[str] = None,
+        events_hub=None,
     ):
         self.address = address
         self.host, self.port = parse_address(address)
+        # ConnectionEventsHub (detectors.py): edge-triggered connect/
+        # disconnect fan-out shared by every NodeClient of one facade
+        self.events_hub = events_hub
         self._password = password
         self._username = username
         # a tpus:// address with no explicit context gets the system default
@@ -482,8 +486,12 @@ class NodeClient:
             )
         except OSError as e:
             self.detector.on_connect_failed()
+            if self.events_hub is not None:
+                self.events_hub.node_disconnected(self.address)
             raise ConnectionError_(f"cannot connect to {self.address}: {e}") from e
         self.detector.on_connect_successful()
+        if self.events_hub is not None:
+            self.events_hub.node_connected(self.address)
         return conn
 
     # -- command path --------------------------------------------------------
@@ -555,6 +563,8 @@ class NodeClient:
                 raise
             except (ConnectionError, OSError) as e:
                 self.detector.on_command_failed(e)
+                if self.events_hub is not None:
+                    self.events_hub.node_disconnected(self.address)
                 self.pool.discard(conn)
                 last = e
                 continue
@@ -563,6 +573,11 @@ class NodeClient:
                 self.detector.on_command_failed(result)
                 raise result
             self.detector.on_command_successful()
+            if self.events_hub is not None:
+                # a benign single-connection drop fired node_disconnected;
+                # any subsequent success re-marks the node up (edge-triggered
+                # — a no-op while already connected)
+                self.events_hub.node_connected(self.address)
             return result
         assert last is not None
         raise last

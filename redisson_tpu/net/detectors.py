@@ -103,3 +103,70 @@ class FailedCommandsTimeoutDetector(FailedNodeDetector):
 
     def is_node_failed(self) -> bool:
         return self._counter.count() >= self.threshold
+
+
+class ConnectionListener:
+    """SPI: connect/disconnect notifications per node address
+    (org/redisson/api/ConnectionListener — onConnect/onDisconnect)."""
+
+    def on_connect(self, address: str) -> None: ...
+    def on_disconnect(self, address: str) -> None: ...
+
+
+class ConnectionEventsHub:
+    """Fan-out of connection lifecycle events to registered listeners
+    (connection/ConnectionEventsHub.java): one hub per client, fed by
+    every NodeClient's connect/disconnect transitions.  Events are
+    EDGE-triggered per node address — N pooled connections to one node
+    emit one connect on first establish and one disconnect when the node
+    becomes unreachable, matching the reference's per-client semantics."""
+
+    def __init__(self):
+        self._listeners: list = []
+        self._connected: set = set()
+        # ONE reentrant lock serializes state transition + listener fire:
+        # separating them lets a racing reconnect deliver on_connect before
+        # the earlier on_disconnect, leaving listeners with inverted state.
+        # RLock so a listener may call add/remove_listener from its callback.
+        # Contract: listeners are short and non-blocking (reference
+        # ConnectionEventsHub fires inline on IO threads the same way).
+        self._lock = threading.RLock()
+
+    def add_listener(self, listener: ConnectionListener) -> ConnectionListener:
+        with self._lock:
+            self._listeners.append(listener)
+            # late registration replays current state under the SAME lock:
+            # connections established during client construction (pool
+            # warm-up) must be visible, and no transition may interleave
+            for addr in self._connected:
+                try:
+                    listener.on_connect(addr)
+                except Exception:  # noqa: BLE001 — listener bugs stay contained
+                    pass
+        return listener
+
+    def remove_listener(self, listener: ConnectionListener) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(listener)
+            except ValueError:
+                pass
+
+    def _fire_locked(self, event: str, address: str) -> None:
+        for ls in list(self._listeners):
+            try:
+                getattr(ls, event)(address)
+            except Exception:  # noqa: BLE001 — listener bugs stay contained
+                pass
+
+    def node_connected(self, address: str) -> None:
+        with self._lock:
+            if address not in self._connected:
+                self._connected.add(address)
+                self._fire_locked("on_connect", address)
+
+    def node_disconnected(self, address: str) -> None:
+        with self._lock:
+            if address in self._connected:
+                self._connected.discard(address)
+                self._fire_locked("on_disconnect", address)

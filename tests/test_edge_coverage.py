@@ -157,3 +157,116 @@ def test_read_group_explicit_id_rereads_own_pel_only(client):
     rere_a = s.read_group("g", "a", from_id="0")
     assert set(rere_a) == set(got_a)
     assert not (set(rere_a) & set(got_b))
+
+
+# -- ConnectionEventsHub ------------------------------------------------------
+
+
+def test_connection_events_hub_edge_triggered():
+    from redisson_tpu.client.remote import RemoteRedisson
+    from redisson_tpu.net.detectors import ConnectionListener
+    from redisson_tpu.server.server import ServerThread
+
+    events = []
+
+    class L(ConnectionListener):
+        def on_connect(self, address):
+            events.append(("up", address))
+
+        def on_disconnect(self, address):
+            events.append(("down", address))
+
+    st = ServerThread(port=0).start()
+    port = st.server.port
+    client = RemoteRedisson(st.address, timeout=5.0)
+    try:
+        client.add_connection_listener(L())
+        client.execute("PING")
+        assert ("up", client.node.address) in events
+        n_up = len(events)
+        client.execute("PING")  # edge-triggered: no duplicate connect event
+        assert len(events) == n_up
+        st.stop()
+        try:
+            client.execute("PING", timeout=2.0)
+        except Exception:
+            pass
+        assert ("down", client.node.address) in events
+        # recovery fires connect again
+        st = ServerThread(port=port).start()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                client.execute("PING", timeout=2.0)
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert events.count(("up", client.node.address)) >= 2
+    finally:
+        client.shutdown()
+        st.stop()
+
+
+def test_cluster_connection_events_per_node():
+    from redisson_tpu.harness import ClusterRunner
+    from redisson_tpu.net.detectors import ConnectionListener
+
+    runner = ClusterRunner(masters=2).run()
+    try:
+        ups = []
+
+        class L(ConnectionListener):
+            def on_connect(self, address):
+                ups.append(address)
+
+            def on_disconnect(self, address):
+                pass
+
+        client = runner.client(scan_interval=0)
+        client.add_connection_listener(L())
+        for i in range(20):
+            client.execute("SET", f"ev-{i}", "x")
+        assert len(set(ups)) == 2  # both masters reported up (once each)
+        client.shutdown()
+    finally:
+        runner.shutdown()
+
+
+def test_cluster_shutdown_cancels_subscriptions():
+    from redisson_tpu.harness import ClusterRunner
+
+    runner = ClusterRunner(masters=2).run()
+    try:
+        client = runner.client(scan_interval=0)
+        svc = client.get_elements_subscribe_service()
+        sid = svc.subscribe_on_elements("ec:csd", lambda v: None, poll_interval=0.2)
+        sub = svc.subscription(sid)
+        client.shutdown()
+        sub._thread.join(5)
+        assert not sub._thread.is_alive(), "subscription outlived cluster client"
+    finally:
+        runner.shutdown()
+
+
+def test_events_hub_recovers_after_benign_connection_drop():
+    """A single pooled-connection failure fires a (spurious) disconnect; the
+    next successful command re-marks the node up — listeners never get stuck
+    believing a serving node is down."""
+    from redisson_tpu.net.detectors import ConnectionEventsHub
+
+    hub = ConnectionEventsHub()
+    log = []
+
+    class L:
+        def on_connect(self, a):
+            log.append(("up", a))
+
+        def on_disconnect(self, a):
+            log.append(("down", a))
+
+    hub.add_listener(L())
+    hub.node_connected("n1")
+    hub.node_disconnected("n1")   # benign drop
+    hub.node_connected("n1")      # next success re-marks up
+    hub.node_disconnected("n1")   # the REAL death still fires
+    assert log == [("up", "n1"), ("down", "n1"), ("up", "n1"), ("down", "n1")]
