@@ -63,21 +63,15 @@ def bench_config2_tenant_bank(client):
     t = tenant_of(keys)
 
     arr.contains(t, keys)  # warm compile
-    # latency: per-flush, synchronous (what a single caller observes).
-    # All 30 samples count toward the reported p99 — trimming the tail
-    # would hide genuine serving-path stalls, not just tunnel noise.
-    lat = []
-    for _ in range(30):
-        s = time.perf_counter()
-        found = arr.contains(t, keys)
-        lat.append(time.perf_counter() - s)
-    # throughput: pipelined flushes (RBatch executeAsync analog) — dispatch
-    # everything (async), then fetch all results in ONE batched device_get so
-    # the fixed ~68ms/sync tunnel round-trip amortizes across the whole run.
-    # The tunnel's bandwidth swings 10-40x between runs, so the recorded
-    # number is the BEST of 3 independent windows of 50 flushes each — it
-    # must measure the framework, not the tunnel's mood (same discipline
-    # config5 already uses; window list goes to the log for audit).
+    # throughput FIRST: pipelined flushes (RBatch executeAsync analog) —
+    # dispatch everything (async), then fetch all results in ONE batched
+    # device_get so the fixed ~68ms/sync tunnel round-trip amortizes across
+    # the whole run.  The tunnel's bandwidth swings 10-40x between runs AND
+    # degrades within a session as flush count accumulates, so (a) the
+    # headline windows run before the sync-latency loop, and (b) the
+    # recorded number is the BEST of 3 independent windows of 50 flushes —
+    # it must measure the framework, not the tunnel's mood (window list
+    # goes to the log for audit).
     import jax
 
     reps, windows = 50, 3
@@ -88,6 +82,14 @@ def bench_config2_tenant_bank(client):
         jax.device_get(pending)
         rates.append(reps * FLUSH / (time.perf_counter() - t0))
     ops_per_sec = max(rates)
+    # latency: per-flush, synchronous (what a single caller observes).
+    # All 30 samples count toward the reported p99 — trimming the tail
+    # would hide genuine serving-path stalls, not just tunnel noise.
+    lat = []
+    for _ in range(30):
+        s = time.perf_counter()
+        found = arr.contains(t, keys)
+        lat.append(time.perf_counter() - s)
     log(
         f"config2: {ops_per_sec/1e6:.2f}M contains/s (best of {windows} windows "
         f"of {reps} pipelined flushes: {['%.2fM' % (r/1e6) for r in rates]}), "

@@ -25,7 +25,10 @@ ALL_SHARD = {"KEYS": "concat", "DBSIZE": "sum", "FLUSHALL": "ok"}
 
 # multi-key WRITE commands that are one atomic compound op server-side:
 # all keys must colocate on one shard (Redis CROSSSLOT rule)
-SAME_SLOT = {"PFMERGE", "BITOP", "RENAME"}
+SAME_SLOT = {"PFMERGE", "BITOP", "RENAME", "MGET", "MSET"}
+# (MGET/MSET follow real Redis cluster semantics: multi-key commands
+#  spanning slots raise CROSSSLOT; use {hashtags} or the RBuckets
+#  handles, which split per shard client-side)
 
 # sentinel slot meaning "cross-slot but splittable" (DEL/UNLINK grouping)
 SPLIT = -1

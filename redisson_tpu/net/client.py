@@ -559,6 +559,11 @@ class NodeClient:
                 # reference stops retrying once the write completed
                 # (RedisExecutor response-timeout path) — same rule here.
                 self.detector.on_command_timeout()
+                if self.events_hub is not None and self.detector.is_node_failed():
+                    # a hung-but-accepting node never refuses connects; the
+                    # DETECTOR's verdict is what should flip listeners to
+                    # disconnected (one slow reply must not)
+                    self.events_hub.node_disconnected(self.address)
                 self.pool.discard(conn)
                 raise
             except (ConnectionError, OSError) as e:

@@ -14,13 +14,15 @@ from typing import List, Optional, Tuple
 
 
 class CommandSpec:
-    __slots__ = ("name", "write", "key_at", "multi_key", "global_cmd")
+    __slots__ = ("name", "write", "key_at", "multi_key", "global_cmd", "key_stride")
 
-    def __init__(self, name: str, write: bool, key_at: Optional[int], multi_key: bool = False):
+    def __init__(self, name: str, write: bool, key_at: Optional[int],
+                 multi_key: bool = False, key_stride: int = 1):
         self.name = name
         self.write = write
         self.key_at = key_at  # index into args AFTER the command name; None = keyless
         self.multi_key = multi_key  # keys run from key_at to end of args
+        self.key_stride = key_stride  # MSET-style interleaved key-value lists
         self.global_cmd = key_at is None
 
 
@@ -52,6 +54,14 @@ _spec(SPECS, "EXISTS TTL PTTL TYPE GET GETBIT BITCOUNT GETBITS GETBITSB "
 _spec(SPECS, "EXPIRE PEXPIRE PERSIST SET INCR INCRBY DECR SETBIT SETBITS "
              "SETBITSB BF.RESERVE BF.ADD BF.MADD BF.MADD64 BFA.RESERVE "
              "BFA.MADD64 PFADD64 PFADD", True, 0)
+
+# typed data commands (Redis-compatible verbs over the object handles)
+_spec(SPECS, "HGET HMGET HGETALL HEXISTS HLEN HKEYS HVALS SISMEMBER SMEMBERS "
+             "SCARD LLEN LRANGE LINDEX ZSCORE ZCARD ZRANK ZRANGE STRLEN", False, 0)
+_spec(SPECS, "HSET HDEL SADD SREM LPUSH RPUSH LPOP RPOP ZADD ZREM ZINCRBY "
+             "GETSET GETDEL APPEND", True, 0)
+_spec(SPECS, "MGET", False, 0, multi_key=True)
+SPECS["MSET"] = CommandSpec("MSET", True, 0, multi_key=True, key_stride=2)
 
 # multi-key
 _spec(SPECS, "DEL UNLINK", True, 0, multi_key=True)
@@ -92,7 +102,7 @@ def command_keys(cmd: str, args: List[bytes]) -> List[bytes]:
     if spec is None or spec.key_at is None or len(args) <= spec.key_at:
         return []
     if spec.multi_key:
-        return list(args[spec.key_at:])
+        return list(args[spec.key_at :: spec.key_stride])
     return [args[spec.key_at]]
 
 

@@ -160,6 +160,13 @@ class ConnectionEventsHub:
                 pass
 
     def node_connected(self, address: str) -> None:
+        # lock-free fast path: this runs on EVERY successful command of
+        # every node sharing the hub — contending on the lock just to learn
+        # the address is already connected would serialize the hot path
+        # (set membership reads are atomic under the GIL; a rare stale read
+        # only costs one extra locked check)
+        if address in self._connected:
+            return
         with self._lock:
             if address not in self._connected:
                 self._connected.add(address)

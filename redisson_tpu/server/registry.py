@@ -1239,3 +1239,261 @@ def _objcallm_apply(server, ops, caller):
         except Exception as e:  # noqa: BLE001 — tagged per-op, frame continues
             out.append(("E", e))
     return b"M" + pickle.dumps(out)
+
+
+# -- typed data commands (Redis-compatible wire surface) ----------------------
+# The reference registry defines ~447 typed commands (RedisCommands.java);
+# the batch-first blob forms above are the TPU-first primary citizens, and
+# OBJCALL carries the full object surface — but generic Redis clients speak
+# THESE verbs.  Values are raw bytes (BytesCodec), Redis semantics: a typed
+# command and a default-codec OBJCALL handle on the same name see different
+# encodings, exactly like mixing codecs in the reference.
+
+def _typed_handle(server, factory: str, name: str):
+    from redisson_tpu.client.codec import BytesCodec
+
+    return getattr(server.local_client(), factory)(name, codec=BytesCodec())
+
+
+@register("HSET")
+def cmd_hset(server, ctx, args):
+    name = _s(args[0])
+    m = _typed_handle(server, "get_map", name)
+    n = 0
+    with server.engine.locked(name):  # multi-field writes land atomically
+        for i in range(1, len(args) - 1, 2):
+            if m.fast_put(bytes(args[i]), bytes(args[i + 1])):
+                n += 1
+    return n
+
+
+@register("HGET")
+def cmd_hget(server, ctx, args):
+    return _typed_handle(server, "get_map", _s(args[0])).get(bytes(args[1]))
+
+
+@register("HMGET")
+def cmd_hmget(server, ctx, args):
+    m = _typed_handle(server, "get_map", _s(args[0]))
+    return [m.get(bytes(f)) for f in args[1:]]
+
+
+@register("HDEL")
+def cmd_hdel(server, ctx, args):
+    m = _typed_handle(server, "get_map", _s(args[0]))
+    return int(m.fast_remove(*[bytes(f) for f in args[1:]]))
+
+
+@register("HGETALL")
+def cmd_hgetall(server, ctx, args):
+    m = _typed_handle(server, "get_map", _s(args[0]))
+    out = []
+    for k, v in m.read_all_entry_set():
+        out += [k, v]
+    return out
+
+
+@register("HEXISTS")
+def cmd_hexists(server, ctx, args):
+    return 1 if _typed_handle(server, "get_map", _s(args[0])).contains_key(bytes(args[1])) else 0
+
+
+@register("HLEN")
+def cmd_hlen(server, ctx, args):
+    return _typed_handle(server, "get_map", _s(args[0])).size()
+
+
+@register("HKEYS")
+def cmd_hkeys(server, ctx, args):
+    return _typed_handle(server, "get_map", _s(args[0])).read_all_keys()
+
+
+@register("HVALS")
+def cmd_hvals(server, ctx, args):
+    return _typed_handle(server, "get_map", _s(args[0])).read_all_values()
+
+
+@register("SADD")
+def cmd_sadd(server, ctx, args):
+    s = _typed_handle(server, "get_set", _s(args[0]))
+    return sum(1 for v in args[1:] if s.add(bytes(v)))
+
+
+@register("SREM")
+def cmd_srem(server, ctx, args):
+    s = _typed_handle(server, "get_set", _s(args[0]))
+    return sum(1 for v in args[1:] if s.remove(bytes(v)))
+
+
+@register("SISMEMBER")
+def cmd_sismember(server, ctx, args):
+    return 1 if _typed_handle(server, "get_set", _s(args[0])).contains(bytes(args[1])) else 0
+
+
+@register("SMEMBERS")
+def cmd_smembers(server, ctx, args):
+    return _typed_handle(server, "get_set", _s(args[0])).read_all()
+
+
+@register("SCARD")
+def cmd_scard(server, ctx, args):
+    return _typed_handle(server, "get_set", _s(args[0])).size()
+
+
+def _deque(server, name: str):
+    return _typed_handle(server, "get_deque", name)
+
+
+@register("LPUSH")
+def cmd_lpush(server, ctx, args):
+    d = _deque(server, _s(args[0]))
+    for v in args[1:]:
+        d.add_first(bytes(v))
+    return d.size()
+
+
+@register("RPUSH")
+def cmd_rpush(server, ctx, args):
+    d = _deque(server, _s(args[0]))
+    for v in args[1:]:
+        d.add_last(bytes(v))
+    return d.size()
+
+
+@register("LPOP")
+def cmd_lpop(server, ctx, args):
+    return _deque(server, _s(args[0])).poll_first()
+
+
+@register("RPOP")
+def cmd_rpop(server, ctx, args):
+    return _deque(server, _s(args[0])).poll_last()
+
+
+@register("LLEN")
+def cmd_llen(server, ctx, args):
+    return _deque(server, _s(args[0])).size()
+
+
+@register("LRANGE")
+def cmd_lrange(server, ctx, args):
+    from redisson_tpu.client.objects.scoredsortedset import _norm_range
+
+    d = _deque(server, _s(args[0]))
+    items = d.read_all()
+    lo, hi = _norm_range(_int(args[1]), _int(args[2]), len(items))
+    return items[lo : hi + 1] if hi >= lo else []
+
+
+@register("LINDEX")
+def cmd_lindex(server, ctx, args):
+    items = _deque(server, _s(args[0])).read_all()
+    i = _int(args[1])
+    if i < 0:
+        i += len(items)
+    return items[i] if 0 <= i < len(items) else None
+
+
+@register("ZADD")
+def cmd_zadd(server, ctx, args):
+    name = _s(args[0])
+    z = _typed_handle(server, "get_scored_sorted_set", name)
+    n = 0
+    with server.engine.locked(name):  # multi-member adds land atomically
+        for i in range(1, len(args) - 1, 2):
+            if z.add(float(args[i]), bytes(args[i + 1])):
+                n += 1
+    return n
+
+
+@register("ZSCORE")
+def cmd_zscore(server, ctx, args):
+    sc = _typed_handle(server, "get_scored_sorted_set", _s(args[0])).get_score(bytes(args[1]))
+    return None if sc is None else repr(sc).encode()
+
+
+@register("ZREM")
+def cmd_zrem(server, ctx, args):
+    z = _typed_handle(server, "get_scored_sorted_set", _s(args[0]))
+    return sum(1 for m in args[1:] if z.remove(bytes(m)))
+
+
+@register("ZCARD")
+def cmd_zcard(server, ctx, args):
+    return _typed_handle(server, "get_scored_sorted_set", _s(args[0])).size()
+
+
+@register("ZRANK")
+def cmd_zrank(server, ctx, args):
+    return _typed_handle(server, "get_scored_sorted_set", _s(args[0])).rank(bytes(args[1]))
+
+
+@register("ZINCRBY")
+def cmd_zincrby(server, ctx, args):
+    z = _typed_handle(server, "get_scored_sorted_set", _s(args[0]))
+    return repr(z.add_score(bytes(args[2]), float(args[1]))).encode()
+
+
+@register("ZRANGE")
+def cmd_zrange(server, ctx, args):
+    z = _typed_handle(server, "get_scored_sorted_set", _s(args[0]))
+    withscores = len(args) > 3 and bytes(args[3]).upper() == b"WITHSCORES"
+    lo, hi = _int(args[1]), _int(args[2])
+    if withscores:
+        out = []
+        for member, score in z.entry_range(lo, hi):
+            out += [member, repr(score).encode()]
+        return out
+    return z.value_range(lo, hi)
+
+
+@register("MGET")
+def cmd_mget(server, ctx, args):
+    # atomic snapshot across keys (Redis executes MGET as one step): without
+    # all locks, a reader interleaving a concurrent MSET could see a torn
+    # half-old half-new multi-key view
+    names = [_s(k) for k in args]
+    with server.engine.locked_many(names):
+        return [_bucket(server, n).get() for n in names]
+
+
+@register("MSET")
+def cmd_mset(server, ctx, args):
+    # ALL record locks up front (engine.locked_many): Redis MSET is atomic —
+    # a concurrent MGET must never observe a torn multi-key write
+    names = [_s(args[i]) for i in range(0, len(args) - 1, 2)]
+    with server.engine.locked_many(names):
+        for i in range(0, len(args) - 1, 2):
+            _bucket(server, _s(args[i])).set(bytes(args[i + 1]))
+    return "+OK"
+
+
+@register("GETSET")
+def cmd_getset(server, ctx, args):
+    return _bucket(server, _s(args[0])).get_and_set(bytes(args[1]))
+
+
+@register("GETDEL")
+def cmd_getdel(server, ctx, args):
+    name = _s(args[0])
+    with server.engine.locked(name):
+        v = _bucket(server, name).get()
+        server.engine.store.delete(name)
+        return v
+
+
+@register("APPEND")
+def cmd_append(server, ctx, args):
+    name = _s(args[0])
+    with server.engine.locked(name):
+        b = _bucket(server, name)
+        cur = b.get() or b""
+        new = bytes(cur) + bytes(args[1])
+        b.set(new)
+        return len(new)
+
+
+@register("STRLEN")
+def cmd_strlen(server, ctx, args):
+    v = _bucket(server, _s(args[0])).get()
+    return 0 if v is None else len(bytes(v))

@@ -1,0 +1,158 @@
+"""Typed Redis-compatible data commands (hash/set/list/zset/string verbs) —
+the generic-client wire surface over the object handles (the reference's
+RedisCommands.java registry, server-side)."""
+import pytest
+
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.harness import ClusterRunner
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture(scope="module")
+def server():
+    with ServerThread(port=0) as st:
+        yield st
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = RemoteRedisson(server.address, timeout=30.0)
+    yield c
+    c.shutdown()
+
+
+def _x(client, *args):
+    reply = client.execute(*args)
+    if isinstance(reply, RespError):
+        raise reply
+    return reply
+
+
+def test_hash_commands(client):
+    assert _x(client, "HSET", "h", "f1", "v1", "f2", "v2") == 2
+    assert _x(client, "HSET", "h", "f1", "v1b") == 0  # overwrite, not new
+    assert bytes(_x(client, "HGET", "h", "f1")) == b"v1b"
+    assert _x(client, "HGET", "h", "nope") is None
+    assert [bytes(v) if v else v for v in _x(client, "HMGET", "h", "f1", "zz", "f2")] == [b"v1b", None, b"v2"]
+    assert _x(client, "HEXISTS", "h", "f2") == 1
+    assert _x(client, "HLEN", "h") == 2
+    flat = _x(client, "HGETALL", "h")
+    pairs = {bytes(flat[i]): bytes(flat[i + 1]) for i in range(0, len(flat), 2)}
+    assert pairs == {b"f1": b"v1b", b"f2": b"v2"}
+    assert sorted(bytes(k) for k in _x(client, "HKEYS", "h")) == [b"f1", b"f2"]
+    assert _x(client, "HDEL", "h", "f1", "zz") == 1
+    assert _x(client, "HLEN", "h") == 1
+
+
+def test_set_commands(client):
+    assert _x(client, "SADD", "s", "a", "b", "a") == 2
+    assert _x(client, "SISMEMBER", "s", "a") == 1
+    assert _x(client, "SISMEMBER", "s", "z") == 0
+    assert _x(client, "SCARD", "s") == 2
+    assert sorted(bytes(m) for m in _x(client, "SMEMBERS", "s")) == [b"a", b"b"]
+    assert _x(client, "SREM", "s", "a", "z") == 1
+    assert _x(client, "SCARD", "s") == 1
+
+
+def test_list_commands(client):
+    assert _x(client, "RPUSH", "l", "b", "c") == 2
+    assert _x(client, "LPUSH", "l", "a") == 3
+    assert _x(client, "LLEN", "l") == 3
+    assert [bytes(v) for v in _x(client, "LRANGE", "l", 0, -1)] == [b"a", b"b", b"c"]
+    assert [bytes(v) for v in _x(client, "LRANGE", "l", 1, 1)] == [b"b"]
+    assert bytes(_x(client, "LPOP", "l")) == b"a"
+    assert bytes(_x(client, "RPOP", "l")) == b"c"
+    assert _x(client, "LLEN", "l") == 1
+
+
+def test_zset_commands(client):
+    assert _x(client, "ZADD", "z", "1.5", "a", "2.5", "b") == 2
+    assert float(_x(client, "ZSCORE", "z", "a")) == 1.5
+    assert _x(client, "ZSCORE", "z", "nope") is None
+    assert _x(client, "ZCARD", "z") == 2
+    assert _x(client, "ZRANK", "z", "b") == 1
+    assert [bytes(v) for v in _x(client, "ZRANGE", "z", 0, -1)] == [b"a", b"b"]
+    ws = _x(client, "ZRANGE", "z", 0, -1, "WITHSCORES")
+    assert bytes(ws[0]) == b"a" and float(ws[1]) == 1.5
+    assert float(_x(client, "ZINCRBY", "z", "10", "a")) == 11.5
+    assert _x(client, "ZRANK", "z", "a") == 1  # re-sorted
+    assert _x(client, "ZREM", "z", "a") == 1
+    assert _x(client, "ZCARD", "z") == 1
+
+
+def test_string_extras(client):
+    _x(client, "MSET", "{st}k1", "v1", "{st}k2", "v2")
+    got = _x(client, "MGET", "{st}k1", "{st}k2", "{st}missing")
+    assert [bytes(v) if v else v for v in got] == [b"v1", b"v2", None]
+    assert bytes(_x(client, "GETSET", "{st}k1", "new")) == b"v1"
+    assert _x(client, "APPEND", "{st}k1", "!") == 4
+    assert _x(client, "STRLEN", "{st}k1") == 4
+    assert bytes(_x(client, "GETDEL", "{st}k1")) == b"new!"
+    assert _x(client, "GET", "{st}k1") is None
+
+
+def test_typed_commands_route_on_cluster():
+    runner = ClusterRunner(masters=2).run()
+    try:
+        client = runner.client(scan_interval=0)
+        for i in range(20):
+            client.execute("HSET", f"ch-{i}", "f", str(i))
+        for i in range(20):
+            assert int(client.execute("HGET", f"ch-{i}", "f")) == i
+        client.execute("SADD", "cs", "m1", "m2")
+        assert int(client.execute("SCARD", "cs")) == 2
+        # real Redis cluster semantics: cross-slot MSET/MGET raise CROSSSLOT
+        with pytest.raises(RespError, match="CROSSSLOT"):
+            client.execute("MSET", "cm-aaa", "1", "cm-bbb", "2")
+        client.execute("MSET", "{cm}a", "1", "{cm}b", "2")  # hashtag: fine
+        got = client.execute("MGET", "{cm}a", "{cm}b")
+        assert [bytes(v) for v in got] == [b"1", b"2"]
+        client.shutdown()
+    finally:
+        runner.shutdown()
+
+
+def test_typed_and_objcall_surfaces_share_raw_bytes(client):
+    """Typed commands store RAW bytes; an OBJCALL handle with BytesCodec on
+    the same name sees identical data (codec-consistency contract)."""
+    _x(client, "HSET", "mix", "f", "raw")
+    from redisson_tpu.client.codec import BytesCodec
+
+    m = client.get_map("mix", BytesCodec())
+    assert bytes(m.get(b"f")) == b"raw"
+    m.put(b"g", b"via-objcall")
+    assert bytes(_x(client, "HGET", "mix", "g")) == b"via-objcall"
+
+
+def test_lindex(client):
+    _x(client, "RPUSH", "li", "a", "b", "c")
+    assert bytes(_x(client, "LINDEX", "li", 0)) == b"a"
+    assert bytes(_x(client, "LINDEX", "li", -1)) == b"c"
+    assert _x(client, "LINDEX", "li", 9) is None
+
+
+def test_mset_atomic_no_torn_reads(client):
+    """MSET holds every record lock up front: a concurrent MGET never sees a
+    torn multi-key write (Redis atomicity contract)."""
+    import threading
+
+    _x(client, "MSET", "{at}a", "0", "{at}b", "0")
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        while not stop.is_set():
+            a, b = _x(client, "MGET", "{at}a", "{at}b")
+            if bytes(a) != bytes(b):
+                torn.append((bytes(a), bytes(b)))
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for i in range(1, 60):
+            _x(client, "MSET", "{at}a", str(i), "{at}b", str(i))
+    finally:
+        stop.set()
+        t.join(10)
+    assert not torn, f"torn MSET observed: {torn[:5]}"
