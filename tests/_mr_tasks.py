@@ -18,3 +18,10 @@ def wc_reducer(key, values):
 def slow_echo(tag, delay=1.5):
     time.sleep(delay)
     return f"done-{tag}"
+
+
+def slow_wc_mapper(key, value, collector):
+    """Word-count mapper with a per-entry stall — keeps a chunk in flight
+    long enough for the chaos test to kill its worker mid-map."""
+    time.sleep(0.1)
+    wc_mapper(key, value, collector)

@@ -273,3 +273,68 @@ def test_remote_handle_codec_rides_the_wire(grid2):
     ex = _ExecutorProxy(client, "redisson_executor")
     counts = word_count(m, workers=2, executor=ex)
     assert counts["w1"] == 10 and counts["w2"] == 10
+
+
+def test_killed_worker_mid_mapreduce_still_correct(grid2):
+    """End-to-end chaos: SIGKILL a worker while it holds a mapper chunk; the
+    orphan sweep requeues the chunk, a survivor re-runs it under a fresh
+    run id, and the FINAL COUNTS are exactly right (no loss, no
+    duplication)."""
+    import threading
+
+    st, procs, client = grid2
+    m = client.get_map("mr:chaos")
+    m.put_all({f"k{i}": "w1 w2" for i in range(40)})
+    ex = _ExecutorProxy(client, "redisson_executor")
+    mr = MapReduce(
+        None, _mr_tasks.slow_wc_mapper, _mr_tasks.wc_reducer,
+        workers=4, executor=ex,
+    ).timeout(120.0)
+
+    killed = threading.Event()
+
+    def _running_claims():
+        rec = st.server.engine.store.get("{redisson_executor}:tasks")
+        if rec is None:
+            return 0
+        return sum(
+            1 for task in rec.host["tasks"].values()
+            if task.state == "running" and task.claimed_by is not None
+        )
+
+    def assassin():
+        # wait until BOTH workers hold RUNNING chunks (1 worker thread per
+        # process), so killing procs[0] is GUARANTEED to orphan a live
+        # chunk — firing on any stale/finished claim would make the chaos
+        # vacuous
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if _running_claims() >= 2:
+                procs[0].send_signal(signal.SIGKILL)
+                procs[0].wait(timeout=10)
+                killed.set()
+                return
+            time.sleep(0.02)
+
+    def sweeper():
+        # aggressive orphan sweeps so the dead worker's chunk requeues fast
+        while not done.is_set():
+            try:
+                ex.requeue_orphans(1.5)
+            except Exception:
+                pass
+            time.sleep(0.3)
+
+    done = threading.Event()
+    ta = threading.Thread(target=assassin)
+    ts = threading.Thread(target=sweeper)
+    ta.start()
+    ts.start()
+    try:
+        result = mr.execute(m)
+    finally:
+        done.set()
+        ta.join(10)
+        ts.join(10)
+    assert killed.is_set(), "assassin never fired; chaos scenario did not run"
+    assert result == {"w1": 40, "w2": 40}, result
