@@ -108,6 +108,24 @@ class RemoteObjectProxy:
         return call
 
 
+def int64_blob(keys) -> bytes:
+    """The blob wire form for integer key batches (BF.MADD64 family): one
+    little-endian i64 buffer — shared by every sync/async blob handle so
+    the wire shape cannot drift between surfaces."""
+    return np.ascontiguousarray(keys, dtype="<i8").tobytes()
+
+
+def bool_reply(out) -> np.ndarray:
+    """Decode a blob command's per-key byte reply into a bool array."""
+    return np.frombuffer(out, np.uint8).astype(bool)
+
+
+def reserve_exists(err: "RespError") -> bool:
+    """True when BF.RESERVE failed because the filter ALREADY EXISTS (the
+    RedisBloom 'item exists' wording) — any other error must propagate."""
+    return "item exists" in str(err)
+
+
 class RemoteBloomFilter:
     """Hot-path bloom handle (BF.* wire commands; int batches ride blobs)."""
 
@@ -122,8 +140,10 @@ class RemoteBloomFilter:
                 "BF.RESERVE", self.name, repr(false_probability), expected_insertions
             )
             return True
-        except RespError:
-            return False
+        except RespError as e:
+            if reserve_exists(e):
+                return False  # already initialized: the documented False
+            raise  # bad params / routing exhaustion must not masquerade
 
     def _encode_keys(self, objs) -> List[bytes]:
         if isinstance(objs, (bytes, str, int, float)):
@@ -138,9 +158,8 @@ class RemoteBloomFilter:
 
     def add_each(self, objs) -> np.ndarray:
         if isinstance(objs, np.ndarray) and objs.dtype.kind in "iu":
-            blob = np.ascontiguousarray(objs, dtype="<i8").tobytes()
-            out = self._client.execute("BF.MADD64", self.name, blob)
-            return np.frombuffer(out, np.uint8).astype(bool)
+            out = self._client.execute("BF.MADD64", self.name, int64_blob(objs))
+            return bool_reply(out)
         reply = self._client.execute("BF.MADD", self.name, *self._encode_keys(objs))
         return np.asarray(reply, dtype=bool)
 
@@ -149,9 +168,8 @@ class RemoteBloomFilter:
 
     def contains_each(self, objs) -> np.ndarray:
         if isinstance(objs, np.ndarray) and objs.dtype.kind in "iu":
-            blob = np.ascontiguousarray(objs, dtype="<i8").tobytes()
-            out = self._client.execute("BF.MEXISTS64", self.name, blob)
-            return np.frombuffer(out, np.uint8).astype(bool)
+            out = self._client.execute("BF.MEXISTS64", self.name, int64_blob(objs))
+            return bool_reply(out)
         reply = self._client.execute("BF.MEXISTS", self.name, *self._encode_keys(objs))
         return np.asarray(reply, dtype=bool)
 

@@ -308,7 +308,7 @@ def test_killed_worker_mid_mapreduce_still_correct(grid2):
         # chunk — firing on any stale/finished claim would make the chaos
         # vacuous
         deadline = time.time() + 30
-        while time.time() < deadline:
+        while time.time() < deadline and not done.is_set():
             if _running_claims() >= 2:
                 procs[0].send_signal(signal.SIGKILL)
                 procs[0].wait(timeout=10)

@@ -192,6 +192,8 @@ def test_remote_local_cached_map_invalidation():
                 "lcm", options=LocalCachedMapOptions(sync_strategy=SyncStrategy.INVALIDATE)
             )
             ma.put("k", "v1")
+            _time.sleep(0.3)  # let ma's OWN invalidation broadcast land
+            # first — a late push would spuriously evict mb's fresh cache
             assert mb.get("k") == "v1"      # miss -> fetch -> cached
             assert mb.get("k") == "v1"      # near-cache hit
             assert mb.hits == 1 and mb.misses == 1
