@@ -76,11 +76,10 @@ class BloomFilterArray(RExpirable):
     def get_hash_iterations(self) -> int:
         return self._rec().meta["k"]
 
-    def _pack(self, tenant_ids, keys):
-        """One flush -> ONE contiguous (3, B) uint32 transfer buffer
-        (rows: tenant, key-lo, key-hi).  The host->device copy dominates a
-        flush's cost on a tunneled chip, and one large transfer runs ~3x the
-        bandwidth of three small ones (core/kernels.py pack_rows note)."""
+    def _validate_flush(self, tenant_ids, keys, allow_empty: bool = True):
+        """Shared flush validation/conversion for the single-flush and
+        window packers — ONE place for dtype/shape rules so the two transfer
+        layouts can never drift."""
         t = np.ascontiguousarray(tenant_ids, np.int32)
         if not self._engine.is_int_batch(keys):
             raise TypeError(
@@ -88,8 +87,18 @@ class BloomFilterArray(RExpirable):
                 "integer numpy array (use BloomFilter for codec-encoded objects)"
             )
         arr = np.ascontiguousarray(keys, np.int64)
-        if t.shape != arr.shape:
+        if t.shape != arr.shape or t.ndim != 1:
             raise ValueError("tenant_ids and keys must be aligned 1-D arrays")
+        if not allow_empty and arr.shape[0] == 0:
+            raise ValueError("window flushes must be non-empty")
+        return t, arr
+
+    def _pack(self, tenant_ids, keys):
+        """One flush -> ONE contiguous (3, B) uint32 transfer buffer
+        (rows: tenant, key-lo, key-hi).  The host->device copy dominates a
+        flush's cost on a tunneled chip, and one large transfer runs ~3x the
+        bandwidth of three small ones (core/kernels.py pack_rows note)."""
+        t, arr = self._validate_flush(tenant_ids, keys)
         n = arr.shape[0]
         b = K.bucket_size(max(1, n))
         lo, hi = H.int_keys_to_u32_pair(arr)
@@ -158,6 +167,119 @@ class BloomFilterArray(RExpirable):
                 rec.arrays["bits"], tlh, K.valid_n(n), rec.meta["k"], rec.meta["m"]
             )
         return found, n
+
+    # -- window submission (multi-flush, single transfer) --------------------
+
+    def _pack_flush_window(self, flushes):
+        """Pack R flushes into ONE contiguous (3, R*Bb) uint32 buffer staged
+        to the device in a single async copy.
+
+        The RBatch discipline taken one level further: the reference batches
+        k*N SETBIT/GETBITs of one logical op into one CommandsData frame
+        (command/CommandBatchService.java:87-151); a window submission
+        batches R whole flushes into one frame.  One large copy sustains
+        tunnel bandwidth that R small pipelined copies measurably do not
+        (the tunnel's async-copy path degrades with copy COUNT, not bytes).
+
+        Each flush gets a uniform Bb = bucket_size(max_len) slot; the slack
+        is filled by REPEATING the flush's last entry, so the same packed
+        buffer is valid for add (scatter-OR is idempotent; repeats set the
+        same bits again) and for contains (repeat results are discarded at
+        unpack).  Returns (device buffer, Bb, lengths)."""
+        if not flushes:
+            raise ValueError("empty window")
+        # identity dedupe: window position -> unique-flush slot.  Keyed on the
+        # CALLER's array objects (all alive in `flushes`, so ids are unique
+        # among them) — exact, and costs nothing for all-distinct windows.
+        slot_of: dict = {}
+        first_pos: list = []
+        idx = np.empty(len(flushes), np.int32)
+        for i, (t, k) in enumerate(flushes):
+            key = (id(t), id(k))
+            s = slot_of.get(key)
+            if s is None:
+                s = slot_of[key] = len(first_pos)
+                first_pos.append(i)
+            idx[i] = s
+        rows = [
+            self._validate_flush(*flushes[i], allow_empty=False) for i in first_pos
+        ]
+        lengths = [rows[idx[i]][1].shape[0] for i in range(len(flushes))]
+        bb = K.bucket_size(max(lengths))
+
+        def fill(dst, t, arr):
+            n = arr.shape[0]
+            lo, hi = H.int_keys_to_u32_pair(arr)
+            dst[0, :n] = t.view(np.uint32)
+            dst[1, :n] = lo
+            dst[2, :n] = hi
+            if n < bb:  # repeat-pad: idempotent for add, ignored for contains
+                dst[:, n:bb] = dst[:, n - 1 : n]
+
+        if len(rows) == len(flushes):
+            # all distinct: one flat buffer, no device-side composition
+            buf = np.zeros((3, len(rows) * bb), np.uint32)
+            for i, (t, arr) in enumerate(rows):
+                fill(buf[:, i * bb : (i + 1) * bb], t, arr)
+            return K.stage(buf), bb, lengths
+        # repeated flushes: upload UNIQUE buffers once, compose the window
+        # in HBM (kernels.window_from_unique) — R-x less tunnel traffic for
+        # hot-set workloads that re-submit the same query buffers
+        uniq = np.zeros((len(rows), 3, bb), np.uint32)
+        for s, (t, arr) in enumerate(rows):
+            fill(uniq[s], t, arr)
+        tlh = K.window_from_unique(K.stage(uniq), K.stage(idx))
+        return tlh, bb, lengths
+
+    def contains_flushes_async(self, flushes):
+        """Submit R contains flushes as ONE upload + ONE kernel dispatch.
+
+        Returns (device uint32 bitmap over R*Bb entries, Bb, lengths); decode
+        flush i with kernels.unpack_found on the [i*Bb, i*Bb+lengths[i])
+        slice (contains_flushes does this).  This is the throughput path for
+        pipelined multi-flush workloads (BASELINE config 2)."""
+        tlh, bb, lengths = self._pack_flush_window(flushes)
+        total = tlh.shape[1]
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            packed = K.bloom_bank_contains_packed_bits(
+                rec.arrays["bits"], tlh, K.valid_n(total), rec.meta["k"], rec.meta["m"]
+            )
+        return packed, bb, lengths
+
+    def contains_flushes(self, flushes) -> list:
+        """Sync window submission: list of bool arrays, one per flush."""
+        packed, bb, lengths = self.contains_flushes_async(flushes)
+        full = K.unpack_found(np.asarray(packed), len(lengths) * bb)
+        return [full[i * bb : i * bb + n] for i, n in enumerate(lengths)]
+
+    def add_flushes_async(self, flushes):
+        """Submit R add flushes as ONE upload + ONE kernel dispatch; returns
+        (device newly-added uint32 bitmap, Bb, lengths) without a host sync
+        — the bulk-populate path (one transfer for a whole ingest window)."""
+        tlh, bb, lengths = self._pack_flush_window(flushes)
+        total = tlh.shape[1]
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            bits, newly = K.bloom_bank_add_packed_bits(
+                rec.arrays["bits"], tlh, K.valid_n(total), rec.meta["k"], rec.meta["m"]
+            )
+            rec.arrays["bits"] = bits
+            self._touch_version(rec)
+        return newly, bb, lengths
+
+    def add_flushes(self, flushes) -> list:
+        """Sync window submission: newly-added count per flush.
+
+        Positions past lengths[i] (the repeat-padding) are sliced off before
+        counting, so padding never inflates counts.  "Newly" is evaluated
+        against the bank state at WINDOW start (one batch-parallel dispatch):
+        a key appearing in two flushes of the same window counts as new in
+        both — identical to the existing semantics for duplicate keys inside
+        a single flush."""
+        newly, bb, lengths = self.add_flushes_async(flushes)
+        full = K.unpack_found(np.asarray(newly), len(lengths) * bb)
+        return [int(full[i * bb : i * bb + n].sum()) for i, n in enumerate(lengths)]
 
     def clear_tenant(self, tenant_id: int) -> None:
         with self._engine.locked(self._name):

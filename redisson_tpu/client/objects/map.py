@@ -69,6 +69,14 @@ class MapOptions:
 class Map(RExpirable):
     _kind = "map"
 
+    @property
+    def _scan_view_safe(self) -> bool:
+        """True when the value set is fully described by (nonce, version) —
+        the key for staged device scan views (services/mapreduce._WcScanView).
+        Loader-backed maps are excluded: read-through loads insert values
+        without a version bump."""
+        return self._options.loader is None
+
     def __init__(self, engine, name, codec=None, options: Optional[MapOptions] = None):
         super().__init__(engine, name, codec)
         self._options = options or MapOptions()
@@ -390,6 +398,9 @@ class MapCache(Map):
     """
 
     _kind = "map_cache"
+    # TTL/max-idle expiry removes entries WITHOUT bumping the record version
+    # (lazy reap on access), so (nonce, version) cannot key a scan view here
+    _scan_view_safe = False
 
     def _now(self):
         return time.time()

@@ -116,7 +116,12 @@ class Engine:
                 we = self._wait_entries[key] = WaitEntry()
         we.touch()  # a fetched entry is in use: restart its idle clock
         # the sweep rides the shared eviction thread; first use starts it
-        self.eviction.schedule("__wait_entry_gc__", self._gc_wait_entries)
+        try:
+            self.eviction.schedule("__wait_entry_gc__", self._gc_wait_entries)
+        except RuntimeError:
+            # engine shut down between the entry fetch and the schedule; the
+            # caller's park loop is bounded, so skipping the GC is harmless
+            pass
         return we
 
     def _gc_wait_entries(self, max_idle: float = 60.0) -> int:

@@ -269,6 +269,32 @@ def bloom_bank_contains_packed_bits(bits2d, tlh, n_valid, k: int, m: int):
     return _pack_bool_u32(_bloom_bank_contains_impl(bits2d, tlh, n_valid, k, m))
 
 
+@jax.jit
+def window_from_unique(uniq, idx):
+    """Compose a flush window on DEVICE from its unique flushes.
+
+    uniq: (U, 3, Bb) packed unique flushes; idx: (R,) int32 mapping window
+    position -> unique slot.  Returns (3, R*Bb) laid out exactly like a
+    host-packed window (flush i occupies [i*Bb, (i+1)*Bb) of each row).
+
+    Pipelined workloads re-submit the same flush buffers (hot query sets,
+    re-validation sweeps); re-uploading R identical 1.4MB operands is pure
+    tunnel waste AND triggers the tunnel's h2d decay mode, while an HBM-side
+    take of the same bytes is effectively free.  The dedupe is by object
+    identity in _pack_flush_window — exact, zero hashing cost."""
+    w = jnp.take(uniq, idx, axis=0)  # (R, 3, Bb)
+    return jnp.swapaxes(w, 0, 1).reshape(3, -1)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4), donate_argnums=(0,))
+def bloom_bank_add_packed_bits(bits2d, tlh, n_valid, k: int, m: int):
+    """Add variant returning the newly-added flags as a uint32 bitmap — the
+    multi-flush (window) result path, where B bool bytes per entry would
+    dominate d2h the same way they do for contains."""
+    bits, newly = _bloom_bank_add_packed(bits2d, tlh, n_valid, k, m)
+    return bits, _pack_bool_u32(newly)
+
+
 def _bloom_add_packed(bits, lh, n_valid, k: int, m: int):
     return _bloom_add_body(bits, lh[0], lh[1], n_valid, k, m)
 

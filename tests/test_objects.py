@@ -100,6 +100,68 @@ class TestBloomFilterArray:
         arr.clear_tenant(2)
         assert not arr.contains(np.full(50, 2, np.int32), keys).any()
 
+    def test_flush_window_matches_per_flush(self, client):
+        """Window submission (one buffer, one dispatch) must be semantically
+        identical to the same flushes submitted one by one — including ragged
+        flush lengths, cross-flush duplicate keys, and newly-added counts."""
+        rng = np.random.default_rng(11)
+        arr = client.get_bloom_filter_array("tenants")
+        arr.try_init(tenants=8, expected_insertions=2000, false_probability=0.01)
+        flushes = []
+        for n in (700, 41, 1, 530):  # ragged: exercises repeat-padding
+            t = rng.integers(0, 8, n).astype(np.int32)
+            k = rng.integers(0, 1 << 40, n).astype(np.int64)
+            flushes.append((t, k))
+        # pre-add the first flush: re-adding it in a window must count 0 new
+        arr.add(*flushes[0])
+        counts = arr.add_flushes(flushes + [flushes[0]])
+        assert counts[0] == 0 and counts[-1] == 0
+        assert len(counts) == len(flushes) + 1
+        assert 0 < counts[3] <= 530  # repeat-padding must not inflate counts
+        results = arr.contains_flushes(flushes)
+        for (t, k), found in zip(flushes, results):
+            assert found.shape == k.shape
+            assert found.all()  # everything was added
+        # absent keys in a window mixed with present ones
+        absent = rng.integers(1 << 50, 1 << 60, 300).astype(np.int64)
+        mixed = arr.contains_flushes(
+            [(flushes[0][0][:300], flushes[0][1][:300]),
+             (rng.integers(0, 8, 300).astype(np.int32), absent)]
+        )
+        assert mixed[0].all()
+        assert mixed[1].sum() <= 6  # FP allowance
+
+    def test_flush_window_identity_dedupe(self, client):
+        """A window repeating the same flush OBJECTS takes the device-side
+        composition path (one unique upload + take); results and counts must
+        be identical to a window of distinct equal-content copies."""
+        rng = np.random.default_rng(5)
+        arr = client.get_bloom_filter_array("tenants")
+        arr.try_init(tenants=4, expected_insertions=1000, false_probability=0.01)
+        t = rng.integers(0, 4, 257).astype(np.int32)
+        k = rng.integers(0, 1 << 40, 257).astype(np.int64)
+        other = (rng.integers(0, 4, 40).astype(np.int32),
+                 rng.integers(0, 1 << 40, 40).astype(np.int64))
+        window = [(t, k), other, (t, k), (t, k)]  # dupes by identity
+        copies = [(t.copy(), k.copy()), other, (t.copy(), k.copy()), (t.copy(), k.copy())]
+        counts = arr.add_flushes(window)
+        assert counts[0] == counts[2] == counts[3]  # same window-start state
+        res_dedup = arr.contains_flushes(window)
+        res_plain = arr.contains_flushes(copies)
+        for a, b in zip(res_dedup, res_plain):
+            assert np.array_equal(a, b)
+        assert all(r.all() for r in res_dedup)
+
+    def test_flush_window_validation(self, client):
+        arr = client.get_bloom_filter_array("tenants")
+        arr.try_init(tenants=2, expected_insertions=100, false_probability=0.01)
+        with pytest.raises(ValueError):
+            arr.add_flushes([])
+        with pytest.raises(ValueError):
+            arr.add_flushes([(np.zeros(0, np.int32), np.zeros(0, np.int64))])
+        with pytest.raises(ValueError):
+            arr.contains_flushes([(np.zeros(3, np.int32), np.zeros(4, np.int64))])
+
 
 class TestHyperLogLog:
     def test_basic(self, client):

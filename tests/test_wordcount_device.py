@@ -87,3 +87,81 @@ def test_device_word_count_ascii_control_whitespace():
     them); the byte kernel must treat them identically (reviewer repro)."""
     vals = ["alpha\x1cbeta", "alpha beta", "g\x1dh\x1ei\x1fj"]
     assert device_word_count(vals) == _ref(vals)
+
+
+def test_word_count_scan_view_cache_invalidation():
+    """Repeated word_count over an UNCHANGED map serves from the staged
+    device view; ANY mutation (put / remove / delete+recreate) must
+    invalidate it — stale counts would be a correctness bug, not a perf
+    detail."""
+    import redisson_tpu
+    from redisson_tpu.client.codec import StringCodec
+    from redisson_tpu.services.mapreduce import _WcViewCache
+
+    client = redisson_tpu.create()
+    try:
+        m = client.get_map("wc:view", codec=StringCodec())
+        m.put_all({f"d{i}": "alpha beta alpha" for i in range(50)})
+        assert word_count(m) == {"alpha": 100, "beta": 50}
+        cache = client._engine.service("wc_scan_views", _WcViewCache)
+        assert cache._views.get("wc:view") is not None  # view was staged
+        # second scan hits the view (key unchanged)
+        rec = client._engine.store.get("wc:view")
+        assert cache.get("wc:view", (rec.nonce, rec.version)) is not None
+        assert word_count(m) == {"alpha": 100, "beta": 50}
+        # mutation bumps version -> view miss -> fresh counts
+        m.put("extra", "gamma gamma")
+        assert word_count(m) == {"alpha": 100, "beta": 50, "gamma": 2}
+        m.remove("extra")
+        assert word_count(m) == {"alpha": 100, "beta": 50}
+        # delete + recreate restarts versions but changes the nonce
+        m.delete()
+        m.put_all({"x": "delta"})
+        assert word_count(m) == {"delta": 1}
+    finally:
+        client.shutdown()
+
+
+def test_word_count_map_cache_ttl_not_stale():
+    """MapCache TTL expiry removes entries without a version bump, so the
+    scan-view fast path must not apply — counts must reflect expiry."""
+    import time
+
+    import redisson_tpu
+    from redisson_tpu.client.codec import StringCodec
+
+    client = redisson_tpu.create()
+    try:
+        mc = client.get_map_cache("wc:ttl", codec=StringCodec())
+        mc.put("keep", "alpha")
+        mc.put_with_ttl("gone", "beta", ttl=0.2)
+        assert word_count(mc) == {"alpha": 1, "beta": 1}
+        time.sleep(0.3)
+        assert word_count(mc) == {"alpha": 1}  # stale view would keep beta
+    finally:
+        client.shutdown()
+
+
+def test_word_count_loader_backed_map_not_stale():
+    """Read-through loads insert values WITHOUT a version bump, so
+    loader-configured maps must bypass the scan-view fast path."""
+    import redisson_tpu
+    from redisson_tpu.client.codec import StringCodec
+    from redisson_tpu.client.objects.map import MapLoader, MapOptions
+
+    class L(MapLoader):
+        def load(self, key):
+            return "gamma gamma"
+
+        def load_all_keys(self):
+            return []
+
+    client = redisson_tpu.create()
+    try:
+        m = client.get_map("wc:loader", codec=StringCodec(), options=MapOptions(loader=L()))
+        m.put("a", "alpha beta")
+        assert word_count(m) == {"alpha": 1, "beta": 1}
+        m.get("newkey")  # read-through load, no version bump
+        assert word_count(m) == {"alpha": 1, "beta": 1, "gamma": 2}
+    finally:
+        client.shutdown()
