@@ -298,6 +298,11 @@ class ScoredSortedSet(RExpirable):
 
     def poll_first(self):
         """ZPOPMIN."""
+        e = self.poll_first_entry()
+        return None if e is None else e[0]
+
+    def poll_first_entry(self):
+        """ZPOPMIN with score: (member, score) or None."""
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
             idx = self._index_of(rec)
@@ -307,10 +312,15 @@ class ScoredSortedSet(RExpirable):
             del rec.host["scores"][m]
             self._dirty(rec)
             self._touch_version(rec)
-            return self._d(m)
+            return self._d(m), sc
 
     def poll_last(self):
         """ZPOPMAX."""
+        e = self.poll_last_entry()
+        return None if e is None else e[0]
+
+    def poll_last_entry(self):
+        """ZPOPMAX with score: (member, score) or None."""
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
             idx = self._index_of(rec)
@@ -320,7 +330,7 @@ class ScoredSortedSet(RExpirable):
             del rec.host["scores"][m]
             self._dirty(rec)
             self._touch_version(rec)
-            return self._d(m)
+            return self._d(m), sc
 
     def random_member(self):
         rec = self._engine.store.get(self._name)

@@ -23,9 +23,15 @@ from redisson_tpu.utils.crc16 import MAX_SLOT, calc_slot
 # scatter-gather surface (CommandAsyncService readAllAsync/writeAllAsync)
 ALL_SHARD = {"KEYS": "concat", "DBSIZE": "sum", "FLUSHALL": "ok"}
 
-# multi-key WRITE commands that are one atomic compound op server-side:
+# multi-key commands that are one atomic compound op server-side:
 # all keys must colocate on one shard (Redis CROSSSLOT rule)
-SAME_SLOT = {"PFMERGE", "BITOP", "RENAME", "MGET", "MSET"}
+SAME_SLOT = {
+    "PFMERGE", "BITOP", "RENAME", "MGET", "MSET", "MSETNX",
+    "SMOVE", "LMOVE", "RPOPLPUSH",
+    "SINTER", "SUNION", "SDIFF",
+    "SINTERSTORE", "SUNIONSTORE", "SDIFFSTORE", "SINTERCARD",
+    "ZUNIONSTORE", "ZINTERSTORE",
+}
 # (MGET/MSET follow real Redis cluster semantics: multi-key commands
 #  spanning slots raise CROSSSLOT; use {hashtags} or the RBuckets
 #  handles, which split per shard client-side)

@@ -14,16 +14,23 @@ from typing import List, Optional, Tuple
 
 
 class CommandSpec:
-    __slots__ = ("name", "write", "key_at", "multi_key", "global_cmd", "key_stride")
+    __slots__ = ("name", "write", "key_at", "multi_key", "global_cmd",
+                 "key_stride", "key_count", "numkeys_at")
 
     def __init__(self, name: str, write: bool, key_at: Optional[int],
-                 multi_key: bool = False, key_stride: int = 1):
+                 multi_key: bool = False, key_stride: int = 1,
+                 key_count: Optional[int] = None,
+                 numkeys_at: Optional[int] = None):
         self.name = name
         self.write = write
         self.key_at = key_at  # index into args AFTER the command name; None = keyless
         self.multi_key = multi_key  # keys run from key_at to end of args
         self.key_stride = key_stride  # MSET-style interleaved key-value lists
-        self.global_cmd = key_at is None
+        self.key_count = key_count  # bounded key runs (SMOVE/LMOVE: first 2)
+        # EVAL-style dynamic key lists: args[numkeys_at] holds the count and
+        # the keys follow it (ZUNIONSTORE dest numkeys k1..kn)
+        self.numkeys_at = numkeys_at
+        self.global_cmd = key_at is None and numkeys_at is None
 
 
 def _spec(table, names, write, key_at, multi_key=False):
@@ -63,6 +70,28 @@ _spec(SPECS, "HSET HDEL SADD SREM LPUSH RPUSH LPOP RPOP ZADD ZREM ZINCRBY "
 _spec(SPECS, "MGET", False, 0, multi_key=True)
 SPECS["MSET"] = CommandSpec("MSET", True, 0, multi_key=True, key_stride=2)
 
+# typed surface expansion (strings/keys/hash/set/list/zset verbs)
+_spec(SPECS, "GETRANGE EXPIRETIME PEXPIRETIME HSTRLEN HRANDFIELD HSCAN SSCAN "
+             "ZSCAN SRANDMEMBER SMISMEMBER ZCOUNT ZRANGEBYSCORE "
+             "ZREVRANGEBYSCORE ZREVRANGE ZMSCORE ZRANDMEMBER ZREVRANK LPOS",
+      False, 0)
+_spec(SPECS, "SETNX SETEX PSETEX GETEX SETRANGE INCRBYFLOAT DECRBY EXPIREAT "
+             "PEXPIREAT HSETNX HINCRBY HINCRBYFLOAT SPOP LSET LINSERT LREM "
+             "LTRIM LPUSHX RPUSHX ZPOPMIN ZPOPMAX ZREMRANGEBYSCORE "
+             "ZREMRANGEBYRANK", True, 0)
+_spec(SPECS, "RANDOMKEY SCAN", False, None)
+_spec(SPECS, "TOUCH", False, 0, multi_key=True)
+SPECS["MSETNX"] = CommandSpec("MSETNX", True, 0, multi_key=True, key_stride=2)
+_spec(SPECS, "SINTER SUNION SDIFF", False, 0, multi_key=True)
+_spec(SPECS, "SINTERSTORE SUNIONSTORE SDIFFSTORE", True, 0, multi_key=True)
+# bounded key runs: first two args are keys, the rest are operands
+for _n in ("SMOVE", "LMOVE", "RPOPLPUSH"):
+    SPECS[_n] = CommandSpec(_n, True, 0, multi_key=True, key_count=2)
+# EVAL-style numkeys commands
+SPECS["SINTERCARD"] = CommandSpec("SINTERCARD", False, None, numkeys_at=0)
+SPECS["ZUNIONSTORE"] = CommandSpec("ZUNIONSTORE", True, 0, numkeys_at=1)
+SPECS["ZINTERSTORE"] = CommandSpec("ZINTERSTORE", True, 0, numkeys_at=1)
+
 # multi-key
 _spec(SPECS, "DEL UNLINK", True, 0, multi_key=True)
 _spec(SPECS, "RENAME", True, 0, multi_key=True)
@@ -99,10 +128,26 @@ def lookup(cmd: str) -> Optional[CommandSpec]:
 def command_keys(cmd: str, args: List[bytes]) -> List[bytes]:
     """Key args of an encoded command (args EXCLUDE the command name)."""
     spec = lookup(cmd)
-    if spec is None or spec.key_at is None or len(args) <= spec.key_at:
+    if spec is None:
+        return []
+    if spec.numkeys_at is not None:
+        if len(args) <= spec.numkeys_at:
+            return []
+        try:
+            n = int(args[spec.numkeys_at])
+        except (TypeError, ValueError):
+            return []
+        keys = list(args[spec.numkeys_at + 1 : spec.numkeys_at + 1 + n])
+        if spec.key_at is not None and spec.key_at < spec.numkeys_at:
+            keys.insert(0, args[spec.key_at])  # STORE dest before numkeys
+        return keys
+    if spec.key_at is None or len(args) <= spec.key_at:
         return []
     if spec.multi_key:
-        return list(args[spec.key_at :: spec.key_stride])
+        keys = list(args[spec.key_at :: spec.key_stride])
+        if spec.key_count is not None:
+            keys = keys[: spec.key_count]
+        return keys
     return [args[spec.key_at]]
 
 

@@ -1409,7 +1409,7 @@ def cmd_zadd(server, ctx, args):
 @register("ZSCORE")
 def cmd_zscore(server, ctx, args):
     sc = _typed_handle(server, "get_scored_sorted_set", _s(args[0])).get_score(bytes(args[1]))
-    return None if sc is None else repr(sc).encode()
+    return None if sc is None else _fnum(sc)
 
 
 @register("ZREM")
@@ -1431,7 +1431,7 @@ def cmd_zrank(server, ctx, args):
 @register("ZINCRBY")
 def cmd_zincrby(server, ctx, args):
     z = _typed_handle(server, "get_scored_sorted_set", _s(args[0]))
-    return repr(z.add_score(bytes(args[2]), float(args[1]))).encode()
+    return _fnum(z.add_score(bytes(args[2]), float(args[1])))
 
 
 @register("ZRANGE")
@@ -1442,7 +1442,7 @@ def cmd_zrange(server, ctx, args):
     if withscores:
         out = []
         for member, score in z.entry_range(lo, hi):
-            out += [member, repr(score).encode()]
+            out += [member, _fnum(score)]
         return out
     return z.value_range(lo, hi)
 
@@ -1497,3 +1497,825 @@ def cmd_append(server, ctx, args):
 def cmd_strlen(server, ctx, args):
     v = _bucket(server, _s(args[0])).get()
     return 0 if v is None else len(bytes(v))
+
+
+# -- typed surface expansion (strings / keys / scan cursors) ------------------
+# Same contract as the block above: BytesCodec values, Redis reply shapes,
+# record locks for compound read-modify-write.  Reference definitions:
+# client/protocol/RedisCommands.java (SETNX:188, SETRANGE/GETRANGE:199-201,
+# INCRBYFLOAT:214, SCAN:531, EXPIREAT:340).
+
+def _fnum(x: float) -> bytes:
+    """Redis float reply formatting: integral values print without '.0'."""
+    return (str(int(x)) if float(x) == int(x) else repr(float(x))).encode()
+
+
+def _glob_match(pattern: str, value: str) -> bool:
+    import fnmatch
+
+    return fnmatch.fnmatchcase(value, pattern)
+
+
+def _scan_page(items: List[bytes], cursor: int, count: int):
+    """Cursor = offset into the sorted item list (stable enough under the
+    weakly-consistent SCAN contract the reference also provides)."""
+    nxt = cursor + count
+    page = items[cursor:nxt]
+    return [b"0" if nxt >= len(items) else str(nxt).encode(), page]
+
+
+def _scan_opts(args, start: int):
+    pattern, count, novalues = None, 10, False
+    i = start
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"MATCH":
+            pattern = _s(args[i + 1])
+            i += 2
+        elif opt == b"COUNT":
+            count = max(1, _int(args[i + 1]))
+            i += 2
+        elif opt == b"NOVALUES":
+            novalues = True
+            i += 1
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    return pattern, count, novalues
+
+
+@register("SETNX")
+def cmd_setnx(server, ctx, args):
+    return 1 if _bucket(server, _s(args[0])).try_set(bytes(args[1])) else 0
+
+
+@register("SETEX")
+def cmd_setex(server, ctx, args):
+    ttl = _int(args[1])
+    if ttl <= 0:
+        raise RespError("ERR invalid expire time in 'setex' command")
+    _bucket(server, _s(args[0])).set(bytes(args[2]), ttl=float(ttl))
+    return "+OK"
+
+
+@register("PSETEX")
+def cmd_psetex(server, ctx, args):
+    ttl = _int(args[1])
+    if ttl <= 0:
+        raise RespError("ERR invalid expire time in 'psetex' command")
+    _bucket(server, _s(args[0])).set(bytes(args[2]), ttl=ttl / 1000.0)
+    return "+OK"
+
+
+@register("GETEX")
+def cmd_getex(server, ctx, args):
+    name = _s(args[0])
+    # parse the FULL option list before touching state: a trailing syntax
+    # error must leave the TTL unchanged (Redis validates then applies)
+    actions = []
+    i = 1
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"EX":
+            actions.append(lambda n=name, s=_int(args[i + 1]): server.engine.store.expire(n, time.time() + s))
+            i += 2
+        elif opt == b"PX":
+            actions.append(lambda n=name, ms=_int(args[i + 1]): server.engine.store.expire(n, time.time() + ms / 1000.0))
+            i += 2
+        elif opt == b"EXAT":
+            actions.append(lambda n=name, at=float(_int(args[i + 1])): server.engine.store.expire(n, at))
+            i += 2
+        elif opt == b"PXAT":
+            actions.append(lambda n=name, at=_int(args[i + 1]) / 1000.0: server.engine.store.expire(n, at))
+            i += 2
+        elif opt == b"PERSIST":
+            actions.append(lambda n=name: server.engine.store.expire(n, None))
+            i += 1
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    with server.engine.locked(name):
+        v = _bucket(server, name).get()
+        if v is None:
+            return None
+        for act in actions:
+            act()
+        return v
+
+
+@register("GETRANGE")
+def cmd_getrange(server, ctx, args):
+    v = _bucket(server, _s(args[0])).get()
+    if v is None:
+        return b""
+    data = bytes(v)
+    from redisson_tpu.client.objects.scoredsortedset import _norm_range
+
+    lo, hi = _norm_range(_int(args[1]), _int(args[2]), len(data))
+    return data[lo : hi + 1] if hi >= lo else b""
+
+
+@register("SETRANGE")
+def cmd_setrange(server, ctx, args):
+    name = _s(args[0])
+    off = _int(args[1])
+    if off < 0:
+        raise RespError("ERR offset is out of range")
+    patch = bytes(args[2])
+    with server.engine.locked(name):
+        b = _bucket(server, name)
+        cur = bytearray(bytes(b.get() or b""))
+        if len(cur) < off + len(patch):
+            cur.extend(b"\x00" * (off + len(patch) - len(cur)))
+        cur[off : off + len(patch)] = patch
+        b.set(bytes(cur))
+        return len(cur)
+
+
+@register("INCRBYFLOAT")
+def cmd_incrbyfloat(server, ctx, args):
+    name = _s(args[0])
+    with server.engine.locked(name):
+        b = _bucket(server, name)
+        cur = b.get()
+        try:
+            new = (float(cur) if cur is not None else 0.0) + float(args[1])
+        except ValueError:
+            raise RespError("ERR value is not a valid float")
+        b.set(_fnum(new))
+        return _fnum(new)
+
+
+@register("DECRBY")
+def cmd_decrby(server, ctx, args):
+    from redisson_tpu.client.objects.bucket import AtomicLong
+
+    return AtomicLong(server.engine, _s(args[0])).add_and_get(-_int(args[1]))
+
+
+@register("MSETNX")
+def cmd_msetnx(server, ctx, args):
+    # all-or-nothing: every key must be absent (Redis MSETNX contract)
+    names = [_s(args[i]) for i in range(0, len(args) - 1, 2)]
+    with server.engine.locked_many(names):
+        if any(server.engine.store.exists(n) for n in names):
+            return 0
+        for i in range(0, len(args) - 1, 2):
+            _bucket(server, _s(args[i])).set(bytes(args[i + 1]))
+        return 1
+
+
+@register("EXPIREAT")
+def cmd_expireat(server, ctx, args):
+    return _expire_locked(server, _s(args[0]), float(_int(args[1])))
+
+
+@register("PEXPIREAT")
+def cmd_pexpireat(server, ctx, args):
+    return _expire_locked(server, _s(args[0]), _int(args[1]) / 1000.0)
+
+
+def _expiretime(server, name: str, ms: bool):
+    if not server.engine.store.exists(name):
+        return -2
+    ttl = server.engine.store.ttl(name)
+    if ttl is None:
+        return -1
+    at = time.time() + ttl
+    return int(at * 1000) if ms else int(at)
+
+
+@register("EXPIRETIME")
+def cmd_expiretime(server, ctx, args):
+    return _expiretime(server, _s(args[0]), ms=False)
+
+
+@register("PEXPIRETIME")
+def cmd_pexpiretime(server, ctx, args):
+    return _expiretime(server, _s(args[0]), ms=True)
+
+
+@register("RANDOMKEY")
+def cmd_randomkey(server, ctx, args):
+    import random
+
+    ks = list(server.engine.store.keys())
+    return random.choice(ks).encode() if ks else None
+
+
+@register("TOUCH")
+def cmd_touch(server, ctx, args):
+    return sum(1 for k in args if server.engine.store.exists(_s(k)))
+
+
+@register("SCAN")
+def cmd_scan(server, ctx, args):
+    pattern, count, _ = _scan_opts(args, 1)
+    ks = sorted(server.engine.store.keys(pattern))
+    return _scan_page([k.encode() for k in ks], _int(args[0]), count)
+
+
+# -- typed surface expansion (hashes) ----------------------------------------
+
+@register("HSETNX")
+def cmd_hsetnx(server, ctx, args):
+    m = _typed_handle(server, "get_map", _s(args[0]))
+    return 1 if m.fast_put_if_absent(bytes(args[1]), bytes(args[2])) else 0
+
+
+def _hash_incr(server, args, parse, fmt):
+    name = _s(args[0])
+    field = bytes(args[1])
+    m = _typed_handle(server, "get_map", name)
+    with server.engine.locked(name):
+        cur = m.get(field)
+        try:
+            new = (parse(cur) if cur is not None else parse(b"0")) + parse(args[2])
+        except ValueError:
+            raise RespError("ERR hash value is not a number")
+        m.fast_put(field, fmt(new))
+        return new
+
+
+@register("HINCRBY")
+def cmd_hincrby(server, ctx, args):
+    return _hash_incr(server, args, _int, lambda v: str(v).encode())
+
+
+@register("HINCRBYFLOAT")
+def cmd_hincrbyfloat(server, ctx, args):
+    return _fnum(_hash_incr(server, args, float, _fnum))
+
+
+@register("HSTRLEN")
+def cmd_hstrlen(server, ctx, args):
+    v = _typed_handle(server, "get_map", _s(args[0])).get(bytes(args[1]))
+    return 0 if v is None else len(bytes(v))
+
+
+@register("HRANDFIELD")
+def cmd_hrandfield(server, ctx, args):
+    import random
+
+    m = _typed_handle(server, "get_map", _s(args[0]))
+    entries = m.read_all_entry_set()
+    if len(args) == 1:
+        return random.choice(entries)[0] if entries else None
+    n = _int(args[1])
+    withvalues = len(args) > 2 and bytes(args[2]).upper() == b"WITHVALUES"
+    if n >= 0:  # distinct fields, at most n
+        picked = random.sample(entries, min(n, len(entries)))
+    else:  # repeats allowed, exactly |n|
+        picked = [random.choice(entries) for _ in range(-n)] if entries else []
+    out = []
+    for k, v in picked:
+        out += [k, v] if withvalues else [k]
+    return out
+
+
+@register("HSCAN")
+def cmd_hscan(server, ctx, args):
+    pattern, count, novalues = _scan_opts(args, 2)
+    m = _typed_handle(server, "get_map", _s(args[0]))
+    entries = sorted(m.read_all_entry_set())
+    if pattern is not None:
+        entries = [e for e in entries if _glob_match(pattern, e[0].decode(errors="replace"))]
+    cur, page = _scan_page(entries, _int(args[1]), count)
+    flat = []
+    for k, v in page:
+        flat += [k] if novalues else [k, v]
+    return [cur, flat]
+
+
+# -- typed surface expansion (sets) ------------------------------------------
+
+def _set(server, name: str):
+    return _typed_handle(server, "get_set", name)
+
+
+@register("SPOP")
+def cmd_spop(server, ctx, args):
+    s = _set(server, _s(args[0]))
+    if len(args) == 1:
+        v = s.remove_random()
+        return None if v is None else bytes(v)
+    return [bytes(v) for v in (s.remove_random() for _ in range(_int(args[1]))) if v is not None]
+
+
+@register("SRANDMEMBER")
+def cmd_srandmember(server, ctx, args):
+    import random
+
+    s = _set(server, _s(args[0]))
+    if len(args) == 1:
+        v = s.random_member()
+        return None if v is None else bytes(v)
+    n = _int(args[1])
+    members = s.read_all()
+    if n >= 0:
+        return random.sample(members, min(n, len(members)))
+    return [random.choice(members) for _ in range(-n)] if members else []
+
+
+@register("SMISMEMBER")
+def cmd_smismember(server, ctx, args):
+    s = _set(server, _s(args[0]))
+    return [1 if s.contains(bytes(m)) else 0 for m in args[1:]]
+
+
+@register("SMOVE")
+def cmd_smove(server, ctx, args):
+    return 1 if _set(server, _s(args[0])).move(_s(args[1]), bytes(args[2])) else 0
+
+
+@register("SINTER")
+def cmd_sinter(server, ctx, args):
+    return _set(server, _s(args[0])).read_intersection(*[_s(n) for n in args[1:]])
+
+
+@register("SUNION")
+def cmd_sunion(server, ctx, args):
+    return _set(server, _s(args[0])).read_union(*[_s(n) for n in args[1:]])
+
+
+@register("SDIFF")
+def cmd_sdiff(server, ctx, args):
+    return _set(server, _s(args[0])).read_diff(*[_s(n) for n in args[1:]])
+
+
+def _set_store(server, args, op: str):
+    # Redis *STORE semantics: result = op over the SOURCES only, dest is
+    # overwritten (its old content never participates).  The handle-level
+    # union/intersection/diff include self, so compute via the first
+    # source's read_* form and write the result — all under one lock scope
+    # (record RLocks are re-entrant per thread, so the nested handle locks
+    # are safe)
+    dest = _s(args[0])
+    srcs = [_s(n) for n in args[1:]]
+    with server.engine.locked_many([dest, *srcs]):
+        result = getattr(_set(server, srcs[0]), op)(*srcs[1:])
+        server.engine.store.delete(dest)
+        d = _set(server, dest)
+        if result:
+            d.add_all(bytes(v) for v in result)
+        return len(result)
+
+
+@register("SINTERSTORE")
+def cmd_sinterstore(server, ctx, args):
+    return _set_store(server, args, "read_intersection")
+
+
+@register("SUNIONSTORE")
+def cmd_sunionstore(server, ctx, args):
+    return _set_store(server, args, "read_union")
+
+
+@register("SDIFFSTORE")
+def cmd_sdiffstore(server, ctx, args):
+    return _set_store(server, args, "read_diff")
+
+
+@register("SINTERCARD")
+def cmd_sintercard(server, ctx, args):
+    n = _int(args[0])
+    names = [_s(k) for k in args[1 : 1 + n]]
+    limit = None
+    if len(args) > 1 + n:
+        if bytes(args[1 + n]).upper() != b"LIMIT":
+            raise RespError("ERR syntax error")
+        limit = _int(args[2 + n])
+        if limit < 0:
+            raise RespError("ERR LIMIT can't be negative")
+    inter = _set(server, names[0]).read_intersection(*names[1:])
+    card = len(inter)
+    return min(card, limit) if limit not in (None, 0) else card
+
+
+@register("SSCAN")
+def cmd_sscan(server, ctx, args):
+    pattern, count, _ = _scan_opts(args, 2)
+    members = sorted(bytes(v) for v in _set(server, _s(args[0])).read_all())
+    if pattern is not None:
+        members = [m for m in members if _glob_match(pattern, m.decode(errors="replace"))]
+    return _scan_page(members, _int(args[1]), count)
+
+
+# -- typed surface expansion (lists) -----------------------------------------
+# Compound list edits operate on the queue record's host list directly under
+# the record lock (the handle exposes the safe subset; Redis list verbs like
+# LINSERT/LREM need positional surgery).
+
+def _list_edit(server, name: str):
+    d = _deque(server, name)
+    rec = d._rec_or_create()
+    return d, rec
+
+
+@register("LPUSHX")
+def cmd_lpushx(server, ctx, args):
+    name = _s(args[0])
+    with server.engine.locked(name):
+        if not server.engine.store.exists(name):
+            return 0
+        d = _deque(server, name)
+        for v in args[1:]:
+            d.add_first(bytes(v))
+        return d.size()
+
+
+@register("RPUSHX")
+def cmd_rpushx(server, ctx, args):
+    name = _s(args[0])
+    with server.engine.locked(name):
+        if not server.engine.store.exists(name):
+            return 0
+        d = _deque(server, name)
+        for v in args[1:]:
+            d.add_last(bytes(v))
+        return d.size()
+
+
+@register("LSET")
+def cmd_lset(server, ctx, args):
+    name = _s(args[0])
+    with server.engine.locked(name):
+        if not server.engine.store.exists(name):
+            raise RespError("ERR no such key")
+        d, rec = _list_edit(server, name)
+        i = _int(args[1])
+        if i < 0:
+            i += len(rec.host)
+        if not 0 <= i < len(rec.host):
+            raise RespError("ERR index out of range")
+        rec.host[i] = bytes(args[2])
+        d._touch_version(rec)
+        return "+OK"
+
+
+@register("LINSERT")
+def cmd_linsert(server, ctx, args):
+    name = _s(args[0])
+    where = bytes(args[1]).upper()
+    if where not in (b"BEFORE", b"AFTER"):
+        raise RespError("ERR syntax error")
+    pivot, elem = bytes(args[2]), bytes(args[3])
+    with server.engine.locked(name):
+        if not server.engine.store.exists(name):
+            return 0
+        d, rec = _list_edit(server, name)
+        try:
+            i = rec.host.index(pivot)
+        except ValueError:
+            return -1
+        rec.host.insert(i if where == b"BEFORE" else i + 1, elem)
+        d._touch_version(rec)
+        return len(rec.host)
+
+
+@register("LREM")
+def cmd_lrem(server, ctx, args):
+    name = _s(args[0])
+    n, target = _int(args[1]), bytes(args[2])
+    with server.engine.locked(name):
+        if not server.engine.store.exists(name):
+            return 0
+        d, rec = _list_edit(server, name)
+        items = rec.host
+        removed = 0
+        if n == 0:
+            before = len(items)
+            rec.host = [v for v in items if v != target]
+            removed = before - len(rec.host)
+        elif n > 0:
+            out = []
+            for v in items:
+                if v == target and removed < n:
+                    removed += 1
+                else:
+                    out.append(v)
+            rec.host = out
+        else:
+            out = []
+            for v in reversed(items):
+                if v == target and removed < -n:
+                    removed += 1
+                else:
+                    out.append(v)
+            rec.host = out[::-1]
+        if removed:
+            d._touch_version(rec)
+        return removed
+
+
+@register("LTRIM")
+def cmd_ltrim(server, ctx, args):
+    from redisson_tpu.client.objects.scoredsortedset import _norm_range
+
+    name = _s(args[0])
+    with server.engine.locked(name):
+        if not server.engine.store.exists(name):
+            return "+OK"
+        d, rec = _list_edit(server, name)
+        lo, hi = _norm_range(_int(args[1]), _int(args[2]), len(rec.host))
+        rec.host = rec.host[lo : hi + 1] if hi >= lo else []
+        d._touch_version(rec)
+        return "+OK"
+
+
+@register("LPOS")
+def cmd_lpos(server, ctx, args):
+    name = _s(args[0])
+    target = bytes(args[1])
+    rank, num = 1, None
+    i = 2
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"RANK":
+            rank = _int(args[i + 1])
+            if rank == 0:
+                raise RespError("ERR RANK can't be zero")
+            i += 2
+        elif opt == b"COUNT":
+            num = _int(args[i + 1])
+            i += 2
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    if not server.engine.store.exists(name):
+        return None if num is None else []
+    items = [bytes(v) for v in _deque(server, name).read_all()]
+    order = range(len(items)) if rank > 0 else range(len(items) - 1, -1, -1)
+    skip = abs(rank) - 1
+    hits = []
+    for idx in order:
+        if items[idx] != target:
+            continue
+        if skip:
+            skip -= 1
+            continue
+        hits.append(idx)
+        if num is None:  # single-answer form: first match wins
+            break
+        if num != 0 and len(hits) >= num:  # COUNT 0 = all matches
+            break
+    if num is None:
+        return hits[0] if hits else None
+    return hits
+
+
+def _list_move(server, src: str, dst: str, from_left: bool, to_left: bool):
+    with server.engine.locked_many((src, dst)):
+        s = _deque(server, src)
+        v = s.poll_first() if from_left else s.poll_last()
+        if v is None:
+            return None
+        d = _deque(server, dst)
+        (d.add_first if to_left else d.add_last)(bytes(v))
+        return bytes(v)
+
+
+@register("LMOVE")
+def cmd_lmove(server, ctx, args):
+    wherefrom = bytes(args[2]).upper()
+    whereto = bytes(args[3]).upper()
+    if wherefrom not in (b"LEFT", b"RIGHT") or whereto not in (b"LEFT", b"RIGHT"):
+        raise RespError("ERR syntax error")
+    return _list_move(
+        server, _s(args[0]), _s(args[1]), wherefrom == b"LEFT", whereto == b"LEFT"
+    )
+
+
+@register("RPOPLPUSH")
+def cmd_rpoplpush(server, ctx, args):
+    return _list_move(server, _s(args[0]), _s(args[1]), False, True)
+
+
+# -- typed surface expansion (sorted sets) -----------------------------------
+
+def _zset(server, name: str):
+    return _typed_handle(server, "get_scored_sorted_set", name)
+
+
+def _zbound(raw: bytes):
+    """Parse a ZRANGEBYSCORE bound: -inf/+inf, (exclusive, or inclusive."""
+    s = bytes(raw)
+    inc = True
+    if s.startswith(b"("):
+        inc = False
+        s = s[1:]
+    if s in (b"-inf", b"+inf", b"inf"):
+        return (float("-inf") if s == b"-inf" else float("inf")), inc
+    return float(s), inc
+
+
+@register("ZCOUNT")
+def cmd_zcount(server, ctx, args):
+    lo, lo_inc = _zbound(args[1])
+    hi, hi_inc = _zbound(args[2])
+    return _zset(server, _s(args[0])).count(lo, lo_inc, hi, hi_inc)
+
+
+def _zrangebyscore(server, args, reverse: bool):
+    z = _zset(server, _s(args[0]))
+    if reverse:  # ZREVRANGEBYSCORE takes max first
+        hi, hi_inc = _zbound(args[1])
+        lo, lo_inc = _zbound(args[2])
+    else:
+        lo, lo_inc = _zbound(args[1])
+        hi, hi_inc = _zbound(args[2])
+    withscores = False
+    offset, limit = 0, None
+    i = 3
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"WITHSCORES":
+            withscores = True
+            i += 1
+        elif opt == b"LIMIT":
+            offset, limit = _int(args[i + 1]), _int(args[i + 2])
+            i += 3
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    from redisson_tpu.client.objects.scoredsortedset import _in_score
+
+    entries = [
+        (m, sc)
+        for m, sc in z.entry_range(0, -1)
+        if _in_score(sc, lo, lo_inc, hi, hi_inc)
+    ]
+    if reverse:
+        entries.reverse()
+    if limit is not None and limit >= 0:
+        entries = entries[offset : offset + limit]
+    elif offset:
+        entries = entries[offset:]
+    out = []
+    for m, sc in entries:
+        out += [m, _fnum(sc)] if withscores else [m]
+    return out
+
+
+@register("ZRANGEBYSCORE")
+def cmd_zrangebyscore(server, ctx, args):
+    return _zrangebyscore(server, args, reverse=False)
+
+
+@register("ZREVRANGEBYSCORE")
+def cmd_zrevrangebyscore(server, ctx, args):
+    return _zrangebyscore(server, args, reverse=True)
+
+
+@register("ZREVRANGE")
+def cmd_zrevrange(server, ctx, args):
+    z = _zset(server, _s(args[0]))
+    withscores = len(args) > 3 and bytes(args[3]).upper() == b"WITHSCORES"
+    entries = z.entry_range(0, -1)
+    entries.reverse()
+    from redisson_tpu.client.objects.scoredsortedset import _norm_range
+
+    lo, hi = _norm_range(_int(args[1]), _int(args[2]), len(entries))
+    entries = entries[lo : hi + 1] if hi >= lo else []
+    out = []
+    for m, sc in entries:
+        out += [m, _fnum(sc)] if withscores else [m]
+    return out
+
+
+@register("ZREVRANK")
+def cmd_zrevrank(server, ctx, args):
+    return _zset(server, _s(args[0])).rev_rank(bytes(args[1]))
+
+
+def _zpop(server, args, first: bool):
+    z = _zset(server, _s(args[0]))
+    n = _int(args[1]) if len(args) > 1 else 1
+    out = []
+    for _ in range(n):
+        entry = z.poll_first_entry() if first else z.poll_last_entry()
+        if entry is None:
+            break
+        m, sc = entry
+        out += [m, _fnum(sc)]
+    return out
+
+
+@register("ZPOPMIN")
+def cmd_zpopmin(server, ctx, args):
+    return _zpop(server, args, first=True)
+
+
+@register("ZPOPMAX")
+def cmd_zpopmax(server, ctx, args):
+    return _zpop(server, args, first=False)
+
+
+@register("ZMSCORE")
+def cmd_zmscore(server, ctx, args):
+    z = _zset(server, _s(args[0]))
+    out = []
+    for m in args[1:]:
+        sc = z.get_score(bytes(m))
+        out.append(None if sc is None else _fnum(sc))
+    return out
+
+
+@register("ZRANDMEMBER")
+def cmd_zrandmember(server, ctx, args):
+    import random
+
+    z = _zset(server, _s(args[0]))
+    entries = z.entry_range(0, -1)
+    if len(args) == 1:
+        return random.choice(entries)[0] if entries else None
+    n = _int(args[1])
+    withscores = len(args) > 2 and bytes(args[2]).upper() == b"WITHSCORES"
+    if n >= 0:
+        picked = random.sample(entries, min(n, len(entries)))
+    else:
+        picked = [random.choice(entries) for _ in range(-n)] if entries else []
+    out = []
+    for m, sc in picked:
+        out += [m, _fnum(sc)] if withscores else [m]
+    return out
+
+
+@register("ZREMRANGEBYSCORE")
+def cmd_zremrangebyscore(server, ctx, args):
+    lo, lo_inc = _zbound(args[1])
+    hi, hi_inc = _zbound(args[2])
+    return _zset(server, _s(args[0])).remove_range_by_score(lo, lo_inc, hi, hi_inc)
+
+
+@register("ZREMRANGEBYRANK")
+def cmd_zremrangebyrank(server, ctx, args):
+    return _zset(server, _s(args[0])).remove_range_by_rank(_int(args[1]), _int(args[2]))
+
+
+@register("ZSCAN")
+def cmd_zscan(server, ctx, args):
+    pattern, count, _ = _scan_opts(args, 2)
+    entries = sorted(_zset(server, _s(args[0])).entry_range(0, -1))
+    if pattern is not None:
+        entries = [e for e in entries if _glob_match(pattern, e[0].decode(errors="replace"))]
+    cur, page = _scan_page(entries, _int(args[1]), count)
+    flat = []
+    for m, sc in page:
+        flat += [m, _fnum(sc)]
+    return [cur, flat]
+
+
+def _zstore(server, args, op: str):
+    """ZUNIONSTORE/ZINTERSTORE dest numkeys key... [WEIGHTS w...]
+    [AGGREGATE SUM|MIN|MAX] — computed in the handler so WEIGHTS compose
+    (the handle-level union/intersection don't carry weights)."""
+    dest = _s(args[0])
+    n = _int(args[1])
+    names = [_s(k) for k in args[2 : 2 + n]]
+    weights = [1.0] * n
+    agg = "SUM"
+    i = 2 + n
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"WEIGHTS":
+            weights = [float(args[i + 1 + j]) for j in range(n)]
+            i += 1 + n
+        elif opt == b"AGGREGATE":
+            agg = _s(args[i + 1]).upper()
+            if agg not in ("SUM", "MIN", "MAX"):
+                raise RespError("ERR syntax error")
+            i += 2
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    with server.engine.locked_many([dest, *names]):
+        maps = []
+        for nm, w in zip(names, weights):
+            maps.append({m: sc * w for m, sc in _zset(server, nm).entry_range(0, -1)})
+        if op == "union":
+            acc: Dict[bytes, float] = {}
+            for mp in maps:
+                for m, sc in mp.items():
+                    if m in acc:
+                        acc[m] = sc + acc[m] if agg == "SUM" else (min if agg == "MIN" else max)(acc[m], sc)
+                    else:
+                        acc[m] = sc
+        else:  # intersection
+            keys = set(maps[0]) if maps else set()
+            for mp in maps[1:]:
+                keys &= set(mp)
+            acc = {}
+            for m in keys:
+                vals = [mp[m] for mp in maps]
+                acc[m] = sum(vals) if agg == "SUM" else (min(vals) if agg == "MIN" else max(vals))
+        server.engine.store.delete(dest)
+        z = _zset(server, dest)
+        for m, sc in acc.items():
+            z.add(sc, m)
+        return len(acc)
+
+
+@register("ZUNIONSTORE")
+def cmd_zunionstore(server, ctx, args):
+    return _zstore(server, args, "union")
+
+
+@register("ZINTERSTORE")
+def cmd_zinterstore(server, ctx, args):
+    return _zstore(server, args, "intersection")

@@ -156,3 +156,243 @@ def test_mset_atomic_no_torn_reads(client):
         stop.set()
         t.join(10)
     assert not torn, f"torn MSET observed: {torn[:5]}"
+
+
+def test_string_expansion(client):
+    assert _x(client, "SETNX", "sx", "v1") == 1
+    assert _x(client, "SETNX", "sx", "v2") == 0
+    assert bytes(_x(client, "GET", "sx")) == b"v1"
+    assert bytes(_x(client, "SETEX", "se", 100, "val")) == b"OK"
+    assert 0 < _x(client, "TTL", "se") <= 100
+    assert _x(client, "PSETEX", "pse", 50_000, "val")
+    assert 0 < _x(client, "TTL", "pse") <= 50
+    with pytest.raises(RespError):
+        _x(client, "SETEX", "se", 0, "v")
+    assert bytes(_x(client, "GETEX", "sx", "EX", 90)) == b"v1"
+    assert 0 < _x(client, "TTL", "sx") <= 90
+    assert bytes(_x(client, "GETEX", "sx", "PERSIST")) == b"v1"
+    assert _x(client, "TTL", "sx") == -1
+    assert _x(client, "SETRANGE", "sr", 5, "hello") == 10
+    assert bytes(_x(client, "GET", "sr")) == b"\x00\x00\x00\x00\x00hello"
+    assert bytes(_x(client, "GETRANGE", "sr", 5, -1)) == b"hello"
+    assert bytes(_x(client, "GETRANGE", "sr", 0, 1)) == b"\x00\x00"
+    assert bytes(_x(client, "INCRBYFLOAT", "fl", "2.5")) == b"2.5"
+    assert bytes(_x(client, "INCRBYFLOAT", "fl", "0.5")) == b"3"
+    assert _x(client, "DECRBY", "ctr", 4) == -4
+    assert _x(client, "MSETNX", "mk1", "a", "mk2", "b") == 1
+    assert _x(client, "MSETNX", "mk2", "x", "mk3", "y") == 0
+    assert _x(client, "EXISTS", "mk3") == 0
+
+
+def test_key_expansion(client):
+    import time as _t
+
+    _x(client, "SET", "ke", "v")
+    at = int(_t.time()) + 100
+    assert _x(client, "EXPIREAT", "ke", at) == 1
+    assert abs(_x(client, "EXPIRETIME", "ke") - at) <= 1
+    assert abs(_x(client, "PEXPIRETIME", "ke") - at * 1000) <= 1500
+    assert _x(client, "PERSIST", "ke") == 1
+    assert _x(client, "EXPIRETIME", "ke") == -1
+    assert _x(client, "EXPIRETIME", "noexist:k") == -2
+    assert _x(client, "TOUCH", "ke", "noexist:k") == 1
+    assert _x(client, "RANDOMKEY") is not None  # keys exist at this point
+    cursor, page = _x(client, "SCAN", 0, "COUNT", 3)
+    seen = [bytes(k) for k in page]
+    while bytes(cursor) != b"0":
+        cursor, page = _x(client, "SCAN", int(cursor), "COUNT", 3)
+        seen += [bytes(k) for k in page]
+    assert b"ke" in seen
+    _, matched = _x(client, "SCAN", 0, "MATCH", "ke", "COUNT", 100)
+    assert [bytes(k) for k in matched] == [b"ke"]
+
+
+def test_hash_expansion(client):
+    assert _x(client, "HSETNX", "hx", "f", "v") == 1
+    assert _x(client, "HSETNX", "hx", "f", "w") == 0
+    assert _x(client, "HINCRBY", "hc", "n", 5) == 5
+    assert _x(client, "HINCRBY", "hc", "n", -2) == 3
+    assert bytes(_x(client, "HINCRBYFLOAT", "hc", "fval", "1.5")) == b"1.5"
+    assert _x(client, "HSTRLEN", "hx", "f") == 1
+    assert _x(client, "HSTRLEN", "hx", "none") == 0
+    assert bytes(_x(client, "HRANDFIELD", "hx")) == b"f"
+    fields = _x(client, "HRANDFIELD", "hx", 5)
+    assert [bytes(f) for f in fields] == [b"f"]
+    _x(client, "HSET", "hs", "a", "1", "b", "2", "c", "3")
+    cursor, flat = _x(client, "HSCAN", "hs", 0, "COUNT", 2)
+    all_flat = list(flat)
+    while bytes(cursor) != b"0":
+        cursor, flat = _x(client, "HSCAN", "hs", int(cursor), "COUNT", 2)
+        all_flat += list(flat)
+    got = {bytes(all_flat[i]): bytes(all_flat[i + 1]) for i in range(0, len(all_flat), 2)}
+    assert got == {b"a": b"1", b"b": b"2", b"c": b"3"}
+    _, novals = _x(client, "HSCAN", "hs", 0, "COUNT", 10, "NOVALUES")
+    assert sorted(bytes(f) for f in novals) == [b"a", b"b", b"c"]
+
+
+def test_set_expansion(client):
+    _x(client, "SADD", "sa", "a", "b", "c")
+    _x(client, "SADD", "sb", "b", "c", "d")
+    assert sorted(bytes(m) for m in _x(client, "SINTER", "sa", "sb")) == [b"b", b"c"]
+    assert sorted(bytes(m) for m in _x(client, "SUNION", "sa", "sb")) == [b"a", b"b", b"c", b"d"]
+    assert sorted(bytes(m) for m in _x(client, "SDIFF", "sa", "sb")) == [b"a"]
+    assert _x(client, "SINTERSTORE", "sdest", "sa", "sb") == 2
+    assert sorted(bytes(m) for m in _x(client, "SMEMBERS", "sdest")) == [b"b", b"c"]
+    # dest's old content must NOT leak into the stored result
+    assert _x(client, "SUNIONSTORE", "sdest", "sa", "sb") == 4
+    assert _x(client, "SDIFFSTORE", "sdest", "sa", "sb") == 1
+    assert _x(client, "SINTERCARD", 2, "sa", "sb") == 2
+    assert _x(client, "SINTERCARD", 2, "sa", "sb", "LIMIT", 1) == 1
+    assert _x(client, "SMISMEMBER", "sa", "a", "zz") == [1, 0]
+    assert _x(client, "SMOVE", "sa", "sb", "a") == 1
+    assert _x(client, "SMOVE", "sa", "sb", "zz") == 0
+    assert _x(client, "SISMEMBER", "sb", "a") == 1
+    v = bytes(_x(client, "SPOP", "sdest"))
+    assert v == b"a"  # only member
+    _x(client, "SADD", "sp", "x", "y", "z")
+    popped = _x(client, "SPOP", "sp", 2)
+    assert len(popped) == 2
+    assert _x(client, "SCARD", "sp") == 1
+    m = _x(client, "SRANDMEMBER", "sp")
+    assert bytes(m) in (b"x", b"y", b"z")
+    ms = _x(client, "SRANDMEMBER", "sp", -5)
+    assert len(ms) == 5
+    cursor, page = _x(client, "SSCAN", "sb", 0, "COUNT", 2)
+    assert len(page) == 2
+
+
+def test_list_expansion(client):
+    assert _x(client, "LPUSHX", "lx:none", "v") == 0
+    assert _x(client, "RPUSHX", "lx:none", "v") == 0
+    _x(client, "RPUSH", "lx", "a", "b", "c", "b")
+    assert _x(client, "LPUSHX", "lx", "z") == 5
+    assert _x(client, "RPUSHX", "lx", "w") == 6  # z a b c b w
+    _x(client, "LSET", "lx", 0, "Z")
+    assert bytes(_x(client, "LINDEX", "lx", 0)) == b"Z"
+    with pytest.raises(RespError):
+        _x(client, "LSET", "lx", 99, "no")
+    assert _x(client, "LINSERT", "lx", "BEFORE", "a", "pre") == 7
+    assert _x(client, "LINSERT", "lx", "AFTER", "a", "post") == 8
+    assert _x(client, "LINSERT", "lx", "BEFORE", "nope", "x") == -1
+    # Z pre a post b c b w
+    assert _x(client, "LPOS", "lx", "b") == 4
+    assert _x(client, "LPOS", "lx", "b", "RANK", -1) == 6
+    assert _x(client, "LPOS", "lx", "b", "COUNT", 0) == [4, 6]
+    assert _x(client, "LREM", "lx", 1, "b") == 1  # Z pre a post c b w
+    assert _x(client, "LREM", "lx", -1, "b") == 1  # Z pre a post c w
+    assert _x(client, "LREM", "lx", 0, "nope") == 0
+    _x(client, "LTRIM", "lx", 1, 3)  # pre a post
+    assert [bytes(v) for v in _x(client, "LRANGE", "lx", 0, -1)] == [b"pre", b"a", b"post"]
+    _x(client, "RPUSH", "lm:a", "1", "2", "3")
+    assert bytes(_x(client, "LMOVE", "lm:a", "lm:b", "LEFT", "RIGHT")) == b"1"
+    assert bytes(_x(client, "RPOPLPUSH", "lm:a", "lm:b")) == b"3"
+    assert [bytes(v) for v in _x(client, "LRANGE", "lm:b", 0, -1)] == [b"3", b"1"]
+    assert _x(client, "LMOVE", "lm:none", "lm:b", "LEFT", "LEFT") is None
+
+
+def test_zset_expansion(client):
+    _x(client, "ZADD", "z1", 1, "a", 2, "b", 3, "c", 4, "d")
+    assert _x(client, "ZCOUNT", "z1", 2, 3) == 2
+    assert _x(client, "ZCOUNT", "z1", "(2", "+inf") == 2
+    assert [bytes(v) for v in _x(client, "ZRANGEBYSCORE", "z1", 2, 3)] == [b"b", b"c"]
+    out = _x(client, "ZRANGEBYSCORE", "z1", "-inf", "+inf", "WITHSCORES", "LIMIT", 1, 2)
+    assert [bytes(v) for v in out] == [b"b", b"2", b"c", b"3"]
+    assert [bytes(v) for v in _x(client, "ZREVRANGEBYSCORE", "z1", 3, 2)] == [b"c", b"b"]
+    assert [bytes(v) for v in _x(client, "ZREVRANGE", "z1", 0, 1)] == [b"d", b"c"]
+    assert _x(client, "ZREVRANK", "z1", "d") == 0
+    assert [None if v is None else bytes(v) for v in _x(client, "ZMSCORE", "z1", "a", "zz", "c")] == [b"1", None, b"3"]
+    assert bytes(_x(client, "ZRANDMEMBER", "z1")) in (b"a", b"b", b"c", b"d")
+    assert len(_x(client, "ZRANDMEMBER", "z1", -6)) == 6
+    _x(client, "ZADD", "zp", 1, "x", 2, "y", 3, "z")
+    assert [bytes(v) for v in _x(client, "ZPOPMIN", "zp")] == [b"x", b"1"]
+    assert [bytes(v) for v in _x(client, "ZPOPMAX", "zp", 2)] == [b"z", b"3", b"y", b"2"]
+    _x(client, "ZADD", "zr", 1, "a", 2, "b", 3, "c", 4, "d")
+    assert _x(client, "ZREMRANGEBYSCORE", "zr", "(1", 3) == 2
+    assert _x(client, "ZREMRANGEBYRANK", "zr", 0, 0) == 1
+    assert [bytes(v) for v in _x(client, "ZRANGE", "zr", 0, -1)] == [b"d"]
+    _x(client, "ZADD", "zu1", 1, "a", 2, "b")
+    _x(client, "ZADD", "zu2", 10, "b", 20, "c")
+    assert _x(client, "ZUNIONSTORE", "zu", 2, "zu1", "zu2") == 3
+    out = _x(client, "ZRANGE", "zu", 0, -1, "WITHSCORES")
+    got = {bytes(out[i]): float(out[i + 1]) for i in range(0, len(out), 2)}
+    assert got == {b"a": 1.0, b"b": 12.0, b"c": 20.0}
+    assert _x(client, "ZUNIONSTORE", "zu", 2, "zu1", "zu2", "WEIGHTS", 2, 1, "AGGREGATE", "MAX") == 3
+    out = _x(client, "ZRANGE", "zu", 0, -1, "WITHSCORES")
+    got = {bytes(out[i]): float(out[i + 1]) for i in range(0, len(out), 2)}
+    assert got == {b"a": 2.0, b"b": 10.0, b"c": 20.0}
+    assert _x(client, "ZINTERSTORE", "zi", 2, "zu1", "zu2", "AGGREGATE", "MIN") == 1
+    out = _x(client, "ZRANGE", "zi", 0, -1, "WITHSCORES")
+    assert [bytes(v) for v in out] == [b"b", b"2"]
+    cursor, flat = _x(client, "ZSCAN", "z1", 0, "COUNT", 2)
+    assert len(flat) == 4  # 2 members with scores
+
+
+def test_command_keys_new_spec_shapes():
+    """Key extraction for the expanded spec forms: bounded key runs and
+    EVAL-style numkeys lists — these drive cluster slot routing and the
+    server's MOVED/migration checks."""
+    from redisson_tpu.net import commands as C
+
+    assert C.command_keys("SMOVE", [b"src", b"dst", b"member"]) == [b"src", b"dst"]
+    assert C.command_keys("LMOVE", [b"a", b"b", b"LEFT", b"RIGHT"]) == [b"a", b"b"]
+    assert C.command_keys("RPOPLPUSH", [b"a", b"b"]) == [b"a", b"b"]
+    assert C.command_keys("ZUNIONSTORE", [b"dest", b"2", b"k1", b"k2", b"WEIGHTS", b"1", b"2"]) == [b"dest", b"k1", b"k2"]
+    assert C.command_keys("SINTERCARD", [b"2", b"k1", b"k2", b"LIMIT", b"1"]) == [b"k1", b"k2"]
+    assert C.command_keys("SINTERCARD", [b"bogus"]) == []
+    assert C.command_keys("MSETNX", [b"k1", b"v1", b"k2", b"v2"]) == [b"k1", b"k2"]
+    assert C.command_keys("SCAN", [b"0"]) == []
+    assert C.is_write("SMOVE", []) and not C.is_write("SINTERCARD", [])
+
+
+def test_new_typed_commands_route_on_cluster():
+    """Hashtagged multi-key forms of the new verbs execute on a cluster;
+    cross-slot forms raise CROSSSLOT like real Redis."""
+    runner = ClusterRunner(masters=2).run()
+    try:
+        client = runner.client(scan_interval=0)
+        client.execute("SADD", "{tc}a", "1", "2")
+        client.execute("SADD", "{tc}b", "2", "3")
+        assert int(client.execute("SINTERSTORE", "{tc}d", "{tc}a", "{tc}b")) == 1
+        assert int(client.execute("ZADD", "{tc}z1", "1", "m")) == 1
+        assert int(client.execute("ZADD", "{tc}z2", "2", "m")) == 1
+        assert int(client.execute("ZUNIONSTORE", "{tc}zu", "2", "{tc}z1", "{tc}z2")) == 1
+        client.execute("RPUSH", "{tc}l", "x")
+        assert bytes(client.execute("LMOVE", "{tc}l", "{tc}l2", "LEFT", "RIGHT")) == b"x"
+        with pytest.raises(RespError, match="CROSSSLOT"):
+            client.execute("SMOVE", "tc-aaa", "tc-bbb", "m")
+        client.shutdown()
+    finally:
+        runner.shutdown()
+
+
+def test_list_verbs_missing_key_semantics(client):
+    """List surgery verbs on a missing key must not create it (reviewer
+    repro): LREM/LTRIM/LPOS no-op, LSET raises 'no such key'."""
+    assert _x(client, "LREM", "lv:none", 0, "x") == 0
+    assert _x(client, "EXISTS", "lv:none") == 0
+    _x(client, "LTRIM", "lv:none", 0, -1)
+    assert _x(client, "EXISTS", "lv:none") == 0
+    assert _x(client, "LPOS", "lv:none", "x") is None
+    assert _x(client, "LPOS", "lv:none", "x", "COUNT", 0) == []
+    assert _x(client, "EXISTS", "lv:none") == 0
+    with pytest.raises(RespError, match="no such key"):
+        _x(client, "LSET", "lv:none", 0, "v")
+    assert _x(client, "EXISTS", "lv:none") == 0
+
+
+def test_getex_validates_before_mutating(client):
+    """A trailing syntax error in GETEX options must leave TTL untouched."""
+    _x(client, "SET", "gx", "v")
+    with pytest.raises(RespError, match="syntax error"):
+        _x(client, "GETEX", "gx", "EX", 100, "BOGUS")
+    assert _x(client, "TTL", "gx") == -1
+    _x(client, "EXPIRE", "gx", 500)
+    with pytest.raises(RespError, match="syntax error"):
+        _x(client, "GETEX", "gx", "PERSIST", "BOGUS")
+    assert _x(client, "TTL", "gx") > 0
+
+
+def test_sintercard_negative_limit(client):
+    _x(client, "SADD", "sc1", "a")
+    with pytest.raises(RespError, match="negative"):
+        _x(client, "SINTERCARD", 1, "sc1", "LIMIT", -1)
