@@ -543,7 +543,8 @@ class ClusterRedisson(RemoteSurface):
                     raise ConnectionError_(f"no entry for {addr}")
                 payload = _pickle.dumps([ops[i] for i in idxs])
                 replies = _unwrap_many(
-                    entry.master.execute("OBJCALLM", payload, caller, timeout=timeout)
+                    entry.master.execute("OBJCALLM", payload, caller, timeout=timeout),
+                    self,
                 )
             except TimeoutError:
                 # The OBJCALLM frame was written and may have EXECUTED
@@ -615,7 +616,8 @@ class ClusterRedisson(RemoteSurface):
             if entry is None:
                 raise ConnectionError_("no cluster entries")
             replies = _unwrap_many(
-                entry.master.execute("OBJCALLMA", payload, self.caller_id(), timeout=timeout)
+                entry.master.execute("OBJCALLMA", payload, self.caller_id(), timeout=timeout),
+                self,
             )
             # a stale view bounces EVERY op with a routing error before any
             # applies (single-slot frame): refresh + full resend is safe.

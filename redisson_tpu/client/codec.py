@@ -274,3 +274,165 @@ def by_name(name: str) -> Codec:
         return _REGISTRY[name]
     except KeyError:
         raise ValueError(f"unknown codec '{name}' (have {sorted(_REGISTRY)})") from None
+
+
+# -- object references (RedissonReference analog) -----------------------------
+
+_RREF_MAGIC = b"\x00RREF1\x00"
+_RREF_MODULE_PREFIX = "redisson_tpu.client.objects."
+
+
+class ObjectRef:
+    """Inert descriptor decoded where no engine is available (e.g. a pickled
+    codec shipped to a worker process): identifies the referenced object
+    without binding a live handle."""
+
+    __slots__ = ("module", "cls", "name", "codec")
+
+    def __init__(self, module: str, cls: str, name: str, codec: str):
+        self.module, self.cls, self.name, self.codec = module, cls, name, codec
+
+    def __repr__(self):
+        return f"ObjectRef({self.cls}:{self.name})"
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and (
+            (self.module, self.cls, self.name) == (other.module, other.cls, other.name)
+        )
+
+    def __hash__(self):
+        return hash((self.module, self.cls, self.name))
+
+
+def _codec_spec(codec) -> object:
+    """Serialize a codec as a rebuildable spec: class name + nested inner
+    chain (compression wrappers).  Codecs whose configuration a spec cannot
+    carry (CompositeCodec's two halves, parameterized codecs) rebuild as
+    None -> the handle falls back to the default codec."""
+    if codec is None:
+        return None
+    spec: dict = {"cls": type(codec).__name__}
+    inner = getattr(codec, "inner", None)
+    if isinstance(inner, Codec):
+        spec["inner"] = _codec_spec(inner)
+    return spec
+
+
+def _codec_from_spec(spec) -> "Codec | None":
+    if not isinstance(spec, dict):
+        return None
+    cls = globals().get(spec.get("cls", ""))
+    if not (isinstance(cls, type) and issubclass(cls, Codec)):
+        return None
+    if cls is ReferenceCodec:  # never nested on purpose; unwrap defensively
+        return _codec_from_spec(spec.get("inner"))
+    inner = _codec_from_spec(spec.get("inner")) if spec.get("inner") else None
+    try:
+        return cls(inner) if inner is not None else cls()
+    except TypeError:
+        return None  # constructor needs config a spec can't carry
+
+
+def _is_ref(data) -> bool:
+    """Magic-prefix test without copying the (possibly large) payload.
+    Non-bytes inputs (counter records store raw ints; codecs pass them
+    through) are never references."""
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        return False
+    return bytes(data[: len(_RREF_MAGIC)]) == _RREF_MAGIC
+
+
+class ReferenceCodec(Codec):
+    """RedissonReference support (liveobject/core/RedissonObjectBuilder.java,
+    RedissonReference.java): storing an RObject handle INSIDE another object
+    persists a typed reference — module/class/name/codec — not a serialized
+    copy of its state; reading it back yields a LIVE handle bound to the same
+    engine.  Every handle's codec is wrapped with this at construction
+    (client/objects/base.py), so references work uniformly across maps,
+    buckets, queues, and nested combinations.
+
+    Non-handle values pass straight through to the inner codec; the magic
+    prefix contains a NUL so neither JSON nor pickle output can collide with
+    it (a raw BytesCodec payload theoretically could — same caveat class as
+    the reference's codec-specific reference handling)."""
+
+    name = "reference"
+
+    def __init__(self, inner: Codec, engine=None):
+        self.inner = inner
+        self._engine = engine
+
+    def __reduce__(self):
+        # engines never cross process boundaries; a shipped codec decodes
+        # references as inert ObjectRef descriptors
+        return (ReferenceCodec, (self.inner, None))
+
+    def encode(self, value: Any) -> bytes:
+        from redisson_tpu.client.objects.base import RObject
+
+        if isinstance(value, RObject):
+            cls = type(value)
+            inner = getattr(value, "_codec", None)
+            if isinstance(inner, ReferenceCodec):
+                inner = inner.inner
+            payload = {
+                "m": cls.__module__,
+                "c": cls.__name__,
+                "n": value._name,
+                "codec": _codec_spec(inner),
+            }
+            return _RREF_MAGIC + json.dumps(payload).encode()
+        return self.inner.encode(value)
+
+    def decode(self, data: bytes) -> Any:
+        if not _is_ref(data):
+            return self.inner.decode(data)
+        payload = json.loads(bytes(data)[len(_RREF_MAGIC) :])
+        if self._engine is None:
+            return ObjectRef(payload["m"], payload["c"], payload["n"], payload["codec"])
+        return _build_handle(self._engine, payload)
+
+    # references are opaque to map key/value splitting
+    def encode_map_key(self, value: Any) -> bytes:
+        from redisson_tpu.client.objects.base import RObject
+
+        if isinstance(value, RObject):
+            return self.encode(value)
+        return self.inner.encode_map_key(value)
+
+    def decode_map_key(self, data: bytes) -> Any:
+        if _is_ref(data):
+            return self.decode(data)
+        return self.inner.decode_map_key(data)
+
+    def encode_map_value(self, value: Any) -> bytes:
+        from redisson_tpu.client.objects.base import RObject
+
+        if isinstance(value, RObject):
+            return self.encode(value)
+        return self.inner.encode_map_value(value)
+
+    def decode_map_value(self, data: bytes) -> Any:
+        if _is_ref(data):
+            return self.decode(data)
+        return self.inner.decode_map_value(data)
+
+
+def _build_handle(engine, payload: dict):
+    """Rebuild a live handle from a reference payload.
+
+    Import safety: only classes under redisson_tpu.client.objects resolve
+    (a stored blob must never become an arbitrary import gadget), and the
+    class must be an RObject subclass."""
+    import importlib
+
+    from redisson_tpu.client.objects.base import RObject
+
+    module = payload["m"]
+    if not module.startswith(_RREF_MODULE_PREFIX):
+        raise ValueError(f"reference to non-object module '{module}'")
+    cls = getattr(importlib.import_module(module), payload["c"], None)
+    if cls is None or not (isinstance(cls, type) and issubclass(cls, RObject)):
+        raise ValueError(f"reference to unknown object class '{payload['c']}'")
+    codec = _codec_from_spec(payload.get("codec"))
+    return cls(engine, payload["n"], codec)

@@ -17,9 +17,35 @@ from redisson_tpu.core.engine import Engine
 
 class RObject:
     def __init__(self, engine: Engine, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.codec import ReferenceCodec
+
         self._engine = engine
         self._name = name
-        self._codec = codec or engine.default_codec
+        # every handle's codec is reference-aware: storing another handle
+        # persists a typed RedissonReference, not a serialized copy
+        # (client/codec.py ReferenceCodec; RedissonObjectBuilder analog)
+        base = codec or engine.default_codec
+        if isinstance(base, ReferenceCodec):
+            # rebind to THIS engine: a shipped/shared wrapper may carry no
+            # engine (pickled to a worker) or a different one
+            self._codec = (
+                base if base._engine is engine else ReferenceCodec(base.inner, engine)
+            )
+        else:
+            self._codec = ReferenceCodec(base, engine)
+
+    def __reduce__(self):
+        # handles bind an engine (thread locks, device state) and can never
+        # cross a process boundary live; they pickle as inert ObjectRef
+        # descriptors — the remote result path resolves them back into
+        # handles bound to the receiving client (client/remote.py)
+        from redisson_tpu.client.codec import ObjectRef, ReferenceCodec, _codec_spec
+
+        codec = self._codec.inner if isinstance(self._codec, ReferenceCodec) else self._codec
+        return (
+            ObjectRef,
+            (type(self).__module__, type(self).__name__, self._name, _codec_spec(codec)),
+        )
 
     @property
     def name(self) -> str:

@@ -52,7 +52,65 @@ def _client_renewal_infra():
         return _renewal_timer, _renewal_pool
 
 
-def _unwrap(reply: Any) -> Any:
+# ObjectRef resolution (RedissonReference over the wire): server-side
+# handles pickle as inert ObjectRef descriptors (objects/base.py
+# __reduce__); the receiving client rebinds them to LIVE handles through
+# its own factories so references read back as objects on every surface.
+_REF_FACTORIES = {
+    "Map": "get_map", "MapCache": "get_map_cache", "LocalCachedMap": "get_map",
+    "Set": "get_set", "SetCache": "get_set_cache",
+    "RList": "get_list", "Queue": "get_queue", "Deque": "get_deque",
+    "BlockingQueue": "get_blocking_queue", "BlockingDeque": "get_blocking_deque",
+    "PriorityQueue": "get_priority_queue", "RingBuffer": "get_ring_buffer",
+    "DelayedQueue": "get_delayed_queue", "TransferQueue": "get_transfer_queue",
+    "ScoredSortedSet": "get_scored_sorted_set",
+    "Bucket": "get_bucket", "AtomicLong": "get_atomic_long",
+    "AtomicDouble": "get_atomic_double", "IdGenerator": "get_id_generator",
+    "BitSet": "get_bit_set", "BloomFilter": "get_bloom_filter",
+    "HyperLogLog": "get_hyper_log_log", "Geo": "get_geo",
+    "TimeSeries": "get_time_series", "Stream": "get_stream",
+    "JsonBucket": "get_json_bucket", "BinaryStream": "get_binary_stream",
+}
+
+
+def resolve_ref(client, ref):
+    """ObjectRef -> live handle via the client's factory; unknown classes
+    stay inert (the descriptor itself is still useful: name + type)."""
+    from redisson_tpu.client.codec import _codec_from_spec
+
+    factory = getattr(client, _REF_FACTORIES.get(ref.cls, ""), None)
+    if factory is None:
+        return ref
+    codec = _codec_from_spec(ref.codec)
+    try:
+        return factory(ref.name, codec) if codec is not None else factory(ref.name)
+    except TypeError:
+        return factory(ref.name)
+
+
+def _resolve_refs(client, value):
+    """Resolve ObjectRefs at the top level and one container level deep —
+    the shapes object methods actually return (scalars, lists, dicts)."""
+    from redisson_tpu.client.codec import ObjectRef
+
+    if client is None:
+        return value
+    if isinstance(value, ObjectRef):
+        return resolve_ref(client, value)
+    if isinstance(value, list):
+        return [resolve_ref(client, v) if isinstance(v, ObjectRef) else v for v in value]
+    if isinstance(value, tuple):
+        return tuple(resolve_ref(client, v) if isinstance(v, ObjectRef) else v for v in value)
+    if isinstance(value, dict):
+        return {
+            (resolve_ref(client, k) if isinstance(k, ObjectRef) else k):
+            (resolve_ref(client, v) if isinstance(v, ObjectRef) else v)
+            for k, v in value.items()
+        }
+    return value
+
+
+def _unwrap(reply: Any, client=None) -> Any:
     from redisson_tpu.net.safe_pickle import safe_loads
 
     if isinstance(reply, RespError):
@@ -61,11 +119,11 @@ def _unwrap(reply: Any) -> Any:
         payload = safe_loads(bytes(reply[1:]))
         if reply[:1] == b"E":
             raise payload
-        return payload
+        return _resolve_refs(client, payload)
     return reply
 
 
-def _unwrap_many(reply: Any) -> List[Any]:
+def _unwrap_many(reply: Any, client=None) -> List[Any]:
     """Decode an OBJCALLM reply: list of results with per-op exceptions left
     AS VALUES (batch semantics — the caller decides what to raise)."""
     from redisson_tpu.net.safe_pickle import safe_loads
@@ -74,7 +132,7 @@ def _unwrap_many(reply: Any) -> List[Any]:
         raise reply
     if not (isinstance(reply, (bytes, bytearray)) and reply[:1] == b"M"):
         raise RespError("ERR bad OBJCALLM reply frame")
-    return [r for _tag, r in safe_loads(bytes(reply[1:]))]
+    return [_resolve_refs(client, r) for _tag, r in safe_loads(bytes(reply[1:]))]
 
 
 class RemoteObjectProxy:
@@ -907,7 +965,7 @@ class RemoteSurface:
         if codec is not None:
             frame.append(pickle.dumps(codec))
         reply = self.execute(*frame)
-        return _unwrap(reply)
+        return _unwrap(reply, self)
 
     def objcall_many(
         self, ops: List[Tuple], caller: Optional[str] = None,
@@ -922,7 +980,7 @@ class RemoteSurface:
         reply = self.execute(
             "OBJCALLM", payload, caller or self.caller_id(), timeout=timeout
         )
-        return _unwrap_many(reply)
+        return _unwrap_many(reply, self)
 
     def objcall_many_batch(
         self, ops: List[Tuple], atomic: bool = False, timeout: Optional[float] = None
@@ -936,7 +994,7 @@ class RemoteSurface:
         cmd = "OBJCALLMA" if atomic else "OBJCALLM"
         payload = pickle.dumps(wire_ops)
         reply = self.execute(cmd, payload, self.caller_id(), timeout=timeout)
-        return _unwrap_many(reply)
+        return _unwrap_many(reply, self)
 
     @staticmethod
     def _normalize_batch_op(op: Tuple) -> Tuple:

@@ -53,6 +53,10 @@ _ALLOWED_GLOBALS = {
     ("redisson_tpu.client.codec", "ZlibCodec"),
     ("redisson_tpu.client.codec", "Bz2Codec"),
     ("redisson_tpu.client.codec", "LzmaCodec"),
+    # reference support: handle codecs are ReferenceCodec-wrapped, and
+    # handles themselves pickle as inert ObjectRef descriptors
+    ("redisson_tpu.client.codec", "ReferenceCodec"),
+    ("redisson_tpu.client.codec", "ObjectRef"),
     # the restricted unpickler's own rejection travels inside E-replies;
     # without this the root cause is masked by a second rejection
     ("_pickle", "UnpicklingError"),

@@ -1,0 +1,130 @@
+"""RedissonReference analog (client/codec.py ReferenceCodec): storing an
+RObject handle inside another object persists a typed reference and reads
+back as a LIVE handle.  Reference: RedissonReference.java +
+liveobject/core/RedissonObjectBuilder.java."""
+import pickle
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.codec import ObjectRef, ReferenceCodec, StringCodec
+
+
+@pytest.fixture()
+def client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+def test_map_value_reference_roundtrip(client):
+    inner = client.get_list("ref:inner")
+    inner.add("x")
+    inner.add("y")
+    m = client.get_map("ref:outer")
+    m.put("mylist", inner)
+    got = m.get("mylist")
+    assert type(got).__name__ == "RList"
+    assert got.name == "ref:inner"
+    assert got.read_all() == ["x", "y"]
+    got.add("z")  # live handle: mutations visible through the original
+    assert inner.read_all() == ["x", "y", "z"]
+
+
+def test_bucket_and_queue_references(client):
+    counter = client.get_atomic_long("ref:ctr")
+    counter.set(41)
+    b = client.get_bucket("ref:slot")
+    b.set(counter)
+    assert b.get().increment_and_get() == 42
+    q = client.get_queue("ref:q")
+    q.offer(client.get_set("ref:s"))
+    handle = q.poll()
+    handle.add("member")
+    assert client.get_set("ref:s").contains("member")
+
+
+def test_nested_reference_chain(client):
+    leaf = client.get_bucket("ref:leaf")
+    leaf.set("payload")
+    mid = client.get_map("ref:mid")
+    mid.put("leaf", leaf)
+    top = client.get_map("ref:top")
+    top.put("mid", mid)
+    assert top.get("mid").get("leaf").get() == "payload"
+
+
+def test_reference_preserves_codec(client):
+    inner = client.get_list("ref:coded", codec=StringCodec())
+    inner.add("plain")
+    m = client.get_map("ref:outer2")
+    m.put("l", inner)
+    got = m.get("l")
+    assert isinstance(got._codec, ReferenceCodec)
+    assert type(got._codec.inner).__name__ == "StringCodec"
+    assert got.read_all() == ["plain"]
+
+
+def test_reference_decodes_inert_without_engine(client):
+    inner = client.get_list("ref:inert")
+    m = client.get_map("ref:outer3")
+    m.put("l", inner)
+    codec = pickle.loads(pickle.dumps(m._codec))  # shipped to a worker
+    rec = client._engine.store.get("ref:outer3")
+    raw = next(iter(rec.host.values()))
+    ref = codec.decode_map_value(raw)
+    assert isinstance(ref, ObjectRef)
+    assert ref.name == "ref:inert"
+
+
+def test_reference_rejects_foreign_module(client):
+    from redisson_tpu.client.codec import _RREF_MAGIC
+    import json
+
+    evil = _RREF_MAGIC + json.dumps(
+        {"m": "os.path", "c": "join", "n": "x", "codec": ""}
+    ).encode()
+    m = client.get_map("ref:sec")
+    rec_codec = m._codec
+    with pytest.raises(ValueError, match="non-object module"):
+        rec_codec.decode(evil)
+
+
+def test_plain_values_unaffected(client):
+    m = client.get_map("ref:plain")
+    m.put("k", {"a": 1})
+    assert m.get("k") == {"a": 1}
+    b = client.get_bucket("ref:plainb")
+    b.set([1, 2, 3])
+    assert b.get() == [1, 2, 3]
+
+
+def test_reference_over_the_wire():
+    """A reference stored by one surface reads back as a LIVE handle over
+    the remote wire: the server pickles handles as ObjectRef and the
+    receiving client rebinds them through its own factories."""
+    from redisson_tpu.client.remote import RemoteRedisson
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0) as st:
+        c = RemoteRedisson(st.address, timeout=30.0)
+        try:
+            inner = c.get_list("w:inner")
+            inner.add("x")
+            m = c.get_map("w:outer")
+            # storing a REMOTE handle: it pickles as ObjectRef in the OBJCALL
+            # args, the server's reference codec... remote proxies are not
+            # RObject, so store an ObjectRef-producing embedded path instead:
+            # write through a second client's typed surface is N/A here — use
+            # the server-side engine directly via an embedded handle.
+            srv_client = st.server.local_client()
+            srv_inner = srv_client.get_list("w:inner")
+            srv_map = srv_client.get_map("w:outer")
+            srv_map.put("l", srv_inner)
+            got = m.get("l")
+            assert type(got).__name__ == "RemoteObjectProxy" or hasattr(got, "add")
+            assert got.read_all() == ["x"]
+            got.add("y")
+            assert srv_inner.read_all() == ["x", "y"]
+        finally:
+            c.shutdown()
