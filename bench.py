@@ -257,8 +257,12 @@ def bench_config5_cluster_mixed():
       * blob bit commands (SETBITSB): indexes travel as one i32 buffer and
         previous-bit replies as one byte blob — RESP integer encode/parse at
         these batch sizes is pure overhead.
-    Best-of-2 reps: the tunnel's bandwidth swings run to run; rep 1 also
-    absorbs in-memory jit-cache warmup for the frame-concat programs.
+    Best-of-4 reps, every rep logged (same audit discipline as config 2):
+    the tunnel's bandwidth swings run to run — r2 recorded 1214k and a
+    later identical run 383k on this exact code path — and each rep costs
+    only ~1-3s, so four fixed reps make the recorded number measure the
+    framework, not the tunnel's mood.  Rep 1 also absorbs in-memory
+    jit-cache warmup for the frame-concat programs.
     """
     from redisson_tpu.harness import ClusterRunner
 
@@ -298,8 +302,8 @@ def bench_config5_cluster_mixed():
         # warm compiles (bloom add/contains, bitset, frame-concat programs)
         warm_cmds, _ = make_cmds("w")
         client.execute_many(warm_cmds)
-        best = 0.0
-        for rep in range(2):
+        rates = []
+        for rep in range(4):
             cmds, ops = make_cmds(f"r{rep}")
             t0 = time.perf_counter()
             replies = client.execute_many(cmds)
@@ -307,11 +311,12 @@ def bench_config5_cluster_mixed():
             probe = replies[2 * tenants : 3 * tenants]
             for t, out in enumerate(probe):
                 assert np.frombuffer(out, np.uint8).all(), f"false negatives t{t}"
-            best = max(best, ops / wall)
+            rates.append(ops / wall)
+        best = max(rates)
         log(
             f"config5: {ops} mixed ops over 8-master cluster = "
             f"{best/1e3:.0f}k ops/s (64-tenant fan-out, one merged pipeline, "
-            "best of 2)"
+            f"best of {len(rates)}: {['%.0fk' % (r/1e3) for r in rates]})"
         )
         client.shutdown()
         return best

@@ -74,6 +74,10 @@ class ClusterRedisson(RemoteSurface):
     """Slot-routed facade sharing the Remote* handle surface (the handles
     call ``client.execute``/``client.objcall``; routing happens here)."""
 
+    # refresh asks each master for its replica set (REPLICAS); replicated
+    # mode discovers replicas client-side instead and sets this False
+    _replica_discovery = True
+
     def __init__(
         self,
         seeds: List[str],
@@ -246,15 +250,20 @@ class ClusterRedisson(RemoteSurface):
                     fresh[addr] = entry  # grace period: keep routing to it
                 # else: dropped from fresh -> closed as retired below
         # replica discovery per master (REPLICAS command) — still outside
-        # lock, single-shot for the same reason
-        for addr, entry in fresh.items():
-            try:
-                reps = entry.master.execute("REPLICAS", timeout=5.0, retry_attempts=0)
-                entry.sync_replicas(
-                    [r.decode() if isinstance(r, bytes) else r for r in reps]
-                )
-            except Exception:  # noqa: BLE001 — master briefly down
-                pass
+        # lock, single-shot for the same reason.  Subclasses that already
+        # know the replica set from their own scan (replicated mode) turn
+        # this off instead of paying the round-trip and overwriting it.
+        if self._replica_discovery:
+            for addr, entry in fresh.items():
+                try:
+                    reps = entry.master.execute(
+                        "REPLICAS", timeout=5.0, retry_attempts=0
+                    )
+                    entry.sync_replicas(
+                        [r.decode() if isinstance(r, bytes) else r for r in reps]
+                    )
+                except Exception:  # noqa: BLE001 — master briefly down
+                    pass
         with self._lock:
             if self._closed.is_set():
                 # shutdown raced this refresh: do NOT repopulate a closed

@@ -390,6 +390,15 @@ class ReferenceCodec(Codec):
         payload = json.loads(bytes(data)[len(_RREF_MAGIC) :])
         if self._engine is None:
             return ObjectRef(payload["m"], payload["c"], payload["n"], payload["codec"])
+        # forged/foreign payloads must fail LOUDLY even when they would
+        # otherwise fall into the inert-descriptor path below
+        _validate_ref_module(payload["m"])
+        if payload.get("codec") is not None and _codec_from_spec(payload["codec"]) is None:
+            # recorded codec is unrebuildable from its spec (CompositeCodec
+            # halves, parameterized codecs): a live handle would silently
+            # decode with the DEFAULT codec — stay an inert descriptor, the
+            # same contract as remote resolve_ref
+            return ObjectRef(payload["m"], payload["c"], payload["n"], payload["codec"])
         return _build_handle(self._engine, payload)
 
     # references are opaque to map key/value splitting
@@ -418,19 +427,24 @@ class ReferenceCodec(Codec):
         return self.inner.decode_map_value(data)
 
 
+def _validate_ref_module(module) -> None:
+    """Import safety: only classes under redisson_tpu.client.objects resolve
+    (a stored blob must never become an arbitrary import gadget)."""
+    if not str(module).startswith(_RREF_MODULE_PREFIX):
+        raise ValueError(f"reference to non-object module '{module}'")
+
+
 def _build_handle(engine, payload: dict):
     """Rebuild a live handle from a reference payload.
 
-    Import safety: only classes under redisson_tpu.client.objects resolve
-    (a stored blob must never become an arbitrary import gadget), and the
-    class must be an RObject subclass."""
+    Import safety: see _validate_ref_module; additionally the class must be
+    an RObject subclass."""
     import importlib
 
     from redisson_tpu.client.objects.base import RObject
 
     module = payload["m"]
-    if not module.startswith(_RREF_MODULE_PREFIX):
-        raise ValueError(f"reference to non-object module '{module}'")
+    _validate_ref_module(module)
     cls = getattr(importlib.import_module(module), payload["c"], None)
     if cls is None or not (isinstance(cls, type) and issubclass(cls, RObject)):
         raise ValueError(f"reference to unknown object class '{payload['c']}'")

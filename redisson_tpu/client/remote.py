@@ -57,19 +57,43 @@ def _client_renewal_infra():
 # __reduce__); the receiving client rebinds them to LIVE handles through
 # its own factories so references read back as objects on every surface.
 _REF_FACTORIES = {
-    "Map": "get_map", "MapCache": "get_map_cache", "LocalCachedMap": "get_map",
+    "Map": "get_map", "MapCache": "get_map_cache",
+    # LocalCachedMap must rebind as a local-cached handle: resolving it as a
+    # plain map would mutate without publishing invalidations, leaving every
+    # other client's near cache silently stale
+    "LocalCachedMap": "get_local_cached_map",
     "Set": "get_set", "SetCache": "get_set_cache",
     "RList": "get_list", "Queue": "get_queue", "Deque": "get_deque",
     "BlockingQueue": "get_blocking_queue", "BlockingDeque": "get_blocking_deque",
     "PriorityQueue": "get_priority_queue", "RingBuffer": "get_ring_buffer",
-    "DelayedQueue": "get_delayed_queue", "TransferQueue": "get_transfer_queue",
+    # DelayedQueue deliberately absent: its factory takes the DESTINATION
+    # queue handle, not a name — a by-name rebind can't reconstruct it, so
+    # its references stay inert (name + type still identify it)
+    "TransferQueue": "get_transfer_queue",
     "ScoredSortedSet": "get_scored_sorted_set",
+    "SortedSet": "get_sorted_set", "LexSortedSet": "get_lex_sorted_set",
+    "ListMultimap": "get_list_multimap", "SetMultimap": "get_set_multimap",
+    "BoundedBlockingQueue": "get_bounded_blocking_queue",
     "Bucket": "get_bucket", "AtomicLong": "get_atomic_long",
     "AtomicDouble": "get_atomic_double", "IdGenerator": "get_id_generator",
     "BitSet": "get_bit_set", "BloomFilter": "get_bloom_filter",
     "HyperLogLog": "get_hyper_log_log", "Geo": "get_geo",
     "TimeSeries": "get_time_series", "Stream": "get_stream",
     "JsonBucket": "get_json_bucket", "BinaryStream": "get_binary_stream",
+    "Lock": "get_lock", "FairLock": "get_fair_lock", "SpinLock": "get_spin_lock",
+    "FencedLock": "get_fenced_lock", "Semaphore": "get_semaphore",
+    "CountDownLatch": "get_count_down_latch", "RateLimiter": "get_rate_limiter",
+}
+
+# classes whose handles never decode user values with their codec
+# (synchronizers, numeric counters, raw-bit state): the ref's recorded
+# codec — every handle carries one, usually the default — is irrelevant,
+# so their factories are called name-only.  Everything else MUST honor the
+# reference's codec or fail loudly (see resolve_ref).
+_CODEC_FREE = {
+    "Lock", "FairLock", "SpinLock", "FencedLock", "Semaphore",
+    "CountDownLatch", "RateLimiter", "AtomicLong", "AtomicDouble",
+    "IdGenerator", "BitSet",
 }
 
 
@@ -82,10 +106,28 @@ def resolve_ref(client, ref):
     if factory is None:
         return ref
     codec = _codec_from_spec(ref.codec)
-    try:
-        return factory(ref.name, codec) if codec is not None else factory(ref.name)
-    except TypeError:
+    if ref.codec is not None and codec is None and ref.cls not in _CODEC_FREE:
+        # the reference recorded a codec its spec cannot rebuild
+        # (CompositeCodec halves, parameterized codecs): resolving with the
+        # default codec would silently misdecode — stay inert instead
+        return ref
+    if (
+        codec is not None
+        and type(codec) is type(DEFAULT_CODEC)
+        and getattr(codec, "inner", None) is None
+    ):
+        # every handle records a codec, usually the default: passing the
+        # default along changes nothing, and name-only keeps codec-less
+        # surfaces (async proxies) resolving
+        codec = None
+    if codec is None or ref.cls in _CODEC_FREE:
         return factory(ref.name)
+    # a factory that cannot honor the reference's NON-default codec must
+    # FAIL here, not silently decode with the default one — the async
+    # surface raises TypeError for exactly that (aio.py make()); swallowing
+    # it would turn a StringCodec list into wrongly-JSON-decoded values
+    # with no trace
+    return factory(ref.name, codec)
 
 
 def _resolve_refs(client, value):

@@ -117,6 +117,19 @@ class ClusterServersConfig:
 
 
 @dataclass
+class ReplicatedServersConfig(ClusterServersConfig):
+    """Replicated mode (ReplicatedServersConfig analog): N plain endpoints,
+    master discovered by the client's ROLE scan — the Azure Redis Cache /
+    ElastiCache topology (connection/ReplicatedConnectionManager.java).
+    Same knob set as cluster mode; only the defaults differ: a tighter
+    scan (master flips are externally driven and the group is small) and
+    replica-first reads (the reference's replicated default)."""
+
+    scan_interval: float = 1.0
+    read_mode: str = "SLAVE"
+
+
+@dataclass
 class MeshConfig:
     """Device-mesh layout for the embedded data plane (L3', SURVEY §7.1-3).
 
@@ -153,6 +166,7 @@ class Config:
     # -- mode sections --------------------------------------------------------
     single_server_config: Optional[SingleServerConfig] = None
     cluster_servers_config: Optional[ClusterServersConfig] = None
+    replicated_servers_config: Optional[ReplicatedServersConfig] = None
     mesh: MeshConfig = field(default_factory=MeshConfig)
 
     # -- SPI slots (reference extension points, §5.6) -------------------------
@@ -173,6 +187,11 @@ class Config:
             self.cluster_servers_config = ClusterServersConfig()
         return self.cluster_servers_config
 
+    def use_replicated_servers(self) -> ReplicatedServersConfig:
+        if self.replicated_servers_config is None:
+            self.replicated_servers_config = ReplicatedServersConfig()
+        return self.replicated_servers_config
+
     # -- loaders (Config.fromYAML / fromJSON analogs) ------------------------
 
     @classmethod
@@ -180,12 +199,17 @@ class Config:
         data = dict(data)
         single = data.pop("singleServerConfig", data.pop("single_server_config", None))
         cluster = data.pop("clusterServersConfig", data.pop("cluster_servers_config", None))
+        replicated = data.pop(
+            "replicatedServersConfig", data.pop("replicated_servers_config", None)
+        )
         mesh = data.pop("mesh", None)
         cfg = cls(**{_snake(k): v for k, v in data.items() if _known_field(cls, _snake(k))})
         if single:
             cfg.single_server_config = _build(SingleServerConfig, single)
         if cluster:
             cfg.cluster_servers_config = _build(ClusterServersConfig, cluster)
+        if replicated:
+            cfg.replicated_servers_config = _build(ReplicatedServersConfig, replicated)
         if mesh:
             cfg.mesh = _build(MeshConfig, mesh)
         return cfg

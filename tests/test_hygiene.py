@@ -240,3 +240,21 @@ def test_force_unlock_cancels_all_renewals(client, monkeypatch):
     assert len(client._engine._renewals) == 1
     lk.force_unlock()
     assert len(client._engine._renewals) == 0
+
+
+def test_ref_factories_resolve_on_both_facades():
+    """Every _REF_FACTORIES entry must name a real factory on BOTH the
+    embedded facade and the remote surface — and the mapped embedded
+    factory must construct the class the entry claims, so references
+    to any handle type decode LIVE instead of falling back to inert
+    ObjectRef (silent drift as object types are added)."""
+    from redisson_tpu.client.redisson import RedissonTpu
+    from redisson_tpu.client.remote import _CODEC_FREE, _GENERIC_FACTORIES, _REF_FACTORIES
+    from redisson_tpu.client.remote import RemoteSurface
+
+    for cls_name, factory in _REF_FACTORIES.items():
+        assert hasattr(RedissonTpu, factory), f"{cls_name}: no embedded {factory}"
+        assert factory in _GENERIC_FACTORIES or hasattr(
+            RemoteSurface, factory
+        ), f"{cls_name}: {factory} unreachable on the remote surface"
+    assert _CODEC_FREE <= set(_REF_FACTORIES), "codec-free classes must be mapped"

@@ -128,3 +128,43 @@ def test_reference_over_the_wire():
             assert srv_inner.read_all() == ["x", "y"]
         finally:
             c.shutdown()
+
+
+def test_unrebuildable_codec_reference_stays_inert(client):
+    """A reference whose recorded codec cannot be rebuilt from its spec
+    (CompositeCodec: the spec can't carry its two halves) must come back as
+    an inert ObjectRef — resolving it as a live handle would silently
+    decode with the DEFAULT codec instead of the one the data was written
+    with."""
+    from redisson_tpu.client.codec import CompositeCodec
+
+    inner = client.get_map(
+        "ref:comp", codec=CompositeCodec(StringCodec(), StringCodec())
+    )
+    inner.put("k", "v")
+    outer = client.get_map("ref:outer-comp")
+    outer.put("m", inner)
+    got = outer.get("m")
+    assert isinstance(got, ObjectRef)
+    assert got.cls == "Map" and got.name == "ref:comp"
+
+
+def test_local_cached_map_reference_rebinds_with_invalidation(client):
+    """A LocalCachedMap reference must rebind as a LOCAL-CACHED handle, not
+    a plain map: mutations through the resolved handle must publish near-
+    cache invalidations to other holders."""
+    lcm = client.get_local_cached_map("ref:lcm")
+    lcm.put("k", 1)
+    holder = client.get_local_cached_map("ref:lcm")
+    assert holder.get("k") == 1  # near cache primed
+    b = client.get_bucket("ref:lcm-slot")
+    b.set(lcm)
+    got = b.get()
+    assert type(got).__name__ == "LocalCachedMap"
+    got.put("k", 2)
+    import time as _t
+
+    deadline = _t.time() + 5
+    while _t.time() < deadline and holder.get("k") != 2:
+        _t.sleep(0.02)
+    assert holder.get("k") == 2  # invalidation reached the other holder

@@ -1,0 +1,216 @@
+"""ReplicatedRedisson: client-side master discovery over plain nodes.
+
+Mirrors the reference's replicated-mode behavior
+(``connection/ReplicatedConnectionManager.java``): a replication group of
+plain endpoints, no cluster protocol — the client polls ROLE per node,
+elects the master for writes, serves reads from scan-discovered replicas,
+and follows an EXTERNALLY-performed failover (the cloud service's job in
+the reference; REPLICAOF NO ONE here).
+"""
+import time
+
+import pytest
+
+from redisson_tpu.client.replicated import ReplicatedRedisson
+from redisson_tpu.config import Config
+from redisson_tpu.harness import _exec, free_port
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.server import ServerThread
+
+
+def _start_group(n=3):
+    servers = [ServerThread(port=free_port()).start() for _ in range(n)]
+    master = servers[0]
+    for s in servers[1:]:
+        with s.client() as c:
+            _exec(c, "REPLICAOF", master.server.host, master.server.port, timeout=120.0)
+    return servers
+
+
+def _addr(st: ServerThread) -> str:
+    return f"{st.server.host}:{st.server.port}"
+
+
+def _wait_master(client, want: str, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if client.current_master == want:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"master never became {want}; got {client.current_master}")
+
+
+def test_replicated_discovers_master_routes_writes_reads_replicas():
+    servers = _start_group(3)
+    try:
+        # master deliberately NOT first in the node list: discovery must
+        # come from the ROLE scan, not list position
+        nodes = [_addr(servers[1]), _addr(servers[0]), _addr(servers[2])]
+        client = ReplicatedRedisson(
+            nodes, scan_interval=0.2, read_mode="replica", dns_monitoring_interval=0
+        )
+        try:
+            assert client.current_master == _addr(servers[0])
+            b = client.get_bucket("rp:k")
+            b.set("v1")
+            # ship the op-log now instead of sleeping through the debounce
+            with servers[0].client() as c:
+                assert _exec(c, "REPLFLUSH") >= 1
+            # replica set came from the scan: both replicas are read targets
+            entry = client.entry_for_slot(0)
+            assert set(entry.replicas) == {_addr(servers[1]), _addr(servers[2])}
+            assert client.get_bucket("rp:k").get() == "v1"
+            # replicas reject writes directly
+            with servers[1].client() as c:
+                reply = c.execute("SET", "rp:no", "x")
+            assert isinstance(reply, RespError) and "READONLY" in str(reply)
+        finally:
+            client.shutdown()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_replicated_follows_external_failover():
+    servers = _start_group(3)
+    try:
+        nodes = [_addr(s) for s in servers]
+        client = ReplicatedRedisson(
+            nodes,
+            scan_interval=0.2,
+            dns_monitoring_interval=0,
+            retry_attempts=0,
+            timeout=2.0,
+        )
+        try:
+            client.get_bucket("rp:f").set("before")
+            with servers[0].client() as c:
+                _exec(c, "REPLFLUSH")
+            servers[0].stop()
+            # promotion window: nobody claims master -> the view sticks and
+            # writes fail fast (the reference behaves the same until the
+            # cloud service finishes its failover)
+            with pytest.raises(Exception):
+                client.get_bucket("rp:f").set("during")
+            # external failover: operator promotes replica 1 and re-points 2
+            with servers[1].client() as c:
+                _exec(c, "REPLICAOF", "NO", "ONE")
+            with servers[2].client() as c:
+                _exec(
+                    c, "REPLICAOF", servers[1].server.host, servers[1].server.port,
+                    timeout=120.0,
+                )
+            _wait_master(client, _addr(servers[1]))
+            # writes flow to the promoted node; replicated state survived
+            assert client.get_bucket("rp:f").get() == "before"
+            client.get_bucket("rp:f").set("after")
+            assert client.get_bucket("rp:f").get() == "after"
+            entry = client.entry_for_slot(0)
+            assert entry.address == _addr(servers[1])
+        finally:
+            client.shutdown()
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — servers[0] is already stopped
+                pass
+
+
+def test_replicated_election_prefers_replica_votes_over_list_order():
+    # A is the real master (B replicates it); C is an unrelated node that
+    # ALSO claims master (a stale pre-failover survivor).  C listed first:
+    # replica votes must beat node-list order.
+    a = ServerThread(port=free_port()).start()
+    b = ServerThread(port=free_port()).start()
+    c = ServerThread(port=free_port()).start()
+    try:
+        with b.client() as conn:
+            _exec(conn, "REPLICAOF", a.server.host, a.server.port, timeout=120.0)
+        client = ReplicatedRedisson(
+            [_addr(c), _addr(a), _addr(b)],
+            scan_interval=0,
+            dns_monitoring_interval=0,
+        )
+        try:
+            assert client.current_master == _addr(a)
+        finally:
+            client.shutdown()
+    finally:
+        for s in (a, b, c):
+            s.stop()
+
+
+def test_replicated_moves_off_demoted_master_and_excludes_stale_replicas():
+    """External failover that never stops the old master: A keeps claiming
+    master while B is promoted and the majority of replicas re-point to B.
+    A long-running client must (1) move writes to B — replica votes beat
+    current-master stickiness — and (2) NOT install the straggler replica
+    still following A as a read target for B's data (it never receives B's
+    op-log: that would be silently stale reads forever, not lag)."""
+    a = ServerThread(port=free_port()).start()
+    others = [ServerThread(port=free_port()).start() for _ in range(4)]
+    b, c_, d, e = others
+    try:
+        for s in others:
+            with s.client() as conn:
+                _exec(conn, "REPLICAOF", a.server.host, a.server.port, timeout=120.0)
+        client = ReplicatedRedisson(
+            [_addr(s) for s in (a, b, c_, d, e)],
+            scan_interval=0.2,
+            dns_monitoring_interval=0,
+        )
+        try:
+            assert client.current_master == _addr(a)
+            # operator promotes B and re-points C and D; E lags behind on A
+            with b.client() as conn:
+                _exec(conn, "REPLICAOF", "NO", "ONE")
+            for s in (c_, d):
+                with s.client() as conn:
+                    _exec(conn, "REPLICAOF", b.server.host, b.server.port, timeout=120.0)
+            _wait_master(client, _addr(b))
+            entry = client.entry_for_slot(0)
+            assert entry.address == _addr(b)
+            # replica sync lands just after the entry swap becomes visible
+            # (the gap is benign: reads fall back to the master) — wait for
+            # it before asserting the membership
+            deadline = time.time() + 5
+            while time.time() < deadline and not entry.replicas:
+                time.sleep(0.05)
+            assert set(entry.replicas) == {_addr(c_), _addr(d)}  # E excluded
+            client.get_bucket("rp:demote").set("on-b")
+            assert client.get_bucket("rp:demote").get() == "on-b"
+        finally:
+            client.shutdown()
+    finally:
+        for s in [a] + others:
+            s.stop()
+
+
+def test_replicated_config_mode_and_loader():
+    servers = _start_group(2)
+    try:
+        cfg = Config()
+        rsc = cfg.use_replicated_servers()
+        rsc.node_addresses = [_addr(servers[1]), _addr(servers[0])]
+        rsc.scan_interval = 0.2
+        client = ReplicatedRedisson.create(cfg)
+        try:
+            assert client.current_master == _addr(servers[0])
+            client.get_bucket("rp:cfg").set(1)
+            # default read_mode=SLAVE serves this read from the replica —
+            # ship the op-log before reading (the debounce is ~100ms)
+            with servers[0].client() as c:
+                _exec(c, "REPLFLUSH")
+            assert client.get_bucket("rp:cfg").get() == 1
+        finally:
+            client.shutdown()
+        # loader path (camelCase section name like the reference's YAML)
+        cfg2 = Config.from_dict(
+            {"replicatedServersConfig": {"nodeAddresses": ["h:1"], "readMode": "MASTER"}}
+        )
+        assert cfg2.replicated_servers_config.node_addresses == ["h:1"]
+        assert cfg2.replicated_servers_config.read_mode == "MASTER"
+    finally:
+        for s in servers:
+            s.stop()
