@@ -172,18 +172,6 @@ class _BaseMultimap(RExpirable):
                         out.append((self._dk(ek), self._dv(ev)))
             return out
 
-    def expire_key(self, key, ttl: float) -> bool:
-        """Cache-variant per-key TTL (RListMultimapCache.expireKey)."""
-        with self._engine.locked(self._name):
-            rec = self._rec_or_create()
-            ek = self._ek(key)
-            if not self._live(rec, ek):
-                return False
-            rec.host["ttl"][ek] = time.time() + ttl
-            self._touch_version(rec)
-            return True
-
-
 class ListMultimap(_BaseMultimap):
     """RListMultimap: values per key form a list (duplicates kept, order kept)."""
 
@@ -208,3 +196,47 @@ class SetMultimap(_BaseMultimap):
         bucket.append(ev)
         self._touch_version(rec)
         return True
+
+
+class _MultimapCacheMixin:
+    """Per-key TTL surface of the cache variants
+    (`RedissonListMultimapCache.java` / `RedissonSetMultimapCache.java`):
+    the only API the reference adds over the plain multimap is
+    `expireKey(key, ttl)`; expiry itself is enforced lazily by `_live` and
+    swept by the EvictionScheduler (`eviction/BaseEvictionTask` analog —
+    the facade registers `reap_expired` on creation)."""
+
+    def expire_key(self, key, ttl: float) -> bool:
+        """RMultimapCache.expireKey — per-key TTL in seconds; False if the
+        key is absent (matches the reference's boolean reply)."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            ek = self._ek(key)
+            if not self._live(rec, ek):
+                return False
+            rec.host["ttl"][ek] = time.time() + ttl
+            self._touch_version(rec)
+            return True
+
+    def reap_expired(self) -> int:
+        """EvictionScheduler sweep entry point; returns keys removed."""
+        with self._engine.locked(self._name):
+            rec = self._engine.store.get(self._name)
+            if rec is None:
+                return 0
+            before = len(rec.host["data"])
+            for ek in list(rec.host["data"]):
+                self._live(rec, ek)
+            return before - len(rec.host["data"])
+
+
+class ListMultimapCache(_MultimapCacheMixin, ListMultimap):
+    """RListMultimapCache: list multimap + per-key TTL."""
+
+    _kind = "list_multimap_cache"
+
+
+class SetMultimapCache(_MultimapCacheMixin, SetMultimap):
+    """RSetMultimapCache: set multimap + per-key TTL."""
+
+    _kind = "set_multimap_cache"

@@ -361,6 +361,129 @@ class PriorityQueue(Queue):
             return []
         return [self._d(raw) for _, raw in sorted(rec.host)]
 
+    # The heap stores (sort_key, raw) tuples, not flat raw values, so every
+    # list-shaped op inherited from Queue must be re-expressed over tuples.
+
+    def poll_many(self, limit: int) -> List:
+        out = []
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            while rec.host and len(out) < limit:
+                _, raw = heapq.heappop(rec.host)
+                out.append(self._d(raw))
+            if out:
+                self._touch_version(rec)
+        return out
+
+    def contains(self, value) -> bool:
+        e = self._e(value)
+        rec = self._engine.store.get(self._name)
+        return rec is not None and any(raw == e for _, raw in rec.host)
+
+    def remove(self, value) -> bool:
+        e = self._e(value)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            for i, (_, raw) in enumerate(rec.host):
+                if raw == e:
+                    rec.host.pop(i)
+                    heapq.heapify(rec.host)
+                    self._touch_version(rec)
+                    return True
+            return False
+
+    def poll_last_and_offer_first_to(self, dest_name: str):
+        """Moves the comparator-greatest element to the head of `dest_name`
+        (RPOPLPUSH shape; the destination is a priority queue of the same
+        type, so "first" means heap order there too)."""
+        with self._engine.locked_many((self._name, dest_name)):
+            rec = self._rec_or_create()
+            if not rec.host:
+                return None
+            i = max(range(len(rec.host)), key=lambda j: rec.host[j])
+            hk, raw = rec.host.pop(i)
+            heapq.heapify(rec.host)
+            dest = type(self)(self._engine, dest_name, self._codec, self._key)
+            drec = dest._rec_or_create()
+            heapq.heappush(drec.host, (hk, raw))
+            self._touch_version(rec)
+            self._touch_version(drec)
+        type(self)(self._engine, dest_name, self._codec, self._key)._signal()
+        return self._d(raw)
+
+
+class PriorityDeque(PriorityQueue):
+    """RPriorityDeque (`RedissonPriorityDeque.java`): deque view over the
+    comparator order.  Positional inserts are meaningless on a heap, so
+    addFirst/addLast raise — the reference throws
+    UnsupportedOperationException("use add or put method")."""
+
+    def add_first(self, value):
+        raise NotImplementedError("use add/offer — order is comparator-defined")
+
+    def add_last(self, value):
+        raise NotImplementedError("use add/offer — order is comparator-defined")
+
+    offer_first = add_first
+    offer_last = add_last
+
+    def poll_first(self):
+        return self.poll()
+
+    def peek_first(self):
+        return self.peek()
+
+    def poll_last(self):
+        """Removes the comparator-greatest element (heap max)."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            if not rec.host:
+                return None
+            i = max(range(len(rec.host)), key=lambda j: rec.host[j])
+            _, raw = rec.host.pop(i)
+            heapq.heapify(rec.host)
+            self._touch_version(rec)
+            return self._d(raw)
+
+    def peek_last(self):
+        rec = self._engine.store.get(self._name)
+        if rec is None or not rec.host:
+            return None
+        return self._d(max(rec.host)[1])
+
+    def read_all_descending(self) -> List:
+        """descendingIterator materialized."""
+        return list(reversed(self.read_all()))
+
+
+class PriorityBlockingQueue(PriorityQueue, BlockingQueue):
+    """RPriorityBlockingQueue: heap order + parked take/poll(timeout).
+    MRO gives heap offer/poll from PriorityQueue and the wait-entry parking
+    from BlockingQueue; cross-queue polls are unsupported exactly like the
+    reference (`RedissonPriorityBlockingQueue.java` pollFromAny)."""
+
+    def poll_from_any(self, timeout, *other_names):
+        raise NotImplementedError("use poll method")
+
+    def poll_last_and_offer_first_to_blocking(self, dest_name, timeout):
+        raise NotImplementedError("use poll method")
+
+
+class PriorityBlockingDeque(PriorityBlockingQueue, PriorityDeque):
+    """RPriorityBlockingDeque: blocking + deque views of the heap."""
+
+    def take_first(self):
+        return self.poll_blocking(None)
+
+    def take_last(self):
+        return self.poll_last_blocking(None)
+
+    def poll_first_blocking(self, timeout: Optional[float]):
+        return self.poll_blocking(timeout)
+
+    def poll_last_blocking(self, timeout: Optional[float]):
+        return self._poll_blocking_impl(self.poll_last, timeout)
+
 
 class RingBuffer(Queue):
     """RRingBuffer: fixed capacity, overwrites oldest when full."""

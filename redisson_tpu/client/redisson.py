@@ -175,6 +175,20 @@ class RedissonTpu:
 
         return SetMultimap(self._engine, name, codec)
 
+    def get_list_multimap_cache(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.multimap import ListMultimapCache
+
+        mm = ListMultimapCache(self._engine, name, codec)
+        self._engine.eviction.schedule_for_record(self._engine, name, mm.reap_expired)
+        return mm
+
+    def get_set_multimap_cache(self, name: str, codec: Optional[Codec] = None):
+        from redisson_tpu.client.objects.multimap import SetMultimapCache
+
+        mm = SetMultimapCache(self._engine, name, codec)
+        self._engine.eviction.schedule_for_record(self._engine, name, mm.reap_expired)
+        return mm
+
     # -- queues -------------------------------------------------------------
 
     def get_queue(self, name: str, codec: Optional[Codec] = None):
@@ -206,6 +220,21 @@ class RedissonTpu:
         from redisson_tpu.client.objects.queue import PriorityQueue
 
         return PriorityQueue(self._engine, name, codec, key)
+
+    def get_priority_deque(self, name: str, codec: Optional[Codec] = None, key=None):
+        from redisson_tpu.client.objects.queue import PriorityDeque
+
+        return PriorityDeque(self._engine, name, codec, key)
+
+    def get_priority_blocking_queue(self, name: str, codec: Optional[Codec] = None, key=None):
+        from redisson_tpu.client.objects.queue import PriorityBlockingQueue
+
+        return PriorityBlockingQueue(self._engine, name, codec, key)
+
+    def get_priority_blocking_deque(self, name: str, codec: Optional[Codec] = None, key=None):
+        from redisson_tpu.client.objects.queue import PriorityBlockingDeque
+
+        return PriorityBlockingDeque(self._engine, name, codec, key)
 
     def get_ring_buffer(self, name: str, codec: Optional[Codec] = None):
         from redisson_tpu.client.objects.queue import RingBuffer

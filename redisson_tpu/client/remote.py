@@ -65,7 +65,10 @@ _REF_FACTORIES = {
     "Set": "get_set", "SetCache": "get_set_cache",
     "RList": "get_list", "Queue": "get_queue", "Deque": "get_deque",
     "BlockingQueue": "get_blocking_queue", "BlockingDeque": "get_blocking_deque",
-    "PriorityQueue": "get_priority_queue", "RingBuffer": "get_ring_buffer",
+    "PriorityQueue": "get_priority_queue", "PriorityDeque": "get_priority_deque",
+    "PriorityBlockingQueue": "get_priority_blocking_queue",
+    "PriorityBlockingDeque": "get_priority_blocking_deque",
+    "RingBuffer": "get_ring_buffer",
     # DelayedQueue deliberately absent: its factory takes the DESTINATION
     # queue handle, not a name — a by-name rebind can't reconstruct it, so
     # its references stay inert (name + type still identify it)
@@ -73,6 +76,8 @@ _REF_FACTORIES = {
     "ScoredSortedSet": "get_scored_sorted_set",
     "SortedSet": "get_sorted_set", "LexSortedSet": "get_lex_sorted_set",
     "ListMultimap": "get_list_multimap", "SetMultimap": "get_set_multimap",
+    "ListMultimapCache": "get_list_multimap_cache",
+    "SetMultimapCache": "get_set_multimap_cache",
     "BoundedBlockingQueue": "get_bounded_blocking_queue",
     "Bucket": "get_bucket", "AtomicLong": "get_atomic_long",
     "AtomicDouble": "get_atomic_double", "IdGenerator": "get_id_generator",
@@ -194,6 +199,15 @@ class RemoteObjectProxy:
     @property
     def name(self) -> str:
         return self._name
+
+    def drain_to(self, collection: list, max_elements: Optional[int] = None) -> int:
+        """Out-param methods cannot cross the RPC boundary (the server would
+        fill a pickled COPY of `collection`); re-expressed as one poll_many
+        wire call whose reply fills the caller's collection locally —
+        the reference's drainTo is the same client-side loop shape."""
+        items = self.poll_many(max_elements if max_elements is not None else 1 << 62)
+        collection.extend(items)
+        return len(items)
 
     def __getattr__(self, method: str) -> Callable:
         if method.startswith("_"):
@@ -779,7 +793,9 @@ _GENERIC_FACTORIES = {
     "get_map", "get_map_cache", "get_set", "get_set_cache", "get_sorted_set",
     "get_lex_sorted_set", "get_scored_sorted_set", "get_list", "get_queue",
     "get_deque", "get_blocking_queue", "get_blocking_deque", "get_priority_queue",
+    "get_priority_deque", "get_priority_blocking_queue", "get_priority_blocking_deque",
     "get_ring_buffer", "get_transfer_queue", "get_list_multimap", "get_set_multimap",
+    "get_list_multimap_cache", "get_set_multimap_cache",
     "get_atomic_long", "get_atomic_double", "get_id_generator", "get_lock",
     "get_fair_lock", "get_spin_lock", "get_fenced_lock", "get_semaphore",
     "get_count_down_latch", "get_rate_limiter", "get_stream", "get_time_series",

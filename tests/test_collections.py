@@ -404,3 +404,148 @@ class TestQueues:
         assert tq.transfer("y", timeout=2.0)
         t.join(2.0)
         assert res == ["y"]
+
+
+class TestPriorityFamily:
+    """RedissonPriorityDeque/PriorityBlockingQueueTest analogs."""
+
+    def test_priority_deque_both_ends(self, client):
+        pd = client.get_priority_deque("pd")
+        for v in [5, 1, 3, 9, 7]:
+            pd.offer(v)
+        assert pd.peek_first() == 1
+        assert pd.peek_last() == 9
+        assert pd.poll_last() == 9
+        assert pd.poll_first() == 1
+        assert pd.read_all() == [3, 5, 7]
+        assert pd.read_all_descending() == [7, 5, 3]
+
+    def test_priority_deque_positional_inserts_unsupported(self, client):
+        pd = client.get_priority_deque("pd2")
+        with pytest.raises(NotImplementedError):
+            pd.add_first(1)
+        with pytest.raises(NotImplementedError):
+            pd.offer_last(1)
+
+    def test_priority_deque_key_function(self, client):
+        pd = client.get_priority_deque("pd3", key=lambda v: -len(v))
+        for v in ["aa", "a", "aaa"]:
+            pd.offer(v)
+        assert pd.poll_first() == "aaa"  # longest = smallest key
+        assert pd.poll_last() == "a"
+
+    def test_priority_blocking_queue_take(self, client):
+        pbq = client.get_priority_blocking_queue("pbq")
+        got = []
+
+        def consumer():
+            got.append(pbq.take())
+            got.append(pbq.take())
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        pbq.offer(7)
+        pbq.offer(2)
+        t.join(3.0)
+        assert not t.is_alive()
+        # first take races the two offers; both elements arrive, and once
+        # both are present the heap order governs what a poll would see
+        assert sorted(got) == [2, 7]
+        assert pbq.poll() is None
+
+    def test_priority_blocking_queue_poll_timeout(self, client):
+        pbq = client.get_priority_blocking_queue("pbq2")
+        t0 = time.time()
+        assert pbq.poll_blocking(0.1) is None
+        assert time.time() - t0 >= 0.09
+        with pytest.raises(NotImplementedError):
+            pbq.poll_from_any(0.1, "other")
+
+    def test_priority_blocking_deque(self, client):
+        pbd = client.get_priority_blocking_deque("pbd")
+        for v in [4, 8, 6]:
+            pbd.offer(v)
+        assert pbd.take_first() == 4
+        assert pbd.take_last() == 8
+        assert pbd.poll_last_blocking(0.1) == 6
+        assert pbd.poll_last_blocking(0.05) is None
+
+
+class TestMultimaps:
+    """RedissonListMultimapTest / RedissonSetMultimapCacheTest analogs."""
+
+    def test_list_multimap_semantics(self, client):
+        mm = client.get_list_multimap("lmm")
+        assert mm.put("k", 1) and mm.put("k", 1) and mm.put("k", 2)
+        assert mm.get_all("k") == [1, 1, 2]  # duplicates + order kept
+        assert mm.size() == 3 and mm.key_size() == 1
+        assert mm.remove("k", 1)
+        assert mm.get_all("k") == [1, 2]
+        assert mm.remove_all("k") == [1, 2]
+        assert not mm.contains_key("k")
+
+    def test_set_multimap_semantics(self, client):
+        mm = client.get_set_multimap("smm")
+        assert mm.put("k", "a")
+        assert not mm.put("k", "a")  # uniqueness per key
+        assert mm.put("k", "b")
+        assert sorted(mm.get_all("k")) == ["a", "b"]
+        assert mm.contains_entry("k", "a") and not mm.contains_entry("k", "z")
+        assert sorted(mm.entries()) == [("k", "a"), ("k", "b")]
+
+    def test_multimap_cache_expire_key(self, client):
+        mm = client.get_list_multimap_cache("lmmc")
+        mm.put("hot", 1)
+        mm.put("cold", 2)
+        assert mm.expire_key("cold", 0.08)
+        assert not mm.expire_key("missing", 1.0)
+        assert mm.contains_key("cold")
+        time.sleep(0.1)
+        assert not mm.contains_key("cold")  # lazily reaped
+        assert mm.get_all("cold") == []
+        assert mm.get_all("hot") == [1]  # untouched key survives
+        assert mm.key_size() == 1
+
+    def test_multimap_cache_sweep(self, client):
+        mm = client.get_set_multimap_cache("smmc")
+        for i in range(5):
+            mm.put(f"k{i}", i)
+            mm.expire_key(f"k{i}", 0.05)
+        mm.put("keep", 99)
+        time.sleep(0.08)
+        # the sweep entry point removes expired keys without any access
+        assert mm.reap_expired() == 5
+        assert mm.read_all_key_set() == ["keep"]
+
+    def test_multimap_cache_put_after_expiry_recreates(self, client):
+        mm = client.get_set_multimap_cache("smmc2")
+        mm.put("k", "v1")
+        mm.expire_key("k", 0.05)
+        time.sleep(0.07)
+        assert mm.put("k", "v1")  # expired bucket dropped: fresh insert
+        assert mm.get_all("k") == ["v1"]
+        # recreated key carries no TTL until expire_key is called again
+        time.sleep(0.07)
+        assert mm.contains_key("k")
+
+    def test_priority_queue_list_shaped_ops(self, client):
+        """Regression: ops inherited from Queue must handle heap tuples."""
+        pq = client.get_priority_queue("pq-ops")
+        for v in [5, 1, 3]:
+            pq.offer(v)
+        assert pq.contains(3) and not pq.contains(99)
+        assert pq.remove(3) and not pq.remove(3)
+        assert pq.poll_many(10) == [1, 5]
+        for v in [4, 2]:
+            pq.offer(v)
+        assert pq.poll_last_and_offer_first_to("pq-ops-dst") == 4
+        dst = client.get_priority_queue("pq-ops-dst")
+        assert dst.read_all() == [4]
+
+    def test_priority_blocking_drain(self, client):
+        pbq = client.get_priority_blocking_queue("pbq-drain")
+        for v in [9, 4, 6]:
+            pbq.offer(v)
+        assert pbq.poll_many(2) == [4, 6]
+        assert pbq.contains(9)

@@ -90,6 +90,19 @@ def drive_surface(client, tag):
     mm.put("k", "v1")
     mm.put("k", "v2")
     assert mm.get_all("k") == ["v1", "v2"]
+    mmc = client.get_set_multimap_cache(f"wmmc-{tag}")
+    mmc.put("k", "v")
+    assert mmc.expire_key("k", 30.0) is True
+    assert mmc.get_all("k") == ["v"]
+    # priority family
+    pd = client.get_priority_deque(f"wpd-{tag}")
+    pd.offer(3)
+    pd.offer(1)
+    assert pd.poll_last() == 3
+    assert pd.poll_first() == 1
+    pbq = client.get_priority_blocking_queue(f"wpbq-{tag}")
+    pbq.offer(5)
+    assert pbq.poll_blocking(1.0) == 5
     # time series
     ts = client.get_time_series(f"wts-{tag}")
     ts.add(1.0, "a")
