@@ -393,8 +393,21 @@ class MapCache(Map):
     """RMapCache: per-entry TTL / max-idle (RedissonMapCache.java).
 
     Entry layout: host[ek] = [ev, expire_at | None, max_idle | None,
-    last_access].  Expired entries are reaped lazily on access and by the
-    EvictionScheduler sweep (eviction.py).
+    last_access, hit_count].  Expired entries are reaped lazily on access and
+    by the EvictionScheduler sweep (eviction.py).  Four-element cells from
+    older checkpoints are read transparently (hit_count treated as 0).
+
+    Entry listeners (created/updated/removed/expired) publish on the
+    reference's channel names (`RedissonMapCache.java:1767-1787`:
+    `redisson_map_cache_<kind>:{name}`) through the engine hub, so embedded
+    listeners AND wire pubsub subscribers observe the same events.  Delivery
+    is async on the engine's single-worker events pool: mutation order is
+    preserved, and user listeners never run under the record lock.
+
+    Size-bounded mode (`trySetMaxSize`/`setMaxSize` + EvictionMode LRU|LFU,
+    `RedissonMapCache.java:91-137`): inserts beyond max_size evict the
+    least-recently-used (last_access) or least-frequently-used (hit_count)
+    live entries, which are announced as `removed` events.
     """
 
     _kind = "map_cache"
@@ -402,31 +415,161 @@ class MapCache(Map):
     # (lazy reap on access), so (nonce, version) cannot key a scan view here
     _scan_view_safe = False
 
+    EVENT_KINDS = ("created", "updated", "removed", "expired")
+
     def _now(self):
         return time.time()
+
+    # -- entry events --------------------------------------------------------
+
+    def entry_event_channel(self, kind: str) -> str:
+        return f"redisson_map_cache_{kind}:{self._name}"
+
+    def _emit(self, kind: str, ek: bytes, raw, old_raw=None) -> None:
+        """Queue one listener event for async FIFO delivery.  No-op without
+        subscribers so the unlistened hot path never pays decode cost."""
+        hub = self._engine.pubsub
+        ch = self.entry_event_channel(kind)
+        if not hub.has_listeners(ch):
+            return
+        key = self._dk(ek)
+        value = None if raw is None else self._dv(raw)
+        old = None if old_raw is None else self._dv(old_raw)
+        try:
+            self._engine.events_pool.submit(hub.publish, ch, (key, value, old))
+        except RuntimeError:
+            pass  # engine shutting down: events are best-effort
+
+    def add_entry_listener(self, kind: str, fn) -> Tuple[str, int]:
+        """RMapCache.addListener analog; `kind` selects the listener
+        interface (EntryCreated/Updated/Removed/ExpiredListener).  `fn` is
+        called as fn(key, value, old_value); old_value is non-None only for
+        'updated'.  Returns a token for remove_entry_listener."""
+        if kind not in self.EVENT_KINDS:
+            raise ValueError(f"unknown entry event kind: {kind!r}")
+        ch = self.entry_event_channel(kind)
+        lid = self._engine.pubsub.subscribe(ch, lambda _ch, msg: fn(*msg))
+        return (kind, lid)
+
+    def remove_entry_listener(self, token) -> None:
+        kind, lid = token
+        self._engine.pubsub.unsubscribe(self.entry_event_channel(kind), lid)
+
+    # -- cell machinery ------------------------------------------------------
 
     def _live(self, rec, ek, touch=True):
         cell = rec.host.get(ek)
         if cell is None:
             return None
-        ev, exp, max_idle, last = cell
         now = self._now()
-        if exp is not None and now >= exp:
+        if cell[1] is not None and now >= cell[1]:
             del rec.host[ek]
+            self._emit("expired", ek, cell[0])
             return None
-        if max_idle is not None:
-            if now - last >= max_idle:
-                del rec.host[ek]
-                return None
-            if touch:
-                cell[3] = now
-        return ev
+        if cell[2] is not None and now - cell[3] >= cell[2]:
+            del rec.host[ek]
+            self._emit("expired", ek, cell[0])
+            return None
+        if touch:
+            cell[3] = now
+            if len(cell) > 4:
+                cell[4] += 1
+        return cell[0]
+
+    def _store_cell(self, rec, ek: bytes, ev: bytes, exp=None, max_idle=None):
+        """Write one cell, emitting created|updated and enforcing max_size;
+        returns the previous live raw value (None if absent)."""
+        old = self._live(rec, ek, touch=False)
+        # an update carries the access frequency forward: LFU must rank by
+        # read history, and a write resetting it would turn the hottest key
+        # into the next eviction victim
+        prev = rec.host.get(ek)
+        hits = prev[4] if (old is not None and prev is not None and len(prev) > 4) else 0
+        rec.host[ek] = [ev, exp, max_idle, self._now(), hits]
+        if old is None:
+            self._emit("created", ek, ev)
+            self._enforce_max_size(rec, keep=ek)
+        else:
+            self._emit("updated", ek, ev, old)
+        return old
 
     def _raw_get(self, rec, ek: bytes):
         return self._live(rec, ek)
 
     def _raw_put(self, rec, ek: bytes, ev: bytes):
-        rec.host[ek] = [ev, None, None, self._now()]
+        self._store_cell(rec, ek, ev)
+
+    def _raw_del(self, rec, ek: bytes) -> bool:
+        live = self._live(rec, ek, touch=False)
+        if live is None:
+            return False
+        del rec.host[ek]
+        self._emit("removed", ek, live)
+        return True
+
+    # -- size-bounded mode ---------------------------------------------------
+
+    def try_set_max_size(self, max_size: int, mode: str = "LRU") -> bool:
+        """Set the bound only if none exists yet (RMapCache.trySetMaxSize)."""
+        self._check_max_size(max_size, mode)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            if rec.meta.get("max_size"):
+                return False
+            rec.meta["max_size"] = max_size
+            rec.meta["eviction_mode"] = mode
+            self._touch_version(rec)  # the bound must replicate/ship
+            return True
+
+    def set_max_size(self, max_size: int, mode: str = "LRU") -> None:
+        """Set/replace the bound; an already-over-bound map is trimmed on
+        the spot (the reference trims on the next write)."""
+        self._check_max_size(max_size, mode)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            rec.meta["max_size"] = max_size
+            rec.meta["eviction_mode"] = mode
+            self._enforce_max_size(rec)
+            self._touch_version(rec)
+
+    def get_max_size(self) -> int:
+        rec = self._engine.store.get(self._name)
+        return 0 if rec is None else rec.meta.get("max_size", 0)
+
+    @staticmethod
+    def _check_max_size(max_size: int, mode: str) -> None:
+        # 0 must not pass: meta stores it falsy, so a later try_set_max_size
+        # would ALSO report "bound set" and break the set-once contract
+        if max_size <= 0:
+            raise ValueError("maxSize should be greater than zero")
+        if mode not in ("LRU", "LFU"):
+            raise ValueError(f"unknown eviction mode: {mode!r}")
+
+    def _enforce_max_size(self, rec, keep: Optional[bytes] = None) -> None:
+        mx = rec.meta.get("max_size") or 0
+        if mx <= 0 or len(rec.host) <= mx:
+            return
+        # reap dead cells FIRST (emitting their honest 'expired' events):
+        # counting them toward the bound would evict live entries while
+        # expired ones hold the capacity
+        for ek in list(rec.host.keys()):
+            self._live(rec, ek, touch=False)
+        if len(rec.host) <= mx:
+            return
+        lfu = rec.meta.get("eviction_mode") == "LFU"
+
+        def rank(item):
+            cell = item[1]
+            if lfu:
+                return cell[4] if len(cell) > 4 else 0
+            return cell[3]  # last_access
+
+        victims = sorted(
+            (kv for kv in rec.host.items() if kv[0] != keep), key=rank
+        )[: len(rec.host) - mx]
+        for vek, vcell in victims:
+            del rec.host[vek]
+            self._emit("removed", vek, vcell[0])
 
     def put_with_ttl(
         self,
@@ -440,8 +583,7 @@ class MapCache(Map):
         now = self._now()
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
-            old = self._live(rec, ek, touch=False)
-            rec.host[ek] = [ev, now + ttl if ttl else None, max_idle, now]
+            old = self._store_cell(rec, ek, ev, now + ttl if ttl else None, max_idle)
             self._touch_version(rec)
         self._write_through("write", key, value)
         return None if old is None else self._dv(old)
@@ -456,7 +598,7 @@ class MapCache(Map):
             old = self._live(rec, ek, touch=False)
             if old is not None:
                 return self._dv(old)
-            rec.host[ek] = [ev, now + ttl if ttl else None, max_idle, now]
+            self._store_cell(rec, ek, ev, now + ttl if ttl else None, max_idle)
             self._touch_version(rec)
         self._write_through("write", key, value)
         return None

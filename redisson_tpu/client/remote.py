@@ -15,6 +15,7 @@ Listeners (topics) ride the dedicated pubsub connection.
 """
 from __future__ import annotations
 
+import logging
 import pickle
 import time
 import uuid
@@ -23,6 +24,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from redisson_tpu.client.codec import Codec, DEFAULT_CODEC
+
+logger = logging.getLogger(__name__)
 from redisson_tpu.net.client import NodeClient
 from redisson_tpu.net.resp import RespError
 
@@ -208,6 +211,33 @@ class RemoteObjectProxy:
         items = self.poll_many(max_elements if max_elements is not None else 1 << 62)
         collection.extend(items)
         return len(items)
+
+    def add_entry_listener(self, kind: str, fn):
+        """MapCache entry events ride pubsub channels
+        (`redisson_map_cache_<kind>:{name}`), so a remote listener is a wire
+        SUBSCRIBE — callbacks cannot cross RPC as OBJCALL args.  fn is
+        called as fn(key, value, old_value), same as the embedded handle."""
+        from redisson_tpu.client.objects.map import MapCache
+        from redisson_tpu.net.safe_pickle import safe_loads
+
+        if kind not in MapCache.EVENT_KINDS:  # fail fast like the embedded handle
+            raise ValueError(f"unknown entry event kind: {kind!r}")
+        ch = f"redisson_map_cache_{kind}:{self._name}"
+
+        def wire_listener(_channel: str, payload: bytes) -> None:
+            # guarded: an exception here would kill the shared pubsub reader
+            # thread and silently end ALL push delivery on this connection
+            try:
+                fn(*safe_loads(payload))
+            except Exception:  # noqa: BLE001 — listener faults must not stop the reader
+                logger.exception("entry listener for %s failed", ch)
+
+        self._client.pubsub_for(ch).subscribe(ch, wire_listener)
+        return (ch, wire_listener)
+
+    def remove_entry_listener(self, token) -> None:
+        ch, wire_listener = token
+        self._client.pubsub_for(ch).remove_listener(ch, wire_listener)
 
     def __getattr__(self, method: str) -> Callable:
         if method.startswith("_"):

@@ -49,6 +49,7 @@ class Engine:
         self._timer = None
         self._timer_pool = None
         self._renewal_pool_ = None
+        self._events_pool_ = None
         # (name, holder) -> Timeout: active lock-watchdog renewals, all on
         # the ONE shared wheel timer (ServiceManager's HashedWheelTimer role)
         self._renewals: dict[tuple, Any] = {}
@@ -170,6 +171,26 @@ class Engine:
         Returns the wheel Timeout (cancellable until it fires)."""
         pool = self.timer_pool
         return self.timer.new_timeout(lambda: pool.submit(fn), delay)
+
+    @property
+    def events_pool(self):
+        """SINGLE-worker pool delivering entry/eviction events
+        (MapCache listeners etc.).  One worker on purpose: events for one
+        object must arrive in mutation order (created before updated before
+        removed), which a multi-worker pool cannot guarantee.  Deliveries
+        are async so a mutator never runs user listeners while holding the
+        record lock (the reference gets the same decoupling from Redis
+        pubsub delivery)."""
+        with self._locks_guard:
+            if self._closed:
+                raise RuntimeError("engine is shut down")
+            if self._events_pool_ is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._events_pool_ = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="rtpu-events"
+                )
+            return self._events_pool_
 
     @property
     def _renewal_pool(self):
@@ -343,6 +364,7 @@ class Engine:
             timer, self._timer = self._timer, None
             pool, self._timer_pool = self._timer_pool, None
             rpool, self._renewal_pool_ = self._renewal_pool_, None
+            epool, self._events_pool_ = self._events_pool_, None
             renewals = list(self._renewals.values())
             self._renewals.clear()
         for t in renewals:
@@ -350,7 +372,7 @@ class Engine:
                 t.cancel()
         if timer is not None:
             timer.stop()
-        for p in (pool, rpool):
+        for p in (pool, rpool, epool):
             if p is not None:
                 p.shutdown(wait=False, cancel_futures=True)
         if eviction is not None:

@@ -84,6 +84,20 @@ class PubSubHub:
         with self._lock:
             return len(self._channels.get(channel, []))
 
+    def has_listeners(self, channel: str) -> bool:
+        """True when a publish to `channel` would reach anyone — exact
+        subscribers OR matching pattern subscribers.  Publishers use this to
+        skip payload-construction cost; gating on subscriber_count alone
+        would silently starve PSUBSCRIBE-only consumers."""
+        with self._lock:
+            if self._channels.get(channel):
+                return True
+            return any(
+                fnmatch.fnmatchcase(channel, pat)
+                for pat, subs in self._patterns.items()
+                if subs
+            )
+
     def channels(self) -> List[str]:
         with self._lock:
             return list(self._channels)

@@ -140,6 +140,132 @@ class TestMapCache:
         time.sleep(0.02)
         assert m.reap_expired() == 1
 
+    @staticmethod
+    def _wait_for(pred, timeout=3.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.01)
+        return pred()
+
+    def test_entry_listeners(self, client):
+        m = client.get_map_cache("mcl")
+        events = []
+        tokens = [
+            m.add_entry_listener(kind, lambda k, v, o, _kind=kind: events.append((_kind, k, v, o)))
+            for kind in ("created", "updated", "removed", "expired")
+        ]
+        m.put("a", 1)          # created
+        m.put("a", 2)          # updated (old=1)
+        m.remove("a")          # removed
+        m.put_with_ttl("b", 9, ttl=0.05)  # created
+        time.sleep(0.07)
+        assert m.get("b") is None  # expired via lazy reap
+        assert self._wait_for(lambda: len(events) == 5), events
+        assert events == [
+            ("created", "a", 1, None),
+            ("updated", "a", 2, 1),
+            ("removed", "a", 2, None),
+            ("created", "b", 9, None),
+            ("expired", "b", 9, None),
+        ]
+        for t in tokens:
+            m.remove_entry_listener(t)
+        m.put("silent", 1)
+        time.sleep(0.05)
+        assert len(events) == 5  # detached listeners stay silent
+
+    def test_entry_listener_kind_validated(self, client):
+        m = client.get_map_cache("mcl2")
+        with pytest.raises(ValueError):
+            m.add_entry_listener("evicted", lambda *a: None)
+
+    def test_max_size_lru(self, client):
+        m = client.get_map_cache("mcsize")
+        assert m.try_set_max_size(3)
+        assert not m.try_set_max_size(5)  # already bounded
+        assert m.get_max_size() == 3
+        for i in range(3):
+            m.put(f"k{i}", i)
+            time.sleep(0.01)
+        m.get("k0")  # refresh k0: k1 becomes LRU victim
+        time.sleep(0.01)
+        m.put("k3", 3)
+        assert m.size() == 3
+        assert m.get("k1") is None
+        assert m.get("k0") == 0 and m.get("k3") == 3
+
+    def test_max_size_lfu(self, client):
+        m = client.get_map_cache("mcsize2")
+        m.set_max_size(2, mode="LFU")
+        m.put("hot", 1)
+        m.put("cold", 2)
+        for _ in range(5):
+            m.get("hot")
+        m.put("new", 3)  # evicts 'cold' (fewest hits)
+        assert m.get("cold") is None
+        assert m.get("hot") == 1 and m.get("new") == 3
+
+    def test_set_max_size_trims_immediately(self, client):
+        m = client.get_map_cache("mcsize3")
+        for i in range(5):
+            m.put(f"k{i}", i)
+        m.set_max_size(2)
+        assert m.size() == 2
+
+    def test_max_size_eviction_fires_removed_event(self, client):
+        m = client.get_map_cache("mcsize4")
+        m.set_max_size(1)
+        removed = []
+        m.add_entry_listener("removed", lambda k, v, o: removed.append((k, v)))
+        m.put("a", 1)
+        m.put("b", 2)  # evicts a
+        assert self._wait_for(lambda: removed == [("a", 1)]), removed
+
+    def test_max_size_validation(self, client):
+        m = client.get_map_cache("mcsize5")
+        with pytest.raises(ValueError):
+            m.set_max_size(-1)
+        with pytest.raises(ValueError):
+            m.set_max_size(0)  # 0 is falsy in meta: would break set-once
+        with pytest.raises(ValueError):
+            m.set_max_size(2, mode="FIFO")
+
+    def test_lfu_update_keeps_frequency(self, client):
+        """A write to a hot key must not reset its LFU rank."""
+        m = client.get_map_cache("mcsize6")
+        m.set_max_size(2, mode="LFU")
+        m.put("hot", 1)
+        m.put("warm", 2)
+        for _ in range(5):
+            m.get("hot")
+        m.get("warm")
+        m.put("hot", 10)  # update: frequency carries forward
+        m.put("new", 3)   # evicts 'warm', not the freshly-written 'hot'
+        assert m.get("hot") == 10
+        assert m.get("warm") is None
+
+    def test_max_size_ignores_expired_cells(self, client):
+        """Dead cells must not hold capacity nor push out live entries."""
+        m = client.get_map_cache("mcsize7")
+        m.set_max_size(2)
+        m.put_with_ttl("dead", 0, ttl=0.03)
+        m.put("live", 1)
+        time.sleep(0.05)
+        m.put("new", 2)  # bound hit: the expired cell is reaped, both live survive
+        assert m.get("live") == 1 and m.get("new") == 2
+
+    def test_entry_events_reach_pattern_subscribers(self, client):
+        """PSUBSCRIBE-only consumers must receive entry events (the publish
+        fast path cannot gate on exact-channel subscribers alone)."""
+        m = client.get_map_cache("mcpat")
+        events = []
+        pt = client.get_pattern_topic("redisson_map_cache_created:mcpat*")
+        pt.add_listener(lambda ch, msg: events.append(msg))
+        m.put("k", 7)
+        assert self._wait_for(lambda: events == [("k", 7, None)]), events
+
 
 class TestSet:
     def test_basics(self, client):

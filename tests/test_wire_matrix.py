@@ -226,3 +226,31 @@ def test_remote_local_cached_map_invalidation():
         finally:
             a.shutdown()
             b.shutdown()
+
+
+def test_remote_map_cache_entry_listeners(single):
+    """Entry events ride the wire pubsub path: a remote listener observes
+    mutations performed by another remote caller."""
+    import time
+
+    mc = single.get_map_cache("wire-mcl")
+    events = []
+    token = mc.add_entry_listener("created", lambda k, v, o: events.append((k, v)))
+    try:
+        time.sleep(0.1)  # let SUBSCRIBE land before the mutation
+        mc.put("k", "v")
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not events:
+            time.sleep(0.02)
+        assert events == [("k", "v")]
+    finally:
+        mc.remove_entry_listener(token)
+
+
+def test_remote_map_cache_max_size(single):
+    mc = single.get_map_cache("wire-mcsize")
+    assert mc.try_set_max_size(2) is True
+    mc.put("a", 1)
+    mc.put("b", 2)
+    mc.put("c", 3)
+    assert mc.size() == 2
