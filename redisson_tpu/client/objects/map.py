@@ -106,6 +106,12 @@ class Map(RExpirable):
     def _raw_get(self, rec, ek: bytes):
         return rec.host.get(ek)
 
+    def _raw_get_for_update(self, rec, ek: bytes):
+        """Old-value fetch inside WRITE paths.  Same as _raw_get here;
+        MapCache overrides it to skip the access-tracking touch — a write
+        must not count as a read or LFU ranks writers above readers."""
+        return self._raw_get(rec, ek)
+
     def _raw_put(self, rec, ek: bytes, ev: bytes):
         rec.host[ek] = ev
 
@@ -247,7 +253,7 @@ class Map(RExpirable):
         ek, ev = self._ek(key), self._ev(value)
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
-            old = self._raw_get(rec, ek)
+            old = self._raw_get_for_update(rec, ek)
             self._raw_put(rec, ek, ev)
             self._touch_version(rec)
         self._write_through("write", key, value)
@@ -269,7 +275,7 @@ class Map(RExpirable):
         ek, ev = self._ek(key), self._ev(value)
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
-            old = self._raw_get(rec, ek)
+            old = self._raw_get_for_update(rec, ek)
             if old is not None:
                 return self._dv(old)
             self._raw_put(rec, ek, ev)
@@ -294,7 +300,7 @@ class Map(RExpirable):
         ek = self._ek(key)
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
-            old = self._raw_get(rec, ek)
+            old = self._raw_get_for_update(rec, ek)
             if old is None:
                 return None
             self._raw_del(rec, ek)
@@ -320,7 +326,7 @@ class Map(RExpirable):
         ek, ev = self._ek(key), self._ev(expected)
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
-            if self._raw_get(rec, ek) != ev:
+            if self._raw_get_for_update(rec, ek) != ev:
                 return False
             self._raw_del(rec, ek)
             self._touch_version(rec)
@@ -332,7 +338,7 @@ class Map(RExpirable):
         ek, ev = self._ek(key), self._ev(value)
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
-            old = self._raw_get(rec, ek)
+            old = self._raw_get_for_update(rec, ek)
             if old is None:
                 return None
             self._raw_put(rec, ek, ev)
@@ -344,7 +350,7 @@ class Map(RExpirable):
         ek = self._ek(key)
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
-            if self._raw_get(rec, ek) != self._ev(expected):
+            if self._raw_get_for_update(rec, ek) != self._ev(expected):
                 return False
             self._raw_put(rec, ek, self._ev(update))
             self._touch_version(rec)
@@ -356,7 +362,7 @@ class Map(RExpirable):
         ek = self._ek(key)
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
-            raw = self._raw_get(rec, ek)
+            raw = self._raw_get_for_update(rec, ek)
             cur = 0 if raw is None else self._dv(raw)
             if not isinstance(cur, (int, float)):
                 raise TypeError(f"value at {key!r} is not numeric")
@@ -495,6 +501,11 @@ class MapCache(Map):
 
     def _raw_get(self, rec, ek: bytes):
         return self._live(rec, ek)
+
+    def _raw_get_for_update(self, rec, ek: bytes):
+        # writes fetch the old value WITHOUT touching access tracking:
+        # a put must not refresh max-idle or count as an LFU hit
+        return self._live(rec, ek, touch=False)
 
     def _raw_put(self, rec, ek: bytes, ev: bytes):
         self._store_cell(rec, ek, ev)

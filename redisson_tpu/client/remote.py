@@ -232,12 +232,15 @@ class RemoteObjectProxy:
             except Exception:  # noqa: BLE001 — listener faults must not stop the reader
                 logger.exception("entry listener for %s failed", ch)
 
-        self._client.pubsub_for(ch).subscribe(ch, wire_listener)
+        # subscribe on the shard that owns the MAP (not the channel string):
+        # the engine-hub publish happens on the master serving the map's
+        # slot, so that is where has_listeners() must see this subscriber
+        self._client.pubsub_for(self._name).subscribe(ch, wire_listener)
         return (ch, wire_listener)
 
     def remove_entry_listener(self, token) -> None:
         ch, wire_listener = token
-        self._client.pubsub_for(ch).remove_listener(ch, wire_listener)
+        self._client.pubsub_for(self._name).remove_listener(ch, wire_listener)
 
     def __getattr__(self, method: str) -> Callable:
         if method.startswith("_"):

@@ -92,6 +92,23 @@ SPECS["SINTERCARD"] = CommandSpec("SINTERCARD", False, None, numkeys_at=0)
 SPECS["ZUNIONSTORE"] = CommandSpec("ZUNIONSTORE", True, 0, numkeys_at=1)
 SPECS["ZINTERSTORE"] = CommandSpec("ZINTERSTORE", True, 0, numkeys_at=1)
 
+# typed surface expansion round 3: lex zset ranges, multi-pops, blocking
+# verbs, generic COPY/SORT.  Blocking verbs route as writes (they consume).
+_spec(SPECS, "BITPOS ZLEXCOUNT ZRANGEBYLEX ZREVRANGEBYLEX", False, 0)
+_spec(SPECS, "ZREMRANGEBYLEX SORT", True, 0)
+# BLPOP/BRPOP/BZPOPMIN/BZPOPMAX <key>... <timeout> — route by FIRST key
+# (cluster semantics already require all keys in one slot, as in the
+# reference's isBlockingCommand handling)
+_spec(SPECS, "BLPOP BRPOP BZPOPMIN BZPOPMAX", True, 0)
+for _n in ("COPY", "RENAMENX", "ZRANGESTORE", "BLMOVE", "BRPOPLPUSH"):
+    SPECS[_n] = CommandSpec(_n, True, 0, multi_key=True, key_count=2)
+SPECS["ZDIFF"] = CommandSpec("ZDIFF", False, None, numkeys_at=0)
+SPECS["ZINTER"] = CommandSpec("ZINTER", False, None, numkeys_at=0)
+SPECS["ZUNION"] = CommandSpec("ZUNION", False, None, numkeys_at=0)
+SPECS["ZDIFFSTORE"] = CommandSpec("ZDIFFSTORE", True, 0, numkeys_at=1)
+SPECS["LMPOP"] = CommandSpec("LMPOP", True, None, numkeys_at=0)
+SPECS["ZMPOP"] = CommandSpec("ZMPOP", True, None, numkeys_at=0)
+
 # multi-key
 _spec(SPECS, "DEL UNLINK", True, 0, multi_key=True)
 _spec(SPECS, "RENAME", True, 0, multi_key=True)

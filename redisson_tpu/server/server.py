@@ -75,6 +75,18 @@ def _force_lazies(results: list, server) -> None:
                 fail(i, e)
 
 
+# Commands whose handlers may PARK the worker thread (blocking verbs hold it
+# for up to their timeout; OBJCALL runs arbitrary object methods incl.
+# poll_blocking).  Dispatched on the wide slow pool so the per-connection
+# fast pool never starves.
+_SLOW_COMMANDS = frozenset(
+    b.encode() for b in (
+        "OBJCALL", "OBJCALLM", "OBJCALLMA", "BLPOP", "BRPOP", "BLMOVE",
+        "BRPOPLPUSH", "BZPOPMIN", "BZPOPMAX",
+    )
+)
+
+
 class TpuServer:
     def __init__(
         self,
@@ -453,9 +465,12 @@ class TpuServer:
                         results.append(_Encoded(resp.encode_error("ERR bad request frame")))
                         continue
                     self.stats["commands"] += 1
+                    # OBJCALL (user methods may park) and blocking verbs go
+                    # to the wide slow pool: a parked handler must never
+                    # starve the small fast pool every connection shares
                     pool = (
                         self._slow_pool
-                        if bytes(cmd[0]).upper() == b"OBJCALL"
+                        if bytes(cmd[0]).upper() in _SLOW_COMMANDS
                         else self._pool
                     )
                     try:

@@ -675,3 +675,17 @@ class TestMultimaps:
             pbq.offer(v)
         assert pbq.poll_many(2) == [4, 6]
         assert pbq.contains(9)
+
+    def test_lfu_writes_do_not_count_as_reads(self, client):
+        """Regression: put() overwrites must not inflate the LFU counter."""
+        m = client.get_map_cache("mcsize8")
+        m.set_max_size(2, mode="LFU")
+        m.put("writer", 0)
+        for i in range(50):
+            m.put("writer", i)  # written often, never read
+        m.put("reader", 1)
+        for _ in range(3):
+            m.get("reader")
+        m.put("new", 2)  # must evict 'writer' (0 reads), not 'reader'
+        assert m.get("reader") == 1
+        assert m.get("writer") is None

@@ -396,3 +396,220 @@ def test_sintercard_negative_limit(client):
     _x(client, "SADD", "sc1", "a")
     with pytest.raises(RespError, match="negative"):
         _x(client, "SINTERCARD", 1, "sc1", "LIMIT", -1)
+
+
+# -- typed surface expansion round 3 ------------------------------------------
+
+def test_copy_and_renamenx(client):
+    _x(client, "SET", "cp:src", "v1")
+    assert _x(client, "COPY", "cp:src", "cp:dst") == 1
+    assert bytes(_x(client, "GET", "cp:dst")) == b"v1"
+    _x(client, "SET", "cp:src", "v2")          # copies are independent
+    assert bytes(_x(client, "GET", "cp:dst")) == b"v1"
+    assert _x(client, "COPY", "cp:src", "cp:dst") == 0  # exists, no REPLACE
+    assert _x(client, "COPY", "cp:src", "cp:dst", "REPLACE") == 1
+    assert bytes(_x(client, "GET", "cp:dst")) == b"v2"
+    assert _x(client, "COPY", "cp:missing", "cp:x") == 0
+    # structured objects round-trip too
+    _x(client, "HSET", "cp:h", "f", "v")
+    assert _x(client, "COPY", "cp:h", "cp:h2") == 1
+    assert bytes(_x(client, "HGET", "cp:h2", "f")) == b"v"
+    # RENAMENX
+    _x(client, "SET", "rn:a", "1")
+    _x(client, "SET", "rn:b", "2")
+    assert _x(client, "RENAMENX", "rn:a", "rn:b") == 0   # dst exists
+    assert _x(client, "RENAMENX", "rn:b", "rn:c") == 1
+    assert bytes(_x(client, "GET", "rn:c")) == b"2"
+    with pytest.raises(RespError):
+        _x(client, "RENAMENX", "rn:gone", "rn:d")
+
+
+def test_bitpos_and_sort(client):
+    _x(client, "SETBIT", "bp", 5, 1)
+    assert _x(client, "BITPOS", "bp", 1) == 5
+    assert _x(client, "BITPOS", "bp", 0) == 0
+    _x(client, "RPUSH", "srt", "3", "1", "10", "2")
+    assert [bytes(v) for v in _x(client, "SORT", "srt")] == [b"1", b"2", b"3", b"10"]
+    assert [bytes(v) for v in _x(client, "SORT", "srt", "DESC")] == [b"10", b"3", b"2", b"1"]
+    assert [bytes(v) for v in _x(client, "SORT", "srt", "LIMIT", "1", "2")] == [b"2", b"3"]
+    assert [bytes(v) for v in _x(client, "SORT", "srt", "ALPHA")] == [b"1", b"10", b"2", b"3"]
+    assert _x(client, "SORT", "srt", "STORE", "srt:out") == 4
+    assert [bytes(v) for v in _x(client, "LRANGE", "srt:out", 0, -1)] == [b"1", b"2", b"3", b"10"]
+    _x(client, "RPUSH", "srt:alpha", "b", "a")
+    with pytest.raises(RespError):
+        _x(client, "SORT", "srt:alpha")  # non-numeric without ALPHA
+
+
+def test_zset_lex_family(client):
+    for m in ("a", "b", "c", "d"):
+        _x(client, "ZADD", "zl", 0, m)
+    assert _x(client, "ZLEXCOUNT", "zl", "-", "+") == 4
+    assert _x(client, "ZLEXCOUNT", "zl", "[b", "[c") == 2
+    assert _x(client, "ZLEXCOUNT", "zl", "(b", "[c") == 1
+    assert [bytes(v) for v in _x(client, "ZRANGEBYLEX", "zl", "-", "[c")] == [b"a", b"b", b"c"]
+    assert [bytes(v) for v in _x(client, "ZRANGEBYLEX", "zl", "-", "+", "LIMIT", 1, 2)] == [b"b", b"c"]
+    assert [bytes(v) for v in _x(client, "ZREVRANGEBYLEX", "zl", "+", "[b")] == [b"d", b"c", b"b"]
+    assert _x(client, "ZREMRANGEBYLEX", "zl", "[a", "(c") == 2
+    assert [bytes(v) for v in _x(client, "ZRANGEBYLEX", "zl", "-", "+")] == [b"c", b"d"]
+    with pytest.raises(RespError):
+        _x(client, "ZRANGEBYLEX", "zl", "a", "+")  # bare bound invalid
+
+
+def test_zset_combination_reads(client):
+    _x(client, "ZADD", "zc1", 1, "a", 2, "b", 3, "c")
+    _x(client, "ZADD", "zc2", 10, "b")
+    assert [bytes(v) for v in _x(client, "ZDIFF", 2, "zc1", "zc2")] == [b"a", b"c"]
+    flat = _x(client, "ZDIFF", 2, "zc1", "zc2", "WITHSCORES")
+    assert [bytes(v) for v in flat] == [b"a", b"1", b"c", b"3"]
+    assert [bytes(v) for v in _x(client, "ZINTER", 2, "zc1", "zc2")] == [b"b"]
+    flat = _x(client, "ZINTER", 2, "zc1", "zc2", "WITHSCORES")
+    assert [bytes(v) for v in flat] == [b"b", b"12"]
+    assert [bytes(v) for v in _x(client, "ZUNION", 2, "zc1", "zc2")] == [b"a", b"c", b"b"]
+    assert _x(client, "ZDIFFSTORE", "zc:out", 2, "zc1", "zc2") == 2
+    assert _x(client, "ZSCORE", "zc:out", "a") is not None
+
+
+def test_zrangestore(client):
+    for i, m in enumerate(("a", "b", "c", "d")):
+        _x(client, "ZADD", "zrs", i, m)
+    assert _x(client, "ZRANGESTORE", "zrs:idx", "zrs", 1, 2) == 2
+    assert [bytes(v) for v in _x(client, "ZRANGE", "zrs:idx", 0, -1)] == [b"b", b"c"]
+    assert _x(client, "ZRANGESTORE", "zrs:sc", "zrs", 1, 3, "BYSCORE") == 3
+    assert [bytes(v) for v in _x(client, "ZRANGE", "zrs:sc", 0, -1)] == [b"b", b"c", b"d"]
+    assert _x(client, "ZRANGESTORE", "zrs:lex", "zrs", "[b", "[c", "BYLEX") == 2
+    assert _x(client, "ZRANGESTORE", "zrs:lim", "zrs", "-inf", "+inf", "BYSCORE", "LIMIT", 1, 2) == 2
+    with pytest.raises(RespError):
+        _x(client, "ZRANGESTORE", "zrs:bad", "zrs", 0, 1, "LIMIT", 0, 1)
+
+
+def test_multi_pops(client):
+    _x(client, "RPUSH", "mp2", "x", "y", "z")
+    got = _x(client, "LMPOP", 2, "mp1", "mp2", "LEFT", "COUNT", 2)
+    assert bytes(got[0]) == b"mp2" and [bytes(v) for v in got[1]] == [b"x", b"y"]
+    got = _x(client, "LMPOP", 2, "mp1", "mp2", "RIGHT")
+    assert [bytes(v) for v in got[1]] == [b"z"]
+    assert _x(client, "LMPOP", 2, "mp1", "mp2", "LEFT") is None
+    _x(client, "ZADD", "zmp", 1, "lo", 9, "hi")
+    got = _x(client, "ZMPOP", 1, "zmp", "MIN")
+    assert bytes(got[0]) == b"zmp" and [bytes(v) for v in got[1]] == [b"lo", b"1"]
+    got = _x(client, "ZMPOP", 1, "zmp", "MAX", "COUNT", 5)
+    assert [bytes(v) for v in got[1]] == [b"hi", b"9"]
+    assert _x(client, "ZMPOP", 1, "zmp", "MIN") is None
+
+
+def test_blocking_pops(client, server):
+    import threading
+    import time
+
+    # immediate path: element already present
+    _x(client, "RPUSH", "bq", "ready")
+    got = _x(client, "BLPOP", "bq", 1)
+    assert bytes(got[0]) == b"bq" and bytes(got[1]) == b"ready"
+    # timeout path
+    t0 = time.time()
+    assert _x(client, "BLPOP", "bq:empty", 0.2) is None
+    assert time.time() - t0 >= 0.15
+    # parked path: a second connection pushes while we block
+    results = []
+
+    def parked():
+        c2 = RemoteRedisson(server.address, timeout=30.0)
+        try:
+            results.append(_x(c2, "BLPOP", "bq:parked", 10))
+        finally:
+            c2.shutdown()
+
+    t = threading.Thread(target=parked)
+    t.start()
+    time.sleep(0.3)
+    _x(client, "RPUSH", "bq:parked", "wake")
+    t.join(10.0)
+    assert not t.is_alive()
+    assert bytes(results[0][1]) == b"wake"
+
+
+def test_blocking_zset_and_moves(client, server):
+    import threading
+    import time
+
+    _x(client, "ZADD", "bz", 3, "m")
+    got = _x(client, "BZPOPMIN", "bz", 1)
+    assert [bytes(got[0]), bytes(got[1]), bytes(got[2])] == [b"bz", b"m", b"3"]
+    assert _x(client, "BZPOPMAX", "bz", 0.15) is None
+    # BZPOPMIN parked until ZADD from another connection
+    results = []
+
+    def parked():
+        c2 = RemoteRedisson(server.address, timeout=30.0)
+        try:
+            results.append(_x(c2, "BZPOPMIN", "bz:parked", 10))
+        finally:
+            c2.shutdown()
+
+    t = threading.Thread(target=parked)
+    t.start()
+    time.sleep(0.3)
+    _x(client, "ZADD", "bz:parked", 7, "w")
+    t.join(10.0)
+    assert not t.is_alive()
+    assert bytes(results[0][1]) == b"w"
+    # BLMOVE / BRPOPLPUSH immediate paths
+    _x(client, "RPUSH", "bm:src", "a", "b")
+    assert bytes(_x(client, "BLMOVE", "bm:src", "bm:dst", "LEFT", "RIGHT", 1)) == b"a"
+    assert bytes(_x(client, "BRPOPLPUSH", "bm:src", "bm:dst", 1)) == b"b"
+    assert [bytes(v) for v in _x(client, "LRANGE", "bm:dst", 0, -1)] == [b"b", b"a"]
+    assert _x(client, "BLMOVE", "bm:src", "bm:dst", "LEFT", "LEFT", 0.2) is None
+
+
+def test_round3_verbs_route_on_cluster():
+    """Round-3 verbs (COPY/ZDIFF/LMPOP/ZRANGESTORE/BLPOP) route correctly
+    with hashtags on a 2-master cluster."""
+    runner = ClusterRunner(masters=2).run()
+    try:
+        client = runner.client(scan_interval=0)
+        client.execute("SET", "{r3}src", "v")
+        assert int(client.execute("COPY", "{r3}src", "{r3}dst")) == 1
+        assert bytes(client.execute("GET", "{r3}dst")) == b"v"
+        client.execute("ZADD", "{r3}z1", "1", "a", "2", "b")
+        client.execute("ZADD", "{r3}z2", "9", "b")
+        assert [bytes(v) for v in client.execute("ZDIFF", "2", "{r3}z1", "{r3}z2")] == [b"a"]
+        assert int(client.execute("ZRANGESTORE", "{r3}zr", "{r3}z1", "0", "-1")) == 2
+        client.execute("RPUSH", "{r3}q", "x")
+        got = client.execute("LMPOP", "2", "{r3}empty", "{r3}q", "LEFT")
+        assert bytes(got[0]) == b"{r3}q"
+        got = client.execute("BLPOP", "{r3}empty", "0.1")
+        assert got is None
+        client.shutdown()
+    finally:
+        runner.shutdown()
+
+
+def test_copy_device_backed_object_no_alias(client):
+    """Regression: COPY must deep-copy device arrays — kernels mutate
+    records via donated buffers, so a shared reference dies on the next
+    write to either record ("Buffer has been deleted or donated")."""
+    bf = client.get_bloom_filter("cp:bf")
+    bf.try_init(1000, 0.01)
+    bf.add(b"k1")
+    assert _x(client, "COPY", "cp:bf", "cp:bf2") == 1
+    bf2 = client.get_bloom_filter("cp:bf2")
+    assert bf2.contains(b"k1")
+    bf2.add(b"k2")        # mutates the CLONE (donates its buffer)
+    assert bf.contains(b"k1")       # source still serves
+    assert not bf.contains(b"k2")   # and was not aliased
+    bf.add(b"k3")         # mutate the SOURCE: clone unaffected
+    assert not bf2.contains(b"k3")
+
+
+def test_zcombo_weights_aggregate_and_strict_syntax(client):
+    _x(client, "ZADD", "zw1", 1, "a", 2, "b")
+    _x(client, "ZADD", "zw2", 10, "b")
+    flat = _x(client, "ZUNION", 2, "zw1", "zw2", "WEIGHTS", 2, 3, "WITHSCORES")
+    pairs = {bytes(flat[i]): float(flat[i + 1]) for i in range(0, len(flat), 2)}
+    assert pairs == {b"a": 2.0, b"b": 34.0}
+    flat = _x(client, "ZINTER", 2, "zw1", "zw2", "AGGREGATE", "MAX", "WITHSCORES")
+    assert [bytes(v) for v in flat] == [b"b", b"10"]
+    with pytest.raises(RespError, match="syntax"):
+        _x(client, "ZUNION", 2, "zw1", "zw2", "WITHSCORE")  # typo must error
+    with pytest.raises(RespError, match="syntax"):
+        _x(client, "ZDIFF", 2, "zw1", "zw2", "WEIGHTS", 1, 1)  # no modifiers on ZDIFF

@@ -254,3 +254,22 @@ def test_remote_map_cache_max_size(single):
     mc.put("b", 2)
     mc.put("c", 3)
     assert mc.size() == 2
+
+
+def test_cluster_map_cache_entry_listener(clustered):
+    """Regression: remote entry listeners must subscribe on the shard that
+    owns the MAP — the channel string hashes to a different slot."""
+    import time as _time
+
+    mc = clustered.get_map_cache("clmc")
+    events = []
+    token = mc.add_entry_listener("created", lambda k, v, o: events.append((k, v)))
+    try:
+        _time.sleep(0.2)
+        mc.put("k", "v")
+        deadline = _time.time() + 5.0
+        while _time.time() < deadline and not events:
+            _time.sleep(0.02)
+        assert events == [("k", "v")]
+    finally:
+        mc.remove_entry_listener(token)
