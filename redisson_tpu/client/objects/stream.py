@@ -275,18 +275,27 @@ class Stream(RExpirable):
                     break
         return out
 
-    def claim(self, group: str, consumer: str, min_idle: float, *ids: str) -> Dict[str, Dict]:
-        """XCLAIM: transfer ownership of idle pending entries."""
+    def claim(
+        self, group: str, consumer: str, min_idle: float, *ids: str, force: bool = False
+    ) -> Dict[str, Dict]:
+        """XCLAIM: transfer ownership of idle pending entries.  `force`
+        creates a PEL entry for an existing stream entry that nobody has
+        delivered yet (XCLAIM FORCE semantics)."""
         targets = [parse_id(i) for i in ids]
         now = time.time()
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
             g = self._group(rec, group)
+            g["consumers"].setdefault(consumer, now)  # XCLAIM auto-creates
             entries = {i: f for i, f in rec.host["entries"]}
             out = {}
             for eid in targets:
                 cell = g["pel"].get(eid)
-                if cell is None or now - cell[1] < min_idle:
+                if cell is None:
+                    if not (force and eid in entries):
+                        continue
+                    cell = [consumer, 0.0, 0]  # fresh forced claim
+                elif now - cell[1] < min_idle:
                     continue
                 g["pel"][eid] = [consumer, now, cell[2] + 1]
                 if eid in entries:
@@ -304,6 +313,7 @@ class Stream(RExpirable):
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
             g = self._group(rec, group)
+            g["consumers"].setdefault(consumer, now)  # XAUTOCLAIM auto-creates
             entries = {i: f for i, f in rec.host["entries"]}
             out = {}
             cursor = (0, 0)
@@ -338,6 +348,17 @@ class Stream(RExpirable):
             "max_id": fmt_id(ids[-1]) if ids else None,
             "consumers": per,
         }
+
+    def create_consumer(self, group: str, consumer: str) -> bool:
+        """XGROUP CREATECONSUMER; True if the consumer is new."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            g = self._group(rec, group)
+            fresh = consumer not in g["consumers"]
+            g["consumers"].setdefault(consumer, time.time())
+            if fresh:
+                self._touch_version(rec)
+            return fresh
 
     def remove_consumer(self, group: str, consumer: str) -> int:
         """XGROUP DELCONSUMER: drop a consumer, DISCARDING its pending

@@ -31,6 +31,10 @@ SAME_SLOT = {
     "SINTER", "SUNION", "SDIFF",
     "SINTERSTORE", "SUNIONSTORE", "SDIFFSTORE", "SINTERCARD",
     "ZUNIONSTORE", "ZINTERSTORE",
+    "COPY", "RENAMENX", "SORT", "GEOSEARCHSTORE",
+    "ZDIFF", "ZINTER", "ZUNION", "ZDIFFSTORE", "ZRANGESTORE",
+    "LMPOP", "ZMPOP", "BLPOP", "BRPOP", "BLMOVE", "BRPOPLPUSH",
+    "BZPOPMIN", "BZPOPMAX", "XREAD", "XREADGROUP",
 }
 # (MGET/MSET follow real Redis cluster semantics: multi-key commands
 #  spanning slots raise CROSSSLOT; use {hashtags} or the RBuckets

@@ -109,6 +109,18 @@ SPECS["ZDIFFSTORE"] = CommandSpec("ZDIFFSTORE", True, 0, numkeys_at=1)
 SPECS["LMPOP"] = CommandSpec("LMPOP", True, None, numkeys_at=0)
 SPECS["ZMPOP"] = CommandSpec("ZMPOP", True, None, numkeys_at=0)
 
+# typed stream + geo verbs
+_spec(SPECS, "XLEN XRANGE XREVRANGE XPENDING GEOPOS GEODIST GEOSEARCH", False, 0)
+_spec(SPECS, "XADD XDEL XTRIM XACK XCLAIM XAUTOCLAIM GEOADD", True, 0)
+# XINFO <STREAM|GROUPS|CONSUMERS> <key>, XGROUP <sub> <key> — key at index 1
+_spec(SPECS, "XINFO", False, 1)
+_spec(SPECS, "XGROUP", True, 1)
+SPECS["GEOSEARCHSTORE"] = CommandSpec("GEOSEARCHSTORE", True, 0, multi_key=True, key_count=2)
+# XREAD/XREADGROUP key lists follow the STREAMS marker — extracted by a
+# dedicated branch in command_keys (not expressible as a static position)
+_spec(SPECS, "XREAD", False, None)
+_spec(SPECS, "XREADGROUP", True, None)
+
 # multi-key
 _spec(SPECS, "DEL UNLINK", True, 0, multi_key=True)
 _spec(SPECS, "RENAME", True, 0, multi_key=True)
@@ -147,6 +159,25 @@ def command_keys(cmd: str, args: List[bytes]) -> List[bytes]:
     spec = lookup(cmd)
     if spec is None:
         return []
+    if spec.name in ("XREAD", "XREADGROUP", "SORT"):
+        # markers may arrive as str (client-side routing) or bytes (wire)
+        uppers = [
+            (bytes(a) if isinstance(a, (bytes, bytearray)) else str(a).encode()).upper()
+            for a in args
+        ]
+        if spec.name == "SORT":
+            # the STORE destination is a key too — omitting it would let a
+            # cluster write the result onto whichever node owns the source
+            keys = [args[0]] if args else []
+            for j, u in enumerate(uppers):
+                if u == b"STORE" and j + 1 < len(args):
+                    keys.append(args[j + 1])
+            return keys
+        # XREAD/XREADGROUP: keys are the first half after the STREAMS marker
+        if b"STREAMS" not in uppers:
+            return []
+        rest = args[uppers.index(b"STREAMS") + 1 :]
+        return list(rest[: len(rest) // 2])
     if spec.numkeys_at is not None:
         if len(args) <= spec.numkeys_at:
             return []

@@ -82,7 +82,7 @@ def _force_lazies(results: list, server) -> None:
 _SLOW_COMMANDS = frozenset(
     b.encode() for b in (
         "OBJCALL", "OBJCALLM", "OBJCALLMA", "BLPOP", "BRPOP", "BLMOVE",
-        "BRPOPLPUSH", "BZPOPMIN", "BZPOPMAX",
+        "BRPOPLPUSH", "BZPOPMIN", "BZPOPMAX", "XREAD", "XREADGROUP",
     )
 )
 

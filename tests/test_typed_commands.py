@@ -613,3 +613,231 @@ def test_zcombo_weights_aggregate_and_strict_syntax(client):
         _x(client, "ZUNION", 2, "zw1", "zw2", "WITHSCORE")  # typo must error
     with pytest.raises(RespError, match="syntax"):
         _x(client, "ZDIFF", 2, "zw1", "zw2", "WEIGHTS", 1, 1)  # no modifiers on ZDIFF
+
+
+# -- typed stream verbs -------------------------------------------------------
+
+def test_xadd_xrange_xlen(client):
+    id1 = bytes(_x(client, "XADD", "st", "*", "a", "1"))
+    id2 = bytes(_x(client, "XADD", "st", "*", "b", "2"))
+    assert id1 < id2
+    assert _x(client, "XLEN", "st") == 2
+    rows = _x(client, "XRANGE", "st", "-", "+")
+    assert [bytes(r[0]) for r in rows] == [id1, id2]
+    assert [bytes(v) for v in rows[0][1]] == [b"a", b"1"]
+    rows = _x(client, "XREVRANGE", "st", "+", "-", "COUNT", 1)
+    assert bytes(rows[0][0]) == id2
+    # explicit id + monotonicity error
+    _x(client, "XADD", "st2", "5-1", "f", "v")
+    with pytest.raises(RespError):
+        _x(client, "XADD", "st2", "5-1", "f", "v")
+    # NOMKSTREAM on a missing stream
+    assert _x(client, "XADD", "st:none", "NOMKSTREAM", "*", "f", "v") is None
+    assert _x(client, "EXISTS", "st:none") == 0
+    # MAXLEN trim inline
+    for i in range(5):
+        _x(client, "XADD", "st3", "MAXLEN", 3, "*", "i", str(i))
+    assert _x(client, "XLEN", "st3") == 3
+
+
+def test_xdel_xtrim(client):
+    ids = [bytes(_x(client, "XADD", "xt", "*", "i", str(i))) for i in range(4)]
+    assert _x(client, "XDEL", "xt", ids[0].decode()) == 1
+    assert _x(client, "XLEN", "xt") == 3
+    assert _x(client, "XTRIM", "xt", "MAXLEN", "~", 1) == 2
+    assert _x(client, "XLEN", "xt") == 1
+
+
+def test_xread(client):
+    _x(client, "XADD", "xr", "1-1", "f", "v1")
+    _x(client, "XADD", "xr", "2-1", "f", "v2")
+    out = _x(client, "XREAD", "COUNT", 10, "STREAMS", "xr", "0")
+    assert bytes(out[0][0]) == b"xr" and len(out[0][1]) == 2
+    out = _x(client, "XREAD", "STREAMS", "xr", "1-1")
+    assert [bytes(r[0]) for r in out[0][1]] == [b"2-1"]
+    assert _x(client, "XREAD", "STREAMS", "xr", "2-1") is None
+    assert _x(client, "XREAD", "BLOCK", 100, "STREAMS", "xr", "$") is None
+
+
+def test_xread_blocking_wakeup(client, server):
+    import threading
+    import time as _t
+
+    got = []
+
+    def parked():
+        c2 = RemoteRedisson(server.address, timeout=30.0)
+        try:
+            got.append(_x(c2, "XREAD", "BLOCK", 10000, "STREAMS", "xbw", "$"))
+        finally:
+            c2.shutdown()
+
+    t = threading.Thread(target=parked)
+    t.start()
+    _t.sleep(0.3)
+    _x(client, "XADD", "xbw", "*", "f", "wake")
+    t.join(10.0)
+    assert not t.is_alive()
+    assert bytes(got[0][0][0]) == b"xbw"
+    assert [bytes(v) for v in got[0][0][1][0][1]] == [b"f", b"wake"]
+
+
+def test_consumer_group_lifecycle(client):
+    for i in range(3):
+        _x(client, "XADD", "xg", f"{i+1}-1", "i", str(i))
+    assert _x(client, "XGROUP", "CREATE", "xg", "g1", "0") is not None
+    out = _x(client, "XREADGROUP", "GROUP", "g1", "c1", "COUNT", 2, "STREAMS", "xg", ">")
+    assert len(out[0][1]) == 2
+    # pending summary: 2 entries on c1
+    s = _x(client, "XPENDING", "xg", "g1")
+    assert s[0] == 2 and bytes(s[1]) == b"1-1" and bytes(s[2]) == b"2-1"
+    assert [bytes(s[3][0][0]), bytes(s[3][0][1])] == [b"c1", b"2"]
+    # extended form
+    rows = _x(client, "XPENDING", "xg", "g1", "-", "+", 10)
+    assert len(rows) == 2 and bytes(rows[0][1]) == b"c1"
+    # ack one
+    assert _x(client, "XACK", "xg", "g1", "1-1") == 1
+    assert _x(client, "XPENDING", "xg", "g1")[0] == 1
+    # claim the other into c2 (0 idle threshold)
+    claimed = _x(client, "XCLAIM", "xg", "g1", "c2", 0, "2-1")
+    assert bytes(claimed[0][0]) == b"2-1"
+    rows = _x(client, "XPENDING", "xg", "g1", "-", "+", 10, "c2")
+    assert len(rows) == 1
+    # autoclaim back to c3
+    cur, body, _deleted = _x(client, "XAUTOCLAIM", "xg", "g1", "c3", 0, "0")
+    assert bytes(body[0][0]) == b"2-1"
+    # consumers / groups info
+    info = _x(client, "XINFO", "GROUPS", "xg")
+    assert bytes(info[0][1]) == b"g1"
+    consumers = _x(client, "XINFO", "CONSUMERS", "xg", "g1")
+    assert len(consumers) >= 2
+    assert _x(client, "XGROUP", "CREATECONSUMER", "xg", "g1", "cX") == 1
+    assert _x(client, "XGROUP", "CREATECONSUMER", "xg", "g1", "cX") == 0
+    assert _x(client, "XGROUP", "DELCONSUMER", "xg", "g1", "c3") == 1  # pending discarded
+    stream_info = _x(client, "XINFO", "STREAM", "xg")
+    kv = {bytes(stream_info[i]): stream_info[i + 1] for i in range(0, len(stream_info), 2)}
+    assert kv[b"length"] == 3 and kv[b"groups"] == 1
+    assert _x(client, "XGROUP", "DESTROY", "xg", "g1") == 1
+
+
+def test_xreadgroup_noack_and_reread(client):
+    _x(client, "XADD", "xn", "1-1", "f", "v")
+    _x(client, "XGROUP", "CREATE", "xn", "g", "0")
+    _x(client, "XREADGROUP", "GROUP", "g", "c", "NOACK", "STREAMS", "xn", ">")
+    assert _x(client, "XPENDING", "xn", "g")[0] == 0  # NOACK: nothing pending
+    assert _x(client, "XREADGROUP", "GROUP", "g", "c", "STREAMS", "xn", ">") is None
+
+
+# -- typed geo verbs ----------------------------------------------------------
+
+def test_geo_verbs(client):
+    assert _x(client, "GEOADD", "geo",
+              13.361389, 38.115556, "Palermo",
+              15.087269, 37.502669, "Catania") == 2
+    pos = _x(client, "GEOPOS", "geo", "Palermo", "missing")
+    assert abs(float(pos[0][0]) - 13.361389) < 1e-6
+    assert pos[1] is None
+    d_m = float(_x(client, "GEODIST", "geo", "Palermo", "Catania"))
+    assert 160_000 < d_m < 170_000
+    d_km = float(_x(client, "GEODIST", "geo", "Palermo", "Catania", "km"))
+    assert abs(d_km - d_m / 1000) < 0.5
+    assert _x(client, "GEODIST", "geo", "Palermo", "missing") is None
+    # search around Sicily: both cities in 200km
+    got = _x(client, "GEOSEARCH", "geo", "FROMLONLAT", 15, 37, "BYRADIUS", 200, "km", "ASC")
+    assert [bytes(m) for m in got] == [b"Catania", b"Palermo"]
+    got = _x(client, "GEOSEARCH", "geo", "FROMMEMBER", "Palermo", "BYRADIUS", 1, "km")
+    assert [bytes(m) for m in got] == [b"Palermo"]
+    rows = _x(client, "GEOSEARCH", "geo", "FROMLONLAT", 15, 37,
+              "BYRADIUS", 200, "km", "ASC", "COUNT", 1, "WITHCOORD", "WITHDIST")
+    assert bytes(rows[0][0]) == b"Catania"
+    assert float(rows[0][1]) > 0 and abs(float(rows[0][2][0]) - 15.087269) < 1e-6
+    box = _x(client, "GEOSEARCH", "geo", "FROMLONLAT", 15.05, 37.5, "BYBOX", 40, 40, "km")
+    assert [bytes(m) for m in box] == [b"Catania"]
+    assert _x(client, "GEOSEARCHSTORE", "geo:near", "geo",
+              "FROMLONLAT", 15, 37, "BYRADIUS", 200, "km") == 2
+
+
+def test_stream_error_shapes(client):
+    """BUSYGROUP / NOGROUP reach clients verbatim (pattern-matchable)."""
+    _x(client, "XADD", "xe", "*", "f", "v")
+    _x(client, "XGROUP", "CREATE", "xe", "g", "0")
+    with pytest.raises(RespError, match="^BUSYGROUP"):
+        _x(client, "XGROUP", "CREATE", "xe", "g", "0")
+    with pytest.raises(RespError, match="^NOGROUP"):
+        _x(client, "XREADGROUP", "GROUP", "nope", "c", "STREAMS", "xe", ">")
+    with pytest.raises(RespError, match="^NOGROUP"):
+        _x(client, "XPENDING", "xe", "nope")
+
+
+def test_xclaim_force_and_options(client):
+    _x(client, "XADD", "xf", "1-1", "f", "v")
+    _x(client, "XGROUP", "CREATE", "xf", "g", "0")
+    # entry never delivered: plain claim skips it, FORCE claims it
+    assert _x(client, "XCLAIM", "xf", "g", "c", 0, "1-1") == []
+    claimed = _x(client, "XCLAIM", "xf", "g", "c", 0, "1-1", "FORCE")
+    assert bytes(claimed[0][0]) == b"1-1"
+    assert _x(client, "XPENDING", "xf", "g")[0] == 1
+    # metadata options are accepted, JUSTID returns ids only
+    got = _x(client, "XCLAIM", "xf", "g", "c2", 0, "1-1", "RETRYCOUNT", 5, "JUSTID")
+    assert [bytes(i) for i in got] == [b"1-1"]
+
+
+def test_xpending_idle_filters_before_count(client):
+    _x(client, "XGROUP", "CREATE", "xi", "g", "0", "MKSTREAM")
+    for i in range(4):
+        _x(client, "XADD", "xi", f"{i+1}-1", "f", "v")
+    _x(client, "XREADGROUP", "GROUP", "g", "c", "STREAMS", "xi", ">")
+    # all 4 pending with ~0 idle: a high idle floor must yield [] rather
+    # than silently dropping young rows after counting
+    assert _x(client, "XPENDING", "xi", "g", "IDLE", 60000, "-", "+", 2) == []
+    rows = _x(client, "XPENDING", "xi", "g", "IDLE", 0, "-", "+", 2)
+    assert len(rows) == 2
+
+
+def test_bitpos_ranges(client):
+    _x(client, "SETBIT", "bp2", 12, 1)  # byte 1, bit 4
+    assert _x(client, "BITPOS", "bp2", 1) == 12
+    assert _x(client, "BITPOS", "bp2", 1, 1) == 12
+    assert _x(client, "BITPOS", "bp2", 1, 2) == -1
+    assert _x(client, "BITPOS", "bp2", 0, 1) == 8
+    # all-ones byte: bit-0 search runs past the end without explicit end
+    for i in range(8):
+        _x(client, "SETBIT", "bp3", i, 1)
+    assert _x(client, "BITPOS", "bp3", 0) == 8
+    assert _x(client, "BITPOS", "bp3", 0, 0, 0) == -1
+    with pytest.raises(RespError, match="syntax"):
+        _x(client, "BITPOS", "bp3", 0, 0, 0, "BIT")
+
+
+def test_geosearch_bybox_distances(client):
+    _x(client, "GEOADD", "geob", 15.087269, 37.502669, "Catania",
+       15.051, 37.505, "nearer")  # ~0.6km from center vs Catania's ~3.3km
+    rows = _x(client, "GEOSEARCH", "geob", "FROMLONLAT", 15.05, 37.5,
+              "BYBOX", 60, 60, "km", "ASC", "WITHDIST")
+    assert bytes(rows[0][0]) == b"nearer"
+    assert 0 < float(rows[0][1]) < float(rows[1][1])
+    rows_desc = _x(client, "GEOSEARCH", "geob", "FROMLONLAT", 15.05, 37.5,
+                   "BYBOX", 60, 60, "km", "DESC", "COUNT", 1)
+    assert bytes(rows_desc[0]) == b"Catania"
+
+
+def test_mpop_count_syntax_guard(client):
+    _x(client, "RPUSH", "mpg", "a")
+    with pytest.raises(RespError, match="syntax"):
+        _x(client, "LMPOP", 1, "mpg", "LEFT", "COUNT")
+    with pytest.raises(RespError, match="syntax"):
+        _x(client, "ZMPOP", 1, "mpg", "MIN", "COUNT")
+
+
+def test_sort_store_routes_as_key_on_cluster():
+    runner = ClusterRunner(masters=2).run()
+    try:
+        client = runner.client(scan_interval=0)
+        client.execute("RPUSH", "{s3}l", "2", "1")
+        assert int(client.execute("SORT", "{s3}l", "STORE", "{s3}out")) == 2
+        assert [bytes(v) for v in client.execute("LRANGE", "{s3}out", 0, -1)] == [b"1", b"2"]
+        with pytest.raises(RespError, match="CROSSSLOT"):
+            client.execute("SORT", "s3-aaa", "STORE", "s3-bbb")
+        client.shutdown()
+    finally:
+        runner.shutdown()
