@@ -121,6 +121,17 @@ SPECS["GEOSEARCHSTORE"] = CommandSpec("GEOSEARCHSTORE", True, 0, multi_key=True,
 _spec(SPECS, "XREAD", False, None)
 _spec(SPECS, "XREADGROUP", True, None)
 
+# redis-stack module verbs: JSON documents route by key; FT indexes are
+# not keyspace keys (RediSearch coordinates cluster-side), so FT.* is
+# keyless — served by whichever node the client drives
+_spec(SPECS, "JSON.GET JSON.TYPE JSON.STRLEN JSON.ARRLEN JSON.ARRINDEX "
+             "JSON.OBJKEYS JSON.OBJLEN", False, 0)
+_spec(SPECS, "JSON.SET JSON.DEL JSON.NUMINCRBY JSON.STRAPPEND JSON.ARRAPPEND "
+             "JSON.ARRINSERT JSON.ARRPOP JSON.ARRTRIM JSON.CLEAR JSON.TOGGLE "
+             "JSON.MERGE", True, 0)
+_spec(SPECS, "FT.SEARCH FT.AGGREGATE FT.INFO FT._LIST", False, None)
+_spec(SPECS, "FT.CREATE FT.DROPINDEX", True, None)
+
 # multi-key
 _spec(SPECS, "DEL UNLINK", True, 0, multi_key=True)
 _spec(SPECS, "RENAME", True, 0, multi_key=True)

@@ -179,10 +179,26 @@ class _NumericPlane:
 class SearchIndex:
     """One FT index: schema + doc table + inverted/tag/numeric planes."""
 
-    def __init__(self, name: str, schema: Dict[str, str], prefixes: Sequence[str] = ("",)):
+    def __init__(
+        self,
+        name: str,
+        schema: Dict[str, str],
+        prefixes: Sequence[str] = ("",),
+        doc_mode: str = "entry",
+    ):
         self.name = name
         self.schema = dict(schema)
         self.prefixes = list(prefixes)
+        # document model for auto-ingestion (SearchService.sync):
+        #   "entry" — one doc per dict-valued map ENTRY, id "{map}:{key}"
+        #             (the embedded facade's historical model)
+        #   "hash"  — one doc per map RECORD, id = map name (RediSearch's
+        #             ON HASH model, used by the FT.* wire verbs)
+        # One model per index: the two disagree on doc identity, and mixing
+        # them through the shared version stamps would suppress each other.
+        if doc_mode not in ("entry", "hash"):
+            raise ValueError(f"unknown doc_mode {doc_mode!r}")
+        self.doc_mode = doc_mode
         self.docs: Dict[str, Dict[str, Any]] = {}          # doc_id -> fields
         self._rowid: Dict[str, int] = {}                   # doc_id -> numeric row
         self._rowdoc: List[Optional[str]] = []             # row -> doc_id
@@ -325,11 +341,12 @@ class SearchService:
         name: str,
         schema: Dict[str, str],
         prefixes: Sequence[str] = ("",),
+        doc_mode: str = "entry",
     ) -> SearchIndex:
         with self._lock:
             if name in self._indexes:
                 raise ValueError(f"index '{name}' already exists")
-            idx = SearchIndex(name, schema, prefixes)
+            idx = SearchIndex(name, schema, prefixes, doc_mode)
             self._indexes[name] = idx
         self.sync(name)
         return idx
@@ -339,10 +356,11 @@ class SearchService:
         name: str,
         schema: Dict[str, str],
         prefixes: Sequence[str] = ("",),
+        doc_mode: str = "entry",
     ) -> bool:
         """Wire-friendly FT.CREATE (returns a plain bool so it survives the
         OBJCALL pickle boundary; `create_index` returns the live index)."""
-        self.create_index(name, schema, prefixes)
+        self.create_index(name, schema, prefixes, doc_mode)
         return True
 
     def drop_index(self, name: str) -> bool:
@@ -380,25 +398,53 @@ class SearchService:
     def sync(self, name: str) -> int:
         """Pull documents from every map whose name matches a prefix — the
         reference's hash auto-indexing, done as a version-diffed scan (maps
-        whose record version is unchanged are skipped)."""
+        whose record version is unchanged are skipped).  The index's
+        doc_mode decides the document model (see SearchIndex.__init__)."""
         idx = self._idx(name)
         from redisson_tpu.client.objects.map import Map
 
         n = 0
+        seen = set()
         for key in self._engine.store.keys():
             if not any(key.startswith(p) for p in idx.prefixes):
                 continue
             rec = self._engine.store.get(key)
             if rec is None or rec.kind not in ("map", "map_cache"):
                 continue
+            seen.add(key)
             if idx._synced_versions.get(key) == rec.version:
                 continue
-            m = Map(self._engine, key)
-            for k, v in m.read_all_entry_set():
-                if isinstance(v, dict):
-                    idx.add(f"{key}:{k}", v)
-                    n += 1
+            if idx.doc_mode == "hash":
+                # wire hashes hold RAW bytes (typed HSET surface): read
+                # through BytesCodec, decode to str below
+                from redisson_tpu.client.codec import BytesCodec
+
+                m = Map(self._engine, key, codec=BytesCodec())
+                fields = {}
+                for k, v in m.read_all_entry_set():
+                    ks = k.decode() if isinstance(k, (bytes, bytearray)) else str(k)
+                    vs = v.decode() if isinstance(v, (bytes, bytearray)) else v
+                    if idx.schema.get(ks) == FieldType.NUMERIC:
+                        try:
+                            vs = float(vs)
+                        except (TypeError, ValueError):
+                            pass
+                    fields[ks] = vs
+                idx.add(key, fields)
+                n += 1
+            else:
+                for k, v in Map(self._engine, key).read_all_entry_set():
+                    if isinstance(v, dict):
+                        idx.add(f"{key}:{k}", v)
+                        n += 1
             idx._synced_versions[key] = rec.version
+        if idx.doc_mode == "hash":
+            # deleted hashes leave the store silently; prune their docs or
+            # searches keep serving stale fields forever
+            for gone in [d for d in list(idx.docs) if d not in seen]:
+                idx.remove(gone)
+                idx._synced_versions.pop(gone, None)
+                n += 1
         return n
 
     # -- FT.SEARCH -----------------------------------------------------------

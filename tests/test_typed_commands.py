@@ -841,3 +841,137 @@ def test_sort_store_routes_as_key_on_cluster():
         client.shutdown()
     finally:
         runner.shutdown()
+
+
+# -- redis-stack module verbs -------------------------------------------------
+
+def test_json_verbs(client):
+    import json
+
+    assert _x(client, "JSON.SET", "jd", "$", json.dumps({"a": {"b": [1, 2]}, "s": "hi", "n": 4})) is not None
+    assert json.loads(_x(client, "JSON.GET", "jd", "$.a.b")) == [1, 2]
+    assert json.loads(_x(client, "JSON.GET", "jd")) == {"a": {"b": [1, 2]}, "s": "hi", "n": 4}
+    multi = json.loads(_x(client, "JSON.GET", "jd", "$.s", "$.n"))
+    assert multi == {"$.s": "hi", "$.n": 4}
+    assert bytes(_x(client, "JSON.TYPE", "jd", "$.a")) == b"object"
+    assert json.loads(_x(client, "JSON.NUMINCRBY", "jd", "$.n", "2.5")) == 6.5
+    assert _x(client, "JSON.STRAPPEND", "jd", "$.s", json.dumps("!")) == 3
+    assert _x(client, "JSON.STRLEN", "jd", "$.s") == 3
+    assert _x(client, "JSON.ARRAPPEND", "jd", "$.a.b", "3", "4") == 4
+    assert _x(client, "JSON.ARRINSERT", "jd", "$.a.b", 0, "0") == 5
+    assert _x(client, "JSON.ARRLEN", "jd", "$.a.b") == 5
+    assert _x(client, "JSON.ARRINDEX", "jd", "$.a.b", "3") == 3
+    assert json.loads(_x(client, "JSON.ARRPOP", "jd", "$.a.b")) == 4
+    assert _x(client, "JSON.ARRTRIM", "jd", "$.a.b", 1, 2) == 2
+    assert json.loads(_x(client, "JSON.GET", "jd", "$.a.b")) == [1, 2]
+    keys = [bytes(k) for k in _x(client, "JSON.OBJKEYS", "jd")]
+    assert sorted(keys) == [b"a", b"n", b"s"]
+    assert _x(client, "JSON.OBJLEN", "jd") == 3
+    # NX/XX conditions
+    assert _x(client, "JSON.SET", "jd", "$.s", json.dumps("no"), "NX") is None
+    assert _x(client, "JSON.SET", "jd", "$.zz", json.dumps(1), "XX") is None
+    assert _x(client, "JSON.SET", "jd", "$.zz", json.dumps(1), "NX") is not None
+    # toggle / clear / merge / del
+    _x(client, "JSON.SET", "jt", "$", json.dumps({"flag": True, "arr": [1, 2]}))
+    assert _x(client, "JSON.TOGGLE", "jt", "$.flag") == 0
+    assert _x(client, "JSON.CLEAR", "jt", "$.arr") == 1
+    assert json.loads(_x(client, "JSON.GET", "jt", "$.arr")) == []
+    _x(client, "JSON.MERGE", "jt", "$", json.dumps({"extra": 9}))
+    assert json.loads(_x(client, "JSON.GET", "jt", "$.extra")) == 9
+    assert _x(client, "JSON.DEL", "jt", "$.extra") == 1
+    assert _x(client, "JSON.GET", "jt", "$.extra") is None
+
+
+def test_ft_verbs(client):
+    assert _x(client, "FT.CREATE", "idx1", "ON", "HASH", "PREFIX", 1, "prod:",
+              "SCHEMA", "title", "TEXT", "price", "NUMERIC", "SORTABLE",
+              "cat", "TAG") is not None
+    with pytest.raises(RespError):
+        _x(client, "FT.CREATE", "idx1", "SCHEMA", "x", "TEXT")  # dup index
+    _x(client, "HSET", "prod:1", "title", "red shirt", "price", "10", "cat", "wear")
+    _x(client, "HSET", "prod:2", "title", "blue shirt", "price", "25", "cat", "wear")
+    _x(client, "HSET", "prod:3", "title", "red shoe", "price", "50", "cat", "shoes")
+    _x(client, "HSET", "other:9", "title", "not indexed", "price", "1")
+    # match-all + total
+    out = _x(client, "FT.SEARCH", "idx1", "*")
+    assert out[0] == 3
+    # text AND
+    out = _x(client, "FT.SEARCH", "idx1", "@title:red", "NOCONTENT")
+    assert out[0] == 2 and sorted(bytes(d) for d in out[1:]) == [b"prod:1", b"prod:3"]
+    out = _x(client, "FT.SEARCH", "idx1", "red shirt", "NOCONTENT")
+    assert out[0] == 1 and bytes(out[1]) == b"prod:1"
+    # numeric range incl. exclusive bound
+    out = _x(client, "FT.SEARCH", "idx1", "@price:[10 25]", "NOCONTENT")
+    assert out[0] == 2
+    out = _x(client, "FT.SEARCH", "idx1", "@price:[(10 25]", "NOCONTENT")
+    assert out[0] == 1 and bytes(out[1]) == b"prod:2"
+    # tag set
+    out = _x(client, "FT.SEARCH", "idx1", "@cat:{shoes|hats}", "NOCONTENT")
+    assert out[0] == 1 and bytes(out[1]) == b"prod:3"
+    # sort + limit + content shape
+    out = _x(client, "FT.SEARCH", "idx1", "*", "SORTBY", "price", "DESC", "LIMIT", 0, 2)
+    assert out[0] == 3 and bytes(out[1]) == b"prod:3"
+    fields = {bytes(out[2][i]): bytes(out[2][i + 1]) for i in range(0, len(out[2]), 2)}
+    assert fields[b"price"] == b"50.0"
+    # updates re-sync by version diff
+    _x(client, "HSET", "prod:1", "price", "99")
+    out = _x(client, "FT.SEARCH", "idx1", "@price:[99 99]", "NOCONTENT")
+    assert out[0] == 1 and bytes(out[1]) == b"prod:1"
+    # info / list
+    info = _x(client, "FT.INFO", "idx1")
+    kv = {bytes(info[i]): info[i + 1] for i in range(0, len(info), 2)}
+    assert kv[b"num_docs"] == 3  # prod:1..3; other:9 misses the prefix
+    assert b"idx1" in [bytes(n) for n in _x(client, "FT._LIST")]
+
+
+def test_ft_aggregate(client):
+    _x(client, "FT.CREATE", "agg1", "PREFIX", 1, "sale:",
+       "SCHEMA", "region", "TAG", "amount", "NUMERIC")
+    for i, (region, amt) in enumerate([("eu", 10), ("eu", 30), ("us", 5)]):
+        _x(client, "HSET", f"sale:{i}", "region", region, "amount", str(amt))
+    out = _x(client, "FT.AGGREGATE", "agg1", "*",
+             "GROUPBY", 1, "@region",
+             "REDUCE", "SUM", 1, "@amount", "AS", "total",
+             "REDUCE", "COUNT", 0, "AS", "n",
+             "SORTBY", 2, "@total", "DESC")
+    assert out[0] == 2
+    row0 = {bytes(out[1][i]): bytes(out[1][i + 1]) for i in range(0, len(out[1]), 2)}
+    assert row0[b"region"] == b"eu" and float(row0[b"total"]) == 40.0 and row0[b"n"] == b"2"
+    with pytest.raises(RespError, match="Unknown Index"):
+        _x(client, "FT.SEARCH", "nope", "*")
+    assert _x(client, "FT.DROPINDEX", "agg1") is not None
+
+
+def test_ft_indexes_hashes_created_before_index(client):
+    """Regression: FT.CREATE must ingest already-existing hashes (the
+    service's entry-model sync used to stamp versions while indexing
+    nothing, hiding them forever)."""
+    _x(client, "HSET", "pre:1", "title", "old hash", "price", "7")
+    _x(client, "FT.CREATE", "preidx", "PREFIX", 1, "pre:",
+       "SCHEMA", "title", "TEXT", "price", "NUMERIC")
+    out = _x(client, "FT.SEARCH", "preidx", "@title:old", "NOCONTENT")
+    assert out[0] == 1 and bytes(out[1]) == b"pre:1"
+    out = _x(client, "FT.SEARCH", "preidx", "@price:[7 7]", "NOCONTENT")
+    assert out[0] == 1
+
+
+def test_ft_prunes_deleted_hashes(client):
+    """Regression: a DELed hash must leave the index, not serve stale docs."""
+    _x(client, "FT.CREATE", "delidx", "PREFIX", 1, "dl:", "SCHEMA", "t", "TEXT")
+    _x(client, "HSET", "dl:1", "t", "alive")
+    _x(client, "HSET", "dl:2", "t", "doomed")
+    assert _x(client, "FT.SEARCH", "delidx", "*")[0] == 2
+    _x(client, "DEL", "dl:2")
+    out = _x(client, "FT.SEARCH", "delidx", "*", "NOCONTENT")
+    assert out[0] == 1 and bytes(out[1]) == b"dl:1"
+
+
+def test_ft_malformed_queries_are_syntax_errors(client):
+    _x(client, "FT.CREATE", "errq", "PREFIX", 1, "eq:", "SCHEMA",
+       "p", "NUMERIC", "c", "TAG")
+    with pytest.raises(RespError, match="syntax"):
+        _x(client, "FT.SEARCH", "errq", "@p:[abc 5]")
+    with pytest.raises(RespError, match="syntax"):
+        _x(client, "FT.SEARCH", "errq", "@c:{}")
+    with pytest.raises(RespError, match="syntax"):
+        _x(client, "FT.CREATE", "errq2", "ON")
