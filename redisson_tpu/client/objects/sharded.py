@@ -249,3 +249,149 @@ class ShardedHllArray(_ShardedBase):
             regs = self._mgr.ensure_state(rec, "regs", HLL_SPEC)
             rec.arrays["regs"] = regs.at[tenant_id].set(jnp.uint8(0))
             self._touch_version(rec)
+
+
+BITSET_SPEC = P(SHARD_AXIS)        # (m,): columns sharded
+
+
+class ShardedBitSet(_ShardedBase):
+    """ONE logical RBitSet column-sharded across the mesh — wider than any
+    single chip's HBM, probed/updated with one psum over ICI (SURVEY.md
+    §5.7: the reference's one-key-one-shard ceiling removed for bulk bits).
+
+    Fixed geometry: the plane is sized at try_init (padded to a lane- and
+    shard-aligned width); indexes are validated against the LOGICAL size, so
+    padding never leaks into results."""
+
+    _kind = "sharded_bitset"
+
+    def try_init(self, size: int) -> bool:
+        if size <= 0:
+            raise ValueError("size must be positive")
+        mgr = self._mgr
+        m = mgr.round_up(size, 128 * mgr.n_shard)
+        with self._engine.locked(self._name):
+            if self._engine.store.exists(self._name):
+                return False
+            rec = StateRecord(
+                kind=self._kind,
+                meta={"size": size, "m": m, "sharded": True},
+                arrays={"bits": jnp.zeros((m,), jnp.uint8)},
+            )
+            mgr.ensure_state(rec, "bits", BITSET_SPEC)
+            self._engine.store.put(self._name, rec)
+            return True
+
+    def size(self) -> int:
+        return self._rec().meta["size"]
+
+    def plane_width(self) -> int:
+        return self._rec().meta["m"]
+
+    def shards(self) -> int:
+        return self._mgr.n_shard
+
+    def _pack_indexes(self, indexes, size: int):
+        import jax
+
+        from redisson_tpu.core import kernels as K
+        from redisson_tpu.parallel import mesh as M
+
+        idx = np.ascontiguousarray(indexes, np.int64)
+        if idx.ndim != 1:
+            raise ValueError("indexes must be a 1-D integer array")
+        if idx.size and ((idx < 0) | (idx >= size)).any():
+            raise IndexError(f"bit index out of range [0, {size})")
+        mgr = self._mgr
+        n = idx.shape[0]
+        # 1/8-octave buckets like pad_batch: pow2 would waste up to 2x of
+        # host->device bandwidth on padding (the dominant flush cost)
+        b = mgr.round_up(K.bucket_size(max(1, n)), mgr.dp)
+        idx32 = np.pad(idx.astype(np.int32), (0, b - n)) if b > n else idx.astype(np.int32)
+        return jax.device_put(idx32, M.batch_sharding(mgr.mesh)), n
+
+    def set_each(self, indexes, value: bool = True) -> np.ndarray:
+        """Batch SETBIT; returns each bit's PREVIOUS value."""
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            idx, n = self._pack_indexes(indexes, rec.meta["size"])
+            if n == 0:
+                return np.zeros((0,), bool)
+            (set_t, set_f), _, _ = self._mgr.bitset_kernels(rec.meta["m"])
+            bits = self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
+            bits, old = (set_t if value else set_f)(bits, idx, n)
+            rec.arrays["bits"] = bits
+            self._touch_version(rec)
+        return np.asarray(old)[:n]
+
+    def get_each(self, indexes) -> np.ndarray:
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            idx, n = self._pack_indexes(indexes, rec.meta["size"])
+            if n == 0:
+                return np.zeros((0,), bool)
+            _, get, _ = self._mgr.bitset_kernels(rec.meta["m"])
+            bits = self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
+            got = get(bits, idx, n)
+        return np.asarray(got)[:n]
+
+    def set(self, index: int, value: bool = True) -> bool:
+        return bool(self.set_each(np.asarray([index]), value)[0])
+
+    def get(self, index: int) -> bool:
+        return bool(self.get_each(np.asarray([index]))[0])
+
+    def cardinality(self) -> int:
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            _, _, card = self._mgr.bitset_kernels(rec.meta["m"])
+            bits = self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
+            return int(card(bits))
+
+    def clear(self) -> None:
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            rec.arrays["bits"] = jnp.zeros_like(
+                self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
+            )
+            self._touch_version(rec)
+
+    def _binary_op(self, op, other_names):
+        """BITOP against other sharded bitsets: identically-sharded planes,
+        elementwise combine — XLA emits zero collectives."""
+        names = [self._name, *other_names]
+        with self._engine.locked_many(names):
+            rec = self._rec()
+            bits = self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
+            for other in other_names:
+                orec = self._engine.store.get(other)
+                if orec is None or orec.kind != self._kind:
+                    raise ValueError(f"'{other}' is not an initialized {type(self).__name__}")
+                if orec.meta["m"] != rec.meta["m"] or orec.meta["size"] != rec.meta["size"]:
+                    # logical size matters too: a wider-size operand would
+                    # plant ghost bits past this plane's size, corrupting
+                    # cardinality() and not_()'s padding invariant
+                    raise ValueError("sharded BITOP operands must share geometry (size and plane width)")
+                obits = self._mgr.ensure_state(orec, "bits", BITSET_SPEC)
+                bits = op(bits, obits)
+            rec.arrays["bits"] = bits
+            self._touch_version(rec)
+
+    def or_(self, *other_names: str) -> None:
+        self._binary_op(jnp.bitwise_or, other_names)
+
+    def and_(self, *other_names: str) -> None:
+        self._binary_op(jnp.bitwise_and, other_names)
+
+    def xor(self, *other_names: str) -> None:
+        self._binary_op(jnp.bitwise_xor, other_names)
+
+    def not_(self) -> None:
+        """Flip every LOGICAL bit (padding stays zero so cardinality and
+        cross-plane ops never see ghost bits)."""
+        with self._engine.locked(self._name):
+            rec = self._rec()
+            bits = self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
+            mask = (jnp.arange(rec.meta["m"], dtype=jnp.int32) < rec.meta["size"])
+            rec.arrays["bits"] = jnp.where(mask, 1 - bits, bits).astype(jnp.uint8)
+            self._touch_version(rec)

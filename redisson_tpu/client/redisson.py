@@ -57,6 +57,12 @@ class RedissonTpu:
 
         return ShardedHllArray(self._engine, name)
 
+    def get_sharded_bit_set(self, name: str):
+        """ONE logical bitset column-sharded over the device mesh."""
+        from redisson_tpu.client.objects.sharded import ShardedBitSet
+
+        return ShardedBitSet(self._engine, name)
+
     def get_hyper_log_log(self, name: str, codec: Optional[Codec] = None):
         from redisson_tpu.client.objects.hyperloglog import HyperLogLog
 

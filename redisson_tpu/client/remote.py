@@ -834,7 +834,7 @@ _GENERIC_FACTORIES = {
     "get_count_down_latch", "get_rate_limiter", "get_stream", "get_time_series",
     "get_geo", "get_binary_stream", "get_json_bucket", "get_buckets",
     "get_bounded_blocking_queue", "get_sharded_bloom_filter_array",
-    "get_sharded_hll_array",
+    "get_sharded_hll_array", "get_sharded_bit_set",
 }
 
 

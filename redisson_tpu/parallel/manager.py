@@ -108,6 +108,18 @@ class MeshManager:
                 )
         return fns
 
+    def bitset_kernels(self, m: int):
+        """(set, get, cardinality) for one (m,) plane column-sharded."""
+        key = ("bitset", m)
+        mesh = self.mesh  # resolve BEFORE taking the guard
+        with self._guard:
+            fns = self._kernels.get(key)
+            if fns is None:
+                from redisson_tpu.parallel.sharded import make_sharded_bitset_kernels
+
+                fns = self._kernels[key] = make_sharded_bitset_kernels(mesh, m=m)
+        return fns
+
     def hll_kernels(self, p: int, tenants: int):
         """(add, estimate) for a (tenants, m_regs) HLL bank, tenant-sharded."""
         key = ("hll", p, tenants)

@@ -147,3 +147,86 @@ def make_sharded_hll_kernels(mesh: Mesh, p: int, n_tenants: int):
         )
     )
     return add, estimate
+
+
+def make_sharded_bitset_kernels(mesh: Mesh, m: int):
+    """(set, get, cardinality) for a single (m,) bit plane column-sharded
+    over the `shard` axis — ONE logical RBitSet wider than any one chip's
+    HBM (SURVEY.md §5.7: the one-key-one-shard constraint removed).
+
+    Scheme mirrors the bloom kernels: each shard owns bits
+    [s*m_loc, (s+1)*m_loc); set/get batches split over dp; gathers psum over
+    `shard` (exactly one shard owns each index), scatters touch only owned
+    indexes then pmax-combine across dp replicas; cardinality is a local
+    popcount + psum."""
+    n_shard = mesh.shape[SHARD_AXIS]
+    if m % n_shard != 0:
+        raise ValueError(f"m={m} must be divisible by shard axis size {n_shard}")
+    m_local = m // n_shard
+
+    state_spec = P(SHARD_AXIS)
+    ops_spec = P(DP_AXIS)
+
+    def _owned(idx):
+        shard = jax.lax.axis_index(SHARD_AXIS)
+        local = idx - shard * m_local
+        in_range = (local >= 0) & (local < m_local)
+        return jnp.clip(local, 0, m_local - 1), in_range
+
+    def _valid(idx, n_valid):
+        dp_idx = jax.lax.axis_index(DP_AXIS)
+        base = dp_idx * idx.shape[0]
+        return (jnp.arange(idx.shape[0], dtype=jnp.int32) + base) < n_valid
+
+    def get_local(bits_local, idx, n_valid):
+        safe, in_range = _owned(idx)
+        got = jnp.where(in_range, bits_local[safe], 0).astype(jnp.uint8)
+        return (jax.lax.psum(got, SHARD_AXIS) > 0) & _valid(idx, n_valid)
+
+    def make_set(setting: bool):
+        # the set/clear direction is known host-side, so it is a STATIC
+        # kernel parameter: each variant emits exactly ONE dp collective
+        # (pmax converges sets, pmin converges clears) instead of paying
+        # both full-plane all-reduces on every write
+        def set_local(bits_local, idx, n_valid):
+            safe, in_range = _owned(idx)
+            old = jnp.where(in_range, bits_local[safe], 0).astype(jnp.uint8)
+            old = jax.lax.psum(old, SHARD_AXIS) > 0
+            valid = _valid(idx, n_valid)
+            target = jnp.where(in_range & valid, safe, m_local)  # pad -> dropped
+            bits_local = bits_local.at[target].set(
+                jnp.uint8(1 if setting else 0), mode="drop"
+            )
+            combined = (
+                jax.lax.pmax(bits_local, DP_AXIS)
+                if setting
+                else jax.lax.pmin(bits_local, DP_AXIS)
+            )
+            return combined, old & valid
+
+        return jax.jit(
+            jax.shard_map(
+                set_local, mesh=mesh,
+                in_specs=(state_spec, ops_spec, P()),
+                out_specs=(state_spec, ops_spec),
+            ),
+            donate_argnums=(0,),
+        )
+
+    def card_local(bits_local):
+        # int32 accumulator: x64 is disabled in this runtime and a per-shard
+        # popcount beyond 2^31 set bits (>2 Gbit set on ONE shard) is past
+        # any plane this handle serves
+        return jax.lax.psum(jnp.sum(bits_local, dtype=jnp.int32), SHARD_AXIS)
+
+    get = jax.jit(
+        jax.shard_map(
+            get_local, mesh=mesh,
+            in_specs=(state_spec, ops_spec, P()),
+            out_specs=ops_spec,
+        )
+    )
+    card = jax.jit(
+        jax.shard_map(card_local, mesh=mesh, in_specs=(state_spec,), out_specs=P())
+    )
+    return (make_set(True), make_set(False)), get, card

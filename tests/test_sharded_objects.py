@@ -159,6 +159,18 @@ def test_dp_mesh_geometry():
         h.try_init(4, p=12)
         h.add_each(tenants, keys)
         assert abs(h.estimate(1) - 250) < 60
+        # bitset dp-convergence: set (pmax combine) then clear (pmin
+        # combine) with ops split over BOTH dp groups
+        bs = c.get_sharded_bit_set("dpbits")
+        bs.try_init(100_000)
+        idx = np.arange(0, 99_000, 13)
+        assert not bs.set_each(idx).any()
+        assert bs.get_each(idx).all()
+        assert bs.cardinality() == len(idx)
+        assert bs.set_each(idx[::2], value=False).all()
+        assert not bs.get_each(idx[::2]).any()
+        assert bs.get_each(idx[1::2]).all()
+        assert bs.cardinality() == len(idx) - len(idx[::2])
     finally:
         c.shutdown()
 
@@ -189,3 +201,104 @@ def test_sharded_over_the_wire():
         c.shutdown()
     finally:
         st.stop()
+
+
+class TestShardedBitSet:
+    def test_basic_set_get_cardinality(self, client):
+        bs = client.get_sharded_bit_set("sbs")
+        assert bs.try_init(1_000_000)
+        assert not bs.try_init(10)
+        assert bs.shards() == 8
+        assert bs.plane_width() % (128 * 8) == 0
+        rng = np.random.default_rng(3)
+        idx = np.unique(rng.integers(0, 1_000_000, 5000))
+        old = bs.set_each(idx)
+        assert not old.any(), "fresh plane: all previous values are 0"
+        assert bs.get_each(idx).all()
+        assert bs.cardinality() == len(idx)
+        # single-bit ops agree with batch ops
+        assert bs.get(int(idx[0])) is True
+        assert bs.set(int(idx[0]), False) is True  # returns previous
+        assert bs.get(int(idx[0])) is False
+        assert bs.cardinality() == len(idx) - 1
+
+    def test_plane_is_actually_sharded(self, client):
+        from redisson_tpu.client.objects.sharded import BITSET_SPEC
+        from jax.sharding import NamedSharding
+
+        bs = client.get_sharded_bit_set("sbs-layout")
+        bs.try_init(100_000)
+        rec = client._engine.store.get("sbs-layout")
+        mgr = MeshManager.of(client._engine)
+        assert rec.arrays["bits"].sharding == NamedSharding(mgr.mesh, BITSET_SPEC)
+
+    def test_clear_value_semantics(self, client):
+        """set_each(value=False) clears, and dp-replica convergence holds
+        in both directions (pmax for sets, pmin for clears)."""
+        bs = client.get_sharded_bit_set("sbs-clear")
+        bs.try_init(10_000)
+        idx = np.arange(0, 10_000, 7)
+        bs.set_each(idx)
+        old = bs.set_each(idx[:10], value=False)
+        assert old.all()
+        assert not bs.get_each(idx[:10]).any()
+        assert bs.get_each(idx[10:]).all()
+
+    def test_bitops_and_not(self, client):
+        a = client.get_sharded_bit_set("sbs-a")
+        b = client.get_sharded_bit_set("sbs-b")
+        a.try_init(50_000)
+        b.try_init(50_000)
+        a.set_each(np.array([1, 2, 3]))
+        b.set_each(np.array([2, 3, 4]))
+        a.or_("sbs-b")
+        assert a.get_each(np.array([1, 2, 3, 4])).all()
+        a.and_("sbs-b")
+        assert list(a.get_each(np.array([1, 2, 3, 4]))) == [False, True, True, True]
+        a.xor("sbs-b")
+        assert a.cardinality() == 0  # identical planes cancel
+        # not_ flips logical bits only: padding must not leak into counts
+        a.not_()
+        assert a.cardinality() == 50_000
+        with pytest.raises(ValueError):
+            a.or_("sbs-missing")
+        c = client.get_sharded_bit_set("sbs-c")
+        c.try_init(1)  # different plane width
+        with pytest.raises(ValueError):
+            a.or_("sbs-c")
+        # same PLANE width but larger logical size: must refuse, or the
+        # operand's high bits become ghosts past our size
+        d = client.get_sharded_bit_set("sbs-d")
+        d.try_init(50_001)
+        assert d.plane_width() == a.plane_width()
+        with pytest.raises(ValueError):
+            a.or_("sbs-d")
+
+    def test_index_validation(self, client):
+        bs = client.get_sharded_bit_set("sbs-val")
+        bs.try_init(100)
+        with pytest.raises(IndexError):
+            bs.set(100)
+        with pytest.raises(IndexError):
+            bs.get_each(np.array([-1]))
+        assert bs.set_each(np.array([], dtype=np.int64)).shape == (0,)
+
+    def test_checkpoint_roundtrip(self, client):
+        import tempfile
+
+        from redisson_tpu.core import checkpoint
+
+        bs = client.get_sharded_bit_set("sbs-ckpt")
+        bs.try_init(10_000)
+        bs.set_each(np.array([5, 500, 5000]))
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "s.ckp")
+            assert checkpoint.save(client._engine, path) >= 1
+            fresh = redisson_tpu.create()
+            try:
+                assert checkpoint.load(fresh._engine, path) >= 1
+                bs2 = fresh.get_sharded_bit_set("sbs-ckpt")
+                assert bs2.get_each(np.array([5, 500, 5000])).all()
+                assert bs2.cardinality() == 3
+            finally:
+                fresh.shutdown()
