@@ -268,6 +268,10 @@ class ShardedBitSet(_ShardedBase):
     def try_init(self, size: int) -> bool:
         if size <= 0:
             raise ValueError("size must be positive")
+        if size > (1 << 31):
+            # indexes travel as int32 through the kernels; a larger plane
+            # would silently WRAP high indexes onto low bits
+            raise ValueError("sharded bitset size is capped at 2^31 bits")
         mgr = self._mgr
         m = mgr.round_up(size, 128 * mgr.n_shard)
         with self._engine.locked(self._name):

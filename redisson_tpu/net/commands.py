@@ -132,6 +132,16 @@ _spec(SPECS, "JSON.SET JSON.DEL JSON.NUMINCRBY JSON.STRAPPEND JSON.ARRAPPEND "
 _spec(SPECS, "FT.SEARCH FT.AGGREGATE FT.INFO FT._LIST", False, None)
 _spec(SPECS, "FT.CREATE FT.DROPINDEX", True, None)
 
+# script/function invocation: keys follow the numkeys arg (EVAL-style);
+# FCALL_RO is replica-servable, the rest mutate
+SPECS["EVALSHA"] = CommandSpec("EVALSHA", True, None, numkeys_at=1)
+SPECS["EVAL"] = CommandSpec("EVAL", True, None, numkeys_at=1)
+SPECS["FCALL"] = CommandSpec("FCALL", True, None, numkeys_at=1)
+SPECS["FCALL_RO"] = CommandSpec("FCALL_RO", False, None, numkeys_at=1)
+# admin verbs: keyless, replica-servable (CONFIG/SCRIPT admin is node-local;
+# WAIT on a replica reports 0 attached replicas)
+_spec(SPECS, "SCRIPT FUNCTION CONFIG WAIT", False, None)
+
 # multi-key
 _spec(SPECS, "DEL UNLINK", True, 0, multi_key=True)
 _spec(SPECS, "RENAME", True, 0, multi_key=True)

@@ -171,6 +171,37 @@ class TpuServer:
 
     # -- registry support ----------------------------------------------------
 
+    def config_view(self) -> Dict[str, Any]:
+        """CONFIG GET surface: the node's live knob table (read side)."""
+        ev = self.engine._eviction
+        cfg = self.engine.config
+        view = {
+            "port": self.port,
+            "mode": self.mode,
+            "role": self.role,
+            "node-id": self.node_id,
+            "checkpoint-path": self.checkpoint_path or "",
+            "tls": bool(self.tls_cert_file),
+            # before the scheduler lazily starts, report what it WILL use
+            "eviction-min-delay": ev.min_delay if ev else cfg.min_cleanup_delay,
+            "eviction-max-delay": ev.max_delay if ev else cfg.max_cleanup_delay,
+        }
+        return view
+
+    def config_set(self, key: str, value: str) -> bool:
+        """CONFIG SET: the runtime-tunable subset (RedisNode.setConfig
+        analog).  Structural knobs (port, TLS, mode) are read-only."""
+        if key == "eviction-min-delay":
+            self.engine.eviction.min_delay = float(value)
+            return True
+        if key == "eviction-max-delay":
+            self.engine.eviction.max_delay = float(value)
+            return True
+        if key == "checkpoint-path":
+            self.checkpoint_path = value or None
+            return True
+        return False
+
     def next_client_id(self) -> int:
         return next(self._client_ids)
 

@@ -38,7 +38,9 @@ class TestExecutor:
         ex = client.get_executor_service("ex")
         ex.register_workers(2)
         futs = [ex.submit(square, i) for i in range(10)]
-        assert [f.get(5.0) for f in futs] == [i * i for i in range(10)]
+        # generous budget: under full-suite load the worker threads compete
+        # with every other module's pools, and a tight bound flakes
+        assert [f.get(30.0) for f in futs] == [i * i for i in range(10)]
         assert ex.count_active_workers() == 2
         ex.shutdown()
 

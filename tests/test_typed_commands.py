@@ -975,3 +975,90 @@ def test_ft_malformed_queries_are_syntax_errors(client):
         _x(client, "FT.SEARCH", "errq", "@c:{}")
     with pytest.raises(RespError, match="syntax"):
         _x(client, "FT.CREATE", "errq2", "ON")
+
+
+def _incr_by(ctx, keys, args):
+    counter = ctx.get_atomic_long(keys[0])
+    return counter.add_and_get(int(args[0]))
+
+
+def test_evalsha_and_script_admin(client, server):
+    from redisson_tpu.services.script import sha1_of
+
+    # scripts register SERVER-SIDE (Python callables never ship on the wire)
+    from redisson_tpu.services.script import ScriptService
+
+    svc = server.server.engine.service(
+        "script", lambda: ScriptService(server.server.engine)
+    )
+    sha = svc.script_load(_incr_by)
+    assert _x(client, "SCRIPT", "EXISTS", sha, "0" * 40) == [1, 0]
+    assert _x(client, "EVALSHA", sha, 1, "ev:ctr", 5) == 5
+    assert _x(client, "EVALSHA", sha, 1, "ev:ctr", 2) == 7
+    with pytest.raises(RespError, match="^NOSCRIPT"):
+        _x(client, "EVALSHA", "f" * 40, 0)
+    with pytest.raises(RespError, match="not supported"):
+        _x(client, "EVAL", "whatever()", 0)
+    with pytest.raises(RespError, match="not supported"):
+        _x(client, "SCRIPT", "LOAD", "source")
+    _x(client, "SCRIPT", "FLUSH")
+    assert _x(client, "SCRIPT", "EXISTS", sha) == [0]
+
+
+def _weigh(ctx, keys, args):
+    return len(args)
+
+
+def test_fcall_and_function_list(client, server):
+    from redisson_tpu.services.script import FunctionService
+
+    fsvc = server.server.engine.service(
+        "function", lambda: FunctionService(server.server.engine)
+    )
+    fsvc.load("lib1", {"incr_by": _incr_by, "weigh": _weigh})
+    out = _x(client, "FUNCTION", "LIST")
+    row = {bytes(out[0][i]): out[0][i + 1] for i in range(0, len(out[0]), 2)}
+    assert bytes(row[b"library_name"]) == b"lib1"
+    assert _x(client, "FCALL", "incr_by", 1, "fc:ctr", 3) == 3
+    assert _x(client, "FCALL_RO", "weigh", 0, "a", "b") == 2
+    with pytest.raises(RespError, match="not found"):
+        _x(client, "FCALL", "nope", 0)
+
+
+def test_config_and_wait(client):
+    flat = _x(client, "CONFIG", "GET", "*")
+    kv = {bytes(flat[i]): bytes(flat[i + 1]) for i in range(0, len(flat), 2)}
+    assert b"port" in kv and b"role" in kv
+    flat = _x(client, "CONFIG", "GET", "eviction-*")
+    assert len(flat) == 4
+    assert _x(client, "CONFIG", "SET", "eviction-min-delay", "2.5") is not None
+    flat = _x(client, "CONFIG", "GET", "eviction-min-delay")
+    assert bytes(flat[1]) == b"2.5"
+    with pytest.raises(RespError, match="read-only|Unknown"):
+        _x(client, "CONFIG", "SET", "port", "1234")
+    # no replicas attached: WAIT returns 0 after the timeout
+    assert _x(client, "WAIT", 1, 100) == 0
+    assert _x(client, "WAIT", 0, 0) == 0
+
+
+def _boom(ctx, keys, args):
+    return {}["missing"]  # KeyError from the function BODY
+
+
+def test_fcall_body_keyerror_not_masked(client, server):
+    from redisson_tpu.services.script import FunctionService
+
+    fsvc = server.server.engine.service(
+        "function", lambda: FunctionService(server.server.engine)
+    )
+    fsvc.load("errlib", {"boom": _boom})
+    with pytest.raises(RespError) as ei:
+        _x(client, "FCALL", "boom", 0)
+    assert "not found" not in str(ei.value)  # the body's error, not a miss
+
+
+def test_evalsha_truncated_keys_error(client):
+    with pytest.raises(RespError, match="greater than number"):
+        _x(client, "EVALSHA", "a" * 40, 3, "k1", "k2")
+    with pytest.raises(RespError, match="negative"):
+        _x(client, "EVALSHA", "a" * 40, -1)
