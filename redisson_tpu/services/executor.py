@@ -107,31 +107,42 @@ class ExecutorService:
 
     def submit(self, fn: Callable, *args, **kwargs) -> TaskFuture:
         payload = pickle.dumps((fn, args, kwargs), protocol=pickle.HIGHEST_PROTOCOL)
-        tid = self.submit_payload(payload)
+        # the future registers BEFORE the task becomes claimable: an idle
+        # worker can claim-and-finish the instant the queue append lands,
+        # and a late registration would wait forever on a completed task
+        tid = uuid.uuid4().hex[:16]
         fut = TaskFuture(tid)
         self._futures[tid] = fut
+        self.submit_payload(payload, task_id=tid)
         return fut
 
     def execute(self, fn: Callable, *args, **kwargs) -> None:
-        self.submit(fn, *args, **kwargs)
+        # fire-and-forget: no future is ever observable, so none registers
+        self.submit_payload(
+            pickle.dumps((fn, args, kwargs), protocol=pickle.HIGHEST_PROTOCOL)
+        )
 
     def submit_many(self, calls: List[Tuple[Callable, tuple]]) -> List[TaskFuture]:
         return [self.submit(fn, *args) for fn, args in calls]
 
     def cancel_task(self, task_id: str) -> bool:
-        """RExecutorService.cancelTask: only queued tasks can be cancelled."""
+        """RExecutorService.cancelTask: queued tasks and not-yet-fired
+        one-shot schedules cancel (the fire hook checks the state under the
+        same lock, so a cancelled schedule never enqueues); running tasks
+        don't — matching the reference's no-interrupt semantics."""
         with self._engine.locked(f"{{{self._name}}}:tasks"):
             rec = self._rec()
             task = rec.host["tasks"].get(task_id)
-            if task is None or task.state != "queued":
+            if task is None or task.state not in ("queued", "scheduled"):
                 return False
             task.state = "cancelled"
             if task_id in rec.host["queue"]:
                 rec.host["queue"].remove(task_id)
             rec.version += 1
-        fut = self._futures.get(task_id)
+        fut = self._futures.pop(task_id, None)
         if fut:
             fut._cancel()
+        self._done_wait().signal(all_=True)  # wake await_task_result pollers
         return True
 
     # -- workers (TasksRunnerService / RedissonNode.registerWorkers) --------
@@ -140,6 +151,7 @@ class ExecutorService:
         with self._engine.locked(f"{{{self._name}}}:tasks"):
             rec = self._rec()
             rec.host["workers"] += n
+            rec.version += 1  # worker counts must survive failover too
         for _ in range(n):
             t = threading.Thread(target=self._worker_loop, daemon=True)
             t.start()
@@ -184,7 +196,10 @@ class ExecutorService:
             self._run_task(task)
 
     def _run_task(self, task: _Task):
-        fut = self._futures.get(task.id)
+        # pop, don't get: a completed future is delivered through the
+        # caller's own reference; keeping it registered would grow the
+        # dict by one entry per task for the service's lifetime
+        fut = self._futures.pop(task.id, None)
         try:
             fn, args, kwargs = pickle.loads(task.payload)
             # @RInject analog (misc/Injector): tasks asking for the client get it
@@ -201,6 +216,8 @@ class ExecutorService:
                 if task.retries < self.MAX_RETRIES and isinstance(e, _RetryableError):
                     task.state = "queued"
                     rec.host["queue"].append(task.id)
+                    if fut is not None:  # the retry will need it again
+                        self._futures[task.id] = fut
                     return
                 task.state = "failed"
                 task.error = traceback.format_exc()
@@ -241,9 +258,11 @@ class ExecutorService:
     # result) — the server never deserializes task code, mirroring the
     # reference where task classBody bytes pass through Redis untouched.
 
-    def submit_payload(self, payload: bytes) -> str:
-        """Enqueue an opaque pickled (fn, args, kwargs) payload; returns id."""
-        task = _Task(id=uuid.uuid4().hex[:16], payload=bytes(payload))
+    def submit_payload(self, payload: bytes, task_id: Optional[str] = None) -> str:
+        """Enqueue an opaque pickled (fn, args, kwargs) payload; returns id.
+        `task_id` lets submit() pre-register its future under the id before
+        the task is visible to workers."""
+        task = _Task(id=task_id or uuid.uuid4().hex[:16], payload=bytes(payload))
         with self._engine.locked(f"{{{self._name}}}:tasks"):
             rec = self._rec()
             rec.host["tasks"][task.id] = task
@@ -452,7 +471,18 @@ class ScheduledExecutorService(ExecutorService):
 
     def __init__(self, engine, name: str):
         super().__init__(engine, name)
-        self._timers: List = []  # wheel Timeouts (shared engine timer)
+        # task id -> wheel Timeout: fire() prunes its own entry and
+        # cancel_task cancels+drops, so the map stays bounded by the number
+        # of schedules actually pending
+        self._timers: Dict[str, Any] = {}
+
+    def cancel_task(self, task_id: str) -> bool:
+        ok = super().cancel_task(task_id)
+        if ok:
+            t = self._timers.pop(task_id, None)
+            if t is not None:
+                t.cancel()  # no point firing into a cancelled state
+        return ok
 
     def schedule(self, delay: float, fn: Callable, *args, **kwargs) -> TaskFuture:
         """scheduleAsync(task, delay)."""
@@ -463,19 +493,24 @@ class ScheduledExecutorService(ExecutorService):
         with self._engine.locked(f"{{{self._name}}}:tasks"):
             rec = self._rec()
             rec.host["tasks"][task.id] = task
+            rec.version += 1  # every transition ships to replicas
 
         def fire():
+            self._timers.pop(task.id, None)
             with self._engine.locked(f"{{{self._name}}}:tasks"):
                 if task.state != "scheduled":
                     return
                 task.state = "queued"
                 rec2 = self._rec()
                 rec2.host["queue"].append(task.id)
+                rec2.version += 1  # scheduled->queued must replicate too
             self._wait().signal()
 
         # one shared wheel timer, not a thread per scheduled task; fire()
-        # takes record locks, so it runs on the timer pool, not the wheel
-        self._timers.append(self._engine.schedule_timeout(fire, delay))
+        # takes record locks, so it runs on the timer pool, not the wheel.
+        # Keyed by task id so cancel_task can drop the timer and fire()
+        # prunes its own entry — an append-only list would grow forever.
+        self._timers[task.id] = self._engine.schedule_timeout(fire, delay)
         return fut
 
     def schedule_at_fixed_rate(self, initial_delay: float, period: float, fn: Callable, *args) -> str:
@@ -526,8 +561,9 @@ class ScheduledExecutorService(ExecutorService):
         return True
 
     def shutdown(self) -> None:
-        for t in self._timers:
+        for t in list(self._timers.values()):
             t.cancel()
+        self._timers.clear()
         for stop in getattr(self, "_fixed_rate_stops", {}).values():
             stop.set()
         super().shutdown()

@@ -318,3 +318,114 @@ class TestMapReduce:
             workers=2,
         )
         assert mr.execute(lst) == {"a": 1, "b": 2, "c": 2, "d": 1}
+
+
+def _read_pair(ctx, keys, args):
+    """Atomic cross-object read: holds both record locks like Lua would."""
+    return (ctx.get_bucket(keys[0]).get(), ctx.get_map(keys[1]).get("v"))
+
+
+class TestServiceEdges:
+    """Edge behaviors modeled on the reference's service test classes
+    (RedissonLiveObjectServiceTest / RedissonTransactionTest /
+    RedissonExecutorServiceTest)."""
+
+    def test_liveobject_index_follows_field_updates(self, client):
+        @entity(id_field="id", indexed=("city",))
+        class Person:
+            def __init__(self, id=None, city=None):
+                self.id = id
+                self.city = city
+
+        svc = client.get_live_object_service()
+        p = svc.persist(Person(id=1, city="berlin"))
+        assert [x.id for x in svc.find(Person, city="berlin")] == [1]
+        p.city = "tokyo"  # indexed field update must move the index entry
+        assert svc.find(Person, city="berlin") == []
+        assert [x.id for x in svc.find(Person, city="tokyo")] == [1]
+        svc.delete(Person, 1)
+        assert svc.find(Person, city="tokyo") == []
+        assert not svc.is_exists(Person, 1)
+
+    def test_liveobject_multi_condition_and(self, client):
+        @entity(id_field="id", indexed=("city", "tier"))
+        class Acct:
+            def __init__(self, id=None, city=None, tier=None):
+                self.id = id
+                self.city = city
+                self.tier = tier
+
+        svc = client.get_live_object_service()
+        for i, (c, t) in enumerate([("a", 1), ("a", 2), ("b", 1)]):
+            svc.persist(Acct(id=i, city=c, tier=t))
+        assert [x.id for x in svc.find(Acct, city="a", tier=1)] == [0]
+        with pytest.raises(ValueError, match="not indexed"):
+            svc.find(Acct, id=1)
+
+    def test_transaction_multi_object_commit_is_atomic(self, client):
+        """An ATOMIC reader (script holding both record locks) never
+        observes a commit's objects half-applied.  Two plain gets would not
+        prove this — another commit can land between them."""
+        import threading
+
+        client.get_bucket("txa:b").set(0)
+        client.get_map("txa:m").put("v", 0)
+        svc = client.get_script()
+        stop = threading.Event()
+        torn = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    a, b = svc.eval(_read_pair, ["txa:b", "txa:m"])
+                except Exception as e:  # noqa: BLE001 — a dead reader must FAIL the test
+                    torn.append(("reader-error", repr(e)))
+                    return
+                if a != b:
+                    torn.append((a, b))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            for i in range(1, 40):
+                tx = client.create_transaction()
+                tx.get_bucket("txa:b").set(i)
+                tx.get_map("txa:m").put("v", i)
+                tx.commit()
+        finally:
+            stop.set()
+            t.join(10)
+        assert not torn, f"torn transaction observed: {torn[:5]}"
+
+    def test_transaction_rollback_then_reuse_fails(self, client):
+        tx = client.create_transaction()
+        tx.get_bucket("txr:b").set(9)
+        tx.rollback()
+        assert client.get_bucket("txr:b").get() is None
+        with pytest.raises(TransactionException):
+            tx.get_bucket("txr:b").set(1)  # finished tx refuses new ops
+
+    def test_executor_cancel_scheduled_before_fire(self, client):
+        ex = client.get_scheduled_executor_service("sched-edge")
+        ex.register_workers(1)
+        fired = client.get_atomic_long("sched-edge:fired")
+        f = ex.schedule(0.4, uses_client, "sched-edge:fired")
+        assert ex.cancel_task(f.task_id)  # not yet fired: cancellable
+        assert not ex.cancel_task(f.task_id)
+        time.sleep(0.6)
+        assert fired.get() == 0  # cancelled schedule never fires
+        ex.shutdown()
+
+    def test_delayed_queue_transfers_exactly_once(self, client):
+        """However many transfer paths race (wheel timer + explicit calls),
+        the element reaches the destination exactly once."""
+        dest = client.get_blocking_queue("dqe:dest")
+        dq = client.get_delayed_queue(dest)
+        dq.offer("x", delay=0.5)  # generous pre-due window: a CI stall
+        assert dq.transfer_due() == 0  # must not flake the early asserts
+        assert dest.poll() is None
+        time.sleep(0.6)
+        dq.transfer_due()
+        dq.transfer_due()
+        assert dest.poll_blocking(2.0) == "x"
+        assert dest.poll() is None  # exactly one copy arrived

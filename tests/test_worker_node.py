@@ -47,6 +47,11 @@ def test_remote_worker_executes_tasks(grid):
     ids = [_submit(client, square, i) for i in range(10)]
     results = [_await(client, tid) for tid in ids]
     assert results == [i * i for i in range(10)]
+    # the node stores the result BEFORE bumping its counter, so the stat can
+    # trail the last visible result by one tick — poll briefly
+    deadline = time.time() + 5.0
+    while time.time() < deadline and node.stats["executed"] < 10:
+        time.sleep(0.02)
     assert node.stats["executed"] >= 10
     # the server process never ran the task code, the worker did
     active = client.objcall(
