@@ -171,6 +171,27 @@ def objcall_is_write(method: str) -> bool:
     return not any(m.startswith(p) for p in READ_METHOD_PREFIXES)
 
 
+# verbs that PARK server-side until data arrives or their timeout lapses
+# (the reference's isBlockingCommand set): multiplexed clients must give
+# these a dedicated connection or they head-of-line-block every other reply
+BLOCKING_COMMANDS = frozenset(
+    {"BLPOP", "BRPOP", "BLMOVE", "BRPOPLPUSH", "BZPOPMIN", "BZPOPMAX"}
+)
+
+
+def is_blocking(cmd, args) -> bool:
+    # command names arrive as str OR bytes (encode_command accepts both)
+    cu = (cmd.decode() if isinstance(cmd, (bytes, bytearray)) else str(cmd)).upper()
+    if cu in BLOCKING_COMMANDS:
+        return True
+    if cu in ("XREAD", "XREADGROUP"):
+        return any(
+            (bytes(a) if isinstance(a, (bytes, bytearray)) else str(a).encode()).upper() == b"BLOCK"
+            for a in args
+        )
+    return False
+
+
 def lookup(cmd: str) -> Optional[CommandSpec]:
     return SPECS.get(cmd.upper())
 

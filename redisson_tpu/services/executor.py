@@ -297,7 +297,7 @@ class ExecutorService:
             task.state = "finished"
             task.result = bytes(result_bytes)
             rec.version += 1
-        fut = self._futures.get(task_id)
+        fut = self._futures.pop(task_id, None)  # pop: see _run_task
         if fut:
             try:
                 fut._complete(pickle.loads(task.result))  # noqa: S301 — submitter-side decode
@@ -327,7 +327,7 @@ class ExecutorService:
                 return True
             task.state = "failed"
             task.error = error_text
-        fut = self._futures.get(task_id)
+        fut = self._futures.pop(task_id, None)  # pop: see _run_task
         if fut:
             fut._fail(RuntimeError(error_text))
         self._done_wait().signal(all_=True)
@@ -496,8 +496,10 @@ class ScheduledExecutorService(ExecutorService):
             rec.version += 1  # every transition ships to replicas
 
         def fire():
-            self._timers.pop(task.id, None)
             with self._engine.locked(f"{{{self._name}}}:tasks"):
+                # prune under the SAME lock schedule() arms under, so a
+                # 0-delay fire cannot pop before the timer is stored
+                self._timers.pop(task.id, None)
                 if task.state != "scheduled":
                     return
                 task.state = "queued"
@@ -510,7 +512,10 @@ class ScheduledExecutorService(ExecutorService):
         # takes record locks, so it runs on the timer pool, not the wheel.
         # Keyed by task id so cancel_task can drop the timer and fire()
         # prunes its own entry — an append-only list would grow forever.
-        self._timers[task.id] = self._engine.schedule_timeout(fire, delay)
+        # Armed under the record lock: fire() prunes under the same lock,
+        # so even a 0-delay fire observes the stored Timeout.
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            self._timers[task.id] = self._engine.schedule_timeout(fire, delay)
         return fut
 
     def schedule_at_fixed_rate(self, initial_delay: float, period: float, fn: Callable, *args) -> str:

@@ -183,3 +183,124 @@ def test_async_pubsub_reconnects_after_drop(server):
             assert (await asyncio.wait_for(q.get(), 5))[1] == b"back"
 
     asyncio.run(main())
+
+
+def test_async_blocking_pop_does_not_stall_pipeline(server):
+    """A parked BLPOP must ride a dedicated connection: concurrent commands
+    on the shared multiplexed FIFO keep flowing while it waits."""
+
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            parked = asyncio.create_task(
+                client.execute("BLPOP", "aio:bq", 10, timeout=30.0)
+            )
+            await asyncio.sleep(0.2)  # BLPOP is now parked server-side
+            # the shared pipeline must answer FAST despite the park
+            t0 = asyncio.get_running_loop().time()
+            await client.execute("SET", "aio:k", "v")
+            got = await client.execute("GET", "aio:k")
+            elapsed = asyncio.get_running_loop().time() - t0
+            assert bytes(got) == b"v"
+            assert elapsed < 2.0, f"pipeline stalled behind BLPOP ({elapsed:.1f}s)"
+            # wake the parked pop and check its reply shape
+            await client.execute("RPUSH", "aio:bq", "wake")
+            key, val = await asyncio.wait_for(parked, 10.0)
+            assert bytes(key) == b"aio:bq" and bytes(val) == b"wake"
+            # timeout path returns nil without disturbing the client
+            assert await client.execute("BLPOP", "aio:empty", 0.2, timeout=10.0) is None
+            assert await client.execute("PING") in (b"PONG", "PONG")
+
+    asyncio.run(main())
+
+
+def test_async_xread_block_is_dedicated(server):
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            parked = asyncio.create_task(
+                client.execute("XREAD", "BLOCK", 10000, "STREAMS", "aio:st", "$",
+                               timeout=30.0)
+            )
+            await asyncio.sleep(0.2)
+            assert await client.execute("PING") in (b"PONG", "PONG")  # not stalled
+            await client.execute("XADD", "aio:st", "*", "f", "v")
+            out = await asyncio.wait_for(parked, 10.0)
+            assert bytes(out[0][0]) == b"aio:st"
+
+    asyncio.run(main())
+
+
+def test_async_blocking_connection_reuse_and_close(server):
+    """Clean blocking calls return their dedicated connection to the
+    free-list; a timed-out one is discarded (its reply is still in
+    flight); close() tears everything down."""
+
+    async def main():
+        client = await AsyncRemoteRedisson.connect(server.address)
+        node = client.node
+        # clean call: connection returns to the free-list and is reused
+        await client.execute("RPUSH", "aio:rq", "a")
+        await client.execute("BLPOP", "aio:rq", 5, timeout=30.0)
+        assert len(node._dedicated_idle) == 1
+        first = node._dedicated_idle[0]
+        await client.execute("RPUSH", "aio:rq", "b")
+        await client.execute("BLPOP", "aio:rq", 5, timeout=30.0)
+        assert node._dedicated_idle and node._dedicated_idle[0] is first
+        # client-side timeout: the pooled conn is consumed by the call and
+        # discarded (its reply is still in flight — reuse would misalign
+        # the FIFO), so the free-list ends empty
+        with pytest.raises(TimeoutError):
+            await client.execute("BLPOP", "aio:never", 10, timeout=0.3)
+        assert first.closed
+        assert not node._dedicated_idle
+        # a clean call after the discard builds a FRESH pooled conn
+        await client.execute("RPUSH", "aio:rq", "c")
+        await client.execute("BLPOP", "aio:rq", 5, timeout=30.0)
+        assert len(node._dedicated_idle) == 1
+        assert node._dedicated_idle[0] is not first
+        await client.aclose()
+        assert not node._dedicated_idle and not node._dedicated_active
+
+    asyncio.run(main())
+
+
+def test_async_blocking_detects_bytes_command_names(server):
+    """b'BLPOP' must route to a dedicated connection exactly like 'BLPOP'."""
+
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            parked = asyncio.create_task(
+                client.execute(b"BLPOP", "aio:bk", 10, timeout=30.0)
+            )
+            await asyncio.sleep(0.2)
+            t0 = asyncio.get_running_loop().time()
+            assert await client.execute("PING") in (b"PONG", "PONG")
+            assert asyncio.get_running_loop().time() - t0 < 2.0
+            await client.execute("RPUSH", "aio:bk", "w")
+            _, v = await asyncio.wait_for(parked, 10.0)
+            assert bytes(v) == b"w"
+
+    asyncio.run(main())
+
+
+def test_async_blocking_timeout_derives_from_block_arg(server):
+    """BLPOP k 40 with no explicit client timeout must NOT be cut short by
+    the 30s default — the wait derives from the command's own budget."""
+    from redisson_tpu.client.aio import AsyncNodeClient
+
+    assert AsyncNodeClient._block_budget(("BLPOP", "k", "40")) == 40.0
+    assert AsyncNodeClient._block_budget(("BLPOP", "k", 0)) is None  # forever
+    assert AsyncNodeClient._block_budget(
+        ("XREAD", "BLOCK", "45000", "STREAMS", "k", "$")
+    ) == 45.0
+    assert AsyncNodeClient._block_budget((b"BRPOP", "k", "2.5")) == 2.5
+
+    async def main():
+        async with await AsyncRemoteRedisson.connect(server.address) as client:
+            # error replies keep the dedicated connection reusable
+            await client.execute("SET", "aio:str", "v")
+            node = client.node
+            with pytest.raises(RespError):
+                await client.execute("BLPOP", "aio:str", 1)
+            assert len(node._dedicated_idle) == 1  # FIFO aligned: reused
+
+    asyncio.run(main())
