@@ -378,6 +378,58 @@ class Map(RExpirable):
             rec.host.clear()
             self._touch_version(rec)
 
+    def value_size(self, key) -> int:
+        """Encoded byte size of one value (RMap.valueSize / HSTRLEN)."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            raw = self._raw_get(rec, self._ek(key))
+            return 0 if raw is None else len(raw)
+
+    def random_keys(self, count: int) -> List:
+        """HRANDFIELD-style sample of distinct LIVE keys (RMap.randomKeys) —
+        sampled through _raw_get so MapCache expiry applies."""
+        import random as _random
+
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            keys = [
+                k for k in list(rec.host.keys())
+                if self._raw_get(rec, k) is not None
+            ]
+        return [self._dk(k) for k in _random.sample(keys, min(count, len(keys)))]
+
+    def random_entries(self, count: int) -> Dict:
+        """RMap.randomEntries — live entries only (expired cells reaped)."""
+        import random as _random
+
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            items = [
+                (k, raw) for k in list(rec.host.keys())
+                if (raw := self._raw_get(rec, k)) is not None
+            ]
+        picked = _random.sample(items, min(count, len(items)))
+        return {self._dk(k): self._dv(raw) for k, raw in picked}
+
+    def load_all(self, replace_existing: bool = False) -> int:
+        """Warm the map from its MapLoader (RMap.loadAll); returns #loaded."""
+        loader = self._options.loader
+        if loader is None:
+            return 0
+        n = 0
+        for key in loader.load_all_keys():
+            with self._engine.locked(self._name):
+                rec = self._rec_or_create()
+                ek = self._ek(key)
+                if not replace_existing and self._raw_get(rec, ek) is not None:
+                    continue
+                loaded = loader.load(key)
+                if loaded is not None:
+                    self._raw_put(rec, ek, self._ev(loaded))
+                    self._touch_version(rec)
+                    n += 1
+        return n
+
     # dict-protocol sugar
     def __getitem__(self, key):
         v = self.get(key)

@@ -64,7 +64,15 @@ class ScoredSortedSet(RExpirable):
             rec.host["scores"][e] = float(score)
             self._dirty(rec)
             self._touch_version(rec)
-            return fresh
+        self._signal_waiters()
+        return fresh
+
+    def _signal_waiters(self) -> None:
+        """Wake parked take_first/take_last (BZPOPMIN/MAX analog) without
+        materializing a wait entry when nobody waits."""
+        e = self._engine._wait_entries.get(f"__q_wait__:{self._name}")
+        if e is not None:
+            e.signal(all_=True)
 
     def add_all(self, entries: Dict[Any, float]) -> int:
         """ZADD many: {member: score}; returns count of new members."""
@@ -78,6 +86,7 @@ class ScoredSortedSet(RExpirable):
                 rec.host["scores"][e] = float(score)
             self._dirty(rec)
             self._touch_version(rec)
+        self._signal_waiters()
         return n
 
     def add_if_absent(self, score: float, member) -> bool:
@@ -133,7 +142,8 @@ class ScoredSortedSet(RExpirable):
             rec.host["scores"][e] = new
             self._dirty(rec)
             self._touch_version(rec)
-            return new
+        self._signal_waiters()
+        return new
 
     def remove(self, member) -> bool:
         e = self._e(member)
@@ -358,27 +368,18 @@ class ScoredSortedSet(RExpirable):
             out.append({} if rec is None else dict(rec.host["scores"]))
         return out
 
-    def union(self, *names: str, aggregate: str = "SUM") -> int:
-        with self._engine.locked_many((self._name, *names)):
-            rec = self._rec_or_create()
-            maps = self._gather((self._name, *names))
+    @staticmethod
+    def _accumulate(maps, op: str, aggregate: str = "SUM") -> Dict[bytes, float]:
+        """ONE accumulator for union/inter/diff — shared by the store ops
+        AND the read_* variants so aggregation semantics cannot drift."""
+        if op == "union":
             acc: Dict[bytes, float] = {}
             for mp in maps:
                 for m, s in mp.items():
-                    if m in acc:
-                        acc[m] = _agg(aggregate, acc[m], s)
-                    else:
-                        acc[m] = s
-            rec.host["scores"] = acc
-            self._dirty(rec)
-            self._touch_version(rec)
-            return len(acc)
-
-    def intersection(self, *names: str, aggregate: str = "SUM") -> int:
-        with self._engine.locked_many((self._name, *names)):
-            rec = self._rec_or_create()
-            maps = self._gather((self._name, *names))
-            common = set(maps[0])
+                    acc[m] = _agg(aggregate, acc[m], s) if m in acc else s
+            return acc
+        if op == "inter":
+            common = set(maps[0]) if maps else set()
             for mp in maps[1:]:
                 common &= set(mp)
             acc = {}
@@ -387,23 +388,165 @@ class ScoredSortedSet(RExpirable):
                 for mp in maps[1:]:
                     v = _agg(aggregate, v, mp[m])
                 acc[m] = v
-            rec.host["scores"] = acc
-            self._dirty(rec)
-            self._touch_version(rec)
-            return len(acc)
+            return acc
+        acc = dict(maps[0]) if maps else {}
+        for mp in maps[1:]:
+            for m in mp:
+                acc.pop(m, None)
+        return acc
 
-    def diff(self, *names: str) -> int:
+    def _combine_store(self, names, op: str, aggregate: str = "SUM") -> int:
         with self._engine.locked_many((self._name, *names)):
             rec = self._rec_or_create()
-            maps = self._gather((self._name, *names))
-            acc = dict(maps[0])
-            for mp in maps[1:]:
-                for m in mp:
-                    acc.pop(m, None)
+            acc = self._accumulate(self._gather((self._name, *names)), op, aggregate)
             rec.host["scores"] = acc
             self._dirty(rec)
             self._touch_version(rec)
-            return len(acc)
+        self._signal_waiters()
+        return len(acc)
+
+    def union(self, *names: str, aggregate: str = "SUM") -> int:
+        return self._combine_store(names, "union", aggregate)
+
+    def intersection(self, *names: str, aggregate: str = "SUM") -> int:
+        return self._combine_store(names, "inter", aggregate)
+
+    def diff(self, *names: str) -> int:
+        return self._combine_store(names, "diff")
+
+    # -- combination reads (readUnion/readIntersection/readDiff) -------------
+
+    def _combine_read(self, names, op: str, aggregate: str = "SUM") -> List:
+        with self._engine.locked_many((self._name, *names)):
+            maps = self._gather((self._name, *names))
+        acc = self._accumulate(maps, op, aggregate)
+        return [self._d(m) for _s, m in sorted((s, m) for m, s in acc.items())]
+
+    def read_union(self, *names: str, aggregate: str = "SUM") -> List:
+        """ZUNION read — leaves this set untouched (RScoredSortedSet.readUnion)."""
+        return self._combine_read(names, "union", aggregate)
+
+    def read_intersection(self, *names: str, aggregate: str = "SUM") -> List:
+        return self._combine_read(names, "inter", aggregate)
+
+    def read_diff(self, *names: str) -> List:
+        return self._combine_read(names, "diff")
+
+    def count_intersection(self, *names: str, limit: int = 0) -> int:
+        """ZINTERCARD (RScoredSortedSet.countIntersection)."""
+        n = len(self._combine_read(names, "inter"))
+        return min(n, limit) if limit else n
+
+    # -- rank-returning adds / member surgery --------------------------------
+
+    def add_and_get_rank(self, score: float, member) -> int:
+        """ZADD + ZRANK in one locked step (addAndGetRank)."""
+        with self._engine.locked(self._name):
+            self.add(score, member)
+            return self.rank(member)
+
+    def add_and_get_rev_rank(self, score: float, member) -> int:
+        with self._engine.locked(self._name):
+            self.add(score, member)
+            return self.rev_rank(member)
+
+    def replace(self, old_member, new_member) -> bool:
+        """Rename a member keeping its score (RScoredSortedSet.replace)."""
+        eo, en = self._e(old_member), self._e(new_member)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            score = rec.host["scores"].pop(eo, None)
+            if score is None:
+                return False
+            rec.host["scores"][en] = score
+            self._dirty(rec)
+            self._touch_version(rec)
+        self._signal_waiters()
+        return True
+
+    def retain_all(self, values: Iterable) -> bool:
+        """Keep only `values`; True if anything was removed."""
+        keep = {self._e(v) for v in values}
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            victims = [m for m in rec.host["scores"] if m not in keep]
+            for m in victims:
+                del rec.host["scores"][m]
+            if victims:
+                self._dirty(rec)
+                self._touch_version(rec)
+            return bool(victims)
+
+    def random_entries(self, count: int) -> Dict:
+        """ZRANDMEMBER WITHSCORES as a dict (randomEntries)."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            items = list(rec.host["scores"].items())
+        picked = random.sample(items, min(count, len(items)))
+        return {self._d(m): s for m, s in picked}
+
+    # -- reversed ranges ------------------------------------------------------
+
+    def value_range_reversed(self, start: int, end: int) -> List:
+        """ZREVRANGE by rank (valueRangeReversed)."""
+        return [m for m, _s in self.entry_range_reversed(start, end)]
+
+    def entry_range_reversed(self, start: int, end: int) -> List[Tuple[Any, float]]:
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = list(reversed(self._index_of(rec)))
+        lo, hi = _norm_range(start, end, len(idx))
+        return [(self._d(m), s) for s, m in (idx[lo : hi + 1] if hi >= lo else [])]
+
+    # -- counted + blocking pops ---------------------------------------------
+
+    def poll_first_many(self, count: int) -> List:
+        """ZPOPMIN with count (pollFirst(count))."""
+        out = []
+        with self._engine.locked(self._name):
+            for _ in range(count):
+                e = self.poll_first_entry()
+                if e is None:
+                    break
+                out.append(e[0])
+        return out
+
+    def poll_last_many(self, count: int) -> List:
+        out = []
+        with self._engine.locked(self._name):
+            for _ in range(count):
+                e = self.poll_last_entry()
+                if e is None:
+                    break
+                out.append(e[0])
+        return out
+
+    def _poll_blocking(self, poll_fn, timeout: Optional[float]):
+        import time as _t
+
+        deadline = None if timeout is None else _t.time() + timeout
+        entry = self._engine.wait_entry(f"__q_wait__:{self._name}")
+        while True:
+            v = poll_fn()
+            if v is not None:
+                return v
+            remaining = None if deadline is None else deadline - _t.time()
+            if remaining is not None and remaining <= 0:
+                return None
+            entry.wait_for(min(1.0, remaining) if remaining is not None else 1.0)
+
+    def take_first(self):
+        """BZPOPMIN parked on add wakeups (takeFirst)."""
+        return self._poll_blocking(self.poll_first, None)
+
+    def take_last(self):
+        return self._poll_blocking(self.poll_last, None)
+
+    def poll_first_blocking(self, timeout: Optional[float]):
+        return self._poll_blocking(self.poll_first, timeout)
+
+    def poll_last_blocking(self, timeout: Optional[float]):
+        return self._poll_blocking(self.poll_last, timeout)
 
 
 def _agg(mode: str, a: float, b: float) -> float:

@@ -33,7 +33,7 @@ SAME_SLOT = {
     "ZUNIONSTORE", "ZINTERSTORE",
     "COPY", "RENAMENX", "SORT", "GEOSEARCHSTORE",
     "ZDIFF", "ZINTER", "ZUNION", "ZDIFFSTORE", "ZRANGESTORE",
-    "LMPOP", "ZMPOP", "BLPOP", "BRPOP", "BLMOVE", "BRPOPLPUSH",
+    "LMPOP", "ZMPOP", "BLMPOP", "BZMPOP", "BLPOP", "BRPOP", "BLMOVE", "BRPOPLPUSH",
     "BZPOPMIN", "BZPOPMAX", "XREAD", "XREADGROUP",
 }
 # (MGET/MSET follow real Redis cluster semantics: multi-key commands

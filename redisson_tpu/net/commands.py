@@ -108,6 +108,8 @@ SPECS["ZUNION"] = CommandSpec("ZUNION", False, None, numkeys_at=0)
 SPECS["ZDIFFSTORE"] = CommandSpec("ZDIFFSTORE", True, 0, numkeys_at=1)
 SPECS["LMPOP"] = CommandSpec("LMPOP", True, None, numkeys_at=0)
 SPECS["ZMPOP"] = CommandSpec("ZMPOP", True, None, numkeys_at=0)
+SPECS["BLMPOP"] = CommandSpec("BLMPOP", True, None, numkeys_at=1)
+SPECS["BZMPOP"] = CommandSpec("BZMPOP", True, None, numkeys_at=1)
 
 # typed stream + geo verbs
 _spec(SPECS, "XLEN XRANGE XREVRANGE XPENDING GEOPOS GEODIST GEOSEARCH", False, 0)
@@ -175,8 +177,11 @@ def objcall_is_write(method: str) -> bool:
 # (the reference's isBlockingCommand set): multiplexed clients must give
 # these a dedicated connection or they head-of-line-block every other reply
 BLOCKING_COMMANDS = frozenset(
-    {"BLPOP", "BRPOP", "BLMOVE", "BRPOPLPUSH", "BZPOPMIN", "BZPOPMAX"}
+    {"BLPOP", "BRPOP", "BLMOVE", "BRPOPLPUSH", "BZPOPMIN", "BZPOPMAX",
+     "BLMPOP", "BZMPOP"}
 )
+# verbs whose block timeout is the FIRST argument (the rest carry it last)
+BLOCK_TIMEOUT_FIRST = frozenset({"BLMPOP", "BZMPOP"})
 
 
 def is_blocking(cmd, args) -> bool:

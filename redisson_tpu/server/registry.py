@@ -3924,3 +3924,29 @@ def cmd_config(server, ctx, args):
             raise RespError(f"ERR Unknown or read-only CONFIG parameter '{_s(args[1])}'")
         return "+OK"
     raise RespError(f"ERR Unknown CONFIG subcommand '{_s(args[0])}'")
+
+
+@register("BLMPOP")
+def cmd_blmpop(server, ctx, args):
+    """BLMPOP timeout numkeys key... LEFT|RIGHT [COUNT n]."""
+    timeout = float(args[0])
+    rest = args[1:]
+
+    def poll_once():
+        return cmd_lmpop(server, ctx, rest)
+
+    first_key = _s(rest[1])
+    return _block_loop(server, first_key, poll_once, timeout)
+
+
+@register("BZMPOP")
+def cmd_bzmpop(server, ctx, args):
+    """BZMPOP timeout numkeys key... MIN|MAX [COUNT n]."""
+    timeout = float(args[0])
+    rest = args[1:]
+
+    def poll_once():
+        return cmd_zmpop(server, ctx, rest)
+
+    first_key = _s(rest[1])
+    return _block_loop(server, first_key, poll_once, timeout)

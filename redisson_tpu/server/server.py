@@ -82,7 +82,8 @@ def _force_lazies(results: list, server) -> None:
 _SLOW_COMMANDS = frozenset(
     b.encode() for b in (
         "OBJCALL", "OBJCALLM", "OBJCALLMA", "BLPOP", "BRPOP", "BLMOVE",
-        "BRPOPLPUSH", "BZPOPMIN", "BZPOPMAX", "XREAD", "XREADGROUP",
+        "BRPOPLPUSH", "BZPOPMIN", "BZPOPMAX", "BLMPOP", "BZMPOP",
+        "XREAD", "XREADGROUP",
     )
 )
 

@@ -689,3 +689,117 @@ class TestMultimaps:
         m.put("new", 2)  # must evict 'writer' (0 reads), not 'reader'
         assert m.get("reader") == 1
         assert m.get("writer") is None
+
+
+class TestScoredSortedSetDepth:
+    """RScoredSortedSet surface depth (RedissonScoredSortedSetTest edges)."""
+
+    def test_combination_reads_leave_set_untouched(self, client):
+        a = client.get_scored_sorted_set("zd:a")
+        b = client.get_scored_sorted_set("zd:b")
+        for m, s in [("x", 1), ("y", 2)]:
+            a.add(s, m)
+        b.add(10, "y")
+        assert a.read_union("zd:b") == ["x", "y"]
+        assert a.read_intersection("zd:b") == ["y"]
+        assert a.read_diff("zd:b") == ["x"]
+        assert a.count_intersection("zd:b") == 1
+        assert a.count_intersection("zd:b", limit=0) == 1
+        assert a.size() == 2  # untouched, unlike union()/intersection()
+
+    def test_rank_adds_and_replace(self, client):
+        z = client.get_scored_sorted_set("zd:r")
+        assert z.add_and_get_rank(5, "mid") == 0
+        assert z.add_and_get_rank(1, "low") == 0
+        assert z.add_and_get_rank(9, "high") == 2
+        assert z.add_and_get_rev_rank(7, "seven") == 1
+        assert z.replace("mid", "renamed")
+        assert not z.replace("missing", "x")
+        assert z.get_score("renamed") == 5 and z.get_score("mid") is None
+
+    def test_retain_random_reversed(self, client):
+        z = client.get_scored_sorted_set("zd:m")
+        for i, m in enumerate("abcde"):
+            z.add(i, m)
+        assert z.entry_range_reversed(0, 1) == [("e", 4.0), ("d", 3.0)]
+        assert z.value_range_reversed(0, -1) == ["e", "d", "c", "b", "a"]
+        picked = z.random_entries(3)
+        assert len(picked) == 3 and all(m in "abcde" for m in picked)
+        assert z.retain_all(["a", "b"])
+        assert z.read_all() == ["a", "b"]
+        assert not z.retain_all(["a", "b"])  # nothing left to drop
+
+    def test_counted_and_blocking_pops(self, client):
+        z = client.get_scored_sorted_set("zd:p")
+        for i, m in enumerate("abcd"):
+            z.add(i, m)
+        assert z.poll_first_many(2) == ["a", "b"]
+        assert z.poll_last_many(10) == ["d", "c"]
+        assert z.poll_first_blocking(0.1) is None
+        got = []
+        t = threading.Thread(target=lambda: got.append(z.take_first()))
+        t.start()
+        time.sleep(0.1)
+        z.add(1, "wake")
+        t.join(5.0)
+        assert not t.is_alive() and got == ["wake"]
+
+
+class TestMapDepth:
+    def test_value_size_random_sampling(self, client):
+        m = client.get_map("md")
+        m.put_all({f"k{i}": "v" * (i + 1) for i in range(6)})
+        assert m.value_size("k3") == len(m._ev("vvvv"))
+        assert m.value_size("missing") == 0
+        ks = m.random_keys(3)
+        assert len(ks) == 3 and all(k.startswith("k") for k in ks)
+        assert len(m.random_keys(99)) == 6  # clamped to size
+        es = m.random_entries(2)
+        assert len(es) == 2 and all(m.get(k) == v for k, v in es.items())
+
+    def test_map_cache_random_entries_decode_cells(self, client):
+        mc = client.get_map_cache("mdc")
+        mc.put_with_ttl("a", 1, ttl=60.0)
+        mc.put("b", 2)
+        es = mc.random_entries(2)
+        assert es == {"a": 1, "b": 2}
+
+    def test_load_all(self, client):
+        from redisson_tpu.client.objects.map import MapLoader, MapOptions
+
+        class L(MapLoader):
+            def load(self, key):
+                return f"v:{key}"
+
+            def load_all_keys(self):
+                return ["x", "y", "z"]
+
+        m = client.get_map("ml", options=MapOptions(loader=L()))
+        m.put("x", "existing")
+        assert m.load_all() == 2  # x kept
+        assert m.get("x") == "existing"
+        assert m.load_all(replace_existing=True) == 3
+        assert m.get("x") == "v:x"
+
+    def test_add_all_wakes_blocking_take(self, client):
+        """Regression: every member-introducing write signals parked takers
+        (not just add) — a 0.5s poll must see add_all's element."""
+        z = client.get_scored_sorted_set("zd:w")
+        got = []
+        t = threading.Thread(target=lambda: got.append(z.poll_first_blocking(5.0)))
+        t.start()
+        time.sleep(0.15)
+        t0 = time.time()
+        z.add_all({"m": 1.0})
+        t.join(5.0)
+        assert not t.is_alive() and got == ["m"]
+        assert time.time() - t0 < 0.9  # woke on the signal, not the 1s re-poll
+
+    def test_map_cache_sampling_skips_expired(self, client):
+        """Regression: random_keys/random_entries must not surface dead cells."""
+        mc = client.get_map_cache("mcsamp")
+        mc.put_with_ttl("dead", 1, ttl=0.03)
+        mc.put("live", 2)
+        time.sleep(0.05)
+        assert mc.random_keys(5) == ["live"]
+        assert mc.random_entries(5) == {"live": 2}

@@ -1062,3 +1062,33 @@ def test_evalsha_truncated_keys_error(client):
         _x(client, "EVALSHA", "a" * 40, 3, "k1", "k2")
     with pytest.raises(RespError, match="negative"):
         _x(client, "EVALSHA", "a" * 40, -1)
+
+
+def test_blocking_multi_pops(client, server):
+    import threading
+    import time
+
+    _x(client, "RPUSH", "bmp", "a", "b")
+    got = _x(client, "BLMPOP", 1, 2, "bmp-none", "bmp", "LEFT", "COUNT", 2)
+    assert bytes(got[0]) == b"bmp" and [bytes(v) for v in got[1]] == [b"a", b"b"]
+    assert _x(client, "BLMPOP", 0.2, 1, "bmp", "LEFT") is None
+    _x(client, "ZADD", "bzm", 1, "m")
+    got = _x(client, "BZMPOP", 1, 1, "bzm", "MIN")
+    assert bytes(got[0]) == b"bzm" and [bytes(v) for v in got[1]] == [b"m", b"1"]
+    # parked BLMPOP woken by a push from another connection
+    out = []
+
+    def parked():
+        c2 = RemoteRedisson(server.address, timeout=30.0)
+        try:
+            out.append(_x(c2, "BLMPOP", 10, 1, "bmp:park", "LEFT"))
+        finally:
+            c2.shutdown()
+
+    t = threading.Thread(target=parked)
+    t.start()
+    time.sleep(0.3)
+    _x(client, "RPUSH", "bmp:park", "w")
+    t.join(10.0)
+    assert not t.is_alive()
+    assert [bytes(v) for v in out[0][1]] == [b"w"]
