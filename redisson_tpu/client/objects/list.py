@@ -61,6 +61,36 @@ class RList(RExpirable):
             rec.host.insert(index, self._e(value))
             self._touch_version(rec)
 
+    def _add_relative(self, pivot, value, after: bool) -> int:
+        """LINSERT BEFORE|AFTER pivot; new length, or -1 if pivot absent."""
+        ep, ev = self._e(pivot), self._e(value)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            try:
+                i = rec.host.index(ep)
+            except ValueError:
+                return -1
+            rec.host.insert(i + 1 if after else i, ev)
+            self._touch_version(rec)
+            return len(rec.host)
+
+    def add_after(self, pivot, value) -> int:
+        """RList.addAfter (LINSERT AFTER)."""
+        return self._add_relative(pivot, value, after=True)
+
+    def add_before(self, pivot, value) -> int:
+        """RList.addBefore (LINSERT BEFORE)."""
+        return self._add_relative(pivot, value, after=False)
+
+    def sub_list(self, from_index: int, to_index: int) -> PyList:
+        """RList.subList materialized (reference returns a live view; a
+        snapshot honors the same read semantics without proxy plumbing)."""
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            if from_index < 0 or to_index > len(rec.host) or from_index > to_index:
+                raise IndexError(f"subList({from_index}, {to_index}) out of bounds")
+            return [self._d(e) for e in rec.host[from_index:to_index]]
+
     def get(self, index: int):
         """LINDEX; raises IndexError out of range (reference throws)."""
         rec = self._engine.store.get(self._name)

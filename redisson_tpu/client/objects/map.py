@@ -394,7 +394,9 @@ class Map(RExpirable):
             rec = self._rec_or_create()
             keys = [
                 k for k in list(rec.host.keys())
-                if self._raw_get(rec, k) is not None
+                # non-touching probe: sampling must not refresh max-idle
+                # clocks or inflate LFU hit counts for every live entry
+                if self._raw_get_for_update(rec, k) is not None
             ]
         return [self._dk(k) for k in _random.sample(keys, min(count, len(keys)))]
 
@@ -406,7 +408,7 @@ class Map(RExpirable):
             rec = self._rec_or_create()
             items = [
                 (k, raw) for k in list(rec.host.keys())
-                if (raw := self._raw_get(rec, k)) is not None
+                if (raw := self._raw_get_for_update(rec, k)) is not None
             ]
         picked = _random.sample(items, min(count, len(items)))
         return {self._dk(k): self._dv(raw) for k, raw in picked}
@@ -418,16 +420,24 @@ class Map(RExpirable):
             return 0
         n = 0
         for key in loader.load_all_keys():
+            ek = self._ek(key)
+            if not replace_existing:
+                with self._engine.locked(self._name):
+                    rec = self._rec_or_create()
+                    if self._raw_get_for_update(rec, ek) is not None:
+                        continue
+            # the loader may hit a slow backing store: NEVER under the
+            # record lock, or every concurrent op on this map stalls per key
+            loaded = loader.load(key)
+            if loaded is None:
+                continue
             with self._engine.locked(self._name):
                 rec = self._rec_or_create()
-                ek = self._ek(key)
-                if not replace_existing and self._raw_get(rec, ek) is not None:
-                    continue
-                loaded = loader.load(key)
-                if loaded is not None:
-                    self._raw_put(rec, ek, self._ev(loaded))
-                    self._touch_version(rec)
-                    n += 1
+                if not replace_existing and self._raw_get_for_update(rec, ek) is not None:
+                    continue  # raced in while we were loading: keep it
+                self._raw_put(rec, ek, self._ev(loaded))
+                self._touch_version(rec)
+                n += 1
         return n
 
     # dict-protocol sugar

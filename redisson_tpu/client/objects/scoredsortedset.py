@@ -68,11 +68,8 @@ class ScoredSortedSet(RExpirable):
         return fresh
 
     def _signal_waiters(self) -> None:
-        """Wake parked take_first/take_last (BZPOPMIN/MAX analog) without
-        materializing a wait entry when nobody waits."""
-        e = self._engine._wait_entries.get(f"__q_wait__:{self._name}")
-        if e is not None:
-            e.signal(all_=True)
+        """Wake parked take_first/take_last (BZPOPMIN/MAX analog)."""
+        self._engine.signal_queue_waiters(self._name)
 
     def add_all(self, entries: Dict[Any, float]) -> int:
         """ZADD many: {member: score}; returns count of new members."""
@@ -99,7 +96,8 @@ class ScoredSortedSet(RExpirable):
             rec.host["scores"][e] = float(score)
             self._dirty(rec)
             self._touch_version(rec)
-            return True
+        self._signal_waiters()
+        return True
 
     def add_if_exists(self, score: float, member) -> bool:
         """ZADD XX."""
@@ -131,7 +129,10 @@ class ScoredSortedSet(RExpirable):
             rec.host["scores"][e] = float(score)
             self._dirty(rec)
             self._touch_version(rec)
-            return old is None
+            fresh = old is None
+        if fresh:  # a GT/LT add can introduce a member: wake parked takers
+            self._signal_waiters()
+        return fresh
 
     def add_score(self, member, delta: float) -> float:
         """ZINCRBY."""
@@ -433,8 +434,11 @@ class ScoredSortedSet(RExpirable):
         return self._combine_read(names, "diff")
 
     def count_intersection(self, *names: str, limit: int = 0) -> int:
-        """ZINTERCARD (RScoredSortedSet.countIntersection)."""
-        n = len(self._combine_read(names, "inter"))
+        """ZINTERCARD (RScoredSortedSet.countIntersection) — counts the
+        accumulator directly; decoding/sorting members to len() them would
+        pay the full read cost for a number."""
+        with self._engine.locked_many((self._name, *names)):
+            n = len(self._accumulate(self._gather((self._name, *names)), "inter"))
         return min(n, limit) if limit else n
 
     # -- rank-returning adds / member surgery --------------------------------
@@ -500,26 +504,29 @@ class ScoredSortedSet(RExpirable):
 
     # -- counted + blocking pops ---------------------------------------------
 
+    def _poll_many(self, count: int, first: bool) -> List:
+        """ONE index build + one slice + one batched delete — popping
+        through poll_*_entry would re-sort the whole set per element."""
+        if count <= 0:
+            return []
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            idx = self._index_of(rec)
+            victims = idx[:count] if first else idx[: -count - 1 : -1]
+            if not victims:
+                return []
+            for _s, m in victims:
+                del rec.host["scores"][m]
+            self._dirty(rec)
+            self._touch_version(rec)
+            return [self._d(m) for _s, m in victims]
+
     def poll_first_many(self, count: int) -> List:
         """ZPOPMIN with count (pollFirst(count))."""
-        out = []
-        with self._engine.locked(self._name):
-            for _ in range(count):
-                e = self.poll_first_entry()
-                if e is None:
-                    break
-                out.append(e[0])
-        return out
+        return self._poll_many(count, first=True)
 
     def poll_last_many(self, count: int) -> List:
-        out = []
-        with self._engine.locked(self._name):
-            for _ in range(count):
-                e = self.poll_last_entry()
-                if e is None:
-                    break
-                out.append(e[0])
-        return out
+        return self._poll_many(count, first=False)
 
     def _poll_blocking(self, poll_fn, timeout: Optional[float]):
         import time as _t

@@ -166,6 +166,14 @@ class Engine:
                 )
             return self._timer_pool
 
+    def signal_queue_waiters(self, name: str) -> None:
+        """Wake queue-family waiters parked on `name` WITHOUT materializing
+        a wait entry when nobody waits — the ONE authority for the
+        __q_wait__ key format (BlockingQueue/BZPOP/take_first parking)."""
+        e = self._wait_entries.get(f"__q_wait__:{name}")
+        if e is not None:
+            e.signal(all_=True)
+
     def schedule_timeout(self, fn, delay: float):
         """Run `fn` ~`delay` seconds from now on the shared timer pool.
         Returns the wheel Timeout (cancellable until it fires)."""

@@ -2681,11 +2681,9 @@ def cmd_zrangestore(server, ctx, args):
 # -- multi-pops + blocking family --------------------------------------------
 
 def _signal_waiters(server, name: str) -> None:
-    """Wake queue-family waiters WITHOUT materializing a wait entry (pushes
-    through Deque handles signal automatically; ZADD must wake BZPOP*)."""
-    e = server.engine._wait_entries.get(f"__q_wait__:{name}")
-    if e is not None:
-        e.signal(all_=True)
+    """Wake queue-family waiters (pushes through Deque handles signal
+    automatically; ZADD must wake BZPOP*)."""
+    server.engine.signal_queue_waiters(name)
 
 
 def _block_loop(server, first_key: str, poll_once, timeout: float):
@@ -3926,27 +3924,42 @@ def cmd_config(server, ctx, args):
     raise RespError(f"ERR Unknown CONFIG subcommand '{_s(args[0])}'")
 
 
+def _bmpop_prelude(args):
+    """Shared BLMPOP/BZMPOP validation: timeout + numkeys BEFORE any
+    delegation, so malformed input replies a syntax error, never ERR
+    internal."""
+    try:
+        timeout = float(args[0])
+    except (TypeError, ValueError):
+        raise RespError("ERR timeout is not a float or out of range")
+    rest = args[1:]
+    if len(rest) < 3:
+        raise RespError("ERR wrong number of arguments")
+    n = _int(rest[0])
+    if n <= 0:
+        raise RespError("ERR numkeys should be greater than 0")
+    if len(rest) < 1 + n + 1:
+        raise RespError("ERR Number of keys is greater than number of args")
+    return timeout, rest, _s(rest[1])
+
+
 @register("BLMPOP")
 def cmd_blmpop(server, ctx, args):
     """BLMPOP timeout numkeys key... LEFT|RIGHT [COUNT n]."""
-    timeout = float(args[0])
-    rest = args[1:]
+    timeout, rest, first_key = _bmpop_prelude(args)
 
     def poll_once():
         return cmd_lmpop(server, ctx, rest)
 
-    first_key = _s(rest[1])
     return _block_loop(server, first_key, poll_once, timeout)
 
 
 @register("BZMPOP")
 def cmd_bzmpop(server, ctx, args):
     """BZMPOP timeout numkeys key... MIN|MAX [COUNT n]."""
-    timeout = float(args[0])
-    rest = args[1:]
+    timeout, rest, first_key = _bmpop_prelude(args)
 
     def poll_once():
         return cmd_zmpop(server, ctx, rest)
 
-    first_key = _s(rest[1])
     return _block_loop(server, first_key, poll_once, timeout)

@@ -803,3 +803,14 @@ class TestMapDepth:
         time.sleep(0.05)
         assert mc.random_keys(5) == ["live"]
         assert mc.random_entries(5) == {"live": 2}
+
+    def test_list_relative_inserts_and_sublist(self, client):
+        lst = client.get_list("ld")
+        lst.add_all(["a", "c"])
+        assert lst.add_before("c", "b") == 3
+        assert lst.add_after("c", "d") == 4
+        assert lst.read_all() == ["a", "b", "c", "d"]
+        assert lst.add_before("missing", "x") == -1
+        assert lst.sub_list(1, 3) == ["b", "c"]
+        with pytest.raises(IndexError):
+            lst.sub_list(2, 9)
