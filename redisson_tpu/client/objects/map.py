@@ -107,9 +107,11 @@ class Map(RExpirable):
         return rec.host.get(ek)
 
     def _raw_get_for_update(self, rec, ek: bytes):
-        """Old-value fetch inside WRITE paths.  Same as _raw_get here;
-        MapCache overrides it to skip the access-tracking touch — a write
-        must not count as a read or LFU ranks writers above readers."""
+        """NON-TOUCHING value fetch: write paths reading the old value, and
+        sampling/warm-up probes (random_keys/random_entries/load_all).
+        Same as _raw_get here; MapCache overrides it to skip access
+        tracking — none of those callers may refresh max-idle clocks or
+        count as LFU reads."""
         return self._raw_get(rec, ek)
 
     def _raw_put(self, rec, ek: bytes, ev: bytes):
@@ -387,7 +389,8 @@ class Map(RExpirable):
 
     def random_keys(self, count: int) -> List:
         """HRANDFIELD-style sample of distinct LIVE keys (RMap.randomKeys) —
-        sampled through _raw_get so MapCache expiry applies."""
+        the non-touching probe applies MapCache expiry without refreshing
+        access tracking."""
         import random as _random
 
         with self._engine.locked(self._name):

@@ -143,7 +143,7 @@ class Queue(RExpirable):
 
     # wakeup plumbing shared with blocking subclasses
     def _wait_entry(self) -> WaitEntry:
-        return self._engine.wait_entry(f"__q_wait__:{self._name}")
+        return self._engine.queue_wait_entry(self._name)
 
     def _signal(self):
         self._wait_entry().signal(all_=True)

@@ -532,7 +532,7 @@ class ScoredSortedSet(RExpirable):
         import time as _t
 
         deadline = None if timeout is None else _t.time() + timeout
-        entry = self._engine.wait_entry(f"__q_wait__:{self._name}")
+        entry = self._engine.queue_wait_entry(self._name)
         while True:
             v = poll_fn()
             if v is not None:

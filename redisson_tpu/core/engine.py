@@ -166,10 +166,16 @@ class Engine:
                 )
             return self._timer_pool
 
+    def queue_wait_entry(self, name: str):
+        """The wait entry blocking-queue-family consumers park on — the ONE
+        authority for the __q_wait__ key format (paired with
+        signal_queue_waiters; hand-built keys at park sites would silently
+        strand waiters if the format ever moved)."""
+        return self.wait_entry(f"__q_wait__:{name}")
+
     def signal_queue_waiters(self, name: str) -> None:
         """Wake queue-family waiters parked on `name` WITHOUT materializing
-        a wait entry when nobody waits — the ONE authority for the
-        __q_wait__ key format (BlockingQueue/BZPOP/take_first parking)."""
+        a wait entry when nobody waits."""
         e = self._wait_entries.get(f"__q_wait__:{name}")
         if e is not None:
             e.signal(all_=True)

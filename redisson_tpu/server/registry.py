@@ -2693,7 +2693,7 @@ def _block_loop(server, first_key: str, poll_once, timeout: float):
     import time as _t
 
     deadline = None if timeout <= 0 else _t.time() + timeout
-    entry = server.engine.wait_entry(f"__q_wait__:{first_key}")
+    entry = server.engine.queue_wait_entry(first_key)
     while True:
         r = poll_once()
         if r is not None:
@@ -3928,13 +3928,18 @@ def _bmpop_prelude(args):
     """Shared BLMPOP/BZMPOP validation: timeout + numkeys BEFORE any
     delegation, so malformed input replies a syntax error, never ERR
     internal."""
+    import math as _math
+
+    if len(args) < 4:
+        raise RespError("ERR wrong number of arguments")
     try:
         timeout = float(args[0])
     except (TypeError, ValueError):
         raise RespError("ERR timeout is not a float or out of range")
+    if not _math.isfinite(timeout) or timeout < 0:
+        # NaN would make every deadline comparison False: park forever
+        raise RespError("ERR timeout is not a float or out of range")
     rest = args[1:]
-    if len(rest) < 3:
-        raise RespError("ERR wrong number of arguments")
     n = _int(rest[0])
     if n <= 0:
         raise RespError("ERR numkeys should be greater than 0")

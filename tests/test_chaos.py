@@ -117,3 +117,76 @@ def test_concurrent_multi_writer_objects():
         if client is not None:
             client.shutdown()
         runner.shutdown()
+
+
+def test_blocking_consumer_survives_failover():
+    """A BLPOP consumer parked on the dying master reconnects and keeps
+    consuming after the replica is promoted (the ElementsSubscribe +
+    isBlockingCommand resilience story, end to end)."""
+    runner = ClusterRunner(masters=2, replicas_per_master=1).run()
+    coord = None
+    client = None
+    try:
+        client = runner.client(scan_interval=0.5)
+        coord = FailoverCoordinator(runner.view_tuples(), check_interval=0.1).start()
+        time.sleep(0.4)
+
+        tag = "bq"
+        slot = calc_slot(tag.encode())
+        mi = next(i for i, (lo, hi) in enumerate(runner.slot_ranges) if lo <= slot <= hi)
+        qname = f"jobs{{{tag}}}"
+
+        consumed = []
+        stop = threading.Event()
+
+        def consumer():
+            while not stop.is_set():
+                try:
+                    got = client.execute("BLPOP", qname, 1)
+                    if got is not None:
+                        consumed.append(bytes(got[1]))
+                except Exception:  # noqa: BLE001 — outage window: retry
+                    time.sleep(0.1)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        # feed a few jobs, prove consumption, then kill the master mid-stream
+        for i in range(5):
+            client.execute("RPUSH", qname, f"pre-{i}")
+        deadline = time.time() + 10
+        while time.time() < deadline and len(consumed) < 5:
+            time.sleep(0.05)
+        assert len(consumed) == 5, consumed
+
+        runner.stop_master(mi)
+        deadline = time.time() + 20
+        while time.time() < deadline and not coord.failovers:
+            time.sleep(0.2)
+        assert coord.failovers, "no automatic failover happened"
+        time.sleep(1.5)
+        client.refresh_topology()
+
+        # jobs pushed AFTER promotion reach the parked consumer
+        produced = []
+        deadline = time.time() + 15
+        i = 0
+        while time.time() < deadline and len(consumed) < 8:
+            try:
+                client.execute("RPUSH", qname, f"post-{i}")
+                produced.append(f"post-{i}".encode())
+                i += 1
+            except Exception:  # noqa: BLE001 — routing may still settle
+                pass
+            time.sleep(0.2)
+        stop.set()
+        t.join(10)
+        assert not t.is_alive()
+        post = [c for c in consumed if c.startswith(b"post-")]
+        assert post, f"consumer never resumed after failover: {consumed}"
+        assert set(post) <= set(produced), "consumed a job that was never acked"
+    finally:
+        if coord is not None:
+            coord.stop()
+        if client is not None:
+            client.shutdown()
+        runner.shutdown()
