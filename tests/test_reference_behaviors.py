@@ -1,0 +1,173 @@
+"""Behavioral edges for the thinly-covered objects (RedissonTimeSeriesTest /
+RedissonBinaryStreamTest / RedissonGeoTest / RedissonAtomicDouble+AdderTest /
+RedissonIdGeneratorTest / RedissonRateLimiterTest analogs)."""
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+
+
+@pytest.fixture()
+def client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+class TestTimeSeries:
+    def test_add_range_order_and_bounds(self, client):
+        ts = client.get_time_series("ts")
+        for t, v in [(3.0, "c"), (1.0, "a"), (2.0, "b")]:
+            ts.add(t, v)
+        assert ts.size() == 3
+        assert [v for _t, v in ts.range(0, 10)] == ["a", "b", "c"]
+        assert [v for _t, v in ts.range(1.5, 10)] == ["b", "c"]
+        assert [v for _t, v in ts.range(0, 10, limit=2)] == ["a", "b"]
+        assert [v for _t, v in ts.range_reversed(0, 10)] == ["c", "b", "a"]
+        assert ts.first() == ["a"] and ts.last() == ["c"]
+        assert ts.first_timestamp() == 1.0 and ts.last_timestamp() == 3.0
+
+    def test_get_remove_and_range_removal(self, client):
+        ts = client.get_time_series("ts2")
+        ts.add(1.0, "a")
+        ts.add(2.0, "b")
+        ts.add(3.0, "c")
+        assert ts.get(2.0) == "b"
+        assert ts.get(9.0) is None
+        assert ts.remove(2.0) and not ts.remove(2.0)
+        assert ts.remove_range(0.0, 1.5) == 1
+        assert [v for _t, v in ts.range(0, 10)] == ["c"]
+
+    def test_entry_ttl_expires(self, client):
+        ts = client.get_time_series("ts3")
+        ts.add(1.0, "mayfly", ttl=0.05)
+        ts.add(2.0, "stone")
+        assert ts.get(1.0) == "mayfly"
+        time.sleep(0.07)
+        assert ts.get(1.0) is None
+        assert ts.size() == 1
+
+    def test_poll_first_last(self, client):
+        ts = client.get_time_series("ts4")
+        for t in (1.0, 2.0, 3.0):
+            ts.add(t, f"v{t}")
+        assert ts.poll_first() == ["v1.0"]
+        assert ts.poll_last() == ["v3.0"]
+        assert ts.poll_first(5) == ["v2.0"]  # clamped to remaining
+        assert ts.size() == 0
+
+
+class TestBinaryStream:
+    def test_stream_io(self, client):
+        bs = client.get_binary_stream("bin")
+        bs.set(b"hello world")
+        assert bs.size() == 11
+        assert bs.get() == b"hello world"
+        assert bs.read(6, 5) == b"world"
+        assert bs.read(6, 100) == b"world"  # clamped tail read
+        assert bs.write(6, b"earth") == 11
+        assert bs.get() == b"hello earth"
+        assert bs.append(b"!") == 12
+        assert bs.get() == b"hello earth!"
+
+    def test_write_past_end_zero_fills(self, client):
+        bs = client.get_binary_stream("bin2")
+        bs.write(3, b"x")
+        assert bs.get() == b"\x00\x00\x00x"
+
+
+class TestGeo:
+    LON_B, LAT_B = 13.405, 52.52      # berlin
+    LON_P, LAT_P = 2.3522, 48.8566    # paris
+
+    def test_add_pos_dist_hash(self, client):
+        g = client.get_geo("geo")
+        assert g.add(self.LON_B, self.LAT_B, "berlin") == 1
+        assert g.add(self.LON_B, self.LAT_B, "berlin") == 0  # update
+        g.add_all({"paris": (self.LON_P, self.LAT_P)})
+        pos = g.pos("berlin", "ghost")
+        assert abs(pos["berlin"][0] - self.LON_B) < 1e-9
+        assert "ghost" not in pos
+        d = g.dist("berlin", "paris", unit="km")
+        assert 850 < d < 900  # great-circle ~878km
+        assert g.dist("berlin", "ghost") is None
+        h = g.hash("berlin")["berlin"]
+        assert h.startswith("u33")  # well-known berlin geohash prefix
+
+    def test_search_and_store(self, client):
+        g = client.get_geo("geo2")
+        g.add_all({"berlin": (self.LON_B, self.LAT_B),
+                   "paris": (self.LON_P, self.LAT_P),
+                   "potsdam": (13.06, 52.4)})
+        near = g.search_radius(self.LON_B, self.LAT_B, 50, unit="km")
+        assert near == ["berlin", "potsdam"]  # ASC by distance
+        far = g.search_radius(self.LON_B, self.LAT_B, 2000, unit="km", count=2, order="DESC")
+        assert far[0] == "paris"
+        member = g.search_member_radius("berlin", 50, unit="km")
+        assert "potsdam" in member
+        with pytest.raises(KeyError):
+            g.search_member_radius("ghost", 1)
+        box = g.search_box(self.LON_B, self.LAT_B, 80, 40, unit="km")
+        assert set(box) == {"berlin", "potsdam"}
+        assert g.store_search_radius_to("geo2:near", self.LON_B, self.LAT_B, 50, unit="km") == 2
+        assert client.get_geo("geo2:near").size() == 2
+        assert g.remove("potsdam") and not g.remove("ghost")
+
+
+class TestAdders:
+    def test_long_adder_multi_instance_sum(self, client):
+        a = client.get_long_adder("hits")
+        b = client.get_long_adder("hits")
+        a.add(5)
+        b.increment()
+        b.increment()
+        assert a.sum() == 7 and b.sum() == 7  # pubsub'd cross-instance sum
+        a.reset()
+        assert b.sum() == 0
+
+    def test_double_adder(self, client):
+        a = client.get_double_adder("temp")
+        a.add(1.5)
+        a.add(2.25)
+        assert a.sum() == pytest.approx(3.75)
+
+    def test_adder_concurrent_increments(self, client):
+        a = client.get_long_adder("conc")
+
+        def worker():
+            for _ in range(200):
+                a.increment()
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        assert a.sum() == 800
+
+
+class TestIdGeneratorAndRateLimiter:
+    def test_id_generator_block_rollover_and_uniqueness(self, client):
+        idg = client.get_id_generator("ids")
+        assert idg.try_init(start=100, allocation_size=10)
+        seen = {idg.next_id() for _ in range(25)}  # crosses 2 block refills
+        assert len(seen) == 25
+        assert min(seen) == 100 and max(seen) == 124
+
+    def test_rate_limiter_refill_over_time(self, client):
+        rl = client.get_rate_limiter("rl")
+        assert rl.try_set_rate("OVERALL", 2, 0.2)  # 2 permits / 200ms
+        assert rl.try_acquire() and rl.try_acquire()
+        assert not rl.try_acquire()  # window exhausted
+        time.sleep(0.25)
+        assert rl.try_acquire()  # refilled
+
+    def test_rate_limiter_per_client_scope(self, client):
+        rl = client.get_rate_limiter("rl2")
+        assert rl.try_set_rate("PER_CLIENT", 1, 60.0)
+        assert rl.try_acquire()
+        assert not rl.try_acquire()
+        # set_rate is one-shot like the reference
+        assert not rl.try_set_rate("OVERALL", 100, 1.0)
