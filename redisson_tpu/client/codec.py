@@ -378,7 +378,10 @@ class ReferenceCodec(Codec):
             payload = {
                 "m": cls.__module__,
                 "c": cls.__name__,
-                "n": value._name,
+                # LOGICAL name: the decode path rebuilds through a factory
+                # whose ctor re-applies the NameMapper (a stored key here
+                # would double-map)
+                "n": value._unmap_name(value._name),
                 "codec": _codec_spec(inner),
             }
             return _RREF_MAGIC + json.dumps(payload).encode()

@@ -20,7 +20,11 @@ class RObject:
         from redisson_tpu.client.codec import ReferenceCodec
 
         self._engine = engine
-        self._name = name
+        # NameMapper SPI (config/Config.java NameMapper): logical name ->
+        # stored key, applied at handle construction exactly like the
+        # reference's RedissonObject ctor maps via config.getNameMapper()
+        mapper = getattr(engine.config, "name_mapper", None)
+        self._name = mapper.map(name) if mapper is not None else name
         # every handle's codec is reference-aware: storing another handle
         # persists a typed RedissonReference, not a serialized copy
         # (client/codec.py ReferenceCodec; RedissonObjectBuilder analog)
@@ -44,7 +48,11 @@ class RObject:
         codec = self._codec.inner if isinstance(self._codec, ReferenceCodec) else self._codec
         return (
             ObjectRef,
-            (type(self).__module__, type(self).__name__, self._name, _codec_spec(codec)),
+            # references carry the LOGICAL name: resolution re-enters a
+            # factory whose ctor maps again (a stored key here would
+            # double-map under a NameMapper)
+            (type(self).__module__, type(self).__name__,
+             self._unmap_name(self._name), _codec_spec(codec)),
         )
 
     @property
@@ -62,11 +70,23 @@ class RObject:
         with self._engine.locked(self._name):
             return self._engine.store.delete(self._name)
 
+    def _map_name(self, name: str) -> str:
+        """Logical -> stored key for OTHER-object name parameters (dest
+        names, combination operands): cross-key ops must address the same
+        namespace this handle's own name was mapped into."""
+        mapper = getattr(self._engine.config, "name_mapper", None)
+        return mapper.map(name) if mapper is not None else name
+
+    def _unmap_name(self, key: str) -> str:
+        mapper = getattr(self._engine.config, "name_mapper", None)
+        return mapper.unmap(key) if mapper is not None else key
+
     def rename(self, new_name: str) -> None:
+        mapped = self._map_name(new_name)  # stay inside the namespace
         with self._engine.locked(self._name):
-            if not self._engine.store.rename(self._name, new_name):
+            if not self._engine.store.rename(self._name, mapped):
                 raise KeyError(f"object '{self._name}' does not exist")
-            self._name = new_name
+            self._name = mapped
 
     def _record(self):
         return self._engine.store.get(self._name)

@@ -148,6 +148,7 @@ class BitSet(RExpirable):
     # -- BITOP against other bit sets (RedissonBitSet.java:387-446) ---------
 
     def _binary_op(self, op, other_names: Sequence[str]) -> None:
+        other_names = [self._map_name(n) for n in other_names]
         names = (self._name, *other_names)
         with self._engine.locked_many(names):
             rec = self._rec_or_create()

@@ -110,13 +110,14 @@ class Buckets:
 
     def try_set(self, values: Dict[str, Any]) -> bool:
         """MSETNX: all-or-nothing if any key exists."""
-        names = sorted(values)
-        with self._engine.locked_many(names):
-            for nm in names:
-                if Bucket(self._engine, nm, self._codec).get() is not None:
+        # handles map names (NameMapper); the lock must cover the MAPPED keys
+        handles = {nm: Bucket(self._engine, nm, self._codec) for nm in sorted(values)}
+        with self._engine.locked_many([h._name for h in handles.values()]):
+            for h in handles.values():
+                if h.get() is not None:
                     return False
-            for nm in names:
-                Bucket(self._engine, nm, self._codec).set(values[nm])
+            for nm, h in handles.items():
+                h.set(values[nm])
             return True
 
 

@@ -196,8 +196,8 @@ class Geo(RExpirable):
         """GEOSEARCHSTORE: store hits (as a geo set) into dest."""
         pairs = self._search_point(lon, lat, radius * _UNITS[unit], None, "ASC")
         rec = self._engine.store.get(self._name)
-        with self._engine.locked_many((self._name, dest_name)):
-            dest = Geo(self._engine, dest_name, self._codec)
+        dest = Geo(self._engine, dest_name, self._codec)  # maps dest_name
+        with self._engine.locked_many((self._name, dest._name)):
             drec = dest._rec_or_create()
             for m, _ in pairs:
                 drec.host[m] = rec.host[m]

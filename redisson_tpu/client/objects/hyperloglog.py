@@ -69,7 +69,7 @@ class HyperLogLog(RExpirable):
 
     def count_with(self, *other_names: str) -> int:
         """PFCOUNT over the union of this and other counters, non-destructive."""
-        names = (self._name, *other_names)
+        names = (self._name, *(self._map_name(n) for n in other_names))
         with self._engine.locked_many(names):
             regs = None
             for nm in names:
@@ -85,6 +85,7 @@ class HyperLogLog(RExpirable):
 
     def merge_with(self, *other_names: str) -> None:
         """PFMERGE other counters into this one (RedissonHyperLogLog.java:96-102)."""
+        other_names = [self._map_name(n) for n in other_names]
         with self._engine.locked_many((self._name, *other_names)):
             rec = self._rec_or_create()
             regs = rec.arrays["regs"]

@@ -128,17 +128,19 @@ class Queue(RExpirable):
 
     def poll_last_and_offer_first_to(self, dest_name: str):
         """RPOPLPUSH (RQueue.pollLastAndOfferFirstTo)."""
-        with self._engine.locked_many((self._name, dest_name)):
+        # construct the dest handle FIRST: its ctor applies the NameMapper,
+        # and the lock must cover the mapped key it will actually mutate
+        dest = type(self)(self._engine, dest_name, self._codec)
+        with self._engine.locked_many((self._name, dest._name)):
             rec = self._rec_or_create()
             if not rec.host:
                 return None
             raw = rec.host.pop()
-            dest = type(self)(self._engine, dest_name, self._codec)
             drec = dest._rec_or_create()
             drec.host.insert(0, raw)
             self._touch_version(rec)
             self._touch_version(drec)
-        type(self)(self._engine, dest_name, self._codec)._signal()
+        dest._signal()
         return self._d(raw)
 
     # wakeup plumbing shared with blocking subclasses
@@ -396,19 +398,19 @@ class PriorityQueue(Queue):
         """Moves the comparator-greatest element to the head of `dest_name`
         (RPOPLPUSH shape; the destination is a priority queue of the same
         type, so "first" means heap order there too)."""
-        with self._engine.locked_many((self._name, dest_name)):
+        dest = type(self)(self._engine, dest_name, self._codec, self._key)
+        with self._engine.locked_many((self._name, dest._name)):
             rec = self._rec_or_create()
             if not rec.host:
                 return None
             i = max(range(len(rec.host)), key=lambda j: rec.host[j])
             hk, raw = rec.host.pop(i)
             heapq.heapify(rec.host)
-            dest = type(self)(self._engine, dest_name, self._codec, self._key)
             drec = dest._rec_or_create()
             heapq.heappush(drec.host, (hk, raw))
             self._touch_version(rec)
             self._touch_version(drec)
-        type(self)(self._engine, dest_name, self._codec, self._key)._signal()
+        dest._signal()
         return self._d(raw)
 
 

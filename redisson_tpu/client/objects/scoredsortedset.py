@@ -397,6 +397,7 @@ class ScoredSortedSet(RExpirable):
         return acc
 
     def _combine_store(self, names, op: str, aggregate: str = "SUM") -> int:
+        names = [self._map_name(n) for n in names]
         with self._engine.locked_many((self._name, *names)):
             rec = self._rec_or_create()
             acc = self._accumulate(self._gather((self._name, *names)), op, aggregate)
@@ -418,6 +419,7 @@ class ScoredSortedSet(RExpirable):
     # -- combination reads (readUnion/readIntersection/readDiff) -------------
 
     def _combine_read(self, names, op: str, aggregate: str = "SUM") -> List:
+        names = [self._map_name(n) for n in names]
         with self._engine.locked_many((self._name, *names)):
             maps = self._gather((self._name, *names))
         acc = self._accumulate(maps, op, aggregate)
@@ -437,6 +439,7 @@ class ScoredSortedSet(RExpirable):
         """ZINTERCARD (RScoredSortedSet.countIntersection) — counts the
         accumulator directly; decoding/sorting members to len() them would
         pay the full read cost for a number."""
+        names = tuple(self._map_name(n) for n in names)
         with self._engine.locked_many((self._name, *names)):
             n = len(self._accumulate(self._gather((self._name, *names)), "inter"))
         return min(n, limit) if limit else n

@@ -151,11 +151,12 @@ class Set(RExpirable):
     def move(self, dest_name: str, value) -> bool:
         """SMOVE (RedissonSet.move)."""
         e = self._e(value)
-        with self._engine.locked_many((self._name, dest_name)):
+        dest_h = Set(self._engine, dest_name, self._codec)  # maps dest_name
+        with self._engine.locked_many((self._name, dest_h._name)):
             rec = self._rec_or_create()
             if e not in rec.host:
                 return False
-            dest = Set(self._engine, dest_name, self._codec)._rec_or_create()
+            dest = dest_h._rec_or_create()
             rec.host.discard(e)
             dest.host.add(e)
             self._touch_version(rec)
@@ -165,6 +166,7 @@ class Set(RExpirable):
     # -- set algebra (SUNION/SINTER/SDIFF + STORE variants) ------------------
 
     def _others(self, names):
+        """`names` are STORED keys (callers map logical operands once)."""
         out = []
         for nm in names:
             rec = self._engine.store.get(nm)
@@ -172,6 +174,7 @@ class Set(RExpirable):
         return out
 
     def read_union(self, *names: str) -> List:
+        names = tuple(self._map_name(n) for n in names)
         with self._engine.locked_many((self._name, *names)):
             rec = self._rec_or_create()
             acc = set(rec.host)
@@ -180,6 +183,7 @@ class Set(RExpirable):
         return [self._d(e) for e in acc]
 
     def read_intersection(self, *names: str) -> List:
+        names = tuple(self._map_name(n) for n in names)
         with self._engine.locked_many((self._name, *names)):
             rec = self._rec_or_create()
             acc = set(rec.host)
@@ -188,6 +192,7 @@ class Set(RExpirable):
         return [self._d(e) for e in acc]
 
     def read_diff(self, *names: str) -> List:
+        names = tuple(self._map_name(n) for n in names)
         with self._engine.locked_many((self._name, *names)):
             rec = self._rec_or_create()
             acc = set(rec.host)
@@ -197,6 +202,7 @@ class Set(RExpirable):
 
     def union(self, *names: str) -> int:
         """SUNIONSTORE into this set; returns resulting size."""
+        names = tuple(self._map_name(n) for n in names)
         with self._engine.locked_many((self._name, *names)):
             rec = self._rec_or_create()
             acc = set()
@@ -208,6 +214,7 @@ class Set(RExpirable):
             return len(rec.host)
 
     def intersection(self, *names: str) -> int:
+        names = tuple(self._map_name(n) for n in names)
         with self._engine.locked_many((self._name, *names)):
             rec = self._rec_or_create()
             sets = self._others((self._name, *names))
@@ -220,6 +227,7 @@ class Set(RExpirable):
             return len(rec.host)
 
     def diff(self, *names: str) -> int:
+        names = tuple(self._map_name(n) for n in names)
         with self._engine.locked_many((self._name, *names)):
             rec = self._rec_or_create()
             sets = self._others((self._name, *names))

@@ -363,6 +363,7 @@ class ShardedBitSet(_ShardedBase):
     def _binary_op(self, op, other_names):
         """BITOP against other sharded bitsets: identically-sharded planes,
         elementwise combine — XLA emits zero collectives."""
+        other_names = [self._map_name(n) for n in other_names]
         names = [self._name, *other_names]
         with self._engine.locked_many(names):
             rec = self._rec()

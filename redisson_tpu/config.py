@@ -170,7 +170,9 @@ class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
 
     # -- SPI slots (reference extension points, §5.6) -------------------------
-    # name_mapper: map logical object names to stored keys (NameMapper)
+    # name_mapper: logical object name -> stored key, applied at handle
+    # construction (NameMapper SPI).  Must expose map(name) and unmap(key);
+    # see NameMapper below for the prefix convenience implementation.
     name_mapper: Any = None
     # engine hooks: instrumentation callbacks (NettyHook analog, §5.1)
     hooks: List[Any] = field(default_factory=list)
@@ -262,3 +264,24 @@ def _build(cls, data: Dict[str, Any]):
         if _known_field(cls, sk):
             kwargs[sk] = v
     return cls(**kwargs)
+
+
+class NameMapper:
+    """Prefix/suffix NameMapper (the reference ships the same convenience:
+    org/redisson/api/NameMapper.direct()/prefix()).  Custom mappers only
+    need map(name) -> stored key and unmap(key) -> logical name."""
+
+    def __init__(self, prefix: str = "", suffix: str = ""):
+        self.prefix = prefix
+        self.suffix = suffix
+
+    def map(self, name: str) -> str:
+        return f"{self.prefix}{name}{self.suffix}"
+
+    def unmap(self, key: str) -> str:
+        out = key
+        if self.prefix and out.startswith(self.prefix):
+            out = out[len(self.prefix):]
+        if self.suffix and out.endswith(self.suffix):
+            out = out[: -len(self.suffix)]
+        return out

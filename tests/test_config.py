@@ -123,3 +123,75 @@ def test_codec_objects_end_to_end():
             assert bf.contains("item-1")
     finally:
         client.shutdown()
+
+
+def test_name_mapper_applies_at_handle_construction():
+    """NameMapper SPI: logical names map to stored keys for every handle
+    (the reference applies it in the RedissonObject ctor)."""
+    import redisson_tpu
+    from redisson_tpu.config import Config, NameMapper
+
+    cfg = Config()
+    cfg.name_mapper = NameMapper(prefix="tenant7:")
+    c = redisson_tpu.create(cfg)
+    try:
+        c.get_bucket("cfg").set(1)
+        assert c.get_bucket("cfg").name == "tenant7:cfg"
+        assert c._engine.store.exists("tenant7:cfg")
+        assert not c._engine.store.exists("cfg")
+        # two logical names, one mapper: isolation holds per mapped key
+        m = c.get_map("m")
+        m.put("k", "v")
+        assert c._engine.store.exists("tenant7:m")
+        assert cfg.name_mapper.unmap("tenant7:m") == "m"
+    finally:
+        c.shutdown()
+
+
+def test_name_mapper_no_double_mapping():
+    """Regression (review findings): references, renames, cross-key ops and
+    the Keys surface all stay inside the mapped namespace exactly once."""
+    import redisson_tpu
+    from redisson_tpu.config import Config, NameMapper
+
+    cfg = Config()
+    cfg.name_mapper = NameMapper(prefix="t:")
+    c = redisson_tpu.create(cfg)
+    try:
+        # object references round-trip without double-prefixing
+        b = c.get_bucket("cfg")
+        b.set(41)
+        c.get_map("m").put("ref", b)
+        h = c.get_map("m").get("ref")
+        assert h.name == "t:cfg" and h.get() == 41
+        # rename stays in the namespace
+        b2 = c.get_bucket("a")
+        b2.set(1)
+        b2.rename("b")
+        assert b2.name == "t:b"
+        assert c.get_bucket("b").get() == 1
+        # cross-key op: lock and record agree (SMOVE into mapped dest)
+        s1, s2 = c.get_set("s1"), c.get_set("s2")
+        s1.add("x")
+        assert s1.move("s2", "x")
+        assert s2.contains("x")
+        assert c._engine.store.exists("t:s2")
+        # zset combination reads address mapped operands
+        za, zb = c.get_scored_sorted_set("za"), c.get_scored_sorted_set("zb")
+        za.add(1, "m")
+        zb.add(2, "n")
+        assert sorted(za.read_union("zb")) == ["m", "n"]
+        # Keys admin surface: logical in, logical out
+        keys = c.get_keys()
+        assert keys.count_exists("cfg", "nope") == 1
+        assert "cfg" in keys.get_keys()
+        assert keys.delete("cfg") == 1
+        assert not c._engine.store.exists("t:cfg")
+        # rpoplpush locks/mutates the mapped dest
+        q = c.get_queue("src")
+        q.offer("j")
+        assert q.poll_last_and_offer_first_to("dst") == "j"
+        assert c.get_queue("dst").peek() == "j"
+        assert c._engine.store.exists("t:dst")
+    finally:
+        c.shutdown()
