@@ -96,6 +96,9 @@ class ClusterRedisson(RemoteSurface):
         self.max_redirects = max_redirects
         self._balancer_factory = balancer
         self._node_kw = dict(node_kw)
+        # config-level SPIs ride every node connection of the cluster
+        self._node_kw.setdefault("credentials_resolver", self.config.credentials_resolver)
+        self._node_kw.setdefault("command_mapper", self.config.command_mapper)
         # one ConnectionEventsHub shared by every node of the cluster:
         # listeners see per-ADDRESS edge-triggered connect/disconnect
         from redisson_tpu.net.detectors import ConnectionEventsHub
@@ -215,6 +218,15 @@ class ClusterRedisson(RemoteSurface):
         if view is None:
             return False
         new_slots, masters = routing.parse_view(view)
+        nat = self.config.nat_mapper
+        if nat is not None:
+            # NatMapper SPI: advertised addresses -> reachable addresses
+            # (container/NAT topologies, api/NatMapper.java role).  Mapped
+            # once per DISTINCT address — a real mapper may do table/DNS
+            # work, and the slot array has 16384 entries
+            table = {a: nat.map(a) for a in masters}
+            new_slots = [None if a is None else table.get(a, a) for a in new_slots]
+            masters = {table[a]: None for a in masters}
         with self._lock:
             existing = dict(self._entries)
         fresh: Dict[str, ShardEntry] = {}
@@ -259,9 +271,11 @@ class ClusterRedisson(RemoteSurface):
                     reps = entry.master.execute(
                         "REPLICAS", timeout=5.0, retry_attempts=0
                     )
-                    entry.sync_replicas(
-                        [r.decode() if isinstance(r, bytes) else r for r in reps]
-                    )
+                    rep_addrs = [r.decode() if isinstance(r, bytes) else r for r in reps]
+                    if self.config.nat_mapper is not None:
+                        # replicas advertise internal addresses too
+                        rep_addrs = [self.config.nat_mapper.map(a) for a in rep_addrs]
+                    entry.sync_replicas(rep_addrs)
                 except Exception:  # noqa: BLE001 — master briefly down
                     pass
         with self._lock:
@@ -385,6 +399,8 @@ class ClusterRedisson(RemoteSurface):
     def _execute_asking(self, target: str, cmd_args, timeout) -> Any:
         """ASKING + command on ONE connection of the importing node (the
         RedisExecutor ASK path: same connection, no slot-table update)."""
+        if self.config.nat_mapper is not None:
+            target = self.config.nat_mapper.map(target)  # ASK advertises too
         with self._lock:
             entry = self._entries.get(target)
         transient = None

@@ -27,14 +27,19 @@ class Keys:
         mapper = getattr(self._engine.config, "name_mapper", None)
         return mapper.unmap(key) if mapper is not None else key
 
+    def _map_pattern(self, pattern: Optional[str]) -> Optional[str]:
+        # patterns are LOGICAL too: prefix mappers compose naturally
+        # ("cfg*" -> "t:cfg*"); identity mappers are no-ops
+        return None if pattern is None else self._map(pattern)
+
     def get_keys(self, pattern: Optional[str] = None) -> List[str]:
-        """LOGICAL names (unmapped) — the result must round-trip into
+        """LOGICAL names in and out — results must round-trip into
         get_bucket()/delete() without double-prefixing."""
-        return [self._unmap(k) for k in self._engine.store.keys(pattern)]
+        return [self._unmap(k) for k in self._engine.store.keys(self._map_pattern(pattern))]
 
     def get_keys_stream(self, pattern: Optional[str] = None, chunk: int = 10) -> Iterator[str]:
         """Cursor-style iteration (SCAN analog; chunk mirrors COUNT)."""
-        for name in self._engine.store.keys(pattern):
+        for name in self._engine.store.keys(self._map_pattern(pattern)):
             yield self._unmap(name)
 
     def count(self) -> int:
@@ -57,9 +62,8 @@ class Keys:
         return n
 
     def delete_by_pattern(self, pattern: str) -> int:
-        # pattern matches STORED keys; delete by stored key directly
         n = 0
-        for key in self._engine.store.keys(pattern):
+        for key in self._engine.store.keys(self._map_pattern(pattern)):
             with self._engine.locked(key):
                 if self._engine.store.delete(key):
                     n += 1

@@ -222,12 +222,17 @@ class BlockingQueue(Queue):
         return self._poll_blocking_impl(self.poll, timeout)
 
     def poll_from_any(self, timeout: Optional[float], *other_names: str):
-        """BLPOP across several queues (RBlockingQueue.pollFromAny)."""
-        names = (self._name, *other_names)
+        """BLPOP across several queues (RBlockingQueue.pollFromAny).
+        Handles are built ONCE from logical names (the ctor applies the
+        NameMapper; re-feeding self._name through it would double-map),
+        and the returned name is the logical one the caller passed."""
+        pairs = [(self._unmap_name(self._name), self)] + [
+            (nm, BlockingQueue(self._engine, nm, self._codec)) for nm in other_names
+        ]
         deadline = None if timeout is None else time.time() + timeout
         while True:
-            for nm in names:
-                v = BlockingQueue(self._engine, nm, self._codec).poll()
+            for nm, h in pairs:
+                v = h.poll()
                 if v is not None:
                     return nm, v
             remaining = None if deadline is None else deadline - time.time()

@@ -1107,27 +1107,27 @@ class RemoteSurface:
     # -- hot-path handles ----------------------------------------------------
 
     def get_bloom_filter(self, name: str, codec: Optional[Codec] = None) -> "RemoteBloomFilter":
-        return RemoteBloomFilter(self, name, codec)
+        return RemoteBloomFilter(self, self._map_name(name), codec)
 
     def get_bloom_filter_array(self, name: str) -> "RemoteBloomFilterArray":
-        return RemoteBloomFilterArray(self, name)
+        return RemoteBloomFilterArray(self, self._map_name(name))
 
     def get_hyper_log_log(self, name: str, codec: Optional[Codec] = None) -> "RemoteHyperLogLog":
-        return RemoteHyperLogLog(self, name, codec)
+        return RemoteHyperLogLog(self, self._map_name(name), codec)
 
     def get_bit_set(self, name: str) -> "RemoteBitSet":
-        return RemoteBitSet(self, name)
+        return RemoteBitSet(self, self._map_name(name))
 
     def get_bucket(self, name: str, codec: Optional[Codec] = None) -> "RemoteBucket":
-        return RemoteBucket(self, name, codec)
+        return RemoteBucket(self, self._map_name(name), codec)
 
     def get_topic(self, name: str, codec: Optional[Codec] = None) -> "RemoteTopic":
-        return RemoteTopic(self, name, codec)
+        return RemoteTopic(self, self._map_name(name), codec)
 
     def get_local_cached_map(
         self, name: str, codec: Optional[Codec] = None, options=None
     ) -> "RemoteLocalCachedMap":
-        return RemoteLocalCachedMap(self, name, options=options, codec=codec)
+        return RemoteLocalCachedMap(self, self._map_name(name), options=options, codec=codec)
 
     def create_batch(self, options: Optional["BatchOptions"] = None) -> "RemoteBatch":
         return RemoteBatch(self, options)
@@ -1158,17 +1158,25 @@ class RemoteSurface:
 
     _LOCK_FACTORIES = {"get_lock", "get_fair_lock", "get_spin_lock", "get_fenced_lock"}
 
+    def _map_name(self, name: str) -> str:
+        """NameMapper on the NETWORKED surface: remote handles carry the
+        STORED key so OBJCALL payloads, blob fast paths, and pubsub channel
+        names (lock unlock channels, invalidation topics) all agree with
+        what the server persists."""
+        mapper = getattr(getattr(self, "config", None), "name_mapper", None)
+        return mapper.map(name) if mapper is not None else name
+
     def __getattr__(self, factory: str):
         if factory in self._LOCK_FACTORIES:
 
             def make_lock(name: str, *_a, **_k) -> RemoteLock:
-                return RemoteLock(self, factory, name)
+                return RemoteLock(self, factory, self._map_name(name))
 
             return make_lock
         if factory in _GENERIC_FACTORIES:
 
             def make(name: str, codec: Optional[Codec] = None, *_a, **_k) -> RemoteObjectProxy:
-                return RemoteObjectProxy(self, factory, name, codec)
+                return RemoteObjectProxy(self, factory, self._map_name(name), codec)
 
             return make
         raise AttributeError(factory)
@@ -1198,6 +1206,9 @@ class RemoteRedisson(RemoteSurface):
                 ssl_context=ssc.build_ssl_context(),
             )
         kw.update(node_kw)
+        # config-level SPIs ride every connection of this facade
+        kw.setdefault("credentials_resolver", self.config.credentials_resolver)
+        kw.setdefault("command_mapper", self.config.command_mapper)
         # ConnectionEventsHub (connection/ConnectionEventsHub.java):
         # edge-triggered connect/disconnect fan-out for this facade
         from redisson_tpu.net.detectors import ConnectionEventsHub

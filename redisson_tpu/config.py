@@ -174,6 +174,18 @@ class Config:
     # construction (NameMapper SPI).  Must expose map(name) and unmap(key);
     # see NameMapper below for the prefix convenience implementation.
     name_mapper: Any = None
+    # command_mapper: wire verb rename (CommandMapper SPI — managed Redis
+    # deployments rename dangerous commands).  map(name) -> name, applied
+    # just before the frame is written.
+    command_mapper: Any = None
+    # credentials_resolver: callable(address) -> (username, password) | None,
+    # resolved PER CONNECTION ATTEMPT so rotated secrets apply live
+    # (CredentialsResolver SPI).
+    credentials_resolver: Any = None
+    # nat_mapper: advertised cluster address -> reachable address
+    # ("host:port" -> "host:port"), applied to CLUSTER SLOTS discoveries
+    # (NatMapper SPI — container/NAT topologies).
+    nat_mapper: Any = None
     # engine hooks: instrumentation callbacks (NettyHook analog, §5.1)
     hooks: List[Any] = field(default_factory=list)
 

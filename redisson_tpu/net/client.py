@@ -438,8 +438,16 @@ class NodeClient:
         ssl_context: Optional[_ssl.SSLContext] = None,
         ssl_hostname: Optional[str] = None,
         events_hub=None,
+        credentials_resolver=None,
+        command_mapper=None,
     ):
         self.address = address
+        # CredentialsResolver SPI (config/CredentialsResolver): resolved PER
+        # CONNECTION ATTEMPT so rotated secrets apply without a restart
+        self._credentials_resolver = credentials_resolver
+        # CommandMapper SPI (config/CommandMapper): renamed-command support
+        # for managed deployments; applied just before the wire write
+        self._command_mapper = command_mapper
         self.host, self.port = parse_address(address)
         # ConnectionEventsHub (detectors.py): edge-triggered connect/
         # disconnect fan-out shared by every NodeClient of one facade
@@ -472,14 +480,19 @@ class NodeClient:
             self._ping_thread.start()
 
     def _connect(self) -> Connection:
+        username, password = self._username, self._password
+        if self._credentials_resolver is not None:
+            creds = self._credentials_resolver(self.address)
+            if creds is not None:
+                username, password = creds
         try:
             conn = Connection(
                 self.host,
                 self.port,
                 connect_timeout=self._connect_timeout,
                 timeout=self.timeout,
-                password=self._password,
-                username=self._username,
+                password=password,
+                username=username,
                 client_name=self._client_name,
                 ssl_context=self._ssl_context,
                 ssl_hostname=self._ssl_hostname,
@@ -496,6 +509,13 @@ class NodeClient:
 
     # -- command path --------------------------------------------------------
 
+    def _mapped(self, args: tuple) -> tuple:
+        if self._command_mapper is None or not args:
+            return args
+        cmd = args[0]
+        name = cmd.decode() if isinstance(cmd, (bytes, bytearray)) else str(cmd)
+        return (self._command_mapper.map(name), *args[1:])
+
     def execute(
         self, *args, timeout: Optional[float] = None,
         retry_attempts: Optional[int] = None,
@@ -503,6 +523,7 @@ class NodeClient:
         """`retry_attempts=0` makes this a single-shot probe — topology
         refreshes ping candidate nodes this way so a dead master costs one
         refused connect, not retries-with-backoff under the refresh lock."""
+        args = self._mapped(args)
         if not self.hooks:
             return self._with_retry(
                 lambda c: c.execute(*args, timeout=timeout), retry_attempts
@@ -525,6 +546,8 @@ class NodeClient:
         return result
 
     def execute_many(self, commands: List[Tuple], timeout: Optional[float] = None) -> List[Any]:
+        if self._command_mapper is not None:
+            commands = [self._mapped(tuple(c)) for c in commands]
         if not self.hooks:
             return self._with_retry(lambda c: c.execute_many(commands, timeout=timeout))
         # the batch is ONE wire round trip: record it as one PIPELINE[n]
@@ -596,9 +619,16 @@ class NodeClient:
     def pubsub(self) -> PubSubConnection:
         with self._pubsub_lock:
             if self._pubsub is None or self._pubsub._conn.closed:
+                username, password = self._username, self._password
+                if self._credentials_resolver is not None:
+                    # pubsub connects/reconnects resolve like data conns:
+                    # a rotated secret must not strand re-subscriptions
+                    creds = self._credentials_resolver(self.address)
+                    if creds is not None:
+                        username, password = creds
                 fresh = PubSubConnection(
-                    self.host, self.port, password=self._password,
-                    username=self._username, ssl_context=self._ssl_context,
+                    self.host, self.port, password=password,
+                    username=username, ssl_context=self._ssl_context,
                     ssl_hostname=self._ssl_hostname,
                 )
                 if self._pubsub is not None:

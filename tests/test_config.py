@@ -195,3 +195,130 @@ def test_name_mapper_no_double_mapping():
         assert c._engine.store.exists("t:dst")
     finally:
         c.shutdown()
+
+
+def test_credentials_resolver_and_command_mapper():
+    """CredentialsResolver resolves per connection attempt; CommandMapper
+    renames verbs just before the wire write."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from redisson_tpu.client.remote import RemoteRedisson
+    from redisson_tpu.config import Config
+    from redisson_tpu.net.resp import RespError
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0, password="rotated-secret") as st:
+        calls = []
+
+        def resolver(address):
+            calls.append(address)
+            return (None, "rotated-secret")
+
+        cfg = Config()
+        ssc = cfg.use_single_server()
+        ssc.address = f"tpu://{st.address}"
+        cfg.credentials_resolver = resolver
+
+        class RenameDangerous:
+            def map(self, name):
+                return {"FLUSHALL": "FLUSHALL-RENAMED"}.get(name, name)
+
+        cfg.command_mapper = RenameDangerous()
+        c = RemoteRedisson(st.address, config=cfg)
+        try:
+            c.get_bucket("k").set(1)        # AUTH came from the resolver
+            assert calls, "resolver never consulted"
+            assert c.get_bucket("k").get() == 1
+            with pytest.raises(RespError, match="unknown command"):
+                c.execute("FLUSHALL")        # mapped to the renamed verb
+        finally:
+            c.shutdown()
+
+
+def test_nat_mapper_remaps_cluster_view():
+    from redisson_tpu.harness import ClusterRunner
+    from redisson_tpu.client.cluster import ClusterRedisson
+    from redisson_tpu.config import Config
+
+    runner = ClusterRunner(masters=2).run()
+    try:
+        real = runner.seeds()
+
+        class Nat:
+            """Advertised -> reachable: here an identity-with-log mapping
+            (the harness has no real NAT), proving the hook is applied."""
+
+            def __init__(self):
+                self.seen = []
+
+            def map(self, addr):
+                self.seen.append(addr)
+                return addr
+
+        cfg = Config()
+        cfg.nat_mapper = Nat()
+        client = ClusterRedisson(list(real), config=cfg, scan_interval=0)
+        try:
+            client.get_bucket("nm").set(1)
+            assert client.get_bucket("nm").get() == 1
+            assert set(cfg.nat_mapper.seen) >= set(real)
+        finally:
+            client.shutdown()
+    finally:
+        runner.shutdown()
+
+
+def test_name_mapper_on_remote_surface():
+    """Review regression: the NETWORKED surface maps names too — tenant
+    isolation must not silently vanish over the wire."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from redisson_tpu.client.remote import RemoteRedisson
+    from redisson_tpu.config import Config, NameMapper
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0) as st:
+        cfg = Config()
+        cfg.name_mapper = NameMapper(prefix="tenant:")
+        c = RemoteRedisson(st.address, config=cfg)
+        plain = RemoteRedisson(st.address)
+        try:
+            c.get_bucket("cfg").set(1)
+            assert c.get_bucket("cfg").get() == 1
+            assert plain.get_bucket("tenant:cfg").get() == 1  # stored mapped
+            assert plain.get_bucket("cfg").get() is None
+            # lock channels agree between surfaces (mapped name everywhere)
+            lk = c.get_lock("m")
+            assert lk.name == "tenant:m"
+            m = c.get_map("data")
+            m.put("k", "v")
+            assert plain.get_map("tenant:data").get("k") == "v"
+        finally:
+            c.shutdown()
+            plain.shutdown()
+
+
+def test_poll_from_any_with_name_mapper():
+    import redisson_tpu
+    from redisson_tpu.config import Config, NameMapper
+
+    cfg = Config()
+    cfg.name_mapper = NameMapper(prefix="t:")
+    c = redisson_tpu.create(cfg)
+    try:
+        q = c.get_blocking_queue("a")
+        q.offer("x")
+        nm, v = q.poll_from_any(0.5, "b")
+        assert (nm, v) == ("a", "x")  # logical name, own queue polled once
+        c.get_blocking_queue("b").offer("y")
+        nm, v = q.poll_from_any(0.5, "b")
+        assert (nm, v) == ("b", "y")
+        # Keys patterns are logical too
+        keys = c.get_keys()
+        c.get_bucket("cfg-x").set(1)
+        assert keys.get_keys("cfg-*") == ["cfg-x"]
+        assert keys.delete_by_pattern("cfg-*") == 1
+    finally:
+        c.shutdown()
