@@ -88,6 +88,97 @@ class RObject:
                 raise KeyError(f"object '{self._name}' does not exist")
             self._name = mapped
 
+    # -- lifecycle surface (RObject.java dump/restore/copy/touch/unlink) ----
+
+    def touch(self) -> bool:
+        """RObject.touch: True if the object exists (access-clock poke)."""
+        return self._engine.store.exists(self._name)
+
+    def unlink(self) -> bool:
+        """RObject.unlink — in-process reclamation is immediate, so this is
+        delete (the reference's UNLINK/DEL distinction is Redis-internal)."""
+        return self.delete()
+
+    def dump(self) -> bytes:
+        """Portable serialized state (RObject.dump / the DUMP verb): the
+        shared single-record codec — same fields as checkpoint records plus
+        a hash_version stamp (core/checkpoint.dump_record)."""
+        from redisson_tpu.core import checkpoint
+
+        return checkpoint.dump_record(self._engine, self._name)
+
+    def _restore(self, state: bytes, ttl: Optional[float], replace: bool) -> None:
+        from redisson_tpu.core import checkpoint
+
+        checkpoint.restore_record(self._engine, self._name, state, ttl, replace)
+
+    def restore(self, state: bytes, ttl: Optional[float] = None) -> None:
+        """RObject.restore: install a dump under this name; BUSYKEY error if
+        the name exists (Redis RESTORE semantics)."""
+        self._restore(state, ttl, replace=False)
+
+    def restore_and_replace(self, state: bytes, ttl: Optional[float] = None) -> None:
+        self._restore(state, ttl, replace=True)
+
+    def copy_to(self, dest_name: str, replace: bool = False) -> bool:
+        """RObject.copy: clone this record under `dest_name` (COPY verb
+        semantics — device arrays deep-copied, never aliased: records
+        mutate through donated buffers)."""
+        import pickle as _p
+
+        import jax.numpy as jnp
+
+        from redisson_tpu.core.store import StateRecord
+
+        dest = self._map_name(dest_name)
+        with self._engine.locked_many([self._name, dest]):
+            rec = self._engine.store.get(self._name)
+            if rec is None:
+                return False
+            if self._engine.store.exists(dest) and not replace:
+                return False
+            clone = StateRecord(
+                kind=rec.kind,
+                meta=_p.loads(_p.dumps(dict(rec.meta))),
+                arrays={k: jnp.copy(v) for k, v in rec.arrays.items()},
+                host=_p.loads(_p.dumps(rec.host)),
+            )
+            clone.expire_at = rec.expire_at
+            self._engine.store.delete(dest)
+            self._engine.store.put(dest, clone)
+        return True
+
+    def migrate(
+        self,
+        address: str,
+        timeout: float = 10.0,
+        delete_local: bool = True,
+        replace: bool = False,
+        password: Optional[str] = None,
+        username: Optional[str] = None,
+        ssl_context=None,
+    ) -> None:
+        """RObject.migrate: DUMP here, RESTORE on the node at `address`
+        (tpu://host:port), then delete locally — the Redis MIGRATE recipe.
+        Mirrors MIGRATE's contracts: the record's TTL travels in the blob,
+        a destination collision is BUSYKEY unless `replace` (Redis's
+        REPLACE opt-in), and secured destinations take credentials/TLS
+        (the AUTH/AUTH2 knobs)."""
+        from redisson_tpu.net.client import NodeClient
+
+        blob = self.dump()
+        node = NodeClient(
+            address, ping_interval=0, password=password, username=username,
+            ssl_context=ssl_context,
+        )
+        try:
+            args = ("RESTORE", self._name, 0, blob) + (("REPLACE",) if replace else ())
+            node.execute(*args, timeout=timeout)  # error replies RAISE RespError
+        finally:
+            node.close()
+        if delete_local:
+            self.delete()
+
     def _record(self):
         return self._engine.store.get(self._name)
 

@@ -206,3 +206,63 @@ class AutoCheckpointer:
         self._stop.set()
         if self._thread.is_alive():
             self._thread.join(timeout=5.0)
+
+
+# -- single-record portable blobs (RObject.dump/restore + the DUMP verb) -----
+
+def dump_record(engine, name: str) -> bytes:
+    """ONE record as a self-contained blob: same field set as checkpoint
+    records (kind/meta/host/arrays/expire_at) plus the hash_version stamp —
+    dump/restore and checkpoints must never drift, or a migrated bloom
+    filter would silently answer wrong under a different hash build."""
+    from redisson_tpu.utils import hashing as H
+
+    with engine.locked(name):
+        rec = engine.store.get(name)
+        if rec is None:
+            raise KeyError(f"object '{name}' does not exist")
+        payload = {
+            "format": 1,
+            "hash_version": getattr(H, "HASH_VERSION", 1),
+            "kind": rec.kind,
+            "meta": dict(rec.meta),
+            "expire_at": rec.expire_at,
+            "host_pickled": pickle.dumps(rec.host, protocol=4),
+            "arrays": {k: np.asarray(v) for k, v in rec.arrays.items()},
+        }
+    return pickle.dumps(payload, protocol=4)
+
+
+def restore_record(engine, name: str, state: bytes, ttl=None, replace: bool = False) -> None:
+    """Install a dump_record blob under `name`.  BUSYKEY unless `replace`
+    (Redis RESTORE semantics); `ttl` (seconds) overrides the blob's own
+    expire_at; hash-version mismatches refuse exactly like checkpoint.load."""
+    import jax.numpy as jnp
+
+    from redisson_tpu.core.store import StateRecord
+    from redisson_tpu.utils import hashing as H
+
+    payload = _loads(bytes(state))  # restricted unpickler: wire-reachable
+    if not isinstance(payload, dict) or payload.get("format") != 1:
+        raise ValueError("unrecognized dump payload")
+    hv = payload.get("hash_version", 1)
+    if hv != getattr(H, "HASH_VERSION", 1):
+        raise ValueError(
+            f"dump hash_version={hv} != runtime {getattr(H, 'HASH_VERSION', 1)}"
+        )
+    host = _loads(payload["host_pickled"])  # inner state is attacker-reachable too
+    with engine.locked(name):
+        if not replace and engine.store.exists(name):
+            raise ValueError(f"BUSYKEY object '{name}' already exists")
+        rec = StateRecord(
+            kind=payload["kind"],
+            meta=dict(payload["meta"]),
+            arrays={k: jnp.asarray(v) for k, v in payload["arrays"].items()},
+            host=host,
+        )
+        if ttl is not None:
+            rec.expire_at = time.time() + ttl
+        else:
+            rec.expire_at = payload.get("expire_at")
+        engine.store.delete(name)
+        engine.store.put(name, rec)

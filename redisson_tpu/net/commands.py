@@ -144,6 +144,10 @@ SPECS["FCALL_RO"] = CommandSpec("FCALL_RO", False, None, numkeys_at=1)
 # WAIT on a replica reports 0 attached replicas)
 _spec(SPECS, "SCRIPT FUNCTION CONFIG WAIT", False, None)
 
+# record serialization (RObject.dump/restore; the MIGRATE recipe)
+_spec(SPECS, "DUMP", False, 0)
+_spec(SPECS, "RESTORE", True, 0)
+
 # multi-key
 _spec(SPECS, "DEL UNLINK", True, 0, multi_key=True)
 _spec(SPECS, "RENAME", True, 0, multi_key=True)

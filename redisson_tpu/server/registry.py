@@ -3968,3 +3968,34 @@ def cmd_bzmpop(server, ctx, args):
         return cmd_zmpop(server, ctx, rest)
 
     return _block_loop(server, first_key, poll_once, timeout)
+
+
+@register("DUMP")
+def cmd_dump(server, ctx, args):
+    """DUMP key — the portable record blob (core/checkpoint.dump_record;
+    wire names are stored keys, so no handle/NameMapper indirection)."""
+    from redisson_tpu.core import checkpoint
+
+    try:
+        return checkpoint.dump_record(server.engine, _s(args[0]))
+    except KeyError:
+        return None  # missing key dumps nil
+
+
+@register("RESTORE")
+def cmd_restore(server, ctx, args):
+    """RESTORE key ttl(ms) blob [REPLACE] — BUSYKEY unless REPLACE."""
+    from redisson_tpu.core import checkpoint
+
+    name = _s(args[0])
+    ttl_ms = _int(args[1])
+    replace = any(bytes(a).upper() == b"REPLACE" for a in args[3:])
+    try:
+        checkpoint.restore_record(
+            server.engine, name, bytes(args[2]),
+            ttl_ms / 1000.0 if ttl_ms > 0 else None, replace,
+        )
+    except ValueError as e:
+        msg = str(e)
+        raise RespError(msg if msg.startswith("BUSYKEY") else f"ERR {msg}")
+    return "+OK"

@@ -377,3 +377,134 @@ class TestBatch:
         batch.execute()
         assert f1.get() == 10 and f2.get() == 10
         assert arr.contains(np.zeros(10, np.int32), np.arange(10, dtype=np.int64)).all()
+
+
+class TestObjectLifecycle:
+    """RObject.dump/restore/copy/touch/unlink/migrate (RObject.java:49-140)."""
+
+    def test_dump_restore_roundtrip(self, client):
+        m = client.get_map("lc:m")
+        m.put_all({"a": 1, "b": [1, 2]})
+        blob = m.dump()
+        m2 = client.get_map("lc:m2")
+        m2.restore(blob)
+        assert m2.read_all_map() == {"a": 1, "b": [1, 2]}
+        # BUSYKEY on existing name; replace variant overwrites
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="BUSYKEY"):
+            m2.restore(blob)
+        m2.put("c", 3)
+        m2.restore_and_replace(blob)
+        assert m2.get("c") is None
+
+    def test_dump_restore_device_object(self, client):
+        bf = client.get_bloom_filter("lc:bf")
+        bf.try_init(1000, 0.01)
+        bf.add(b"k1")
+        blob = bf.dump()
+        bf2 = client.get_bloom_filter("lc:bf2")
+        bf2.restore(blob)
+        assert bf2.contains(b"k1")
+        bf2.add(b"k2")               # restored arrays are independent
+        assert not bf.contains(b"k2")
+
+    def test_restore_with_ttl_and_bad_payload(self, client):
+        import time as _t
+
+        b = client.get_bucket("lc:b")
+        b.set("v")
+        blob = b.dump()
+        b2 = client.get_bucket("lc:b2")
+        b2.restore(blob, ttl=0.05)
+        assert b2.get() == "v"
+        _t.sleep(0.07)
+        assert b2.get() is None
+        import pytest as _pytest
+
+        with _pytest.raises(Exception):
+            b2.restore(b"garbage")
+
+    def test_copy_touch_unlink(self, client):
+        b = client.get_bucket("lc:src")
+        b.set(7)
+        assert b.copy_to("lc:dst")
+        assert client.get_bucket("lc:dst").get() == 7
+        assert not b.copy_to("lc:dst")          # exists, no replace
+        b.set(8)
+        assert b.copy_to("lc:dst", replace=True)
+        assert client.get_bucket("lc:dst").get() == 8
+        assert b.touch() and b.unlink()
+        assert not b.touch()
+        assert not client.get_bucket("lc:missing").copy_to("x")
+
+    def test_migrate_to_another_server(self, client):
+        """The MIGRATE recipe: dump -> remote RESTORE -> local delete."""
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from redisson_tpu.client.remote import RemoteRedisson
+        from redisson_tpu.server.server import ServerThread
+
+        with ServerThread(port=0) as st:
+            z = client.get_scored_sorted_set("lc:z")
+            z.add(1, "m")
+            z.add(2, "n")
+            z.migrate(st.address)
+            assert not z.touch()  # gone locally
+            rc = RemoteRedisson(st.address, timeout=30.0)
+            try:
+                rz = rc.get_scored_sorted_set("lc:z")
+                assert rz.read_all() == ["m", "n"]
+                assert rz.get_score("n") == 2
+            finally:
+                rc.shutdown()
+
+    def test_dump_preserves_ttl_and_hash_version(self, client):
+        """Review regressions: the blob carries expire_at and refuses a
+        mismatched hash_version (the checkpoint guard, shared codec)."""
+        import time as _t
+
+        b = client.get_bucket("lc:ttl")
+        b.set("v")
+        b.expire(60.0)
+        blob = b.dump()
+        b2 = client.get_bucket("lc:ttl2")
+        b2.restore(blob)
+        ttl = b2.remain_time_to_live()
+        assert ttl is not None and 0 < ttl <= 60.0
+        # hash-version mismatch refuses
+        from redisson_tpu.core import checkpoint
+        from redisson_tpu.net.safe_pickle import RestrictedUnpickler  # noqa: F401
+        import pickle
+
+        payload = pickle.loads(blob)
+        payload["hash_version"] = 999
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="hash_version"):
+            client.get_bucket("lc:hv").restore(pickle.dumps(payload))
+
+    def test_migrate_busykey_unless_replace(self, client):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from redisson_tpu.client.remote import RemoteRedisson
+        from redisson_tpu.net.resp import RespError
+        from redisson_tpu.server.server import ServerThread
+        import pytest as _pytest
+
+        with ServerThread(port=0) as st:
+            rc = RemoteRedisson(st.address, timeout=30.0)
+            try:
+                rc.get_bucket("lc:clash").set("theirs")
+                b = client.get_bucket("lc:clash")
+                b.set("mine")
+                with _pytest.raises(RespError, match="^BUSYKEY"):
+                    b.migrate(st.address)
+                assert b.touch()  # NOT deleted locally on failure
+                b.migrate(st.address, replace=True)
+                assert not b.touch()
+                assert rc.get_bucket("lc:clash").get() == "mine"
+            finally:
+                rc.shutdown()
