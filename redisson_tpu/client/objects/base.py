@@ -121,32 +121,13 @@ class RObject:
         self._restore(state, ttl, replace=True)
 
     def copy_to(self, dest_name: str, replace: bool = False) -> bool:
-        """RObject.copy: clone this record under `dest_name` (COPY verb
-        semantics — device arrays deep-copied, never aliased: records
-        mutate through donated buffers)."""
-        import pickle as _p
+        """RObject.copy: clone this record under `dest_name` (the COPY verb
+        and this method share core/checkpoint.clone_record)."""
+        from redisson_tpu.core import checkpoint
 
-        import jax.numpy as jnp
-
-        from redisson_tpu.core.store import StateRecord
-
-        dest = self._map_name(dest_name)
-        with self._engine.locked_many([self._name, dest]):
-            rec = self._engine.store.get(self._name)
-            if rec is None:
-                return False
-            if self._engine.store.exists(dest) and not replace:
-                return False
-            clone = StateRecord(
-                kind=rec.kind,
-                meta=_p.loads(_p.dumps(dict(rec.meta))),
-                arrays={k: jnp.copy(v) for k, v in rec.arrays.items()},
-                host=_p.loads(_p.dumps(rec.host)),
-            )
-            clone.expire_at = rec.expire_at
-            self._engine.store.delete(dest)
-            self._engine.store.put(dest, clone)
-        return True
+        return checkpoint.clone_record(
+            self._engine, self._name, self._map_name(dest_name), replace
+        )
 
     def migrate(
         self,
@@ -199,3 +180,33 @@ class RExpirable(RObject):
     def remain_time_to_live(self) -> Optional[float]:
         """Seconds until expiry; None if persistent or absent (pttl analog)."""
         return self._engine.store.ttl(self._name)
+
+    # Redis-7 conditional expiry (RExpirable.expireIfSet/NotSet/Greater/Less
+    # — the EXPIRE NX|XX|GT|LT options)
+
+    def _expire_if(self, seconds: float, pred) -> bool:
+        with self._engine.locked(self._name):
+            if not self._engine.store.exists(self._name):
+                return False
+            current = self._engine.store.ttl(self._name)
+            if not pred(current):
+                return False
+            return self._engine.store.expire(self._name, time.time() + seconds)
+
+    def expire_if_set(self, seconds: float) -> bool:
+        """EXPIRE XX: only when a TTL already exists."""
+        return self._expire_if(seconds, lambda cur: cur is not None)
+
+    def expire_if_not_set(self, seconds: float) -> bool:
+        """EXPIRE NX: only when the object is persistent."""
+        return self._expire_if(seconds, lambda cur: cur is None)
+
+    def expire_if_greater(self, seconds: float) -> bool:
+        """EXPIRE GT: only extend (persistent counts as infinite, like Redis)."""
+        return self._expire_if(
+            seconds, lambda cur: cur is not None and seconds > cur
+        )
+
+    def expire_if_less(self, seconds: float) -> bool:
+        """EXPIRE LT: only shorten (always applies when persistent)."""
+        return self._expire_if(seconds, lambda cur: cur is None or seconds < cur)

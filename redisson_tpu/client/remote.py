@@ -273,8 +273,34 @@ def reserve_exists(err: "RespError") -> bool:
     return "item exists" in str(err)
 
 
-class RemoteBloomFilter:
+class _ObjcallFallback:
+    """Unknown methods on the CONCRETE fast-path handles fall through to
+    OBJCALL on the matching factory: the typed verbs stay the hot path,
+    while the full embedded surface (lifecycle ops, conditional expiry,
+    future additions) is reachable without hand-mirroring every method."""
+
+    _FALLBACK_FACTORY: str = ""
+
+    def __getattr__(self, method: str):
+        if method.startswith("_") or not self._FALLBACK_FACTORY:
+            raise AttributeError(method)
+
+        def call(*args, **kwargs):
+            return self._client.objcall(
+                self._FALLBACK_FACTORY, self.name, method, args, kwargs,
+                # the handle's codec travels like the generic proxy's:
+                # a custom-codec handle must not fall back to the default
+                codec=getattr(self, "_codec", None),
+            )
+
+        call.__name__ = method
+        return call
+
+
+class RemoteBloomFilter(_ObjcallFallback):
     """Hot-path bloom handle (BF.* wire commands; int batches ride blobs)."""
+
+    _FALLBACK_FACTORY = "get_bloom_filter"
 
     def __init__(self, client: "RemoteRedisson", name: str, codec: Optional[Codec]):
         self._client = client
@@ -324,8 +350,10 @@ class RemoteBloomFilter:
         return int(self.contains_each(objs).sum())
 
 
-class RemoteBloomFilterArray:
+class RemoteBloomFilterArray(_ObjcallFallback):
     """Multi-tenant bloom bank over the wire (BFA.* blob commands)."""
+
+    _FALLBACK_FACTORY = "get_bloom_filter_array"
 
     def __init__(self, client: "RemoteRedisson", name: str):
         self._client = client
@@ -356,7 +384,8 @@ class RemoteBloomFilterArray:
         return np.frombuffer(out, np.uint8).astype(bool)
 
 
-class RemoteHyperLogLog:
+class RemoteHyperLogLog(_ObjcallFallback):
+    _FALLBACK_FACTORY = "get_hyper_log_log"
     def __init__(self, client: "RemoteRedisson", name: str, codec: Optional[Codec]):
         self._client = client
         self.name = name
@@ -383,7 +412,8 @@ class RemoteHyperLogLog:
         self._client.execute("PFMERGE", self.name, *names)
 
 
-class RemoteBitSet:
+class RemoteBitSet(_ObjcallFallback):
+    _FALLBACK_FACTORY = "get_bit_set"
     def __init__(self, client: "RemoteRedisson", name: str):
         self._client = client
         self.name = name
@@ -418,7 +448,8 @@ class RemoteBitSet:
         self._client.execute("BITOP", "XOR", self.name, self.name, *others)
 
 
-class RemoteBucket:
+class RemoteBucket(_ObjcallFallback):
+    _FALLBACK_FACTORY = "get_bucket"
     def __init__(self, client: "RemoteRedisson", name: str, codec: Optional[Codec]):
         self._client = client
         self.name = name

@@ -233,10 +233,17 @@ def dump_record(engine, name: str) -> bytes:
     return pickle.dumps(payload, protocol=4)
 
 
-def restore_record(engine, name: str, state: bytes, ttl=None, replace: bool = False) -> None:
+def restore_record(
+    engine, name: str, state: bytes, ttl=None, replace: bool = False,
+    persist: bool = False,
+) -> None:
     """Install a dump_record blob under `name`.  BUSYKEY unless `replace`
     (Redis RESTORE semantics); `ttl` (seconds) overrides the blob's own
-    expire_at; hash-version mismatches refuse exactly like checkpoint.load."""
+    expire_at; `persist` strips expiry entirely; hash-version mismatches
+    refuse exactly like checkpoint.load.  A blob whose carried TTL has
+    ALREADY elapsed refuses loudly — installing it would reply OK and then
+    serve nothing (silent loss), while silently resurrecting it persistent
+    would serve data past its expiry."""
     import jax.numpy as jnp
 
     from redisson_tpu.core.store import StateRecord
@@ -260,9 +267,44 @@ def restore_record(engine, name: str, state: bytes, ttl=None, replace: bool = Fa
             arrays={k: jnp.asarray(v) for k, v in payload["arrays"].items()},
             host=host,
         )
-        if ttl is not None:
+        if persist:
+            rec.expire_at = None
+        elif ttl is not None:
             rec.expire_at = time.time() + ttl
         else:
-            rec.expire_at = payload.get("expire_at")
+            carried = payload.get("expire_at")
+            if carried is not None and carried <= time.time():
+                raise ValueError(
+                    "dump TTL already elapsed; pass an explicit ttl or "
+                    "persist=True (wire: RESTORE ... PERSIST)"
+                )
+            rec.expire_at = carried
         engine.store.delete(name)
         engine.store.put(name, rec)
+
+
+def clone_record(engine, src_name: str, dst_name: str, replace: bool = False) -> bool:
+    """COPY semantics shared by RObject.copy_to and the COPY verb: clone one
+    record under a new name.  Device arrays get a DEVICE-SIDE deep copy
+    (records mutate through donated buffers — a shared reference dies on
+    the next write to either side); host state deep-copies via pickle."""
+    import jax.numpy as jnp
+
+    from redisson_tpu.core.store import StateRecord
+
+    with engine.locked_many([src_name, dst_name]):
+        rec = engine.store.get(src_name)
+        if rec is None:
+            return False
+        if engine.store.exists(dst_name) and not replace:
+            return False
+        clone = StateRecord(
+            kind=rec.kind,
+            meta=pickle.loads(pickle.dumps(dict(rec.meta))),
+            arrays={k: jnp.copy(v) for k, v in rec.arrays.items()},
+            host=pickle.loads(pickle.dumps(rec.host)),
+        )
+        clone.expire_at = rec.expire_at
+        engine.store.delete(dst_name)
+        engine.store.put(dst_name, clone)
+    return True

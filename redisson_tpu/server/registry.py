@@ -2327,35 +2327,14 @@ def cmd_zinterstore(server, ctx, args):
 
 @register("COPY")
 def cmd_copy(server, ctx, args):
-    """COPY src dst [REPLACE] — record-level clone, any object kind.
-    Device arrays get a DEVICE-SIDE deep copy: kernels update records with
-    donated buffers (jit donate_argnums), so a shared reference would be
-    invalidated the moment either record mutates ("Buffer deleted or
-    donated").  Host state is deep-copied via a pickle round-trip."""
-    import pickle as _p
-
-    import jax.numpy as jnp
+    """COPY src dst [REPLACE] — record-level clone, any object kind
+    (core/checkpoint.clone_record: device arrays deep-copy on device since
+    records mutate through donated buffers)."""
+    from redisson_tpu.core import checkpoint
 
     src, dst = _s(args[0]), _s(args[1])
     replace = any(bytes(a).upper() == b"REPLACE" for a in args[2:])
-    from redisson_tpu.core.store import StateRecord
-
-    with server.engine.locked_many([src, dst]):
-        rec = server.engine.store.get(src)
-        if rec is None:
-            return 0
-        if server.engine.store.exists(dst) and not replace:
-            return 0
-        clone = StateRecord(
-            kind=rec.kind,
-            meta=_p.loads(_p.dumps(dict(rec.meta))),
-            arrays={k: jnp.copy(v) for k, v in rec.arrays.items()},
-            host=_p.loads(_p.dumps(rec.host)),
-        )
-        clone.expire_at = rec.expire_at
-        server.engine.store.delete(dst)
-        server.engine.store.put(dst, clone)
-    return 1
+    return 1 if checkpoint.clone_record(server.engine, src, dst, replace) else 0
 
 
 @register("RENAMENX")
@@ -3989,11 +3968,14 @@ def cmd_restore(server, ctx, args):
 
     name = _s(args[0])
     ttl_ms = _int(args[1])
-    replace = any(bytes(a).upper() == b"REPLACE" for a in args[3:])
+    opts = {bytes(a).upper() for a in args[3:]}
+    if opts - {b"REPLACE", b"PERSIST"}:
+        raise RespError("ERR syntax error")
     try:
         checkpoint.restore_record(
             server.engine, name, bytes(args[2]),
-            ttl_ms / 1000.0 if ttl_ms > 0 else None, replace,
+            ttl_ms / 1000.0 if ttl_ms > 0 else None,
+            b"REPLACE" in opts, persist=b"PERSIST" in opts,
         )
     except ValueError as e:
         msg = str(e)

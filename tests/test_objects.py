@@ -508,3 +508,61 @@ class TestObjectLifecycle:
                 assert rc.get_bucket("lc:clash").get() == "mine"
             finally:
                 rc.shutdown()
+
+    def test_conditional_expiry(self, client):
+        """EXPIRE NX/XX/GT/LT semantics (RExpirable.expireIf*)."""
+        b = client.get_bucket("lc:ce")
+        b.set(1)
+        assert not b.expire_if_set(10)       # XX: no TTL yet
+        assert b.expire_if_not_set(10)       # NX: persistent -> applies
+        assert not b.expire_if_not_set(99)   # NX: TTL exists now
+        assert b.expire_if_greater(50)       # GT: 50 > ~10
+        assert not b.expire_if_greater(5)    # GT: 5 < ~50
+        assert b.expire_if_less(20)          # LT: 20 < ~50
+        assert not b.expire_if_less(30)      # LT: 30 > ~20
+        ttl = b.remain_time_to_live()
+        assert ttl is not None and 15 < ttl <= 20
+        # persistent: GT refuses (infinite), LT applies
+        b.clear_expire()
+        assert not b.expire_if_greater(10)
+        assert b.expire_if_less(10)
+        assert not client.get_bucket("lc:absent").expire_if_not_set(5)
+
+    def test_restore_elapsed_ttl_refuses(self, client):
+        """A blob whose carried TTL elapsed must refuse, not install a
+        dead key or resurrect it persistent; PERSIST is the escape hatch."""
+        import time as _t
+        import pytest as _pytest
+
+        b = client.get_bucket("lc:et")
+        b.set("v")
+        b.expire(0.05)
+        blob = b.dump()
+        _t.sleep(0.07)
+        b2 = client.get_bucket("lc:et2")
+        with _pytest.raises(ValueError, match="elapsed"):
+            b2.restore(blob)
+        b2.restore(blob, ttl=30.0)  # explicit ttl overrides
+        assert b2.get() == "v"
+
+    def test_fallback_honors_custom_codec(self, client):
+        """Remote fallback methods must ship the handle's codec (a custom
+        codec falling back to the default would misdecode)."""
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from redisson_tpu.client.codec import StringCodec
+        from redisson_tpu.client.remote import RemoteRedisson
+        from redisson_tpu.server.server import ServerThread
+
+        with ServerThread(port=0) as st:
+            c = RemoteRedisson(st.address, timeout=30.0)
+            try:
+                b = c.get_bucket("cc", StringCodec())
+                b.set("plain-text")
+                # get_and_delete is NOT a typed verb on RemoteBucket: it
+                # falls through to OBJCALL and must carry StringCodec
+                assert b.get_and_delete() == "plain-text"
+                assert b.get() is None
+            finally:
+                c.shutdown()
