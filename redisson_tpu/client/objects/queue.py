@@ -498,12 +498,29 @@ class RingBuffer(Queue):
     _kind = "ring_buffer"
 
     def try_set_capacity(self, capacity: int) -> bool:
+        if capacity <= 0:
+            # a zero bound would make every offer silently drop its element
+            raise ValueError("capacity must be positive")
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
             if "capacity" in rec.meta:
                 return False
             rec.meta["capacity"] = capacity
+            self._touch_version(rec)  # the bound must replicate
             return True
+
+    def set_capacity(self, capacity: int) -> None:
+        """RRingBuffer.setCapacity: change the bound unconditionally;
+        shrinking evicts oldest elements (the buffer's overflow rule)."""
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            rec.meta["capacity"] = capacity
+            excess = len(rec.host) - capacity
+            if excess > 0:
+                del rec.host[:excess]  # one splice, not O(n^2) pops
+            self._touch_version(rec)  # meta changed even when nothing trimmed
 
     def capacity(self) -> int:
         rec = self._engine.store.get(self._name)

@@ -814,3 +814,90 @@ class TestMapDepth:
         assert lst.sub_list(1, 3) == ["b", "c"]
         with pytest.raises(IndexError):
             lst.sub_list(2, 9)
+
+
+class TestSetFamilyDepth:
+    """RedissonSetCacheTest / RedissonLexSortedSetTest edge analogs."""
+
+    def test_set_cache_per_value_ttl(self, client):
+        sc = client.get_set_cache("scd")
+        assert sc.add("mayfly", ttl=0.05)
+        assert sc.add("stone")
+        assert not sc.add("stone")  # duplicate
+        assert sc.contains("mayfly")
+        time.sleep(0.07)
+        assert not sc.contains("mayfly")
+        assert sorted(sc.read_all()) == ["stone"]
+        assert sc.size() == 1
+        # re-add after expiry is a fresh insert with a fresh ttl
+        assert sc.add("mayfly", ttl=30.0)
+        assert sc.contains("mayfly")
+        assert sc.reap_expired() == 0
+
+    def test_set_cache_sweep_counts(self, client):
+        sc = client.get_set_cache("scd2")
+        for i in range(4):
+            sc.add(f"v{i}", ttl=0.04)
+        sc.add("keeper")
+        time.sleep(0.06)
+        assert sc.reap_expired() == 4
+        assert sc.read_all() == ["keeper"]
+
+    def test_lex_sorted_set_ranges(self, client):
+        z = client.get_lex_sorted_set("lexd")
+        z.add_all(["a", "b", "c", "d"])
+        assert z.range("a", False, "d", False) == ["b", "c"]
+        assert z.range("a", True, "c", True) == ["a", "b", "c"]
+        assert z.range_head("b", True) == ["a", "b"]
+        assert z.range_tail("c", False) == ["d"]
+        assert z.count("a", True, "z", True) == 4
+        assert z.first() == "a" and z.last() == "d"
+
+    def test_bounded_blocking_queue_producer_parks(self, client):
+        q = client.get_bounded_blocking_queue("bbqd")
+        assert q.try_set_capacity(1)
+        assert q.offer("a")
+        produced = []
+
+        def producer():
+            produced.append(q.offer("b", timeout=5.0))  # parks until space
+
+        t = threading.Thread(target=producer)
+        t.start()
+        time.sleep(0.1)
+        assert not produced  # still parked: queue full
+        assert q.poll() == "a"
+        t.join(5.0)
+        assert produced == [True]
+        assert q.poll() == "b"
+
+    def test_ring_buffer_capacity_change(self, client):
+        rb = client.get_ring_buffer("rbd")
+        rb.try_set_capacity(2)
+        for v in (1, 2, 3):
+            rb.offer(v)
+        assert rb.read_all() == [2, 3]
+        rb.set_capacity(4)  # grow keeps content
+        rb.offer(4)
+        assert rb.read_all() == [2, 3, 4]
+        rb.set_capacity(2)  # shrink trims oldest
+        assert rb.read_all() == [3, 4]
+
+    def test_transfer_queue_timeout_path(self, client):
+        tq = client.get_transfer_queue("tqd")
+        t0 = time.time()
+        assert not tq.transfer("x", timeout=0.15)  # nobody consumes
+        assert time.time() - t0 >= 0.1
+        assert tq.size() == 0  # failed transfer leaves nothing behind
+
+    def test_ring_buffer_capacity_validation_and_replication_bump(self, client):
+        import pytest as _pytest
+
+        rb = client.get_ring_buffer("rbv")
+        with _pytest.raises(ValueError):
+            rb.try_set_capacity(0)
+        rb.try_set_capacity(2)
+        rec = client._engine.store.get("rbv")
+        v0 = rec.version
+        rb.set_capacity(10)  # no trim — the bound must still replicate
+        assert client._engine.store.get("rbv").version > v0
