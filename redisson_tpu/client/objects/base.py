@@ -141,19 +141,22 @@ class RObject:
     ) -> None:
         """RObject.migrate: DUMP here, RESTORE on the node at `address`
         (tpu://host:port), then delete locally — the Redis MIGRATE recipe.
-        Mirrors MIGRATE's contracts: the record's TTL travels in the blob,
-        a destination collision is BUSYKEY unless `replace` (Redis's
-        REPLACE opt-in), and secured destinations take credentials/TLS
-        (the AUTH/AUTH2 knobs)."""
+        Mirrors MIGRATE's contracts: the remaining TTL is measured here and
+        travels as RESTORE's explicit ttl operand (Redis MIGRATE does the
+        same; wire RESTORE treats ttl 0 as persistent), a destination
+        collision is BUSYKEY unless `replace` (Redis's REPLACE opt-in), and
+        secured destinations take credentials/TLS (the AUTH/AUTH2 knobs)."""
         from redisson_tpu.net.client import NodeClient
 
+        ttl = self._engine.store.ttl(self._name)  # before dump: no expiry race
         blob = self.dump()
+        ttl_ms = max(1, int(ttl * 1000)) if ttl is not None else 0
         node = NodeClient(
             address, ping_interval=0, password=password, username=username,
             ssl_context=ssl_context,
         )
         try:
-            args = ("RESTORE", self._name, 0, blob) + (("REPLACE",) if replace else ())
+            args = ("RESTORE", self._name, ttl_ms, blob) + (("REPLACE",) if replace else ())
             node.execute(*args, timeout=timeout)  # error replies RAISE RespError
         finally:
             node.close()

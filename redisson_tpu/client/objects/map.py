@@ -590,7 +590,7 @@ class MapCache(Map):
         self._check_max_size(max_size, mode)
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
-            if rec.meta.get("max_size"):
+            if "max_size" in rec.meta:
                 return False
             rec.meta["max_size"] = max_size
             rec.meta["eviction_mode"] = mode
@@ -614,10 +614,10 @@ class MapCache(Map):
 
     @staticmethod
     def _check_max_size(max_size: int, mode: str) -> None:
-        # 0 must not pass: meta stores it falsy, so a later try_set_max_size
-        # would ALSO report "bound set" and break the set-once contract
-        if max_size <= 0:
-            raise ValueError("maxSize should be greater than zero")
+        # 0 = unbounded (RedissonMapCache.trySetMaxSizeAsync only rejects
+        # negatives); the set-once contract uses key PRESENCE, not truthiness
+        if max_size < 0:
+            raise ValueError("maxSize should not be negative")
         if mode not in ("LRU", "LFU"):
             raise ValueError(f"unknown eviction mode: {mode!r}")
 

@@ -116,7 +116,7 @@ class RedissonTpu:
         from redisson_tpu.client.objects.map import MapCache
 
         mc = MapCache(self._engine, name, codec, options)
-        self._engine.eviction.schedule_for_record(self._engine, name, mc.reap_expired)
+        self._engine.eviction.schedule_for_record(self._engine, mc._name, mc.reap_expired)
         return mc
 
     def get_local_cached_map(self, name: str, codec: Optional[Codec] = None, options=None):
@@ -148,7 +148,7 @@ class RedissonTpu:
         from redisson_tpu.client.objects.set import SetCache
 
         sc = SetCache(self._engine, name, codec)
-        self._engine.eviction.schedule_for_record(self._engine, name, sc.reap_expired)
+        self._engine.eviction.schedule_for_record(self._engine, sc._name, sc.reap_expired)
         return sc
 
     def get_sorted_set(self, name: str, codec: Optional[Codec] = None, key=None):
@@ -185,14 +185,14 @@ class RedissonTpu:
         from redisson_tpu.client.objects.multimap import ListMultimapCache
 
         mm = ListMultimapCache(self._engine, name, codec)
-        self._engine.eviction.schedule_for_record(self._engine, name, mm.reap_expired)
+        self._engine.eviction.schedule_for_record(self._engine, mm._name, mm.reap_expired)
         return mm
 
     def get_set_multimap_cache(self, name: str, codec: Optional[Codec] = None):
         from redisson_tpu.client.objects.multimap import SetMultimapCache
 
         mm = SetMultimapCache(self._engine, name, codec)
-        self._engine.eviction.schedule_for_record(self._engine, name, mm.reap_expired)
+        self._engine.eviction.schedule_for_record(self._engine, mm._name, mm.reap_expired)
         return mm
 
     # -- queues -------------------------------------------------------------

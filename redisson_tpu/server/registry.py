@@ -2508,6 +2508,10 @@ def _apply_limit(out, args, at):
 
 def _znumkeys(server, args, at=0):
     n = _int(args[at])
+    if n <= 0:
+        raise RespError("ERR numkeys should be greater than 0")
+    if len(args) < at + 1 + n:
+        raise RespError("ERR Number of keys can't be greater than number of args")
     names = [_s(k) for k in args[at + 1 : at + 1 + n]]
     return n, names, at + 1 + n
 
@@ -2673,7 +2677,7 @@ def _block_loop(server, first_key: str, poll_once, timeout: float):
 
     deadline = None if timeout <= 0 else _t.time() + timeout
     entry = server.engine.queue_wait_entry(first_key)
-    while True:
+    while not getattr(server, "_closing", False):
         r = poll_once()
         if r is not None:
             return r
@@ -2681,6 +2685,7 @@ def _block_loop(server, first_key: str, poll_once, timeout: float):
         if remaining is not None and remaining <= 0:
             return None
         entry.wait_for(min(0.05, remaining) if remaining is not None else 0.05)
+    return None  # server stopping: unpark, reply nil
 
 
 def _bpop(server, args, first: bool):
@@ -3871,15 +3876,25 @@ def cmd_wait(server, ctx, args):
     many replicas — the syncSlaves/REPLFLUSH semantics)."""
     import time as _t
 
+    if len(args) < 2:
+        raise RespError("ERR wrong number of arguments for 'wait' command")
     want = _int(args[0])
-    timeout = _int(args[1]) / 1000.0 if len(args) > 1 else 0.0
-    deadline = _t.time() + timeout
+    timeout_ms = _int(args[1])
+    if timeout_ms < 0:
+        raise RespError("ERR timeout is negative")
+    # Redis WAIT timeout 0 = block until the replica count is reached
+    # (same convention as _block_loop's timeout<=0)
+    deadline = None if timeout_ms == 0 else _t.time() + timeout_ms / 1000.0
     while True:
         n = 0
         if server._replication is not None:
             server._replication.flush()
             n = len(server._replication.replicas())
-        if n >= want or _t.time() >= deadline:
+        if (
+            n >= want
+            or (deadline is not None and _t.time() >= deadline)
+            or getattr(server, "_closing", False)
+        ):
             return n
         _t.sleep(0.02)  # parked, not spinning: this holds a pool worker
 
@@ -3968,14 +3983,19 @@ def cmd_restore(server, ctx, args):
 
     name = _s(args[0])
     ttl_ms = _int(args[1])
+    if ttl_ms < 0:
+        raise RespError("ERR Invalid TTL value, must be >= 0")
     opts = {bytes(a).upper() for a in args[3:]}
     if opts - {b"REPLACE", b"PERSIST"}:
         raise RespError("ERR syntax error")
     try:
+        # Redis semantics: ttl 0 == no expiry.  RObject.migrate ships the
+        # remaining TTL as this explicit operand; the blob-carried TTL only
+        # applies to direct restore_record calls (checkpoint files).
         checkpoint.restore_record(
             server.engine, name, bytes(args[2]),
             ttl_ms / 1000.0 if ttl_ms > 0 else None,
-            b"REPLACE" in opts, persist=b"PERSIST" in opts,
+            b"REPLACE" in opts, persist=b"PERSIST" in opts or ttl_ms == 0,
         )
     except ValueError as e:
         msg = str(e)

@@ -83,7 +83,7 @@ _SLOW_COMMANDS = frozenset(
     b.encode() for b in (
         "OBJCALL", "OBJCALLM", "OBJCALLMA", "BLPOP", "BRPOP", "BLMOVE",
         "BRPOPLPUSH", "BZPOPMIN", "BZPOPMAX", "BLMPOP", "BZMPOP",
-        "XREAD", "XREADGROUP",
+        "XREAD", "XREADGROUP", "WAIT",
     )
 )
 
@@ -165,6 +165,7 @@ class TpuServer:
         # can't starve the data-plane workers (the reference marks such
         # commands isBlockingCommand and gives them dedicated connections)
         self._slow_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="rtpu-slow")
+        self._closing = False
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._writers: set = set()
@@ -611,6 +612,10 @@ class TpuServer:
             await self._server.serve_forever()
 
     def stop(self):
+        # parked blocking verbs (_block_loop, WAIT) poll this to unpark:
+        # a forever-blocked worker would otherwise survive pool shutdown
+        # (wait=False) and hang interpreter exit via the futures atexit join
+        self._closing = True
         loop, server = self._loop, self._server
         if loop is not None and server is not None:
             def shutdown():

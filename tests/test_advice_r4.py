@@ -1,0 +1,201 @@
+"""Regressions for the round-3 advisor findings (ADVICE.md r3).
+
+1. medium — eviction sweeps must be registered under the NameMapper-mapped
+   key, or background reaping never runs for mapped caches.
+2. low — _znumkeys verbs (LMPOP/ZMPOP/ZDIFF/ZINTER/ZUNION/...) validate
+   numkeys like their blocking siblings instead of ERR internal.
+3. low — MapCache max_size 0 = unbounded (trySetMaxSizeAsync only rejects
+   negatives), with key-presence keeping the set-once contract.
+4. low — wire RESTORE ttl 0 = no expiry (Redis semantics), carried-TTL
+   behavior stays behind RObject.migrate.
+5. low — WAIT timeout 0 has no deadline (blocks until replica count).
+"""
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture(scope="module")
+def wire():
+    with ServerThread(port=0) as st:
+        client = RemoteRedisson(st.address, timeout=60.0)
+        yield client
+        client.shutdown()
+
+
+def test_eviction_sweep_registered_under_mapped_name():
+    """With a name_mapper, the sweep must watch the MAPPED record name —
+    otherwise schedule_for_record sees exists()==False forever and the
+    cache is only reaped lazily on access."""
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    cfg.name_mapper = type(
+        "PrefixMapper", (), {
+            "map": staticmethod(lambda n: f"tenant7:{n}"),
+            "unmap": staticmethod(lambda n: n[len("tenant7:"):]),
+        },
+    )()
+    c = redisson_tpu.create(cfg)
+    try:
+        for factory, nm in (
+            (c.get_map_cache, "amc"),
+            (c.get_set_cache, "asc"),
+            (c.get_list_multimap_cache, "almc"),
+            (c.get_set_multimap_cache, "asmc"),
+        ):
+            h = factory(nm)
+            assert h._name.startswith("tenant7:")
+            assert h._name in c._engine.eviction._tasks, factory.__name__
+            assert nm not in c._engine.eviction._tasks, factory.__name__
+    finally:
+        c.shutdown()
+
+
+def test_eviction_sweep_actually_reaps_mapped_cache():
+    """End-to-end: a mapped MapCache's expired entry disappears via the
+    background sweep, without any client access to trigger lazy reaping."""
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    cfg.name_mapper = type(
+        "PrefixMapper", (), {
+            "map": staticmethod(lambda n: f"t:{n}"),
+            "unmap": staticmethod(lambda n: n[2:]),
+        },
+    )()
+    c = redisson_tpu.create(cfg)
+    try:
+        c._engine.eviction.start_delay = 0.05
+        c._engine.eviction.min_delay = 0.05  # keep the adaptive reschedule fast
+        mc = c.get_map_cache("reapme")
+        mc.put_with_ttl("k", "v", ttl=0.05)
+        rec = c._engine.store.get(mc._name)
+        assert rec is not None and len(rec.host) == 1
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            rec = c._engine.store.get(mc._name)
+            if rec is None or len(rec.host) == 0:
+                break
+            time.sleep(0.05)
+        rec = c._engine.store.get(mc._name)
+        assert rec is None or len(rec.host) == 0
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.parametrize("cmdline", [
+    ("LMPOP", "0", "LEFT"),
+    ("ZMPOP", "0", "MIN"),
+    ("ZDIFF", "0"),
+    ("ZINTER", "0"),
+    ("ZUNION", "0"),
+])
+def test_numkeys_zero_is_syntax_error(wire, cmdline):
+    with pytest.raises(RespError, match="numkeys"):
+        wire.execute(*cmdline)
+
+
+@pytest.mark.parametrize("cmdline", [
+    ("LMPOP", "9", "kx", "LEFT"),
+    ("ZMPOP", "9", "kx", "MIN"),
+    ("ZUNION", "9", "kx"),
+])
+def test_numkeys_oversized_is_clean_error(wire, cmdline):
+    """An oversized numkeys must not swallow the mode token as a key name
+    and die with ERR internal."""
+    with pytest.raises(RespError, match="[Nn]umber of keys|numkeys"):
+        wire.execute(*cmdline)
+
+
+def test_mapcache_max_size_zero_unbounded():
+    c = redisson_tpu.create()
+    try:
+        mc = c.get_map_cache("msz")
+        mc.set_max_size(0)  # must not raise; 0 == unbounded
+        for i in range(50):
+            mc.put(f"k{i}", i)
+        assert mc.size() == 50  # nothing evicted
+        assert mc.get_max_size() == 0
+        with pytest.raises(ValueError, match="negative"):
+            mc.set_max_size(-1)
+        # set-once contract survives a 0 bound: presence, not truthiness
+        mc2 = c.get_map_cache("msz2")
+        assert mc2.try_set_max_size(0) is True
+        assert mc2.try_set_max_size(5) is False
+    finally:
+        c.shutdown()
+
+
+def test_wire_restore_ttl_zero_means_persist(wire):
+    wire.execute("SET", "dmp-src", "payload")
+    wire.execute("PEXPIRE", "dmp-src", "80")
+    blob = wire.execute("DUMP", "dmp-src")
+    assert blob is not None
+    time.sleep(0.15)  # let the carried TTL elapse
+    # ttl 0 == no expiry: must install fine even though the blob's own
+    # carried expiry has already passed
+    assert wire.execute("RESTORE", "dmp-restored", "0", blob) in (b"OK", "OK")
+    assert wire.execute("GET", "dmp-restored") == b"payload"
+    assert wire.execute("PTTL", "dmp-restored") == -1
+    with pytest.raises(RespError, match="Invalid TTL"):
+        wire.execute("RESTORE", "dmp-neg", "-1", blob)
+
+
+def test_migrate_carries_remaining_ttl(wire):
+    """RObject.migrate ships the remaining TTL as RESTORE's explicit ttl
+    operand (Redis MIGRATE recipe) — wire RESTORE ttl 0 now means persist,
+    so migrate must NOT rely on the blob-carried expiry."""
+    c = redisson_tpu.create()
+    try:
+        b = c.get_bucket("mig-ttl")
+        b.set("v")
+        b.expire(60.0)
+        b.migrate(f"tpu://{wire.node.host}:{wire.node.port}")
+        pttl = wire.execute("PTTL", "mig-ttl")
+        assert 1_000 < pttl <= 60_000, pttl
+        # persistent records stay persistent (ttl operand 0)
+        p = c.get_bucket("mig-per")
+        p.set("w")
+        p.migrate(f"tpu://{wire.node.host}:{wire.node.port}")
+        assert wire.execute("PTTL", "mig-per") == -1
+    finally:
+        c.shutdown()
+
+
+def test_wait_malformed_args_error(wire):
+    with pytest.raises(RespError, match="wrong number"):
+        wire.execute("WAIT", "1")
+    with pytest.raises(RespError, match="negative"):
+        wire.execute("WAIT", "1", "-100")
+
+
+def test_wait_timeout_zero_blocks_until_count(wire):
+    """WAIT n 0 must park (no replicas will ever attach here), not return
+    after one probe; WAIT n small-timeout still honors the deadline."""
+    t0 = time.time()
+    assert wire.execute("WAIT", "0", "0") == 0  # satisfied instantly
+    assert time.time() - t0 < 5.0
+
+    got = []
+
+    def parked_wait():
+        try:
+            got.append(wire.execute("WAIT", "1", "0"))
+        except Exception:  # noqa: BLE001 — client closes under us at teardown
+            pass
+
+    th = threading.Thread(target=parked_wait, daemon=True)
+    th.start()
+    th.join(timeout=0.6)
+    assert th.is_alive(), "WAIT 1 0 returned early; timeout 0 must block"
+    # deadline path still works
+    t0 = time.time()
+    assert wire.execute("WAIT", "1", "120") == 0
+    assert 0.05 <= time.time() - t0 < 5.0

@@ -227,8 +227,8 @@ class TestMapCache:
         m = client.get_map_cache("mcsize5")
         with pytest.raises(ValueError):
             m.set_max_size(-1)
-        with pytest.raises(ValueError):
-            m.set_max_size(0)  # 0 is falsy in meta: would break set-once
+        m.set_max_size(0)  # 0 == unbounded (trySetMaxSizeAsync rejects only <0)
+        assert m.get_max_size() == 0
         with pytest.raises(ValueError):
             m.set_max_size(2, mode="FIFO")
 
