@@ -661,6 +661,40 @@ class ClusterRedisson(RemoteSurface):
         assert last is not None
         raise last
 
+    def tx_groups(self, names):
+        """Transaction commit grouping: one TXEXEC frame per slot owner
+        (the per-MasterSlaveEntry grouping of the reference's commit batch,
+        CommandBatchService executeBatchedAsync)."""
+        with self._lock:
+            slot_table = list(self._slots)
+        groups: Dict[Optional[str], List[str]] = {}
+        for n in names:
+            slot = calc_slot(str(n).encode())
+            groups.setdefault(slot_table[slot], []).append(n)
+        return groups
+
+    def txexec(
+        self, group_key, versions, ops, timeout: Optional[float] = None
+    ):
+        """One commit frame straight to the owning master.  MOVED/ASK/
+        TRYAGAIN raise to the caller (RemoteTransaction regroups after a
+        topology refresh and retries — TXEXEC's whole-frame routing precheck
+        guarantees a bounced frame applied nothing)."""
+        import pickle as _pickle
+
+        from redisson_tpu.client.remote import _unwrap_many
+
+        entry = self._entries.get(group_key) if group_key is not None else None
+        if entry is None:
+            entry = next(iter(self.entries()), None)
+        if entry is None:
+            raise ConnectionError_("no cluster entries")
+        reply = entry.master.execute(
+            "TXEXEC", _pickle.dumps(versions), _pickle.dumps(ops),
+            self.caller_id(), timeout=timeout,
+        )
+        return _unwrap_many(reply, self)
+
     def sync_replication(self, names, timeout: Optional[float] = None) -> None:
         """REPLFLUSH on every shard that owns one of `names` (syncSlaves)."""
         with self._lock:

@@ -141,6 +141,7 @@ class LocalCachedMap(Map):
         self._lc_opts = opts
         self._cache = _LocalCache(opts)
         self._cache_id = uuid.uuid4().hex
+        self._disabled: set = set()  # active tx-commit disable requests
         self._channel = f"redisson_local_cache:{name}"
         self._listener_id = engine.pubsub.subscribe(self._channel, self._on_sync)
         self.hits = 0
@@ -169,6 +170,18 @@ class LocalCachedMap(Map):
                 self._cache.put(ek, self._dv(ev))
         elif kind == "clear":
             self._cache.clear()
+        elif kind == "disable":
+            # transaction commit handshake (LocalCachedMapDisable analog):
+            # bypass the near cache until the matching enable — with a
+            # failsafe timer in case the committer dies mid-commit
+            self._disabled.add(sender)
+            self._cache.clear()
+            self._engine.schedule_timeout(
+                lambda: self._disabled.discard(sender), 30.0
+            )
+        elif kind == "enable":
+            self._disabled.discard(sender)
+            self._cache.clear()
 
     def _broadcast(self, kind: str, payload=None) -> None:
         s = self._lc_opts.sync_strategy
@@ -181,6 +194,10 @@ class LocalCachedMap(Map):
     # -- read path -----------------------------------------------------------
 
     def get(self, key):
+        if self._disabled:
+            # tx-commit window: read through, never serve or populate the
+            # near cache (the reference's disabledKeys discipline)
+            return super().get(key)
         ek = self._ek(key)
         hit, value = self._cache.get(ek)
         if hit:
@@ -198,6 +215,8 @@ class LocalCachedMap(Map):
         return value
 
     def get_all(self, keys) -> Dict:
+        if self._disabled:
+            return super().get_all(keys)
         out, missing = {}, []
         for k in keys:
             hit, v = self._cache.get(self._ek(k))
@@ -214,6 +233,22 @@ class LocalCachedMap(Map):
                     self._cache.put(self._ek(k), v)
             out.update(fetched)
         return out
+
+    # -- transaction commit handshake ----------------------------------------
+
+    def tx_disable(self, req_id: str) -> None:
+        """Broadcast + locally apply the near-cache disable for a
+        transaction commit (disableLocalCacheAsync analog).  Published with
+        the REQUEST id as sender so no subscriber — including this handle —
+        is excluded by the own-write filter."""
+        self._disabled.add(req_id)
+        self._cache.clear()
+        self._engine.pubsub.publish(self._channel, ("disable", req_id, None))
+
+    def tx_enable(self, req_id: str) -> None:
+        self._disabled.discard(req_id)
+        self._cache.clear()
+        self._engine.pubsub.publish(self._channel, ("enable", req_id, None))
 
     # -- write path (mutate shared map, update own cache, notify peers) ------
 

@@ -399,10 +399,12 @@ class RedissonTpu:
 
         return RemoteService(self._engine, name)
 
-    def create_transaction(self, timeout: float = 5.0):
-        from redisson_tpu.services.transactions import Transaction
+    def create_transaction(self, timeout: Optional[float] = None, options=None):
+        """RedissonClient.createTransaction(TransactionOptions) analog; the
+        bare `timeout` form is kept for back-compat."""
+        from redisson_tpu.services.transactions import EmbeddedTransaction
 
-        return Transaction(self._engine, timeout)
+        return EmbeddedTransaction(self._engine, timeout, options)
 
     def get_live_object_service(self):
         from redisson_tpu.services.liveobject import LiveObjectService

@@ -475,6 +475,47 @@ class RemoteBucket(_ObjcallFallback):
         return bool(self._client.execute("DEL", self.name))
 
 
+class RemoteBuckets:
+    """RBuckets over the wire (RedissonBuckets.java): every per-name op
+    routes by ITS name (cluster-correct — the embedded handle's in-process
+    loop becomes per-slot routing for free), and the MSETNX-style try_set
+    rides an optimistic transaction so the all-or-nothing contract holds
+    atomically even across shards (version preconditions at commit)."""
+
+    def __init__(self, client, codec: Optional[Codec] = None):
+        self._client = client
+        self._codec = codec
+
+    def get(self, *names: str) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for nm in names:
+            v = self._client.get_bucket(nm, self._codec).get()
+            if v is not None:
+                out[nm] = v
+        return out
+
+    def set(self, values: Dict[str, Any]) -> None:
+        for nm, v in values.items():
+            self._client.get_bucket(nm, self._codec).set(v)
+
+    def try_set(self, values: Dict[str, Any]) -> bool:
+        from redisson_tpu.services.transactions import (
+            TransactionException,
+        )
+
+        for _attempt in range(3):
+            tx = self._client.create_transaction()
+            if not tx.get_buckets(self._codec).try_set(values):
+                tx.rollback()
+                return False
+            try:
+                tx.commit()
+                return True
+            except TransactionException:
+                continue  # a racer created/changed a key: re-probe
+        return False
+
+
 class RemoteTopic:
     def __init__(self, client: "RemoteRedisson", name: str, codec: Optional[Codec]):
         self._client = client
@@ -897,6 +938,7 @@ class RemoteLocalCachedMap:
         self._codec = codec or DEFAULT_CODEC
         self._cache = _LocalCache(self._opts)
         self._cache_id = uuid.uuid4().hex
+        self._disabled: set = set()  # active tx-commit disable requests
         self._channel = f"redisson_local_cache:{name}"
         # mutations ride the PLAIN map: this handle owns its own broadcasts
         self._proxy = RemoteObjectProxy(client, "get_map", name)
@@ -938,6 +980,16 @@ class RemoteLocalCachedMap:
                 self._cache.put(ek, self._codec.decode_map_value(ev))
         elif kind == "clear":
             self._cache.clear()
+        elif kind == "disable":
+            # transaction commit handshake (LocalCachedMapDisable analog)
+            self._disabled.add(sender)
+            self._cache.clear()
+            t = _threading.Timer(30.0, self._disabled.discard, (sender,))
+            t.daemon = True
+            t.start()  # failsafe: committer died before the enable
+        elif kind == "enable":
+            self._disabled.discard(sender)
+            self._cache.clear()
 
     def _broadcast(self, kind: str, payload) -> None:
         if not self._sync:
@@ -958,6 +1010,9 @@ class RemoteLocalCachedMap:
     # -- reads (near cache first) ---------------------------------------------
 
     def get(self, key):
+        if self._disabled:
+            # tx-commit window: read through, never serve or populate
+            return self._proxy.get(key)
         ek = self._ek(key)
         hit, value = self._cache.get(ek)
         if hit:
@@ -966,12 +1021,14 @@ class RemoteLocalCachedMap:
         self.misses += 1
         gen = self._gen
         value = self._proxy.get(key)
-        if value is not None and self._gen == gen:
+        if value is not None and self._gen == gen and not self._disabled:
             # no invalidation raced the fetch: safe to populate
             self._cache.put(ek, value)
         return value
 
     def get_all(self, keys) -> Dict:
+        if self._disabled:
+            return self._proxy.get_all(list(keys))
         out, missing = {}, []
         for k in keys:
             hit, v = self._cache.get(self._ek(k))
@@ -984,11 +1041,30 @@ class RemoteLocalCachedMap:
         if missing:
             gen = self._gen
             fetched = self._proxy.get_all(missing)
-            if self._gen == gen:
+            if self._gen == gen and not self._disabled:
                 for k, v in fetched.items():
                     self._cache.put(self._ek(k), v)
             out.update(fetched)
         return out
+
+    # -- transaction commit handshake ----------------------------------------
+
+    def tx_disable(self, req_id: str) -> None:
+        """Near-cache disable broadcast for a transaction commit
+        (LocalCachedMapDisable analog); sender = the REQUEST id so no
+        subscriber — including this handle — is excluded."""
+        self._disabled.add(req_id)
+        self._cache.clear()
+        if self._sync:
+            blob = pickle.dumps(("disable", req_id, None), protocol=4)
+            self._client.publish_for(self.name, self._channel, blob)
+
+    def tx_enable(self, req_id: str) -> None:
+        self._disabled.discard(req_id)
+        self._cache.clear()
+        if self._sync:
+            blob = pickle.dumps(("enable", req_id, None), protocol=4)
+            self._client.publish_for(self.name, self._channel, blob)
 
     def cached_size(self) -> int:
         return len(self._cache)
@@ -1135,6 +1211,37 @@ class RemoteSurface:
         REPLFLUSH; the cluster client overrides per touched shard."""
         self.execute("REPLFLUSH", timeout=timeout)
 
+    # -- transactions (transaction/RedissonTransaction.java over the wire) ----
+
+    def create_transaction(self, timeout: Optional[float] = None, options=None):
+        from redisson_tpu.services.transactions import (
+            RemoteTransaction,
+            TransactionOptions,
+        )
+
+        if options is None:
+            options = TransactionOptions.defaults()
+        if timeout is not None:
+            options.timeout = timeout
+        return RemoteTransaction(self, options)
+
+    def tx_groups(self, names: List[str]) -> Dict[Any, List[str]]:
+        """Commit grouping seam: which TXEXEC frame carries which names.
+        Single node = one frame; the cluster client groups per slot owner."""
+        return {None: list(names)}
+
+    def txexec(
+        self, group_key, versions: Dict[str, int], ops: List[Tuple],
+        timeout: Optional[float] = None,
+    ) -> List[Any]:
+        """One atomic commit frame: version preconditions + buffered ops
+        under the server's locked_many (see registry cmd_txexec)."""
+        reply = self.execute(
+            "TXEXEC", pickle.dumps(versions), pickle.dumps(ops),
+            self.caller_id(), timeout=timeout,
+        )
+        return _unwrap_many(reply, self)
+
     # -- hot-path handles ----------------------------------------------------
 
     def get_bloom_filter(self, name: str, codec: Optional[Codec] = None) -> "RemoteBloomFilter":
@@ -1151,6 +1258,9 @@ class RemoteSurface:
 
     def get_bucket(self, name: str, codec: Optional[Codec] = None) -> "RemoteBucket":
         return RemoteBucket(self, self._map_name(name), codec)
+
+    def get_buckets(self, codec: Optional[Codec] = None) -> "RemoteBuckets":
+        return RemoteBuckets(self, codec)
 
     def get_topic(self, name: str, codec: Optional[Codec] = None) -> "RemoteTopic":
         return RemoteTopic(self, self._map_name(name), codec)

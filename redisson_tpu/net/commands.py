@@ -144,6 +144,16 @@ SPECS["FCALL_RO"] = CommandSpec("FCALL_RO", False, None, numkeys_at=1)
 # WAIT on a replica reports 0 attached replicas)
 _spec(SPECS, "SCRIPT FUNCTION CONFIG WAIT", False, None)
 
+# transactions: MULTI/DISCARD/UNWATCH/RESET are connection-local; WATCH
+# routes by its keys (queue-time MOVED checks); EXEC and TXEXEC mutate
+# (replicas must refuse); OBJCALLV is the transactional read — it routes
+# like OBJCALL and is replica-UNSAFE (the version must come from the
+# master that will commit), so it stays a write for routing purposes
+_spec(SPECS, "MULTI DISCARD UNWATCH RESET", False, None)
+_spec(SPECS, "WATCH", False, 0, multi_key=True)
+_spec(SPECS, "EXEC TXEXEC", True, None)
+SPECS["OBJCALLV"] = CommandSpec("OBJCALLV", True, 1)
+
 # record serialization (RObject.dump/restore; the MIGRATE recipe)
 _spec(SPECS, "DUMP", False, 0)
 _spec(SPECS, "RESTORE", True, 0)

@@ -16,6 +16,7 @@ by the connection loop (CommandsQueue FIFO discipline).
 from __future__ import annotations
 
 import pickle
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -120,6 +121,12 @@ class CommandContext:
         self.psubscriptions: Dict[str, int] = {}
         self.push: Optional[Callable[[Any], None]] = None  # wired by the server
         self.asking = False  # one-shot ASK admission (cleared per command)
+        # MULTI/EXEC/WATCH state (per-connection, like Redis): a non-None
+        # multi_queue means queueing mode; watch_versions holds the record
+        # versions observed at WATCH time (the optimistic precondition)
+        self.multi_queue: Optional[List[List[bytes]]] = None
+        self.multi_error = False
+        self.watch_versions: Dict[str, int] = {}
 
     def subscription_count(self) -> int:
         return len(self.subscriptions) + len(self.psubscriptions)
@@ -136,12 +143,21 @@ class Registry:
 
         return deco
 
+    # commands served immediately even while a MULTI queue is open
+    _TX_IMMEDIATE = frozenset(
+        (b"MULTI", b"EXEC", b"DISCARD", b"WATCH", b"UNWATCH", b"RESET",
+         b"QUIT", b"AUTH", b"HELLO")
+    )
+
     def dispatch(self, server, ctx: CommandContext, args: List[bytes]):
         if not args:
             raise RespError("ERR empty command")
         cmd = bytes(args[0]).upper()
         handler = self._handlers.get(cmd)
         if handler is None:
+            if ctx.multi_queue is not None:
+                # Redis poisons the open transaction: EXEC replies EXECABORT
+                ctx.multi_error = True
             raise RespError(f"ERR unknown command '{cmd.decode()}'")
         if not ctx.authenticated and cmd not in (b"AUTH", b"HELLO", b"QUIT", b"PING"):
             raise RespError("NOAUTH Authentication required.")
@@ -149,7 +165,12 @@ class Registry:
         # handler re-arms it for the next one)
         asking, ctx.asking = ctx.asking, False
         if server.cluster_view or server.role == "replica":
+            # queue-time MOVED/ASK replies match Redis cluster; EXEC rechecks
+            # the whole group before applying anything
             server.check_routing(cmd.decode(), args[1:], asking=asking)
+        if ctx.multi_queue is not None and cmd not in self._TX_IMMEDIATE:
+            ctx.multi_queue.append([bytes(a) for a in args])
+            return "+QUEUED"
         hooks = getattr(server, "hooks", None)
         if not hooks:
             return handler(server, ctx, args[1:])
@@ -1239,6 +1260,195 @@ def _objcallm_apply(server, ops, caller):
         except Exception as e:  # noqa: BLE001 — tagged per-op, frame continues
             out.append(("E", e))
     return b"M" + pickle.dumps(out)
+
+
+# -- transactions over the wire ----------------------------------------------
+# Two surfaces, one engine mechanism (record versions + locked_many):
+#   * MULTI/EXEC/WATCH/DISCARD/UNWATCH — the Redis-compatible verbs for
+#     generic clients (queue in CommandContext, optimistic WATCH versions);
+#   * OBJCALLV/TXEXEC — the object-level transaction wire used by
+#     RemoteTransaction (transaction/RedissonTransaction.java:49-79 role):
+#     reads return the observed record version, commit is ONE atomic frame
+#     with version preconditions checked under locked_many.
+
+# EXEC runs its queue on one worker thread; blocking verbs inside a
+# transaction must degrade to a single non-blocking probe (Redis semantics:
+# BLPOP inside MULTI acts as if the timeout elapsed immediately)
+_exec_tls = threading.local()
+
+
+@register("MULTI")
+def cmd_multi(server, ctx, args):
+    if ctx.multi_queue is not None:
+        raise RespError("ERR MULTI calls can not be nested")
+    ctx.multi_queue = []
+    ctx.multi_error = False
+    return "+OK"
+
+
+@register("DISCARD")
+def cmd_discard(server, ctx, args):
+    if ctx.multi_queue is None:
+        raise RespError("ERR DISCARD without MULTI")
+    ctx.multi_queue = None
+    ctx.multi_error = False
+    ctx.watch_versions.clear()
+    return "+OK"
+
+
+@register("WATCH")
+def cmd_watch(server, ctx, args):
+    if ctx.multi_queue is not None:
+        raise RespError("ERR WATCH inside MULTI is not allowed")
+    if not args:
+        raise RespError("ERR wrong number of arguments for 'watch' command")
+    for a in args:
+        name = _s(a)
+        rec = server.engine.store.get(name)
+        # first observation wins (re-WATCHing a key keeps the original
+        # precondition, matching the read-versions discipline)
+        ctx.watch_versions.setdefault(name, 0 if rec is None else rec.version)
+    return "+OK"
+
+
+@register("UNWATCH")
+def cmd_unwatch(server, ctx, args):
+    ctx.watch_versions.clear()
+    return "+OK"
+
+
+@register("RESET")
+def cmd_reset(server, ctx, args):
+    """Connection state reset (Redis 6.2 RESET): transaction, watches,
+    subscriptions stay untouched server-side except tx state (subscription
+    teardown rides connection close)."""
+    ctx.multi_queue = None
+    ctx.multi_error = False
+    ctx.watch_versions.clear()
+    ctx.asking = False
+    return "+RESET"
+
+
+@register("EXEC")
+def cmd_exec(server, ctx, args):
+    from redisson_tpu.net import commands as C
+
+    if ctx.multi_queue is None:
+        raise RespError("ERR EXEC without MULTI")
+    queue, ctx.multi_queue = ctx.multi_queue, None
+    poisoned, ctx.multi_error = ctx.multi_error, False
+    watches, ctx.watch_versions = dict(ctx.watch_versions), {}
+    if poisoned:
+        raise RespError(
+            "EXECABORT Transaction discarded because of previous errors."
+        )
+    # routing precheck over the WHOLE group before anything applies: a slot
+    # migrated since queue time must bounce the entire EXEC, never half of it
+    if server.cluster_view or server.role == "replica":
+        for qargs in queue:
+            server.check_routing(bytes(qargs[0]).decode().upper(), qargs[1:])
+    names = set(watches)
+    for qargs in queue:
+        for key in C.command_keys(bytes(qargs[0]).decode().upper(), qargs[1:]):
+            names.add(key.decode() if isinstance(key, (bytes, bytearray)) else str(key))
+    # one EXEC at a time: handlers may take record locks beyond the
+    # precomputed key set (derived names), and serializing EXECs removes
+    # any cross-transaction lock-order inversion those could introduce
+    with server._exec_mutex:
+        with server.engine.locked_many(sorted(names)):
+            for name, seen in watches.items():
+                rec = server.engine.store.get(name)
+                cur = 0 if rec is None else rec.version
+                if cur != seen:
+                    return None  # nil reply: transaction aborted (Redis WATCH)
+            results = []
+            _exec_tls.in_exec = True
+            try:
+                for qargs in queue:
+                    try:
+                        r = REGISTRY.dispatch(server, ctx, qargs)
+                        if isinstance(r, LazyReply):
+                            # the frame-level lazy materializer only walks
+                            # TOP-level results; nested lazies force here
+                            r = r.force()
+                        if isinstance(r, str) and r.startswith("+"):
+                            r = r[1:]  # "+OK" marker is a top-level encoding
+                        results.append(r)
+                    except RespError as e:
+                        results.append(e)  # per-command errors as values
+                    except Exception as e:  # noqa: BLE001 — WRONGTYPE et al.
+                        results.append(
+                            RespError(f"ERR internal: {type(e).__name__}: {e}")
+                        )
+            finally:
+                _exec_tls.in_exec = False
+            return results
+
+
+@register("OBJCALLV")
+def cmd_objcallv(server, ctx, args):
+    """OBJCALL returning (observed record version, result) — the
+    transactional read.  The version is captured UNDER the record lock
+    before the method runs, so a concurrent writer cannot slip between
+    observation and result (RemoteTransaction records it as the commit
+    precondition, the WATCH analog for the object surface)."""
+    from redisson_tpu.net.safe_pickle import safe_loads
+
+    factory, name, method = _s(args[0]), _s(args[1]), _s(args[2])
+    call_args, call_kwargs = safe_loads(bytes(args[3])) if len(args) > 3 else ((), {})
+    caller = _s(args[4]) if len(args) > 4 and args[4] is not None else None
+    codec_blob = bytes(args[5]) if len(args) > 5 and args[5] is not None else None
+    with server.engine.locked(name):
+        rec = server.engine.store.get(name)
+        version = 0 if rec is None else rec.version
+        try:
+            result = _objcall_invoke(
+                server, factory, name, method, call_args, call_kwargs, caller,
+                codec_blob,
+            )
+        except RespError:
+            raise
+        except Exception as e:  # noqa: BLE001 — ship the exception to the caller
+            return b"E" + pickle.dumps(e)
+    return b"R" + pickle.dumps((version, result))
+
+
+@register("TXEXEC")
+def cmd_txexec(server, ctx, args):
+    """TXEXEC <pickled {name: version}> <pickled ops> [caller] — the atomic
+    transaction commit frame: version preconditions verified and ops applied
+    under ONE locked_many, so the check-then-apply window cannot admit a
+    concurrent writer.  Versions mismatching reply TXCONFLICT with NOTHING
+    applied; op errors after a passing check are tagged per-op with no
+    rollback (EXEC semantics, same as OBJCALLMA).  The version-checked
+    OBJCALLMA this extends is the commit path of RemoteTransaction
+    (transaction/RedissonTransaction.java:270-306 made one frame)."""
+    from redisson_tpu.net.safe_pickle import safe_loads
+
+    versions = safe_loads(bytes(args[0]))
+    ops = safe_loads(bytes(args[1]))
+    caller = _s(args[2]) if len(args) > 2 and args[2] is not None else None
+    names = sorted(
+        {str(n) for n in versions} | {str(op[1]) for op in ops if op[1]}
+    )
+    # whole-frame routing precheck BEFORE any lock/apply: a mid-migration
+    # frame must bounce atomically (client refreshes topology and retries
+    # the full commit — nothing has applied)
+    if server.cluster_view:
+        for n in names:
+            server.check_routing(
+                "OBJCALL", [b"tx", n.encode(), b"precheck"]
+            )
+    with server.engine.locked_many(names):
+        for name, seen in versions.items():
+            rec = server.engine.store.get(str(name))
+            cur = 0 if rec is None else rec.version
+            if cur != int(seen):
+                raise RespError(
+                    f"TXCONFLICT object '{name}' changed concurrently "
+                    f"(version {seen} -> {cur})"
+                )
+        return _objcallm_apply(server, ops, caller)
 
 
 # -- typed data commands (Redis-compatible wire surface) ----------------------
@@ -2675,6 +2885,9 @@ def _block_loop(server, first_key: str, poll_once, timeout: float):
     and hold their connection; here they hold one slow-pool worker)."""
     import time as _t
 
+    if getattr(_exec_tls, "in_exec", False):
+        # blocking verbs inside MULTI/EXEC act as an immediate-timeout poll
+        return poll_once()
     deadline = None if timeout <= 0 else _t.time() + timeout
     entry = server.engine.queue_wait_entry(first_key)
     while not getattr(server, "_closing", False):
@@ -3894,6 +4107,7 @@ def cmd_wait(server, ctx, args):
             n >= want
             or (deadline is not None and _t.time() >= deadline)
             or getattr(server, "_closing", False)
+            or getattr(_exec_tls, "in_exec", False)  # no parking inside EXEC
         ):
             return n
         _t.sleep(0.02)  # parked, not spinning: this holds a pool worker

@@ -81,7 +81,8 @@ def _force_lazies(results: list, server) -> None:
 # fast pool never starves.
 _SLOW_COMMANDS = frozenset(
     b.encode() for b in (
-        "OBJCALL", "OBJCALLM", "OBJCALLMA", "BLPOP", "BRPOP", "BLMOVE",
+        "OBJCALL", "OBJCALLM", "OBJCALLMA", "OBJCALLV", "TXEXEC", "EXEC",
+        "BLPOP", "BRPOP", "BLMOVE",
         "BRPOPLPUSH", "BZPOPMIN", "BZPOPMAX", "BLMPOP", "BZMPOP",
         "XREAD", "XREADGROUP", "WAIT",
     )
@@ -166,6 +167,9 @@ class TpuServer:
         # commands isBlockingCommand and gives them dedicated connections)
         self._slow_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="rtpu-slow")
         self._closing = False
+        # EXEC transactions serialize (see cmd_exec: handlers may take record
+        # locks beyond the precomputed key set)
+        self._exec_mutex = threading.Lock()
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._writers: set = set()

@@ -1,0 +1,143 @@
+"""Async-facade transactions (VERDICT r3 #1: usable from AsyncRemoteRedisson
+and the async cluster client)."""
+import asyncio
+
+import pytest
+
+from redisson_tpu.client.aio import AsyncClusterRedisson, AsyncRemoteRedisson
+from redisson_tpu.harness import ClusterRunner
+from redisson_tpu.server.server import ServerThread
+from redisson_tpu.services.transactions import (
+    TransactionException,
+    TransactionOptions,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(scope="module")
+def server():
+    with ServerThread(port=0) as st:
+        yield st
+
+
+def test_async_commit_and_views(server):
+    async def main():
+        c = await AsyncRemoteRedisson.connect(server.address)
+        c2 = await AsyncRemoteRedisson.connect(server.address)
+        tx = c.create_transaction()
+        await tx.get_bucket("ab").set("v1")
+        m = tx.get_map("am")
+        assert await m.put("k", 1) is None
+        assert await m.put("k", 2) == 1
+        await tx.get_set("as").add("member")
+        await tx.get_map_cache("amc").put_with_ttl("t", "v", ttl=30)
+        await tx.get_set_cache("asc").add("e", ttl=30)
+        assert await c2.get_bucket("ab").get() is None  # no dirty read
+        await tx.commit()
+        assert tx.state == "committed"
+        assert await c2.get_bucket("ab").get() == "v1"
+        assert await c2.get_map("am").get("k") == 2
+        assert await c2.get_set("as").contains("member")
+        assert await c2.get_map_cache("amc").get("t") == "v"
+        assert await c2.get_set_cache("asc").contains("e")
+        await c.aclose()
+        await c2.aclose()
+
+    run(main())
+
+
+def test_async_conflict_and_rollback(server):
+    async def main():
+        c = await AsyncRemoteRedisson.connect(server.address)
+        c2 = await AsyncRemoteRedisson.connect(server.address)
+        await c.get_bucket("acf").set("orig")
+        tx = c.create_transaction()
+        b = tx.get_bucket("acf")
+        assert await b.get() == "orig"
+        await c2.get_bucket("acf").set("concurrent")
+        await b.set("mine")
+        with pytest.raises(TransactionException, match="changed concurrently"):
+            await tx.commit()
+        assert tx.state == "rolled_back"
+        assert await c2.get_bucket("acf").get() == "concurrent"
+        # rollback discards, reuse fails
+        tx = c.create_transaction()
+        await tx.get_bucket("arb").set("x")
+        await tx.rollback()
+        assert await c2.get_bucket("arb").get() is None
+        with pytest.raises(TransactionException):
+            await tx.commit()
+        await c.aclose()
+        await c2.aclose()
+
+    run(main())
+
+
+def test_async_read_your_writes_and_buckets(server):
+    async def main():
+        c = await AsyncRemoteRedisson.connect(server.address)
+        async with c.create_transaction() as tx:
+            m = tx.get_map("aryw")
+            await m.fast_put("k", 42)
+            assert await m.get("k") == 42
+            await m.fast_remove("k")
+            assert await m.get("k") is None
+            bs = tx.get_buckets()
+            assert await bs.try_set({"abk1": 1, "abk2": 2}) is True
+        assert await c.get_bucket("abk1").get() == 1
+        assert await c.get_map("aryw").get("k") is None
+        await c.aclose()
+
+    run(main())
+
+
+def test_async_timeout(server):
+    async def main():
+        c = await AsyncRemoteRedisson.connect(server.address)
+        tx = c.create_transaction(options=TransactionOptions(timeout=0.05))
+        await asyncio.sleep(0.1)
+        with pytest.raises(TransactionException, match="timed out"):
+            await tx.get_bucket("atb").set("late")
+        await c.aclose()
+
+    run(main())
+
+
+def test_async_cluster_cross_shard():
+    runner = ClusterRunner(masters=2).run()
+    sync_client = runner.client(scan_interval=0)
+    seeds = [f"tpu://{a}" for a in sync_client._entries.keys()]
+    sync_client.shutdown()
+
+    async def main():
+        c = await AsyncClusterRedisson.connect(seeds, scan_interval=0)
+        c2 = await AsyncClusterRedisson.connect(seeds, scan_interval=0)
+        groups = c.tx_groups([f"aq{i}" for i in range(40)])
+        assert len(groups) == 2
+        (_, an), (_, bn) = groups.items()
+        na, nb = an[0], bn[0]
+        tx = c.create_transaction()
+        await tx.get_bucket(na).set("A")
+        await tx.get_map(nb).fast_put("k", "B")
+        await tx.commit()
+        assert await c2.get_bucket(na).get() == "A"
+        assert await c2.get_map(nb).get("k") == "B"
+        # cross-shard conflict leaves no torn writes
+        tx = c.create_transaction()
+        assert await tx.get_bucket(na).get() == "A"
+        await c2.get_bucket(na).set("A2")
+        await tx.get_bucket(na).set("mine")
+        await tx.get_map(nb).fast_put("k", "TORN?")
+        with pytest.raises(TransactionException):
+            await tx.commit()
+        assert await c2.get_map(nb).get("k") == "B"
+        await c.aclose()
+        await c2.aclose()
+
+    try:
+        run(main())
+    finally:
+        runner.shutdown()
