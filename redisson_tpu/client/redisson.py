@@ -409,7 +409,7 @@ class RedissonTpu:
     def get_live_object_service(self):
         from redisson_tpu.services.liveobject import LiveObjectService
 
-        return LiveObjectService(self._engine)
+        return LiveObjectService(self)
 
     def get_map_reduce(self, mapper, reducer, collator=None, workers: int = 4, executor=None):
         from redisson_tpu.services.mapreduce import MapReduce

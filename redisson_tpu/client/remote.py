@@ -1295,6 +1295,15 @@ class RemoteSurface:
     def get_keys(self) -> "RemoteKeys":
         return RemoteKeys(self)
 
+    def get_live_object_service(self):
+        """RLiveObjectService over the wire: the service drives this client's
+        own object factories, so every live-object key (map, index sets,
+        score sets — all {Cls:...}-hashtagged) routes per key exactly like
+        the reference's live objects against a cluster."""
+        from redisson_tpu.services.liveobject import LiveObjectService
+
+        return LiveObjectService(self)
+
     # -- generic surface -----------------------------------------------------
 
     _LOCK_FACTORIES = {"get_lock", "get_fair_lock", "get_spin_lock", "get_fenced_lock"}

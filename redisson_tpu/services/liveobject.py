@@ -1,18 +1,31 @@
-"""LiveObject service: objects whose attributes live in the grid.
+"""LiveObject service: objects whose attributes live in the grid — on EVERY
+facade.
 
 Parity target (SURVEY.md §2.6): ``org/redisson/RedissonLiveObjectService.java``
-(929 LoC) + ``liveobject/core/AccessorInterceptor.java`` + LiveObjectSearch —
-the reference generates a ByteBuddy proxy per @REntity class whose field
-accessors read/write an RMap hash; @RId names the primary key; @RIndex'd
-fields maintain index sets enabling condition search (EQ/GT/LT/IN/AND/OR).
+(929 LoC) + ``liveobject/core/AccessorInterceptor.java`` + LiveObjectSearch
+(``liveobject/LiveObjectSearch.java``) — the reference generates a ByteBuddy
+proxy per @REntity class whose field accessors read/write an RMap hash; @RId
+names the primary key; @RIndex'd fields maintain index structures enabling
+condition search over the full tree ``liveobject/condition/{EQ,GT,GE,LT,LE,
+IN,AND,OR}Condition.java``.
 
-Here: `@entity` marks a Python class (with `id_field`); `attach/persist/get`
-return a proxy whose __getattr__/__setattr__ hit the backing Map;
-`@indexed` fields maintain per-value index sets used by `find`.
+Design here: `@entity` marks a Python class (with `id_field`); `attach/
+persist/get` return a proxy whose __getattr__/__setattr__ hit the backing
+Map.  `@indexed` fields maintain TWO index structures per the reference's
+split: a per-value Set (EQ/IN membership) and, for numeric values, ONE
+ScoredSortedSet per field scoring rid -> value (GT/GE/LT/LE ranges ride
+ZRANGEBYSCORE instead of scanning per-value sets).
+
+The service talks ONLY through a client facade's object factories
+(get_map/get_set/get_scored_sorted_set), so the same code serves the
+embedded client, RemoteRedisson, and ClusterRedisson — every key carries a
+{Cls:...} hashtag and routes per key, exactly how the reference's live
+objects work against a cluster.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Type
+import math
+from typing import Any, Iterable, List, Optional, Type
 
 
 def entity(id_field: str = "id", indexed: tuple = ()):  # decorator
@@ -24,6 +37,104 @@ def entity(id_field: str = "id", indexed: tuple = ()):  # decorator
         return cls
 
     return wrap
+
+
+# -- condition tree (liveobject/condition/*.java) -----------------------------
+
+
+class Condition:
+    """Search-condition node; combine with & / | like Conditions.and_/or_."""
+
+    def __and__(self, other: "Condition") -> "ANDCondition":
+        return ANDCondition(self, other)
+
+    def __or__(self, other: "Condition") -> "ORCondition":
+        return ORCondition(self, other)
+
+
+class _FieldCondition(Condition):
+    def __init__(self, field: str, value: Any):
+        self.field = field
+        self.value = value
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.field!r}, {self.value!r})"
+
+
+class EQCondition(_FieldCondition):
+    pass
+
+
+class GTCondition(_FieldCondition):
+    pass
+
+
+class GECondition(_FieldCondition):
+    pass
+
+
+class LTCondition(_FieldCondition):
+    pass
+
+
+class LECondition(_FieldCondition):
+    pass
+
+
+class INCondition(Condition):
+    def __init__(self, field: str, values: Iterable[Any]):
+        self.field = field
+        self.values = tuple(values)
+
+
+class ANDCondition(Condition):
+    def __init__(self, *conditions: Condition):
+        self.conditions = tuple(conditions)
+
+
+class ORCondition(Condition):
+    def __init__(self, *conditions: Condition):
+        self.conditions = tuple(conditions)
+
+
+class Conditions:
+    """org.redisson.api.condition.Conditions static-factory analog."""
+
+    @staticmethod
+    def eq(field: str, value: Any) -> EQCondition:
+        return EQCondition(field, value)
+
+    @staticmethod
+    def gt(field: str, value: float) -> GTCondition:
+        return GTCondition(field, value)
+
+    @staticmethod
+    def ge(field: str, value: float) -> GECondition:
+        return GECondition(field, value)
+
+    @staticmethod
+    def lt(field: str, value: float) -> LTCondition:
+        return LTCondition(field, value)
+
+    @staticmethod
+    def le(field: str, value: float) -> LECondition:
+        return LECondition(field, value)
+
+    @staticmethod
+    def in_(field: str, values: Iterable[Any]) -> INCondition:
+        return INCondition(field, values)
+
+    @staticmethod
+    def and_(*conditions: Condition) -> ANDCondition:
+        return ANDCondition(*conditions)
+
+    @staticmethod
+    def or_(*conditions: Condition) -> ORCondition:
+        return ORCondition(*conditions)
+
+
+def _is_numeric(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
 
 
 class LiveObjectProxy:
@@ -70,10 +181,22 @@ class LiveObjectProxy:
 
 
 class LiveObjectService:
-    """RLiveObjectService analog: persist/get/delete/is_exists/find."""
+    """RLiveObjectService analog: persist/get/delete/is_exists/find.
 
-    def __init__(self, engine):
-        self._engine = engine
+    Accepts either a client facade (embedded/remote/cluster — anything with
+    get_map/get_set/get_scored_sorted_set) or a bare Engine (back-compat:
+    wrapped in the embedded facade)."""
+
+    def __init__(self, client_or_engine):
+        from redisson_tpu.core.engine import Engine
+
+        if isinstance(client_or_engine, Engine):
+            from redisson_tpu.client.redisson import RedissonTpu
+
+            client_or_engine = RedissonTpu(client_or_engine)
+        self._client = client_or_engine
+
+    # -- key naming (every key hashtags by its own identity) ------------------
 
     def _map_name(self, cls: Type, rid: Any) -> str:
         return f"redisson_live_object:{{{cls.__name__}:{rid!r}}}"
@@ -81,26 +204,34 @@ class LiveObjectService:
     def _index_name(self, cls: Type, field: str, value: Any) -> str:
         return f"redisson_live_object_index:{{{cls.__name__}:{field}:{value!r}}}"
 
+    def _score_name(self, cls: Type, field: str) -> str:
+        return f"redisson_live_object_score:{{{cls.__name__}:{field}}}"
+
     def _ids_name(self, cls: Type) -> str:
         return f"redisson_live_object_ids:{{{cls.__name__}}}"
 
     def _backing_map(self, cls: Type, rid: Any):
-        from redisson_tpu.client.objects.map import Map
-
-        return Map(self._engine, self._map_name(cls, rid))
+        return self._client.get_map(self._map_name(cls, rid))
 
     def _ids_set(self, cls: Type):
-        from redisson_tpu.client.objects.set import Set as RSet
+        return self._client.get_set(self._ids_name(cls))
 
-        return RSet(self._engine, self._ids_name(cls))
+    def _value_set(self, cls: Type, field: str, value: Any):
+        return self._client.get_set(self._index_name(cls, field, value))
+
+    def _score_set(self, cls: Type, field: str):
+        return self._client.get_scored_sorted_set(self._score_name(cls, field))
 
     def _index_update(self, cls: Type, field: str, rid: Any, old: Any, new: Any):
-        from redisson_tpu.client.objects.set import Set as RSet
-
         if old is not None:
-            RSet(self._engine, self._index_name(cls, field, old)).remove(rid)
+            self._value_set(cls, field, old).remove(rid)
+            if _is_numeric(old) and not _is_numeric(new):
+                self._score_set(cls, field).remove(rid)
         if new is not None:
-            RSet(self._engine, self._index_name(cls, field, new)).add(rid)
+            self._value_set(cls, field, new).add(rid)
+            if _is_numeric(new):
+                # rid -> value: GT/GE/LT/LE ride one ZRANGEBYSCORE
+                self._score_set(cls, field).add(float(new), rid)
 
     # -- lifecycle (RLiveObjectService.persist/attach/get/delete) ------------
 
@@ -145,19 +276,70 @@ class LiveObjectService:
         self._ids_set(cls).remove(rid)
         return True
 
-    # -- search (LiveObjectSearch / liveobject/condition/*) ------------------
+    # -- search (LiveObjectSearch over liveobject/condition/*) ----------------
 
-    def find(self, cls: Type, **conditions) -> List[LiveObjectProxy]:
-        """EQ-conditions across indexed fields, AND-combined (the common
-        Conditions.and_(Conditions.eq(...)) shape)."""
-        from redisson_tpu.client.objects.set import Set as RSet
+    def _check_indexed(self, cls: Type, field: str) -> None:
+        if field not in cls.__rindexed__:
+            raise ValueError(f"field {field!r} is not indexed on {cls.__name__}")
 
-        ids: Optional[set] = None
-        for field, value in conditions.items():
-            if field not in cls.__rindexed__:
-                raise ValueError(f"field {field!r} is not indexed on {cls.__name__}")
-            matches = set(RSet(self._engine, self._index_name(cls, field, value)).read_all())
-            ids = matches if ids is None else (ids & matches)
-        if ids is None:
+    def _resolve(self, cls: Type, cond: Condition) -> set:
+        """Condition tree -> id set (LiveObjectSearch.traverseAnd/Or)."""
+        if isinstance(cond, EQCondition):
+            self._check_indexed(cls, cond.field)
+            return set(self._value_set(cls, cond.field, cond.value).read_all())
+        if isinstance(cond, INCondition):
+            self._check_indexed(cls, cond.field)
+            out: set = set()
+            for v in cond.values:
+                out |= set(self._value_set(cls, cond.field, v).read_all())
+            return out
+        if isinstance(cond, (GTCondition, GECondition, LTCondition, LECondition)):
+            self._check_indexed(cls, cond.field)
+            inf = math.inf
+            lo, lo_inc, hi, hi_inc = -inf, True, inf, True
+            if isinstance(cond, GTCondition):
+                lo, lo_inc = float(cond.value), False
+            elif isinstance(cond, GECondition):
+                lo, lo_inc = float(cond.value), True
+            elif isinstance(cond, LTCondition):
+                hi, hi_inc = float(cond.value), False
+            else:
+                hi, hi_inc = float(cond.value), True
+            return set(
+                self._score_set(cls, cond.field).value_range_by_score(
+                    lo, lo_inc, hi, hi_inc
+                )
+            )
+        if isinstance(cond, ANDCondition):
+            ids: Optional[set] = None
+            for c in cond.conditions:
+                sub = self._resolve(cls, c)
+                ids = sub if ids is None else (ids & sub)
+                if not ids:
+                    return set()
+            return ids if ids is not None else set()
+        if isinstance(cond, ORCondition):
+            out = set()
+            for c in cond.conditions:
+                out |= self._resolve(cls, c)
+            return out
+        raise TypeError(f"unknown condition: {cond!r}")
+
+    def find(self, cls: Type, *conditions: Condition, **eq_conditions) -> List[LiveObjectProxy]:
+        """RLiveObjectService.find(cls, condition).  Positional `Condition`
+        nodes AND-combine with keyword EQ shorthands; no conditions = all
+        instances.  Full tree support: EQ/GT/GE/LT/LE/IN/AND/OR
+        (liveobject/condition/*.java, LiveObjectSearch.java)."""
+        conds = list(conditions) + [
+            EQCondition(f, v) for f, v in eq_conditions.items()
+        ]
+        if not conds:
             ids = set(self._ids_set(cls).read_all())
+        else:
+            ids = self._resolve(
+                cls, conds[0] if len(conds) == 1 else ANDCondition(*conds)
+            )
         return [LiveObjectProxy(self, cls, rid) for rid in sorted(ids, key=repr)]
+
+    def count(self, cls: Type, *conditions: Condition, **eq_conditions) -> int:
+        return len(self.find(cls, *conditions, **eq_conditions))
