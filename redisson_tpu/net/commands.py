@@ -131,8 +131,22 @@ _spec(SPECS, "JSON.GET JSON.TYPE JSON.STRLEN JSON.ARRLEN JSON.ARRINDEX "
 _spec(SPECS, "JSON.SET JSON.DEL JSON.NUMINCRBY JSON.STRAPPEND JSON.ARRAPPEND "
              "JSON.ARRINSERT JSON.ARRPOP JSON.ARRTRIM JSON.CLEAR JSON.TOGGLE "
              "JSON.MERGE", True, 0)
-_spec(SPECS, "FT.SEARCH FT.AGGREGATE FT.INFO FT._LIST", False, None)
-_spec(SPECS, "FT.CREATE FT.DROPINDEX", True, None)
+_spec(SPECS, "FT.SEARCH FT.AGGREGATE FT.INFO FT._LIST FT.SPELLCHECK "
+             "FT.DICTDUMP FT.CURSOR", False, None)
+_spec(SPECS, "FT.CREATE FT.DROPINDEX FT.ALTER FT.ALIASADD FT.ALIASUPDATE "
+             "FT.ALIASDEL FT.DICTADD FT.DICTDEL", True, None)
+
+# bitfields (Redis bit-layout over the BitSet record)
+_spec(SPECS, "BITFIELD", True, 0)
+_spec(SPECS, "BITFIELD_RO", False, 0)
+
+# pubsub introspection + sharded pubsub (routing for S* happens client-side
+# by channel slot, same as the plain SUBSCRIBE discipline)
+_spec(SPECS, "PUBSUB SSUBSCRIBE SUNSUBSCRIBE SPUBLISH", False, None)
+
+# legacy GEO radius forms (GEORADIUS may STORE -> write)
+_spec(SPECS, "GEORADIUS GEORADIUSBYMEMBER", True, 0)
+_spec(SPECS, "GEORADIUS_RO GEORADIUSBYMEMBER_RO", False, 0)
 
 # script/function invocation: keys follow the numkeys arg (EVAL-style);
 # FCALL_RO is replica-servable, the rest mutate

@@ -523,6 +523,140 @@ def cmd_bitop(server, ctx, args):
     )
 
 
+def _bf_type(tok: bytes):
+    """u<w> (1..63) or i<w> (1..64) -> (signed, width)."""
+    t = bytes(tok)
+    if len(t) < 2 or t[:1] not in (b"u", b"i"):
+        raise RespError("ERR Invalid bitfield type. Use something like i16 u8.")
+    signed = t[:1] == b"i"
+    try:
+        width = int(t[1:])
+    except ValueError:
+        raise RespError("ERR Invalid bitfield type. Use something like i16 u8.")
+    if not 1 <= width <= (64 if signed else 63):
+        raise RespError("ERR Invalid bitfield type. Use something like i16 u8.")
+    return signed, width
+
+
+def _bf_offset(tok: bytes, width: int) -> int:
+    t = bytes(tok)
+    if t[:1] == b"#":
+        return int(t[1:]) * width
+    return int(t)
+
+
+@register("BITFIELD")
+def cmd_bitfield(server, ctx, args):
+    """BITFIELD key [GET ty off] [SET ty off v] [INCRBY ty off n]
+    [OVERFLOW WRAP|SAT|FAIL] — Redis bit-layout semantics (offset 0 is the
+    MSB of byte 0, matching GETBIT/SETBIT numbering) over the BitSet record;
+    fields read/write through the batched get_each/set_each forms so one
+    subcommand costs one indexed kernel, not w scalar ops
+    (client/protocol/RedisCommands.java BITFIELD def)."""
+    import numpy as np
+
+    bs = _bitset(server, _s(args[0]))
+    overflow = "WRAP"
+    out: List[Any] = []
+    i = 1
+
+    def read_field(signed, width, off):
+        idx = np.arange(off, off + width, dtype=np.int64)
+        nbits = bs.size()
+        bits = np.zeros(width, np.uint64)
+        in_range = idx < nbits  # bits past the plane read 0 (Redis strings)
+        if in_range.any():
+            bits[in_range] = np.asarray(bs.get_each(idx[in_range]), np.uint64)
+        val = 0
+        for b in bits:
+            val = (val << 1) | int(b)
+        if signed and width and (val >> (width - 1)) & 1:
+            val -= 1 << width
+        return val
+
+    def write_field(width, off, val):
+        mask = (1 << width) - 1
+        uval = val & mask
+        bits = np.array(
+            [(uval >> (width - 1 - k)) & 1 for k in range(width)], dtype=bool
+        )
+        idx = np.arange(off, off + width, dtype=np.int64)
+        if bits.any():
+            bs.set_each(idx[bits], True)
+        if (~bits).any():
+            bs.set_each(idx[~bits], False)
+
+    def apply_overflow(signed, width, val):
+        """-> (in-range value, failed) per OVERFLOW mode."""
+        lo = -(1 << (width - 1)) if signed else 0
+        hi = (1 << (width - 1)) - 1 if signed else (1 << width) - 1
+        if lo <= val <= hi:
+            return val, False
+        if overflow == "FAIL":
+            return 0, True
+        if overflow == "SAT":
+            return (lo if val < lo else hi), False
+        span = 1 << width  # WRAP: two's-complement modular arithmetic
+        wrapped = val % span
+        if signed and wrapped > hi:
+            wrapped -= span
+        return wrapped, False
+
+    while i < len(args):
+        op = bytes(args[i]).upper()
+        if op == b"OVERFLOW":
+            mode = bytes(args[i + 1]).upper().decode()
+            if mode not in ("WRAP", "SAT", "FAIL"):
+                raise RespError("ERR Invalid OVERFLOW type specified")
+            overflow = mode
+            i += 2
+        elif op == b"GET":
+            signed, width = _bf_type(args[i + 1])
+            off = _bf_offset(args[i + 2], width)
+            out.append(read_field(signed, width, off))
+            i += 3
+        elif op == b"SET":
+            signed, width = _bf_type(args[i + 1])
+            off = _bf_offset(args[i + 2], width)
+            new = _int(args[i + 3])
+            with server.engine.locked(_s(args[0])):
+                old = read_field(signed, width, off)
+                new, failed = apply_overflow(signed, width, new)
+                if failed:
+                    out.append(None)
+                else:
+                    write_field(width, off, new)
+                    out.append(old)
+            i += 4
+        elif op == b"INCRBY":
+            signed, width = _bf_type(args[i + 1])
+            off = _bf_offset(args[i + 2], width)
+            delta = _int(args[i + 3])
+            with server.engine.locked(_s(args[0])):
+                cur = read_field(signed, width, off)
+                new, failed = apply_overflow(signed, width, cur + delta)
+                if failed:
+                    out.append(None)
+                else:
+                    write_field(width, off, new)
+                    out.append(new)
+            i += 4
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    return out
+
+
+@register("BITFIELD_RO")
+def cmd_bitfield_ro(server, ctx, args):
+    """Read-only BITFIELD: GET subcommands only (replica-servable)."""
+    for i in range(1, len(args), 3):
+        if bytes(args[i]).upper() != b"GET":
+            raise RespError(
+                "ERR BITFIELD_RO only supports the GET subcommand"
+            )
+    return cmd_bitfield(server, ctx, args)
+
+
 # batched forms: SETBITS name idx... / GETBITS name idx... (one kernel each)
 @register("SETBITS")
 def cmd_setbits(server, ctx, args):
@@ -814,6 +948,86 @@ def cmd_punsubscribe(server, ctx, args):
 @register("PUBLISH")
 def cmd_publish(server, ctx, args):
     return server.engine.pubsub.publish(_s(args[0]), bytes(args[1]))
+
+
+@register("PUBSUB")
+def cmd_pubsub(server, ctx, args):
+    """PUBSUB CHANNELS [pattern] | NUMSUB [ch...] | NUMPAT |
+    SHARDCHANNELS [pattern] | SHARDNUMSUB [ch...] — hub introspection
+    (RedissonTopic.countSubscribers / getChannelNames role)."""
+    hub = server.engine.pubsub
+    sub = bytes(args[0]).upper() if args else b""
+    if sub in (b"CHANNELS", b"SHARDCHANNELS"):
+        prefix = _SHARD_NS if sub == b"SHARDCHANNELS" else ""
+        pattern = _s(args[1]) if len(args) > 1 else "*"
+        out = []
+        for ch in hub.channels():
+            if prefix:
+                if not ch.startswith(prefix):
+                    continue
+                ch = ch[len(prefix):]
+            elif ch.startswith(_SHARD_NS):
+                continue  # shard channels live in their own namespace
+            if _glob_match(pattern, ch):
+                out.append(ch.encode())
+        return sorted(out)
+    if sub in (b"NUMSUB", b"SHARDNUMSUB"):
+        prefix = _SHARD_NS if sub == b"SHARDNUMSUB" else ""
+        out = []
+        for raw in args[1:]:
+            ch = _s(raw)
+            out += [raw, hub.subscriber_count(prefix + ch)]
+        return out
+    if sub == b"NUMPAT":
+        return len(hub._patterns)
+    raise RespError(f"ERR Unknown PUBSUB subcommand '{_s(args[0]) if args else ''}'")
+
+
+# sharded pubsub (Redis 7 SPUBLISH/SSUBSCRIBE): shard channels are a
+# SEPARATE namespace (a PUBLISH must not reach an SSUBSCRIBE listener) —
+# modeled as a reserved hub-channel prefix.  Slot routing happens client-
+# side by channel name, same as the plain-SUBSCRIBE slot routing the
+# cluster client already does (RedissonShardedTopic semantic parity).
+_SHARD_NS = "__shard__:"
+
+
+@register("SSUBSCRIBE")
+def cmd_ssubscribe(server, ctx, args):
+    out = []
+    for ch_raw in args:
+        ch = _s(ch_raw)
+        hubch = _SHARD_NS + ch
+        if hubch not in ctx.subscriptions:
+            push = ctx.push
+
+            def listener(channel, msg, _push=push, _ch=ch):
+                _push(Push([
+                    b"smessage", _ch.encode(),
+                    msg if isinstance(msg, bytes) else pickle.dumps(msg),
+                ]))
+
+            ctx.subscriptions[hubch] = server.engine.pubsub.subscribe(hubch, listener)
+        out.append(Push([b"ssubscribe", ch_raw, ctx.subscription_count()]))
+    return out
+
+
+@register("SUNSUBSCRIBE")
+def cmd_sunsubscribe(server, ctx, args):
+    chans = [_s(a) for a in args] or [
+        c[len(_SHARD_NS):] for c in ctx.subscriptions if c.startswith(_SHARD_NS)
+    ]
+    out = []
+    for ch in chans:
+        lid = ctx.subscriptions.pop(_SHARD_NS + ch, None)
+        if lid is not None:
+            server.engine.pubsub.unsubscribe(_SHARD_NS + ch, lid)
+        out.append(Push([b"sunsubscribe", ch.encode(), ctx.subscription_count()]))
+    return out
+
+
+@register("SPUBLISH")
+def cmd_spublish(server, ctx, args):
+    return server.engine.pubsub.publish(_SHARD_NS + _s(args[0]), bytes(args[1]))
 
 
 # -- admin / node info (redisnode/* surface) ---------------------------------
@@ -3552,6 +3766,83 @@ def cmd_geosearchstore(server, ctx, args):
     )
 
 
+def _georadius(server, ctx, args, by_member: bool, allow_store: bool = True):
+    """Legacy GEORADIUS[BYMEMBER] translated onto the GEOSEARCH engine
+    (Redis 6.2 deprecates these in favor of GEOSEARCH; the reference's
+    RedissonGeo still drives them — client/protocol/RedisCommands.java
+    GEORADIUS defs).  STORE/STOREDIST subset: plain STORE only."""
+    key = args[0]
+    if by_member:
+        head = [key, b"FROMMEMBER", args[1]]
+        i = 4
+        radius, unit = args[2], args[3]
+    else:
+        head = [key, b"FROMLONLAT", args[1], args[2]]
+        i = 5
+        radius, unit = args[3], args[4]
+    head += [b"BYRADIUS", radius, unit]
+    store = None
+    tail = []
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt in (b"WITHCOORD", b"WITHDIST", b"ASC", b"DESC"):
+            tail.append(args[i])
+            i += 1
+        elif opt == b"WITHHASH":
+            i += 1  # geohash integers are not materialized here; ignored
+        elif opt == b"COUNT":
+            tail += [args[i], args[i + 1]]
+            i += 2
+            if i < len(args) and bytes(args[i]).upper() == b"ANY":
+                tail.append(args[i])
+                i += 1
+        elif opt in (b"STORE", b"STOREDIST"):
+            if not allow_store:
+                raise RespError(
+                    "ERR STORE option in GEORADIUS is not compatible with "
+                    "the _RO variant"
+                )
+            if opt == b"STOREDIST":
+                raise RespError("ERR STOREDIST is not supported; use STORE")
+            store = _s(args[i + 1])
+            i += 2
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    if store is not None:
+        g = _geo(server, _s(key))
+        if by_member:
+            p = g.pos(bytes(args[1])).get(bytes(args[1]))
+            if p is None:
+                raise RespError("ERR could not decode requested zset member")
+            lon, lat = p
+        else:
+            lon, lat = float(args[1]), float(args[2])
+        return g.store_search_radius_to(
+            store, lon, lat, float(radius), unit=_s(unit).lower()
+        )
+    return cmd_geosearch(server, ctx, head + tail)
+
+
+@register("GEORADIUS")
+def cmd_georadius(server, ctx, args):
+    return _georadius(server, ctx, args, by_member=False)
+
+
+@register("GEORADIUS_RO")
+def cmd_georadius_ro(server, ctx, args):
+    return _georadius(server, ctx, args, by_member=False, allow_store=False)
+
+
+@register("GEORADIUSBYMEMBER")
+def cmd_georadiusbymember(server, ctx, args):
+    return _georadius(server, ctx, args, by_member=True)
+
+
+@register("GEORADIUSBYMEMBER_RO")
+def cmd_georadiusbymember_ro(server, ctx, args):
+    return _georadius(server, ctx, args, by_member=True, allow_store=False)
+
+
 # -- redis-stack module verbs: JSON.* (RedisJSON role — RedissonJsonBucket
 # -- drives these same verbs in the reference) -------------------------------
 
@@ -3918,18 +4209,25 @@ def cmd_ft_search(server, ctx, args):
 @_ft_cmd
 def cmd_ft_aggregate(server, ctx, args):
     """FT.AGGREGATE idx query [GROUPBY 1 @f REDUCE op n [@f] AS name ...]
-    [SORTBY n @f [ASC|DESC]] [LIMIT off n]."""
+    [SORTBY n @f [ASC|DESC]] [LIMIT off n] [WITHCURSOR [COUNT n]]."""
     svc = _ft(server)
     idx = svc._idx(_s(args[0]))  # KeyError -> Unknown Index via _ft_cmd
-    svc.sync(_s(args[0]))
+    svc.sync(svc.resolve(_s(args[0])))
     cond = _ft_parse_query(_s(args[1]), idx.schema)
     group_by, reducers = None, {}
     sort_by, desc = None, False
     off, lim = 0, None
+    withcursor, cursor_count = False, 1000
     i = 2
     while i < len(args):
         opt = bytes(args[i]).upper()
-        if opt == b"GROUPBY":
+        if opt == b"WITHCURSOR":
+            withcursor = True
+            i += 1
+            if i + 1 < len(args) and bytes(args[i]).upper() == b"COUNT":
+                cursor_count = _int(args[i + 1])
+                i += 2
+        elif opt == b"GROUPBY":
             if _int(args[i + 1]) != 1:
                 raise RespError("ERR GROUPBY supports exactly one property")
             group_by = _s(args[i + 2]).lstrip("@")
@@ -3960,13 +4258,134 @@ def cmd_ft_aggregate(server, ctx, args):
     rows = svc.aggregate(_s(args[0]), cond, group_by=group_by,
                          reducers=reducers or None, sort_by=sort_by,
                          descending=desc, offset=off, limit=lim)
-    out = [len(rows)]
+    flat_rows = []
     for row in rows:
         flat = []
         for k, v in row.items():
             flat += [str(k).encode(), str(v).encode()]
-        out.append(flat)
-    return out
+        flat_rows.append(flat)
+    if withcursor:
+        batch, rest = flat_rows[:cursor_count], flat_rows[cursor_count:]
+        cid = svc.cursor_create(rest) if rest else 0
+        return [[len(batch)] + batch, cid]
+    return [len(flat_rows)] + flat_rows
+
+
+@register("FT.CURSOR")
+@_ft_cmd
+def cmd_ft_cursor(server, ctx, args):
+    """FT.CURSOR READ idx cid [COUNT n] | FT.CURSOR DEL idx cid — pages a
+    WITHCURSOR aggregation (RediSearch cursor API)."""
+    svc = _ft(server)
+    sub = bytes(args[0]).upper()
+    cid = _int(args[2])
+    if sub == b"READ":
+        count = 1000
+        if len(args) > 4 and bytes(args[3]).upper() == b"COUNT":
+            count = _int(args[4])
+        rows, nxt = svc.cursor_read(cid, count)  # KeyError -> unknown cursor
+        return [[len(rows)] + rows, nxt]
+    if sub == b"DEL":
+        svc.cursor_del(cid)
+        return "+OK"
+    raise RespError("ERR syntax error")
+
+
+@register("FT.ALTER")
+@_ft_cmd
+def cmd_ft_alter(server, ctx, args):
+    """FT.ALTER idx SCHEMA ADD field type [SORTABLE]."""
+    if (
+        len(args) < 5
+        or bytes(args[1]).upper() != b"SCHEMA"
+        or bytes(args[2]).upper() != b"ADD"
+    ):
+        raise RespError("ERR syntax error")
+    ty = bytes(args[4]).upper().decode()
+    if ty not in ("TEXT", "TAG", "NUMERIC"):
+        raise RespError(f"ERR unsupported field type '{ty}'")
+    try:
+        _ft(server).alter(_s(args[0]), _s(args[3]), ty)
+    except ValueError as e:
+        raise RespError(f"ERR {e}")
+    return "+OK"
+
+
+@register("FT.ALIASADD")
+@_ft_cmd
+def cmd_ft_aliasadd(server, ctx, args):
+    try:
+        _ft(server).alias_add(_s(args[0]), _s(args[1]))
+    except ValueError as e:
+        raise RespError(f"ERR {e}")
+    return "+OK"
+
+
+@register("FT.ALIASUPDATE")
+@_ft_cmd
+def cmd_ft_aliasupdate(server, ctx, args):
+    _ft(server).alias_update(_s(args[0]), _s(args[1]))
+    return "+OK"
+
+
+@register("FT.ALIASDEL")
+@_ft_cmd
+def cmd_ft_aliasdel(server, ctx, args):
+    try:
+        _ft(server).alias_del(_s(args[0]))
+    except ValueError as e:
+        raise RespError(f"ERR {e}")
+    return "+OK"
+
+
+@register("FT.DICTADD")
+@_ft_cmd
+def cmd_ft_dictadd(server, ctx, args):
+    return _ft(server).dict_add(_s(args[0]), *[_s(a) for a in args[1:]])
+
+
+@register("FT.DICTDEL")
+@_ft_cmd
+def cmd_ft_dictdel(server, ctx, args):
+    return _ft(server).dict_del(_s(args[0]), *[_s(a) for a in args[1:]])
+
+
+@register("FT.DICTDUMP")
+@_ft_cmd
+def cmd_ft_dictdump(server, ctx, args):
+    return [t.encode() for t in _ft(server).dict_dump(_s(args[0]))]
+
+
+@register("FT.SPELLCHECK")
+@_ft_cmd
+def cmd_ft_spellcheck(server, ctx, args):
+    """FT.SPELLCHECK idx query [DISTANCE d] [TERMS INCLUDE|EXCLUDE dict]...
+    -> [["TERM", term, [[score, suggestion], ...]], ...]."""
+    include, exclude = [], []
+    distance = 1
+    i = 2
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"DISTANCE":
+            distance = _int(args[i + 1])
+            if not 1 <= distance <= 4:
+                raise RespError("ERR invalid distance, must be between 1 and 4")
+            i += 2
+        elif opt == b"TERMS":
+            mode = bytes(args[i + 1]).upper()
+            (include if mode == b"INCLUDE" else exclude).append(_s(args[i + 2]))
+            i += 3
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    res = _ft(server).spellcheck(
+        _s(args[0]), _s(args[1]), include=include, exclude=exclude,
+        distance=distance,
+    )
+    return [
+        [b"TERM", term.encode(),
+         [[_fnum(score), sugg.encode()] for score, sugg in suggs]]
+        for term, suggs in res.items()
+    ]
 
 
 # -- script / function / admin verbs (RScript + RFunction wire surface) ------

@@ -326,12 +326,35 @@ class SearchResult:
 # -- service -----------------------------------------------------------------
 
 
+def _edit_distance_le(a: str, b: str, k: int) -> bool:
+    """Levenshtein distance <= k (banded DP; FT.SPELLCHECK DISTANCE 1-4)."""
+    if abs(len(a) - len(b)) > k:
+        return False
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        best = i
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(
+                prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb)
+            )
+            best = min(best, cur[j])
+        if best > k:
+            return False
+        prev = cur
+    return prev[-1] <= k
+
+
 class SearchService:
     """RSearch analog bound to one engine."""
 
     def __init__(self, engine):
         self._engine = engine
         self._indexes: Dict[str, SearchIndex] = {}
+        self._aliases: Dict[str, str] = {}       # alias -> index name
+        self._dicts: Dict[str, set] = {}         # FT.DICT* custom dictionaries
+        self._cursors: Dict[int, List[Any]] = {}  # FT.CURSOR id -> pending rows
+        self._next_cursor = 1
         self._lock = threading.Lock()
 
     # -- FT.CREATE / DROPINDEX / _LIST ---------------------------------------
@@ -373,10 +396,143 @@ class SearchService:
 
     def _idx(self, name: str) -> SearchIndex:
         with self._lock:
+            name = self._aliases.get(name, name)
             idx = self._indexes.get(name)
         if idx is None:
             raise KeyError(f"no such index '{name}'")
         return idx
+
+    def resolve(self, name: str) -> str:
+        """Alias -> real index name (identity for real names)."""
+        with self._lock:
+            return self._aliases.get(name, name)
+
+    # -- FT.ALTER ------------------------------------------------------------
+
+    def alter(self, name: str, field: str, ftype: str) -> None:
+        """FT.ALTER idx SCHEMA ADD field type: rebuild the index with the
+        widened schema and re-add every stored doc (the numeric plane's
+        column set is fixed at construction, so ALTER swaps the index the
+        way RediSearch rescans)."""
+        old = self._idx(name)
+        if field in old.schema:
+            raise ValueError(f"field '{field}' already exists")
+        schema = dict(old.schema)
+        schema[field] = ftype
+        fresh = SearchIndex(old.name, schema, old.prefixes, old.doc_mode)
+        with old._lock:
+            for doc_id, fields in old.docs.items():
+                fresh.add(doc_id, fields)
+        with self._lock:
+            self._indexes[old.name] = fresh
+        self.sync(old.name)
+
+    # -- FT.ALIAS* -----------------------------------------------------------
+
+    def alias_add(self, alias: str, index: str) -> None:
+        self._idx(index)  # KeyError if unknown
+        with self._lock:
+            if alias in self._aliases:
+                raise ValueError(f"alias '{alias}' already exists")
+            self._aliases[alias] = self._aliases.get(index, index)
+
+    def alias_update(self, alias: str, index: str) -> None:
+        self._idx(index)
+        with self._lock:
+            self._aliases[alias] = self._aliases.get(index, index)
+
+    def alias_del(self, alias: str) -> None:
+        with self._lock:
+            if alias not in self._aliases:
+                raise ValueError(f"alias '{alias}' does not exist")
+            del self._aliases[alias]
+
+    # -- FT.DICT* ------------------------------------------------------------
+
+    def dict_add(self, name: str, *terms: str) -> int:
+        with self._lock:
+            d = self._dicts.setdefault(name, set())
+            before = len(d)
+            d.update(terms)
+            return len(d) - before
+
+    def dict_del(self, name: str, *terms: str) -> int:
+        with self._lock:
+            d = self._dicts.get(name, set())
+            n = 0
+            for t in terms:
+                if t in d:
+                    d.discard(t)
+                    n += 1
+            return n
+
+    def dict_dump(self, name: str) -> List[str]:
+        with self._lock:
+            return sorted(self._dicts.get(name, ()))
+
+    # -- FT.SPELLCHECK -------------------------------------------------------
+
+    def spellcheck(
+        self, index: str, query: str, include: Sequence[str] = (),
+        exclude: Sequence[str] = (), distance: int = 1,
+    ) -> Dict[str, List[Tuple[float, str]]]:
+        """Suggestions for query terms absent from the index vocabulary
+        (RediSearch FT.SPELLCHECK): candidates come from the index's TEXT
+        terms plus INCLUDE dicts, minus EXCLUDE dicts; scored by the share
+        of docs containing the suggestion (the RediSearch score shape)."""
+        idx = self._idx(index)
+        self.sync(self.resolve(index))
+        vocab: Dict[str, int] = {}
+        with idx._lock:
+            ndocs = max(1, len(idx.docs))
+            for words in idx._text.values():
+                for w, ids in words.items():
+                    if ids:
+                        vocab[w] = max(vocab.get(w, 0), len(ids))
+        with self._lock:
+            included = set().union(*(self._dicts.get(d, set()) for d in include)) if include else set()
+            excluded = set().union(*(self._dicts.get(d, set()) for d in exclude)) if exclude else set()
+        known = (set(vocab) | included) - excluded
+        out: Dict[str, List[Tuple[float, str]]] = {}
+        for term in tokenize(query):
+            if term in known:
+                continue
+            sugg = [
+                (vocab.get(c, 0) / ndocs if c in vocab else 0.0, c)
+                for c in known
+                if _edit_distance_le(term, c, distance)
+            ]
+            sugg.sort(key=lambda t: (-t[0], t[1]))
+            out[term] = sugg
+        return out
+
+    # -- FT.CURSOR -----------------------------------------------------------
+
+    def cursor_create(self, rows: List[Any]) -> int:
+        with self._lock:
+            cid = self._next_cursor
+            self._next_cursor += 1
+            self._cursors[cid] = list(rows)
+            return cid
+
+    def cursor_read(self, cid: int, count: int) -> Tuple[List[Any], int]:
+        """Returns (rows, next_cursor_id); 0 = exhausted (and deleted)."""
+        with self._lock:
+            pending = self._cursors.get(cid)
+            if pending is None:
+                raise KeyError(f"no such cursor {cid}")
+            rows, rest = pending[:count], pending[count:]
+            if rest:
+                self._cursors[cid] = rest
+                return rows, cid
+            del self._cursors[cid]
+            return rows, 0
+
+    def cursor_del(self, cid: int) -> None:
+        with self._lock:
+            if cid not in self._cursors:
+                raise KeyError(f"no such cursor {cid}")
+            del self._cursors[cid]
 
     def info(self, name: str) -> Dict[str, Any]:
         idx = self._idx(name)
