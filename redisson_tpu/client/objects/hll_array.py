@@ -65,23 +65,37 @@ class HyperLogLogArray(RExpirable):
             self._touch_version(rec)
 
     def merge_rows(self, dst_ids, src_ids) -> None:
-        """Batched pairwise PFMERGE: counter[dst] |= counter[src] per pair."""
+        """Batched pairwise PFMERGE: counter[dst] |= counter[src] per pair.
+
+        Each round ships ONE (P,) source map and dispatches ONE dense
+        gather+max over the bank (kernels.hll_bank_merge_map) — the
+        scatter-free shape that lifted config3 off the serialized
+        row-scatter path.  Pairs sharing a dst split into successive
+        unique-dst rounds so every source still folds in (gathers read the
+        PRE-round bank, matching the old scatter-max semantics)."""
         dst = np.ascontiguousarray(dst_ids, np.int32)
         src = np.ascontiguousarray(src_ids, np.int32)
         if dst.shape != src.shape:
             raise ValueError("dst_ids and src_ids must be aligned")
-        n = dst.shape[0]
-        if n == 0:
+        if dst.shape[0] == 0:
             return
-        b = K.pow2_bucket(n)
         with self._engine.locked(self._name):
             rec = self._rec()
-            rec.arrays["regs"] = K.hll_bank_merge_rows(
-                rec.arrays["regs"],
-                K.stage(K.pad_to(dst, b)),
-                K.stage(K.pad_to(src, b)),
-                K.valid_n(n),
-            )
+            P = rec.arrays["regs"].shape[0]
+            if dst.size and (int(dst.min()) < 0 or int(dst.max()) >= P
+                             or int(src.min()) < 0 or int(src.max()) >= P):
+                raise ValueError(f"counter id out of range [0, {P})")
+            pairs_d, pairs_s = dst, src
+            while pairs_d.size:
+                _vals, first = np.unique(pairs_d, return_index=True)
+                take = np.zeros(pairs_d.shape[0], bool)
+                take[first] = True
+                src_map = np.arange(P, dtype=np.int32)
+                src_map[pairs_d[take]] = pairs_s[take]
+                rec.arrays["regs"] = K.hll_bank_merge_map(
+                    rec.arrays["regs"], K.stage(src_map)
+                )
+                pairs_d, pairs_s = pairs_d[~take], pairs_s[~take]
             self._touch_version(rec)
 
     def estimate_all(self) -> np.ndarray:

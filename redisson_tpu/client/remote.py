@@ -384,6 +384,45 @@ class RemoteBloomFilterArray(_ObjcallFallback):
         return np.frombuffer(out, np.uint8).astype(bool)
 
 
+class RemoteHyperLogLogArray(_ObjcallFallback):
+    """Multi-tenant HLL bank over the wire (HLLA.* blob commands — the
+    sketch-blob discipline of the bloom bank applied to the HLL bank)."""
+
+    _FALLBACK_FACTORY = "get_hyper_log_log_array"
+
+    def __init__(self, client: "RemoteRedisson", name: str):
+        self._client = client
+        self.name = name
+
+    def try_init(self, tenants: int) -> bool:
+        return bool(self._client.execute("HLLA.RESERVE", self.name, tenants))
+
+    @staticmethod
+    def _pair_blobs(a, b) -> Tuple[bytes, bytes]:
+        return (
+            np.ascontiguousarray(np.asarray(a), dtype="<i4").tobytes(),
+            np.ascontiguousarray(np.asarray(b), dtype="<i4").tobytes(),
+        )
+
+    def add(self, tenant_ids, keys) -> None:
+        t = np.ascontiguousarray(np.asarray(tenant_ids), dtype="<i4").tobytes()
+        k = np.ascontiguousarray(np.asarray(keys), dtype="<i8").tobytes()
+        self._client.execute("HLLA.MADD64", self.name, t, k)
+
+    def merge_rows(self, dst_ids, src_ids) -> None:
+        d, s = self._pair_blobs(dst_ids, src_ids)
+        self._client.execute("HLLA.MERGEROWS", self.name, d, s)
+
+    def estimate_all(self) -> np.ndarray:
+        out = self._client.execute("HLLA.ESTIMATE", self.name)
+        return np.frombuffer(out, "<f8").copy()
+
+    def estimate_union_pairs(self, a_ids, b_ids) -> np.ndarray:
+        a, b = self._pair_blobs(a_ids, b_ids)
+        out = self._client.execute("HLLA.ESTPAIRS", self.name, a, b)
+        return np.frombuffer(out, "<f8").copy()
+
+
 class RemoteHyperLogLog(_ObjcallFallback):
     _FALLBACK_FACTORY = "get_hyper_log_log"
     def __init__(self, client: "RemoteRedisson", name: str, codec: Optional[Codec]):
@@ -1252,6 +1291,9 @@ class RemoteSurface:
 
     def get_hyper_log_log(self, name: str, codec: Optional[Codec] = None) -> "RemoteHyperLogLog":
         return RemoteHyperLogLog(self, self._map_name(name), codec)
+
+    def get_hyper_log_log_array(self, name: str) -> "RemoteHyperLogLogArray":
+        return RemoteHyperLogLogArray(self, self._map_name(name))
 
     def get_bit_set(self, name: str) -> "RemoteBitSet":
         return RemoteBitSet(self, self._map_name(name))

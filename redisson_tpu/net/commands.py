@@ -60,7 +60,9 @@ _spec(SPECS, "EXISTS TTL PTTL TYPE GET GETBIT BITCOUNT GETBITS GETBITSB "
 # single-key writes
 _spec(SPECS, "EXPIRE PEXPIRE PERSIST SET INCR INCRBY DECR SETBIT SETBITS "
              "SETBITSB BF.RESERVE BF.ADD BF.MADD BF.MADD64 BFA.RESERVE "
-             "BFA.MADD64 PFADD64 PFADD", True, 0)
+             "BFA.MADD64 PFADD64 PFADD HLLA.RESERVE HLLA.MADD64 "
+             "HLLA.MERGEROWS", True, 0)
+_spec(SPECS, "HLLA.ESTIMATE HLLA.ESTPAIRS", False, 0)
 
 # typed data commands (Redis-compatible verbs over the object handles)
 _spec(SPECS, "HGET HMGET HGETALL HEXISTS HLEN HKEYS HVALS SISMEMBER SMEMBERS "

@@ -847,6 +847,70 @@ def cmd_pfadd64(server, ctx, args):
     return 1 if _hll(server, _s(args[0])).add_all(keys) else 0
 
 
+# -- hyperloglog BANK blob verbs (the multi-tenant sketch fast path: one
+# -- blob frame per flush, mirroring the BFA.* bloom-bank discipline) --------
+
+def _hll_array(server, name: str):
+    from redisson_tpu.client.objects.hll_array import HyperLogLogArray
+
+    return HyperLogLogArray(server.engine, name)
+
+
+@register("HLLA.RESERVE")
+def cmd_hlla_reserve(server, ctx, args):
+    """HLLA.RESERVE name tenants — idempotent init replies 0 like BFA."""
+    ok = _hll_array(server, _s(args[0])).try_init(tenants=_int(args[1]))
+    return 1 if ok else 0
+
+
+@register("HLLA.MADD64")
+def cmd_hlla_madd64(server, ctx, args):
+    """HLLA.MADD64 name <i32 tenant blob> <i64 key blob> — ONE fused
+    scatter-max dispatch for the whole flush."""
+    import numpy as np
+
+    t = np.frombuffer(bytes(args[1]), dtype="<i4")
+    k = np.frombuffer(bytes(args[2]), dtype="<i8")
+    _hll_array(server, _s(args[0])).add(t, k)
+    return "+OK"
+
+
+@register("HLLA.MERGEROWS")
+def cmd_hlla_mergerows(server, ctx, args):
+    """HLLA.MERGEROWS name <i32 dst blob> <i32 src blob> — batched pairwise
+    PFMERGE (the dense gather+max kernel)."""
+    import numpy as np
+
+    dst = np.frombuffer(bytes(args[1]), dtype="<i4")
+    src = np.frombuffer(bytes(args[2]), dtype="<i4")
+    try:
+        _hll_array(server, _s(args[0])).merge_rows(dst, src)
+    except ValueError as e:
+        raise RespError(f"ERR {e}")
+    return "+OK"
+
+
+@register("HLLA.ESTIMATE")
+def cmd_hlla_estimate(server, ctx, args):
+    """HLLA.ESTIMATE name -> <f64 blob> of per-tenant estimates."""
+    import numpy as np
+
+    est = _hll_array(server, _s(args[0])).estimate_all()
+    return np.ascontiguousarray(est, dtype="<f8").tobytes()
+
+
+@register("HLLA.ESTPAIRS")
+def cmd_hlla_estpairs(server, ctx, args):
+    """HLLA.ESTPAIRS name <i32 a blob> <i32 b blob> -> <f64 blob> of
+    per-pair union estimates (PFCOUNT a b without mutation)."""
+    import numpy as np
+
+    a = np.frombuffer(bytes(args[1]), dtype="<i4")
+    b = np.frombuffer(bytes(args[2]), dtype="<i4")
+    est = _hll_array(server, _s(args[0])).estimate_union_pairs(a, b)
+    return np.ascontiguousarray(est, dtype="<f8").tobytes()
+
+
 # -- hyperloglog (PFADD/PFCOUNT/PFMERGE parity, RedissonHyperLogLog.java) ----
 
 def _hll(server, name: str):

@@ -65,3 +65,25 @@ def test_graft_entry_multichip():
     import __graft_entry__ as G
 
     G.dryrun_multichip(8)
+
+
+def test_bank_merge_duplicate_dst_folds_all_sources(client):
+    """Pairs sharing a dst split into unique-dst rounds — every source must
+    still fold in (the dense-map kernel can hold one src per dst per round)."""
+    bank = client.get_hyper_log_log_array("bank-dup")
+    bank.try_init(tenants=6)
+    bank.add(np.full(3000, 1, np.int32), np.arange(0, 3000, dtype=np.int64))
+    bank.add(np.full(3000, 2, np.int32), np.arange(3000, 6000, dtype=np.int64))
+    bank.add(np.full(3000, 3, np.int32), np.arange(6000, 9000, dtype=np.int64))
+    bank.merge_rows([0, 0, 0], [1, 2, 3])  # three sources, one dst
+    ests = bank.estimate_all()
+    assert abs(ests[0] - 9000) / 9000 < 0.05
+    # sources untouched
+    assert abs(ests[1] - 3000) / 3000 < 0.05
+
+
+def test_bank_merge_id_out_of_range(client):
+    bank = client.get_hyper_log_log_array("bank-oor")
+    bank.try_init(tenants=4)
+    with pytest.raises(ValueError, match="out of range"):
+        bank.merge_rows([0], [99999])
