@@ -203,17 +203,20 @@ class Bz2Codec(Codec):
 
     name = "bz2"
 
+    # no module-object attributes: codecs must PICKLE (they travel with
+    # OBJCALL frames per the getMap(name, codec) contract)
     def __init__(self, inner: Codec | None = None):
-        import bz2 as _bz2
-
-        self._bz2 = _bz2
         self.inner = inner or JsonCodec()
 
     def encode(self, value):
-        return self._bz2.compress(self.inner.encode(value))
+        import bz2
+
+        return bz2.compress(self.inner.encode(value))
 
     def decode(self, data):
-        return self.inner.decode(self._bz2.decompress(data))
+        import bz2
+
+        return self.inner.decode(bz2.decompress(data))
 
 
 class LzmaCodec(Codec):
@@ -222,16 +225,17 @@ class LzmaCodec(Codec):
     name = "lzma"
 
     def __init__(self, inner: Codec | None = None):
-        import lzma as _lzma
-
-        self._lzma = _lzma
         self.inner = inner or JsonCodec()
 
     def encode(self, value):
-        return self._lzma.compress(self.inner.encode(value))
+        import lzma
+
+        return lzma.compress(self.inner.encode(value))
 
     def decode(self, data):
-        return self.inner.decode(self._lzma.decompress(data))
+        import lzma
+
+        return self.inner.decode(lzma.decompress(data))
 
 
 class ProtobufCodec(Codec):

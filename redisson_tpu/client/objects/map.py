@@ -572,6 +572,18 @@ class MapCache(Map):
         # a put must not refresh max-idle or count as an LFU hit
         return self._live(rec, ek, touch=False)
 
+    def contains_value(self, value) -> bool:
+        """Cells are [value, exp, idle, ...] lists — the base class's raw
+        comparison never matches; compare the LIVE value per cell
+        (RMapCache.containsValue skips expired entries the same way)."""
+        ev = self._ev(value)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            return any(
+                self._live(rec, ek, touch=False) == ev
+                for ek in list(rec.host.keys())
+            )
+
     def _raw_put(self, rec, ek: bytes, ev: bytes):
         self._store_cell(rec, ek, ev)
 

@@ -100,11 +100,13 @@ class ScoredSortedSet(RExpirable):
         return True
 
     def add_if_exists(self, score: float, member) -> bool:
-        """ZADD XX."""
+        """ZADD XX CH (RedissonScoredSortedSet.addIfExistsAsync): True only
+        when an existing member's score actually CHANGED."""
         e = self._e(member)
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
-            if e not in rec.host["scores"]:
+            old = rec.host["scores"].get(e)
+            if old is None or old == float(score):
                 return False
             rec.host["scores"][e] = float(score)
             self._dirty(rec)
@@ -120,6 +122,8 @@ class ScoredSortedSet(RExpirable):
         return self._add_cmp(score, member, lambda new, old: new < old)
 
     def _add_cmp(self, score, member, pred) -> bool:
+        """ZADD GT|LT CH (addIfGreater/LessAsync): True when the member was
+        ADDED or its score CHANGED — not merely touched with an equal score."""
         e = self._e(member)
         with self._engine.locked(self._name):
             rec = self._rec_or_create()
@@ -132,7 +136,7 @@ class ScoredSortedSet(RExpirable):
             fresh = old is None
         if fresh:  # a GT/LT add can introduce a member: wake parked takers
             self._signal_waiters()
-        return fresh
+        return fresh or old != float(score)
 
     def add_score(self, member, delta: float) -> float:
         """ZINCRBY."""

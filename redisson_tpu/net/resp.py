@@ -90,7 +90,15 @@ def encode_reply(value: Any, proto: int = 3) -> bytes:
     if isinstance(value, float):
         if proto >= 3:
             return b"," + repr(value).encode() + CRLF
-        return encode_bulk(repr(value).encode())
+        # RESP2 projection keeps Redis's float formatting: integral scores
+        # print without '.0' (ZSCORE 3 replies "3", not "3.0")
+        import math as _math
+
+        txt = (
+            str(int(value)) if _math.isfinite(value) and value == int(value)
+            else repr(value)
+        )
+        return encode_bulk(txt.encode())
     if isinstance(value, (bytes, bytearray, memoryview)):
         return encode_bulk(bytes(value))
     if isinstance(value, str):

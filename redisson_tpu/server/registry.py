@@ -1774,11 +1774,9 @@ def cmd_hdel(server, ctx, args):
 
 @register("HGETALL")
 def cmd_hgetall(server, ctx, args):
+    # dict reply: RESP3 map frame `%`, RESP2 flattens to field-value array
     m = _typed_handle(server, "get_map", _s(args[0]))
-    out = []
-    for k, v in m.read_all_entry_set():
-        out += [k, v]
-    return out
+    return {bytes(k): v for k, v in m.read_all_entry_set()}
 
 
 @register("HEXISTS")
@@ -1820,7 +1818,9 @@ def cmd_sismember(server, ctx, args):
 
 @register("SMEMBERS")
 def cmd_smembers(server, ctx, args):
-    return _typed_handle(server, "get_set", _s(args[0])).read_all()
+    # a python set encodes as the RESP3 `~` set frame (RESP2 projects to an
+    # array) — the CommandDecoder.java marker for SMEMBERS-family replies
+    return set(_typed_handle(server, "get_set", _s(args[0])).read_all())
 
 
 @register("SCARD")
@@ -1897,8 +1897,9 @@ def cmd_zadd(server, ctx, args):
 
 @register("ZSCORE")
 def cmd_zscore(server, ctx, args):
+    # float reply: RESP3 double frame `,`, RESP2 Redis-formatted bulk
     sc = _typed_handle(server, "get_scored_sorted_set", _s(args[0])).get_score(bytes(args[1]))
-    return None if sc is None else _fnum(sc)
+    return None if sc is None else float(sc)
 
 
 @register("ZREM")
@@ -1920,7 +1921,7 @@ def cmd_zrank(server, ctx, args):
 @register("ZINCRBY")
 def cmd_zincrby(server, ctx, args):
     z = _typed_handle(server, "get_scored_sorted_set", _s(args[0]))
-    return _fnum(z.add_score(bytes(args[2]), float(args[1])))
+    return float(z.add_score(bytes(args[2]), float(args[1])))
 
 
 @register("ZRANGE")
@@ -2317,17 +2318,18 @@ def cmd_smove(server, ctx, args):
 
 @register("SINTER")
 def cmd_sinter(server, ctx, args):
-    return _set(server, _s(args[0])).read_intersection(*[_s(n) for n in args[1:]])
+    # set combination replies are RESP3 `~` set frames, like SMEMBERS
+    return set(_set(server, _s(args[0])).read_intersection(*[_s(n) for n in args[1:]]))
 
 
 @register("SUNION")
 def cmd_sunion(server, ctx, args):
-    return _set(server, _s(args[0])).read_union(*[_s(n) for n in args[1:]])
+    return set(_set(server, _s(args[0])).read_union(*[_s(n) for n in args[1:]]))
 
 
 @register("SDIFF")
 def cmd_sdiff(server, ctx, args):
-    return _set(server, _s(args[0])).read_diff(*[_s(n) for n in args[1:]])
+    return set(_set(server, _s(args[0])).read_diff(*[_s(n) for n in args[1:]]))
 
 
 def _set_store(server, args, op: str):
@@ -2702,7 +2704,7 @@ def cmd_zmscore(server, ctx, args):
     out = []
     for m in args[1:]:
         sc = z.get_score(bytes(m))
-        out.append(None if sc is None else _fnum(sc))
+        out.append(None if sc is None else float(sc))
     return out
 
 

@@ -1,0 +1,141 @@
+"""Codec matrix over the wire (VERDICT r3 #7): every codec x every major
+handle family, embedded AND remote — the codec must travel with the OBJCALL
+frame so the server-side handle encodes exactly like the caller's
+(getMap(name, codec) contract, codec/BaseCodecTest discipline).
+"""
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.codec import (
+    Bz2Codec,
+    BytesCodec,
+    CompositeCodec,
+    DoubleCodec,
+    JsonCodec,
+    LongCodec,
+    LzmaCodec,
+    PickleCodec,
+    StringCodec,
+    ZlibCodec,
+)
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.server.server import ServerThread
+
+# (codec factory, sample value, sample map key) — values chosen to catch
+# mis-decoding (ints vs strs vs bytes vs structures)
+CODECS = [
+    ("json", JsonCodec, {"nested": [1, 2, {"x": "y"}]}, "k1"),
+    ("pickle", PickleCodec, ("tuple", 42, frozenset({1})), "k1"),
+    ("string", StringCodec, "plain string värde", "k1"),
+    ("bytes", BytesCodec, b"\x00\x01binary\xff", b"bk"),
+    ("long", LongCodec, -(1 << 40), 77),
+    ("double", DoubleCodec, 3.14159, 2.5),
+    ("zlib", ZlibCodec, {"compress": "me" * 50}, "k1"),
+    ("bz2", Bz2Codec, {"compress": "me" * 50}, "k1"),
+    ("lzma", LzmaCodec, {"compress": "me" * 50}, "k1"),
+]
+
+
+@pytest.fixture(scope="module")
+def remote_client():
+    with ServerThread(port=0) as st:
+        c = RemoteRedisson(st.address, timeout=60.0)
+        yield c
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def embedded_client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(params=["embedded", "remote"])
+def client(request, embedded_client, remote_client):
+    return embedded_client if request.param == "embedded" else remote_client
+
+
+def nm(tag):
+    return f"cm-{tag}-{time.time_ns()}"
+
+
+@pytest.mark.parametrize("cname,codec_cls,value,key", CODECS, ids=[c[0] for c in CODECS])
+class TestCodecMatrix:
+    def test_bucket_roundtrip(self, client, cname, codec_cls, value, key):
+        b = client.get_bucket(nm(f"b{cname}"), codec_cls())
+        b.set(value)
+        assert b.get() == value
+
+    def test_map_roundtrip(self, client, cname, codec_cls, value, key):
+        m = client.get_map(nm(f"m{cname}"), codec_cls())
+        m.put(key, value)
+        assert m.get(key) == value
+        assert m.read_all_map() == {key: value}
+
+    def test_list_roundtrip(self, client, cname, codec_cls, value, key):
+        lst = client.get_list(nm(f"l{cname}"), codec_cls())
+        lst.add(value)
+        assert lst.get(0) == value
+
+    def test_set_roundtrip(self, client, cname, codec_cls, value, key):
+        s = client.get_set(nm(f"s{cname}"), codec_cls())
+        s.add(value)
+        assert s.contains(value)
+        assert s.read_all() == [value]
+
+    def test_queue_roundtrip(self, client, cname, codec_cls, value, key):
+        q = client.get_queue(nm(f"q{cname}"), codec_cls())
+        q.offer(value)
+        assert q.poll() == value
+
+
+class TestCompositeCodec:
+    def test_split_key_value_codecs(self, client):
+        """String keys + pickled values (the CompositeCodec contract)."""
+        codec = CompositeCodec(StringCodec(), PickleCodec())
+        m = client.get_map(nm("comp"), codec)
+        m.put("skey", ("complex", {"v": 1}))
+        assert m.get("skey") == ("complex", {"v": 1})
+
+    def test_cross_surface_same_codec_agrees(self, embedded_client, remote_client):
+        """A value written embedded-side with codec C reads back through a
+        remote handle with the same C (both address the same server store
+        only in remote mode, so run the agreement against remote twice:
+        writer handle and reader handle must agree byte-for-byte)."""
+        name = nm("agree")
+        w = remote_client.get_map(name, StringCodec())
+        r = remote_client.get_map(name, StringCodec())
+        w.put("k", "value")
+        assert r.get("k") == "value"
+
+    def test_wrong_codec_mismatch_is_loud_or_distinct(self, remote_client):
+        """Reading LongCodec data with StringCodec must not silently decode
+        to the original value (the mis-decode either raises or yields a
+        clearly different representation)."""
+        name = nm("mism")
+        w = remote_client.get_bucket(name, LongCodec())
+        w.set(12345)
+        r = remote_client.get_bucket(name, StringCodec())
+        try:
+            got = r.get()
+        except Exception:
+            return  # loud failure is fine
+        assert got != 12345
+
+
+class TestCodecOnTtlAndTx:
+    def test_map_cache_codec_with_ttl(self, client):
+        mc = client.get_map_cache(nm("mct"), StringCodec())
+        mc.put_with_ttl("k", "v", ttl=30.0)
+        assert mc.get("k") == "v"
+
+    def test_transaction_honors_codec(self, remote_client):
+        name = nm("txc")
+        tx = remote_client.create_transaction()
+        m = tx.get_map(name, StringCodec())
+        m.fast_put("k", "tx-value")
+        tx.commit()
+        assert remote_client.get_map(name, StringCodec()).get("k") == "tx-value"
