@@ -30,6 +30,11 @@ def _enable_persistent_compile_cache() -> None:
     cache_dir = os.environ.get("REDISSON_TPU_COMPILE_CACHE")
     if cache_dir == "off":
         return
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu" and not cache_dir:
+        # hermetic CPU runs (tests, dryruns) skip the cache by default:
+        # XLA:CPU AOT entries pin host machine features, so a cache written
+        # on one host can SIGILL on another; TPU executables don't
+        return
     try:
         import jax
 
