@@ -1,0 +1,225 @@
+"""RList + RSet behavioral depth, ported from RedissonListTest.java (74
+@Test) and RedissonSetTest.java (50 @Test) — VERDICT r3 #7, round-4 batch 2.
+
+Same assertions against the embedded facade AND over the wire.
+"""
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture(scope="module")
+def remote_client():
+    with ServerThread(port=0) as st:
+        c = RemoteRedisson(st.address, timeout=60.0)
+        yield c
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def embedded_client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(params=["embedded", "remote"])
+def client(request, embedded_client, remote_client):
+    return embedded_client if request.param == "embedded" else remote_client
+
+
+def flist(client, tag, *items):
+    lst = client.get_list(f"lsem-{tag}-{time.time_ns()}")
+    for it in items:
+        lst.add(it)
+    return lst
+
+
+def fset(client, tag, *items):
+    s = client.get_set(f"ssem-{tag}-{time.time_ns()}")
+    for it in items:
+        s.add(it)
+    return s
+
+
+class TestListBasics:
+    def test_add_get_size(self, client):
+        lst = flist(client, "ag", "a", "b", "c")
+        assert lst.get(0) == "a" and lst.get(2) == "c"
+        assert lst.size() == 3
+        assert not lst.is_empty()
+
+    def test_get_out_of_range(self, client):
+        lst = flist(client, "oor", "a")
+        with pytest.raises((IndexError, Exception)):
+            lst.get(5)
+
+    def test_duplicates_kept(self, client):
+        lst = flist(client, "dup", "x", "x", "x")
+        assert lst.size() == 3
+
+    def test_add_by_index(self, client):
+        lst = flist(client, "abi", "a", "c")
+        lst.add_at(1, "b")
+        assert lst.read_all() == ["a", "b", "c"]
+        lst.add_at(0, "z")
+        assert lst.get(0) == "z"
+
+    def test_add_before_after(self, client):
+        lst = flist(client, "aba", "a", "c")
+        assert lst.add_before("c", "b") >= 0
+        assert lst.read_all() == ["a", "b", "c"]
+        assert lst.add_after("c", "d") >= 0
+        assert lst.read_all() == ["a", "b", "c", "d"]
+
+    def test_set_and_fast_set(self, client):
+        lst = flist(client, "set", "a", "b")
+        old = lst.set(1, "B")
+        assert old == "b"
+        lst.fast_set(0, "A")
+        assert lst.read_all() == ["A", "B"]
+
+    def test_set_out_of_range(self, client):
+        lst = flist(client, "sor", "a")
+        with pytest.raises(Exception):
+            lst.set(9, "x")
+
+    def test_index_of(self, client):
+        lst = flist(client, "io", "a", "b", "a", "c")
+        assert lst.index_of("a") == 0
+        assert lst.last_index_of("a") == 2
+        assert lst.index_of("zz") == -1
+        assert lst.last_index_of("zz") == -1
+
+    def test_remove_value_and_at(self, client):
+        lst = flist(client, "rm", "a", "b", "a")
+        assert lst.remove("a") is True     # first occurrence
+        assert lst.read_all() == ["b", "a"]
+        assert lst.remove_at(0) == "b"
+        assert lst.read_all() == ["a"]
+        assert lst.remove("zz") is False
+
+    def test_remove_with_count(self, client):
+        lst = flist(client, "rwc", "a", "b", "a", "a", "c")
+        assert lst.remove_count("a", 2) is True  # RList.remove(o, count): bool
+        assert lst.read_all() == ["b", "a", "c"]
+        assert lst.remove_count("zz", 2) is False
+
+    def test_range_and_trim(self, client):
+        lst = flist(client, "rt", *"abcdef")
+        assert lst.range(1, 3) == ["b", "c", "d"]
+        lst.trim(1, 3)
+        assert lst.read_all() == ["b", "c", "d"]
+
+    def test_sub_list(self, client):
+        lst = flist(client, "sub", *"abcde")
+        assert lst.sub_list(1, 4) == ["b", "c", "d"]
+        assert lst.sub_list(0, 2) == ["a", "b"]
+
+    def test_contains_and_clear(self, client):
+        lst = flist(client, "cc", "a", "b")
+        assert lst.contains("a")
+        assert not lst.contains("z")
+        lst.clear()
+        assert lst.is_empty() and lst.size() == 0
+
+    def test_add_all(self, client):
+        lst = flist(client, "aa")
+        lst.add_all(["x", "y", "z"])
+        assert lst.read_all() == ["x", "y", "z"]
+
+    def test_iteration_order(self, embedded_client):
+        lst = flist(embedded_client, "it", *[f"e{i}" for i in range(10)])
+        assert [v for v in lst] == [f"e{i}" for i in range(10)]
+
+
+class TestSetBasics:
+    def test_add_contains_size(self, client):
+        s = fset(client, "acs", "a", "b")
+        assert s.add("c") is True
+        assert s.add("c") is False  # already present
+        assert s.contains("c")
+        assert s.size() == 3
+
+    def test_remove(self, client):
+        s = fset(client, "rm", "a", "b")
+        assert s.remove("a") is True
+        assert s.remove("a") is False
+        assert s.size() == 1
+
+    def test_remove_all_retain_all(self, client):
+        s = fset(client, "ra", "a", "b", "c", "d")
+        assert s.remove_all(["a", "b", "zz"]) is True
+        assert sorted(s.read_all()) == ["c", "d"]
+        assert s.retain_all(["c"]) is True
+        assert s.read_all() == ["c"]
+        assert s.retain_all(["c"]) is False  # no modification
+
+    def test_contains_all(self, client):
+        s = fset(client, "ca", "a", "b", "c")
+        assert s.contains_all(["a", "b"]) is True
+        assert s.contains_all(["a", "zz"]) is False
+        assert s.contains_all([]) is True
+
+    def test_random_member_and_remove_random(self, client):
+        s = fset(client, "rand", "a", "b", "c")
+        assert s.random_member() in {"a", "b", "c"}
+        got = s.remove_random()
+        assert got in {"a", "b", "c"}
+        assert s.size() == 2
+
+    def test_random_members_count(self, client):
+        s = fset(client, "randn", *[f"m{i}" for i in range(10)])
+        got = s.random_members(4)
+        assert len(set(got)) == 4
+        assert all(m in {f"m{i}" for i in range(10)} for m in got)
+
+    def test_move(self, client):
+        a = fset(client, "mv-a", "x", "y")
+        b = fset(client, "mv-b")
+        assert a.move(b.name, "x") is True
+        assert not a.contains("x")
+        assert b.contains("x")
+        assert a.move(b.name, "absent") is False
+
+    def test_union_intersection_diff_reads(self, client):
+        a = fset(client, "alg-a", "1", "2", "3")
+        b = fset(client, "alg-b", "3", "4")
+        assert sorted(a.read_union(b.name)) == ["1", "2", "3", "4"]
+        assert a.read_intersection(b.name) == ["3"]
+        assert sorted(a.read_diff(b.name)) == ["1", "2"]
+
+    def test_store_forms(self, client):
+        a = fset(client, "st-a", "1", "2")
+        b = fset(client, "st-b", "2", "3")
+        n = a.union(b.name)
+        assert n == 3 and sorted(a.read_all()) == ["1", "2", "3"]
+
+    def test_structured_values(self, client):
+        s = fset(client, "struct")
+        s.add(("tuple", 1))
+        assert s.contains(("tuple", 1))
+        assert not s.contains(("tuple", 2))
+
+
+class TestListeners:
+    def test_set_cache_ttl_add(self, client):
+        sc = client.get_set_cache(f"scsem-{time.time_ns()}")
+        assert sc.add("ttl-ed", ttl=0.15) is True
+        assert sc.add("perm") is True
+        assert sc.contains("ttl-ed")
+        time.sleep(0.3)
+        assert not sc.contains("ttl-ed")
+        assert sc.contains("perm")
+        assert sc.size() == 1
+
+    def test_set_cache_re_add_resets_ttl(self, client):
+        sc = client.get_set_cache(f"scsem2-{time.time_ns()}")
+        sc.add("v", ttl=0.15)
+        sc.add("v", ttl=30.0)  # reset to long TTL
+        time.sleep(0.3)
+        assert sc.contains("v")

