@@ -256,6 +256,44 @@ class LiveObjectService:
         """Proxy without existence check (reference attach())."""
         return LiveObjectProxy(self, cls, rid)
 
+    def merge(self, instance: Any) -> LiveObjectProxy:
+        """RLiveObjectService.merge: persist-or-update — existing entities
+        get the detached instance's non-None fields written over them,
+        absent ones are persisted fresh (RLiveObjectService.java:145)."""
+        cls = type(instance)
+        rid = getattr(instance, cls.__rid_field__)
+        if rid is None:
+            raise ValueError("@RId field must be set before merge")
+        if not self.is_exists(cls, rid):
+            return self.persist(instance)
+        proxy = LiveObjectProxy(self, cls, rid)
+        for k, v in vars(instance).items():
+            if k != cls.__rid_field__ and not k.startswith("_") and v is not None:
+                setattr(proxy, k, v)
+        return proxy
+
+    def merge_all(self, *instances: Any) -> List[LiveObjectProxy]:
+        return [self.merge(i) for i in instances]
+
+    def detach(self, proxy: LiveObjectProxy) -> Any:
+        """RLiveObjectService.detach: materialize a plain instance carrying a
+        snapshot of the grid state (RLiveObjectService.java:195)."""
+        d = object.__getattribute__(proxy, "__dict__")
+        cls, rid = d["_cls"], d["_rid"]
+        inst = cls.__new__(cls)
+        setattr(inst, cls.__rid_field__, rid)
+        for k, v in self._backing_map(cls, rid).read_all_map().items():
+            setattr(inst, k, v)
+        return inst
+
+    @staticmethod
+    def is_live_object(instance: Any) -> bool:
+        return isinstance(instance, LiveObjectProxy)
+
+    def delete_by_ids(self, cls: Type, *rids: Any) -> int:
+        """RLiveObjectService.delete(entityClass, ids...): count deleted."""
+        return sum(1 for rid in rids if self.delete(cls, rid))
+
     def get(self, cls: Type, rid: Any) -> Optional[LiveObjectProxy]:
         if not self.is_exists(cls, rid):
             return None

@@ -1,0 +1,232 @@
+"""Synchronizer behavioral depth, ported from RedissonReadWriteLockTest (30
+@Test), RedissonLockTest, RedissonSemaphoreTest, RedissonCountDownLatchTest —
+VERDICT r3 #7, round-4 batch 3.  Embedded + wire where the semantics cross
+processes (the wire surface carries the caller's uuid:threadId identity).
+"""
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture(scope="module")
+def remote_client():
+    with ServerThread(port=0) as st:
+        c = RemoteRedisson(st.address, timeout=60.0)
+        yield c
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def embedded_client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+def nm(tag):
+    return f"sync-{tag}-{time.time_ns()}"
+
+
+class TestReadWriteLock:
+    def test_multiple_readers(self, embedded_client):
+        rw = embedded_client.get_read_write_lock(nm("rr"))
+        r = rw.read_lock()
+        assert r.try_lock() is True
+        got = []
+        th = threading.Thread(target=lambda: got.append(rw.read_lock().try_lock()))
+        th.start(); th.join(5.0)
+        assert got == [True]  # readers share
+        r.unlock()
+
+    def test_writer_excludes_readers(self, embedded_client):
+        rw = embedded_client.get_read_write_lock(nm("wx"))
+        w = rw.write_lock()
+        assert w.try_lock() is True
+        got = []
+        th = threading.Thread(target=lambda: got.append(rw.read_lock().try_lock()))
+        th.start(); th.join(5.0)
+        assert got == [False]
+        w.unlock()
+        th = threading.Thread(target=lambda: got.append(rw.read_lock().try_lock()))
+        th.start(); th.join(5.0)
+        assert got == [False, True]
+
+    def test_reader_excludes_writer(self, embedded_client):
+        rw = embedded_client.get_read_write_lock(nm("rxw"))
+        r = rw.read_lock()
+        r.lock()
+        got = []
+        th = threading.Thread(target=lambda: got.append(rw.write_lock().try_lock()))
+        th.start(); th.join(5.0)
+        assert got == [False]
+        r.unlock()
+
+    def test_write_then_read_downgrade_same_holder(self, embedded_client):
+        """The reference allows the write holder to take the read lock
+        (lock downgrade)."""
+        rw = embedded_client.get_read_write_lock(nm("down"))
+        w = rw.write_lock()
+        r = rw.read_lock()
+        assert w.try_lock() is True
+        assert r.try_lock() is True  # same holder: admitted
+        w.unlock()
+        r.unlock()
+
+    def test_writer_waits_for_reader_release(self, embedded_client):
+        rw = embedded_client.get_read_write_lock(nm("wwait"))
+        r = rw.read_lock()
+        r.lock()
+        acquired = threading.Event()
+
+        def writer():
+            if rw.write_lock().try_lock(wait_time=10.0):
+                acquired.set()
+
+        th = threading.Thread(target=writer, daemon=True)
+        th.start()
+        time.sleep(0.15)
+        assert not acquired.is_set()
+        r.unlock()
+        assert acquired.wait(5.0)
+
+    def test_reentrant_read(self, embedded_client):
+        rw = embedded_client.get_read_write_lock(nm("rre"))
+        r = rw.read_lock()
+        assert r.try_lock() and r.try_lock()
+        r.unlock()
+        # still held once: a writer must NOT get in
+        got = []
+        th = threading.Thread(target=lambda: got.append(rw.write_lock().try_lock()))
+        th.start(); th.join(5.0)
+        assert got == [False]
+        r.unlock()
+
+
+class TestLockDepth:
+    def test_reentrancy_and_hold_count(self, embedded_client):
+        lk = embedded_client.get_lock(nm("re"))
+        assert lk.try_lock() and lk.try_lock()
+        assert lk.get_hold_count() == 2
+        lk.unlock()
+        assert lk.is_locked()
+        lk.unlock()
+        assert not lk.is_locked()
+
+    def test_unlock_by_non_holder_raises(self, embedded_client):
+        lk = embedded_client.get_lock(nm("nh"))
+        lk.lock()
+        errs = []
+
+        def alien():
+            try:
+                lk.unlock()
+            except Exception as e:  # noqa: BLE001
+                errs.append(type(e).__name__)
+
+        th = threading.Thread(target=alien)
+        th.start(); th.join(5.0)
+        assert errs  # IllegalMonitorState analog
+        lk.unlock()
+
+    def test_force_unlock(self, embedded_client):
+        lk = embedded_client.get_lock(nm("fu"))
+        lk.lock()
+        got = []
+        th = threading.Thread(target=lambda: (lk.force_unlock(), got.append(lk.try_lock())))
+        th.start(); th.join(5.0)
+        assert got == [True]
+
+    def test_lease_expiry_releases(self, embedded_client):
+        lk = embedded_client.get_lock(nm("lease"))
+        assert lk.try_lock(lease_time=0.15)
+        time.sleep(0.3)
+        got = []
+        th = threading.Thread(target=lambda: got.append(lk.try_lock()))
+        th.start(); th.join(5.0)
+        assert got == [True]
+
+    def test_wire_lock_identity_travels(self, remote_client):
+        """Two wire clients contend; the holder identity is the caller's,
+        so client B cannot unlock A's lock but A can re-enter."""
+        name = nm("wireid")
+        a = remote_client.get_lock(name)
+        assert a.try_lock() is True
+        assert a.try_lock() is True  # reentrant over the wire
+        b_result = []
+
+        def other_client():
+            c2 = RemoteRedisson(remote_client.node.address, timeout=30.0)
+            try:
+                b_result.append(c2.get_lock(name).try_lock())
+            finally:
+                c2.shutdown()
+
+        th = threading.Thread(target=other_client)
+        th.start(); th.join(15.0)
+        assert b_result == [False]
+        a.unlock()
+        a.unlock()
+
+
+class TestSemaphoreDepth:
+    def test_acquire_release_counts(self, embedded_client):
+        sem = embedded_client.get_semaphore(nm("sem"))
+        assert sem.try_set_permits(2)
+        assert sem.try_acquire() and sem.try_acquire()
+        assert sem.try_acquire() is False
+        sem.release()
+        assert sem.try_acquire() is True
+        sem.release(2)
+
+    def test_available_permits(self, embedded_client):
+        sem = embedded_client.get_semaphore(nm("avail"))
+        sem.try_set_permits(3)
+        sem.try_acquire()
+        assert sem.available_permits() == 2
+
+    def test_blocking_acquire_wakes(self, embedded_client):
+        sem = embedded_client.get_semaphore(nm("blk"))
+        sem.try_set_permits(1)
+        assert sem.try_acquire()
+        acquired = threading.Event()
+
+        def waiter():
+            if sem.try_acquire(wait_time=10.0):
+                acquired.set()
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        time.sleep(0.1)
+        assert not acquired.is_set()
+        sem.release()
+        assert acquired.wait(5.0)
+
+
+class TestLatchDepth:
+    def test_count_down_and_await(self, embedded_client):
+        latch = embedded_client.get_count_down_latch(nm("cdl"))
+        assert latch.try_set_count(2)
+        done = threading.Event()
+
+        def waiter():
+            if latch.await_(timeout=10.0):
+                done.set()
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        latch.count_down()
+        time.sleep(0.1)
+        assert not done.is_set()
+        latch.count_down()
+        assert done.wait(5.0)
+        assert latch.get_count() == 0
+
+    def test_set_count_once(self, embedded_client):
+        latch = embedded_client.get_count_down_latch(nm("once"))
+        assert latch.try_set_count(2) is True
+        assert latch.try_set_count(5) is False  # already counting
