@@ -578,6 +578,11 @@ class RemoteTopic:
         self._client.pubsub_for(self.name).subscribe(self.name, wire_listener)
         return wire_listener
 
+    def remove_listener(self, token) -> None:
+        """RTopic.removeListener(id): detach ONE listener by the token
+        add_listener returned (the wire wrapper)."""
+        self._client.pubsub_for(self.name).remove_listener(self.name, token)
+
     def remove_all_listeners(self) -> None:
         self._client.pubsub_for(self.name).unsubscribe(self.name)
 

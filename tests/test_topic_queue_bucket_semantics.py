@@ -1,0 +1,247 @@
+"""Topic + queue family + bucket behavioral depth, ported from
+RedissonTopicTest (34 @Test), RedissonBoundedBlockingQueueTest (34),
+RedissonBucketTest (30) — VERDICT r3 #7, round-4 batch 5.
+"""
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture(scope="module")
+def remote_client():
+    with ServerThread(port=0) as st:
+        c = RemoteRedisson(st.address, timeout=60.0)
+        yield c
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def embedded_client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(params=["embedded", "remote"])
+def client(request, embedded_client, remote_client):
+    return embedded_client if request.param == "embedded" else remote_client
+
+
+def nm(tag):
+    return f"tqb-{tag}-{time.time_ns()}"
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+class TestTopic:
+    def test_publish_delivers_to_listener(self, client):
+        t = client.get_topic(nm("pub"))
+        got = []
+        t.add_listener(lambda ch, msg: got.append(msg))
+        time.sleep(0.1)  # let the subscription land
+        n = t.publish({"structured": [1, 2]})
+        assert n >= 1  # receiver count (PUBLISH reply semantics)
+        assert wait_until(lambda: got == [{"structured": [1, 2]}]), got
+
+    def test_multiple_listeners_all_fire(self, client):
+        t = client.get_topic(nm("multi"))
+        a, b = [], []
+        t.add_listener(lambda ch, m: a.append(m))
+        t.add_listener(lambda ch, m: b.append(m))
+        time.sleep(0.1)
+        t.publish("x")
+        assert wait_until(lambda: a == ["x"] and b == ["x"])
+
+    def test_remove_listener_stops_delivery(self, client):
+        t = client.get_topic(nm("rm"))
+        got = []
+        token = t.add_listener(lambda ch, m: got.append(m))
+        time.sleep(0.1)
+        t.publish("first")
+        assert wait_until(lambda: got == ["first"])
+        t.remove_listener(token)
+        time.sleep(0.1)
+        t.publish("second")
+        time.sleep(0.3)
+        assert got == ["first"]
+
+    def test_publish_without_listeners_returns_zero(self, client):
+        t = client.get_topic(nm("zero"))
+        assert t.publish("nobody") == 0
+
+    def test_cross_client_topic(self, remote_client, embedded_client):
+        """Publisher on one wire client, listener on another connection of
+        the same server."""
+        name = nm("cross")
+        sub = remote_client.get_topic(name)
+        got = []
+        sub.add_listener(lambda ch, m: got.append(m))
+        time.sleep(0.15)
+        pub = RemoteRedisson(remote_client.node.address, timeout=30.0)
+        try:
+            assert pub.get_topic(name).publish("hello") >= 1
+            assert wait_until(lambda: got == ["hello"])
+        finally:
+            pub.shutdown()
+
+
+class TestBoundedBlockingQueue:
+    def test_capacity_enforced(self, client):
+        q = client.get_bounded_blocking_queue(nm("cap"))
+        assert q.try_set_capacity(2) is True
+        assert q.offer("a") is True
+        assert q.offer("b") is True
+        assert q.offer("c") is False  # full
+        assert q.poll() == "a"
+        assert q.offer("c") is True
+
+    def test_try_set_capacity_once(self, client):
+        q = client.get_bounded_blocking_queue(nm("once"))
+        assert q.try_set_capacity(2) is True
+        assert q.try_set_capacity(5) is False
+
+    def test_put_blocks_until_space(self, embedded_client):
+        q = embedded_client.get_bounded_blocking_queue(nm("putb"))
+        q.try_set_capacity(1)
+        q.offer("a")
+        done = threading.Event()
+
+        def putter():
+            q.put("b")  # blocks while full
+            done.set()
+
+        th = threading.Thread(target=putter, daemon=True)
+        th.start()
+        time.sleep(0.15)
+        assert not done.is_set()
+        assert q.poll() == "a"
+        assert done.wait(5.0)
+        assert q.poll() == "b"
+
+    def test_take_blocks_until_offer(self, embedded_client):
+        q = embedded_client.get_blocking_queue(nm("take"))
+        got = []
+        th = threading.Thread(target=lambda: got.append(q.take()), daemon=True)
+        th.start()
+        time.sleep(0.1)
+        assert not got
+        q.offer("v")
+        th.join(5.0)
+        assert got == ["v"]
+
+    def test_drain_to(self, client):
+        q = client.get_blocking_queue(nm("drain"))
+        for i in range(5):
+            q.offer(i)
+        sink: list = []
+        n = q.drain_to(sink, 3)
+        assert n == 3 and sink == [0, 1, 2]
+        assert q.size() == 2
+
+    def test_poll_from_any(self, embedded_client):
+        q1 = embedded_client.get_blocking_queue(nm("any1"))
+        q2 = embedded_client.get_blocking_queue(nm("any2"))
+        q2.offer("from-q2")
+        name, value = q1.poll_from_any(0.5, q2.name)  # (source queue, value)
+        assert value == "from-q2" and name == q2.name
+
+    def test_deque_ends(self, client):
+        dq = client.get_deque(nm("dq"))
+        dq.add_first("m")
+        dq.add_first("f")
+        dq.add_last("l")
+        assert dq.peek_first() == "f" and dq.peek_last() == "l"
+        assert dq.poll_first() == "f"
+        assert dq.poll_last() == "l"
+        assert dq.poll_first() == "m"
+
+    def test_poll_last_and_offer_first_to(self, client):
+        src = client.get_queue(nm("plofa"))
+        dst = client.get_queue(nm("plofb"))
+        src.offer("x")
+        src.offer("y")
+        moved = src.poll_last_and_offer_first_to(dst.name)
+        assert moved == "y"
+        assert dst.peek() == "y"
+        assert src.size() == 1
+
+
+class TestBucketDepth:
+    def test_set_get_delete(self, client):
+        b = client.get_bucket(nm("sgd"))
+        assert b.get() is None
+        b.set({"v": 1})
+        assert b.get() == {"v": 1}
+        assert b.delete() is True
+        assert b.delete() is False
+
+    def test_set_with_ttl(self, client):
+        b = client.get_bucket(nm("ttl"))
+        b.set("v", ttl=0.15)
+        assert b.get() == "v"
+        time.sleep(0.3)
+        assert b.get() is None
+
+    def test_try_set(self, client):
+        b = client.get_bucket(nm("try"))
+        assert b.try_set("first") is True
+        assert b.try_set("second") is False
+        assert b.get() == "first"
+
+    def test_compare_and_set(self, client):
+        b = client.get_bucket(nm("cas"))
+        assert b.compare_and_set(None, "v1") is True
+        assert b.compare_and_set("wrong", "x") is False
+        assert b.compare_and_set("v1", "v2") is True
+        assert b.get() == "v2"
+
+    def test_get_and_set(self, client):
+        b = client.get_bucket(nm("gas"))
+        assert b.get_and_set("a") is None
+        assert b.get_and_set("b") == "a"
+
+    def test_get_and_delete(self, client):
+        b = client.get_bucket(nm("gad"))
+        b.set("v")
+        assert b.get_and_delete() == "v"
+        assert b.get() is None
+
+    def test_size_in_bytes(self, client):
+        b = client.get_bucket(nm("sz"))
+        b.set("hello world")
+        assert b.size() > 0
+
+    def test_atomic_long_family(self, client):
+        al = client.get_atomic_long(nm("al"))
+        assert al.increment_and_get() == 1
+        assert al.add_and_get(5) == 6
+        assert al.get_and_add(4) == 6
+        assert al.get() == 10
+        assert al.decrement_and_get() == 9
+        assert al.compare_and_set(9, 100) is True
+        assert al.compare_and_set(9, 0) is False
+        al.set(42)
+        assert al.get_and_set(0) == 42
+
+    def test_atomic_double(self, client):
+        ad = client.get_atomic_double(nm("ad"))
+        assert ad.add_and_get(1.5) == 1.5
+        assert ad.add_and_get(0.25) == 1.75
+
+    def test_id_generator_monotonic_unique(self, client):
+        idg = client.get_id_generator(nm("idg"))
+        ids = [idg.next_id() for _ in range(50)]
+        assert len(set(ids)) == 50
+        assert ids == sorted(ids)
