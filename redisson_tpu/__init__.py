@@ -18,13 +18,23 @@ Layering (SURVEY.md §7.1):
 from redisson_tpu.version import __version__  # noqa: F401
 
 
+_compile_cache_configured = False
+
+
 def _enable_persistent_compile_cache() -> None:
     """Point JAX at an on-disk XLA compilation cache so a fresh process
     (server boot, WorkerNode spawn, bench cold run) reloads prior TPU
     compiles instead of re-lowering (~10s for the word-count pipeline —
     BENCH config4's entire cold gap).  Opt out with
-    REDISSON_TPU_COMPILE_CACHE=off.  Safe pre-backend-init: jax.config
-    updates don't initialize a backend."""
+    REDISSON_TPU_COMPILE_CACHE=off.  Called lazily from Engine.__init__ —
+    NOT at package import: wire-only clients never touch jax, and eagerly
+    importing it here would cost them seconds of startup.  Safe
+    pre-backend-init: jax.config updates don't initialize a backend."""
+    global _compile_cache_configured
+
+    if _compile_cache_configured:
+        return
+    _compile_cache_configured = True
     import os
 
     cache_dir = os.environ.get("REDISSON_TPU_COMPILE_CACHE")
@@ -50,9 +60,6 @@ def _enable_persistent_compile_cache() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     except Exception:  # noqa: BLE001 — older jax without these knobs
         pass
-
-
-_enable_persistent_compile_cache()
 
 
 def create(config=None):

@@ -71,8 +71,13 @@ class HyperLogLogArray(RExpirable):
         gather+max over the bank (kernels.hll_bank_merge_map) — the
         scatter-free shape that lifted config3 off the serialized
         row-scatter path.  Pairs sharing a dst split into successive
-        unique-dst rounds so every source still folds in (gathers read the
-        PRE-round bank, matching the old scatter-max semantics)."""
+        unique-dst rounds; rounds past the first gather from a PRE-CALL
+        snapshot of the bank (hll_bank_merge_map_from), so every source
+        folds in with read-all-sources-from-old scatter-max semantics —
+        a dst updated in round 1 cannot leak its new registers through a
+        later round."""
+        import jax.numpy as jnp
+
         dst = np.ascontiguousarray(dst_ids, np.int32)
         src = np.ascontiguousarray(src_ids, np.int32)
         if dst.shape != src.shape:
@@ -85,6 +90,11 @@ class HyperLogLogArray(RExpirable):
             if dst.size and (int(dst.min()) < 0 or int(dst.max()) >= P
                              or int(src.min()) < 0 or int(src.max()) >= P):
                 raise ValueError(f"counter id out of range [0, {P})")
+            multi_round = len(np.unique(dst)) != dst.shape[0]
+            # duplicate dsts: later rounds must read sources from the
+            # pre-call bank, which the first round's donation destroys
+            orig = jnp.copy(rec.arrays["regs"]) if multi_round else None
+            first_round = True
             pairs_d, pairs_s = dst, src
             while pairs_d.size:
                 _vals, first = np.unique(pairs_d, return_index=True)
@@ -92,9 +102,15 @@ class HyperLogLogArray(RExpirable):
                 take[first] = True
                 src_map = np.arange(P, dtype=np.int32)
                 src_map[pairs_d[take]] = pairs_s[take]
-                rec.arrays["regs"] = K.hll_bank_merge_map(
-                    rec.arrays["regs"], K.stage(src_map)
-                )
+                if first_round:
+                    rec.arrays["regs"] = K.hll_bank_merge_map(
+                        rec.arrays["regs"], K.stage(src_map)
+                    )
+                    first_round = False
+                else:
+                    rec.arrays["regs"] = K.hll_bank_merge_map_from(
+                        rec.arrays["regs"], orig, K.stage(src_map)
+                    )
                 pairs_d, pairs_s = pairs_d[~take], pairs_s[~take]
             self._touch_version(rec)
 

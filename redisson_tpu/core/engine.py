@@ -31,9 +31,15 @@ from redisson_tpu.utils import hashing as H
 
 class Engine:
     def __init__(self, config=None):
+        import redisson_tpu
         from redisson_tpu.config import Config
         from redisson_tpu.core.pubsub import PubSubHub
 
+        # engines are where device work starts: configure the persistent
+        # XLA compile cache before the first kernel compiles (lazy — a
+        # wire-only client never constructs an Engine and never pays the
+        # jax import)
+        redisson_tpu._enable_persistent_compile_cache()
         self.config = config if config is not None else Config()
         self.store = DeviceStore()
         self.pubsub = PubSubHub()

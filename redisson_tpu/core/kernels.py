@@ -360,6 +360,16 @@ def hll_bank_merge_map(regs2d, src_map):
     return jnp.maximum(regs2d, regs2d[src_map])
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def hll_bank_merge_map_from(regs2d, src_bank, src_map):
+    """Round >= 2 of a duplicate-dst merge: sources gather from
+    `src_bank` — the PRE-CALL snapshot — never from the partially merged
+    `regs2d`, so every round folds in exactly the requested sources (a
+    dst updated in round 1 must not leak ITS new sources into a later
+    round's dst — scatter-max read-all-sources-from-old semantics)."""
+    return jnp.maximum(regs2d, src_bank[src_map])
+
+
 @functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
 def hll_bank_add_packed(regs2d, tlh, n_valid, p: int):
     tenant, lo, hi = _unpack_tlh(tlh)

@@ -353,9 +353,25 @@ class SearchService:
         self._indexes: Dict[str, SearchIndex] = {}
         self._aliases: Dict[str, str] = {}       # alias -> index name
         self._dicts: Dict[str, set] = {}         # FT.DICT* custom dictionaries
-        self._cursors: Dict[int, List[Any]] = {}  # FT.CURSOR id -> pending rows
+        # FT.CURSOR id -> (pending rows, expires_at): abandoned cursors are
+        # pruned by idle timeout + a hard cap, like RediSearch's cursor
+        # expiry — without it every undrained WITHCURSOR leaks its rows for
+        # the server's lifetime
+        self._cursors: Dict[int, Tuple[List[Any], float]] = {}
         self._next_cursor = 1
         self._lock = threading.Lock()
+
+    CURSOR_TTL = 300.0
+    CURSOR_MAX = 128
+
+    def _prune_cursors_locked(self) -> None:
+        import time as _time
+
+        now = _time.time()
+        for cid in [c for c, (_r, exp) in self._cursors.items() if exp <= now]:
+            del self._cursors[cid]
+        while len(self._cursors) > self.CURSOR_MAX:
+            del self._cursors[min(self._cursors)]  # oldest id first
 
     # -- FT.CREATE / DROPINDEX / _LIST ---------------------------------------
 
@@ -509,21 +525,29 @@ class SearchService:
     # -- FT.CURSOR -----------------------------------------------------------
 
     def cursor_create(self, rows: List[Any]) -> int:
+        import time as _time
+
         with self._lock:
             cid = self._next_cursor
             self._next_cursor += 1
-            self._cursors[cid] = list(rows)
+            self._cursors[cid] = (list(rows), _time.time() + self.CURSOR_TTL)
+            self._prune_cursors_locked()  # after insert: cap includes the new one
             return cid
 
     def cursor_read(self, cid: int, count: int) -> Tuple[List[Any], int]:
-        """Returns (rows, next_cursor_id); 0 = exhausted (and deleted)."""
+        """Returns (rows, next_cursor_id); 0 = exhausted (and deleted).
+        A read refreshes the cursor's idle deadline."""
+        import time as _time
+
         with self._lock:
-            pending = self._cursors.get(cid)
-            if pending is None:
+            self._prune_cursors_locked()
+            entry = self._cursors.get(cid)
+            if entry is None:
                 raise KeyError(f"no such cursor {cid}")
+            pending, _exp = entry
             rows, rest = pending[:count], pending[count:]
             if rest:
-                self._cursors[cid] = rest
+                self._cursors[cid] = (rest, _time.time() + self.CURSOR_TTL)
                 return rows, cid
             del self._cursors[cid]
             return rows, 0

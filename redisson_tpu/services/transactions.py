@@ -22,9 +22,12 @@ Facades:
   * ``RemoteTransaction`` — single-node AND cluster wire clients: reads ride
     ``OBJCALLV`` (result + observed version), commit rides ``TXEXEC`` frames
     grouped per shard owner.  Cross-shard commits run a check-only phase on
-    every owner first (nothing applied anywhere if any shard conflicts),
-    then the apply frames — per-shard atomicity, the same guarantee level as
-    the reference's cluster batch (CommandBatchService per-entry MULTI/EXEC).
+    every owner first, so a conflict existing at commit time aborts with
+    nothing applied anywhere; a write racing into the window between one
+    shard's check and its apply can still land a partial commit — the same
+    per-shard-atomic guarantee level as the reference's cluster batch
+    (CommandBatchService per-entry MULTI/EXEC) — and is reported loudly as
+    PARTIALLY COMMITTED (see RemoteTransaction._commit_frames).
 
 Transaction-scoped object views give read-your-writes inside the transaction
 (the reference's transactional RBucket/RBuckets/RMap/RMapCache/RSet/RSetCache/
@@ -272,6 +275,70 @@ Transaction = EmbeddedTransaction
 _ROUTING_PREFIXES = ("MOVED ", "ASK ", "TRYAGAIN", "CLUSTERDOWN")
 
 
+class CommitPlan:
+    """Pure commit bookkeeping shared by the sync AND async wire
+    transactions (no I/O): which TXEXEC frames to send for the names not
+    yet committed, and what a mid-commit error means.  Keeping this in ONE
+    place is what lets the two event models share the subtle parts —
+    check-phase eligibility, no re-send of already-applied frames, loud
+    partial-commit classification."""
+
+    def __init__(self, versions: Dict[str, int], wire_ops: List[tuple],
+                 op_names: List[str], all_names: List[str]):
+        self.versions = versions
+        self.wire_ops = wire_ops
+        self.op_names = op_names
+        self.all_names = list(all_names)
+        self.done: Set[str] = set()  # names whose group frame committed
+
+    def remaining(self) -> List[str]:
+        return [n for n in self.all_names if n not in self.done]
+
+    def frames(self, groups: Dict[Any, List[str]]) -> List[tuple]:
+        """-> [(group_key, names, versions_sub, ops_sub)] with empty frames
+        dropped."""
+        out = []
+        for key, names in groups.items():
+            nameset = set(names)
+            vsub = {n: self.versions[n] for n in names if n in self.versions}
+            osub = [
+                op for op, nm in zip(self.wire_ops, self.op_names)
+                if nm in nameset
+            ]
+            if vsub or osub:
+                out.append((key, names, vsub, osub))
+        return out
+
+    def needs_check_phase(self, frames: List[tuple]) -> bool:
+        # one frame is already check+apply atomic; after a partial apply the
+        # committed shards' versions are stale, so re-checking would lie
+        return len(frames) > 1 and not self.done
+
+    @property
+    def partially_applied(self) -> bool:
+        return bool(self.done)
+
+    def classify(self, msg: str, attempt: int, attempts: int) -> str:
+        """'conflict' | 'partial' | 'retry' | 'raise' for a RespError."""
+        if msg.startswith("TXCONFLICT"):
+            return "partial" if self.done else "conflict"
+        if msg.startswith(_ROUTING_PREFIXES) and attempt < attempts - 1:
+            # TXEXEC's whole-frame routing precheck guarantees a bounced
+            # frame applied nothing; already-committed frames are excluded
+            # from the retry via remaining(), so no double-apply
+            return "retry"
+        return "raise"
+
+    def partial_error(self, msg: str) -> "TransactionException":
+        return TransactionException(
+            f"PARTIALLY COMMITTED: {len(self.done)} object(s) "
+            f"({sorted(self.done)[:5]}...) were applied before a later "
+            f"shard conflicted — {msg.replace('TXCONFLICT ', '', 1)}; "
+            "cross-shard commits are per-shard atomic (the reference's "
+            "cluster batch guarantee), not globally atomic"
+        )
+
+
 class RemoteTransaction(BaseTransaction):
     """Wire transaction for RemoteRedisson / ClusterRedisson (and the async
     client via a thin awaitable shell): reads ride OBJCALLV, commit rides
@@ -321,58 +388,52 @@ class RemoteTransaction(BaseTransaction):
             )
 
     def _commit_frames(self, all_names, versions, wire_ops, op_names) -> None:
+        """Cross-shard discipline: a check-only phase runs on every owner
+        BEFORE any apply, so a conflict that existed at commit time aborts
+        with nothing applied anywhere; a write racing between a shard's
+        check and its apply can still partially commit (the same per-shard
+        exposure as the reference's cluster batch) and is reported loudly
+        as PARTIALLY COMMITTED.  Retries after MOVED/ASK only re-send the
+        frames that have NOT committed (CommitPlan.remaining), so a
+        topology change mid-commit cannot double-apply."""
         from redisson_tpu.net.resp import RespError
 
+        plan = CommitPlan(versions, wire_ops, op_names, all_names)
         attempts = max(1, self._options.retry_attempts)
+        timeout = self._options.response_timeout
         for attempt in range(attempts):
-            groups = self._client.tx_groups(all_names)
+            frames = plan.frames(self._client.tx_groups(plan.remaining()))
+            if not frames:
+                return
             try:
-                if len(groups) > 1:
-                    # phase 1 — check-only frames on every owner: any shard's
-                    # conflict aborts with NOTHING applied anywhere
-                    for key, names in groups.items():
-                        vsub = {n: versions[n] for n in names if n in versions}
+                if plan.needs_check_phase(frames):
+                    for key, _names, vsub, _osub in frames:
                         if vsub:
-                            self._client.txexec(
-                                key, vsub, [],
-                                timeout=self._options.response_timeout,
-                            )
-                # apply frames (single-group commits skip phase 1: the one
-                # frame is already check+apply atomic)
+                            self._client.txexec(key, vsub, [], timeout=timeout)
                 results: List[Any] = []
-                for key, names in groups.items():
-                    nameset = set(names)
-                    vsub = {n: versions[n] for n in names if n in versions}
-                    osub = [
-                        op for op, nm in zip(wire_ops, op_names) if nm in nameset
-                    ]
-                    if not vsub and not osub:
-                        continue
+                for key, names, vsub, osub in frames:
                     results.extend(
-                        self._client.txexec(
-                            key, vsub, osub,
-                            timeout=self._options.response_timeout,
-                        )
+                        self._client.txexec(key, vsub, osub, timeout=timeout)
                     )
+                    plan.done.update(names)
                 errs = [r for r in results if isinstance(r, BaseException)]
                 if errs:
-                    # EXEC semantics: other ops applied, no rollback — but the
-                    # caller must know (the reference wraps batch failures in
-                    # TransactionException the same way)
+                    # EXEC semantics: other ops applied, no rollback — but
+                    # the caller must know (the reference wraps batch
+                    # failures in TransactionException the same way)
                     raise TransactionException(
                         f"transaction op failed: {errs[0]!r}"
                     ) from errs[0]
                 return
             except RespError as e:
-                msg = str(e)
-                if msg.startswith("TXCONFLICT"):
+                action = plan.classify(str(e), attempt, attempts)
+                if action == "conflict":
                     raise TransactionException(
-                        msg.replace("TXCONFLICT ", "", 1)
+                        str(e).replace("TXCONFLICT ", "", 1)
                     ) from None
-                if msg.startswith(_ROUTING_PREFIXES) and attempt < attempts - 1:
-                    # topology moved under the commit; TXEXEC's whole-frame
-                    # routing precheck guarantees the bounced frame applied
-                    # nothing, so regrouping and retrying is safe
+                if action == "partial":
+                    raise plan.partial_error(str(e)) from None
+                if action == "retry":
                     refresh = getattr(self._client, "refresh_topology", None)
                     if refresh is not None:
                         refresh()

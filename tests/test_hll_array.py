@@ -87,3 +87,22 @@ def test_bank_merge_id_out_of_range(client):
     bank.try_init(tenants=4)
     with pytest.raises(ValueError, match="out of range"):
         bank.merge_rows([0], [99999])
+
+
+def test_bank_merge_chained_dst_does_not_leak(client):
+    """Review regression: with pairs [(c,x),(a,b),(c,a)], counter c must
+    fold in x and ORIGINAL a — never b (a's round-1 source).  Later rounds
+    gather from the pre-call snapshot."""
+    bank = client.get_hyper_log_log_array("bank-leak")
+    bank.try_init(tenants=8)
+    A, B, C, X = 0, 1, 2, 3
+    bank.add(np.full(2000, A, np.int32), np.arange(0, 2000, dtype=np.int64))
+    bank.add(np.full(2000, B, np.int32), np.arange(10_000, 12_000, dtype=np.int64))
+    bank.add(np.full(2000, C, np.int32), np.arange(20_000, 22_000, dtype=np.int64))
+    bank.add(np.full(2000, X, np.int32), np.arange(30_000, 32_000, dtype=np.int64))
+    bank.merge_rows([C, A, C], [X, B, A])
+    ests = bank.estimate_all()
+    # a absorbed b: ~4000
+    assert abs(ests[A] - 4000) / 4000 < 0.1, ests[A]
+    # c = orig_c + x + ORIG a = ~6000; a leak of b would push it toward 8000
+    assert abs(ests[C] - 6000) / 6000 < 0.08, ests[C]

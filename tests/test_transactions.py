@@ -449,3 +449,52 @@ class TestWireMultiExec:
         assert c.execute("RESET") in (b"RESET", "RESET")
         with pytest.raises(RespError, match="EXEC without MULTI"):
             c.execute("EXEC")
+
+
+class TestCommitPlan:
+    """The shared sync/async commit planner (review fix): retry never
+    re-sends applied frames, partial commits classify loudly."""
+
+    def _plan(self):
+        from redisson_tpu.services.transactions import CommitPlan
+
+        versions = {"a": 1, "b": 2}
+        ops = [("get_map", "a", "fast_put", ("k", 1), {}),
+               ("get_map", "c", "fast_put", ("k", 2), {})]
+        return CommitPlan(versions, ops, ["a", "c"], ["a", "b", "c"])
+
+    def test_frames_split_versions_and_ops(self):
+        plan = self._plan()
+        frames = plan.frames({"n1": ["a", "b"], "n2": ["c"]})
+        by_key = {f[0]: f for f in frames}
+        assert by_key["n1"][2] == {"a": 1, "b": 2}
+        assert [op[1] for op in by_key["n1"][3]] == ["a"]
+        assert by_key["n2"][2] == {} and [op[1] for op in by_key["n2"][3]] == ["c"]
+
+    def test_remaining_excludes_done(self):
+        plan = self._plan()
+        plan.done.update(["a", "b"])
+        assert plan.remaining() == ["c"]
+        # retried grouping only covers the un-applied names
+        frames = plan.frames({"n2": plan.remaining()})
+        assert len(frames) == 1 and frames[0][1] == ["c"]
+
+    def test_check_phase_only_multi_frame_and_clean(self):
+        plan = self._plan()
+        two = plan.frames({"n1": ["a", "b"], "n2": ["c"]})
+        one = plan.frames({"n1": ["a", "b", "c"]})
+        assert plan.needs_check_phase(two) is True
+        assert plan.needs_check_phase(one) is False
+        plan.done.add("a")
+        assert plan.needs_check_phase(two) is False  # post-partial: no lying
+
+    def test_classify(self):
+        plan = self._plan()
+        assert plan.classify("TXCONFLICT object 'a' changed", 0, 3) == "conflict"
+        plan.done.add("a")
+        assert plan.classify("TXCONFLICT object 'b' changed", 0, 3) == "partial"
+        assert plan.classify("MOVED 12 n2", 0, 3) == "retry"
+        assert plan.classify("MOVED 12 n2", 2, 3) == "raise"  # attempts spent
+        assert plan.classify("ERR boom", 0, 3) == "raise"
+        err = plan.partial_error("TXCONFLICT object 'b' changed")
+        assert "PARTIALLY COMMITTED" in str(err)

@@ -484,3 +484,29 @@ class TestFtAdmin:
         )
         _, cid3 = reply
         assert n.execute("FT.CURSOR", "DEL", idx, str(cid3)) in (b"OK", "OK")
+
+
+def test_ft_cursor_idle_expiry_and_cap(single):
+    """Review fix: abandoned WITHCURSOR cursors expire by idle timeout and
+    a hard cap — no unbounded server memory growth."""
+    from redisson_tpu.services.search import SearchService
+
+    svc = SearchService.__new__(SearchService)
+    import threading as _t
+
+    svc._cursors = {}
+    svc._next_cursor = 1
+    svc._lock = _t.Lock()
+    cid = svc.cursor_create([[b"row1"], [b"row2"]])
+    # reads page and refresh the deadline
+    rows, nxt = svc.cursor_read(cid, 1)
+    assert rows == [[b"row1"]] and nxt == cid
+    # expire it manually and confirm pruning
+    pending, _exp = svc._cursors[cid]
+    svc._cursors[cid] = (pending, 0.0)
+    with pytest.raises(KeyError):
+        svc.cursor_read(cid, 1)
+    # cap: creating beyond CURSOR_MAX drops the oldest
+    for _ in range(SearchService.CURSOR_MAX + 10):
+        svc.cursor_create([[b"r"]])
+    assert len(svc._cursors) <= SearchService.CURSOR_MAX
