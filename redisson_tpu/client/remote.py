@@ -947,7 +947,8 @@ _GENERIC_FACTORIES = {
     "get_list_multimap_cache", "get_set_multimap_cache",
     "get_atomic_long", "get_atomic_double", "get_id_generator", "get_lock",
     "get_fair_lock", "get_spin_lock", "get_fenced_lock", "get_semaphore",
-    "get_count_down_latch", "get_rate_limiter", "get_stream", "get_time_series",
+    "get_count_down_latch", "get_rate_limiter", "get_permit_expirable_semaphore",
+    "get_stream", "get_time_series",
     "get_geo", "get_binary_stream", "get_json_bucket", "get_buckets",
     "get_bounded_blocking_queue", "get_sharded_bloom_filter_array",
     "get_sharded_hll_array", "get_sharded_bit_set",

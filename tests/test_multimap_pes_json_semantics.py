@@ -1,0 +1,241 @@
+"""Multimap, PermitExpirableSemaphore, FairLock, JsonBucket behavioral depth
+(RedissonListMultimapTest 20 / SetMultimapTest 28 /
+PermitExpirableSemaphoreTest 26 / FairLockTest 25 / JsonBucketTest 20) —
+VERDICT r3 #7, round-4 batch 7.
+"""
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture(scope="module")
+def remote_client():
+    with ServerThread(port=0) as st:
+        c = RemoteRedisson(st.address, timeout=60.0)
+        yield c
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def embedded_client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(params=["embedded", "remote"])
+def client(request, embedded_client, remote_client):
+    return embedded_client if request.param == "embedded" else remote_client
+
+
+def nm(tag):
+    return f"mpj-{tag}-{time.time_ns()}"
+
+
+class TestListMultimap:
+    def test_put_preserves_duplicates_and_order(self, client):
+        mm = client.get_list_multimap(nm("dup"))
+        mm.put("k", "a")
+        mm.put("k", "b")
+        mm.put("k", "a")
+        assert mm.get_all("k") == ["a", "b", "a"]
+        assert mm.size() == 3
+        assert mm.key_size() == 1
+
+    def test_remove_single_occurrence(self, client):
+        mm = client.get_list_multimap(nm("rm"))
+        mm.put("k", "a")
+        mm.put("k", "a")
+        assert mm.remove("k", "a") is True
+        assert mm.get_all("k") == ["a"]
+
+    def test_remove_all_returns_values(self, client):
+        mm = client.get_list_multimap(nm("rma"))
+        mm.put("k", "a")
+        mm.put("k", "b")
+        assert mm.remove_all("k") == ["a", "b"]
+        assert mm.get_all("k") == []
+        assert mm.key_size() == 0
+
+    def test_fast_remove_and_contains(self, client):
+        mm = client.get_list_multimap(nm("fr"))
+        mm.put_all("k", ["a", "b"])
+        mm.put("k2", "c")
+        assert mm.contains_key("k") and not mm.contains_key("zz")
+        assert mm.contains_entry("k", "a") and not mm.contains_entry("k", "zz")
+        assert mm.fast_remove("k", "zz") == 1
+        assert mm.key_size() == 1
+
+    def test_entries_and_keysets(self, client):
+        mm = client.get_list_multimap(nm("ent"))
+        mm.put("k1", "a")
+        mm.put("k2", "b")
+        assert sorted(mm.read_all_key_set()) == ["k1", "k2"]
+        assert sorted(mm.entries()) == [("k1", "a"), ("k2", "b")]
+
+
+class TestSetMultimap:
+    def test_put_dedupes(self, client):
+        mm = client.get_set_multimap(nm("dd"))
+        assert mm.put("k", "a") is True
+        assert mm.put("k", "a") is False  # already in the value set
+        assert mm.get_all("k") == ["a"]
+
+    def test_independent_keys(self, client):
+        mm = client.get_set_multimap(nm("ind"))
+        mm.put("k1", "x")
+        mm.put("k2", "x")
+        mm.remove("k1", "x")
+        assert mm.get_all("k1") == []
+        assert mm.get_all("k2") == ["x"]
+
+    def test_cache_per_key_ttl(self, client):
+        mmc = client.get_set_multimap_cache(nm("ttl"))
+        mmc.put("hot", "v1")
+        mmc.put("cold", "v2")
+        assert mmc.expire_key("cold", 0.15) is True
+        assert mmc.expire_key("absent", 1.0) is False
+        time.sleep(0.3)
+        assert mmc.get_all("cold") == []
+        assert mmc.get_all("hot") == ["v1"]
+
+
+class TestPermitExpirableSemaphore:
+    def test_acquire_returns_permit_id(self, client):
+        s = client.get_permit_expirable_semaphore(nm("pid"))
+        assert s.try_set_permits(2) is True
+        assert s.try_set_permits(5) is False  # set-once
+        p1 = s.try_acquire()
+        p2 = s.try_acquire()
+        assert p1 and p2 and p1 != p2
+        assert s.try_acquire() is None  # exhausted
+        assert s.available_permits() == 0
+
+    def test_release_by_id(self, client):
+        s = client.get_permit_expirable_semaphore(nm("rel"))
+        s.try_set_permits(1)
+        pid = s.try_acquire()
+        assert s.release(pid) is True
+        assert s.release(pid) is False  # double release
+        assert s.release("bogus") is False
+        assert s.available_permits() == 1
+
+    def test_lease_expiry_returns_permit(self, client):
+        s = client.get_permit_expirable_semaphore(nm("lease"))
+        s.try_set_permits(1)
+        pid = s.try_acquire(lease_time=0.15)
+        assert pid is not None
+        assert s.available_permits() == 0
+        time.sleep(0.3)
+        assert s.available_permits() == 1  # lease reaped
+        assert s.release(pid) is False     # expired permit cannot release
+
+    def test_update_lease_time(self, client):
+        s = client.get_permit_expirable_semaphore(nm("upd"))
+        s.try_set_permits(1)
+        pid = s.try_acquire(lease_time=0.15)
+        assert s.update_lease_time(pid, 30.0) is True
+        time.sleep(0.3)
+        assert s.available_permits() == 0  # extended lease still held
+        assert s.update_lease_time("bogus", 1.0) is False
+
+    def test_blocked_acquire_wakes_on_release(self, embedded_client):
+        s = embedded_client.get_permit_expirable_semaphore(nm("wake"))
+        s.try_set_permits(1)
+        held = s.try_acquire()
+        got = []
+
+        def waiter():
+            got.append(s.try_acquire(wait_time=10.0))
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        time.sleep(0.1)
+        assert not got
+        s.release(held)
+        th.join(5.0)
+        assert got and got[0] is not None
+
+
+class TestFairLock:
+    def test_fifo_grant_order(self, embedded_client):
+        """Waiters acquire in arrival order (the fair-queue contract)."""
+        lk = embedded_client.get_fair_lock(nm("fifo"))
+        lk.lock()
+        order = []
+        threads = []
+
+        def waiter(tag, delay):
+            time.sleep(delay)
+            lk.lock()
+            order.append(tag)
+            time.sleep(0.05)
+            lk.unlock()
+
+        for i, d in enumerate((0.05, 0.15, 0.25)):
+            th = threading.Thread(target=waiter, args=(i, d), daemon=True)
+            th.start()
+            threads.append(th)
+        time.sleep(0.5)  # all three queued behind the holder
+        lk.unlock()
+        for th in threads:
+            th.join(timeout=10.0)
+        assert order == [0, 1, 2]
+
+    def test_try_lock_fails_behind_queue(self, embedded_client):
+        lk = embedded_client.get_fair_lock(nm("behind"))
+        lk.lock()
+        got = []
+        th = threading.Thread(target=lambda: got.append(lk.try_lock()))
+        th.start(); th.join(5.0)
+        assert got == [False]
+        lk.unlock()
+
+
+class TestJsonBucket:
+    def test_set_get_paths(self, client):
+        jb = client.get_json_bucket(nm("jp"))
+        jb.set("$", {"user": {"name": "ann", "tags": ["a", "b"], "age": 30}})
+        assert jb.get("$.user.name") == "ann"
+        assert jb.get("$.user.tags") == ["a", "b"]
+        assert jb.get("$") == {"user": {"name": "ann", "tags": ["a", "b"], "age": 30}}
+
+    def test_set_subpath(self, client):
+        jb = client.get_json_bucket(nm("sub"))
+        jb.set("$", {"a": {"b": 1}})
+        jb.set("$.a.b", 2)
+        assert jb.get("$.a.b") == 2
+
+    def test_num_incr(self, client):
+        jb = client.get_json_bucket(nm("incr"))
+        jb.set("$", {"n": 10})
+        assert jb.increment_and_get("$.n", 5) == 15
+        assert jb.get("$.n") == 15
+
+    def test_array_ops(self, client):
+        jb = client.get_json_bucket(nm("arr"))
+        jb.set("$", {"xs": [1, 2]})
+        assert jb.array_append("$.xs", 3) == 3  # new length
+        assert jb.get("$.xs") == [1, 2, 3]
+        assert jb.array_index_of("$.xs", 2) == 1
+        assert jb.array_pop("$.xs") == 3
+        assert jb.array_size("$.xs") == 2
+
+    def test_toggle_and_clear(self, client):
+        jb = client.get_json_bucket(nm("tc"))
+        jb.set("$", {"flag": True, "n": 5})
+        assert jb.toggle("$.flag") is False
+        assert jb.clear("$.n") == 1
+        assert jb.get("$.n") == 0
+
+    def test_object_introspection(self, client):
+        jb = client.get_json_bucket(nm("obj"))
+        jb.set("$", {"a": 1, "b": {"c": 2}})
+        assert sorted(jb.object_keys("$")) == ["a", "b"]
+        assert jb.object_size("$") == 2
+        assert jb.type("$.a") in ("integer", "number", "int")
