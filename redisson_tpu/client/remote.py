@@ -777,6 +777,9 @@ class RemoteBatch:
 
 
 class RemoteKeys:
+    """RKeys over the wire — the full embedded Keys surface on typed verbs
+    (RedissonKeys.java roles)."""
+
     def __init__(self, client: "RemoteRedisson"):
         self._client = client
 
@@ -786,8 +789,32 @@ class RemoteKeys:
     def delete(self, *names: str) -> int:
         return int(self._client.execute("DEL", *names))
 
+    def unlink(self, *names: str) -> int:
+        return int(self._client.execute("UNLINK", *names))
+
+    def delete_by_pattern(self, pattern: str) -> int:
+        names = self.get_keys(pattern)
+        return self.delete(*names) if names else 0
+
     def count(self) -> int:
         return int(self._client.execute("DBSIZE"))
+
+    def count_exists(self, *names: str) -> int:
+        return sum(int(self._client.execute("EXISTS", nm)) for nm in names)
+
+    def random_key(self) -> Optional[str]:
+        k = self._client.execute("RANDOMKEY")
+        return None if k is None else bytes(k).decode()
+
+    def expire(self, name: str, seconds: float) -> bool:
+        return bool(self._client.execute("PEXPIRE", name, int(seconds * 1000)))
+
+    def remain_time_to_live(self, name: str) -> Optional[float]:
+        ms = int(self._client.execute("PTTL", name))
+        return None if ms < 0 else ms / 1000.0
+
+    def flushdb(self) -> None:
+        self._client.execute("FLUSHALL")
 
     def flushall(self) -> None:
         self._client.execute("FLUSHALL")
