@@ -1,0 +1,177 @@
+"""Heavy multi-instance concurrency battery (BaseConcurrentTest /
+RedissonLockHeavyTest role, SURVEY §4.3): many threads across SEVERAL client
+instances hammer the same objects; invariants must hold exactly.
+"""
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.remote import RemoteRedisson
+from redisson_tpu.server.server import ServerThread
+
+THREADS = 8
+ROUNDS = 25
+
+
+def fan_out(n, fn):
+    errs = []
+
+    def run(i):
+        try:
+            fn(i)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not errs, errs[:3]
+    assert not any(t.is_alive() for t in threads), "worker wedged"
+
+
+@pytest.fixture(scope="module")
+def server():
+    with ServerThread(port=0) as st:
+        yield st
+
+
+@pytest.fixture(scope="module")
+def clients(server):
+    cs = [RemoteRedisson(server.address, timeout=60.0) for _ in range(4)]
+    yield cs
+    for c in cs:
+        c.shutdown()
+
+
+def test_lock_mutual_exclusion_under_load(clients):
+    """N threads x M clients increment a plain (non-atomic) map value under
+    a distributed lock: the final count proves strict mutual exclusion."""
+    counter = {"v": 0}
+
+    def work(i):
+        c = clients[i % len(clients)]
+        lk = c.get_lock("heavy-lock")
+        for _ in range(ROUNDS):
+            lk.lock()
+            try:
+                m = c.get_map("heavy-lock-map")
+                cur = m.get("n") or 0
+                m.fast_put("n", cur + 1)
+                counter["v"] += 1  # host-side mirror under the same lock
+            finally:
+                lk.unlock()
+
+    fan_out(THREADS, work)
+    assert clients[0].get_map("heavy-lock-map").get("n") == THREADS * ROUNDS
+    assert counter["v"] == THREADS * ROUNDS
+
+
+def test_atomic_long_is_linearizable(clients):
+    def work(i):
+        al = clients[i % len(clients)].get_atomic_long("heavy-al")
+        for _ in range(ROUNDS * 4):
+            al.increment_and_get()
+
+    fan_out(THREADS, work)
+    assert clients[0].get_atomic_long("heavy-al").get() == THREADS * ROUNDS * 4
+
+
+def test_semaphore_never_overcommits(clients):
+    permits = 3
+    sem0 = clients[0].get_semaphore("heavy-sem")
+    assert sem0.try_set_permits(permits)
+    inside = []
+    peak = []
+
+    def work(i):
+        c = clients[i % len(clients)]
+        sem = c.get_semaphore("heavy-sem")
+        for _ in range(6):
+            if sem.try_acquire(wait_time=10.0):
+                inside.append(1)
+                peak.append(len(inside))
+                time.sleep(0.01)
+                inside.pop()
+                sem.release()
+
+    fan_out(THREADS, work)
+    assert max(peak) <= permits
+    assert sem0.available_permits() == permits
+
+
+def test_queue_every_element_delivered_once(clients):
+    total = THREADS * ROUNDS
+    produced = [f"e{i}" for i in range(total)]
+    consumed: list = []
+    consumed_lock = threading.Lock()
+
+    def producer(i):
+        q = clients[i % len(clients)].get_blocking_queue("heavy-q")
+        for j in range(ROUNDS):
+            q.offer(f"e{i * ROUNDS + j}")
+
+    def consumer(i):
+        q = clients[i % len(clients)].get_blocking_queue("heavy-q")
+        while True:
+            v = q.poll_blocking(1.0)
+            if v is None:
+                return
+            with consumed_lock:
+                consumed.append(v)
+
+    producers = [threading.Thread(target=producer, args=(i,)) for i in range(THREADS)]
+    consumers = [threading.Thread(target=consumer, args=(i,)) for i in range(4)]
+    for t in producers + consumers:
+        t.start()
+    for t in producers:
+        t.join(timeout=60.0)
+    for t in consumers:
+        t.join(timeout=60.0)
+    assert sorted(consumed) == sorted(produced)  # exactly-once, none lost
+
+
+def test_map_put_if_absent_single_winner(clients):
+    winners: list = []
+    lock = threading.Lock()
+
+    def work(i):
+        m = clients[i % len(clients)].get_map("heavy-pia")
+        for r in range(ROUNDS):
+            prev = m.put_if_absent(f"slot{r}", f"t{i}")
+            if prev is None:
+                with lock:
+                    winners.append((r, i))
+
+    fan_out(THREADS, work)
+    # exactly one winner per slot
+    assert len(winners) == ROUNDS
+    assert len({r for r, _ in winners}) == ROUNDS
+
+
+def test_embedded_count_down_latch_fan_in():
+    c = redisson_tpu.create()
+    try:
+        latch = c.get_count_down_latch("heavy-cdl")
+        latch.try_set_count(THREADS)
+        released = threading.Event()
+
+        def waiter():
+            if latch.await_(timeout=30.0):
+                released.set()
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+
+        def work(i):
+            time.sleep(0.01 * i)
+            latch.count_down()
+
+        fan_out(THREADS, work)
+        assert released.wait(10.0)
+        assert latch.get_count() == 0
+    finally:
+        c.shutdown()
