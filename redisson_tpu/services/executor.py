@@ -77,6 +77,7 @@ class _Task:
     submitted_at: float = field(default_factory=time.time)
     started_at: Optional[float] = None  # set on claim; orphan requeue keys on this
     claimed_by: Optional[str] = None    # worker id (remote workers)
+    expires_at: Optional[float] = None  # submit(ttl=...): discard if unstarted
 
 
 class ExecutorService:
@@ -105,15 +106,20 @@ class ExecutorService:
 
     # -- submission (RExecutorService.submit / RExecutorService.execute) ----
 
-    def submit(self, fn: Callable, *args, **kwargs) -> TaskFuture:
+    def submit(self, fn: Callable, *args, task_id: Optional[str] = None,
+               ttl: Optional[float] = None, **kwargs) -> TaskFuture:
+        """RExecutorService.submit incl. the id form (submit(id, task) — an
+        explicit id makes the task addressable/idempotent across clients)
+        and the time-to-live form (submit(task, timeToLive): a task not
+        STARTED within `ttl` seconds is discarded and its future fails)."""
         payload = pickle.dumps((fn, args, kwargs), protocol=pickle.HIGHEST_PROTOCOL)
         # the future registers BEFORE the task becomes claimable: an idle
         # worker can claim-and-finish the instant the queue append lands,
         # and a late registration would wait forever on a completed task
-        tid = uuid.uuid4().hex[:16]
+        tid = task_id or uuid.uuid4().hex[:16]
         fut = TaskFuture(tid)
         self._futures[tid] = fut
-        self.submit_payload(payload, task_id=tid)
+        self.submit_payload(payload, task_id=tid, ttl=ttl)
         return fut
 
     def execute(self, fn: Callable, *args, **kwargs) -> None:
@@ -179,12 +185,21 @@ class ExecutorService:
             while rec.host["queue"]:
                 tid = rec.host["queue"].pop(0)
                 task = rec.host["tasks"].get(tid)
-                if task is not None and task.state == "queued":
-                    task.state = "running"
-                    task.started_at = time.time()
-                    task.claimed_by = worker_id
+                if task is None or task.state != "queued":
+                    continue
+                if task.expires_at is not None and time.time() >= task.expires_at:
+                    # submit(ttl=...): unstarted past its TTL — discard and
+                    # fail the future (the reference drops the task record)
+                    task.state = "failed"
+                    task.error = "task expired before execution (time-to-live)"
                     rec.version += 1
-                    return task
+                    self._resolve_failure(task)
+                    continue
+                task.state = "running"
+                task.started_at = time.time()
+                task.claimed_by = worker_id
+                rec.version += 1
+                return task
             return None
 
     def _worker_loop(self):
@@ -258,13 +273,22 @@ class ExecutorService:
     # result) — the server never deserializes task code, mirroring the
     # reference where task classBody bytes pass through Redis untouched.
 
-    def submit_payload(self, payload: bytes, task_id: Optional[str] = None) -> str:
+    def submit_payload(self, payload: bytes, task_id: Optional[str] = None,
+                       ttl: Optional[float] = None) -> str:
         """Enqueue an opaque pickled (fn, args, kwargs) payload; returns id.
         `task_id` lets submit() pre-register its future under the id before
-        the task is visible to workers."""
-        task = _Task(id=task_id or uuid.uuid4().hex[:16], payload=bytes(payload))
+        the task is visible to workers; an existing id is rejected
+        (submit(id, task) addressability contract).  `ttl` bounds how long
+        the task may sit UNSTARTED."""
+        task = _Task(
+            id=task_id or uuid.uuid4().hex[:16], payload=bytes(payload),
+            expires_at=time.time() + ttl if ttl is not None else None,
+        )
         with self._engine.locked(f"{{{self._name}}}:tasks"):
             rec = self._rec()
+            existing = rec.host["tasks"].get(task.id)
+            if existing is not None and existing.state in ("queued", "running"):
+                raise ValueError(f"task id '{task.id}' is already active")
             rec.host["tasks"][task.id] = task
             rec.host["queue"].append(task.id)
             rec.version += 1
@@ -332,6 +356,13 @@ class ExecutorService:
             fut._fail(RuntimeError(error_text))
         self._done_wait().signal(all_=True)
         return True
+
+    def _resolve_failure(self, task: "_Task") -> None:
+        """Fail the local future (if any) for an already-failed task record."""
+        fut = self._futures.pop(task.id, None)
+        if fut:
+            fut._fail(RuntimeError(task.error or "task failed"))
+        self._done_wait().signal(all_=True)
 
     def _done_wait(self):
         return self._engine.wait_entry(f"__exec_done__:{self._name}")

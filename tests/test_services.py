@@ -429,3 +429,40 @@ class TestServiceEdges:
         dq.transfer_due()
         assert dest.poll_blocking(2.0) == "x"
         assert dest.poll() is None  # exactly one copy arrived
+
+
+class TestExecutorSubmitForms:
+    """RExecutorService.submit(id, task) and submit(task, timeToLive)."""
+
+    def test_submit_with_explicit_id(self, client):
+        ex = client.get_executor_service("exid")
+        ex.register_workers(1)
+        f = ex.submit(square, 4, task_id="my-task")
+        assert f.task_id == "my-task"
+        assert f.get(10.0) == 16
+        assert ex.task_state("my-task") == "finished"
+        ex.shutdown()
+
+    def test_duplicate_active_id_rejected(self, client):
+        ex = client.get_executor_service("exdup")  # no workers: stays queued
+        ex.submit(square, 1, task_id="dup")
+        with pytest.raises(ValueError, match="already active"):
+            ex.submit(square, 2, task_id="dup")
+        ex.shutdown()
+
+    def test_ttl_expires_unstarted_task(self, client):
+        ex = client.get_executor_service("exttl")  # no workers yet
+        f = ex.submit(square, 9, ttl=0.1)
+        time.sleep(0.25)
+        ex.register_workers(1)  # claims AFTER the ttl elapsed
+        with pytest.raises(RuntimeError, match="expired"):
+            f.get(10.0)
+        assert ex.task_state(f.task_id) == "failed"
+        ex.shutdown()
+
+    def test_ttl_task_runs_if_claimed_in_time(self, client):
+        ex = client.get_executor_service("exttl2")
+        ex.register_workers(1)
+        f = ex.submit(square, 5, ttl=30.0)
+        assert f.get(10.0) == 25
+        ex.shutdown()
