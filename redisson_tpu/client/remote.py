@@ -800,7 +800,15 @@ class RemoteKeys:
         return int(self._client.execute("DBSIZE"))
 
     def count_exists(self, *names: str) -> int:
-        return sum(int(self._client.execute("EXISTS", nm)) for nm in names)
+        """ONE variadic EXISTS per shard owner (Redis + cmd_exists both sum
+        args) instead of a round trip per name; tx_groups collapses to a
+        single frame on the single-node client."""
+        if not names:
+            return 0
+        return sum(
+            int(self._client.execute("EXISTS", *group))
+            for group in self._client.tx_groups(list(names)).values()
+        )
 
     def random_key(self) -> Optional[str]:
         k = self._client.execute("RANDOMKEY")

@@ -118,8 +118,18 @@ class ExecutorService:
         # and a late registration would wait forever on a completed task
         tid = task_id or uuid.uuid4().hex[:16]
         fut = TaskFuture(tid)
+        prev = self._futures.get(tid)
         self._futures[tid] = fut
-        self.submit_payload(payload, task_id=tid, ttl=ttl)
+        try:
+            self.submit_payload(payload, task_id=tid, ttl=ttl)
+        except BaseException:
+            # rejected (duplicate-id) submit must not clobber the original
+            # submitter's still-pending future
+            if prev is not None:
+                self._futures[tid] = prev
+            else:
+                self._futures.pop(tid, None)
+            raise
         return fut
 
     def execute(self, fn: Callable, *args, **kwargs) -> None:
@@ -293,7 +303,34 @@ class ExecutorService:
             rec.host["queue"].append(task.id)
             rec.version += 1
         self._wait().signal()
+        if ttl is not None:
+            # proactive expiry: with no worker ever claiming, the TTL must
+            # still fail the task (and its future) at the deadline — not
+            # leave the caller to time out
+            self._engine.schedule_timeout(self._expire_due_tasks, ttl + 0.01)
         return task.id
+
+    def _expire_due_tasks(self) -> int:
+        """Fail every queued task whose submit-TTL elapsed (claim-time
+        checks in _take_task stay as the fallback for late timers)."""
+        expired = []
+        with self._engine.locked(f"{{{self._name}}}:tasks"):
+            rec = self._rec()
+            now = time.time()
+            for tid in list(rec.host["queue"]):
+                task = rec.host["tasks"].get(tid)
+                if (
+                    task is not None and task.state == "queued"
+                    and task.expires_at is not None and now >= task.expires_at
+                ):
+                    task.state = "failed"
+                    task.error = "task expired before execution (time-to-live)"
+                    rec.host["queue"].remove(tid)
+                    rec.version += 1
+                    expired.append(task)
+        for t in expired:
+            self._resolve_failure(t)
+        return len(expired)
 
     def claim_task(self, worker_id: str) -> Optional[Tuple[str, bytes]]:
         """Worker pull: (task_id, payload) or None.  Claiming heartbeats the

@@ -466,3 +466,24 @@ class TestExecutorSubmitForms:
         f = ex.submit(square, 5, ttl=30.0)
         assert f.get(10.0) == 25
         ex.shutdown()
+
+    def test_ttl_expires_without_any_worker_claim(self, client):
+        """Review fix: the TTL deadline fails the task via the engine timer
+        even when NO worker ever claims it."""
+        ex = client.get_executor_service("exttl3")  # never registers workers
+        f = ex.submit(square, 2, ttl=0.1)
+        with pytest.raises(RuntimeError, match="expired"):
+            f.get(10.0)  # resolved by the timer, well before this timeout
+        assert ex.task_state(f.task_id) == "failed"
+        ex.shutdown()
+
+    def test_duplicate_id_rejection_keeps_original_future(self, client):
+        """Review fix: a rejected duplicate submit must not clobber the
+        original submitter's future."""
+        ex = client.get_executor_service("exdup2")
+        f1 = ex.submit(square, 6, task_id="keep")
+        with pytest.raises(ValueError):
+            ex.submit(square, 7, task_id="keep")
+        ex.register_workers(1)
+        assert f1.get(10.0) == 36  # original future still resolves
+        ex.shutdown()
