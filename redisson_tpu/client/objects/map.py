@@ -359,6 +359,106 @@ class Map(RExpirable):
         self._write_through("write", key, update)
         return True
 
+    # -- java.util.Map compute family (RMap.compute*/merge; BaseMapTest
+    # -- testCompute*/testMerge).  Built on the public ops under ONE record
+    # -- lock so MapWriter/MapLoader/TTL semantics inherit; the functions
+    # -- are plain callables (over the wire they travel pickled in the
+    # -- OBJCALL frame, the serialized-task discipline).
+
+    def compute(self, key, remapping):
+        """remapping(key, old_or_None) -> new value, or None to remove."""
+        with self._engine.locked(self._name):
+            old = self.get(key)
+            new = remapping(key, old)
+            if new is None:
+                if old is not None:
+                    self.fast_remove(key)
+                return None
+            self.fast_put(key, new)
+            return new
+
+    def compute_if_absent(self, key, mapping):
+        """mapping(key) computes a value only when absent; returns the
+        current value either way (None when mapping returned None)."""
+        with self._engine.locked(self._name):
+            old = self.get(key)
+            if old is not None:
+                return old
+            new = mapping(key)
+            if new is not None:
+                self.fast_put(key, new)
+            return new
+
+    def compute_if_present(self, key, remapping):
+        with self._engine.locked(self._name):
+            old = self.get(key)
+            if old is None:
+                return None
+            new = remapping(key, old)
+            if new is None:
+                self.fast_remove(key)
+                return None
+            self.fast_put(key, new)
+            return new
+
+    def merge(self, key, value, remapping):
+        """RMap.merge: absent -> value; present -> remapping(old, value);
+        a None result removes the entry."""
+        with self._engine.locked(self._name):
+            old = self.get(key)
+            new = value if old is None else remapping(old, value)
+            if new is None:
+                self.fast_remove(key)
+                return None
+            self.fast_put(key, new)
+            return new
+
+    # -- XX-style conditional puts (RMap.putIfExists/fastPutIfExists) --------
+
+    def put_if_exists(self, key, value):
+        """Write only over an EXISTING entry; returns the previous value
+        (None = absent, nothing written)."""
+        with self._engine.locked(self._name):
+            old = self.get(key)
+            if old is None:
+                return None
+            self.fast_put(key, value)
+            return old
+
+    def fast_put_if_exists(self, key, value) -> bool:
+        with self._engine.locked(self._name):
+            if self.get(key) is None:
+                return False
+            self.fast_put(key, value)
+            return True
+
+    def fast_replace(self, key, value) -> bool:
+        """RMap.fastReplace: replace() without returning the old value."""
+        with self._engine.locked(self._name):
+            if self.get(key) is None:
+                return False
+            self.fast_put(key, value)
+            return True
+
+    # -- pattern scans (RMap.keySet/values/entrySet(pattern)) ----------------
+
+    def _entries_by_pattern(self, pattern: str):
+        import fnmatch
+
+        return [
+            (k, v) for k, v in self.read_all_entry_set()
+            if isinstance(k, str) and fnmatch.fnmatchcase(k, pattern)
+        ]
+
+    def key_set_by_pattern(self, pattern: str) -> List:
+        return [k for k, _v in self._entries_by_pattern(pattern)]
+
+    def values_by_pattern(self, pattern: str) -> List:
+        return [v for _k, v in self._entries_by_pattern(pattern)]
+
+    def entry_set_by_pattern(self, pattern: str) -> List[Tuple[Any, Any]]:
+        return self._entries_by_pattern(pattern)
+
     def add_and_get(self, key, delta):
         """Numeric field increment (RMap.addAndGet / HINCRBY Lua)."""
         ek = self._ek(key)
