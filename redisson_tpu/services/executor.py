@@ -607,6 +607,32 @@ class ScheduledExecutorService(ExecutorService):
         threading.Thread(target=loop, daemon=True).start()
         return sid
 
+    def schedule_with_fixed_delay(self, initial_delay: float, delay: float,
+                                  fn: Callable, *args) -> str:
+        """RScheduledExecutorService.scheduleWithFixedDelay: the next run
+        starts `delay` seconds AFTER the previous one FINISHES (fixed-rate
+        schedules by wall-clock period instead)."""
+        sid = uuid.uuid4().hex[:12]
+        stop = threading.Event()
+        self._fixed_rate_stops = getattr(self, "_fixed_rate_stops", {})
+        self._fixed_rate_stops[sid] = stop
+
+        def loop():
+            if initial_delay > 0:
+                stop.wait(initial_delay)
+            while not stop.is_set() and not self._shutdown.is_set():
+                fut = self.submit(fn, *args)
+                try:
+                    fut.get(timeout=3600.0)  # completion gates the next delay
+                except Exception:  # noqa: BLE001 — a failing run still reschedules
+                    pass
+                if stop.is_set() or self._shutdown.is_set():
+                    return
+                stop.wait(delay)
+
+        threading.Thread(target=loop, daemon=True).start()
+        return sid
+
     def schedule_cron(self, cron_expr: str, fn: Callable, *args) -> str:
         """schedule(task, CronSchedule.of(expr))."""
         cron = CronExpression(cron_expr)

@@ -28,6 +28,15 @@ def boom():
 
 
 @inject_client
+def stamp_and_sleep(name, duration, client=None):
+    import time as _t
+
+    client.get_list(name).add(_t.time())
+    _t.sleep(duration)
+    return True
+
+
+@inject_client
 def uses_client(key, client=None):
     client.get_atomic_long(key).increment_and_get()
     return client.get_atomic_long(key).get()
@@ -487,3 +496,22 @@ class TestExecutorSubmitForms:
         ex.register_workers(1)
         assert f1.get(10.0) == 36  # original future still resolves
         ex.shutdown()
+
+
+class TestScheduleWithFixedDelay:
+    def test_delay_counts_from_completion(self, client):
+        """scheduleWithFixedDelay: runs never overlap — each delay starts
+        after the previous run finishes (a fixed-rate schedule with a slow
+        task would stack submissions)."""
+        sched = client.get_scheduled_executor_service("swfd")
+        sched.register_workers(2)
+        stamps = client.get_list("swfd-stamps")
+        sid = sched.schedule_with_fixed_delay(0.0, 0.15, stamp_and_sleep, "swfd-stamps", 0.1)
+        time.sleep(0.9)
+        assert sched.cancel_scheduled(sid)
+        n = stamps.size()
+        # each cycle costs >= 0.25s (0.1 run + 0.15 delay): 0.9s fits 3-4
+        assert 2 <= n <= 4, n
+        time.sleep(0.35)
+        assert stamps.size() == n  # cancelled: no further runs
+        sched.shutdown()
