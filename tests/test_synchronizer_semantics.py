@@ -230,3 +230,65 @@ class TestLatchDepth:
         latch = embedded_client.get_count_down_latch(nm("once"))
         assert latch.try_set_count(2) is True
         assert latch.try_set_count(5) is False  # already counting
+
+
+class TestFencedLock:
+    """RFencedLock (RedissonFencedLockTest + the fencing-token contract)."""
+
+    def test_tokens_strictly_increase(self, embedded_client):
+        lk = embedded_client.get_fenced_lock(nm("tok"))
+        t1 = lk.lock_and_get_token()
+        lk.unlock()
+        t2 = lk.lock_and_get_token()
+        lk.unlock()
+        assert t2 > t1  # monotonic across grants
+
+    def test_token_survives_reentry(self, embedded_client):
+        lk = embedded_client.get_fenced_lock(nm("re"))
+        t1 = lk.lock_and_get_token()
+        t2 = lk.lock_and_get_token()  # reentrant: same grant, same token
+        assert t1 == t2
+        assert lk.get_token() == t1
+        lk.unlock()
+        lk.unlock()
+
+    def test_try_lock_and_get_token(self, embedded_client):
+        lk = embedded_client.get_fenced_lock(nm("try"))
+        tok = lk.try_lock_and_get_token()
+        assert tok is not None
+        got = []
+        th = threading.Thread(target=lambda: got.append(lk.try_lock_and_get_token()))
+        th.start(); th.join(5.0)
+        assert got == [None]  # contended: no token handed out
+        lk.unlock()
+
+    def test_fencing_across_lease_expiry(self, embedded_client):
+        """The POINT of fencing: a holder that lost its lease must see a
+        SMALLER token than the new holder — stale writers are detectable."""
+        lk = embedded_client.get_fenced_lock(nm("lease"))
+        t1 = lk.try_lock_and_get_token(lease_time=0.15)
+        assert t1 is not None
+        time.sleep(0.3)  # lease expires
+        t2 = lk.try_lock_and_get_token(wait_time=5.0)
+        assert t2 is not None and t2 > t1
+
+
+class TestSpinLock:
+    def test_mutual_exclusion_and_reentry(self, embedded_client):
+        lk = embedded_client.get_spin_lock(nm("spin"))
+        assert lk.try_lock() is True
+        assert lk.try_lock() is True  # reentrant
+        got = []
+        th = threading.Thread(target=lambda: got.append(lk.try_lock()))
+        th.start(); th.join(5.0)
+        assert got == [False]
+        lk.unlock()
+        lk.unlock()
+        th = threading.Thread(target=lambda: got.append(lk.try_lock()))
+        th.start(); th.join(5.0)
+        assert got == [False, True]
+
+    def test_wire_spin_lock(self, remote_client):
+        lk = remote_client.get_spin_lock(nm("wspin"))
+        assert lk.try_lock() is True
+        lk.unlock()
