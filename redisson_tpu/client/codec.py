@@ -260,13 +260,124 @@ class ProtobufCodec(Codec):
         return msg
 
 
+class CborCodec(Codec):
+    """CBOR binary codec (parity: codec/CborJacksonCodec.java) — a pure
+    RFC 8949 core-type subset (int, bytes, str, list, dict, bool, None,
+    float64), self-contained because the image carries no cbor library.
+    Interoperable with any standards-compliant CBOR decoder for these
+    types."""
+
+    name = "cbor"
+
+    def encode(self, value: Any) -> bytes:
+        out = bytearray()
+        self._enc(value, out)
+        return bytes(out)
+
+    @staticmethod
+    def _head(major: int, arg: int, out: bytearray) -> None:
+        if arg < 24:
+            out.append((major << 5) | arg)
+        elif arg < 0x100:
+            out.append((major << 5) | 24); out += arg.to_bytes(1, "big")
+        elif arg < 0x10000:
+            out.append((major << 5) | 25); out += arg.to_bytes(2, "big")
+        elif arg < 0x100000000:
+            out.append((major << 5) | 26); out += arg.to_bytes(4, "big")
+        else:
+            out.append((major << 5) | 27); out += arg.to_bytes(8, "big")
+
+    def _enc(self, v: Any, out: bytearray) -> None:
+        if v is False:
+            out.append(0xF4)
+        elif v is True:
+            out.append(0xF5)
+        elif v is None:
+            out.append(0xF6)
+        elif isinstance(v, int):
+            if v >= 0:
+                self._head(0, v, out)
+            else:
+                self._head(1, -1 - v, out)
+        elif isinstance(v, float):
+            out.append(0xFB); out += struct.pack(">d", v)
+        elif isinstance(v, (bytes, bytearray, memoryview)):
+            b = bytes(v); self._head(2, len(b), out); out += b
+        elif isinstance(v, str):
+            b = v.encode(); self._head(3, len(b), out); out += b
+        elif isinstance(v, (list, tuple)):
+            self._head(4, len(v), out)
+            for item in v:
+                self._enc(item, out)
+        elif isinstance(v, dict):
+            self._head(5, len(v), out)
+            for k, val in v.items():
+                self._enc(k, out); self._enc(val, out)
+        else:
+            raise TypeError(f"CborCodec cannot encode {type(v).__name__}")
+
+    def decode(self, data: bytes) -> Any:
+        v, i = self._dec(bytes(data), 0)
+        if i != len(data):
+            raise ValueError("trailing bytes after CBOR value")
+        return v
+
+    @staticmethod
+    def _arg(data: bytes, i: int):
+        info = data[i] & 0x1F
+        i += 1
+        if info < 24:
+            return info, i
+        n = {24: 1, 25: 2, 26: 4, 27: 8}.get(info)
+        if n is None:
+            raise ValueError(f"unsupported CBOR additional info {info}")
+        return int.from_bytes(data[i:i + n], "big"), i + n
+
+    def _dec(self, data: bytes, i: int):
+        major = data[i] >> 5
+        if major == 7:
+            b = data[i]
+            if b == 0xF4:
+                return False, i + 1
+            if b == 0xF5:
+                return True, i + 1
+            if b == 0xF6:
+                return None, i + 1
+            if b == 0xFB:
+                return struct.unpack(">d", data[i + 1:i + 9])[0], i + 9
+            raise ValueError(f"unsupported CBOR simple/float byte {b:#x}")
+        arg, i = self._arg(data, i)
+        if major == 0:
+            return arg, i
+        if major == 1:
+            return -1 - arg, i
+        if major == 2:
+            return data[i:i + arg], i + arg
+        if major == 3:
+            return data[i:i + arg].decode(), i + arg
+        if major == 4:
+            out = []
+            for _ in range(arg):
+                v, i = self._dec(data, i)
+                out.append(v)
+            return out, i
+        if major == 5:
+            d = {}
+            for _ in range(arg):
+                k, i = self._dec(data, i)
+                v, i = self._dec(data, i)
+                d[k] = v
+            return d, i
+        raise ValueError(f"unsupported CBOR major type {major}")
+
+
 DEFAULT_CODEC = JsonCodec()
 
 _REGISTRY = {
     c.name: c
     for c in [
         JsonCodec(), PickleCodec(), StringCodec(), BytesCodec(), LongCodec(),
-        DoubleCodec(), ZlibCodec(), Bz2Codec(), LzmaCodec(),
+        DoubleCodec(), ZlibCodec(), Bz2Codec(), LzmaCodec(), CborCodec(),
     ]
 }
 if MsgPackCodec is not None:

@@ -10,6 +10,7 @@ import pytest
 import redisson_tpu
 from redisson_tpu.client.codec import (
     Bz2Codec,
+    CborCodec,
     BytesCodec,
     CompositeCodec,
     DoubleCodec,
@@ -35,6 +36,7 @@ CODECS = [
     ("zlib", ZlibCodec, {"compress": "me" * 50}, "k1"),
     ("bz2", Bz2Codec, {"compress": "me" * 50}, "k1"),
     ("lzma", LzmaCodec, {"compress": "me" * 50}, "k1"),
+    ("cbor", CborCodec, {"nested": [1, -5, 2.5, b"\x00raw", True, None]}, "k1"),
 ]
 
 
@@ -139,3 +141,35 @@ class TestCodecOnTtlAndTx:
         m.fast_put("k", "tx-value")
         tx.commit()
         assert remote_client.get_map(name, StringCodec()).get("k") == "tx-value"
+
+
+class TestCborWireFormat:
+    """The pure-python CBOR codec emits standards-compliant RFC 8949 bytes
+    for its core-type subset (spot-checked against the RFC examples)."""
+
+    def test_rfc_example_encodings(self):
+        c = CborCodec()
+        assert c.encode(0) == b"\x00"
+        assert c.encode(23) == b"\x17"
+        assert c.encode(24) == b"\x18\x18"
+        assert c.encode(-1) == b"\x20"
+        assert c.encode("a") == b"\x61a"
+        assert c.encode([1, 2, 3]) == b"\x83\x01\x02\x03"
+        assert c.encode({"a": 1}) == b"\xa1\x61a\x01"
+        assert c.encode(True) == b"\xf5"
+        assert c.encode(None) == b"\xf6"
+        assert c.encode(1.5) == b"\xfb?\xf8\x00\x00\x00\x00\x00\x00"
+
+    def test_roundtrip_structures(self):
+        c = CborCodec()
+        v = {"k": [1, -99, "s", b"b", {"n": None, "f": 2.25}], "big": 1 << 40}
+        assert c.decode(c.encode(v)) == v
+
+    def test_trailing_bytes_rejected(self):
+        c = CborCodec()
+        with pytest.raises(ValueError, match="trailing"):
+            c.decode(c.encode(1) + b"\x00")
+
+    def test_unencodable_rejected(self):
+        with pytest.raises(TypeError):
+            CborCodec().encode(object())
