@@ -295,6 +295,11 @@ class CborCodec(Codec):
         elif v is None:
             out.append(0xF6)
         elif isinstance(v, int):
+            if not (-(1 << 64) <= v < (1 << 64)):
+                raise TypeError(
+                    "CBOR integer out of uint64 argument range "
+                    "(RFC 8949 bignum tags are not supported)"
+                )
             if v >= 0:
                 self._head(0, v, out)
             else:
@@ -317,7 +322,10 @@ class CborCodec(Codec):
             raise TypeError(f"CborCodec cannot encode {type(v).__name__}")
 
     def decode(self, data: bytes) -> Any:
-        v, i = self._dec(bytes(data), 0)
+        try:
+            v, i = self._dec(bytes(data), 0)
+        except (IndexError, struct.error):
+            raise ValueError("truncated CBOR input") from None
         if i != len(data):
             raise ValueError("trailing bytes after CBOR value")
         return v
@@ -331,6 +339,8 @@ class CborCodec(Codec):
         n = {24: 1, 25: 2, 26: 4, 27: 8}.get(info)
         if n is None:
             raise ValueError(f"unsupported CBOR additional info {info}")
+        if i + n > len(data):  # a short slice would silently mis-decode
+            raise ValueError("truncated CBOR input")
         return int.from_bytes(data[i:i + n], "big"), i + n
 
     def _dec(self, data: bytes, i: int):
@@ -351,10 +361,11 @@ class CborCodec(Codec):
             return arg, i
         if major == 1:
             return -1 - arg, i
-        if major == 2:
-            return data[i:i + arg], i + arg
-        if major == 3:
-            return data[i:i + arg].decode(), i + arg
+        if major in (2, 3):
+            if i + arg > len(data):
+                raise ValueError("truncated CBOR input")
+            chunk = data[i:i + arg]
+            return (chunk if major == 2 else chunk.decode()), i + arg
         if major == 4:
             out = []
             for _ in range(arg):

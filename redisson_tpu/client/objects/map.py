@@ -414,20 +414,25 @@ class Map(RExpirable):
             return new
 
     # -- XX-style conditional puts (RMap.putIfExists/fastPutIfExists) --------
+    # presence checks use _raw_get_for_update like replace(): a write-path
+    # probe must neither read-through-load from a MapLoader (the XX contract
+    # is about the HASH's contents) nor touch MapCache access tracking
 
     def put_if_exists(self, key, value):
         """Write only over an EXISTING entry; returns the previous value
         (None = absent, nothing written)."""
         with self._engine.locked(self._name):
-            old = self.get(key)
-            if old is None:
+            rec = self._rec_or_create()
+            old_raw = self._raw_get_for_update(rec, self._ek(key))
+            if old_raw is None:
                 return None
             self.fast_put(key, value)
-            return old
+            return self._dv(old_raw)
 
     def fast_put_if_exists(self, key, value) -> bool:
         with self._engine.locked(self._name):
-            if self.get(key) is None:
+            rec = self._rec_or_create()
+            if self._raw_get_for_update(rec, self._ek(key)) is None:
                 return False
             self.fast_put(key, value)
             return True
@@ -435,23 +440,31 @@ class Map(RExpirable):
     def fast_replace(self, key, value) -> bool:
         """RMap.fastReplace: replace() without returning the old value."""
         with self._engine.locked(self._name):
-            if self.get(key) is None:
+            rec = self._rec_or_create()
+            if self._raw_get_for_update(rec, self._ek(key)) is None:
                 return False
             self.fast_put(key, value)
             return True
 
     # -- pattern scans (RMap.keySet/values/entrySet(pattern)) ----------------
+    # str(k) matching keeps these agreeing with key_iterator(pattern) for
+    # non-string keys; the key-only scan never decodes values
 
     def _entries_by_pattern(self, pattern: str):
         import fnmatch
 
         return [
             (k, v) for k, v in self.read_all_entry_set()
-            if isinstance(k, str) and fnmatch.fnmatchcase(k, pattern)
+            if fnmatch.fnmatchcase(str(k), pattern)
         ]
 
     def key_set_by_pattern(self, pattern: str) -> List:
-        return [k for k, _v in self._entries_by_pattern(pattern)]
+        import fnmatch
+
+        return [
+            k for k in self.read_all_keys()
+            if fnmatch.fnmatchcase(str(k), pattern)
+        ]
 
     def values_by_pattern(self, pattern: str) -> List:
         return [v for _k, v in self._entries_by_pattern(pattern)]

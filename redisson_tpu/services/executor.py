@@ -622,10 +622,17 @@ class ScheduledExecutorService(ExecutorService):
                 stop.wait(initial_delay)
             while not stop.is_set() and not self._shutdown.is_set():
                 fut = self.submit(fn, *args)
-                try:
-                    fut.get(timeout=3600.0)  # completion gates the next delay
-                except Exception:  # noqa: BLE001 — a failing run still reschedules
-                    pass
+                # completion gates the next delay — wait HOWEVER long the run
+                # takes (capping would let a long run overlap the next one),
+                # polling so cancel/shutdown still take effect promptly
+                while not stop.is_set() and not self._shutdown.is_set():
+                    try:
+                        fut.get(timeout=1.0)
+                        break
+                    except TimeoutError:
+                        continue
+                    except Exception:  # noqa: BLE001 — failed run reschedules
+                        break
                 if stop.is_set() or self._shutdown.is_set():
                     return
                 stop.wait(delay)

@@ -173,3 +173,30 @@ class TestCborWireFormat:
     def test_unencodable_rejected(self):
         with pytest.raises(TypeError):
             CborCodec().encode(object())
+
+
+class TestCborMalformedInput:
+    """Review fixes: every malformed-input shape raises the documented
+    ValueError/TypeError, never IndexError/OverflowError."""
+
+    def test_truncated_array_header(self):
+        with pytest.raises(ValueError, match="truncated"):
+            CborCodec().decode(b"\x83\x01\x02")  # says 3 items, has 2
+
+    def test_truncated_string_body(self):
+        with pytest.raises(ValueError, match="truncated"):
+            CborCodec().decode(b"\x63ab")  # says 3 bytes, has 2
+
+    def test_truncated_length_prefix(self):
+        with pytest.raises(ValueError, match="truncated"):
+            CborCodec().decode(b"\x19\x01")  # u16 length cut short
+
+    def test_bignum_out_of_range_is_type_error(self):
+        with pytest.raises(TypeError, match="uint64"):
+            CborCodec().encode(1 << 64)
+        with pytest.raises(TypeError, match="uint64"):
+            CborCodec().encode(-(1 << 64) - 1)
+        # boundary values still encode
+        c = CborCodec()
+        assert c.decode(c.encode((1 << 64) - 1)) == (1 << 64) - 1
+        assert c.decode(c.encode(-(1 << 64))) == -(1 << 64)

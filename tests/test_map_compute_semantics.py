@@ -157,3 +157,51 @@ class TestPatternScans:
         mc.put_with_ttl("user:dead", 2, ttl=0.1)
         time.sleep(0.25)
         assert mc.key_set_by_pattern("user:*") == ["user:live"]
+
+
+class TestXXContractDiscipline:
+    """Review fixes: XX probes must not read-through-load or touch
+    access tracking."""
+
+    def test_put_if_exists_does_not_loader_load(self, embedded_client):
+        from redisson_tpu.client.objects.map import MapLoader, MapOptions
+
+        class L(MapLoader):
+            def load(self, key):
+                return f"loaded-{key}"
+
+            def load_all_keys(self):
+                return ["only-in-loader"]
+
+        m = embedded_client.get_map(nm("xxl"), options=MapOptions(loader=L()))
+        # the key exists only in the LOADER: XX ops must refuse, like replace()
+        assert m.put_if_exists("only-in-loader", "x") is None
+        assert m.fast_put_if_exists("only-in-loader", "x") is False
+        assert m.fast_replace("only-in-loader", "x") is False
+        # plain get still read-through-loads (the loader contract)
+        assert m.get("only-in-loader") == "loaded-only-in-loader"
+        # NOW present in the hash: XX ops write
+        assert m.fast_replace("only-in-loader", "replaced") is True
+
+    def test_fast_put_if_exists_no_lfu_touch(self, embedded_client):
+        mc = embedded_client.get_map_cache(nm("xxlfu"))
+        mc.set_max_size(2, mode="LFU")
+        mc.put("cold", 1)
+        mc.put("hot", 2)
+        for _ in range(5):
+            mc.get("hot")
+        # ten XX writes to 'cold' must NOT count as LFU hits
+        for i in range(10):
+            mc.fast_put_if_exists("cold", i)
+        mc.put("new", 3)  # evicts the LFU victim
+        assert mc.get("hot") == 2      # genuinely hot key survives
+        assert mc.get("cold") is None  # write-only key was the victim
+
+
+class TestPatternAgreesWithIterator:
+    def test_non_string_keys_match_via_str(self, embedded_client):
+        m = embedded_client.get_map(nm("pati"))
+        m.put(1, "one")
+        m.put("1x", "str")
+        assert sorted(str(k) for k in m.key_set_by_pattern("1*")) == ["1", "1x"]
+        assert sorted(str(k) for k in m.key_iterator("1*")) == ["1", "1x"]
