@@ -150,3 +150,107 @@ def test_auto_checkpointer(tmp_path, client):
         assert checkpoint.load(fresh.engine, path) >= 5
     finally:
         fresh.shutdown()
+
+
+class TestDumpRestoreDepth:
+    """RObject.dump/restore + the SAVE/RESTORESTATE wire surface depth
+    (round-4: §5.4 checkpoint subsystem hardening)."""
+
+    def test_dump_blob_is_self_contained(self, client):
+        from redisson_tpu.core import checkpoint
+
+        z = client.get_scored_sorted_set("cpd-z")
+        z.add(1.0, "a")
+        z.add(2.0, "b")
+        blob = checkpoint.dump_record(client._engine, "cpd-z")
+        # restoring under a NEW name on the SAME engine clones fully
+        checkpoint.restore_record(client._engine, "cpd-z2", blob)
+        z2 = client.get_scored_sorted_set("cpd-z2")
+        assert z2.entry_range(0, -1) == [("a", 1.0), ("b", 2.0)]
+        # the copy is independent: mutating one leaves the other
+        z2.add(3.0, "c")
+        assert z.size() == 2
+
+    def test_restore_busykey_without_replace(self, client):
+        import pytest as _pytest
+
+        from redisson_tpu.core import checkpoint
+
+        client.get_bucket("cpd-busy").set("v1")
+        blob = checkpoint.dump_record(client._engine, "cpd-busy")
+        with _pytest.raises(ValueError, match="BUSYKEY"):
+            checkpoint.restore_record(client._engine, "cpd-busy", blob)
+        checkpoint.restore_record(client._engine, "cpd-busy", blob, replace=True)
+
+    def test_device_arrays_survive_roundtrip(self, tmp_path, client):
+        import numpy as np
+
+        from redisson_tpu.core import checkpoint
+
+        bf = client.get_bloom_filter("cpd-bf")
+        bf.try_init(10_000, 0.01)
+        keys = np.arange(500, dtype=np.int64)
+        bf.add_all(keys)
+        path = str(tmp_path / "dev.ckpt")
+        checkpoint.save(client._engine, path)
+        import redisson_tpu as _r
+
+        fresh = _r.create()
+        try:
+            checkpoint.load(fresh._engine, path)
+            bf2 = fresh.get_bloom_filter("cpd-bf")
+            assert bf2.contains_each(keys).all()  # device plane restored
+        finally:
+            fresh.shutdown()
+
+    def test_malicious_global_in_blob_rejected(self, client):
+        """The restricted unpickler must refuse attacker-chosen globals in
+        a RESTORE blob (wire-reachable surface)."""
+        import pickle as _pickle
+
+        import pytest as _pytest
+
+        from redisson_tpu.core import checkpoint
+
+        class Evil:
+            def __reduce__(self):
+                import os
+
+                return (os.system, ("echo pwned",))
+
+        payload = {
+            "format": 1,
+            "hash_version": 1,
+            "kind": "bucket",
+            "meta": {},
+            "arrays": {},
+            "host_pickled": _pickle.dumps(Evil()),
+            "expire_at": None,
+        }
+        with _pytest.raises(Exception) as exc:
+            checkpoint.restore_record(
+                client._engine, "cpd-evil", _pickle.dumps(payload)
+            )
+        assert "forbidden" in str(exc.value) or "Unpickl" in type(exc.value).__name__
+
+    def test_wire_save_restorestate(self):
+        import os
+        import time as _t
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from redisson_tpu.client.remote import RemoteRedisson
+        from redisson_tpu.server.server import ServerThread
+
+        with ServerThread(port=0) as st:
+            c = RemoteRedisson(st.address, timeout=60.0)
+            c.get_map("cpw-m").put("k", "v")
+            path = f"/tmp/cpw-{_t.time_ns()}.ckpt"
+            try:
+                c.execute("SAVE", path)
+                c.get_map("cpw-m").put("k", "changed")
+                c.execute("RESTORESTATE", path)
+                assert c.get_map("cpw-m").get("k") == "v"
+            finally:
+                c.shutdown()
+                if os.path.exists(path):
+                    os.unlink(path)
