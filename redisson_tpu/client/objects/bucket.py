@@ -74,6 +74,35 @@ class Bucket(RExpirable):
             self.set(update)
             return True
 
+    def set_if_absent(self, value: Any, ttl: Optional[float] = None) -> bool:
+        """RBucket.setIfAbsent — the modern name for trySet."""
+        return self.try_set(value, ttl)
+
+    def set_and_keep_ttl(self, value: Any) -> None:
+        """RBucket.setAndKeepTTL (SET ... KEEPTTL): replace the value
+        without disturbing the record's expiry."""
+        data = self._codec.encode(value)
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            rec.host["v"] = data  # expire_at untouched
+            self._touch_version(rec)
+
+    def get_and_expire(self, ttl: float) -> Any:
+        """RBucket.getAndExpire (GETEX EX): read + set expiry atomically."""
+        with self._engine.locked(self._name):
+            old = self.get()
+            if old is not None:
+                self._engine.store.expire(self._name, time.time() + ttl)
+            return old
+
+    def get_and_clear_expire(self) -> Any:
+        """RBucket.getAndClearExpire (GETEX PERSIST)."""
+        with self._engine.locked(self._name):
+            old = self.get()
+            if old is not None:
+                self._engine.store.expire(self._name, None)
+            return old
+
     def get_and_delete(self) -> Any:
         with self._engine.locked(self._name):
             old = self.get()

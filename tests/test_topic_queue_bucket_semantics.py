@@ -245,3 +245,37 @@ class TestBucketDepth:
         ids = [idg.next_id() for _ in range(50)]
         assert len(set(ids)) == 50
         assert ids == sorted(ids)
+
+
+class TestBucketModernNames:
+    """RBucket.setIfAbsent/setAndKeepTTL/getAndExpire/getAndClearExpire."""
+
+    def test_set_if_absent(self, client):
+        b = client.get_bucket(nm("sia"))
+        assert b.set_if_absent("v") is True
+        assert b.set_if_absent("w") is False
+
+    def test_set_and_keep_ttl(self, client):
+        b = client.get_bucket(nm("kttl"))
+        b.set("v1", ttl=30.0)
+        b.set_and_keep_ttl("v2")
+        assert b.get() == "v2"
+        remain = b.remain_time_to_live()
+        assert remain is not None and 25.0 < remain <= 30.0
+        # plain set clears the TTL by contrast
+        b.set("v3")
+        assert b.remain_time_to_live() is None
+
+    def test_get_and_expire(self, client):
+        b = client.get_bucket(nm("gex"))
+        b.set("v")
+        assert b.get_and_expire(30.0) == "v"
+        remain = b.remain_time_to_live()
+        assert remain is not None and 25.0 < remain <= 30.0
+        assert b.get_and_clear_expire() == "v"
+        assert b.remain_time_to_live() is None
+
+    def test_get_and_expire_absent(self, client):
+        b = client.get_bucket(nm("gexa"))
+        assert b.get_and_expire(10.0) is None
+        assert b.get_and_clear_expire() is None
