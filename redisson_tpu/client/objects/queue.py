@@ -195,6 +195,63 @@ class Deque(Queue):
             return None
         return self._d(rec.host[-1])
 
+    # -- RDeque round-4 surface: XX pushes + cross-deque moves ---------------
+
+    def add_first_if_exists(self, *values) -> int:
+        """RDeque.addFirstIfExists (LPUSHX): push only onto an EXISTING
+        deque; returns the new size (0 = absent, nothing pushed)."""
+        with self._engine.locked(self._name):
+            rec = self._engine.store.get(self._name)
+            if rec is None or not rec.host:
+                return 0
+            for v in values:
+                rec.host.insert(0, self._e(v))
+            self._touch_version(rec)
+        self._signal()
+        return self.size()
+
+    def add_last_if_exists(self, *values) -> int:
+        """RDeque.addLastIfExists (RPUSHX)."""
+        with self._engine.locked(self._name):
+            rec = self._engine.store.get(self._name)
+            if rec is None or not rec.host:
+                return 0
+            for v in values:
+                rec.host.append(self._e(v))
+            self._touch_version(rec)
+        self._signal()
+        return self.size()
+
+    def move(self, dest_name: str, src_end: str = "LEFT", dest_end: str = "LEFT"):
+        """RDeque.move (LMOVE src dest LEFT|RIGHT LEFT|RIGHT): atomic
+        cross-deque transfer; returns the moved value or None."""
+        if src_end.upper() not in ("LEFT", "RIGHT") or dest_end.upper() not in ("LEFT", "RIGHT"):
+            raise ValueError("ends must be LEFT or RIGHT")
+        dest = Deque(self._engine, dest_name, self._codec)
+        names = [self._name, dest._name]
+        with self._engine.locked_many(names):
+            rec = self._engine.store.get(self._name)
+            if rec is None or not rec.host:
+                return None
+            raw = rec.host.pop(0) if src_end.upper() == "LEFT" else rec.host.pop()
+            self._touch_version(rec)
+            drec = dest._rec_or_create()
+            if dest_end.upper() == "LEFT":
+                drec.host.insert(0, raw)
+            else:
+                drec.host.append(raw)
+            dest._touch_version(drec)
+        dest._signal()
+        return self._d(raw)
+
+    def add_first_to(self, dest_name: str):
+        """RDeque.addFirstTo: pop this deque's HEAD onto dest's head."""
+        return self.move(dest_name, "LEFT", "LEFT")
+
+    def add_last_to(self, dest_name: str):
+        """RDeque.addLastTo: pop this deque's HEAD onto dest's tail."""
+        return self.move(dest_name, "LEFT", "RIGHT")
+
 
 class BlockingQueue(Queue):
     """RBlockingQueue: take/poll(timeout) park on the wait entry and are woken

@@ -279,3 +279,47 @@ class TestBucketModernNames:
         b = client.get_bucket(nm("gexa"))
         assert b.get_and_expire(10.0) is None
         assert b.get_and_clear_expire() is None
+
+
+class TestDequeXXAndMove:
+    """RDeque.addFirst/LastIfExists (LPUSHX/RPUSHX) + move (LMOVE)."""
+
+    def test_push_if_exists_refuses_absent(self, client):
+        dq = client.get_deque(nm("dxx"))
+        assert dq.add_first_if_exists("x") == 0
+        assert dq.add_last_if_exists("x") == 0
+        assert dq.size() == 0
+        dq.add_first("seed")
+        assert dq.add_first_if_exists("f") == 2
+        assert dq.add_last_if_exists("l") == 3
+        assert dq.read_all() == ["f", "seed", "l"]
+
+    def test_move_all_end_combinations(self, client):
+        src = client.get_deque(nm("mv-src"))
+        dst = client.get_deque(nm("mv-dst"))
+        for v in ("a", "b", "c", "d"):
+            src.add_last(v)
+        dst.add_last("z")
+        assert src.move(dst.name, "LEFT", "LEFT") == "a"    # a -> head
+        assert src.move(dst.name, "RIGHT", "RIGHT") == "d"  # d -> tail
+        assert dst.read_all() == ["a", "z", "d"]
+        assert src.read_all() == ["b", "c"]
+
+    def test_move_empty_source(self, client):
+        src = client.get_deque(nm("mv-empty"))
+        assert src.move(nm("mv-sink"), "LEFT", "LEFT") is None
+
+    def test_move_validates_ends(self, client):
+        src = client.get_deque(nm("mv-val"))
+        with pytest.raises(ValueError):
+            src.move("x", "MIDDLE", "LEFT")
+
+    def test_add_first_to_and_last_to(self, client):
+        src = client.get_deque(nm("aft-src"))
+        dst = client.get_deque(nm("aft-dst"))
+        src.add_last("h1")
+        src.add_last("h2")
+        dst.add_last("existing")
+        assert src.add_first_to(dst.name) == "h1"
+        assert src.add_last_to(dst.name) == "h2"
+        assert dst.read_all() == ["h1", "existing", "h2"]
