@@ -223,3 +223,53 @@ class TestListeners:
         sc.add("v", ttl=30.0)  # reset to long TTL
         time.sleep(0.3)
         assert sc.contains("v")
+
+
+class TestSetRound4Surface:
+    """RSet counted bulk ops, tryAdd, containsEach, per-value synchronizers
+    (RSet.java:39-75, 300-337)."""
+
+    def test_add_remove_counted(self, client):
+        s = fset(client, "cnt", "a", "b")
+        assert s.add_all_counted(["b", "c", "d"]) == 2  # b already present
+        assert s.remove_all_counted(["a", "zz", "c"]) == 2
+        assert sorted(s.read_all()) == ["b", "d"]
+        empty = fset(client, "cnte")
+        assert empty.remove_all_counted(["x"]) == 0
+
+    def test_try_add_all_or_nothing(self, client):
+        s = fset(client, "try", "present")
+        assert s.try_add("new1", "new2") is True
+        assert s.try_add("new3", "present") is False  # one clash: nothing added
+        assert not s.contains("new3")
+
+    def test_contains_each(self, client):
+        s = fset(client, "ce", "a", "b")
+        assert s.contains_each(["a", "zz", "b"]) == ["a", "b"]
+        assert s.contains_each([]) == []
+
+    def test_per_value_locks_independent(self, embedded_client):
+        import threading
+
+        s = embedded_client.get_set(f"ssem-locks-{time.time_ns()}")
+        s.add("v1")
+        lk1 = s.get_lock("v1")
+        lk2 = s.get_lock("v2")
+        assert lk1.try_lock() is True
+        got = []
+        th = threading.Thread(target=lambda: got.append((lk2.try_lock(), lk1.try_lock())))
+        th.start(); th.join(5.0)
+        assert got == [(True, False)]  # different values: independent locks
+        lk1.unlock()
+
+    def test_per_value_semaphore_and_latch(self, embedded_client):
+        s = embedded_client.get_set(f"ssem-sync-{time.time_ns()}")
+        sem = s.get_semaphore("item")
+        assert sem.try_set_permits(1)
+        assert sem.try_acquire() is True
+        latch = s.get_count_down_latch("item")
+        assert latch.try_set_count(1)
+        latch.count_down()
+        assert latch.get_count() == 0
+        # a fresh per-value handle addresses the SAME underlying objects
+        assert s.get_semaphore("item").available_permits() == 0
