@@ -446,6 +446,49 @@ class Map(RExpirable):
             self.fast_put(key, value)
             return True
 
+    # -- per-key synchronizers (RMap.getLock(key)/getReadWriteLock(key)/
+    # -- getSemaphore/getPermitExpirableSemaphore/getFairLock/
+    # -- getCountDownLatch — entry-granular coordination, names derived
+    # -- from the encoded key's hash like the reference's suffix scheme)
+
+    def _key_object_name(self, key, kind: str) -> str:
+        import hashlib
+
+        h = hashlib.sha1(self._ek(key)).hexdigest()[:16]
+        return f"{self._name}:{h}:{kind}"
+
+    def get_lock(self, key):
+        from redisson_tpu.client.objects.lock import Lock
+
+        return Lock(self._engine, self._key_object_name(key, "lock"))
+
+    def get_fair_lock(self, key):
+        from redisson_tpu.client.objects.lock import FairLock
+
+        return FairLock(self._engine, self._key_object_name(key, "fairlock"))
+
+    def get_read_write_lock(self, key):
+        from redisson_tpu.client.objects.lock import ReadWriteLock
+
+        return ReadWriteLock(self._engine, self._key_object_name(key, "rwlock"))
+
+    def get_semaphore(self, key):
+        from redisson_tpu.client.objects.semaphore import Semaphore
+
+        return Semaphore(self._engine, self._key_object_name(key, "semaphore"))
+
+    def get_permit_expirable_semaphore(self, key):
+        from redisson_tpu.client.objects.semaphore import PermitExpirableSemaphore
+
+        return PermitExpirableSemaphore(
+            self._engine, self._key_object_name(key, "psemaphore")
+        )
+
+    def get_count_down_latch(self, key):
+        from redisson_tpu.client.objects.semaphore import CountDownLatch
+
+        return CountDownLatch(self._engine, self._key_object_name(key, "latch"))
+
     # -- pattern scans (RMap.keySet/values/entrySet(pattern)) ----------------
     # str(k) matching keeps these agreeing with key_iterator(pattern) for
     # non-string keys; the key-only scan never decodes values

@@ -205,3 +205,70 @@ class TestPatternAgreesWithIterator:
         m.put("1x", "str")
         assert sorted(str(k) for k in m.key_set_by_pattern("1*")) == ["1", "1x"]
         assert sorted(str(k) for k in m.key_iterator("1*")) == ["1", "1x"]
+
+
+class TestPerKeySynchronizers:
+    """RMap.getLock(key) family: entry-granular coordination."""
+
+    def test_per_key_locks_independent(self, embedded_client):
+        import threading
+
+        m = embedded_client.get_map(nm("pkl"))
+        lk_a = m.get_lock("key-a")
+        lk_b = m.get_lock("key-b")
+        assert lk_a.try_lock() is True
+        got = []
+        th = threading.Thread(target=lambda: got.append((lk_b.try_lock(), lk_a.try_lock())))
+        th.start(); th.join(5.0)
+        assert got == [(True, False)]  # per-key isolation
+        lk_a.unlock()
+
+    def test_guarded_read_modify_write(self, embedded_client):
+        import threading
+
+        m = embedded_client.get_map(nm("pkrmw"))
+        m.put("n", 0)
+
+        def bump():
+            lk = m.get_lock("n")
+            for _ in range(20):
+                lk.lock()
+                try:
+                    m.fast_put("n", m.get("n") + 1)
+                finally:
+                    lk.unlock()
+
+        ths = [threading.Thread(target=bump) for _ in range(4)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(30.0)
+        assert m.get("n") == 80
+
+    def test_per_key_rwlock_and_latch(self, embedded_client):
+        m = embedded_client.get_map(nm("pkrw"))
+        rw = m.get_read_write_lock("doc")
+        r = rw.read_lock()
+        assert r.try_lock() is True
+        r.unlock()
+        latch = m.get_count_down_latch("doc")
+        assert latch.try_set_count(1)
+        latch.count_down()
+        assert latch.get_count() == 0
+
+    def test_same_key_same_object_over_wire(self, remote_client):
+        m = remote_client.get_map(nm("pkw"))
+        lk = m.get_lock("shared")
+        assert lk.try_lock() is True
+        # second handle for the same key contends on the SAME lock
+        got = []
+        import threading
+
+        def other():
+            got.append(remote_client.get_map(m.name).get_lock("shared").try_lock())
+
+        th = threading.Thread(target=other)
+        th.start(); th.join(10.0)
+        # same client identity (uuid:threadId differs per thread) -> False
+        assert got == [False]
+        lk.unlock()
