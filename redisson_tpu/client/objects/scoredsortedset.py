@@ -86,6 +86,97 @@ class ScoredSortedSet(RExpirable):
         self._signal_waiters()
         return n
 
+    def add_all_if_absent(self, entries: Dict[Any, float]) -> int:
+        """ZADD NX many (RScoredSortedSet.addAllIfAbsent): count ADDED."""
+        n = 0
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            for member, score in entries.items():
+                e = self._e(member)
+                if e not in rec.host["scores"]:
+                    rec.host["scores"][e] = float(score)
+                    n += 1
+            if n:
+                self._dirty(rec)
+                self._touch_version(rec)
+        if n:
+            self._signal_waiters()
+        return n
+
+    def add_all_if_exist(self, entries: Dict[Any, float]) -> int:
+        """ZADD XX CH many: count of existing members whose score CHANGED."""
+        n = 0
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            for member, score in entries.items():
+                e = self._e(member)
+                old = rec.host["scores"].get(e)
+                if old is not None and old != float(score):
+                    rec.host["scores"][e] = float(score)
+                    n += 1
+            if n:
+                self._dirty(rec)
+                self._touch_version(rec)
+        return n
+
+    def _add_all_cmp(self, entries: Dict[Any, float], pred) -> int:
+        n = 0
+        fresh = 0
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            for member, score in entries.items():
+                e = self._e(member)
+                old = rec.host["scores"].get(e)
+                if old is None or pred(float(score), old):
+                    rec.host["scores"][e] = float(score)
+                    n += 1
+                    fresh += old is None
+            if n:
+                self._dirty(rec)
+                self._touch_version(rec)
+        if fresh:
+            self._signal_waiters()
+        return n
+
+    def add_all_if_greater(self, entries: Dict[Any, float]) -> int:
+        """ZADD GT CH many: count added-or-raised."""
+        return self._add_all_cmp(entries, lambda new, old: new > old)
+
+    def add_all_if_less(self, entries: Dict[Any, float]) -> int:
+        """ZADD LT CH many."""
+        return self._add_all_cmp(entries, lambda new, old: new < old)
+
+    def add_score_and_get_rank(self, member, delta: float) -> Optional[int]:
+        """ZINCRBY + ZRANK atomically (addScoreAndGetRank)."""
+        with self._engine.locked(self._name):
+            self.add_score(member, delta)
+            return self.rank(member)
+
+    def add_score_and_get_rev_rank(self, member, delta: float) -> Optional[int]:
+        with self._engine.locked(self._name):
+            self.add_score(member, delta)
+            return self.rev_rank(member)
+
+    def first_entry(self) -> Optional[Tuple[Any, float]]:
+        """(member, score) of the lowest-scored member (firstEntry)."""
+        entries = self.entry_range(0, 0)
+        return entries[0] if entries else None
+
+    def last_entry(self) -> Optional[Tuple[Any, float]]:
+        entries = self.entry_range(-1, -1)
+        return entries[0] if entries else None
+
+    def rank_entry(self, member) -> Optional[Tuple[int, float]]:
+        """(rank, score) in one locked read (rankEntry)."""
+        with self._engine.locked(self._name):
+            r = self.rank(member)
+            return None if r is None else (r, self.get_score(member))
+
+    def rev_rank_entry(self, member) -> Optional[Tuple[int, float]]:
+        with self._engine.locked(self._name):
+            r = self.rev_rank(member)
+            return None if r is None else (r, self.get_score(member))
+
     def add_if_absent(self, score: float, member) -> bool:
         """ZADD NX."""
         e = self._e(member)

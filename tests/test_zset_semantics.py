@@ -260,3 +260,48 @@ class TestIteration:
         assert z.get_score("m3b") == 3.0
         assert z.get_score("m3") is None
         assert z.replace("absent", "x") is False
+
+
+class TestBulkConditionalAdds:
+    """addAllIfAbsent/Exist/Greater/Less + entry helpers (round-4 RScored
+    SortedSet interface diff)."""
+
+    def test_add_all_if_absent(self, client):
+        z = fresh(client, "bulknx")
+        z.add(1.0, "kept")
+        assert z.add_all_if_absent({"kept": 99.0, "new1": 2.0, "new2": 3.0}) == 2
+        assert z.get_score("kept") == 1.0  # NX: untouched
+        assert z.get_score("new1") == 2.0
+
+    def test_add_all_if_exist(self, client):
+        z = fresh(client, "bulkxx")
+        z.add(1.0, "a")
+        z.add(2.0, "b")
+        assert z.add_all_if_exist({"a": 9.0, "b": 2.0, "ghost": 5.0}) == 1
+        assert z.get_score("a") == 9.0
+        assert z.get_score("b") == 2.0    # unchanged score: not counted
+        assert z.get_score("ghost") is None  # XX: never created
+
+    def test_add_all_if_greater_less(self, client):
+        z = fresh(client, "bulkgl")
+        z.add_all({"a": 5.0, "b": 5.0})
+        assert z.add_all_if_greater({"a": 9.0, "b": 1.0, "new": 3.0}) == 2  # a raised + new added
+        assert z.get_score("a") == 9.0 and z.get_score("b") == 5.0
+        assert z.add_all_if_less({"a": 1.0, "b": 9.0}) == 1
+        assert z.get_score("a") == 1.0 and z.get_score("b") == 5.0
+
+    def test_add_score_and_get_rank(self, client):
+        z = fresh(client, "asgr")
+        z.add_all({"low": 1.0, "high": 9.0})
+        assert z.add_score_and_get_rank("mid", 5.0) == 1
+        assert z.add_score_and_get_rev_rank("mid", 10.0) == 0  # now 15: top
+
+    def test_entry_helpers(self, client):
+        z = seeded(client, "enth")
+        assert z.first_entry() == ("m1", 1.0)
+        assert z.last_entry() == ("m5", 5.0)
+        assert z.rank_entry("m3") == (2, 3.0)
+        assert z.rev_rank_entry("m3") == (2, 3.0)
+        assert z.rank_entry("ghost") is None
+        empty = fresh(client, "enthe")
+        assert empty.first_entry() is None and empty.last_entry() is None
