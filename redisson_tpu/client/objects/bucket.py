@@ -216,6 +216,15 @@ class AtomicLong(RExpirable):
             self._touch_version(rec)
             return old
 
+    def get_and_delete(self):
+        """RAtomicLong.getAndDelete: read the counter and drop the record
+        atomically (a later read restarts from zero)."""
+        with self._engine.locked(self._name):
+            rec = self._engine.store.get(self._name)
+            old = self._zero if rec is None else rec.host["v"]
+            self._engine.store.delete(self._name)
+            return old
+
 
 class AtomicDouble(AtomicLong):
     """RAtomicDouble (INCRBYFLOAT family)."""

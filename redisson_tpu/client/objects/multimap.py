@@ -103,6 +103,16 @@ class _BaseMultimap(RExpirable):
             self._touch_version(rec)
             return True
 
+    def replace_values(self, key, values) -> List:
+        """RListMultimap.replaceValues: swap the key's whole value
+        collection atomically; returns the PREVIOUS values (empty values
+        clears the key, matching the reference)."""
+        with self._engine.locked(self._name):
+            old = self.remove_all(key)
+            for v in values:
+                self.put(key, v)
+            return old
+
     def remove_all(self, key) -> List:
         """Drops the key; returns its values (RMultimap.removeAll)."""
         with self._engine.locked(self._name):

@@ -239,3 +239,32 @@ class TestJsonBucket:
         assert sorted(jb.object_keys("$")) == ["a", "b"]
         assert jb.object_size("$") == 2
         assert jb.type("$.a") in ("integer", "number", "int")
+
+
+class TestInterfaceDiffTail:
+    """Round-4 API-diff tail: AtomicLong.getAndDelete,
+    RMultimap.replaceValues."""
+
+    def test_atomic_long_get_and_delete(self, client):
+        al = client.get_atomic_long(nm("gad"))
+        al.set(42)
+        assert al.get_and_delete() == 42
+        assert al.get() == 0          # record gone: fresh zero
+        assert al.get_and_delete() == 0  # absent: zero, no error
+
+    def test_multimap_replace_values(self, client):
+        mm = client.get_list_multimap(nm("repl"))
+        mm.put_all("k", ["a", "b"])
+        assert mm.replace_values("k", ["x", "y", "z"]) == ["a", "b"]
+        assert mm.get_all("k") == ["x", "y", "z"]
+        assert mm.replace_values("k", []) == ["x", "y", "z"]
+        assert mm.get_all("k") == []
+        assert mm.replace_values("fresh", ["n"]) == []
+        assert mm.get_all("fresh") == ["n"]
+
+    def test_set_multimap_replace_values_dedupes(self, client):
+        mm = client.get_set_multimap(nm("repls"))
+        mm.put("k", "old")
+        old = mm.replace_values("k", ["v", "v", "w"])
+        assert old == ["old"]
+        assert sorted(mm.get_all("k")) == ["v", "w"]
