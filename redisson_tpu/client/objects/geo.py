@@ -91,6 +91,47 @@ class Geo(RExpirable):
     def add_all(self, entries: Dict[Any, Tuple[float, float]]) -> int:
         return sum(self.add(lon, lat, m) for m, (lon, lat) in entries.items())
 
+    def add_if_exists(self, lon: float, lat: float, member) -> bool:
+        """GEOADD XX (RGeo.addIfExists): update an existing member's
+        position only; returns True when the position CHANGED."""
+        if not (-180 <= lon <= 180 and -85.05112878 <= lat <= 85.05112878):
+            raise ValueError(f"invalid longitude/latitude ({lon}, {lat})")
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            e = self._e(member)
+            old = rec.host.get(e)
+            if old is None:
+                return False
+            new = (float(lon), float(lat))
+            if old == new:
+                return False
+            rec.host[e] = new
+            self._touch_version(rec)
+            return True
+
+    def try_add(self, lon: float, lat: float, member) -> bool:
+        """GEOADD NX (RGeo.tryAdd): add only when ABSENT."""
+        if not (-180 <= lon <= 180 and -85.05112878 <= lat <= 85.05112878):
+            raise ValueError(f"invalid longitude/latitude ({lon}, {lat})")
+        with self._engine.locked(self._name):
+            rec = self._rec_or_create()
+            e = self._e(member)
+            if e in rec.host:
+                return False
+            rec.host[e] = (float(lon), float(lat))
+            self._touch_version(rec)
+            return True
+
+    def search_with_position(
+        self, lon: float, lat: float, radius: float, unit: str = "m",
+        count=None, order: str = "ASC",
+    ) -> Dict[Any, Tuple[float, float]]:
+        """GEOSEARCH ... WITHCOORD (RGeo.searchWithPosition): member ->
+        (lon, lat), nearest-first."""
+        members = self.search_radius(lon, lat, radius, unit=unit, count=count, order=order)
+        positions = self.pos(*members)
+        return {m: positions[m] for m in members if positions.get(m) is not None}
+
     def remove(self, member) -> bool:
         with self._engine.locked(self._name):
             rec = self._rec_or_create()

@@ -225,3 +225,29 @@ class TestStream:
         s.set_group_id("g", "0")  # rewind
         rows = s.read_group("g", "c", count=5)
         assert list(rows) == ids
+
+
+class TestGeoConditionalAdds:
+    """RGeo.tryAdd (NX), addIfExists (XX), searchWithPosition."""
+
+    def test_try_add_nx(self, client):
+        g = client.get_geo(nm("nx"))
+        assert g.try_add(*PALERMO, "Palermo") is True
+        assert g.try_add(10.0, 40.0, "Palermo") is False  # NX: untouched
+        assert abs(g.pos("Palermo")["Palermo"][0] - PALERMO[0]) < 1e-4
+
+    def test_add_if_exists_xx(self, client):
+        g = client.get_geo(nm("xx"))
+        assert g.add_if_exists(*PALERMO, "ghost") is False  # absent: no-op
+        assert g.pos("ghost").get("ghost") is None
+        g.add(*PALERMO, "city")
+        assert g.add_if_exists(*CATANIA, "city") is True
+        assert abs(g.pos("city")["city"][0] - CATANIA[0]) < 1e-4
+        assert g.add_if_exists(*CATANIA, "city") is False  # unchanged
+
+    def test_search_with_position(self, client):
+        g = geo2(client, "swp")
+        got = g.search_with_position(15.0, 37.0, 300, unit="km")
+        assert set(got) == {"Palermo", "Catania"}
+        assert abs(got["Catania"][1] - CATANIA[1]) < 1e-4
+        assert list(got)[0] == "Catania"  # nearest first
