@@ -1,0 +1,429 @@
+"""JSR-107 depth: CacheLoader/CacheWriter through-paths, entry listeners,
+statistics (VERDICT r4 missing #1).
+
+Parity seams: jcache/JCache.java:77-104 (loader/writer wiring),
+:406-421/:1117-1160 (read-through + loadAll), :1257-1290 (write-through
+ordering), :3154-3312 (listener registration), :1811-1845 (removeAll events)
+and the JSR-107 TCK semantics they implement.
+"""
+import time
+
+import pytest
+
+from redisson_tpu.client.jcache import (
+    CacheConfig,
+    CacheEntryListenerConfiguration,
+    CacheLoader,
+    CacheLoaderException,
+    CacheWriter,
+    CacheWriterException,
+    ExpiryPolicy,
+)
+from redisson_tpu.client.redisson import RedissonTpu
+
+
+@pytest.fixture()
+def client():
+    c = RedissonTpu.create()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture()
+def cm(client):
+    return client.get_cache_manager()
+
+
+class DictLoader(CacheLoader):
+    def __init__(self, backing):
+        self.backing = backing
+        self.loads = []
+
+    def load(self, key):
+        self.loads.append(key)
+        return self.backing.get(key)
+
+
+class RecordingWriter(CacheWriter):
+    def __init__(self, fail_on=()):
+        self.store = {}
+        self.ops = []
+        self.fail_on = set(fail_on)
+
+    def write(self, key, value):
+        if key in self.fail_on:
+            raise IOError(f"backing store down for {key}")
+        self.ops.append(("write", key, value))
+        self.store[key] = value
+
+    def delete(self, key):
+        if key in self.fail_on:
+            raise IOError(f"backing store down for {key}")
+        self.ops.append(("delete", key))
+        self.store.pop(key, None)
+
+
+class Recorder:
+    """Entry listener that records every event it sees."""
+
+    def __init__(self):
+        self.events = []
+
+    def _rec(self, ev):
+        self.events.append((ev.event_type, ev.key, ev.value, ev.old_value))
+
+    on_created = on_updated = on_removed = on_expired = _rec
+
+    def wait_for(self, n, timeout=3.0):
+        deadline = time.time() + timeout
+        while len(self.events) < n and time.time() < deadline:
+            time.sleep(0.01)
+        return self.events
+
+
+# -- read-through ------------------------------------------------------------
+
+
+def test_read_through_fills_miss(cm):
+    loader = DictLoader({"a": 1, "b": 2})
+    cache = cm.create_cache("rt1", CacheConfig(loader=loader, read_through=True))
+    assert cache.get("a") == 1
+    assert loader.loads == ["a"]
+    # second get is a cache hit: the loader is not consulted again
+    assert cache.get("a") == 1
+    assert loader.loads == ["a"]
+    # a key the loader doesn't know stays a miss and is not cached
+    assert cache.get("zz") is None
+    assert not cache.contains_key("zz")
+
+
+def test_read_through_miss_still_counts_as_miss(cm):
+    loader = DictLoader({"a": 1})
+    cache = cm.create_cache("rt2", CacheConfig(loader=loader, read_through=True))
+    cache.get("a")
+    assert cache.statistics.misses == 1
+    cache.get("a")
+    assert cache.statistics.hits == 1
+
+
+def test_read_through_disabled_without_flag(cm):
+    loader = DictLoader({"a": 1})
+    cache = cm.create_cache("rt3", CacheConfig(loader=loader, read_through=False))
+    assert cache.get("a") is None
+    assert loader.loads == []
+
+
+def test_get_all_bulk_read_through(cm):
+    loader = DictLoader({"a": 1, "b": 2, "c": 3})
+    cache = cm.create_cache("rt4", CacheConfig(loader=loader, read_through=True))
+    cache.put("a", 10)  # present entries are NOT reloaded
+    got = cache.get_all(["a", "b", "c", "zz"])
+    assert got == {"a": 10, "b": 2, "c": 3}
+    assert sorted(loader.loads) == ["b", "c", "zz"]
+
+
+def test_load_all_warms_cache(cm):
+    loader = DictLoader({"a": 1, "b": 2})
+    cache = cm.create_cache("rt5", CacheConfig(loader=loader, read_through=True))
+    done = []
+    cache.load_all(["a", "b"], completion_listener=done.append)
+    assert done == [None]
+    loader.loads.clear()
+    assert cache.get("a") == 1 and cache.get("b") == 2
+    assert loader.loads == []  # both were pre-warmed
+
+
+def test_load_all_replace_existing(cm):
+    loader = DictLoader({"a": 99})
+    cache = cm.create_cache("rt6", CacheConfig(loader=loader, read_through=True))
+    cache.put("a", 1)
+    cache.load_all(["a"], replace_existing=False)
+    assert cache.get("a") == 1
+    cache.load_all(["a"], replace_existing=True)
+    assert cache.get("a") == 99
+
+
+def test_loader_failure_wraps(cm):
+    class Boom(CacheLoader):
+        def load(self, key):
+            raise RuntimeError("db down")
+
+    cache = cm.create_cache("rt7", CacheConfig(loader=Boom(), read_through=True))
+    with pytest.raises(CacheLoaderException):
+        cache.get("a")
+    errs = []
+    cache.load_all(["a"], completion_listener=errs.append)
+    assert isinstance(errs[0], CacheLoaderException)
+
+
+def test_invoke_read_through(cm):
+    loader = DictLoader({"a": 5})
+    cache = cm.create_cache("rt8", CacheConfig(loader=loader, read_through=True))
+
+    def bump(entry):
+        entry.set_value((entry.value or 0) + 1)
+        return entry.value
+
+    assert cache.invoke("a", bump) == 6
+    assert cache.get("a") == 6
+    assert loader.loads == ["a"]
+
+
+def test_invoke_read_only_load_populates(cm):
+    loader = DictLoader({"a": 5})
+    cache = cm.create_cache("rt9", CacheConfig(loader=loader, read_through=True))
+    assert cache.invoke("a", lambda e: e.value) == 5
+    loader.loads.clear()
+    assert cache.get("a") == 5  # populated by the processor's read
+    assert loader.loads == []
+
+
+# -- write-through -----------------------------------------------------------
+
+
+def test_write_through_put_remove(cm):
+    w = RecordingWriter()
+    cache = cm.create_cache("wt1", CacheConfig(writer=w, write_through=True))
+    cache.put("a", 1)
+    assert w.store == {"a": 1}
+    cache.get_and_put("a", 2)
+    assert w.store == {"a": 2}
+    cache.remove("a")
+    assert w.store == {}
+    assert [op[0] for op in w.ops] == ["write", "write", "delete"]
+
+
+def test_write_through_failure_leaves_cache_unchanged(cm):
+    w = RecordingWriter(fail_on={"bad"})
+    cache = cm.create_cache("wt2", CacheConfig(writer=w, write_through=True))
+    with pytest.raises(CacheWriterException):
+        cache.put("bad", 1)
+    assert not cache.contains_key("bad")
+    cache.put("good", 1)
+    w.fail_on.add("good")
+    with pytest.raises(CacheWriterException):
+        cache.remove("good")
+    assert cache.get("good") == 1  # delete failed -> entry retained
+
+
+def test_write_through_put_all_atomic(cm):
+    w = RecordingWriter(fail_on={"b"})
+    cache = cm.create_cache("wt3", CacheConfig(writer=w, write_through=True))
+    with pytest.raises(CacheWriterException):
+        cache.put_all({"a": 1, "b": 2})
+    assert not cache.contains_key("a") and not cache.contains_key("b")
+    w.fail_on.clear()
+    cache.put_all({"a": 1, "b": 2})
+    assert cache.get("a") == 1 and w.store == {"a": 1, "b": 2}
+
+
+def test_write_through_conditional_ops(cm):
+    w = RecordingWriter()
+    cache = cm.create_cache("wt4", CacheConfig(writer=w, write_through=True))
+    assert cache.put_if_absent("a", 1) is True
+    assert w.store == {"a": 1}
+    # losing conditional ops must NOT reach the writer
+    assert cache.put_if_absent("a", 9) is False
+    assert cache.replace("zz", 9) is False
+    assert cache.remove("a", 999) is False
+    assert w.store == {"a": 1}
+    assert cache.replace("a", 2) is True
+    assert w.store == {"a": 2}
+    assert cache.replace("a", 3, old_value=2) is True
+    assert w.store == {"a": 3}
+    assert cache.get_and_replace("a", 4) == 3
+    assert w.store == {"a": 4}
+    assert cache.remove("a", 4) is True
+    assert w.store == {}
+
+
+def test_write_through_remove_all_and_invoke(cm):
+    w = RecordingWriter()
+    cache = cm.create_cache("wt5", CacheConfig(writer=w, write_through=True))
+    cache.put_all({"a": 1, "b": 2, "c": 3})
+    cache.remove_all(["a", "b"])
+    assert w.store == {"c": 3}
+
+    def wipe(entry):
+        entry.remove()
+
+    cache.invoke("c", wipe)
+    assert w.store == {}
+    cache.invoke("d", lambda e: e.set_value(7))
+    assert w.store == {"d": 7}
+
+
+def test_clear_skips_writer_and_events(cm):
+    w = RecordingWriter()
+    rec = Recorder()
+    cache = cm.create_cache(
+        "wt6",
+        CacheConfig(
+            writer=w,
+            write_through=True,
+            listener_configurations=[
+                CacheEntryListenerConfiguration(rec, synchronous=True)
+            ],
+        ),
+    )
+    cache.put("a", 1)
+    n_events = len(rec.events)
+    w.ops.clear()
+    cache.clear()
+    assert not cache.contains_key("a")
+    assert w.ops == []           # writer not consulted
+    assert len(rec.events) == n_events  # no removed events from clear()
+    assert w.store == {"a": 1}   # backing store untouched by clear
+
+
+def test_invoke_remove_after_load_deletes_backing_row(cm):
+    """entry.remove() after a read-through load must still writer.delete the
+    external row, even though the entry never lived in the cache."""
+    loader = DictLoader({"a": 5})
+    w = RecordingWriter()
+    w.store["a"] = 5
+    cache = cm.create_cache(
+        "wt7",
+        CacheConfig(loader=loader, writer=w, read_through=True, write_through=True),
+    )
+
+    def read_then_remove(entry):
+        _ = entry.value  # triggers the load
+        entry.remove()
+
+    cache.invoke("a", read_then_remove)
+    assert w.store == {}
+    assert not cache.contains_key("a")
+
+
+# -- entry listeners ---------------------------------------------------------
+
+
+def test_sync_listener_created_updated_removed(cm):
+    rec = Recorder()
+    lc = CacheEntryListenerConfiguration(rec, old_value_required=True, synchronous=True)
+    cache = cm.create_cache("el1", CacheConfig(listener_configurations=[lc]))
+    cache.put("a", 1)
+    cache.put("a", 2)
+    cache.remove("a")
+    assert rec.events == [
+        ("created", "a", 1, None),
+        ("updated", "a", 2, 1),
+        ("removed", "a", 2, 2),  # removed event carries the removed value
+    ]
+
+
+def test_old_value_not_required_strips_old(cm):
+    rec = Recorder()
+    lc = CacheEntryListenerConfiguration(rec, old_value_required=False, synchronous=True)
+    cache = cm.create_cache("el2", CacheConfig(listener_configurations=[lc]))
+    cache.put("a", 1)
+    cache.put("a", 2)
+    assert rec.events[1] == ("updated", "a", 2, None)
+
+
+def test_listener_filter(cm):
+    rec = Recorder()
+    lc = CacheEntryListenerConfiguration(
+        rec, filter=lambda ev: ev.key != "skip", synchronous=True
+    )
+    cache = cm.create_cache("el3", CacheConfig(listener_configurations=[lc]))
+    cache.put("skip", 1)
+    cache.put("keep", 2)
+    assert rec.events == [("created", "keep", 2, None)]
+
+
+def test_async_listener_delivery(cm):
+    rec = Recorder()
+    lc = CacheEntryListenerConfiguration(rec, synchronous=False)
+    cache = cm.create_cache("el4", CacheConfig(listener_configurations=[lc]))
+    cache.put("a", 1)
+    cache.remove("a")
+    evs = rec.wait_for(2)
+    assert [e[0] for e in evs] == ["created", "removed"]
+
+
+def test_expired_event_reaches_listener(cm):
+    rec = Recorder()
+    lc = CacheEntryListenerConfiguration(rec, synchronous=True)
+    cache = cm.create_cache(
+        "el5",
+        CacheConfig(expiry=ExpiryPolicy.created(0.1), listener_configurations=[lc]),
+    )
+    cache.put("a", 1)
+    time.sleep(0.15)
+    assert cache.get("a") is None  # lazy reap fires the expiry
+    evs = rec.wait_for(2)
+    assert ("expired", "a", 1, None) in evs
+    assert cache.statistics.evictions >= 1
+
+
+def test_register_deregister_listener(cm):
+    rec = Recorder()
+    cache = cm.create_cache("el6", CacheConfig())
+    lc = CacheEntryListenerConfiguration(rec, synchronous=True)
+    cache.register_cache_entry_listener(lc)
+    with pytest.raises(ValueError):
+        cache.register_cache_entry_listener(lc)  # duplicate registration
+    cache.put("a", 1)
+    cache.deregister_cache_entry_listener(lc)
+    cache.put("b", 2)
+    assert rec.events == [("created", "a", 1, None)]
+
+
+def test_remove_all_fires_removed_events(cm):
+    rec = Recorder()
+    lc = CacheEntryListenerConfiguration(rec, old_value_required=True, synchronous=True)
+    cache = cm.create_cache("el7", CacheConfig(listener_configurations=[lc]))
+    cache.put_all({"a": 1, "b": 2})
+    rec.events.clear()
+    cache.remove_all()
+    assert sorted(rec.events) == [("removed", "a", 1, 1), ("removed", "b", 2, 2)]
+
+
+def test_sync_listener_error_propagates(cm):
+    class Angry:
+        def on_created(self, ev):
+            raise RuntimeError("listener veto")
+
+    lc = CacheEntryListenerConfiguration(Angry(), synchronous=True)
+    cache = cm.create_cache("el8", CacheConfig(listener_configurations=[lc]))
+    with pytest.raises(RuntimeError):
+        cache.put("a", 1)
+    # the mutation itself happened before notification (post-event semantics)
+    assert cache.get("a") == 1
+
+
+# -- statistics --------------------------------------------------------------
+
+
+def test_statistics_counters(cm):
+    cache = cm.create_cache("st1", CacheConfig())
+    cache.put("a", 1)
+    cache.get("a")
+    cache.get("zz")
+    cache.remove("a")
+    st = cache.statistics
+    assert (st.puts, st.hits, st.misses, st.removals) == (1, 1, 1, 1)
+    assert st.gets == 2
+    assert st.hit_ratio == 0.5 and st.miss_ratio == 0.5
+    assert st.average_get_time_us > 0
+    assert st.average_put_time_us > 0
+    assert st.average_remove_time_us > 0
+    st.clear()
+    assert st.gets == 0 and st.average_get_time_us == 0.0
+
+
+def test_statistics_disabled(cm):
+    cache = cm.create_cache("st2", CacheConfig(statistics_enabled=False))
+    cache.put("a", 1)
+    cache.get("a")
+    assert cache.statistics.gets == 0 and cache.statistics.puts == 0
+
+
+def test_invoke_all(cm):
+    cache = cm.create_cache("ia1", CacheConfig())
+    cache.put_all({"a": 1, "b": 2})
+    out = cache.invoke_all(["a", "b"], lambda e: (e.set_value(e.value * 10), e.value)[1])
+    assert out == {"a": 10, "b": 20}
+    assert cache.get("a") == 10 and cache.get("b") == 20
