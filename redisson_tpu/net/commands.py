@@ -43,7 +43,7 @@ SPECS: dict = {}
 # keyless / administrative (never redirected)
 _spec(SPECS, "PING ECHO AUTH HELLO SELECT CLIENT QUIT DBSIZE TIME INFO MEMORY "
              "CLUSTER KEYS SAVE ROLE REPLICAOF REPLREGISTER "
-             "REPLPUSH REPLFLUSH REPLSNAPSHOT REPLICAS SUBSCRIBE UNSUBSCRIBE "
+             "REPLPUSH REPLPUSHSEG REPLFLUSH REPLSNAPSHOT REPLICAS SUBSCRIBE UNSUBSCRIBE "
              "PSUBSCRIBE PUNSUBSCRIBE PUBLISH METRICS ASKING", False, None)
 
 # keyless but state-mutating: a replica must refuse these (REPLPUSH is the

@@ -1308,6 +1308,33 @@ def cmd_replpush(server, ctx, args):
     return replication.apply_records(server.engine, bytes(args[0]))
 
 
+@register("REPLPUSHSEG")
+def cmd_replpushseg(server, ctx, args):
+    """REPLPUSHSEG <xfer_id> <seq> <nsegs> <chunk> — one bounded slice of an
+    oversized REPLPUSH blob (a 10M-key bloom plane is ~95MB; a single
+    sendall of that stalls past socket timeouts, server/replication.py
+    SEGMENT_BYTES).  The final slice reassembles and applies the blob;
+    intermediates stage host-side and answer +OK."""
+    from redisson_tpu.server import replication
+
+    xfer_id, seq, nsegs = _s(args[0]), _int(args[1]), _int(args[2])
+    chunk = bytes(args[3])
+    xfers = server.__dict__.setdefault("_repl_xfers", {})
+    if seq == 0:
+        xfers[xfer_id] = [None] * nsegs
+        # a lost transfer must not leak staging forever: keep at most 4
+        while len(xfers) > 4:
+            xfers.pop(next(iter(xfers)))
+    slots = xfers.get(xfer_id)
+    if slots is None or len(slots) != nsegs or not (0 <= seq < nsegs):
+        raise RespError(f"ERR unknown replication transfer {xfer_id}/{seq}")
+    slots[seq] = chunk
+    if any(s is None for s in slots):
+        return "+OK"
+    del xfers[xfer_id]
+    return replication.apply_records(server.engine, b"".join(slots))
+
+
 @register("REPLFLUSH")
 def cmd_replflush(server, ctx, args):
     """Ship dirty records to all replicas NOW (WAIT / syncSlaves analog)."""
