@@ -200,4530 +200,8 @@ def _int(b) -> int:
         raise RespError("ERR value is not an integer or out of range")
 
 
-# -- connection handshake (BaseConnectionHandler.java:59-122 parity) ---------
 
-@register("PING")
-def cmd_ping(server, ctx, args):
-    if args:
-        return args[0]
-    return "+PONG"
-
-
-@register("ECHO")
-def cmd_echo(server, ctx, args):
-    return args[0]
-
-
-@register("AUTH")
-def cmd_auth(server, ctx, args):
-    """AUTH <password> | AUTH <username> <password> — the ACL form matches
-    the reference handshake (BaseConnectionHandler.java:59-122 sends
-    username+password when a username is configured).  "default" aliases
-    the server-level password, like Redis ACL's default user."""
-    if len(args) >= 2:
-        username, password = _s(args[-2]), _s(args[-1])
-    else:
-        username, password = "default", _s(args[-1])
-    if username == "default":
-        # with ACL users configured but NO default password, the default
-        # user is DISABLED — `AUTH anything` must not bypass the user gate
-        if server.password is not None:
-            ok = password == server.password
-        else:
-            ok = not server.users
-    else:
-        expected = server.users.get(username)
-        ok = expected is not None and password == expected
-    if ok:
-        ctx.authenticated = True
-        ctx.username = username
-        return "+OK"
-    raise RespError("WRONGPASS invalid username-password pair")
-
-
-@register("HELLO")
-def cmd_hello(server, ctx, args):
-    """HELLO [protover [AUTH user pass]] — the real protocol switch
-    (config/Config.java:57-99 protocol knob; CommandDecoder.java markers).
-    This wire is RESP3-native by default; HELLO 2 downgrades the connection
-    to the strict RESP2 projection (maps flatten, pushes become arrays)."""
-    i = 0
-    if args and bytes(args[0]).isdigit():
-        ver = _int(args[0])
-        if ver not in (2, 3):
-            raise RespError("NOPROTO unsupported protocol version")
-        ctx.proto = ver
-        i = 1
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"AUTH" and i + 2 < len(args):
-            cmd_auth(server, ctx, [args[i + 1], args[i + 2]])
-            i += 3
-        elif opt == b"SETNAME" and i + 1 < len(args):
-            ctx.name = _s(args[i + 1])
-            i += 2
-        else:
-            raise RespError(f"ERR unknown HELLO option '{_s(args[i])}'")
-    return {
-        b"server": b"redisson-tpu",
-        b"version": VERSION.encode(),
-        b"proto": ctx.proto,
-        b"id": server.next_client_id(),
-        b"mode": server.mode.encode(),
-        b"role": b"master" if server.role == "master" else b"replica",
-    }
-
-
-@register("SELECT")
-def cmd_select(server, ctx, args):
-    _int(args[0])  # single logical db: accept and ignore, like db 0 only
-    return "+OK"
-
-
-@register("CLIENT")
-def cmd_client(server, ctx, args):
-    sub = bytes(args[0]).upper() if args else b""
-    if sub == b"SETNAME":
-        ctx.name = _s(args[1])
-        return "+OK"
-    if sub == b"GETNAME":
-        return ctx.name.encode() if ctx.name else b""
-    if sub == b"ID":
-        return server.next_client_id()
-    return "+OK"
-
-
-@register("QUIT")
-def cmd_quit(server, ctx, args):
-    raise ConnectionResetError("client quit")
-
-
-# -- keyspace admin (RedissonKeys surface) -----------------------------------
-
-@register("KEYS")
-def cmd_keys(server, ctx, args):
-    pattern = _s(args[0]) if args else "*"
-    return [k.encode() for k in server.engine.store.keys(pattern)]
-
-
-@register("DBSIZE")
-def cmd_dbsize(server, ctx, args):
-    return len(server.engine.store)
-
-
-@register("DEL")
-def cmd_del(server, ctx, args):
-    # Record lock per key: a DEL racing a slot drain must serialize against
-    # the in-flight ship (server.py migrate_slot_batch) or the acked delete
-    # resurrects from the migrated copy when the slot finalizes.
-    def _del(k: str) -> bool:
-        with server.engine.locked(k):
-            return server.engine.store.delete(k)
-
-    return sum(1 for k in args if _del(_s(k)))
-
-
-@register("UNLINK")
-def cmd_unlink(server, ctx, args):
-    return cmd_del(server, ctx, args)
-
-
-@register("EXISTS")
-def cmd_exists(server, ctx, args):
-    return sum(1 for k in args if server.engine.store.exists(_s(k)))
-
-
-def _expire_locked(server, name: str, at) -> int:
-    # Same record-lock discipline as DEL: a TTL change racing a slot drain
-    # must serialize against the in-flight ship or it silently vanishes.
-    with server.engine.locked(name):
-        return 1 if server.engine.store.expire(name, at) else 0
-
-
-@register("EXPIRE")
-def cmd_expire(server, ctx, args):
-    return _expire_locked(server, _s(args[0]), time.time() + _int(args[1]))
-
-
-@register("PEXPIRE")
-def cmd_pexpire(server, ctx, args):
-    return _expire_locked(server, _s(args[0]), time.time() + _int(args[1]) / 1000.0)
-
-
-@register("PERSIST")
-def cmd_persist(server, ctx, args):
-    return _expire_locked(server, _s(args[0]), None)
-
-
-@register("TTL")
-def cmd_ttl(server, ctx, args):
-    name = _s(args[0])
-    if not server.engine.store.exists(name):
-        return -2
-    ttl = server.engine.store.ttl(name)
-    return -1 if ttl is None else int(ttl)
-
-
-@register("PTTL")
-def cmd_pttl(server, ctx, args):
-    name = _s(args[0])
-    if not server.engine.store.exists(name):
-        return -2
-    ttl = server.engine.store.ttl(name)
-    return -1 if ttl is None else int(ttl * 1000)
-
-
-@register("RENAME")
-def cmd_rename(server, ctx, args):
-    src, dst = _s(args[0]), _s(args[1])
-    with server.engine.locked_many([src, dst]):
-        if not server.engine.store.rename(src, dst):
-            raise RespError("ERR no such key")
-    return "+OK"
-
-
-@register("FLUSHALL")
-def cmd_flushall(server, ctx, args):
-    server.engine.store.flushall()
-    return "+OK"
-
-
-@register("TYPE")
-def cmd_type(server, ctx, args):
-    rec = server.engine.store.get(_s(args[0]))
-    return ("+" + (rec.kind if rec else "none"))
-
-
-# -- strings / buckets --------------------------------------------------------
-
-def _bucket(server, name: str):
-    from redisson_tpu.client.objects.bucket import Bucket
-    from redisson_tpu.client.codec import BytesCodec
-
-    return Bucket(server.engine, name, BytesCodec())
-
-
-@register("GET")
-def cmd_get(server, ctx, args):
-    return _bucket(server, _s(args[0])).get()
-
-
-@register("SET")
-def cmd_set(server, ctx, args):
-    name = _s(args[0])
-    value = bytes(args[1])
-    px: Optional[float] = None
-    nx = xx = False
-    i = 2
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"PX":
-            px = _int(args[i + 1]) / 1000.0
-            i += 2
-        elif opt == b"EX":
-            px = float(_int(args[i + 1]))
-            i += 2
-        elif opt == b"NX":
-            nx = True
-            i += 1
-        elif opt == b"XX":
-            xx = True
-            i += 1
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    b = _bucket(server, name)
-    if nx:
-        if not b.try_set(value, ttl=px):
-            return None
-    elif xx:
-        with server.engine.locked(name):
-            if not b.set_if_exists(value):
-                return None
-            if px is not None:
-                server.engine.store.expire(name, time.time() + px)
-    else:
-        b.set(value, ttl=px)
-    return "+OK"
-
-
-@register("INCR")
-def cmd_incr(server, ctx, args):
-    from redisson_tpu.client.objects.bucket import AtomicLong
-
-    return AtomicLong(server.engine, _s(args[0])).increment_and_get()
-
-
-@register("INCRBY")
-def cmd_incrby(server, ctx, args):
-    from redisson_tpu.client.objects.bucket import AtomicLong
-
-    return AtomicLong(server.engine, _s(args[0])).add_and_get(_int(args[1]))
-
-
-@register("DECR")
-def cmd_decr(server, ctx, args):
-    from redisson_tpu.client.objects.bucket import AtomicLong
-
-    return AtomicLong(server.engine, _s(args[0])).decrement_and_get()
-
-
-# -- bits (RBitSet surface; batched forms are primary) ------------------------
-
-def _bitset(server, name: str):
-    from redisson_tpu.client.objects.bitset import BitSet
-
-    return BitSet(server.engine, name)
-
-
-@register("SETBIT")
-def cmd_setbit(server, ctx, args):
-    old = _bitset(server, _s(args[0])).set(_int(args[1]), bool(_int(args[2])))
-    return 1 if old else 0
-
-
-@register("GETBIT")
-def cmd_getbit(server, ctx, args):
-    return 1 if _bitset(server, _s(args[0])).get(_int(args[1])) else 0
-
-
-@register("BITCOUNT")
-def cmd_bitcount(server, ctx, args):
-    return _bitset(server, _s(args[0])).cardinality()
-
-
-@register("BITOP")
-def cmd_bitop(server, ctx, args):
-    from redisson_tpu.core import kernels as K
-
-    op = bytes(args[0]).upper()
-    dest = _s(args[1])
-    srcs = [_s(a) for a in args[2:]]
-    bs = _bitset(server, dest)
-    if op == b"AND":
-        bs.and_(*srcs)
-    elif op == b"OR":
-        bs.or_(*srcs)
-    elif op == b"XOR":
-        bs.xor(*srcs)
-    elif op == b"NOT":
-        bs.from_byte_array(_bitset(server, srcs[0]).to_byte_array())
-        bs.not_()
-    else:
-        raise RespError("ERR syntax error")
-    # reply = dest byte length; computed from the device WITHOUT a per-op
-    # sync (the length rides the frame's grouped transfer)
-    with server.engine.locked(dest):
-        rec = server.engine.store.get(dest)
-        if rec is None:
-            return 0
-        length_dev = K.bitset_length(rec.arrays["bits"])
-    return LazyReply(
-        device=(length_dev,),
-        finish=lambda v: (n := int(v[0])) // 8 + (1 if n % 8 else 0),
-    )
-
-
-def _bf_type(tok: bytes):
-    """u<w> (1..63) or i<w> (1..64) -> (signed, width)."""
-    t = bytes(tok)
-    if len(t) < 2 or t[:1] not in (b"u", b"i"):
-        raise RespError("ERR Invalid bitfield type. Use something like i16 u8.")
-    signed = t[:1] == b"i"
-    try:
-        width = int(t[1:])
-    except ValueError:
-        raise RespError("ERR Invalid bitfield type. Use something like i16 u8.")
-    if not 1 <= width <= (64 if signed else 63):
-        raise RespError("ERR Invalid bitfield type. Use something like i16 u8.")
-    return signed, width
-
-
-def _bf_offset(tok: bytes, width: int) -> int:
-    t = bytes(tok)
-    if t[:1] == b"#":
-        return int(t[1:]) * width
-    return int(t)
-
-
-@register("BITFIELD")
-def cmd_bitfield(server, ctx, args):
-    """BITFIELD key [GET ty off] [SET ty off v] [INCRBY ty off n]
-    [OVERFLOW WRAP|SAT|FAIL] — Redis bit-layout semantics (offset 0 is the
-    MSB of byte 0, matching GETBIT/SETBIT numbering) over the BitSet record;
-    fields read/write through the batched get_each/set_each forms so one
-    subcommand costs one indexed kernel, not w scalar ops
-    (client/protocol/RedisCommands.java BITFIELD def)."""
-    import numpy as np
-
-    bs = _bitset(server, _s(args[0]))
-    overflow = "WRAP"
-    out: List[Any] = []
-    i = 1
-
-    def read_field(signed, width, off):
-        idx = np.arange(off, off + width, dtype=np.int64)
-        nbits = bs.size()
-        bits = np.zeros(width, np.uint64)
-        in_range = idx < nbits  # bits past the plane read 0 (Redis strings)
-        if in_range.any():
-            bits[in_range] = np.asarray(bs.get_each(idx[in_range]), np.uint64)
-        val = 0
-        for b in bits:
-            val = (val << 1) | int(b)
-        if signed and width and (val >> (width - 1)) & 1:
-            val -= 1 << width
-        return val
-
-    def write_field(width, off, val):
-        mask = (1 << width) - 1
-        uval = val & mask
-        bits = np.array(
-            [(uval >> (width - 1 - k)) & 1 for k in range(width)], dtype=bool
-        )
-        idx = np.arange(off, off + width, dtype=np.int64)
-        if bits.any():
-            bs.set_each(idx[bits], True)
-        if (~bits).any():
-            bs.set_each(idx[~bits], False)
-
-    def apply_overflow(signed, width, val):
-        """-> (in-range value, failed) per OVERFLOW mode."""
-        lo = -(1 << (width - 1)) if signed else 0
-        hi = (1 << (width - 1)) - 1 if signed else (1 << width) - 1
-        if lo <= val <= hi:
-            return val, False
-        if overflow == "FAIL":
-            return 0, True
-        if overflow == "SAT":
-            return (lo if val < lo else hi), False
-        span = 1 << width  # WRAP: two's-complement modular arithmetic
-        wrapped = val % span
-        if signed and wrapped > hi:
-            wrapped -= span
-        return wrapped, False
-
-    while i < len(args):
-        op = bytes(args[i]).upper()
-        if op == b"OVERFLOW":
-            mode = bytes(args[i + 1]).upper().decode()
-            if mode not in ("WRAP", "SAT", "FAIL"):
-                raise RespError("ERR Invalid OVERFLOW type specified")
-            overflow = mode
-            i += 2
-        elif op == b"GET":
-            signed, width = _bf_type(args[i + 1])
-            off = _bf_offset(args[i + 2], width)
-            out.append(read_field(signed, width, off))
-            i += 3
-        elif op == b"SET":
-            signed, width = _bf_type(args[i + 1])
-            off = _bf_offset(args[i + 2], width)
-            new = _int(args[i + 3])
-            with server.engine.locked(_s(args[0])):
-                old = read_field(signed, width, off)
-                new, failed = apply_overflow(signed, width, new)
-                if failed:
-                    out.append(None)
-                else:
-                    write_field(width, off, new)
-                    out.append(old)
-            i += 4
-        elif op == b"INCRBY":
-            signed, width = _bf_type(args[i + 1])
-            off = _bf_offset(args[i + 2], width)
-            delta = _int(args[i + 3])
-            with server.engine.locked(_s(args[0])):
-                cur = read_field(signed, width, off)
-                new, failed = apply_overflow(signed, width, cur + delta)
-                if failed:
-                    out.append(None)
-                else:
-                    write_field(width, off, new)
-                    out.append(new)
-            i += 4
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    return out
-
-
-@register("BITFIELD_RO")
-def cmd_bitfield_ro(server, ctx, args):
-    """Read-only BITFIELD: GET subcommands only (replica-servable)."""
-    for i in range(1, len(args), 3):
-        if bytes(args[i]).upper() != b"GET":
-            raise RespError(
-                "ERR BITFIELD_RO only supports the GET subcommand"
-            )
-    return cmd_bitfield(server, ctx, args)
-
-
-# batched forms: SETBITS name idx... / GETBITS name idx... (one kernel each)
-@register("SETBITS")
-def cmd_setbits(server, ctx, args):
-    import numpy as np
-
-    idx = np.asarray([_int(a) for a in args[1:]], np.int64)
-    old, n = _bitset(server, _s(args[0])).set_each_async(idx, True)
-    return LazyReply(device=(old,), finish=lambda v: [int(x) for x in v[0][:n]])
-
-
-@register("GETBITS")
-def cmd_getbits(server, ctx, args):
-    import numpy as np
-
-    idx = np.asarray([_int(a) for a in args[1:]], np.int64)
-    got, n = _bitset(server, _s(args[0])).get_each_async(idx)
-    return LazyReply(device=(got,), finish=lambda v: [int(x) for x in v[0][:n]])
-
-
-# blob forms: indexes travel as ONE little-endian i32 buffer and previous
-# bit values return as ONE byte blob — RESP integer encode/parse for
-# thousands of per-bit args is pure overhead at batch sizes (bytes on the
-# wire are the cost that matters through the tunnel)
-@register("SETBITSB")
-def cmd_setbitsb(server, ctx, args):
-    import numpy as np
-
-    idx = np.frombuffer(bytes(args[1]), dtype="<i4").astype(np.int64)
-    old, n = _bitset(server, _s(args[0])).set_each_async(idx, True)
-    return LazyReply(
-        device=(old,), finish=lambda v: np.asarray(v[0][:n], np.uint8).tobytes()
-    )
-
-
-@register("GETBITSB")
-def cmd_getbitsb(server, ctx, args):
-    import numpy as np
-
-    idx = np.frombuffer(bytes(args[1]), dtype="<i4").astype(np.int64)
-    got, n = _bitset(server, _s(args[0])).get_each_async(idx)
-    return LazyReply(
-        device=(got,), finish=lambda v: np.asarray(v[0][:n], np.uint8).tobytes()
-    )
-
-
-# -- bloom filter (RedisBloom-compatible verbs + batch-first forms) ----------
-
-def _bloom(server, name: str):
-    from redisson_tpu.client.objects.bloom import BloomFilter
-
-    return BloomFilter(server.engine, name)
-
-
-@register("BF.RESERVE")
-def cmd_bf_reserve(server, ctx, args):
-    bf = _bloom(server, _s(args[0]))
-    error_rate = float(args[1])
-    capacity = _int(args[2])
-    if not bf.try_init(capacity, error_rate):
-        raise RespError("ERR item exists")  # RedisBloom wording
-    return "+OK"
-
-
-@register("BF.ADD")
-def cmd_bf_add(server, ctx, args):
-    bf = _bloom(server, _s(args[0]))
-    return 1 if bf.add(bytes(args[1])) else 0
-
-
-@register("BF.MADD")
-def cmd_bf_madd(server, ctx, args):
-    bf = _bloom(server, _s(args[0]))
-    newly = bf.add_each([bytes(a) for a in args[1:]])
-    return [int(v) for v in newly]
-
-
-@register("BF.EXISTS")
-def cmd_bf_exists(server, ctx, args):
-    bf = _bloom(server, _s(args[0]))
-    return 1 if bf.contains(bytes(args[1])) else 0
-
-
-@register("BF.MEXISTS")
-def cmd_bf_mexists(server, ctx, args):
-    bf = _bloom(server, _s(args[0]))
-    found = bf.contains_each([bytes(a) for a in args[1:]])
-    return [int(v) for v in found]
-
-
-@register("BF.INFO")
-def cmd_bf_info(server, ctx, args):
-    bf = _bloom(server, _s(args[0]))
-    rec = server.engine.store.get(bf.name)
-    if rec is None:
-        raise RespError("ERR not found")
-    return [
-        b"Capacity", rec.meta.get("expected_insertions", 0),
-        b"Size", rec.meta["m"],
-        b"Number of hashes", rec.meta["k"],
-        b"Number of items inserted", bf.count(),
-    ]
-
-
-# Binary batch forms — the remote RBatch hot path (BASELINE north star):
-# one command carries the whole key batch as a little-endian int64 blob, the
-# reply is a 0/1 byte per key.  This is the wire shape of "one fused kernel
-# dispatch per flush".
-
-@register("BF.MADD64")
-def cmd_bf_madd64(server, ctx, args):
-    import numpy as np
-
-    keys = np.frombuffer(bytes(args[1]), dtype="<i8")
-    newly, n = _bloom(server, _s(args[0])).add_each_async(keys)
-    return LazyReply(
-        device=(newly,),
-        finish=lambda v: np.asarray(v[0], np.uint8)[:n].tobytes(),
-    )
-
-
-@register("BF.MEXISTS64")
-def cmd_bf_mexists64(server, ctx, args):
-    import numpy as np
-
-    from redisson_tpu.core import kernels as K
-
-    keys = np.frombuffer(bytes(args[1]), dtype="<i8")
-    found, n = _bloom(server, _s(args[0])).contains_each_async(keys)
-
-    def finish(vals):
-        arr = vals[0]
-        if arr.dtype == np.uint32:  # packed bitmap (u64 fast path)
-            arr = K.unpack_found(arr, n)
-        return np.asarray(arr[:n], np.uint8).tobytes()
-
-    return LazyReply(device=(found,), finish=finish)
-
-
-@register("BFA.RESERVE")
-def cmd_bfa_reserve(server, ctx, args):
-    from redisson_tpu.client.objects.bloom_array import BloomFilterArray
-
-    arr = BloomFilterArray(server.engine, _s(args[0]))
-    arr.try_init(_int(args[1]), _int(args[2]), float(args[3]))
-    return "+OK"
-
-
-@register("BFA.MADD64")
-def cmd_bfa_madd64(server, ctx, args):
-    import numpy as np
-    from redisson_tpu.client.objects.bloom_array import BloomFilterArray
-
-    arr = BloomFilterArray(server.engine, _s(args[0]))
-    tenants = np.frombuffer(bytes(args[1]), dtype="<i4")
-    keys = np.frombuffer(bytes(args[2]), dtype="<i8")
-    newly, n = arr.add_each_async(tenants, keys)
-    if n == 0:
-        return b""
-    return LazyReply(
-        device=(newly,),
-        finish=lambda v: np.asarray(v[0], np.uint8)[:n].tobytes(),
-    )
-
-
-@register("BFA.MEXISTS64")
-def cmd_bfa_mexists64(server, ctx, args):
-    import numpy as np
-    from redisson_tpu.client.objects.bloom_array import BloomFilterArray
-    from redisson_tpu.core import kernels as K
-
-    arr = BloomFilterArray(server.engine, _s(args[0]))
-    tenants = np.frombuffer(bytes(args[1]), dtype="<i4")
-    keys = np.frombuffer(bytes(args[2]), dtype="<i8")
-    found, n = arr.contains_async(tenants, keys)
-    if n == 0:
-        return b""
-    return LazyReply(
-        device=(found,),
-        finish=lambda v: np.asarray(K.unpack_found(v[0], n), np.uint8).tobytes(),
-    )
-
-
-@register("PFADD64")
-def cmd_pfadd64(server, ctx, args):
-    import numpy as np
-
-    keys = np.frombuffer(bytes(args[1]), dtype="<i8")
-    return 1 if _hll(server, _s(args[0])).add_all(keys) else 0
-
-
-# -- hyperloglog BANK blob verbs (the multi-tenant sketch fast path: one
-# -- blob frame per flush, mirroring the BFA.* bloom-bank discipline) --------
-
-def _hll_array(server, name: str):
-    from redisson_tpu.client.objects.hll_array import HyperLogLogArray
-
-    return HyperLogLogArray(server.engine, name)
-
-
-@register("HLLA.RESERVE")
-def cmd_hlla_reserve(server, ctx, args):
-    """HLLA.RESERVE name tenants — idempotent init replies 0 like BFA."""
-    ok = _hll_array(server, _s(args[0])).try_init(tenants=_int(args[1]))
-    return 1 if ok else 0
-
-
-@register("HLLA.MADD64")
-def cmd_hlla_madd64(server, ctx, args):
-    """HLLA.MADD64 name <i32 tenant blob> <i64 key blob> — ONE fused
-    scatter-max dispatch for the whole flush."""
-    import numpy as np
-
-    t = np.frombuffer(bytes(args[1]), dtype="<i4")
-    k = np.frombuffer(bytes(args[2]), dtype="<i8")
-    _hll_array(server, _s(args[0])).add(t, k)
-    return "+OK"
-
-
-@register("HLLA.MERGEROWS")
-def cmd_hlla_mergerows(server, ctx, args):
-    """HLLA.MERGEROWS name <i32 dst blob> <i32 src blob> — batched pairwise
-    PFMERGE (the dense gather+max kernel)."""
-    import numpy as np
-
-    dst = np.frombuffer(bytes(args[1]), dtype="<i4")
-    src = np.frombuffer(bytes(args[2]), dtype="<i4")
-    try:
-        _hll_array(server, _s(args[0])).merge_rows(dst, src)
-    except ValueError as e:
-        raise RespError(f"ERR {e}")
-    return "+OK"
-
-
-@register("HLLA.ESTIMATE")
-def cmd_hlla_estimate(server, ctx, args):
-    """HLLA.ESTIMATE name -> <f64 blob> of per-tenant estimates."""
-    import numpy as np
-
-    est = _hll_array(server, _s(args[0])).estimate_all()
-    return np.ascontiguousarray(est, dtype="<f8").tobytes()
-
-
-@register("HLLA.ESTPAIRS")
-def cmd_hlla_estpairs(server, ctx, args):
-    """HLLA.ESTPAIRS name <i32 a blob> <i32 b blob> -> <f64 blob> of
-    per-pair union estimates (PFCOUNT a b without mutation)."""
-    import numpy as np
-
-    a = np.frombuffer(bytes(args[1]), dtype="<i4")
-    b = np.frombuffer(bytes(args[2]), dtype="<i4")
-    est = _hll_array(server, _s(args[0])).estimate_union_pairs(a, b)
-    return np.ascontiguousarray(est, dtype="<f8").tobytes()
-
-
-# -- hyperloglog (PFADD/PFCOUNT/PFMERGE parity, RedissonHyperLogLog.java) ----
-
-def _hll(server, name: str):
-    from redisson_tpu.client.objects.hyperloglog import HyperLogLog
-    from redisson_tpu.client.codec import BytesCodec
-
-    return HyperLogLog(server.engine, name, BytesCodec())
-
-
-@register("PFADD")
-def cmd_pfadd(server, ctx, args):
-    name = _s(args[0])
-    h = _hll(server, name)
-    if len(args) == 1:
-        # Redis contract: 1 only if the key was created by this call
-        with server.engine.locked(name):
-            created = not server.engine.store.exists(name)
-            h.create_if_absent()
-        return 1 if created else 0
-    return 1 if h.add_all([bytes(a) for a in args[1:]]) else 0
-
-
-@register("PFCOUNT")
-def cmd_pfcount(server, ctx, args):
-    names = [_s(a) for a in args]
-    if len(names) == 1:
-        return int(_hll(server, names[0]).count())
-    return int(_hll(server, names[0]).count_with(*names[1:]))
-
-
-@register("PFMERGE")
-def cmd_pfmerge(server, ctx, args):
-    dest = _hll(server, _s(args[0]))
-    dest.merge_with(*[_s(a) for a in args[1:]])
-    return "+OK"
-
-
-# -- pubsub ------------------------------------------------------------------
-
-@register("SUBSCRIBE")
-def cmd_subscribe(server, ctx, args):
-    out = []
-    for ch_raw in args:
-        ch = _s(ch_raw)
-        if ch not in ctx.subscriptions:
-            push = ctx.push
-
-            def listener(channel, msg, _push=push):
-                _push(Push([b"message", channel.encode(), msg if isinstance(msg, bytes) else pickle.dumps(msg)]))
-
-            ctx.subscriptions[ch] = server.engine.pubsub.subscribe(ch, listener)
-        out.append(Push([b"subscribe", ch_raw, ctx.subscription_count()]))
-    return out
-
-
-@register("UNSUBSCRIBE")
-def cmd_unsubscribe(server, ctx, args):
-    chans = [_s(a) for a in args] or list(ctx.subscriptions)
-    out = []
-    for ch in chans:
-        lid = ctx.subscriptions.pop(ch, None)
-        if lid is not None:
-            server.engine.pubsub.unsubscribe(ch, lid)
-        out.append(Push([b"unsubscribe", ch.encode(), ctx.subscription_count()]))
-    return out
-
-
-@register("PSUBSCRIBE")
-def cmd_psubscribe(server, ctx, args):
-    out = []
-    for pat_raw in args:
-        pat = _s(pat_raw)
-        if pat not in ctx.psubscriptions:
-            push = ctx.push
-
-            def listener(channel, msg, _push=push, _pat=pat):
-                _push(Push([
-                    b"pmessage", _pat.encode(), channel.encode(),
-                    msg if isinstance(msg, bytes) else pickle.dumps(msg),
-                ]))
-
-            ctx.psubscriptions[pat] = server.engine.pubsub.psubscribe(pat, listener)
-        out.append(Push([b"psubscribe", pat_raw, ctx.subscription_count()]))
-    return out
-
-
-@register("PUNSUBSCRIBE")
-def cmd_punsubscribe(server, ctx, args):
-    pats = [_s(a) for a in args] or list(ctx.psubscriptions)
-    out = []
-    for pat in pats:
-        lid = ctx.psubscriptions.pop(pat, None)
-        if lid is not None:
-            server.engine.pubsub.punsubscribe(pat, lid)
-        out.append(Push([b"punsubscribe", pat.encode(), ctx.subscription_count()]))
-    return out
-
-
-@register("PUBLISH")
-def cmd_publish(server, ctx, args):
-    return server.engine.pubsub.publish(_s(args[0]), bytes(args[1]))
-
-
-@register("PUBSUB")
-def cmd_pubsub(server, ctx, args):
-    """PUBSUB CHANNELS [pattern] | NUMSUB [ch...] | NUMPAT |
-    SHARDCHANNELS [pattern] | SHARDNUMSUB [ch...] — hub introspection
-    (RedissonTopic.countSubscribers / getChannelNames role)."""
-    hub = server.engine.pubsub
-    sub = bytes(args[0]).upper() if args else b""
-    if sub in (b"CHANNELS", b"SHARDCHANNELS"):
-        prefix = _SHARD_NS if sub == b"SHARDCHANNELS" else ""
-        pattern = _s(args[1]) if len(args) > 1 else "*"
-        out = []
-        for ch in hub.channels():
-            if prefix:
-                if not ch.startswith(prefix):
-                    continue
-                ch = ch[len(prefix):]
-            elif ch.startswith(_SHARD_NS):
-                continue  # shard channels live in their own namespace
-            if _glob_match(pattern, ch):
-                out.append(ch.encode())
-        return sorted(out)
-    if sub in (b"NUMSUB", b"SHARDNUMSUB"):
-        prefix = _SHARD_NS if sub == b"SHARDNUMSUB" else ""
-        out = []
-        for raw in args[1:]:
-            ch = _s(raw)
-            out += [raw, hub.subscriber_count(prefix + ch)]
-        return out
-    if sub == b"NUMPAT":
-        return len(hub._patterns)
-    raise RespError(f"ERR Unknown PUBSUB subcommand '{_s(args[0]) if args else ''}'")
-
-
-# sharded pubsub (Redis 7 SPUBLISH/SSUBSCRIBE): shard channels are a
-# SEPARATE namespace (a PUBLISH must not reach an SSUBSCRIBE listener) —
-# modeled as a reserved hub-channel prefix.  Slot routing happens client-
-# side by channel name, same as the plain-SUBSCRIBE slot routing the
-# cluster client already does (RedissonShardedTopic semantic parity).
-_SHARD_NS = "__shard__:"
-
-
-@register("SSUBSCRIBE")
-def cmd_ssubscribe(server, ctx, args):
-    out = []
-    for ch_raw in args:
-        ch = _s(ch_raw)
-        hubch = _SHARD_NS + ch
-        if hubch not in ctx.subscriptions:
-            push = ctx.push
-
-            def listener(channel, msg, _push=push, _ch=ch):
-                _push(Push([
-                    b"smessage", _ch.encode(),
-                    msg if isinstance(msg, bytes) else pickle.dumps(msg),
-                ]))
-
-            ctx.subscriptions[hubch] = server.engine.pubsub.subscribe(hubch, listener)
-        out.append(Push([b"ssubscribe", ch_raw, ctx.subscription_count()]))
-    return out
-
-
-@register("SUNSUBSCRIBE")
-def cmd_sunsubscribe(server, ctx, args):
-    chans = [_s(a) for a in args] or [
-        c[len(_SHARD_NS):] for c in ctx.subscriptions if c.startswith(_SHARD_NS)
-    ]
-    out = []
-    for ch in chans:
-        lid = ctx.subscriptions.pop(_SHARD_NS + ch, None)
-        if lid is not None:
-            server.engine.pubsub.unsubscribe(_SHARD_NS + ch, lid)
-        out.append(Push([b"sunsubscribe", ch.encode(), ctx.subscription_count()]))
-    return out
-
-
-@register("SPUBLISH")
-def cmd_spublish(server, ctx, args):
-    return server.engine.pubsub.publish(_SHARD_NS + _s(args[0]), bytes(args[1]))
-
-
-# -- admin / node info (redisnode/* surface) ---------------------------------
-
-@register("TIME")
-def cmd_time(server, ctx, args):
-    t = time.time()
-    return [str(int(t)).encode(), str(int((t % 1) * 1e6)).encode()]
-
-
-@register("INFO")
-def cmd_info(server, ctx, args):
-    return server.info_text().encode()
-
-
-@register("MEMORY")
-def cmd_memory(server, ctx, args):
-    sub = bytes(args[0]).upper() if args else b""
-    if sub == b"USAGE":
-        rec = server.engine.store.get(_s(args[1]))
-        if rec is None:
-            return None
-        total = 0
-        for arr in rec.arrays.values():
-            total += int(getattr(arr, "nbytes", 0) or 0)
-        import sys
-
-        if rec.host is not None:
-            total += sys.getsizeof(rec.host)
-        return total
-    if sub == b"STATS":
-        return [b"keys.count", len(server.engine.store)]
-    return "+OK"
-
-
-@register("CLUSTER")
-def cmd_cluster(server, ctx, args):
-    sub = bytes(args[0]).upper() if args else b""
-    if sub == b"SLOTS":
-        return server.cluster_slots()
-    if sub == b"MYID":
-        return server.node_id.encode()
-    if sub == b"INFO":
-        state = "ok" if server.cluster_view else "ok"
-        return f"cluster_enabled:{1 if server.cluster_view else 0}\r\ncluster_state:{state}\r\n".encode()
-    if sub == b"SETVIEW":
-        # SETVIEW [TOKEN <n>] <from> <to> <host> <port> <node_id> ...
-        # (5-tuples) — the topology/launcher (harness.ClusterRunner,
-        # server/monitor.py) installs the slot map on every node; the
-        # reference's analog is each node's view from CLUSTER NODES gossip.
-        # TOKEN carries the writing coordinator's FENCING token (its
-        # FencedLock leadership token): a view stamped with a LOWER token
-        # than the last accepted one is a stale ex-leader's late write and
-        # is rejected — the fencing discipline that makes coordinator HA
-        # safe (a paused leader resuming after its lease lapsed cannot
-        # clobber its successor's topology).
-        rest = args[1:]
-        token = None
-        if rest and bytes(rest[0]).upper() == b"TOKEN":
-            token = _int(rest[1])
-            rest = rest[2:]
-        if len(rest) % 5 != 0:
-            raise RespError("ERR SETVIEW expects 5-tuples")
-        if token is not None:
-            if token < server.view_epoch:
-                raise RespError(
-                    f"STALEVIEW token {token} < accepted epoch {server.view_epoch}"
-                )
-            server.view_epoch = token
-        view = []
-        for i in range(0, len(rest), 5):
-            view.append(
-                (
-                    _int(rest[i]),
-                    _int(rest[i + 1]),
-                    _s(rest[i + 2]),
-                    _int(rest[i + 3]),
-                    _s(rest[i + 4]),
-                )
-            )
-        server.cluster_view = view
-        return "+OK"
-    if sub == b"RESET":
-        server.cluster_view = []
-        return "+OK"
-    # -- live slot migration (MIGRATING/IMPORTING window + drain) ------------
-    if sub == b"SETSLOT":
-        # SETSLOT <slot> MIGRATING <host:port> | IMPORTING <host:port> |
-        #         STABLE | NODE <host:port> <node_id>
-        slot = _int(args[1])
-        mode = bytes(args[2]).upper()
-        if mode == b"MIGRATING":
-            server.set_slot_migrating(slot, _s(args[3]))
-            return "+OK"
-        if mode == b"IMPORTING":
-            server.set_slot_importing(slot, _s(args[3]))
-            return "+OK"
-        if mode == b"STABLE":
-            server.set_slot_stable(slot)
-            return "+OK"
-        if mode == b"NODE":
-            # finalize locally: point the slot at its new owner in this
-            # node's view and clear the window state (the orchestrator also
-            # pushes a full SETVIEW; NODE keeps single-node finalization
-            # correct even before that lands)
-            addr, nid = _s(args[3]), _s(args[4])
-            host, port = addr.rsplit(":", 1)
-            new_view = []
-            for lo, hi, h, p, vnid in server.cluster_view:
-                if lo <= slot <= hi:
-                    # split the range around the reassigned slot
-                    if lo <= slot - 1:
-                        new_view.append((lo, slot - 1, h, p, vnid))
-                    new_view.append((slot, slot, host, int(port), nid))
-                    if slot + 1 <= hi:
-                        new_view.append((slot + 1, hi, h, p, vnid))
-                else:
-                    new_view.append((lo, hi, h, p, vnid))
-            server.cluster_view = new_view
-            server.set_slot_stable(slot)
-            return "+OK"
-        raise RespError("ERR SETSLOT expects MIGRATING|IMPORTING|STABLE|NODE")
-    if sub == b"COUNTKEYSINSLOT":
-        return len(server.slot_names(_int(args[1])))
-    if sub == b"GETKEYSINSLOT":
-        names = server.slot_names(_int(args[1]))
-        limit = _int(args[2]) if len(args) > 2 else len(names)
-        return [n.encode() for n in names[:limit]]
-    if sub == b"MIGRATESLOT":
-        # drain one MIGRATING slot (optional batch limit; <=0 = fully)
-        limit = _int(args[2]) if len(args) > 2 else 0
-        return server.migrate_slot_batch(_int(args[1]), limit)
-    if sub == b"MIGRATESLOTS":
-        # drain MANY migrating slots in one store scan — the orchestrator's
-        # bulk form (a reshard of hundreds of slots must not pay a full
-        # keyspace scan per slot)
-        return server.migrate_slot_batch([_int(a) for a in args[1:]])
-    raise RespError("ERR unknown CLUSTER subcommand")
-
-
-@register("ASKING")
-def cmd_asking(server, ctx, args):
-    """One-shot admission for the NEXT command on this connection into an
-    IMPORTING slot (the redirect half of the ASK protocol)."""
-    ctx.asking = True
-    return "+OK"
-
-
-@register("IMPORTRECORDS")
-def cmd_importrecords(server, ctx, args):
-    """Install migrated records (slot-migration transfer frame; the blob
-    carries records only — no live-list pruning, unlike REPLPUSH)."""
-    from redisson_tpu.server import replication
-
-    return replication.apply_records(server.engine, bytes(args[0]))
-
-
-# -- replication (server/replication.py) -------------------------------------
-
-@register("REPLICAOF")
-def cmd_replicaof(server, ctx, args):
-    """REPLICAOF NO ONE -> become master; REPLICAOF <host> <port> -> full
-    sync from master, then register for the push stream."""
-    if len(args) == 2 and bytes(args[0]).upper() == b"NO" and bytes(args[1]).upper() == b"ONE":
-        if server.role == "replica" and server.master_address:
-            # breadcrumb for successor coordinators: an orphaned master that
-            # can name the dead master it was promoted FROM is a
-            # half-finished failover; a restarted stale master cannot
-            server.promoted_from = server.master_address
-        server.role = "master"
-        server.master_address = None
-        return "+OK"
-    if len(args) != 2:
-        raise RespError("ERR REPLICAOF <host> <port> | NO ONE")
-    host, port = _s(args[0]), _int(args[1])
-    from redisson_tpu.server import replication
-
-    # nodes of one grid share credentials AND transport security: the link
-    # authenticates with this node's own password and speaks TLS when this
-    # node does (cluster-wide convention; server.link_client)
-    master = server.link_client(
-        f"{host}:{port}", ping_interval=0, retry_attempts=1
-    )
-    try:
-        blob = master.execute("REPLSNAPSHOT", timeout=60.0)
-        replication.apply_records(server.engine, bytes(blob))
-        master.execute("REPLREGISTER", server.host, server.port)
-    finally:
-        master.close()
-    server.role = "replica"
-    server.master_address = f"{host}:{port}"
-    return "+OK"
-
-
-@register("REPLSNAPSHOT")
-def cmd_replsnapshot(server, ctx, args):
-    from redisson_tpu.server import replication
-
-    blob, _shipped = replication.serialize_records(server.engine)
-    return blob
-
-
-@register("REPLREGISTER")
-def cmd_replregister(server, ctx, args):
-    host, port = _s(args[0]), _int(args[1])
-    server.replication_source().register(f"{host}:{port}")
-    return "+OK"
-
-
-@register("REPLPUSH")
-def cmd_replpush(server, ctx, args):
-    from redisson_tpu.server import replication
-
-    return replication.apply_records(server.engine, bytes(args[0]))
-
-
-@register("REPLPUSHSEG")
-def cmd_replpushseg(server, ctx, args):
-    """REPLPUSHSEG <xfer_id> <seq> <nsegs> <chunk> — one bounded slice of an
-    oversized REPLPUSH blob (a 10M-key bloom plane is ~95MB; a single
-    sendall of that stalls past socket timeouts, server/replication.py
-    SEGMENT_BYTES).  The final slice reassembles and applies the blob;
-    intermediates stage host-side and answer +OK."""
-    from redisson_tpu.server import replication
-
-    xfer_id, seq, nsegs = _s(args[0]), _int(args[1]), _int(args[2])
-    chunk = bytes(args[3])
-    xfers = server.__dict__.setdefault("_repl_xfers", {})
-    if seq == 0:
-        xfers[xfer_id] = [None] * nsegs
-        # a lost transfer must not leak staging forever: keep at most 4
-        while len(xfers) > 4:
-            xfers.pop(next(iter(xfers)))
-    slots = xfers.get(xfer_id)
-    if slots is None or len(slots) != nsegs or not (0 <= seq < nsegs):
-        raise RespError(f"ERR unknown replication transfer {xfer_id}/{seq}")
-    slots[seq] = chunk
-    if any(s is None for s in slots):
-        return "+OK"
-    del xfers[xfer_id]
-    return replication.apply_records(server.engine, b"".join(slots))
-
-
-@register("REPLFLUSH")
-def cmd_replflush(server, ctx, args):
-    """Ship dirty records to all replicas NOW (WAIT / syncSlaves analog)."""
-    if server._replication is None:
-        return 0
-    return server._replication.flush()
-
-
-@register("ROLE")
-def cmd_role(server, ctx, args):
-    """Redis ROLE parity: master -> ["master", 0, [replica addrs]];
-    replica -> ["slave", host, port, "connected", 0].  Failover
-    coordinators probe this to DISCOVER a dead master's replicas when they
-    started after the death (a successor coordinator has no poll history)."""
-    if server.role == "replica" and server.master_address:
-        host, _, port = server.master_address.rpartition(":")
-        return [b"slave", host.encode(), int(port), b"connected", 0]
-    reps = []
-    if server._replication is not None:
-        reps = [a.encode() for a in server._replication.replicas()]
-    promoted_from = getattr(server, "promoted_from", None)
-    # 4th element is our extension past Redis ROLE: the address this master
-    # was promoted FROM (empty when it never was a replica) — coordinators
-    # use it to adopt half-finished failovers without mistaking a restarted
-    # stale master for one
-    return [b"master", 0, reps, (promoted_from or "").encode()]
-
-
-@register("REPLICAS")
-def cmd_replicas(server, ctx, args):
-    if server._replication is None:
-        return []
-    return [a.encode() for a in server._replication.replicas()]
-
-
-@register("METRICS")
-def cmd_metrics(server, ctx, args):
-    """Prometheus text exposition of the node's metrics registry."""
-    return server.metrics.prometheus_text().encode()
-
-
-# -- checkpoint (SAVE analog; full impl in core/checkpoint.py) ---------------
-
-@register("SAVE")
-def cmd_save(server, ctx, args):
-    path = _s(args[0]) if args else server.checkpoint_path
-    if path is None:
-        raise RespError("ERR no checkpoint path configured")
-    from redisson_tpu.core import checkpoint
-
-    checkpoint.save(server.engine, path)
-    return "+OK"
-
-
-@register("RESTORESTATE")
-def cmd_restorestate(server, ctx, args):
-    path = _s(args[0]) if args else server.checkpoint_path
-    if path is None:
-        raise RespError("ERR no checkpoint path configured")
-    from redisson_tpu.core import checkpoint
-
-    n = checkpoint.load(server.engine, path)
-    return n
-
-
-# -- generic object invocation (the classBody-shipping analog) ---------------
-
-def _objcall_resolve(server, factory: str, name: str, codec_blob: Optional[bytes] = None):
-    """Resolve the (cached) handle instance for one object call.
-
-    `codec_blob` (optional, pickled Codec) lets remote clients carry a
-    non-default codec across the wire — the reference's getMap(name, codec)
-    contract; without it every wire handle silently used the server's
-    default codec.  The raw blob keys the cache so same-name handles with
-    different codecs don't alias."""
-    if not factory.startswith(("get_", "create_")):
-        raise RespError("ERR bad factory")
-    client = server.local_client()
-    fn = getattr(client, factory, None)
-    if fn is None:
-        raise RespError(f"ERR unknown factory '{factory}'")
-
-    def _make():
-        kw = {}
-        if codec_blob is not None:
-            import inspect
-
-            from redisson_tpu.net.safe_pickle import safe_loads
-
-            # signature probe, not except-TypeError: a TypeError raised
-            # INSIDE an accepting factory must not masquerade as "does not
-            # accept a codec"
-            try:
-                params = inspect.signature(fn).parameters
-            except (TypeError, ValueError):
-                params = {}
-            if "codec" not in params and not any(
-                p.kind == p.VAR_KEYWORD for p in params.values()
-            ):
-                raise RespError(f"ERR factory '{factory}' does not accept a codec")
-            kw["codec"] = safe_loads(codec_blob)
-        return fn(name, **kw) if name else fn(**kw)
-
-    # handle instances are cached per (factory, name): stateful handles
-    # (LocalCachedMap subscribes an invalidation listener, adders register
-    # counters) must not accrete one instance per OBJCALL.  create_* stays
-    # uncached by contract (fresh object per call).
-    if not factory.startswith("get_"):
-        return _make()
-    cache = server._objcall_handles
-    key = (factory, name, codec_blob)
-    with server._objcall_handles_lock:
-        obj = cache.get(key)
-        if obj is None:
-            obj = _make()
-            cache[key] = obj
-            if len(cache) > 4096:  # bounded LRU
-                _k, old = cache.popitem(last=False)
-                detach = getattr(old, "destroy", None)  # detach-only by contract
-                if detach is not None:
-                    try:
-                        detach()
-                    except Exception:  # noqa: BLE001
-                        pass
-        else:
-            cache.move_to_end(key)
-    return obj
-
-
-def _objcall_invoke(server, factory, name, method, call_args, call_kwargs, caller,
-                    codec_blob: Optional[bytes] = None):
-    """One object-method invocation; returns the raw result (exceptions
-    other than protocol errors propagate to the caller for tagging)."""
-    obj = _objcall_resolve(server, factory, name, codec_blob)
-    m = getattr(obj, method, None)
-    if m is None or method.startswith("_"):
-        raise RespError(f"ERR unknown method '{method}'")
-    with server.engine.impersonate(caller):
-        return m(*call_args, **call_kwargs)
-
-
-@register("OBJCALL")
-def cmd_objcall(server, ctx, args):
-    """OBJCALL <factory> <name> <method> <pickled (args, kwargs)> [<caller-id>]
-    [<pickled codec>] -> pickled result.  factory = RedissonTpu getter name
-    ("get_map", ...); caller-id = client uuid:threadId so synchronizer
-    identity survives the wire (RedissonBaseLock.getLockName travels
-    client->Lua the same way); the optional codec rides the frame so remote
-    handles honor getMap(name, codec) semantics."""
-    from redisson_tpu.net.safe_pickle import safe_loads
-
-    factory, name, method = _s(args[0]), _s(args[1]), _s(args[2])
-    call_args, call_kwargs = safe_loads(bytes(args[3])) if len(args) > 3 else ((), {})
-    caller = _s(args[4]) if len(args) > 4 and args[4] is not None else None
-    codec_blob = bytes(args[5]) if len(args) > 5 and args[5] is not None else None
-    try:
-        result = _objcall_invoke(
-            server, factory, name, method, call_args, call_kwargs, caller, codec_blob
-        )
-    except RespError:
-        raise
-    except Exception as e:  # noqa: BLE001 — ship the exception to the caller
-        return b"E" + pickle.dumps(e)
-    return b"R" + pickle.dumps(result)
-
-
-@register("OBJCALLM")
-def cmd_objcallm(server, ctx, args):
-    """OBJCALLM <pickled [(factory, name, method, args, kwargs), ...]> [caller]
-    -> b"M" + pickled [("R", result) | ("E", exception), ...].
-
-    The batched object wire (CommandBatchService.java:87-151 made a single
-    command): MANY object ops cross the wire as ONE frame and ONE pickle,
-    instead of one round trip + pickle per op — the lever that lifts
-    OBJCALL-bound cluster throughput.  Per-op routing errors (MOVED/ASK
-    during a reshard) come back as tagged entries so the client re-routes
-    just those ops."""
-    return _objcallm_run(server, args, atomic=False)
-
-
-@register("OBJCALLMA")
-def cmd_objcallm_atomic(server, ctx, args):
-    """Atomic OBJCALLM (BatchOptions IN_MEMORY_ATOMIC / the MULTI-EXEC
-    analog, command/CommandBatchService.java:211-540): every op's record
-    lock is taken UP FRONT via engine.locked_many, so no other command
-    interleaves with the group — Redis EXEC semantics: non-interleaved
-    execution, no rollback of ops that already applied when a later op
-    errors.  Cluster rule matches the reference: all object names must
-    colocate on this node (use {hashtags})."""
-    return _objcallm_run(server, args, atomic=True)
-
-
-def _objcallm_run(server, args, atomic: bool):
-    from redisson_tpu.net.safe_pickle import safe_loads
-
-    ops = safe_loads(bytes(args[0]))
-    caller = _s(args[1]) if len(args) > 1 else None
-    if atomic:
-        names = sorted({str(op[1]) for op in ops if op[1]})
-        with server.engine.locked_many(names):
-            return _objcallm_apply(server, ops, caller)
-    return _objcallm_apply(server, ops, caller)
-
-
-def _objcallm_apply(server, ops, caller):
-    out = []
-    for op in ops:
-        # 5-tuple (factory, name, method, args, kwargs) or 6-tuple with a
-        # trailing pickled-codec blob (same contract as OBJCALL's 6th arg)
-        factory, name, method, call_args, call_kwargs = op[:5]
-        codec_blob = op[5] if len(op) > 5 else None
-        try:
-            if server.cluster_view:
-                # per-op routing check (the frame itself is keyless)
-                server.check_routing(
-                    "OBJCALL",
-                    [str(factory).encode(), str(name).encode(), str(method).encode()],
-                )
-            out.append(
-                (
-                    "R",
-                    _objcall_invoke(
-                        server, factory, name, method,
-                        tuple(call_args), dict(call_kwargs), caller, codec_blob,
-                    ),
-                )
-            )
-        except Exception as e:  # noqa: BLE001 — tagged per-op, frame continues
-            out.append(("E", e))
-    return b"M" + pickle.dumps(out)
-
-
-# -- transactions over the wire ----------------------------------------------
-# Two surfaces, one engine mechanism (record versions + locked_many):
-#   * MULTI/EXEC/WATCH/DISCARD/UNWATCH — the Redis-compatible verbs for
-#     generic clients (queue in CommandContext, optimistic WATCH versions);
-#   * OBJCALLV/TXEXEC — the object-level transaction wire used by
-#     RemoteTransaction (transaction/RedissonTransaction.java:49-79 role):
-#     reads return the observed record version, commit is ONE atomic frame
-#     with version preconditions checked under locked_many.
-
-# EXEC runs its queue on one worker thread; blocking verbs inside a
-# transaction must degrade to a single non-blocking probe (Redis semantics:
-# BLPOP inside MULTI acts as if the timeout elapsed immediately)
-_exec_tls = threading.local()
-
-
-@register("MULTI")
-def cmd_multi(server, ctx, args):
-    if ctx.multi_queue is not None:
-        raise RespError("ERR MULTI calls can not be nested")
-    ctx.multi_queue = []
-    ctx.multi_error = False
-    return "+OK"
-
-
-@register("DISCARD")
-def cmd_discard(server, ctx, args):
-    if ctx.multi_queue is None:
-        raise RespError("ERR DISCARD without MULTI")
-    ctx.multi_queue = None
-    ctx.multi_error = False
-    ctx.watch_versions.clear()
-    return "+OK"
-
-
-@register("WATCH")
-def cmd_watch(server, ctx, args):
-    if ctx.multi_queue is not None:
-        raise RespError("ERR WATCH inside MULTI is not allowed")
-    if not args:
-        raise RespError("ERR wrong number of arguments for 'watch' command")
-    for a in args:
-        name = _s(a)
-        rec = server.engine.store.get(name)
-        # first observation wins (re-WATCHing a key keeps the original
-        # precondition, matching the read-versions discipline)
-        ctx.watch_versions.setdefault(name, 0 if rec is None else rec.version)
-    return "+OK"
-
-
-@register("UNWATCH")
-def cmd_unwatch(server, ctx, args):
-    ctx.watch_versions.clear()
-    return "+OK"
-
-
-@register("RESET")
-def cmd_reset(server, ctx, args):
-    """Connection state reset (Redis 6.2 RESET): transaction, watches,
-    subscriptions stay untouched server-side except tx state (subscription
-    teardown rides connection close)."""
-    ctx.multi_queue = None
-    ctx.multi_error = False
-    ctx.watch_versions.clear()
-    ctx.asking = False
-    return "+RESET"
-
-
-@register("EXEC")
-def cmd_exec(server, ctx, args):
-    from redisson_tpu.net import commands as C
-
-    if ctx.multi_queue is None:
-        raise RespError("ERR EXEC without MULTI")
-    queue, ctx.multi_queue = ctx.multi_queue, None
-    poisoned, ctx.multi_error = ctx.multi_error, False
-    watches, ctx.watch_versions = dict(ctx.watch_versions), {}
-    if poisoned:
-        raise RespError(
-            "EXECABORT Transaction discarded because of previous errors."
-        )
-    # routing precheck over the WHOLE group before anything applies: a slot
-    # migrated since queue time must bounce the entire EXEC, never half of it
-    if server.cluster_view or server.role == "replica":
-        for qargs in queue:
-            server.check_routing(bytes(qargs[0]).decode().upper(), qargs[1:])
-    names = set(watches)
-    for qargs in queue:
-        for key in C.command_keys(bytes(qargs[0]).decode().upper(), qargs[1:]):
-            names.add(key.decode() if isinstance(key, (bytes, bytearray)) else str(key))
-    # one EXEC at a time: handlers may take record locks beyond the
-    # precomputed key set (derived names), and serializing EXECs removes
-    # any cross-transaction lock-order inversion those could introduce
-    with server._exec_mutex:
-        with server.engine.locked_many(sorted(names)):
-            for name, seen in watches.items():
-                rec = server.engine.store.get(name)
-                cur = 0 if rec is None else rec.version
-                if cur != seen:
-                    return None  # nil reply: transaction aborted (Redis WATCH)
-            results = []
-            _exec_tls.in_exec = True
-            try:
-                for qargs in queue:
-                    try:
-                        r = REGISTRY.dispatch(server, ctx, qargs)
-                        if isinstance(r, LazyReply):
-                            # the frame-level lazy materializer only walks
-                            # TOP-level results; nested lazies force here
-                            r = r.force()
-                        if isinstance(r, str) and r.startswith("+"):
-                            r = r[1:]  # "+OK" marker is a top-level encoding
-                        results.append(r)
-                    except RespError as e:
-                        results.append(e)  # per-command errors as values
-                    except Exception as e:  # noqa: BLE001 — WRONGTYPE et al.
-                        results.append(
-                            RespError(f"ERR internal: {type(e).__name__}: {e}")
-                        )
-            finally:
-                _exec_tls.in_exec = False
-            return results
-
-
-@register("OBJCALLV")
-def cmd_objcallv(server, ctx, args):
-    """OBJCALL returning (observed record version, result) — the
-    transactional read.  The version is captured UNDER the record lock
-    before the method runs, so a concurrent writer cannot slip between
-    observation and result (RemoteTransaction records it as the commit
-    precondition, the WATCH analog for the object surface)."""
-    from redisson_tpu.net.safe_pickle import safe_loads
-
-    factory, name, method = _s(args[0]), _s(args[1]), _s(args[2])
-    call_args, call_kwargs = safe_loads(bytes(args[3])) if len(args) > 3 else ((), {})
-    caller = _s(args[4]) if len(args) > 4 and args[4] is not None else None
-    codec_blob = bytes(args[5]) if len(args) > 5 and args[5] is not None else None
-    with server.engine.locked(name):
-        rec = server.engine.store.get(name)
-        version = 0 if rec is None else rec.version
-        try:
-            result = _objcall_invoke(
-                server, factory, name, method, call_args, call_kwargs, caller,
-                codec_blob,
-            )
-        except RespError:
-            raise
-        except Exception as e:  # noqa: BLE001 — ship the exception to the caller
-            return b"E" + pickle.dumps(e)
-    return b"R" + pickle.dumps((version, result))
-
-
-@register("TXEXEC")
-def cmd_txexec(server, ctx, args):
-    """TXEXEC <pickled {name: version}> <pickled ops> [caller] — the atomic
-    transaction commit frame: version preconditions verified and ops applied
-    under ONE locked_many, so the check-then-apply window cannot admit a
-    concurrent writer.  Versions mismatching reply TXCONFLICT with NOTHING
-    applied; op errors after a passing check are tagged per-op with no
-    rollback (EXEC semantics, same as OBJCALLMA).  The version-checked
-    OBJCALLMA this extends is the commit path of RemoteTransaction
-    (transaction/RedissonTransaction.java:270-306 made one frame)."""
-    from redisson_tpu.net.safe_pickle import safe_loads
-
-    versions = safe_loads(bytes(args[0]))
-    ops = safe_loads(bytes(args[1]))
-    caller = _s(args[2]) if len(args) > 2 and args[2] is not None else None
-    names = sorted(
-        {str(n) for n in versions} | {str(op[1]) for op in ops if op[1]}
-    )
-    # whole-frame routing precheck BEFORE any lock/apply: a mid-migration
-    # frame must bounce atomically (client refreshes topology and retries
-    # the full commit — nothing has applied)
-    if server.cluster_view:
-        for n in names:
-            server.check_routing(
-                "OBJCALL", [b"tx", n.encode(), b"precheck"]
-            )
-    with server.engine.locked_many(names):
-        for name, seen in versions.items():
-            rec = server.engine.store.get(str(name))
-            cur = 0 if rec is None else rec.version
-            if cur != int(seen):
-                raise RespError(
-                    f"TXCONFLICT object '{name}' changed concurrently "
-                    f"(version {seen} -> {cur})"
-                )
-        return _objcallm_apply(server, ops, caller)
-
-
-# -- typed data commands (Redis-compatible wire surface) ----------------------
-# The reference registry defines ~447 typed commands (RedisCommands.java);
-# the batch-first blob forms above are the TPU-first primary citizens, and
-# OBJCALL carries the full object surface — but generic Redis clients speak
-# THESE verbs.  Values are raw bytes (BytesCodec), Redis semantics: a typed
-# command and a default-codec OBJCALL handle on the same name see different
-# encodings, exactly like mixing codecs in the reference.
-
-def _typed_handle(server, factory: str, name: str):
-    from redisson_tpu.client.codec import BytesCodec
-
-    return getattr(server.local_client(), factory)(name, codec=BytesCodec())
-
-
-@register("HSET")
-def cmd_hset(server, ctx, args):
-    name = _s(args[0])
-    m = _typed_handle(server, "get_map", name)
-    n = 0
-    with server.engine.locked(name):  # multi-field writes land atomically
-        for i in range(1, len(args) - 1, 2):
-            if m.fast_put(bytes(args[i]), bytes(args[i + 1])):
-                n += 1
-    return n
-
-
-@register("HGET")
-def cmd_hget(server, ctx, args):
-    return _typed_handle(server, "get_map", _s(args[0])).get(bytes(args[1]))
-
-
-@register("HMGET")
-def cmd_hmget(server, ctx, args):
-    m = _typed_handle(server, "get_map", _s(args[0]))
-    return [m.get(bytes(f)) for f in args[1:]]
-
-
-@register("HDEL")
-def cmd_hdel(server, ctx, args):
-    m = _typed_handle(server, "get_map", _s(args[0]))
-    return int(m.fast_remove(*[bytes(f) for f in args[1:]]))
-
-
-@register("HGETALL")
-def cmd_hgetall(server, ctx, args):
-    # dict reply: RESP3 map frame `%`, RESP2 flattens to field-value array
-    m = _typed_handle(server, "get_map", _s(args[0]))
-    return {bytes(k): v for k, v in m.read_all_entry_set()}
-
-
-@register("HEXISTS")
-def cmd_hexists(server, ctx, args):
-    return 1 if _typed_handle(server, "get_map", _s(args[0])).contains_key(bytes(args[1])) else 0
-
-
-@register("HLEN")
-def cmd_hlen(server, ctx, args):
-    return _typed_handle(server, "get_map", _s(args[0])).size()
-
-
-@register("HKEYS")
-def cmd_hkeys(server, ctx, args):
-    return _typed_handle(server, "get_map", _s(args[0])).read_all_keys()
-
-
-@register("HVALS")
-def cmd_hvals(server, ctx, args):
-    return _typed_handle(server, "get_map", _s(args[0])).read_all_values()
-
-
-@register("SADD")
-def cmd_sadd(server, ctx, args):
-    s = _typed_handle(server, "get_set", _s(args[0]))
-    return sum(1 for v in args[1:] if s.add(bytes(v)))
-
-
-@register("SREM")
-def cmd_srem(server, ctx, args):
-    s = _typed_handle(server, "get_set", _s(args[0]))
-    return sum(1 for v in args[1:] if s.remove(bytes(v)))
-
-
-@register("SISMEMBER")
-def cmd_sismember(server, ctx, args):
-    return 1 if _typed_handle(server, "get_set", _s(args[0])).contains(bytes(args[1])) else 0
-
-
-@register("SMEMBERS")
-def cmd_smembers(server, ctx, args):
-    # a python set encodes as the RESP3 `~` set frame (RESP2 projects to an
-    # array) — the CommandDecoder.java marker for SMEMBERS-family replies
-    return set(_typed_handle(server, "get_set", _s(args[0])).read_all())
-
-
-@register("SCARD")
-def cmd_scard(server, ctx, args):
-    return _typed_handle(server, "get_set", _s(args[0])).size()
-
-
-def _deque(server, name: str):
-    return _typed_handle(server, "get_deque", name)
-
-
-@register("LPUSH")
-def cmd_lpush(server, ctx, args):
-    d = _deque(server, _s(args[0]))
-    for v in args[1:]:
-        d.add_first(bytes(v))
-    return d.size()
-
-
-@register("RPUSH")
-def cmd_rpush(server, ctx, args):
-    d = _deque(server, _s(args[0]))
-    for v in args[1:]:
-        d.add_last(bytes(v))
-    return d.size()
-
-
-@register("LPOP")
-def cmd_lpop(server, ctx, args):
-    return _deque(server, _s(args[0])).poll_first()
-
-
-@register("RPOP")
-def cmd_rpop(server, ctx, args):
-    return _deque(server, _s(args[0])).poll_last()
-
-
-@register("LLEN")
-def cmd_llen(server, ctx, args):
-    return _deque(server, _s(args[0])).size()
-
-
-@register("LRANGE")
-def cmd_lrange(server, ctx, args):
-    from redisson_tpu.client.objects.scoredsortedset import _norm_range
-
-    d = _deque(server, _s(args[0]))
-    items = d.read_all()
-    lo, hi = _norm_range(_int(args[1]), _int(args[2]), len(items))
-    return items[lo : hi + 1] if hi >= lo else []
-
-
-@register("LINDEX")
-def cmd_lindex(server, ctx, args):
-    items = _deque(server, _s(args[0])).read_all()
-    i = _int(args[1])
-    if i < 0:
-        i += len(items)
-    return items[i] if 0 <= i < len(items) else None
-
-
-@register("ZADD")
-def cmd_zadd(server, ctx, args):
-    name = _s(args[0])
-    z = _typed_handle(server, "get_scored_sorted_set", name)
-    n = 0
-    with server.engine.locked(name):  # multi-member adds land atomically
-        for i in range(1, len(args) - 1, 2):
-            if z.add(float(args[i]), bytes(args[i + 1])):
-                n += 1
-    _signal_waiters(server, name)  # wake parked BZPOPMIN/BZPOPMAX
-    return n
-
-
-@register("ZSCORE")
-def cmd_zscore(server, ctx, args):
-    # float reply: RESP3 double frame `,`, RESP2 Redis-formatted bulk
-    sc = _typed_handle(server, "get_scored_sorted_set", _s(args[0])).get_score(bytes(args[1]))
-    return None if sc is None else float(sc)
-
-
-@register("ZREM")
-def cmd_zrem(server, ctx, args):
-    z = _typed_handle(server, "get_scored_sorted_set", _s(args[0]))
-    return sum(1 for m in args[1:] if z.remove(bytes(m)))
-
-
-@register("ZCARD")
-def cmd_zcard(server, ctx, args):
-    return _typed_handle(server, "get_scored_sorted_set", _s(args[0])).size()
-
-
-@register("ZRANK")
-def cmd_zrank(server, ctx, args):
-    return _typed_handle(server, "get_scored_sorted_set", _s(args[0])).rank(bytes(args[1]))
-
-
-@register("ZINCRBY")
-def cmd_zincrby(server, ctx, args):
-    z = _typed_handle(server, "get_scored_sorted_set", _s(args[0]))
-    return float(z.add_score(bytes(args[2]), float(args[1])))
-
-
-@register("ZRANGE")
-def cmd_zrange(server, ctx, args):
-    z = _typed_handle(server, "get_scored_sorted_set", _s(args[0]))
-    withscores = len(args) > 3 and bytes(args[3]).upper() == b"WITHSCORES"
-    lo, hi = _int(args[1]), _int(args[2])
-    if withscores:
-        out = []
-        for member, score in z.entry_range(lo, hi):
-            out += [member, _fnum(score)]
-        return out
-    return z.value_range(lo, hi)
-
-
-@register("MGET")
-def cmd_mget(server, ctx, args):
-    # atomic snapshot across keys (Redis executes MGET as one step): without
-    # all locks, a reader interleaving a concurrent MSET could see a torn
-    # half-old half-new multi-key view
-    names = [_s(k) for k in args]
-    with server.engine.locked_many(names):
-        return [_bucket(server, n).get() for n in names]
-
-
-@register("MSET")
-def cmd_mset(server, ctx, args):
-    # ALL record locks up front (engine.locked_many): Redis MSET is atomic —
-    # a concurrent MGET must never observe a torn multi-key write
-    names = [_s(args[i]) for i in range(0, len(args) - 1, 2)]
-    with server.engine.locked_many(names):
-        for i in range(0, len(args) - 1, 2):
-            _bucket(server, _s(args[i])).set(bytes(args[i + 1]))
-    return "+OK"
-
-
-@register("GETSET")
-def cmd_getset(server, ctx, args):
-    return _bucket(server, _s(args[0])).get_and_set(bytes(args[1]))
-
-
-@register("GETDEL")
-def cmd_getdel(server, ctx, args):
-    name = _s(args[0])
-    with server.engine.locked(name):
-        v = _bucket(server, name).get()
-        server.engine.store.delete(name)
-        return v
-
-
-@register("APPEND")
-def cmd_append(server, ctx, args):
-    name = _s(args[0])
-    with server.engine.locked(name):
-        b = _bucket(server, name)
-        cur = b.get() or b""
-        new = bytes(cur) + bytes(args[1])
-        b.set(new)
-        return len(new)
-
-
-@register("STRLEN")
-def cmd_strlen(server, ctx, args):
-    v = _bucket(server, _s(args[0])).get()
-    return 0 if v is None else len(bytes(v))
-
-
-# -- typed surface expansion (strings / keys / scan cursors) ------------------
-# Same contract as the block above: BytesCodec values, Redis reply shapes,
-# record locks for compound read-modify-write.  Reference definitions:
-# client/protocol/RedisCommands.java (SETNX:188, SETRANGE/GETRANGE:199-201,
-# INCRBYFLOAT:214, SCAN:531, EXPIREAT:340).
-
-def _fnum(x: float) -> bytes:
-    """Redis float reply formatting: integral values print without '.0'."""
-    return (str(int(x)) if float(x) == int(x) else repr(float(x))).encode()
-
-
-def _glob_match(pattern: str, value: str) -> bool:
-    import fnmatch
-
-    return fnmatch.fnmatchcase(value, pattern)
-
-
-def _scan_page(items: List[bytes], cursor: int, count: int):
-    """Cursor = offset into the sorted item list (stable enough under the
-    weakly-consistent SCAN contract the reference also provides)."""
-    nxt = cursor + count
-    page = items[cursor:nxt]
-    return [b"0" if nxt >= len(items) else str(nxt).encode(), page]
-
-
-def _scan_opts(args, start: int):
-    pattern, count, novalues = None, 10, False
-    i = start
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"MATCH":
-            pattern = _s(args[i + 1])
-            i += 2
-        elif opt == b"COUNT":
-            count = max(1, _int(args[i + 1]))
-            i += 2
-        elif opt == b"NOVALUES":
-            novalues = True
-            i += 1
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    return pattern, count, novalues
-
-
-@register("SETNX")
-def cmd_setnx(server, ctx, args):
-    return 1 if _bucket(server, _s(args[0])).try_set(bytes(args[1])) else 0
-
-
-@register("SETEX")
-def cmd_setex(server, ctx, args):
-    ttl = _int(args[1])
-    if ttl <= 0:
-        raise RespError("ERR invalid expire time in 'setex' command")
-    _bucket(server, _s(args[0])).set(bytes(args[2]), ttl=float(ttl))
-    return "+OK"
-
-
-@register("PSETEX")
-def cmd_psetex(server, ctx, args):
-    ttl = _int(args[1])
-    if ttl <= 0:
-        raise RespError("ERR invalid expire time in 'psetex' command")
-    _bucket(server, _s(args[0])).set(bytes(args[2]), ttl=ttl / 1000.0)
-    return "+OK"
-
-
-@register("GETEX")
-def cmd_getex(server, ctx, args):
-    name = _s(args[0])
-    # parse the FULL option list before touching state: a trailing syntax
-    # error must leave the TTL unchanged (Redis validates then applies)
-    actions = []
-    i = 1
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"EX":
-            actions.append(lambda n=name, s=_int(args[i + 1]): server.engine.store.expire(n, time.time() + s))
-            i += 2
-        elif opt == b"PX":
-            actions.append(lambda n=name, ms=_int(args[i + 1]): server.engine.store.expire(n, time.time() + ms / 1000.0))
-            i += 2
-        elif opt == b"EXAT":
-            actions.append(lambda n=name, at=float(_int(args[i + 1])): server.engine.store.expire(n, at))
-            i += 2
-        elif opt == b"PXAT":
-            actions.append(lambda n=name, at=_int(args[i + 1]) / 1000.0: server.engine.store.expire(n, at))
-            i += 2
-        elif opt == b"PERSIST":
-            actions.append(lambda n=name: server.engine.store.expire(n, None))
-            i += 1
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    with server.engine.locked(name):
-        v = _bucket(server, name).get()
-        if v is None:
-            return None
-        for act in actions:
-            act()
-        return v
-
-
-@register("GETRANGE")
-def cmd_getrange(server, ctx, args):
-    v = _bucket(server, _s(args[0])).get()
-    if v is None:
-        return b""
-    data = bytes(v)
-    from redisson_tpu.client.objects.scoredsortedset import _norm_range
-
-    lo, hi = _norm_range(_int(args[1]), _int(args[2]), len(data))
-    return data[lo : hi + 1] if hi >= lo else b""
-
-
-@register("SETRANGE")
-def cmd_setrange(server, ctx, args):
-    name = _s(args[0])
-    off = _int(args[1])
-    if off < 0:
-        raise RespError("ERR offset is out of range")
-    patch = bytes(args[2])
-    with server.engine.locked(name):
-        b = _bucket(server, name)
-        cur = bytearray(bytes(b.get() or b""))
-        if len(cur) < off + len(patch):
-            cur.extend(b"\x00" * (off + len(patch) - len(cur)))
-        cur[off : off + len(patch)] = patch
-        b.set(bytes(cur))
-        return len(cur)
-
-
-@register("INCRBYFLOAT")
-def cmd_incrbyfloat(server, ctx, args):
-    name = _s(args[0])
-    with server.engine.locked(name):
-        b = _bucket(server, name)
-        cur = b.get()
-        try:
-            new = (float(cur) if cur is not None else 0.0) + float(args[1])
-        except ValueError:
-            raise RespError("ERR value is not a valid float")
-        b.set(_fnum(new))
-        return _fnum(new)
-
-
-@register("DECRBY")
-def cmd_decrby(server, ctx, args):
-    from redisson_tpu.client.objects.bucket import AtomicLong
-
-    return AtomicLong(server.engine, _s(args[0])).add_and_get(-_int(args[1]))
-
-
-@register("MSETNX")
-def cmd_msetnx(server, ctx, args):
-    # all-or-nothing: every key must be absent (Redis MSETNX contract)
-    names = [_s(args[i]) for i in range(0, len(args) - 1, 2)]
-    with server.engine.locked_many(names):
-        if any(server.engine.store.exists(n) for n in names):
-            return 0
-        for i in range(0, len(args) - 1, 2):
-            _bucket(server, _s(args[i])).set(bytes(args[i + 1]))
-        return 1
-
-
-@register("EXPIREAT")
-def cmd_expireat(server, ctx, args):
-    return _expire_locked(server, _s(args[0]), float(_int(args[1])))
-
-
-@register("PEXPIREAT")
-def cmd_pexpireat(server, ctx, args):
-    return _expire_locked(server, _s(args[0]), _int(args[1]) / 1000.0)
-
-
-def _expiretime(server, name: str, ms: bool):
-    if not server.engine.store.exists(name):
-        return -2
-    ttl = server.engine.store.ttl(name)
-    if ttl is None:
-        return -1
-    at = time.time() + ttl
-    return int(at * 1000) if ms else int(at)
-
-
-@register("EXPIRETIME")
-def cmd_expiretime(server, ctx, args):
-    return _expiretime(server, _s(args[0]), ms=False)
-
-
-@register("PEXPIRETIME")
-def cmd_pexpiretime(server, ctx, args):
-    return _expiretime(server, _s(args[0]), ms=True)
-
-
-@register("RANDOMKEY")
-def cmd_randomkey(server, ctx, args):
-    import random
-
-    ks = list(server.engine.store.keys())
-    return random.choice(ks).encode() if ks else None
-
-
-@register("TOUCH")
-def cmd_touch(server, ctx, args):
-    return sum(1 for k in args if server.engine.store.exists(_s(k)))
-
-
-@register("SCAN")
-def cmd_scan(server, ctx, args):
-    pattern, count, _ = _scan_opts(args, 1)
-    ks = sorted(server.engine.store.keys(pattern))
-    return _scan_page([k.encode() for k in ks], _int(args[0]), count)
-
-
-# -- typed surface expansion (hashes) ----------------------------------------
-
-@register("HSETNX")
-def cmd_hsetnx(server, ctx, args):
-    m = _typed_handle(server, "get_map", _s(args[0]))
-    return 1 if m.fast_put_if_absent(bytes(args[1]), bytes(args[2])) else 0
-
-
-def _hash_incr(server, args, parse, fmt):
-    name = _s(args[0])
-    field = bytes(args[1])
-    m = _typed_handle(server, "get_map", name)
-    with server.engine.locked(name):
-        cur = m.get(field)
-        try:
-            new = (parse(cur) if cur is not None else parse(b"0")) + parse(args[2])
-        except ValueError:
-            raise RespError("ERR hash value is not a number")
-        m.fast_put(field, fmt(new))
-        return new
-
-
-@register("HINCRBY")
-def cmd_hincrby(server, ctx, args):
-    return _hash_incr(server, args, _int, lambda v: str(v).encode())
-
-
-@register("HINCRBYFLOAT")
-def cmd_hincrbyfloat(server, ctx, args):
-    return _fnum(_hash_incr(server, args, float, _fnum))
-
-
-@register("HSTRLEN")
-def cmd_hstrlen(server, ctx, args):
-    v = _typed_handle(server, "get_map", _s(args[0])).get(bytes(args[1]))
-    return 0 if v is None else len(bytes(v))
-
-
-@register("HRANDFIELD")
-def cmd_hrandfield(server, ctx, args):
-    import random
-
-    m = _typed_handle(server, "get_map", _s(args[0]))
-    entries = m.read_all_entry_set()
-    if len(args) == 1:
-        return random.choice(entries)[0] if entries else None
-    n = _int(args[1])
-    withvalues = len(args) > 2 and bytes(args[2]).upper() == b"WITHVALUES"
-    if n >= 0:  # distinct fields, at most n
-        picked = random.sample(entries, min(n, len(entries)))
-    else:  # repeats allowed, exactly |n|
-        picked = [random.choice(entries) for _ in range(-n)] if entries else []
-    out = []
-    for k, v in picked:
-        out += [k, v] if withvalues else [k]
-    return out
-
-
-@register("HSCAN")
-def cmd_hscan(server, ctx, args):
-    pattern, count, novalues = _scan_opts(args, 2)
-    m = _typed_handle(server, "get_map", _s(args[0]))
-    entries = sorted(m.read_all_entry_set())
-    if pattern is not None:
-        entries = [e for e in entries if _glob_match(pattern, e[0].decode(errors="replace"))]
-    cur, page = _scan_page(entries, _int(args[1]), count)
-    flat = []
-    for k, v in page:
-        flat += [k] if novalues else [k, v]
-    return [cur, flat]
-
-
-# -- typed surface expansion (sets) ------------------------------------------
-
-def _set(server, name: str):
-    return _typed_handle(server, "get_set", name)
-
-
-@register("SPOP")
-def cmd_spop(server, ctx, args):
-    s = _set(server, _s(args[0]))
-    if len(args) == 1:
-        v = s.remove_random()
-        return None if v is None else bytes(v)
-    return [bytes(v) for v in (s.remove_random() for _ in range(_int(args[1]))) if v is not None]
-
-
-@register("SRANDMEMBER")
-def cmd_srandmember(server, ctx, args):
-    import random
-
-    s = _set(server, _s(args[0]))
-    if len(args) == 1:
-        v = s.random_member()
-        return None if v is None else bytes(v)
-    n = _int(args[1])
-    members = s.read_all()
-    if n >= 0:
-        return random.sample(members, min(n, len(members)))
-    return [random.choice(members) for _ in range(-n)] if members else []
-
-
-@register("SMISMEMBER")
-def cmd_smismember(server, ctx, args):
-    s = _set(server, _s(args[0]))
-    return [1 if s.contains(bytes(m)) else 0 for m in args[1:]]
-
-
-@register("SMOVE")
-def cmd_smove(server, ctx, args):
-    return 1 if _set(server, _s(args[0])).move(_s(args[1]), bytes(args[2])) else 0
-
-
-@register("SINTER")
-def cmd_sinter(server, ctx, args):
-    # set combination replies are RESP3 `~` set frames, like SMEMBERS
-    return set(_set(server, _s(args[0])).read_intersection(*[_s(n) for n in args[1:]]))
-
-
-@register("SUNION")
-def cmd_sunion(server, ctx, args):
-    return set(_set(server, _s(args[0])).read_union(*[_s(n) for n in args[1:]]))
-
-
-@register("SDIFF")
-def cmd_sdiff(server, ctx, args):
-    return set(_set(server, _s(args[0])).read_diff(*[_s(n) for n in args[1:]]))
-
-
-def _set_store(server, args, op: str):
-    # Redis *STORE semantics: result = op over the SOURCES only, dest is
-    # overwritten (its old content never participates).  The handle-level
-    # union/intersection/diff include self, so compute via the first
-    # source's read_* form and write the result — all under one lock scope
-    # (record RLocks are re-entrant per thread, so the nested handle locks
-    # are safe)
-    dest = _s(args[0])
-    srcs = [_s(n) for n in args[1:]]
-    with server.engine.locked_many([dest, *srcs]):
-        result = getattr(_set(server, srcs[0]), op)(*srcs[1:])
-        server.engine.store.delete(dest)
-        d = _set(server, dest)
-        if result:
-            d.add_all(bytes(v) for v in result)
-        return len(result)
-
-
-@register("SINTERSTORE")
-def cmd_sinterstore(server, ctx, args):
-    return _set_store(server, args, "read_intersection")
-
-
-@register("SUNIONSTORE")
-def cmd_sunionstore(server, ctx, args):
-    return _set_store(server, args, "read_union")
-
-
-@register("SDIFFSTORE")
-def cmd_sdiffstore(server, ctx, args):
-    return _set_store(server, args, "read_diff")
-
-
-@register("SINTERCARD")
-def cmd_sintercard(server, ctx, args):
-    n = _int(args[0])
-    names = [_s(k) for k in args[1 : 1 + n]]
-    limit = None
-    if len(args) > 1 + n:
-        if bytes(args[1 + n]).upper() != b"LIMIT":
-            raise RespError("ERR syntax error")
-        limit = _int(args[2 + n])
-        if limit < 0:
-            raise RespError("ERR LIMIT can't be negative")
-    inter = _set(server, names[0]).read_intersection(*names[1:])
-    card = len(inter)
-    return min(card, limit) if limit not in (None, 0) else card
-
-
-@register("SSCAN")
-def cmd_sscan(server, ctx, args):
-    pattern, count, _ = _scan_opts(args, 2)
-    members = sorted(bytes(v) for v in _set(server, _s(args[0])).read_all())
-    if pattern is not None:
-        members = [m for m in members if _glob_match(pattern, m.decode(errors="replace"))]
-    return _scan_page(members, _int(args[1]), count)
-
-
-# -- typed surface expansion (lists) -----------------------------------------
-# Compound list edits operate on the queue record's host list directly under
-# the record lock (the handle exposes the safe subset; Redis list verbs like
-# LINSERT/LREM need positional surgery).
-
-def _list_edit(server, name: str):
-    d = _deque(server, name)
-    rec = d._rec_or_create()
-    return d, rec
-
-
-@register("LPUSHX")
-def cmd_lpushx(server, ctx, args):
-    name = _s(args[0])
-    with server.engine.locked(name):
-        if not server.engine.store.exists(name):
-            return 0
-        d = _deque(server, name)
-        for v in args[1:]:
-            d.add_first(bytes(v))
-        return d.size()
-
-
-@register("RPUSHX")
-def cmd_rpushx(server, ctx, args):
-    name = _s(args[0])
-    with server.engine.locked(name):
-        if not server.engine.store.exists(name):
-            return 0
-        d = _deque(server, name)
-        for v in args[1:]:
-            d.add_last(bytes(v))
-        return d.size()
-
-
-@register("LSET")
-def cmd_lset(server, ctx, args):
-    name = _s(args[0])
-    with server.engine.locked(name):
-        if not server.engine.store.exists(name):
-            raise RespError("ERR no such key")
-        d, rec = _list_edit(server, name)
-        i = _int(args[1])
-        if i < 0:
-            i += len(rec.host)
-        if not 0 <= i < len(rec.host):
-            raise RespError("ERR index out of range")
-        rec.host[i] = bytes(args[2])
-        d._touch_version(rec)
-        return "+OK"
-
-
-@register("LINSERT")
-def cmd_linsert(server, ctx, args):
-    name = _s(args[0])
-    where = bytes(args[1]).upper()
-    if where not in (b"BEFORE", b"AFTER"):
-        raise RespError("ERR syntax error")
-    pivot, elem = bytes(args[2]), bytes(args[3])
-    with server.engine.locked(name):
-        if not server.engine.store.exists(name):
-            return 0
-        d, rec = _list_edit(server, name)
-        try:
-            i = rec.host.index(pivot)
-        except ValueError:
-            return -1
-        rec.host.insert(i if where == b"BEFORE" else i + 1, elem)
-        d._touch_version(rec)
-        return len(rec.host)
-
-
-@register("LREM")
-def cmd_lrem(server, ctx, args):
-    name = _s(args[0])
-    n, target = _int(args[1]), bytes(args[2])
-    with server.engine.locked(name):
-        if not server.engine.store.exists(name):
-            return 0
-        d, rec = _list_edit(server, name)
-        items = rec.host
-        removed = 0
-        if n == 0:
-            before = len(items)
-            rec.host = [v for v in items if v != target]
-            removed = before - len(rec.host)
-        elif n > 0:
-            out = []
-            for v in items:
-                if v == target and removed < n:
-                    removed += 1
-                else:
-                    out.append(v)
-            rec.host = out
-        else:
-            out = []
-            for v in reversed(items):
-                if v == target and removed < -n:
-                    removed += 1
-                else:
-                    out.append(v)
-            rec.host = out[::-1]
-        if removed:
-            d._touch_version(rec)
-        return removed
-
-
-@register("LTRIM")
-def cmd_ltrim(server, ctx, args):
-    from redisson_tpu.client.objects.scoredsortedset import _norm_range
-
-    name = _s(args[0])
-    with server.engine.locked(name):
-        if not server.engine.store.exists(name):
-            return "+OK"
-        d, rec = _list_edit(server, name)
-        lo, hi = _norm_range(_int(args[1]), _int(args[2]), len(rec.host))
-        rec.host = rec.host[lo : hi + 1] if hi >= lo else []
-        d._touch_version(rec)
-        return "+OK"
-
-
-@register("LPOS")
-def cmd_lpos(server, ctx, args):
-    name = _s(args[0])
-    target = bytes(args[1])
-    rank, num = 1, None
-    i = 2
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"RANK":
-            rank = _int(args[i + 1])
-            if rank == 0:
-                raise RespError("ERR RANK can't be zero")
-            i += 2
-        elif opt == b"COUNT":
-            num = _int(args[i + 1])
-            i += 2
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    if not server.engine.store.exists(name):
-        return None if num is None else []
-    items = [bytes(v) for v in _deque(server, name).read_all()]
-    order = range(len(items)) if rank > 0 else range(len(items) - 1, -1, -1)
-    skip = abs(rank) - 1
-    hits = []
-    for idx in order:
-        if items[idx] != target:
-            continue
-        if skip:
-            skip -= 1
-            continue
-        hits.append(idx)
-        if num is None:  # single-answer form: first match wins
-            break
-        if num != 0 and len(hits) >= num:  # COUNT 0 = all matches
-            break
-    if num is None:
-        return hits[0] if hits else None
-    return hits
-
-
-def _list_move(server, src: str, dst: str, from_left: bool, to_left: bool):
-    with server.engine.locked_many((src, dst)):
-        s = _deque(server, src)
-        v = s.poll_first() if from_left else s.poll_last()
-        if v is None:
-            return None
-        d = _deque(server, dst)
-        (d.add_first if to_left else d.add_last)(bytes(v))
-        return bytes(v)
-
-
-@register("LMOVE")
-def cmd_lmove(server, ctx, args):
-    wherefrom = bytes(args[2]).upper()
-    whereto = bytes(args[3]).upper()
-    if wherefrom not in (b"LEFT", b"RIGHT") or whereto not in (b"LEFT", b"RIGHT"):
-        raise RespError("ERR syntax error")
-    return _list_move(
-        server, _s(args[0]), _s(args[1]), wherefrom == b"LEFT", whereto == b"LEFT"
-    )
-
-
-@register("RPOPLPUSH")
-def cmd_rpoplpush(server, ctx, args):
-    return _list_move(server, _s(args[0]), _s(args[1]), False, True)
-
-
-# -- typed surface expansion (sorted sets) -----------------------------------
-
-def _zset(server, name: str):
-    return _typed_handle(server, "get_scored_sorted_set", name)
-
-
-def _zbound(raw: bytes):
-    """Parse a ZRANGEBYSCORE bound: -inf/+inf, (exclusive, or inclusive."""
-    s = bytes(raw)
-    inc = True
-    if s.startswith(b"("):
-        inc = False
-        s = s[1:]
-    if s in (b"-inf", b"+inf", b"inf"):
-        return (float("-inf") if s == b"-inf" else float("inf")), inc
-    return float(s), inc
-
-
-@register("ZCOUNT")
-def cmd_zcount(server, ctx, args):
-    lo, lo_inc = _zbound(args[1])
-    hi, hi_inc = _zbound(args[2])
-    return _zset(server, _s(args[0])).count(lo, lo_inc, hi, hi_inc)
-
-
-def _zrangebyscore(server, args, reverse: bool):
-    z = _zset(server, _s(args[0]))
-    if reverse:  # ZREVRANGEBYSCORE takes max first
-        hi, hi_inc = _zbound(args[1])
-        lo, lo_inc = _zbound(args[2])
-    else:
-        lo, lo_inc = _zbound(args[1])
-        hi, hi_inc = _zbound(args[2])
-    withscores = False
-    offset, limit = 0, None
-    i = 3
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"WITHSCORES":
-            withscores = True
-            i += 1
-        elif opt == b"LIMIT":
-            offset, limit = _int(args[i + 1]), _int(args[i + 2])
-            i += 3
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    from redisson_tpu.client.objects.scoredsortedset import _in_score
-
-    entries = [
-        (m, sc)
-        for m, sc in z.entry_range(0, -1)
-        if _in_score(sc, lo, lo_inc, hi, hi_inc)
-    ]
-    if reverse:
-        entries.reverse()
-    if limit is not None and limit >= 0:
-        entries = entries[offset : offset + limit]
-    elif offset:
-        entries = entries[offset:]
-    out = []
-    for m, sc in entries:
-        out += [m, _fnum(sc)] if withscores else [m]
-    return out
-
-
-@register("ZRANGEBYSCORE")
-def cmd_zrangebyscore(server, ctx, args):
-    return _zrangebyscore(server, args, reverse=False)
-
-
-@register("ZREVRANGEBYSCORE")
-def cmd_zrevrangebyscore(server, ctx, args):
-    return _zrangebyscore(server, args, reverse=True)
-
-
-@register("ZREVRANGE")
-def cmd_zrevrange(server, ctx, args):
-    z = _zset(server, _s(args[0]))
-    withscores = len(args) > 3 and bytes(args[3]).upper() == b"WITHSCORES"
-    entries = z.entry_range(0, -1)
-    entries.reverse()
-    from redisson_tpu.client.objects.scoredsortedset import _norm_range
-
-    lo, hi = _norm_range(_int(args[1]), _int(args[2]), len(entries))
-    entries = entries[lo : hi + 1] if hi >= lo else []
-    out = []
-    for m, sc in entries:
-        out += [m, _fnum(sc)] if withscores else [m]
-    return out
-
-
-@register("ZREVRANK")
-def cmd_zrevrank(server, ctx, args):
-    return _zset(server, _s(args[0])).rev_rank(bytes(args[1]))
-
-
-def _zpop(server, args, first: bool):
-    z = _zset(server, _s(args[0]))
-    n = _int(args[1]) if len(args) > 1 else 1
-    out = []
-    for _ in range(n):
-        entry = z.poll_first_entry() if first else z.poll_last_entry()
-        if entry is None:
-            break
-        m, sc = entry
-        out += [m, _fnum(sc)]
-    return out
-
-
-@register("ZPOPMIN")
-def cmd_zpopmin(server, ctx, args):
-    return _zpop(server, args, first=True)
-
-
-@register("ZPOPMAX")
-def cmd_zpopmax(server, ctx, args):
-    return _zpop(server, args, first=False)
-
-
-@register("ZMSCORE")
-def cmd_zmscore(server, ctx, args):
-    z = _zset(server, _s(args[0]))
-    out = []
-    for m in args[1:]:
-        sc = z.get_score(bytes(m))
-        out.append(None if sc is None else float(sc))
-    return out
-
-
-@register("ZRANDMEMBER")
-def cmd_zrandmember(server, ctx, args):
-    import random
-
-    z = _zset(server, _s(args[0]))
-    entries = z.entry_range(0, -1)
-    if len(args) == 1:
-        return random.choice(entries)[0] if entries else None
-    n = _int(args[1])
-    withscores = len(args) > 2 and bytes(args[2]).upper() == b"WITHSCORES"
-    if n >= 0:
-        picked = random.sample(entries, min(n, len(entries)))
-    else:
-        picked = [random.choice(entries) for _ in range(-n)] if entries else []
-    out = []
-    for m, sc in picked:
-        out += [m, _fnum(sc)] if withscores else [m]
-    return out
-
-
-@register("ZREMRANGEBYSCORE")
-def cmd_zremrangebyscore(server, ctx, args):
-    lo, lo_inc = _zbound(args[1])
-    hi, hi_inc = _zbound(args[2])
-    return _zset(server, _s(args[0])).remove_range_by_score(lo, lo_inc, hi, hi_inc)
-
-
-@register("ZREMRANGEBYRANK")
-def cmd_zremrangebyrank(server, ctx, args):
-    return _zset(server, _s(args[0])).remove_range_by_rank(_int(args[1]), _int(args[2]))
-
-
-@register("ZSCAN")
-def cmd_zscan(server, ctx, args):
-    pattern, count, _ = _scan_opts(args, 2)
-    entries = sorted(_zset(server, _s(args[0])).entry_range(0, -1))
-    if pattern is not None:
-        entries = [e for e in entries if _glob_match(pattern, e[0].decode(errors="replace"))]
-    cur, page = _scan_page(entries, _int(args[1]), count)
-    flat = []
-    for m, sc in page:
-        flat += [m, _fnum(sc)]
-    return [cur, flat]
-
-
-def _zstore(server, args, op: str):
-    """ZUNIONSTORE/ZINTERSTORE dest numkeys key... [WEIGHTS w...]
-    [AGGREGATE SUM|MIN|MAX] — computed in the handler so WEIGHTS compose
-    (the handle-level union/intersection don't carry weights)."""
-    dest = _s(args[0])
-    n = _int(args[1])
-    names = [_s(k) for k in args[2 : 2 + n]]
-    weights = [1.0] * n
-    agg = "SUM"
-    i = 2 + n
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"WEIGHTS":
-            weights = [float(args[i + 1 + j]) for j in range(n)]
-            i += 1 + n
-        elif opt == b"AGGREGATE":
-            agg = _s(args[i + 1]).upper()
-            if agg not in ("SUM", "MIN", "MAX"):
-                raise RespError("ERR syntax error")
-            i += 2
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    with server.engine.locked_many([dest, *names]):
-        maps = []
-        for nm, w in zip(names, weights):
-            maps.append({m: sc * w for m, sc in _zset(server, nm).entry_range(0, -1)})
-        if op == "union":
-            acc: Dict[bytes, float] = {}
-            for mp in maps:
-                for m, sc in mp.items():
-                    if m in acc:
-                        acc[m] = sc + acc[m] if agg == "SUM" else (min if agg == "MIN" else max)(acc[m], sc)
-                    else:
-                        acc[m] = sc
-        else:  # intersection
-            keys = set(maps[0]) if maps else set()
-            for mp in maps[1:]:
-                keys &= set(mp)
-            acc = {}
-            for m in keys:
-                vals = [mp[m] for mp in maps]
-                acc[m] = sum(vals) if agg == "SUM" else (min(vals) if agg == "MIN" else max(vals))
-        server.engine.store.delete(dest)
-        z = _zset(server, dest)
-        for m, sc in acc.items():
-            z.add(sc, m)
-        return len(acc)
-
-
-@register("ZUNIONSTORE")
-def cmd_zunionstore(server, ctx, args):
-    return _zstore(server, args, "union")
-
-
-@register("ZINTERSTORE")
-def cmd_zinterstore(server, ctx, args):
-    return _zstore(server, args, "intersection")
-
-
-# -- typed surface expansion round 3: generic verbs, lex ranges, multi-pops,
-# -- blocking family (RedisCommands.java rows toward full verb parity) -------
-
-@register("COPY")
-def cmd_copy(server, ctx, args):
-    """COPY src dst [REPLACE] — record-level clone, any object kind
-    (core/checkpoint.clone_record: device arrays deep-copy on device since
-    records mutate through donated buffers)."""
-    from redisson_tpu.core import checkpoint
-
-    src, dst = _s(args[0]), _s(args[1])
-    replace = any(bytes(a).upper() == b"REPLACE" for a in args[2:])
-    return 1 if checkpoint.clone_record(server.engine, src, dst, replace) else 0
-
-
-@register("RENAMENX")
-def cmd_renamenx(server, ctx, args):
-    src, dst = _s(args[0]), _s(args[1])
-    with server.engine.locked_many([src, dst]):
-        if not server.engine.store.exists(src):
-            raise RespError("ERR no such key")
-        if server.engine.store.exists(dst):
-            return 0
-        server.engine.store.rename(src, dst)
-    return 1
-
-
-@register("BITPOS")
-def cmd_bitpos(server, ctx, args):
-    """BITPOS key bit [start [end]] — byte-indexed range, Redis semantics:
-    searching for 0 with NO explicit end treats the value as right-padded
-    with zeros (position past the last byte); with an explicit end, -1."""
-    bit = _int(args[1])
-    if bit not in (0, 1):
-        raise RespError("ERR The bit argument must be 1 or 0.")
-    if len(args) > 4:
-        raise RespError("ERR syntax error")
-    data = _bitset(server, _s(args[0])).to_byte_array()
-    nbytes = len(data)
-    start = _int(args[2]) if len(args) > 2 else 0
-    has_end = len(args) > 3
-    end = _int(args[3]) if has_end else nbytes - 1
-    if start < 0:
-        start = max(0, nbytes + start)
-    if end < 0:
-        end = nbytes + end
-    end = min(end, nbytes - 1)
-    want = bool(bit)
-    # bit order matches SETBIT/GETBIT's indexing (LSB-first within a byte,
-    # the BitSet layout) so BITPOS(SETBIT(i)) == i on this surface
-    for byte_i in range(start, end + 1):
-        b = data[byte_i]
-        for bit_i in range(8):
-            if bool((b >> bit_i) & 1) == want:
-                return byte_i * 8 + bit_i
-    if not want and not has_end and start <= nbytes:
-        return nbytes * 8  # zeros continue past the stored bytes
-    return -1
-
-
-@register("SORT")
-def cmd_sort(server, ctx, args):
-    """SORT key [LIMIT off cnt] [ASC|DESC] [ALPHA] [STORE dest] over list or
-    set records (the RedissonList/SortedSet sort surface)."""
-    name = _s(args[0])
-    off, cnt, desc, alpha, store = 0, None, False, False, None
-    i = 1
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"LIMIT":
-            off, cnt = _int(args[i + 1]), _int(args[i + 2])
-            i += 3
-        elif opt in (b"ASC", b"DESC"):
-            desc = opt == b"DESC"
-            i += 1
-        elif opt == b"ALPHA":
-            alpha = True
-            i += 1
-        elif opt == b"STORE":
-            store = _s(args[i + 1])
-            i += 2
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    rec = server.engine.store.get(name)
-    if rec is None:
-        vals = []
-    elif rec.kind == "set":
-        vals = [bytes(v) for v in _set(server, name).read_all()]
-    else:
-        vals = [bytes(v) for v in _deque(server, name).read_all()]
-    if alpha:
-        vals.sort(reverse=desc)
-    else:
-        try:
-            vals.sort(key=float, reverse=desc)
-        except ValueError:
-            raise RespError("ERR One or more scores can't be converted into double")
-    if cnt is not None:
-        vals = vals[off : off + cnt] if cnt >= 0 else vals[off:]
-    if store is None:
-        return vals
-    with server.engine.locked(store):
-        server.engine.store.delete(store)
-        d = _deque(server, store)
-        for v in vals:
-            d.add_last(v)
-    return len(vals)
-
-
-# -- lex ranges over sorted sets ---------------------------------------------
-
-def _lex_bound(raw):
-    """Returns (value|None, inclusive).  None value = unbounded (-/+)."""
-    s = bytes(raw)
-    if s in (b"-", b"+"):
-        return None, True
-    if s.startswith(b"["):
-        return s[1:], True
-    if s.startswith(b"("):
-        return s[1:], False
-    raise RespError("ERR min or max not valid string range item")
-
-
-def _lex_slice(server, name: str, lo_raw, hi_raw):
-    lo, lo_inc = _lex_bound(lo_raw)
-    hi, hi_inc = _lex_bound(hi_raw)
-    lo_unbounded = bytes(lo_raw) == b"-"
-    hi_unbounded = bytes(hi_raw) == b"+"
-    if bytes(lo_raw) == b"+" or bytes(hi_raw) == b"-":
-        return []  # inverted unbounded forms select nothing
-    members = sorted(bytes(m) for m, _ in _zset(server, name).entry_range(0, -1))
-    out = []
-    for m in members:
-        if not lo_unbounded:
-            if m < lo or (m == lo and not lo_inc):
-                continue
-        if not hi_unbounded:
-            if m > hi or (m == hi and not hi_inc):
-                continue
-        out.append(m)
-    return out
-
-
-@register("ZLEXCOUNT")
-def cmd_zlexcount(server, ctx, args):
-    return len(_lex_slice(server, _s(args[0]), args[1], args[2]))
-
-
-@register("ZRANGEBYLEX")
-def cmd_zrangebylex(server, ctx, args):
-    out = _lex_slice(server, _s(args[0]), args[1], args[2])
-    return _apply_limit(out, args, 3)
-
-
-@register("ZREVRANGEBYLEX")
-def cmd_zrevrangebylex(server, ctx, args):
-    # note the reversed bound order: ZREVRANGEBYLEX key max min
-    out = _lex_slice(server, _s(args[0]), args[2], args[1])
-    out.reverse()
-    return _apply_limit(out, args, 3)
-
-
-@register("ZREMRANGEBYLEX")
-def cmd_zremrangebylex(server, ctx, args):
-    name = _s(args[0])
-    with server.engine.locked(name):
-        victims = _lex_slice(server, name, args[1], args[2])
-        z = _zset(server, name)
-        for m in victims:
-            z.remove(m)
-    return len(victims)
-
-
-def _apply_limit(out, args, at):
-    if len(args) > at:
-        if bytes(args[at]).upper() != b"LIMIT" or len(args) < at + 3:
-            raise RespError("ERR syntax error")
-        off, cnt = _int(args[at + 1]), _int(args[at + 2])
-        out = out[off : off + cnt] if cnt >= 0 else out[off:]
-    return out
-
-
-# -- zset combination reads + range store ------------------------------------
-
-def _znumkeys(server, args, at=0):
-    n = _int(args[at])
-    if n <= 0:
-        raise RespError("ERR numkeys should be greater than 0")
-    if len(args) < at + 1 + n:
-        raise RespError("ERR Number of keys can't be greater than number of args")
-    names = [_s(k) for k in args[at + 1 : at + 1 + n]]
-    return n, names, at + 1 + n
-
-
-def _zcombine(server, names, op, weights=None, agg="SUM"):
-    fold = sum if agg == "SUM" else (min if agg == "MIN" else max)
-    weights = weights or [1.0] * len(names)
-    maps = [
-        {m: sc * w for m, sc in _zset(server, nm).entry_range(0, -1)}
-        for nm, w in zip(names, weights)
-    ]
-    if not maps:
-        return {}
-    if op == "union":
-        acc: dict = {}
-        for mp in maps:
-            for m, sc in mp.items():
-                acc[m] = fold((acc[m], sc)) if m in acc else sc
-        return acc
-    if op == "inter":
-        keys = set(maps[0])
-        for mp in maps[1:]:
-            keys &= set(mp)
-        return {m: fold(mp[m] for mp in maps) for m in keys}
-    # diff: first minus membership of the rest, scores from the first
-    drop = set()
-    for mp in maps[1:]:
-        drop |= set(mp)
-    return {m: sc for m, sc in maps[0].items() if m not in drop}
-
-
-def _zcombo_read(server, ctx, args, op):
-    n, names, i = _znumkeys(server, args)
-    weights, agg, withscores = None, "SUM", False
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"WITHSCORES":
-            withscores = True
-            i += 1
-        elif opt == b"WEIGHTS" and op != "diff":  # ZDIFF takes no modifiers
-            if len(args) < i + 1 + n:
-                raise RespError("ERR syntax error")
-            weights = [float(args[i + 1 + j]) for j in range(n)]
-            i += 1 + n
-        elif opt == b"AGGREGATE" and op != "diff":
-            agg = _s(args[i + 1]).upper() if len(args) > i + 1 else ""
-            if agg not in ("SUM", "MIN", "MAX"):
-                raise RespError("ERR syntax error")
-            i += 2
-        else:
-            # unknown trailing args must ERROR, never silently drop —
-            # a typo'd WITHSCORES would otherwise return wrong-shaped data
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    with server.engine.locked_many(names):
-        acc = _zcombine(server, names, op, weights, agg)
-    out = []
-    for m, sc in sorted(acc.items(), key=lambda kv: (kv[1], kv[0])):
-        out += [m, _fnum(sc)] if withscores else [m]
-    return out
-
-
-@register("ZDIFF")
-def cmd_zdiff(server, ctx, args):
-    return _zcombo_read(server, ctx, args, "diff")
-
-
-@register("ZINTER")
-def cmd_zinter(server, ctx, args):
-    return _zcombo_read(server, ctx, args, "inter")
-
-
-@register("ZUNION")
-def cmd_zunion(server, ctx, args):
-    return _zcombo_read(server, ctx, args, "union")
-
-
-@register("ZDIFFSTORE")
-def cmd_zdiffstore(server, ctx, args):
-    dest = _s(args[0])
-    _n, names, _i = _znumkeys(server, args, 1)
-    with server.engine.locked_many([dest, *names]):
-        acc = _zcombine(server, names, "diff")
-        server.engine.store.delete(dest)
-        z = _zset(server, dest)
-        for m, sc in acc.items():
-            z.add(sc, m)
-    return len(acc)
-
-
-@register("ZRANGESTORE")
-def cmd_zrangestore(server, ctx, args):
-    """ZRANGESTORE dst src min max [BYSCORE|BYLEX] [REV] [LIMIT off cnt]."""
-    dst, src = _s(args[0]), _s(args[1])
-    by, rev = b"INDEX", False
-    limit_at = None
-    i = 4
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt in (b"BYSCORE", b"BYLEX"):
-            by = opt
-            i += 1
-        elif opt == b"REV":
-            rev = True
-            i += 1
-        elif opt == b"LIMIT":
-            limit_at = i
-            i += 3
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    if limit_at is not None and by == b"INDEX":
-        raise RespError("ERR syntax error, LIMIT is only supported in combination with either BYSCORE or BYLEX")
-    with server.engine.locked_many([dst, src]):
-        lo_raw, hi_raw = (args[3], args[2]) if rev else (args[2], args[3])
-        if by == b"BYLEX":
-            members = _lex_slice(server, src, lo_raw, hi_raw)
-            z = _zset(server, src)
-            entries = [(m, z.get_score(m) or 0.0) for m in members]
-        elif by == b"BYSCORE":
-            lo, lo_inc = _zbound(lo_raw)
-            hi, hi_inc = _zbound(hi_raw)
-            entries = [
-                (bytes(m), sc)
-                for m, sc in _zset(server, src).entry_range(0, -1)
-                if (sc > lo or (sc == lo and lo_inc)) and (sc < hi or (sc == hi and hi_inc))
-            ]
-        else:
-            all_entries = _zset(server, src).entry_range(0, -1)
-            from redisson_tpu.client.objects.scoredsortedset import _norm_range
-
-            start, stop = _int(args[2]), _int(args[3])
-            if rev:
-                all_entries.reverse()
-            lo_i, hi_i = _norm_range(start, stop, len(all_entries))
-            entries = [
-                (bytes(m), sc) for m, sc in
-                (all_entries[lo_i : hi_i + 1] if hi_i >= lo_i else [])
-            ]
-        if rev and by != b"INDEX":
-            entries.reverse()
-        if limit_at is not None:
-            off, cnt = _int(args[limit_at + 1]), _int(args[limit_at + 2])
-            entries = entries[off : off + cnt] if cnt >= 0 else entries[off:]
-        server.engine.store.delete(dst)
-        z = _zset(server, dst)
-        for m, sc in entries:
-            z.add(sc, m)
-    return len(entries)
-
-
-# -- multi-pops + blocking family --------------------------------------------
-
-def _signal_waiters(server, name: str) -> None:
-    """Wake queue-family waiters (pushes through Deque handles signal
-    automatically; ZADD must wake BZPOP*)."""
-    server.engine.signal_queue_waiters(name)
-
-
-def _block_loop(server, first_key: str, poll_once, timeout: float):
-    """Shared BLPOP/BRPOP/BZPOP/BLMOVE wait loop.  timeout<=0 = forever
-    (the reference marks these isBlockingCommand: they bypass ping timeouts
-    and hold their connection; here they hold one slow-pool worker)."""
-    import time as _t
-
-    if getattr(_exec_tls, "in_exec", False):
-        # blocking verbs inside MULTI/EXEC act as an immediate-timeout poll
-        return poll_once()
-    deadline = None if timeout <= 0 else _t.time() + timeout
-    entry = server.engine.queue_wait_entry(first_key)
-    while not getattr(server, "_closing", False):
-        r = poll_once()
-        if r is not None:
-            return r
-        remaining = None if deadline is None else deadline - _t.time()
-        if remaining is not None and remaining <= 0:
-            return None
-        entry.wait_for(min(0.05, remaining) if remaining is not None else 0.05)
-    return None  # server stopping: unpark, reply nil
-
-
-def _bpop(server, args, first: bool):
-    names = [_s(k) for k in args[:-1]]
-    timeout = float(args[-1])
-
-    def poll_once():
-        for nm in names:
-            v = _deque(server, nm).poll_first() if first else _deque(server, nm).poll_last()
-            if v is not None:
-                return [nm.encode(), bytes(v)]
-        return None
-
-    return _block_loop(server, names[0], poll_once, timeout)
-
-
-@register("BLPOP")
-def cmd_blpop(server, ctx, args):
-    return _bpop(server, args, first=True)
-
-
-@register("BRPOP")
-def cmd_brpop(server, ctx, args):
-    return _bpop(server, args, first=False)
-
-
-@register("BLMOVE")
-def cmd_blmove(server, ctx, args):
-    src, dst = _s(args[0]), _s(args[1])
-    wherefrom = bytes(args[2]).upper()
-    whereto = bytes(args[3]).upper()
-    if wherefrom not in (b"LEFT", b"RIGHT") or whereto not in (b"LEFT", b"RIGHT"):
-        raise RespError("ERR syntax error")
-    timeout = float(args[4])
-
-    def poll_once():
-        return _list_move(server, src, dst, wherefrom == b"LEFT", whereto == b"LEFT")
-
-    return _block_loop(server, src, poll_once, timeout)
-
-
-@register("BRPOPLPUSH")
-def cmd_brpoplpush(server, ctx, args):
-    src, dst = _s(args[0]), _s(args[1])
-    timeout = float(args[2])
-
-    def poll_once():
-        return _list_move(server, src, dst, False, True)
-
-    return _block_loop(server, src, poll_once, timeout)
-
-
-@register("LMPOP")
-def cmd_lmpop(server, ctx, args):
-    """LMPOP numkeys key... LEFT|RIGHT [COUNT n]."""
-    _n, names, i = _znumkeys(server, args)
-    where = bytes(args[i]).upper()
-    if where not in (b"LEFT", b"RIGHT"):
-        raise RespError("ERR syntax error")
-    count = 1
-    if len(args) > i + 1:
-        if bytes(args[i + 1]).upper() != b"COUNT" or len(args) <= i + 2:
-            raise RespError("ERR syntax error")
-        count = _int(args[i + 2])
-    for nm in names:
-        with server.engine.locked(nm):  # the COUNT batch pops atomically
-            d = _deque(server, nm)
-            popped = []
-            for _ in range(count):
-                v = d.poll_first() if where == b"LEFT" else d.poll_last()
-                if v is None:
-                    break
-                popped.append(bytes(v))
-        if popped:
-            return [nm.encode(), popped]
-    return None
-
-
-def _zpop_entry(server, name: str, first: bool):
-    z = _zset(server, name)
-    entries = z.entry_range(0, 0) if first else z.entry_range(-1, -1)
-    if not entries:
-        return None
-    m, sc = entries[0]
-    z.remove(m)
-    return bytes(m), sc
-
-
-@register("ZMPOP")
-def cmd_zmpop(server, ctx, args):
-    """ZMPOP numkeys key... MIN|MAX [COUNT n]."""
-    _n, names, i = _znumkeys(server, args)
-    which = bytes(args[i]).upper()
-    if which not in (b"MIN", b"MAX"):
-        raise RespError("ERR syntax error")
-    count = 1
-    if len(args) > i + 1:
-        if bytes(args[i + 1]).upper() != b"COUNT" or len(args) <= i + 2:
-            raise RespError("ERR syntax error")
-        count = _int(args[i + 2])
-    for nm in names:
-        with server.engine.locked(nm):
-            flat = []
-            for _ in range(count):
-                e = _zpop_entry(server, nm, which == b"MIN")
-                if e is None:
-                    break
-                flat += [e[0], _fnum(e[1])]
-        if flat:
-            return [nm.encode(), flat]
-    return None
-
-
-def _bzpop(server, args, first: bool):
-    names = [_s(k) for k in args[:-1]]
-    timeout = float(args[-1])
-
-    def poll_once():
-        for nm in names:
-            with server.engine.locked(nm):
-                e = _zpop_entry(server, nm, first)
-            if e is not None:
-                return [nm.encode(), e[0], _fnum(e[1])]
-        return None
-
-    return _block_loop(server, names[0], poll_once, timeout)
-
-
-@register("BZPOPMIN")
-def cmd_bzpopmin(server, ctx, args):
-    return _bzpop(server, args, first=True)
-
-
-@register("BZPOPMAX")
-def cmd_bzpopmax(server, ctx, args):
-    return _bzpop(server, args, first=False)
-
-
-# -- typed stream verbs (XADD family — RedissonStream.java wire parity) ------
-
-def _stream(server, name: str):
-    return _typed_handle(server, "get_stream", name)
-
-
-def _stream_cmd(fn):
-    """Map stream-handle exceptions to Redis reply shapes: BUSYGROUP /
-    NOGROUP pass through verbatim (clients pattern-match those prefixes),
-    anything else becomes a plain ERR instead of 'ERR internal: ...'."""
-    import functools
-
-    @functools.wraps(fn)
-    def wrapper(server, ctx, args):
-        try:
-            return fn(server, ctx, args)
-        except ValueError as e:
-            msg = str(e)
-            raise RespError(msg if msg.startswith("BUSYGROUP") else f"ERR {msg}")
-        except KeyError as e:
-            msg = str(e.args[0]) if e.args else str(e)
-            raise RespError(msg if msg.startswith("NOGROUP") else f"ERR {msg}")
-        except IndexError:
-            raise RespError("ERR syntax error")
-
-    return wrapper
-
-
-def _xentries(d) -> list:
-    """Dict[id, fields] -> Redis XRANGE reply shape [[id, [f, v, ...]], ...]."""
-    out = []
-    for i, fields in d.items():
-        flat = []
-        for k, v in fields.items():
-            flat += [k, v]
-        out.append([i.encode() if isinstance(i, str) else i, flat])
-    return out
-
-
-@register("XADD")
-@_stream_cmd
-def cmd_xadd(server, ctx, args):
-    """XADD key [NOMKSTREAM] [MAXLEN|MINID [~|=] threshold] <id|*> f v ..."""
-    name = _s(args[0])
-    i = 1
-    nomkstream = False
-    trim_kind, trim_arg = None, None
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"NOMKSTREAM":
-            nomkstream = True
-            i += 1
-        elif opt in (b"MAXLEN", b"MINID"):
-            j = i + 1
-            if bytes(args[j]) in (b"~", b"="):  # approximate == exact here
-                j += 1
-            trim_kind, trim_arg = opt, args[j]
-            i = j + 1
-        else:
-            break
-    if i >= len(args) or (len(args) - i - 1) % 2 != 0 or len(args) - i - 1 == 0:
-        raise RespError("ERR wrong number of arguments for 'xadd' command")
-    if nomkstream and not server.engine.store.exists(name):
-        return None
-    entry_id = _s(args[i])
-    fields = {bytes(args[j]): bytes(args[j + 1]) for j in range(i + 1, len(args) - 1, 2)}
-    st = _stream(server, name)
-    try:
-        rid = st.add(fields, id=None if entry_id == "*" else entry_id)
-    except ValueError as e:
-        raise RespError(f"ERR {e}")
-    if trim_kind == b"MAXLEN":
-        st.trim(_int(trim_arg))
-    elif trim_kind == b"MINID":
-        st.trim_by_min_id(_s(trim_arg))
-    return rid.encode()
-
-
-@register("XLEN")
-@_stream_cmd
-def cmd_xlen(server, ctx, args):
-    return _stream(server, _s(args[0])).size()
-
-
-def _xrange(server, args, reverse: bool):
-    count = None
-    if len(args) > 3:
-        if bytes(args[3]).upper() != b"COUNT":
-            raise RespError("ERR syntax error")
-        count = _int(args[4])
-    st = _stream(server, _s(args[0]))
-    a, b = _s(args[1]), _s(args[2])
-    d = st.rev_range(a, b, count) if reverse else st.range(a, b, count)
-    return _xentries(d)
-
-
-@register("XRANGE")
-@_stream_cmd
-def cmd_xrange(server, ctx, args):
-    return _xrange(server, args, reverse=False)
-
-
-@register("XREVRANGE")
-@_stream_cmd
-def cmd_xrevrange(server, ctx, args):
-    return _xrange(server, args, reverse=True)
-
-
-@register("XDEL")
-@_stream_cmd
-def cmd_xdel(server, ctx, args):
-    return _stream(server, _s(args[0])).remove(*[_s(i) for i in args[1:]])
-
-
-@register("XTRIM")
-@_stream_cmd
-def cmd_xtrim(server, ctx, args):
-    kind = bytes(args[1]).upper()
-    j = 2
-    if bytes(args[j]) in (b"~", b"="):
-        j += 1
-    st = _stream(server, _s(args[0]))
-    if kind == b"MAXLEN":
-        return st.trim(_int(args[j]))
-    if kind == b"MINID":
-        return st.trim_by_min_id(_s(args[j]))
-    raise RespError("ERR syntax error")
-
-
-def _xread_streams(args, i):
-    rest = args[i:]
-    if not rest or len(rest) % 2:
-        raise RespError("ERR Unbalanced XREAD list of streams: for each stream key an ID or '$' must be specified.")
-    nk = len(rest) // 2
-    return [_s(k) for k in rest[:nk]], [_s(v) for v in rest[nk:]]
-
-
-@register("XREAD")
-@_stream_cmd
-def cmd_xread(server, ctx, args):
-    """XREAD [COUNT n] [BLOCK ms] STREAMS key... id...  ('$' = from now)."""
-    import time as _t
-
-    count, block = None, None
-    i = 0
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"COUNT":
-            count = _int(args[i + 1])
-            i += 2
-        elif opt == b"BLOCK":
-            block = _int(args[i + 1]) / 1000.0
-            i += 2
-        elif opt == b"STREAMS":
-            i += 1
-            break
-        else:
-            raise RespError("ERR syntax error")
-    else:
-        raise RespError("ERR syntax error")
-    names, ids = _xread_streams(args, i)
-    resolved = []
-    for nm, fid in zip(names, ids):
-        if fid == "$":
-            fid = _stream(server, nm).last_id() or "0"
-        resolved.append(fid)
-    deadline = None if block is None else _t.time() + block
-    while True:
-        out = []
-        for nm, fid in zip(names, resolved):
-            d = _stream(server, nm).read(from_id=fid, count=count, timeout=0.0)
-            if d:
-                out.append([nm.encode(), _xentries(d)])
-        if out:
-            return out
-        if deadline is None or _t.time() >= deadline:
-            return None
-        server.engine.wait_entry(f"__stream__:{names[0]}").wait_for(
-            min(0.05, max(0.0, deadline - _t.time()))
-        )
-
-
-@register("XGROUP")
-@_stream_cmd
-def cmd_xgroup(server, ctx, args):
-    sub = bytes(args[0]).upper()
-    st = _stream(server, _s(args[1]))
-    if sub == b"CREATE":
-        # MKSTREAM tolerated: records are created on first touch anyway
-        st.create_group(_s(args[2]), from_id=_s(args[3]) if len(args) > 3 else "$")
-        return "+OK"
-    if sub == b"DESTROY":
-        st.remove_group(_s(args[2]))
-        return 1
-    if sub == b"CREATECONSUMER":
-        return 1 if st.create_consumer(_s(args[2]), _s(args[3])) else 0
-    if sub == b"DELCONSUMER":
-        return st.remove_consumer(_s(args[2]), _s(args[3]))
-    if sub == b"SETID":
-        st.set_group_id(_s(args[2]), _s(args[3]))
-        return "+OK"
-    raise RespError(f"ERR Unknown XGROUP subcommand or wrong number of arguments for '{_s(args[0])}'")
-
-
-@register("XREADGROUP")
-@_stream_cmd
-def cmd_xreadgroup(server, ctx, args):
-    """XREADGROUP GROUP g consumer [COUNT n] [BLOCK ms] [NOACK] STREAMS k id."""
-    if bytes(args[0]).upper() != b"GROUP":
-        raise RespError("ERR syntax error")
-    group, consumer = _s(args[1]), _s(args[2])
-    count, block, noack = None, None, False
-    i = 3
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"COUNT":
-            count = _int(args[i + 1])
-            i += 2
-        elif opt == b"BLOCK":
-            block = _int(args[i + 1]) / 1000.0
-            i += 2
-        elif opt == b"NOACK":
-            noack = True
-            i += 1
-        elif opt == b"STREAMS":
-            i += 1
-            break
-        else:
-            raise RespError("ERR syntax error")
-    else:
-        raise RespError("ERR syntax error")
-    names, ids = _xread_streams(args, i)
-    import time as _t
-
-    deadline = None if block is None else _t.time() + block
-    while True:
-        out = []
-        for nm, fid in zip(names, ids):
-            st = _stream(server, nm)
-            # non-blocking sweep across ALL streams: blocking inside one
-            # stream would starve data already waiting in the next
-            d = st.read_group(group, consumer, count=count, timeout=0.0, from_id=fid)
-            if d:
-                if noack:
-                    st.ack(group, *d.keys())
-                out.append([nm.encode(), _xentries(d)])
-        if out:
-            return out
-        if deadline is None or _t.time() >= deadline:
-            return None
-        server.engine.wait_entry(f"__stream__:{names[0]}").wait_for(
-            min(0.05, max(0.0, deadline - _t.time()))
-        )
-
-
-@register("XACK")
-@_stream_cmd
-def cmd_xack(server, ctx, args):
-    return _stream(server, _s(args[0])).ack(_s(args[1]), *[_s(i) for i in args[2:]])
-
-
-@register("XPENDING")
-@_stream_cmd
-def cmd_xpending(server, ctx, args):
-    st = _stream(server, _s(args[0]))
-    group = _s(args[1])
-    if len(args) == 2:  # summary form
-        s = st.pending_summary(group)
-        consumers = [
-            [c.encode(), str(n).encode()] for c, n in sorted(s["consumers"].items())
-        ]
-        return [
-            s["total"],
-            s["min_id"].encode() if s["min_id"] else None,
-            s["max_id"].encode() if s["max_id"] else None,
-            consumers or None,
-        ]
-    # extended: [IDLE ms] start end count [consumer]
-    i = 2
-    min_idle = 0.0
-    if bytes(args[i]).upper() == b"IDLE":
-        min_idle = _int(args[i + 1]) / 1000.0
-        i += 2
-    lo, hi, count = _s(args[i]), _s(args[i + 1]), _int(args[i + 2])
-    consumer = _s(args[i + 3]) if len(args) > i + 3 else None
-    # idle filters BEFORE count (scanning order): counting first could
-    # return empty while matching idle entries exist past the cut
-    rows = st.pending_range(group, lo, hi, count=None, consumer=consumer)
-    rows = [r for r in rows if r["idle"] >= min_idle][:count]
-    return [
-        [r["id"].encode(), r["consumer"].encode(),
-         int(r["idle"] * 1000), r["delivered"]]
-        for r in rows
-    ]
-
-
-@register("XCLAIM")
-@_stream_cmd
-def cmd_xclaim(server, ctx, args):
-    st = _stream(server, _s(args[0]))
-    group, consumer = _s(args[1]), _s(args[2])
-    min_idle = _int(args[3]) / 1000.0
-    ids = []
-    justid = force = False
-    i = 4
-    while i < len(args):
-        a = bytes(args[i]).upper()
-        if a == b"JUSTID":
-            justid = True
-            i += 1
-        elif a == b"FORCE":
-            force = True
-            i += 1
-        elif a in (b"IDLE", b"TIME", b"RETRYCOUNT", b"LASTID"):
-            # PEL metadata knobs: accepted for wire compatibility; delivery
-            # stamps are managed server-side
-            i += 2
-        else:
-            ids.append(_s(args[i]))
-            i += 1
-    claimed = st.claim(group, consumer, min_idle, *ids, force=force)
-    if justid:
-        return [i.encode() for i in claimed]
-    return _xentries(claimed)
-
-
-@register("XAUTOCLAIM")
-@_stream_cmd
-def cmd_xautoclaim(server, ctx, args):
-    st = _stream(server, _s(args[0]))
-    group, consumer = _s(args[1]), _s(args[2])
-    min_idle = _int(args[3]) / 1000.0
-    start = _s(args[4])
-    count = 100
-    justid = False
-    i = 5
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"COUNT":
-            count = _int(args[i + 1])
-            i += 2
-        elif opt == b"JUSTID":
-            justid = True
-            i += 1
-        else:
-            raise RespError("ERR syntax error")
-    cursor, claimed = st.auto_claim(group, consumer, min_idle, start_id=start, count=count)
-    body = [i.encode() for i in claimed] if justid else _xentries(claimed)
-    return [cursor.encode(), body, []]
-
-
-@register("XINFO")
-@_stream_cmd
-def cmd_xinfo(server, ctx, args):
-    sub = bytes(args[0]).upper()
-    st = _stream(server, _s(args[1]))
-    if sub == b"STREAM":
-        last = st.last_id()
-        return [
-            b"length", st.size(),
-            b"last-generated-id", (last or "0-0").encode(),
-            b"groups", len(st.list_groups()),
-        ]
-    if sub == b"GROUPS":
-        out = []
-        for g in st.list_groups():
-            s = st.pending_summary(g)
-            out.append([
-                b"name", g.encode(),
-                b"consumers", len(st.list_consumers(g)),
-                b"pending", s["total"],
-            ])
-        return out
-    if sub == b"CONSUMERS":
-        group = _s(args[2])
-        s = st.pending_summary(group)
-        return [
-            [b"name", c.encode(), b"pending", s["consumers"].get(c, 0)]
-            for c in st.list_consumers(group)
-        ]
-    raise RespError(f"ERR syntax error in XINFO {_s(args[0])}")
-
-
-# -- typed geo verbs (RedissonGeo.java wire parity) --------------------------
-
-def _geo(server, name: str):
-    return _typed_handle(server, "get_geo", name)
-
-
-@register("GEOADD")
-def cmd_geoadd(server, ctx, args):
-    if (len(args) - 1) % 3:
-        raise RespError("ERR syntax error")
-    g = _geo(server, _s(args[0]))
-    n = 0
-    for i in range(1, len(args), 3):
-        n += g.add(float(args[i]), float(args[i + 1]), bytes(args[i + 2]))
-    return n
-
-
-@register("GEOPOS")
-def cmd_geopos(server, ctx, args):
-    g = _geo(server, _s(args[0]))
-    pos = g.pos(*[bytes(m) for m in args[1:]])
-    out = []
-    for m in args[1:]:
-        p = pos.get(bytes(m))
-        out.append(None if p is None else [repr(p[0]).encode(), repr(p[1]).encode()])
-    return out
-
-
-@register("GEODIST")
-def cmd_geodist(server, ctx, args):
-    unit = _s(args[3]).lower() if len(args) > 3 else "m"
-    d = _geo(server, _s(args[0])).dist(bytes(args[1]), bytes(args[2]), unit=unit)
-    return None if d is None else _fnum(round(d, 4))
-
-
-@register("GEOSEARCH")
-def cmd_geosearch(server, ctx, args):
-    """GEOSEARCH key <FROMMEMBER m | FROMLONLAT lon lat>
-    <BYRADIUS r unit | BYBOX w h unit> [ASC|DESC] [COUNT n [ANY]]
-    [WITHCOORD] [WITHDIST]."""
-    g = _geo(server, _s(args[0]))
-    i = 1
-    member, lonlat = None, None
-    shape = None
-    order, count = "ASC", None
-    withcoord = withdist = False
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"FROMMEMBER":
-            member = bytes(args[i + 1])
-            i += 2
-        elif opt == b"FROMLONLAT":
-            lonlat = (float(args[i + 1]), float(args[i + 2]))
-            i += 3
-        elif opt == b"BYRADIUS":
-            shape = ("radius", float(args[i + 1]), _s(args[i + 2]).lower())
-            i += 3
-        elif opt == b"BYBOX":
-            shape = ("box", float(args[i + 1]), float(args[i + 2]), _s(args[i + 3]).lower())
-            i += 4
-        elif opt in (b"ASC", b"DESC"):
-            order = _s(args[i]).upper()
-            i += 1
-        elif opt == b"COUNT":
-            count = _int(args[i + 1])
-            i += 2
-            if i < len(args) and bytes(args[i]).upper() == b"ANY":
-                i += 1
-        elif opt == b"WITHCOORD":
-            withcoord = True
-            i += 1
-        elif opt == b"WITHDIST":
-            withdist = True
-            i += 1
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    if shape is None or (member is None and lonlat is None):
-        raise RespError("ERR syntax error")
-    if member is not None:
-        p = g.pos(member).get(member)
-        if p is None:
-            raise RespError("ERR could not decode requested zset member")
-        lonlat = p
-    if shape[0] == "radius":
-        pairs = list(
-            g.search_radius_with_distance(
-                lonlat[0], lonlat[1], shape[1], unit=shape[2], count=count, order=order
-            ).items()
-        )
-        pairs.sort(key=lambda p: p[1], reverse=order == "DESC")  # dicts drop order
-    else:
-        from redisson_tpu.client.objects.geo import _UNITS, _haversine_m
-
-        members = g.search_box(lonlat[0], lonlat[1], shape[1], shape[2], unit=shape[3])
-        u = _UNITS[shape[3]]
-        pairs = []
-        for m in members:
-            p = g.pos(m).get(m)
-            dm = float(_haversine_m(lonlat[0], lonlat[1], p[0], p[1])) if p else 0.0
-            pairs.append((m, dm / u))
-        pairs.sort(key=lambda t: t[1], reverse=order == "DESC")
-        if count is not None:
-            pairs = pairs[:count]
-    out = []
-    for m, dist in pairs:
-        m = m if isinstance(m, (bytes, bytearray)) else str(m).encode()
-        if not (withcoord or withdist):
-            out.append(m)
-            continue
-        row = [m]
-        if withdist:
-            row.append(_fnum(round(dist, 4)))
-        if withcoord:
-            p = g.pos(m).get(m)
-            row.append([repr(p[0]).encode(), repr(p[1]).encode()] if p else None)
-        out.append(row)
-    return out
-
-
-@register("GEOSEARCHSTORE")
-def cmd_geosearchstore(server, ctx, args):
-    """GEOSEARCHSTORE dest src FROMLONLAT lon lat BYRADIUS r unit — the
-    store-variant subset the reference's searchStore covers."""
-    dest, src = _s(args[0]), _s(args[1])
-    if bytes(args[2]).upper() != b"FROMLONLAT" or bytes(args[5]).upper() != b"BYRADIUS":
-        raise RespError("ERR syntax error (only FROMLONLAT ... BYRADIUS supported)")
-    g = _geo(server, src)
-    return g.store_search_radius_to(
-        dest, float(args[3]), float(args[4]), float(args[6]), unit=_s(args[7]).lower()
-    )
-
-
-def _georadius(server, ctx, args, by_member: bool, allow_store: bool = True):
-    """Legacy GEORADIUS[BYMEMBER] translated onto the GEOSEARCH engine
-    (Redis 6.2 deprecates these in favor of GEOSEARCH; the reference's
-    RedissonGeo still drives them — client/protocol/RedisCommands.java
-    GEORADIUS defs).  STORE/STOREDIST subset: plain STORE only."""
-    key = args[0]
-    if by_member:
-        head = [key, b"FROMMEMBER", args[1]]
-        i = 4
-        radius, unit = args[2], args[3]
-    else:
-        head = [key, b"FROMLONLAT", args[1], args[2]]
-        i = 5
-        radius, unit = args[3], args[4]
-    head += [b"BYRADIUS", radius, unit]
-    store = None
-    tail = []
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt in (b"WITHCOORD", b"WITHDIST", b"ASC", b"DESC"):
-            tail.append(args[i])
-            i += 1
-        elif opt == b"WITHHASH":
-            i += 1  # geohash integers are not materialized here; ignored
-        elif opt == b"COUNT":
-            tail += [args[i], args[i + 1]]
-            i += 2
-            if i < len(args) and bytes(args[i]).upper() == b"ANY":
-                tail.append(args[i])
-                i += 1
-        elif opt in (b"STORE", b"STOREDIST"):
-            if not allow_store:
-                raise RespError(
-                    "ERR STORE option in GEORADIUS is not compatible with "
-                    "the _RO variant"
-                )
-            if opt == b"STOREDIST":
-                raise RespError("ERR STOREDIST is not supported; use STORE")
-            store = _s(args[i + 1])
-            i += 2
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    if store is not None:
-        g = _geo(server, _s(key))
-        if by_member:
-            p = g.pos(bytes(args[1])).get(bytes(args[1]))
-            if p is None:
-                raise RespError("ERR could not decode requested zset member")
-            lon, lat = p
-        else:
-            lon, lat = float(args[1]), float(args[2])
-        return g.store_search_radius_to(
-            store, lon, lat, float(radius), unit=_s(unit).lower()
-        )
-    return cmd_geosearch(server, ctx, head + tail)
-
-
-@register("GEORADIUS")
-def cmd_georadius(server, ctx, args):
-    return _georadius(server, ctx, args, by_member=False)
-
-
-@register("GEORADIUS_RO")
-def cmd_georadius_ro(server, ctx, args):
-    return _georadius(server, ctx, args, by_member=False, allow_store=False)
-
-
-@register("GEORADIUSBYMEMBER")
-def cmd_georadiusbymember(server, ctx, args):
-    return _georadius(server, ctx, args, by_member=True)
-
-
-@register("GEORADIUSBYMEMBER_RO")
-def cmd_georadiusbymember_ro(server, ctx, args):
-    return _georadius(server, ctx, args, by_member=True, allow_store=False)
-
-
-# -- redis-stack module verbs: JSON.* (RedisJSON role — RedissonJsonBucket
-# -- drives these same verbs in the reference) -------------------------------
-
-def _json(server, name: str):
-    from redisson_tpu.client.objects.binarystream import JsonBucket
-
-    return JsonBucket(server.engine, name)  # codec-free: documents are parsed JSON
-
-
-def _json_cmd(fn):
-    """Map JsonBucket exceptions (bad paths, type mismatches) to ERR replies."""
-    import functools
-
-    @functools.wraps(fn)
-    def wrapper(server, ctx, args):
-        import json as _j
-
-        try:
-            return fn(server, ctx, args, _j)
-        except (KeyError, IndexError) as e:
-            raise RespError(f"ERR Path does not exist: {e.args[0] if e.args else e}")
-        except (TypeError, ValueError) as e:
-            raise RespError(f"ERR {e}")
-
-    return wrapper
-
-
-@register("JSON.SET")
-@_json_cmd
-def cmd_json_set(server, ctx, args, _j):
-    """JSON.SET key path json [NX|XX]."""
-    name, path = _s(args[0]), _s(args[1])
-    value = _j.loads(bytes(args[2]))
-    mode = bytes(args[3]).upper() if len(args) > 3 else None
-    jb = _json(server, name)
-    if mode in (b"NX", b"XX"):
-        existing = jb.get(path)  # returns None for missing paths, never raises
-        if (mode == b"NX" and existing is not None) or (mode == b"XX" and existing is None):
-            return None
-    elif mode is not None:
-        raise RespError("ERR syntax error")
-    jb.set(path, value)
-    return "+OK"
-
-
-@register("JSON.GET")
-@_json_cmd
-def cmd_json_get(server, ctx, args, _j):
-    """JSON.GET key [path ...] — one path returns its value; several return
-    a {path: value} object (RedisJSON reply shape)."""
-    jb = _json(server, _s(args[0]))
-    paths = [_s(p) for p in args[1:]] or ["$"]
-    # JsonBucket.get swallows path errors and returns None; reply nil like
-    # RedisJSON (a stored JSON null also reads nil — simplified path
-    # semantics, the same trade the handle itself makes)
-    if len(paths) == 1:
-        v = jb.get(paths[0])
-        return None if v is None else _j.dumps(v).encode()
-    return _j.dumps({p: jb.get(p) for p in paths}).encode()
-
-
-@register("JSON.DEL")
-@_json_cmd
-def cmd_json_del(server, ctx, args, _j):
-    jb = _json(server, _s(args[0]))
-    return 1 if jb.delete(_s(args[1]) if len(args) > 1 else "$") else 0
-
-
-@register("JSON.TYPE")
-@_json_cmd
-def cmd_json_type(server, ctx, args, _j):
-    t = _json(server, _s(args[0])).type(_s(args[1]) if len(args) > 1 else "$")
-    return None if t is None else t.encode()
-
-
-@register("JSON.NUMINCRBY")
-@_json_cmd
-def cmd_json_numincrby(server, ctx, args, _j):
-    v = _json(server, _s(args[0])).increment_and_get(_s(args[1]), _j.loads(bytes(args[2])))
-    return _j.dumps(v).encode()
-
-
-@register("JSON.STRAPPEND")
-@_json_cmd
-def cmd_json_strappend(server, ctx, args, _j):
-    return _json(server, _s(args[0])).string_append(_s(args[1]), _j.loads(bytes(args[2])))
-
-
-@register("JSON.STRLEN")
-@_json_cmd
-def cmd_json_strlen(server, ctx, args, _j):
-    return _json(server, _s(args[0])).string_size(_s(args[1]) if len(args) > 1 else "$")
-
-
-@register("JSON.ARRAPPEND")
-@_json_cmd
-def cmd_json_arrappend(server, ctx, args, _j):
-    vals = [_j.loads(bytes(a)) for a in args[2:]]
-    return _json(server, _s(args[0])).array_append(_s(args[1]), *vals)
-
-
-@register("JSON.ARRINSERT")
-@_json_cmd
-def cmd_json_arrinsert(server, ctx, args, _j):
-    vals = [_j.loads(bytes(a)) for a in args[3:]]
-    return _json(server, _s(args[0])).array_insert(_s(args[1]), _int(args[2]), *vals)
-
-
-@register("JSON.ARRLEN")
-@_json_cmd
-def cmd_json_arrlen(server, ctx, args, _j):
-    return _json(server, _s(args[0])).array_size(_s(args[1]) if len(args) > 1 else "$")
-
-
-@register("JSON.ARRPOP")
-@_json_cmd
-def cmd_json_arrpop(server, ctx, args, _j):
-    idx = _int(args[2]) if len(args) > 2 else -1
-    v = _json(server, _s(args[0])).array_pop(_s(args[1]) if len(args) > 1 else "$", idx)
-    return None if v is None else _j.dumps(v).encode()
-
-
-@register("JSON.ARRTRIM")
-@_json_cmd
-def cmd_json_arrtrim(server, ctx, args, _j):
-    return _json(server, _s(args[0])).array_trim(_s(args[1]), _int(args[2]), _int(args[3]))
-
-
-@register("JSON.ARRINDEX")
-@_json_cmd
-def cmd_json_arrindex(server, ctx, args, _j):
-    start = _int(args[3]) if len(args) > 3 else 0
-    stop = _int(args[4]) if len(args) > 4 else 0
-    return _json(server, _s(args[0])).array_index_of(
-        _s(args[1]), _j.loads(bytes(args[2])), start, stop
-    )
-
-
-@register("JSON.OBJKEYS")
-@_json_cmd
-def cmd_json_objkeys(server, ctx, args, _j):
-    ks = _json(server, _s(args[0])).object_keys(_s(args[1]) if len(args) > 1 else "$")
-    return None if ks is None else [k.encode() for k in ks]
-
-
-@register("JSON.OBJLEN")
-@_json_cmd
-def cmd_json_objlen(server, ctx, args, _j):
-    return _json(server, _s(args[0])).object_size(_s(args[1]) if len(args) > 1 else "$")
-
-
-@register("JSON.CLEAR")
-@_json_cmd
-def cmd_json_clear(server, ctx, args, _j):
-    return _json(server, _s(args[0])).clear(_s(args[1]) if len(args) > 1 else "$")
-
-
-@register("JSON.TOGGLE")
-@_json_cmd
-def cmd_json_toggle(server, ctx, args, _j):
-    v = _json(server, _s(args[0])).toggle(_s(args[1]))
-    return None if v is None else int(v)
-
-
-@register("JSON.MERGE")
-@_json_cmd
-def cmd_json_merge(server, ctx, args, _j):
-    _json(server, _s(args[0])).merge(_s(args[1]), _j.loads(bytes(args[2])))
-    return "+OK"
-
-
-# -- redis-stack module verbs: FT.* (RediSearch role — RedissonSearch.java
-# -- drives these same verbs in the reference) -------------------------------
-
-def _ft(server):
-    from redisson_tpu.services.search import SearchService
-
-    return server.engine.service("search", lambda: SearchService(server.engine))
-
-
-def _ft_parse_query(q: str, schema: dict):
-    """RediSearch query subset -> Condition tree: `*`, `@f:[lo hi]` numeric
-    ranges ('(' = exclusive, ±inf), `@f:{tag|tag}`, `@f:text`, `@f:(txt)`,
-    bare words (full-text across every TEXT field); top-level terms AND."""
-    import re as _re
-
-    from redisson_tpu.services.search import And, Eq, In, Or, Range, Text
-
-    q = q.strip()
-    if q in ("*", ""):
-        return None
-    tokens = _re.findall(
-        r"@\w+:\[[^\]]*\]|@\w+:\{[^}]*\}|@\w+:\([^)]*\)|@\w+:\S+|\S+", q
-    )
-
-    def bound(s):
-        inc = not s.startswith("(")
-        s = s.lstrip("(")
-        if s in ("-inf", "inf", "+inf"):
-            return (float("-inf") if s == "-inf" else float("inf")), inc
-        return float(s), inc
-
-    terms = []
-    for t in tokens:
-        if t.startswith("@"):
-            fld, _, rest = t[1:].partition(":")
-            if rest.startswith("["):
-                body = rest[1:-1].split()
-                if len(body) != 2:
-                    raise RespError("ERR Syntax error in numeric range")
-                (lo, lo_inc), (hi, hi_inc) = bound(body[0]), bound(body[1])
-                terms.append(Range(fld, lo, hi, lo_inc, hi_inc))
-            elif rest.startswith("{"):
-                vals = [v.strip() for v in rest[1:-1].split("|") if v.strip()]
-                if not vals:
-                    raise RespError("ERR syntax error: empty tag set")
-                terms.append(Eq(fld, vals[0]) if len(vals) == 1 else In(fld, vals))
-            elif rest.startswith("("):
-                terms.append(Text(fld, rest[1:-1]))
-            else:
-                terms.append(Text(fld, rest))
-        else:
-            text_fields = [f for f, ty in schema.items() if ty == "TEXT"]
-            if not text_fields:
-                raise RespError(f"ERR no TEXT field for bare term '{t}'")
-            parts = [Text(f, t) for f in text_fields]
-            terms.append(parts[0] if len(parts) == 1 else Or(parts))
-    return terms[0] if len(terms) == 1 else And(terms)
-
-
-def _ft_cmd(fn):
-    """Map malformed FT arguments/queries to syntax errors, missing indexes
-    to the RediSearch wording — never 'ERR internal'."""
-    import functools
-
-    @functools.wraps(fn)
-    def wrapper(server, ctx, args):
-        try:
-            return fn(server, ctx, args)
-        except KeyError:
-            raise RespError("ERR Unknown Index name")
-        except (ValueError, IndexError) as e:
-            raise RespError(f"ERR syntax error: {e}")
-
-    return wrapper
-
-
-@register("FT.CREATE")
-@_ft_cmd
-def cmd_ft_create(server, ctx, args):
-    """FT.CREATE idx [ON HASH] [PREFIX n p...] SCHEMA f TYPE [SORTABLE] ..."""
-    name = _s(args[0])
-    prefixes = [""]
-    i = 1
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"ON":
-            if bytes(args[i + 1]).upper() != b"HASH":
-                raise RespError("ERR only ON HASH indexes are supported")
-            i += 2
-        elif opt == b"PREFIX":
-            n = _int(args[i + 1])
-            prefixes = [_s(p) for p in args[i + 2 : i + 2 + n]]
-            i += 2 + n
-        elif opt == b"SCHEMA":
-            i += 1
-            break
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    else:
-        raise RespError("ERR SCHEMA is required")
-    schema = {}
-    while i < len(args):
-        fld = _s(args[i])
-        ty = bytes(args[i + 1]).upper().decode()
-        if ty not in ("TEXT", "TAG", "NUMERIC"):
-            raise RespError(f"ERR unsupported field type '{ty}'")
-        schema[fld] = ty
-        i += 2
-        if i < len(args) and bytes(args[i]).upper() == b"SORTABLE":
-            i += 1  # everything is sortable here
-    try:
-        _ft(server).create(name, schema, prefixes, doc_mode="hash")
-    except ValueError as e:
-        raise RespError(f"ERR {e}")
-    return "+OK"
-
-
-@register("FT.DROPINDEX")
-@_ft_cmd
-def cmd_ft_dropindex(server, ctx, args):
-    if not _ft(server).drop_index(_s(args[0])):
-        raise RespError("ERR Unknown Index name")
-    return "+OK"
-
-
-@register("FT._LIST")
-@_ft_cmd
-def cmd_ft_list(server, ctx, args):
-    return [n.encode() for n in _ft(server).index_names()]
-
-
-@register("FT.INFO")
-@_ft_cmd
-def cmd_ft_info(server, ctx, args):
-    svc = _ft(server)
-    idx = svc._idx(_s(args[0]))  # KeyError -> Unknown Index via _ft_cmd
-    svc.sync(_s(args[0]))
-    info = svc.info(_s(args[0]))
-    flat_schema = []
-    for f, ty in info["schema"].items():
-        flat_schema.append([f.encode(), b"type", ty.encode()])
-    return [
-        b"index_name", info["name"].encode(),
-        b"num_docs", info["num_docs"],
-        b"attributes", flat_schema,
-        b"prefixes", [p.encode() for p in info["prefixes"]],
-    ]
-
-
-@register("FT.SEARCH")
-@_ft_cmd
-def cmd_ft_search(server, ctx, args):
-    """FT.SEARCH idx query [NOCONTENT] [SORTBY f [ASC|DESC]] [LIMIT off n]
-    -> [total, id, [f, v, ...], ...] (RediSearch reply shape)."""
-    svc = _ft(server)
-    idx = svc._idx(_s(args[0]))  # KeyError -> Unknown Index via _ft_cmd
-    svc.sync(_s(args[0]))
-    cond = _ft_parse_query(_s(args[1]), idx.schema)
-    nocontent = False
-    sort_by, desc = None, False
-    off, lim = 0, 10
-    i = 2
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"NOCONTENT":
-            nocontent = True
-            i += 1
-        elif opt == b"SORTBY":
-            sort_by = _s(args[i + 1])
-            i += 2
-            if i < len(args) and bytes(args[i]).upper() in (b"ASC", b"DESC"):
-                desc = bytes(args[i]).upper() == b"DESC"
-                i += 1
-        elif opt == b"LIMIT":
-            off, lim = _int(args[i + 1]), _int(args[i + 2])
-            i += 3
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    res = svc.search(_s(args[0]), cond, sort_by=sort_by, descending=desc,
-                     offset=off, limit=lim)
-    out = [res.total]
-    for doc_id, fields in res.docs:
-        out.append(doc_id.encode())
-        if not nocontent:
-            flat = []
-            for k, v in fields.items():
-                flat += [str(k).encode(), str(v).encode()]
-            out.append(flat)
-    return out
-
-
-@register("FT.AGGREGATE")
-@_ft_cmd
-def cmd_ft_aggregate(server, ctx, args):
-    """FT.AGGREGATE idx query [GROUPBY 1 @f REDUCE op n [@f] AS name ...]
-    [SORTBY n @f [ASC|DESC]] [LIMIT off n] [WITHCURSOR [COUNT n]]."""
-    svc = _ft(server)
-    idx = svc._idx(_s(args[0]))  # KeyError -> Unknown Index via _ft_cmd
-    svc.sync(svc.resolve(_s(args[0])))
-    cond = _ft_parse_query(_s(args[1]), idx.schema)
-    group_by, reducers = None, {}
-    sort_by, desc = None, False
-    off, lim = 0, None
-    withcursor, cursor_count = False, 1000
-    i = 2
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"WITHCURSOR":
-            withcursor = True
-            i += 1
-            if i + 1 < len(args) and bytes(args[i]).upper() == b"COUNT":
-                cursor_count = _int(args[i + 1])
-                i += 2
-        elif opt == b"GROUPBY":
-            if _int(args[i + 1]) != 1:
-                raise RespError("ERR GROUPBY supports exactly one property")
-            group_by = _s(args[i + 2]).lstrip("@")
-            i += 3
-        elif opt == b"REDUCE":
-            op = _s(args[i + 1]).lower()
-            if op not in ("count", "sum", "avg", "min", "max"):
-                raise RespError(f"ERR unsupported reducer '{op}'")
-            nargs = _int(args[i + 2])
-            fld = _s(args[i + 3]).lstrip("@") if nargs else None
-            i += 3 + nargs
-            name = f"{op}({fld or ''})"
-            if i < len(args) and bytes(args[i]).upper() == b"AS":
-                name = _s(args[i + 1])
-                i += 2
-            reducers[name] = (op, fld)
-        elif opt == b"SORTBY":
-            n = _int(args[i + 1])
-            sort_by = _s(args[i + 2]).lstrip("@")
-            if n > 1:
-                desc = bytes(args[i + 3]).upper() == b"DESC"
-            i += 2 + n
-        elif opt == b"LIMIT":
-            off, lim = _int(args[i + 1]), _int(args[i + 2])
-            i += 3
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    rows = svc.aggregate(_s(args[0]), cond, group_by=group_by,
-                         reducers=reducers or None, sort_by=sort_by,
-                         descending=desc, offset=off, limit=lim)
-    flat_rows = []
-    for row in rows:
-        flat = []
-        for k, v in row.items():
-            flat += [str(k).encode(), str(v).encode()]
-        flat_rows.append(flat)
-    if withcursor:
-        batch, rest = flat_rows[:cursor_count], flat_rows[cursor_count:]
-        cid = svc.cursor_create(rest) if rest else 0
-        return [[len(batch)] + batch, cid]
-    return [len(flat_rows)] + flat_rows
-
-
-@register("FT.CURSOR")
-@_ft_cmd
-def cmd_ft_cursor(server, ctx, args):
-    """FT.CURSOR READ idx cid [COUNT n] | FT.CURSOR DEL idx cid — pages a
-    WITHCURSOR aggregation (RediSearch cursor API)."""
-    svc = _ft(server)
-    sub = bytes(args[0]).upper()
-    cid = _int(args[2])
-    if sub == b"READ":
-        count = 1000
-        if len(args) > 4 and bytes(args[3]).upper() == b"COUNT":
-            count = _int(args[4])
-        rows, nxt = svc.cursor_read(cid, count)  # KeyError -> unknown cursor
-        return [[len(rows)] + rows, nxt]
-    if sub == b"DEL":
-        svc.cursor_del(cid)
-        return "+OK"
-    raise RespError("ERR syntax error")
-
-
-@register("FT.ALTER")
-@_ft_cmd
-def cmd_ft_alter(server, ctx, args):
-    """FT.ALTER idx SCHEMA ADD field type [SORTABLE]."""
-    if (
-        len(args) < 5
-        or bytes(args[1]).upper() != b"SCHEMA"
-        or bytes(args[2]).upper() != b"ADD"
-    ):
-        raise RespError("ERR syntax error")
-    ty = bytes(args[4]).upper().decode()
-    if ty not in ("TEXT", "TAG", "NUMERIC"):
-        raise RespError(f"ERR unsupported field type '{ty}'")
-    try:
-        _ft(server).alter(_s(args[0]), _s(args[3]), ty)
-    except ValueError as e:
-        raise RespError(f"ERR {e}")
-    return "+OK"
-
-
-@register("FT.ALIASADD")
-@_ft_cmd
-def cmd_ft_aliasadd(server, ctx, args):
-    try:
-        _ft(server).alias_add(_s(args[0]), _s(args[1]))
-    except ValueError as e:
-        raise RespError(f"ERR {e}")
-    return "+OK"
-
-
-@register("FT.ALIASUPDATE")
-@_ft_cmd
-def cmd_ft_aliasupdate(server, ctx, args):
-    _ft(server).alias_update(_s(args[0]), _s(args[1]))
-    return "+OK"
-
-
-@register("FT.ALIASDEL")
-@_ft_cmd
-def cmd_ft_aliasdel(server, ctx, args):
-    try:
-        _ft(server).alias_del(_s(args[0]))
-    except ValueError as e:
-        raise RespError(f"ERR {e}")
-    return "+OK"
-
-
-@register("FT.DICTADD")
-@_ft_cmd
-def cmd_ft_dictadd(server, ctx, args):
-    return _ft(server).dict_add(_s(args[0]), *[_s(a) for a in args[1:]])
-
-
-@register("FT.DICTDEL")
-@_ft_cmd
-def cmd_ft_dictdel(server, ctx, args):
-    return _ft(server).dict_del(_s(args[0]), *[_s(a) for a in args[1:]])
-
-
-@register("FT.DICTDUMP")
-@_ft_cmd
-def cmd_ft_dictdump(server, ctx, args):
-    return [t.encode() for t in _ft(server).dict_dump(_s(args[0]))]
-
-
-@register("FT.SPELLCHECK")
-@_ft_cmd
-def cmd_ft_spellcheck(server, ctx, args):
-    """FT.SPELLCHECK idx query [DISTANCE d] [TERMS INCLUDE|EXCLUDE dict]...
-    -> [["TERM", term, [[score, suggestion], ...]], ...]."""
-    include, exclude = [], []
-    distance = 1
-    i = 2
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"DISTANCE":
-            distance = _int(args[i + 1])
-            if not 1 <= distance <= 4:
-                raise RespError("ERR invalid distance, must be between 1 and 4")
-            i += 2
-        elif opt == b"TERMS":
-            mode = bytes(args[i + 1]).upper()
-            (include if mode == b"INCLUDE" else exclude).append(_s(args[i + 2]))
-            i += 3
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    res = _ft(server).spellcheck(
-        _s(args[0]), _s(args[1]), include=include, exclude=exclude,
-        distance=distance,
-    )
-    return [
-        [b"TERM", term.encode(),
-         [[_fnum(score), sugg.encode()] for score, sugg in suggs]]
-        for term, suggs in res.items()
-    ]
-
-
-# -- script / function / admin verbs (RScript + RFunction wire surface) ------
-
-def _script_svc(server):
-    from redisson_tpu.services.script import ScriptService
-
-    return server.engine.service("script", lambda: ScriptService(server.engine))
-
-
-def _function_svc(server):
-    from redisson_tpu.services.script import FunctionService
-
-    return server.engine.service("function", lambda: FunctionService(server.engine))
-
-
-def _proc_keys_args(args, at):
-    """numkeys keys... args... tail shared by EVALSHA/FCALL."""
-    n = _int(args[at])
-    if n < 0:
-        raise RespError("ERR Number of keys can't be negative")
-    if len(args) < at + 1 + n:
-        raise RespError("ERR Number of keys is greater than number of args")
-    keys = [_s(k) for k in args[at + 1 : at + 1 + n]]
-    rest = [bytes(a) for a in args[at + 1 + n :]]
-    return keys, rest
-
-
-@register("EVALSHA")
-def cmd_evalsha(server, ctx, args):
-    """EVALSHA sha numkeys key... arg... — invokes a script REGISTERED
-    SERVER-SIDE (embedded script_load).  Scripts here are Python callables,
-    so source never ships over the wire: remote callers address by digest
-    only, and a miss replies NOSCRIPT exactly like the reference's
-    EVAL-fallback discipline expects."""
-    from redisson_tpu.services.script import NoScriptError
-
-    keys, rest = _proc_keys_args(args, 1)
-    try:
-        return _script_svc(server).eval_sha(_s(args[0]), keys, rest)
-    except NoScriptError:
-        raise RespError("NOSCRIPT No matching script. Please use EVAL.")
-
-
-@register("EVAL")
-def cmd_eval(server, ctx, args):
-    raise RespError(
-        "ERR EVAL with shipped source is not supported on this server: "
-        "scripts are Python callables registered server-side (script_load); "
-        "invoke by digest with EVALSHA, or FCALL a loaded function library"
-    )
-
-
-@register("SCRIPT")
-def cmd_script(server, ctx, args):
-    sub = bytes(args[0]).upper()
-    svc = _script_svc(server)
-    if sub == b"EXISTS":
-        return [1 if ok else 0 for ok in svc.script_exists(*[_s(s) for s in args[1:]])]
-    if sub == b"FLUSH":
-        svc.script_flush()
-        return "+OK"
-    if sub == b"LOAD":
-        raise RespError(
-            "ERR SCRIPT LOAD over the wire is not supported (scripts are "
-            "Python callables; register them server-side)"
-        )
-    raise RespError(f"ERR Unknown SCRIPT subcommand '{_s(args[0])}'")
-
-
-def _fcall(server, args, read_only: bool):
-    keys, rest = _proc_keys_args(args, 1)
-    svc = _function_svc(server)
-    # resolve OUTSIDE the invocation: a KeyError raised by the function's
-    # own body must surface as the function's error, not "not found"
-    try:
-        fn = svc._resolve(_s(args[0]))
-    except KeyError:
-        raise RespError(f"ERR Function not found: {_s(args[0])}")
-    from redisson_tpu.services.script import ScriptMode
-
-    mode = ScriptMode.READ_ONLY if read_only else ScriptMode.READ_WRITE
-    return svc._script.eval(fn, keys, rest, mode)
-
-
-@register("FCALL")
-def cmd_fcall(server, ctx, args):
-    return _fcall(server, args, read_only=False)
-
-
-@register("FCALL_RO")
-def cmd_fcall_ro(server, ctx, args):
-    return _fcall(server, args, read_only=True)
-
-
-@register("FUNCTION")
-def cmd_function(server, ctx, args):
-    sub = bytes(args[0]).upper()
-    if sub == b"LIST":
-        out = []
-        for lib, fns in sorted(_function_svc(server).list().items()):
-            out.append([
-                b"library_name", lib.encode(),
-                b"functions", [f.encode() for f in fns],
-            ])
-        return out
-    if sub == b"DUMP" or sub == b"LOAD":
-        raise RespError(
-            "ERR FUNCTION libraries are Python callables registered "
-            "server-side; wire DUMP/LOAD is not supported"
-        )
-    raise RespError(f"ERR Unknown FUNCTION subcommand '{_s(args[0])}'")
-
-
-@register("WAIT")
-def cmd_wait(server, ctx, args):
-    """WAIT numreplicas timeout(ms): flush dirty records to replicas now and
-    report how many replicas are attached (record-level async replication:
-    a returned count >= numreplicas means the flush was SHIPPED to that
-    many replicas — the syncSlaves/REPLFLUSH semantics)."""
-    import time as _t
-
-    if len(args) < 2:
-        raise RespError("ERR wrong number of arguments for 'wait' command")
-    want = _int(args[0])
-    timeout_ms = _int(args[1])
-    if timeout_ms < 0:
-        raise RespError("ERR timeout is negative")
-    # Redis WAIT timeout 0 = block until the replica count is reached
-    # (same convention as _block_loop's timeout<=0)
-    deadline = None if timeout_ms == 0 else _t.time() + timeout_ms / 1000.0
-    while True:
-        n = 0
-        if server._replication is not None:
-            server._replication.flush()
-            n = len(server._replication.replicas())
-        if (
-            n >= want
-            or (deadline is not None and _t.time() >= deadline)
-            or getattr(server, "_closing", False)
-            or getattr(_exec_tls, "in_exec", False)  # no parking inside EXEC
-        ):
-            return n
-        _t.sleep(0.02)  # parked, not spinning: this holds a pool worker
-
-
-@register("CONFIG")
-def cmd_config(server, ctx, args):
-    """CONFIG GET pattern | CONFIG SET key value — the RedisNode.setConfig
-    admin surface over the server's live knob table."""
-    sub = bytes(args[0]).upper()
-    if sub == b"GET":
-        pattern = _s(args[1]) if len(args) > 1 else "*"
-        out = []
-        for k, v in sorted(server.config_view().items()):
-            if _glob_match(pattern, k):
-                out += [k.encode(), str(v).encode()]
-        return out
-    if sub == b"SET":
-        if not server.config_set(_s(args[1]), _s(args[2])):
-            raise RespError(f"ERR Unknown or read-only CONFIG parameter '{_s(args[1])}'")
-        return "+OK"
-    raise RespError(f"ERR Unknown CONFIG subcommand '{_s(args[0])}'")
-
-
-def _bmpop_prelude(args):
-    """Shared BLMPOP/BZMPOP validation: timeout + numkeys BEFORE any
-    delegation, so malformed input replies a syntax error, never ERR
-    internal."""
-    import math as _math
-
-    if len(args) < 4:
-        raise RespError("ERR wrong number of arguments")
-    try:
-        timeout = float(args[0])
-    except (TypeError, ValueError):
-        raise RespError("ERR timeout is not a float or out of range")
-    if not _math.isfinite(timeout) or timeout < 0:
-        # NaN would make every deadline comparison False: park forever
-        raise RespError("ERR timeout is not a float or out of range")
-    rest = args[1:]
-    n = _int(rest[0])
-    if n <= 0:
-        raise RespError("ERR numkeys should be greater than 0")
-    if len(rest) < 1 + n + 1:
-        raise RespError("ERR Number of keys is greater than number of args")
-    return timeout, rest, _s(rest[1])
-
-
-@register("BLMPOP")
-def cmd_blmpop(server, ctx, args):
-    """BLMPOP timeout numkeys key... LEFT|RIGHT [COUNT n]."""
-    timeout, rest, first_key = _bmpop_prelude(args)
-
-    def poll_once():
-        return cmd_lmpop(server, ctx, rest)
-
-    return _block_loop(server, first_key, poll_once, timeout)
-
-
-@register("BZMPOP")
-def cmd_bzmpop(server, ctx, args):
-    """BZMPOP timeout numkeys key... MIN|MAX [COUNT n]."""
-    timeout, rest, first_key = _bmpop_prelude(args)
-
-    def poll_once():
-        return cmd_zmpop(server, ctx, rest)
-
-    return _block_loop(server, first_key, poll_once, timeout)
-
-
-@register("DUMP")
-def cmd_dump(server, ctx, args):
-    """DUMP key — the portable record blob (core/checkpoint.dump_record;
-    wire names are stored keys, so no handle/NameMapper indirection)."""
-    from redisson_tpu.core import checkpoint
-
-    try:
-        return checkpoint.dump_record(server.engine, _s(args[0]))
-    except KeyError:
-        return None  # missing key dumps nil
-
-
-@register("RESTORE")
-def cmd_restore(server, ctx, args):
-    """RESTORE key ttl(ms) blob [REPLACE] — BUSYKEY unless REPLACE."""
-    from redisson_tpu.core import checkpoint
-
-    name = _s(args[0])
-    ttl_ms = _int(args[1])
-    if ttl_ms < 0:
-        raise RespError("ERR Invalid TTL value, must be >= 0")
-    opts = {bytes(a).upper() for a in args[3:]}
-    if opts - {b"REPLACE", b"PERSIST"}:
-        raise RespError("ERR syntax error")
-    try:
-        # Redis semantics: ttl 0 == no expiry.  RObject.migrate ships the
-        # remaining TTL as this explicit operand; the blob-carried TTL only
-        # applies to direct restore_record calls (checkpoint files).
-        checkpoint.restore_record(
-            server.engine, name, bytes(args[2]),
-            ttl_ms / 1000.0 if ttl_ms > 0 else None,
-            b"REPLACE" in opts, persist=b"PERSIST" in opts or ttl_ms == 0,
-        )
-    except ValueError as e:
-        msg = str(e)
-        raise RespError(msg if msg.startswith("BUSYKEY") else f"ERR {msg}")
-    return "+OK"
+# verb families live in server/verbs/*; importing the package registers
+# every handler into REGISTRY (split r5: registry.py was a 4,702-line
+# monolith; the families + shared prelude set now live per-module)
+from redisson_tpu.server import verbs  # noqa: E402,F401  (registration side effect)

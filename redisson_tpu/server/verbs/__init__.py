@@ -1,0 +1,13 @@
+"""Wire-verb handler families; importing this package registers every verb.
+
+Order mirrors the original registry.py layout.
+"""
+from redisson_tpu.server.verbs import connection  # noqa: F401,E402
+from redisson_tpu.server.verbs import keyspace  # noqa: F401,E402
+from redisson_tpu.server.verbs import sketch  # noqa: F401,E402
+from redisson_tpu.server.verbs import admin  # noqa: F401,E402
+from redisson_tpu.server.verbs import objcall_tx  # noqa: F401,E402
+from redisson_tpu.server.verbs import collections  # noqa: F401,E402
+from redisson_tpu.server.verbs import zset  # noqa: F401,E402
+from redisson_tpu.server.verbs import streamgeo  # noqa: F401,E402
+from redisson_tpu.server.verbs import modules  # noqa: F401,E402

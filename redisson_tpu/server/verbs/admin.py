@@ -1,0 +1,566 @@
+"""Node admin/info, replication, checkpoint, script/function verbs (redisnode + RScript/RFunction surface).
+
+Split from server/registry.py (round 5, no behavior change): one module per
+verb family, shared preludes in verbs/common.py so numkeys/syntax validation
+cannot diverge between families again.
+"""
+
+import time
+
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.registry import register, _s, _int
+from redisson_tpu.server.verbs.collections import cmd_lmpop, cmd_zmpop
+from redisson_tpu.server.verbs.common import _block_loop, _exec_tls, _glob_match
+
+# -- admin / node info (redisnode/* surface) ---------------------------------
+
+@register("TIME")
+def cmd_time(server, ctx, args):
+    t = time.time()
+    return [str(int(t)).encode(), str(int((t % 1) * 1e6)).encode()]
+
+
+@register("INFO")
+def cmd_info(server, ctx, args):
+    return server.info_text().encode()
+
+
+@register("MEMORY")
+def cmd_memory(server, ctx, args):
+    sub = bytes(args[0]).upper() if args else b""
+    if sub == b"USAGE":
+        rec = server.engine.store.get(_s(args[1]))
+        if rec is None:
+            return None
+        total = 0
+        for arr in rec.arrays.values():
+            total += int(getattr(arr, "nbytes", 0) or 0)
+        import sys
+
+        if rec.host is not None:
+            total += sys.getsizeof(rec.host)
+        return total
+    if sub == b"STATS":
+        return [b"keys.count", len(server.engine.store)]
+    return "+OK"
+
+
+@register("CLUSTER")
+def cmd_cluster(server, ctx, args):
+    sub = bytes(args[0]).upper() if args else b""
+    if sub == b"SLOTS":
+        return server.cluster_slots()
+    if sub == b"MYID":
+        return server.node_id.encode()
+    if sub == b"INFO":
+        state = "ok" if server.cluster_view else "ok"
+        return f"cluster_enabled:{1 if server.cluster_view else 0}\r\ncluster_state:{state}\r\n".encode()
+    if sub == b"SETVIEW":
+        # SETVIEW [TOKEN <n>] <from> <to> <host> <port> <node_id> ...
+        # (5-tuples) — the topology/launcher (harness.ClusterRunner,
+        # server/monitor.py) installs the slot map on every node; the
+        # reference's analog is each node's view from CLUSTER NODES gossip.
+        # TOKEN carries the writing coordinator's FENCING token (its
+        # FencedLock leadership token): a view stamped with a LOWER token
+        # than the last accepted one is a stale ex-leader's late write and
+        # is rejected — the fencing discipline that makes coordinator HA
+        # safe (a paused leader resuming after its lease lapsed cannot
+        # clobber its successor's topology).
+        rest = args[1:]
+        token = None
+        if rest and bytes(rest[0]).upper() == b"TOKEN":
+            token = _int(rest[1])
+            rest = rest[2:]
+        if len(rest) % 5 != 0:
+            raise RespError("ERR SETVIEW expects 5-tuples")
+        if token is not None:
+            if token < server.view_epoch:
+                raise RespError(
+                    f"STALEVIEW token {token} < accepted epoch {server.view_epoch}"
+                )
+            server.view_epoch = token
+        view = []
+        for i in range(0, len(rest), 5):
+            view.append(
+                (
+                    _int(rest[i]),
+                    _int(rest[i + 1]),
+                    _s(rest[i + 2]),
+                    _int(rest[i + 3]),
+                    _s(rest[i + 4]),
+                )
+            )
+        server.cluster_view = view
+        return "+OK"
+    if sub == b"RESET":
+        server.cluster_view = []
+        return "+OK"
+    # -- live slot migration (MIGRATING/IMPORTING window + drain) ------------
+    if sub == b"SETSLOT":
+        # SETSLOT <slot> MIGRATING <host:port> | IMPORTING <host:port> |
+        #         STABLE | NODE <host:port> <node_id>
+        slot = _int(args[1])
+        mode = bytes(args[2]).upper()
+        if mode == b"MIGRATING":
+            server.set_slot_migrating(slot, _s(args[3]))
+            return "+OK"
+        if mode == b"IMPORTING":
+            server.set_slot_importing(slot, _s(args[3]))
+            return "+OK"
+        if mode == b"STABLE":
+            server.set_slot_stable(slot)
+            return "+OK"
+        if mode == b"NODE":
+            # finalize locally: point the slot at its new owner in this
+            # node's view and clear the window state (the orchestrator also
+            # pushes a full SETVIEW; NODE keeps single-node finalization
+            # correct even before that lands)
+            addr, nid = _s(args[3]), _s(args[4])
+            host, port = addr.rsplit(":", 1)
+            new_view = []
+            for lo, hi, h, p, vnid in server.cluster_view:
+                if lo <= slot <= hi:
+                    # split the range around the reassigned slot
+                    if lo <= slot - 1:
+                        new_view.append((lo, slot - 1, h, p, vnid))
+                    new_view.append((slot, slot, host, int(port), nid))
+                    if slot + 1 <= hi:
+                        new_view.append((slot + 1, hi, h, p, vnid))
+                else:
+                    new_view.append((lo, hi, h, p, vnid))
+            server.cluster_view = new_view
+            server.set_slot_stable(slot)
+            return "+OK"
+        raise RespError("ERR SETSLOT expects MIGRATING|IMPORTING|STABLE|NODE")
+    if sub == b"COUNTKEYSINSLOT":
+        return len(server.slot_names(_int(args[1])))
+    if sub == b"GETKEYSINSLOT":
+        names = server.slot_names(_int(args[1]))
+        limit = _int(args[2]) if len(args) > 2 else len(names)
+        return [n.encode() for n in names[:limit]]
+    if sub == b"MIGRATESLOT":
+        # drain one MIGRATING slot (optional batch limit; <=0 = fully)
+        limit = _int(args[2]) if len(args) > 2 else 0
+        return server.migrate_slot_batch(_int(args[1]), limit)
+    if sub == b"MIGRATESLOTS":
+        # drain MANY migrating slots in one store scan — the orchestrator's
+        # bulk form (a reshard of hundreds of slots must not pay a full
+        # keyspace scan per slot)
+        return server.migrate_slot_batch([_int(a) for a in args[1:]])
+    raise RespError("ERR unknown CLUSTER subcommand")
+
+
+@register("ASKING")
+def cmd_asking(server, ctx, args):
+    """One-shot admission for the NEXT command on this connection into an
+    IMPORTING slot (the redirect half of the ASK protocol)."""
+    ctx.asking = True
+    return "+OK"
+
+
+@register("IMPORTRECORDS")
+def cmd_importrecords(server, ctx, args):
+    """Install migrated records (slot-migration transfer frame; the blob
+    carries records only — no live-list pruning, unlike REPLPUSH)."""
+    from redisson_tpu.server import replication
+
+    return replication.apply_records(server.engine, bytes(args[0]))
+
+
+# -- replication (server/replication.py) -------------------------------------
+
+@register("REPLICAOF")
+def cmd_replicaof(server, ctx, args):
+    """REPLICAOF NO ONE -> become master; REPLICAOF <host> <port> -> full
+    sync from master, then register for the push stream."""
+    if len(args) == 2 and bytes(args[0]).upper() == b"NO" and bytes(args[1]).upper() == b"ONE":
+        if server.role == "replica" and server.master_address:
+            # breadcrumb for successor coordinators: an orphaned master that
+            # can name the dead master it was promoted FROM is a
+            # half-finished failover; a restarted stale master cannot
+            server.promoted_from = server.master_address
+        server.role = "master"
+        server.master_address = None
+        return "+OK"
+    if len(args) != 2:
+        raise RespError("ERR REPLICAOF <host> <port> | NO ONE")
+    host, port = _s(args[0]), _int(args[1])
+    from redisson_tpu.server import replication
+
+    # nodes of one grid share credentials AND transport security: the link
+    # authenticates with this node's own password and speaks TLS when this
+    # node does (cluster-wide convention; server.link_client)
+    master = server.link_client(
+        f"{host}:{port}", ping_interval=0, retry_attempts=1
+    )
+    try:
+        blob = master.execute("REPLSNAPSHOT", timeout=60.0)
+        replication.apply_records(server.engine, bytes(blob))
+        master.execute("REPLREGISTER", server.host, server.port)
+    finally:
+        master.close()
+    server.role = "replica"
+    server.master_address = f"{host}:{port}"
+    return "+OK"
+
+
+@register("REPLSNAPSHOT")
+def cmd_replsnapshot(server, ctx, args):
+    from redisson_tpu.server import replication
+
+    blob, _shipped = replication.serialize_records(server.engine)
+    return blob
+
+
+@register("REPLREGISTER")
+def cmd_replregister(server, ctx, args):
+    host, port = _s(args[0]), _int(args[1])
+    server.replication_source().register(f"{host}:{port}")
+    return "+OK"
+
+
+@register("REPLPUSH")
+def cmd_replpush(server, ctx, args):
+    from redisson_tpu.server import replication
+
+    return replication.apply_records(server.engine, bytes(args[0]))
+
+
+@register("REPLPUSHSEG")
+def cmd_replpushseg(server, ctx, args):
+    """REPLPUSHSEG <xfer_id> <seq> <nsegs> <chunk> — one bounded slice of an
+    oversized REPLPUSH blob (a 10M-key bloom plane is ~95MB; a single
+    sendall of that stalls past socket timeouts, server/replication.py
+    SEGMENT_BYTES).  The final slice reassembles and applies the blob;
+    intermediates stage host-side and answer +OK."""
+    from redisson_tpu.server import replication
+
+    xfer_id, seq, nsegs = _s(args[0]), _int(args[1]), _int(args[2])
+    chunk = bytes(args[3])
+    xfers = server.__dict__.setdefault("_repl_xfers", {})
+    if seq == 0:
+        xfers[xfer_id] = [None] * nsegs
+        # a lost transfer must not leak staging forever: keep at most 4
+        while len(xfers) > 4:
+            xfers.pop(next(iter(xfers)))
+    slots = xfers.get(xfer_id)
+    if slots is None or len(slots) != nsegs or not (0 <= seq < nsegs):
+        raise RespError(f"ERR unknown replication transfer {xfer_id}/{seq}")
+    slots[seq] = chunk
+    if any(s is None for s in slots):
+        return "+OK"
+    del xfers[xfer_id]
+    return replication.apply_records(server.engine, b"".join(slots))
+
+
+@register("REPLFLUSH")
+def cmd_replflush(server, ctx, args):
+    """Ship dirty records to all replicas NOW (WAIT / syncSlaves analog)."""
+    if server._replication is None:
+        return 0
+    return server._replication.flush()
+
+
+@register("ROLE")
+def cmd_role(server, ctx, args):
+    """Redis ROLE parity: master -> ["master", 0, [replica addrs]];
+    replica -> ["slave", host, port, "connected", 0].  Failover
+    coordinators probe this to DISCOVER a dead master's replicas when they
+    started after the death (a successor coordinator has no poll history)."""
+    if server.role == "replica" and server.master_address:
+        host, _, port = server.master_address.rpartition(":")
+        return [b"slave", host.encode(), int(port), b"connected", 0]
+    reps = []
+    if server._replication is not None:
+        reps = [a.encode() for a in server._replication.replicas()]
+    promoted_from = getattr(server, "promoted_from", None)
+    # 4th element is our extension past Redis ROLE: the address this master
+    # was promoted FROM (empty when it never was a replica) — coordinators
+    # use it to adopt half-finished failovers without mistaking a restarted
+    # stale master for one
+    return [b"master", 0, reps, (promoted_from or "").encode()]
+
+
+@register("REPLICAS")
+def cmd_replicas(server, ctx, args):
+    if server._replication is None:
+        return []
+    return [a.encode() for a in server._replication.replicas()]
+
+
+@register("METRICS")
+def cmd_metrics(server, ctx, args):
+    """Prometheus text exposition of the node's metrics registry."""
+    return server.metrics.prometheus_text().encode()
+
+
+# -- checkpoint (SAVE analog; full impl in core/checkpoint.py) ---------------
+
+@register("SAVE")
+def cmd_save(server, ctx, args):
+    path = _s(args[0]) if args else server.checkpoint_path
+    if path is None:
+        raise RespError("ERR no checkpoint path configured")
+    from redisson_tpu.core import checkpoint
+
+    checkpoint.save(server.engine, path)
+    return "+OK"
+
+
+@register("RESTORESTATE")
+def cmd_restorestate(server, ctx, args):
+    path = _s(args[0]) if args else server.checkpoint_path
+    if path is None:
+        raise RespError("ERR no checkpoint path configured")
+    from redisson_tpu.core import checkpoint
+
+    n = checkpoint.load(server.engine, path)
+    return n
+
+
+# -- script / function / admin verbs (RScript + RFunction wire surface) ------
+
+def _script_svc(server):
+    from redisson_tpu.services.script import ScriptService
+
+    return server.engine.service("script", lambda: ScriptService(server.engine))
+
+
+def _function_svc(server):
+    from redisson_tpu.services.script import FunctionService
+
+    return server.engine.service("function", lambda: FunctionService(server.engine))
+
+
+def _proc_keys_args(args, at):
+    """numkeys keys... args... tail shared by EVALSHA/FCALL."""
+    n = _int(args[at])
+    if n < 0:
+        raise RespError("ERR Number of keys can't be negative")
+    if len(args) < at + 1 + n:
+        raise RespError("ERR Number of keys is greater than number of args")
+    keys = [_s(k) for k in args[at + 1 : at + 1 + n]]
+    rest = [bytes(a) for a in args[at + 1 + n :]]
+    return keys, rest
+
+
+@register("EVALSHA")
+def cmd_evalsha(server, ctx, args):
+    """EVALSHA sha numkeys key... arg... — invokes a script REGISTERED
+    SERVER-SIDE (embedded script_load).  Scripts here are Python callables,
+    so source never ships over the wire: remote callers address by digest
+    only, and a miss replies NOSCRIPT exactly like the reference's
+    EVAL-fallback discipline expects."""
+    from redisson_tpu.services.script import NoScriptError
+
+    keys, rest = _proc_keys_args(args, 1)
+    try:
+        return _script_svc(server).eval_sha(_s(args[0]), keys, rest)
+    except NoScriptError:
+        raise RespError("NOSCRIPT No matching script. Please use EVAL.")
+
+
+@register("EVAL")
+def cmd_eval(server, ctx, args):
+    raise RespError(
+        "ERR EVAL with shipped source is not supported on this server: "
+        "scripts are Python callables registered server-side (script_load); "
+        "invoke by digest with EVALSHA, or FCALL a loaded function library"
+    )
+
+
+@register("SCRIPT")
+def cmd_script(server, ctx, args):
+    sub = bytes(args[0]).upper()
+    svc = _script_svc(server)
+    if sub == b"EXISTS":
+        return [1 if ok else 0 for ok in svc.script_exists(*[_s(s) for s in args[1:]])]
+    if sub == b"FLUSH":
+        svc.script_flush()
+        return "+OK"
+    if sub == b"LOAD":
+        raise RespError(
+            "ERR SCRIPT LOAD over the wire is not supported (scripts are "
+            "Python callables; register them server-side)"
+        )
+    raise RespError(f"ERR Unknown SCRIPT subcommand '{_s(args[0])}'")
+
+
+def _fcall(server, args, read_only: bool):
+    keys, rest = _proc_keys_args(args, 1)
+    svc = _function_svc(server)
+    # resolve OUTSIDE the invocation: a KeyError raised by the function's
+    # own body must surface as the function's error, not "not found"
+    try:
+        fn = svc._resolve(_s(args[0]))
+    except KeyError:
+        raise RespError(f"ERR Function not found: {_s(args[0])}")
+    from redisson_tpu.services.script import ScriptMode
+
+    mode = ScriptMode.READ_ONLY if read_only else ScriptMode.READ_WRITE
+    return svc._script.eval(fn, keys, rest, mode)
+
+
+@register("FCALL")
+def cmd_fcall(server, ctx, args):
+    return _fcall(server, args, read_only=False)
+
+
+@register("FCALL_RO")
+def cmd_fcall_ro(server, ctx, args):
+    return _fcall(server, args, read_only=True)
+
+
+@register("FUNCTION")
+def cmd_function(server, ctx, args):
+    sub = bytes(args[0]).upper()
+    if sub == b"LIST":
+        out = []
+        for lib, fns in sorted(_function_svc(server).list().items()):
+            out.append([
+                b"library_name", lib.encode(),
+                b"functions", [f.encode() for f in fns],
+            ])
+        return out
+    if sub == b"DUMP" or sub == b"LOAD":
+        raise RespError(
+            "ERR FUNCTION libraries are Python callables registered "
+            "server-side; wire DUMP/LOAD is not supported"
+        )
+    raise RespError(f"ERR Unknown FUNCTION subcommand '{_s(args[0])}'")
+
+
+@register("WAIT")
+def cmd_wait(server, ctx, args):
+    """WAIT numreplicas timeout(ms): flush dirty records to replicas now and
+    report how many replicas are attached (record-level async replication:
+    a returned count >= numreplicas means the flush was SHIPPED to that
+    many replicas — the syncSlaves/REPLFLUSH semantics)."""
+    import time as _t
+
+    if len(args) < 2:
+        raise RespError("ERR wrong number of arguments for 'wait' command")
+    want = _int(args[0])
+    timeout_ms = _int(args[1])
+    if timeout_ms < 0:
+        raise RespError("ERR timeout is negative")
+    # Redis WAIT timeout 0 = block until the replica count is reached
+    # (same convention as _block_loop's timeout<=0)
+    deadline = None if timeout_ms == 0 else _t.time() + timeout_ms / 1000.0
+    while True:
+        n = 0
+        if server._replication is not None:
+            server._replication.flush()
+            n = len(server._replication.replicas())
+        if (
+            n >= want
+            or (deadline is not None and _t.time() >= deadline)
+            or getattr(server, "_closing", False)
+            or getattr(_exec_tls, "in_exec", False)  # no parking inside EXEC
+        ):
+            return n
+        _t.sleep(0.02)  # parked, not spinning: this holds a pool worker
+
+
+@register("CONFIG")
+def cmd_config(server, ctx, args):
+    """CONFIG GET pattern | CONFIG SET key value — the RedisNode.setConfig
+    admin surface over the server's live knob table."""
+    sub = bytes(args[0]).upper()
+    if sub == b"GET":
+        pattern = _s(args[1]) if len(args) > 1 else "*"
+        out = []
+        for k, v in sorted(server.config_view().items()):
+            if _glob_match(pattern, k):
+                out += [k.encode(), str(v).encode()]
+        return out
+    if sub == b"SET":
+        if not server.config_set(_s(args[1]), _s(args[2])):
+            raise RespError(f"ERR Unknown or read-only CONFIG parameter '{_s(args[1])}'")
+        return "+OK"
+    raise RespError(f"ERR Unknown CONFIG subcommand '{_s(args[0])}'")
+
+
+def _bmpop_prelude(args):
+    """Shared BLMPOP/BZMPOP validation: timeout + numkeys BEFORE any
+    delegation, so malformed input replies a syntax error, never ERR
+    internal."""
+    import math as _math
+
+    if len(args) < 4:
+        raise RespError("ERR wrong number of arguments")
+    try:
+        timeout = float(args[0])
+    except (TypeError, ValueError):
+        raise RespError("ERR timeout is not a float or out of range")
+    if not _math.isfinite(timeout) or timeout < 0:
+        # NaN would make every deadline comparison False: park forever
+        raise RespError("ERR timeout is not a float or out of range")
+    rest = args[1:]
+    n = _int(rest[0])
+    if n <= 0:
+        raise RespError("ERR numkeys should be greater than 0")
+    if len(rest) < 1 + n + 1:
+        raise RespError("ERR Number of keys is greater than number of args")
+    return timeout, rest, _s(rest[1])
+
+
+@register("BLMPOP")
+def cmd_blmpop(server, ctx, args):
+    """BLMPOP timeout numkeys key... LEFT|RIGHT [COUNT n]."""
+    timeout, rest, first_key = _bmpop_prelude(args)
+
+    def poll_once():
+        return cmd_lmpop(server, ctx, rest)
+
+    return _block_loop(server, first_key, poll_once, timeout)
+
+
+@register("BZMPOP")
+def cmd_bzmpop(server, ctx, args):
+    """BZMPOP timeout numkeys key... MIN|MAX [COUNT n]."""
+    timeout, rest, first_key = _bmpop_prelude(args)
+
+    def poll_once():
+        return cmd_zmpop(server, ctx, rest)
+
+    return _block_loop(server, first_key, poll_once, timeout)
+
+
+@register("DUMP")
+def cmd_dump(server, ctx, args):
+    """DUMP key — the portable record blob (core/checkpoint.dump_record;
+    wire names are stored keys, so no handle/NameMapper indirection)."""
+    from redisson_tpu.core import checkpoint
+
+    try:
+        return checkpoint.dump_record(server.engine, _s(args[0]))
+    except KeyError:
+        return None  # missing key dumps nil
+
+
+@register("RESTORE")
+def cmd_restore(server, ctx, args):
+    """RESTORE key ttl(ms) blob [REPLACE] — BUSYKEY unless REPLACE."""
+    from redisson_tpu.core import checkpoint
+
+    name = _s(args[0])
+    ttl_ms = _int(args[1])
+    if ttl_ms < 0:
+        raise RespError("ERR Invalid TTL value, must be >= 0")
+    opts = {bytes(a).upper() for a in args[3:]}
+    if opts - {b"REPLACE", b"PERSIST"}:
+        raise RespError("ERR syntax error")
+    try:
+        # Redis semantics: ttl 0 == no expiry.  RObject.migrate ships the
+        # remaining TTL as this explicit operand; the blob-carried TTL only
+        # applies to direct restore_record calls (checkpoint files).
+        checkpoint.restore_record(
+            server.engine, name, bytes(args[2]),
+            ttl_ms / 1000.0 if ttl_ms > 0 else None,
+            b"REPLACE" in opts, persist=b"PERSIST" in opts or ttl_ms == 0,
+        )
+    except ValueError as e:
+        msg = str(e)
+        raise RespError(msg if msg.startswith("BUSYKEY") else f"ERR {msg}")
+    return "+OK"

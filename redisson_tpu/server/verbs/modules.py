@@ -1,0 +1,557 @@
+"""Redis-stack module verbs: JSON.* (RedisJSON role) and FT.* (RediSearch role).
+
+Split from server/registry.py (round 5, no behavior change): one module per
+verb family, shared preludes in verbs/common.py so numkeys/syntax validation
+cannot diverge between families again.
+"""
+
+
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.registry import register, _s, _int
+from redisson_tpu.server.verbs.common import _fnum
+
+# -- redis-stack module verbs: JSON.* (RedisJSON role — RedissonJsonBucket
+# -- drives these same verbs in the reference) -------------------------------
+
+def _json(server, name: str):
+    from redisson_tpu.client.objects.binarystream import JsonBucket
+
+    return JsonBucket(server.engine, name)  # codec-free: documents are parsed JSON
+
+
+def _json_cmd(fn):
+    """Map JsonBucket exceptions (bad paths, type mismatches) to ERR replies."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(server, ctx, args):
+        import json as _j
+
+        try:
+            return fn(server, ctx, args, _j)
+        except (KeyError, IndexError) as e:
+            raise RespError(f"ERR Path does not exist: {e.args[0] if e.args else e}")
+        except (TypeError, ValueError) as e:
+            raise RespError(f"ERR {e}")
+
+    return wrapper
+
+
+@register("JSON.SET")
+@_json_cmd
+def cmd_json_set(server, ctx, args, _j):
+    """JSON.SET key path json [NX|XX]."""
+    name, path = _s(args[0]), _s(args[1])
+    value = _j.loads(bytes(args[2]))
+    mode = bytes(args[3]).upper() if len(args) > 3 else None
+    jb = _json(server, name)
+    if mode in (b"NX", b"XX"):
+        existing = jb.get(path)  # returns None for missing paths, never raises
+        if (mode == b"NX" and existing is not None) or (mode == b"XX" and existing is None):
+            return None
+    elif mode is not None:
+        raise RespError("ERR syntax error")
+    jb.set(path, value)
+    return "+OK"
+
+
+@register("JSON.GET")
+@_json_cmd
+def cmd_json_get(server, ctx, args, _j):
+    """JSON.GET key [path ...] — one path returns its value; several return
+    a {path: value} object (RedisJSON reply shape)."""
+    jb = _json(server, _s(args[0]))
+    paths = [_s(p) for p in args[1:]] or ["$"]
+    # JsonBucket.get swallows path errors and returns None; reply nil like
+    # RedisJSON (a stored JSON null also reads nil — simplified path
+    # semantics, the same trade the handle itself makes)
+    if len(paths) == 1:
+        v = jb.get(paths[0])
+        return None if v is None else _j.dumps(v).encode()
+    return _j.dumps({p: jb.get(p) for p in paths}).encode()
+
+
+@register("JSON.DEL")
+@_json_cmd
+def cmd_json_del(server, ctx, args, _j):
+    jb = _json(server, _s(args[0]))
+    return 1 if jb.delete(_s(args[1]) if len(args) > 1 else "$") else 0
+
+
+@register("JSON.TYPE")
+@_json_cmd
+def cmd_json_type(server, ctx, args, _j):
+    t = _json(server, _s(args[0])).type(_s(args[1]) if len(args) > 1 else "$")
+    return None if t is None else t.encode()
+
+
+@register("JSON.NUMINCRBY")
+@_json_cmd
+def cmd_json_numincrby(server, ctx, args, _j):
+    v = _json(server, _s(args[0])).increment_and_get(_s(args[1]), _j.loads(bytes(args[2])))
+    return _j.dumps(v).encode()
+
+
+@register("JSON.STRAPPEND")
+@_json_cmd
+def cmd_json_strappend(server, ctx, args, _j):
+    return _json(server, _s(args[0])).string_append(_s(args[1]), _j.loads(bytes(args[2])))
+
+
+@register("JSON.STRLEN")
+@_json_cmd
+def cmd_json_strlen(server, ctx, args, _j):
+    return _json(server, _s(args[0])).string_size(_s(args[1]) if len(args) > 1 else "$")
+
+
+@register("JSON.ARRAPPEND")
+@_json_cmd
+def cmd_json_arrappend(server, ctx, args, _j):
+    vals = [_j.loads(bytes(a)) for a in args[2:]]
+    return _json(server, _s(args[0])).array_append(_s(args[1]), *vals)
+
+
+@register("JSON.ARRINSERT")
+@_json_cmd
+def cmd_json_arrinsert(server, ctx, args, _j):
+    vals = [_j.loads(bytes(a)) for a in args[3:]]
+    return _json(server, _s(args[0])).array_insert(_s(args[1]), _int(args[2]), *vals)
+
+
+@register("JSON.ARRLEN")
+@_json_cmd
+def cmd_json_arrlen(server, ctx, args, _j):
+    return _json(server, _s(args[0])).array_size(_s(args[1]) if len(args) > 1 else "$")
+
+
+@register("JSON.ARRPOP")
+@_json_cmd
+def cmd_json_arrpop(server, ctx, args, _j):
+    idx = _int(args[2]) if len(args) > 2 else -1
+    v = _json(server, _s(args[0])).array_pop(_s(args[1]) if len(args) > 1 else "$", idx)
+    return None if v is None else _j.dumps(v).encode()
+
+
+@register("JSON.ARRTRIM")
+@_json_cmd
+def cmd_json_arrtrim(server, ctx, args, _j):
+    return _json(server, _s(args[0])).array_trim(_s(args[1]), _int(args[2]), _int(args[3]))
+
+
+@register("JSON.ARRINDEX")
+@_json_cmd
+def cmd_json_arrindex(server, ctx, args, _j):
+    start = _int(args[3]) if len(args) > 3 else 0
+    stop = _int(args[4]) if len(args) > 4 else 0
+    return _json(server, _s(args[0])).array_index_of(
+        _s(args[1]), _j.loads(bytes(args[2])), start, stop
+    )
+
+
+@register("JSON.OBJKEYS")
+@_json_cmd
+def cmd_json_objkeys(server, ctx, args, _j):
+    ks = _json(server, _s(args[0])).object_keys(_s(args[1]) if len(args) > 1 else "$")
+    return None if ks is None else [k.encode() for k in ks]
+
+
+@register("JSON.OBJLEN")
+@_json_cmd
+def cmd_json_objlen(server, ctx, args, _j):
+    return _json(server, _s(args[0])).object_size(_s(args[1]) if len(args) > 1 else "$")
+
+
+@register("JSON.CLEAR")
+@_json_cmd
+def cmd_json_clear(server, ctx, args, _j):
+    return _json(server, _s(args[0])).clear(_s(args[1]) if len(args) > 1 else "$")
+
+
+@register("JSON.TOGGLE")
+@_json_cmd
+def cmd_json_toggle(server, ctx, args, _j):
+    v = _json(server, _s(args[0])).toggle(_s(args[1]))
+    return None if v is None else int(v)
+
+
+@register("JSON.MERGE")
+@_json_cmd
+def cmd_json_merge(server, ctx, args, _j):
+    _json(server, _s(args[0])).merge(_s(args[1]), _j.loads(bytes(args[2])))
+    return "+OK"
+
+
+# -- redis-stack module verbs: FT.* (RediSearch role — RedissonSearch.java
+# -- drives these same verbs in the reference) -------------------------------
+
+def _ft(server):
+    from redisson_tpu.services.search import SearchService
+
+    return server.engine.service("search", lambda: SearchService(server.engine))
+
+
+def _ft_parse_query(q: str, schema: dict):
+    """RediSearch query subset -> Condition tree: `*`, `@f:[lo hi]` numeric
+    ranges ('(' = exclusive, ±inf), `@f:{tag|tag}`, `@f:text`, `@f:(txt)`,
+    bare words (full-text across every TEXT field); top-level terms AND."""
+    import re as _re
+
+    from redisson_tpu.services.search import And, Eq, In, Or, Range, Text
+
+    q = q.strip()
+    if q in ("*", ""):
+        return None
+    tokens = _re.findall(
+        r"@\w+:\[[^\]]*\]|@\w+:\{[^}]*\}|@\w+:\([^)]*\)|@\w+:\S+|\S+", q
+    )
+
+    def bound(s):
+        inc = not s.startswith("(")
+        s = s.lstrip("(")
+        if s in ("-inf", "inf", "+inf"):
+            return (float("-inf") if s == "-inf" else float("inf")), inc
+        return float(s), inc
+
+    terms = []
+    for t in tokens:
+        if t.startswith("@"):
+            fld, _, rest = t[1:].partition(":")
+            if rest.startswith("["):
+                body = rest[1:-1].split()
+                if len(body) != 2:
+                    raise RespError("ERR Syntax error in numeric range")
+                (lo, lo_inc), (hi, hi_inc) = bound(body[0]), bound(body[1])
+                terms.append(Range(fld, lo, hi, lo_inc, hi_inc))
+            elif rest.startswith("{"):
+                vals = [v.strip() for v in rest[1:-1].split("|") if v.strip()]
+                if not vals:
+                    raise RespError("ERR syntax error: empty tag set")
+                terms.append(Eq(fld, vals[0]) if len(vals) == 1 else In(fld, vals))
+            elif rest.startswith("("):
+                terms.append(Text(fld, rest[1:-1]))
+            else:
+                terms.append(Text(fld, rest))
+        else:
+            text_fields = [f for f, ty in schema.items() if ty == "TEXT"]
+            if not text_fields:
+                raise RespError(f"ERR no TEXT field for bare term '{t}'")
+            parts = [Text(f, t) for f in text_fields]
+            terms.append(parts[0] if len(parts) == 1 else Or(parts))
+    return terms[0] if len(terms) == 1 else And(terms)
+
+
+def _ft_cmd(fn):
+    """Map malformed FT arguments/queries to syntax errors, missing indexes
+    to the RediSearch wording — never 'ERR internal'."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(server, ctx, args):
+        try:
+            return fn(server, ctx, args)
+        except KeyError:
+            raise RespError("ERR Unknown Index name")
+        except (ValueError, IndexError) as e:
+            raise RespError(f"ERR syntax error: {e}")
+
+    return wrapper
+
+
+@register("FT.CREATE")
+@_ft_cmd
+def cmd_ft_create(server, ctx, args):
+    """FT.CREATE idx [ON HASH] [PREFIX n p...] SCHEMA f TYPE [SORTABLE] ..."""
+    name = _s(args[0])
+    prefixes = [""]
+    i = 1
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"ON":
+            if bytes(args[i + 1]).upper() != b"HASH":
+                raise RespError("ERR only ON HASH indexes are supported")
+            i += 2
+        elif opt == b"PREFIX":
+            n = _int(args[i + 1])
+            prefixes = [_s(p) for p in args[i + 2 : i + 2 + n]]
+            i += 2 + n
+        elif opt == b"SCHEMA":
+            i += 1
+            break
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    else:
+        raise RespError("ERR SCHEMA is required")
+    schema = {}
+    while i < len(args):
+        fld = _s(args[i])
+        ty = bytes(args[i + 1]).upper().decode()
+        if ty not in ("TEXT", "TAG", "NUMERIC"):
+            raise RespError(f"ERR unsupported field type '{ty}'")
+        schema[fld] = ty
+        i += 2
+        if i < len(args) and bytes(args[i]).upper() == b"SORTABLE":
+            i += 1  # everything is sortable here
+    try:
+        _ft(server).create(name, schema, prefixes, doc_mode="hash")
+    except ValueError as e:
+        raise RespError(f"ERR {e}")
+    return "+OK"
+
+
+@register("FT.DROPINDEX")
+@_ft_cmd
+def cmd_ft_dropindex(server, ctx, args):
+    if not _ft(server).drop_index(_s(args[0])):
+        raise RespError("ERR Unknown Index name")
+    return "+OK"
+
+
+@register("FT._LIST")
+@_ft_cmd
+def cmd_ft_list(server, ctx, args):
+    return [n.encode() for n in _ft(server).index_names()]
+
+
+@register("FT.INFO")
+@_ft_cmd
+def cmd_ft_info(server, ctx, args):
+    svc = _ft(server)
+    idx = svc._idx(_s(args[0]))  # KeyError -> Unknown Index via _ft_cmd
+    svc.sync(_s(args[0]))
+    info = svc.info(_s(args[0]))
+    flat_schema = []
+    for f, ty in info["schema"].items():
+        flat_schema.append([f.encode(), b"type", ty.encode()])
+    return [
+        b"index_name", info["name"].encode(),
+        b"num_docs", info["num_docs"],
+        b"attributes", flat_schema,
+        b"prefixes", [p.encode() for p in info["prefixes"]],
+    ]
+
+
+@register("FT.SEARCH")
+@_ft_cmd
+def cmd_ft_search(server, ctx, args):
+    """FT.SEARCH idx query [NOCONTENT] [SORTBY f [ASC|DESC]] [LIMIT off n]
+    -> [total, id, [f, v, ...], ...] (RediSearch reply shape)."""
+    svc = _ft(server)
+    idx = svc._idx(_s(args[0]))  # KeyError -> Unknown Index via _ft_cmd
+    svc.sync(_s(args[0]))
+    cond = _ft_parse_query(_s(args[1]), idx.schema)
+    nocontent = False
+    sort_by, desc = None, False
+    off, lim = 0, 10
+    i = 2
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"NOCONTENT":
+            nocontent = True
+            i += 1
+        elif opt == b"SORTBY":
+            sort_by = _s(args[i + 1])
+            i += 2
+            if i < len(args) and bytes(args[i]).upper() in (b"ASC", b"DESC"):
+                desc = bytes(args[i]).upper() == b"DESC"
+                i += 1
+        elif opt == b"LIMIT":
+            off, lim = _int(args[i + 1]), _int(args[i + 2])
+            i += 3
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    res = svc.search(_s(args[0]), cond, sort_by=sort_by, descending=desc,
+                     offset=off, limit=lim)
+    out = [res.total]
+    for doc_id, fields in res.docs:
+        out.append(doc_id.encode())
+        if not nocontent:
+            flat = []
+            for k, v in fields.items():
+                flat += [str(k).encode(), str(v).encode()]
+            out.append(flat)
+    return out
+
+
+@register("FT.AGGREGATE")
+@_ft_cmd
+def cmd_ft_aggregate(server, ctx, args):
+    """FT.AGGREGATE idx query [GROUPBY 1 @f REDUCE op n [@f] AS name ...]
+    [SORTBY n @f [ASC|DESC]] [LIMIT off n] [WITHCURSOR [COUNT n]]."""
+    svc = _ft(server)
+    idx = svc._idx(_s(args[0]))  # KeyError -> Unknown Index via _ft_cmd
+    svc.sync(svc.resolve(_s(args[0])))
+    cond = _ft_parse_query(_s(args[1]), idx.schema)
+    group_by, reducers = None, {}
+    sort_by, desc = None, False
+    off, lim = 0, None
+    withcursor, cursor_count = False, 1000
+    i = 2
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"WITHCURSOR":
+            withcursor = True
+            i += 1
+            if i + 1 < len(args) and bytes(args[i]).upper() == b"COUNT":
+                cursor_count = _int(args[i + 1])
+                i += 2
+        elif opt == b"GROUPBY":
+            if _int(args[i + 1]) != 1:
+                raise RespError("ERR GROUPBY supports exactly one property")
+            group_by = _s(args[i + 2]).lstrip("@")
+            i += 3
+        elif opt == b"REDUCE":
+            op = _s(args[i + 1]).lower()
+            if op not in ("count", "sum", "avg", "min", "max"):
+                raise RespError(f"ERR unsupported reducer '{op}'")
+            nargs = _int(args[i + 2])
+            fld = _s(args[i + 3]).lstrip("@") if nargs else None
+            i += 3 + nargs
+            name = f"{op}({fld or ''})"
+            if i < len(args) and bytes(args[i]).upper() == b"AS":
+                name = _s(args[i + 1])
+                i += 2
+            reducers[name] = (op, fld)
+        elif opt == b"SORTBY":
+            n = _int(args[i + 1])
+            sort_by = _s(args[i + 2]).lstrip("@")
+            if n > 1:
+                desc = bytes(args[i + 3]).upper() == b"DESC"
+            i += 2 + n
+        elif opt == b"LIMIT":
+            off, lim = _int(args[i + 1]), _int(args[i + 2])
+            i += 3
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    rows = svc.aggregate(_s(args[0]), cond, group_by=group_by,
+                         reducers=reducers or None, sort_by=sort_by,
+                         descending=desc, offset=off, limit=lim)
+    flat_rows = []
+    for row in rows:
+        flat = []
+        for k, v in row.items():
+            flat += [str(k).encode(), str(v).encode()]
+        flat_rows.append(flat)
+    if withcursor:
+        batch, rest = flat_rows[:cursor_count], flat_rows[cursor_count:]
+        cid = svc.cursor_create(rest) if rest else 0
+        return [[len(batch)] + batch, cid]
+    return [len(flat_rows)] + flat_rows
+
+
+@register("FT.CURSOR")
+@_ft_cmd
+def cmd_ft_cursor(server, ctx, args):
+    """FT.CURSOR READ idx cid [COUNT n] | FT.CURSOR DEL idx cid — pages a
+    WITHCURSOR aggregation (RediSearch cursor API)."""
+    svc = _ft(server)
+    sub = bytes(args[0]).upper()
+    cid = _int(args[2])
+    if sub == b"READ":
+        count = 1000
+        if len(args) > 4 and bytes(args[3]).upper() == b"COUNT":
+            count = _int(args[4])
+        rows, nxt = svc.cursor_read(cid, count)  # KeyError -> unknown cursor
+        return [[len(rows)] + rows, nxt]
+    if sub == b"DEL":
+        svc.cursor_del(cid)
+        return "+OK"
+    raise RespError("ERR syntax error")
+
+
+@register("FT.ALTER")
+@_ft_cmd
+def cmd_ft_alter(server, ctx, args):
+    """FT.ALTER idx SCHEMA ADD field type [SORTABLE]."""
+    if (
+        len(args) < 5
+        or bytes(args[1]).upper() != b"SCHEMA"
+        or bytes(args[2]).upper() != b"ADD"
+    ):
+        raise RespError("ERR syntax error")
+    ty = bytes(args[4]).upper().decode()
+    if ty not in ("TEXT", "TAG", "NUMERIC"):
+        raise RespError(f"ERR unsupported field type '{ty}'")
+    try:
+        _ft(server).alter(_s(args[0]), _s(args[3]), ty)
+    except ValueError as e:
+        raise RespError(f"ERR {e}")
+    return "+OK"
+
+
+@register("FT.ALIASADD")
+@_ft_cmd
+def cmd_ft_aliasadd(server, ctx, args):
+    try:
+        _ft(server).alias_add(_s(args[0]), _s(args[1]))
+    except ValueError as e:
+        raise RespError(f"ERR {e}")
+    return "+OK"
+
+
+@register("FT.ALIASUPDATE")
+@_ft_cmd
+def cmd_ft_aliasupdate(server, ctx, args):
+    _ft(server).alias_update(_s(args[0]), _s(args[1]))
+    return "+OK"
+
+
+@register("FT.ALIASDEL")
+@_ft_cmd
+def cmd_ft_aliasdel(server, ctx, args):
+    try:
+        _ft(server).alias_del(_s(args[0]))
+    except ValueError as e:
+        raise RespError(f"ERR {e}")
+    return "+OK"
+
+
+@register("FT.DICTADD")
+@_ft_cmd
+def cmd_ft_dictadd(server, ctx, args):
+    return _ft(server).dict_add(_s(args[0]), *[_s(a) for a in args[1:]])
+
+
+@register("FT.DICTDEL")
+@_ft_cmd
+def cmd_ft_dictdel(server, ctx, args):
+    return _ft(server).dict_del(_s(args[0]), *[_s(a) for a in args[1:]])
+
+
+@register("FT.DICTDUMP")
+@_ft_cmd
+def cmd_ft_dictdump(server, ctx, args):
+    return [t.encode() for t in _ft(server).dict_dump(_s(args[0]))]
+
+
+@register("FT.SPELLCHECK")
+@_ft_cmd
+def cmd_ft_spellcheck(server, ctx, args):
+    """FT.SPELLCHECK idx query [DISTANCE d] [TERMS INCLUDE|EXCLUDE dict]...
+    -> [["TERM", term, [[score, suggestion], ...]], ...]."""
+    include, exclude = [], []
+    distance = 1
+    i = 2
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"DISTANCE":
+            distance = _int(args[i + 1])
+            if not 1 <= distance <= 4:
+                raise RespError("ERR invalid distance, must be between 1 and 4")
+            i += 2
+        elif opt == b"TERMS":
+            mode = bytes(args[i + 1]).upper()
+            (include if mode == b"INCLUDE" else exclude).append(_s(args[i + 2]))
+            i += 3
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    res = _ft(server).spellcheck(
+        _s(args[0]), _s(args[1]), include=include, exclude=exclude,
+        distance=distance,
+    )
+    return [
+        [b"TERM", term.encode(),
+         [[_fnum(score), sugg.encode()] for score, sugg in suggs]]
+        for term, suggs in res.items()
+    ]
+
+
