@@ -44,13 +44,25 @@ class _ShardedBase(RExpirable):
     def _mgr(self) -> MeshManager:
         return MeshManager.of(self._engine)
 
+    def _bloom_width(self, m: int, geom) -> int:
+        """Stored plane width for the dispatch geometry: the hash domain m
+        padded to a lane-aligned shard multiple (pad columns are never
+        probed, so a reshard to a non-dividing shard count just re-pads —
+        live resharding, SURVEY §7.3-4)."""
+        return self._mgr.round_up(m, 128 * geom.n_shard)
+
+    def _hll_rows(self, tenants: int, geom) -> int:
+        """Stored row count for the dispatch geometry (logical tenants
+        padded to a shard multiple; pad rows are never addressed)."""
+        return self._mgr.round_up(tenants, geom.n_shard)
+
     def _rec(self) -> StateRecord:
         rec = self._engine.store.get(self._name)
         if rec is None:
             raise RuntimeError(f"{type(self).__name__} '{self._name}' is not initialized")
         return rec
 
-    def _pack(self, tenant_ids, keys):
+    def _pack(self, tenant_ids, keys, geom):
         t = np.ascontiguousarray(tenant_ids, np.int32)
         if not self._engine.is_int_batch(keys):
             raise TypeError(
@@ -61,7 +73,7 @@ class _ShardedBase(RExpirable):
         if t.shape != arr.shape:
             raise ValueError("tenant_ids and keys must be aligned 1-D arrays")
         lo, hi = H.int_keys_to_u32_pair(arr)
-        return self._mgr.pad_batch(t, lo, hi)
+        return self._mgr.pad_batch(t, lo, hi, geom=geom)
 
 
 class ShardedBloomFilterArray(_ShardedBase):
@@ -121,14 +133,20 @@ class ShardedBloomFilterArray(_ShardedBase):
 
     def add_each(self, tenant_ids, keys) -> np.ndarray:
         """Batch add across tenants; bool array: element was (probably) new."""
-        tenant, lo, hi, n = self._pack(tenant_ids, keys)
+        geom = self._mgr.geometry()
+        tenant, lo, hi, n = self._pack(tenant_ids, keys, geom)
         if n == 0:
             return np.zeros((0,), bool)
         with self._engine.locked(self._name):
             rec = self._rec()
             meta = rec.meta
-            add, _ = self._mgr.bloom_kernels(meta["k"], meta["m"], meta["tenants"])
-            bits = self._mgr.ensure_state(rec, "bits", BLOOM_SPEC)
+            w = self._bloom_width(meta["m"], geom)
+            add, _ = self._mgr.bloom_kernels(
+                meta["k"], meta["m"], meta["tenants"], width=w, geom=geom
+            )
+            bits = self._mgr.adapt_plane(
+                rec, "bits", BLOOM_SPEC, axis=1, length=w, geom=geom
+            )
             bits, newly = add(bits, tenant, lo, hi, n)
             rec.arrays["bits"] = bits
             self._touch_version(rec)
@@ -145,14 +163,20 @@ class ShardedBloomFilterArray(_ShardedBase):
     def contains_async(self, tenant_ids, keys):
         """Pipelined probe: (device bool array, n_valid) without forcing the
         device->host sync — callers keep flushes in flight and force later."""
-        tenant, lo, hi, n = self._pack(tenant_ids, keys)
+        geom = self._mgr.geometry()
+        tenant, lo, hi, n = self._pack(tenant_ids, keys, geom)
         if n == 0:
             return np.zeros((0,), bool), 0
         with self._engine.locked(self._name):
             rec = self._rec()
             meta = rec.meta
-            _, contains = self._mgr.bloom_kernels(meta["k"], meta["m"], meta["tenants"])
-            bits = self._mgr.ensure_state(rec, "bits", BLOOM_SPEC)
+            w = self._bloom_width(meta["m"], geom)
+            _, contains = self._mgr.bloom_kernels(
+                meta["k"], meta["m"], meta["tenants"], width=w, geom=geom
+            )
+            bits = self._mgr.adapt_plane(
+                rec, "bits", BLOOM_SPEC, axis=1, length=w, geom=geom
+            )
             found = contains(bits, tenant, lo, hi, n)
         return found, n
 
@@ -165,7 +189,11 @@ class ShardedBloomFilterArray(_ShardedBase):
                 raise IndexError(
                     f"tenant {tenant_id} out of range [0, {rec.meta['tenants']})"
                 )
-            bits = self._mgr.ensure_state(rec, "bits", BLOOM_SPEC)
+            geom = self._mgr.geometry()
+            w = self._bloom_width(rec.meta["m"], geom)
+            bits = self._mgr.adapt_plane(
+                rec, "bits", BLOOM_SPEC, axis=1, length=w, geom=geom
+            )
             rec.arrays["bits"] = bits.at[tenant_id].set(jnp.uint8(0))
             self._touch_version(rec)
 
@@ -174,7 +202,11 @@ class ShardedBloomFilterArray(_ShardedBase):
         then summed by XLA across the column shards."""
         with self._engine.locked(self._name):
             rec = self._rec()
-            bits = self._mgr.ensure_state(rec, "bits", BLOOM_SPEC)
+            geom = self._mgr.geometry()
+            w = self._bloom_width(rec.meta["m"], geom)
+            bits = self._mgr.adapt_plane(
+                rec, "bits", BLOOM_SPEC, axis=1, length=w, geom=geom
+            )
             return np.asarray(jnp.sum(bits.astype(jnp.int32), axis=1))
 
 
@@ -188,7 +220,8 @@ class ShardedHllArray(_ShardedBase):
         if tenants <= 0:
             raise ValueError("tenants must be positive")
         mgr = self._mgr
-        padded_tenants = mgr.round_up(tenants, mgr.n_shard)
+        geom = mgr.geometry()
+        padded_tenants = self._hll_rows(tenants, geom)
         with self._engine.locked(self._name):
             if self._engine.store.exists(self._name):
                 return False
@@ -197,7 +230,6 @@ class ShardedHllArray(_ShardedBase):
                 kind=self._kind,
                 meta={
                     "tenants": tenants,
-                    "padded_tenants": padded_tenants,
                     "p": p,
                     "hash": H.HASH_NAME,
                     "sharded": True,
@@ -215,14 +247,18 @@ class ShardedHllArray(_ShardedBase):
         return self._mgr.n_shard
 
     def add_each(self, tenant_ids, keys) -> None:
-        tenant, lo, hi, n = self._pack(tenant_ids, keys)
+        geom = self._mgr.geometry()
+        tenant, lo, hi, n = self._pack(tenant_ids, keys, geom)
         if n == 0:
             return
         with self._engine.locked(self._name):
             rec = self._rec()
             meta = rec.meta
-            add, _ = self._mgr.hll_kernels(meta["p"], meta["padded_tenants"])
-            regs = self._mgr.ensure_state(rec, "regs", HLL_SPEC)
+            rows = self._hll_rows(meta["tenants"], geom)
+            add, _ = self._mgr.hll_kernels(meta["p"], rows, geom=geom)
+            regs = self._mgr.adapt_plane(
+                rec, "regs", HLL_SPEC, axis=0, length=rows, geom=geom
+            )
             rec.arrays["regs"] = add(regs, tenant, lo, hi, n)
             self._touch_version(rec)
 
@@ -231,8 +267,12 @@ class ShardedHllArray(_ShardedBase):
         with self._engine.locked(self._name):
             rec = self._rec()
             meta = rec.meta
-            _, estimate = self._mgr.hll_kernels(meta["p"], meta["padded_tenants"])
-            regs = self._mgr.ensure_state(rec, "regs", HLL_SPEC)
+            geom = self._mgr.geometry()
+            rows = self._hll_rows(meta["tenants"], geom)
+            _, estimate = self._mgr.hll_kernels(meta["p"], rows, geom=geom)
+            regs = self._mgr.adapt_plane(
+                rec, "regs", HLL_SPEC, axis=0, length=rows, geom=geom
+            )
             ests = estimate(regs)
         return np.asarray(ests)[: meta["tenants"]]
 
@@ -246,7 +286,11 @@ class ShardedHllArray(_ShardedBase):
                 raise IndexError(
                     f"tenant {tenant_id} out of range [0, {rec.meta['tenants']})"
                 )
-            regs = self._mgr.ensure_state(rec, "regs", HLL_SPEC)
+            geom = self._mgr.geometry()
+            rows = self._hll_rows(rec.meta["tenants"], geom)
+            regs = self._mgr.adapt_plane(
+                rec, "regs", HLL_SPEC, axis=0, length=rows, geom=geom
+            )
             rec.arrays["regs"] = regs.at[tenant_id].set(jnp.uint8(0))
             self._touch_version(rec)
 
@@ -259,9 +303,11 @@ class ShardedBitSet(_ShardedBase):
     single chip's HBM, probed/updated with one psum over ICI (SURVEY.md
     §5.7: the reference's one-key-one-shard ceiling removed for bulk bits).
 
-    Fixed geometry: the plane is sized at try_init (padded to a lane- and
-    shard-aligned width); indexes are validated against the LOGICAL size, so
-    padding never leaks into results."""
+    The LOGICAL size is fixed at try_init; the STORED width is mesh-
+    dependent (padded to a lane- and shard-aligned multiple for the current
+    geometry and re-padded on reshard by adapt_plane).  Indexes are
+    validated against the logical size, so padding never leaks into
+    results — never compare raw plane shapes across records."""
 
     _kind = "sharded_bitset"
 
@@ -321,8 +367,14 @@ class ShardedBitSet(_ShardedBase):
             idx, n = self._pack_indexes(indexes, rec.meta["size"])
             if n == 0:
                 return np.zeros((0,), bool)
-            (set_t, set_f), _, _ = self._mgr.bitset_kernels(rec.meta["m"])
-            bits = self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
+            geom = self._mgr.geometry()
+            w = self._bloom_width(rec.meta["m"], geom)
+            (set_t, set_f), _, _ = self._mgr.bitset_kernels(
+                rec.meta["m"], width=w, geom=geom
+            )
+            bits = self._mgr.adapt_plane(
+                rec, "bits", BITSET_SPEC, axis=0, length=w, geom=geom
+            )
             bits, old = (set_t if value else set_f)(bits, idx, n)
             rec.arrays["bits"] = bits
             self._touch_version(rec)
@@ -334,8 +386,12 @@ class ShardedBitSet(_ShardedBase):
             idx, n = self._pack_indexes(indexes, rec.meta["size"])
             if n == 0:
                 return np.zeros((0,), bool)
-            _, get, _ = self._mgr.bitset_kernels(rec.meta["m"])
-            bits = self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
+            geom = self._mgr.geometry()
+            w = self._bloom_width(rec.meta["m"], geom)
+            _, get, _ = self._mgr.bitset_kernels(rec.meta["m"], width=w, geom=geom)
+            bits = self._mgr.adapt_plane(
+                rec, "bits", BITSET_SPEC, axis=0, length=w, geom=geom
+            )
             got = get(bits, idx, n)
         return np.asarray(got)[:n]
 
@@ -348,16 +404,21 @@ class ShardedBitSet(_ShardedBase):
     def cardinality(self) -> int:
         with self._engine.locked(self._name):
             rec = self._rec()
-            _, _, card = self._mgr.bitset_kernels(rec.meta["m"])
-            bits = self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
+            geom = self._mgr.geometry()
+            w = self._bloom_width(rec.meta["m"], geom)
+            _, _, card = self._mgr.bitset_kernels(rec.meta["m"], width=w, geom=geom)
+            bits = self._mgr.adapt_plane(
+                rec, "bits", BITSET_SPEC, axis=0, length=w, geom=geom
+            )
             return int(card(bits))
 
     def clear(self) -> None:
         with self._engine.locked(self._name):
             rec = self._rec()
-            rec.arrays["bits"] = jnp.zeros_like(
-                self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
-            )
+            geom = self._mgr.geometry()
+            w = self._bloom_width(rec.meta["m"], geom)
+            rec.arrays["bits"] = jnp.zeros((w,), jnp.uint8)
+            self._mgr.ensure_state(rec, "bits", BITSET_SPEC, geom=geom)
             self._touch_version(rec)
 
     def _binary_op(self, op, other_names):
@@ -367,7 +428,11 @@ class ShardedBitSet(_ShardedBase):
         names = [self._name, *other_names]
         with self._engine.locked_many(names):
             rec = self._rec()
-            bits = self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
+            geom = self._mgr.geometry()
+            w = self._bloom_width(rec.meta["m"], geom)
+            bits = self._mgr.adapt_plane(
+                rec, "bits", BITSET_SPEC, axis=0, length=w, geom=geom
+            )
             for other in other_names:
                 orec = self._engine.store.get(other)
                 if orec is None or orec.kind != self._kind:
@@ -377,7 +442,9 @@ class ShardedBitSet(_ShardedBase):
                     # plant ghost bits past this plane's size, corrupting
                     # cardinality() and not_()'s padding invariant
                     raise ValueError("sharded BITOP operands must share geometry (size and plane width)")
-                obits = self._mgr.ensure_state(orec, "bits", BITSET_SPEC)
+                obits = self._mgr.adapt_plane(
+                    orec, "bits", BITSET_SPEC, axis=0, length=w, geom=geom
+                )
                 bits = op(bits, obits)
             rec.arrays["bits"] = bits
             self._touch_version(rec)
@@ -396,7 +463,11 @@ class ShardedBitSet(_ShardedBase):
         cross-plane ops never see ghost bits)."""
         with self._engine.locked(self._name):
             rec = self._rec()
-            bits = self._mgr.ensure_state(rec, "bits", BITSET_SPEC)
-            mask = (jnp.arange(rec.meta["m"], dtype=jnp.int32) < rec.meta["size"])
+            geom = self._mgr.geometry()
+            w = self._bloom_width(rec.meta["m"], geom)
+            bits = self._mgr.adapt_plane(
+                rec, "bits", BITSET_SPEC, axis=0, length=w, geom=geom
+            )
+            mask = (jnp.arange(w, dtype=jnp.int32) < rec.meta["size"])
             rec.arrays["bits"] = jnp.where(mask, 1 - bits, bits).astype(jnp.uint8)
             self._touch_version(rec)

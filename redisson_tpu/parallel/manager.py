@@ -26,7 +26,7 @@ slices; SURVEY.md §2.8's "cluster bus").
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -56,6 +56,29 @@ def initialize_multihost(
     )
 
 
+class Geometry(NamedTuple):
+    """One consistent view of the mesh for the duration of ONE dispatch.
+
+    Every step of a sharded dispatch (width calc, batch padding, kernel
+    fetch, plane adaptation) must see the SAME mesh — re-reading
+    MeshManager.mesh mid-dispatch races a concurrent reshard() into a torn
+    geometry (batch padded for the old dp, kernel compiled for the new
+    shard axis).  Handles grab a Geometry once per call and thread it
+    through; the epoch keys the kernel cache so a stale build can never be
+    served after a reshard."""
+
+    mesh: Mesh
+    epoch: int
+
+    @property
+    def dp(self) -> int:
+        return self.mesh.shape[M.DP_AXIS]
+
+    @property
+    def n_shard(self) -> int:
+        return self.mesh.shape[M.SHARD_AXIS]
+
+
 class MeshManager:
     SERVICE_KEY = "mesh_manager"
 
@@ -64,6 +87,7 @@ class MeshManager:
         self._mesh = mesh
         self._guard = threading.Lock()
         self._kernels: Dict[Tuple, Tuple] = {}
+        self._epoch = 0
 
     @classmethod
     def of(cls, engine) -> "MeshManager":
@@ -83,6 +107,30 @@ class MeshManager:
                 self._mesh = M.make_mesh(n_devices=n, dp=dp)
             return self._mesh
 
+    def reshard(self, dp: int, shard: int) -> Mesh:
+        """Live mesh-geometry change (SURVEY §7.3 hard-part 4; the role of
+        slot migration, cluster/ClusterConnectionManager.java:358-450, done
+        as array re-layout).  Swaps the mesh and drops the kernel cache; the
+        DUAL-ROUTING WINDOW is per-record: a dispatch already in flight
+        holds its record lock and finishes on the old geometry (its compiled
+        kernel closes over the old mesh), while every subsequent dispatch
+        adapts that record's plane to the new geometry under the same lock
+        (adapt_plane) — so at any instant some records serve on the old
+        layout and some on the new, and no probe is lost or double-applied
+        because the record lock orders the two."""
+        new = M.make_mesh(n_devices=dp * shard, dp=dp)
+        with self._guard:
+            self._mesh = new
+            self._epoch += 1
+            self._kernels.clear()
+        return new
+
+    def geometry(self) -> Geometry:
+        """Snapshot (mesh, epoch) for one dispatch; grab ONCE per call."""
+        self.mesh  # noqa: B018 — force the lazy build (under the guard)
+        with self._guard:
+            return Geometry(self._mesh, self._epoch)
+
     @property
     def n_shard(self) -> int:
         return self.mesh.shape[M.SHARD_AXIS]
@@ -96,60 +144,74 @@ class MeshManager:
 
     # -- kernel cache --------------------------------------------------------
 
-    def bloom_kernels(self, k: int, m: int, tenants: int):
-        """(add, contains) for a (tenants, m) plane sharded over the mesh."""
-        key = ("bloom", k, m, tenants)
-        mesh = self.mesh  # resolve BEFORE taking the guard (mesh locks it too)
+    def _cached(self, geom: Optional[Geometry], key: Tuple, build):
+        """Fetch/build a kernel set for `geom`.  The epoch in the cache key
+        plus the insert-time epoch check make cache poisoning impossible: a
+        getter racing reshard() may still BUILD against the old mesh (its
+        caller's dispatch legitimately finishes on the old geometry), but it
+        can never INSERT that build where the new epoch would find it."""
+        if geom is None:
+            geom = self.geometry()
+        key = (geom.epoch, *key)
         with self._guard:
             fns = self._kernels.get(key)
-            if fns is None:
-                fns = self._kernels[key] = make_sharded_bloom_kernels(
-                    mesh, k=k, m=m, n_tenants=tenants
-                )
+        if fns is not None:
+            return fns
+        fns = build(geom.mesh)
+        with self._guard:
+            if self._epoch == geom.epoch:
+                self._kernels[key] = fns
         return fns
 
-    def bitset_kernels(self, m: int):
+    def bloom_kernels(self, k: int, m: int, tenants: int, width: int = 0,
+                      geom: Optional[Geometry] = None):
+        """(add, contains) for a (tenants, width) plane sharded over the
+        mesh; m is the hash domain (width pads it to a shard multiple)."""
+        return self._cached(
+            geom, ("bloom", k, m, tenants, width),
+            lambda mesh: make_sharded_bloom_kernels(
+                mesh, k=k, m=m, n_tenants=tenants, width=width
+            ),
+        )
+
+    def bitset_kernels(self, m: int, width: int = 0,
+                       geom: Optional[Geometry] = None):
         """(set, get, cardinality) for one (m,) plane column-sharded."""
-        key = ("bitset", m)
-        mesh = self.mesh  # resolve BEFORE taking the guard
-        with self._guard:
-            fns = self._kernels.get(key)
-            if fns is None:
-                from redisson_tpu.parallel.sharded import make_sharded_bitset_kernels
+        from redisson_tpu.parallel.sharded import make_sharded_bitset_kernels
 
-                fns = self._kernels[key] = make_sharded_bitset_kernels(mesh, m=m)
-        return fns
+        return self._cached(
+            geom, ("bitset", m, width),
+            lambda mesh: make_sharded_bitset_kernels(mesh, m=m, width=width),
+        )
 
-    def hll_kernels(self, p: int, tenants: int):
-        """(add, estimate) for a (tenants, m_regs) HLL bank, tenant-sharded."""
-        key = ("hll", p, tenants)
-        mesh = self.mesh  # resolve BEFORE taking the guard
-        with self._guard:
-            fns = self._kernels.get(key)
-            if fns is None:
-                fns = self._kernels[key] = make_sharded_hll_kernels(
-                    mesh, p=p, n_tenants=tenants
-                )
-        return fns
+    def hll_kernels(self, p: int, rows: int, geom: Optional[Geometry] = None):
+        """(add, estimate) for a (rows, m_regs) HLL bank, tenant-sharded."""
+        return self._cached(
+            geom, ("hll", p, rows),
+            lambda mesh: make_sharded_hll_kernels(mesh, p=p, n_rows=rows),
+        )
 
     # -- placement helpers ---------------------------------------------------
 
     def round_up(self, value: int, multiple: int) -> int:
         return (value + multiple - 1) // multiple * multiple
 
-    def pad_batch(self, tenant: np.ndarray, lo: np.ndarray, hi: np.ndarray):
+    def pad_batch(self, tenant: np.ndarray, lo: np.ndarray, hi: np.ndarray,
+                  geom: Optional[Geometry] = None):
         """Pad op arrays to a dp-divisible pow2 bucket and place them on the
         dp axis.  Returns (tenant, lo, hi) device arrays + n_valid."""
         from redisson_tpu.core import kernels as K
 
+        if geom is None:
+            geom = self.geometry()
         n = lo.shape[0]
-        b = self.round_up(K.bucket_size(max(1, n)), self.dp)
+        b = self.round_up(K.bucket_size(max(1, n)), geom.dp)
         pad = b - n
         if pad:
             tenant = np.pad(tenant, (0, pad))
             lo = np.pad(lo, (0, pad))
             hi = np.pad(hi, (0, pad))
-        sb = M.batch_sharding(self.mesh)
+        sb = M.batch_sharding(geom.mesh)
         return (
             jax.device_put(tenant, sb),
             jax.device_put(lo, sb),
@@ -157,12 +219,38 @@ class MeshManager:
             n,
         )
 
-    def ensure_state(self, rec, key: str, spec: P):
+    def adapt_plane(self, rec, key: str, spec: P, axis: int, length: int,
+                    geom: Optional[Geometry] = None):
+        """ensure_state + geometry adaptation: pad/trim `axis` of the plane
+        to `length` (the dispatch geometry's divisibility requirement),
+        entirely on device, then place on the mesh.  Pad cells are zeros and
+        are never addressed by the kernels (probes index the logical
+        domain), so trimming back only ever removes zeros.  Caller holds the
+        record lock — this IS the per-record step of a live reshard."""
+        import jax.numpy as jnp
+
+        arr = rec.arrays[key]
+        cur = arr.shape[axis]
+        if cur != length:
+            if length > cur:
+                widths = [(0, 0)] * arr.ndim
+                widths[axis] = (0, length - cur)
+                arr = jnp.pad(arr, widths)
+            else:
+                sl = [slice(None)] * arr.ndim
+                sl[axis] = slice(0, length)
+                arr = arr[tuple(sl)]
+            rec.arrays[key] = arr
+        return self.ensure_state(rec, key, spec, geom=geom)
+
+    def ensure_state(self, rec, key: str, spec: P,
+                     geom: Optional[Geometry] = None):
         """Lazy re-shard: a restored/replicated record carries its plane on
         the default device; the first sharded dispatch places it on the mesh
         (checkpoint stores layout-free host arrays on purpose)."""
         arr = rec.arrays[key]
-        want = NamedSharding(self.mesh, spec)
+        mesh = geom.mesh if geom is not None else self.mesh
+        want = NamedSharding(mesh, spec)
         sharding = getattr(arr, "sharding", None)
         if sharding != want:
             rec.arrays[key] = jax.device_put(arr, want)

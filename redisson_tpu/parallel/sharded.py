@@ -40,15 +40,26 @@ def _local_probe_gather(bits_local, tenant, idx_global, m_local):
     return jnp.where(in_range, got, 0).astype(jnp.uint8), in_range, safe
 
 
-def make_sharded_bloom_kernels(mesh: Mesh, k: int, m: int, n_tenants: int):
-    """Build (add, contains) jitted over the mesh for a (n_tenants, m) bank.
+def make_sharded_bloom_kernels(
+    mesh: Mesh, k: int, m: int, n_tenants: int, width: int = 0
+):
+    """Build (add, contains) jitted over the mesh for a (n_tenants, width)
+    plane whose HASH DOMAIN is m (probes index [0, m)).
 
-    m must divide evenly by the shard-axis size.
+    width >= m is the stored plane's column count and must divide evenly by
+    the shard-axis size; the pad columns [m, width) are never addressed, so
+    the same logical filter can re-layout onto a mesh whose shard count does
+    not divide m (live resharding, SURVEY §7.3-4 — the slot-migration analog
+    of cluster/ClusterConnectionManager.java:358-450 done as array
+    re-layout).
     """
     n_shard = mesh.shape[SHARD_AXIS]
-    if m % n_shard != 0:
-        raise ValueError(f"m={m} must be divisible by shard axis size {n_shard}")
-    m_local = m // n_shard
+    width = width or m
+    if width % n_shard != 0:
+        raise ValueError(f"width={width} must be divisible by shard axis {n_shard}")
+    if width < m:
+        raise ValueError(f"width={width} cannot be below the hash domain m={m}")
+    m_local = width // n_shard
 
     state_spec = P(None, SHARD_AXIS)
     ops_spec = P(DP_AXIS)
@@ -101,15 +112,18 @@ def make_sharded_bloom_kernels(mesh: Mesh, k: int, m: int, n_tenants: int):
     return add, contains
 
 
-def make_sharded_hll_kernels(mesh: Mesh, p: int, n_tenants: int):
-    """(T, m_regs) HLL bank with the TENANT axis sharded (each shard owns a
-    tenant range — the expert-parallel analog: counters are independent, so
-    adds route to the owning shard with no collective; estimates are local
-    reduces gathered at the end)."""
+def make_sharded_hll_kernels(mesh: Mesh, p: int, n_rows: int):
+    """(n_rows, m_regs) HLL bank with the TENANT axis sharded (each shard
+    owns a tenant range — the expert-parallel analog: counters are
+    independent, so adds route to the owning shard with no collective;
+    estimates are local reduces gathered at the end).  n_rows is the stored
+    plane's row count (logical tenants padded up to a shard multiple); pad
+    rows are never addressed, so the bank can re-layout onto a mesh with a
+    different shard count (live resharding)."""
     n_shard = mesh.shape[SHARD_AXIS]
-    if n_tenants % n_shard != 0:
-        raise ValueError(f"tenants={n_tenants} must divide by shard axis {n_shard}")
-    t_local = n_tenants // n_shard
+    if n_rows % n_shard != 0:
+        raise ValueError(f"rows={n_rows} must divide by shard axis {n_shard}")
+    t_local = n_rows // n_shard
     m = hll_ops.m_of(p)
 
     state_spec = P(SHARD_AXIS, None)
@@ -149,7 +163,7 @@ def make_sharded_hll_kernels(mesh: Mesh, p: int, n_tenants: int):
     return add, estimate
 
 
-def make_sharded_bitset_kernels(mesh: Mesh, m: int):
+def make_sharded_bitset_kernels(mesh: Mesh, m: int, width: int = 0):
     """(set, get, cardinality) for a single (m,) bit plane column-sharded
     over the `shard` axis — ONE logical RBitSet wider than any one chip's
     HBM (SURVEY.md §5.7: the one-key-one-shard constraint removed).
@@ -158,11 +172,15 @@ def make_sharded_bitset_kernels(mesh: Mesh, m: int):
     [s*m_loc, (s+1)*m_loc); set/get batches split over dp; gathers psum over
     `shard` (exactly one shard owns each index), scatters touch only owned
     indexes then pmax-combine across dp replicas; cardinality is a local
-    popcount + psum."""
+    popcount + psum.  width >= m pads the stored plane to a shard multiple
+    (pad bits stay zero; cardinality is exact) for live resharding."""
     n_shard = mesh.shape[SHARD_AXIS]
-    if m % n_shard != 0:
-        raise ValueError(f"m={m} must be divisible by shard axis size {n_shard}")
-    m_local = m // n_shard
+    width = width or m
+    if width % n_shard != 0:
+        raise ValueError(f"width={width} must be divisible by shard axis {n_shard}")
+    if width < m:
+        raise ValueError(f"width={width} cannot be below logical size m={m}")
+    m_local = width // n_shard
 
     state_spec = P(SHARD_AXIS)
     ops_spec = P(DP_AXIS)

@@ -1,0 +1,192 @@
+"""Live device-level resharding: one logical sharded plane re-laid-out
+across a CHANGED shard axis under traffic, zero lost/wrong probes
+(VERDICT r4 missing #2 / next-round item 6; SURVEY §7.3 hard-part 4).
+
+Reference analog: slot migration with a dual-routing window
+(cluster/ClusterConnectionManager.java:358-450) — here the window is
+per-record: in-flight dispatches finish on the old mesh geometry, every
+later dispatch adapts the record's plane to the new geometry under the same
+record lock (parallel/manager.py MeshManager.reshard/adapt_plane).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu.config import Config
+from redisson_tpu.parallel import mesh as M
+from redisson_tpu.parallel.manager import MeshManager
+
+
+@pytest.fixture()
+def client():
+    cfg = Config()
+    cfg.mesh.dp = 2
+    cfg.mesh.shard = 4
+    c = redisson_tpu.create(cfg)
+    yield c
+    c.shutdown()
+
+
+def _keys(rng, n):
+    return rng.integers(0, 1 << 60, n).astype(np.int64)
+
+
+def test_bloom_survives_reshard_roundtrip(client):
+    mgr = MeshManager.of(client._engine)
+    assert mgr.n_shard == 4
+    rng = np.random.default_rng(1)
+    T = 8
+    bf = client.get_sharded_bloom_filter_array("rs:bloom")
+    assert bf.try_init(T, expected_insertions=50_000, false_probability=0.01)
+    keys = _keys(rng, 512)
+    tenant = (np.arange(512) % T).astype(np.int32)
+    assert bf.add_each(tenant, keys).all()
+
+    mgr.reshard(dp=1, shard=8)
+    assert mgr.n_shard == 8
+    # every key added on the 4-shard layout must still be found on 8
+    assert bf.contains_each(tenant, keys).all()
+    # writes on the new layout work
+    keys2 = _keys(rng, 256)
+    t2 = (np.arange(256) % T).astype(np.int32)
+    assert bf.add_each(t2, keys2).all()
+    assert bf.contains_each(t2, keys2).all()
+
+    mgr.reshard(dp=2, shard=4)
+    assert bf.contains_each(tenant, keys).all()
+    assert bf.contains_each(t2, keys2).all()
+    # absent keys stay mostly absent (FP sanity, not membership corruption)
+    absent = _keys(rng, 512)
+    fp = int(bf.contains_each(tenant, absent).sum())
+    assert fp < 32
+
+
+def test_hll_estimates_identical_across_reshard(client):
+    mgr = MeshManager.of(client._engine)
+    rng = np.random.default_rng(2)
+    T = 8
+    h = client.get_sharded_hll_array("rs:hll")
+    assert h.try_init(T, p=10)
+    keys = _keys(rng, 2048)
+    tenant = (np.arange(2048) % T).astype(np.int32)
+    h.add_each(tenant, keys)
+    before = h.estimate_all()
+
+    mgr.reshard(dp=1, shard=8)
+    mid = h.estimate_all()
+    # re-layout moves registers, never changes them: estimates are EXACT
+    np.testing.assert_array_equal(before, mid)
+    h.add_each(tenant, keys)  # idempotent adds on the new layout
+    np.testing.assert_array_equal(before, h.estimate_all())
+
+    mgr.reshard(dp=2, shard=4)
+    np.testing.assert_array_equal(before, h.estimate_all())
+
+
+def test_bitset_cardinality_exact_across_reshard(client):
+    mgr = MeshManager.of(client._engine)
+    rng = np.random.default_rng(3)
+    bs = client.get_sharded_bit_set("rs:bits")
+    assert bs.try_init(1 << 20)
+    idxs = rng.integers(0, 1 << 20, 1024)
+    bs.set_each(idxs)
+    card = bs.cardinality()
+    assert card == len(np.unique(idxs))
+
+    mgr.reshard(dp=1, shard=8)
+    assert bs.get_each(idxs).all()
+    assert bs.cardinality() == card
+    bs.not_()
+    assert bs.cardinality() == (1 << 20) - card
+    bs.not_()
+
+    mgr.reshard(dp=2, shard=4)
+    assert bs.get_each(idxs).all()
+    assert bs.cardinality() == card
+
+
+def test_reshard_under_traffic_zero_lost_probes(client):
+    """The dual-routing window: a writer hammers the plane while the mesh
+    reshapes 4->8->4; every acked add must be found afterwards."""
+    mgr = MeshManager.of(client._engine)
+    rng = np.random.default_rng(4)
+    T = 8
+    bf = client.get_sharded_bloom_filter_array("rs:traffic")
+    assert bf.try_init(T, expected_insertions=200_000, false_probability=0.01)
+
+    added = []
+    errors = []
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        try:
+            while not stop.is_set() and i < 60:
+                keys = _keys(rng, 128)
+                tenant = (np.arange(128) % T).astype(np.int32)
+                bf.add_each(tenant, keys)
+                added.append((tenant, keys))  # acked only after add returns
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def wait_batches(n, timeout=120):
+        import time
+
+        deadline = time.time() + timeout
+        while len(added) < n and th.is_alive() and time.time() < deadline:
+            time.sleep(0.02)
+
+    th = threading.Thread(target=writer)
+    th.start()
+    try:
+        # reshard mid-stream in both directions, with acked batches on
+        # either side of each geometry change
+        wait_batches(3)
+        mgr.reshard(dp=1, shard=8)
+        wait_batches(8)
+        mgr.reshard(dp=2, shard=4)
+        wait_batches(12)
+    finally:
+        stop.set()
+        th.join(timeout=120)
+    assert not errors, errors
+    assert len(added) >= 12
+    for tenant, keys in added:
+        got = bf.contains_each(tenant, keys)
+        assert got.all(), f"lost probes after reshard: {int((~got).sum())}"
+
+
+def test_reshard_validates_geometry(client):
+    mgr = MeshManager.of(client._engine)
+    with pytest.raises(ValueError):
+        mgr.reshard(dp=5, shard=2)  # 10 devices > the 8 available
+
+
+def test_checkpoint_restores_onto_new_geometry(client, tmp_path):
+    """A checkpoint saved on shard=4 loads into a shard=8 engine (the
+    layout-free checkpoint format + adapt_plane on first dispatch)."""
+    from redisson_tpu.core import checkpoint
+
+    rng = np.random.default_rng(5)
+    T = 8
+    bf = client.get_sharded_bloom_filter_array("rs:ckpt")
+    assert bf.try_init(T, expected_insertions=50_000, false_probability=0.01)
+    keys = _keys(rng, 256)
+    tenant = (np.arange(256) % T).astype(np.int32)
+    bf.add_each(tenant, keys)
+    path = str(tmp_path / "rs.ckp")
+    assert checkpoint.save(client._engine, path) >= 1
+
+    cfg = Config()
+    cfg.mesh.dp = 1
+    cfg.mesh.shard = 8
+    fresh = redisson_tpu.create(cfg)
+    try:
+        assert checkpoint.load(fresh._engine, path) >= 1
+        bf2 = fresh.get_sharded_bloom_filter_array("rs:ckpt")
+        assert bf2.contains_each(tenant, keys).all()
+    finally:
+        fresh.shutdown()

@@ -72,7 +72,7 @@ def test_sharded_bloom_wrong_tenant_not_found(mesh):
 
 def test_sharded_hll(mesh):
     T, p = 8, hll_ops.DEFAULT_P
-    add, estimate = make_sharded_hll_kernels(mesh, p=p, n_tenants=T)
+    add, estimate = make_sharded_hll_kernels(mesh, p=p, n_rows=T)
     regs = jax.device_put(
         jnp.zeros((T, hll_ops.m_of(p)), jnp.uint8), jax.NamedSharding(mesh, jax.P("shard", None))
     )
