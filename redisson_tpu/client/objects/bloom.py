@@ -178,7 +178,7 @@ class BloomFilter(RExpirable):
         BloomFilterArray.contains_async).  For integer-key batches the result
         is a device uint32 bitmap (decode with kernels.unpack_found); for
         codec-encoded keys it is a device bool array."""
-        kind, arrays, n = self._engine.pack_keys(objs, self._codec)
+        kind, arrays, n = self._engine.pack_keys(objs, self._codec, cache_hot=True)
         if n == 0:
             return np.zeros((0,), np.uint32), 0
         # Dispatch under the record lock: a concurrent add() donates the bit

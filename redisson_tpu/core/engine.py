@@ -343,7 +343,8 @@ class Engine:
             return True
         return False
 
-    def pack_keys(self, objs, codec: Optional[Codec]) -> Tuple[str, tuple, int]:
+    def pack_keys(self, objs, codec: Optional[Codec],
+                  cache_hot: bool = False) -> Tuple[str, tuple, int]:
         """Normalize a key batch for the hash kernels.
 
         Returns (kind, padded_arrays, n_valid):
@@ -362,8 +363,17 @@ class Engine:
             arr = np.ascontiguousarray(objs, dtype=np.int64)
             n = arr.shape[0]
             b = K.bucket_size(max(1, n))
-            lo, hi = H.int_keys_to_u32_pair(arr)
-            return "u64", K.pack_rows(lo, hi, size=b), n
+
+            def build():
+                lo, hi = H.int_keys_to_u32_pair(arr)
+                return K.pack_rows(lo, hi, size=b)
+
+            if cache_hot and n >= 4096:
+                # hot-set reuse, READ paths only (kernels.cached_staged): a
+                # serving loop re-probing the same working set skips the
+                # pack and the h2d upload entirely
+                return "u64", K.cached_staged(build, arr, extra=b"u64%d" % b), n
+            return "u64", build(), n
         if isinstance(objs, (bytes, str, int, float)) or not isinstance(objs, (list, tuple, np.ndarray)):
             objs = [objs]
         encoded = [o if isinstance(o, bytes) else codec.encode(o) for o in objs]

@@ -197,6 +197,66 @@ bloom_bank_contains_u64 = jax.jit(_bloom_bank_contains_body, static_argnums=(5, 
 # their unpacked forms, they only change the wire layout.
 
 
+# -- hot-query staged-buffer cache -------------------------------------------
+# A latency-sensitive serving loop re-probes the same hot working set (the
+# bench's own "hot-set serving pattern"); re-uploading an identical query
+# buffer pays the tunnel's h2d cost — 25-55ms on a degraded session — every
+# flush.  Content addressing (blake2b over the raw operand bytes, ~1ms/MB)
+# makes the reuse EXACT: any mutation of the caller's arrays changes the
+# digest, so this is never identity-cache guesswork.  Entries hold staged
+# DEVICE buffers; kernels never donate their query operand, so a cached
+# buffer survives any number of dispatches.
+import hashlib as _hashlib
+
+_QCACHE: "_OrderedDict[bytes, object]" = _OrderedDict()
+_QCACHE_SLOTS = 8
+_QCACHE_MAX_BYTES = 8 << 20  # don't pin giant one-off uploads in HBM
+_QCACHE_LOCK = _threading.Lock()
+
+
+def query_digest(*arrays, extra: bytes = b"") -> bytes:
+    h = _hashlib.blake2b(digest_size=16)
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(memoryview(a).cast("B"))
+    h.update(extra)
+    return h.digest()
+
+
+def query_cache_get(digest: bytes):
+    with _QCACHE_LOCK:
+        buf = _QCACHE.pop(digest, None)
+        if buf is not None:
+            _QCACHE[digest] = buf  # LRU refresh
+        return buf
+
+
+def query_cache_put(digest: bytes, buf) -> None:
+    nbytes = getattr(buf, "nbytes", _QCACHE_MAX_BYTES + 1)
+    if nbytes > _QCACHE_MAX_BYTES:
+        return
+    with _QCACHE_LOCK:
+        _QCACHE[digest] = buf
+        while len(_QCACHE) > _QCACHE_SLOTS:
+            _QCACHE.popitem(last=False)
+
+
+def cached_staged(build, *digest_arrays, extra: bytes = b""):
+    """THE one expression of the hot-query policy: content-digest the raw
+    operands, reuse the staged device buffer on a hit, else build+stage+
+    cache.  `build()` runs only on a miss, so hits skip the pack AND the
+    h2d upload.  Callers gate this to READ paths — caching one-shot write
+    flushes would evict the hot working set for zero hits."""
+    digest = query_digest(*digest_arrays, extra=extra)
+    buf = query_cache_get(digest)
+    if buf is None:
+        buf = build()
+        query_cache_put(digest, buf)
+    return buf
+
+
 def stage(arr):
     """Asynchronous host->device staging for kernel operands.
 
@@ -508,6 +568,52 @@ def wc_extract_words(buf, end_deltas, n_words, base):
     return ha, hb, start
 
 
+def _wc_hash_prelude(buf):
+    n = buf.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    ws = buf == 32
+    last_ws = jax.lax.cummax(jnp.where(ws, idx, jnp.int32(-1)))
+    pos = idx - last_ws - 1
+    cap = jnp.minimum(pos, _WC_POW - 1)
+    b1 = buf.astype(jnp.uint32) + 1
+    ca = jnp.where(ws, jnp.uint32(0), b1 * jnp.asarray(_WC_POW_A)[cap])
+    cb = jnp.where(ws, jnp.uint32(0), b1 * jnp.asarray(_WC_POW_B)[cap])
+    return ws, idx, last_ws, jnp.cumsum(ca), jnp.cumsum(cb)
+
+
+def _wc_gather_words(cum_a, cum_b, last_ws, e, valid, base, n):
+    lw = last_ws[e]
+    ha = cum_a[e] - jnp.where(lw >= 0, cum_a[jnp.maximum(lw, 0)], 0)
+    hb = cum_b[e] - jnp.where(lw >= 0, cum_b[jnp.maximum(lw, 0)], 0)
+    ln = (e - lw).astype(jnp.uint32)
+    ha = ha ^ (ln * jnp.uint32(2654435761))
+    hb = hb + (ln * jnp.uint32(0x9E3779B9))
+    sentinel = jnp.uint32(0xFFFFFFFF)
+    ha = jnp.where(valid, ha, sentinel)
+    hb = jnp.where(valid, hb, sentinel)
+    start = jnp.where(valid, (lw + 1).astype(jnp.uint32) + base, sentinel)
+    return ha, hb, start
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def wc_extract_words_auto(buf, n_words, eb: int, base):
+    """wc_extract_words with DEVICE-side word-end discovery: the host ships
+    only the text bytes + a word count; end positions come from a mask +
+    sort compaction in HBM.  Kills the (E,) delta upload entirely — ~16MB
+    per 1M-doc scan on a path where upload bandwidth is the binding cost —
+    and the host's delta-encode pass with it.  eb is the static output
+    bucket (>= n_words)."""
+    n = buf.shape[0]
+    ws, idx, last_ws, cum_a, cum_b = _wc_hash_prelude(buf)
+    # word end = non-ws byte followed by ws (buf is ws-padded, so the final
+    # word's end is always visible)
+    end_mask = (~ws) & jnp.concatenate([ws[1:], jnp.ones((1,), bool)])
+    ends = jnp.sort(jnp.where(end_mask, idx, jnp.int32(0x7FFFFFFF)))[:eb]
+    valid = jnp.arange(eb, dtype=jnp.int32) < n_words
+    e = jnp.where(valid, jnp.minimum(ends, n - 1), 0)
+    return _wc_gather_words(cum_a, cum_b, last_ws, e, valid, base, n)
+
+
 @functools.partial(jax.jit, static_argnums=(3,))
 def wc_sort_runs(ha, hb, start, d_max: int):
     """Count words by sorting.  (ha, hb) 64-bit keys sort lexicographically;
@@ -524,4 +630,9 @@ def wc_sort_runs(ha, hb, start, d_max: int):
     BIG = jnp.int32(0x7FFFFFFF)
     fp = jnp.where(first, idx, BIG)
     c_fp, c_off = jax.lax.sort((fp, sh_off), num_keys=1)
-    return c_fp[:d_max], c_off[:d_max]
+    # ONE (2, d_max) result instead of two arrays: the reduce fetches it in
+    # a single d2h round trip (each sync costs a fixed ~66ms on the tunnel;
+    # uint32 offsets travel bit-exact through the int32 bitcast)
+    return jnp.stack(
+        [c_fp[:d_max], jax.lax.bitcast_convert_type(c_off[:d_max], jnp.int32)]
+    )

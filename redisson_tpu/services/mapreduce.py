@@ -408,6 +408,35 @@ _WS_TRANSLATE = bytes.maketrans(b"\t\n\x0b\x0c\r\x1c\x1d\x1e\x1f", b" " * 9)
 # any whitespace OUTSIDE that ASCII set (NBSP, ideographic space, \x85, ...)
 _UNICODE_WS_RE = re.compile(r"[^\S \t\n\x0b\x0c\r\x1c\x1d\x1e\x1f]")
 
+# gc.disable() is a process-wide toggle: a depth counter makes the pause
+# reentrant across overlapping scans (one scan finishing must not re-enable
+# collection under another still running)
+_gc_guard = threading.Lock()
+_gc_depth = 0
+_gc_was_enabled = False
+
+
+class _gc_paused:
+    def __enter__(self):
+        import gc
+
+        global _gc_depth, _gc_was_enabled
+        with _gc_guard:
+            if _gc_depth == 0:
+                _gc_was_enabled = gc.isenabled()
+                gc.disable()
+            _gc_depth += 1
+
+    def __exit__(self, *exc):
+        import gc
+
+        global _gc_depth
+        with _gc_guard:
+            _gc_depth -= 1
+            if _gc_depth == 0 and _gc_was_enabled:
+                gc.enable()
+        return False
+
 
 def _host_word_count(vals: List[str]) -> Dict[str, int]:
     """Single-pass C-speed fallback: per-value split + Counter.update (both
@@ -503,29 +532,71 @@ def _wc_tokenize(vals: List[str], n_chunks: int, key=None) -> Optional[_WcScanVi
         b = K.bucket_size(len(big))
         buf = np.full(b, 32, np.uint8)
         buf[: len(big)] = np.frombuffer(big, np.uint8)
+        # the host counts words (one vectorized pass) but ships ONLY the
+        # text: end positions are rediscovered on device by
+        # wc_extract_words_auto, killing the former (E,) u16 delta upload
+        # (~16MB per 1M-doc scan) on the upload-bound tunnel path
         ws = buf == 32
-        ends = np.flatnonzero(~ws[:-1] & ws[1:])
-        deltas = np.diff(ends + 1, prepend=0)
-        if len(deltas) and deltas.max() >= 65536:
-            # a >=64KB whitespace run or token: delta encoding can't carry
-            # it; this shape is pathological for the kernel anyway
-            return None
-        eb = K.bucket_size(max(1, len(ends)))
-        deltas_p = np.zeros(eb, np.uint16)
-        deltas_p[: len(ends)] = deltas.astype(np.uint16)
+        n_ends = int(np.count_nonzero(~ws[:-1] & ws[1:]))
+        eb = K.bucket_size(max(1, n_ends))
         parts.append(
-            K.wc_extract_words(
-                K.stage(buf), K.stage(deltas_p), K.valid_n(len(ends)), jnp.uint32(base)
+            K.wc_extract_words_auto(
+                K.stage(buf), K.valid_n(n_ends), eb, jnp.uint32(base)
             )
         )
         blobs.append(big)
         padded.append(b)
-        nw += len(ends)
+        nw += n_ends
         base += b
     ha = jnp.concatenate([p[0] for p in parts])
     hb = jnp.concatenate([p[1] for p in parts])
     st = jnp.concatenate([p[2] for p in parts])
     return _WcScanView(key, ha, hb, st, blobs, padded, nw)
+
+
+def prewarm_word_count(
+    total_chars: int,
+    total_words: int,
+    n_chunks: int = 2,  # word_count's device path always scans in 2 chunks
+    d_max_bits: int = None,
+) -> None:
+    """Load (or compile) the word-count device programs for the shape
+    buckets a corpus of ~total_chars/~total_words will use, so the first
+    real scan pays neither the XLA compile (~50s) nor the persistent-cache
+    program load (~1.6s) inside its own latency budget.
+
+    The reference keeps executor workers warm for exactly this reason
+    (executor/TasksRunnerService.java:54,192 warm pools); here "warm" means
+    the compiled programs are resident in the in-process jit cache.  Shapes
+    are pow2-bucketed, so an estimate within 2x of the real corpus lands in
+    the same bucket; a miss only wastes this call, never affects results.
+    Call at server boot / before a timed scan, off the serving path."""
+    import jax
+    import jax.numpy as jnp
+
+    from redisson_tpu.core import kernels as K
+
+    if d_max_bits is None:
+        d_max_bits = _WC_D_MAX_BITS
+    csize_chars = max(1, -(-total_chars // n_chunks))
+    b = K.bucket_size(csize_chars)
+    wper = max(1, -(-total_words // n_chunks))
+    eb = K.bucket_size(wper)
+    buf = np.full(b, 32, np.uint8)
+    buf[:4] = np.frombuffer(b"abc ", np.uint8)  # one real token
+    part = K.wc_extract_words_auto(
+        K.stage(buf), K.valid_n(1), eb, jnp.uint32(0)
+    )
+    # the sort program's shape is the CONCATENATED stream: n_chunks * eb
+    parts = [part] * n_chunks
+    ha = jnp.concatenate([p[0] for p in parts])
+    hb = jnp.concatenate([p[1] for p in parts])
+    st = jnp.concatenate([p[2] for p in parts])
+    # fetch to host too: a session's FIRST d2h costs ~5x the steady fetch
+    # (transport path setup), and a first fetch issued right after the
+    # job's 50MB token upload stalls even longer (measured: ~2s vs ~0.7s
+    # clean) — paying it here, at boot, is the cheap side of the trade.
+    np.asarray(K.wc_sort_runs(ha, hb, st, 1 << d_max_bits))
 
 
 def _wc_reduce(view: _WcScanView, d_max: int) -> Optional[Dict[str, int]]:
@@ -535,12 +606,13 @@ def _wc_reduce(view: _WcScanView, d_max: int) -> Optional[Dict[str, int]]:
 
     from redisson_tpu.core import kernels as K
 
-    fp, off = K.wc_sort_runs(view.ha, view.hb, view.st, d_max)
+    fused = K.wc_sort_runs(view.ha, view.hb, view.st, d_max)
     # drain compute BEFORE pulling results: a d2h with uploads/kernels still
     # in flight stalls for seconds on a tunneled chip (measured in bench.py)
-    jax.block_until_ready((fp, off))
-    fp = np.asarray(fp)
-    off = np.asarray(off)
+    jax.block_until_ready(fused)
+    host = np.asarray(fused)  # ONE fetch for both result rows
+    fp = host[0]
+    off = host[1].view(np.uint32)
     # padding ends carry sentinel hashes that sort AFTER every real word,
     # so positions [0, nw) of the sorted array are the real words
     nw = view.nw
@@ -643,23 +715,34 @@ def word_count(
                 return _host_word_count_blobs(view.blobs) if out is None else out
             except Exception:  # noqa: BLE001 — device gone: rebuild below
                 pass
-    vals = [str(v) for v in source_map.read_all_values()]
-    try:
-        key = None
-        if key0 is not None:
-            # revalidate after the read: a mutation racing the value read
-            # must not get its torn view cached under ANY version
-            rec2 = engine.store.get(name)
-            if rec2 is not None and (rec2.nonce, rec2.version) == key0:
-                key = key0
-        view = _wc_tokenize(vals, 2, key)
-        if view is None:
+    # pause cyclic gc for the scan: the value read + tokenize allocate
+    # millions of short-lived objects next to the map's own millions, and
+    # collection passes triggered mid-scan cost hundreds of ms of pure
+    # latency (nothing here creates cycles; gen0 pressure is the trigger)
+    with _gc_paused():
+        raw = source_map.read_all_values()
+        from redisson_tpu.client.codec import StringCodec
+
+        if isinstance(getattr(source_map, "_codec", None), StringCodec):
+            vals = raw  # StringCodec decodes to str: skip the 1M-item copy
+        else:
+            vals = [v if type(v) is str else str(v) for v in raw]
+        try:
+            key = None
+            if key0 is not None:
+                # revalidate after the read: a mutation racing the value read
+                # must not get its torn view cached under ANY version
+                rec2 = engine.store.get(name)
+                if rec2 is not None and (rec2.nonce, rec2.version) == key0:
+                    key = key0
+            view = _wc_tokenize(vals, 2, key)
+            if view is None:
+                return _host_word_count(vals)
+            out = _wc_reduce(view, 1 << _WC_D_MAX_BITS)
+            if out is None:
+                return _host_word_count(vals)
+            if cache is not None and key is not None:
+                cache.put(name, view)
+            return out
+        except Exception:  # noqa: BLE001 — device gone/edge shapes: host path
             return _host_word_count(vals)
-        out = _wc_reduce(view, 1 << _WC_D_MAX_BITS)
-        if out is None:
-            return _host_word_count(vals)
-        if cache is not None and key is not None:
-            cache.put(name, view)
-        return out
-    except Exception:  # noqa: BLE001 — device unavailable/edge shapes: host path
-        return _host_word_count(vals)
