@@ -5,6 +5,7 @@ verb family, shared preludes in verbs/common.py so numkeys/syntax validation
 cannot diverge between families again.
 """
 
+import threading
 import time
 
 from redisson_tpu.net.resp import RespError
@@ -304,6 +305,61 @@ def cmd_save(server, ctx, args):
     from redisson_tpu.core import checkpoint
 
     checkpoint.save(server.engine, path)
+    return "+OK"
+
+
+@register("BGSAVE")
+def cmd_bgsave(server, ctx, args):
+    """Checkpoint in the background (the RDB BGSAVE role); LASTSAVE reports
+    the completion time of the most recent one."""
+    path = _s(args[0]) if args else server.checkpoint_path
+    if path is None:
+        raise RespError("ERR no checkpoint path configured")
+    from redisson_tpu.core import checkpoint
+
+    def run():
+        try:
+            checkpoint.save(server.engine, path)
+            server.__dict__["_lastsave"] = int(time.time())
+        except Exception:  # noqa: BLE001 — background save: best-effort
+            pass
+
+    threading.Thread(target=run, daemon=True, name="rtpu-bgsave").start()
+    return "+Background saving started"
+
+
+@register("BGREWRITEAOF")
+def cmd_bgrewriteaof(server, ctx, args):
+    """No AOF exists: durability is checkpoint + replication, so the rewrite
+    request degrades to a background checkpoint (documented in PARITY.md)."""
+    cmd_bgsave(server, ctx, args)
+    return "+Background append only file rewriting started"
+
+
+@register("LASTSAVE")
+def cmd_lastsave(server, ctx, args):
+    return int(server.__dict__.get("_lastsave", 0))
+
+
+@register("SHUTDOWN")
+def cmd_shutdown(server, ctx, args):
+    """SHUTDOWN [NOSAVE|SAVE]: optionally checkpoint, then stop the server.
+    Like Redis, a successful shutdown never delivers a reply — the
+    connection just dies; the stop runs on a side thread so this handler's
+    worker can finish its frame."""
+    mode = bytes(args[0]).upper() if args else b""
+    if mode == b"SAVE" and not server.checkpoint_path:
+        raise RespError("ERR no checkpoint path configured")
+    if mode == b"SAVE" or (mode != b"NOSAVE" and server.checkpoint_path):
+        from redisson_tpu.core import checkpoint
+
+        try:
+            checkpoint.save(server.engine, server.checkpoint_path)
+            server.__dict__["_lastsave"] = int(time.time())
+        except Exception as e:  # noqa: BLE001 — like Redis: a failed final
+            # save ABORTS the shutdown (data would be lost silently)
+            raise RespError(f"ERR shutdown save failed, aborting: {e}")
+    threading.Thread(target=server.stop, daemon=True, name="rtpu-shutdown").start()
     return "+OK"
 
 

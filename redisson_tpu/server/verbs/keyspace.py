@@ -109,6 +109,12 @@ def cmd_flushall(server, ctx, args):
     return "+OK"
 
 
+@register("FLUSHDB")
+def cmd_flushdb(server, ctx, args):
+    # single-keyspace engine: the selected db IS the keyspace
+    return cmd_flushall(server, ctx, args)
+
+
 @register("TYPE")
 def cmd_type(server, ctx, args):
     rec = server.engine.store.get(_s(args[0]))
@@ -207,6 +213,14 @@ def cmd_hset(server, ctx, args):
             if m.fast_put(bytes(args[i]), bytes(args[i + 1])):
                 n += 1
     return n
+
+
+@register("HMSET")
+def cmd_hmset(server, ctx, args):
+    """Deprecated Redis alias of HSET that replies +OK (the reference's
+    RedisCommands.HMSET row)."""
+    cmd_hset(server, ctx, args)
+    return "+OK"
 
 
 @register("HGET")

@@ -505,6 +505,52 @@ def cmd_ft_aliasdel(server, ctx, args):
     return "+OK"
 
 
+@register("FT.SYNUPDATE")
+@_ft_cmd
+def cmd_ft_synupdate(server, ctx, args):
+    """FT.SYNUPDATE idx group_id [SKIPINITIALSCAN] term... — terms join the
+    synonym group; query-time TEXT matching expands through groups
+    (services/search.py SearchIndex.syn_update)."""
+    idx = _ft(server)._idx(_s(args[0]))
+    group = _s(args[1])
+    terms = [_s(a) for a in args[2:]]
+    if terms and terms[0].upper() == "SKIPINITIALSCAN":
+        terms = terms[1:]  # groups apply query-side: no rescan either way
+    if not terms:
+        raise RespError("ERR FT.SYNUPDATE needs at least one term")
+    idx.syn_update(group, terms)
+    return "+OK"
+
+
+@register("FT.SYNDUMP")
+@_ft_cmd
+def cmd_ft_syndump(server, ctx, args):
+    """FT.SYNDUMP idx -> flat [term, [group...], ...] (RediSearch shape)."""
+    idx = _ft(server)._idx(_s(args[0]))
+    out = []
+    for term, groups in sorted(idx.syn_dump().items()):
+        out.append(term.encode())
+        out.append([g.encode() for g in groups])
+    return out
+
+
+@register("FT.CONFIG")
+def cmd_ft_config(server, ctx, args):
+    """FT.CONFIG GET|SET option [value] — a real settings map (per-server),
+    accepted for driver compatibility; options do not alter the engine's
+    search behavior and say so in FT.INFO-style introspection."""
+    sub = bytes(args[0]).upper() if args else b""
+    cfg = server.__dict__.setdefault("_ft_config", {"MAXEXPANSIONS": "200"})
+    if sub == b"SET" and len(args) >= 3:
+        cfg[_s(args[1]).upper()] = _s(args[2])
+        return "+OK"
+    if sub == b"GET" and len(args) >= 2:
+        pat = _s(args[1]).upper()
+        items = cfg.items() if pat == "*" else [(pat, cfg.get(pat))]
+        return [[k.encode(), (v or "").encode()] for k, v in items if v is not None]
+    raise RespError("ERR FT.CONFIG GET|SET option [value]")
+
+
 @register("FT.DICTADD")
 @_ft_cmd
 def cmd_ft_dictadd(server, ctx, args):

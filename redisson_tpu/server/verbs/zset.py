@@ -508,6 +508,24 @@ def cmd_zunion(server, ctx, args):
     return _zcombo_read(server, ctx, args, "union")
 
 
+@register("ZINTERCARD")
+def cmd_zintercard(server, ctx, args):
+    """ZINTERCARD numkeys key... [LIMIT n] — intersection cardinality
+    without materializing the member list on the wire."""
+    _n, names, i = _znumkeys(server, args, 0)
+    limit = 0
+    if i < len(args):
+        if bytes(args[i]).upper() != b"LIMIT" or i + 1 >= len(args):
+            raise RespError("ERR syntax error")
+        limit = _int(args[i + 1])
+        if limit < 0:
+            raise RespError("ERR LIMIT can't be negative")
+    with server.engine.locked_many(names):
+        acc = _zcombine(server, names, "inter")
+    card = len(acc)
+    return min(card, limit) if limit else card
+
+
 @register("ZDIFFSTORE")
 def cmd_zdiffstore(server, ctx, args):
     dest = _s(args[0])

@@ -212,7 +212,26 @@ class SearchIndex:
             [f for f, t in schema.items() if t == FieldType.NUMERIC]
         )
         self._synced_versions: Dict[str, int] = {}          # map name -> version
+        # synonym groups (FT.SYNUPDATE/SYNDUMP): group id -> lowercase terms,
+        # and the reverse map consulted at query time
+        self.synonyms: Dict[str, set] = {}
+        self._syn_of: Dict[str, set] = {}
         self._lock = threading.RLock()
+
+    # -- synonyms (RediSearch FT.SYNUPDATE / FT.SYNDUMP) ---------------------
+
+    def syn_update(self, group_id: str, terms: Sequence[str]) -> None:
+        with self._lock:
+            g = self.synonyms.setdefault(group_id, set())
+            for t in terms:
+                t = str(t).lower()
+                g.add(t)
+                self._syn_of.setdefault(t, set()).add(group_id)
+
+    def syn_dump(self) -> Dict[str, List[str]]:
+        """term -> sorted group ids (the FT.SYNDUMP reply shape)."""
+        with self._lock:
+            return {t: sorted(gs) for t, gs in self._syn_of.items()}
 
     # -- document maintenance ------------------------------------------------
 
@@ -282,7 +301,16 @@ class SearchIndex:
         if isinstance(cond, Text):
             words = tokenize(cond.query)
             plane = self._text.get(cond.field, {})
-            sets = [plane.get(w, set()) for w in words]
+            sets = []
+            for w in words:
+                ids = set(plane.get(w, set()))
+                # synonym expansion (FT.SYNUPDATE groups): a query term
+                # matches docs containing ANY member of its groups —
+                # RediSearch semantics, index-time groups applied query-side
+                for g in self._syn_of.get(w, ()):
+                    for w2 in self.synonyms.get(g, ()):
+                        ids |= plane.get(w2, set())
+                sets.append(ids)
             return set.intersection(*sets) if sets else set()
         if isinstance(cond, Eq):
             ftype = self.schema.get(cond.field)

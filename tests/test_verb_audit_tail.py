@@ -1,0 +1,127 @@
+"""The verb-audit tail (VERDICT r4 next-step #9): the last reference verbs
+tools/verb_audit.py flagged, now served — FLUSHDB, HMSET, ZINTERCARD,
+BGSAVE/BGREWRITEAOF/LASTSAVE, SHUTDOWN, FT.CONFIG, FT.SYNUPDATE/SYNDUMP.
+
+Parity seams: client/protocol/RedisCommands.java rows of the same names.
+"""
+import time
+
+import pytest
+
+from redisson_tpu.harness import _exec, free_port
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture()
+def st(tmp_path):
+    t = ServerThread(port=free_port(), checkpoint_path=str(tmp_path / "ck.bin")).start()
+    yield t
+    t.stop()
+
+
+def test_flushdb_is_flushall(st):
+    with st.client() as c:
+        _exec(c, "SET", "a", "1")
+        assert _exec(c, "FLUSHDB") in ("OK", b"OK", "+OK")
+        assert _exec(c, "GET", "a") is None
+
+
+def test_hmset_replies_ok(st):
+    with st.client() as c:
+        assert _exec(c, "HMSET", "h", "f1", "v1", "f2", "v2") in ("OK", b"OK", "+OK")
+        assert _exec(c, "HGET", "h", "f1") == b"v1"
+        assert _exec(c, "HLEN", "h") == 2
+
+
+def test_zintercard(st):
+    with st.client() as c:
+        _exec(c, "ZADD", "za", 1, "a", 2, "b", 3, "c")
+        _exec(c, "ZADD", "zb", 1, "b", 2, "c", 3, "d")
+        assert _exec(c, "ZINTERCARD", 2, "za", "zb") == 2
+        assert _exec(c, "ZINTERCARD", 2, "za", "zb", "LIMIT", 1) == 1
+        assert _exec(c, "ZINTERCARD", 2, "za", "missing") == 0
+        with pytest.raises(RespError):
+            _exec(c, "ZINTERCARD", 2, "za", "zb", "LIMIT")
+
+
+def test_bgsave_and_lastsave(st, tmp_path):
+    with st.client() as c:
+        _exec(c, "SET", "k", "v")
+        assert _exec(c, "LASTSAVE") == 0
+        out = _exec(c, "BGSAVE")
+        assert b"Background" in (out if isinstance(out, bytes) else str(out).encode())
+        deadline = time.time() + 10
+        while _exec(c, "LASTSAVE") == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert _exec(c, "LASTSAVE") > 0
+        assert (tmp_path / "ck.bin").exists()
+
+
+def test_bgrewriteaof_degrades_to_checkpoint(st, tmp_path):
+    with st.client() as c:
+        _exec(c, "SET", "k", "v")
+        out = _exec(c, "BGREWRITEAOF")
+        assert b"rewriting" in (out if isinstance(out, bytes) else str(out).encode())
+        deadline = time.time() + 10
+        while not (tmp_path / "ck.bin").exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert (tmp_path / "ck.bin").exists()
+
+
+def test_shutdown_saves_and_stops(tmp_path):
+    st = ServerThread(
+        port=free_port(), checkpoint_path=str(tmp_path / "down.bin")
+    ).start()
+    with st.client() as c:
+        _exec(c, "SET", "k", "v")
+        try:
+            _exec(c, "SHUTDOWN")
+        except Exception:  # noqa: BLE001 — like Redis: success may never reply
+            pass
+    deadline = time.time() + 10
+    while not st.server._closing and time.time() < deadline:
+        time.sleep(0.05)
+    assert st.server._closing
+    assert (tmp_path / "down.bin").exists()
+
+
+def test_ft_config_roundtrip(st):
+    with st.client() as c:
+        assert _exec(c, "FT.CONFIG", "SET", "MINPREFIX", "3") in ("OK", b"OK", "+OK")
+        got = _exec(c, "FT.CONFIG", "GET", "MINPREFIX")
+        assert got == [[b"MINPREFIX", b"3"]]
+        all_opts = _exec(c, "FT.CONFIG", "GET", "*")
+        assert [b"MINPREFIX", b"3"] in all_opts
+
+
+def test_ft_synonyms_expand_queries(st):
+    with st.client() as c:
+        _exec(c, "FT.CREATE", "idx", "ON", "HASH", "PREFIX", 1, "car:",
+              "SCHEMA", "title", "TEXT")
+        _exec(c, "HSET", "car:1", "title", "fast automobile")
+        _exec(c, "HSET", "car:2", "title", "slow vehicle")
+        _exec(c, "FT.SYNUPDATE", "idx", "g1", "car", "automobile", "vehicle")
+        dump = _exec(c, "FT.SYNDUMP", "idx")
+        flat = {dump[i]: dump[i + 1] for i in range(0, len(dump), 2)}
+        assert flat[b"car"] == [b"g1"] and flat[b"vehicle"] == [b"g1"]
+        # querying any group member matches docs containing any other member
+        out = _exec(c, "FT.SEARCH", "idx", "@title:car")
+        assert out[0] == 2  # both docs, via synonym expansion
+        out = _exec(c, "FT.SEARCH", "idx", "@title:automobile")
+        assert out[0] == 2
+
+
+def test_verb_audit_script_reports_clean(tmp_path):
+    """The living artifact itself: zero UNEXPLAINED verbs."""
+    import subprocess
+    import sys
+
+    p = subprocess.run(
+        [sys.executable, "tools/verb_audit.py"],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "/root/repo:/root/.axon_site", "HOME": "/root"},
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "0 UNEXPLAINED" in p.stdout

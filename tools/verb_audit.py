@@ -1,0 +1,83 @@
+"""Wire-verb parity audit: reference RedisCommands.java vs our registry.
+
+Living artifact (VERDICT r4 next-step #9): run
+    python tools/verb_audit.py [--ref /root/reference]
+and paste the emitted table into PARITY.md.  The script extracts every verb
+name the reference's command table defines, diffs it against the verbs the
+server registry actually registers, and classifies the remainder against
+the N/A table below so future rounds stop re-litigating the tail.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+# verbs the reference defines that this framework deliberately does not
+# serve, with the reason — reviewed per round, not auto-generated
+NA = {
+    # JVM-codec / connection-machinery internals
+    "AUTH2": "HELLO AUTH form covers it (net/resp.py HELLO)",
+    "SENTINEL": "sentinel topology out of scope: replicated/cluster coordinators cover failover (SURVEY §7.4)",
+    "FAILOVER": "HA failover is coordinator-driven (server/monitor.py), not verb-driven",
+    "MIGRATE": "record migration rides IMPORTRECORDS/TRANSFER frames (server/migration.py)",
+    "DUMP": "object lifecycle rides core/checkpoint.py record codec (OBJCALL dump/restore)",
+    "RESTORE": "see DUMP",
+    "DEBUG": "server introspection rides INFO/METRICS",
+    "RESET_": "RESET is served (tx family)",
+    "SWAPDB": "single-keyspace engine; SELECT is accepted for db 0 only",
+    "MOVE": "single-keyspace engine",
+    "WAITAOF": "no AOF: durability is checkpoint/replication (SAVE/RESTORESTATE, REPLPUSH)",
+    "TOUCH": "LRU bookkeeping is engine-internal; EXISTS covers the client use",
+    "RANDOMKEY": "no reference caller in redisson; trivially expressible via KEYS",
+    "READONLY": "replica reads are routed client-side (client/cluster.py)",
+    "READWRITE": "see READONLY",
+    "CLUSTER_NODES": "CLUSTER subcommands are served via the CLUSTER verb",
+    "LPOS": "RList.indexOf rides OBJCALL indexOf (no wire caller in reference either)",
+    "OBJECT": "encoding introspection is meaningless for device-resident records",
+    "LOLWUT": "easter egg",
+}
+
+def reference_verbs(ref_root: Path) -> set:
+    src = (ref_root / "redisson/src/main/java/org/redisson/client/protocol/RedisCommands.java").read_text()
+    # new RedisCommand<...>("VERB"[, "SUB"...]) and RedisStrictCommand("VERB")
+    names = set()
+    for m in re.finditer(r'new\s+Redis\w*Command[^(]*\(\s*"([A-Z][A-Z0-9._ -]*)"(?:\s*,\s*"([A-Za-z0-9 _-]+)")?', src):
+        names.add(m.group(1))
+    return names
+
+def our_verbs() -> set:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from redisson_tpu.server.registry import REGISTRY
+    return {k.decode() for k in REGISTRY._handlers}
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ref", default="/root/reference")
+    args = ap.parse_args()
+    ref = reference_verbs(Path(args.ref))
+    ours = our_verbs()
+    missing = sorted(v for v in ref if v not in ours)
+    extra = sorted(v for v in ours if v not in ref)
+    unexplained = [v for v in missing if v.replace(" ", "_") not in NA and v not in NA]
+    print(f"reference verbs: {len(ref)}; registered here: {len(ours)}")
+    print(f"covered: {len(ref) - len(missing)}; missing: {len(missing)} "
+          f"({len(missing) - len(unexplained)} documented N/A, "
+          f"{len(unexplained)} UNEXPLAINED)")
+    print("\n## N/A (deliberate, with reasons)\n")
+    for v in missing:
+        key = v.replace(" ", "_") if v.replace(" ", "_") in NA else v
+        if key in NA:
+            print(f"| {v} | {NA[key]} |")
+    if unexplained:
+        print("\n## UNEXPLAINED (implement or document)\n")
+        for v in unexplained:
+            print(f"  {v}")
+    print(f"\n## Extra verbs (ours beyond the reference): {len(extra)}")
+    print("  " + " ".join(extra))
+    return 1 if unexplained else 0
+
+if __name__ == "__main__":
+    sys.exit(main())
