@@ -238,6 +238,32 @@ class LzmaCodec(Codec):
         return self.inner.decode(lzma.decompress(data))
 
 
+class Lz4Codec(Codec):
+    """LZ4 block compression wrapper — the reference's recommended
+    compression codec (codec/LZ4Codec.java).  Backed by the pure-python
+    block implementation in utils/lz4block.py (standard block format:
+    interoperable with any LZ4 block decoder); the frame is a 4-byte LE
+    uncompressed length + the block, matching the reference's
+    decompressor-needs-length discipline."""
+
+    name = "lz4"
+
+    def __init__(self, inner: Codec | None = None):
+        self.inner = inner or JsonCodec()
+
+    def encode(self, value):
+        from redisson_tpu.utils import lz4block
+
+        raw = self.inner.encode(value)
+        return len(raw).to_bytes(4, "little") + lz4block.compress(raw)
+
+    def decode(self, data):
+        from redisson_tpu.utils import lz4block
+
+        ulen = int.from_bytes(data[:4], "little")
+        return self.inner.decode(lz4block.decompress(data[4:], ulen))
+
+
 class ProtobufCodec(Codec):
     """Protocol-buffers codec for one message class (parity:
     codec/ProtobufCodec.java — values must be instances of `message_cls`)."""

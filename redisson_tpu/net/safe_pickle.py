@@ -54,6 +54,7 @@ _ALLOWED_GLOBALS = {
     ("redisson_tpu.client.codec", "Bz2Codec"),
     ("redisson_tpu.client.codec", "LzmaCodec"),
     ("redisson_tpu.client.codec", "CborCodec"),
+    ("redisson_tpu.client.codec", "Lz4Codec"),
     # reference support: handle codecs are ReferenceCodec-wrapped, and
     # handles themselves pickle as inert ObjectRef descriptors
     ("redisson_tpu.client.codec", "ReferenceCodec"),

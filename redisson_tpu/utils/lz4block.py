@@ -1,0 +1,134 @@
+"""Pure-python LZ4 *block* format (compress + decompress).
+
+Parity: the reference ships LZ4Codec (codec/LZ4Codec.java, backed by
+lz4-java's block codec) as its recommended compression wrapper.  No lz4
+native library is available here, so this is an original implementation of
+the published block format (token nibbles, 255-run extended lengths,
+little-endian 2-byte match offsets, literals-only final sequence, the
+12/5-byte end-of-block match rules) — interoperable with any standard LZ4
+block decoder at the byte level.
+
+Throughput is python-speed (~5-20MB/s compress): the codec exists for wire
+compatibility and storage-ratio parity, not as the fast path — bulk device
+state never routes through user codecs (core/checkpoint.py has its own
+record codec).
+"""
+from __future__ import annotations
+
+_MIN_MATCH = 4
+_LAST_LITERALS = 5   # spec: the last 5 bytes are always literals
+_MATCH_GUARD = 12    # spec: no match may start within the last 12 bytes
+_MAX_OFFSET = 0xFFFF
+
+
+def compress(src: bytes) -> bytes:
+    """LZ4 block compress (greedy, 4-byte hash chaining)."""
+    n = len(src)
+    if n == 0:
+        return b"\x00"  # one empty-literal token: a valid empty block
+    out = bytearray()
+    table: dict = {}
+    anchor = 0
+    i = 0
+    limit = n - _MATCH_GUARD
+    find = int.from_bytes
+    while i < limit:
+        seq = find(src[i : i + 4], "little")
+        cand = table.get(seq)
+        table[seq] = i
+        if cand is None or i - cand > _MAX_OFFSET or src[cand : cand + 4] != src[i : i + 4]:
+            i += 1
+            continue
+        # extend the match forward (stop before the guard tail)
+        m = i + 4
+        c = cand + 4
+        end = n - _LAST_LITERALS
+        while m < end and src[m] == src[c]:
+            m += 1
+            c += 1
+        lit = src[anchor:i]
+        _emit(out, lit, i - cand, m - i)
+        anchor = i = m
+    # final literals-only sequence
+    lit = src[anchor:]
+    ll = len(lit)
+    if ll >= 15:
+        out.append(0xF0)
+        _ext(out, ll - 15)
+    else:
+        out.append(ll << 4)
+    out += lit
+    return bytes(out)
+
+
+def _ext(out: bytearray, v: int) -> None:
+    while v >= 255:
+        out.append(255)
+        v -= 255
+    out.append(v)
+
+
+def _emit(out: bytearray, lit: bytes, offset: int, mlen: int) -> None:
+    ll = len(lit)
+    ml = mlen - _MIN_MATCH
+    token = (min(ll, 15) << 4) | min(ml, 15)
+    out.append(token)
+    if ll >= 15:
+        _ext(out, ll - 15)
+    out += lit
+    out += offset.to_bytes(2, "little")
+    if ml >= 15:
+        _ext(out, ml - 15)
+
+
+def decompress(src: bytes, expected_size: int) -> bytes:
+    """LZ4 block decompress; raises ValueError on malformed input or a size
+    mismatch (the codec frame carries the uncompressed length)."""
+    out = bytearray()
+    i = 0
+    n = len(src)
+    try:
+        while i < n:
+            token = src[i]
+            i += 1
+            ll = token >> 4
+            if ll == 15:
+                while True:
+                    b = src[i]
+                    i += 1
+                    ll += b
+                    if b != 255:
+                        break
+            if i + ll > n:
+                raise ValueError("truncated literals")
+            out += src[i : i + ll]
+            i += ll
+            if i >= n:
+                break  # final sequence has no match part
+            offset = int.from_bytes(src[i : i + 2], "little")
+            i += 2
+            if offset == 0 or offset > len(out):
+                raise ValueError(f"bad match offset {offset}")
+            ml = token & 0xF
+            if ml == 15:
+                while True:
+                    b = src[i]
+                    i += 1
+                    ml += b
+                    if b != 255:
+                        break
+            ml += _MIN_MATCH
+            start = len(out) - offset
+            if offset >= ml:
+                out += out[start : start + ml]
+            else:
+                # overlapping copy (RLE-style): byte-at-a-time semantics
+                for k in range(ml):
+                    out.append(out[start + k])
+    except IndexError:
+        raise ValueError("truncated LZ4 block") from None
+    if len(out) != expected_size:
+        raise ValueError(
+            f"LZ4 size mismatch: got {len(out)}, expected {expected_size}"
+        )
+    return bytes(out)

@@ -1,0 +1,102 @@
+"""Pure-python LZ4 block codec (utils/lz4block.py + client Lz4Codec) —
+VERDICT r4 missing #4 / next-step #10; parity: codec/LZ4Codec.java.
+"""
+import os
+import random
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.codec import JsonCodec, Lz4Codec, StringCodec
+from redisson_tpu.utils import lz4block
+
+
+def rt(data: bytes) -> bytes:
+    return lz4block.decompress(lz4block.compress(data), len(data))
+
+
+@pytest.mark.parametrize("data", [
+    b"",
+    b"a",
+    b"short",
+    b"aaaaaaaaaaaa",                       # 12 bytes: under the match guard
+    b"a" * 1000,                           # RLE (overlapping matches)
+    b"abcd" * 500,                         # short-period repetition
+    b"the quick brown fox " * 100,
+    bytes(range(256)) * 64,                # long period
+    b"x" * 14 + b"y",                      # literal run crossing the 15 nibble
+    b"ab" * 7 + b"unique-tail-bytes!",
+])
+def test_roundtrip(data):
+    assert rt(data) == data
+
+
+def test_roundtrip_random_and_mixed():
+    rng = random.Random(7)
+    for n in (13, 100, 4096, 70_000):
+        incompressible = bytes(rng.getrandbits(8) for _ in range(n))
+        assert rt(incompressible) == incompressible
+        mixed = incompressible[: n // 2] + b"Z" * (n // 2)
+        assert rt(mixed) == mixed
+
+
+def test_compression_actually_compresses():
+    data = (b"redisson_tpu " * 1000) + os.urandom(100)
+    packed = lz4block.compress(data)
+    assert len(packed) < len(data) // 4
+
+
+def test_long_match_and_literal_extension_encoding():
+    # match length >> 15 and literal run >> 15 both take the 255-run path
+    data = os.urandom(300) + b"q" * 100_000 + os.urandom(300)
+    assert rt(data) == data
+
+
+def test_decompress_rejects_malformed():
+    data = b"hello world " * 50
+    packed = lz4block.compress(data)
+    with pytest.raises(ValueError):
+        lz4block.decompress(packed[:-3], len(data))  # truncated
+    with pytest.raises(ValueError):
+        lz4block.decompress(packed, len(data) + 1)   # size mismatch
+    with pytest.raises(ValueError):
+        lz4block.decompress(b"\x01\x41\x09\x00\xff\xff", 100)  # bad offset
+
+
+def test_format_literals_only_block():
+    # a block of pure literals: token = len<<4, no offsets — decodable by
+    # inspection against the published spec
+    data = b"0123456789"
+    packed = lz4block.compress(data)
+    assert packed[0] == len(data) << 4
+    assert packed[1:] == data
+
+
+def test_codec_wraps_and_travels():
+    c = Lz4Codec(JsonCodec())
+    v = {"k": list(range(100)), "s": "x" * 500}
+    assert c.decode(c.encode(v)) == v
+    cs = Lz4Codec(StringCodec())
+    assert cs.decode(cs.encode("hello " * 200)) == "hello " * 200
+
+
+def test_codec_on_map_over_engine():
+    client = redisson_tpu.create()
+    try:
+        m = client.get_map("lz4:m", codec=Lz4Codec())
+        m.put("a", {"payload": "z" * 10_000})
+        assert m.get("a") == {"payload": "z" * 10_000}
+    finally:
+        client.shutdown()
+
+
+def test_codec_pickles_for_objcall():
+    import pickle
+
+    from redisson_tpu.net import safe_pickle
+
+    c = Lz4Codec(JsonCodec())
+    blob = pickle.dumps(c, protocol=4)
+    c2 = safe_pickle.safe_loads(blob)
+    assert c2.decode(c.encode([1, 2, 3])) == [1, 2, 3]
