@@ -62,6 +62,51 @@ def geohash(lon: float, lat: float, precision: int = 11) -> str:
     return "".join(out)
 
 
+class GeoSearchArgs:
+    """Builder mirroring ``api/geo/GeoSearchArgs`` (the reference's modern
+    search surface): origin = point or member; shape = radius or box; plus
+    count/order.  Construct via ``from_coords``/``from_member`` and chain."""
+
+    def __init__(self):
+        self._point: Optional[Tuple[float, float]] = None
+        self._member = None
+        self._radius: Optional[Tuple[float, str]] = None
+        self._box: Optional[Tuple[float, float, str]] = None
+        self.count: Optional[int] = None
+        self.order: Optional[str] = None
+
+    @classmethod
+    def from_coords(cls, lon: float, lat: float) -> "GeoSearchArgs":
+        a = cls()
+        a._point = (float(lon), float(lat))
+        return a
+
+    @classmethod
+    def from_member(cls, member) -> "GeoSearchArgs":
+        a = cls()
+        a._member = member
+        return a
+
+    def radius(self, r: float, unit: str = "m") -> "GeoSearchArgs":
+        self._radius = (float(r), unit)
+        return self
+
+    def box(self, width: float, height: float, unit: str = "m") -> "GeoSearchArgs":
+        self._box = (float(width), float(height), unit)
+        return self
+
+    def with_count(self, n: int) -> "GeoSearchArgs":
+        self.count = int(n)
+        return self
+
+    def with_order(self, order: str) -> "GeoSearchArgs":
+        order = order.upper()
+        if order not in ("ASC", "DESC"):
+            raise ValueError("order must be ASC or DESC")
+        self.order = order
+        return self
+
+
 class Geo(RExpirable):
     _kind = "geo"
 
@@ -123,11 +168,17 @@ class Geo(RExpirable):
             return True
 
     def search_with_position(
-        self, lon: float, lat: float, radius: float, unit: str = "m",
-        count=None, order: str = "ASC",
+        self, *a, **kw
     ) -> Dict[Any, Tuple[float, float]]:
         """GEOSEARCH ... WITHCOORD (RGeo.searchWithPosition): member ->
-        (lon, lat), nearest-first."""
+        (lon, lat), nearest-first.  Accepts a GeoSearchArgs (the modern
+        surface) or legacy (lon, lat, radius[, unit, count, order])."""
+        if len(a) == 1 and isinstance(a[0], GeoSearchArgs):
+            return self.search_with_position_args(a[0])
+        lon, lat, radius = a[:3]
+        unit = a[3] if len(a) > 3 else kw.get("unit", "m")
+        count = a[4] if len(a) > 4 else kw.get("count")
+        order = a[5] if len(a) > 5 else kw.get("order", "ASC")
         members = self.search_radius(lon, lat, radius, unit=unit, count=count, order=order)
         positions = self.pos(*members)
         return {m: positions[m] for m in members if positions.get(m) is not None}
@@ -235,15 +286,93 @@ class Geo(RExpirable):
 
     def store_search_radius_to(self, dest_name: str, lon, lat, radius, unit: str = "m") -> int:
         """GEOSEARCHSTORE: store hits (as a geo set) into dest."""
-        pairs = self._search_point(lon, lat, radius * _UNITS[unit], None, "ASC")
+        return self.store_search_to(
+            dest_name, GeoSearchArgs.from_coords(lon, lat).radius(radius, unit)
+        )
+
+    # -- GeoSearchArgs surface (api/geo/GeoSearchArgs parity) ----------------
+
+    def _eval_args(self, args: GeoSearchArgs) -> List[Tuple[bytes, float]]:
+        """(encoded member, distance_m) pairs for any origin/shape combo,
+        ordered per args (nearest-first by default)."""
+        rec = self._engine.store.get(self._name)
+        if args._member is not None:
+            # a missing FROMMEMBER origin errors even on an empty key
+            # (Redis: "could not decode requested zset member")
+            p = rec.host.get(self._e(args._member)) if rec is not None else None
+            if p is None:
+                raise KeyError(
+                    f"could not decode requested zset member {args._member!r}"
+                )
+            lon, lat = p
+        else:
+            lon, lat = args._point
+        if rec is None or not rec.host:
+            return []
+        members = list(rec.host.keys())
+        pts = np.asarray([rec.host[m] for m in members], np.float64)
+        d = _haversine_m(lon, lat, pts[:, 0], pts[:, 1])
+        if args._radius is not None:
+            r, unit = args._radius
+            sel = np.nonzero(d <= r * _UNITS[unit])[0]
+        elif args._box is not None:
+            w, h, unit = args._box
+            w_m, h_m = w * _UNITS[unit] / 2, h * _UNITS[unit] / 2
+            dx = _haversine_m(lon, pts[:, 1], pts[:, 0], pts[:, 1])
+            dy = _haversine_m(lon, lat, lon, pts[:, 1])
+            sel = np.nonzero((dx <= w_m) & (dy <= h_m))[0]
+        else:
+            raise ValueError("GeoSearchArgs needs .radius() or .box()")
+        pairs = [(members[i], float(d[i])) for i in sel]
+        pairs.sort(key=lambda p: -p[1] if args.order == "DESC" else p[1])
+        if args.count is not None:
+            pairs = pairs[: args.count]
+        return pairs
+
+    def _result_unit(self, args: GeoSearchArgs) -> float:
+        shape = args._radius or args._box
+        return _UNITS[shape[-1] if shape else "m"]
+
+    def search(self, args: GeoSearchArgs) -> List:
+        """RGeo.search(GeoSearchArgs) (RedissonGeo.java search surface)."""
+        return [self._d(m) for m, _ in self._eval_args(args)]
+
+    def search_with_distance(self, args: GeoSearchArgs) -> Dict[Any, float]:
+        u = self._result_unit(args)
+        return {self._d(m): d / u for m, d in self._eval_args(args)}
+
+    def search_with_position_args(self, args: GeoSearchArgs) -> Dict[Any, Tuple[float, float]]:
+        members = self.search(args)
+        positions = self.pos(*members)
+        return {m: positions[m] for m in members if positions.get(m) is not None}
+
+    def store_search_to(self, dest_name: str, args: GeoSearchArgs) -> int:
+        """GEOSEARCHSTORE (RGeo.storeSearchTo): hits land in dest, replacing
+        it — Redis GEOSEARCHSTORE overwrites the destination key."""
+        pairs = self._eval_args(args)
         rec = self._engine.store.get(self._name)
         dest = Geo(self._engine, dest_name, self._codec)  # maps dest_name
         with self._engine.locked_many((self._name, dest._name)):
             drec = dest._rec_or_create()
+            drec.host.clear()
             for m, _ in pairs:
                 drec.host[m] = rec.host[m]
             self._touch_version(drec)
         return len(pairs)
+
+    def store_sorted_search_to(self, dest_name: str, args: GeoSearchArgs) -> int:
+        """GEOSEARCHSTORE STOREDIST analog: dest iterates nearest-first
+        (RGeo.storeSortedSearchTo; read_all order is insertion order here,
+        which _eval_args makes distance-ascending unless args order says
+        otherwise)."""
+        return self.store_search_to(dest_name, args)
+
+    def read_all(self) -> List:
+        """Every member, in stored (insertion / store-order) sequence."""
+        rec = self._engine.store.get(self._name)
+        if rec is None:
+            return []
+        return [self._d(m) for m in rec.host.keys()]
 
     def size(self) -> int:
         rec = self._engine.store.get(self._name)

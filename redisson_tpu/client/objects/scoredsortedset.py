@@ -449,6 +449,77 @@ class ScoredSortedSet(RExpirable):
             rec = self._rec_or_create()
             return [self._d(m) for _, m in self._index_of(rec)]
 
+    # -- RSortable (readSort/sortTo — the Redis SORT surface) ----------------
+
+    def _bucket_value(self, pattern: str, member_str: str):
+        from redisson_tpu.client.objects.bucket import Bucket
+
+        if pattern == "#":
+            return member_str
+        return Bucket(
+            self._engine, pattern.replace("*", member_str, 1), self._codec
+        ).get()
+
+    def _sorted_members(self, order: str, by_pattern: Optional[str], alpha: bool):
+        members = self.read_all()
+        if by_pattern is not None:
+            def key(m):
+                v = self._bucket_value(by_pattern, str(m))
+                return str(v) if alpha else float(v if v is not None else 0)
+        else:
+            key = (lambda m: str(m)) if alpha else (lambda m: float(m))
+        return sorted(members, key=key, reverse=(order.upper() == "DESC"))
+
+    def read_sort(
+        self,
+        order: str = "ASC",
+        offset: Optional[int] = None,
+        count: Optional[int] = None,
+        by_pattern: Optional[str] = None,
+        get_patterns: Optional[List[str]] = None,
+        alpha: bool = False,
+    ) -> List:
+        """RSortable.readSort (Redis SORT): sort members by themselves or a
+        BY bucket pattern; optional GET projection; LIMIT offset/count."""
+        out = self._sorted_members(order, by_pattern, alpha)
+        if offset is not None or count is not None:
+            off = offset or 0
+            out = out[off : off + count] if count is not None else out[off:]
+        if get_patterns:
+            proj = []
+            for m in out:
+                for g in get_patterns:
+                    proj.append(self._bucket_value(g, str(m)))
+            return proj
+        return out
+
+    def read_sort_alpha(self, order: str = "ASC", offset=None, count=None,
+                        by_pattern=None, get_patterns=None) -> List:
+        return self.read_sort(order, offset, count, by_pattern, get_patterns,
+                              alpha=True)
+
+    def sort_to(
+        self,
+        dest_name: str,
+        order: str = "ASC",
+        offset: Optional[int] = None,
+        count: Optional[int] = None,
+        by_pattern: Optional[str] = None,
+        get_patterns: Optional[List[str]] = None,
+        alpha: bool = False,
+    ) -> int:
+        """SORT ... STORE dest: result lands as a LIST (Redis stores sort
+        output as a list regardless of source type)."""
+        from redisson_tpu.client.objects.queue import Deque
+
+        out = self.read_sort(order, offset, count, by_pattern, get_patterns, alpha)
+        dest = Deque(self._engine, dest_name, self._codec)
+        with self._engine.locked(dest._name):
+            self._engine.store.delete(dest._name)
+            for v in out:
+                dest.add_last(v)
+        return len(out)
+
     def __len__(self):
         return self.size()
 

@@ -1,0 +1,430 @@
+"""RedissonGeoTest ported (63 @Test — VERDICT r4 next-step #2 test-depth
+campaign; the largest unported dedicated suite after zset/mapcache).
+
+Parity: RedissonGeoTest.java test-for-test against the GeoSearchArgs
+surface (api/geo/GeoSearchArgs).  Numeric deltas vs the reference's
+literals come from Redis's 52-bit geohash quantization (positions shift by
+~1e-7 deg, distances by <0.2m over 166km) — asserted with tolerances
+instead; geohash strings match on the 10 leading chars (Redis zero-pads
+the 11th from the quantized value).
+"""
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.objects.geo import GeoSearchArgs as A
+
+
+@pytest.fixture()
+def client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture()
+def geo(client):
+    return client.get_geo("test")
+
+
+PALERMO = (13.361389, 38.115556)
+CATANIA = (15.087269, 37.502669)
+
+
+def add_cities(geo):
+    assert geo.add_all({"Palermo": PALERMO, "Catania": CATANIA}) == 2
+
+
+def approx_map(got, want, rel=1e-3):
+    assert set(got) == set(want)
+    for k, v in want.items():
+        assert got[k] == pytest.approx(v, rel=rel), k
+
+
+def test_add(geo):
+    assert geo.add(2.51, 3.12, "city1") == 1
+
+
+def test_add_if_exists(geo):
+    assert geo.add(2.51, 3.12, "city1") == 1
+    assert geo.add_if_exists(2.9, 3.9, "city1") is True
+    pos = geo.pos("city1")
+    assert 3.8 <= pos["city1"][1] <= 3.9
+    assert 2.8 <= pos["city1"][0] <= 3.0
+    assert geo.add_if_exists(2.12, 3.5, "city2") is False
+
+
+def test_try_add(geo):
+    assert geo.add(2.51, 3.12, "city1") == 1
+    assert geo.try_add(2.5, 3.1, "city1") is False
+    assert geo.try_add(2.12, 3.5, "city2") is True
+
+
+def test_add_entries(geo):
+    assert geo.add_all({"city1": (3.11, 9.10321), "city2": (81.1231, 38.65478)}) == 2
+
+
+def test_dist(geo):
+    add_cities(geo)
+    assert geo.dist("Palermo", "Catania", "m") == pytest.approx(166274.1516, rel=1e-5)
+
+
+def test_dist_empty(geo):
+    assert geo.dist("Palermo", "Catania", "m") is None
+
+
+def test_hash(geo):
+    add_cities(geo)
+    h = geo.hash("Palermo", "Catania")
+    assert h["Palermo"][:10] == "sqc8b49rny"
+    assert h["Catania"][:10] == "sqdtr74hyu"
+
+
+def test_hash_empty(geo):
+    assert geo.hash("Palermo", "Catania") == {}
+
+
+def test_pos4(geo):
+    add_cities(geo)
+    got = geo.pos("Palermo", "Catania")
+    assert got["Palermo"] == pytest.approx(PALERMO, rel=1e-6)
+    assert got["Catania"] == pytest.approx(CATANIA, rel=1e-6)
+
+
+def test_pos1(geo):
+    geo.add(0.123, 0.893, "hi")
+    res = geo.pos("hi")
+    assert res["hi"][0] is not None and res["hi"][1] is not None
+
+
+def test_pos3(geo):
+    geo.add(0.123, 0.893, "hi")
+    res = geo.pos("hi", "123f", "sdfdsf")
+    assert set(res) == {"hi"}
+
+
+def test_pos2(geo):
+    geo.add(*PALERMO, "Palermo")
+    got = geo.pos("test2", "Palermo", "test3", "Catania", "test1")
+    assert set(got) == {"Palermo"}
+
+
+def test_pos(geo):
+    add_cities(geo)
+    got = geo.pos("test2", "Palermo", "test3", "Catania", "test1")
+    assert set(got) == {"Palermo", "Catania"}
+
+
+def test_pos_empty(geo):
+    assert geo.pos("test2", "Palermo", "test3", "Catania", "test1") == {}
+
+
+def test_box(geo):
+    add_cities(geo)
+    got = geo.search(A.from_coords(15.5, 38.5).box(5400, 5400, "km"))
+    assert set(got) == {"Palermo", "Catania"}
+
+
+def test_box_with_distance(geo):
+    add_cities(geo)
+    got = geo.search_with_distance(A.from_coords(15.5, 38.5).box(5400, 5400, "km"))
+    approx_map(got, {"Palermo": 191.4848, "Catania": 116.6784})
+
+
+def test_box_with_position(geo):
+    add_cities(geo)
+    got = geo.search_with_position(A.from_coords(15.5, 38.5).box(5400, 5400, "km"))
+    assert got["Palermo"] == pytest.approx(PALERMO, rel=1e-6)
+    assert got["Catania"] == pytest.approx(CATANIA, rel=1e-6)
+
+
+def test_box_store_search(client, geo):
+    dest = client.get_geo("test-store")
+    add_cities(geo)
+    assert geo.store_search_to("test-store", A.from_coords(15.5, 38.5).box(5400, 5400, "km")) == 2
+    assert set(dest.read_all()) == {"Palermo", "Catania"}
+
+
+def test_box_store_sorted(client, geo):
+    dest = client.get_geo("test-store")
+    add_cities(geo)
+    assert geo.store_sorted_search_to("test-store", A.from_coords(15, 37).box(5400, 5400, "km")) == 2
+    assert dest.read_all() == ["Catania", "Palermo"]
+
+
+def test_radius(geo):
+    add_cities(geo)
+    assert set(geo.search(A.from_coords(15, 37).radius(200, "km"))) == {"Palermo", "Catania"}
+
+
+def test_radius_count(geo):
+    add_cities(geo)
+    assert geo.search(A.from_coords(15, 37).radius(200, "km").with_count(1)) == ["Catania"]
+
+
+def test_radius_order(geo):
+    add_cities(geo)
+    assert geo.search(A.from_coords(15, 37).radius(200, "km").with_order("DESC")) == ["Palermo", "Catania"]
+    assert geo.search(A.from_coords(15, 37).radius(200, "km").with_order("ASC")) == ["Catania", "Palermo"]
+
+
+def test_radius_order_count(geo):
+    add_cities(geo)
+    assert geo.search(A.from_coords(15, 37).radius(200, "km").with_order("DESC").with_count(1)) == ["Palermo"]
+    assert geo.search(A.from_coords(15, 37).radius(200, "km").with_order("ASC").with_count(1)) == ["Catania"]
+
+
+def test_radius_empty(geo):
+    assert geo.search(A.from_coords(15, 37).radius(200, "km")) == []
+
+
+def test_radius_with_distance(geo):
+    add_cities(geo)
+    got = geo.search_with_distance(A.from_coords(15, 37).radius(200, "km"))
+    approx_map(got, {"Palermo": 190.4424, "Catania": 56.4413})
+
+
+def test_radius_with_distance_count(geo):
+    add_cities(geo)
+    got = geo.search_with_distance(A.from_coords(15, 37).radius(200, "km").with_count(1))
+    approx_map(got, {"Catania": 56.4413})
+
+
+def test_radius_with_distance_order(geo):
+    add_cities(geo)
+    got = geo.search_with_distance(A.from_coords(15, 37).radius(200, "km").with_order("DESC"))
+    assert list(got) == ["Palermo", "Catania"]
+    got = geo.search_with_distance(A.from_coords(15, 37).radius(200, "km").with_order("ASC"))
+    assert list(got) == ["Catania", "Palermo"]
+
+
+def test_radius_with_distance_order_count(geo):
+    add_cities(geo)
+    got = geo.search_with_distance(A.from_coords(15, 37).radius(200, "km").with_order("DESC").with_count(1))
+    approx_map(got, {"Palermo": 190.4424})
+    got = geo.search_with_distance(A.from_coords(15, 37).radius(200, "km").with_order("ASC").with_count(1))
+    approx_map(got, {"Catania": 56.4413})
+
+
+def test_radius_with_distance_huge_amount(geo):
+    for i in range(10_000):
+        geo.add(10 + 0.000001 * i, 11 + 0.000001 * i, i)
+    got = geo.search_with_distance(A.from_coords(10, 11).radius(200, "km"))
+    assert len(got) == 10_000
+
+
+def test_radius_with_position_huge_amount(geo):
+    for i in range(10_000):
+        geo.add(10 + 0.000001 * i, 11 + 0.000001 * i, i)
+    got = geo.search_with_position(A.from_coords(10, 11).radius(200, "km"))
+    assert len(got) == 10_000
+
+
+def test_radius_with_distance_big_object(geo):
+    big = "home:" + ",".join(str(i) for i in range(600))  # ~3KB member
+    geo.add(13.361389, 38.115556, big)
+    got = geo.search_with_distance(A.from_coords(15, 37).radius(200, "km"))
+    assert set(got) == {big}
+
+
+def test_radius_with_distance_empty(geo):
+    assert geo.search_with_distance(A.from_coords(15, 37).radius(200, "km")) == {}
+
+
+def test_radius_with_position(geo):
+    add_cities(geo)
+    got = geo.search_with_position(A.from_coords(15, 37).radius(200, "km"))
+    assert set(got) == {"Palermo", "Catania"}
+    assert got["Palermo"] == pytest.approx(PALERMO, rel=1e-6)
+
+
+def test_radius_with_position_count(geo):
+    add_cities(geo)
+    got = geo.search_with_position(A.from_coords(15, 37).radius(200, "km").with_count(1))
+    assert set(got) == {"Catania"}
+
+
+def test_radius_with_position_order(geo):
+    add_cities(geo)
+    got = geo.search_with_position(A.from_coords(15, 37).radius(200, "km").with_order("DESC"))
+    assert list(got) == ["Palermo", "Catania"]
+
+
+def test_radius_with_position_order_count(geo):
+    add_cities(geo)
+    got = geo.search_with_position(A.from_coords(15, 37).radius(200, "km").with_order("DESC").with_count(1))
+    assert list(got) == ["Palermo"]
+
+
+def test_radius_with_position_empty(geo):
+    assert geo.search_with_position(A.from_coords(15, 37).radius(200, "km")) == {}
+
+
+def test_radius_member(geo):
+    add_cities(geo)
+    assert set(geo.search(A.from_member("Palermo").radius(200, "km"))) == {"Palermo", "Catania"}
+
+
+def test_radius_member_count(geo):
+    add_cities(geo)
+    assert geo.search(A.from_member("Palermo").radius(200, "km").with_count(1)) == ["Palermo"]
+
+
+def test_radius_member_order(geo):
+    add_cities(geo)
+    assert geo.search(A.from_member("Palermo").radius(200, "km").with_order("DESC")) == ["Catania", "Palermo"]
+    assert geo.search(A.from_member("Palermo").radius(200, "km").with_order("ASC")) == ["Palermo", "Catania"]
+
+
+def test_radius_member_order_count(geo):
+    add_cities(geo)
+    assert geo.search(A.from_member("Palermo").radius(200, "km").with_order("DESC").with_count(1)) == ["Catania"]
+
+
+def test_radius_member_empty(geo):
+    with pytest.raises(KeyError):
+        geo.search(A.from_member("Palermo").radius(200, "km"))
+
+
+def test_radius_member_with_distance(geo):
+    add_cities(geo)
+    got = geo.search_with_distance(A.from_member("Palermo").radius(200, "km"))
+    approx_map(got, {"Palermo": 0.0, "Catania": 166.2742}, rel=1e-3)
+    assert got["Palermo"] == 0.0
+
+
+def test_radius_member_with_distance_count(geo):
+    add_cities(geo)
+    got = geo.search_with_distance(A.from_member("Palermo").radius(200, "km").with_count(1))
+    assert set(got) == {"Palermo"}
+
+
+def test_radius_member_with_distance_order(geo):
+    add_cities(geo)
+    got = geo.search_with_distance(A.from_member("Palermo").radius(200, "km").with_order("DESC"))
+    assert list(got) == ["Catania", "Palermo"]
+
+
+def test_radius_member_with_distance_order_count(geo):
+    add_cities(geo)
+    got = geo.search_with_distance(A.from_member("Palermo").radius(200, "km").with_order("DESC").with_count(1))
+    assert set(got) == {"Catania"}
+
+
+def test_radius_member_with_distance_empty(geo):
+    with pytest.raises(KeyError):
+        geo.search_with_distance(A.from_member("Palermo").radius(200, "km"))
+
+
+def test_radius_member_with_position(geo):
+    add_cities(geo)
+    got = geo.search_with_position(A.from_member("Palermo").radius(200, "km"))
+    assert set(got) == {"Palermo", "Catania"}
+
+
+def test_radius_member_with_position_count(geo):
+    add_cities(geo)
+    got = geo.search_with_position(A.from_member("Palermo").radius(200, "km").with_count(1))
+    assert set(got) == {"Palermo"}
+
+
+def test_radius_member_with_position_order(geo):
+    add_cities(geo)
+    got = geo.search_with_position(A.from_member("Palermo").radius(200, "km").with_order("DESC"))
+    assert list(got) == ["Catania", "Palermo"]
+
+
+def test_radius_member_with_position_order_count(geo):
+    add_cities(geo)
+    got = geo.search_with_position(A.from_member("Palermo").radius(200, "km").with_order("DESC").with_count(1))
+    assert list(got) == ["Catania"]
+
+
+def test_radius_member_with_position_empty(geo):
+    with pytest.raises(KeyError):
+        geo.search_with_position(A.from_member("Palermo").radius(200, "km"))
+
+
+def test_radius_store(client, geo):
+    dest = client.get_geo("test-store")
+    add_cities(geo)
+    assert geo.store_search_to("test-store", A.from_coords(15, 37).radius(200, "km")) == 2
+    assert set(dest.read_all()) == {"Palermo", "Catania"}
+
+
+def test_radius_store_sorted(client, geo):
+    dest = client.get_geo("test-store")
+    add_cities(geo)
+    assert geo.store_sorted_search_to("test-store", A.from_coords(15, 37).radius(200, "km")) == 2
+    assert dest.read_all() == ["Catania", "Palermo"]
+
+
+def test_radius_store_count(client, geo):
+    dest = client.get_geo("test-store")
+    add_cities(geo)
+    assert geo.store_search_to("test-store", A.from_coords(15, 37).radius(200, "km").with_count(1)) == 1
+    assert dest.read_all() == ["Catania"]
+
+
+def test_radius_store_sorted_count(client, geo):
+    dest = client.get_geo("test-store")
+    add_cities(geo)
+    assert geo.store_sorted_search_to("test-store", A.from_coords(15, 37).radius(200, "km").with_count(1)) == 1
+    assert dest.read_all() == ["Catania"]
+
+
+def test_radius_store_order_count(client, geo):
+    dest = client.get_geo("test-store")
+    add_cities(geo)
+    assert geo.store_search_to(
+        "test-store", A.from_coords(15, 37).radius(200, "km").with_order("DESC").with_count(1)) == 1
+    assert dest.read_all() == ["Palermo"]
+
+
+def test_radius_store_sorted_order_count(client, geo):
+    dest = client.get_geo("test-store")
+    add_cities(geo)
+    assert geo.store_sorted_search_to(
+        "test-store", A.from_coords(15, 37).radius(200, "km").with_order("DESC").with_count(1)) == 1
+    assert dest.read_all() == ["Palermo"]
+
+
+def test_radius_store_empty(client, geo):
+    dest = client.get_geo("test-store")
+    assert geo.store_search_to("test-store", A.from_coords(15, 37).radius(200, "km")) == 0
+    assert dest.read_all() == []
+
+
+def test_radius_store_member(client, geo):
+    dest = client.get_geo("test-store")
+    add_cities(geo)
+    assert geo.store_search_to("test-store", A.from_member("Palermo").radius(200, "km")) == 2
+    assert set(dest.read_all()) == {"Palermo", "Catania"}
+
+
+def test_radius_store_member_count(client, geo):
+    dest = client.get_geo("test-store")
+    add_cities(geo)
+    assert geo.store_search_to("test-store", A.from_member("Palermo").radius(200, "km").with_count(1)) == 1
+    assert dest.read_all() == ["Palermo"]
+
+
+def test_radius_store_member_order_count(client, geo):
+    dest = client.get_geo("test-store")
+    add_cities(geo)
+    assert geo.store_search_to(
+        "test-store", A.from_member("Palermo").radius(200, "km").with_order("DESC").with_count(1)) == 1
+    assert dest.read_all() == ["Catania"]
+
+
+def test_radius_store_member_empty(client, geo):
+    with pytest.raises(KeyError):
+        geo.store_search_to("test-store", A.from_member("Palermo").radius(200, "km"))
+
+
+def test_store_overwrites_destination(client, geo):
+    """GEOSEARCHSTORE replaces dest (Redis semantics), never merges."""
+    dest = client.get_geo("test-store")
+    dest.add(1.0, 1.0, "stale")
+    add_cities(geo)
+    geo.store_search_to("test-store", A.from_coords(15, 37).radius(200, "km"))
+    assert "stale" not in dest.read_all()
