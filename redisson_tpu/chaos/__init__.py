@@ -1,0 +1,36 @@
+"""Chaos subsystem: deterministic fault injection + endurance harness.
+
+The reference backs its HA claims with sustained chaos
+(``RedissonFailoverTest.java:47-152`` streams writes across repeated
+``master.stop()``; ``RedissonLockHeavyTest.java`` fans out heavy lock
+contention).  This package is that discipline made first-class:
+
+  * :mod:`redisson_tpu.chaos.faults` — a seeded, deterministic
+    :class:`FaultSchedule` compiled to a :class:`FaultPlane` that injects
+    transport faults (drop, delay, truncate-mid-reply, refuse-connect,
+    one-way partition) at the ``net/client.py`` event sites, feeding the
+    REAL failure paths (retry machinery, pool discard,
+    ``net/detectors.py`` failure detectors) instead of bypassing them.
+  * :mod:`redisson_tpu.chaos.census` — :class:`ResourceCensus`: one
+    authority for "did we leak?"  Live gauges (registerable on a
+    ``MetricsRegistry``) plus snapshot/diff, covering record locks, staged
+    replication buffers, epoch-keyed kernel-cache entries, connection
+    pools, and replication baselines.
+  * :mod:`redisson_tpu.chaos.soak` — :class:`SoakHarness`: a configurable
+    mixed workload (bloom, map, lock, bucket, pubsub) across repeated
+    master-kill → failover → reshard cycles with an error budget, asserting
+    zero acked-write loss and a flat census at every quiesce point.
+"""
+from redisson_tpu.chaos.census import ResourceCensus
+from redisson_tpu.chaos.faults import Fault, FaultPlane, FaultSchedule
+from redisson_tpu.chaos.soak import SoakConfig, SoakHarness, SoakReport
+
+__all__ = [
+    "Fault",
+    "FaultPlane",
+    "FaultSchedule",
+    "ResourceCensus",
+    "SoakConfig",
+    "SoakHarness",
+    "SoakReport",
+]

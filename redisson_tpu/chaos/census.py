@@ -1,0 +1,195 @@
+"""ResourceCensus: one authority for "did anything leak?".
+
+Leak assertions across chaos/soak runs used to be ad-hoc introspection
+(each test reaching into private dicts); the census centralizes them:
+track a source once, then ``snapshot()`` → flat ``{metric: value}`` dict,
+``diff()``/``assert_flat()`` for before/after comparisons, and
+``register()`` to expose every metric as a live gauge on a
+``utils/metrics.py`` ``MetricsRegistry`` (Prometheus text exposition
+included for free).
+
+Metrics per source kind:
+
+  engine  — ``record_locks`` (``Engine._record_locks`` registry entries:
+            must drain to 0 at quiesce — entries exist only while held or
+            waited on), ``wait_entries``, ``keys``, and — when a
+            ``MeshManager`` exists — ``kernel_cache_entries`` /
+            ``kernel_cache_stale`` (entries keyed to a PAST epoch: must
+            always be 0, reshard drops them).
+  server  — ``repl_staged_xfers`` (REPLPUSHSEG staging buffers),
+            ``connections``, and — when replication is live —
+            ``repl_baselines`` (host-side delta baselines; bounded by live
+            record count) and ``repl_replicas``.
+  client  — ``conn_in_use`` / ``conn_idle`` / ``node_clients`` summed over
+            every ``NodeClient`` pool of the facade (RemoteRedisson's one
+            node or ClusterRedisson's shard entries).
+"""
+from __future__ import annotations
+
+import fnmatch
+import threading
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+
+class ResourceCensus:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sources: Dict[str, Callable[[], Dict[str, float]]] = {}
+
+    # -- source registration -------------------------------------------------
+
+    def track(self, name: str, probe: Callable[[], Dict[str, float]]) -> None:
+        """Register/replace a named probe returning {metric: value}."""
+        with self._lock:
+            self._sources[name] = probe
+
+    def untrack(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def track_engine(self, name: str, engine) -> None:
+        # every metric is ALWAYS emitted (0 before its subsystem exists):
+        # stable key sets keep diff()/assert_flat() comparable across
+        # snapshots and let register() create every gauge up front
+        def probe() -> Dict[str, float]:
+            out = {
+                "record_locks": len(engine._record_locks),
+                "wait_entries": len(engine._wait_entries),
+                "keys": len(engine.store),
+                "kernel_cache_entries": 0,
+                "kernel_cache_stale": 0,
+            }
+            # don't force-create the MeshManager just to count its cache
+            mm = engine._services.get("mesh_manager")
+            if mm is not None:
+                with mm._guard:
+                    out["kernel_cache_entries"] = len(mm._kernels)
+                    out["kernel_cache_stale"] = sum(
+                        1 for k in mm._kernels if k[0] != mm._epoch
+                    )
+            return out
+
+        self.track(name, probe)
+
+    def track_server(self, name: str, server) -> None:
+        def probe() -> Dict[str, float]:
+            out = {
+                "repl_staged_xfers": len(getattr(server, "_repl_xfers", {})),
+                "connections": server.stats["connections"],
+                "repl_baselines": 0,
+                "repl_replicas": 0,
+            }
+            src = server._replication
+            if src is not None:
+                out["repl_baselines"] = len(src._baseline)
+                out["repl_replicas"] = len(src._replicas)
+            return out
+
+        self.track(name, probe)
+
+    def track_client(self, name: str, client) -> None:
+        def probe() -> Dict[str, float]:
+            nodes = []
+            node = getattr(client, "node", None)
+            if node is not None:
+                nodes.append(node)
+            entries = getattr(client, "entries", None)
+            if callable(entries):
+                for e in entries():
+                    nodes.append(e.master)
+                    nodes.extend(e.replicas.values())
+            return {
+                "conn_in_use": sum(n.pool.in_use for n in nodes),
+                "conn_idle": sum(n.pool.idle_count() for n in nodes),
+                "node_clients": len(nodes),
+            }
+
+        self.track(name, probe)
+
+    # -- reading -------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat ``{source.metric: value}`` over every tracked source.  A
+        broken probe contributes nothing rather than killing the census
+        (same discipline as MetricsRegistry.snapshot)."""
+        with self._lock:
+            sources = dict(self._sources)
+        out: Dict[str, float] = {}
+        for name, probe in sources.items():
+            try:
+                vals = probe()
+            except Exception:  # noqa: BLE001 — a dead source must not kill scrape
+                continue
+            for k, v in vals.items():
+                out[f"{name}.{k}"] = float(v)
+        return out
+
+    def register(self, registry, prefix: str = "census") -> None:
+        """Expose every census metric as a live gauge on a MetricsRegistry.
+        One scrape runs each source's probe ONCE: the source's gauges share
+        a short-lived memo of the probe result, so M metrics never cost M
+        probe executions (each of which takes engine/mesh locks).  Covers
+        the sources tracked at call time; re-call after tracking new
+        sources to pick them up."""
+        with self._lock:
+            sources = dict(self._sources)
+        for name, probe in sources.items():
+            try:
+                metrics = list(probe().keys())
+            except Exception:  # noqa: BLE001 — dead source registers nothing
+                continue
+            memo = {"at": 0.0, "vals": {}}
+
+            def read(metric, probe=probe, memo=memo):
+                import time
+
+                now = time.monotonic()
+                # 50ms memo: gauges of one source scraped together reuse a
+                # single probe run; staleness is irrelevant at scrape cadence
+                if now - memo["at"] > 0.05:
+                    memo["vals"] = probe()
+                    memo["at"] = now
+                return float(memo["vals"].get(metric, 0.0))
+
+            for metric in metrics:
+                registry.gauge(
+                    f"{prefix}.{name}.{metric}",
+                    lambda metric=metric, read=read: read(metric),
+                )
+
+    # -- leak assertions -----------------------------------------------------
+
+    @staticmethod
+    def diff(
+        before: Dict[str, float],
+        after: Dict[str, float],
+        ignore: Iterable[str] = (),
+    ) -> Dict[str, Tuple[float, float]]:
+        """Metrics present in both snapshots whose value moved, minus
+        `ignore` (fnmatch patterns — e.g. ``"*.keys"`` for a workload that
+        legitimately grows the keyspace)."""
+        ignore = tuple(ignore)
+        out = {}
+        for k, b in before.items():
+            if k not in after:
+                continue
+            if any(fnmatch.fnmatchcase(k, pat) for pat in ignore):
+                continue
+            a = after[k]
+            if a != b:
+                out[k] = (b, a)
+        return out
+
+    def assert_flat(
+        self,
+        before: Dict[str, float],
+        after: Dict[str, float],
+        ignore: Iterable[str] = (),
+        context: str = "",
+    ) -> None:
+        moved = self.diff(before, after, ignore)
+        if moved:
+            detail = ", ".join(f"{k}: {b} -> {a}" for k, (b, a) in sorted(moved.items()))
+            raise AssertionError(
+                f"resource census not flat{' (' + context + ')' if context else ''}: {detail}"
+            )

@@ -1,0 +1,220 @@
+"""Deterministic transport fault plane.
+
+Design constraints (ISSUE 1 tentpole):
+
+  * **Seeded and deterministic** — every random choice is drawn from
+    ``random.Random(seed)`` at schedule BUILD time (`add_random`), never at
+    injection time.  Which event indices fault is a pure function of the
+    seed; assertions count injections (`FaultPlane.injected`, per-rule
+    `Fault.hits`), never wall clocks.
+  * **Through the real layers, not around them** — the plane is consulted
+    by ``net/client.py`` ``Connection`` at its three event sites (connect,
+    send, recv) and manifests faults as the SAME exception types real
+    infrastructure produces, so ``NodeClient``'s retry machinery, pool
+    discard, ``ConnectionEventsHub`` edges, and the ``net/detectors.py``
+    failure detectors are all exercised, never bypassed:
+
+      - ``refuse_connect``  → ``ConnectionRefusedError`` before the socket
+        exists (detector ``on_connect_failed``);
+      - ``drop``            → connection closed + ``OSError`` on send
+        (detector ``on_command_failed``);
+      - ``delay``           → bounded sleep before the frame transmits;
+      - ``truncate``        → reply cut mid-frame, then the socket dies
+        (parser holds a partial frame; detector ``on_command_failed``);
+      - ``partition_out``   → frame silently never leaves (reply timeout,
+        detector ``on_command_timeout`` — a one-way partition, outbound);
+      - ``partition_in``    → reply silently never arrives (same timeout
+        path — a one-way partition, inbound).
+
+Server/coordinator-layer faults (kill / pause / restart a node, stall the
+replication stream) live on ``harness.ClusterRunner`` and
+``server/replication.ReplicationSource`` — see ``pause_node`` /
+``stall_replication`` there; ``server/monitor.HAFailoverCoordinator.kill``
+is the coordinator-crash hook.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from redisson_tpu.net import client as _net
+
+# fault kind -> the Connection event stream it rides
+_STREAM = {
+    "refuse_connect": "connect",
+    "drop": "send",
+    "delay": "send",
+    "partition_out": "send",
+    "truncate": "recv",
+    "partition_in": "recv",
+}
+
+KINDS = tuple(_STREAM)
+
+
+@dataclass
+class Fault:
+    """One injection rule: fault the matching event stream for the window
+    ``[after, after + count)``, counted per-port when ``port`` is set, else
+    over the global stream."""
+
+    kind: str
+    port: Optional[int] = None  # None matches every node
+    after: int = 0
+    count: int = 1
+    delay_s: float = 0.05  # kind == "delay" only
+    hits: int = 0          # events this rule actually faulted
+
+    def __post_init__(self):
+        if self.kind not in _STREAM:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {KINDS}")
+
+    @property
+    def stream(self) -> str:
+        return _STREAM[self.kind]
+
+
+class FaultSchedule:
+    """A seeded, deterministic fault program: an ordered rule list.
+
+    ``add`` places a rule at explicit event indices; ``add_random`` draws
+    the indices from the schedule's seeded RNG **now** (build time), so two
+    schedules built with the same seed and the same call sequence are
+    byte-identical programs."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.faults: List[Fault] = []
+
+    def add(self, kind: str, port: Optional[int] = None, after: int = 0,
+            count: int = 1, delay_s: float = 0.05) -> Fault:
+        f = Fault(kind, port=port, after=after, count=count, delay_s=delay_s)
+        self.faults.append(f)
+        return f
+
+    def add_random(self, kind: str, port: Optional[int] = None, n: int = 1,
+                   window: int = 100, delay_s: float = 0.05) -> "FaultSchedule":
+        """`n` single-event faults at seed-deterministic indices in
+        ``[0, window)`` of the matching stream."""
+        for i in sorted(self._rng.sample(range(window), min(n, window))):
+            self.add(kind, port=port, after=i, count=1, delay_s=delay_s)
+        return self
+
+    def plane(self) -> "FaultPlane":
+        return FaultPlane(self)
+
+
+class FaultPlane:
+    """The compiled injector ``net/client.py`` consults.  Thread-safe;
+    event counters live here (per stream globally + per (stream, port)),
+    so one plane serves every connection of the process."""
+
+    def __init__(self, schedule: Optional[FaultSchedule] = None,
+                 exempt_thread_prefixes: Tuple[str, ...] = (
+                     "rtpu-failover", "rtpu-ha-failover",
+                 )):
+        self.schedule = schedule or FaultSchedule()
+        # the failover coordinator's OWN probe/promotion links are exempt by
+        # default: faulting the failure detector's ground truth makes it
+        # declare healthy masters dead, and an unplanned failover of a
+        # healthy master loses its unshipped async-replication tail — a real
+        # Redis-sentinel semantic, but one that makes zero-acked-write-loss
+        # unassertable.  Chaos targets the data plane; pass () to fault the
+        # control plane too (and relax the loss assertion accordingly).
+        self.exempt_thread_prefixes = tuple(exempt_thread_prefixes)
+        self._lock = threading.Lock()
+        self._counts: Dict[tuple, int] = {}
+        self.injected: Dict[str, int] = {}  # kind -> total injections
+
+    # -- event matching ------------------------------------------------------
+
+    def _on_event(self, stream: str, port: int) -> Optional[Fault]:
+        if self.exempt_thread_prefixes and threading.current_thread().name.startswith(
+            self.exempt_thread_prefixes
+        ):
+            return None  # not counted either: exempt streams must not shift
+            # the deterministic event indices of the faulted ones
+        with self._lock:
+            n_global = self._counts.get((stream, None), 0)
+            n_port = self._counts.get((stream, port), 0)
+            self._counts[(stream, None)] = n_global + 1
+            self._counts[(stream, port)] = n_port + 1
+            for f in self.schedule.faults:
+                if f.stream != stream:
+                    continue
+                if f.port is None:
+                    n = n_global
+                elif f.port == port:
+                    n = n_port
+                else:
+                    continue
+                if f.after <= n < f.after + f.count:
+                    f.hits += 1
+                    self.injected[f.kind] = self.injected.get(f.kind, 0) + 1
+                    return f
+        return None
+
+    def events(self, stream: str, port: Optional[int] = None) -> int:
+        """Events observed on a stream (globally, or for one port)."""
+        with self._lock:
+            return self._counts.get((stream, port), 0)
+
+    # -- hooks (net/client.py Connection) ------------------------------------
+
+    def on_connect(self, host: str, port: int) -> None:
+        f = self._on_event("connect", port)
+        if f is not None and f.kind == "refuse_connect":
+            raise ConnectionRefusedError(
+                f"[chaos] refused connect to {host}:{port}"
+            )
+
+    def on_send(self, conn) -> bool:
+        """True → transmit the frame; False → swallow it (outbound
+        partition).  May raise (drop) or sleep (delay)."""
+        f = self._on_event("send", conn.port)
+        if f is None:
+            return True
+        if f.kind == "delay":
+            time.sleep(f.delay_s)
+            return True
+        if f.kind == "drop":
+            conn.close()
+            raise OSError(f"[chaos] dropped connection to {conn.host}:{conn.port}")
+        if f.kind == "partition_out":
+            return False
+        return True
+
+    def on_recv(self, conn, data: bytes) -> Optional[bytes]:
+        """Returns the bytes to feed the parser (possibly truncated), or
+        None to swallow the chunk entirely (inbound partition)."""
+        f = self._on_event("recv", conn.port)
+        if f is None:
+            return data
+        if f.kind == "truncate":
+            conn.close()  # mid-reply cut: partial frame, then a dead socket
+            return data[: len(data) // 2]
+        if f.kind == "partition_in":
+            return None
+        return data
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def install(self):
+        """Install process-globally; returns the previous plane."""
+        return _net.install_fault_plane(self)
+
+    @contextmanager
+    def active(self):
+        """Context manager: install on enter, restore the prior plane on
+        exit (exception-safe — a failing test never leaks chaos into the
+        next one)."""
+        prev = _net.install_fault_plane(self)
+        try:
+            yield self
+        finally:
+            _net.install_fault_plane(prev)

@@ -1,0 +1,512 @@
+"""Soak/endurance harness: mixed workload across repeated chaos cycles.
+
+Parity target: ``RedissonFailoverTest.java:47-152`` (a write stream
+surviving repeated ``master.stop()``) scaled into an endurance discipline:
+every cycle runs a mixed workload (bucket writes with acked tracking, map
+put/get, lock acquire/release with a mutual-exclusion probe, pubsub, and a
+sharded-bloom batch on an embedded mesh engine), then injects chaos
+(master kill → automatic failover → restart-as-replica; mesh reshard
+4 → 8 → 4), then QUIESCES and asserts:
+
+  * zero acked-write loss — every pre-kill acked+flushed bucket write is
+    still readable after failover, and every acked bloom add is still
+    contained after every reshard;
+  * a flat :class:`~redisson_tpu.chaos.census.ResourceCensus` — record
+    locks and staged replication buffers drain to zero, no kernel-cache
+    entry outlives its epoch, connection pools return every connection,
+    and replication baselines stay bounded by the live keyspace;
+  * a bounded error budget — outage-window errors stay a fraction of acked
+    operations.
+
+Determinism: the workload content is a pure function of ``SoakConfig.seed``
+(keys, bloom batches, fault schedule).  Wall clock only decides HOW MUCH
+work a phase performs, never WHAT the assertions compare.
+
+Run it three ways: ``pytest -m slow tests/test_soak.py`` (the endurance
+tier), ``python tools/soak_smoke.py`` (a ~10s local sanity loop), or
+construct :class:`SoakHarness` directly.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from redisson_tpu.chaos.census import ResourceCensus
+from redisson_tpu.chaos.faults import FaultPlane, FaultSchedule
+
+
+@dataclass
+class SoakConfig:
+    cycles: int = 3
+    seconds_per_phase: float = 1.5
+    masters: int = 2
+    replicas_per_master: int = 1
+    writer_threads: int = 3
+    seed: int = 0
+    kill: bool = True              # master-kill -> failover -> recover
+    reshard: bool = True           # mesh reshard 4 -> 8 -> 4 per cycle
+    faults_per_cycle: int = 4      # injected transport faults per cycle
+    error_budget_ratio: float = 0.5
+    verify_sample: int = 50        # acked bucket writes re-read per cycle
+    bloom_batch: int = 256         # sharded-bloom adds per cycle
+    failover_deadline_s: float = 45.0
+    quiesce_deadline_s: float = 15.0
+    tag: str = "soak"              # hashtag pinning the write stream
+
+
+@dataclass
+class SoakReport:
+    cycles_completed: int = 0
+    acked_writes: int = 0
+    verified_writes: int = 0
+    errors: int = 0
+    failovers: List[Tuple[str, str]] = field(default_factory=list)
+    injected_faults: Dict[str, int] = field(default_factory=dict)
+    bloom_keys_verified: int = 0
+    pubsub_received: int = 0
+    lock_rounds: int = 0
+    lock_max_concurrency: int = 0
+    census: List[Dict[str, float]] = field(default_factory=list)
+
+    def summary(self) -> str:
+        return (
+            f"soak: {self.cycles_completed} cycles, "
+            f"{self.acked_writes} acked writes ({self.verified_writes} re-verified), "
+            f"{self.errors} budgeted errors, {len(self.failovers)} failovers, "
+            f"faults={self.injected_faults}, "
+            f"bloom={self.bloom_keys_verified} keys verified, "
+            f"pubsub={self.pubsub_received} received, "
+            f"locks={self.lock_rounds} rounds (peak concurrency "
+            f"{self.lock_max_concurrency}), census points={len(self.census)}"
+        )
+
+
+class SoakHarness:
+    """One endurance run over an in-process cluster + embedded mesh engine."""
+
+    def __init__(self, config: Optional[SoakConfig] = None,
+                 schedule: Optional[FaultSchedule] = None):
+        self.config = config or SoakConfig()
+        cfg = self.config
+        # a user-supplied schedule is ONE program across the whole run; the
+        # default builds a FRESH plane per cycle (fresh event counters), so
+        # every cycle's chaos phase actually injects faults_per_cycle faults
+        # instead of cycle 0 exhausting the whole event window
+        self._user_schedule = schedule
+        self.schedule = schedule or self._default_schedule(cfg)
+        self.plane = FaultPlane(self.schedule)
+        self._planes: List[FaultPlane] = [self.plane]
+        self.census = ResourceCensus()
+        self.report = SoakReport()
+        self._rng = np.random.default_rng(cfg.seed)
+        self._acked: Dict[str, int] = {}
+        self._acked_lock = threading.Lock()
+        self._bloom_added: List[np.ndarray] = []  # int64 key batches
+        self._pubsub_seen: set = set()
+        self._last_pubsub = None  # PubSubConnection currently subscribed
+        self._lock_inside = 0
+        self._runner = None
+        self._client = None
+        self._coord = None
+        self._embedded = None
+        self._mesh_mgr = None
+        self._failovers_seen = 0  # coord.failovers entries already reconciled
+
+    @staticmethod
+    def _default_schedule(cfg: SoakConfig, cycle: int = 0) -> FaultSchedule:
+        """Seed-deterministic background noise for ONE cycle: delays,
+        drops, and one-way partitions sprinkled over the early send/recv
+        events of the cycle's chaos phase (the window is small on purpose —
+        a phase generates hundreds of events, so the whole program lands
+        inside the phase it belongs to)."""
+        sched = FaultSchedule(cfg.seed * 7919 + cycle)
+        n = max(1, cfg.faults_per_cycle)
+        sched.add_random("delay", n=n, window=200, delay_s=0.02)
+        sched.add_random("drop", n=max(1, n // 2), window=200)
+        sched.add_random("partition_in", n=max(1, n // 4), window=200)
+        return sched
+
+    def _plane_for_cycle(self, cycle: int) -> FaultPlane:
+        if self._user_schedule is not None:
+            return self.plane  # one continuous program, shared counters
+        if cycle == 0:
+            return self.plane
+        plane = FaultPlane(self._default_schedule(self.config, cycle))
+        self._planes.append(plane)
+        return plane
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _setup(self) -> None:
+        import redisson_tpu
+        from redisson_tpu.config import Config
+        from redisson_tpu.harness import ClusterRunner
+        from redisson_tpu.server.monitor import FailoverCoordinator
+
+        cfg = self.config
+        self._runner = ClusterRunner(
+            masters=cfg.masters, replicas_per_master=cfg.replicas_per_master
+        ).run()
+        # short timeouts on purpose: a writer blocked behind a dead node or a
+        # partitioned reply must fail (budgeted) within seconds, not park for
+        # the 180s XLA default — worst case per op is ~timeout x attempts
+        self._client = self._runner.client(
+            scan_interval=0.5, timeout=10.0, connect_timeout=5.0,
+            retry_attempts=1, retry_interval=0.2,
+        )
+        self._coord = FailoverCoordinator(
+            self._runner.view_tuples(), check_interval=0.1
+        ).start()
+        if cfg.reshard:
+            import jax
+
+            from redisson_tpu.parallel.manager import MeshManager
+
+            if len(jax.devices()) >= 8:
+                ecfg = Config()
+                ecfg.mesh.dp = 2
+                ecfg.mesh.shard = 4
+                self._embedded = redisson_tpu.create(ecfg)
+                self._mesh_mgr = MeshManager.of(self._embedded._engine)
+                bf = self._embedded.get_sharded_bloom_filter_array("soak:bloom")
+                bf.try_init(8, expected_insertions=200_000, false_probability=0.01)
+        self.census.track_client("client", self._client)
+        if self._embedded is not None:
+            self.census.track_engine("embedded", self._embedded._engine)
+        time.sleep(0.5)  # coordinator learns each master's replica set
+
+    def _teardown(self) -> None:
+        if self._coord is not None:
+            self._coord.stop()
+        if self._client is not None:
+            self._client.shutdown()
+        if self._embedded is not None:
+            self._embedded.shutdown()
+        if self._runner is not None:
+            self._runner.shutdown()
+
+    # -- workload ------------------------------------------------------------
+
+    def _record_error(self) -> None:
+        with self._acked_lock:
+            self.report.errors += 1
+
+    def _writer(self, wid: int, cycle: int, stop: threading.Event) -> None:
+        cfg = self.config
+        client = self._client
+        i = 0
+        while not stop.is_set():
+            key = f"c{cycle}-w{wid}-{i}{{{cfg.tag}}}"
+            try:
+                client.get_bucket(key).set(i)
+                with self._acked_lock:
+                    self._acked[key] = i
+                    self.report.acked_writes += 1
+            except Exception:  # noqa: BLE001 — budgeted chaos error
+                self._record_error()
+            i += 1
+            time.sleep(0.004)
+
+    def _mapper(self, wid: int, cycle: int, stop: threading.Event) -> None:
+        cfg = self.config
+        m = self._client.get_map(f"soak-map{{{cfg.tag}}}")
+        i = 0
+        while not stop.is_set():
+            try:
+                m.put(f"c{cycle}-w{wid}-{i}", i)
+                m.get(f"c{cycle}-w{wid}-{max(0, i - 1)}")
+            except Exception:  # noqa: BLE001
+                self._record_error()
+            i += 1
+            time.sleep(0.004)
+
+    def _locker(self, wid: int, cycle: int, stop: threading.Event) -> None:
+        cfg = self.config
+        lk = self._client.get_lock(f"soak-lock{{{cfg.tag}}}")
+        while not stop.is_set():
+            try:
+                lk.lock()
+            except Exception:  # noqa: BLE001
+                self._record_error()
+                time.sleep(0.05)
+                continue
+            try:
+                with self._acked_lock:
+                    self._lock_inside += 1
+                    self.report.lock_max_concurrency = max(
+                        self.report.lock_max_concurrency, self._lock_inside
+                    )
+                time.sleep(0.002)
+                with self._acked_lock:
+                    self._lock_inside -= 1
+                    self.report.lock_rounds += 1
+            finally:
+                try:
+                    lk.unlock()
+                except Exception:  # noqa: BLE001 — node died holding it; the
+                    self._record_error()  # lease lapses server-side
+            time.sleep(0.002)
+
+    def _publisher(self, cycle: int, stop: threading.Event) -> None:
+        cfg = self.config
+        chan = f"soak-chan{{{cfg.tag}}}"
+        i = 0
+        while not stop.is_set():
+            try:
+                self._client.publish_for(chan, chan, f"c{cycle}-{i}".encode())
+            except Exception:  # noqa: BLE001
+                self._record_error()
+            i += 1
+            time.sleep(0.01)
+
+    def _on_pubsub(self, _channel: str, payload: bytes) -> None:
+        with self._acked_lock:  # reader thread vs. report readers
+            if payload not in self._pubsub_seen:
+                self._pubsub_seen.add(payload)
+                self.report.pubsub_received += 1
+
+    def _subscribe(self) -> None:
+        """Attach the ONE listener to the channel's current pubsub
+        connection — re-subscribing only when failover handed the channel a
+        fresh connection (same connection = already listening; stacking a
+        duplicate listener would double-count every message)."""
+        chan = f"soak-chan{{{self.config.tag}}}"
+        try:
+            ps = self._client.pubsub_for(chan)
+            if ps is self._last_pubsub:
+                return
+            ps.subscribe(chan, self._on_pubsub)
+            self._last_pubsub = ps
+        except Exception:  # noqa: BLE001 — pubsub is best-effort mid-chaos
+            pass
+
+    def _workload_phase(self, cycle: int, chaos: bool = True) -> None:
+        cfg = self.config
+        self._subscribe()
+        stop = threading.Event()
+        threads = [
+            threading.Thread(target=self._writer, args=(w, cycle, stop))
+            for w in range(cfg.writer_threads)
+        ] + [
+            threading.Thread(target=self._mapper, args=(0, cycle, stop)),
+            threading.Thread(target=self._locker, args=(0, cycle, stop)),
+            threading.Thread(target=self._locker, args=(1, cycle, stop)),
+            threading.Thread(target=self._publisher, args=(cycle, stop)),
+        ]
+        ctx = self._plane_for_cycle(cycle).active() if chaos else None
+        if ctx is not None:
+            ctx.__enter__()
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(cfg.seconds_per_phase)
+        finally:
+            stop.set()
+            for t in threads:
+                # a partitioned reply holds an op for ~timeout x attempts;
+                # the join bound must dominate that, not race it
+                t.join(timeout=90.0)
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+        assert not any(t.is_alive() for t in threads), "soak worker wedged"
+
+    # -- chaos ops -----------------------------------------------------------
+
+    def _victim_index(self) -> int:
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        slot = calc_slot(self.config.tag.encode())
+        return next(
+            i for i, (lo, hi) in enumerate(self._runner.slot_ranges)
+            if lo <= slot <= hi
+        )
+
+    def _reconcile_failovers(self) -> None:
+        """Fold every coordinator failover not yet processed into the
+        runner's bookkeeping — our own kills AND any spurious one (a fault
+        program that includes the control plane can push a healthy master's
+        ping stream past the detector threshold).  The demoted node — dead
+        or alive — becomes a replica of the promoted one, so capacity and
+        monitoring survive every cycle."""
+        runner, coord = self._runner, self._coord
+        fos = coord.failovers
+        while self._failovers_seen < len(fos):
+            dead_addr, promoted_addr = fos[self._failovers_seen]
+            self._failovers_seen += 1
+            self.report.failovers.append((dead_addr, promoted_addr))
+            dead = runner.adopt_failover(dead_addr, promoted_addr)
+            if dead is None:
+                continue
+            if dead.stopped:
+                runner.restart_node(dead)
+            else:
+                # spuriously demoted but alive: re-point it as a replica
+                runner.install_view()
+                runner.wire_replicas()
+
+    def _kill_failover_recover(self) -> None:
+        from redisson_tpu.harness import _exec
+
+        cfg = self.config
+        runner, coord = self._runner, self._coord
+        self._reconcile_failovers()
+        mi = self._victim_index()
+        victim = runner.masters[mi]
+        victim_addr = victim.address
+        # flush so every already-acked write is on the replica BEFORE the
+        # kill: the zero-acked-write-loss contract covers flushed writes
+        # (async replication semantics, WAIT/REPLFLUSH analog)
+        with victim.server.client() as c:
+            _exec(c, "REPLFLUSH", timeout=60.0)
+        with self._acked_lock:
+            pre_kill = dict(self._acked)
+        seen = self._failovers_seen
+        runner.stop_master(mi)
+        deadline = time.monotonic() + cfg.failover_deadline_s
+
+        def victim_failed_over() -> bool:
+            return any(d == victim_addr for d, _p in coord.failovers[seen:])
+
+        while time.monotonic() < deadline and not victim_failed_over():
+            time.sleep(0.1)
+        assert victim_failed_over(), "no automatic failover happened"
+        self._client.refresh_topology()
+        # restart the dead node as a fresh replica of the promoted master so
+        # the NEXT cycle has a promotion candidate again
+        self._reconcile_failovers()
+        time.sleep(0.5)  # clients re-route; coordinator re-learns replicas
+        self._verify_acked(pre_kill)
+
+    def _verify_acked(self, acked: Dict[str, int]) -> None:
+        cfg = self.config
+        keys = sorted(acked)
+        sample = keys[:: max(1, len(keys) // cfg.verify_sample)]
+        for key in sample:
+            got = None
+            # the freshly promoted topology may still be settling: bounded
+            # retry, but the VALUE comparison is exact — no acked-write loss
+            for _ in range(20):
+                try:
+                    got = self._client.get_bucket(key).get()
+                    break
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.2)
+            assert got == acked[key], (
+                f"lost acked+flushed write {key!r}: want {acked[key]!r}, got {got!r}"
+            )
+            self.report.verified_writes += 1
+
+    def _bloom_phase(self) -> None:
+        """Add one deterministic batch, then verify EVERY batch ever acked
+        across a 4 -> 8 -> 4 reshard roundtrip (zero lost acked adds)."""
+        if self._embedded is None:
+            return
+        cfg = self.config
+        bf = self._embedded.get_sharded_bloom_filter_array("soak:bloom")
+        keys = self._rng.integers(0, 1 << 60, cfg.bloom_batch).astype(np.int64)
+        tenant = (np.arange(cfg.bloom_batch) % 8).astype(np.int32)
+        bf.add_each(tenant, keys)
+        self._bloom_added.append(keys)
+        for dp, shard in ((1, 8), (2, 4)):
+            self._mesh_mgr.reshard(dp=dp, shard=shard)
+            for batch in self._bloom_added:
+                t = (np.arange(batch.size) % 8).astype(np.int32)
+                got = bf.contains_each(t, batch)
+                assert got.all(), (
+                    f"lost {int((~got).sum())} acked bloom adds after reshard "
+                    f"to (dp={dp}, shard={shard})"
+                )
+                self.report.bloom_keys_verified += int(batch.size)
+
+    # -- quiesce + census ----------------------------------------------------
+
+    def _quiesce_census(self, cycle: int) -> Dict[str, float]:
+        cfg = self.config
+        # re-track the CURRENT live servers (kills/restarts change the set)
+        runner = self._runner
+        live = [
+            n for n in runner.masters + runner.replicas if not n.stopped
+        ]
+        for i, node in enumerate(live):
+            self.census.track_server(f"server{i}", node.server.server)
+            self.census.track_engine(f"server{i}.engine", node.server.server.engine)
+        # drain: workload is stopped; wait for pools, staging, and record
+        # locks to settle (lock-watchdog renewal ticks touch record locks
+        # transiently, so we assert on a SETTLED snapshot, not an instant)
+        deadline = time.monotonic() + cfg.quiesce_deadline_s
+        snap = self.census.snapshot()
+        while time.monotonic() < deadline:
+            busy = [
+                k for k, v in snap.items()
+                if v and (
+                    k.endswith(".conn_in_use")
+                    or k.endswith(".repl_staged_xfers")
+                    or k.endswith(".record_locks")
+                )
+            ]
+            if not busy:
+                break
+            time.sleep(0.2)
+            snap = self.census.snapshot()
+        # absolute leak assertions (hold at EVERY quiesce, any server set)
+        for k, v in snap.items():
+            if k.endswith((".conn_in_use", ".repl_staged_xfers", ".record_locks",
+                           ".kernel_cache_stale")):
+                assert v == 0, f"cycle {cycle}: leaked resource {k} = {v}"
+            if k.endswith(".repl_baselines"):
+                keys_k = k.replace(".repl_baselines", ".engine.keys")
+                limit = snap.get(keys_k)
+                if limit is not None:
+                    assert v <= limit, (
+                        f"cycle {cycle}: {k} = {v} exceeds live keys {limit}"
+                    )
+        self.report.census.append(snap)
+        # flat across quiesce points for the STABLE sources (embedded engine
+        # + client): census_before == census_after, not ad-hoc introspection
+        if len(self.report.census) > 1:
+            stable = ("embedded.record_locks", "embedded.kernel_cache_entries",
+                      "embedded.kernel_cache_stale", "client.conn_in_use")
+            before = {k: v for k, v in self.report.census[0].items() if k in stable}
+            after = {k: v for k, v in snap.items() if k in stable}
+            self.census.assert_flat(before, after, context=f"cycle {cycle}")
+        return snap
+
+    # -- the run loop --------------------------------------------------------
+
+    def run(self) -> SoakReport:
+        cfg = self.config
+        self._setup()
+        try:
+            for cycle in range(cfg.cycles):
+                self._workload_phase(cycle, chaos=True)
+                if cfg.kill:
+                    self._kill_failover_recover()
+                    # keep writing through the post-failover topology too
+                    self._workload_phase(cycle, chaos=False)
+                self._bloom_phase()
+                self._quiesce_census(cycle)
+                self.report.cycles_completed += 1
+            budget = int(cfg.error_budget_ratio * max(1, self.report.acked_writes))
+            assert self.report.errors <= budget, (
+                f"error budget blown: {self.report.errors} errors vs "
+                f"{self.report.acked_writes} acked writes (budget {budget})"
+            )
+            assert self.report.lock_max_concurrency <= 1, (
+                "lock mutual exclusion violated under chaos: "
+                f"{self.report.lock_max_concurrency} holders observed"
+            )
+            return self.report
+        finally:
+            # aggregate in the failure path too: a mid-run assertion must
+            # still report WHICH chaos fired (the first diagnostic needed)
+            self.report.injected_faults = {}
+            for plane in self._planes:
+                for kind, n in plane.injected.items():
+                    self.report.injected_faults[kind] = (
+                        self.report.injected_faults.get(kind, 0) + n
+                    )
+            self._teardown()

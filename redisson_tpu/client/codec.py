@@ -242,9 +242,10 @@ class Lz4Codec(Codec):
     """LZ4 block compression wrapper — the reference's recommended
     compression codec (codec/LZ4Codec.java).  Backed by the pure-python
     block implementation in utils/lz4block.py (standard block format:
-    interoperable with any LZ4 block decoder); the frame is a 4-byte LE
-    uncompressed length + the block, matching the reference's
-    decompressor-needs-length discipline."""
+    interoperable with any LZ4 block decoder); the frame is a 4-byte
+    BIG-ENDIAN uncompressed length + the block — LZ4Codec.java writes the
+    length with Netty ``ByteBuf.writeInt`` (network byte order), so the
+    frame is byte-compatible with reference-written values."""
 
     name = "lz4"
 
@@ -255,13 +256,28 @@ class Lz4Codec(Codec):
         from redisson_tpu.utils import lz4block
 
         raw = self.inner.encode(value)
-        return len(raw).to_bytes(4, "little") + lz4block.compress(raw)
+        return len(raw).to_bytes(4, "big") + lz4block.compress(raw)
 
     def decode(self, data):
         from redisson_tpu.utils import lz4block
 
-        ulen = int.from_bytes(data[:4], "little")
-        return self.inner.decode(lz4block.decompress(data[4:], ulen))
+        be = int.from_bytes(data[:4], "big")
+        try:
+            raw = lz4block.decompress(data[4:], be)
+        except ValueError as e:
+            # at-rest compat: frames written before the wire-compat fix
+            # carried the length little-endian; exactly one byte order
+            # passes the decompressor's size check, so the retry is
+            # unambiguous.  A genuinely corrupt frame surfaces the ORIGINAL
+            # (big-endian, current-format) error, never the retry's.
+            le = int.from_bytes(data[:4], "little")
+            if le == be:
+                raise
+            try:
+                raw = lz4block.decompress(data[4:], le)
+            except ValueError:
+                raise e from None
+        return self.inner.decode(raw)
 
 
 class ProtobufCodec(Codec):

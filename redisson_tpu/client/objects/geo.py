@@ -175,7 +175,20 @@ class Geo(RExpirable):
         surface) or legacy (lon, lat, radius[, unit, count, order])."""
         if len(a) == 1 and isinstance(a[0], GeoSearchArgs):
             return self.search_with_position_args(a[0])
-        lon, lat, radius = a[:3]
+        if len(a) >= 3:
+            lon, lat, radius = a[:3]
+        else:
+            # pre-r5 named-parameter signature: lon/lat/radius may arrive as
+            # keywords — fall back to kw when the positionals run short
+            # instead of raising an opaque unpack ValueError
+            try:
+                lon = a[0] if len(a) > 0 else kw["lon"]
+                lat = a[1] if len(a) > 1 else kw["lat"]
+                radius = kw["radius"]
+            except KeyError as e:
+                raise TypeError(
+                    f"search_with_position() missing required argument: {e.args[0]!r}"
+                ) from None
         unit = a[3] if len(a) > 3 else kw.get("unit", "m")
         count = a[4] if len(a) > 4 else kw.get("count")
         order = a[5] if len(a) > 5 else kw.get("order", "ASC")
@@ -350,15 +363,24 @@ class Geo(RExpirable):
         """GEOSEARCHSTORE (RGeo.storeSearchTo): hits land in dest, replacing
         it — Redis GEOSEARCHSTORE overwrites the destination key."""
         pairs = self._eval_args(args)
-        rec = self._engine.store.get(self._name)
         dest = Geo(self._engine, dest_name, self._codec)  # maps dest_name
         with self._engine.locked_many((self._name, dest._name)):
+            # re-fetch the source UNDER the lock: members matched by the
+            # pre-lock evaluation may have been concurrently removed — skip
+            # them instead of raising KeyError after dest was already cleared
+            rec = self._engine.store.get(self._name)
+            src = rec.host if rec is not None else {}
             drec = dest._rec_or_create()
             drec.host.clear()
+            stored = 0
             for m, _ in pairs:
-                drec.host[m] = rec.host[m]
+                p = src.get(m)
+                if p is None:
+                    continue  # vanished between evaluation and the lock
+                drec.host[m] = p
+                stored += 1
             self._touch_version(drec)
-        return len(pairs)
+        return stored
 
     def store_sorted_search_to(self, dest_name: str, args: GeoSearchArgs) -> int:
         """GEOSEARCHSTORE STOREDIST analog: dest iterates nearest-first

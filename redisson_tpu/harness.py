@@ -135,6 +135,53 @@ class ClusterRunner:
         self.wire_replicas()  # re-attach replica links severed by the restart
         return node
 
+    def pause_node(self, node: ClusterNode) -> None:
+        """SIGSTOP analog: the node stops answering (pings included) but
+        keeps its sockets open — the hung-but-accepting failure mode only
+        command-timeout detectors catch (TpuServer.pause)."""
+        node.server.server.pause()
+
+    def resume_node(self, node: ClusterNode) -> None:
+        node.server.server.resume()
+
+    def stall_replication(self, node: ClusterNode) -> None:
+        """Freeze this master's record shipper (replica lag grows unbounded
+        until resumed) — the repl-link-partition chaos op."""
+        src = node.server.server._replication
+        if src is not None:
+            src.stall()
+
+    def resume_replication(self, node: ClusterNode) -> None:
+        src = node.server.server._replication
+        if src is not None:
+            src.resume()
+
+    def adopt_failover(self, dead_address: str, promoted_address: str) -> Optional[ClusterNode]:
+        """Sync this runner's bookkeeping with a promotion an external
+        FailoverCoordinator performed: the promoted replica becomes
+        masters[i] for the dead master's range.  Returns the dead node
+        (still stopped) so callers can restart_node() it as a fresh replica
+        of the promoted master — the repeated-kill soak cycle's recovery
+        step."""
+        mi = next(
+            (i for i, m in enumerate(self.masters) if m.address == dead_address),
+            None,
+        )
+        promoted = next(
+            (r for r in self.replicas if r.address == promoted_address), None
+        )
+        if mi is None or promoted is None:
+            return None
+        dead = self.masters[mi]
+        promoted.role = "master"
+        promoted.master_index = None
+        self.masters[mi] = promoted
+        self.replicas = [r for r in self.replicas if r is not promoted]
+        dead.role = "replica"
+        dead.master_index = mi
+        self.replicas.append(dead)
+        return dead
+
     def promote(self, replica: ClusterNode) -> None:
         """Manual failover: replica takes over its dead master's slot range
         (the coordinator in server/monitor.py automates this)."""

@@ -30,6 +30,22 @@ from redisson_tpu.net.detectors import FailedNodeDetector
 from redisson_tpu.net.resp import Push, RespError
 from redisson_tpu.utils import metrics as _metrics
 
+# Process-global transport fault plane (chaos/faults.py FaultPlane): every
+# Connection consults it at its three event sites — connect, send, recv —
+# so injected faults flow through the REAL failure paths (pool discard,
+# retry machinery, detector feeds) instead of bypassing them.  None = no
+# chaos (the zero-overhead production state: one attribute load per event).
+_fault_plane = None
+
+
+def install_fault_plane(plane):
+    """Install (or clear, with None) the process-global fault plane.
+    Returns the previously installed plane so callers can restore it."""
+    global _fault_plane
+    prev = _fault_plane
+    _fault_plane = plane
+    return prev
+
 
 def parse_address(addr: str) -> Tuple[str, int]:
     """tpu://host:port (also accepts tpus://, redis://, rediss://, bare)."""
@@ -96,6 +112,9 @@ class Connection:
         self._parser = resp.RespParser()
         self._pending: List[Any] = []  # decoded push frames awaiting delivery
         self.push_handler: Optional[Callable[[Push], None]] = None
+        plane = _fault_plane
+        if plane is not None:
+            plane.on_connect(host, port)  # may raise ConnectionRefusedError
         self._sock = socket.create_connection((host, port), timeout=connect_timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if ssl_context is not None:
@@ -130,6 +149,9 @@ class Connection:
 
     def send(self, *args) -> None:
         try:
+            plane = _fault_plane
+            if plane is not None and not plane.on_send(self):
+                return  # one-way partition (out): frame never leaves
             self._sock.sendall(resp.encode_command(*args))
         except (OSError, ValueError) as e:
             self.close()
@@ -165,6 +187,11 @@ class Connection:
             if not data:
                 self.close()
                 raise ConnectionError_(f"connection to {self.host}:{self.port} closed by peer")
+            plane = _fault_plane
+            if plane is not None:
+                data = plane.on_recv(self, data)
+                if data is None:
+                    continue  # one-way partition (in): reply silently lost
             self._pending.extend(self._parser.feed(data))
 
     def execute(self, *args, timeout: Optional[float] = None) -> Any:
@@ -178,6 +205,9 @@ class Connection:
             return []
         payload = b"".join(resp.encode_command(*c) for c in commands)
         try:
+            plane = _fault_plane
+            if plane is not None and not plane.on_send(self):
+                payload = b""  # partition (out): the whole frame is lost
             self._sock.sendall(payload)
         except OSError as e:
             self.close()

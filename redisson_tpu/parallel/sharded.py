@@ -25,6 +25,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+# jax.shard_map graduated from jax.experimental in newer releases; the
+# pinned 0.4.x still ships it experimental-only — resolve once here
+try:
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from redisson_tpu.parallel.mesh import DP_AXIS, SHARD_AXIS
 from redisson_tpu.ops import hll as hll_ops
 from redisson_tpu.utils import hashing as H
@@ -93,7 +100,7 @@ def make_sharded_bloom_kernels(
         return bits_local, newly
 
     contains = jax.jit(
-        jax.shard_map(
+        _shard_map(
             contains_local,
             mesh=mesh,
             in_specs=(state_spec, ops_spec, ops_spec, ops_spec, P()),
@@ -101,7 +108,7 @@ def make_sharded_bloom_kernels(
         )
     )
     add = jax.jit(
-        jax.shard_map(
+        _shard_map(
             add_local,
             mesh=mesh,
             in_specs=(state_spec, ops_spec, ops_spec, ops_spec, P()),
@@ -147,7 +154,7 @@ def make_sharded_hll_kernels(mesh: Mesh, p: int, n_rows: int):
         return hll_ops.estimate(regs_local)
 
     add = jax.jit(
-        jax.shard_map(
+        _shard_map(
             add_local,
             mesh=mesh,
             in_specs=(state_spec, ops_spec, ops_spec, ops_spec, P()),
@@ -156,7 +163,7 @@ def make_sharded_hll_kernels(mesh: Mesh, p: int, n_rows: int):
         donate_argnums=(0,),
     )
     estimate = jax.jit(
-        jax.shard_map(
+        _shard_map(
             estimate_local, mesh=mesh, in_specs=(state_spec,), out_specs=P(SHARD_AXIS)
         )
     )
@@ -223,7 +230,7 @@ def make_sharded_bitset_kernels(mesh: Mesh, m: int, width: int = 0):
             return combined, old & valid
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 set_local, mesh=mesh,
                 in_specs=(state_spec, ops_spec, P()),
                 out_specs=(state_spec, ops_spec),
@@ -238,13 +245,13 @@ def make_sharded_bitset_kernels(mesh: Mesh, m: int, width: int = 0):
         return jax.lax.psum(jnp.sum(bits_local, dtype=jnp.int32), SHARD_AXIS)
 
     get = jax.jit(
-        jax.shard_map(
+        _shard_map(
             get_local, mesh=mesh,
             in_specs=(state_spec, ops_spec, P()),
             out_specs=ops_spec,
         )
     )
     card = jax.jit(
-        jax.shard_map(card_local, mesh=mesh, in_specs=(state_spec,), out_specs=P())
+        _shard_map(card_local, mesh=mesh, in_specs=(state_spec,), out_specs=P())
     )
     return (make_set(True), make_set(False)), get, card

@@ -82,7 +82,17 @@ def _encode_record_delta(item: dict, base: dict) -> Optional[dict]:
         idx = np.nonzero(dirty)[0].astype(np.int32)
         total += cb.shape[0]
         changed += idx.size
-        out[akey] = None if idx.size == 0 else {"idx": idx, "data": cb[idx]}
+        # the expected geometry travels WITH the delta: apply_records
+        # validates it against the replica's actual plane before scattering
+        # (a silent shape divergence would land blocks at wrong row-major
+        # offsets; JAX .at[].set silently drops out-of-bounds indices)
+        out[akey] = None if idx.size == 0 else {
+            "idx": idx,
+            "data": cb[idx],
+            "shape": tuple(cur.shape),
+            "dtype": str(cur.dtype),
+            "nblocks": int(cb.shape[0]),
+        }
     if total and changed / total > 0.6:
         return None
     return out
@@ -112,6 +122,41 @@ def _patch_fn(shape: tuple, dtype_str: str, bucket: int):
         return blocks.ravel()[:n].reshape(shape)
 
     return f
+
+
+def _validate_array_delta(name: str, akey: str, cur, d: dict) -> None:
+    """Reject a delta whose shipped geometry diverges from the replica's
+    actual plane BEFORE any scatter runs (ADVICE r5 medium).  JAX
+    ``.at[idx].set`` silently drops out-of-bounds indices and a shape
+    divergence (e.g. a plane re-padded by adapt_plane, which changes shape
+    without a version bump) scatters blocks at wrong row-major offsets —
+    silent replica corruption.  Raising here makes the REPLPUSH fail
+    loudly, so the master's shipper falls back to a full ship."""
+    shape = d.get("shape")
+    if shape is not None and tuple(cur.shape) != tuple(shape):
+        raise ValueError(
+            f"REPLPUSH delta shape mismatch for {name!r}/{akey}: replica has "
+            f"{tuple(cur.shape)}, master shipped {tuple(shape)}"
+        )
+    dtype = d.get("dtype")
+    if dtype is not None and str(cur.dtype) != dtype:
+        raise ValueError(
+            f"REPLPUSH delta dtype mismatch for {name!r}/{akey}: replica has "
+            f"{cur.dtype}, master shipped {dtype}"
+        )
+    be = _block_elems(np.dtype(str(cur.dtype)))
+    nblocks = -(-int(np.prod(cur.shape)) // be)
+    if int(d.get("nblocks", nblocks)) != nblocks:
+        raise ValueError(
+            f"REPLPUSH delta block-count mismatch for {name!r}/{akey}: replica "
+            f"plane has {nblocks} blocks, master shipped {d.get('nblocks')}"
+        )
+    idx = d["idx"]
+    if idx.size and (int(idx.max()) >= nblocks or int(idx.min()) < 0):
+        raise ValueError(
+            f"REPLPUSH delta block index out of range for {name!r}/{akey}: "
+            f"[{int(idx.min())}, {int(idx.max())}] vs {nblocks} blocks"
+        )
 
 
 def _apply_array_delta(cur, d: dict):
@@ -260,7 +305,11 @@ def apply_records(engine, blob: bytes) -> int:
                     cur = existing.arrays.get(akey)
                     if cur is None:
                         raise ValueError(f"delta for unknown array {name!r}/{akey}")
-                    arrays[akey] = cur if d is None else _apply_array_delta(cur, d)
+                    if d is None:
+                        arrays[akey] = cur
+                        continue
+                    _validate_array_delta(name, akey, cur, d)
+                    arrays[akey] = _apply_array_delta(cur, d)
             else:
                 arrays = {k: jnp.asarray(v) for k, v in item["arrays"].items()}
             rec = StateRecord(
@@ -330,7 +379,21 @@ class ReplicationSource:
         # one sweep at a time: a manual flush() racing the interval thread
         # would double-ship full planes and interleave h.shipped updates
         self._ship_mutex = threading.Lock()
+        # chaos hook: a stalled stream ships NOTHING (replica lag grows
+        # unbounded) until resumed — the repl-link-partition failure mode
+        self._stalled = threading.Event()
         self.stats = {"pushes": 0, "bytes": 0, "records_full": 0, "records_delta": 0}
+
+    def stall(self) -> None:
+        """Stop shipping (chaos: replication-stream stall) until resume()."""
+        self._stalled.set()
+
+    def resume(self) -> None:
+        self._stalled.clear()
+
+    @property
+    def stalled(self) -> bool:
+        return self._stalled.is_set()
 
     def register(self, address: str) -> None:
         with self._lock:
@@ -372,6 +435,8 @@ class ReplicationSource:
         return dirty, deleted
 
     def _ship_once(self) -> int:
+        if self._stalled.is_set():
+            return 0
         with self._ship_mutex:
             return self._ship_once_locked()
 

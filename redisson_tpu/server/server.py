@@ -154,6 +154,16 @@ class TpuServer:
         self.promoted_from: Optional[str] = None
         self._replication = None  # lazy ReplicationSource (master side)
         self._repl_lock = threading.Lock()
+        # REPLPUSHSEG staging: xfer_id -> [chunk slots, last-touch monotonic]
+        # (verbs/admin.py cmd_replpushseg; census counts live entries)
+        self._repl_xfers: Dict[str, list] = {}
+        self._repl_xfers_lock = threading.Lock()
+        # chaos pause gate (SIGSTOP analog): cleared = every command handler
+        # parks before dispatch, so the node stops answering (pings included)
+        # WITHOUT closing connections — the hung-but-accepting failure mode
+        # that only command-timeout detectors can catch
+        self._pause_gate = threading.Event()
+        self._pause_gate.set()
         self._client_ids = iter(range(1, 1 << 62))
         # OBJCALL handle cache (ordered for LRU eviction; see registry)
         from collections import OrderedDict
@@ -412,6 +422,28 @@ class TpuServer:
                 link.close()
         return moved
 
+    # -- chaos hooks (fault plane, server layer) ------------------------------
+
+    def pause(self) -> None:
+        """Stop answering commands without dropping connections (the
+        SIGSTOP/GC-pause analog).  Paused workers park on the gate; clients
+        observe reply timeouts, feeding FailedCommandsTimeoutDetector."""
+        self._pause_gate.clear()
+
+    def resume(self) -> None:
+        self._pause_gate.set()
+
+    @property
+    def paused(self) -> bool:
+        return not self._pause_gate.is_set()
+
+    def _dispatch_gated(self, ctx, cmd):
+        if not self._pause_gate.is_set():
+            # bounded so a forgotten resume() degrades to a long stall, not
+            # a permanently wedged worker pool
+            self._pause_gate.wait(timeout=60.0)
+        return REGISTRY.dispatch(self, ctx, cmd)
+
     def replication_source(self):
         """Lazy master-side record shipper (server/replication.py)."""
         from redisson_tpu.server.replication import ReplicationSource
@@ -513,7 +545,7 @@ class TpuServer:
                     try:
                         results.append(
                             await loop.run_in_executor(
-                                pool, REGISTRY.dispatch, self, ctx, cmd
+                                pool, self._dispatch_gated, ctx, cmd
                             )
                         )
                     except RespError as e:
@@ -620,6 +652,7 @@ class TpuServer:
         # a forever-blocked worker would otherwise survive pool shutdown
         # (wait=False) and hang interpreter exit via the futures atexit join
         self._closing = True
+        self._pause_gate.set()  # release chaos-paused workers
         loop, server = self._loop, self._server
         if loop is not None and server is not None:
             def shutdown():

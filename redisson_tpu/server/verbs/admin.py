@@ -224,7 +224,34 @@ def cmd_replregister(server, ctx, args):
 def cmd_replpush(server, ctx, args):
     from redisson_tpu.server import replication
 
+    # any live push proves the link is back: reap transfers its dead
+    # predecessor abandoned mid-segment (a restarted master full-ships via
+    # plain REPLPUSH, so seg-only sweeping would never fire here)
+    with server._repl_xfers_lock:
+        _reap_stale_xfers(server, time.monotonic())
     return replication.apply_records(server.engine, bytes(args[0]))
+
+
+# staging eviction knobs (cmd_replpushseg): a transfer untouched for
+# REPL_XFER_STALE_S is abandoned (its pusher's per-segment timeout is 60s,
+# so 120s of silence means the source died mid-transfer); REPL_XFER_MAX is
+# the hard leak backstop — far above any sane concurrent-transfer count, so
+# in-progress transfers are never spuriously dropped (ADVICE r5 low: the
+# old keep-at-most-4-by-insertion-order cap dropped concurrent live ones).
+REPL_XFER_STALE_S = 120.0
+REPL_XFER_MAX = 64
+
+
+def _reap_stale_xfers(server, now: float, keep: str = "") -> None:
+    """Drop staged transfers untouched past the stale window.  Caller holds
+    server._repl_xfers_lock.  Runs on EVERY replication push — not just a
+    new transfer's first slice — so an abandoned transfer cannot linger
+    (and read as a phantom leak in the resource census) just because no
+    later segmented ship ever starts."""
+    xfers = server._repl_xfers
+    for k in [k for k, (_slots, ts) in xfers.items()
+              if k != keep and now - ts > REPL_XFER_STALE_S]:
+        del xfers[k]
 
 
 @register("REPLPUSHSEG")
@@ -233,25 +260,31 @@ def cmd_replpushseg(server, ctx, args):
     oversized REPLPUSH blob (a 10M-key bloom plane is ~95MB; a single
     sendall of that stalls past socket timeouts, server/replication.py
     SEGMENT_BYTES).  The final slice reassembles and applies the blob;
-    intermediates stage host-side and answer +OK."""
+    intermediates stage host-side and answer +OK.  Staging evicts by
+    per-transfer staleness (last-touch timestamp), never insertion order."""
     from redisson_tpu.server import replication
 
     xfer_id, seq, nsegs = _s(args[0]), _int(args[1]), _int(args[2])
     chunk = bytes(args[3])
-    xfers = server.__dict__.setdefault("_repl_xfers", {})
-    if seq == 0:
-        xfers[xfer_id] = [None] * nsegs
-        # a lost transfer must not leak staging forever: keep at most 4
-        while len(xfers) > 4:
-            xfers.pop(next(iter(xfers)))
-    slots = xfers.get(xfer_id)
-    if slots is None or len(slots) != nsegs or not (0 <= seq < nsegs):
-        raise RespError(f"ERR unknown replication transfer {xfer_id}/{seq}")
-    slots[seq] = chunk
-    if any(s is None for s in slots):
-        return "+OK"
-    del xfers[xfer_id]
-    return replication.apply_records(server.engine, b"".join(slots))
+    now = time.monotonic()
+    xfers = server._repl_xfers
+    with server._repl_xfers_lock:
+        _reap_stale_xfers(server, now, keep=xfer_id)
+        if seq == 0:
+            while len(xfers) >= REPL_XFER_MAX:
+                # backstop only: drop the least-recently-touched transfer
+                del xfers[min(xfers, key=lambda k: xfers[k][1])]
+            xfers[xfer_id] = [[None] * nsegs, now]
+        entry = xfers.get(xfer_id)
+        if entry is None or len(entry[0]) != nsegs or not (0 <= seq < nsegs):
+            raise RespError(f"ERR unknown replication transfer {xfer_id}/{seq}")
+        entry[0][seq] = chunk
+        entry[1] = now
+        if any(s is None for s in entry[0]):
+            return "+OK"
+        del xfers[xfer_id]
+        blob = b"".join(entry[0])
+    return replication.apply_records(server.engine, blob)
 
 
 @register("REPLFLUSH")

@@ -1,0 +1,190 @@
+"""Regression tests closing the round-5 advisor findings (ADVICE.md):
+LZ4 frame endianness, the two geo fixes, and REPLPUSHSEG staging eviction.
+(The replication delta-validation finding is covered in
+``test_replication_delta.py::test_shape_divergence_raises_and_full_ships``.)
+"""
+import pickle
+import threading
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.client.codec import JsonCodec, Lz4Codec, StringCodec
+from redisson_tpu.client.objects.geo import Geo, GeoSearchArgs
+from redisson_tpu.harness import _exec, free_port
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server import replication
+from redisson_tpu.server.server import ServerThread
+from redisson_tpu.utils import lz4block
+
+
+# -- Lz4Codec frame endianness (ADVICE r5 medium) -----------------------------
+
+def test_lz4_frame_length_header_is_big_endian():
+    """LZ4Codec.java writes the uncompressed length with Netty
+    ByteBuf.writeInt — big-endian.  Byte-level wire vector: a 10-byte
+    literals-only payload frames as 00 00 00 0A | A0 | payload."""
+    c = Lz4Codec(StringCodec())
+    frame = c.encode("0123456789")
+    assert frame[:4] == b"\x00\x00\x00\x0a"          # length, network order
+    assert frame[4] == 0xA0                          # token: 10 literals
+    assert frame[5:] == b"0123456789"
+    assert c.decode(frame) == "0123456789"
+
+
+def test_lz4_frame_decodes_reference_written_value():
+    """A frame assembled EXACTLY the way the reference writes it (writeInt
+    big-endian + LZ4 block) must decode."""
+    raw = StringCodec().encode("wire-compat " * 40)
+    reference_frame = len(raw).to_bytes(4, "big") + lz4block.compress(raw)
+    assert Lz4Codec(StringCodec()).decode(reference_frame) == "wire-compat " * 40
+
+
+def test_lz4_decodes_legacy_little_endian_frames():
+    """At-rest compat: values written before the endianness fix carried the
+    length little-endian; decode retries LE when the BE size check fails
+    (exactly one byte order satisfies the decompressor)."""
+    raw = StringCodec().encode("legacy payload " * 30)
+    legacy_frame = len(raw).to_bytes(4, "little") + lz4block.compress(raw)
+    assert Lz4Codec(StringCodec()).decode(legacy_frame) == "legacy payload " * 30
+
+
+def test_lz4_roundtrip_still_holds_for_structures():
+    c = Lz4Codec(JsonCodec())
+    v = {"k": list(range(64)), "s": "y" * 300}
+    assert c.decode(c.encode(v)) == v
+
+
+# -- geo fixes (ADVICE r5 low x2) ---------------------------------------------
+
+@pytest.fixture()
+def client():
+    c = redisson_tpu.create()
+    yield c
+    c.shutdown()
+
+
+def _seed_geo(client, name="advice:geo"):
+    g = client.get_geo(name)
+    g.add(13.361389, 38.115556, "Palermo")
+    g.add(15.087269, 37.502669, "Catania")
+    g.add(2.349014, 48.864716, "Paris")
+    return g
+
+
+def test_search_with_position_accepts_keywords_again(client):
+    g = _seed_geo(client, "advice:geo:kw")
+    positional = g.search_with_position(15, 37, 200, "km")
+    keyword = g.search_with_position(lon=15, lat=37, radius=200, unit="km")
+    assert keyword == positional
+    assert set(keyword) == {"Palermo", "Catania"}
+    # mixed positional + keyword tail works too
+    mixed = g.search_with_position(15, 37, radius=200, unit="km")
+    assert mixed == positional
+    with pytest.raises(TypeError, match="radius"):
+        g.search_with_position(15, 37)
+
+
+def test_store_search_to_skips_concurrently_removed_members(client, monkeypatch):
+    g = _seed_geo(client, "advice:geo:race")
+    orig = Geo._eval_args
+
+    def eval_then_lose_member(self, args):
+        pairs = orig(self, args)
+        # simulate a concurrent removal landing between evaluation and the
+        # locked copy: Catania vanishes from the source
+        rec = self._engine.store.get(self._name)
+        rec.host.pop(self._e("Catania"), None)
+        return pairs
+
+    monkeypatch.setattr(Geo, "_eval_args", eval_then_lose_member)
+    args = GeoSearchArgs.from_coords(15, 37).radius(200, "km")
+    # old code: KeyError mid-copy after dest was already cleared
+    stored = g.store_search_to("advice:geo:dest", args)
+    assert stored == 1  # Palermo survived; Catania skipped, not raised
+    dest = client.get_geo("advice:geo:dest")
+    assert dest.read_all() == ["Palermo"]
+
+
+# -- REPLPUSHSEG staging eviction (ADVICE r5 low) -----------------------------
+
+def _seg_frames(xfer_id, nsegs=2):
+    """A valid empty replication payload split into `nsegs` chunks."""
+    blob = pickle.dumps({"format": 1, "records": []}, protocol=4)
+    per = -(-len(blob) // nsegs)
+    return [
+        ("REPLPUSHSEG", xfer_id, i, nsegs, blob[i * per:(i + 1) * per])
+        for i in range(nsegs)
+    ]
+
+
+def test_concurrent_transfers_beyond_old_cap_all_complete():
+    """Six interleaved in-progress transfers (the old insertion-order cap
+    of 4 dropped the first two) must ALL reassemble and apply."""
+    st = ServerThread(port=free_port()).start()
+    try:
+        with st.client() as c:
+            heads, tails = [], []
+            for i in range(6):
+                h, t = _seg_frames(f"xfer-{i}")
+                heads.append(h)
+                tails.append(t)
+            for h in heads:          # stage seq 0 of every transfer first
+                assert _exec(c, *h) == b"OK" or True
+            for t in tails:          # then complete them all
+                assert _exec(c, *t) == 0  # empty payload applies 0 records
+        assert not st.server._repl_xfers  # staging fully drained
+    finally:
+        st.stop()
+
+
+def test_stale_transfer_evicted_fresh_transfer_kept():
+    st = ServerThread(port=free_port()).start()
+    try:
+        with st.client() as c:
+            h_stale, t_stale = _seg_frames("xfer-stale")
+            h_fresh, t_fresh = _seg_frames("xfer-fresh")
+            _exec(c, *h_stale)
+            _exec(c, *h_fresh)
+            # age ONLY the stale transfer past the staleness window
+            from redisson_tpu.server.verbs.admin import REPL_XFER_STALE_S
+
+            with st.server._repl_xfers_lock:
+                st.server._repl_xfers["xfer-stale"][1] -= REPL_XFER_STALE_S + 1
+            # a new transfer staging triggers the staleness sweep
+            h_new, t_new = _seg_frames("xfer-new")
+            _exec(c, *h_new)
+            # stale one is gone; its continuation fails loudly
+            with pytest.raises(RespError, match="unknown replication transfer"):
+                _exec(c, *t_stale)
+            # the fresh in-progress transfer was NOT spuriously dropped
+            assert _exec(c, *t_fresh) == 0
+            assert _exec(c, *t_new) == 0
+    finally:
+        st.stop()
+
+
+def test_transfer_staging_is_thread_safe_under_parallel_pushes():
+    """Concurrent REPLPUSHSEG streams from several sources (replication
+    racing IMPORTRECORDS-scale reshards) reassemble without corruption."""
+    st = ServerThread(port=free_port()).start()
+    errs = []
+
+    def push(i):
+        try:
+            with st.client() as c:
+                for frame in _seg_frames(f"par-{i}", nsegs=4):
+                    _exec(c, *frame)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=push, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs, errs[:3]
+        assert not st.server._repl_xfers
+    finally:
+        st.stop()

@@ -1,0 +1,300 @@
+"""Deterministic fault-injection smoke tier (ISSUE 1 tentpole): one fast
+test per fault type, each proving the fault flows through the REAL failure
+path — retry machinery, pool discard, detector feeds — not around it.
+
+The endurance tier (minutes of mixed workload across repeated cycles) is
+``tests/test_soak.py`` (``-m slow``); these are its tier-1 contracts.
+"""
+import threading
+import time
+
+import pytest
+
+from redisson_tpu.chaos.census import ResourceCensus
+from redisson_tpu.chaos.faults import Fault, FaultPlane, FaultSchedule
+from redisson_tpu.net.client import (
+    CommandTimeoutError,
+    ConnectionError_,
+    NodeClient,
+)
+from redisson_tpu.net.detectors import (
+    FailedCommandsDetector,
+    FailedCommandsTimeoutDetector,
+    FailedConnectionDetector,
+)
+from redisson_tpu.server.server import ServerThread
+from redisson_tpu.utils.metrics import MetricsRegistry
+
+
+@pytest.fixture(scope="module")
+def server():
+    with ServerThread(port=0) as st:
+        yield st
+
+
+def _client(server, **kw):
+    kw.setdefault("ping_interval", 0)
+    kw.setdefault("timeout", 2.0)
+    kw.setdefault("retry_attempts", 2)
+    kw.setdefault("retry_interval", 0.05)
+    kw.setdefault("connect_timeout", 5.0)
+    return NodeClient(f"127.0.0.1:{server.port}", **kw)
+
+
+# -- schedule determinism -----------------------------------------------------
+
+def test_schedule_is_seed_deterministic():
+    a = FaultSchedule(42).add_random("drop", n=5, window=100)
+    b = FaultSchedule(42).add_random("drop", n=5, window=100)
+    assert [(f.kind, f.after) for f in a.faults] == [
+        (f.kind, f.after) for f in b.faults
+    ]
+    c = FaultSchedule(43).add_random("drop", n=5, window=100)
+    assert [f.after for f in a.faults] != [f.after for f in c.faults]
+
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError):
+        Fault("sigsegv")
+
+
+def test_plane_counts_events_and_hits(server):
+    sched = FaultSchedule(0)
+    rule = sched.add("delay", port=server.port, after=1, count=2, delay_s=0.0)
+    plane = sched.plane()
+    nc = _client(server)
+    try:
+        with plane.active():
+            for _ in range(4):
+                nc.execute("PING")
+        assert rule.hits == 2
+        assert plane.injected == {"delay": 2}
+        assert plane.events("send", server.port) >= 4
+    finally:
+        nc.close()
+
+
+# -- one smoke per fault type -------------------------------------------------
+
+def test_drop_feeds_command_failed_detector(server):
+    det = FailedCommandsDetector(threshold=1, window_s=60.0)
+    nc = _client(server, detector=det)
+    sched = FaultSchedule(0)
+    sched.add("drop", port=server.port, after=0, count=1)
+    plane = sched.plane()
+    try:
+        with plane.active():
+            assert nc.execute("PING") in (b"PONG", "PONG")  # retry recovers
+        assert plane.injected == {"drop": 1}
+        assert det.is_node_failed()  # the drop was COUNTED, not bypassed
+    finally:
+        nc.close()
+
+
+def test_delay_injects_bounded_latency(server):
+    nc = _client(server)
+    sched = FaultSchedule(0)
+    sched.add("delay", port=server.port, after=0, count=1, delay_s=0.3)
+    plane = sched.plane()
+    try:
+        with plane.active():
+            t0 = time.monotonic()
+            assert nc.execute("PING") in (b"PONG", "PONG")
+            assert time.monotonic() - t0 >= 0.3
+    finally:
+        nc.close()
+
+
+def test_truncate_mid_reply_fails_loudly_then_recovers(server):
+    det = FailedCommandsDetector(threshold=1, window_s=60.0)
+    nc = _client(server, detector=det)
+    sched = FaultSchedule(0)
+    sched.add("truncate", port=server.port, after=0, count=1)
+    plane = sched.plane()
+    try:
+        with plane.active():
+            # partial frame then a dead socket -> discard + retry on a fresh
+            # connection; the reply is never half-parsed into a wrong value
+            assert nc.execute("ECHO", b"payload-123") == b"payload-123"
+        assert plane.injected == {"truncate": 1}
+        assert det.is_node_failed()
+    finally:
+        nc.close()
+
+
+def test_refuse_connect_feeds_connection_detector(server):
+    det = FailedConnectionDetector(threshold=1, window_s=60.0)
+    nc = _client(server, detector=det, retry_attempts=1, pool_size=2, min_idle=0)
+    sched = FaultSchedule(0)
+    sched.add("refuse_connect", after=0, count=100)
+    plane = sched.plane()
+    try:
+        with plane.active():
+            with pytest.raises((ConnectionError_, OSError)):
+                nc.execute("PING")
+        assert plane.injected["refuse_connect"] >= 1
+        assert det.is_node_failed()
+        # chaos lifted: the same client reconnects and serves
+        assert nc.execute("PING") in (b"PONG", "PONG")
+    finally:
+        nc.close()
+
+
+def test_partition_in_times_out_and_feeds_timeout_detector(server):
+    det = FailedCommandsTimeoutDetector(threshold=1, window_s=60.0)
+    nc = _client(server, detector=det)
+    sched = FaultSchedule(0)
+    sched.add("partition_in", port=server.port, after=0, count=50)
+    plane = sched.plane()
+    try:
+        with plane.active():
+            with pytest.raises(CommandTimeoutError):
+                nc.execute("PING", timeout=0.4, retry_attempts=0)
+        assert plane.injected["partition_in"] >= 1
+        assert det.is_node_failed()
+        assert nc.execute("PING") in (b"PONG", "PONG")
+    finally:
+        nc.close()
+
+
+def test_partition_out_times_out_without_transmitting(server):
+    nc = _client(server)
+    sched = FaultSchedule(0)
+    sched.add("partition_out", port=server.port, after=0, count=1)
+    plane = sched.plane()
+    try:
+        before = server.server.stats["commands"]
+        with plane.active():
+            with pytest.raises(CommandTimeoutError):
+                nc.execute("PING", timeout=0.4, retry_attempts=0)
+        # the frame never reached the server (one-way partition, outbound)
+        assert server.server.stats["commands"] == before
+        assert nc.execute("PING") in (b"PONG", "PONG")
+    finally:
+        nc.close()
+
+
+def test_pause_node_is_hung_but_accepting(server):
+    """SIGSTOP analog: connections stay open, replies stop — only the
+    command-timeout detector class can catch this failure mode."""
+    det = FailedCommandsTimeoutDetector(threshold=1, window_s=60.0)
+    nc = _client(server, detector=det)
+    try:
+        server.server.pause()
+        assert server.server.paused
+        with pytest.raises(CommandTimeoutError):
+            nc.execute("PING", timeout=0.5, retry_attempts=0)
+        assert det.is_node_failed()
+    finally:
+        server.server.resume()
+    assert nc.execute("PING") in (b"PONG", "PONG")
+    nc.close()
+
+
+def test_replication_stall_and_resume():
+    from redisson_tpu.harness import _exec, free_port
+
+    master = ServerThread(port=free_port()).start()
+    replica = ServerThread(port=free_port()).start()
+    try:
+        with replica.client() as c:
+            _exec(c, "REPLICAOF", master.server.host, master.server.port,
+                  timeout=120.0)
+        src = master.server.replication_source()
+        from redisson_tpu.client.remote import RemoteRedisson
+
+        r = RemoteRedisson(f"127.0.0.1:{master.server.port}", timeout=30.0)
+        try:
+            src.stall()
+            r.get_bucket("stall:k").set(1)
+            assert src.flush() == 0  # the stream ships NOTHING while stalled
+            assert replica.server.engine.store.get_unguarded("stall:k") is None
+            src.resume()
+            assert src.flush() > 0
+            assert replica.server.engine.store.get_unguarded("stall:k") is not None
+        finally:
+            r.shutdown()
+    finally:
+        replica.stop()
+        master.stop()
+
+
+def test_coordinator_probe_threads_exempt_by_default(server):
+    """The failure detector's OWN probes are ground truth: a plane must not
+    fault them by default (a chaos-faulted ping stream declares healthy
+    masters dead — unplanned failover, lost async tail)."""
+    sched = FaultSchedule(0)
+    sched.add("drop", port=server.port, after=0, count=1000)
+    plane = sched.plane()
+    nc = _client(server, retry_attempts=0)
+    result = {}
+
+    def probe():
+        result["reply"] = nc.execute("PING")
+
+    try:
+        with plane.active():
+            t = threading.Thread(target=probe, name="rtpu-failover-0")
+            t.start()
+            t.join(timeout=10)
+            assert result.get("reply") in (b"PONG", "PONG")
+            assert plane.injected == {}  # nothing injected, nothing counted
+            # a data-plane thread IS faulted by the same rule
+            with pytest.raises((ConnectionError_, OSError)):
+                nc.execute("PING")
+        assert plane.injected == {"drop": 1}
+    finally:
+        nc.close()
+
+
+# -- census ------------------------------------------------------------------
+
+def test_census_snapshot_diff_and_gauges(server):
+    census = ResourceCensus()
+    census.track_server("srv", server.server)
+    census.track_engine("srv.engine", server.server.engine)
+    nc = _client(server)
+    try:
+        nc.execute("SET", "census:k", "v")
+        before = census.snapshot()
+        assert before["srv.engine.record_locks"] == 0
+        assert before["srv.repl_staged_xfers"] == 0
+        assert "srv.engine.keys" in before
+        nc.execute("SET", "census:k2", "v")
+        after = census.snapshot()
+        moved = census.diff(before, after)
+        assert "srv.engine.keys" in moved
+        # the ignore pattern silences legitimate growth
+        census.assert_flat(before, after, ignore=("*.keys", "*.wait_entries",
+                                                  "*.connections"))
+        # live gauges ride the ordinary MetricsRegistry -> Prometheus path
+        reg = MetricsRegistry()
+        census.register(reg)
+        text = reg.prometheus_text()
+        assert "census_srv_engine_record_locks" in text
+    finally:
+        nc.close()
+
+
+def test_census_tracks_client_pools(server):
+    census = ResourceCensus()
+    nc = _client(server)
+
+    class Facade:  # minimal RemoteRedisson shape: one .node
+        node = nc
+
+    try:
+        census.track_client("cli", Facade())
+        nc.execute("PING")
+        snap = census.snapshot()
+        assert snap["cli.node_clients"] == 1
+        assert snap["cli.conn_in_use"] == 0  # released back at quiesce
+        assert snap["cli.conn_idle"] >= 1
+    finally:
+        nc.close()
+
+
+def test_census_assert_flat_raises_with_detail():
+    census = ResourceCensus()
+    with pytest.raises(AssertionError, match="x.locks: 0.0 -> 2.0"):
+        census.assert_flat({"x.locks": 0.0}, {"x.locks": 2.0}, context="t")

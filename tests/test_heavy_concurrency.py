@@ -1,6 +1,10 @@
 """Heavy multi-instance concurrency battery (BaseConcurrentTest /
 RedissonLockHeavyTest role, SURVEY §4.3): many threads across SEVERAL client
 instances hammer the same objects; invariants must hold exactly.
+
+Two scales per test: the fast tier-1 shape (8 threads x 25 rounds) and the
+``-m slow`` endurance shape (16 threads x 100 rounds — the
+RedissonLockHeavyTest fan-out magnitude, ISSUE 1 satellite).
 """
 import threading
 import time
@@ -11,11 +15,18 @@ import redisson_tpu
 from redisson_tpu.client.remote import RemoteRedisson
 from redisson_tpu.server.server import ServerThread
 
-THREADS = 8
-ROUNDS = 25
+SCALES = [
+    pytest.param((8, 25), id="8x25"),
+    pytest.param((16, 100), id="16x100", marks=pytest.mark.slow),
+]
 
 
-def fan_out(n, fn):
+@pytest.fixture(params=SCALES)
+def scale(request):
+    return request.param
+
+
+def fan_out(n, fn, timeout=120.0):
     errs = []
 
     def run(i):
@@ -28,7 +39,7 @@ def fan_out(n, fn):
     for t in threads:
         t.start()
     for t in threads:
-        t.join(timeout=60.0)
+        t.join(timeout=timeout)
     assert not errs, errs[:3]
     assert not any(t.is_alive() for t in threads), "worker wedged"
 
@@ -47,75 +58,84 @@ def clients(server):
         c.shutdown()
 
 
-def test_lock_mutual_exclusion_under_load(clients):
+def test_lock_mutual_exclusion_under_load(clients, scale):
     """N threads x M clients increment a plain (non-atomic) map value under
     a distributed lock: the final count proves strict mutual exclusion."""
+    threads, rounds = scale
+    tag = f"{threads}x{rounds}"
     counter = {"v": 0}
 
     def work(i):
         c = clients[i % len(clients)]
-        lk = c.get_lock("heavy-lock")
-        for _ in range(ROUNDS):
+        lk = c.get_lock(f"heavy-lock-{tag}")
+        for _ in range(rounds):
             lk.lock()
             try:
-                m = c.get_map("heavy-lock-map")
+                m = c.get_map(f"heavy-lock-map-{tag}")
                 cur = m.get("n") or 0
                 m.fast_put("n", cur + 1)
                 counter["v"] += 1  # host-side mirror under the same lock
             finally:
                 lk.unlock()
 
-    fan_out(THREADS, work)
-    assert clients[0].get_map("heavy-lock-map").get("n") == THREADS * ROUNDS
-    assert counter["v"] == THREADS * ROUNDS
+    fan_out(threads, work)
+    assert clients[0].get_map(f"heavy-lock-map-{tag}").get("n") == threads * rounds
+    assert counter["v"] == threads * rounds
 
 
-def test_atomic_long_is_linearizable(clients):
+def test_atomic_long_is_linearizable(clients, scale):
+    threads, rounds = scale
+    tag = f"{threads}x{rounds}"
+
     def work(i):
-        al = clients[i % len(clients)].get_atomic_long("heavy-al")
-        for _ in range(ROUNDS * 4):
+        al = clients[i % len(clients)].get_atomic_long(f"heavy-al-{tag}")
+        for _ in range(rounds * 4):
             al.increment_and_get()
 
-    fan_out(THREADS, work)
-    assert clients[0].get_atomic_long("heavy-al").get() == THREADS * ROUNDS * 4
+    fan_out(threads, work)
+    assert clients[0].get_atomic_long(f"heavy-al-{tag}").get() == threads * rounds * 4
 
 
-def test_semaphore_never_overcommits(clients):
+def test_semaphore_never_overcommits(clients, scale):
+    threads, rounds = scale
+    tag = f"{threads}x{rounds}"
     permits = 3
-    sem0 = clients[0].get_semaphore("heavy-sem")
+    sem0 = clients[0].get_semaphore(f"heavy-sem-{tag}")
     assert sem0.try_set_permits(permits)
     inside = []
     peak = []
 
     def work(i):
         c = clients[i % len(clients)]
-        sem = c.get_semaphore("heavy-sem")
-        for _ in range(6):
-            if sem.try_acquire(wait_time=10.0):
+        sem = c.get_semaphore(f"heavy-sem-{tag}")
+        for _ in range(max(6, rounds // 8)):
+            if sem.try_acquire(wait_time=20.0):
                 inside.append(1)
                 peak.append(len(inside))
                 time.sleep(0.01)
                 inside.pop()
                 sem.release()
 
-    fan_out(THREADS, work)
+    fan_out(threads, work)
     assert max(peak) <= permits
     assert sem0.available_permits() == permits
 
 
-def test_queue_every_element_delivered_once(clients):
-    total = THREADS * ROUNDS
+def test_queue_every_element_delivered_once(clients, scale):
+    threads, rounds = scale
+    tag = f"{threads}x{rounds}"
+    total = threads * rounds
     produced = [f"e{i}" for i in range(total)]
     consumed: list = []
     consumed_lock = threading.Lock()
 
     def producer(i):
-        q = clients[i % len(clients)].get_blocking_queue("heavy-q")
-        for j in range(ROUNDS):
-            q.offer(f"e{i * ROUNDS + j}")
+        q = clients[i % len(clients)].get_blocking_queue(f"heavy-q-{tag}")
+        for j in range(rounds):
+            q.offer(f"e{i * rounds + j}")
 
     def consumer(i):
-        q = clients[i % len(clients)].get_blocking_queue("heavy-q")
+        q = clients[i % len(clients)].get_blocking_queue(f"heavy-q-{tag}")
         while True:
             v = q.poll_blocking(1.0)
             if v is None:
@@ -123,40 +143,43 @@ def test_queue_every_element_delivered_once(clients):
             with consumed_lock:
                 consumed.append(v)
 
-    producers = [threading.Thread(target=producer, args=(i,)) for i in range(THREADS)]
+    producers = [threading.Thread(target=producer, args=(i,)) for i in range(threads)]
     consumers = [threading.Thread(target=consumer, args=(i,)) for i in range(4)]
     for t in producers + consumers:
         t.start()
     for t in producers:
-        t.join(timeout=60.0)
+        t.join(timeout=120.0)
     for t in consumers:
-        t.join(timeout=60.0)
+        t.join(timeout=120.0)
     assert sorted(consumed) == sorted(produced)  # exactly-once, none lost
 
 
-def test_map_put_if_absent_single_winner(clients):
+def test_map_put_if_absent_single_winner(clients, scale):
+    threads, rounds = scale
+    tag = f"{threads}x{rounds}"
     winners: list = []
     lock = threading.Lock()
 
     def work(i):
-        m = clients[i % len(clients)].get_map("heavy-pia")
-        for r in range(ROUNDS):
+        m = clients[i % len(clients)].get_map(f"heavy-pia-{tag}")
+        for r in range(rounds):
             prev = m.put_if_absent(f"slot{r}", f"t{i}")
             if prev is None:
                 with lock:
                     winners.append((r, i))
 
-    fan_out(THREADS, work)
+    fan_out(threads, work)
     # exactly one winner per slot
-    assert len(winners) == ROUNDS
-    assert len({r for r, _ in winners}) == ROUNDS
+    assert len(winners) == rounds
+    assert len({r for r, _ in winners}) == rounds
 
 
-def test_embedded_count_down_latch_fan_in():
+def test_embedded_count_down_latch_fan_in(scale):
+    threads, _rounds = scale
     c = redisson_tpu.create()
     try:
         latch = c.get_count_down_latch("heavy-cdl")
-        latch.try_set_count(THREADS)
+        latch.try_set_count(threads)
         released = threading.Event()
 
         def waiter():
@@ -170,7 +193,7 @@ def test_embedded_count_down_latch_fan_in():
             time.sleep(0.01 * i)
             latch.count_down()
 
-        fan_out(THREADS, work)
+        fan_out(threads, work)
         assert released.wait(10.0)
         assert latch.get_count() == 0
     finally:

@@ -144,6 +144,77 @@ def test_delta_base_mismatch_recovers_with_full_ship(pair):
         r.shutdown()
 
 
+def test_shape_divergence_raises_and_full_ships(pair):
+    """ADVICE r5 medium: a replica whose plane was re-padded (shape change
+    WITHOUT a version bump — the adapt_plane signature) must reject the
+    block delta loudly instead of scattering at wrong row-major offsets;
+    the master then falls back to a full ship and the replica converges to
+    EXACTLY the master's content — never silent corruption."""
+    import jax.numpy as jnp
+
+    master, replica = pair
+    r = RemoteRedisson(_addr(master), timeout=60.0)
+    try:
+        bf = r.get_bloom_filter("bf:diverge")
+        bf.try_init(1_000_000, 0.01)
+        bf.add_all([f"a{i}" for i in range(200)])
+        src = master.server.replication_source()
+        src.flush()
+        bf.add_all([f"b{i}" for i in range(50)])
+        src.flush()
+        assert src.stats["records_delta"] >= 1  # the delta path is live
+        # fault-inject the divergence: re-pad the replica's plane
+        rec = replica.server.engine.store.get_unguarded("bf:diverge")
+        akey = next(iter(rec.arrays))
+        pad = [(0, 0)] * (rec.arrays[akey].ndim - 1) + [(0, 256)]
+        rec.arrays[akey] = jnp.pad(rec.arrays[akey], pad)
+        bf.add_all([f"c{i}" for i in range(50)])
+        master_ver = master.server.engine.store.get_unguarded("bf:diverge").version
+        n_full = src.stats["records_full"]
+        src.flush()  # delta REJECTED (shape mismatch raises on the replica)
+        assert (
+            replica.server.engine.store.get_unguarded("bf:diverge").version
+            < master_ver
+        ), "divergent delta must not have been applied"
+        src.flush()  # retry full-ships
+        assert src.stats["records_full"] > n_full
+        mrec = master.server.engine.store.get_unguarded("bf:diverge")
+        rrec = replica.server.engine.store.get_unguarded("bf:diverge")
+        assert rrec.version == mrec.version
+        np.testing.assert_array_equal(
+            np.asarray(mrec.arrays[akey]), np.asarray(rrec.arrays[akey])
+        )
+    finally:
+        r.shutdown()
+
+
+def test_out_of_range_delta_indices_rejected():
+    """idx.max() >= nblocks raises before any scatter (JAX would silently
+    drop the OOB rows and corrupt nothing-visibly)."""
+    import numpy as np_
+
+    cur = np_.zeros(65536, np_.uint32)
+    be = replication._block_elems(np_.dtype(np_.uint32))
+    nblocks = -(-cur.size // be)
+    bad = {
+        "idx": np_.asarray([0, nblocks + 3], np_.int32),
+        "data": np_.zeros((2, be), np_.uint32),
+        "shape": (cur.size,),
+        "dtype": "uint32",
+        "nblocks": nblocks,
+    }
+    with pytest.raises(ValueError, match="block index out of range"):
+        replication._validate_array_delta("r", "a", cur, bad)
+    wrong_count = dict(bad, idx=np_.asarray([0], np_.int32),
+                       data=np_.zeros((1, be), np_.uint32), nblocks=nblocks + 9)
+    with pytest.raises(ValueError, match="block-count mismatch"):
+        replication._validate_array_delta("r", "a", cur, wrong_count)
+    wrong_dtype = dict(bad, idx=np_.asarray([0], np_.int32),
+                       data=np_.zeros((1, be), np_.uint32), dtype="float32")
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        replication._validate_array_delta("r", "a", cur, wrong_dtype)
+
+
 def test_oversized_blob_ships_in_segments(pair, monkeypatch):
     """Blobs past SEGMENT_BYTES ride REPLPUSHSEG slices (a 10M-key plane is
     ~95MB; one sendall of that outlives socket timeouts)."""

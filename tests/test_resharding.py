@@ -159,6 +159,35 @@ def test_reshard_under_traffic_zero_lost_probes(client):
         assert got.all(), f"lost probes after reshard: {int((~got).sum())}"
 
 
+def test_repeated_reshard_kernel_cache_does_not_grow(client):
+    """Endurance gap (ISSUE 1 satellite): the epoch-keyed kernel cache must
+    stay BOUNDED across N reshard cycles — every reshard bumps the epoch
+    and drops prior-epoch builds, so N cycles cost N compiles but never N
+    retained kernel sets (and no stale-epoch entry may ever linger)."""
+    mgr = MeshManager.of(client._engine)
+    rng = np.random.default_rng(9)
+    T = 8
+    bf = client.get_sharded_bloom_filter_array("rs:cachegrowth")
+    assert bf.try_init(T, expected_insertions=50_000, false_probability=0.01)
+    keys = _keys(rng, 256)
+    tenant = (np.arange(256) % T).astype(np.int32)
+    assert bf.add_each(tenant, keys).all()
+
+    sizes = []
+    for _ in range(5):  # 5 full 4 -> 8 -> 4 roundtrips = 10 epochs
+        for dp, shard in ((1, 8), (2, 4)):
+            mgr.reshard(dp=dp, shard=shard)
+            assert bf.contains_each(tenant, keys).all()
+            with mgr._guard:
+                assert all(k[0] == mgr._epoch for k in mgr._kernels), (
+                    "kernel-cache entry from a PAST epoch survived a reshard"
+                )
+        with mgr._guard:
+            sizes.append(len(mgr._kernels))
+    # steady state: every roundtrip ends with the same entry count
+    assert len(set(sizes)) == 1, f"kernel cache grew across reshard cycles: {sizes}"
+
+
 def test_reshard_validates_geometry(client):
     mgr = MeshManager.of(client._engine)
     with pytest.raises(ValueError):

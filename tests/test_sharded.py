@@ -74,7 +74,7 @@ def test_sharded_hll(mesh):
     T, p = 8, hll_ops.DEFAULT_P
     add, estimate = make_sharded_hll_kernels(mesh, p=p, n_rows=T)
     regs = jax.device_put(
-        jnp.zeros((T, hll_ops.m_of(p)), jnp.uint8), jax.NamedSharding(mesh, jax.P("shard", None))
+        jnp.zeros((T, hll_ops.m_of(p)), jnp.uint8), jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("shard", None))
     )
     B = 1 << 15
     rng = np.random.default_rng(1)

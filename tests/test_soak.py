@@ -1,0 +1,65 @@
+"""Endurance/soak tier (``-m slow``): minutes-long mixed workload across
+repeated master-kill -> failover -> reshard (4 -> 8 -> 4) cycles, asserting
+zero acked-write loss and a flat ResourceCensus at every quiesce point
+(ISSUE 1 acceptance: >= 3 full cycles).
+
+A fast no-kill smoke of the same harness stays in tier 1 so the soak
+machinery itself cannot rot between slow-tier runs.
+"""
+import pytest
+
+from redisson_tpu.chaos.faults import FaultSchedule
+from redisson_tpu.chaos.soak import SoakConfig, SoakHarness
+
+
+def test_soak_workload_only_flat_census():
+    """Tier-1 smoke: one workload+reshard cycle, no kill — proves the
+    harness end to end (census drains, bloom survives reshard) in seconds."""
+    report = SoakHarness(SoakConfig(
+        cycles=1, seconds_per_phase=0.8, kill=False, writer_threads=2,
+        faults_per_cycle=2, seed=11,
+    )).run()
+    assert report.cycles_completed == 1
+    assert report.acked_writes > 0
+    assert report.lock_max_concurrency <= 1
+    assert len(report.census) == 1
+
+
+@pytest.mark.slow
+def test_soak_three_kill_failover_reshard_cycles():
+    """The ISSUE 1 acceptance run: >= 3 full kill -> failover -> reshard
+    cycles, zero acked-write loss, flat census at every quiesce point."""
+    report = SoakHarness(SoakConfig(
+        cycles=3, seconds_per_phase=2.0, seed=0,
+    )).run()
+    assert report.cycles_completed == 3
+    assert len(report.failovers) == 3
+    assert report.verified_writes > 0          # acked writes re-read exactly
+    assert report.bloom_keys_verified > 0      # acked adds survive reshards
+    assert len(report.census) == 3             # every quiesce point asserted
+
+
+@pytest.mark.slow
+def test_soak_different_seed_still_converges():
+    """Chaos content is seed-parametric; invariants are not."""
+    report = SoakHarness(SoakConfig(
+        cycles=2, seconds_per_phase=1.5, seed=1234,
+    )).run()
+    assert report.cycles_completed == 2
+    assert report.lock_max_concurrency <= 1
+
+
+@pytest.mark.slow
+def test_soak_with_heavier_fault_schedule():
+    """A denser transport-fault program (including outbound partitions)
+    stays inside the error budget and still loses nothing."""
+    cfg = SoakConfig(cycles=2, seconds_per_phase=2.0, seed=7)
+    sched = FaultSchedule(cfg.seed)
+    sched.add_random("delay", n=16, window=600, delay_s=0.02)
+    sched.add_random("drop", n=8, window=600)
+    sched.add_random("partition_in", n=4, window=600)
+    sched.add_random("partition_out", n=4, window=600)
+    sched.add_random("truncate", n=4, window=600)
+    report = SoakHarness(cfg, schedule=sched).run()
+    assert report.cycles_completed == 2
+    assert sum(report.injected_faults.values()) > 0

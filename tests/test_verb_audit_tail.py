@@ -114,9 +114,15 @@ def test_ft_synonyms_expand_queries(st):
 
 def test_verb_audit_script_reports_clean(tmp_path):
     """The living artifact itself: zero UNEXPLAINED verbs."""
+    import pathlib
     import subprocess
     import sys
 
+    ref = pathlib.Path(
+        "/root/reference/redisson/src/main/java/org/redisson/client/protocol/RedisCommands.java"
+    )
+    if not ref.exists():
+        pytest.skip("reference Java checkout not present in this environment")
     p = subprocess.run(
         [sys.executable, "tools/verb_audit.py"],
         capture_output=True, text=True, cwd="/root/repo",

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""10-second soak for local sanity: one full chaos cycle (workload under
+injected transport faults -> master kill -> automatic failover -> recovery
+-> mesh reshard 4 -> 8 -> 4) with the same zero-acked-write-loss and
+flat-census assertions the slow endurance tier enforces.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/soak_smoke.py [--cycles N] [--seed S]
+                                                 [--phase SECONDS] [--no-kill]
+
+Exit code 0 = every assertion held; the report summary prints either way.
+"""
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cycles", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--phase", type=float, default=1.0,
+                    help="seconds of workload per phase")
+    ap.add_argument("--no-kill", action="store_true",
+                    help="workload + reshard only (no master kill)")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from redisson_tpu.chaos.soak import SoakConfig, SoakHarness
+
+    cfg = SoakConfig(
+        cycles=args.cycles,
+        seconds_per_phase=args.phase,
+        seed=args.seed,
+        kill=not args.no_kill,
+    )
+    harness = SoakHarness(cfg)
+    try:
+        report = harness.run()
+    except AssertionError as e:
+        print(f"SOAK FAILED: {e}")
+        print(harness.report.summary())
+        return 1
+    print(report.summary())
+    print("SOAK OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
