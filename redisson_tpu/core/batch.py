@@ -115,17 +115,49 @@ class Batch:
             groups.setdefault(op.group, []).append(op)
             order.append(op)
 
+        def run_one(group, ops):
+            try:
+                _DISPATCH[group[1]](self._engine, group, ops)
+            except Exception as e:  # noqa: BLE001 - failures land on futures
+                for op in ops:
+                    if not op.future.done():
+                        op.future._fail(e)
+
         def run_groups():
             # groups run in first-submission order of their first op, so a
             # same-name object queued under two op kinds sees its earlier-
-            # submitted group applied first (documented ordering contract)
-            for group, ops in groups.items():
-                try:
-                    _DISPATCH[group[1]](self._engine, group, ops)
-                except Exception as e:  # noqa: BLE001 - failures land on futures
-                    for op in ops:
-                        if not op.future.done():
-                            op.future._fail(e)
+            # submitted group applied first (documented ordering contract).
+            # The coalescing plane fuses CONSECUTIVE same-verb bloom groups
+            # (different filters, one stacked-bank dispatch) and the
+            # add-then-contains hot pair on one filter (one fused program) —
+            # run boundaries never cross a verb change, so the ordering
+            # contract is untouched; ineligible runs fall back per group.
+            items = list(groups.items())
+            i = 0
+            while i < len(items):
+                group, ops = items[i]
+                verb = group[1]
+                if verb in ("bloom.add", "bloom.contains"):
+                    j = i + 1
+                    while j < len(items) and items[j][0][1] == verb:
+                        j += 1
+                    if j - i >= 2 and _try_fused_run(
+                        self._engine, verb, items[i:j]
+                    ):
+                        i = j
+                        continue
+                    if (
+                        verb == "bloom.add"
+                        and j == i + 1
+                        and j < len(items)
+                        and items[j][0][1] == "bloom.contains"
+                        and items[j][0][0] == group[0]
+                        and _try_fused_pair(self._engine, items[i], items[j])
+                    ):
+                        i = j + 1
+                        continue
+                run_one(group, ops)
+                i += 1
 
         if self._atomic:
             with self._engine.locked_many({g[0] for g in groups}):
@@ -137,10 +169,110 @@ class Batch:
         return BatchResult([op.future.get() for op in order])
 
 
+# -- cross-group coalescing (core/coalesce.py fused dispatch) ----------------
+
+def _group_int_keys(engine, ops: List[_QueuedOp]) -> Optional[np.ndarray]:
+    """One group's concatenated int keys, or None when any op carries
+    codec-encoded keys (the coalescer's eligibility probe)."""
+    for op in ops:
+        if not engine.is_int_batch(np.asarray(op.payload)):
+            return None
+    return _concat_int_keys(ops)
+
+
+def _try_fused_run(engine, verb: str, run) -> bool:
+    """Fuse a run of >=2 consecutive same-verb bloom groups into ONE stacked
+    dispatch.  True = futures completed (or failed); False = ineligible,
+    caller dispatches per group."""
+    from redisson_tpu.core import coalesce as CO
+
+    names = [group[0] for group, _ops in run]
+    keys_list = []
+    for _group, ops in run:
+        keys = _group_int_keys(engine, ops)
+        if keys is None or keys.size == 0:
+            return False
+        keys_list.append(keys)
+    try:
+        if verb == "bloom.contains":
+            found, _lengths = CO.fused_bloom_contains_async(engine, names, keys_list)
+            flat = np.asarray(found)
+            off = 0
+            for _group, ops in run:
+                for op in ops:
+                    op.future._complete(flat[off : off + op.n])
+                    off += op.n
+        else:
+            newly, _lengths = CO.fused_bloom_add_async(engine, names, keys_list)
+            flat = np.asarray(newly)
+            off = 0
+            for _group, ops in run:
+                for op in ops:
+                    op.future._complete(int(flat[off : off + op.n].sum()))
+                    off += op.n
+    except CO.CoalesceIneligible:
+        return False
+    except Exception as e:  # noqa: BLE001 — failures land on the run's futures
+        for _group, ops in run:
+            for op in ops:
+                if not op.future.done():
+                    op.future._fail(e)
+    return True
+
+
+def _try_fused_pair(engine, add_item, probe_item) -> bool:
+    """Fuse the add-then-contains hot pair on ONE filter into a single
+    program (kernels.bloom_fused_add_contains): the probe group observes the
+    adds, exactly as the sequential group order would."""
+    from redisson_tpu.core import coalesce as CO
+
+    (add_group, add_ops), (probe_group, probe_ops) = add_item, probe_item
+    add_keys = _group_int_keys(engine, add_ops)
+    probe_keys = _group_int_keys(engine, probe_ops)
+    if add_keys is None or probe_keys is None:
+        return False
+    if add_keys.size == 0 or probe_keys.size == 0:
+        return False
+    try:
+        newly, n_add, found, n_probe = CO.fused_bloom_pair_async(
+            engine, add_group[0], add_keys, probe_keys
+        )
+        newly = np.asarray(newly)[:n_add]
+        off = 0
+        for op in add_ops:
+            op.future._complete(int(newly[off : off + op.n].sum()))
+            off += op.n
+        _scatter(probe_ops, np.asarray(found))
+    except CO.CoalesceIneligible:
+        return False
+    except Exception as e:  # noqa: BLE001
+        for op in add_ops + probe_ops:
+            if not op.future.done():
+                op.future._fail(e)
+    return True
+
+
 # -- per-op-kind dispatchers -------------------------------------------------
 
 def _concat_int_keys(ops: List[_QueuedOp]) -> np.ndarray:
-    return np.concatenate([np.asarray(op.payload, np.int64).reshape(-1) for op in ops])
+    """Concatenate every op's keys into ONE preallocated buffer.
+
+    np.concatenate over a per-op list allocates an intermediate array per op
+    before the final copy; at batch fan-outs (hundreds of queued ops per
+    flush) that numpy churn is measurable host overhead on the hot path, so
+    the buffer is sized once from the summed key counts and filled through
+    views."""
+    if len(ops) == 1:
+        return np.ascontiguousarray(
+            np.asarray(ops[0].payload, np.int64).reshape(-1)
+        )
+    arrs = [np.asarray(op.payload, np.int64).reshape(-1) for op in ops]
+    out = np.empty(sum(a.shape[0] for a in arrs), np.int64)
+    off = 0
+    for a in arrs:
+        out[off : off + a.shape[0]] = a
+        off += a.shape[0]
+    return out
 
 
 def _key_count(keys) -> int:
@@ -153,6 +285,9 @@ def _key_count(keys) -> int:
 
 
 def _scatter(ops: List[_QueuedOp], results: np.ndarray):
+    # force a single host materialization up front so every per-op slice
+    # below is a VIEW of one buffer, never a per-op device fetch/copy
+    results = np.asarray(results)
     off = 0
     for op in ops:
         # op.n == 0 means the op contributed no keys (empty array): complete

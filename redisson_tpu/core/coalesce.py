@@ -1,0 +1,174 @@
+"""Adaptive cross-object coalescing plane (ISSUE 2 tentpole).
+
+The batch layer (core/batch.py) and the server's pipelined frames
+(server/server.py) both arrive at the same shape of work: a RUN of same-verb
+bloom ops against DIFFERENT filters in one pipeline window — the config-5
+fan-out (64 per-tenant filters, one BF.MADD64 + one BF.MEXISTS64 each).
+Ungrouped that costs one device dispatch per (verb, object); each dispatch
+pays the fixed XLA-dispatch + tunnel overhead (~10-100us on-chip, far more
+through a tunneled session), so a 64-filter wave pays it 64 times for work
+one kernel could do.
+
+This module fuses such a run into ONE kernel call: filters that share
+geometry (same m, k, hash, physical plane size) are stacked into a (F, S)
+bank on device, every op's keys concatenate into one packed (3, B) transfer
+buffer whose first row is the SEGMENT SLOT (which filter each key probes),
+and the existing bank kernels (core/kernels.py — flat `slot*stride + idx`
+indexing) execute the whole run.  Results scatter back to each issuer by
+segment offset.  The stack itself is an HBM-side copy (F*S bytes), cheap
+next to F dispatch overheads; adds write each filter's row back under the
+same locked_many window that ordered the dispatch.
+
+Semantics preserved exactly:
+  * per-issuer results: segment offsets are computed host-side from the
+    submitted lengths, so every reply slices back to its op in order;
+  * adds: "newly" is evaluated against the window-start plane — identical
+    to the single-group semantics for duplicate keys inside one flush; a
+    run with the SAME filter named twice under `add` is ineligible (the
+    second group must see the first's bits, which one dispatch cannot do);
+  * locking: the whole fused dispatch runs under engine.locked_many over
+    the touched names (sorted order, deadlock-free), the same exclusion a
+    per-group dispatch takes per name.
+
+Ineligible runs (mixed geometry, codec keys, missing records, duplicate add
+names, int32 flat-index overflow) raise CoalesceIneligible — callers fall
+back to the per-group path, so coalescing is a pure fast path, never a
+semantics change.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from redisson_tpu.core import kernels as K
+from redisson_tpu.utils import hashing as H
+
+
+class CoalesceIneligible(Exception):
+    """Run cannot fuse; caller must dispatch per group."""
+
+
+def _concat_segments(engine, keys_list) -> Tuple[np.ndarray, np.ndarray, List[int]]:
+    """Concatenate per-op int-key arrays into one preallocated buffer plus an
+    aligned segment-slot column.  Returns (slot, keys, lengths)."""
+    arrs = []
+    for ks in keys_list:
+        a = np.asarray(ks)
+        if not engine.is_int_batch(a):
+            raise CoalesceIneligible("non-integer key batch")
+        arrs.append(np.ascontiguousarray(a, np.int64).reshape(-1))
+    lengths = [a.shape[0] for a in arrs]
+    total = sum(lengths)
+    if total == 0:
+        raise CoalesceIneligible("empty run")
+    keys = np.empty(total, np.int64)
+    slot = np.empty(total, np.int32)
+    off = 0
+    for s, a in enumerate(arrs):
+        n = a.shape[0]
+        keys[off : off + n] = a
+        slot[off : off + n] = s
+        off += n
+    return slot, keys, lengths
+
+
+def _validated_records(engine, names: Sequence[str]):
+    """Fetch + geometry-check the run's records.  Caller holds the locks."""
+    recs = []
+    m = k = shape = hname = None
+    for name in names:
+        rec = engine.store.get(name)
+        if rec is None or rec.kind != "bloom":
+            raise CoalesceIneligible(f"'{name}' is not an initialized bloom filter")
+        if m is None:
+            m, k = rec.meta["m"], rec.meta["k"]
+            hname = rec.meta.get("hash")
+            shape = rec.arrays["bits"].shape
+        elif (
+            rec.meta["m"] != m
+            or rec.meta["k"] != k
+            or rec.meta.get("hash") != hname
+            or rec.arrays["bits"].shape != shape
+        ):
+            raise CoalesceIneligible("mixed filter geometry in run")
+        recs.append(rec)
+    if len(names) * shape[0] > K.BANK_MAX_CELLS:
+        raise CoalesceIneligible("stacked planes exceed flat int32 index space")
+    return recs, m, k
+
+
+def _pack_window(slot: np.ndarray, keys: np.ndarray):
+    """(slot, keys) -> staged (3, B) uint32 transfer buffer + n_valid."""
+    n = keys.shape[0]
+    b = K.bucket_size(n)
+    lo, hi = H.int_keys_to_u32_pair(keys)
+    return K.pack_rows(slot, lo, hi, size=b), n
+
+
+def fused_bloom_contains_async(engine, names: Sequence[str], keys_list):
+    """ONE dispatch for a contains run over several same-geometry filters.
+
+    Returns (device bool array over the concatenated window, lengths) —
+    slice issuer i's reply at [sum(lengths[:i]), +lengths[i]).  No host
+    sync: callers force on their own result path (frame-level gather on
+    the server, np.asarray in the batch layer)."""
+    slot, keys, lengths = _concat_segments(engine, keys_list)
+    tlh, n = _pack_window(slot, keys)
+    import jax.numpy as jnp
+
+    with engine.locked_many(set(names)):
+        recs, m, k = _validated_records(engine, names)
+        planes = jnp.stack([r.arrays["bits"] for r in recs])
+        found = K.bloom_bank_contains_packed(planes, tlh, K.valid_n(n), k, m)
+    return found, lengths
+
+
+def fused_bloom_add_async(engine, names: Sequence[str], keys_list):
+    """ONE dispatch for an add run over several DISTINCT same-geometry
+    filters; writes each filter's new plane row back under the run's locks.
+    Returns (device newly-added bool array, lengths)."""
+    if len(set(names)) != len(names):
+        raise CoalesceIneligible(
+            "duplicate filter in add run (second group must observe the first)"
+        )
+    slot, keys, lengths = _concat_segments(engine, keys_list)
+    tlh, n = _pack_window(slot, keys)
+    import jax.numpy as jnp
+
+    with engine.locked_many(set(names)):
+        recs, m, k = _validated_records(engine, names)
+        planes = jnp.stack([r.arrays["bits"] for r in recs])
+        bits2d, newly = K.bloom_bank_add_packed(planes, tlh, K.valid_n(n), k, m)
+        for i, rec in enumerate(recs):
+            rec.arrays["bits"] = bits2d[i]
+            rec.version += 1
+    return newly, lengths
+
+
+def fused_bloom_pair_async(engine, name: str, add_keys, probe_keys):
+    """The hot add-then-probe PAIR on one filter as a single fused program
+    (kernels.bloom_fused_add_contains): the probe observes the adds, the
+    plane stays donated/resident between the scatter and the gather.
+    Returns (device newly bool, n_add, device found bool, n_probe)."""
+    add_arr = np.asarray(add_keys)
+    probe_arr = np.asarray(probe_keys)
+    if not (engine.is_int_batch(add_arr) and engine.is_int_batch(probe_arr)):
+        raise CoalesceIneligible("non-integer key batch")
+    if add_arr.size == 0 or probe_arr.size == 0:
+        raise CoalesceIneligible("empty side of fused pair")
+    kind_a, lh_a, n_a = engine.pack_keys(add_arr, None)
+    kind_p, lh_p, n_p = engine.pack_keys(probe_arr, None)
+    if kind_a != "u64" or kind_p != "u64":
+        raise CoalesceIneligible("fused pair requires u64 key packing")
+    with engine.locked(name):
+        rec = engine.store.get(name)
+        if rec is None or rec.kind != "bloom":
+            raise CoalesceIneligible(f"'{name}' is not an initialized bloom filter")
+        m, k = rec.meta["m"], rec.meta["k"]
+        bits, newly, found = K.bloom_fused_add_contains(
+            rec.arrays["bits"], lh_a, K.valid_n(n_a), lh_p, K.valid_n(n_p), k, m
+        )
+        rec.arrays["bits"] = bits
+        rec.version += 1
+    return newly, n_a, found, n_p

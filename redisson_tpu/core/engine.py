@@ -335,6 +335,24 @@ class Engine:
                     if entry[1] == 0:
                         self._record_locks.pop(n, None)
 
+    # -- kernel warm pool ----------------------------------------------------
+
+    @property
+    def warm_pool(self):
+        """The process-global kernel warm-pool (core/warmpool.py)."""
+        from redisson_tpu.core import warmpool
+
+        return warmpool.POOL
+
+    def prewarm(self, names=None, buckets=(0,)) -> int:
+        """Precompile the hot kernels of live records at the given batch
+        buckets (TasksRunnerService warm-pool analog) — run at boot or
+        before a timed serving phase, never on the hot path.  Returns the
+        number of programs actually compiled/loaded this call."""
+        from redisson_tpu.core import warmpool
+
+        return warmpool.prewarm_store(self, names=names, buckets=buckets)
+
     # -- key packing --------------------------------------------------------
 
     @staticmethod

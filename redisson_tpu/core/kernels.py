@@ -170,7 +170,11 @@ def _bloom_bank_add_body(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
     mask = _valid_mask(lo.shape[0], n_valid)
     size = bits2d.shape[0] * bits2d.shape[1]
     flat = bits2d.reshape(-1)
-    g = jnp.where(mask[:, None], tenant[:, None] * m + idx, size)
+    # row stride is the PHYSICAL row width: for BloomFilterArray banks it
+    # equals m (rows are padded_size-aligned at init), and it makes the same
+    # kernels serve the coalescing plane's stacked single-filter planes,
+    # whose physical size exceeds the logical hash domain m (core/coalesce)
+    g = jnp.where(mask[:, None], tenant[:, None] * bits2d.shape[1] + idx, size)
     old = flat.at[g].get(mode="fill", fill_value=1)
     newly = jnp.any(old == 0, axis=-1) & mask
     new_flat = flat.at[g.reshape(-1)].set(jnp.uint8(1), mode="drop")
@@ -180,7 +184,7 @@ def _bloom_bank_add_body(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
 def _bloom_bank_contains_body(bits2d, tenant, lo, hi, n_valid, k: int, m: int):
     h1, h2 = H.hash_u64_pair(lo, hi, jnp)
     idx = H.bloom_indexes(h1, h2, k, m, jnp)
-    g = tenant[:, None] * m + idx
+    g = tenant[:, None] * bits2d.shape[1] + idx
     got = bits2d.reshape(-1).at[g].get(mode="fill", fill_value=1)
     return jnp.all(got != 0, axis=-1) & _valid_mask(lo.shape[0], n_valid)
 
@@ -378,6 +382,37 @@ bloom_contains_packed = jax.jit(_bloom_contains_impl, static_argnums=(3, 4))
 @functools.partial(jax.jit, static_argnums=(3, 4))
 def bloom_contains_packed_bits(bits, lh, n_valid, k: int, m: int):
     return _pack_bool_u32(_bloom_contains_impl(bits, lh, n_valid, k, m))
+
+
+# --- fused multi-verb hot pair ----------------------------------------------
+# The bloom serving loop's hottest verb PAIR is add-then-probe on one filter
+# (ingest acks + read-your-writes probes in the same pipeline window).  Run
+# unfused that is two dispatches and an extra full-plane donation round trip
+# through the jit boundary; fused it is ONE program — XLA keeps the bit plane
+# resident in HBM between the scatter and the gather, and the probe sees the
+# adds (submission order: the add group precedes the contains group, the
+# same order the reference preserves inside a CommandsData frame).
+
+def _bloom_fused_add_contains_body(bits, add_lh, n_add, probe_lh, n_probe,
+                                   k: int, m: int):
+    bits, newly = _bloom_add_body(bits, add_lh[0], add_lh[1], n_add, k, m)
+    found = _bloom_contains_body(bits, probe_lh[0], probe_lh[1], n_probe, k, m)
+    return bits, newly, found
+
+
+bloom_fused_add_contains = jax.jit(
+    _bloom_fused_add_contains_body, static_argnums=(5, 6), donate_argnums=(0,)
+)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6), donate_argnums=(0,))
+def bloom_fused_add_contains_bits(bits, add_lh, n_add, probe_lh, n_probe,
+                                  k: int, m: int):
+    """Fused pair with bitmap result paths (the wire/window d2h discipline)."""
+    bits, newly, found = _bloom_fused_add_contains_body(
+        bits, add_lh, n_add, probe_lh, n_probe, k, m
+    )
+    return bits, _pack_bool_u32(newly), _pack_bool_u32(found)
 
 
 # --------------------------------------------------------------------------

@@ -33,8 +33,14 @@ from redisson_tpu.utils import metrics as _metrics
 # Process-global transport fault plane (chaos/faults.py FaultPlane): every
 # Connection consults it at its three event sites — connect, send, recv —
 # so injected faults flow through the REAL failure paths (pool discard,
-# retry machinery, detector feeds) instead of bypassing them.  None = no
-# chaos (the zero-overhead production state: one attribute load per event).
+# retry machinery, detector feeds) instead of bypassing them.
+#
+# ZERO-COST CONTRACT (ISSUE 2, enforced by tests/test_perf_smoke.py and
+# measured by tools/chaos_overhead_bench.py): with no plane installed the
+# per-event cost is exactly one module-global load plus one `is None`
+# branch — no attribute chase, no call, no allocation.  Every event site
+# below reads `_fault_plane` into a local ONCE and branches; nothing else
+# may be added to the disabled path.
 _fault_plane = None
 
 
@@ -110,7 +116,12 @@ class Connection:
         self.host, self.port = host, port
         self.timeout = timeout
         self._parser = resp.RespParser()
-        self._pending: List[Any] = []  # decoded push frames awaiting delivery
+        # deque: read_reply consumes from the FRONT once per reply — a list
+        # pop(0) is O(pending) per reply, quadratic across a large pipelined
+        # frame's reply drain (hot for execute_many)
+        from collections import deque
+
+        self._pending: "deque" = deque()  # decoded frames awaiting delivery
         self.push_handler: Optional[Callable[[Push], None]] = None
         plane = _fault_plane
         if plane is not None:
@@ -162,7 +173,7 @@ class Connection:
         deadline = time.monotonic() + (timeout if timeout is not None else self.timeout)
         while True:
             while self._pending:
-                value = self._pending.pop(0)
+                value = self._pending.popleft()
                 if isinstance(value, Push) and self.push_handler is not None:
                     self.push_handler(value)
                     continue

@@ -26,6 +26,7 @@ slices; SURVEY.md §2.8's "cluster bus").
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Dict, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -82,12 +83,32 @@ class Geometry(NamedTuple):
 class MeshManager:
     SERVICE_KEY = "mesh_manager"
 
+    # bound on the cross-epoch warm pool: geometries cycle among a handful
+    # of shapes in practice (4<->8 reshards), so a small LRU holds them all
+    # while a pathological geometry sweep stays bounded
+    WARM_POOL_MAX = 32
+
     def __init__(self, config=None, mesh: Optional[Mesh] = None):
         self._config = config
         self._mesh = mesh
         self._guard = threading.Lock()
         self._kernels: Dict[Tuple, Tuple] = {}
         self._epoch = 0
+        # cross-epoch kernel warm pool (ISSUE 2): reshard() must invalidate
+        # the EPOCH cache (a stale-geometry build must never serve a new-
+        # epoch dispatch), but a 4->8->4 cycle lands back on a geometry
+        # whose programs were already built — keyed by the mesh's physical
+        # identity (axis shape + device ids), those builds are still exact,
+        # so they re-enter the epoch cache without recompiling.  Bounded
+        # LRU; entries hold the same fns tuples the epoch cache holds.
+        self._warm: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+
+    @staticmethod
+    def _mesh_key(mesh: Mesh) -> Tuple:
+        return (
+            tuple(mesh.shape.items()),
+            tuple(d.id for d in mesh.devices.flat),
+        )
 
     @classmethod
     def of(cls, engine) -> "MeshManager":
@@ -149,18 +170,34 @@ class MeshManager:
         plus the insert-time epoch check make cache poisoning impossible: a
         getter racing reshard() may still BUILD against the old mesh (its
         caller's dispatch legitimately finishes on the old geometry), but it
-        can never INSERT that build where the new epoch would find it."""
+        can never INSERT that build where the new epoch would find it.
+
+        Second level: the cross-epoch WARM POOL, keyed by the mesh's
+        physical identity instead of the epoch — an epoch-cache miss whose
+        geometry was built in ANY earlier epoch (4->8->4 round trips) reuses
+        that build instead of recompiling.  Compiled programs depend only on
+        the mesh's axis shape and device set, which the pool key captures
+        exactly, so reuse is always bit-identical."""
         if geom is None:
             geom = self.geometry()
-        key = (geom.epoch, *key)
+        ekey = (geom.epoch, *key)
         with self._guard:
-            fns = self._kernels.get(key)
-        if fns is not None:
-            return fns
-        fns = build(geom.mesh)
+            fns = self._kernels.get(ekey)
+            if fns is not None:
+                return fns
+            wkey = (self._mesh_key(geom.mesh), *key)
+            fns = self._warm.get(wkey)
+            if fns is not None:
+                self._warm.move_to_end(wkey)
+        if fns is None:
+            fns = build(geom.mesh)
         with self._guard:
             if self._epoch == geom.epoch:
-                self._kernels[key] = fns
+                self._kernels[ekey] = fns
+            self._warm[wkey] = fns
+            self._warm.move_to_end(wkey)
+            while len(self._warm) > self.WARM_POOL_MAX:
+                self._warm.popitem(last=False)
         return fns
 
     def bloom_kernels(self, k: int, m: int, tenants: int, width: int = 0,

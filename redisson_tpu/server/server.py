@@ -22,6 +22,7 @@ import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
+from redisson_tpu.client import routing as _routing
 from redisson_tpu.core.engine import Engine
 from redisson_tpu.net import resp
 from redisson_tpu.net.resp import ProtocolError, RespError
@@ -444,6 +445,61 @@ class TpuServer:
             self._pause_gate.wait(timeout=60.0)
         return REGISTRY.dispatch(self, ctx, cmd)
 
+    def _dispatch_bloom_run(self, ctx, cmds):
+        """Coalesced execution of a same-verb BF blob run inside one frame
+        (the adaptive coalescing plane): ONE stacked-bank kernel dispatch for
+        the whole run instead of one per command, per-command LazyReplies
+        riding the frame's single d2h gather.  Ineligible runs fall back to
+        sequential per-command dispatch with identical semantics; an
+        unexpected failure of the fused path falls back only for CONTAINS
+        runs (read-only) — add runs reply per-command errors instead, so a
+        possibly-applied mutation is never re-dispatched (at-most-once)."""
+        from redisson_tpu.server.verbs.sketch import coalesce_bloom_run
+
+        if not self._pause_gate.is_set():
+            self._pause_gate.wait(timeout=60.0)
+        is_add = bytes(cmds[0][0]).upper() == b"BF.MADD64"
+        try:
+            fused = coalesce_bloom_run(self, ctx, cmds)
+        except RuntimeError as e:
+            if "shutdown" in str(e):
+                # same contract as the per-command path: a stopping worker
+                # pool drops the connection, never replies per-command errors
+                raise ConnectionResetError(str(e)) from e
+            if is_add:
+                self.stats["errors"] += len(cmds)
+                enc = resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
+                return [_Encoded(enc) for _ in cmds]
+            fused = None
+        except Exception as e:  # noqa: BLE001 — per-run isolation
+            if is_add:
+                self.stats["errors"] += len(cmds)
+                enc = resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
+                return [_Encoded(enc) for _ in cmds]
+            fused = None
+        if fused is not None:
+            return fused
+        out = []
+        for cmd in cmds:
+            try:
+                out.append(REGISTRY.dispatch(self, ctx, cmd))
+            except RespError as e:
+                self.stats["errors"] += 1
+                out.append(_Encoded(resp.encode_error(str(e.args[0]))))
+            except RuntimeError as e:
+                if "shutdown" in str(e):
+                    raise ConnectionResetError(str(e)) from e
+                self.stats["errors"] += 1
+                out.append(_Encoded(
+                    resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
+                ))
+            except Exception as e:  # noqa: BLE001 — sandbox per-command
+                self.stats["errors"] += 1
+                out.append(_Encoded(
+                    resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
+                ))
+        return out
+
     def replication_source(self):
         """Lazy master-side record shipper (server/replication.py)."""
         from redisson_tpu.server.replication import ReplicationSource
@@ -526,8 +582,37 @@ class TpuServer:
                 # device->host sync per frame instead of per command; per-
                 # connection ordering is untouched (dispatch stays
                 # sequential, and the device stream is in-order).
+                # Same-verb BF blob RUNS additionally collapse into one
+                # fused kernel dispatch each (_dispatch_bloom_run — the
+                # coalescing plane; runs never cross a verb change, so
+                # frame order is preserved exactly).
+                run_at: Dict[int, int] = {}
+                if len(commands) > 1:
+                    run_at = {
+                        s: e
+                        for s, e in _routing.coalescible_frame_runs(commands)
+                        if all(
+                            isinstance(a, (bytes, bytearray))
+                            for c in commands[s:e]
+                            for a in c
+                        )
+                    }
                 results: list = []
+                ci = -1
                 for cmd in commands:
+                    ci += 1
+                    if len(results) > ci:
+                        continue  # covered by an already-dispatched run
+                    run_end = run_at.get(ci)
+                    if run_end is not None:
+                        run_cmds = commands[ci:run_end]
+                        self.stats["commands"] += len(run_cmds)
+                        results.extend(
+                            await loop.run_in_executor(
+                                self._pool, self._dispatch_bloom_run, ctx, run_cmds
+                            )
+                        )
+                        continue
                     if not isinstance(cmd, list) or not all(
                         isinstance(a, (bytes, bytearray)) for a in cmd
                     ):
@@ -769,6 +854,11 @@ def main(argv=None):
         help="seconds between automatic snapshots (0 = manual SAVE only)",
     )
     ap.add_argument("--platform", default=None, help="force jax platform (cpu/tpu)")
+    ap.add_argument(
+        "--prewarm", action="store_true",
+        help="precompile hot kernels for restored records at boot "
+             "(core/warmpool — keeps the first request's latency clean)",
+    )
     args = ap.parse_args(argv)
     if args.checkpoint_interval > 0 and not args.checkpoint:
         ap.error("--checkpoint-interval requires --checkpoint <path>")
@@ -788,6 +878,8 @@ def main(argv=None):
         from redisson_tpu.core import checkpoint
 
         checkpoint.load(engine, args.checkpoint)
+    if args.prewarm:
+        engine.prewarm()
     if args.checkpoint and args.checkpoint_interval > 0:
         from redisson_tpu.core.checkpoint import AutoCheckpointer
 

@@ -339,6 +339,84 @@ def cmd_bf_mexists64(server, ctx, args):
     return LazyReply(device=(found,), finish=finish)
 
 
+# -- frame-run coalescing (the adaptive coalescing plane, ISSUE 2) -----------
+# A pipelined frame carrying a RUN of same-verb BF.MADD64 / BF.MEXISTS64
+# commands against different filters (the config-5 fan-out: one command per
+# tenant filter) used to cost one device dispatch per command.  The server
+# frame loop (server/server.py) hands such runs here: same-geometry filters
+# stack into one (F, S) bank, the whole run executes as ONE kernel, and each
+# command's reply is a device slice riding the frame's single d2h gather.
+
+def coalesce_bloom_run(server, ctx, cmds: List[List[bytes]]):
+    """Fused dispatch for a same-verb BF blob run.  Returns one LazyReply
+    per command, or None when the run is ineligible (caller falls back to
+    per-command dispatch, which reproduces exact per-command semantics and
+    errors).  Prechecks mirror Registry.dispatch's pre-dispatch gates; any
+    state that would make them diverge (open MULTI, unauthenticated
+    connection, pending ASKING, routing redirect) disqualifies the run."""
+    import numpy as np
+
+    from redisson_tpu.core import coalesce as CO
+
+    if ctx.multi_queue is not None or not ctx.authenticated or ctx.asking:
+        return None
+    verb = bytes(cmds[0][0]).upper()
+    add = verb == b"BF.MADD64"
+    names: List[str] = []
+    keys_list = []
+    for cmd in cmds:
+        if len(cmd) != 3:
+            return None
+        if server.cluster_view or server.role == "replica":
+            try:
+                server.check_routing(verb.decode(), cmd[1:], asking=False)
+            except RespError:
+                return None  # redirect/readonly: per-command path replies
+        try:
+            names.append(_s(cmd[1]))
+            keys = np.frombuffer(bytes(cmd[2]), dtype="<i8")
+        except (ValueError, UnicodeDecodeError):
+            # malformed blob/name: NOTHING was dispatched yet, so the run is
+            # simply ineligible — per-command dispatch errors only the bad
+            # command and serves the rest (uncoalesced semantics, exactly)
+            return None
+        if keys.size == 0:
+            return None  # empty-blob replies follow the per-command path
+        keys_list.append(keys)
+    from redisson_tpu.utils.metrics import run_hooks_end, run_hooks_start
+
+    hooks = getattr(server, "hooks", None) or ()
+    name = verb.decode() + ".COALESCED"
+    tokens = run_hooks_start(hooks, name, (len(cmds),))
+    try:
+        if add:
+            flags, lengths = CO.fused_bloom_add_async(server.engine, names, keys_list)
+        else:
+            flags, lengths = CO.fused_bloom_contains_async(
+                server.engine, names, keys_list
+            )
+    except CO.CoalesceIneligible:
+        run_hooks_end(tokens, name, None)
+        return None
+    except BaseException as e:
+        run_hooks_end(tokens, name, e)
+        raise
+    run_hooks_end(tokens, name, None)
+
+    def reply(seg):
+        return LazyReply(
+            device=(seg,),
+            finish=lambda v: np.asarray(v[0], np.uint8).tobytes(),
+        )
+
+    out = []
+    off = 0
+    for n in lengths:
+        out.append(reply(flags[off : off + n]))
+        off += n
+    return out
+
+
 @register("BFA.RESERVE")
 def cmd_bfa_reserve(server, ctx, args):
     from redisson_tpu.client.objects.bloom_array import BloomFilterArray

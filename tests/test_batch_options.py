@@ -175,6 +175,111 @@ def test_batch_result_order_is_submission_order(cluster2):
         client.shutdown()
 
 
+def test_local_batch_coalesces_cross_filter_runs(monkeypatch):
+    """The coalescing plane (ISSUE 2): a run of same-verb bloom ops against
+    DIFFERENT same-geometry filters executes as ONE fused dispatch, and
+    every response scatters back to its issuer with its own length."""
+    import redisson_tpu
+    from redisson_tpu.core import coalesce as CO
+
+    calls = {"add": 0, "contains": 0}
+    real_add, real_contains = CO.fused_bloom_add_async, CO.fused_bloom_contains_async
+    monkeypatch.setattr(
+        CO, "fused_bloom_add_async",
+        lambda *a, **k: (calls.__setitem__("add", calls["add"] + 1), real_add(*a, **k))[1],
+    )
+    monkeypatch.setattr(
+        CO, "fused_bloom_contains_async",
+        lambda *a, **k: (calls.__setitem__("contains", calls["contains"] + 1), real_contains(*a, **k))[1],
+    )
+    client = redisson_tpu.create()
+    try:
+        F = 5
+        for i in range(F):
+            assert client.get_bloom_filter(f"co:{i}").try_init(20_000, 0.01)
+        b = client.create_batch()
+        adds, probes = [], []
+        for i in range(F):
+            bf = b.get_bloom_filter(f"co:{i}")
+            # distinct lengths per op: a mis-scattered reply cannot have the
+            # right shape by accident
+            adds.append((i, bf.add_async(np.arange(i * 1000, i * 1000 + 100 + i, dtype=np.int64))))
+        for i in range(F):
+            bf = b.get_bloom_filter(f"co:{i}")
+            probes.append((i, bf.contains_async(np.arange(i * 1000, i * 1000 + 150 + i, dtype=np.int64))))
+        b.execute()
+        assert calls == {"add": 1, "contains": 1}, calls  # ONE dispatch per run
+        for i, fut in adds:
+            assert fut.get() == 100 + i
+        for i, fut in probes:
+            got = np.asarray(fut.get())
+            assert got.shape[0] == 150 + i
+            assert got[: 100 + i].all() and not got[100 + i :].any()
+    finally:
+        client.shutdown()
+
+
+def test_remote_batch_run_coalesces_server_side(cluster2):
+    """The wire form: a remote batch fan-out over many filters arrives as a
+    same-verb BF blob run per shard frame; the server fuses each run into
+    one kernel (metrics record the coalesced dispatch) and per-command
+    replies still scatter correctly."""
+    client = cluster2.client(scan_interval=0)
+    try:
+        F = 6
+        for i in range(F):
+            client.get_bloom_filter(f"rco:{i}").try_init(20_000, 0.01)
+        b = client.create_batch()
+        handles = [b.get_bloom_filter(f"rco:{i}") for i in range(F)]
+        i_adds = [h.add_async(np.arange(i * 500, i * 500 + 80 + i, dtype=np.int64))
+                  for i, h in enumerate(handles)]
+        results = b.execute()
+        for i, idx in enumerate(i_adds):
+            got = np.asarray(results[idx])
+            assert got.shape[0] == 80 + i and got.all()
+        b2 = client.create_batch()
+        handles = [b2.get_bloom_filter(f"rco:{i}") for i in range(F)]
+        i_probes = [h.contains_async(np.arange(i * 500, i * 500 + 120, dtype=np.int64))
+                    for i, h in enumerate(handles)]
+        results = b2.execute()
+        for i, idx in enumerate(i_probes):
+            got = np.asarray(results[idx])
+            assert got[: 80 + i].all() and not got[80 + i : 120].any()
+        # at least one node saw a fused run (6 filters over 2 shards)
+        snaps = [
+            n.server.server.metrics.snapshot() for n in cluster2.masters
+        ]
+        assert any(
+            k.startswith("command.bf.") and "coalesced" in k
+            for snap in snaps for k in snap
+        ), "no node recorded a coalesced dispatch"
+    finally:
+        client.shutdown()
+
+
+def test_local_batch_mixed_geometry_falls_back_per_group():
+    """Filters with DIFFERENT geometry in one run are ineligible: the batch
+    falls back to per-group dispatch with identical results."""
+    import redisson_tpu
+
+    client = redisson_tpu.create()
+    try:
+        assert client.get_bloom_filter("mix:a").try_init(10_000, 0.01)
+        assert client.get_bloom_filter("mix:b").try_init(90_000, 0.001)
+        b = client.create_batch()
+        fa = b.get_bloom_filter("mix:a").add_async(np.arange(50, dtype=np.int64))
+        fb = b.get_bloom_filter("mix:b").add_async(np.arange(60, dtype=np.int64))
+        ca = b.get_bloom_filter("mix:a").contains_async(np.arange(70, dtype=np.int64))
+        cb = b.get_bloom_filter("mix:b").contains_async(np.arange(80, dtype=np.int64))
+        b.execute()
+        assert fa.get() == 50 and fb.get() == 60
+        ga, gb = np.asarray(ca.get()), np.asarray(cb.get())
+        assert ga[:50].all() and not ga[50:].any()
+        assert gb[:60].all() and not gb[60:].any()
+    finally:
+        client.shutdown()
+
+
 def test_atomic_batch_includes_bloom_ops_in_lock_group(cluster2):
     """ATOMIC batches route bloom sketch ops through the locked OBJCALLMA
     frame instead of the (unlocked) blob fast path, so sketch and generic

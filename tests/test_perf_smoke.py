@@ -1,0 +1,291 @@
+"""Perf-contract smoke tier (ISSUE 2 satellite, `-m perf`): fast assertions
+that pin the coalescing plane's correctness contracts and the zero-cost
+chaos-hook guarantee — the properties bench.py measures but CI can't time.
+
+  * fused add+contains kernel is BIT-IDENTICAL to the unfused pair;
+  * the fault-plane DISABLED hot path allocates nothing (tracemalloc
+    attribution against the exact guard lines in net/client.py);
+  * coalesced cross-filter dispatch returns exactly what per-filter
+    dispatch returns;
+  * tools/perf_gate.py logic passes/fails on the recorded artifacts.
+"""
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.perf
+
+
+# -- fused kernel bit-identity ------------------------------------------------
+
+def test_fused_add_contains_bit_identical_to_unfused_pair():
+    """One fused program == add kernel then contains kernel, bit for bit:
+    same new plane, same newly flags, same found flags."""
+    from redisson_tpu.core import kernels as K
+    from redisson_tpu.ops import bittensor as bt
+    from redisson_tpu.utils import hashing as H
+
+    m, k = 95_851, 7
+    rng = np.random.default_rng(5)
+    pre = rng.integers(0, 1 << 60, 500).astype(np.int64)
+    add = rng.integers(0, 1 << 60, 300).astype(np.int64)
+    probe = np.concatenate([add[:150], rng.integers(0, 1 << 60, 150).astype(np.int64)])
+
+    def pack(keys):
+        lo, hi = H.int_keys_to_u32_pair(keys)
+        return K.pack_rows(lo, hi, size=K.bucket_size(keys.shape[0])), keys.shape[0]
+
+    lh_pre, n_pre = pack(pre)
+    lh_add, n_add = pack(add)
+    lh_probe, n_probe = pack(probe)
+
+    base, _ = K.bloom_add_packed(bt.make(m), lh_pre, K.valid_n(n_pre), k, m)
+    base = np.asarray(base)  # host copy: both paths start from identical bits
+
+    import jax.numpy as jnp
+
+    bits_a, newly_a = K.bloom_add_packed(jnp.asarray(base), lh_add, K.valid_n(n_add), k, m)
+    found_a = K.bloom_contains_packed(bits_a, lh_probe, K.valid_n(n_probe), k, m)
+
+    bits_b, newly_b, found_b = K.bloom_fused_add_contains(
+        jnp.asarray(base), lh_add, K.valid_n(n_add), lh_probe, K.valid_n(n_probe), k, m
+    )
+    np.testing.assert_array_equal(np.asarray(bits_a), np.asarray(bits_b))
+    np.testing.assert_array_equal(np.asarray(newly_a), np.asarray(newly_b))
+    np.testing.assert_array_equal(np.asarray(found_a), np.asarray(found_b))
+    # the probe must observe the adds (read-your-writes inside the pair)
+    assert np.asarray(found_b)[:150].all()
+
+
+# -- zero-alloc disabled fault plane -----------------------------------------
+
+def _guard_lines():
+    """Line numbers of every fault-plane guard in net/client.py — the exact
+    sites the zero-cost contract covers."""
+    import redisson_tpu.net.client as mod
+
+    path = mod.__file__
+    lines = []
+    with open(path) as fh:
+        for no, line in enumerate(fh, 1):
+            if "_fault_plane" in line and "def " not in line and "install" not in line:
+                lines.append(no)
+            if "plane is not None" in line or "plane.on_" in line:
+                lines.append(no)
+    return path, sorted(set(lines))
+
+
+def test_fault_plane_disabled_path_allocates_nothing():
+    """With no plane installed, send/recv through a real socket must not
+    allocate ANYTHING attributable to the fault-plane guard lines — the
+    'single `is None` branch' contract, asserted at the allocator level."""
+    import tracemalloc
+
+    from redisson_tpu.net import client as net
+
+    assert net._fault_plane is None, "a fault plane leaked from another test"
+    a, b = socket.socketpair()
+    stop = threading.Event()
+
+    def echo():
+        # reply one RESP simple string per received chunk
+        while not stop.is_set():
+            try:
+                data = b.recv(1 << 16)
+            except OSError:
+                return
+            if not data:
+                return
+            b.sendall(b"+PONG\r\n" * max(1, data.count(b"PING")))
+
+    t = threading.Thread(target=echo, daemon=True)
+    t.start()
+    conn = net.Connection.__new__(net.Connection)  # bypass connect handshake
+    from collections import deque
+
+    conn.host, conn.port = "local", 0
+    conn.timeout = 5.0
+    import redisson_tpu.net.resp as resp
+
+    conn._parser = resp.RespParser()
+    conn._pending = deque()
+    conn.push_handler = None
+    conn._sock = a
+    conn.closed = False
+    try:
+        conn.execute("PING")  # warm every lazy path before tracing
+        path, guards = _guard_lines()
+        assert guards, "guard lines not found — contract comment drifted"
+        tracemalloc.start(1)
+        try:
+            for _ in range(200):
+                conn.execute("PING")
+            snap = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        offenders = [
+            (tb.lineno, stat.size)
+            for stat in snap.statistics("lineno")
+            for tb in [stat.traceback[0]]
+            if tb.filename == path and tb.lineno in guards and stat.size > 0
+        ]
+        assert not offenders, (
+            f"fault-plane guard lines allocated with the plane DISABLED: {offenders}"
+        )
+    finally:
+        stop.set()
+        conn.close()
+        b.close()
+        t.join(timeout=5)
+
+
+# -- coalesced dispatch equivalence ------------------------------------------
+
+def test_coalesced_run_matches_per_filter_dispatch():
+    import redisson_tpu
+    from redisson_tpu.core import coalesce as CO
+
+    c = redisson_tpu.create()
+    try:
+        engine = c._engine
+        rng = np.random.default_rng(7)
+        names, keys_list = [], []
+        for i in range(6):
+            name = f"perf:co{i}"
+            assert c.get_bloom_filter(name).try_init(20_000, 0.01)
+            names.append(name)
+            keys_list.append(rng.integers(0, 1 << 60, 200 + 40 * i).astype(np.int64))
+        newly, lengths = CO.fused_bloom_add_async(engine, names, keys_list)
+        flat = np.asarray(newly)
+        off = 0
+        for i, (name, keys) in enumerate(zip(names, keys_list)):
+            seg = flat[off : off + lengths[i]]
+            off += lengths[i]
+            assert seg.all(), f"{name}: fused add lost keys"
+            # per-filter ground truth sees exactly the fused writes
+            assert c.get_bloom_filter(name).contains_each(keys).all()
+        probes = [
+            np.concatenate([keys[:50], rng.integers(0, 1 << 60, 50).astype(np.int64)])
+            for keys in keys_list
+        ]
+        found, lengths = CO.fused_bloom_contains_async(engine, names, probes)
+        flat = np.asarray(found)
+        off = 0
+        for i, (name, probe) in enumerate(zip(names, probes)):
+            seg = flat[off : off + lengths[i]]
+            off += lengths[i]
+            expect = c.get_bloom_filter(name).contains_each(probe)
+            np.testing.assert_array_equal(seg, expect)
+    finally:
+        c.shutdown()
+
+
+def test_coalesce_ineligible_on_mixed_geometry():
+    import redisson_tpu
+    from redisson_tpu.core import coalesce as CO
+
+    c = redisson_tpu.create()
+    try:
+        assert c.get_bloom_filter("perf:g1").try_init(10_000, 0.01)
+        assert c.get_bloom_filter("perf:g2").try_init(90_000, 0.01)
+        with pytest.raises(CO.CoalesceIneligible):
+            CO.fused_bloom_add_async(
+                c._engine,
+                ["perf:g1", "perf:g2"],
+                [np.arange(10, dtype=np.int64), np.arange(10, dtype=np.int64)],
+            )
+        # duplicate names in an ADD run: second group must see the first
+        with pytest.raises(CO.CoalesceIneligible):
+            CO.fused_bloom_add_async(
+                c._engine,
+                ["perf:g1", "perf:g1"],
+                [np.arange(10, dtype=np.int64), np.arange(10, dtype=np.int64)],
+            )
+    finally:
+        c.shutdown()
+
+
+def test_malformed_frame_element_does_not_kill_connection():
+    """Reviewer regression: a frame whose command carries a NON-BYTES
+    element (nested array) must not crash the run scanner — the bad command
+    gets 'ERR bad request frame' and the rest of the frame is served."""
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0) as st:
+        s = socket.create_connection((st.server.host, st.server.port), timeout=5)
+        try:
+            s.sendall(
+                b"*2\r\n*1\r\n$2\r\nhi\r\n$1\r\nx\r\n"  # nested-array element
+                b"*1\r\n$4\r\nPING\r\n"
+            )
+            s.settimeout(5)
+            data = b""
+            while b"PONG" not in data:
+                chunk = s.recv(1 << 16)
+                assert chunk, f"connection dropped; got only {data!r}"
+                data += chunk
+            assert b"ERR bad request frame" in data
+        finally:
+            s.close()
+
+
+def test_coalesced_run_with_one_bad_blob_errors_only_that_command():
+    """Reviewer regression: one malformed blob in a BF.MADD64 run makes the
+    run ineligible (nothing dispatched yet) — per-command fallback errors
+    ONLY the bad command, the other adds land."""
+    from redisson_tpu.net.resp import RespError
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0) as st:
+        with st.client() as conn:
+            for i in range(3):
+                assert conn.execute("BF.RESERVE", f"bad:{i}", 0.01, 1000) in (b"OK", "OK")
+            good = np.arange(100, dtype=np.int64).tobytes()
+            replies = conn.execute_many([
+                ("BF.MADD64", "bad:0", good),
+                ("BF.MADD64", "bad:1", good[:7]),  # not a multiple of 8
+                ("BF.MADD64", "bad:2", good),
+            ], timeout=30.0)
+            assert np.frombuffer(replies[0], np.uint8).all()
+            assert isinstance(replies[1], RespError)
+            assert np.frombuffer(replies[2], np.uint8).all()
+            probe = conn.execute("BF.MEXISTS64", "bad:2", good, timeout=30.0)
+            assert np.frombuffer(probe, np.uint8).all()
+
+
+# -- perf gate logic ----------------------------------------------------------
+
+def test_perf_gate_passes_self_and_fails_known_regression(tmp_path):
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(repo, "tools", "perf_gate.py")
+    )
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+
+    r5 = os.path.join(repo, "BENCH_r05.json")
+    if not os.path.exists(r5):
+        pytest.skip("no recorded BENCH artifacts")
+    assert gate.main(["--fresh", r5, "--baseline", r5]) == 0
+    r3 = os.path.join(repo, "BENCH_r03.json")
+    if os.path.exists(r3):
+        # the recorded r3->r5 decline (the motivating regression) must FAIL
+        assert gate.main(["--fresh", r5, "--baseline", r3]) == 1
+
+    # synthetic: a 6% headline drop fails, 4% passes
+    with open(r5) as fh:
+        base = gate.load_bench_doc(fh.read())
+    import copy
+    import json
+
+    for factor, want in ((0.94, 1), (0.96, 0)):
+        doc = copy.deepcopy(base)
+        doc["value"] = base["value"] * factor
+        p = tmp_path / f"fresh_{factor}.json"
+        p.write_text(json.dumps(doc))
+        assert gate.main(["--fresh", str(p), "--baseline", r5]) == want

@@ -188,6 +188,85 @@ def test_repeated_reshard_kernel_cache_does_not_grow(client):
     assert len(set(sizes)) == 1, f"kernel cache grew across reshard cycles: {sizes}"
 
 
+def test_warm_pool_no_recompile_across_reshard_epochs(client, monkeypatch):
+    """ISSUE 2 satellite: after one 4->8->4 roundtrip has populated the
+    cross-epoch warm pool, FURTHER roundtrips over the same geometries must
+    not rebuild a single sharded kernel — the epoch cache refills from the
+    pool (cache HIT across epochs for same-shape planes)."""
+    import redisson_tpu.parallel.manager as MM
+
+    mgr = MeshManager.of(client._engine)
+    rng = np.random.default_rng(11)
+    T = 8
+    bf = client.get_sharded_bloom_filter_array("rs:warmpool")
+    assert bf.try_init(T, expected_insertions=50_000, false_probability=0.01)
+    keys = _keys(rng, 256)
+    tenant = (np.arange(256) % T).astype(np.int32)
+    assert bf.add_each(tenant, keys).all()
+    # one full roundtrip warms the pool for BOTH geometries
+    mgr.reshard(dp=1, shard=8)
+    assert bf.contains_each(tenant, keys).all()
+    mgr.reshard(dp=2, shard=4)
+    assert bf.contains_each(tenant, keys).all()
+
+    builds = []
+    real = MM.make_sharded_bloom_kernels
+    monkeypatch.setattr(
+        MM, "make_sharded_bloom_kernels",
+        lambda *a, **kw: (builds.append(kw.get("m")), real(*a, **kw))[1],
+    )
+    for _ in range(3):
+        mgr.reshard(dp=1, shard=8)
+        assert bf.contains_each(tenant, keys).all()
+        mgr.reshard(dp=2, shard=4)
+        assert bf.contains_each(tenant, keys).all()
+    assert not builds, f"sharded kernels recompiled across known epochs: {builds}"
+
+
+def test_warm_pool_size_bounded_and_steady_under_reshard_cycles(client):
+    """The pool must stay BOUNDED (LRU cap) and reach a steady size across
+    repeated 4->8->4 cycles — reshard churn can never grow it without
+    limit, and stale-epoch entries never linger in the EPOCH cache."""
+    mgr = MeshManager.of(client._engine)
+    rng = np.random.default_rng(12)
+    T = 8
+    bf = client.get_sharded_bloom_filter_array("rs:warmbound")
+    assert bf.try_init(T, expected_insertions=50_000, false_probability=0.01)
+    keys = _keys(rng, 256)
+    tenant = (np.arange(256) % T).astype(np.int32)
+    assert bf.add_each(tenant, keys).all()
+
+    sizes = []
+    for _ in range(5):
+        for dp, shard in ((1, 8), (2, 4)):
+            mgr.reshard(dp=dp, shard=shard)
+            assert bf.contains_each(tenant, keys).all()
+            with mgr._guard:
+                assert all(k[0] == mgr._epoch for k in mgr._kernels)
+                assert len(mgr._warm) <= MeshManager.WARM_POOL_MAX
+        with mgr._guard:
+            sizes.append(len(mgr._warm))
+    assert len(set(sizes)) == 1, f"warm pool grew across reshard cycles: {sizes}"
+
+
+def test_engine_warm_pool_prewarm_is_idempotent(client):
+    """Single-chip warm pool (core/warmpool): prewarm compiles each
+    (verb, shape, dtype, epoch) combination ONCE; a second prewarm over the
+    same store is a no-op and the pool stays bounded."""
+    bf = client.get_bloom_filter("rs:enginewarm")
+    assert bf.try_init(10_000, 0.01)
+    first = client._engine.prewarm(names=["rs:enginewarm"])
+    assert first >= 1
+    again = client._engine.prewarm(names=["rs:enginewarm"])
+    assert again == 0, "second prewarm recompiled warm programs"
+    pool = client._engine.warm_pool
+    assert pool.stats()["entries"] <= 512
+    # prewarm used a throwaway plane: the real record is untouched
+    assert bf.count() == 0
+    assert bf.add_all(np.arange(64, dtype=np.int64)) == 64
+    assert bf.contains_each(np.arange(64, dtype=np.int64)).all()
+
+
 def test_reshard_validates_geometry(client):
     mgr = MeshManager.of(client._engine)
     with pytest.raises(ValueError):

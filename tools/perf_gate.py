@@ -1,0 +1,179 @@
+"""Perf regression gate (ISSUE 2 satellite): compare a fresh bench.py run
+against the latest recorded BENCH_rNN.json, per config.
+
+The headline throughput slid three rounds in a row (8.17M -> 8.03M -> 7.71M
+contains/s, BENCH_r03..r05) before anyone was forced to look; this gate makes
+that slide impossible to miss again.  It is the pre-commit perf ritual
+(README "Performance"): run bench.py on the chip, feed the JSON here, commit
+only when the gate is green or the miss is explicitly traded out in ROADMAP.
+
+Usage:
+  python tools/perf_gate.py --fresh out.json      # out.json = bench.py stdout
+  python bench.py | tee out.txt; python tools/perf_gate.py --fresh out.txt
+  python tools/perf_gate.py --run                 # runs bench.py itself
+  python tools/perf_gate.py --fresh out.json --baseline BENCH_r03.json
+
+Inputs accept either the raw bench.py JSON line (possibly embedded in other
+stdout) or a recorded BENCH_rNN.json wrapper ({"parsed": {...}}).  Baseline
+defaults to the highest-numbered BENCH_r*.json in the repo root.
+
+Gate rule: exit nonzero on a >5% drop (--threshold) in the HEADLINE metric
+(windowed bank contains/s) or CONFIG5 (cluster mixed ops/s).  Every other
+tracked metric prints in the regression table and flags WARN on a drop —
+visible, but advisory (tunnel variance on the secondary configs is real;
+the two gated numbers are windowed/best-of and stable).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (label, extractor-path, higher_is_better, gated)
+METRICS = [
+    ("headline bank contains/s", ("value",), True, True),
+    ("config5 cluster mixed ops/s", ("details", "config5_cluster_mixed_ops_per_sec"), True, True),
+    ("config1 single contains/s", ("details", "config1_single_filter_contains_per_sec"), True, False),
+    ("config2 flush p99 ms", ("details", "config2_flush_p99_ms"), False, False),
+    ("config3 hll add/s", ("details", "config3_hll_add_per_sec"), True, False),
+    ("config3 hll merge pairs/s", ("details", "config3_hll_merge_pairs_per_sec"), True, False),
+    ("config4 mapreduce entries/s", ("details", "config4_mapreduce_entries_per_sec"), True, False),
+    ("config4 mapreduce COLD entries/s", ("details", "config4_mapreduce_cold_entries_per_sec"), True, False),
+]
+
+
+def _extract(doc: dict, path: Tuple[str, ...]) -> Optional[float]:
+    cur = doc
+    for key in path:
+        if not isinstance(cur, dict) or key not in cur:
+            return None
+        cur = cur[key]
+    try:
+        return float(cur)
+    except (TypeError, ValueError):
+        return None
+
+
+def load_bench_doc(text: str) -> dict:
+    """Parse a bench result from raw text: a BENCH_rNN wrapper, the bare
+    bench.py JSON object, or stdout containing the JSON line."""
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            if "parsed" in doc and isinstance(doc["parsed"], dict):
+                return doc["parsed"]
+            if "metric" in doc:
+                return doc
+    except json.JSONDecodeError:
+        pass
+    # scan line-wise for the bench JSON object (bench.py logs to stderr, but
+    # callers often tee both streams into one file)
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict) and "metric" in doc:
+            return doc
+    raise SystemExit("no bench.py JSON result found in input")
+
+
+def latest_baseline_path() -> str:
+    paths = glob.glob(os.path.join(REPO, "BENCH_r*.json"))
+    if not paths:
+        raise SystemExit("no BENCH_r*.json baseline found in repo root")
+
+    def round_no(p: str) -> int:
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    return max(paths, key=round_no)
+
+
+def compare(baseline: dict, fresh: dict, threshold: float) -> Tuple[list, bool]:
+    """Per-metric rows + overall gate verdict."""
+    rows = []
+    ok = True
+    for label, path, higher, gated in METRICS:
+        b = _extract(baseline, path)
+        f = _extract(fresh, path)
+        if b is None or f is None or b == 0:
+            rows.append((label, b, f, None, "n/a"))
+            continue
+        delta = (f - b) / b if higher else (b - f) / b
+        regressed = delta < -threshold
+        status = "OK"
+        if regressed:
+            status = "FAIL" if gated else "WARN"
+            if gated:
+                ok = False
+        elif delta < 0:
+            status = "fail(soft)" if gated else "warn(soft)"
+        rows.append((label, b, f, delta, status))
+    return rows, ok
+
+
+def render(rows, threshold: float) -> str:
+    out = [
+        f"{'metric':<34} {'baseline':>14} {'fresh':>14} {'delta':>8}  verdict",
+        "-" * 82,
+    ]
+    for label, b, f, delta, status in rows:
+        bs = f"{b:,.0f}" if isinstance(b, float) else "-"
+        fs = f"{f:,.0f}" if isinstance(f, float) else "-"
+        ds = f"{delta*+100:+.1f}%" if delta is not None else "-"
+        out.append(f"{label:<34} {bs:>14} {fs:>14} {ds:>8}  {status}")
+    out.append("-" * 82)
+    out.append(
+        f"gate: >{threshold:.0%} drop in headline or config5 fails; "
+        "other drops are advisory (WARN)"
+    )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="bench.py regression gate")
+    ap.add_argument("--fresh", help="file holding a fresh bench.py result")
+    ap.add_argument("--run", action="store_true", help="run bench.py now")
+    ap.add_argument("--baseline", help="baseline file (default: latest BENCH_r*.json)")
+    ap.add_argument("--threshold", type=float, default=0.05)
+    args = ap.parse_args(argv)
+
+    if bool(args.fresh) == bool(args.run):
+        ap.error("exactly one of --fresh/--run is required")
+    if args.run:
+        import subprocess
+
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            stdout=subprocess.PIPE, text=True,
+        )
+        if p.returncode != 0:
+            raise SystemExit(f"bench.py failed rc={p.returncode}")
+        fresh = load_bench_doc(p.stdout)
+    else:
+        with open(args.fresh) as fh:
+            fresh = load_bench_doc(fh.read())
+
+    bpath = args.baseline or latest_baseline_path()
+    with open(bpath) as fh:
+        baseline = load_bench_doc(fh.read())
+
+    rows, ok = compare(baseline, fresh, args.threshold)
+    print(f"baseline: {os.path.basename(bpath)}")
+    print(render(rows, args.threshold))
+    print("GATE:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
