@@ -157,6 +157,72 @@ def bench_config2_tenant_bank(client):
     post = probe_d2h()
     d2h_post = pctl(post, 50) * 1e3
     d2h_post_p99 = pctl(post, 99) * 1e3
+
+    # -- overlapped-vs-serial flush A/B (ISSUE 3 device I/O plane) ----------
+    # The same serving flush driven through ioplane.FlushPipeline both ways:
+    # serial (--no-overlap shape: counted barrier + forced fetch per window)
+    # vs dispatch-ahead depth 2 (window i+1's staging/upload/kernel overlap
+    # window i's readback).  Overlap efficiency = hidden readback ms /
+    # total readback ms, where total is the serial run's barrier+fetch time
+    # and hidden is the part the overlapped run no longer exposes.  Runs
+    # LAST in the config, after the floor probes and the post-window
+    # re-probe: its 2x12 computed-result fetches must not contaminate the
+    # floor/latency numbers recorded above (both A/B legs run on the same
+    # post-window transport, so their RELATIVE comparison stays honest).
+    from redisson_tpu.core import ioplane
+    from redisson_tpu.core import kernels as _K
+
+    def window_fn(t_, k_):
+        def fn():
+            packed, n = arr.contains_async(t_, k_)
+            return (packed,), (lambda host, n=n: _K.unpack_found(host[0], n))
+        return fn
+
+    reps_ab = 12
+    ab = {}
+    ab_last = {}
+    for mode in ("serial", "overlapped"):
+        pipe = ioplane.FlushPipeline(overlap=(mode == "overlapped"), depth=2)
+        ioplane.STATS.reset()
+        t0 = time.perf_counter()
+        futs = [
+            pipe.submit(window_fn(*flushes[i % len(flushes)]))
+            for i in range(reps_ab)
+        ]
+        pipe.drain()
+        wall_ab = time.perf_counter() - t0
+        snap = ioplane.STATS.snapshot()
+        ab[mode] = {
+            "wall_ms": round(wall_ab * 1e3, 3),
+            "readback_ms": round(
+                (snap["barrier_wait_s"] + snap["readback_wait_s"]) * 1e3, 3
+            ),
+            "exposed_readback_ms": round(snap["readback_exposed_s"] * 1e3, 3),
+            "blocking_syncs": snap["blocking_syncs"],
+        }
+        ab_last[mode] = futs[-1].result()
+    assert np.array_equal(ab_last["serial"], ab_last["overlapped"]), (
+        "overlap plane must be bit-identical to the serial path"
+    )
+    serial_total_ms = ab["serial"]["readback_ms"]
+    hidden_ms = max(0.0, serial_total_ms - ab["overlapped"]["exposed_readback_ms"])
+    overlap_eff = hidden_ms / serial_total_ms if serial_total_ms > 0 else 0.0
+    overlap_detail = {
+        "windows": reps_ab,
+        "phase": "post-window (after floor probes; see comment)",
+        "serial": ab["serial"],
+        "overlapped": ab["overlapped"],
+        "hidden_readback_ms": round(hidden_ms, 3),
+        "total_readback_ms": round(serial_total_ms, 3),
+        "overlap_efficiency": round(overlap_eff, 3),
+    }
+    log(
+        f"config2: overlap A/B ({reps_ab} windows): serial wall "
+        f"{ab['serial']['wall_ms']:.1f}ms ({ab['serial']['blocking_syncs']} syncs), "
+        f"overlapped wall {ab['overlapped']['wall_ms']:.1f}ms "
+        f"({ab['overlapped']['blocking_syncs']} syncs), hidden readback "
+        f"{hidden_ms:.1f}/{serial_total_ms:.1f}ms = {overlap_eff:.0%} efficiency"
+    )
     log(
         f"config2: {ops_per_sec/1e6:.2f}M contains/s (best of {len(rates)} windows "
         f"of {reps} flushes, one buffer each: {['%.2fM' % (r/1e6) for r in rates]}), "
@@ -169,6 +235,7 @@ def bench_config2_tenant_bank(client):
     return ops_per_sec, {
         "flush_p50_ms": round(p50, 3),
         "flush_p99_ms": round(p99, 3),
+        "overlap": overlap_detail,
         "tunnel_computed_fetch_floor_ms": round(d2h_floor, 3),
         "tunnel_computed_fetch_floor_p99_ms": round(d2h_floor_p99, 3),
         "tunnel_h2d_query_ms": round(h2d_floor, 3),
@@ -619,6 +686,7 @@ def main():
                     "config1_single_filter_contains_per_sec": results["1"]["single_filter_contains_per_sec"],
                     "config2_flush_p99_ms": results["2"]["flush_p99_ms"],
                     "config2_flush_latency": results["2"].get("flush_latency"),
+                    "config2_overlap": (results["2"].get("flush_latency") or {}).get("overlap"),
                     "config2_fresh_session_latency": results["2L"].get("fresh_latency"),
                     "config2_async_parity": results["2A"].get("async_parity"),
                     "config3_hll_add_per_sec": results["3"]["hll_add_per_sec"],

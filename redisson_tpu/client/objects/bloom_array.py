@@ -111,7 +111,7 @@ class BloomFilterArray(RExpirable):
 
         def build():
             lo, hi = H.int_keys_to_u32_pair(arr)
-            return K.pack_rows(t, lo, hi, size=b)
+            return K.pack_rows(t, lo, hi, size=b, pool=self._engine.staging_pool())
 
         if cache_hot and n >= 4096:
             return K.cached_staged(build, t, arr, extra=b"bfa%d" % b), n
@@ -230,11 +230,27 @@ class BloomFilterArray(RExpirable):
                 dst[:, n:bb] = dst[:, n - 1 : n]
 
         if len(rows) == len(flushes):
-            # all distinct: one flat buffer, no device-side composition
-            buf = np.zeros((3, len(rows) * bb), np.uint32)
-            for i, (t, arr) in enumerate(rows):
-                fill(buf[:, i * bb : (i + 1) * bb], t, arr)
-            return K.stage(buf), bb, lengths
+            # all distinct: one flat buffer, no device-side composition.
+            # The buffer comes from the engine's double-buffered staging
+            # pool (overlap plane): packing window W+1 overlaps window W's
+            # still-in-flight upload instead of waiting allocator + DMA.
+            pool = self._engine.staging_pool()
+            shape = (3, len(rows) * bb)
+            if pool is None:
+                buf, slot = np.zeros(shape, np.uint32), None
+            else:
+                buf, slot = pool.acquire(shape, np.uint32)
+            try:
+                for i, (t, arr) in enumerate(rows):
+                    fill(buf[:, i * bb : (i + 1) * bb], t, arr)
+                staged = K.stage(buf)
+            except BaseException:
+                if pool is not None:
+                    pool.release(slot)  # never leak a busy slot on error
+                raise
+            if pool is not None:
+                pool.commit(slot, staged)
+            return staged, bb, lengths
         # repeated flushes: upload UNIQUE buffers once, compose the window
         # in HBM (kernels.window_from_unique) — R-x less tunnel traffic for
         # hot-set workloads that re-submit the same query buffers

@@ -116,16 +116,24 @@ class HyperLogLogArray(RExpirable):
 
     def estimate_all(self) -> np.ndarray:
         """Per-tenant cardinality estimates (one fused reduce over the bank)."""
+        return np.asarray(self.estimate_all_async())
+
+    def estimate_all_async(self):
+        """Pipelined estimate: the (T,) float64 result stays on DEVICE — the
+        server's reply path rides it as a readback future (overlap plane),
+        so an estimate sweep never blocks the frame that asked for it."""
         with self._engine.locked(self._name):
             rec = self._rec()
-            est = K.hll_estimate(rec.arrays["regs"])
-        return np.asarray(est)
+            return K.hll_estimate(rec.arrays["regs"])
 
     def estimate_union_pairs(self, a_ids, b_ids) -> np.ndarray:
         """PFCOUNT of union per (a, b) pair without mutating either row."""
+        return np.asarray(self.estimate_union_pairs_async(a_ids, b_ids))
+
+    def estimate_union_pairs_async(self, a_ids, b_ids):
+        """Pipelined pairwise union estimate (device result, no host sync)."""
         a = np.ascontiguousarray(a_ids, np.int32)
         b = np.ascontiguousarray(b_ids, np.int32)
         with self._engine.locked(self._name):
             rec = self._rec()
-            est = K.hll_bank_estimate_union_pairs(rec.arrays["regs"], a, b)
-        return np.asarray(est)
+            return K.hll_bank_estimate_union_pairs(rec.arrays["regs"], a, b)

@@ -23,21 +23,34 @@ import numpy as np
 
 
 class BatchFuture:
-    """Minimal completion handle (RFuture analog, misc/CompletableFutureWrapper)."""
+    """Minimal completion handle (RFuture analog, misc/CompletableFutureWrapper).
 
-    __slots__ = ("_value", "_error", "_done")
+    Under the overlap plane (core/ioplane) a future may complete LAZILY:
+    the dispatch happened, the result is a device-side readback future, and
+    the D2H transfer runs only when get() actually demands the value (or
+    when execute() drains every pending readback in one grouped transfer).
+    """
+
+    __slots__ = ("_value", "_error", "_done", "_resolve")
 
     def __init__(self):
         self._value = None
         self._error = None
         self._done = False
+        self._resolve = None
 
     def _complete(self, value):
         self._value = value
         self._done = True
 
+    def _complete_lazy(self, resolve):
+        """Dispatch done; `resolve()` materializes the value on demand."""
+        self._resolve = resolve
+        self._done = True
+
     def _fail(self, err):
         self._error = err
+        self._resolve = None
         self._done = True
 
     def done(self) -> bool:
@@ -46,6 +59,12 @@ class BatchFuture:
     def get(self):
         if not self._done:
             raise RuntimeError("batch not executed yet")
+        if self._resolve is not None:
+            resolve, self._resolve = self._resolve, None
+            try:
+                self._value = resolve()
+            except Exception as e:  # noqa: BLE001 — readback failure lands here
+                self._error = e
         if self._error is not None:
             raise self._error
         return self._value
@@ -105,7 +124,19 @@ class Batch:
     # -- execution ----------------------------------------------------------
 
     def execute(self) -> BatchResult:
-        """Group queued ops, one fused dispatch per group, scatter results."""
+        """Group queued ops, one fused dispatch per group, scatter results.
+
+        Overlap plane (core/ioplane, default on): groups DISPATCH in order
+        but their results stay on device as readback futures — the whole
+        batch then drains in ONE grouped D2H transfer (force_all) instead of
+        one blocking fetch per group, so group G+1's staging and kernel
+        overlap group G's readback.  With the plane off (--no-overlap /
+        set_overlap(False)) every group forces its results before the next
+        dispatches — the serial A/B reference.  Results are bit-identical in
+        both modes: the plane reorders host WAITS, never device work (the
+        device stream is in-order and mutations apply at dispatch time)."""
+        from redisson_tpu.core import ioplane
+
         if self._executed:
             raise RuntimeError("batch already executed")
         self._executed = True
@@ -114,10 +145,16 @@ class Batch:
         for op in self._ops:
             groups.setdefault(op.group, []).append(op)
             order.append(op)
+        # pending device readbacks (overlap mode); None = serial dispatch
+        pending: Optional[List] = [] if ioplane.overlap_enabled() else None
 
         def run_one(group, ops):
             try:
-                _DISPATCH[group[1]](self._engine, group, ops)
+                fn = None if pending is None else _DISPATCH_LAZY.get(group[1])
+                if fn is not None:
+                    fn(self._engine, group, ops, pending)
+                else:
+                    _DISPATCH[group[1]](self._engine, group, ops)
             except Exception as e:  # noqa: BLE001 - failures land on futures
                 for op in ops:
                     if not op.future.done():
@@ -142,7 +179,7 @@ class Batch:
                     while j < len(items) and items[j][0][1] == verb:
                         j += 1
                     if j - i >= 2 and _try_fused_run(
-                        self._engine, verb, items[i:j]
+                        self._engine, verb, items[i:j], pending
                     ):
                         i = j
                         continue
@@ -152,7 +189,9 @@ class Batch:
                         and j < len(items)
                         and items[j][0][1] == "bloom.contains"
                         and items[j][0][0] == group[0]
-                        and _try_fused_pair(self._engine, items[i], items[j])
+                        and _try_fused_pair(
+                            self._engine, items[i], items[j], pending
+                        )
                     ):
                         i = j + 1
                         continue
@@ -165,7 +204,12 @@ class Batch:
         else:
             run_groups()
         if self._skip_result:
+            # results were never demanded: pending readbacks stay on device
+            # (a later fut.get() still resolves them individually)
             return BatchResult([])
+        if pending:
+            # THE one grouped D2H transfer for the whole batch's readbacks
+            ioplane.force_all(pending)
         return BatchResult([op.future.get() for op in order])
 
 
@@ -180,11 +224,30 @@ def _group_int_keys(engine, ops: List[_QueuedOp]) -> Optional[np.ndarray]:
     return _concat_int_keys(ops)
 
 
-def _try_fused_run(engine, verb: str, run) -> bool:
+def _assign_lazy_slices(ops: List[_QueuedOp], rb, start: int = 0,
+                        summed: bool = False) -> int:
+    """Complete each op's future with a lazy slice of `rb.result()` —
+    demand-driven readback (overlap plane).  Returns the end offset."""
+    off = start
+    for op in ops:
+        o, w = off, op.n
+        if summed:
+            op.future._complete_lazy(
+                lambda o=o, w=w: int(rb.result()[o : o + w].sum())
+            )
+        else:
+            op.future._complete_lazy(lambda o=o, w=w: rb.result()[o : o + w])
+        off += w
+    return off
+
+
+def _try_fused_run(engine, verb: str, run, pending=None) -> bool:
     """Fuse a run of >=2 consecutive same-verb bloom groups into ONE stacked
     dispatch.  True = futures completed (or failed); False = ineligible,
-    caller dispatches per group."""
+    caller dispatches per group.  With `pending` (overlap plane) the run's
+    result stays on device as one readback future the batch drains later."""
     from redisson_tpu.core import coalesce as CO
+    from redisson_tpu.core import ioplane
 
     names = [group[0] for group, _ops in run]
     keys_list = []
@@ -196,20 +259,34 @@ def _try_fused_run(engine, verb: str, run) -> bool:
     try:
         if verb == "bloom.contains":
             found, _lengths = CO.fused_bloom_contains_async(engine, names, keys_list)
-            flat = np.asarray(found)
-            off = 0
-            for _group, ops in run:
-                for op in ops:
-                    op.future._complete(flat[off : off + op.n])
-                    off += op.n
+            if pending is not None:
+                rb = ioplane.ReadbackFuture((found,))
+                pending.append(rb)
+                off = 0
+                for _group, ops in run:
+                    off = _assign_lazy_slices(ops, rb, off)
+            else:
+                flat = np.asarray(found)
+                off = 0
+                for _group, ops in run:
+                    for op in ops:
+                        op.future._complete(flat[off : off + op.n])
+                        off += op.n
         else:
             newly, _lengths = CO.fused_bloom_add_async(engine, names, keys_list)
-            flat = np.asarray(newly)
-            off = 0
-            for _group, ops in run:
-                for op in ops:
-                    op.future._complete(int(flat[off : off + op.n].sum()))
-                    off += op.n
+            if pending is not None:
+                rb = ioplane.ReadbackFuture((newly,))
+                pending.append(rb)
+                off = 0
+                for _group, ops in run:
+                    off = _assign_lazy_slices(ops, rb, off, summed=True)
+            else:
+                flat = np.asarray(newly)
+                off = 0
+                for _group, ops in run:
+                    for op in ops:
+                        op.future._complete(int(flat[off : off + op.n].sum()))
+                        off += op.n
     except CO.CoalesceIneligible:
         return False
     except Exception as e:  # noqa: BLE001 — failures land on the run's futures
@@ -220,11 +297,12 @@ def _try_fused_run(engine, verb: str, run) -> bool:
     return True
 
 
-def _try_fused_pair(engine, add_item, probe_item) -> bool:
+def _try_fused_pair(engine, add_item, probe_item, pending=None) -> bool:
     """Fuse the add-then-contains hot pair on ONE filter into a single
     program (kernels.bloom_fused_add_contains): the probe group observes the
     adds, exactly as the sequential group order would."""
     from redisson_tpu.core import coalesce as CO
+    from redisson_tpu.core import ioplane
 
     (add_group, add_ops), (probe_group, probe_ops) = add_item, probe_item
     add_keys = _group_int_keys(engine, add_ops)
@@ -237,12 +315,19 @@ def _try_fused_pair(engine, add_item, probe_item) -> bool:
         newly, n_add, found, n_probe = CO.fused_bloom_pair_async(
             engine, add_group[0], add_keys, probe_keys
         )
-        newly = np.asarray(newly)[:n_add]
-        off = 0
-        for op in add_ops:
-            op.future._complete(int(newly[off : off + op.n].sum()))
-            off += op.n
-        _scatter(probe_ops, np.asarray(found))
+        if pending is not None:
+            rb_add = ioplane.ReadbackFuture((newly,), lambda h: h[0][:n_add])
+            rb_probe = ioplane.ReadbackFuture((found,))
+            pending.extend((rb_add, rb_probe))
+            _assign_lazy_slices(add_ops, rb_add, summed=True)
+            _assign_lazy_slices(probe_ops, rb_probe)
+        else:
+            newly = np.asarray(newly)[:n_add]
+            off = 0
+            for op in add_ops:
+                op.future._complete(int(newly[off : off + op.n].sum()))
+                off += op.n
+            _scatter(probe_ops, np.asarray(found))
     except CO.CoalesceIneligible:
         return False
     except Exception as e:  # noqa: BLE001
@@ -275,6 +360,36 @@ def _concat_int_keys(ops: List[_QueuedOp]) -> np.ndarray:
     return out
 
 
+def _concat_field(ops: List[_QueuedOp], index: Optional[int], dtype) -> np.ndarray:
+    """Concatenate one payload field of every op into ONE preallocated
+    buffer (the _concat_int_keys discipline for tuple payloads: no per-op
+    intermediate array before the final copy — at batch fan-outs that numpy
+    churn is measurable host overhead on the hot path).  `index` picks the
+    payload tuple element; None takes the payload itself."""
+    pick = (lambda op: op.payload) if index is None else (lambda op: op.payload[index])
+    if len(ops) == 1:
+        return np.ascontiguousarray(np.asarray(pick(ops[0]), dtype).reshape(-1))
+    arrs = [np.asarray(pick(op), dtype).reshape(-1) for op in ops]
+    out = np.empty(sum(a.shape[0] for a in arrs), dtype)
+    off = 0
+    for a in arrs:
+        out[off : off + a.shape[0]] = a
+        off += a.shape[0]
+    return out
+
+
+def _group_keys(engine, ops: List[_QueuedOp]):
+    """One group's key payloads: int batches concatenate into ONE
+    preallocated buffer; codec-encoded payloads flatten to a list."""
+    if all(engine.is_int_batch(np.asarray(op.payload)) for op in ops):
+        return _concat_int_keys(ops)
+    return [
+        k
+        for op in ops
+        for k in (op.payload if isinstance(op.payload, list) else [op.payload])
+    ]
+
+
 def _key_count(keys) -> int:
     """Result-slice width of a queued key payload: scalars (incl. str/bytes,
     which have misleading __len__) contribute 1 result; sequences their
@@ -299,53 +414,62 @@ def _scatter(ops: List[_QueuedOp], results: np.ndarray):
 def _bloom_contains(engine, group, ops):
     from redisson_tpu.client.objects.bloom import BloomFilter
 
-    name = group[0]
-    bf = BloomFilter(engine, name, group[2])
-    if all(engine.is_int_batch(np.asarray(op.payload)) for op in ops):
-        keys = _concat_int_keys(ops)
-    else:
-        keys = [k for op in ops for k in (op.payload if isinstance(op.payload, list) else [op.payload])]
-    found = bf.contains_each(keys)
+    bf = BloomFilter(engine, group[0], group[2])
+    found = bf.contains_each(_group_keys(engine, ops))
     _scatter(ops, found)
+
+
+def _bloom_contains_lazy(engine, group, ops, pending):
+    """Dispatch-only contains: the result bitmap stays on device; each op's
+    future resolves a slice when demanded (overlap plane)."""
+    from redisson_tpu.client.objects.bloom import BloomFilter
+    from redisson_tpu.core import ioplane
+    from redisson_tpu.core import kernels as K
+
+    bf = BloomFilter(engine, group[0], group[2])
+    found, n = bf.contains_each_async(_group_keys(engine, ops))
+
+    def finish(host):
+        arr = host[0]
+        if arr.dtype == np.uint32:  # packed-bitmap fast path (u64 keys)
+            return K.unpack_found(arr, n)
+        return arr[:n]
+
+    rb = ioplane.ReadbackFuture((found,), finish)
+    pending.append(rb)
+    _assign_lazy_slices(ops, rb)
 
 
 def _bloom_add(engine, group, ops):
     from redisson_tpu.client.objects.bloom import BloomFilter
 
-    name = group[0]
-    bf = BloomFilter(engine, name, group[2])
+    bf = BloomFilter(engine, group[0], group[2])
     # adds complete with per-op "new element" counts; one fused kernel call
-    sizes = [op.n for op in ops]
-    if all(engine.is_int_batch(np.asarray(op.payload)) for op in ops):
-        keys = _concat_int_keys(ops)
-    else:
-        keys = [k for op in ops for k in (op.payload if isinstance(op.payload, list) else [op.payload])]
-    kind, arrays, n = engine.pack_keys(keys, bf.codec)
-    from redisson_tpu.core import kernels as K
-
-    with engine.locked(name):
-        rec = bf._rec()
-        m, k = rec.meta["m"], rec.meta["k"]
-        if kind == "u64":
-            bits, newly = K.bloom_add_packed(rec.arrays["bits"], arrays, n, k, m)
-        else:
-            words, nbytes = arrays
-            bits, newly = K.bloom_add_bytes_masked(rec.arrays["bits"], words, nbytes, n, k, m)
-        rec.arrays["bits"] = bits
-        rec.version += 1
+    newly, n = bf.add_each_async(_group_keys(engine, ops))
     newly = np.asarray(newly)[:n]
     off = 0
-    for op, sz in zip(ops, sizes):
-        op.future._complete(int(newly[off : off + sz].sum()))
-        off += sz
+    for op in ops:
+        op.future._complete(int(newly[off : off + op.n].sum()))
+        off += op.n
+
+
+def _bloom_add_lazy(engine, group, ops, pending):
+    from redisson_tpu.client.objects.bloom import BloomFilter
+    from redisson_tpu.core import ioplane
+
+    bf = BloomFilter(engine, group[0], group[2])
+    newly, n = bf.add_each_async(_group_keys(engine, ops))
+    rb = ioplane.ReadbackFuture((newly,), lambda host: host[0][:n])
+    pending.append(rb)
+    _assign_lazy_slices(ops, rb, summed=True)
 
 
 def _bloom_array_op(engine, group, ops, add: bool):
     from redisson_tpu.client.objects.bloom_array import BloomFilterArray
 
     arr = BloomFilterArray(engine, group[0])
-    tenants = np.concatenate([np.asarray(op.payload[0], np.int32).reshape(-1) for op in ops])
-    keys = np.concatenate([np.asarray(op.payload[1], np.int64).reshape(-1) for op in ops])
+    tenants = _concat_field(ops, 0, np.int32)
+    keys = _concat_field(ops, 1, np.int64)
     if add:
         newly = arr.add_each(tenants, keys)
         off = 0
@@ -357,15 +481,35 @@ def _bloom_array_op(engine, group, ops, add: bool):
         _scatter(ops, found)
 
 
+def _bloom_array_op_lazy(engine, group, ops, pending, add: bool):
+    from redisson_tpu.client.objects.bloom_array import BloomFilterArray
+    from redisson_tpu.core import ioplane
+    from redisson_tpu.core import kernels as K
+
+    arr = BloomFilterArray(engine, group[0])
+    tenants = _concat_field(ops, 0, np.int32)
+    keys = _concat_field(ops, 1, np.int64)
+    if add:
+        newly, n = arr.add_each_async(tenants, keys)
+        rb = ioplane.ReadbackFuture((newly,), lambda host: host[0][:n])
+        pending.append(rb)
+        _assign_lazy_slices(ops, rb, summed=True)
+    else:
+        packed, n = arr.contains_async(tenants, keys)
+        rb = ioplane.ReadbackFuture(
+            (packed,), lambda host: K.unpack_found(host[0], n)
+        )
+        pending.append(rb)
+        _assign_lazy_slices(ops, rb)
+
+
 def _hll_add(engine, group, ops):
     from redisson_tpu.client.objects.hyperloglog import HyperLogLog
 
     h = HyperLogLog(engine, group[0], group[2])
-    if all(engine.is_int_batch(np.asarray(op.payload)) for op in ops):
-        keys = _concat_int_keys(ops)
-    else:
-        keys = [k for op in ops for k in (op.payload if isinstance(op.payload, list) else [op.payload])]
-    h.add_all(keys)
+    # add_all dispatches without a host sync (the register plane is donated
+    # on device); PFADD-style True is the whole reply — nothing to read back
+    h.add_all(_group_keys(engine, ops))
     for op in ops:
         op.future._complete(True)
 
@@ -374,19 +518,41 @@ def _bitset_set(engine, group, ops):
     from redisson_tpu.client.objects.bitset import BitSet
 
     bs = BitSet(engine, group[0])
-    idx = np.concatenate([np.asarray(op.payload[0], np.int64).reshape(-1) for op in ops])
+    idx = _concat_field(ops, 0, np.int64)
     value = group[2]
     old = bs.set_each(idx, value)
     _scatter(ops, old)
+
+
+def _bitset_set_lazy(engine, group, ops, pending):
+    from redisson_tpu.client.objects.bitset import BitSet
+    from redisson_tpu.core import ioplane
+
+    bs = BitSet(engine, group[0])
+    old, n = bs.set_each_async(_concat_field(ops, 0, np.int64), group[2])
+    rb = ioplane.ReadbackFuture((old,), lambda host: host[0][:n])
+    pending.append(rb)
+    _assign_lazy_slices(ops, rb)
 
 
 def _bitset_get(engine, group, ops):
     from redisson_tpu.client.objects.bitset import BitSet
 
     bs = BitSet(engine, group[0])
-    idx = np.concatenate([np.asarray(op.payload[0], np.int64).reshape(-1) for op in ops])
+    idx = _concat_field(ops, 0, np.int64)
     got = bs.get_each(idx)
     _scatter(ops, got)
+
+
+def _bitset_get_lazy(engine, group, ops, pending):
+    from redisson_tpu.client.objects.bitset import BitSet
+    from redisson_tpu.core import ioplane
+
+    bs = BitSet(engine, group[0])
+    got, n = bs.get_each_async(_concat_field(ops, 0, np.int64))
+    rb = ioplane.ReadbackFuture((got,), lambda host: host[0][:n])
+    pending.append(rb)
+    _assign_lazy_slices(ops, rb)
 
 
 def _bucket_get(engine, group, ops):
@@ -426,6 +592,19 @@ _DISPATCH: Dict[str, Callable] = {
     "bucket.get": _bucket_get,
     "bucket.set": _bucket_set,
     "atomic.add": _atomic_add,
+}
+
+# Overlap-plane dispatchers (core/ioplane): dispatch WITHOUT forcing — the
+# group's device results join the batch's pending readbacks and drain in one
+# grouped transfer at execute() end.  Verbs without a lazy form (host-value
+# ops: buckets, atomics, hll's constant True) use _DISPATCH in both modes.
+_DISPATCH_LAZY: Dict[str, Callable] = {
+    "bloom.contains": _bloom_contains_lazy,
+    "bloom.add": _bloom_add_lazy,
+    "bloom_array.add": lambda e, g, o, p: _bloom_array_op_lazy(e, g, o, p, True),
+    "bloom_array.contains": lambda e, g, o, p: _bloom_array_op_lazy(e, g, o, p, False),
+    "bitset.set": _bitset_set_lazy,
+    "bitset.get": _bitset_get_lazy,
 }
 
 

@@ -98,12 +98,14 @@ def _validated_records(engine, names: Sequence[str]):
     return recs, m, k
 
 
-def _pack_window(slot: np.ndarray, keys: np.ndarray):
-    """(slot, keys) -> staged (3, B) uint32 transfer buffer + n_valid."""
+def _pack_window(engine, slot: np.ndarray, keys: np.ndarray):
+    """(slot, keys) -> staged (3, B) uint32 transfer buffer + n_valid.
+    Staged through the engine's double-buffered pool (overlap plane): one
+    wave's packing overlaps the previous wave's in-flight upload."""
     n = keys.shape[0]
     b = K.bucket_size(n)
     lo, hi = H.int_keys_to_u32_pair(keys)
-    return K.pack_rows(slot, lo, hi, size=b), n
+    return K.pack_rows(slot, lo, hi, size=b, pool=engine.staging_pool()), n
 
 
 def fused_bloom_contains_async(engine, names: Sequence[str], keys_list):
@@ -114,7 +116,7 @@ def fused_bloom_contains_async(engine, names: Sequence[str], keys_list):
     sync: callers force on their own result path (frame-level gather on
     the server, np.asarray in the batch layer)."""
     slot, keys, lengths = _concat_segments(engine, keys_list)
-    tlh, n = _pack_window(slot, keys)
+    tlh, n = _pack_window(engine, slot, keys)
     import jax.numpy as jnp
 
     with engine.locked_many(set(names)):
@@ -133,7 +135,7 @@ def fused_bloom_add_async(engine, names: Sequence[str], keys_list):
             "duplicate filter in add run (second group must observe the first)"
         )
     slot, keys, lengths = _concat_segments(engine, keys_list)
-    tlh, n = _pack_window(slot, keys)
+    tlh, n = _pack_window(engine, slot, keys)
     import jax.numpy as jnp
 
     with engine.locked_many(set(names)):

@@ -60,6 +60,11 @@ class Engine:
         # the ONE shared wheel timer (ServiceManager's HashedWheelTimer role)
         self._renewals: dict[tuple, Any] = {}
         self._services: dict = {}
+        # overlapped device I/O plane (core/ioplane): double-buffered host
+        # staging shared by every flush packer of this engine
+        from redisson_tpu.core import ioplane
+
+        self.staging = ioplane.StagingPool()
 
     def service(self, key: str, factory):
         """Engine-scoped lazy singleton (script cache, search indexes, ...)
@@ -353,6 +358,19 @@ class Engine:
 
         return warmpool.prewarm_store(self, names=names, buckets=buckets)
 
+    # -- overlapped device I/O ----------------------------------------------
+
+    def staging_pool(self):
+        """The engine's double-buffered host staging pool — or None when the
+        overlap plane is off (--no-overlap: serial A/B reference) or the
+        backend zero-copy-aliases host memory (CPU jax), where slot reuse
+        would corrupt a staged value (ioplane.staging_reuse_safe)."""
+        from redisson_tpu.core import ioplane
+
+        if ioplane.overlap_enabled() and ioplane.staging_reuse_safe():
+            return self.staging
+        return None
+
     # -- key packing --------------------------------------------------------
 
     @staticmethod
@@ -384,7 +402,7 @@ class Engine:
 
             def build():
                 lo, hi = H.int_keys_to_u32_pair(arr)
-                return K.pack_rows(lo, hi, size=b)
+                return K.pack_rows(lo, hi, size=b, pool=self.staging_pool())
 
             if cache_hot and n >= 4096:
                 # hot-set reuse, READ paths only (kernels.cached_staged): a
@@ -426,6 +444,7 @@ class Engine:
         if eviction is not None:
             eviction.close()
         self.pubsub.close()
+        self.staging.clear()
         self.store.flushall()
 
 

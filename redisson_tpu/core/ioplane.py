@@ -1,0 +1,436 @@
+"""Overlapped device I/O plane: double-buffered H2D staging, dispatch-ahead,
+demand-driven D2H readback (ISSUE 3 tentpole).
+
+The flush path used to execute stage -> dispatch -> fetch strictly in series:
+every window paid a blocking host->device staging barrier AND a blocking
+computed-result fetch (~66ms fixed through the tunnel, BENCH_r05's
+"computed-result fetch floor") before the next window could even stage.  The
+reference never serializes this way — every command is async at the
+CommandAsyncExecutor boundary and the wire only waits on results the caller
+demanded.  This module is the device-side analog of that contract:
+
+  * **Staging** (`StagingPool`): flush packing fills one of `depth` reusable
+    host buffers; the upload of buffer B overlaps the refill of buffer A.  A
+    slot is only re-issued once its previous upload has materialized on
+    device, so reuse can never corrupt an in-flight copy.
+  * **Dispatch-ahead** (`FlushPipeline`): up to `depth` windows stay
+    dispatched-but-unfetched; window i+1's upload and kernel overlap window
+    i's readback.
+  * **Readback futures** (`ReadbackFuture`): kernel outputs stay on device as
+    lazy handles; the D2H transfer happens only when a result is actually
+    demanded (`result()`), and co-pending futures can drain in ONE grouped
+    transfer (`force_all` / `gather_device_results` — the server's
+    `_force_lazies` seam generalized).
+
+Disable with ``--no-overlap`` (tpu-server flag) or ``set_overlap(False)`` /
+``RTPU_NO_OVERLAP=1`` for A/B measurement: the disabled plane reproduces the
+serial stage/dispatch/fetch shape exactly, and results are bit-identical in
+both modes (the plane reorders WAITS, never device work — the device stream
+stays in-order).
+
+Accounting (`STATS`) counts blocking device syncs and exposed readback time;
+the structural contract CI pins (tests/test_perf_smoke.py) is: N flush
+windows cost <= N+1 blocking syncs overlapped vs 2N serial.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# -- global switch ------------------------------------------------------------
+
+_overlap = os.environ.get("RTPU_NO_OVERLAP", "") not in ("1", "true", "yes")
+
+
+def overlap_enabled() -> bool:
+    return _overlap
+
+
+def set_overlap(on: bool) -> bool:
+    """Flip the process-global overlap switch; returns the previous value
+    (callers restore it — the A/B discipline of bench.py)."""
+    global _overlap
+    prev = _overlap
+    _overlap = bool(on)
+    return prev
+
+
+_staging_safe: Optional[bool] = None
+
+
+def staging_reuse_safe() -> bool:
+    """Pooled host-buffer reuse requires device_put to COPY.  CPU jax may
+    zero-copy ALIAS suitably-aligned numpy memory, so refilling a slot would
+    corrupt the "device" value it staged earlier; off-CPU the upload is a
+    real DMA copy and reuse is safe.  Cached once per process."""
+    global _staging_safe
+    if _staging_safe is None:
+        try:
+            import jax
+
+            _staging_safe = jax.default_backend() != "cpu"
+        except Exception:  # noqa: BLE001 — no jax: nothing stages anyway
+            _staging_safe = False
+    return _staging_safe
+
+
+# -- blocking-sync + readback accounting --------------------------------------
+
+
+class IOStats:
+    """Process-global counters for the plane's observable costs.
+
+    ``blocking_syncs`` counts every host-side wait on device work the plane
+    performs (staging barriers, forced readbacks, grouped gathers) — the
+    quantity the structural smoke test bounds.  ``readback_exposed_s``
+    accumulates ONLY the readback wall time spent while the device value was
+    not yet ready (the un-hidden part); bench.py derives overlap efficiency
+    as 1 - exposed/serial_total."""
+
+    __slots__ = ("_lock", "blocking_syncs", "readbacks", "readback_wait_s",
+                 "readback_exposed_s", "staging_waits", "barrier_wait_s")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        self.blocking_syncs = 0
+        self.readbacks = 0
+        self.readback_wait_s = 0.0
+        self.readback_exposed_s = 0.0
+        self.staging_waits = 0
+        self.barrier_wait_s = 0.0
+
+    def count_sync(self, n: int = 1) -> None:
+        with self._lock:
+            self.blocking_syncs += n
+
+    def add_barrier(self, wall_s: float) -> None:
+        with self._lock:
+            self.blocking_syncs += 1
+            self.barrier_wait_s += wall_s
+
+    def count_staging_wait(self) -> None:
+        with self._lock:
+            self.blocking_syncs += 1
+            self.staging_waits += 1
+
+    def add_readback(self, wall_s: float, was_ready: bool) -> None:
+        with self._lock:
+            self.blocking_syncs += 1
+            self.readbacks += 1
+            self.readback_wait_s += wall_s
+            if not was_ready:
+                self.readback_exposed_s += wall_s
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "blocking_syncs": self.blocking_syncs,
+                "readbacks": self.readbacks,
+                "readback_wait_s": self.readback_wait_s,
+                "readback_exposed_s": self.readback_exposed_s,
+                "staging_waits": self.staging_waits,
+                "barrier_wait_s": self.barrier_wait_s,
+            }
+
+
+STATS = IOStats()
+
+
+def _is_ready(x) -> bool:
+    """True when a device value has materialized (forcing it costs only the
+    transfer, no compute wait).  Non-jax values (numpy fallbacks) are always
+    ready."""
+    f = getattr(x, "is_ready", None)
+    if f is None:
+        return True
+    try:
+        return bool(f())
+    except Exception:  # noqa: BLE001 — deleted/donated buffer: nothing to wait on
+        return True
+
+
+def barrier(values) -> None:
+    """COUNTED blocking device sync: the serial path's explicit
+    stage/dispatch drain before a fetch (the `--no-overlap` reference
+    shape).  The overlapped path never calls this.  Wall time is recorded
+    (STATS.barrier_wait_s) so bench's A/B can attribute the serial path's
+    total readback cost: barrier wait + forced fetch."""
+    import jax
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(values)
+    STATS.add_barrier(time.perf_counter() - t0)
+
+
+# -- readback futures ----------------------------------------------------------
+
+
+class ReadbackFuture:
+    """Demand-driven D2H readback handle (the RFuture of the device plane).
+
+    Holds kernel outputs as device references; ``result()`` performs the
+    host transfer on first demand (counted, exposed-time attributed) and
+    caches.  ``force_all`` primes several futures with ONE grouped transfer
+    instead — device references are released either way."""
+
+    __slots__ = ("_device", "_finish", "_value", "_error", "_done")
+
+    def __init__(self, device: Sequence[Any], finish: Optional[Callable] = None):
+        self._device: tuple = tuple(device)
+        self._finish = finish
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self._done = False
+
+    def done(self) -> bool:
+        return self._done
+
+    def ready(self) -> bool:
+        """True when result() would not block on device work."""
+        return self._done or all(_is_ready(v) for v in self._device)
+
+    def _deliver(self, host: tuple) -> None:
+        try:
+            self._value = self._finish(host) if self._finish is not None else (
+                host[0] if len(host) == 1 else host
+            )
+        except BaseException as e:  # noqa: BLE001 — surfaced on result()
+            self._error = e
+        self._done = True
+        self._device = ()  # release device memory references
+
+    def result(self):
+        if not self._done:
+            was_ready = all(_is_ready(v) for v in self._device)
+            t0 = time.perf_counter()
+            try:
+                host = tuple(np.asarray(v) for v in self._device)
+            except BaseException as e:  # noqa: BLE001
+                STATS.add_readback(time.perf_counter() - t0, was_ready)
+                self._error = e
+                self._done = True
+                self._device = ()
+            else:
+                STATS.add_readback(time.perf_counter() - t0, was_ready)
+                self._deliver(host)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+def gather_device_results(groups: Sequence[Sequence[Any]]) -> List[tuple]:
+    """Fetch every device value of `groups` with ONE device->host transfer:
+    bitcast each value to a uint8 byte stream on device, concatenate, pull
+    once, split and reinterpret on the host.  Every sync through the tunnel
+    costs a fixed ~68ms regardless of size, so G groups at one transfer each
+    would pay G floors — this path pays ~one.  Constraint: each device
+    value's dtype must round-trip via ``np.dtype(a.dtype.name)``."""
+    import jax
+    import jax.numpy as jnp
+
+    flat = []  # (device uint8 stream, host dtype, orig shape, was_bool)
+    index: List[List[int]] = []  # per group: flat positions
+    for group in groups:
+        pos = []
+        for arr in group:
+            a = jnp.asarray(arr)
+            was_bool = a.dtype == jnp.bool_
+            if was_bool:
+                b = a.astype(jnp.uint8)  # exact: values are 0/1
+            elif a.dtype == jnp.uint8:
+                b = a
+            else:
+                b = jax.lax.bitcast_convert_type(a, jnp.uint8)
+            pos.append(len(flat))
+            flat.append((
+                jnp.ravel(b),
+                np.dtype(a.dtype.name if not was_bool else "uint8"),
+                a.shape,
+                was_bool,
+            ))
+        index.append(pos)
+    parts = [f[0] for f in flat]
+    sizes = [int(p.shape[0]) for p in parts]
+    if not parts:
+        return [() for _ in groups]
+    STATS.count_sync()
+    if len(parts) == 1:
+        merged = np.asarray(parts[0])
+    else:
+        merged = np.asarray(jnp.concatenate(parts))  # THE one transfer
+    chunks = np.split(merged, np.cumsum(sizes)[:-1]) if len(parts) > 1 else [merged]
+    host: List[Any] = []
+    for chunk, (_p, dtype, shape, was_bool) in zip(chunks, flat):
+        v = np.ascontiguousarray(chunk).view(dtype).reshape(shape)
+        host.append(v.astype(bool) if was_bool else v)
+    return [tuple(host[i] for i in pos) for pos in index]
+
+
+def force_all(futures: Sequence[ReadbackFuture]) -> None:
+    """Materialize several ReadbackFutures with ONE grouped transfer (the
+    frame-level drain the server's reply path uses; the embedded Batch
+    drains its pending groups through here too)."""
+    todo = [f for f in futures if not f.done()]
+    if not todo:
+        return
+    try:
+        host_groups = gather_device_results([f._device for f in todo])
+    except Exception:  # noqa: BLE001 — grouped path failed; force singly
+        for f in todo:
+            try:
+                f.result()
+            except Exception:  # noqa: BLE001 — error lands on THAT future
+                pass
+        return
+    for f, host in zip(todo, host_groups):
+        f._deliver(host)
+
+
+# -- double-buffered host staging ----------------------------------------------
+
+
+class _StageSlot:
+    __slots__ = ("buf", "staged", "busy")
+
+    def __init__(self, buf: np.ndarray):
+        self.buf = buf
+        self.staged = None  # device handle last uploaded from this buffer
+        self.busy = False
+
+
+class StagingPool:
+    """Double-buffered host staging buffers for flush packing.
+
+    ``acquire(shape, dtype)`` hands out a zeroed host view backed by one of
+    ``depth`` reusable slots; ``commit(slot, staged)`` pairs the slot with
+    the device copy made from it and frees it.  A slot is re-issued only
+    once its previous upload has materialized (a real wait is counted as a
+    blocking sync) — refilling buffer A therefore overlaps buffer B's
+    in-flight upload, and reuse can never scribble over bytes the DMA is
+    still reading.  When every slot is checked out (deep concurrent
+    fan-out) acquire degrades to a fresh one-off allocation (slot=None):
+    correctness never depends on pool depth."""
+
+    def __init__(self, depth: int = 2):
+        self._lock = threading.Lock()
+        self._slots: List[_StageSlot] = []
+        self._depth = max(1, depth)
+        self.reuses = 0  # observability (ResourceCensus-friendly gauges)
+        self.oneoffs = 0
+
+    def acquire(self, shape, dtype=np.uint32) -> Tuple[np.ndarray, Optional[_StageSlot]]:
+        want = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        slot = None
+        with self._lock:
+            for s in self._slots:
+                if not s.busy:
+                    s.busy = True
+                    slot = s
+                    break
+            if slot is None and len(self._slots) < self._depth:
+                slot = _StageSlot(np.empty(max(want, 1), np.uint8))
+                slot.busy = True
+                self._slots.append(slot)
+        if slot is None:
+            self.oneoffs += 1
+            return np.zeros(shape, dtype), None
+        staged, slot.staged = slot.staged, None
+        if staged is not None and not _is_ready(staged):
+            # the double-buffer boundary: the slot's previous upload is
+            # still in flight — wait (counted) before touching its bytes
+            import jax
+
+            STATS.count_staging_wait()
+            jax.block_until_ready(staged)
+        if slot.buf.nbytes < want:
+            slot.buf = np.empty(want, np.uint8)
+        self.reuses += 1
+        view = slot.buf[:want].view(dtype).reshape(shape)
+        view[...] = 0
+        return view, slot
+
+    def commit(self, slot: Optional[_StageSlot], staged):
+        """Record the device handle uploaded from `slot` and free the slot.
+        Returns `staged` for call-site chaining; slot=None (one-off buffer)
+        is a no-op."""
+        if slot is not None:
+            with self._lock:
+                slot.staged = staged
+                slot.busy = False
+        return staged
+
+    def release(self, slot: Optional[_StageSlot]) -> None:
+        """Abandon a slot without an upload (error paths)."""
+        if slot is not None:
+            with self._lock:
+                slot.busy = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._slots.clear()
+
+    def slot_count(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+
+# -- dispatch-ahead flush driver -----------------------------------------------
+
+
+class FlushPipeline:
+    """stage -> dispatch -> fetch driver for a stream of flush windows — the
+    plane's A/B harness (bench.py's overlap sub-measurement and the CPU
+    structural smoke both drive it).
+
+    ``submit(fn)``: ``fn()`` stages + dispatches ONE window and returns
+    ``(device_values, finish)`` with ``finish(host_tuple) -> result``.
+
+      * overlap on: returns a ReadbackFuture immediately; at most ``depth``
+        windows stay un-forced (the dispatch-ahead bound) — submitting
+        window depth+1 forces the oldest, whose readback by then overlapped
+        the younger windows' staging and dispatch.  N windows cost N counted
+        readback syncs (+ at most one staging wait): the <= N+1 contract.
+      * overlap off: the strict serial reference — a counted barrier on the
+        window's device values (the stage/dispatch drain) then an immediate
+        forced fetch: exactly 2 blocking syncs per window, the 2N shape.
+    """
+
+    def __init__(self, *, overlap: Optional[bool] = None, depth: int = 2):
+        self.overlap = overlap_enabled() if overlap is None else bool(overlap)
+        self.depth = max(1, depth)
+        self._ring: List[ReadbackFuture] = []
+
+    def submit(self, fn: Callable[[], Tuple[Sequence[Any], Optional[Callable]]]) -> ReadbackFuture:
+        device, finish = fn()
+        fut = ReadbackFuture(device, finish)
+        if not self.overlap:
+            barrier(tuple(device))
+            try:
+                fut.result()
+            except Exception:  # noqa: BLE001 — error stays on the future
+                pass
+            return fut
+        self._ring.append(fut)
+        if len(self._ring) > self.depth:
+            oldest = self._ring.pop(0)
+            try:
+                oldest.result()
+            except Exception:  # noqa: BLE001
+                pass
+        return fut
+
+    def drain(self) -> None:
+        """Force every still-pending window (end of the stream)."""
+        ring, self._ring = self._ring, []
+        for fut in ring:
+            try:
+                fut.result()
+            except Exception:  # noqa: BLE001
+                pass

@@ -273,14 +273,30 @@ def stage(arr):
     return jax.device_put(arr)
 
 
-def pack_rows(*arrays, size: int):
+def pack_rows(*arrays, size: int, pool=None):
     """Stack 1-D arrays into one (R, size) uint32 transfer buffer, staged
     to the device asynchronously (see stage()) — ONE contiguous upload per
-    flush instead of R small ones, and the dispatch never blocks on it."""
-    out = np.zeros((len(arrays), size), np.uint32)
-    for i, a in enumerate(arrays):
-        out[i, : a.shape[0]] = a.view(np.uint32) if a.dtype == np.int32 else a
-    return stage(out)
+    flush instead of R small ones, and the dispatch never blocks on it.
+
+    `pool` (core/ioplane.StagingPool) fills a double-buffered reusable host
+    slot instead of a fresh allocation: refilling the next flush's buffer
+    overlaps this one's in-flight upload (the overlap plane's H2D half).
+    Callers pass a pool only where reuse is safe (Engine.staging_pool gates
+    on the backend's copy semantics)."""
+    shape = (len(arrays), size)
+    if pool is None:
+        out, slot = np.zeros(shape, np.uint32), None
+    else:
+        out, slot = pool.acquire(shape, np.uint32)
+    try:
+        for i, a in enumerate(arrays):
+            out[i, : a.shape[0]] = a.view(np.uint32) if a.dtype == np.int32 else a
+        staged = stage(out)
+    except BaseException:
+        if pool is not None:
+            pool.release(slot)  # a leaked-busy slot would silently disable
+        raise                   # the double-buffer for the pool's lifetime
+    return staged if pool is None else pool.commit(slot, staged)
 
 
 def _unpack_tlh(tlh):

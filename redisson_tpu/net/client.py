@@ -209,11 +209,16 @@ class Connection:
         self.send(*args)
         return self.read_reply(timeout)
 
-    def execute_many(self, commands: List[Tuple], timeout: Optional[float] = None) -> List[Any]:
-        """Pipelined send: all frames in one write, replies read in order
-        (the CommandBatchEncoder one-flush discipline)."""
+    def send_many(self, commands: List[Tuple]) -> int:
+        """Write a whole pipelined frame in one syscall WITHOUT reading any
+        reply; returns the number of commands written.  The upload half of
+        the client-side overlap plane: pair with read_replies() to keep the
+        next wave's frame in flight while the server's readback of the
+        previous wave drains (core/ioplane discipline at the wire layer).
+        Callers own the FIFO: every sent command's reply must be consumed,
+        in order, before any other use of this connection."""
         if not commands:
-            return []
+            return 0
         payload = b"".join(resp.encode_command(*c) for c in commands)
         try:
             plane = _fault_plane
@@ -223,7 +228,54 @@ class Connection:
         except OSError as e:
             self.close()
             raise ConnectionError_(f"send to {self.host}:{self.port} failed: {e}") from e
-        return [self.read_reply(timeout) for _ in commands]
+        return len(commands)
+
+    def read_replies(self, n: int, timeout: Optional[float] = None) -> List[Any]:
+        """Read the next `n` non-push replies in order (the drain half of
+        send_many)."""
+        return [self.read_reply(timeout) for _ in range(n)]
+
+    def execute_many(self, commands: List[Tuple], timeout: Optional[float] = None) -> List[Any]:
+        """Pipelined send: all frames in one write, replies read in order
+        (the CommandBatchEncoder one-flush discipline)."""
+        return self.read_replies(self.send_many(commands), timeout)
+
+    def execute_many_lazy(self, commands: List[Tuple]) -> "PipelinedReplies":
+        """Overlapped pipelined send: the frame is written NOW, replies are
+        read only when demanded (PipelinedReplies.get()).  A sync caller can
+        submit wave k+1 while the server still drains wave k's readback
+        futures — the client face of the overlapped device I/O plane.  The
+        handle OWNS this connection's FIFO until get() completes."""
+        return PipelinedReplies(self, self.send_many(commands))
+
+
+class PipelinedReplies:
+    """Deferred replies of one pipelined frame (RFuture-of-a-frame): created
+    by Connection.execute_many_lazy after the frame's single write; get()
+    performs the FIFO reply drain on first demand and caches.  NOT
+    thread-safe (it borrows its Connection's exclusion rules)."""
+
+    __slots__ = ("_conn", "_n", "_values", "_error")
+
+    def __init__(self, conn: Connection, n: int):
+        self._conn = conn
+        self._n = n
+        self._values: Optional[List[Any]] = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._values is not None or self._error is not None
+
+    def get(self, timeout: Optional[float] = None) -> List[Any]:
+        if self._values is None:
+            if self._error is not None:
+                raise self._error
+            try:
+                self._values = self._conn.read_replies(self._n, timeout)
+            except BaseException as e:
+                self._error = e
+                raise
+        return self._values
 
 
 class PubSubConnection:

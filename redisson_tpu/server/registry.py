@@ -62,46 +62,14 @@ class LazyReply:
 
 
 def gather_lazy_device_results(lazies: List["LazyReply"]) -> List[tuple]:
-    """Fetch every device value of `lazies` with ONE device->host transfer:
-    bitcast each value to a uint8 byte stream on device, concatenate, pull
-    once, split and reinterpret on the host.  Every sync through the tunnel
-    costs a fixed ~68ms regardless of size, so a frame of 32 results at one
-    transfer each would pay ~2s — this path pays ~one."""
-    import numpy as np
+    """Fetch every device value of `lazies` with ONE device->host transfer —
+    the frame-level grouped gather, now THE shared primitive of the overlap
+    plane (core/ioplane.gather_device_results): the server's reply path, the
+    embedded Batch drain, and bench's A/B harness all force through it, so
+    the bitcast/concat/split discipline cannot diverge between layers."""
+    from redisson_tpu.core.ioplane import gather_device_results
 
-    import jax
-    import jax.numpy as jnp
-
-    flat = []  # (device uint8 stream, host dtype, orig shape, was_bool)
-    index: List[List[int]] = []  # per lazy: flat positions
-    for lz in lazies:
-        pos = []
-        for arr in lz.device:
-            a = jnp.asarray(arr)
-            was_bool = a.dtype == jnp.bool_
-            if was_bool:
-                b = a.astype(jnp.uint8)  # exact: values are 0/1
-            elif a.dtype == jnp.uint8:
-                b = a
-            else:
-                b = jax.lax.bitcast_convert_type(a, jnp.uint8)
-            pos.append(len(flat))
-            flat.append((jnp.ravel(b), np.dtype(a.dtype.name if not was_bool else "uint8"), a.shape, was_bool))
-        index.append(pos)
-    parts = [f[0] for f in flat]
-    sizes = [int(p.shape[0]) for p in parts]
-    if not parts:
-        return [() for _ in lazies]
-    if len(parts) == 1:
-        merged = np.asarray(parts[0])
-    else:
-        merged = np.asarray(jnp.concatenate(parts))  # THE one transfer
-    chunks = np.split(merged, np.cumsum(sizes)[:-1]) if len(parts) > 1 else [merged]
-    host: List[Any] = []
-    for chunk, (_p, dtype, shape, was_bool) in zip(chunks, flat):
-        v = np.ascontiguousarray(chunk).view(dtype).reshape(shape)
-        host.append(v.astype(bool) if was_bool else v)
-    return [tuple(host[i] for i in pos) for pos in index]
+    return gather_device_results([lz.device for lz in lazies])
 
 
 class CommandContext:

@@ -11,6 +11,14 @@ execution per connection (the CommandsQueue FIFO guarantee), replies written
 in arrival order, pubsub push frames interleaved from a writer queue.
 Engine calls execute on a bounded thread pool so the event loop never blocks
 on device dispatch.
+
+Overlap plane (core/ioplane, ISSUE 3): a frame whose replies carry device
+results no longer blocks its read loop on the D2H readback — the frame's
+grouped force runs as a readback future drained by the per-connection writer
+task's completion queue (FIFO: reply order and RESP framing are untouched),
+while the read loop stages and dispatches the NEXT frame.  Frames without
+device results flush immediately.  `--no-overlap` restores the serial
+stage->dispatch->fetch shape for A/B measurement.
 """
 from __future__ import annotations
 
@@ -36,6 +44,28 @@ class _Encoded:
 
     def __init__(self, data: bytes):
         self.data = data
+
+
+class _PendingFrame:
+    """A frame whose readback is still in flight (overlap plane): the
+    per-connection writer task awaits `fut` (the executor job forcing the
+    frame's LazyReplies), then encodes and writes the replies — while the
+    connection's read loop is already staging and dispatching the NEXT
+    frame.  `proto` is the connection's negotiated protocol AT DISPATCH
+    time: a later frame's HELLO must not re-encode earlier replies."""
+
+    __slots__ = ("results", "fut", "proto")
+
+    def __init__(self, results: list, fut, proto: int):
+        self.results = results
+        self.fut = fut
+        self.proto = proto
+
+    def encoded(self) -> bytes:
+        return b"".join(
+            r.data if isinstance(r, _Encoded) else _encode_result(r, self.proto)
+            for r in self.results
+        )
 
 
 def _force_lazies(results: list, server) -> None:
@@ -104,8 +134,21 @@ class TpuServer:
         tls_key_file: Optional[str] = None,
         tls_ca_file: Optional[str] = None,
         users: Optional[Dict[str, str]] = None,
+        overlap: Optional[bool] = None,
     ):
         self.engine = engine if engine is not None else Engine()
+        # overlapped device I/O plane (core/ioplane): frames with device-form
+        # lazy replies hand their readback to the per-connection writer task
+        # instead of blocking the read loop — upload/kernel of frame N+1
+        # overlaps the D2H readback of frame N.  None = follow the process-
+        # global switch; False = the serial A/B reference (--no-overlap).
+        from redisson_tpu.core import ioplane as _ioplane
+
+        self.overlap = _ioplane.overlap_enabled() if overlap is None else bool(overlap)
+        # dispatch-ahead bound: at most this many frames may sit between
+        # "dispatched" and "replies written" per connection (bounds device
+        # memory held by un-drained readbacks)
+        self.readback_ahead = 2
         self.host = host
         self.port = port
         self.password = password
@@ -543,26 +586,75 @@ class TpuServer:
 
         ctx.push = push
 
+        # dispatch-ahead bound (overlap plane): the read loop may run at most
+        # `readback_ahead` frames ahead of the slowest un-written readback
+        readback_slots = asyncio.Semaphore(max(1, self.readback_ahead))
+        writer_alive = True
+
         async def writer_task():
-            while True:
-                data = await write_q.get()
-                if data is None:
-                    break
-                final = False
-                # drain coalesced frames in one syscall
-                while not write_q.empty():
-                    nxt = write_q.get_nowait()
-                    if nxt is None:
-                        final = True
-                        break
-                    data += nxt
-                writer.write(data)
-                try:
-                    await writer.drain()
-                except ConnectionError:
-                    return
-                if final:
-                    return
+            # The completion queue drain: items are pre-encoded bytes (pubsub
+            # pushes, readback-free frames — these flush immediately) or
+            # _PendingFrame readback futures (awaited HERE, off the read
+            # loop, so the next frame's upload and dispatch overlap this
+            # frame's D2H readback).  The queue is FIFO and this task writes
+            # strictly in pop order, so per-connection reply ordering and
+            # RESP framing are preserved exactly.
+            nonlocal writer_alive
+            held = None  # a _PendingFrame popped while coalescing bytes
+            try:
+                while True:
+                    item = held if held is not None else await write_q.get()
+                    held = None
+                    if item is None:
+                        return
+                    if isinstance(item, _PendingFrame):
+                        try:
+                            await item.fut  # the overlapped readback
+                        except Exception:  # noqa: BLE001 — pool died mid-force
+                            # tear the connection DOWN, like the serial path's
+                            # in-loop exception would: a silent return leaves
+                            # the read loop dispatching into a dead queue and
+                            # the client blocked on recv with no EOF
+                            try:
+                                writer.close()
+                            except Exception:  # noqa: BLE001
+                                pass
+                            return
+                        finally:
+                            readback_slots.release()
+                        data = item.encoded()
+                    else:
+                        data = item
+                        final = False
+                        # drain coalesced frames in one syscall (stop at a
+                        # pending frame: its readback must not delay bytes
+                        # that are already encoded)
+                        while not write_q.empty():
+                            nxt = write_q.get_nowait()
+                            if nxt is None:
+                                final = True
+                                break
+                            if isinstance(nxt, _PendingFrame):
+                                held = nxt
+                                break
+                            data += nxt
+                        if final:
+                            writer.write(data)
+                            try:
+                                await writer.drain()
+                            except ConnectionError:
+                                pass
+                            return
+                    writer.write(data)
+                    try:
+                        await writer.drain()
+                    except ConnectionError:
+                        return
+            finally:
+                writer_alive = False
+                # un-stick a read loop parked on the dispatch-ahead bound
+                for _ in range(max(1, self.readback_ahead)):
+                    readback_slots.release()
 
         wt = asyncio.create_task(writer_task())
         try:
@@ -655,6 +747,20 @@ class TpuServer:
                             _Encoded(resp.encode_error(f"ERR internal: {type(e).__name__}: {e}"))
                         )
                 if any(isinstance(r, LazyReply) for r in results):
+                    if self.overlap:
+                        # overlap plane: hand the readback to the writer task
+                        # as a completion-queue entry and go straight back to
+                        # reading — frame N+1's upload/dispatch overlaps this
+                        # frame's D2H.  FIFO queue order preserves the reply
+                        # order; proto is snapshotted at dispatch time.
+                        await readback_slots.acquire()
+                        if not writer_alive:
+                            break  # connection is going down; stop dispatching
+                        fut = loop.run_in_executor(
+                            self._pool, _force_lazies, results, self
+                        )
+                        write_q.put_nowait(_PendingFrame(results, fut, ctx.proto))
+                        continue
                     await loop.run_in_executor(self._pool, _force_lazies, results, self)
                 for r in results:
                     write_q.put_nowait(
@@ -859,6 +965,13 @@ def main(argv=None):
         help="precompile hot kernels for restored records at boot "
              "(core/warmpool — keeps the first request's latency clean)",
     )
+    ap.add_argument(
+        "--no-overlap", action="store_true",
+        help="disable the overlapped device I/O plane (core/ioplane): "
+             "flushes run strictly stage->dispatch->fetch and every frame's "
+             "readback blocks its connection's read loop — the serial "
+             "reference path for A/B measurement",
+    )
     args = ap.parse_args(argv)
     if args.checkpoint_interval > 0 and not args.checkpoint:
         ap.error("--checkpoint-interval requires --checkpoint <path>")
@@ -866,6 +979,12 @@ def main(argv=None):
         import os
 
         os.environ.setdefault("JAX_PLATFORMS", args.platform)
+    if args.no_overlap:
+        # flip the process-global switch too: the embedded Batch/pack paths
+        # of THIS process must match the server's serial reply path
+        from redisson_tpu.core import ioplane
+
+        ioplane.set_overlap(False)
     engine = Engine()
     srv = TpuServer(
         engine,
@@ -873,6 +992,7 @@ def main(argv=None):
         port=args.port,
         password=args.password,
         checkpoint_path=args.checkpoint,
+        overlap=not args.no_overlap,
     )
     if args.restore and args.checkpoint:
         from redisson_tpu.core import checkpoint

@@ -346,6 +346,10 @@ def cmd_bf_mexists64(server, ctx, args):
 # frame loop (server/server.py) hands such runs here: same-geometry filters
 # stack into one (F, S) bank, the whole run executes as ONE kernel, and each
 # command's reply is a device slice riding the frame's single d2h gather.
+# Under the overlap plane (core/ioplane) that gather runs on the writer
+# task's completion queue, so a 64-filter wave's readback overlaps the NEXT
+# wave's staging (engine staging pool) and upload — back-to-back waves
+# pipeline instead of serializing on the d2h floor.
 
 def coalesce_bloom_run(server, ctx, cmds: List[List[bytes]]):
     """Fused dispatch for a same-verb BF blob run.  Returns one LazyReply
@@ -514,23 +518,32 @@ def cmd_hlla_mergerows(server, ctx, args):
 
 @register("HLLA.ESTIMATE")
 def cmd_hlla_estimate(server, ctx, args):
-    """HLLA.ESTIMATE name -> <f64 blob> of per-tenant estimates."""
+    """HLLA.ESTIMATE name -> <f64 blob> of per-tenant estimates.  The
+    estimate stays on device as a readback future (overlap plane): the reply
+    rides the frame's grouped d2h and drains on the writer task."""
     import numpy as np
 
-    est = _hll_array(server, _s(args[0])).estimate_all()
-    return np.ascontiguousarray(est, dtype="<f8").tobytes()
+    est = _hll_array(server, _s(args[0])).estimate_all_async()
+    return LazyReply(
+        device=(est,),
+        finish=lambda v: np.ascontiguousarray(v[0], dtype="<f8").tobytes(),
+    )
 
 
 @register("HLLA.ESTPAIRS")
 def cmd_hlla_estpairs(server, ctx, args):
     """HLLA.ESTPAIRS name <i32 a blob> <i32 b blob> -> <f64 blob> of
-    per-pair union estimates (PFCOUNT a b without mutation)."""
+    per-pair union estimates (PFCOUNT a b without mutation); device-form
+    lazy reply like HLLA.ESTIMATE."""
     import numpy as np
 
     a = np.frombuffer(bytes(args[1]), dtype="<i4")
     b = np.frombuffer(bytes(args[2]), dtype="<i4")
-    est = _hll_array(server, _s(args[0])).estimate_union_pairs(a, b)
-    return np.ascontiguousarray(est, dtype="<f8").tobytes()
+    est = _hll_array(server, _s(args[0])).estimate_union_pairs_async(a, b)
+    return LazyReply(
+        device=(est,),
+        finish=lambda v: np.ascontiguousarray(v[0], dtype="<f8").tobytes(),
+    )
 
 
 # -- hyperloglog (PFADD/PFCOUNT/PFMERGE parity, RedissonHyperLogLog.java) ----

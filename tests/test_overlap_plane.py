@@ -1,0 +1,394 @@
+"""Overlapped device I/O plane (ISSUE 3): bit-identity against the serial
+path (embedded Batch AND server wire, coalesced runs included), per-
+connection reply ordering under concurrent mixed readback/non-readback
+verbs, chaos interplay while readback futures are in flight, and the
+staging pool's double-buffer discipline."""
+import threading
+
+import numpy as np
+import pytest
+
+from redisson_tpu.core import ioplane
+
+
+# -- plane primitives ----------------------------------------------------------
+
+
+class _FakeStaged:
+    """Stub device handle for StagingPool unit tests (is_ready contract)."""
+
+    def __init__(self, ready: bool):
+        self.ready = ready
+        self.waited = False
+
+    def is_ready(self) -> bool:
+        return self.ready
+
+    def block_until_ready(self):
+        self.waited = True
+        self.ready = True
+        return self
+
+
+def test_staging_pool_double_buffers_and_degrades_to_oneoff():
+    pool = ioplane.StagingPool(depth=2)
+    buf1, s1 = pool.acquire((3, 8))
+    assert s1 is not None and buf1.shape == (3, 8) and not buf1.any()
+    buf1[:] = 7  # dirty the slot: the next acquire must hand it back zeroed
+    pool.commit(s1, _FakeStaged(ready=True))
+    buf2, s2 = pool.acquire((3, 8))
+    assert s2 is s1 and not buf2.any(), "reused slot must be zeroed"
+    buf3, s3 = pool.acquire((3, 16))  # second slot; capacity grows on demand
+    assert s3 is not None and s3 is not s1 and buf3.shape == (3, 16)
+    buf4, s4 = pool.acquire((3, 8))  # pool exhausted: one-off fallback
+    assert s4 is None and buf4.shape == (3, 8)
+    pool.release(s2)
+    pool.release(s3)
+    assert pool.slot_count() == 2
+
+
+def test_staging_pool_waits_only_for_inflight_uploads():
+    pool = ioplane.StagingPool(depth=1)
+    _, slot = pool.acquire((2, 4))
+    ready = _FakeStaged(ready=True)
+    pool.commit(slot, ready)
+    before = ioplane.STATS.snapshot()["staging_waits"]
+    _, slot = pool.acquire((2, 4))  # previous upload done: no wait
+    assert ioplane.STATS.snapshot()["staging_waits"] == before
+    assert not ready.waited
+    inflight = _FakeStaged(ready=False)
+    pool.commit(slot, inflight)
+    _, slot = pool.acquire((2, 4))  # previous upload IN FLIGHT: counted wait
+    assert ioplane.STATS.snapshot()["staging_waits"] == before + 1
+    assert inflight.waited
+    pool.release(slot)
+
+
+def test_readback_future_demand_driven_and_grouped_force():
+    import jax.numpy as jnp
+
+    a = jnp.arange(6, dtype=jnp.int32) * 2
+    b = jnp.arange(4, dtype=jnp.uint8)
+    f1 = ioplane.ReadbackFuture((a,), lambda host: host[0][:3])
+    f2 = ioplane.ReadbackFuture((a, b))
+    assert not f1.done() and not f2.done()
+    ioplane.force_all([f1, f2])  # ONE grouped transfer primes both
+    assert f1.done() and f2.done()
+    np.testing.assert_array_equal(f1.result(), [0, 2, 4])
+    host_a, host_b = f2.result()
+    np.testing.assert_array_equal(host_a, np.arange(6) * 2)
+    np.testing.assert_array_equal(host_b, np.arange(4))
+    # single-demand path too
+    f3 = ioplane.ReadbackFuture((b,))
+    np.testing.assert_array_equal(f3.result(), np.arange(4))
+
+
+# -- embedded Batch: overlapped == serial, bit for bit -------------------------
+
+
+def _run_mixed_batch(overlap: bool):
+    """One mixed batch exercising every lazy dispatcher plus the coalesced
+    run and fused-pair paths; returns JSON-able responses + a state probe."""
+    import redisson_tpu
+
+    prev = ioplane.set_overlap(overlap)
+    try:
+        c = redisson_tpu.create()
+        try:
+            rng = np.random.default_rng(11)
+            for i in range(4):
+                assert c.get_bloom_filter(f"ov:bf{i}").try_init(20_000, 0.01)
+            arr = c.get_bloom_filter_array("ov:bank")
+            assert arr.try_init(tenants=8, expected_insertions=1000,
+                                false_probability=0.01)
+            keysets = [
+                rng.integers(0, 1 << 60, 150 + 30 * i).astype(np.int64)
+                for i in range(4)
+            ]
+            tk = rng.integers(0, 1 << 60, 200).astype(np.int64)
+            tt = (tk % 8).astype(np.int32)
+            idx = rng.integers(0, 4000, 120).astype(np.int64)
+
+            b = c.create_batch()
+            # consecutive same-verb bloom groups -> coalesced stacked run
+            for i in range(4):
+                b.get_bloom_filter(f"ov:bf{i}").add_async(keysets[i])
+            for i in range(4):
+                b.get_bloom_filter(f"ov:bf{i}").contains_async(keysets[i])
+            # bank + bitset + hll + host-value verbs
+            ba = b.get_bloom_filter_array("ov:bank")
+            ba.add_async(tt, tk)
+            ba.contains_async(tt, tk)
+            bs = b.get_bit_set("ov:bits")
+            bs.set_async(idx, True)
+            bs.get_async(idx)
+            b.get_hyper_log_log("ov:hll").add_all_async(tk)
+            b.get_bucket("ov:bucket").set_async({"v": 1})
+            b.get_bucket("ov:bucket").get_async()
+            b.get_atomic_long("ov:ctr").add_and_get_async(41)
+            res = b.execute()
+
+            def norm(v):
+                if isinstance(v, np.ndarray):
+                    return np.asarray(v).tolist()
+                if isinstance(v, (np.integer, np.bool_)):
+                    return v.item()
+                return v
+
+            out = [norm(r) for r in res.responses]
+            # post-batch state probe: the mutations landed identically
+            for i in range(4):
+                assert c.get_bloom_filter(f"ov:bf{i}").contains_each(keysets[i]).all()
+            out.append(int(c.get_hyper_log_log("ov:hll").count()))
+            return out
+        finally:
+            c.shutdown()
+    finally:
+        ioplane.set_overlap(prev)
+
+
+def test_batch_overlapped_bit_identical_to_serial():
+    assert _run_mixed_batch(True) == _run_mixed_batch(False)
+
+
+def test_batch_fused_pair_lazy_matches_serial():
+    import redisson_tpu
+
+    def run(overlap: bool):
+        prev = ioplane.set_overlap(overlap)
+        try:
+            c = redisson_tpu.create()
+            try:
+                assert c.get_bloom_filter("ovp:bf").try_init(10_000, 0.01)
+                rng = np.random.default_rng(3)
+                add = rng.integers(0, 1 << 60, 100).astype(np.int64)
+                probe = np.concatenate(
+                    [add[:40], rng.integers(0, 1 << 60, 60).astype(np.int64)]
+                )
+                b = c.create_batch()
+                f_add = b.get_bloom_filter("ovp:bf").add_async(add)
+                f_probe = b.get_bloom_filter("ovp:bf").contains_async(probe)
+                b.execute()
+                return f_add.get(), np.asarray(f_probe.get()).tolist()
+            finally:
+                c.shutdown()
+        finally:
+            ioplane.set_overlap(prev)
+
+    added_a, found_a = run(True)
+    added_b, found_b = run(False)
+    assert added_a == added_b == 100
+    assert found_a == found_b
+    assert all(found_a[:40])  # the probe observed the adds (pair fusion)
+
+
+def test_batch_skip_result_resolves_lazily_on_demand():
+    """skip_result drops the batch-level drain; a later fut.get() must still
+    resolve its readback individually (demand-driven D2H)."""
+    import redisson_tpu
+
+    prev = ioplane.set_overlap(True)
+    try:
+        c = redisson_tpu.create()
+        try:
+            assert c.get_bloom_filter("ovs:bf").try_init(5_000, 0.01)
+            keys = np.arange(64, dtype=np.int64) * 2654435761
+            b = c.create_batch(skip_result=True)
+            fut = b.get_bloom_filter("ovs:bf").add_async(keys)
+            assert b.execute().responses == []
+            assert fut.done()
+            assert fut.get() == 64
+        finally:
+            c.shutdown()
+    finally:
+        ioplane.set_overlap(prev)
+
+
+# -- server wire: overlapped == serial, reply for reply ------------------------
+
+
+def test_server_overlap_ab_identical_replies():
+    from redisson_tpu.net.client import Connection
+    from redisson_tpu.server.server import ServerThread
+
+    def run(overlap: bool):
+        with ServerThread(port=0, overlap=overlap) as st:
+            conn = Connection(st.server.host, st.server.port, timeout=60.0)
+            try:
+                rng = np.random.default_rng(23)
+                keys = rng.integers(0, 1 << 60, 300).astype(np.int64)
+                blob = np.ascontiguousarray(keys, "<i8").tobytes()
+                absent = np.ascontiguousarray(
+                    rng.integers(1 << 50, 1 << 60, 300).astype(np.int64), "<i8"
+                ).tobytes()
+                t32 = np.ascontiguousarray(
+                    np.arange(300, dtype=np.int32) % 8, "<i4"
+                ).tobytes()
+                idx = np.ascontiguousarray(
+                    rng.integers(0, 5000, 200).astype(np.int32), "<i4"
+                ).tobytes()
+                cmds = []
+                cmds += [("BF.RESERVE", f"ab:bf{i}", 0.01, 10_000) for i in range(4)]
+                cmds += [("BF.MADD64", f"ab:bf{i}", blob) for i in range(4)]
+                cmds += [("BF.MEXISTS64", f"ab:bf{i}", blob) for i in range(4)]
+                cmds += [("BF.MEXISTS64", "ab:bf0", absent)]
+                cmds += [
+                    ("BFA.RESERVE", "ab:bank", 8, 1000, 0.01),
+                    ("BFA.MADD64", "ab:bank", t32, blob),
+                    ("BFA.MEXISTS64", "ab:bank", t32, blob),
+                ]
+                cmds += [("PFADD64", "ab:hll", blob), ("PFCOUNT", "ab:hll")]
+                cmds += [
+                    ("HLLA.RESERVE", "ab:hbank", 8),
+                    ("HLLA.MADD64", "ab:hbank", t32, blob),
+                    ("HLLA.ESTIMATE", "ab:hbank"),
+                ]
+                cmds += [("SETBITSB", "ab:bits", idx), ("GETBITSB", "ab:bits", idx)]
+                cmds += [("PING",), ("ECHO", b"tail")]
+                out = []
+                for i in range(0, len(cmds), 5):  # several pipelined frames
+                    out.extend(conn.execute_many(cmds[i : i + 5], timeout=60.0))
+                return out
+            finally:
+                conn.close()
+
+    a, b = run(True), run(False)
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert x == y, f"reply {i} diverged between overlapped and serial"
+
+
+# -- per-connection reply ordering under concurrency ---------------------------
+
+
+def test_reply_order_preserved_16_clients_mixed_verbs():
+    """16 concurrent clients, each keeping several frames in flight
+    (execute_many_lazy) with readback verbs (BF blob ops) interleaved
+    between immediate verbs (ECHO acks): every connection's replies must
+    arrive exactly in submission order — the completion queue preserves the
+    per-connection FIFO while readbacks drain on the writer task."""
+    from redisson_tpu.net.client import Connection
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0) as st:
+        assert st.server.overlap
+        host, port = st.server.host, st.server.port
+        errors = []
+
+        def check(item):
+            tags, blob, handle = item
+            r = handle.get(timeout=60.0)
+            assert r[0] == tags[0], "ack before the readback frame moved"
+            assert r[2] == tags[1], "ack between readbacks moved"
+            assert r[4] == tags[2], "trailing ack moved"
+            assert np.frombuffer(r[3], np.uint8).all(), "probe missed its adds"
+
+        def worker(wid: int):
+            try:
+                conn = Connection(host, port, timeout=60.0)
+                try:
+                    name = f"ord:{wid}"
+                    assert conn.execute(
+                        "BF.RESERVE", name, 0.01, 5000, timeout=30.0
+                    ) in (b"OK", "OK")
+                    inflight = []
+                    for f in range(6):
+                        keys = (
+                            np.arange(120, dtype=np.int64)
+                            + wid * 100_000 + f * 1000
+                        ) * 2654435761
+                        blob = np.ascontiguousarray(keys, "<i8").tobytes()
+                        tags = [f"w{wid}f{f}c{i}".encode() for i in range(3)]
+                        cmds = [
+                            ("ECHO", tags[0]),
+                            ("BF.MADD64", name, blob),
+                            ("ECHO", tags[1]),
+                            ("BF.MEXISTS64", name, blob),
+                            ("ECHO", tags[2]),
+                        ]
+                        inflight.append((tags, blob, conn.execute_many_lazy(cmds)))
+                        if len(inflight) > 3:  # keep 3 frames in flight
+                            check(inflight.pop(0))
+                    for item in inflight:
+                        check(item)
+                finally:
+                    conn.close()
+            except Exception as e:  # noqa: BLE001 — surfaced on the main thread
+                errors.append((wid, repr(e)))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), name=f"ord-{i}")
+            for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+
+
+# -- chaos interplay: faults while readback futures are in flight --------------
+
+
+def test_chaos_faults_during_inflight_readbacks():
+    """Inject truncate/delay transport faults while readback futures are in
+    flight: no reply reordering, no lost acks (every ACKED add remains
+    queryable), and a flat ResourceCensus afterwards."""
+    from redisson_tpu.chaos.census import ResourceCensus
+    from redisson_tpu.chaos.faults import FaultSchedule
+    from redisson_tpu.net.client import NodeClient
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0) as st:
+        census = ResourceCensus()
+        census.track_engine("srv", st.server.engine)
+        nc = NodeClient(
+            f"127.0.0.1:{st.server.port}", ping_interval=0, timeout=30.0,
+            retry_attempts=4, retry_interval=0.05,
+        )
+        try:
+            assert nc.execute("BF.RESERVE", "chaos:bf", 0.01, 50_000) in (b"OK", "OK")
+            before = census.snapshot()
+            sched = FaultSchedule(7)
+            sched.add("delay", port=st.server.port, after=2, count=3, delay_s=0.02)
+            sched.add("truncate", port=st.server.port, after=5, count=2)
+            sched.add("delay", port=st.server.port, after=12, count=2, delay_s=0.01)
+            sched.add("truncate", port=st.server.port, after=30, count=1)
+            plane = sched.plane()
+            rng = np.random.default_rng(5)
+            acked = []
+            with plane.active():
+                for r in range(12):
+                    keys = rng.integers(0, 1 << 60, 400).astype(np.int64)
+                    blob = np.ascontiguousarray(keys, "<i8").tobytes()
+                    tag = f"round-{r}".encode()
+                    try:
+                        replies = nc.execute_many(
+                            [
+                                ("ECHO", tag),
+                                ("BF.MADD64", "chaos:bf", blob),
+                                ("BF.MEXISTS64", "chaos:bf", blob),
+                                ("ECHO", tag),
+                            ],
+                            timeout=30.0,
+                        )
+                    except Exception:  # noqa: BLE001 — faulted round, nothing acked
+                        continue
+                    # ordering: the ack markers still bracket the readbacks
+                    assert replies[0] == tag and replies[3] == tag
+                    assert np.frombuffer(replies[2], np.uint8).all()
+                    acked.append(keys)
+            assert plane.injected, "chaos schedule never fired"
+            assert acked, "every round faulted; nothing exercised the ack path"
+            # no lost acks: every key of every ACKED round is present
+            for keys in acked:
+                blob = np.ascontiguousarray(keys, "<i8").tobytes()
+                out = nc.execute("BF.MEXISTS64", "chaos:bf", blob, timeout=30.0)
+                assert np.frombuffer(out, np.uint8).all(), "acked add lost"
+            census.assert_flat(
+                before, census.snapshot(),
+                ignore=("*.keys", "*.wait_entries"),
+                context="overlap-plane chaos interplay",
+            )
+        finally:
+            nc.close()

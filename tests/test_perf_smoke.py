@@ -7,7 +7,10 @@ chaos-hook guarantee — the properties bench.py measures but CI can't time.
     attribution against the exact guard lines in net/client.py);
   * coalesced cross-filter dispatch returns exactly what per-filter
     dispatch returns;
-  * tools/perf_gate.py logic passes/fails on the recorded artifacts.
+  * the overlap plane's structural win (ISSUE 3): N flush windows cost
+    <= N+1 blocking device syncs overlapped vs 2N serial, bit-identically;
+  * tools/perf_gate.py logic passes/fails on the recorded artifacts,
+    including the two ISSUE 3-gated metrics.
 """
 import socket
 import threading
@@ -255,6 +258,57 @@ def test_coalesced_run_with_one_bad_blob_errors_only_that_command():
             assert np.frombuffer(probe, np.uint8).all()
 
 
+# -- overlap plane structural property (ISSUE 3) ------------------------------
+
+def test_overlap_pipeline_sync_bound_and_bit_identity():
+    """THE structural win of the overlap plane, pinned without a TPU: N
+    flush windows through ioplane.FlushPipeline cost exactly 2N counted
+    blocking device syncs serial (barrier + forced fetch per window) and
+    <= N+1 overlapped (one demand-driven readback per window, plus at most
+    one staging wait) — and the two modes return bit-identical results."""
+    import redisson_tpu
+    from redisson_tpu.core import ioplane
+    from redisson_tpu.core import kernels as K
+
+    c = redisson_tpu.create()
+    try:
+        arr = c.get_bloom_filter_array("perf:ov")
+        assert arr.try_init(tenants=32, expected_insertions=2000,
+                            false_probability=0.01)
+        rng = np.random.default_rng(3)
+        keys = rng.integers(0, 1 << 60, 4000).astype(np.int64)
+        t = (keys % 32).astype(np.int32)
+        arr.add_each(t, keys)
+        n_win = 8
+        windows = [
+            (t[i * 500 : (i + 1) * 500], keys[i * 500 : (i + 1) * 500])
+            for i in range(n_win)
+        ]
+
+        def window_fn(tt, kk):
+            def fn():
+                packed, n = arr.contains_async(tt, kk)
+                return (packed,), (lambda host, n=n: K.unpack_found(host[0], n))
+
+            return fn
+
+        out, syncs = {}, {}
+        for mode, overlap in (("serial", False), ("overlapped", True)):
+            pipe = ioplane.FlushPipeline(overlap=overlap, depth=2)
+            ioplane.STATS.reset()
+            futs = [pipe.submit(window_fn(*w)) for w in windows]
+            pipe.drain()
+            out[mode] = [f.result() for f in futs]
+            syncs[mode] = ioplane.STATS.snapshot()["blocking_syncs"]
+        assert syncs["serial"] == 2 * n_win, syncs
+        assert syncs["overlapped"] <= n_win + 1, syncs
+        for a, b in zip(out["serial"], out["overlapped"]):
+            np.testing.assert_array_equal(a, b)
+        assert out["serial"][0].all()  # populated keys are all present
+    finally:
+        c.shutdown()
+
+
 # -- perf gate logic ----------------------------------------------------------
 
 def test_perf_gate_passes_self_and_fails_known_regression(tmp_path):
@@ -289,3 +343,19 @@ def test_perf_gate_passes_self_and_fails_known_regression(tmp_path):
         p = tmp_path / f"fresh_{factor}.json"
         p.write_text(json.dumps(doc))
         assert gate.main(["--fresh", str(p), "--baseline", r5]) == want
+
+    # the two metrics gated by ISSUE 3: config2 flush p99 (LOWER is better —
+    # a 6% slower p99 fails, 4% passes) and config4 cold entries/s
+    for key, factor, want in (
+        ("config2_flush_p99_ms", 1.06, 1),
+        ("config2_flush_p99_ms", 1.04, 0),
+        ("config4_mapreduce_cold_entries_per_sec", 0.94, 1),
+        ("config4_mapreduce_cold_entries_per_sec", 0.96, 0),
+    ):
+        doc = copy.deepcopy(base)
+        doc["details"][key] = base["details"][key] * factor
+        p = tmp_path / f"fresh_{key}_{factor}.json"
+        p.write_text(json.dumps(doc))
+        assert gate.main(["--fresh", str(p), "--baseline", r5]) == want, (
+            key, factor,
+        )

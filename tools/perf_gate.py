@@ -17,11 +17,13 @@ Inputs accept either the raw bench.py JSON line (possibly embedded in other
 stdout) or a recorded BENCH_rNN.json wrapper ({"parsed": {...}}).  Baseline
 defaults to the highest-numbered BENCH_r*.json in the repo root.
 
-Gate rule: exit nonzero on a >5% drop (--threshold) in the HEADLINE metric
-(windowed bank contains/s) or CONFIG5 (cluster mixed ops/s).  Every other
+Gate rule: exit nonzero on a >5% drop (--threshold) in any GATED metric:
+the HEADLINE (windowed bank contains/s), CONFIG5 (cluster mixed ops/s),
+CONFIG2 flush p99 ms (lower is better — the latency floor the overlap
+plane of ISSUE 3 attacks), and CONFIG4 cold entries/s.  Every other
 tracked metric prints in the regression table and flags WARN on a drop —
 visible, but advisory (tunnel variance on the secondary configs is real;
-the two gated numbers are windowed/best-of and stable).
+the gated numbers are windowed/best-of or percentile-stable).
 """
 from __future__ import annotations
 
@@ -36,15 +38,19 @@ from typing import Dict, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # (label, extractor-path, higher_is_better, gated)
+# Gated set (exit nonzero on a >threshold regression): the windowed headline,
+# config5, and — since the overlap plane (ISSUE 3) attacked the flush-latency
+# floor — config2 flush p99 and the config4 COLD rate, so the latency the
+# plane recovered cannot silently regress either.
 METRICS = [
     ("headline bank contains/s", ("value",), True, True),
     ("config5 cluster mixed ops/s", ("details", "config5_cluster_mixed_ops_per_sec"), True, True),
     ("config1 single contains/s", ("details", "config1_single_filter_contains_per_sec"), True, False),
-    ("config2 flush p99 ms", ("details", "config2_flush_p99_ms"), False, False),
+    ("config2 flush p99 ms", ("details", "config2_flush_p99_ms"), False, True),
     ("config3 hll add/s", ("details", "config3_hll_add_per_sec"), True, False),
     ("config3 hll merge pairs/s", ("details", "config3_hll_merge_pairs_per_sec"), True, False),
     ("config4 mapreduce entries/s", ("details", "config4_mapreduce_entries_per_sec"), True, False),
-    ("config4 mapreduce COLD entries/s", ("details", "config4_mapreduce_cold_entries_per_sec"), True, False),
+    ("config4 mapreduce COLD entries/s", ("details", "config4_mapreduce_cold_entries_per_sec"), True, True),
 ]
 
 
@@ -134,8 +140,8 @@ def render(rows, threshold: float) -> str:
         out.append(f"{label:<34} {bs:>14} {fs:>14} {ds:>8}  {status}")
     out.append("-" * 82)
     out.append(
-        f"gate: >{threshold:.0%} drop in headline or config5 fails; "
-        "other drops are advisory (WARN)"
+        f"gate: >{threshold:.0%} regression in headline, config5, config2 "
+        "flush p99, or config4 cold fails; other drops are advisory (WARN)"
     )
     return "\n".join(out)
 
