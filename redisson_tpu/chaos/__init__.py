@@ -19,16 +19,29 @@ contention).  This package is that discipline made first-class:
   * :mod:`redisson_tpu.chaos.soak` — :class:`SoakHarness`: a configurable
     mixed workload (bloom, map, lock, bucket, pubsub) across repeated
     master-kill → failover → reshard cycles with an error budget, asserting
-    zero acked-write loss and a flat census at every quiesce point.
+    zero acked-write loss and a flat census at every quiesce point; and
+    :class:`MigrationSoakHarness` — the migration-under-fault profile:
+    journaled slot migrations killed at every phase boundary and resumed,
+    under transport noise and checkpoint storage corruption.
 """
 from redisson_tpu.chaos.census import ResourceCensus
 from redisson_tpu.chaos.faults import Fault, FaultPlane, FaultSchedule
-from redisson_tpu.chaos.soak import SoakConfig, SoakHarness, SoakReport
+from redisson_tpu.chaos.soak import (
+    MigrationSoakConfig,
+    MigrationSoakHarness,
+    MigrationSoakReport,
+    SoakConfig,
+    SoakHarness,
+    SoakReport,
+)
 
 __all__ = [
     "Fault",
     "FaultPlane",
     "FaultSchedule",
+    "MigrationSoakConfig",
+    "MigrationSoakHarness",
+    "MigrationSoakReport",
     "ResourceCensus",
     "SoakConfig",
     "SoakHarness",

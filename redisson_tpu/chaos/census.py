@@ -87,6 +87,18 @@ class ResourceCensus:
 
         self.track(name, probe)
 
+    def track_checkpoints(self, name: str = "checkpoint") -> None:
+        """Expose ``core/checkpoint.STATS`` (corrupt generations detected,
+        generation fallbacks served) — storage chaos must leave a VISIBLE
+        trail, not just a survived one."""
+
+        def probe() -> Dict[str, float]:
+            from redisson_tpu.core import checkpoint
+
+            return {k: float(v) for k, v in checkpoint.STATS.items()}
+
+        self.track(name, probe)
+
     def track_client(self, name: str, client) -> None:
         def probe() -> Dict[str, float]:
             nodes = []

@@ -26,24 +26,44 @@ Design constraints (ISSUE 1 tentpole):
       - ``partition_in``    → reply silently never arrives (same timeout
         path — a one-way partition, inbound).
 
+**DCN-level partitions** (ISSUE 4): a one-way partition of a host GROUP —
+a rule with ``ports=(p1, p2, ...)`` matches every node in the group and is
+counted on the group's own combined event stream, so "the second send to
+either DCN-B node is swallowed" is expressible (a per-port rule can't say
+that; a global rule also faults intra-group traffic).  Build one with
+``FaultSchedule.add_dcn_partition``.
+
+**Storage faults** (ISSUE 4): the persistence plane (``core/checkpoint``)
+consults the SAME installed plane at its two file-I/O event sites:
+
+      - ``enospc``      → ``OSError(ENOSPC)`` raised on the snapshot write;
+      - ``torn_write``  → only the first ``torn_at`` bytes (or
+        ``torn_frac`` of them) reach the file, but the write REPORTS
+        success — the media-lied/power-loss model whose corruption only the
+        CRC32 trailer catches at the next load;
+      - ``fsync_fail``  → ``OSError(EIO)`` from fsync.
+
 Server/coordinator-layer faults (kill / pause / restart a node, stall the
 replication stream) live on ``harness.ClusterRunner`` and
 ``server/replication.ReplicationSource`` — see ``pause_node`` /
 ``stall_replication`` there; ``server/monitor.HAFailoverCoordinator.kill``
-is the coordinator-crash hook.
+is the coordinator-crash hook; ``server/migration.migrate_slots``'s
+``crash_after=`` is the kill-the-migration-coordinator hook.
 """
 from __future__ import annotations
 
+import errno
 import random
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from redisson_tpu.net import client as _net
 
-# fault kind -> the Connection event stream it rides
+# fault kind -> the event stream it rides (connect/send/recv are
+# net/client.py Connection sites; storage_* are core/checkpoint.py sites)
 _STREAM = {
     "refuse_connect": "connect",
     "drop": "send",
@@ -51,6 +71,9 @@ _STREAM = {
     "partition_out": "send",
     "truncate": "recv",
     "partition_in": "recv",
+    "enospc": "storage_write",
+    "torn_write": "storage_write",
+    "fsync_fail": "storage_fsync",
 }
 
 KINDS = tuple(_STREAM)
@@ -59,19 +82,27 @@ KINDS = tuple(_STREAM)
 @dataclass
 class Fault:
     """One injection rule: fault the matching event stream for the window
-    ``[after, after + count)``, counted per-port when ``port`` is set, else
-    over the global stream."""
+    ``[after, after + count)``, counted per-port when ``port`` is set,
+    per-GROUP when ``ports`` is set (DCN-level: the rule's window indexes
+    the group's combined stream), else over the global stream."""
 
     kind: str
     port: Optional[int] = None  # None matches every node
     after: int = 0
     count: int = 1
     delay_s: float = 0.05  # kind == "delay" only
+    ports: Optional[Tuple[int, ...]] = None  # host GROUP (DCN partition)
+    torn_at: Optional[int] = None  # kind == "torn_write": cut at byte k...
+    torn_frac: float = 0.5         # ...or at this fraction when torn_at unset
     hits: int = 0          # events this rule actually faulted
 
     def __post_init__(self):
         if self.kind not in _STREAM:
             raise ValueError(f"unknown fault kind {self.kind!r}; one of {KINDS}")
+        if self.ports is not None:
+            if self.port is not None:
+                raise ValueError("port= and ports= are mutually exclusive")
+            self.ports = tuple(sorted(set(self.ports)))
 
     @property
     def stream(self) -> str:
@@ -92,10 +123,29 @@ class FaultSchedule:
         self.faults: List[Fault] = []
 
     def add(self, kind: str, port: Optional[int] = None, after: int = 0,
-            count: int = 1, delay_s: float = 0.05) -> Fault:
-        f = Fault(kind, port=port, after=after, count=count, delay_s=delay_s)
+            count: int = 1, delay_s: float = 0.05,
+            ports: Optional[Sequence[int]] = None,
+            torn_at: Optional[int] = None, torn_frac: float = 0.5) -> Fault:
+        f = Fault(kind, port=port, after=after, count=count, delay_s=delay_s,
+                  ports=tuple(ports) if ports is not None else None,
+                  torn_at=torn_at, torn_frac=torn_frac)
         self.faults.append(f)
         return f
+
+    def add_dcn_partition(self, ports: Sequence[int], direction: str = "out",
+                          after: int = 0, count: int = 1) -> Fault:
+        """One-way partition of a host GROUP (the DCN-level scenario: one
+        datacenter's uplink dies in ONE direction).  ``direction="out"``
+        swallows frames TO any node in the group; ``"in"`` swallows replies
+        FROM them.  The window ``[after, after+count)`` indexes the group's
+        combined event stream, so the program stays deterministic no matter
+        how traffic interleaves across the group's nodes."""
+        if direction not in ("out", "in"):
+            raise ValueError("direction must be 'out' or 'in'")
+        return self.add(
+            "partition_out" if direction == "out" else "partition_in",
+            ports=ports, after=after, count=count,
+        )
 
     def add_random(self, kind: str, port: Optional[int] = None, n: int = 1,
                    window: int = 100, delay_s: float = 0.05) -> "FaultSchedule":
@@ -144,14 +194,43 @@ class FaultPlane:
             n_port = self._counts.get((stream, port), 0)
             self._counts[(stream, None)] = n_global + 1
             self._counts[(stream, port)] = n_port + 1
+            # host-GROUP streams (DCN rules): one combined counter per
+            # distinct group this event belongs to, bumped once per event
+            # even when several rules share the group
+            n_groups: Dict[Tuple[int, ...], int] = {}
+            for f in self.schedule.faults:
+                if (f.stream == stream and f.ports is not None
+                        and port in f.ports and f.ports not in n_groups):
+                    n = self._counts.get((stream, f.ports), 0)
+                    n_groups[f.ports] = n
+                    self._counts[(stream, f.ports)] = n + 1
             for f in self.schedule.faults:
                 if f.stream != stream:
                     continue
-                if f.port is None:
+                if f.ports is not None:
+                    if port not in f.ports:
+                        continue
+                    n = n_groups[f.ports]
+                elif f.port is None:
                     n = n_global
                 elif f.port == port:
                     n = n_port
                 else:
+                    continue
+                if f.after <= n < f.after + f.count:
+                    f.hits += 1
+                    self.injected[f.kind] = self.injected.get(f.kind, 0) + 1
+                    return f
+        return None
+
+    def _on_storage_event(self, stream: str) -> Optional[Fault]:
+        """Storage faults are port-less: one global event stream per site
+        (indices count snapshot writes/fsyncs, not bytes)."""
+        with self._lock:
+            n = self._counts.get((stream, None), 0)
+            self._counts[(stream, None)] = n + 1
+            for f in self.schedule.faults:
+                if f.stream != stream:
                     continue
                 if f.after <= n < f.after + f.count:
                     f.hits += 1
@@ -201,6 +280,34 @@ class FaultPlane:
         if f.kind == "partition_in":
             return None
         return data
+
+    # -- hooks (core/checkpoint.py storage plane) -----------------------------
+
+    def on_storage_write(self, path: str, data: bytes) -> bytes:
+        """Returns the bytes that actually reach stable storage.  May raise
+        ``OSError(ENOSPC)`` (disk full) or return a PREFIX of ``data``
+        (torn write: the write call reports success but only the head
+        landed — the power-loss/media-lied model the CRC32 trailer exists
+        to catch)."""
+        f = self._on_storage_event("storage_write")
+        if f is None:
+            return data
+        if f.kind == "enospc":
+            raise OSError(
+                errno.ENOSPC, f"[chaos] No space left on device writing {path!r}"
+            )
+        if f.kind == "torn_write":
+            k = f.torn_at if f.torn_at is not None else int(len(data) * f.torn_frac)
+            return data[: max(0, min(k, len(data)))]
+        return data
+
+    def on_storage_fsync(self, path: str) -> None:
+        """May raise ``OSError(EIO)`` — the fsync-failure mode where the
+        kernel reports the flush failed and the caller must treat the file
+        as suspect (a failed save, never a silently-accepted one)."""
+        f = self._on_storage_event("storage_fsync")
+        if f is not None and f.kind == "fsync_fail":
+            raise OSError(errno.EIO, f"[chaos] fsync failed for {path!r}")
 
     # -- lifecycle -----------------------------------------------------------
 

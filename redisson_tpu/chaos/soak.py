@@ -25,9 +25,23 @@ work a phase performs, never WHAT the assertions compare.
 Run it three ways: ``pytest -m slow tests/test_soak.py`` (the endurance
 tier), ``python tools/soak_smoke.py`` (a ~10s local sanity loop), or
 construct :class:`SoakHarness` directly.
+
+The **migration-under-fault profile** (:class:`MigrationSoakHarness`,
+ISSUE 4) is the second discipline in this module: a mixed workload keeps
+writing through a slot range while the MIGRATION COORDINATOR is killed at
+every journal phase (``migrate_slots(crash_after=...)`` →
+``resume_migrations``) and storage faults corrupt checkpoint heads.
+Invariants per cycle: zero acked-write loss, no slot left non-STABLE on
+either end, bit-identical record contents for a quiesced device-backed
+record vs its pre-migration snapshot, checkpoint loads surviving torn
+heads via generation fallback, and a flat ResourceCensus.  Run it with
+``python tools/soak_smoke.py --profile migration`` or the slow tier in
+``tests/test_soak.py``.
 """
 from __future__ import annotations
 
+import os
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
@@ -503,6 +517,375 @@ class SoakHarness:
         finally:
             # aggregate in the failure path too: a mid-run assertion must
             # still report WHICH chaos fired (the first diagnostic needed)
+            self.report.injected_faults = {}
+            for plane in self._planes:
+                for kind, n in plane.injected.items():
+                    self.report.injected_faults[kind] = (
+                        self.report.injected_faults.get(kind, 0) + n
+                    )
+            self._teardown()
+
+
+# -- migration-under-fault profile (ISSUE 4) ---------------------------------
+
+@dataclass
+class MigrationSoakConfig:
+    cycles: int = 1
+    # one coordinator kill per phase per cycle; DRAINING:1 = after the
+    # first drain sweep's journal entry (mid-drain death)
+    crash_phases: Tuple[str, ...] = (
+        "PLANNED", "WINDOW_OPEN", "DRAINING:1", "VIEW_COMMITTED",
+    )
+    keys: int = 40                 # acked bucket writes riding the moving slots
+    writer_threads: int = 2
+    seed: int = 0
+    transport_faults: bool = True  # delay/drop program over each cycle
+    storage_faults: bool = True    # torn-write/ENOSPC checkpoint chaos per cycle
+    error_budget_ratio: float = 0.5
+    quiesce_deadline_s: float = 15.0
+    verify_retries: int = 25
+
+
+@dataclass
+class MigrationSoakReport:
+    cycles_completed: int = 0
+    coordinator_kills: int = 0
+    resumed_completed: int = 0
+    resumed_rolled_back: int = 0
+    acked_writes: int = 0
+    verified_writes: int = 0
+    errors: int = 0
+    checkpoint_fallbacks: int = 0
+    bloom_bits_verified: int = 0
+    injected_faults: Dict[str, int] = field(default_factory=dict)
+    census: List[Dict[str, float]] = field(default_factory=list)
+
+    def summary(self) -> str:
+        return (
+            f"migration soak: {self.cycles_completed} cycles, "
+            f"{self.coordinator_kills} coordinator kills "
+            f"({self.resumed_completed} resumed-complete, "
+            f"{self.resumed_rolled_back} rolled back), "
+            f"{self.acked_writes} acked writes ({self.verified_writes} re-verified), "
+            f"{self.errors} budgeted errors, "
+            f"{self.checkpoint_fallbacks} checkpoint generation fallbacks, "
+            f"bloom bits bit-identical x{self.bloom_bits_verified}, "
+            f"faults={self.injected_faults}, census points={len(self.census)}"
+        )
+
+
+class MigrationSoakHarness:
+    """Kill-the-coordinator endurance: a 2-master cluster serves a mixed
+    write stream while journaled slot migrations are murdered at every
+    phase boundary and resumed, and checkpoint storage is corrupted under
+    it.  The acceptance property: every cycle ends with all slots STABLE
+    on exactly one owner, every acked write readable at its acked value,
+    a quiesced device record bit-identical to its pre-storm snapshot, the
+    last good checkpoint generation loadable, and a flat census."""
+
+    def __init__(self, config: Optional[MigrationSoakConfig] = None):
+        self.config = config or MigrationSoakConfig()
+        self.report = MigrationSoakReport()
+        self.census = ResourceCensus()
+        self._rng = np.random.default_rng(self.config.seed)
+        self._acked: Dict[str, str] = {}
+        self._acked_lock = threading.Lock()
+        self._runner = None
+        self._client = None
+        self._journal_dir: Optional[str] = None
+        self._keys: List[str] = []
+        self._slots: List[int] = []
+        self._bloom_name: Optional[str] = None
+        self._planes: List[FaultPlane] = []
+
+    # -- setup ----------------------------------------------------------------
+
+    def _setup(self) -> None:
+        from redisson_tpu.harness import ClusterRunner
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        self._runner = ClusterRunner(masters=2).run()
+        self._client = self._runner.client(
+            scan_interval=0.5, timeout=10.0, connect_timeout=5.0,
+            retry_attempts=2, retry_interval=0.1,
+        )
+        self._journal_dir = tempfile.mkdtemp(prefix="rtpu-migsoak-journal-")
+        lo0, hi0 = self._runner.slot_ranges[0]
+        self._keys = [
+            k for k in (f"migsoak-{i}" for i in range(2000))
+            if lo0 <= calc_slot(k.encode()) <= hi0
+        ][: self.config.keys]
+        assert len(self._keys) >= 10, "key generation failed to fill the range"
+        self._bloom_name = next(
+            n for n in (f"migsoak:bloom-{j}" for j in range(500))
+            if lo0 <= calc_slot(n.encode()) <= hi0
+        )
+        self._slots = sorted(
+            {calc_slot(k.encode()) for k in self._keys}
+            | {calc_slot(self._bloom_name.encode())}
+        )
+        bf = self._client.get_bloom_filter(self._bloom_name)
+        bf.try_init(expected_insertions=50_000, false_probability=0.01)
+        bf.add(self._rng.integers(0, 1 << 60, 512).astype(np.int64))
+        self.census.track_client("client", self._client)
+        self.census.track_checkpoints("checkpoint")
+        for i, m in enumerate(self._runner.masters):
+            self.census.track_server(f"m{i}", m.server.server)
+            self.census.track_engine(f"m{i}.engine", m.server.server.engine)
+
+    def _teardown(self) -> None:
+        if self._client is not None:
+            self._client.shutdown()
+        if self._runner is not None:
+            self._runner.shutdown()
+
+    def _transport_schedule(self, cycle: int) -> FaultSchedule:
+        """Light seed-deterministic noise: delays plus a few drops — the
+        RetryPolicy-riding admin links must absorb them mid-migration."""
+        sched = FaultSchedule(self.config.seed * 6151 + cycle)
+        sched.add_random("delay", n=6, window=300, delay_s=0.01)
+        sched.add_random("drop", n=2, window=300)
+        return sched
+
+    # -- workload -------------------------------------------------------------
+
+    def _writer(self, wid: int, cycle: int, stop: threading.Event) -> None:
+        client = self._client
+        mine = self._keys[wid::self.config.writer_threads]
+        i = 0
+        while not stop.is_set():
+            k = mine[i % len(mine)]
+            v = f"c{cycle}-w{wid}-{i}"
+            try:
+                client.execute("SET", k, v)
+                with self._acked_lock:
+                    self._acked[k] = v
+                    self.report.acked_writes += 1
+            except Exception:  # noqa: BLE001 — budgeted chaos error
+                with self._acked_lock:
+                    self.report.errors += 1
+            i += 1
+            time.sleep(0.004)
+
+    @staticmethod
+    def _value_seq(v: str) -> Tuple[int, int]:
+        """Order a writer value ``c<cycle>-w<wid>-<i>``: each key has ONE
+        writer, so its stored value advances monotonically in (cycle, i)."""
+        parts = v.split("-")
+        return int(parts[0][1:]), int(parts[2])
+
+    def _verify_acked(self, sample: Optional[int] = None) -> None:
+        """Zero acked-write LOSS: the stored value must be the acked one or
+        a NEWER write by the same key's writer (the writer keeps running
+        during verification, and a timed-out-but-applied SET is allowed to
+        land — what must never happen is the value going BACKWARDS or
+        vanishing)."""
+        with self._acked_lock:
+            acked = dict(self._acked)
+        keys = sorted(acked)
+        if sample:
+            keys = keys[:: max(1, len(keys) // sample)]
+        for k in keys:
+            got = None
+            for _ in range(self.config.verify_retries):
+                try:
+                    got = self._client.execute("GET", k)
+                    break
+                except Exception:  # noqa: BLE001 — topology still settling
+                    time.sleep(0.2)
+            got = bytes(got).decode() if got is not None else None
+            assert got is not None and (
+                self._value_seq(got) >= self._value_seq(acked[k])
+            ), f"lost acked write {k!r}: want >= {acked[k]!r}, got {got!r}"
+            self.report.verified_writes += 1
+
+    # -- migration storm ------------------------------------------------------
+
+    def _owner_engines(self):
+        return [m.server.server for m in self._runner.masters]
+
+    def _assert_slots_stable(self) -> None:
+        from redisson_tpu.server.migration_journal import MigrationJournal
+
+        assert not MigrationJournal.in_flight(self._journal_dir), (
+            "journal left non-terminal migrations behind"
+        )
+        for srv in self._owner_engines():
+            assert not srv.migrating_slots, (
+                f"slots left MIGRATING on {srv.address()}: {srv.migrating_slots}"
+            )
+            assert not srv.importing_slots, (
+                f"slots left IMPORTING on {srv.address()}: {srv.importing_slots}"
+            )
+
+    def _assert_one_owner(self) -> None:
+        """Every workload key lives on EXACTLY one master's store."""
+        stores = [s.engine.store for s in self._owner_engines()]
+        for name in self._keys + [self._bloom_name]:
+            holders = sum(1 for st in stores if st.exists(name))
+            # a key never successfully written exists nowhere — only assert
+            # single-residency for ones that do exist
+            assert holders <= 1, f"record {name!r} resident on {holders} masters"
+
+    def _bloom_snapshot(self):
+        for srv in self._owner_engines():
+            rec = srv.engine.store.get(self._bloom_name)
+            if rec is not None:
+                return {k: np.asarray(v).copy() for k, v in rec.arrays.items()}
+        raise AssertionError(f"bloom record {self._bloom_name!r} not found")
+
+    def _assert_bloom_bit_identical(self, before) -> None:
+        after = self._bloom_snapshot()
+        assert set(before) == set(after), "bloom arrays changed shape set"
+        for k in before:
+            assert np.array_equal(before[k], after[k]), (
+                f"bloom plane {k!r} not bit-identical after faulted migration"
+            )
+            self.report.bloom_bits_verified += int(before[k].size)
+
+    def _migration_storm(self, cycle: int) -> None:
+        """Kill the coordinator at every journal phase; resume each time."""
+        from redisson_tpu.server.migration import (
+            CoordinatorKilled, migrate_slots, resume_migrations,
+        )
+
+        masters = self._runner.masters
+        # who currently owns the moving slots (cycle > 0 may have flipped)
+        owner = next(
+            i for i, m in enumerate(masters)
+            if m.server.server.engine.store.exists(self._bloom_name)
+        )
+        for phase in self.config.crash_phases:
+            src, dst = masters[owner], masters[1 - owner]
+            try:
+                migrate_slots(
+                    src.address, dst.address, self._slots,
+                    journal_dir=self._journal_dir, crash_after=phase,
+                )
+                raise AssertionError(f"crash_after={phase!r} did not fire")
+            except CoordinatorKilled:
+                self.report.coordinator_kills += 1
+            results = resume_migrations(self._journal_dir)
+            assert results, "resume found no in-flight migration"
+            for r in results:
+                assert r["action"] in ("completed", "rolled_back"), r
+                if r["action"] == "completed":
+                    self.report.resumed_completed += 1
+                    owner = 1 - owner
+                else:
+                    self.report.resumed_rolled_back += 1
+            self._client.refresh_topology()
+            self._assert_slots_stable()
+            self._assert_one_owner()
+            self._verify_acked(sample=10)
+
+    # -- checkpoint chaos -----------------------------------------------------
+
+    def _checkpoint_chaos(self, cycle: int) -> None:
+        """Good save → torn-write save (head corrupt) → load falls back to
+        the good generation; ENOSPC save fails loudly and leaves the
+        lineage untouched."""
+        import redisson_tpu
+        from redisson_tpu.core import checkpoint
+
+        engine = self._runner.masters[0].server.server.engine
+        path = os.path.join(self._journal_dir, f"cycle{cycle}.ckpt")
+        n_good = checkpoint.save(engine, path)
+        sched = FaultSchedule(self.config.seed * 31 + cycle)
+        sched.add("torn_write", after=0, count=1, torn_frac=0.5)
+        sched.add("enospc", after=1, count=1)
+        plane = FaultPlane(sched)
+        self._planes.append(plane)
+        with plane.active():
+            checkpoint.save(engine, path)         # head torn (media lied)
+            try:
+                checkpoint.save(engine, path)     # disk full: loud failure
+                raise AssertionError("ENOSPC fault did not surface")
+            except OSError:
+                pass
+        before = dict(checkpoint.STATS)
+        fresh = redisson_tpu.create()
+        try:
+            n_loaded = checkpoint.load(fresh._engine, path)
+            assert n_loaded == n_good, (
+                f"fallback generation lost records: {n_loaded} != {n_good}"
+            )
+        finally:
+            fresh.shutdown()
+        assert checkpoint.STATS["generation_fallbacks"] > before.get(
+            "generation_fallbacks", 0
+        ), "torn head did not register a generation fallback"
+        self.report.checkpoint_fallbacks += 1
+
+    # -- quiesce --------------------------------------------------------------
+
+    def _quiesce_census(self, cycle: int) -> None:
+        deadline = time.monotonic() + self.config.quiesce_deadline_s
+        snap = self.census.snapshot()
+        while time.monotonic() < deadline:
+            busy = [
+                k for k, v in snap.items()
+                if v and (
+                    k.endswith(".conn_in_use")
+                    or k.endswith(".repl_staged_xfers")
+                    or k.endswith(".record_locks")
+                )
+            ]
+            if not busy:
+                break
+            time.sleep(0.2)
+            snap = self.census.snapshot()
+        for k, v in snap.items():
+            if k.endswith((".conn_in_use", ".repl_staged_xfers",
+                           ".record_locks", ".kernel_cache_stale")):
+                assert v == 0, f"cycle {cycle}: leaked resource {k} = {v}"
+        self.report.census.append(snap)
+
+    # -- the run loop ---------------------------------------------------------
+
+    def run(self) -> MigrationSoakReport:
+        cfg = self.config
+        self._setup()
+        try:
+            for cycle in range(cfg.cycles):
+                bloom_before = self._bloom_snapshot()
+                stop = threading.Event()
+                threads = [
+                    threading.Thread(target=self._writer, args=(w, cycle, stop))
+                    for w in range(cfg.writer_threads)
+                ]
+                ctx = None
+                if cfg.transport_faults:
+                    plane = FaultPlane(self._transport_schedule(cycle))
+                    self._planes.append(plane)
+                    ctx = plane.active()
+                    ctx.__enter__()
+                try:
+                    for t in threads:
+                        t.start()
+                    self._migration_storm(cycle)
+                finally:
+                    stop.set()
+                    for t in threads:
+                        t.join(timeout=90.0)
+                    if ctx is not None:
+                        ctx.__exit__(None, None, None)
+                assert not any(t.is_alive() for t in threads), "writer wedged"
+                self._verify_acked()           # EVERY acked write, exact value
+                self._assert_bloom_bit_identical(bloom_before)
+                if cfg.storage_faults:
+                    self._checkpoint_chaos(cycle)
+                self._quiesce_census(cycle)
+                self.report.cycles_completed += 1
+            budget = int(
+                cfg.error_budget_ratio * max(1, self.report.acked_writes)
+            )
+            assert self.report.errors <= budget, (
+                f"error budget blown: {self.report.errors} errors vs "
+                f"{self.report.acked_writes} acked writes (budget {budget})"
+            )
+            return self.report
+        finally:
             self.report.injected_faults = {}
             for plane in self._planes:
                 for kind, n in plane.injected.items():

@@ -24,23 +24,75 @@ Wire format (version 1):
             ...
         ],
     }
+    8-byte trailer magic b"RTPUCRC1" + 4-byte big-endian CRC32 of everything
+    before the trailer — a torn write (power loss after the rename, media
+    that lied about fsync) truncates the tail, so a missing/mismatched
+    trailer is the crash-consistency detector.
+
+Durability generations (ISSUE 4): ``save`` keeps the last ``keep`` good
+snapshots — the previous head rotates to ``<path>.1``, the one before to
+``<path>.2``, ... — and fsyncs the parent DIRECTORY after the final
+``os.replace`` so the rename itself survives power loss.  ``load`` verifies
+the CRC trailer and, when the head is corrupt or truncated, falls back to
+the newest intact generation LOUDLY (logged + counted in ``STATS``; the
+chaos census exposes the counters via
+``ResourceCensus.track_checkpoints``).
 
 Restore uses the restricted unpickler policy of net/safe_pickle.py extended
 with numpy reconstruction — a checkpoint is the same trust domain as a Redis
 RDB file, but there is no reason to allow arbitrary classes either.
+
+Fault injection: the two file-I/O event sites (write, fsync) consult the
+process-global chaos plane (``chaos/faults.py`` storage stream: ``enospc``,
+``torn_write``, ``fsync_fail``) exactly like ``net/client.py`` consults it
+for transport events — injected storage faults flow through the REAL
+durability machinery, never around it.
 """
 from __future__ import annotations
 
 import io
+import logging
 import os
 import pickle
+import struct
 import time
-from typing import Any, Dict, List
+import zlib
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from redisson_tpu.utils.durability import fsync_dir as _fsync_dir
+
 MAGIC = b"RTPUCKP1"
+TRAILER_MAGIC = b"RTPUCRC1"
 FORMAT = 1
+DEFAULT_GENERATIONS = 3  # head + 2 rotated backups
+
+_log = logging.getLogger("redisson_tpu.checkpoint")
+
+# durability bookkeeping, exposed to the chaos census
+# (ResourceCensus.track_checkpoints): corruption must be OBSERVABLE, not
+# just survived
+STATS: Dict[str, int] = {
+    "corrupt_generations": 0,   # candidates that failed CRC/magic on load
+    "generation_fallbacks": 0,  # loads served by a non-head generation
+}
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint file failed structural verification (bad magic,
+    truncated payload, CRC mismatch, unreadable pickle) — distinct from
+    version/hash INCOMPATIBILITY, which raises plain ValueError and never
+    falls back (an incompatible head means incompatible generations)."""
+
+
+def _storage_plane():
+    # the same process-global plane net/client.py consults; checkpoint I/O
+    # is cold path, so no zero-cost contract applies here
+    from redisson_tpu.net import client as _net
+
+    return _net._fault_plane
+
 
 # serializes same-process savers (AutoCheckpointer thread vs SAVE command);
 # cross-process uniqueness comes from the tmp-file name
@@ -75,8 +127,21 @@ def _snapshot_records(engine) -> List[Dict[str, Any]]:
     return out
 
 
-def save(engine, path: str) -> int:
-    """Snapshot the full DeviceStore to `path`. Returns #records saved."""
+def generation_path(path: str, gen: int) -> str:
+    """Generation 0 is the head; generation N is the Nth-newest backup."""
+    return path if gen == 0 else f"{path}.{gen}"
+
+
+def save(engine, path: str, keep: int = DEFAULT_GENERATIONS) -> int:
+    """Snapshot the full DeviceStore to `path`. Returns #records saved.
+
+    Keeps the ``keep - 1`` previous snapshots as rotated generations
+    (``<path>.1`` newest) so a head corrupted AFTER a successful save
+    (torn write surfacing at the block layer, admin truncation) still
+    leaves a loadable lineage.  The write path is: tmp file -> fsync(file)
+    -> rotate old generations -> ``os.replace`` onto the head ->
+    fsync(parent dir), so no crash point can lose BOTH the old head and
+    the new one."""
     from redisson_tpu.utils import hashing as H
 
     with _save_lock:
@@ -87,15 +152,35 @@ def save(engine, path: str) -> int:
             "hash_version": getattr(H, "HASH_VERSION", 1),
             "records": records,
         }
+        body = MAGIC + pickle.dumps(payload, protocol=4)
+        data = body + TRAILER_MAGIC + struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF)
         tmp = f"{path}.tmp.{os.getpid()}.{next(_save_seq)}"
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        plane = _storage_plane()
+        if plane is not None:
+            # may raise OSError(ENOSPC), or return a torn PREFIX that this
+            # save then treats as fully written (the media-lied model)
+            data = plane.on_storage_write(tmp, data)
         try:
             with open(tmp, "wb") as f:
-                f.write(MAGIC)
-                pickle.dump(payload, f, protocol=4)
+                f.write(data)
                 f.flush()
+                if plane is not None:
+                    plane.on_storage_fsync(tmp)  # may raise OSError(EIO)
                 os.fsync(f.fileno())
+            # rotate: previous head -> .1, .1 -> .2, ... (newest-first);
+            # anything past `keep - 1` backups falls off the end
+            if keep > 1 and os.path.exists(path):
+                for gen in range(keep - 1, 1, -1):
+                    older = generation_path(path, gen - 1)
+                    if os.path.exists(older):
+                        os.replace(older, generation_path(path, gen))
+                os.replace(path, generation_path(path, 1))
             os.replace(tmp, path)
+            # the renames live in the DIRECTORY's blocks: without this
+            # fsync a power loss can roll the whole rotation back
+            _fsync_dir(parent)
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
@@ -122,22 +207,89 @@ def _loads(data: bytes):
     return _make_unpickler(io.BytesIO(data)).load()
 
 
+def read_verified(path: str):
+    """Read + structurally verify ONE checkpoint file; returns the payload
+    dict or raises :class:`CheckpointCorruptError`."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data.startswith(MAGIC):
+        raise CheckpointCorruptError(f"not a redisson_tpu checkpoint: {path!r}")
+    trailer_len = len(TRAILER_MAGIC) + 4
+    if len(data) < len(MAGIC) + trailer_len or data[-trailer_len:-4] != TRAILER_MAGIC:
+        raise CheckpointCorruptError(
+            f"checkpoint truncated (CRC trailer missing): {path!r}"
+        )
+    body = data[:-trailer_len]
+    (crc,) = struct.unpack(">I", data[-4:])
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise CheckpointCorruptError(
+            f"checkpoint CRC mismatch (torn write?): {path!r}"
+        )
+    try:
+        return _loads(body[len(MAGIC):])
+    except Exception as e:  # noqa: BLE001 — CRC passed but pickle didn't: corrupt
+        raise CheckpointCorruptError(
+            f"checkpoint payload unreadable: {path!r}: {e}"
+        ) from e
+
+
+def _load_lineage(path: str):
+    """Try the head, then each rotated generation, newest first.  Returns
+    ``(payload, generation_index)``; corruption is counted and logged
+    loudly, and only the exhaustion of EVERY generation re-raises (the
+    head's error, so callers see the primary failure)."""
+    head_err: Optional[Exception] = None
+    gen = 0
+    while True:
+        cand = generation_path(path, gen)
+        if gen > 0 and not os.path.exists(cand):
+            break
+        try:
+            payload = read_verified(cand)
+        except FileNotFoundError as e:
+            # gen 0 only (gen > 0 is existence-guarded above): save()'s
+            # crash window between the rotation rename and the head install
+            # leaves NO head but an intact .1 — fall through to the
+            # generations; a checkpoint that never existed re-raises below
+            # once no generation turns up either
+            if head_err is None:
+                head_err = e
+            gen += 1
+            continue
+        except CheckpointCorruptError as e:
+            STATS["corrupt_generations"] += 1
+            _log.error("checkpoint generation %s corrupt: %s", gen, e)
+            if head_err is None:
+                head_err = e
+            gen += 1
+            continue
+        if gen > 0:
+            STATS["generation_fallbacks"] += 1
+            _log.error(
+                "checkpoint head %r missing/corrupt; falling back to "
+                "generation %d (%r)", path, gen, cand,
+            )
+        return payload, gen
+    assert head_err is not None
+    raise head_err
+
+
 def load(engine, path: str) -> int:
     """Restore a snapshot into the engine's store. Returns #records loaded.
 
     Existing records with the same name are overwritten (RESTORE REPLACE
-    semantics); records whose TTL already elapsed are skipped.
+    semantics); records whose TTL already elapsed are skipped.  A corrupt
+    or truncated head (bad magic, missing/mismatched CRC trailer, torn
+    pickle) falls back to the newest intact generation — loudly: logged,
+    counted in ``STATS``, and raising :class:`CheckpointCorruptError` only
+    when NO generation survives.
     """
     import jax
 
     from redisson_tpu.core.store import StateRecord
     from redisson_tpu.utils import hashing as H
 
-    with open(path, "rb") as f:
-        magic = f.read(len(MAGIC))
-        if magic != MAGIC:
-            raise ValueError(f"not a redisson_tpu checkpoint: {path!r}")
-        payload = _loads(f.read())
+    payload, _gen = _load_lineage(path)
     if payload.get("format") != FORMAT:
         raise ValueError(f"unsupported checkpoint format {payload.get('format')}")
     hv = payload.get("hash_version", 1)
@@ -202,10 +354,28 @@ class AutoCheckpointer:
             except Exception as e:  # noqa: BLE001 - keep the loop alive
                 self.last_error = e
 
-    def stop(self):
+    def stop(self, flush: bool = True, join_timeout: float = 5.0) -> bool:
+        """Stop the loop, then take a FINAL snapshot (flush-on-stop: writes
+        since the last interval tick would otherwise die with the process —
+        the `SHUTDOWN SAVE` discipline applied to the background saver).
+
+        Returns whether the thread actually joined; ``False`` means a save
+        longer than ``join_timeout`` is STILL RUNNING on the daemon thread
+        — previously this was silent, and the final snapshot is skipped in
+        that case (the in-flight save IS the freshest one, and a second
+        saver would just queue behind its lock)."""
         self._stop.set()
         if self._thread.is_alive():
-            self._thread.join(timeout=5.0)
+            self._thread.join(timeout=join_timeout)
+        joined = not self._thread.is_alive()
+        if flush and joined and self._thread.ident is not None:
+            try:
+                save(self.engine, self.path)
+                self.last_save = time.time()
+                self.last_error = None
+            except Exception as e:  # noqa: BLE001 — report, never raise mid-teardown
+                self.last_error = e
+        return joined
 
 
 # -- single-record portable blobs (RObject.dump/restore + the DUMP verb) -----

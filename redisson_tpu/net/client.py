@@ -524,6 +524,7 @@ class NodeClient:
         connect_timeout: float = 10.0,
         retry_attempts: int = 3,
         retry_interval: float = 1.5,
+        retry_policy=None,
         ping_interval: float = 30.0,
         detector: Optional[FailedNodeDetector] = None,
         hooks: Optional[List] = None,
@@ -558,6 +559,12 @@ class NodeClient:
         self._connect_timeout = connect_timeout
         self.retry_attempts = retry_attempts
         self.retry_interval = retry_interval
+        # net/retry.py RetryPolicy: bounded exponential backoff + jitter +
+        # deadline propagation.  When set it REPLACES the legacy
+        # retry_attempts/retry_interval schedule (same detector feeds, same
+        # pool discard — only the retry cadence changes); an explicit
+        # per-call retry_attempts= still overrides both.
+        self.retry_policy = retry_policy
         self.detector = detector or FailedNodeDetector()
         self.hooks = list(hooks or [])  # CommandHook SPI (utils/metrics.py)
         self._closed = threading.Event()
@@ -655,16 +662,35 @@ class NodeClient:
         self, fn: Callable[[Connection], Any], retry_attempts: Optional[int] = None
     ) -> Any:
         last: Optional[BaseException] = None
-        attempts = self.retry_attempts if retry_attempts is None else retry_attempts
+        # a RetryPolicy (net/retry.py) replaces the legacy fixed schedule:
+        # bounded exponential backoff + seeded jitter, and an overall
+        # deadline the acquire timeout is clamped to (deadline propagation);
+        # an explicit per-call retry_attempts= keeps the legacy schedule
+        policy = self.retry_policy if retry_attempts is None else None
+        clock = policy.start() if policy is not None else None
+        if policy is not None:
+            attempts = policy.max_attempts - 1
+        else:
+            attempts = self.retry_attempts if retry_attempts is None else retry_attempts
         for attempt in range(attempts + 1):
             if self._closed.is_set():
                 raise ConnectionError_("client is closed")
             if attempt:
-                # exponential backoff on reconnect attempts
-                # (ConnectionWatchdog.java: timeout = 2 << attempts ms floor)
-                time.sleep(min(self.retry_interval * attempt, 10.0))
+                if clock is not None:
+                    clock.attempt = attempt
+                    try:
+                        clock.sleep()
+                    except TimeoutError:
+                        break  # deadline gone: surface the last real error
+                else:
+                    # exponential backoff on reconnect attempts
+                    # (ConnectionWatchdog.java: timeout = 2 << attempts ms floor)
+                    time.sleep(min(self.retry_interval * attempt, 10.0))
+            acquire_timeout = self._connect_timeout
+            if clock is not None:
+                acquire_timeout = clock.attempt_timeout(self._connect_timeout)
             try:
-                conn = self.pool.acquire(timeout=self._connect_timeout)
+                conn = self.pool.acquire(timeout=acquire_timeout)
             except (ConnectionError, OSError) as e:
                 last = e
                 continue
@@ -700,7 +726,12 @@ class NodeClient:
                 # — a no-op while already connected)
                 self.events_hub.node_connected(self.address)
             return result
-        assert last is not None
+        if last is None:
+            from redisson_tpu.net.retry import DeadlineExceeded
+
+            raise DeadlineExceeded(
+                f"retry budget exhausted talking to {self.address}"
+            )
         raise last
 
     def in_flight(self) -> int:

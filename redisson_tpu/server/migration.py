@@ -13,28 +13,68 @@ Protocol walk (per slot):
   1. target: CLUSTER SETSLOT <s> IMPORTING <source>   (admit ASKING traffic)
   2. source: CLUSTER SETSLOT <s> MIGRATING <target>   (absent keys -> ASK;
      record creation in the slot is barred by the store's creation guard)
-  3. source: CLUSTER MIGRATESLOT <s> [batch] until 0  (each record moves
+  3. source: CLUSTER MIGRATESLOTS <s...> until 0      (each record moves
      atomically under its record lock: serialize -> IMPORTRECORDS -> delete)
-  4. everyone: CLUSTER SETVIEW <new view>; source+target: SETSLOT NODE
+  4. everyone: CLUSTER SETVIEW <new view>; source+target: SETSLOT STABLE
      (clears the window; clients converge via MOVED + refresh)
 
 During the window writes are never dropped: a record still on the source
 serves there (and ships if it mutates before its move); a record already
 moved ASK-redirects; creations ASK-redirect.  The chaos test
 (tests/test_migration.py) rebalances mid-load and audits every acked write.
+
+Crash safety (ISSUE 4 tentpole): pass ``journal_dir=`` and the run becomes
+a **journaled state machine** — every phase is recorded write-ahead in a
+:class:`~redisson_tpu.server.migration_journal.MigrationJournal` (PLANNED →
+WINDOW_OPEN → DRAINING(sweep progress) → VIEW_COMMITTED →
+STABLE/ROLLED_BACK), each ``SETSLOT``/``MIGRATESLOTS`` carries the
+migration's fencing ``EPOCH`` (stale coordinators get ``STALEEPOCH``), and
+:func:`resume_migrations` replays the journal directory after a
+coordinator crash: migrations that died before opening the window roll
+back (reverse-draining any ASK-created strays), migrations that died later
+complete forward — idempotently, because every re-issued verb is safe
+under the recorded epoch and views.  ``crash_after=`` is the deterministic
+kill hook the chaos tier uses to murder the coordinator at every phase
+boundary.
+
+Admin links ride :class:`~redisson_tpu.net.retry.RetryPolicy` (bounded
+exponential backoff + jitter + deadline) instead of the old single-shot
+``retry_attempts=1`` connections, so control traffic feeds the same
+failure detectors as data traffic and a transient refuse-connect no longer
+aborts a whole reshard.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from redisson_tpu.net.client import NodeClient
+from redisson_tpu.net.retry import RetryPolicy
+from redisson_tpu.server.migration_journal import MigrationJournal
 from redisson_tpu.utils.crc16 import MAX_SLOT
+
+
+class CoordinatorKilled(BaseException):
+    """The deterministic coordinator-kill hook (``crash_after=``): raised
+    at a phase boundary to simulate the process dying there.  Derives from
+    BaseException so no best-effort ``except Exception`` in the protocol
+    path can swallow the 'death' — exactly like a real SIGKILL, nothing
+    (including rollback) runs after it."""
+
+
+def _admin_retry_policy() -> RetryPolicy:
+    """Migration control traffic's retry schedule: a fresh policy per link
+    (each carries its own jitter RNG) with a deadline that bounds any one
+    control verb's total retry budget."""
+    return RetryPolicy(
+        max_attempts=4, base_delay=0.05, max_delay=1.0, jitter=0.2,
+        deadline_s=30.0,
+    )
 
 
 def _admin(addr: str, password: Optional[str], ssl_context=None) -> NodeClient:
     return NodeClient(
-        addr, password=password, ping_interval=0, retry_attempts=1,
-        ssl_context=ssl_context,
+        addr, password=password, ping_interval=0,
+        retry_policy=_admin_retry_policy(), ssl_context=ssl_context,
     )
 
 
@@ -45,100 +85,326 @@ def migrate_slots(
     all_nodes: Optional[Sequence[str]] = None,
     password: Optional[str] = None,
     ssl_context=None,
+    journal_dir: Optional[str] = None,
+    crash_after: Optional[str] = None,
 ) -> int:
     """Move `slots` from `source` to `target` while both serve traffic.
 
     `all_nodes` = every node (masters + replicas) that should learn the new
     view; defaults to the masters named in the source's current view plus
     the target.  Returns the number of records moved.
+
+    With ``journal_dir`` the run is journaled + fenced (see module
+    docstring); ``crash_after=<PHASE>`` (or ``"DRAINING:<sweep>"``) raises
+    :class:`CoordinatorKilled` right after that phase's journal entry —
+    the chaos tier's deterministic kill switch.
     """
-    src = _admin(source, password, ssl_context)
-    tgt = _admin(target, password, ssl_context)
-    moved = 0
-    window_open = False
-    old_view: List[Tuple[int, int, str, int, str]] = []
-    try:
-        view = old_view = _fetch_view(src)
-        target_id = _s(tgt.execute("CLUSTER", "MYID"))
-        # 1+2: open the window (importing BEFORE migrating: an ASK redirect
-        # must never land on a target that would bounce it back MOVED)
-        for s in slots:
-            tgt.execute("CLUSTER", "SETSLOT", s, "IMPORTING", source)
-        window_open = True
-        for s in slots:
-            src.execute("CLUSTER", "SETSLOT", s, "MIGRATING", target)
-        # 3: drain — one bulk call scans the store once for ALL slots; loop
-        # until a sweep moves nothing (absent-guarded creations can't add
-        # names behind the scan, so this converges in ~2 sweeps)
+    journal = (
+        MigrationJournal.create(journal_dir, source, target)
+        if journal_dir is not None else None
+    )
+    run = _MigrationRun(
+        source, target, slots, all_nodes=all_nodes, password=password,
+        ssl_context=ssl_context, journal=journal, crash_after=crash_after,
+    )
+    return run.execute()
+
+
+def resume_migrations(
+    journal_dir: str,
+    password: Optional[str] = None,
+    ssl_context=None,
+) -> List[Dict[str, Any]]:
+    """Settle every in-flight migration the journal directory records —
+    the coordinator-restart path.  Idempotent: re-running it (even after
+    ANOTHER crash mid-resume) converges, because every replayed verb
+    carries the migration's recorded epoch and views.
+
+    Policy per last-recorded phase:
+
+      * ``PLANNED`` — the window may be partially open but no drain sweep
+        was recorded: ROLL BACK (close the window, reverse-drain strays an
+        ASK redirect created on the target, restore the recorded old view).
+      * ``WINDOW_OPEN`` / ``DRAINING`` / ``VIEW_COMMITTED`` — COMPLETE
+        forward: re-open the window (idempotent re-issue), drain to zero,
+        re-commit the recorded new view, stabilize + propagate.
+
+    Returns one summary dict per journal touched; a migration whose nodes
+    are unreachable is reported ``"failed"`` and left non-terminal for the
+    next resume pass rather than aborting the others.
+    """
+    out: List[Dict[str, Any]] = []
+    for journal in MigrationJournal.in_flight(journal_dir):
+        planned = journal.entry("PLANNED")
+        if planned is None:  # only a torn PLANNED line: nothing ever ran
+            journal.append("ROLLED_BACK", resumed=True, reason="empty journal")
+            out.append({"id": journal.migration_id, "action": "rolled_back"})
+            continue
+        run = _MigrationRun(
+            planned["source"], planned["target"], planned["slots"],
+            all_nodes=planned.get("all_nodes"), password=password,
+            ssl_context=ssl_context, journal=journal,
+        )
+        try:
+            if journal.phase == "PLANNED":
+                run.resume_rollback(planned)
+                out.append({
+                    "id": journal.migration_id, "action": "rolled_back",
+                    "epoch": journal.epoch,
+                })
+            else:
+                moved = run.resume_complete(planned)
+                out.append({
+                    "id": journal.migration_id, "action": "completed",
+                    "moved": moved, "epoch": journal.epoch,
+                })
+        except Exception as e:  # noqa: BLE001 — settle the REST of the journals
+            out.append({
+                "id": journal.migration_id, "action": "failed", "error": repr(e),
+            })
+    return out
+
+
+class _MigrationRun:
+    """One migration as an explicit state machine: phase methods shared by
+    the fresh path (``execute``) and the journal-replay paths
+    (``resume_complete`` / ``resume_rollback``)."""
+
+    def __init__(
+        self,
+        source: str,
+        target: str,
+        slots: Sequence[int],
+        all_nodes: Optional[Sequence[str]] = None,
+        password: Optional[str] = None,
+        ssl_context=None,
+        journal: Optional[MigrationJournal] = None,
+        crash_after: Optional[str] = None,
+    ):
+        self.source, self.target = source, target
+        self.slots = [int(s) for s in slots]
+        self.all_nodes = all_nodes
+        self.password, self.ssl_context = password, ssl_context
+        self.journal = journal
+        self.crash_after = crash_after
+        self.epoch: Optional[int] = journal.epoch if journal is not None else None
+        self.src: Optional[NodeClient] = None
+        self.tgt: Optional[NodeClient] = None
+
+    # -- journal / crash plumbing --------------------------------------------
+
+    def _record(self, phase: str, **data) -> None:
+        if self.journal is not None:
+            self.journal.append(phase, **data)
+
+    def _crash_point(self, label: str) -> None:
+        if self.crash_after is not None and self.crash_after == label:
+            raise CoordinatorKilled(f"[chaos] coordinator killed after {label}")
+
+    def _ep(self) -> Tuple:
+        """Trailing fencing operands for SETSLOT (epoch-less when not
+        journaled — legacy manual migrations stay unfenced)."""
+        return ("EPOCH", self.epoch) if self.epoch is not None else ()
+
+    def _ep_lead(self) -> Tuple:
+        """Leading fencing operands for MIGRATESLOTS."""
+        return ("EPOCH", self.epoch) if self.epoch is not None else ()
+
+    def _connect(self) -> None:
+        self.src = _admin(self.source, self.password, self.ssl_context)
+        self.tgt = _admin(self.target, self.password, self.ssl_context)
+
+    def _close(self) -> None:
+        for c in (self.src, self.tgt):
+            if c is not None:
+                c.close()
+
+    # -- phases ---------------------------------------------------------------
+
+    def _phase_open_window(self) -> None:
+        # importing BEFORE migrating: an ASK redirect must never land on a
+        # target that would bounce it back MOVED
+        for s in self.slots:
+            self.tgt.execute(
+                "CLUSTER", "SETSLOT", s, "IMPORTING", self.source, *self._ep()
+            )
+        for s in self.slots:
+            self.src.execute(
+                "CLUSTER", "SETSLOT", s, "MIGRATING", self.target, *self._ep()
+            )
+
+    def _phase_drain(self, moved: int = 0) -> int:
+        # one bulk call scans the store once for ALL slots; loop until a
+        # sweep moves nothing (absent-guarded creations can't add names
+        # behind the scan, so this converges in ~2 sweeps).  Each sweep is
+        # journaled — a resumed coordinator knows how far the drain got.
+        sweep_no = 0
         while True:
             n = int(
-                src.execute("CLUSTER", "MIGRATESLOTS", *slots, timeout=300.0)
+                self.src.execute(
+                    "CLUSTER", "MIGRATESLOTS", *self._ep_lead(), *self.slots,
+                    timeout=300.0,
+                )
             )
             moved += n
+            sweep_no += 1
+            self._record("DRAINING", moved=moved, sweep=sweep_no, batch=n)
+            self._crash_point(f"DRAINING:{sweep_no}")
             if n == 0:
-                break
-        # 4: finalize.  Source and target MUST learn the new view before the
-        # window closes — a target that still believes the old view would
-        # MOVED-bounce the slot back at the source forever.  Failure here
-        # aborts (and rolls back) rather than strands the slot.
-        new_view = _reassign(view, slots, target, target_id)
+                return moved
+
+    def _phase_commit_view(self, new_view) -> List:
         flat: List = []
         for lo, hi, h, p, nid in new_view:
             flat += [lo, hi, h, p, nid]
-        tgt.execute("CLUSTER", "SETVIEW", *flat, timeout=10.0)
-        src.execute("CLUSTER", "SETVIEW", *flat, timeout=10.0)
-        for s in slots:
-            src.execute("CLUSTER", "SETSLOT", s, "STABLE")
-            tgt.execute("CLUSTER", "SETSLOT", s, "STABLE")
+        # Source and target MUST learn the new view before the window
+        # closes — a target that still believes the old view would
+        # MOVED-bounce the slot back at the source forever.
+        self.tgt.execute("CLUSTER", "SETVIEW", *flat, timeout=10.0)
+        self.src.execute("CLUSTER", "SETVIEW", *flat, timeout=10.0)
+        return flat
+
+    def _phase_stabilize(self, flat: List, known_view) -> None:
+        for s in self.slots:
+            self.src.execute("CLUSTER", "SETSLOT", s, "STABLE", *self._ep())
+            self.tgt.execute("CLUSTER", "SETSLOT", s, "STABLE", *self._ep())
         # remaining nodes are best-effort: they converge via MOVED + refresh
-        nodes = set(all_nodes or [])
-        nodes.update(f"{h}:{p}" for _lo, _hi, h, p, _nid in view)
-        nodes.discard(source)
-        nodes.discard(target)
+        nodes = set(self.all_nodes or [])
+        nodes.update(f"{h}:{p}" for _lo, _hi, h, p, _nid in known_view)
+        nodes.discard(self.source)
+        nodes.discard(self.target)
         for addr in nodes:
             c = None
             try:
-                c = _admin(addr, password, ssl_context)
+                c = _admin(addr, self.password, self.ssl_context)
                 c.execute("CLUSTER", "SETVIEW", *flat, timeout=10.0)
             except Exception:  # noqa: BLE001 — down node learns on recovery/MOVED
                 pass
             finally:
                 if c is not None:
                     c.close()
-        return moved
-    except BaseException:
-        if window_open:
-            _rollback(src, tgt, source, target, slots, old_view)
-        raise
-    finally:
-        src.close()
-        tgt.close()
+
+    # -- fresh run -------------------------------------------------------------
+
+    def execute(self) -> int:
+        moved = 0
+        window_open = False
+        old_view: List[Tuple[int, int, str, int, str]] = []
+        self._connect()
+        try:
+            view = old_view = _fetch_view(self.src)
+            target_id = _s(self.tgt.execute("CLUSTER", "MYID"))
+            new_view = _reassign(view, self.slots, self.target, target_id)
+            # WRITE-AHEAD: the PLANNED entry carries everything a resumed
+            # coordinator needs — recorded BEFORE any remote mutation
+            self._record(
+                "PLANNED", source=self.source, target=self.target,
+                slots=self.slots, epoch=self.epoch, old_view=old_view,
+                new_view=new_view, target_id=target_id,
+                all_nodes=list(self.all_nodes) if self.all_nodes else None,
+            )
+            self._crash_point("PLANNED")
+            # set BEFORE opening: a failure mid-way through either SETSLOT
+            # loop leaves a HALF-open window (e.g. target IMPORTING, source
+            # untouched) that the rollback must still unwind
+            window_open = True
+            self._phase_open_window()
+            self._record("WINDOW_OPEN")
+            self._crash_point("WINDOW_OPEN")
+            moved = self._phase_drain()
+            self._crash_point("DRAINING")
+            flat = self._phase_commit_view(new_view)
+            self._record("VIEW_COMMITTED")
+            self._crash_point("VIEW_COMMITTED")
+            self._phase_stabilize(flat, view)
+            self._record("STABLE", moved=moved)
+            return moved
+        except CoordinatorKilled:
+            raise  # a 'dead' coordinator runs nothing — resume owns recovery
+        except BaseException as primary:
+            if window_open:
+                try:
+                    _rollback(
+                        self.src, self.tgt, self.source, self.target,
+                        self.slots, old_view, epoch=self.epoch,
+                    )
+                except BaseException as rb_err:  # noqa: BLE001
+                    # the rollback's OWN failure must not mask the original
+                    # error: surface the primary, chain the rollback failure
+                    raise primary from rb_err
+                self._record("ROLLED_BACK", error=repr(primary))
+            raise
+        finally:
+            self._close()
+
+    # -- journal-replay paths --------------------------------------------------
+
+    def resume_complete(self, planned: Dict[str, Any]) -> int:
+        """Drive a journaled migration that died at/after WINDOW_OPEN to
+        STABLE.  Every step re-issues under the recorded epoch, so redoing
+        work the dead coordinator already did is a no-op (SETSLOT and
+        SETVIEW are level-triggered; an empty drain sweeps zero records)."""
+        self._connect()
+        try:
+            self._phase_open_window()  # idempotent re-open
+            moved = self._phase_drain(moved=int(self.journal.latest("moved", 0)))
+            new_view = [tuple(row) for row in planned["new_view"]]
+            flat = self._phase_commit_view(new_view)
+            self._record("VIEW_COMMITTED", resumed=True)
+            old_view = [tuple(row) for row in planned["old_view"]]
+            self._phase_stabilize(flat, old_view)
+            self._record("STABLE", moved=moved, resumed=True)
+            return moved
+        finally:
+            self._close()
+
+    def resume_rollback(self, planned: Dict[str, Any]) -> None:
+        """Unwind a journaled migration that died at PLANNED: the window
+        may be half-open and an ASK redirect may have created records on
+        the target, but no drain sweep was recorded — rolling back is
+        strictly cheaper than completing."""
+        self._connect()
+        try:
+            old_view = [tuple(row) for row in planned["old_view"]]
+            _rollback(
+                self.src, self.tgt, self.source, self.target, self.slots,
+                old_view, epoch=self.epoch,
+            )
+            self._record("ROLLED_BACK", resumed=True)
+        finally:
+            self._close()
 
 
-def _rollback(src, tgt, source: str, target: str, slots, old_view) -> None:
+def _rollback(src, tgt, source: str, target: str, slots, old_view,
+              epoch: Optional[int] = None) -> None:
     """Best-effort unwind of a failed migration: pull already-moved records
     back to the source, restore the pre-migration view on BOTH ends, close
     the window.  If the target is unreachable, the window is still closed —
     records already shipped stay safe on the target and a RE-RUN of
     migrate_slots(source, target, slots) converges once it returns
-    (IMPORTRECORDS applies by version, the drain resumes where it stopped)."""
+    (IMPORTRECORDS applies by version, the drain resumes where it stopped).
+    A journaled rollback carries the migration's fencing epoch so a stale
+    coordinator's late rollback cannot disturb a newer migration."""
+    ep: Tuple = ("EPOCH", epoch) if epoch is not None else ()
     # close the forward window on the source FIRST: its absent guard must
     # not ASK-bounce the reverse imports about to arrive
     for s in slots:
         try:
-            src.execute("CLUSTER", "SETSLOT", s, "STABLE")
+            src.execute("CLUSTER", "SETSLOT", s, "STABLE", *ep)
         except Exception:  # noqa: BLE001 — source gone; nothing to unwind into
             pass
     try:
         # reverse-drain: target -> source for anything that already moved
         for s in slots:
             try:
-                src.execute("CLUSTER", "SETSLOT", s, "IMPORTING", target)
-                tgt.execute("CLUSTER", "SETSLOT", s, "MIGRATING", source)
+                src.execute("CLUSTER", "SETSLOT", s, "IMPORTING", target, *ep)
+                tgt.execute("CLUSTER", "SETSLOT", s, "MIGRATING", source, *ep)
             except Exception:  # noqa: BLE001 — target gone; records stay there
                 pass
         try:
-            while int(tgt.execute("CLUSTER", "MIGRATESLOTS", *slots, timeout=300.0)) > 0:
+            while int(tgt.execute(
+                "CLUSTER", "MIGRATESLOTS", *ep, *slots, timeout=300.0
+            )) > 0:
                 pass
         except Exception:  # noqa: BLE001 — target gone; records stay there
             pass
@@ -146,7 +412,7 @@ def _rollback(src, tgt, source: str, target: str, slots, old_view) -> None:
         for s in slots:
             for c in (src, tgt):
                 try:
-                    c.execute("CLUSTER", "SETSLOT", s, "STABLE")
+                    c.execute("CLUSTER", "SETSLOT", s, "STABLE", *ep)
                 except Exception:  # noqa: BLE001 — unreachable node
                     pass
         # restore the pre-migration view: a target that already installed

@@ -1,0 +1,214 @@
+"""Write-ahead journal for slot migrations (ISSUE 4 tentpole).
+
+``server/migration.py``'s coordinator used to be single-shot: a crash after
+``SETSLOT IMPORTING/MIGRATING`` but before ``SETVIEW`` stranded slots in
+window limbo with NO record of what was in flight.  The journal is the
+crash-safety substrate: one append-only file per migration under a journal
+directory, one fsync'd entry per phase:
+
+    PLANNED        intent + everything resume needs (source, target, slots,
+                   fencing epoch, old view, computed new view, target id)
+    WINDOW_OPEN    IMPORTING + MIGRATING issued on both ends
+    DRAINING       one entry per MIGRATESLOTS sweep (cumulative progress)
+    VIEW_COMMITTED SETVIEW landed on source + target
+    STABLE         terminal: windows closed, view propagated
+    ROLLED_BACK    terminal: unwound (reverse-drained, old view restored)
+
+Entry format: one line per entry, ``<compact-json>|<crc32-hex>``.  The CRC
+makes a torn TAIL line (the crash happened mid-append) detectable:
+``open()`` keeps the intact prefix and drops everything from the first bad
+line — exactly the replay semantics a WAL wants, because the phase a torn
+entry was recording never completed its durability point.
+
+Crash-consistency of the journal itself: every append is flushed and
+fsync'd before the phase is considered recorded, and the journal
+DIRECTORY is fsync'd when the file is first created (the file's existence
+lives in the directory's blocks — same discipline as
+``core/checkpoint.save``).
+
+The fencing ``epoch`` is allocated per-migration (max existing + 1 within
+the journal directory) and stamped on every ``SETSLOT``/``MIGRATESLOTS``
+the coordinator issues; servers reject lower epochs (``STALEEPOCH``, see
+``TpuServer.fence_slot_epoch``), so a stale coordinator resuming after a
+newer migration touched the slot cannot clobber it, while a legitimate
+resume (same epoch) re-issues idempotently.
+
+Chaos-engineering lineage: deterministic fault schedules + write-ahead
+journaling for multi-step topology operations are the two PAPERS.md lines
+this subsystem implements (crash-consistency via WAL; fault injection as a
+seeded program).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+from redisson_tpu.utils.durability import fsync_dir as _fsync_dir
+
+PHASES = (
+    "PLANNED",
+    "WINDOW_OPEN",
+    "DRAINING",
+    "VIEW_COMMITTED",
+    "STABLE",
+    "ROLLED_BACK",
+)
+TERMINAL_PHASES = frozenset({"STABLE", "ROLLED_BACK"})
+
+
+class MigrationJournal:
+    """One migration's write-ahead journal (append-only, fsync'd)."""
+
+    SUFFIX = ".journal"
+
+    def __init__(self, path: str, entries: Optional[List[Dict[str, Any]]] = None,
+                 intact_bytes: Optional[int] = None):
+        self.path = path
+        self.entries: List[Dict[str, Any]] = entries if entries is not None else []
+        # byte length of the intact line prefix (set by open()): append()
+        # truncates any torn tail back to this boundary before writing, so
+        # a new entry never concatenates onto a half-written line
+        self._intact_bytes = intact_bytes
+
+    # -- identity ------------------------------------------------------------
+
+    @property
+    def migration_id(self) -> str:
+        name = os.path.basename(self.path)
+        return name[: -len(self.SUFFIX)] if name.endswith(self.SUFFIX) else name
+
+    @property
+    def epoch(self) -> int:
+        for e in self.entries:
+            if "epoch" in e:
+                return int(e["epoch"])
+        # pre-PLANNED journal (crash before the first append): the filename
+        # carries the allocated epoch so the slot is never re-fenced lower
+        try:
+            return int(self.migration_id.split("-")[1])
+        except (IndexError, ValueError):
+            return 0
+
+    @property
+    def phase(self) -> Optional[str]:
+        return self.entries[-1]["phase"] if self.entries else None
+
+    def is_terminal(self) -> bool:
+        return self.phase in TERMINAL_PHASES
+
+    def entry(self, phase: str) -> Optional[Dict[str, Any]]:
+        """First entry recorded for `phase` (PLANNED is the canonical one)."""
+        for e in self.entries:
+            if e["phase"] == phase:
+                return e
+        return None
+
+    def latest(self, key: str, default=None):
+        """Newest entry value for `key` (e.g. cumulative ``moved``)."""
+        for e in reversed(self.entries):
+            if key in e:
+                return e[key]
+        return default
+
+    # -- write path ----------------------------------------------------------
+
+    def append(self, phase: str, **data) -> Dict[str, Any]:
+        """Record one phase entry durably: the entry is on disk (file
+        fsync'd; directory too on creation) before this returns — the
+        write-AHEAD property callers rely on."""
+        if phase not in PHASES:
+            raise ValueError(f"unknown journal phase {phase!r}; one of {PHASES}")
+        entry: Dict[str, Any] = {"phase": phase, "ts": time.time(), **data}
+        payload = json.dumps(entry, sort_keys=True, separators=(",", ":"))
+        line = (
+            payload + "|" + format(zlib.crc32(payload.encode()) & 0xFFFFFFFF, "08x")
+            + "\n"
+        )
+        parent = os.path.dirname(os.path.abspath(self.path))
+        created = not os.path.exists(self.path)
+        if created:
+            with open(self.path, "ab") as f:
+                f.write(line.encode())
+                f.flush()
+                os.fsync(f.fileno())
+            self._intact_bytes = len(line.encode())
+        else:
+            # a crash mid-append may have left a torn tail line: truncate
+            # back to the intact prefix FIRST, or the new entry would
+            # concatenate onto the partial line and corrupt both
+            end = (
+                self._intact_bytes if self._intact_bytes is not None
+                else os.path.getsize(self.path)
+            )
+            with open(self.path, "r+b") as f:
+                f.truncate(end)
+                f.seek(end)
+                f.write(line.encode())
+                f.flush()
+                os.fsync(f.fileno())
+            self._intact_bytes = end + len(line.encode())
+        if created:
+            _fsync_dir(parent)
+        self.entries.append(entry)
+        return entry
+
+    # -- read path -----------------------------------------------------------
+
+    @classmethod
+    def open(cls, path: str) -> "MigrationJournal":
+        """Parse a journal, keeping only the intact prefix: the first
+        torn/corrupt line (crash mid-append) and everything after it is
+        dropped — that phase never reached its durability point."""
+        entries: List[Dict[str, Any]] = []
+        with open(path, "rb") as f:
+            raw = f.read()
+        intact = 0
+        for line in raw.split(b"\n"):
+            if not line:
+                continue
+            payload, sep, crc = line.rpartition(b"|")
+            if not sep:
+                break
+            try:
+                if int(crc, 16) != zlib.crc32(payload) & 0xFFFFFFFF:
+                    break
+                entries.append(json.loads(payload.decode()))
+            except (ValueError, UnicodeDecodeError):
+                break
+            intact += len(line) + 1  # the writer always terminates with \n
+        return cls(path, entries, intact_bytes=intact)
+
+    @classmethod
+    def create(cls, journal_dir: str, source: str, target: str) -> "MigrationJournal":
+        """Allocate a journal (and its fencing epoch) for a NEW migration.
+        The epoch is one past the highest epoch any journal in the
+        directory ever used, so it is monotonic across completed, rolled
+        back, AND in-flight migrations."""
+        os.makedirs(journal_dir, exist_ok=True)
+        epoch = 1 + max((j.epoch for j in cls.scan(journal_dir)), default=0)
+        mid = f"mig-{epoch:08d}-{os.getpid()}"
+        return cls(os.path.join(journal_dir, mid + cls.SUFFIX))
+
+    @classmethod
+    def scan(cls, journal_dir: str) -> List["MigrationJournal"]:
+        """Every journal in the directory, oldest epoch first."""
+        if not os.path.isdir(journal_dir):
+            return []
+        out = [
+            cls.open(os.path.join(journal_dir, fn))
+            for fn in sorted(os.listdir(journal_dir))
+            if fn.endswith(cls.SUFFIX)
+        ]
+        out.sort(key=lambda j: j.epoch)
+        return out
+
+    @classmethod
+    def in_flight(cls, journal_dir: str) -> List["MigrationJournal"]:
+        """Non-terminal journals — what ``resume_migrations`` must settle.
+        Includes journals whose ONLY line was torn (crash mid-first-append:
+        zero intact entries) — nothing ran, but the file must still be
+        terminalized so it stops reading as in-flight."""
+        return [j for j in cls.scan(journal_dir) if not j.is_terminal()]

@@ -189,6 +189,14 @@ class TpuServer:
         #   importing_slots: slot -> source "host:port" (this node receives)
         self.migrating_slots: Dict[int, str] = {}
         self.importing_slots: Dict[int, str] = {}
+        # per-slot migration fencing (ISSUE 4 journaled migrations): the
+        # highest EPOCH this node accepted for each slot's SETSLOT/
+        # MIGRATESLOTS traffic.  A resumed coordinator re-issues its
+        # journaled epoch (== accepted: idempotent redo), while a STALE
+        # coordinator resuming after a NEWER migration touched the slot
+        # carries a lower epoch and is rejected (STALEEPOCH) — the fencing
+        # that makes journal replay safe under coordinator races.
+        self.slot_epochs: Dict[int, int] = {}
         # -- cluster / replication role (server/replication.py) -------------
         self.role = "master"  # "master" | "replica"
         self.master_address: Optional[str] = None
@@ -370,6 +378,20 @@ class TpuServer:
         target = self.migrating_slots.get(slot)
         if target is not None:
             raise RespError(f"ASK {slot} {target}")
+
+    def fence_slot_epoch(self, slot: int, epoch: Optional[int]) -> None:
+        """Accept-or-reject a migration-control command's fencing epoch for
+        one slot.  Epoch-less commands (legacy callers, manual admin) pass
+        unfenced; an epoch below the highest accepted one is a stale
+        coordinator's late write and is refused loudly."""
+        if epoch is None:
+            return
+        cur = self.slot_epochs.get(slot, 0)
+        if epoch < cur:
+            raise RespError(
+                f"STALEEPOCH slot {slot} fenced at epoch {cur}; got {epoch}"
+            )
+        self.slot_epochs[slot] = epoch
 
     def set_slot_migrating(self, slot: int, target: str) -> None:
         self.migrating_slots[slot] = target
@@ -1000,11 +1022,20 @@ def main(argv=None):
         checkpoint.load(engine, args.checkpoint)
     if args.prewarm:
         engine.prewarm()
+    checkpointer = None
     if args.checkpoint and args.checkpoint_interval > 0:
         from redisson_tpu.core.checkpoint import AutoCheckpointer
 
-        AutoCheckpointer(engine, args.checkpoint, args.checkpoint_interval).start()
-    asyncio.run(srv.serve_forever())
+        checkpointer = AutoCheckpointer(
+            engine, args.checkpoint, args.checkpoint_interval
+        ).start()
+    try:
+        asyncio.run(srv.serve_forever())
+    finally:
+        if checkpointer is not None:
+            # flush-on-stop: writes since the last interval tick reach disk
+            # even on Ctrl-C / SIGTERM-driven exit
+            checkpointer.stop()
 
 
 if __name__ == "__main__":

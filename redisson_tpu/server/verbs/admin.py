@@ -99,14 +99,24 @@ def cmd_cluster(server, ctx, args):
     # -- live slot migration (MIGRATING/IMPORTING window + drain) ------------
     if sub == b"SETSLOT":
         # SETSLOT <slot> MIGRATING <host:port> | IMPORTING <host:port> |
-        #         STABLE | NODE <host:port> <node_id>
+        #         STABLE | NODE <host:port> <node_id>   [EPOCH <n>]
+        # EPOCH is the journaled coordinator's per-migration fencing token
+        # (server.fence_slot_epoch): re-issue with the SAME epoch is the
+        # idempotent resume path; a LOWER epoch is a stale coordinator and
+        # replies STALEEPOCH before any state changes.
         slot = _int(args[1])
         mode = bytes(args[2]).upper()
+        rest = list(args[3:])
+        epoch = None
+        if len(rest) >= 2 and bytes(rest[-2]).upper() == b"EPOCH":
+            epoch = _int(rest[-1])
+            rest = rest[:-2]
+        server.fence_slot_epoch(slot, epoch)
         if mode == b"MIGRATING":
-            server.set_slot_migrating(slot, _s(args[3]))
+            server.set_slot_migrating(slot, _s(rest[0]))
             return "+OK"
         if mode == b"IMPORTING":
-            server.set_slot_importing(slot, _s(args[3]))
+            server.set_slot_importing(slot, _s(rest[0]))
             return "+OK"
         if mode == b"STABLE":
             server.set_slot_stable(slot)
@@ -116,7 +126,7 @@ def cmd_cluster(server, ctx, args):
             # node's view and clear the window state (the orchestrator also
             # pushes a full SETVIEW; NODE keeps single-node finalization
             # correct even before that lands)
-            addr, nid = _s(args[3]), _s(args[4])
+            addr, nid = _s(rest[0]), _s(rest[1])
             host, port = addr.rsplit(":", 1)
             new_view = []
             for lo, hi, h, p, vnid in server.cluster_view:
@@ -144,10 +154,19 @@ def cmd_cluster(server, ctx, args):
         limit = _int(args[2]) if len(args) > 2 else 0
         return server.migrate_slot_batch(_int(args[1]), limit)
     if sub == b"MIGRATESLOTS":
-        # drain MANY migrating slots in one store scan — the orchestrator's
-        # bulk form (a reshard of hundreds of slots must not pay a full
-        # keyspace scan per slot)
-        return server.migrate_slot_batch([_int(a) for a in args[1:]])
+        # MIGRATESLOTS [EPOCH <n>] <slot>... — drain MANY migrating slots
+        # in one store scan (the orchestrator's bulk form: a reshard of
+        # hundreds of slots must not pay a full keyspace scan per slot).
+        # EPOCH fences every named slot like SETSLOT EPOCH does.
+        rest = list(args[1:])
+        epoch = None
+        if rest and bytes(rest[0]).upper() == b"EPOCH":
+            epoch = _int(rest[1])
+            rest = rest[2:]
+        slots = [_int(a) for a in rest]
+        for s in slots:
+            server.fence_slot_epoch(s, epoch)
+        return server.migrate_slot_batch(slots)
     raise RespError("ERR unknown CLUSTER subcommand")
 
 

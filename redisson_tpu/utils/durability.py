@@ -1,0 +1,20 @@
+"""Shared durability primitives for the persistence planes.
+
+Both crash-safety substrates — checkpoint snapshots (``core/checkpoint``)
+and migration journals (``server/migration_journal``) — need the same
+POSIX discipline: after ``os.replace``/file creation, the RENAME ITSELF
+lives in the parent directory's data blocks, so only an fsync of the
+directory makes it durable across power loss.
+"""
+from __future__ import annotations
+
+import os
+
+
+def fsync_dir(dirpath: str) -> None:
+    """fsync a directory so a just-completed rename/creation is durable."""
+    fd = os.open(dirpath or ".", os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)

@@ -152,6 +152,209 @@ def test_auto_checkpointer(tmp_path, client):
         fresh.shutdown()
 
 
+class TestCrashConsistency:
+    """ISSUE 4: durability generations + CRC trailer + storage fault
+    stream.  A torn/ENOSPC snapshot must never prevent loading the last
+    good generation, and the corruption must be VISIBLE (STATS + census)."""
+
+    def _faulted_plane(self, *rules):
+        from redisson_tpu.chaos.faults import FaultPlane, FaultSchedule
+
+        sched = FaultSchedule(0)
+        for kind, kw in rules:
+            sched.add(kind, **kw)
+        return FaultPlane(sched)
+
+    def test_trailer_written_and_verified(self, tmp_path, client):
+        client.get_bucket("cc:k").set("v")
+        path = str(tmp_path / "s.ckpt")
+        checkpoint.save(client.engine, path)
+        data = open(path, "rb").read()
+        assert data.startswith(checkpoint.MAGIC)
+        assert checkpoint.TRAILER_MAGIC in data[-12:]
+        payload = checkpoint.read_verified(path)
+        assert payload["format"] == checkpoint.FORMAT
+
+    def test_generations_rotate(self, tmp_path, client):
+        path = str(tmp_path / "s.ckpt")
+        for i in range(4):
+            client.get_bucket("cc:gen").set(f"v{i}")
+            checkpoint.save(client.engine, path, keep=3)
+        import os
+
+        assert os.path.exists(path + ".1") and os.path.exists(path + ".2")
+        assert not os.path.exists(path + ".3")  # oldest fell off the end
+        # every surviving generation verifies structurally
+        for p in (path, path + ".1", path + ".2"):
+            checkpoint.read_verified(p)
+
+    def test_torn_write_falls_back_to_previous_generation(self, tmp_path, client):
+        client.get_bucket("cc:torn").set("good")
+        path = str(tmp_path / "s.ckpt")
+        n_good = checkpoint.save(client.engine, path)
+        plane = self._faulted_plane(("torn_write", dict(after=0, count=1)))
+        with plane.active():
+            checkpoint.save(client.engine, path)  # media lied: head is torn
+        assert plane.injected == {"torn_write": 1}
+        with pytest.raises(checkpoint.CheckpointCorruptError):
+            checkpoint.read_verified(path)  # the head IS corrupt...
+        from redisson_tpu.client.redisson import RedissonTpu
+
+        before = dict(checkpoint.STATS)
+        fresh = RedissonTpu.create()
+        try:
+            # ...but load() serves the previous generation, loudly counted
+            assert checkpoint.load(fresh.engine, path) == n_good
+            assert fresh.get_bucket("cc:torn").get() == "good"
+        finally:
+            fresh.shutdown()
+        assert checkpoint.STATS["corrupt_generations"] > before["corrupt_generations"]
+        assert checkpoint.STATS["generation_fallbacks"] > before["generation_fallbacks"]
+
+    def test_torn_write_at_explicit_byte(self, tmp_path, client):
+        client.get_bucket("cc:tornk").set("v")
+        path = str(tmp_path / "s.ckpt")
+        checkpoint.save(client.engine, path)
+        plane = self._faulted_plane(("torn_write", dict(after=0, count=1,
+                                                        torn_at=16)))
+        with plane.active():
+            checkpoint.save(client.engine, path)
+        import os
+
+        assert os.path.getsize(path) == 16
+
+    def test_enospc_fails_loudly_and_preserves_lineage(self, tmp_path, client):
+        client.get_bucket("cc:enospc").set("kept")
+        path = str(tmp_path / "s.ckpt")
+        n = checkpoint.save(client.engine, path)
+        plane = self._faulted_plane(("enospc", dict(after=0, count=1)))
+        with plane.active():
+            with pytest.raises(OSError, match="No space left"):
+                checkpoint.save(client.engine, path)
+        # the failed save touched NOTHING: head still the good snapshot
+        assert checkpoint.read_verified(path)["format"] == checkpoint.FORMAT
+        from redisson_tpu.client.redisson import RedissonTpu
+
+        fresh = RedissonTpu.create()
+        try:
+            assert checkpoint.load(fresh.engine, path) == n
+        finally:
+            fresh.shutdown()
+
+    def test_fsync_failure_fails_the_save(self, tmp_path, client):
+        client.get_bucket("cc:fsync").set("v")
+        path = str(tmp_path / "s.ckpt")
+        checkpoint.save(client.engine, path)
+        head = open(path, "rb").read()
+        plane = self._faulted_plane(("fsync_fail", dict(after=0, count=1)))
+        with plane.active():
+            with pytest.raises(OSError, match="fsync failed"):
+                checkpoint.save(client.engine, path)
+        assert open(path, "rb").read() == head  # head untouched
+
+    def test_missing_head_falls_back_to_generation(self, tmp_path, client):
+        """save()'s crash window between the rotation rename and the head
+        install leaves NO head file but an intact .1 — load must serve it."""
+        import os
+
+        client.get_bucket("cc:nohead").set("kept")
+        path = str(tmp_path / "s.ckpt")
+        n = checkpoint.save(client.engine, path)
+        checkpoint.save(client.engine, path)  # rotates the first save to .1
+        os.unlink(path)                       # simulate the crash window
+        from redisson_tpu.client.redisson import RedissonTpu
+
+        fresh = RedissonTpu.create()
+        try:
+            assert checkpoint.load(fresh.engine, path) == n
+            assert fresh.get_bucket("cc:nohead").get() == "kept"
+        finally:
+            fresh.shutdown()
+        # a checkpoint that never existed still raises FileNotFoundError
+        with pytest.raises(FileNotFoundError):
+            checkpoint.load(client.engine, str(tmp_path / "never.ckpt"))
+
+    def test_all_generations_corrupt_raises(self, tmp_path, client):
+        client.get_bucket("cc:all").set("v")
+        path = str(tmp_path / "s.ckpt")
+        checkpoint.save(client.engine, path)
+        checkpoint.save(client.engine, path)
+        import os
+
+        for p in (path, path + ".1"):
+            with open(p, "r+b") as f:
+                f.truncate(os.path.getsize(p) // 2)
+        with pytest.raises(checkpoint.CheckpointCorruptError):
+            checkpoint.load(client.engine, path)
+
+    def test_truncated_payload_is_corrupt_not_pickle_traceback(self, tmp_path, client):
+        """Satellite: a truncated file must raise CheckpointCorruptError,
+        never a raw pickle/EOF traceback."""
+        client.get_bucket("cc:trunc").set("v")
+        path = str(tmp_path / "s.ckpt")
+        checkpoint.save(client.engine, path)
+        import os
+
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 5)  # CRC trailer gone
+        with pytest.raises(checkpoint.CheckpointCorruptError, match="trailer"):
+            checkpoint.read_verified(path)
+
+    def test_census_records_corruption(self, tmp_path, client):
+        from redisson_tpu.chaos.census import ResourceCensus
+
+        census = ResourceCensus()
+        census.track_checkpoints("ckpt")
+        before = census.snapshot()
+        client.get_bucket("cc:census").set("v")
+        path = str(tmp_path / "s.ckpt")
+        checkpoint.save(client.engine, path)
+        plane = self._faulted_plane(("torn_write", dict(after=0, count=1)))
+        with plane.active():
+            checkpoint.save(client.engine, path)
+        from redisson_tpu.client.redisson import RedissonTpu
+
+        fresh = RedissonTpu.create()
+        try:
+            checkpoint.load(fresh.engine, path)
+        finally:
+            fresh.shutdown()
+        after = census.snapshot()
+        moved = census.diff(before, after)
+        assert "ckpt.corrupt_generations" in moved
+        assert "ckpt.generation_fallbacks" in moved
+
+    def test_autocheckpointer_stop_flushes_and_reports_join(self, tmp_path, client):
+        """Satellite: stop() takes a final snapshot (flush-on-stop) and
+        reports whether the thread actually joined."""
+        import os
+
+        client.get_bucket("cc:stop").set("final")
+        path = str(tmp_path / "auto.ckpt")
+        # interval far in the future: ONLY the flush-on-stop can write it
+        ac = checkpoint.AutoCheckpointer(client.engine, path, interval=3600.0)
+        ac.start()
+        assert ac.stop() is True
+        assert os.path.exists(path), "flush-on-stop snapshot missing"
+        from redisson_tpu.client.redisson import RedissonTpu
+
+        fresh = RedissonTpu.create()
+        try:
+            checkpoint.load(fresh.engine, path)
+            assert fresh.get_bucket("cc:stop").get() == "final"
+        finally:
+            fresh.shutdown()
+
+    def test_autocheckpointer_stop_no_flush(self, tmp_path, client):
+        import os
+
+        path = str(tmp_path / "auto.ckpt")
+        ac = checkpoint.AutoCheckpointer(client.engine, path, interval=3600.0)
+        ac.start()
+        assert ac.stop(flush=False) is True
+        assert not os.path.exists(path)
+
+
 class TestDumpRestoreDepth:
     """RObject.dump/restore + the SAVE/RESTORESTATE wire surface depth
     (round-4: §5.4 checkpoint subsystem hardening)."""

@@ -247,6 +247,187 @@ def test_coordinator_probe_threads_exempt_by_default(server):
         nc.close()
 
 
+# -- DCN-level (host-group) partitions ----------------------------------------
+
+def test_dcn_partition_counts_on_group_stream():
+    """A host-GROUP rule indexes the group's combined event stream: the
+    faulted window covers the first N sends to EITHER node, regardless of
+    how traffic interleaves — the DCN-uplink failure a per-port rule
+    cannot express."""
+    a = ServerThread(port=0).start()
+    b = ServerThread(port=0).start()
+    try:
+        group = (a.port, b.port)
+        sched = FaultSchedule(0)
+        rule = sched.add_dcn_partition(group, direction="out", after=0, count=2)
+        plane = sched.plane()
+        nca = _client(a)
+        ncb = _client(b)
+        try:
+            with plane.active():
+                # first two sends into the group are swallowed — one per node
+                with pytest.raises(CommandTimeoutError):
+                    nca.execute("PING", timeout=0.4, retry_attempts=0)
+                with pytest.raises(CommandTimeoutError):
+                    ncb.execute("PING", timeout=0.4, retry_attempts=0)
+                # window exhausted: BOTH nodes serve again
+                assert nca.execute("PING") in (b"PONG", "PONG")
+                assert ncb.execute("PING") in (b"PONG", "PONG")
+            assert rule.hits == 2
+            assert plane.injected == {"partition_out": 2}
+        finally:
+            nca.close()
+            ncb.close()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_dcn_partition_leaves_other_hosts_alone(server):
+    """A group rule must not touch traffic to nodes OUTSIDE the group."""
+    sched = FaultSchedule(0)
+    sched.add_dcn_partition((server.port + 1, server.port + 2), after=0, count=50)
+    plane = sched.plane()
+    nc = _client(server)
+    try:
+        with plane.active():
+            assert nc.execute("PING") in (b"PONG", "PONG")
+        assert plane.injected == {}
+    finally:
+        nc.close()
+
+
+def test_dcn_partition_validation():
+    sched = FaultSchedule(0)
+    with pytest.raises(ValueError, match="direction"):
+        sched.add_dcn_partition((1, 2), direction="sideways")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        Fault("partition_out", port=1, ports=(1, 2))
+
+
+# -- storage fault stream (checkpoint plane; depth in test_checkpoint.py) -----
+
+def test_storage_faults_count_on_their_own_streams():
+    from redisson_tpu.chaos.faults import FaultPlane
+
+    sched = FaultSchedule(0)
+    sched.add("enospc", after=1, count=1)
+    sched.add("fsync_fail", after=0, count=1)
+    plane = FaultPlane(sched)
+    # event 0 on storage_write passes; event 1 raises
+    assert plane.on_storage_write("/x", b"abcd") == b"abcd"
+    with pytest.raises(OSError):
+        plane.on_storage_write("/x", b"abcd")
+    with pytest.raises(OSError):
+        plane.on_storage_fsync("/x")
+    assert plane.events("storage_write") == 2
+    assert plane.events("storage_fsync") == 1
+    assert plane.injected == {"enospc": 1, "fsync_fail": 1}
+
+
+def test_torn_write_truncates_at_fraction_and_byte():
+    from redisson_tpu.chaos.faults import FaultPlane
+
+    sched = FaultSchedule(0)
+    sched.add("torn_write", after=0, count=1, torn_frac=0.25)
+    sched.add("torn_write", after=1, count=1, torn_at=3)
+    plane = FaultPlane(sched)
+    assert plane.on_storage_write("/x", b"x" * 100) == b"x" * 25
+    assert plane.on_storage_write("/x", b"abcdef") == b"abc"
+    assert plane.on_storage_write("/x", b"abcdef") == b"abcdef"  # window over
+
+
+# -- RetryPolicy (net/retry.py) -----------------------------------------------
+
+def test_retry_policy_backoff_is_seed_deterministic_and_bounded():
+    from redisson_tpu.net.retry import RetryPolicy
+
+    a = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=1.0, seed=7)
+    b = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=1.0, seed=7)
+    da = [a.backoff(i) for i in range(6)]
+    db = [b.backoff(i) for i in range(6)]
+    assert da == db  # same seed -> byte-identical sleep program
+    for i, d in enumerate(da):
+        assert 0.0 <= d <= 1.0 * 1.2 + 1e-9  # max_delay * (1 + jitter)
+    c = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=1.0, seed=8)
+    assert [c.backoff(i) for i in range(6)] != da
+
+
+def test_retry_policy_deadline_propagates_into_sleep_and_timeouts():
+    from redisson_tpu.net.retry import DeadlineExceeded, RetryPolicy
+
+    clock = RetryPolicy(max_attempts=10, base_delay=5.0, deadline_s=0.05,
+                        jitter=0.0).start()
+    # per-attempt timeout is clamped to the remaining budget
+    assert clock.attempt_timeout(30.0) <= 0.05
+    clock.attempt = 1
+    t0 = time.monotonic()
+    try:
+        clock.sleep()  # 5s backoff truncated to the ~0.05s budget
+    except DeadlineExceeded:
+        pass
+    assert time.monotonic() - t0 < 1.0
+    time.sleep(0.06)
+    assert not clock.more_attempts()
+    with pytest.raises(DeadlineExceeded):
+        clock.sleep()
+
+
+def test_node_client_retry_policy_rides_the_detectors(server):
+    """The admin-plane satellite: a NodeClient on a RetryPolicy absorbs a
+    drop via backoff AND still feeds the failure detector — control
+    traffic rides the same machinery as data traffic."""
+    from redisson_tpu.net.retry import RetryPolicy
+
+    det = FailedCommandsDetector(threshold=1, window_s=60.0)
+    nc = NodeClient(
+        f"127.0.0.1:{server.port}", ping_interval=0, timeout=2.0,
+        connect_timeout=5.0, detector=det,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay=0.02,
+                                 max_delay=0.1, deadline_s=10.0),
+    )
+    sched = FaultSchedule(0)
+    sched.add("drop", port=server.port, after=0, count=1)
+    plane = sched.plane()
+    try:
+        with plane.active():
+            assert nc.execute("PING") in (b"PONG", "PONG")  # retry recovers
+        assert plane.injected == {"drop": 1}
+        assert det.is_node_failed()  # the drop was COUNTED, not bypassed
+        # an explicit per-call retry_attempts still overrides the policy
+        sched2 = FaultSchedule(0)
+        sched2.add("drop", port=server.port, after=0, count=10)
+        with sched2.plane().active():
+            with pytest.raises((ConnectionError_, OSError)):
+                nc.execute("PING", retry_attempts=0)
+    finally:
+        nc.close()
+
+
+def test_node_client_retry_policy_deadline_bounds_total_time(server):
+    from redisson_tpu.net.retry import RetryPolicy
+
+    nc = NodeClient(
+        f"127.0.0.1:{server.port}", ping_interval=0, timeout=2.0,
+        connect_timeout=5.0,
+        retry_policy=RetryPolicy(max_attempts=50, base_delay=0.5,
+                                 max_delay=2.0, deadline_s=0.8, jitter=0.0),
+    )
+    sched = FaultSchedule(0)
+    sched.add("drop", port=server.port, after=0, count=1000)
+    plane = sched.plane()
+    try:
+        with plane.active():
+            t0 = time.monotonic()
+            with pytest.raises((ConnectionError_, OSError)):
+                nc.execute("PING")
+            # 50 attempts x 0.5s+ backoff would be ~25s; the deadline
+            # cuts the whole operation to ~its budget
+            assert time.monotonic() - t0 < 5.0
+    finally:
+        nc.close()
+
+
 # -- census ------------------------------------------------------------------
 
 def test_census_snapshot_diff_and_gauges(server):

@@ -1,0 +1,276 @@
+"""Journaled resumable slot migration (ISSUE 4 tentpole): write-ahead
+journal mechanics, kill-the-coordinator-at-every-phase resume property,
+fencing epochs, and the rollback-must-not-mask-the-original-error
+satellite.
+
+The acceptance property lives in ``test_kill_coordinator_at_every_phase``:
+for EVERY journal phase, killing the coordinator right after that phase's
+entry and calling ``resume_migrations()`` ends with all slots STABLE on
+exactly one owner, the record readable at its exact value, and the journal
+terminal.
+"""
+import os
+
+import pytest
+
+from redisson_tpu.harness import ClusterRunner, _exec
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server import migration as mig
+from redisson_tpu.server.migration import (
+    CoordinatorKilled,
+    migrate_slots,
+    resume_migrations,
+)
+from redisson_tpu.server.migration_journal import MigrationJournal
+from redisson_tpu.utils.crc16 import calc_slot
+
+
+# -- journal file mechanics ---------------------------------------------------
+
+def test_journal_append_open_roundtrip(tmp_path):
+    j = MigrationJournal.create(str(tmp_path), "a:1", "b:2")
+    j.append("PLANNED", source="a:1", target="b:2", slots=[5], epoch=j.epoch,
+             old_view=[[0, 10, "h", 1, "n"]], new_view=[[0, 10, "h", 2, "m"]])
+    j.append("WINDOW_OPEN")
+    j.append("DRAINING", moved=3, sweep=1, batch=3)
+    j.append("DRAINING", moved=3, sweep=2, batch=0)
+    back = MigrationJournal.open(j.path)
+    assert [e["phase"] for e in back.entries] == [
+        "PLANNED", "WINDOW_OPEN", "DRAINING", "DRAINING",
+    ]
+    assert back.phase == "DRAINING"
+    assert back.latest("moved") == 3
+    assert back.entry("PLANNED")["slots"] == [5]
+    assert not back.is_terminal()
+    back.append("STABLE", moved=3)
+    assert MigrationJournal.open(j.path).is_terminal()
+
+
+def test_journal_torn_tail_line_dropped(tmp_path):
+    j = MigrationJournal.create(str(tmp_path), "a:1", "b:2")
+    j.append("PLANNED", source="a:1", target="b:2", slots=[1], epoch=j.epoch,
+             old_view=[], new_view=[])
+    j.append("WINDOW_OPEN")
+    # simulate a crash mid-append: the last line is half-written
+    raw = open(j.path, "rb").read()
+    with open(j.path, "wb") as f:
+        f.write(raw[: len(raw) - 7])
+    back = MigrationJournal.open(j.path)
+    assert [e["phase"] for e in back.entries] == ["PLANNED"]
+    # a corrupt line also invalidates everything after it (WAL prefix rule)
+    with open(j.path, "wb") as f:
+        f.write(raw.split(b"\n")[0] + b"XX\n" + raw.split(b"\n")[1] + b"\n")
+    assert MigrationJournal.open(j.path).entries == []
+
+
+def test_journal_epoch_allocation_is_monotonic(tmp_path):
+    a = MigrationJournal.create(str(tmp_path), "a:1", "b:2")
+    a.append("PLANNED", epoch=a.epoch, source="a:1", target="b:2", slots=[1],
+             old_view=[], new_view=[])
+    a.append("STABLE")
+    b = MigrationJournal.create(str(tmp_path), "a:1", "b:2")
+    assert b.epoch == a.epoch + 1
+    # terminal journals still hold their epoch: a third allocation sees both
+    b.append("PLANNED", epoch=b.epoch, source="a:1", target="b:2", slots=[1],
+             old_view=[], new_view=[])
+    c = MigrationJournal.create(str(tmp_path), "a:1", "b:2")
+    assert c.epoch == b.epoch + 1
+
+
+def test_journal_rejects_unknown_phase(tmp_path):
+    j = MigrationJournal.create(str(tmp_path), "a:1", "b:2")
+    with pytest.raises(ValueError, match="unknown journal phase"):
+        j.append("EXPLODED")
+
+
+def test_resume_on_empty_or_missing_dir(tmp_path):
+    assert resume_migrations(str(tmp_path / "nope")) == []
+    assert resume_migrations(str(tmp_path)) == []
+
+
+def test_resume_terminalizes_torn_first_line_journal(tmp_path):
+    """A crash mid-append of the very FIRST entry leaves a journal with
+    zero intact lines: nothing ever ran, but resume must terminalize it so
+    it stops reading as in-flight."""
+    j = MigrationJournal.create(str(tmp_path), "a:1", "b:2")
+    with open(j.path, "wb") as f:
+        f.write(b'{"phase":"PLANNED"')  # torn: no CRC separator, no newline
+    assert [x.migration_id for x in MigrationJournal.in_flight(str(tmp_path))]
+    results = resume_migrations(str(tmp_path))
+    assert [r["action"] for r in results] == ["rolled_back"]
+    assert MigrationJournal.in_flight(str(tmp_path)) == []
+
+
+# -- the kill-the-coordinator property ---------------------------------------
+
+@pytest.fixture()
+def cluster2():
+    runner = ClusterRunner(masters=2).run()
+    yield runner
+    runner.shutdown()
+
+
+def _owner_index(runner, slot: int) -> int:
+    return next(
+        i for i, (lo, hi) in enumerate(runner.slot_ranges) if lo <= slot <= hi
+    )
+
+
+def test_kill_coordinator_at_every_phase(cluster2, tmp_path):
+    """ISSUE 4 acceptance: for every journal phase, kill after that phase,
+    resume, and end with all slots STABLE on exactly one owner, zero acked
+    loss, and the record's exact contents intact."""
+    client = cluster2.client(scan_interval=0)
+    jd = str(tmp_path / "journal")
+    try:
+        client.get_bucket("mig-key").set("payload")
+        slot = calc_slot(b"mig-key")
+        for phase, expect in [
+            ("PLANNED", "rolled_back"),
+            ("WINDOW_OPEN", "completed"),
+            ("DRAINING:1", "completed"),
+            ("VIEW_COMMITTED", "completed"),
+        ]:
+            owner = next(
+                m for m in cluster2.masters
+                if m.server.server.engine.store.exists("mig-key")
+            )
+            other = next(m for m in cluster2.masters if m is not owner)
+            with pytest.raises(CoordinatorKilled):
+                migrate_slots(owner.address, other.address, [slot],
+                              journal_dir=jd, crash_after=phase)
+            results = resume_migrations(jd)
+            assert [r["action"] for r in results] == [expect], (phase, results)
+            assert not MigrationJournal.in_flight(jd)
+            # window fully closed on both ends — no slot left non-STABLE
+            for node in cluster2.masters:
+                srv = node.server.server
+                assert not srv.migrating_slots, (phase, srv.migrating_slots)
+                assert not srv.importing_slots, (phase, srv.importing_slots)
+            # exactly one owner holds the record, value intact
+            holders = [
+                m for m in cluster2.masters
+                if m.server.server.engine.store.exists("mig-key")
+            ]
+            assert len(holders) == 1, phase
+            expected_holder = owner if expect == "rolled_back" else other
+            assert holders[0] is expected_holder, phase
+            client.refresh_topology()
+            assert client.get_bucket("mig-key").get() == "payload", phase
+    finally:
+        client.shutdown()
+
+
+def test_resume_is_idempotent(cluster2, tmp_path):
+    """A crash DURING resume (simulated by resuming twice) converges: the
+    second pass finds nothing in flight."""
+    client = cluster2.client(scan_interval=0)
+    jd = str(tmp_path / "journal")
+    try:
+        client.get_bucket("idem-key").set("v")
+        slot = calc_slot(b"idem-key")
+        si = _owner_index(cluster2, slot)
+        with pytest.raises(CoordinatorKilled):
+            migrate_slots(cluster2.masters[si].address,
+                          cluster2.masters[1 - si].address, [slot],
+                          journal_dir=jd, crash_after="WINDOW_OPEN")
+        first = resume_migrations(jd)
+        assert [r["action"] for r in first] == ["completed"]
+        assert resume_migrations(jd) == []  # nothing left in flight
+        client.refresh_topology()
+        assert client.get_bucket("idem-key").get() == "v"
+    finally:
+        client.shutdown()
+
+
+def test_journaled_migration_without_crash_records_stable(cluster2, tmp_path):
+    client = cluster2.client(scan_interval=0)
+    jd = str(tmp_path / "journal")
+    try:
+        client.get_bucket("jrn-key").set("v")
+        slot = calc_slot(b"jrn-key")
+        si = _owner_index(cluster2, slot)
+        moved = migrate_slots(cluster2.masters[si].address,
+                              cluster2.masters[1 - si].address, [slot],
+                              journal_dir=jd)
+        assert moved >= 1
+        journals = MigrationJournal.scan(jd)
+        assert len(journals) == 1
+        assert journals[0].phase == "STABLE"
+        phases = [e["phase"] for e in journals[0].entries]
+        assert phases[0] == "PLANNED" and "WINDOW_OPEN" in phases
+        assert "VIEW_COMMITTED" in phases and phases[-1] == "STABLE"
+        assert resume_migrations(jd) == []
+    finally:
+        client.shutdown()
+
+
+# -- fencing epochs -----------------------------------------------------------
+
+def test_stale_epoch_rejected_idempotent_epoch_accepted(cluster2):
+    node = cluster2.masters[0]
+    peer = cluster2.masters[1]
+    lo, _hi = cluster2.slot_ranges[0]
+    with node.server.client() as c:
+        _exec(c, "CLUSTER", "SETSLOT", lo, "MIGRATING", peer.address,
+              "EPOCH", 5)
+        # same epoch re-issue = the resume path: accepted
+        _exec(c, "CLUSTER", "SETSLOT", lo, "MIGRATING", peer.address,
+              "EPOCH", 5)
+        # a STALE coordinator (lower epoch) is fenced out
+        reply = c.execute("CLUSTER", "SETSLOT", lo, "STABLE", "EPOCH", 4)
+        assert isinstance(reply, RespError)
+        assert str(reply).startswith("STALEEPOCH")
+        # MIGRATESLOTS is fenced by the same per-slot epoch
+        reply = c.execute("CLUSTER", "MIGRATESLOTS", "EPOCH", 4, lo)
+        assert isinstance(reply, RespError)
+        assert str(reply).startswith("STALEEPOCH")
+        # a NEWER epoch supersedes and closes the window
+        _exec(c, "CLUSTER", "SETSLOT", lo, "STABLE", "EPOCH", 6)
+    assert not node.server.server.migrating_slots
+    # epoch-less legacy traffic stays unfenced (manual admin path)
+    with node.server.client() as c:
+        _exec(c, "CLUSTER", "SETSLOT", lo, "MIGRATING", peer.address)
+        _exec(c, "CLUSTER", "SETSLOT", lo, "STABLE")
+
+
+# -- rollback exception chaining (satellite) ----------------------------------
+
+def test_rollback_failure_does_not_mask_original_error(cluster2, monkeypatch):
+    """A `_rollback` that itself raises must surface the ORIGINAL failure
+    to the caller, with the rollback failure chained onto it."""
+    primary = RuntimeError("drain exploded")
+    rb_err = RuntimeError("rollback also exploded")
+
+    def boom_drain(self, moved=0):
+        raise primary
+
+    def boom_rollback(*a, **kw):
+        raise rb_err
+
+    monkeypatch.setattr(mig._MigrationRun, "_phase_drain", boom_drain)
+    monkeypatch.setattr(mig, "_rollback", boom_rollback)
+    slot = cluster2.slot_ranges[0][0]
+    with pytest.raises(RuntimeError) as exc:
+        migrate_slots(cluster2.masters[0].address,
+                      cluster2.masters[1].address, [slot])
+    assert exc.value is primary          # the FIRST failure reaches the caller
+    assert exc.value.__cause__ is rb_err  # the rollback failure rides along
+
+
+def test_rollback_success_reraises_original(cluster2, monkeypatch):
+    primary = RuntimeError("drain exploded")
+
+    def boom_drain(self, moved=0):
+        raise primary
+
+    monkeypatch.setattr(mig._MigrationRun, "_phase_drain", boom_drain)
+    slot = cluster2.slot_ranges[0][0]
+    with pytest.raises(RuntimeError) as exc:
+        migrate_slots(cluster2.masters[0].address,
+                      cluster2.masters[1].address, [slot])
+    assert exc.value is primary
+    # rollback really ran: no window left behind
+    for node in cluster2.masters:
+        srv = node.server.server
+        assert not srv.migrating_slots and not srv.importing_slots

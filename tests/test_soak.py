@@ -9,7 +9,12 @@ machinery itself cannot rot between slow-tier runs.
 import pytest
 
 from redisson_tpu.chaos.faults import FaultSchedule
-from redisson_tpu.chaos.soak import SoakConfig, SoakHarness
+from redisson_tpu.chaos.soak import (
+    MigrationSoakConfig,
+    MigrationSoakHarness,
+    SoakConfig,
+    SoakHarness,
+)
 
 
 def test_soak_workload_only_flat_census():
@@ -47,6 +52,43 @@ def test_soak_different_seed_still_converges():
     )).run()
     assert report.cycles_completed == 2
     assert report.lock_max_concurrency <= 1
+
+
+def test_migration_soak_single_kill_resume_smoke():
+    """Tier-1 smoke of the migration-under-fault profile: one coordinator
+    kill (mid-drain — the nastiest point) + resume under workload, with
+    the checkpoint storage chaos leg, in seconds."""
+    report = MigrationSoakHarness(MigrationSoakConfig(
+        cycles=1, crash_phases=("DRAINING:1",), keys=20, writer_threads=2,
+        seed=3,
+    )).run()
+    assert report.cycles_completed == 1
+    assert report.coordinator_kills == 1
+    assert report.resumed_completed == 1
+    assert report.acked_writes > 0 and report.verified_writes > 0
+    assert report.bloom_bits_verified > 0      # bit-identical device plane
+    assert report.checkpoint_fallbacks == 1    # torn head -> previous gen
+    assert len(report.census) == 1
+
+
+@pytest.mark.slow
+def test_migration_soak_kill_every_phase_two_cycles():
+    """The ISSUE 4 soak acceptance: the coordinator dies after EVERY
+    journal phase, twice over, while a mixed workload writes through the
+    moving slots and storage faults corrupt checkpoint heads — zero
+    acked-write loss, no slot left non-STABLE, bit-identical record
+    contents, flat census."""
+    report = MigrationSoakHarness(MigrationSoakConfig(
+        cycles=2, seed=0,
+    )).run()
+    assert report.cycles_completed == 2
+    assert report.coordinator_kills == 8       # 4 phases x 2 cycles
+    assert report.resumed_rolled_back == 2     # PLANNED-phase kills
+    assert report.resumed_completed == 6
+    assert report.verified_writes > 0
+    assert report.bloom_bits_verified > 0
+    assert report.checkpoint_fallbacks == 2
+    assert len(report.census) == 2
 
 
 @pytest.mark.slow

@@ -1,11 +1,22 @@
 #!/usr/bin/env python
-"""10-second soak for local sanity: one full chaos cycle (workload under
-injected transport faults -> master kill -> automatic failover -> recovery
--> mesh reshard 4 -> 8 -> 4) with the same zero-acked-write-loss and
-flat-census assertions the slow endurance tier enforces.
+"""10-second soak for local sanity, two profiles:
+
+  * ``standard`` (default) — one full chaos cycle (workload under injected
+    transport faults -> master kill -> automatic failover -> recovery ->
+    mesh reshard 4 -> 8 -> 4) with the same zero-acked-write-loss and
+    flat-census assertions the slow endurance tier enforces.
+  * ``migration`` — the crash-safe control-plane profile: a mixed write
+    stream over a slot range while the JOURNALED migration coordinator is
+    killed at every phase boundary (PLANNED, WINDOW_OPEN, mid-DRAINING,
+    VIEW_COMMITTED) and resumed via ``resume_migrations``, plus
+    torn-write/ENOSPC checkpoint chaos.  Asserts zero acked-write loss,
+    no slot left non-STABLE, bit-identical record contents, checkpoint
+    generation fallback, flat census.  One kill-resume cycle runs in well
+    under 60s.
 
 Usage:
-    JAX_PLATFORMS=cpu python tools/soak_smoke.py [--cycles N] [--seed S]
+    JAX_PLATFORMS=cpu python tools/soak_smoke.py [--profile standard|migration]
+                                                 [--cycles N] [--seed S]
                                                  [--phase SECONDS] [--no-kill]
 
 Exit code 0 = every assertion held; the report summary prints either way.
@@ -26,27 +37,37 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--profile", choices=("standard", "migration"),
+                    default="standard")
     ap.add_argument("--cycles", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--phase", type=float, default=1.0,
-                    help="seconds of workload per phase")
+                    help="seconds of workload per phase (standard profile)")
     ap.add_argument("--no-kill", action="store_true",
-                    help="workload + reshard only (no master kill)")
+                    help="standard profile: workload + reshard only")
     args = ap.parse_args()
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
-    from redisson_tpu.chaos.soak import SoakConfig, SoakHarness
+    if args.profile == "migration":
+        from redisson_tpu.chaos.soak import (
+            MigrationSoakConfig, MigrationSoakHarness,
+        )
 
-    cfg = SoakConfig(
-        cycles=args.cycles,
-        seconds_per_phase=args.phase,
-        seed=args.seed,
-        kill=not args.no_kill,
-    )
-    harness = SoakHarness(cfg)
+        harness = MigrationSoakHarness(MigrationSoakConfig(
+            cycles=args.cycles, seed=args.seed,
+        ))
+    else:
+        from redisson_tpu.chaos.soak import SoakConfig, SoakHarness
+
+        harness = SoakHarness(SoakConfig(
+            cycles=args.cycles,
+            seconds_per_phase=args.phase,
+            seed=args.seed,
+            kill=not args.no_kill,
+        ))
     try:
         report = harness.run()
     except AssertionError as e:
