@@ -31,14 +31,14 @@ class RtpuToken(ctypes.Structure):
     ]
 
 
-def _build() -> bool:
+def _build(dst: Optional[str] = None) -> bool:
     src = os.path.join(_NATIVE_DIR, "resp.cpp")
     if not os.path.exists(src):
         return False
     os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
     try:
         subprocess.run(
-            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", _SO_PATH, src],
+            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", dst or _SO_PATH, src],
             check=True,
             capture_output=True,
             timeout=120,
@@ -46,6 +46,66 @@ def _build() -> bool:
         return True
     except Exception:
         return False
+
+
+def _stale() -> bool:
+    """True when the checked-in/previously-built .so predates resp.cpp —
+    a stale artifact must never silently serve a diverged source."""
+    src = os.path.join(_NATIVE_DIR, "resp.cpp")
+    try:
+        return os.path.getmtime(_SO_PATH) < os.path.getmtime(src)
+    except OSError:
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare every entry point; raises AttributeError on a library built
+    from an older resp.cpp (missing symbols)."""
+    lib.rtpu_resp_scan.restype = ctypes.c_int64
+    lib.rtpu_resp_scan.argtypes = [
+        ctypes.POINTER(ctypes.c_char),
+        ctypes.c_uint64,
+        ctypes.POINTER(RtpuToken),
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rtpu_encode_reply.restype = ctypes.c_int64
+    lib.rtpu_encode_reply.argtypes = [
+        ctypes.c_void_p,  # int32* ops (op | marker<<8)
+        ctypes.c_void_p,  # int64* vals
+        ctypes.c_void_p,  # int64* offs
+        ctypes.c_uint64,
+        ctypes.c_void_p,  # byte pool
+        ctypes.c_void_p,  # output arena
+        ctypes.c_uint64,
+    ]
+    lib.rtpu_lz4_compress.restype = ctypes.c_int64
+    lib.rtpu_lz4_compress.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_char),
+        ctypes.c_uint64,
+    ]
+    lib.rtpu_lz4_decompress.restype = ctypes.c_int64
+    lib.rtpu_lz4_decompress.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_char),
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rtpu_crc16.restype = ctypes.c_uint16
+    lib.rtpu_crc16.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.rtpu_calc_slots.restype = None
+    lib.rtpu_calc_slots.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint16),
+    ]
+    return lib
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -59,30 +119,31 @@ def load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("RTPU_NO_NATIVE"):
             return None
-        if not os.path.exists(_SO_PATH) and not _build():
-            return None
+        if (not os.path.exists(_SO_PATH) or _stale()) and not _build():
+            if not os.path.exists(_SO_PATH):
+                return None
         try:
-            lib = ctypes.CDLL(_SO_PATH)
+            lib = _bind(ctypes.CDLL(_SO_PATH))
         except OSError:
             return None
-        lib.rtpu_resp_scan.restype = ctypes.c_int64
-        lib.rtpu_resp_scan.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_uint64,
-            ctypes.POINTER(RtpuToken),
-            ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint64),
-        ]
-        lib.rtpu_crc16.restype = ctypes.c_uint16
-        lib.rtpu_crc16.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
-        lib.rtpu_calc_slots.restype = None
-        lib.rtpu_calc_slots.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_uint16),
-        ]
+        except AttributeError:
+            # Artifact built from an older resp.cpp (mtimes lied, e.g. a git
+            # checkout stamping both files together): rebuild to a fresh
+            # path — re-dlopen()ing the original path could hand back the
+            # cached stale handle — then promote it to the canonical name.
+            tmp = f"{_SO_PATH}.{os.getpid()}"
+            try:
+                if not _build(tmp):
+                    return None
+                lib = _bind(ctypes.CDLL(tmp))
+                os.replace(tmp, _SO_PATH)
+            except (OSError, AttributeError):
+                return None
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
         _lib = lib
         return _lib

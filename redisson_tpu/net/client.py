@@ -219,7 +219,7 @@ class Connection:
         in order, before any other use of this connection."""
         if not commands:
             return 0
-        payload = b"".join(resp.encode_command(*c) for c in commands)
+        payload = resp.encode_commands(commands)
         try:
             plane = _fault_plane
             if plane is not None and not plane.on_send(self):

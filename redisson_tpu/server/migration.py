@@ -114,6 +114,7 @@ def resume_migrations(
     journal_dir: str,
     password: Optional[str] = None,
     ssl_context=None,
+    gc_keep: Optional[int] = 64,
 ) -> List[Dict[str, Any]]:
     """Settle every in-flight migration the journal directory records —
     the coordinator-restart path.  Idempotent: re-running it (even after
@@ -132,6 +133,11 @@ def resume_migrations(
     Returns one summary dict per journal touched; a migration whose nodes
     are unreachable is reported ``"failed"`` and left non-terminal for the
     next resume pass rather than aborting the others.
+
+    After settling, terminal journals older than the newest ``gc_keep`` are
+    pruned (``MigrationJournal.gc`` — the GC policy long-lived coordinators
+    need so the journal directory stops growing one file per migration
+    forever); pass ``gc_keep=None`` to keep everything.
     """
     out: List[Dict[str, Any]] = []
     for journal in MigrationJournal.in_flight(journal_dir):
@@ -162,6 +168,8 @@ def resume_migrations(
             out.append({
                 "id": journal.migration_id, "action": "failed", "error": repr(e),
             })
+    if gc_keep is not None:
+        MigrationJournal.gc(journal_dir, keep=gc_keep)
     return out
 
 

@@ -212,3 +212,26 @@ class MigrationJournal:
         zero intact entries) — nothing ran, but the file must still be
         terminalized so it stops reading as in-flight."""
         return [j for j in cls.scan(journal_dir) if not j.is_terminal()]
+
+    @classmethod
+    def gc(cls, journal_dir: str, keep: int = 64) -> List[str]:
+        """Prune settled history for long-lived coordinators: remove
+        STABLE/ROLLED_BACK journals older (by epoch) than the newest `keep`
+        terminal ones.  In-flight journals are NEVER touched — only a
+        terminal entry marks a migration as safe to forget — and epoch
+        monotonicity survives because ``create`` allocates one past the
+        highest epoch still present (the kept tail).  Returns the removed
+        paths."""
+        if keep < 1:
+            raise ValueError(f"gc keep must be >= 1, got {keep}")
+        terminal = [j for j in cls.scan(journal_dir) if j.is_terminal()]
+        removed: List[str] = []
+        for j in terminal[:-keep]:
+            try:
+                os.remove(j.path)
+            except OSError:
+                continue  # racing coordinator already pruned it
+            removed.append(j.path)
+        if removed:
+            _fsync_dir(os.path.abspath(journal_dir))
+        return removed

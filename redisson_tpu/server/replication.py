@@ -194,11 +194,36 @@ def _record_head(rec, name: str) -> dict:
     }
 
 
+# LZ4-framed replication blobs (REPLSNAPSHOT / REPLPUSH / IMPORTRECORDS):
+# magic + 4-byte BIG-ENDIAN uncompressed length (the Lz4Codec/Netty
+# writeInt convention from PR 1) + one LZ4 block.  Decoding accepts bare
+# pickles too (pickles start with \x80, so the magic can't collide), which
+# keeps mixed-version links and recorded blobs working.
+_WIRE_LZ4_MAGIC = b"RLZ4"
+
+
 def _wire_payload(records: List[dict], live: Optional[List[str]]) -> bytes:
     payload = {"format": 1, "records": records}
     if live is not None:
         payload["live"] = live
-    return pickle.dumps(payload, protocol=4)
+    raw = pickle.dumps(payload, protocol=4)
+    if len(raw) > 0xFFFFFFFF:  # BE32 length frame caps at 4GB; ship raw
+        return raw
+    from redisson_tpu.utils import lz4block
+
+    packed = lz4block.compress(raw)
+    if len(packed) + 8 >= len(raw):  # incompressible (device noise): ship raw
+        return raw
+    return _WIRE_LZ4_MAGIC + len(raw).to_bytes(4, "big") + packed
+
+
+def _unwire_payload(blob: bytes) -> bytes:
+    if blob[:4] == _WIRE_LZ4_MAGIC:
+        from redisson_tpu.utils import lz4block
+
+        raw_len = int.from_bytes(blob[4:8], "big")
+        return lz4block.decompress(bytes(blob[8:]), raw_len)
+    return blob
 
 
 def snapshot_records(engine, names: List[str]) -> Dict[str, dict]:
@@ -266,7 +291,7 @@ def apply_records(engine, blob: bytes) -> int:
 
     import jax.numpy as jnp
 
-    payload = _loads(blob)
+    payload = _loads(_unwire_payload(blob))
     applied = 0
     for item in payload["records"]:
         name = item["name"]

@@ -62,10 +62,7 @@ class _PendingFrame:
         self.proto = proto
 
     def encoded(self) -> bytes:
-        return b"".join(
-            r.data if isinstance(r, _Encoded) else _encode_result(r, self.proto)
-            for r in self.results
-        )
+        return _encode_frame(self.results, self.proto)
 
 
 def _force_lazies(results: list, server) -> None:
@@ -621,6 +618,12 @@ class TpuServer:
             # frame's D2H readback).  The queue is FIFO and this task writes
             # strictly in pop order, so per-connection reply ordering and
             # RESP framing are preserved exactly.
+            #
+            # Aggregated writes: everything drained from one queue pass —
+            # coalesced frames AND resolved readback frames — is joined and
+            # written as a SINGLE transport.write (one syscall per drained
+            # batch instead of per frame).  An unresolved readback only ever
+            # delays bytes queued BEHIND it, never ones already collected.
             nonlocal writer_alive
             held = None  # a _PendingFrame popped while coalescing bytes
             try:
@@ -629,48 +632,46 @@ class TpuServer:
                     held = None
                     if item is None:
                         return
-                    if isinstance(item, _PendingFrame):
+                    parts: list = []
+                    final = False
+                    while True:
+                        if isinstance(item, _PendingFrame):
+                            if parts and not item.fut.done():
+                                # flush what's ready; await this one next pass
+                                held = item
+                                break
+                            try:
+                                await item.fut  # the overlapped readback
+                            except Exception:  # noqa: BLE001 — pool died mid-force
+                                # tear the connection DOWN, like the serial
+                                # path's in-loop exception would: a silent
+                                # return leaves the read loop dispatching into
+                                # a dead queue and the client blocked on recv
+                                # with no EOF
+                                try:
+                                    writer.close()
+                                except Exception:  # noqa: BLE001
+                                    pass
+                                return
+                            finally:
+                                readback_slots.release()
+                            parts.append(item.encoded())
+                        else:
+                            parts.append(item)
+                        if write_q.empty():
+                            break
+                        nxt = write_q.get_nowait()
+                        if nxt is None:
+                            final = True
+                            break
+                        item = nxt
+                    if parts:
+                        writer.write(parts[0] if len(parts) == 1 else b"".join(parts))
                         try:
-                            await item.fut  # the overlapped readback
-                        except Exception:  # noqa: BLE001 — pool died mid-force
-                            # tear the connection DOWN, like the serial path's
-                            # in-loop exception would: a silent return leaves
-                            # the read loop dispatching into a dead queue and
-                            # the client blocked on recv with no EOF
-                            try:
-                                writer.close()
-                            except Exception:  # noqa: BLE001
-                                pass
+                            await writer.drain()
+                        except ConnectionError:
                             return
-                        finally:
-                            readback_slots.release()
-                        data = item.encoded()
-                    else:
-                        data = item
-                        final = False
-                        # drain coalesced frames in one syscall (stop at a
-                        # pending frame: its readback must not delay bytes
-                        # that are already encoded)
-                        while not write_q.empty():
-                            nxt = write_q.get_nowait()
-                            if nxt is None:
-                                final = True
-                                break
-                            if isinstance(nxt, _PendingFrame):
-                                held = nxt
-                                break
-                            data += nxt
-                        if final:
-                            writer.write(data)
-                            try:
-                                await writer.drain()
-                            except ConnectionError:
-                                pass
-                            return
-                    writer.write(data)
-                    try:
-                        await writer.drain()
-                    except ConnectionError:
+                    if final:
                         return
             finally:
                 writer_alive = False
@@ -784,10 +785,10 @@ class TpuServer:
                         write_q.put_nowait(_PendingFrame(results, fut, ctx.proto))
                         continue
                     await loop.run_in_executor(self._pool, _force_lazies, results, self)
-                for r in results:
-                    write_q.put_nowait(
-                        r.data if isinstance(r, _Encoded) else _encode_result(r, ctx.proto)
-                    )
+                if results:
+                    # one queue item per frame — the whole frame's replies
+                    # encode in one pass and write in one syscall batch
+                    write_q.put_nowait(_encode_frame(results, ctx.proto))
         except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
             pass
         finally:
@@ -892,6 +893,40 @@ def _encode_result(result, proto: int = 3) -> bytes:
         # subscribe-style confirmations: stream of push frames
         return b"".join(resp.encode_reply(r, proto) for r in result)
     return resp.encode_reply(result, proto)
+
+
+def _encode_frame(results: list, proto: int) -> bytes:
+    """Encode a whole frame's replies as ONE byte string.  Runs of plain
+    values ride a single resp.encode_replies emit (one native arena write
+    for the run — the aggregated-write path); pre-encoded errors and the
+    two special result forms (`+simple` strings, push-frame lists) keep
+    their _encode_result semantics, in place, in order."""
+    parts: list = []
+    run: list = []
+    flush = parts.append
+    for r in results:
+        if isinstance(r, _Encoded):
+            if run:
+                flush(resp.encode_replies(run, proto))
+                run = []
+            flush(r.data)
+        elif isinstance(r, str) and r.startswith("+"):
+            if run:
+                flush(resp.encode_replies(run, proto))
+                run = []
+            flush(resp.encode_simple(r[1:]))
+        elif isinstance(r, list) and r and isinstance(r[0], resp.Push):
+            if run:
+                flush(resp.encode_replies(run, proto))
+                run = []
+            flush(_encode_result(r, proto))
+        else:
+            run.append(r)
+    if run:
+        flush(resp.encode_replies(run, proto))
+    if len(parts) == 1:
+        return parts[0]
+    return b"".join(parts)
 
 
 class ServerThread:

@@ -1,19 +1,27 @@
-"""Pure-python LZ4 *block* format (compress + decompress).
+"""LZ4 *block* format (compress + decompress): native fast path + pure
+Python fallback.
 
 Parity: the reference ships LZ4Codec (codec/LZ4Codec.java, backed by
-lz4-java's block codec) as its recommended compression wrapper.  No lz4
-native library is available here, so this is an original implementation of
-the published block format (token nibbles, 255-run extended lengths,
-little-endian 2-byte match offsets, literals-only final sequence, the
-12/5-byte end-of-block match rules) — interoperable with any standard LZ4
-block decoder at the byte level.
+lz4-java's JNI block codec) as its recommended compression wrapper.  The
+same split exists here: ``compress``/``decompress`` dispatch to
+``rtpu_lz4_compress``/``rtpu_lz4_decompress`` (native/resp.cpp via the
+net/_native loader) when the toolchain is available, and fall back to the
+original pure-Python implementation of the published block format (token
+nibbles, 255-run extended lengths, little-endian 2-byte match offsets,
+literals-only final sequence, the 12/5-byte end-of-block match rules) —
+``compress_python``/``decompress_python``, kept public as the documented
+fallback and cross-validation reference.  Both implementations are
+interoperable with any standard LZ4 block decoder at the byte level, and
+with EACH OTHER in both directions (enforced by tests/test_native_wire.py);
+``RTPU_NO_NATIVE=1`` forces the fallback.
 
-Throughput is python-speed (~5-20MB/s compress): the codec exists for wire
-compatibility and storage-ratio parity, not as the fast path — bulk device
-state never routes through user codecs (core/checkpoint.py has its own
-record codec).
+Fallback throughput is python-speed (~5-20MB/s compress); the native path
+runs at memory speed, which is what lets the codec and the replication
+full-ship/delta wire (server/replication.py) compress by default.
 """
 from __future__ import annotations
+
+import ctypes
 
 _MIN_MATCH = 4
 _LAST_LITERALS = 5   # spec: the last 5 bytes are always literals
@@ -21,8 +29,52 @@ _MATCH_GUARD = 12    # spec: no match may start within the last 12 bytes
 _MAX_OFFSET = 0xFFFF
 
 
+def _lib():
+    from redisson_tpu.net import _native
+
+    return _native.load()
+
+
 def compress(src: bytes) -> bytes:
-    """LZ4 block compress (greedy, 4-byte hash chaining)."""
+    """LZ4 block compress — native when available, else pure Python."""
+    lib = _lib()
+    if lib is None:
+        return compress_python(src)
+    src = bytes(src)
+    n = len(src)
+    cap = n + n // 255 + 16  # LZ4 worst-case expansion bound
+    out = ctypes.create_string_buffer(cap)
+    w = lib.rtpu_lz4_compress(src, n, out, cap)
+    if w < 0:  # oversized input (-3) or bound drift (-1): python handles it
+        return compress_python(src)
+    return ctypes.string_at(out, w)
+
+
+def decompress(src: bytes, expected_size: int) -> bytes:
+    """LZ4 block decompress; raises ValueError on malformed input or a size
+    mismatch — native when available, else pure Python."""
+    lib = _lib()
+    if lib is None:
+        return decompress_python(src, expected_size)
+    if expected_size < 0:
+        raise ValueError(f"bad LZ4 expected size {expected_size}")
+    src = bytes(src)
+    out = ctypes.create_string_buffer(max(1, expected_size))
+    produced = ctypes.c_uint64(0)
+    rc = lib.rtpu_lz4_decompress(
+        src, len(src), out, expected_size, ctypes.byref(produced)
+    )
+    if rc == -1:
+        raise ValueError("truncated or malformed LZ4 block")
+    if rc != 0:
+        raise ValueError(
+            f"LZ4 size mismatch: got {produced.value}, expected {expected_size}"
+        )
+    return ctypes.string_at(out, expected_size)
+
+
+def compress_python(src: bytes) -> bytes:
+    """Pure-python LZ4 block compress (greedy, 4-byte hash chaining)."""
     n = len(src)
     if n == 0:
         return b"\x00"  # one empty-literal token: a valid empty block
@@ -81,9 +133,10 @@ def _emit(out: bytearray, lit: bytes, offset: int, mlen: int) -> None:
         _ext(out, ml - 15)
 
 
-def decompress(src: bytes, expected_size: int) -> bytes:
-    """LZ4 block decompress; raises ValueError on malformed input or a size
-    mismatch (the codec frame carries the uncompressed length)."""
+def decompress_python(src: bytes, expected_size: int) -> bytes:
+    """Pure-python LZ4 block decompress; raises ValueError on malformed
+    input or a size mismatch (the codec frame carries the uncompressed
+    length)."""
     out = bytearray()
     i = 0
     n = len(src)

@@ -274,3 +274,61 @@ def test_rollback_success_reraises_original(cluster2, monkeypatch):
     for node in cluster2.masters:
         srv = node.server.server
         assert not srv.migrating_slots and not srv.importing_slots
+
+
+# -- journal GC (long-lived coordinators) -------------------------------------
+
+def _terminal_journal(tmp_path, phase="STABLE"):
+    j = MigrationJournal.create(str(tmp_path), "a:1", "b:2")
+    j.append("PLANNED", source="a:1", target="b:2", slots=[1], epoch=j.epoch,
+             old_view=[], new_view=[])
+    j.append(phase)
+    return j
+
+
+def test_gc_removes_only_old_terminal_journals(tmp_path):
+    old = [
+        _terminal_journal(tmp_path, "STABLE" if i % 2 else "ROLLED_BACK")
+        for i in range(6)
+    ]
+    inflight = MigrationJournal.create(str(tmp_path), "a:1", "b:2")
+    inflight.append("PLANNED", source="a:1", target="b:2", slots=[2],
+                    epoch=inflight.epoch, old_view=[], new_view=[])
+    inflight.append("WINDOW_OPEN")
+    newer = [_terminal_journal(tmp_path) for _ in range(2)]
+    removed = MigrationJournal.gc(str(tmp_path), keep=3)
+    # the oldest 5 terminal journals go; the newest 3 terminal stay
+    assert sorted(removed) == sorted(j.path for j in old[:5])
+    kept = MigrationJournal.scan(str(tmp_path))
+    assert {j.path for j in kept} == {old[5].path, inflight.path,
+                                      newer[0].path, newer[1].path}
+    # the in-flight journal is NEVER touched, even with keep=1
+    MigrationJournal.gc(str(tmp_path), keep=1)
+    assert inflight.path in {j.path for j in MigrationJournal.scan(str(tmp_path))}
+    # epoch allocation stays monotonic after pruning (the newest terminal
+    # journal survives, so max-epoch never decreases)
+    nxt = MigrationJournal.create(str(tmp_path), "a:1", "b:2")
+    assert nxt.epoch > newer[1].epoch
+
+
+def test_gc_rejects_keep_zero(tmp_path):
+    _terminal_journal(tmp_path)
+    with pytest.raises(ValueError, match="keep"):
+        MigrationJournal.gc(str(tmp_path), keep=0)
+
+
+def test_gc_empty_or_missing_dir(tmp_path):
+    assert MigrationJournal.gc(str(tmp_path / "nope"), keep=4) == []
+    assert MigrationJournal.gc(str(tmp_path), keep=4) == []
+
+
+def test_resume_migrations_invokes_gc(tmp_path):
+    for _ in range(5):
+        _terminal_journal(tmp_path)
+    assert resume_migrations(str(tmp_path), gc_keep=2) == []
+    assert len(MigrationJournal.scan(str(tmp_path))) == 2
+    # gc_keep=None keeps everything
+    for _ in range(3):
+        _terminal_journal(tmp_path)
+    resume_migrations(str(tmp_path), gc_keep=None)
+    assert len(MigrationJournal.scan(str(tmp_path))) == 5

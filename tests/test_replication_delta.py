@@ -80,7 +80,9 @@ def test_hot_plane_ships_sublinear_bytes(pair):
         src = master.server.replication_source()
         src.flush()  # first ship is a full plane (establishes the baseline)
         full_bytes = src.stats["bytes"]
-        assert full_bytes > 1_000_000  # ~2.4MB plane shipped in full once
+        # the ~2.4MB plane ships in full once; the wire blob is LZ4-framed
+        # (mostly-zero plane compresses ~20x) but still dwarfs any delta
+        assert full_bytes > 50_000, full_bytes
         assert src.stats["records_full"] >= 1
 
         per_sweep = []
@@ -95,7 +97,8 @@ def test_hot_plane_ships_sublinear_bytes(pair):
             per_sweep.append(src.stats["bytes"] - b0)
         assert src.stats["records_delta"] >= 6
         # sub-linear: each delta sweep ships a small fraction of the plane
-        # (100 keys * k bits -> ~700 dirty 256B blocks ~= 180KB worst case)
+        # (100 keys * k bits -> ~700 dirty 256B blocks, ~180KB raw / ~9KB
+        # on the LZ4-framed wire vs ~115KB for the compressed full plane)
         assert max(per_sweep) < full_bytes / 4, (per_sweep, full_bytes)
 
         # correctness: the replica converges to the same membership

