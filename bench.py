@@ -396,6 +396,59 @@ def bench_config4_mapreduce(client):
     return rate, cold_rate
 
 
+def _mixed_cluster_cmds(rng, tenants=64, per=10_000):
+    """The config5 mixed workload builder, shared VERBATIM by the
+    in-process config5 and the multi-process config5p so the two numbers
+    measure the same command stream."""
+    keysets = [
+        (np.arange(t * per, (t + 1) * per, dtype=np.int64) * 2654435761)
+        for t in range(tenants)
+    ]
+    blobs = [np.ascontiguousarray(ks, dtype="<i8").tobytes() for ks in keysets]
+
+    def make_cmds(tag):
+        cmds = [
+            ("BF.RESERVE", f"bf{tag}{{t{t}}}", 0.01, per) for t in range(tenants)
+        ]
+        cmds += [
+            ("BF.MADD64", f"bf{tag}{{t{t}}}", blobs[t]) for t in range(tenants)
+        ]
+        cmds += [
+            ("BF.MEXISTS64", f"bf{tag}{{t{t}}}", blobs[t]) for t in range(tenants)
+        ]
+        ops = 2 * tenants * per
+        for t in range(tenants):
+            i1 = np.ascontiguousarray(rng.integers(0, 100_000, 500), "<i4").tobytes()
+            i2 = np.ascontiguousarray(rng.integers(0, 100_000, 500), "<i4").tobytes()
+            cmds.append(("SETBITSB", f"bits{tag}{{t{t}}}", i1))
+            cmds.append(("SETBITSB", f"bits2{tag}{{t{t}}}", i2))
+            cmds.append(("BITOP", "OR", f"bits{tag}{{t{t}}}", f"bits{tag}{{t{t}}}", f"bits2{tag}{{t{t}}}"))
+            cmds.append(("BITOP", "XOR", f"bits{tag}{{t{t}}}", f"bits{tag}{{t{t}}}", f"bits2{tag}{{t{t}}}"))
+            ops += 1000 + 2
+        return cmds, ops
+
+    return make_cmds
+
+
+def _run_mixed_workload(client, make_cmds, tenants=64, reps=4):
+    """Warm + best-of-`reps` driver for the mixed pipeline (audit
+    discipline: every rep's rate returned, recorded number = max)."""
+    warm_cmds, _ = make_cmds("w")
+    client.execute_many(warm_cmds)
+    rates = []
+    ops = 0
+    for rep in range(reps):
+        cmds, ops = make_cmds(f"r{rep}")
+        t0 = time.perf_counter()
+        replies = client.execute_many(cmds)
+        wall = time.perf_counter() - t0
+        probe = replies[2 * tenants : 3 * tenants]
+        for t, out in enumerate(probe):
+            assert np.frombuffer(out, np.uint8).all(), f"false negatives t{t}"
+        rates.append(ops / wall)
+    return rates, ops
+
+
 def bench_config5_cluster_mixed():
     """Mixed BitSet OR/XOR + bloom across an 8-master cluster (config 5).
 
@@ -417,55 +470,19 @@ def bench_config5_cluster_mixed():
     only ~1-3s, so four fixed reps make the recorded number measure the
     framework, not the tunnel's mood.  Rep 1 also absorbs in-memory
     jit-cache warmup for the frame-concat programs.
+
+    NOTE: this cluster is 8 ServerThreads in ONE process sharing one GIL —
+    the wire-plane and dispatch concurrency are structurally hidden here;
+    config5p (bench_config5p_cluster_proc) is the honest multi-process
+    number.
     """
     from redisson_tpu.harness import ClusterRunner
 
     runner = ClusterRunner(masters=8, workers=16).run()
     try:
         client = runner.client(scan_interval=0)
-        tenants = 64
-        per = 10_000
-        rng = np.random.default_rng(11)
-        keysets = [
-            (np.arange(t * per, (t + 1) * per, dtype=np.int64) * 2654435761)
-            for t in range(tenants)
-        ]
-        blobs = [np.ascontiguousarray(ks, dtype="<i8").tobytes() for ks in keysets]
-
-        def make_cmds(tag):
-            cmds = [
-                ("BF.RESERVE", f"bf{tag}{{t{t}}}", 0.01, per) for t in range(tenants)
-            ]
-            cmds += [
-                ("BF.MADD64", f"bf{tag}{{t{t}}}", blobs[t]) for t in range(tenants)
-            ]
-            cmds += [
-                ("BF.MEXISTS64", f"bf{tag}{{t{t}}}", blobs[t]) for t in range(tenants)
-            ]
-            ops = 2 * tenants * per
-            for t in range(tenants):
-                i1 = np.ascontiguousarray(rng.integers(0, 100_000, 500), "<i4").tobytes()
-                i2 = np.ascontiguousarray(rng.integers(0, 100_000, 500), "<i4").tobytes()
-                cmds.append(("SETBITSB", f"bits{tag}{{t{t}}}", i1))
-                cmds.append(("SETBITSB", f"bits2{tag}{{t{t}}}", i2))
-                cmds.append(("BITOP", "OR", f"bits{tag}{{t{t}}}", f"bits{tag}{{t{t}}}", f"bits2{tag}{{t{t}}}"))
-                cmds.append(("BITOP", "XOR", f"bits{tag}{{t{t}}}", f"bits{tag}{{t{t}}}", f"bits2{tag}{{t{t}}}"))
-                ops += 1000 + 2
-            return cmds, ops
-
-        # warm compiles (bloom add/contains, bitset, frame-concat programs)
-        warm_cmds, _ = make_cmds("w")
-        client.execute_many(warm_cmds)
-        rates = []
-        for rep in range(4):
-            cmds, ops = make_cmds(f"r{rep}")
-            t0 = time.perf_counter()
-            replies = client.execute_many(cmds)
-            wall = time.perf_counter() - t0
-            probe = replies[2 * tenants : 3 * tenants]
-            for t, out in enumerate(probe):
-                assert np.frombuffer(out, np.uint8).all(), f"false negatives t{t}"
-            rates.append(ops / wall)
+        make_cmds = _mixed_cluster_cmds(np.random.default_rng(11))
+        rates, ops = _run_mixed_workload(client, make_cmds)
         best = max(rates)
         log(
             f"config5: {ops} mixed ops over 8-master cluster = "
@@ -476,6 +493,68 @@ def bench_config5_cluster_mixed():
         return best
     finally:
         runner.shutdown()
+
+
+def bench_config5p_cluster_proc():
+    """Config 5P: the SAME mixed workload against 8 supervisor-spawned
+    ``tpu-server`` OS PROCESSES (cluster/supervisor.py) — no shared GIL, so
+    the 8 masters actually parse/dispatch/encode concurrently.  This is the
+    honest cluster number the ROADMAP calls for, and the A/B the CPU
+    in-process runs could never resolve: the native wire plane
+    (``native/resp.cpp``) vs ``RTPU_NO_NATIVE=1``, flipped in the SERVER
+    processes only (the client stays native both legs, so the delta
+    isolates the server-side wire plane).
+
+    Server processes default to the CPU jax backend (``RTPU_PROC_PLATFORM``
+    overrides): 8 processes cannot share one TPU chip — per-process device
+    placement is the device-sharded-slots open item in ROADMAP.md.
+    """
+    import os
+
+    from redisson_tpu.cluster import ClusterSupervisor
+
+    platform = os.environ.get("RTPU_PROC_PLATFORM", "cpu")
+    results = {}
+    for label, extra_env in (("native", {}), ("no_native", {"RTPU_NO_NATIVE": "1"})):
+        sup = ClusterSupervisor(
+            masters=8,
+            env=extra_env,
+            server_args=("--workers", "16"),
+            platform=platform,
+        ).start()
+        try:
+            client = sup.client(scan_interval=0, timeout=180.0)
+            assert client.wait_routable(timeout=60.0), "proc cluster never served"
+            make_cmds = _mixed_cluster_cmds(np.random.default_rng(11))
+            rates, ops = _run_mixed_workload(client, make_cmds)
+            results[label] = {"rates": rates, "best": max(rates), "ops": ops}
+            log(
+                f"config5p[{label}]: {ops} mixed ops over 8 OS processes = "
+                f"{max(rates)/1e3:.0f}k ops/s (best of {len(rates)}: "
+                f"{['%.0fk' % (r/1e3) for r in rates]})"
+            )
+            client.shutdown()
+        finally:
+            sup.shutdown()
+    best = results["native"]["best"]
+    ratio = best / results["no_native"]["best"] if results["no_native"]["best"] else 0.0
+    log(
+        f"config5p: native {best/1e3:.0f}k vs RTPU_NO_NATIVE=1 "
+        f"{results['no_native']['best']/1e3:.0f}k ops/s -> native/python = "
+        f"{ratio:.2f}x (server-side wire plane only; client native both legs)"
+    )
+    return {
+        "cluster_proc_mixed_ops_per_sec": round(best),
+        "server_platform": platform,
+        "native_ab": {
+            "native_ops_per_sec": round(best),
+            "no_native_ops_per_sec": round(results["no_native"]["best"]),
+            "native_over_python": round(ratio, 3),
+            "native_rates": [round(r) for r in results["native"]["rates"]],
+            "no_native_rates": [round(r) for r in results["no_native"]["rates"]],
+            "note": "RTPU_NO_NATIVE=1 flipped in server processes only",
+        },
+    }
 
 
 def bench_config2a_async_parity():
@@ -614,6 +693,13 @@ def _probe_h2d(dev):
 def child(which: str) -> None:
     """Run ONE config in this process and emit its results as an @@RESULT
     line for the parent orchestrator."""
+    if which == "5p":
+        # pure orchestrator: the parent must NOT claim the device — the 8
+        # spawned server processes own their own jax runtimes (and on a TPU
+        # host the parent grabbing the chip would starve all of them)
+        result = bench_config5p_cluster_proc()
+        print("@@RESULT " + json.dumps(result), flush=True)
+        return
     dev = _init_jax()
     h2d = _probe_h2d(dev)
     log(f"config{which}: device {dev}, tunnel h2d probe {h2d:.0f} MB/s")
@@ -662,7 +748,7 @@ def main():
     import subprocess
 
     results: dict = {}
-    for which in ("2", "2L", "2A", "1", "3", "4", "5"):
+    for which in ("2", "2L", "2A", "1", "3", "4", "5", "5p"):
         p = subprocess.run(
             [sys.executable, __file__, "--config", which],
             stdout=subprocess.PIPE,
@@ -694,8 +780,13 @@ def main():
                     "config4_mapreduce_entries_per_sec": results["4"]["mapreduce_entries_per_sec"],
                     "config4_mapreduce_cold_entries_per_sec": results["4"]["mapreduce_cold_entries_per_sec"],
                     "config5_cluster_mixed_ops_per_sec": results["5"]["cluster_mixed_ops_per_sec"],
+                    "config5p_cluster_proc_ops_per_sec": results["5p"]["cluster_proc_mixed_ops_per_sec"],
+                    "config5p_native_ab": results["5p"]["native_ab"],
+                    "config5p_server_platform": results["5p"]["server_platform"],
                     "baseline_model": "k=7 GETBITs @ 1M pipelined ops/s/core = 143k contains/s",
-                    "tunnel_h2d_mb_per_sec": {w: r["h2d_mb_s"] for w, r in results.items()},
+                    "tunnel_h2d_mb_per_sec": {
+                        w: r["h2d_mb_s"] for w, r in results.items() if "h2d_mb_s" in r
+                    },
                     "device": results["2"]["device"],
                 },
             }

@@ -22,11 +22,17 @@ contention).  This package is that discipline made first-class:
     zero acked-write loss and a flat census at every quiesce point; and
     :class:`MigrationSoakHarness` — the migration-under-fault profile:
     journaled slot migrations killed at every phase boundary and resumed,
-    under transport noise and checkpoint storage corruption.
+    under transport noise and checkpoint storage corruption; and
+    :class:`ClusterProcSoakHarness` — the same storm against real
+    ``tpu-server`` OS processes with actual SIGKILLs
+    (cluster/supervisor.py, ISSUE 6).
 """
 from redisson_tpu.chaos.census import ResourceCensus
 from redisson_tpu.chaos.faults import Fault, FaultPlane, FaultSchedule
 from redisson_tpu.chaos.soak import (
+    ClusterProcSoakConfig,
+    ClusterProcSoakHarness,
+    ClusterProcSoakReport,
     MigrationSoakConfig,
     MigrationSoakHarness,
     MigrationSoakReport,
@@ -36,6 +42,9 @@ from redisson_tpu.chaos.soak import (
 )
 
 __all__ = [
+    "ClusterProcSoakConfig",
+    "ClusterProcSoakHarness",
+    "ClusterProcSoakReport",
     "Fault",
     "FaultPlane",
     "FaultSchedule",

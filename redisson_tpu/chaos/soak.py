@@ -37,6 +37,14 @@ record vs its pre-migration snapshot, checkpoint loads surviving torn
 heads via generation fallback, and a flat ResourceCensus.  Run it with
 ``python tools/soak_smoke.py --profile migration`` or the slow tier in
 ``tests/test_soak.py``.
+
+The **cross-process profile** (:class:`ClusterProcSoakHarness`, ISSUE 6)
+is the third discipline: the same storm against REAL ``tpu-server`` OS
+processes (cluster/supervisor.py) — the coordinator dies at a journal
+phase AND the source master takes an actual SIGKILL, the supervisor
+restarts it from its checkpoint, and ``resume_migrations`` must
+terminalize every journal across a genuine process boundary.  Run it with
+``python tools/soak_smoke.py --profile cluster-proc``.
 """
 from __future__ import annotations
 
@@ -572,6 +580,398 @@ class MigrationSoakReport:
             f"bloom bits bit-identical x{self.bloom_bits_verified}, "
             f"faults={self.injected_faults}, census points={len(self.census)}"
         )
+
+
+# -- cross-process profile (ISSUE 6) ------------------------------------------
+
+@dataclass
+class ClusterProcSoakConfig:
+    cycles: int = 1
+    # per cycle: one coordinator-crash + server-SIGKILL at each phase.
+    # DRAINING:1 = after the first drain sweep's journal entry (mid-drain).
+    crash_phases: Tuple[str, ...] = ("WINDOW_OPEN", "DRAINING:1")
+    keys: int = 24                 # acked TCP writes riding the moving slots
+    writer_threads: int = 2
+    seed: int = 0
+    bloom_keys: int = 512          # acked bloom adds re-probed after each storm
+    error_budget_ratio: float = 2.0  # dead-process windows are real here
+    verify_retries: int = 30
+    ready_timeout: float = 90.0
+
+
+@dataclass
+class ClusterProcSoakReport:
+    cycles_completed: int = 0
+    coordinator_kills: int = 0
+    server_sigkills: int = 0
+    restarts: int = 0
+    resumed_completed: int = 0
+    resumed_rolled_back: int = 0
+    acked_writes: int = 0
+    verified_writes: int = 0
+    errors: int = 0
+    bloom_keys_verified: int = 0
+    exit_codes: List[int] = field(default_factory=list)
+
+    def summary(self) -> str:
+        return (
+            f"cluster-proc soak: {self.cycles_completed} cycles, "
+            f"{self.coordinator_kills} coordinator kills + "
+            f"{self.server_sigkills} server SIGKILLs "
+            f"({self.restarts} supervisor restarts, exit codes "
+            f"{self.exit_codes}), "
+            f"{self.resumed_completed} resumed-complete / "
+            f"{self.resumed_rolled_back} rolled back, "
+            f"{self.acked_writes} acked writes "
+            f"({self.verified_writes} re-verified), "
+            f"{self.errors} budgeted errors, "
+            f"bloom={self.bloom_keys_verified} acked adds re-probed"
+        )
+
+
+class ClusterProcSoakHarness:
+    """The process-level chaos discipline (ISSUE 6): a 2-master cluster of
+    REAL ``tpu-server`` OS processes serves a mixed write stream over real
+    TCP while a journaled slot migration is storming between them — and at
+    a chosen journal phase the coordinator "dies" (``CoordinatorKilled``)
+    and the SOURCE master is SIGKILLed, both at once.  The supervisor
+    restarts the dead process (``--restore`` from its checkpoint),
+    ``resume_migrations`` replays the journal ACROSS the process boundary,
+    and the cycle asserts:
+
+      * **zero acked-durable-write loss** — every write acked before the
+        pre-kill ``SAVE`` barrier reads back at its acked value or newer
+        (the SIGKILL analog of the standard profile's REPLFLUSH-before-kill
+        contract: with no replica, durability is the checkpoint, so the
+        covered set is acked-and-saved writes; writes acked in the
+        SAVE→SIGKILL window are explicitly NOT covered — that is what
+        replicas are for);
+      * **exactly-one-owner residency** — after resume, no workload record
+        is resident on more than one master (``CLUSTER GETKEYSINSLOT`` on
+        every node; a re-drained stale restore copy must lose to the
+        target's newer version and then die locally);
+      * **all slots STABLE** — no journal left in flight, no node
+        reporting a MIGRATING/IMPORTING window (``CLUSTER WINDOWS``);
+      * every acked bloom add from setup still probes positive over TCP.
+
+    Runs via ``python tools/soak_smoke.py --profile cluster-proc`` (<60s)
+    or the slow tier in ``tests/test_cluster_proc.py``.
+    """
+
+    def __init__(self, config: Optional[ClusterProcSoakConfig] = None):
+        self.config = config or ClusterProcSoakConfig()
+        self.report = ClusterProcSoakReport()
+        self._rng = np.random.default_rng(self.config.seed)
+        self._acked: Dict[str, str] = {}
+        self._durable: Dict[str, str] = {}  # acked AND checkpoint-covered
+        self._acked_lock = threading.Lock()
+        self._sup = None
+        self._client = None
+        self._keys: List[str] = []
+        self._slots: List[int] = []
+        self._bloom_name: Optional[str] = None
+        self._bloom_keys = None
+        self._owner = 0  # masters[_owner] currently holds the moving slots
+
+    # -- setup ----------------------------------------------------------------
+
+    def _setup(self) -> None:
+        from redisson_tpu.cluster import ClusterSupervisor
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        cfg = self.config
+        # server processes default to the CPU backend (RTPU_PROC_PLATFORM
+        # overrides): N processes cannot share one TPU chip — same
+        # discipline as bench config5p
+        self._sup = ClusterSupervisor(
+            masters=2, ready_timeout=cfg.ready_timeout,
+            platform=os.environ.get("RTPU_PROC_PLATFORM", "cpu"),
+        ).start()
+        self._client = self._sup.client(
+            scan_interval=0.5, timeout=15.0, connect_timeout=5.0,
+            retry_attempts=2, retry_interval=0.1,
+        )
+        assert self._client.wait_routable(timeout=30.0), "cluster never served"
+        lo0, hi0 = self._sup.slot_ranges[0]
+        self._keys = [
+            k for k in (f"procsoak-{i}" for i in range(3000))
+            if lo0 <= calc_slot(k.encode()) <= hi0
+        ][: cfg.keys]
+        assert len(self._keys) >= 8, "key generation failed to fill the range"
+        self._bloom_name = next(
+            n for n in (f"procsoak:bloom-{j}" for j in range(500))
+            if lo0 <= calc_slot(n.encode()) <= hi0
+        )
+        self._slots = sorted(
+            {calc_slot(k.encode()) for k in self._keys}
+            | {calc_slot(self._bloom_name.encode())}
+        )
+        bf = self._client.get_bloom_filter(self._bloom_name)
+        bf.try_init(expected_insertions=50_000, false_probability=0.01)
+        self._bloom_keys = self._rng.integers(
+            0, 1 << 60, cfg.bloom_keys
+        ).astype(np.int64)
+        newly = bf.add_each(self._bloom_keys)
+        assert len(newly) == cfg.bloom_keys, "bloom setup batch truncated"
+
+    def _teardown(self) -> None:
+        try:
+            if self._client is not None:
+                self._client.shutdown()
+        finally:
+            # the supervisor MUST reap its OS processes even if the client
+            # teardown throws — orphaned tpu-server processes outlive the
+            # test session otherwise
+            if self._sup is not None:
+                self._sup.shutdown()
+                for node in self._sup.nodes():
+                    self.report.exit_codes.extend(node.exit_codes)
+
+    # -- workload -------------------------------------------------------------
+
+    def _writer(self, wid: int, cycle: int, stop: threading.Event) -> None:
+        client = self._client
+        mine = self._keys[wid::self.config.writer_threads]
+        i = 0
+        while not stop.is_set():
+            k = mine[i % len(mine)]
+            v = f"c{cycle}-w{wid}-{i}"
+            try:
+                client.execute("SET", k, v)
+                with self._acked_lock:
+                    self._acked[k] = v
+                    self.report.acked_writes += 1
+            except Exception:  # noqa: BLE001 — budgeted chaos error
+                with self._acked_lock:
+                    self.report.errors += 1
+                stop.wait(0.05)  # a dead-process window fails fast; back off
+            i += 1
+            stop.wait(0.004)
+
+    def _mapper(self, cycle: int, stop: threading.Event) -> None:
+        """The 'mixed' half: hash traffic sharing the moving slot range
+        (errors budgeted, correctness carried by the SET stream)."""
+        # hashtag pins the map into the same (moving) slot as keys[0]
+        m = self._client.get_map(f"{{{self._keys[0]}}}:map")
+        i = 0
+        while not stop.is_set():
+            try:
+                m.put(f"c{cycle}-{i}", i)
+                m.get(f"c{cycle}-{max(0, i - 1)}")
+            except Exception:  # noqa: BLE001
+                with self._acked_lock:
+                    self.report.errors += 1
+                stop.wait(0.05)
+            i += 1
+            stop.wait(0.008)
+
+    @staticmethod
+    def _value_seq(v: str) -> Tuple[int, int]:
+        parts = v.split("-")
+        return int(parts[0][1:]), int(parts[2])
+
+    def _save_barrier(self, min_acked: int = 4, wait_s: float = 15.0) -> None:
+        """Checkpoint the CURRENT owner and promote every write acked
+        before the SAVE started into the durable (covered) set.
+
+        Waits (bounded) for a few acks to exist first: under heavy machine
+        load the writers may not have landed anything yet, and a barrier
+        that promotes an empty snapshot would make the later verify
+        vacuous — the soak would "pass" having protected nothing."""
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            with self._acked_lock:
+                if len(self._acked) >= min(min_acked, len(self._keys)):
+                    break
+            time.sleep(0.05)
+        with self._acked_lock:
+            snapshot = dict(self._acked)
+        victim = self._sup.masters[self._owner]
+        with self._sup.conn(victim, timeout=60.0) as c:
+            reply = c.execute("SAVE", timeout=60.0)
+            from redisson_tpu.net.resp import RespError
+
+            assert not isinstance(reply, RespError), reply
+        self._durable.update(snapshot)
+
+    def _verify_durable(self, sample: Optional[int] = None) -> None:
+        """Monotone zero-loss check over the durable set: the stored value
+        is the acked-durable one or a NEWER write by the same key's single
+        writer — never older, never gone."""
+        keys = sorted(self._durable)
+        if sample:
+            keys = keys[:: max(1, len(keys) // sample)]
+        for k in keys:
+            got = None
+            for _ in range(self.config.verify_retries):
+                try:
+                    got = self._client.execute("GET", k)
+                except Exception:  # noqa: BLE001 — topology still settling
+                    got = None
+                if got is not None:
+                    break
+                # nil is retryable too: a read routed while the post-resume
+                # topology is still converging can transiently miss; only a
+                # PERSISTENT nil is a lost write
+                time.sleep(0.2)
+            got = bytes(got).decode() if got is not None else None
+            want = self._durable[k]
+            assert got is not None and (
+                self._value_seq(got) >= self._value_seq(want)
+            ), f"lost acked-durable write {k!r}: want >= {want!r}, got {got!r}"
+            self.report.verified_writes += 1
+
+    def _verify_bloom(self) -> None:
+        """Every acked bloom add from setup (pre-first-SAVE, so durable)
+        still probes positive through whatever master now owns the slot."""
+        bf = self._client.get_bloom_filter(self._bloom_name)
+        got = None
+        for _ in range(self.config.verify_retries):
+            try:
+                got = bf.contains_each(self._bloom_keys)
+                break
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+        assert got is not None, "bloom probe never answered after the storm"
+        got = np.asarray(got)
+        assert got.all(), (
+            f"lost {int((~got).sum())} acked bloom adds across the "
+            "process-kill storm"
+        )
+        self.report.bloom_keys_verified += int(got.size)
+
+    # -- invariants -----------------------------------------------------------
+
+    def _assert_slots_stable(self) -> None:
+        from redisson_tpu.server.migration_journal import MigrationJournal
+
+        assert not MigrationJournal.in_flight(self._sup.journal_dir), (
+            "journal left non-terminal migrations behind"
+        )
+        for node in self._sup.masters:
+            with self._sup.conn(node) as c:
+                windows = c.execute("CLUSTER", "WINDOWS")
+            assert not windows, (
+                f"{node.name} left migration windows open: {windows!r}"
+            )
+
+    def _assert_one_owner(self) -> None:
+        """No workload record resident on more than one PROCESS: asked over
+        the wire per node (CLUSTER GETKEYSINSLOT bypasses routing)."""
+        holders: Dict[str, int] = {}
+        for node in self._sup.masters:
+            with self._sup.conn(node) as c:
+                for slot in self._slots:
+                    names = c.execute(
+                        "CLUSTER", "GETKEYSINSLOT", slot, 1_000_000
+                    )
+                    for n in names or []:
+                        n = bytes(n).decode()
+                        holders[n] = holders.get(n, 0) + 1
+        multi = {n: c for n, c in holders.items() if c > 1}
+        assert not multi, f"records resident on multiple processes: {multi}"
+
+    # -- the storm ------------------------------------------------------------
+
+    def _storm(self, cycle: int) -> None:
+        import signal as _signal
+
+        from redisson_tpu.cluster.chaos import sigkill_at_phase
+        from redisson_tpu.server.migration import resume_migrations
+
+        sup = self._sup
+        for phase in self.config.crash_phases:
+            src = sup.masters[self._owner]
+            dst = sup.masters[1 - self._owner]
+            # durability barrier BEFORE the kill: this cycle's covered set
+            self._save_barrier()
+            rc = sigkill_at_phase(
+                sup, src, src.address, dst.address, self._slots, phase,
+                sig=_signal.SIGKILL,
+            )
+            self.report.coordinator_kills += 1
+            self.report.server_sigkills += 1
+            assert rc == -_signal.SIGKILL, f"expected SIGKILL death, got {rc}"
+            # The SIGKILL voids every ack the victim applied AFTER the SAVE
+            # barrier (same truth as Redis writes past the last RDB
+            # snapshot: they die with the process).  Roll the promise set
+            # back to the durable floor, or the NEXT barrier would promote
+            # doomed acks its SAVE can no longer cover — the harness would
+            # then "detect" a loss the durability contract never promised
+            # to prevent.  Acks that actually landed on the surviving
+            # target are conservatively un-promised too; they re-enter the
+            # promise set the next time their writer gets an ack.  The
+            # short settle lets in-flight replies (applied+buffered before
+            # the kill) finish recording first.
+            time.sleep(0.3)
+            with self._acked_lock:
+                for k in list(self._acked):
+                    if k in self._durable:
+                        self._acked[k] = self._durable[k]
+                    else:
+                        del self._acked[k]
+            sup.restart(src)  # same port, --restore from the SAVE barrier
+            self.report.restarts += 1
+            results = resume_migrations(sup.journal_dir)
+            assert results, "resume found no in-flight migration"
+            for r in results:
+                assert r["action"] in ("completed", "rolled_back"), r
+                if r["action"] == "completed":
+                    self.report.resumed_completed += 1
+                    self._owner = 1 - self._owner
+                else:
+                    self.report.resumed_rolled_back += 1
+            self._client.refresh_topology()
+            self._assert_slots_stable()
+            self._assert_one_owner()
+            self._verify_durable(sample=8)
+
+    # -- the run loop ---------------------------------------------------------
+
+    def run(self) -> ClusterProcSoakReport:
+        cfg = self.config
+        try:
+            # inside the try: _setup spawns real OS processes and then has
+            # failure points (wait_routable, key generation) — a setup
+            # abort must still reap them via the finally's _teardown
+            self._setup()
+            for cycle in range(cfg.cycles):
+                stop = threading.Event()
+                threads = [
+                    threading.Thread(target=self._writer, args=(w, cycle, stop))
+                    for w in range(cfg.writer_threads)
+                ] + [threading.Thread(target=self._mapper, args=(cycle, stop))]
+                try:
+                    for t in threads:
+                        t.start()
+                    self._storm(cycle)
+                    # post-recovery write window: let the writers land acks
+                    # on the HEALED topology before they stop, so the final
+                    # verify covers fresh post-storm writes too (writers
+                    # parked in retry funnels during recovery may otherwise
+                    # contribute nothing after the ack rollback)
+                    time.sleep(1.0)
+                finally:
+                    stop.set()
+                    for t in threads:
+                        t.join(timeout=90.0)
+                assert not any(t.is_alive() for t in threads), "writer wedged"
+                # final barrier: everything acked while the cluster was
+                # healthy post-storm becomes covered, then full verify
+                self._save_barrier()
+                self._verify_durable()
+                self._verify_bloom()
+                self.report.cycles_completed += 1
+            budget = int(
+                cfg.error_budget_ratio * max(1, self.report.acked_writes)
+            )
+            assert self.report.errors <= budget, (
+                f"error budget blown: {self.report.errors} errors vs "
+                f"{self.report.acked_writes} acked writes (budget {budget})"
+            )
+            return self.report
+        finally:
+            self._teardown()
 
 
 class MigrationSoakHarness:

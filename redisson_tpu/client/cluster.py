@@ -300,6 +300,36 @@ class ClusterRedisson(RemoteSurface):
             except Exception:  # noqa: BLE001 — keep scanning
                 pass
 
+    def wait_routable(self, timeout: float = 30.0,
+                      full_coverage: bool = True) -> bool:
+        """Block until the cluster actually serves: every hash slot has a
+        live owner in the routing table (with ``full_coverage``) and every
+        routed master answers PING.  The barrier callers need after a
+        process-level start/restart (cluster/supervisor.py) or a failover
+        storm — node processes report READY when their listener binds,
+        which is before the topology view reaches them.  Returns False on
+        deadline instead of raising (the caller owns the failure story)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self.refresh_topology()
+                with self._lock:
+                    addrs = {a for a in self._slots if a is not None}
+                    covered = all(a is not None for a in self._slots)
+                    entries = [
+                        self._entries[a] for a in addrs if a in self._entries
+                    ]
+                if addrs and (covered or not full_coverage) \
+                        and len(entries) == len(addrs):
+                    for e in entries:
+                        e.master.execute("PING", timeout=2.0, retry_attempts=0)
+                    return True
+            except Exception:  # noqa: BLE001 — not routable yet
+                pass
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.1)
+
     def entry_for_slot(self, slot: int) -> ShardEntry:
         with self._lock:
             addr = self._slots[slot]

@@ -324,6 +324,11 @@ class RemoteBloomFilter(_ObjcallFallback):
         return [o if isinstance(o, bytes) else self._codec.encode(o) for o in objs]
 
     def add(self, obj) -> bool:
+        if isinstance(obj, np.ndarray):
+            # embedded-handle parity (objects/bloom.py BloomFilter.add): an
+            # array argument is a BATCH — the old path encoded the array to
+            # a key list and silently added only its first element
+            return bool(self.add_each(obj).any())
         return bool(self._client.execute("BF.ADD", self.name, self._encode_keys(obj)[0]))
 
     def add_all(self, objs) -> int:

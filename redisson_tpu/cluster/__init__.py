@@ -1,0 +1,16 @@
+"""Process-level cluster plane (ISSUE 6): real ``tpu-server`` OS processes,
+real TCP topology wiring, real signals.
+
+  * :mod:`~redisson_tpu.cluster.supervisor` — :class:`ClusterSupervisor`
+    (spawn / wait_ready / kill / stop / restart, per-node logs + exit codes);
+  * :mod:`~redisson_tpu.cluster.topology` — the single slot-assignment +
+    SETVIEW program shared with the in-process harness;
+  * :mod:`~redisson_tpu.cluster.chaos` — process-chaos primitives
+    (coordinator crash at a journal phase, SIGKILL-at-phase storms).
+"""
+from redisson_tpu.cluster.supervisor import (  # noqa: F401
+    ClusterSupervisor,
+    NodeProc,
+    NodeStartupError,
+)
+from redisson_tpu.cluster.topology import split_slots  # noqa: F401

@@ -1,0 +1,70 @@
+"""Process-level chaos primitives for supervisor-run clusters (ISSUE 6).
+
+The in-process chaos plane "kills" nodes by clearing a pause gate; here the
+victims are real OS processes, so the primitives are real signals plus one
+deterministic coordinator-death hook:
+
+  * :func:`crash_coordinator_at` — run a journaled migration and "die" at a
+    chosen journal phase (``migrate_slots(crash_after=...)`` raising
+    ``CoordinatorKilled``).  Because the journal lives in the SUPERVISOR
+    process and the servers are separate processes, the subsequent
+    ``resume_migrations`` replays the PR 4 journal across a genuine process
+    boundary — the property the in-process tier could only approximate.
+  * :func:`sigkill_at_phase` — the compound storm the soak profile uses:
+    crash the coordinator at a phase, then SIGKILL a server process AT that
+    exact journal state, leaving both halves of the protocol dead at once.
+
+SIGSTOP/SIGCONT freezes ride :meth:`ClusterSupervisor.pause`/``resume``
+directly; SIGKILL/SIGTERM ride :meth:`ClusterSupervisor.kill`/``stop``.
+"""
+from __future__ import annotations
+
+import signal
+from typing import Optional, Sequence
+
+from redisson_tpu.cluster.supervisor import ClusterSupervisor, NodeProc
+
+
+def crash_coordinator_at(
+    source: str,
+    target: str,
+    slots: Sequence[int],
+    journal_dir: str,
+    phase: str,
+    password: Optional[str] = None,
+) -> None:
+    """Start a journaled migration and murder the coordinator right after
+    `phase`'s journal entry (``PLANNED``, ``WINDOW_OPEN``,
+    ``DRAINING:<sweep>``, ``VIEW_COMMITTED``).  Raises AssertionError if the
+    crash point never fired (the phase was not reached) — a storm that
+    silently completed is a broken storm, not a passed one."""
+    from redisson_tpu.server.migration import CoordinatorKilled, migrate_slots
+
+    try:
+        migrate_slots(
+            source, target, slots,
+            journal_dir=journal_dir, crash_after=phase, password=password,
+        )
+    except CoordinatorKilled:
+        return
+    raise AssertionError(f"crash_after={phase!r} did not fire")
+
+
+def sigkill_at_phase(
+    sup: ClusterSupervisor,
+    victim: NodeProc,
+    source: str,
+    target: str,
+    slots: Sequence[int],
+    phase: str,
+    sig: int = signal.SIGKILL,
+) -> Optional[int]:
+    """The cross-process double-kill: coordinator dies at `phase` (journal
+    frozen at that exact state), THEN the victim server process is killed.
+    Returns the victim's exit code (negative signal number).  Recovery is
+    the caller's move: ``sup.restart(victim)`` +
+    ``resume_migrations(sup.journal_dir)``."""
+    crash_coordinator_at(
+        source, target, slots, sup.journal_dir, phase, password=sup.password
+    )
+    return sup.kill(victim, sig)

@@ -119,6 +119,13 @@ def _snapshot_records(engine) -> List[Dict[str, Any]]:
                     "kind": rec.kind,
                     "meta": dict(rec.meta),
                     "version": rec.version,
+                    # creation identity MUST survive a restore: replication
+                    # and migration transfers compare (nonce, version), and
+                    # a restored record minted a fresh nonce would read as
+                    # "recreated" — apply_records would then install its
+                    # STALE state over a peer's newer copy of the same
+                    # lineage (the restored-source fork, ISSUE 6 soak)
+                    "nonce": rec.nonce,
                     "expire_at": rec.expire_at,
                     "host_pickled": pickle.dumps(rec.host, protocol=4),
                     "arrays": arrays,
@@ -314,6 +321,11 @@ def load(engine, path: str) -> int:
             version=r["version"],
             expire_at=r["expire_at"],
         )
+        if "nonce" in r:
+            # restore is NOT a recreation: keep the record's creation
+            # identity so peers still recognize this lineage (legacy
+            # checkpoints without the field keep the fresh nonce)
+            rec.nonce = r["nonce"]
         with engine.locked(r["name"]):
             engine.store.put(r["name"], rec)
         n += 1

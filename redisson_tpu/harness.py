@@ -11,12 +11,16 @@ ServerThreads (hermetic, works on the CPU backend) — chaos tests call
 from __future__ import annotations
 
 import socket
-import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Tuple
 
+from redisson_tpu.cluster import topology as _topology
 from redisson_tpu.net.resp import RespError
 from redisson_tpu.server.server import ServerThread
-from redisson_tpu.utils.crc16 import MAX_SLOT
+
+# THE slot-assignment program (cluster/topology.py): shared verbatim with
+# the process-level ClusterSupervisor so the in-process and multi-process
+# cluster shapes cannot drift in how the 16384 slots map onto masters
+split_slots = _topology.split_slots
 
 
 def _exec(conn, *args, timeout: Optional[float] = None):
@@ -30,17 +34,6 @@ def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
-
-
-def split_slots(n: int) -> List[Tuple[int, int]]:
-    """Even slot partition (the reference's create-cluster default layout)."""
-    per = MAX_SLOT // n
-    ranges = []
-    for i in range(n):
-        lo = i * per
-        hi = MAX_SLOT - 1 if i == n - 1 else (i + 1) * per - 1
-        ranges.append((lo, hi))
-    return ranges
 
 
 class ClusterNode:
@@ -86,22 +79,27 @@ class ClusterRunner:
     # -- topology management --------------------------------------------------
 
     def view_tuples(self) -> List[Tuple[int, int, str, int, str]]:
-        return [
-            (lo, hi, m.server.server.host, m.port, m.server.server.node_id)
-            for (lo, hi), m in zip(self.slot_ranges, self.masters)
-            if not m.stopped
-        ]
+        return _topology.view_tuples(
+            self.slot_ranges,
+            [
+                None if m.stopped else
+                (m.server.server.host, m.port, m.server.server.node_id)
+                for m in self.masters
+            ],
+        )
 
     def install_view(self) -> None:
-        """Push the slot map to every live node (CLUSTER SETVIEW)."""
-        flat: List = []
-        for lo, hi, h, p, nid in self.view_tuples():
-            flat += [lo, hi, h, p, nid]
-        for node in self.masters + self.replicas:
-            if node.stopped:
-                continue
-            with node.server.client() as c:
-                _exec(c, "CLUSTER", "SETVIEW", *flat)
+        """Push the slot map to every live node (CLUSTER SETVIEW) — through
+        the shared topology program (cluster/topology.install_view)."""
+        _topology.install_view(
+            [
+                node.server.client
+                for node in self.masters + self.replicas
+                if not node.stopped
+            ],
+            self.view_tuples(),
+            timeout=None,
+        )
 
     def wire_replicas(self) -> None:
         for node in self.replicas:
@@ -110,8 +108,9 @@ class ClusterRunner:
             master = self.masters[node.master_index]
             if master.stopped:
                 continue
-            with node.server.client() as c:
-                _exec(c, "REPLICAOF", master.server.server.host, master.port, timeout=120.0)
+            _topology.wire_replica(
+                node.server.client, master.server.server.host, master.port
+            )
 
     # -- chaos ops (RedisRunner stop()/restart() analog) ----------------------
 

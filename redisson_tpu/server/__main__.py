@@ -1,3 +1,5 @@
+"""``python -m redisson_tpu.server`` — the tpu-server CLI entry point the
+ClusterSupervisor spawns one OS process of per node (cluster/supervisor.py)."""
 from redisson_tpu.server.server import main
 
-main()
+raise SystemExit(main())

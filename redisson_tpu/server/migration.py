@@ -173,6 +173,52 @@ def resume_migrations(
     return out
 
 
+def rearm_recovery(server, journal_dir: str) -> int:
+    """Boot-time journal re-arm for a RESTARTED server process (ISSUE 6).
+
+    A node SIGKILLed mid-migration loses its in-memory window state; its
+    restored checkpoint may resurrect records the pre-crash drain already
+    shipped to the target.  If the fresh process served those slots
+    normally, two processes would accept writes for the same records (the
+    restored stale lineage here, the shipped lineage there) and whichever
+    fork loses the resumed drain's version reconciliation would silently
+    drop acked writes.  So, BEFORE the listener answers its first command,
+    the restart path replays the journal directory:
+
+      * this node is the SOURCE of an in-flight migration — re-fence the
+        epoch, re-arm the MIGRATING window (``resume_migrations``' drain
+        needs it) and mark every slot RECOVERING: all keyed traffic gets
+        ``TRYAGAIN`` until the resumed migration reaches STABLE (writes
+        held off entirely: brief unavailability instead of a silent fork);
+      * this node is the TARGET — re-fence the epoch and re-arm the
+        IMPORTING window so in-flight ASK traffic is admitted again.
+
+    Returns the number of slot windows re-armed.  Wired to the CLI as
+    ``tpu-server --journal-dir`` (the ClusterSupervisor passes its
+    coordinator journal dir to every node it spawns).
+    """
+    n = 0
+    addr = server.address()
+    for journal in MigrationJournal.in_flight(journal_dir):
+        planned = journal.entry("PLANNED")
+        if planned is None:
+            continue
+        slots = [int(s) for s in planned["slots"]]
+        epoch = journal.epoch
+        if planned["source"] == addr:
+            for s in slots:
+                server.fence_slot_epoch(s, epoch)
+                server.set_slot_migrating(s, planned["target"])
+                server.set_slot_recovering(s, planned["target"])
+                n += 1
+        elif planned["target"] == addr:
+            for s in slots:
+                server.fence_slot_epoch(s, epoch)
+                server.set_slot_importing(s, planned["source"])
+                n += 1
+    return n
+
+
 class _MigrationRun:
     """One migration as an explicit state machine: phase methods shared by
     the fresh path (``execute``) and the journal-replay paths

@@ -186,6 +186,15 @@ class TpuServer:
         #   importing_slots: slot -> source "host:port" (this node receives)
         self.migrating_slots: Dict[int, str] = {}
         self.importing_slots: Dict[int, str] = {}
+        # crash-recovery fence (ISSUE 6): slots whose journaled migration
+        # was in flight when THIS process last died (rearm_recovery at
+        # boot).  Until resume_migrations settles the journal, every keyed
+        # command in such a slot answers TRYAGAIN — serving the restored
+        # (possibly stale) copies would fork the record lineage against the
+        # copies the pre-crash drain already shipped to the target, and one
+        # fork's acked writes would silently lose the version race when the
+        # resumed drain reconciles them.  SETSLOT STABLE clears it.
+        self.recovering_slots: Dict[int, str] = {}
         # per-slot migration fencing (ISSUE 4 journaled migrations): the
         # highest EPOCH this node accepted for each slot's SETSLOT/
         # MIGRATESLOTS traffic.  A resumed coordinator re-issues its
@@ -332,6 +341,14 @@ class TpuServer:
             ask_target = None
             for key in C.command_keys(cmd, args):
                 slot = calc_slot(key)
+                if slot in self.recovering_slots:
+                    # interrupted-migration fence: neither the restored
+                    # local copy nor an ASK hop is safe until the journal
+                    # resume settles the slot (see recovering_slots above)
+                    raise RespError(
+                        f"TRYAGAIN slot {slot} recovering from an "
+                        "interrupted migration"
+                    )
                 if self.owns_slot(slot):
                     target = self.migrating_slots.get(slot)
                     if target is not None:
@@ -397,9 +414,13 @@ class TpuServer:
     def set_slot_importing(self, slot: int, source: str) -> None:
         self.importing_slots[slot] = source
 
+    def set_slot_recovering(self, slot: int, target: str) -> None:
+        self.recovering_slots[slot] = target
+
     def set_slot_stable(self, slot: int) -> None:
         self.migrating_slots.pop(slot, None)
         self.importing_slots.pop(slot, None)
+        self.recovering_slots.pop(slot, None)  # resume settled the journal
         if not self.migrating_slots:
             self.engine.store.absent_guard = None
 
@@ -861,6 +882,56 @@ class TpuServer:
         async with self._server:
             await self._server.serve_forever()
 
+    async def serve_until_signal(self, ready_fd: Optional[int] = None,
+                                 journal_dir: Optional[str] = None):
+        """CLI serve loop: run until SIGTERM **or** SIGINT — both are the
+        graceful path (supervisors send SIGTERM; only the SIGINT/Ctrl-C
+        route used to reach the AutoCheckpointer flush-on-stop, which left
+        SIGTERM'd deployments losing their last interval of writes).
+
+        ``ready_fd``: once the listener is bound (port 0 resolved), write
+        one line — ``READY <host> <port> <pid>`` — to this inherited file
+        descriptor and close it.  The ClusterSupervisor awaits that line
+        instead of sleep-polling the port (cluster/supervisor.py)."""
+        import os
+        import signal as _signal
+
+        loop = asyncio.get_running_loop()
+        stopped = asyncio.Event()
+        installed = []
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stopped.set)
+                installed.append(sig)
+            except (NotImplementedError, RuntimeError):  # non-main thread /
+                pass                                     # exotic loop
+        await self.start_async()
+        if journal_dir is not None:
+            # BEFORE the ready line goes out (supervised clients gate on
+            # it): re-arm migration windows this node was a party to when
+            # it last died — restored copies of mid-migration slots must
+            # answer TRYAGAIN, not serve a forked lineage (see
+            # migration.rearm_recovery)
+            from redisson_tpu.server.migration import rearm_recovery
+
+            rearm_recovery(self, journal_dir)
+        if ready_fd is not None:
+            line = f"READY {self.host} {self.port} {os.getpid()}\n".encode()
+            try:
+                os.write(ready_fd, line)
+            finally:
+                try:
+                    os.close(ready_fd)
+                except OSError:
+                    pass
+        try:
+            async with self._server:
+                await stopped.wait()
+        finally:
+            for sig in installed:
+                loop.remove_signal_handler(sig)
+            self.stop()
+
     def stop(self):
         # parked blocking verbs (_block_loop, WAIT) poll this to unpark:
         # a forever-blocked worker would otherwise survive pool shutdown
@@ -1029,6 +1100,23 @@ def main(argv=None):
              "readback blocks its connection's read loop — the serial "
              "reference path for A/B measurement",
     )
+    ap.add_argument(
+        "--workers", type=int, default=4,
+        help="data-plane worker threads (the per-connection dispatch pool)",
+    )
+    ap.add_argument(
+        "--ready-fd", type=int, default=None,
+        help="inherited fd to write one 'READY <host> <port> <pid>' line to "
+             "once the listener is bound (the ClusterSupervisor's readiness "
+             "protocol; with --port 0 this reports the kernel-chosen port)",
+    )
+    ap.add_argument(
+        "--journal-dir", default=None,
+        help="migration-journal directory to consult at boot: in-flight "
+             "migrations naming this node re-arm their windows and fence "
+             "their slots RECOVERING until resume_migrations settles them "
+             "(the crashed-node restart discipline, migration.rearm_recovery)",
+    )
     args = ap.parse_args(argv)
     if args.checkpoint_interval > 0 and not args.checkpoint:
         ap.error("--checkpoint-interval requires --checkpoint <path>")
@@ -1050,11 +1138,17 @@ def main(argv=None):
         password=args.password,
         checkpoint_path=args.checkpoint,
         overlap=not args.no_overlap,
+        workers=args.workers,
     )
     if args.restore and args.checkpoint:
         from redisson_tpu.core import checkpoint
 
-        checkpoint.load(engine, args.checkpoint)
+        # a fresh boot has nothing to restore yet — the supervisor restart
+        # path passes --restore unconditionally once a checkpoint dir exists
+        import os as _os
+
+        if _os.path.exists(args.checkpoint):
+            checkpoint.load(engine, args.checkpoint)
     if args.prewarm:
         engine.prewarm()
     checkpointer = None
@@ -1065,12 +1159,17 @@ def main(argv=None):
             engine, args.checkpoint, args.checkpoint_interval
         ).start()
     try:
-        asyncio.run(srv.serve_forever())
+        # SIGTERM and SIGINT both land on the graceful path (the supervisor
+        # stops nodes with SIGTERM; see serve_until_signal)
+        asyncio.run(srv.serve_until_signal(
+            ready_fd=args.ready_fd, journal_dir=args.journal_dir,
+        ))
     finally:
         if checkpointer is not None:
             # flush-on-stop: writes since the last interval tick reach disk
             # even on Ctrl-C / SIGTERM-driven exit
             checkpointer.stop()
+    return 0
 
 
 if __name__ == "__main__":

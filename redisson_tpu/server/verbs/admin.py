@@ -143,6 +143,25 @@ def cmd_cluster(server, ctx, args):
             server.set_slot_stable(slot)
             return "+OK"
         raise RespError("ERR SETSLOT expects MIGRATING|IMPORTING|STABLE|NODE")
+    if sub == b"WINDOWS":
+        # live migration-window state, over the wire: the cross-process
+        # soak (chaos/soak.py ClusterProcSoakHarness) asserts "all slots
+        # STABLE" on real server processes, where reaching into
+        # server.migrating_slots directly is impossible by design.
+        # Reply: [["MIGRATING", slot, target], ..., ["IMPORTING", slot, src]]
+        out = [
+            [b"MIGRATING", s, t.encode()]
+            for s, t in sorted(server.migrating_slots.items())
+        ]
+        out += [
+            [b"IMPORTING", s, src.encode()]
+            for s, src in sorted(server.importing_slots.items())
+        ]
+        out += [
+            [b"RECOVERING", s, t.encode()]
+            for s, t in sorted(server.recovering_slots.items())
+        ]
+        return out
     if sub == b"COUNTKEYSINSLOT":
         return len(server.slot_names(_int(args[1])))
     if sub == b"GETKEYSINSLOT":

@@ -1,0 +1,246 @@
+"""Process-level cluster plane (ISSUE 6): ClusterSupervisor, real-TCP
+serving, ready-line protocol, SIGTERM/SIGKILL/SIGSTOP chaos, cross-process
+journaled-migration recovery.
+
+Every supervisor here spawns REAL ``python -m redisson_tpu.server`` OS
+processes (the RedisRunner discipline: SURVEY's 2,095 tests run against
+live server processes).  The fast tier keeps one shared 2-master cluster
+plus a couple of dedicated single-purpose spawns; the full
+kill-at-every-phase matrix and the endurance soak live under
+``@pytest.mark.slow``.
+"""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.cluster import ClusterSupervisor, split_slots
+from redisson_tpu.cluster import topology
+from redisson_tpu.net.client import CommandTimeoutError, Connection
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.utils.crc16 import MAX_SLOT, calc_slot
+
+
+@pytest.fixture(scope="module")
+def sup():
+    s = ClusterSupervisor(masters=2, platform="cpu").start()
+    yield s
+    s.shutdown()
+
+
+def _key_in_range(lo, hi, prefix="pk"):
+    return next(
+        k for k in (f"{prefix}-{i}" for i in range(3000))
+        if lo <= calc_slot(k.encode()) <= hi
+    )
+
+
+# -- topology: one source of truth -------------------------------------------
+
+def test_topology_is_single_source_of_truth():
+    """harness.ClusterRunner and cluster.ClusterSupervisor must share the
+    slot-assignment program VERBATIM — not a copy that can drift."""
+    from redisson_tpu import harness
+
+    assert harness.split_slots is topology.split_slots
+    ranges = split_slots(8)
+    assert ranges[0][0] == 0 and ranges[-1][1] == MAX_SLOT - 1
+    # contiguous, non-overlapping, fully covering
+    for (lo_a, hi_a), (lo_b, _hi_b) in zip(ranges, ranges[1:]):
+        assert lo_b == hi_a + 1
+    rows = topology.view_tuples(
+        split_slots(2), [("h1", 1, "n1"), ("h2", 2, "n2")]
+    )
+    assert rows == [(0, 8191, "h1", 1, "n1"), (8192, 16383, "h2", 2, "n2")]
+    # a stopped master drops its range (the failover hole)
+    rows = topology.view_tuples(split_slots(2), [None, ("h2", 2, "n2")])
+    assert rows == [(8192, 16383, "h2", 2, "n2")]
+    assert topology.flatten_view(rows) == [8192, 16383, "h2", 2, "n2"]
+    with pytest.raises(ValueError):
+        topology.view_tuples(split_slots(2), [("h1", 1, "n1")])
+
+
+# -- spawn / ready / serve ----------------------------------------------------
+
+def test_spawn_ready_and_serve_over_tcp(sup):
+    """Ready-line protocol learned each node's kernel-chosen port; both
+    shards serve keyed commands over real TCP; logs and identities exist."""
+    for node in sup.masters:
+        assert node.alive()
+        assert node.port > 0          # resolved from READY line, not guessed
+        assert node.node_id           # CLUSTER MYID round-tripped
+        assert os.path.exists(node.log_path)
+        assert node.generation == 1
+    client = sup.client(scan_interval=0)
+    try:
+        for mi, (lo, hi) in enumerate(sup.slot_ranges):
+            k = _key_in_range(lo, hi, prefix=f"serve{mi}")
+            client.execute("SET", k, f"v{mi}")
+            assert bytes(client.execute("GET", k)) == f"v{mi}".encode()
+    finally:
+        client.shutdown()
+    # the two nodes are genuinely separate OS processes
+    pids = {n.pid for n in sup.masters}
+    assert len(pids) == 2 and os.getpid() not in pids
+
+
+def test_sigstop_freezes_sigcont_thaws(sup):
+    """SIGSTOP is the real hung-but-accepting failure mode: the listener
+    stays up (kernel), nothing answers; SIGCONT resumes service."""
+    node = sup.masters[1]
+    sup.pause(node)
+    try:
+        with pytest.raises((CommandTimeoutError, OSError)):
+            c = Connection(node.host, node.port, connect_timeout=5.0, timeout=1.5)
+            try:
+                c.execute("PING")
+            finally:
+                c.close()
+    finally:
+        sup.resume(node)
+    with sup.conn(node) as c:
+        assert bytes(c.execute("PING")) == b"PONG"
+    assert node.alive()
+
+
+def test_kill_restart_idempotency_and_exit_codes(sup):
+    """SIGKILL records the signal death; restart revives on the SAME port;
+    a second restart of a healthy node is a no-op (exit codes captured)."""
+    node = sup.masters[1]
+    port = node.port
+    gen = node.generation
+    rc = sup.kill(node)                      # SIGKILL
+    assert rc == -signal.SIGKILL
+    assert node.exit_codes[-1] == -signal.SIGKILL
+    assert not node.alive()
+    sup.restart(node)
+    assert node.alive() and node.port == port
+    assert node.generation == gen + 1
+    pid = node.pid
+    sup.restart(node)                        # idempotent: healthy -> no-op
+    assert node.pid == pid and node.generation == gen + 1
+    with sup.conn(node) as c:
+        assert bytes(c.execute("PING")) == b"PONG"
+    # the restarted process rejoined the cluster view
+    client = sup.client(scan_interval=0)
+    try:
+        assert client.wait_routable(timeout=30.0)
+        lo, hi = sup.slot_ranges[1]
+        k = _key_in_range(lo, hi, prefix="revive")
+        client.execute("SET", k, "back")
+        assert bytes(client.execute("GET", k)) == b"back"
+    finally:
+        client.shutdown()
+
+
+# -- SIGTERM: graceful exit with checkpoint flush -----------------------------
+
+def test_sigterm_flushes_checkpoint_and_exits_zero():
+    """The supervisor's stop path is SIGTERM; the server must treat it like
+    SIGINT — AutoCheckpointer flush-on-stop — so the last interval of
+    writes survives a graceful stop (exit code 0, loadable checkpoint)."""
+    s = ClusterSupervisor(masters=1, platform="cpu",
+                          checkpoint_interval=3600.0).start()
+    try:
+        node = s.masters[0]
+        with s.conn(node) as c:
+            assert c.execute("SET", "durable", "42") is not None
+        rc = s.stop(node)
+        assert rc == 0, s.log_tail(node)
+        assert os.path.exists(node.checkpoint_path)
+        from redisson_tpu.core import checkpoint
+        from redisson_tpu.core.engine import Engine
+
+        engine = Engine()
+        assert checkpoint.load(engine, node.checkpoint_path) >= 1
+        assert engine.store.get("durable") is not None
+    finally:
+        s.shutdown()
+
+
+# -- journal re-arm fence (unit: no subprocess needed) ------------------------
+
+def test_rearm_recovery_fences_restored_source(tmp_path):
+    """A restarted source consults the journal dir at boot: in-flight
+    migrations re-arm their windows, re-fence their epochs, and mark the
+    slots RECOVERING — keyed traffic answers TRYAGAIN until resume
+    stabilizes them (the restored-copy fork guard)."""
+    from redisson_tpu.server.migration import rearm_recovery
+    from redisson_tpu.server.migration_journal import MigrationJournal
+    from redisson_tpu.server.server import TpuServer
+
+    srv = TpuServer(port=7001)
+    srv.host, srv.port = "127.0.0.1", 7001
+    addr = srv.address()
+    slot = calc_slot(b"fencekey")
+    srv.cluster_view = [(0, MAX_SLOT - 1, "127.0.0.1", 7001, srv.node_id)]
+    j = MigrationJournal.create(str(tmp_path), addr, "127.0.0.1:7002")
+    j.append("PLANNED", source=addr, target="127.0.0.1:7002",
+             slots=[slot], epoch=j.epoch, old_view=[], new_view=[])
+    j.append("WINDOW_OPEN")
+    assert rearm_recovery(srv, str(tmp_path)) == 1
+    assert srv.migrating_slots[slot] == "127.0.0.1:7002"
+    assert srv.recovering_slots[slot] == "127.0.0.1:7002"
+    assert srv.slot_epochs[slot] == j.epoch
+    with pytest.raises(RespError, match="TRYAGAIN"):
+        srv.check_routing("GET", [b"fencekey"])
+    # resume's SETSLOT STABLE clears the fence
+    srv.set_slot_stable(slot)
+    srv.check_routing("GET", [b"fencekey"])  # serves again
+    # a TARGET node re-arms its importing window instead
+    tgt = TpuServer(port=7002)
+    tgt.host, tgt.port = "127.0.0.1", 7002
+    assert rearm_recovery(tgt, str(tmp_path)) == 1
+    assert tgt.importing_slots[slot] == addr
+    assert not tgt.recovering_slots
+    # terminal journals re-arm nothing
+    j.append("STABLE")
+    fresh = TpuServer(port=7001)
+    fresh.host, fresh.port = "127.0.0.1", 7001
+    assert rearm_recovery(fresh, str(tmp_path)) == 0
+    srv.stop(), tgt.stop(), fresh.stop()
+
+
+# -- cross-process kill-at-phase: fast smoke + slow matrix --------------------
+
+def test_cross_process_kill_mid_drain_smoke():
+    """Tier-1 smoke of the acceptance property: SIGKILL the source master
+    mid-drain (coordinator dead at DRAINING:1) over real TCP, supervisor
+    restart + --restore + journal re-arm, resume_migrations terminalizes,
+    zero acked-durable-write loss, exactly-one-owner, all slots STABLE."""
+    from redisson_tpu.chaos.soak import (
+        ClusterProcSoakConfig, ClusterProcSoakHarness,
+    )
+
+    report = ClusterProcSoakHarness(ClusterProcSoakConfig(
+        cycles=1, crash_phases=("DRAINING:1",), keys=12, bloom_keys=128,
+    )).run()
+    assert report.cycles_completed == 1
+    assert report.server_sigkills == 1
+    assert report.resumed_completed == 1
+    assert report.verified_writes > 0
+    assert report.bloom_keys_verified == 128
+    assert -signal.SIGKILL in report.exit_codes
+
+
+@pytest.mark.slow
+def test_cross_process_kill_at_every_phase():
+    """The full matrix across a real process boundary: coordinator death +
+    source SIGKILL at PLANNED (resume rolls back), WINDOW_OPEN, mid-DRAIN,
+    and VIEW_COMMITTED (resume completes forward) — two cycles, so the
+    second cycle storms the topology the first one flipped."""
+    from redisson_tpu.chaos.soak import (
+        ClusterProcSoakConfig, ClusterProcSoakHarness,
+    )
+
+    report = ClusterProcSoakHarness(ClusterProcSoakConfig(
+        cycles=2,
+        crash_phases=("PLANNED", "WINDOW_OPEN", "DRAINING:1", "VIEW_COMMITTED"),
+    )).run()
+    assert report.cycles_completed == 2
+    assert report.server_sigkills == 8
+    assert report.resumed_rolled_back >= 2   # every PLANNED death rolls back
+    assert report.resumed_completed >= 4
+    assert report.bloom_keys_verified == 2 * 512

@@ -359,3 +359,18 @@ def test_perf_gate_passes_self_and_fails_known_regression(tmp_path):
         assert gate.main(["--fresh", str(p), "--baseline", r5]) == want, (
             key, factor,
         )
+
+    # config5p (ISSUE 6): absent from the r05 baseline — FIRST sight must
+    # pass (n/a row, the fresh number becomes the next baseline) ...
+    doc = copy.deepcopy(base)
+    doc["details"]["config5p_cluster_proc_ops_per_sec"] = 515_000
+    first = tmp_path / "fresh_5p_first.json"
+    first.write_text(json.dumps(doc))
+    assert gate.main(["--fresh", str(first), "--baseline", r5]) == 0
+    # ... and once recorded, a >5% drop GATES
+    for factor, want in ((0.94, 1), (0.96, 0)):
+        doc2 = copy.deepcopy(doc)
+        doc2["details"]["config5p_cluster_proc_ops_per_sec"] = 515_000 * factor
+        p = tmp_path / f"fresh_5p_{factor}.json"
+        p.write_text(json.dumps(doc2))
+        assert gate.main(["--fresh", str(p), "--baseline", str(first)]) == want

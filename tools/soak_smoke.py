@@ -13,11 +13,20 @@
     no slot left non-STABLE, bit-identical record contents, checkpoint
     generation fallback, flat census.  One kill-resume cycle runs in well
     under 60s.
+  * ``cluster-proc`` — the PROCESS-LEVEL profile (ISSUE 6): real
+    ``tpu-server`` OS processes under a ClusterSupervisor serve a mixed
+    write stream over real TCP while the coordinator dies at a journal
+    phase AND the source master takes an actual SIGKILL; the supervisor
+    restarts it (``--restore`` + journal re-arm) and ``resume_migrations``
+    settles the journal across the process boundary.  Asserts zero
+    acked-durable-write loss, exactly-one-owner residency, all slots
+    STABLE, acked bloom adds intact.  One two-phase cycle runs in well
+    under 60s.
 
 Usage:
-    JAX_PLATFORMS=cpu python tools/soak_smoke.py [--profile standard|migration]
-                                                 [--cycles N] [--seed S]
-                                                 [--phase SECONDS] [--no-kill]
+    JAX_PLATFORMS=cpu python tools/soak_smoke.py \
+        [--profile standard|migration|cluster-proc]
+        [--cycles N] [--seed S] [--phase SECONDS] [--no-kill]
 
 Exit code 0 = every assertion held; the report summary prints either way.
 """
@@ -37,7 +46,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--profile", choices=("standard", "migration"),
+    ap.add_argument("--profile",
+                    choices=("standard", "migration", "cluster-proc"),
                     default="standard")
     ap.add_argument("--cycles", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
@@ -51,7 +61,19 @@ def main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
-    if args.profile == "migration":
+    if args.profile == "cluster-proc":
+        from redisson_tpu.chaos.soak import (
+            ClusterProcSoakConfig, ClusterProcSoakHarness,
+        )
+
+        harness = ClusterProcSoakHarness(ClusterProcSoakConfig(
+            cycles=args.cycles, seed=args.seed,
+            # smoke = the sharpest single phase (SIGKILL mid-drain); the
+            # full phase matrix runs in tests/test_cluster_proc.py's slow
+            # tier — one phase keeps the smoke inside its 60s budget
+            crash_phases=("DRAINING:1",),
+        ))
+    elif args.profile == "migration":
         from redisson_tpu.chaos.soak import (
             MigrationSoakConfig, MigrationSoakHarness,
         )
