@@ -624,6 +624,143 @@ def bench_config2a_async_parity():
         st.stop()
 
 
+def bench_config6_tracking():
+    """Config 6: server-assisted client tracking (ISSUE 7) — N remote
+    clients, zipf-distributed reads at a 99% read ratio over a shared
+    bucket working set, identical op streams with the near-cache plane OFF
+    then ON.  Two numbers:
+
+      * ``config6_server_op_reduction`` — server ops per issued op with
+        tracking off / on (>=10x target: reads are local until someone
+        writes, so the server only sees writes + post-invalidation
+        refetches + cold misses);
+      * ``config6_tracked_read_ops_per_sec`` — client-observed throughput
+        of the tracked phase (most reads never touch the wire).
+
+    CPU-only by design: the tracked workload is host-side buckets — the
+    point is wire/dispatch elimination, not device throughput."""
+    import threading
+
+    from redisson_tpu.client.remote import RemoteRedisson
+    from redisson_tpu.server.server import ServerThread
+
+    n_clients = 8
+    n_keys = 512
+    read_ratio = 0.99
+    zipf_s = 1.0
+    rng = np.random.default_rng(17)
+    # zipf over the finite key domain: p_i ~ 1/(i+1)^s
+    p = 1.0 / np.power(np.arange(1, n_keys + 1), zipf_s)
+    p /= p.sum()
+
+    st = ServerThread(port=0, workers=8).start()
+    try:
+        addr = f"{st.server.host}:{st.server.port}"
+        from redisson_tpu.client.codec import DEFAULT_CODEC
+
+        seed = RemoteRedisson(addr, timeout=60.0)
+        seed.execute_many(
+            [("SET", f"c6:{i}", DEFAULT_CODEC.encode(b"v0")) for i in range(n_keys)]
+        )
+        seed.shutdown()
+
+        def run_phase(tracked: bool, ops_per_client: int):
+            clients = [RemoteRedisson(addr, timeout=60.0) for _ in range(n_clients)]
+            handles = []
+            for c in clients:
+                if tracked:
+                    # NOLOOP: a client's own writes seed its own cache (the
+                    # excludedId own-write discipline) instead of costing a
+                    # push + refetch round trip
+                    plane = c.enable_tracking(cache_entries=4 * n_keys, noloop=True)
+                    hs = [plane.get_bucket(f"c6:{i}") for i in range(n_keys)]
+                    # steady-state serving measurement: warm each client's
+                    # near cache with one full pass OUTSIDE the timed window
+                    # (every other config warms compiles/caches the same way)
+                    for h in hs:
+                        h.get()
+                    handles.append(hs)
+                else:
+                    handles.append([c.get_bucket(f"c6:{i}") for i in range(n_keys)])
+            # pre-generated per-client streams: same distribution both phases
+            streams = []
+            for ci in range(n_clients):
+                idx = rng.choice(n_keys, size=ops_per_client, p=p)
+                writes = rng.random(ops_per_client) >= read_ratio
+                streams.append((idx, writes))
+            start = threading.Barrier(n_clients + 1)
+            errors = []
+
+            def worker(ci):
+                hs = handles[ci]
+                idx, writes = streams[ci]
+                try:
+                    start.wait()
+                    for j in range(len(idx)):
+                        h = hs[idx[j]]
+                        if writes[j]:
+                            h.set(b"w%d-%d" % (ci, j))
+                        else:
+                            h.get()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=worker, args=(ci,), daemon=True)
+                for ci in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+            before = st.server.stats["commands"]
+            start.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            server_ops = st.server.stats["commands"] - before
+            for c in clients:
+                c.shutdown()
+            if errors:
+                raise errors[0]
+            issued = n_clients * ops_per_client
+            return {
+                "issued_ops": issued,
+                "server_ops": server_ops,
+                "wall_s": round(wall, 3),
+                "ops_per_sec": round(issued / wall) if wall > 0 else 0,
+                "server_ops_per_issued": server_ops / issued,
+            }
+
+        # OFF phase: every read is a wire RPC, so a shorter stream suffices
+        # (the metric is server ops PER ISSUED OP, not the wall clock)
+        off = run_phase(tracked=False, ops_per_client=4_000)
+        on = run_phase(tracked=True, ops_per_client=20_000)
+        reduction = (
+            off["server_ops_per_issued"] / on["server_ops_per_issued"]
+            if on["server_ops_per_issued"] > 0 else float("inf")
+        )
+        log(
+            f"config6: {n_clients} clients x zipf(s={zipf_s}) over {n_keys} "
+            f"buckets @ {read_ratio:.0%} reads — tracking OFF "
+            f"{off['server_ops_per_issued']:.3f} server-ops/op "
+            f"({off['ops_per_sec']/1e3:.1f}k ops/s), ON "
+            f"{on['server_ops_per_issued']:.4f} server-ops/op "
+            f"({on['ops_per_sec']/1e3:.1f}k ops/s) -> reduction {reduction:.1f}x"
+        )
+        return {
+            "config6_server_op_reduction": round(reduction, 2),
+            "config6_tracked_read_ops_per_sec": on["ops_per_sec"],
+            "clients": n_clients,
+            "keys": n_keys,
+            "read_ratio": read_ratio,
+            "zipf_s": zipf_s,
+            "off": off,
+            "on": on,
+        }
+    finally:
+        st.stop()
+
+
 def _init_jax():
     """Per-process JAX setup: persistent compile cache (the big kernels cost
     ~10s of XLA compile each; cached programs make re-runs near-instant)."""
@@ -710,6 +847,8 @@ def child(which: str) -> None:
         result["cluster_mixed_ops_per_sec"] = round(bench_config5_cluster_mixed())
     elif which == "2A":
         result["async_parity"] = bench_config2a_async_parity()
+    elif which == "6":
+        result["tracking"] = bench_config6_tracking()
     else:
         client = redisson_tpu.create()
         try:
@@ -748,7 +887,7 @@ def main():
     import subprocess
 
     results: dict = {}
-    for which in ("2", "2L", "2A", "1", "3", "4", "5", "5p"):
+    for which in ("2", "2L", "2A", "1", "3", "4", "5", "5p", "6"):
         p = subprocess.run(
             [sys.executable, __file__, "--config", which],
             stdout=subprocess.PIPE,
@@ -783,6 +922,9 @@ def main():
                     "config5p_cluster_proc_ops_per_sec": results["5p"]["cluster_proc_mixed_ops_per_sec"],
                     "config5p_native_ab": results["5p"]["native_ab"],
                     "config5p_server_platform": results["5p"]["server_platform"],
+                    "config6_server_op_reduction": results["6"]["tracking"]["config6_server_op_reduction"],
+                    "config6_tracked_read_ops_per_sec": results["6"]["tracking"]["config6_tracked_read_ops_per_sec"],
+                    "config6_tracking": results["6"]["tracking"],
                     "baseline_model": "k=7 GETBITs @ 1M pipelined ops/s/core = 143k contains/s",
                     "tunnel_h2d_mb_per_sec": {
                         w: r["h2d_mb_s"] for w, r in results.items() if "h2d_mb_s" in r

@@ -78,11 +78,21 @@ class ResourceCensus:
                 "connections": server.stats["connections"],
                 "repl_baselines": 0,
                 "repl_replicas": 0,
+                "tracking_table_keys": 0,
+                "tracking_conns": 0,
+                "tracking_bcast_conns": 0,
             }
             src = server._replication
             if src is not None:
                 out["repl_baselines"] = len(src._baseline)
                 out["repl_replicas"] = len(src._replicas)
+            # client-tracking table (tracking/table.py): sizes must drain to
+            # 0 on connection death — a tracked key outliving its connection
+            # is a leak, and the soak's disconnect-cleanup assertion
+            tracking = getattr(server, "tracking", None)
+            if tracking is not None:
+                for k, v in tracking.census().items():
+                    out[f"tracking_{k}" if not k.startswith("tracking") else k] = v
             return out
 
         self.track(name, probe)
@@ -101,6 +111,8 @@ class ResourceCensus:
 
     def track_client(self, name: str, client) -> None:
         def probe() -> Dict[str, float]:
+            from redisson_tpu.net import client as _net
+
             nodes = []
             node = getattr(client, "node", None)
             if node is not None:
@@ -110,11 +122,20 @@ class ResourceCensus:
                 for e in entries():
                     nodes.append(e.master)
                     nodes.extend(e.replicas.values())
-            return {
+            out = {
                 "conn_in_use": sum(n.pool.in_use for n in nodes),
                 "conn_idle": sum(n.pool.idle_count() for n in nodes),
                 "node_clients": len(nodes),
+                # orphaned RESP3 pushes dropped (process-global): any growth
+                # means a push reached a connection with no handler — a
+                # mis-routed invalidation or pubsub frame (ISSUE 7 satellite)
+                "dropped_pushes": float(_net.dropped_push_count()),
+                "near_cache_entries": 0,
             }
+            plane = getattr(client, "tracking", None)
+            if plane is not None:
+                out["near_cache_entries"] = float(len(plane.cache))
+            return out
 
         self.track(name, probe)
 

@@ -772,6 +772,9 @@ class ClusterRedisson(RemoteSurface):
         svc = self.__dict__.get("_elements_service")
         if svc is not None:
             svc.shutdown()
+        plane = self.__dict__.get("tracking")
+        if plane is not None:
+            plane.close()
         if self._dns is not None:
             self._dns.stop()
         with self._lock:

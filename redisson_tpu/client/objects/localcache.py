@@ -39,6 +39,15 @@ class SyncStrategy:
     NONE = "NONE"
     INVALIDATE = "INVALIDATE"
     UPDATE = "UPDATE"
+    # Server-assisted mode (ISSUE 7): coherence rides the CLIENT TRACKING
+    # invalidation plane (tracking/) instead of the hand-rolled topic —
+    # writers need not publish anything; the server remembers which
+    # connections read the map and pushes RESP3 invalidations on write.
+    # Wire handles (client/remote.py RemoteLocalCachedMap) require the
+    # facade's tracking plane (client.enable_tracking()); the EMBEDDED
+    # handle below has no wire, so it degrades to INVALIDATE topic
+    # semantics — in-process peers are coherent either way.
+    TRACKING = "TRACKING"
 
 
 class ReconnectionStrategy:
@@ -188,6 +197,8 @@ class LocalCachedMap(Map):
         if s == SyncStrategy.NONE:
             return
         if kind == "upd" and s != SyncStrategy.UPDATE:
+            # TRACKING degrades to INVALIDATE on the embedded handle (no
+            # wire between in-process peers; see SyncStrategy.TRACKING)
             kind, payload = "inv", [ek for ek, _ in payload]
         self._engine.pubsub.publish(self._channel, (kind, self._cache_id, payload))
 

@@ -38,6 +38,9 @@ import threading as _threading
 _renewal_timer = None
 _renewal_pool = None
 _renewal_guard = _threading.Lock()
+# first-enable CAS for RemoteSurface.enable_tracking (shared across facades:
+# the op is once-per-facade, contention is nil)
+_tracking_enable_lock = _threading.Lock()
 
 
 def _client_renewal_infra():
@@ -1028,7 +1031,15 @@ class RemoteLocalCachedMap:
         # mutations ride the PLAIN map: this handle owns its own broadcasts
         self._proxy = RemoteObjectProxy(client, "get_map", name)
         self._sync_strategy = self._opts.sync_strategy
-        self._sync = self._sync_strategy != SyncStrategy.NONE
+        # TRACKING mode (ISSUE 7): coherence rides the server-assisted
+        # invalidation plane — no topic subscription, no write broadcasts.
+        # Every OBJCALL read registers the map name on its (tracked) data
+        # connection server-side; any write by anyone pushes an invalidate
+        # down the facade's feed, which clears this handle's cache.
+        self._tracking_mode = self._sync_strategy == SyncStrategy.TRACKING
+        self._sync = (
+            self._sync_strategy != SyncStrategy.NONE and not self._tracking_mode
+        )
         # generation counter: a fetch only populates the cache if no
         # invalidation arrived while it was in flight (the wire analog of the
         # embedded handle's read+populate under the record lock)
@@ -1036,11 +1047,30 @@ class RemoteLocalCachedMap:
         self.hits = 0
         self.misses = 0
         self._pubsub = None
-        if self._sync:
+        self._tracking_listener = None
+        if self._tracking_mode:
+            plane = getattr(client, "tracking", None)
+            if plane is None:
+                raise RuntimeError(
+                    "SyncStrategy.TRACKING requires the facade's tracking "
+                    "plane: call client.enable_tracking() first"
+                )
+            self._tracking_plane = plane
+            self._tracking_listener = plane.add_name_listener(
+                name, self._on_tracking_invalidate
+            )
+        elif self._sync:
             # subscribe on the shard that owns the MAP (not the channel
             # string): that is where OBJCALL mutations execute and publish
             self._pubsub = client.pubsub_for(name)
             self._pubsub.subscribe(self._channel, self._on_wire_sync)
+
+    def _on_tracking_invalidate(self, _name) -> None:
+        # record-level granularity: any write to the map drops the whole
+        # near copy (the plane cannot see which entry changed); _gen guards
+        # in-flight fetches exactly like the topic path
+        self._gen += 1
+        self._cache.clear()
 
     # -- invalidation feed ----------------------------------------------------
 
@@ -1156,47 +1186,115 @@ class RemoteLocalCachedMap:
 
     # -- writes (mutate shared map, update own cache, notify peers) -----------
 
+    def _seed_own_write(self) -> bool:
+        """May a write populate its own cache?  TRACKING mode: NO — without
+        NOLOOP the server pops (or, for a write with no prior read, never
+        held) our registration when it applies the write, so nothing
+        guarantees a later foreign write ever invalidates the seed; WITH
+        NOLOOP the self-pushes that would order concurrent own-writes are
+        suppressed, and the map-wide ``_gen`` guard cannot tell two own
+        writers apart — the loser of the server-side race could cache its
+        overwritten value with nothing left to correct it (review fix; the
+        tracked-handle seed in TrackedBucket.set survives this because the
+        NearCache generation is per NAME and invalidate drops entries).
+        Topic mode seeds like the reference (excludedId scheme)."""
+        return not self._tracking_mode
+
+    def _own_invalidate(self, eks) -> None:
+        """Drop our local copies after an own write, bumping ``_gen`` FIRST:
+        a concurrent get() that fetched the PRE-write value must fail its
+        populate guard, or it would re-cache the stale value right after
+        this invalidate — and under tracking+NOLOOP the suppressed
+        self-push would never correct it (review fix)."""
+        self._gen += 1
+        for ek in eks:
+            self._cache.invalidate(ek)
+
+    def _invalidate_on_error(self, eks) -> None:
+        """A raised wire write may still have APPLIED (lost reply) — drop
+        the local copies: under tracking+NOLOOP the self-push is suppressed
+        and in topic mode the broadcast never went out, so nothing else
+        would ever correct a stale cached value."""
+        self._own_invalidate(eks)
+
     def put(self, key, value):
-        old = self._proxy.put(key, value)
+        # gen-guarded like get(): an invalidation landing between the wire
+        # write and the populate (our own push, or a foreign writer's)
+        # voids the populate instead of caching over it
+        gen = self._gen
+        seed = self._seed_own_write()
+        try:
+            old = self._proxy.put(key, value)
+        except BaseException:
+            self._invalidate_on_error([self._ek(key)])
+            raise
         ek = self._ek(key)
-        self._cache.put(ek, value)
+        if not seed:
+            self._own_invalidate([ek])
+        elif self._gen == gen and not self._disabled:
+            self._cache.put(ek, value)
         self._broadcast("upd", [(ek, self._codec.encode_map_value(value))])
         return old
 
     def fast_put(self, key, value) -> bool:
-        created = self._proxy.fast_put(key, value)
+        gen = self._gen
+        seed = self._seed_own_write()
+        try:
+            created = self._proxy.fast_put(key, value)
+        except BaseException:
+            self._invalidate_on_error([self._ek(key)])
+            raise
         ek = self._ek(key)
-        self._cache.put(ek, value)
+        if not seed:
+            self._own_invalidate([ek])
+        elif self._gen == gen and not self._disabled:
+            self._cache.put(ek, value)
         self._broadcast("upd", [(ek, self._codec.encode_map_value(value))])
         return created
 
     def put_all(self, entries: Dict) -> None:
-        self._proxy.put_all(entries)
+        gen = self._gen
+        seed = self._seed_own_write()
+        try:
+            self._proxy.put_all(entries)
+        except BaseException:
+            self._invalidate_on_error([self._ek(k) for k in entries])
+            raise
         payload = []
+        populate = seed and self._gen == gen and not self._disabled
+        if not seed:
+            self._own_invalidate([self._ek(k) for k in entries])
         for k, v in entries.items():
             ek = self._ek(k)
-            self._cache.put(ek, v)
+            if populate:
+                self._cache.put(ek, v)
             payload.append((ek, self._codec.encode_map_value(v)))
         self._broadcast("upd", payload)
 
     def remove(self, key):
-        old = self._proxy.remove(key)
         ek = self._ek(key)
-        self._cache.invalidate(ek)
+        try:
+            old = self._proxy.remove(key)
+        finally:
+            self._own_invalidate([ek])
         self._broadcast("inv", [ek])
         return old
 
     def fast_remove(self, *keys) -> int:
-        n = self._proxy.fast_remove(*keys)
         eks = [self._ek(k) for k in keys]
-        for ek in eks:
-            self._cache.invalidate(ek)
+        try:
+            n = self._proxy.fast_remove(*keys)
+        finally:
+            self._own_invalidate(eks)
         self._broadcast("inv", eks)
         return n
 
     def clear(self) -> None:
-        self._proxy.clear()
-        self._cache.clear()
+        try:
+            self._proxy.clear()
+        finally:
+            self._gen += 1  # void in-flight populates (see _own_invalidate)
+            self._cache.clear()
         if self._sync:
             blob = pickle.dumps(("clear", self._cache_id), protocol=4)
             self._client.publish_for(self.name, self._channel, blob)
@@ -1207,6 +1305,11 @@ class RemoteLocalCachedMap:
         if self._pubsub is not None:
             self._pubsub.remove_listener(self._channel, self._on_wire_sync)
             self._pubsub = None
+        if self._tracking_listener is not None:
+            self._tracking_plane.remove_name_listener(
+                self.name, self._tracking_listener
+            )
+            self._tracking_listener = None
         self._cache.clear()
 
     def __getattr__(self, method: str):
@@ -1220,6 +1323,30 @@ class RemoteSurface:
     cluster client: every factory only talks through the transport seam
     (execute / execute_many / objcall / pubsub_for / caller_id), so the same
     handle classes ride either routing."""
+
+    # the CLIENT TRACKING near-cache plane (tracking/nearcache.py), None
+    # until enable_tracking() arms it
+    tracking = None
+
+    def enable_tracking(self, **kw) -> "Any":
+        """Arm server-assisted client tracking for this facade: every pooled
+        data connection redirects its invalidation stream to the node's
+        dedicated feed connection, and the returned ``ClientTracking``
+        plane's handles (``get_bucket``/``get_map``/``get_set``/
+        ``get_bloom_filter``) answer repeat reads from a process-local
+        near cache until someone writes.  Idempotent (kwargs of the first
+        call win) — including under concurrent first calls: construction
+        arms feeds and registers invalidation listeners, so a racing loser
+        plane would leak its listeners for the process lifetime."""
+        plane = self.__dict__.get("tracking")
+        if plane is None:
+            with _tracking_enable_lock:
+                plane = self.__dict__.get("tracking")
+                if plane is None:
+                    from redisson_tpu.tracking.nearcache import ClientTracking
+
+                    plane = self.__dict__["tracking"] = ClientTracking(self, **kw)
+        return plane
 
     def caller_id(self) -> str:
         """This thread's synchronizer identity (uuid:threadId — the
@@ -1494,6 +1621,9 @@ class RemoteRedisson(RemoteSurface):
         svc = getattr(self, "_elements_service", None)
         if svc is not None:
             svc.shutdown()
+        plane = self.__dict__.get("tracking")
+        if plane is not None:
+            plane.close()
         self.node.close()
 
     def __enter__(self):

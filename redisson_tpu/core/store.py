@@ -58,6 +58,20 @@ class DeviceStore:
         # that makes drain-vs-access races lose no acked state
         # (server/server.py _migration_absent_guard).
         self.absent_guard: Optional[Callable[[str], None]] = None
+        # Hook fired with the NAMES of expired records the store just
+        # reaped (lazily on access or by reap_expired) — the client-tracking
+        # plane invalidates near caches through it exactly like a DEL
+        # (server/server.py wires it to TrackingTable.note_expired).
+        # Contract: the callback must not reenter the store (lazy-expiry
+        # sites fire while the store lock is held by reentrant callers).
+        self.on_expired: Optional[Callable[[list], None]] = None
+
+    def _reaped(self, name: str) -> None:
+        if self.on_expired is not None:
+            try:
+                self.on_expired([name])
+            except Exception:  # noqa: BLE001 — expiry must never fail a read
+                pass
 
     def get(self, name: str) -> Optional[StateRecord]:
         with self._lock:
@@ -65,6 +79,7 @@ class DeviceStore:
             if rec is not None and rec.expired():
                 del self._states[name]
                 rec = None
+                self._reaped(name)
             if rec is None and self.absent_guard is not None:
                 self.absent_guard(name)
             return rec
@@ -123,6 +138,7 @@ class DeviceStore:
             rec = self._states.get(name)
             if rec is not None and rec.expired():
                 del self._states[name]
+                self._reaped(name)
                 return None
             return rec
 
@@ -171,12 +187,17 @@ class DeviceStore:
 
     def reap_expired(self) -> int:
         now = time.time()
-        n = 0
+        reaped = []
         with self._lock:
             for name in [n_ for n_, r in self._states.items() if r.expired(now)]:
                 del self._states[name]
-                n += 1
-        return n
+                reaped.append(name)
+        if reaped and self.on_expired is not None:
+            try:
+                self.on_expired(reaped)
+            except Exception:  # noqa: BLE001 — sweep must survive hook bugs
+                pass
+        return len(reaped)
 
     def flushall(self) -> None:
         with self._lock:

@@ -53,6 +53,19 @@ def install_fault_plane(plane):
     return prev
 
 
+# Process-global count of ORPHANED pushes: RESP3 push frames that arrived on
+# a connection with no push_handler installed.  The old behavior consumed
+# such a frame as the next pipeline reply — desyncing every subsequent
+# command on the connection (ISSUE 7 satellite).  Now they drop, visibly:
+# per-connection `dropped_pushes` plus this aggregate, exposed as a census/
+# metrics gauge via dropped_push_count().
+PUSH_DROPS = {"count": 0}
+
+
+def dropped_push_count() -> int:
+    return PUSH_DROPS["count"]
+
+
 def parse_address(addr: str) -> Tuple[str, int]:
     """tpu://host:port (also accepts tpus://, redis://, rediss://, bare)."""
     for prefix in ("tpus://", "tpu://", "rediss://", "redis://"):
@@ -123,6 +136,7 @@ class Connection:
 
         self._pending: "deque" = deque()  # decoded frames awaiting delivery
         self.push_handler: Optional[Callable[[Push], None]] = None
+        self.dropped_pushes = 0  # orphaned pushes dropped (no handler)
         plane = _fault_plane
         if plane is not None:
             plane.on_connect(host, port)  # may raise ConnectionRefusedError
@@ -174,8 +188,15 @@ class Connection:
         while True:
             while self._pending:
                 value = self._pending.popleft()
-                if isinstance(value, Push) and self.push_handler is not None:
-                    self.push_handler(value)
+                if isinstance(value, Push):
+                    if self.push_handler is not None:
+                        self.push_handler(value)
+                    else:
+                        # orphaned push (no handler): consuming it as the
+                        # next pipeline reply would desync every later
+                        # command on this connection — drop it, counted
+                        self.dropped_pushes += 1
+                        PUSH_DROPS["count"] += 1
                     continue
                 return value
             remaining = deadline - time.monotonic()
@@ -297,11 +318,51 @@ class PubSubConnection:
         )
         self._listeners: Dict[str, List[Callable[[str, bytes], None]]] = {}
         self._plisteners: Dict[str, List[Callable[[str, str, bytes], None]]] = {}
+        # CLIENT TRACKING invalidation listeners: fn(keys) with keys =
+        # [bytes, ...] or None (flush-everything).  This dedicated reader-
+        # thread connection is the natural REDIRECT target — its stable
+        # client id is captured BEFORE the reader starts (after that, the
+        # reader owns all replies on this socket).
+        self._inv_listeners: List[Callable] = []
+        # fired (once) when this connection stops being able to deliver
+        # pushes — transport error OR explicit close().  The near-cache
+        # plane's reconnection-CLEAR hook: an invalidation stream that ENDS
+        # (for any reason: node death, topology refresh retiring the entry)
+        # leaves every cache fed by it uninvalidatable, so the plane must
+        # flush either way; it distinguishes its own shutdown itself.
+        self.on_disconnect: Optional[Callable[["PubSubConnection"], None]] = None
+        self._disc_fired = False
         self._lock = threading.RLock()
+        # pre-CLIENT-ID servers reply an error value -> feed works, just
+        # not usable as a REDIRECT target.  Transport failures (timeout,
+        # reset) must PROPAGATE instead: a live feed stuck with
+        # client_id=None would make every tracking conn_setup against this
+        # node fail with no recovery path, since _ensure_feed keeps
+        # handing back the same poisoned feed until its socket dies
+        try:
+            reply = self._conn.execute("CLIENT", "ID")
+        except BaseException:
+            self._conn.close()  # constructor aborts: do not leak the socket
+            raise
+        self.client_id: Optional[int] = (
+            None if isinstance(reply, RespError) else int(reply)
+        )
         self._conn.push_handler = self._on_push
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._reader, daemon=True, name="rtpu-pubsub")
         self._thread.start()
+
+    def add_invalidation_listener(self, fn: Callable) -> Callable:
+        with self._lock:
+            self._inv_listeners.append(fn)
+        return fn
+
+    def remove_invalidation_listener(self, fn: Callable) -> None:
+        with self._lock:
+            try:
+                self._inv_listeners.remove(fn)
+            except ValueError:
+                pass
 
     def subscribe(self, channel: str, listener: Callable[[str, bytes], None]) -> None:
         with self._lock:
@@ -337,18 +398,6 @@ class PubSubConnection:
                 del self._listeners[channel]
                 self._conn.send("UNSUBSCRIBE", channel)
 
-    def resubscribe_on(self, conn: Connection) -> None:
-        """Re-attach all subscriptions on a fresh connection (the watchdog's
-        pubsub re-attach, ConnectionWatchdog.java:85-175)."""
-        with self._lock:
-            old, self._conn = self._conn, conn
-            old.close()
-            conn.push_handler = self._on_push
-            for channel in self._listeners:
-                conn.send("SUBSCRIBE", channel)
-            for pattern in self._plisteners:
-                conn.send("PSUBSCRIBE", pattern)
-
     def channels(self) -> List[str]:
         with self._lock:
             return list(self._listeners)
@@ -367,6 +416,17 @@ class PubSubConnection:
                 listeners = list(self._plisteners.get(pattern, ()))
             for fn in listeners:
                 fn(pattern, channel, push[3])
+        elif kind == b"invalidate":
+            # CLIENT TRACKING invalidation frame: >2 invalidate [key...]
+            # (payload None = FLUSHALL / flush-everything)
+            keys = push[1] if len(push) > 1 else None
+            with self._lock:
+                listeners = list(self._inv_listeners)
+            for fn in listeners:
+                try:
+                    fn(keys)
+                except Exception:  # noqa: BLE001 — listener bugs must not
+                    pass           # kill push delivery for the connection
 
     def _reader(self) -> None:
         while not self._stop.is_set() and not self._conn.closed:
@@ -377,12 +437,36 @@ class PubSubConnection:
             except CommandTimeoutError:
                 continue
             except (ConnectionError, OSError):
-                return  # watchdog (NodeClient) owns reconnect
+                # watchdog (NodeClient) owns reconnect; the tracking plane's
+                # reconnection-CLEAR discipline hangs off this edge (a feed
+                # that died may have dropped invalidations — near caches
+                # must flush, not serve through the gap)
+                if not self._stop.is_set():
+                    self._fire_disconnect()
+                return
+
+    def _fire_disconnect(self) -> None:
+        with self._lock:
+            if self._disc_fired:
+                return
+            self._disc_fired = True
+            cb = self.on_disconnect
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — hook bugs stay contained
+                pass
 
     def close(self) -> None:
         self._stop.set()
         self._conn.close()
         self._thread.join(timeout=2)
+        # an ARMED feed closing for ANY reason ends its invalidation stream:
+        # the plane must hear about it (it ignores the event once the whole
+        # facade is shutting down) — a topology refresh retiring this
+        # node's entry would otherwise strand every cache entry whose
+        # server-side registration redirected here, silently stale
+        self._fire_disconnect()
 
 
 class ConnectionPool:
@@ -397,28 +481,50 @@ class ConnectionPool:
         size: int = 8,
         min_idle: int = 1,
         idle_timeout: float = 60.0,
+        defer_warmup: bool = False,
     ):
         self._factory = factory
         self._size = size
         self._min_idle = min(min_idle, size)
         self._idle_timeout = idle_timeout
+        # release-time admission filter: return False to RETIRE the
+        # connection instead of pooling it (the tracking plane uses this to
+        # drain connections armed against a dead invalidation feed — their
+        # server-side tracking state is gone, so pooling them would let
+        # untracked reads populate near caches invisibly)
+        self.release_filter: Optional[Callable[[Connection], bool]] = None
         self._sem = threading.Semaphore(size)
         self._idle: List[Tuple[Connection, float]] = []  # (conn, idle-since)
         self._lock = threading.Lock()
         self.in_use = 0  # CommandsLoadBalancer feed (least in-flight picks)
         self._closed = False
-        # min-idle warm-up is BEST-EFFORT: a client to a temporarily-down
-        # node must still construct (failure detectors, coordinators, and
-        # the watchdog all hold clients to nodes that may be down right
-        # now) — the connect error surfaces on first acquire() instead
-        for _ in range(self._min_idle):
-            try:
-                self._idle.append((factory(), time.monotonic()))
-            except (ConnectionError, OSError):
-                break
+        if not defer_warmup:
+            self.warm()
         self._reaper: Optional[threading.Timer] = None
         if idle_timeout and idle_timeout > 0:
             self._schedule_reap()
+
+    def warm(self) -> None:
+        """Best-effort min-idle warm-up: a client to a temporarily-down
+        node must still construct (failure detectors, coordinators, and
+        the watchdog all hold clients to nodes that may be down right
+        now) — the connect error surfaces on first acquire() instead.
+        Deferred (``defer_warmup=True``) by owners whose connection factory
+        needs the pool attribute already assigned (NodeClient's conn_setup
+        hook runs inside the factory)."""
+        for _ in range(self._min_idle - self.idle_count()):
+            try:
+                conn = self._factory()
+            except (ConnectionError, OSError):
+                break
+            # the reaper may already be armed (defer_warmup path): an
+            # unlocked append racing _reap's list reassignment would drop
+            # the conn from tracking with its socket open
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    break
+                self._idle.append((conn, time.monotonic()))
 
     def _schedule_reap(self) -> None:
         # the timer must not keep an abandoned pool alive: hold the pool by
@@ -464,6 +570,13 @@ class ConnectionPool:
                 "'connection_pool_size' or reduce concurrency"
             )
         with self._lock:
+            # a CLOSED pool must never mint connections: a retired shard
+            # entry (topology refresh) is unreachable from shutdown(), so a
+            # socket opened here would outlive the client — and keep its
+            # server-side tracking state pinned (a census leak)
+            if self._closed:
+                self._sem.release()
+                raise ConnectionError("connection pool is closed")
             self.in_use += 1
             while self._idle:
                 conn, _since = self._idle.pop()
@@ -478,11 +591,38 @@ class ConnectionPool:
             raise
 
     def release(self, conn: Connection) -> None:
+        if not conn.closed and self.release_filter is not None:
+            try:
+                if not self.release_filter(conn):
+                    conn.close()
+            except Exception:  # noqa: BLE001 — a filter bug must not leak slots
+                pass
+        retire = False
         with self._lock:
             self.in_use -= 1
             if not conn.closed:
-                self._idle.append((conn, time.monotonic()))
+                if self._closed:
+                    # released after close() (holder raced a topology-refresh
+                    # retirement): the idle sweep already ran, nothing will
+                    # ever close this conn again — retire it now
+                    retire = True
+                else:
+                    self._idle.append((conn, time.monotonic()))
+        if retire:
+            conn.close()
         self._sem.release()
+
+    def clear_idle(self) -> None:
+        """Close every idle connection NOW (fresh acquires reconnect through
+        the factory).  The re-arm half of the tracking plane's reconnection
+        discipline: after the invalidation feed changes, pooled connections
+        must re-handshake so their CLIENT TRACKING REDIRECT points at the
+        live feed."""
+        with self._lock:
+            victims = [c for c, _since in self._idle]
+            self._idle.clear()
+        for c in victims:
+            c.close()
 
     def discard(self, conn: Connection) -> None:
         conn.close()
@@ -534,6 +674,7 @@ class NodeClient:
         events_hub=None,
         credentials_resolver=None,
         command_mapper=None,
+        conn_setup=None,
     ):
         self.address = address
         # CredentialsResolver SPI (config/CredentialsResolver): resolved PER
@@ -567,10 +708,22 @@ class NodeClient:
         self.retry_policy = retry_policy
         self.detector = detector or FailedNodeDetector()
         self.hooks = list(hooks or [])  # CommandHook SPI (utils/metrics.py)
+        # per-connection post-handshake hook, called as conn_setup(self,
+        # conn) on every FRESH pooled connection (the tracking plane arms
+        # CLIENT TRACKING REDIRECT here); installable after construction
+        self.conn_setup = conn_setup
         self._closed = threading.Event()
-        self.pool = ConnectionPool(self._connect, size=pool_size, min_idle=min_idle)
+        # pubsub state BEFORE the pool: the pool's min-idle warm-up calls
+        # _connect, whose conn_setup hook (tracking plane) may need
+        # self.pubsub() — the invalidation-feed connection
         self._pubsub: Optional[PubSubConnection] = None
         self._pubsub_lock = threading.Lock()
+        self.pool = ConnectionPool(
+            self._connect, size=pool_size, min_idle=min_idle, defer_warmup=True
+        )
+        # warm AFTER self.pool exists: the conn_setup hook (tracking plane)
+        # touches node.pool from inside the connection factory
+        self.pool.warm()
         self._ping_interval = ping_interval
         self._ping_thread: Optional[threading.Thread] = None
         if ping_interval and ping_interval > 0:
@@ -605,6 +758,16 @@ class NodeClient:
         self.detector.on_connect_successful()
         if self.events_hub is not None:
             self.events_hub.node_connected(self.address)
+        setup = self.conn_setup
+        if setup is not None:
+            try:
+                setup(self, conn)
+            except BaseException:
+                # a half-armed connection must not enter the pool: reads on
+                # it would look tracked to the caller but be invisible to
+                # the server's invalidation plane
+                conn.close()
+                raise
         return conn
 
     # -- command path --------------------------------------------------------

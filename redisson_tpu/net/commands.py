@@ -198,8 +198,17 @@ READ_METHOD_PREFIXES = (
 )
 
 
+# Read-PREFIXED method families that nonetheless mutate: get_and_* returns
+# the old value but installs a new one (AtomicLong.get_and_add,
+# Bucket.get_and_set, MapCache.get_and_put, ...).  Checked before the read
+# prefixes so these route to masters and invalidate tracked readers.
+WRITE_METHOD_PREFIXES = ("get_and_",)
+
+
 def objcall_is_write(method: str) -> bool:
     m = method.lower()
+    if any(m.startswith(p) for p in WRITE_METHOD_PREFIXES):
+        return True
     return not any(m.startswith(p) for p in READ_METHOD_PREFIXES)
 
 

@@ -209,7 +209,7 @@ def rearm_recovery(server, journal_dir: str) -> int:
             for s in slots:
                 server.fence_slot_epoch(s, epoch)
                 server.set_slot_migrating(s, planned["target"])
-                server.set_slot_recovering(s, planned["target"])
+                server.set_slot_recovering(s, planned["target"], epoch)
                 n += 1
         elif planned["target"] == addr:
             for s in slots:

@@ -85,6 +85,13 @@ class CommandContext:
         # to the strict RESP2 projection for compatibility clients
         self.proto: int = 3
         self.name: Optional[str] = None
+        # stable connection identity: CLIENT ID / TRACKING REDIRECT address
+        # this context for its whole life (the old per-call next_client_id
+        # minted a fresh id every CLIENT ID — useless as a redirect target)
+        self.client_id: int = server.next_client_id()
+        # per-connection tracking state (tracking/table.py ConnTracking);
+        # None until CLIENT TRACKING ON
+        self.tracking = None
         self.subscriptions: Dict[str, int] = {}
         self.psubscriptions: Dict[str, int] = {}
         self.push: Optional[Callable[[Any], None]] = None  # wired by the server
@@ -139,17 +146,51 @@ class Registry:
         if ctx.multi_queue is not None and cmd not in self._TX_IMMEDIATE:
             ctx.multi_queue.append([bytes(a) for a in args])
             return "+QUEUED"
+        # client-tracking hooks (tracking/table.py): `active` is an int load
+        # + compare, so a server with no tracking clients pays ~nothing.
+        # Reads register PRE-dispatch (a concurrent writer must see the
+        # registration or apply before our read); writes invalidate
+        # POST-dispatch (after the handler applied).
+        track = getattr(server, "tracking", None)
+        if track is not None and not track.active:
+            track = None
+        if track is not None:
+            track.pre_dispatch(ctx, cmd, args[1:])
         hooks = getattr(server, "hooks", None)
         if not hooks:
-            return handler(server, ctx, args[1:])
+            try:
+                result = handler(server, ctx, args[1:])
+            except BaseException:
+                # a raising write verb may have PARTIALLY applied (e.g. a
+                # multi-source merge that created its dest before a later
+                # WRONGTYPE): other clients' tracked entries must still
+                # invalidate — same possibly-applied discipline as the
+                # fused-BF error path.  A spurious push for a not-applied
+                # write costs one refetch; a skipped one is stale forever.
+                if track is not None:
+                    try:
+                        track.post_dispatch(ctx, cmd, args[1:])
+                    except Exception:
+                        pass  # never mask the primary error
+                raise
+            if track is not None:
+                track.post_dispatch(ctx, cmd, args[1:])
+            return result
         name = cmd.decode()
         tokens = run_hooks_start(hooks, name, args[1:])
         try:
             result = handler(server, ctx, args[1:])
         except BaseException as e:
             run_hooks_end(tokens, name, e)
+            if track is not None:  # possibly-applied (see no-hooks branch)
+                try:
+                    track.post_dispatch(ctx, cmd, args[1:])
+                except Exception:
+                    pass
             raise
         run_hooks_end(tokens, name, None)
+        if track is not None:
+            track.post_dispatch(ctx, cmd, args[1:])
         return result
 
 

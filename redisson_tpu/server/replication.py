@@ -284,8 +284,15 @@ def serialize_records(
     return _wire_payload(out, live if include_live else None), shipped
 
 
-def apply_records(engine, blob: bytes) -> int:
-    """Install shipped records (last-writer-wins by version). Returns #applied."""
+def apply_records(engine, blob: bytes, on_applied=None) -> int:
+    """Install shipped records (last-writer-wins by version). Returns #applied.
+
+    ``on_applied`` (optional) receives the list of names whose state this
+    frame actually changed (installed or pruned) AFTER the apply — the
+    client-tracking plane invalidates near caches through it: a record
+    arriving by migration import or replication push mutates the keyspace
+    exactly like a write, so tracked readers on THIS node must hear about
+    it (verbs/admin.py wires it to TrackingTable.note_write)."""
     from redisson_tpu.core.checkpoint import _loads
     from redisson_tpu.core.store import StateRecord
 
@@ -293,6 +300,7 @@ def apply_records(engine, blob: bytes) -> int:
 
     payload = _loads(_unwire_payload(blob))
     applied = 0
+    changed = []
     for item in payload["records"]:
         name = item["name"]
         nonce = item.get("nonce")
@@ -349,6 +357,7 @@ def apply_records(engine, blob: bytes) -> int:
             rec.expire_at = item["expire_at"]
             engine.store.put_unguarded(name, rec)
             applied += 1
+            changed.append(name)
     live = payload.get("live")
     if live is not None:
         # prune records the master no longer has (deletion propagation)
@@ -358,6 +367,12 @@ def apply_records(engine, blob: bytes) -> int:
         for n in stale:
             engine.store.delete_unguarded(n)
             applied += 1
+            changed.append(n)
+    if on_applied is not None and changed:
+        try:
+            on_applied(changed)
+        except Exception:  # noqa: BLE001 — invalidation fan-out must not
+            pass           # fail the transfer frame
     return applied
 
 

@@ -223,6 +223,24 @@ class TpuServer:
         self._pause_gate = threading.Event()
         self._pause_gate.set()
         self._client_ids = iter(range(1, 1 << 62))
+        # server-assisted client tracking (tracking/table.py): per-connection
+        # read-key memory + RESP3 invalidation pushes on write/expiry/
+        # FLUSHALL/slot handoff.  Always constructed (cheap); the dispatch
+        # hook costs one int load while no client has tracking on.
+        from redisson_tpu.tracking.table import TrackingTable
+
+        self.tracking = TrackingTable(self)
+        self.metrics.gauge("tracking_keys", self.tracking.tracked_key_count)
+        self.metrics.gauge(
+            "tracking_overflow_evictions",
+            lambda: self.tracking.stats["overflow_evictions"],
+        )
+        self.metrics.gauge(
+            "tracking_pushes", lambda: self.tracking.stats["pushes"]
+        )
+        # expiry invalidation: a key the TTL reaper (or a lazy-expiry read)
+        # drops must invalidate near caches exactly like a DEL would
+        self.engine.store.on_expired = self.tracking.note_expired
         # OBJCALL handle cache (ordered for LRU eviction; see registry)
         from collections import OrderedDict
 
@@ -259,6 +277,7 @@ class TpuServer:
             # before the scheduler lazily starts, report what it WILL use
             "eviction-min-delay": ev.min_delay if ev else cfg.min_cleanup_delay,
             "eviction-max-delay": ev.max_delay if ev else cfg.max_cleanup_delay,
+            "tracking-table-max-keys": self.tracking.max_keys,
         }
         return view
 
@@ -273,6 +292,12 @@ class TpuServer:
             return True
         if key == "checkpoint-path":
             self.checkpoint_path = value or None
+            return True
+        if key == "tracking-table-max-keys":
+            n = int(value)
+            if n <= 0:
+                return False
+            self.tracking.max_keys = n
             return True
         return False
 
@@ -414,15 +439,41 @@ class TpuServer:
     def set_slot_importing(self, slot: int, source: str) -> None:
         self.importing_slots[slot] = source
 
-    def set_slot_recovering(self, slot: int, target: str) -> None:
+    def set_slot_recovering(self, slot: int, target: str,
+                            epoch: Optional[int] = None) -> None:
         self.recovering_slots[slot] = target
+        # fence-first invalidation (the case Redis gets wrong-by-config): a
+        # RECOVERING slot's restored copies may be stale against what the
+        # pre-crash drain already shipped — every near cache drops the
+        # slot's keys BEFORE the slot serves anything again, stamped with
+        # THIS handoff's fencing epoch (the caller's, NOT the recorded
+        # slot_epochs high-water mark: an epoch-less handoff of a slot a
+        # PREVIOUS journaled migration fenced would otherwise be deduped
+        # against that stale record and emit nothing) so the resume
+        # re-issue is idempotent
+        # slot_names is a full store scan — only pay it when a tracking
+        # client could actually hear the invalidation (rearm_recovery calls
+        # this per in-flight slot BEFORE serving; with tracking idle the
+        # boot path must stay O(1))
+        self.tracking.invalidate_slot(
+            slot, epoch,
+            self.slot_names(slot) if self.tracking.active else None,
+        )
 
-    def set_slot_stable(self, slot: int) -> None:
+    def set_slot_stable(self, slot: int, epoch: Optional[int] = None) -> None:
+        migrated = slot in self.migrating_slots or slot in self.recovering_slots
         self.migrating_slots.pop(slot, None)
         self.importing_slots.pop(slot, None)
         self.recovering_slots.pop(slot, None)  # resume settled the journal
         if not self.migrating_slots:
             self.engine.store.absent_guard = None
+        if migrated:
+            # handoff finalized on the SOURCE: whatever the per-key drain
+            # stream didn't already invalidate (keys read-but-absent, keys
+            # registered after their ship) flushes here, stamped with THIS
+            # command's epoch — None (unfenced legacy migration) always
+            # emits, a journaled re-issue at its own epoch dedupes
+            self.tracking.invalidate_slot(slot, epoch)
 
     def slot_names(self, slot: int) -> List[str]:
         from redisson_tpu.utils.crc16 import calc_slot
@@ -501,6 +552,14 @@ class TpuServer:
                     link.execute("IMPORTRECORDS", blob, timeout=30.0)
                     self.engine.store.delete_unguarded(name)
                     moved += 1
+                    # drain-stream invalidation: the record just left this
+                    # node — a near cache serving it would miss every write
+                    # the target accepts from now on (push enqueue only, so
+                    # holding the record lock here is fine); active-guarded
+                    # like every other site so an idle-tracking migration
+                    # never touches the dispatch-shared table lock
+                    if self.tracking.active:
+                        self.tracking.note_write([name], None)
         finally:
             for link in links.values():
                 link.close()
@@ -528,6 +587,19 @@ class TpuServer:
             self._pause_gate.wait(timeout=60.0)
         return REGISTRY.dispatch(self, ctx, cmd)
 
+    def _fused_add_error_invalidate(self, track, run_names) -> None:
+        """A failed fused BF.MADD64 run may have PARTIALLY applied (that is
+        why add runs never re-dispatch) — tracked near caches holding
+        negative `contains` entries for these filters must still be
+        invalidated or they serve stale membership forever.  writer_ctx is
+        None deliberately: the writer's client-side wrapper aborted on the
+        error reply, so even a NOLOOP writer needs the push."""
+        if track is not None and run_names:
+            try:
+                track.note_write(run_names, None)
+            except Exception:  # noqa: BLE001 — never mask the primary error
+                pass
+
     def _dispatch_bloom_run(self, ctx, cmds):
         """Coalesced execution of a same-verb BF blob run inside one frame
         (the adaptive coalescing plane): ONE stacked-bank kernel dispatch for
@@ -542,6 +614,20 @@ class TpuServer:
         if not self._pause_gate.is_set():
             self._pause_gate.wait(timeout=60.0)
         is_add = bytes(cmds[0][0]).upper() == b"BF.MADD64"
+        # tracking hooks for the fused path (the fallback below re-dispatches
+        # through REGISTRY.dispatch, which carries its own hooks): probe runs
+        # register their filter names PRE-dispatch, add runs invalidate after
+        # the fused kernel applied
+        track = self.tracking if self.tracking.active else None
+        run_names = None
+        if track is not None:
+            seen = set()
+            run_names = [
+                n for n in (bytes(c[1]).decode() for c in cmds)
+                if not (n in seen or seen.add(n))
+            ]
+            if not is_add:
+                track.note_read(ctx, run_names)
         try:
             fused = coalesce_bloom_run(self, ctx, cmds)
         except RuntimeError as e:
@@ -550,17 +636,21 @@ class TpuServer:
                 # pool drops the connection, never replies per-command errors
                 raise ConnectionResetError(str(e)) from e
             if is_add:
+                self._fused_add_error_invalidate(track, run_names)
                 self.stats["errors"] += len(cmds)
                 enc = resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
                 return [_Encoded(enc) for _ in cmds]
             fused = None
         except Exception as e:  # noqa: BLE001 — per-run isolation
             if is_add:
+                self._fused_add_error_invalidate(track, run_names)
                 self.stats["errors"] += len(cmds)
                 enc = resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
                 return [_Encoded(enc) for _ in cmds]
             fused = None
         if fused is not None:
+            if track is not None and is_add:
+                track.note_write(run_names, ctx)
             return fused
         out = []
         for cmd in cmds:
@@ -613,6 +703,7 @@ class TpuServer:
         self.stats["connections"] += 1
         self._writers.add(writer)
         ctx = CommandContext(self)
+        self.tracking.register_conn(ctx)
         parser = resp.RespParser()
         loop = asyncio.get_running_loop()
         write_q: asyncio.Queue = asyncio.Queue()
@@ -813,6 +904,9 @@ class TpuServer:
         except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
             pass
         finally:
+            # tracking disconnect-cleanup FIRST: the table must not leak this
+            # conn's keys, and dependents redirecting here must break loudly
+            self.tracking.unregister_conn(ctx)
             for ch, lid in list(ctx.subscriptions.items()):
                 self.engine.pubsub.unsubscribe(ch, lid)
             for pat, lid in list(ctx.psubscriptions.items()):
@@ -950,7 +1044,10 @@ class TpuServer:
                     except Exception:  # noqa: BLE001
                         pass
 
-            loop.call_soon_threadsafe(shutdown)
+            try:
+                loop.call_soon_threadsafe(shutdown)
+            except RuntimeError:
+                pass  # loop already closed (repeated stop): nothing to do
         if self._replication is not None:
             self._replication.close()
         self._pool.shutdown(wait=False)

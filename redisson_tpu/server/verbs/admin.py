@@ -119,7 +119,7 @@ def cmd_cluster(server, ctx, args):
             server.set_slot_importing(slot, _s(rest[0]))
             return "+OK"
         if mode == b"STABLE":
-            server.set_slot_stable(slot)
+            server.set_slot_stable(slot, epoch)
             return "+OK"
         if mode == b"NODE":
             # finalize locally: point the slot at its new owner in this
@@ -140,7 +140,7 @@ def cmd_cluster(server, ctx, args):
                 else:
                     new_view.append((lo, hi, h, p, vnid))
             server.cluster_view = new_view
-            server.set_slot_stable(slot)
+            server.set_slot_stable(slot, epoch)
             return "+OK"
         raise RespError("ERR SETSLOT expects MIGRATING|IMPORTING|STABLE|NODE")
     if sub == b"WINDOWS":
@@ -197,13 +197,27 @@ def cmd_asking(server, ctx, args):
     return "+OK"
 
 
+def _tracking_invalidator(server):
+    """apply_records on_applied hook: transfer frames (migration imports,
+    replication pushes) mutate the keyspace exactly like writes, so tracked
+    readers on this node must invalidate — the hole that would otherwise
+    leave a near cache stale forever is a reader registered on the IMPORT
+    side while the record's newer state arrives by drain, not by verb."""
+    tracking = getattr(server, "tracking", None)
+    if tracking is None or not tracking.active:
+        return None
+    return lambda names: tracking.note_write(list(names), None)
+
+
 @register("IMPORTRECORDS")
 def cmd_importrecords(server, ctx, args):
     """Install migrated records (slot-migration transfer frame; the blob
     carries records only — no live-list pruning, unlike REPLPUSH)."""
     from redisson_tpu.server import replication
 
-    return replication.apply_records(server.engine, bytes(args[0]))
+    return replication.apply_records(
+        server.engine, bytes(args[0]), on_applied=_tracking_invalidator(server)
+    )
 
 
 # -- replication (server/replication.py) -------------------------------------
@@ -234,7 +248,10 @@ def cmd_replicaof(server, ctx, args):
     )
     try:
         blob = master.execute("REPLSNAPSHOT", timeout=60.0)
-        replication.apply_records(server.engine, bytes(blob))
+        replication.apply_records(
+            server.engine, bytes(blob),
+            on_applied=_tracking_invalidator(server),
+        )
         master.execute("REPLREGISTER", server.host, server.port)
     finally:
         master.close()
@@ -267,7 +284,9 @@ def cmd_replpush(server, ctx, args):
     # plain REPLPUSH, so seg-only sweeping would never fire here)
     with server._repl_xfers_lock:
         _reap_stale_xfers(server, time.monotonic())
-    return replication.apply_records(server.engine, bytes(args[0]))
+    return replication.apply_records(
+        server.engine, bytes(args[0]), on_applied=_tracking_invalidator(server)
+    )
 
 
 # staging eviction knobs (cmd_replpushseg): a transfer untouched for
@@ -322,7 +341,9 @@ def cmd_replpushseg(server, ctx, args):
             return "+OK"
         del xfers[xfer_id]
         blob = b"".join(entry[0])
-    return replication.apply_records(server.engine, blob)
+    return replication.apply_records(
+        server.engine, blob, on_applied=_tracking_invalidator(server)
+    )
 
 
 @register("REPLFLUSH")

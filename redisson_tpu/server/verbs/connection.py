@@ -80,7 +80,7 @@ def cmd_hello(server, ctx, args):
         b"server": b"redisson-tpu",
         b"version": VERSION.encode(),
         b"proto": ctx.proto,
-        b"id": server.next_client_id(),
+        b"id": ctx.client_id,
         b"mode": server.mode.encode(),
         b"role": b"master" if server.role == "master" else b"replica",
     }
@@ -101,7 +101,97 @@ def cmd_client(server, ctx, args):
     if sub == b"GETNAME":
         return ctx.name.encode() if ctx.name else b""
     if sub == b"ID":
-        return server.next_client_id()
+        # STABLE identity for this connection's whole life (the redirect
+        # target of CLIENT TRACKING REDIRECT; minting a fresh id per call
+        # made redirect impossible to express)
+        return ctx.client_id
+    if sub == b"INFO":
+        return _client_info_line(server, ctx)
+    if sub == b"TRACKING":
+        return _client_tracking(server, ctx, args[1:])
+    if sub == b"TRACKINGINFO":
+        st = server.tracking.state_of(ctx)
+        from redisson_tpu.tracking.table import ConnTracking
+
+        if st is None:
+            st = ConnTracking()
+        return {
+            b"flags": st.flags(),
+            b"redirect": st.redirect if st.redirect is not None else -1,
+            b"prefixes": [p.encode() for p in st.prefixes],
+            b"keys": st.nkeys,
+        }
+    return "+OK"
+
+
+def _client_info_line(server, ctx) -> bytes:
+    """CLIENT INFO: the Redis one-line key=value shape (the fields this
+    wire actually has; resp= is the negotiated protocol, tracking flags
+    from the table)."""
+    from redisson_tpu.tracking.table import ConnTracking
+
+    st = server.tracking.state_of(ctx)
+    flags = b"|".join((st or ConnTracking()).flags())
+    redirect = st.redirect if (st is not None and st.redirect) else -1
+    return (
+        f"id={ctx.client_id} name={ctx.name or ''} resp={ctx.proto} "
+        f"user={ctx.username or 'default'} "
+        f"tracking={flags.decode()} redirect={redirect} "
+        f"sub={len(ctx.subscriptions)} psub={len(ctx.psubscriptions)}"
+    ).encode()
+
+
+def _client_tracking(server, ctx, args):
+    """CLIENT TRACKING ON|OFF [REDIRECT <client-id>] [BCAST]
+    [PREFIX <prefix>]... [NOLOOP] — the server-assisted caching switch
+    (tracking/table.py; Redis 6 semantics for the options this wire
+    supports)."""
+    if not args:
+        raise RespError("ERR wrong number of arguments for 'client|tracking'")
+    mode = bytes(args[0]).upper()
+    if mode not in (b"ON", b"OFF"):
+        raise RespError("ERR syntax error in CLIENT TRACKING (ON|OFF expected)")
+    redirect = None
+    bcast = False
+    noloop = False
+    prefixes = []
+    i = 1
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"REDIRECT" and i + 1 < len(args):
+            redirect = _int(args[i + 1])
+            i += 2
+        elif opt == b"BCAST":
+            bcast = True
+            i += 1
+        elif opt == b"PREFIX" and i + 1 < len(args):
+            prefixes.append(_s(args[i + 1]))
+            i += 2
+        elif opt == b"NOLOOP":
+            noloop = True
+            i += 1
+        else:
+            raise RespError(f"ERR unknown CLIENT TRACKING option '{_s(args[i])}'")
+    if prefixes and not bcast:
+        raise RespError(
+            "ERR PREFIX option requires BCAST mode to be enabled"
+        )
+    if mode == b"OFF":
+        server.tracking.disable(ctx)
+        return "+OK"
+    if redirect == 0:
+        redirect = None  # Redis: REDIRECT 0 = no redirection
+    if redirect is None and ctx.proto < 3:
+        # Redis's own refusal: without RESP3 push frames the invalidation
+        # could only arrive as a PLAIN array interleaved into the reply
+        # stream, desyncing every later reply on this connection
+        raise RespError(
+            "ERR Client tracking is only supported in RESP3 mode or when "
+            "a redirection client is specified via the 'REDIRECT' option"
+        )
+    server.tracking.enable(
+        ctx, bcast=bcast, prefixes=prefixes, redirect=redirect, noloop=noloop
+    )
     return "+OK"
 
 

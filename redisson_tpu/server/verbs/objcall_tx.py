@@ -125,7 +125,7 @@ def cmd_objcallm(server, ctx, args):
     OBJCALL-bound cluster throughput.  Per-op routing errors (MOVED/ASK
     during a reshard) come back as tagged entries so the client re-routes
     just those ops."""
-    return _objcallm_run(server, args, atomic=False)
+    return _objcallm_run(server, ctx, args, atomic=False)
 
 
 @register("OBJCALLMA")
@@ -137,10 +137,10 @@ def cmd_objcallm_atomic(server, ctx, args):
     execution, no rollback of ops that already applied when a later op
     errors.  Cluster rule matches the reference: all object names must
     colocate on this node (use {hashtags})."""
-    return _objcallm_run(server, args, atomic=True)
+    return _objcallm_run(server, ctx, args, atomic=True)
 
 
-def _objcallm_run(server, args, atomic: bool):
+def _objcallm_run(server, ctx, args, atomic: bool):
     from redisson_tpu.net.safe_pickle import safe_loads
 
     ops = safe_loads(bytes(args[0]))
@@ -148,8 +148,16 @@ def _objcallm_run(server, args, atomic: bool):
     if atomic:
         names = sorted({str(op[1]) for op in ops if op[1]})
         with server.engine.locked_many(names):
-            return _objcallm_apply(server, ops, caller)
-    return _objcallm_apply(server, ops, caller)
+            result = _objcallm_apply(server, ops, caller)
+    else:
+        result = _objcallm_apply(server, ops, caller)
+    # the OBJCALLM frame is keyless on the wire, so the registry's generic
+    # tracking hook cannot see its keys — invalidate from the decoded ops
+    # (write-methods only; tracking/table.note_objcall_ops)
+    _track = getattr(server, "tracking", None)
+    if _track is not None and _track.active:
+        _track.note_objcall_ops(ops, ctx)
+    return result
 
 
 def _objcallm_apply(server, ops, caller):
@@ -365,6 +373,12 @@ def cmd_txexec(server, ctx, args):
                     f"TXCONFLICT object '{name}' changed concurrently "
                     f"(version {seen} -> {cur})"
                 )
-        return _objcallm_apply(server, ops, caller)
+        result = _objcallm_apply(server, ops, caller)
+    # commit applied: invalidate tracked readers of every written object
+    # (keyless frame — same rule as OBJCALLM above)
+    _track = getattr(server, "tracking", None)
+    if _track is not None and _track.active:
+        _track.note_objcall_ops(ops, ctx)
+    return result
 
 

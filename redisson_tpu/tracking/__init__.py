@@ -1,0 +1,18 @@
+"""Server-assisted client tracking: the RESP3 invalidation plane.
+
+Two halves, one protocol (Redis 6 ``CLIENT TRACKING`` reimagined for this
+wire — ISSUE 7 / ROADMAP "RESP3 client-side caching"):
+
+  * ``tracking/table.py`` — the SERVER side: a per-node ``TrackingTable``
+    remembers which connections read which keys (default mode, bounded with
+    synthetic-invalidation eviction) or which prefixes they subscribed
+    (BCAST mode, no per-key memory), and pushes RESP3
+    ``>2 invalidate [key...]`` frames on every mutating verb, expiry,
+    FLUSHALL, and slot-migration handoff.
+  * ``tracking/nearcache.py`` — the CLIENT side: one ``NearCache`` per
+    remote facade fed by the invalidation stream over a dedicated REDIRECT
+    connection, consulted by the read paths of buckets, maps, sets, the
+    generalized ``localcache`` TRACKING sync mode, and bloom negative
+    lookups.
+"""
+from redisson_tpu.tracking.table import ConnTracking, TrackingTable  # noqa: F401

@@ -99,3 +99,28 @@ def test_idle_connection_reaper():
     assert pool.idle_count() == 1, "idle conns beyond min_idle must be reaped"
     assert sum(1 for c in made if c.closed) >= 4
     pool.close()
+
+
+def test_closed_pool_retires_releases_and_refuses_acquires():
+    """A conn released AFTER pool.close() (the holder raced a topology-
+    refresh retirement) must close immediately — a closed pool is
+    unreachable from shutdown(), so pooling it would leak the socket and
+    pin its server-side tracking state forever.  And a closed pool must
+    never mint fresh connections through the factory."""
+    from redisson_tpu.net.client import ConnectionPool
+
+    class FakeConn:
+        def __init__(self):
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    pool = ConnectionPool(FakeConn, size=4, min_idle=0)
+    held = pool.acquire()
+    pool.close()
+    pool.release(held)
+    assert held.closed, "release after close() must retire the conn"
+    assert pool.idle_count() == 0
+    with pytest.raises(ConnectionError):
+        pool.acquire(timeout=1.0)

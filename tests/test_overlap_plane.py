@@ -392,3 +392,138 @@ def test_chaos_faults_during_inflight_readbacks():
             )
         finally:
             nc.close()
+
+
+# -- tracking pushes x readback frames (ISSUE 7 satellite) ---------------------
+
+
+def test_invalidation_pushes_between_inflight_readbacks_preserve_fifo():
+    """Invalidation pushes enqueued while 3 _PendingFrame readbacks are in
+    flight per connection ride the SAME completion queue: per-connection
+    reply FIFO must hold exactly (no push consumed as a reply, no reply
+    reordered around a resolved readback), and every push must surface as a
+    typed push frame on the handler."""
+    from redisson_tpu.net.client import Connection
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0) as st:
+        assert st.server.overlap
+        host, port = st.server.host, st.server.port
+        errors = []
+        stop = threading.Event()
+
+        def writer():
+            w = Connection(host, port, timeout=30.0)
+            try:
+                i = 0
+                while not stop.is_set():
+                    for k in range(4):
+                        w.execute("SET", f"tpk:{k}", b"v%d" % i)
+                    i += 1
+            finally:
+                w.close()
+
+        def worker(wid: int):
+            try:
+                pushes = []
+                conn = Connection(host, port, timeout=60.0)
+                conn.push_handler = pushes.append
+                try:
+                    assert conn.execute("CLIENT", "TRACKING", "ON") in (b"OK",)
+                    name = f"tpo:{wid}"
+                    assert conn.execute(
+                        "BF.RESERVE", name, 0.01, 5000, timeout=30.0
+                    ) in (b"OK",)
+                    inflight = []
+
+                    def check(item):
+                        tags, handle = item
+                        r = handle.get(timeout=60.0)
+                        # frame shape: [echo, madd, echo, mexists, get, echo]
+                        assert r[0] == tags[0] and r[2] == tags[1] and r[5] == tags[2]
+                        assert np.frombuffer(r[3], np.uint8).all()
+
+                    for f in range(8):
+                        keys = (
+                            np.arange(96, dtype=np.int64)
+                            + wid * 100_000 + f * 1000
+                        ) * 2654435761
+                        blob = np.ascontiguousarray(keys, "<i8").tobytes()
+                        tags = [f"w{wid}f{f}c{i}".encode() for i in range(3)]
+                        cmds = [
+                            ("ECHO", tags[0]),
+                            ("BF.MADD64", name, blob),
+                            ("ECHO", tags[1]),
+                            ("BF.MEXISTS64", name, blob),
+                            # the tracked read RE-REGISTERS the key each
+                            # frame, so the writer keeps generating pushes
+                            # that interleave with the pending readbacks
+                            ("GET", f"tpk:{wid % 4}"),
+                            ("ECHO", tags[2]),
+                        ]
+                        inflight.append((tags, conn.execute_many_lazy(cmds)))
+                        if len(inflight) > 3:  # 3 readback frames in flight
+                            check(inflight.pop(0))
+                    for item in inflight:
+                        check(item)
+                    # pushes surfaced as typed pushes, never as replies
+                    assert all(
+                        bytes(p[0]) == b"invalidate" for p in pushes
+                    ), pushes[:3]
+                    assert conn.dropped_pushes == 0
+                finally:
+                    conn.close()
+            except Exception as e:  # noqa: BLE001 — surfaced on main thread
+                errors.append((wid, repr(e)))
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        stop.set()
+        wt.join(timeout=30.0)
+        assert not errors, errors
+
+
+def test_push_proto_snapshot_across_hello_downgrade():
+    """A push encodes with the connection's proto AT PUSH TIME: frames (and
+    pushes) produced before a later HELLO 2 stay RESP3-typed; after the
+    downgrade the same invalidation arrives as the RESP2 array
+    projection."""
+    from redisson_tpu.net.client import Connection
+    from redisson_tpu.net.resp import Push
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0) as st:
+        host, port = st.server.host, st.server.port
+        pushes = []
+        a = Connection(host, port, timeout=30.0)
+        a.push_handler = pushes.append
+        b = Connection(host, port, timeout=30.0)
+        try:
+            assert a.execute("CLIENT", "TRACKING", "ON") in (b"OK",)
+            b.execute("SET", "ph:k", "v1")
+            assert a.execute("GET", "ph:k") == b"v1"
+            b.execute("SET", "ph:k", "v2")
+            assert a.execute("PING") == b"PONG"
+            assert len(pushes) == 1 and isinstance(pushes[0], Push)
+            # downgrade THIS connection; earlier pushes were already typed
+            reply = a.execute("HELLO", "2")
+            assert reply[reply.index(b"proto") + 1] == 2
+            assert a.execute("GET", "ph:k") == b"v2"  # re-register on RESP2
+            b.execute("SET", "ph:k", "v3")
+            # the RESP2 projection of the push is a PLAIN array — it arrives
+            # as the next value (which is exactly why RESP2 clients use
+            # REDIRECT mode for real traffic)
+            nxt = a.read_reply(timeout=5.0)
+            assert not isinstance(nxt, Push)
+            assert nxt[0] == b"invalidate" and nxt[1] == [b"ph:k"]
+            assert len(pushes) == 1  # no further typed pushes
+        finally:
+            a.close()
+            b.close()

@@ -374,3 +374,80 @@ def test_perf_gate_passes_self_and_fails_known_regression(tmp_path):
         p = tmp_path / f"fresh_5p_{factor}.json"
         p.write_text(json.dumps(doc2))
         assert gate.main(["--fresh", str(p), "--baseline", str(first)]) == want
+
+
+# -- config6 tracking gate + bounded overflow (ISSUE 7) ------------------------
+
+
+def test_perf_gate_config6_floor_and_relative(tmp_path):
+    """config6_server_op_reduction: n/a-passes while absent, then gates BOTH
+    relatively (>5% drop vs baseline) and absolutely (>=10x floor from
+    first sight)."""
+    import copy
+    import importlib.util
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(repo, "tools", "perf_gate.py")
+    )
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+    r5 = os.path.join(repo, "BENCH_r05.json")
+    if not os.path.exists(r5):
+        pytest.skip("no recorded BENCH artifacts")
+    with open(r5) as fh:
+        base = gate.load_bench_doc(fh.read())
+
+    # absent everywhere: n/a rows pass (first sight is next round's baseline)
+    assert gate.main(["--fresh", r5, "--baseline", r5]) == 0
+    # first sight ABOVE the floor passes; BELOW the floor fails even though
+    # the baseline has no config6 at all
+    for reduction, want in ((24.7, 0), (8.0, 1)):
+        doc = copy.deepcopy(base)
+        doc["details"]["config6_server_op_reduction"] = reduction
+        p = tmp_path / f"fresh_c6_{reduction}.json"
+        p.write_text(json.dumps(doc))
+        assert gate.main(["--fresh", str(p), "--baseline", r5]) == want
+    # once recorded: a >5% relative drop fails even while above the floor
+    doc = copy.deepcopy(base)
+    doc["details"]["config6_server_op_reduction"] = 24.7
+    rec = tmp_path / "c6_recorded.json"
+    rec.write_text(json.dumps(doc))
+    for reduction, want in ((12.0, 1), (24.0, 0)):
+        doc2 = copy.deepcopy(doc)
+        doc2["details"]["config6_server_op_reduction"] = reduction
+        p = tmp_path / f"fresh_c6_rel_{reduction}.json"
+        p.write_text(json.dumps(doc2))
+        assert gate.main(["--fresh", str(p), "--baseline", str(rec)]) == want
+
+
+def test_tracking_table_overflow_stays_bounded():
+    """The perf contract of the tracking table: a read stream over MORE
+    distinct keys than tracking-table-max-keys keeps the table AT the
+    bound (never beyond), with exactly (distinct - bound) synthetic
+    overflow evictions — the counter the perf smoke tier asserts bounded."""
+    from redisson_tpu.net.client import Connection
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0) as st:
+        srv = st.server
+        srv.config_set("tracking-table-max-keys", "64")
+        a = Connection(srv.host, srv.port, timeout=30.0)
+        a.push_handler = lambda _p: None
+        b = Connection(srv.host, srv.port, timeout=30.0)
+        try:
+            assert a.execute("CLIENT", "TRACKING", "ON") in (b"OK",)
+            distinct = 200
+            b.send_many([("SET", f"ovb:{i}", b"v") for i in range(distinct)])
+            b.read_replies(distinct, timeout=30.0)
+            high_water = 0
+            for i in range(distinct):
+                a.execute("GET", f"ovb:{i}")
+                high_water = max(high_water, srv.tracking.tracked_key_count())
+            assert high_water <= 64, high_water
+            assert srv.tracking.stats["overflow_evictions"] == distinct - 64
+        finally:
+            a.close()
+            b.close()

@@ -1,0 +1,1218 @@
+"""Server-assisted client tracking (ISSUE 7): the RESP3 invalidation plane.
+
+Server half (tracking/table.py): CLIENT TRACKING modes (default / BCAST /
+REDIRECT / NOLOOP), stable CLIENT ID + INFO/TRACKINGINFO, invalidation on
+write / expiry / FLUSHALL, bounded-table overflow with synthetic
+invalidations, disconnect cleanup (keys AND redirect dependents), and the
+fence-epoch idempotence of slot-handoff invalidation.
+
+Client half (tracking/nearcache.py): the NearCache gen guard, the tracked
+bucket/map/set handles, bloom negative caching, the localcache TRACKING
+sync mode, and the reconnection-CLEAR discipline.
+
+Plus the orphaned-push satellite: a push on a handler-less connection
+DROPS (counted) instead of masquerading as the next pipeline reply.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.net.client import Connection
+from redisson_tpu.net.resp import Push, RespError
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture()
+def server():
+    with ServerThread(port=0) as st:
+        yield st
+
+
+def _conn(st, proto=3, handler=None):
+    c = Connection(st.server.host, st.server.port)
+    if handler is not None:
+        c.push_handler = handler
+    if proto == 2:
+        c.execute("HELLO", "2")
+    return c
+
+
+def _wait(cond, timeout=5.0, tick=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(tick)
+    return cond()
+
+
+# -- CLIENT verbs -------------------------------------------------------------
+
+def test_client_id_stable_and_info(server):
+    c = _conn(server)
+    ida = c.execute("CLIENT", "ID")
+    assert isinstance(ida, int)
+    assert c.execute("CLIENT", "ID") == ida  # stable per connection
+    other = _conn(server)
+    assert other.execute("CLIENT", "ID") != ida
+    c.execute("CLIENT", "SETNAME", "t1")
+    info = bytes(c.execute("CLIENT", "INFO")).decode()
+    assert f"id={ida}" in info and "name=t1" in info and "resp=3" in info
+    assert "tracking=off" in info
+    c.execute("CLIENT", "TRACKING", "ON")
+    info = bytes(c.execute("CLIENT", "INFO")).decode()
+    assert "tracking=on" in info
+    c.close()
+    other.close()
+
+
+def test_client_trackinginfo_shapes(server):
+    c = _conn(server)
+    ti = c.execute("CLIENT", "TRACKINGINFO")
+    assert ti[b"flags"] == [b"off"] and ti[b"redirect"] == -1
+    c.execute("CLIENT", "TRACKING", "ON", "BCAST", "PREFIX", "user:", "NOLOOP")
+    ti = c.execute("CLIENT", "TRACKINGINFO")
+    assert set(ti[b"flags"]) == {b"on", b"bcast", b"noloop"}
+    assert ti[b"prefixes"] == [b"user:"]
+    c.execute("CLIENT", "TRACKING", "OFF")
+    ti = c.execute("CLIENT", "TRACKINGINFO")
+    assert ti[b"flags"] == [b"off"]
+    c.close()
+
+
+def test_client_tracking_option_errors(server):
+    # raw Connections deliver -ERR replies as RespError VALUES
+    c = _conn(server)
+    r = c.execute("CLIENT", "TRACKING", "ON", "REDIRECT", "999999")
+    assert isinstance(r, RespError) and "does not exist" in str(r)
+    r = c.execute("CLIENT", "TRACKING", "ON", "PREFIX", "x:")
+    assert isinstance(r, RespError) and "BCAST" in str(r)
+    r = c.execute("CLIENT", "TRACKING", "MAYBE")
+    assert isinstance(r, RespError)
+    c.close()
+
+
+# -- default-mode invalidation ------------------------------------------------
+
+def test_read_then_write_pushes_invalidate(server):
+    pushes = []
+    a = _conn(server, handler=pushes.append)
+    b = _conn(server)
+    a.execute("CLIENT", "TRACKING", "ON")
+    b.execute("SET", "t:k", "v1")
+    assert a.execute("GET", "t:k") == b"v1"
+    b.execute("SET", "t:k", "v2")
+    a.execute("PING")  # drains the push queued ahead of the reply
+    assert pushes and bytes(pushes[0][0]) == b"invalidate"
+    assert pushes[0][1] == [b"t:k"]
+    # one-shot: a second write without a re-read pushes nothing new
+    n = len(pushes)
+    b.execute("SET", "t:k", "v3")
+    a.execute("PING")
+    assert len(pushes) == n
+    a.close()
+    b.close()
+
+
+def test_noloop_skips_own_writes(server):
+    pushes = []
+    a = _conn(server, handler=pushes.append)
+    a.execute("CLIENT", "TRACKING", "ON", "NOLOOP")
+    a.execute("SET", "t:n", "v1")
+    a.execute("GET", "t:n")
+    a.execute("SET", "t:n", "v2")  # own write: NOLOOP suppresses the push
+    a.execute("PING")
+    assert not pushes
+    # the suppressed self-write must NOT consume the registration: the
+    # writer's near cache seeds the value it just wrote, so a LATER foreign
+    # write has to find the registration and invalidate it — popping it
+    # here would leave the seeded entry stale forever (review fix)
+    b = _conn(server)
+    b.execute("SET", "t:n", "v3")
+    a.execute("PING")
+    assert len(pushes) == 1 and pushes[-1][1] == [b"t:n"]
+    # ... and the foreign write WAS one-shot: another without a re-read
+    # pushes nothing new
+    b.execute("SET", "t:n", "v3b")
+    a.execute("PING")
+    assert len(pushes) == 1
+    a.execute("GET", "t:n")  # re-register
+    b.execute("SET", "t:n", "v4")
+    a.execute("PING")
+    assert len(pushes) == 2 and pushes[-1][1] == [b"t:n"]
+    a.close()
+    b.close()
+
+
+def test_noloop_self_is_per_connection_not_per_feed(server):
+    """Review regression (both directions): NOLOOP "self" is ONE connection
+    — Redis's own scope — NOT every conn sharing the writer's redirect
+    feed.  A same-facade write that lands on a DIFFERENT pooled conn must
+    still push: writes through plain (untracked) handles ride the same
+    armed pool and never touch the near cache locally, so the push is the
+    only thing keeping mixed tracked/plain usage coherent."""
+    feed_pushes = []
+    feed = _conn(server, handler=feed_pushes.append)
+    fid = feed.execute("CLIENT", "ID")
+    a = _conn(server)
+    b = _conn(server)
+    a.execute("CLIENT", "TRACKING", "ON", "REDIRECT", str(fid), "NOLOOP")
+    b.execute("CLIENT", "TRACKING", "ON", "REDIRECT", str(fid), "NOLOOP")
+    w = _conn(server)  # untracked: seeds without registering anything
+    w.execute("SET", "t:sf", "v0")
+    assert a.execute("GET", "t:sf") == b"v0"  # registers under a's cid
+    a.execute("SET", "t:sf", "v1")  # SAME conn: suppressed
+    feed.execute("PING")
+    assert not feed_pushes
+    b.execute("SET", "t:sf", "v2")  # same feed, different conn: pushes
+    feed.execute("PING")
+    assert feed_pushes and feed_pushes[0][1] == [b"t:sf"]
+    for c in (feed, a, b, w):
+        c.close()
+
+
+def test_fused_add_run_failure_still_invalidates(server):
+    """Review regression: a failed fused BF.MADD64 run may have PARTIALLY
+    applied (add runs never re-dispatch) — tracked negative `contains`
+    caches must still get the invalidation push or they serve stale
+    membership forever."""
+    import redisson_tpu.server.verbs.sketch as sketch
+
+    pushes = []
+    a = _conn(server, handler=pushes.append)
+    b = _conn(server)
+    for i in range(2):
+        assert b.execute("BF.RESERVE", f"fz:{i}", 0.01, 1000) in (b"OK", "OK")
+    a.execute("CLIENT", "TRACKING", "ON")
+    probe = np.arange(10, dtype=np.int64).tobytes()
+    a.execute("BF.MEXISTS64", "fz:0", probe, timeout=30.0)  # registers fz:0
+    blob = np.arange(100, dtype=np.int64).tobytes()
+    real = sketch.coalesce_bloom_run
+
+    def boom(srv, ctx, cmds):
+        raise RuntimeError("injected fused failure")
+
+    sketch.coalesce_bloom_run = boom
+    try:
+        replies = b.execute_many([
+            ("BF.MADD64", "fz:0", blob),
+            ("BF.MADD64", "fz:1", blob),
+        ], timeout=30.0)
+    finally:
+        sketch.coalesce_bloom_run = real
+    assert all(isinstance(r, RespError) for r in replies)
+    a.execute("PING")
+    assert pushes and any(b"fz:0" in p[1] for p in pushes)
+    a.close()
+    b.close()
+
+
+def test_tracking_on_rejected_for_resp2_without_redirect(server):
+    """RESP2 has no push frames: an invalidation could only arrive as a
+    plain array interleaved into the reply stream (desyncing every later
+    reply), so CLIENT TRACKING ON must refuse unless REDIRECTed — Redis's
+    own rule."""
+    c = _conn(server, proto=2)
+    r = c.execute("CLIENT", "TRACKING", "ON")
+    assert isinstance(r, RespError) and "RESP3" in str(r)
+    # with a REDIRECT target the same connection may track (covered
+    # end-to-end by test_redirect_routes_pushes_to_target_resp2_data_conn)
+    target = _conn(server)
+    tid = target.execute("CLIENT", "ID")
+    assert c.execute("CLIENT", "TRACKING", "ON", "REDIRECT", str(tid)) == b"OK"
+    c.close()
+    target.close()
+
+
+def test_redirect_routes_pushes_to_target_resp2_data_conn(server):
+    """The RESP2-client path: the data connection stays push-free; its
+    invalidations land on the REDIRECT target encoded with the TARGET's
+    protocol."""
+    pushes = []
+    target = _conn(server, handler=pushes.append)
+    tid = target.execute("CLIENT", "ID")
+    data = _conn(server, proto=2)
+    data.execute("CLIENT", "TRACKING", "ON", "REDIRECT", str(tid))
+    w = _conn(server)
+    w.execute("SET", "t:r", "v1")
+    assert data.execute("GET", "t:r") == b"v1"
+    w.execute("SET", "t:r", "v2")
+    target.execute("PING")
+    assert pushes and pushes[0][1] == [b"t:r"]
+    # the data conn itself got NO push interleaved: its replies stay aligned
+    assert data.execute("PING") in (b"PONG",)
+    assert data.dropped_pushes == 0
+    data.close()
+    target.close()
+    w.close()
+
+
+def test_bcast_prefix_mode(server):
+    pushes = []
+    a = _conn(server, handler=pushes.append)
+    a.execute("CLIENT", "TRACKING", "ON", "BCAST", "PREFIX", "user:")
+    b = _conn(server)
+    b.execute("SET", "user:1", "x")  # no prior read needed in BCAST
+    a.execute("PING")
+    assert pushes and pushes[-1][1] == [b"user:1"]
+    n = len(pushes)
+    b.execute("SET", "other:1", "x")  # prefix mismatch: silent
+    a.execute("PING")
+    assert len(pushes) == n
+    b.execute("SET", "user:2", "y")  # every matching write, stateless
+    b.execute("SET", "user:2", "z")
+    a.execute("PING")
+    assert len(pushes) == n + 2
+    a.close()
+    b.close()
+
+
+def test_flushall_sends_null_invalidation(server):
+    pushes = []
+    a = _conn(server, handler=pushes.append)
+    a.execute("CLIENT", "TRACKING", "ON")
+    b = _conn(server)
+    b.execute("SET", "t:f", "v")
+    a.execute("GET", "t:f")
+    b.execute("FLUSHALL")
+    a.execute("PING")
+    assert pushes and pushes[-1][1] is None  # flush-everything frame
+    a.close()
+    b.close()
+
+
+def test_flushall_not_suppressed_by_noloop(server):
+    """Review regression: NOLOOP must NOT apply to flush invalidation
+    (Redis's rule) — the writer cannot enumerate-and-drop its own cached
+    keys locally, so suppressing the null frame would leave its whole near
+    cache serving deleted data."""
+    pushes = []
+    a = _conn(server, handler=pushes.append)
+    a.execute("CLIENT", "TRACKING", "ON", "NOLOOP")
+    a.execute("SET", "t:fn", "v")
+    a.execute("GET", "t:fn")
+    a.execute("FLUSHALL")  # the writer's OWN flush
+    a.execute("PING")
+    assert pushes and pushes[-1][1] is None
+    a.close()
+
+
+def test_expiry_invalidates_tracked_key(server):
+    pushes = []
+    a = _conn(server, handler=pushes.append)
+    a.execute("CLIENT", "TRACKING", "ON")
+    b = _conn(server)
+    b.execute("SET", "t:e", "v", "PX", "60")
+    assert a.execute("GET", "t:e") == b"v"
+    # wait past the TTL, then force the reaper (deterministic expiry path)
+    time.sleep(0.12)
+    server.server.engine.store.reap_expired()
+    a.execute("PING")
+    assert any(p[1] == [b"t:e"] for p in pushes), pushes
+    a.close()
+    b.close()
+
+
+def test_store_on_expired_hook_lazy_and_reaper():
+    from redisson_tpu.core.store import DeviceStore, StateRecord
+
+    store = DeviceStore()
+    seen = []
+    store.on_expired = seen.append
+    store.put("a", StateRecord(kind="bucket", expire_at=time.time() - 1))
+    store.put("b", StateRecord(kind="bucket", expire_at=time.time() - 1))
+    assert store.get("a") is None  # lazy-expiry path
+    assert seen == [["a"]]
+    assert store.reap_expired() == 1  # sweeper path
+    assert seen == [["a"], ["b"]]
+
+
+# -- bounded table / overflow -------------------------------------------------
+
+def test_overflow_evicts_with_synthetic_invalidation(server):
+    srv = server.server
+    srv.tracking.max_keys = 8
+    pushes = []
+    a = _conn(server, handler=pushes.append)
+    a.execute("CLIENT", "TRACKING", "ON")
+    b = _conn(server)
+    for i in range(12):
+        b.execute("SET", f"ov:{i}", "v")
+        a.execute("GET", f"ov:{i}")
+    a.execute("PING")
+    assert srv.tracking.tracked_key_count() <= 8
+    assert srv.tracking.stats["overflow_evictions"] == 4
+    # the 4 oldest-registered keys invalidated synthetically, FIFO order
+    evicted = [p[1][0] for p in pushes if p[1] is not None]
+    assert evicted == [b"ov:0", b"ov:1", b"ov:2", b"ov:3"]
+    a.close()
+    b.close()
+
+
+# -- disconnect cleanup -------------------------------------------------------
+
+def test_disconnect_drops_tracked_keys(server):
+    srv = server.server
+    a = _conn(server)
+    a.execute("CLIENT", "TRACKING", "ON")
+    b = _conn(server)
+    b.execute("SET", "dc:k", "v")
+    a.execute("GET", "dc:k")
+    assert srv.tracking.tracked_key_count() == 1
+    assert srv.tracking.census()["tracking_conns"] == 1
+    a.close()
+    assert _wait(lambda: srv.tracking.census()["tracking_conns"] == 0)
+    assert srv.tracking.tracked_key_count() == 0
+    b.close()
+
+
+def test_data_conn_death_synthesizes_invalidation_via_redirect(server):
+    """Review regression: a dying DATA connection strands its registrations
+    (the server forgets them silently) while the client's near cache — fed
+    through a still-alive REDIRECT target — keeps the entries they guarded.
+    The disconnect purge must push a synthetic invalidation through the
+    surviving feed, same rule as bounded-table overflow."""
+    pushes = []
+    target = _conn(server, handler=pushes.append)
+    tid = target.execute("CLIENT", "ID")
+    data = _conn(server)
+    data.execute("CLIENT", "TRACKING", "ON", "REDIRECT", str(tid))
+    w = _conn(server)
+    w.execute("SET", "dd:k", "v")
+    data.execute("GET", "dd:k")
+    assert server.server.tracking.tracked_key_count() == 1
+    data.close()  # idle-reap / discard-on-error analog
+
+    def got():
+        target.execute("PING")  # drain pushes queued on the feed
+        return any(p[1] == [b"dd:k"] for p in pushes)
+
+    assert _wait(got), pushes
+    assert _wait(lambda: server.server.tracking.tracked_key_count() == 0)
+    target.close()
+    w.close()
+
+
+def test_clear_idle_does_not_strand_near_cache(server):
+    """Plane-level: retiring the data connection that registered a key
+    (pool idle reap / clear_idle) must not leave the near-cache entry
+    uninvalidatable."""
+    from redisson_tpu.client.remote import RemoteRedisson
+
+    addr = f"{server.server.host}:{server.server.port}"
+    a = RemoteRedisson(addr, pool_size=1)
+    w = RemoteRedisson(addr)
+    try:
+        plane = a.enable_tracking()
+        b = plane.get_bucket("tb:strand")
+        w.get_bucket("tb:strand").set("s1")
+        assert _wait(lambda: b.get() == "s1")
+        a.node.pool.clear_idle()  # the registering conn dies server-side
+        w.get_bucket("tb:strand").set("s2")
+        assert _wait(lambda: b.get() == "s2"), (
+            "near-cache entry stranded by its connection's death"
+        )
+    finally:
+        a.shutdown()
+        w.shutdown()
+
+
+def test_transactional_read_does_not_invalidate(server):
+    """Review regression: OBJCALLV (the transactional READ — write-classed
+    only for master routing) must register like a read, not pop every
+    tracker's registration and storm invalidations."""
+    from redisson_tpu.client.remote import RemoteRedisson
+
+    pushes = []
+    a = _conn(server, handler=pushes.append)
+    a.execute("CLIENT", "TRACKING", "ON")
+    w = RemoteRedisson(f"{server.server.host}:{server.server.port}")
+    try:
+        w.get_bucket("tx:k").set("v")
+        a.execute("GET", "tx:k")  # register
+        tx = w.create_transaction()
+        assert tx.get_bucket("tx:k").get() == "v"  # OBJCALLV
+        tx.commit()
+        a.execute("PING")
+        assert not any(p[1] == [b"tx:k"] for p in pushes), pushes
+        # the registration survived the transactional read: a real write
+        # still invalidates
+        w.get_bucket("tx:k").set("v2")
+
+        def got():
+            a.execute("PING")
+            return any(p[1] == [b"tx:k"] for p in pushes)
+
+        assert _wait(got)
+    finally:
+        w.shutdown()
+        a.close()
+
+
+def test_redirect_target_death_breaks_dependent_tracking(server):
+    srv = server.server
+    target = _conn(server)
+    tid = target.execute("CLIENT", "ID")
+    data = _conn(server)
+    data.execute("CLIENT", "TRACKING", "ON", "REDIRECT", str(tid))
+    w = _conn(server)
+    w.execute("SET", "rb:k", "v")
+    data.execute("GET", "rb:k")
+    assert srv.tracking.tracked_key_count() == 1
+    broken_before = srv.tracking.stats["redirect_broken"]
+    target.close()  # the invalidation stream's endpoint dies
+    assert _wait(
+        lambda: srv.tracking.stats["redirect_broken"] == broken_before + 1
+    )
+    # the dependent's tracking is OFF and its keys are gone — a silent
+    # stale cache is worse than no cache
+    assert srv.tracking.tracked_key_count() == 0
+    ti = data.execute("CLIENT", "TRACKINGINFO")
+    assert ti[b"flags"] == [b"off"]
+    data.close()
+    w.close()
+
+
+# -- slot-handoff fence epochs ------------------------------------------------
+
+def test_invalidate_slot_epoch_idempotence(server):
+    from redisson_tpu.utils.crc16 import calc_slot
+
+    srv = server.server
+    pushes = []
+    a = _conn(server, handler=pushes.append)
+    a.execute("CLIENT", "TRACKING", "ON")
+    b = _conn(server)
+    b.execute("SET", "ep:k", "v")
+    a.execute("GET", "ep:k")
+    slot = calc_slot(b"ep:k")
+    assert srv.tracking.invalidate_slot(slot, epoch=7) == 1
+    # idempotent resume re-issue (same epoch) and a stale coordinator's
+    # lower epoch both emit NOTHING
+    assert srv.tracking.invalidate_slot(slot, epoch=7) == 0
+    assert srv.tracking.invalidate_slot(slot, epoch=3) == 0
+    a.execute("GET", "ep:k")  # re-register
+    assert srv.tracking.invalidate_slot(slot, epoch=8) == 1  # newer epoch emits
+    a.execute("PING")
+    assert sum(1 for p in pushes if p[1] == [b"ep:k"]) == 2
+    a.close()
+    b.close()
+
+
+def test_epochless_handoff_invalidates_after_fenced_migration(server):
+    """Review regression: an EPOCH-LESS (un-journaled, the migrate_slots
+    default) handoff of a slot that a PREVIOUS journaled migration fenced
+    must still invalidate — set_slot_stable used to pass the recorded
+    slot_epochs high-water mark, so the fresh handoff's flush was deduped
+    against the OLD migration's epoch and emitted nothing."""
+    from redisson_tpu.harness import _exec
+    from redisson_tpu.utils.crc16 import calc_slot
+
+    pushes = []
+    a = _conn(server, handler=pushes.append)
+    b = _conn(server)
+    b.execute("SET", "t:ep2", "v1")
+    a.execute("CLIENT", "TRACKING", "ON")
+    a.execute("GET", "t:ep2")
+    slot = calc_slot(b"t:ep2")
+    # fenced (journaled) migration: STABLE EPOCH 5 invalidates once
+    _exec(b, "CLUSTER", "SETSLOT", slot, "MIGRATING", "peer:1", "EPOCH", 5)
+    _exec(b, "CLUSTER", "SETSLOT", slot, "STABLE", "EPOCH", 5)
+    a.execute("PING")
+    assert sum(1 for p in pushes if p[1] == [b"t:ep2"]) == 1
+    a.execute("GET", "t:ep2")  # re-register
+    # later epoch-less migration of the same slot must STILL invalidate
+    _exec(b, "CLUSTER", "SETSLOT", slot, "MIGRATING", "peer:1")
+    _exec(b, "CLUSTER", "SETSLOT", slot, "STABLE")
+    a.execute("PING")
+    assert sum(1 for p in pushes if p[1] == [b"t:ep2"]) == 2
+    a.close()
+    b.close()
+
+
+def test_slot_index_mirrors_tracked_table(server):
+    """invalidate_slot consults a slot->keys index maintained at
+    registration time (review fix: the old full-table calc_slot scan under
+    the lock stalled the dispatch hot path per handoff).  The index must
+    mirror the table through every mutation path: registration, write
+    invalidation, slot handoff, overflow eviction, disconnect purge, and
+    FLUSHALL."""
+    from redisson_tpu.utils.crc16 import calc_slot
+
+    srv = server.server
+    t = srv.tracking
+    a = _conn(server)
+    a.execute("CLIENT", "TRACKING", "ON")
+    b = _conn(server)
+    names = [f"si:{i}" for i in range(16)]
+    for n in names:
+        b.execute("SET", n, "v")
+        a.execute("GET", n)
+
+    def mirrored():
+        c = t.census()
+        return c["slot_index_keys"] == c["table_keys"]
+
+    assert t.census()["table_keys"] == 16 and mirrored()
+    slot = calc_slot(b"si:0")
+    expected = sum(1 for n in names if calc_slot(n.encode()) == slot)
+    assert t.invalidate_slot(slot) == expected  # handoff: O(keys-in-slot)
+    assert t.census()["table_keys"] == 16 - expected and mirrored()
+    survivor = next(n for n in names if calc_slot(n.encode()) != slot)
+    b.execute("SET", survivor, "w")  # write invalidation pops one key
+    assert t.census()["table_keys"] == 15 - expected and mirrored()
+    t.max_keys = 4  # overflow eviction drains the oldest registrations
+    a.execute("GET", "si:ov")
+    assert t.census()["table_keys"] == 4 and mirrored()
+    b.execute("FLUSHALL")
+    assert t.census()["table_keys"] == 0 and mirrored()
+    a.execute("GET", "si:back")
+    assert t.census()["table_keys"] == 1 and mirrored()
+    a.close()  # disconnect purge (O(keys-of-conn) via the reverse index)
+    assert _wait(lambda: t.census()["table_keys"] == 0)
+    assert mirrored()
+    assert t.census()["client_index_keys"] == 0
+    b.close()
+
+
+# -- orphaned pushes (satellite bugfix) ---------------------------------------
+
+def test_orphaned_push_drops_instead_of_desyncing_pipeline(server):
+    """A push interleaved between pipelined replies on a handler-less
+    connection previously got consumed AS the next reply, desyncing every
+    later command.  Now it drops, counted."""
+    a = _conn(server)  # NO push handler
+    a.execute("CLIENT", "TRACKING", "ON")
+    b = _conn(server)
+    b.execute("SET", "orph:k", "v1")
+    assert a.execute("GET", "orph:k") == b"v1"
+    # the write queues an invalidate push on a's connection, ahead of
+    # whatever a reads next
+    b.execute("SET", "orph:k", "v2")
+    n = a.send_many([("PING",), ("ECHO", "x"), ("PING",)])
+    replies = a.read_replies(n)
+    assert replies == [b"PONG", b"x", b"PONG"]  # aligned, push dropped
+    assert a.dropped_pushes == 1
+    from redisson_tpu.net import client as net_client
+
+    assert net_client.dropped_push_count() >= 1
+    a.close()
+    b.close()
+
+
+# -- push frame byte identity (RESP2/RESP3, native/python) --------------------
+
+def test_invalidate_push_wire_bytes():
+    from redisson_tpu.net import resp
+
+    push = Push([b"invalidate", [b"key"]])
+    # the exact RESP3 frame of the issue spec
+    assert resp.encode_reply(push, 3) == (
+        b">2\r\n$10\r\ninvalidate\r\n*1\r\n$3\r\nkey\r\n"
+    )
+    # RESP2 projection (what a REDIRECT target speaking RESP2 receives)
+    assert resp.encode_reply(push, 2) == (
+        b"*2\r\n$10\r\ninvalidate\r\n*1\r\n$3\r\nkey\r\n"
+    )
+    # null-payload (FLUSHALL) form
+    assert resp.encode_reply(Push([b"invalidate", None]), 3) == (
+        b">2\r\n$10\r\ninvalidate\r\n_\r\n"
+    )
+    # native and pure-Python encoders agree byte for byte on every form
+    for proto in (2, 3):
+        for p in (push, Push([b"invalidate", None]),
+                  Push([b"invalidate", [b"a", b"bb", b"c" * 100]])):
+            assert resp.encode_reply(p, proto) == resp.encode_reply_python(p, proto)
+    # ... and the parser round-trips the frame back to a Push
+    parser = resp.RespParser()
+    vals = parser.feed(resp.encode_reply(push, 3) + b"+PONG\r\n")
+    assert isinstance(vals[0], Push) and vals[0][1] == [b"key"]
+    assert vals[1] == b"PONG"
+
+
+def test_invalidate_push_byte_identity_no_native_subprocess():
+    """RTPU_NO_NATIVE=1 (pure-Python wire) produces byte-identical push
+    frames — the encoding contract holds on the fallback path too."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "from redisson_tpu.net.resp import Push, encode_reply\n"
+        "import sys\n"
+        "p = Push([b'invalidate', [b'key-1', b'key-22']])\n"
+        "sys.stdout.buffer.write(encode_reply(p, 3) + encode_reply(p, 2))\n"
+    )
+    outs = []
+    for extra in ({}, {"RTPU_NO_NATIVE": "1"}):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **extra)
+        r = subprocess.run(
+            [sys.executable, "-c", code], stdout=subprocess.PIPE, env=env,
+            check=True,
+        )
+        outs.append(r.stdout)
+    assert outs[0] == outs[1] and outs[0].startswith(b">2\r\n$10\r\ninvalidate")
+
+
+# -- NearCache unit -----------------------------------------------------------
+
+def test_nearcache_gen_guard_and_lru():
+    from redisson_tpu.tracking.nearcache import NearCache
+
+    c = NearCache(max_entries=3)
+    gen = c.gen("a")
+    assert c.put("a", ("get",), 1, gen)
+    assert c.get("a", ("get",)) == (True, 1)
+    # an invalidation between the gen snapshot and the put VOIDS the put
+    gen = c.gen("b")
+    c.invalidate("b")
+    assert not c.put("b", ("get",), 2, gen)
+    assert c.get("b", ("get",)) == (False, None)
+    # a flush voids too
+    gen = c.gen("c")
+    c.flush()
+    assert not c.put("c", ("get",), 3, gen)
+    # LRU bound
+    for name in ("x", "y", "z", "w"):
+        c.put(name, ("get",), name, c.gen(name))
+    assert len(c) == 3
+    assert c.get("x", ("get",)) == (False, None)  # oldest evicted
+    # invalidate drops every subkey of the name
+    c.put("m", ("f1",), 1, c.gen("m"))
+    c.put("m", ("f2",), 2, c.gen("m"))
+    c.invalidate("m")
+    assert c.get("m", ("f1",)) == (False, None)
+    assert c.get("m", ("f2",)) == (False, None)
+
+
+# -- tracked handles over the wire --------------------------------------------
+
+@pytest.fixture()
+def tracked_pair(server):
+    from redisson_tpu.client.remote import RemoteRedisson
+
+    addr = f"{server.server.host}:{server.server.port}"
+    c1 = RemoteRedisson(addr)
+    c2 = RemoteRedisson(addr)
+    plane = c1.enable_tracking()
+    yield server, c1, c2, plane
+    c1.shutdown()
+    c2.shutdown()
+
+
+def test_tracked_bucket_reads_are_local_until_write(tracked_pair):
+    st, c1, c2, plane = tracked_pair
+    b1 = plane.get_bucket("tb:b")
+    b2 = c2.get_bucket("tb:b")
+    b2.set("v1")
+    assert b1.get() == "v1"
+    before = st.server.stats["commands"]
+    for _ in range(40):
+        assert b1.get() == "v1"
+    assert st.server.stats["commands"] == before  # zero wire traffic
+    b2.set("v2")
+    assert _wait(lambda: b1.get() == "v2")
+    s = plane.stats()
+    assert s["hits"] >= 40 and s["invalidations"] >= 1
+
+
+def test_tracked_map_and_set(tracked_pair):
+    st, c1, c2, plane = tracked_pair
+    m1, m2 = plane.get_map("tb:m"), c2.get_map("tb:m")
+    m2.put("k", 1)
+    assert m1.get("k") == 1
+    before = st.server.stats["commands"]
+    assert m1.get("k") == 1 and st.server.stats["commands"] == before
+    m2.put("k", 2)
+    assert _wait(lambda: m1.get("k") == 2)
+    assert m1.get_all(["k"]) == {"k": 2}
+    s1, s2 = plane.get_set("tb:s"), c2.get_set("tb:s")
+    assert s1.contains("x") is False
+    before = st.server.stats["commands"]
+    assert s1.contains("x") is False  # negative membership cached
+    assert st.server.stats["commands"] == before
+    s2.add("x")
+    assert _wait(lambda: s1.contains("x"))
+
+
+def test_bloom_negative_cache_on_the_plane(tracked_pair):
+    st, c1, c2, plane = tracked_pair
+    bf1 = plane.get_bloom_filter("tb:bf")
+    bf2 = c2.get_bloom_filter("tb:bf")
+    assert bf2.try_init(10_000, 0.01)
+    keys = np.arange(64, dtype=np.int64)
+    assert not bf1.contains_each(keys).any()
+    before = st.server.stats["commands"]
+    # immutable-until-add: repeat membership answers locally
+    assert not bf1.contains_each(keys).any()
+    assert not bf1.contains(int(keys[0]))
+    assert st.server.stats["commands"] == before
+    # the filter's add stream invalidates the negatives
+    bf2.add_each(keys[:32])
+    assert _wait(lambda: bf1.contains_each(keys[:32]).all())
+    out = bf1.contains_each(keys)
+    assert out[:32].all() and not out[32:].any()
+
+
+def test_tracked_own_write_seeds_cache_with_noloop(server):
+    from redisson_tpu.client.remote import RemoteRedisson
+
+    addr = f"{server.server.host}:{server.server.port}"
+    c = RemoteRedisson(addr)
+    try:
+        plane = c.enable_tracking(noloop=True)
+        b = plane.get_bucket("tb:own")
+        b.set("mine")
+        before = server.server.stats["commands"]
+        assert b.get() == "mine"  # served from the self-seeded entry
+        assert server.server.stats["commands"] == before
+    finally:
+        c.shutdown()
+
+
+def test_noloop_seed_invalidated_by_foreign_write(server):
+    """Review regression: a NOLOOP self-write must REGISTER the key
+    server-side (not consume/skip the registration) — otherwise the
+    self-seeded near-cache entry can never be invalidated and a later
+    foreign write leaves the seeding client stale FOREVER.  pool_size=1
+    forces read+write onto one connection (the worst case: the old code
+    popped that connection's own registration while suppressing its
+    push)."""
+    from redisson_tpu.client.remote import RemoteRedisson
+
+    addr = f"{server.server.host}:{server.server.port}"
+    a = RemoteRedisson(addr, pool_size=1)
+    w = RemoteRedisson(addr)
+    try:
+        plane = a.enable_tracking(noloop=True)
+        b = plane.get_bucket("tb:stale")
+        assert b.get() is None          # register on the (only) data conn
+        b.set("v1")                     # self-write: suppressed + seeded
+        assert b.get() == "v1"          # near-cache hit on the seed
+        w.get_bucket("tb:stale").set("v2")  # foreign write MUST invalidate
+        assert _wait(lambda: b.get() == "v2"), (
+            "self-seeded entry never invalidated: stale forever"
+        )
+        # and with NO prior read at all: the write alone must register
+        b2 = plane.get_bucket("tb:stale2")
+        b2.set("x1")
+        assert b2.get() == "x1"
+        w.get_bucket("tb:stale2").set("x2")
+        assert _wait(lambda: b2.get() == "x2")
+    finally:
+        a.shutdown()
+        w.shutdown()
+
+
+def test_tracked_write_error_still_invalidates_locally(server):
+    """Review regression: a raised wire write may still have APPLIED (lost
+    reply) — the tracked handle must invalidate its near cache anyway.
+    Under NOLOOP the server suppresses the self-push, so skipping the
+    local invalidation on the error path leaves the cache stale forever."""
+    from redisson_tpu.client.remote import RemoteRedisson
+
+    addr = f"{server.server.host}:{server.server.port}"
+    a = RemoteRedisson(addr, pool_size=1)
+    try:
+        plane = a.enable_tracking(noloop=True)
+        b = plane.get_bucket("tb:werr")
+        b.set("v1")
+        assert b.get() == "v1"  # cached
+
+        real_set = b._proxy.set
+
+        def applied_but_lost(*args, **kw):
+            real_set(*args, **kw)  # applies server-side
+            raise TimeoutError("reply lost")
+
+        b._proxy.set = applied_but_lost
+        with pytest.raises(TimeoutError):
+            b.set("v2")  # explicit wrapper path
+        b._proxy.set = real_set
+        assert b.get() == "v2", "stale cache survived a raised set"
+
+        real_del = b._proxy.delete
+
+        def del_applied_but_lost(*args, **kw):
+            real_del(*args, **kw)
+            raise TimeoutError("reply lost")
+
+        b._proxy.delete = del_applied_but_lost
+        with pytest.raises(TimeoutError):
+            b.delete()  # generic __getattr__ fall-through path
+        b._proxy.delete = real_del
+        assert b.get() is None, "stale cache survived a raised delete"
+    finally:
+        a.shutdown()
+
+
+def test_tracked_mutator_fallthrough_invalidates_under_noloop(server):
+    """Review regression: mutators a tracked handle does not explicitly
+    wrap (compare_and_set, get_and_set, ...) must still invalidate the
+    near cache locally.  Under NOLOOP the server suppresses the self-write
+    push, so the generic write fall-through is the ONLY thing standing
+    between such a write and a permanently stale cache.  get_and_set is
+    the nasty case: its read-looking prefix must still classify as a
+    write."""
+    from redisson_tpu.client.remote import RemoteRedisson
+    from redisson_tpu.net import commands as C
+
+    assert C.objcall_is_write("get_and_set")
+    assert C.objcall_is_write("get_and_put")
+    assert not C.objcall_is_write("get")
+    addr = f"{server.server.host}:{server.server.port}"
+    a = RemoteRedisson(addr, pool_size=1)
+    try:
+        plane = a.enable_tracking(noloop=True)
+        b = plane.get_bucket("tb:cas")
+        b.set("v0")                            # seeds the cache
+        assert b.get() == "v0"
+        assert b.compare_and_set("v0", "v1")   # __getattr__ fall-through
+        assert b.get() == "v1", "stale cache survived compare_and_set"
+        assert b.get_and_set("v2") == "v1"
+        assert b.get() == "v2", "stale cache survived get_and_set"
+        m = plane.get_map("tb:casm")
+        m.put("k", 1)
+        assert m.get("k") == 1
+        m.put_if_absent("k2", 5)               # fall-through map mutator
+        assert m.get("k2") == 5
+    finally:
+        a.shutdown()
+
+
+def test_replica_reads_arm_tracking_and_invalidate():
+    """Review regression: with read_mode=replica, tracked reads route to
+    replica connections — those must arm CLIENT TRACKING against the
+    REPLICA's table (REPLPUSH apply invalidates there), or every
+    replica-served entry would be stale forever."""
+    from redisson_tpu.harness import ClusterRunner, _exec
+
+    runner = ClusterRunner(masters=1, replicas_per_master=1).run()
+    try:
+        client = runner.client(scan_interval=0, read_mode="replica")
+        writer = runner.client(scan_interval=0)
+        try:
+            client.refresh_topology()  # discover the replica via REPLICAS
+            plane = client.enable_tracking()
+            writer.get_bucket("rt:k").set("v1")
+            with runner.masters[0].server.client() as c:
+                _exec(c, "REPLFLUSH")  # ship to the replica
+            b = plane.get_bucket("rt:k")
+            assert _wait(lambda: b.get() == "v1")
+            # the replica-routed read registered on the REPLICA's table
+            rep_srv = runner.replicas[0].server.server
+            assert rep_srv.tracking.active >= 1
+            assert rep_srv.tracking.tracked_key_count() >= 1
+            before = plane.stats()["hits"]
+            assert b.get() == "v1"
+            assert plane.stats()["hits"] == before + 1  # served locally
+            # a foreign master write must reach the near cache through the
+            # replica's REPLPUSH-apply invalidation stream
+            writer.get_bucket("rt:k").set("v2")
+            with runner.masters[0].server.client() as c:
+                _exec(c, "REPLFLUSH")
+            assert _wait(lambda: b.get() == "v2"), (
+                "replica-served entry never invalidated"
+            )
+        finally:
+            client.shutdown()
+            writer.shutdown()
+    finally:
+        runner.shutdown()
+
+
+def test_localcache_tracking_sync_mode(tracked_pair):
+    from redisson_tpu.client.objects.localcache import (
+        LocalCachedMapOptions,
+        SyncStrategy,
+    )
+
+    st, c1, c2, plane = tracked_pair
+    lm1 = c1.get_local_cached_map(
+        "tb:lc",
+        options=LocalCachedMapOptions(sync_strategy=SyncStrategy.TRACKING),
+    )
+    lm2 = c2.get_local_cached_map("tb:lc")  # legacy topic-mode peer
+    lm2.put("a", 10)
+    assert lm1.get("a") == 10
+    before = st.server.stats["commands"]
+    assert lm1.get("a") == 10  # near-cache hit: no wire
+    assert st.server.stats["commands"] == before
+    lm2.put("a", 11)  # topic-mode writer; coherence rides the PLANE
+    assert _wait(lambda: lm1.get("a") == 11)
+    # destroy detaches the plane listener
+    lm1.destroy()
+    assert "tb:lc" not in plane._name_listeners
+
+
+def test_localcache_tracking_mode_requires_plane(server):
+    from redisson_tpu.client.objects.localcache import (
+        LocalCachedMapOptions,
+        SyncStrategy,
+    )
+    from redisson_tpu.client.remote import RemoteRedisson
+
+    addr = f"{server.server.host}:{server.server.port}"
+    c = RemoteRedisson(addr)
+    try:
+        with pytest.raises(RuntimeError, match="enable_tracking"):
+            c.get_local_cached_map(
+                "tb:lc2",
+                options=LocalCachedMapOptions(
+                    sync_strategy=SyncStrategy.TRACKING
+                ),
+            )
+    finally:
+        c.shutdown()
+
+
+def test_plane_close_uninstalls_hooks(server):
+    """close() must actually remove the conn_setup/release_filter hooks.
+    Bound-method identity (`is`) never matches a stored hook — each
+    attribute access mints a fresh bound-method object — so an identity
+    compare left _release_ok installed forever, and on a closed plane it
+    retired every unarmed connection on release (one TCP connect per op)."""
+    from redisson_tpu.client.remote import RemoteRedisson
+
+    addr = f"{server.server.host}:{server.server.port}"
+    c = RemoteRedisson(addr)
+    try:
+        plane = c.enable_tracking()
+        node = c.node
+        assert node.conn_setup == plane._conn_setup
+        assert node.pool.release_filter == plane._release_ok
+        plane.close()
+        assert node.conn_setup is None
+        assert node.pool.release_filter is None
+        # pooling discipline restored: a conn released after close re-pools
+        # instead of being closed by the stale release filter
+        conn = node.pool.acquire()
+        node.pool.release(conn)
+        assert node.pool.idle_count() >= 1
+        assert c.get_bucket("ch:k").get() is None  # client still serves
+    finally:
+        c.shutdown()
+
+
+def test_localcache_tracking_own_write_does_not_stale_seed(tracked_pair):
+    """TRACKING mode (no NOLOOP): a put must NOT seed the near cache.  A
+    write with no prior read never registered server-side (and one with a
+    prior read pops the registration as it applies), so nothing guarantees
+    a later foreign write pushes an invalidation for the seeded entry —
+    it would serve the own-written value forever."""
+    from redisson_tpu.client.objects.localcache import (
+        LocalCachedMapOptions,
+        SyncStrategy,
+    )
+
+    st, c1, c2, plane = tracked_pair
+    lm1 = c1.get_local_cached_map(
+        "tb:lcseed",
+        options=LocalCachedMapOptions(sync_strategy=SyncStrategy.TRACKING),
+    )
+    lm1.put("k", 1)  # own write, no prior read: no server registration
+    assert lm1.cached_size() == 0  # not seeded
+    assert lm1.get("k") == 1  # read-through registers + populates
+    c2.get_map("tb:lcseed").put("k", 2)  # foreign write -> push
+    assert _wait(lambda: lm1.get("k") == 2), "own-write seed went stale"
+
+
+def test_localcache_tracking_own_write_voids_inflight_get(tracked_pair):
+    """Review regression: an own write must bump ``_gen`` when it
+    invalidates locally — a get() whose wire fetch was in flight across
+    the write would otherwise re-populate the PRE-write value right after
+    the write's invalidate, and under tracking+NOLOOP the suppressed
+    self-push never corrects it."""
+    from redisson_tpu.client.objects.localcache import (
+        LocalCachedMapOptions,
+        SyncStrategy,
+    )
+
+    st, c1, c2, plane = tracked_pair
+    lm1 = c1.get_local_cached_map(
+        "tb:lcrace2",
+        options=LocalCachedMapOptions(sync_strategy=SyncStrategy.TRACKING),
+    )
+    lm1.put("k", 1)
+    real_get = lm1._proxy.get
+
+    def racing_get(key):
+        v = real_get(key)   # wire fetch returns the PRE-write value 1 ...
+        lm1.put(key, 2)     # ... and our own write lands before the populate
+        return v
+
+    lm1._proxy.get = racing_get
+    assert lm1.get("k") == 1  # the stale in-flight read itself
+    lm1._proxy.get = real_get
+    assert lm1.cached_size() == 0, "stale populate survived an own write"
+    assert lm1.get("k") == 2  # refetched, serves the written value
+
+
+def test_localcache_topic_put_races_foreign_invalidation(tracked_pair):
+    """Legacy topic mode keeps own-write seeding, but gen-guarded like
+    get(): a foreign invalidation landing between the wire write and the
+    populate voids the populate instead of caching over it."""
+    st, c1, c2, plane = tracked_pair
+    lm1 = c1.get_local_cached_map("tb:lcrace")  # topic mode (default)
+    real_put = lm1._proxy.put
+
+    def racing_put(key, value):
+        out = real_put(key, value)
+        lm1._gen += 1  # foreign invalidation processed mid-call
+        return out
+
+    lm1._proxy.put = racing_put
+    lm1.put("k", 1)
+    assert lm1.cached_size() == 0  # populate voided
+    lm1._proxy.put = real_put
+    lm1.put("k", 2)
+    assert lm1.cached_size() == 1  # undisturbed topic put still seeds
+
+
+def test_conn_setup_stamps_epoch_snapshotted_before_arming(server):
+    """Review regression: the feed-generation stamp must be captured BEFORE
+    the CLIENT TRACKING round-trip.  If the feed dies while the arm is in
+    flight, _on_feed_down bumps the node epoch — a conn stamped with the
+    post-bump epoch would pass _release_ok and pool even though it
+    redirects to the dead feed (its push route delivers nowhere, so every
+    entry it populates is stale forever)."""
+    from redisson_tpu.client.remote import RemoteRedisson
+
+    addr = f"{server.server.host}:{server.server.port}"
+    c = RemoteRedisson(addr)
+    try:
+        plane = c.enable_tracking()
+        assert c.get_bucket("es:k").get() is None  # arm the feed
+        node = c.node
+
+        class ArmRacedConn:
+            def execute(self, *args):
+                # the feed dies mid-handshake: epoch bumps while the
+                # CLIENT TRACKING reply is still in flight
+                node._rtpu_feed_epoch += 1
+                return b"OK"
+
+        conn = ArmRacedConn()
+        plane._conn_setup(node, conn)
+        assert conn._rtpu_track_epoch == node._rtpu_feed_epoch - 1
+        assert plane._release_ok(conn) is False  # retired, not pooled
+    finally:
+        c.shutdown()
+
+
+def test_feed_down_clears_idle_before_flush(server):
+    """Review regression: the reconnection-CLEAR sequence must clear the
+    node's idle pool BEFORE flushing the cache.  Flushing first leaves a
+    window where a read whose gen snapshot post-dates the flush acquires
+    an old-feed idle conn and populates an entry no live feed can ever
+    invalidate."""
+    from redisson_tpu.client.remote import RemoteRedisson
+
+    addr = f"{server.server.host}:{server.server.port}"
+    c = RemoteRedisson(addr)
+    try:
+        plane = c.enable_tracking()
+        assert plane.get_bucket("od:k").get() is None  # arm the feed
+        node = c.node
+        feed = node.pubsub()
+        events = []
+        real_clear = node.pool.clear_idle
+        real_flush = plane.cache.flush
+        node.pool.clear_idle = lambda: (events.append("clear_idle"), real_clear())[1]
+        plane.cache.flush = lambda: (events.append("flush"), real_flush())[1]
+        try:
+            plane._on_feed_down(feed)
+        finally:
+            node.pool.clear_idle = real_clear
+            plane.cache.flush = real_flush
+        assert "clear_idle" in events and "flush" in events
+        assert events.index("clear_idle") < events.index("flush")
+    finally:
+        c.shutdown()
+
+
+def test_feed_loss_flushes_cache():
+    """Reconnection-CLEAR: the invalidation feed dying must flush the near
+    cache — serving through the gap could miss invalidations."""
+    from redisson_tpu.client.remote import RemoteRedisson
+
+    st = ServerThread(port=0).start()
+    c = None
+    try:
+        addr = f"{st.server.host}:{st.server.port}"
+        c = RemoteRedisson(addr)
+        plane = c.enable_tracking()
+        b = plane.get_bucket("fl:k")
+        c.get_bucket("fl:k").set("v")
+        assert b.get() == "v"
+        assert len(plane.cache) == 1
+        flushes_before = plane.cache.stats()["flushes"]
+        st.stop()  # server dies: feed reader sees the close
+        assert _wait(
+            lambda: plane.cache.stats()["flushes"] > flushes_before
+        )
+        assert len(plane.cache) == 0
+    finally:
+        if c is not None:
+            c.shutdown()
+        st.stop()
+
+
+# -- census / metrics ---------------------------------------------------------
+
+def test_tracking_census_and_metrics_gauges(server):
+    from redisson_tpu.chaos.census import ResourceCensus
+
+    srv = server.server
+    census = ResourceCensus()
+    census.track_server("srv", srv)
+    a = _conn(server)
+    a.execute("CLIENT", "TRACKING", "ON")
+    b = _conn(server)
+    b.execute("SET", "cz:k", "v")
+    a.execute("GET", "cz:k")
+    snap = census.snapshot()
+    assert snap["srv.tracking_conns"] == 1
+    assert snap["srv.tracking_table_keys"] == 1
+    text = srv.metrics.prometheus_text()
+    assert "tracking_keys" in text and "tracking_pushes" in text
+    a.close()
+    b.close()
+    assert _wait(
+        lambda: census.snapshot()["srv.tracking_conns"] == 0
+    )
+    assert census.snapshot()["srv.tracking_table_keys"] == 0
+
+
+# -- the soak profile ---------------------------------------------------------
+
+def test_tracking_soak_migration_smoke():
+    """Fast tier: zipf tracked readers + writers while key-bearing slots
+    round-trip between masters — zero stale reads, full convergence, flat
+    tracking tables (the kill+failover variant runs in the slow tier)."""
+    from redisson_tpu.chaos.soak import TrackingSoakConfig, TrackingSoakHarness
+
+    report = TrackingSoakHarness(TrackingSoakConfig(
+        cycles=1, seed=0, kill=False, phase_seconds=0.6, keys=32, readers=2,
+    )).run()
+    assert report.stale_reads == 0
+    assert report.converged_keys == 32
+    assert report.migrations == 1 and report.records_migrated > 0
+    assert report.reads > 0 and report.writes_acked > 0
+
+
+@pytest.mark.slow
+def test_tracking_soak_kill_failover():
+    """Slow tier: the full storm — migration round-trip AND master
+    SIGKILL-analog + failover under tracked readers."""
+    from redisson_tpu.chaos.soak import TrackingSoakConfig, TrackingSoakHarness
+
+    for seed in (0, 1):
+        report = TrackingSoakHarness(TrackingSoakConfig(
+            cycles=1, seed=seed, kill=True,
+        )).run()
+        assert report.stale_reads == 0
+        assert report.failovers == 1
+        assert report.converged_keys == report.cycles_completed * 0 + 48

@@ -351,27 +351,44 @@ class TestShardedPubSub:
     def test_namespace_isolation_and_delivery(self, single):
         import time as _time
 
-        from redisson_tpu.net.client import Connection
+        from redisson_tpu.net.client import CommandTimeoutError, Connection
 
+        pushes = []
         sc = Connection("127.0.0.1", single.node.port, timeout=10.0)
+        # subscribe confirmations and smessage deliveries are RESP3 push
+        # frames: only a push_handler sees them (an orphaned push now DROPS
+        # with a counter instead of masquerading as the next reply)
+        sc.push_handler = pushes.append
+
+        def drain(timeout=0.3):
+            try:
+                sc.read_reply(timeout=timeout)
+            except CommandTimeoutError:
+                pass
+
         try:
-            sc.execute("SSUBSCRIBE", "wsp-ch")
+            sc.send("SSUBSCRIBE", "wsp-ch")
+            drain()
+            assert pushes and bytes(pushes[0][0]) == b"ssubscribe"
             n = single.node
             # plain PUBLISH must NOT cross into the shard namespace
             n.execute("PUBLISH", "wsp-ch", "plain")
             assert n.execute("SPUBLISH", "wsp-ch", "sharded") == 1
             assert b"wsp-ch" in n.execute("PUBSUB", "SHARDCHANNELS")
             assert n.execute("PUBSUB", "SHARDNUMSUB", "wsp-ch")[1] == 1
-            # smessage push arrives on the subscriber connection
+            # the smessage push arrives on the subscriber connection — and
+            # ONLY the sharded one (namespace isolation: no b"message")
             deadline = _time.time() + 5.0
-            got = None
-            while _time.time() < deadline and got is None:
-                p = sc.poll_push(timeout=0.2) if hasattr(sc, "poll_push") else None
-                if p is None:
-                    break
-                if p and p[0] in (b"smessage", "smessage"):
-                    got = p
-            sc.execute("SUNSUBSCRIBE", "wsp-ch")
+            while _time.time() < deadline and not any(
+                bytes(p[0]) == b"smessage" for p in pushes
+            ):
+                drain()
+            smsgs = [p for p in pushes if bytes(p[0]) == b"smessage"]
+            assert smsgs and smsgs[0][1] == b"wsp-ch" and smsgs[0][2] == b"sharded"
+            assert not any(bytes(p[0]) == b"message" for p in pushes)
+            sc.send("SUNSUBSCRIBE", "wsp-ch")
+            drain()
+            assert any(bytes(p[0]) == b"sunsubscribe" for p in pushes)
         finally:
             sc.close()
 

@@ -56,6 +56,20 @@ METRICS = [
     ("config3 hll merge pairs/s", ("details", "config3_hll_merge_pairs_per_sec"), True, False),
     ("config4 mapreduce entries/s", ("details", "config4_mapreduce_entries_per_sec"), True, False),
     ("config4 mapreduce COLD entries/s", ("details", "config4_mapreduce_cold_entries_per_sec"), True, True),
+    # config6 (ISSUE 7): the tracking plane's server-op reduction at a 99%
+    # read ratio.  Gated relative to baseline AND against an ABSOLUTE floor
+    # (FLOORS below): reads must cost >=10x fewer server ops with tracking
+    # on, every round, not merely "no worse than last round".
+    ("config6 server-op reduction", ("details", "config6_server_op_reduction"), True, True),
+    ("config6 tracked read ops/s", ("details", "config6_tracked_read_ops_per_sec"), True, False),
+]
+
+# (label, extractor-path, minimum) — ABSOLUTE floors checked on the FRESH
+# run alone: unlike the relative gate, a floor holds from the metric's first
+# appearance (n/a only while the fresh run doesn't emit the metric at all).
+FLOORS = [
+    ("config6 server-op reduction >= 10x",
+     ("details", "config6_server_op_reduction"), 10.0),
 ]
 
 
@@ -130,6 +144,15 @@ def compare(baseline: dict, fresh: dict, threshold: float) -> Tuple[list, bool]:
         elif delta < 0:
             status = "fail(soft)" if gated else "warn(soft)"
         rows.append((label, b, f, delta, status))
+    for label, path, floor in FLOORS:
+        f = _extract(fresh, path)
+        if f is None:
+            rows.append((label, floor, f, None, "n/a"))
+            continue
+        passed = f >= floor
+        rows.append((label, floor, f, None, "OK" if passed else "FAIL"))
+        if not passed:
+            ok = False
     return rows, ok
 
 
@@ -146,9 +169,10 @@ def render(rows, threshold: float) -> str:
     out.append("-" * 82)
     out.append(
         f"gate: >{threshold:.0%} regression in headline, config5, config5p, "
-        "config2 flush p99, or config4 cold fails; other drops are advisory "
-        "(WARN); a metric absent from the baseline reads n/a and passes "
-        "(recorded on first sight)"
+        "config2 flush p99, config4 cold, or config6 reduction fails; other "
+        "drops are advisory (WARN); a metric absent from the baseline reads "
+        "n/a and passes (recorded on first sight).  Absolute floors "
+        "(config6 server-op reduction >= 10x) bind from first sight."
     )
     return "\n".join(out)
 

@@ -22,10 +22,17 @@
     acked-durable-write loss, exactly-one-owner residency, all slots
     STABLE, acked bloom adds intact.  One two-phase cycle runs in well
     under 60s.
+  * ``tracking`` — the near-cache coherence profile (ISSUE 7): zipf
+    readers with server-assisted near caches (CLIENT TRACKING) keep
+    reading while key-bearing slots migrate m0 -> m1 -> m0 and the
+    write-owning master is killed and failed over.  Asserts ZERO stale
+    tracked reads (no read ever goes backwards; every near cache
+    converges to ground truth after quiesce) and that server tracking
+    tables drain to zero when reader connections die.
 
 Usage:
     JAX_PLATFORMS=cpu python tools/soak_smoke.py \
-        [--profile standard|migration|cluster-proc]
+        [--profile standard|migration|cluster-proc|tracking]
         [--cycles N] [--seed S] [--phase SECONDS] [--no-kill]
 
 Exit code 0 = every assertion held; the report summary prints either way.
@@ -47,7 +54,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--profile",
-                    choices=("standard", "migration", "cluster-proc"),
+                    choices=("standard", "migration", "cluster-proc",
+                             "tracking"),
                     default="standard")
     ap.add_argument("--cycles", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
@@ -61,7 +69,16 @@ def main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
-    if args.profile == "cluster-proc":
+    if args.profile == "tracking":
+        from redisson_tpu.chaos.soak import (
+            TrackingSoakConfig, TrackingSoakHarness,
+        )
+
+        harness = TrackingSoakHarness(TrackingSoakConfig(
+            cycles=args.cycles, seed=args.seed,
+            kill=not args.no_kill,
+        ))
+    elif args.profile == "cluster-proc":
         from redisson_tpu.chaos.soak import (
             ClusterProcSoakConfig, ClusterProcSoakHarness,
         )
