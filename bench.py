@@ -557,6 +557,183 @@ def bench_config5p_cluster_proc():
     }
 
 
+def _tenant_of_cmd(cmd) -> int:
+    """Tenant index of a mixed-workload command (the {tN} hash tag)."""
+    for a in cmd:
+        if isinstance(a, str) and "{t" in a:
+            return int(a[a.index("{t") + 2 : a.index("}", a.index("{t"))])
+    raise ValueError(f"no tenant tag in {cmd[:2]}")
+
+
+def _run_mixed_mt(host, port, make_cmds, conns=8, reps=3):
+    """The config5d driver: the SAME mixed workload, split by tenant across
+    `conns` CONCURRENT connections (the multi-client serving shape — a
+    single connection's pipelined frame fragments into per-recv parse
+    batches at the server, so cross-device overlap needs concurrent
+    clients, exactly like production traffic).  Per-tenant command order is
+    preserved (each tenant lives on exactly one connection).  Returns
+    (rates, ops, verification_replies) with verification replies
+    re-assembled in canonical command order for the leg bit-identity
+    check."""
+    import threading
+
+    from redisson_tpu.net.client import Connection
+
+    conn_objs = [Connection(host, port, timeout=600.0) for _ in range(conns)]
+
+    def run_tagged(tag):
+        cmds, ops = make_cmds(tag)
+        slices: list = [[] for _ in range(conns)]
+        for idx, cmd in enumerate(cmds):
+            slices[_tenant_of_cmd(cmd) % conns].append((idx, cmd))
+        replies: list = [None] * len(cmds)
+        start = threading.Barrier(conns + 1)
+        errs: list = []
+
+        def worker(j):
+            try:
+                start.wait()
+                out = conn_objs[j].execute_many([c for _i, c in slices[j]])
+                for (i, _c), r in zip(slices[j], out):
+                    replies[i] = r
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(j,), daemon=True)
+            for j in range(conns)
+        ]
+        for th in threads:
+            th.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        for cmd, r in zip(cmds, replies):
+            if cmd[0] == "BF.MEXISTS64":
+                assert np.frombuffer(r, np.uint8).all(), (
+                    f"false negatives in {cmd[1]}"
+                )
+        return replies, ops, wall
+
+    try:
+        run_tagged("w")  # warm: compiles + creates every tenant's records
+        rates = []
+        ops = 0
+        for rep in range(reps):
+            _, ops, wall = run_tagged(f"r{rep}")
+            rates.append(ops / wall)
+        ver_replies, _, _ = run_tagged("ver")
+    finally:
+        for c in conn_objs:
+            c.close()
+    return rates, ops, ver_replies
+
+
+def bench_config5d_device_sharded():
+    """Config 5D: the config5 mixed workload (shared VERBATIM via
+    ``_mixed_cluster_cmds``) against ONE ``tpu-server`` owning the whole
+    LOCAL DEVICE MESH (ISSUE 8: slot -> device placement + per-device
+    dispatch lanes), as a 1-device vs N-device A/B.
+
+    Both legs run the SAME lane-dispatch code path (placement enabled both
+    times; the 1-device leg simply owns every slot with one lane), the same
+    command stream (rng seed fixed per leg), and must return bit-identical
+    replies — the delta isolates cross-device dispatch concurrency.
+
+    On chip-less containers every forced host "device" is the same CPU, so
+    overlapping lanes wins no real compute — the CPU-replica occupancy
+    model (``ioplane.set_replica_occupancy``, RTPU_REPLICA_NS ns/item,
+    same scaled-replica discipline as the PR 3 overlap-efficiency number)
+    charges each lane the per-chip compute time N real chips would
+    serialize per device and overlap across devices.  On a real TPU the
+    model stays DISARMED and the A/B measures actual chips.
+
+    Sub-metrics: ``dispatch_concurrency_peak`` (LaneSet.peak_concurrent —
+    >1 proves frames actually fan out across lanes) and the per-device
+    IOStats split."""
+    import os
+
+    import jax
+
+    from redisson_tpu.core import ioplane
+    from redisson_tpu.server.server import ServerThread
+
+    devices = jax.local_devices()
+    n_local = len(devices)
+    platform = devices[0].platform
+    replica_ns = (
+        float(os.environ.get("RTPU_REPLICA_NS", "10000"))
+        if platform == "cpu" else None
+    )
+    legs = {}
+    reply_digests = {}
+    for leg, n_dev in (("1dev", 1), (f"{n_local}dev", n_local)):
+        st = ServerThread(port=0, devices=n_dev, workers=16).start()
+        prev_ns = ioplane.set_replica_occupancy(replica_ns)
+        ioplane.reset_device_stats()
+        try:
+            engine = st.server.engine
+            make_cmds = _mixed_cluster_cmds(np.random.default_rng(11))
+            engine.lanes.reset_concurrency()
+            rates, ops, ver = _run_mixed_mt(
+                st.server.host, st.server.port, make_cmds, conns=8, reps=3
+            )
+            peak = engine.lanes.reset_concurrency()
+            reply_digests[leg] = ver
+            per_dev = {
+                str(d): {"syncs": s["blocking_syncs"]}
+                for d, s in ioplane.device_stats_snapshot().items()
+            }
+            lane_dispatches = {
+                lane.dev_id: lane.dispatches for lane in engine.lanes.lanes()
+            }
+            legs[leg] = {
+                "devices": n_dev,
+                "rates": [round(r) for r in rates],
+                "best": max(rates),
+                "ops": ops,
+                "dispatch_concurrency_peak": peak,
+                "lane_dispatches": lane_dispatches,
+                "per_device_stats": per_dev,
+            }
+            log(
+                f"config5d[{leg}]: {ops} mixed ops, one server, {n_dev} "
+                f"device(s) = {max(rates)/1e3:.0f}k ops/s (best of "
+                f"{len(rates)}: {['%.0fk' % (r/1e3) for r in rates]}), "
+                f"peak lane concurrency {peak}, lane dispatches "
+                f"{lane_dispatches}"
+            )
+        finally:
+            ioplane.set_replica_occupancy(prev_ns)
+            st.stop()
+    one, many = legs["1dev"], legs[f"{n_local}dev"]
+    assert reply_digests["1dev"] == reply_digests[f"{n_local}dev"], (
+        "config5d legs must be bit-identical"
+    )
+    speedup = many["best"] / one["best"] if one["best"] else 0.0
+    log(
+        f"config5d: {n_local}-device {many['best']/1e3:.0f}k vs 1-device "
+        f"{one['best']/1e3:.0f}k ops/s = {speedup:.2f}x (platform "
+        f"{platform}, replica occupancy "
+        f"{'%.0fns/item' % replica_ns if replica_ns else 'disarmed'}), "
+        f"replies bit-identical"
+    )
+    return {
+        "device_sharded_ops_per_sec": round(many["best"]),
+        "speedup_vs_1dev": round(speedup, 3),
+        "n_devices": n_local,
+        "platform": platform,
+        "replica_occupancy_ns_per_item": replica_ns,
+        "dispatch_concurrency_peak": many["dispatch_concurrency_peak"],
+        "legs": legs,
+        "replies_bit_identical": True,
+    }
+
+
 def bench_config2a_async_parity():
     """Config 2A: async facade throughput parity on the config2 serving
     shape (VERDICT r4 next-step #8).  One server on the chip; the SAME
@@ -837,6 +1014,19 @@ def child(which: str) -> None:
         result = bench_config5p_cluster_proc()
         print("@@RESULT " + json.dumps(result), flush=True)
         return
+    if which == "5d":
+        # device-sharded serving: make sure a chip-less container still has
+        # a mesh to shard over (8 forced host devices — the same harness
+        # line tests/conftest.py and tools/soak_smoke.py use).  Set BEFORE
+        # the first jax import; on a TPU host the flag only affects the
+        # unused CPU backend.
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     dev = _init_jax()
     h2d = _probe_h2d(dev)
     log(f"config{which}: device {dev}, tunnel h2d probe {h2d:.0f} MB/s")
@@ -845,6 +1035,8 @@ def child(which: str) -> None:
     result: dict = {"h2d_mb_s": round(h2d), "device": str(dev)}
     if which == "5":
         result["cluster_mixed_ops_per_sec"] = round(bench_config5_cluster_mixed())
+    elif which == "5d":
+        result["device_sharded"] = bench_config5d_device_sharded()
     elif which == "2A":
         result["async_parity"] = bench_config2a_async_parity()
     elif which == "6":
@@ -887,7 +1079,7 @@ def main():
     import subprocess
 
     results: dict = {}
-    for which in ("2", "2L", "2A", "1", "3", "4", "5", "5p", "6"):
+    for which in ("2", "2L", "2A", "1", "3", "4", "5", "5p", "5d", "6"):
         p = subprocess.run(
             [sys.executable, __file__, "--config", which],
             stdout=subprocess.PIPE,
@@ -922,6 +1114,9 @@ def main():
                     "config5p_cluster_proc_ops_per_sec": results["5p"]["cluster_proc_mixed_ops_per_sec"],
                     "config5p_native_ab": results["5p"]["native_ab"],
                     "config5p_server_platform": results["5p"]["server_platform"],
+                    "config5d_device_sharded_ops_per_sec": results["5d"]["device_sharded"]["device_sharded_ops_per_sec"],
+                    "config5d_speedup_vs_1dev": results["5d"]["device_sharded"]["speedup_vs_1dev"],
+                    "config5d_device_sharded": results["5d"]["device_sharded"],
                     "config6_server_op_reduction": results["6"]["tracking"]["config6_server_op_reduction"],
                     "config6_tracked_read_ops_per_sec": results["6"]["tracking"]["config6_tracked_read_ops_per_sec"],
                     "config6_tracking": results["6"]["tracking"],

@@ -148,11 +148,14 @@ class BitSet(RExpirable):
     # -- BITOP against other bit sets (RedissonBitSet.java:387-446) ---------
 
     def _binary_op(self, op, other_names: Sequence[str]) -> None:
+        from redisson_tpu.core import ioplane
+
         other_names = [self._map_name(n) for n in other_names]
         names = (self._name, *other_names)
         with self._engine.locked_many(names):
             rec = self._rec_or_create()
             acc = rec.arrays["bits"]
+            acc_dev = ioplane.device_of(acc)
             for nm in other_names:
                 if nm == self._name:
                     continue
@@ -162,7 +165,10 @@ class BitSet(RExpirable):
                 elif other.kind != "bitset":
                     raise TypeError(f"'{nm}' is not a BitSet")
                 else:
-                    o_bits = other.arrays["bits"]
+                    # device-sharded slots: a source plane on another device
+                    # hops over d2d (never through the host) before the
+                    # donated combine — ioplane.colocate, counted
+                    o_bits = ioplane.colocate(other.arrays["bits"], acc_dev)
                 if o_bits.shape[0] > acc.shape[0]:
                     grown = bt.make(o_bits.shape[0])
                     acc = grown.at[: acc.shape[0]].set(acc)

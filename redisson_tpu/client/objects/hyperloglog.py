@@ -67,28 +67,52 @@ class HyperLogLog(RExpirable):
             est = K.hll_estimate(rec.arrays["regs"])
         return int(round(float(est)))
 
+    @staticmethod
+    def _spans_devices(regs_list) -> bool:
+        """True when the registers live on MORE than one committed device
+        (device-sharded placement put the slots on different chips)."""
+        from redisson_tpu.core.ioplane import device_of
+
+        seen = {d for d in map(device_of, regs_list) if d is not None}
+        return len(seen) > 1
+
     def count_with(self, *other_names: str) -> int:
-        """PFCOUNT over the union of this and other counters, non-destructive."""
+        """PFCOUNT over the union of this and other counters, non-destructive.
+
+        Registers spanning devices (device-sharded slots) merge ON-DEVICE
+        through the mesh collectives / d2d transfers
+        (parallel.manager.merge_across_devices) — never a host gather."""
         names = (self._name, *(self._map_name(n) for n in other_names))
         with self._engine.locked_many(names):
-            regs = None
+            all_regs = []
             for nm in names:
                 rec = self._engine.store.get(nm)
-                if rec is None:
-                    continue
-                r = rec.arrays["regs"]
-                # merge produces a fresh array, so the estimate below never
-                # aliases a live (donatable) record buffer
-                regs = hll_ops.merge(r, r) if regs is None else hll_ops.merge(regs, r)
-            est = None if regs is None else K.hll_estimate(regs)
-        return 0 if est is None else int(round(float(est)))
+                if rec is not None:
+                    all_regs.append(rec.arrays["regs"])
+            if not all_regs:
+                return 0
+            if self._spans_devices(all_regs):
+                from redisson_tpu.parallel.manager import merge_across_devices
+
+                regs = merge_across_devices(all_regs)
+            else:
+                regs = None
+                for r in all_regs:
+                    # merge produces a fresh array, so the estimate below
+                    # never aliases a live (donatable) record buffer
+                    regs = hll_ops.merge(r, r) if regs is None else hll_ops.merge(regs, r)
+            est = K.hll_estimate(regs)
+        return int(round(float(est)))
 
     def merge_with(self, *other_names: str) -> None:
-        """PFMERGE other counters into this one (RedissonHyperLogLog.java:96-102)."""
+        """PFMERGE other counters into this one (RedissonHyperLogLog.java:96-102).
+        Cross-device sources merge on-device (see count_with) and the result
+        lands committed back on THIS record's device."""
         other_names = [self._map_name(n) for n in other_names]
         with self._engine.locked_many((self._name, *other_names)):
             rec = self._rec_or_create()
             regs = rec.arrays["regs"]
+            sources = []
             for nm in other_names:
                 if nm == self._name:  # self-merge is a no-op (and would alias
                     continue          # the donated buffer as a second arg)
@@ -97,6 +121,16 @@ class HyperLogLog(RExpirable):
                     continue
                 if other.kind != "hll":
                     raise TypeError(f"'{nm}' is not a HyperLogLog")
-                regs = K.hll_merge(regs, other.arrays["regs"])
+                sources.append(other.arrays["regs"])
+            if sources and self._spans_devices([regs, *sources]):
+                from redisson_tpu.core.ioplane import device_of
+                from redisson_tpu.parallel.manager import merge_across_devices
+
+                regs = merge_across_devices(
+                    [regs, *sources], dest_device=device_of(regs)
+                )
+            else:
+                for src in sources:
+                    regs = K.hll_merge(regs, src)
             rec.arrays["regs"] = regs
             self._touch_version(rec)

@@ -73,10 +73,24 @@ def _concat_segments(engine, keys_list) -> Tuple[np.ndarray, np.ndarray, List[in
     return slot, keys, lengths
 
 
+# ACTUAL committed device of a plane (None = uncommitted/host: stacks with
+# anything) — the ONE device-detection rule, shared process-wide
+from redisson_tpu.core.ioplane import device_of as _plane_device
+
+
 def _validated_records(engine, names: Sequence[str]):
-    """Fetch + geometry-check the run's records.  Caller holds the locks."""
+    """Fetch + geometry-check the run's records.  Caller holds the locks.
+
+    Device check (device-sharded serving, ISSUE 8): every plane in the
+    stack must live on ONE device — jnp.stack across committed devices
+    would have to gather through the host, which the coalescing plane must
+    never do.  The server splits runs per device BEFORE coalescing
+    (placement.plan_frame), so a mixed group here only happens mid-slot-
+    handoff — the run simply falls back to per-record dispatch (each record
+    executes on its own current device), never a host-side gather."""
     recs = []
     m = k = shape = hname = None
+    device = None
     for name in names:
         rec = engine.store.get(name)
         if rec is None or rec.kind != "bloom":
@@ -85,6 +99,7 @@ def _validated_records(engine, names: Sequence[str]):
             m, k = rec.meta["m"], rec.meta["k"]
             hname = rec.meta.get("hash")
             shape = rec.arrays["bits"].shape
+            device = _plane_device(rec.arrays["bits"])
         elif (
             rec.meta["m"] != m
             or rec.meta["k"] != k
@@ -92,20 +107,29 @@ def _validated_records(engine, names: Sequence[str]):
             or rec.arrays["bits"].shape != shape
         ):
             raise CoalesceIneligible("mixed filter geometry in run")
+        else:
+            d = _plane_device(rec.arrays["bits"])
+            if d is not None and device is not None and d != device:
+                raise CoalesceIneligible(
+                    "planes span devices (slot handoff in flight)"
+                )
+            device = device if device is not None else d
         recs.append(rec)
     if len(names) * shape[0] > K.BANK_MAX_CELLS:
         raise CoalesceIneligible("stacked planes exceed flat int32 index space")
     return recs, m, k
 
 
-def _pack_window(engine, slot: np.ndarray, keys: np.ndarray):
+def _pack_window(engine, slot: np.ndarray, keys: np.ndarray, device=None):
     """(slot, keys) -> staged (3, B) uint32 transfer buffer + n_valid.
     Staged through the engine's double-buffered pool (overlap plane): one
-    wave's packing overlaps the previous wave's in-flight upload."""
+    wave's packing overlaps the previous wave's in-flight upload.  With
+    placement on, `device` selects that device's LANE pool so two devices'
+    waves never contend on one slot pair (ISSUE 8)."""
     n = keys.shape[0]
     b = K.bucket_size(n)
     lo, hi = H.int_keys_to_u32_pair(keys)
-    return K.pack_rows(slot, lo, hi, size=b, pool=engine.staging_pool()), n
+    return K.pack_rows(slot, lo, hi, size=b, pool=engine.staging_pool(device)), n
 
 
 def fused_bloom_contains_async(engine, names: Sequence[str], keys_list):
@@ -116,7 +140,9 @@ def fused_bloom_contains_async(engine, names: Sequence[str], keys_list):
     sync: callers force on their own result path (frame-level gather on
     the server, np.asarray in the batch layer)."""
     slot, keys, lengths = _concat_segments(engine, keys_list)
-    tlh, n = _pack_window(engine, slot, keys)
+    tlh, n = _pack_window(
+        engine, slot, keys, device=engine.device_for_name(names[0])
+    )
     import jax.numpy as jnp
 
     with engine.locked_many(set(names)):
@@ -135,7 +161,9 @@ def fused_bloom_add_async(engine, names: Sequence[str], keys_list):
             "duplicate filter in add run (second group must observe the first)"
         )
     slot, keys, lengths = _concat_segments(engine, keys_list)
-    tlh, n = _pack_window(engine, slot, keys)
+    tlh, n = _pack_window(
+        engine, slot, keys, device=engine.device_for_name(names[0])
+    )
     import jax.numpy as jnp
 
     with engine.locked_many(set(names)):

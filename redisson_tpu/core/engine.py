@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import threading
 from contextlib import contextmanager
-from typing import Any, Iterable, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -65,6 +65,11 @@ class Engine:
         from redisson_tpu.core import ioplane
 
         self.staging = ioplane.StagingPool()
+        # device-sharded serving (ISSUE 8): slot -> local-device placement +
+        # one serving lane per device.  None (the default) = single-device
+        # behavior, bit for bit; enable_placement() opts in.
+        self.placement = None
+        self.lanes = None
 
     def service(self, key: str, factory):
         """Engine-scoped lazy singleton (script cache, search indexes, ...)
@@ -340,6 +345,130 @@ class Engine:
                     if entry[1] == 0:
                         self._record_locks.pop(n, None)
 
+    # -- device-sharded placement (ISSUE 8) -----------------------------------
+
+    def enable_placement(self, devices=None, n_devices: Optional[int] = None):
+        """Map the 16384-slot table onto the local device mesh: every
+        record created/installed from here on commits its device arrays to
+        the device owning its slot, frames routed to different devices
+        dispatch down per-device lanes (ioplane.LaneSet), and coalesced
+        runs fuse PER DEVICE.  Returns the SlotPlacement (rebalanceable
+        online via fenced slot handoffs — server/migration.rebalance_devices).
+        """
+        from redisson_tpu.core import ioplane
+        from redisson_tpu.server.placement import SlotPlacement
+
+        placement = SlotPlacement(devices=devices, n_devices=n_devices)
+        with self._locks_guard:
+            self.placement = placement
+            self.lanes = ioplane.LaneSet(placement.devices)
+        self.store.placement_hook = self._place_record
+        return placement
+
+    def device_for_name(self, name: str):
+        """Owner device of `name`'s slot, or None with placement off."""
+        p = self.placement
+        return None if p is None else p.device_for_name(name)
+
+    def _place_record(self, name: str, rec) -> None:
+        """DeviceStore placement hook: commit the record's single-device
+        arrays to the slot's owner.  Multi-device (mesh-sharded) planes are
+        never touched — the parallel/ layer owns their layout."""
+        p = self.placement
+        if p is None:
+            return
+        device = p.device_for_name(name)
+        import jax
+
+        for key, arr in list(rec.arrays.items()):
+            devs = getattr(arr, "devices", None)
+            if devs is not None:
+                try:
+                    ds = devs()
+                except TypeError:  # pragma: no cover
+                    continue
+                if len(ds) != 1 or ds == {device}:
+                    continue  # sharded plane, or already home
+            elif not isinstance(arr, np.ndarray):
+                continue  # host-side state (lists/dicts) never places
+            rec.arrays[key] = jax.device_put(arr, device)
+
+    @staticmethod
+    def _move_record_to(rec, device) -> bool:
+        """Commit a record's movable arrays to `device`; True iff anything
+        actually hopped.  Sharded (multi-device) planes and host-side state
+        never move; single-device jax arrays and numpy values do."""
+        import jax
+
+        changed = False
+        for key, arr in list(rec.arrays.items()):
+            devs = getattr(arr, "devices", None)
+            if devs is None:
+                if not isinstance(arr, np.ndarray):
+                    continue
+            else:
+                try:
+                    ds = devs()
+                except TypeError:  # pragma: no cover
+                    continue
+                if len(ds) != 1 or ds == {device}:
+                    continue
+            rec.arrays[key] = jax.device_put(arr, device)
+            changed = True
+        return changed
+
+    def move_slots_records(self, targets: Dict[int, int],
+                           epoch: Optional[int] = None,
+                           skip_stale: bool = False) -> Tuple[int, int]:
+        """BULK fenced slot -> device handoff: fence + repoint every slot
+        in ``targets`` ({slot: device_index}), then move the affected
+        records in ONE store scan (a full 8->4 rebalance repoints ~8192
+        owners; per-slot scans would be O(slots x keys)).  Each record
+        moves under its record lock: an in-flight dispatch holds the lock
+        and finishes on the old device; the next dispatch finds the plane
+        committed to the new one.  Returns (records_moved, stale_slots);
+        a stale coordinator's epoch raises PlacementStaleEpoch unless
+        ``skip_stale`` (the resume path) counts it instead."""
+        from redisson_tpu.server.placement import PlacementStaleEpoch
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        p = self.placement
+        if p is None:
+            raise RuntimeError("placement is not enabled on this engine")
+        fenced: Dict[int, int] = {}
+        stale = 0
+        for slot, dev_index in targets.items():
+            try:
+                p.assign(slot, dev_index, epoch)  # fences + repoints routing
+                fenced[slot] = dev_index
+            except PlacementStaleEpoch:
+                if not skip_stale:
+                    raise
+                stale += 1  # a newer rebalance owns this slot now
+        if not fenced:
+            return 0, stale
+        moving = [
+            (n, fenced[s])
+            for n in self.store.keys()
+            for s in (calc_slot(n.encode()),)
+            if s in fenced
+        ]
+        moved = 0
+        for name, dev_index in moving:
+            device = p.devices[dev_index]
+            with self.locked(name):
+                rec = self.store.get_unguarded(name)
+                if rec is not None and self._move_record_to(rec, device):
+                    moved += 1
+        return moved, stale
+
+    def move_slot_records(self, slot: int, dev_index: int,
+                          epoch: Optional[int] = None) -> int:
+        """One fenced slot -> device handoff (CLUSTER DEVMOVE's unit);
+        see move_slots_records for the bulk form and the contract."""
+        moved, _stale = self.move_slots_records({slot: dev_index}, epoch)
+        return moved
+
     # -- kernel warm pool ----------------------------------------------------
 
     @property
@@ -349,27 +478,46 @@ class Engine:
 
         return warmpool.POOL
 
-    def prewarm(self, names=None, buckets=(0,)) -> int:
+    def prewarm(self, names=None, buckets=(0,), all_devices: Optional[bool] = None) -> int:
         """Precompile the hot kernels of live records at the given batch
         buckets (TasksRunnerService warm-pool analog) — run at boot or
         before a timed serving phase, never on the hot path.  Returns the
-        number of programs actually compiled/loaded this call."""
+        number of programs actually compiled/loaded this call.
+
+        With placement enabled (device-sharded serving) the default warms
+        every record's geometry on EVERY local device — jit specializes per
+        device placement, so a slot handoff onto a cold device would
+        otherwise pay a first-dispatch compile mid-serving.  Pass
+        ``all_devices=False`` to warm only each record's current owner."""
         from redisson_tpu.core import warmpool
 
-        return warmpool.prewarm_store(self, names=names, buckets=buckets)
+        if all_devices is None:
+            all_devices = self.placement is not None
+        return warmpool.prewarm_store(
+            self, names=names, buckets=buckets,
+            devices=(self.placement.devices
+                     if (all_devices and self.placement is not None) else None),
+        )
 
     # -- overlapped device I/O ----------------------------------------------
 
-    def staging_pool(self):
+    def staging_pool(self, device=None):
         """The engine's double-buffered host staging pool — or None when the
         overlap plane is off (--no-overlap: serial A/B reference) or the
         backend zero-copy-aliases host memory (CPU jax), where slot reuse
-        would corrupt a staged value (ioplane.staging_reuse_safe)."""
+        would corrupt a staged value (ioplane.staging_reuse_safe).
+
+        With placement enabled and a `device` given, the DEVICE'S lane pool
+        is returned instead of the shared one: each device's uploads double-
+        buffer independently, so two lanes' flush packing never contends on
+        one slot pair (the per-chip lane discipline, ISSUE 8)."""
         from redisson_tpu.core import ioplane
 
-        if ioplane.overlap_enabled() and ioplane.staging_reuse_safe():
-            return self.staging
-        return None
+        if not (ioplane.overlap_enabled() and ioplane.staging_reuse_safe()):
+            return None
+        if device is not None and self.lanes is not None:
+            return self.lanes.lane(device).pool
+        return self.staging
 
     # -- key packing --------------------------------------------------------
 
@@ -445,6 +593,8 @@ class Engine:
             eviction.close()
         self.pubsub.close()
         self.staging.clear()
+        if self.lanes is not None:
+            self.lanes.clear()
         self.store.flushall()
 
 

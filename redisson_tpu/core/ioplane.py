@@ -89,10 +89,16 @@ class IOStats:
     quantity the structural smoke test bounds.  ``readback_exposed_s``
     accumulates ONLY the readback wall time spent while the device value was
     not yet ready (the un-hidden part); bench.py derives overlap efficiency
-    as 1 - exposed/serial_total."""
+    as 1 - exposed/serial_total.
+
+    ``d2d_colocations`` / ``host_colocations`` audit the cross-device merge
+    discipline (ISSUE 8): moving a device value onto another device for an
+    on-device merge must be a direct device transfer (``colocate``), never a
+    host round trip — the soak/tests assert host_colocations stays 0."""
 
     __slots__ = ("_lock", "blocking_syncs", "readbacks", "readback_wait_s",
-                 "readback_exposed_s", "staging_waits", "barrier_wait_s")
+                 "readback_exposed_s", "staging_waits", "barrier_wait_s",
+                 "d2d_colocations", "host_colocations")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -105,6 +111,8 @@ class IOStats:
         self.readback_exposed_s = 0.0
         self.staging_waits = 0
         self.barrier_wait_s = 0.0
+        self.d2d_colocations = 0
+        self.host_colocations = 0
 
     def count_sync(self, n: int = 1) -> None:
         with self._lock:
@@ -128,6 +136,13 @@ class IOStats:
             if not was_ready:
                 self.readback_exposed_s += wall_s
 
+    def count_colocation(self, via_host: bool) -> None:
+        with self._lock:
+            if via_host:
+                self.host_colocations += 1
+            else:
+                self.d2d_colocations += 1
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -137,10 +152,99 @@ class IOStats:
                 "readback_exposed_s": self.readback_exposed_s,
                 "staging_waits": self.staging_waits,
                 "barrier_wait_s": self.barrier_wait_s,
+                "d2d_colocations": self.d2d_colocations,
+                "host_colocations": self.host_colocations,
             }
 
 
 STATS = IOStats()
+
+# -- per-device stats (ISSUE 8: IOStats split per device) ---------------------
+# One IOStats per local device id, lazily created: the per-device serving
+# lanes attribute their gathers/syncs here IN ADDITION to the global STATS
+# (the global counters keep their exact historical semantics — every
+# structural contract pinned against STATS is unchanged).
+
+_DEVICE_STATS: dict = {}
+_DEVICE_STATS_LOCK = threading.Lock()
+
+
+def device_stats(dev_id: int) -> IOStats:
+    with _DEVICE_STATS_LOCK:
+        st = _DEVICE_STATS.get(dev_id)
+        if st is None:
+            st = _DEVICE_STATS[dev_id] = IOStats()
+        return st
+
+
+def device_stats_snapshot() -> dict:
+    with _DEVICE_STATS_LOCK:
+        stats = dict(_DEVICE_STATS)
+    return {d: s.snapshot() for d, s in stats.items()}
+
+
+def reset_device_stats() -> None:
+    with _DEVICE_STATS_LOCK:
+        for s in _DEVICE_STATS.values():
+            s.reset()
+
+
+def device_of(value):
+    """Single committed device of a jax array, else None (numpy values,
+    uncommitted arrays, multi-device sharded planes)."""
+    devs = getattr(value, "devices", None)
+    if devs is None:
+        return None
+    try:
+        ds = devs()
+    except TypeError:  # pragma: no cover
+        return None
+    return next(iter(ds)) if len(ds) == 1 else None
+
+
+def _device_id_of(value) -> Optional[int]:
+    """Single committed device id of a jax array, else None (numpy values
+    and multi-device sharded arrays)."""
+    devs = getattr(value, "devices", None)
+    if devs is None:
+        return None
+    try:
+        ds = devs()
+    except TypeError:  # pragma: no cover
+        return None
+    if len(ds) != 1:
+        return None
+    return next(iter(ds)).id
+
+
+def colocate(value, device):
+    """Move a device value onto `device` WITHOUT a host round trip: the
+    cross-device merge primitive (HLL PFMERGE/PFCOUNT across slots,
+    MapReduce chunk-merge, BITOP across records).  On TPU this is an ICI
+    device-to-device copy — the same interconnect the parallel/ mesh
+    collectives ride; the host fallback exists only for exotic transfer
+    failures and is COUNTED so the zero-host-gather contract is auditable
+    (STATS.host_colocations)."""
+    if device is None:
+        return value
+    devs = getattr(value, "devices", None)
+    if devs is None:
+        return value  # host value: the dispatch will stage it where needed
+    try:
+        if devs() == {device}:
+            return value
+    except TypeError:  # pragma: no cover
+        return value
+    import jax
+
+    try:
+        out = jax.device_put(value, device)
+        STATS.count_colocation(via_host=False)
+        return out
+    except Exception:  # noqa: BLE001 — transfer path unavailable: go via host
+        out = jax.device_put(np.asarray(value), device)
+        STATS.count_colocation(via_host=True)
+        return out
 
 
 def _is_ready(x) -> bool:
@@ -166,7 +270,12 @@ def barrier(values) -> None:
 
     t0 = time.perf_counter()
     jax.block_until_ready(values)
-    STATS.add_barrier(time.perf_counter() - t0)
+    wall = time.perf_counter() - t0
+    STATS.add_barrier(wall)
+    for dev_id in {
+        d for d in (_device_id_of(v) for v in values) if d is not None
+    }:
+        device_stats(dev_id).add_barrier(wall)
 
 
 # -- readback futures ----------------------------------------------------------
@@ -209,6 +318,10 @@ class ReadbackFuture:
     def result(self):
         if not self._done:
             was_ready = all(_is_ready(v) for v in self._device)
+            dev_ids = {
+                d for d in (_device_id_of(v) for v in self._device)
+                if d is not None
+            }
             t0 = time.perf_counter()
             try:
                 host = tuple(np.asarray(v) for v in self._device)
@@ -218,20 +331,48 @@ class ReadbackFuture:
                 self._done = True
                 self._device = ()
             else:
-                STATS.add_readback(time.perf_counter() - t0, was_ready)
+                wall = time.perf_counter() - t0
+                STATS.add_readback(wall, was_ready)
+                for dev_id in dev_ids:  # per-lane sync ledger (ISSUE 8)
+                    device_stats(dev_id).add_readback(wall, was_ready)
                 self._deliver(host)
         if self._error is not None:
             raise self._error
         return self._value
 
 
+_GATHER_POOL = None
+_GATHER_POOL_LOCK = threading.Lock()
+
+
+def _gather_pool():
+    """Small shared pool for CONCURRENT per-device d2h fetches: with the
+    slot table device-sharded (ISSUE 8), one frame's results live on
+    several devices and cannot concatenate into one stream — fetching the
+    per-device sub-streams in parallel overlaps their transfer latencies
+    (on the tunnel each sync costs its fixed floor REGARDLESS of size, so
+    serializing D fetches would pay D floors)."""
+    global _GATHER_POOL
+    with _GATHER_POOL_LOCK:
+        if _GATHER_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _GATHER_POOL = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="rtpu-d2h"
+            )
+        return _GATHER_POOL
+
+
 def gather_device_results(groups: Sequence[Sequence[Any]]) -> List[tuple]:
-    """Fetch every device value of `groups` with ONE device->host transfer:
-    bitcast each value to a uint8 byte stream on device, concatenate, pull
-    once, split and reinterpret on the host.  Every sync through the tunnel
-    costs a fixed ~68ms regardless of size, so G groups at one transfer each
-    would pay G floors — this path pays ~one.  Constraint: each device
-    value's dtype must round-trip via ``np.dtype(a.dtype.name)``."""
+    """Fetch every device value of `groups` with ONE device->host transfer
+    PER DEVICE: bitcast each value to a uint8 byte stream on device,
+    concatenate per device, pull each device's merged stream (concurrently
+    when results span several devices), split and reinterpret on the host.
+    Every sync through the tunnel costs a fixed ~68ms regardless of size,
+    so G groups at one transfer each would pay G floors — this path pays
+    ~one per touched device, and the per-device fetches overlap.
+    Constraint: each device value's dtype must round-trip via
+    ``np.dtype(a.dtype.name)``."""
     import jax
     import jax.numpy as jnp
 
@@ -256,20 +397,45 @@ def gather_device_results(groups: Sequence[Sequence[Any]]) -> List[tuple]:
                 was_bool,
             ))
         index.append(pos)
-    parts = [f[0] for f in flat]
-    sizes = [int(p.shape[0]) for p in parts]
-    if not parts:
+    if not flat:
         return [() for _ in groups]
-    STATS.count_sync()
-    if len(parts) == 1:
-        merged = np.asarray(parts[0])
+    # bucket flat positions by committed device: cross-device streams can
+    # neither concatenate nor ride one transfer — each device gets its own
+    # merged stream (device-sharded serving, ISSUE 8).  The common single-
+    # device case degenerates to exactly the historical one-transfer shape.
+    buckets: "dict[Optional[int], List[int]]" = {}
+    for fi, (part, _d, _s, _b) in enumerate(flat):
+        buckets.setdefault(_device_id_of(part), []).append(fi)
+
+    host: List[Any] = [None] * len(flat)
+
+    def fetch_bucket(dev_id, fis) -> None:
+        parts = [flat[fi][0] for fi in fis]
+        sizes = [int(p.shape[0]) for p in parts]
+        STATS.count_sync()
+        if dev_id is not None:
+            device_stats(dev_id).count_sync()
+        if len(parts) == 1:
+            merged = np.asarray(parts[0])
+            chunks = [merged]
+        else:
+            merged = np.asarray(jnp.concatenate(parts))  # one transfer/device
+            chunks = np.split(merged, np.cumsum(sizes)[:-1])
+        for fi, chunk in zip(fis, chunks):
+            _p, dtype, shape, was_bool = flat[fi]
+            v = np.ascontiguousarray(chunk).view(dtype).reshape(shape)
+            host[fi] = v.astype(bool) if was_bool else v
+
+    items = list(buckets.items())
+    if len(items) == 1:
+        fetch_bucket(*items[0])
     else:
-        merged = np.asarray(jnp.concatenate(parts))  # THE one transfer
-    chunks = np.split(merged, np.cumsum(sizes)[:-1]) if len(parts) > 1 else [merged]
-    host: List[Any] = []
-    for chunk, (_p, dtype, shape, was_bool) in zip(chunks, flat):
-        v = np.ascontiguousarray(chunk).view(dtype).reshape(shape)
-        host.append(v.astype(bool) if was_bool else v)
+        futs = [
+            _gather_pool().submit(fetch_bucket, dev_id, fis)
+            for dev_id, fis in items
+        ]
+        for f in futs:
+            f.result()  # surface the first failure (caller falls back)
     return [tuple(host[i] for i in pos) for pos in index]
 
 
@@ -434,3 +600,145 @@ class FlushPipeline:
                 fut.result()
             except Exception:  # noqa: BLE001
                 pass
+
+
+# -- per-device serving lanes (ISSUE 8: device-sharded slot ownership) --------
+#
+# With the slot table mapped onto the local device mesh, ONE flush lane is a
+# structural bottleneck: frames routed to different devices would still
+# serialize through a single StagingPool/FlushPipeline and a single IOStats
+# ledger.  A DeviceLane is the per-chip lane — its own double-buffered
+# staging pool, its own dispatch-ahead pipeline, its own stats — and LaneSet
+# is the engine's registry of them, plus the cross-lane dispatch-concurrency
+# accounting bench.py's config5d reports.
+
+_replica_ns_per_item: Optional[float] = None
+
+
+def set_replica_occupancy(ns_per_item: Optional[float]) -> Optional[float]:
+    """Arm/disarm the CPU-replica device-occupancy model: with a value set,
+    every ``DeviceLane.occupy(n_items)`` holds its lane for n_items *
+    ns_per_item nanoseconds — modeling the per-chip compute time a real
+    accelerator would serialize on its stream.  This exists ONLY for A/B
+    measurement on chip-less containers (bench config5d; the same
+    scaled-down-replica discipline as the PR 3 overlap-efficiency CPU
+    number): the 1-device leg serializes the modeled occupancy through one
+    lane, the N-device leg overlaps it across lanes, exactly as N chips
+    would.  Disarmed (None, the default) a lane's occupy() costs one
+    uncontended lock acquisition.  Returns the previous value."""
+    global _replica_ns_per_item
+    prev = _replica_ns_per_item
+    _replica_ns_per_item = ns_per_item
+    return prev
+
+
+def replica_occupancy() -> Optional[float]:
+    return _replica_ns_per_item
+
+
+class DeviceLane:
+    """One device's serving lane: staging pool + flush pipeline + stats +
+    the dispatch-occupancy gate (a mutex standing in for the device stream:
+    dispatches bound for one device serialize, dispatches bound for
+    different devices overlap)."""
+
+    def __init__(self, device, laneset: "LaneSet", depth: int = 2):
+        self.device = device
+        self.dev_id = getattr(device, "id", 0)
+        self.pool = StagingPool(depth=depth)
+        self.pipeline = FlushPipeline(depth=depth)
+        self.stats = device_stats(self.dev_id)
+        self._laneset = laneset
+        self._gate = threading.Lock()
+        self.dispatches = 0
+
+    def occupy(self, n_items: int = 0):
+        """Context manager bounding one dispatch's device occupancy: holds
+        the lane gate (per-device serialization) and, under the CPU-replica
+        knob, the modeled per-chip compute time for `n_items` ops."""
+        return _LaneOccupancy(self, n_items)
+
+
+class _LaneOccupancy:
+    __slots__ = ("_lane", "_n")
+
+    def __init__(self, lane: DeviceLane, n_items: int):
+        self._lane = lane
+        self._n = n_items
+
+    def __enter__(self):
+        self._lane._gate.acquire()
+        self._lane._laneset._enter()
+        self._lane.dispatches += 1
+        return self._lane
+
+    def __exit__(self, *exc):
+        try:
+            ns = _replica_ns_per_item
+            if ns is not None and self._n > 0:
+                time.sleep(self._n * ns * 1e-9)
+        finally:
+            self._lane._laneset._exit()
+            self._lane._gate.release()
+        return False
+
+
+class LaneSet:
+    """The engine's per-device lane registry + cross-lane concurrency
+    accounting (``peak_concurrent`` is bench config5d's dispatch-concurrency
+    sub-metric: >1 proves frames routed to different devices actually
+    dispatched in parallel)."""
+
+    def __init__(self, devices: Sequence[Any], depth: int = 2):
+        self._lanes = {
+            getattr(d, "id", i): DeviceLane(d, self, depth=depth)
+            for i, d in enumerate(devices)
+        }
+        self._lock = threading.Lock()
+        self._active = 0
+        self.peak_concurrent = 0
+
+    def lane(self, device) -> DeviceLane:
+        dev_id = device if isinstance(device, int) else getattr(device, "id", 0)
+        lane = self._lanes.get(dev_id)
+        if lane is None:  # unknown device (placement grew): one-off lane
+            with self._lock:
+                lane = self._lanes.get(dev_id)
+                if lane is None:
+                    lane = self._lanes[dev_id] = DeviceLane(device, self)
+        return lane
+
+    def lanes(self) -> List[DeviceLane]:
+        return list(self._lanes.values())
+
+    def _enter(self) -> None:
+        with self._lock:
+            self._active += 1
+            if self._active > self.peak_concurrent:
+                self.peak_concurrent = self._active
+
+    def _exit(self) -> None:
+        with self._lock:
+            self._active -= 1
+
+    def active(self) -> int:
+        with self._lock:
+            return self._active
+
+    def reset_concurrency(self) -> int:
+        with self._lock:
+            prev, self.peak_concurrent = self.peak_concurrent, 0
+            return prev
+
+    def census(self) -> dict:
+        """Flat gauges for ResourceCensus: staging slots and in-flight
+        dispatch count must return to baseline after a storm."""
+        out = {"lanes": len(self._lanes), "active_dispatches": self.active()}
+        for dev_id, lane in sorted(self._lanes.items()):
+            out[f"lane{dev_id}_staging_slots"] = lane.pool.slot_count()
+        return out
+
+    def clear(self) -> None:
+        for lane in self._lanes.values():
+            lane.pool.clear()
+            lane.pipeline.drain()

@@ -65,6 +65,23 @@ class DeviceStore:
         # Contract: the callback must not reenter the store (lazy-expiry
         # sites fire while the store lock is held by reentrant callers).
         self.on_expired: Optional[Callable[[list], None]] = None
+        # Device-placement hook (ISSUE 8): fired with (name, record) at
+        # EVERY install chokepoint (get_or_create factory result, put,
+        # put_unguarded) so a placement-enabled engine commits the record's
+        # device arrays to the device owning its slot — creations, restores
+        # (checkpoint.load goes through put) and migration/replication
+        # imports (put_unguarded) all land on the right device through this
+        # ONE seam.  None (the default) keeps today's default-device
+        # behavior bit for bit.
+        self.placement_hook: Optional[Callable[[str, StateRecord], None]] = None
+
+    def _placed(self, name: str, rec: StateRecord) -> StateRecord:
+        if self.placement_hook is not None:
+            try:
+                self.placement_hook(name, rec)
+            except Exception:  # noqa: BLE001 — placement is an optimization:
+                pass           # a failed placement must never fail the write
+        return rec
 
     def _reaped(self, name: str) -> None:
         if self.on_expired is not None:
@@ -90,7 +107,7 @@ class DeviceStore:
             if rec is None:
                 rec = factory()
                 assert rec.kind == kind
-                self._states[name] = rec
+                self._states[name] = self._placed(name, rec)
             elif rec.kind != kind:
                 raise TypeError(
                     f"object '{name}' holds a {rec.kind}, requested {kind} "
@@ -107,14 +124,14 @@ class DeviceStore:
             cur = self._states.get(name)
             if (cur is None or cur.expired()) and self.absent_guard is not None:
                 self.absent_guard(name)
-            self._states[name] = rec
+            self._states[name] = self._placed(name, rec)
 
     def put_unguarded(self, name: str, rec: StateRecord) -> None:
         """Install bypassing the absent guard — ONLY for migration/replication
         transfer frames, which legitimately create records in windowed slots
         (the importing side) or overwrite during a drain."""
         with self._lock:
-            self._states[name] = rec
+            self._states[name] = self._placed(name, rec)
 
     def delete(self, name: str) -> bool:
         with self._lock:

@@ -12,13 +12,21 @@ here "warm" means the compiled program is resident in the in-process jit
 cache before serving starts.
 
 One process-global pool (jit caches are process-global), keyed by
-``(verb, shape, dtype, epoch)``:
+``(verb, shape, dtype, epoch, geometry, device)``:
 
   * verb   — logical kernel family ("bloom.add", "hll.add", "wc", ...);
   * shape  — the padded shape bucket(s) the program was built for;
   * dtype  — operand dtype discriminator;
   * epoch  — mesh epoch for sharded programs (a reshard invalidates those
-             builds; single-chip programs use epoch 0).
+             builds; single-chip programs use epoch 0);
+  * device — the PLACEMENT axis (ISSUE 8): jit specializes per committed
+             device, so with the slot table device-sharded a program warmed
+             on device 0 is cold on device 3.  ``prewarm_store`` therefore
+             warms each geometry on every requested device (the whole local
+             mesh under ``Engine.prewarm`` with placement on) — a slot
+             handoff onto any device then hits the pool with ZERO rebuilds.
+             Single-device engines use device id -1 (the default device),
+             preserving every pre-placement key.
 
 The pool only BOOKKEEPS which combinations are already warm (bounded LRU —
 it never pins device memory; compiled executables live in jax's own cache);
@@ -87,7 +95,23 @@ class KernelWarmPool:
 POOL = KernelWarmPool()
 
 
-def _warm_bloom(engine, rec, buckets: Iterable[int]) -> int:
+def _dev_key(device) -> int:
+    """Pool-key device axis: -1 = the default (pre-placement) device, so
+    single-device engines keep their historical keys exactly."""
+    return -1 if device is None else getattr(device, "id", 0)
+
+
+def _on(device, arr):
+    """Commit a throwaway warm plane to `device` (the kernel then compiles
+    FOR that device); None keeps the default placement."""
+    if device is None:
+        return arr
+    import jax
+
+    return jax.device_put(arr, device)
+
+
+def _warm_bloom(engine, rec, buckets: Iterable[int], device=None) -> int:
     import numpy as np
 
     import jax
@@ -106,19 +130,19 @@ def _warm_bloom(engine, rec, buckets: Iterable[int]) -> int:
             nv = K.valid_n(1)
             # throwaway zeros plane of the record's geometry: add kernels
             # DONATE their state, so real record planes never warm directly
-            bits = bt.make(m)
+            bits = _on(device, bt.make(m))
             bits, _ = K.bloom_add_packed(bits, lh, nv, k, m)
             K.bloom_contains_packed_bits(bits, lh, nv, k, m)
-            bits2 = bt.make(m)
+            bits2 = _on(device, bt.make(m))
             bits2, _ = K.bloom_add_packed_count(bits2, lh, nv, k, m)
             out = K.bloom_fused_add_contains(bits2, lh, nv, lh2, nv, k, m)
             jax.block_until_ready(out[0])
 
-        n += POOL.warm(("bloom", (b,), "u64", 0, (m, k)), thunk)
+        n += POOL.warm(("bloom", (b,), "u64", 0, (m, k), _dev_key(device)), thunk)
     return n
 
 
-def _warm_bloom_array(engine, rec, buckets: Iterable[int]) -> int:
+def _warm_bloom_array(engine, rec, buckets: Iterable[int], device=None) -> int:
     import numpy as np
 
     import jax
@@ -134,16 +158,19 @@ def _warm_bloom_array(engine, rec, buckets: Iterable[int]) -> int:
         def thunk(b=b):
             tlh = K.stage(np.zeros((3, b), np.uint32))
             nv = K.valid_n(1)
-            bank = jnp.zeros((tenants, m), jnp.uint8)
+            bank = _on(device, jnp.zeros((tenants, m), jnp.uint8))
             bank, _ = K.bloom_bank_add_packed_bits(bank, tlh, nv, k, m)
             out = K.bloom_bank_contains_packed_bits(bank, tlh, nv, k, m)
             jax.block_until_ready(out)
 
-        n += POOL.warm(("bloom_array", (tenants, b), "u64", 0, (m, k)), thunk)
+        n += POOL.warm(
+            ("bloom_array", (tenants, b), "u64", 0, (m, k), _dev_key(device)),
+            thunk,
+        )
     return n
 
 
-def _warm_hll(engine, rec, buckets: Iterable[int]) -> int:
+def _warm_hll(engine, rec, buckets: Iterable[int], device=None) -> int:
     import numpy as np
 
     import jax
@@ -160,7 +187,7 @@ def _warm_hll(engine, rec, buckets: Iterable[int]) -> int:
 
         def thunk(b=b):
             nv = K.valid_n(1)
-            dummy = jnp.zeros(shape, regs.dtype)
+            dummy = _on(device, jnp.zeros(shape, regs.dtype))
             if len(shape) == 2:
                 tlh = K.stage(np.zeros((3, b), np.uint32))
                 out = K.hll_bank_add_packed(dummy, tlh, nv, p)
@@ -169,7 +196,9 @@ def _warm_hll(engine, rec, buckets: Iterable[int]) -> int:
                 out = K.hll_add_packed(dummy, lh, nv, p)
             jax.block_until_ready(out)
 
-        n += POOL.warm(("hll", shape, str(regs.dtype), 0, (p, b)), thunk)
+        n += POOL.warm(
+            ("hll", shape, str(regs.dtype), 0, (p, b), _dev_key(device)), thunk
+        )
     return n
 
 
@@ -182,11 +211,19 @@ _KIND_WARMERS = {
 
 
 def prewarm_store(engine, names: Optional[Iterable[str]] = None,
-                  buckets: Iterable[int] = (0,)) -> int:
+                  buckets: Iterable[int] = (0,),
+                  devices: Optional[Iterable] = None) -> int:
     """Warm the hot verbs of every (named) live record at the given batch
     buckets (0 = the minimum bucket).  Returns the number of programs this
     call actually compiled/loaded; everything already warm is free.  Run at
-    server boot or before a timed serving phase — never on the hot path."""
+    server boot or before a timed serving phase — never on the hot path.
+
+    ``devices``: the placement axis — warm each geometry ON EACH of these
+    devices (Engine.prewarm passes the whole local mesh with placement on,
+    so ``tpu-server --prewarm`` compiles every device's kernels, not just
+    device 0's, and a later slot handoff re-hits the pool: 0 rebuilds).
+    None warms each record on its CURRENT device (the owner with placement
+    on, the default device otherwise)."""
     from redisson_tpu.core import kernels as K
 
     buckets = [K.bucket_size(max(1, b)) for b in buckets]
@@ -198,11 +235,16 @@ def prewarm_store(engine, names: Optional[Iterable[str]] = None,
         warmer = _KIND_WARMERS.get(rec.kind)
         if warmer is None:
             continue
+        if devices is not None:
+            devs = list(devices)
+        else:
+            devs = [engine.device_for_name(name)]  # None with placement off
         with engine.locked(name):
             rec = engine.store.get(name)
             if rec is None:
                 continue
-            warmed += warmer(engine, rec, buckets)
+            for dev in devs:
+                warmed += warmer(engine, rec, buckets, device=dev)
     return warmed
 
 

@@ -333,6 +333,17 @@ class PubSubConnection:
         self.on_disconnect: Optional[Callable[["PubSubConnection"], None]] = None
         self._disc_fired = False
         self._lock = threading.RLock()
+        # Serializes all I/O on the shared socket between the reader thread
+        # and subscriber sends.  An SSL object is NOT safe under a
+        # concurrent read+write (one thread in recv, another in sendall
+        # corrupts the TLS stream — reproduced as SSLEOFError / a silently
+        # dead subscription, the test_tls_pubsub_connection full-suite
+        # flake).  The reader waits for READABILITY outside this lock and
+        # holds it only for the short non-blocking-ish recv, so a
+        # subscribe() send waits at most the in-lock read timeout (50ms),
+        # never the 250ms poll interval.  RLock: a push listener may
+        # legitimately (un)subscribe on its own connection.
+        self._io_lock = threading.RLock()
         # pre-CLIENT-ID servers reply an error value -> feed works, just
         # not usable as a REDIRECT target.  Transport failures (timeout,
         # reset) must PROPAGATE instead: a live feed stuck with
@@ -364,28 +375,39 @@ class PubSubConnection:
             except ValueError:
                 pass
 
+    def send_locked(self, *args) -> None:
+        """The ONLY legal way to write this connection's socket once the
+        reader thread is running (see _io_lock)."""
+        with self._io_lock:
+            self._conn.send(*args)
+
     def subscribe(self, channel: str, listener: Callable[[str, bytes], None]) -> None:
+        # the send happens OUTSIDE self._lock: the reader thread dispatches
+        # pushes under _io_lock -> _lock, so a sender holding _lock while
+        # waiting on _io_lock would deadlock the pair
         with self._lock:
             fresh = channel not in self._listeners
             self._listeners.setdefault(channel, []).append(listener)
-            if fresh:
-                self._conn.send("SUBSCRIBE", channel)
+        if fresh:
+            self.send_locked("SUBSCRIBE", channel)
 
     def psubscribe(self, pattern: str, listener: Callable[[str, str, bytes], None]) -> None:
         with self._lock:
             fresh = pattern not in self._plisteners
             self._plisteners.setdefault(pattern, []).append(listener)
-            if fresh:
-                self._conn.send("PSUBSCRIBE", pattern)
+        if fresh:
+            self.send_locked("PSUBSCRIBE", pattern)
 
     def unsubscribe(self, channel: str) -> None:
         with self._lock:
-            if self._listeners.pop(channel, None) is not None:
-                self._conn.send("UNSUBSCRIBE", channel)
+            gone = self._listeners.pop(channel, None) is not None
+        if gone:
+            self.send_locked("UNSUBSCRIBE", channel)
 
     def remove_listener(self, channel: str, listener) -> None:
         """Detach ONE listener; unsubscribes only when the last one goes
         (handles sharing a channel on one connection keep receiving)."""
+        unsub = False
         with self._lock:
             listeners = self._listeners.get(channel)
             if listeners is None:
@@ -396,7 +418,9 @@ class PubSubConnection:
                 return
             if not listeners:
                 del self._listeners[channel]
-                self._conn.send("UNSUBSCRIBE", channel)
+                unsub = True
+        if unsub:
+            self.send_locked("UNSUBSCRIBE", channel)
 
     def channels(self) -> List[str]:
         with self._lock:
@@ -429,13 +453,69 @@ class PubSubConnection:
                     pass           # kill push delivery for the connection
 
     def _reader(self) -> None:
-        while not self._stop.is_set() and not self._conn.closed:
+        import select as _select
+
+        conn = self._conn
+        while not self._stop.is_set() and not conn.closed:
             try:
-                value = self._conn.read_reply(timeout=0.25)
-                # subscribe/unsubscribe confirmations arrive here; ignore
-                _ = value
+                # wait for readability OUTSIDE the I/O lock (holding it
+                # across a blocking recv would stall subscribe sends for
+                # the whole poll interval); SSL sockets may hold decrypted
+                # bytes the kernel fd no longer shows — check pending()
+                sock = conn._sock
+                if not (
+                    conn._pending
+                    or getattr(sock, "pending", lambda: 0)()
+                ):
+                    readable, _, _ = _select.select([sock], [], [], 0.25)
+                    if not readable:
+                        continue
+                # in-lock: ONE immediate recv + parse, never a timed wait —
+                # a sender (subscribe/unsubscribe) must only ever block for
+                # this, not for a read budget (a 50ms in-lock wait showed up
+                # whole in lock-handoff latency via UNSUBSCRIBE-on-close)
+                batch = []
+                with self._io_lock:
+                    if not conn._pending:
+                        sock.settimeout(0.05)  # partial-TLS-record bound
+                        try:
+                            data = sock.recv(1 << 16)
+                        except socket.timeout:
+                            data = None
+                        finally:
+                            # the 50ms budget is the READER's only; leaving
+                            # it on the shared socket would put every
+                            # subscribe/unsubscribe sendall under it
+                            sock.settimeout(conn.timeout)
+                        if data is not None:
+                            if not data:
+                                conn.close()
+                                raise ConnectionError_(
+                                    "pubsub connection closed by peer"
+                                )
+                            plane = _fault_plane
+                            if plane is not None:
+                                # chaos parity with read_reply: injected
+                                # drops/truncation hit the push feed too
+                                data = plane.on_recv(conn, data)
+                            if data is not None:
+                                conn._pending.extend(conn._parser.feed(data))
+                    while conn._pending:
+                        batch.append(conn._pending.popleft())
+                # route pushes OUTSIDE the lock: listener callbacks may be
+                # slow or (re)subscribe on this very connection
+                for value in batch:
+                    if isinstance(value, Push):
+                        if conn.push_handler is not None:
+                            conn.push_handler(value)
+                    # else: subscribe/unsubscribe confirmations; ignore
             except CommandTimeoutError:
                 continue
+            except ValueError:
+                # select on a fd closed mid-wait (close() raced the loop)
+                if not self._stop.is_set():
+                    self._fire_disconnect()
+                return
             except (ConnectionError, OSError):
                 # watchdog (NodeClient) owns reconnect; the tracking plane's
                 # reconnection-CLEAR discipline hangs off this edge (a feed
@@ -923,9 +1003,9 @@ class NodeClient:
                     fresh._listeners = self._pubsub._listeners
                     fresh._plisteners = self._pubsub._plisteners
                     for channel in fresh._listeners:
-                        fresh._conn.send("SUBSCRIBE", channel)
+                        fresh.send_locked("SUBSCRIBE", channel)
                     for pattern in fresh._plisteners:
-                        fresh._conn.send("PSUBSCRIBE", pattern)
+                        fresh.send_locked("PSUBSCRIBE", pattern)
                 self._pubsub = fresh
             return self._pubsub
 

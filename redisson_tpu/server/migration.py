@@ -146,6 +146,12 @@ def resume_migrations(
             journal.append("ROLLED_BACK", resumed=True, reason="empty journal")
             out.append({"id": journal.migration_id, "action": "rolled_back"})
             continue
+        if planned.get("kind") == "device_rebalance":
+            # intra-process device moves (ISSUE 8) share the journal
+            # directory's epoch allocator but resume through
+            # resume_device_rebalances — treating one as a slot migration
+            # would dial "dev:N" as a node address
+            continue
         run = _MigrationRun(
             planned["source"], planned["target"], planned["slots"],
             all_nodes=planned.get("all_nodes"), password=password,
@@ -201,7 +207,7 @@ def rearm_recovery(server, journal_dir: str) -> int:
     addr = server.address()
     for journal in MigrationJournal.in_flight(journal_dir):
         planned = journal.entry("PLANNED")
-        if planned is None:
+        if planned is None or planned.get("kind") == "device_rebalance":
             continue
         slots = [int(s) for s in planned["slots"]]
         epoch = journal.epoch
@@ -480,6 +486,149 @@ def _rollback(src, tgt, source: str, target: str, slots, old_view,
                     c.execute("CLUSTER", "SETVIEW", *flat, timeout=10.0)
                 except Exception:  # noqa: BLE001 — unreachable node
                     pass
+
+
+# -- journaled DEVICE rebalance (ISSUE 8: slot -> device handoffs) ------------
+#
+# A device move is a slot handoff INSIDE one process (no wire drain, no view
+# commit), but it shares the failure mode journaled slot migrations exist
+# for: a coordinator killed mid-rebalance leaves half the move set on the
+# old device with no record of intent, and a STALE coordinator resuming
+# later must not clobber a newer move.  So device moves ride the same
+# machinery — one MigrationJournal per rebalance (kind="device_rebalance" in
+# PLANNED so the two resume paths never cross), the journal directory's
+# monotonic epoch allocator, per-slot fencing on the SlotPlacement
+# (PlacementStaleEpoch == the STALEEPOCH reply), and kill-at-every-phase
+# resume: PLANNED -> DRAINING (per-batch progress) -> STABLE.
+
+_DEVICE_PHASES = ("PLANNED", "DRAINING", "STABLE")
+
+
+def rebalance_devices(
+    engine,
+    targets: Dict[int, int],
+    journal_dir: Optional[str] = None,
+    crash_after: Optional[str] = None,
+    batch: int = 256,
+) -> int:
+    """Move the slots in ``targets`` ({slot: device_index}) onto their new
+    owner devices, fenced and (optionally) journaled.  Returns the number
+    of records whose banks moved.  ``crash_after`` raises
+    :class:`CoordinatorKilled` right after that phase's journal entry
+    (``"PLANNED"``, ``"DRAINING:<sweep>"``, ``"STABLE"``) — the chaos
+    tier's deterministic kill switch, same contract as ``migrate_slots``.
+
+    Every slot is fenced at the journal's epoch BEFORE any bank moves, so
+    a resumed re-issue is idempotent and a stale coordinator (lower epoch
+    than a newer rebalance that touched the slot) dies loudly with
+    PlacementStaleEpoch instead of silently un-moving it."""
+    placement = engine.placement
+    if placement is None:
+        raise RuntimeError("placement is not enabled on this engine")
+    journal = None
+    epoch = None
+    if journal_dir is not None:
+        devs = sorted(set(targets.values()))
+        journal = MigrationJournal.create(
+            journal_dir, "dev:rebalance", f"dev:{devs}"
+        )
+        epoch = journal.epoch
+        journal.append(
+            "PLANNED", kind="device_rebalance", epoch=epoch,
+            targets={str(s): int(d) for s, d in targets.items()},
+        )
+    run = _DeviceRebalanceRun(engine, targets, journal, epoch, crash_after,
+                              batch=batch)
+    return run.execute()
+
+
+def resume_device_rebalances(engine, journal_dir: str) -> List[Dict[str, Any]]:
+    """Settle every in-flight device rebalance the journal directory
+    records — the restart path.  A device move has no rollback shape (the
+    banks live in this process either way), so every in-flight rebalance
+    completes FORWARD: re-fence at the recorded epoch, re-move (moving an
+    already-moved slot is a no-op), STABLE.  Idempotent under repeated
+    crashes mid-resume; a slot a NEWER rebalance already fenced higher is
+    skipped (stale epoch), counted in the summary."""
+    out: List[Dict[str, Any]] = []
+    for journal in MigrationJournal.in_flight(journal_dir):
+        planned = journal.entry("PLANNED")
+        if planned is None or planned.get("kind") != "device_rebalance":
+            continue
+        targets = {int(s): int(d) for s, d in planned["targets"].items()}
+        run = _DeviceRebalanceRun(
+            engine, targets, journal, journal.epoch, None
+        )
+        try:
+            moved, stale = run.resume()
+            out.append({
+                "id": journal.migration_id, "action": "completed",
+                "moved": moved, "stale_slots": stale, "epoch": journal.epoch,
+            })
+        except Exception as e:  # noqa: BLE001 — settle the rest
+            out.append({
+                "id": journal.migration_id, "action": "failed",
+                "error": repr(e),
+            })
+    return out
+
+
+class _DeviceRebalanceRun:
+    """One device rebalance as a journaled state machine (the
+    ``_MigrationRun`` shape without a wire)."""
+
+    def __init__(self, engine, targets: Dict[int, int], journal, epoch,
+                 crash_after: Optional[str], batch: int = 256):
+        self.engine = engine
+        self.targets = dict(targets)
+        self.journal = journal
+        self.epoch = epoch
+        self.crash_after = crash_after
+        self.batch = max(1, batch)
+
+    def _record(self, phase: str, **data) -> None:
+        if self.journal is not None:
+            self.journal.append(phase, **data)
+
+    def _crash_point(self, label: str) -> None:
+        if self.crash_after is not None and self.crash_after == label:
+            raise CoordinatorKilled(f"[chaos] coordinator killed after {label}")
+
+    def _move(self, moved: int = 0, skip_stale: bool = False):
+        """Batched fenced moves (one bulk store scan per batch —
+        engine.move_slots_records), one DRAINING journal entry per batch so
+        a resumed coordinator knows how far it got.  Returns
+        (records_moved, stale_slot_count)."""
+        slots = sorted(self.targets)
+        stale = 0
+        sweep = 0
+        for start in range(0, len(slots), self.batch):
+            batch = {
+                slot: self.targets[slot]
+                for slot in slots[start:start + self.batch]
+            }
+            n, s = self.engine.move_slots_records(
+                batch, self.epoch, skip_stale=skip_stale
+            )
+            moved += n
+            stale += s
+            sweep += 1
+            self._record("DRAINING", moved=moved, sweep=sweep)
+            self._crash_point(f"DRAINING:{sweep}")
+        return moved, stale
+
+    def execute(self) -> int:
+        self._crash_point("PLANNED")
+        moved, _stale = self._move()
+        self._record("STABLE", moved=moved)
+        self._crash_point("STABLE")
+        return moved
+
+    def resume(self):
+        moved0 = int(self.journal.latest("moved", 0)) if self.journal else 0
+        moved, stale = self._move(moved=moved0, skip_stale=True)
+        self._record("STABLE", moved=moved, resumed=True)
+        return moved, stale
 
 
 def _s(v) -> str:

@@ -31,6 +31,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from redisson_tpu.client import routing as _routing
+from redisson_tpu.core import ioplane
 from redisson_tpu.core.engine import Engine
 from redisson_tpu.net import resp
 from redisson_tpu.net.resp import ProtocolError, RespError
@@ -132,8 +133,17 @@ class TpuServer:
         tls_ca_file: Optional[str] = None,
         users: Optional[Dict[str, str]] = None,
         overlap: Optional[bool] = None,
+        devices: Optional[Any] = None,
     ):
         self.engine = engine if engine is not None else Engine()
+        # device-sharded serving (ISSUE 8): `devices` maps the 16384-slot
+        # table onto the local device mesh — an int takes the first N local
+        # devices, "all" takes every one.  None (default) = the historical
+        # single-device server.  An engine whose placement is already
+        # enabled (embedded callers) is left as configured.
+        if devices is not None and self.engine.placement is None:
+            n = None if devices in ("all", "ALL") else int(devices)
+            self.engine.enable_placement(n_devices=n)
         # overlapped device I/O plane (core/ioplane): frames with device-form
         # lazy replies hand their readback to the per-connection writer task
         # instead of blocking the read loop — upload/kernel of frame N+1
@@ -278,6 +288,10 @@ class TpuServer:
             "eviction-min-delay": ev.min_delay if ev else cfg.min_cleanup_delay,
             "eviction-max-delay": ev.max_delay if ev else cfg.max_cleanup_delay,
             "tracking-table-max-keys": self.tracking.max_keys,
+            "placement-devices": (
+                self.engine.placement.n_devices
+                if self.engine.placement is not None else 0
+            ),
         }
         return view
 
@@ -673,6 +687,180 @@ class TpuServer:
                 ))
         return out
 
+    # -- device-sharded frame dispatch (ISSUE 8) ------------------------------
+
+    @staticmethod
+    def _estimate_device_items(cmds) -> int:
+        """Rough op count a command list dispatches to one device — the
+        occupancy unit the per-device lane accounts (and, under the bench
+        CPU-replica knob, the modeled per-chip compute time).  Blob verbs
+        count their batch elements; everything else counts 1."""
+        total = 0
+        for cmd in cmds:
+            try:
+                verb = bytes(cmd[0]).upper()
+                if verb in (b"BF.MADD64", b"BF.MEXISTS64", b"PFADD64"):
+                    total += max(1, len(cmd[2]) // 8)
+                elif verb in (b"BFA.MADD64", b"BFA.MEXISTS64", b"HLLA.MADD64"):
+                    total += max(1, len(cmd[3]) // 8)
+                elif verb in (b"SETBITSB", b"GETBITSB"):
+                    total += max(1, len(cmd[2]) // 4)
+                else:
+                    total += 1
+            except (IndexError, TypeError):
+                total += 1
+        return total
+
+    def _occupancy_gate(self, cmds):
+        """Lane-occupancy context for one sequential-path dispatch (a single
+        command or one same-verb coalesced run): the owning device's lane
+        when every key maps to ONE device, else None (no gate).  This is how
+        single-command frames — pipelined blobs bigger than one recv chunk
+        arrive one command per parse batch — still account their device
+        occupancy against the owning lane: dispatches from CONCURRENT
+        connections bound for different devices overlap, same-device ones
+        serialize, exactly like N per-chip streams."""
+        eng = self.engine
+        if eng.placement is None or eng.lanes is None:
+            return None
+        dev = None
+        for cmd in cmds:
+            d = eng.placement.device_index_for_command(cmd)
+            if d is None or (dev is not None and d != dev):
+                return None
+            dev = d
+        if dev is None:
+            return None
+        lane = eng.lanes.lane(eng.placement.devices[dev])
+        return lane.occupy(self._estimate_device_items(cmds))
+
+    def _dispatch_laned(self, ctx, cmd):
+        """Sequential-path single-command dispatch with lane accounting."""
+        gate = self._occupancy_gate((cmd,))
+        if gate is None:
+            return self._dispatch_gated(ctx, cmd)
+        with gate:
+            return self._dispatch_gated(ctx, cmd)
+
+    def _dispatch_bloom_run_laned(self, ctx, cmds):
+        """Sequential-path coalesced run with lane accounting (a run whose
+        filters span devices gets no gate — the coalescer itself falls back
+        to per-record dispatch on a mixed-device group)."""
+        gate = self._occupancy_gate(cmds)
+        if gate is None:
+            return self._dispatch_bloom_run(ctx, cmds)
+        with gate:
+            return self._dispatch_bloom_run(ctx, cmds)
+
+    def _dispatch_one_sync(self, ctx, cmd):
+        """One command, dispatched with the per-command error translation of
+        the connection loop (RespError -> -ERR reply, shutdown -> drop the
+        connection, anything else sandboxed per command)."""
+        if not isinstance(cmd, list) or not all(
+            isinstance(a, (bytes, bytearray)) for a in cmd
+        ):
+            return _Encoded(resp.encode_error("ERR bad request frame"))
+        try:
+            return self._dispatch_gated(ctx, cmd)
+        except RespError as e:
+            self.stats["errors"] += 1
+            return _Encoded(resp.encode_error(str(e.args[0])))
+        except ConnectionResetError:
+            raise
+        except RuntimeError as e:
+            if "shutdown" in str(e):
+                raise ConnectionResetError(str(e)) from e
+            self.stats["errors"] += 1
+            return _Encoded(
+                resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
+            )
+        except Exception as e:  # noqa: BLE001 — sandbox handler bugs per-command
+            self.stats["errors"] += 1
+            return _Encoded(
+                resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
+            )
+
+    def _dispatch_device_bucket(self, ctx, dev_index: int, items):
+        """One device's ordered slice of a pipelined frame (placement
+        plan_frame 'sharded' segment): runs on a worker thread WHILE the
+        other devices' buckets run on theirs — the per-chip dispatch lanes
+        of device-sharded serving.  Same-verb BF blob runs inside the
+        bucket still coalesce into one stacked-bank kernel (now guaranteed
+        single-device).  Returns [(frame_index, result), ...]."""
+        if not self._pause_gate.is_set():
+            self._pause_gate.wait(timeout=60.0)
+        eng = self.engine
+        lane = (
+            eng.lanes.lane(eng.placement.devices[dev_index])
+            if eng.lanes is not None else None
+        )
+        cmds = [c for _i, c in items]
+        out = []
+        run_at: Dict[int, int] = (
+            dict(_routing.coalescible_frame_runs(cmds)) if len(cmds) > 1 else {}
+        )
+        from contextlib import nullcontext
+
+        gate = (
+            lane.occupy(self._estimate_device_items(cmds))
+            if lane is not None else nullcontext()
+        )
+        with gate:
+            ci = 0
+            while ci < len(cmds):
+                run_end = run_at.get(ci)
+                if run_end is not None:
+                    replies = self._dispatch_bloom_run(ctx, cmds[ci:run_end])
+                    for off, r in enumerate(replies):
+                        out.append((items[ci + off][0], r))
+                    ci = run_end
+                    continue
+                out.append((items[ci][0], self._dispatch_one_sync(ctx, cmds[ci])))
+                ci += 1
+        return out
+
+    async def _run_frame_sharded(self, ctx, commands, plan, loop):
+        """Execute one pipelined frame under a placement plan: 'sharded'
+        segments fan their per-device buckets out on the worker pool
+        CONCURRENTLY (each bucket FIFO on its device lane — per-key order
+        is preserved because a key maps to exactly one device), 'serial'
+        segments run in frame order as barriers.  Reply order is by frame
+        index regardless of completion order."""
+        results: list = [None] * len(commands)
+        for seg_kind, seg in plan:
+            if seg_kind == "serial":
+                for i in seg:
+                    cmd = commands[i]
+                    self.stats["commands"] += 1
+                    pool = (
+                        self._slow_pool
+                        if (
+                            isinstance(cmd, list) and cmd
+                            and isinstance(cmd[0], (bytes, bytearray))
+                            and bytes(cmd[0]).upper() in _SLOW_COMMANDS
+                        )
+                        else self._pool
+                    )
+                    results[i] = await loop.run_in_executor(
+                        pool, self._dispatch_one_sync, ctx, cmd
+                    )
+                continue
+            jobs = []
+            for dev_index, idxs in seg.items():
+                self.stats["commands"] += len(idxs)
+                jobs.append(loop.run_in_executor(
+                    self._pool, self._dispatch_device_bucket, ctx, dev_index,
+                    [(i, commands[i]) for i in idxs],
+                ))
+            outs = await asyncio.gather(*jobs, return_exceptions=True)
+            err = next((o for o in outs if isinstance(o, BaseException)), None)
+            if err is not None:
+                raise err
+            for out in outs:
+                for i, r in out:
+                    results[i] = r
+        return results
+
     def replication_source(self):
         """Lazy master-side record shipper (server/replication.py)."""
         from redisson_tpu.server.replication import ReplicationSource
@@ -813,6 +1001,55 @@ class TpuServer:
                 # fused kernel dispatch each (_dispatch_bloom_run — the
                 # coalescing plane; runs never cross a verb change, so
                 # frame order is preserved exactly).
+                # Device-sharded frame plan (ISSUE 8): with the slot table
+                # placed over >1 device, the frame's single-device keyed
+                # data commands split into per-device queues dispatched
+                # CONCURRENTLY (one worker per device lane) instead of
+                # serializing through one lane; everything else barriers in
+                # frame order.  plan is None when there is nothing to shard
+                # — the sequential loop below is byte-identical to before.
+                plan = None
+                if (
+                    self.engine.placement is not None
+                    and ctx.multi_queue is None
+                    and ctx.authenticated
+                    and not ctx.asking
+                    and len(commands) > 1
+                ):
+                    try:
+                        # with the CPU-replica occupancy model armed (bench
+                        # config5d A/B), even a 1-device frame runs the lane
+                        # dispatch path so both legs execute identical code
+                        plan = self.engine.placement.plan_frame(
+                            commands,
+                            single_device_ok=(
+                                ioplane.replica_occupancy() is not None
+                            ),
+                        )
+                    except Exception:  # noqa: BLE001 — planning must never
+                        plan = None    # break a frame; fall back to serial
+                if plan is not None:
+                    results = await self._run_frame_sharded(
+                        ctx, commands, plan, loop
+                    )
+                    if any(isinstance(r, LazyReply) for r in results):
+                        if self.overlap:
+                            await readback_slots.acquire()
+                            if not writer_alive:
+                                break
+                            fut = loop.run_in_executor(
+                                self._pool, _force_lazies, results, self
+                            )
+                            write_q.put_nowait(
+                                _PendingFrame(results, fut, ctx.proto)
+                            )
+                            continue
+                        await loop.run_in_executor(
+                            self._pool, _force_lazies, results, self
+                        )
+                    if results:
+                        write_q.put_nowait(_encode_frame(results, ctx.proto))
+                    continue
                 run_at: Dict[int, int] = {}
                 if len(commands) > 1:
                     run_at = {
@@ -824,7 +1061,7 @@ class TpuServer:
                             for a in c
                         )
                     }
-                results: list = []
+                results = []
                 ci = -1
                 for cmd in commands:
                     ci += 1
@@ -836,7 +1073,8 @@ class TpuServer:
                         self.stats["commands"] += len(run_cmds)
                         results.extend(
                             await loop.run_in_executor(
-                                self._pool, self._dispatch_bloom_run, ctx, run_cmds
+                                self._pool, self._dispatch_bloom_run_laned,
+                                ctx, run_cmds,
                             )
                         )
                         continue
@@ -857,7 +1095,7 @@ class TpuServer:
                     try:
                         results.append(
                             await loop.run_in_executor(
-                                pool, self._dispatch_gated, ctx, cmd
+                                pool, self._dispatch_laned, ctx, cmd
                             )
                         )
                     except RespError as e:
@@ -1202,6 +1440,14 @@ def main(argv=None):
         help="data-plane worker threads (the per-connection dispatch pool)",
     )
     ap.add_argument(
+        "--devices", default=None,
+        help="device-sharded serving (ISSUE 8): map the 16384-slot table "
+             "onto this many local devices ('all' = every jax.local_device); "
+             "each object's banks live on the device owning its slot and "
+             "frames dispatch down per-device lanes.  Default: one device "
+             "(no placement).",
+    )
+    ap.add_argument(
         "--ready-fd", type=int, default=None,
         help="inherited fd to write one 'READY <host> <port> <pid>' line to "
              "once the listener is bound (the ClusterSupervisor's readiness "
@@ -1236,6 +1482,7 @@ def main(argv=None):
         checkpoint_path=args.checkpoint,
         overlap=not args.no_overlap,
         workers=args.workers,
+        devices=args.devices,
     )
     if args.restore and args.checkpoint:
         from redisson_tpu.core import checkpoint

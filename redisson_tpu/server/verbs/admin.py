@@ -172,6 +172,46 @@ def cmd_cluster(server, ctx, args):
         # drain one MIGRATING slot (optional batch limit; <=0 = fully)
         limit = _int(args[2]) if len(args) > 2 else 0
         return server.migrate_slot_batch(_int(args[1]), limit)
+    if sub == b"DEVICES":
+        # device-sharded serving state (ISSUE 8), over the wire: per-device
+        # slot counts + device labels so tooling (bench config5d, the
+        # device-shard soak) can audit the placement without in-process
+        # access.  Reply: [n_devices, [dev_id, slots_owned, label]...];
+        # a server without placement replies [0].
+        p = server.engine.placement
+        if p is None:
+            return [0]
+        counts = p.slot_counts()
+        return [p.n_devices] + [
+            [getattr(d, "id", i), counts[i], str(d).encode()]
+            for i, d in enumerate(p.devices)
+        ]
+    if sub == b"DEVMOVE":
+        # DEVMOVE <dev_index> [EPOCH <n>] <slot>... — fenced slot -> device
+        # handoff inside THIS process (the device-rebalance wire verb: a
+        # move is just a placement handoff riding the migration fencing
+        # epochs; a stale coordinator's lower epoch replies STALEEPOCH).
+        # Returns the number of records whose banks actually moved.
+        from redisson_tpu.server.placement import PlacementStaleEpoch
+
+        if server.engine.placement is None:
+            raise RespError("ERR placement is not enabled on this server")
+        rest = list(args[1:])
+        dev_index = _int(rest[0])
+        rest = rest[1:]
+        epoch = None
+        if rest and bytes(rest[0]).upper() == b"EPOCH":
+            epoch = _int(rest[1])
+            rest = rest[2:]
+        moved = 0
+        try:
+            for s in (_int(a) for a in rest):
+                moved += server.engine.move_slot_records(s, dev_index, epoch)
+        except PlacementStaleEpoch as e:
+            raise RespError(str(e))
+        except ValueError as e:
+            raise RespError(f"ERR {e}")
+        return moved
     if sub == b"MIGRATESLOTS":
         # MIGRATESLOTS [EPOCH <n>] <slot>... — drain MANY migrating slots
         # in one store scan (the orchestrator's bulk form: a reshard of

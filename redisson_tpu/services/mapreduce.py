@@ -505,15 +505,24 @@ class _WcViewCache:
                 self._views.pop(next(iter(self._views)))
 
 
-def _wc_tokenize(vals: List[str], n_chunks: int, key=None) -> Optional[_WcScanView]:
+def _wc_tokenize(vals: List[str], n_chunks: int, key=None,
+                 devices=None) -> Optional[_WcScanView]:
     """Host tokenize + device staging; None means "use the host path"
     (non-ASCII whitespace or pathological token shapes).  Chunking overlaps
     host prep of chunk i+1 with device compute of chunk i (uploads are
-    staged asynchronously)."""
+    staged asynchronously).
+
+    ``devices`` (device-sharded engines, ISSUE 8): chunk i commits to
+    devices[i % D], so the extract kernels of all chunks run CONCURRENTLY
+    across the local mesh; the per-chunk token streams then merge back onto
+    devices[0] over d2d transfers (ioplane.colocate — never a host gather)
+    before the sort."""
     import jax.numpy as jnp
 
     from redisson_tpu.core import kernels as K
 
+    if devices is not None and len(devices) > 1:
+        n_chunks = max(n_chunks, len(devices))
     csize = max(1, (len(vals) + n_chunks - 1) // n_chunks)
     blobs: List[bytes] = []
     padded: List[int] = []
@@ -539,15 +548,30 @@ def _wc_tokenize(vals: List[str], n_chunks: int, key=None) -> Optional[_WcScanVi
         ws = buf == 32
         n_ends = int(np.count_nonzero(~ws[:-1] & ws[1:]))
         eb = K.bucket_size(max(1, n_ends))
+        if devices is not None and len(devices) > 1:
+            import jax
+
+            staged = jax.device_put(buf, devices[len(parts) % len(devices)])
+        else:
+            staged = K.stage(buf)
         parts.append(
             K.wc_extract_words_auto(
-                K.stage(buf), K.valid_n(n_ends), eb, jnp.uint32(base)
+                staged, K.valid_n(n_ends), eb, jnp.uint32(base)
             )
         )
         blobs.append(big)
         padded.append(b)
         nw += n_ends
         base += b
+    if devices is not None and len(devices) > 1 and len(parts) > 1:
+        # the cross-device MapReduce MERGE: every chunk's token stream hops
+        # d2d onto devices[0] (counted, zero host gathers) and the sorted
+        # reduce runs there
+        from redisson_tpu.core import ioplane
+
+        parts = [
+            tuple(ioplane.colocate(a, devices[0]) for a in p) for p in parts
+        ]
     ha = jnp.concatenate([p[0] for p in parts])
     hb = jnp.concatenate([p[1] for p in parts])
     st = jnp.concatenate([p[2] for p in parts])
@@ -735,7 +759,11 @@ def word_count(
                 rec2 = engine.store.get(name)
                 if rec2 is not None and (rec2.nonce, rec2.version) == key0:
                     key = key0
-            view = _wc_tokenize(vals, 2, key)
+            placement = getattr(engine, "placement", None) if engine is not None else None
+            view = _wc_tokenize(
+                vals, 2, key,
+                devices=placement.devices if placement is not None else None,
+            )
             if view is None:
                 return _host_word_count(vals)
             out = _wc_reduce(view, 1 << _WC_D_MAX_BITS)

@@ -451,3 +451,128 @@ def test_tracking_table_overflow_stays_bounded():
         finally:
             a.close()
             b.close()
+
+
+# -- per-device lane sync bound (ISSUE 8) -------------------------------------
+
+
+def test_overlap_pipeline_per_device_lane_sync_bound():
+    """The ISSUE 8 extension of the N-windows contract: with the slot table
+    device-sharded, EACH device lane independently holds N windows <= N+1
+    blocking syncs (per-device IOStats ledger), windows on different
+    devices never count against each other's lane, and both lanes return
+    bit-identical results to the serial reference."""
+    import redisson_tpu
+    from redisson_tpu.core import ioplane
+    from redisson_tpu.core import kernels as K
+
+    c = redisson_tpu.create()
+    try:
+        engine = c._engine
+        placement = engine.enable_placement()
+        # two filter-array names owned by DIFFERENT devices
+        names, seen = [], set()
+        for i in range(4000):
+            n = f"perf:lane{i}"
+            d = placement.device_id_for_name(n)
+            if d not in seen:
+                seen.add(d)
+                names.append((n, d))
+            if len(names) == 2:
+                break
+        assert len(names) == 2
+        rng = np.random.default_rng(9)
+        arrs = {}
+        for name, _d in names:
+            arr = c.get_bloom_filter_array(name)
+            assert arr.try_init(tenants=16, expected_insertions=1000,
+                                false_probability=0.01)
+            keys = rng.integers(0, 1 << 60, 2000).astype(np.int64)
+            t = (keys % 16).astype(np.int32)
+            arr.add_each(t, keys)
+            arrs[name] = (arr, t, keys)
+
+        def window_fn(arr, tt, kk):
+            def fn():
+                packed, n = arr.contains_async(tt, kk)
+                return (packed,), (lambda host, n=n: K.unpack_found(host[0], n))
+            return fn
+
+        n_win = 6
+        out = {}
+        ioplane.reset_device_stats()
+        pipes = {
+            name: ioplane.FlushPipeline(overlap=True, depth=2)
+            for name, _d in names
+        }
+        futs = {name: [] for name, _d in names}
+        for w in range(n_win):
+            for name, _d in names:
+                arr, t, keys = arrs[name]
+                lo = w * 300
+                futs[name].append(pipes[name].submit(
+                    window_fn(arr, t[lo : lo + 300], keys[lo : lo + 300])
+                ))
+        for name, _d in names:
+            pipes[name].drain()
+            out[name] = [f.result() for f in futs[name]]
+        per_dev = ioplane.device_stats_snapshot()
+        for name, d in names:
+            syncs = per_dev[d]["blocking_syncs"]
+            assert 0 < syncs <= n_win + 1, (name, d, per_dev)
+        # bit-identity against the direct (serial) path
+        for name, _d in names:
+            arr, t, keys = arrs[name]
+            for w in range(n_win):
+                lo = w * 300
+                expect = arr.contains(t[lo : lo + 300], keys[lo : lo + 300])
+                np.testing.assert_array_equal(out[name][w], np.asarray(expect))
+    finally:
+        c.shutdown()
+
+
+# -- config5d gate logic (ISSUE 8) --------------------------------------------
+
+
+def test_perf_gate_config5d_first_sight_and_relative(tmp_path):
+    """config5d_device_sharded_ops_per_sec AND the 1-vs-N speedup ratio:
+    n/a-pass while absent from the baseline, then BOTH gate a >5% relative
+    drop once recorded."""
+    import copy
+    import importlib.util
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(repo, "tools", "perf_gate.py")
+    )
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+    r5 = os.path.join(repo, "BENCH_r05.json")
+    if not os.path.exists(r5):
+        pytest.skip("no recorded BENCH artifacts")
+    with open(r5) as fh:
+        base = gate.load_bench_doc(fh.read())
+
+    # first sight: absent from the baseline -> n/a rows, gate passes
+    doc = copy.deepcopy(base)
+    doc["details"]["config5d_device_sharded_ops_per_sec"] = 300_000
+    doc["details"]["config5d_speedup_vs_1dev"] = 3.5
+    first = tmp_path / "fresh_5d_first.json"
+    first.write_text(json.dumps(doc))
+    assert gate.main(["--fresh", str(first), "--baseline", r5]) == 0
+    # once recorded, each metric independently gates a >5% drop
+    for key, factor, want in (
+        ("config5d_device_sharded_ops_per_sec", 0.94, 1),
+        ("config5d_device_sharded_ops_per_sec", 0.96, 0),
+        ("config5d_speedup_vs_1dev", 0.94, 1),
+        ("config5d_speedup_vs_1dev", 0.96, 0),
+    ):
+        doc2 = copy.deepcopy(doc)
+        doc2["details"][key] = doc["details"][key] * factor
+        p = tmp_path / f"fresh_5d_{key}_{factor}.json"
+        p.write_text(json.dumps(doc2))
+        assert gate.main(
+            ["--fresh", str(p), "--baseline", str(first)]
+        ) == want, (key, factor)

@@ -105,3 +105,29 @@ def test_soak_with_heavier_fault_schedule():
     report = SoakHarness(cfg, schedule=sched).run()
     assert report.cycles_completed == 2
     assert sum(report.injected_faults.values()) > 0
+
+
+# -- device-shard soak (ISSUE 8) ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_device_shard_soak_rebalance_under_traffic():
+    """The ISSUE 8 soak acceptance: mixed bucket/bloom traffic plus tracked
+    readers against one device-sharded server while the slot table
+    rebalances 8 -> 4 -> 8 through journaled fenced handoffs under
+    transport faults — zero acked-write loss, zero stale tracked reads,
+    near caches converge, per-device lane census flat, zero host-side
+    cross-device gathers."""
+    from redisson_tpu.chaos.soak import (
+        DeviceShardSoakConfig, DeviceShardSoakHarness,
+    )
+
+    report = DeviceShardSoakHarness(DeviceShardSoakConfig(
+        cycles=2, seed=3,
+    )).run()
+    assert report.cycles_completed == 2
+    assert report.rebalances == 4              # 8->4 and 4->8, twice
+    assert report.stale_reads == 0
+    assert report.host_colocations == 0
+    assert report.writes_acked > 0 and report.reads > 0
+    assert report.bloom_keys_verified > 0

@@ -50,6 +50,13 @@ METRICS = [
     # (baseline has no config5p) the row reads n/a and passes — the fresh
     # run becomes the recorded baseline for the next round to defend.
     ("config5p cluster-proc mixed ops/s", ("details", "config5p_cluster_proc_ops_per_sec"), True, True),
+    # config5d (ISSUE 8): ONE server owning the local device mesh — the
+    # device-sharded throughput AND the 1-vs-N-device speedup ratio are both
+    # gated (n/a-pass on first sight, >threshold relative drop after): a
+    # regression in the ratio means the per-device lanes stopped
+    # overlapping even if raw throughput moved for other reasons.
+    ("config5d device-sharded ops/s", ("details", "config5d_device_sharded_ops_per_sec"), True, True),
+    ("config5d speedup vs 1 device", ("details", "config5d_speedup_vs_1dev"), True, True),
     ("config1 single contains/s", ("details", "config1_single_filter_contains_per_sec"), True, False),
     ("config2 flush p99 ms", ("details", "config2_flush_p99_ms"), False, True),
     ("config3 hll add/s", ("details", "config3_hll_add_per_sec"), True, False),
@@ -169,10 +176,11 @@ def render(rows, threshold: float) -> str:
     out.append("-" * 82)
     out.append(
         f"gate: >{threshold:.0%} regression in headline, config5, config5p, "
-        "config2 flush p99, config4 cold, or config6 reduction fails; other "
-        "drops are advisory (WARN); a metric absent from the baseline reads "
-        "n/a and passes (recorded on first sight).  Absolute floors "
-        "(config6 server-op reduction >= 10x) bind from first sight."
+        "config5d (ops/s AND 1-vs-N speedup), config2 flush p99, config4 "
+        "cold, or config6 reduction fails; other drops are advisory (WARN); "
+        "a metric absent from the baseline reads n/a and passes (recorded "
+        "on first sight).  Absolute floors (config6 server-op reduction "
+        ">= 10x) bind from first sight."
     )
     return "\n".join(out)
 

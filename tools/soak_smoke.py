@@ -22,6 +22,14 @@
     acked-durable-write loss, exactly-one-owner residency, all slots
     STABLE, acked bloom adds intact.  One two-phase cycle runs in well
     under 60s.
+  * ``device-shard`` — the device-sharded serving profile (ISSUE 8): mixed
+    bucket/bloom traffic plus tracked zipf readers against ONE server
+    owning 8 (forced host) devices while the slot table rebalances across
+    devices 8 -> 4 -> 8 through the journaled fenced handoff path, under
+    injected transport faults.  Asserts zero acked-write loss, zero stale
+    tracked reads (a device move must be invisible to the tracking plane),
+    near-cache convergence after quiesce, per-device lane census flat, and
+    zero host-side cross-device gathers (IOStats.host_colocations == 0).
   * ``tracking`` — the near-cache coherence profile (ISSUE 7): zipf
     readers with server-assisted near caches (CLIENT TRACKING) keep
     reading while key-bearing slots migrate m0 -> m1 -> m0 and the
@@ -55,7 +63,7 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--profile",
                     choices=("standard", "migration", "cluster-proc",
-                             "tracking"),
+                             "tracking", "device-shard"),
                     default="standard")
     ap.add_argument("--cycles", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
@@ -69,7 +77,15 @@ def main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
-    if args.profile == "tracking":
+    if args.profile == "device-shard":
+        from redisson_tpu.chaos.soak import (
+            DeviceShardSoakConfig, DeviceShardSoakHarness,
+        )
+
+        harness = DeviceShardSoakHarness(DeviceShardSoakConfig(
+            cycles=args.cycles, seed=args.seed,
+        ))
+    elif args.profile == "tracking":
         from redisson_tpu.chaos.soak import (
             TrackingSoakConfig, TrackingSoakHarness,
         )
