@@ -938,6 +938,204 @@ def bench_config6_tracking():
         st.stop()
 
 
+def bench_config2q_qos():
+    """Config 2Q: tail-latency under a hostile mixed-tenant workload
+    (ISSUE 10 — the deadline-aware window scheduler + per-tenant QoS).
+
+    ONE server, two legs over the IDENTICAL workload:
+
+      * hostile tenant ``hog`` — several connections pipelining big
+        BF.MADD64 bulk frames continuously (the abusive-tenant flood that
+        used to occupy every worker and the completion queues);
+      * two equal-budget interactive tenants ``ta``/``tb`` — one
+        connection each, issuing small sync BF.MEXISTS64 probes and
+        recording per-op wall latency.
+
+    Armed leg (default QoS): hog is declared bulk + budgeted
+    (``qos-tenant-rate``), so its over-budget frames shed with -BUSY before
+    dispatch and the rest pass the bounded bulk admission gate while
+    interactive frames ride the reserved dispatch slice.  Disarmed leg
+    (``qos=False``): pure arrival order — the baseline the armed p99 must
+    beat.  Three numbers:
+
+      * ``config2q_interactive_p99_ms`` — armed interactive p99 (worst of
+        the two tenants; gated, lower-better);
+      * ``config2q_fairness_p99_ratio`` — p99 ratio between the two
+        equal-budget interactive tenants (gated, absolute ceiling 2x);
+      * ``config2q_interactive_speedup_vs_noqos`` — disarmed p99 / armed
+        p99 (absolute floor: the scheduler must land the armed p99
+        MATERIALLY below the disarmed baseline on the same container).
+    """
+    import threading
+
+    from redisson_tpu.net.client import Connection
+    from redisson_tpu.net.resp import RespError
+    from redisson_tpu.server.server import ServerThread
+
+    HOG_CONNS = 6
+    HOG_CMDS = 12          # commands per hostile frame
+    HOG_KEYS = 30_000      # keys per hostile command
+    INT_KEYS = 64          # keys per interactive probe
+    WARM_S = 1.0
+    MEASURE_S = 5.0
+    RATE = 100_000.0       # per-tenant budget, device items/s
+    BURST = 150_000.0
+    HOG_BACKOFF_S = 0.025  # hog reaction to a fully-BUSY frame (the shed
+    #                        reply's documented contract: retry after backoff)
+
+    hog_blob = np.ascontiguousarray(
+        np.arange(HOG_KEYS, dtype=np.int64) * 2654435761, "<i8"
+    ).tobytes()
+    int_keys = {
+        t: np.ascontiguousarray(
+            (np.arange(INT_KEYS, dtype=np.int64) + 7919 * i) * 40503, "<i8"
+        ).tobytes()
+        for i, t in enumerate(("ta", "tb"))
+    }
+
+    def leg(qos_on: bool):
+        st = ServerThread(port=0, workers=4, qos=qos_on).start()
+        conns = []
+        stop = threading.Event()  # before the try: the finally sets it
+        try:
+            host, port = st.server.host, st.server.port
+            admin = Connection(host, port, timeout=60.0)
+            conns.append(admin)
+            # budgets configured in BOTH legs (the disarmed leg ignores
+            # them — that asymmetry IS the A/B)
+            admin.execute("CONFIG", "SET", "qos-tenant-rate", str(RATE))
+            admin.execute("CONFIG", "SET", "qos-tenant-burst", str(BURST))
+            for i in range(HOG_CMDS):
+                admin.execute("BF.RESERVE", "q2q:bulk%d{hog}" % i, 0.01, HOG_KEYS)
+            for t, blob in int_keys.items():
+                admin.execute("BF.RESERVE", "q2q:int{%s}" % t, 0.01, 10_000)
+                admin.execute("BF.MADD64", "q2q:int{%s}" % t, blob)
+            hog_stats = {"frames": 0, "admitted": 0, "busy": 0}
+            hog_lock = threading.Lock()
+            lat: dict = {t: [] for t in int_keys}
+            errors: list = []
+
+            def hog(j):
+                try:
+                    c = Connection(host, port, timeout=120.0)
+                    conns.append(c)
+                    c.execute("CLIENT", "QOS", "CLASS", "bulk", "TENANT", "hog")
+                    frame = [
+                        ("BF.MADD64", "q2q:bulk%d{hog}" % i, hog_blob)
+                        for i in range(HOG_CMDS)
+                    ]
+                    while not stop.is_set():
+                        out = c.execute_many(frame, timeout=120.0)
+                        busy = sum(1 for r in out if isinstance(r, RespError))
+                        with hog_lock:
+                            hog_stats["frames"] += 1
+                            hog_stats["busy"] += busy
+                            hog_stats["admitted"] += len(out) - busy
+                        if busy == len(out):
+                            time.sleep(HOG_BACKOFF_S)  # honor the -BUSY contract
+                except Exception as e:  # noqa: BLE001
+                    if not stop.is_set():
+                        errors.append(e)
+
+            def interactive(t):
+                try:
+                    c = Connection(host, port, timeout=120.0)
+                    conns.append(c)
+                    c.execute(
+                        "CLIENT", "QOS", "CLASS", "interactive", "TENANT", t
+                    )
+                    name = "q2q:int{%s}" % t
+                    blob = int_keys[t]
+                    samples = lat[t]
+                    while not stop.is_set():
+                        s = time.perf_counter()
+                        r = c.execute("BF.MEXISTS64", name, blob, timeout=120.0)
+                        samples.append(time.perf_counter() - s)
+                        if isinstance(r, RespError):
+                            errors.append(AssertionError(
+                                f"interactive tenant {t} shed: {r}"
+                            ))
+                            return
+                except Exception as e:  # noqa: BLE001
+                    if not stop.is_set():
+                        errors.append(e)
+
+            threads = [
+                threading.Thread(target=hog, args=(j,), daemon=True)
+                for j in range(HOG_CONNS)
+            ] + [
+                threading.Thread(target=interactive, args=(t,), daemon=True)
+                for t in int_keys
+            ]
+            for th in threads:
+                th.start()
+            time.sleep(WARM_S)
+            marks = {t: len(lat[t]) for t in lat}  # warm-up excluded
+            time.sleep(MEASURE_S)
+            stop.set()
+            for th in threads:
+                th.join(timeout=60.0)
+            if errors:
+                raise errors[0]
+            out = {}
+            for t in lat:
+                samples = np.asarray(lat[t][marks[t]:])
+                assert samples.size >= 20, (
+                    f"tenant {t} starved: only {samples.size} interactive "
+                    f"ops completed in {MEASURE_S}s"
+                )
+                out[t] = {
+                    "ops": int(samples.size),
+                    "p50_ms": round(pctl(samples, 50) * 1e3, 3),
+                    "p99_ms": round(pctl(samples, 99) * 1e3, 3),
+                }
+            p99s = [out[t]["p99_ms"] for t in out]
+            return {
+                "tenants": out,
+                "interactive_p99_ms": round(max(p99s), 3),
+                "fairness_p99_ratio": round(
+                    max(p99s) / max(min(p99s), 1e-6), 3
+                ),
+                "hog": dict(hog_stats),
+                "server_sheds": st.server.stats["sheds"],
+            }
+        finally:
+            stop.set()
+            for c in conns:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            st.stop()
+
+    armed = leg(qos_on=True)
+    disarmed = leg(qos_on=False)
+    assert armed["server_sheds"] > 0, (
+        "hostile tenant never shed — the budget knob is not binding; "
+        "the armed leg measured nothing"
+    )
+    assert disarmed["server_sheds"] == 0, "disarmed leg must never shed"
+    speedup = (
+        disarmed["interactive_p99_ms"] / armed["interactive_p99_ms"]
+        if armed["interactive_p99_ms"] > 0 else 0.0
+    )
+    log(
+        f"config2q: interactive p99 armed {armed['interactive_p99_ms']:.1f}ms "
+        f"vs disarmed {disarmed['interactive_p99_ms']:.1f}ms = {speedup:.2f}x "
+        f"better, fairness ratio {armed['fairness_p99_ratio']:.2f} "
+        f"(target <= 2x), hog admitted {armed['hog']['admitted']} / busy "
+        f"{armed['hog']['busy']} cmds ({armed['server_sheds']} sheds)"
+    )
+    return {
+        "config2q_interactive_p99_ms": armed["interactive_p99_ms"],
+        "config2q_fairness_p99_ratio": armed["fairness_p99_ratio"],
+        "config2q_interactive_speedup_vs_noqos": round(speedup, 3),
+        "config2q_noqos_interactive_p99_ms": disarmed["interactive_p99_ms"],
+        "armed": armed,
+        "disarmed": disarmed,
+    }
+
+
 def _init_jax():
     """Per-process JAX setup: persistent compile cache (the big kernels cost
     ~10s of XLA compile each; cached programs make re-runs near-instant)."""
@@ -1041,6 +1239,11 @@ def child(which: str) -> None:
         result["async_parity"] = bench_config2a_async_parity()
     elif which == "6":
         result["tracking"] = bench_config6_tracking()
+    elif which == "2q":
+        # QoS A/B (ISSUE 10): one server, hostile + interactive tenants —
+        # host-side dispatch contention is the thing measured, so the CPU
+        # backend is fine and the config needs no chip warm-up
+        result["qos"] = bench_config2q_qos()
     else:
         client = redisson_tpu.create()
         try:
@@ -1079,7 +1282,7 @@ def main():
     import subprocess
 
     results: dict = {}
-    for which in ("2", "2L", "2A", "1", "3", "4", "5", "5p", "5d", "6"):
+    for which in ("2", "2L", "2A", "2q", "1", "3", "4", "5", "5p", "5d", "6"):
         p = subprocess.run(
             [sys.executable, __file__, "--config", which],
             stdout=subprocess.PIPE,
@@ -1120,6 +1323,10 @@ def main():
                     "config6_server_op_reduction": results["6"]["tracking"]["config6_server_op_reduction"],
                     "config6_tracked_read_ops_per_sec": results["6"]["tracking"]["config6_tracked_read_ops_per_sec"],
                     "config6_tracking": results["6"]["tracking"],
+                    "config2q_interactive_p99_ms": results["2q"]["qos"]["config2q_interactive_p99_ms"],
+                    "config2q_fairness_p99_ratio": results["2q"]["qos"]["config2q_fairness_p99_ratio"],
+                    "config2q_interactive_speedup_vs_noqos": results["2q"]["qos"]["config2q_interactive_speedup_vs_noqos"],
+                    "config2q_qos": results["2q"]["qos"],
                     "baseline_model": "k=7 GETBITs @ 1M pipelined ops/s/core = 143k contains/s",
                     "tunnel_h2d_mb_per_sec": {
                         w: r["h2d_mb_s"] for w, r in results.items() if "h2d_mb_s" in r

@@ -93,6 +93,15 @@ class ResourceCensus:
             if tracking is not None:
                 for k, v in tracking.census().items():
                     out[f"tracking_{k}" if not k.startswith("tracking") else k] = v
+            # QoS window scheduler (ISSUE 10, server/scheduler.py): the
+            # per-class in-flight rows must drain to 0 at quiesce (a frame
+            # whose admission was never exited is a ledger leak); the shed
+            # counters are cumulative — soaks that shed on purpose ignore
+            # them via "*.qos_shed_*" patterns
+            sched = getattr(server, "scheduler", None)
+            if sched is not None:
+                for k, v in sched.census().items():
+                    out[k] = v
             return out
 
         self.track(name, probe)

@@ -2047,3 +2047,386 @@ class DeviceShardSoakHarness:
             return self.report
         finally:
             self._teardown()
+
+
+# -- QoS soak (ISSUE 10): abusive bulk tenant vs interactive tenants ----------
+
+
+@dataclass
+class QosSoakConfig:
+    """An abusive bulk tenant floods one master while zipf-ish interactive
+    tenants keep reading/writing small keys, under transport faults, while
+    the interactive keys' slots migrate m0 -> m1 -> m0.  The tail-latency
+    plane (server/scheduler.py) must keep the interactive tenants served:
+    bounded p99, sheds landing ONLY on the over-budget tenant, zero
+    acked-write loss, and a flat QoS ledger census at quiesce."""
+
+    seed: int = 0
+    cycles: int = 1
+    keys: int = 32
+    interactive_workers: int = 2
+    hog_conns: int = 2
+    hog_cmds: int = 6
+    hog_keys: int = 20_000
+    tenant_rate: float = 60_000.0      # items/s — binds on the hog only
+    tenant_burst: float = 90_000.0
+    shed_penalty_ms: float = 5.0
+    phase_seconds: float = 1.2
+    migrate_count: int = 4
+    faults_per_cycle: int = 3
+    interactive_p99_bound_s: float = 3.0
+    quiesce_deadline_s: float = 10.0
+
+
+@dataclass
+class QosSoakReport:
+    cycles_completed: int = 0
+    reads: int = 0
+    writes_acked: int = 0
+    errors: int = 0
+    hog_frames: int = 0
+    hog_admitted: int = 0
+    hog_busy: int = 0
+    sheds_hog: int = 0
+    sheds_other: int = 0
+    interactive_p99_ms: float = 0.0
+    migrations: int = 0
+    records_migrated: int = 0
+    census: List[Dict[str, float]] = field(default_factory=list)
+
+    def summary(self) -> str:
+        return (
+            f"qos soak: {self.cycles_completed} cycles, {self.reads} "
+            f"interactive reads + {self.writes_acked} acked writes "
+            f"(p99 {self.interactive_p99_ms:.1f}ms), {self.errors} budgeted "
+            f"errors, hog {self.hog_admitted} admitted / {self.hog_busy} "
+            f"BUSY cmds over {self.hog_frames} frames "
+            f"(sheds: hog={self.sheds_hog} other={self.sheds_other}), "
+            f"{self.migrations} slot round-trips "
+            f"({self.records_migrated} records), "
+            f"census points={len(self.census)}"
+        )
+
+
+class QosSoakHarness:
+    """The QoS plane's three invariants, under fire:
+
+      * **no interactive starvation** — every interactive tenant's op p99
+        stays under a bound while the hog floods (disarmed, the flood owns
+        every worker and the bound blows);
+      * **sheds only ever hit the over-budget tenant** — the hog's -BUSY
+        count grows, every other tenant's stays exactly 0;
+      * **zero acked-write loss + flat census** — shedding and the bulk
+        admission gate must never eat an admitted write, and the per-class
+        in-flight ledgers (global + per-lane) drain to zero at quiesce.
+
+    Chaos per cycle: transport faults over the client links (the same
+    FaultSchedule noise as the standard soak) while a batch of
+    interactive-key slots migrates m0 -> m1 and back mid-traffic.
+    """
+
+    def __init__(self, config: Optional[QosSoakConfig] = None):
+        self.config = config or QosSoakConfig()
+        self.report = QosSoakReport()
+        self.census = ResourceCensus()
+        self._rng = np.random.default_rng(self.config.seed)
+        self._runner = None
+        self._client = None
+        self._hog_addr = None
+        self._hog_names: List[str] = []
+        self._hog_blob = b""
+        self._acked: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._latencies: List[float] = []
+        self._planes: List[FaultPlane] = []
+
+    def _key(self, i: int) -> str:
+        return f"qk:{i}"
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _setup(self) -> None:
+        from redisson_tpu.harness import ClusterRunner
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        cfg = self.config
+        self._runner = ClusterRunner(masters=2).run()
+        for m in self._runner.masters:
+            srv = m.server.server
+            srv.config_set("qos-tenant-rate", str(cfg.tenant_rate))
+            srv.config_set("qos-tenant-burst", str(cfg.tenant_burst))
+            srv.config_set("qos-shed-penalty-ms", str(cfg.shed_penalty_ms))
+        self._client = self._runner.client(
+            scan_interval=0.5, timeout=10.0, connect_timeout=5.0,
+            retry_attempts=1, retry_interval=0.2,
+        )
+        for i in range(cfg.keys):
+            self._client.get_bucket(self._key(i)).set(0)
+            self._acked[self._key(i)] = 0
+        # the hog's filters live under ONE hashtag so its whole flood lands
+        # on one master (the realistic abusive-tenant shape); pin the raw
+        # hog connections to that master
+        tag = "qhog"
+        slot = calc_slot(tag.encode())
+        mi = next(
+            i for i, (lo, hi) in enumerate(self._runner.slot_ranges)
+            if lo <= slot <= hi
+        )
+        victim = self._runner.masters[mi]
+        self._hog_addr = (victim.server.server.host, victim.server.server.port)
+        self._hog_names = [
+            "qs:bulk%d{%s}" % (i, tag) for i in range(cfg.hog_cmds)
+        ]
+        self._hog_blob = np.ascontiguousarray(
+            (np.arange(cfg.hog_keys, dtype=np.int64) + 1) * 2654435761, "<i8"
+        ).tobytes()
+        from redisson_tpu.net.client import Connection
+
+        c = Connection(*self._hog_addr, timeout=30.0)
+        try:
+            for name in self._hog_names:
+                c.execute("BF.RESERVE", name, 0.01, cfg.hog_keys)
+        finally:
+            c.close()
+        self.census.track_client("client", self._client)
+        for i, m in enumerate(self._runner.masters):
+            self.census.track_server(f"master{i}", m.server.server)
+
+    def _teardown(self) -> None:
+        if self._client is not None:
+            self._client.shutdown()
+        if self._runner is not None:
+            self._runner.shutdown()
+
+    # -- workload ------------------------------------------------------------
+
+    def _interactive(self, wid: int, stop: threading.Event) -> None:
+        cfg = self.config
+        client = self._client
+        rng = np.random.default_rng(cfg.seed * 977 + wid)
+        my_keys = [
+            self._key(i)
+            for i in range(wid, cfg.keys, cfg.interactive_workers)
+        ]
+        vals = {k: self._acked.get(k, 0) for k in my_keys}
+        j = 0
+        while not stop.is_set():
+            k = my_keys[j % len(my_keys)]
+            write = (j % 4) == 0
+            t0 = time.perf_counter()
+            try:
+                if write:
+                    v = vals[k] + 1
+                    client.get_bucket(k).set(v)
+                    vals[k] = v
+                    with self._lock:
+                        self._acked[k] = max(self._acked[k], v)
+                        self.report.writes_acked += 1
+                else:
+                    client.get_bucket(k).get()
+                    with self._lock:
+                        self.report.reads += 1
+                with self._lock:
+                    self._latencies.append(time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 — budgeted outage-window error
+                with self._lock:
+                    self.report.errors += 1
+                time.sleep(0.01)
+            j += 1
+            _ = rng  # zipf selection not needed for the invariants; FIFO walk
+
+    def _hog(self, hid: int, stop: threading.Event) -> None:
+        from redisson_tpu.net.client import Connection
+        from redisson_tpu.net.resp import RespError
+
+        cfg = self.config
+        conn = None
+        frame = [("BF.MADD64", n, self._hog_blob) for n in self._hog_names]
+        while not stop.is_set():
+            try:
+                if conn is None:
+                    conn = Connection(*self._hog_addr, timeout=60.0)
+                    conn.execute(
+                        "CLIENT", "QOS", "CLASS", "bulk", "TENANT", "qhog"
+                    )
+                out = conn.execute_many(frame, timeout=60.0)
+                busy = sum(1 for r in out if isinstance(r, RespError))
+                with self._lock:
+                    self.report.hog_frames += 1
+                    self.report.hog_busy += busy
+                    self.report.hog_admitted += len(out) - busy
+                if busy == len(out):
+                    time.sleep(0.02)  # honor the -BUSY backoff contract
+            except Exception:  # noqa: BLE001 — transport fault: reconnect
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    conn = None
+                with self._lock:
+                    self.report.errors += 1
+                time.sleep(0.02)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _phase(self, seconds: float) -> None:
+        stop = threading.Event()
+        threads = [
+            threading.Thread(target=self._interactive, args=(w, stop),
+                             daemon=True)
+            for w in range(self.config.interactive_workers)
+        ] + [
+            threading.Thread(target=self._hog, args=(h, stop), daemon=True)
+            for h in range(self.config.hog_conns)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert not any(t.is_alive() for t in threads), "qos soak worker wedged"
+
+    def _migrate_roundtrip(self) -> None:
+        from redisson_tpu.server.migration import migrate_slots
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        cfg = self.config
+        runner = self._runner
+        lo, hi = runner.slot_ranges[0]
+        key_slots: List[int] = []
+        for i in range(cfg.keys):
+            s = calc_slot(self._key(i).encode())
+            if lo <= s <= hi and s not in key_slots:
+                key_slots.append(s)
+            if len(key_slots) >= cfg.migrate_count:
+                break
+        if not key_slots:
+            return
+        src = runner.masters[0].address
+        dst = runner.masters[1].address
+        nodes = runner.seeds()
+        self.report.records_migrated += migrate_slots(
+            src, dst, key_slots, all_nodes=nodes
+        )
+        self.report.records_migrated += migrate_slots(
+            dst, src, key_slots, all_nodes=nodes
+        )
+        self.report.migrations += 1
+        self._client.refresh_topology()
+
+    # -- the run loop --------------------------------------------------------
+
+    def run(self) -> QosSoakReport:
+        cfg = self.config
+        self._setup()
+        try:
+            before = self.census.snapshot()
+            for cycle in range(cfg.cycles):
+                sched = FaultSchedule(cfg.seed * 6271 + cycle)
+                n = max(1, cfg.faults_per_cycle)
+                sched.add_random("delay", n=n, window=400, delay_s=0.02)
+                sched.add_random("drop", n=max(1, n // 2), window=400)
+                plane = FaultPlane(sched)
+                self._planes.append(plane)
+                with plane.active():
+                    self._phase(cfg.phase_seconds)
+                    # migration leg CONCURRENT with the storm (the shed/
+                    # admission races only exist while traffic is in flight)
+                    mig_err: List[BaseException] = []
+
+                    def migrate_leg():
+                        try:
+                            self._migrate_roundtrip()
+                        except BaseException as e:  # noqa: BLE001
+                            mig_err.append(e)
+
+                    mig_thread = threading.Thread(
+                        target=migrate_leg, daemon=True
+                    )
+                    mig_thread.start()
+                    while mig_thread.is_alive():
+                        self._phase(0.3)
+                    mig_thread.join()
+                    if mig_err:
+                        raise mig_err[0]
+                    self._phase(cfg.phase_seconds)
+                self.report.cycles_completed += 1
+            # -- invariants ---------------------------------------------------
+            # 1. the hog actually shed, and ONLY the hog shed
+            shed_by_tenant: Dict[str, int] = {}
+            for m in self._runner.masters:
+                for t, n in m.server.server.scheduler.tenant_sheds().items():
+                    shed_by_tenant[t] = shed_by_tenant.get(t, 0) + n
+            self.report.sheds_hog = shed_by_tenant.get("qhog", 0)
+            self.report.sheds_other = sum(
+                n for t, n in shed_by_tenant.items() if t != "qhog"
+            )
+            assert self.report.sheds_hog > 0, (
+                "the abusive tenant never shed — the budget knob is not "
+                f"binding (sheds: {shed_by_tenant})"
+            )
+            assert self.report.sheds_other == 0, (
+                f"sheds hit an in-budget tenant: {shed_by_tenant}"
+            )
+            # 2. no interactive starvation: bounded p99 under the flood
+            with self._lock:
+                lats = list(self._latencies)
+            assert len(lats) >= 50, (
+                f"interactive tenants starved: only {len(lats)} ops completed"
+            )
+            p99 = float(np.percentile(np.asarray(lats), 99))
+            self.report.interactive_p99_ms = p99 * 1e3
+            assert p99 <= cfg.interactive_p99_bound_s, (
+                f"interactive starvation: p99 {p99*1e3:.0f}ms over the "
+                f"{cfg.interactive_p99_bound_s*1e3:.0f}ms bound"
+            )
+            # 3. zero acked-write loss (truth may run AHEAD of acked when an
+            # applied write's ack was lost to a budgeted error — never behind)
+            with self._lock:
+                acked = dict(self._acked)
+            for k, v in acked.items():
+                got = None
+                for _ in range(20):
+                    try:
+                        got = self._client.get_bucket(k).get()
+                        break
+                    except Exception:  # noqa: BLE001 — topology settling
+                        time.sleep(0.2)
+                got = 0 if got is None else int(got)
+                assert got >= v, f"acked-write loss: {k} read {got} < acked {v}"
+            # 4. QoS ledgers flat at quiesce: nothing in flight anywhere
+            deadline = time.monotonic() + cfg.quiesce_deadline_s
+            snap = self.census.snapshot()
+            def busy_rows(s):
+                return [
+                    k for k, val in s.items()
+                    if val and ("_inflight_" in k or k.endswith("_bulk_waiting"))
+                ]
+            while time.monotonic() < deadline and busy_rows(snap):
+                time.sleep(0.2)
+                snap = self.census.snapshot()
+            assert not busy_rows(snap), (
+                f"QoS ledger not flat at quiesce: {busy_rows(snap)}"
+            )
+            # the rest of the census must be flat too (cumulative QoS shed
+            # counters, keyspace growth, and conn-pool churn excepted)
+            self.census.assert_flat(
+                before, snap,
+                ignore=("*.keys", "*.wait_entries", "*.qos_shed_*",
+                        "*.connections", "*.conn_idle", "*.conn_in_use",
+                        "*.node_clients", "*.repl_*", "*.tracking_*"),
+                context="qos soak",
+            )
+            self.report.census.append(snap)
+            budget = max(10, (self.report.writes_acked + self.report.reads) // 2)
+            assert self.report.errors <= budget, (
+                f"error budget blown: {self.report.errors} vs {budget}"
+            )
+            return self.report
+        finally:
+            self._teardown()

@@ -49,6 +49,33 @@ class CoalesceIneligible(Exception):
     """Run cannot fuse; caller must dispatch per group."""
 
 
+def runs_within_admission(runs, shed_mask) -> List[Tuple[int, int]]:
+    """Split each [start, end) coalescible run at QoS shed boundaries
+    (ISSUE 10): a shed command never dispatches, so a run spanning one would
+    fuse commands the admission decision already refused — and, worse, a
+    fused ADD run that partially applied could never be re-dispatched
+    (at-most-once).  Runs therefore form per ADMITTED window only: each run
+    is cut into its maximal admitted sub-runs, and sub-runs shorter than 2
+    fall back to per-command dispatch.  ``shed_mask`` None (fully admitted
+    frame) returns ``runs`` unchanged — the disarmed path costs nothing."""
+    if shed_mask is None:
+        return list(runs)
+    out: List[Tuple[int, int]] = []
+    for start, end in runs:
+        i = start
+        while i < end:
+            if shed_mask[i]:
+                i += 1
+                continue
+            j = i + 1
+            while j < end and not shed_mask[j]:
+                j += 1
+            if j - i >= 2:
+                out.append((i, j))
+            i = j
+    return out
+
+
 def _concat_segments(engine, keys_list) -> Tuple[np.ndarray, np.ndarray, List[int]]:
     """Concatenate per-op int-key arrays into one preallocated buffer plus an
     aligned segment-slot column.  Returns (slot, keys, lengths)."""

@@ -59,6 +59,27 @@ def set_overlap(on: bool) -> bool:
     return prev
 
 
+# process-global flush-window deadline (ISSUE 10): FlushPipelines built
+# without an explicit deadline_s follow this default, so CONFIG SET
+# qos-interactive-deadline-ms arms the deadline-triggered window close for
+# every pipeline constructed afterwards (same process-global discipline as
+# set_overlap).  None = deadline trigger off (the historical shape).
+_window_deadline_s: Optional[float] = None
+
+
+def set_window_deadline(seconds: Optional[float]) -> Optional[float]:
+    """Set the default FlushPipeline window deadline; returns the previous
+    value (callers restore it — the A/B discipline)."""
+    global _window_deadline_s
+    prev = _window_deadline_s
+    _window_deadline_s = seconds
+    return prev
+
+
+def window_deadline() -> Optional[float]:
+    return _window_deadline_s
+
+
 _staging_safe: Optional[bool] = None
 
 
@@ -566,40 +587,151 @@ class FlushPipeline:
       * overlap off: the strict serial reference — a counted barrier on the
         window's device values (the stage/dispatch drain) then an immediate
         forced fetch: exactly 2 blocking syncs per window, the 2N shape.
+
+    Deadline-aware window close (ISSUE 10, the QoS plane): size/arrival are
+    no longer the ONLY flush triggers —
+
+      * ``submit(fn, interactive=True)`` closes the window at the deadline
+        class boundary: an interactive window's readback is forced as soon
+        as its dispatch lands instead of parking un-forced behind up to
+        ``depth`` bulk windows (laziness trades the bulk stream's
+        throughput for the interactive result's latency, exactly the wrong
+        trade for that class);
+      * with ``deadline_s`` set, any window older than the deadline is
+        forced by the next submit, bounding how long a result can sit
+        dispatched-but-undelivered when traffic goes quiet.
+
+    Neither trigger reorders device work — only WAITS move, so results stay
+    bit-identical (the same contract as the overlap switch itself).
     """
 
-    def __init__(self, *, overlap: Optional[bool] = None, depth: int = 2):
+    def __init__(self, *, overlap: Optional[bool] = None, depth: int = 2,
+                 deadline_s: Optional[float] = None):
         self.overlap = overlap_enabled() if overlap is None else bool(overlap)
         self.depth = max(1, depth)
-        self._ring: List[ReadbackFuture] = []
+        # None = follow the process-global default (set_window_deadline,
+        # armed by CONFIG SET qos-interactive-deadline-ms)
+        self.deadline_s = (
+            _window_deadline_s if deadline_s is None else deadline_s
+        )
+        self._ring: List[Tuple[ReadbackFuture, float]] = []
 
-    def submit(self, fn: Callable[[], Tuple[Sequence[Any], Optional[Callable]]]) -> ReadbackFuture:
+    @staticmethod
+    def _force(fut: ReadbackFuture) -> None:
+        try:
+            fut.result()
+        except Exception:  # noqa: BLE001 — error stays on the future
+            pass
+
+    def submit(self, fn: Callable[[], Tuple[Sequence[Any], Optional[Callable]]],
+               interactive: bool = False) -> ReadbackFuture:
         device, finish = fn()
         fut = ReadbackFuture(device, finish)
         if not self.overlap:
             barrier(tuple(device))
-            try:
-                fut.result()
-            except Exception:  # noqa: BLE001 — error stays on the future
-                pass
+            self._force(fut)
             return fut
-        self._ring.append(fut)
+        now = time.monotonic()
+        # deadline-triggered close: windows older than deadline_s deliver
+        # NOW — a quiet lane must not hold results hostage to the next
+        # arrival or the depth overflow
+        if self.deadline_s is not None:
+            while self._ring and now - self._ring[0][1] > self.deadline_s:
+                self._force(self._ring.pop(0)[0])
+        if interactive:
+            # deadline-class close: the interactive window never parks in
+            # the dispatch-ahead ring — one readback sync, right here, at
+            # the earliest point the device can deliver it
+            self._force(fut)
+            return fut
+        self._ring.append((fut, now))
         if len(self._ring) > self.depth:
-            oldest = self._ring.pop(0)
-            try:
-                oldest.result()
-            except Exception:  # noqa: BLE001
-                pass
+            self._force(self._ring.pop(0)[0])
         return fut
+
+    def pending(self) -> int:
+        return len(self._ring)
 
     def drain(self) -> None:
         """Force every still-pending window (end of the stream)."""
         ring, self._ring = self._ring, []
-        for fut in ring:
-            try:
-                fut.result()
-            except Exception:  # noqa: BLE001
-                pass
+        for fut, _t in ring:
+            self._force(fut)
+
+
+# -- per-class QoS in-flight ledger (ISSUE 10) ---------------------------------
+
+
+class QosLedger:
+    """Per-deadline-class in-flight accounting: one global ledger on the
+    server's WindowScheduler, one per DeviceLane.  Every ``enter`` must be
+    paired with an ``exit`` — the in-flight rows are census gauges (the
+    soak's flat-census assertion guards them), the cumulative rows feed the
+    CLUSTER QOS / CLUSTER DEVICES wire views."""
+
+    __slots__ = ("_lock", "frames", "ops", "nbytes", "waiting",
+                 "dispatched_ops", "dispatched_frames")
+
+    _CLASSES = ("interactive", "bulk")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.frames = {c: 0 for c in self._CLASSES}
+        self.ops = {c: 0 for c in self._CLASSES}
+        self.nbytes = {c: 0 for c in self._CLASSES}
+        self.waiting = 0  # bulk frames parked at the admission gate
+        self.dispatched_ops = {c: 0 for c in self._CLASSES}
+        self.dispatched_frames = {c: 0 for c in self._CLASSES}
+
+    @classmethod
+    def _cls(cls, qos_class: str) -> str:
+        return qos_class if qos_class in cls._CLASSES else "bulk"
+
+    def enter(self, qos_class: str, ops: int, nbytes: int = 0) -> None:
+        c = self._cls(qos_class)
+        with self._lock:
+            self.frames[c] += 1
+            self.ops[c] += ops
+            self.nbytes[c] += nbytes
+            self.dispatched_ops[c] += ops
+            self.dispatched_frames[c] += 1
+
+    def exit(self, qos_class: str, ops: int, nbytes: int = 0) -> None:
+        c = self._cls(qos_class)
+        with self._lock:
+            self.frames[c] -= 1
+            self.ops[c] -= ops
+            self.nbytes[c] -= nbytes
+
+    def wait_enter(self) -> None:
+        with self._lock:
+            self.waiting += 1
+
+    def wait_exit(self) -> None:
+        with self._lock:
+            self.waiting -= 1
+
+    def census(self, prefix: str = "qos") -> dict:
+        """Drain-to-zero gauges only (cumulative counters are exposed on the
+        wire views instead, so flat-census assertions stay meaningful)."""
+        with self._lock:
+            out = {f"{prefix}_bulk_waiting": float(self.waiting)}
+            for c in self._CLASSES:
+                out[f"{prefix}_{c}_inflight_frames"] = float(self.frames[c])
+                out[f"{prefix}_{c}_inflight_ops"] = float(self.ops[c])
+                out[f"{prefix}_{c}_inflight_bytes"] = float(self.nbytes[c])
+            return out
+
+    def wire_row(self) -> list:
+        """[in-flight ops i/b, in-flight bytes i/b, dispatched ops i/b] —
+        the compact CLUSTER DEVICES per-lane projection."""
+        with self._lock:
+            return [
+                self.ops["interactive"], self.ops["bulk"],
+                self.nbytes["interactive"], self.nbytes["bulk"],
+                self.dispatched_ops["interactive"],
+                self.dispatched_ops["bulk"],
+            ]
 
 
 # -- per-device serving lanes (ISSUE 8: device-sharded slot ownership) --------
@@ -648,25 +780,36 @@ class DeviceLane:
         self.pool = StagingPool(depth=depth)
         self.pipeline = FlushPipeline(depth=depth)
         self.stats = device_stats(self.dev_id)
+        # per-lane QoS ledger (ISSUE 10): queue depth / in-flight ops+bytes
+        # per deadline class, read by CLUSTER DEVICES and the lane census
+        self.qos = QosLedger()
         self._laneset = laneset
         self._gate = threading.Lock()
         self.dispatches = 0
 
-    def occupy(self, n_items: int = 0):
+    def occupy(self, n_items: int = 0, qos_class: Optional[str] = None,
+               nbytes: int = 0):
         """Context manager bounding one dispatch's device occupancy: holds
         the lane gate (per-device serialization) and, under the CPU-replica
-        knob, the modeled per-chip compute time for `n_items` ops."""
-        return _LaneOccupancy(self, n_items)
+        knob, the modeled per-chip compute time for `n_items` ops.  With
+        `qos_class` given (the scheduler armed), the dispatch is accounted
+        on the lane's per-class QoS ledger for its whole residency."""
+        return _LaneOccupancy(self, n_items, qos_class, nbytes)
 
 
 class _LaneOccupancy:
-    __slots__ = ("_lane", "_n")
+    __slots__ = ("_lane", "_n", "_cls", "_nbytes")
 
-    def __init__(self, lane: DeviceLane, n_items: int):
+    def __init__(self, lane: DeviceLane, n_items: int,
+                 qos_class: Optional[str] = None, nbytes: int = 0):
         self._lane = lane
         self._n = n_items
+        self._cls = qos_class
+        self._nbytes = nbytes
 
     def __enter__(self):
+        if self._cls is not None:
+            self._lane.qos.enter(self._cls, self._n, self._nbytes)
         self._lane._gate.acquire()
         self._lane._laneset._enter()
         self._lane.dispatches += 1
@@ -680,6 +823,8 @@ class _LaneOccupancy:
         finally:
             self._lane._laneset._exit()
             self._lane._gate.release()
+            if self._cls is not None:
+                self._lane.qos.exit(self._cls, self._n, self._nbytes)
         return False
 
 
@@ -736,6 +881,9 @@ class LaneSet:
         out = {"lanes": len(self._lanes), "active_dispatches": self.active()}
         for dev_id, lane in sorted(self._lanes.items()):
             out[f"lane{dev_id}_staging_slots"] = lane.pool.slot_count()
+            # per-lane QoS in-flight (ISSUE 10): must drain to 0 at quiesce
+            for k, v in lane.qos.census(prefix=f"lane{dev_id}_qos").items():
+                out[k] = v
         return out
 
     def clear(self) -> None:

@@ -92,6 +92,12 @@ class CommandContext:
         # per-connection tracking state (tracking/table.py ConnTracking);
         # None until CLIENT TRACKING ON
         self.tracking = None
+        # QoS plane (ISSUE 10, server/scheduler.py): the connection-declared
+        # deadline class ("interactive"/"bulk"; None = heuristic by frame
+        # size) and tenant (None = derive from the frame's key {hashtag})
+        # — set by CLIENT QOS CLASS <c> [TENANT <t>]
+        self.qos_class: Optional[str] = None
+        self.tenant: Optional[str] = None
         self.subscriptions: Dict[str, int] = {}
         self.psubscriptions: Dict[str, int] = {}
         self.push: Optional[Callable[[Any], None]] = None  # wired by the server

@@ -19,6 +19,13 @@ task's completion queue (FIFO: reply order and RESP framing are untouched),
 while the read loop stages and dispatches the NEXT frame.  Frames without
 device results flush immediately.  `--no-overlap` restores the serial
 stage->dispatch->fetch shape for A/B measurement.
+
+QoS plane (server/scheduler.py, ISSUE 10): between frame parsing and
+dispatch, every frame is classified into a deadline class (interactive vs
+bulk), charged against its tenant's token bucket (over-budget = -BUSY shed
+before dispatch), and admitted class-aware — interactive on a reserved
+worker slice, bulk behind a bounded admission gate.  `--no-qos` /
+`RTPU_NO_QOS=1` restores pure arrival-order dispatch, bit-identically.
 """
 from __future__ import annotations
 
@@ -32,9 +39,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from redisson_tpu.client import routing as _routing
 from redisson_tpu.core import ioplane
+from redisson_tpu.core.coalesce import runs_within_admission
 from redisson_tpu.core.engine import Engine
 from redisson_tpu.net import resp
 from redisson_tpu.net.resp import ProtocolError, RespError
+from redisson_tpu.server import scheduler as _sched
 from redisson_tpu.server.registry import LazyReply, REGISTRY, CommandContext
 
 
@@ -134,6 +143,8 @@ class TpuServer:
         users: Optional[Dict[str, str]] = None,
         overlap: Optional[bool] = None,
         devices: Optional[Any] = None,
+        qos: Optional[bool] = None,
+        dispatch_ahead: Optional[int] = None,
     ):
         self.engine = engine if engine is not None else Engine()
         # device-sharded serving (ISSUE 8): `devices` maps the 16384-slot
@@ -154,8 +165,26 @@ class TpuServer:
         self.overlap = _ioplane.overlap_enabled() if overlap is None else bool(overlap)
         # dispatch-ahead bound: at most this many frames may sit between
         # "dispatched" and "replies written" per connection (bounds device
-        # memory held by un-drained readbacks)
-        self.readback_ahead = 2
+        # memory held by un-drained readbacks).  Configurable (ISSUE 10
+        # satellite): tpu-server --dispatch-ahead N / CONFIG SET
+        # dispatch-ahead — applied to connections opened AFTER the change
+        # (each connection sizes its semaphore at accept time); default 2.
+        self.readback_ahead = (
+            2 if dispatch_ahead is None else max(1, int(dispatch_ahead))
+        )
+        # deadline-aware window scheduling + per-tenant QoS (ISSUE 10,
+        # server/scheduler.py): classify frames interactive/bulk, charge
+        # per-tenant token buckets, shed over-budget frames with -BUSY
+        # before dispatch.  None = follow the process-global switch
+        # (RTPU_NO_QOS=1 disarms); shedding itself is additionally opt-in
+        # via CONFIG SET qos-tenant-rate (default unlimited).
+        self.scheduler = _sched.WindowScheduler(enabled=qos)
+        if self.scheduler.bulk_slots <= 0:
+            # reserve one dispatch slot for interactive traffic: bulk-class
+            # frames across ALL connections share workers-1 admission slots
+            self.scheduler.bulk_slots = max(1, workers - 1)
+        self._bulk_gate: Optional[asyncio.Semaphore] = None
+        self._bulk_gate_n = 0
         self.host = host
         self.port = port
         self.password = password
@@ -173,7 +202,7 @@ class TpuServer:
         self.mode = mode
         self.node_id = uuid.uuid4().hex
         self.started_at = time.time()
-        self.stats = {"connections": 0, "commands": 0, "errors": 0}
+        self.stats = {"connections": 0, "commands": 0, "errors": 0, "sheds": 0}
         # observability (utils/metrics.py): per-command timers + counters,
         # rendered by the METRICS command; hooks = NettyHook-analog SPI
         from redisson_tpu.utils.metrics import MetricsHook, MetricsRegistry
@@ -182,6 +211,23 @@ class TpuServer:
         self.hooks = [MetricsHook(self.metrics)]
         self.metrics.gauge("keys", lambda: len(self.engine.store))
         self.metrics.gauge("connections", lambda: self.stats["connections"])
+        # QoS plane gauges (ISSUE 10): shed totals + per-class in-flight —
+        # the census variants of the same numbers live in scheduler.census()
+        self.metrics.gauge("qos_shed_ops", lambda: self.scheduler.shed_ops)
+        self.metrics.gauge(
+            "qos_shed_frames", lambda: self.scheduler.shed_frames
+        )
+        self.metrics.gauge(
+            "qos_interactive_inflight_ops",
+            lambda: self.scheduler.ledger.ops["interactive"],
+        )
+        self.metrics.gauge(
+            "qos_bulk_inflight_ops",
+            lambda: self.scheduler.ledger.ops["bulk"],
+        )
+        self.metrics.gauge(
+            "qos_bulk_waiting", lambda: self.scheduler.ledger.waiting
+        )
         # cluster_view: [(slot_from, slot_to, host, port, node_id)] when this
         # node is part of a cluster (set by the topology/launcher, L3')
         self.cluster_view: List[Tuple[int, int, str, int, str]] = []
@@ -257,6 +303,19 @@ class TpuServer:
         self._objcall_handles: "OrderedDict" = OrderedDict()
         self._objcall_handles_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="rtpu-srv")
+        # reserved interactive dispatch capacity (ISSUE 10): frames the
+        # scheduler classifies interactive run HERE, so a bulk flood holding
+        # every shared worker can never queue ahead of them (the thread-pool
+        # face of "interactive ops are admitted into the window first").
+        # FULL --workers width on purpose: this is isolation, not a
+        # reservation — with QoS armed by default, small-frame-heavy
+        # deployments (and sharded interactive frames' per-device fan-out)
+        # must keep their historical dispatch concurrency (threads spawn
+        # lazily, so an all-bulk workload never pays for these)
+        self._workers = workers
+        self._qos_pool = ThreadPoolExecutor(
+            max_workers=max(2, workers), thread_name_prefix="rtpu-qos"
+        )
         # OBJCALL may run arbitrarily-blocking object methods (blocking
         # queues, latches); isolate them on a wide pool so parked callers
         # can't starve the data-plane workers (the reference marks such
@@ -292,7 +351,9 @@ class TpuServer:
                 self.engine.placement.n_devices
                 if self.engine.placement is not None else 0
             ),
+            "dispatch-ahead": self.readback_ahead,
         }
+        view.update(self.scheduler.config_view())
         return view
 
     def config_set(self, key: str, value: str) -> bool:
@@ -313,6 +374,30 @@ class TpuServer:
                 return False
             self.tracking.max_keys = n
             return True
+        if key == "dispatch-ahead":
+            n = int(value)
+            if n <= 0:
+                return False
+            # connections opened from now on size their per-connection
+            # dispatch-ahead semaphore with this (see _handle)
+            self.readback_ahead = n
+            return True
+        if key.startswith("qos-"):
+            if key == "qos-bulk-slots" and int(value) <= 0:
+                # 0 means "re-derive from workers" exactly like construction
+                # time — it must never silently disable the flood protection
+                value = str(max(1, self._workers - 1))
+            ok = self.scheduler.config_set(key, value)
+            if ok and key == "qos-interactive-deadline-ms":
+                # arm/disarm ioplane's deadline-triggered window close: live
+                # lane pipelines update NOW, pipelines built later inherit
+                # the process-global default
+                s = self.scheduler.interactive_deadline_ms / 1000.0
+                ioplane.set_window_deadline(s if s > 0 else None)
+                if self.engine.lanes is not None:
+                    for lane in self.engine.lanes.lanes():
+                        lane.pipeline.deadline_s = s if s > 0 else None
+            return ok
         return False
 
     def next_client_id(self) -> int:
@@ -693,25 +778,12 @@ class TpuServer:
     def _estimate_device_items(cmds) -> int:
         """Rough op count a command list dispatches to one device — the
         occupancy unit the per-device lane accounts (and, under the bench
-        CPU-replica knob, the modeled per-chip compute time).  Blob verbs
-        count their batch elements; everything else counts 1."""
-        total = 0
-        for cmd in cmds:
-            try:
-                verb = bytes(cmd[0]).upper()
-                if verb in (b"BF.MADD64", b"BF.MEXISTS64", b"PFADD64"):
-                    total += max(1, len(cmd[2]) // 8)
-                elif verb in (b"BFA.MADD64", b"BFA.MEXISTS64", b"HLLA.MADD64"):
-                    total += max(1, len(cmd[3]) // 8)
-                elif verb in (b"SETBITSB", b"GETBITSB"):
-                    total += max(1, len(cmd[2]) // 4)
-                else:
-                    total += 1
-            except (IndexError, TypeError):
-                total += 1
-        return total
+        CPU-replica knob, the modeled per-chip compute time).  The sizing
+        rule itself lives in server/scheduler.py (ISSUE 10) so lane
+        accounting and tenant budgets cannot diverge."""
+        return _sched.estimate_device_items(cmds)
 
-    def _occupancy_gate(self, cmds):
+    def _occupancy_gate(self, cmds, qos_class: Optional[str] = None):
         """Lane-occupancy context for one sequential-path dispatch (a single
         command or one same-verb coalesced run): the owning device's lane
         when every key maps to ONE device, else None (no gate).  This is how
@@ -732,25 +804,38 @@ class TpuServer:
         if dev is None:
             return None
         lane = eng.lanes.lane(eng.placement.devices[dev])
-        return lane.occupy(self._estimate_device_items(cmds))
+        return lane.occupy(
+            self._estimate_device_items(cmds), qos_class=qos_class,
+            nbytes=_sched._frame_nbytes(cmds) if qos_class is not None else 0,
+        )
 
-    def _dispatch_laned(self, ctx, cmd):
+    def _dispatch_laned(self, ctx, cmd, qos_class: Optional[str] = None):
         """Sequential-path single-command dispatch with lane accounting."""
-        gate = self._occupancy_gate((cmd,))
+        gate = self._occupancy_gate((cmd,), qos_class)
         if gate is None:
             return self._dispatch_gated(ctx, cmd)
         with gate:
             return self._dispatch_gated(ctx, cmd)
 
-    def _dispatch_bloom_run_laned(self, ctx, cmds):
+    def _dispatch_bloom_run_laned(self, ctx, cmds,
+                                  qos_class: Optional[str] = None):
         """Sequential-path coalesced run with lane accounting (a run whose
         filters span devices gets no gate — the coalescer itself falls back
         to per-record dispatch on a mixed-device group)."""
-        gate = self._occupancy_gate(cmds)
+        gate = self._occupancy_gate(cmds, qos_class)
         if gate is None:
             return self._dispatch_bloom_run(ctx, cmds)
         with gate:
             return self._dispatch_bloom_run(ctx, cmds)
+
+    def _pool_for(self, adm):
+        """Worker pool for one frame's dispatch: interactive-class frames
+        (scheduler armed) run on the reserved interactive pool so a bulk
+        flood occupying every shared worker can never queue ahead of them;
+        everything else keeps the historical shared pool."""
+        if adm is not None and adm.interactive:
+            return self._qos_pool
+        return self._pool
 
     def _dispatch_one_sync(self, ctx, cmd):
         """One command, dispatched with the per-command error translation of
@@ -780,7 +865,8 @@ class TpuServer:
                 resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
             )
 
-    def _dispatch_device_bucket(self, ctx, dev_index: int, items):
+    def _dispatch_device_bucket(self, ctx, dev_index: int, items,
+                                qos_class: Optional[str] = None):
         """One device's ordered slice of a pipelined frame (placement
         plan_frame 'sharded' segment): runs on a worker thread WHILE the
         other devices' buckets run on theirs — the per-chip dispatch lanes
@@ -802,7 +888,12 @@ class TpuServer:
         from contextlib import nullcontext
 
         gate = (
-            lane.occupy(self._estimate_device_items(cmds))
+            lane.occupy(
+                self._estimate_device_items(cmds), qos_class=qos_class,
+                nbytes=(
+                    _sched._frame_nbytes(cmds) if qos_class is not None else 0
+                ),
+            )
             if lane is not None else nullcontext()
         )
         with gate:
@@ -819,13 +910,14 @@ class TpuServer:
                 ci += 1
         return out
 
-    async def _run_frame_sharded(self, ctx, commands, plan, loop):
+    async def _run_frame_sharded(self, ctx, commands, plan, loop, adm=None):
         """Execute one pipelined frame under a placement plan: 'sharded'
         segments fan their per-device buckets out on the worker pool
         CONCURRENTLY (each bucket FIFO on its device lane — per-key order
         is preserved because a key maps to exactly one device), 'serial'
         segments run in frame order as barriers.  Reply order is by frame
         index regardless of completion order."""
+        qos_class = adm.qos_class if adm is not None else None
         results: list = [None] * len(commands)
         for seg_kind, seg in plan:
             if seg_kind == "serial":
@@ -839,7 +931,7 @@ class TpuServer:
                             and isinstance(cmd[0], (bytes, bytearray))
                             and bytes(cmd[0]).upper() in _SLOW_COMMANDS
                         )
-                        else self._pool
+                        else self._pool_for(adm)
                     )
                     results[i] = await loop.run_in_executor(
                         pool, self._dispatch_one_sync, ctx, cmd
@@ -849,8 +941,8 @@ class TpuServer:
             for dev_index, idxs in seg.items():
                 self.stats["commands"] += len(idxs)
                 jobs.append(loop.run_in_executor(
-                    self._pool, self._dispatch_device_bucket, ctx, dev_index,
-                    [(i, commands[i]) for i in idxs],
+                    self._pool_for(adm), self._dispatch_device_bucket, ctx,
+                    dev_index, [(i, commands[i]) for i in idxs], qos_class,
                 ))
             outs = await asyncio.gather(*jobs, return_exceptions=True)
             err = next((o for o in outs if isinstance(o, BaseException)), None)
@@ -885,6 +977,259 @@ class TpuServer:
             f"db0:keys={len(self.engine.store)},expires=0\r\n"
         )
 
+    # -- QoS admission (ISSUE 10: deadline classes + per-tenant budgets) ------
+
+    def _bulk_gate_for(self, slots: int) -> Optional[asyncio.Semaphore]:
+        """The server-wide bulk admission gate: at most `slots` bulk-class
+        frames may be in dispatch at once across ALL connections, so a bulk
+        flood can never occupy every worker ahead of interactive traffic.
+        Rebuilt when CONFIG SET qos-bulk-slots changes the count (holders of
+        the old gate release into the old gate — each frame releases exactly
+        the object it acquired)."""
+        if slots <= 0:
+            return None
+        gate = self._bulk_gate
+        if gate is None or self._bulk_gate_n != slots:
+            gate = self._bulk_gate = asyncio.Semaphore(slots)
+            self._bulk_gate_n = slots
+        return gate
+
+    async def _serve_frame(self, ctx, commands, loop, write_q,
+                           readback_slots, alive) -> bool:
+        """Admit + dispatch ONE parsed frame (the read loop's per-frame
+        body).  Returns False when the connection must stop reading (writer
+        task dead).  With the scheduler armed the frame is classified
+        (interactive/bulk) and charged against its tenant's token bucket
+        BEFORE anything dispatches: over-budget commands shed with -BUSY
+        (never any queue residency), bulk frames pass the bounded bulk
+        admission gate, and the frame's dispatch is accounted on the
+        per-class in-flight ledger for its whole residency."""
+        sched = self.scheduler
+        adm = None
+        bulk_gate = None
+        acquired = begun = False
+        if (
+            sched.armed
+            and commands
+            and ctx.authenticated
+            and ctx.multi_queue is None
+        ):
+            adm = sched.admit(ctx, commands)
+            if adm.shed_count:
+                self.stats["sheds"] += adm.shed_count
+        fully_shed = (
+            adm is not None
+            and adm.shed_mask is not None
+            and all(adm.shed_mask)
+        )
+        try:
+            if adm is not None:
+                # a FULLY-refused frame never dispatches (its replies are
+                # pure encodes), so it must not occupy a bulk admission
+                # slot — holding one through the shed path would give the
+                # over-budget tenant's refusals queue residency that delays
+                # in-budget bulk tenants
+                if not adm.interactive and not fully_shed:
+                    bulk_gate = self._bulk_gate_for(sched.bulk_slots)
+                    if bulk_gate is not None:
+                        sched.ledger.wait_enter()
+                        try:
+                            await bulk_gate.acquire()
+                            acquired = True
+                        finally:
+                            sched.ledger.wait_exit()
+                sched.begin(adm)
+                begun = True
+            ok = await self._dispatch_frame(
+                ctx, commands, loop, write_q, readback_slots, alive, adm
+            )
+        finally:
+            if begun:
+                sched.end(adm)
+            if acquired:
+                bulk_gate.release()
+        if ok and fully_shed and sched.shed_penalty_ms > 0:
+            # fully-refused frame: park THIS connection's read loop for the
+            # shed penalty (replies already flushed, every gate/ledger hold
+            # already released) — a client that spins on -BUSY cannot turn
+            # the cheap shed path into a parse-plane DoS; nobody else's
+            # traffic is delayed
+            await asyncio.sleep(sched.shed_penalty_ms / 1000.0)
+        return ok
+
+    async def _dispatch_frame(self, ctx, commands, loop, write_q,
+                              readback_slots, alive, adm=None) -> bool:
+        # Two-phase frame execution: dispatch every command of the
+        # pipelined frame first (handlers may return LazyReply —
+        # device work enqueued, NOT forced), then force all lazy
+        # replies together and write the replies in order.  One
+        # device->host sync per frame instead of per command; per-
+        # connection ordering is untouched (dispatch stays
+        # sequential, and the device stream is in-order).
+        # Same-verb BF blob RUNS additionally collapse into one
+        # fused kernel dispatch each (_dispatch_bloom_run — the
+        # coalescing plane; runs never cross a verb change, so
+        # frame order is preserved exactly).
+        # Device-sharded frame plan (ISSUE 8): with the slot table
+        # placed over >1 device, the frame's single-device keyed
+        # data commands split into per-device queues dispatched
+        # CONCURRENTLY (one worker per device lane) instead of
+        # serializing through one lane; everything else barriers in
+        # frame order.  plan is None when there is nothing to shard
+        # — the sequential loop below is byte-identical to before.
+        qos_class = adm.qos_class if adm is not None else None
+        shed_mask = adm.shed_mask if adm is not None else None
+        shed_enc = (
+            resp.encode_error(_sched.busy_error(adm.tenant))
+            if shed_mask is not None else None
+        )
+        plan = None
+        if (
+            self.engine.placement is not None
+            and ctx.multi_queue is None
+            and ctx.authenticated
+            and not ctx.asking
+            and shed_mask is None  # a partially-shed frame stays sequential
+            and len(commands) > 1
+        ):
+            try:
+                # with the CPU-replica occupancy model armed (bench
+                # config5d A/B), even a 1-device frame runs the lane
+                # dispatch path so both legs execute identical code
+                plan = self.engine.placement.plan_frame(
+                    commands,
+                    single_device_ok=(
+                        ioplane.replica_occupancy() is not None
+                    ),
+                )
+            except Exception:  # noqa: BLE001 — planning must never
+                plan = None    # break a frame; fall back to serial
+        if plan is not None:
+            results = await self._run_frame_sharded(
+                ctx, commands, plan, loop, adm
+            )
+            if any(isinstance(r, LazyReply) for r in results):
+                if self.overlap:
+                    await readback_slots.acquire()
+                    if not alive["writer"]:
+                        return False
+                    fut = loop.run_in_executor(
+                        self._pool_for(adm), _force_lazies, results, self
+                    )
+                    write_q.put_nowait(
+                        _PendingFrame(results, fut, ctx.proto)
+                    )
+                    return True
+                await loop.run_in_executor(
+                    self._pool_for(adm), _force_lazies, results, self
+                )
+            if results:
+                write_q.put_nowait(_encode_frame(results, ctx.proto))
+            return True
+        run_at: Dict[int, int] = {}
+        if len(commands) > 1:
+            runs = [
+                (s, e)
+                for s, e in _routing.coalescible_frame_runs(commands)
+                if all(
+                    isinstance(a, (bytes, bytearray))
+                    for c in commands[s:e]
+                    for a in c
+                )
+            ]
+            # QoS shed boundary (ISSUE 10): a run never spans a shed
+            # command — the fused window covers ADMITTED ops only, so a
+            # partially-applied coalesced add run can never be created by
+            # (or re-dispatched after) a shed decision
+            run_at = dict(runs_within_admission(runs, shed_mask))
+        results = []
+        ci = -1
+        for cmd in commands:
+            ci += 1
+            if len(results) > ci:
+                continue  # covered by an already-dispatched run
+            if shed_mask is not None and shed_mask[ci]:
+                # load-shed: -BUSY in frame position, NO dispatch, no
+                # queue residency (the reply FIFO is untouched — the
+                # error encodes exactly where the command's reply goes)
+                results.append(_Encoded(shed_enc))
+                continue
+            run_end = run_at.get(ci)
+            if run_end is not None:
+                run_cmds = commands[ci:run_end]
+                self.stats["commands"] += len(run_cmds)
+                results.extend(
+                    await loop.run_in_executor(
+                        self._pool_for(adm), self._dispatch_bloom_run_laned,
+                        ctx, run_cmds, qos_class,
+                    )
+                )
+                continue
+            if not isinstance(cmd, list) or not all(
+                isinstance(a, (bytes, bytearray)) for a in cmd
+            ):
+                results.append(_Encoded(resp.encode_error("ERR bad request frame")))
+                continue
+            self.stats["commands"] += 1
+            # OBJCALL (user methods may park) and blocking verbs go
+            # to the wide slow pool: a parked handler must never
+            # starve the small fast pool every connection shares
+            pool = (
+                self._slow_pool
+                if bytes(cmd[0]).upper() in _SLOW_COMMANDS
+                else self._pool_for(adm)
+            )
+            try:
+                results.append(
+                    await loop.run_in_executor(
+                        pool, self._dispatch_laned, ctx, cmd, qos_class
+                    )
+                )
+            except RespError as e:
+                self.stats["errors"] += 1
+                results.append(_Encoded(resp.encode_error(str(e.args[0]))))
+            except ConnectionResetError:
+                raise
+            except RuntimeError as e:
+                if "shutdown" in str(e):  # worker pool stopped: drop conn
+                    raise ConnectionResetError(str(e)) from e
+                # any other RuntimeError (uninitialized object, state
+                # errors) is a per-command failure — reply -ERR, keep
+                # the connection (dropping it would kill every other
+                # pipelined command on this socket)
+                self.stats["errors"] += 1
+                results.append(
+                    _Encoded(resp.encode_error(f"ERR internal: {type(e).__name__}: {e}"))
+                )
+            except Exception as e:  # noqa: BLE001 — sandbox handler bugs per-command
+                self.stats["errors"] += 1
+                results.append(
+                    _Encoded(resp.encode_error(f"ERR internal: {type(e).__name__}: {e}"))
+                )
+        if any(isinstance(r, LazyReply) for r in results):
+            if self.overlap:
+                # overlap plane: hand the readback to the writer task
+                # as a completion-queue entry and go straight back to
+                # reading — frame N+1's upload/dispatch overlaps this
+                # frame's D2H.  FIFO queue order preserves the reply
+                # order; proto is snapshotted at dispatch time.
+                await readback_slots.acquire()
+                if not alive["writer"]:
+                    return False  # connection is going down; stop dispatching
+                fut = loop.run_in_executor(
+                    self._pool_for(adm), _force_lazies, results, self
+                )
+                write_q.put_nowait(_PendingFrame(results, fut, ctx.proto))
+                return True
+            await loop.run_in_executor(
+                self._pool_for(adm), _force_lazies, results, self
+            )
+        if results:
+            # one queue item per frame — the whole frame's replies
+            # encode in one pass and write in one syscall batch
+            write_q.put_nowait(_encode_frame(results, ctx.proto))
+        return True
+
     # -- asyncio plumbing ----------------------------------------------------
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -907,8 +1252,12 @@ class TpuServer:
 
         # dispatch-ahead bound (overlap plane): the read loop may run at most
         # `readback_ahead` frames ahead of the slowest un-written readback
-        readback_slots = asyncio.Semaphore(max(1, self.readback_ahead))
-        writer_alive = True
+        # (snapshotted at accept time so a mid-connection CONFIG SET
+        # dispatch-ahead cannot skew this connection's acquire/release pairing)
+        readback_ahead = max(1, self.readback_ahead)
+        readback_slots = asyncio.Semaphore(readback_ahead)
+        # shared liveness flag (writer task -> read loop/_serve_frame)
+        alive = {"writer": True}
 
         async def writer_task():
             # The completion queue drain: items are pre-encoded bytes (pubsub
@@ -924,7 +1273,6 @@ class TpuServer:
             # written as a SINGLE transport.write (one syscall per drained
             # batch instead of per frame).  An unresolved readback only ever
             # delays bytes queued BEHIND it, never ones already collected.
-            nonlocal writer_alive
             held = None  # a _PendingFrame popped while coalescing bytes
             try:
                 while True:
@@ -974,9 +1322,9 @@ class TpuServer:
                     if final:
                         return
             finally:
-                writer_alive = False
+                alive["writer"] = False
                 # un-stick a read loop parked on the dispatch-ahead bound
-                for _ in range(max(1, self.readback_ahead)):
+                for _ in range(readback_ahead):
                     readback_slots.release()
 
         wt = asyncio.create_task(writer_task())
@@ -990,155 +1338,10 @@ class TpuServer:
                 except ProtocolError as e:
                     write_q.put_nowait(resp.encode_error(f"ERR protocol error: {e}"))
                     break
-                # Two-phase frame execution: dispatch every command of the
-                # pipelined frame first (handlers may return LazyReply —
-                # device work enqueued, NOT forced), then force all lazy
-                # replies together and write the replies in order.  One
-                # device->host sync per frame instead of per command; per-
-                # connection ordering is untouched (dispatch stays
-                # sequential, and the device stream is in-order).
-                # Same-verb BF blob RUNS additionally collapse into one
-                # fused kernel dispatch each (_dispatch_bloom_run — the
-                # coalescing plane; runs never cross a verb change, so
-                # frame order is preserved exactly).
-                # Device-sharded frame plan (ISSUE 8): with the slot table
-                # placed over >1 device, the frame's single-device keyed
-                # data commands split into per-device queues dispatched
-                # CONCURRENTLY (one worker per device lane) instead of
-                # serializing through one lane; everything else barriers in
-                # frame order.  plan is None when there is nothing to shard
-                # — the sequential loop below is byte-identical to before.
-                plan = None
-                if (
-                    self.engine.placement is not None
-                    and ctx.multi_queue is None
-                    and ctx.authenticated
-                    and not ctx.asking
-                    and len(commands) > 1
+                if not await self._serve_frame(
+                    ctx, commands, loop, write_q, readback_slots, alive
                 ):
-                    try:
-                        # with the CPU-replica occupancy model armed (bench
-                        # config5d A/B), even a 1-device frame runs the lane
-                        # dispatch path so both legs execute identical code
-                        plan = self.engine.placement.plan_frame(
-                            commands,
-                            single_device_ok=(
-                                ioplane.replica_occupancy() is not None
-                            ),
-                        )
-                    except Exception:  # noqa: BLE001 — planning must never
-                        plan = None    # break a frame; fall back to serial
-                if plan is not None:
-                    results = await self._run_frame_sharded(
-                        ctx, commands, plan, loop
-                    )
-                    if any(isinstance(r, LazyReply) for r in results):
-                        if self.overlap:
-                            await readback_slots.acquire()
-                            if not writer_alive:
-                                break
-                            fut = loop.run_in_executor(
-                                self._pool, _force_lazies, results, self
-                            )
-                            write_q.put_nowait(
-                                _PendingFrame(results, fut, ctx.proto)
-                            )
-                            continue
-                        await loop.run_in_executor(
-                            self._pool, _force_lazies, results, self
-                        )
-                    if results:
-                        write_q.put_nowait(_encode_frame(results, ctx.proto))
-                    continue
-                run_at: Dict[int, int] = {}
-                if len(commands) > 1:
-                    run_at = {
-                        s: e
-                        for s, e in _routing.coalescible_frame_runs(commands)
-                        if all(
-                            isinstance(a, (bytes, bytearray))
-                            for c in commands[s:e]
-                            for a in c
-                        )
-                    }
-                results = []
-                ci = -1
-                for cmd in commands:
-                    ci += 1
-                    if len(results) > ci:
-                        continue  # covered by an already-dispatched run
-                    run_end = run_at.get(ci)
-                    if run_end is not None:
-                        run_cmds = commands[ci:run_end]
-                        self.stats["commands"] += len(run_cmds)
-                        results.extend(
-                            await loop.run_in_executor(
-                                self._pool, self._dispatch_bloom_run_laned,
-                                ctx, run_cmds,
-                            )
-                        )
-                        continue
-                    if not isinstance(cmd, list) or not all(
-                        isinstance(a, (bytes, bytearray)) for a in cmd
-                    ):
-                        results.append(_Encoded(resp.encode_error("ERR bad request frame")))
-                        continue
-                    self.stats["commands"] += 1
-                    # OBJCALL (user methods may park) and blocking verbs go
-                    # to the wide slow pool: a parked handler must never
-                    # starve the small fast pool every connection shares
-                    pool = (
-                        self._slow_pool
-                        if bytes(cmd[0]).upper() in _SLOW_COMMANDS
-                        else self._pool
-                    )
-                    try:
-                        results.append(
-                            await loop.run_in_executor(
-                                pool, self._dispatch_laned, ctx, cmd
-                            )
-                        )
-                    except RespError as e:
-                        self.stats["errors"] += 1
-                        results.append(_Encoded(resp.encode_error(str(e.args[0]))))
-                    except ConnectionResetError:
-                        raise
-                    except RuntimeError as e:
-                        if "shutdown" in str(e):  # worker pool stopped: drop conn
-                            raise ConnectionResetError(str(e)) from e
-                        # any other RuntimeError (uninitialized object, state
-                        # errors) is a per-command failure — reply -ERR, keep
-                        # the connection (dropping it would kill every other
-                        # pipelined command on this socket)
-                        self.stats["errors"] += 1
-                        results.append(
-                            _Encoded(resp.encode_error(f"ERR internal: {type(e).__name__}: {e}"))
-                        )
-                    except Exception as e:  # noqa: BLE001 — sandbox handler bugs per-command
-                        self.stats["errors"] += 1
-                        results.append(
-                            _Encoded(resp.encode_error(f"ERR internal: {type(e).__name__}: {e}"))
-                        )
-                if any(isinstance(r, LazyReply) for r in results):
-                    if self.overlap:
-                        # overlap plane: hand the readback to the writer task
-                        # as a completion-queue entry and go straight back to
-                        # reading — frame N+1's upload/dispatch overlaps this
-                        # frame's D2H.  FIFO queue order preserves the reply
-                        # order; proto is snapshotted at dispatch time.
-                        await readback_slots.acquire()
-                        if not writer_alive:
-                            break  # connection is going down; stop dispatching
-                        fut = loop.run_in_executor(
-                            self._pool, _force_lazies, results, self
-                        )
-                        write_q.put_nowait(_PendingFrame(results, fut, ctx.proto))
-                        continue
-                    await loop.run_in_executor(self._pool, _force_lazies, results, self)
-                if results:
-                    # one queue item per frame — the whole frame's replies
-                    # encode in one pass and write in one syscall batch
-                    write_q.put_nowait(_encode_frame(results, ctx.proto))
+                    break
         except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
             pass
         finally:
@@ -1289,6 +1492,7 @@ class TpuServer:
         if self._replication is not None:
             self._replication.close()
         self._pool.shutdown(wait=False)
+        self._qos_pool.shutdown(wait=False)
         self._slow_pool.shutdown(wait=False)
 
 
@@ -1440,6 +1644,20 @@ def main(argv=None):
         help="data-plane worker threads (the per-connection dispatch pool)",
     )
     ap.add_argument(
+        "--no-qos", action="store_true",
+        help="disable the deadline-aware window scheduler / per-tenant QoS "
+             "plane (server/scheduler.py): frames dispatch in pure arrival "
+             "order with no classification, budgets, or load shedding — the "
+             "reference path for A/B measurement (RTPU_NO_QOS=1 equivalent)",
+    )
+    ap.add_argument(
+        "--dispatch-ahead", type=int, default=None,
+        help="per-connection dispatch-ahead bound: how many frames may sit "
+             "between 'dispatched' and 'replies written' on one connection "
+             "(bounds device memory held by un-drained readbacks; also "
+             "CONFIG SET dispatch-ahead).  Default: 2.",
+    )
+    ap.add_argument(
         "--devices", default=None,
         help="device-sharded serving (ISSUE 8): map the 16384-slot table "
              "onto this many local devices ('all' = every jax.local_device); "
@@ -1473,6 +1691,8 @@ def main(argv=None):
         from redisson_tpu.core import ioplane
 
         ioplane.set_overlap(False)
+    if args.no_qos:
+        _sched.set_qos(False)
     engine = Engine()
     srv = TpuServer(
         engine,
@@ -1483,6 +1703,8 @@ def main(argv=None):
         overlap=not args.no_overlap,
         workers=args.workers,
         devices=args.devices,
+        qos=False if args.no_qos else None,
+        dispatch_ahead=args.dispatch_ahead,
     )
     if args.restore and args.checkpoint:
         from redisson_tpu.core import checkpoint

@@ -176,16 +176,44 @@ def cmd_cluster(server, ctx, args):
         # device-sharded serving state (ISSUE 8), over the wire: per-device
         # slot counts + device labels so tooling (bench config5d, the
         # device-shard soak) can audit the placement without in-process
-        # access.  Reply: [n_devices, [dev_id, slots_owned, label]...];
-        # a server without placement replies [0].
+        # access.  Reply: [n_devices, [dev_id, slots_owned, label,
+        # [QOS, infl_ops_i, infl_ops_b, infl_bytes_i, infl_bytes_b,
+        #  dispatched_i, dispatched_b]]...] — the trailing QOS row is the
+        # lane's per-deadline-class scheduler ledger (ISSUE 10; appended so
+        # pre-QoS consumers indexing row[0..2] keep working).  A server
+        # without placement replies [0].
         p = server.engine.placement
         if p is None:
             return [0]
         counts = p.slot_counts()
-        return [p.n_devices] + [
-            [getattr(d, "id", i), counts[i], str(d).encode()]
-            for i, d in enumerate(p.devices)
-        ]
+        lanes = server.engine.lanes
+        out = [p.n_devices]
+        for i, d in enumerate(p.devices):
+            row = [getattr(d, "id", i), counts[i], str(d).encode()]
+            if lanes is not None:
+                row.append([b"QOS"] + lanes.lane(d).qos.wire_row())
+            out.append(row)
+        return out
+    if sub == b"QOS":
+        # global window-scheduler state (ISSUE 10): armed flag, shed
+        # totals, per-class in-flight, and the per-tenant bucket table.
+        # Reply: [armed, shed_ops, shed_frames,
+        #         [class, infl_frames, infl_ops, infl_bytes]...,
+        #         [b"TENANT", name, bucket_level, admitted, shed_ops,
+        #          shed_frames]...]
+        sched = server.scheduler
+        led = sched.ledger
+        out = [1 if sched.armed else 0, sched.shed_ops, sched.shed_frames]
+        for cls in ("interactive", "bulk"):
+            out.append([
+                cls.encode(), led.frames[cls], led.ops[cls], led.nbytes[cls],
+            ])
+        for name, level, admitted, shed_ops, shed_frames in sched.tenant_table():
+            out.append([
+                b"TENANT", name.encode(), int(level), admitted,
+                shed_ops, shed_frames,
+            ])
+        return out
     if sub == b"DEVMOVE":
         # DEVMOVE <dev_index> [EPOCH <n>] <slot>... — fenced slot -> device
         # handoff inside THIS process (the device-rebalance wire verb: a

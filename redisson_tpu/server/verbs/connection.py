@@ -109,6 +109,8 @@ def cmd_client(server, ctx, args):
         return _client_info_line(server, ctx)
     if sub == b"TRACKING":
         return _client_tracking(server, ctx, args[1:])
+    if sub == b"QOS":
+        return _client_qos(server, ctx, args[1:])
     if sub == b"TRACKINGINFO":
         st = server.tracking.state_of(ctx)
         from redisson_tpu.tracking.table import ConnTracking
@@ -193,6 +195,56 @@ def _client_tracking(server, ctx, args):
         ctx, bcast=bcast, prefixes=prefixes, redirect=redirect, noloop=noloop
     )
     return "+OK"
+
+
+def _client_qos(server, ctx, args):
+    """CLIENT QOS CLASS <interactive|bulk|auto> [TENANT <t>] |
+    CLIENT QOS TENANT <t> | CLIENT QOS GET — the deadline-class/tenant
+    declaration of the QoS plane (ISSUE 10, server/scheduler.py).  CLASS
+    pins this connection's frames to a deadline class (auto restores the
+    size heuristic); TENANT names the token bucket its ops are charged to
+    (default: the frame's key {hashtag}).  GET reports the connection's
+    declared state plus its tenant's live bucket level and shed count."""
+    if not args:
+        raise RespError("ERR wrong number of arguments for 'client|qos'")
+    sub = bytes(args[0]).upper()
+    if sub == b"CLASS":
+        if len(args) < 2:
+            raise RespError("ERR CLIENT QOS CLASS expects a class")
+        cls = _s(args[1]).lower()
+        if cls not in ("interactive", "bulk", "auto"):
+            raise RespError(
+                "ERR CLIENT QOS CLASS expects interactive|bulk|auto"
+            )
+        ctx.qos_class = None if cls == "auto" else cls
+        rest = args[2:]
+        if rest:
+            if len(rest) != 2 or bytes(rest[0]).upper() != b"TENANT":
+                raise RespError("ERR syntax error in CLIENT QOS CLASS")
+            ctx.tenant = _s(rest[1]) or None
+        return "+OK"
+    if sub == b"TENANT":
+        if len(args) != 2:
+            raise RespError("ERR CLIENT QOS TENANT expects a tenant name")
+        ctx.tenant = _s(args[1]) or None
+        return "+OK"
+    if sub == b"GET":
+        sched = server.scheduler
+        tenant = ctx.tenant or "default"
+        level = 0.0
+        sheds = 0
+        for name, lvl, _adm, shed_ops, _sf in sched.tenant_table():
+            if name == tenant:
+                level, sheds = lvl, shed_ops
+                break
+        return {
+            b"class": (ctx.qos_class or "auto").encode(),
+            b"tenant": tenant.encode(),
+            b"armed": 1 if sched.armed else 0,
+            b"bucket-level": int(level),
+            b"shed-ops": sheds,
+        }
+    raise RespError(f"ERR unknown CLIENT QOS subcommand '{_s(args[0])}'")
 
 
 @register("QUIT")

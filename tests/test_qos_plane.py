@@ -1,0 +1,603 @@
+"""Tail-latency plane (ISSUE 10): deadline-aware window scheduling +
+per-tenant QoS with load shedding.
+
+Contracts pinned here:
+  * class-aware admission never reorders replies within a connection (the
+    FIFO + proto-snapshot contract, 3 frames in flight — mirroring
+    test_overlap_plane's ordering property);
+  * a shed decision never leaves a partially-applied coalesced add run
+    (shed commands never dispatch; runs never span a shed boundary);
+  * bit-identical results with the scheduler disarmed (RTPU_NO_QOS
+  * discipline), on both the server wire and the embedded path;
+  * sheds only ever hit the over-budget tenant;
+  * the QoS ledgers (global + per-lane) drain to zero at quiesce.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.core import coalesce, ioplane
+from redisson_tpu.server import scheduler as sched_mod
+from redisson_tpu.server.scheduler import (
+    Admission, TokenBucket, WindowScheduler, estimate_device_items,
+    tenant_of_frame,
+)
+
+
+class _Ctx:
+    qos_class = None
+    tenant = None
+
+
+# -- unit: token bucket, classifier, tenant, shed masks ------------------------
+
+
+def test_token_bucket_spend_refill_and_unlimited():
+    b = TokenBucket(rate=100.0, burst=200.0)
+    assert b.take(150, now=0.0)
+    assert not b.take(100, now=0.0)  # only 50 left; refused take spends 0
+    assert b.take(50, now=0.0)
+    assert not b.take(1, now=0.0)
+    assert b.take(100, now=1.0)  # 1s refill at 100/s
+    assert TokenBucket(rate=0.0).take(10**9, now=0.0)  # unlimited
+    lvl = TokenBucket(rate=50.0, burst=75.0).level(now=123.0)
+    assert lvl == 75.0  # untouched bucket reports full burst
+
+
+def test_classifier_heuristic_and_declared_class():
+    ws = WindowScheduler(enabled=True, interactive_max_items=256)
+    small = [[b"GET", b"k"], [b"SET", b"k", b"v"]]
+    big_blob = [[b"BF.MADD64", b"f", b"x" * 8 * 1000]]
+    ctx = _Ctx()
+    assert ws.classify(ctx, small)[0] == "interactive"
+    assert ws.classify(ctx, big_blob)[0] == "bulk"
+    ctx.qos_class = "bulk"
+    assert ws.classify(ctx, small)[0] == "bulk"
+    ctx.qos_class = "interactive"
+    assert ws.classify(ctx, big_blob)[0] == "interactive"
+    # sizing rule shared with the lane occupancy unit
+    assert estimate_device_items(big_blob) == 1000
+    assert estimate_device_items(small) == 2
+
+
+def test_tenant_of_frame_hashtag_and_declared():
+    ctx = _Ctx()
+    assert tenant_of_frame(ctx, [[b"GET", b"plain"]]) == "default"
+    assert tenant_of_frame(ctx, [[b"GET", b"bf{t42}"]]) == "t42"
+    # first KEYED command decides; keyless preludes are skipped
+    assert tenant_of_frame(ctx, [[b"PING"], [b"GET", b"x{ten}"]]) == "ten"
+    ctx.tenant = "declared"
+    assert tenant_of_frame(ctx, [[b"GET", b"bf{t42}"]]) == "declared"
+
+
+def test_admission_sheds_suffix_and_charges_nothing_for_shed():
+    ws = WindowScheduler(enabled=True, tenant_rate=100.0, tenant_burst=250.0)
+    ctx = _Ctx()
+    blob = b"x" * 8 * 100  # 100 items per command
+    frame = [[b"BF.MADD64", b"a{t}", blob]] * 4
+    adm = ws.admit(ctx, frame, now=0.0)
+    # 250 tokens cover two 100-item commands; the rest sheds as a SUFFIX
+    assert adm.shed_mask == [False, False, True, True]
+    assert adm.items == 200 and adm.shed_count == 2
+    assert ws.tenant_sheds()["t"] == 200
+    # refill admits again
+    adm2 = ws.admit(ctx, frame[:1], now=1.0)
+    assert adm2.shed_mask is None
+
+
+def test_runs_never_cross_a_shed_boundary():
+    runs = [(0, 4), (6, 9)]
+    # no mask: unchanged
+    assert coalesce.runs_within_admission(runs, None) == runs
+    mask = [False, False, True, False, False, False, False, False, True]
+    # (0,4) splits at index 2 -> only (0,2) survives (singleton [3,4) drops);
+    # (6,9) cuts to (6,8)
+    assert coalesce.runs_within_admission(runs, mask) == [(0, 2), (6, 8)]
+    # fully shed run vanishes
+    assert coalesce.runs_within_admission([(0, 3)], [True] * 3) == []
+
+
+# -- ioplane: deadline-triggered window close ----------------------------------
+
+
+def _window(v):
+    import jax.numpy as jnp
+
+    arr = jnp.arange(4, dtype=jnp.int32) + v
+
+    def fn():
+        return (arr,), (lambda host: int(host[0][0]))
+
+    return fn
+
+
+def test_flush_pipeline_interactive_closes_window_immediately():
+    pipe = ioplane.FlushPipeline(overlap=True, depth=4)
+    bulk = pipe.submit(_window(10))
+    assert not bulk.done()  # bulk windows stay lazily parked
+    inter = pipe.submit(_window(20), interactive=True)
+    assert inter.done(), "interactive window must close at submit"
+    assert inter.result() == 20
+    assert not bulk.done()  # the interactive close never forces bulk peers
+    pipe.drain()
+    assert bulk.result() == 10
+
+
+def test_flush_pipeline_deadline_forces_stale_windows():
+    pipe = ioplane.FlushPipeline(overlap=True, depth=8, deadline_s=0.03)
+    old = pipe.submit(_window(1))
+    assert not old.done()
+    time.sleep(0.05)
+    pipe.submit(_window(2))  # next submit closes the expired window
+    assert old.done() and old.result() == 1
+    assert pipe.pending() == 1
+    pipe.drain()
+
+
+def test_interactive_deadline_config_arms_pipelines(qos_server):
+    """CONFIG SET qos-interactive-deadline-ms is a REAL knob: it arms the
+    process-global FlushPipeline deadline default (pipelines built after
+    the set inherit it) and updates live lane pipelines; 0 disarms."""
+    st = qos_server
+    c = _conn(st)
+    prev = ioplane.window_deadline()
+    try:
+        assert c.execute(
+            "CONFIG", "SET", "qos-interactive-deadline-ms", "40"
+        ) == b"OK"
+        assert ioplane.window_deadline() == pytest.approx(0.04)
+        pipe = ioplane.FlushPipeline(overlap=True, depth=8)
+        assert pipe.deadline_s == pytest.approx(0.04)
+        old = pipe.submit(_window(9))
+        time.sleep(0.06)
+        pipe.submit(_window(10))
+        assert old.done()  # the armed deadline closed the stale window
+        pipe.drain()
+        assert c.execute(
+            "CONFIG", "SET", "qos-interactive-deadline-ms", "0"
+        ) == b"OK"
+        assert ioplane.window_deadline() is None
+        assert ioplane.FlushPipeline(overlap=True).deadline_s is None
+    finally:
+        ioplane.set_window_deadline(prev)
+        c.close()
+
+
+def test_flush_pipeline_serial_shape_unchanged():
+    pipe = ioplane.FlushPipeline(overlap=False, depth=2, deadline_s=0.01)
+    fut = pipe.submit(_window(5), interactive=True)
+    assert fut.done() and fut.result() == 5
+
+
+def test_lane_qos_ledger_accounts_and_drains(devices):
+    laneset = ioplane.LaneSet(devices[:2])
+    lane = laneset.lane(devices[0])
+    with lane.occupy(7, qos_class="bulk", nbytes=100):
+        c = laneset.census()
+        assert c["lane0_qos_bulk_inflight_ops"] == 7
+        assert c["lane0_qos_bulk_inflight_bytes"] == 100
+        assert c["lane0_qos_bulk_inflight_frames"] == 1
+    c = laneset.census()
+    assert c["lane0_qos_bulk_inflight_ops"] == 0
+    assert c["lane0_qos_bulk_inflight_frames"] == 0
+    assert lane.qos.wire_row()[4:] == [0, 7]  # dispatched: 0 interactive, 7 bulk
+
+
+# -- wire: verbs, knobs, shedding ----------------------------------------------
+
+
+@pytest.fixture()
+def qos_server():
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0, workers=4) as st:
+        yield st
+
+
+def _conn(st, **kw):
+    from redisson_tpu.net.client import Connection
+
+    return Connection(st.server.host, st.server.port, timeout=30.0, **kw)
+
+
+def test_client_qos_verb_and_config_knobs(qos_server):
+    from redisson_tpu.net.resp import RespError
+
+    st = qos_server
+    c = _conn(st)
+    try:
+        assert c.execute("CLIENT", "QOS", "CLASS", "bulk", "TENANT", "acme") == b"OK"
+        got = c.execute("CLIENT", "QOS", "GET")
+        assert got[b"class"] == b"bulk" and got[b"tenant"] == b"acme"
+        assert got[b"armed"] == 1
+        assert c.execute("CLIENT", "QOS", "CLASS", "auto") == b"OK"
+        assert c.execute("CLIENT", "QOS", "GET")[b"class"] == b"auto"
+        bad = c.execute("CLIENT", "QOS", "CLASS", "warp")
+        assert isinstance(bad, RespError)
+        # CONFIG surface
+        view = dict(zip(*[iter(c.execute("CONFIG", "GET", "qos-*"))] * 2))
+        assert view[b"qos-enabled"] == b"1"
+        assert c.execute("CONFIG", "SET", "qos-interactive-max-items", "64") == b"OK"
+        assert st.server.scheduler.interactive_max_items == 64
+        assert c.execute("CONFIG", "SET", "qos-bulk-slots", "2") == b"OK"
+        assert st.server.scheduler.bulk_slots == 2
+        # qos-bulk-slots 0 = re-derive from workers, NEVER "unlimited"
+        assert c.execute("CONFIG", "SET", "qos-bulk-slots", "0") == b"OK"
+        assert st.server.scheduler.bulk_slots == 3  # workers(4) - 1
+        # dispatch-ahead satellite: CONFIG-settable, >0 enforced
+        assert c.execute("CONFIG", "SET", "dispatch-ahead", "5") == b"OK"
+        assert st.server.readback_ahead == 5
+        assert isinstance(
+            c.execute("CONFIG", "SET", "dispatch-ahead", "0"), RespError
+        )
+        got = dict(zip(*[iter(c.execute("CONFIG", "GET", "dispatch-ahead"))] * 2))
+        assert got[b"dispatch-ahead"] == b"5"
+    finally:
+        c.close()
+
+
+def test_dispatch_ahead_cli_flag():
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0, dispatch_ahead=7) as st:
+        assert st.server.readback_ahead == 7
+
+
+def test_shed_hits_only_the_over_budget_tenant(qos_server):
+    from redisson_tpu.net.resp import RespError
+
+    st = qos_server
+    c = _conn(st)
+    try:
+        assert c.execute("CONFIG", "SET", "qos-tenant-rate", "100") == b"OK"
+        assert c.execute("CONFIG", "SET", "qos-tenant-burst", "300") == b"OK"
+        c.execute("BF.RESERVE", "sh{hog}", 0.01, 10_000)
+        c.execute("BF.RESERVE", "sh{vip}", 0.01, 10_000)
+        hog_blob = np.arange(200, dtype="<i8").tobytes()  # 200 items/cmd
+        out = c.execute_many([("BF.MADD64", "sh{hog}", hog_blob)] * 4)
+        kinds = [isinstance(r, RespError) for r in out]
+        assert kinds == [False, True, True, True], out
+        assert str(out[1]).startswith("BUSY")
+        # the OTHER tenant's small traffic is untouched
+        vip_blob = np.arange(32, dtype="<i8").tobytes()
+        vip = c.execute_many([("BF.MADD64", "sh{vip}", vip_blob)] * 2)
+        assert not any(isinstance(r, RespError) for r in vip), vip
+        sheds = st.server.scheduler.tenant_sheds()
+        assert sheds["hog"] > 0
+        assert sheds.get("vip", 0) == 0
+        assert st.server.stats["sheds"] == 3
+        # CLUSTER QOS exposes the tenant table
+        q = c.execute("CLUSTER", "QOS")
+        tenants = {
+            bytes(row[1]): row for row in q[3:] if bytes(row[0]) == b"TENANT"
+        }
+        assert tenants[b"hog"][4] > 0  # shed_ops
+        assert tenants.get(b"vip", [0] * 6)[4] == 0
+    finally:
+        c.close()
+
+
+def test_shed_never_leaves_partial_coalesced_add_run(qos_server):
+    """A frame whose BF.MADD64 run crosses the budget boundary: the admitted
+    prefix applies EXACTLY once, the shed suffix NEVER dispatches (its keys
+    stay absent), and no run spans the boundary (at-most-once: a shed can
+    never create a partially-applied fused add run)."""
+    from redisson_tpu.net.resp import RespError
+
+    st = qos_server
+    c = _conn(st)
+    try:
+        for name in ("ru{t1}", "rv{t1}", "rw{t1}"):
+            c.execute("BF.RESERVE", name, 0.01, 10_000)
+        assert c.execute("CONFIG", "SET", "qos-tenant-rate", "10") == b"OK"
+        assert c.execute("CONFIG", "SET", "qos-tenant-burst", "150") == b"OK"
+        blobs = {
+            "ru{t1}": np.arange(100, 200, dtype="<i8").tobytes(),
+            "rv{t1}": np.arange(300, 400, dtype="<i8").tobytes(),
+            "rw{t1}": np.arange(500, 600, dtype="<i8").tobytes(),
+        }
+        # a 3-command same-verb run, 100 items each, 150-token budget:
+        # command 0 admitted, commands 1-2 shed
+        out = c.execute_many(
+            [("BF.MADD64", n, b) for n, b in blobs.items()]
+        )
+        assert not isinstance(out[0], RespError)
+        assert np.frombuffer(out[0], np.uint8).all()  # all newly added, once
+        assert isinstance(out[1], RespError) and str(out[1]).startswith("BUSY")
+        assert isinstance(out[2], RespError)
+        # lift the budget, then audit state: admitted applied, shed absent
+        assert c.execute("CONFIG", "SET", "qos-tenant-rate", "0") == b"OK"
+        present = c.execute("BF.MEXISTS64", "ru{t1}", blobs["ru{t1}"])
+        assert np.frombuffer(present, np.uint8).all()
+        for name in ("rv{t1}", "rw{t1}"):
+            absent = c.execute("BF.MEXISTS64", name, blobs[name])
+            assert not np.frombuffer(absent, np.uint8).any(), (
+                f"shed command partially applied on {name}"
+            )
+    finally:
+        c.close()
+
+
+def test_fifo_preserved_with_qos_armed_and_sheds_inline():
+    """Mirror of test_overlap_plane's ordering property with the scheduler
+    ARMED and budgets binding: 8 clients, 3 frames in flight each, mixed
+    readback + ack verbs; every reply arrives in submission order and a
+    shed only ever appears as a -BUSY suffix of its own frame."""
+    from redisson_tpu.net.client import Connection
+    from redisson_tpu.net.resp import RespError
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0, workers=4) as st:
+        assert st.server.scheduler.armed
+        host, port = st.server.host, st.server.port
+        admin = Connection(host, port, timeout=30.0)
+        admin.execute("CONFIG", "SET", "qos-tenant-rate", "30000")
+        admin.execute("CONFIG", "SET", "qos-tenant-burst", "4000")
+        admin.execute("CONFIG", "SET", "qos-shed-penalty-ms", "0")
+        admin.close()
+        errors = []
+
+        def worker(wid: int):
+            try:
+                conn = Connection(host, port, timeout=60.0)
+                try:
+                    name = f"qf{{w{wid}}}"
+                    r = conn.execute("BF.RESERVE", name, 0.01, 50_000,
+                                     timeout=30.0)
+                    assert r in (b"OK", "OK"), r
+                    inflight = []
+
+                    def check(item):
+                        tags, handle = item
+                        r = handle.get(timeout=60.0)
+                        assert len(r) == 5
+                        # shed is a SUFFIX of the frame: once BUSY, all BUSY
+                        busy = [isinstance(x, RespError) for x in r]
+                        first = busy.index(True) if any(busy) else len(r)
+                        assert all(busy[first:]), (wid, r)
+                        # every non-shed reply is in submission order
+                        if first > 0:
+                            assert r[0] == tags[0]
+                        if first > 2:
+                            assert r[2] == tags[1]
+                        if first > 3:
+                            assert np.frombuffer(r[3], np.uint8).all()
+                        if first > 4:
+                            assert r[4] == tags[2]
+
+                    for f in range(10):
+                        keys = (
+                            np.arange(600, dtype=np.int64)
+                            + wid * 1_000_000 + f * 1000
+                        ) * 2654435761
+                        blob = np.ascontiguousarray(keys, "<i8").tobytes()
+                        tags = [f"w{wid}f{f}c{i}".encode() for i in range(3)]
+                        cmds = [
+                            ("ECHO", tags[0]),
+                            ("BF.MADD64", name, blob),
+                            ("ECHO", tags[1]),
+                            ("BF.MEXISTS64", name, blob),
+                            ("ECHO", tags[2]),
+                        ]
+                        inflight.append((tags, conn.execute_many_lazy(cmds)))
+                        if len(inflight) > 3:  # 3 frames in flight
+                            check(inflight.pop(0))
+                    for item in inflight:
+                        check(item)
+                finally:
+                    conn.close()
+            except Exception as e:  # noqa: BLE001 — surfaced on main thread
+                errors.append((wid, repr(e)))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert st.server.stats["sheds"] > 0, (
+            "budgets never bound — the property ran without any shed"
+        )
+
+
+# -- bit-identity with the scheduler disarmed ----------------------------------
+
+
+def _mixed_wire_replies(qos_on: bool):
+    from redisson_tpu.net.client import Connection
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0, qos=qos_on) as st:
+        conn = Connection(st.server.host, st.server.port, timeout=60.0)
+        try:
+            rng = np.random.default_rng(77)
+            keys = rng.integers(0, 1 << 60, 256).astype(np.int64)
+            blob = np.ascontiguousarray(keys, "<i8").tobytes()
+            t32 = np.ascontiguousarray(
+                np.arange(256, dtype=np.int32) % 8, "<i4"
+            ).tobytes()
+            idx = np.ascontiguousarray(
+                rng.integers(0, 4000, 128).astype(np.int32), "<i4"
+            ).tobytes()
+            cmds = []
+            cmds += [("BF.RESERVE", f"id:bf{i}", 0.01, 10_000) for i in range(4)]
+            cmds += [("BF.MADD64", f"id:bf{i}", blob) for i in range(4)]
+            cmds += [("BF.MEXISTS64", f"id:bf{i}", blob) for i in range(4)]
+            cmds += [
+                ("BFA.RESERVE", "id:bank", 8, 1000, 0.01),
+                ("BFA.MADD64", "id:bank", t32, blob),
+                ("BFA.MEXISTS64", "id:bank", t32, blob),
+                ("PFADD64", "id:hll", blob), ("PFCOUNT", "id:hll"),
+                ("SETBITSB", "id:bits", idx), ("GETBITSB", "id:bits", idx),
+                ("PING",), ("ECHO", b"tail"),
+            ]
+            out = []
+            for i in range(0, len(cmds), 6):  # several pipelined frames
+                out.extend(conn.execute_many(cmds[i : i + 6], timeout=60.0))
+            return out
+        finally:
+            conn.close()
+
+
+def test_server_replies_bit_identical_with_qos_disarmed():
+    a = _mixed_wire_replies(qos_on=True)
+    b = _mixed_wire_replies(qos_on=False)
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert x == y, f"reply {i} diverged between QoS armed and disarmed"
+
+
+def test_embedded_batch_bit_identical_with_qos_disarmed():
+    import redisson_tpu
+
+    def run(qos_on: bool):
+        prev = sched_mod.set_qos(qos_on)
+        try:
+            c = redisson_tpu.create()
+            try:
+                rng = np.random.default_rng(5)
+                for i in range(3):
+                    assert c.get_bloom_filter(f"eq:bf{i}").try_init(5000, 0.01)
+                keysets = [
+                    rng.integers(0, 1 << 60, 100 + 20 * i).astype(np.int64)
+                    for i in range(3)
+                ]
+                b = c.create_batch()
+                for i in range(3):
+                    b.get_bloom_filter(f"eq:bf{i}").add_async(keysets[i])
+                for i in range(3):
+                    b.get_bloom_filter(f"eq:bf{i}").contains_async(keysets[i])
+                b.get_atomic_long("eq:ctr").add_and_get_async(3)
+                res = b.execute()
+                return [
+                    np.asarray(r).tolist() if isinstance(r, np.ndarray) else r
+                    for r in res.responses
+                ]
+            finally:
+                c.shutdown()
+        finally:
+            sched_mod.set_qos(prev)
+
+    assert run(True) == run(False)
+
+
+def test_rtpu_no_qos_env_disarms_subprocess():
+    import json
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import json\n"
+        "from redisson_tpu.server import scheduler\n"
+        "from redisson_tpu.server.server import TpuServer\n"
+        "srv = TpuServer()\n"
+        "print(json.dumps({'module': scheduler.qos_enabled(),"
+        " 'armed': srv.scheduler.armed}))\n"
+        "srv.stop()\n"
+    )
+    env = dict(os.environ, RTPU_NO_QOS="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    got = json.loads(out.stdout.strip().splitlines()[-1])
+    assert got == {"module": False, "armed": False}
+
+
+# -- observability: census + gate wiring ---------------------------------------
+
+
+def test_scheduler_census_tracked_and_drains(qos_server):
+    from redisson_tpu.chaos.census import ResourceCensus
+
+    st = qos_server
+    census = ResourceCensus()
+    census.track_server("srv", st.server)
+    snap = census.snapshot()
+    assert "srv.qos_interactive_inflight_ops" in snap
+    assert "srv.qos_bulk_waiting" in snap
+    adm = Admission("bulk", "t", 9, 50)
+    st.server.scheduler.begin(adm)
+    mid = census.snapshot()
+    assert mid["srv.qos_bulk_inflight_ops"] == 9
+    assert mid["srv.qos_bulk_inflight_bytes"] == 50
+    st.server.scheduler.end(adm)
+    after = census.snapshot()
+    assert after["srv.qos_bulk_inflight_ops"] == 0
+    census.assert_flat(
+        snap, after, ignore=("*.connections",), context="qos ledger",
+    )
+    # metrics registry gauges exist too (MetricsRegistry satellite)
+    mets = st.server.metrics.snapshot()
+    assert "qos_shed_ops" in mets and "qos_bulk_waiting" in mets
+
+
+def test_perf_gate_qos_rows():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "perf_gate.py"),
+    )
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    def doc(p99, ratio, speedup):
+        return {
+            "metric": "x", "value": 1000.0,
+            "details": {
+                "config2q_interactive_p99_ms": p99,
+                "config2q_fairness_p99_ratio": ratio,
+                "config2q_interactive_speedup_vs_noqos": speedup,
+            },
+        }
+
+    base = doc(20.0, 1.1, 2.0)
+    # healthy fresh run passes
+    rows, ok = pg.compare(base, doc(19.0, 1.1, 2.1), 0.05)
+    assert ok, rows
+    # fairness ceiling binds absolutely (even vs an n/a baseline)
+    rows, ok = pg.compare({"metric": "x", "value": 1000.0}, doc(19.0, 2.4, 2.1), 0.05)
+    assert not ok
+    assert any("fairness" in r[0] and r[4] == "FAIL" for r in rows)
+    # speedup floor binds absolutely
+    rows, ok = pg.compare(base, doc(19.0, 1.2, 1.05), 0.05)
+    assert not ok
+    # relative p99 regression gates
+    rows, ok = pg.compare(base, doc(40.0, 1.2, 2.1), 0.05)
+    assert not ok
+
+
+def test_cluster_qos_and_devices_wire():
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0, devices="all", workers=4) as st:
+        c = _conn(st)
+        try:
+            q = c.execute("CLUSTER", "QOS")
+            assert q[0] == 1  # armed
+            classes = {bytes(row[0]) for row in q[3:5]}
+            assert classes == {b"interactive", b"bulk"}
+            d = c.execute("CLUSTER", "DEVICES")
+            assert int(d[0]) == 8
+            for row in d[1:]:
+                assert bytes(row[3][0]) == b"QOS"
+                assert len(row[3]) == 7
+        finally:
+            c.close()
+
+
+@pytest.mark.slow
+def test_qos_soak_profile():
+    from redisson_tpu.chaos.soak import QosSoakConfig, QosSoakHarness
+
+    report = QosSoakHarness(QosSoakConfig(cycles=1, seed=3)).run()
+    assert report.sheds_hog > 0 and report.sheds_other == 0
+    assert report.writes_acked > 0 and report.reads > 0

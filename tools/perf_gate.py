@@ -69,6 +69,16 @@ METRICS = [
     # on, every round, not merely "no worse than last round".
     ("config6 server-op reduction", ("details", "config6_server_op_reduction"), True, True),
     ("config6 tracked read ops/s", ("details", "config6_tracked_read_ops_per_sec"), True, False),
+    # config2q (ISSUE 10): interactive tail latency under the hostile
+    # mixed-tenant flood with the QoS scheduler armed, and the p99 fairness
+    # ratio between equal-budget tenants.  Both gated relative to baseline
+    # (n/a-pass on first sight) AND bound absolutely from first sight: the
+    # fairness ratio by a 2x CEILING, the armed-vs-disarmed speedup by a
+    # 1.2x floor (the scheduler must land interactive p99 materially below
+    # the disarmed baseline on the same container, every round).
+    ("config2q interactive p99 ms", ("details", "config2q_interactive_p99_ms"), False, True),
+    ("config2q fairness p99 ratio", ("details", "config2q_fairness_p99_ratio"), False, True),
+    ("config2q speedup vs no-qos", ("details", "config2q_interactive_speedup_vs_noqos"), True, False),
 ]
 
 # (label, extractor-path, minimum) — ABSOLUTE floors checked on the FRESH
@@ -77,6 +87,15 @@ METRICS = [
 FLOORS = [
     ("config6 server-op reduction >= 10x",
      ("details", "config6_server_op_reduction"), 10.0),
+    ("config2q speedup vs no-qos >= 1.2x",
+     ("details", "config2q_interactive_speedup_vs_noqos"), 1.2),
+]
+
+# (label, extractor-path, maximum) — ABSOLUTE ceilings, same first-sight
+# discipline as FLOORS but bounding from above (lower is better).
+CEILINGS = [
+    ("config2q fairness p99 ratio <= 2x",
+     ("details", "config2q_fairness_p99_ratio"), 2.0),
 ]
 
 
@@ -160,6 +179,15 @@ def compare(baseline: dict, fresh: dict, threshold: float) -> Tuple[list, bool]:
         rows.append((label, floor, f, None, "OK" if passed else "FAIL"))
         if not passed:
             ok = False
+    for label, path, ceiling in CEILINGS:
+        f = _extract(fresh, path)
+        if f is None:
+            rows.append((label, ceiling, f, None, "n/a"))
+            continue
+        passed = f <= ceiling
+        rows.append((label, ceiling, f, None, "OK" if passed else "FAIL"))
+        if not passed:
+            ok = False
     return rows, ok
 
 
@@ -177,10 +205,12 @@ def render(rows, threshold: float) -> str:
     out.append(
         f"gate: >{threshold:.0%} regression in headline, config5, config5p, "
         "config5d (ops/s AND 1-vs-N speedup), config2 flush p99, config4 "
-        "cold, or config6 reduction fails; other drops are advisory (WARN); "
-        "a metric absent from the baseline reads n/a and passes (recorded "
-        "on first sight).  Absolute floors (config6 server-op reduction "
-        ">= 10x) bind from first sight."
+        "cold, config6 reduction, config2q interactive p99, or config2q "
+        "fairness fails; other drops are advisory (WARN); a metric absent "
+        "from the baseline reads n/a and passes (recorded on first sight).  "
+        "Absolute floors (config6 reduction >= 10x, config2q speedup vs "
+        "no-qos >= 1.2x) and ceilings (config2q fairness <= 2x) bind from "
+        "first sight."
     )
     return "\n".join(out)
 

@@ -30,6 +30,13 @@
     tracked reads (a device move must be invisible to the tracking plane),
     near-cache convergence after quiesce, per-device lane census flat, and
     zero host-side cross-device gathers (IOStats.host_colocations == 0).
+  * ``qos`` — the tail-latency/QoS profile (ISSUE 10): an abusive bulk
+    tenant floods one master with big blob pipelines while interactive
+    tenants keep reading/writing small keys, under transport faults, while
+    interactive-key slots migrate m0 -> m1 -> m0.  Asserts zero
+    acked-write loss, bounded interactive p99 (no starvation), sheds
+    landing ONLY on the over-budget tenant, and flat QoS ledgers at
+    quiesce.
   * ``tracking`` — the near-cache coherence profile (ISSUE 7): zipf
     readers with server-assisted near caches (CLIENT TRACKING) keep
     reading while key-bearing slots migrate m0 -> m1 -> m0 and the
@@ -63,7 +70,7 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--profile",
                     choices=("standard", "migration", "cluster-proc",
-                             "tracking", "device-shard"),
+                             "tracking", "device-shard", "qos"),
                     default="standard")
     ap.add_argument("--cycles", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
@@ -77,7 +84,13 @@ def main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
-    if args.profile == "device-shard":
+    if args.profile == "qos":
+        from redisson_tpu.chaos.soak import QosSoakConfig, QosSoakHarness
+
+        harness = QosSoakHarness(QosSoakConfig(
+            cycles=args.cycles, seed=args.seed,
+        ))
+    elif args.profile == "device-shard":
         from redisson_tpu.chaos.soak import (
             DeviceShardSoakConfig, DeviceShardSoakHarness,
         )
