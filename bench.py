@@ -1136,6 +1136,105 @@ def bench_config2q_qos():
     }
 
 
+def bench_config7_vector():
+    """Config 7: device-accelerated vector search (ISSUE 11) — FLAT KNN as
+    one jitted matmul-top-k per stacked query batch over a device-resident
+    embedding bank, with the ROADMAP's quality axis next to ops/s:
+
+      * ``config7_knn_qps`` — single KNN queries/s at the largest (N, d, k)
+        point, queries stacked 64 per dispatch (the FT.MSEARCH wire shape);
+        gated relative (n/a-pass on first sight).
+      * ``config7_recall_at_10`` — recall@10 of the device f32 scoring
+        against a NumPy float64 brute-force oracle, minimum across points;
+        FLAT scoring is exact, so only f32-vs-f64 near-ties can cost recall
+        — the gate binds an absolute >= 0.99 floor from first sight.
+
+    Embedded (no wire): the kernel plane is the thing measured — wire
+    framing and dispatch contention have their own configs (5*/2q)."""
+    from redisson_tpu.core.engine import Engine
+    from redisson_tpu.services.search import SearchService
+    from redisson_tpu.services import vector as V
+
+    assert V.vector_enabled(), "config7 measures the ARMED device path"
+    points = [
+        (20_000, 64, 10),
+        (50_000, 128, 10),
+    ]
+    Q_BATCH = 64
+    N_ORACLE = 64
+    MEASURE_S = 2.0
+    engine = Engine()
+    svc = SearchService(engine)
+    rng = np.random.default_rng(71)
+    out_points = []
+    for N, d, k in points:
+        name = f"v7_{N}_{d}"
+        svc.create_index(
+            name, {"emb": "VECTOR"},
+            vector={"emb": {"dim": d, "metric": "COSINE"}},
+        )
+        vecs = rng.standard_normal((N, d)).astype(np.float32)
+        t0 = time.perf_counter()
+        for i in range(N):
+            svc.add_document(name, f"d{i}", {"emb": vecs[i]})
+        ingest_s = time.perf_counter() - t0
+        idx = svc._idx(name)
+        bank = idx.vectors.banks["emb"]
+        # warm the (cap, Q_BATCH, k) program outside the timed window
+        warm_q = rng.standard_normal((Q_BATCH, d)).astype(np.float32)
+        dev, fin = svc.knn(name, "emb", warm_q, k)
+        fin(tuple(np.asarray(v) for v in dev))
+        # timed: stacked batches, one dispatch + one readback per batch
+        queries = rng.standard_normal((Q_BATCH, d)).astype(np.float32)
+        done = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < MEASURE_S:
+            dev, fin = svc.knn(name, "emb", queries, k)
+            fin(tuple(np.asarray(v) for v in dev))
+            done += Q_BATCH
+        qps = done / (time.perf_counter() - t0)
+        # recall@10 vs the float64 brute-force oracle (ties only can differ)
+        oracle_q = rng.standard_normal((N_ORACLE, d)).astype(np.float32)
+        dev, fin = svc.knn(name, "emb", oracle_q, 10)
+        got = fin(tuple(np.asarray(v) for v in dev))
+        q64 = oracle_q.astype(np.float64)
+        v64 = vecs.astype(np.float64)
+        dots = q64 @ v64.T
+        denom = (
+            np.linalg.norm(q64, axis=1)[:, None]
+            * np.linalg.norm(v64, axis=1)[None, :]
+        )
+        dist64 = 1.0 - np.where(denom > 0, dots / denom, 0.0)
+        hits = 0
+        for qi in range(N_ORACLE):
+            truth = set(np.argsort(dist64[qi], kind="stable")[:10].tolist())
+            mine = {int(doc[1:]) for doc, _s in got[qi][:10]}
+            hits += len(truth & mine)
+        recall = hits / (10 * N_ORACLE)
+        log(
+            f"config7: N={N} d={d} k={k} — {qps/1e3:.1f}k knn qps "
+            f"(batch {Q_BATCH}), recall@10 {recall:.4f}, ingest "
+            f"{N/ingest_s/1e3:.0f}k docs/s, bank "
+            f"{bank.device_bytes()/1e6:.1f}MB, {bank.h2d_flushes} H2D "
+            f"flushes for {N} docs"
+        )
+        out_points.append({
+            "n": N, "dim": d, "k": k,
+            "knn_qps": round(qps),
+            "recall_at_10": round(recall, 4),
+            "ingest_docs_per_sec": round(N / ingest_s),
+            "bank_device_bytes": bank.device_bytes(),
+            "h2d_flushes": bank.h2d_flushes,
+        })
+        svc.drop_index(name)
+    return {
+        "config7_knn_qps": out_points[-1]["knn_qps"],
+        "config7_recall_at_10": min(p["recall_at_10"] for p in out_points),
+        "q_batch": Q_BATCH,
+        "points": out_points,
+    }
+
+
 def _init_jax():
     """Per-process JAX setup: persistent compile cache (the big kernels cost
     ~10s of XLA compile each; cached programs make re-runs near-instant)."""
@@ -1244,6 +1343,8 @@ def child(which: str) -> None:
         # host-side dispatch contention is the thing measured, so the CPU
         # backend is fine and the config needs no chip warm-up
         result["qos"] = bench_config2q_qos()
+    elif which == "7":
+        result["vector"] = bench_config7_vector()
     else:
         client = redisson_tpu.create()
         try:
@@ -1282,7 +1383,7 @@ def main():
     import subprocess
 
     results: dict = {}
-    for which in ("2", "2L", "2A", "2q", "1", "3", "4", "5", "5p", "5d", "6"):
+    for which in ("2", "2L", "2A", "2q", "1", "3", "4", "5", "5p", "5d", "6", "7"):
         p = subprocess.run(
             [sys.executable, __file__, "--config", which],
             stdout=subprocess.PIPE,
@@ -1327,6 +1428,9 @@ def main():
                     "config2q_fairness_p99_ratio": results["2q"]["qos"]["config2q_fairness_p99_ratio"],
                     "config2q_interactive_speedup_vs_noqos": results["2q"]["qos"]["config2q_interactive_speedup_vs_noqos"],
                     "config2q_qos": results["2q"]["qos"],
+                    "config7_knn_qps": results["7"]["vector"]["config7_knn_qps"],
+                    "config7_recall_at_10": results["7"]["vector"]["config7_recall_at_10"],
+                    "config7_vector": results["7"]["vector"],
                     "baseline_model": "k=7 GETBITs @ 1M pipelined ops/s/core = 143k contains/s",
                     "tunnel_h2d_mb_per_sec": {
                         w: r["h2d_mb_s"] for w, r in results.items() if "h2d_mb_s" in r

@@ -102,6 +102,15 @@ class ResourceCensus:
             if sched is not None:
                 for k, v in sched.census().items():
                     out[k] = v
+            # embedding-bank residency (ISSUE 11): bank count + device
+            # bytes must return to baseline once FT.DROPINDEX tears an
+            # index down — the vector soak's flat-census assertion
+            out["ftvec_banks"] = 0.0
+            out["ftvec_device_bytes"] = 0.0
+            ftvec = getattr(server, "_ftvec_census", None)
+            if ftvec is not None:
+                for k, v in ftvec().items():
+                    out[k] = v
             return out
 
         self.track(name, probe)

@@ -2430,3 +2430,404 @@ class QosSoakHarness:
             return self.report
         finally:
             self._teardown()
+
+
+# -- vector-search soak (ISSUE 11): KNN readers vs concurrent ingest ----------
+
+
+@dataclass
+class VectorSoakConfig:
+    """KNN readers with tracked near-cached query results + concurrent HSET
+    ingest against ONE device-sharded server while the slot table (and the
+    index's embedding-bank record with it) rebalances 8 -> 4 -> 8 across
+    devices under transport faults.  Invariants: zero stale tracked query
+    results, zero acked-write loss, recall@k >= 0.99 vs a float64
+    brute-force oracle AFTER the storm, and the embedding-bank census flat
+    after FT.DROPINDEX."""
+
+    seed: int = 0
+    cycles: int = 1
+    docs: int = 48
+    dim: int = 16
+    knn_k: int = 5
+    query_pool: int = 8        # distinct reader queries (cache-hit shape)
+    writer_threads: int = 2
+    reader_threads: int = 2
+    phase_seconds: float = 1.0
+    faults_per_cycle: int = 8
+    quiesce_s: float = 1.0
+
+
+@dataclass
+class VectorSoakReport:
+    cycles_completed: int = 0
+    writes_acked: int = 0
+    reads: int = 0
+    cache_hits: int = 0
+    invalidations: int = 0
+    errors: int = 0
+    stale_results: int = 0     # MUST stay 0
+    rebalances: int = 0
+    records_moved: int = 0
+    recall_at_k: float = 0.0   # post-storm, vs the f64 oracle
+    bank_bytes_peak: float = 0.0
+
+    def summary(self) -> str:
+        return (
+            f"vector soak: {self.cycles_completed} cycles, "
+            f"{self.writes_acked} acked ingests, {self.reads} KNN reads "
+            f"({self.cache_hits} near-cache hits, {self.invalidations} "
+            f"invalidations, {self.stale_results} stale), "
+            f"{self.errors} budgeted errors, {self.rebalances} rebalances "
+            f"({self.records_moved} records moved), post-storm recall@k "
+            f"{self.recall_at_k:.4f}, bank peak {self.bank_bytes_peak:.0f}B"
+        )
+
+
+class VectorSoakHarness:
+    """The vector-search plane's invariants, under fire (ISSUE 11):
+
+      * **zero stale tracked results** — a reader that near-caches a KNN
+        result keyed on the index's ``__ftq__`` query key either received
+        an invalidation for every ingest that could change it, or its
+        cached result still equals a fresh server query after quiesce;
+      * **recall floor holds post-storm** — after rebalances, faults and
+        concurrent ingest, server KNN against the final corpus matches the
+        float64 brute-force oracle at >= 0.99 recall@k;
+      * **zero acked-write loss** — every acked HSET version reads back;
+      * **bank census flat** — FT.DROPINDEX returns the ftvec bank/byte
+        gauges to baseline (teardown releases the device memory)."""
+
+    INDEX = "vsoak"
+    PREFIX = "vs:"
+
+    def __init__(self, config: Optional[VectorSoakConfig] = None):
+        self.config = config or VectorSoakConfig()
+        self.report = VectorSoakReport()
+        self._server = None
+        self._journal_dir = None
+        self._acked: Dict[int, int] = {}        # doc -> acked version
+        self._acked_lock = threading.Lock()
+        self._violations: List[str] = []
+        rng = np.random.default_rng(self.config.seed + 5)
+        self._base = rng.standard_normal(
+            (self.config.docs, self.config.dim)
+        ).astype(np.float32)
+        self._bump = rng.standard_normal(
+            (self.config.docs, self.config.dim)
+        ).astype(np.float32)
+        self._queries = rng.standard_normal(
+            (self.config.query_pool, self.config.dim)
+        ).astype(np.float32)
+
+    def _vec(self, doc: int, version: int) -> np.ndarray:
+        """Deterministic per-(doc, version) embedding: ingest keeps MOVING
+        every doc in embedding space, so a stale cached result is actually
+        wrong, not coincidentally right."""
+        return (self._base[doc] + 0.05 * version * self._bump[doc]).astype(
+            np.float32
+        )
+
+    def _connect(self, handler=None):
+        from redisson_tpu.net.client import Connection
+
+        c = Connection(self._server.server.host, self._server.server.port,
+                       timeout=10.0)
+        if handler is not None:
+            c.push_handler = handler
+        return c
+
+    def _setup(self) -> None:
+        from redisson_tpu.server.server import ServerThread
+
+        cfg = self.config
+        self._journal_dir = tempfile.mkdtemp(prefix="rtpu-vecsoak-")
+        self._server = ServerThread(port=0, devices="all", workers=8).start()
+        admin = self._connect()
+        r = admin.execute(
+            "FT.CREATE", self.INDEX, "ON", "HASH", "PREFIX", "1", self.PREFIX,
+            "SCHEMA", "price", "NUMERIC",
+            "emb", "VECTOR", "FLAT", "6", "TYPE", "FLOAT32",
+            "DIM", str(cfg.dim), "DISTANCE_METRIC", "L2",
+        )
+        assert r == b"OK", r
+        for i in range(cfg.docs):
+            self._hset(admin, i, 0)
+            self._acked[i] = 0
+        admin.close()
+
+    def _hset(self, conn, doc: int, version: int):
+        return conn.execute(
+            "HSET", f"{self.PREFIX}{doc}", "price", str(doc),
+            "ver", str(version), "emb", self._vec(doc, version).tobytes(),
+        )
+
+    def _knn(self, conn, qi: int, k: Optional[int] = None):
+        """One NOCONTENT KNN over query-pool vector `qi`; returns a tuple
+        of (doc_id, score) pairs — the near-cache value shape."""
+        out = conn.execute(
+            "FT.SEARCH", self.INDEX, "(*)=>[KNN %d @emb $v]" % (
+                k or self.config.knn_k
+            ),
+            "PARAMS", "2", "v", self._queries[qi].tobytes(), "NOCONTENT",
+        )
+        from redisson_tpu.net.resp import RespError
+
+        if isinstance(out, RespError):
+            raise RuntimeError(str(out))
+        pairs = []
+        for j in range(1, len(out), 2):
+            pairs.append((bytes(out[j]), bytes(out[j + 1][-1])))
+        return tuple(pairs)
+
+    def _teardown(self) -> None:
+        from redisson_tpu.net.client import install_fault_plane
+
+        install_fault_plane(None)
+        if self._server is not None:
+            self._server.stop()
+
+    # -- workload --------------------------------------------------------------
+
+    def _writer(self, wid: int, stop: threading.Event) -> None:
+        cfg = self.config
+        conn = None
+        vers = {d: 0 for d in range(wid, cfg.docs, cfg.writer_threads)}
+        my_docs = sorted(vers)
+        j = 0
+        while not stop.is_set():
+            try:
+                if conn is None:
+                    conn = self._connect()
+                d = my_docs[j % len(my_docs)]
+                v = vers[d] + 1
+                r = self._hset(conn, d, v)
+                from redisson_tpu.net.resp import RespError
+
+                if isinstance(r, RespError):
+                    raise RuntimeError(str(r))
+                vers[d] = v
+                with self._acked_lock:
+                    self._acked[d] = max(self._acked[d], v)
+                    self.report.writes_acked += 1
+            except Exception:  # noqa: BLE001 — budgeted fault-window error
+                with self._acked_lock:
+                    self.report.errors += 1
+                try:
+                    if conn is not None:
+                        conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                conn = None
+            j += 1
+            time.sleep(0.004)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _reader(self, rid: int, stop: threading.Event,
+                final_caches: List[Dict[int, tuple]]) -> None:
+        cfg = self.config
+        rng = np.random.default_rng(cfg.seed * 97 + rid)
+        state = {"conn": None, "cache": {}}
+
+        def on_push(push) -> None:
+            try:
+                if bytes(push[0]) == b"invalidate":
+                    state["cache"].clear()
+                    with self._acked_lock:
+                        self.report.invalidations += 1
+            except Exception:  # noqa: BLE001
+                state["cache"].clear()
+
+        while not stop.is_set():
+            try:
+                if state["conn"] is None:
+                    state["cache"] = {}
+                    c = self._connect(handler=on_push)
+                    c.execute("CLIENT", "TRACKING", "ON")
+                    state["conn"] = c
+                qi = int(rng.integers(cfg.query_pool))
+                cached = state["cache"].get(qi)
+                if cached is not None and rng.random() < 0.7:
+                    # near-cache hit — but still PING so queued pushes drain
+                    state["conn"].execute("PING")
+                    with self._acked_lock:
+                        self.report.reads += 1
+                        self.report.cache_hits += 1
+                else:
+                    res = self._knn(state["conn"], qi)
+                    state["cache"][qi] = res
+                    with self._acked_lock:
+                        self.report.reads += 1
+            except Exception:  # noqa: BLE001 — budgeted fault-window error
+                with self._acked_lock:
+                    self.report.errors += 1
+                try:
+                    if state["conn"] is not None:
+                        state["conn"].close()
+                except Exception:  # noqa: BLE001
+                    pass
+                state["conn"] = None
+            time.sleep(0.003)
+        # quiesce-time coherence check happens in run(): hand the LIVE
+        # cache dict over (a drain-time invalidation push must still be
+        # able to clear entries before the staleness comparison reads them)
+        final_caches[rid] = state["cache"]
+        self._reader_conns[rid] = state["conn"]
+
+    def _rebalance(self, n_active: int) -> None:
+        from redisson_tpu.server import migration as mig
+
+        engine = self._server.server.engine
+        targets = engine.placement.spread_plan(n_active)
+        moved = mig.rebalance_devices(
+            engine, targets, journal_dir=self._journal_dir
+        )
+        self.report.rebalances += 1
+        self.report.records_moved += moved
+
+    # -- run -------------------------------------------------------------------
+
+    def run(self) -> VectorSoakReport:
+        from redisson_tpu.net.client import install_fault_plane
+        from redisson_tpu.server import migration as mig
+
+        cfg = self.config
+        self._setup()
+        census = ResourceCensus()
+        census.track_server("srv", self._server.server)
+        try:
+            engine = self._server.server.engine
+            baseline = census.snapshot()
+            self._reader_conns: List[Optional[object]] = [None] * cfg.reader_threads
+            final_caches: List[Dict[int, tuple]] = [{} for _ in range(cfg.reader_threads)]
+            for cycle in range(cfg.cycles):
+                sched = FaultSchedule(cfg.seed * 6151 + cycle)
+                n = max(1, cfg.faults_per_cycle)
+                sched.add_random("delay", n=n, window=300, delay_s=0.01)
+                sched.add_random("drop", n=max(1, n // 2), window=300)
+                stop = threading.Event()
+                threads = [
+                    threading.Thread(
+                        target=self._writer, args=(w, stop), daemon=True
+                    )
+                    for w in range(cfg.writer_threads)
+                ] + [
+                    threading.Thread(
+                        target=self._reader, args=(r, stop, final_caches),
+                        daemon=True,
+                    )
+                    for r in range(cfg.reader_threads)
+                ]
+                install_fault_plane(FaultPlane(sched))
+                for t in threads:
+                    t.start()
+                try:
+                    time.sleep(cfg.phase_seconds)
+                    self._rebalance(4)      # 8 -> 4 under traffic
+                    snap = self._server.server._ftvec_census()
+                    self.report.bank_bytes_peak = max(
+                        self.report.bank_bytes_peak,
+                        snap["ftvec_device_bytes"],
+                    )
+                    time.sleep(cfg.phase_seconds)
+                    self._rebalance(engine.placement.n_devices)  # 4 -> 8
+                    time.sleep(cfg.phase_seconds)
+                finally:
+                    stop.set()
+                    for t in threads:
+                        t.join(timeout=30)
+                    install_fault_plane(None)
+                self.report.cycles_completed += 1
+            time.sleep(cfg.quiesce_s)
+            leftover = mig.resume_device_rebalances(engine, self._journal_dir)
+            assert leftover == [], f"rebalances left in flight: {leftover}"
+            # zero acked-write loss: every acked version reads back
+            check = self._connect()
+            with self._acked_lock:
+                acked = dict(self._acked)
+            for d, v in acked.items():
+                got = check.execute("HGET", f"{self.PREFIX}{d}", "ver")
+                got = int(got) if got is not None else -1
+                assert got >= v, (
+                    f"acked-write loss: {self.PREFIX}{d} ver {got} < acked {v}"
+                )
+            # zero stale tracked results: any cache entry a reader still
+            # holds was never invalidated — after quiesce (one PING drains
+            # the push queue) it must equal a fresh server answer
+            for rid, cache in enumerate(final_caches):
+                conn = self._reader_conns[rid]
+                if conn is None:
+                    continue
+                try:
+                    conn.execute("PING")  # drain queued invalidations
+                except Exception:  # noqa: BLE001
+                    continue
+                for qi, cached in list(cache.items()):
+                    # drop entries an in-flight push just cleared
+                    fresh = self._knn(check, qi)
+                    if cached != fresh and qi in cache:
+                        self.report.stale_results += 1
+                        self._violations.append(
+                            f"reader{rid} q{qi}: cached {cached} != {fresh}"
+                        )
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            assert self.report.stale_results == 0, (
+                "stale tracked KNN results: " + "; ".join(self._violations[:3])
+            )
+            # recall floor post-storm: server KNN vs the f64 oracle over the
+            # FINAL corpus (read back from the server, not assumed)
+            corpus = np.zeros((cfg.docs, cfg.dim), np.float64)
+            for d in range(cfg.docs):
+                blob = check.execute("HGET", f"{self.PREFIX}{d}", "emb")
+                corpus[d] = np.frombuffer(bytes(blob), "<f4").astype(np.float64)
+            hits = total = 0
+            for qi in range(cfg.query_pool):
+                mine = self._knn(check, qi)
+                q64 = self._queries[qi].astype(np.float64)
+                d64 = np.sum((corpus - q64[None, :]) ** 2, axis=1)
+                truth = {
+                    f"{self.PREFIX}{r}".encode()
+                    for r in np.argsort(d64, kind="stable")[: cfg.knn_k]
+                }
+                hits += len(truth & {doc for doc, _s in mine})
+                total += cfg.knn_k
+            self.report.recall_at_k = hits / total
+            assert self.report.recall_at_k >= 0.99, (
+                f"post-storm recall@{cfg.knn_k} {self.report.recall_at_k:.4f}"
+            )
+            # bank census flat after teardown: DROPINDEX must release the
+            # device-resident banks (the HBM-ledger guard)
+            assert self.report.bank_bytes_peak > 0, "bank never materialized"
+            r = check.execute("FT.DROPINDEX", self.INDEX)
+            assert r == b"OK", r
+            check.close()
+            after = census.snapshot()
+            assert after["srv.ftvec_banks"] == 0.0, after
+            assert after["srv.ftvec_device_bytes"] == 0.0, after
+            census.assert_flat(
+                baseline, after,
+                # ftvec rows are asserted EXACTLY zero above (the baseline
+                # snapshot runs after _setup's FT.CREATE, so their diff is
+                # the 1 -> 0 teardown, not a leak)
+                ignore=("*.keys", "*.wait_entries", "*.connections",
+                        "*.conn_*", "*.repl_*", "*.tracking_*",
+                        "*.qos_shed_*", "*.ftvec_*"),
+                context="vector soak",
+            )
+            lanes = engine.lanes.census()
+            assert lanes["active_dispatches"] == 0, lanes
+            budget = max(10, (self.report.writes_acked + self.report.reads) // 2)
+            assert self.report.errors <= budget, (
+                f"error budget blown: {self.report.errors} vs {budget}"
+            )
+            assert self.report.writes_acked > 0 and self.report.reads > 0
+            return self.report
+        finally:
+            self._teardown()

@@ -619,6 +619,100 @@ def wc_extract_words(buf, end_deltas, n_words, base):
     return ha, hb, start
 
 
+# --------------------------------------------------------------------------
+# Vector-search kernels (FT VECTOR / KNN, ISSUE 11).
+#
+# FLAT (exact) KNN is a dense score matrix + a top-k: queries (Q, d) against
+# a bank (C, d) is ONE (Q, d) x (d, C) matmul — the MXU's native shape — and
+# jax.lax.top_k over the masked score rows.  Same no-Pallas rationale as the
+# probe kernels above: XLA already lowers dot_general to the systolic array
+# and top_k to the tuned sort unit; a hand kernel could only re-derive them.
+#
+# Distance conventions (lower = better, the RediSearch FLAT shapes):
+#   L2     — squared euclidean ||q - b||^2 (expanded form so the matmul
+#            carries the whole cross term)
+#   COSINE — 1 - cos(q, b)  (zero-norm rows score distance 1: orthogonal)
+#   IP     — 1 - <q, b>
+# Rows at index >= n_rows (padding / unfilled capacity) and rows whose
+# `bias` is +inf (deleted docs, prefilter exclusions) never reach the top-k:
+# bias adds into the distance row before selection, so a hybrid query's
+# host-built mask is just an additive bias operand — no second kernel.
+# Ties break toward the LOWER row index (lax.top_k is stable), which the
+# NumPy fallback (services/vector.py) mirrors with a stable argsort: the
+# armed and disarmed paths return identical orderings.
+# --------------------------------------------------------------------------
+
+
+def _knn_distances(bank, bias, q, n_rows, metric: str):
+    dots = jnp.dot(q, bank.T, preferred_element_type=jnp.float32)  # (Q, C)
+    if metric == "L2":
+        q_sq = jnp.sum(q * q, axis=1, dtype=jnp.float32)
+        b_sq = jnp.sum(bank * bank, axis=1, dtype=jnp.float32)
+        dist = q_sq[:, None] - 2.0 * dots + b_sq[None, :]
+    elif metric == "COSINE":
+        qn = jnp.sqrt(jnp.sum(q * q, axis=1, dtype=jnp.float32))
+        bn = jnp.sqrt(jnp.sum(bank * bank, axis=1, dtype=jnp.float32))
+        denom = qn[:, None] * bn[None, :]
+        dist = 1.0 - jnp.where(denom > 0.0, dots / denom, 0.0)
+    elif metric == "IP":
+        dist = 1.0 - dots
+    else:  # pragma: no cover — metric validated at FT.CREATE
+        raise ValueError(f"unknown metric {metric!r}")
+    dist = dist + bias[None, :]
+    live = jnp.arange(bank.shape[0], dtype=jnp.int32) < n_rows
+    return jnp.where(live[None, :], dist, jnp.inf)
+
+
+def _knn_topk_body(bank, bias, q, n_rows, k: int, metric: str):
+    dist = _knn_distances(bank, bias, q, n_rows, metric)
+    neg, idx = jax.lax.top_k(-dist, k)
+    return -neg, idx.astype(jnp.int32)
+
+
+def _knn_topk_masked_body(bank, bias, qbias, q, n_rows, k: int, metric: str):
+    """Hybrid prefilter: per-query additive bias (Q, C) — 0 keeps a row,
+    +inf drops it (the planner's host mask lowered onto the score matrix)."""
+    dist = _knn_distances(bank, bias, q, n_rows, metric) + qbias
+    neg, idx = jax.lax.top_k(-dist, k)
+    return -neg, idx.astype(jnp.int32)
+
+
+knn_topk = jax.jit(_knn_topk_body, static_argnums=(4, 5))
+knn_topk_masked = jax.jit(_knn_topk_masked_body, static_argnums=(5, 6))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def rowbank_write_packed(bank, bias, packed, n_valid):
+    """Block-append/overwrite rows of a (C, W) f32 device bank from ONE
+    packed uint32 transfer buffer — the embedding/numeric ingest path's
+    single H2D per flush (ISSUE 11; the pack_rows bandwidth discipline).
+
+    packed: (P, W+2) uint32 — col 0 = row index, col 1 = the row's new bias
+    bits (f32: 0.0 live, +inf dead), cols 2.. = the row data bitcast to
+    uint32.  Rows past n_valid scatter out of range (dropped)."""
+    idx = packed[:, 0].astype(jnp.int32)
+    newbias = jax.lax.bitcast_convert_type(packed[:, 1], jnp.float32)
+    rows = jax.lax.bitcast_convert_type(packed[:, 2:], jnp.float32)
+    mask = _valid_mask(packed.shape[0], n_valid)
+    safe = jnp.where(mask, idx, bank.shape[0])
+    return (
+        bank.at[safe].set(rows, mode="drop"),
+        bias.at[safe].set(newbias, mode="drop"),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(2, 3))
+def rowbank_grow(bank, bias, grown_bank, grown_bias):
+    """Device-side capacity growth: copy the old bank into the zero-filled
+    larger plane (HBM copy — growth never re-uploads host rows).  The grown
+    planes are donated: XLA writes the copy into their buffers in place."""
+    c = bank.shape[0]
+    return (
+        grown_bank.at[:c].set(bank),
+        grown_bias.at[:c].set(bias),
+    )
+
+
 def _wc_hash_prelude(buf):
     n = buf.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)

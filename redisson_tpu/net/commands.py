@@ -133,8 +133,8 @@ _spec(SPECS, "JSON.GET JSON.TYPE JSON.STRLEN JSON.ARRLEN JSON.ARRINDEX "
 _spec(SPECS, "JSON.SET JSON.DEL JSON.NUMINCRBY JSON.STRAPPEND JSON.ARRAPPEND "
              "JSON.ARRINSERT JSON.ARRPOP JSON.ARRTRIM JSON.CLEAR JSON.TOGGLE "
              "JSON.MERGE", True, 0)
-_spec(SPECS, "FT.SEARCH FT.AGGREGATE FT.INFO FT._LIST FT.SPELLCHECK "
-             "FT.DICTDUMP FT.CURSOR", False, None)
+_spec(SPECS, "FT.SEARCH FT.MSEARCH FT.AGGREGATE FT.INFO FT._LIST "
+             "FT.SPELLCHECK FT.DICTDUMP FT.CURSOR", False, None)
 _spec(SPECS, "FT.CREATE FT.DROPINDEX FT.ALTER FT.ALIASADD FT.ALIASUPDATE "
              "FT.ALIASDEL FT.DICTADD FT.DICTDEL", True, None)
 

@@ -77,6 +77,11 @@ def set_qos(on: bool) -> bool:
 _BLOB8 = {b"BF.MADD64": 2, b"BF.MEXISTS64": 2, b"PFADD64": 2}
 _BLOB8_AT3 = {b"BFA.MADD64": 3, b"BFA.MEXISTS64": 3, b"HLLA.MADD64": 3}
 _BLOB4 = {b"SETBITSB": 2, b"GETBITSB": 2}
+# KNN verbs (ISSUE 11): charged by their PARAMS vector payload — every 8
+# payload bytes counts one device item, the same unit as the sketch blob
+# verbs, so tenant budgets and lane ledgers see a stacked multi-query KNN
+# frame as proportionally heavier than a single probe
+_FT_KNN = frozenset((b"FT.SEARCH", b"FT.MSEARCH"))
 
 
 def estimate_device_items(cmds: Sequence) -> int:
@@ -100,6 +105,13 @@ def estimate_command_items(cmd) -> int:
             return max(1, len(cmd[3]) // 8)
         if verb in _BLOB4:
             return max(1, len(cmd[2]) // 4)
+        if verb in _FT_KNN:
+            # the query-vector blob(s) ride PARAMS values: charge every
+            # bulk byte argument (small option tokens stay under the bar)
+            return max(1, sum(
+                len(a) for a in cmd[2:]
+                if isinstance(a, (bytes, bytearray)) and len(a) >= 64
+            ) // 8)
         return 1
     except (IndexError, TypeError):
         return 1

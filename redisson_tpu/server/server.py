@@ -297,6 +297,17 @@ class TpuServer:
         # expiry invalidation: a key the TTL reaper (or a lazy-expiry read)
         # drops must invalidate near caches exactly like a DEL would
         self.engine.store.on_expired = self.tracking.note_expired
+        # embedding-bank residency gauges (ISSUE 11, the first HBM-ledger
+        # brick): bank count + device bytes, 0 until FT.CREATE ... VECTOR
+        # builds one (the search service is lazily constructed — don't
+        # force it just to report zero)
+        self.metrics.gauge(
+            "ftvec_banks", lambda: self._ftvec_census().get("ftvec_banks", 0.0)
+        )
+        self.metrics.gauge(
+            "ftvec_device_bytes",
+            lambda: self._ftvec_census().get("ftvec_device_bytes", 0.0),
+        )
         # OBJCALL handle cache (ordered for LRU eviction; see registry)
         from collections import OrderedDict
 
@@ -773,6 +784,17 @@ class TpuServer:
         return out
 
     # -- device-sharded frame dispatch (ISSUE 8) ------------------------------
+
+    def _ftvec_census(self) -> dict:
+        """Embedding-bank residency rows ({ftvec_banks, ftvec_device_bytes})
+        from the lazily-created search service; zeros while none exists."""
+        svc = self.engine._services.get("search")
+        if svc is None:
+            return {"ftvec_banks": 0.0, "ftvec_device_bytes": 0.0}
+        try:
+            return svc.device_census()
+        except Exception:  # noqa: BLE001 — a broken gauge must not kill scrape
+            return {"ftvec_banks": 0.0, "ftvec_device_bytes": 0.0}
 
     @staticmethod
     def _estimate_device_items(cmds) -> int:

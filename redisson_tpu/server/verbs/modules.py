@@ -190,6 +190,35 @@ def _ft(server):
     return server.engine.service("search", lambda: SearchService(server.engine))
 
 
+import re as _knn_re
+
+# `(<filter>)=>[KNN <k> @<field> $<param> [AS <alias>]]` — the RediSearch
+# vector-query arm (dialect 2).  The filter half feeds the ordinary query
+# planner; its candidate set lowers onto the score matrix as an additive
+# -inf bias (services/search.knn), so hybrid queries stay ONE kernel.
+_KNN_ARM = _knn_re.compile(
+    r"^\s*(?:\((?P<filt>.*)\)|(?P<star>\*))\s*=>\s*\[\s*KNN\s+"
+    r"(?P<k>\d+)\s+@(?P<field>\w+)\s+\$(?P<param>\w+)"
+    r"(?:\s+AS\s+(?P<alias>\w+))?\s*\]\s*$",
+    _knn_re.IGNORECASE | _knn_re.DOTALL,
+)
+
+
+def _ft_split_knn(q: str):
+    """Split a query into (filter-query, knn-spec|None).  Non-KNN queries
+    pass through unchanged."""
+    m = _KNN_ARM.match(q)
+    if m is None:
+        return q, None
+    filt = "*" if m.group("star") else (m.group("filt") or "*")
+    return filt, {
+        "k": int(m.group("k")),
+        "field": m.group("field"),
+        "param": m.group("param"),
+        "alias": m.group("alias"),
+    }
+
+
 def _ft_parse_query(q: str, schema: dict):
     """RediSearch query subset -> Condition tree: `*`, `@f:[lo hi]` numeric
     ranges ('(' = exclusive, ±inf), `@f:{tag|tag}`, `@f:text`, `@f:(txt)`,
@@ -240,6 +269,36 @@ def _ft_parse_query(q: str, schema: dict):
     return terms[0] if len(terms) == 1 else And(terms)
 
 
+def _ft_invalidate(server, ctx, index_name: str) -> None:
+    """Index DDL / ingest invalidates the index's synthetic QUERY KEY
+    (services/search.query_key): tracked FT.SEARCH results near-cache
+    client-side and must go stale whenever the index can change.  Plain
+    writes under the index prefixes invalidate through the TrackingTable
+    post-dispatch hook; DDL verbs call this directly."""
+    track = getattr(server, "tracking", None)
+    if track is None or not track.active:
+        return
+    svc = _ft(server)
+    try:
+        track.note_write([svc.query_key(svc.resolve(index_name))], None)
+    except Exception:  # noqa: BLE001 — invalidation must not fail the verb
+        pass
+
+
+def _ft_track_read(server, ctx, index_name: str) -> None:
+    """Register a tracked connection's interest in the index's query key —
+    the FT analog of the pre-dispatch read registration (FT.* is keyless,
+    so the generic hook never sees it)."""
+    track = getattr(server, "tracking", None)
+    if track is None or not track.active or ctx.tracking is None:
+        return
+    svc = _ft(server)
+    try:
+        track.note_read(ctx, [svc.query_key(svc.resolve(index_name))])
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _ft_cmd(fn):
     """Map malformed FT arguments/queries to syntax errors, missing indexes
     to the RediSearch wording — never 'ERR internal'."""
@@ -260,7 +319,13 @@ def _ft_cmd(fn):
 @register("FT.CREATE")
 @_ft_cmd
 def cmd_ft_create(server, ctx, args):
-    """FT.CREATE idx [ON HASH] [PREFIX n p...] SCHEMA f TYPE [SORTABLE] ..."""
+    """FT.CREATE idx [ON HASH] [PREFIX n p...] SCHEMA f TYPE [SORTABLE] ...
+
+    VECTOR attributes use the RediSearch shape:
+    ``f VECTOR FLAT 6 TYPE FLOAT32 DIM d DISTANCE_METRIC {L2|COSINE|IP}`` —
+    FLAT/FLOAT32 only (exact scoring; the nargs pairs may arrive in any
+    order).  Each VECTOR field gets a device-resident embedding bank placed
+    on the index's slot-owner device (services/vector.py)."""
     name = _s(args[0])
     prefixes = [""]
     i = 1
@@ -282,25 +347,51 @@ def cmd_ft_create(server, ctx, args):
     else:
         raise RespError("ERR SCHEMA is required")
     schema = {}
+    vector = {}
     while i < len(args):
         fld = _s(args[i])
         ty = bytes(args[i + 1]).upper().decode()
-        if ty not in ("TEXT", "TAG", "NUMERIC"):
+        if ty == "VECTOR":
+            algo = _s(args[i + 2]).upper()
+            nargs = _int(args[i + 3])
+            if nargs % 2 or i + 4 + nargs > len(args):
+                raise RespError("ERR bad vector attribute count")
+            attrs = {}
+            for j in range(i + 4, i + 4 + nargs, 2):
+                attrs[_s(args[j]).upper()] = _s(args[j + 1])
+            missing = {"TYPE", "DIM", "DISTANCE_METRIC"} - set(attrs)
+            if missing:
+                raise RespError(
+                    f"ERR vector attribute(s) missing: {sorted(missing)}"
+                )
+            vector[fld] = {
+                "dim": _int(attrs["DIM"].encode()),
+                "metric": attrs["DISTANCE_METRIC"],
+                "dtype": attrs["TYPE"],
+                "algo": algo,
+            }
+            schema[fld] = "VECTOR"
+            i += 4 + nargs
+        elif ty in ("TEXT", "TAG", "NUMERIC"):
+            schema[fld] = ty
+            i += 2
+        else:
             raise RespError(f"ERR unsupported field type '{ty}'")
-        schema[fld] = ty
-        i += 2
         if i < len(args) and bytes(args[i]).upper() == b"SORTABLE":
             i += 1  # everything is sortable here
     try:
-        _ft(server).create(name, schema, prefixes, doc_mode="hash")
+        _ft(server).create(name, schema, prefixes, doc_mode="hash",
+                           vector=vector)
     except ValueError as e:
         raise RespError(f"ERR {e}")
+    _ft_invalidate(server, ctx, name)
     return "+OK"
 
 
 @register("FT.DROPINDEX")
 @_ft_cmd
 def cmd_ft_dropindex(server, ctx, args):
+    _ft_invalidate(server, ctx, _s(args[0]))  # before the name resolves away
     if not _ft(server).drop_index(_s(args[0])):
         raise RespError("ERR Unknown Index name")
     return "+OK"
@@ -319,57 +410,256 @@ def cmd_ft_info(server, ctx, args):
     idx = svc._idx(_s(args[0]))  # KeyError -> Unknown Index via _ft_cmd
     svc.sync(_s(args[0]))
     info = svc.info(_s(args[0]))
+    vec_rows = {r["field"]: r for r in info.get("vector_fields", [])}
     flat_schema = []
     for f, ty in info["schema"].items():
-        flat_schema.append([f.encode(), b"type", ty.encode()])
-    return [
+        row = [f.encode(), b"type", ty.encode()]
+        vr = vec_rows.get(f)
+        if vr is not None:
+            # the vector attribute's full shape: dim/metric/rows/bytes —
+            # the per-field half of the HBM ledger FT.INFO exposes
+            row += [
+                b"algorithm", vr["algo"].encode(),
+                b"data_type", vr["dtype"].encode(),
+                b"dim", vr["dim"],
+                b"distance_metric", vr["metric"].encode(),
+                b"rows", vr["rows"],
+                b"device_bytes", vr["device_bytes"],
+            ]
+        flat_schema.append(row)
+    out = [
         b"index_name", info["name"].encode(),
         b"num_docs", info["num_docs"],
         b"attributes", flat_schema,
         b"prefixes", [p.encode() for p in info["prefixes"]],
     ]
+    if "vector_device_bytes" in info:
+        out += [b"vector_device_bytes", info["vector_device_bytes"]]
+    return out
+
+
+def _ft_field_blob(v) -> bytes:
+    """Reply encoding of one stored field value — raw bytes (vector blobs)
+    pass through untouched, everything else stringifies."""
+    return bytes(v) if isinstance(v, (bytes, bytearray)) else str(v).encode()
+
+
+def _ft_score_bytes(d: float) -> bytes:
+    """Distance formatting for KNN replies: fixed 4-decimal text, so the
+    armed (device f32) and disarmed (NumPy f32) paths — which may differ in
+    the last ulp from reduction order — encode identically on the wire."""
+    return (b"%.4f" % d)
+
+
+def _ft_parse_search_opts(args, i):
+    """Shared FT.SEARCH/FT.MSEARCH option tail: NOCONTENT / SORTBY / LIMIT /
+    PARAMS / DIALECT / WITHCURSOR [COUNT n]."""
+    opts = {
+        "nocontent": False, "sort_by": None, "desc": False,
+        "off": 0, "lim": 10, "params": {}, "withcursor": False,
+        "cursor_count": 10,
+    }
+    while i < len(args):
+        opt = bytes(args[i]).upper()
+        if opt == b"NOCONTENT":
+            opts["nocontent"] = True
+            i += 1
+        elif opt == b"SORTBY":
+            opts["sort_by"] = _s(args[i + 1])
+            i += 2
+            if i < len(args) and bytes(args[i]).upper() in (b"ASC", b"DESC"):
+                opts["desc"] = bytes(args[i]).upper() == b"DESC"
+                i += 1
+        elif opt == b"LIMIT":
+            opts["off"], opts["lim"] = _int(args[i + 1]), _int(args[i + 2])
+            i += 3
+        elif opt == b"PARAMS":
+            n = _int(args[i + 1])
+            if n % 2:
+                raise RespError("ERR PARAMS count must be even")
+            for j in range(i + 2, i + 2 + n, 2):
+                opts["params"][_s(args[j])] = bytes(args[j + 1])
+            i += 2 + n
+        elif opt == b"DIALECT":
+            i += 2  # accepted for driver compatibility; grammar is fixed
+        elif opt == b"WITHCURSOR":
+            opts["withcursor"] = True
+            i += 1
+            if i + 1 < len(args) and bytes(args[i]).upper() == b"COUNT":
+                opts["cursor_count"] = _int(args[i + 1])
+                i += 2
+        else:
+            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
+    return opts
+
+
+def _ft_knn_query_vectors(server, idx, knn, params, expect_multiple=False):
+    """Decode the KNN arm's $param blob into (Q, dim) float32 queries."""
+    import numpy as np
+
+    spec = idx.vector_specs.get(knn["field"])
+    if spec is None:
+        raise RespError(
+            f"ERR '{knn['field']}' is not a VECTOR field of '{idx.name}'"
+        )
+    blob = params.get(knn["param"])
+    if blob is None:
+        raise RespError(f"ERR missing PARAMS value for ${knn['param']}")
+    if len(blob) == 0 or len(blob) % (spec.dim * 4):
+        raise RespError(
+            f"ERR vector blob of {len(blob)} bytes does not pack DIM "
+            f"{spec.dim} float32 vectors"
+        )
+    q = np.frombuffer(blob, dtype="<f4").reshape(-1, spec.dim)
+    if not expect_multiple and q.shape[0] != 1:
+        raise RespError("ERR FT.SEARCH KNN takes exactly one query vector")
+    return np.ascontiguousarray(q, np.float32)
+
+
+def _ft_knn_reply(idx, hits, opts, score_field):
+    """One query's [(doc_id, dist), ...] -> the FT.SEARCH reply rows.
+
+    Plain mode returns the flat RediSearch shape
+    ``[total, id, [f, v, ..., score_field, score], ...]`` (LIMIT applies to
+    the k hits).  WITHCURSOR returns ``[[n, [id, flds], ...], cid]`` — rows
+    nest so FT.CURSOR READ pages the SAME shape (k > COUNT spills into the
+    cursor; services/search cursor expiry/cap applies)."""
+    rows = []
+    for doc_id, dist in hits:
+        fields = idx.docs.get(doc_id)
+        if opts["nocontent"]:
+            flat = [score_field.encode(), _ft_score_bytes(dist)]
+        else:
+            flat = []
+            for k, v in (fields or {}).items():
+                flat += [str(k).encode(), _ft_field_blob(v)]
+            flat += [score_field.encode(), _ft_score_bytes(dist)]
+        rows.append([doc_id.encode(), flat])
+    return rows
 
 
 @register("FT.SEARCH")
 @_ft_cmd
 def cmd_ft_search(server, ctx, args):
     """FT.SEARCH idx query [NOCONTENT] [SORTBY f [ASC|DESC]] [LIMIT off n]
-    -> [total, id, [f, v, ...], ...] (RediSearch reply shape)."""
+    [PARAMS n k v ...] [DIALECT d] [WITHCURSOR [COUNT n]]
+    -> [total, id, [f, v, ...], ...] (RediSearch reply shape).
+
+    The KNN arm ``(filter)=>[KNN k @f $vec]`` scores on the index's
+    device-resident embedding bank as ONE matmul-top-k kernel and replies
+    lazily: the (dist, idx) kernel outputs ride the frame-grouped readback
+    (LazyReply), so M concurrent KNN frames cost <= M+1 blocking syncs.
+    Results carry ``__<field>_score`` (distance, 4 decimals, ascending).
+    WITHCURSOR pages k > COUNT hits through FT.CURSOR READ (nested-row
+    shape, see _ft_knn_reply)."""
+    from redisson_tpu.server.registry import LazyReply
+
     svc = _ft(server)
     idx = svc._idx(_s(args[0]))  # KeyError -> Unknown Index via _ft_cmd
-    svc.sync(_s(args[0]))
-    cond = _ft_parse_query(_s(args[1]), idx.schema)
-    nocontent = False
-    sort_by, desc = None, False
-    off, lim = 0, 10
-    i = 2
-    while i < len(args):
-        opt = bytes(args[i]).upper()
-        if opt == b"NOCONTENT":
-            nocontent = True
-            i += 1
-        elif opt == b"SORTBY":
-            sort_by = _s(args[i + 1])
-            i += 2
-            if i < len(args) and bytes(args[i]).upper() in (b"ASC", b"DESC"):
-                desc = bytes(args[i]).upper() == b"DESC"
-                i += 1
-        elif opt == b"LIMIT":
-            off, lim = _int(args[i + 1]), _int(args[i + 2])
-            i += 3
-        else:
-            raise RespError(f"ERR syntax error near '{_s(args[i])}'")
-    res = svc.search(_s(args[0]), cond, sort_by=sort_by, descending=desc,
-                     offset=off, limit=lim)
-    out = [res.total]
-    for doc_id, fields in res.docs:
-        out.append(doc_id.encode())
-        if not nocontent:
-            flat = []
-            for k, v in fields.items():
-                flat += [str(k).encode(), str(v).encode()]
+    _ft_track_read(server, ctx, _s(args[0]))
+    svc.sync(svc.resolve(_s(args[0])))
+    qstr, knn = _ft_split_knn(_s(args[1]))
+    opts = _ft_parse_search_opts(args, 2)
+    cond = _ft_parse_query(qstr, idx.schema)
+
+    if knn is None:
+        if opts["withcursor"]:
+            raise RespError("ERR WITHCURSOR requires a KNN query")
+        res = svc.search(_s(args[0]), cond, sort_by=opts["sort_by"],
+                         descending=opts["desc"], offset=opts["off"],
+                         limit=opts["lim"])
+        out = [res.total]
+        for doc_id, fields in res.docs:
+            out.append(doc_id.encode())
+            if not opts["nocontent"]:
+                flat = []
+                for k, v in fields.items():
+                    flat += [str(k).encode(), _ft_field_blob(v)]
+                out.append(flat)
+        return out
+
+    # -- KNN path -------------------------------------------------------------
+    if knn["k"] <= 0:
+        raise RespError("ERR KNN k must be positive")
+    if opts["sort_by"] is not None and opts["sort_by"] != (
+        knn["alias"] or f"__{knn['field']}_score"
+    ):
+        raise RespError("ERR KNN results sort by the vector score")
+    q = _ft_knn_query_vectors(server, idx, knn, opts["params"])
+    try:
+        device, finish = svc.knn(
+            _s(args[0]), knn["field"], q, knn["k"], condition=cond
+        )
+    except ValueError as e:
+        raise RespError(f"ERR {e}")
+    score_field = knn["alias"] or f"__{knn['field']}_score"
+
+    def encode(vals):
+        hits = finish(vals)[0]
+        if opts["desc"]:
+            hits = hits[::-1]  # SORTBY <score> DESC: farthest-first paging
+        rows = _ft_knn_reply(idx, hits, opts, score_field)
+        if opts["withcursor"]:
+            count = max(1, opts["cursor_count"])
+            batch, rest = rows[:count], rows[count:]
+            cid = svc.cursor_create(rest) if rest else 0
+            return [[len(batch)] + batch, cid]
+        rows = rows[opts["off"] : opts["off"] + opts["lim"]]
+        out = [len(hits)]
+        for doc_id, flat in rows:
+            out.append(doc_id)
             out.append(flat)
-    return out
+        return out
+
+    if device is None:  # disarmed (RTPU_NO_VECTOR) or empty index/filter
+        return encode(None)
+    return LazyReply(device=device, finish=encode)
+
+
+@register("FT.MSEARCH")
+@_ft_cmd
+def cmd_ft_msearch(server, ctx, args):
+    """FT.MSEARCH idx query [PARAMS ...] — the batched multi-query KNN
+    path: the $param blob packs Q stacked float32 vectors (Q*dim*4 bytes)
+    and the whole batch scores as ONE stacked matmul-top-k dispatch (a
+    coalesced run of same-index KNN frames in a single command).  Reply:
+    ``[Q, [id, score, id, score, ...] per query]`` — ids+scores only, the
+    throughput projection."""
+    from redisson_tpu.server.registry import LazyReply
+
+    svc = _ft(server)
+    idx = svc._idx(_s(args[0]))
+    _ft_track_read(server, ctx, _s(args[0]))
+    svc.sync(svc.resolve(_s(args[0])))
+    qstr, knn = _ft_split_knn(_s(args[1]))
+    if knn is None:
+        raise RespError("ERR FT.MSEARCH requires a KNN query")
+    opts = _ft_parse_search_opts(args, 2)
+    if opts["withcursor"]:
+        raise RespError("ERR FT.MSEARCH does not support WITHCURSOR")
+    cond = _ft_parse_query(qstr, idx.schema)
+    q = _ft_knn_query_vectors(server, idx, knn, opts["params"],
+                              expect_multiple=True)
+    try:
+        device, finish = svc.knn(
+            _s(args[0]), knn["field"], q, knn["k"], condition=cond
+        )
+    except ValueError as e:
+        raise RespError(f"ERR {e}")
+
+    def encode(vals):
+        per_query = finish(vals)
+        out = [len(per_query)]
+        for hits in per_query:
+            flat = []
+            for doc_id, dist in hits:
+                flat += [doc_id.encode(), _ft_score_bytes(dist)]
+            out.append(flat)
+        return out
+
+    if device is None:
+        return encode(None)
+    return LazyReply(device=device, finish=encode)
 
 
 @register("FT.AGGREGATE")
@@ -475,6 +765,7 @@ def cmd_ft_alter(server, ctx, args):
         _ft(server).alter(_s(args[0]), _s(args[3]), ty)
     except ValueError as e:
         raise RespError(f"ERR {e}")
+    _ft_invalidate(server, ctx, _s(args[0]))
     return "+OK"
 
 
@@ -519,6 +810,7 @@ def cmd_ft_synupdate(server, ctx, args):
     if not terms:
         raise RespError("ERR FT.SYNUPDATE needs at least one term")
     idx.syn_update(group, terms)
+    _ft_invalidate(server, ctx, _s(args[0]))
     return "+OK"
 
 

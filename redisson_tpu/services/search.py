@@ -36,6 +36,7 @@ class FieldType:
     TEXT = "TEXT"
     TAG = "TAG"
     NUMERIC = "NUMERIC"
+    VECTOR = "VECTOR"  # device-resident embedding bank (services/vector.py)
 
 
 _WORD = re.compile(r"[\w']+")
@@ -118,49 +119,69 @@ class Or(Condition):
 
 
 class _NumericPlane:
-    """Dense (docs × numeric-fields) matrix, device-resident lazily.
+    """Dense (docs × numeric-fields) matrix on the block-appended device row
+    bank (services/vector.DeviceRowBank).
 
-    Rows are appended host-side and flushed to device in one transfer when a
-    query needs them (write-coalescing, the framework's universal trick)."""
+    Historically this cached one whole-matrix device upload and re-staged
+    the ENTIRE host matrix whenever the row count changed — O(docs) H2D per
+    single-doc ingest.  Now appends/overwrites buffer host-side and flush as
+    ONE packed upload + scatter per block (the embedding banks' discipline),
+    so N single-doc ingests cost O(N/block) transfers; a query flushes at
+    most the pending tail, never the full matrix."""
 
     def __init__(self, fields: List[str]):
+        from redisson_tpu.services.vector import DeviceRowBank
+
         self.fields = fields
         self.col = {f: i for i, f in enumerate(fields)}
-        self.rows: List[np.ndarray] = []
-        self._device = None  # jax array cache, invalidated on append
+        self._count = 0
+        self._bank = DeviceRowBank(len(fields)) if fields else None
+
+    def __len__(self) -> int:
+        return self._count
+
+    @property
+    def h2d_flushes(self) -> int:
+        return self._bank.h2d_flushes if self._bank is not None else 0
+
+    def _row(self, values: Dict[str, Any]) -> np.ndarray:
+        row = np.full(len(self.fields), np.nan, np.float32)
+        for f, v in values.items():
+            if f in self.col and v is not None:
+                try:
+                    row[self.col[f]] = float(v)
+                except (TypeError, ValueError):
+                    pass  # non-numeric value in a NUMERIC column: unindexed
+        return row
 
     def append(self, values: Dict[str, Any]) -> int:
-        row = np.full(len(self.fields), np.nan, np.float32)
-        for f, v in values.items():
-            if f in self.col and v is not None:
-                row[self.col[f]] = float(v)
-        self.rows.append(row)
-        self._device = None
-        return len(self.rows) - 1
+        rowid = self._count
+        self._count += 1
+        if self._bank is not None:
+            self._bank.set_row(rowid, self._row(values))
+        return rowid
 
     def replace(self, rowid: int, values: Dict[str, Any]) -> None:
-        row = np.full(len(self.fields), np.nan, np.float32)
-        for f, v in values.items():
-            if f in self.col and v is not None:
-                row[self.col[f]] = float(v)
-        self.rows[rowid] = row
-        self._device = None
+        if self._bank is not None:
+            self._bank.set_row(rowid, self._row(values))
 
     def clear_row(self, rowid: int) -> None:
-        self.rows[rowid] = np.full(len(self.fields), np.nan, np.float32)
-        self._device = None
+        # explicit NaN row (NOT the bank's zero-filled kill): NaN is the
+        # "unindexed" sentinel every range compare already treats as False
+        if self._bank is not None:
+            self._bank.set_row(
+                rowid, np.full(len(self.fields), np.nan, np.float32)
+            )
 
     def matrix(self):
         import jax.numpy as jnp
 
-        if self._device is None or self._device.shape[0] != len(self.rows):
-            host = (
-                np.stack(self.rows)
-                if self.rows
-                else np.zeros((0, len(self.fields)), np.float32)
-            )
-            self._device = jnp.asarray(host)
-        return self._device
+        if self._bank is None:
+            return jnp.zeros((0, 0), jnp.float32)
+        bank, _bias, rows = self._bank.device_planes()
+        if bank is None:
+            return jnp.zeros((0, len(self.fields)), jnp.float32)
+        return bank[:rows]
 
     def range_mask(self, cond: Range) -> np.ndarray:
         """One vectorized compare over all docs on device."""
@@ -168,7 +189,7 @@ class _NumericPlane:
 
         m = self.matrix()
         if m.shape[0] == 0 or cond.field not in self.col:
-            return np.zeros(len(self.rows), bool)
+            return np.zeros(self._count, bool)
         colv = m[:, self.col[cond.field]]
         lo_ok = colv >= cond.lo if cond.lo_inc else colv > cond.lo
         hi_ok = colv <= cond.hi if cond.hi_inc else colv < cond.hi
@@ -185,10 +206,26 @@ class SearchIndex:
         schema: Dict[str, str],
         prefixes: Sequence[str] = ("",),
         doc_mode: str = "entry",
+        engine=None,
+        vector_specs: Optional[Dict[str, Any]] = None,
     ):
         self.name = name
         self.schema = dict(schema)
         self.prefixes = list(prefixes)
+        # device-resident embedding banks (FT VECTOR fields, ISSUE 11):
+        # rowids shared with the numeric plane, banks record-backed so they
+        # place/rebalance/tear down like every other record.  Requires the
+        # engine; an engine-less index (unit-test construction) refuses
+        # VECTOR fields rather than silently indexing nothing.
+        self.vector_specs = dict(vector_specs or {})
+        if self.vector_specs and engine is None:
+            raise ValueError("VECTOR fields need an engine-bound index")
+        if engine is not None and self.vector_specs:
+            from redisson_tpu.services.vector import VectorPlane
+
+            self.vectors = VectorPlane(engine, name, self.vector_specs)
+        else:
+            self.vectors = None
         # document model for auto-ingestion (SearchService.sync):
         #   "entry" — one doc per dict-valued map ENTRY, id "{map}:{key}"
         #             (the embedded facade's historical model)
@@ -241,13 +278,16 @@ class SearchIndex:
                 self._unindex(doc_id)
                 self.docs[doc_id] = dict(fields)
                 self._index_inverted(doc_id, fields)
-                self._numeric.replace(self._rowid[doc_id], fields)
+                row = self._rowid[doc_id]
+                self._numeric.replace(row, fields)
             else:
                 self.docs[doc_id] = dict(fields)
                 self._index_inverted(doc_id, fields)
                 row = self._numeric.append(fields)
                 self._rowid[doc_id] = row
                 self._rowdoc.append(doc_id)
+            if self.vectors:
+                self.vectors.set_row(row, fields)
 
     def remove(self, doc_id: str) -> bool:
         with self._lock:
@@ -258,6 +298,8 @@ class SearchIndex:
             row = self._rowid.pop(doc_id)
             self._rowdoc[row] = None
             self._numeric.clear_row(row)
+            if self.vectors:
+                self.vectors.clear_row(row)
             return True
 
     def _index_inverted(self, doc_id: str, fields: Dict[str, Any]) -> None:
@@ -403,17 +445,41 @@ class SearchService:
 
     # -- FT.CREATE / DROPINDEX / _LIST ---------------------------------------
 
+    @staticmethod
+    def _vector_specs(schema: Dict[str, str], vector) -> Dict[str, Any]:
+        """Normalize the `vector` argument ({field: VectorFieldSpec | spec
+        kwargs}) and cross-check it against the schema's VECTOR fields."""
+        from redisson_tpu.services.vector import VectorFieldSpec
+
+        specs: Dict[str, Any] = {}
+        for f, spec in (vector or {}).items():
+            if not isinstance(spec, VectorFieldSpec):
+                spec = VectorFieldSpec(field=f, **dict(spec))
+            specs[f] = spec
+        declared = {f for f, t in schema.items() if t == FieldType.VECTOR}
+        if declared != set(specs):
+            raise ValueError(
+                f"VECTOR schema fields {sorted(declared)} need matching "
+                f"vector specs (got {sorted(specs)})"
+            )
+        return specs
+
     def create_index(
         self,
         name: str,
         schema: Dict[str, str],
         prefixes: Sequence[str] = ("",),
         doc_mode: str = "entry",
+        vector: Optional[Dict[str, Any]] = None,
     ) -> SearchIndex:
+        specs = self._vector_specs(schema, vector)
         with self._lock:
             if name in self._indexes:
                 raise ValueError(f"index '{name}' already exists")
-            idx = SearchIndex(name, schema, prefixes, doc_mode)
+            idx = SearchIndex(
+                name, schema, prefixes, doc_mode,
+                engine=self._engine, vector_specs=specs,
+            )
             self._indexes[name] = idx
         self.sync(name)
         return idx
@@ -424,15 +490,22 @@ class SearchService:
         schema: Dict[str, str],
         prefixes: Sequence[str] = ("",),
         doc_mode: str = "entry",
+        vector: Optional[Dict[str, Any]] = None,
     ) -> bool:
         """Wire-friendly FT.CREATE (returns a plain bool so it survives the
         OBJCALL pickle boundary; `create_index` returns the live index)."""
-        self.create_index(name, schema, prefixes, doc_mode)
+        self.create_index(name, schema, prefixes, doc_mode, vector=vector)
         return True
 
     def drop_index(self, name: str) -> bool:
         with self._lock:
-            return self._indexes.pop(name, None) is not None
+            idx = self._indexes.pop(name, None)
+        if idx is not None and idx.vectors:
+            # bank records leave the store with the index — device memory is
+            # released through the ordinary teardown path, so the census's
+            # ftvec gauges return to baseline (the HBM-ledger brick)
+            idx.vectors.drop()
+        return idx is not None
 
     def index_names(self) -> List[str]:
         with self._lock:
@@ -463,7 +536,10 @@ class SearchService:
             raise ValueError(f"field '{field}' already exists")
         schema = dict(old.schema)
         schema[field] = ftype
-        fresh = SearchIndex(old.name, schema, old.prefixes, old.doc_mode)
+        fresh = SearchIndex(
+            old.name, schema, old.prefixes, old.doc_mode,
+            engine=self._engine, vector_specs=old.vector_specs,
+        )
         with old._lock:
             for doc_id, fields in old.docs.items():
                 fresh.add(doc_id, fields)
@@ -588,12 +664,133 @@ class SearchService:
 
     def info(self, name: str) -> Dict[str, Any]:
         idx = self._idx(name)
-        return {
+        out = {
             "name": idx.name,
             "num_docs": len(idx),
             "schema": dict(idx.schema),
             "prefixes": list(idx.prefixes),
         }
+        if idx.vectors:
+            out["vector_fields"] = idx.vectors.info_rows()
+            out["vector_device_bytes"] = idx.vectors.device_bytes()
+        return out
+
+    def device_census(self) -> Dict[str, float]:
+        """Embedding-bank residency gauges — the first concrete brick of the
+        ROADMAP HBM-ledger item: per-process bank count + device bytes (and
+        per-index byte rows for FT.INFO).  Feeds MetricsRegistry gauges and
+        ResourceCensus rows; the vector soak asserts these return to
+        baseline after FT.DROPINDEX."""
+        with self._lock:
+            indexes = list(self._indexes.values())
+        banks = 0
+        total = 0
+        for idx in indexes:
+            if idx.vectors:
+                banks += len(idx.vectors.banks)
+                total += idx.vectors.device_bytes()
+        return {"ftvec_banks": float(banks), "ftvec_device_bytes": float(total)}
+
+    # -- tracking-plane integration (ISSUE 11) --------------------------------
+    #
+    # FT.* is keyless on the wire, so the generic key-based tracking hooks
+    # never see it.  A tracked FT.SEARCH registers the index's synthetic
+    # QUERY KEY instead, and the index's INGEST STREAM (writes landing under
+    # its prefixes, index DDL) invalidates that key — hot query results
+    # near-cache client-side and go stale the moment the index can change.
+
+    @staticmethod
+    def query_key(name: str) -> str:
+        return f"__ftq__:{name}"
+
+    def ingest_touched(self, written_names: Sequence[str]) -> List[str]:
+        """Query keys of every hash-mode index whose prefixes cover any of
+        the written key names (the write-side invalidation hook the server's
+        TrackingTable calls post-dispatch)."""
+        with self._lock:
+            indexes = list(self._indexes.items())
+        out = []
+        for name, idx in indexes:
+            if idx.doc_mode != "hash":
+                continue
+            if any(
+                n.startswith(p)
+                for p in idx.prefixes
+                for n in written_names
+            ):
+                out.append(self.query_key(name))
+        return out
+
+    # -- KNN (FT VECTOR, services/vector.py) ----------------------------------
+
+    def knn(self, index: str, field: str, queries, k: int,
+            condition: Optional[Condition] = None):
+        """One stacked FLAT KNN over the index's embedding bank.
+
+        Returns ``(device, finish)``: with the device plane armed, `device`
+        is the (dist, idx) kernel-output pair — the caller wraps it in a
+        LazyReply / ReadbackFuture and calls ``finish((dist, idx))`` with
+        the fetched host arrays; disarmed (RTPU_NO_VECTOR), `device` is
+        None and ``finish(None)`` scores on the NumPy path.  Either way
+        ``finish`` maps rows back to doc ids and returns one
+        ``[(doc_id, distance), ...]`` list per query (distance ascending,
+        ties toward the lower rowid)."""
+        from redisson_tpu.services import vector as V
+
+        idx = self._idx(index)
+        bank = idx.vectors.banks.get(field) if idx.vectors else None
+        if bank is None:
+            raise ValueError(f"'{field}' is not a VECTOR field of '{index}'")
+        q = np.ascontiguousarray(queries, np.float32).reshape(-1, bank.spec.dim)
+        nq = q.shape[0]
+        allowed = None
+        if condition is not None:
+            ids = idx._eval(condition)
+            with idx._lock:
+                allowed = np.fromiter(
+                    (idx._rowid[d] for d in ids if d in idx._rowid),
+                    np.int64,
+                )
+            if allowed.size == 0:
+                return None, lambda _vals: [[] for _ in range(nq)]
+        armed = V.vector_enabled()
+        out = (
+            bank.knn_async(q, k, allowed_rows=allowed)
+            if armed else None
+        )
+        if armed and out is None:
+            return None, lambda _vals: [[] for _ in range(nq)]
+
+        def finish(vals):
+            if vals is None:  # disarmed: score now, on host
+                host = bank.knn_host(q, k, allowed_rows=allowed)
+                if host is None:
+                    return [[] for _ in range(nq)]
+                dist_h, idx_h, _nq, k_eff = host
+            else:
+                dist_h, idx_h = np.asarray(vals[0]), np.asarray(vals[1])
+                k_eff = dist_h.shape[1]
+            res = []
+            for qi in range(nq):
+                row = []
+                for j in range(k_eff):
+                    d = float(dist_h[qi, j])
+                    if not np.isfinite(d):
+                        continue  # k exceeded the live rows: padding entry
+                    r = int(idx_h[qi, j])
+                    doc = (
+                        idx._rowdoc[r] if r < len(idx._rowdoc) else None
+                    )
+                    if doc is None:
+                        continue  # doc deleted between dispatch and fetch
+                    row.append((doc, d))
+                res.append(row)
+            return res
+
+        if not armed:
+            return None, finish
+        dist, ridx, _nq, _k_eff = out
+        return (dist, ridx), finish
 
     # -- document ingestion --------------------------------------------------
 
@@ -631,6 +828,13 @@ class SearchService:
                 fields = {}
                 for k, v in m.read_all_entry_set():
                     ks = k.decode() if isinstance(k, (bytes, bytearray)) else str(k)
+                    if idx.schema.get(ks) == FieldType.VECTOR:
+                        # raw float32 blob (the RediSearch HSET wire shape):
+                        # utf-8 decoding arbitrary vector bytes would throw
+                        fields[ks] = bytes(v) if isinstance(
+                            v, (bytes, bytearray)
+                        ) else v
+                        continue
                     vs = v.decode() if isinstance(v, (bytes, bytearray)) else v
                     if idx.schema.get(ks) == FieldType.NUMERIC:
                         try:

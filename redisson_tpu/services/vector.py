@@ -1,0 +1,560 @@
+"""Device-accelerated vector search: FT VECTOR fields + KNN banks (ISSUE 11).
+
+Parity target: RediSearch's ``FT.CREATE ... SCHEMA f VECTOR FLAT 6 TYPE
+FLOAT32 DIM d DISTANCE_METRIC {L2|COSINE|IP}`` and the ``(*)=>[KNN k @f $v]``
+query arm of FT.SEARCH (RedissonSearch.java drives the same verbs).  The
+reference scores every document per-query in the RediSearch C module; here an
+index's embeddings live as ONE device-resident ``(capacity, dim)`` float32
+bank and a FLAT KNN query is a single jitted matmul-(+norm)-top-k kernel
+(core/kernels.knn_topk) — the MXU replaces the per-doc loop, exactly the
+trade the numeric plane already made for range predicates.
+
+Bank layout (the bloom-bank discipline generalized to float rows):
+
+  * **Block-appended, never re-uploaded** — ingested rows buffer host-side
+    and flush to the device as ONE packed ``(P, dim+2)`` uint32 transfer
+    (row index + bias bits + bitcast row data) through the engine's
+    double-buffered staging pool; a stream of single-doc ingests costs
+    O(N/block) H2D transfers, not O(N) full-bank uploads (the
+    ``NumericTable.matrix()`` bug this module retires — ``_NumericPlane``
+    now rides the same ``DeviceRowBank``).
+  * **Capacity growth is an HBM copy** — the grown plane is zero-filled on
+    device and the old rows copy device-side (kernels.rowbank_grow); host
+    rows are never re-staged.
+  * **Record-backed, slot-placed** — each bank lives in a DeviceStore
+    record named ``__ftvec__{<index>}:<field>`` (the ``{hashtag}`` pins the
+    record to the INDEX's slot), so placement commits it to the slot-owner
+    device, fenced journaled device rebalances move it like any record, and
+    FT.DROPINDEX tears it down through the ordinary store path (census
+    flat).
+  * **Deletions are a bias, not a compaction** — every row carries an f32
+    bias (0 live, +inf dead) added into the distance row inside the kernel;
+    hybrid queries lower their host-side prefilter mask onto the score
+    matrix as one more additive bias operand.
+
+Results come back as demand-driven device handles: the server's FT verbs
+wrap (dist, idx) in a LazyReply so M concurrent KNN frames drain through the
+frame-grouped transfer (<= M+1 blocking syncs, the overlap-plane contract),
+and dispatch holds the owning device's lane gate so KNN occupancy is
+accounted like every other verb.
+
+Disarm with ``RTPU_NO_VECTOR=1`` / ``set_vector(False)``: scoring runs a
+pure-NumPy float32 path with the same formulas and the same stable
+tie-break, so replies are identical with the device path off (the A/B
+discipline of every plane in this repo).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import nullcontext
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# -- global switch (same discipline as ioplane.set_overlap) -------------------
+
+_vector = os.environ.get("RTPU_NO_VECTOR", "") not in ("1", "true", "yes")
+
+
+def vector_enabled() -> bool:
+    return _vector
+
+
+def set_vector(on: bool) -> bool:
+    """Flip the process-global device-KNN switch; returns the previous value
+    (callers restore it — the A/B discipline of bench.py config 7)."""
+    global _vector
+    prev = _vector
+    _vector = bool(on)
+    return prev
+
+
+VECTOR_METRICS = ("L2", "COSINE", "IP")
+DEFAULT_BLOCK = 256  # rows buffered per H2D flush (the O(N/block) contract)
+
+
+@dataclass
+class VectorFieldSpec:
+    """One FT VECTOR schema attribute (FLAT / FLOAT32 — the exact-scoring
+    subset; HNSW would change recall semantics, FLAT cannot)."""
+
+    field: str
+    dim: int
+    metric: str = "COSINE"
+    dtype: str = "FLOAT32"
+    algo: str = "FLAT"
+
+    def __post_init__(self):
+        self.metric = str(self.metric).upper()
+        self.algo = str(self.algo).upper()
+        self.dtype = str(self.dtype).upper()
+        if self.dim <= 0:
+            raise ValueError("vector DIM must be positive")
+        if self.metric not in VECTOR_METRICS:
+            raise ValueError(f"unsupported DISTANCE_METRIC '{self.metric}'")
+        if self.algo != "FLAT":
+            raise ValueError(f"unsupported vector algorithm '{self.algo}'")
+        if self.dtype != "FLOAT32":
+            raise ValueError(f"unsupported vector TYPE '{self.dtype}'")
+
+    def to_meta(self) -> Dict[str, Any]:
+        return {
+            "field": self.field, "dim": self.dim, "metric": self.metric,
+            "dtype": self.dtype, "algo": self.algo,
+        }
+
+
+def parse_vector_value(value, dim: int) -> Optional[np.ndarray]:
+    """Decode one document's vector field into a (dim,) float32 row.
+
+    Accepts the wire form (raw little-endian float32 bytes, the RediSearch
+    HSET blob) and host forms (sequence of floats / numpy array).  Returns
+    None for absent values; raises ValueError on a dimension mismatch."""
+    if value is None:
+        return None
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        buf = bytes(value)
+        if len(buf) != dim * 4:
+            raise ValueError(
+                f"vector blob is {len(buf)} bytes; DIM {dim} needs {dim * 4}"
+            )
+        return np.frombuffer(buf, dtype="<f4").astype(np.float32, copy=True)
+    arr = np.asarray(value, dtype=np.float32).reshape(-1)
+    if arr.shape[0] != dim:
+        raise ValueError(f"vector has {arr.shape[0]} dims; schema says {dim}")
+    return np.ascontiguousarray(arr)
+
+
+def bank_record_name(index: str, field: str) -> str:
+    """DeviceStore name of one index-field embedding bank.  The ``{index}``
+    hashtag maps the record to the INDEX's keyspace slot, so SlotPlacement
+    commits every bank of one index to that index's slot-owner device and
+    indexes shard across the local mesh like any record."""
+    return "__ftvec__{%s}:%s" % (index, field)
+
+
+def _query_bucket(n: int) -> int:
+    """Small pow2 bucket for stacked query counts (compile-cache bound)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class DeviceRowBank:
+    """Block-appended device-resident float32 row bank.
+
+    The shared substrate of the embedding banks AND the search service's
+    numeric plane: rows are addressed by the index's doc rowid, mutations
+    buffer host-side in ``_pending`` and flush as ONE packed upload +
+    ONE scatter kernel per block (kernels.rowbank_write_packed).  A host
+    mirror is kept alongside — it feeds the pure-NumPy disarmed path, the
+    recall oracle, and index rebuilds, and costs rows*width*4 host bytes.
+
+    This base class is STANDALONE (arrays held directly, default device) —
+    the engine-free binding ``_NumericPlane`` uses.  ``RecordRowBank``
+    overrides the plane seam to live inside a DeviceStore record."""
+
+    def __init__(self, width: int, block: int = DEFAULT_BLOCK):
+        self.width = int(width)
+        self.block = max(1, int(block))
+        self.rows = 0            # logical row count (max rowid + 1)
+        self._cap = 0            # device capacity (rows)
+        self._pending: Dict[int, Tuple[float, Optional[np.ndarray]]] = {}
+        self._lock = threading.RLock()
+        # host mirror (disarmed path / oracle): grown by doubling
+        self._host = np.zeros((0, self.width), np.float32)
+        self._host_bias = np.zeros((0,), np.float32)
+        # observability: the transfer discipline tests pin these
+        self.h2d_flushes = 0     # packed uploads (ONE per flush)
+        self.grows = 0           # device-side capacity copies
+        self.dispatches = 0      # scatter kernels dispatched
+
+    # -- plane seam (overridden by RecordRowBank) -----------------------------
+
+    def _get_planes(self):
+        return getattr(self, "_bank", None), getattr(self, "_bias", None)
+
+    def _set_planes(self, bank, bias) -> None:
+        self._bank, self._bias = bank, bias
+
+    def _target_device(self):
+        return None
+
+    def _staging_pool(self):
+        return None
+
+    def _record_guard(self):
+        """Mutual exclusion for device-plane mutation (record lock for the
+        store-backed binding; the bank's own lock already covers standalone)."""
+        return nullcontext()
+
+    # -- host-side mutation ---------------------------------------------------
+
+    def _mirror(self, rowid: int, bias: float, row: Optional[np.ndarray]) -> None:
+        if rowid >= self._host.shape[0]:
+            new_cap = max(self.block, self._host.shape[0] * 2)
+            while new_cap <= rowid:
+                new_cap *= 2
+            grown = np.zeros((new_cap, self.width), np.float32)
+            grown[: self._host.shape[0]] = self._host
+            self._host = grown
+            gbias = np.zeros((new_cap,), np.float32)
+            gbias[: self._host_bias.shape[0]] = self._host_bias
+            self._host_bias = gbias
+        self._host[rowid] = 0.0 if row is None else row
+        self._host_bias[rowid] = bias
+
+    def set_row(self, rowid: int, row: Optional[np.ndarray]) -> None:
+        """Install/overwrite one row.  ``row=None`` kills it: data goes to
+        zeros and bias to +inf, so the row can never reach a top-k (zeros,
+        not NaN — a NaN row would poison the whole distance column through
+        the matmul; callers that WANT NaN semantics, like the numeric
+        plane's cleared rows, pass an explicit NaN-filled row)."""
+        bias = np.float32(np.inf) if row is None else np.float32(0.0)
+        with self._lock:
+            self._mirror(rowid, float(bias), row)
+            self.rows = max(self.rows, rowid + 1)
+            self._pending[rowid] = (float(bias), row)
+            if vector_enabled() and len(self._pending) >= self.block:
+                self.flush_pending()
+
+    # -- device flush ---------------------------------------------------------
+
+    def _ensure_capacity_locked(self, needed: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from redisson_tpu.core import kernels as K
+
+        if needed <= self._cap:
+            return
+        new_cap = max(self.block, self._cap)
+        while new_cap < needed:
+            new_cap *= 2
+        device = self._target_device()
+        ctx = jax.default_device(device) if device is not None else nullcontext()
+        with ctx:
+            grown = jnp.zeros((new_cap, self.width), jnp.float32)
+            gbias = jnp.zeros((new_cap,), jnp.float32)
+        if device is not None:
+            grown = jax.device_put(grown, device)
+            gbias = jax.device_put(gbias, device)
+        bank, bias = self._get_planes()
+        if bank is not None and self._cap > 0:
+            grown, gbias = K.rowbank_grow(bank, bias, grown, gbias)
+            self.grows += 1
+        self._set_planes(grown, gbias)
+        self._cap = new_cap
+
+    def flush_pending(self) -> int:
+        """Drain the pending rows to the device: ONE packed H2D + ONE
+        scatter kernel regardless of how many rows accumulated.  Returns the
+        number of rows flushed."""
+        from redisson_tpu.core import kernels as K
+
+        with self._lock:
+            if not self._pending:
+                return 0
+            pending, self._pending = self._pending, {}
+            with self._record_guard():
+                self._ensure_capacity_locked(self.rows)
+                n = len(pending)
+                p = K.bucket_size(n, minimum=min(self.block, 256))
+                shape = (p, self.width + 2)
+                pool = self._staging_pool()
+                if pool is None:
+                    buf, slot = np.zeros(shape, np.uint32), None
+                else:
+                    buf, slot = pool.acquire(shape, np.uint32)
+                try:
+                    items = sorted(pending.items())
+                    idxs = np.fromiter(
+                        (r for r, _v in items), np.uint32, count=n
+                    )
+                    biasv = np.fromiter(
+                        (b for _r, (b, _row) in items), np.float32, count=n
+                    )
+                    rows = np.zeros((n, self.width), np.float32)
+                    for i, (_r, (_b, row)) in enumerate(items):
+                        if row is not None:
+                            rows[i] = row
+                    buf[:n, 0] = idxs
+                    buf[:n, 1] = biasv.view(np.uint32)
+                    buf[:n, 2:] = rows.view(np.uint32)
+                    staged = K.stage(buf)
+                except BaseException:
+                    if pool is not None:
+                        pool.release(slot)
+                    raise
+                if pool is not None:
+                    pool.commit(slot, staged)
+                bank, bias = self._get_planes()
+                bank, bias = K.rowbank_write_packed(
+                    bank, bias, staged, K.valid_n(n)
+                )
+                self._set_planes(bank, bias)
+                self.h2d_flushes += 1
+                self.dispatches += 1
+            return n
+
+    def device_planes(self) -> Tuple[Any, Any, int]:
+        """(bank, bias, rows) with every pending row flushed — the kernel
+        operand view.  bank is None while the bank has never filled."""
+        with self._lock:
+            self.flush_pending()
+            bank, bias = self._get_planes()
+            return bank, bias, self.rows
+
+    def host_planes(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(rows x width data, bias) host mirror — the disarmed scoring path
+        and the brute-force oracle's input."""
+        with self._lock:
+            return (
+                self._host[: self.rows].copy(),
+                self._host_bias[: self.rows].copy(),
+            )
+
+    def device_bytes(self) -> int:
+        bank, bias = self._get_planes()
+        total = 0
+        for a in (bank, bias):
+            if a is not None:
+                total += int(a.nbytes)
+        return total
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+
+class RecordRowBank(DeviceRowBank):
+    """DeviceRowBank whose planes live inside a DeviceStore StateRecord —
+    placement commits them to the slot-owner device at creation, fenced
+    journaled rebalances move them like any record, and deleting the record
+    (FT.DROPINDEX) releases the device memory through the ordinary store
+    teardown path."""
+
+    KIND = "vector_bank"
+
+    def __init__(self, engine, name: str, width: int,
+                 block: int = DEFAULT_BLOCK, meta: Optional[dict] = None,
+                 reset: bool = True):
+        super().__init__(width, block)
+        self._engine = engine
+        self.name = name
+        from redisson_tpu.core.store import StateRecord
+
+        with engine.locked(name):
+            if reset:
+                # index definitions are host-side (engine services), so a
+                # stale bank record from a dropped/rebuilt index must not
+                # leak rows into the fresh one
+                engine.store.delete_unguarded(name)
+            rec = engine.store.get_unguarded(name)
+            if rec is None:
+                engine.store.put_unguarded(
+                    name,
+                    StateRecord(
+                        kind=self.KIND,
+                        meta=dict(meta or {}, rows=0, width=width,
+                                  block=self.block),
+                        arrays={},
+                    ),
+                )
+
+    def _rec(self):
+        rec = self._engine.store.get_unguarded(self.name)
+        if rec is None:
+            raise KeyError(f"vector bank '{self.name}' was dropped")
+        return rec
+
+    def _get_planes(self):
+        arrays = self._rec().arrays
+        return arrays.get("bank"), arrays.get("bias")
+
+    def _set_planes(self, bank, bias) -> None:
+        rec = self._rec()
+        rec.arrays["bank"] = bank
+        rec.arrays["bias"] = bias
+        rec.meta["rows"] = self.rows
+        rec.version += 1
+
+    def _target_device(self):
+        from redisson_tpu.core.ioplane import device_of
+
+        bank, _bias = self._get_planes()
+        if bank is not None:
+            dev = device_of(bank)
+            if dev is not None:
+                return dev
+        return self._engine.device_for_name(self.name)
+
+    def _staging_pool(self):
+        return self._engine.staging_pool(self._target_device())
+
+    def _record_guard(self):
+        return self._engine.locked(self.name)
+
+    def drop(self) -> None:
+        with self._lock:
+            self._pending.clear()
+            self._engine.store.delete_unguarded(self.name)
+
+
+class EmbeddingBank(RecordRowBank):
+    """One index-field embedding bank + the KNN dispatch path."""
+
+    def __init__(self, engine, index: str, spec: VectorFieldSpec,
+                 block: int = DEFAULT_BLOCK, reset: bool = True):
+        self.spec = spec
+        super().__init__(
+            engine, bank_record_name(index, spec.field), spec.dim,
+            block=block, meta=dict(spec.to_meta(), index=index), reset=reset,
+        )
+
+    # -- scoring --------------------------------------------------------------
+
+    def _lane_gate(self, n_items: int):
+        """Hold the owning device's serving lane for the dispatch — KNN
+        occupancy is accounted per chip exactly like the whitelisted verbs
+        (ioplane.DeviceLane; a no-op without placement)."""
+        eng = self._engine
+        if eng.lanes is None:
+            return nullcontext()
+        device = self._target_device()
+        if device is None:
+            return nullcontext()
+        return eng.lanes.lane(device).occupy(n_items)
+
+    def knn_async(self, queries: np.ndarray, k: int,
+                  allowed_rows: Optional[np.ndarray] = None):
+        """Dispatch one stacked KNN: queries (Q, dim) float32 against every
+        live row.  Returns (device_dist, device_idx, q_count, k_eff) WITHOUT
+        forcing the readback — the server wraps it in a LazyReply so the
+        frame-grouped transfer drains it; embedded callers np.asarray().
+
+        ``allowed_rows`` (hybrid prefilter): int row ids that may score —
+        everything else gets +inf distance via a per-query bias operand.
+
+        Falls back to the host path (knn_host) when the device plane is
+        disarmed (RTPU_NO_VECTOR) — callers branch on vector_enabled()."""
+        import jax
+
+        from redisson_tpu.core import kernels as K
+
+        q = np.ascontiguousarray(queries, np.float32).reshape(-1, self.width)
+        nq = q.shape[0]
+        with self._lock:
+            bank, bias, rows = self.device_planes()
+            if bank is None or rows == 0:
+                return None
+            k_eff = max(1, min(int(k), self._cap))
+            qb = _query_bucket(nq)
+            qpad = q if qb == nq else np.concatenate(
+                [q, np.zeros((qb - nq, self.width), np.float32)]
+            )
+            staged = K.stage(qpad)
+            with self._lane_gate(nq * max(1, rows)):
+                if allowed_rows is None:
+                    dist, idx = K.knn_topk(
+                        bank, bias, staged, K.valid_n(rows), k_eff,
+                        self.spec.metric,
+                    )
+                else:
+                    qbias = np.full((qb, self._cap), np.inf, np.float32)
+                    qbias[:, np.asarray(allowed_rows, np.int64)] = 0.0
+                    dist, idx = K.knn_topk_masked(
+                        bank, bias, K.stage(qbias), staged,
+                        K.valid_n(rows), k_eff, self.spec.metric,
+                    )
+        return dist, idx, nq, k_eff
+
+    def knn_host(self, queries: np.ndarray, k: int,
+                 allowed_rows: Optional[np.ndarray] = None):
+        """Pure-NumPy KNN (the RTPU_NO_VECTOR reference): same float32
+        formulas, same +inf bias discipline, same stable lowest-index
+        tie-break as the kernel — replies must be identical."""
+        q = np.ascontiguousarray(queries, np.float32).reshape(-1, self.width)
+        host, hbias = self.host_planes()
+        rows = host.shape[0]
+        if rows == 0:
+            return None
+        dots = q @ host.T  # (Q, rows) f32
+        metric = self.spec.metric
+        if metric == "L2":
+            q_sq = np.sum(q * q, axis=1, dtype=np.float32)
+            b_sq = np.sum(host * host, axis=1, dtype=np.float32)
+            dist = q_sq[:, None] - 2.0 * dots + b_sq[None, :]
+        elif metric == "COSINE":
+            qn = np.sqrt(np.sum(q * q, axis=1, dtype=np.float32))
+            bn = np.sqrt(np.sum(host * host, axis=1, dtype=np.float32))
+            denom = qn[:, None] * bn[None, :]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                cos = np.where(denom > 0.0, dots / denom, 0.0)
+            dist = (1.0 - cos).astype(np.float32)
+        else:  # IP
+            dist = (1.0 - dots).astype(np.float32)
+        dist = dist + hbias[None, :]
+        if allowed_rows is not None:
+            mask = np.full(rows, np.inf, np.float32)
+            mask[np.asarray(allowed_rows, np.int64)] = 0.0
+            dist = dist + mask[None, :]
+        k_eff = max(1, min(int(k), rows))
+        order = np.argsort(dist, axis=1, kind="stable")[:, :k_eff]
+        top = np.take_along_axis(dist, order, axis=1)
+        return top.astype(np.float32), order.astype(np.int32), q.shape[0], k_eff
+
+
+class VectorPlane:
+    """Per-index vector fields: field -> EmbeddingBank sharing the index's
+    doc rowid space (the numeric plane's row discipline)."""
+
+    def __init__(self, engine, index: str,
+                 specs: Dict[str, VectorFieldSpec],
+                 block: int = DEFAULT_BLOCK, reset: bool = True):
+        self.index = index
+        self.banks: Dict[str, EmbeddingBank] = {
+            f: EmbeddingBank(engine, index, spec, block=block, reset=reset)
+            for f, spec in specs.items()
+        }
+
+    def __bool__(self) -> bool:
+        return bool(self.banks)
+
+    def set_row(self, rowid: int, fields: Dict[str, Any]) -> None:
+        for f, bank in self.banks.items():
+            try:
+                row = parse_vector_value(fields.get(f), bank.spec.dim)
+            except ValueError:
+                # malformed blob in an auto-ingested hash: the doc stays
+                # text/tag/numeric-searchable, just never KNN-visible (the
+                # RediSearch failed-attribute discipline)
+                row = None
+            bank.set_row(rowid, row)
+
+    def clear_row(self, rowid: int) -> None:
+        for bank in self.banks.values():
+            bank.set_row(rowid, None)
+
+    def drop(self) -> None:
+        for bank in self.banks.values():
+            bank.drop()
+
+    def device_bytes(self) -> int:
+        return sum(b.device_bytes() for b in self.banks.values())
+
+    def h2d_flushes(self) -> int:
+        return sum(b.h2d_flushes for b in self.banks.values())
+
+    def info_rows(self) -> List[Dict[str, Any]]:
+        out = []
+        for f, b in self.banks.items():
+            out.append({
+                "field": f, "dim": b.spec.dim, "metric": b.spec.metric,
+                "algo": b.spec.algo, "dtype": b.spec.dtype,
+                "rows": b.rows, "device_bytes": b.device_bytes(),
+            })
+        return out

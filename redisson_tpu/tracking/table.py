@@ -258,7 +258,27 @@ class TrackingTable:
             return
         keys = C.command_keys(name, args)
         if keys:
-            self.note_write([self._kname(k) for k in keys], ctx)
+            names = [self._kname(k) for k in keys]
+            self.note_write(names, ctx)
+            self._note_search_ingest(names)
+
+    def _note_search_ingest(self, names: List[str]) -> None:
+        """A write under a search index's prefixes is that index's INGEST
+        STREAM: invalidate the index's synthetic query key so tracked
+        FT.SEARCH results (near-cached KNN hits) never serve stale (ISSUE
+        11).  Writer NOLOOP is deliberately NOT honored — the writer's own
+        cached query results are just as stale as anyone's.  Runs only when
+        tracking is active (post_dispatch already gated) and only if the
+        search service exists."""
+        svc = self._server.engine._services.get("search")
+        if svc is None:
+            return
+        try:
+            qkeys = svc.ingest_touched(names)
+        except Exception:  # noqa: BLE001 — instrumentation must not fail writes
+            return
+        if qkeys:
+            self.note_write(qkeys, None)
 
     @staticmethod
     def _kname(k) -> str:
@@ -392,8 +412,13 @@ class TrackingTable:
             self._deliver({vc: [victim] for vc in vcids})
 
     def note_expired(self, names: List[str]) -> None:
-        """TTL reaper / lazy-expiry hook (DeviceStore.on_expired)."""
-        self.note_write(list(names), None)
+        """TTL reaper / lazy-expiry hook (DeviceStore.on_expired).  An
+        expiring hash under a search index's prefixes is ingest-stream
+        churn too (sync() prunes the doc), so the index query key
+        invalidates exactly like a DEL's would."""
+        names = list(names)
+        self.note_write(names, None)
+        self._note_search_ingest(names)
 
     def note_objcall_ops(self, ops, writer_ctx=None) -> None:
         """OBJCALLM / OBJCALLMA / TXEXEC frames are keyless on the wire —
@@ -404,6 +429,7 @@ class TrackingTable:
         ]
         if names:
             self.note_write(names, writer_ctx)
+            self._note_search_ingest(names)
 
     def invalidate_all(self, writer_ctx=None) -> None:
         """FLUSHALL discipline: one null-payload invalidate per tracking

@@ -131,3 +131,24 @@ def test_device_shard_soak_rebalance_under_traffic():
     assert report.host_colocations == 0
     assert report.writes_acked > 0 and report.reads > 0
     assert report.bloom_keys_verified > 0
+
+
+# -- vector-search soak (ISSUE 11) ---------------------------------------------
+
+
+@pytest.mark.slow
+def test_vector_soak_knn_under_rebalance_and_ingest():
+    """The ISSUE 11 soak acceptance: KNN readers with tracked near-cached
+    query results + concurrent HSET ingest while the index's slots (and the
+    embedding-bank record with them) rebalance 8 -> 4 -> 8 across devices
+    under transport faults — zero stale tracked results, recall floor holds
+    post-storm, bank census flat after FT.DROPINDEX."""
+    from redisson_tpu.chaos.soak import VectorSoakConfig, VectorSoakHarness
+
+    report = VectorSoakHarness(VectorSoakConfig(cycles=2, seed=3)).run()
+    assert report.cycles_completed == 2
+    assert report.rebalances == 4              # 8->4 and 4->8, twice
+    assert report.stale_results == 0
+    assert report.recall_at_k >= 0.99
+    assert report.invalidations > 0            # the ingest stream was seen
+    assert report.writes_acked > 0 and report.reads > 0

@@ -1,0 +1,570 @@
+"""Device-accelerated vector search (ISSUE 11): FT VECTOR fields, embedding
+banks, jitted KNN matmul-top-k, wire grammar, cursors, tracking, census.
+
+Contracts pinned here:
+  * armed (device) and disarmed (RTPU_NO_VECTOR NumPy) paths return
+    IDENTICAL wire replies (fixed-precision scores, same tie-break);
+  * KNN is exact vs a brute-force oracle (FLAT scoring);
+  * ingesting N docs one-by-one costs O(N/block) H2D transfers — for the
+    embedding bank AND the numeric plane (the retired O(docs) re-upload);
+  * M concurrent KNN frames cost <= M+1 blocking syncs with reply FIFO
+    preserved (the per-device lane + readback-future planes);
+  * FT.CURSOR expiry + cap pruning, and KNN WITHCURSOR paging;
+  * the index ingest stream invalidates tracked query results;
+  * FT.INFO / metrics / census report bank residency, and FT.DROPINDEX
+    returns the gauges to zero.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.core.engine import Engine
+from redisson_tpu.net.client import Connection
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.server import ServerThread
+from redisson_tpu.services import vector as V
+from redisson_tpu.services.search import FieldType, Range, SearchService
+
+
+@pytest.fixture()
+def svc():
+    return SearchService(Engine())
+
+
+@pytest.fixture()
+def server():
+    with ServerThread(port=0, workers=4) as st:
+        yield st
+
+
+def _conn(st, handler=None):
+    c = Connection(st.server.host, st.server.port, timeout=30.0)
+    if handler is not None:
+        c.push_handler = handler
+    return c
+
+
+def _mk_index(svc, name="vi", n=40, dim=8, metric="L2", seed=0, prefix=None):
+    svc.create_index(
+        name, {"price": "NUMERIC", "emb": "VECTOR"},
+        prefixes=(prefix,) if prefix else ("",),
+        vector={"emb": {"dim": dim, "metric": metric}},
+    )
+    rng = np.random.default_rng(seed)
+    vecs = rng.standard_normal((n, dim)).astype(np.float32)
+    for i in range(n):
+        svc.add_document(name, f"d{i}", {"price": i, "emb": vecs[i]})
+    return vecs
+
+
+def _force(dev, finish):
+    if dev is None:
+        return finish(None)
+    return finish(tuple(np.asarray(v) for v in dev))
+
+
+# -- embedded service ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("metric", ["L2", "COSINE", "IP"])
+def test_knn_exact_vs_bruteforce(svc, metric):
+    vecs = _mk_index(svc, metric=metric, n=60, dim=12, seed=3)
+    rng = np.random.default_rng(7)
+    q = rng.standard_normal(12).astype(np.float32)
+    res = _force(*svc.knn("vi", "emb", q, 10))[0]
+    q32, v32 = q.astype(np.float32), vecs.astype(np.float32)
+    dots = v32 @ q32
+    if metric == "L2":
+        dist = np.sum((v32 - q32[None, :]) ** 2, axis=1)
+    elif metric == "COSINE":
+        dist = 1 - dots / (np.linalg.norm(v32, axis=1) * np.linalg.norm(q32))
+    else:
+        dist = 1 - dots
+    truth = [f"d{i}" for i in np.argsort(dist, kind="stable")[:10]]
+    assert [d for d, _s in res] == truth
+
+
+def test_armed_disarmed_identical_ordering(svc):
+    _mk_index(svc, n=50, dim=16, metric="COSINE", seed=5)
+    q = np.random.default_rng(9).standard_normal(16).astype(np.float32)
+    armed = _force(*svc.knn("vi", "emb", q, 8))
+    prev = V.set_vector(False)
+    try:
+        dev, fin = svc.knn("vi", "emb", q, 8)
+        assert dev is None
+        disarmed = fin(None)
+    finally:
+        V.set_vector(prev)
+    assert [d for d, _s in armed[0]] == [d for d, _s in disarmed[0]]
+    for (_, a), (_, b) in zip(armed[0], disarmed[0]):
+        assert abs(a - b) < 1e-4
+
+
+def test_hybrid_prefilter_masks_scores(svc):
+    _mk_index(svc, n=40, dim=8, seed=1)
+    q = np.random.default_rng(2).standard_normal(8).astype(np.float32)
+    res = _force(*svc.knn("vi", "emb", q, 10, condition=Range("price", hi=9.5)))[0]
+    assert res and all(int(d[1:]) <= 9 for d, _s in res)
+    # empty prefilter -> empty result, no dispatch
+    dev, fin = svc.knn("vi", "emb", q, 5, condition=Range("price", lo=1e9))
+    assert dev is None and fin(None) == [[]]
+
+
+def test_update_and_delete_move_vectors(svc):
+    vecs = _mk_index(svc, n=20, dim=8, seed=4)
+    target = vecs[3] + 0.001
+    top = _force(*svc.knn("vi", "emb", target, 1))[0]
+    assert top[0][0] == "d3"
+    # overwrite d3's embedding far away: it must stop winning
+    svc.add_document("vi", "d3", {"price": 3, "emb": vecs[3] + 100.0})
+    top = _force(*svc.knn("vi", "emb", target, 1))[0]
+    assert top[0][0] != "d3"
+    # delete the new winner: it must vanish from results
+    winner = top[0][0]
+    svc.remove_document("vi", winner)
+    res = _force(*svc.knn("vi", "emb", target, 20))[0]
+    assert winner not in [d for d, _s in res]
+
+
+def test_vector_schema_validation(svc):
+    with pytest.raises(ValueError):
+        svc.create_index("bad", {"emb": "VECTOR"},
+                         vector={"emb": {"dim": 4, "metric": "HAMMING"}})
+    with pytest.raises(ValueError):
+        svc.create_index("bad2", {"emb": "VECTOR"}, vector={})
+    with pytest.raises(ValueError):
+        svc.create_index("bad3", {"emb": "VECTOR"},
+                         vector={"emb": {"dim": 0}})
+    # malformed blobs index as dead rows, doc stays searchable
+    svc.create_index("ok", {"t": "TEXT", "emb": "VECTOR"},
+                     vector={"emb": {"dim": 4}})
+    svc.add_document("ok", "d0", {"t": "hello", "emb": b"tooshort"})
+    assert svc.search("ok", None).total == 1
+    q = np.ones(4, np.float32)
+    dev, fin = svc.knn("ok", "emb", q, 3)
+    assert _force(dev, fin)[0] == []
+
+
+def test_block_append_transfer_counts(svc):
+    """N single-doc ingests -> O(N/block) uploads, never O(N) re-uploads."""
+    from redisson_tpu.services.vector import DEFAULT_BLOCK
+
+    svc.create_index("tb", {"price": "NUMERIC", "emb": "VECTOR"},
+                     vector={"emb": {"dim": 4}})
+    idx = svc._idx("tb")
+    n = DEFAULT_BLOCK * 3 + 17
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        svc.add_document("tb", f"d{i}", {
+            "price": i, "emb": rng.standard_normal(4).astype(np.float32)
+        })
+    bank = idx.vectors.banks["emb"]
+    assert bank.h2d_flushes == 3, bank.h2d_flushes  # full blocks only
+    # a query flushes the pending tail (one more upload), then scores
+    _force(*svc.knn("tb", "emb", np.ones(4, np.float32), 5))
+    assert bank.h2d_flushes == 4
+    # numeric plane rides the same discipline (the retired O(docs) path)
+    assert idx._numeric.h2d_flushes <= 4, idx._numeric.h2d_flushes
+    ids = idx._eval(Range("price", lo=n - 10))
+    assert len(ids) == 10
+    assert idx._numeric.h2d_flushes <= 5
+
+
+def test_numeric_plane_incremental_and_correct(svc):
+    svc.create_index("np1", {"x": "NUMERIC"})
+    for i in range(10):
+        svc.add_document("np1", f"d{i}", {"x": i})
+    assert {f"d{i}" for i in range(3, 7)} == svc._idx("np1")._eval(
+        Range("x", lo=3, hi=6)
+    )
+    # replace + clear keep NaN semantics
+    svc.add_document("np1", "d4", {"x": None})
+    svc.remove_document("np1", "d5")
+    assert svc._idx("np1")._eval(Range("x", lo=3, hi=6)) == {"d3", "d6"}
+
+
+def test_alter_preserves_vector_fields(svc):
+    _mk_index(svc, n=10, dim=8, seed=6)
+    svc.alter("vi", "tag", "TAG")
+    assert svc._idx("vi").schema["tag"] == "TAG"
+    q = np.random.default_rng(1).standard_normal(8).astype(np.float32)
+    res = _force(*svc.knn("vi", "emb", q, 3))[0]
+    assert len(res) == 3
+
+
+def test_bank_record_placed_and_census(svc):
+    _mk_index(svc, n=8, dim=8)
+    eng = svc._engine
+    rec = eng.store.get(V.bank_record_name("vi", "emb"))
+    assert rec is not None and rec.kind == "vector_bank"
+    census = svc.device_census()
+    assert census["ftvec_banks"] == 1.0
+    # 8 docs sit in the pending block — honestly zero device bytes until
+    # the first flush (a query forces it)
+    assert census["ftvec_device_bytes"] == 0.0
+    _force(*svc.knn("vi", "emb", np.ones(8, np.float32), 2))
+    census = svc.device_census()
+    assert census["ftvec_device_bytes"] > 0
+    assert svc.drop_index("vi")
+    assert eng.store.get(V.bank_record_name("vi", "emb")) is None
+    assert svc.device_census() == {
+        "ftvec_banks": 0.0, "ftvec_device_bytes": 0.0
+    }
+
+
+# -- FT.CURSOR expiry + cap (satellite: services/search.py:393-402) -----------
+
+
+def test_cursor_ttl_expiry(svc):
+    svc.CURSOR_TTL = 0.05
+    cid = svc.cursor_create([[b"a"], [b"b"], [b"c"]])
+    rows, nxt = svc.cursor_read(cid, 1)
+    assert rows == [[b"a"]] and nxt == cid
+    time.sleep(0.12)
+    with pytest.raises(KeyError):
+        svc.cursor_read(cid, 1)  # pruned by idle TTL
+
+
+def test_cursor_cap_prunes_oldest(svc):
+    svc.CURSOR_MAX = 3
+    cids = [svc.cursor_create([[b"r%d" % i]]) for i in range(5)]
+    # the two oldest ids were pruned by the cap
+    for dead in cids[:2]:
+        with pytest.raises(KeyError):
+            svc.cursor_read(dead, 1)
+    for live in cids[2:]:
+        rows, nxt = svc.cursor_read(live, 10)
+        assert nxt == 0 and rows
+
+
+def test_cursor_read_refreshes_deadline(svc):
+    svc.CURSOR_TTL = 0.15
+    cid = svc.cursor_create([[b"a"], [b"b"], [b"c"]])
+    for _ in range(3):
+        time.sleep(0.08)
+        _rows, cid2 = svc.cursor_read(cid, 1)
+        if cid2 == 0:
+            break
+        assert cid2 == cid  # read refreshed the idle deadline each time
+
+
+# -- wire surface -------------------------------------------------------------
+
+
+def _wire_setup(c, n=24, dim=8, prefix="vd:", idx="vwire", seed=11):
+    r = c.execute(
+        "FT.CREATE", idx, "ON", "HASH", "PREFIX", "1", prefix,
+        "SCHEMA", "price", "NUMERIC",
+        "emb", "VECTOR", "FLAT", "6", "TYPE", "FLOAT32",
+        "DIM", str(dim), "DISTANCE_METRIC", "L2",
+    )
+    assert r == b"OK", r
+    rng = np.random.default_rng(seed)
+    vecs = rng.standard_normal((n, dim)).astype(np.float32)
+    for i in range(n):
+        c.execute("HSET", f"{prefix}{i}", "price", str(i),
+                  "emb", vecs[i].tobytes())
+    return vecs
+
+
+def test_wire_knn_reply_shape_and_limit(server):
+    c = _conn(server)
+    vecs = _wire_setup(c)
+    q = (vecs[5] + 0.01).astype(np.float32)
+    out = c.execute("FT.SEARCH", "vwire", "(*)=>[KNN 6 @emb $v]",
+                    "PARAMS", "2", "v", q.tobytes(), "DIALECT", "2")
+    assert out[0] == 6 and bytes(out[1]) == b"vd:5"
+    flat = out[2]
+    assert bytes(flat[-2]) == b"__emb_score"
+    float(flat[-1])  # parseable 4-decimal distance
+    # LIMIT pages within the k hits, total stays k
+    lim = c.execute("FT.SEARCH", "vwire", "(*)=>[KNN 6 @emb $v]",
+                    "PARAMS", "2", "v", q.tobytes(), "LIMIT", "2", "2")
+    assert lim[0] == 6 and len(lim) == 1 + 2 * 2
+    assert bytes(lim[1]) != bytes(out[1])  # offset skipped the best hits
+    # NOCONTENT keeps ids + scores only
+    nc = c.execute("FT.SEARCH", "vwire", "(*)=>[KNN 2 @emb $v]",
+                   "PARAMS", "2", "v", q.tobytes(), "NOCONTENT")
+    assert nc[0] == 2 and bytes(nc[2][0]) == b"__emb_score"
+    c.close()
+
+
+def test_wire_armed_vs_disarmed_identical(server):
+    c = _conn(server)
+    vecs = _wire_setup(c, idx="vab", prefix="va:", seed=23)
+    q = np.random.default_rng(1).standard_normal(8).astype(np.float32)
+    args = ("FT.SEARCH", "vab", "(@price:[3 20])=>[KNN 5 @emb $v]",
+            "PARAMS", "2", "v", q.tobytes())
+    armed = c.execute(*args)
+    prev = V.set_vector(False)
+    try:
+        disarmed = c.execute(*args)
+    finally:
+        V.set_vector(prev)
+    assert armed == disarmed  # byte-identical wire reply, device path off
+
+
+def test_wire_msearch_batched(server):
+    c = _conn(server)
+    vecs = _wire_setup(c, idx="vm", prefix="vm:", seed=31)
+    blob = np.concatenate([vecs[3], vecs[17]]).astype(np.float32).tobytes()
+    out = c.execute("FT.MSEARCH", "vm", "(*)=>[KNN 3 @emb $v]",
+                    "PARAMS", "2", "v", blob)
+    assert out[0] == 2
+    assert bytes(out[1][0]) == b"vm:3" and bytes(out[2][0]) == b"vm:17"
+    assert len(out[1]) == 6  # 3 hits x (id, score)
+    c.close()
+
+
+def test_wire_knn_withcursor_pages(server):
+    c = _conn(server)
+    vecs = _wire_setup(c, idx="vc", prefix="vc:", n=30, seed=41)
+    q = vecs[0]
+    batch, cid = c.execute(
+        "FT.SEARCH", "vc", "(*)=>[KNN 12 @emb $v]",
+        "PARAMS", "2", "v", q.tobytes(), "WITHCURSOR", "COUNT", "5",
+    )
+    assert batch[0] == 5 and cid != 0
+    seen = [bytes(row[0]) for row in batch[1:]]
+    while cid:
+        rows, cid = c.execute("FT.CURSOR", "READ", "vc", str(cid),
+                              "COUNT", "5")
+        seen += [bytes(row[0]) for row in rows[1:]]
+    assert len(seen) == 12 and len(set(seen)) == 12
+    assert seen[0] == b"vc:0"  # distance order preserved across pages
+    # DEL on a fresh cursor
+    _b, cid2 = c.execute("FT.SEARCH", "vc", "(*)=>[KNN 12 @emb $v]",
+                         "PARAMS", "2", "v", q.tobytes(),
+                         "WITHCURSOR", "COUNT", "3")
+    assert c.execute("FT.CURSOR", "DEL", "vc", str(cid2)) == b"OK"
+    r = c.execute("FT.CURSOR", "READ", "vc", str(cid2))
+    assert isinstance(r, RespError)
+    c.close()
+
+
+def test_wire_knn_errors(server):
+    c = _conn(server)
+    _wire_setup(c, idx="ve", prefix="ve:")
+    q = np.ones(8, np.float32).tobytes()
+    r = c.execute("FT.SEARCH", "ve", "(*)=>[KNN 5 @emb $missing]",
+                  "PARAMS", "2", "v", q)
+    assert isinstance(r, RespError) and "missing" in str(r)
+    r = c.execute("FT.SEARCH", "ve", "(*)=>[KNN 5 @emb $v]",
+                  "PARAMS", "2", "v", b"\x00" * 10)
+    assert isinstance(r, RespError)
+    r = c.execute("FT.SEARCH", "ve", "(*)=>[KNN 5 @price $v]",
+                  "PARAMS", "2", "v", q)
+    assert isinstance(r, RespError) and "VECTOR" in str(r)
+    r = c.execute("FT.SEARCH", "ve", "(*)=>[KNN 0 @emb $v]",
+                  "PARAMS", "2", "v", q)
+    assert isinstance(r, RespError)
+    r = c.execute("FT.MSEARCH", "ve", "*")
+    assert isinstance(r, RespError) and "KNN" in str(r)
+    c.close()
+
+
+def test_wire_ft_info_and_gauges(server):
+    c = _conn(server)
+    _wire_setup(c, idx="vinfo", prefix="vi:", n=10)
+    c.execute("FT.SEARCH", "vinfo", "(*)=>[KNN 1 @emb $v]",
+              "PARAMS", "2", "v", np.ones(8, np.float32).tobytes())
+    info = c.execute("FT.INFO", "vinfo")
+    d = {bytes(info[i]): info[i + 1] for i in range(0, len(info), 2)}
+    assert d[b"vector_device_bytes"] > 0
+    attr = [row for row in d[b"attributes"] if bytes(row[0]) == b"emb"][0]
+    a = {bytes(attr[i]): attr[i + 1] for i in range(1, len(attr), 2)}
+    assert a[b"type"] == b"VECTOR" and a[b"dim"] == 8
+    assert a[b"distance_metric"] == b"L2" and a[b"rows"] == 10
+    assert a[b"device_bytes"] > 0
+    # metrics gauges + census rows live, and DROPINDEX zeroes them
+    mets = server.server.metrics.snapshot()
+    assert mets["ftvec_banks"] == 1.0 and mets["ftvec_device_bytes"] > 0
+    from redisson_tpu.chaos.census import ResourceCensus
+
+    census = ResourceCensus()
+    census.track_server("srv", server.server)
+    assert census.snapshot()["srv.ftvec_banks"] == 1.0
+    assert c.execute("FT.DROPINDEX", "vinfo") == b"OK"
+    assert server.server.metrics.snapshot()["ftvec_banks"] == 0.0
+    assert census.snapshot()["srv.ftvec_device_bytes"] == 0.0
+    c.close()
+
+
+def test_ingest_stream_invalidates_tracked_queries(server):
+    pushes = []
+    t = _conn(server, handler=pushes.append)
+    w = _conn(server)
+    _wire_setup(w, idx="vt", prefix="vt:", n=8)
+    t.execute("CLIENT", "TRACKING", "ON")
+    q = np.ones(8, np.float32).tobytes()
+    t.execute("FT.SEARCH", "vt", "(*)=>[KNN 2 @emb $v]", "PARAMS", "2", "v", q)
+    # a write under the index prefix is the ingest stream
+    w.execute("HSET", "vt:3", "price", "3",
+              "emb", np.zeros(8, np.float32).tobytes())
+    t.execute("PING")  # drain
+    names = [bytes(n) for p in pushes if bytes(p[0]) == b"invalidate"
+             for n in (p[1] or [])]
+    assert b"__ftq__:vt" in names, names
+    # one-shot: re-registration needed before the next push
+    pushes.clear()
+    w.execute("HSET", "vt:4", "price", "4",
+              "emb", np.zeros(8, np.float32).tobytes())
+    t.execute("PING")
+    assert not any(
+        b"__ftq__:vt" in (p[1] or []) for p in pushes
+        if bytes(p[0]) == b"invalidate"
+    )
+    # DDL invalidates too
+    t.execute("FT.SEARCH", "vt", "(*)=>[KNN 2 @emb $v]", "PARAMS", "2", "v", q)
+    pushes.clear()
+    w.execute("FT.DROPINDEX", "vt")
+    t.execute("PING")
+    names = [bytes(n) for p in pushes if bytes(p[0]) == b"invalidate"
+             for n in (p[1] or [])]
+    assert b"__ftq__:vt" in names, names
+    t.close()
+    w.close()
+
+
+def test_expiry_and_objcall_ingest_invalidate_query_key(server):
+    """TTL expiry of a doc hash and OBJCALL-path writes are ingest-stream
+    churn too: both must invalidate the index's __ftq__ key (review fix)."""
+    pushes = []
+    t = _conn(server, handler=pushes.append)
+    w = _conn(server)
+    _wire_setup(w, idx="vx", prefix="vx:", n=6)
+    t.execute("CLIENT", "TRACKING", "ON")
+    q = np.ones(8, np.float32).tobytes()
+    t.execute("FT.SEARCH", "vx", "(*)=>[KNN 2 @emb $v]", "PARAMS", "2", "v", q)
+    server.server.tracking.note_expired(["vx:2"])  # the TTL reaper's hook
+    t.execute("PING")
+    names = [bytes(n) for p in pushes if bytes(p[0]) == b"invalidate"
+             for n in (p[1] or [])]
+    assert b"__ftq__:vx" in names, names
+    # objcall write path (OBJCALLM/TXEXEC tuples) hits the same seam
+    t.execute("FT.SEARCH", "vx", "(*)=>[KNN 2 @emb $v]", "PARAMS", "2", "v", q)
+    pushes.clear()
+    server.server.tracking.note_objcall_ops(
+        [("map", "vx:3", "fast_put", ())], None
+    )
+    t.execute("PING")
+    names = [bytes(n) for p in pushes if bytes(p[0]) == b"invalidate"
+             for n in (p[1] or [])]
+    assert b"__ftq__:vx" in names, names
+    t.close()
+    w.close()
+
+
+def test_wire_knn_sortby_desc_reverses(server):
+    c = _conn(server)
+    vecs = _wire_setup(c, idx="vdesc", prefix="vd2:", n=16)
+    q = vecs[2].tobytes()
+    asc = c.execute("FT.SEARCH", "vdesc", "(*)=>[KNN 4 @emb $v]",
+                    "PARAMS", "2", "v", q, "NOCONTENT")
+    desc = c.execute("FT.SEARCH", "vdesc", "(*)=>[KNN 4 @emb $v]",
+                     "SORTBY", "__emb_score", "DESC",
+                     "PARAMS", "2", "v", q, "NOCONTENT")
+    asc_ids = [bytes(asc[i]) for i in range(1, len(asc), 2)]
+    desc_ids = [bytes(desc[i]) for i in range(1, len(desc), 2)]
+    assert desc_ids == asc_ids[::-1]
+    c.close()
+
+
+def test_concurrent_knn_frames_sync_bound_and_fifo(server):
+    """M concurrent KNN frames <= M+1 blocking syncs (each frame's reply is
+    ONE frame-grouped readback), and a pipelined frame keeps reply FIFO."""
+    from redisson_tpu.core import ioplane
+
+    c = _conn(server)
+    vecs = _wire_setup(c, idx="vs8", prefix="vs8:", n=32)
+    q = vecs[1].tobytes()
+    # warm: compile the (cap, Q, k) program + prime cursors outside the
+    # measured window
+    c.execute("FT.SEARCH", "vs8", "(*)=>[KNN 3 @emb $v]",
+              "PARAMS", "2", "v", q)
+    M = 6
+    conns = [_conn(server) for _ in range(M)]
+    barrier = threading.Barrier(M)
+    outs = [None] * M
+    errs = []
+
+    def worker(i):
+        try:
+            barrier.wait()
+            outs[i] = conns[i].execute(
+                "FT.SEARCH", "vs8", "(*)=>[KNN 3 @emb $v]",
+                "PARAMS", "2", "v", q, "NOCONTENT",
+            )
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    before = ioplane.STATS.snapshot()["blocking_syncs"]
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(M)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    after = ioplane.STATS.snapshot()["blocking_syncs"]
+    assert not errs
+    assert all(o is not None and o[0] == 3 for o in outs)
+    assert after - before <= M + 1, (before, after)
+    # FIFO: a pipelined frame mixing KNN + PING + KNN replies in order
+    rep = conns[0].execute_many([
+        ("FT.SEARCH", "vs8", "(*)=>[KNN 2 @emb $v]", "PARAMS", "2", "v", q,
+         "NOCONTENT"),
+        ("PING",),
+        ("FT.SEARCH", "vs8", "(*)=>[KNN 1 @emb $v]", "PARAMS", "2", "v", q,
+         "NOCONTENT"),
+    ])
+    assert rep[0][0] == 2 and rep[1] == b"PONG" and rep[2][0] == 1
+    for cc in conns:
+        cc.close()
+    c.close()
+
+
+def test_qos_estimates_knn_by_payload():
+    from redisson_tpu.server import scheduler as sched
+
+    blob = b"\x00" * 1024
+    n = sched.estimate_command_items(
+        [b"FT.SEARCH", b"vi", b"(*)=>[KNN 5 @emb $v]",
+         b"PARAMS", b"2", b"v", blob]
+    )
+    assert n == 1024 // 8
+    # small frames stay interactive-sized
+    assert sched.estimate_command_items(
+        [b"FT.SEARCH", b"vi", b"*"]
+    ) == 1
+
+
+def test_perf_gate_config7_rows():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "perf_gate.py"),
+    )
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    def doc(qps, recall):
+        return {"metric": "x", "value": 1000.0,
+                "details": {"config7_knn_qps": qps,
+                            "config7_recall_at_10": recall}}
+
+    # healthy run passes; first sight (no baseline rows) passes on qps
+    rows, ok = pg.compare({"metric": "x", "value": 1000.0},
+                          doc(2000.0, 0.999), 0.05)
+    assert ok, rows
+    # recall floor binds absolutely from first sight
+    rows, ok = pg.compare({"metric": "x", "value": 1000.0},
+                          doc(2000.0, 0.95), 0.05)
+    assert not ok
+    assert any("recall" in r[0] and r[4] == "FAIL" for r in rows)
+    # relative qps regression gates
+    rows, ok = pg.compare(doc(2000.0, 1.0), doc(1500.0, 1.0), 0.05)
+    assert not ok
+    assert any("knn qps" in r[0] and r[4] == "FAIL" for r in rows)

@@ -79,6 +79,10 @@ METRICS = [
     ("config2q interactive p99 ms", ("details", "config2q_interactive_p99_ms"), False, True),
     ("config2q fairness p99 ratio", ("details", "config2q_fairness_p99_ratio"), False, True),
     ("config2q speedup vs no-qos", ("details", "config2q_interactive_speedup_vs_noqos"), True, False),
+    # config7 (ISSUE 11): device KNN throughput — gated relative
+    # (n/a-pass on first sight, like every new config); the recall QUALITY
+    # axis binds as an absolute floor below, not a relative row.
+    ("config7 knn qps", ("details", "config7_knn_qps"), True, True),
 ]
 
 # (label, extractor-path, minimum) — ABSOLUTE floors checked on the FRESH
@@ -89,6 +93,11 @@ FLOORS = [
      ("details", "config6_server_op_reduction"), 10.0),
     ("config2q speedup vs no-qos >= 1.2x",
      ("details", "config2q_interactive_speedup_vs_noqos"), 1.2),
+    # config7 recall@10 vs the float64 brute-force oracle: FLAT scoring is
+    # exact in f32, so only rounding ties may differ — binding from first
+    # sight (a recall drop means the kernel, not the workload, changed)
+    ("config7 recall@10 >= 0.99",
+     ("details", "config7_recall_at_10"), 0.99),
 ]
 
 # (label, extractor-path, maximum) — ABSOLUTE ceilings, same first-sight
@@ -205,12 +214,13 @@ def render(rows, threshold: float) -> str:
     out.append(
         f"gate: >{threshold:.0%} regression in headline, config5, config5p, "
         "config5d (ops/s AND 1-vs-N speedup), config2 flush p99, config4 "
-        "cold, config6 reduction, config2q interactive p99, or config2q "
-        "fairness fails; other drops are advisory (WARN); a metric absent "
-        "from the baseline reads n/a and passes (recorded on first sight).  "
-        "Absolute floors (config6 reduction >= 10x, config2q speedup vs "
-        "no-qos >= 1.2x) and ceilings (config2q fairness <= 2x) bind from "
-        "first sight."
+        "cold, config6 reduction, config2q interactive p99, config2q "
+        "fairness, or config7 knn qps fails; other drops are advisory "
+        "(WARN); a metric absent from the baseline reads n/a and passes "
+        "(recorded on first sight).  Absolute floors (config6 reduction >= "
+        "10x, config2q speedup vs no-qos >= 1.2x, config7 recall@10 >= "
+        "0.99) and ceilings (config2q fairness <= 2x) bind from first "
+        "sight."
     )
     return "\n".join(out)
 

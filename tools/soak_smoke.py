@@ -37,6 +37,13 @@
     acked-write loss, bounded interactive p99 (no starvation), sheds
     landing ONLY on the over-budget tenant, and flat QoS ledgers at
     quiesce.
+  * ``vector`` — the vector-search profile (ISSUE 11): KNN readers with
+    tracked near-cached query results + concurrent HSET ingest while the
+    index's slots (embedding-bank record included) rebalance 8 -> 4 -> 8
+    across devices under transport faults.  Asserts zero stale tracked
+    KNN results, zero acked-ingest loss, post-storm recall@k >= 0.99 vs a
+    float64 brute-force oracle, and a flat embedding-bank census after
+    FT.DROPINDEX.
   * ``tracking`` — the near-cache coherence profile (ISSUE 7): zipf
     readers with server-assisted near caches (CLIENT TRACKING) keep
     reading while key-bearing slots migrate m0 -> m1 -> m0 and the
@@ -70,7 +77,7 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--profile",
                     choices=("standard", "migration", "cluster-proc",
-                             "tracking", "device-shard", "qos"),
+                             "tracking", "device-shard", "qos", "vector"),
                     default="standard")
     ap.add_argument("--cycles", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
@@ -84,7 +91,13 @@ def main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
-    if args.profile == "qos":
+    if args.profile == "vector":
+        from redisson_tpu.chaos.soak import VectorSoakConfig, VectorSoakHarness
+
+        harness = VectorSoakHarness(VectorSoakConfig(
+            cycles=args.cycles, seed=args.seed,
+        ))
+    elif args.profile == "qos":
         from redisson_tpu.chaos.soak import QosSoakConfig, QosSoakHarness
 
         harness = QosSoakHarness(QosSoakConfig(
