@@ -102,6 +102,16 @@ class ResourceCensus:
             if sched is not None:
                 for k, v in sched.census().items():
                     out[k] = v
+            # tracing plane (ISSUE 12): ring occupancy is BOUNDED by the
+            # configured capacity; trace_inflight must drain to 0 at
+            # quiesce (a begun frame whose reply never closed the books is
+            # a trace leak).  Both 0 while tracing is disarmed.
+            out["trace_ring_entries"] = 0.0
+            out["trace_inflight"] = 0.0
+            tracer = getattr(server, "tracer", None)
+            if tracer is not None:
+                for k, v in tracer.census().items():
+                    out[k] = v
             # embedding-bank residency (ISSUE 11): bank count + device
             # bytes must return to baseline once FT.DROPINDEX tears an
             # index down — the vector soak's flat-census assertion

@@ -474,6 +474,27 @@ class ClusterSupervisor:
             kw.setdefault("password", self.password)
         return ClusterRedisson(self.seeds(), **kw)
 
+    def scrape(self) -> str:
+        """Fleet-wide Prometheus scrape (ISSUE 12): pull ``METRICS`` from
+        every live node and merge the expositions with per-node
+        ``node="host:port"`` labels — the supervisor half of the
+        one-pane-of-glass (the ``METRICS CLUSTER`` verb is the wire half;
+        both ride ``utils.metrics.merge_prometheus_texts``).  Dead or
+        unreachable nodes contribute nothing rather than failing the
+        scrape."""
+        from redisson_tpu.utils.metrics import merge_prometheus_texts
+
+        texts: Dict[str, str] = {}
+        for node in self.nodes():
+            if not node.alive():
+                continue
+            try:
+                with self.conn(node, timeout=10.0) as c:
+                    texts[node.address] = bytes(c.execute("METRICS")).decode()
+            except Exception:  # noqa: BLE001 — scrape the rest of the fleet
+                continue
+        return merge_prometheus_texts(texts)
+
     def log_tail(self, node: NodeProc, max_bytes: int = 4096) -> str:
         try:
             with open(node.log_path, "rb") as f:

@@ -41,6 +41,12 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+# tracing plane (observe/trace.py, ISSUE 12): every site below guards on the
+# process-global `_obs._tracer` — disarmed cost is one global load plus an
+# `is None` branch (the chaos-hook zero-cost discipline, asserted at the
+# allocator level by tests/test_observe.py)
+from redisson_tpu.observe import trace as _obs
+
 # -- global switch ------------------------------------------------------------
 
 _overlap = os.environ.get("RTPU_NO_OVERLAP", "") not in ("1", "true", "yes")
@@ -356,6 +362,16 @@ class ReadbackFuture:
                 STATS.add_readback(wall, was_ready)
                 for dev_id in dev_ids:  # per-lane sync ledger (ISSUE 8)
                     device_stats(dev_id).add_readback(wall, was_ready)
+                if _obs._tracer is not None:
+                    cur = _obs.current_trace()
+                    if cur is not None:
+                        # this frame PAID a blocking sync iff the device
+                        # value had not materialized when force hit it
+                        now = time.monotonic()
+                        cur.add_span(
+                            "readback", now - wall, now,
+                            blocking=int(not was_ready), grouped=0,
+                        )
                 self._deliver(host)
         if self._error is not None:
             raise self._error
@@ -798,7 +814,7 @@ class DeviceLane:
 
 
 class _LaneOccupancy:
-    __slots__ = ("_lane", "_n", "_cls", "_nbytes")
+    __slots__ = ("_lane", "_n", "_cls", "_nbytes", "_tcur", "_tmark")
 
     def __init__(self, lane: DeviceLane, n_items: int,
                  qos_class: Optional[str] = None, nbytes: int = 0):
@@ -806,11 +822,27 @@ class _LaneOccupancy:
         self._n = n_items
         self._cls = qos_class
         self._nbytes = nbytes
+        self._tcur = None  # active FrameTrace (tracing armed only)
+        self._tmark = 0.0
 
     def __enter__(self):
         if self._cls is not None:
             self._lane.qos.enter(self._cls, self._n, self._nbytes)
-        self._lane._gate.acquire()
+        if _obs._tracer is not None:
+            self._tcur = _obs.current_trace()
+        if self._tcur is not None:
+            # `stage` = time queued behind the lane gate (ahead of the
+            # chip); the occupancy hold itself becomes the `dispatch` span
+            t0 = time.monotonic()
+            self._lane._gate.acquire()
+            self._tmark = time.monotonic()
+            self._tcur.add_span(
+                "stage", t0, self._tmark,
+                device=self._lane.dev_id, items=self._n,
+                nbytes=self._nbytes,
+            )
+        else:
+            self._lane._gate.acquire()
         self._lane._laneset._enter()
         self._lane.dispatches += 1
         return self._lane
@@ -821,6 +853,12 @@ class _LaneOccupancy:
             if ns is not None and self._n > 0:
                 time.sleep(self._n * ns * 1e-9)
         finally:
+            if self._tcur is not None:
+                self._tcur.add_span(
+                    "dispatch", self._tmark, time.monotonic(),
+                    device=self._lane.dev_id, items=self._n,
+                    nbytes=self._nbytes,
+                )
             self._lane._laneset._exit()
             self._lane._gate.release()
             if self._cls is not None:

@@ -1,0 +1,17 @@
+"""End-to-end request tracing plane (ISSUE 12).
+
+``observe/trace.py`` is the per-frame span tracer; this package re-exports
+the arming surface so callers write ``from redisson_tpu import observe``
+and the server/ioplane instrumentation sites import one stable name.
+"""
+from redisson_tpu.observe.trace import (  # noqa: F401
+    TRACER,
+    FrameTrace,
+    Span,
+    Tracer,
+    clear_current,
+    current_trace,
+    set_current,
+    set_tracing,
+    tracing_enabled,
+)

@@ -21,6 +21,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from redisson_tpu.net.resp import Push, RespError
+from redisson_tpu.observe import trace as _obs
 from redisson_tpu.utils.metrics import run_hooks_end, run_hooks_start
 from redisson_tpu.version import __version__ as VERSION
 
@@ -67,8 +68,26 @@ def gather_lazy_device_results(lazies: List["LazyReply"]) -> List[tuple]:
     plane (core/ioplane.gather_device_results): the server's reply path, the
     embedded Batch drain, and bench's A/B harness all force through it, so
     the bitcast/concat/split discipline cannot diverge between layers."""
-    from redisson_tpu.core.ioplane import gather_device_results
+    from redisson_tpu.core.ioplane import _is_ready, gather_device_results
 
+    if _obs._tracer is not None:
+        cur = _obs.current_trace()
+        if cur is not None:
+            # the frame rode the GROUPED fetch: one span covering the whole
+            # gather, annotated whether any member still had to block on
+            # device work (vs a pure-transfer ride)
+            import time as _time
+
+            was_ready = all(
+                _is_ready(v) for lz in lazies for v in lz.device
+            )
+            t0 = _time.monotonic()
+            out = gather_device_results([lz.device for lz in lazies])
+            cur.add_span(
+                "readback", t0, _time.monotonic(),
+                grouped=len(lazies), blocking=int(not was_ready),
+            )
+            return out
     return gather_device_results([lz.device for lz in lazies])
 
 

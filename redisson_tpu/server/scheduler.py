@@ -42,6 +42,13 @@ Contracts preserved (pinned by tests/test_qos_plane.py):
     reaches dispatch, and a run never spans a shed boundary, so no
     partially-applied coalesced add run is ever re-dispatched;
   * bit-identical results with the scheduler disarmed.
+
+Observability (ISSUE 12): with the tracing plane armed
+(``redisson_tpu/observe``), every frame's classification + tenant charge +
+bulk-gate wait is recorded as its ``qos`` stage span (annotated
+tenant/class/items/shed by ``server._serve_frame``) — ``TRACE GET ... BY
+qos`` surfaces the frames that sat longest behind admission, and the
+``stage.qos`` histogram rides the Prometheus exposition.
 """
 from __future__ import annotations
 

@@ -43,6 +43,7 @@ from redisson_tpu.core.coalesce import runs_within_admission
 from redisson_tpu.core.engine import Engine
 from redisson_tpu.net import resp
 from redisson_tpu.net.resp import ProtocolError, RespError
+from redisson_tpu.observe import trace as _obs
 from redisson_tpu.server import scheduler as _sched
 from redisson_tpu.server.registry import LazyReply, REGISTRY, CommandContext
 
@@ -62,24 +63,45 @@ class _PendingFrame:
     frame's LazyReplies), then encodes and writes the replies — while the
     connection's read loop is already staging and dispatching the NEXT
     frame.  `proto` is the connection's negotiated protocol AT DISPATCH
-    time: a later frame's HELLO must not re-encode earlier replies."""
+    time: a later frame's HELLO must not re-encode earlier replies.
+    `trace` is the frame's FrameTrace when tracing is armed (the writer
+    task closes its `reply` span at write time), else None."""
 
-    __slots__ = ("results", "fut", "proto")
+    __slots__ = ("results", "fut", "proto", "trace")
 
-    def __init__(self, results: list, fut, proto: int):
+    def __init__(self, results: list, fut, proto: int, trace=None):
         self.results = results
         self.fut = fut
         self.proto = proto
+        self.trace = trace
 
     def encoded(self) -> bytes:
         return _encode_frame(self.results, self.proto)
 
 
-def _force_lazies(results: list, server) -> None:
+class _TracedEncoded:
+    """Pre-encoded frame bytes carrying their FrameTrace (tracing ARMED
+    only — disarmed frames enqueue plain bytes, exactly as before): the
+    writer task writes `data` and closes the trace's `reply` span, making
+    the trace total the true client-observable latency."""
+
+    __slots__ = ("data", "trace")
+
+    def __init__(self, data: bytes, trace):
+        self.data = data
+        self.trace = trace
+
+
+def _force_lazies(results: list, server, trace=None) -> None:
     """Materialize every LazyReply of a frame in place.  Device-form lazies
     are fetched with one concatenated transfer per dtype (the whole frame
-    pays ~1 tunnel round trip); callable-form lazies force individually."""
+    pays ~1 tunnel round trip); callable-form lazies force individually.
+    `trace` (tracing armed only) is activated on this worker thread so the
+    readback spans recorded inside the gather land on the right frame."""
     from redisson_tpu.server.registry import gather_lazy_device_results
+
+    if trace is not None:
+        _obs.set_current(trace)
 
     def fail(i, e):
         server.stats["errors"] += 1
@@ -90,27 +112,31 @@ def _force_lazies(results: list, server) -> None:
                 resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
             )
 
-    dev_idx = [
-        i for i, r in enumerate(results)
-        if isinstance(r, LazyReply) and r.device is not None
-    ]
-    if dev_idx:
-        try:
-            host_vals = gather_lazy_device_results([results[i] for i in dev_idx])
-        except Exception:  # noqa: BLE001 — grouped path failed; force singly
-            host_vals = None
-        if host_vals is not None:
-            for i, vals in zip(dev_idx, host_vals):
+    try:
+        dev_idx = [
+            i for i, r in enumerate(results)
+            if isinstance(r, LazyReply) and r.device is not None
+        ]
+        if dev_idx:
+            try:
+                host_vals = gather_lazy_device_results([results[i] for i in dev_idx])
+            except Exception:  # noqa: BLE001 — grouped path failed; force singly
+                host_vals = None
+            if host_vals is not None:
+                for i, vals in zip(dev_idx, host_vals):
+                    try:
+                        results[i] = results[i].finish(vals)
+                    except Exception as e:  # noqa: BLE001 — per-reply isolation
+                        fail(i, e)
+        for i, r in enumerate(results):
+            if isinstance(r, LazyReply):
                 try:
-                    results[i] = results[i].finish(vals)
+                    results[i] = r.force()
                 except Exception as e:  # noqa: BLE001 — per-reply isolation
                     fail(i, e)
-    for i, r in enumerate(results):
-        if isinstance(r, LazyReply):
-            try:
-                results[i] = r.force()
-            except Exception as e:  # noqa: BLE001 — per-reply isolation
-                fail(i, e)
+    finally:
+        if trace is not None:
+            _obs.clear_current()
 
 
 # Commands whose handlers may PARK the worker thread (blocking verbs hold it
@@ -211,6 +237,26 @@ class TpuServer:
         self.hooks = [MetricsHook(self.metrics)]
         self.metrics.gauge("keys", lambda: len(self.engine.store))
         self.metrics.gauge("connections", lambda: self.stats["connections"])
+        # tracing plane (ISSUE 12, observe/trace.py): the process tracer —
+        # disarmed by default (zero-cost guards); CONFIG SET trace-enabled /
+        # RTPU_TRACE=1 arms it.  Stage-duration histograms feed THIS
+        # registry (stage.* timers) so prometheus_text exports breakdowns.
+        self.tracer = _obs.TRACER
+        self.tracer.registry = self.metrics
+        self.metrics.gauge(
+            "trace_ring_entries",
+            lambda: self.tracer.census()["trace_ring_entries"],
+        )
+        self.metrics.gauge(
+            "trace_inflight",
+            lambda: self.tracer.census()["trace_inflight"],
+        )
+        # orphaned RESP3 pushes (ISSUE 12 satellite bugfix): the process-
+        # global drop counter was census-only — a fleet scrape could never
+        # see a desync-avoided push drop.  Now a first-class gauge.
+        from redisson_tpu.net.client import dropped_push_count
+
+        self.metrics.gauge("dropped_pushes", dropped_push_count)
         # QoS plane gauges (ISSUE 10): shed totals + per-class in-flight —
         # the census variants of the same numbers live in scheduler.census()
         self.metrics.gauge("qos_shed_ops", lambda: self.scheduler.shed_ops)
@@ -363,6 +409,11 @@ class TpuServer:
                 if self.engine.placement is not None else 0
             ),
             "dispatch-ahead": self.readback_ahead,
+            # tracing plane (ISSUE 12): arming + ring/slowlog knobs
+            "trace-enabled": int(_obs.tracing_enabled()),
+            "trace-ring-capacity": self.tracer.ring_capacity,
+            "slowlog-log-slower-than": self.tracer.slowlog_slower_than_us,
+            "slowlog-max-len": self.tracer.slowlog_max_len,
         }
         view.update(self.scheduler.config_view())
         return view
@@ -392,6 +443,31 @@ class TpuServer:
             # connections opened from now on size their per-connection
             # dispatch-ahead semaphore with this (see _handle)
             self.readback_ahead = n
+            return True
+        if key == "trace-enabled":
+            # arm/disarm the per-frame tracing plane live (the chaos-hook
+            # discipline: disarmed sites cost one load + is-None; armed
+            # replies stay bit-identical — tests/test_observe.py pins both)
+            _obs.set_tracing(
+                value.lower() not in ("0", "false", "no", "off")
+            )
+            return True
+        if key == "trace-ring-capacity":
+            n = int(value)
+            if n <= 0:
+                return False
+            self.tracer.set_ring_capacity(n)
+            return True
+        if key == "slowlog-log-slower-than":
+            # Redis parity: µs threshold; negative disables slowlog
+            # recording, 0 logs every frame
+            self.tracer.slowlog_slower_than_us = int(value)
+            return True
+        if key == "slowlog-max-len":
+            n = int(value)
+            if n <= 0:
+                return False
+            self.tracer.set_slowlog_max_len(n)
             return True
         if key.startswith("qos-"):
             if key == "qos-bulk-slots" and int(value) <= 0:
@@ -723,6 +799,8 @@ class TpuServer:
 
         if not self._pause_gate.is_set():
             self._pause_gate.wait(timeout=60.0)
+        cur = _obs.current_trace() if _obs._tracer is not None else None
+        k0 = time.monotonic() if cur is not None else 0.0
         is_add = bytes(cmds[0][0]).upper() == b"BF.MADD64"
         # tracking hooks for the fused path (the fallback below re-dispatches
         # through REGISTRY.dispatch, which carries its own hooks): probe runs
@@ -759,6 +837,22 @@ class TpuServer:
                 return [_Encoded(enc) for _ in cmds]
             fused = None
         if fused is not None:
+            if cur is not None:
+                # coalescer fan-in: ONE kernel span for the fused run, its
+                # member commands recorded as child spans sharing the
+                # kernel's interval (bounded so a 1000-command blob run
+                # cannot bloat the trace)
+                k1 = time.monotonic()
+                cur.add_span(
+                    "kernel", k0, k1,
+                    verb=bytes(cmds[0][0]).upper().decode(),
+                    members=len(cmds),
+                )
+                for c in cmds[:32]:
+                    cur.add_span(
+                        "kernel.member", k0, k1,
+                        key=bytes(c[1]).decode(errors="replace"),
+                    )
             if track is not None and is_add:
                 track.note_write(run_names, ctx)
             return fused
@@ -831,24 +925,53 @@ class TpuServer:
             nbytes=_sched._frame_nbytes(cmds) if qos_class is not None else 0,
         )
 
-    def _dispatch_laned(self, ctx, cmd, qos_class: Optional[str] = None):
-        """Sequential-path single-command dispatch with lane accounting."""
-        gate = self._occupancy_gate((cmd,), qos_class)
-        if gate is None:
-            return self._dispatch_gated(ctx, cmd)
-        with gate:
-            return self._dispatch_gated(ctx, cmd)
+    def _dispatch_laned(self, ctx, cmd, qos_class: Optional[str] = None,
+                        trace=None):
+        """Sequential-path single-command dispatch with lane accounting.
+        `trace` (tracing armed only) is activated on this worker thread so
+        lane/readback spans land on the frame; laneless dispatches record
+        their own `dispatch` span (the lane gate records it otherwise)."""
+        if trace is not None:
+            _obs.set_current(trace)
+        try:
+            gate = self._occupancy_gate((cmd,), qos_class)
+            if gate is None:
+                if trace is not None:
+                    t0 = time.monotonic()
+                    try:
+                        return self._dispatch_gated(ctx, cmd)
+                    finally:
+                        trace.add_span("dispatch", t0, time.monotonic())
+                return self._dispatch_gated(ctx, cmd)
+            with gate:
+                return self._dispatch_gated(ctx, cmd)
+        finally:
+            if trace is not None:
+                _obs.clear_current()
 
     def _dispatch_bloom_run_laned(self, ctx, cmds,
-                                  qos_class: Optional[str] = None):
+                                  qos_class: Optional[str] = None,
+                                  trace=None):
         """Sequential-path coalesced run with lane accounting (a run whose
         filters span devices gets no gate — the coalescer itself falls back
         to per-record dispatch on a mixed-device group)."""
-        gate = self._occupancy_gate(cmds, qos_class)
-        if gate is None:
-            return self._dispatch_bloom_run(ctx, cmds)
-        with gate:
-            return self._dispatch_bloom_run(ctx, cmds)
+        if trace is not None:
+            _obs.set_current(trace)
+        try:
+            gate = self._occupancy_gate(cmds, qos_class)
+            if gate is None:
+                if trace is not None:
+                    t0 = time.monotonic()
+                    try:
+                        return self._dispatch_bloom_run(ctx, cmds)
+                    finally:
+                        trace.add_span("dispatch", t0, time.monotonic())
+                return self._dispatch_bloom_run(ctx, cmds)
+            with gate:
+                return self._dispatch_bloom_run(ctx, cmds)
+        finally:
+            if trace is not None:
+                _obs.clear_current()
 
     def _pool_for(self, adm):
         """Worker pool for one frame's dispatch: interactive-class frames
@@ -859,14 +982,24 @@ class TpuServer:
             return self._qos_pool
         return self._pool
 
-    def _dispatch_one_sync(self, ctx, cmd):
+    def _dispatch_one_sync(self, ctx, cmd, trace=None):
         """One command, dispatched with the per-command error translation of
         the connection loop (RespError -> -ERR reply, shutdown -> drop the
-        connection, anything else sandboxed per command)."""
+        connection, anything else sandboxed per command).  `trace` (tracing
+        armed only, serial-segment path) activates the frame's trace on
+        this worker thread and records the handler window as `dispatch`."""
         if not isinstance(cmd, list) or not all(
             isinstance(a, (bytes, bytearray)) for a in cmd
         ):
             return _Encoded(resp.encode_error("ERR bad request frame"))
+        if trace is not None:
+            _obs.set_current(trace)
+            t0 = time.monotonic()
+            try:
+                return self._dispatch_one_sync(ctx, cmd)
+            finally:
+                trace.add_span("dispatch", t0, time.monotonic())
+                _obs.clear_current()
         try:
             return self._dispatch_gated(ctx, cmd)
         except RespError as e:
@@ -888,13 +1021,22 @@ class TpuServer:
             )
 
     def _dispatch_device_bucket(self, ctx, dev_index: int, items,
-                                qos_class: Optional[str] = None):
+                                qos_class: Optional[str] = None,
+                                trace=None):
         """One device's ordered slice of a pipelined frame (placement
         plan_frame 'sharded' segment): runs on a worker thread WHILE the
         other devices' buckets run on theirs — the per-chip dispatch lanes
         of device-sharded serving.  Same-verb BF blob runs inside the
         bucket still coalesce into one stacked-bank kernel (now guaranteed
         single-device).  Returns [(frame_index, result), ...]."""
+        if trace is not None:
+            _obs.set_current(trace)
+            try:
+                return self._dispatch_device_bucket(
+                    ctx, dev_index, items, qos_class
+                )
+            finally:
+                _obs.clear_current()
         if not self._pause_gate.is_set():
             self._pause_gate.wait(timeout=60.0)
         eng = self.engine
@@ -932,7 +1074,8 @@ class TpuServer:
                 ci += 1
         return out
 
-    async def _run_frame_sharded(self, ctx, commands, plan, loop, adm=None):
+    async def _run_frame_sharded(self, ctx, commands, plan, loop, adm=None,
+                                 trace=None):
         """Execute one pipelined frame under a placement plan: 'sharded'
         segments fan their per-device buckets out on the worker pool
         CONCURRENTLY (each bucket FIFO on its device lane — per-key order
@@ -956,7 +1099,7 @@ class TpuServer:
                         else self._pool_for(adm)
                     )
                     results[i] = await loop.run_in_executor(
-                        pool, self._dispatch_one_sync, ctx, cmd
+                        pool, self._dispatch_one_sync, ctx, cmd, trace
                     )
                 continue
             jobs = []
@@ -965,6 +1108,7 @@ class TpuServer:
                 jobs.append(loop.run_in_executor(
                     self._pool_for(adm), self._dispatch_device_bucket, ctx,
                     dev_index, [(i, commands[i]) for i in idxs], qos_class,
+                    trace,
                 ))
             outs = await asyncio.gather(*jobs, return_exceptions=True)
             err = next((o for o in outs if isinstance(o, BaseException)), None)
@@ -999,6 +1143,26 @@ class TpuServer:
             f"db0:keys={len(self.engine.store)},expires=0\r\n"
         )
 
+    def commandstats_text(self) -> str:
+        """INFO commandstats section (Redis parity): per-verb
+        calls/usec/usec_per_call, sourced from the MetricsRegistry's
+        ``command.<verb>`` timers (the MetricsHook records every dispatched
+        command there already — no second accounting plane)."""
+        lines = ["# Commandstats"]
+        with self.metrics._lock:
+            timers = sorted(self.metrics._timers.items())
+        for name, t in timers:
+            if not name.startswith("command."):
+                continue
+            verb = name[len("command."):]
+            usec = int(t.total_s * 1e6)
+            per = usec / t.count if t.count else 0.0
+            lines.append(
+                f"cmdstat_{verb}:calls={t.count},usec={usec},"
+                f"usec_per_call={per:.2f}"
+            )
+        return "\r\n".join(lines) + "\r\n"
+
     # -- QoS admission (ISSUE 10: deadline classes + per-tenant budgets) ------
 
     def _bulk_gate_for(self, slots: int) -> Optional[asyncio.Semaphore]:
@@ -1017,7 +1181,7 @@ class TpuServer:
         return gate
 
     async def _serve_frame(self, ctx, commands, loop, write_q,
-                           readback_slots, alive) -> bool:
+                           readback_slots, alive, trace=None) -> bool:
         """Admit + dispatch ONE parsed frame (the read loop's per-frame
         body).  Returns False when the connection must stop reading (writer
         task dead).  With the scheduler armed the frame is classified
@@ -1025,11 +1189,14 @@ class TpuServer:
         BEFORE anything dispatches: over-budget commands shed with -BUSY
         (never any queue residency), bulk frames pass the bounded bulk
         admission gate, and the frame's dispatch is accounted on the
-        per-class in-flight ledger for its whole residency."""
+        per-class in-flight ledger for its whole residency.  `trace`
+        (tracing armed only) records admit + bulk-gate wait as the frame's
+        `qos` span, annotated tenant/class/items/shed."""
         sched = self.scheduler
         adm = None
         bulk_gate = None
         acquired = begun = False
+        tq0 = time.monotonic() if trace is not None else 0.0
         if (
             sched.armed
             and commands
@@ -1062,8 +1229,19 @@ class TpuServer:
                             sched.ledger.wait_exit()
                 sched.begin(adm)
                 begun = True
+                if trace is not None:
+                    # classification + tenant charge + bulk-gate wait: the
+                    # span that attributes "my frame sat behind admission"
+                    trace.qos_class = adm.qos_class
+                    trace.tenant = adm.tenant
+                    trace.add_span(
+                        "qos", tq0, time.monotonic(),
+                        tenant=adm.tenant, cls=adm.qos_class,
+                        items=adm.items, shed=adm.shed_count,
+                    )
             ok = await self._dispatch_frame(
-                ctx, commands, loop, write_q, readback_slots, alive, adm
+                ctx, commands, loop, write_q, readback_slots, alive, adm,
+                trace,
             )
         finally:
             if begun:
@@ -1080,7 +1258,8 @@ class TpuServer:
         return ok
 
     async def _dispatch_frame(self, ctx, commands, loop, write_q,
-                              readback_slots, alive, adm=None) -> bool:
+                              readback_slots, alive, adm=None,
+                              trace=None) -> bool:
         # Two-phase frame execution: dispatch every command of the
         # pipelined frame first (handlers may return LazyReply —
         # device work enqueued, NOT forced), then force all lazy
@@ -1128,25 +1307,34 @@ class TpuServer:
                 plan = None    # break a frame; fall back to serial
         if plan is not None:
             results = await self._run_frame_sharded(
-                ctx, commands, plan, loop, adm
+                ctx, commands, plan, loop, adm, trace
             )
             if any(isinstance(r, LazyReply) for r in results):
                 if self.overlap:
                     await readback_slots.acquire()
                     if not alive["writer"]:
                         return False
+                    if trace is not None:
+                        trace.mark_dispatched()
                     fut = loop.run_in_executor(
-                        self._pool_for(adm), _force_lazies, results, self
+                        self._pool_for(adm), _force_lazies, results, self,
+                        trace,
                     )
                     write_q.put_nowait(
-                        _PendingFrame(results, fut, ctx.proto)
+                        _PendingFrame(results, fut, ctx.proto, trace)
                     )
                     return True
                 await loop.run_in_executor(
-                    self._pool_for(adm), _force_lazies, results, self
+                    self._pool_for(adm), _force_lazies, results, self, trace
                 )
             if results:
-                write_q.put_nowait(_encode_frame(results, ctx.proto))
+                if trace is not None:
+                    trace.mark_dispatched()
+                    write_q.put_nowait(_TracedEncoded(
+                        _encode_frame(results, ctx.proto), trace
+                    ))
+                else:
+                    write_q.put_nowait(_encode_frame(results, ctx.proto))
             return True
         run_at: Dict[int, int] = {}
         if len(commands) > 1:
@@ -1183,7 +1371,7 @@ class TpuServer:
                 results.extend(
                     await loop.run_in_executor(
                         self._pool_for(adm), self._dispatch_bloom_run_laned,
-                        ctx, run_cmds, qos_class,
+                        ctx, run_cmds, qos_class, trace,
                     )
                 )
                 continue
@@ -1204,7 +1392,8 @@ class TpuServer:
             try:
                 results.append(
                     await loop.run_in_executor(
-                        pool, self._dispatch_laned, ctx, cmd, qos_class
+                        pool, self._dispatch_laned, ctx, cmd, qos_class,
+                        trace,
                     )
                 )
             except RespError as e:
@@ -1238,18 +1427,27 @@ class TpuServer:
                 await readback_slots.acquire()
                 if not alive["writer"]:
                     return False  # connection is going down; stop dispatching
+                if trace is not None:
+                    trace.mark_dispatched()
                 fut = loop.run_in_executor(
-                    self._pool_for(adm), _force_lazies, results, self
+                    self._pool_for(adm), _force_lazies, results, self, trace
                 )
-                write_q.put_nowait(_PendingFrame(results, fut, ctx.proto))
+                write_q.put_nowait(_PendingFrame(results, fut, ctx.proto,
+                                                 trace))
                 return True
             await loop.run_in_executor(
-                self._pool_for(adm), _force_lazies, results, self
+                self._pool_for(adm), _force_lazies, results, self, trace
             )
         if results:
             # one queue item per frame — the whole frame's replies
             # encode in one pass and write in one syscall batch
-            write_q.put_nowait(_encode_frame(results, ctx.proto))
+            if trace is not None:
+                trace.mark_dispatched()
+                write_q.put_nowait(_TracedEncoded(
+                    _encode_frame(results, ctx.proto), trace
+                ))
+            else:
+                write_q.put_nowait(_encode_frame(results, ctx.proto))
         return True
 
     # -- asyncio plumbing ----------------------------------------------------
@@ -1295,6 +1493,13 @@ class TpuServer:
             # written as a SINGLE transport.write (one syscall per drained
             # batch instead of per frame).  An unresolved readback only ever
             # delays bytes queued BEHIND it, never ones already collected.
+            #
+            # Tracing (armed only): traced items carry their FrameTrace;
+            # once the batch's bytes are written+drained each trace closes
+            # its `reply` span HERE — the trace total is therefore the true
+            # client-observable latency.  A trace whose bytes never reach
+            # the wire (pool death, connection error) is abandoned so the
+            # inflight census row still drains.
             held = None  # a _PendingFrame popped while coalescing bytes
             try:
                 while True:
@@ -1303,6 +1508,7 @@ class TpuServer:
                     if item is None:
                         return
                     parts: list = []
+                    done_tr = None  # traces of this batch (armed only)
                     final = False
                     while True:
                         if isinstance(item, _PendingFrame):
@@ -1318,6 +1524,11 @@ class TpuServer:
                                 # return leaves the read loop dispatching into
                                 # a dead queue and the client blocked on recv
                                 # with no EOF
+                                if item.trace is not None:
+                                    _obs.TRACER.abandon(item.trace)
+                                if done_tr is not None:
+                                    for t in done_tr:
+                                        _obs.TRACER.abandon(t)
                                 try:
                                     writer.close()
                                 except Exception:  # noqa: BLE001
@@ -1326,6 +1537,15 @@ class TpuServer:
                             finally:
                                 readback_slots.release()
                             parts.append(item.encoded())
+                            if item.trace is not None:
+                                if done_tr is None:
+                                    done_tr = []
+                                done_tr.append(item.trace)
+                        elif isinstance(item, _TracedEncoded):
+                            parts.append(item.data)
+                            if done_tr is None:
+                                done_tr = []
+                            done_tr.append(item.trace)
                         else:
                             parts.append(item)
                         if write_q.empty():
@@ -1340,7 +1560,13 @@ class TpuServer:
                         try:
                             await writer.drain()
                         except ConnectionError:
+                            if done_tr is not None:
+                                for t in done_tr:
+                                    _obs.TRACER.abandon(t)
                             return
+                        if done_tr is not None:
+                            for t in done_tr:
+                                _obs.TRACER.finish_reply(t)
                     if final:
                         return
             finally:
@@ -1355,14 +1581,40 @@ class TpuServer:
                 data = await reader.read(1 << 16)
                 if not data:
                     break
+                # tracing (observe/trace.py): frames are stamped AT PARSE
+                # TIME — trace id + monotonic t0 — and the stamp rides the
+                # frame through every chokepoint.  Disarmed cost: one
+                # module-global load + `is not None` per read.  None (not
+                # 0.0) is the disarmed sentinel: arming between this read
+                # and the begin_frame guard must not anchor a trace at
+                # monotonic zero (a garbage uptime-long total).
+                t_parse0 = (
+                    time.monotonic() if _obs._tracer is not None else None
+                )
                 try:
                     commands = parser.feed(data)
                 except ProtocolError as e:
                     write_q.put_nowait(resp.encode_error(f"ERR protocol error: {e}"))
                     break
-                if not await self._serve_frame(
-                    ctx, commands, loop, write_q, readback_slots, alive
-                ):
+                trace = None
+                if _obs._tracer is not None and commands:
+                    trace = _obs._tracer.begin_frame(
+                        ctx, commands, t0=t_parse0
+                    )
+                try:
+                    ok = await self._serve_frame(
+                        ctx, commands, loop, write_q, readback_slots, alive,
+                        trace,
+                    )
+                except BaseException:
+                    # frame died before its replies were queued: close the
+                    # trace's books so the inflight census row drains
+                    if trace is not None and not trace.finished:
+                        _obs.TRACER.abandon(trace)
+                    raise
+                if not ok:
+                    if trace is not None and not trace.finished:
+                        _obs.TRACER.abandon(trace)
                     break
         except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
             pass
@@ -1376,6 +1628,13 @@ class TpuServer:
                 self.engine.pubsub.punsubscribe(pat, lid)
             write_q.put_nowait(None)
             await wt
+            # traced frames still queued behind the writer's death never
+            # reached the wire: abandon them so trace_inflight drains
+            while not write_q.empty():
+                leftover = write_q.get_nowait()
+                t = getattr(leftover, "trace", None)
+                if t is not None and not t.finished:
+                    _obs.TRACER.abandon(t)
             self._writers.discard(writer)
             self.stats["connections"] -= 1
             try:

@@ -23,6 +23,15 @@ def cmd_time(server, ctx, args):
 
 @register("INFO")
 def cmd_info(server, ctx, args):
+    """INFO [section] — the default sections, or one named section.
+    ``INFO commandstats`` (ISSUE 12 satellite) renders per-verb
+    calls/usec/usec_per_call from the MetricsRegistry command timers."""
+    if args:
+        section = _s(args[0]).lower()
+        if section == "commandstats":
+            return server.commandstats_text().encode()
+        if section in ("all", "everything"):
+            return (server.info_text() + server.commandstats_text()).encode()
     return server.info_text().encode()
 
 
@@ -451,8 +460,168 @@ def cmd_replicas(server, ctx, args):
 
 @register("METRICS")
 def cmd_metrics(server, ctx, args):
-    """Prometheus text exposition of the node's metrics registry."""
+    """Prometheus text exposition of the node's metrics registry.
+
+    ``METRICS CLUSTER`` (ISSUE 12): fan the scrape out to every master in
+    this node's cluster view and merge the expositions with per-node
+    ``node="host:port"`` labels — the wire half of the fleet-wide
+    one-pane-of-glass (``ClusterSupervisor.scrape()`` is the supervisor
+    half; both ride ``utils.metrics.merge_prometheus_texts``).  A dead
+    peer contributes nothing rather than failing the whole scrape."""
+    if args and bytes(args[0]).upper() == b"CLUSTER":
+        from redisson_tpu.utils.metrics import merge_prometheus_texts
+
+        texts = {server.address(): server.metrics.prometheus_text()}
+        seen = {(server.host, server.port)}
+        for _lo, _hi, host, port, _nid in server.cluster_view:
+            if (host, port) in seen:
+                continue
+            seen.add((host, port))
+            try:
+                link = server.link_client(
+                    f"{host}:{port}", ping_interval=0, retry_attempts=1
+                )
+                try:
+                    texts[f"{host}:{port}"] = bytes(
+                        link.execute("METRICS", timeout=10.0)
+                    ).decode()
+                finally:
+                    link.close()
+            except Exception:  # noqa: BLE001 — dead peer: scrape the rest
+                continue
+        return merge_prometheus_texts(texts).encode()
     return server.metrics.prometheus_text().encode()
+
+
+# -- tracing plane verbs (ISSUE 12: TRACE / SLOWLOG / LATENCY) ----------------
+
+
+def _span_wire(span) -> list:
+    """One stage span on the wire: [name, off_us, dur_us, [k, v, ...]]."""
+    attrs = []
+    if span.attrs:
+        for k, v in span.attrs.items():
+            attrs.append(k.encode())
+            attrs.append(v if isinstance(v, int) else str(v).encode())
+    return [span.name.encode(), span.off_us, span.dur_us, attrs]
+
+
+def _trace_wire(tr) -> list:
+    """One frame trace on the wire: [id, unix_ms, total_us, verb, n_cmds,
+    class, tenant, [span, ...]] — tools/trace_dump.py renders this as a
+    per-stage waterfall."""
+    return [
+        tr.trace_id, int(tr.ts * 1000), tr.total_us, tr.verbs.encode(),
+        tr.n_cmds, (tr.qos_class or "").encode(), (tr.tenant or "").encode(),
+        [_span_wire(s) for s in tr.spans],
+    ]
+
+
+@register("TRACE")
+def cmd_trace(server, ctx, args):
+    """TRACE GET [n] [BY total|<stage>] | RESET | CONFIG GET|SET k v —
+    the per-frame span ring over the wire.  GET returns the slowest-n
+    finished traces ordered by total duration (or by one stage's summed
+    duration: BY qos / readback / dispatch / ...), each a full span tree.
+    Empty while tracing is disarmed (CONFIG SET trace-enabled yes arms)."""
+    sub = bytes(args[0]).upper() if args else b"GET"
+    tracer = server.tracer
+    if sub == b"GET":
+        rest = list(args[1:])
+        n = 10
+        by = "total"
+        if rest and bytes(rest[0]).upper() != b"BY":
+            n = _int(rest[0])
+            rest = rest[1:]
+        if rest and bytes(rest[0]).upper() == b"BY":
+            if len(rest) < 2:
+                raise RespError("ERR TRACE GET ... BY needs a stage name")
+            by = _s(rest[1])
+        return [_trace_wire(t) for t in tracer.slowest(n, by=by)]
+    if sub == b"RESET":
+        tracer.reset()
+        return "+OK"
+    if sub == b"CONFIG":
+        mode = bytes(args[1]).upper() if len(args) > 1 else b"GET"
+        if mode == b"GET":
+            out = []
+            view = server.config_view()
+            for k in ("trace-enabled", "trace-ring-capacity",
+                      "slowlog-log-slower-than", "slowlog-max-len"):
+                out += [k.encode(), str(view[k]).encode()]
+            return out
+        if mode == b"SET":
+            if len(args) < 4:
+                raise RespError("ERR TRACE CONFIG SET <key> <value>")
+            if not server.config_set(_s(args[2]), _s(args[3])):
+                raise RespError(
+                    f"ERR unknown TRACE CONFIG parameter '{_s(args[2])}'"
+                )
+            return "+OK"
+        raise RespError("ERR TRACE CONFIG expects GET|SET")
+    raise RespError("ERR TRACE expects GET|RESET|CONFIG")
+
+
+@register("SLOWLOG")
+def cmd_slowlog(server, ctx, args):
+    """SLOWLOG GET [n] | RESET | LEN — Redis parity verbs backed by the
+    trace ring (threshold: CONFIG SET slowlog-log-slower-than <µs>,
+    negative disables, 0 logs everything).  Each entry carries the
+    per-stage breakdown instead of Redis's flat duration:
+    [id, unix_ts, total_us, [verb, ncmds], [[stage, dur_us], ...]]."""
+    sub = bytes(args[0]).upper() if args else b"GET"
+    tracer = server.tracer
+    if sub == b"GET":
+        n = _int(args[1]) if len(args) > 1 else 10
+        out = []
+        for sid, ts, dur_us, tr, stages in tracer.slowlog_get(n):
+            out.append([
+                sid, ts, dur_us,
+                [tr.verbs.encode(), str(tr.n_cmds).encode()],
+                [[st.encode(), us] for st, us in sorted(stages.items())],
+            ])
+        return out
+    if sub == b"LEN":
+        return tracer.slowlog_len()
+    if sub == b"RESET":
+        tracer.slowlog_reset()
+        return "+OK"
+    raise RespError("ERR SLOWLOG expects GET|RESET|LEN")
+
+
+@register("LATENCY")
+def cmd_latency(server, ctx, args):
+    """LATENCY HISTORY <event> | RESET [event ...] | LATEST — Redis parity
+    over the per-STAGE samples the tracer collects (events are stage names:
+    total, qos, dispatch, stage, kernel, readback, reply)."""
+    sub = bytes(args[0]).upper() if args else b""
+    tracer = server.tracer
+    if sub == b"HISTORY":
+        if len(args) < 2:
+            raise RespError("ERR LATENCY HISTORY <event>")
+        return [
+            # (unix ts, MILLISECONDS) pairs — the Redis LATENCY contract;
+            # sub-ms durations round up to 1 so a recorded sample is never
+            # indistinguishable from "no latency"
+            [ts, max(1, int(round(ms)))]
+            for ts, ms in tracer.latency_history(_s(args[1]))
+        ]
+    if sub == b"RESET":
+        return tracer.latency_reset([_s(a) for a in args[1:]])
+    if sub == b"LATEST":
+        out = []
+        for ev in tracer.latency_events():
+            hist = tracer.latency_history(ev)
+            if not hist:
+                continue
+            ts, ms = hist[-1]
+            worst = max(m for _t, m in hist)
+            out.append([
+                ev.encode(), ts,
+                max(1, int(round(ms))), max(1, int(round(worst))),
+            ])
+        return out
+    raise RespError("ERR LATENCY expects HISTORY|RESET|LATEST")
 
 
 # -- checkpoint (SAVE analog; full impl in core/checkpoint.py) ---------------

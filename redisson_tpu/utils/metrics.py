@@ -124,6 +124,30 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+def merge_prometheus_texts(texts: Dict[str, str]) -> str:
+    """Fleet-wide scrape merge (ISSUE 12): stamp each node's Prometheus
+    exposition with a ``node="host:port"`` label and concatenate — one pane
+    of glass for a multi-process cluster (``ClusterSupervisor.scrape()``
+    and the ``METRICS CLUSTER`` verb both ride this, so the two scrape
+    paths cannot diverge).  Lines that already carry a label set keep it
+    (the node label is appended); malformed lines are dropped."""
+    out: List[str] = []
+    for node in sorted(texts):
+        for line in texts[node].splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            name, _, value = line.rpartition(" ")
+            if not name or not value:
+                continue
+            if name.endswith("}"):
+                name = f'{name[:-1]},node="{node}"}}'
+            else:
+                name = f'{name}{{node="{node}"}}'
+            out.append(f"{name} {value}")
+    return "\n".join(out) + "\n"
+
+
 class CommandHook:
     """SPI: subclass and override; attach via Engine.config or server/client
     hook lists (the NettyHook analog)."""

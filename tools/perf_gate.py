@@ -83,6 +83,11 @@ METRICS = [
     # (n/a-pass on first sight, like every new config); the recall QUALITY
     # axis binds as an absolute floor below, not a relative row.
     ("config7 knn qps", ("details", "config7_knn_qps"), True, True),
+    # observability (ISSUE 12): armed-vs-disarmed tracing throughput ratio
+    # from tools/obs_overhead_bench.py — advisory relative row (n/a-pass
+    # first sight); the binding bound is the ABSOLUTE floor below (armed
+    # tracing may cost at most 3% on the config5-shaped mixed workload).
+    ("obs armed tracing ratio", ("details", "obs_armed_overhead_ratio"), True, False),
 ]
 
 # (label, extractor-path, minimum) — ABSOLUTE floors checked on the FRESH
@@ -98,6 +103,10 @@ FLOORS = [
     # sight (a recall drop means the kernel, not the workload, changed)
     ("config7 recall@10 >= 0.99",
      ("details", "config7_recall_at_10"), 0.99),
+    # armed tracing overhead (ISSUE 12): obs_overhead_bench.py's
+    # armed/disarmed ops ratio — binds from first sight, n/a while absent
+    ("obs armed tracing ratio >= 0.97",
+     ("details", "obs_armed_overhead_ratio"), 0.97),
 ]
 
 # (label, extractor-path, maximum) — ABSOLUTE ceilings, same first-sight
@@ -219,8 +228,8 @@ def render(rows, threshold: float) -> str:
         "(WARN); a metric absent from the baseline reads n/a and passes "
         "(recorded on first sight).  Absolute floors (config6 reduction >= "
         "10x, config2q speedup vs no-qos >= 1.2x, config7 recall@10 >= "
-        "0.99) and ceilings (config2q fairness <= 2x) bind from first "
-        "sight."
+        "0.99, armed tracing ratio >= 0.97) and ceilings (config2q "
+        "fairness <= 2x) bind from first sight."
     )
     return "\n".join(out)
 
