@@ -45,6 +45,15 @@ phase AND the source master takes an actual SIGKILL, the supervisor
 restarts it from its checkpoint, and ``resume_migrations`` must
 terminalize every journal across a genuine process boundary.  Run it with
 ``python tools/soak_smoke.py --profile cluster-proc``.
+
+The **fleet profile** (:class:`FleetSoakHarness`, ISSUE 13) extends the
+cross-process storm to whole-fleet lifecycle: replica-covered masters, a
+rolling restart of the live fleet (zero acked loss through graceful
+drains), TARGET double-kills recovered by import-journal replay, a
+replica promotion carrying an in-flight import window across a failover,
+and a live-coordinator target kill that must leave its journal resumable —
+under client-side transport faults, with a flat client census per cycle.
+Run it with ``python tools/soak_smoke.py --profile fleet``.
 """
 from __future__ import annotations
 
@@ -590,6 +599,12 @@ class ClusterProcSoakConfig:
     # per cycle: one coordinator-crash + server-SIGKILL at each phase.
     # DRAINING:1 = after the first drain sweep's journal entry (mid-drain).
     crash_phases: Tuple[str, ...] = ("WINDOW_OPEN", "DRAINING:1")
+    # which server process(es) take the SIGKILL next to the dead
+    # coordinator: "source" (the historical profile), "target" (the
+    # import-side gap ISSUE 13 closes — records the source already deleted
+    # must come back from the target's import journal), or "both" (the
+    # full double-kill matrix)
+    victims: str = "source"
     keys: int = 24                 # acked TCP writes riding the moving slots
     writer_threads: int = 2
     seed: int = 0
@@ -634,10 +649,13 @@ class ClusterProcSoakHarness:
     REAL ``tpu-server`` OS processes serves a mixed write stream over real
     TCP while a journaled slot migration is storming between them — and at
     a chosen journal phase the coordinator "dies" (``CoordinatorKilled``)
-    and the SOURCE master is SIGKILLed, both at once.  The supervisor
-    restarts the dead process (``--restore`` from its checkpoint),
-    ``resume_migrations`` replays the journal ACROSS the process boundary,
-    and the cycle asserts:
+    and a server process is SIGKILLed at that exact journal state: the
+    SOURCE master (the historical profile), the import TARGET (ISSUE 13 —
+    its boot-time import-journal replay must restore records the source
+    already deleted), or BOTH (``config.victims``).  The supervisor
+    restarts the dead process(es) (``--restore`` from checkpoint + journal
+    re-arm/replay), ``resume_migrations`` replays the journal ACROSS the
+    process boundary, and the cycle asserts:
 
       * **zero acked-durable-write loss** — every write acked before the
         pre-kill ``SAVE`` barrier reads back at its acked value or newer
@@ -675,18 +693,24 @@ class ClusterProcSoakHarness:
 
     # -- setup ----------------------------------------------------------------
 
-    def _setup(self) -> None:
+    def _make_supervisor(self):
+        """The fleet to storm — subclass hook (FleetSoakHarness adds
+        replicas + auto-checkpointing)."""
         from redisson_tpu.cluster import ClusterSupervisor
-        from redisson_tpu.utils.crc16 import calc_slot
 
-        cfg = self.config
         # server processes default to the CPU backend (RTPU_PROC_PLATFORM
         # overrides): N processes cannot share one TPU chip — same
         # discipline as bench config5p
-        self._sup = ClusterSupervisor(
-            masters=2, ready_timeout=cfg.ready_timeout,
+        return ClusterSupervisor(
+            masters=2, ready_timeout=self.config.ready_timeout,
             platform=os.environ.get("RTPU_PROC_PLATFORM", "cpu"),
-        ).start()
+        )
+
+    def _setup(self) -> None:
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        cfg = self.config
+        self._sup = self._make_supervisor().start()
         self._client = self._sup.client(
             scan_interval=0.5, timeout=15.0, connect_timeout=5.0,
             retry_attempts=2, retry_interval=0.1,
@@ -794,6 +818,23 @@ class ClusterProcSoakHarness:
             assert not isinstance(reply, RespError), reply
         self._durable.update(snapshot)
 
+    def _void_unsaved_acks(self) -> None:
+        """A SIGKILL voids every ack the victim applied AFTER the SAVE
+        barrier (same truth as Redis writes past the last RDB snapshot:
+        they die with the process).  Roll the promise set back to the
+        durable floor, or the NEXT barrier would promote doomed acks its
+        SAVE can no longer cover — the harness would then "detect" a loss
+        the durability contract never promised to prevent.  Acks that
+        actually landed on a surviving node are conservatively un-promised
+        too; they re-enter the promise set the next time their writer gets
+        an ack."""
+        with self._acked_lock:
+            for k in list(self._acked):
+                if k in self._durable:
+                    self._acked[k] = self._durable[k]
+                else:
+                    del self._acked[k]
+
     def _verify_durable(self, sample: Optional[int] = None) -> None:
         """Monotone zero-loss check over the durable set: the stored value
         is the acked-durable one or a NEWER write by the same key's single
@@ -876,42 +917,40 @@ class ClusterProcSoakHarness:
     def _storm(self, cycle: int) -> None:
         import signal as _signal
 
-        from redisson_tpu.cluster.chaos import sigkill_at_phase
+        from redisson_tpu.cluster.chaos import kill_pair_at_phase
         from redisson_tpu.server.migration import resume_migrations
 
         sup = self._sup
+        kill_source = self.config.victims in ("source", "both")
+        kill_target = self.config.victims in ("target", "both")
+        assert kill_source or kill_target, self.config.victims
         for phase in self.config.crash_phases:
             src = sup.masters[self._owner]
             dst = sup.masters[1 - self._owner]
             # durability barrier BEFORE the kill: this cycle's covered set
             self._save_barrier()
-            rc = sigkill_at_phase(
-                sup, src, src.address, dst.address, self._slots, phase,
+            rcs = kill_pair_at_phase(
+                sup, src, dst, self._slots, phase,
+                kill_source=kill_source, kill_target=kill_target,
                 sig=_signal.SIGKILL,
             )
             self.report.coordinator_kills += 1
-            self.report.server_sigkills += 1
-            assert rc == -_signal.SIGKILL, f"expected SIGKILL death, got {rc}"
-            # The SIGKILL voids every ack the victim applied AFTER the SAVE
-            # barrier (same truth as Redis writes past the last RDB
-            # snapshot: they die with the process).  Roll the promise set
-            # back to the durable floor, or the NEXT barrier would promote
-            # doomed acks its SAVE can no longer cover — the harness would
-            # then "detect" a loss the durability contract never promised
-            # to prevent.  Acks that actually landed on the surviving
-            # target are conservatively un-promised too; they re-enter the
-            # promise set the next time their writer gets an ack.  The
-            # short settle lets in-flight replies (applied+buffered before
-            # the kill) finish recording first.
+            self.report.server_sigkills += len(rcs)
+            for who, rc in rcs.items():
+                assert rc == -_signal.SIGKILL, \
+                    f"expected SIGKILL death of {who}, got {rc}"
+            # The short settle lets in-flight replies (applied+buffered
+            # before the kill) finish recording, then the promise set rolls
+            # back to the durable floor (see _void_unsaved_acks).
             time.sleep(0.3)
-            with self._acked_lock:
-                for k in list(self._acked):
-                    if k in self._durable:
-                        self._acked[k] = self._durable[k]
-                    else:
-                        del self._acked[k]
-            sup.restart(src)  # same port, --restore from the SAVE barrier
-            self.report.restarts += 1
+            self._void_unsaved_acks()
+            # restart every victim on its old port: the target FIRST, so
+            # its boot-time import-journal replay restores the records the
+            # source already deleted before the resumed drain re-fences
+            for victim in ([dst] if kill_target else []) \
+                    + ([src] if kill_source else []):
+                sup.restart(victim)  # --restore + journal re-arm/replay
+                self.report.restarts += 1
             results = resume_migrations(sup.journal_dir)
             assert results, "resume found no in-flight migration"
             for r in results:
@@ -961,6 +1000,308 @@ class ClusterProcSoakHarness:
                 self._save_barrier()
                 self._verify_durable()
                 self._verify_bloom()
+                self.report.cycles_completed += 1
+            budget = int(
+                cfg.error_budget_ratio * max(1, self.report.acked_writes)
+            )
+            assert self.report.errors <= budget, (
+                f"error budget blown: {self.report.errors} errors vs "
+                f"{self.report.acked_writes} acked writes (budget {budget})"
+            )
+            return self.report
+        finally:
+            self._teardown()
+
+
+# -- fleet lifecycle profile (ISSUE 13) ---------------------------------------
+
+@dataclass
+class FleetSoakConfig(ClusterProcSoakConfig):
+    """The fleet-survival profile: replica-covered masters, a rolling
+    restart of the live fleet, TARGET double-kills at journal phases,
+    a replica-promotion failover of a dead import target, and a
+    live-coordinator target SIGKILL — all under client-side transport
+    faults."""
+    replicas_per_master: int = 1
+    crash_phases: Tuple[str, ...] = ("DRAINING:1",)
+    victims: str = "target"
+    roll_scope: str = "masters"     # "all" | "masters" | "none"
+    promote: bool = True            # replica-promotion failover leg
+    live_kill: bool = True          # target dies under a LIVE coordinator
+    # auto-checkpoint cadence: with it armed, a graceful (SIGTERM) stop
+    # flushes on exit, so a rolling restart loses NOTHING acked before the
+    # stop — the property the roll leg asserts
+    checkpoint_interval: float = 0.5
+
+
+@dataclass
+class FleetSoakReport(ClusterProcSoakReport):
+    nodes_rolled: int = 0
+    promotions: int = 0
+    live_kill_migrations: int = 0
+
+    def summary(self) -> str:
+        return (
+            super().summary()
+            + f"; fleet: {self.nodes_rolled} nodes rolled, "
+              f"{self.promotions} replica promotions, "
+              f"{self.live_kill_migrations} live-coordinator target kills"
+        )
+
+
+class FleetSoakHarness(ClusterProcSoakHarness):
+    """Whole-fleet lifecycle robustness (ISSUE 13): a 2-master cluster of
+    real OS processes, each master replica-covered, serves a mixed write
+    stream over real TCP while — under injected client-side transport
+    faults — the harness:
+
+      1. **rolls the fleet** (``ClusterSupervisor.rolling_restart``): each
+         node drains (REPLFLUSH + SAVE), stops gracefully (escalating
+         SIGTERM→SIGKILL), restarts on its address, and the roll only
+         advances through the health barrier.  EVERY write acked before
+         the roll must survive it — graceful stops flush, so this leg has
+         no SAVE-barrier exclusions;
+      2. **double-kills the import TARGET** at journal phases (coordinator
+         dead at the same instant) and recovers via restart + import-journal
+         replay + ``resume_migrations`` — records the source deleted on the
+         strength of a journaled ack must come back;
+      3. **promotes a replica over a dead target** mid-import
+         (``promote_replica`` + ``resume_migrations(readdress=...)``): the
+         REPLPUSH-covered batches carry the import forward with the window
+         intact, and the old master rejoins as a replica of its successor;
+      4. **SIGKILLs the target under a LIVE coordinator** mid-drain: the
+         failed ``migrate_slots`` must leave its journal IN FLIGHT (no
+         rollback into a fork), and resume completes the pair forward.
+
+    Each cycle ends with the full invariant sweep: zero acked-durable-write
+    loss (monotone per-key), exactly-one-owner residency, all slots STABLE
+    with every import journal terminal, acked bloom adds intact, and a flat
+    client-side resource census.
+
+    Runs via ``python tools/soak_smoke.py --profile fleet`` (<60s) or the
+    2-cycle kill-every-phase variant in ``tests/test_cluster_proc.py``'s
+    slow tier.
+    """
+
+    def __init__(self, config: Optional[FleetSoakConfig] = None):
+        super().__init__(config or FleetSoakConfig())
+        self.report = FleetSoakReport()
+
+    def _make_supervisor(self):
+        from redisson_tpu.cluster import ClusterSupervisor
+
+        cfg = self.config
+        return ClusterSupervisor(
+            masters=2, replicas_per_master=cfg.replicas_per_master,
+            ready_timeout=cfg.ready_timeout,
+            checkpoint_interval=cfg.checkpoint_interval,
+            platform=os.environ.get("RTPU_PROC_PLATFORM", "cpu"),
+        )
+
+    def _transport_schedule(self, cycle: int) -> FaultSchedule:
+        """Light seed-deterministic client-side noise: the routed client,
+        the coordinator's RetryPolicy-riding admin links, and the resume
+        path all have to absorb it mid-roll/mid-kill."""
+        sched = FaultSchedule(self.config.seed * 9173 + cycle)
+        sched.add_random("delay", n=6, window=400, delay_s=0.01)
+        sched.add_random("drop", n=2, window=400)
+        return sched
+
+    def _relearn_owner(self) -> None:
+        """Re-derive which master holds the moving slots by actual record
+        residency (the bloom record always exists) — legs whose outcome can
+        legitimately be either completed or rolled back re-sync here
+        instead of guessing."""
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        slot = calc_slot(self._bloom_name.encode())
+        for i, node in enumerate(self._sup.masters):
+            with self._sup.conn(node) as c:
+                names = c.execute("CLUSTER", "GETKEYSINSLOT", slot, 1_000_000)
+            if self._bloom_name in {bytes(n).decode() for n in names or []}:
+                self._owner = i
+                return
+        raise AssertionError("bloom record resident on no master")
+
+    # -- legs ------------------------------------------------------------------
+
+    def _roll_leg(self) -> None:
+        """Rolling restart under load: pre-roll acks are promoted to the
+        covered set BEFORE the roll — the roll's own drain (SAVE +
+        flush-on-stop) is the durability mechanism, so losing any of them
+        is a failed roll, not an uncovered window."""
+        sup = self._sup
+        with self._acked_lock:
+            snapshot = dict(self._acked)
+        nodes = None if self.config.roll_scope == "all" else list(sup.masters)
+        rolled = sup.rolling_restart(nodes=nodes)
+        for step in rolled:
+            assert step["exit_code"] == 0, (
+                f"roll step was not graceful: {step}"
+            )
+        self.report.nodes_rolled += len(rolled)
+        self._durable.update(snapshot)
+        self._client.refresh_topology()
+        self._verify_durable(sample=8)
+
+    def _promote_leg(self, cycle: int) -> None:
+        """Target dies mid-import with the coordinator; its replica is
+        promoted WITH the in-flight window and the readdressed resume
+        drives the pair to STABLE — then the old master rejoins as a
+        replica of its successor."""
+        import signal as _signal
+
+        from redisson_tpu.cluster.chaos import kill_pair_at_phase
+        from redisson_tpu.server.migration import resume_migrations
+
+        sup = self._sup
+        src = sup.masters[self._owner]
+        dst = sup.masters[1 - self._owner]
+        self._save_barrier()
+        rcs = kill_pair_at_phase(
+            sup, src, dst, self._slots, "DRAINING:1", kill_target=True,
+        )
+        self.report.coordinator_kills += 1
+        self.report.server_sigkills += len(rcs)
+        assert rcs["target"] == -_signal.SIGKILL, rcs
+        time.sleep(0.3)
+        self._void_unsaved_acks()
+        promoted = sup.promote_replica(dst)
+        assert promoted is not None, "target had no live replica to promote"
+        self.report.promotions += 1
+        results = resume_migrations(
+            sup.journal_dir, readdress={dst.address: promoted.address},
+        )
+        assert any(r["action"] == "completed" for r in results), results
+        self.report.resumed_completed += sum(
+            1 for r in results if r["action"] == "completed"
+        )
+        self._owner = 1 - self._owner
+        sup.restart(dst)  # rejoins as a replica of its successor
+        self.report.restarts += 1
+        self._client.refresh_topology()
+        self._assert_slots_stable()
+        self._assert_one_owner()
+        self._verify_durable(sample=8)
+
+    def _live_kill_leg(self, cycle: int) -> None:
+        """The coordinator is ALIVE when its target dies: migrate_slots
+        must leave the journal in flight (rolling back would fork the
+        journaled-but-deleted records), and restart + resume completes the
+        pair forward."""
+        import glob
+        import signal as _signal
+
+        from redisson_tpu.server.migration import (
+            migrate_slots, resume_migrations,
+        )
+
+        sup = self._sup
+        src = sup.masters[self._owner]
+        dst = sup.masters[1 - self._owner]
+        self._save_barrier()
+        pattern = os.path.join(sup.journal_dir, "*.import")
+        before = set(glob.glob(pattern))
+        did_kill: List[int] = []
+
+        def killer() -> None:
+            # SIGKILL the target the moment its NEW import journal exists —
+            # the first batch is durable, the source has begun deleting.
+            # Exits only on kill or deadline: a drain that wins the race
+            # still gets its (now harmless) late kill, so the leg's
+            # did-the-trigger-fire assert below stays race-free.
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if set(glob.glob(pattern)) - before:
+                    sup.kill(dst, _signal.SIGKILL)
+                    did_kill.append(1)
+                    return
+                time.sleep(0.002)
+
+        t = threading.Thread(target=killer)
+        t.start()
+        inline_error = None
+        try:
+            migrate_slots(
+                src.address, dst.address, self._slots,
+                journal_dir=sup.journal_dir,
+            )
+        except BaseException as e:  # noqa: BLE001 — the kill's intended blast
+            inline_error = e
+        finally:
+            t.join(timeout=35.0)
+        # a storm whose trigger never fired is a broken storm, not a green
+        # one: the kill waits on a NEW .import file, so this also guards
+        # EPOCH stamping and target-side journaling end to end
+        assert did_kill, "live-kill trigger never fired (no import journal)"
+        self.report.server_sigkills += len(did_kill)
+        time.sleep(0.3)
+        self._void_unsaved_acks()
+        sup.restart(dst)
+        self.report.restarts += 1
+        results = resume_migrations(sup.journal_dir)
+        if inline_error is not None:
+            # the failed run must have left its journal resumable — the
+            # new no-rollback-into-a-dead-target policy
+            assert any(
+                r["action"] in ("completed", "rolled_back") for r in results
+            ), (inline_error, results)
+            self.report.resumed_completed += sum(
+                1 for r in results if r["action"] == "completed"
+            )
+        self.report.live_kill_migrations += 1
+        self._client.refresh_topology()
+        self._relearn_owner()
+        self._assert_slots_stable()
+        self._assert_one_owner()
+        self._verify_durable(sample=8)
+
+    # -- the run loop ----------------------------------------------------------
+
+    def run(self) -> FleetSoakReport:
+        cfg = self.config
+        try:
+            self._setup()
+            census = ResourceCensus()
+            census.track_client("client", self._client)
+            for cycle in range(cfg.cycles):
+                stop = threading.Event()
+                threads = [
+                    threading.Thread(target=self._writer, args=(w, cycle, stop))
+                    for w in range(cfg.writer_threads)
+                ] + [threading.Thread(target=self._mapper, args=(cycle, stop))]
+                plane = FaultPlane(self._transport_schedule(cycle))
+                base = census.snapshot()
+                try:
+                    for t in threads:
+                        t.start()
+                    with plane.active():
+                        if cfg.roll_scope != "none":
+                            self._roll_leg()
+                        self._storm(cycle)  # target double-kills per phase
+                        if cfg.promote:
+                            self._promote_leg(cycle)
+                        if cfg.live_kill:
+                            self._live_kill_leg(cycle)
+                    time.sleep(1.0)  # post-recovery acks on the healed fleet
+                finally:
+                    stop.set()
+                    for t in threads:
+                        t.join(timeout=90.0)
+                assert not any(t.is_alive() for t in threads), "writer wedged"
+                self._save_barrier()
+                self._verify_durable()
+                self._verify_bloom()
+                self._assert_slots_stable()
+                self._assert_one_owner()
+                # quiesce, then the census must be flat: no connection,
+                # push, or near-cache growth survives a full fleet cycle
+                time.sleep(0.5)
+                census.assert_flat(
+                    base, census.snapshot(),
+                    ignore=("client.conn_idle", "client.node_clients"),
+                    context=f"fleet cycle {cycle}",
+                )
                 self.report.cycles_completed += 1
             budget = int(
                 cfg.error_budget_ratio * max(1, self.report.acked_writes)

@@ -50,6 +50,37 @@ def crash_coordinator_at(
     raise AssertionError(f"crash_after={phase!r} did not fire")
 
 
+def kill_pair_at_phase(
+    sup: ClusterSupervisor,
+    source_node: NodeProc,
+    target_node: NodeProc,
+    slots: Sequence[int],
+    phase: str,
+    kill_source: bool = False,
+    kill_target: bool = True,
+    sig: int = signal.SIGKILL,
+) -> dict:
+    """The DOUBLE-kill matrix (ISSUE 13): the coordinator dies at `phase`
+    (journal frozen at that exact state), then the chosen server
+    process(es) — the migration TARGET by default, optionally the source
+    too, i.e. every party to the protocol dead at once.  Returns
+    ``{"source": rc, "target": rc}`` for the processes actually killed.
+    Recovery is the caller's move: ``sup.restart(...)`` each victim (boot
+    replays the target's import journal) + ``resume_migrations`` — or
+    ``sup.promote_replica(target_node)`` +
+    ``resume_migrations(readdress=...)`` for the failover path."""
+    crash_coordinator_at(
+        source_node.address, target_node.address, slots, sup.journal_dir,
+        phase, password=sup.password,
+    )
+    out = {}
+    if kill_target:
+        out["target"] = sup.kill(target_node, sig)
+    if kill_source:
+        out["source"] = sup.kill(source_node, sig)
+    return out
+
+
 def sigkill_at_phase(
     sup: ClusterSupervisor,
     victim: NodeProc,

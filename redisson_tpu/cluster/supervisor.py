@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from redisson_tpu.cluster import topology
 from redisson_tpu.net.client import Connection
 from redisson_tpu.net.resp import RespError
+from redisson_tpu.net.retry import RetryPolicy, call_with_retry
 
 
 class NodeStartupError(RuntimeError):
@@ -172,7 +173,9 @@ class ClusterSupervisor:
 
     def shutdown(self) -> None:
         """SIGTERM everything (graceful: checkpoint flush-on-stop), escalate
-        to SIGKILL on stragglers, reap every exit code."""
+        to SIGKILL on stragglers, reap every exit code.  Bounded end to
+        end: a wedged node (SIGSTOPped, hung in a flush) cannot stall the
+        teardown — SIGKILL reaps even a stopped process."""
         for node in self.nodes():
             if node.alive():
                 try:
@@ -183,13 +186,30 @@ class ClusterSupervisor:
         for node in self.nodes():
             if node.proc is None:
                 continue
+            self._reap_escalating(
+                node, max(0.1, deadline - time.monotonic())
+            )
+
+    def _reap_escalating(self, node: NodeProc, grace: float) -> Optional[int]:
+        """Bounded reap of a process that was just signalled: wait `grace`
+        for a voluntary exit, SIGKILL on expiry, bound the post-kill wait
+        too.  Records the exit code (satellite: the code still lands in
+        ``exit_codes`` even on the escalated path); returns None only if
+        even SIGKILL cannot reap in time (uninterruptible D-state) — the
+        next ``reap()`` collects it."""
+        if node.proc is None:
+            return node.exit_codes[-1] if node.exit_codes else None
+        try:
+            node.proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            node.proc.kill()
             try:
-                node.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
-            except subprocess.TimeoutExpired:
-                node.proc.kill()
                 node.proc.wait(timeout=10.0)
-            node.reap()
-            self._close_ready_fd(node)
+            except subprocess.TimeoutExpired:
+                self._close_ready_fd(node)
+                return None
+        self._close_ready_fd(node)
+        return node.reap()
 
     # -- spawning ------------------------------------------------------------
 
@@ -327,31 +347,21 @@ class ClusterSupervisor:
             pass
         if sig in (signal.SIGSTOP, signal.SIGCONT):
             return None
-        try:
-            node.proc.wait(timeout=30.0)
-        except subprocess.TimeoutExpired:
-            if sig != signal.SIGKILL:  # graceful signal ignored: escalate
-                node.proc.kill()
-                node.proc.wait(timeout=10.0)
-        self._close_ready_fd(node)
-        return node.reap()
+        return self._reap_escalating(node, 30.0)
 
     def stop(self, node: NodeProc, timeout: float = 15.0) -> Optional[int]:
         """Graceful SIGTERM (checkpoint flush-on-stop inside the server),
-        escalating to SIGKILL after `timeout`.  Returns the exit code."""
+        escalating to SIGKILL after the `timeout` grace period — a wedged
+        node (SIGSTOPped, hung mid-flush) cannot stall a teardown or a
+        rolling restart; its exit code is still recorded.  Returns the
+        exit code."""
         if node.proc is None:
             return node.exit_codes[-1] if node.exit_codes else None
         try:
             os.kill(node.proc.pid, signal.SIGTERM)
         except ProcessLookupError:
             pass
-        try:
-            node.proc.wait(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            node.proc.kill()
-            node.proc.wait(timeout=10.0)
-        self._close_ready_fd(node)
-        return node.reap()
+        return self._reap_escalating(node, timeout)
 
     def pause(self, node: NodeProc) -> None:
         """SIGSTOP: the real hung-but-accepting failure mode — the kernel
@@ -369,27 +379,52 @@ class ClusterSupervisor:
                 return None
         return node.reap()
 
-    def restart(self, node: NodeProc, restore: bool = True) -> NodeProc:
+    @staticmethod
+    def _rejoin_retry_policy() -> RetryPolicy:
+        """The view-learning/re-wiring schedule for a node rejoining the
+        fleet: mid-roll its peers may themselves be restarting, so a
+        refused connect retries instead of failing the whole restart."""
+        return RetryPolicy(
+            max_attempts=5, base_delay=0.1, max_delay=1.0, jitter=0.2,
+            deadline_s=20.0,
+        )
+
+    def restart(self, node: NodeProc, restore: bool = True,
+                force: bool = False) -> NodeProc:
         """Bring a dead node back on the SAME address.  **Idempotent**: a
         node that is still alive is left untouched (double restart is a
-        no-op — the supervisor never kills a healthy process by accident).
-        The fresh process ``--restore``\\ s its checkpoint (when one exists),
-        relearns the cluster view from a live peer (the supervisor's
-        original plan may be stale after migrations/failovers), and replica
-        links severed by the death are re-wired."""
+        no-op — the supervisor never kills a healthy process by accident)
+        unless ``force=True``, which first stops it through the escalating
+        SIGTERM→SIGKILL path (the rolling-restart step, and the only way
+        to recycle a wedged-but-alive process).  The fresh process
+        ``--restore``\\ s its checkpoint (when one exists), relearns the
+        cluster view from a live peer (the supervisor's original plan may
+        be stale after migrations/failovers — retried under
+        :class:`~redisson_tpu.net.retry.RetryPolicy`, because mid-roll the
+        peers may be restarting too), and replica links severed by the
+        death are re-wired."""
         if node.alive():
-            return node
+            if not force:
+                return node
+            self.stop(node)
         node.reap()  # capture the exit code before respawning
         self._spawn(node, restore=restore)
         self.wait_ready(node)
+        policy = self._rejoin_retry_policy()
         view = self.current_view()
         if view:
-            topology.install_view([self._conn_factory(node)], view)
+            call_with_retry(
+                policy,
+                lambda: topology.install_view([self._conn_factory(node)], view),
+            )
         if node.role == "replica" and node.master_index is not None:
             master = self.masters[node.master_index]
             if master.alive():
-                topology.wire_replica(
-                    self._conn_factory(node), master.host, master.port
+                call_with_retry(
+                    policy,
+                    lambda: topology.wire_replica(
+                        self._conn_factory(node), master.host, master.port
+                    ),
                 )
         elif node.role == "master":
             # replicas of THIS master lost their push registration with the
@@ -398,10 +433,184 @@ class ClusterSupervisor:
                 if rep.master_index is not None \
                         and self.masters[rep.master_index] is node \
                         and rep.alive():
-                    topology.wire_replica(
-                        self._conn_factory(rep), node.host, node.port
+                    call_with_retry(
+                        policy,
+                        lambda rep=rep: topology.wire_replica(
+                            self._conn_factory(rep), node.host, node.port
+                        ),
                     )
         return node
+
+    # -- fleet lifecycle (ISSUE 13) -------------------------------------------
+
+    def promote_replica(self, master: NodeProc) -> Optional[NodeProc]:
+        """Fail a DEAD master over onto one of its live replicas, keeping
+        any in-flight import window intact: the replica is promoted
+        (``REPLICAOF NO ONE``), inherits the dead master's slots in the
+        fleet view, and re-arms the IMPORTING windows of every in-flight
+        journaled migration that targeted the dead address — then REPLAYS
+        the dead master's journaled import batches onto it
+        (apply-by-version: a no-op for every batch its REPLPUSH-covered
+        link already delivered, the recovery path for any it missed),
+        making it the durable continuation of the import, which
+        ``resume_migrations(readdress={dead: promoted})`` then drives to
+        STABLE.  Only after the replay are the dead master's in-flight
+        import journals terminalized (superseded), and the bookkeeping
+        swaps so a later ``restart()`` of the old process brings it back
+        as a replica of its successor.  Returns the promoted node, or
+        None when the master has no live replica."""
+        from redisson_tpu.server.migration_journal import (
+            ImportJournal, MigrationJournal,
+        )
+
+        mi = self.masters.index(master)
+        rep = next(
+            (r for r in self.replicas
+             if r.master_index == mi and r.alive()),
+            None,
+        )
+        if rep is None:
+            return None
+        dead_addr = master.address
+        inflight_imports = [
+            ij for ij in ImportJournal.in_flight(self.journal_dir)
+            if ij.target == dead_addr
+        ]
+        with self.conn(rep) as c:
+            topology.check_reply(c.execute("REPLICAOF", "NO", "ONE"))
+            # in-flight import windows move WITH the promotion: the same
+            # epoch re-fences, so the resumed drain's re-issues stay
+            # idempotent and a stale coordinator stays fenced out
+            for j in MigrationJournal.in_flight(self.journal_dir):
+                planned = j.entry("PLANNED")
+                if not planned or planned.get("kind") == "device_rebalance":
+                    continue
+                if planned["target"] == dead_addr:
+                    for s in planned["slots"]:
+                        topology.check_reply(c.execute(
+                            "CLUSTER", "SETSLOT", int(s), "IMPORTING",
+                            planned["source"], "EPOCH", j.epoch,
+                        ))
+            # replay the dead target's journaled batches onto the promoted
+            # node BEFORE superseding the journal: the REPLPUSH cover on the
+            # import ack is best-effort (a stalled shipper or unhealthy
+            # replica link ships nothing and the ack still authorized the
+            # source's delete), so the journal — the one durability point
+            # the ack actually proved — must not be retired on an
+            # assumption.  apply-by-version makes the replay a no-op for
+            # every batch the replica DID receive, and the EPOCH stamp
+            # re-journals the batches under the promoted node's own import
+            # journal, which the resumed migration's STABLE then settles.
+            for ij in inflight_imports:
+                for blob in ij.batch_blobs():
+                    args = ["IMPORTRECORDS", "EPOCH", ij.epoch]
+                    if ij.source:
+                        args += ["SOURCE", ij.source]
+                    topology.check_reply(
+                        c.execute(*args, blob, timeout=60.0)
+                    )
+        for ij in inflight_imports:
+            ij.append("STABLE", superseded_by=rep.address)
+        new_view = [
+            (lo, hi, rep.host, rep.port, rep.node_id)
+            if f"{h}:{p}" == dead_addr else (lo, hi, h, p, nid)
+            for lo, hi, h, p, nid in self.current_view()
+        ]
+        rep.role, master.role = "master", "replica"
+        self.replicas.remove(rep)
+        rep.master_index = None
+        self.masters[mi] = rep
+        master.master_index = mi
+        self.replicas.append(master)
+        call_with_retry(
+            self._rejoin_retry_policy(),
+            lambda: topology.install_view(
+                [self._conn_factory(n) for n in self.nodes() if n.alive()],
+                new_view,
+            ),
+        )
+        return rep
+
+    def rolling_restart(
+        self,
+        nodes: Optional[Sequence[NodeProc]] = None,
+        grace: float = 15.0,
+        health_timeout: float = 60.0,
+    ) -> List[Dict[str, object]]:
+        """Restart/upgrade a LIVE fleet one node at a time with zero acked
+        loss: per node — drain (``REPLFLUSH`` ships everything dirty to its
+        replicas, ``SAVE`` pins the restart's restore point), escalating
+        graceful stop, respawn on the same address, then a health barrier
+        (cluster routable end to end, the restarted node answering, its
+        replica links re-attached) before the roll moves on.  Replicas
+        roll first so no master ever loses its last replica mid-step.
+        Default order covers every node; pass ``nodes`` to roll a subset
+        (e.g. masters only).  Returns one summary dict per node rolled."""
+        order = (
+            list(nodes) if nodes is not None
+            else list(self.replicas) + list(self.masters)
+        )
+        rolled: List[Dict[str, object]] = []
+        for node in order:
+            if node.alive():
+                try:
+                    with self.conn(node, timeout=60.0) as c:
+                        c.execute("REPLFLUSH", timeout=30.0)
+                        reply = c.execute("SAVE", timeout=60.0)
+                        if isinstance(reply, RespError):
+                            raise reply
+                except Exception:  # noqa: BLE001 — wedged node: the
+                    pass           # escalating stop below still bounds us
+            rc = self.stop(node, timeout=grace)
+            # force: if even SIGKILL could not reap in time (rc None), the
+            # retried stop inside restart() keeps the roll bounded instead
+            # of silently no-opping on a still-"alive" zombie
+            self.restart(node, force=True)
+            self._health_barrier(node, timeout=health_timeout)
+            rolled.append({
+                "node": node.name, "exit_code": rc,
+                "generation": node.generation,
+            })
+        return rolled
+
+    def _health_barrier(self, node: NodeProc, timeout: float = 60.0) -> None:
+        """One roll step's gate: the fleet routes end to end again AND the
+        restarted node's replication links are re-attached (a master must
+        list its live replicas — replication catch-up restarts from the
+        full-sync pull ``wire_replica`` triggers) before the next node goes
+        down."""
+        deadline = time.monotonic() + timeout
+        client = self.client(scan_interval=0.5)
+        try:
+            if not client.wait_routable(
+                timeout=max(1.0, deadline - time.monotonic())
+            ):
+                raise NodeStartupError(
+                    f"fleet not routable after rolling {node.name}\n"
+                    + self.log_tail(node)
+                )
+        finally:
+            client.shutdown()
+        want = [
+            rep for rep in self.replicas
+            if node.role == "master" and rep.master_index is not None
+            and self.masters[rep.master_index] is node and rep.alive()
+        ]
+        while want:
+            try:
+                with self.conn(node, timeout=10.0) as c:
+                    have = {
+                        topology._s(a) for a in c.execute("REPLICAS") or []
+                    }
+                if all(rep.address in have for rep in want):
+                    return
+            except Exception:  # noqa: BLE001 — node still settling
+                pass
+            if time.monotonic() >= deadline:
+                raise NodeStartupError(
+                    f"replicas never re-attached to {node.name} after roll"
+                )
+            time.sleep(0.1)
 
     # -- topology -------------------------------------------------------------
 

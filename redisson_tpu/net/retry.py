@@ -70,6 +70,26 @@ class RetryPolicy:
         return RetryClock(self)
 
 
+def call_with_retry(policy: RetryPolicy, fn, retryable=(Exception,)):
+    """Run ``fn()`` under a policy's schedule: retry on ``retryable`` until
+    the attempt budget or deadline runs out, then re-raise the LAST failure
+    (a DeadlineExceeded mid-backoff chains it as ``__cause__``).  The one
+    call shape control-plane loops need (supervisor view learning during a
+    rolling restart, replica re-wiring) without hand-rolled sleep loops."""
+    clock = policy.start()
+    while True:
+        clock.attempt += 1
+        try:
+            return fn()
+        except retryable as e:  # noqa: PERF203 — retry loop by definition
+            if not clock.more_attempts():
+                raise
+            try:
+                clock.sleep()
+            except DeadlineExceeded as dl:
+                raise dl from e
+
+
 class RetryClock:
     """One operation's view of a RetryPolicy: attempt budget + armed
     deadline.  ``sleep()`` truncates the backoff to the remaining budget

@@ -49,7 +49,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from redisson_tpu.net.client import NodeClient
 from redisson_tpu.net.retry import RetryPolicy
-from redisson_tpu.server.migration_journal import MigrationJournal
+from redisson_tpu.server.migration_journal import ImportJournal, MigrationJournal
 from redisson_tpu.utils.crc16 import MAX_SLOT
 
 
@@ -115,6 +115,7 @@ def resume_migrations(
     password: Optional[str] = None,
     ssl_context=None,
     gc_keep: Optional[int] = 64,
+    readdress: Optional[Dict[str, str]] = None,
 ) -> List[Dict[str, Any]]:
     """Settle every in-flight migration the journal directory records —
     the coordinator-restart path.  Idempotent: re-running it (even after
@@ -137,9 +138,26 @@ def resume_migrations(
     After settling, terminal journals older than the newest ``gc_keep`` are
     pruned (``MigrationJournal.gc`` — the GC policy long-lived coordinators
     need so the journal directory stops growing one file per migration
-    forever); pass ``gc_keep=None`` to keep everything.
+    forever; terminal IMPORT journals ride the same sweep, in-flight ones
+    never do); pass ``gc_keep=None`` to keep everything.
+
+    ``readdress`` maps a DEAD node's address to its promoted successor's
+    (``ClusterSupervisor.promote_replica``): every replayed verb, dial, and
+    recorded view row naming the old address is rewritten to the new one —
+    the replica that REPLPUSH-covered the in-flight import batches becomes
+    the migration's target and the pair still converges to STABLE.
     """
     out: List[Dict[str, Any]] = []
+    myid_cache: Dict[str, Optional[str]] = {}
+    for ij in ImportJournal.in_flight(journal_dir):
+        # a torn OPENED line (crash mid-first-append) leaves an import
+        # journal with zero intact entries: no batch ever became durable,
+        # but no node will claim it (its target is unreadable) — settle it
+        # here or it reads in-flight forever and gc pins its coordinator
+        # journal for eternity
+        if not ij.entries:
+            ij.append("ROLLED_BACK", resumed=True,
+                      reason="torn import journal; no durable batches")
     for journal in MigrationJournal.in_flight(journal_dir):
         planned = journal.entry("PLANNED")
         if planned is None:  # only a torn PLANNED line: nothing ever ran
@@ -152,6 +170,10 @@ def resume_migrations(
             # resume_device_rebalances — treating one as a slot migration
             # would dial "dev:N" as a node address
             continue
+        if readdress:
+            planned = _readdress_planned(
+                planned, readdress, myid_cache, password, ssl_context
+            )
         run = _MigrationRun(
             planned["source"], planned["target"], planned["slots"],
             all_nodes=planned.get("all_nodes"), password=password,
@@ -179,6 +201,58 @@ def resume_migrations(
     return out
 
 
+def _readdress_planned(
+    planned: Dict[str, Any],
+    readdress: Dict[str, str],
+    myid_cache: Dict[str, Optional[str]],
+    password: Optional[str],
+    ssl_context,
+) -> Dict[str, Any]:
+    """Rewrite a PLANNED entry's addresses through a failover mapping
+    ({dead "host:port": promoted "host:port"}): source/target dials plus
+    every recorded view row, whose node id becomes the successor's (fetched
+    once per address, best-effort — an unreachable successor keeps the
+    recorded id and the resume reports "failed" for the next pass)."""
+    def _myid(addr: str) -> Optional[str]:
+        if addr not in myid_cache:
+            c = None
+            try:
+                c = _admin(addr, password, ssl_context)
+                myid_cache[addr] = _s(c.execute("CLUSTER", "MYID"))
+            except Exception:  # noqa: BLE001 — successor unreachable too
+                myid_cache[addr] = None
+            finally:
+                if c is not None:
+                    c.close()
+        return myid_cache[addr]
+
+    out = dict(planned)
+    out["source"] = readdress.get(planned["source"], planned["source"])
+    out["target"] = readdress.get(planned["target"], planned["target"])
+    if planned.get("all_nodes"):
+        out["all_nodes"] = [
+            readdress.get(a, a) for a in planned["all_nodes"]
+        ]
+    if out["target"] != planned["target"]:
+        out["target_id"] = _myid(out["target"]) or planned.get("target_id")
+    for key in ("old_view", "new_view"):
+        rows = planned.get(key)
+        if not rows:
+            continue
+        rewritten = []
+        for lo, hi, h, p, nid in (tuple(r) for r in rows):
+            addr = f"{h}:{p}"
+            if addr in readdress:
+                nh, _, np_ = readdress[addr].rpartition(":")
+                rewritten.append(
+                    (lo, hi, nh, int(np_), _myid(readdress[addr]) or nid)
+                )
+            else:
+                rewritten.append((lo, hi, h, p, nid))
+        out[key] = rewritten
+    return out
+
+
 def rearm_recovery(server, journal_dir: str) -> int:
     """Boot-time journal re-arm for a RESTARTED server process (ISSUE 6).
 
@@ -199,12 +273,63 @@ def rearm_recovery(server, journal_dir: str) -> int:
       * this node is the TARGET — re-fence the epoch and re-arm the
         IMPORTING window so in-flight ASK traffic is admitted again.
 
-    Returns the number of slot windows re-armed.  Wired to the CLI as
-    ``tpu-server --journal-dir`` (the ClusterSupervisor passes its
-    coordinator journal dir to every node it spawns).
+    The IMPORTING arm (ISSUE 13) additionally replays this node's import
+    journals: every batch this node journaled-then-acked is re-applied on
+    top of the restored checkpoint (idempotent — ``apply_records``
+    reconciles by version), because the source deleted those records on the
+    strength of the ack and the SIGKILL took the applied copies with the
+    process.  Replay policy per the matching COORDINATOR journal:
+
+      * in flight — replay, keep the import journal open (the resumed
+        migration's final SETSLOT STABLE settles it);
+      * STABLE — replay (the records are this node's to keep; only their
+        durable copy may predate the crash) and terminalize;
+      * ROLLED_BACK and this node was the migration's TARGET — do NOT
+        replay (the rollback reverse-drained the records home;
+        resurrecting them would fork ownership), terminalize;
+      * ROLLED_BACK and this node was the SOURCE — the journal holds the
+        REVERSE drain's batches, which belong here: replay, terminalize;
+      * missing (externally pruned — gc keeps coordinator journals whose
+        epoch has an in-flight import journal, so this is abnormal) —
+        favor durability: replay and terminalize.
+
+    Returns the number of slot windows re-armed plus import journals
+    replayed.  Wired to the CLI as ``tpu-server --journal-dir`` (the
+    ClusterSupervisor passes its coordinator journal dir to every node it
+    spawns).
     """
+    from redisson_tpu.server import replication
+
     n = 0
     addr = server.address()
+    coordinator: Dict[int, MigrationJournal] = {}
+    for journal in MigrationJournal.scan(journal_dir):
+        planned = journal.entry("PLANNED")
+        if planned is not None and planned.get("kind") != "device_rebalance":
+            coordinator[journal.epoch] = journal
+    for ij in ImportJournal.in_flight(journal_dir):
+        if ij.target != addr:
+            continue
+        cj = coordinator.get(ij.epoch)
+        cj_planned = cj.entry("PLANNED") if cj is not None else None
+        if cj is not None and cj.phase == "ROLLED_BACK" \
+                and (cj_planned or {}).get("source") != addr:
+            ij.append("ROLLED_BACK", resumed=True,
+                      reason="migration rolled back; records went home")
+            continue
+        for blob in ij.batch_blobs():
+            replication.apply_records(server.engine, blob)
+        n += 1
+        if cj is None or cj.is_terminal():
+            # the replayed records live only in memory until a checkpoint
+            # covers them — terminalizing before that would hand a second
+            # crash nothing to replay.  On save failure the journal stays
+            # in flight on disk for the next boot.
+            if server._checkpoint_import_state():
+                ij.append("STABLE", resumed=True,
+                          reason="migration already settled")
+        else:
+            server.adopt_import_journal(ij)
     for journal in MigrationJournal.in_flight(journal_dir):
         planned = journal.entry("PLANNED")
         if planned is None or planned.get("kind") == "device_rebalance":
@@ -214,7 +339,7 @@ def rearm_recovery(server, journal_dir: str) -> int:
         if planned["source"] == addr:
             for s in slots:
                 server.fence_slot_epoch(s, epoch)
-                server.set_slot_migrating(s, planned["target"])
+                server.set_slot_migrating(s, planned["target"], epoch)
                 server.set_slot_recovering(s, planned["target"], epoch)
                 n += 1
         elif planned["target"] == addr:
@@ -273,6 +398,24 @@ class _MigrationRun:
     def _connect(self) -> None:
         self.src = _admin(self.source, self.password, self.ssl_context)
         self.tgt = _admin(self.target, self.password, self.ssl_context)
+
+    def _target_reachable(self) -> bool:
+        """One cheap fresh-connection PING (no retry schedule): decides
+        whether a failed journaled migration may roll back now or must stay
+        in flight for a forward resume."""
+        c = None
+        try:
+            c = NodeClient(
+                self.target, password=self.password, ping_interval=0,
+                retry_attempts=1, ssl_context=self.ssl_context,
+            )
+            c.execute("PING", timeout=2.0)
+            return True
+        except Exception:  # noqa: BLE001 — any failure reads as dead
+            return False
+        finally:
+            if c is not None:
+                c.close()
 
     def _close(self) -> None:
         for c in (self.src, self.tgt):
@@ -382,6 +525,20 @@ class _MigrationRun:
         except CoordinatorKilled:
             raise  # a 'dead' coordinator runs nothing — resume owns recovery
         except BaseException as primary:
+            if window_open and self.journal is not None \
+                    and not self._target_reachable():
+                # The target died mid-migration (ISSUE 13): it may hold
+                # journaled import batches whose source copies the drain
+                # already deleted, and a rollback that cannot reach it
+                # would close the window and restore the old view — the
+                # source would then recreate those keys at version 0 and
+                # the resumed drain's reconciliation would drop their
+                # journaled (newer) lineage.  Leave the journal IN FLIGHT
+                # and the window armed instead: drained keys keep
+                # ASK-redirecting (brief unavailability, not a fork) until
+                # resume_migrations completes the pair forward once the
+                # target — or its promoted replica (readdress=) — is back.
+                raise
             if window_open:
                 try:
                     _rollback(

@@ -40,6 +40,7 @@ seeded program).
 """
 from __future__ import annotations
 
+import base64
 import json
 import os
 import time
@@ -63,6 +64,10 @@ class MigrationJournal:
     """One migration's write-ahead journal (append-only, fsync'd)."""
 
     SUFFIX = ".journal"
+    # subclasses (ImportJournal) carry their own phase alphabet on the class
+    # so append()/is_terminal() validate against the right one
+    PHASES = PHASES
+    TERMINAL = TERMINAL_PHASES
 
     def __init__(self, path: str, entries: Optional[List[Dict[str, Any]]] = None,
                  intact_bytes: Optional[int] = None):
@@ -97,7 +102,7 @@ class MigrationJournal:
         return self.entries[-1]["phase"] if self.entries else None
 
     def is_terminal(self) -> bool:
-        return self.phase in TERMINAL_PHASES
+        return self.phase in self.TERMINAL
 
     def entry(self, phase: str) -> Optional[Dict[str, Any]]:
         """First entry recorded for `phase` (PLANNED is the canonical one)."""
@@ -119,8 +124,10 @@ class MigrationJournal:
         """Record one phase entry durably: the entry is on disk (file
         fsync'd; directory too on creation) before this returns — the
         write-AHEAD property callers rely on."""
-        if phase not in PHASES:
-            raise ValueError(f"unknown journal phase {phase!r}; one of {PHASES}")
+        if phase not in self.PHASES:
+            raise ValueError(
+                f"unknown journal phase {phase!r}; one of {self.PHASES}"
+            )
         entry: Dict[str, Any] = {"phase": phase, "ts": time.time(), **data}
         payload = json.dumps(entry, sort_keys=True, separators=(",", ":"))
         line = (
@@ -221,17 +228,113 @@ class MigrationJournal:
         terminal entry marks a migration as safe to forget — and epoch
         monotonicity survives because ``create`` allocates one past the
         highest epoch still present (the kept tail).  Returns the removed
-        paths."""
+        paths.
+
+        Import journals (ISSUE 13) ride the same sweep: a target's TERMINAL
+        import journal is pruned by the same keep policy, an in-flight one
+        never is — and a coordinator journal whose epoch still has an
+        in-flight import journal anywhere is kept regardless of age, because
+        the target's boot-time replay (``rearm_recovery``) needs the
+        coordinator record to decide replay-vs-discard."""
         if keep < 1:
             raise ValueError(f"gc keep must be >= 1, got {keep}")
-        terminal = [j for j in cls.scan(journal_dir) if j.is_terminal()]
+        imports = ImportJournal.scan(journal_dir)
+        live_import_epochs = {j.epoch for j in imports if not j.is_terminal()}
+        groups = (
+            [
+                j for j in MigrationJournal.scan(journal_dir)
+                if j.is_terminal() and j.epoch not in live_import_epochs
+            ],
+            [j for j in imports if j.is_terminal()],
+        )
         removed: List[str] = []
-        for j in terminal[:-keep]:
-            try:
-                os.remove(j.path)
-            except OSError:
-                continue  # racing coordinator already pruned it
-            removed.append(j.path)
+        for group in groups:  # each epoch-sorted; keep applies per kind
+            for j in group[:-keep]:
+                try:
+                    os.remove(j.path)
+                except OSError:
+                    continue  # racing coordinator already pruned it
+                removed.append(j.path)
         if removed:
             _fsync_dir(os.path.abspath(journal_dir))
         return removed
+
+
+class ImportJournal(MigrationJournal):
+    """The RECEIVING side's write-ahead journal (ISSUE 13 tentpole).
+
+    ``migrate_slot_batch`` deletes a record from the source the moment the
+    target acks its ``IMPORTRECORDS`` batch — so a SIGKILLed target whose
+    memory held the only applied copy used to lose every record the source
+    had already deleted (the documented target-kill durability gap).  The
+    fix is this mirror of :class:`MigrationJournal` on the TARGET node: each
+    accepted batch is appended (fsync'd, CRC-per-line, epoch-stamped)
+    BEFORE the ack goes out, so the source only deletes records the target
+    has made durable, and a restarted target replays its import journals at
+    boot (``migration.rearm_recovery``) on top of whatever checkpoint it
+    restored — ``apply_records`` reconciles by version, so replay is
+    idempotent.
+
+    One file per (migration epoch, target address); same line format, CRC
+    torn-tail handling, and directory as the coordinator journals (the
+    supervisor's shared ``journal_dir``), distinguished by suffix so the two
+    scans never cross.  Phases::
+
+        OPENED        identity: target, source, epoch — first entry
+        BATCH         one accepted IMPORTRECORDS blob (base64), pre-ack
+        STABLE        terminal: the migration settled (either direction)
+        ROLLED_BACK   terminal: the migration rolled back and the records
+                      went home — boot replay must NOT resurrect them
+    """
+
+    SUFFIX = ".import"
+    PHASES = ("OPENED", "BATCH", "STABLE", "ROLLED_BACK")
+    TERMINAL = frozenset({"STABLE", "ROLLED_BACK"})
+
+    @classmethod
+    def path_for(cls, journal_dir: str, target: str, epoch: int) -> str:
+        safe = target.replace(":", "_").replace("/", "_")
+        return os.path.join(journal_dir, f"imp-{epoch:08d}-{safe}{cls.SUFFIX}")
+
+    @classmethod
+    def open_for(cls, journal_dir: str, target: str, epoch: int,
+                 source: Optional[str] = None) -> "ImportJournal":
+        """Find-or-create the target's journal for one migration epoch; a
+        fresh journal records its OPENED identity entry immediately (so even
+        a crash before the first batch leaves the pairing on disk)."""
+        os.makedirs(journal_dir, exist_ok=True)
+        path = cls.path_for(journal_dir, target, epoch)
+        j = cls.open(path) if os.path.exists(path) else cls(path)
+        if not j.entries:
+            j.append("OPENED", target=target, source=source, epoch=epoch)
+        return j
+
+    def append_batch(self, blob: bytes) -> None:
+        """Make one transfer batch durable BEFORE it is acked — the
+        write-ahead hop that closes the target-kill gap."""
+        self.append(
+            "BATCH",
+            blob=base64.b64encode(bytes(blob)).decode("ascii"),
+            nbytes=len(blob),
+        )
+
+    def batch_blobs(self) -> List[bytes]:
+        """Every journaled batch, in arrival order — the boot replay feed."""
+        return [
+            base64.b64decode(e["blob"])
+            for e in self.entries
+            if e["phase"] == "BATCH"
+        ]
+
+    def batch_count(self) -> int:
+        return sum(1 for e in self.entries if e["phase"] == "BATCH")
+
+    @property
+    def target(self) -> Optional[str]:
+        opened = self.entry("OPENED")
+        return opened.get("target") if opened else None
+
+    @property
+    def source(self) -> Optional[str]:
+        opened = self.entry("OPENED")
+        return opened.get("source") if opened else None

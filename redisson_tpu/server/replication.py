@@ -461,6 +461,71 @@ class ReplicationSource:
         """Ship everything dirty NOW, synchronously (the WAIT analog)."""
         return self._ship_once()
 
+    def cover(self, names: Optional[List[str]] = None) -> int:
+        """The import-ack covering hop (ISSUE 13 replica-covered targets):
+        ship state to the replicas NOW — scoped to `names` (the records an
+        IMPORTRECORDS frame just applied) when given, else everything
+        dirty — and report how many replicas are healthy after the push.
+        The IMPORTRECORDS handler calls this BEFORE acking, so the
+        source's delete is additionally backed by the target's replica
+        set; the import journal stays the primary durability (promotion
+        replays it), so a failed cover loses nothing.  A scoped cover
+        ships full arrays with no live-name list (no prune semantics) —
+        O(batch) work per frame, never a full-store dirty scan."""
+        if names is None:
+            self._ship_once()
+        else:
+            self._cover_names(names)
+        with self._lock:
+            return sum(1 for h in self._replicas.values() if h.healthy)
+
+    def _cover_names(self, names: List[str]) -> int:
+        """Name-scoped synchronous ship (the per-import-frame cover)."""
+        if self._stalled.is_set():
+            return 0  # chaos contract: a stalled stream ships NOTHING
+        with self._lock:
+            replicas = list(self._replicas.values())
+        if not replicas or not names:
+            return 0
+        from redisson_tpu.net.resp import RespError
+
+        with self._ship_mutex:
+            snap = snapshot_records(self.server.engine, sorted(set(names)))
+            if not snap:
+                return 0
+            records = []
+            shipped_now = []
+            for name, item in snap.items():
+                head = {k: item[k] for k in _HEAD_FIELDS}
+                head["arrays"] = item["arrays"]
+                records.append(head)
+                shipped_now.append((name, item["nonce"], item["version"]))
+            blob = _wire_payload(records, None)
+            total = 0
+            for h in replicas:
+                try:
+                    self._push_blob(h, blob)
+                    h.healthy = True
+                except Exception as e:  # noqa: BLE001 — interval sweep retries
+                    if isinstance(e, RespError):
+                        # replica alive but rejected the apply: forget what
+                        # we think it holds so the next sweep full-ships
+                        for name, _n, _v in shipped_now:
+                            h.shipped.pop(name, None)
+                    else:
+                        h.healthy = False
+                    continue
+                for name, nonce, version in shipped_now:
+                    # advances shipped state so the interval sweep skips
+                    # these versions; the delta baseline stays put (a later
+                    # mutation simply full-ships once)
+                    h.shipped[name] = (nonce, version)
+                total += len(shipped_now)
+                self.stats["pushes"] += 1
+                self.stats["bytes"] += len(blob)
+                self.stats["records_full"] += len(records)
+            return total
+
     def _dirty_for(self, handle: ReplicaHandle) -> Tuple[List[str], List[str]]:
         """(records to ship, shipped names since deleted on the master)."""
         engine = self.server.engine

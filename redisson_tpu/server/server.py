@@ -171,6 +171,7 @@ class TpuServer:
         devices: Optional[Any] = None,
         qos: Optional[bool] = None,
         dispatch_ahead: Optional[int] = None,
+        journal_dir: Optional[str] = None,
     ):
         self.engine = engine if engine is not None else Engine()
         # device-sharded serving (ISSUE 8): `devices` maps the 16384-slot
@@ -305,6 +306,22 @@ class TpuServer:
         # carries a lower epoch and is rejected (STALEEPOCH) — the fencing
         # that makes journal replay safe under coordinator races.
         self.slot_epochs: Dict[int, int] = {}
+        # the ACTIVE journaled epoch per MIGRATING slot (set when SETSLOT
+        # MIGRATING carries EPOCH, popped on STABLE): the drain stamps it
+        # onto every outgoing IMPORTRECORDS so the target journals the
+        # batch before acking.  Distinct from slot_epochs, which is the
+        # fencing high-water mark and survives past STABLE — stamping from
+        # it would mis-attribute a later unjournaled migration's batches
+        # to a settled journal.
+        self.migrating_epochs: Dict[int, int] = {}
+        # import-side journal plane (ISSUE 13): the shared journal
+        # directory (``--journal-dir`` / ClusterSupervisor) this node
+        # writes its ImportJournals into, plus the OPEN journals by epoch —
+        # settled on the migration's final SETSLOT STABLE, replayed by
+        # migration.rearm_recovery after a crash
+        self.journal_dir = journal_dir
+        self._import_journals: Dict[int, Any] = {}
+        self._import_journal_lock = threading.Lock()
         # -- cluster / replication role (server/replication.py) -------------
         self.role = "master"  # "master" | "replica"
         self.master_address: Optional[str] = None
@@ -618,8 +635,13 @@ class TpuServer:
             )
         self.slot_epochs[slot] = epoch
 
-    def set_slot_migrating(self, slot: int, target: str) -> None:
+    def set_slot_migrating(self, slot: int, target: str,
+                           epoch: Optional[int] = None) -> None:
         self.migrating_slots[slot] = target
+        if epoch is not None:
+            # journaled drain: outgoing IMPORTRECORDS carry this epoch so
+            # the target journals each batch before acking (ISSUE 13)
+            self.migrating_epochs[slot] = epoch
         self.engine.store.absent_guard = self._migration_absent_guard
 
     def set_slot_importing(self, slot: int, source: str) -> None:
@@ -651,8 +673,10 @@ class TpuServer:
         self.migrating_slots.pop(slot, None)
         self.importing_slots.pop(slot, None)
         self.recovering_slots.pop(slot, None)  # resume settled the journal
+        self.migrating_epochs.pop(slot, None)
         if not self.migrating_slots:
             self.engine.store.absent_guard = None
+        self._settle_import_journals(epoch)
         if migrated:
             # handoff finalized on the SOURCE: whatever the per-key drain
             # stream didn't already invalidate (keys read-but-absent, keys
@@ -660,6 +684,103 @@ class TpuServer:
             # command's epoch — None (unfenced legacy migration) always
             # emits, a journaled re-issue at its own epoch dedupes
             self.tracking.invalidate_slot(slot, epoch)
+
+    # -- import-side journal (ISSUE 13: the target-kill durability gap) -------
+
+    def journal_import_batch(self, epoch: int, source: Optional[str],
+                             blob: bytes) -> None:
+        """Make one accepted IMPORTRECORDS batch durable (fsync'd into this
+        node's ImportJournal) BEFORE it is applied or acked — the source
+        deletes a record only once its batch survives a SIGKILL here.  A
+        batch arriving for an epoch whose journal is already terminal is a
+        stale re-ship of a settled migration: applied (idempotent by
+        version) but not re-journaled — terminal journals stay terminal."""
+        from redisson_tpu.server.migration_journal import ImportJournal
+
+        if self.journal_dir is None:
+            return
+        with self._import_journal_lock:
+            j = self._import_journals.get(epoch)
+            if j is None:
+                j = ImportJournal.open_for(
+                    self.journal_dir, self.address(), epoch, source=source
+                )
+                if j.is_terminal():
+                    return
+                self._import_journals[epoch] = j
+            j.append_batch(blob)
+
+    def adopt_import_journal(self, journal) -> None:
+        """Boot-time re-adoption (migration.rearm_recovery): a replayed
+        in-flight import journal stays open on the restarted node so the
+        resumed migration's final SETSLOT STABLE settles it."""
+        with self._import_journal_lock:
+            self._import_journals[journal.epoch] = journal
+
+    def import_journal_rows(self) -> List[Tuple[int, str, int, str]]:
+        """(epoch, phase, batches journaled, source) per OPEN import
+        journal — the CLUSTER WINDOWS rows that let an operator see an
+        in-flight import from the receiving end."""
+        with self._import_journal_lock:
+            return [
+                (epoch, j.phase or "", j.batch_count(), j.source or "")
+                for epoch, j in sorted(self._import_journals.items())
+            ]
+
+    def _settle_import_journals(self, epoch: Optional[int]) -> None:
+        """Terminalize the import journal for `epoch` once its migration's
+        LAST window slot goes STABLE (no remaining MIGRATING/IMPORTING/
+        RECOVERING slot fenced at that epoch) — after which gc may prune it
+        and a restart no longer replays it."""
+        if epoch is None or not self._import_journals:
+            return
+
+        def _settleable() -> bool:
+            j = self._import_journals.get(epoch)
+            if j is None:
+                return False
+            open_slots = (
+                set(self.importing_slots) | set(self.migrating_slots)
+                | set(self.recovering_slots)
+            )
+            # still a window in flight for this migration? not settleable
+            return not any(
+                self.slot_epochs.get(s) == epoch for s in open_slots
+            )
+
+        with self._import_journal_lock:
+            if not _settleable():
+                return
+        # durability point OUTSIDE the lock: a concurrent drain's
+        # journal-and-ack (journal_import_batch) must not stall behind a
+        # full-store snapshot and time its source's link out
+        if not self._checkpoint_import_state():
+            return  # not durable yet: keep the journal for boot replay
+        with self._import_journal_lock:
+            if not _settleable():  # a re-opened window raced the save
+                return
+            self._import_journals.pop(epoch).append("STABLE", settled=True)
+
+    def _checkpoint_import_state(self) -> bool:
+        """Make the imported records as durable as this node's normal
+        story BEFORE an import journal retires: the journal holds the only
+        durable copy of batches whose source copies are already deleted,
+        so it may only terminalize once a checkpoint covers them — else a
+        SIGKILL after STABLE but before the next snapshot would restore a
+        pre-import checkpoint with nothing left to replay.  A node with no
+        checkpoint configured has no durability floor to wait for.
+        Returns False (journal kept in flight, replayed at next boot) when
+        the save fails."""
+        if self.checkpoint_path is None:
+            return True
+        from redisson_tpu.core import checkpoint
+
+        try:
+            checkpoint.save(self.engine, self.checkpoint_path)
+            self.__dict__["_lastsave"] = int(time.time())
+            return True
+        except Exception:  # noqa: BLE001 — keep the journal instead
+            return False
 
     def slot_names(self, slot: int) -> List[str]:
         from redisson_tpu.utils.crc16 import calc_slot
@@ -735,7 +856,18 @@ class TpuServer:
                     )
                     if not shipped:
                         continue
-                    link.execute("IMPORTRECORDS", blob, timeout=30.0)
+                    ep = self.migrating_epochs.get(slot)
+                    if ep is not None:
+                        # journaled migration: the target fsyncs the batch
+                        # into its ImportJournal BEFORE this ack — the
+                        # local delete below is then safe against a target
+                        # SIGKILL (ISSUE 13 target-kill gap)
+                        link.execute(
+                            "IMPORTRECORDS", "EPOCH", ep, "SOURCE",
+                            self.address(), blob, timeout=30.0,
+                        )
+                    else:
+                        link.execute("IMPORTRECORDS", blob, timeout=30.0)
                     self.engine.store.delete_unguarded(name)
                     moved += 1
                     # drain-stream invalidation: the record just left this
@@ -1723,14 +1855,19 @@ class TpuServer:
                 pass                                     # exotic loop
         await self.start_async()
         if journal_dir is not None:
+            # the node's import journals live here too (ISSUE 13): the
+            # IMPORTRECORDS handler needs the dir armed before serving
+            self.journal_dir = journal_dir
+        if self.journal_dir is not None:
             # BEFORE the ready line goes out (supervised clients gate on
             # it): re-arm migration windows this node was a party to when
             # it last died — restored copies of mid-migration slots must
-            # answer TRYAGAIN, not serve a forked lineage (see
-            # migration.rearm_recovery)
+            # answer TRYAGAIN, not serve a forked lineage — and replay the
+            # import journals whose batches this node acked but may have
+            # lost with its memory (migration.rearm_recovery)
             from redisson_tpu.server.migration import rearm_recovery
 
-            rearm_recovery(self, journal_dir)
+            rearm_recovery(self, self.journal_dir)
         if ready_fd is not None:
             line = f"READY {self.host} {self.port} {os.getpid()}\n".encode()
             try:

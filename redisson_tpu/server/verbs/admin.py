@@ -122,7 +122,7 @@ def cmd_cluster(server, ctx, args):
             rest = rest[:-2]
         server.fence_slot_epoch(slot, epoch)
         if mode == b"MIGRATING":
-            server.set_slot_migrating(slot, _s(rest[0]))
+            server.set_slot_migrating(slot, _s(rest[0]), epoch)
             return "+OK"
         if mode == b"IMPORTING":
             server.set_slot_importing(slot, _s(rest[0]))
@@ -169,6 +169,15 @@ def cmd_cluster(server, ctx, args):
         out += [
             [b"RECOVERING", s, t.encode()]
             for s, t in sorted(server.recovering_slots.items())
+        ]
+        # target-side import-journal state (ISSUE 13): an operator can see
+        # an in-flight import from the RECEIVING end — epoch, phase,
+        # batches made durable pre-ack, and the draining source.  Rows
+        # disappear when the migration's last slot goes STABLE (the
+        # journal terminalizes), so "no windows" keeps meaning "settled".
+        out += [
+            [b"IMPORTJOURNAL", epoch, phase.encode(), batches, src.encode()]
+            for epoch, phase, batches, src in server.import_journal_rows()
         ]
         return out
     if sub == b"COUNTKEYSINSLOT":
@@ -288,13 +297,64 @@ def _tracking_invalidator(server):
 
 @register("IMPORTRECORDS")
 def cmd_importrecords(server, ctx, args):
-    """Install migrated records (slot-migration transfer frame; the blob
-    carries records only — no live-list pruning, unlike REPLPUSH)."""
+    """IMPORTRECORDS [EPOCH <n> [SOURCE <addr>]] <blob> — install migrated
+    records (slot-migration transfer frame; the blob carries records only —
+    no live-list pruning, unlike REPLPUSH).
+
+    With EPOCH (a journaled migration's fenced drain) and a configured
+    journal dir, the batch is fsync'd into this node's
+    :class:`~redisson_tpu.server.migration_journal.ImportJournal` BEFORE it
+    is applied or acked — the source deletes only records this node has
+    made durable, which closes the target-kill gap (ISSUE 13).  When
+    replicas are attached, the applied records are additionally
+    REPLPUSH-covered before the ack, so a dead target's promoted replica
+    carries the in-flight import forward.
+
+    A node started WITHOUT a journal dir accepts EPOCH frames but journals
+    nothing — the pre-ISSUE-13 degraded mode, kept for the manual/legacy
+    migration path.  The target-kill guarantee therefore requires the
+    fleet to share a journal dir; the ClusterSupervisor enforces this by
+    construction (``--journal-dir`` is passed to every node it spawns)."""
     from redisson_tpu.server import replication
 
-    return replication.apply_records(
-        server.engine, bytes(args[0]), on_applied=_tracking_invalidator(server)
+    rest = list(args)
+    epoch = source = None
+    while len(rest) > 2:
+        head = bytes(rest[0]).upper()
+        if head == b"EPOCH":
+            epoch = _int(rest[1])
+        elif head == b"SOURCE":
+            source = _s(rest[1])
+        else:
+            break
+        rest = rest[2:]
+    if len(rest) != 1:
+        raise RespError("ERR IMPORTRECORDS [EPOCH n [SOURCE addr]] <blob>")
+    blob = bytes(rest[0])
+    if epoch is not None:
+        # durability point FIRST: a SIGKILL after this line loses nothing
+        # the source will delete (the reply below is what authorizes it)
+        server.journal_import_batch(epoch, source, blob)
+    applied_names: list = []
+    tracking_cb = _tracking_invalidator(server)
+
+    def on_applied(names):
+        applied_names.extend(names)
+        if tracking_cb is not None:
+            tracking_cb(names)
+
+    applied = replication.apply_records(
+        server.engine, blob, on_applied=on_applied
     )
+    repl = server._replication
+    if epoch is not None and applied_names \
+            and repl is not None and repl.replicas():
+        # replica-covered target (journaled imports only — the legacy
+        # epoch-less path never promised it): best-effort push of JUST the
+        # applied records before the ack, so failover-by-promotion starts
+        # from a caught-up replica (the journal remains the proof)
+        repl.cover(applied_names)
+    return applied
 
 
 # -- replication (server/replication.py) -------------------------------------

@@ -244,3 +244,270 @@ def test_cross_process_kill_at_every_phase():
     assert report.resumed_rolled_back >= 2   # every PLANNED death rolls back
     assert report.resumed_completed >= 4
     assert report.bloom_keys_verified == 2 * 512
+
+
+# -- ISSUE 13: target kills, double kills, fleet lifecycle --------------------
+
+def test_cross_process_target_kill_mid_drain_smoke():
+    """The target-kill gap, closed (ISSUE 13 acceptance smoke): SIGKILL the
+    migration TARGET mid-drain (coordinator dead at DRAINING:1) — records
+    the source already deleted exist nowhere but the target's import
+    journal; the supervisor restart replays it at boot and
+    resume_migrations completes forward with zero acked-durable-write
+    loss, exactly-one-owner, all slots STABLE."""
+    from redisson_tpu.chaos.soak import (
+        ClusterProcSoakConfig, ClusterProcSoakHarness,
+    )
+
+    report = ClusterProcSoakHarness(ClusterProcSoakConfig(
+        cycles=1, crash_phases=("DRAINING:1",), victims="target",
+        keys=12, bloom_keys=128,
+    )).run()
+    assert report.cycles_completed == 1
+    assert report.server_sigkills == 1
+    assert report.resumed_completed == 1
+    assert report.verified_writes > 0
+    assert report.bloom_keys_verified == 128
+
+
+@pytest.mark.slow
+def test_cross_process_double_kill_at_every_phase():
+    """The DOUBLE-kill matrix across real process boundaries: coordinator
+    AND source AND target all SIGKILLed at each journal phase, both
+    servers restarted (the target's boot replays its import journal, the
+    source's re-arms RECOVERING fences), resume settles — idempotent,
+    zero acked-durable loss."""
+    from redisson_tpu.chaos.soak import (
+        ClusterProcSoakConfig, ClusterProcSoakHarness,
+    )
+
+    report = ClusterProcSoakHarness(ClusterProcSoakConfig(
+        cycles=1,
+        crash_phases=("PLANNED", "WINDOW_OPEN", "DRAINING:1", "VIEW_COMMITTED"),
+        victims="both",
+    )).run()
+    assert report.cycles_completed == 1
+    assert report.server_sigkills == 8   # two victims x four phases
+    assert report.resumed_rolled_back >= 1
+    assert report.resumed_completed >= 3
+    assert report.verified_writes > 0
+
+
+def test_stop_escalates_wedged_node_to_sigkill():
+    """Satellite: a SIGSTOPped (wedged) node ignores SIGTERM forever —
+    stop() must escalate to SIGKILL within its bounded grace and still
+    record the exit code, so no teardown or rolling restart can stall."""
+    import time as _time
+
+    s = ClusterSupervisor(masters=1, platform="cpu").start()
+    try:
+        node = s.masters[0]
+        s.pause(node)  # SIGSTOP: alive, answering nothing
+        t0 = _time.monotonic()
+        rc = s.stop(node, timeout=2.0)
+        took = _time.monotonic() - t0
+        assert rc == -signal.SIGKILL, rc
+        assert node.exit_codes[-1] == -signal.SIGKILL
+        assert not node.alive()
+        assert took < 15.0, f"escalating stop took {took:.1f}s"
+    finally:
+        s.shutdown()
+
+
+def test_rolling_restart_preserves_acked_writes(sup):
+    """rolling_restart drains (SAVE) + gracefully recycles every master one
+    at a time behind a health barrier: the fleet stays a cluster, every
+    pre-roll acked write survives, and each step exited 0 (graceful, not
+    escalated)."""
+    client = sup.client(scan_interval=0)
+    try:
+        assert client.wait_routable(timeout=30.0)
+        written = {}
+        for mi, (lo, hi) in enumerate(sup.slot_ranges):
+            k = _key_in_range(lo, hi, prefix=f"roll{mi}")
+            client.execute("SET", k, f"v{mi}")
+            written[k] = f"v{mi}"
+        gens = [n.generation for n in sup.masters]
+        rolled = sup.rolling_restart(nodes=sup.masters)
+        assert [r["exit_code"] for r in rolled] == [0, 0], rolled
+        assert [n.generation for n in sup.masters] == [g + 1 for g in gens]
+        assert client.wait_routable(timeout=30.0)
+        for k, v in written.items():
+            assert bytes(client.execute("GET", k)) == v.encode(), k
+    finally:
+        client.shutdown()
+
+
+def test_import_survives_kill_after_stable(sup):
+    """The import journal may only retire once a checkpoint covers the
+    imported state: complete a journaled migration, SIGKILL the new owner
+    immediately (before any SAVE barrier), restart — the settle-time
+    snapshot must bring the migrated record back even though the journal
+    is terminal and the source deleted its copy."""
+    from redisson_tpu.server.migration import migrate_slots
+
+    client = sup.client(scan_interval=0)
+    try:
+        assert client.wait_routable(timeout=30.0)
+        # a key currently owned by m0, wherever the slot lives by now
+        # (earlier tests may have moved slots): derive the owner live
+        key = "stable-kill-key"
+        client.execute("SET", key, "survives")
+        slot = calc_slot(key.encode())
+        owner = next(
+            n for n in sup.masters
+            if any(
+                bytes(x) == key.encode()
+                for x in _getkeys(sup, n, slot)
+            )
+        )
+        other = next(n for n in sup.masters if n is not owner)
+        moved = migrate_slots(owner.address, other.address, [slot],
+                              journal_dir=sup.journal_dir)
+        assert moved >= 1
+        rc = sup.kill(other)          # no SAVE barrier in between
+        assert rc == -signal.SIGKILL
+        sup.restart(other)
+        client.refresh_topology()
+        got = None
+        for _ in range(50):
+            try:
+                got = client.execute("GET", key)
+            except Exception:  # noqa: BLE001 — topology settling
+                got = None
+            if got is not None:
+                break
+            time.sleep(0.2)
+        assert got is not None and bytes(got) == b"survives"
+    finally:
+        client.shutdown()
+
+
+def _getkeys(sup, node, slot):
+    with sup.conn(node) as c:
+        return c.execute("CLUSTER", "GETKEYSINSLOT", slot, 1000) or []
+
+
+def test_promote_replica_carries_import_window_across_failover():
+    """Replica-covered targets (ISSUE 13): the import target dies mid-drain
+    with the coordinator; its replica — REPLPUSH-covered before every
+    import ack — is promoted WITH the in-flight IMPORTING window, and
+    resume_migrations(readdress=...) drives the pair to STABLE on the
+    promoted node.  The old master's import journal reads superseded."""
+    from redisson_tpu.cluster.chaos import kill_pair_at_phase
+    from redisson_tpu.server.migration import resume_migrations
+    from redisson_tpu.server.migration_journal import ImportJournal
+
+    s = ClusterSupervisor(masters=2, replicas_per_master=1,
+                          platform="cpu").start()
+    try:
+        client = s.client(scan_interval=0.5)
+        try:
+            assert client.wait_routable(timeout=30.0)
+            lo, hi = s.slot_ranges[0]
+            key = _key_in_range(lo, hi, prefix="promo")
+            client.execute("SET", key, "covered")
+            slot = calc_slot(key.encode())
+            src, dst = s.masters[0], s.masters[1]
+            dst_addr = dst.address
+            rcs = kill_pair_at_phase(
+                s, src, dst, [slot], "DRAINING:1", kill_target=True,
+            )
+            assert rcs["target"] == -signal.SIGKILL
+            # forge a journaled batch the replica never saw (the window an
+            # unhealthy link leaves: the ack's REPLPUSH cover is
+            # best-effort) — promotion must install it from the journal,
+            # not assume replica coverage
+            from redisson_tpu.server import replication
+            from redisson_tpu.server.migration_journal import (
+                ImportJournal as _IJ,
+            )
+            from redisson_tpu.server.server import ServerThread
+
+            ghost = next(
+                k for k in (f"ghost-{i}" for i in range(300000))
+                if calc_slot(k.encode()) == slot
+            )
+            st = ServerThread(port=0).start()
+            try:
+                with st.client() as c:
+                    c.execute("SET", ghost, "from-journal")
+                blob, shipped = replication.serialize_records(
+                    st.server.engine, [ghost], include_live=False
+                )
+                assert shipped
+            finally:
+                st.stop()
+            dead_journal = next(
+                j for j in _IJ.in_flight(s.journal_dir)
+                if j.target == dst.address
+            )
+            dead_journal.append_batch(blob)
+            promoted = s.promote_replica(dst)
+            assert promoted is not None
+            # the window moved with the promotion, epoch intact
+            with s.conn(promoted) as c:
+                windows = c.execute("CLUSTER", "WINDOWS")
+            assert any(
+                bytes(r[0]) == b"IMPORTING" and int(r[1]) == slot
+                for r in windows
+            ), windows
+            # the dead target's import journal reads superseded (terminal)
+            for ij in ImportJournal.scan(s.journal_dir):
+                if ij.target == dst_addr:
+                    assert ij.is_terminal()
+            results = resume_migrations(
+                s.journal_dir, readdress={dst_addr: promoted.address},
+            )
+            assert [r["action"] for r in results] == ["completed"], results
+            client.refresh_topology()
+            got = client.execute("GET", key)
+            assert got is not None and bytes(got) == b"covered"
+            # the replica-missed batch was recovered from the journal
+            got = client.execute("GET", ghost)
+            assert got is not None and bytes(got) == b"from-journal"
+            # the promoted node owns the slot now
+            with s.conn(promoted) as c:
+                names = c.execute("CLUSTER", "GETKEYSINSLOT", slot, 100)
+            assert key.encode() in [bytes(n) for n in names]
+            # the old master rejoins as a REPLICA of its successor
+            assert dst.role == "replica"
+            s.restart(dst)
+            with s.conn(promoted) as c:
+                import time as _time
+
+                deadline = _time.monotonic() + 30.0
+                while _time.monotonic() < deadline:
+                    reps = [
+                        topology._s(a) for a in c.execute("REPLICAS") or []
+                    ]
+                    if dst.address in reps:
+                        break
+                    _time.sleep(0.2)
+                assert dst.address in reps, reps
+        finally:
+            client.shutdown()
+    finally:
+        s.shutdown()
+
+
+@pytest.mark.slow
+def test_fleet_soak_two_cycles_every_phase():
+    """ISSUE 13 endurance: two full fleet cycles — rolling restart of every
+    node, target double-kills at every journal phase, replica promotion,
+    live-coordinator target kill — zero acked-durable loss, flat client
+    census."""
+    from redisson_tpu.chaos.soak import FleetSoakConfig, FleetSoakHarness
+
+    report = FleetSoakHarness(FleetSoakConfig(
+        cycles=2,
+        crash_phases=("PLANNED", "WINDOW_OPEN", "DRAINING:1",
+                      "VIEW_COMMITTED"),
+        roll_scope="all",
+    )).run()
+    assert report.cycles_completed == 2
+    assert report.nodes_rolled == 2 * 4      # 2 masters + 2 replicas, twice
+    assert report.promotions == 2
+    assert report.live_kill_migrations == 2
+    assert report.verified_writes > 0
+    assert report.bloom_keys_verified == 2 * 512

@@ -8,6 +8,11 @@ for EVERY journal phase, killing the coordinator right after that phase's
 entry and calling ``resume_migrations()`` ends with all slots STABLE on
 exactly one owner, the record readable at its exact value, and the journal
 terminal.
+
+ISSUE 13 extends the property to the RECEIVING side: ``ImportJournal``
+mechanics, the target's boot-time batch replay, the double-kill matrix
+(coordinator AND target dead at the same journal phase), and the
+no-rollback-into-a-dead-target policy.
 """
 import os
 
@@ -19,9 +24,10 @@ from redisson_tpu.server import migration as mig
 from redisson_tpu.server.migration import (
     CoordinatorKilled,
     migrate_slots,
+    rearm_recovery,
     resume_migrations,
 )
-from redisson_tpu.server.migration_journal import MigrationJournal
+from redisson_tpu.server.migration_journal import ImportJournal, MigrationJournal
 from redisson_tpu.utils.crc16 import calc_slot
 
 
@@ -332,3 +338,246 @@ def test_resume_migrations_invokes_gc(tmp_path):
         _terminal_journal(tmp_path)
     resume_migrations(str(tmp_path), gc_keep=None)
     assert len(MigrationJournal.scan(str(tmp_path))) == 5
+
+
+# -- import-side journal (ISSUE 13 tentpole) ----------------------------------
+
+def test_import_journal_roundtrip_and_suffix_isolation(tmp_path):
+    """ImportJournal batches survive a reopen byte-for-byte, the two
+    journal kinds never appear in each other's scans, and terminalization
+    sticks."""
+    jd = str(tmp_path)
+    j = ImportJournal.open_for(jd, "127.0.0.1:7002", 3, source="127.0.0.1:7001")
+    assert j.phase == "OPENED" and j.epoch == 3
+    assert j.target == "127.0.0.1:7002" and j.source == "127.0.0.1:7001"
+    j.append_batch(b"\x00binary\xffblob-1")
+    j.append_batch(b"blob-2")
+    back = ImportJournal.open(j.path)
+    assert back.batch_blobs() == [b"\x00binary\xffblob-1", b"blob-2"]
+    assert back.batch_count() == 2 and not back.is_terminal()
+    # a coordinator journal in the same dir: the scans stay disjoint
+    cj = _terminal_journal(tmp_path)
+    assert {x.path for x in ImportJournal.scan(jd)} == {j.path}
+    assert {x.path for x in MigrationJournal.scan(jd)} == {cj.path}
+    back.append("STABLE", settled=True)
+    assert ImportJournal.open(j.path).is_terminal()
+    assert ImportJournal.in_flight(jd) == []
+    # open_for on an existing journal does NOT re-OPEN it
+    again = ImportJournal.open_for(jd, "127.0.0.1:7002", 3)
+    assert [e["phase"] for e in again.entries].count("OPENED") == 1
+
+
+def test_import_journal_rejects_coordinator_phases(tmp_path):
+    j = ImportJournal.open_for(str(tmp_path), "a:1", 1)
+    with pytest.raises(ValueError, match="unknown journal phase"):
+        j.append("DRAINING")
+
+
+def test_resume_terminalizes_torn_import_journal(tmp_path):
+    """A crash mid-append of the OPENED line leaves an import journal with
+    zero intact entries — no node can claim it (its target is unreadable)
+    and no batch ever became durable, so resume_migrations settles it
+    (else it reads in-flight forever and gc pins its coordinator
+    journal)."""
+    jd = str(tmp_path)
+    path = ImportJournal.path_for(jd, "t:1", 5)
+    with open(path, "wb") as f:
+        f.write(b'{"phase":"OPENED"')  # torn: no CRC separator, no newline
+    assert ImportJournal.in_flight(jd)
+    assert resume_migrations(jd) == []
+    assert ImportJournal.in_flight(jd) == []
+    assert ImportJournal.open(path).phase == "ROLLED_BACK"
+
+
+def test_gc_sweeps_terminal_import_journals_protects_inflight(tmp_path):
+    """Satellite: gc prunes a target's TERMINAL import journals by the same
+    keep policy, never an in-flight one — and a coordinator journal whose
+    epoch still has an in-flight import journal is kept regardless of
+    age (the target's boot replay needs it)."""
+    jd = str(tmp_path)
+    # epoch 1..6: terminal coordinator journals with terminal import mirrors
+    for _ in range(6):
+        cj = _terminal_journal(tmp_path)
+        ij = ImportJournal.open_for(jd, "t:1", cj.epoch, source="s:1")
+        ij.append_batch(b"x")
+        ij.append("STABLE", settled=True)
+    # epoch 7: TERMINAL coordinator journal but the import journal is still
+    # in flight (target died before settling) — both files must survive gc
+    cj7 = _terminal_journal(tmp_path)
+    inflight = ImportJournal.open_for(jd, "t:1", cj7.epoch, source="s:1")
+    inflight.append_batch(b"y")
+    removed = MigrationJournal.gc(jd, keep=2)
+    kept_coord = {j.path for j in MigrationJournal.scan(jd)}
+    kept_imports = {j.path for j in ImportJournal.scan(jd)}
+    assert cj7.path in kept_coord, "protected coordinator journal pruned"
+    assert inflight.path in kept_imports, "in-flight import journal pruned"
+    # keep=2 applies per kind: 2 terminal imports survive (plus in-flight),
+    # and of the 6 unprotected terminal coordinator journals 2 survive
+    assert len(kept_imports) == 3
+    assert len(kept_coord) == 3  # cj7 + newest 2 unprotected
+    assert removed and all(p.endswith((".journal", ".import")) for p in removed)
+    # after the import journal terminalizes, the next sweep may prune both
+    inflight.append("STABLE", settled=True)
+    MigrationJournal.gc(jd, keep=1)
+    assert len([j for j in ImportJournal.scan(jd) if j.is_terminal()]) == 1
+
+
+@pytest.fixture()
+def cluster2j(tmp_path):
+    """2 masters + a shared journal dir on every node: imports journal."""
+    jd = str(tmp_path / "journal")
+    runner = ClusterRunner(masters=2, journal_dir=jd).run()
+    yield runner, jd
+    runner.shutdown()
+
+
+def test_double_kill_matrix_in_process(cluster2j):
+    """ISSUE 13 acceptance (in-process leg): at every journal phase, kill
+    the coordinator AND the migration TARGET (fresh engine on the same
+    port — its memory dies like a SIGKILL), replay the import journal at
+    'boot' via rearm_recovery, resume — zero acked loss, exactly-one-owner,
+    all slots STABLE, import journals terminal."""
+    runner, jd = cluster2j
+    client = runner.client(scan_interval=0)
+    try:
+        client.get_bucket("dk-key").set("payload")
+        slot = calc_slot(b"dk-key")
+        for phase, expect in [
+            ("PLANNED", "rolled_back"),
+            ("WINDOW_OPEN", "completed"),
+            ("DRAINING:1", "completed"),
+            ("VIEW_COMMITTED", "completed"),
+        ]:
+            owner = next(
+                m for m in runner.masters
+                if m.server.server.engine.store.exists("dk-key")
+            )
+            other = next(m for m in runner.masters if m is not owner)
+            with pytest.raises(CoordinatorKilled):
+                migrate_slots(owner.address, other.address, [slot],
+                              journal_dir=jd, crash_after=phase)
+            # the TARGET dies too: restart_node gives it a FRESH engine on
+            # the same port — the drained records now exist nowhere but its
+            # import journal
+            runner.stop_node(other)
+            runner.restart_node(other)
+            rearm_recovery(other.server.server, jd)
+            results = resume_migrations(jd)
+            assert [r["action"] for r in results] == [expect], (phase, results)
+            assert not MigrationJournal.in_flight(jd), phase
+            assert not ImportJournal.in_flight(jd), phase
+            holders = [
+                m for m in runner.masters
+                if m.server.server.engine.store.exists("dk-key")
+            ]
+            assert len(holders) == 1, phase
+            assert holders[0] is (owner if expect == "rolled_back" else other)
+            for node in runner.masters:
+                srv = node.server.server
+                assert not srv.migrating_slots and not srv.importing_slots
+                assert srv.import_journal_rows() == [], phase
+            client.refresh_topology()
+            assert client.get_bucket("dk-key").get() == "payload", phase
+    finally:
+        client.shutdown()
+
+
+def test_dead_target_leaves_journal_resumable_from_either_side(cluster2j):
+    """Resume with the target still DOWN reports 'failed' and leaves the
+    journal in flight; once the target is back (fresh engine + import
+    replay) the next resume drives the pair to STABLE — 'from either
+    side'."""
+    runner, jd = cluster2j
+    client = runner.client(scan_interval=0)
+    try:
+        client.get_bucket("dt-key").set("payload")
+        slot = calc_slot(b"dt-key")
+        owner = next(
+            m for m in runner.masters
+            if m.server.server.engine.store.exists("dt-key")
+        )
+        other = next(m for m in runner.masters if m is not owner)
+        with pytest.raises(CoordinatorKilled):
+            migrate_slots(owner.address, other.address, [slot],
+                          journal_dir=jd, crash_after="DRAINING:1")
+        runner.stop_node(other)  # the target is simply GONE
+        results = resume_migrations(jd)
+        assert [r["action"] for r in results] == ["failed"], results
+        assert len(MigrationJournal.in_flight(jd)) == 1
+        runner.restart_node(other)
+        rearm_recovery(other.server.server, jd)
+        results = resume_migrations(jd)
+        assert [r["action"] for r in results] == ["completed"], results
+        assert not MigrationJournal.in_flight(jd)
+        client.refresh_topology()
+        assert client.get_bucket("dt-key").get() == "payload"
+    finally:
+        client.shutdown()
+
+
+def test_live_rollback_skipped_when_target_unreachable(cluster2j, monkeypatch):
+    """The no-fork policy: a journaled migration whose drain fails with an
+    UNREACHABLE target must NOT roll back (the target may hold journaled
+    batches whose source copies are deleted) — the journal stays in flight
+    for a forward resume.  A reachable target still rolls back."""
+    runner, jd = cluster2j
+    primary = RuntimeError("drain exploded")
+
+    def boom_drain(self, moved=0):
+        raise primary
+
+    monkeypatch.setattr(mig._MigrationRun, "_phase_drain", boom_drain)
+    slot = runner.slot_ranges[0][0]
+    src, dst = runner.masters[0], runner.masters[1]
+    # reachable target: the historical rollback runs and terminalizes
+    with pytest.raises(RuntimeError):
+        migrate_slots(src.address, dst.address, [slot], journal_dir=jd)
+    assert not MigrationJournal.in_flight(jd)
+    assert MigrationJournal.scan(jd)[-1].phase == "ROLLED_BACK"
+    # unreachable target: no rollback — in flight, window still armed
+    monkeypatch.setattr(
+        mig._MigrationRun, "_target_reachable", lambda self: False
+    )
+    with pytest.raises(RuntimeError):
+        migrate_slots(src.address, dst.address, [slot], journal_dir=jd)
+    inflight = MigrationJournal.in_flight(jd)
+    assert [j.phase for j in inflight] == ["WINDOW_OPEN"]
+    assert slot in src.server.server.migrating_slots
+    # forward resume converges once the 'dead' target answers again
+    monkeypatch.undo()
+    results = resume_migrations(jd)
+    assert [r["action"] for r in results] == ["completed"], results
+    assert not src.server.server.migrating_slots
+
+
+def test_cluster_windows_reports_import_journal_rows(cluster2j):
+    """Satellite: CLUSTER WINDOWS on the TARGET shows the in-flight import
+    journal (epoch, phase, batches, source) mid-migration, and the rows
+    disappear when the migration settles."""
+    runner, jd = cluster2j
+    client = runner.client(scan_interval=0)
+    try:
+        client.get_bucket("cw-key").set("v")
+        slot = calc_slot(b"cw-key")
+        owner = next(
+            m for m in runner.masters
+            if m.server.server.engine.store.exists("cw-key")
+        )
+        other = next(m for m in runner.masters if m is not owner)
+        with pytest.raises(CoordinatorKilled):
+            migrate_slots(owner.address, other.address, [slot],
+                          journal_dir=jd, crash_after="DRAINING:1")
+        with other.server.client() as c:
+            rows = [r for r in c.execute("CLUSTER", "WINDOWS")
+                    if bytes(r[0]) == b"IMPORTJOURNAL"]
+        assert len(rows) == 1
+        _tag, epoch, phase, batches, source = rows[0]
+        assert int(epoch) == MigrationJournal.in_flight(jd)[0].epoch
+        assert bytes(phase) == b"BATCH" and int(batches) >= 1
+        assert bytes(source).decode() == owner.address
+        resume_migrations(jd)
+        for node in runner.masters:
+            with node.server.client() as c:
+                assert c.execute("CLUSTER", "WINDOWS") == [], node.address
+    finally:
+        client.shutdown()

@@ -173,9 +173,11 @@ def test_replicated_moves_off_demoted_master_and_excludes_stale_replicas():
             assert entry.address == _addr(b)
             # replica sync lands just after the entry swap becomes visible
             # (the gap is benign: reads fall back to the master) — wait for
-            # it before asserting the membership
-            deadline = time.time() + 5
-            while time.time() < deadline and not entry.replicas:
+            # BOTH re-pointed replicas, not just the first, before asserting
+            # membership (on a loaded box the second registration can land a
+            # scan later; exiting on "any replica" raced the assert below)
+            deadline = time.time() + 15
+            while time.time() < deadline and len(entry.replicas) < 2:
                 time.sleep(0.05)
             assert set(entry.replicas) == {_addr(c_), _addr(d)}  # E excluded
             client.get_bucket("rp:demote").set("on-b")

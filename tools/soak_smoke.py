@@ -13,6 +13,15 @@
     no slot left non-STABLE, bit-identical record contents, checkpoint
     generation fallback, flat census.  One kill-resume cycle runs in well
     under 60s.
+  * ``fleet`` — the fleet-lifecycle profile (ISSUE 13): a replica-covered
+    multi-process cluster under client-side transport faults takes a full
+    rolling restart (graceful drains, zero acked loss), a coordinator+
+    TARGET double-kill at a journal phase (recovered by the target's
+    import-journal replay), a replica promotion that carries an in-flight
+    import window across a failover, and a live-coordinator target
+    SIGKILL whose journal must stay resumable.  Asserts zero
+    acked-durable-write loss, exactly-one-owner, all slots STABLE with
+    import journals terminal, bloom adds intact, flat client census.
   * ``cluster-proc`` — the PROCESS-LEVEL profile (ISSUE 6): real
     ``tpu-server`` OS processes under a ClusterSupervisor serve a mixed
     write stream over real TCP while the coordinator dies at a journal
@@ -54,7 +63,7 @@
 
 Usage:
     JAX_PLATFORMS=cpu python tools/soak_smoke.py \
-        [--profile standard|migration|cluster-proc|tracking]
+        [--profile standard|migration|cluster-proc|fleet|tracking]
         [--cycles N] [--seed S] [--phase SECONDS] [--no-kill]
 
 Exit code 0 = every assertion held; the report summary prints either way.
@@ -77,7 +86,8 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--profile",
                     choices=("standard", "migration", "cluster-proc",
-                             "tracking", "device-shard", "qos", "vector"),
+                             "fleet", "tracking", "device-shard", "qos",
+                             "vector"),
                     default="standard")
     ap.add_argument("--cycles", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
@@ -119,6 +129,16 @@ def main() -> int:
         harness = TrackingSoakHarness(TrackingSoakConfig(
             cycles=args.cycles, seed=args.seed,
             kill=not args.no_kill,
+        ))
+    elif args.profile == "fleet":
+        from redisson_tpu.chaos.soak import FleetSoakConfig, FleetSoakHarness
+
+        harness = FleetSoakHarness(FleetSoakConfig(
+            cycles=args.cycles, seed=args.seed,
+            # smoke = one target double-kill phase + roll of the masters +
+            # promotion + live-coordinator kill; the kill-every-phase
+            # matrix runs in tests/test_cluster_proc.py's slow tier
+            crash_phases=("DRAINING:1",),
         ))
     elif args.profile == "cluster-proc":
         from redisson_tpu.chaos.soak import (
