@@ -1244,11 +1244,159 @@ def bench_config7_vector():
             "h2d_flushes": bank.h2d_flushes,
         })
         svc.drop_index(name)
+    ivf = _bench_config7_ivf(svc, rng)
     return {
         "config7_knn_qps": out_points[-1]["knn_qps"],
         "config7_recall_at_10": min(p["recall_at_10"] for p in out_points),
         "q_batch": Q_BATCH,
         "points": out_points,
+        **ivf,
+    }
+
+
+def _bench_config7_ivf(svc, rng):
+    """Config 7 IVF + compressed legs (ISSUE 14): the sub-linear and
+    bank-compression axes, on a CLUSTERED corpus at the big point — real
+    embedding manifolds are clustered; uniform-gaussian d=128 is the
+    adversarial case where IVF recall intrinsically collapses (the test
+    suite pins that shape; the bench measures the serving shape).
+
+      * ``config7_ivf_knn_qps`` / ``config7_ivf_recall_at_10`` — the
+        gated IVF leg (nlist=1536, nprobe=4 at N=50k/d=128, batch-64
+        stacked like the FLAT legs); qps relative-gated + a >= 2x
+        speedup-vs-FLAT floor, recall bound >= 0.97 absolute from first
+        sight against the f64 oracle.
+      * ``config7_int8_recall_at_10`` / ``config7_int8_bytes_ratio`` —
+        FLAT INT8 on the same corpus: recall floor >= 0.95 absolute and
+        the quantized bank must hold <= 0.35x the f32 device bytes.
+      * details carry the full nprobe sweep and the IVF-over-INT8
+        composition row (both axes at once)."""
+    N, d, k = 50_000, 128, 10
+    Q_BATCH = 64
+    N_ORACLE = 64
+    MEASURE_S = 1.5
+    C = 512
+    centers = rng.standard_normal((C, d)).astype(np.float32)
+    vecs = (
+        centers[rng.integers(C, size=N)]
+        + 0.25 * rng.standard_normal((N, d))
+    ).astype(np.float32)
+    queries = (
+        vecs[rng.integers(N, size=Q_BATCH)]
+        + 0.1 * rng.standard_normal((Q_BATCH, d))
+    ).astype(np.float32)
+    oracle_q = (
+        vecs[rng.integers(N, size=N_ORACLE)]
+        + 0.1 * rng.standard_normal((N_ORACLE, d))
+    ).astype(np.float32)
+    q64, v64 = oracle_q.astype(np.float64), vecs.astype(np.float64)
+    dots = q64 @ v64.T
+    denom = (
+        np.linalg.norm(q64, axis=1)[:, None]
+        * np.linalg.norm(v64, axis=1)[None, :]
+    )
+    dist64 = 1.0 - np.where(denom > 0, dots / denom, 0.0)
+    truth = [
+        set(np.argsort(dist64[i], kind="stable")[:k].tolist())
+        for i in range(N_ORACLE)
+    ]
+
+    def measure(name, nprobe=None):
+        """ONE measurement discipline for every leg and sweep point: warm
+        (train + compile) outside the window, timed stacked batches, then
+        recall@k vs the f64 oracle."""
+        dev, fin = svc.knn(name, "emb", queries, k, nprobe=nprobe)
+        fin(tuple(np.asarray(v) for v in dev))  # warm (train + compile)
+        done, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < MEASURE_S:
+            dev, fin = svc.knn(name, "emb", queries, k, nprobe=nprobe)
+            fin(tuple(np.asarray(v) for v in dev))
+            done += Q_BATCH
+        qps = done / (time.perf_counter() - t0)
+        dev, fin = svc.knn(name, "emb", oracle_q, k, nprobe=nprobe)
+        got = fin(tuple(np.asarray(v) for v in dev))
+        hits = sum(
+            len(truth[i] & {int(doc[1:]) for doc, _s in got[i][:k]})
+            for i in range(N_ORACLE)
+        )
+        return {
+            "knn_qps": round(qps),
+            "recall_at_10": round(hits / (k * N_ORACLE), 4),
+        }
+
+    def leg(name, spec, nprobe=None):
+        svc.create_index(name, {"emb": "VECTOR"}, vector={"emb": spec})
+        t0 = time.perf_counter()
+        for i in range(N):
+            svc.add_document(name, f"d{i}", {"emb": vecs[i]})
+        ingest_s = time.perf_counter() - t0
+        row = measure(name, nprobe=nprobe)
+        bank = svc._idx(name).vectors.banks["emb"]
+        row.update({
+            "bank_device_bytes": bank.device_bytes(),
+            "index_device_bytes": bank.index_device_bytes(),
+            "ingest_docs_per_sec": round(N / ingest_s),
+        })
+        return row, bank
+
+    # FLAT f32 on the SAME corpus: the speedup denominator
+    flat_row, flat_bank = leg("v7c_flat", {"dim": d, "metric": "COSINE"})
+    flat_bytes = flat_row["bank_device_bytes"]
+    svc.drop_index("v7c_flat")
+
+    ivf_spec = {"dim": d, "metric": "COSINE", "algo": "IVF", "nlist": 1536}
+    svc.create_index("v7c_ivf", {"emb": "VECTOR"}, vector={"emb": ivf_spec})
+    for i in range(N):
+        svc.add_document("v7c_ivf", f"d{i}", {"emb": vecs[i]})
+    sweep = []
+    for nprobe in (2, 4, 8):
+        row = measure("v7c_ivf", nprobe=nprobe)
+        sweep.append(dict(nprobe=nprobe, **row))
+        log(
+            f"config7 ivf: N={N} d={d} nlist=1536 nprobe={nprobe} — "
+            f"{row['knn_qps']/1e3:.1f}k qps, recall@10 "
+            f"{row['recall_at_10']:.4f}"
+        )
+    ivf_bank = svc._idx("v7c_ivf").vectors.banks["emb"]
+    index_bytes = ivf_bank.index_device_bytes()
+    svc.drop_index("v7c_ivf")
+    gated = next(s for s in sweep if s["nprobe"] == 4)  # the gated leg
+    speedup = gated["knn_qps"] / max(1, flat_row["knn_qps"])
+
+    int8_row, _ = leg("v7c_i8", {"dim": d, "metric": "COSINE",
+                                 "dtype": "INT8"})
+    svc.drop_index("v7c_i8")
+    int8_ratio = int8_row["bank_device_bytes"] / max(1, flat_bytes)
+
+    # composition: IVF over the quantized bank (both axes at once)
+    both_row, _ = leg(
+        "v7c_ivf8",
+        {"dim": d, "metric": "COSINE", "algo": "IVF", "nlist": 1536,
+         "dtype": "INT8"},
+        nprobe=4,
+    )
+    svc.drop_index("v7c_ivf8")
+
+    log(
+        f"config7 ivf gated leg: {gated['knn_qps']/1e3:.1f}k qps = "
+        f"{speedup:.2f}x FLAT ({flat_row['knn_qps']/1e3:.1f}k) at recall "
+        f"{gated['recall_at_10']:.4f}; int8 recall "
+        f"{int8_row['recall_at_10']:.4f} at {int8_ratio:.3f}x f32 bytes; "
+        f"ivf+int8 {both_row['knn_qps']/1e3:.1f}k qps / "
+        f"{both_row['recall_at_10']:.4f}"
+    )
+    return {
+        "config7_ivf_knn_qps": gated["knn_qps"],
+        "config7_ivf_recall_at_10": gated["recall_at_10"],
+        "config7_ivf_speedup_vs_flat": round(speedup, 3),
+        "config7_int8_recall_at_10": int8_row["recall_at_10"],
+        "config7_int8_bytes_ratio": round(int8_ratio, 4),
+        "ivf": {
+            "nlist": 1536, "sweep": sweep,
+            "flat_clustered": flat_row,
+            "index_device_bytes": index_bytes,
+            "int8": int8_row, "ivf_int8": both_row,
+        },
     }
 
 
@@ -1450,6 +1598,11 @@ def main():
                     "stage_breakdown": results["2q"]["qos"]["stage_breakdown"],
                     "config7_knn_qps": results["7"]["vector"]["config7_knn_qps"],
                     "config7_recall_at_10": results["7"]["vector"]["config7_recall_at_10"],
+                    "config7_ivf_knn_qps": results["7"]["vector"]["config7_ivf_knn_qps"],
+                    "config7_ivf_recall_at_10": results["7"]["vector"]["config7_ivf_recall_at_10"],
+                    "config7_ivf_speedup_vs_flat": results["7"]["vector"]["config7_ivf_speedup_vs_flat"],
+                    "config7_int8_recall_at_10": results["7"]["vector"]["config7_int8_recall_at_10"],
+                    "config7_int8_bytes_ratio": results["7"]["vector"]["config7_int8_bytes_ratio"],
                     "config7_vector": results["7"]["vector"],
                     "baseline_model": "k=7 GETBITs @ 1M pipelined ops/s/core = 143k contains/s",
                     "tunnel_h2d_mb_per_sec": {

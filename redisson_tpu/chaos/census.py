@@ -117,6 +117,7 @@ class ResourceCensus:
             # index down — the vector soak's flat-census assertion
             out["ftvec_banks"] = 0.0
             out["ftvec_device_bytes"] = 0.0
+            out["ftvec_index_bytes"] = 0.0
             ftvec = getattr(server, "_ftvec_census", None)
             if ftvec is not None:
                 for k, v in ftvec().items():

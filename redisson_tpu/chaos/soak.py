@@ -2797,6 +2797,16 @@ class VectorSoakConfig:
     phase_seconds: float = 1.0
     faults_per_cycle: int = 8
     quiesce_s: float = 1.0
+    # ISSUE 14: the soaked index is IVF by default — centroids + cell
+    # table live in the bank's record and must MOVE WITH IT through every
+    # fenced rebalance.  nprobe == nlist probes every cell, so the strict
+    # 0.99 recall floor still binds (routing/cells machinery exercised,
+    # exactness preserved — partial-probe recall has its own gated bench
+    # legs); algo="FLAT" restores the ISSUE 11 shape.
+    algo: str = "IVF"
+    nlist: int = 6
+    nprobe: int = 6
+    train_min: int = 24
 
 
 @dataclass
@@ -2885,11 +2895,21 @@ class VectorSoakHarness:
         self._journal_dir = tempfile.mkdtemp(prefix="rtpu-vecsoak-")
         self._server = ServerThread(port=0, devices="all", workers=8).start()
         admin = self._connect()
+        if cfg.algo == "IVF":
+            vec_tail = (
+                "emb", "VECTOR", "IVF", "12", "TYPE", "FLOAT32",
+                "DIM", str(cfg.dim), "DISTANCE_METRIC", "L2",
+                "NLIST", str(cfg.nlist), "NPROBE", str(cfg.nprobe),
+                "TRAIN_MIN", str(cfg.train_min),
+            )
+        else:
+            vec_tail = (
+                "emb", "VECTOR", "FLAT", "6", "TYPE", "FLOAT32",
+                "DIM", str(cfg.dim), "DISTANCE_METRIC", "L2",
+            )
         r = admin.execute(
             "FT.CREATE", self.INDEX, "ON", "HASH", "PREFIX", "1", self.PREFIX,
-            "SCHEMA", "price", "NUMERIC",
-            "emb", "VECTOR", "FLAT", "6", "TYPE", "FLOAT32",
-            "DIM", str(cfg.dim), "DISTANCE_METRIC", "L2",
+            "SCHEMA", "price", "NUMERIC", *vec_tail,
         )
         assert r == b"OK", r
         for i in range(cfg.docs):
@@ -3029,6 +3049,28 @@ class VectorSoakHarness:
         )
         self.report.rebalances += 1
         self.report.records_moved += moved
+        self._assert_index_moved_with_bank()
+
+    def _assert_index_moved_with_bank(self) -> None:
+        """ISSUE 14: the IVF coarse index (centroids + cell table) lives in
+        the SAME record as the bank — after a fenced rebalance all of its
+        device arrays must sit on ONE device (nothing straggles on the old
+        owner)."""
+        from redisson_tpu.core.ioplane import device_of
+        from redisson_tpu.services.vector import bank_record_name
+
+        rec = self._server.server.engine.store.get(
+            bank_record_name(self.INDEX, "emb")
+        )
+        if rec is None:
+            return
+        devices = {
+            str(device_of(a)) for a in rec.arrays.values() if a is not None
+        }
+        devices.discard("None")
+        assert len(devices) <= 1, (
+            f"bank/centroids/cells split across devices: {devices}"
+        )
 
     # -- run -------------------------------------------------------------------
 
@@ -3152,6 +3194,8 @@ class VectorSoakHarness:
             after = census.snapshot()
             assert after["srv.ftvec_banks"] == 0.0, after
             assert after["srv.ftvec_device_bytes"] == 0.0, after
+            # the IVF cell index must die with the bank (leak row, ISSUE 14)
+            assert after["srv.ftvec_index_bytes"] == 0.0, after
             census.assert_flat(
                 baseline, after,
                 # ftvec rows are asserted EXACTLY zero above (the baseline

@@ -663,22 +663,193 @@ def _knn_distances(bank, bias, q, n_rows, metric: str):
     return jnp.where(live[None, :], dist, jnp.inf)
 
 
-def _knn_topk_body(bank, bias, q, n_rows, k: int, metric: str):
-    dist = _knn_distances(bank, bias, q, n_rows, metric)
+def _bank_f32(bank, scale):
+    """Decompress-in-kernel seam (ISSUE 14): quantized banks (FLOAT16, or
+    INT8 + symmetric per-row scale) widen to float32 INSIDE the scoring
+    program, so the MXU still sees one fused matmul and the decompressed
+    plane never round-trips HBM as a separate buffer.  The trace
+    specializes on the bank dtype — float32 banks pay nothing."""
+    if bank.dtype == jnp.float32:
+        return bank
+    rows = bank.astype(jnp.float32)
+    if scale is not None:
+        rows = rows * scale[..., None]
+    return rows
+
+
+def _knn_topk_body(bank, scale, bias, q, n_rows, k: int, metric: str):
+    dist = _knn_distances(_bank_f32(bank, scale), bias, q, n_rows, metric)
     neg, idx = jax.lax.top_k(-dist, k)
     return -neg, idx.astype(jnp.int32)
 
 
-def _knn_topk_masked_body(bank, bias, qbias, q, n_rows, k: int, metric: str):
+def _knn_topk_masked_body(bank, scale, bias, qbias, q, n_rows, k: int,
+                          metric: str):
     """Hybrid prefilter: per-query additive bias (Q, C) — 0 keeps a row,
     +inf drops it (the planner's host mask lowered onto the score matrix)."""
-    dist = _knn_distances(bank, bias, q, n_rows, metric) + qbias
+    dist = (
+        _knn_distances(_bank_f32(bank, scale), bias, q, n_rows, metric)
+        + qbias
+    )
     neg, idx = jax.lax.top_k(-dist, k)
     return -neg, idx.astype(jnp.int32)
 
 
-knn_topk = jax.jit(_knn_topk_body, static_argnums=(4, 5))
-knn_topk_masked = jax.jit(_knn_topk_masked_body, static_argnums=(5, 6))
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def knn_topk(bank, bias, q, n_rows, k: int, metric: str):
+    return _knn_topk_body(bank, None, bias, q, n_rows, k, metric)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def knn_topk_q(bank, scale, bias, q, n_rows, k: int, metric: str):
+    """INT8 banks: per-row symmetric scale dequantizes inside the kernel."""
+    return _knn_topk_body(bank, scale, bias, q, n_rows, k, metric)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def knn_topk_masked(bank, bias, qbias, q, n_rows, k: int, metric: str):
+    return _knn_topk_masked_body(bank, None, bias, qbias, q, n_rows, k,
+                                 metric)
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7))
+def knn_topk_masked_q(bank, scale, bias, qbias, q, n_rows, k: int,
+                      metric: str):
+    return _knn_topk_masked_body(bank, scale, bias, qbias, q, n_rows, k,
+                                 metric)
+
+
+# -- IVF (inverted-file) KNN: sub-linear scoring (ISSUE 14) -------------------
+#
+# A coarse k-means quantizer routes each query through ONE small
+# (Q, d) x (d, nlist) matmul; only the rows of the top-`nprobe` cells are
+# then gathered and scored, so candidate work is O(nprobe * cell_cap) per
+# query instead of O(N).  The per-cell row lists arrive as a CSR-style
+# device index with a UNIFORM stride (`cells`: (nlist, cell_cap) int32,
+# ragged rows padded with an out-of-range sentinel) — uniform stride keeps
+# the candidate gather ONE fixed-shape XLA gather; the recall gate
+# (config7 floors) keeps the approximation honest.  Ties break toward the
+# earlier candidate position (probe order, then cell position), which the
+# NumPy fallback in services/vector.py mirrors with a stable argsort.
+
+# (padded `cells` entries carry services/vector._IVF_SENTINEL — any value
+# >= n_rows works here, validity is the `cand < n_rows` mask below)
+
+
+def _ivf_candidate_dists(rows_f32, q, metric: str):
+    """Distances of gathered candidate rows (Q, M, W) against their own
+    query (Q, W) — the _knn_distances conventions, batched per query."""
+    dots = jnp.einsum(
+        "qmw,qw->qm", rows_f32, q, preferred_element_type=jnp.float32
+    )
+    if metric == "L2":
+        q_sq = jnp.sum(q * q, axis=1, dtype=jnp.float32)
+        r_sq = jnp.sum(rows_f32 * rows_f32, axis=2, dtype=jnp.float32)
+        return q_sq[:, None] - 2.0 * dots + r_sq
+    if metric == "COSINE":
+        qn = jnp.sqrt(jnp.sum(q * q, axis=1, dtype=jnp.float32))
+        rn = jnp.sqrt(jnp.sum(rows_f32 * rows_f32, axis=2, dtype=jnp.float32))
+        denom = qn[:, None] * rn
+        return 1.0 - jnp.where(denom > 0.0, dots / denom, 0.0)
+    if metric == "IP":
+        return 1.0 - dots
+    raise ValueError(f"unknown metric {metric!r}")  # pragma: no cover
+
+
+def _ivf_route(centroids, q, nprobe: int, metric: str):
+    """Top-`nprobe` coarse cells per query: ONE (Q, d) x (d, nlist) matmul
+    + top_k — the sub-linear plane's whole routing cost."""
+    cdots = jnp.dot(q, centroids.T, preferred_element_type=jnp.float32)
+    if metric == "L2":
+        cd = (
+            jnp.sum(q * q, axis=1, dtype=jnp.float32)[:, None]
+            - 2.0 * cdots
+            + jnp.sum(centroids * centroids, axis=1,
+                      dtype=jnp.float32)[None, :]
+        )
+    elif metric == "COSINE":
+        qn = jnp.sqrt(jnp.sum(q * q, axis=1, dtype=jnp.float32))
+        cn = jnp.sqrt(jnp.sum(centroids * centroids, axis=1,
+                              dtype=jnp.float32))
+        denom = qn[:, None] * cn[None, :]
+        cd = 1.0 - jnp.where(denom > 0.0, cdots / denom, 0.0)
+    else:  # IP
+        cd = 1.0 - cdots
+    _neg, probe = jax.lax.top_k(-cd, nprobe)
+    return probe  # (Q, nprobe) cell ids
+
+
+def _knn_ivf_body(bank, scale, bias, qmask, centroids, cells, q, n_rows,
+                  k: int, nprobe: int, metric: str):
+    probe = _ivf_route(centroids, q, nprobe, metric)
+    cand = cells[probe].reshape(q.shape[0], -1)   # (Q, nprobe*cap) rowids
+    valid = cand < n_rows                         # sentinel + padding out
+    safe = jnp.where(valid, cand, 0)
+    rows = _bank_f32(bank[safe], None if scale is None else scale[safe])
+    dist = _ivf_candidate_dists(rows, q, metric) + bias[safe]
+    if qmask is not None:  # hybrid prefilter: (C,) additive 0/+inf plane
+        dist = dist + qmask[safe]
+    dist = jnp.where(valid, dist, jnp.inf)
+    neg, pos = jax.lax.top_k(-dist, k)
+    idx = jnp.take_along_axis(cand, pos, axis=1)  # +inf rows carry garbage
+    return -neg, idx.astype(jnp.int32)            # ids; callers drop them
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7, 8))
+def knn_ivf_topk(bank, bias, centroids, cells, q, n_rows, k: int,
+                 nprobe: int, metric: str):
+    return _knn_ivf_body(bank, None, bias, None, centroids, cells, q,
+                         n_rows, k, nprobe, metric)
+
+
+@functools.partial(jax.jit, static_argnums=(7, 8, 9))
+def knn_ivf_topk_q(bank, scale, bias, centroids, cells, q, n_rows, k: int,
+                   nprobe: int, metric: str):
+    return _knn_ivf_body(bank, scale, bias, None, centroids, cells, q,
+                         n_rows, k, nprobe, metric)
+
+
+@functools.partial(jax.jit, static_argnums=(7, 8, 9))
+def knn_ivf_topk_masked(bank, bias, qmask, centroids, cells, q, n_rows,
+                        k: int, nprobe: int, metric: str):
+    return _knn_ivf_body(bank, None, bias, qmask, centroids, cells, q,
+                         n_rows, k, nprobe, metric)
+
+
+@functools.partial(jax.jit, static_argnums=(8, 9, 10))
+def knn_ivf_topk_masked_q(bank, scale, bias, qmask, centroids, cells, q,
+                          n_rows, k: int, nprobe: int, metric: str):
+    return _knn_ivf_body(bank, scale, bias, qmask, centroids, cells, q,
+                         n_rows, k, nprobe, metric)
+
+
+@jax.jit
+def kmeans_step(points, weights, centroids):
+    """One Lloyd iteration over the host mirror staged once per training
+    run: L2 assignment (the classic IVF coarse quantizer, whatever the
+    field's query metric) + weighted mean update.  `weights` zeroes dead
+    rows out of both the assignment result (-1) and the centroid update;
+    empty cells keep their previous centroid.  Returns
+    (new_centroids f32 (L, W), assign int32 (N,))."""
+    d = (
+        jnp.sum(points * points, axis=1, dtype=jnp.float32)[:, None]
+        - 2.0 * jnp.dot(points, centroids.T,
+                        preferred_element_type=jnp.float32)
+        + jnp.sum(centroids * centroids, axis=1, dtype=jnp.float32)[None, :]
+    )
+    assign = jnp.argmin(d, axis=1).astype(jnp.int32)
+    sums = jnp.zeros_like(centroids).at[assign].add(
+        points * weights[:, None]
+    )
+    counts = jnp.zeros((centroids.shape[0],), jnp.float32).at[assign].add(
+        weights
+    )
+    new_c = jnp.where(
+        counts[:, None] > 0.0,
+        sums / jnp.maximum(counts, 1.0)[:, None],
+        centroids,
+    )
+    return new_c, jnp.where(weights > 0.0, assign, jnp.int32(-1))
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -701,16 +872,62 @@ def rowbank_write_packed(bank, bias, packed, n_valid):
     )
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def rowbank_write_packed_f16(bank, bias, packed, n_valid):
+    """rowbank_write_packed for FLOAT16 banks: cols 2.. carry TWO f16 lanes
+    per uint32 word (numpy ``.view(uint32)`` packing; XLA's bitcast orders
+    the trailing lane dim from the least-significant bits, which matches) —
+    the compressed upload is HALF the f32 transfer for the same rows."""
+    idx = packed[:, 0].astype(jnp.int32)
+    newbias = jax.lax.bitcast_convert_type(packed[:, 1], jnp.float32)
+    halves = jax.lax.bitcast_convert_type(packed[:, 2:], jnp.float16)
+    rows = halves.reshape(packed.shape[0], -1)
+    mask = _valid_mask(packed.shape[0], n_valid)
+    safe = jnp.where(mask, idx, bank.shape[0])
+    return (
+        bank.at[safe].set(rows, mode="drop"),
+        bias.at[safe].set(newbias, mode="drop"),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def rowbank_write_packed_i8(bank, scale, bias, packed, n_valid):
+    """rowbank_write_packed for INT8 banks: col 2 = the row's symmetric
+    dequant scale (f32 bits), cols 3.. = FOUR int8 lanes per uint32 word —
+    a quarter of the f32 transfer; the scoring kernels dequantize in-
+    program (``_bank_f32``)."""
+    idx = packed[:, 0].astype(jnp.int32)
+    newbias = jax.lax.bitcast_convert_type(packed[:, 1], jnp.float32)
+    newscale = jax.lax.bitcast_convert_type(packed[:, 2], jnp.float32)
+    quads = jax.lax.bitcast_convert_type(packed[:, 3:], jnp.int8)
+    rows = quads.reshape(packed.shape[0], -1)
+    mask = _valid_mask(packed.shape[0], n_valid)
+    safe = jnp.where(mask, idx, bank.shape[0])
+    return (
+        bank.at[safe].set(rows, mode="drop"),
+        scale.at[safe].set(newscale, mode="drop"),
+        bias.at[safe].set(newbias, mode="drop"),
+    )
+
+
 @functools.partial(jax.jit, donate_argnums=(2, 3))
 def rowbank_grow(bank, bias, grown_bank, grown_bias):
     """Device-side capacity growth: copy the old bank into the zero-filled
     larger plane (HBM copy — growth never re-uploads host rows).  The grown
-    planes are donated: XLA writes the copy into their buffers in place."""
+    planes are donated: XLA writes the copy into their buffers in place.
+    dtype-agnostic: the jit re-specializes for f16/int8 banks."""
     c = bank.shape[0]
     return (
         grown_bank.at[:c].set(bank),
         grown_bias.at[:c].set(bias),
     )
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def rowbank_grow_plane(plane, grown):
+    """Grow ONE auxiliary per-row plane (the INT8 scale column) the same
+    HBM-copy way."""
+    return grown.at[: plane.shape[0]].set(plane)
 
 
 def _wc_hash_prelude(buf):

@@ -371,6 +371,13 @@ class TpuServer:
             "ftvec_device_bytes",
             lambda: self._ftvec_census().get("ftvec_device_bytes", 0.0),
         )
+        # the IVF coarse index (centroids + cell tables) — separate gauge
+        # so an index leak on DROPINDEX is visible even when the bank
+        # itself released (ISSUE 14)
+        self.metrics.gauge(
+            "ftvec_index_bytes",
+            lambda: self._ftvec_census().get("ftvec_index_bytes", 0.0),
+        )
         # OBJCALL handle cache (ordered for LRU eviction; see registry)
         from collections import OrderedDict
 
@@ -789,21 +796,34 @@ class TpuServer:
             n for n in self.engine.store.keys() if calc_slot(n.encode()) == slot
         ]
 
-    def migrate_slot_batch(self, slots, limit: int = 0) -> int:
+    # records shipped per IMPORTRECORDS frame during drains: a journaled
+    # target fsyncs ONCE per frame, so batch coalescing divides the
+    # journal-before-ack cost by the batch width (ISSUE 14 satellite; the
+    # r06 container measured ~2.7ms/record = -27% import throughput at
+    # batch 1)
+    DRAIN_BATCH_RECORDS = 32
+
+    def migrate_slot_batch(self, slots, limit: int = 0,
+                           batch: Optional[int] = None) -> int:
         """Drain MIGRATING slot(s) to their targets; limit<=0 drains fully.
 
-        Move protocol per record (NO network I/O under the record lock — a
-        record lock held across a push would stall unrelated work queued
-        behind it, e.g. lock-watchdog renewals):
-          1. under the record lock: serialize, note (nonce, version);
-          2. outside the lock: IMPORTRECORDS to the target — concurrent
-             writers keep mutating the still-present local record;
-          3. under the record lock again: if (nonce, version) unchanged,
-             delete locally (move complete); else loop — the newer state
-             re-ships.  After the delete, the absent guard ASK-redirects.
-        A write therefore either ships with the record or redirects to the
-        target — no acked write is lost.  The store is scanned ONCE for all
-        requested slots; one link per target serves the whole call.
+        Records ship in BATCHES of `batch` (default DRAIN_BATCH_RECORDS)
+        per IMPORTRECORDS frame, grouped by (target, epoch).  The whole
+        batch's record locks are held (sorted order — deadlock-free) across
+        serialize -> IMPORTRECORDS -> local delete, the same atomicity the
+        per-record path had: every mutation path (object handles AND the
+        store-level DEL/EXPIRE commands) takes these locks, so no client
+        write, delete, or expire can interleave between the snapshot
+        leaving and the local copies dying — the zero-lost-acked-writes
+        contract holds for deletes too (a DEL either lands before the
+        snapshot, keeping the record out of the batch, or blocks until the
+        name is locally absent and then ASK-redirects to the target).
+        Redis gets the same guarantee from MIGRATE's single-threaded
+        blocking; we pay it per-batch instead of per-server.  A journaled
+        target fsyncs its ImportJournal ONCE per frame (journal-before-ack
+        and the pre-ack replica cover are per-frame contracts — both hold
+        unchanged), so the batch width directly divides the durability
+        overhead the ISSUE 13 plane added.
         """
         from redisson_tpu.net.client import NodeClient
         from redisson_tpu.server import replication
@@ -827,61 +847,71 @@ class TpuServer:
             names = names[:limit]
         if not names:
             return 0
+        if batch is None or batch <= 0:
+            batch = self.DRAIN_BATCH_RECORDS
+        # group by (target, epoch) preserving scan order: one frame may
+        # carry records of MANY slots, but never records bound for
+        # different targets or fenced at different epochs
+        groups: Dict[Tuple[str, Optional[int]], List[str]] = {}
+        for name, slot in names:
+            key = (targets[slot], self.migrating_epochs.get(slot))
+            groups.setdefault(key, []).append(name)
         moved = 0
         links: Dict[str, NodeClient] = {}
         try:
-            for name, slot in names:
-                target = targets[slot]
+            for (target, ep), gnames in groups.items():
                 link = links.get(target)
                 if link is None:
                     link = links[target] = self.link_client(
                         target, ping_interval=0, retry_attempts=1
                     )
-                # Hold the record lock across serialize -> IMPORTRECORDS ->
-                # local delete.  Every mutation path (object handles AND the
-                # store-level DEL/EXPIRE commands) takes this lock, so the
-                # per-name move is atomic: no client write, delete, or expire
-                # can interleave between the snapshot leaving and the local
-                # copy dying — the zero-lost-acked-writes contract holds for
-                # deletes too (a DEL either lands before the snapshot, making
-                # peek() fail here, or blocks until the name is locally
-                # absent and then ASK-redirects to the target).  Redis gets
-                # the same guarantee from MIGRATE's single-threaded blocking;
-                # we pay it per-key instead of per-server.
-                with self.engine.locked(name):
-                    if not self.engine.store.peek(name):
-                        continue  # expired/deleted meanwhile
-                    blob, shipped = replication.serialize_records(
-                        self.engine, [name], include_live=False
+                for i in range(0, len(gnames), batch):
+                    moved += self._drain_batch_locked(
+                        link, ep, gnames[i : i + batch]
                     )
-                    if not shipped:
-                        continue
-                    ep = self.migrating_epochs.get(slot)
-                    if ep is not None:
-                        # journaled migration: the target fsyncs the batch
-                        # into its ImportJournal BEFORE this ack — the
-                        # local delete below is then safe against a target
-                        # SIGKILL (ISSUE 13 target-kill gap)
-                        link.execute(
-                            "IMPORTRECORDS", "EPOCH", ep, "SOURCE",
-                            self.address(), blob, timeout=30.0,
-                        )
-                    else:
-                        link.execute("IMPORTRECORDS", blob, timeout=30.0)
-                    self.engine.store.delete_unguarded(name)
-                    moved += 1
-                    # drain-stream invalidation: the record just left this
-                    # node — a near cache serving it would miss every write
-                    # the target accepts from now on (push enqueue only, so
-                    # holding the record lock here is fine); active-guarded
-                    # like every other site so an idle-tracking migration
-                    # never touches the dispatch-shared table lock
-                    if self.tracking.active:
-                        self.tracking.note_write([name], None)
         finally:
             for link in links.values():
                 link.close()
         return moved
+
+    def _drain_batch_locked(self, link, ep: Optional[int],
+                            names: List[str]) -> int:
+        """Ship one drain batch under ALL its record locks (sorted
+        acquisition; serialize_records re-enters each per-record RLock)."""
+        from redisson_tpu.server import replication
+
+        with self.engine.locked_many(names):
+            present = [n for n in names if self.engine.store.peek(n)]
+            if not present:
+                return 0  # expired/deleted meanwhile
+            blob, shipped = replication.serialize_records(
+                self.engine, present, include_live=False
+            )
+            if not shipped:
+                return 0
+            if ep is not None:
+                # journaled migration: the target fsyncs the whole frame
+                # into its ImportJournal BEFORE this ack — the local
+                # deletes below are then safe against a target SIGKILL
+                # (ISSUE 13 target-kill gap), at ONE fsync per batch
+                link.execute(
+                    "IMPORTRECORDS", "EPOCH", ep, "SOURCE",
+                    self.address(), blob, timeout=30.0,
+                )
+            else:
+                link.execute("IMPORTRECORDS", blob, timeout=30.0)
+            shipped_names = [n for n, _nonce, _ver in shipped]
+            for name in shipped_names:
+                self.engine.store.delete_unguarded(name)
+            # drain-stream invalidation: the records just left this node —
+            # a near cache serving them would miss every write the target
+            # accepts from now on (push enqueue only, so holding the locks
+            # here is fine); active-guarded like every other site so an
+            # idle-tracking migration never touches the dispatch-shared
+            # table lock
+            if self.tracking.active:
+                self.tracking.note_write(shipped_names, None)
+            return len(shipped_names)
 
     # -- chaos hooks (fault plane, server layer) ------------------------------
 
@@ -1015,12 +1045,14 @@ class TpuServer:
         """Embedding-bank residency rows ({ftvec_banks, ftvec_device_bytes})
         from the lazily-created search service; zeros while none exists."""
         svc = self.engine._services.get("search")
+        zeros = {"ftvec_banks": 0.0, "ftvec_device_bytes": 0.0,
+                 "ftvec_index_bytes": 0.0}
         if svc is None:
-            return {"ftvec_banks": 0.0, "ftvec_device_bytes": 0.0}
+            return zeros
         try:
             return svc.device_census()
         except Exception:  # noqa: BLE001 — a broken gauge must not kill scrape
-            return {"ftvec_banks": 0.0, "ftvec_device_bytes": 0.0}
+            return zeros
 
     @staticmethod
     def _estimate_device_items(cmds) -> int:

@@ -322,10 +322,14 @@ def cmd_ft_create(server, ctx, args):
     """FT.CREATE idx [ON HASH] [PREFIX n p...] SCHEMA f TYPE [SORTABLE] ...
 
     VECTOR attributes use the RediSearch shape:
-    ``f VECTOR FLAT 6 TYPE FLOAT32 DIM d DISTANCE_METRIC {L2|COSINE|IP}`` —
-    FLAT/FLOAT32 only (exact scoring; the nargs pairs may arrive in any
-    order).  Each VECTOR field gets a device-resident embedding bank placed
-    on the index's slot-owner device (services/vector.py)."""
+    ``f VECTOR {FLAT|IVF} <nargs> TYPE {FLOAT32|FLOAT16|INT8} DIM d
+    DISTANCE_METRIC {L2|COSINE|IP} [NLIST n] [NPROBE p] [TRAIN_MIN t]``
+    (the nargs pairs may arrive in any order).  IVF routes queries through
+    a trained coarse-centroid bank and scores only the top-NPROBE cells;
+    FLOAT16/INT8 compress the bank at upload and dequantize in-kernel —
+    both axes compose (services/vector.py).  Each VECTOR field gets a
+    device-resident embedding bank placed on the index's slot-owner
+    device."""
     name = _s(args[0])
     prefixes = [""]
     i = 1
@@ -370,6 +374,10 @@ def cmd_ft_create(server, ctx, args):
                 "dtype": attrs["TYPE"],
                 "algo": algo,
             }
+            for opt_attr, key in (("NLIST", "nlist"), ("NPROBE", "nprobe"),
+                                  ("TRAIN_MIN", "train_min")):
+                if opt_attr in attrs:
+                    vector[fld][key] = _int(attrs[opt_attr].encode())
             schema[fld] = "VECTOR"
             i += 4 + nargs
         elif ty in ("TEXT", "TAG", "NUMERIC"):
@@ -417,7 +425,9 @@ def cmd_ft_info(server, ctx, args):
         vr = vec_rows.get(f)
         if vr is not None:
             # the vector attribute's full shape: dim/metric/rows/bytes —
-            # the per-field half of the HBM ledger FT.INFO exposes
+            # the per-field half of the HBM ledger FT.INFO exposes.
+            # device_bytes is the QUANTIZED (actual) residency, not the
+            # logical f32 size — compressed banks report what they hold
             row += [
                 b"algorithm", vr["algo"].encode(),
                 b"data_type", vr["dtype"].encode(),
@@ -426,6 +436,13 @@ def cmd_ft_info(server, ctx, args):
                 b"rows", vr["rows"],
                 b"device_bytes", vr["device_bytes"],
             ]
+            if vr["algo"] == "IVF":
+                row += [
+                    b"nlist", vr["nlist"],
+                    b"nprobe", vr["nprobe"],
+                    b"trained", 1 if vr["trained"] else 0,
+                    b"index_device_bytes", vr["index_device_bytes"],
+                ]
         flat_schema.append(row)
     out = [
         b"index_name", info["name"].encode(),
@@ -435,6 +452,7 @@ def cmd_ft_info(server, ctx, args):
     ]
     if "vector_device_bytes" in info:
         out += [b"vector_device_bytes", info["vector_device_bytes"]]
+        out += [b"vector_index_bytes", info.get("vector_index_bytes", 0)]
     return out
 
 
@@ -453,11 +471,11 @@ def _ft_score_bytes(d: float) -> bytes:
 
 def _ft_parse_search_opts(args, i):
     """Shared FT.SEARCH/FT.MSEARCH option tail: NOCONTENT / SORTBY / LIMIT /
-    PARAMS / DIALECT / WITHCURSOR [COUNT n]."""
+    PARAMS / DIALECT / NPROBE / WITHCURSOR [COUNT n]."""
     opts = {
         "nocontent": False, "sort_by": None, "desc": False,
         "off": 0, "lim": 10, "params": {}, "withcursor": False,
-        "cursor_count": 10,
+        "cursor_count": 10, "nprobe": None,
     }
     while i < len(args):
         opt = bytes(args[i]).upper()
@@ -482,6 +500,13 @@ def _ft_parse_search_opts(args, i):
             i += 2 + n
         elif opt == b"DIALECT":
             i += 2  # accepted for driver compatibility; grammar is fixed
+        elif opt == b"NPROBE":
+            # per-query IVF probe width (the recall/latency dial); rejected
+            # downstream for non-IVF fields
+            opts["nprobe"] = _int(args[i + 1])
+            if opts["nprobe"] <= 0:
+                raise RespError("ERR NPROBE must be positive")
+            i += 2
         elif opt == b"WITHCURSOR":
             opts["withcursor"] = True
             i += 1
@@ -588,7 +613,8 @@ def cmd_ft_search(server, ctx, args):
     q = _ft_knn_query_vectors(server, idx, knn, opts["params"])
     try:
         device, finish = svc.knn(
-            _s(args[0]), knn["field"], q, knn["k"], condition=cond
+            _s(args[0]), knn["field"], q, knn["k"], condition=cond,
+            nprobe=opts["nprobe"],
         )
     except ValueError as e:
         raise RespError(f"ERR {e}")
@@ -642,7 +668,8 @@ def cmd_ft_msearch(server, ctx, args):
                               expect_multiple=True)
     try:
         device, finish = svc.knn(
-            _s(args[0]), knn["field"], q, knn["k"], condition=cond
+            _s(args[0]), knn["field"], q, knn["k"], condition=cond,
+            nprobe=opts["nprobe"],
         )
     except ValueError as e:
         raise RespError(f"ERR {e}")

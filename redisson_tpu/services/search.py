@@ -178,7 +178,7 @@ class _NumericPlane:
 
         if self._bank is None:
             return jnp.zeros((0, 0), jnp.float32)
-        bank, _bias, rows = self._bank.device_planes()
+        bank, _bias, _scale, rows = self._bank.device_planes()
         if bank is None:
             return jnp.zeros((0, len(self.fields)), jnp.float32)
         return bank[:rows]
@@ -673,6 +673,7 @@ class SearchService:
         if idx.vectors:
             out["vector_fields"] = idx.vectors.info_rows()
             out["vector_device_bytes"] = idx.vectors.device_bytes()
+            out["vector_index_bytes"] = idx.vectors.index_device_bytes()
         return out
 
     def device_census(self) -> Dict[str, float]:
@@ -685,11 +686,20 @@ class SearchService:
             indexes = list(self._indexes.values())
         banks = 0
         total = 0
+        index_bytes = 0
         for idx in indexes:
             if idx.vectors:
                 banks += len(idx.vectors.banks)
                 total += idx.vectors.device_bytes()
-        return {"ftvec_banks": float(banks), "ftvec_device_bytes": float(total)}
+                index_bytes += idx.vectors.index_device_bytes()
+        return {
+            "ftvec_banks": float(banks),
+            "ftvec_device_bytes": float(total),
+            # the IVF coarse index (centroids + cell table) — its own row
+            # so soaks catch a cell-index leak on DROPINDEX even when the
+            # bank itself tears down correctly
+            "ftvec_index_bytes": float(index_bytes),
+        }
 
     # -- tracking-plane integration (ISSUE 11) --------------------------------
     #
@@ -724,8 +734,11 @@ class SearchService:
     # -- KNN (FT VECTOR, services/vector.py) ----------------------------------
 
     def knn(self, index: str, field: str, queries, k: int,
-            condition: Optional[Condition] = None):
-        """One stacked FLAT KNN over the index's embedding bank.
+            condition: Optional[Condition] = None,
+            nprobe: Optional[int] = None):
+        """One stacked KNN over the index's embedding bank (FLAT exact, or
+        routed IVF once the field's coarse quantizer trained; ``nprobe``
+        overrides the IVF field's probe width for this query).
 
         Returns ``(device, finish)``: with the device plane armed, `device`
         is the (dist, idx) kernel-output pair — the caller wraps it in a
@@ -741,6 +754,12 @@ class SearchService:
         bank = idx.vectors.banks.get(field) if idx.vectors else None
         if bank is None:
             raise ValueError(f"'{field}' is not a VECTOR field of '{index}'")
+        if nprobe and bank.spec.algo != "IVF":
+            # validated HERE, before either scoring path dispatches: the
+            # disarmed path resolves inside finish() — past the verb's
+            # ValueError->RespError mapping — so a late raise would reply
+            # 'ERR internal' disarmed but a clean error armed
+            raise ValueError("NPROBE applies to an IVF field")
         q = np.ascontiguousarray(queries, np.float32).reshape(-1, bank.spec.dim)
         nq = q.shape[0]
         allowed = None
@@ -755,7 +774,7 @@ class SearchService:
                 return None, lambda _vals: [[] for _ in range(nq)]
         armed = V.vector_enabled()
         out = (
-            bank.knn_async(q, k, allowed_rows=allowed)
+            bank.knn_async(q, k, allowed_rows=allowed, nprobe=nprobe)
             if armed else None
         )
         if armed and out is None:
@@ -763,19 +782,18 @@ class SearchService:
 
         def finish(vals):
             if vals is None:  # disarmed: score now, on host
-                host = bank.knn_host(q, k, allowed_rows=allowed)
+                host = bank.knn_host(q, k, allowed_rows=allowed,
+                                     nprobe=nprobe)
                 if host is None:
                     return [[] for _ in range(nq)]
                 dist_h, idx_h, _nq, k_eff = host
             else:
                 dist_h, idx_h = np.asarray(vals[0]), np.asarray(vals[1])
                 k_eff = dist_h.shape[1]
-            res = []
+            picked = []   # (qi, rowid, doc) winners, reply order
             for qi in range(nq):
-                row = []
                 for j in range(k_eff):
-                    d = float(dist_h[qi, j])
-                    if not np.isfinite(d):
+                    if not np.isfinite(dist_h[qi, j]):
                         continue  # k exceeded the live rows: padding entry
                     r = int(idx_h[qi, j])
                     doc = (
@@ -783,8 +801,21 @@ class SearchService:
                     )
                     if doc is None:
                         continue  # doc deleted between dispatch and fetch
-                    row.append((doc, d))
-                res.append(row)
+                    picked.append((qi, r, doc))
+            # the kernel/NumPy paths choose WHICH rows win; the scores on
+            # the wire come from ONE canonical per-pair routine so armed
+            # and disarmed replies are byte-identical (vector.pair_scores)
+            res = [[] for _ in range(nq)]
+            if picked:
+                scores = bank.pair_scores(
+                    q,
+                    np.fromiter((p[0] for p in picked), np.int64,
+                                count=len(picked)),
+                    np.fromiter((p[1] for p in picked), np.int64,
+                                count=len(picked)),
+                )
+                for (qi, _r, doc), d in zip(picked, scores):
+                    res[qi].append((doc, float(d)))
             return res
 
         if not armed:

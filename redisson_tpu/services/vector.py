@@ -1,23 +1,41 @@
-"""Device-accelerated vector search: FT VECTOR fields + KNN banks (ISSUE 11).
+"""Device-accelerated vector search: FT VECTOR fields + KNN banks.
 
 Parity target: RediSearch's ``FT.CREATE ... SCHEMA f VECTOR FLAT 6 TYPE
 FLOAT32 DIM d DISTANCE_METRIC {L2|COSINE|IP}`` and the ``(*)=>[KNN k @f $v]``
 query arm of FT.SEARCH (RedissonSearch.java drives the same verbs).  The
 reference scores every document per-query in the RediSearch C module; here an
-index's embeddings live as ONE device-resident ``(capacity, dim)`` float32
-bank and a FLAT KNN query is a single jitted matmul-(+norm)-top-k kernel
+index's embeddings live as ONE device-resident ``(capacity, dim)`` bank and a
+FLAT KNN query is a single jitted matmul-(+norm)-top-k kernel
 (core/kernels.knn_topk) — the MXU replaces the per-doc loop, exactly the
 trade the numeric plane already made for range predicates.
+
+Two sub-linear axes compose on top of FLAT (ISSUE 14), both behind the
+recall gate that keeps them honest:
+
+  * **IVF** (``VECTOR IVF ... NLIST n [NPROBE p]``) — a coarse k-means
+    centroid bank (kernels.kmeans_step over the host mirror, trained at a
+    build threshold and retrained on growth drift) routes each query
+    through one small (Q, d) x (d, nlist) matmul; only the rows of the
+    top-``nprobe`` cells are gathered and scored
+    (kernels.knn_ivf_topk).  Per-cell row lists ship as a CSR-style
+    uniform-stride device index ((nlist, cell_cap) int32, sentinel-padded)
+    that lives IN the bank's record — centroids + cells + bank move
+    together through fenced rebalances and die together on DROPINDEX.
+  * **FP16 / INT8 storage** (``TYPE FLOAT16|INT8``) — bank blocks compress
+    at upload (two f16 / four int8 lanes per packed uint32 word; INT8
+    carries a symmetric per-row scale) and decompress INSIDE the scoring
+    kernel, so HBM holds 2-4x more rows per chip and the MXU still sees
+    one fused program.  The host mirror stores the DEQUANTIZED values, so
+    the disarmed path and the recall oracle score exactly what the device
+    scores.
 
 Bank layout (the bloom-bank discipline generalized to float rows):
 
   * **Block-appended, never re-uploaded** — ingested rows buffer host-side
-    and flush to the device as ONE packed ``(P, dim+2)`` uint32 transfer
-    (row index + bias bits + bitcast row data) through the engine's
-    double-buffered staging pool; a stream of single-doc ingests costs
-    O(N/block) H2D transfers, not O(N) full-bank uploads (the
-    ``NumericTable.matrix()`` bug this module retires — ``_NumericPlane``
-    now rides the same ``DeviceRowBank``).
+    and flush to the device as ONE packed ``(P, cols)`` uint32 transfer
+    (row index + bias bits [+ scale bits] + bitcast row lanes) through the
+    engine's double-buffered staging pool; a stream of single-doc ingests
+    costs O(N/block) H2D transfers, not O(N) full-bank uploads.
   * **Capacity growth is an HBM copy** — the grown plane is zero-filled on
     device and the old rows copy device-side (kernels.rowbank_grow); host
     rows are never re-staged.
@@ -39,9 +57,11 @@ and dispatch holds the owning device's lane gate so KNN occupancy is
 accounted like every other verb.
 
 Disarm with ``RTPU_NO_VECTOR=1`` / ``set_vector(False)``: scoring runs a
-pure-NumPy float32 path with the same formulas and the same stable
-tie-break, so replies are identical with the device path off (the A/B
-discipline of every plane in this repo).
+pure-NumPy float32 path with the same formulas, the same canonical IVF
+index (centroids, assignments and cell lists are HOST state — whichever
+path trained them, both score through them) and the same stable tie-break,
+so replies are identical with the device path off (the A/B discipline of
+every plane in this repo).
 """
 from __future__ import annotations
 
@@ -72,37 +92,76 @@ def set_vector(on: bool) -> bool:
 
 
 VECTOR_METRICS = ("L2", "COSINE", "IP")
+VECTOR_DTYPES = ("FLOAT32", "FLOAT16", "INT8")
+VECTOR_ALGOS = ("FLAT", "IVF")
 DEFAULT_BLOCK = 256  # rows buffered per H2D flush (the O(N/block) contract)
+DEFAULT_NPROBE = 8
+RETRAIN_GROWTH = 1.5   # retrain once the corpus grew this much past the
+                       # last training set (the drift heuristic)
+KMEANS_ITERS = 6
+IVF_CELL_IMBALANCE = 3  # cell_cap bound = this x mean occupancy; rows past
+                        # it spill to their next-nearest cell (recall-vs-
+                        # gather-width trade, see _rebuild_cells)
+
+_IVF_SENTINEL = np.int32(0x3FFFFFFF)  # padded cells entry: never a live row
 
 
 @dataclass
 class VectorFieldSpec:
-    """One FT VECTOR schema attribute (FLAT / FLOAT32 — the exact-scoring
-    subset; HNSW would change recall semantics, FLAT cannot)."""
+    """One FT VECTOR schema attribute.
+
+    ``algo``   — FLAT (exact) or IVF (sub-linear, recall-gated).
+    ``dtype``  — FLOAT32, or the compressed bank formats FLOAT16 / INT8
+                 (symmetric per-row scale); compression composes with both
+                 algorithms.
+    ``nlist``  — IVF coarse-cell count (required for IVF).
+    ``nprobe`` — default cells probed per query (queries may override);
+                 0 resolves to min(nlist, 8).
+    ``train_min`` — row count at which the coarse quantizer first trains;
+                 0 resolves to max(4 * nlist, 256).  Below it IVF scores
+                 FLAT (exact)."""
 
     field: str
     dim: int
     metric: str = "COSINE"
     dtype: str = "FLOAT32"
     algo: str = "FLAT"
+    nlist: int = 0
+    nprobe: int = 0
+    train_min: int = 0
 
     def __post_init__(self):
         self.metric = str(self.metric).upper()
         self.algo = str(self.algo).upper()
         self.dtype = str(self.dtype).upper()
+        self.dim = int(self.dim)
+        self.nlist = int(self.nlist)
+        self.nprobe = int(self.nprobe)
+        self.train_min = int(self.train_min)
         if self.dim <= 0:
             raise ValueError("vector DIM must be positive")
         if self.metric not in VECTOR_METRICS:
             raise ValueError(f"unsupported DISTANCE_METRIC '{self.metric}'")
-        if self.algo != "FLAT":
+        if self.algo not in VECTOR_ALGOS:
             raise ValueError(f"unsupported vector algorithm '{self.algo}'")
-        if self.dtype != "FLOAT32":
+        if self.dtype not in VECTOR_DTYPES:
             raise ValueError(f"unsupported vector TYPE '{self.dtype}'")
+        if self.algo == "IVF":
+            if self.nlist < 2:
+                raise ValueError("IVF needs NLIST >= 2")
+            if self.nprobe <= 0:
+                self.nprobe = min(self.nlist, DEFAULT_NPROBE)
+            self.nprobe = min(self.nprobe, self.nlist)
+            if self.train_min <= 0:
+                self.train_min = max(4 * self.nlist, 256)
+        elif self.nlist or self.nprobe or self.train_min:
+            raise ValueError("NLIST/NPROBE/TRAIN_MIN are IVF attributes")
 
     def to_meta(self) -> Dict[str, Any]:
         return {
             "field": self.field, "dim": self.dim, "metric": self.metric,
-            "dtype": self.dtype, "algo": self.algo,
+            "dtype": self.dtype, "algo": self.algo, "nlist": self.nlist,
+            "nprobe": self.nprobe, "train_min": self.train_min,
         }
 
 
@@ -143,28 +202,85 @@ def _query_bucket(n: int) -> int:
     return b
 
 
+# -- bank compression (FP16 / INT8 with symmetric per-row scale) --------------
+
+
+def phys_width(dim: int, dtype: str) -> int:
+    """Physical bank width: the logical dim rounded up so rows pack whole
+    uint32 words in the staged upload (2 f16 / 4 int8 lanes per word).
+    Padding lanes hold zeros — they add exact 0.0 to every dot product and
+    norm, so scoring on the padded width equals scoring on the logical."""
+    if dtype == "FLOAT16":
+        return dim + (dim & 1)
+    if dtype == "INT8":
+        return (dim + 3) & ~3
+    return dim
+
+
+def quantize_row(row: np.ndarray, dtype: str, pwidth: int):
+    """(stored row at physical width, scale f32, dequantized logical f32).
+
+    The DEQUANTIZED values are what both scoring paths see: the device
+    kernel widens the stored lanes in-program (kernels._bank_f32) and the
+    host mirror records exactly those widened values — armed and disarmed
+    scoring read the same numbers."""
+    dim = row.shape[0]
+    if dtype == "FLOAT16":
+        stored = np.zeros(pwidth, np.float16)
+        stored[:dim] = row.astype(np.float16)
+        return stored, np.float32(1.0), stored[:dim].astype(np.float32)
+    if dtype == "INT8":
+        amax = float(np.max(np.abs(row))) if dim else 0.0
+        if not np.isfinite(amax) or amax == 0.0:
+            scale = np.float32(1.0)
+        else:
+            scale = np.float32(amax / 127.0)
+        stored = np.zeros(pwidth, np.int8)
+        with np.errstate(invalid="ignore"):
+            q = np.clip(np.rint(row / scale), -127, 127)
+        stored[:dim] = np.nan_to_num(q).astype(np.int8)
+        return stored, scale, stored[:dim].astype(np.float32) * scale
+    stored = np.zeros(pwidth, np.float32)
+    stored[:dim] = row
+    return stored, np.float32(1.0), stored[:dim].copy()
+
+
+_NP_DTYPES = {
+    "FLOAT32": np.float32, "FLOAT16": np.float16, "INT8": np.int8,
+}
+
+
 class DeviceRowBank:
-    """Block-appended device-resident float32 row bank.
+    """Block-appended device-resident row bank (f32 / f16 / int8+scale).
 
     The shared substrate of the embedding banks AND the search service's
     numeric plane: rows are addressed by the index's doc rowid, mutations
     buffer host-side in ``_pending`` and flush as ONE packed upload +
-    ONE scatter kernel per block (kernels.rowbank_write_packed).  A host
+    ONE scatter kernel per block (kernels.rowbank_write_packed*).  A host
     mirror is kept alongside — it feeds the pure-NumPy disarmed path, the
-    recall oracle, and index rebuilds, and costs rows*width*4 host bytes.
+    recall oracle, and index rebuilds, and costs rows*width*4 host bytes
+    (always f32: it stores the DEQUANTIZED values the device scores).
 
     This base class is STANDALONE (arrays held directly, default device) —
     the engine-free binding ``_NumericPlane`` uses.  ``RecordRowBank``
     overrides the plane seam to live inside a DeviceStore record."""
 
-    def __init__(self, width: int, block: int = DEFAULT_BLOCK):
-        self.width = int(width)
+    def __init__(self, width: int, block: int = DEFAULT_BLOCK,
+                 dtype: str = "FLOAT32"):
+        self.width = int(width)          # logical dim
+        self.dtype = str(dtype).upper()
+        if self.dtype not in VECTOR_DTYPES:
+            raise ValueError(f"unsupported bank dtype '{dtype}'")
+        self.pwidth = phys_width(self.width, self.dtype)
         self.block = max(1, int(block))
         self.rows = 0            # logical row count (max rowid + 1)
         self._cap = 0            # device capacity (rows)
-        self._pending: Dict[int, Tuple[float, Optional[np.ndarray]]] = {}
+        # rowid -> (bias, stored row at pwidth | None, scale)
+        self._pending: Dict[int, Tuple[float, Optional[np.ndarray],
+                                       np.float32]] = {}
         self._lock = threading.RLock()
-        # host mirror (disarmed path / oracle): grown by doubling
+        # host mirror (disarmed path / oracle): grown by doubling; always
+        # f32 at the LOGICAL width, holding dequantized values
         self._host = np.zeros((0, self.width), np.float32)
         self._host_bias = np.zeros((0,), np.float32)
         # observability: the transfer discipline tests pin these
@@ -172,13 +288,26 @@ class DeviceRowBank:
         self.grows = 0           # device-side capacity copies
         self.dispatches = 0      # scatter kernels dispatched
 
+    # -- packed upload geometry ----------------------------------------------
+
+    def _packed_cols(self) -> int:
+        if self.dtype == "FLOAT16":
+            return 2 + self.pwidth // 2
+        if self.dtype == "INT8":
+            return 3 + self.pwidth // 4
+        return 2 + self.pwidth
+
     # -- plane seam (overridden by RecordRowBank) -----------------------------
 
     def _get_planes(self):
-        return getattr(self, "_bank", None), getattr(self, "_bias", None)
+        return (
+            getattr(self, "_bank", None),
+            getattr(self, "_bias", None),
+            getattr(self, "_scale", None),
+        )
 
-    def _set_planes(self, bank, bias) -> None:
-        self._bank, self._bias = bank, bias
+    def _set_planes(self, bank, bias, scale) -> None:
+        self._bank, self._bias, self._scale = bank, bias, scale
 
     def _target_device(self):
         return None
@@ -207,17 +336,29 @@ class DeviceRowBank:
         self._host[rowid] = 0.0 if row is None else row
         self._host_bias[rowid] = bias
 
+    def _note_row_change(self, rowid: int) -> None:
+        """Hook for derived index maintenance (EmbeddingBank's IVF plane);
+        called under the bank lock on every set_row."""
+
     def set_row(self, rowid: int, row: Optional[np.ndarray]) -> None:
         """Install/overwrite one row.  ``row=None`` kills it: data goes to
         zeros and bias to +inf, so the row can never reach a top-k (zeros,
         not NaN — a NaN row would poison the whole distance column through
         the matmul; callers that WANT NaN semantics, like the numeric
         plane's cleared rows, pass an explicit NaN-filled row)."""
-        bias = np.float32(np.inf) if row is None else np.float32(0.0)
+        if row is None:
+            bias = np.float32(np.inf)
+            stored, scale, deq = None, np.float32(1.0), None
+        else:
+            bias = np.float32(0.0)
+            stored, scale, deq = quantize_row(
+                np.asarray(row, np.float32), self.dtype, self.pwidth
+            )
         with self._lock:
-            self._mirror(rowid, float(bias), row)
+            self._mirror(rowid, float(bias), deq)
             self.rows = max(self.rows, rowid + 1)
-            self._pending[rowid] = (float(bias), row)
+            self._pending[rowid] = (float(bias), stored, scale)
+            self._note_row_change(rowid)
             if vector_enabled() and len(self._pending) >= self.block:
                 self.flush_pending()
 
@@ -235,19 +376,49 @@ class DeviceRowBank:
         while new_cap < needed:
             new_cap *= 2
         device = self._target_device()
+        jdt = {"FLOAT32": jnp.float32, "FLOAT16": jnp.float16,
+               "INT8": jnp.int8}[self.dtype]
         ctx = jax.default_device(device) if device is not None else nullcontext()
         with ctx:
-            grown = jnp.zeros((new_cap, self.width), jnp.float32)
+            grown = jnp.zeros((new_cap, self.pwidth), jdt)
             gbias = jnp.zeros((new_cap,), jnp.float32)
+            gscale = (
+                jnp.ones((new_cap,), jnp.float32)
+                if self.dtype == "INT8" else None
+            )
         if device is not None:
             grown = jax.device_put(grown, device)
             gbias = jax.device_put(gbias, device)
-        bank, bias = self._get_planes()
+            if gscale is not None:
+                gscale = jax.device_put(gscale, device)
+        bank, bias, scale = self._get_planes()
         if bank is not None and self._cap > 0:
             grown, gbias = K.rowbank_grow(bank, bias, grown, gbias)
+            if gscale is not None and scale is not None:
+                gscale = K.rowbank_grow_plane(scale, gscale)
             self.grows += 1
-        self._set_planes(grown, gbias)
+        self._set_planes(grown, gbias, gscale)
         self._cap = new_cap
+
+    def _pack_items(self, buf: np.ndarray, items) -> None:
+        """Fill the packed upload buffer: col 0 rowid, col 1 bias bits,
+        [col 2 scale bits for INT8,] remaining cols = row lanes bitcast."""
+        n = len(items)
+        buf[:n, 0] = np.fromiter((r for r, _v in items), np.uint32, count=n)
+        buf[:n, 1] = np.fromiter(
+            (b for _r, (b, _row, _s) in items), np.float32, count=n
+        ).view(np.uint32)
+        rows = np.zeros((n, self.pwidth), _NP_DTYPES[self.dtype])
+        for i, (_r, (_b, row, _s)) in enumerate(items):
+            if row is not None:
+                rows[i] = row
+        if self.dtype == "INT8":
+            buf[:n, 2] = np.fromiter(
+                (s for _r, (_b, _row, s) in items), np.float32, count=n
+            ).view(np.uint32)
+            buf[:n, 3:] = rows.view(np.uint32)
+        else:
+            buf[:n, 2:] = rows.view(np.uint32)
 
     def flush_pending(self) -> int:
         """Drain the pending rows to the device: ONE packed H2D + ONE
@@ -263,27 +434,14 @@ class DeviceRowBank:
                 self._ensure_capacity_locked(self.rows)
                 n = len(pending)
                 p = K.bucket_size(n, minimum=min(self.block, 256))
-                shape = (p, self.width + 2)
+                shape = (p, self._packed_cols())
                 pool = self._staging_pool()
                 if pool is None:
                     buf, slot = np.zeros(shape, np.uint32), None
                 else:
                     buf, slot = pool.acquire(shape, np.uint32)
                 try:
-                    items = sorted(pending.items())
-                    idxs = np.fromiter(
-                        (r for r, _v in items), np.uint32, count=n
-                    )
-                    biasv = np.fromiter(
-                        (b for _r, (b, _row) in items), np.float32, count=n
-                    )
-                    rows = np.zeros((n, self.width), np.float32)
-                    for i, (_r, (_b, row)) in enumerate(items):
-                        if row is not None:
-                            rows[i] = row
-                    buf[:n, 0] = idxs
-                    buf[:n, 1] = biasv.view(np.uint32)
-                    buf[:n, 2:] = rows.view(np.uint32)
+                    self._pack_items(buf, sorted(pending.items()))
                     staged = K.stage(buf)
                 except BaseException:
                     if pool is not None:
@@ -291,26 +449,37 @@ class DeviceRowBank:
                     raise
                 if pool is not None:
                     pool.commit(slot, staged)
-                bank, bias = self._get_planes()
-                bank, bias = K.rowbank_write_packed(
-                    bank, bias, staged, K.valid_n(n)
-                )
-                self._set_planes(bank, bias)
+                bank, bias, scale = self._get_planes()
+                nv = K.valid_n(n)
+                if self.dtype == "INT8":
+                    bank, scale, bias = K.rowbank_write_packed_i8(
+                        bank, scale, bias, staged, nv
+                    )
+                elif self.dtype == "FLOAT16":
+                    bank, bias = K.rowbank_write_packed_f16(
+                        bank, bias, staged, nv
+                    )
+                else:
+                    bank, bias = K.rowbank_write_packed(
+                        bank, bias, staged, nv
+                    )
+                self._set_planes(bank, bias, scale)
                 self.h2d_flushes += 1
                 self.dispatches += 1
             return n
 
-    def device_planes(self) -> Tuple[Any, Any, int]:
-        """(bank, bias, rows) with every pending row flushed — the kernel
-        operand view.  bank is None while the bank has never filled."""
+    def device_planes(self) -> Tuple[Any, Any, Any, int]:
+        """(bank, bias, scale, rows) with every pending row flushed — the
+        kernel operand view (scale is None except for INT8 banks).  bank is
+        None while the bank has never filled."""
         with self._lock:
             self.flush_pending()
-            bank, bias = self._get_planes()
-            return bank, bias, self.rows
+            bank, bias, scale = self._get_planes()
+            return bank, bias, scale, self.rows
 
     def host_planes(self) -> Tuple[np.ndarray, np.ndarray]:
         """(rows x width data, bias) host mirror — the disarmed scoring path
-        and the brute-force oracle's input."""
+        and the brute-force oracle's input (dequantized f32)."""
         with self._lock:
             return (
                 self._host[: self.rows].copy(),
@@ -318,12 +487,17 @@ class DeviceRowBank:
             )
 
     def device_bytes(self) -> int:
-        bank, bias = self._get_planes()
+        bank, bias, scale = self._get_planes()
         total = 0
-        for a in (bank, bias):
+        for a in (bank, bias, scale):
             if a is not None:
                 total += int(a.nbytes)
         return total
+
+    def logical_f32_bytes(self) -> int:
+        """What the same rows would cost uncompressed — the denominator of
+        the compression-ratio gauge (config7_int8_bytes_ratio)."""
+        return int(self._cap) * (self.width + 1) * 4
 
     def pending_count(self) -> int:
         with self._lock:
@@ -340,9 +514,9 @@ class RecordRowBank(DeviceRowBank):
     KIND = "vector_bank"
 
     def __init__(self, engine, name: str, width: int,
-                 block: int = DEFAULT_BLOCK, meta: Optional[dict] = None,
-                 reset: bool = True):
-        super().__init__(width, block)
+                 block: int = DEFAULT_BLOCK, dtype: str = "FLOAT32",
+                 meta: Optional[dict] = None, reset: bool = True):
+        super().__init__(width, block, dtype=dtype)
         self._engine = engine
         self.name = name
         from redisson_tpu.core.store import StateRecord
@@ -360,7 +534,7 @@ class RecordRowBank(DeviceRowBank):
                     StateRecord(
                         kind=self.KIND,
                         meta=dict(meta or {}, rows=0, width=width,
-                                  block=self.block),
+                                  block=self.block, dtype=self.dtype),
                         arrays={},
                     ),
                 )
@@ -373,19 +547,21 @@ class RecordRowBank(DeviceRowBank):
 
     def _get_planes(self):
         arrays = self._rec().arrays
-        return arrays.get("bank"), arrays.get("bias")
+        return arrays.get("bank"), arrays.get("bias"), arrays.get("scale")
 
-    def _set_planes(self, bank, bias) -> None:
+    def _set_planes(self, bank, bias, scale) -> None:
         rec = self._rec()
         rec.arrays["bank"] = bank
         rec.arrays["bias"] = bias
+        if scale is not None:
+            rec.arrays["scale"] = scale
         rec.meta["rows"] = self.rows
         rec.version += 1
 
     def _target_device(self):
         from redisson_tpu.core.ioplane import device_of
 
-        bank, _bias = self._get_planes()
+        bank, _bias, _scale = self._get_planes()
         if bank is not None:
             dev = device_of(bank)
             if dev is not None:
@@ -404,16 +580,342 @@ class RecordRowBank(DeviceRowBank):
             self._engine.store.delete_unguarded(self.name)
 
 
+class _IvfPlane:
+    """Host-canonical IVF coarse index for one embedding bank: centroids,
+    per-row cell assignments and the padded per-cell row lists.  BOTH
+    scoring paths read this one state — whichever path trained it — so
+    armed and disarmed replies stay identical.  The device copies
+    (``centroids`` / ``cells`` arrays in the bank's record) are derived,
+    stamped, and re-uploaded lazily when stale."""
+
+    def __init__(self, spec: "VectorFieldSpec"):
+        self.spec = spec
+        self.centroids: Optional[np.ndarray] = None  # (nlist, dim) f32
+        self.assign = np.full(0, -1, np.int32)       # rowid -> cell | -1
+        self.cells: Optional[np.ndarray] = None      # (nlist, cap) i32
+        self.cell_cap = 0
+        self.trained_rows = 0
+        self.trains = 0
+        self.dirty_rows: set = set()
+        self.cells_stale = False
+        self.training = False    # a snapshot-train is in flight (off-lock)
+        self.stamp = 0           # host index version
+        self.uploaded_stamp = -1  # device copy version
+        self.index_uploads = 0
+
+
 class EmbeddingBank(RecordRowBank):
     """One index-field embedding bank + the KNN dispatch path."""
 
     def __init__(self, engine, index: str, spec: VectorFieldSpec,
                  block: int = DEFAULT_BLOCK, reset: bool = True):
         self.spec = spec
+        self._ivf = _IvfPlane(spec) if spec.algo == "IVF" else None
         super().__init__(
             engine, bank_record_name(index, spec.field), spec.dim,
-            block=block, meta=dict(spec.to_meta(), index=index), reset=reset,
+            block=block, dtype=spec.dtype,
+            meta=dict(spec.to_meta(), index=index), reset=reset,
         )
+
+    # -- IVF host-canonical index maintenance ---------------------------------
+
+    def _note_row_change(self, rowid: int) -> None:
+        if self._ivf is not None:
+            self._ivf.dirty_rows.add(rowid)
+
+    def _centroid_l2(self, rows: np.ndarray) -> np.ndarray:
+        """L2 assignment of rows (M, dim) to the canonical centroids —
+        np.argmin ties toward the lower cell, matching kernels.kmeans_step."""
+        c = self._ivf.centroids
+        d = (
+            np.sum(rows * rows, axis=1, dtype=np.float32)[:, None]
+            - 2.0 * (rows @ c.T)
+            + np.sum(c * c, axis=1, dtype=np.float32)[None, :]
+        )
+        return np.argmin(d, axis=1).astype(np.int32)
+
+    def _needs_train_locked(self) -> bool:
+        ivf = self._ivf
+        n = self.rows
+        return n >= ivf.spec.train_min and (
+            ivf.centroids is None
+            or n >= int(RETRAIN_GROWTH * ivf.trained_rows)
+        )
+
+    def _train_snapshot_locked(self):
+        """(n, pts copy, weights, pre-snapshot dirty set) or None when too
+        few live rows to seat nlist centroids."""
+        ivf = self._ivf
+        n = self.rows
+        live = np.isfinite(self._host_bias[:n])
+        if int(np.count_nonzero(live)) < ivf.spec.nlist:
+            return None
+        return (
+            n,
+            self._host[:n].copy(),
+            live.astype(np.float32),
+            frozenset(ivf.dirty_rows),
+        )
+
+    def _train_compute(self, n: int, pts: np.ndarray, w: np.ndarray):
+        """The pure training computation — runs WITHOUT the bank lock:
+        jitted kmeans_step iterations when the device plane is armed, the
+        same NumPy formula when disarmed.  Either way the result
+        (centroids + assignments) is plain host data the caller installs
+        as the one canonical index."""
+        nlist = self._ivf.spec.nlist
+        live = w > 0.0
+        # deterministic seeded init from live rows (pure host-side, so the
+        # SAME init feeds whichever iteration path runs)
+        rng = np.random.default_rng(0x1DF5EED ^ n)
+        init = rng.choice(np.nonzero(live)[0], nlist, replace=False)
+        cent = pts[np.sort(init)].astype(np.float32, copy=True)
+        if vector_enabled():
+            from redisson_tpu.core import kernels as K
+
+            dp = K.stage(pts)
+            dw = K.stage(w)
+            dc = K.stage(cent)
+            assign = None
+            for _ in range(KMEANS_ITERS):
+                dc, assign = K.kmeans_step(dp, dw, dc)
+            cent = np.asarray(dc)
+            assign = np.asarray(assign)
+        else:
+            assign = None
+            for _ in range(KMEANS_ITERS):
+                d = (
+                    np.sum(pts * pts, axis=1, dtype=np.float32)[:, None]
+                    - 2.0 * (pts @ cent.T)
+                    + np.sum(cent * cent, axis=1, dtype=np.float32)[None, :]
+                )
+                assign = np.argmin(d, axis=1).astype(np.int32)
+                sums = np.zeros_like(cent)
+                np.add.at(sums, assign, pts * w[:, None])
+                counts = np.zeros(cent.shape[0], np.float32)
+                np.add.at(counts, assign, w)
+                cent = np.where(
+                    counts[:, None] > 0.0,
+                    sums / np.maximum(counts, 1.0)[:, None],
+                    cent,
+                )
+        return cent, np.where(live, assign, -1).astype(np.int32)
+
+    def _train_now(self) -> None:
+        """One training run: snapshot under the lock, ITERATE OUTSIDE IT
+        (a 50k x 128 x nlist=1536 training is seconds of compute — holding
+        the bank lock across it would stall every query and ingest on the
+        field, a tail-latency cliff the QoS plane can't see), install the
+        result under the lock.  Queries during the run score on the
+        previous index (or FLAT while untrained); `training` keeps
+        concurrent callers from duplicating the work."""
+        ivf = self._ivf
+        with self._lock:
+            if ivf.training:
+                return
+            snap = self._train_snapshot_locked()
+            if snap is None:
+                return
+            ivf.training = True
+        try:
+            n, pts, w, pre_dirty = snap
+            cent, assign = self._train_compute(n, pts, w)
+        finally:
+            with self._lock:
+                ivf.training = False
+        with self._lock:
+            ivf.centroids = cent
+            if ivf.assign.shape[0] < max(n, self.rows):
+                grown = np.full(
+                    max(self.rows, n, 2 * max(1, ivf.assign.shape[0])),
+                    -1, np.int32,
+                )
+                grown[: ivf.assign.shape[0]] = ivf.assign
+                ivf.assign = grown
+            ivf.assign[:n] = assign
+            ivf.trained_rows = n
+            ivf.trains += 1
+            # rows dirty AT the snapshot are covered by this training; rows
+            # dirtied DURING it keep their dirty mark (their mirror values
+            # post-date the snapshot).  A row in both sets keeps its
+            # snapshot-value assignment — one update behind, self-corrected
+            # at its next write and bounded by the recall gate.
+            ivf.dirty_rows -= pre_dirty
+            ivf.cells_stale = True
+
+    def _maybe_train(self) -> None:
+        """Train/retrain gate, called by BOTH scoring paths BEFORE they
+        take the bank lock for dispatch."""
+        if self._ivf is None:
+            return
+        with self._lock:
+            if not self._needs_train_locked() or self._ivf.training:
+                return
+        self._train_now()
+
+    def _rebuild_cells(self) -> None:
+        """Repack the per-cell row lists into the uniform-stride CSR table
+        ((nlist, cell_cap) int32, sentinel-padded, rowids ascending within
+        a cell — the tie-break order both scoring paths share).
+
+        BALANCED: cell_cap is bounded at IVF_CELL_IMBALANCE x the mean
+        occupancy (bucketed),
+        because the kernel's candidate gather is O(nprobe * cell_cap) per
+        query — one kmeans-imbalanced giant cell would silently inflate
+        EVERY query's gather past the cache-friendly window.  An overfull
+        cell keeps its centroid-closest rows and SPILLS the rest to their
+        next-nearest cell with room (Faiss-style balanced assignment); a
+        spilled row is still found through its second-best centroid, and
+        the recall gate keeps the trade honest."""
+        from redisson_tpu.core import kernels as K
+
+        ivf = self._ivf
+        n = self.rows
+        a = ivf.assign[:n].copy()
+        live_rows = np.nonzero(a >= 0)[0]
+        n_live = live_rows.shape[0]
+        counts = np.bincount(a[live_rows], minlength=ivf.spec.nlist)
+        avg = max(1, -(-n_live // ivf.spec.nlist))  # ceil
+        cap = K.bucket_size(max(4, IVF_CELL_IMBALANCE * avg), minimum=4)
+        cent = ivf.centroids
+        overfull = np.nonzero(counts > cap)[0]
+        for c in overfull:
+            members = live_rows[a[live_rows] == c]
+            rows = self._host[members]
+            d_own = np.sum((rows - cent[c][None, :]) ** 2, axis=1)
+            order = np.argsort(d_own, kind="stable")
+            spill = members[order[cap:]]
+            # next-nearest cells with room, nearest-first (stable)
+            srows = self._host[spill]
+            d_all = (
+                np.sum(srows * srows, axis=1, dtype=np.float32)[:, None]
+                - 2.0 * (srows @ cent.T)
+                + np.sum(cent * cent, axis=1, dtype=np.float32)[None, :]
+            )
+            pref = np.argsort(d_all, axis=1, kind="stable")
+            for i, rowid in enumerate(spill):
+                placed = False
+                for cc in pref[i]:
+                    if cc != c and counts[cc] < cap:
+                        a[rowid] = cc
+                        counts[cc] += 1
+                        placed = True
+                        break
+                if not placed:  # pragma: no cover — nlist*cap >= 2*n_live
+                    a[rowid] = int(np.argmin(counts))
+                    counts[a[rowid]] += 1
+            counts[c] = cap
+        cells = np.full((ivf.spec.nlist, cap), _IVF_SENTINEL, np.int32)
+        # vectorized repack (a per-query Python loop over the corpus would
+        # dominate interleaved ingest/query workloads): sort live rows by
+        # (cell, rowid) — lexsort's last key is primary — then each row's
+        # slot is its rank within its cell's contiguous run
+        if live_rows.size:
+            order = np.lexsort((live_rows, a[live_rows]))
+            srows = live_rows[order]
+            scells = a[srows]
+            starts = np.searchsorted(scells, np.arange(ivf.spec.nlist))
+            rank = np.arange(srows.size) - starts[scells]
+            keep = rank < cap  # post-balance this is all rows
+            cells[scells[keep], rank[keep]] = srows[keep]
+        ivf.assign[:n] = a
+        ivf.cells = cells
+        ivf.cell_cap = cap
+        ivf.cells_stale = False
+        ivf.stamp += 1
+
+    def _ivf_sync(self) -> None:
+        """Bring the canonical host index up to date with the mirror:
+        incrementally assign rows ingested since the last sync and repack
+        the cell lists.  Called under the bank lock from BOTH scoring
+        paths, so whichever path queries first does the maintenance and
+        the other reuses it.  (Training/retraining happens OFF the lock in
+        _maybe_train, which the scoring entry points call first.)"""
+        ivf = self._ivf
+        n = self.rows
+        if ivf.assign.shape[0] < n:
+            grown = np.full(max(n, 2 * max(1, ivf.assign.shape[0])), -1,
+                            np.int32)
+            grown[: ivf.assign.shape[0]] = ivf.assign
+            ivf.assign = grown
+        if ivf.centroids is not None and ivf.dirty_rows:
+            dirty = np.fromiter(
+                (r for r in ivf.dirty_rows if r < n), np.int64
+            )
+            ivf.dirty_rows.clear()
+            if dirty.size:
+                live = np.isfinite(self._host_bias[dirty])
+                cells = np.full(dirty.size, -1, np.int32)
+                if np.any(live):
+                    cells[live] = self._centroid_l2(self._host[dirty[live]])
+                ivf.assign[dirty] = cells
+                ivf.cells_stale = True
+        if ivf.centroids is not None and (ivf.cells_stale or ivf.cells is None):
+            self._rebuild_cells()
+
+    def _ensure_index_device(self):
+        """(device centroids (nlist, pwidth) f32, device cells) — uploaded
+        into the bank's RECORD arrays when the host index moved past the
+        uploaded stamp, so fenced rebalances move centroids + cells + bank
+        as one record and DROPINDEX releases all three."""
+        import jax
+
+        ivf = self._ivf
+        # record guard: a fenced rebalance moves these arrays under the
+        # record lock — the upload must not interleave with the move
+        with self._record_guard():
+            rec = self._rec()
+            if (
+                ivf.uploaded_stamp == ivf.stamp
+                and "centroids" in rec.arrays
+                and "cells" in rec.arrays
+            ):
+                return rec.arrays["centroids"], rec.arrays["cells"]
+            cent = ivf.centroids
+            if self.pwidth != self.width:
+                padded = np.zeros((cent.shape[0], self.pwidth), np.float32)
+                padded[:, : self.width] = cent
+                cent = padded
+            device = self._target_device()
+            dc = jax.device_put(np.ascontiguousarray(cent, np.float32),
+                                device)
+            dl = jax.device_put(np.ascontiguousarray(ivf.cells), device)
+            rec.arrays["centroids"] = dc
+            rec.arrays["cells"] = dl
+            rec.version += 1
+            ivf.uploaded_stamp = ivf.stamp
+            ivf.index_uploads += 1
+            return dc, dl
+
+    def index_device_bytes(self) -> int:
+        """Bytes the coarse index (centroids + cell table) holds on device —
+        the census row that catches cell-index leaks on DROPINDEX."""
+        try:
+            arrays = self._rec().arrays
+        except KeyError:
+            return 0
+        total = 0
+        for k in ("centroids", "cells"):
+            a = arrays.get(k)
+            if a is not None:
+                total += int(a.nbytes)
+        return total
+
+    def ivf_ready(self) -> bool:
+        return self._ivf is not None and self._ivf.centroids is not None
+
+    def _resolve_nprobe(self, nprobe: Optional[int]) -> int:
+        p = self.spec.nprobe if not nprobe else int(nprobe)
+        return max(1, min(p, self.spec.nlist))
+
+    def retrain(self) -> None:
+        """Force a coarse-quantizer retrain now (tests / admin)."""
+        if self._ivf is None:
+            return
+        self._train_now()
+        with self._lock:
+            if self._ivf.centroids is not None:
+                self._rebuild_cells()
 
     # -- scoring --------------------------------------------------------------
 
@@ -429,75 +931,141 @@ class EmbeddingBank(RecordRowBank):
             return nullcontext()
         return eng.lanes.lane(device).occupy(n_items)
 
+    def _pad_queries(self, q: np.ndarray, qb: int) -> np.ndarray:
+        """Stack to the query bucket AND the physical bank width (the
+        padding lanes are zeros, exact no-ops in every metric)."""
+        out = np.zeros((qb, self.pwidth), np.float32)
+        out[: q.shape[0], : self.width] = q
+        return out
+
     def knn_async(self, queries: np.ndarray, k: int,
-                  allowed_rows: Optional[np.ndarray] = None):
+                  allowed_rows: Optional[np.ndarray] = None,
+                  nprobe: Optional[int] = None):
         """Dispatch one stacked KNN: queries (Q, dim) float32 against every
-        live row.  Returns (device_dist, device_idx, q_count, k_eff) WITHOUT
-        forcing the readback — the server wraps it in a LazyReply so the
-        frame-grouped transfer drains it; embedded callers np.asarray().
+        live row (FLAT) or the routed top-nprobe cells (IVF).  Returns
+        (device_dist, device_idx, q_count, k_eff) WITHOUT forcing the
+        readback — the server wraps it in a LazyReply so the frame-grouped
+        transfer drains it; embedded callers np.asarray().
 
         ``allowed_rows`` (hybrid prefilter): int row ids that may score —
-        everything else gets +inf distance via a per-query bias operand.
+        everything else gets +inf distance via an additive bias operand.
 
         Falls back to the host path (knn_host) when the device plane is
         disarmed (RTPU_NO_VECTOR) — callers branch on vector_enabled()."""
-        import jax
-
         from redisson_tpu.core import kernels as K
 
         q = np.ascontiguousarray(queries, np.float32).reshape(-1, self.width)
         nq = q.shape[0]
+        self._maybe_train()  # off-lock; queries meanwhile score the old index
         with self._lock:
-            bank, bias, rows = self.device_planes()
+            bank, bias, scale, rows = self.device_planes()
             if bank is None or rows == 0:
                 return None
-            k_eff = max(1, min(int(k), self._cap))
+            if self._ivf is not None:
+                self._ivf_sync()
             qb = _query_bucket(nq)
-            qpad = q if qb == nq else np.concatenate(
-                [q, np.zeros((qb - nq, self.width), np.float32)]
-            )
-            staged = K.stage(qpad)
+            staged = K.stage(self._pad_queries(q, qb))
+            metric = self.spec.metric
+            if self.ivf_ready():
+                np_eff = self._resolve_nprobe(nprobe)
+                dc, dl = self._ensure_index_device()
+                cand = np_eff * self._ivf.cell_cap
+                k_eff = max(1, min(int(k), cand))
+                mask = None
+                if allowed_rows is not None:
+                    m = np.full(self._cap, np.inf, np.float32)
+                    m[np.asarray(allowed_rows, np.int64)] = 0.0
+                    mask = K.stage(m)
+                with self._lane_gate(nq * max(1, min(rows, cand))):
+                    nv = K.valid_n(rows)
+                    if scale is not None and mask is not None:
+                        dist, idx = K.knn_ivf_topk_masked_q(
+                            bank, scale, bias, mask, dc, dl, staged, nv,
+                            k_eff, np_eff, metric,
+                        )
+                    elif scale is not None:
+                        dist, idx = K.knn_ivf_topk_q(
+                            bank, scale, bias, dc, dl, staged, nv,
+                            k_eff, np_eff, metric,
+                        )
+                    elif mask is not None:
+                        dist, idx = K.knn_ivf_topk_masked(
+                            bank, bias, mask, dc, dl, staged, nv,
+                            k_eff, np_eff, metric,
+                        )
+                    else:
+                        dist, idx = K.knn_ivf_topk(
+                            bank, bias, dc, dl, staged, nv,
+                            k_eff, np_eff, metric,
+                        )
+                return dist, idx, nq, k_eff
+            if nprobe and self._ivf is None:
+                raise ValueError("NPROBE applies to an IVF field")
+            k_eff = max(1, min(int(k), self._cap))
             with self._lane_gate(nq * max(1, rows)):
+                nv = K.valid_n(rows)
                 if allowed_rows is None:
-                    dist, idx = K.knn_topk(
-                        bank, bias, staged, K.valid_n(rows), k_eff,
-                        self.spec.metric,
-                    )
+                    if scale is not None:
+                        dist, idx = K.knn_topk_q(
+                            bank, scale, bias, staged, nv, k_eff, metric
+                        )
+                    else:
+                        dist, idx = K.knn_topk(
+                            bank, bias, staged, nv, k_eff, metric
+                        )
                 else:
                     qbias = np.full((qb, self._cap), np.inf, np.float32)
                     qbias[:, np.asarray(allowed_rows, np.int64)] = 0.0
-                    dist, idx = K.knn_topk_masked(
-                        bank, bias, K.stage(qbias), staged,
-                        K.valid_n(rows), k_eff, self.spec.metric,
-                    )
+                    if scale is not None:
+                        dist, idx = K.knn_topk_masked_q(
+                            bank, scale, bias, K.stage(qbias), staged,
+                            nv, k_eff, metric,
+                        )
+                    else:
+                        dist, idx = K.knn_topk_masked(
+                            bank, bias, K.stage(qbias), staged,
+                            nv, k_eff, metric,
+                        )
         return dist, idx, nq, k_eff
 
-    def knn_host(self, queries: np.ndarray, k: int,
-                 allowed_rows: Optional[np.ndarray] = None):
-        """Pure-NumPy KNN (the RTPU_NO_VECTOR reference): same float32
-        formulas, same +inf bias discipline, same stable lowest-index
-        tie-break as the kernel — replies must be identical."""
-        q = np.ascontiguousarray(queries, np.float32).reshape(-1, self.width)
-        host, hbias = self.host_planes()
-        rows = host.shape[0]
-        if rows == 0:
-            return None
+    def _host_flat_dists(self, q: np.ndarray, host: np.ndarray) -> np.ndarray:
         dots = q @ host.T  # (Q, rows) f32
         metric = self.spec.metric
         if metric == "L2":
             q_sq = np.sum(q * q, axis=1, dtype=np.float32)
             b_sq = np.sum(host * host, axis=1, dtype=np.float32)
-            dist = q_sq[:, None] - 2.0 * dots + b_sq[None, :]
-        elif metric == "COSINE":
+            return q_sq[:, None] - 2.0 * dots + b_sq[None, :]
+        if metric == "COSINE":
             qn = np.sqrt(np.sum(q * q, axis=1, dtype=np.float32))
             bn = np.sqrt(np.sum(host * host, axis=1, dtype=np.float32))
             denom = qn[:, None] * bn[None, :]
             with np.errstate(invalid="ignore", divide="ignore"):
                 cos = np.where(denom > 0.0, dots / denom, 0.0)
-            dist = (1.0 - cos).astype(np.float32)
-        else:  # IP
-            dist = (1.0 - dots).astype(np.float32)
-        dist = dist + hbias[None, :]
+            return (1.0 - cos).astype(np.float32)
+        return (1.0 - dots).astype(np.float32)  # IP
+
+    def knn_host(self, queries: np.ndarray, k: int,
+                 allowed_rows: Optional[np.ndarray] = None,
+                 nprobe: Optional[int] = None):
+        """Pure-NumPy KNN (the RTPU_NO_VECTOR reference): same float32
+        formulas, same +inf bias discipline, same canonical IVF index and
+        the same stable tie-break as the kernels — replies must be
+        identical."""
+        q = np.ascontiguousarray(queries, np.float32).reshape(-1, self.width)
+        self._maybe_train()  # off-lock, same gate as the armed path
+        with self._lock:
+            host, hbias = self.host_planes()
+            rows = host.shape[0]
+            if rows == 0:
+                return None
+            if self._ivf is not None:
+                self._ivf_sync()
+            if self.ivf_ready():
+                return self._knn_host_ivf(q, k, allowed_rows, nprobe,
+                                          host, hbias)
+            if nprobe and self._ivf is None:
+                raise ValueError("NPROBE applies to an IVF field")
+        dist = self._host_flat_dists(q, host) + hbias[None, :]
         if allowed_rows is not None:
             mask = np.full(rows, np.inf, np.float32)
             mask[np.asarray(allowed_rows, np.int64)] = 0.0
@@ -506,6 +1074,76 @@ class EmbeddingBank(RecordRowBank):
         order = np.argsort(dist, axis=1, kind="stable")[:, :k_eff]
         top = np.take_along_axis(dist, order, axis=1)
         return top.astype(np.float32), order.astype(np.int32), q.shape[0], k_eff
+
+    def pair_scores(self, q: np.ndarray, qis: np.ndarray,
+                    rowids: np.ndarray) -> np.ndarray:
+        """THE canonical reply-score routine (byte-identity contract): both
+        scoring paths pick WHICH rows win (device kernel or NumPy), then
+        the wire score of every (query, row) hit is recomputed here — one
+        deterministic per-pair NumPy reduction over the dequantized mirror,
+        identical bits whichever path chose the ids.  (Device-vs-host GEMMs
+        disagree in the last ulp; at large score magnitudes that ulp
+        crosses the reply's 4-decimal rounding boundary.)"""
+        with self._lock:
+            rows = self._host[np.asarray(rowids, np.int64)]       # (M, d)
+        qs = np.ascontiguousarray(q, np.float32)[np.asarray(qis, np.int64)]
+        dots = np.einsum("md,md->m", rows, qs, dtype=np.float32)
+        metric = self.spec.metric
+        if metric == "L2":
+            q_sq = np.einsum("md,md->m", qs, qs, dtype=np.float32)
+            r_sq = np.einsum("md,md->m", rows, rows, dtype=np.float32)
+            return (q_sq - 2.0 * dots + r_sq).astype(np.float32)
+        if metric == "COSINE":
+            qn = np.sqrt(np.einsum("md,md->m", qs, qs, dtype=np.float32))
+            rn = np.sqrt(np.einsum("md,md->m", rows, rows, dtype=np.float32))
+            denom = qn * rn
+            with np.errstate(invalid="ignore", divide="ignore"):
+                cos = np.where(denom > 0.0, dots / denom, 0.0)
+            return (1.0 - cos).astype(np.float32)
+        return (1.0 - dots).astype(np.float32)  # IP
+
+    def _knn_host_ivf(self, q, k, allowed_rows, nprobe, host, hbias):
+        """NumPy mirror of kernels._knn_ivf_body over the SAME canonical
+        centroids/cells: identical routing, identical candidate order
+        (probe order then cell position), identical padding semantics."""
+        ivf = self._ivf
+        np_eff = self._resolve_nprobe(nprobe)
+        nq = q.shape[0]
+        rows = host.shape[0]
+        cent = ivf.centroids
+        metric = self.spec.metric
+        # routing = the FLAT distance formula against the centroid bank
+        cd = self._host_flat_dists(q, cent)
+        probe = np.argsort(cd, axis=1, kind="stable")[:, :np_eff]
+        cand = ivf.cells[probe].reshape(nq, -1)          # (Q, M)
+        valid = cand < rows
+        safe = np.where(valid, cand, 0)
+        rvec = host[safe]                                 # (Q, M, dim)
+        dots = np.einsum("qmw,qw->qm", rvec, q, dtype=np.float32)
+        if metric == "L2":
+            q_sq = np.sum(q * q, axis=1, dtype=np.float32)
+            r_sq = np.sum(rvec * rvec, axis=2, dtype=np.float32)
+            dist = q_sq[:, None] - 2.0 * dots + r_sq
+        elif metric == "COSINE":
+            qn = np.sqrt(np.sum(q * q, axis=1, dtype=np.float32))
+            rn = np.sqrt(np.sum(rvec * rvec, axis=2, dtype=np.float32))
+            denom = qn[:, None] * rn
+            with np.errstate(invalid="ignore", divide="ignore"):
+                dist = 1.0 - np.where(denom > 0.0, dots / denom, 0.0)
+        else:
+            dist = 1.0 - dots
+        dist = dist + hbias[safe]
+        if allowed_rows is not None:
+            mask = np.full(rows, np.inf, np.float32)
+            mask[np.asarray(allowed_rows, np.int64)] = 0.0
+            dist = dist + mask[safe]
+        dist = np.where(valid, dist, np.inf).astype(np.float32)
+        cand_n = np_eff * ivf.cell_cap
+        k_eff = max(1, min(int(k), cand_n))
+        order = np.argsort(dist, axis=1, kind="stable")[:, :k_eff]
+        top = np.take_along_axis(dist, order, axis=1)
+        idx = np.take_along_axis(cand, order, axis=1)
+        return top.astype(np.float32), idx.astype(np.int32), nq, k_eff
 
 
 class VectorPlane:
@@ -546,15 +1184,25 @@ class VectorPlane:
     def device_bytes(self) -> int:
         return sum(b.device_bytes() for b in self.banks.values())
 
+    def index_device_bytes(self) -> int:
+        return sum(b.index_device_bytes() for b in self.banks.values())
+
     def h2d_flushes(self) -> int:
         return sum(b.h2d_flushes for b in self.banks.values())
 
     def info_rows(self) -> List[Dict[str, Any]]:
         out = []
         for f, b in self.banks.items():
-            out.append({
+            row = {
                 "field": f, "dim": b.spec.dim, "metric": b.spec.metric,
                 "algo": b.spec.algo, "dtype": b.spec.dtype,
                 "rows": b.rows, "device_bytes": b.device_bytes(),
-            })
+            }
+            if b.spec.algo == "IVF":
+                row.update({
+                    "nlist": b.spec.nlist, "nprobe": b.spec.nprobe,
+                    "trained": b.ivf_ready(),
+                    "index_device_bytes": b.index_device_bytes(),
+                })
+            out.append(row)
         return out

@@ -581,3 +581,60 @@ def test_cluster_windows_reports_import_journal_rows(cluster2j):
                 assert c.execute("CLUSTER", "WINDOWS") == [], node.address
     finally:
         client.shutdown()
+
+
+def test_batched_drain_one_journal_fsync_per_batch(cluster2j):
+    """Batch-coalesced drains (ISSUE 14 satellite): a journaled migration
+    ships DRAIN_BATCH_RECORDS records per IMPORTRECORDS frame, so the
+    target journals (= fsyncs) once per BATCH, not once per record — and
+    the journal-before-ack contract still holds: every drained record is
+    inside some journaled frame."""
+    runner, jd = cluster2j
+    client = runner.client(scan_interval=0)
+    try:
+        tag = "{bdrain}"
+        n = 10
+        for i in range(n):
+            client.get_bucket(f"{tag}:r{i}").set(f"v{i}")
+        slot = calc_slot(tag.encode())
+        owner = next(
+            m for m in runner.masters
+            if m.server.server.engine.store.exists(f"{tag}:r0")
+        )
+        other = next(m for m in runner.masters if m is not owner)
+        for m in runner.masters:
+            m.server.server.DRAIN_BATCH_RECORDS = 4
+        migrate_slots(owner.address, other.address, [slot], journal_dir=jd)
+        # every record landed on the target (zero loss through the batches)
+        for i in range(n):
+            assert other.server.server.engine.store.exists(f"{tag}:r{i}")
+            assert not owner.server.server.engine.store.exists(f"{tag}:r{i}")
+        # the target's import journal holds ceil(10/4) = 3 batches: one
+        # fsync per FRAME, not per record
+        journals = [j for j in ImportJournal.scan(jd) if j.batch_count() > 0]
+        assert len(journals) == 1, [j.path for j in journals]
+        assert journals[0].batch_count() == 3, journals[0].batch_count()
+        client.refresh_topology()
+        assert client.get_bucket(f"{tag}:r7").get() == "v7"
+    finally:
+        client.shutdown()
+
+
+def test_batched_drain_reships_nothing_on_empty_followup_sweep(cluster2j):
+    """The drain loop's convergence contract survives batching: the second
+    MIGRATESLOTS sweep finds nothing and ships no frame."""
+    runner, jd = cluster2j
+    client = runner.client(scan_interval=0)
+    try:
+        client.get_bucket("{bd2}:x").set("v")
+        slot = calc_slot(b"{bd2}")
+        owner = next(
+            m for m in runner.masters
+            if m.server.server.engine.store.exists("{bd2}:x")
+        )
+        other = next(m for m in runner.masters if m is not owner)
+        migrate_slots(owner.address, other.address, [slot], journal_dir=jd)
+        journals = [j for j in ImportJournal.scan(jd) if j.batch_count() > 0]
+        assert len(journals) == 1 and journals[0].batch_count() == 1
+    finally:
+        client.shutdown()

@@ -210,7 +210,8 @@ def test_bank_record_placed_and_census(svc):
     assert svc.drop_index("vi")
     assert eng.store.get(V.bank_record_name("vi", "emb")) is None
     assert svc.device_census() == {
-        "ftvec_banks": 0.0, "ftvec_device_bytes": 0.0
+        "ftvec_banks": 0.0, "ftvec_device_bytes": 0.0,
+        "ftvec_index_bytes": 0.0,
     }
 
 
@@ -550,10 +551,10 @@ def test_perf_gate_config7_rows():
     pg = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(pg)
 
-    def doc(qps, recall):
+    def doc(qps, recall, **extra):
         return {"metric": "x", "value": 1000.0,
                 "details": {"config7_knn_qps": qps,
-                            "config7_recall_at_10": recall}}
+                            "config7_recall_at_10": recall, **extra}}
 
     # healthy run passes; first sight (no baseline rows) passes on qps
     rows, ok = pg.compare({"metric": "x", "value": 1000.0},
@@ -568,3 +569,460 @@ def test_perf_gate_config7_rows():
     rows, ok = pg.compare(doc(2000.0, 1.0), doc(1500.0, 1.0), 0.05)
     assert not ok
     assert any("knn qps" in r[0] and r[4] == "FAIL" for r in rows)
+
+
+def test_perf_gate_config7_ivf_int8_rows():
+    """ISSUE 14 gate rows: IVF qps relative-gated; IVF recall >= 0.97,
+    IVF speedup >= 2x and INT8 recall >= 0.95 floors + the INT8 bytes
+    ratio <= 0.35 ceiling all bind from FIRST sight."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "perf_gate.py"),
+    )
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    def doc(**d):
+        base = {"config7_ivf_knn_qps": 9000.0,
+                "config7_ivf_recall_at_10": 0.99,
+                "config7_ivf_speedup_vs_flat": 4.5,
+                "config7_int8_recall_at_10": 0.99,
+                "config7_int8_bytes_ratio": 0.27}
+        base.update(d)
+        return {"metric": "x", "value": 1000.0, "details": base}
+
+    empty = {"metric": "x", "value": 1000.0}
+    # first sight: healthy values pass every new row
+    rows, ok = pg.compare(empty, doc(), 0.05)
+    assert ok, rows
+    # each floor/ceiling binds from first sight
+    for bad, needle in [
+        (dict(config7_ivf_recall_at_10=0.95), "ivf recall"),
+        (dict(config7_ivf_speedup_vs_flat=1.5), "ivf speedup"),
+        (dict(config7_int8_recall_at_10=0.90), "int8 recall"),
+        (dict(config7_int8_bytes_ratio=0.50), "int8 bytes"),
+    ]:
+        rows, ok = pg.compare(empty, doc(**bad), 0.05)
+        assert not ok, bad
+        assert any(needle in r[0] and r[4] == "FAIL" for r in rows), (
+            bad, rows,
+        )
+    # IVF qps gates RELATIVE once a baseline exists
+    rows, ok = pg.compare(doc(), doc(config7_ivf_knn_qps=7000.0), 0.05)
+    assert not ok
+    assert any("ivf knn qps" in r[0] and r[4] == "FAIL" for r in rows)
+    rows, ok = pg.compare(doc(), doc(), 0.05)
+    assert ok, rows
+
+
+# -- IVF + compressed banks (ISSUE 14) ----------------------------------------
+
+
+def _clustered(n, dim, n_clusters, seed, spread=0.25):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_clusters, dim)).astype(np.float32)
+    vecs = (
+        centers[rng.integers(n_clusters, size=n)]
+        + spread * rng.standard_normal((n, dim))
+    ).astype(np.float32)
+    return vecs, rng
+
+
+def _ingest(svc, name, spec, vecs):
+    svc.create_index(name, {"emb": "VECTOR"}, vector={"emb": spec})
+    for i, v in enumerate(vecs):
+        svc.add_document(name, f"d{i}", {"emb": v})
+
+
+def _recall_vs_oracle(svc, name, vecs, queries, k, metric="L2", nprobe=None):
+    dev, fin = svc.knn(name, "emb", queries, k, nprobe=nprobe)
+    got = _force(dev, fin)
+    v64 = vecs.astype(np.float64)
+    q64 = queries.astype(np.float64)
+    if metric == "L2":
+        d64 = np.sum(
+            (v64[None, :, :] - q64[:, None, :]) ** 2, axis=2
+        )
+    else:
+        raise NotImplementedError(metric)
+    hits = total = 0
+    for qi in range(queries.shape[0]):
+        truth = set(np.argsort(d64[qi], kind="stable")[:k].tolist())
+        mine = {int(doc[1:]) for doc, _s in got[qi][:k]}
+        hits += len(truth & mine)
+        total += k
+    return hits / total
+
+
+def test_ivf_recall_clustered_vs_oracle(svc):
+    """IVF on clustered data (the serving shape): high recall at small
+    nprobe, monotone in nprobe, exact-FLAT parity at nprobe=nlist."""
+    vecs, rng = _clustered(1200, 16, 12, seed=9)
+    _ingest(svc, "ivc", {"dim": 16, "metric": "L2", "algo": "IVF",
+                         "nlist": 12, "nprobe": 3, "train_min": 256}, vecs)
+    queries = (vecs[rng.integers(1200, size=16)]
+               + 0.05 * rng.standard_normal((16, 16))).astype(np.float32)
+    r_small = _recall_vs_oracle(svc, "ivc", vecs, queries, 10)
+    assert r_small >= 0.9, r_small
+    r_more = _recall_vs_oracle(svc, "ivc", vecs, queries, 10, nprobe=6)
+    assert r_more >= r_small - 1e-9, (r_small, r_more)
+    # probing every cell recovers the exact result (spill-proof: every
+    # live row lives in exactly one probed cell)
+    r_all = _recall_vs_oracle(svc, "ivc", vecs, queries, 10, nprobe=12)
+    assert r_all == 1.0, r_all
+
+
+def test_ivf_recall_adversarial_uniform(svc):
+    """Uniform gaussian d=32 is the adversarial distribution for IVF:
+    recall at small nprobe degrades (documented, recall-gated) but stays
+    monotone in nprobe and exact at nprobe=nlist."""
+    rng = np.random.default_rng(17)
+    vecs = rng.standard_normal((1000, 32)).astype(np.float32)
+    _ingest(svc, "ivu", {"dim": 32, "metric": "L2", "algo": "IVF",
+                         "nlist": 10, "nprobe": 2, "train_min": 200}, vecs)
+    queries = rng.standard_normal((16, 32)).astype(np.float32)
+    r2 = _recall_vs_oracle(svc, "ivu", vecs, queries, 10, nprobe=2)
+    r5 = _recall_vs_oracle(svc, "ivu", vecs, queries, 10, nprobe=5)
+    r10 = _recall_vs_oracle(svc, "ivu", vecs, queries, 10, nprobe=10)
+    assert r2 <= r5 + 1e-9 <= r10 + 2e-9, (r2, r5, r10)
+    assert r10 == 1.0, r10
+    assert r2 < 1.0  # adversarial: small nprobe must actually cost recall
+
+
+@pytest.mark.parametrize("algo", ["FLAT", "IVF"])
+@pytest.mark.parametrize("dtype", ["FLOAT32", "FLOAT16", "INT8"])
+def test_armed_disarmed_identical_all_cells(svc, algo, dtype):
+    """Reply identity for EVERY algo x dtype cell (ISSUE 14 acceptance):
+    same ids, same scores — the canonical pair_scores routine plus the
+    shared host-canonical IVF index make the two paths byte-equal."""
+    vecs, rng = _clustered(700, 12, 8, seed=21)
+    spec = {"dim": 12, "metric": "L2", "algo": algo, "dtype": dtype}
+    if algo == "IVF":
+        spec.update(nlist=8, nprobe=3, train_min=128)
+    _ingest(svc, "cell", spec, vecs)
+    queries = (vecs[rng.integers(700, size=5)]
+               + 0.03 * rng.standard_normal((5, 12))).astype(np.float32)
+    armed = _force(*svc.knn("cell", "emb", queries, 7))
+    prev = V.set_vector(False)
+    try:
+        dev, fin = svc.knn("cell", "emb", queries, 7)
+        assert dev is None
+        disarmed = fin(None)
+    finally:
+        V.set_vector(prev)
+    assert armed == disarmed
+    svc.drop_index("cell")
+
+
+@pytest.mark.parametrize("dtype", ["FLOAT16", "INT8"])
+def test_quantized_bank_compression_and_updates(svc, dtype):
+    """Compressed banks: device bytes shrink vs the logical f32 size,
+    updates/deletes still land through the packed upload, and the mirror
+    serves the DEQUANTIZED values (oracle == device)."""
+    rng = np.random.default_rng(5)
+    vecs = rng.standard_normal((600, 32)).astype(np.float32)
+    _ingest(svc, "qb", {"dim": 32, "metric": "L2", "dtype": dtype}, vecs)
+    _force(*svc.knn("qb", "emb", vecs[0], 1))  # flush
+    bank = svc._idx("qb").vectors.banks["emb"]
+    ratio = bank.device_bytes() / bank.logical_f32_bytes()
+    assert ratio <= (0.6 if dtype == "FLOAT16" else 0.35), ratio
+    # update: d3 moves far away and must stop winning
+    target = vecs[3] + 0.001
+    top = _force(*svc.knn("qb", "emb", target, 1))[0]
+    assert top[0][0] == "d3"
+    svc.add_document("qb", "d3", {"emb": vecs[3] + 50.0})
+    top = _force(*svc.knn("qb", "emb", target, 1))[0]
+    assert top[0][0] != "d3"
+    # delete the new winner through the packed bias kill
+    winner = top[0][0]
+    svc.remove_document("qb", winner)
+    res = _force(*svc.knn("qb", "emb", target, 20))[0]
+    assert winner not in [d for d, _s in res]
+    # quantization error is bounded (int8 symmetric scale: ~1/127 of amax)
+    got = _force(*svc.knn("qb", "emb", vecs[7], 1))[0][0]
+    assert got[0] == "d7" and got[1] < 0.01
+    svc.drop_index("qb")
+
+
+def test_int8_quantization_is_symmetric_per_row(svc):
+    """Rows of very different magnitude each get their own scale: a large
+    row must not destroy a small row's resolution."""
+    svc.create_index("sc", {"emb": "VECTOR"},
+                     vector={"emb": {"dim": 4, "dtype": "INT8"}})
+    small = np.array([0.01, -0.02, 0.03, 0.015], np.float32)
+    big = np.array([500.0, -800.0, 100.0, 250.0], np.float32)
+    svc.add_document("sc", "small", {"emb": small})
+    svc.add_document("sc", "big", {"emb": big})
+    got = _force(*svc.knn("sc", "emb", small, 1))[0][0]
+    assert got[0] == "small" and got[1] < 1e-4, got
+    got = _force(*svc.knn("sc", "emb", big, 1))[0][0]
+    assert got[0] == "big", got
+
+
+def test_ivf_centroid_retrain_on_growth_drift(svc):
+    """The coarse quantizer retrains as the corpus grows past
+    RETRAIN_GROWTH x its training set, and recall holds on the GROWN
+    corpus (the drift contract)."""
+    vecs, rng = _clustered(1600, 12, 10, seed=31)
+    _ingest(svc, "dr", {"dim": 12, "metric": "L2", "algo": "IVF",
+                        "nlist": 10, "nprobe": 4, "train_min": 300},
+            vecs[:400])
+    _force(*svc.knn("dr", "emb", vecs[0], 1))
+    bank = svc._idx("dr").vectors.banks["emb"]
+    assert bank.ivf_ready() and bank._ivf.trains == 1
+    t0 = bank._ivf.trained_rows
+    for i in range(400, 1600):
+        svc.add_document("dr", f"d{i}", {"emb": vecs[i]})
+    queries = (vecs[rng.integers(400, 1600, size=12)]
+               + 0.05 * rng.standard_normal((12, 12))).astype(np.float32)
+    r = _recall_vs_oracle(svc, "dr", vecs, queries, 10)
+    assert bank._ivf.trains >= 2 and bank._ivf.trained_rows > t0
+    assert r >= 0.9, r
+    svc.drop_index("dr")
+
+
+def test_ivf_retrain_under_concurrent_ingest(svc):
+    """Writers keep ingesting (moving docs in embedding space) while
+    readers query through trains/retrains: no exceptions, and the final
+    index answers exactly like its own disarmed reference."""
+    vecs, rng = _clustered(900, 8, 6, seed=41)
+    _ingest(svc, "cc", {"dim": 8, "metric": "L2", "algo": "IVF",
+                        "nlist": 6, "nprobe": 3, "train_min": 200},
+            vecs[:250])
+    errs = []
+    stop = threading.Event()
+
+    def writer():
+        try:
+            for i in range(250, 900):
+                svc.add_document("cc", f"d{i}", {"emb": vecs[i]})
+                if stop.is_set():
+                    return
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                q = vecs[int(rng.integers(250))]
+                _force(*svc.knn("cc", "emb", q, 5))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    w = threading.Thread(target=writer)
+    rs = [threading.Thread(target=reader) for _ in range(2)]
+    w.start()
+    for t in rs:
+        t.start()
+    w.join(timeout=60)
+    stop.set()
+    for t in rs:
+        t.join(timeout=30)
+    assert not errs, errs
+    bank = svc._idx("cc").vectors.banks["emb"]
+    assert bank._ivf.trains >= 1
+    queries = vecs[rng.integers(900, size=8)].astype(np.float32)
+    armed = _force(*svc.knn("cc", "emb", queries, 6))
+    prev = V.set_vector(False)
+    try:
+        disarmed = svc.knn("cc", "emb", queries, 6)[1](None)
+    finally:
+        V.set_vector(prev)
+    assert armed == disarmed
+    svc.drop_index("cc")
+
+
+def test_ivf_index_lives_in_bank_record(svc):
+    """Centroids + cells are RECORD arrays next to the bank planes: they
+    move with the record through rebalances and die with DROPINDEX (the
+    census ftvec_index_bytes row)."""
+    vecs, _rng = _clustered(600, 8, 6, seed=51)
+    _ingest(svc, "rec", {"dim": 8, "metric": "L2", "algo": "IVF",
+                         "nlist": 6, "nprobe": 2, "train_min": 128}, vecs)
+    _force(*svc.knn("rec", "emb", vecs[0], 3))  # train + upload
+    eng = svc._engine
+    rec = eng.store.get(V.bank_record_name("rec", "emb"))
+    assert rec is not None
+    assert {"bank", "bias", "centroids", "cells"} <= set(rec.arrays)
+    census = svc.device_census()
+    assert census["ftvec_index_bytes"] > 0
+    assert svc.drop_index("rec")
+    census = svc.device_census()
+    assert census["ftvec_index_bytes"] == 0.0
+    assert census["ftvec_device_bytes"] == 0.0
+
+
+def test_ivf_hybrid_prefilter_masks(svc):
+    """Hybrid prefilter composes with IVF routing: only allowed rows may
+    appear, in every probed cell."""
+    vecs, rng = _clustered(800, 8, 6, seed=61)
+    svc.create_index("hy", {"price": "NUMERIC", "emb": "VECTOR"},
+                     vector={"emb": {"dim": 8, "metric": "L2",
+                                     "algo": "IVF", "nlist": 6,
+                                     "nprobe": 4, "train_min": 128}})
+    for i, v in enumerate(vecs):
+        svc.add_document("hy", f"d{i}", {"price": i, "emb": v})
+    q = vecs[5]
+    res = _force(*svc.knn("hy", "emb", q, 10,
+                          condition=Range("price", hi=99.5)))[0]
+    assert res and all(int(d[1:]) <= 99 for d, _s in res)
+    svc.drop_index("hy")
+
+
+# -- IVF wire surface (ISSUE 14) ----------------------------------------------
+
+
+def _wire_setup_ivf(c, n=400, dim=8, prefix="iw:", idx="ivwire", seed=71,
+                    dtype="FLOAT32", nlist=6, train_min=128):
+    r = c.execute(
+        "FT.CREATE", idx, "ON", "HASH", "PREFIX", "1", prefix,
+        "SCHEMA", "price", "NUMERIC",
+        "emb", "VECTOR", "IVF", "12", "TYPE", dtype,
+        "DIM", str(dim), "DISTANCE_METRIC", "L2",
+        "NLIST", str(nlist), "NPROBE", "3", "TRAIN_MIN", str(train_min),
+    )
+    assert r == b"OK", r
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((nlist, dim)).astype(np.float32)
+    vecs = (
+        centers[rng.integers(nlist, size=n)]
+        + 0.2 * rng.standard_normal((n, dim))
+    ).astype(np.float32)
+    for i in range(n):
+        c.execute("HSET", f"{prefix}{i}", "price", str(i),
+                  "emb", vecs[i].tobytes())
+    return vecs
+
+
+def test_wire_ivf_create_search_and_nprobe(server):
+    c = _conn(server)
+    vecs = _wire_setup_ivf(c)
+    q = (vecs[7] + 0.01).astype(np.float32)
+    out = c.execute("FT.SEARCH", "ivwire", "(*)=>[KNN 5 @emb $v]",
+                    "PARAMS", "2", "v", q.tobytes(), "NOCONTENT")
+    assert out[0] == 5 and bytes(out[1]) == b"iw:7"
+    # NPROBE dial: probing every cell == exact; result ids are a superset-
+    # quality check (same winner either way on this corpus)
+    full = c.execute("FT.SEARCH", "ivwire", "(*)=>[KNN 5 @emb $v]",
+                     "PARAMS", "2", "v", q.tobytes(), "NOCONTENT",
+                     "NPROBE", "6")
+    assert full[0] == 5 and bytes(full[1]) == b"iw:7"
+    r = c.execute("FT.SEARCH", "ivwire", "(*)=>[KNN 5 @emb $v]",
+                  "PARAMS", "2", "v", q.tobytes(), "NPROBE", "0")
+    assert isinstance(r, RespError)
+    c.close()
+
+
+def test_wire_ivf_armed_disarmed_identical(server):
+    c = _conn(server)
+    vecs = _wire_setup_ivf(c, idx="ivab", prefix="iva:", seed=83,
+                           dtype="INT8")
+    q = (vecs[11] + 0.02).astype(np.float32)
+    args = ("FT.SEARCH", "ivab", "(*)=>[KNN 6 @emb $v]",
+            "PARAMS", "2", "v", q.tobytes())
+    armed = c.execute(*args)
+    prev = V.set_vector(False)
+    try:
+        disarmed = c.execute(*args)
+    finally:
+        V.set_vector(prev)
+    assert armed == disarmed  # byte-identical wire reply, device path off
+    c.close()
+
+
+def test_wire_nprobe_on_flat_errors(server):
+    """NPROBE on a FLAT field is rejected BEFORE either scoring path
+    dispatches — the armed and disarmed replies carry the SAME clean
+    error (never 'ERR internal')."""
+    c = _conn(server)
+    _wire_setup(c, idx="npf", prefix="npf:")
+    q = np.ones(8, np.float32).tobytes()
+    args = ("FT.SEARCH", "npf", "(*)=>[KNN 3 @emb $v]",
+            "PARAMS", "2", "v", q, "NPROBE", "2")
+    armed = c.execute(*args)
+    assert isinstance(armed, RespError) and "IVF" in str(armed)
+    assert "internal" not in str(armed)
+    prev = V.set_vector(False)
+    try:
+        disarmed = c.execute(*args)
+    finally:
+        V.set_vector(prev)
+    assert isinstance(disarmed, RespError) and str(disarmed) == str(armed)
+    c.close()
+
+
+def test_wire_ivf_ft_info_and_index_gauges(server):
+    c = _conn(server)
+    _wire_setup_ivf(c, idx="ivinfo", prefix="ivi:")
+    q = np.ones(8, np.float32).tobytes()
+    c.execute("FT.SEARCH", "ivinfo", "(*)=>[KNN 2 @emb $v]",
+              "PARAMS", "2", "v", q)
+    info = c.execute("FT.INFO", "ivinfo")
+    d = {bytes(info[i]): info[i + 1] for i in range(0, len(info), 2)}
+    attr = [row for row in d[b"attributes"] if bytes(row[0]) == b"emb"][0]
+    a = {bytes(attr[i]): attr[i + 1] for i in range(1, len(attr), 2)}
+    assert a[b"algorithm"] == b"IVF" and a[b"nlist"] == 6
+    assert a[b"nprobe"] == 3 and a[b"trained"] == 1
+    assert a[b"index_device_bytes"] > 0
+    assert d[b"vector_index_bytes"] > 0
+    mets = server.server.metrics.snapshot()
+    assert mets["ftvec_index_bytes"] > 0
+    assert c.execute("FT.DROPINDEX", "ivinfo") == b"OK"
+    mets = server.server.metrics.snapshot()
+    assert mets["ftvec_index_bytes"] == 0.0
+    c.close()
+
+
+def test_wire_create_rejects_bad_ivf_attrs(server):
+    c = _conn(server)
+    r = c.execute(
+        "FT.CREATE", "badivf", "ON", "HASH", "SCHEMA",
+        "emb", "VECTOR", "IVF", "6", "TYPE", "FLOAT32",
+        "DIM", "8", "DISTANCE_METRIC", "L2",
+    )
+    assert isinstance(r, RespError)  # IVF without NLIST
+    r = c.execute(
+        "FT.CREATE", "badnl", "ON", "HASH", "SCHEMA",
+        "emb", "VECTOR", "FLAT", "8", "TYPE", "FLOAT32",
+        "DIM", "8", "DISTANCE_METRIC", "L2", "NLIST", "4",
+    )
+    assert isinstance(r, RespError)  # NLIST on FLAT
+    r = c.execute(
+        "FT.CREATE", "badtm", "ON", "HASH", "SCHEMA",
+        "emb", "VECTOR", "FLAT", "8", "TYPE", "FLOAT32",
+        "DIM", "8", "DISTANCE_METRIC", "L2", "TRAIN_MIN", "100",
+    )
+    assert isinstance(r, RespError)  # TRAIN_MIN on FLAT
+    r = c.execute(
+        "FT.CREATE", "badty", "ON", "HASH", "SCHEMA",
+        "emb", "VECTOR", "FLAT", "6", "TYPE", "INT4",
+        "DIM", "8", "DISTANCE_METRIC", "L2",
+    )
+    assert isinstance(r, RespError)  # unsupported TYPE
+    c.close()
+
+
+def test_wire_float16_hset_roundtrip(server):
+    """FLOAT16 banks on the wire: HSET f32 blobs in, replies carry the
+    stored doc's ORIGINAL blob while scores come off the f16 bank."""
+    c = _conn(server)
+    r = c.execute(
+        "FT.CREATE", "f16", "ON", "HASH", "PREFIX", "1", "f16:",
+        "SCHEMA", "emb", "VECTOR", "FLAT", "6", "TYPE", "FLOAT16",
+        "DIM", "8", "DISTANCE_METRIC", "L2",
+    )
+    assert r == b"OK", r
+    rng = np.random.default_rng(5)
+    vecs = rng.standard_normal((20, 8)).astype(np.float32)
+    for i in range(20):
+        c.execute("HSET", f"f16:{i}", "emb", vecs[i].tobytes())
+    out = c.execute("FT.SEARCH", "f16", "(*)=>[KNN 1 @emb $v]",
+                    "PARAMS", "2", "v", vecs[4].tobytes())
+    assert out[0] == 1 and bytes(out[1]) == b"f16:4"
+    flat = out[2]
+    kv = {bytes(flat[i]): flat[i + 1] for i in range(0, len(flat), 2)}
+    assert bytes(kv[b"emb"]) == vecs[4].tobytes()  # original f32 blob
+    c.close()

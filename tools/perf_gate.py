@@ -83,6 +83,11 @@ METRICS = [
     # (n/a-pass on first sight, like every new config); the recall QUALITY
     # axis binds as an absolute floor below, not a relative row.
     ("config7 knn qps", ("details", "config7_knn_qps"), True, True),
+    # config7 IVF leg (ISSUE 14): sub-linear cell-scored KNN at N=50k/d=128
+    # — qps gated relative like the FLAT leg; its recall and its
+    # speedup-vs-FLAT bind as absolute floors below, and the INT8 bank's
+    # compression ratio as a ceiling (quality axes never gate relatively).
+    ("config7 ivf knn qps", ("details", "config7_ivf_knn_qps"), True, True),
     # observability (ISSUE 12): armed-vs-disarmed tracing throughput ratio
     # from tools/obs_overhead_bench.py — advisory relative row (n/a-pass
     # first sight); the binding bound is the ABSOLUTE floor below (armed
@@ -103,6 +108,15 @@ FLOORS = [
     # sight (a recall drop means the kernel, not the workload, changed)
     ("config7 recall@10 >= 0.99",
      ("details", "config7_recall_at_10"), 0.99),
+    # ISSUE 14: the sub-linear/compressed legs are only admissible while
+    # their recall holds — floors bind from first sight so the speedup can
+    # never be bought by silently giving up result quality
+    ("config7 ivf recall@10 >= 0.97",
+     ("details", "config7_ivf_recall_at_10"), 0.97),
+    ("config7 ivf speedup vs FLAT >= 2x",
+     ("details", "config7_ivf_speedup_vs_flat"), 2.0),
+    ("config7 int8 recall@10 >= 0.95",
+     ("details", "config7_int8_recall_at_10"), 0.95),
     # armed tracing overhead (ISSUE 12): obs_overhead_bench.py's
     # armed/disarmed ops ratio — binds from first sight, n/a while absent
     ("obs armed tracing ratio >= 0.97",
@@ -114,6 +128,10 @@ FLOORS = [
 CEILINGS = [
     ("config2q fairness p99 ratio <= 2x",
      ("details", "config2q_fairness_p99_ratio"), 2.0),
+    # ISSUE 14: an INT8 bank must actually be compressed — quantized
+    # device bytes at most 0.35x what f32 storage of the same rows costs
+    ("config7 int8 bytes ratio <= 0.35x",
+     ("details", "config7_int8_bytes_ratio"), 0.35),
 ]
 
 
@@ -224,12 +242,14 @@ def render(rows, threshold: float) -> str:
         f"gate: >{threshold:.0%} regression in headline, config5, config5p, "
         "config5d (ops/s AND 1-vs-N speedup), config2 flush p99, config4 "
         "cold, config6 reduction, config2q interactive p99, config2q "
-        "fairness, or config7 knn qps fails; other drops are advisory "
-        "(WARN); a metric absent from the baseline reads n/a and passes "
-        "(recorded on first sight).  Absolute floors (config6 reduction >= "
-        "10x, config2q speedup vs no-qos >= 1.2x, config7 recall@10 >= "
-        "0.99, armed tracing ratio >= 0.97) and ceilings (config2q "
-        "fairness <= 2x) bind from first sight."
+        "fairness, config7 knn qps, or config7 ivf qps fails; other drops "
+        "are advisory (WARN); a metric absent from the baseline reads n/a "
+        "and passes (recorded on first sight).  Absolute floors (config6 "
+        "reduction >= 10x, config2q speedup vs no-qos >= 1.2x, config7 "
+        "recall@10 >= 0.99, ivf recall >= 0.97 + ivf speedup >= 2x, int8 "
+        "recall >= 0.95, armed tracing ratio >= 0.97) and ceilings "
+        "(config2q fairness <= 2x, int8 bytes ratio <= 0.35x) bind from "
+        "first sight."
     )
     return "\n".join(out)
 
