@@ -1400,6 +1400,189 @@ def _bench_config7_ivf(svc, rng):
     }
 
 
+def bench_config7s_sharded():
+    """Config 7S: MESH-SHARDED device KNN (ISSUE 15) — one FT index's
+    embedding bank split row-wise across the local mesh (``SHARDS n``),
+    queries fanning per-shard matmul-top-k legs across the per-device
+    lanes and merging ON DEVICE (kernels.knn_sharded_merge), as a
+    1-shard vs n-shard A/B over the SAME corpus and query stream.
+
+    On chip-less containers every forced host "device" is the same CPU, so
+    the CPU-replica occupancy model (the config5d convention,
+    ``ioplane.set_replica_occupancy``; RTPU_REPLICA_NS_VEC ns/item,
+    auto-disarmed on a real TPU) charges each lane the per-chip scoring
+    time n real chips would overlap: the 1-shard leg serializes N rows of
+    occupancy through one lane, the n-shard leg overlaps N/n per lane —
+    the delta isolates the row-parallel win.
+
+      * ``config7_sharded_knn_qps``   — n-shard stacked-batch queries/s
+        (gated relative, n/a-pass first sight)
+      * ``config7_sharded_speedup_vs_1shard`` — absolute floor >= 1.5x
+        under the occupancy model
+      * ``config7_sharded_recall_at_10``  — FLAT sharding is exact: >= 0.99
+        vs the f64 oracle, binding from first sight
+      * ``capacity_demo`` — with a per-bank device-bytes budget armed
+        (``ftvec-device-budget``) the corpus REFUSES to fit one device
+        (VectorBudgetError) and serves only sharded — the first enforced
+        brick of the ROADMAP HBM-capacity ledger."""
+    import os
+
+    import jax
+
+    from redisson_tpu.core import ioplane
+    from redisson_tpu.core.engine import Engine
+    from redisson_tpu.services import vector as V
+    from redisson_tpu.services.search import SearchService
+
+    assert V.vector_enabled(), "config7s measures the ARMED device path"
+    devices = jax.local_devices()
+    n_dev = len(devices)
+    platform = devices[0].platform
+    replica_ns = (
+        float(os.environ.get("RTPU_REPLICA_NS_VEC", "20"))
+        if platform == "cpu" else None
+    )
+    N, d, k = 40_000, 64, 10
+    Q_BATCH, N_ORACLE, MEASURE_S = 64, 64, 1.5
+    rng = np.random.default_rng(73)
+    vecs = rng.standard_normal((N, d)).astype(np.float32)
+    queries = rng.standard_normal((Q_BATCH, d)).astype(np.float32)
+    oracle_q = rng.standard_normal((N_ORACLE, d)).astype(np.float32)
+    q64, v64 = oracle_q.astype(np.float64), vecs.astype(np.float64)
+    dots = q64 @ v64.T
+    denom = (
+        np.linalg.norm(q64, axis=1)[:, None]
+        * np.linalg.norm(v64, axis=1)[None, :]
+    )
+    dist64 = 1.0 - np.where(denom > 0, dots / denom, 0.0)
+    truth = [
+        set(np.argsort(dist64[i], kind="stable")[:k].tolist())
+        for i in range(N_ORACLE)
+    ]
+    engine = Engine()
+    engine.enable_placement()
+    svc = SearchService(engine)
+
+    def leg(name, shards):
+        svc.create_index(
+            name, {"emb": "VECTOR"},
+            vector={"emb": {"dim": d, "metric": "COSINE",
+                            "shards": shards}},
+        )
+        t0 = time.perf_counter()
+        for i in range(N):
+            svc.add_document(name, f"d{i}", {"emb": vecs[i]})
+        ingest_s = time.perf_counter() - t0
+        # warm (flush + compile per-shard programs + merge) OUTSIDE the
+        # timed window AND outside the occupancy model
+        dev, fin = svc.knn(name, "emb", queries, k)
+        fin(tuple(np.asarray(v) for v in dev))
+        prev_ns = ioplane.set_replica_occupancy(replica_ns)
+        try:
+            done, t0 = 0, time.perf_counter()
+            while time.perf_counter() - t0 < MEASURE_S:
+                dev, fin = svc.knn(name, "emb", queries, k)
+                fin(tuple(np.asarray(v) for v in dev))
+                done += Q_BATCH
+            qps = done / (time.perf_counter() - t0)
+        finally:
+            ioplane.set_replica_occupancy(prev_ns)
+        dev, fin = svc.knn(name, "emb", oracle_q, k)
+        got = fin(tuple(np.asarray(v) for v in dev))
+        hits = sum(
+            len(truth[i] & {int(doc[1:]) for doc, _s in got[i][:k]})
+            for i in range(N_ORACLE)
+        )
+        bank = svc._idx(name).vectors.banks["emb"]
+        row = {
+            "shards": shards,
+            "knn_qps": round(qps),
+            "recall_at_10": round(hits / (k * N_ORACLE), 4),
+            "ingest_docs_per_sec": round(N / ingest_s),
+            "bank_device_bytes": bank.device_bytes(),
+            "bytes_by_device": {
+                str(dd): b for dd, b in
+                sorted(bank.device_bytes_by_device().items())
+            },
+        }
+        svc.drop_index(name)
+        return row
+
+    io_before = ioplane.STATS.snapshot()
+    one = leg("v7s_1", 1)
+    many = leg("v7s_n", n_dev)
+    io_after = ioplane.STATS.snapshot()
+    assert io_after["host_colocations"] == io_before["host_colocations"], (
+        "sharded merge fell back to a host gather"
+    )
+    speedup = many["knn_qps"] / max(1, one["knn_qps"])
+
+    # -- capacity demo: the per-bank device-bytes budget (HBM-ledger brick) --
+    # budget sized so ONE device cannot hold the full corpus's bank but
+    # every 1/n_dev shard fits comfortably
+    demo_n = 20_000
+    full_cap = 1 << (demo_n - 1).bit_length()
+    budget = V.DeviceRowBank(d)._projected_device_bytes(full_cap) // 2
+    prev_budget = V.set_device_bytes_budget(budget)
+    unsharded_served = sharded_served = False
+    try:
+        svc.create_index(
+            "v7s_cap1", {"emb": "VECTOR"},
+            vector={"emb": {"dim": d, "metric": "COSINE"}},
+        )
+        try:
+            for i in range(demo_n):
+                svc.add_document("v7s_cap1", f"d{i}", {"emb": vecs[i]})
+            dev, fin = svc.knn("v7s_cap1", "emb", queries[:1], k)
+            fin(tuple(np.asarray(v) for v in dev))
+            unsharded_served = True
+        except V.VectorBudgetError as e:
+            log(f"config7s capacity: unsharded refused as designed — {e}")
+        svc.drop_index("v7s_cap1")
+        svc.create_index(
+            "v7s_capn", {"emb": "VECTOR"},
+            vector={"emb": {"dim": d, "metric": "COSINE",
+                            "shards": n_dev}},
+        )
+        for i in range(demo_n):
+            svc.add_document("v7s_capn", f"d{i}", {"emb": vecs[i]})
+        dev, fin = svc.knn("v7s_capn", "emb", queries[:1], k)
+        got = fin(tuple(np.asarray(v) for v in dev))
+        sharded_served = bool(got[0])
+        svc.drop_index("v7s_capn")
+    finally:
+        V.set_device_bytes_budget(prev_budget)
+    assert not unsharded_served, (
+        "capacity demo: the unsharded bank fit under a budget sized to "
+        "exclude it — the ledger is not binding"
+    )
+    assert sharded_served, "capacity demo: sharded corpus failed to serve"
+
+    log(
+        f"config7s: {n_dev}-shard {many['knn_qps']/1e3:.1f}k vs 1-shard "
+        f"{one['knn_qps']/1e3:.1f}k knn qps = {speedup:.2f}x (platform "
+        f"{platform}, occupancy "
+        f"{'%.0fns/item' % replica_ns if replica_ns else 'disarmed'}), "
+        f"recall@10 {many['recall_at_10']:.4f}, capacity demo: unsharded "
+        f"refused / sharded served under a {budget}B per-device budget"
+    )
+    return {
+        "config7_sharded_knn_qps": many["knn_qps"],
+        "config7_sharded_speedup_vs_1shard": round(speedup, 3),
+        "config7_sharded_recall_at_10": many["recall_at_10"],
+        "n_shards": n_dev,
+        "platform": platform,
+        "replica_occupancy_ns_per_item": replica_ns,
+        "legs": {"1shard": one, f"{n_dev}shard": many},
+        "capacity_demo": {
+            "budget_bytes": budget,
+            "corpus_rows": demo_n,
+            "unsharded_served": unsharded_served,
+            "sharded_served": sharded_served,
+        },
+    }
+
+
 def _init_jax():
     """Per-process JAX setup: persistent compile cache (the big kernels cost
     ~10s of XLA compile each; cached programs make re-runs near-instant)."""
@@ -1476,12 +1659,12 @@ def child(which: str) -> None:
         result = bench_config5p_cluster_proc()
         print("@@RESULT " + json.dumps(result), flush=True)
         return
-    if which == "5d":
-        # device-sharded serving: make sure a chip-less container still has
-        # a mesh to shard over (8 forced host devices — the same harness
-        # line tests/conftest.py and tools/soak_smoke.py use).  Set BEFORE
-        # the first jax import; on a TPU host the flag only affects the
-        # unused CPU backend.
+    if which in ("5d", "7s"):
+        # device-sharded serving / mesh-sharded KNN: make sure a chip-less
+        # container still has a mesh to shard over (8 forced host devices —
+        # the same harness line tests/conftest.py and tools/soak_smoke.py
+        # use).  Set BEFORE the first jax import; on a TPU host the flag
+        # only affects the unused CPU backend.
         import os
 
         flags = os.environ.get("XLA_FLAGS", "")
@@ -1510,6 +1693,8 @@ def child(which: str) -> None:
         result["qos"] = bench_config2q_qos()
     elif which == "7":
         result["vector"] = bench_config7_vector()
+    elif which == "7s":
+        result["sharded"] = bench_config7s_sharded()
     else:
         client = redisson_tpu.create()
         try:
@@ -1548,7 +1733,8 @@ def main():
     import subprocess
 
     results: dict = {}
-    for which in ("2", "2L", "2A", "2q", "1", "3", "4", "5", "5p", "5d", "6", "7"):
+    for which in ("2", "2L", "2A", "2q", "1", "3", "4", "5", "5p", "5d", "6",
+                  "7", "7s"):
         p = subprocess.run(
             [sys.executable, __file__, "--config", which],
             stdout=subprocess.PIPE,
@@ -1604,6 +1790,13 @@ def main():
                     "config7_int8_recall_at_10": results["7"]["vector"]["config7_int8_recall_at_10"],
                     "config7_int8_bytes_ratio": results["7"]["vector"]["config7_int8_bytes_ratio"],
                     "config7_vector": results["7"]["vector"],
+                    # config7s (ISSUE 15): the mesh-sharded KNN legs —
+                    # row-parallel shards + on-device merge, 1-vs-n A/B
+                    # under the config5d occupancy convention
+                    "config7_sharded_knn_qps": results["7s"]["sharded"]["config7_sharded_knn_qps"],
+                    "config7_sharded_speedup_vs_1shard": results["7s"]["sharded"]["config7_sharded_speedup_vs_1shard"],
+                    "config7_sharded_recall_at_10": results["7s"]["sharded"]["config7_sharded_recall_at_10"],
+                    "config7_sharded": results["7s"]["sharded"],
                     "baseline_model": "k=7 GETBITs @ 1M pipelined ops/s/core = 143k contains/s",
                     "tunnel_h2d_mb_per_sec": {
                         w: r["h2d_mb_s"] for w, r in results.items() if "h2d_mb_s" in r

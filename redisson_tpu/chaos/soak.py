@@ -2807,6 +2807,12 @@ class VectorSoakConfig:
     nlist: int = 6
     nprobe: int = 6
     train_min: int = 24
+    # ISSUE 15: shards > 1 soaks the MESH-SHARDED bank — per-shard records
+    # under shard-salted hashtags rebalance independently, reads run the
+    # fan-out + on-device merge path (sharded_knn_merges must move, and
+    # host_colocations must NOT — the never-a-host-gather contract under
+    # fire), and the per-device census rows must all die on DROPINDEX.
+    shards: int = 1
 
 
 @dataclass
@@ -2895,17 +2901,22 @@ class VectorSoakHarness:
         self._journal_dir = tempfile.mkdtemp(prefix="rtpu-vecsoak-")
         self._server = ServerThread(port=0, devices="all", workers=8).start()
         admin = self._connect()
+        shard_tail = (
+            ("SHARDS", str(cfg.shards)) if cfg.shards > 1 else ()
+        )
         if cfg.algo == "IVF":
             vec_tail = (
-                "emb", "VECTOR", "IVF", "12", "TYPE", "FLOAT32",
+                "emb", "VECTOR", "IVF", str(12 + len(shard_tail)),
+                "TYPE", "FLOAT32",
                 "DIM", str(cfg.dim), "DISTANCE_METRIC", "L2",
                 "NLIST", str(cfg.nlist), "NPROBE", str(cfg.nprobe),
-                "TRAIN_MIN", str(cfg.train_min),
+                "TRAIN_MIN", str(cfg.train_min), *shard_tail,
             )
         else:
             vec_tail = (
-                "emb", "VECTOR", "FLAT", "6", "TYPE", "FLOAT32",
-                "DIM", str(cfg.dim), "DISTANCE_METRIC", "L2",
+                "emb", "VECTOR", "FLAT", str(6 + len(shard_tail)),
+                "TYPE", "FLOAT32",
+                "DIM", str(cfg.dim), "DISTANCE_METRIC", "L2", *shard_tail,
             )
         r = admin.execute(
             "FT.CREATE", self.INDEX, "ON", "HASH", "PREFIX", "1", self.PREFIX,
@@ -2992,16 +3003,18 @@ class VectorSoakHarness:
                 final_caches: List[Dict[int, tuple]]) -> None:
         cfg = self.config
         rng = np.random.default_rng(cfg.seed * 97 + rid)
-        state = {"conn": None, "cache": {}}
+        state = {"conn": None, "cache": {}, "epoch": 0}
 
         def on_push(push) -> None:
             try:
                 if bytes(push[0]) == b"invalidate":
                     state["cache"].clear()
+                    state["epoch"] += 1
                     with self._acked_lock:
                         self.report.invalidations += 1
             except Exception:  # noqa: BLE001
                 state["cache"].clear()
+                state["epoch"] += 1
 
         while not stop.is_set():
             try:
@@ -3019,8 +3032,16 @@ class VectorSoakHarness:
                         self.report.reads += 1
                         self.report.cache_hits += 1
                 else:
+                    # the NearCache in-flight discipline (tracking/
+                    # nearcache.py): an invalidation that lands WHILE this
+                    # read is on the wire may cover a write the result
+                    # predates — and the push also consumed the one-shot
+                    # registration, so no later push would ever clear the
+                    # entry.  Cache only epoch-stable results.
+                    epoch0 = state["epoch"]
                     res = self._knn(state["conn"], qi)
-                    state["cache"][qi] = res
+                    if state["epoch"] == epoch0:
+                        state["cache"][qi] = res
                     with self._acked_lock:
                         self.report.reads += 1
             except Exception:  # noqa: BLE001 — budgeted fault-window error
@@ -3055,22 +3076,33 @@ class VectorSoakHarness:
         """ISSUE 14: the IVF coarse index (centroids + cell table) lives in
         the SAME record as the bank — after a fenced rebalance all of its
         device arrays must sit on ONE device (nothing straggles on the old
-        owner)."""
+        owner).  ISSUE 15: a sharded bank is a CONSTELLATION — the manifest
+        record lists the shard records, and the invariant holds PER SHARD
+        (each shard's bank + coarse index move as one record; different
+        shards legitimately sit on different devices)."""
         from redisson_tpu.core.ioplane import device_of
         from redisson_tpu.services.vector import bank_record_name
 
-        rec = self._server.server.engine.store.get(
-            bank_record_name(self.INDEX, "emb")
-        )
+        store = self._server.server.engine.store
+        rec = store.get(bank_record_name(self.INDEX, "emb"))
         if rec is None:
             return
-        devices = {
-            str(device_of(a)) for a in rec.arrays.values() if a is not None
-        }
-        devices.discard("None")
-        assert len(devices) <= 1, (
-            f"bank/centroids/cells split across devices: {devices}"
-        )
+        names = rec.meta.get("shard_names") or [
+            bank_record_name(self.INDEX, "emb")
+        ]
+        for nm in names:
+            srec = store.get(nm)
+            if srec is None:
+                continue
+            devices = {
+                str(device_of(a))
+                for a in srec.arrays.values() if a is not None
+            }
+            devices.discard("None")
+            assert len(devices) <= 1, (
+                f"{nm}: bank/centroids/cells split across devices: "
+                f"{devices}"
+            )
 
     # -- run -------------------------------------------------------------------
 
@@ -3083,8 +3115,11 @@ class VectorSoakHarness:
         census = ResourceCensus()
         census.track_server("srv", self._server.server)
         try:
+            from redisson_tpu.core import ioplane
+
             engine = self._server.server.engine
             baseline = census.snapshot()
+            io_base = ioplane.STATS.snapshot()
             self._reader_conns: List[Optional[object]] = [None] * cfg.reader_threads
             final_caches: List[Dict[int, tuple]] = [{} for _ in range(cfg.reader_threads)]
             for cycle in range(cfg.cycles):
@@ -3196,6 +3231,14 @@ class VectorSoakHarness:
             assert after["srv.ftvec_device_bytes"] == 0.0, after
             # the IVF cell index must die with the bank (leak row, ISSUE 14)
             assert after["srv.ftvec_index_bytes"] == 0.0, after
+            # per-device ledger rows (ISSUE 15): every shard's row is gone
+            # or zero once the constellation tore down
+            leaked = {
+                k: v for k, v in after.items()
+                if k.startswith("srv.ftvec_") and "bytes_dev" in k
+                and v != 0.0
+            }
+            assert not leaked, leaked
             census.assert_flat(
                 baseline, after,
                 # ftvec rows are asserted EXACTLY zero above (the baseline
@@ -3208,6 +3251,18 @@ class VectorSoakHarness:
             )
             lanes = engine.lanes.census()
             assert lanes["active_dispatches"] == 0, lanes
+            # the never-a-host-gather contract (ISSUE 15): every cross-
+            # shard KNN merge of the storm rode d2d colocation, not a host
+            # round trip — and with shards > 1, the merge path actually ran
+            io_snap = ioplane.STATS.snapshot()
+            assert (
+                io_snap["host_colocations"] == io_base["host_colocations"]
+            ), (io_base, io_snap)
+            if cfg.shards > 1:
+                assert (
+                    io_snap["sharded_knn_merges"]
+                    > io_base["sharded_knn_merges"]
+                ), (io_base, io_snap)
             budget = max(10, (self.report.writes_acked + self.report.reads) // 2)
             assert self.report.errors <= budget, (
                 f"error budget blown: {self.report.errors} vs {budget}"

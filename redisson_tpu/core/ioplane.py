@@ -125,7 +125,7 @@ class IOStats:
 
     __slots__ = ("_lock", "blocking_syncs", "readbacks", "readback_wait_s",
                  "readback_exposed_s", "staging_waits", "barrier_wait_s",
-                 "d2d_colocations", "host_colocations")
+                 "d2d_colocations", "host_colocations", "sharded_knn_merges")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -140,6 +140,7 @@ class IOStats:
         self.barrier_wait_s = 0.0
         self.d2d_colocations = 0
         self.host_colocations = 0
+        self.sharded_knn_merges = 0
 
     def count_sync(self, n: int = 1) -> None:
         with self._lock:
@@ -170,6 +171,13 @@ class IOStats:
             else:
                 self.d2d_colocations += 1
 
+    def count_sharded_merge(self) -> None:
+        """One on-device sharded-KNN top-k merge ran (ISSUE 15) — paired
+        with host_colocations == 0 this proves the cross-shard reduce
+        stayed on the interconnect (the vector soak asserts both)."""
+        with self._lock:
+            self.sharded_knn_merges += 1
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -181,6 +189,7 @@ class IOStats:
                 "barrier_wait_s": self.barrier_wait_s,
                 "d2d_colocations": self.d2d_colocations,
                 "host_colocations": self.host_colocations,
+                "sharded_knn_merges": self.sharded_knn_merges,
             }
 
 

@@ -823,6 +823,40 @@ def knn_ivf_topk_masked_q(bank, scale, bias, qmask, centroids, cells, q,
                          n_rows, k, nprobe, metric)
 
 
+# -- mesh-sharded KNN merge (ISSUE 15) ----------------------------------------
+#
+# Row-parallel banks (services/vector.ShardedEmbeddingBank) reuse the whole
+# knn_topk / knn_ivf_topk family above AS the per-shard variants — each shard
+# is a full bank on its own device, so the per-shard leg is literally the
+# single-device program.  What sharding adds is the REDUCE: every shard's
+# (Q, k_s) local top-k d2d-colocates onto one device and this kernel picks
+# the global top-k as concat + lax.top_k — the FAISS shard-then-merge shape
+# on the repo's psum/merge discipline (never a host gather).  Ties break
+# toward the earlier concatenated position: lower shard id first, then the
+# shard's own tie order — which the NumPy fallback mirrors with a stable
+# argsort over the identical concat layout.
+
+
+def knn_sharded_merge(dists, idxs, shard_of_pos, k: int):
+    """dists/idxs: tuples of per-shard (Q, k_s) top-k outputs (all on ONE
+    device by the time this runs); shard_of_pos: (sum k_s,) int32 mapping a
+    concat position to its shard id (static per constellation, staged
+    once).  Returns (dist (Q, k), shard (Q, k), local_idx (Q, k)) — the
+    host decodes (shard, local) back to global rowids off the readback
+    path (resolve_hits), so no global-id plane ever ships to the device.
+
+    Deliberately NOT jitted here: the serving jit instances are minted per
+    mesh geometry by MeshManager.knn_merge_kernel, whose cross-epoch warm
+    pool is what makes a 4->8->4 reshard land back on the already-built
+    program — a module-level jit would be a second, unpooled compile path."""
+    dist_cat = jnp.concatenate(list(dists), axis=1)
+    idx_cat = jnp.concatenate(list(idxs), axis=1)
+    neg, pos = jax.lax.top_k(-dist_cat, k)
+    sid = shard_of_pos[pos]
+    lidx = jnp.take_along_axis(idx_cat, pos, axis=1)
+    return -neg, sid.astype(jnp.int32), lidx.astype(jnp.int32)
+
+
 @jax.jit
 def kmeans_step(points, weights, centroids):
     """One Lloyd iteration over the host mirror staged once per training

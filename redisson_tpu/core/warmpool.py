@@ -202,11 +202,118 @@ def _warm_hll(engine, rec, buckets: Iterable[int], device=None) -> int:
     return n
 
 
+def _warm_vector_bank(engine, rec, buckets: Iterable[int], device=None) -> int:
+    """Warm one embedding bank's KNN programs (ISSUE 15): the FLAT
+    matmul-top-k (and the IVF routed gather when the record carries a
+    trained coarse index) at the bank's exact plane geometry, per device.
+    Sharded banks hit this once PER SHARD RECORD — each shard is a plain
+    vector_bank record on its own device — and their cross-shard merge
+    warms through the manifest warmer below."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from redisson_tpu.core import kernels as K
+
+    bank = rec.arrays.get("bank")
+    if bank is None:
+        return 0  # never flushed: no geometry to warm yet
+    meta = rec.meta
+    metric = str(meta.get("metric", "COSINE"))
+    dtype = str(meta.get("dtype", "FLOAT32"))
+    cap, pwidth = bank.shape
+    k = max(1, min(10, cap))
+    cells = rec.arrays.get("cells")
+    cents = rec.arrays.get("centroids")
+    nprobe = int(meta.get("nprobe", 0) or 1)
+    n = 0
+
+    def thunk():
+        q = K.stage(np.zeros((1, pwidth), np.float32))
+        nv = K.valid_n(1)
+        dummy = _on(device, jnp.zeros(bank.shape, bank.dtype))
+        scale = rec.arrays.get("scale")
+        dscale = (
+            _on(device, jnp.ones((cap,), jnp.float32))
+            if scale is not None else None
+        )
+        dbias = _on(device, jnp.zeros((cap,), jnp.float32))
+        if dscale is not None:
+            out = K.knn_topk_q(dummy, dscale, dbias, q, nv, k, metric)
+        else:
+            out = K.knn_topk(dummy, dbias, q, nv, k, metric)
+        if cells is not None and cents is not None:
+            dc = _on(device, jnp.zeros(cents.shape, jnp.float32))
+            dl = _on(device, jnp.zeros(cells.shape, jnp.int32))
+            np_eff = max(1, min(nprobe, cents.shape[0]))
+            k_ivf = max(1, min(k, np_eff * cells.shape[1]))
+            if dscale is not None:
+                out = K.knn_ivf_topk_q(dummy, dscale, dbias, dc, dl, q,
+                                       nv, k_ivf, np_eff, metric)
+            else:
+                out = K.knn_ivf_topk(dummy, dbias, dc, dl, q, nv,
+                                     k_ivf, np_eff, metric)
+        jax.block_until_ready(out[0])
+
+    ivf_key = (
+        (cents.shape, cells.shape, nprobe)
+        if cells is not None and cents is not None else None
+    )
+    n += POOL.warm(
+        ("ftvec_knn", bank.shape, str(bank.dtype), metric, k, dtype,
+         ivf_key, _dev_key(device)),
+        thunk,
+    )
+    return n
+
+
+def _warm_vector_manifest(engine, rec, buckets: Iterable[int],
+                          device=None) -> int:
+    """Warm the sharded-KNN MERGE program for a bank constellation: the
+    jit instance comes from MeshManager's geometry-keyed cross-epoch pool
+    (knn_merge_kernel), so a 4->8->4 reshard re-enters prewarm with the
+    already-built program — 0 rebuilds, 0 first-dispatch traces."""
+    import jax
+    import jax.numpy as jnp
+
+    from redisson_tpu.parallel.manager import MeshManager
+
+    names = rec.meta.get("shard_names") or ()
+    n_legs = len(names)
+    if n_legs < 2:
+        return 0
+    mm = MeshManager.of(engine)
+    geom = mm.geometry()
+    merge = mm.knn_merge_kernel(n_legs, geom=geom)
+    k = 10
+
+    def thunk():
+        dists = tuple(
+            _on(device, jnp.zeros((1, k), jnp.float32))
+            for _ in range(n_legs)
+        )
+        idxs = tuple(
+            _on(device, jnp.zeros((1, k), jnp.int32)) for _ in range(n_legs)
+        )
+        sop = _on(device, jnp.zeros((n_legs * k,), jnp.int32))
+        out = merge(dists, idxs, sop, k)
+        jax.block_until_ready(out[0])
+
+    return POOL.warm(
+        ("ftvec_merge", n_legs, k, mm._mesh_key(geom.mesh),
+         _dev_key(device)),
+        thunk,
+    )
+
+
 _KIND_WARMERS = {
     "bloom": _warm_bloom,
     "bloom_array": _warm_bloom_array,
     "hll": _warm_hll,
     "hll_array": _warm_hll,
+    "vector_bank": _warm_vector_bank,
+    "vector_bank_manifest": _warm_vector_manifest,
 }
 
 

@@ -168,6 +168,10 @@ class MeshManager:
         self._guard = threading.Lock()
         self._kernels: Dict[Tuple, Tuple] = {}
         self._epoch = 0
+        # observability: kernel-set builds that actually ran (epoch-cache
+        # AND warm-pool miss) — the sharded-KNN warm-pool tests pin "a
+        # 4->8->4 reshard re-enters the pool with 0 rebuilds" against this
+        self.kernel_builds = 0
         # cross-epoch kernel warm pool (ISSUE 2): reshard() must invalidate
         # the EPOCH cache (a stale-geometry build must never serve a new-
         # epoch dispatch), but a 4->8->4 cycle lands back on a geometry
@@ -265,6 +269,8 @@ class MeshManager:
                 self._warm.move_to_end(wkey)
         if fns is None:
             fns = build(geom.mesh)
+            with self._guard:
+                self.kernel_builds += 1
         with self._guard:
             if self._epoch == geom.epoch:
                 self._kernels[ekey] = fns
@@ -301,6 +307,25 @@ class MeshManager:
             geom, ("hll", p, rows),
             lambda mesh: make_sharded_hll_kernels(mesh, p=p, n_rows=rows),
         )
+
+    def knn_merge_kernel(self, n_legs: int, geom: Optional[Geometry] = None):
+        """The sharded-KNN top-k-of-top-ks program (ISSUE 15) for an
+        ``n_legs`` constellation, geometry-keyed like every sharded kernel:
+        reshard() swaps the epoch cache, but the cross-epoch WARM POOL
+        keys on the mesh's physical identity — so a 4->8->4 round trip
+        lands back on the already-built jit instance (same Python object,
+        same compiled programs) with ZERO rebuilds.  Engine.prewarm's
+        vector warmer compiles through this same fetch, so a slot handoff
+        mid-serving never pays a first-dispatch trace."""
+        def build(_mesh):
+            from redisson_tpu.core import kernels as K
+
+            # a FRESH jit wrapper per geometry: its trace cache belongs to
+            # this mesh's device set, and pool reuse returns this exact
+            # object (0 rebuilds) instead of re-tracing
+            return jax.jit(K.knn_sharded_merge, static_argnums=(3,))
+
+        return self._cached(geom, ("knn_merge", n_legs), build)
 
     # -- placement helpers ---------------------------------------------------
 

@@ -51,6 +51,16 @@ def make_mesh(
     return Mesh(grid, (DP_AXIS, SHARD_AXIS))
 
 
+def device_ring(n_devices: int, base: int, n: int) -> list:
+    """Ring walk over a device axis: n member positions starting at `base`
+    — the placement shape shared by the slot-table split and the sharded
+    embedding-bank constellations (SlotPlacement.device_span).  Distinct
+    while n <= n_devices; wraps evenly past it."""
+    if n_devices <= 0:
+        raise ValueError("need at least one device")
+    return [(base + i) % n_devices for i in range(max(0, n))]
+
+
 def state_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for (T, m) state planes: plane axis split over `shard`,
     replicated over `dp`."""

@@ -155,6 +155,17 @@ class SlotPlacement:
                 self.moves += 1
         return changed
 
+    def device_span(self, base: int, n: int) -> List[int]:
+        """Target device ids for an n-member record CONSTELLATION anchored
+        at device `base` (ISSUE 15: mesh-sharded embedding banks): the ring
+        walk (base + i) % n_devices — distinct devices while n <= device
+        count, wrapping evenly past it.  Callers (vector.pick_shard_record
+        _names) then salt each member's hashtag until its slot lands on its
+        span device, so the ordinary slot machinery owns every move."""
+        from redisson_tpu.parallel.mesh import device_ring
+
+        return device_ring(self.n_devices, base, n)
+
     def spread_plan(self, n_active: int) -> Dict[int, int]:
         """The 4->8->4 rebalance shape: target owner for every slot when
         only the first `n_active` devices serve.  Returns {slot: dev_index}

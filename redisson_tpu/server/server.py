@@ -364,20 +364,15 @@ class TpuServer:
         # brick): bank count + device bytes, 0 until FT.CREATE ... VECTOR
         # builds one (the search service is lazily constructed — don't
         # force it just to report zero)
-        self.metrics.gauge(
-            "ftvec_banks", lambda: self._ftvec_census().get("ftvec_banks", 0.0)
-        )
-        self.metrics.gauge(
-            "ftvec_device_bytes",
-            lambda: self._ftvec_census().get("ftvec_device_bytes", 0.0),
-        )
-        # the IVF coarse index (centroids + cell tables) — separate gauge
-        # so an index leak on DROPINDEX is visible even when the bank
-        # itself released (ISSUE 14)
-        self.metrics.gauge(
-            "ftvec_index_bytes",
-            lambda: self._ftvec_census().get("ftvec_index_bytes", 0.0),
-        )
+        # ONE labeled gauge family for the whole embedding-bank census —
+        # totals (ftvec_banks / ftvec_device_bytes / ftvec_index_bytes, the
+        # ISSUE 11/14 rows) AND the per-device HBM-ledger labels
+        # ftvec_*_bytes_dev<N> (ISSUE 15), which exist only while that
+        # device holds bank bytes, so DROPINDEX zeroes every shard's row.
+        # One family on purpose: the census walks every index/bank/shard,
+        # and per-row scalar gauges would re-run that walk once per row
+        # per scrape.
+        self.metrics.multi_gauge("ftvec", self._ftvec_census)
         # OBJCALL handle cache (ordered for LRU eviction; see registry)
         from collections import OrderedDict
 
@@ -439,6 +434,14 @@ class TpuServer:
             "slowlog-log-slower-than": self.tracer.slowlog_slower_than_us,
             "slowlog-max-len": self.tracer.slowlog_max_len,
         }
+        # vector-plane tuning (ISSUE 15 satellite): the IVF gather geometry
+        # and the per-bank HBM budget must re-sweep on a chip WITHOUT a
+        # code edit — live process-global knobs in services/vector.py
+        from redisson_tpu.services import vector as _V
+
+        view["ivf-cell-imbalance"] = _V.IVF_CELL_IMBALANCE
+        view["ivf-cell-cap-max"] = _V.IVF_CELL_CAP_MAX
+        view["ftvec-device-budget"] = _V.DEVICE_BYTES_BUDGET
         view.update(self.scheduler.config_view())
         return view
 
@@ -492,6 +495,34 @@ class TpuServer:
             if n <= 0:
                 return False
             self.tracer.set_slowlog_max_len(n)
+            return True
+        if key == "ivf-cell-imbalance":
+            # cell_cap bound multiplier; applies at the next cell rebuild /
+            # retrain (the chip-run gather-bandwidth sweep, ISSUE 15)
+            v = float(value)
+            if v < 1.0:
+                return False
+            from redisson_tpu.services import vector as _V
+
+            _V.set_ivf_cell_imbalance(v)
+            return True
+        if key == "ivf-cell-cap-max":
+            # hard gather-width ceiling (0 = unbounded)
+            n = int(value)
+            if n < 0:
+                return False
+            from redisson_tpu.services import vector as _V
+
+            _V.set_ivf_cell_cap_max(n)
+            return True
+        if key == "ftvec-device-budget":
+            # per-bank-per-device HBM budget in bytes (0 = unlimited)
+            n = int(value)
+            if n < 0:
+                return False
+            from redisson_tpu.services import vector as _V
+
+            _V.set_device_bytes_budget(n)
             return True
         if key.startswith("qos-"):
             if key == "qos-bulk-slots" and int(value) <= 0:

@@ -323,13 +323,16 @@ def cmd_ft_create(server, ctx, args):
 
     VECTOR attributes use the RediSearch shape:
     ``f VECTOR {FLAT|IVF} <nargs> TYPE {FLOAT32|FLOAT16|INT8} DIM d
-    DISTANCE_METRIC {L2|COSINE|IP} [NLIST n] [NPROBE p] [TRAIN_MIN t]``
+    DISTANCE_METRIC {L2|COSINE|IP} [NLIST n] [NPROBE p] [TRAIN_MIN t]
+    [SHARDS s]``
     (the nargs pairs may arrive in any order).  IVF routes queries through
     a trained coarse-centroid bank and scores only the top-NPROBE cells;
-    FLOAT16/INT8 compress the bank at upload and dequantize in-kernel —
-    both axes compose (services/vector.py).  Each VECTOR field gets a
-    device-resident embedding bank placed on the index's slot-owner
-    device."""
+    FLOAT16/INT8 compress the bank at upload and dequantize in-kernel;
+    SHARDS s > 1 splits the bank row-wise across s local devices with an
+    on-device top-k merge (ISSUE 15) — all three axes compose
+    (services/vector.py).  Each VECTOR field gets a device-resident
+    embedding bank placed on the index's slot-owner device (per shard
+    when sharded)."""
     name = _s(args[0])
     prefixes = [""]
     i = 1
@@ -375,7 +378,8 @@ def cmd_ft_create(server, ctx, args):
                 "algo": algo,
             }
             for opt_attr, key in (("NLIST", "nlist"), ("NPROBE", "nprobe"),
-                                  ("TRAIN_MIN", "train_min")):
+                                  ("TRAIN_MIN", "train_min"),
+                                  ("SHARDS", "shards")):
                 if opt_attr in attrs:
                     vector[fld][key] = _int(attrs[opt_attr].encode())
             schema[fld] = "VECTOR"
@@ -442,6 +446,24 @@ def cmd_ft_info(server, ctx, args):
                     b"nprobe", vr["nprobe"],
                     b"trained", 1 if vr["trained"] else 0,
                     b"index_device_bytes", vr["index_device_bytes"],
+                ]
+            if "shards" in vr:
+                # mesh-sharded bank (ISSUE 15): shard count + one nested
+                # row per shard — rows / owning device / residency, the
+                # per-shard half of the HBM ledger
+                row += [
+                    b"shards", vr["shards"],
+                    b"shard_rows", [
+                        [
+                            b"shard", sr["shard"],
+                            b"rows", sr["rows"],
+                            b"device", sr["device"],
+                            b"device_bytes", sr["device_bytes"],
+                            b"index_device_bytes",
+                            sr["index_device_bytes"],
+                        ]
+                        for sr in vr.get("shard_rows", [])
+                    ],
                 ]
         flat_schema.append(row)
     out = [

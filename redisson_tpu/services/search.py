@@ -687,12 +687,18 @@ class SearchService:
         banks = 0
         total = 0
         index_bytes = 0
+        by_dev: Dict[int, float] = {}
+        idx_by_dev: Dict[int, float] = {}
         for idx in indexes:
             if idx.vectors:
                 banks += len(idx.vectors.banks)
                 total += idx.vectors.device_bytes()
                 index_bytes += idx.vectors.index_device_bytes()
-        return {
+                for d, v in idx.vectors.device_bytes_by_device().items():
+                    by_dev[d] = by_dev.get(d, 0.0) + float(v)
+                for d, v in idx.vectors.index_bytes_by_device().items():
+                    idx_by_dev[d] = idx_by_dev.get(d, 0.0) + float(v)
+        out = {
             "ftvec_banks": float(banks),
             "ftvec_device_bytes": float(total),
             # the IVF coarse index (centroids + cell table) — its own row
@@ -700,6 +706,16 @@ class SearchService:
             # bank itself tears down correctly
             "ftvec_index_bytes": float(index_bytes),
         }
+        # per-DEVICE breakdown (ISSUE 15 satellite — the HBM-capacity
+        # ledger's first per-chip rows): which chip holds how many bank /
+        # coarse-index bytes.  Rows exist only while a device holds bytes,
+        # so DROPINDEX returns every shard's row to absence == zero (the
+        # sharded soak pins that).
+        for d, v in sorted(by_dev.items()):
+            out[f"ftvec_device_bytes_dev{d}"] = v
+        for d, v in sorted(idx_by_dev.items()):
+            out[f"ftvec_index_bytes_dev{d}"] = v
+        return out
 
     # -- tracking-plane integration (ISSUE 11) --------------------------------
     #
@@ -788,7 +804,10 @@ class SearchService:
                     return [[] for _ in range(nq)]
                 dist_h, idx_h, _nq, k_eff = host
             else:
-                dist_h, idx_h = np.asarray(vals[0]), np.asarray(vals[1])
+                # the bank decodes its own device outputs to GLOBAL rowids:
+                # (dist, idx) for plain banks, (dist, shard, local) for the
+                # mesh-sharded facade (gmap decode off the readback path)
+                dist_h, idx_h = bank.resolve_hits(vals)
                 k_eff = dist_h.shape[1]
             picked = []   # (qi, rowid, doc) winners, reply order
             for qi in range(nq):
@@ -797,7 +816,8 @@ class SearchService:
                         continue  # k exceeded the live rows: padding entry
                     r = int(idx_h[qi, j])
                     doc = (
-                        idx._rowdoc[r] if r < len(idx._rowdoc) else None
+                        idx._rowdoc[r]
+                        if 0 <= r < len(idx._rowdoc) else None
                     )
                     if doc is None:
                         continue  # doc deleted between dispatch and fetch
@@ -820,8 +840,10 @@ class SearchService:
 
         if not armed:
             return None, finish
-        dist, ridx, _nq, _k_eff = out
-        return (dist, ridx), finish
+        # device arrays lead, (q_count, k_eff) trail: (dist, idx) for the
+        # plain bank, (dist, shard, local) for the sharded facade — the
+        # LazyReply grouped readback is tuple-length agnostic
+        return tuple(out[:-2]), finish
 
     # -- document ingestion --------------------------------------------------
 

@@ -9,7 +9,7 @@ FLAT KNN query is a single jitted matmul-(+norm)-top-k kernel
 (core/kernels.knn_topk) — the MXU replaces the per-doc loop, exactly the
 trade the numeric plane already made for range predicates.
 
-Two sub-linear axes compose on top of FLAT (ISSUE 14), both behind the
+Three scaling axes compose on top of FLAT (ISSUEs 14/15), all behind the
 recall gate that keeps them honest:
 
   * **IVF** (``VECTOR IVF ... NLIST n [NPROBE p]``) — a coarse k-means
@@ -28,6 +28,19 @@ recall gate that keeps them honest:
     one fused program.  The host mirror stores the DEQUANTIZED values, so
     the disarmed path and the recall oracle score exactly what the device
     scores.
+  * **Mesh sharding** (``SHARDS n``, ISSUE 15) — the bank splits ROW-WISE
+    into n shard records, each pinned to a distinct local device through
+    its own ``{hashtag}`` slot (ShardedEmbeddingBank), so N x d scales
+    past one chip's HBM — the FAISS shard-then-merge pattern (Johnson et
+    al. 2017) under this repo's record/placement discipline.  Ingest
+    routes each new rowid to the least-full shard (one packed H2D per
+    shard per flush through that shard device's lane staging pool); a
+    query fans per-shard matmul/IVF-gather-score + local top-k legs out
+    across the lanes and merges the per-shard winners ON DEVICE
+    (kernels.knn_sharded_merge: concat + lax.top_k — a d2d colocate of
+    (Q, k) tops, never a host gather; IOStats.host_colocations stays 0).
+    Each shard is a full EmbeddingBank, so IVF and FP16/INT8 compose with
+    sharding — all three axes multiply.
 
 Bank layout (the bloom-bank discipline generalized to float rows):
 
@@ -99,9 +112,55 @@ DEFAULT_NPROBE = 8
 RETRAIN_GROWTH = 1.5   # retrain once the corpus grew this much past the
                        # last training set (the drift heuristic)
 KMEANS_ITERS = 6
-IVF_CELL_IMBALANCE = 3  # cell_cap bound = this x mean occupancy; rows past
-                        # it spill to their next-nearest cell (recall-vs-
-                        # gather-width trade, see _rebuild_cells)
+
+# -- live tuning knobs (ISSUE 15 satellite) ------------------------------------
+# The next chip run must re-sweep the IVF gather geometry around REAL HBM
+# gather bandwidth (ROADMAP chip-run note) — these must move via env /
+# ``CONFIG SET``, never a code edit.  Read at use time, so a live SET takes
+# effect at the next cell rebuild / capacity growth.
+
+IVF_CELL_IMBALANCE = float(os.environ.get("RTPU_IVF_CELL_IMBALANCE", "3"))
+# cell_cap bound = IVF_CELL_IMBALANCE x mean occupancy; rows past it spill
+# to their next-nearest cell (recall-vs-gather-width trade, _rebuild_cells)
+
+IVF_CELL_CAP_MAX = int(os.environ.get("RTPU_IVF_CELL_CAP_MAX", "0"))
+# hard ceiling on cell_cap — the per-query candidate gather is
+# O(nprobe x cell_cap), so this IS the gather-width dial; 0 = unbounded.
+# Rows a capped cell cannot hold (even after spilling) drop from the cell
+# table — the recall gate keeps that trade visible.
+
+DEVICE_BYTES_BUDGET = int(os.environ.get("RTPU_FTVEC_DEVICE_BUDGET", "0"))
+# per-bank-per-device HBM budget in bytes (0 = unlimited) — the first
+# enforced brick of the ROADMAP HBM-capacity ledger: a single-device bank
+# that would grow past it raises VectorBudgetError at flush, while a
+# SHARDS n bank splits the same corpus into n under-budget shard banks
+# (the config7s capacity demo).
+
+
+def set_ivf_cell_imbalance(value: float) -> float:
+    """Set the cell_cap imbalance bound; returns the previous value."""
+    global IVF_CELL_IMBALANCE
+    prev, IVF_CELL_IMBALANCE = IVF_CELL_IMBALANCE, max(1.0, float(value))
+    return prev
+
+
+def set_ivf_cell_cap_max(value: int) -> int:
+    """Set the gather-width ceiling (0 = unbounded); returns the previous."""
+    global IVF_CELL_CAP_MAX
+    prev, IVF_CELL_CAP_MAX = IVF_CELL_CAP_MAX, max(0, int(value))
+    return prev
+
+
+def set_device_bytes_budget(value: int) -> int:
+    """Set the per-bank device-bytes budget (0 = unlimited); returns prev."""
+    global DEVICE_BYTES_BUDGET
+    prev, DEVICE_BYTES_BUDGET = DEVICE_BYTES_BUDGET, max(0, int(value))
+    return prev
+
+
+class VectorBudgetError(RuntimeError):
+    """A bank flush would grow one device's bank past DEVICE_BYTES_BUDGET —
+    the corpus needs SHARDS (or a compressed TYPE) to fit the mesh."""
 
 _IVF_SENTINEL = np.int32(0x3FFFFFFF)  # padded cells entry: never a live row
 
@@ -119,7 +178,11 @@ class VectorFieldSpec:
                  0 resolves to min(nlist, 8).
     ``train_min`` — row count at which the coarse quantizer first trains;
                  0 resolves to max(4 * nlist, 256).  Below it IVF scores
-                 FLAT (exact)."""
+                 FLAT (exact).
+    ``shards`` — row-parallel mesh shards (ISSUE 15): 1 (default) keeps
+                 the single-record bank; n > 1 splits rows across n shard
+                 records pinned to distinct local devices.  IVF state and
+                 compressed storage are PER SHARD, so all axes compose."""
 
     field: str
     dim: int
@@ -129,6 +192,7 @@ class VectorFieldSpec:
     nlist: int = 0
     nprobe: int = 0
     train_min: int = 0
+    shards: int = 1
 
     def __post_init__(self):
         self.metric = str(self.metric).upper()
@@ -138,6 +202,9 @@ class VectorFieldSpec:
         self.nlist = int(self.nlist)
         self.nprobe = int(self.nprobe)
         self.train_min = int(self.train_min)
+        self.shards = int(self.shards)
+        if self.shards < 1:
+            raise ValueError("SHARDS must be a positive shard count")
         if self.dim <= 0:
             raise ValueError("vector DIM must be positive")
         if self.metric not in VECTOR_METRICS:
@@ -162,6 +229,7 @@ class VectorFieldSpec:
             "field": self.field, "dim": self.dim, "metric": self.metric,
             "dtype": self.dtype, "algo": self.algo, "nlist": self.nlist,
             "nprobe": self.nprobe, "train_min": self.train_min,
+            "shards": self.shards,
         }
 
 
@@ -192,6 +260,40 @@ def bank_record_name(index: str, field: str) -> str:
     commits every bank of one index to that index's slot-owner device and
     indexes shard across the local mesh like any record."""
     return "__ftvec__{%s}:%s" % (index, field)
+
+
+def shard_record_name(index: str, field: str, shard: int, salt: int) -> str:
+    """DeviceStore name of ONE shard of a mesh-sharded bank.  The hashtag
+    embeds the shard id + a salt, so each shard record owns its OWN
+    keyspace slot: SlotPlacement commits it to that slot's device, fenced
+    journaled rebalances / CLUSTER DEVMOVE move it like any record, and
+    the constellation re-pins shard by shard — no bespoke migration
+    machinery (the manifest record under bank_record_name lists these)."""
+    return "__ftvec__{%s#s%d.%d}:%s" % (index, shard, salt, field)
+
+
+def pick_shard_record_names(engine, index: str, field: str,
+                            n: int) -> List[str]:
+    """Shard record names whose slots land on DISTINCT devices: shard i
+    targets device (owner(base) + i) % n_devices (SlotPlacement.device_span)
+    and the hashtag salt is searched until the name's slot maps there —
+    deterministic given the placement table, a few CRC16 probes per shard.
+    Placement off: salt 0 (every record on the default device anyway)."""
+    p = getattr(engine, "placement", None)
+    if p is None:
+        return [shard_record_name(index, field, i, 0) for i in range(n)]
+    span = p.device_span(p.device_id_for_name(bank_record_name(index, field)),
+                         n)
+    names = []
+    for i, want in enumerate(span):
+        for salt in range(512):
+            nm = shard_record_name(index, field, i, salt)
+            if p.device_id_for_name(nm) == want:
+                names.append(nm)
+                break
+        else:  # pragma: no cover — 512 probes over 16384 slots always hit
+            names.append(shard_record_name(index, field, i, 0))
+    return names
 
 
 def _query_bucket(n: int) -> int:
@@ -248,6 +350,27 @@ def quantize_row(row: np.ndarray, dtype: str, pwidth: int):
 _NP_DTYPES = {
     "FLOAT32": np.float32, "FLOAT16": np.float16, "INT8": np.int8,
 }
+
+
+def _pair_score_math(rows: np.ndarray, qs: np.ndarray,
+                     metric: str) -> np.ndarray:
+    """The per-pair score reduction shared by EVERY reply path (plain and
+    sharded banks): (M, d) rows against (M, d) queries -> (M,) f32 scores.
+    One routine on purpose — the armed/disarmed byte-identity contract
+    hangs off these exact reductions."""
+    dots = np.einsum("md,md->m", rows, qs, dtype=np.float32)
+    if metric == "L2":
+        q_sq = np.einsum("md,md->m", qs, qs, dtype=np.float32)
+        r_sq = np.einsum("md,md->m", rows, rows, dtype=np.float32)
+        return (q_sq - 2.0 * dots + r_sq).astype(np.float32)
+    if metric == "COSINE":
+        qn = np.sqrt(np.einsum("md,md->m", qs, qs, dtype=np.float32))
+        rn = np.sqrt(np.einsum("md,md->m", rows, rows, dtype=np.float32))
+        denom = qn * rn
+        with np.errstate(invalid="ignore", divide="ignore"):
+            cos = np.where(denom > 0.0, dots / denom, 0.0)
+        return (1.0 - cos).astype(np.float32)
+    return (1.0 - dots).astype(np.float32)  # IP
 
 
 class DeviceRowBank:
@@ -364,6 +487,18 @@ class DeviceRowBank:
 
     # -- device flush ---------------------------------------------------------
 
+    BUDGETED = False  # RecordRowBank opts in: only device-resident banks
+                      # charge the HBM ledger, never the numeric plane's
+                      # engine-free standalone binding
+
+    def _projected_device_bytes(self, cap: int) -> int:
+        """Device bytes a `cap`-row bank holds: stored rows + bias plane
+        (+ INT8 scale column) — the quantity DEVICE_BYTES_BUDGET bounds."""
+        per_row = self.pwidth * np.dtype(_NP_DTYPES[self.dtype]).itemsize + 4
+        if self.dtype == "INT8":
+            per_row += 4
+        return cap * per_row
+
     def _ensure_capacity_locked(self, needed: int) -> None:
         import jax
         import jax.numpy as jnp
@@ -375,6 +510,16 @@ class DeviceRowBank:
         new_cap = max(self.block, self._cap)
         while new_cap < needed:
             new_cap *= 2
+        budget = DEVICE_BYTES_BUDGET
+        if budget and self.BUDGETED:
+            projected = self._projected_device_bytes(new_cap)
+            if projected > budget:
+                raise VectorBudgetError(
+                    f"bank '{getattr(self, 'name', '?')}' would hold "
+                    f"{projected} device bytes at capacity {new_cap} — over "
+                    f"the {budget}-byte per-device budget; shard the index "
+                    f"(SHARDS n) or compress its TYPE"
+                )
         device = self._target_device()
         jdt = {"FLOAT32": jnp.float32, "FLOAT16": jnp.float16,
                "INT8": jnp.int8}[self.dtype]
@@ -430,8 +575,16 @@ class DeviceRowBank:
             if not self._pending:
                 return 0
             pending, self._pending = self._pending, {}
+            try:
+                with self._record_guard():
+                    self._ensure_capacity_locked(self.rows)
+            except VectorBudgetError:
+                # over-budget growth refused: the rows stay PENDING (their
+                # mirror values are already installed), so nothing is lost
+                # and a raised budget / resharded index drains them later
+                self._pending = pending
+                raise
             with self._record_guard():
-                self._ensure_capacity_locked(self.rows)
                 n = len(pending)
                 p = K.bucket_size(n, minimum=min(self.block, 256))
                 shape = (p, self._packed_cols())
@@ -512,6 +665,7 @@ class RecordRowBank(DeviceRowBank):
     teardown path."""
 
     KIND = "vector_bank"
+    BUDGETED = True
 
     def __init__(self, engine, name: str, width: int,
                  block: int = DEFAULT_BLOCK, dtype: str = "FLOAT32",
@@ -605,15 +759,22 @@ class _IvfPlane:
 
 
 class EmbeddingBank(RecordRowBank):
-    """One index-field embedding bank + the KNN dispatch path."""
+    """One index-field embedding bank + the KNN dispatch path.
+
+    ``record_name`` overrides the canonical bank record name — the mesh-
+    sharded facade (ShardedEmbeddingBank) constructs one EmbeddingBank per
+    SHARD under a shard-salted hashtag, so each shard slot-places onto its
+    own device and every per-shard axis (IVF plane, compressed storage,
+    lane accounting) is exactly this class, unchanged."""
 
     def __init__(self, engine, index: str, spec: VectorFieldSpec,
-                 block: int = DEFAULT_BLOCK, reset: bool = True):
+                 block: int = DEFAULT_BLOCK, reset: bool = True,
+                 record_name: Optional[str] = None):
         self.spec = spec
         self._ivf = _IvfPlane(spec) if spec.algo == "IVF" else None
         super().__init__(
-            engine, bank_record_name(index, spec.field), spec.dim,
-            block=block, dtype=spec.dtype,
+            engine, record_name or bank_record_name(index, spec.field),
+            spec.dim, block=block, dtype=spec.dtype,
             meta=dict(spec.to_meta(), index=index), reset=reset,
         )
 
@@ -766,7 +927,10 @@ class EmbeddingBank(RecordRowBank):
         cell keeps its centroid-closest rows and SPILLS the rest to their
         next-nearest cell with room (Faiss-style balanced assignment); a
         spilled row is still found through its second-best centroid, and
-        the recall gate keeps the trade honest."""
+        the recall gate keeps the trade honest.  Both bounds are LIVE
+        knobs (env / CONFIG SET, ISSUE 15): IVF_CELL_IMBALANCE and the
+        hard gather-width ceiling IVF_CELL_CAP_MAX, re-read here so the
+        chip-run sweep never needs a code edit."""
         from redisson_tpu.core import kernels as K
 
         ivf = self._ivf
@@ -776,7 +940,10 @@ class EmbeddingBank(RecordRowBank):
         n_live = live_rows.shape[0]
         counts = np.bincount(a[live_rows], minlength=ivf.spec.nlist)
         avg = max(1, -(-n_live // ivf.spec.nlist))  # ceil
-        cap = K.bucket_size(max(4, IVF_CELL_IMBALANCE * avg), minimum=4)
+        imb = max(1.0, float(IVF_CELL_IMBALANCE))
+        cap = K.bucket_size(max(4, int(round(imb * avg))), minimum=4)
+        if IVF_CELL_CAP_MAX:
+            cap = min(cap, max(4, int(IVF_CELL_CAP_MAX)))
         cent = ivf.centroids
         overfull = np.nonzero(counts > cap)[0]
         for c in overfull:
@@ -900,6 +1067,30 @@ class EmbeddingBank(RecordRowBank):
             if a is not None:
                 total += int(a.nbytes)
         return total
+
+    def owner_device_id(self) -> int:
+        """Device id the bank's planes sit on (-1 while unplaced/never
+        flushed) — the label of the per-device HBM-ledger rows."""
+        from redisson_tpu.core.ioplane import device_of
+
+        try:
+            bank, _bias, _scale = self._get_planes()
+        except KeyError:
+            return -1
+        dev = device_of(bank) if bank is not None else None
+        if dev is None:
+            dev = self._target_device()
+        return getattr(dev, "id", -1) if dev is not None else -1
+
+    def device_bytes_by_device(self) -> Dict[int, int]:
+        """{device id: bank bytes} — one entry for a plain bank; the
+        sharded facade merges its shards' maps (per-device ledger rows)."""
+        b = self.device_bytes()
+        return {self.owner_device_id(): b} if b else {}
+
+    def index_bytes_by_device(self) -> Dict[int, int]:
+        b = self.index_device_bytes()
+        return {self.owner_device_id(): b} if b else {}
 
     def ivf_ready(self) -> bool:
         return self._ivf is not None and self._ivf.centroids is not None
@@ -1087,20 +1278,13 @@ class EmbeddingBank(RecordRowBank):
         with self._lock:
             rows = self._host[np.asarray(rowids, np.int64)]       # (M, d)
         qs = np.ascontiguousarray(q, np.float32)[np.asarray(qis, np.int64)]
-        dots = np.einsum("md,md->m", rows, qs, dtype=np.float32)
-        metric = self.spec.metric
-        if metric == "L2":
-            q_sq = np.einsum("md,md->m", qs, qs, dtype=np.float32)
-            r_sq = np.einsum("md,md->m", rows, rows, dtype=np.float32)
-            return (q_sq - 2.0 * dots + r_sq).astype(np.float32)
-        if metric == "COSINE":
-            qn = np.sqrt(np.einsum("md,md->m", qs, qs, dtype=np.float32))
-            rn = np.sqrt(np.einsum("md,md->m", rows, rows, dtype=np.float32))
-            denom = qn * rn
-            with np.errstate(invalid="ignore", divide="ignore"):
-                cos = np.where(denom > 0.0, dots / denom, 0.0)
-            return (1.0 - cos).astype(np.float32)
-        return (1.0 - dots).astype(np.float32)  # IP
+        return _pair_score_math(rows, qs, self.spec.metric)
+
+    def resolve_hits(self, vals) -> Tuple[np.ndarray, np.ndarray]:
+        """Host arrays of one armed dispatch -> (dist (Q,k), GLOBAL rowids
+        (Q,k)).  Plain banks already address global rowids; the sharded
+        facade overrides to decode its (dist, shard, local) triple."""
+        return np.asarray(vals[0]), np.asarray(vals[1])
 
     def _knn_host_ivf(self, q, k, allowed_rows, nprobe, host, hbias):
         """NumPy mirror of kernels._knn_ivf_body over the SAME canonical
@@ -1146,6 +1330,432 @@ class EmbeddingBank(RecordRowBank):
         return top.astype(np.float32), idx.astype(np.int32), nq, k_eff
 
 
+# -- mesh-sharded banks (ISSUE 15) --------------------------------------------
+
+_FANOUT_POOL = None
+_FANOUT_POOL_LOCK = threading.Lock()
+
+
+def _gmap_decode(g: np.ndarray, local: np.ndarray) -> np.ndarray:
+    """Shard-local rowids -> global rowids through one shard's gmap, with
+    out-of-range entries (IVF padding sentinels, capacity padding) mapped
+    to -1 — the ONE guarded lookup both reply paths share, so neither can
+    dereference a sentinel the other would have masked."""
+    local = np.asarray(local)
+    ok = (local >= 0) & (local < g.shape[0])
+    return np.where(ok, g[np.clip(local, 0, max(0, g.shape[0] - 1))], -1)
+
+
+def _fanout_pool():
+    """Shared worker pool for per-shard KNN legs: each leg stages its query
+    onto its OWN shard's device and occupies that device's lane, so
+    dispatching legs from concurrent threads is what lets N chips (or the
+    CPU-replica occupancy model) overlap one sharded frame — the thread
+    face of config5d's cross-lane dispatch."""
+    global _FANOUT_POOL
+    if _FANOUT_POOL is None:
+        with _FANOUT_POOL_LOCK:
+            if _FANOUT_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _FANOUT_POOL = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="rtpu-ftvec-shard"
+                )
+    return _FANOUT_POOL
+
+
+class ShardedEmbeddingBank:
+    """One index-field embedding bank split ROW-WISE across the local mesh
+    (``SHARDS n``): n EmbeddingBank shards, each a full bank (own IVF
+    plane, own compressed storage, own lane/staging accounting) under a
+    shard-salted hashtag record pinned to its own slot-owner device — so
+    the constellation's total N x d exceeds any ONE chip's HBM, and every
+    existing per-record discipline (fenced rebalances, DEVMOVE, DROPINDEX
+    teardown, census) applies shard by shard with zero new machinery.
+
+    Routing: a global rowid is assigned once to the LEAST-FULL shard
+    (``_route``/``_local``), and each shard keeps its local->global map
+    (``_gmap``).  Queries fan per-shard ``knn_async`` legs out across the
+    lanes (each leg charges ITS device's lane), then the per-shard (Q, k)
+    tops d2d-colocate onto one shard's device and merge as ONE jitted
+    top-k-of-top-ks (kernels.knn_sharded_merge) — never a host gather
+    (IOStats.host_colocations unmoved; sharded_knn_merges counts).  The
+    disarmed path mirrors the SAME shard legs + concat order with a stable
+    argsort, and reply scores come from the one canonical
+    ``_pair_score_math`` over the shard mirrors, so armed and disarmed
+    replies stay byte-identical for every shards x algo x dtype cell."""
+
+    KIND = "vector_bank_manifest"
+
+    def __init__(self, engine, index: str, spec: VectorFieldSpec,
+                 block: int = DEFAULT_BLOCK, reset: bool = True):
+        from redisson_tpu.core.store import StateRecord
+
+        self.spec = spec
+        self._engine = engine
+        self.index = index
+        self.block = max(1, int(block))
+        self.name = bank_record_name(index, spec.field)
+        self._lock = threading.RLock()
+        with engine.locked(self.name):
+            old = engine.store.get_unguarded(self.name)
+            if reset and old is not None:
+                # a dropped/rebuilt index must not leak its old shard
+                # records (their salted names may differ this time)
+                for nm in old.meta.get("shard_names", ()):
+                    engine.store.delete_unguarded(nm)
+                engine.store.delete_unguarded(self.name)
+                old = None
+            if old is not None and old.meta.get("shard_names"):
+                names = list(old.meta["shard_names"])
+            else:
+                names = pick_shard_record_names(
+                    engine, index, spec.field, spec.shards
+                )
+                engine.store.put_unguarded(
+                    self.name,
+                    StateRecord(
+                        kind=self.KIND,
+                        meta=dict(spec.to_meta(), index=index,
+                                  shard_names=list(names)),
+                        arrays={},
+                    ),
+                )
+        self.shard_names = names
+        self.shards: List[EmbeddingBank] = [
+            EmbeddingBank(engine, index, spec, block=block, reset=reset,
+                          record_name=nm)
+            for nm in names
+        ]
+        # global rowid -> (shard, shard-local rowid); -1 = never assigned
+        self._route = np.full(0, -1, np.int32)
+        self._local = np.full(0, -1, np.int32)
+        # per shard: local rowid -> global rowid (append-only: a local slot
+        # never re-routes, so readback-time decode needs no lock ordering)
+        self._gmap: List[np.ndarray] = [
+            np.full(0, -1, np.int32) for _ in names
+        ]
+        # local slots ASSIGNED per shard — the least-full/next-slot counter.
+        # Kept here (not read off shard.rows) so slot minting stays correct
+        # while the shard's own set_row runs OUTSIDE the facade lock.
+        self._assigned: List[int] = [0 for _ in names]
+        # round-robin cursor for the merge device (no fixed hot lane)
+        self._merge_rr = 0
+        # staged shard_of_pos operands, keyed by (leg shard ids, per-leg
+        # k_s, merge device id): static per constellation geometry, so the
+        # hot query path reuses the device buffer instead of paying one
+        # tiny H2D per dispatch.  Bounded: geometries are few (k values x
+        # merge-device rotation); a pathological sweep just clears it.
+        self._sop_cache: Dict[Tuple, Any] = {}
+        self.rows = 0
+
+    # -- routing --------------------------------------------------------------
+
+    def _grow_routing_locked(self, rowid: int) -> None:
+        if rowid < self._route.shape[0]:
+            return
+        cap = max(self.block, 2 * max(1, self._route.shape[0]))
+        while cap <= rowid:
+            cap *= 2
+        for attr in ("_route", "_local"):
+            cur = getattr(self, attr)
+            grown = np.full(cap, -1, np.int32)
+            grown[: cur.shape[0]] = cur
+            setattr(self, attr, grown)
+
+    def _assign_locked(self, rowid: int) -> Tuple[int, int]:
+        """Route one new rowid to the LEAST-FULL shard and mint its local
+        slot (ties toward the lower shard — deterministic layout).  The
+        fullness/next-slot source is the facade's own ``_assigned`` ledger,
+        never ``shard.rows``: the shard write runs outside the facade lock,
+        so its row count lags the minting and reading it here would hand
+        two rowids the same slot."""
+        s = int(np.argmin(self._assigned))
+        loc = self._assigned[s]
+        self._assigned[s] = loc + 1
+        self._route[rowid] = s
+        self._local[rowid] = loc
+        g = self._gmap[s]
+        if loc >= g.shape[0]:
+            cap = max(DEFAULT_BLOCK, 2 * max(1, g.shape[0]))
+            while cap <= loc:
+                cap *= 2
+            grown = np.full(cap, -1, np.int32)
+            grown[: g.shape[0]] = g
+            self._gmap[s] = g = grown
+        g[loc] = rowid
+        return s, loc
+
+    def set_row(self, rowid: int, row: Optional[np.ndarray]) -> None:
+        # routing under the facade lock; the shard write OUTSIDE it — a
+        # shard whose pending block flushes (packed H2D + scatter) must not
+        # stall ingest to every other shard or query leg-selection (the
+        # shard's own lock already serializes its slots)
+        with self._lock:
+            self._grow_routing_locked(rowid)
+            s = int(self._route[rowid])
+            if s < 0:
+                s, loc = self._assign_locked(rowid)
+            else:
+                loc = int(self._local[rowid])
+            self.rows = max(self.rows, rowid + 1)
+        self.shards[s].set_row(loc, row)
+
+    # -- aggregate bank surface (the EmbeddingBank API, summed) ---------------
+
+    @property
+    def h2d_flushes(self) -> int:
+        return sum(sh.h2d_flushes for sh in self.shards)
+
+    @property
+    def grows(self) -> int:
+        return sum(sh.grows for sh in self.shards)
+
+    def device_bytes(self) -> int:
+        return sum(sh.device_bytes() for sh in self.shards)
+
+    def index_device_bytes(self) -> int:
+        return sum(sh.index_device_bytes() for sh in self.shards)
+
+    def logical_f32_bytes(self) -> int:
+        return sum(sh.logical_f32_bytes() for sh in self.shards)
+
+    def pending_count(self) -> int:
+        return sum(sh.pending_count() for sh in self.shards)
+
+    def device_bytes_by_device(self) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for sh in self.shards:
+            for d, b in sh.device_bytes_by_device().items():
+                out[d] = out.get(d, 0) + b
+        return out
+
+    def index_bytes_by_device(self) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for sh in self.shards:
+            for d, b in sh.index_bytes_by_device().items():
+                out[d] = out.get(d, 0) + b
+        return out
+
+    def ivf_ready(self) -> bool:
+        return any(sh.ivf_ready() for sh in self.shards)
+
+    def retrain(self) -> None:
+        for sh in self.shards:
+            sh.retrain()
+
+    def flush_pending(self) -> int:
+        return sum(sh.flush_pending() for sh in self.shards)
+
+    def drop(self) -> None:
+        for sh in self.shards:
+            sh.drop()
+        with self._engine.locked(self.name):
+            self._engine.store.delete_unguarded(self.name)
+
+    def shard_rows(self) -> List[Dict[str, Any]]:
+        """Per-shard FT.INFO / census rows: residency shard by shard."""
+        out = []
+        for i, sh in enumerate(self.shards):
+            out.append({
+                "shard": i, "record": sh.name, "rows": sh.rows,
+                "device": sh.owner_device_id(),
+                "device_bytes": sh.device_bytes(),
+                "index_device_bytes": sh.index_device_bytes(),
+            })
+        return out
+
+    # -- scoring --------------------------------------------------------------
+
+    def _legs(self, allowed_rows: Optional[np.ndarray]):
+        """[(shard, shard-local allowed | None)] — the ONE leg-selection
+        routine both scoring paths share: ascending shard order (the merge
+        tie-break), empty shards skipped, and a hybrid prefilter that
+        covers no rows of a shard skips that shard's dispatch entirely."""
+        with self._lock:
+            if allowed_rows is None:
+                return [
+                    (s, None) for s in range(len(self.shards))
+                    if self.shards[s].rows > 0
+                ]
+            al = np.asarray(allowed_rows, np.int64).reshape(-1)
+            al = al[(al >= 0) & (al < self._route.shape[0])]
+            rs = self._route[al]
+            ls = self._local[al]
+            legs = []
+            for s in range(len(self.shards)):
+                if self.shards[s].rows <= 0:
+                    continue
+                m = rs == s
+                if np.any(m):
+                    legs.append((s, ls[m].astype(np.int64)))
+            return legs
+
+    def _merge_kernel(self, n_legs: int):
+        """The top-k-of-top-ks program, fetched through MeshManager's
+        geometry-keyed cross-epoch warm pool — a 4->8->4 reshard lands back
+        on the already-built program (0 rebuilds; the sharded-KNN half of
+        the Engine.prewarm contract)."""
+        from redisson_tpu.parallel.manager import MeshManager
+
+        return MeshManager.of(self._engine).knn_merge_kernel(n_legs)
+
+    def _merge_lane_gate(self, device, n_items: int):
+        eng = self._engine
+        if eng.lanes is None or device is None:
+            return nullcontext()
+        return eng.lanes.lane(device).occupy(n_items)
+
+    def knn_async(self, queries: np.ndarray, k: int,
+                  allowed_rows: Optional[np.ndarray] = None,
+                  nprobe: Optional[int] = None):
+        """Row-parallel KNN: fan the stacked queries out as one
+        ``knn_async`` leg per live shard (concurrent, each under its own
+        device lane), d2d-colocate the per-shard (Q, k) tops onto one
+        shard's device and run ONE merged top-k kernel there.  Returns
+        (dist, shard, local, q_count, k_eff) — resolve_hits decodes the
+        (shard, local) pair back to global rowids host-side."""
+        from redisson_tpu.core import ioplane
+        from redisson_tpu.core import kernels as K
+
+        q = np.ascontiguousarray(queries, np.float32).reshape(
+            -1, self.spec.dim
+        )
+        nq = q.shape[0]
+        legs = self._legs(allowed_rows)
+        if not legs:
+            return None
+        pool = _fanout_pool()
+        futs = [
+            pool.submit(self.shards[s].knn_async, q, k, al, nprobe)
+            for s, al in legs
+        ]
+        outs = []
+        for (s, _al), f in zip(legs, futs):
+            o = f.result()
+            if o is not None:
+                outs.append((s, o))
+        if not outs:
+            return None
+        # merge device rotates across the live legs per dispatch — a fixed
+        # choice (always shard 0) would serialize EVERY bank's merges on
+        # one lane while the other chips idle after their legs
+        with self._lock:
+            rr = self._merge_rr
+            self._merge_rr = rr + 1
+        dest = ioplane.device_of(outs[rr % len(outs)][1][0])
+        dists, idxs = [], []
+        for _s, (d, i, _nq, _k_s) in outs:
+            dists.append(ioplane.colocate(d, dest))
+            idxs.append(ioplane.colocate(i, dest))
+        geom_key = (
+            tuple(s for s, _o in outs),
+            tuple(o[3] for _s, o in outs),
+            getattr(dest, "id", None),
+        )
+        with self._lock:
+            sop = self._sop_cache.get(geom_key)
+        if sop is None:
+            shard_of_pos = np.concatenate(
+                [np.full(o[3], s, np.int32) for s, o in outs]
+            )
+            if dest is not None:
+                import jax
+
+                sop = jax.device_put(shard_of_pos, dest)
+            else:
+                sop = K.stage(shard_of_pos)
+            with self._lock:
+                if len(self._sop_cache) >= 64:
+                    self._sop_cache.clear()
+                self._sop_cache[geom_key] = sop
+        total = sum(o[3] for _s, o in outs)
+        k_out = max(1, min(int(k), total))
+        merge = self._merge_kernel(len(outs))
+        # the merge charges the MERGE device's lane on top of the per-shard
+        # legs already charged — a sharded frame bills every lane it rides
+        with self._merge_lane_gate(dest, nq * total):
+            dist, sid, lidx = merge(tuple(dists), tuple(idxs), sop, k_out)
+        ioplane.STATS.count_sharded_merge()
+        return dist, sid, lidx, nq, k_out
+
+    def resolve_hits(self, vals) -> Tuple[np.ndarray, np.ndarray]:
+        """(dist, shard, local) host arrays -> (dist, GLOBAL rowids); non-
+        finite / unmapped entries resolve to rowid -1 (callers skip)."""
+        dist = np.asarray(vals[0])
+        sid = np.asarray(vals[1])
+        lidx = np.asarray(vals[2])
+        with self._lock:
+            gmaps = list(self._gmap)
+        glob = np.full(dist.shape, -1, np.int32)
+        finite = np.isfinite(dist)
+        if np.any(finite):
+            for s in np.unique(sid[finite]):
+                m = finite & (sid == s)
+                glob[m] = _gmap_decode(gmaps[int(s)], lidx[m])
+        return dist, glob
+
+    def knn_host(self, queries: np.ndarray, k: int,
+                 allowed_rows: Optional[np.ndarray] = None,
+                 nprobe: Optional[int] = None):
+        """Disarmed reference: the SAME per-shard legs (each shard's own
+        ``knn_host`` — same IVF index, same tie-breaks), concatenated in
+        the same ascending-shard order, merged by one stable argsort —
+        mirrors the device merge position for position."""
+        q = np.ascontiguousarray(queries, np.float32).reshape(
+            -1, self.spec.dim
+        )
+        legs = self._legs(allowed_rows)
+        if not legs:
+            return None
+        outs = []
+        for s, al in legs:
+            o = self.shards[s].knn_host(q, k, allowed_rows=al, nprobe=nprobe)
+            if o is not None:
+                outs.append((s, o))
+        if not outs:
+            return None
+        with self._lock:
+            gmaps = list(self._gmap)
+        dist_cat = np.concatenate([o[0] for _s, o in outs], axis=1)
+        # decode through the SAME guarded gmap lookup as resolve_hits: an
+        # IVF shard leg's top-k may carry padding-sentinel candidates
+        # (probed cells holding fewer than k live rows — common once rows
+        # split n ways), whose +inf dist the caller drops but whose raw
+        # index must never dereference the gmap
+        glob_cat = np.concatenate(
+            [_gmap_decode(gmaps[s], o[1]) for s, o in outs], axis=1
+        )
+        k_out = max(1, min(int(k), dist_cat.shape[1]))
+        order = np.argsort(dist_cat, axis=1, kind="stable")[:, :k_out]
+        top = np.take_along_axis(dist_cat, order, axis=1)
+        idx = np.take_along_axis(glob_cat, order, axis=1)
+        return (
+            top.astype(np.float32), idx.astype(np.int32), q.shape[0], k_out
+        )
+
+    def pair_scores(self, q: np.ndarray, qis: np.ndarray,
+                    rowids: np.ndarray) -> np.ndarray:
+        """The canonical reply-score routine over the SHARD mirrors: global
+        rowids gather their dequantized rows shard by shard, then the one
+        shared per-pair reduction — identical bits to a plain bank holding
+        the same rows."""
+        rid = np.asarray(rowids, np.int64).reshape(-1)
+        with self._lock:
+            rs = self._route[rid]
+            ls = self._local[rid]
+        rows = np.zeros((rid.shape[0], self.spec.dim), np.float32)
+        for s in np.unique(rs):
+            if s < 0:  # pragma: no cover — winners are always routed
+                continue
+            m = rs == s
+            sh = self.shards[int(s)]
+            with sh._lock:
+                rows[m] = sh._host[ls[m]]
+        qs = np.ascontiguousarray(q, np.float32)[np.asarray(qis, np.int64)]
+        return _pair_score_math(rows, qs, self.spec.metric)
+
+
 class VectorPlane:
     """Per-index vector fields: field -> EmbeddingBank sharing the index's
     doc rowid space (the numeric plane's row discipline)."""
@@ -1154,8 +1764,17 @@ class VectorPlane:
                  specs: Dict[str, VectorFieldSpec],
                  block: int = DEFAULT_BLOCK, reset: bool = True):
         self.index = index
-        self.banks: Dict[str, EmbeddingBank] = {
-            f: EmbeddingBank(engine, index, spec, block=block, reset=reset)
+        # SHARDS 1 constructs the plain single-record bank — the sharded
+        # facade never sits in that path, so SHARDS=1 replies are the
+        # unsharded plane's replies byte for byte (ISSUE 15 acceptance)
+        self.banks: Dict[str, Any] = {
+            f: (
+                ShardedEmbeddingBank(engine, index, spec, block=block,
+                                     reset=reset)
+                if spec.shards > 1
+                else EmbeddingBank(engine, index, spec, block=block,
+                                   reset=reset)
+            )
             for f, spec in specs.items()
         }
 
@@ -1190,6 +1809,20 @@ class VectorPlane:
     def h2d_flushes(self) -> int:
         return sum(b.h2d_flushes for b in self.banks.values())
 
+    def device_bytes_by_device(self) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for b in self.banks.values():
+            for d, v in b.device_bytes_by_device().items():
+                out[d] = out.get(d, 0) + v
+        return out
+
+    def index_bytes_by_device(self) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for b in self.banks.values():
+            for d, v in b.index_bytes_by_device().items():
+                out[d] = out.get(d, 0) + v
+        return out
+
     def info_rows(self) -> List[Dict[str, Any]]:
         out = []
         for f, b in self.banks.items():
@@ -1204,5 +1837,8 @@ class VectorPlane:
                     "trained": b.ivf_ready(),
                     "index_device_bytes": b.index_device_bytes(),
                 })
+            if isinstance(b, ShardedEmbeddingBank):
+                row["shards"] = b.spec.shards
+                row["shard_rows"] = b.shard_rows()
             out.append(row)
         return out

@@ -75,6 +75,7 @@ class MetricsRegistry:
     def __init__(self):
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
+        self._multi_gauges: Dict[str, Callable[[], Dict[str, float]]] = {}
         self._timers: Dict[str, Timer] = {}
         self._lock = threading.Lock()
 
@@ -89,6 +90,15 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = Gauge(fn)
 
+    def multi_gauge(self, key: str, fn: Callable[[], Dict[str, float]]) -> None:
+        """Register a LABELED gauge family: `fn` returns {row_name: value}
+        and every row lands in the snapshot verbatim.  This is how dynamic
+        label sets (per-device HBM-ledger rows, ISSUE 15) ride the scrape —
+        rows appear/disappear with the resource, so a torn-down shard's
+        row vanishes instead of sticking at its last value."""
+        with self._lock:
+            self._multi_gauges[key] = fn
+
     def timer(self, name: str) -> Timer:
         with self._lock:
             t = self._timers.get(name)
@@ -101,6 +111,7 @@ class MetricsRegistry:
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
+            multi = dict(self._multi_gauges)
             timers = dict(self._timers)
         for name, c in counters.items():
             out[name] = c.value
@@ -108,6 +119,12 @@ class MetricsRegistry:
             try:
                 out[name] = float(g.fn())
             except Exception:  # noqa: BLE001 — a broken gauge must not kill scrape
+                continue
+        for _key, fn in multi.items():
+            try:
+                for name, v in fn().items():
+                    out[name] = float(v)
+            except Exception:  # noqa: BLE001 — same scrape-safety contract
                 continue
         for name, t in timers.items():
             out[f"{name}_count"] = t.count

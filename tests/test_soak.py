@@ -152,3 +152,23 @@ def test_vector_soak_knn_under_rebalance_and_ingest():
     assert report.recall_at_k >= 0.99
     assert report.invalidations > 0            # the ingest stream was seen
     assert report.writes_acked > 0 and report.reads > 0
+
+
+@pytest.mark.slow
+def test_vector_soak_sharded_constellation():
+    """The ISSUE 15 soak leg: the soaked index is MESH-SHARDED (SHARDS 3)
+    — concurrent ingest + KNN readers while the shard-record constellation
+    rebalances 8 -> 4 -> 8; the harness additionally asserts the
+    cross-shard merges stayed on device (host_colocations unmoved,
+    sharded_knn_merges > 0), zero stale tracked reads, post-storm
+    recall@k >= 0.99, and every per-device census row flat after
+    FT.DROPINDEX."""
+    from redisson_tpu.chaos.soak import VectorSoakConfig, VectorSoakHarness
+
+    report = VectorSoakHarness(
+        VectorSoakConfig(cycles=1, seed=7, shards=3)
+    ).run()
+    assert report.cycles_completed == 1
+    assert report.stale_results == 0
+    assert report.recall_at_k >= 0.99
+    assert report.writes_acked > 0 and report.reads > 0

@@ -1,0 +1,497 @@
+"""Mesh-sharded embedding banks (ISSUE 15): SHARDS n splits one FT VECTOR
+bank row-wise across the local device mesh.
+
+Contracts pinned here:
+  * sharded FLAT KNN is EXACT vs a brute-force oracle, and shard rows stay
+    balanced (least-full routing) on distinct devices;
+  * armed (fan-out legs + on-device merge) and disarmed (NumPy mirror of
+    the same shard legs) replies are IDENTICAL for every
+    shards x {FLAT, IVF} x {FLOAT32, FLOAT16, INT8} cell;
+  * SHARDS=1 constructs the plain single-record bank — replies identical
+    to an index created without the attribute at all;
+  * the cross-shard merge is ON DEVICE: sharded_knn_merges moves,
+    host_colocations does not;
+  * the manifest + shard records exist under shard-salted hashtags, the
+    per-device census rows report each shard's residency, and
+    FT.DROPINDEX tears the whole constellation down;
+  * the per-bank device-bytes budget (HBM-ledger brick) refuses an
+    unsharded over-budget corpus and serves it sharded;
+  * IVF_CELL_IMBALANCE / IVF_CELL_CAP_MAX are LIVE knobs (setter + wire
+    CONFIG SET) — no code edit for the chip-run gather sweep;
+  * Engine.prewarm compiles the sharded KNN programs and a 4->8->4 mesh
+    reshard re-enters MeshManager's warm pool with 0 rebuilds;
+  * perf_gate carries the config7s rows (relative qps gate + recall and
+    speedup floors binding from first sight).
+"""
+import numpy as np
+import pytest
+
+from redisson_tpu.core.engine import Engine
+from redisson_tpu.net.client import Connection
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.server import ServerThread
+from redisson_tpu.services import vector as V
+from redisson_tpu.services.search import Range, SearchService
+
+
+@pytest.fixture()
+def svc():
+    """Placement-enabled embedded service: shard records land on distinct
+    forced-host devices exactly as they would on a v5e-8 slice."""
+    eng = Engine()
+    eng.enable_placement()
+    return SearchService(eng)
+
+
+def _force(dev, finish):
+    if dev is None:
+        return finish(None)
+    return finish(tuple(np.asarray(v) for v in dev))
+
+
+def _clustered(n, dim, n_clusters, seed, spread=0.25):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_clusters, dim)).astype(np.float32)
+    vecs = (
+        centers[rng.integers(n_clusters, size=n)]
+        + spread * rng.standard_normal((n, dim))
+    ).astype(np.float32)
+    return vecs, rng
+
+
+def _mk_sharded(svc, name="shx", n=240, dim=8, shards=4, seed=0,
+                extra_spec=None, schema_extra=None):
+    spec = {"dim": dim, "metric": "L2", "shards": shards}
+    spec.update(extra_spec or {})
+    schema = {"price": "NUMERIC", "emb": "VECTOR"}
+    schema.update(schema_extra or {})
+    svc.create_index(name, schema, vector={"emb": spec})
+    rng = np.random.default_rng(seed)
+    vecs = rng.standard_normal((n, dim)).astype(np.float32)
+    for i in range(n):
+        svc.add_document(name, f"d{i}", {"price": i, "emb": vecs[i]})
+    return vecs
+
+
+# -- embedded: exactness, routing, merge discipline ---------------------------
+
+
+def test_sharded_exact_vs_bruteforce_balanced_distinct_devices(svc):
+    vecs = _mk_sharded(svc, n=300, dim=8, shards=4, seed=3)
+    bank = svc._idx("shx").vectors.banks["emb"]
+    assert isinstance(bank, V.ShardedEmbeddingBank)
+    # least-full routing keeps shard populations within one row
+    rows = [sh.rows for sh in bank.shards]
+    assert max(rows) - min(rows) <= 1, rows
+    q = np.random.default_rng(7).standard_normal((3, 8)).astype(np.float32)
+    got = _force(*svc.knn("shx", "emb", q, 10))
+    d64 = np.sum(
+        (vecs.astype(np.float64)[None] - q.astype(np.float64)[:, None]) ** 2,
+        axis=2,
+    )
+    for qi in range(3):
+        truth = [f"d{i}" for i in np.argsort(d64[qi], kind="stable")[:10]]
+        assert [d for d, _s in got[qi]] == truth
+    # each shard's planes sit on its own device (the HBM-scaling point)
+    devs = [sh.owner_device_id() for sh in bank.shards]
+    assert len(set(devs)) == len(devs), devs
+
+
+def test_sharded_merge_on_device_never_host(svc):
+    from redisson_tpu.core import ioplane
+
+    _mk_sharded(svc, name="shm", n=200, dim=8, shards=3, seed=5)
+    before = ioplane.STATS.snapshot()
+    q = np.ones(8, np.float32)
+    res = _force(*svc.knn("shm", "emb", q, 5))[0]
+    assert len(res) == 5
+    after = ioplane.STATS.snapshot()
+    assert after["sharded_knn_merges"] > before["sharded_knn_merges"]
+    assert after["host_colocations"] == before["host_colocations"]
+
+
+def test_sharded_update_delete_and_prefilter(svc):
+    vecs = _mk_sharded(svc, name="shu", n=120, dim=8, shards=3, seed=11)
+    target = vecs[3] + 0.001
+    top = _force(*svc.knn("shu", "emb", target, 1))[0]
+    assert top[0][0] == "d3"
+    # overwrite d3 far away: same global rowid, same shard slot, new value
+    svc.add_document("shu", "d3", {"price": 3, "emb": vecs[3] + 100.0})
+    top = _force(*svc.knn("shu", "emb", target, 1))[0]
+    assert top[0][0] != "d3"
+    winner = top[0][0]
+    svc.remove_document("shu", winner)
+    res = _force(*svc.knn("shu", "emb", target, 30))[0]
+    assert winner not in [d for d, _s in res]
+    # hybrid prefilter: only allowed rows may appear, across every shard
+    res = _force(*svc.knn("shu", "emb", target, 10,
+                          condition=Range("price", hi=39.5)))[0]
+    assert res and all(int(d[1:]) <= 39 for d, _s in res)
+    # a prefilter matching nothing dispatches nothing
+    dev, fin = svc.knn("shu", "emb", target, 5,
+                       condition=Range("price", lo=1e9))
+    assert dev is None and fin(None) == [[]]
+
+
+@pytest.mark.parametrize("algo", ["FLAT", "IVF"])
+@pytest.mark.parametrize("dtype", ["FLOAT32", "FLOAT16", "INT8"])
+def test_sharded_armed_disarmed_identical_all_cells(svc, algo, dtype):
+    """Reply identity for every shards x algo x dtype cell (ISSUE 15
+    acceptance): the disarmed path mirrors the SAME shard legs + concat
+    order, and scores come from the one canonical pair routine."""
+    vecs, rng = _clustered(420, 12, 8, seed=21)
+    spec = {"dim": 12, "metric": "L2", "algo": algo, "dtype": dtype,
+            "shards": 3}
+    if algo == "IVF":
+        spec.update(nlist=6, nprobe=3, train_min=64)
+    svc.create_index("cell", {"emb": "VECTOR"}, vector={"emb": spec})
+    for i, v in enumerate(vecs):
+        svc.add_document("cell", f"d{i}", {"emb": v})
+    queries = (vecs[rng.integers(420, size=4)]
+               + 0.03 * rng.standard_normal((4, 12))).astype(np.float32)
+    armed = _force(*svc.knn("cell", "emb", queries, 7))
+    prev = V.set_vector(False)
+    try:
+        dev, fin = svc.knn("cell", "emb", queries, 7)
+        assert dev is None
+        disarmed = fin(None)
+    finally:
+        V.set_vector(prev)
+    assert armed == disarmed
+    svc.drop_index("cell")
+
+
+def test_sharded_ivf_sparse_cells_disarmed_no_crash(svc):
+    """Regression: an IVF shard leg's top-k carries padding-sentinel
+    candidates once probed cells hold fewer than k live rows (rows split n
+    ways make that common) — the disarmed path must mask them through the
+    same guarded gmap decode as the armed path, not IndexError on the
+    sentinel."""
+    vecs, rng = _clustered(200, 8, 6, seed=77)
+    svc.create_index("sparse", {"emb": "VECTOR"},
+                     vector={"emb": {"dim": 8, "metric": "L2",
+                                     "algo": "IVF", "nlist": 6,
+                                     "nprobe": 1, "train_min": 24,
+                                     "shards": 4}})
+    for i, v in enumerate(vecs):
+        svc.add_document("sparse", f"d{i}", {"emb": v})
+    q = vecs[rng.integers(200, size=3)].astype(np.float32)
+    armed = _force(*svc.knn("sparse", "emb", q, 10))
+    prev = V.set_vector(False)
+    try:
+        dev, fin = svc.knn("sparse", "emb", q, 10)
+        assert dev is None
+        disarmed = fin(None)
+    finally:
+        V.set_vector(prev)
+    assert armed == disarmed
+    assert all(hits for hits in armed)
+    svc.drop_index("sparse")
+
+
+def test_shards_zero_rejected(svc):
+    with pytest.raises(ValueError):
+        svc.create_index("z0", {"emb": "VECTOR"},
+                         vector={"emb": {"dim": 8, "shards": 0}})
+
+
+def test_shards_one_is_the_plain_bank(svc):
+    """SHARDS=1 never constructs the facade — replies are the unsharded
+    plane's replies, identically."""
+    rng = np.random.default_rng(9)
+    vecs = rng.standard_normal((80, 8)).astype(np.float32)
+    for name, spec in (
+        ("s1", {"dim": 8, "metric": "L2", "shards": 1}),
+        ("s0", {"dim": 8, "metric": "L2"}),
+    ):
+        svc.create_index(name, {"emb": "VECTOR"}, vector={"emb": spec})
+        for i, v in enumerate(vecs):
+            svc.add_document(name, f"d{i}", {"emb": v})
+    b1 = svc._idx("s1").vectors.banks["emb"]
+    assert isinstance(b1, V.EmbeddingBank)
+    assert not isinstance(b1, V.ShardedEmbeddingBank)
+    q = rng.standard_normal((2, 8)).astype(np.float32)
+    assert _force(*svc.knn("s1", "emb", q, 6)) == _force(
+        *svc.knn("s0", "emb", q, 6)
+    )
+
+
+def test_sharded_records_census_and_drop(svc):
+    _mk_sharded(svc, name="shc", n=160, dim=8, shards=4, seed=31)
+    eng = svc._engine
+    manifest = eng.store.get(V.bank_record_name("shc", "emb"))
+    assert manifest is not None and manifest.kind == "vector_bank_manifest"
+    names = manifest.meta["shard_names"]
+    assert len(names) == 4
+    for nm in names:
+        rec = eng.store.get(nm)
+        assert rec is not None and rec.kind == "vector_bank"
+    # flush (query) then the per-device ledger rows must cover 4 devices
+    _force(*svc.knn("shc", "emb", np.ones(8, np.float32), 3))
+    census = svc.device_census()
+    dev_rows = {k: v for k, v in census.items()
+                if k.startswith("ftvec_device_bytes_dev")}
+    assert len(dev_rows) == 4 and all(v > 0 for v in dev_rows.values())
+    assert sum(dev_rows.values()) == census["ftvec_device_bytes"] > 0
+    # DROPINDEX releases every shard + the manifest; all rows vanish
+    assert svc.drop_index("shc")
+    assert eng.store.get(V.bank_record_name("shc", "emb")) is None
+    for nm in names:
+        assert eng.store.get(nm) is None
+    census = svc.device_census()
+    assert census["ftvec_device_bytes"] == 0.0
+    assert not any("bytes_dev" in k for k in census)
+
+
+def test_budget_refuses_unsharded_serves_sharded(svc):
+    """The HBM-ledger brick: a per-bank device-bytes budget below the
+    corpus's single-bank footprint refuses the unsharded ingest
+    (VectorBudgetError, rows kept pending — nothing lost) while the same
+    corpus sharded fits and serves."""
+    rng = np.random.default_rng(41)
+    n, dim = 600, 16
+    vecs = rng.standard_normal((n, dim)).astype(np.float32)
+    cap = 1 << (n - 1).bit_length()
+    budget = V.DeviceRowBank(dim)._projected_device_bytes(cap) // 2
+    prev = V.set_device_bytes_budget(budget)
+    try:
+        svc.create_index("cap1", {"emb": "VECTOR"},
+                         vector={"emb": {"dim": dim, "metric": "L2"}})
+        with pytest.raises(V.VectorBudgetError):
+            for i in range(n):
+                svc.add_document("cap1", f"d{i}", {"emb": vecs[i]})
+            _force(*svc.knn("cap1", "emb", vecs[0], 1))
+        svc.drop_index("cap1")
+        svc.create_index("cap4", {"emb": "VECTOR"},
+                         vector={"emb": {"dim": dim, "metric": "L2",
+                                         "shards": 4}})
+        for i in range(n):
+            svc.add_document("cap4", f"d{i}", {"emb": vecs[i]})
+        got = _force(*svc.knn("cap4", "emb", vecs[7], 1))[0]
+        assert got[0][0] == "d7"
+        svc.drop_index("cap4")
+    finally:
+        V.set_device_bytes_budget(prev)
+
+
+def test_ivf_gather_knobs_are_live(svc):
+    """IVF_CELL_IMBALANCE / IVF_CELL_CAP_MAX re-read at every cell
+    rebuild: a live SET changes cell_cap with no code edit (the chip-run
+    gather-bandwidth sweep)."""
+    vecs, _rng = _clustered(480, 8, 6, seed=51)
+    svc.create_index("knob", {"emb": "VECTOR"},
+                     vector={"emb": {"dim": 8, "metric": "L2",
+                                     "algo": "IVF", "nlist": 6,
+                                     "nprobe": 3, "train_min": 128}})
+    for i, v in enumerate(vecs):
+        svc.add_document("knob", f"d{i}", {"emb": v})
+    bank = svc._idx("knob").vectors.banks["emb"]
+    _force(*svc.knn("knob", "emb", vecs[0], 3))
+    base_cap = bank._ivf.cell_cap
+    assert base_cap > 4
+    prev_imb = V.set_ivf_cell_imbalance(8.0)
+    prev_max = V.set_ivf_cell_cap_max(0)
+    try:
+        bank.retrain()
+        wide = bank._ivf.cell_cap
+        assert wide > base_cap, (base_cap, wide)
+        # the gather-width ceiling binds over whatever imbalance allows
+        V.set_ivf_cell_cap_max(8)
+        bank.retrain()
+        assert bank._ivf.cell_cap <= 8
+    finally:
+        V.set_ivf_cell_imbalance(prev_imb)
+        V.set_ivf_cell_cap_max(prev_max)
+        svc.drop_index("knob")
+
+
+def test_mesh_warm_pool_sharded_knn_survives_reshard(svc):
+    """Engine.prewarm compiles the per-shard + merge programs; a 4->8->4
+    geometry round trip re-enters MeshManager's cross-epoch pool with 0
+    rebuilds and returns the SAME jit instance."""
+    from redisson_tpu.parallel.manager import MeshManager
+
+    _mk_sharded(svc, name="shw", n=120, dim=8, shards=4, seed=61)
+    _force(*svc.knn("shw", "emb", np.ones(8, np.float32), 3))  # flush
+    eng = svc._engine
+    mm = MeshManager.of(eng)
+    warmed = eng.prewarm(all_devices=False)
+    assert warmed > 0
+    assert eng.prewarm(all_devices=False) == 0  # everything already warm
+    builds = mm.kernel_builds  # prewarm built for the default 8-dev mesh
+    mm.reshard(1, 4)
+    k4a = mm.knn_merge_kernel(4)  # NEW geometry: exactly one build
+    assert mm.kernel_builds == builds + 1
+    mm.reshard(1, 8)
+    mm.knn_merge_kernel(4)  # back on the PREWARMED geometry: 0 rebuilds
+    assert mm.kernel_builds == builds + 1
+    mm.reshard(1, 4)
+    k4b = mm.knn_merge_kernel(4)  # 4->8->4 round trip: 0 rebuilds, same fn
+    assert mm.kernel_builds == builds + 1
+    assert k4b is k4a
+
+
+# -- wire surface -------------------------------------------------------------
+
+
+@pytest.fixture()
+def server8():
+    """Device-sharded server: placement over every forced host device, the
+    shape one tpu-server owns on a v5e-8 slice."""
+    with ServerThread(port=0, devices="all", workers=4) as st:
+        yield st
+
+
+def _conn(st):
+    return Connection(st.server.host, st.server.port, timeout=30.0)
+
+
+def _wire_setup_sharded(c, idx="swire", prefix="sw:", n=160, dim=8,
+                        shards=4, seed=71):
+    r = c.execute(
+        "FT.CREATE", idx, "ON", "HASH", "PREFIX", "1", prefix,
+        "SCHEMA", "price", "NUMERIC",
+        "emb", "VECTOR", "FLAT", "8", "TYPE", "FLOAT32",
+        "DIM", str(dim), "DISTANCE_METRIC", "L2",
+        "SHARDS", str(shards),
+    )
+    assert r == b"OK", r
+    rng = np.random.default_rng(seed)
+    vecs = rng.standard_normal((n, dim)).astype(np.float32)
+    for i in range(n):
+        c.execute("HSET", f"{prefix}{i}", "price", str(i),
+                  "emb", vecs[i].tobytes())
+    return vecs
+
+
+def test_wire_sharded_search_and_armed_disarmed_identical(server8):
+    c = _conn(server8)
+    vecs = _wire_setup_sharded(c)
+    q = (vecs[9] + 0.01).astype(np.float32)
+    args = ("FT.SEARCH", "swire", "(@price:[2 150])=>[KNN 6 @emb $v]",
+            "PARAMS", "2", "v", q.tobytes())
+    armed = c.execute(*args)
+    assert armed[0] == 6 and bytes(armed[1]) == b"sw:9"
+    prev = V.set_vector(False)
+    try:
+        disarmed = c.execute(*args)
+    finally:
+        V.set_vector(prev)
+    assert armed == disarmed  # byte-identical wire reply, device path off
+    # batched FT.MSEARCH rides the same fan-out + merge
+    blob = np.concatenate([vecs[3], vecs[17]]).astype(np.float32).tobytes()
+    out = c.execute("FT.MSEARCH", "swire", "(*)=>[KNN 3 @emb $v]",
+                    "PARAMS", "2", "v", blob)
+    assert out[0] == 2
+    assert bytes(out[1][0]) == b"sw:3" and bytes(out[2][0]) == b"sw:17"
+    c.close()
+
+
+def test_wire_sharded_ft_info_and_device_gauges(server8):
+    c = _conn(server8)
+    _wire_setup_sharded(c, idx="sinfo", prefix="si:", n=96, shards=3)
+    c.execute("FT.SEARCH", "sinfo", "(*)=>[KNN 2 @emb $v]",
+              "PARAMS", "2", "v", np.ones(8, np.float32).tobytes())
+    info = c.execute("FT.INFO", "sinfo")
+    d = {bytes(info[i]): info[i + 1] for i in range(0, len(info), 2)}
+    attr = [row for row in d[b"attributes"] if bytes(row[0]) == b"emb"][0]
+    a = {bytes(attr[i]): attr[i + 1] for i in range(1, len(attr), 2)}
+    assert a[b"shards"] == 3
+    shard_rows = a[b"shard_rows"]
+    assert len(shard_rows) == 3
+    rows_total = 0
+    devices = set()
+    for sr in shard_rows:
+        m = {bytes(sr[i]): sr[i + 1] for i in range(0, len(sr), 2)}
+        rows_total += m[b"rows"]
+        devices.add(m[b"device"])
+        assert m[b"device_bytes"] > 0
+    assert rows_total == 96 and len(devices) == 3
+    # per-device gauge labels on the metrics scrape, zeroed by DROPINDEX
+    mets = server8.server.metrics.snapshot()
+    dev_rows = {k: v for k, v in mets.items()
+                if k.startswith("ftvec_device_bytes_dev")}
+    assert len(dev_rows) == 3 and all(v > 0 for v in dev_rows.values())
+    assert c.execute("FT.DROPINDEX", "sinfo") == b"OK"
+    mets = server8.server.metrics.snapshot()
+    assert mets["ftvec_device_bytes"] == 0.0
+    assert not any(k.startswith("ftvec_device_bytes_dev") for k in mets)
+    c.close()
+
+
+def test_wire_config_knobs_roundtrip(server8):
+    c = _conn(server8)
+    for key, good, shown in (
+        ("ivf-cell-imbalance", "5.0", b"5.0"),
+        ("ivf-cell-cap-max", "64", b"64"),
+        ("ftvec-device-budget", "1048576", b"1048576"),
+    ):
+        try:
+            assert c.execute("CONFIG", "SET", key, good) == b"OK"
+            got = c.execute("CONFIG", "GET", key)
+            assert got[0] == key.encode() and bytes(got[1]) == shown, got
+        finally:
+            # restore defaults so later tests see the module defaults
+            default = {"ivf-cell-imbalance": "3", "ivf-cell-cap-max": "0",
+                       "ftvec-device-budget": "0"}[key]
+            c.execute("CONFIG", "SET", key, default)
+    r = c.execute("CONFIG", "SET", "ivf-cell-imbalance", "0.5")
+    assert isinstance(r, RespError)  # below 1x mean occupancy: rejected
+    r = c.execute("CONFIG", "SET", "ftvec-device-budget", "-3")
+    assert isinstance(r, RespError)
+    c.close()
+
+
+def test_wire_sharded_create_rejects_bad_shards(server8):
+    c = _conn(server8)
+    for bad in ("-2", "0"):
+        r = c.execute(
+            "FT.CREATE", "badsh", "ON", "HASH", "SCHEMA",
+            "emb", "VECTOR", "FLAT", "8", "TYPE", "FLOAT32",
+            "DIM", "8", "DISTANCE_METRIC", "L2", "SHARDS", bad,
+        )
+        assert isinstance(r, RespError), bad
+    c.close()
+
+
+# -- perf gate rows (config7s) ------------------------------------------------
+
+
+def test_perf_gate_config7_sharded_rows():
+    """ISSUE 15 gate rows: sharded qps relative-gated; sharded recall
+    >= 0.99 and speedup-vs-1shard >= 1.5x floors bind from FIRST sight."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "perf_gate.py"),
+    )
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    def doc(**d):
+        base = {"config7_sharded_knn_qps": 4000.0,
+                "config7_sharded_recall_at_10": 1.0,
+                "config7_sharded_speedup_vs_1shard": 3.2}
+        base.update(d)
+        return {"metric": "x", "value": 1000.0, "details": base}
+
+    empty = {"metric": "x", "value": 1000.0}
+    rows, ok = pg.compare(empty, doc(), 0.05)
+    assert ok, rows
+    for bad, needle in [
+        (dict(config7_sharded_recall_at_10=0.9), "sharded recall"),
+        (dict(config7_sharded_speedup_vs_1shard=1.1), "sharded speedup"),
+    ]:
+        rows, ok = pg.compare(empty, doc(**bad), 0.05)
+        assert not ok, bad
+        assert any(needle in r[0] and r[4] == "FAIL" for r in rows), (
+            bad, rows,
+        )
+    rows, ok = pg.compare(doc(), doc(config7_sharded_knn_qps=3000.0), 0.05)
+    assert not ok
+    assert any("sharded knn qps" in r[0] and r[4] == "FAIL" for r in rows)
+    rows, ok = pg.compare(doc(), doc(), 0.05)
+    assert ok, rows

@@ -52,7 +52,12 @@
     across devices under transport faults.  Asserts zero stale tracked
     KNN results, zero acked-ingest loss, post-storm recall@k >= 0.99 vs a
     float64 brute-force oracle, and a flat embedding-bank census after
-    FT.DROPINDEX.
+    FT.DROPINDEX.  ``--shards n`` (> 1) runs the MESH-SHARDED leg
+    (ISSUE 15): the bank splits across n shard records on distinct
+    devices, reads exercise the fan-out + on-device top-k merge while the
+    constellation rebalances, and the run additionally asserts
+    host_colocations unmoved (never a host gather) with
+    sharded_knn_merges > 0 and per-device census rows flat.
   * ``tracking`` — the near-cache coherence profile (ISSUE 7): zipf
     readers with server-assisted near caches (CLIENT TRACKING) keep
     reading while key-bearing slots migrate m0 -> m1 -> m0 and the
@@ -95,6 +100,10 @@ def main() -> int:
                     help="seconds of workload per phase (standard profile)")
     ap.add_argument("--no-kill", action="store_true",
                     help="standard profile: workload + reshard only")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="vector profile: SHARDS for the soaked index — "
+                         "> 1 runs the mesh-sharded leg (ISSUE 15: fan-out "
+                         "legs + on-device merge under rebalance)")
     args = ap.parse_args()
 
     import jax
@@ -105,7 +114,7 @@ def main() -> int:
         from redisson_tpu.chaos.soak import VectorSoakConfig, VectorSoakHarness
 
         harness = VectorSoakHarness(VectorSoakConfig(
-            cycles=args.cycles, seed=args.seed,
+            cycles=args.cycles, seed=args.seed, shards=args.shards,
         ))
     elif args.profile == "qos":
         from redisson_tpu.chaos.soak import QosSoakConfig, QosSoakHarness
