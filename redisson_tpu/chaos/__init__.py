@@ -33,6 +33,12 @@ from redisson_tpu.chaos.soak import (
     ClusterProcSoakConfig,
     ClusterProcSoakHarness,
     ClusterProcSoakReport,
+    FleetSoakConfig,
+    FleetSoakHarness,
+    FleetSoakReport,
+    HostFleetSoakConfig,
+    HostFleetSoakHarness,
+    HostFleetSoakReport,
     MigrationSoakConfig,
     MigrationSoakHarness,
     MigrationSoakReport,
@@ -48,6 +54,12 @@ __all__ = [
     "Fault",
     "FaultPlane",
     "FaultSchedule",
+    "FleetSoakConfig",
+    "FleetSoakHarness",
+    "FleetSoakReport",
+    "HostFleetSoakConfig",
+    "HostFleetSoakHarness",
+    "HostFleetSoakReport",
     "MigrationSoakConfig",
     "MigrationSoakHarness",
     "MigrationSoakReport",

@@ -75,6 +75,7 @@ class ResourceCensus:
         def probe() -> Dict[str, float]:
             out = {
                 "repl_staged_xfers": len(getattr(server, "_repl_xfers", {})),
+                "repl_snap_stages": len(getattr(server, "_snap_stages", {})),
                 "connections": server.stats["connections"],
                 "repl_baselines": 0,
                 "repl_replicas": 0,

@@ -1315,6 +1315,207 @@ class FleetSoakHarness(ClusterProcSoakHarness):
             self._teardown()
 
 
+# -- cross-host fleet profile (ISSUE 16) --------------------------------------
+
+@dataclass
+class HostFleetSoakConfig(FleetSoakConfig):
+    """The failure-DOMAIN profile: the fleet spans host labels under the
+    REAL ssh driver pipeline (loopback transport — no sshd in CI),
+    placement is host-anti-affine, the bus is TLS-armed, and the storm
+    takes out a whole HOST — every process on it at once — while the
+    network to that host partitions mid-drain."""
+    hosts: Tuple[str, ...] = ("hostA", "hostB")
+    crash_phases: Tuple[str, ...] = ("DRAINING:1",)
+    # the host kill IS this profile's storm and failover; the
+    # single-process roll/promote/live-kill legs stay with the plain
+    # fleet profile
+    roll_scope: str = "none"
+    promote: bool = False
+    live_kill: bool = False
+    # partition shape while the host is dark: a couple of swallowed
+    # in-flight frames (the wire died mid-send) + a refused-connect
+    # window (new dials to an unreachable machine fail fast for a dialer
+    # with a deadline — and keep the <60s smoke budget honest, unlike a
+    # swallow that parks the writer on its full reply timeout)
+    partition_sends: int = 2
+    partition_connects: int = 32
+
+
+@dataclass
+class HostFleetSoakReport(FleetSoakReport):
+    host_kills: int = 0
+    hosts_partitioned: int = 0
+
+    def summary(self) -> str:
+        return (
+            super().summary()
+            + f"; host: {self.host_kills} whole-host kills "
+              f"({self.hosts_partitioned} partitioned mid-drain)"
+        )
+
+
+class HostFleetSoakHarness(FleetSoakHarness):
+    """Whole-host chaos (ISSUE 16): two masters + their replicas placed
+    across two HOST labels with anti-affinity (a replica never shares its
+    master's failure domain), spawned through the real
+    :class:`~redisson_tpu.cluster.hostdriver.SshHostDriver` command
+    pipeline (remote-spawn script, READY over the channel, signals by
+    remote kill) with the loopback transport standing in for the ssh hop,
+    and the cross-host bus TLS-armed by the supervisor exactly as a real
+    fleet would be.  A mixed write stream runs over real (TLS) TCP while,
+    per cycle:
+
+      1. a journaled migration is crashed mid-drain (coordinator dead,
+         journal frozen at ``DRAINING:1``);
+      2. the import TARGET's whole host dies AT ONCE (``kill_host`` —
+         the target master AND the other master's replica share it) and
+         the network to that host partitions (swallowed frames + refused
+         dials) while it is dark;
+      3. the partition heals, the processes stay dead, and recovery runs
+         in dependency order: the surviving master's replica restarts and
+         re-wires; the dead target fails over onto its OFF-host replica
+         (``promote_replica`` — alive precisely because placement was
+         anti-affine); the import resumes READDRESSED to the promoted
+         node; the old target rejoins as a replica of its successor.
+
+    Each cycle ends with the full sweep: zero acked-durable-write loss,
+    exactly-one-owner residency, all slots STABLE with journals terminal,
+    acked bloom adds intact, flat client census.
+
+    Runs via ``python tools/soak_smoke.py --profile fleet-host`` (<60s)
+    or the 2-cycle host-kill matrix in ``tests/test_soak.py``'s slow
+    tier.
+    """
+
+    def __init__(self, config: Optional[HostFleetSoakConfig] = None):
+        super().__init__(config or HostFleetSoakConfig())
+        self.report = HostFleetSoakReport()
+        self._cycle_sched: Optional[FaultSchedule] = None
+
+    def _make_supervisor(self):
+        from redisson_tpu.cluster import ClusterSupervisor
+        from redisson_tpu.cluster.hostdriver import (
+            LoopbackTransport, SshHostDriver,
+        )
+
+        cfg = self.config
+        return ClusterSupervisor(
+            masters=2, replicas_per_master=cfg.replicas_per_master,
+            hosts=list(cfg.hosts),
+            driver=SshHostDriver(transport=LoopbackTransport()),
+            ready_timeout=cfg.ready_timeout,
+            checkpoint_interval=cfg.checkpoint_interval,
+            platform=os.environ.get("RTPU_PROC_PLATFORM", "cpu"),
+        )
+
+    def _setup(self) -> None:
+        super()._setup()
+        sup = self._sup
+        # the properties the storm depends on, asserted up front so a
+        # placement/TLS regression fails HERE and not as a mystery
+        # promotion failure mid-storm
+        assert sup.tls_armed, "cross-host fleet must arm TLS"
+        for rep in sup.replicas:
+            master = sup.masters[rep.master_index]
+            assert rep.host_label != master.host_label, (
+                f"anti-affinity violated: {rep.name}@{rep.host_label} "
+                f"shares {master.name}'s host"
+            )
+
+    def _transport_schedule(self, cycle: int) -> FaultSchedule:
+        # stashed so _storm can graft the host-partition rules onto the
+        # plane the run loop already activated (the matcher reads the
+        # schedule's rule list live)
+        self._cycle_sched = super()._transport_schedule(cycle)
+        return self._cycle_sched
+
+    def _storm(self, cycle: int) -> None:
+        import signal as _signal
+
+        from redisson_tpu.cluster.chaos import crash_coordinator_at
+        from redisson_tpu.server.migration import resume_migrations
+
+        sup = self._sup
+        for phase in self.config.crash_phases:
+            src = sup.masters[self._owner]
+            dst = sup.masters[1 - self._owner]
+            victim_host = dst.host_label
+            victim_ports = tuple(sorted(
+                n.port for n in sup.nodes_on(victim_host)
+            ))
+            self._save_barrier()
+            # the coordinator dies mid-drain, journal frozen at `phase`...
+            crash_coordinator_at(
+                src.address, dst.address, self._slots, sup.journal_dir,
+                phase, password=sup.password,
+                ssl_context=sup.client_ssl_context(),
+            )
+            # ...the target's whole failure domain drops off the network...
+            faults = [
+                self._cycle_sched.add(
+                    "partition_out", ports=victim_ports,
+                    count=self.config.partition_sends,
+                ),
+                self._cycle_sched.add(
+                    "refuse_connect", ports=victim_ports,
+                    count=self.config.partition_connects,
+                ),
+            ]
+            self.report.hosts_partitioned += 1
+            # ...and every process on the host dies at once
+            rcs = sup.kill_host(victim_host, _signal.SIGKILL)
+            self.report.coordinator_kills += 1
+            self.report.host_kills += 1
+            self.report.server_sigkills += len(rcs)
+            assert dst.name in rcs, rcs
+            assert len(rcs) >= 2, (
+                f"host held one process, not a failure domain: {rcs}"
+            )
+            for who, rc in rcs.items():
+                assert rc == -_signal.SIGKILL, \
+                    f"expected SIGKILL death of {who}, got {rc}"
+            time.sleep(0.3)
+            self._void_unsaved_acks()
+            # the partition heals (the network comes back; the processes
+            # stay dead): zero the windows in place — the plane's matcher
+            # reads rule counts live, so recovery links are clean
+            for f in faults:
+                f.count = 0
+            # recovery in dependency order: (1) every CO-victim that died
+            # with the host restarts and re-wires — the other master's
+            # replica, and (after a prior cycle's failover moved mastership
+            # around) possibly the migration SOURCE master itself, which
+            # resume needs alive; (2) the dead target fails over onto its
+            # off-host replica; (3) the journaled import resumes
+            # READDRESSED to the promoted node; (4) the old target rejoins
+            # as a replica of its successor
+            for n in sup.nodes_on(victim_host):
+                if n is not dst:
+                    sup.restart(n)
+                    self.report.restarts += 1
+            promoted = sup.promote_replica(dst)
+            assert promoted is not None, (
+                "anti-affinity left no live replica to promote"
+            )
+            self.report.promotions += 1
+            results = resume_migrations(
+                sup.journal_dir,
+                readdress={dst.address: promoted.address},
+                ssl_context=sup.client_ssl_context(),
+            )
+            assert any(r["action"] == "completed" for r in results), results
+            self.report.resumed_completed += sum(
+                1 for r in results if r["action"] == "completed"
+            )
+            self._owner = 1 - self._owner
+            sup.restart(dst)  # rejoins as a replica of its successor
+            self.report.restarts += 1
+            self._client.refresh_topology()
+            self._assert_slots_stable()
+            self._assert_one_owner()
+            self._verify_durable(sample=8)
+
+
 class MigrationSoakHarness:
     """Kill-the-coordinator endurance: a 2-master cluster serves a mixed
     write stream while journaled slot migrations are murdered at every

@@ -6,11 +6,29 @@ real TCP topology wiring, real signals.
   * :mod:`~redisson_tpu.cluster.topology` — the single slot-assignment +
     SETVIEW program shared with the in-process harness;
   * :mod:`~redisson_tpu.cluster.chaos` — process-chaos primitives
-    (coordinator crash at a journal phase, SIGKILL-at-phase storms).
+    (coordinator crash at a journal phase, SIGKILL-at-phase storms,
+    whole-host kills);
+  * :mod:`~redisson_tpu.cluster.hostdriver` — where node processes RUN
+    (ISSUE 16): :class:`LocalHostDriver` (today's subprocess path),
+    :class:`SshHostDriver` (remote spawn over an ssh channel),
+    :class:`K8sDriver` (pod-spec codegen).
 """
+from redisson_tpu.cluster.hostdriver import (  # noqa: F401
+    HostDriver,
+    K8sDriver,
+    LocalHostDriver,
+    LoopbackTransport,
+    NodeHandle,
+    SshHostDriver,
+    SshTransport,
+)
 from redisson_tpu.cluster.supervisor import (  # noqa: F401
     ClusterSupervisor,
     NodeProc,
     NodeStartupError,
 )
-from redisson_tpu.cluster.topology import split_slots  # noqa: F401
+from redisson_tpu.cluster.topology import (  # noqa: F401
+    PlacementDegraded,
+    assign_hosts,
+    split_slots,
+)

@@ -32,6 +32,7 @@ def crash_coordinator_at(
     journal_dir: str,
     phase: str,
     password: Optional[str] = None,
+    ssl_context=None,
 ) -> None:
     """Start a journaled migration and murder the coordinator right after
     `phase`'s journal entry (``PLANNED``, ``WINDOW_OPEN``,
@@ -44,6 +45,7 @@ def crash_coordinator_at(
         migrate_slots(
             source, target, slots,
             journal_dir=journal_dir, crash_after=phase, password=password,
+            ssl_context=ssl_context,
         )
     except CoordinatorKilled:
         return
@@ -71,7 +73,7 @@ def kill_pair_at_phase(
     ``resume_migrations(readdress=...)`` for the failover path."""
     crash_coordinator_at(
         source_node.address, target_node.address, slots, sup.journal_dir,
-        phase, password=sup.password,
+        phase, password=sup.password, ssl_context=sup.client_ssl_context(),
     )
     out = {}
     if kill_target:
@@ -96,6 +98,7 @@ def sigkill_at_phase(
     the caller's move: ``sup.restart(victim)`` +
     ``resume_migrations(sup.journal_dir)``."""
     crash_coordinator_at(
-        source, target, slots, sup.journal_dir, phase, password=sup.password
+        source, target, slots, sup.journal_dir, phase, password=sup.password,
+        ssl_context=sup.client_ssl_context(),
     )
     return sup.kill(victim, sig)

@@ -24,6 +24,19 @@ ROADMAP names as the only honest production topology:
     the SAME slot-assignment program the in-process harness uses, so the
     two cluster shapes cannot drift.
 
+Cross-HOST fleets (ISSUE 16): WHERE a node runs is a
+:class:`~redisson_tpu.cluster.hostdriver.HostDriver` decision, not the
+supervisor's — :class:`LocalHostDriver` (default) is the historical
+subprocess path byte-for-byte, :class:`SshHostDriver` spawns nodes on
+remote machines with the SAME ready-line/signal/reap contract riding the
+ssh channel, and every node carries a ``host_label`` naming its failure
+domain.  ``hosts=`` activates failure-domain placement
+(:func:`topology.assign_hosts` — a replica never shares its master's
+host), ``kill_host`` takes a whole domain down at once, and a fleet with
+any genuinely remote host arms TLS by default (the supervisor generates a
+fleet cert and injects ``--tls-cert/--tls-key`` into every node; plaintext
+stays the loopback-only default).
+
 The supervisor process doubles as the migration coordinator's home: its
 ``journal_dir`` hosts the write-ahead migration journals
 (server/migration_journal.py), so killing a *server* process mid-migration
@@ -32,19 +45,25 @@ real process boundary — the cross-process soak profile in chaos/soak.py.
 """
 from __future__ import annotations
 
+import ipaddress
 import os
 import select
 import signal
 import subprocess
-import sys
 import tempfile
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from redisson_tpu.cluster import topology
+from redisson_tpu.cluster.hostdriver import (
+    HostDriver, LocalHostDriver, NodeHandle,
+)
 from redisson_tpu.net.client import Connection
 from redisson_tpu.net.resp import RespError
-from redisson_tpu.net.retry import RetryPolicy, call_with_retry
+from redisson_tpu.net.retry import RetryPolicy, call_with_retry, link_policy
+
+#: the implicit single-domain label a host-unaware supervisor places on
+_LOCAL_HOST_LABEL = "local"
 
 
 class NodeStartupError(RuntimeError):
@@ -53,23 +72,26 @@ class NodeStartupError(RuntimeError):
 
 
 class NodeProc:
-    """One supervised server process: identity, liveness, history."""
+    """One supervised server process: identity, liveness, history.  The
+    process itself lives behind a :class:`NodeHandle` — local child or
+    ssh'd remote, the supervisor's contract is the same."""
 
     def __init__(self, name: str, role: str, base_dir: str,
-                 master_index: Optional[int] = None):
+                 master_index: Optional[int] = None,
+                 host_label: str = _LOCAL_HOST_LABEL):
         self.name = name
         self.role = role  # "master" | "replica"
         self.master_index = master_index
         self.base_dir = base_dir
+        self.host_label = host_label  # failure domain (driver-interpreted)
         self.checkpoint_path = os.path.join(base_dir, "ckpt", "head.ckpt")
         self.log_path = os.path.join(base_dir, "server.log")
         self.host = "127.0.0.1"
         self.port = 0            # learned from the first ready line, then pinned
         self.node_id: Optional[str] = None  # CLUSTER MYID (fresh per process)
-        self.proc: Optional[subprocess.Popen] = None
+        self.handle: Optional[NodeHandle] = None
         self.generation = 0      # +1 per successful spawn
         self.exit_codes: List[int] = []  # every reaped exit status, in order
-        self._ready_rfd: Optional[int] = None
 
     @property
     def address(self) -> str:
@@ -77,20 +99,21 @@ class NodeProc:
 
     @property
     def pid(self) -> Optional[int]:
-        return self.proc.pid if self.proc is not None else None
+        return self.handle.pid if self.handle is not None else None
 
     def alive(self) -> bool:
-        return self.proc is not None and self.proc.poll() is None
+        return self.handle is not None and self.handle.poll() is None
 
     def reap(self) -> Optional[int]:
         """Collect the exit code of a dead process (no-op while alive)."""
-        if self.proc is None:
+        if self.handle is None:
             return self.exit_codes[-1] if self.exit_codes else None
-        rc = self.proc.poll()
+        rc = self.handle.poll()
         if rc is None:
             return None
         self.exit_codes.append(rc)
-        self.proc = None
+        self.handle.release()
+        self.handle = None
         return rc
 
 
@@ -107,7 +130,12 @@ class ClusterSupervisor:
                                            # --restore from its checkpoint
         finally:
             sup.shutdown()
-    """
+
+    Cross-host: ``ClusterSupervisor(masters=2, replicas_per_master=1,
+    hosts=("hostA", "hostB"), driver=SshHostDriver(...))`` places masters
+    round-robin and replicas off their master's host, spawns over ssh, and
+    arms fleet TLS automatically (``tls=False`` opts out, ``tls=True``
+    forces it for local fleets)."""
 
     def __init__(
         self,
@@ -120,6 +148,10 @@ class ClusterSupervisor:
         platform: Optional[str] = None,
         checkpoint_interval: float = 0.0,
         ready_timeout: float = 90.0,
+        driver: Optional[HostDriver] = None,
+        hosts: Optional[Sequence[str]] = None,
+        tls: Optional[bool] = None,
+        retry_profile: Optional[str] = None,
     ):
         self.n_masters = masters
         self.replicas_per_master = replicas_per_master
@@ -129,6 +161,15 @@ class ClusterSupervisor:
         self.platform = platform
         self.checkpoint_interval = checkpoint_interval
         self.ready_timeout = ready_timeout
+        self.driver = driver if driver is not None else LocalHostDriver()
+        # tpu-server --retry-profile for every node (net/retry LINK_PROFILES;
+        # "wan" stretches cluster-link backoff for real networks).  The
+        # COORDINATOR side (this process) follows RTPU_RETRY_PROFILE.
+        self.retry_profile = retry_profile
+        self.tls = tls  # None = auto: on iff any host is remote
+        self._tls_cert: Optional[str] = None
+        self._tls_key: Optional[str] = None
+        self._client_ssl = None
         self._owns_base_dir = base_dir is None
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="rtpu-cluster-")
         # the COORDINATOR's migration-journal home: migrate_slots /
@@ -136,6 +177,22 @@ class ClusterSupervisor:
         self.journal_dir = os.path.join(self.base_dir, "journal")
         os.makedirs(self.journal_dir, exist_ok=True)
         self.slot_ranges = topology.split_slots(masters)
+        # failure-domain placement: explicit hosts= engages anti-affinity
+        # (loudly degraded when impossible); a host-unaware supervisor is
+        # ONE implicit domain and stays silent about it — that is today's
+        # single-machine fleet, not a degraded placement
+        if hosts:
+            self.hosts = list(hosts)
+            self._master_hosts, self._replica_hosts = topology.assign_hosts(
+                self.hosts, masters, replicas_per_master
+            )
+        else:
+            self.hosts = [_LOCAL_HOST_LABEL]
+            self._master_hosts = [_LOCAL_HOST_LABEL] * masters
+            self._replica_hosts = {
+                (mi, r): _LOCAL_HOST_LABEL
+                for mi in range(masters) for r in range(replicas_per_master)
+            }
         self.masters: List[NodeProc] = []
         self.replicas: List[NodeProc] = []
 
@@ -150,24 +207,37 @@ class ClusterSupervisor:
     def nodes(self) -> List[NodeProc]:
         return self.masters + self.replicas
 
+    def nodes_on(self, host: str) -> List[NodeProc]:
+        """Every node placed in failure domain ``host``."""
+        return [n for n in self.nodes() if n.host_label == host]
+
     def start(self) -> "ClusterSupervisor":
         try:
+            self._arm_tls()
             for i in range(self.n_masters):
-                node = self._make_node(f"m{i}", "master")
+                node = self._make_node(
+                    f"m{i}", "master", host_label=self._master_hosts[i]
+                )
                 self.masters.append(node)
                 self._spawn(node)
             for mi in range(self.n_masters):
                 for r in range(self.replicas_per_master):
-                    node = self._make_node(f"r{mi}-{r}", "replica", master_index=mi)
+                    node = self._make_node(
+                        f"r{mi}-{r}", "replica", master_index=mi,
+                        host_label=self._replica_hosts[(mi, r)],
+                    )
                     self.replicas.append(node)
                     self._spawn(node)
             for node in self.nodes():
                 self.wait_ready(node)
             self.install_topology()
         except BaseException:
-            # a half-started fleet must not leak OS processes: reap
-            # everything already spawned before surfacing the failure
+            # a half-started fleet must not leak OS processes OR driver-held
+            # remote resources (ssh channels, emitted specs): reap everything
+            # already spawned, then let the driver drop what only IT can see,
+            # before surfacing the failure
             self.shutdown()
+            self.driver.on_start_failure()
             raise
         return self
 
@@ -175,20 +245,19 @@ class ClusterSupervisor:
         """SIGTERM everything (graceful: checkpoint flush-on-stop), escalate
         to SIGKILL on stragglers, reap every exit code.  Bounded end to
         end: a wedged node (SIGSTOPped, hung in a flush) cannot stall the
-        teardown — SIGKILL reaps even a stopped process."""
+        teardown — SIGKILL reaps even a stopped process.  Driver-held
+        resources (ssh channels) are released last."""
         for node in self.nodes():
             if node.alive():
-                try:
-                    os.kill(node.proc.pid, signal.SIGTERM)
-                except ProcessLookupError:
-                    pass
+                node.handle.signal(signal.SIGTERM)
         deadline = time.monotonic() + 15.0
         for node in self.nodes():
-            if node.proc is None:
+            if node.handle is None:
                 continue
             self._reap_escalating(
                 node, max(0.1, deadline - time.monotonic())
             )
+        self.driver.close()
 
     def _reap_escalating(self, node: NodeProc, grace: float) -> Optional[int]:
         """Bounded reap of a process that was just signalled: wait `grace`
@@ -197,63 +266,43 @@ class ClusterSupervisor:
         ``exit_codes`` even on the escalated path); returns None only if
         even SIGKILL cannot reap in time (uninterruptible D-state) — the
         next ``reap()`` collects it."""
-        if node.proc is None:
+        if node.handle is None:
             return node.exit_codes[-1] if node.exit_codes else None
-        try:
-            node.proc.wait(timeout=grace)
-        except subprocess.TimeoutExpired:
-            node.proc.kill()
-            try:
-                node.proc.wait(timeout=10.0)
-            except subprocess.TimeoutExpired:
-                self._close_ready_fd(node)
+        if node.handle.wait(grace) is None:
+            node.handle.force_kill()
+            if node.handle.wait(10.0) is None:
+                node.handle.close_ready()
                 return None
-        self._close_ready_fd(node)
+        node.handle.close_ready()
         return node.reap()
 
     # -- spawning ------------------------------------------------------------
 
     def _make_node(self, name: str, role: str,
-                   master_index: Optional[int] = None) -> NodeProc:
+                   master_index: Optional[int] = None,
+                   host_label: str = _LOCAL_HOST_LABEL) -> NodeProc:
         base = os.path.join(self.base_dir, name)
         os.makedirs(os.path.join(base, "ckpt"), exist_ok=True)
-        return NodeProc(name, role, base, master_index=master_index)
-
-    def _child_env(self) -> Dict[str, str]:
-        env = dict(os.environ)
-        # the child must import redisson_tpu from THIS checkout regardless
-        # of the supervisor's cwd
-        repo_root = os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        return NodeProc(
+            name, role, base, master_index=master_index,
+            host_label=host_label,
         )
-        env["PYTHONPATH"] = repo_root + (
-            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
-        )
-        env.update(self.extra_env)
-        return env
 
-    def _spawn(self, node: NodeProc, restore: bool = False) -> None:
-        rfd, wfd = os.pipe()
-        try:
-            self._spawn_inner(node, rfd, wfd, restore)
-        except BaseException:
-            # spawn failed before the child owned the pipe: close both ends
-            # here or repeated failed restarts leak fds until EMFILE
-            for fd in (rfd, wfd):
-                try:
-                    os.close(fd)
-                except OSError:
-                    pass
-            raise
-        node._ready_rfd = rfd
-        node.generation += 1
-
-    def _spawn_inner(self, node: NodeProc, rfd: int, wfd: int,
-                     restore: bool) -> None:
+    def _server_cli(self, node: NodeProc, restore: bool) -> List[str]:
+        """The full tpu-server CLI for one node — everything except
+        ``--ready-fd``, which the driver owns (local: inherited pipe fd;
+        ssh: fd 3 dup'd onto the channel's stdout)."""
+        bind = self.driver.bind_host(node.host_label)
         cmd = [
-            sys.executable, "-m", "redisson_tpu.server",
-            "--host", node.host, "--port", str(node.port),
-            "--ready-fd", str(wfd),
+            "--host", bind if bind is not None else node.host,
+            "--port", str(node.port),
+        ]
+        connect = self.driver.connect_address(node.host_label)
+        if connect is not None and connect != (bind or node.host):
+            # cross-host nodes bind wide but are NAMED by their routable
+            # address everywhere (views, journals, READY)
+            cmd += ["--advertise-host", connect]
+        cmd += [
             "--checkpoint", node.checkpoint_path,
             # crashed-node restart discipline: a node that died mid-
             # migration re-arms its windows from the coordinator journal
@@ -268,22 +317,23 @@ class ClusterSupervisor:
             cmd += ["--password", self.password]
         if self.platform:
             cmd += ["--platform", self.platform]
+        if self.tls_armed:
+            # every node gets the fleet cert: the bus (client listeners AND
+            # server-to-server links via link_client's TLS inheritance)
+            # refuses plaintext fleet-wide, not just on the remote hops
+            cmd += ["--tls-cert", self._tls_cert, "--tls-key", self._tls_key]
+        if self.retry_profile:
+            cmd += ["--retry-profile", self.retry_profile]
         cmd += self.server_args
-        with open(node.log_path, "ab") as log:
-            node.proc = subprocess.Popen(
-                cmd, stdout=log, stderr=subprocess.STDOUT,
-                pass_fds=(wfd,), env=self._child_env(),
-                start_new_session=True,  # our signals hit THIS pid only
-            )
-        os.close(wfd)  # child holds the write end now
+        return cmd
 
-    def _close_ready_fd(self, node: NodeProc) -> None:
-        if node._ready_rfd is not None:
-            try:
-                os.close(node._ready_rfd)
-            except OSError:
-                pass
-            node._ready_rfd = None
+    def _spawn(self, node: NodeProc, restore: bool = False) -> None:
+        node.handle = self.driver.spawn(
+            node.name, node.host_label, self._server_cli(node, restore),
+            node.log_path, dict(self.extra_env),
+            ensure_dirs=(os.path.dirname(node.checkpoint_path),),
+        )
+        node.generation += 1
 
     def wait_ready(self, node: NodeProc, timeout: Optional[float] = None) -> NodeProc:
         """Block until the node's ready line arrives (no sleep-polling: the
@@ -293,8 +343,10 @@ class ClusterSupervisor:
         :class:`NodeStartupError` with its exit code and log tail."""
         deadline = time.monotonic() + (timeout or self.ready_timeout)
         buf = b""
-        rfd = node._ready_rfd
-        assert rfd is not None, f"{node.name}: no spawn in flight"
+        handle = node.handle
+        assert handle is not None, f"{node.name}: no spawn in flight"
+        rfd = handle.ready_fd()
+        assert rfd is not None, f"{node.name}: ready channel already closed"
         try:
             while b"\n" not in buf:
                 remain = deadline - time.monotonic()
@@ -322,16 +374,80 @@ class ClusterSupervisor:
                     )
                 buf += chunk
         finally:
-            self._close_ready_fd(node)
+            handle.close_ready()
         line = buf.split(b"\n", 1)[0].decode(errors="replace").split()
         if len(line) < 3 or line[0] != "READY":
             raise NodeStartupError(f"{node.name}: bad ready line {line!r}")
-        node.host, node.port = line[1], int(line[2])
+        if len(line) >= 4:
+            # remote handles learn their signal target (the REMOTE pid) here
+            handle.note_ready(line[1], int(line[2]), int(line[3]))
+        # connect address: the driver's word beats the READY line's bind
+        # host (a remote node binding 0.0.0.0 is reached by its host's
+        # routable address, not by what it bound)
+        node.host = handle.connect_host or line[1]
+        node.port = int(line[2])
         with self.conn(node) as c:
             node.node_id = topology._s(
                 topology.check_reply(c.execute("CLUSTER", "MYID"))
             )
         return node
+
+    # -- TLS (cross-host bus) -------------------------------------------------
+
+    @property
+    def tls_armed(self) -> bool:
+        return self._tls_cert is not None
+
+    def _arm_tls(self) -> None:
+        """TLS-by-default for fleets that leave the machine: ``tls=None``
+        arms iff the driver reports any host as remote (plaintext stays
+        the loopback default), ``tls=True`` forces arming.  The supervisor
+        generates ONE self-signed fleet cert (openssl CLI, the
+        tests/test_tls_acl.py recipe) that every node loads — servers
+        refuse plaintext at the handshake, and ``link_client``'s TLS
+        inheritance carries it onto every server-to-server
+        migration/replication link.  Ssh nodes read the cert over the
+        shared filesystem (see hostdriver module docs)."""
+        want = self.tls if self.tls is not None else any(
+            self.driver.is_remote(h) for h in self.hosts
+        )
+        if not want:
+            return
+        tls_dir = os.path.join(self.base_dir, "tls")
+        cert = os.path.join(tls_dir, "fleet.crt")
+        key = os.path.join(tls_dir, "fleet.key")
+        if not (os.path.exists(cert) and os.path.exists(key)):
+            os.makedirs(tls_dir, exist_ok=True)
+            sans = ["DNS:localhost", "IP:127.0.0.1"]
+            for h in self.hosts:
+                try:
+                    ipaddress.ip_address(h)
+                    sans.append(f"IP:{h}")
+                except ValueError:
+                    sans.append(f"DNS:{h}")
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-keyout", key, "-out", cert, "-days", "2", "-nodes",
+                 "-subj", "/CN=rtpu-fleet",
+                 "-addext", "subjectAltName=" + ",".join(dict.fromkeys(sans))],
+                check=True, capture_output=True,
+            )
+        self._tls_cert, self._tls_key = cert, key
+
+    def client_ssl_context(self):
+        """The coordinator/client-side SSL context for this fleet's bus
+        (None when plaintext): trusts the fleet cert as its own root,
+        hostname checks off — fleet peers are addressed by IP/labels, and
+        the chain pin is what keeps plaintext and foreign certs out."""
+        if not self.tls_armed:
+            return None
+        if self._client_ssl is None:
+            from redisson_tpu.net.client import client_ssl_context
+
+            self._client_ssl = client_ssl_context(
+                ca_file=self._tls_cert, verify_hostname=False,
+            )
+        return self._client_ssl
 
     # -- chaos / process control ----------------------------------------------
 
@@ -339,15 +455,32 @@ class ClusterSupervisor:
         """Deliver a real signal.  SIGKILL (the default) reaps and returns
         the exit code — the process is DEAD, its GIL, sockets, and device
         state gone with it.  SIGSTOP/SIGCONT return None (still alive)."""
-        if node.proc is None:
+        if node.handle is None:
             return node.exit_codes[-1] if node.exit_codes else None
-        try:
-            os.kill(node.proc.pid, sig)
-        except ProcessLookupError:
-            pass
+        node.handle.signal(sig)
         if sig in (signal.SIGSTOP, signal.SIGCONT):
             return None
         return self._reap_escalating(node, 30.0)
+
+    def kill_host(self, host: str,
+                  sig: int = signal.SIGKILL) -> Dict[str, Optional[int]]:
+        """A whole failure domain dies AT ONCE (ISSUE 16): signal every
+        node on ``host`` first — concurrently dead, the way a machine
+        loses power — then reap them under one shared deadline.  Returns
+        ``{node name: exit code}`` (None entries for SIGSTOP/SIGCONT,
+        which leave the domain frozen/thawed rather than dead)."""
+        victims = [n for n in self.nodes_on(host) if n.handle is not None]
+        for n in victims:
+            n.handle.signal(sig)
+        if sig in (signal.SIGSTOP, signal.SIGCONT):
+            return {n.name: None for n in victims}
+        deadline = time.monotonic() + 30.0
+        return {
+            n.name: self._reap_escalating(
+                n, max(0.1, deadline - time.monotonic())
+            )
+            for n in victims
+        }
 
     def stop(self, node: NodeProc, timeout: float = 15.0) -> Optional[int]:
         """Graceful SIGTERM (checkpoint flush-on-stop inside the server),
@@ -355,12 +488,9 @@ class ClusterSupervisor:
         node (SIGSTOPped, hung mid-flush) cannot stall a teardown or a
         rolling restart; its exit code is still recorded.  Returns the
         exit code."""
-        if node.proc is None:
+        if node.handle is None:
             return node.exit_codes[-1] if node.exit_codes else None
-        try:
-            os.kill(node.proc.pid, signal.SIGTERM)
-        except ProcessLookupError:
-            pass
+        node.handle.signal(signal.SIGTERM)
         return self._reap_escalating(node, timeout)
 
     def pause(self, node: NodeProc) -> None:
@@ -372,22 +502,18 @@ class ClusterSupervisor:
         self.kill(node, signal.SIGCONT)
 
     def wait_exit(self, node: NodeProc, timeout: float = 30.0) -> Optional[int]:
-        if node.proc is not None:
-            try:
-                node.proc.wait(timeout=timeout)
-            except subprocess.TimeoutExpired:
-                return None
+        if node.handle is not None:
+            node.handle.wait(timeout)
         return node.reap()
 
     @staticmethod
     def _rejoin_retry_policy() -> RetryPolicy:
         """The view-learning/re-wiring schedule for a node rejoining the
         fleet: mid-roll its peers may themselves be restarting, so a
-        refused connect retries instead of failing the whole restart."""
-        return RetryPolicy(
-            max_attempts=5, base_delay=0.1, max_delay=1.0, jitter=0.2,
-            deadline_s=20.0,
-        )
+        refused connect retries instead of failing the whole restart.
+        Profile-driven (net/retry LINK_PROFILES "rejoin"): "lan" is the
+        historical schedule, RTPU_RETRY_PROFILE=wan stretches it."""
+        return link_policy("rejoin")
 
     def restart(self, node: NodeProc, restore: bool = True,
                 force: bool = False) -> NodeProc:
@@ -402,7 +528,10 @@ class ClusterSupervisor:
         be stale after migrations/failovers — retried under
         :class:`~redisson_tpu.net.retry.RetryPolicy`, because mid-roll the
         peers may be restarting too), and replica links severed by the
-        death are re-wired."""
+        death are re-wired.  Peer SELECTION retries with the install: the
+        view is re-fetched inside every attempt across ALL live nodes —
+        replicas included — so a peer that died between attempts (the
+        common case mid-host-kill) costs one retry, not the restart."""
         if node.alive():
             if not force:
                 return node
@@ -411,12 +540,16 @@ class ClusterSupervisor:
         self._spawn(node, restore=restore)
         self.wait_ready(node)
         policy = self._rejoin_retry_policy()
-        view = self.current_view()
-        if view:
-            call_with_retry(
-                policy,
-                lambda: topology.install_view([self._conn_factory(node)], view),
-            )
+
+        def _relearn_view() -> None:
+            # fetched INSIDE the retry: each attempt re-selects a live peer
+            # (current_view probes every node, bounded per peer), so a dead
+            # or wedged first choice degrades to the next attempt's pick
+            view = self.current_view()
+            if view:
+                topology.install_view([self._conn_factory(node)], view)
+
+        call_with_retry(policy, _relearn_view)
         if node.role == "replica" and node.master_index is not None:
             master = self.masters[node.master_index]
             if master.alive():
@@ -476,39 +609,48 @@ class ClusterSupervisor:
             ij for ij in ImportJournal.in_flight(self.journal_dir)
             if ij.target == dead_addr
         ]
-        with self.conn(rep) as c:
-            topology.check_reply(c.execute("REPLICAOF", "NO", "ONE"))
-            # in-flight import windows move WITH the promotion: the same
-            # epoch re-fences, so the resumed drain's re-issues stay
-            # idempotent and a stale coordinator stays fenced out
-            for j in MigrationJournal.in_flight(self.journal_dir):
-                planned = j.entry("PLANNED")
-                if not planned or planned.get("kind") == "device_rebalance":
-                    continue
-                if planned["target"] == dead_addr:
-                    for s in planned["slots"]:
-                        topology.check_reply(c.execute(
-                            "CLUSTER", "SETSLOT", int(s), "IMPORTING",
-                            planned["source"], "EPOCH", j.epoch,
-                        ))
-            # replay the dead target's journaled batches onto the promoted
-            # node BEFORE superseding the journal: the REPLPUSH cover on the
-            # import ack is best-effort (a stalled shipper or unhealthy
-            # replica link ships nothing and the ack still authorized the
-            # source's delete), so the journal — the one durability point
-            # the ack actually proved — must not be retired on an
-            # assumption.  apply-by-version makes the replay a no-op for
-            # every batch the replica DID receive, and the EPOCH stamp
-            # re-journals the batches under the promoted node's own import
-            # journal, which the resumed migration's STABLE then settles.
-            for ij in inflight_imports:
-                for blob in ij.batch_blobs():
-                    args = ["IMPORTRECORDS", "EPOCH", ij.epoch]
-                    if ij.source:
-                        args += ["SOURCE", ij.source]
-                    topology.check_reply(
-                        c.execute(*args, blob, timeout=60.0)
-                    )
+        def _promote() -> None:
+            # idempotent end to end (REPLICAOF NO ONE, epoch-fenced SETSLOT
+            # re-issues, apply-by-version IMPORTRECORDS replays), so the
+            # whole block retries as one unit — a failover must survive the
+            # very transport chaos that made it necessary
+            with self.conn(rep) as c:
+                topology.check_reply(c.execute("REPLICAOF", "NO", "ONE"))
+                # in-flight import windows move WITH the promotion: the same
+                # epoch re-fences, so the resumed drain's re-issues stay
+                # idempotent and a stale coordinator stays fenced out
+                for j in MigrationJournal.in_flight(self.journal_dir):
+                    planned = j.entry("PLANNED")
+                    if not planned \
+                            or planned.get("kind") == "device_rebalance":
+                        continue
+                    if planned["target"] == dead_addr:
+                        for s in planned["slots"]:
+                            topology.check_reply(c.execute(
+                                "CLUSTER", "SETSLOT", int(s), "IMPORTING",
+                                planned["source"], "EPOCH", j.epoch,
+                            ))
+                # replay the dead target's journaled batches onto the
+                # promoted node BEFORE superseding the journal: the REPLPUSH
+                # cover on the import ack is best-effort (a stalled shipper
+                # or unhealthy replica link ships nothing and the ack still
+                # authorized the source's delete), so the journal — the one
+                # durability point the ack actually proved — must not be
+                # retired on an assumption.  apply-by-version makes the
+                # replay a no-op for every batch the replica DID receive,
+                # and the EPOCH stamp re-journals the batches under the
+                # promoted node's own import journal, which the resumed
+                # migration's STABLE then settles.
+                for ij in inflight_imports:
+                    for blob in ij.batch_blobs():
+                        args = ["IMPORTRECORDS", "EPOCH", ij.epoch]
+                        if ij.source:
+                            args += ["SOURCE", ij.source]
+                        topology.check_reply(
+                            c.execute(*args, blob, timeout=60.0)
+                        )
+
+        call_with_retry(self._rejoin_retry_policy(), _promote)
         for ij in inflight_imports:
             ij.append("STABLE", superseded_by=rep.address)
         new_view = [
@@ -626,12 +768,15 @@ class ClusterSupervisor:
     def current_view(self) -> List[topology.ViewRow]:
         """The view as the LIVE cluster knows it: asked from any live node
         that has one installed (migrations move ownership underneath the
-        supervisor's original plan), falling back to the plan."""
+        supervisor's original plan), falling back to the plan.  Each peer
+        probe is BOUNDED (5s) so one wedged-but-accepting node — SIGSTOPped
+        mid-host-kill — degrades to the next peer, not a 30s stall per
+        restart."""
         for node in self.nodes():
             if not node.alive():
                 continue
             try:
-                with self.conn(node) as c:
+                with self.conn(node, timeout=5.0) as c:
                     view = topology.fetch_view(c)
             except Exception:  # noqa: BLE001 — try the next node
                 continue
@@ -661,11 +806,13 @@ class ClusterSupervisor:
     # -- access ---------------------------------------------------------------
 
     def conn(self, node: NodeProc, timeout: float = 30.0):
-        """Context-managed admin connection to one node (real TCP)."""
+        """Context-managed admin connection to one node (real TCP; TLS when
+        the fleet bus is armed)."""
         from contextlib import closing
 
         return closing(Connection(
             node.host, node.port, timeout=timeout, password=self.password,
+            ssl_context=self.client_ssl_context(),
         ))
 
     def _conn_factory(self, node: NodeProc):
@@ -681,6 +828,8 @@ class ClusterSupervisor:
         kw.setdefault("timeout", 60.0)
         if self.password is not None:
             kw.setdefault("password", self.password)
+        if self.tls_armed:
+            kw.setdefault("ssl_context", self.client_ssl_context())
         return ClusterRedisson(self.seeds(), **kw)
 
     def scrape(self) -> str:

@@ -22,7 +22,10 @@ admin connections without this module knowing which it is talking to.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
+import warnings
+from typing import (
+    Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple,
+)
 
 from redisson_tpu.net.resp import RespError
 from redisson_tpu.utils.crc16 import MAX_SLOT
@@ -106,6 +109,61 @@ def wire_replica(
         check_reply(
             c.execute("REPLICAOF", master_host, master_port, timeout=timeout)
         )
+
+
+class PlacementDegraded(UserWarning):
+    """Host anti-affinity could not be honored (fewer failure domains than
+    the replication factor needs) — the fleet still forms, but a single
+    host failure can now take a master AND its replica together."""
+
+
+def assign_hosts(
+    hosts: Sequence[str],
+    n_masters: int,
+    replicas_per_master: int = 0,
+) -> Tuple[List[str], Dict[Tuple[int, int], str]]:
+    """Failure-domain placement (ISSUE 16): map a fleet plan onto host
+    labels with HOST ANTI-AFFINITY — a replica is never placed on its
+    master's host, because a replica that shares its master's failure
+    domain is not a replica, it is a second copy of the same outage.
+
+      * masters round-robin across ``hosts`` (spread, not packed);
+      * replica ``r`` of master ``mi`` takes the ``(1 + r)``-th host AFTER
+        its master's in ring order — off-host by construction, and
+        consecutive replicas of one master land on DISTINCT hosts while
+        enough domains exist;
+      * one host (or ``replicas_per_master >= len(hosts)``) cannot honor
+        anti-affinity for every replica: the placement DEGRADES LOUDLY —
+        a :class:`PlacementDegraded` warning names every violating pair —
+        rather than refusing to form (single-host CI fleets are the
+        common case) or silently pretending the domain split exists.
+
+    Returns ``(master_hosts, replica_hosts)``: ``master_hosts[mi]`` is
+    master ``mi``'s host label, ``replica_hosts[(mi, r)]`` replica ``r``
+    of master ``mi``'s."""
+    if not hosts:
+        raise ValueError("need at least one host label")
+    ring = list(hosts)
+    master_hosts = [ring[i % len(ring)] for i in range(n_masters)]
+    replica_hosts: Dict[Tuple[int, int], str] = {}
+    violations: List[str] = []
+    for mi in range(n_masters):
+        anchor = mi % len(ring)
+        for r in range(replicas_per_master):
+            host = ring[(anchor + 1 + r) % len(ring)]
+            replica_hosts[(mi, r)] = host
+            if host == master_hosts[mi]:
+                violations.append(f"r{mi}-{r} shares host {host!r} with m{mi}")
+    if violations:
+        warnings.warn(
+            "host anti-affinity DEGRADED — "
+            f"{len(ring)} host(s) cannot separate "
+            f"{replicas_per_master} replica(s) from each master: "
+            + "; ".join(violations),
+            PlacementDegraded,
+            stacklevel=2,
+        )
+    return master_hosts, replica_hosts
 
 
 def fetch_view(conn: Any, timeout: Optional[float] = 10.0) -> List[ViewRow]:

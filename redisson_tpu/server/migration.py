@@ -48,7 +48,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from redisson_tpu.net.client import NodeClient
-from redisson_tpu.net.retry import RetryPolicy
+from redisson_tpu.net.retry import RetryPolicy, link_policy
 from redisson_tpu.server.migration_journal import ImportJournal, MigrationJournal
 from redisson_tpu.utils.crc16 import MAX_SLOT
 
@@ -64,11 +64,11 @@ class CoordinatorKilled(BaseException):
 def _admin_retry_policy() -> RetryPolicy:
     """Migration control traffic's retry schedule: a fresh policy per link
     (each carries its own jitter RNG) with a deadline that bounds any one
-    control verb's total retry budget."""
-    return RetryPolicy(
-        max_attempts=4, base_delay=0.05, max_delay=1.0, jitter=0.2,
-        deadline_s=30.0,
-    )
+    control verb's total retry budget.  Numbers come from the active link
+    profile (RTPU_RETRY_PROFILE): the ``lan`` profile IS the historical
+    hard-coded schedule; ``wan`` stretches attempts/backoff/deadline for
+    cross-host links without touching deadline-clamp semantics."""
+    return link_policy("admin")
 
 
 def _admin(addr: str, password: Optional[str], ssl_context=None) -> NodeClient:

@@ -201,6 +201,90 @@ def _record_head(rec, name: str) -> dict:
 # keeps mixed-version links and recorded blobs working.
 _WIRE_LZ4_MAGIC = b"RLZ4"
 
+# resumable full-sync (ISSUE 16): the master stages ONE serialized snapshot
+# and the replica pulls it in offset-addressed chunks — a WAN link that
+# drops mid-ship resumes at the byte it stopped at instead of re-shipping
+# the whole RLZ4 blob from byte 0.  4MB chunks keep any single send well
+# inside socket timeouts; staleness/backstop mirror the REPLPUSHSEG staging
+# discipline (verbs/admin.py REPL_XFER_*).
+SNAPSHOT_CHUNK_BYTES = 4 << 20
+SNAP_STAGE_STALE_S = 120.0
+SNAP_STAGE_MAX = 16
+
+
+def pull_snapshot(client, timeout: float = 60.0,
+                  chunk_bytes: Optional[int] = None,
+                  max_link_errors: int = 8,
+                  max_restarts: int = 2) -> bytes:
+    """Replica-side resumable REPLSNAPSHOT pull.
+
+    ``REPLSNAPSHOT BEGIN`` stages the cut master-side and returns
+    ``[xfer_id, total, crc32, chunk]``; ``FETCH <id> <offset>`` streams it
+    chunk by chunk — a dropped link retries the SAME offset (the staged
+    blob is immutable, so re-reads are idempotent), a ``SNAPEXPIRED``
+    reply (master restarted / stage reaped) restarts from a fresh BEGIN.
+    The assembled bytes are CRC-verified against the BEGIN header before
+    they are returned, so a torn or mixed-stage snapshot can never reach
+    ``apply_records``.  A legacy master that predates subcommands ignores
+    the args and answers with the full blob — returned as-is (one ship,
+    no resume, exactly the old behavior)."""
+    import zlib
+
+    from redisson_tpu.net.resp import RespError
+
+    restarts = 0
+    while True:
+        begin = ["REPLSNAPSHOT", "BEGIN"]
+        if chunk_bytes:
+            begin += ["CHUNK", int(chunk_bytes)]
+        reply = client.execute(*begin, timeout=timeout)
+        if isinstance(reply, (bytes, bytearray, memoryview)):
+            return bytes(reply)  # legacy full-blob master
+        xfer_id = reply[0].decode() if isinstance(reply[0], (bytes, bytearray)) \
+            else str(reply[0])
+        total, crc = int(reply[1]), int(reply[2])
+        buf = bytearray()
+        errors = 0
+        expired = False
+        while len(buf) < total:
+            try:
+                part = client.execute(
+                    "REPLSNAPSHOT", "FETCH", xfer_id, len(buf),
+                    timeout=timeout,
+                )
+            except RespError as e:
+                if str(e).startswith("SNAPEXPIRED") and restarts < max_restarts:
+                    restarts += 1
+                    expired = True
+                    break
+                raise
+            except (ConnectionError, OSError, TimeoutError):
+                # the resume: the link rebuilds and the next FETCH re-asks
+                # for the SAME offset — nothing shipped so far is re-sent
+                errors += 1
+                if errors > max_link_errors:
+                    raise
+                continue
+            if not part:
+                raise ConnectionError(
+                    f"REPLSNAPSHOT FETCH returned no data at offset "
+                    f"{len(buf)}/{total}"
+                )
+            buf += bytes(part)
+        if expired:
+            continue
+        blob = bytes(buf)
+        if zlib.crc32(blob) != crc:
+            raise ValueError(
+                f"REPLSNAPSHOT torn: crc mismatch over {total} bytes "
+                f"(transfer {xfer_id})"
+            )
+        try:  # release the stage eagerly; the reaper is the backstop
+            client.execute("REPLSNAPSHOT", "END", xfer_id, timeout=5.0)
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
+        return blob
+
 
 def _wire_payload(records: List[dict], live: Optional[List[str]]) -> bytes:
     payload = {"format": 1, "records": records}
@@ -382,14 +466,18 @@ class ReplicaHandle:
     def __init__(self, address: str, password: Optional[str] = None, server=None):
         self.address = address
         # grid nodes share credentials + transport security (registry
-        # cmd_replicaof note; server.link_client carries TLS when on)
+        # cmd_replicaof note; server.link_client carries TLS when on).
+        # Link cadence is profile-driven (net/retry): "lan" is the legacy
+        # single-shot link byte-for-byte, "wan" adds per-call backoff.
+        from redisson_tpu.net.retry import replica_link_kwargs
+
         if server is not None:
-            self.client = server.link_client(address, ping_interval=0, retry_attempts=1)
+            self.client = server.link_client(address, **replica_link_kwargs())
         else:
             from redisson_tpu.net.client import NodeClient
 
             self.client = NodeClient(
-                address, ping_interval=0, retry_attempts=1, password=password
+                address, password=password, **replica_link_kwargs()
             )
         # record name -> (nonce, version) last shipped; the nonce detects
         # delete+recreate between sweeps (version restarts under a new nonce)

@@ -172,6 +172,7 @@ class TpuServer:
         qos: Optional[bool] = None,
         dispatch_ahead: Optional[int] = None,
         journal_dir: Optional[str] = None,
+        advertise_host: Optional[str] = None,
     ):
         self.engine = engine if engine is not None else Engine()
         # device-sharded serving (ISSUE 8): `devices` maps the 16384-slot
@@ -214,6 +215,12 @@ class TpuServer:
         self._bulk_gate_n = 0
         self.host = host
         self.port = port
+        # the address this node IS in cluster views (ISSUE 16): a cross-host
+        # node binds 0.0.0.0 but is named in views/journals/READY by its
+        # routable address — without the split, owns_slot never matches and
+        # the node MOVED-bounces its own slots forever.  None = bind host
+        # (the single-machine default, where they coincide).
+        self.advertise_host = advertise_host
         self.password = password
         # ACL users (username -> password): the reference's AUTH user pass
         # (BaseConnectionHandler.java:59-122).  "default" aliases `password`.
@@ -335,6 +342,13 @@ class TpuServer:
         # (verbs/admin.py cmd_replpushseg; census counts live entries)
         self._repl_xfers: Dict[str, list] = {}
         self._repl_xfers_lock = threading.Lock()
+        # resumable REPLSNAPSHOT staging (ISSUE 16): xfer_id ->
+        # [blob, chunk_bytes, last-touch monotonic] — one immutable
+        # serialized cut a replica FETCHes by offset; reaped by staleness
+        # (verbs/admin.py cmd_replsnapshot; census counts live entries)
+        self._snap_stages: Dict[str, list] = {}
+        self._snap_lock = threading.Lock()
+        self._snap_seq = 0
         # chaos pause gate (SIGSTOP analog): cleared = every command handler
         # parks before dispatch, so the node stops answering (pings included)
         # WITHOUT closing connections — the hung-but-accepting failure mode
@@ -556,7 +570,9 @@ class TpuServer:
     def cluster_slots(self) -> List[Any]:
         """CLUSTER SLOTS reply shape: [from, to, [host, port, id]]."""
         if not self.cluster_view:
-            return [[0, 16383, [self.host.encode(), self.port, self.node_id.encode()]]]
+            return [[0, 16383,
+                     [self.public_host.encode(), self.port,
+                      self.node_id.encode()]]]
         return [
             [lo, hi, [h.encode(), p, nid.encode()]]
             for (lo, hi, h, p, nid) in self.cluster_view
@@ -564,15 +580,22 @@ class TpuServer:
 
     # -- cluster routing / replication role ----------------------------------
 
+    @property
+    def public_host(self) -> str:
+        """The host this node is KNOWN BY (views, journals, READY line):
+        the advertised address when bind and routable addresses differ
+        (cross-host nodes binding 0.0.0.0), else the bind host."""
+        return self.advertise_host or self.host
+
     def address(self) -> str:
-        return f"{self.host}:{self.port}"
+        return f"{self.public_host}:{self.port}"
 
     def owns_slot(self, slot: int) -> bool:
         if not self.cluster_view:
             return True
         for lo, hi, h, p, _nid in self.cluster_view:
             if lo <= slot <= hi:
-                if (h, p) == (self.host, self.port):
+                if (h, p) == (self.public_host, self.port):
                     return True
                 # a replica serves READS for its master's range (the READONLY
                 # connection mode of Redis cluster replicas); writes are
@@ -1932,7 +1955,7 @@ class TpuServer:
 
             rearm_recovery(self, self.journal_dir)
         if ready_fd is not None:
-            line = f"READY {self.host} {self.port} {os.getpid()}\n".encode()
+            line = f"READY {self.public_host} {self.port} {os.getpid()}\n".encode()
             try:
                 os.write(ready_fd, line)
             finally:
@@ -2100,6 +2123,13 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description="redisson-tpu server")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6390)
+    ap.add_argument(
+        "--advertise-host", default=None,
+        help="the routable address this node is named by in cluster views, "
+             "migration journals, and its READY line when it differs from "
+             "the bind --host (cross-host nodes bind 0.0.0.0; without this "
+             "a node would MOVED-bounce its own slots)",
+    )
     ap.add_argument("--password", default=None)
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--restore", action="store_true", help="load checkpoint on boot")
@@ -2159,7 +2189,32 @@ def main(argv=None):
              "their slots RECOVERING until resume_migrations settles them "
              "(the crashed-node restart discipline, migration.rearm_recovery)",
     )
+    ap.add_argument(
+        "--tls-cert", default=None,
+        help="PEM certificate: enables TLS on the listener (with --tls-key) "
+             "and on this node's OUTGOING cluster links (migration/"
+             "replication) — the cross-host bus discipline: plaintext "
+             "clients are refused at the handshake",
+    )
+    ap.add_argument("--tls-key", default=None,
+                    help="PEM private key for --tls-cert")
+    ap.add_argument(
+        "--tls-ca", default=None,
+        help="PEM CA bundle: additionally REQUIRE client certificates "
+             "(mutual TLS) and pin the trust root for outgoing links",
+    )
+    ap.add_argument(
+        "--retry-profile", default=None, choices=("lan", "wan"),
+        help="link retry cadence for cluster-internal connections "
+             "(net/retry.py LINK_PROFILES): 'lan' (default) keeps the "
+             "historical tight schedules; 'wan' stretches backoff and "
+             "deadlines for links that cross real networks.  Equivalent to "
+             "RTPU_RETRY_PROFILE; the flag also exports the env var so "
+             "coordinator code spawned from this process inherits it.",
+    )
     args = ap.parse_args(argv)
+    if bool(args.tls_cert) != bool(args.tls_key):
+        ap.error("--tls-cert and --tls-key must be given together")
     if args.checkpoint_interval > 0 and not args.checkpoint:
         ap.error("--checkpoint-interval requires --checkpoint <path>")
     if args.platform:
@@ -2174,11 +2229,19 @@ def main(argv=None):
         ioplane.set_overlap(False)
     if args.no_qos:
         _sched.set_qos(False)
+    if args.retry_profile:
+        import os as _os
+
+        from redisson_tpu.net import retry as _retry
+
+        _os.environ["RTPU_RETRY_PROFILE"] = args.retry_profile
+        _retry.set_retry_profile(args.retry_profile)
     engine = Engine()
     srv = TpuServer(
         engine,
         host=args.host,
         port=args.port,
+        advertise_host=args.advertise_host,
         password=args.password,
         checkpoint_path=args.checkpoint,
         overlap=not args.no_overlap,
@@ -2186,6 +2249,9 @@ def main(argv=None):
         devices=args.devices,
         qos=False if args.no_qos else None,
         dispatch_ahead=args.dispatch_ahead,
+        tls_cert_file=args.tls_cert,
+        tls_key_file=args.tls_key,
+        tls_ca_file=args.tls_ca,
     )
     if args.restore and args.checkpoint:
         from redisson_tpu.core import checkpoint

@@ -379,17 +379,23 @@ def cmd_replicaof(server, ctx, args):
 
     # nodes of one grid share credentials AND transport security: the link
     # authenticates with this node's own password and speaks TLS when this
-    # node does (cluster-wide convention; server.link_client)
-    master = server.link_client(
-        f"{host}:{port}", ping_interval=0, retry_attempts=1
-    )
+    # node does (cluster-wide convention; server.link_client), with
+    # profile-driven cadence (net/retry: lan = legacy single-shot link)
+    from redisson_tpu.net.retry import replica_link_kwargs
+
+    master = server.link_client(f"{host}:{port}", **replica_link_kwargs())
     try:
-        blob = master.execute("REPLSNAPSHOT", timeout=60.0)
+        # resumable chunked pull (ISSUE 16): a dropped link resumes at the
+        # offset it reached; the blob is CRC-gated before it can apply
+        blob = replication.pull_snapshot(master, timeout=60.0)
         replication.apply_records(
-            server.engine, bytes(blob),
+            server.engine, blob,
             on_applied=_tracking_invalidator(server),
         )
-        master.execute("REPLREGISTER", server.host, server.port)
+        # register by the address this node is KNOWN BY (advertise split):
+        # the master's push link must reach a routable address, not a
+        # 0.0.0.0 bind
+        master.execute("REPLREGISTER", server.public_host, server.port)
     finally:
         master.close()
     server.role = "replica"
@@ -397,12 +403,79 @@ def cmd_replicaof(server, ctx, args):
     return "+OK"
 
 
+def _reap_stale_snaps(server, now: float, keep: str = "") -> None:
+    """Drop staged snapshot cuts untouched past the stale window (caller
+    holds server._snap_lock) — the same discipline as _reap_stale_xfers:
+    a replica that died mid-pull must not pin its cut forever."""
+    stages = server._snap_stages
+    from redisson_tpu.server.replication import SNAP_STAGE_STALE_S
+
+    for k in [k for k, (_b, _c, ts) in stages.items()
+              if k != keep and now - ts > SNAP_STAGE_STALE_S]:
+        del stages[k]
+
+
 @register("REPLSNAPSHOT")
 def cmd_replsnapshot(server, ctx, args):
+    """Bare REPLSNAPSHOT -> the full serialized cut (legacy one-ship path).
+
+    Subcommands (ISSUE 16, resumable full-sync — replication.pull_snapshot
+    is the client half):
+
+      * ``BEGIN [CHUNK n]`` — serialize ONE immutable cut, stage it, reply
+        ``[xfer_id, total_bytes, crc32, chunk_bytes]``;
+      * ``FETCH <id> <offset>`` — the staged bytes at ``offset`` (up to the
+        stage's chunk size); an unknown/reaped id answers ``SNAPEXPIRED``
+        so the puller restarts from a fresh BEGIN instead of assembling a
+        mixed-cut blob;
+      * ``END <id>`` — release the stage (idempotent)."""
     from redisson_tpu.server import replication
 
-    blob, _shipped = replication.serialize_records(server.engine)
-    return blob
+    if not args:
+        blob, _shipped = replication.serialize_records(server.engine)
+        return blob
+    sub = bytes(args[0]).upper()
+    now = time.monotonic()
+    if sub == b"BEGIN":
+        chunk = replication.SNAPSHOT_CHUNK_BYTES
+        if len(args) >= 3 and bytes(args[1]).upper() == b"CHUNK":
+            chunk = max(1, _int(args[2]))
+        import zlib
+
+        blob, _shipped = replication.serialize_records(server.engine)
+        with server._snap_lock:
+            _reap_stale_snaps(server, now)
+            while len(server._snap_stages) >= replication.SNAP_STAGE_MAX:
+                # backstop only: drop the least-recently-touched stage
+                stages = server._snap_stages
+                del stages[min(stages, key=lambda k: stages[k][2])]
+            server._snap_seq += 1
+            xfer_id = f"snap-{server.node_id[:8]}-{server._snap_seq}"
+            server._snap_stages[xfer_id] = [blob, chunk, now]
+        return [xfer_id, len(blob), zlib.crc32(blob), chunk]
+    if sub == b"FETCH":
+        xfer_id, offset = _s(args[1]), _int(args[2])
+        with server._snap_lock:
+            _reap_stale_snaps(server, now, keep=xfer_id)
+            entry = server._snap_stages.get(xfer_id)
+            if entry is None:
+                raise RespError(
+                    f"SNAPEXPIRED unknown snapshot transfer {xfer_id}"
+                )
+            blob, chunk, _ts = entry
+            entry[2] = now
+        if not (0 <= offset <= len(blob)):
+            raise RespError(
+                f"ERR snapshot offset {offset} outside 0..{len(blob)}"
+            )
+        return blob[offset:offset + chunk]
+    if sub == b"END":
+        with server._snap_lock:
+            server._snap_stages.pop(_s(args[1]), None)
+        return "+OK"
+    raise RespError(
+        "ERR REPLSNAPSHOT [BEGIN [CHUNK n] | FETCH <id> <offset> | END <id>]"
+    )
 
 
 @register("REPLREGISTER")

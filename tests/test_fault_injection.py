@@ -479,3 +479,114 @@ def test_census_assert_flat_raises_with_detail():
     census = ResourceCensus()
     with pytest.raises(AssertionError, match="x.locks: 0.0 -> 2.0"):
         census.assert_flat({"x.locks": 0.0}, {"x.locks": 2.0}, context="t")
+
+
+# -- link retry profiles (ISSUE 16) --------------------------------------------
+
+@pytest.fixture()
+def _profile_reset():
+    """Every profile test leaves the process exactly as found: unpinned,
+    env untouched."""
+    import os
+
+    from redisson_tpu.net import retry
+
+    saved = os.environ.pop("RTPU_RETRY_PROFILE", None)
+    retry.set_retry_profile(None)
+    yield
+    if saved is None:
+        os.environ.pop("RTPU_RETRY_PROFILE", None)
+    else:
+        os.environ["RTPU_RETRY_PROFILE"] = saved
+    retry.set_retry_profile(None)
+
+
+def test_lan_profile_is_the_historical_schedule(_profile_reset):
+    """The behavioral-identity contract: the default profile's numbers ARE
+    the policies the call sites hard-coded before profiles existed, so a
+    single-host fleet (and every deterministic fault-schedule test) sees
+    byte-identical retry behavior."""
+    from redisson_tpu.net.retry import link_policy, replica_link_kwargs
+
+    admin = link_policy("admin")
+    assert (admin.max_attempts, admin.base_delay, admin.max_delay,
+            admin.jitter, admin.deadline_s) == (4, 0.05, 1.0, 0.2, 30.0)
+    rejoin = link_policy("rejoin")
+    assert (rejoin.max_attempts, rejoin.base_delay, rejoin.max_delay,
+            rejoin.jitter, rejoin.deadline_s) == (5, 0.1, 1.0, 0.2, 20.0)
+    # replication links: the legacy single-shot discipline, no retry_policy
+    assert replica_link_kwargs() == {"ping_interval": 0, "retry_attempts": 1}
+
+
+def test_migration_admin_policy_rides_the_profile(_profile_reset):
+    from redisson_tpu.net import retry
+    from redisson_tpu.server.migration import _admin_retry_policy
+
+    assert _admin_retry_policy().deadline_s == 30.0
+    retry.set_retry_profile("wan")
+    assert _admin_retry_policy().deadline_s == 120.0
+
+
+def test_wan_profile_stretches_and_arms_replica_links(_profile_reset):
+    from redisson_tpu.net import retry
+    from redisson_tpu.net.retry import link_policy, replica_link_kwargs
+
+    retry.set_retry_profile("wan")
+    admin = link_policy("admin")
+    assert admin.max_attempts == 8 and admin.deadline_s == 120.0
+    kw = replica_link_kwargs()
+    # still single-shot per call at the NodeClient layer, but the link now
+    # carries a policy so WAN flaps back off instead of tearing down
+    assert kw["retry_attempts"] == 1
+    assert kw["retry_policy"].deadline_s == 60.0
+
+
+def test_profile_resolution_env_pin_unknown(_profile_reset):
+    import os
+
+    from redisson_tpu.net import retry
+
+    assert retry.current_profile() == "lan"          # default
+    os.environ["RTPU_RETRY_PROFILE"] = "wan"
+    assert retry.current_profile() == "wan"          # env engages
+    retry.set_retry_profile("lan")
+    assert retry.current_profile() == "lan"          # pin beats env
+    retry.set_retry_profile(None)
+    assert retry.current_profile() == "wan"          # unpin re-reads env
+    os.environ["RTPU_RETRY_PROFILE"] = "interplanetary"
+    assert retry.current_profile() == "lan"          # unknown -> lan, no boot
+    with pytest.raises(ValueError):
+        retry.set_retry_profile("interplanetary")    # explicit pin DOES fail
+    from redisson_tpu.net.retry import link_policy
+
+    assert link_policy("admin", deadline_s=5.0).deadline_s == 5.0  # override
+
+
+def test_wan_profile_keeps_deadline_clamp_semantics(_profile_reset):
+    """The clamp contract is profile-independent: a per-attempt timeout
+    inside a nearly-exhausted operation budget waits the REMAINING budget,
+    not its own default, and the sleep path still raises DeadlineExceeded
+    at zero — wan only changes the numbers, never the semantics."""
+    from redisson_tpu.net import retry
+    from redisson_tpu.net.retry import DeadlineExceeded, link_policy
+
+    retry.set_retry_profile("wan")
+    clock = link_policy("admin", deadline_s=0.05).start()
+    assert clock.attempt_timeout(30.0) <= 0.05       # clamped to the budget
+    time.sleep(0.06)
+    assert clock.attempt_timeout(30.0) == 0.0
+    assert not clock.more_attempts()
+    with pytest.raises(DeadlineExceeded):
+        clock.sleep()
+
+
+def test_supervisor_threads_retry_profile_to_server_cli(tmp_path, _profile_reset):
+    from redisson_tpu.cluster import ClusterSupervisor
+    from redisson_tpu.cluster.supervisor import NodeProc
+
+    sup = ClusterSupervisor(masters=1, base_dir=str(tmp_path),
+                            platform="cpu", retry_profile="wan")
+    node = NodeProc("m0", "master", base_dir=str(tmp_path))
+    cli = sup._server_cli(node, restore=False)
+    i = cli.index("--retry-profile")
+    assert cli[i + 1] == "wan"

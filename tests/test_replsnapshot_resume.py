@@ -1,0 +1,271 @@
+"""Resumable chunked REPLSNAPSHOT (ISSUE 16): the WAN-hardened full-sync.
+
+The protocol under test (server/verbs/admin.py + replication.pull_snapshot):
+
+  * ``REPLSNAPSHOT BEGIN [CHUNK n]`` stages an immutable cut master-side
+    and answers ``[xfer_id, total, crc32, chunk]``;
+  * ``FETCH <id> <offset>`` streams it — re-reads are idempotent, so a
+    dropped link resumes at the SAME offset instead of re-shipping;
+  * ``END <id>`` releases the stage (a stale-stage reaper is the backstop);
+  * the assembled bytes are CRC-gated before apply — a torn snapshot can
+    never reach ``apply_records``;
+  * a legacy full-blob master still works (BEGIN args ignored, bytes back).
+"""
+import zlib
+
+import pytest
+
+from redisson_tpu.net.client import NodeClient
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server import replication
+from redisson_tpu.server.server import ServerThread
+
+CHUNK = 512
+
+
+@pytest.fixture(scope="module")
+def master():
+    with ServerThread() as st:
+        with st.client() as c:
+            for i in range(200):
+                c.execute("SET", f"snapkey-{i}", "v" * 64 + str(i))
+        yield st
+
+
+@pytest.fixture()
+def link(master):
+    nc = NodeClient(f"127.0.0.1:{master.port}", ping_interval=0,
+                    retry_attempts=1)
+    yield nc
+    nc.close()
+
+
+def _multi_chunk(total):
+    assert total > 3 * CHUNK, (
+        f"dataset too small to exercise resume: {total} bytes"
+    )
+
+
+# -- the happy chunked path ----------------------------------------------------
+
+def test_begin_fetch_end_roundtrip(master, link):
+    xid, total, crc, chunk = link.execute("REPLSNAPSHOT", "BEGIN",
+                                          "CHUNK", CHUNK)
+    xid, total, crc, chunk = bytes(xid).decode(), int(total), int(crc), \
+        int(chunk)
+    _multi_chunk(total)
+    assert chunk == CHUNK
+    buf = bytearray()
+    while len(buf) < total:
+        part = link.execute("REPLSNAPSHOT", "FETCH", xid, len(buf))
+        assert len(part) <= CHUNK
+        buf += bytes(part)
+    assert len(buf) == total and zlib.crc32(bytes(buf)) == crc
+    assert bytes(link.execute("REPLSNAPSHOT", "END", xid)) == b"OK"
+    # the stage is GONE: a fetch after END is the restart signal, never
+    # silently re-staged data
+    with pytest.raises(RespError, match="SNAPEXPIRED"):
+        link.execute("REPLSNAPSHOT", "FETCH", xid, 0)
+    assert len(master.server._snap_stages) == 0
+
+
+def test_fetch_rereads_are_idempotent(master, link):
+    """The property the whole resume leans on: the staged cut is immutable,
+    so asking for the same offset twice yields the same bytes."""
+    xid, total, _, _ = link.execute("REPLSNAPSHOT", "BEGIN", "CHUNK", CHUNK)
+    a = bytes(link.execute("REPLSNAPSHOT", "FETCH", xid, CHUNK))
+    b = bytes(link.execute("REPLSNAPSHOT", "FETCH", xid, CHUNK))
+    assert a == b
+    link.execute("REPLSNAPSHOT", "END", xid)
+
+
+def test_fetch_offset_bounds_checked(master, link):
+    xid, total, _, _ = link.execute("REPLSNAPSHOT", "BEGIN", "CHUNK", CHUNK)
+    with pytest.raises(RespError):
+        link.execute("REPLSNAPSHOT", "FETCH", xid, int(total) + 1)
+    link.execute("REPLSNAPSHOT", "END", xid)
+
+
+# -- pull_snapshot under link chaos --------------------------------------------
+
+class _Boundary:
+    """Proxy link that raises ConnectionError the FIRST time each FETCH
+    offset is requested — the link dies at EVERY chunk boundary — then
+    lets the retry through."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.dropped = set()
+        self.begins = 0
+
+    def execute(self, *args, **kw):
+        if len(args) >= 2 and args[1] == "BEGIN":
+            self.begins += 1
+        if len(args) >= 4 and args[1] == "FETCH" and \
+                args[3] not in self.dropped:
+            self.dropped.add(args[3])
+            raise ConnectionError("chaos: link died at the boundary")
+        return self.inner.execute(*args, **kw)
+
+
+def test_pull_resumes_through_drop_at_every_boundary(master, link):
+    """The acceptance storm: the link drops at EVERY chunk boundary and
+    the pull still converges BIT-IDENTICAL to an unmolested pull — each
+    resume re-asks for the same offset, nothing is re-shipped, nothing is
+    skipped."""
+    clean = replication.pull_snapshot(link, timeout=30.0, chunk_bytes=CHUNK)
+    _multi_chunk(len(clean))
+    flaky = _Boundary(link)
+    blob = replication.pull_snapshot(
+        flaky, timeout=30.0, chunk_bytes=CHUNK,
+        max_link_errors=len(clean) // CHUNK + 8,
+    )
+    assert blob == clean
+    assert flaky.begins == 1                    # resumed, never restarted
+    assert len(flaky.dropped) == len(clean) // CHUNK + 1  # every boundary
+    assert len(master.server._snap_stages) == 0  # ENDed eagerly
+
+
+def test_pull_gives_up_after_link_error_budget(master, link):
+    class Dead:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def execute(self, *args, **kw):
+            if len(args) >= 2 and args[1] == "FETCH":
+                raise ConnectionError("chaos: hard down")
+            return self.inner.execute(*args, **kw)
+
+    with pytest.raises(ConnectionError):
+        replication.pull_snapshot(Dead(link), timeout=30.0,
+                                  chunk_bytes=CHUNK, max_link_errors=3)
+
+
+class _Expirer:
+    """Proxy that ENDs the transfer behind the puller's back after the
+    first chunk — the master-restarted/stage-reaped shape.  The puller
+    must restart from a fresh BEGIN, not resume into a different cut."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.begins = 0
+        self.sabotaged = False
+
+    def execute(self, *args, **kw):
+        if len(args) >= 2 and args[1] == "BEGIN":
+            self.begins += 1
+            self.last_xid = None
+        out = self.inner.execute(*args, **kw)
+        if len(args) >= 2 and args[1] == "BEGIN":
+            self.last_xid = bytes(out[0]).decode()
+        elif len(args) >= 2 and args[1] == "FETCH" and not self.sabotaged:
+            self.sabotaged = True
+            self.inner.execute("REPLSNAPSHOT", "END", self.last_xid)
+        return out
+
+
+def test_pull_restarts_on_snapexpired(master, link):
+    wrapper = _Expirer(link)
+    blob = replication.pull_snapshot(wrapper, timeout=30.0,
+                                     chunk_bytes=CHUNK)
+    assert wrapper.begins == 2                  # expired once, restarted once
+    assert zlib.crc32(blob) == zlib.crc32(
+        replication.pull_snapshot(link, timeout=30.0)
+    )
+
+
+def test_pull_restart_budget_bounded(master, link):
+    class AlwaysExpired:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def execute(self, *args, **kw):
+            if len(args) >= 2 and args[1] == "FETCH":
+                raise RespError("SNAPEXPIRED unknown snapshot transfer x")
+            return self.inner.execute(*args, **kw)
+
+    with pytest.raises(RespError, match="SNAPEXPIRED"):
+        replication.pull_snapshot(AlwaysExpired(link), timeout=30.0,
+                                  chunk_bytes=CHUNK, max_restarts=2)
+
+
+def test_torn_snapshot_is_never_returned(master, link):
+    """CRC gate: a corrupted chunk (right length, wrong bytes — the
+    torn/mixed-stage shape a length check cannot catch) must raise, so
+    the replica NEVER applies a torn snapshot."""
+    class Corruptor:
+        def __init__(self, inner):
+            self.inner = inner
+            self.hit = False
+
+        def execute(self, *args, **kw):
+            out = self.inner.execute(*args, **kw)
+            if len(args) >= 4 and args[1] == "FETCH" and not self.hit:
+                self.hit = True
+                return b"\x00" * len(out)
+            return out
+
+    with pytest.raises(ValueError, match="REPLSNAPSHOT torn"):
+        replication.pull_snapshot(Corruptor(link), timeout=30.0,
+                                  chunk_bytes=CHUNK, max_restarts=0)
+
+
+def test_legacy_full_blob_master_fallback():
+    """A master that predates the subcommands answers BEGIN with the whole
+    blob: pull_snapshot returns it as-is — one ship, no FETCH, exactly the
+    old behavior."""
+    class Legacy:
+        calls = []
+
+        def execute(self, *args, **kw):
+            self.calls.append(args)
+            return b"legacy-blob-bytes"
+
+    out = replication.pull_snapshot(Legacy(), timeout=5.0, chunk_bytes=CHUNK)
+    assert out == b"legacy-blob-bytes"
+    assert all(a[1] == "BEGIN" for a in Legacy.calls)
+
+
+# -- stage lifecycle (master side) ---------------------------------------------
+
+def test_stage_backstop_evicts_oldest(master, link):
+    """An abandoned-puller storm cannot pin unbounded snapshot copies:
+    the stage table is capped at SNAP_STAGE_MAX, least-recently-touched
+    evicted first (SNAPEXPIRED tells that puller to restart)."""
+    xids = []
+    for _ in range(replication.SNAP_STAGE_MAX + 2):
+        h = link.execute("REPLSNAPSHOT", "BEGIN", "CHUNK", CHUNK)
+        xids.append(bytes(h[0]).decode())
+    assert len(master.server._snap_stages) <= replication.SNAP_STAGE_MAX
+    with pytest.raises(RespError, match="SNAPEXPIRED"):
+        link.execute("REPLSNAPSHOT", "FETCH", xids[0], 0)
+    # the newest stage survived the storm
+    assert link.execute("REPLSNAPSHOT", "FETCH", xids[-1], 0)
+    for x in xids:
+        try:
+            link.execute("REPLSNAPSHOT", "END", x)
+        except RespError:
+            pass
+    assert len(master.server._snap_stages) == 0
+
+
+# -- the real full-sync path ---------------------------------------------------
+
+def test_replicaof_full_sync_rides_chunked_pull(monkeypatch):
+    """REPLICAOF end to end with the chunk size squeezed far below the
+    snapshot size: the replica's full sync runs BEGIN/FETCH/END, converges
+    to the master's records, and drains the master's stage table."""
+    monkeypatch.setattr(replication, "SNAPSHOT_CHUNK_BYTES", CHUNK)
+    with ServerThread() as m, ServerThread() as r:
+        with m.client() as c:
+            for i in range(200):
+                c.execute("SET", f"fs-{i}", "val" * 24 + str(i))
+        with r.client() as c:
+            reply = c.execute("REPLICAOF", "127.0.0.1", m.port,
+                              timeout=60.0)
+            assert bytes(reply) == b"OK"
+        with r.client() as c:
+            for i in (0, 57, 199):
+                got = c.execute("GET", f"fs-{i}")
+                assert bytes(got) == ("val" * 24 + str(i)).encode()
+        assert len(m.server._snap_stages) == 0

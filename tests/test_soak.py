@@ -172,3 +172,33 @@ def test_vector_soak_sharded_constellation():
     assert report.stale_results == 0
     assert report.recall_at_k >= 0.99
     assert report.writes_acked > 0 and report.reads > 0
+
+
+# -- cross-host fleet soak (ISSUE 16) ------------------------------------------
+
+
+@pytest.mark.slow
+def test_host_fleet_soak_two_cycle_host_kill_matrix():
+    """The ISSUE 16 soak acceptance: two cycles of the whole-host storm —
+    the import TARGET's host (master + the other master's replica) is
+    SIGKILLed and partitioned mid-drain, recovery promotes the off-host
+    replica and resumes the import readdressed onto it, the old target
+    rejoins as a replica — with the ownership ping-ponging between hosts
+    across cycles.  Zero acked-durable loss, exactly-one-owner, all slots
+    STABLE, bloom adds intact, flat client census, both cycles."""
+    from redisson_tpu.chaos.soak import (
+        HostFleetSoakConfig, HostFleetSoakHarness,
+    )
+
+    report = HostFleetSoakHarness(HostFleetSoakConfig(
+        cycles=2, seed=5,
+    )).run()
+    assert report.cycles_completed == 2
+    assert report.host_kills == 2
+    assert report.hosts_partitioned == 2
+    assert report.promotions == 2
+    assert report.server_sigkills == 4         # 2 processes per host kill
+    assert report.resumed_completed == 2
+    assert report.restarts == 4                # co-victim replica + old target
+    assert report.acked_writes > 0 and report.verified_writes > 0
+    assert report.bloom_keys_verified > 0

@@ -22,6 +22,15 @@
     SIGKILL whose journal must stay resumable.  Asserts zero
     acked-durable-write loss, exactly-one-owner, all slots STABLE with
     import journals terminal, bloom adds intact, flat client census.
+  * ``fleet-host`` — the failure-DOMAIN profile (ISSUE 16): the fleet
+    spans two host labels via the real ssh-driver command pipeline
+    (loopback transport), placement is host-anti-affine and the bus is
+    TLS-armed; mid-drain the import target's WHOLE host is SIGKILLed and
+    partitioned at once, then recovery restarts the surviving master's
+    replica, promotes the target's off-host replica, resumes the import
+    readdressed to it, and rejoins the old target as a replica.  Asserts
+    zero acked-durable-write loss, exactly-one-owner, all slots STABLE,
+    bloom adds intact, flat client census.
   * ``cluster-proc`` — the PROCESS-LEVEL profile (ISSUE 6): real
     ``tpu-server`` OS processes under a ClusterSupervisor serve a mixed
     write stream over real TCP while the coordinator dies at a journal
@@ -91,8 +100,8 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--profile",
                     choices=("standard", "migration", "cluster-proc",
-                             "fleet", "tracking", "device-shard", "qos",
-                             "vector"),
+                             "fleet", "fleet-host", "tracking",
+                             "device-shard", "qos", "vector"),
                     default="standard")
     ap.add_argument("--cycles", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
@@ -138,6 +147,18 @@ def main() -> int:
         harness = TrackingSoakHarness(TrackingSoakConfig(
             cycles=args.cycles, seed=args.seed,
             kill=not args.no_kill,
+        ))
+    elif args.profile == "fleet-host":
+        from redisson_tpu.chaos.soak import (
+            HostFleetSoakConfig, HostFleetSoakHarness,
+        )
+
+        harness = HostFleetSoakHarness(HostFleetSoakConfig(
+            cycles=args.cycles, seed=args.seed,
+            # smoke = one whole-host kill + partition mid-drain; the
+            # 2-cycle host-kill matrix runs in tests/test_soak.py's slow
+            # tier
+            crash_phases=("DRAINING:1",),
         ))
     elif args.profile == "fleet":
         from redisson_tpu.chaos.soak import FleetSoakConfig, FleetSoakHarness
