@@ -938,6 +938,256 @@ def bench_config6_tracking():
         st.stop()
 
 
+def bench_config6r_read_scaling():
+    """Config 6R: the read-scaling plane (ISSUE 17) — zipf-distributed
+    BF.MEXISTS64 blob reads fanned out to replicas via
+    ``read_mode=replica`` + the occupancy balancer, at 1 / 2 / 4 replicas
+    behind ONE master, with a light concurrent writer and the bounded-
+    staleness probe riding every replica read.
+
+    Throughput model (the config5d convention): on a chip-less container
+    the CPU-replica occupancy knob charges each node's device lane the
+    per-chip compute time a real accelerator would serialize per blob
+    (RTPU_REPLICA_NS ns/item; a 128-key blob is 16 device items), so one
+    node's lane bounds one node's read rate and extra replicas add serving
+    lanes exactly the way extra chips would.  On a real TPU the model stays
+    disarmed and the legs measure actual chips.
+
+    Numbers:
+      * ``config6r_read_qps_scaling`` — 4-replica read QPS over 1-replica
+        read QPS (gated >= 2.5x: replicas must actually absorb reads);
+      * ``config6r_staleness_p99_ms`` — p99 replica staleness (REPLSTATE
+        receipt clock) sampled through the 4-replica read window, writer
+        active (ceiling-gated: the push/heartbeat stream must keep
+        replicas fresh while they serve).
+
+    Every leg also A/B-checks the contract that makes replica serving
+    safe to ship: the SAME query stream answered by a replica-fanned
+    client and a master-only client must hash byte-identical."""
+    import hashlib
+    import os
+    import threading
+
+    import jax
+
+    from redisson_tpu.client.cluster import ClusterRedisson
+    from redisson_tpu.core import ioplane
+    from redisson_tpu.harness import ClusterRunner
+    from redisson_tpu.net.balancer import OccupancyLoadBalancer
+    from redisson_tpu.net.client import NodeClient
+
+    n_keys = 8
+    # 2048-key probe blobs: the modeled per-chip lane time (2048 items x
+    # RTPU_REPLICA_NS) must dominate the host-side parse/dispatch work,
+    # which is GIL-shared across the in-proc nodes and thus does NOT scale
+    # with replica count — exactly the regime a real chip fleet is in
+    # (device compute >> host shuffling), and the regime where added
+    # replicas translate to added read throughput
+    blob_keys = 2048
+    reader_threads = 16
+    ops_per_thread = 48
+    zipf_s = 1.0
+    max_staleness_ms = 2000
+    platform = jax.local_devices()[0].platform
+    replica_ns = (
+        float(os.environ.get("RTPU_REPLICA_NS", "10000"))
+        if platform == "cpu" else None
+    )
+    p = 1.0 / np.power(np.arange(1, n_keys + 1), zipf_s)
+    p /= p.sum()
+    member_pool = np.arange(4096, dtype=np.int64) * 2654435761
+
+    legs = {}
+    for n_rep in (1, 2, 4):
+        leg = f"{n_rep}r"
+        runner = ClusterRunner(
+            masters=1, replicas_per_master=n_rep, devices=1, workers=8
+        )
+        prev_ns = ioplane.set_replica_occupancy(replica_ns)
+        reader = seed = None
+        try:
+            runner.run()
+            seed = runner.client()
+            keys = [f"c6r:{i}" for i in range(n_keys)]
+            for k in keys:
+                seed.execute("BF.RESERVE", k, 0.01, 100_000)
+                seed.execute(
+                    "BF.MADD64", k, member_pool[:2048].astype("<i8").tobytes()
+                )
+            seed.sync_replication(keys)
+
+            reader = ClusterRedisson(
+                runner.seeds(), read_mode="replica",
+                max_staleness_ms=max_staleness_ms,
+                balancer=OccupancyLoadBalancer(),
+                scan_interval=0, ping_interval=0,
+                pool_size=reader_threads, timeout=180.0,
+            )
+            assert reader.wait_routable(timeout=60)
+            # light writer: keeps the replication stream carrying real
+            # deltas through the read window (staleness is measured under
+            # write traffic, not an idle heartbeat)
+            stop_writer = threading.Event()
+            wrng = np.random.default_rng(31)
+
+            def write_loop():
+                while not stop_writer.is_set():
+                    k = keys[int(wrng.choice(n_keys, p=p))]
+                    blob = wrng.choice(member_pool, size=64)
+                    try:
+                        seed.execute(
+                            "BF.MADD64", k, blob.astype("<i8").tobytes()
+                        )
+                    except Exception:  # noqa: BLE001 — bench writer is best-effort
+                        pass
+                    stop_writer.wait(0.01)
+
+            # staleness sampler: poll every replica's REPLSTATE through the
+            # window (receipt-clock ms; -1 = never synced, counted raw)
+            stale_samples: list = []
+            stop_sampler = threading.Event()
+            rep_addrs = [n.address for n in runner.replicas]
+
+            def sample_loop():
+                nodes = [
+                    NodeClient(a, ping_interval=0, retry_attempts=0)
+                    for a in rep_addrs
+                ]
+                try:
+                    while not stop_sampler.is_set():
+                        for nd in nodes:
+                            try:
+                                st = nd.execute("REPLSTATE", timeout=5.0)
+                                stale_samples.append(int(st[2]))
+                            except Exception:  # noqa: BLE001
+                                pass
+                        stop_sampler.wait(0.01)
+                finally:
+                    for nd in nodes:
+                        nd.close()
+
+            streams = []
+            for ti in range(reader_threads):
+                trng = np.random.default_rng(100 + ti)
+                idx = trng.choice(n_keys, size=ops_per_thread, p=p)
+                blobs = [
+                    trng.choice(member_pool, size=blob_keys)
+                    .astype("<i8").tobytes()
+                    for _ in range(ops_per_thread)
+                ]
+                streams.append((idx, blobs))
+            start = threading.Barrier(reader_threads + 1)
+            errors: list = []
+
+            def read_worker(ti):
+                idx, blobs = streams[ti]
+                try:
+                    start.wait()
+                    for j in range(ops_per_thread):
+                        reader.execute(
+                            "BF.MEXISTS64", keys[idx[j]], blobs[j]
+                        )
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            writer = threading.Thread(target=write_loop, daemon=True)
+            sampler = threading.Thread(target=sample_loop, daemon=True)
+            threads = [
+                threading.Thread(target=read_worker, args=(ti,), daemon=True)
+                for ti in range(reader_threads)
+            ]
+            writer.start()
+            sampler.start()
+            for t in threads:
+                t.start()
+            start.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            stop_writer.set()
+            stop_sampler.set()
+            writer.join(timeout=5)
+            sampler.join(timeout=5)
+            if errors:
+                raise errors[0]
+            total_ops = reader_threads * ops_per_thread
+            qps = total_ops / wall if wall > 0 else 0.0
+
+            # byte-identity A/B: one settled query stream, replica-fanned
+            # vs master-only, hashed reply-for-reply
+            seed.sync_replication(keys)
+            time.sleep(0.5)
+            master_c = ClusterRedisson(
+                runner.seeds(), read_mode="master",
+                scan_interval=0, ping_interval=0, timeout=180.0,
+            )
+            vrng = np.random.default_rng(7)
+            qidx = vrng.choice(n_keys, size=64, p=p)
+            qblobs = [
+                vrng.choice(member_pool, size=blob_keys)
+                .astype("<i8").tobytes()
+                for _ in range(64)
+            ]
+            h_rep, h_mas = hashlib.sha256(), hashlib.sha256()
+            for j in range(64):
+                h_rep.update(
+                    bytes(reader.execute("BF.MEXISTS64", keys[qidx[j]], qblobs[j]))
+                )
+                h_mas.update(
+                    bytes(master_c.execute("BF.MEXISTS64", keys[qidx[j]], qblobs[j]))
+                )
+            assert h_rep.hexdigest() == h_mas.hexdigest(), (
+                f"config6r[{leg}]: replica-served replies diverged from master"
+            )
+            master_c.shutdown()
+
+            valid = [s for s in stale_samples if s >= 0]
+            p99 = float(np.percentile(valid, 99)) if valid else -1.0
+            legs[leg] = {
+                "replicas": n_rep,
+                "read_qps": round(qps),
+                "wall_s": round(wall, 3),
+                "ops": total_ops,
+                "staleness_p99_ms": round(p99, 1),
+                "staleness_samples": len(stale_samples),
+                "read_stats": dict(reader.read_stats),
+                "replies_bit_identical": True,
+            }
+            log(
+                f"config6r[{leg}]: {total_ops} blob reads, {n_rep} replica(s) "
+                f"= {qps/1e3:.2f}k reads/s, staleness p99 {p99:.0f}ms, "
+                f"client stats {reader.read_stats}, replies bit-identical"
+            )
+            reader.shutdown()
+            reader = None
+        finally:
+            ioplane.set_replica_occupancy(prev_ns)
+            if reader is not None:
+                reader.shutdown()
+            if seed is not None:
+                seed.shutdown()
+            runner.shutdown()
+    scaling = (
+        legs["4r"]["read_qps"] / legs["1r"]["read_qps"]
+        if legs["1r"]["read_qps"] else 0.0
+    )
+    log(
+        f"config6r: 4-replica {legs['4r']['read_qps']/1e3:.2f}k vs 1-replica "
+        f"{legs['1r']['read_qps']/1e3:.2f}k reads/s = {scaling:.2f}x, "
+        f"4r staleness p99 {legs['4r']['staleness_p99_ms']}ms "
+        f"(occupancy {replica_ns or 0:.0f}ns/item, bound {max_staleness_ms}ms)"
+    )
+    return {
+        "config6r_read_qps_scaling": round(scaling, 3),
+        "config6r_staleness_p99_ms": legs["4r"]["staleness_p99_ms"],
+        "config6r_read_qps_4r": legs["4r"]["read_qps"],
+        "replica_occupancy_ns_per_item": replica_ns,
+        "max_staleness_ms": max_staleness_ms,
+        "legs": legs,
+    }
+
+
 def bench_config2q_qos():
     """Config 2Q: tail-latency under a hostile mixed-tenant workload
     (ISSUE 10 — the deadline-aware window scheduler + per-tenant QoS).
@@ -1686,6 +1936,12 @@ def child(which: str) -> None:
         result["async_parity"] = bench_config2a_async_parity()
     elif which == "6":
         result["tracking"] = bench_config6_tracking()
+    elif which == "6r":
+        # read-scaling legs (ISSUE 17): each leg is its own in-proc cluster
+        # with devices=1 per node — the CPU-replica occupancy model charges
+        # each NODE's single lane, so scaling comes from more serving nodes,
+        # not from forcing a host-device mesh
+        result["read_scaling"] = bench_config6r_read_scaling()
     elif which == "2q":
         # QoS A/B (ISSUE 10): one server, hostile + interactive tenants —
         # host-side dispatch contention is the thing measured, so the CPU
@@ -1734,7 +1990,7 @@ def main():
 
     results: dict = {}
     for which in ("2", "2L", "2A", "2q", "1", "3", "4", "5", "5p", "5d", "6",
-                  "7", "7s"):
+                  "6r", "7", "7s"):
         p = subprocess.run(
             [sys.executable, __file__, "--config", which],
             stdout=subprocess.PIPE,
@@ -1775,6 +2031,12 @@ def main():
                     "config6_server_op_reduction": results["6"]["tracking"]["config6_server_op_reduction"],
                     "config6_tracked_read_ops_per_sec": results["6"]["tracking"]["config6_tracked_read_ops_per_sec"],
                     "config6_tracking": results["6"]["tracking"],
+                    # config6r (ISSUE 17): replica read-scaling legs —
+                    # zipf blob reads fanned to 1/2/4 replicas under the
+                    # config5d occupancy convention, staleness-probed
+                    "config6r_read_qps_scaling": results["6r"]["read_scaling"]["config6r_read_qps_scaling"],
+                    "config6r_staleness_p99_ms": results["6r"]["read_scaling"]["config6r_staleness_p99_ms"],
+                    "config6r_read_scaling": results["6r"]["read_scaling"],
                     "config2q_interactive_p99_ms": results["2q"]["qos"]["config2q_interactive_p99_ms"],
                     "config2q_fairness_p99_ratio": results["2q"]["qos"]["config2q_fairness_p99_ratio"],
                     "config2q_interactive_speedup_vs_noqos": results["2q"]["qos"]["config2q_interactive_speedup_vs_noqos"],

@@ -2279,6 +2279,528 @@ class TrackingSoakHarness:
             self._teardown()
 
 
+# -- read-scale soak (ISSUE 17): replica-served tracked reads under fire ------
+
+
+@dataclass
+class ReadScaleSoakConfig:
+    """Tracked zipf readers served FROM REPLICAS (read_mode=replica +
+    bounded staleness) while a replica takes a kill mid-traffic (reads must
+    drain to the master), the write-owning master is killed and promoted,
+    and key-bearing slots migrate — the coherence storm for the
+    read-scaling plane."""
+
+    seed: int = 0
+    cycles: int = 1
+    keys: int = 48
+    readers: int = 3
+    writer_threads: int = 2
+    phase_seconds: float = 1.2
+    migrate_count: int = 4          # slots round-tripped m0 -> m1 -> m0
+    kill: bool = True               # master SIGKILL + promote leg
+    replica_kill: bool = True       # replica SIGKILL leg (drain to master)
+    max_staleness_ms: int = 5000
+    failover_deadline_s: float = 45.0
+    quiesce_deadline_s: float = 10.0
+
+
+@dataclass
+class ReadScaleSoakReport:
+    cycles_completed: int = 0
+    reads: int = 0
+    writes_acked: int = 0
+    errors: int = 0
+    stale_reads: int = 0            # monotonicity violations (MUST stay 0)
+    replica_reads: int = 0          # client-counted replica-served reads
+    replica_fallbacks: int = 0      # drained to master (outage/transport)
+    replica_redirects_stale: int = 0
+    migrations: int = 0
+    failovers: int = 0
+    replica_kills: int = 0
+    records_migrated: int = 0
+    converged_keys: int = 0
+    cache_stats: List[Dict[str, float]] = field(default_factory=list)
+    census: List[Dict[str, float]] = field(default_factory=list)
+
+    def summary(self) -> str:
+        return (
+            f"read-scale soak: {self.cycles_completed} cycles, "
+            f"{self.reads} tracked reads ({self.stale_reads} stale, "
+            f"{self.replica_reads} replica-served, "
+            f"{self.replica_fallbacks} drained to master, "
+            f"{self.replica_redirects_stale} staleness redirects), "
+            f"{self.writes_acked} acked writes, {self.errors} budgeted "
+            f"errors, {self.migrations} slot round-trips "
+            f"({self.records_migrated} records), {self.failovers} failovers, "
+            f"{self.replica_kills} replica kills, "
+            f"{self.converged_keys} keys converged, "
+            f"census points={len(self.census)}"
+        )
+
+
+class ReadScaleSoakHarness:
+    """The read-scaling contract, under fire: tracked zipf readers route
+    every keyed read to REPLICAS (``read_mode=replica`` with the
+    bounded-staleness probe riding each read), and even so **no tracked
+    read may ever go BACKWARDS** (per reader, per key) — replica-side
+    tracking tables must invalidate near caches on REPLPUSH apply exactly
+    like a master's write path does.  The storm per cycle:
+
+    (1) key-bearing slots migrate m0 -> m1 and back while readers run —
+        replica reads for an in-flight slot must redirect/fallback, never
+        serve a stale or vanished record;
+    (2) a REPLICA is killed mid-traffic: its shard's reads must DRAIN TO
+        THE MASTER (replica_fallbacks > 0, zero reader errors attributable
+        to the dead replica beyond the budget), then the replica restarts
+        and re-hydrates;
+    (3) the write-owning MASTER is killed (writers paused over the
+        REPLFLUSH+kill window), the FailoverCoordinator promotes its
+        replica — the promoted node flips to master serving, the dead node
+        restarts as a replica and re-hydrates from the promoted master.
+
+    After the storm quiesces every reader's near-cache view must CONVERGE
+    to ground truth, no acked write may be lost, and the census must drain
+    flat (tracking tables empty once readers disconnect)."""
+
+    def __init__(self, config: Optional[ReadScaleSoakConfig] = None):
+        self.config = config or ReadScaleSoakConfig()
+        self.report = ReadScaleSoakReport()
+        self.census = ResourceCensus()
+        self._rng = np.random.default_rng(self.config.seed)
+        self._runner = None
+        self._coord = None
+        self._writer_client = None
+        self._readers = []            # (client, plane, {key: bucket})
+        # per-reader high-water marks shared ACROSS phases (same rationale
+        # as TrackingSoakHarness: a backwards read right after a phase
+        # boundary must still count)
+        self._reader_last: List[Dict[str, int]] = []
+        self._acked: Dict[str, int] = {}
+        self._acked_lock = threading.Lock()
+        self._failovers_seen = 0
+        self._violations: List[str] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _key(self, i: int) -> str:
+        return f"rs:{i}"
+
+    def _setup(self) -> None:
+        from redisson_tpu.harness import ClusterRunner
+        from redisson_tpu.net.balancer import OccupancyLoadBalancer
+        from redisson_tpu.server.monitor import FailoverCoordinator
+
+        cfg = self.config
+        self._runner = ClusterRunner(masters=2, replicas_per_master=1).run()
+        self._writer_client = self._runner.client(
+            scan_interval=0.5, timeout=10.0, connect_timeout=5.0,
+            retry_attempts=1, retry_interval=0.2,
+        )
+        for i in range(cfg.keys):
+            self._writer_client.get_bucket(self._key(i)).set(0)
+            self._acked[self._key(i)] = 0
+        # replicas need the seed values before readers arrive
+        self._replflush_all()
+        for _r in range(cfg.readers):
+            c = self._runner.client(
+                read_mode="replica",
+                max_staleness_ms=cfg.max_staleness_ms,
+                balancer=OccupancyLoadBalancer(),
+                scan_interval=0.5, timeout=10.0, connect_timeout=5.0,
+                retry_attempts=1, retry_interval=0.2,
+            )
+            plane = c.enable_tracking(cache_entries=8 * cfg.keys)
+            buckets = {
+                self._key(i): plane.get_bucket(self._key(i))
+                for i in range(cfg.keys)
+            }
+            self._readers.append((c, plane, buckets))
+            self._reader_last.append({})
+        if cfg.kill:
+            self._coord = FailoverCoordinator(
+                self._runner.view_tuples(), check_interval=0.1
+            ).start()
+            time.sleep(0.5)  # coordinator learns the replica sets
+        self.census.track_client("writer", self._writer_client)
+
+    def _replflush_all(self) -> None:
+        from redisson_tpu.harness import _exec
+
+        for m in self._runner.masters:
+            if m.stopped:
+                continue
+            try:
+                with m.server.client() as c:
+                    _exec(c, "REPLFLUSH", timeout=60.0)
+            except Exception:  # noqa: BLE001 — node mid-restart
+                pass
+
+    def _teardown(self) -> None:
+        if self._coord is not None:
+            self._coord.stop()
+        for c, _plane, _b in self._readers:
+            try:
+                c.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._writer_client is not None:
+            self._writer_client.shutdown()
+        if self._runner is not None:
+            self._runner.shutdown()
+
+    # -- workload ------------------------------------------------------------
+
+    def _writer(self, wid: int, stop: threading.Event) -> None:
+        cfg = self.config
+        client = self._writer_client
+        my_keys = [self._key(i) for i in range(wid, cfg.keys, cfg.writer_threads)]
+        vals = {k: self._acked.get(k, 0) for k in my_keys}
+        j = 0
+        while not stop.is_set():
+            k = my_keys[j % len(my_keys)]
+            v = vals[k] + 1
+            try:
+                client.get_bucket(k).set(v)
+                vals[k] = v
+                with self._acked_lock:
+                    self._acked[k] = v
+                    self.report.writes_acked += 1
+            except Exception:  # noqa: BLE001 — budgeted outage-window error
+                with self._acked_lock:
+                    self.report.errors += 1
+            j += 1
+            time.sleep(0.002)
+
+    def _reader(self, rid: int, stop: threading.Event) -> None:
+        cfg = self.config
+        _c, _plane, buckets = self._readers[rid]
+        rng = np.random.default_rng(self.config.seed * 131 + rid)
+        p = 1.0 / np.power(np.arange(1, cfg.keys + 1), 1.0)
+        p /= p.sum()
+        last = self._reader_last[rid]  # spans phases (see __init__)
+        n = 0
+        while not stop.is_set():
+            k = self._key(int(rng.choice(cfg.keys, p=p)))
+            try:
+                v = buckets[k].get()
+            except Exception:  # noqa: BLE001 — budgeted outage-window error
+                with self._acked_lock:
+                    self.report.errors += 1
+                time.sleep(0.01)
+                continue
+            n += 1
+            if v is not None:
+                prev = last.get(k)
+                if prev is not None and v < prev:
+                    with self._acked_lock:
+                        self.report.stale_reads += 1
+                        self._violations.append(
+                            f"reader {rid} key {k}: saw {v} after {prev}"
+                        )
+                if prev is None or v > prev:
+                    last[k] = v
+        with self._acked_lock:
+            self.report.reads += n
+
+    def _phase(self, seconds: float, writers: bool = True) -> None:
+        stop = threading.Event()
+        threads = [
+            threading.Thread(target=self._reader, args=(r, stop), daemon=True)
+            for r in range(self.config.readers)
+        ]
+        if writers:
+            threads += [
+                threading.Thread(target=self._writer, args=(w, stop), daemon=True)
+                for w in range(self.config.writer_threads)
+            ]
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert not any(t.is_alive() for t in threads), "read-scale soak worker wedged"
+
+    # -- chaos ops -----------------------------------------------------------
+
+    def _migrate_roundtrip(self) -> None:
+        from redisson_tpu.server.migration import migrate_slots
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        cfg = self.config
+        runner = self._runner
+        lo, hi = runner.slot_ranges[0]
+        key_slots = []
+        for i in range(cfg.keys):
+            s = calc_slot(self._key(i).encode())
+            if lo <= s <= hi and s not in key_slots:
+                key_slots.append(s)
+            if len(key_slots) >= cfg.migrate_count:
+                break
+        if not key_slots:
+            return
+        src = runner.masters[0].address
+        dst = runner.masters[1].address
+        nodes = runner.seeds()
+        moved = migrate_slots(src, dst, key_slots, all_nodes=nodes)
+        self.report.records_migrated += moved
+        moved = migrate_slots(dst, src, key_slots, all_nodes=nodes)
+        self.report.records_migrated += moved
+        self.report.migrations += 1
+        # migrated-in records reach the destination's replica on its next
+        # sweep; flush now so replica reads answer fresh immediately
+        self._replflush_all()
+        for c in [self._writer_client] + [c for c, _p, _b in self._readers]:
+            c.refresh_topology()
+
+    def _kill_replica(self) -> None:
+        """SIGKILL-analog on the replica serving key 0's shard: reads keep
+        flowing (the client drains them to the master — replica_fallbacks
+        must move), then the replica restarts empty, re-wires, and the
+        master's cover stream re-hydrates it."""
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        runner = self._runner
+        slot = calc_slot(self._key(0).encode())
+        mi = next(
+            i for i, (lo, hi) in enumerate(runner.slot_ranges) if lo <= slot <= hi
+        )
+        master_addr = runner.masters[mi].address
+        victim = next(
+            (r for r in runner.replicas
+             if not r.stopped and r.master_index == mi), None
+        )
+        if victim is None:
+            return
+        runner.stop_node(victim)
+        self.report.replica_kills += 1
+        # reads + writes continue against the degraded shard: every read
+        # that would have gone to the dead replica must fall back to the
+        # master — the drain contract this leg exists to prove
+        self._phase(self.config.phase_seconds)
+        runner.restart_node(victim)
+        # readers-only over the catch-up window: the restarted replica is
+        # EMPTY until the cover stream re-ships, and version skew while
+        # writers run would fake a staleness signal once reads return to it
+        self._replflush_all()
+        self._phase(0.3, writers=False)
+        for c in [self._writer_client] + [c for c, _p, _b in self._readers]:
+            c.refresh_topology()
+        _ = master_addr  # kept for debuggability in assertion messages
+
+    def _reconcile_failovers(self) -> None:
+        runner, coord = self._runner, self._coord
+        fos = coord.failovers
+        while self._failovers_seen < len(fos):
+            dead_addr, promoted_addr = fos[self._failovers_seen]
+            self._failovers_seen += 1
+            self.report.failovers += 1
+            dead = runner.adopt_failover(dead_addr, promoted_addr)
+            if dead is not None and dead.stopped:
+                runner.restart_node(dead)
+
+    def _kill_failover(self) -> None:
+        """Master SIGKILL + promote (writers already paused by the calling
+        readers-only phase): the promoted replica must flip to master
+        serving — its device plane rebuilt under the promoted fence epoch —
+        while tracked replica reads keep answering without a backwards
+        step."""
+        from redisson_tpu.harness import _exec
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        cfg = self.config
+        runner, coord = self._runner, self._coord
+        self._reconcile_failovers()
+        slot = calc_slot(self._key(0).encode())
+        mi = next(
+            i for i, (lo, hi) in enumerate(runner.slot_ranges) if lo <= slot <= hi
+        )
+        victim = runner.masters[mi]
+        victim_addr = victim.address
+        with victim.server.client() as c:
+            _exec(c, "REPLFLUSH", timeout=60.0)
+        seen = self._failovers_seen
+        runner.stop_master(mi)
+        deadline = time.monotonic() + cfg.failover_deadline_s
+        while time.monotonic() < deadline and not any(
+            d == victim_addr for d, _p in coord.failovers[seen:]
+        ):
+            time.sleep(0.1)
+        assert any(
+            d == victim_addr for d, _p in coord.failovers[seen:]
+        ), "no automatic failover happened"
+        self._reconcile_failovers()
+        time.sleep(0.5)
+        self._replflush_all()
+        for c in [self._writer_client] + [c for c, _p, _b in self._readers]:
+            c.refresh_topology()
+
+    # -- convergence + leak checks -------------------------------------------
+
+    def _collect_read_stats(self) -> None:
+        for c, _plane, _b in self._readers:
+            st = getattr(c, "read_stats", {})
+            self.report.replica_reads += int(st.get("replica_reads", 0))
+            self.report.replica_fallbacks += int(st.get("replica_fallbacks", 0))
+            self.report.replica_redirects_stale += int(
+                st.get("replica_redirects_stale", 0)
+            )
+
+    def _verify_convergence(self) -> None:
+        """Writers stopped, pushes flushed: every reader's tracked
+        replica-routed read must converge to ground truth for every key."""
+        cfg = self.config
+        with self._acked_lock:
+            acked = dict(self._acked)
+        self._replflush_all()
+        ground = self._runner.client(scan_interval=0, timeout=10.0)
+        try:
+            for i in range(cfg.keys):
+                k = self._key(i)
+                truth = None
+                for _ in range(20):
+                    try:
+                        truth = ground.get_bucket(k).get()
+                        break
+                    except Exception:  # noqa: BLE001 — topology settling
+                        time.sleep(0.2)
+                # same durability shape as the tracking soak: truth may run
+                # one AHEAD of acked (applied write whose ack was lost to a
+                # budgeted error) but never behind
+                assert truth is not None and truth >= acked[k], (
+                    f"acked write lost: {k} want >= {acked[k]!r} got {truth!r}"
+                )
+                for rid, (rc, _plane, buckets) in enumerate(self._readers):
+                    got = None
+                    for _ in range(25):
+                        try:
+                            got = buckets[k].get()
+                        except Exception:  # noqa: BLE001 — topology settling
+                            try:
+                                rc.refresh_topology()
+                            except Exception:  # noqa: BLE001
+                                pass
+                            time.sleep(0.2)
+                            continue
+                        if got == truth:
+                            break
+                        time.sleep(0.1)
+                    assert got == truth, (
+                        f"STALE replica-served read after quiesce: reader "
+                        f"{rid} key {k} want {truth!r} got {got!r}"
+                    )
+                self.report.converged_keys += 1
+        finally:
+            ground.shutdown()
+
+    def _quiesce_census(self, cycle: int) -> None:
+        cfg = self.config
+        runner = self._runner
+        live = [n for n in runner.masters + runner.replicas if not n.stopped]
+        for i, node in enumerate(live):
+            self.census.track_server(f"server{i}", node.server.server)
+        self._collect_read_stats()
+        for c, plane, _b in self._readers:
+            self.report.cache_stats.append(plane.stats())
+            c.shutdown()
+        self._readers = []
+        deadline = time.monotonic() + cfg.quiesce_deadline_s
+        snap = self.census.snapshot()
+        while time.monotonic() < deadline:
+            busy = [
+                k for k, v in snap.items()
+                if v and k.endswith((".tracking_table_keys", ".tracking_conns",
+                                     ".tracking_bcast_conns", ".conn_in_use",
+                                     ".tracking_slot_index_keys",
+                                     ".tracking_client_index_keys"))
+            ]
+            if not busy:
+                break
+            time.sleep(0.2)
+            snap = self.census.snapshot()
+        for k, v in snap.items():
+            if k.endswith((".tracking_table_keys", ".tracking_conns",
+                           ".tracking_bcast_conns",
+                           ".tracking_slot_index_keys",
+                           ".tracking_client_index_keys")):
+                assert v == 0, (
+                    f"cycle {cycle}: tracking table leaked after reader "
+                    f"disconnect (replica tables included): {k} = {v}"
+                )
+        self.report.census.append(snap)
+
+    # -- the run loop --------------------------------------------------------
+
+    def run(self) -> ReadScaleSoakReport:
+        cfg = self.config
+        self._setup()
+        try:
+            for cycle in range(cfg.cycles):
+                self._phase(cfg.phase_seconds)
+                # migration leg concurrent with replica-routed traffic
+                mig_err: List[BaseException] = []
+
+                def migrate_leg():
+                    try:
+                        self._migrate_roundtrip()
+                    except BaseException as e:  # noqa: BLE001 — re-raised below
+                        mig_err.append(e)
+
+                mig_thread = threading.Thread(target=migrate_leg, daemon=True)
+                mig_thread.start()
+                while mig_thread.is_alive():
+                    self._phase(0.3)
+                mig_thread.join()
+                if mig_err:
+                    raise mig_err[0]
+                if cfg.replica_kill:
+                    self._kill_replica()
+                self._phase(cfg.phase_seconds)
+                if cfg.kill:
+                    kill_err: List[BaseException] = []
+
+                    def kill_leg():
+                        try:
+                            self._kill_failover()
+                        except BaseException as e:  # noqa: BLE001 — re-raised below
+                            kill_err.append(e)
+
+                    kill_thread = threading.Thread(target=kill_leg, daemon=True)
+                    kill_thread.start()
+                    while kill_thread.is_alive():
+                        self._phase(0.3, writers=False)
+                    kill_thread.join()
+                    if kill_err:
+                        raise kill_err[0]
+                    self._phase(cfg.phase_seconds)
+                self.report.cycles_completed += 1
+            if cfg.kill:
+                assert self.report.failovers >= 1, (
+                    "kill profile ran but no failover was recorded"
+                )
+            self._verify_convergence()
+            assert self.report.stale_reads == 0, (
+                f"{self.report.stale_reads} stale tracked replica reads: "
+                + "; ".join(self._violations[:5])
+            )
+            budget = max(10, self.report.writes_acked // 2)
+            assert self.report.errors <= budget, (
+                f"error budget blown: {self.report.errors} vs budget {budget}"
+            )
+            self._quiesce_census(cfg.cycles - 1)
+            assert self.report.replica_reads > 0, (
+                "read-scale soak never served a read from a replica"
+            )
+            if cfg.replica_kill:
+                assert self.report.replica_fallbacks > 0, (
+                    "replica was killed mid-traffic but no read drained to "
+                    "the master"
+                )
+            return self.report
+        finally:
+            self._teardown()
+
+
 # -- device-shard soak (ISSUE 8): slot -> device rebalance under traffic ------
 
 

@@ -48,7 +48,11 @@ class ShardEntry:
     def sync_replicas(self, addresses: List[str]) -> None:
         for addr in addresses:
             if addr not in self.replicas:
-                self.replicas[addr] = NodeClient(addr, **self._node_kw)
+                # replica connections arm READONLY at handshake (ISSUE 17):
+                # a cluster replica -MOVEDs keyed reads from plain conns
+                self.replicas[addr] = NodeClient(
+                    addr, readonly=True, **self._node_kw
+                )
         for addr in list(self.replicas):
             if addr not in addresses:
                 self.replicas.pop(addr).close()
@@ -87,6 +91,8 @@ class ClusterRedisson(RemoteSurface):
         scan_interval: float = 5.0,
         dns_monitoring_interval: float = 5.0,
         max_redirects: int = 5,
+        max_staleness_ms: Optional[int] = None,
+        max_staleness_offset: Optional[int] = None,
         **node_kw,
     ):
         from redisson_tpu.config import Config
@@ -94,6 +100,21 @@ class ClusterRedisson(RemoteSurface):
         self.config = config or Config()
         self.read_mode = read_mode
         self.max_redirects = max_redirects
+        # bounded-staleness contract (ISSUE 17): with either bound set,
+        # every replica-served read pipelines a REPLSTATE MAXSTALE probe in
+        # the SAME frame and the client redirects to the master when the
+        # answer is too stale.  max_staleness_ms bounds time since the
+        # replica's last applied push/heartbeat; max_staleness_offset bounds
+        # sweep-cut lag against the highest offset this client has seen any
+        # node of the shard prove.
+        self.max_staleness_ms = max_staleness_ms
+        self.max_staleness_offset = max_staleness_offset
+        self.read_stats: Dict[str, int] = {
+            "replica_reads": 0,
+            "replica_redirects_stale": 0,
+            "replica_fallbacks": 0,
+        }
+        self._shard_offsets: Dict[str, int] = {}  # master addr -> max offset seen
         self._balancer_factory = balancer
         self._node_kw = dict(node_kw)
         # config-level SPIs ride every node connection of the cluster
@@ -370,7 +391,14 @@ class ClusterRedisson(RemoteSurface):
                     node = entries[attempt % len(entries)].master
                 else:
                     entry = self.entry_for_slot(slot)
-                    node = entry.master if write else entry.read_node(self.read_mode)
+                    if write:
+                        node = entry.master
+                    else:
+                        node = entry.read_node(self.read_mode)
+                        if node is not entry.master:
+                            return self._execute_replica_read(
+                                entry, node, cmd_args, timeout
+                            )
                 return node.execute(*cmd_args, timeout=timeout)
             except RespError as e:
                 msg = str(e)
@@ -425,6 +453,72 @@ class ClusterRedisson(RemoteSurface):
                 continue
         assert last is not None
         raise last
+
+    def _execute_replica_read(self, entry: ShardEntry, node: NodeClient,
+                              cmd_args, timeout) -> Any:
+        """Replica-served read under the bounded-staleness contract
+        (ISSUE 17).  With a staleness bound configured, the REPLSTATE
+        MAXSTALE probe rides the SAME pipelined frame as the read — one
+        round trip, one connection — and its reply decides CLIENT-side
+        whether the answer is admissible: too stale (or never synced, or a
+        reply-shape surprise) and the master re-serves.  Transport failure
+        mid-read drains to the master too (reads are idempotent); redirect
+        errors re-enter the outer redirect loop like master-served reads."""
+        probe = (self.max_staleness_ms is not None
+                 or self.max_staleness_offset is not None)
+        try:
+            if not probe:
+                reply = node.execute(*cmd_args, timeout=timeout)
+                self.read_stats["replica_reads"] += 1
+                return reply
+            ms = self.max_staleness_ms
+            replies = node.execute_many(
+                [("REPLSTATE", "MAXSTALE", int(1 << 30 if ms is None else ms)),
+                 tuple(cmd_args)],
+                timeout=timeout,
+            )
+        except (ConnectionError, OSError, TimeoutError):
+            self.read_stats["replica_fallbacks"] += 1
+            return entry.master.execute(*cmd_args, timeout=timeout)
+        state, reply = replies[0], replies[1]
+        if isinstance(reply, RespError) and str(reply).startswith(
+            ("MOVED ", "ASK ", "TRYAGAIN", "CLUSTERDOWN", "RECOVERING")
+        ):
+            # fenced / migrating / mid-hand-off slot: NEVER replica-served —
+            # the outer redirect loop re-routes exactly as for a master read
+            raise reply
+        if isinstance(state, RespError) or not self._fresh_enough(entry, state):
+            self.read_stats["replica_redirects_stale"] += 1
+            return entry.master.execute(*cmd_args, timeout=timeout)
+        if isinstance(reply, RespError):
+            raise reply
+        self.read_stats["replica_reads"] += 1
+        return reply
+
+    def _fresh_enough(self, entry: ShardEntry, state) -> bool:
+        """Judge one REPLSTATE reply ([role, applied_offset, staleness_ms,
+        view_epoch]) against the configured bounds.  A node that answers as
+        master (promotion raced the read) is authoritative by definition."""
+        try:
+            role, offset, stale_ms = state[0], int(state[1]), int(state[2])
+        except (TypeError, ValueError, IndexError):
+            return False
+        role = role.decode() if isinstance(role, (bytes, bytearray)) else str(role)
+        if role != "replica":
+            return True
+        if stale_ms < 0:
+            return False  # never synced: always too stale
+        if self.max_staleness_ms is not None and stale_ms > self.max_staleness_ms:
+            return False
+        hw = self._shard_offsets.get(entry.address, 0)
+        if self.max_staleness_offset is not None \
+                and hw - offset > self.max_staleness_offset:
+            return False
+        if offset > hw:
+            # a replica can only prove an offset its master has cut: reads
+            # advance the client's per-shard high-water for the lag bound
+            self._shard_offsets[entry.address] = offset
+        return True
 
     def _execute_asking(self, target: str, cmd_args, timeout) -> Any:
         """ASKING + command on ONE connection of the importing node (the

@@ -1423,6 +1423,23 @@ class RemoteSurface:
         REPLFLUSH; the cluster client overrides per touched shard."""
         self.execute("REPLFLUSH", timeout=timeout)
 
+    def replication_state(self, timeout: Optional[float] = None) -> dict:
+        """Parsed REPLSTATE (ISSUE 17): {role, applied_offset, staleness_ms,
+        view_epoch}.  staleness_ms is time since the node's last applied
+        replication push/heartbeat (-1 = never synced); a master answers 0.
+        The bounded-staleness read plane's observability probe — soak and
+        bench harvest replica lag through this."""
+        role, offset, stale_ms, epoch = self.execute(
+            "REPLSTATE", timeout=timeout
+        )
+        return {
+            "role": role.decode() if isinstance(role, (bytes, bytearray))
+            else str(role),
+            "applied_offset": int(offset),
+            "staleness_ms": int(stale_ms),
+            "view_epoch": int(epoch),
+        }
+
     # -- transactions (transaction/RedissonTransaction.java over the wire) ----
 
     def create_transaction(self, timeout: Optional[float] = None, options=None):

@@ -70,6 +70,19 @@ def route(cmd: str, args: tuple) -> Tuple[Optional[int], bool]:
     return slots.pop(), write
 
 
+def replica_readable(cmd: str, args: tuple) -> bool:
+    """True when a READONLY replica may serve this command (ISSUE 17): the
+    client-side mirror of the server's check_routing admission — keyed
+    (slot-routed, single slot) and read-classified.  Keyless commands route
+    to masters (admin surface), writes always do, and split multi-key
+    reads re-enter per group where each group is re-checked."""
+    try:
+        slot, write = route(cmd, args)
+    except RespError:
+        return False  # CROSSSLOT surfaces on the normal path
+    return slot is not None and slot != SPLIT and not write
+
+
 def parse_view(view_rows: List[Any]) -> Tuple[List[Optional[str]], Dict[str, None]]:
     """CLUSTER SLOTS reply -> (slot->addr table, ordered master addr set)."""
     new_slots: List[Optional[str]] = [None] * MAX_SLOT

@@ -34,6 +34,7 @@ windows cost <= N+1 blocking syncs overlapped vs 2N serial.
 """
 from __future__ import annotations
 
+import functools
 import os
 import threading
 import time
@@ -483,6 +484,80 @@ def gather_device_results(groups: Sequence[Sequence[Any]]) -> List[tuple]:
         for f in futs:
             f.result()  # surface the first failure (caller falls back)
     return [tuple(host[i] for i in pos) for pos in index]
+
+
+@functools.lru_cache(maxsize=256)
+def _scatter_fn(sig: tuple):
+    """Jitted on-device unpack for scatter_host_arrays: slice the merged
+    uint8 stream at static offsets, bitcast each piece back to its dtype,
+    reshape — one compile per layout signature (the exact inverse of the
+    gather path's bitcast/concat)."""
+    import jax
+    import jax.numpy as jnp
+
+    def unpack(stream):
+        out = []
+        for off, nbytes, dtype_name, shape, was_bool in sig:
+            piece = jax.lax.slice_in_dim(stream, off, off + nbytes)
+            dt = np.dtype(dtype_name)
+            if was_bool:
+                out.append(piece.astype(jnp.bool_).reshape(shape))
+            elif dt == np.uint8:
+                out.append(piece.reshape(shape))
+            else:
+                n = nbytes // dt.itemsize
+                out.append(
+                    jax.lax.bitcast_convert_type(
+                        piece.reshape(n, dt.itemsize), dt
+                    ).reshape(shape)
+                )
+        return tuple(out)
+
+    return jax.jit(unpack)
+
+
+def scatter_host_arrays(arrays: dict, device, pool: "Optional[StagingPool]" = None
+                        ) -> dict:
+    """Upload a dict of host arrays to `device` with ONE host->device
+    transfer — the inverse of gather_device_results: view every array as a
+    uint8 byte stream (bool via uint8, values 0/1), pack them into one
+    merged host buffer (through the lane's double-buffered staging pool
+    when one is armed), upload the merged stream once, then split/bitcast/
+    reshape entirely on device (jitted, one compile per layout signature).
+    Returns {key: committed jax.Array on `device`}.  Same constraint as
+    the gather path: each dtype must round-trip via ``np.dtype(a.dtype
+    .name)`` — callers fall back to per-array device_put on any raise."""
+    import jax
+
+    keys = sorted(arrays)
+    sig = []
+    chunks = []
+    off = 0
+    for k in keys:
+        a = np.asarray(arrays[k])
+        np.dtype(a.dtype.name)  # raises on non-round-tripping dtypes
+        was_bool = a.dtype == np.bool_
+        b = a.astype(np.uint8) if was_bool else a
+        stream = np.ascontiguousarray(b).view(np.uint8).ravel()
+        sig.append((off, int(stream.size), a.dtype.name, tuple(a.shape),
+                    was_bool))
+        chunks.append(stream)
+        off += int(stream.size)
+    if off == 0:  # nothing but empty planes: placement still applies
+        return {k: jax.device_put(np.asarray(arrays[k]), device) for k in keys}
+    if pool is not None:
+        buf, slot = pool.acquire((off,), np.uint8)
+    else:
+        buf, slot = np.empty(off, np.uint8), None
+    pos = 0
+    for stream in chunks:
+        buf[pos:pos + stream.size] = stream
+        pos += stream.size
+    merged = jax.device_put(buf, device)
+    if pool is not None:
+        pool.commit(slot, merged)
+    parts = _scatter_fn(tuple(sig))(merged)
+    return dict(zip(keys, parts))
 
 
 def force_all(futures: Sequence[ReadbackFuture]) -> None:

@@ -755,8 +755,14 @@ class NodeClient:
         credentials_resolver=None,
         command_mapper=None,
         conn_setup=None,
+        readonly: bool = False,
     ):
         self.address = address
+        # READONLY handshake (ISSUE 17): every pooled connection of this
+        # client arms replica reads right after connect — BEFORE conn_setup,
+        # which the tracking plane overwrites, so replica-read admission and
+        # tracking arming compose instead of clobbering each other
+        self.readonly = readonly
         # CredentialsResolver SPI (config/CredentialsResolver): resolved PER
         # CONNECTION ATTEMPT so rotated secrets apply without a restart
         self._credentials_resolver = credentials_resolver
@@ -838,6 +844,14 @@ class NodeClient:
         self.detector.on_connect_successful()
         if self.events_hub is not None:
             self.events_hub.node_connected(self.address)
+        if self.readonly:
+            try:
+                conn.execute("READONLY")
+            except BaseException:
+                # a connection that failed to arm must not enter the pool:
+                # its keyed reads would bounce -MOVED on a cluster replica
+                conn.close()
+                raise
         setup = self.conn_setup
         if setup is not None:
             try:

@@ -44,7 +44,8 @@ SPECS: dict = {}
 _spec(SPECS, "PING ECHO AUTH HELLO SELECT CLIENT QUIT DBSIZE TIME INFO MEMORY "
              "CLUSTER KEYS SAVE ROLE REPLICAOF REPLREGISTER "
              "REPLPUSH REPLPUSHSEG REPLFLUSH REPLSNAPSHOT REPLICAS SUBSCRIBE UNSUBSCRIBE "
-             "PSUBSCRIBE PUNSUBSCRIBE PUBLISH METRICS ASKING", False, None)
+             "PSUBSCRIBE PUNSUBSCRIBE PUBLISH METRICS ASKING "
+             "READONLY READWRITE REPLSTATE REPLPING", False, None)
 
 # keyless but state-mutating: a replica must refuse these (REPLPUSH is the
 # one sanctioned mutation path on a replica; IMPORTRECORDS is the slot-

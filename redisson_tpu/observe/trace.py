@@ -79,7 +79,7 @@ class FrameTrace:
 
     __slots__ = ("trace_id", "ts", "t0", "verbs", "n_cmds", "client_id",
                  "qos_class", "tenant", "spans", "dispatched_at", "total_us",
-                 "finished")
+                 "finished", "base_attrs")
 
     def __init__(self, trace_id: int, ts: float, t0: float, verbs: str,
                  n_cmds: int, client_id: int):
@@ -95,10 +95,15 @@ class FrameTrace:
         self.dispatched_at: Optional[float] = None
         self.total_us = 0
         self.finished = False
+        # attrs merged into EVERY span of this frame (replica-served frames
+        # stamp replica=1 here, so per-stage breakdowns split by role)
+        self.base_attrs: Optional[dict] = None
 
     def add_span(self, name: str, start: float, end: float,
                  **attrs) -> None:
         """Record one stage interval ([start, end] monotonic seconds)."""
+        if self.base_attrs:
+            attrs = {**self.base_attrs, **attrs}
         self.spans.append(Span(
             name,
             int((start - self.t0) * 1e6),

@@ -121,6 +121,11 @@ class CommandContext:
         self.psubscriptions: Dict[str, int] = {}
         self.push: Optional[Callable[[Any], None]] = None  # wired by the server
         self.asking = False  # one-shot ASK admission (cleared per command)
+        # READONLY connection state (Redis cluster parity, ISSUE 17): armed
+        # by the READONLY verb, cleared by READWRITE.  A cluster replica
+        # serves keyed reads only to readonly connections — everyone else
+        # gets -MOVED to the master (server.check_routing).
+        self.readonly = False
         # MULTI/EXEC/WATCH state (per-connection, like Redis): a non-None
         # multi_queue means queueing mode; watch_versions holds the record
         # versions observed at WATCH time (the optimistic precondition)
@@ -167,7 +172,8 @@ class Registry:
         if server.cluster_view or server.role == "replica":
             # queue-time MOVED/ASK replies match Redis cluster; EXEC rechecks
             # the whole group before applying anything
-            server.check_routing(cmd.decode(), args[1:], asking=asking)
+            server.check_routing(cmd.decode(), args[1:], asking=asking,
+                                 readonly=ctx.readonly)
         if ctx.multi_queue is not None and cmd not in self._TX_IMMEDIATE:
             ctx.multi_queue.append([bytes(a) for a in args])
             return "+QUEUED"

@@ -286,10 +286,19 @@ def pull_snapshot(client, timeout: float = 60.0,
         return blob
 
 
-def _wire_payload(records: List[dict], live: Optional[List[str]]) -> bytes:
+def _wire_payload(records: List[dict], live: Optional[List[str]],
+                  offset: Optional[int] = None,
+                  ts: Optional[float] = None) -> bytes:
     payload = {"format": 1, "records": records}
     if live is not None:
         payload["live"] = live
+    if offset is not None:
+        # the bounded-staleness stamp (ISSUE 17): this blob carries the
+        # master's sweep-cut offset — a replica that applies it is caught
+        # up to this cut.  Scoped covers and migration transfers ship
+        # unstamped (they advance no cut).
+        payload["repl_offset"] = int(offset)
+        payload["repl_ts"] = float(ts if ts is not None else time.time())
     raw = pickle.dumps(payload, protocol=4)
     if len(raw) > 0xFFFFFFFF:  # BE32 length frame caps at 4GB; ship raw
         return raw
@@ -368,7 +377,76 @@ def serialize_records(
     return _wire_payload(out, live if include_live else None), shipped
 
 
-def apply_records(engine, blob: bytes, on_applied=None) -> int:
+def _current_trace():
+    from redisson_tpu.observe import trace as _obs
+
+    return _obs.current_trace() if _obs._tracer is not None else None
+
+
+def _hydrate_full_arrays(engine, name: str, host_arrays: dict) -> dict:
+    """Full-ship install path: with placement enabled, hydrate the record's
+    arrays onto the slot's OWNER device as ONE packed upload through that
+    lane's staging pool (ioplane.scatter_host_arrays — the inverse of the
+    reply path's gather) instead of ``jnp.asarray`` onto the default device
+    + a second device_put hop in the placement hook.  A replica's banks /
+    IVF cells / numeric / bitset planes are therefore device-resident the
+    moment the REPLPUSH applies — read-serving amortizes the hydration a
+    promote used to pay all at once.
+
+    MUST be called WITHOUT the record lock held: the upload takes the
+    device lane gate, and the dispatch path's lock order is lane -> record
+    — acquiring them record -> lane here could deadlock.  Any packing
+    surprise (exotic dtype, non-numpy value) falls back to per-array
+    placement; placement off keeps the historical host-side install."""
+    import jax.numpy as jnp
+
+    device = engine.device_for_name(name)
+    if device is None:
+        return {k: jnp.asarray(v) for k, v in host_arrays.items()}
+    from redisson_tpu.core import ioplane
+
+    stats = getattr(engine, "hydration_stats", None)
+    if stats is None:
+        stats = engine.hydration_stats = {
+            "records_packed": 0, "records_fallback": 0, "bytes": 0,
+        }
+    nbytes = sum(
+        int(getattr(v, "nbytes", 0) or 0) for v in host_arrays.values()
+    )
+    t0 = time.monotonic()
+    lane = engine.lanes.lane(device) if engine.lanes is not None else None
+    try:
+        pool = engine.staging_pool(device)
+        if lane is not None:
+            # hydration holds the lane like any dispatch: replica reads on
+            # this device see it in the occupancy ledger (QoS `bulk` class),
+            # exactly what the client-side balancer scrapes
+            with lane.occupy(len(host_arrays), qos_class="bulk",
+                             nbytes=nbytes):
+                arrays = ioplane.scatter_host_arrays(host_arrays, device, pool)
+        else:
+            arrays = ioplane.scatter_host_arrays(host_arrays, device, pool)
+        stats["records_packed"] += 1
+        stats["bytes"] += nbytes
+    except Exception:  # noqa: BLE001 — packing surprise: place singly
+        import jax
+
+        arrays = {}
+        for k, v in host_arrays.items():
+            try:
+                arrays[k] = jax.device_put(v, device)
+            except Exception:  # noqa: BLE001 — host-side state
+                arrays[k] = jnp.asarray(v)
+        stats["records_fallback"] += 1
+    tr = _current_trace()
+    if tr is not None:
+        tr.add_span("hydrate", t0, time.monotonic(),
+                    device=getattr(device, "id", 0),
+                    arrays=len(host_arrays), nbytes=nbytes)
+    return arrays
+
+
+def apply_records(engine, blob: bytes, on_applied=None, on_payload=None) -> int:
     """Install shipped records (last-writer-wins by version). Returns #applied.
 
     ``on_applied`` (optional) receives the list of names whose state this
@@ -376,7 +454,12 @@ def apply_records(engine, blob: bytes, on_applied=None) -> int:
     client-tracking plane invalidates near caches through it: a record
     arriving by migration import or replication push mutates the keyspace
     exactly like a write, so tracked readers on THIS node must hear about
-    it (verbs/admin.py wires it to TrackingTable.note_write)."""
+    it (verbs/admin.py wires it to TrackingTable.note_write).
+
+    ``on_payload`` (optional) receives the decoded payload dict after a
+    SUCCESSFUL apply — the replication verbs record the bounded-staleness
+    stamp (``repl_offset``/``repl_ts``) through it without a second decode
+    of the blob; a failed apply never advances the replica's offset."""
     from redisson_tpu.core.checkpoint import _loads
     from redisson_tpu.core.store import StateRecord
 
@@ -388,6 +471,18 @@ def apply_records(engine, blob: bytes, on_applied=None) -> int:
     for item in payload["records"]:
         name = item["name"]
         nonce = item.get("nonce")
+        hydrated = None
+        if "arrays_delta" not in item:
+            # hydrate OUTSIDE the record lock (lock-order contract above);
+            # the lock-free peek only skips hydrating obviously-stale ships
+            # — the authoritative staleness check reruns under the lock
+            peek = engine.store.get_unguarded(name)
+            if not (
+                peek is not None
+                and (nonce is None or peek.nonce == nonce)
+                and peek.version >= item["version"]
+            ):
+                hydrated = _hydrate_full_arrays(engine, name, item["arrays"])
         with engine.locked(name):
             # unguarded access throughout: a transfer frame legitimately
             # creates/probes absent names even inside a migration window
@@ -428,7 +523,14 @@ def apply_records(engine, blob: bytes, on_applied=None) -> int:
                     _validate_array_delta(name, akey, cur, d)
                     arrays[akey] = _apply_array_delta(cur, d)
             else:
-                arrays = {k: jnp.asarray(v) for k, v in item["arrays"].items()}
+                arrays = hydrated
+                if arrays is None:
+                    # raced from stale to fresh between the peek and the
+                    # lock (rare): install host-side — the store's
+                    # placement hook re-homes the arrays on put
+                    arrays = {
+                        k: jnp.asarray(v) for k, v in item["arrays"].items()
+                    }
             rec = StateRecord(
                 kind=item["kind"],
                 meta=item["meta"],
@@ -457,6 +559,11 @@ def apply_records(engine, blob: bytes, on_applied=None) -> int:
             on_applied(changed)
         except Exception:  # noqa: BLE001 — invalidation fan-out must not
             pass           # fail the transfer frame
+    if on_payload is not None:
+        try:
+            on_payload(payload)
+        except Exception:  # noqa: BLE001 — stamp recording must not fail
+            pass           # the transfer frame either
     return applied
 
 
@@ -483,6 +590,9 @@ class ReplicaHandle:
         # delete+recreate between sweeps (version restarts under a new nonce)
         self.shipped: Dict[str, Tuple[int, int]] = {}
         self.healthy = True
+        # monotonic time of the last offset carrier (push or REPLPING) this
+        # handle received — throttles the clean-sweep heartbeat
+        self.last_beat = 0.0
 
 
 class ReplicationSource:
@@ -510,7 +620,13 @@ class ReplicationSource:
         # chaos hook: a stalled stream ships NOTHING (replica lag grows
         # unbounded) until resumed — the repl-link-partition failure mode
         self._stalled = threading.Event()
-        self.stats = {"pushes": 0, "bytes": 0, "records_full": 0, "records_delta": 0}
+        # the replication offset (ISSUE 17 bounded staleness): one tick per
+        # sweep CUT — every push this sweep carries it, replicas with
+        # nothing dirty hear it via REPLPING, and a replica's applied
+        # offset advancing to it means "caught up as of this cut"
+        self.offset = 0
+        self.stats = {"pushes": 0, "bytes": 0, "records_full": 0,
+                      "records_delta": 0, "heartbeats": 0}
 
     def stall(self) -> None:
         """Stop shipping (chaos: replication-stream stall) until resume()."""
@@ -633,6 +749,30 @@ class ReplicationSource:
         with self._ship_mutex:
             return self._ship_once_locked()
 
+    def _heartbeat(self, handles: List[ReplicaHandle], offset: int,
+                   ts: float) -> None:
+        """Offset-only keepalive for replicas with nothing dirty this sweep:
+        a clean replica holds everything the cut holds, so its applied
+        offset advances to the cut without shipping a byte — client-side
+        ``max_staleness`` reads stay serveable on an idle keyspace.
+        Throttled to half the sweep interval per handle so flush()-polling
+        callers (the WAIT loop) cannot spam the link."""
+        from redisson_tpu.net.resp import RespError
+
+        now = time.monotonic()
+        for h in handles:
+            if now - h.last_beat < self.interval * 0.5:
+                continue
+            try:
+                reply = h.client.execute("REPLPING", offset, ts, timeout=5.0)
+                if isinstance(reply, RespError):
+                    raise reply
+                h.healthy = True
+                h.last_beat = now
+                self.stats["heartbeats"] += 1
+            except Exception:  # noqa: BLE001 — down OR promoted (rejects)
+                h.healthy = False
+
     def _ship_once_locked(self) -> int:
         with self._lock:
             replicas = list(self._replicas.values())
@@ -645,7 +785,12 @@ class ReplicationSource:
             names, deleted = self._dirty_for(h)
             plan.append((h, names, deleted))
             union.update(names)
+        # one offset tick per sweep CUT (taken while replicas exist): every
+        # stamped push below carries it, clean replicas hear it by REPLPING
+        self.offset += 1
+        offset, ts = self.offset, time.time()
         if not union and not any(d for _, _, d in plan):
+            self._heartbeat(replicas, offset, ts)
             return 0
         # ONE snapshot serves every replica this sweep: arrays are device-
         # copied under the lock, pulled to host after, then block-diffed
@@ -671,6 +816,7 @@ class ReplicationSource:
         delivered: set = set()
         for h, names, deleted in plan:
             if not names and not deleted:
+                self._heartbeat([h], offset, ts)
                 continue
             # the blob's live-name list makes the replica prune deletions,
             # so a deletions-only sweep ships an empty record set
@@ -691,10 +837,11 @@ class ReplicationSource:
                     head["arrays"] = item["arrays"]
                 records.append(head)
                 shipped_now.append((name, item["nonce"], item["version"]))
-            blob = _wire_payload(records, live)
+            blob = _wire_payload(records, live, offset=offset, ts=ts)
             try:
                 self._push_blob(h, blob)
                 h.healthy = True
+                h.last_beat = time.monotonic()
             except Exception as e:  # noqa: BLE001 — retry next sweep
                 from redisson_tpu.net.resp import RespError
 

@@ -236,7 +236,13 @@ class TpuServer:
         self.mode = mode
         self.node_id = uuid.uuid4().hex
         self.started_at = time.time()
-        self.stats = {"connections": 0, "commands": 0, "errors": 0, "sheds": 0}
+        self.stats = {"connections": 0, "commands": 0, "errors": 0, "sheds": 0,
+                      # read-scaling plane (ISSUE 17): replica-served keyed
+                      # reads, reads refused as too stale (REPLSTATE
+                      # MAXSTALE), reads bounced to the master (missing
+                      # READONLY / fenced slot)
+                      "replica_reads": 0, "replica_redirects_stale": 0,
+                      "replica_fallbacks": 0}
         # observability (utils/metrics.py): per-command timers + counters,
         # rendered by the METRICS command; hooks = NettyHook-analog SPI
         from redisson_tpu.utils.metrics import MetricsHook, MetricsRegistry
@@ -329,9 +335,30 @@ class TpuServer:
         self.journal_dir = journal_dir
         self._import_journals: Dict[int, Any] = {}
         self._import_journal_lock = threading.Lock()
+        # read-scaling gauges (ISSUE 17): METRICS / METRICS CLUSTER rows +
+        # ResourceCensus feed — replica-side attribution (the replica both
+        # serves the read and refuses the stale/unarmed one)
+        self.metrics.gauge(
+            "replica_reads", lambda: self.stats["replica_reads"]
+        )
+        self.metrics.gauge(
+            "replica_redirects_stale",
+            lambda: self.stats["replica_redirects_stale"],
+        )
+        self.metrics.gauge(
+            "replica_fallbacks", lambda: self.stats["replica_fallbacks"]
+        )
         # -- cluster / replication role (server/replication.py) -------------
         self.role = "master"  # "master" | "replica"
         self.master_address: Optional[str] = None
+        # bounded-staleness stamp (ISSUE 17): the highest sweep-cut offset
+        # this REPLICA applied (REPLPUSH payload stamp or REPLPING), the
+        # master wall-clock of that cut, and the LOCAL monotonic receipt
+        # time — staleness_ms is measured against the local receipt so
+        # cross-host clock skew can never fake freshness
+        self.repl_applied_offset = 0
+        self.repl_applied_ts = 0.0
+        self.repl_applied_at: Optional[float] = None
         # set on REPLICAOF NO ONE promotion: the master this node replicated
         # before — the ROLE breadcrumb coordinators use to adopt
         # half-finished failovers (registry cmd_role / cmd_replicaof)
@@ -609,7 +636,8 @@ class TpuServer:
                 return h, p
         return None
 
-    def check_routing(self, cmd: str, args: List[bytes], asking: bool = False) -> None:
+    def check_routing(self, cmd: str, args: List[bytes], asking: bool = False,
+                      readonly: bool = False) -> None:
         """MOVED/ASK + READONLY enforcement (the server half of the
         reference's redirect protocol, cluster/ClusterConnectionManager +
         command/RedisExecutor redirect handling).
@@ -620,6 +648,12 @@ class TpuServer:
             already or must be created there);
           * slot IMPORTING here: normally MOVED back to the source (the view
             still names it), but a command preceded by ASKING is served.
+
+        Replica read admission (ISSUE 17, Redis parity): a CLUSTER replica
+        serves keyed reads only to connections that armed READONLY —
+        everyone else is MOVED to the master (writes keep the historical
+        -READONLY refusal below).  Standalone replicated pairs (no cluster
+        view) keep serving reads to every connection, as before.
         """
         from redisson_tpu.net import commands as C
         from redisson_tpu.net.resp import RespError
@@ -628,17 +662,30 @@ class TpuServer:
         if self.cluster_view:
             migrating_absent = migrating_present = 0
             ask_target = None
+            replica_read = False
             for key in C.command_keys(cmd, args):
                 slot = calc_slot(key)
                 if slot in self.recovering_slots:
                     # interrupted-migration fence: neither the restored
                     # local copy nor an ASK hop is safe until the journal
                     # resume settles the slot (see recovering_slots above)
+                    # — and a replica never serves a fenced slot either
                     raise RespError(
                         f"TRYAGAIN slot {slot} recovering from an "
                         "interrupted migration"
                     )
                 if self.owns_slot(slot):
+                    if self.role == "replica" and not C.is_write(cmd, args):
+                        if not readonly:
+                            # the Redis-parity refusal: keyed reads without
+                            # READONLY bounce to the master (the client's
+                            # fallback path counts the redirect)
+                            self.stats["replica_fallbacks"] += 1
+                            ma = self.master_address
+                            if ma:
+                                raise RespError(f"MOVED {slot} {ma}")
+                        else:
+                            replica_read = True
                     target = self.migrating_slots.get(slot)
                     if target is not None:
                         name = key.decode() if isinstance(key, bytes) else key
@@ -664,6 +711,8 @@ class TpuServer:
                         "TRYAGAIN Multiple keys request during rehashing of slot"
                     )
                 raise RespError(f"ASK {ask_slot} {ask_target}")
+            if replica_read:
+                self.stats["replica_reads"] += 1
         if self.role == "replica" and C.is_write(cmd, args):
             raise RespError("READONLY You can't write against a read only replica.")
 
@@ -1819,6 +1868,10 @@ class TpuServer:
                     trace = _obs._tracer.begin_frame(
                         ctx, commands, t0=t_parse0
                     )
+                    if self.role == "replica":
+                        # per-stage replica annotation (ISSUE 17): every
+                        # span of a replica-served frame carries replica=1
+                        trace.base_attrs = {"replica": 1}
                 try:
                     ok = await self._serve_frame(
                         ctx, commands, loop, write_q, readback_slots, alive,

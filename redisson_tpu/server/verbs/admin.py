@@ -295,6 +295,68 @@ def _tracking_invalidator(server):
     return lambda names: tracking.note_write(list(names), None)
 
 
+def _bank_resync(server, names) -> None:
+    """Hydration-awareness seam (ISSUE 17, services/vector.py): a full-ship
+    replaces a vector_bank record's arrays behind any service-level bank
+    object bound to it — resync the bank's host mirror / row count so a
+    later (e.g. post-promotion) query never scores a stale mirror."""
+    services = getattr(server.engine, "_services", None)
+    if not services or services.get("search") is None or not names:
+        return
+    try:
+        from redisson_tpu.services.vector import sync_banks_from_records
+
+        sync_banks_from_records(server.engine, names)
+    except Exception:
+        pass  # observability seam: never fail the apply
+
+
+def _replica_on_applied(server):
+    """Composite on_applied for replica-side apply_records: tracked readers
+    invalidate (replica-side tracking tables stay coherent across the push
+    stream) and service banks re-adopt externally installed records."""
+    tracking_cb = _tracking_invalidator(server)
+
+    def on_applied(names):
+        if tracking_cb is not None:
+            tracking_cb(names)
+        _bank_resync(server, names)
+
+    return on_applied
+
+
+def _stamp_recorder(server):
+    """apply_records on_payload hook: adopt the push's replication stamp
+    (master sweep-cut offset + wall ts) AFTER the records applied — the
+    bounded-staleness answer REPLSTATE gives must never run ahead of the
+    state a replica read would actually see.  Receipt time is monotonic,
+    so staleness_ms needs no cross-host clock agreement."""
+
+    def on_payload(payload):
+        off = payload.get("repl_offset")
+        if off is None:
+            return  # scoped cover-ship: carries records, not a sweep cut
+        server.repl_applied_offset = int(off)
+        server.repl_applied_ts = float(payload.get("repl_ts") or 0.0)
+        server.repl_applied_at = time.monotonic()
+
+    return on_payload
+
+
+def _require_replica(server, verb: str) -> None:
+    """Replication-stream verbs apply only on replicas (ISSUE 17 bugfix): a
+    promoted master must NEVER apply a late push from its old master — the
+    promoted hydrated plane would silently regress to pre-failover state.
+    Rejecting here (instead of trusting the pusher to notice the
+    promotion) closes the race between REPLICAOF NO ONE and the old
+    master's next sweep; the rejected pusher marks the link unhealthy and
+    stops treating this node as its replica."""
+    if server.role != "replica":
+        raise RespError(
+            f"ERR {verb} rejected: node is a master (stale replication push)"
+        )
+
+
 @register("IMPORTRECORDS")
 def cmd_importrecords(server, ctx, args):
     """IMPORTRECORDS [EPOCH <n> [SOURCE <addr>]] <blob> — install migrated
@@ -359,18 +421,48 @@ def cmd_importrecords(server, ctx, args):
 
 # -- replication (server/replication.py) -------------------------------------
 
+def _promote_flush(server) -> None:
+    """Promotion barrier (ISSUE 17 bugfix): the replica's hydrated device
+    plane becomes MASTER state the instant the role flips, so everything
+    that could let replica-stream staleness leak in afterwards is cut
+    here — half-assembled segmented pushes are dropped (their remaining
+    segments are role-gate rejected anyway), tracked readers invalidate
+    across the live keyspace (their entries were registered against
+    replica-served values and must refetch under the promoted epoch), the
+    staleness clock resets (a master is authoritative, never 'stale'), and
+    service-level banks re-adopt their records under the promoted role."""
+    with server._repl_xfers_lock:
+        server._repl_xfers.clear()
+    server.repl_applied_at = None
+    names = list(server.engine.store.keys())
+    cb = _tracking_invalidator(server)
+    if cb is not None and names:
+        try:
+            cb(names)
+        except Exception:
+            pass
+    _bank_resync(server, names)
+    server.stats["promotions"] = server.stats.get("promotions", 0) + 1
+
+
 @register("REPLICAOF")
 def cmd_replicaof(server, ctx, args):
     """REPLICAOF NO ONE -> become master; REPLICAOF <host> <port> -> full
     sync from master, then register for the push stream."""
     if len(args) == 2 and bytes(args[0]).upper() == b"NO" and bytes(args[1]).upper() == b"ONE":
-        if server.role == "replica" and server.master_address:
+        promoted = server.role == "replica"
+        if promoted and server.master_address:
             # breadcrumb for successor coordinators: an orphaned master that
             # can name the dead master it was promoted FROM is a
             # half-finished failover; a restarted stale master cannot
             server.promoted_from = server.master_address
+        # role flips FIRST: from here every in-flight/late push from the old
+        # master is rejected by _require_replica, THEN the promotion barrier
+        # scrubs what the replica stream staged (ISSUE 17 bugfix)
         server.role = "master"
         server.master_address = None
+        if promoted:
+            _promote_flush(server)
         return "+OK"
     if len(args) != 2:
         raise RespError("ERR REPLICAOF <host> <port> | NO ONE")
@@ -400,6 +492,9 @@ def cmd_replicaof(server, ctx, args):
         master.close()
     server.role = "replica"
     server.master_address = f"{host}:{port}"
+    # stale stamps from a PREVIOUS master's stream must not answer fresh:
+    # the staleness clock restarts at the new master's first push/heartbeat
+    server.repl_applied_at = None
     return "+OK"
 
 
@@ -489,13 +584,16 @@ def cmd_replregister(server, ctx, args):
 def cmd_replpush(server, ctx, args):
     from redisson_tpu.server import replication
 
+    _require_replica(server, "REPLPUSH")
     # any live push proves the link is back: reap transfers its dead
     # predecessor abandoned mid-segment (a restarted master full-ships via
     # plain REPLPUSH, so seg-only sweeping would never fire here)
     with server._repl_xfers_lock:
         _reap_stale_xfers(server, time.monotonic())
     return replication.apply_records(
-        server.engine, bytes(args[0]), on_applied=_tracking_invalidator(server)
+        server.engine, bytes(args[0]),
+        on_applied=_replica_on_applied(server),
+        on_payload=_stamp_recorder(server),
     )
 
 
@@ -531,6 +629,7 @@ def cmd_replpushseg(server, ctx, args):
     per-transfer staleness (last-touch timestamp), never insertion order."""
     from redisson_tpu.server import replication
 
+    _require_replica(server, "REPLPUSHSEG")
     xfer_id, seq, nsegs = _s(args[0]), _int(args[1]), _int(args[2])
     chunk = bytes(args[3])
     now = time.monotonic()
@@ -552,8 +651,61 @@ def cmd_replpushseg(server, ctx, args):
         del xfers[xfer_id]
         blob = b"".join(entry[0])
     return replication.apply_records(
-        server.engine, blob, on_applied=_tracking_invalidator(server)
+        server.engine, blob,
+        on_applied=_replica_on_applied(server),
+        on_payload=_stamp_recorder(server),
     )
+
+
+@register("REPLPING")
+def cmd_replping(server, ctx, args):
+    """REPLPING <offset> <ts> — master heartbeat on a clean sweep cut: the
+    replica's applied offset advances without any payload, so bounded-
+    staleness replica reads stay eligible while the keyspace is idle
+    (otherwise an idle master would starve every MAXSTALE bound)."""
+    _require_replica(server, "REPLPING")
+    server.repl_applied_offset = _int(args[0])
+    try:
+        server.repl_applied_ts = float(_s(args[1]))
+    except (ValueError, IndexError):
+        server.repl_applied_ts = 0.0
+    server.repl_applied_at = time.monotonic()
+    return "+OK"
+
+
+@register("REPLSTATE")
+def cmd_replstate(server, ctx, args):
+    """REPLSTATE [MAXSTALE <ms>] -> [role, applied_offset, staleness_ms,
+    view_epoch] — the bounded-staleness contract's server half (ISSUE 17).
+
+    staleness_ms is measured from the monotonic RECEIPT of the last applied
+    push/heartbeat, so it needs no cross-host clock agreement; -1 means the
+    replica has never synced (always too stale).  A master answers 0 — it
+    is never stale with respect to itself.  The MAXSTALE form replies the
+    same shape and additionally counts replica_redirects_stale when the
+    answer exceeds the client's bound: the client pipelines REPLSTATE
+    MAXSTALE ahead of its read and redirects to the master on the reply."""
+    max_stale = None
+    if args:
+        if len(args) == 2 and bytes(args[0]).upper() == b"MAXSTALE":
+            max_stale = _int(args[1])
+        else:
+            raise RespError("ERR REPLSTATE [MAXSTALE <ms>]")
+    if server.role != "replica":
+        stale_ms = 0
+    elif server.repl_applied_at is None:
+        stale_ms = -1
+    else:
+        stale_ms = int((time.monotonic() - server.repl_applied_at) * 1000.0)
+    if max_stale is not None and server.role == "replica" \
+            and (stale_ms < 0 or stale_ms > max_stale):
+        server.stats["replica_redirects_stale"] += 1
+    return [
+        server.role.encode(),
+        int(server.repl_applied_offset),
+        stale_ms,
+        int(server.view_epoch),
+    ]
 
 
 @register("REPLFLUSH")

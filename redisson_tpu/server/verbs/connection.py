@@ -26,6 +26,26 @@ def cmd_echo(server, ctx, args):
     return args[0]
 
 
+@register("READONLY")
+def cmd_readonly(server, ctx, args):
+    """READONLY — arm replica reads for this connection (Redis cluster
+    parity).  A cluster replica serves keyed reads only to connections
+    that declared READONLY; everyone else is -MOVED to the master
+    (server.check_routing).  No-op on masters, like Redis."""
+    if args:
+        raise RespError("ERR wrong number of arguments for 'readonly' command")
+    ctx.readonly = True
+    return "+OK"
+
+
+@register("READWRITE")
+def cmd_readwrite(server, ctx, args):
+    if args:
+        raise RespError("ERR wrong number of arguments for 'readwrite' command")
+    ctx.readonly = False
+    return "+OK"
+
+
 @register("AUTH")
 def cmd_auth(server, ctx, args):
     """AUTH <password> | AUTH <username> <password> — the ACL form matches

@@ -733,6 +733,67 @@ class RecordRowBank(DeviceRowBank):
             self._pending.clear()
             self._engine.store.delete_unguarded(self.name)
 
+    def sync_external(self) -> None:
+        """Adopt record state installed BEHIND this object's back — a
+        replication full-ship replacing rec.arrays, or a promoted replica
+        re-binding an index over hydrated records (ISSUE 17).  Row count
+        comes from rec.meta, the host mirror is re-dequantized from the
+        device planes (one d2h), pending rows are dropped (the record is
+        the newer truth), and any IVF plane resets so the next query
+        retrains over the adopted rows instead of scoring stale cells."""
+        with self._lock:
+            rec = self._engine.store.get_unguarded(self.name)
+            if rec is None:
+                return
+            bank, bias, scale = self._get_planes()
+            rows = int(rec.meta.get("rows", 0))
+            self._pending.clear()
+            self.rows = rows
+            self._cap = 0 if bank is None else int(bank.shape[0])
+            if bank is None or rows <= 0:
+                self._host = np.zeros((0, self.width), np.float32)
+                self._host_bias = np.zeros((0,), np.float32)
+            else:
+                stored = np.asarray(bank)[:rows]
+                if self.dtype == "INT8" and scale is not None:
+                    sc = np.asarray(scale)[:rows].astype(np.float32)
+                    deq = stored.astype(np.float32) * sc[:, None]
+                else:
+                    deq = stored.astype(np.float32)
+                self._host = np.ascontiguousarray(deq[:, : self.width])
+                self._host_bias = (
+                    np.asarray(bias)[:rows].astype(np.float32)
+                    if bias is not None else np.zeros((rows,), np.float32)
+                )
+            ivf = getattr(self, "_ivf", None)
+            if ivf is not None:
+                self._ivf = type(ivf)(self.spec)
+
+
+def sync_banks_from_records(engine, names) -> int:
+    """Hydration-awareness seam (ISSUE 17): replication full-ships replace a
+    vector_bank record's arrays WITHOUT the owning bank object seeing it,
+    so a service bank bound to that record (an index def that outlived a
+    REPLPUSH, or a promoted replica's rebuilt index) would keep serving a
+    stale host mirror / row count.  Resync every plain record-backed bank
+    whose record name is in `names`; sharded facades are skipped — their
+    host-side routing tables are not record state, so adopting shard rows
+    without routes would be worse than the stale mirror they replace."""
+    svc = getattr(engine, "_services", {}).get("search")
+    if svc is None or not names:
+        return 0
+    wanted = set(names)
+    synced = 0
+    for idx in list(getattr(svc, "_indexes", {}).values()):
+        vectors = getattr(idx, "vectors", None)
+        if not vectors:
+            continue
+        for bank in vectors.banks.values():
+            if isinstance(bank, RecordRowBank) and bank.name in wanted:
+                bank.sync_external()
+                synced += 1
+    return synced
+
 
 class _IvfPlane:
     """Host-canonical IVF coarse index for one embedding bank: centroids,

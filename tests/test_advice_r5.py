@@ -118,10 +118,17 @@ def _seg_frames(xfer_id, nsegs=2):
     ]
 
 
+def _as_replica(st):
+    """Replication pushes only land on replicas (a master rejects them as
+    stale, ISSUE 17) — arm the staging target's role directly."""
+    st.server.role = "replica"
+    return st
+
+
 def test_concurrent_transfers_beyond_old_cap_all_complete():
     """Six interleaved in-progress transfers (the old insertion-order cap
     of 4 dropped the first two) must ALL reassemble and apply."""
-    st = ServerThread(port=free_port()).start()
+    st = _as_replica(ServerThread(port=free_port()).start())
     try:
         with st.client() as c:
             heads, tails = [], []
@@ -139,7 +146,7 @@ def test_concurrent_transfers_beyond_old_cap_all_complete():
 
 
 def test_stale_transfer_evicted_fresh_transfer_kept():
-    st = ServerThread(port=free_port()).start()
+    st = _as_replica(ServerThread(port=free_port()).start())
     try:
         with st.client() as c:
             h_stale, t_stale = _seg_frames("xfer-stale")
@@ -167,7 +174,7 @@ def test_stale_transfer_evicted_fresh_transfer_kept():
 def test_transfer_staging_is_thread_safe_under_parallel_pushes():
     """Concurrent REPLPUSHSEG streams from several sources (replication
     racing IMPORTRECORDS-scale reshards) reassemble without corruption."""
-    st = ServerThread(port=free_port()).start()
+    st = _as_replica(ServerThread(port=free_port()).start())
     errs = []
 
     def push(i):

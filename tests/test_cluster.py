@@ -192,9 +192,14 @@ def test_replication_and_replica_reads():
         with runner.masters[0].server.client() as c:
             shipped = _exec(c, "REPLFLUSH")
         assert shipped >= 1
-        # read from the replica directly: state must be there
+        # a keyed read on a cluster replica WITHOUT READONLY is -MOVED to
+        # the master (Redis parity, ISSUE 17) ...
         rep = runner.replicas[0]
         with rep.server.client() as c:
+            reply = c.execute("GET", "replicated")
+            assert isinstance(reply, RespError) and str(reply).startswith("MOVED ")
+            # ... and the same connection serves it after READONLY
+            assert _exec(c, "READONLY") is not None
             raw = _exec(c, "GET", "replicated")
         assert raw is not None
         # replica rejects writes
@@ -370,6 +375,7 @@ def test_replication_recreate_within_ship_interval():
         rec = rep_engine.store.get("phoenix")
         assert rec is not None, "recreated record never shipped"
         with runner.replicas[0].server.client() as c:
+            _exec(c, "READONLY")
             raw = _exec(c, "GET", "phoenix")
         from redisson_tpu.client.codec import DEFAULT_CODEC
 

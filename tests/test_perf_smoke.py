@@ -576,3 +576,68 @@ def test_perf_gate_config5d_first_sight_and_relative(tmp_path):
         assert gate.main(
             ["--fresh", str(p), "--baseline", str(first)]
         ) == want, (key, factor)
+
+
+# -- config6r read-scaling gate (ISSUE 17) -------------------------------------
+
+
+def test_perf_gate_config6r_floor_ceiling_and_relative(tmp_path):
+    """config6r: the read-QPS scaling ratio n/a-passes while absent, then
+    gates BOTH relatively (>5% drop) and absolutely (>=2.5x floor from
+    first sight); the staleness p99 binds only as an absolute ceiling
+    (<=1500ms) — never relatively, since wall-clock staleness jitters with
+    container load."""
+    import copy
+    import importlib.util
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(repo, "tools", "perf_gate.py")
+    )
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+    r5 = os.path.join(repo, "BENCH_r05.json")
+    if not os.path.exists(r5):
+        pytest.skip("no recorded BENCH artifacts")
+    with open(r5) as fh:
+        base = gate.load_bench_doc(fh.read())
+
+    # absent everywhere: n/a rows pass
+    assert gate.main(["--fresh", r5, "--baseline", r5]) == 0
+    # first sight: the 2.5x scaling floor and the 1500ms staleness ceiling
+    # bind even though the baseline has no config6r rows at all
+    for scaling, stale, want in (
+        (3.1, 260.0, 0),   # healthy
+        (2.2, 260.0, 1),   # replicas not absorbing reads
+        (3.1, 2400.0, 1),  # scaling bought with stale serving
+    ):
+        doc = copy.deepcopy(base)
+        doc["details"]["config6r_read_qps_scaling"] = scaling
+        doc["details"]["config6r_staleness_p99_ms"] = stale
+        p = tmp_path / f"fresh_c6r_{scaling}_{stale}.json"
+        p.write_text(json.dumps(doc))
+        assert gate.main(["--fresh", str(p), "--baseline", r5]) == want, (
+            scaling, stale,
+        )
+    # once recorded: scaling gates a >5% relative drop even above the
+    # floor; staleness p99 does NOT gate relatively (advisory row only)
+    doc = copy.deepcopy(base)
+    doc["details"]["config6r_read_qps_scaling"] = 3.6
+    doc["details"]["config6r_staleness_p99_ms"] = 100.0
+    rec = tmp_path / "c6r_recorded.json"
+    rec.write_text(json.dumps(doc))
+    for scaling, stale, want in (
+        (3.3, 100.0, 1),    # >5% scaling drop, still above the 2.5x floor
+        (3.5, 100.0, 0),    # <5% drop passes
+        (3.6, 1400.0, 0),   # staleness 14x worse but under the ceiling: OK
+    ):
+        doc2 = copy.deepcopy(doc)
+        doc2["details"]["config6r_read_qps_scaling"] = scaling
+        doc2["details"]["config6r_staleness_p99_ms"] = stale
+        p = tmp_path / f"fresh_c6r_rel_{scaling}_{stale}.json"
+        p.write_text(json.dumps(doc2))
+        assert gate.main(["--fresh", str(p), "--baseline", str(rec)]) == want, (
+            scaling, stale,
+        )

@@ -1,0 +1,458 @@
+"""Read-scaling plane tests (ISSUE 17): replica-served reads under the
+bounded-staleness contract.
+
+Covers the acceptance matrix end to end:
+
+  * wire A/B byte-identity — for every read-verb family exercised, the raw
+    RESP byte stream a READONLY-armed replica serves is IDENTICAL to the
+    master's, on both protocol versions, with the native wire plane armed
+    AND with ``RTPU_NO_NATIVE=1`` (subprocess legs);
+  * READONLY / READWRITE connection semantics (Redis parity);
+  * REPLSTATE / REPLPING — the staleness contract's server half;
+  * the promotion bugfix — a promoted replica flushes/rebuilds its hydrated
+    plane under the promoted fence epoch and REJECTS the old master's late
+    pushes (kill/promote regression);
+  * client-side staleness redirects (``max_staleness_ms``);
+  * OccupancyLoadBalancer scoring/scrape/pick behavior;
+  * the read-scale soak profile (fast tier here, full storm in the slow
+    tier).
+"""
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.harness import ClusterRunner, _exec
+from redisson_tpu.net.balancer import OccupancyLoadBalancer
+from redisson_tpu.net.resp import RespError
+
+
+# -- wire A/B: replica-served replies are byte-identical to the master's ------
+
+# Driver: forms a 1-master/1-replica cluster, seeds every record family the
+# read plane serves, REPLFLUSHes, then drives the SAME pipelined read-verb
+# stream over a raw socket against the master and against the READONLY-armed
+# replica, hashing the raw reply bytes per node per protocol.  Prints
+# "proto=N master=<sha> replica=<sha>" lines; the test asserts the pairs
+# match.  Run once natively and once under RTPU_NO_NATIVE=1 (the digests
+# must also agree ACROSS those runs — one reply stream, three planes).
+_AB_DRIVER = r"""
+import hashlib, socket
+import numpy as np
+from redisson_tpu.harness import ClusterRunner, _exec
+from redisson_tpu.net import resp
+
+members = (np.arange(64, dtype=np.int64) * 2654435761).tobytes()
+probe = (np.arange(16, dtype=np.int64) * 2654435761).tobytes()
+
+SEED = [
+    ("SET", "s:k", "payload"),
+    ("SET", "s:k2", "other"),
+    ("SET", "s:bits", "foobar"),
+    ("RPUSH", "l:k", *[f"e{i}" for i in range(32)]),
+    ("HSET", "h:k", *[x for i in range(16) for x in (f"f{i}", f"v{i}")]),
+    ("SADD", "set:k", *[f"m{i}" for i in range(24)]),
+    ("ZADD", "z:k", *[x for i in range(24) for x in (str(i * 0.5), f"z{i}")]),
+    ("BF.RESERVE", "bf:k", "0.01", "10000"),
+    ("BF.MADD64", "bf:k", members),
+    ("PFADD", "hll:k", *[f"p{i}" for i in range(48)]),
+    ("XADD", "x:k", "1-1", "a", "1"),
+    ("XADD", "x:k", "2-1", "b", "2"),
+    ("JSON.SET", "j:k", "$", '{"a": 1, "b": [2, 3], "c": "s"}'),
+    ("GEOADD", "g:k", "13.361389", "38.115556", "Palermo",
+     "15.087269", "37.502669", "Catania"),
+]
+
+READS = [
+    ("GET", "s:k"), ("GET", "missing"), ("MGET", "s:k", "s:k2", "missing"),
+    ("EXISTS", "s:k", "missing"), ("TYPE", "s:k"), ("STRLEN", "s:k"),
+    ("GETRANGE", "s:k", "1", "4"), ("TTL", "s:k"), ("PTTL", "s:k"),
+    ("GETBIT", "s:bits", "7"), ("BITCOUNT", "s:bits"),
+    ("BITPOS", "s:bits", "1"),
+    ("LRANGE", "l:k", "0", "-1"), ("LLEN", "l:k"), ("LINDEX", "l:k", "3"),
+    ("LPOS", "l:k", "e7"),
+    ("HGET", "h:k", "f3"), ("HGETALL", "h:k"), ("HKEYS", "h:k"),
+    ("HVALS", "h:k"), ("HLEN", "h:k"), ("HMGET", "h:k", "f1", "f2", "nope"),
+    ("HEXISTS", "h:k", "f0"), ("HSTRLEN", "h:k", "f1"),
+    ("SMEMBERS", "set:k"), ("SCARD", "set:k"), ("SISMEMBER", "set:k", "m3"),
+    ("SMISMEMBER", "set:k", "m1", "nope"),
+    ("ZRANGE", "z:k", "0", "-1"), ("ZRANGE", "z:k", "0", "-1", "WITHSCORES"),
+    ("ZSCORE", "z:k", "z5"), ("ZCARD", "z:k"), ("ZRANK", "z:k", "z9"),
+    ("ZCOUNT", "z:k", "1", "5"), ("ZMSCORE", "z:k", "z1", "nope"),
+    ("ZRANGEBYSCORE", "z:k", "2", "6"), ("ZREVRANGE", "z:k", "0", "5"),
+    ("BF.EXISTS", "bf:k", "2654435761"), ("BF.MEXISTS64", "bf:k", probe),
+    ("BF.INFO", "bf:k"),
+    ("PFCOUNT", "hll:k"),
+    ("XLEN", "x:k"), ("XRANGE", "x:k", "-", "+"),
+    ("JSON.GET", "j:k", "$"), ("JSON.TYPE", "j:k", "$"),
+    ("JSON.OBJKEYS", "j:k", "$"), ("JSON.ARRLEN", "j:k", "$.b"),
+    ("GEOPOS", "g:k", "Palermo"), ("GEODIST", "g:k", "Palermo", "Catania"),
+]
+
+
+def reply_digest(node, proto):
+    host = node.server.server.host
+    port = node.server.server.port
+    s = socket.create_connection((host, port), timeout=30)
+    parser = resp.RespParser(use_native=False)
+    try:
+        # preamble consumed BEFORE the hashed stream starts: HELLO flips the
+        # protocol (its reply differs by node identity), READONLY arms
+        # replica reads (+OK on a master too — same conn discipline both
+        # sides)
+        pre = ([("HELLO", "3")] if proto == 3 else []) + [("READONLY",)]
+        s.sendall(b"".join(resp.encode_command_python(*c) for c in pre))
+        got = 0
+        while got < len(pre):
+            data = s.recv(1 << 16)
+            assert data, "server closed during preamble"
+            got += len(parser.feed(data))
+        h = hashlib.sha256()
+        s.sendall(b"".join(resp.encode_command_python(*c) for c in READS))
+        got = 0
+        while got < len(READS):
+            data = s.recv(1 << 16)
+            assert data, "server closed mid-stream"
+            h.update(data)
+            got += len(parser.feed(data))
+        return h.hexdigest()
+    finally:
+        s.close()
+
+
+runner = ClusterRunner(masters=1, replicas_per_master=1).run()
+try:
+    with runner.masters[0].server.client() as c:
+        for cmd in SEED:
+            _exec(c, *cmd)
+        assert _exec(c, "REPLFLUSH") >= 1
+    for proto in (2, 3):
+        m = reply_digest(runner.masters[0], proto)
+        r = reply_digest(runner.replicas[0], proto)
+        print(f"proto={proto} master={m} replica={r}")
+finally:
+    runner.shutdown()
+"""
+
+
+def test_replica_replies_byte_identical_native_and_fallback():
+    """ISSUE 17 acceptance: the replica-served raw reply stream is
+    byte-identical to the master-served one for every read verb exercised,
+    on RESP2 and RESP3, with the native plane armed and under
+    RTPU_NO_NATIVE=1 — and identical ACROSS the native/fallback planes."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runs = {}
+    for label, extra_env in (("native", {}), ("fallback", {"RTPU_NO_NATIVE": "1"})):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **extra_env)
+        out = subprocess.run(
+            [sys.executable, "-c", _AB_DRIVER],
+            capture_output=True, text=True, timeout=300, cwd=repo, env=env,
+        )
+        assert out.returncode == 0, (label, out.stdout[-2000:], out.stderr[-2000:])
+        lines = [ln for ln in out.stdout.splitlines() if ln.startswith("proto=")]
+        assert len(lines) == 2, (label, out.stdout)
+        for ln in lines:
+            proto, master, replica = (kv.split("=")[1] for kv in ln.split())
+            assert master == replica, (label, ln)
+            assert len(master) == 64
+            runs[(label, proto)] = master
+    # the reply stream is also plane-independent (native vs pure python)
+    for proto in ("2", "3"):
+        assert runs[("native", proto)] == runs[("fallback", proto)]
+
+
+# -- READONLY / READWRITE connection semantics --------------------------------
+
+def test_readonly_readwrite_connection_semantics():
+    runner = ClusterRunner(masters=1, replicas_per_master=1).run()
+    try:
+        with runner.masters[0].server.client() as c:
+            _exec(c, "SET", "ro:k", "v")
+            assert _exec(c, "REPLFLUSH") >= 1
+        rep = runner.replicas[0]
+        with rep.server.client() as c:
+            # keyless commands never need READONLY
+            assert _exec(c, "PING") is not None
+            # keyed read without READONLY: -MOVED to the slot owner
+            reply = c.execute("GET", "ro:k")
+            assert isinstance(reply, RespError) and str(reply).startswith("MOVED ")
+            assert runner.masters[0].address in str(reply)
+            # READONLY arms the connection ...
+            assert _exec(c, "READONLY") is not None
+            assert _exec(c, "GET", "ro:k") == b"v"
+            # ... but never makes a replica writable
+            reply = c.execute("SET", "ro:k", "x")
+            assert isinstance(reply, RespError) and "READONLY" in str(reply)
+            # READWRITE restores the MOVED discipline (Redis parity)
+            assert _exec(c, "READWRITE") is not None
+            reply = c.execute("GET", "ro:k")
+            assert isinstance(reply, RespError) and str(reply).startswith("MOVED ")
+        # on a MASTER both verbs are accepted no-ops
+        with runner.masters[0].server.client() as c:
+            assert _exec(c, "READONLY") is not None
+            assert _exec(c, "READWRITE") is not None
+            assert _exec(c, "GET", "ro:k") == b"v"
+    finally:
+        runner.shutdown()
+
+
+# -- REPLSTATE / REPLPING: the contract's server half -------------------------
+
+def test_replstate_staleness_and_heartbeat():
+    runner = ClusterRunner(masters=1, replicas_per_master=1).run()
+    try:
+        master, rep = runner.masters[0], runner.replicas[0]
+        # a master is never stale with respect to itself
+        with master.server.client() as c:
+            role, _off, stale, epoch = _exec(c, "REPLSTATE")
+            assert bytes(role) == b"master" and stale == 0 and epoch >= 0
+        # freeze the shipper so no push/heartbeat can race the assertions
+        # (an in-flight sweep may still land: give it a beat to drain)
+        runner.stall_replication(master)
+        time.sleep(0.3)
+        srv = rep.server.server
+        srv.repl_applied_at = None  # as after (re)wiring: never synced
+        with rep.server.client() as c:
+            role, _off, stale, _e = _exec(c, "REPLSTATE")
+            assert bytes(role) == b"replica" and stale == -1
+            # MAXSTALE form: same shape, counts the server-side redirect
+            before = srv.stats.get("replica_redirects_stale", 0)
+            state = _exec(c, "REPLSTATE", "MAXSTALE", "1000")
+            assert state[2] == -1
+            assert srv.stats["replica_redirects_stale"] == before + 1
+            # a heartbeat restarts the staleness clock without any payload
+            off0 = int(state[1])
+            _exec(c, "REPLPING", str(off0 + 7), str(time.time()))
+            role, off, stale, _e = _exec(c, "REPLSTATE", "MAXSTALE", "60000")
+            assert off == off0 + 7 and 0 <= stale < 60000
+            assert srv.stats["replica_redirects_stale"] == before + 1
+        runner.resume_replication(master)
+        # the resumed stream keeps the replica fresh end-to-end
+        with master.server.client() as c:
+            _exec(c, "SET", "hb:k", "v")
+            _exec(c, "REPLFLUSH")
+        with rep.server.client() as c:
+            state = _exec(c, "REPLSTATE")
+            assert 0 <= state[2] < 60000
+    finally:
+        runner.shutdown()
+
+
+# -- promotion bugfix: hydrated plane rebuilt under the promoted epoch --------
+
+def test_promote_rejects_stale_replication_pushes():
+    """Kill/promote regression (ISSUE 17 bugfix): the instant a replica is
+    promoted its hydrated device plane is MASTER state — late REPLPUSHes
+    from the old master must be rejected, never silently applied over the
+    promoted epoch."""
+    runner = ClusterRunner(masters=1, replicas_per_master=1).run()
+    try:
+        master, rep = runner.masters[0], runner.replicas[0]
+        with master.server.client() as c:
+            for i in range(8):
+                _exec(c, "SET", f"pr:{i}", f"v{i}")
+            assert _exec(c, "REPLFLUSH") >= 1
+        srv = rep.server.server
+        assert srv.stats.get("promotions", 0) == 0
+        # promote WITHOUT telling the old master (the failover race): its
+        # next sweep will push at a node that is no longer its replica
+        with rep.server.client() as c:
+            _exec(c, "REPLICAOF", "NO", "ONE")
+        assert srv.role == "master"
+        assert srv.stats["promotions"] == 1
+        with rep.server.client() as c:
+            # promoted node answers as an authoritative master: staleness
+            # pinned to 0, every replication-stream verb role-gate rejected
+            state = _exec(c, "REPLSTATE")
+            assert bytes(state[0]) == b"master" and state[2] == 0
+            for verb, args in (("REPLPUSH", ("blob",)),
+                               ("REPLPUSHSEG", ("x", "0", "1", "blob")),
+                               ("REPLPING", ("1", "0.0"))):
+                reply = c.execute(verb, *args)
+                assert isinstance(reply, RespError), verb
+                assert "rejected: node is a master" in str(reply), reply
+        # the old master (still owning the slots in the not-yet-updated
+        # view) keeps writing and flushing: the push at its ex-replica is
+        # rejected, the promoted plane must NOT regress
+        with master.server.client() as c:
+            _exec(c, "SET", "pr:0", "STALE")
+            c.execute("REPLFLUSH")  # push is rejected; link marked unhealthy
+        # now the coordinator half: point the slot view at the promoted node
+        runner.adopt_failover(master.address, rep.address)
+        runner.install_view()
+        with rep.server.client() as c:
+            # hydrated device plane serves under the promoted epoch — no
+            # READONLY needed, pre-failover values intact, stale push absent
+            for i in range(8):
+                assert _exec(c, "GET", f"pr:{i}") == f"v{i}".encode()
+            # writes apply (it IS the master now)
+            _exec(c, "SET", "pr:new", "after")
+            assert _exec(c, "GET", "pr:new") == b"after"
+    finally:
+        runner.shutdown()
+
+
+# -- client-side staleness redirects ------------------------------------------
+
+def test_client_redirects_stale_replica_reads_to_master():
+    runner = ClusterRunner(masters=1, replicas_per_master=1).run()
+    client = None
+    try:
+        master = runner.masters[0]
+        client = runner.client(
+            scan_interval=0, read_mode="replica", max_staleness_ms=100,
+        )
+        b = client.get_bucket("st:k")
+        b.set("v1")
+        with master.server.client() as c:
+            assert _exec(c, "REPLFLUSH") >= 1
+        client.refresh_topology()
+        # freeze the stream, let the replica's last-applied stamp age past
+        # the bound: reads must redirect to the master and STILL be right
+        runner.stall_replication(master)
+        time.sleep(0.4)
+        b.set("v2")  # master-applied; the stalled replica never hears it
+        before = dict(client.read_stats)
+        assert b.get() == "v2"
+        assert client.read_stats["replica_redirects_stale"] > before["replica_redirects_stale"]
+        # resume + flush: the replica is fresh again and serves directly
+        runner.resume_replication(master)
+        with master.server.client() as c:
+            _exec(c, "REPLFLUSH")
+        deadline = time.monotonic() + 5.0
+        served = dict(client.read_stats)
+        while time.monotonic() < deadline:
+            assert b.get() == "v2"
+            if client.read_stats["replica_reads"] > served["replica_reads"]:
+                break
+            time.sleep(0.05)
+        assert client.read_stats["replica_reads"] > served["replica_reads"]
+        # read_mode=master client never touches the replica plane
+        mclient = runner.client(scan_interval=0)
+        try:
+            assert mclient.get_bucket("st:k").get() == "v2"
+            assert mclient.read_stats["replica_reads"] == 0
+        finally:
+            mclient.shutdown()
+    finally:
+        if client is not None:
+            client.shutdown()
+        runner.shutdown()
+
+
+# -- OccupancyLoadBalancer ----------------------------------------------------
+
+class _QosNode:
+    """Fake NodeClient: answers CLUSTER QOS with a canned ledger."""
+
+    def __init__(self, addr, infl_ops=0.0, own=0, fail=False):
+        self.address = addr
+        self.infl_ops = infl_ops
+        self.own = own
+        self.fail = fail
+        self.probes = 0
+
+    def execute(self, *args, **kw):
+        self.probes += 1
+        if self.fail:
+            raise ConnectionError("unreachable")
+        return [1, 0, 0,
+                [b"interactive", 0, self.infl_ops / 2, 0],
+                [b"bulk", 0, self.infl_ops / 2, 0],
+                [b"TENANT", b"t0", 99, 99]]  # tenant rows never counted
+
+    def in_flight(self):
+        return self.own
+
+
+def test_occupancy_balancer_qos_parsing_and_own_load_correction():
+    lb = OccupancyLoadBalancer(scrape_interval=0.0)
+    assert lb._qos_infl_ops(
+        [1, 0, 0, [b"interactive", 0, 3, 0], [b"bulk", 0, 4, 0],
+         [b"TENANT", b"x", 50, 0]]
+    ) == 7.0
+    assert lb._qos_infl_ops([0, 0, 0]) == 0.0
+    # scraped ledger INCLUDES our own in-flight ops: the score must book
+    # them apart (others = scraped - own_at_scrape) and re-read own live
+    n = _QosNode("a:1", infl_ops=10.0, own=4)
+    lb._scrape(n)
+    assert lb.score(n) == pytest.approx(10.0)  # (10 - 4) others + 4 own
+    n.own = 0  # our ops drained; scrape snapshot unchanged
+    assert lb.score(n) == pytest.approx(6.0)
+    n.own = 9  # new local burst counts live, others stay fixed
+    assert lb.score(n) == pytest.approx(15.0)
+
+
+def test_occupancy_balancer_prefers_idle_and_spreads():
+    lb = OccupancyLoadBalancer(scrape_interval=0.0)
+    busy = _QosNode("busy:1", infl_ops=50.0)
+    idle_a = _QosNode("idle-a:1", infl_ops=0.0)
+    idle_b = _QosNode("idle-b:1", infl_ops=0.0)
+    picks = [lb.pick([busy, idle_a, idle_b]).address for _ in range(60)]
+    # power-of-two-choices: the loaded node loses every pair it lands in,
+    # so it collects at most the busy-vs-busy draws — never a majority —
+    # while the idle pair SHARES the load (round-robin on exact ties)
+    assert picks.count("busy:1") < 20
+    assert picks.count("idle-a:1") > 5 and picks.count("idle-b:1") > 5
+    # two-node shards score both (no sampling): strict preference holds
+    picks2 = {lb.pick([busy, idle_a]).address for _ in range(8)}
+    assert picks2 == {"idle-a:1"}
+
+
+def test_occupancy_balancer_failed_scrape_ages_out():
+    lb = OccupancyLoadBalancer(scrape_interval=0.0, stale_after=0.05)
+    n = _QosNode("dead:1", infl_ops=40.0, own=2)
+    lb._scrape(n)
+    assert lb.score(n) == pytest.approx(40.0)
+    n.fail = True  # probes start failing: the snapshot must age out
+    time.sleep(0.06)
+    lb._scrape(n)
+    assert lb.score(n) == pytest.approx(2.0)  # local in-flight only
+    # scrape throttle: a fresh reservation stops probe stampedes
+    lb2 = OccupancyLoadBalancer(scrape_interval=60.0)
+    m = _QosNode("m:1", infl_ops=1.0)
+    lb2._scrape(m)
+    lb2._scrape(m)
+    assert m.probes == 1
+
+
+# -- the soak profile ---------------------------------------------------------
+
+def test_read_scale_soak_smoke():
+    """Fast tier: replica-routed tracked readers + writers through a slot
+    round-trip AND a replica kill (reads drain to the master) — zero stale
+    reads, full convergence, flat tracking tables.  The master-kill +
+    promotion storm runs in the slow tier."""
+    from redisson_tpu.chaos.soak import ReadScaleSoakConfig, ReadScaleSoakHarness
+
+    report = ReadScaleSoakHarness(ReadScaleSoakConfig(
+        cycles=1, seed=0, kill=False, replica_kill=True,
+        phase_seconds=0.6, keys=32, readers=2,
+    )).run()
+    assert report.stale_reads == 0
+    assert report.converged_keys == 32
+    assert report.migrations == 1 and report.records_migrated > 0
+    assert report.replica_reads > 0
+    assert report.replica_kills == 1 and report.replica_fallbacks > 0
+    assert report.reads > 0 and report.writes_acked > 0
+
+
+@pytest.mark.slow
+def test_read_scale_soak_kill_failover():
+    """Slow tier: the full storm — migration round-trip, replica kill, AND
+    master SIGKILL-analog + promotion under replica-routed tracked
+    readers, two cycles."""
+    from redisson_tpu.chaos.soak import ReadScaleSoakConfig, ReadScaleSoakHarness
+
+    report = ReadScaleSoakHarness(ReadScaleSoakConfig(
+        cycles=2, seed=0, kill=True, replica_kill=True,
+    )).run()
+    assert report.stale_reads == 0
+    assert report.failovers >= 1
+    assert report.replica_kills == 2 and report.replica_fallbacks > 0
+    assert report.converged_keys == 48
+    assert report.replica_reads > 0

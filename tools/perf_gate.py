@@ -69,6 +69,15 @@ METRICS = [
     # on, every round, not merely "no worse than last round".
     ("config6 server-op reduction", ("details", "config6_server_op_reduction"), True, True),
     ("config6 tracked read ops/s", ("details", "config6_tracked_read_ops_per_sec"), True, False),
+    # config6r (ISSUE 17): the read-scaling plane — 4-replica-vs-1-replica
+    # read QPS ratio under the config5d CPU-replica occupancy convention
+    # (auto-disarmed on a real TPU).  Gated relative (n/a-pass on first
+    # sight) AND bound absolutely below: replicas must deliver >= 2.5x at
+    # 4 replicas, and the p99 replica staleness under write traffic must
+    # stay inside the CEILING — read scaling bought by serving stale data
+    # is not read scaling.
+    ("config6r read qps scaling", ("details", "config6r_read_qps_scaling"), True, True),
+    ("config6r staleness p99 ms", ("details", "config6r_staleness_p99_ms"), False, False),
     # config2q (ISSUE 10): interactive tail latency under the hostile
     # mixed-tenant flood with the QoS scheduler armed, and the p99 fairness
     # ratio between equal-budget tenants.  Both gated relative to baseline
@@ -135,6 +144,10 @@ FLOORS = [
     # armed/disarmed ops ratio — binds from first sight, n/a while absent
     ("obs armed tracing ratio >= 0.97",
      ("details", "obs_armed_overhead_ratio"), 0.97),
+    # ISSUE 17: 4 replicas must actually absorb reads — >= 2.5x the
+    # 1-replica read QPS on the zipf blob-read mix, from first sight
+    ("config6r read qps scaling >= 2.5x",
+     ("details", "config6r_read_qps_scaling"), 2.5),
 ]
 
 # (label, extractor-path, maximum) — ABSOLUTE ceilings, same first-sight
@@ -146,6 +159,13 @@ CEILINGS = [
     # device bytes at most 0.35x what f32 storage of the same rows costs
     ("config7 int8 bytes ratio <= 0.35x",
      ("details", "config7_int8_bytes_ratio"), 0.35),
+    # ISSUE 17: p99 replica staleness (REPLSTATE receipt clock) through
+    # the 4-replica read window with the writer active — replicas serving
+    # reads must stay within the bounded-staleness contract's ballpark
+    # (client-side bound in the bench is 2000ms; the sweep cadence plus
+    # heartbeat keeps a healthy replica an order of magnitude fresher)
+    ("config6r staleness p99 ms <= 1500",
+     ("details", "config6r_staleness_p99_ms"), 1500.0),
 ]
 
 
@@ -255,16 +275,17 @@ def render(rows, threshold: float) -> str:
     out.append(
         f"gate: >{threshold:.0%} regression in headline, config5, config5p, "
         "config5d (ops/s AND 1-vs-N speedup), config2 flush p99, config4 "
-        "cold, config6 reduction, config2q interactive p99, config2q "
-        "fairness, config7 knn qps, config7 ivf qps, or config7 sharded "
-        "qps fails; other drops are advisory (WARN); a metric absent from "
-        "the baseline reads n/a and passes (recorded on first sight).  "
-        "Absolute floors (config6 reduction >= 10x, config2q speedup vs "
+        "cold, config6 reduction, config6r read scaling, config2q "
+        "interactive p99, config2q fairness, config7 knn qps, config7 ivf "
+        "qps, or config7 sharded qps fails; other drops are advisory "
+        "(WARN); a metric absent from the baseline reads n/a and passes "
+        "(recorded on first sight).  Absolute floors (config6 reduction "
+        ">= 10x, config6r read scaling >= 2.5x, config2q speedup vs "
         "no-qos >= 1.2x, config7 recall@10 >= 0.99, ivf recall >= 0.97 + "
         "ivf speedup >= 2x, int8 recall >= 0.95, sharded recall >= 0.99 + "
         "sharded speedup vs 1 shard >= 1.5x, armed tracing ratio >= 0.97) "
         "and ceilings (config2q fairness <= 2x, int8 bytes ratio <= "
-        "0.35x) bind from first sight."
+        "0.35x, config6r staleness p99 <= 1500ms) bind from first sight."
     )
     return "\n".join(out)
 

@@ -67,6 +67,15 @@
     constellation rebalances, and the run additionally asserts
     host_colocations unmoved (never a host gather) with
     sharded_knn_merges > 0 and per-device census rows flat.
+  * ``read-scale`` — the replica read-scaling profile (ISSUE 17): tracked
+    zipf readers route every keyed read to REPLICAS (read_mode=replica +
+    the bounded-staleness probe) while key slots migrate m0 -> m1 -> m0,
+    a replica is killed mid-traffic (reads must drain to the master), and
+    the write-owning master is killed and promoted.  Asserts ZERO stale
+    tracked reads (replica-side tracking tables must invalidate on
+    REPLPUSH apply), zero acked-write loss, replica_fallbacks > 0 over
+    the replica-kill window, convergence to ground truth after quiesce,
+    and tracking tables drained flat when readers disconnect.
   * ``tracking`` — the near-cache coherence profile (ISSUE 7): zipf
     readers with server-assisted near caches (CLIENT TRACKING) keep
     reading while key-bearing slots migrate m0 -> m1 -> m0 and the
@@ -101,7 +110,7 @@ def main() -> int:
     ap.add_argument("--profile",
                     choices=("standard", "migration", "cluster-proc",
                              "fleet", "fleet-host", "tracking",
-                             "device-shard", "qos", "vector"),
+                             "read-scale", "device-shard", "qos", "vector"),
                     default="standard")
     ap.add_argument("--cycles", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
@@ -138,6 +147,15 @@ def main() -> int:
 
         harness = DeviceShardSoakHarness(DeviceShardSoakConfig(
             cycles=args.cycles, seed=args.seed,
+        ))
+    elif args.profile == "read-scale":
+        from redisson_tpu.chaos.soak import (
+            ReadScaleSoakConfig, ReadScaleSoakHarness,
+        )
+
+        harness = ReadScaleSoakHarness(ReadScaleSoakConfig(
+            cycles=args.cycles, seed=args.seed,
+            kill=not args.no_kill,
         ))
     elif args.profile == "tracking":
         from redisson_tpu.chaos.soak import (
