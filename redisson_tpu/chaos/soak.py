@@ -612,6 +612,13 @@ class ClusterProcSoakConfig:
     error_budget_ratio: float = 2.0  # dead-process windows are real here
     verify_retries: int = 30
     ready_timeout: float = 90.0
+    # replicas per master (ISSUE 18 satellite): >0 spawns replica PROCESSES
+    # and adds a read_mode="replica" reader thread to the workload, so
+    # replica-served reads (staleness probe + master re-serve, the PR 17
+    # plane) are exercised on the multi-process supervisor fleet, not just
+    # the in-process harness.  Correctness stays carried by the master-read
+    # verify; the reader's errors are budgeted like the mapper's.
+    replicas: int = 0
 
 
 @dataclass
@@ -626,6 +633,7 @@ class ClusterProcSoakReport:
     verified_writes: int = 0
     errors: int = 0
     bloom_keys_verified: int = 0
+    replica_reads: int = 0
     exit_codes: List[int] = field(default_factory=list)
 
     def summary(self) -> str:
@@ -702,7 +710,8 @@ class ClusterProcSoakHarness:
         # overrides): N processes cannot share one TPU chip — same
         # discipline as bench config5p
         return ClusterSupervisor(
-            masters=2, ready_timeout=self.config.ready_timeout,
+            masters=2, replicas_per_master=self.config.replicas,
+            ready_timeout=self.config.ready_timeout,
             platform=os.environ.get("RTPU_PROC_PLATFORM", "cpu"),
         )
 
@@ -771,6 +780,33 @@ class ClusterProcSoakHarness:
                 stop.wait(0.05)  # a dead-process window fails fast; back off
             i += 1
             stop.wait(0.004)
+
+    def _replica_reader(self, stop: threading.Event) -> None:
+        """Replica-plane read traffic (config.replicas > 0): GETs on the
+        soak keys through a read_mode="replica" client — the bounded-
+        staleness probe rides every read (the client's derived default
+        offset bound), stale verdicts re-serve from the master, and dead-
+        process windows are budgeted errors exactly like the mapper's.
+        The run loop asserts the replica plane actually served reads."""
+        client = self._sup.client(
+            read_mode="replica", scan_interval=0.5, timeout=15.0,
+            connect_timeout=5.0, retry_attempts=2, retry_interval=0.1,
+        )
+        i = 0
+        try:
+            while not stop.is_set():
+                try:
+                    client.execute("GET", self._keys[i % len(self._keys)])
+                except Exception:  # noqa: BLE001 — budgeted chaos error
+                    with self._acked_lock:
+                        self.report.errors += 1
+                    stop.wait(0.05)
+                i += 1
+                stop.wait(0.01)
+            with self._acked_lock:
+                self.report.replica_reads += client.read_stats["replica_reads"]
+        finally:
+            client.shutdown()
 
     def _mapper(self, cycle: int, stop: threading.Event) -> None:
         """The 'mixed' half: hash traffic sharing the moving slot range
@@ -980,6 +1016,10 @@ class ClusterProcSoakHarness:
                     threading.Thread(target=self._writer, args=(w, cycle, stop))
                     for w in range(cfg.writer_threads)
                 ] + [threading.Thread(target=self._mapper, args=(cycle, stop))]
+                if cfg.replicas > 0:
+                    threads.append(threading.Thread(
+                        target=self._replica_reader, args=(stop,)
+                    ))
                 try:
                     for t in threads:
                         t.start()
@@ -1008,6 +1048,11 @@ class ClusterProcSoakHarness:
                 f"error budget blown: {self.report.errors} errors vs "
                 f"{self.report.acked_writes} acked writes (budget {budget})"
             )
+            if cfg.replicas > 0:
+                assert self.report.replica_reads > 0, (
+                    "replica fleet spawned but the replica plane served "
+                    "zero reads — the read_mode=replica leg never engaged"
+                )
             return self.report
         finally:
             self._teardown()
@@ -3135,6 +3180,13 @@ class QosSoakConfig:
     tenant_rate: float = 60_000.0      # items/s — binds on the hog only
     tenant_burst: float = 90_000.0
     shed_penalty_ms: float = 5.0
+    # preemptible sub-windows (ISSUE 18): split the hog's fused runs into
+    # chunks of this many device items with a preemption point between —
+    # smaller than one hog command's blob, so splitting + the per-class
+    # streams are genuinely exercised under chaos (0 = historical whole-
+    # window dispatch).  The flat-census assertion then covers the
+    # per-stream ledger rows too.
+    bulk_subwindow_items: int = 8_000
     phase_seconds: float = 1.2
     migrate_count: int = 4
     faults_per_cycle: int = 3
@@ -3220,6 +3272,9 @@ class QosSoakHarness:
             srv.config_set("qos-tenant-rate", str(cfg.tenant_rate))
             srv.config_set("qos-tenant-burst", str(cfg.tenant_burst))
             srv.config_set("qos-shed-penalty-ms", str(cfg.shed_penalty_ms))
+            srv.config_set(
+                "qos-bulk-subwindow-items", str(cfg.bulk_subwindow_items)
+            )
         self._client = self._runner.client(
             scan_interval=0.5, timeout=10.0, connect_timeout=5.0,
             retry_attempts=1, retry_interval=0.2,
@@ -3257,6 +3312,12 @@ class QosSoakHarness:
             self.census.track_server(f"master{i}", m.server.server)
 
     def _teardown(self) -> None:
+        from redisson_tpu.core import ioplane as _iop
+
+        # the sub-window knob is process-global (the CONFIG SET push):
+        # restore the default so later harnesses in this process see the
+        # historical whole-window dispatch unless they arm it themselves
+        _iop.set_bulk_subwindow_items(0)
         if self._client is not None:
             self._client.shutdown()
         if self._runner is not None:

@@ -33,6 +33,19 @@ READ_MASTER = "master"
 READ_REPLICA = "replica"
 READ_MASTER_SLAVE = "master_slave"
 
+# Default sweep-cut lag bound for replica-read profiles, DERIVED from the
+# replication shipper's cadence (server/replication.py): the master's
+# ``offset`` ticks once per sweep CUT and the shipper sweeps every 0.2 s by
+# default, so a HEALTHY replica is at most ~2 cuts behind at any instant —
+# the cut currently in flight on the link plus the cut forming at the
+# master (the heartbeat, throttled to interval/2, keeps an idle link's lag
+# at 0).  Bounding lag at 2 cuts therefore admits every healthy replica
+# (~0.4 s of writes at the default cadence) while redirecting reads off a
+# replica whose link has actually stalled — without the operator having to
+# know the shipper's internals.  Explicit ``max_staleness_ms`` /
+# ``max_staleness_offset`` values override the derivation entirely.
+DEFAULT_REPLICA_STALENESS_OFFSET = 2
+
 
 class ShardEntry:
     """One shard: master client + replica clients + read balancer
@@ -108,6 +121,13 @@ class ClusterRedisson(RemoteSurface):
         # sweep-cut lag against the highest offset this client has seen any
         # node of the shard prove.
         self.max_staleness_ms = max_staleness_ms
+        if (max_staleness_offset is None and max_staleness_ms is None
+                and read_mode != READ_MASTER):
+            # replica-read profiles are staleness-bounded BY DEFAULT: the
+            # sweep-cut lag bound derived from the shipper's cadence (see
+            # DEFAULT_REPLICA_STALENESS_OFFSET).  Any explicit bound —
+            # either axis — overrides the derivation.
+            max_staleness_offset = DEFAULT_REPLICA_STALENESS_OFFSET
         self.max_staleness_offset = max_staleness_offset
         self.read_stats: Dict[str, int] = {
             "replica_reads": 0,
@@ -115,6 +135,15 @@ class ClusterRedisson(RemoteSurface):
             "replica_fallbacks": 0,
         }
         self._shard_offsets: Dict[str, int] = {}  # master addr -> max offset seen
+        if balancer is None and read_mode != READ_MASTER:
+            # replica-read profiles default to lane-occupancy scoring
+            # (ISSUE 18): each read leg steers to the candidate whose
+            # device lanes are idlest per its scraped CLUSTER QOS ledger,
+            # not just round-robin.  One shared instance — it keys its
+            # scrape cache by node address.
+            from redisson_tpu.net.balancer import OccupancyLoadBalancer
+
+            balancer = OccupancyLoadBalancer()
         self._balancer_factory = balancer
         self._node_kw = dict(node_kw)
         # config-level SPIs ride every node connection of the cluster
@@ -388,7 +417,18 @@ class ClusterRedisson(RemoteSurface):
                     # rotate per redirect attempt: pinning keyless commands
                     # to entries[0] forever starves them when that one node
                     # is down but not yet pruned from the table
-                    node = entries[attempt % len(entries)].master
+                    entry = entries[attempt % len(entries)]
+                    node = entry.master
+                    if self.read_mode != READ_MASTER and not write \
+                            and routing.replica_readable(cmd, cmd_args[1:]):
+                        # keyless FT reads ride the replica plane too
+                        # (ISSUE 18): same staleness probe + master
+                        # re-serve as keyed replica reads
+                        cand = entry.read_node(self.read_mode)
+                        if cand is not entry.master:
+                            return self._execute_replica_read(
+                                entry, cand, cmd_args, timeout
+                            )
                 else:
                     entry = self.entry_for_slot(slot)
                     if write:
@@ -568,6 +608,50 @@ class ClusterRedisson(RemoteSurface):
             total += int(self.execute(cmd, *keys, timeout=timeout) or 0)
         return total
 
+    def _group_replies(self, entry: ShardEntry, cmds, timeout) -> List[Any]:
+        """One shard group's pipelined replies for execute_many — replica-
+        served when EVERY command of the group is replica-readable
+        (ISSUE 18 satellite: the read-only legs of FT.MSEARCH /
+        execute_many cross-shard fan-outs ride the PR 17 replica plane
+        instead of pinning to masters), master-served otherwise.  The
+        group's staleness probe rides the SAME frame (one REPLSTATE row
+        ahead of the group); a stale verdict or transport failure re-serves
+        the WHOLE group from the master (reads are idempotent); per-command
+        redirect rows (-MOVED/-ASK/...) surface to the caller exactly as
+        master-served rows do, preserving redirect parity."""
+        node = None
+        if self.read_mode != READ_MASTER and entry.replicas and all(
+            routing.replica_readable(str(c[0]), tuple(c[1:])) for c in cmds
+        ):
+            cand = entry.read_node(self.read_mode)
+            if cand is not entry.master:
+                node = cand
+        if node is None:
+            return entry.master.execute_many(cmds, timeout=timeout)
+        probe = (self.max_staleness_ms is not None
+                 or self.max_staleness_offset is not None)
+        try:
+            if not probe:
+                replies = node.execute_many(cmds, timeout=timeout)
+                self.read_stats["replica_reads"] += len(cmds)
+                return replies
+            ms = self.max_staleness_ms
+            replies = node.execute_many(
+                [("REPLSTATE", "MAXSTALE",
+                  int(1 << 30 if ms is None else ms))]
+                + [tuple(c) for c in cmds],
+                timeout=timeout,
+            )
+        except (ConnectionError, OSError, TimeoutError):
+            self.read_stats["replica_fallbacks"] += 1
+            return entry.master.execute_many(cmds, timeout=timeout)
+        state, rest = replies[0], replies[1:]
+        if isinstance(state, RespError) or not self._fresh_enough(entry, state):
+            self.read_stats["replica_redirects_stale"] += 1
+            return entry.master.execute_many(cmds, timeout=timeout)
+        self.read_stats["replica_reads"] += len(cmds)
+        return rest
+
     def execute_many(self, commands, timeout: Optional[float] = None):
         """Per-slot grouped pipeline (executeBatchedAsync per-entry grouping,
         CommandAsyncService.java:575-640): one pipelined frame per shard,
@@ -585,8 +669,8 @@ class ClusterRedisson(RemoteSurface):
             try:
                 if entry is None:
                     raise ConnectionError_(f"no entry for {addr}")
-                replies = entry.master.execute_many(
-                    [commands[i] for i in idxs], timeout=timeout
+                replies = self._group_replies(
+                    entry, [commands[i] for i in idxs], timeout
                 )
             except (ConnectionError, OSError, TimeoutError) as group_err:
                 # topology changed under us: redirect-aware per-command path.

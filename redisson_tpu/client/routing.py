@@ -70,17 +70,33 @@ def route(cmd: str, args: tuple) -> Tuple[Optional[int], bool]:
     return slots.pop(), write
 
 
+# Keyless READ verbs a replica serves (ISSUE 18): the FT search surface is
+# read-classified and keyless (indexes are named, not keyed — net/commands
+# SPECS), and the server's check_routing admits keyless reads on replicas,
+# so the read-only legs of FT.MSEARCH / execute_many fan-outs may ride the
+# replica plane.  The admin/introspection remainder of the keyless surface
+# stays master-routed.
+FT_REPLICA_READS = frozenset((
+    "FT.SEARCH", "FT.MSEARCH", "FT.AGGREGATE", "FT.INFO",
+))
+
+
 def replica_readable(cmd: str, args: tuple) -> bool:
     """True when a READONLY replica may serve this command (ISSUE 17): the
     client-side mirror of the server's check_routing admission — keyed
-    (slot-routed, single slot) and read-classified.  Keyless commands route
-    to masters (admin surface), writes always do, and split multi-key
-    reads re-enter per group where each group is re-checked."""
+    (slot-routed, single slot) and read-classified, plus the keyless FT
+    read verbs (FT_REPLICA_READS).  Other keyless commands route to
+    masters (admin surface), writes always do, and split multi-key reads
+    re-enter per group where each group is re-checked."""
     try:
         slot, write = route(cmd, args)
     except RespError:
         return False  # CROSSSLOT surfaces on the normal path
-    return slot is not None and slot != SPLIT and not write
+    if write:
+        return False
+    if slot is None:
+        return cmd.upper() in FT_REPLICA_READS
+    return slot != SPLIT
 
 
 def parse_view(view_rows: List[Any]) -> Tuple[List[Optional[str]], Dict[str, None]]:

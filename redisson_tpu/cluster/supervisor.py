@@ -195,6 +195,9 @@ class ClusterSupervisor:
             }
         self.masters: List[NodeProc] = []
         self.replicas: List[NodeProc] = []
+        # fleet-wide tenant budget control loop (ISSUE 18): armed on demand
+        # via start_qos_rebalance, reaped by shutdown
+        self._qos_rebalancer = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -247,6 +250,7 @@ class ClusterSupervisor:
         end: a wedged node (SIGSTOPped, hung in a flush) cannot stall the
         teardown — SIGKILL reaps even a stopped process.  Driver-held
         resources (ssh channels) are released last."""
+        self.stop_qos_rebalance()
         for node in self.nodes():
             if node.alive():
                 node.handle.signal(signal.SIGTERM)
@@ -831,6 +835,35 @@ class ClusterSupervisor:
         if self.tls_armed:
             kw.setdefault("ssl_context", self.client_ssl_context())
         return ClusterRedisson(self.seeds(), **kw)
+
+    def start_qos_rebalance(self, global_rate: float, *,
+                            global_burst: Optional[float] = None,
+                            interval: float = 1.0,
+                            min_share: float = 0.05):
+        """Arm the fleet-wide tenant budget control loop (ISSUE 18,
+        cluster/qos_control.py): scrape every master's ``CLUSTER QOS``
+        tenant table and re-split each tenant's ``global_rate`` across
+        masters proportional to observed demand, pushed via ``CLUSTER QOS
+        REBALANCE``.  Masters only — replicas don't admit writes, so
+        budgeting them would dilute the split.  Idempotent; stopped by
+        ``stop_qos_rebalance`` and by ``shutdown``."""
+        from redisson_tpu.cluster.qos_control import QosRebalancer
+
+        if self._qos_rebalancer is not None:
+            return self._qos_rebalancer
+        factories = {
+            n.address: self._conn_factory(n) for n in self.masters
+        }
+        self._qos_rebalancer = QosRebalancer(
+            factories, global_rate, global_burst=global_burst,
+            interval=interval, min_share=min_share,
+        ).start()
+        return self._qos_rebalancer
+
+    def stop_qos_rebalance(self) -> None:
+        rb, self._qos_rebalancer = self._qos_rebalancer, None
+        if rb is not None:
+            rb.stop()
 
     def scrape(self) -> str:
         """Fleet-wide Prometheus scrape (ISSUE 12): pull ``METRICS`` from

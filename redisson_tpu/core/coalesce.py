@@ -76,6 +76,45 @@ def runs_within_admission(runs, shed_mask) -> List[Tuple[int, int]]:
     return out
 
 
+def plan_subwindows(items: Sequence[int], target: int) -> List[Tuple[int, int]]:
+    """Partition one coalescible run into preemptible sub-windows
+    (ISSUE 18): given the per-command device-item counts of a run's
+    commands, return [start, end) chunks (indices into the run) such that
+    each chunk's total stays within ``target`` items — the bound on how
+    long one sub-window can occupy its device lane before the next
+    preemption point.
+
+    Splits happen at COMMAND boundaries only, never inside one command's
+    key batch: each chunk dispatches as a self-contained fused run with
+    the standard add-run at-most-once discipline (a failed chunk errors
+    per-command and is never re-dispatched; earlier chunks already applied
+    and replied — exactly the sub-run semantics ``runs_within_admission``
+    already establishes at shed boundaries).  A single command larger than
+    ``target`` therefore forms its own oversized chunk: bounding it any
+    tighter would require splitting a fused apply mid-batch, which the
+    at-most-once contract forbids.
+
+    ``target <= 0`` (splitting disarmed) or a run already within target
+    returns the whole run as one chunk — the historical dispatch shape.
+    """
+    n = len(items)
+    if n == 0:
+        return []
+    if target <= 0 or sum(items) <= target:
+        return [(0, n)]
+    out: List[Tuple[int, int]] = []
+    start = 0
+    acc = 0
+    for i, it in enumerate(items):
+        if i > start and acc + it > target:
+            out.append((start, i))
+            start = i
+            acc = 0
+        acc += it
+    out.append((start, n))
+    return out
+
+
 def _concat_segments(engine, keys_list) -> Tuple[np.ndarray, np.ndarray, List[int]]:
     """Concatenate per-op int-key arrays into one preallocated buffer plus an
     aligned segment-slot column.  Returns (slot, keys, lengths)."""

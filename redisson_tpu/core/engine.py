@@ -516,7 +516,14 @@ class Engine:
         if not (ioplane.overlap_enabled() and ioplane.staging_reuse_safe()):
             return None
         if device is not None and self.lanes is not None:
-            return self.lanes.lane(device).pool
+            lane = self.lanes.lane(device)
+            # interactive device stream (ISSUE 18): the holding thread's
+            # occupancy marks itself in TLS, so its flush packing stages
+            # through the lane's interactive slot instead of contending on
+            # the bulk stream's double buffer
+            if ioplane.current_stream() == "interactive":
+                return lane.ipool
+            return lane.pool
         return self.staging
 
     # -- key packing --------------------------------------------------------

@@ -87,6 +87,75 @@ def window_deadline() -> Optional[float]:
     return _window_deadline_s
 
 
+# -- bulk-window preemption (ISSUE 18) ----------------------------------------
+#
+# The PR 9 QoS plane bounds interactive latency at ADMISSION, but once a big
+# coalesced bulk window is in a lane it holds the device stream end to end —
+# the tracing plane shows the interactive wait sitting in `stage`, not `qos`.
+# Two mechanisms close that gap, both behind this one switch:
+#
+#   * sub-windows: an oversized bulk run splits into bounded chunks (target
+#     items via set_bulk_subwindow_items / CONFIG SET qos-bulk-subwindow-
+#     items), each its own self-contained fused dispatch through the lane,
+#     with a PREEMPTION POINT between chunks — a waiting interactive frame
+#     jumps the inter-sub-window boundary instead of the whole drained
+#     window (DeviceLane.preempt_point);
+#   * per-class streams: an interactive dispatch occupies the lane's
+#     INTERACTIVE stream (its own gate + staging slot + dispatch queue), so
+#     its kernel launches without queuing behind the bulk stream's
+#     occupancy gate at all.
+#
+# Disarm with RTPU_NO_PREEMPT=1 / set_preempt(False) / tpu-server
+# --no-preempt: the disarmed plane reproduces the exact single-stream,
+# unsplit-window PR 9 behavior, bit-identically (splitting moves only WHERE
+# the lane gate is released; per-op results are computed by the same
+# kernels either way).
+
+_preempt = os.environ.get("RTPU_NO_PREEMPT", "") not in ("1", "true", "yes")
+
+
+def preempt_enabled() -> bool:
+    return _preempt
+
+
+def set_preempt(on: bool) -> bool:
+    """Flip the process-global preemption switch; returns the previous
+    value (callers restore it — the A/B discipline of bench.py)."""
+    global _preempt
+    prev = _preempt
+    _preempt = bool(on)
+    return prev
+
+
+# target device items per bulk sub-window (0 = splitting off, the
+# historical whole-window dispatch).  CONFIG SET qos-bulk-subwindow-items
+# pushes here so every lane's dispatch path shares one knob.
+_bulk_subwindow_items = 0
+
+
+def bulk_subwindow_items() -> int:
+    return _bulk_subwindow_items
+
+
+def set_bulk_subwindow_items(n: int) -> int:
+    """Set the sub-window split target; returns the previous value."""
+    global _bulk_subwindow_items
+    prev = _bulk_subwindow_items
+    _bulk_subwindow_items = max(0, int(n))
+    return prev
+
+
+# which lane stream the CURRENT THREAD's dispatch occupies ("interactive"
+# while an interactive _LaneOccupancy is held): engine.staging_pool reads
+# this to hand the interactive fast path its own staging slot without
+# threading the QoS class through every pack call
+_stream_tls = threading.local()
+
+
+def current_stream() -> Optional[str]:
+    return getattr(_stream_tls, "stream", None)
+
+
 _staging_safe: Optional[bool] = None
 
 
@@ -770,9 +839,16 @@ class QosLedger:
     CLUSTER QOS / CLUSTER DEVICES wire views."""
 
     __slots__ = ("_lock", "frames", "ops", "nbytes", "waiting",
-                 "dispatched_ops", "dispatched_frames")
+                 "dispatched_ops", "dispatched_frames",
+                 "stream_inflight", "stream_dispatched")
 
     _CLASSES = ("interactive", "bulk")
+    # device streams (ISSUE 18): which lane stream served a dispatch —
+    # "interactive" only when the per-class fast path actually took it
+    # (preemption armed AND the frame was interactive-class), "bulk"
+    # otherwise, so disarmed runs book every dispatch on the bulk stream
+    # exactly as the pre-stream ledger did
+    _STREAMS = ("interactive", "bulk")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -782,6 +858,8 @@ class QosLedger:
         self.waiting = 0  # bulk frames parked at the admission gate
         self.dispatched_ops = {c: 0 for c in self._CLASSES}
         self.dispatched_frames = {c: 0 for c in self._CLASSES}
+        self.stream_inflight = {s: 0 for s in self._STREAMS}
+        self.stream_dispatched = {s: 0 for s in self._STREAMS}
 
     @classmethod
     def _cls(cls, qos_class: str) -> str:
@@ -811,6 +889,30 @@ class QosLedger:
         with self._lock:
             self.waiting -= 1
 
+    def stream_enter(self, stream: str, ops: int) -> None:
+        s = stream if stream in self._STREAMS else "bulk"
+        with self._lock:
+            self.stream_inflight[s] += ops
+            self.stream_dispatched[s] += ops
+
+    def stream_exit(self, stream: str, ops: int) -> None:
+        s = stream if stream in self._STREAMS else "bulk"
+        with self._lock:
+            self.stream_inflight[s] -= ops
+
+    def stream_rows(self) -> list:
+        """``[b"STREAM", name, in-flight ops, dispatched ops]`` per device
+        stream — appended to the CLUSTER QOS reply.  The leading b"STREAM"
+        tag keeps the rows distinct from the per-class rows (whose row[0]
+        is the class name) so pre-stream consumers' parsers — notably
+        OccupancyLoadBalancer._qos_infl_ops — skip them unchanged."""
+        with self._lock:
+            return [
+                [b"STREAM", s.encode(), self.stream_inflight[s],
+                 self.stream_dispatched[s]]
+                for s in self._STREAMS
+            ]
+
     def census(self, prefix: str = "qos") -> dict:
         """Drain-to-zero gauges only (cumulative counters are exposed on the
         wire views instead, so flat-census assertions stay meaningful)."""
@@ -820,6 +922,9 @@ class QosLedger:
                 out[f"{prefix}_{c}_inflight_frames"] = float(self.frames[c])
                 out[f"{prefix}_{c}_inflight_ops"] = float(self.ops[c])
                 out[f"{prefix}_{c}_inflight_bytes"] = float(self.nbytes[c])
+            for s in self._STREAMS:
+                out[f"{prefix}_stream_{s}_inflight"] = float(
+                    self.stream_inflight[s])
             return out
 
     def wire_row(self) -> list:
@@ -885,7 +990,19 @@ class DeviceLane:
         self.qos = QosLedger()
         self._laneset = laneset
         self._gate = threading.Lock()
+        # interactive device stream (ISSUE 18): its own gate + staging slot
+        # + dispatch queue, so an armed interactive dispatch launches
+        # without queuing behind the bulk stream's occupancy gate.  depth=1
+        # on both — interactive windows never park (FlushPipeline forces
+        # them at submit) and one staging slot matches one-at-a-time
+        # latency-bound traffic.
+        self._igate = threading.Lock()
+        self.ipool = StagingPool(depth=1)
+        self.ipipeline = FlushPipeline(depth=1)
+        self._icond = threading.Condition(threading.Lock())
+        self._iwaiting = 0  # interactive dispatches queued or in flight
         self.dispatches = 0
+        self.preemptions = 0  # preempt points that actually yielded
 
     def occupy(self, n_items: int = 0, qos_class: Optional[str] = None,
                nbytes: int = 0):
@@ -893,12 +1010,63 @@ class DeviceLane:
         the lane gate (per-device serialization) and, under the CPU-replica
         knob, the modeled per-chip compute time for `n_items` ops.  With
         `qos_class` given (the scheduler armed), the dispatch is accounted
-        on the lane's per-class QoS ledger for its whole residency."""
+        on the lane's per-class QoS ledger for its whole residency.  With
+        preemption armed an interactive-class dispatch occupies the lane's
+        INTERACTIVE stream (_igate) instead of the bulk gate."""
         return _LaneOccupancy(self, n_items, qos_class, nbytes)
+
+    def submit(self, fn, interactive: bool = False):
+        """Route one flush window to the serving stream's pipeline: armed
+        interactive windows go through the interactive dispatch queue (so a
+        parked bulk ring never delays forcing them), everything else —
+        and everything when disarmed — through the bulk pipeline."""
+        if interactive and _preempt:
+            return self.ipipeline.submit(fn, interactive=True)
+        return self.pipeline.submit(fn, interactive=interactive)
+
+    def interactive_waiting(self) -> int:
+        with self._icond:
+            return self._iwaiting
+
+    def _ienter(self) -> None:
+        with self._icond:
+            self._iwaiting += 1
+
+    def _iexit(self) -> None:
+        with self._icond:
+            self._iwaiting -= 1
+            if self._iwaiting <= 0:
+                self._icond.notify_all()
+
+    def preempt_point(self, timeout: float = 0.05) -> bool:
+        """The inter-sub-window preemption point: with preemption armed and
+        interactive dispatches queued or in flight on this lane, yield the
+        (released) device for up to `timeout` seconds so their kernels
+        launch before the next bulk sub-window re-occupies the stream.
+        Called BETWEEN chunk dispatches — the caller holds no lane gate and
+        no record locks here, and the wait is bounded, so the point can
+        never deadlock the bulk stream against a stuck client.  Returns
+        True when it actually yielded."""
+        if not _preempt:
+            return False
+        yielded = False
+        with self._icond:
+            if self._iwaiting > 0:
+                deadline = time.monotonic() + timeout
+                while self._iwaiting > 0:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._icond.wait(left)
+                yielded = True
+        if yielded:
+            self.preemptions += 1
+        return yielded
 
 
 class _LaneOccupancy:
-    __slots__ = ("_lane", "_n", "_cls", "_nbytes", "_tcur", "_tmark")
+    __slots__ = ("_lane", "_n", "_cls", "_nbytes", "_tcur", "_tmark",
+                 "_stream", "_gate", "_prev_stream")
 
     def __init__(self, lane: DeviceLane, n_items: int,
                  qos_class: Optional[str] = None, nbytes: int = 0):
@@ -908,25 +1076,43 @@ class _LaneOccupancy:
         self._nbytes = nbytes
         self._tcur = None  # active FrameTrace (tracing armed only)
         self._tmark = 0.0
+        # stream selection (ISSUE 18): interactive dispatches take the
+        # lane's interactive stream only with preemption armed — disarmed,
+        # everything serializes through the one bulk gate, the exact
+        # pre-stream behavior
+        if qos_class == "interactive" and _preempt:
+            self._stream = "interactive"
+            self._gate = lane._igate
+        else:
+            self._stream = "bulk"
+            self._gate = lane._gate
+        self._prev_stream = None
 
     def __enter__(self):
         if self._cls is not None:
             self._lane.qos.enter(self._cls, self._n, self._nbytes)
+        self._lane.qos.stream_enter(self._stream, self._n)
+        if self._stream == "interactive":
+            # visible to preempt_point from the moment the dispatch queues
+            # on the interactive gate, not just once it holds it
+            self._lane._ienter()
         if _obs._tracer is not None:
             self._tcur = _obs.current_trace()
         if self._tcur is not None:
             # `stage` = time queued behind the lane gate (ahead of the
             # chip); the occupancy hold itself becomes the `dispatch` span
             t0 = time.monotonic()
-            self._lane._gate.acquire()
+            self._gate.acquire()
             self._tmark = time.monotonic()
             self._tcur.add_span(
                 "stage", t0, self._tmark,
                 device=self._lane.dev_id, items=self._n,
-                nbytes=self._nbytes,
+                nbytes=self._nbytes, stream=self._stream,
             )
         else:
-            self._lane._gate.acquire()
+            self._gate.acquire()
+        self._prev_stream = getattr(_stream_tls, "stream", None)
+        _stream_tls.stream = self._stream
         self._lane._laneset._enter()
         self._lane.dispatches += 1
         return self._lane
@@ -941,10 +1127,14 @@ class _LaneOccupancy:
                 self._tcur.add_span(
                     "dispatch", self._tmark, time.monotonic(),
                     device=self._lane.dev_id, items=self._n,
-                    nbytes=self._nbytes,
+                    nbytes=self._nbytes, stream=self._stream,
                 )
             self._lane._laneset._exit()
-            self._lane._gate.release()
+            _stream_tls.stream = self._prev_stream
+            self._gate.release()
+            if self._stream == "interactive":
+                self._lane._iexit()
+            self._lane.qos.stream_exit(self._stream, self._n)
             if self._cls is not None:
                 self._lane.qos.exit(self._cls, self._n, self._nbytes)
         return False
@@ -1003,6 +1193,8 @@ class LaneSet:
         out = {"lanes": len(self._lanes), "active_dispatches": self.active()}
         for dev_id, lane in sorted(self._lanes.items()):
             out[f"lane{dev_id}_staging_slots"] = lane.pool.slot_count()
+            out[f"lane{dev_id}_istaging_slots"] = lane.ipool.slot_count()
+            out[f"lane{dev_id}_iwaiting"] = lane.interactive_waiting()
             # per-lane QoS in-flight (ISSUE 10): must drain to 0 at quiesce
             for k, v in lane.qos.census(prefix=f"lane{dev_id}_qos").items():
                 out[k] = v
@@ -1011,4 +1203,6 @@ class LaneSet:
     def clear(self) -> None:
         for lane in self._lanes.values():
             lane.pool.clear()
+            lane.ipool.clear()
             lane.pipeline.drain()
+            lane.ipipeline.drain()

@@ -257,7 +257,8 @@ class WindowScheduler:
                  tenant_burst: Optional[float] = None,
                  interactive_max_items: int = 256,
                  interactive_deadline_ms: float = 0.0,
-                 bulk_slots: int = 0):
+                 bulk_slots: int = 0,
+                 bulk_subwindow_items: int = 0):
         self.enabled = qos_enabled() if enabled is None else bool(enabled)
         self.tenant_rate = float(tenant_rate)
         self.tenant_burst = tenant_burst  # None = follow rate
@@ -272,6 +273,14 @@ class WindowScheduler:
         # count at wiring time: workers - 1, so one dispatch slot is always
         # reserved for interactive traffic)
         self.bulk_slots = int(bulk_slots)
+        # preemptible sub-windows (ISSUE 18): target device items per bulk
+        # sub-window — an oversized fused run splits into chunks of at most
+        # this many items, with a lane preemption point between chunks
+        # (0 = splitting off, the historical whole-window dispatch).  The
+        # server pushes the value into the process-global
+        # ioplane.set_bulk_subwindow_items so every lane's dispatch path
+        # shares it.
+        self.bulk_subwindow_items = int(bulk_subwindow_items)
         # penalty for a FULLY-refused frame: the offending connection's read
         # loop parks this long after its -BUSY replies flush, so a client
         # that spins on BUSY instead of backing off cannot convert the cheap
@@ -303,6 +312,7 @@ class WindowScheduler:
             "qos-interactive-max-items": self.interactive_max_items,
             "qos-interactive-deadline-ms": self.interactive_deadline_ms,
             "qos-bulk-slots": self.bulk_slots,
+            "qos-bulk-subwindow-items": self.bulk_subwindow_items,
             "qos-shed-penalty-ms": self.shed_penalty_ms,
         }
 
@@ -327,6 +337,9 @@ class WindowScheduler:
         if key == "qos-bulk-slots":
             self.bulk_slots = int(value)
             return True
+        if key == "qos-bulk-subwindow-items":
+            self.bulk_subwindow_items = max(0, int(value))
+            return True
         if key == "qos-shed-penalty-ms":
             self.shed_penalty_ms = float(value)
             return True
@@ -341,16 +354,23 @@ class WindowScheduler:
 
     def set_tenant_rate(self, tenant: str, rate: float,
                         burst: Optional[float] = None) -> None:
-        """Per-tenant budget override (admin/test hook; the uniform
-        ``qos-tenant-rate`` knob covers the common case)."""
+        """Per-tenant budget override (the ``CLUSTER QOS REBALANCE``
+        actuator and the test hook; the uniform ``qos-tenant-rate`` knob
+        covers the common case).  An EXISTING bucket is retargeted in
+        place — tokens are preserved (capped at the new burst), never
+        re-minted: the fleet rebalance loop pushes every sweep, and a
+        re-mint would hand the tenant a fresh burst per push, inflating
+        its effective budget by burst/interval."""
         with self._lock:
             ts = self._tenants.get(tenant)
             if ts is None:
-                ts = self._tenants[tenant] = TenantState(
-                    TokenBucket(rate, burst)
-                )
-            else:
-                ts.bucket = TokenBucket(rate, burst)
+                self._tenants[tenant] = TenantState(TokenBucket(rate, burst))
+                return
+            b = ts.bucket
+            b.rate = float(rate)
+            b.burst = float(burst if burst is not None else max(rate, 1.0))
+            if b.tokens > b.burst:
+                b.tokens = b.burst
 
     # -- classification -------------------------------------------------------
 

@@ -39,7 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from redisson_tpu.client import routing as _routing
 from redisson_tpu.core import ioplane
-from redisson_tpu.core.coalesce import runs_within_admission
+from redisson_tpu.core.coalesce import plan_subwindows, runs_within_admission
 from redisson_tpu.core.engine import Engine
 from redisson_tpu.net import resp
 from redisson_tpu.net.resp import ProtocolError, RespError
@@ -580,6 +580,12 @@ class TpuServer:
                 if self.engine.lanes is not None:
                     for lane in self.engine.lanes.lanes():
                         lane.pipeline.deadline_s = s if s > 0 else None
+            if ok and key == "qos-bulk-subwindow-items":
+                # push the sub-window split target into the process-global
+                # knob the lane dispatch paths read (ISSUE 18)
+                ioplane.set_bulk_subwindow_items(
+                    self.scheduler.bulk_subwindow_items
+                )
             return ok
         return False
 
@@ -1175,6 +1181,17 @@ class TpuServer:
         occupancy against the owning lane: dispatches from CONCURRENT
         connections bound for different devices overlap, same-device ones
         serialize, exactly like N per-chip streams."""
+        lane = self._lane_for(cmds)
+        if lane is None:
+            return None
+        return lane.occupy(
+            self._estimate_device_items(cmds), qos_class=qos_class,
+            nbytes=_sched._frame_nbytes(cmds) if qos_class is not None else 0,
+        )
+
+    def _lane_for(self, cmds):
+        """The one device lane every key of `cmds` maps to, else None
+        (laneless or mixed-device: no occupancy gate)."""
         eng = self.engine
         if eng.placement is None or eng.lanes is None:
             return None
@@ -1186,11 +1203,15 @@ class TpuServer:
             dev = d
         if dev is None:
             return None
-        lane = eng.lanes.lane(eng.placement.devices[dev])
-        return lane.occupy(
-            self._estimate_device_items(cmds), qos_class=qos_class,
-            nbytes=_sched._frame_nbytes(cmds) if qos_class is not None else 0,
-        )
+        return eng.lanes.lane(eng.placement.devices[dev])
+
+    def _subwindow_target(self, qos_class: Optional[str]) -> int:
+        """Effective bulk sub-window item target for one dispatch: >0 only
+        with preemption armed, splitting configured, and a non-interactive
+        dispatch (interactive frames ride the fast path whole)."""
+        if qos_class == "interactive" or not ioplane.preempt_enabled():
+            return 0
+        return ioplane.bulk_subwindow_items()
 
     def _dispatch_laned(self, ctx, cmd, qos_class: Optional[str] = None,
                         trace=None):
@@ -1221,12 +1242,25 @@ class TpuServer:
                                   trace=None):
         """Sequential-path coalesced run with lane accounting (a run whose
         filters span devices gets no gate — the coalescer itself falls back
-        to per-record dispatch on a mixed-device group)."""
+        to per-record dispatch on a mixed-device group).
+
+        Preemptible sub-windows (ISSUE 18): an oversized bulk run splits at
+        command boundaries into chunks of at most qos-bulk-subwindow-items
+        estimated device items, each chunk a SELF-CONTAINED fused dispatch
+        — its own lane occupancy, its own record locks — with
+        ``lane.preempt_point()`` between chunks so a waiting interactive
+        frame jumps the inter-sub-window boundary instead of the drained
+        window.  At-most-once survives splitting because a chunk is a
+        complete fused add run: a failed chunk replies per-command errors
+        and is never re-dispatched, while earlier chunks already applied
+        and replied (the ``runs_within_admission`` sub-run shape).  Chunk
+        replies extend in frame order, so per-connection FIFO and reply
+        bytes are identical to the unsplit dispatch."""
         if trace is not None:
             _obs.set_current(trace)
         try:
-            gate = self._occupancy_gate(cmds, qos_class)
-            if gate is None:
+            lane = self._lane_for(cmds)
+            if lane is None:
                 if trace is not None:
                     t0 = time.monotonic()
                     try:
@@ -1234,8 +1268,32 @@ class TpuServer:
                     finally:
                         trace.add_span("dispatch", t0, time.monotonic())
                 return self._dispatch_bloom_run(ctx, cmds)
-            with gate:
-                return self._dispatch_bloom_run(ctx, cmds)
+            target = self._subwindow_target(qos_class)
+            chunks = None
+            if target > 0:
+                per = [_sched.estimate_command_items(c) for c in cmds]
+                plan = plan_subwindows(per, target)
+                if len(plan) > 1:
+                    chunks = plan
+            nb = _sched._frame_nbytes(cmds) if qos_class is not None else 0
+            if chunks is None:
+                with lane.occupy(self._estimate_device_items(cmds),
+                                 qos_class=qos_class, nbytes=nb):
+                    return self._dispatch_bloom_run(ctx, cmds)
+            out = []
+            for k, (s, e) in enumerate(chunks):
+                if k:
+                    lane.preempt_point()
+                sub = cmds[s:e]
+                with lane.occupy(
+                    self._estimate_device_items(sub), qos_class=qos_class,
+                    nbytes=(
+                        _sched._frame_nbytes(sub)
+                        if qos_class is not None else 0
+                    ),
+                ):
+                    out.extend(self._dispatch_bloom_run(ctx, sub))
+            return out
         finally:
             if trace is not None:
                 _obs.clear_current()
@@ -1318,6 +1376,56 @@ class TpuServer:
         )
         from contextlib import nullcontext
 
+        def dispatch_span(lo: int, hi: int) -> None:
+            ci = lo
+            while ci < hi:
+                run_end = run_at.get(ci)
+                if run_end is not None:
+                    replies = self._dispatch_bloom_run(ctx, cmds[ci:run_end])
+                    for off, r in enumerate(replies):
+                        out.append((items[ci + off][0], r))
+                    ci = run_end
+                    continue
+                out.append((items[ci][0], self._dispatch_one_sync(ctx, cmds[ci])))
+                ci += 1
+
+        # preemptible sub-windows (ISSUE 18): an oversized bucket splits its
+        # ONE bucket-wide occupancy into per-segment gates with a lane
+        # preemption point between segments.  Segments cut at dispatch-unit
+        # boundaries — one coalesced run or one single command — so a fused
+        # add run is never split mid-apply (at-most-once).
+        target = self._subwindow_target(qos_class) if lane is not None else 0
+        segs = None
+        if target > 0:
+            units: List[Tuple[int, int]] = []
+            ci = 0
+            while ci < len(cmds):
+                run_end = run_at.get(ci)
+                units.append((ci, run_end) if run_end is not None
+                             else (ci, ci + 1))
+                ci = units[-1][1]
+            unit_items = [
+                self._estimate_device_items(cmds[s:e]) for s, e in units
+            ]
+            plan = plan_subwindows(unit_items, target)
+            if len(plan) > 1:
+                segs = [(units[lo][0], units[hi - 1][1]) for lo, hi in plan]
+        if segs is not None:
+            for k, (s, e) in enumerate(segs):
+                if k:
+                    lane.preempt_point()
+                seg_cmds = cmds[s:e]
+                with lane.occupy(
+                    self._estimate_device_items(seg_cmds),
+                    qos_class=qos_class,
+                    nbytes=(
+                        _sched._frame_nbytes(seg_cmds)
+                        if qos_class is not None else 0
+                    ),
+                ):
+                    dispatch_span(s, e)
+            return out
+
         gate = (
             lane.occupy(
                 self._estimate_device_items(cmds), qos_class=qos_class,
@@ -1328,17 +1436,7 @@ class TpuServer:
             if lane is not None else nullcontext()
         )
         with gate:
-            ci = 0
-            while ci < len(cmds):
-                run_end = run_at.get(ci)
-                if run_end is not None:
-                    replies = self._dispatch_bloom_run(ctx, cmds[ci:run_end])
-                    for off, r in enumerate(replies):
-                        out.append((items[ci + off][0], r))
-                    ci = run_end
-                    continue
-                out.append((items[ci][0], self._dispatch_one_sync(ctx, cmds[ci])))
-                ci += 1
+            dispatch_span(0, len(cmds))
         return out
 
     async def _run_frame_sharded(self, ctx, commands, plan, loop, adm=None,
@@ -2215,6 +2313,14 @@ def main(argv=None):
              "reference path for A/B measurement (RTPU_NO_QOS=1 equivalent)",
     )
     ap.add_argument(
+        "--no-preempt", action="store_true",
+        help="disable the bulk-window preemption plane (ISSUE 18): no "
+             "sub-window splitting, no per-class device streams — every "
+             "dispatch serializes through the single per-lane gate exactly "
+             "as PR 9 shipped, the reference path for A/B measurement "
+             "(RTPU_NO_PREEMPT=1 equivalent)",
+    )
+    ap.add_argument(
         "--dispatch-ahead", type=int, default=None,
         help="per-connection dispatch-ahead bound: how many frames may sit "
              "between 'dispatched' and 'replies written' on one connection "
@@ -2274,14 +2380,16 @@ def main(argv=None):
         import os
 
         os.environ.setdefault("JAX_PLATFORMS", args.platform)
+    from redisson_tpu.core import ioplane as _iop
+
     if args.no_overlap:
         # flip the process-global switch too: the embedded Batch/pack paths
         # of THIS process must match the server's serial reply path
-        from redisson_tpu.core import ioplane
-
-        ioplane.set_overlap(False)
+        _iop.set_overlap(False)
     if args.no_qos:
         _sched.set_qos(False)
+    if args.no_preempt:
+        _iop.set_preempt(False)
     if args.retry_profile:
         import os as _os
 

@@ -213,12 +213,37 @@ def cmd_cluster(server, ctx, args):
             out.append(row)
         return out
     if sub == b"QOS":
+        # CLUSTER QOS REBALANCE <tenant> <rate> [<burst>] (ISSUE 18): the
+        # fleet budget actuator — a supervisor control loop pushes each
+        # node's share of a tenant's GLOBAL rate here (cluster/qos_control
+        # re-splits it proportional to observed per-node demand).  Applies
+        # the override via the scheduler's per-tenant hook; a control-plane
+        # push, not consensus.
+        if len(args) > 1 and bytes(args[1]).upper() == b"REBALANCE":
+            if len(args) < 4:
+                raise RespError(
+                    "ERR CLUSTER QOS REBALANCE <tenant> <rate> [<burst>]"
+                )
+            tenant = _s(args[2])
+            try:
+                rate = float(args[3])
+                burst = float(args[4]) if len(args) > 4 else None
+            except ValueError:
+                raise RespError("ERR value is not a valid float") from None
+            server.scheduler.set_tenant_rate(tenant, rate, burst)
+            return b"OK"
         # global window-scheduler state (ISSUE 10): armed flag, shed
-        # totals, per-class in-flight, and the per-tenant bucket table.
+        # totals, per-class in-flight, the per-device-stream rows
+        # (ISSUE 18), and the per-tenant bucket table.
         # Reply: [armed, shed_ops, shed_frames,
         #         [class, infl_frames, infl_ops, infl_bytes]...,
+        #         [b"STREAM", name, infl_ops, dispatched_ops]...,
         #         [b"TENANT", name, bucket_level, admitted, shed_ops,
         #          shed_frames]...]
+        # STREAM rows aggregate over the engine's device lanes; their
+        # b"STREAM" tag keeps row[0] distinct from the class rows so
+        # pre-stream parsers (OccupancyLoadBalancer._qos_infl_ops) skip
+        # them unchanged.
         sched = server.scheduler
         led = sched.ledger
         out = [1 if sched.armed else 0, sched.shed_ops, sched.shed_frames]
@@ -226,6 +251,17 @@ def cmd_cluster(server, ctx, args):
             out.append([
                 cls.encode(), led.frames[cls], led.ops[cls], led.nbytes[cls],
             ])
+        lanes = server.engine.lanes
+        if lanes is not None:
+            agg = {}
+            for lane in lanes.lanes():
+                for tag, name, infl, disp in lane.qos.stream_rows():
+                    cur = agg.setdefault(name, [0, 0])
+                    cur[0] += infl
+                    cur[1] += disp
+            for name in (b"interactive", b"bulk"):
+                if name in agg:
+                    out.append([b"STREAM", name] + agg[name])
         for name, level, admitted, shed_ops, shed_frames in sched.tenant_table():
             out.append([
                 b"TENANT", name.encode(), int(level), admitted,

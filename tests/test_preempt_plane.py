@@ -1,0 +1,725 @@
+"""Preemptible bulk windows + per-class device streams + fleet QoS
+(ISSUE 18).
+
+Contracts pinned here:
+  * ``plan_subwindows`` splits ONLY at command boundaries (an oversized
+    single command keeps its own chunk — at-most-once must survive);
+  * an interactive-class dispatch rides the lane's INTERACTIVE stream
+    (own gate, own ledger row) with preemption armed, and the historical
+    single bulk gate when disarmed (``RTPU_NO_PREEMPT`` discipline);
+  * ``preempt_point`` yields the device to a queued/in-flight interactive
+    dispatch BETWEEN sub-windows — an interactive frame arriving mid-bulk
+    window dispatches before the next sub-window, never after the drained
+    window;
+  * splitting is reply-invariant: wire bytes with preemption disarmed are
+    bit-identical to the armed run, coalesced fused-add runs included, at
+    3 frames in flight;
+  * kill-mid-sub-window: crossing a preemption point and then dying never
+    leaves a partially-applied fused-add chunk and never loses an acked
+    write;
+  * ``CLUSTER QOS`` grows per-stream rows + the REBALANCE actuator, and
+    the fleet control loop (cluster/qos_control) re-splits a tenant's
+    global budget proportional to observed per-node demand;
+  * the read-only legs of execute_many fan-outs ride the replica plane
+    with the staleness probe intact, and replica-read profiles derive a
+    default ``max_staleness_offset`` from the shipper cadence.
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.core import coalesce, ioplane
+from redisson_tpu.core.coalesce import plan_subwindows
+
+
+@pytest.fixture(autouse=True)
+def _restore_preempt_globals():
+    """Every test leaves the process-global preemption knobs as found."""
+    prev_p = ioplane.preempt_enabled()
+    prev_w = ioplane.bulk_subwindow_items()
+    prev_ns = ioplane.set_replica_occupancy(None)
+    ioplane.set_replica_occupancy(prev_ns)
+    yield
+    ioplane.set_preempt(prev_p)
+    ioplane.set_bulk_subwindow_items(prev_w)
+    ioplane.set_replica_occupancy(prev_ns)
+
+
+# -- unit: the sub-window planner ---------------------------------------------
+
+
+def test_plan_subwindows_shapes():
+    assert plan_subwindows([], 8) == []
+    # under target: one window, untouched
+    assert plan_subwindows([3, 4], 8) == [(0, 2)]
+    # disarmed (target 0): never splits
+    assert plan_subwindows([100, 100], 0) == [(0, 2)]
+    # even split at command boundaries
+    assert plan_subwindows([5, 5, 5, 5], 8) == [(0, 1), (1, 2), (2, 3), (3, 4)]
+    # an oversized SINGLE command keeps its own chunk (a fused add run is
+    # at-most-once per command — the planner must never split inside one)
+    assert plan_subwindows([20], 8) == [(0, 1)]
+    assert plan_subwindows([3, 9, 3, 3], 8) == [(0, 1), (1, 2), (2, 4)]
+    # every item lands in exactly one chunk, in order
+    for items, tgt in ([7, 1, 9, 2, 2, 30, 1], 10), ([1] * 17, 4):
+        plan = plan_subwindows(items, tgt)
+        assert plan[0][0] == 0 and plan[-1][1] == len(items)
+        for (a, b), (c, d) in zip(plan, plan[1:]):
+            assert b == c and a < b
+        # no chunk exceeds the target unless it is a single command
+        for a, b in plan:
+            assert sum(items[a:b]) <= tgt or b - a == 1
+
+
+# -- lane streams: ledger, gate selection, preemption point -------------------
+
+
+def test_lane_stream_ledger_and_gate_selection(devices):
+    laneset = ioplane.LaneSet(devices[:1])
+    lane = laneset.lane(devices[0])
+    assert ioplane.current_stream() is None
+    with lane.occupy(5, qos_class="interactive"):
+        assert ioplane.current_stream() == "interactive"
+        rows = {bytes(r[1]): (r[2], r[3]) for r in lane.qos.stream_rows()}
+        assert rows[b"interactive"] == (5, 5)
+        assert rows[b"bulk"] == (0, 0)
+        c = laneset.census()
+        assert c["lane0_qos_stream_interactive_inflight"] == 5
+        # the BULK gate stays free while an interactive dispatch occupies
+        # its own stream: a bulk peer launches without queueing behind it
+        assert lane._gate.acquire(timeout=1.0)
+        lane._gate.release()
+    assert ioplane.current_stream() is None
+    c = laneset.census()
+    assert c["lane0_qos_stream_interactive_inflight"] == 0
+    with lane.occupy(3, qos_class="bulk"):
+        rows = {bytes(r[1]): (r[2], r[3]) for r in lane.qos.stream_rows()}
+        assert rows[b"bulk"] == (3, 3)
+        assert ioplane.current_stream() == "bulk"
+    # disarmed: interactive dispatches ride the single bulk gate — the
+    # exact pre-stream serialization
+    ioplane.set_preempt(False)
+    with lane.occupy(2, qos_class="interactive"):
+        rows = {bytes(r[1]): (r[2], r[3]) for r in lane.qos.stream_rows()}
+        assert rows[b"interactive"][0] == 0
+        assert rows[b"bulk"][0] == 2
+        assert not lane._gate.acquire(False)  # bulk gate IS held
+    assert not lane.preempt_point(timeout=0.01)
+
+
+def test_interactive_frame_jumps_subwindow_boundary(devices):
+    """An interactive dispatch arriving mid-bulk-window launches before the
+    NEXT sub-window: the bulk loop's preempt_point blocks until the
+    in-flight interactive dispatch drains."""
+    laneset = ioplane.LaneSet(devices[:2])
+    lane = laneset.lane(devices[1])
+    order, lock = [], threading.Lock()
+    chunk0_in, int_in = threading.Event(), threading.Event()
+    yields = []
+    errors = []
+
+    def bulk():
+        try:
+            for k in range(2):
+                if k:
+                    yields.append(lane.preempt_point(timeout=10.0))
+                with lane.occupy(100, qos_class="bulk"):
+                    with lock:
+                        order.append(f"chunk{k}")
+                    if k == 0:
+                        chunk0_in.set()
+                        assert int_in.wait(10.0)
+        except Exception as e:  # noqa: BLE001 — surfaced on main thread
+            errors.append(repr(e))
+
+    def interactive():
+        try:
+            assert chunk0_in.wait(10.0)
+            with lane.occupy(1, qos_class="interactive"):
+                int_in.set()
+                time.sleep(0.05)
+                with lock:
+                    order.append("interactive")
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=bulk),
+               threading.Thread(target=interactive)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert order == ["chunk0", "interactive", "chunk1"]
+    assert yields == [True]
+    assert lane.preemptions == 1
+    assert lane.interactive_waiting() == 0
+    # no waiter -> the point is free (no yield, no sleep)
+    t0 = time.monotonic()
+    assert not lane.preempt_point(timeout=5.0)
+    assert time.monotonic() - t0 < 1.0
+    # a stuck interactive peer can only cost the bounded timeout
+    lane._ienter()
+    try:
+        t0 = time.monotonic()
+        assert lane.preempt_point(timeout=0.05)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        lane._iexit()
+
+
+def test_rtpu_no_preempt_env_disarms_subprocess():
+    import json
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import json\n"
+        "from redisson_tpu.core import ioplane\n"
+        "print(json.dumps({'armed': ioplane.preempt_enabled()}))\n"
+    )
+    env = dict(os.environ, RTPU_NO_PREEMPT="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout.strip().splitlines()[-1]) == {"armed": False}
+
+
+# -- wire: knob, splitting, bit-identity, kill-mid-sub-window -----------------
+
+
+def _conn(st, **kw):
+    from redisson_tpu.net.client import Connection
+
+    return Connection(st.server.host, st.server.port, timeout=60.0, **kw)
+
+
+@pytest.fixture()
+def laned_server():
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0, devices="all", workers=4) as st:
+        yield st
+
+
+def test_subwindow_config_knob(laned_server):
+    st = laned_server
+    c = _conn(st)
+    try:
+        got = dict(zip(*[iter(
+            c.execute("CONFIG", "GET", "qos-bulk-subwindow-items"))] * 2))
+        assert got[b"qos-bulk-subwindow-items"] == b"0"
+        assert c.execute(
+            "CONFIG", "SET", "qos-bulk-subwindow-items", "4096") == b"OK"
+        assert st.server.scheduler.bulk_subwindow_items == 4096
+        # the set pushes the process-global the dispatch path reads
+        assert ioplane.bulk_subwindow_items() == 4096
+        assert c.execute(
+            "CONFIG", "SET", "qos-bulk-subwindow-items", "0") == b"OK"
+        assert ioplane.bulk_subwindow_items() == 0
+    finally:
+        c.close()
+
+
+def _lane_dispatches(st) -> int:
+    lanes = st.server.engine.lanes
+    return sum(lane.dispatches for lane in lanes.lanes())
+
+
+def test_bulk_run_splits_into_subwindows_on_the_wire(laned_server):
+    """A coalesced fused-add run over the sub-window target dispatches as
+    MULTIPLE lane occupancies (one per chunk), with replies identical to
+    the unsplit run: every key applied exactly once."""
+    st = laned_server
+    c = _conn(st)
+    try:
+        assert c.execute("CLIENT", "QOS", "CLASS", "bulk") == b"OK"
+        assert c.execute(
+            "CONFIG", "SET", "qos-bulk-subwindow-items", "256") == b"OK"
+        # same hashtag -> same slot -> same device lane for the whole run
+        names = [f"pw{{h1}}:{i}" for i in range(4)]
+        for n in names:
+            c.execute("BF.RESERVE", n, 0.01, 10_000)
+        blobs = {
+            n: (np.arange(200, dtype=np.int64)
+                + 1_000_000 * i).tobytes()
+            for i, n in enumerate(names)
+        }
+        before = _lane_dispatches(st)
+        out = c.execute_many([("BF.MADD64", n, blobs[n]) for n in names])
+        # 4 commands x 200 items vs a 256-item target -> 4 chunks
+        assert _lane_dispatches(st) - before >= 3
+        for r in out:
+            assert np.frombuffer(r, np.uint8).all()  # all newly added, once
+        for n in names:
+            got = c.execute("BF.MEXISTS64", n, blobs[n])
+            assert np.frombuffer(got, np.uint8).all()
+    finally:
+        c.close()
+
+
+def _preempt_wire_replies(armed: bool):
+    """The disarm A/B driver: mixed read/write frames INCLUDING coalesced
+    fused-add runs, 3 frames in flight on one connection, sub-window
+    splitting configured — replies must be bit-identical armed vs
+    disarmed."""
+    from redisson_tpu.server.server import ServerThread
+
+    prev = ioplane.set_preempt(armed)
+    try:
+        with ServerThread(port=0, devices="all", workers=4) as st:
+            conn = _conn(st)
+            try:
+                assert conn.execute(
+                    "CONFIG", "SET", "qos-bulk-subwindow-items", "128"
+                ) == b"OK"
+                rng = np.random.default_rng(18)
+                names = [f"ab{{g}}:{i}" for i in range(3)]
+                for n in names:
+                    conn.execute("BF.RESERVE", n, 0.01, 50_000)
+                frames = []
+                for f in range(8):
+                    blobs = [
+                        np.ascontiguousarray(
+                            rng.integers(0, 1 << 60, 96), "<i8"
+                        ).tobytes()
+                        for _ in names
+                    ]
+                    frames.append(
+                        # a same-verb run (coalescible, > the 128-item
+                        # target) + interleaved interactive-shaped reads
+                        [("BF.MADD64", n, b) for n, b in zip(names, blobs)]
+                        + [("ECHO", f"f{f}".encode())]
+                        + [("BF.MEXISTS64", n, b)
+                           for n, b in zip(names, blobs)]
+                        + [("GET", "missing"), ("PING",)]
+                    )
+                out = []
+                inflight = []
+                for fr in frames:
+                    inflight.append(conn.execute_many_lazy(fr))
+                    if len(inflight) > 3:  # 3 frames in flight
+                        out.extend(inflight.pop(0).get(timeout=60.0))
+                for h in inflight:
+                    out.extend(h.get(timeout=60.0))
+                return out
+            finally:
+                conn.close()
+    finally:
+        ioplane.set_preempt(prev)
+        ioplane.set_bulk_subwindow_items(0)
+
+
+def test_wire_bit_identical_with_preemption_disarmed():
+    a = _preempt_wire_replies(armed=True)
+    b = _preempt_wire_replies(armed=False)
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert x == y, f"reply {i} diverged between preempt armed/disarmed"
+
+
+def test_kill_mid_subwindow_no_partial_run_no_acked_loss(laned_server):
+    """Connection death after crossing a preemption point: the acked frame
+    stays applied, and every command of the dying bulk run is applied
+    EITHER completely or not at all (a chunk is a self-contained fused
+    dispatch — never re-dispatched, never partially applied)."""
+    from redisson_tpu.net import resp
+
+    st = laned_server
+    admin = _conn(st)
+    try:
+        assert admin.execute(
+            "CONFIG", "SET", "qos-bulk-subwindow-items", "512") == b"OK"
+        names = [f"kl{{z}}:{i}" for i in range(8)]
+        for n in names:
+            admin.execute("BF.RESERVE", n, 0.01, 50_000)
+        acked_blob = (np.arange(64, dtype=np.int64) * 97).tobytes()
+        blobs = {
+            n: (np.arange(512, dtype=np.int64)
+                + 10_000_000 * i).tobytes()
+            for i, n in enumerate(names)
+        }
+        # slow the modeled chip so the 8-chunk window is mid-flight when
+        # the socket dies (~10ms per 512-item chunk)
+        ioplane.set_replica_occupancy(20_000.0)
+        before = _lane_dispatches(st)
+        s = socket.create_connection(
+            (st.server.host, st.server.port), timeout=30)
+        parser = resp.RespParser(use_native=False)
+        try:
+            # frame 1 (small, acked) + frame 2 (the 8-chunk bulk run),
+            # pipelined back to back on one connection
+            f1 = resp.encode_command_python("BF.MADD64", "kl{z}:acked",
+                                            acked_blob)
+            f2 = b"".join(
+                resp.encode_command_python("BF.MADD64", n, blobs[n])
+                for n in names
+            )
+            admin.execute("BF.RESERVE", "kl{z}:acked", 0.01, 10_000)
+            s.sendall(f1 + f2)
+            acked = []
+            while not acked:
+                data = s.recv(1 << 16)
+                assert data, "server closed before the ack"
+                acked = parser.feed(data)
+            assert np.frombuffer(acked[0], np.uint8).all()
+        finally:
+            # die abruptly mid-window
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            s.close()
+        ioplane.set_replica_occupancy(None)
+        # quiesce: wait for the lane ledgers to drain
+        deadline = time.monotonic() + 30.0
+        lanes = st.server.engine.lanes
+        while time.monotonic() < deadline:
+            census = lanes.census()
+            if all(v == 0 for k, v in census.items()
+                   if "_inflight" in k or k.endswith("_active")):
+                break
+            time.sleep(0.05)
+        # the preemption-point plan was crossed (multiple chunk dispatches)
+        assert _lane_dispatches(st) - before >= 2
+        # zero acked loss: the replied frame's keys are present
+        got = admin.execute("BF.MEXISTS64", "kl{z}:acked", acked_blob)
+        assert np.frombuffer(got, np.uint8).all(), "acked write lost"
+        # at-most-once per chunk: every command all-present or all-absent
+        for n in names:
+            got = np.frombuffer(
+                admin.execute("BF.MEXISTS64", n, blobs[n]), np.uint8)
+            assert got.all() or not got.any(), (
+                f"partially applied fused-add chunk on {n}"
+            )
+    finally:
+        admin.close()
+
+
+# -- CLUSTER QOS: stream rows + the REBALANCE actuator ------------------------
+
+
+def test_cluster_qos_stream_rows_and_rebalance(laned_server):
+    from redisson_tpu.net.resp import RespError
+
+    st = laned_server
+    c = _conn(st)
+    try:
+        c.execute("BF.RESERVE", "sr{q}", 0.01, 10_000)
+        c.execute("BF.MADD64", "sr{q}",
+                  np.arange(300, dtype=np.int64).tobytes())
+        q = c.execute("CLUSTER", "QOS")
+        streams = {
+            bytes(row[1]): row for row in q[3:]
+            if isinstance(row, (list, tuple)) and bytes(row[0]) == b"STREAM"
+        }
+        assert set(streams) == {b"interactive", b"bulk"}
+        for row in streams.values():
+            assert row[2] == 0  # quiesced: nothing in flight
+        assert sum(row[3] for row in streams.values()) > 0  # dispatched
+        # the class rows are still where pre-stream parsers expect them
+        assert {bytes(r[0]) for r in q[3:5]} == {b"interactive", b"bulk"}
+        # REBALANCE lands on the scheduler's per-tenant override
+        assert c.execute(
+            "CLUSTER", "QOS", "REBALANCE", "acme", "12500", "20000"
+        ) == b"OK"
+        ts = st.server.scheduler._tenants["acme"]
+        assert ts.bucket.rate == pytest.approx(12500.0)
+        assert ts.bucket.burst == pytest.approx(20000.0)
+        assert isinstance(
+            c.execute("CLUSTER", "QOS", "REBALANCE", "acme"), RespError)
+        assert isinstance(
+            c.execute("CLUSTER", "QOS", "REBALANCE", "acme", "wat"),
+            RespError)
+    finally:
+        c.close()
+
+
+# -- fleet control loop: split_rate, tenant-table parsing, rebalancer ---------
+
+
+def test_split_rate_demand_proportional_with_floor():
+    from redisson_tpu.cluster.qos_control import split_rate
+
+    assert split_rate(100.0, {}) == {}
+    # no demand anywhere: even split
+    s = split_rate(100.0, {"a": 0.0, "b": 0.0})
+    assert s["a"] == pytest.approx(50.0) and s["b"] == pytest.approx(50.0)
+    s = split_rate(100.0, {"a": 90.0, "b": 10.0})
+    assert s["a"] == pytest.approx(90.0) and s["b"] == pytest.approx(10.0)
+    # a quiet node keeps the min_share floor (no zero-budget ratchet) and
+    # the splits still sum to the global rate — the defended invariant
+    s = split_rate(100.0, {"a": 100.0, "b": 0.0})
+    assert s["b"] > 0.0
+    assert sum(s.values()) == pytest.approx(100.0)
+    for demand in ({"a": 5.0, "b": 1.0, "c": 0.0},
+                   {"a": 1e9, "b": 1.0},
+                   {"a": 3.0}):
+        assert sum(split_rate(77.5, demand).values()) == pytest.approx(77.5)
+
+
+def test_parse_tenant_table_tolerates_new_rows():
+    from redisson_tpu.cluster.qos_control import parse_tenant_table
+
+    reply = [
+        1, 0, 0,
+        [b"interactive", 0, 0, 0], [b"bulk", 1, 9, 100],
+        [b"STREAM", b"interactive", 0, 5], [b"STREAM", b"bulk", 9, 900],
+        [b"TENANT", b"hog", 42, 1000, 250, 3],
+        [b"TENANT", b"vip", 7, 50, 0, 0],
+    ]
+    assert parse_tenant_table(reply) == {
+        "hog": (1000, 250), "vip": (50, 0),
+    }
+    assert parse_tenant_table([1, 0, 0]) == {}
+    assert parse_tenant_table(RuntimeError("not a reply")) == {}
+
+
+class _FakeNode:
+    """CLUSTER QOS / REBALANCE endpoint for the control-loop unit: serves a
+    scripted tenant table, records pushes, optionally unreachable."""
+
+    def __init__(self):
+        self.tenants = {}  # tenant -> (admitted, shed)
+        self.pushes = []  # (tenant, rate, burst|None)
+        self.dead = False
+
+    def __call__(self):
+        if self.dead:
+            raise ConnectionError("node down")
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def execute(self, *args):
+        if args[:2] == ("CLUSTER", "QOS") and len(args) == 2:
+            return [1, 0, 0] + [
+                [b"TENANT", t.encode(), 0, adm, shed, 0]
+                for t, (adm, shed) in sorted(self.tenants.items())
+            ]
+        if args[:3] == ("CLUSTER", "QOS", "REBALANCE"):
+            tenant, rate = args[3], float(args[4])
+            burst = float(args[5]) if len(args) > 5 else None
+            self.pushes.append((tenant, rate, burst))
+            return b"OK"
+        raise AssertionError(f"unexpected command {args}")
+
+
+def test_qos_rebalancer_splits_by_demand_and_degrades():
+    from redisson_tpu.cluster.qos_control import QosRebalancer
+
+    a, b = _FakeNode(), _FakeNode()
+    a.tenants = {"hog": (1000, 0)}
+    b.tenants = {"hog": (1000, 0)}
+    rb = QosRebalancer({"a": a, "b": b}, 100_000.0,
+                       global_burst=150_000.0, min_share=0.05)
+    # sweep 1 baselines the cumulative counters: nothing pushed yet
+    assert rb.step() == {}
+    assert not a.pushes and not b.pushes
+    # demand skews 4:1 -> the split follows it and sums to the global rate
+    a.tenants = {"hog": (1000 + 8000, 0)}
+    b.tenants = {"hog": (1000 + 1000, 1000)}  # sheds COUNT as demand
+    pushed = rb.step()
+    split = pushed["hog"]
+    assert split["a"] == pytest.approx(80_000.0)
+    assert split["b"] == pytest.approx(20_000.0)
+    assert sum(split.values()) == pytest.approx(100_000.0)
+    (t, rate, burst) = a.pushes[-1]
+    assert t == "hog" and rate == pytest.approx(80_000.0)
+    # per-node burst scales with the rate share: fleet burst stays global
+    assert burst == pytest.approx(150_000.0 * 0.8)
+    # an unreachable node is skipped, the rest keep getting budget
+    b.dead = True
+    a.tenants = {"hog": (9000 + 4000, 0)}
+    pushed = rb.step()
+    assert pushed["hog"] == {"a": pytest.approx(100_000.0)}
+    assert rb.push_errors == 0  # dead node never even scraped
+    assert b.pushes[-1][1] == pytest.approx(20_000.0)  # last split stands
+    # rejecting a push is counted, not fatal
+    b.dead = False
+    b.tenants = {"hog": (99_999, 0)}
+
+    def broken():
+        raise OSError("push refused")
+
+    rb.conn_factories["b"] = broken
+    rb.step()  # b's counters re-baseline via the failed scrape: no crash
+    assert rb.sweeps == 4
+
+
+def test_qos_rebalancer_against_a_real_fleet():
+    """Two real masters: the loop scrapes their CLUSTER QOS tables and
+    lands per-node budgets via the wire actuator."""
+    from contextlib import closing
+
+    from redisson_tpu.cluster.qos_control import QosRebalancer
+    from redisson_tpu.harness import ClusterRunner
+    from redisson_tpu.net.client import Connection
+
+    runner = ClusterRunner(masters=2).run()
+    try:
+        def factory(node):
+            def open_conn():
+                return closing(Connection(
+                    node.server.server.host, node.server.server.port,
+                    timeout=30.0,
+                ))
+            return open_conn
+
+        m0, m1 = runner.masters
+        # tenant traffic lands only on m0 (hashtag-scoped): demand skews
+        with m0.server.client() as c:
+            c.execute("BF.RESERVE", b"ft{hog}", 0.01, 10_000)
+        rb = QosRebalancer(
+            {m0.address: factory(m0), m1.address: factory(m1)},
+            50_000.0, interval=0.05,
+        )
+        assert rb.step() == {}  # baseline
+        with m0.server.client() as c:
+            for i in range(4):
+                blob = (np.arange(200, dtype=np.int64) + i * 1000).tobytes()
+                c.execute("BF.MADD64", b"ft{hog}", blob)
+        pushed = rb.step()
+        assert "hog" in pushed, pushed
+        split = pushed["hog"]
+        assert sum(split.values()) == pytest.approx(50_000.0)
+        # all observed demand is on m0: it gets (nearly) the whole budget
+        assert split[m0.address] > split.get(m1.address, 0.0)
+        assert m0.server.server.scheduler._tenants["hog"].bucket.rate == (
+            pytest.approx(split[m0.address])
+        )
+    finally:
+        runner.shutdown()
+
+
+def test_supervisor_rebalance_loop_lifecycle():
+    from redisson_tpu.cluster.supervisor import ClusterSupervisor
+
+    sup = ClusterSupervisor.__new__(ClusterSupervisor)
+    sup._qos_rebalancer = None
+    sup.masters = []
+    sup._conn_factory = lambda node: (lambda: None)
+    rb = sup.start_qos_rebalance(10_000.0, interval=0.05)
+    try:
+        assert sup.start_qos_rebalance(10_000.0) is rb  # idempotent
+        assert rb._thread is not None
+    finally:
+        sup.stop_qos_rebalance()
+    assert sup._qos_rebalancer is None
+    assert rb._thread is None
+
+
+# -- replica plane satellites -------------------------------------------------
+
+
+def test_ft_keyless_reads_are_replica_readable():
+    from redisson_tpu.client import routing
+
+    assert routing.replica_readable("FT.SEARCH", ("idx", "q"))
+    assert routing.replica_readable("FT.MSEARCH", ("idx", "q1", "q2"))
+    assert routing.replica_readable("FT.INFO", ("idx",))
+    # keyless non-FT stays master-routed (admin surface)
+    assert not routing.replica_readable("PING", ())
+    assert not routing.replica_readable("CLUSTER", ("QOS",))
+    # keyed reads keep the PR 17 rule; writes never
+    assert routing.replica_readable("GET", ("k",))
+    assert not routing.replica_readable("SET", ("k", "v"))
+    assert not routing.replica_readable("FT.CREATE", ("idx", "ON", "HASH"))
+    # cross-slot reads fall back to the normal split path
+    assert not routing.replica_readable("MGET", ("a", "b"))
+
+
+def test_replica_profile_derives_staleness_offset():
+    from redisson_tpu.client import cluster as cl
+    from redisson_tpu.harness import ClusterRunner
+    from redisson_tpu.net.balancer import OccupancyLoadBalancer
+
+    runner = ClusterRunner(masters=1, replicas_per_master=1).run()
+    clients = []
+    try:
+        def client(**kw):
+            c = runner.client(scan_interval=0, **kw)
+            clients.append(c)
+            return c
+
+        # replica profile, no explicit bound: the derived sweep-cut bound
+        # + the occupancy balancer default
+        c = client(read_mode="replica")
+        assert c.max_staleness_offset == cl.DEFAULT_REPLICA_STALENESS_OFFSET
+        assert c.max_staleness_ms is None
+        assert isinstance(c._balancer_factory, OccupancyLoadBalancer)
+        # any explicit bound overrides the derivation entirely
+        c = client(read_mode="replica", max_staleness_ms=100)
+        assert c.max_staleness_offset is None
+        c = client(read_mode="replica", max_staleness_offset=7)
+        assert c.max_staleness_offset == 7
+        # master profile: no bound, no balancer coercion
+        c = client()
+        assert c.max_staleness_offset is None
+    finally:
+        for c in clients:
+            c.shutdown()
+        runner.shutdown()
+
+
+def test_execute_many_read_legs_ride_the_replica_plane():
+    from redisson_tpu.harness import ClusterRunner, _exec
+
+    runner = ClusterRunner(masters=1, replicas_per_master=1).run()
+    client = None
+    try:
+        master = runner.masters[0]
+        client = runner.client(scan_interval=0, read_mode="replica")
+        seed = {f"em:k{i}": f"v{i}" for i in range(6)}
+        for k, v in seed.items():
+            client.execute("SET", k, v)
+        with master.server.client() as c:
+            assert _exec(c, "REPLFLUSH") >= 1
+        client.refresh_topology()
+        # an all-read group serves from the replica (probe rides the frame)
+        before = dict(client.read_stats)
+        out = client.execute_many([("GET", k) for k in seed])
+        assert [r.decode() for r in out] == list(seed.values())
+        assert client.read_stats["replica_reads"] >= (
+            before["replica_reads"] + len(seed)
+        )
+        # a group containing ONE write pins the whole group to the master
+        served = client.read_stats["replica_reads"]
+        out = client.execute_many(
+            [("GET", "em:k0"), ("SET", "em:k0", "v0b"), ("GET", "em:k1")]
+        )
+        assert out[1] in (b"OK", "OK")
+        assert client.read_stats["replica_reads"] == served
+        client.execute("SET", "em:k0", "v0")
+        with master.server.client() as c:
+            _exec(c, "REPLFLUSH")
+        # stalled replication past an explicit ms bound: the group's probe
+        # redirects the WHOLE group to the master, values still right
+        ms_client = runner.client(
+            scan_interval=0, read_mode="replica", max_staleness_ms=150,
+        )
+        try:
+            runner.stall_replication(master)
+            time.sleep(0.4)
+            ms_client.execute("SET", "em:k2", "w-fresh")
+            before = dict(ms_client.read_stats)
+            out = ms_client.execute_many([("GET", "em:k2"), ("GET", "em:k3")])
+            assert out[0] == b"w-fresh"
+            assert ms_client.read_stats["replica_redirects_stale"] > (
+                before["replica_redirects_stale"]
+            )
+        finally:
+            runner.resume_replication(master)
+            ms_client.shutdown()
+    finally:
+        if client is not None:
+            client.shutdown()
+        runner.shutdown()

@@ -122,6 +122,12 @@ def main() -> int:
                     help="vector profile: SHARDS for the soaked index — "
                          "> 1 runs the mesh-sharded leg (ISSUE 15: fan-out "
                          "legs + on-device merge under rebalance)")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="cluster-proc profile: replicas per master — > 0 "
+                         "spawns replica PROCESSES and adds a "
+                         "read_mode=replica reader to the workload, so "
+                         "replica-served reads ride the multi-process "
+                         "supervisor fleet (ISSUE 18 satellite)")
     args = ap.parse_args()
 
     import jax
@@ -199,6 +205,7 @@ def main() -> int:
             # full phase matrix runs in tests/test_cluster_proc.py's slow
             # tier — one phase keeps the smoke inside its 60s budget
             crash_phases=("DRAINING:1",),
+            replicas=args.replicas,
         ))
     elif args.profile == "migration":
         from redisson_tpu.chaos.soak import (
