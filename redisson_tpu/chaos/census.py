@@ -123,6 +123,14 @@ class ResourceCensus:
             if ftvec is not None:
                 for k, v in ftvec().items():
                     out[k] = v
+            # per-device residency over ALL record kinds (ISSUE 19
+            # satellite): record_bytes_dev<N>[_<kind>] rows exist only
+            # while that device holds bytes — DEL/DROPINDEX drains them
+            # to absence, which the soaks read as zero
+            devbytes = getattr(server, "_device_bytes_census", None)
+            if devbytes is not None:
+                for k, v in devbytes().items():
+                    out[k] = v
             return out
 
         self.track(name, probe)

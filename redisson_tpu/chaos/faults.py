@@ -43,6 +43,23 @@ consults the SAME installed plane at its two file-I/O event sites:
         CRC32 trailer catches at the next load;
       - ``fsync_fail``  → ``OSError(EIO)`` from fsync.
 
+**Device faults** (ISSUE 19): the device plane (``core/ioplane`` lanes,
+``services/vector`` bank growth, ``server/registry`` dispatch) consults the
+SAME installed plane at three port-less-per-process but per-DEVICE event
+sites — the "port" of a device rule is the device id, so "kill lane 1's
+third dispatch" is one ``add("device_kernel", port=1, after=2)``:
+
+      - ``device_kernel``  → the dispatch raises the same
+        ``XlaRuntimeError`` shape a failed kernel launch produces
+        (``INTERNAL: Failed to launch CUDA/TPU kernel``-class text);
+      - ``device_oom``     → an allocation raises the
+        ``RESOURCE_EXHAUSTED: Out of memory allocating N bytes`` shape
+        real JAX raises when HBM is exhausted;
+      - ``device_hang``    → the readback stalls for ``delay_s`` seconds
+        (the hung-DMA model; with the lane watchdog armed the stall trips
+        ``LaneWatchdogTimeout``, with it off the transfer just takes that
+        long — the pre-watchdog wedge, bounded so tests terminate).
+
 Server/coordinator-layer faults (kill / pause / restart a node, stall the
 replication stream) live on ``harness.ClusterRunner`` and
 ``server/replication.ReplicationSource`` — see ``pause_node`` /
@@ -74,9 +91,26 @@ _STREAM = {
     "enospc": "storage_write",
     "torn_write": "storage_write",
     "fsync_fail": "storage_fsync",
+    "device_kernel": "device_dispatch",
+    "device_oom": "device_alloc",
+    "device_hang": "device_readback",
 }
 
 KINDS = tuple(_STREAM)
+
+
+def _xla_runtime_error(text: str) -> RuntimeError:
+    """The exception SHAPE real JAX raises from the device runtime: the
+    concrete ``jaxlib`` class when available (it subclasses RuntimeError
+    and is constructible), else a plain RuntimeError with identical text —
+    catch sites match on the message, never the class, so both shapes
+    exercise the same recovery path."""
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+
+        return XlaRuntimeError(text)
+    except Exception:  # pragma: no cover - jaxlib is baked into the image
+        return RuntimeError(text)
 
 
 @dataclass
@@ -308,6 +342,39 @@ class FaultPlane:
         f = self._on_storage_event("storage_fsync")
         if f is not None and f.kind == "fsync_fail":
             raise OSError(errno.EIO, f"[chaos] fsync failed for {path!r}")
+
+    # -- hooks (core/ioplane.py device plane, ISSUE 19) -----------------------
+
+    def on_device_dispatch(self, dev_id: int) -> None:
+        """May raise the failed-kernel-launch ``XlaRuntimeError`` shape.
+        The event stream counts dispatches per device (the rule's ``port``
+        is the device id)."""
+        f = self._on_event("device_dispatch", int(dev_id))
+        if f is not None and f.kind == "device_kernel":
+            raise _xla_runtime_error(
+                f"INTERNAL: [chaos] Failed to launch kernel on device {dev_id}"
+            )
+
+    def on_device_alloc(self, dev_id: int, nbytes: int = 0) -> None:
+        """May raise the HBM-exhaustion ``RESOURCE_EXHAUSTED`` shape on a
+        bank create/grow allocation (the rule's ``port`` is the device
+        id)."""
+        f = self._on_event("device_alloc", int(dev_id))
+        if f is not None and f.kind == "device_oom":
+            raise _xla_runtime_error(
+                f"RESOURCE_EXHAUSTED: [chaos] Out of memory allocating "
+                f"{int(nbytes)} bytes on device {dev_id}"
+            )
+
+    def on_device_readback(self, dev_id: int) -> float:
+        """Returns the stall (seconds) a hung transfer injects on this
+        readback, 0.0 when unmatched.  The CALLER owns sleeping/raising —
+        the lane watchdog bounds the wait instead of this hook wedging the
+        writer task from inside the chaos plane."""
+        f = self._on_event("device_readback", int(dev_id))
+        if f is not None and f.kind == "device_hang":
+            return float(f.delay_s)
+        return 0.0
 
     # -- lifecycle -----------------------------------------------------------
 

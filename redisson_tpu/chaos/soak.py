@@ -4055,3 +4055,463 @@ class VectorSoakHarness:
             return self.report
         finally:
             self._teardown()
+
+
+# -- device-fault soak (ISSUE 19): lane watchdogs, OOM degradation, -----------
+#    quarantine-and-evacuate
+
+
+@dataclass
+class DeviceFaultSoakConfig(DeviceShardSoakConfig):
+    """Mixed bucket/bloom/KNN traffic against one device-sharded server
+    while device lanes are killed (kernel-launch failures), hung (stalled
+    readbacks under an armed watchdog) and OOMed (RESOURCE_EXHAUSTED bank
+    growth), and the quarantined lane is evacuated mid-traffic."""
+
+    watchdog_ms: int = 250         # lane watchdog bound (armed via CONFIG)
+    quarantine_after: int = 3      # consecutive faults that trip a lane
+    hang_s: float = 0.75           # injected stall (> watchdog bound)
+    kernel_faults: int = 40        # consecutive dispatch kills on the victim
+    docs: int = 32                 # KNN corpus (bit-identity oracle)
+    dim: int = 16
+    victim: int = 1                # device INDEX killed + evacuated
+    hang_victim: int = 2           # device INDEX whose readbacks stall
+
+
+@dataclass
+class DeviceFaultSoakReport(DeviceShardSoakReport):
+    quarantines: int = 0           # lanes the fault streak actually tripped
+    evacuations: int = 0
+    probes_passed: int = 0         # CLUSTER DEVPROBE un-quarantines
+    oom_errors: int = 0            # clean -OOM replies observed
+    banks_verified: int = 0        # docs proven bit-identical post-evacuation
+    injected: Dict[str, int] = field(default_factory=dict)
+
+    def summary(self) -> str:
+        return (
+            f"device-fault soak: {self.cycles_completed} cycles, "
+            f"{self.writes_acked} acked writes, {self.reads} tracked reads "
+            f"({self.stale_reads} stale), {self.errors} budgeted errors, "
+            f"{self.quarantines} quarantines, {self.evacuations} evacuations "
+            f"({self.records_moved} records moved), {self.probes_passed} "
+            f"probes passed, {self.oom_errors} -OOM replies, "
+            f"banks={self.banks_verified} docs bit-identical, "
+            f"bloom={self.bloom_keys_verified} keys verified, "
+            f"injected={self.injected}"
+        )
+
+
+class DeviceFaultSoakHarness(DeviceShardSoakHarness):
+    """The device fault domain's invariants, under fire (ISSUE 19):
+
+      * **detection** — a lane whose dispatches keep failing with the real
+        ``XlaRuntimeError`` kernel-launch shape trips QUARANTINED at the
+        consecutive-fault threshold; a hung readback is BOUNDED by the armed
+        lane watchdog (``CONFIG SET lane-watchdog-ms``) instead of wedging
+        its writer, and counts on the same streak;
+      * **degradation** — commands routed to a faulted/quarantined device
+        fail with clean retryable ``-TRYAGAIN`` replies (never a dead
+        connection, never a wedge); an HBM-exhausted bank growth degrades
+        to ONE ``-OOM`` reply with the rows kept pending, and a later retry
+        lands them;
+      * **recovery** — the quarantined lane's slots evacuate mid-traffic
+        through the journaled fenced rebalance path (zero acked-write
+        loss, resumable), and a ``CLUSTER DEVPROBE`` dispatch that passes
+        un-quarantines the lane so a respread returns it to rotation;
+      * **proof of bit-identity** — after evacuation every doc's stored
+        version field still matches its bank row EXACTLY (KNN with the
+        expected embedding returns that doc at distance ~0), every acked
+        bloom add still probes true, tracked readers never saw a stale
+        value, and the lane census returns to baseline.
+    """
+
+    INDEX = "dfvec"
+    PREFIX = "dfv:"
+
+    def __init__(self, config: Optional[DeviceFaultSoakConfig] = None):
+        super().__init__(config or DeviceFaultSoakConfig())
+        self.report = DeviceFaultSoakReport()
+        cfg = self.config
+        rng = np.random.default_rng(cfg.seed + 23)
+        self._base = rng.standard_normal((cfg.docs, cfg.dim)).astype(np.float32)
+        self._bump = rng.standard_normal((cfg.docs, cfg.dim)).astype(np.float32)
+        self._doc_acked: Dict[int, int] = {}
+        self._prev_watchdog = None
+        self._prev_quarantine = None
+
+    def _vec(self, doc: int, version: int) -> np.ndarray:
+        """Deterministic per-(doc, version) embedding — the bit-identity
+        oracle: the bank row for a doc whose stored ``ver`` field reads v
+        must equal EXACTLY this vector."""
+        return (self._base[doc] + 0.05 * version * self._bump[doc]).astype(
+            np.float32
+        )
+
+    def _connect(self):
+        from redisson_tpu.net.client import Connection
+
+        return Connection(self._server.server.host, self._server.server.port,
+                          timeout=10.0)
+
+    def _hset_doc(self, conn, doc: int, version: int):
+        return conn.execute(
+            "HSET", f"{self.PREFIX}{doc}", "ver", str(version),
+            "emb", self._vec(doc, version).tobytes(),
+        )
+
+    def _knn1(self, conn, index: str, query: np.ndarray):
+        """Top-1 NOCONTENT KNN; returns (doc_id_bytes, score_float)."""
+        from redisson_tpu.net.resp import RespError
+
+        out = conn.execute(
+            "FT.SEARCH", index, "(*)=>[KNN 1 @emb $v]",
+            "PARAMS", "2", "v", query.astype(np.float32).tobytes(),
+            "NOCONTENT",
+        )
+        if isinstance(out, RespError):
+            raise RuntimeError(str(out))
+        if len(out) < 3:
+            raise RuntimeError(f"empty KNN reply: {out!r}")
+        return bytes(out[1]), float(out[2][-1])
+
+    def _setup(self) -> None:
+        from redisson_tpu.core import ioplane
+
+        super()._setup()
+        cfg = self.config
+        self._prev_watchdog = ioplane.lane_watchdog_ms()
+        self._prev_quarantine = ioplane.quarantine_after()
+        admin = self._connect()
+        try:
+            r = admin.execute("CONFIG", "SET", "lane-watchdog-ms",
+                              str(cfg.watchdog_ms))
+            assert r in (b"OK", "OK"), r
+            r = admin.execute("CONFIG", "SET", "lane-quarantine-after",
+                              str(cfg.quarantine_after))
+            assert r in (b"OK", "OK"), r
+            r = admin.execute(
+                "FT.CREATE", self.INDEX, "ON", "HASH",
+                "PREFIX", "1", self.PREFIX,
+                "SCHEMA", "emb", "VECTOR", "FLAT", "6", "TYPE", "FLOAT32",
+                "DIM", str(cfg.dim), "DISTANCE_METRIC", "L2",
+            )
+            assert r in (b"OK", "OK"), r
+            for d in range(cfg.docs):
+                self._hset_doc(admin, d, 0)
+                self._doc_acked[d] = 0
+            # force the bank's device allocation NOW, before any chaos plane
+            # installs: the armed window's first device_alloc event is then
+            # deterministically the OOM leg's own bank, never this one's
+            self._knn1(admin, self.INDEX, self._base[0])
+        finally:
+            admin.close()
+
+    def _teardown(self) -> None:
+        from redisson_tpu.core import ioplane
+
+        # the watchdog/quarantine knobs are process-global: restore them so
+        # a failing run never leaks an armed watchdog into the next test
+        if self._prev_watchdog is not None:
+            ioplane.set_lane_watchdog_ms(self._prev_watchdog)
+        if self._prev_quarantine is not None:
+            ioplane.set_quarantine_after(self._prev_quarantine)
+        super()._teardown()
+
+    # -- workload additions ----------------------------------------------------
+
+    def _ingest(self, stop: threading.Event) -> None:
+        """KNN-corpus writer: keeps every doc MOVING in embedding space
+        (ver bumps re-derive the row), so the post-evacuation bit-identity
+        check proves the bank tracked the acked writes exactly."""
+        cfg = self.config
+        conn = None
+        vers = dict(self._doc_acked)
+        j = 0
+        while not stop.is_set():
+            d = j % cfg.docs
+            try:
+                if conn is None:
+                    conn = self._connect()
+                from redisson_tpu.net.resp import RespError
+
+                r = self._hset_doc(conn, d, vers[d] + 1)
+                if isinstance(r, RespError):
+                    raise RuntimeError(str(r))
+                vers[d] += 1
+                with self._acked_lock:
+                    self._doc_acked[d] = vers[d]
+                    self.report.writes_acked += 1
+            except Exception:  # noqa: BLE001 — budgeted fault-window error
+                try:
+                    if conn is not None:
+                        conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                conn = None
+                with self._acked_lock:
+                    self.report.errors += 1
+            j += 1
+            time.sleep(0.004)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _survivor_key(self, prefix: str, victim: int) -> str:
+        """A key of `prefix` whose slot is NOT owned by the victim device —
+        the OOM leg must not stall behind the victim's quarantine."""
+        from redisson_tpu.core.ioplane import quarantined_device_ids
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        placement = self._server.server.engine.placement
+        owner = placement.owner_snapshot()
+        bad = quarantined_device_ids()
+        for i in range(512):
+            key = f"{prefix}{i}"
+            idx = int(owner[calc_slot(key)])
+            dev_id = getattr(placement.devices[idx], "id", idx)
+            if idx != victim and dev_id not in bad:
+                return key
+        raise AssertionError("no survivor-owned key found")
+
+    def _oom_leg(self, cycle: int) -> None:
+        """Deterministic HBM-OOM degradation: a fresh index's FIRST bank
+        allocation faults with the RESOURCE_EXHAUSTED shape — the client
+        sees ONE clean -OOM reply, the rows stay pending, and the retry
+        lands them (graceful degradation, never a dead connection)."""
+        from redisson_tpu.net.resp import RespError
+
+        cfg = self.config
+        index = f"dfoom{cycle}"
+        prefix = f"dfo{cycle}:"
+        key = self._survivor_key(prefix, cfg.victim)
+        conn = self._connect()
+        try:
+            r = conn.execute(
+                "FT.CREATE", index, "ON", "HASH", "PREFIX", "1", prefix,
+                "SCHEMA", "emb", "VECTOR", "FLAT", "6", "TYPE", "FLOAT32",
+                "DIM", "8", "DISTANCE_METRIC", "L2",
+            )
+            assert r in (b"OK", "OK"), r
+            q = np.ones(8, np.float32)
+            r = conn.execute("HSET", key, "emb", q.tobytes())
+            assert not isinstance(r, RespError), r
+            # first search forces the bank's first device allocation — the
+            # armed device_oom rule faults it: ONE -OOM reply, rows pending
+            out = conn.execute(
+                "FT.SEARCH", index, "(*)=>[KNN 1 @emb $v]",
+                "PARAMS", "2", "v", q.tobytes(), "NOCONTENT",
+            )
+            assert isinstance(out, RespError) and "OOM" in str(out), (
+                f"expected a clean -OOM reply, got {out!r}"
+            )
+            with self._acked_lock:
+                self.report.oom_errors += 1
+            # the retry allocates for real and drains the kept-pending rows
+            doc, score = self._knn1(conn, index, q)
+            assert doc == key.encode() and score < 1e-4, (doc, score)
+        finally:
+            conn.close()
+
+    # -- run -------------------------------------------------------------------
+
+    def run(self) -> DeviceFaultSoakReport:
+        from redisson_tpu.core import ioplane
+        from redisson_tpu.net.client import install_fault_plane
+        from redisson_tpu.server import migration as mig
+        from redisson_tpu.utils.crc16 import MAX_SLOT
+
+        cfg = self.config
+        self._setup()
+        try:
+            engine = self._server.server.engine
+            placement = engine.placement
+            assert placement.n_devices > max(cfg.victim, cfg.hang_victim), (
+                f"need > {max(cfg.victim, cfg.hang_victim)} devices, "
+                f"have {placement.n_devices}"
+            )
+            victim_id = getattr(
+                placement.devices[cfg.victim], "id", cfg.victim
+            )
+            hang_id = getattr(
+                placement.devices[cfg.hang_victim], "id", cfg.hang_victim
+            )
+            baseline = self._lane_census()
+            self.report.lane_census.append(baseline)
+            io_base = ioplane.STATS.snapshot()
+            for cycle in range(cfg.cycles):
+                sched = FaultSchedule(cfg.seed * 6007 + cycle)
+                # kill the victim lane's dispatches until quarantine trips
+                sched.add("device_kernel", port=victim_id, after=2,
+                          count=cfg.kernel_faults)
+                # hang two readbacks on another lane: the armed watchdog
+                # bounds them (two < quarantine_after: trips nothing)
+                sched.add("device_hang", port=hang_id, after=2, count=2,
+                          delay_s=cfg.hang_s)
+                # the next fresh bank allocation OOMs (the _oom_leg index)
+                sched.add("device_oom", after=0, count=1)
+                plane = FaultPlane(sched)
+                stop = threading.Event()
+                threads = [
+                    threading.Thread(
+                        target=self._writer, args=(w, stop), daemon=True
+                    )
+                    for w in range(cfg.writer_threads)
+                ] + [
+                    threading.Thread(
+                        target=self._reader, args=(stop,), daemon=True
+                    ),
+                    threading.Thread(
+                        target=self._ingest, args=(stop,), daemon=True
+                    ),
+                ]
+                install_fault_plane(plane)
+                for t in threads:
+                    t.start()
+                try:
+                    self._oom_leg(cycle)
+                    # detection: traffic drives the victim's dispatch stream
+                    # into the kill window; the streak must trip QUARANTINED
+                    deadline = time.monotonic() + 30.0
+                    while victim_id not in ioplane.quarantined_device_ids():
+                        assert time.monotonic() < deadline, (
+                            "victim lane never quarantined; injected="
+                            f"{plane.injected}"
+                        )
+                        time.sleep(0.01)
+                    self.report.quarantines += 1
+                    time.sleep(cfg.phase_seconds / 2)
+                    # recovery: evacuate the quarantined lane MID-TRAFFIC
+                    # through the journaled fenced rebalance path
+                    moved, targets, _epoch = mig.evacuate_device(
+                        engine, cfg.victim, journal_dir=self._journal_dir
+                    )
+                    self.report.evacuations += 1
+                    self.report.rebalances += 1
+                    self.report.records_moved += moved
+                    assert placement.slot_counts()[cfg.victim] == 0, (
+                        placement.slot_counts()
+                    )
+                    time.sleep(cfg.phase_seconds)
+                finally:
+                    stop.set()
+                    for t in threads:
+                        t.join(timeout=30)
+                    install_fault_plane(None)
+                for kind, n in plane.injected.items():
+                    self.report.injected[kind] = (
+                        self.report.injected.get(kind, 0) + n
+                    )
+                # probe EVERY quarantined lane (the victim, plus any lane
+                # an incidental genuine watchdog trip flagged under load —
+                # the same probe loop an operator runs): a passing
+                # chaos-free dispatch un-quarantines it, then a respread
+                # returns the victim to rotation
+                admin = self._connect()
+                try:
+                    for idx in range(placement.n_devices):
+                        lane = engine.lanes.lane(placement.devices[idx])
+                        if not lane.quarantined:
+                            continue
+                        r = admin.execute("CLUSTER", "DEVPROBE", str(idx))
+                        assert list(r) == [1, 0], (
+                            f"probe of device {idx} should pass + "
+                            f"un-quarantine, got {r!r}"
+                        )
+                        self.report.probes_passed += 1
+                finally:
+                    admin.close()
+                assert ioplane.quarantined_device_ids() == set()
+                moved = mig.rebalance_devices(
+                    engine, placement.spread_plan(placement.n_devices),
+                    journal_dir=self._journal_dir,
+                )
+                self.report.rebalances += 1
+                self.report.records_moved += moved
+                self.report.cycles_completed += 1
+            # every injected fault kind actually fired
+            assert self.report.injected.get("device_kernel", 0) > 0
+            assert self.report.injected.get("device_hang", 0) > 0
+            assert self.report.injected.get("device_oom", 0) > 0
+            # quiesce, then the invariants
+            time.sleep(cfg.quiesce_s)
+            leftover = mig.resume_device_rebalances(engine, self._journal_dir)
+            assert leftover == [], f"rebalances left in flight: {leftover}"
+            counts = placement.slot_counts()
+            assert sum(counts) == MAX_SLOT, counts
+            assert all(c > 0 for c in counts), (
+                f"respread left a device empty: {counts}"
+            )
+            # zero acked-write loss across quarantine + evacuation
+            with self._acked_lock:
+                acked = dict(self._acked)
+                doc_acked = dict(self._doc_acked)
+            for k, v in acked.items():
+                got = self._writer_client.get_bucket(k).get()
+                got = 0 if got is None else int(got)
+                assert got >= v, f"acked-write loss: {k} read {got} < acked {v}"
+            for name, keys in self._bloom_keys.items():
+                bf = self._writer_client.get_bloom_filter(name)
+                bf.add_all(keys[:400])
+                found = np.asarray(bf.contains_each(keys[:400]))
+                assert found.all(), f"{name}: acked bloom adds lost"
+                self.report.bloom_keys_verified += int(found.sum())
+            # bit-identical banks post-evacuation: each doc's STORED version
+            # field must match its bank row exactly — KNN with the expected
+            # embedding returns that doc at ~zero L2 distance
+            conn = self._connect()
+            try:
+                for d in range(cfg.docs):
+                    ver = conn.execute("HGET", f"{self.PREFIX}{d}", "ver")
+                    ver = int(ver)
+                    assert ver >= doc_acked[d], (
+                        f"acked-ingest loss: doc {d} stored ver {ver} < "
+                        f"acked {doc_acked[d]}"
+                    )
+                    doc, score = self._knn1(
+                        conn, self.INDEX, self._vec(d, ver)
+                    )
+                    assert doc == f"{self.PREFIX}{d}".encode(), (
+                        f"doc {d} (ver {ver}): bank row diverged — nearest "
+                        f"is {doc!r} at {score}"
+                    )
+                    assert score < 1e-3, (
+                        f"doc {d} (ver {ver}): bank row not bit-identical "
+                        f"(L2^2 {score})"
+                    )
+                    self.report.banks_verified += 1
+            finally:
+                conn.close()
+            # tracked caches converge to ground truth after quiesce
+            for k in acked:
+                truth = self._writer_client.get_bucket(k).get()
+                tracked = self._reader_buckets[k].get()
+                assert tracked == truth, (
+                    f"near cache diverged on {k}: {tracked} != {truth}"
+                )
+            assert self.report.stale_reads == 0, (
+                "stale tracked reads across quarantine/evacuation: "
+                + "; ".join(self._violations[:5])
+            )
+            # no lane left quarantined, no fault state leaked into census
+            assert ioplane.quarantined_device_ids() == set()
+            snap = ioplane.STATS.snapshot()
+            self.report.host_colocations = snap["host_colocations"]
+            assert snap["host_colocations"] == io_base["host_colocations"], (
+                "evacuation gathered through the host"
+            )
+            final = self._lane_census()
+            self.report.lane_census.append(final)
+            assert final["active_dispatches"] == 0, final
+            assert final["lanes"] == baseline["lanes"], (baseline, final)
+            budget = max(
+                10, (self.report.writes_acked + self.report.reads) // 2
+            )
+            assert self.report.errors <= budget, (
+                f"error budget blown: {self.report.errors} vs {budget}"
+            )
+            assert self.report.writes_acked > 0 and self.report.reads > 0
+            return self.report
+        finally:
+            self._teardown()

@@ -34,7 +34,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["QosRebalancer", "parse_tenant_table", "split_rate"]
+__all__ = ["QosRebalancer", "parse_tenant_table", "parse_tenant_weights",
+           "split_rate"]
 
 
 def parse_tenant_table(reply) -> Dict[str, Tuple[int, int]]:
@@ -57,25 +58,50 @@ def parse_tenant_table(reply) -> Dict[str, Tuple[int, int]]:
     return out
 
 
+def parse_tenant_weights(reply) -> Dict[str, float]:
+    """``CLUSTER QOS`` reply -> {tenant: weight} for TENANT rows that carry
+    the trailing weight element (ISSUE 19 satellite).  Pre-weight nodes
+    (6-element rows) simply contribute nothing — callers default to 1.0."""
+    out: Dict[str, float] = {}
+    for row in reply[3:] if isinstance(reply, (list, tuple)) else ():
+        if not isinstance(row, (list, tuple)) or len(row) < 7:
+            continue
+        if row[0] not in (b"TENANT", "TENANT"):
+            continue
+        name = row[1]
+        if isinstance(name, (bytes, bytearray)):
+            name = bytes(name).decode(errors="replace")
+        try:
+            out[str(name)] = float(row[6])
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
 def split_rate(global_rate: float, demand: Dict[str, float],
-               min_share: float = 0.05) -> Dict[str, float]:
+               min_share: float = 0.05,
+               weight: float = 1.0) -> Dict[str, float]:
     """Split one tenant's global rate across nodes proportional to demand,
     with every node floored at ``min_share`` of an even split (see module
-    docstring for why the floor exists).  Shares are normalized so the
-    splits always sum to ``global_rate`` — the fleet-wide budget is the
-    invariant the loop defends."""
+    docstring for why the floor exists).  ``weight`` is the tenant's
+    service-class multiplier (gold=2.0/silver=1.0; ISSUE 19 satellite):
+    the tenant's effective global budget is ``global_rate * weight``, so a
+    weight of 1.0 reproduces unweighted behavior exactly.  Shares are
+    normalized so the splits always sum to that effective budget — the
+    fleet-wide (weighted) budget is the invariant the loop defends."""
     if not demand:
         return {}
+    budget = global_rate * max(0.0, weight)
     n = len(demand)
     floor = min_share / n
     total = sum(max(0.0, d) for d in demand.values())
     if total <= 0.0:
-        return {node: global_rate / n for node in demand}
+        return {node: budget / n for node in demand}
     shares = {
         node: max(floor, max(0.0, d) / total) for node, d in demand.items()
     }
     norm = sum(shares.values())
-    return {node: global_rate * s / norm for node, s in shares.items()}
+    return {node: budget * s / norm for node, s in shares.items()}
 
 
 class QosRebalancer:
@@ -88,7 +114,8 @@ class QosRebalancer:
 
     def __init__(self, conn_factories: Dict[str, Callable],
                  global_rate: float, *, global_burst: Optional[float] = None,
-                 interval: float = 1.0, min_share: float = 0.05):
+                 interval: float = 1.0, min_share: float = 0.05,
+                 tenant_weights: Optional[Dict[str, float]] = None):
         if global_rate <= 0:
             raise ValueError("global_rate must be positive")
         self.conn_factories = dict(conn_factories)
@@ -96,6 +123,13 @@ class QosRebalancer:
         self.global_burst = global_burst
         self.interval = float(interval)
         self.min_share = float(min_share)
+        # service-class weights (ISSUE 19 satellite): configured weights are
+        # authoritative and are PUSHED to the fleet with each rebalance
+        # (CLUSTER QOS REBALANCE ... WEIGHT); weights a node already
+        # carries (scraped off its TENANT rows) fill in for tenants the
+        # operator didn't name.  Unknown tenants weigh 1.0.
+        self.tenant_weights = dict(tenant_weights or {})
+        self._scraped_weights: Dict[str, float] = {}
         # node -> tenant -> cumulative demand counter at last sweep
         self._last: Dict[str, Dict[str, int]] = {}
         # tenant -> node -> rate pushed last sweep (observability + tests)
@@ -110,17 +144,33 @@ class QosRebalancer:
     def _scrape_node(self, node: str) -> Optional[Dict[str, Tuple[int, int]]]:
         try:
             with self.conn_factories[node]() as c:
-                return parse_tenant_table(c.execute("CLUSTER", "QOS"))
+                reply = c.execute("CLUSTER", "QOS")
         except Exception:  # noqa: BLE001 — a dead node skips this sweep
             return None
+        self._scraped_weights.update(parse_tenant_weights(reply))
+        return parse_tenant_table(reply)
 
-    def _push(self, node: str, tenant: str, rate: float) -> None:
+    def weight_of(self, tenant: str) -> float:
+        """Configured weight wins; a weight the fleet already carries fills
+        in; everyone else is 1.0."""
+        w = self.tenant_weights.get(tenant)
+        if w is None:
+            w = self._scraped_weights.get(tenant, 1.0)
+        return max(0.0, float(w))
+
+    def _push(self, node: str, tenant: str, rate: float,
+              weight: float) -> None:
         args: List[object] = ["CLUSTER", "QOS", "REBALANCE", tenant,
                              f"{rate:.6f}"]
         if self.global_burst is not None:
-            # each node's burst headroom scales with its rate share, so the
-            # fleet-wide burst stays the configured global number
-            args.append(f"{self.global_burst * rate / self.global_rate:.6f}")
+            # each node's burst headroom scales with its share of the
+            # tenant's WEIGHTED global budget, so the fleet-wide burst stays
+            # the configured global number (times the tenant's weight)
+            budget = self.global_rate * max(weight, 1e-9)
+            args.append(f"{self.global_burst * rate / budget:.6f}")
+        if tenant in self.tenant_weights:
+            # operator-configured weights are authoritative: teach the node
+            args += ["WEIGHT", f"{weight:g}"]
         try:
             with self.conn_factories[node]() as c:
                 c.execute(*args)
@@ -149,9 +199,11 @@ class QosRebalancer:
                 prev[tenant] = cum
         pushed: Dict[str, Dict[str, float]] = {}
         for tenant, node_demand in demand.items():
-            split = split_rate(self.global_rate, node_demand, self.min_share)
+            weight = self.weight_of(tenant)
+            split = split_rate(self.global_rate, node_demand, self.min_share,
+                               weight=weight)
             for node, rate in split.items():
-                self._push(node, tenant, rate)
+                self._push(node, tenant, rate, weight)
             pushed[tenant] = split
         if pushed:
             self.last_split = pushed

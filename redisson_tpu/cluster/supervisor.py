@@ -839,14 +839,20 @@ class ClusterSupervisor:
     def start_qos_rebalance(self, global_rate: float, *,
                             global_burst: Optional[float] = None,
                             interval: float = 1.0,
-                            min_share: float = 0.05):
+                            min_share: float = 0.05,
+                            tenant_weights: Optional[Dict[str, float]] = None):
         """Arm the fleet-wide tenant budget control loop (ISSUE 18,
         cluster/qos_control.py): scrape every master's ``CLUSTER QOS``
         tenant table and re-split each tenant's ``global_rate`` across
         masters proportional to observed demand, pushed via ``CLUSTER QOS
         REBALANCE``.  Masters only — replicas don't admit writes, so
-        budgeting them would dilute the split.  Idempotent; stopped by
-        ``stop_qos_rebalance`` and by ``shutdown``."""
+        budgeting them would dilute the split.  The conn factories ride the
+        fleet bus unchanged (TLS + password on cross-host driver fleets),
+        so the loop runs identically over LoopbackTransport/SSH-spawned
+        hosts.  ``tenant_weights`` (ISSUE 19 satellite) sizes each tenant's
+        global budget by service class (gold=2.0/silver=1.0) and is pushed
+        fleet-wide via the REBALANCE verb's WEIGHT operand.  Idempotent;
+        stopped by ``stop_qos_rebalance`` and by ``shutdown``."""
         from redisson_tpu.cluster.qos_control import QosRebalancer
 
         if self._qos_rebalancer is not None:
@@ -857,6 +863,7 @@ class ClusterSupervisor:
         self._qos_rebalancer = QosRebalancer(
             factories, global_rate, global_burst=global_burst,
             interval=interval, min_share=min_share,
+            tenant_weights=tenant_weights,
         ).start()
         return self._qos_rebalancer
 

@@ -38,6 +38,7 @@ import functools
 import os
 import threading
 import time
+import weakref
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -47,6 +48,13 @@ import numpy as np
 # `is None` branch (the chaos-hook zero-cost discipline, asserted at the
 # allocator level by tests/test_observe.py)
 from redisson_tpu.observe import trace as _obs
+
+# device chaos plane (ISSUE 19): the lane dispatch/readback chokepoints
+# consult the SAME process-global fault plane net/client.py hosts, under the
+# same discipline — disarmed cost is one global load plus an `is None`
+# branch, asserted at the allocator level by tests/test_perf_smoke.py
+# against these guard lines too
+from redisson_tpu.net import client as _net
 
 # -- global switch ------------------------------------------------------------
 
@@ -143,6 +151,73 @@ def set_bulk_subwindow_items(n: int) -> int:
     prev = _bulk_subwindow_items
     _bulk_subwindow_items = max(0, int(n))
     return prev
+
+
+# -- lane watchdog (ISSUE 19) --------------------------------------------------
+#
+# `ReadbackFuture.result()` historically blocked FOREVER on a transfer that
+# never materializes (hung DMA, preempted device) — a wedged writer task
+# holding a staging slot and a connection.  The watchdog bounds that wait:
+# armed (CONFIG SET lane-watchdog-ms > 0) a readback that has not
+# materialized within the bound raises `LaneWatchdogTimeout`, which the
+# server dispatch layer converts to a clean retryable -TRYAGAIN and the
+# lane's fault ledger counts toward quarantine.  0 = off, the historical
+# unbounded-wait shape, bit-identical replies.
+
+_lane_watchdog_s = 0.0
+
+
+def lane_watchdog_ms() -> int:
+    return int(_lane_watchdog_s * 1000)
+
+
+def set_lane_watchdog_ms(ms: int) -> int:
+    """Arm/disarm the readback lane watchdog (0 = off); returns the
+    previous value in ms (callers restore it — the A/B discipline)."""
+    global _lane_watchdog_s
+    prev = int(_lane_watchdog_s * 1000)
+    _lane_watchdog_s = max(0, int(ms)) / 1000.0
+    return prev
+
+
+# consecutive device faults/timeouts that flip a lane to QUARANTINED
+_quarantine_after = 3
+
+
+def quarantine_after() -> int:
+    return _quarantine_after
+
+
+def set_quarantine_after(n: int) -> int:
+    """Set the consecutive-fault quarantine threshold; returns the
+    previous value."""
+    global _quarantine_after
+    prev = _quarantine_after
+    _quarantine_after = max(1, int(n))
+    return prev
+
+
+class LaneWatchdogTimeout(RuntimeError):
+    """A device readback exceeded the armed lane-watchdog bound — the
+    frame fails retryably (-TRYAGAIN) instead of wedging its writer."""
+
+
+def is_retryable_device_fault(e: BaseException) -> bool:
+    """Device-layer failure shapes the server dispatch layer converts to a
+    clean retryable ``-TRYAGAIN``: the lane-watchdog timeout and the
+    XlaRuntimeError transient-runtime prefixes (a failed kernel launch, a
+    preempted/unavailable device).  Matched on the message, never the
+    class, so the chaos plane's RuntimeError fallback rides the same path.
+    RESOURCE_EXHAUSTED is deliberately NOT here — HBM exhaustion takes the
+    -OOM degradation path (services/vector.DeviceOomError)."""
+    if isinstance(e, LaneWatchdogTimeout):
+        return True
+    if not isinstance(e, RuntimeError):
+        return False
+    return str(e).lstrip().startswith(
+        ("INTERNAL", "UNAVAILABLE", "ABORTED", "CANCELLED",
+         "DEADLINE_EXCEEDED")
+    )
 
 
 # which lane stream the CURRENT THREAD's dispatch occupies ("interactive"
@@ -421,7 +496,85 @@ class ReadbackFuture:
         self._done = True
         self._device = ()  # release device memory references
 
+    def _chaos_stall(self, plane, dev_ids, was_ready: bool) -> bool:
+        """Apply an injected hung-transfer stall (device_hang).  With the
+        watchdog armed a stall past the bound waits only the bound and
+        trips; otherwise the transfer just takes `stall` seconds — the
+        pre-watchdog shape, bounded so tests terminate.  Returns the
+        (possibly demoted) was_ready flag."""
+        stall = 0.0
+        for d in dev_ids:
+            s = plane.on_device_readback(d)
+            if s > stall:
+                stall = s
+        if stall <= 0.0:
+            return was_ready
+        bound = _lane_watchdog_s
+        if bound > 0.0 and stall > bound:
+            time.sleep(bound)
+            self._trip(dev_ids, bound)
+        else:
+            time.sleep(stall)
+        return False
+
+    def _wait_ready(self, bound: float) -> bool:
+        """Bounded poll for device materialization (the armed watchdog's
+        wait): True when every value is ready within `bound` seconds."""
+        deadline = time.monotonic() + bound
+        while not all(_is_ready(v) for v in self._device):
+            left = deadline - time.monotonic()
+            if left <= 0.0:
+                return False
+            time.sleep(min(0.002, left))
+        return True
+
+    def _trip(self, dev_ids, wall: float) -> None:
+        """The watchdog fired: account the (bounded) wait, attribute a
+        timeout fault to every involved lane, and fail this future with
+        `LaneWatchdogTimeout` — retryable, never a wedged writer."""
+        STATS.add_readback(wall, False)
+        for d in dev_ids:
+            device_stats(d).add_readback(wall, False)
+            note_device_fault(d, "watchdog_timeout")
+        if _obs._tracer is not None:
+            cur = _obs.current_trace()
+            if cur is not None:
+                now = time.monotonic()
+                cur.add_span(
+                    "readback", now - wall, now,
+                    blocking=1, grouped=0, timeout=1,
+                )
+        devs = ", ".join(str(d) for d in sorted(dev_ids)) or "?"
+        self._error = LaneWatchdogTimeout(
+            f"readback exceeded lane-watchdog bound "
+            f"({lane_watchdog_ms()}ms) on device(s) {devs}"
+        )
+        self._done = True
+        self._device = ()
+
+    def _guard(self, plane, bound: float) -> None:
+        """Armed-only detection gate shared by ``result()`` and
+        ``force_all``: applies any injected hung-transfer stall, then
+        enforces the lane-watchdog bound on the device wait.  Never called
+        on the disarmed path (no plane, watchdog off)."""
+        was_ready = all(_is_ready(v) for v in self._device)
+        dev_ids = {
+            d for d in (_device_id_of(v) for v in self._device)
+            if d is not None
+        }
+        t0 = time.perf_counter()
+        if plane is not None:
+            was_ready = self._chaos_stall(plane, dev_ids, was_ready)
+        if (not self._done and bound > 0.0 and not was_ready
+                and not self._wait_ready(bound)):
+            self._trip(dev_ids, time.perf_counter() - t0)
+
     def result(self):
+        if not self._done:
+            plane = _net._fault_plane
+            bound = _lane_watchdog_s
+            if plane is not None or bound > 0.0:
+                self._guard(plane, bound)
         if not self._done:
             was_ready = all(_is_ready(v) for v in self._device)
             dev_ids = {
@@ -433,6 +586,8 @@ class ReadbackFuture:
                 host = tuple(np.asarray(v) for v in self._device)
             except BaseException as e:  # noqa: BLE001
                 STATS.add_readback(time.perf_counter() - t0, was_ready)
+                for dev_id in dev_ids:
+                    note_device_fault(dev_id, "readback_error")
                 self._error = e
                 self._done = True
                 self._device = ()
@@ -441,6 +596,7 @@ class ReadbackFuture:
                 STATS.add_readback(wall, was_ready)
                 for dev_id in dev_ids:  # per-lane sync ledger (ISSUE 8)
                     device_stats(dev_id).add_readback(wall, was_ready)
+                    note_device_ok(dev_id)
                 if _obs._tracer is not None:
                     cur = _obs.current_trace()
                     if cur is not None:
@@ -477,6 +633,43 @@ def _gather_pool():
                 max_workers=8, thread_name_prefix="rtpu-d2h"
             )
         return _GATHER_POOL
+
+
+def _readback_guard(dev_id: Optional[int], parts: Sequence[Any]) -> None:
+    """Armed-only readback gate for the grouped per-device fetch (the
+    serving path's ONE transfer per device): applies any injected
+    hung-transfer stall and enforces the lane-watchdog bound before the
+    blocking transfer starts.  Raises ``LaneWatchdogTimeout`` (retryable)
+    with the fault attributed to the lane.  Disarmed cost: one global
+    load + one float compare, then return."""
+    plane = _net._fault_plane
+    bound = _lane_watchdog_s
+    if (plane is None and bound <= 0.0) or dev_id is None:
+        return
+    stall = 0.0
+    if plane is not None:
+        stall = plane.on_device_readback(dev_id)
+    if stall > 0.0:
+        if bound > 0.0 and stall > bound:
+            time.sleep(bound)
+            note_device_fault(dev_id, "watchdog_timeout")
+            raise LaneWatchdogTimeout(
+                f"readback exceeded lane-watchdog bound "
+                f"({lane_watchdog_ms()}ms) on device(s) {dev_id}"
+            )
+        time.sleep(stall)
+        return
+    if bound > 0.0:
+        deadline = time.monotonic() + bound
+        while not all(_is_ready(p) for p in parts):
+            left = deadline - time.monotonic()
+            if left <= 0.0:
+                note_device_fault(dev_id, "watchdog_timeout")
+                raise LaneWatchdogTimeout(
+                    f"readback exceeded lane-watchdog bound "
+                    f"({lane_watchdog_ms()}ms) on device(s) {dev_id}"
+                )
+            time.sleep(min(0.002, left))
 
 
 def gather_device_results(groups: Sequence[Sequence[Any]]) -> List[tuple]:
@@ -527,6 +720,7 @@ def gather_device_results(groups: Sequence[Sequence[Any]]) -> List[tuple]:
 
     def fetch_bucket(dev_id, fis) -> None:
         parts = [flat[fi][0] for fi in fis]
+        _readback_guard(dev_id, parts)
         sizes = [int(p.shape[0]) for p in parts]
         STATS.count_sync()
         if dev_id is not None:
@@ -636,6 +830,19 @@ def force_all(futures: Sequence[ReadbackFuture]) -> None:
     todo = [f for f in futures if not f.done()]
     if not todo:
         return
+    # the SAME detection gate result() applies: injected hung-transfer
+    # stalls land here too, and the armed lane watchdog bounds the grouped
+    # drain — a wedged device fails its futures with LaneWatchdogTimeout
+    # instead of wedging the whole reply frame.  Disarmed cost: one global
+    # load + one float compare.
+    plane = _net._fault_plane
+    bound = _lane_watchdog_s
+    if plane is not None or bound > 0.0:
+        for f in todo:
+            f._guard(plane, bound)
+        todo = [f for f in todo if not f.done()]  # tripped: error delivered
+        if not todo:
+            return
     try:
         host_groups = gather_device_results([f._device for f in todo])
     except Exception:  # noqa: BLE001 — grouped path failed; force singly
@@ -973,6 +1180,41 @@ def replica_occupancy() -> Optional[float]:
     return _replica_ns_per_item
 
 
+# every live LaneSet, weakly held: device-layer faults observed where no
+# lane reference exists (ReadbackFuture) are attributed through here
+_LANE_SETS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def note_device_fault(dev_id: int, kind: str) -> bool:
+    """Attribute one device fault to every registered lane for `dev_id`;
+    returns True when any lane newly flipped to QUARANTINED."""
+    tripped = False
+    for ls in list(_LANE_SETS):
+        lane = ls._lanes.get(dev_id)
+        if lane is not None and lane.note_fault(kind):
+            tripped = True
+    return tripped
+
+
+def note_device_ok(dev_id: int) -> None:
+    """A readback on `dev_id` completed cleanly: reset its lanes'
+    consecutive-fault streaks (quarantine itself clears only via probe)."""
+    for ls in list(_LANE_SETS):
+        lane = ls._lanes.get(dev_id)
+        if lane is not None:
+            lane.note_ok()
+
+
+def quarantined_device_ids() -> set:
+    """Device ids currently quarantined on ANY registered lane set."""
+    out = set()
+    for ls in list(_LANE_SETS):
+        for dev_id, lane in ls._lanes.items():
+            if lane.quarantined:
+                out.add(dev_id)
+    return out
+
+
 class DeviceLane:
     """One device's serving lane: staging pool + flush pipeline + stats +
     the dispatch-occupancy gate (a mutex standing in for the device stream:
@@ -1003,6 +1245,43 @@ class DeviceLane:
         self._iwaiting = 0  # interactive dispatches queued or in flight
         self.dispatches = 0
         self.preemptions = 0  # preempt points that actually yielded
+        # device fault ledger (ISSUE 19): consecutive faults/timeouts trip
+        # quarantine; a successful readback resets the streak, a probe
+        # dispatch (server CLUSTER DEVPROBE) un-quarantines
+        self.consec_faults = 0
+        self.total_faults = 0
+        self.quarantined = False
+        self.quarantined_at = 0.0
+        self.last_fault_kind = ""
+
+    def note_fault(self, kind: str) -> bool:
+        """Record one device-layer fault (kernel launch failure, readback
+        timeout/error).  Trips QUARANTINED at the consecutive threshold;
+        returns True when THIS call flipped the lane."""
+        self.total_faults += 1
+        self.consec_faults += 1
+        self.last_fault_kind = kind
+        if not self.quarantined and self.consec_faults >= _quarantine_after:
+            self.quarantined = True
+            self.quarantined_at = time.monotonic()
+            if _obs._tracer is not None:
+                cur = _obs.current_trace()
+                if cur is not None:
+                    now = time.monotonic()
+                    cur.add_span("quarantine", now, now, device=self.dev_id)
+            return True
+        return False
+
+    def note_ok(self) -> None:
+        """A device operation completed cleanly: the consecutive-fault
+        streak (NOT the quarantine flag — only a probe clears that) resets."""
+        if self.consec_faults:
+            self.consec_faults = 0
+
+    def unquarantine(self) -> None:
+        """Clear quarantine (the probe-passed path)."""
+        self.quarantined = False
+        self.consec_faults = 0
 
     def occupy(self, n_items: int = 0, qos_class: Optional[str] = None,
                nbytes: int = 0):
@@ -1089,6 +1368,16 @@ class _LaneOccupancy:
         self._prev_stream = None
 
     def __enter__(self):
+        # device dispatch chokepoint (ISSUE 19): consulted BEFORE any
+        # ledger entry so an injected kernel-launch failure unwinds with
+        # nothing to undo — __exit__ never runs when __enter__ raises
+        plane = _net._fault_plane
+        if plane is not None:
+            try:
+                plane.on_device_dispatch(self._lane.dev_id)
+            except BaseException:
+                self._lane.note_fault("kernel_launch")
+                raise
         if self._cls is not None:
             self._lane.qos.enter(self._cls, self._n, self._nbytes)
         self._lane.qos.stream_enter(self._stream, self._n)
@@ -1154,6 +1443,9 @@ class LaneSet:
         self._lock = threading.Lock()
         self._active = 0
         self.peak_concurrent = 0
+        # fault attribution registry (ISSUE 19): ReadbackFuture holds no
+        # lane reference, so watchdog trips reach lanes through here
+        _LANE_SETS.add(self)
 
     def lane(self, device) -> DeviceLane:
         dev_id = device if isinstance(device, int) else getattr(device, "id", 0)
@@ -1195,6 +1487,10 @@ class LaneSet:
             out[f"lane{dev_id}_staging_slots"] = lane.pool.slot_count()
             out[f"lane{dev_id}_istaging_slots"] = lane.ipool.slot_count()
             out[f"lane{dev_id}_iwaiting"] = lane.interactive_waiting()
+            # quarantine state (ISSUE 19): both must return to 0 after a
+            # fault storm recovers (probe passed / evacuation complete)
+            out[f"lane{dev_id}_quarantined"] = int(lane.quarantined)
+            out[f"lane{dev_id}_consec_faults"] = lane.consec_faults
             # per-lane QoS in-flight (ISSUE 10): must drain to 0 at quiesce
             for k, v in lane.qos.census(prefix=f"lane{dev_id}_qos").items():
                 out[k] = v

@@ -192,6 +192,17 @@ class DeviceStore:
             return None
         return max(0.0, rec.expire_at - time.time())
 
+    def census_records(self):
+        """Non-expired ``(kind, record)`` pairs in one consistent snapshot —
+        the residency-ledger scan (server ``_device_bytes_census``): callers
+        read each record's arrays WITHOUT the store lock, so a gauge scrape
+        never serializes against the write path."""
+        with self._lock:
+            return [
+                (r.kind, r) for r in list(self._states.values())
+                if not r.expired()
+            ]
+
     def keys(self, pattern: Optional[str] = None):
         """SCAN/KEYS analog (RedissonKeys.java:545 surface)."""
         import fnmatch

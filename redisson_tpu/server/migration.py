@@ -730,6 +730,60 @@ def resume_device_rebalances(engine, journal_dir: str) -> List[Dict[str, Any]]:
     return out
 
 
+def evacuation_plan(placement, dev_index: int) -> Dict[int, int]:
+    """Target owners for every slot of ``dev_index``: round-robin over the
+    surviving devices (every other device whose lane is not itself
+    quarantined).  The quarantine-and-evacuate half of the device fault
+    domain (ISSUE 19) — the plan feeds :func:`rebalance_devices` unchanged,
+    so an evacuation IS a journaled, kill-at-every-phase-resumable device
+    rebalance with zero new migration machinery."""
+    from redisson_tpu.core.ioplane import quarantined_device_ids
+
+    if not 0 <= dev_index < placement.n_devices:
+        raise ValueError(f"device index {dev_index} outside placement")
+    bad = quarantined_device_ids()
+    survivors = [
+        i for i, d in enumerate(placement.devices)
+        if i != dev_index and getattr(d, "id", i) not in bad
+    ]
+    if not survivors:
+        raise ValueError(
+            f"no surviving devices to evacuate device {dev_index} onto"
+        )
+    owner = placement.owner_snapshot()
+    slots = (owner == dev_index).nonzero()[0]
+    return {
+        int(s): survivors[j % len(survivors)]
+        for j, s in enumerate(slots)
+    }
+
+
+def evacuate_device(engine, dev_index: int,
+                    journal_dir: Optional[str] = None,
+                    crash_after: Optional[str] = None):
+    """Quarantine-and-evacuate driver (ISSUE 19): compute the surviving-
+    device plan for ``dev_index`` and run it through the journaled device
+    rebalance.  Returns ``(records_moved, targets, epoch)``; epoch is None
+    when unjournaled or when the device owned no slots (nothing ran).
+    Keyed traffic on the moving slots rides the existing TRYAGAIN fence;
+    a crashed coordinator resumes via :func:`resume_device_rebalances`."""
+    placement = engine.placement
+    if placement is None:
+        raise RuntimeError("placement is not enabled on this engine")
+    targets = evacuation_plan(placement, dev_index)
+    if not targets:
+        return 0, targets, None
+    moved = rebalance_devices(
+        engine, targets, journal_dir=journal_dir, crash_after=crash_after
+    )
+    epoch = None
+    if journal_dir is not None:
+        # every target slot was fenced at the journal's epoch before any
+        # bank moved — read it back off the placement for the reply
+        epoch = placement.epoch_of(next(iter(targets)))
+    return moved, targets, epoch
+
+
 class _DeviceRebalanceRun:
     """One device rebalance as a journaled state machine (the
     ``_MigrationRun`` shape without a wire)."""

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from redisson_tpu.net import client as _net
 from redisson_tpu.net.resp import Push, RespError
 from redisson_tpu.observe import trace as _obs
 from redisson_tpu.utils.metrics import run_hooks_end, run_hooks_start
@@ -177,6 +178,14 @@ class Registry:
         if ctx.multi_queue is not None and cmd not in self._TX_IMMEDIATE:
             ctx.multi_queue.append([bytes(a) for a in args])
             return "+QUEUED"
+        # device-dispatch chokepoint (ISSUE 19): with the chaos plane armed
+        # a command routed to a faulted device fails HERE, with the same
+        # XlaRuntimeError shape a real kernel launch raises, BEFORE the
+        # handler applies anything.  Disarmed cost: one global load + an
+        # `is None` branch (device resolution runs only when armed).
+        plane = _net._fault_plane
+        if plane is not None:
+            _consult_device_dispatch(plane, server, args)
         # client-tracking hooks (tracking/table.py): `active` is an int load
         # + compare, so a server with no tracking clients pays ~nothing.
         # Reads register PRE-dispatch (a concurrent writer must see the
@@ -223,6 +232,33 @@ class Registry:
         if track is not None:
             track.post_dispatch(ctx, cmd, args[1:])
         return result
+
+
+def _consult_device_dispatch(plane, server, args) -> None:
+    """Armed-only slow path: resolve the command's owning device (the
+    single-device whitelisted verbs of SlotPlacement) and consult the chaos
+    plane's per-device dispatch stream.  A raised fault is attributed to
+    the lane's quarantine ledger before it surfaces."""
+    eng = getattr(server, "engine", None)
+    placement = getattr(eng, "placement", None)
+    if placement is None:
+        return
+    try:
+        dev_index = placement.device_index_for_command(
+            [bytes(a) for a in args]
+        )
+    except Exception:  # noqa: BLE001 — unroutable: not a device command
+        return
+    if dev_index is None:
+        return
+    dev_id = getattr(placement.devices[dev_index], "id", dev_index)
+    try:
+        plane.on_device_dispatch(dev_id)
+    except BaseException:
+        from redisson_tpu.core import ioplane as _iop
+
+        _iop.note_device_fault(dev_id, "kernel_launch")
+        raise
 
 
 REGISTRY = Registry()

@@ -168,13 +168,18 @@ class TokenBucket:
 
 
 class TenantState:
-    __slots__ = ("bucket", "admitted_ops", "shed_ops", "shed_frames")
+    __slots__ = ("bucket", "admitted_ops", "shed_ops", "shed_frames",
+                 "weight")
 
-    def __init__(self, bucket: TokenBucket):
+    def __init__(self, bucket: TokenBucket, weight: float = 1.0):
         self.bucket = bucket
         self.admitted_ops = 0
         self.shed_ops = 0
         self.shed_frames = 0
+        # service-class weight (ISSUE 19 satellite): gold=2.0/silver=1.0
+        # style multiplier the fleet rebalance loop scales this tenant's
+        # GLOBAL rate by; 1.0 (default) reproduces unweighted behavior
+        self.weight = float(weight)
 
 
 # -- admission -----------------------------------------------------------------
@@ -372,6 +377,28 @@ class WindowScheduler:
             if b.tokens > b.burst:
                 b.tokens = b.burst
 
+    def set_tenant_weight(self, tenant: str, weight: float) -> None:
+        """Per-tenant service-class weight (``CLUSTER QOS REBALANCE ...
+        WEIGHT``).  Orthogonal to the bucket: the weight only changes how
+        the FLEET loop sizes this tenant's global rate, so setting it never
+        re-mints or retargets tokens (the token-preserving contract of
+        set_tenant_rate is untouched)."""
+        if weight <= 0:
+            raise ValueError("tenant weight must be positive")
+        with self._lock:
+            ts = self._tenants.get(tenant)
+            if ts is None:
+                ts = TenantState(
+                    TokenBucket(self.tenant_rate, self.tenant_burst)
+                )
+                self._tenants[tenant] = ts
+            ts.weight = float(weight)
+
+    def tenant_weight(self, tenant: str) -> float:
+        with self._lock:
+            ts = self._tenants.get(tenant)
+            return ts.weight if ts is not None else 1.0
+
     # -- classification -------------------------------------------------------
 
     def classify(self, ctx, commands: Sequence) -> Tuple[str, List[int], int]:
@@ -453,15 +480,17 @@ class WindowScheduler:
         out["qos_shed_frames_total"] = float(self.shed_frames)
         return out
 
-    def tenant_table(self, now: Optional[float] = None) -> List[Tuple[str, float, int, int, int]]:
-        """[(tenant, bucket_level, admitted_ops, shed_ops, shed_frames)] —
-        the CLUSTER QOS wire view."""
+    def tenant_table(
+        self, now: Optional[float] = None,
+    ) -> List[Tuple[str, float, int, int, int, float]]:
+        """[(tenant, bucket_level, admitted_ops, shed_ops, shed_frames,
+        weight)] — the CLUSTER QOS wire view."""
         if now is None:
             now = time.monotonic()
         with self._lock:
             return [
                 (name, ts.bucket.level(now), ts.admitted_ops,
-                 ts.shed_ops, ts.shed_frames)
+                 ts.shed_ops, ts.shed_frames, ts.weight)
                 for name, ts in sorted(self._tenants.items())
             ]
 

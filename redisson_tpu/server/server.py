@@ -92,6 +92,15 @@ class _TracedEncoded:
         self.trace = trace
 
 
+# fixed -TRYAGAIN texts (ISSUE 19): byte-identical whichever layer detects
+# the fault and whether the chaos plane is armed or not
+_DEVICE_FAULT_TRYAGAIN = "TRYAGAIN device fault during dispatch; retry"
+
+
+def _quarantined_tryagain(dev_id: int) -> str:
+    return f"TRYAGAIN device {dev_id} quarantined; retry after evacuation"
+
+
 def _force_lazies(results: list, server, trace=None) -> None:
     """Materialize every LazyReply of a frame in place.  Device-form lazies
     are fetched with one concatenated transfer per dtype (the whole frame
@@ -107,6 +116,11 @@ def _force_lazies(results: list, server, trace=None) -> None:
         server.stats["errors"] += 1
         if isinstance(e, RespError):
             results[i] = _Encoded(resp.encode_error(str(e.args[0])))
+        elif ioplane.is_retryable_device_fault(e):
+            # watchdog timeout / kernel-launch failure surfacing at force
+            # time: a clean retryable -TRYAGAIN, never a wedged writer or
+            # an opaque internal error (ISSUE 19)
+            results[i] = _Encoded(resp.encode_error(_DEVICE_FAULT_TRYAGAIN))
         else:
             results[i] = _Encoded(
                 resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
@@ -120,6 +134,14 @@ def _force_lazies(results: list, server, trace=None) -> None:
         if dev_idx:
             try:
                 host_vals = gather_lazy_device_results([results[i] for i in dev_idx])
+            except ioplane.LaneWatchdogTimeout as e:
+                # the grouped drain tripped the armed lane watchdog: the
+                # frame's device-form lazies rode ONE hung transfer — fail
+                # them all retryable instead of re-forcing through the
+                # same wedged device one by one
+                for i in dev_idx:
+                    fail(i, e)
+                dev_idx, host_vals = [], None
             except Exception:  # noqa: BLE001 — grouped path failed; force singly
                 host_vals = None
             if host_vals is not None:
@@ -414,6 +436,10 @@ class TpuServer:
         # and per-row scalar gauges would re-run that walk once per row
         # per scrape.
         self.metrics.multi_gauge("ftvec", self._ftvec_census)
+        # per-device residency over ALL record kinds (ISSUE 19 satellite):
+        # record_bytes_dev<N>[_<kind>] rows from one store scan per scrape —
+        # same one-family discipline as ftvec, rows vanish with the bytes
+        self.metrics.multi_gauge("devbytes", self._device_bytes_census)
         # OBJCALL handle cache (ordered for LRU eviction; see registry)
         from collections import OrderedDict
 
@@ -469,6 +495,9 @@ class TpuServer:
                 if self.engine.placement is not None else 0
             ),
             "dispatch-ahead": self.readback_ahead,
+            # device fault domain (ISSUE 19): lane watchdog + quarantine
+            "lane-watchdog-ms": ioplane.lane_watchdog_ms(),
+            "lane-quarantine-after": ioplane.quarantine_after(),
             # tracing plane (ISSUE 12): arming + ring/slowlog knobs
             "trace-enabled": int(_obs.tracing_enabled()),
             "trace-ring-capacity": self.tracer.ring_capacity,
@@ -511,6 +540,22 @@ class TpuServer:
             # connections opened from now on size their per-connection
             # dispatch-ahead semaphore with this (see _handle)
             self.readback_ahead = n
+            return True
+        if key == "lane-watchdog-ms":
+            # bounded readback wait (ISSUE 19): 0 disarms — the historical
+            # unbounded-wait shape, bit-identical replies
+            n = int(value)
+            if n < 0:
+                return False
+            ioplane.set_lane_watchdog_ms(n)
+            return True
+        if key == "lane-quarantine-after":
+            # consecutive device faults/timeouts that flip a lane to
+            # QUARANTINED (CLUSTER DEVICES shows the state)
+            n = int(value)
+            if n <= 0:
+                return False
+            ioplane.set_quarantine_after(n)
             return True
         if key == "trace-enabled":
             # arm/disarm the per-frame tracing plane live (the chaos-hook
@@ -1097,7 +1142,14 @@ class TpuServer:
             if is_add:
                 self._fused_add_error_invalidate(track, run_names)
                 self.stats["errors"] += len(cmds)
-                enc = resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
+                # a device fault mid-run replies retryably (-TRYAGAIN);
+                # the possibly-applied run is NEVER re-dispatched here —
+                # at-most-once is the client's to spend (ISSUE 19)
+                enc = resp.encode_error(
+                    _DEVICE_FAULT_TRYAGAIN
+                    if ioplane.is_retryable_device_fault(e)
+                    else f"ERR internal: {type(e).__name__}: {e}"
+                )
                 return [_Encoded(enc) for _ in cmds]
             fused = None
         except Exception as e:  # noqa: BLE001 — per-run isolation
@@ -1163,6 +1215,42 @@ class TpuServer:
         except Exception:  # noqa: BLE001 — a broken gauge must not kill scrape
             return zeros
 
+    def _device_bytes_census(self) -> dict:
+        """Per-device HBM residency over EVERY record kind (ISSUE 19
+        satellite — the generalization of the ftvec_*_bytes_dev ledger):
+        one store scan summing each record's committed device arrays by
+        (device, kind).  Rows — ``record_bytes_dev<N>`` totals plus
+        ``record_bytes_dev<N>_<kind>`` breakdowns — exist only while that
+        device holds bytes, so DEL / FT.DROPINDEX drains them to absence
+        == zero (the soak's flat-census assertion)."""
+        from redisson_tpu.core.ioplane import _device_id_of
+
+        by_dev: dict = {}
+        by_kind: dict = {}
+        try:
+            records = self.engine.store.census_records()
+        except Exception:  # noqa: BLE001 — a broken gauge must not kill scrape
+            return {}
+        for kind, rec in records:
+            arrays = getattr(rec, "arrays", None)
+            if not arrays:
+                continue
+            for arr in list(arrays.values()):
+                d = _device_id_of(arr)
+                if d is None:
+                    continue
+                n = float(getattr(arr, "nbytes", 0) or 0)
+                if n <= 0.0:
+                    continue
+                by_dev[d] = by_dev.get(d, 0.0) + n
+                by_kind[(d, kind)] = by_kind.get((d, kind), 0.0) + n
+        out: dict = {}
+        for d, v in sorted(by_dev.items()):
+            out[f"record_bytes_dev{d}"] = v
+        for (d, kind), v in sorted(by_kind.items()):
+            out[f"record_bytes_dev{d}_{kind}"] = v
+        return out
+
     @staticmethod
     def _estimate_device_items(cmds) -> int:
         """Rough op count a command list dispatches to one device — the
@@ -1184,6 +1272,11 @@ class TpuServer:
         lane = self._lane_for(cmds)
         if lane is None:
             return None
+        if lane.quarantined:
+            # a QUARANTINED lane rejects new keyed work retryably while its
+            # slots evacuate / await a probe — never a dispatch into a
+            # faulted device stream (ISSUE 19)
+            raise RespError(_quarantined_tryagain(lane.dev_id))
         return lane.occupy(
             self._estimate_device_items(cmds), qos_class=qos_class,
             nbytes=_sched._frame_nbytes(cmds) if qos_class is not None else 0,
@@ -1260,6 +1353,14 @@ class TpuServer:
             _obs.set_current(trace)
         try:
             lane = self._lane_for(cmds)
+            if lane is not None and lane.quarantined:
+                # per-command retryable rejection (ISSUE 19): the run was
+                # never dispatched, so at-most-once is trivially preserved
+                self.stats["errors"] += len(cmds)
+                enc = _Encoded(
+                    resp.encode_error(_quarantined_tryagain(lane.dev_id))
+                )
+                return [enc for _ in cmds]
             if lane is None:
                 if trace is not None:
                     t0 = time.monotonic()
@@ -1336,6 +1437,10 @@ class TpuServer:
             if "shutdown" in str(e):
                 raise ConnectionResetError(str(e)) from e
             self.stats["errors"] += 1
+            if ioplane.is_retryable_device_fault(e):
+                # device-layer fault (kernel launch, watchdog timeout):
+                # clean retryable -TRYAGAIN, connection survives (ISSUE 19)
+                return _Encoded(resp.encode_error(_DEVICE_FAULT_TRYAGAIN))
             return _Encoded(
                 resp.encode_error(f"ERR internal: {type(e).__name__}: {e}")
             )
@@ -1369,6 +1474,14 @@ class TpuServer:
             eng.lanes.lane(eng.placement.devices[dev_index])
             if eng.lanes is not None else None
         )
+        if lane is not None and lane.quarantined:
+            # the whole bucket rejects retryably in frame position —
+            # the other devices' buckets still serve (ISSUE 19)
+            self.stats["errors"] += len(items)
+            enc = _Encoded(
+                resp.encode_error(_quarantined_tryagain(lane.dev_id))
+            )
+            return [(i, enc) for i, _c in items]
         cmds = [c for _i, c in items]
         out = []
         run_at: Dict[int, int] = (
@@ -1774,9 +1887,11 @@ class TpuServer:
                 # the connection (dropping it would kill every other
                 # pipelined command on this socket)
                 self.stats["errors"] += 1
-                results.append(
-                    _Encoded(resp.encode_error(f"ERR internal: {type(e).__name__}: {e}"))
-                )
+                results.append(_Encoded(resp.encode_error(
+                    _DEVICE_FAULT_TRYAGAIN
+                    if ioplane.is_retryable_device_fault(e)
+                    else f"ERR internal: {type(e).__name__}: {e}"
+                )))
             except Exception as e:  # noqa: BLE001 — sandbox handler bugs per-command
                 self.stats["errors"] += 1
                 results.append(

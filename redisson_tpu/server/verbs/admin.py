@@ -209,7 +209,16 @@ def cmd_cluster(server, ctx, args):
         for i, d in enumerate(p.devices):
             row = [getattr(d, "id", i), counts[i], str(d).encode()]
             if lanes is not None:
-                row.append([b"QOS"] + lanes.lane(d).qos.wire_row())
+                lane = lanes.lane(d)
+                row.append([b"QOS"] + lane.qos.wire_row())
+                # device fault ledger (ISSUE 19) — appended AFTER the QOS
+                # row, same discipline: pre-fault consumers indexing
+                # row[0..3] keep working.  [FAULTS, quarantined,
+                # consec_faults, total_faults, last_fault_kind]
+                row.append([
+                    b"FAULTS", int(lane.quarantined), lane.consec_faults,
+                    lane.total_faults, lane.last_fault_kind.encode(),
+                ])
             out.append(row)
         return out
     if sub == b"QOS":
@@ -222,14 +231,29 @@ def cmd_cluster(server, ctx, args):
         if len(args) > 1 and bytes(args[1]).upper() == b"REBALANCE":
             if len(args) < 4:
                 raise RespError(
-                    "ERR CLUSTER QOS REBALANCE <tenant> <rate> [<burst>]"
+                    "ERR CLUSTER QOS REBALANCE <tenant> <rate> [<burst>] "
+                    "[WEIGHT <w>]"
                 )
-            tenant = _s(args[2])
+            # WEIGHT <w> (ISSUE 19 satellite): the tenant's service-class
+            # weight (gold=2.0 / silver=1.0 style) — stored on the bucket
+            # state, consumed by the supervisor's demand split; the rate
+            # retarget itself stays token-preserving regardless.
+            rest = list(args[2:])
+            weight = None
+            if len(rest) >= 2 and bytes(rest[-2]).upper() == b"WEIGHT":
+                try:
+                    weight = float(rest[-1])
+                except ValueError:
+                    raise RespError("ERR value is not a valid float") from None
+                rest = rest[:-2]
+            tenant = _s(rest[0]) if rest else ""
             try:
-                rate = float(args[3])
-                burst = float(args[4]) if len(args) > 4 else None
-            except ValueError:
+                rate = float(rest[1])
+                burst = float(rest[2]) if len(rest) > 2 else None
+            except (IndexError, ValueError):
                 raise RespError("ERR value is not a valid float") from None
+            if weight is not None:
+                server.scheduler.set_tenant_weight(tenant, weight)
             server.scheduler.set_tenant_rate(tenant, rate, burst)
             return b"OK"
         # global window-scheduler state (ISSUE 10): armed flag, shed
@@ -262,10 +286,14 @@ def cmd_cluster(server, ctx, args):
             for name in (b"interactive", b"bulk"):
                 if name in agg:
                     out.append([b"STREAM", name] + agg[name])
-        for name, level, admitted, shed_ops, shed_frames in sched.tenant_table():
+        for name, level, admitted, shed_ops, shed_frames, weight \
+                in sched.tenant_table():
+            # weight rides as a trailing element (ISSUE 19 satellite):
+            # parse_tenant_table's len>=6 contract tolerates — and now
+            # surfaces — it, so pre-weight consumers keep working.
             out.append([
                 b"TENANT", name.encode(), int(level), admitted,
-                shed_ops, shed_frames,
+                shed_ops, shed_frames, f"{weight:g}".encode(),
             ])
         return out
     if sub == b"DEVMOVE":
@@ -294,6 +322,36 @@ def cmd_cluster(server, ctx, args):
         except ValueError as e:
             raise RespError(f"ERR {e}")
         return moved
+    if sub == b"DEVPROBE":
+        # DEVPROBE <dev_index> (ISSUE 19) — one REAL tiny dispatch+readback
+        # through the device's lane; both chaos chokepoints (occupancy
+        # enter, readback) consult, so a still-faulted device stays
+        # quarantined while a clean pass un-quarantines it.
+        # Reply: [passed, quarantined] — tooling polls this for recovery.
+        return _dev_probe(server, _int(args[1]))
+    if sub == b"DEVEVACUATE":
+        # DEVEVACUATE <dev_index> [DIR <journal_dir>] (ISSUE 19) — evacuate
+        # every slot owned by <dev_index> onto the surviving non-quarantined
+        # devices through the journaled device rebalance (kill-at-every-
+        # phase resumable; keyed traffic on moving slots rides the existing
+        # TRYAGAIN fence).  Reply: [moved_records, evacuated_slots, epoch]
+        # (epoch -1 when unjournaled).
+        from redisson_tpu.server import migration as mig
+
+        if server.engine.placement is None:
+            raise RespError("ERR placement is not enabled on this server")
+        rest = list(args[1:])
+        dev_index = _int(rest[0])
+        journal_dir = None
+        if len(rest) >= 3 and bytes(rest[1]).upper() == b"DIR":
+            journal_dir = _s(rest[2])
+        try:
+            moved, targets, epoch = mig.evacuate_device(
+                server.engine, dev_index, journal_dir=journal_dir
+            )
+        except ValueError as e:
+            raise RespError(f"ERR {e}")
+        return [moved, len(targets), -1 if epoch is None else epoch]
     if sub == b"MIGRATESLOTS":
         # MIGRATESLOTS [EPOCH <n>] <slot>... — drain MANY migrating slots
         # in one store scan (the orchestrator's bulk form: a reshard of
@@ -309,6 +367,43 @@ def cmd_cluster(server, ctx, args):
             server.fence_slot_epoch(s, epoch)
         return server.migrate_slot_batch(slots)
     raise RespError("ERR unknown CLUSTER subcommand")
+
+
+def _dev_probe(server, dev_index: int):
+    """One end-to-end probe dispatch on a device's lane (ISSUE 19): occupy
+    the lane (the chaos kernel-launch chokepoint), run a trivial kernel on
+    the device, read it back through ``ReadbackFuture`` (the hung-transfer /
+    watchdog chokepoint).  Every fault path already attributes itself to the
+    lane's quarantine ledger, so a failed probe only reports — it never
+    double-counts.  A verified pass un-quarantines the lane."""
+    from redisson_tpu.core import ioplane
+
+    p = server.engine.placement
+    lanes = server.engine.lanes
+    if p is None or lanes is None:
+        raise RespError("ERR placement is not enabled on this server")
+    if not (0 <= dev_index < p.n_devices):
+        raise RespError(f"ERR device index {dev_index} outside placement")
+    device = p.devices[dev_index]
+    lane = lanes.lane(device)
+    try:
+        with lane.occupy(1):
+            import jax
+            import jax.numpy as jnp
+
+            val = jax.device_put(jnp.arange(8, dtype=jnp.int32), device) + 1
+        out = ioplane.ReadbackFuture((val,)).result()
+        import numpy as np
+
+        # result() unwraps a single-output future to the array itself
+        ok = int(np.asarray(out).sum()) == 36  # sum(1..8)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException:  # noqa: BLE001 — a failing probe is the answer
+        return [0, int(lane.quarantined)]
+    if ok:
+        lane.unquarantine()
+    return [1 if ok else 0, int(lane.quarantined)]
 
 
 @register("ASKING")

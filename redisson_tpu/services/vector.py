@@ -86,6 +86,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+# device chaos plane (ISSUE 19): the bank create/grow allocation chokepoint
+# consults the process-global fault plane net/client.py hosts — disarmed
+# cost is one global load + `is None` (the zero-alloc guard discipline)
+from redisson_tpu.net import client as _net
+from redisson_tpu.net.resp import RespError
+
 # -- global switch (same discipline as ioplane.set_overlap) -------------------
 
 _vector = os.environ.get("RTPU_NO_VECTOR", "") not in ("1", "true", "yes")
@@ -161,6 +167,32 @@ def set_device_bytes_budget(value: int) -> int:
 class VectorBudgetError(RuntimeError):
     """A bank flush would grow one device's bank past DEVICE_BYTES_BUDGET —
     the corpus needs SHARDS (or a compressed TYPE) to fit the mesh."""
+
+
+class DeviceOomError(RespError):
+    """A device allocation failed (HBM ``RESOURCE_EXHAUSTED``) growing a
+    bank.  Subclassing RespError makes every dispatch layer encode it as a
+    clean retryable ``-OOM`` reply instead of a dead connection; the FIXED
+    message keeps armed/disarmed (and RTPU_NO_NATIVE) replies
+    byte-identical.  The rows that triggered the growth are KEPT pending
+    (flush_pending restores them), so nothing acked is lost."""
+
+    def __init__(self, name: str):
+        super().__init__(
+            f"OOM device out of memory growing vector bank '{name}'; "
+            f"rows kept pending"
+        )
+
+
+def _is_resource_exhausted(e: BaseException) -> bool:
+    """The HBM-exhaustion shape real JAX raises: an ``XlaRuntimeError`` /
+    RuntimeError whose message leads with RESOURCE_EXHAUSTED.  Matched on
+    the message, never the class, so the chaos plane's RuntimeError
+    fallback exercises the same recovery path."""
+    return (
+        isinstance(e, RuntimeError)
+        and str(e).lstrip().startswith("RESOURCE_EXHAUSTED")
+    )
 
 _IVF_SENTINEL = np.int32(0x3FFFFFFF)  # padded cells entry: never a live row
 
@@ -521,29 +553,56 @@ class DeviceRowBank:
                     f"(SHARDS n) or compress its TYPE"
                 )
         device = self._target_device()
+        dev_id = getattr(device, "id", 0) if device is not None else 0
+        # device allocation chokepoint (ISSUE 19): the injected and the
+        # real RESOURCE_EXHAUSTED converge on ONE DeviceOomError below
+        plane = _net._fault_plane
+        if plane is not None:
+            try:
+                plane.on_device_alloc(
+                    dev_id, self._projected_device_bytes(new_cap)
+                )
+            except RuntimeError as e:
+                if _is_resource_exhausted(e):
+                    self._oom(dev_id, e)
+                raise
         jdt = {"FLOAT32": jnp.float32, "FLOAT16": jnp.float16,
                "INT8": jnp.int8}[self.dtype]
         ctx = jax.default_device(device) if device is not None else nullcontext()
-        with ctx:
-            grown = jnp.zeros((new_cap, self.pwidth), jdt)
-            gbias = jnp.zeros((new_cap,), jnp.float32)
-            gscale = (
-                jnp.ones((new_cap,), jnp.float32)
-                if self.dtype == "INT8" else None
-            )
-        if device is not None:
-            grown = jax.device_put(grown, device)
-            gbias = jax.device_put(gbias, device)
-            if gscale is not None:
-                gscale = jax.device_put(gscale, device)
-        bank, bias, scale = self._get_planes()
-        if bank is not None and self._cap > 0:
-            grown, gbias = K.rowbank_grow(bank, bias, grown, gbias)
-            if gscale is not None and scale is not None:
-                gscale = K.rowbank_grow_plane(scale, gscale)
-            self.grows += 1
+        try:
+            with ctx:
+                grown = jnp.zeros((new_cap, self.pwidth), jdt)
+                gbias = jnp.zeros((new_cap,), jnp.float32)
+                gscale = (
+                    jnp.ones((new_cap,), jnp.float32)
+                    if self.dtype == "INT8" else None
+                )
+            if device is not None:
+                grown = jax.device_put(grown, device)
+                gbias = jax.device_put(gbias, device)
+                if gscale is not None:
+                    gscale = jax.device_put(gscale, device)
+            bank, bias, scale = self._get_planes()
+            if bank is not None and self._cap > 0:
+                grown, gbias = K.rowbank_grow(bank, bias, grown, gbias)
+                if gscale is not None and scale is not None:
+                    gscale = K.rowbank_grow_plane(scale, gscale)
+                self.grows += 1
+        except RuntimeError as e:
+            if _is_resource_exhausted(e):
+                self._oom(dev_id, e)
+            raise
         self._set_planes(grown, gbias, gscale)
         self._cap = new_cap
+
+    def _oom(self, dev_id: int, cause: BaseException) -> None:
+        """HBM exhausted growing this bank: count the fault on the lane's
+        quarantine ledger and surface the one fixed ``-OOM`` reply shape
+        (never the raw XlaRuntimeError, never a dead connection)."""
+        from redisson_tpu.core import ioplane as _iop
+
+        _iop.note_device_fault(dev_id, "alloc_oom")
+        raise DeviceOomError(getattr(self, "name", "?")) from cause
 
     def _pack_items(self, buf: np.ndarray, items) -> None:
         """Fill the packed upload buffer: col 0 rowid, col 1 bias bits,
@@ -578,10 +637,11 @@ class DeviceRowBank:
             try:
                 with self._record_guard():
                     self._ensure_capacity_locked(self.rows)
-            except VectorBudgetError:
-                # over-budget growth refused: the rows stay PENDING (their
-                # mirror values are already installed), so nothing is lost
-                # and a raised budget / resharded index drains them later
+            except (VectorBudgetError, DeviceOomError):
+                # over-budget growth refused or HBM exhausted: the rows
+                # stay PENDING (their mirror values are already installed),
+                # so nothing is lost — a raised budget, a resharded index,
+                # or a post-evacuation retry drains them later
                 self._pending = pending
                 raise
             with self._record_guard():

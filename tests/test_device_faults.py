@@ -1,0 +1,475 @@
+"""Device fault domain (ISSUE 19): lane watchdogs, quarantine ledger,
+HBM-OOM graceful degradation and quarantine-and-evacuate.  The focused
+contracts the device-fault soak (chaos/soak.py) exercises under load:
+
+  * a quarantined device's evacuation IS a journaled device rebalance —
+    kill-at-every-phase resumable, banks bit-identical, stale coordinators
+    fenced out with STALEEPOCH (the fault-triggered property test);
+  * the quarantine ledger: consecutive faults trip at the threshold, ONE
+    clean readback resets the streak but never the flag, only a probe
+    un-quarantines;
+  * the armed lane watchdog bounds a hung readback (never a wedged writer)
+    and attributes the timeout to the lane — retryably;
+  * the wire surface: the CLUSTER DEVICES trailing FAULTS row, CLUSTER
+    DEVPROBE / DEVEVACUATE, the lane-watchdog-ms / lane-quarantine-after
+    CONFIG knobs, and the -TRYAGAIN reply on a quarantined device's keys;
+  * the per-device residency ledger (record_bytes_dev<N>[_<kind>], every
+    record kind) appears in METRICS while bytes are resident and drains to
+    ABSENCE on DEL;
+  * the degraded replies are part of the wire contract: -OOM and -TRYAGAIN
+    streams are byte-identical with the native wire plane and with
+    RTPU_NO_NATIVE=1, and identical again once the plane disarms.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.core.engine import Engine
+from redisson_tpu.net import _native
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.migration import (
+    CoordinatorKilled,
+    evacuate_device,
+    evacuation_plan,
+    rebalance_devices,
+    resume_device_rebalances,
+)
+from redisson_tpu.server.migration_journal import MigrationJournal
+from redisson_tpu.server.placement import PlacementStaleEpoch
+from redisson_tpu.utils.crc16 import calc_slot
+
+HAS_NATIVE = _native.load() is not None
+
+
+@pytest.fixture()
+def engine():
+    eng = Engine()
+    eng.enable_placement()
+    yield eng
+    eng.shutdown()
+
+
+def _clear_lanes(dev_id):
+    """Un-quarantine `dev_id` on EVERY registered lane set: the fault
+    ledger is process-global (weakly-held lane sets from earlier engines
+    may still be alive), so a test must never leak a quarantined id."""
+    from redisson_tpu.core import ioplane
+
+    for ls in list(ioplane._LANE_SETS):
+        lane = ls._lanes.get(dev_id)
+        if lane is not None:
+            lane.unquarantine()
+
+
+# -- fault-triggered evacuation: kill-at-every-phase (tentpole) ---------------
+
+
+def test_fault_evacuation_kill_at_every_phase(engine, tmp_path):
+    """Quarantine a device the way the serving path does (consecutive
+    kernel-launch faults), then for EVERY journal phase kill the evacuation
+    coordinator right after that phase's entry and resume: the victim ends
+    drained, banks bit-identical on surviving devices, the journal
+    terminal, and a stale coordinator cannot un-move a slot."""
+    from redisson_tpu.client.objects.hyperloglog import HyperLogLog
+    from redisson_tpu.core import ioplane
+
+    p = engine.placement
+    jd = str(tmp_path / "journal")
+    names = [f"evac{i}" for i in range(6)]
+    for name in names:
+        HyperLogLog(engine, name).add_all([f"{name}:{j}" for j in range(50)])
+    baseline = {
+        n: np.asarray(engine.store.get(n).arrays["regs"]).copy()
+        for n in names
+    }
+    slots = sorted({calc_slot(n.encode()) for n in names})
+    victim = p.device_id_for_slot(slots[0])
+    dev_id = getattr(p.devices[victim], "id", victim)
+    lane = engine.lanes.lane(p.devices[victim])
+    try:
+        for _ in range(ioplane.quarantine_after()):
+            ioplane.note_device_fault(dev_id, "kernel_launch")
+        assert lane.quarantined
+        assert dev_id in ioplane.quarantined_device_ids()
+        # the plan only ever targets healthy survivors
+        plan = evacuation_plan(p, victim)
+        assert plan and victim not in set(plan.values())
+        for phase in ("PLANNED", "DRAINING:1", "STABLE"):
+            # re-seed: hand the record slots back to the victim (epoch-less
+            # manual moves stay unfenced) so every phase evacuates real banks
+            rebalance_devices(engine, {s: victim for s in slots})
+            with pytest.raises(CoordinatorKilled):
+                evacuate_device(
+                    engine, victim, journal_dir=jd, crash_after=phase
+                )
+            results = resume_device_rebalances(engine, jd)
+            if phase == "STABLE":
+                # the kill landed AFTER the terminal entry: the evacuation
+                # is already complete, nothing is in flight to resume
+                assert results == [], (phase, results)
+                epoch = max(j.epoch for j in MigrationJournal.scan(jd))
+            else:
+                assert [r["action"] for r in results] == ["completed"], (
+                    phase, results,
+                )
+                epoch = results[0]["epoch"]
+            assert not MigrationJournal.in_flight(jd), phase
+            assert int((p.owner_snapshot() == victim).sum()) == 0, phase
+            for name in names:
+                rec = engine.store.get(name)
+                assert (
+                    ioplane.device_of(rec.arrays["regs"])
+                    != p.devices[victim]
+                ), (phase, name)
+                np.testing.assert_array_equal(
+                    np.asarray(rec.arrays["regs"]), baseline[name]
+                )
+            # the losing (stale) coordinator cannot hand slots back
+            with pytest.raises(PlacementStaleEpoch, match="STALEEPOCH"):
+                engine.move_slot_records(slots[0], victim, epoch=epoch - 1)
+        # quarantine persisted through every evacuation: only a probe clears
+        assert lane.quarantined
+    finally:
+        _clear_lanes(dev_id)
+
+
+# -- quarantine ledger semantics ----------------------------------------------
+
+
+def test_quarantine_streak_threshold_and_reset(engine):
+    from redisson_tpu.core import ioplane
+
+    p = engine.placement
+    dev_id = getattr(p.devices[0], "id", 0)
+    lane = engine.lanes.lane(p.devices[0])
+    prev = ioplane.set_quarantine_after(3)
+    try:
+        assert not ioplane.note_device_fault(dev_id, "kernel_launch")
+        assert not ioplane.note_device_fault(dev_id, "watchdog_timeout")
+        assert lane.consec_faults == 2 and not lane.quarantined
+        # one clean readback resets the STREAK
+        ioplane.note_device_ok(dev_id)
+        assert lane.consec_faults == 0 and lane.total_faults == 2
+        assert not ioplane.note_device_fault(dev_id, "kernel_launch")
+        assert not ioplane.note_device_fault(dev_id, "kernel_launch")
+        # the trip reports exactly once, on the flipping fault
+        assert ioplane.note_device_fault(dev_id, "kernel_launch")
+        assert lane.quarantined and lane.last_fault_kind == "kernel_launch"
+        assert dev_id in ioplane.quarantined_device_ids()
+        # a clean readback does NOT un-quarantine — only the probe path does
+        ioplane.note_device_ok(dev_id)
+        assert lane.quarantined and lane.consec_faults == 0
+        lane.unquarantine()
+        assert not lane.quarantined
+    finally:
+        _clear_lanes(dev_id)
+        ioplane.set_quarantine_after(prev)
+    assert dev_id not in ioplane.quarantined_device_ids()
+
+
+def test_watchdog_and_quarantine_knobs_roundtrip():
+    from redisson_tpu.core import ioplane
+
+    prev = ioplane.set_lane_watchdog_ms(120)
+    try:
+        assert ioplane.lane_watchdog_ms() == 120
+    finally:
+        assert ioplane.set_lane_watchdog_ms(prev) == 120
+    assert ioplane.lane_watchdog_ms() == prev
+    prev_q = ioplane.set_quarantine_after(5)
+    try:
+        assert ioplane.quarantine_after() == 5
+        # the threshold never drops below one fault
+        ioplane.set_quarantine_after(0)
+        assert ioplane.quarantine_after() == 1
+    finally:
+        ioplane.set_quarantine_after(prev_q)
+
+
+def test_lane_watchdog_bounds_hung_readback(engine):
+    """An injected hung transfer past the armed bound fails the readback
+    with LaneWatchdogTimeout within ~the bound (never the full stall), the
+    timeout lands on the lane's fault ledger, and the error is classified
+    retryable (the -TRYAGAIN translation's predicate)."""
+    import jax
+    import jax.numpy as jnp
+
+    from redisson_tpu.chaos.faults import FaultSchedule
+    from redisson_tpu.core import ioplane
+
+    dev = engine.placement.devices[3]
+    dev_id = getattr(dev, "id", 3)
+    lane = engine.lanes.lane(dev)
+    prev = ioplane.set_lane_watchdog_ms(50)
+    sched = FaultSchedule(0)
+    sched.add("device_hang", port=dev_id, after=0, count=1, delay_s=30.0)
+    try:
+        with sched.plane().active():
+            val = jax.device_put(jnp.arange(4, dtype=jnp.int32), dev)
+            t0 = time.monotonic()
+            with pytest.raises(ioplane.LaneWatchdogTimeout,
+                               match="lane-watchdog"):
+                ioplane.ReadbackFuture((val,)).result()
+            assert time.monotonic() - t0 < 5.0  # bounded, never the 30s stall
+        assert lane.total_faults >= 1
+        assert lane.last_fault_kind == "watchdog_timeout"
+        assert ioplane.is_retryable_device_fault(
+            ioplane.LaneWatchdogTimeout("x")
+        )
+        # RESOURCE_EXHAUSTED deliberately takes the -OOM path, not TRYAGAIN
+        assert not ioplane.is_retryable_device_fault(
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        )
+    finally:
+        ioplane.set_lane_watchdog_ms(prev)
+        _clear_lanes(dev_id)
+        lane.note_ok()
+
+
+# -- wire surface --------------------------------------------------------------
+
+
+def _connect(st):
+    from redisson_tpu.net.client import Connection
+
+    return Connection(st.server.host, st.server.port, timeout=15.0)
+
+
+def _victim_key(server, prefix="dfk"):
+    """A key name together with its owning (device_index, dev_id)."""
+    p = server.engine.placement
+    owner = p.owner_snapshot()
+    key = f"{prefix}0"
+    idx = int(owner[calc_slot(key)])
+    return key, idx, getattr(p.devices[idx], "id", idx)
+
+
+def test_devices_faults_row_probe_and_evacuate_wire(tmp_path):
+    """The full quarantine lifecycle over the wire: FAULTS rows report the
+    ledger, keyed work on the quarantined device fails -TRYAGAIN, CLUSTER
+    DEVEVACUATE drains its slots through the journaled rebalance, and a
+    passing CLUSTER DEVPROBE un-quarantines."""
+    from redisson_tpu.core import ioplane
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0, devices="all", workers=8) as st:
+        conn = _connect(st)
+        try:
+            key, victim, dev_id = _victim_key(st.server)
+            assert conn.execute("PFADD", key, "a", "b") in (1, b"1", True)
+            out = conn.execute("CLUSTER", "DEVICES")
+            assert out[0] == st.server.engine.placement.n_devices
+            for row in out[1:]:
+                # [dev_id, slots_owned, label, [QOS,...], [FAULTS, q, c, t, k]]
+                assert len(row) >= 5 and bytes(row[4][0]) == b"FAULTS"
+                assert list(row[4][1:4]) == [0, 0, 0]
+            lane = st.server.engine.lanes.lane(
+                st.server.engine.placement.devices[victim]
+            )
+            try:
+                for _ in range(ioplane.quarantine_after()):
+                    ioplane.note_device_fault(dev_id, "kernel_launch")
+                assert lane.quarantined
+                # keyed work on the quarantined device: clean retryable
+                # -TRYAGAIN, never a dispatch into the faulted stream
+                r = conn.execute("SET", key, "v")
+                assert isinstance(r, RespError), r
+                assert str(r).startswith("TRYAGAIN") and "quarantined" in str(r)
+                out = conn.execute("CLUSTER", "DEVICES")
+                row = out[1 + victim]
+                assert row[4][1] == 1  # quarantined flag over the wire
+                assert bytes(row[4][4]) == b"kernel_launch"
+                # evacuate: [moved_records, evacuated_slots, epoch]
+                jd = str(tmp_path / "journal")
+                moved, n_slots, epoch = conn.execute(
+                    "CLUSTER", "DEVEVACUATE", str(victim), "DIR", jd
+                )
+                assert moved >= 1 and n_slots >= 1 and epoch >= 0
+                out = conn.execute("CLUSTER", "DEVICES")
+                assert out[1 + victim][1] == 0  # victim owns no slots
+                assert not MigrationJournal.in_flight(jd)
+                # the record followed its slot and still reads back
+                assert conn.execute("PFCOUNT", key) == 2
+                # probe passes on the (healthy) forced-host device and
+                # un-quarantines the lane
+                assert conn.execute(
+                    "CLUSTER", "DEVPROBE", str(victim)
+                ) == [1, 0]
+                assert not lane.quarantined
+                out = conn.execute("CLUSTER", "DEVICES")
+                assert out[1 + victim][4][1] == 0
+                # keyed writes land again
+                assert conn.execute("PFADD", key, "d") == 1
+                assert conn.execute("PFCOUNT", key) == 3
+            finally:
+                _clear_lanes(dev_id)
+        finally:
+            conn.close()
+
+
+def test_lane_watchdog_config_knobs_over_wire():
+    from redisson_tpu.core import ioplane
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0, devices="all", workers=8) as st:
+        conn = _connect(st)
+        try:
+            kv = conn.execute("CONFIG", "GET", "lane-*")
+            view = {
+                bytes(kv[i]).decode(): bytes(kv[i + 1]).decode()
+                for i in range(0, len(kv), 2)
+            }
+            assert view["lane-watchdog-ms"] == "0"  # default: disarmed
+            assert view["lane-quarantine-after"] == str(
+                ioplane.quarantine_after()
+            )
+            prev_ms, prev_q = (
+                ioplane.lane_watchdog_ms(), ioplane.quarantine_after(),
+            )
+            try:
+                assert conn.execute(
+                    "CONFIG", "SET", "lane-watchdog-ms", "250"
+                ) in (b"OK", "OK")
+                assert conn.execute(
+                    "CONFIG", "SET", "lane-quarantine-after", "4"
+                ) in (b"OK", "OK")
+                assert ioplane.lane_watchdog_ms() == 250
+                assert ioplane.quarantine_after() == 4
+                # invalid values are rejected, knobs unchanged
+                for k, v in (("lane-watchdog-ms", "-1"),
+                             ("lane-quarantine-after", "0")):
+                    r = conn.execute("CONFIG", "SET", k, v)
+                    assert isinstance(r, RespError), (k, r)
+                assert ioplane.lane_watchdog_ms() == 250
+                assert ioplane.quarantine_after() == 4
+            finally:
+                ioplane.set_lane_watchdog_ms(prev_ms)
+                ioplane.set_quarantine_after(prev_q)
+        finally:
+            conn.close()
+
+
+# -- per-device residency ledger (satellite) -----------------------------------
+
+
+def test_record_bytes_census_rows_appear_per_kind_and_drain():
+    """record_bytes_dev<N> totals + per-kind breakdowns exist in METRICS
+    exactly while a device holds committed bytes of that kind — DEL drains
+    the rows to absence (the soak's flat-census shape for EVERY kind, not
+    just the vector bank's ledger)."""
+    from redisson_tpu.server.server import ServerThread
+
+    with ServerThread(port=0, devices="all", workers=8) as st:
+        conn = _connect(st)
+        try:
+            assert "record_bytes_dev" not in bytes(
+                conn.execute("METRICS")
+            ).decode()
+            assert conn.execute("PFADD", "cens:hll", "a", "b", "c") in (
+                1, True,
+            )
+            assert conn.execute("SETBIT", "cens:bits", "4096", "1") == 0
+            text = bytes(conn.execute("METRICS")).decode()
+            assert "record_bytes_dev" in text
+            kinds = {
+                line.split()[0].rsplit("_", 1)[-1]
+                for line in text.splitlines()
+                if "record_bytes_dev" in line
+                and not line.split()[0].split("dev")[-1].isdigit()
+            }
+            assert len(kinds) >= 2, kinds  # per-kind breakdown rows exist
+            for line in text.splitlines():
+                if "record_bytes_dev" in line:
+                    assert float(line.split()[-1]) > 0.0, line
+            assert conn.execute("DEL", "cens:hll", "cens:bits") == 2
+            assert "record_bytes_dev" not in bytes(
+                conn.execute("METRICS")
+            ).decode()
+        finally:
+            conn.close()
+
+
+# -- degraded replies: native vs fallback byte identity ------------------------
+
+_FAULT_DIGEST_DRIVER = r"""
+import hashlib, socket
+import numpy as np
+from redisson_tpu.net import resp
+from redisson_tpu.chaos.faults import FaultSchedule
+from redisson_tpu.net.client import install_fault_plane
+from redisson_tpu.server.server import ServerThread
+
+IDX = ("FT.CREATE", "oix", "ON", "HASH", "PREFIX", "1", "oi:",
+       "SCHEMA", "emb", "VECTOR", "FLAT", "6", "TYPE", "FLOAT32",
+       "DIM", "8", "DISTANCE_METRIC", "L2")
+VEC = np.ones(8, np.float32).tobytes()
+KNN = ("FT.SEARCH", "oix", "(*)=>[KNN 1 @emb $v]",
+       "PARAMS", "2", "v", VEC, "NOCONTENT")
+
+with ServerThread(port=0, devices="all", workers=8) as st:
+    s = socket.create_connection((st.server.host, st.server.port), timeout=30)
+    parser = resp.RespParser(use_native=False)
+    h = hashlib.sha256()
+
+    def drive(cmds):
+        s.sendall(b"".join(resp.encode_command_python(*c) for c in cmds))
+        replies = []
+        while len(replies) < len(cmds):
+            data = s.recv(1 << 16)
+            assert data, "server closed early"
+            h.update(data)
+            replies.extend(parser.feed(data))
+        return replies
+
+    drive([("SET", "dk1", "v1"), ("GET", "dk1")])  # disarmed baseline
+    sched = FaultSchedule(0)
+    sched.add("device_kernel", after=0, count=1)  # next keyed dispatch
+    sched.add("device_oom", after=0, count=1)     # first bank allocation
+    prev = install_fault_plane(sched.plane())
+    try:
+        # kernel-launch fault -> one clean retryable -TRYAGAIN
+        (r_try,) = drive([("SET", "dk2", "v2")])
+        # fresh index: HSET keeps the row pending (no allocation yet); the
+        # first search forces the bank's device allocation -> ONE -OOM
+        drive([IDX, ("HSET", "oi:k", "emb", VEC)])
+        (r_oom,) = drive([KNN])
+        # the retry allocates for real and drains the kept-pending row
+        (r_ok,) = drive([KNN])
+    finally:
+        install_fault_plane(prev)
+    drive([("SET", "dk3", "v3"), ("GET", "dk3")])  # disarmed again
+    s.close()
+
+assert isinstance(r_try, resp.RespError) and str(r_try).startswith("TRYAGAIN"), r_try
+assert isinstance(r_oom, resp.RespError) and str(r_oom).startswith("OOM"), r_oom
+assert not isinstance(r_ok, resp.RespError) and r_ok[0] == 1, r_ok
+print(h.hexdigest())
+"""
+
+
+@pytest.mark.skipif(not HAS_NATIVE, reason="native lib unavailable")
+def test_fault_reply_digest_identical_without_native():
+    """The degradation surface is part of the wire contract: one server
+    driven through a kernel-launch fault (-TRYAGAIN), an HBM-OOM bank
+    growth (-OOM, rows kept pending, retry lands) and disarmed traffic on
+    either side produces BYTE-IDENTICAL reply streams with the native wire
+    plane and with RTPU_NO_NATIVE=1."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    digests = {}
+    for label, extra in (("native", {}), ("fallback", {"RTPU_NO_NATIVE": "1"})):
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=8", **extra,
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", _FAULT_DIGEST_DRIVER],
+            capture_output=True, text=True, timeout=240, cwd=repo, env=env,
+        )
+        assert out.returncode == 0, (label, out.stdout, out.stderr)
+        digests[label] = out.stdout.strip().splitlines()[-1]
+    assert digests["native"] == digests["fallback"], digests
+    assert len(digests["native"]) == 64  # a real sha256 came back

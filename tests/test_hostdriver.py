@@ -276,6 +276,56 @@ def test_ssh_fleet_boots_tls_armed_and_anti_affine(ssh_fleet):
         client.shutdown()
 
 
+def test_ssh_fleet_weighted_qos_rebalance(ssh_fleet):
+    """ISSUE 19 satellite: the fleet tenant-budget loop rides the
+    cross-host driver bus (TLS, loopback-ssh-spawned processes) unchanged —
+    scrapes read each master's CLUSTER QOS table, the split lands per-node
+    weighted budgets (rate x service-class weight), and the WEIGHT operand
+    teaches the pushed nodes their class over the same bus."""
+    import time
+
+    import numpy as np
+
+    from redisson_tpu.cluster.qos_control import parse_tenant_weights
+
+    sup = ssh_fleet
+    rb = sup.start_qos_rebalance(
+        40_000.0, interval=3600.0, tenant_weights={"gold": 2.0}
+    )
+    client = sup.client()
+    try:
+        assert client.wait_routable(timeout=30.0)
+        client.execute("BF.RESERVE", b"qw{gold}", 0.01, 10_000)
+        # let the loop thread's own baseline sweep pass, then drive the
+        # remaining sweeps synchronously (interval is parked at an hour)
+        deadline = time.time() + 10.0
+        while rb.sweeps < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert rb.sweeps >= 1
+        rb.step()  # records the tenant's cumulative baseline per node
+        for i in range(4):
+            blob = (np.arange(200, dtype=np.int64) + i * 1000).tobytes()
+            client.execute("BF.MADD64", b"qw{gold}", blob)
+        pushed = rb.step()
+        assert "gold" in pushed, pushed
+        split = pushed["gold"]
+        # the weighted global budget is the invariant: rate x weight
+        assert sum(split.values()) == pytest.approx(80_000.0)
+        # every node that got budget was taught the weight with the push
+        for m in sup.masters:
+            if split.get(m.address, 0.0) <= 0.0:
+                continue
+            with sup.conn(m) as c:
+                weights = parse_tenant_weights(c.execute("CLUSTER", "QOS"))
+            assert weights.get("gold") == pytest.approx(2.0), (
+                m.address, weights,
+            )
+        assert rb.push_errors == 0
+    finally:
+        client.shutdown()
+        sup.stop_qos_rebalance()
+
+
 def test_ssh_fleet_refuses_plaintext(ssh_fleet):
     """The acceptance bullet: a plaintext connection to the TLS-armed bus
     is REFUSED, not silently served."""

@@ -64,10 +64,11 @@ def test_fused_add_contains_bit_identical_to_unfused_pair():
 
 # -- zero-alloc disabled fault plane -----------------------------------------
 
-def _guard_lines():
-    """Line numbers of every fault-plane guard in net/client.py — the exact
-    sites the zero-cost contract covers."""
-    import redisson_tpu.net.client as mod
+def _guard_lines(mod=None):
+    """Line numbers of every fault-plane guard in `mod` (default
+    net/client.py) — the exact sites the zero-cost contract covers."""
+    if mod is None:
+        import redisson_tpu.net.client as mod
 
     path = mod.__file__
     lines = []
@@ -142,6 +143,52 @@ def test_fault_plane_disabled_path_allocates_nothing():
         conn.close()
         b.close()
         t.join(timeout=5)
+
+
+def test_device_fault_guard_sites_discovered_and_zero_alloc_disarmed():
+    """The device fault domain's chokepoints (dispatch, bank alloc, the
+    two readback drains) follow the SAME one-global-load guard discipline
+    as the transport sites: each hook module must contain discoverable
+    guard lines, and the hottest one — the per-readback gate in
+    core/ioplane.py — must allocate NOTHING at those lines with the plane
+    disarmed and the lane watchdog off."""
+    import tracemalloc
+
+    import jax
+    import jax.numpy as jnp
+
+    import redisson_tpu.core.ioplane as iop
+    import redisson_tpu.server.registry as reg
+    import redisson_tpu.services.vector as vec
+    from redisson_tpu.net import client as net
+
+    for mod in (iop, reg, vec):
+        _path, guards = _guard_lines(mod)
+        assert guards, f"no fault-plane guard lines found in {mod.__name__}"
+
+    assert net._fault_plane is None, "a fault plane leaked from another test"
+    assert iop.lane_watchdog_ms() == 0, "a lane watchdog leaked"
+    val = jnp.arange(8, dtype=jnp.int32)
+    jax.block_until_ready(val)
+    iop.ReadbackFuture((val,)).result()  # warm every lazy path
+    path, guards = _guard_lines(iop)
+    tracemalloc.start(1)
+    try:
+        for _ in range(200):
+            iop.ReadbackFuture((val,)).result()
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    offenders = [
+        (tb.lineno, stat.size)
+        for stat in snap.statistics("lineno")
+        for tb in [stat.traceback[0]]
+        if tb.filename == path and tb.lineno in guards and stat.size > 0
+    ]
+    assert not offenders, (
+        f"device-fault guard lines allocated with the plane DISABLED: "
+        f"{offenders}"
+    )
 
 
 # -- coalesced dispatch equivalence ------------------------------------------

@@ -617,6 +617,140 @@ def test_supervisor_rebalance_loop_lifecycle():
     assert rb._thread is None
 
 
+# -- weighted tenant classes (ISSUE 19 satellite) -----------------------------
+
+
+def test_split_rate_weight_scales_the_global_budget():
+    from redisson_tpu.cluster.qos_control import split_rate
+
+    demand = {"a": 30.0, "b": 10.0}
+    # weight=1.0 reproduces unweighted behavior EXACTLY
+    assert split_rate(100.0, demand, weight=1.0) == split_rate(100.0, demand)
+    # gold=2.0: same proportions, twice the budget
+    s1 = split_rate(100.0, demand)
+    s2 = split_rate(100.0, demand, weight=2.0)
+    for node in demand:
+        assert s2[node] == pytest.approx(2.0 * s1[node])
+    assert sum(s2.values()) == pytest.approx(200.0)
+    # a weight floor of zero zeroes the budget, never goes negative
+    assert sum(split_rate(100.0, demand, weight=0.0).values()) == 0.0
+    assert sum(split_rate(100.0, demand, weight=-3.0).values()) == 0.0
+
+
+def test_parse_tenant_weights_reads_trailing_element():
+    from redisson_tpu.cluster.qos_control import (
+        parse_tenant_table, parse_tenant_weights,
+    )
+
+    reply = [
+        1, 0, 0,
+        [b"interactive", 0, 0, 0],
+        [b"TENANT", b"legacy", 0, 10, 0, 0],             # pre-weight row
+        [b"TENANT", b"gold", 0, 10, 0, 0, b"2"],         # weighted row
+        [b"TENANT", b"bad", 0, 10, 0, 0, b"not-a-float"],
+    ]
+    assert parse_tenant_weights(reply) == {"gold": 2.0}
+    # the len>=6 table contract is untouched by the trailing element
+    assert set(parse_tenant_table(reply)) == {"legacy", "gold", "bad"}
+    assert parse_tenant_weights([1, 0, 0]) == {}
+    assert parse_tenant_weights(RuntimeError("down")) == {}
+
+
+def test_rebalance_weight_operand_wire_and_token_preserving(laned_server):
+    from redisson_tpu.cluster.qos_control import parse_tenant_weights
+    from redisson_tpu.net.resp import RespError
+
+    st = laned_server
+    c = _conn(st)
+    try:
+        assert c.execute(
+            "CLUSTER", "QOS", "REBALANCE", "gold", "8000", "12000",
+            "WEIGHT", "2",
+        ) == b"OK"
+        sched = st.server.scheduler
+        assert sched.tenant_weight("gold") == pytest.approx(2.0)
+        assert sched.tenant_weight("unknown") == pytest.approx(1.0)
+        ts = sched._tenants["gold"]
+        assert ts.bucket.rate == pytest.approx(8000.0)
+        # the TENANT wire row carries the weight as its trailing element
+        weights = parse_tenant_weights(c.execute("CLUSTER", "QOS"))
+        assert weights["gold"] == pytest.approx(2.0)
+        # unweighted tenants read back the 1.0 default, so fleet scrapers
+        # see a complete weight column
+        assert all(w == 1.0 for t, w in weights.items() if t != "gold")
+        # re-weighting NEVER re-mints tokens (the token-preserving retarget
+        # contract): drain the bucket, change only the weight, tokens stay
+        ts.bucket.tokens = 3.0
+        sched.set_tenant_weight("gold", 3.5)
+        assert ts.bucket.tokens == pytest.approx(3.0)
+        assert ts.bucket.rate == pytest.approx(8000.0)
+        assert sched.tenant_weight("gold") == pytest.approx(3.5)
+        # malformed / non-positive weights are rejected cleanly
+        r = c.execute("CLUSTER", "QOS", "REBALANCE", "gold", "8000",
+                      "WEIGHT", "wat")
+        assert isinstance(r, RespError)
+        with pytest.raises(ValueError):
+            sched.set_tenant_weight("gold", 0.0)
+        assert sched.tenant_weight("gold") == pytest.approx(3.5)
+    finally:
+        c.close()
+
+
+class _WeightedFakeNode(_FakeNode):
+    """A _FakeNode whose TENANT rows carry a weight element and whose
+    REBALANCE recording keeps the full arg tail (WEIGHT operand)."""
+
+    def __init__(self, weights=None):
+        super().__init__()
+        self.weights = dict(weights or {})  # tenant -> wire-carried weight
+
+    def execute(self, *args):
+        if args[:2] == ("CLUSTER", "QOS") and len(args) == 2:
+            return [1, 0, 0] + [
+                [b"TENANT", t.encode(), 0, adm, shed, 0,
+                 f"{self.weights.get(t, 1.0):g}".encode()]
+                for t, (adm, shed) in sorted(self.tenants.items())
+            ]
+        if args[:3] == ("CLUSTER", "QOS", "REBALANCE"):
+            self.pushes.append(args[3:])
+            return b"OK"
+        raise AssertionError(f"unexpected command {args}")
+
+
+def test_qos_rebalancer_weight_precedence_and_weighted_pushes():
+    """Configured weights are authoritative (and taught to the fleet via
+    the WEIGHT operand); weights the fleet already carries fill in for
+    unnamed tenants; everyone else weighs 1.0.  Every tenant's splits sum
+    to rate x weight."""
+    from redisson_tpu.cluster.qos_control import QosRebalancer
+
+    a, b = _WeightedFakeNode(), _WeightedFakeNode({"carried": 3.0})
+    a.tenants = {"gold": (100, 0), "carried": (100, 0), "plain": (100, 0)}
+    b.tenants = {"gold": (100, 0), "carried": (100, 0), "plain": (100, 0)}
+    rb = QosRebalancer({"a": a, "b": b}, 10_000.0,
+                       tenant_weights={"gold": 2.0, "carried": 9.0})
+    assert rb.step() == {}  # baseline
+    # configured beats scraped beats default
+    assert rb.weight_of("gold") == pytest.approx(2.0)
+    assert rb.weight_of("carried") == pytest.approx(9.0)
+    assert rb.weight_of("plain") == pytest.approx(1.0)
+    del rb.tenant_weights["carried"]
+    assert rb.weight_of("carried") == pytest.approx(3.0)  # scraped fills in
+    for node in (a, b):
+        node.tenants = {
+            t: (adm + 500, 0) for t, (adm, shed) in node.tenants.items()
+        }
+    pushed = rb.step()
+    assert sum(pushed["gold"].values()) == pytest.approx(20_000.0)
+    assert sum(pushed["carried"].values()) == pytest.approx(30_000.0)
+    assert sum(pushed["plain"].values()) == pytest.approx(10_000.0)
+    # only CONFIGURED tenants are taught their weight on the push
+    by_tenant = {p[0]: p for p in a.pushes}
+    assert by_tenant["gold"][-2:] == ("WEIGHT", "2")
+    assert "WEIGHT" not in by_tenant["carried"]
+    assert "WEIGHT" not in by_tenant["plain"]
+
+
 # -- replica plane satellites -------------------------------------------------
 
 

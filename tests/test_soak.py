@@ -133,6 +133,39 @@ def test_device_shard_soak_rebalance_under_traffic():
     assert report.bloom_keys_verified > 0
 
 
+# -- device-fault soak (ISSUE 19) ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_device_fault_soak_quarantine_and_evacuate():
+    """The ISSUE 19 soak acceptance: mixed bucket/bloom/KNN traffic plus
+    tracked readers while device lanes are killed (kernel-launch faults
+    trip quarantine), hung (the armed lane watchdog bounds the stall and
+    fails the frame retryable) and OOMed (bank growth degrades to one
+    clean -OOM with rows kept pending), and the quarantined lane is
+    evacuated mid-traffic, probed healthy and respread — zero acked-write
+    loss, zero stale tracked reads, bit-identical bank rows, flat lane
+    census, host_colocations unmoved."""
+    from redisson_tpu.chaos.soak import (
+        DeviceFaultSoakConfig, DeviceFaultSoakHarness,
+    )
+
+    report = DeviceFaultSoakHarness(DeviceFaultSoakConfig(
+        cycles=2, seed=3,
+    )).run()
+    assert report.cycles_completed == 2
+    assert report.quarantines == 2
+    assert report.evacuations == 2
+    assert report.probes_passed >= 2
+    assert report.oom_errors == 2
+    assert report.stale_reads == 0
+    assert report.banks_verified > 0
+    assert report.injected.get("device_kernel", 0) > 0
+    assert report.injected.get("device_hang", 0) > 0
+    assert report.injected.get("device_oom", 0) > 0
+    assert report.writes_acked > 0 and report.reads > 0
+
+
 # -- vector-search soak (ISSUE 11) ---------------------------------------------
 
 

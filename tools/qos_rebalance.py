@@ -34,6 +34,16 @@ def main(argv=None) -> int:
     ap.add_argument("--min-share", type=float, default=0.05,
                     help="minimum fraction of an even split every node keeps")
     ap.add_argument("--password", default=None)
+    ap.add_argument("--ca-cert", default=None, metavar="PEM",
+                    help="fleet CA certificate: speak TLS to the nodes "
+                         "(cross-host driver fleets arm TLS by default; "
+                         "point this at the supervisor's tls/fleet.crt)")
+    ap.add_argument("--weight", action="append", default=[],
+                    metavar="TENANT=W",
+                    help="per-tenant service-class weight (repeatable, e.g. "
+                         "--weight gold=2.0 --weight silver=1.0); scales "
+                         "that tenant's global budget and is pushed "
+                         "fleet-wide via REBALANCE ... WEIGHT")
     ap.add_argument("--sweeps", type=int, default=0,
                     help="exit after this many sweeps (0 = run forever)")
     args = ap.parse_args(argv)
@@ -41,19 +51,40 @@ def main(argv=None) -> int:
     from redisson_tpu.cluster.qos_control import QosRebalancer
     from redisson_tpu.net.client import Connection
 
+    weights = {}
+    for spec in args.weight:
+        tenant, sep, w = spec.partition("=")
+        if not sep or not tenant:
+            ap.error(f"--weight expects TENANT=W, got {spec!r}")
+        try:
+            weights[tenant] = float(w)
+        except ValueError:
+            ap.error(f"--weight {spec!r}: weight is not a float")
+
+    ssl_context = None
+    if args.ca_cert:
+        from redisson_tpu.net.client import client_ssl_context
+
+        # fleet peers are addressed by IP/label: the chain pin (not the
+        # hostname) is what keeps foreign certs out, same as the supervisor
+        ssl_context = client_ssl_context(
+            ca_file=args.ca_cert, verify_hostname=False,
+        )
+
     def factory(addr: str):
         host, _, port = addr.rpartition(":")
 
         def open_conn():
             return closing(Connection(host, int(port), timeout=10.0,
-                                      password=args.password))
+                                      password=args.password,
+                                      ssl_context=ssl_context))
 
         return open_conn
 
     rb = QosRebalancer(
         {a: factory(a) for a in args.nodes}, args.rate,
         global_burst=args.burst, interval=args.interval,
-        min_share=args.min_share,
+        min_share=args.min_share, tenant_weights=weights,
     )
     n = 0
     try:

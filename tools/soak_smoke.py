@@ -48,6 +48,17 @@
     tracked reads (a device move must be invisible to the tracking plane),
     near-cache convergence after quiesce, per-device lane census flat, and
     zero host-side cross-device gathers (IOStats.host_colocations == 0).
+  * ``device-fault`` — the device fault-domain profile (ISSUE 19): mixed
+    bucket/bloom/KNN traffic plus tracked readers against one
+    device-sharded server while device lanes are killed (kernel-launch
+    failures trip quarantine), hung (an armed lane watchdog bounds the
+    stalled readback and fails the frame retryable) and OOMed (a bank
+    growth degrades to ONE clean ``-OOM`` with rows kept pending), then
+    the quarantined lane is evacuated MID-TRAFFIC through the journaled
+    fenced rebalance, probed back healthy (``CLUSTER DEVPROBE``) and
+    respread.  Asserts zero acked-write loss, zero stale tracked reads,
+    bit-identical bank rows post-evacuation, flat lane census, and
+    host_colocations unmoved.  One cycle runs in well under 60s.
   * ``qos`` — the tail-latency/QoS profile (ISSUE 10): an abusive bulk
     tenant floods one master with big blob pipelines while interactive
     tenants keep reading/writing small keys, under transport faults, while
@@ -110,7 +121,8 @@ def main() -> int:
     ap.add_argument("--profile",
                     choices=("standard", "migration", "cluster-proc",
                              "fleet", "fleet-host", "tracking",
-                             "read-scale", "device-shard", "qos", "vector"),
+                             "read-scale", "device-shard", "device-fault",
+                             "qos", "vector"),
                     default="standard")
     ap.add_argument("--cycles", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
@@ -152,6 +164,14 @@ def main() -> int:
         )
 
         harness = DeviceShardSoakHarness(DeviceShardSoakConfig(
+            cycles=args.cycles, seed=args.seed,
+        ))
+    elif args.profile == "device-fault":
+        from redisson_tpu.chaos.soak import (
+            DeviceFaultSoakConfig, DeviceFaultSoakHarness,
+        )
+
+        harness = DeviceFaultSoakHarness(DeviceFaultSoakConfig(
             cycles=args.cycles, seed=args.seed,
         ))
     elif args.profile == "read-scale":
