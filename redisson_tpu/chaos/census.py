@@ -131,6 +131,15 @@ class ResourceCensus:
             if devbytes is not None:
                 for k, v in devbytes().items():
                     out[k] = v
+            # tiered-HBM residency (ISSUE 20): per-device per-tier byte
+            # rows exist only while that tier holds bytes — DEL drains a
+            # demoted record's warm/cold rows to absence exactly like the
+            # hot rows above, so the residency soak's flat-census check
+            # covers the spill files too
+            residency = getattr(server, "_residency_census", None)
+            if residency is not None:
+                for k, v in residency().items():
+                    out[k] = v
             return out
 
         self.track(name, probe)

@@ -4515,3 +4515,430 @@ class DeviceFaultSoakHarness(DeviceShardSoakHarness):
             return self.report
         finally:
             self._teardown()
+
+
+# -- residency soak (ISSUE 20): zipf tenants over overcommitted HBM -----------
+
+
+@dataclass
+class ResidencySoakConfig:
+    """Zipf tenant banks whose combined footprint overcommits the armed
+    per-device budget several-fold, read/written under transport faults
+    while slots rebalance across devices AND the ResidencyRebalancer sheds
+    pressured devices through the journaled fenced driver."""
+
+    seed: int = 0
+    cycles: int = 1
+    keys: int = 32                 # tracked buckets (coherence probes)
+    filters: int = 24              # tenant bloom banks (the demotable HBM)
+    filter_keys: int = 400         # acked members per bank
+    writer_threads: int = 2
+    phase_seconds: float = 1.0
+    faults_per_cycle: int = 8
+    budget_divisor: int = 4        # armed budget = bank footprint / this
+    quiesce_s: float = 1.0
+
+
+@dataclass
+class ResidencySoakReport:
+    cycles_completed: int = 0
+    writes_acked: int = 0
+    reads: int = 0
+    tenant_probes: int = 0
+    errors: int = 0
+    stale_reads: int = 0           # tracked-read monotonicity (MUST stay 0)
+    promotions: int = 0
+    demotions_warm: int = 0
+    demotions_cold: int = 0
+    rebalances: int = 0
+    records_moved: int = 0
+    rebalancer_sweeps: int = 0
+    rebalancer_sheds: int = 0
+    post_storm_recall: float = 0.0  # demoted-then-promoted banks (>= 0.99)
+    tier_census: List[Dict[str, float]] = field(default_factory=list)
+
+    def summary(self) -> str:
+        return (
+            f"residency soak: {self.cycles_completed} cycles, "
+            f"{self.writes_acked} acked writes, {self.reads} tracked reads "
+            f"({self.stale_reads} stale), {self.tenant_probes} tenant "
+            f"probes, {self.errors} budgeted errors, "
+            f"{self.promotions} promotions / {self.demotions_warm}w+"
+            f"{self.demotions_cold}c demotions, {self.rebalances} "
+            f"rebalances ({self.records_moved} records moved), rebalancer "
+            f"{self.rebalancer_sweeps} sweeps / {self.rebalancer_sheds} "
+            f"sheds, post-storm recall={self.post_storm_recall:.4f}, "
+            f"tier census points={len(self.tier_census)}"
+        )
+
+
+class ResidencySoakHarness:
+    """The tiered-HBM residency invariants, under fire (ISSUE 20):
+
+      * **overcommit serves** — zipf tenant banks totaling
+        ``budget_divisor``x the armed per-device budget keep answering
+        membership probes (demote to host + fault-in on first touch)
+        through transport faults, slot rebalances, and rebalancer sheds;
+      * **zero acked-write loss, zero stale tracked reads** — demotion and
+        fault-in are invisible to the consistency planes;
+      * **post-storm recall** — after the storm, every bank is force-demoted
+        COLD (spilled through the CRC-covered container) and probed back:
+        acked members must read true (>= 0.99; bloom banks have no false
+        negatives, so a miss means tier cycling corrupted state);
+      * **per-tier census flat at quiesce** — two census snapshots after
+        quiesce are byte-identical, and DELing a COLD bank drains its rows
+        AND its spill file to absence.
+    """
+
+    def __init__(self, config: Optional[ResidencySoakConfig] = None):
+        self.config = config or ResidencySoakConfig()
+        self.report = ResidencySoakReport()
+        self._rng = np.random.default_rng(self.config.seed)
+        self._server = None
+        self._writer_client = None
+        self._reader_client = None
+        self._reader_plane = None
+        self._reader_buckets = {}
+        self._reader_last: Dict[str, int] = {}
+        self._acked: Dict[str, int] = {}
+        self._acked_lock = threading.Lock()
+        self._bloom_keys: Dict[str, np.ndarray] = {}
+        self._journal_dir = None
+        self._rebalancer = None
+        self._prev_budget = None
+        self._prev_tier = None
+        self._violations: List[str] = []
+
+    def _key(self, i: int) -> str:
+        return f"res:{i}"
+
+    def _setup(self) -> None:
+        from redisson_tpu.client.remote import RemoteRedisson
+        from redisson_tpu.core import ioplane
+        from redisson_tpu.core import residency as _res
+        from redisson_tpu.server.server import ServerThread
+
+        cfg = self.config
+        self._journal_dir = tempfile.mkdtemp(prefix="rtpu-ressoak-")
+        self._server = ServerThread(port=0, devices="all", workers=8).start()
+        ioplane.STATS.reset()
+        ioplane.reset_device_stats()
+        addr = f"{self._server.server.host}:{self._server.server.port}"
+        self._writer_client = RemoteRedisson(addr, timeout=10.0)
+        self._reader_client = RemoteRedisson(addr, timeout=10.0)
+        self._reader_plane = self._reader_client.enable_tracking(
+            cache_entries=8 * cfg.keys
+        )
+        for i in range(cfg.keys):
+            self._writer_client.get_bucket(self._key(i)).set(0)
+            self._acked[self._key(i)] = 0
+        self._reader_buckets = {
+            self._key(i): self._reader_plane.get_bucket(self._key(i))
+            for i in range(cfg.keys)
+        }
+        rng = np.random.default_rng(cfg.seed + 17)
+        for f in range(cfg.filters):
+            name = f"resbf:{f}"
+            bf = self._writer_client.get_bloom_filter(name)
+            assert bf.try_init(50_000, 0.01)
+            keys = rng.integers(0, 1 << 60, cfg.filter_keys).astype(np.int64)
+            bf.add_all(keys)
+            self._bloom_keys[name] = keys
+        # arm the plane AFTER the banks exist so the measured footprint is
+        # real, with the server's migration fences wired in
+        srv = self._server.server
+        srv.enable_residency(min_idle_s=0.05, sweep_interval=0.2)
+        mgr = srv.engine.residency
+        footprint = sum(
+            b for n, b in self._bank_bytes().items()
+        )
+        budget = max(1, footprint // cfg.budget_divisor)
+        self._prev_budget = _res.set_device_budget_bytes(budget)
+        self._prev_tier = _res.set_tier(True)
+        # the fleet control loop: scrape this node's ledgers, demote-first,
+        # shed persistent pressure through the journaled rebalance
+        from contextlib import closing
+
+        from redisson_tpu.cluster.residency_control import ResidencyRebalancer
+        from redisson_tpu.net.client import Connection
+
+        host, port = srv.host, srv.port
+
+        def factory():
+            return closing(Connection(host, port, timeout=10.0))
+
+        self._rebalancer = ResidencyRebalancer(
+            {addr: factory}, interval=0.25, high_water=0.9, shed_after=3,
+            shed_count=512, journal_dir=self._journal_dir,
+        ).start()
+
+    def _bank_bytes(self) -> Dict[str, int]:
+        from redisson_tpu.core import residency as _res
+
+        eng = self._server.server.engine
+        out: Dict[str, int] = {}
+        with _res.no_promote():
+            for name in self._bloom_keys:
+                rec = eng.store.get_unguarded(name)
+                if rec is not None:
+                    out[name] = _res.record_device_bytes(rec)
+        return out
+
+    def _teardown(self) -> None:
+        from redisson_tpu.core import residency as _res
+        from redisson_tpu.net.client import install_fault_plane
+
+        install_fault_plane(None)
+        if self._rebalancer is not None:
+            self._rebalancer.stop()
+        if self._prev_budget is not None:
+            _res.set_device_budget_bytes(self._prev_budget)
+        if self._prev_tier is not None:
+            _res.set_tier(self._prev_tier)
+        for c in (self._reader_client, self._writer_client):
+            if c is not None:
+                try:
+                    c.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+        if self._server is not None:
+            self._server.stop()
+
+    # -- workload ------------------------------------------------------------
+
+    def _writer(self, wid: int, stop: threading.Event) -> None:
+        cfg = self.config
+        client = self._writer_client
+        my_keys = [
+            self._key(i) for i in range(wid, cfg.keys, cfg.writer_threads)
+        ]
+        vals = {k: self._acked.get(k, 0) for k in my_keys}
+        my_filters = [
+            n for j, n in enumerate(sorted(self._bloom_keys))
+            if j % cfg.writer_threads == wid
+        ]
+        j = 0
+        while not stop.is_set():
+            k = my_keys[j % len(my_keys)]
+            v = vals[k] + 1
+            try:
+                client.get_bucket(k).set(v)
+                vals[k] = v
+                with self._acked_lock:
+                    self._acked[k] = v
+                    self.report.writes_acked += 1
+            except Exception:  # noqa: BLE001 — budgeted fault-window error
+                with self._acked_lock:
+                    self.report.errors += 1
+            if my_filters and j % 4 == 0:
+                # dirty a bank now and then: a bank with in-flight writes
+                # pins HOT (the demoter's pending probe / dirty rule)
+                name = my_filters[(j // 4) % len(my_filters)]
+                keys = self._bloom_keys[name]
+                lo = (j * 7) % (len(keys) - 50)
+                try:
+                    client.get_bloom_filter(name).add_all(keys[lo:lo + 50])
+                    with self._acked_lock:
+                        self.report.writes_acked += 1
+                except Exception:  # noqa: BLE001
+                    with self._acked_lock:
+                        self.report.errors += 1
+            j += 1
+            time.sleep(0.002)
+
+    def _reader(self, stop: threading.Event) -> None:
+        cfg = self.config
+        rng = np.random.default_rng(cfg.seed * 131 + 1)
+        p = 1.0 / np.power(np.arange(1, cfg.keys + 1), 1.0)
+        p /= p.sum()
+        while not stop.is_set():
+            k = self._key(int(rng.choice(cfg.keys, p=p)))
+            try:
+                v = self._reader_buckets[k].get()
+            except Exception:  # noqa: BLE001 — budgeted fault-window error
+                with self._acked_lock:
+                    self.report.errors += 1
+                continue
+            v = 0 if v is None else int(v)
+            last = self._reader_last.get(k, 0)
+            if v < last:
+                self._violations.append(f"{k}: read {v} after {last}")
+                with self._acked_lock:
+                    self.report.stale_reads += 1
+            self._reader_last[k] = max(last, v)
+            with self._acked_lock:
+                self.report.reads += 1
+            time.sleep(0.001)
+
+    def _tenant_reader(self, stop: threading.Event) -> None:
+        """Zipf(1.1) membership probes over the tenant banks — the reads
+        that fault demoted banks back in mid-storm."""
+        cfg = self.config
+        rng = np.random.default_rng(cfg.seed * 977 + 3)
+        names = sorted(self._bloom_keys)
+        p = 1.0 / np.power(np.arange(1, len(names) + 1), 1.1)
+        p /= p.sum()
+        order = rng.permutation(len(names))
+        while not stop.is_set():
+            name = names[int(order[rng.choice(len(names), p=p)])]
+            keys = self._bloom_keys[name]
+            lo = int(rng.integers(0, len(keys) - 32))
+            try:
+                found = self._writer_client.get_bloom_filter(
+                    name
+                ).contains_each(keys[lo:lo + 32])
+                assert np.asarray(found).all(), (
+                    f"false negative on {name} mid-storm"
+                )
+                with self._acked_lock:
+                    self.report.tenant_probes += 1
+            except AssertionError:
+                raise
+            except Exception:  # noqa: BLE001 — budgeted fault-window error
+                with self._acked_lock:
+                    self.report.errors += 1
+            time.sleep(0.002)
+
+    def _rebalance(self, n_active: int) -> None:
+        from redisson_tpu.server import migration as mig
+
+        engine = self._server.server.engine
+        targets = engine.placement.spread_plan(n_active)
+        moved = mig.rebalance_devices(
+            engine, targets, journal_dir=self._journal_dir
+        )
+        self.report.rebalances += 1
+        self.report.records_moved += moved
+
+    def _tier_rows(self) -> Dict[str, float]:
+        mgr = self._server.server.engine.residency
+        return {
+            k: v for k, v in mgr.census().items()
+            if k.startswith("residency_bytes_dev")
+        }
+
+    # -- run -----------------------------------------------------------------
+
+    def run(self) -> ResidencySoakReport:
+        from redisson_tpu.net.client import install_fault_plane
+        from redisson_tpu.server import migration as mig
+        from redisson_tpu.utils.crc16 import MAX_SLOT
+
+        cfg = self.config
+        self._setup()
+        try:
+            engine = self._server.server.engine
+            mgr = engine.residency
+            for cycle in range(cfg.cycles):
+                sched = FaultSchedule(cfg.seed * 7919 + cycle)
+                n = max(1, cfg.faults_per_cycle)
+                sched.add_random("delay", n=n, window=300, delay_s=0.01)
+                sched.add_random("drop", n=max(1, n // 2), window=300)
+                plane = FaultPlane(sched)
+                stop = threading.Event()
+                threads = [
+                    threading.Thread(
+                        target=self._writer, args=(w, stop), daemon=True
+                    )
+                    for w in range(cfg.writer_threads)
+                ] + [
+                    threading.Thread(
+                        target=self._reader, args=(stop,), daemon=True
+                    ),
+                    threading.Thread(
+                        target=self._tenant_reader, args=(stop,), daemon=True
+                    ),
+                ]
+                install_fault_plane(plane)
+                for t in threads:
+                    t.start()
+                try:
+                    time.sleep(cfg.phase_seconds)
+                    self._rebalance(4)      # 8 -> 4 while banks are tiered
+                    time.sleep(cfg.phase_seconds)
+                    self._rebalance(engine.placement.n_devices)  # 4 -> 8
+                    time.sleep(cfg.phase_seconds)
+                finally:
+                    stop.set()
+                    for t in threads:
+                        t.join(timeout=30)
+                    install_fault_plane(None)
+                self.report.cycles_completed += 1
+            # quiesce, then the invariants
+            time.sleep(cfg.quiesce_s)
+            self._rebalancer.stop()
+            self.report.rebalancer_sweeps = self._rebalancer.sweeps_issued
+            self.report.rebalancer_sheds = self._rebalancer.sheds_issued
+            leftover = mig.resume_device_rebalances(engine, self._journal_dir)
+            assert leftover == [], f"rebalances left in flight: {leftover}"
+            counts = engine.placement.slot_counts()
+            assert sum(counts) == MAX_SLOT, counts
+            # zero acked-write loss through demotion + rebalance + shed
+            with self._acked_lock:
+                acked = dict(self._acked)
+            for k, v in acked.items():
+                got = self._writer_client.get_bucket(k).get()
+                got = 0 if got is None else int(got)
+                assert got >= v, f"acked-write loss: {k} read {got} < acked {v}"
+            assert self.report.stale_reads == 0, (
+                "stale tracked reads across tier cycling: "
+                + "; ".join(self._violations[:5])
+            )
+            # post-storm recall: force-demote EVERY bank COLD (spill), then
+            # probe every acked member back through fault-in
+            hits = total = 0
+            for name, keys in self._bloom_keys.items():
+                mgr.demote(name, force=True)
+                mgr.demote(name, cold=True, force=True)
+                found = np.asarray(
+                    self._writer_client.get_bloom_filter(
+                        name
+                    ).contains_each(keys)
+                )
+                hits += int(found.sum())
+                total += len(keys)
+            self.report.post_storm_recall = hits / max(1, total)
+            assert self.report.post_storm_recall >= 0.99, (
+                f"post-storm recall {self.report.post_storm_recall}"
+            )
+            self.report.promotions = mgr.promotions
+            self.report.demotions_warm = mgr.demotions_warm
+            self.report.demotions_cold = mgr.demotions_cold
+            assert mgr.demotions_warm > 0, "storm never demoted a record"
+            assert mgr.promotions > 0, "storm never faulted a record back in"
+            # per-tier census flat at quiesce (sweeper still running): the
+            # system must reach a steady tier assignment, not oscillate.
+            # Age past min_idle first so THIS sweep (not a later sweeper
+            # tick) is the one that settles the over-budget recall probes.
+            time.sleep(max(0.1, 2 * mgr.min_idle_s))
+            mgr.sweep()
+            rows_a = self._tier_rows()
+            self.report.tier_census.append(dict(rows_a))
+            time.sleep(0.5)
+            rows_b = self._tier_rows()
+            self.report.tier_census.append(dict(rows_b))
+            assert rows_a == rows_b, (
+                f"tier census not flat at quiesce: {rows_a} != {rows_b}"
+            )
+            # drain-to-absence: DEL a COLD bank -> its rows AND its spill
+            # file vanish after the next sweep's GC
+            victim = sorted(self._bloom_keys)[0]
+            mgr.demote(victim, force=True)
+            mgr.demote(victim, cold=True, force=True)
+            rec = engine.store.get_unguarded(victim)
+            spill = rec.cold_path
+            assert spill is not None and os.path.exists(spill)
+            self._writer_client.get_bucket(victim).delete()
+            mgr.sweep()
+            assert not os.path.exists(spill), "spill file outlived DEL"
+            budget_errors = max(
+                10, (self.report.writes_acked + self.report.reads) // 2
+            )
+            assert self.report.errors <= budget_errors, (
+                f"error budget blown: {self.report.errors} vs {budget_errors}"
+            )
+            assert self.report.writes_acked > 0 and self.report.reads > 0
+            assert self.report.tenant_probes > 0
+            return self.report
+        finally:
+            self._teardown()

@@ -61,6 +61,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from redisson_tpu.core import residency as _residency
 from redisson_tpu.utils.durability import fsync_dir as _fsync_dir
 
 MAGIC = b"RTPUCKP1"
@@ -112,7 +113,10 @@ def _snapshot_records(engine) -> List[Dict[str, Any]]:
         # host state is serialized HERE, inside the lock — keeping a live
         # reference would race with mutators once the lock is released
         with engine.locked(name):
-            arrays = {k: np.asarray(v) for k, v in rec.arrays.items()}
+            # residency-aware host view (ISSUE 20): a WARM record's exact
+            # bytes come from its host stash and a COLD one's from its
+            # spill — demoted records checkpoint WITHOUT promotion
+            arrays = _residency.record_host_arrays(rec)
             out.append(
                 {
                     "name": name,
@@ -399,7 +403,7 @@ def dump_record(engine, name: str) -> bytes:
     filter would silently answer wrong under a different hash build."""
     from redisson_tpu.utils import hashing as H
 
-    with engine.locked(name):
+    with engine.locked(name), _residency.no_promote():
         rec = engine.store.get(name)
         if rec is None:
             raise KeyError(f"object '{name}' does not exist")
@@ -410,7 +414,9 @@ def dump_record(engine, name: str) -> bytes:
             "meta": dict(rec.meta),
             "expire_at": rec.expire_at,
             "host_pickled": pickle.dumps(rec.host, protocol=4),
-            "arrays": {k: np.asarray(v) for k, v in rec.arrays.items()},
+            # residency-aware: DUMP of a WARM/COLD record ships its stash/
+            # spill bytes without faulting the arrays back into HBM
+            "arrays": _residency.record_host_arrays(rec),
         }
     return pickle.dumps(payload, protocol=4)
 
@@ -474,16 +480,26 @@ def clone_record(engine, src_name: str, dst_name: str, replace: bool = False) ->
 
     from redisson_tpu.core.store import StateRecord
 
-    with engine.locked_many([src_name, dst_name]):
+    with engine.locked_many([src_name, dst_name]), _residency.no_promote():
         rec = engine.store.get(src_name)
         if rec is None:
             return False
         if engine.store.exists(dst_name) and not replace:
             return False
+        if rec.stash is None and rec.cold_path is None:
+            arrays = {k: jnp.copy(v) for k, v in rec.arrays.items()}
+        else:
+            # demoted source: the clone hydrates HOT from the host view
+            # (the source itself stays WARM/COLD — copying must not
+            # double its HBM footprint)
+            arrays = {
+                k: jnp.asarray(v)
+                for k, v in _residency.record_host_arrays(rec).items()
+            }
         clone = StateRecord(
             kind=rec.kind,
             meta=pickle.loads(pickle.dumps(dict(rec.meta))),
-            arrays={k: jnp.copy(v) for k, v in rec.arrays.items()},
+            arrays=arrays,
             host=pickle.loads(pickle.dumps(rec.host)),
         )
         clone.expire_at = rec.expire_at

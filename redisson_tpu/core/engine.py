@@ -70,6 +70,9 @@ class Engine:
         # behavior, bit for bit; enable_placement() opts in.
         self.placement = None
         self.lanes = None
+        # tiered HBM residency (ISSUE 20): None until enable_residency()
+        # arms the HOT/WARM/COLD plane for THIS engine's store
+        self.residency = None
 
     def service(self, key: str, factory):
         """Engine-scoped lazy singleton (script cache, search indexes, ...)
@@ -317,6 +320,36 @@ class Engine:
                     # short-lived objects must not leak host memory)
                     self._record_locks.pop(name, None)
 
+    def try_locked(self, name: str):
+        """Non-blocking record lock: a held context manager, or None when
+        some other thread holds the lock RIGHT NOW.  The residency demoter
+        uses it so releasing cold arrays can never stall a serving path —
+        a busy record simply stays HOT this sweep."""
+        with self._locks_guard:
+            entry = self._record_locks.get(name)
+            if entry is None:
+                entry = self._record_locks[name] = [threading.RLock(), 0]
+            entry[1] += 1
+        if not entry[0].acquire(blocking=False):
+            with self._locks_guard:
+                entry[1] -= 1
+                if entry[1] == 0:
+                    self._record_locks.pop(name, None)
+            return None
+
+        @contextmanager
+        def _held():
+            try:
+                yield
+            finally:
+                entry[0].release()
+                with self._locks_guard:
+                    entry[1] -= 1
+                    if entry[1] == 0:
+                        self._record_locks.pop(name, None)
+
+        return _held()
+
     @contextmanager
     def locked_many(self, names: Iterable[str]):
         """Acquire several record locks in sorted-name order (deadlock-free
@@ -369,6 +402,47 @@ class Engine:
         """Owner device of `name`'s slot, or None with placement off."""
         p = self.placement
         return None if p is None else p.device_for_name(name)
+
+    # -- tiered HBM residency (ISSUE 20) --------------------------------------
+
+    def enable_residency(self, budget_bytes: Optional[int] = None,
+                         spill_dir: Optional[str] = None,
+                         sweep_interval: float = 0.0, **kw):
+        """Arm the HOT/WARM/COLD residency plane for this engine's store:
+        getters fault WARM/COLD records back in on first touch, and the
+        (optional) background sweeper demotes least-recently-touched clean
+        records whenever a device exceeds ``device-budget-bytes``.
+        Idempotent; returns the ResidencyManager."""
+        from redisson_tpu.core import residency as _residency
+
+        if self.residency is None:
+            self.residency = _residency.ResidencyManager(
+                self, spill_dir=spill_dir, sweep_interval=sweep_interval,
+                **kw,
+            )
+            self.store.residency = self.residency
+        if budget_bytes is not None:
+            _residency.set_device_budget_bytes(budget_bytes)
+        return self.residency
+
+    def disable_residency(self) -> None:
+        """Detach the residency plane from this store.  Every WARM/COLD
+        record is promoted back to HOT FIRST — once the getters stop
+        routing to the manager nothing would ever fault a demoted record
+        back in, and its (correct, host-side) state would read as empty."""
+        mgr = self.residency
+        if mgr is None:
+            return
+        with self.store._lock:
+            demoted = [
+                (n, r) for n, r in self.store._states.items()
+                if r.tier != "hot"
+            ]
+        for name, rec in demoted:
+            mgr.fault_in(name, rec)
+        self.residency = None
+        self.store.residency = None
+        mgr.stop()
 
     def _place_record(self, name: str, rec) -> None:
         """DeviceStore placement hook: commit the record's single-device
@@ -598,6 +672,10 @@ class Engine:
                 p.shutdown(wait=False, cancel_futures=True)
         if eviction is not None:
             eviction.close()
+        if self.residency is not None:
+            self.residency.stop()
+            self.residency = None
+            self.store.residency = None
         self.pubsub.close()
         self.staging.clear()
         if self.lanes is not None:

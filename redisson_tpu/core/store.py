@@ -20,6 +20,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
+from redisson_tpu.core import residency as _res
+
 
 @dataclass
 class StateRecord:
@@ -33,6 +35,17 @@ class StateRecord:
     # recreated, so replication compares (nonce, version), not version alone —
     # otherwise a recreate within one ship interval is invisible to replicas
     nonce: int = field(default_factory=lambda: secrets.randbits(63))
+    # residency plane (ISSUE 20): HOT = arrays in HBM (the only state before
+    # this PR), WARM = arrays released with the exact host bytes in `stash`,
+    # COLD = stash spilled to the verified container at `cold_path`.  Tier
+    # moves only under the record lock + the manager's transition lock;
+    # version does NOT bump on a tier change (content is identical, so
+    # replication/migration must not re-ship a demoted record).
+    tier: str = _res.HOT
+    stash: Optional[Dict[str, Any]] = None   # WARM host mirror (numpy)
+    stash_dev: int = -1                      # device the arrays came off
+    cold_path: Optional[str] = None          # COLD spill file
+    cold_bytes: int = 0                      # spilled host bytes (census)
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.expire_at is not None and (now or time.time()) >= self.expire_at
@@ -74,6 +87,11 @@ class DeviceStore:
         # ONE seam.  None (the default) keeps today's default-device
         # behavior bit for bit.
         self.placement_hook: Optional[Callable[[str, StateRecord], None]] = None
+        # residency manager (ISSUE 20): set by Engine.enable_residency —
+        # the armed `_res._tier_plane` guard routes getter touches here so
+        # multiple engines in one process never cross-wire.  None = the
+        # store has no tiering even while the process-global plane is armed.
+        self.residency = None
 
     def _placed(self, name: str, rec: StateRecord) -> StateRecord:
         if self.placement_hook is not None:
@@ -90,20 +108,37 @@ class DeviceStore:
             except Exception:  # noqa: BLE001 — expiry must never fail a read
                 pass
 
+    def _get_locked(self, name: str) -> Optional[StateRecord]:
+        """get() body under self._lock (callers hold it) — shared by the
+        public getters so the residency fault-in below fires exactly once,
+        AFTER the lock is released."""
+        rec = self._states.get(name)
+        if rec is not None and rec.expired():
+            del self._states[name]
+            rec = None
+            self._reaped(name)
+        if rec is None and self.absent_guard is not None:
+            self.absent_guard(name)
+        return rec
+
     def get(self, name: str) -> Optional[StateRecord]:
         with self._lock:
-            rec = self._states.get(name)
-            if rec is not None and rec.expired():
-                del self._states[name]
-                rec = None
-                self._reaped(name)
-            if rec is None and self.absent_guard is not None:
-                self.absent_guard(name)
-            return rec
+            rec = self._get_locked(name)
+        # fault-in chokepoint (ISSUE 20): a keyed command touching a
+        # WARM/COLD record promotes it back to HOT *here*, OUTSIDE the
+        # store lock — promotion takes the record lock and the owner
+        # lane's gate, and holding the store lock across either would
+        # invert every documented lock order.  Disarmed cost: one module-
+        # global load + is-None (tests/test_perf_smoke.py pins it).
+        plane = _res._tier_plane
+        if plane is not None and rec is not None:
+            plane.on_record_access(self, name, rec)
+        return rec
 
     def get_or_create(self, name: str, kind: str, factory: Callable[[], StateRecord]) -> StateRecord:
         with self._lock:
-            rec = self.get(name)  # raises via absent_guard in a migration window
+            # raises via absent_guard in a migration window
+            rec = self._get_locked(name)
             if rec is None:
                 rec = factory()
                 assert rec.kind == kind
@@ -113,7 +148,10 @@ class DeviceStore:
                     f"object '{name}' holds a {rec.kind}, requested {kind} "
                     "(WRONGTYPE in the reference)"
                 )
-            return rec
+        plane = _res._tier_plane
+        if plane is not None and rec is not None:
+            plane.on_record_access(self, name, rec)
+        return rec
 
     def put(self, name: str, rec: StateRecord) -> None:
         with self._lock:
@@ -169,7 +207,7 @@ class DeviceStore:
 
     def rename(self, old: str, new: str) -> bool:
         with self._lock:
-            rec = self.get(old)
+            rec = self._get_locked(old)  # metadata op: no fault-in needed
             if rec is None:
                 return False
             if new != old:
@@ -179,7 +217,7 @@ class DeviceStore:
 
     def expire(self, name: str, at: Optional[float]) -> bool:
         with self._lock:
-            rec = self.get(name)
+            rec = self._get_locked(name)  # metadata op: no fault-in needed
             if rec is None:
                 return False
             rec.expire_at = at

@@ -758,6 +758,20 @@ def evacuation_plan(placement, dev_index: int) -> Dict[int, int]:
     }
 
 
+def shed_plan(placement, dev_index: int, count: int) -> "Dict[int, int]":
+    """Partial evacuation (ISSUE 20): target owners for up to ``count`` of
+    ``dev_index``'s slots, round-robin over the surviving devices — the
+    HBM-pressure actuator the residency rebalancer drives.  Same contract
+    as :func:`evacuation_plan` (feeds :func:`rebalance_devices` unchanged,
+    fenced + journaled + resumable), just bounded so one shed step moves a
+    bite of the device, not the whole device."""
+    full = evacuation_plan(placement, dev_index)
+    if count <= 0 or count >= len(full):
+        return full
+    keep = sorted(full)[:count]
+    return {s: full[s] for s in keep}
+
+
 def evacuate_device(engine, dev_index: int,
                     journal_dir: Optional[str] = None,
                     crash_after: Optional[str] = None):

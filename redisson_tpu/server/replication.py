@@ -334,7 +334,16 @@ def snapshot_records(engine, names: List[str]) -> Dict[str, dict]:
             if rec is None or rec.expired():
                 continue
             item = _record_head(rec, name)
-            item["arrays"] = {k: jnp.copy(v) for k, v in rec.arrays.items()}
+            if rec.stash is not None or rec.cold_path is not None:
+                # demoted record (ISSUE 20): its exact bytes already live
+                # host-side — ship the stash/spill view, never promote
+                from redisson_tpu.core import residency as _residency
+
+                item["arrays"] = _residency.record_host_arrays(rec)
+            else:
+                item["arrays"] = {
+                    k: jnp.copy(v) for k, v in rec.arrays.items()
+                }
             staged.append(item)
     out = {}
     for item in staged:
@@ -368,7 +377,11 @@ def serialize_records(
     for name, rec in items:
         with engine.locked(name):
             item = _record_head(rec, name)
-            item["arrays"] = {k: np.asarray(v) for k, v in rec.arrays.items()}
+            # residency-aware host cut (ISSUE 20): WARM/COLD records ship
+            # their stash/spill bytes without faulting back into HBM
+            from redisson_tpu.core import residency as _residency
+
+            item["arrays"] = _residency.record_host_arrays(rec)
             out.append(item)
             shipped.append((name, rec.nonce, rec.version))
     # include_live=False for record TRANSFER blobs (slot migration): the

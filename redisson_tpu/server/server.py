@@ -440,6 +440,11 @@ class TpuServer:
         # record_bytes_dev<N>[_<kind>] rows from one store scan per scrape —
         # same one-family discipline as ftvec, rows vanish with the bytes
         self.metrics.multi_gauge("devbytes", self._device_bytes_census)
+        # tiered-HBM residency plane (ISSUE 20): per-device per-tier byte
+        # ledgers (residency_bytes_dev<N>_{hot,warm,cold}) plus the
+        # promotion/demotion/fault-in counters — rows exist only while the
+        # manager is armed and the tier holds bytes, so DEL drains them
+        self.metrics.multi_gauge("residency", self._residency_census)
         # OBJCALL handle cache (ordered for LRU eviction; see registry)
         from collections import OrderedDict
 
@@ -512,6 +517,14 @@ class TpuServer:
         view["ivf-cell-imbalance"] = _V.IVF_CELL_IMBALANCE
         view["ivf-cell-cap-max"] = _V.IVF_CELL_CAP_MAX
         view["ftvec-device-budget"] = _V.DEVICE_BYTES_BUDGET
+        # tiered-HBM residency plane (ISSUE 20): the per-DEVICE byte budget
+        # (the generalization of the per-bank ftvec knob above) + arming
+        from redisson_tpu.core import residency as _res
+
+        view["device-budget-bytes"] = _res.DEVICE_BUDGET_BYTES
+        view["residency-enabled"] = int(
+            self.engine.residency is not None and _res.tier_enabled()
+        )
         view.update(self.scheduler.config_view())
         return view
 
@@ -609,6 +622,29 @@ class TpuServer:
             from redisson_tpu.services import vector as _V
 
             _V.set_device_bytes_budget(n)
+            return True
+        if key == "device-budget-bytes":
+            # per-DEVICE HBM budget the residency sweeper demotes against
+            # (0 = unlimited; demotion still available via explicit verbs)
+            n = int(value)
+            if n < 0:
+                return False
+            from redisson_tpu.core import residency as _res
+
+            _res.set_device_budget_bytes(n)
+            return True
+        if key == "residency-enabled":
+            # arm/disarm the tiered-HBM residency plane live (ISSUE 20).
+            # Disarming promotes every demoted record back to HOT first so
+            # replies stay bit-identical with the plane off.
+            on = value.lower() not in ("0", "false", "no", "off")
+            from redisson_tpu.core import residency as _res
+
+            if on:
+                self.enable_residency(sweep_interval=1.0)
+            else:
+                _res.set_tier(False)
+                self.engine.disable_residency()
             return True
         if key.startswith("qos-"):
             if key == "qos-bulk-slots" and int(value) <= 0:
@@ -1211,7 +1247,13 @@ class TpuServer:
         if svc is None:
             return zeros
         try:
-            return svc.device_census()
+            # observe-only: a scrape must never fault a demoted bank back
+            # onto the device (ISSUE 20) — a WARM bank reports 0 HBM bytes,
+            # which is exactly what the ledger means
+            from redisson_tpu.core import residency as _res
+
+            with _res.no_promote():
+                return svc.device_census()
         except Exception:  # noqa: BLE001 — a broken gauge must not kill scrape
             return zeros
 
@@ -1250,6 +1292,46 @@ class TpuServer:
         for (d, kind), v in sorted(by_kind.items()):
             out[f"record_bytes_dev{d}_{kind}"] = v
         return out
+
+    def _residency_census(self) -> dict:
+        """Per-tier residency rows (ISSUE 20): empty while the plane is
+        disarmed so the gauge family contributes nothing to a scrape."""
+        mgr = self.engine.residency
+        if mgr is None:
+            return {}
+        try:
+            return mgr.census()
+        except Exception:  # noqa: BLE001 — a broken gauge must not kill scrape
+            return {}
+
+    def _residency_fence_check(self, name: str) -> bool:
+        """True when ``name``'s slot is mid-migration on this node — the
+        demoter must never touch a record the fenced journaled mover is
+        about to snapshot (ISSUE 20 'fenced/migrating slots never demote')."""
+        if not (self.migrating_slots or self.importing_slots
+                or self.recovering_slots):
+            return False
+        from redisson_tpu.utils.crc16 import calc_slot
+
+        slot = calc_slot(name.encode())
+        return (slot in self.migrating_slots
+                or slot in self.importing_slots
+                or slot in self.recovering_slots)
+
+    def enable_residency(self, **kw) -> None:
+        """Arm the tiered-residency plane with the server's fences wired in
+        (CONFIG SET residency-enabled yes / --residency boot path).  Under
+        RTPU_NO_TIER=1 this is a refused no-op END TO END: set_tier(True)
+        would be rejected, and a manager whose sweeper demotes while the
+        getter guard stays disarmed would strand WARM records with no
+        fault-in path."""
+        from redisson_tpu.core import residency as _res
+
+        if _res._NO_TIER:
+            return
+        self.engine.enable_residency(**kw)
+        self.engine.residency.fence_check = self._residency_fence_check
+        _res.set_tier(True)
 
     @staticmethod
     def _estimate_device_items(cmds) -> int:
@@ -2417,6 +2499,20 @@ def main(argv=None):
              "reference path for A/B measurement",
     )
     ap.add_argument(
+        "--no-tier", action="store_true",
+        help="disable the tiered-HBM residency plane (core/residency): "
+             "every record stays HOT on its owner device, no demotion or "
+             "fault-in ever runs — the reference path for A/B measurement "
+             "(RTPU_NO_TIER=1 equivalent; replies are bit-identical)",
+    )
+    ap.add_argument(
+        "--residency", action="store_true",
+        help="arm the tiered-HBM residency plane at boot (cold records "
+             "demote to host RAM / spill under the per-device "
+             "device-budget-bytes budget and fault back in on first touch; "
+             "also CONFIG SET residency-enabled yes)",
+    )
+    ap.add_argument(
         "--workers", type=int, default=4,
         help="data-plane worker threads (the per-connection dispatch pool)",
     )
@@ -2505,6 +2601,10 @@ def main(argv=None):
         _sched.set_qos(False)
     if args.no_preempt:
         _iop.set_preempt(False)
+    if args.no_tier:
+        from redisson_tpu.core import residency as _res_tier
+
+        _res_tier.set_tier(False)
     if args.retry_profile:
         import os as _os
 
@@ -2538,6 +2638,8 @@ def main(argv=None):
 
         if _os.path.exists(args.checkpoint):
             checkpoint.load(engine, args.checkpoint)
+    if args.residency and not args.no_tier:
+        srv.enable_residency(sweep_interval=1.0)
     if args.prewarm:
         engine.prewarm()
     checkpointer = None

@@ -366,6 +366,115 @@ def cmd_cluster(server, ctx, args):
         for s in slots:
             server.fence_slot_epoch(s, epoch)
         return server.migrate_slot_batch(slots)
+    if sub == b"RESIDENCY":
+        # Tiered-HBM residency plane (ISSUE 20), over the wire.
+        #   CLUSTER RESIDENCY                      — the ledger table:
+        #     [armed, budget_bytes,
+        #      [b"DEV", dev, hot_bytes, warm_bytes, cold_bytes]...,
+        #      [b"CTR", promotions, demotions_warm, demotions_cold,
+        #       cold_loads, fault_in_ms_total, fault_in_ms_max]]
+        #   CLUSTER RESIDENCY TIER <key>           — "hot"/"warm"/"cold"
+        #   CLUSTER RESIDENCY DEMOTE <key> [COLD]  — force one demotion
+        #   CLUSTER RESIDENCY SWEEP                — one on-demand sweep:
+        #     [demoted, colded, freed_bytes]
+        #   CLUSTER RESIDENCY SHED <dev> [COUNT n] [DIR d] — move up to n
+        #     of <dev>'s slots onto the survivors through the journaled
+        #     fenced device rebalance (the pressure-rebalancer's actuator):
+        #     [records_moved, slots_moved]
+        from redisson_tpu.core import residency as _res
+
+        mgr = server.engine.residency
+        if len(args) > 1:
+            op = bytes(args[1]).upper()
+            if op == b"TIER":
+                if len(args) < 3:
+                    raise RespError("ERR CLUSTER RESIDENCY TIER <key>")
+                if mgr is None:
+                    # disarmed plane: everything is HOT by construction
+                    return _res.HOT.encode()
+                t = mgr.tier_of(_s(args[2]))
+                if t is None:
+                    raise RespError("ERR no such key")
+                return t.encode()
+            if op == b"SHED":
+                # a placement op, deliberately legal with the manager off —
+                # an operator can pre-drain a device before arming tiers
+                from redisson_tpu.server import migration as mig
+
+                if server.engine.placement is None:
+                    raise RespError(
+                        "ERR placement is not enabled on this server"
+                    )
+                rest = list(args[2:])
+                if not rest:
+                    raise RespError(
+                        "ERR CLUSTER RESIDENCY SHED <dev> [COUNT n] [DIR d]"
+                    )
+                dev_index = _int(rest[0])
+                rest = rest[1:]
+                count = 8
+                journal_dir = None
+                while rest:
+                    word = bytes(rest[0]).upper()
+                    if word == b"COUNT" and len(rest) >= 2:
+                        count = _int(rest[1])
+                        rest = rest[2:]
+                    elif word == b"DIR" and len(rest) >= 2:
+                        journal_dir = _s(rest[1])
+                        rest = rest[2:]
+                    else:
+                        raise RespError(
+                            "ERR CLUSTER RESIDENCY SHED <dev> "
+                            "[COUNT n] [DIR d]"
+                        )
+                try:
+                    targets = mig.shed_plan(
+                        server.engine.placement, dev_index, count
+                    )
+                    moved = mig.rebalance_devices(
+                        server.engine, targets, journal_dir=journal_dir
+                    ) if targets else 0
+                except ValueError as e:
+                    raise RespError(f"ERR {e}")
+                return [moved, len(targets)]
+            if mgr is None:
+                raise RespError(
+                    "ERR residency plane is not enabled "
+                    "(CONFIG SET residency-enabled yes)"
+                )
+            if op == b"DEMOTE":
+                if len(args) < 3:
+                    raise RespError(
+                        "ERR CLUSTER RESIDENCY DEMOTE <key> [COLD]"
+                    )
+                cold = len(args) > 3 and bytes(args[3]).upper() == b"COLD"
+                return 1 if mgr.demote(_s(args[2]), cold=cold,
+                                       force=True) else 0
+            if op == b"SWEEP":
+                swept = mgr.sweep()
+                return [swept["demoted"], swept["colded"],
+                        int(swept["freed_bytes"])]
+            raise RespError("ERR unknown CLUSTER RESIDENCY subcommand")
+        armed = 1 if (mgr is not None and _res.tier_enabled()) else 0
+        out = [armed, int(_res.DEVICE_BUDGET_BYTES)]
+        if mgr is None:
+            return out
+        census = mgr.census()
+        devs: dict = {}
+        for k, v in census.items():
+            if k.startswith("residency_bytes_dev"):
+                num, _, tier = k[len("residency_bytes_dev"):].partition("_")
+                devs.setdefault(int(num), {})[tier] = int(v)
+        for d in sorted(devs):
+            row = devs[d]
+            out.append([b"DEV", d, row.get("hot", 0), row.get("warm", 0),
+                        row.get("cold", 0)])
+        out.append([
+            b"CTR", mgr.promotions, mgr.demotions_warm, mgr.demotions_cold,
+            mgr.cold_loads, f"{mgr.fault_in_ms_total:g}".encode(),
+            f"{mgr.fault_in_ms_max:g}".encode(),
+        ])
+        return out
     raise RespError("ERR unknown CLUSTER subcommand")
 
 

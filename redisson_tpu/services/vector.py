@@ -80,11 +80,14 @@ from __future__ import annotations
 
 import os
 import threading
+import weakref
 from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from redisson_tpu.core import residency as _res
 
 # device chaos plane (ISSUE 19): the bank create/grow allocation chokepoint
 # consults the process-global fault plane net/client.py hosts — disarmed
@@ -552,6 +555,21 @@ class DeviceRowBank:
                     f"the {budget}-byte per-device budget; shard the index "
                     f"(SHARDS n) or compress its TYPE"
                 )
+        if self.BUDGETED:
+            # residency-plane admission (ISSUE 20 bugfix): growth that would
+            # push the OWNER DEVICE over device-budget-bytes first demotes
+            # that device's colder clean records; VectorBudgetError is the
+            # LAST resort (raised inside admit_device_alloc only when not
+            # enough bytes were demotable).  Disarmed / no manager: no-op.
+            eng = getattr(self, "_engine", None)
+            mgr = getattr(eng, "residency", None) if eng is not None else None
+            if mgr is not None and _res.tier_enabled():
+                delta = (self._projected_device_bytes(new_cap)
+                         - self._projected_device_bytes(self._cap))
+                mgr.admit_device_alloc(
+                    self._target_device(), delta,
+                    exclude=(getattr(self, "name", ""),),
+                )
         device = self._target_device()
         dev_id = getattr(device, "id", 0) if device is not None else 0
         # device allocation chokepoint (ISSUE 19): the injected and the
@@ -717,6 +735,22 @@ class DeviceRowBank:
             return len(self._pending)
 
 
+# live record-backed banks by (store identity, record name): the residency
+# demoter's dirty probe consults this to pin banks with PENDING rows HOT —
+# demoting mid-accumulation would still be correct (the mirror holds the
+# rows) but would turn the next flush into a promote+flush double transfer.
+# Weak values: a dropped index's bank unregisters itself by dying.
+_LIVE_BANKS: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
+def bank_has_pending(store, name: str) -> bool:
+    """Lock-free dirty probe for the residency plane (len() of a dict is
+    GIL-atomic; advisory — a racing flush re-touches the record and the
+    touch clock pins it anyway)."""
+    bank = _LIVE_BANKS.get((id(store), name))
+    return bank is not None and len(getattr(bank, "_pending", ())) > 0
+
+
 class RecordRowBank(DeviceRowBank):
     """DeviceRowBank whose planes live inside a DeviceStore StateRecord —
     placement commits them to the slot-owner device at creation, fenced
@@ -752,11 +786,19 @@ class RecordRowBank(DeviceRowBank):
                         arrays={},
                     ),
                 )
+        _LIVE_BANKS[(id(engine.store), name)] = self
 
     def _rec(self):
         rec = self._engine.store.get_unguarded(self.name)
         if rec is None:
             raise KeyError(f"vector bank '{self.name}' was dropped")
+        # residency fault-in (ISSUE 20): EVERY bank plane read/write funnels
+        # through here, so a demoted bank promotes before any caller can
+        # observe its released arrays.  Same one-load disarm guard as the
+        # store getters (tests/test_perf_smoke.py discovers these lines).
+        plane = _res._tier_plane
+        if plane is not None and rec.tier is not _res.HOT:
+            plane.on_record_access(self._engine.store, self.name, rec)
         return rec
 
     def _get_planes(self):

@@ -191,6 +191,54 @@ def test_device_fault_guard_sites_discovered_and_zero_alloc_disarmed():
     )
 
 
+def test_residency_guard_sites_discovered_and_zero_alloc_disarmed():
+    """The tiered-residency getters (core/store.py) and the vector bank's
+    record accessor (services/vector.py) follow the same one-global-load
+    guard discipline: discoverable `plane is not None` / `plane.on_` lines,
+    and the hottest site — the DeviceStore getter — allocates NOTHING at
+    those lines with the tier plane disarmed (RTPU_NO_TIER semantics)."""
+    import tracemalloc
+
+    import redisson_tpu
+    import redisson_tpu.core.store as store_mod
+    import redisson_tpu.services.vector as vec
+    from redisson_tpu.core import residency as _res
+
+    for mod in (store_mod, vec):
+        _path, guards = _guard_lines(mod)
+        assert guards, f"no tier-plane guard lines found in {mod.__name__}"
+
+    prev = _res.set_tier(False)
+    client = redisson_tpu.create()
+    try:
+        eng = client._engine
+        bf = client.get_bloom_filter("perf:res")
+        assert bf.try_init(10_000, 0.01)
+        bf.add("warm")
+        eng.store.get("perf:res")  # warm every lazy path before tracing
+        path, guards = _guard_lines(store_mod)
+        tracemalloc.start(1)
+        try:
+            for _ in range(200):
+                eng.store.get("perf:res")
+            snap = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        offenders = [
+            (tb.lineno, stat.size)
+            for stat in snap.statistics("lineno")
+            for tb in [stat.traceback[0]]
+            if tb.filename == path and tb.lineno in guards and stat.size > 0
+        ]
+        assert not offenders, (
+            f"tier-plane guard lines allocated with the plane DISARMED: "
+            f"{offenders}"
+        )
+    finally:
+        client.shutdown()
+        _res.set_tier(prev)
+
+
 # -- coalesced dispatch equivalence ------------------------------------------
 
 def test_coalesced_run_matches_per_filter_dispatch():

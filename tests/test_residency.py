@@ -1,0 +1,699 @@
+"""Tiered HBM residency (ISSUE 20): HOT/WARM/COLD demotion, fault-in on
+first touch, the CLUSTER RESIDENCY verb, and the fleet pressure rebalancer.
+
+Contracts pinned here:
+  * a WARM->HOT promotion costs exactly ONE packed H2D (scatter_host_arrays
+    once, no per-array fallback) and ZERO kernel rebuilds — the warm pool
+    re-hits across demote/promote and across a bank reshard (grow);
+  * replies are bit-identical armed-with-demotions vs disarmed
+    (RTPU_NO_TIER=1), under the native wire plane and RTPU_NO_NATIVE=1;
+  * fenced (migrating/importing/recovering) slots never demote, even
+    force=True — handoff serializers own those records;
+  * a tier change is invisible to the tracking plane (no version bump, no
+    invalidation push); a real write after demotion still invalidates;
+  * unsharded bank growth over device-budget-bytes demotes colder records
+    FIRST and raises VectorBudgetError only when not enough was demotable
+    (the refuse-vs-demote boundary);
+  * census rows drain to absence on DEL (spill file GC'd, dev rows gone);
+  * COLD spill files are CRC-verified (torn/forged files refuse to load);
+  * the ResidencyRebalancer control loop sweeps first, sheds persistent
+    pressure, and degrades per-node when a member is unreachable.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu.core import residency as _res
+from redisson_tpu.core.engine import Engine
+from redisson_tpu.net.client import Connection
+from redisson_tpu.net.resp import RespError
+from redisson_tpu.server.server import ServerThread
+
+
+@pytest.fixture()
+def armed_budget():
+    """Arm the plane, hand the test set_device_budget_bytes, restore both."""
+    prev_tier = _res.set_tier(True)
+    prev_budget = _res.set_device_budget_bytes(0)
+    try:
+        yield _res.set_device_budget_bytes
+    finally:
+        _res.set_device_budget_bytes(prev_budget)
+        _res.set_tier(prev_tier)
+
+
+def _conn(st, handler=None):
+    c = Connection(st.server.host, st.server.port, timeout=30.0)
+    if handler is not None:
+        c.push_handler = handler
+    return c
+
+
+# -- spill container: CRC-verified round trip ---------------------------------
+
+
+def test_spill_round_trip_and_crc_corruption(tmp_path):
+    from redisson_tpu.core.checkpoint import CheckpointCorruptError
+
+    arrays = {
+        "bits": np.arange(777, dtype=np.uint64),
+        "flags": np.array([True, False, True]),
+    }
+    path = str(tmp_path / "r.spill")
+    n = _res.write_spill(path, arrays)
+    assert n == os.path.getsize(path)
+    back = _res.load_spill(path)
+    assert set(back) == {"bits", "flags"}
+    np.testing.assert_array_equal(back["bits"], arrays["bits"])
+    np.testing.assert_array_equal(back["flags"], arrays["flags"])
+
+    # flip one payload byte: the CRC trailer must refuse the file
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(CheckpointCorruptError):
+        _res.load_spill(path)
+
+    # truncation (torn write) refuses too
+    open(path, "wb").write(bytes(blob[: len(blob) // 3]))
+    with pytest.raises(CheckpointCorruptError):
+        _res.load_spill(path)
+
+
+# -- embedded demote / fault-in correctness -----------------------------------
+
+
+def test_demote_promote_read_through_warm_and_cold(armed_budget):
+    client = redisson_tpu.create()
+    eng = client._engine
+    mgr = eng.enable_residency(min_idle_s=0.0)
+    try:
+        bf = client.get_bloom_filter("res:f")
+        assert bf.try_init(20_000, 0.01)
+        keys = [f"k{i}" for i in range(300)]
+        bf.add_all(keys)
+        baseline = np.asarray(bf.contains_each(keys))
+        assert baseline.all()
+        assert mgr.tier_of("res:f") == _res.HOT
+
+        assert mgr.demote("res:f", force=True)
+        assert mgr.tier_of("res:f") == _res.WARM
+        rec = eng.store.get_unguarded("res:f")
+        assert not rec.arrays and rec.stash is not None
+
+        # WARM read-through: first touch faults in, replies identical
+        np.testing.assert_array_equal(
+            np.asarray(bf.contains_each(keys)), baseline
+        )
+        assert mgr.tier_of("res:f") == _res.HOT
+        assert mgr.promotions == 1
+
+        # COLD: HOT -> WARM -> spill file -> read-through again
+        assert mgr.demote("res:f", cold=True, force=True)
+        assert mgr.tier_of("res:f") == _res.COLD
+        assert rec.cold_path is not None and os.path.exists(rec.cold_path)
+        assert rec.stash is None
+        np.testing.assert_array_equal(
+            np.asarray(bf.contains_each(keys)), baseline
+        )
+        assert mgr.tier_of("res:f") == _res.HOT
+        assert mgr.cold_loads == 1 and mgr.promotions == 2
+        assert rec.cold_path is None
+    finally:
+        client.shutdown()
+
+
+def test_promotion_costs_exactly_one_h2d(armed_budget, monkeypatch):
+    import redisson_tpu.core.ioplane as iop
+
+    client = redisson_tpu.create()
+    eng = client._engine
+    mgr = eng.enable_residency(min_idle_s=0.0)
+    try:
+        bf = client.get_bloom_filter("res:h2d")
+        assert bf.try_init(50_000, 0.01)
+        bf.add_all([f"m{i}" for i in range(200)])
+
+        scatters = []
+        orig = iop.scatter_host_arrays
+        monkeypatch.setattr(
+            iop, "scatter_host_arrays",
+            lambda arrays, device, pool=None: (
+                scatters.append(len(arrays)),
+                orig(arrays, device, pool=pool),
+            )[1],
+        )
+        puts = []
+        import jax
+
+        orig_put = jax.device_put
+        monkeypatch.setattr(
+            jax, "device_put",
+            lambda *a, **kw: (puts.append(1), orig_put(*a, **kw))[1],
+        )
+        # a HOT probe's own device_put budget (query-key upload etc.) — the
+        # promotion contract is +1 on top of this, the merged-stash upload
+        assert bf.contains("m5") and bf.contains("m6")  # warm lazy paths
+        puts.clear()
+        assert bf.contains("m7")
+        base = len(puts)
+
+        puts.clear()
+        assert mgr.demote("res:h2d", force=True)
+        assert not scatters and not puts  # demotion is D2H only
+        assert bf.contains("m8")          # first touch: the fault-in
+        assert len(scatters) == 1, (
+            f"promotion took {len(scatters)} packed uploads, contract is 1"
+        )
+        assert len(puts) == base + 1, (
+            f"promotion cost {len(puts) - base} H2D transfers beyond the "
+            f"probe's own {base}, contract is 1 (per-array fallback?)"
+        )
+        assert mgr.tier_of("res:h2d") == _res.HOT
+        # steady HOT traffic pays zero further uploads
+        puts.clear()
+        assert bf.contains("m9")
+        assert len(scatters) == 1 and len(puts) == base
+    finally:
+        client.shutdown()
+
+
+def test_zero_kernel_rebuilds_across_demote_promote_and_reshard(armed_budget):
+    from redisson_tpu.core import warmpool
+    from redisson_tpu.services.search import SearchService
+    from redisson_tpu.services.vector import DEFAULT_BLOCK, bank_record_name
+
+    client = redisson_tpu.create()
+    eng = client._engine
+    mgr = eng.enable_residency(min_idle_s=0.0)
+    try:
+        bf = client.get_bloom_filter("res:wp")
+        assert bf.try_init(30_000, 0.01)
+        keys = [f"w{i}" for i in range(128)]
+        bf.add_all(keys)
+        baseline = np.asarray(bf.contains_each(keys))
+        warms0 = warmpool.POOL.warms
+        for cold in (False, True):
+            assert mgr.demote("res:wp", cold=cold, force=True)
+            np.testing.assert_array_equal(
+                np.asarray(bf.contains_each(keys)), baseline
+            )
+        assert warmpool.POOL.warms == warms0, (
+            "demote/promote rebuilt kernels — same geometry must re-hit"
+        )
+
+        # reshard (bank grow = new geometry) warms once; a tier cycle on the
+        # GROWN bank must then re-hit with zero further rebuilds
+        svc = SearchService(eng)
+        svc.create_index("wi", {"emb": "VECTOR"}, vector={"emb": {"dim": 16}})
+        rng = np.random.default_rng(7)
+        for i in range(DEFAULT_BLOCK + 9):  # crosses one grow boundary
+            svc.add_document("wi", f"d{i}", {
+                "emb": rng.standard_normal(16).astype(np.float32)
+            })
+        q = rng.standard_normal(16).astype(np.float32)
+
+        def _knn():
+            dev, finish = svc.knn("wi", "emb", q, 5)
+            if dev is None:
+                return finish(None)[0]
+            return finish(tuple(np.asarray(v) for v in dev))[0]
+
+        res0 = _knn()
+        warms1 = warmpool.POOL.warms
+        bank = bank_record_name("wi", "emb")
+        assert mgr.demote(bank, force=True)
+        assert _knn() == res0
+        assert warmpool.POOL.warms == warms1, (
+            "tier cycle after a reshard rebuilt kernels"
+        )
+    finally:
+        client.shutdown()
+
+
+# -- refuse-vs-demote boundary (the VectorBudgetError bugfix) ------------------
+
+
+def test_unsharded_growth_demotes_colder_records_before_refusing(armed_budget):
+    from redisson_tpu.services.search import SearchService
+    from redisson_tpu.services.vector import (
+        DEFAULT_BLOCK, VectorBudgetError, bank_record_name,
+    )
+
+    eng = Engine()
+    mgr = eng.enable_residency(min_idle_s=0.0)
+    svc = SearchService(eng)
+    rng = np.random.default_rng(3)
+    dim = 64
+
+    import itertools
+
+    seq = itertools.count()
+
+    def _fill(index, n):
+        for _ in range(n):
+            svc.add_document(index, f"{index}:d{next(seq)}", {
+                "emb": rng.standard_normal(dim).astype(np.float32)
+            })
+
+    q = np.ones(dim, np.float32)
+
+    def _knn(index):
+        dev, finish = svc.knn(index, "emb", q, 5)
+        return (finish(None) if dev is None else finish(
+            tuple(np.asarray(v) for v in dev)
+        ))[0]
+
+    svc.create_index("ia", {"emb": "VECTOR"}, vector={"emb": {"dim": dim}})
+    _fill("ia", DEFAULT_BLOCK)
+    res_a = _knn("ia")  # flushes pending: bank A is clean
+    bank_a = bank_record_name("ia", "emb")
+    hot_a = sum(mgr.hot_bytes_by_device().values())
+    assert hot_a > 0
+    # budget fits bank A plus slack — NOT a second bank
+    armed_budget(hot_a + 4096)
+
+    # growth of a second bank demotes idle bank A instead of refusing
+    svc.create_index("ib", {"emb": "VECTOR"}, vector={"emb": {"dim": dim}})
+    _fill("ib", DEFAULT_BLOCK)  # no VectorBudgetError raised
+    assert mgr.tier_of(bank_a) == _res.WARM, (
+        "growth admission did not demote the colder bank first"
+    )
+    assert mgr.demotions_warm >= 1
+
+    # further growth finds NOTHING left demotable (A already warm, B is the
+    # grower itself) — refuse is the last resort, not the first
+    with pytest.raises(VectorBudgetError):
+        _fill("ib", DEFAULT_BLOCK + 1)
+    assert mgr.tier_of(bank_a) == _res.WARM
+
+    # lifting the budget lets A fault back in bit-identically
+    armed_budget(0)
+    assert _knn("ia") == res_a
+    assert mgr.tier_of(bank_a) == _res.HOT
+
+
+# -- census drain-to-absence ---------------------------------------------------
+
+
+def test_census_rows_drain_to_absence_on_delete(armed_budget):
+    client = redisson_tpu.create()
+    eng = client._engine
+    mgr = eng.enable_residency(min_idle_s=0.0)
+    try:
+        bf = client.get_bloom_filter("res:gone")
+        assert bf.try_init(20_000, 0.01)
+        bf.add_all([f"g{i}" for i in range(64)])
+        assert any(
+            k.startswith("residency_bytes_dev") and k.endswith("_hot")
+            for k in mgr.census()
+        )
+        assert mgr.demote("res:gone", cold=True, force=True)
+        spill = eng.store.get_unguarded("res:gone").cold_path
+        assert spill and os.path.exists(spill)
+        assert any(k.endswith("_cold") for k in mgr.census())
+
+        assert eng.store.delete("res:gone")
+        mgr.sweep()  # GC pass
+        rows = mgr.census()
+        assert not any(k.startswith("residency_bytes_dev") for k in rows), rows
+        assert not os.path.exists(spill), "orphaned spill survived the GC"
+    finally:
+        client.shutdown()
+
+
+# -- fences: migrating slots never demote -------------------------------------
+
+
+def test_fenced_slot_never_demotes_even_forced():
+    from redisson_tpu.utils.crc16 import calc_slot
+
+    with ServerThread(port=0, workers=2) as st:
+        srv = st.server
+        c = _conn(st)
+        try:
+            prev_tier = _res.tier_enabled()
+            prev_budget = _res.DEVICE_BUDGET_BYTES
+            srv.enable_residency(min_idle_s=0.0)
+            mgr = srv.engine.residency
+            assert c.execute("BF.RESERVE", "res:fence", "0.01", "10000") == b"OK"
+            c.execute("BF.MADD", "res:fence", "a", "b", "c")
+            slot = calc_slot(b"res:fence")
+
+            for table in (srv.migrating_slots, srv.importing_slots,
+                          srv.recovering_slots):
+                table[slot] = "peer"
+                try:
+                    assert not mgr.demote("res:fence", force=True)
+                    assert c.execute(
+                        "CLUSTER", "RESIDENCY", "DEMOTE", "res:fence"
+                    ) == 0
+                    assert mgr.tier_of("res:fence") == _res.HOT
+                finally:
+                    del table[slot]
+
+            # fence lifted: the same demotion goes through
+            assert c.execute(
+                "CLUSTER", "RESIDENCY", "DEMOTE", "res:fence"
+            ) == 1
+            assert mgr.tier_of("res:fence") == _res.WARM
+        finally:
+            c.close()
+            _res.set_device_budget_bytes(prev_budget)
+            _res.set_tier(prev_tier)
+
+
+# -- tracking: a tier change is not a write -----------------------------------
+
+
+def test_demotion_sends_no_invalidation_but_writes_still_do():
+    with ServerThread(port=0, workers=2) as st:
+        srv = st.server
+        pushes = []
+        a = _conn(st, handler=pushes.append)
+        w = _conn(st)
+        try:
+            prev_tier = _res.tier_enabled()
+            prev_budget = _res.DEVICE_BUDGET_BYTES
+            srv.enable_residency(min_idle_s=0.0)
+            mgr = srv.engine.residency
+            assert w.execute("BF.RESERVE", "res:trk", "0.01", "10000") == b"OK"
+            w.execute("BF.MADD", "res:trk", "x", "y")
+            a.execute("CLIENT", "TRACKING", "ON")
+            assert a.execute("BF.EXISTS", "res:trk", "x") == 1  # registers
+            rec = srv.engine.store.get_unguarded("res:trk")
+            v0 = rec.version
+
+            assert mgr.demote("res:trk", cold=True, force=True)
+            a.execute("PING")  # drain any (wrong) push
+            assert rec.version == v0, "tier change bumped the version"
+            assert not pushes, f"demotion invalidated tracked caches: {pushes}"
+
+            # a REAL write still invalidates the registration
+            w.execute("BF.ADD", "res:trk", "z")
+            deadline = time.time() + 5
+            while time.time() < deadline and not pushes:
+                a.execute("PING")
+                time.sleep(0.01)
+            assert any(
+                p and p[0] == b"invalidate" and b"res:trk" in p[1]
+                for p in pushes
+            ), pushes
+        finally:
+            a.close()
+            w.close()
+            _res.set_device_budget_bytes(prev_budget)
+            _res.set_tier(prev_tier)
+
+
+# -- the CLUSTER RESIDENCY verb ------------------------------------------------
+
+
+def test_cluster_residency_verb_table_tier_demote_sweep():
+    with ServerThread(port=0, workers=2) as st:
+        c = _conn(st)
+        try:
+            prev_tier = _res.tier_enabled()
+            prev_budget = _res.DEVICE_BUDGET_BYTES
+            # disarmed: short table, TIER is hot by construction, mutators err
+            t = c.execute("CLUSTER", "RESIDENCY")
+            assert t[0] == 0
+            assert c.execute("CLUSTER", "RESIDENCY", "TIER", "nope") == b"hot"
+            err = c.execute("CLUSTER", "RESIDENCY", "SWEEP")
+            assert isinstance(err, RespError) and "residency plane" in str(err)
+
+            assert c.execute(
+                "CONFIG", "SET", "device-budget-bytes", "1000000"
+            ) == b"OK"
+            assert c.execute(
+                "CONFIG", "SET", "residency-enabled", "yes"
+            ) == b"OK"
+            view = c.execute("CONFIG", "GET", "residency-enabled")
+            assert view == [b"residency-enabled", b"1"]
+
+            assert c.execute("BF.RESERVE", "res:v", "0.01", "10000") == b"OK"
+            c.execute("BF.MADD", "res:v", *[f"v{i}" for i in range(50)])
+            table = c.execute("CLUSTER", "RESIDENCY")
+            assert table[0] == 1 and table[1] == 1000000
+            devrows = [r for r in table[2:] if r and r[0] == b"DEV"]
+            ctr = [r for r in table[2:] if r and r[0] == b"CTR"]
+            assert devrows and devrows[0][2] > 0  # hot bytes
+            assert len(ctr) == 1 and len(ctr[0]) == 7
+
+            assert c.execute(
+                "CLUSTER", "RESIDENCY", "DEMOTE", "res:v"
+            ) == 1
+            assert c.execute(
+                "CLUSTER", "RESIDENCY", "TIER", "res:v"
+            ) == b"warm"
+            assert c.execute(
+                "CLUSTER", "RESIDENCY", "DEMOTE", "res:v", "COLD"
+            ) == 1
+            assert c.execute(
+                "CLUSTER", "RESIDENCY", "TIER", "res:v"
+            ) == b"cold"
+            # data read faults it back in transparently
+            assert c.execute("BF.EXISTS", "res:v", "v7") == 1
+            assert c.execute(
+                "CLUSTER", "RESIDENCY", "TIER", "res:v"
+            ) == b"hot"
+            swept = c.execute("CLUSTER", "RESIDENCY", "SWEEP")
+            assert isinstance(swept, list) and len(swept) == 3
+
+            err = c.execute("CLUSTER", "RESIDENCY", "TIER", "missing")
+            assert isinstance(err, RespError) and "no such key" in str(err)
+            err = c.execute("CLUSTER", "RESIDENCY", "BOGUS")
+            assert isinstance(err, RespError)
+            assert "unknown CLUSTER RESIDENCY" in str(err)
+
+            # disarm over the wire: table drops back, data still served
+            assert c.execute(
+                "CONFIG", "SET", "residency-enabled", "no"
+            ) == b"OK"
+            assert c.execute("CLUSTER", "RESIDENCY")[0] == 0
+            assert c.execute("BF.EXISTS", "res:v", "v7") == 1
+        finally:
+            c.close()
+            _res.set_device_budget_bytes(prev_budget)
+            _res.set_tier(prev_tier)
+
+
+# -- disarmed A/B wire bit-identity --------------------------------------------
+
+_AB_DRIVER = r"""
+import hashlib, os, socket
+from redisson_tpu.net import resp
+from redisson_tpu.server.server import ServerThread
+
+ARMED = os.environ.get("AB_ARMED") == "1"
+with ServerThread(port=0, workers=2) as st:
+    srv = st.server
+    if ARMED:
+        srv.enable_residency(min_idle_s=0.0)
+    s = socket.create_connection((srv.host, srv.port), timeout=30)
+    parser = resp.RespParser(use_native=False)
+    h = hashlib.sha256()
+
+    def run(cmds):
+        s.sendall(b"".join(resp.encode_command_python(*c) for c in cmds))
+        got = 0
+        while got < len(cmds):
+            data = s.recv(1 << 16)
+            assert data, "server closed early"
+            h.update(data)
+            got += len(parser.feed(data))
+
+    def cycle():
+        # armed leg: force a WARM then COLD round between reply waves; the
+        # disarmed leg does nothing — the digests must match anyway
+        if ARMED:
+            mgr = srv.engine.residency
+            assert mgr.demote("ab:f", force=True)
+            assert mgr.demote("ab:f", cold=True, force=True)
+
+    run([("BF.RESERVE", "ab:f", "0.01", "20000")]
+        + [("BF.MADD", "ab:f", *[f"k{i}" for i in range(j, j + 50)])
+           for j in range(0, 500, 50)]
+        + [("SET", "ab:b", "v1"), ("GET", "ab:b")])
+    cycle()
+    run([("BF.MEXISTS", "ab:f", *[f"k{i}" for i in range(0, 500, 7)])])
+    cycle()
+    run([("BF.EXISTS", "ab:f", "k3"), ("BF.EXISTS", "ab:f", "nope"),
+         ("BF.INFO", "ab:f"), ("GET", "ab:b"),
+         ("BF.MEXISTS", "ab:f", *[f"k{i}" for i in range(100, 200, 3)])])
+    s.close()
+print(h.hexdigest())
+"""
+
+
+def test_wire_replies_bit_identical_armed_vs_disarmed_both_wire_planes():
+    """ISSUE 20 acceptance: reply streams are byte-identical with the plane
+    disarmed (RTPU_NO_TIER=1) vs armed with forced WARM/COLD cycles between
+    waves — under the native wire plane AND RTPU_NO_NATIVE=1."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    digests = {}
+    for wire, wire_env in (("native", {}), ("pyfallback", {"RTPU_NO_NATIVE": "1"})):
+        for mode, mode_env in (
+            ("armed", {"AB_ARMED": "1"}),
+            ("disarmed", {"AB_ARMED": "0", "RTPU_NO_TIER": "1"}),
+        ):
+            env = dict(os.environ, JAX_PLATFORMS="cpu", **wire_env, **mode_env)
+            out = subprocess.run(
+                [sys.executable, "-c", _AB_DRIVER],
+                capture_output=True, text=True, timeout=240, cwd=repo, env=env,
+            )
+            assert out.returncode == 0, (wire, mode, out.stdout, out.stderr)
+            digests[(wire, mode)] = out.stdout.strip().splitlines()[-1]
+    assert len(set(digests.values())) == 1, digests
+    assert len(next(iter(digests.values()))) == 64
+
+
+def test_plane_disarmed_by_default_and_env_killswitch_beats_arm():
+    """The getter guard starts disarmed (armed-with-no-manager measurably
+    taxed the interactive QoS p99 for nothing) and RTPU_NO_TIER=1 must
+    refuse set_tier(True) — the operator's bit-identity guarantee beats any
+    in-process arm, including CONFIG SET residency-enabled yes."""
+    script = (
+        "import os\n"
+        "from redisson_tpu.core import residency as _res\n"
+        "assert _res.tier_enabled() is False, 'must start disarmed'\n"
+        "prev = _res.set_tier(True)\n"
+        "assert prev is False\n"
+        "want = os.environ.get('RTPU_NO_TIER') != '1'\n"
+        "assert _res.tier_enabled() is want, (_res.tier_enabled(), want)\n"
+        "if not want:\n"
+        "    _res.set_tier(False)\n"
+        "    from redisson_tpu.server.server import ServerThread\n"
+        "    with ServerThread(port=0, workers=2) as st:\n"
+        "        st.server.enable_residency(min_idle_s=0.0)\n"
+        "        assert st.server.engine.residency is None, 'enable must refuse'\n"
+        "        assert _res.tier_enabled() is False\n"
+        "print('ok')\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for extra in ({}, {"RTPU_NO_TIER": "1"}):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **extra)
+        if not extra:
+            env.pop("RTPU_NO_TIER", None)
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=120, cwd=repo, env=env,
+        )
+        assert out.returncode == 0, (extra, out.stdout, out.stderr)
+        assert out.stdout.strip().endswith("ok")
+
+
+# -- the fleet pressure rebalancer --------------------------------------------
+
+
+def _table(armed, budget, devs):
+    rows = [1 if armed else 0, budget]
+    for d, (hot, warm, cold) in devs.items():
+        rows.append([b"DEV", d, hot, warm, cold])
+    rows.append([b"CTR", 0, 0, 0, 0, b"0.0", b"0.0"])
+    return rows
+
+
+class _FakeNode:
+    """Conn factory double: serves a mutable CLUSTER RESIDENCY table and
+    records every issued command."""
+
+    def __init__(self, table):
+        self.table = table
+        self.cmds = []
+        self.fail_issues = False
+        self.dead = False
+
+    def factory(self):
+        node = self
+
+        class _C:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+            def execute(self, *args):
+                if args == ("CLUSTER", "RESIDENCY"):
+                    return node.table
+                node.cmds.append(args)
+                if node.fail_issues:
+                    raise RespError("ERR TRYAGAIN rebalance in flight")
+                return b"OK"
+
+        def open_conn():
+            if node.dead:
+                raise ConnectionRefusedError("down")
+            return _C()
+
+        return open_conn
+
+
+def test_parse_residency_table():
+    from redisson_tpu.cluster.residency_control import parse_residency_table
+
+    armed, budget, devs = parse_residency_table(
+        _table(True, 1 << 20, {0: (900, 10, 5), 3: (1, 2, 3)})
+    )
+    assert armed and budget == 1 << 20
+    assert devs == {0: (900, 10, 5), 3: (1, 2, 3)}
+    # CTR row skipped, malformed replies degrade to empty
+    assert parse_residency_table(None) == (False, 0, {})
+    assert parse_residency_table([0]) == (False, 0, {})
+    assert parse_residency_table([0, 5]) == (False, 5, {})
+
+
+def test_rebalancer_sweeps_first_then_sheds_persistent_pressure(tmp_path):
+    from redisson_tpu.cluster.residency_control import ResidencyRebalancer
+
+    node = _FakeNode(_table(True, 1000, {0: (950, 0, 0), 1: (100, 0, 0)}))
+    rb = ResidencyRebalancer(
+        {"n1": node.factory()}, high_water=0.9, shed_after=2, shed_count=4,
+        journal_dir=str(tmp_path),
+    )
+    # sweep 1: pressured dev0 gets a demote-first SWEEP, healthy dev1 nothing
+    assert rb.step() == [("n1", "sweep", 0)]
+    assert node.cmds[-1] == ("CLUSTER", "RESIDENCY", "SWEEP")
+    # sweep 2: still pressured -> SHED with the bounded bite + journal dir
+    assert rb.step() == [("n1", "shed", 0)]
+    assert node.cmds[-1] == ("CLUSTER", "RESIDENCY", "SHED", "0",
+                             "COUNT", "4", "DIR", str(tmp_path))
+    assert rb.sweeps_issued == 1 and rb.sheds_issued == 1
+    # shed resets the streak: next tick demotes-first again
+    assert rb.step() == [("n1", "sweep", 0)]
+    # pressure relieved: streak clears, nothing issued
+    node.table = _table(True, 1000, {0: (100, 850, 0), 1: (100, 0, 0)})
+    assert rb.step() == []
+    node.table = _table(True, 1000, {0: (950, 0, 0)})
+    assert rb.step() == [("n1", "sweep", 0)]  # streak restarted at 1
+
+
+def test_rebalancer_degrades_on_dead_nodes_unarmed_nodes_and_push_errors():
+    from redisson_tpu.cluster.residency_control import ResidencyRebalancer
+
+    node = _FakeNode(_table(True, 1000, {0: (950, 0, 0)}))
+    rb = ResidencyRebalancer({"n1": node.factory()}, shed_after=2)
+    assert rb.step() == [("n1", "sweep", 0)]
+    # a concurrent rebalance makes the SHED raise: push_errors, loop survives
+    node.fail_issues = True
+    assert rb.step() == []
+    assert rb.push_errors == 1
+    node.fail_issues = False
+    # node death: contributes nothing, receives nothing, no exception
+    node.dead = True
+    assert rb.step() == []
+    node.dead = False
+    # disarmed node clears its pressure bookkeeping entirely
+    node.table = _table(False, 1000, {0: (950, 0, 0)})
+    assert rb.step() == []
+    assert not rb._pressure
+    # override budget: operator ceiling beats the node's scraped budget
+    node.table = _table(True, 10**9, {0: (950, 0, 0)})
+    rb2 = ResidencyRebalancer({"n1": node.factory()}, budget_bytes=1000)
+    assert rb2.step() == [("n1", "sweep", 0)]

@@ -235,3 +235,30 @@ def test_host_fleet_soak_two_cycle_host_kill_matrix():
     assert report.restarts == 4                # co-victim replica + old target
     assert report.acked_writes > 0 and report.verified_writes > 0
     assert report.bloom_keys_verified > 0
+
+
+# -- tiered residency soak (ISSUE 20) ------------------------------------------
+
+
+@pytest.mark.slow
+def test_residency_soak_overcommit_storm_and_recall():
+    """The ISSUE 20 soak acceptance: zipf tenant banks overcommitting the
+    armed per-device budget 4x, read/written under transport faults while
+    slots rebalance across devices and the ResidencyRebalancer sheds
+    pressured devices through the journaled fenced driver — zero acked-write
+    loss, zero stale tracked reads, post-storm recall >= 0.99 for
+    demoted-then-promoted banks, per-tier census flat at quiesce."""
+    from redisson_tpu.chaos.soak import (
+        ResidencySoakConfig, ResidencySoakHarness,
+    )
+
+    report = ResidencySoakHarness(ResidencySoakConfig(
+        cycles=2, seed=11,
+    )).run()
+    assert report.cycles_completed == 2
+    assert report.stale_reads == 0
+    assert report.writes_acked > 0 and report.tenant_probes > 0
+    assert report.promotions > 0 and report.demotions_warm > 0
+    assert report.rebalances == 4              # shrink + restore, twice
+    assert report.post_storm_recall >= 0.99
+    assert len(report.tier_census) == 2        # the flat quiesce snapshots

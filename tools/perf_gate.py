@@ -109,6 +109,13 @@ METRICS = [
     # (the speedup runs under the config5d CPU-replica occupancy model,
     # auto-disarmed on a real TPU).
     ("config7 sharded knn qps", ("details", "config7_sharded_knn_qps"), True, True),
+    # config8 (ISSUE 20): tiered-HBM overcommit — zipf tenants at >=4x the
+    # device budget served through demote-to-host + fault-in-on-first-touch.
+    # Throughput gated relative (n/a-pass first sight); the hot-hit floor
+    # and fault-in p99 ceiling bind absolutely below (the residency plane
+    # may never buy throughput by thrashing or stalling).
+    ("config8 overcommit ops/s", ("details", "config8_overcommit_ops_per_sec"), True, True),
+    ("config8 hot hit ratio", ("details", "config8_hot_hit_ratio"), True, False),
     # observability (ISSUE 12): armed-vs-disarmed tracing throughput ratio
     # from tools/obs_overhead_bench.py — advisory relative row (n/a-pass
     # first sight); the binding bound is the ABSOLUTE floor below (armed
@@ -160,6 +167,12 @@ FLOORS = [
     # 1-replica read QPS on the zipf blob-read mix, from first sight
     ("config6r read qps scaling >= 2.5x",
      ("details", "config6r_read_qps_scaling"), 2.5),
+    # ISSUE 20: the LRU clock must keep the zipf head device-resident —
+    # >=90% of probe calls under 4x overcommit served with no fault-in
+    ("config8 hot hit ratio >= 0.9",
+     ("details", "config8_hot_hit_ratio"), 0.9),
+    ("config8 overcommit ratio >= 4x",
+     ("details", "config8_overcommit_ratio"), 4.0),
 ]
 
 # (label, extractor-path, maximum) — ABSOLUTE ceilings, same first-sight
@@ -187,6 +200,11 @@ CEILINGS = [
     # heartbeat keeps a healthy replica an order of magnitude fresher)
     ("config6r staleness p99 ms <= 1500",
      ("details", "config6r_staleness_p99_ms"), 1500.0),
+    # ISSUE 20: a fault-in is one packed H2D plus (COLD) one verified spill
+    # read — p99 must stay a bounded hiccup; anything near this ceiling
+    # means promotion is rebuilding kernels or fighting the lane gate
+    ("config8 fault-in p99 ms <= 250",
+     ("details", "config8_fault_in_p99_ms"), 250.0),
 ]
 
 
@@ -299,17 +317,20 @@ def render(rows, threshold: float) -> str:
         "cold, config6 reduction, config6r read scaling, config2q "
         "interactive p99, config2q fairness, config2q preempt p99, "
         "config2q cluster fairness, config7 knn qps, config7 ivf "
-        "qps, or config7 sharded qps fails; other drops are advisory "
+        "qps, config7 sharded qps, or config8 overcommit ops/s fails; "
+        "other drops are advisory "
         "(WARN); a metric absent from the baseline reads n/a and passes "
         "(recorded on first sight).  Absolute floors (config6 reduction "
         ">= 10x, config6r read scaling >= 2.5x, config2q speedup vs "
         "no-qos >= 1.2x, config2q preempt speedup vs no-preempt >= 1.2x, "
         "config7 recall@10 >= 0.99, ivf recall >= 0.97 + "
         "ivf speedup >= 2x, int8 recall >= 0.95, sharded recall >= 0.99 + "
-        "sharded speedup vs 1 shard >= 1.5x, armed tracing ratio >= 0.97) "
+        "sharded speedup vs 1 shard >= 1.5x, armed tracing ratio >= 0.97, "
+        "config8 hot-hit >= 0.9 + overcommit >= 4x) "
         "and ceilings (config2q fairness <= 2x, config2q cluster admitted "
         "ratio <= 1.5x + cluster fairness <= 2x, int8 bytes ratio <= "
-        "0.35x, config6r staleness p99 <= 1500ms) bind from first sight."
+        "0.35x, config6r staleness p99 <= 1500ms, config8 fault-in p99 <= "
+        "250ms) bind from first sight."
     )
     return "\n".join(out)
 

@@ -48,6 +48,16 @@
     tracked reads (a device move must be invisible to the tracking plane),
     near-cache convergence after quiesce, per-device lane census flat, and
     zero host-side cross-device gathers (IOStats.host_colocations == 0).
+  * ``residency`` — the tiered-HBM residency profile (ISSUE 20): zipf
+    tenant bloom banks whose combined footprint is 4x the armed per-device
+    byte budget keep serving membership probes (demote-to-host + fault-in
+    on first touch) plus tracked bucket readers, under transport faults,
+    while the slot table rebalances 8 -> 4 -> 8 AND the
+    ResidencyRebalancer control loop sheds pressured devices through the
+    journaled fenced rebalance.  Asserts zero acked-write loss, zero
+    stale tracked reads, post-storm recall >= 0.99 for banks force-spilled
+    COLD and faulted back, per-tier census flat at quiesce, and a DELed
+    COLD bank draining its census rows and spill file to absence.
   * ``device-fault`` — the device fault-domain profile (ISSUE 19): mixed
     bucket/bloom/KNN traffic plus tracked readers against one
     device-sharded server while device lanes are killed (kernel-launch
@@ -122,7 +132,7 @@ def main() -> int:
                     choices=("standard", "migration", "cluster-proc",
                              "fleet", "fleet-host", "tracking",
                              "read-scale", "device-shard", "device-fault",
-                             "qos", "vector"),
+                             "qos", "vector", "residency"),
                     default="standard")
     ap.add_argument("--cycles", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
@@ -172,6 +182,14 @@ def main() -> int:
         )
 
         harness = DeviceFaultSoakHarness(DeviceFaultSoakConfig(
+            cycles=args.cycles, seed=args.seed,
+        ))
+    elif args.profile == "residency":
+        from redisson_tpu.chaos.soak import (
+            ResidencySoakConfig, ResidencySoakHarness,
+        )
+
+        harness = ResidencySoakHarness(ResidencySoakConfig(
             cycles=args.cycles, seed=args.seed,
         ))
     elif args.profile == "read-scale":
